@@ -1,0 +1,45 @@
+"""Headline benchmark: EC encode throughput, k=8 m=4, 4KiB stripes, batched.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline semantics: the north-star target (BASELINE.md) is >=10x isa-l
+encode throughput at k=8,m=4 on one v5e chip. The reference publishes no
+absolute numbers; we anchor on 5.0 GiB/s as a representative single-core
+isa-l k=8,m=4 figure (qualitative "fast SIMD" per
+reference src/erasure-code/isa/README), so vs_baseline = value / 5.0 — i.e.
+vs_baseline >= 10 means the north-star 10x is met.
+"""
+
+from __future__ import annotations
+
+import json
+
+ISA_L_BASELINE_GIBPS = 5.0
+
+
+def main() -> None:
+    from ceph_tpu.ec.benchmark import make_codec, run_encode, verify_all_erasures
+
+    # Correctness gate first: exhaustive erasure sweep on a small profile
+    # (every combination round-trips the device, so keep the sweep compact).
+    gate = make_codec("jax_rs", ["k=4", "m=2", "technique=reed_sol_van"])
+    verify_all_erasures(gate, size=4096)
+    ec = make_codec("jax_rs", ["k=8", "m=4", "technique=reed_sol_van"])
+    # 4KiB stripes (BASELINE config), large stripe batch per launch.
+    stripes = 4096
+    result = run_encode(ec, size=stripes * 4096, iterations=32, stripes=stripes)
+    value = result["GiBps"]
+    print(
+        json.dumps(
+            {
+                "metric": "ec_encode_k8_m4_4KiB_stripes",
+                "value": round(value, 3),
+                "unit": "GiB/s",
+                "vs_baseline": round(value / ISA_L_BASELINE_GIBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

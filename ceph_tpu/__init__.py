@@ -1,0 +1,22 @@
+"""ceph_tpu — a TPU-native distributed storage framework.
+
+A brand-new framework with the capabilities of Ceph (reference:
+EL-BACHIR-KASSIMI/ceph, v16 "pacific"), redesigned TPU-first:
+
+- ``ceph_tpu.ec``        — erasure coding: GF(2^8) engine lowered to XLA/Pallas
+  bitplane matmuls, with the same plugin surface as Ceph's
+  ``ErasureCodePluginRegistry`` (jax_rs / lrc / shec / clay).
+- ``ceph_tpu.placement`` — CRUSH-compatible straw2 placement, vectorized in JAX.
+- ``ceph_tpu.store``     — ObjectStore-style transactional host stores.
+- ``ceph_tpu.osd``       — EC backend data path (stripe math, write plan,
+  minimum_to_decode recovery), peering/recovery state machines.
+- ``ceph_tpu.mon``       — monitor-style epoch-versioned cluster maps, config db.
+- ``ceph_tpu.msg``       — asyncio messenger control plane; ICI collectives
+  (shard_map/psum/all_gather) are the data plane.
+- ``ceph_tpu.client``    — librados-like client API.
+- ``ceph_tpu.common``    — config registry, perf counters, logging, codecs.
+"""
+
+__version__ = "0.1.0"
+CEPH_RELEASE = 16          # parity marker with reference src/ceph_release
+CEPH_RELEASE_NAME = "pacific-tpu"

@@ -1,0 +1,18 @@
+"""Erasure coding subsystem (reference: src/erasure-code, SURVEY.md §2.1).
+
+The math core is GF(2^8) with polynomial 0x11D, realised three ways:
+
+- ``gf``        — exact numpy tables/ops (log/antilog, full mul table,
+                  Gaussian inversion). The ground truth.
+- ``reference`` — pure-numpy CPU encoder/decoder: the bit-exactness oracle
+                  (the analog of ceph-erasure-code-corpus non-regression).
+- ``engine``    — the TPU path: GF(2^8) matrix ops lowered to GF(2) bitplane
+                  matmuls on the MXU (XLA + Pallas kernels), batched over
+                  stripes, sharded over chips with shard_map.
+
+Plugin surface mirrors ErasureCodeInterface
+(reference src/erasure-code/ErasureCodeInterface.h:170-462).
+"""
+
+from ceph_tpu.ec.interface import ErasureCodeInterface  # noqa: F401
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry, instance  # noqa: F401

@@ -1,0 +1,161 @@
+"""Erasure-code benchmark harness.
+
+CLI mirror of reference src/test/erasure-code/ceph_erasure_code_benchmark.cc
+(flags --plugin/--workload/--size/--iterations/--erasures/--parameter
+:47-53; encode loop :156-179; exhaustive decode_erasures verification
+:202-243), extended with the stripe-batch dimension that wins the 10x target
+(BASELINE.md config #3: 1024-stripe batched encode on one chip).
+
+Usage:
+    python -m ceph_tpu.ec.benchmark --plugin jax_rs --workload encode \
+        --size $((1024*1024)) --iterations 64 --parameter k=8 --parameter m=4
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import time
+
+import numpy as np
+
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--plugin", default="jax_rs")
+    p.add_argument("--workload", choices=("encode", "decode"), default="encode")
+    p.add_argument("--size", type=int, default=1 << 20,
+                   help="total bytes per iteration")
+    p.add_argument("--iterations", type=int, default=16)
+    p.add_argument("--stripes", type=int, default=1024,
+                   help="stripe batch per device launch")
+    p.add_argument("--erasures", type=int, default=2,
+                   help="erasures per decode iteration")
+    p.add_argument("--erased", type=int, action="append", default=None,
+                   help="explicit chunk ids to erase (repeatable)")
+    p.add_argument("--parameter", "-P", action="append", default=[],
+                   help="profile key=value (repeatable)")
+    p.add_argument("--verify", action="store_true",
+                   help="exhaustively verify all erasure combinations "
+                        "(decode_erasures sweep)")
+    p.add_argument("--json", action="store_true", help="emit one JSON line")
+    return p.parse_args(argv)
+
+
+def make_codec(plugin: str, parameters: list[str]):
+    profile = {}
+    for kv in parameters:
+        key, _, val = kv.partition("=")
+        profile[key] = val
+    registry = ErasureCodePluginRegistry()
+    return registry.factory(plugin, profile)
+
+
+def run_encode(ec, size: int, iterations: int, stripes: int) -> dict:
+    """Throughput with device-resident stripes (the HBM analog of the
+    reference benchmark's RAM-resident bufferlists): one host->device
+    transfer up front, async dispatch, one sync at the end."""
+    import jax
+    import jax.numpy as jnp
+
+    k = ec.get_data_chunk_count()
+    chunk = ec.get_chunk_size(max(size // max(stripes, 1), 1))
+    data = np.random.default_rng(0).integers(
+        0, 256, (stripes, k, chunk), dtype=np.uint8
+    )
+    dev = jnp.asarray(data)
+    jax.block_until_ready(ec.encode_chunks_device(dev))  # warmup/compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iterations):
+        out = ec.encode_chunks_device(dev)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    total = data.nbytes * iterations
+    return {
+        "workload": "encode",
+        "bytes": total,
+        "seconds": dt,
+        "GiBps": total / dt / 2**30,
+        "chunk_size": chunk,
+        "stripes": stripes,
+    }
+
+
+def run_decode(ec, size: int, iterations: int, stripes: int,
+               erasures: int, erased=None) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    k = ec.get_data_chunk_count()
+    n = ec.get_chunk_count()
+    chunk = ec.get_chunk_size(max(size // max(stripes, 1), 1))
+    data = np.random.default_rng(0).integers(
+        0, 256, (stripes, k, chunk), dtype=np.uint8
+    )
+    chunks = ec.encode_chunks_device(jnp.asarray(data))
+    lost = list(erased) if erased else list(range(min(erasures, n)))
+    avail = {i: chunks[:, i] for i in range(n) if i not in lost}
+    jax.block_until_ready(ec.decode_chunks_device(avail, lost))  # warmup
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iterations):
+        out = ec.decode_chunks_device(avail, lost)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    total = data.nbytes * iterations
+    return {
+        "workload": "decode",
+        "bytes": total,
+        "seconds": dt,
+        "GiBps": total / dt / 2**30,
+        "erased": lost,
+        "chunk_size": chunk,
+        "stripes": stripes,
+    }
+
+
+def verify_all_erasures(ec, size: int = 4096) -> int:
+    """Exhaustive erasure sweep — every combination of up to m lost chunks
+    must reconstruct bit-identically (benchmark.cc:202-243 semantics).
+    Returns the number of combinations checked."""
+    k, n = ec.get_data_chunk_count(), ec.get_chunk_count()
+    m = n - k
+    payload = np.random.default_rng(1).integers(0, 256, size, np.uint8).tobytes()
+    enc = ec.encode(list(range(n)), payload)
+    checked = 0
+    for r in range(1, m + 1):
+        for lost in itertools.combinations(range(n), r):
+            avail = {i: enc[i] for i in range(n) if i not in lost}
+            out = ec.decode(list(lost), avail)
+            for w in lost:
+                if out[w] != enc[w]:
+                    raise AssertionError(f"mismatch: lost={lost} chunk={w}")
+            checked += 1
+    return checked
+
+
+def main(argv=None) -> dict:
+    args = _parse_args(argv)
+    ec = make_codec(args.plugin, args.parameter)
+    if args.verify:
+        n = verify_all_erasures(ec)
+        result = {"workload": "verify", "combinations": n, "ok": True}
+    elif args.workload == "encode":
+        result = run_encode(ec, args.size, args.iterations, args.stripes)
+    else:
+        result = run_decode(
+            ec, args.size, args.iterations, args.stripes,
+            args.erasures, args.erased,
+        )
+    result["plugin"] = args.plugin
+    result["profile"] = ec.get_profile()
+    print(json.dumps(result) if args.json else result)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""GF(2^8) coefficient matrix -> GF(2) bitmatrix expansion.
+
+The core trick that makes erasure coding TPU-native: a multiply-by-constant
+in GF(2^8) is a linear map over GF(2)^8, so an (m, k) byte matrix expands to
+an (8m, 8k) 0/1 matrix, and region encode becomes
+
+    parity_bits = (bitmatrix @ data_bits) mod 2
+
+— a small-by-huge integer matmul that runs on the MXU with exact f32
+accumulation (sums <= 8k << 2^24). This mirrors what jerasure's bitmatrix
+schedules do with CPU XORs (reference ErasureCodeJerasure.cc:265 schedule
+encode), but maps the XOR-accumulate onto the systolic array instead of a
+sequential XOR schedule.
+
+Bit order is LSB-first: bit i of byte b is (b >> i) & 1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.ec.gf import gf_mul
+
+
+def gf_matrix_to_bitmatrix(A: np.ndarray) -> np.ndarray:
+    """Expand (m, k) GF(2^8) matrix to (8m, 8k) GF(2) matrix.
+
+    Entry [r*8+i, c*8+j] = bit i of (A[r,c] * 2^j), so that for data bit
+    planes d[c*8+j] the parity bit planes are p = (M @ d) mod 2.
+    """
+    A = np.asarray(A, np.uint8)
+    m, k = A.shape
+    # prods[r, c, j] = A[r,c] * 2^j
+    shifts = (1 << np.arange(8, dtype=np.uint8))
+    prods = gf_mul(A[:, :, None], shifts[None, None, :])  # (m, k, 8)
+    # bits[r, c, j, i] = bit i of prods[r, c, j]
+    bits = (prods[..., None] >> np.arange(8, dtype=np.uint8)) & 1  # (m,k,8,8)
+    # target[r*8+i, c*8+j] -> transpose to (m, i, k, j)
+    out = bits.transpose(0, 3, 1, 2).reshape(8 * m, 8 * k)
+    return np.ascontiguousarray(out.astype(np.uint8))
+
+
+def bytes_to_bitplanes(data: np.ndarray) -> np.ndarray:
+    """(..., k, C) uint8 -> (..., 8k, C) 0/1 uint8, rows ordered c*8+j."""
+    data = np.asarray(data, np.uint8)
+    bits = (data[..., :, None, :] >> np.arange(8, dtype=np.uint8)[:, None]) & 1
+    shape = data.shape[:-2] + (data.shape[-2] * 8, data.shape[-1])
+    return bits.reshape(shape)
+
+
+def bitplanes_to_bytes(bits: np.ndarray) -> np.ndarray:
+    """(..., 8m, C) 0/1 -> (..., m, C) uint8, inverse of bytes_to_bitplanes."""
+    bits = np.asarray(bits, np.uint8)
+    m8, C = bits.shape[-2], bits.shape[-1]
+    grouped = bits.reshape(bits.shape[:-2] + (m8 // 8, 8, C))
+    weights = (1 << np.arange(8, dtype=np.uint16))[:, None]
+    return (grouped.astype(np.uint16) * weights).sum(axis=-2).astype(np.uint8)

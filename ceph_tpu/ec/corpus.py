@@ -1,0 +1,116 @@
+"""Bit-exactness non-regression corpus.
+
+The analog of Ceph's versioned ceph-erasure-code-corpus + the
+encode-decode-non-regression driver (reference
+qa/workunits/erasure-code/encode-decode-non-regression.sh:19-30 and
+src/test/erasure-code/ceph_erasure_code_non_regression.cc): for each
+(plugin, profile) we archive SHA-256 digests of every encoded chunk of a
+deterministic payload; every future version (and every execution path — CPU
+oracle, XLA, Pallas, sharded) must reproduce them bit-identically.
+
+    python -m ceph_tpu.ec.corpus create   # (re)generate corpus/
+    python -m ceph_tpu.ec.corpus check    # verify current code against it
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+CORPUS_DIR = pathlib.Path(__file__).resolve().parents[2] / "corpus"
+PAYLOAD_SEED = 0xCE5  # deterministic corpus payload seed
+PAYLOAD_SIZE = 31 * 1024 + 17  # deliberately unaligned
+
+# The archived profile matrix: spans every technique and the BASELINE.md
+# comparison configs (#1 k=4 m=2 reed_sol_van, #2 k=8 m=3 vandermonde,
+# #3 k=10 m=4 cauchy).
+PROFILES: list[tuple[str, dict[str, str]]] = [
+    ("jax_rs", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
+    ("jax_rs", {"k": "8", "m": "4", "technique": "reed_sol_van"}),
+    ("jax_rs", {"k": "8", "m": "3", "technique": "isa_vandermonde"}),
+    ("jax_rs", {"k": "10", "m": "4", "technique": "cauchy_orig"}),
+    ("jax_rs", {"k": "10", "m": "4", "technique": "cauchy_good"}),
+    ("jax_rs", {"k": "8", "m": "4", "technique": "isa_cauchy"}),
+    ("jax_rs", {"k": "6", "m": "2", "technique": "reed_sol_r6_op"}),
+    ("xor", {"k": "3", "m": "1"}),
+]
+
+
+def _payload() -> bytes:
+    rng = np.random.default_rng(PAYLOAD_SEED)
+    return rng.integers(0, 256, PAYLOAD_SIZE, dtype=np.uint8).tobytes()
+
+
+def _case_name(plugin: str, profile: dict[str, str]) -> str:
+    items = "_".join(f"{k}={profile[k]}" for k in sorted(profile))
+    return f"{plugin}_{items}"
+
+
+def _encode_digests(plugin: str, profile: dict[str, str]) -> dict:
+    registry = ErasureCodePluginRegistry()
+    ec = registry.factory(plugin, profile)
+    n = ec.get_chunk_count()
+    enc = ec.encode(list(range(n)), _payload())
+    return {
+        "plugin": plugin,
+        "profile": profile,
+        "payload_seed": PAYLOAD_SEED,
+        "payload_size": PAYLOAD_SIZE,
+        "chunk_size": len(enc[0]),
+        "chunk_sha256": {
+            str(i): hashlib.sha256(enc[i]).hexdigest() for i in range(n)
+        },
+    }
+
+
+def create(corpus_dir: pathlib.Path = CORPUS_DIR) -> list[str]:
+    corpus_dir.mkdir(exist_ok=True)
+    written = []
+    for plugin, profile in PROFILES:
+        rec = _encode_digests(plugin, profile)
+        path = corpus_dir / f"{_case_name(plugin, profile)}.json"
+        path.write_text(json.dumps(rec, indent=2, sort_keys=True) + "\n")
+        written.append(path.name)
+    return written
+
+
+def check(corpus_dir: pathlib.Path = CORPUS_DIR) -> list[str]:
+    """Returns list of failures (empty == pass). Raises if corpus missing."""
+    files = sorted(corpus_dir.glob("*.json"))
+    if not files:
+        raise FileNotFoundError(f"no corpus archives in {corpus_dir}")
+    failures = []
+    for path in files:
+        rec = json.loads(path.read_text())
+        now = _encode_digests(rec["plugin"], rec["profile"])
+        if now["chunk_sha256"] != rec["chunk_sha256"]:
+            bad = [
+                i
+                for i in rec["chunk_sha256"]
+                if now["chunk_sha256"].get(i) != rec["chunk_sha256"][i]
+            ]
+            failures.append(f"{path.name}: chunks {bad} diverged")
+    return failures
+
+
+def main() -> int:
+    cmd = sys.argv[1] if len(sys.argv) > 1 else "check"
+    if cmd == "create":
+        for name in create():
+            print(f"archived {name}")
+        return 0
+    failures = check()
+    for f in failures:
+        print(f"FAIL {f}")
+    print("corpus: %s" % ("FAIL" if failures else "OK"))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Built-in erasure code plugins.
+
+Each module exposes ``__erasure_code_init__(registry)`` — the Python analog
+of the ``__erasure_code_init`` C entry point the reference resolves after
+dlopen (reference src/erasure-code/ErasureCodePlugin.h:24-27).
+
+- ``jax_rs`` — RS/Cauchy matrix codes on the TPU bitplane engine; covers the
+  jerasure techniques (reed_sol_van, reed_sol_r6_op, cauchy_orig/good) and
+  the isa-l constructions (isa_vandermonde, isa_cauchy).
+- ``xor``    — trivial k+1 XOR code (the ErasureCodeExample analog).
+- ``lrc``    — layered locally-repairable code over inner plugins.
+- ``shec``   — shingled erasure code.
+- ``clay``   — coupled-layer MSR regenerating code (sub-chunked).
+"""

@@ -1,0 +1,181 @@
+"""jax_rs — the flagship RS/Cauchy codec on the TPU bitplane engine.
+
+Covers the techniques of both the jerasure plugin
+(reference src/erasure-code/jerasure/ErasureCodeJerasure.h:81-240 —
+reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good) and the isa plugin
+(reference src/erasure-code/isa/ErasureCodeIsa.cc:368-421 — Vandermonde and
+Cauchy constructions), executing all of them through one device kernel
+(engine.BitplaneEngine). The m=1 pure-XOR fast path of isa_encode
+(ErasureCodeIsa.cc:119-127 region_xor) falls out naturally: an all-ones
+coefficient row is an XOR in GF(2^8).
+
+The isa-flavoured Vandermonde technique enforces the reference's MDS-safety
+caps (m<=4; k<=21 when m=4 — ErasureCodeIsa.cc:330-360).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from ceph_tpu.ec import reference
+from ceph_tpu.ec.base import ErasureCode
+from ceph_tpu.ec.engine import default_engine
+from ceph_tpu.ec.matrix import generator_matrix
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+TECHNIQUES = (
+    "reed_sol_van",
+    "reed_sol_r6_op",
+    "cauchy_orig",
+    "cauchy_good",
+    "isa_vandermonde",
+    "isa_cauchy",
+)
+
+DEFAULT_K = 2
+DEFAULT_M = 2
+DEFAULT_TECHNIQUE = "reed_sol_van"
+
+
+class ErasureCodeJaxRS(ErasureCode):
+    def __init__(self, profile: Mapping[str, str] | None = None):
+        super().__init__()
+        self.k = DEFAULT_K
+        self.m = DEFAULT_M
+        self.technique = DEFAULT_TECHNIQUE
+        self.generator: np.ndarray | None = None
+        self._engine = default_engine()
+        self._decode_matrix_cache: dict[tuple, np.ndarray] = {}
+
+    # -- profile ---------------------------------------------------------
+    def parse(self, profile: Mapping[str, str]) -> None:
+        self.k = self.to_int(profile, "k", DEFAULT_K)
+        self.m = self.to_int(profile, "m", DEFAULT_M)
+        self.technique = str(profile.get("technique", DEFAULT_TECHNIQUE))
+        w = self.to_int(profile, "w", 8)
+        if w != 8:
+            raise ValueError(f"jax_rs supports w=8 only, got w={w}")
+        if self.k < 1 or self.m < 1:
+            raise ValueError(f"k={self.k} m={self.m} must be >= 1")
+        if self.k + self.m > 256:
+            raise ValueError("k+m must be <= 256 in GF(2^8)")
+        if self.technique not in TECHNIQUES:
+            raise ValueError(
+                f"unknown technique {self.technique!r}; have {TECHNIQUES}"
+            )
+        if self.technique == "isa_vandermonde":
+            # Matrix-safety caps (ErasureCodeIsa.cc:330-360).
+            if self.m > 4:
+                raise ValueError("isa_vandermonde requires m <= 4")
+            if self.m == 4 and self.k > 21:
+                raise ValueError("isa_vandermonde m=4 requires k <= 21")
+        if self.technique == "reed_sol_r6_op" and self.m != 2:
+            raise ValueError("reed_sol_r6_op requires m=2")
+        self.generator = generator_matrix(self.technique, self.k, self.m)
+        self._decode_matrix_cache.clear()
+
+    # -- geometry --------------------------------------------------------
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    # -- encode ----------------------------------------------------------
+    def encode_chunks(self, data_chunks) -> np.ndarray:
+        out = self._engine.encode(self.generator, np.asarray(data_chunks))
+        return np.asarray(out)
+
+    def encode_chunks_batch(self, data) -> np.ndarray:
+        """(B, k, C) -> (B, k+m, C); the stripe-batched hot path."""
+        return np.asarray(self._engine.encode(self.generator, data))
+
+    def encode_chunks_device(self, data):
+        """Device-array in, device-array out — no host round trip.
+
+        The hot path for callers that keep stripes resident in HBM (the
+        in-memory analog of ceph_erasure_code_benchmark's RAM-resident
+        buffers)."""
+        return self._engine.encode(self.generator, data)
+
+    def decode_chunks_device(self, available, want_to_read):
+        """Batched device-resident reconstruct: available maps chunk id ->
+        (B, C) device arrays; returns (B, len(want), C) device array."""
+        import jax.numpy as jnp
+
+        want = [int(w) for w in want_to_read]
+        avail_ids = sorted(int(i) for i in available)
+        if len(avail_ids) < self.k:
+            raise IOError(f"cannot decode {want}")
+        survivors = tuple(avail_ids[: self.k])
+        D = self._decode_matrix(survivors, tuple(want))
+        stacked = jnp.stack([available[s] for s in survivors], axis=1)
+        return self._engine.apply(D, stacked)
+
+    # -- decode ----------------------------------------------------------
+    def _decode_matrix(
+        self, survivors: tuple[int, ...], wanted: tuple[int, ...]
+    ) -> np.ndarray:
+        key = (survivors, wanted)
+        hit = self._decode_matrix_cache.get(key)
+        if hit is None:
+            hit = reference.decode_matrix(
+                self.generator, list(survivors), list(wanted)
+            )
+            if len(self._decode_matrix_cache) >= 512:
+                self._decode_matrix_cache.pop(
+                    next(iter(self._decode_matrix_cache))
+                )
+            self._decode_matrix_cache[key] = hit
+        return hit
+
+    def decode_chunks(
+        self, available: Mapping[int, np.ndarray], want_to_read: Sequence[int]
+    ) -> dict[int, np.ndarray]:
+        avail = {int(i): np.asarray(c, np.uint8) for i, c in available.items()}
+        want = [int(w) for w in want_to_read]
+        out: dict[int, np.ndarray] = {}
+        missing = [w for w in want if w not in avail]
+        if missing:
+            if len(avail) < self.k:
+                raise IOError(
+                    f"cannot decode {missing}: only {len(avail)} of "
+                    f"k={self.k} chunks available"
+                )
+            survivors = tuple(sorted(avail)[: self.k])
+            D = self._decode_matrix(survivors, tuple(missing))
+            stacked = np.stack([avail[s] for s in survivors])
+            rebuilt = np.asarray(self._engine.apply(D, stacked))
+            for i, w in enumerate(missing):
+                out[w] = rebuilt[i]
+        for w in want:
+            if w in avail:
+                out[w] = avail[w]
+        return out
+
+    def decode_chunks_batch(
+        self, available: Mapping[int, np.ndarray], want_to_read: Sequence[int]
+    ) -> dict[int, np.ndarray]:
+        """Batched reconstruct: available chunks are (B, C) arrays."""
+        avail = {int(i): np.asarray(c, np.uint8) for i, c in available.items()}
+        want = [int(w) for w in want_to_read]
+        missing = [w for w in want if w not in avail]
+        out: dict[int, np.ndarray] = {w: avail[w] for w in want if w in avail}
+        if missing:
+            if len(avail) < self.k:
+                raise IOError(f"cannot decode {missing}")
+            survivors = tuple(sorted(avail)[: self.k])
+            D = self._decode_matrix(survivors, tuple(missing))
+            stacked = np.stack(
+                [avail[s] for s in survivors], axis=1
+            )  # (B, k, C)
+            rebuilt = np.asarray(self._engine.apply(D, stacked))  # (B, |missing|, C)
+            for i, w in enumerate(missing):
+                out[w] = rebuilt[:, i]
+        return out
+
+
+def __erasure_code_init__(registry: ErasureCodePluginRegistry) -> None:
+    registry.add("jax_rs", ErasureCodeJaxRS)

@@ -1,0 +1,36 @@
+"""xor — minimal k+1 XOR code, the ErasureCodeExample analog.
+
+The reference uses a trivial XOR codec (src/test/erasure-code/
+ErasureCodeExample.h, k=2 m=1) to exercise registry/interface machinery
+without real GF math; same purpose here, and it doubles as the m=1
+region_xor fast path (reference ErasureCodeIsa.cc:119-127).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from ceph_tpu.ec.plugins.jax_rs import ErasureCodeJaxRS
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+
+class ErasureCodeXor(ErasureCodeJaxRS):
+    def parse(self, profile: Mapping[str, str]) -> None:
+        self.k = self.to_int(profile, "k", 2)
+        self.m = self.to_int(profile, "m", 1)
+        if self.m != 1:
+            raise ValueError("xor plugin requires m=1")
+        if self.k < 1:
+            raise ValueError("xor plugin requires k >= 1")
+        self.technique = "xor"
+        full = np.zeros((self.k + 1, self.k), dtype=np.uint8)
+        full[: self.k] = np.eye(self.k, dtype=np.uint8)
+        full[self.k] = 1  # GF(2^8) sum of all data chunks == XOR
+        self.generator = full
+        self._decode_matrix_cache.clear()
+
+
+def __erasure_code_init__(registry: ErasureCodePluginRegistry) -> None:
+    registry.add("xor", ErasureCodeXor)

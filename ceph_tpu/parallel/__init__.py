@@ -1,0 +1,15 @@
+"""Multi-chip parallelism: the ICI/DCN data plane.
+
+TPU-native replacement for the reference's shard fan-out over the network
+messenger (SURVEY.md §2.10): EC stripe batches shard over a device mesh
+('dp' axis = declustered stripe parallelism), encoded chunks fan out across
+the 'cs' axis (chunk sharding — the MOSDECSubOpWrite fan-out of
+reference osd/ECBackend.cc:2090-2106 becomes an all_to_all over ICI), and
+repair reads ride all_gather (BASELINE.md config #5 LRC shard-group repair).
+"""
+
+from ceph_tpu.parallel.ec_sharding import (  # noqa: F401
+    distributed_ec_step,
+    make_ec_mesh,
+    sharded_encode,
+)

@@ -1,0 +1,149 @@
+"""Sharded EC execution over a jax.sharding.Mesh.
+
+Axes:
+- ``dp``  — stripe-batch data parallelism (declustered placement analog:
+            independent stripes on independent devices).
+- ``cs``  — chunk sharding: the k+m chunks of one stripe live on distinct
+            devices/failure domains (the shard_t axis of
+            reference osd/osd_types.h / ECUtil.h:28-65 — positions are NOT
+            interchangeable).
+
+The full step = every device encodes its own stripe block -> chunks fan out
+across 'cs' with an all_to_all (the ICI analog of the per-shard
+MOSDECSubOpWrite fan-out, reference osd/ECBackend.cc:2090-2106) -> each
+device holds one chunk slice of every stripe in its cs-group. Repair =
+all_gather of shard slices within the group + decode-matrix matmul
+(objects_read_and_reconstruct / get_min_avail_to_read_shards semantics,
+reference ECBackend.cc:2364,1613 — recovery reads become ICI collectives,
+BASELINE.md configs #4/#5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+from ceph_tpu.ec import bitmatrix as bm
+from ceph_tpu.ec import reference
+
+
+def make_ec_mesh(devices=None, cs: int = 1) -> Mesh:
+    """Mesh with ('dp', 'cs') axes; cs must divide the device count."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % cs:
+        raise ValueError(f"cs={cs} must divide device count {n}")
+    arr = np.array(devices).reshape(n // cs, cs)
+    return Mesh(arr, ("dp", "cs"))
+
+
+def _encode_bits_matrix(generator: np.ndarray) -> jnp.ndarray:
+    k = generator.shape[1]
+    return jnp.asarray(bm.gf_matrix_to_bitmatrix(generator[k:]), jnp.bfloat16)
+
+
+def _apply_bits(mat: jax.Array, data: jax.Array) -> jax.Array:
+    """Same math as engine._apply_bitmatrix, inlined for shard_map bodies."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (data[:, :, None, :] >> shifts[None, None, :, None]) & 1
+    batch, k, _, C = bits.shape
+    bits = bits.reshape(batch, k * 8, C).astype(jnp.bfloat16)
+    acc = jnp.einsum("pq,bqc->bpc", mat, bits,
+                     preferred_element_type=jnp.float32)
+    pbits = (acc.astype(jnp.int32) & 1).reshape(batch, -1, 8, C)
+    weights = jnp.int32(1) << jnp.arange(8, dtype=jnp.int32)
+    return jnp.sum(pbits * weights[None, None, :, None], axis=2).astype(
+        jnp.uint8
+    )
+
+
+def sharded_encode(mesh: Mesh, generator: np.ndarray, data) -> jax.Array:
+    """Encode a stripe batch sharded over every mesh device.
+
+    data: (B, k, C) uint8, B divisible by the total device count.
+    Returns (B, k+m, C), batch-sharded the same way.
+    """
+    mat = _encode_bits_matrix(generator)
+    batch_spec = P(("dp", "cs"), None, None)
+    data = jax.device_put(
+        jnp.asarray(data, jnp.uint8), NamedSharding(mesh, batch_spec)
+    )
+
+    @jax.jit
+    def step(d):
+        def local(d_blk):
+            parity = _apply_bits(mat, d_blk)
+            return jnp.concatenate([d_blk, parity], axis=1)
+
+        return shard_map(
+            local, mesh=mesh, in_specs=batch_spec, out_specs=batch_spec
+        )(d)
+
+    return step(data)
+
+
+def distributed_ec_step(
+    mesh: Mesh, generator: np.ndarray, data, lost_chunk: int = 0
+):
+    """Full distributed EC step: encode + chunk fan-out + repair.
+
+    data: (B, k, C) uint8, B divisible by dp*cs and k+m divisible by cs.
+
+    Returns ``(shard_slices, repaired)``:
+    - shard_slices: (B, k+m, C) — chunk axis sharded over 'cs' (each device
+      holds its (k+m)/cs chunk columns for every stripe of its cs-group);
+    - repaired: (B, C) — chunk ``lost_chunk`` reconstructed from survivors,
+      bit-identical to the encoded chunk.
+    """
+    k, n = generator.shape[1], generator.shape[0]
+    cs = mesh.shape["cs"]
+    if n % cs:
+        raise ValueError(f"k+m={n} must be divisible by cs={cs}")
+    enc_mat = _encode_bits_matrix(generator)
+
+    survivors = [i for i in range(n) if i != lost_chunk][:k]
+    D = reference.decode_matrix(generator, survivors, [lost_chunk])
+    dec_mat = jnp.asarray(bm.gf_matrix_to_bitmatrix(D), jnp.bfloat16)
+    surv_idx = jnp.asarray(survivors, jnp.int32)
+
+    batch_spec = P(("dp", "cs"), None, None)
+    data = jax.device_put(
+        jnp.asarray(data, jnp.uint8), NamedSharding(mesh, batch_spec)
+    )
+
+    @jax.jit
+    def step(d):
+        def body(d_blk):  # (b, k, C) per device, b = B/(dp*cs)
+            parity = _apply_bits(enc_mat, d_blk)
+            chunks = jnp.concatenate([d_blk, parity], axis=1)  # (b, n, C)
+            # Chunk fan-out over ICI: device j of the cs-group ends up with
+            # chunk columns [j*n/cs, (j+1)*n/cs) of all cs*b group stripes.
+            b, _, C = chunks.shape
+            grouped = chunks.reshape(b, cs, n // cs, C)
+            # split_axis is consumed; received pieces stack as a new leading
+            # source-device axis -> (cs_src, b, n/cs, C).
+            a2a = jax.lax.all_to_all(
+                grouped, "cs", split_axis=1, concat_axis=0
+            )
+            shard = a2a.reshape(cs * b, n // cs, C)
+            # Repair read fan-in: regather every slice within the group.
+            full = jax.lax.all_gather(
+                shard, "cs", axis=1, tiled=True
+            )  # (cs*b, n, C)
+            surv = jnp.take(full, surv_idx, axis=1)  # (cs*b, k, C)
+            repaired = _apply_bits(dec_mat, surv)[:, 0]  # (cs*b, C)
+            return shard, repaired
+
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=batch_spec,
+            out_specs=(P("dp", "cs", None), P("dp", None)),
+            check_vma=False,
+        )(d)
+
+    return step(data)

@@ -1,0 +1,17 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective paths are
+validated on a virtual CPU mesh exactly as the driver's dryrun does. Must run
+before any JAX backend is initialised (sitecustomize registers the axon TPU
+backend, so we override via jax.config, which wins over JAX_PLATFORMS).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,14 @@
+"""Corpus non-regression: CPU execution must reproduce the archived chunk
+digests (which were generated on real TPU hardware) bit-identically —
+the cross-backend analog of encode-decode-non-regression.sh."""
+
+from ceph_tpu.ec import corpus
+
+
+def test_corpus_exists():
+    assert sorted(corpus.CORPUS_DIR.glob("*.json")), "corpus not generated"
+
+
+def test_corpus_reproduced_bit_identically():
+    failures = corpus.check()
+    assert not failures, failures

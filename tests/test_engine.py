@@ -1,0 +1,72 @@
+"""Device engine parity: XLA bitplane matmul must be bit-identical to the
+numpy oracle (the corpus-style non-regression gate, SURVEY.md §4 tier 5)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import matrix, reference
+from ceph_tpu.ec.engine import BitplaneEngine, default_engine
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, shape, dtype=np.uint8)
+
+
+@pytest.mark.parametrize(
+    "technique,k,m",
+    [
+        ("reed_sol_van", 4, 2),
+        ("reed_sol_van", 8, 4),
+        ("cauchy_good", 10, 4),
+        ("isa_cauchy", 8, 4),
+        ("isa_vandermonde", 8, 3),
+    ],
+)
+def test_engine_encode_bit_identical(technique, k, m):
+    G = matrix.generator_matrix(technique, k, m)
+    data = _rand((k, 512), seed=k + m)
+    expect = reference.encode(G, data)
+    got = np.asarray(default_engine().encode(G, data))
+    assert got.dtype == np.uint8
+    assert np.array_equal(got, expect)
+
+
+def test_engine_encode_batched():
+    G = matrix.generator_matrix("reed_sol_van", 8, 4)
+    data = _rand((16, 8, 256), seed=3)
+    got = np.asarray(default_engine().encode(G, data))
+    assert got.shape == (16, 12, 256)
+    for b in range(16):
+        assert np.array_equal(got[b], reference.encode(G, data[b]))
+
+
+def test_engine_apply_decode_matrix():
+    k, m = 8, 4
+    G = matrix.generator_matrix("cauchy_good", k, m)
+    data = _rand((k, 256), seed=9)
+    chunks = reference.encode(G, data)
+    lost = [1, 5, 9]
+    survivors = [i for i in range(k + m) if i not in lost][:k]
+    D = reference.decode_matrix(G, survivors, lost)
+    got = np.asarray(default_engine().apply(D, chunks[survivors]))
+    for i, w in enumerate(lost):
+        assert np.array_equal(got[i], chunks[w])
+
+
+def test_engine_matrix_cache_eviction():
+    eng = BitplaneEngine(max_cached_matrices=2)
+    data = _rand((2, 128), seed=1)
+    for c in range(5):
+        coeff = np.full((1, 2), c + 1, np.uint8)
+        eng.apply(coeff, data)
+    assert len(eng._cache) <= 2
+
+
+def test_engine_large_k_exact_accumulation():
+    # k=64 -> 512-wide bit rows; sums up to 512 must stay exact.
+    k, m = 64, 4
+    G = matrix.cauchy_rs(k, m)
+    data = _rand((k, 128), seed=11)
+    expect = reference.encode(G, data)
+    got = np.asarray(default_engine().encode(G, data))
+    assert np.array_equal(got, expect)

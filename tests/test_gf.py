@@ -1,0 +1,74 @@
+"""GF(2^8) math core tests (field axioms, tables, matrix inversion)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import gf
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf.GF_EXP[gf.GF_LOG[a]] == a
+
+
+def test_mul_table_vs_peasant():
+    # Independent carry-less "Russian peasant" multiply as cross-check.
+    def peasant(a, b):
+        p = 0
+        while b:
+            if b & 1:
+                p ^= a
+            b >>= 1
+            a <<= 1
+            if a & 0x100:
+                a ^= gf.GF_POLY
+        return p
+
+    rng = np.random.default_rng(0)
+    for a, b in rng.integers(0, 256, size=(500, 2)):
+        assert gf.gf_mul(a, b) == peasant(int(a), int(b))
+
+
+def test_mul_axioms():
+    rng = np.random.default_rng(1)
+    a, b, c = (rng.integers(1, 256, 64, dtype=np.uint8) for _ in range(3))
+    assert np.all(gf.gf_mul(a, b) == gf.gf_mul(b, a))
+    assert np.all(gf.gf_mul(a, gf.gf_mul(b, c)) == gf.gf_mul(gf.gf_mul(a, b), c))
+    # distributive over XOR
+    assert np.all(gf.gf_mul(a, b ^ c) == (gf.gf_mul(a, b) ^ gf.gf_mul(a, c)))
+
+
+def test_inverse():
+    a = np.arange(1, 256, dtype=np.uint8)
+    assert np.all(gf.gf_mul(a, gf.gf_inv(a)) == 1)
+    with pytest.raises(ZeroDivisionError):
+        gf.gf_inv(0)
+
+
+def test_pow():
+    assert gf.gf_pow(0, 0) == 1
+    assert gf.gf_pow(0, 5) == 0
+    assert gf.gf_pow(7, 1) == 7
+    x = 1
+    for n in range(10):
+        assert gf.gf_pow(3, n) == x
+        x = int(gf.gf_mul(x, 3))
+
+
+def test_matrix_inverse_roundtrip():
+    rng = np.random.default_rng(2)
+    eye = np.eye(8, dtype=np.uint8)
+    for _ in range(20):
+        A = rng.integers(0, 256, (8, 8), dtype=np.uint8)
+        try:
+            Ainv = gf.gf_inv_matrix(A)
+        except ValueError:
+            continue  # singular draw
+        assert np.array_equal(gf.gf_matmul(A, Ainv), eye)
+        assert np.array_equal(gf.gf_matmul(Ainv, A), eye)
+
+
+def test_singular_matrix_raises():
+    A = np.zeros((4, 4), dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf.gf_inv_matrix(A)

@@ -1,0 +1,149 @@
+"""Plugin registry + jax_rs/xor plugin interface-level tests.
+
+Covers the territory of reference TestErasureCode.cc /
+TestErasureCodePlugin*.cc: registry loading, profile validation, padding
+semantics, encode/decode round trips, minimum_to_decode."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+
+@pytest.fixture()
+def registry():
+    return ErasureCodePluginRegistry()
+
+
+def _codec(registry, profile=None, plugin="jax_rs"):
+    prof = {"k": "8", "m": "4", "technique": "reed_sol_van"}
+    if profile:
+        prof.update(profile)
+    return registry.factory(plugin, prof)
+
+
+def test_registry_load_and_factory(registry):
+    ec = _codec(registry)
+    assert ec.get_chunk_count() == 12
+    assert ec.get_data_chunk_count() == 8
+    assert ec.get_sub_chunk_count() == 1
+
+
+def test_registry_unknown_plugin(registry):
+    with pytest.raises(ImportError):
+        registry.load("no_such_plugin")
+
+
+def test_registry_duplicate_add(registry):
+    registry.load("xor")
+    with pytest.raises(KeyError):
+        registry.add("xor", lambda p: None)
+
+
+def test_profile_validation(registry):
+    with pytest.raises(ValueError):
+        _codec(registry, {"technique": "bogus"})
+    with pytest.raises(ValueError):
+        _codec(registry, {"w": "16"})
+    with pytest.raises(ValueError):
+        _codec(registry, {"k": "zebra"})
+    with pytest.raises(ValueError):
+        _codec(registry, {"technique": "isa_vandermonde", "m": "5"})
+    with pytest.raises(ValueError):
+        _codec(registry, {"technique": "reed_sol_r6_op", "m": "4"})
+
+
+def test_chunk_size_padding(registry):
+    ec = _codec(registry)
+    align = ec.get_alignment()
+    # chunk size is align-multiple; k*chunk >= object size
+    for size in (1, 100, 4096, 4097, 1 << 20):
+        cs = ec.get_chunk_size(size)
+        assert cs % align == 0
+        assert cs * 8 >= size
+    assert ec.get_chunk_size(0) == align
+
+
+def test_encode_decode_roundtrip_bytes(registry):
+    ec = _codec(registry)
+    payload = bytes(range(256)) * 37  # not chunk aligned
+    encoded = ec.encode(list(range(12)), payload)
+    assert set(encoded) == set(range(12))
+    sizes = {len(v) for v in encoded.values()}
+    assert len(sizes) == 1
+    # drop m chunks, reconstruct, reassemble
+    avail = {i: encoded[i] for i in range(12) if i not in (0, 3, 9, 11)}
+    out = ec.decode([0, 3, 9, 11], avail)
+    for i in (0, 3, 9, 11):
+        assert out[i] == encoded[i]
+    restored = ec.decode_concat(avail)
+    assert restored[: len(payload)] == payload
+
+
+def test_decode_passthrough_when_available(registry):
+    ec = _codec(registry)
+    payload = b"x" * 5000
+    encoded = ec.encode(list(range(12)), payload)
+    out = ec.decode([2], {2: encoded[2], 0: encoded[0]})
+    assert out[2] == encoded[2]
+
+
+def test_decode_insufficient_chunks(registry):
+    ec = _codec(registry)
+    payload = b"y" * 1024
+    encoded = ec.encode(list(range(12)), payload)
+    avail = {i: encoded[i] for i in range(5)}  # < k=8
+    with pytest.raises(IOError):
+        ec.decode([11], avail)
+
+
+def test_minimum_to_decode(registry):
+    ec = _codec(registry)
+    # all wanted available -> exactly the wanted set
+    got = ec.minimum_to_decode([0, 1], list(range(12)))
+    assert got == {0: [(0, 1)], 1: [(0, 1)]}
+    # a wanted chunk lost -> k survivors
+    got = ec.minimum_to_decode([0], [1, 2, 3, 4, 5, 6, 7, 8, 9])
+    assert len(got) == 8
+    with pytest.raises(IOError):
+        ec.minimum_to_decode([0], [1, 2, 3])
+
+
+def test_minimum_to_decode_with_cost(registry):
+    ec = _codec(registry)
+    costs = {i: (1 if i >= 4 else 100) for i in range(12)}
+    got = ec.minimum_to_decode_with_cost([0], costs)
+    # chunk 0 is available so it's returned directly regardless of cost
+    assert got == {0: [(0, 1)]}
+    costs.pop(0)
+    got = ec.minimum_to_decode_with_cost([0], costs)
+    assert set(got) == {4, 5, 6, 7, 8, 9, 10, 11}
+
+
+def test_xor_plugin(registry):
+    ec = registry.factory("xor", {"k": "3"})
+    payload = b"hello world" * 100
+    enc = ec.encode([0, 1, 2, 3], payload)
+    a = np.frombuffer(enc[0], np.uint8)
+    b = np.frombuffer(enc[1], np.uint8)
+    c = np.frombuffer(enc[2], np.uint8)
+    p = np.frombuffer(enc[3], np.uint8)
+    assert np.array_equal(p, a ^ b ^ c)
+    out = ec.decode([1], {0: enc[0], 2: enc[2], 3: enc[3]})
+    assert out[1] == enc[1]
+
+
+def test_all_erasure_patterns_plugin_level(registry):
+    """decode_erasures-style sweep at the plugin level
+    (reference ceph_erasure_code_benchmark.cc:202-243)."""
+    import itertools
+
+    ec = _codec(registry, {"k": "4", "m": "2", "technique": "cauchy_good"})
+    payload = np.random.default_rng(5).integers(0, 256, 4096, np.uint8).tobytes()
+    enc = ec.encode(list(range(6)), payload)
+    for n in (1, 2):
+        for lost in itertools.combinations(range(6), n):
+            avail = {i: enc[i] for i in range(6) if i not in lost}
+            out = ec.decode(list(lost), avail)
+            for w in lost:
+                assert out[w] == enc[w], f"lost={lost} chunk={w}"
