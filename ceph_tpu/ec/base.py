@@ -120,6 +120,10 @@ class ErasureCode(ErasureCodeInterface):
         sizes = {a.shape[0] for a in avail.values()}
         if len(sizes) > 1:
             raise ValueError(f"chunks have mismatched sizes {sorted(sizes)}")
+        if chunk_size is not None and sizes and sizes != {chunk_size}:
+            raise ValueError(
+                f"chunks are {sizes.pop()} bytes, expected chunk_size={chunk_size}"
+            )
         want = [int(w) for w in want_to_read]
         out = self.decode_chunks(avail, want)
         return {w: np.asarray(out[w]).tobytes() for w in want}

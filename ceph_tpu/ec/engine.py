@@ -27,9 +27,14 @@ import numpy as np
 from ceph_tpu.ec import bitmatrix as bm
 
 
-@jax.jit
-def _apply_bitmatrix(bits_matrix: jax.Array, data: jax.Array) -> jax.Array:
-    """(P, Q) bf16 0/1 matrix x (B, Q/8, C) uint8 -> (B, P/8, C) uint8."""
+def bitplane_apply(bits_matrix: jax.Array, data: jax.Array) -> jax.Array:
+    """(P, Q) bf16 0/1 matrix x (B, Q/8, C) uint8 -> (B, P/8, C) uint8.
+
+    THE exactness-critical kernel: every execution path (single chip,
+    shard_map bodies, Pallas comparisons) must call this one function so the
+    corpus oracle covers them all. Traceable; callers jit it or call it
+    inside their own jitted/shard_mapped code.
+    """
     shifts = jnp.arange(8, dtype=jnp.uint8)
     bits = (data[:, :, None, :] >> shifts[None, None, :, None]) & 1
     batch, k, _, C = bits.shape
@@ -45,6 +50,9 @@ def _apply_bitmatrix(bits_matrix: jax.Array, data: jax.Array) -> jax.Array:
     weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))
     out = jnp.sum(pbits * weights[None, None, :, None], axis=2)
     return out.astype(jnp.uint8)
+
+
+_apply_bitmatrix = jax.jit(bitplane_apply)
 
 
 class BitplaneEngine:

@@ -127,12 +127,9 @@ def _bitmatrix_ones(row: np.ndarray) -> int:
     c*2^j; total ones = sum of popcounts. This is the XOR cost the
     cauchy_good optimisation minimises.
     """
-    total = 0
-    for c in row:
-        c = int(c)
-        for j in range(8):
-            total += bin(int(gf_mul(c, 1 << j))).count("1")
-    return total
+    shifts = (1 << np.arange(8, dtype=np.uint8))
+    prods = GF_MUL_TABLE[np.asarray(row, np.uint8)[:, None], shifts[None, :]]
+    return int(np.unpackbits(prods).sum())
 
 
 def cauchy_good(k: int, m: int) -> np.ndarray:

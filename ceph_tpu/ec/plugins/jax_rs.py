@@ -48,6 +48,8 @@ class ErasureCodeJaxRS(ErasureCode):
         self.generator: np.ndarray | None = None
         self._engine = default_engine()
         self._decode_matrix_cache: dict[tuple, np.ndarray] = {}
+        if profile is not None:
+            self.init(profile)
 
     # -- profile ---------------------------------------------------------
     def parse(self, profile: Mapping[str, str]) -> None:

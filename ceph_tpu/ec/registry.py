@@ -48,6 +48,7 @@ class ErasureCodePluginRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        self._load_lock = threading.Lock()  # serialises import+register
         self._plugins: dict[str, ErasureCodePlugin] = {}
 
     @classmethod
@@ -74,26 +75,27 @@ class ErasureCodePluginRegistry:
 
         ``module_path`` overrides the default package location, playing the
         role of the plugin directory argument in the reference loader."""
-        plugin = self.get(name)
-        if plugin is not None:
+        with self._load_lock:
+            plugin = self.get(name)
+            if plugin is not None:
+                return plugin
+            path = module_path or f"{DEFAULT_PLUGIN_PACKAGE}.{name}"
+            try:
+                module = importlib.import_module(path)
+            except ImportError as e:
+                raise ImportError(f"erasure code plugin {name!r}: {e}") from e
+            entry = getattr(module, ENTRY_POINT, None)
+            if entry is None:
+                raise ImportError(
+                    f"plugin module {path} has no {ENTRY_POINT} entry point"
+                )
+            entry(self)
+            plugin = self.get(name)
+            if plugin is None:
+                raise ImportError(
+                    f"plugin module {path} entry point did not register {name!r}"
+                )
             return plugin
-        path = module_path or f"{DEFAULT_PLUGIN_PACKAGE}.{name}"
-        try:
-            module = importlib.import_module(path)
-        except ImportError as e:
-            raise ImportError(f"erasure code plugin {name!r}: {e}") from e
-        entry = getattr(module, ENTRY_POINT, None)
-        if entry is None:
-            raise ImportError(
-                f"plugin module {path} has no {ENTRY_POINT} entry point"
-            )
-        entry(self)
-        plugin = self.get(name)
-        if plugin is None:
-            raise ImportError(
-                f"plugin module {path} entry point did not register {name!r}"
-            )
-        return plugin
 
     def preload(self, names=BUILTIN_PLUGINS) -> None:
         for name in names:

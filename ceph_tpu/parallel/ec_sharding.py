@@ -29,6 +29,7 @@ shard_map = jax.shard_map
 
 from ceph_tpu.ec import bitmatrix as bm
 from ceph_tpu.ec import reference
+from ceph_tpu.ec.engine import bitplane_apply as _apply_bits
 
 
 def make_ec_mesh(devices=None, cs: int = 1) -> Mesh:
@@ -44,21 +45,6 @@ def make_ec_mesh(devices=None, cs: int = 1) -> Mesh:
 def _encode_bits_matrix(generator: np.ndarray) -> jnp.ndarray:
     k = generator.shape[1]
     return jnp.asarray(bm.gf_matrix_to_bitmatrix(generator[k:]), jnp.bfloat16)
-
-
-def _apply_bits(mat: jax.Array, data: jax.Array) -> jax.Array:
-    """Same math as engine._apply_bitmatrix, inlined for shard_map bodies."""
-    shifts = jnp.arange(8, dtype=jnp.uint8)
-    bits = (data[:, :, None, :] >> shifts[None, None, :, None]) & 1
-    batch, k, _, C = bits.shape
-    bits = bits.reshape(batch, k * 8, C).astype(jnp.bfloat16)
-    acc = jnp.einsum("pq,bqc->bpc", mat, bits,
-                     preferred_element_type=jnp.float32)
-    pbits = (acc.astype(jnp.int32) & 1).reshape(batch, -1, 8, C)
-    weights = jnp.int32(1) << jnp.arange(8, dtype=jnp.int32)
-    return jnp.sum(pbits * weights[None, None, :, None], axis=2).astype(
-        jnp.uint8
-    )
 
 
 def sharded_encode(mesh: Mesh, generator: np.ndarray, data) -> jax.Array:
