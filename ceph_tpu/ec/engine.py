@@ -55,6 +55,19 @@ def bitplane_apply(bits_matrix: jax.Array, data: jax.Array) -> jax.Array:
 _apply_bitmatrix = jax.jit(bitplane_apply)
 
 
+def _default_use_pallas() -> bool:
+    """Fused Pallas kernel on real TPU; XLA einsum elsewhere (CPU tests,
+    interpret-mode covers the Pallas math there)."""
+    import os
+
+    if os.environ.get("CEPH_TPU_NO_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 class BitplaneEngine:
     """Caches device-resident bitmatrices and runs region ops.
 
@@ -64,25 +77,47 @@ class BitplaneEngine:
     matrix bytes.
     """
 
-    def __init__(self, max_cached_matrices: int = 256):
+    def __init__(self, max_cached_matrices: int = 256,
+                 use_pallas: bool | None = None):
         self._max = max_cached_matrices
         self._cache: dict[bytes, jax.Array] = {}
+        self._pallas_cache: dict[bytes, object] = {}
+        self.use_pallas = (
+            _default_use_pallas() if use_pallas is None else use_pallas
+        )
+
+    def _cached(self, cache: dict, coeff: np.ndarray, factory):
+        """FIFO-bounded per-coefficient-matrix cache lookup."""
+        key = coeff.tobytes() + bytes(coeff.shape)
+        hit = cache.get(key)
+        if hit is None:
+            hit = factory(coeff)
+            if len(cache) >= self._max:
+                cache.pop(next(iter(cache)))
+            cache[key] = hit
+        return hit
 
     def _device_bitmatrix(self, coeff: np.ndarray) -> jax.Array:
-        key = coeff.tobytes() + bytes(coeff.shape)
-        hit = self._cache.get(key)
-        if hit is not None:
-            return hit
-        mat = jnp.asarray(bm.gf_matrix_to_bitmatrix(coeff), jnp.bfloat16)
-        if len(self._cache) >= self._max:
-            self._cache.pop(next(iter(self._cache)))
-        self._cache[key] = mat
-        return mat
+        return self._cached(
+            self._cache,
+            coeff,
+            lambda c: jnp.asarray(bm.gf_matrix_to_bitmatrix(c), jnp.bfloat16),
+        )
+
+    def _pallas_applier(self, coeff: np.ndarray):
+        from ceph_tpu.ec.pallas_kernels import PallasBitplaneApply
+
+        return self._cached(self._pallas_cache, coeff, PallasBitplaneApply)
 
     def apply(self, coeff: np.ndarray, data) -> jax.Array:
         """Apply a GF(2^8) coefficient matrix (m, k) to data (B, k, C)."""
-        mat = self._device_bitmatrix(np.asarray(coeff, np.uint8))
+        from ceph_tpu.ec.pallas_kernels import LANE
+
+        coeff = np.asarray(coeff, np.uint8)
         data = jnp.asarray(data, jnp.uint8)
+        if self.use_pallas and data.shape[-1] % LANE == 0:
+            return self._pallas_applier(coeff)(data)
+        mat = self._device_bitmatrix(coeff)
         if data.ndim == 2:
             return _apply_bitmatrix(mat, data[None])[0]
         return _apply_bitmatrix(mat, data)

@@ -1,0 +1,435 @@
+"""CRUSH map model + rule evaluation.
+
+The map/rule data model of reference src/crush/crush.h + CrushWrapper.h,
+with the rule-step machine of crush_do_rule (mapper.c:900), choose_firstn
+(:461) and choose_indep (:650) — reimplemented as explicit Python state with
+straw2 draws vectorized per bucket. Tunables default to the reference's
+modern profile (choose_total_tries=50, chooseleaf_descend_once/vary_r/stable
+on, local retries off).
+
+Buckets are straw2 (the modern default; reference deprecates straw) or
+uniform (equal weights). Device ids >= 0; bucket ids < 0.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ceph_tpu.placement.hashing import crush_hash32_2
+from ceph_tpu.placement.straw2 import straw2_draws
+
+ITEM_NONE = 0x7FFFFFFF  # CRUSH_ITEM_NONE: indep hole marker
+DEVICE_TYPE = 0
+
+
+@dataclass
+class Tunables:
+    """mapper.c tunables, modern ("jewel"+) defaults."""
+
+    choose_total_tries: int = 50
+    choose_local_retries: int = 0
+    choose_local_fallback_retries: int = 0
+    chooseleaf_descend_once: bool = True
+    chooseleaf_vary_r: int = 1
+    chooseleaf_stable: int = 1
+
+
+@dataclass
+class Bucket:
+    id: int
+    type_id: int
+    name: str
+    alg: str = "straw2"
+    items: list[int] = field(default_factory=list)
+    weights: list[int] = field(default_factory=list)  # 16.16 fixed point
+
+    @property
+    def weight(self) -> int:
+        return sum(self.weights)
+
+
+@dataclass
+class Rule:
+    name: str
+    steps: list[tuple]
+    rule_id: int = -1
+    # step forms:
+    #   ("take", bucket_name)
+    #   ("choose_firstn" | "chooseleaf_firstn" |
+    #    "choose_indep"  | "chooseleaf_indep", num, type_name)
+    #   ("emit",)
+
+
+def weight_to_fp(w: float) -> int:
+    return int(round(w * 0x10000))
+
+
+class CrushMap:
+    def __init__(self, tunables: Tunables | None = None):
+        self.tunables = tunables or Tunables()
+        self.types: dict[str, int] = {"osd": DEVICE_TYPE}
+        self.buckets: dict[int, Bucket] = {}
+        self.names: dict[str, int] = {}
+        self.rules: dict[str, Rule] = {}
+        self.max_device = 0
+        self._next_bucket_id = -1
+        self._parent: dict[int, int] = {}  # child bucket id -> parent id
+
+    # -- construction (builder.c / CrushWrapper facade) ------------------
+    def add_type(self, name: str) -> int:
+        if name not in self.types:
+            self.types[name] = max(self.types.values()) + 1
+        return self.types[name]
+
+    def add_bucket(
+        self, name: str, type_name: str, alg: str = "straw2"
+    ) -> Bucket:
+        if name in self.names:
+            raise ValueError(f"bucket {name!r} exists")
+        bid = self._next_bucket_id
+        self._next_bucket_id -= 1
+        b = Bucket(bid, self.add_type(type_name), name, alg)
+        self.buckets[bid] = b
+        self.names[name] = bid
+        return b
+
+    def add_item(self, bucket: Bucket | str, item: int | Bucket,
+                 weight: float | None = None) -> None:
+        """Add a device id or child bucket to a bucket. Child buckets
+        default to their subtree weight, and weight changes cascade up the
+        tree (CrushWrapper::insert_item / adjust_item_weight semantics) so
+        construction order cannot silently zero out a subtree."""
+        if isinstance(bucket, str):
+            bucket = self.buckets[self.names[bucket]]
+        if isinstance(item, Bucket):
+            item_id = item.id
+            w = item.weight if weight is None else weight_to_fp(weight)
+            self._parent[item_id] = bucket.id
+        else:
+            item_id = int(item)
+            if item_id < 0:
+                raise ValueError("device ids must be >= 0")
+            w = weight_to_fp(1.0 if weight is None else weight)
+            self.max_device = max(self.max_device, item_id + 1)
+        bucket.items.append(item_id)
+        bucket.weights.append(w)
+        self._propagate_weight(bucket)
+
+    def _propagate_weight(self, bucket: Bucket) -> None:
+        """Refresh ancestors' stored weight for ``bucket`` subtrees."""
+        child = bucket
+        while child.id in self._parent:
+            parent = self.buckets[self._parent[child.id]]
+            idx = parent.items.index(child.id)
+            parent.weights[idx] = child.weight
+            child = parent
+
+    def add_rule(self, rule: Rule) -> Rule:
+        rule.rule_id = len(self.rules) if rule.rule_id < 0 else rule.rule_id
+        self.rules[rule.name] = rule
+        return rule
+
+    def create_replicated_rule(
+        self, name: str, failure_domain: str = "host", root: str = "default"
+    ) -> Rule:
+        return self.add_rule(Rule(name, [
+            ("take", root),
+            ("chooseleaf_firstn", 0, failure_domain),
+            ("emit",),
+        ]))
+
+    def create_ec_rule(
+        self,
+        name: str,
+        chunk_count: int,
+        failure_domain: str = "host",
+        root: str = "default",
+        device_class: str = "",
+    ) -> Rule:
+        """EC rules use indep (holes allowed, positions stable) —
+        ErasureCodeInterface.h:212 / ErasureCode::create_rule semantics."""
+        if device_class:
+            raise NotImplementedError(
+                "crush device classes (class-shadow trees) are not yet "
+                "supported; omit device_class"
+            )
+        return self.add_rule(Rule(name, [
+            ("take", root),
+            ("chooseleaf_indep", chunk_count, failure_domain),
+            ("emit",),
+        ]))
+
+    # -- mapping ---------------------------------------------------------
+    def _is_out(self, reweights, item: int, x: int) -> bool:
+        """Reweight test (mapper.c:424): probabilistically reject devices
+        with reweight < 1.0."""
+        if reweights is None:
+            return False
+        if item >= len(reweights):
+            return True
+        w = reweights[item]
+        if w >= 0x10000:
+            return False
+        if w == 0:
+            return True
+        return (int(crush_hash32_2(x, item)) & 0xFFFF) >= w
+
+    def _bucket_choose(self, bucket: Bucket, x: int, r: int) -> int:
+        if bucket.alg == "uniform":
+            # uniform buckets: hash-pick ignoring weights
+            idx = int(crush_hash32_2(x, bucket.id + r * 2654435761)) % len(
+                bucket.items
+            )
+            return bucket.items[idx]
+        draws = straw2_draws(x, bucket.items, bucket.weights, r)
+        return bucket.items[int(np.argmax(draws))]
+
+    def _choose_firstn(
+        self, bucket: Bucket, x: int, numrep: int, type_id: int,
+        out: list[int], out2: list[int] | None, reweights,
+        tries: int, recurse_tries: int, recurse_to_leaf: bool,
+        parent_r: int = 0, stable: bool | None = None,
+    ) -> None:
+        """crush_choose_firstn (mapper.c:461) semantics."""
+        t = self.tunables
+        stable = t.chooseleaf_stable if stable is None else stable
+        outpos = len(out)
+        rep_range = range(0, numrep) if stable else range(outpos, numrep)
+        for rep in rep_range:
+            if len(out) >= numrep:
+                break
+            ftotal = 0
+            item = None
+            while True:  # descent retries
+                node = bucket
+                r = rep + parent_r + ftotal
+                ok = False
+                while True:  # walk down through intervening buckets
+                    if not node.items:
+                        break
+                    item = self._bucket_choose(node, x, r)
+                    itemtype = (
+                        DEVICE_TYPE if item >= 0
+                        else self.buckets[item].type_id
+                    )
+                    if itemtype != type_id:
+                        if item >= 0:
+                            break  # bad: device where bucket expected
+                        node = self.buckets[item]
+                        continue
+                    # candidate at the target type
+                    if item in out:
+                        break  # collision
+                    if recurse_to_leaf and item < 0:
+                        sub_r = r >> (t.chooseleaf_vary_r - 1) \
+                            if t.chooseleaf_vary_r else 0
+                        leaf_out: list[int] = []
+                        self._choose_firstn(
+                            self.buckets[item], x, 1, DEVICE_TYPE,
+                            leaf_out, None, reweights,
+                            recurse_tries, 0, False,
+                            parent_r=sub_r, stable=True,
+                        )
+                        if not leaf_out or leaf_out[0] in (out2 or []):
+                            break  # no leaf / leaf collision
+                        if out2 is not None:
+                            out2.append(leaf_out[0])
+                        ok = True
+                        break
+                    if itemtype == DEVICE_TYPE and self._is_out(
+                        reweights, item, x
+                    ):
+                        break  # rejected by reweight
+                    if recurse_to_leaf and item >= 0 and out2 is not None:
+                        out2.append(item)
+                    ok = True
+                    break
+                if ok:
+                    out.append(item)
+                    break
+                ftotal += 1
+                if ftotal >= tries:
+                    break  # skip this replica
+
+    def _choose_indep(
+        self, bucket: Bucket, x: int, numrep: int, type_id: int,
+        out: list[int], out2: list[int] | None, reweights,
+        tries: int, recurse_tries: int, recurse_to_leaf: bool,
+        parent_r: int = 0,
+    ) -> None:
+        """crush_choose_indep (mapper.c:650): breadth-first, positionally
+        stable, holes allowed (ITEM_NONE)."""
+        endpos = numrep
+        while len(out) < endpos:
+            out.append(None)  # UNDEF
+            if out2 is not None:
+                out2.append(None)
+        left = sum(1 for v in out if v is None)
+        for ftotal in range(tries):
+            if left <= 0:
+                break
+            for rep in range(endpos):
+                if out[rep] is not None:
+                    continue
+                node = bucket
+                while True:
+                    # r recomputed per descent level from the CURRENT node
+                    # (mapper.c:721-727): uniform buckets whose size divides
+                    # numrep get the (numrep+1) anti-cycling stride.
+                    r = rep + parent_r
+                    if (node.alg == "uniform"
+                            and len(node.items) % numrep == 0):
+                        r += (numrep + 1) * ftotal
+                    else:
+                        r += numrep * ftotal
+                    if not node.items:
+                        break
+                    item = self._bucket_choose(node, x, r)
+                    itemtype = (
+                        DEVICE_TYPE if item >= 0
+                        else self.buckets[item].type_id
+                    )
+                    if itemtype != type_id:
+                        if item >= 0:
+                            out[rep] = ITEM_NONE
+                            if out2 is not None:
+                                out2[rep] = ITEM_NONE
+                            left -= 1
+                            break
+                        node = self.buckets[item]
+                        continue
+                    if item in out:
+                        break  # collision; retry next ftotal round
+                    if recurse_to_leaf and item < 0:
+                        self._choose_indep_leaf(
+                            self.buckets[item], x, rep, numrep,
+                            out2, reweights, recurse_tries, r,
+                        )
+                        if out2 is not None and out2[rep] is None:
+                            break  # no leaf
+                    if itemtype == DEVICE_TYPE and self._is_out(
+                        reweights, item, x
+                    ):
+                        break  # rejected by reweight; retry next round
+                    if recurse_to_leaf and item >= 0 and out2 is not None:
+                        out2[rep] = item
+                    out[rep] = item
+                    left -= 1
+                    break
+        for rep in range(endpos):
+            if out[rep] is None:
+                out[rep] = ITEM_NONE
+                if out2 is not None:
+                    # never leak a leaf from an attempt whose position
+                    # ultimately failed
+                    out2[rep] = ITEM_NONE
+            if out2 is not None and out2[rep] is None:
+                out2[rep] = ITEM_NONE
+
+    def _choose_indep_leaf(
+        self, bucket: Bucket, x: int, rep: int, numrep: int,
+        out2: list, reweights, tries: int, parent_r: int,
+    ) -> None:
+        """The chooseleaf recursion of indep: place 1 leaf at position rep
+        (mapper.c:782-791: recursive call with left=1)."""
+        node = bucket
+        for ftotal in range(tries):
+            node = bucket
+            r = rep + parent_r + numrep * ftotal
+            placed = False
+            while True:
+                if not node.items:
+                    break
+                item = self._bucket_choose(node, x, r)
+                if item < 0:
+                    node = self.buckets[item]
+                    continue
+                if item in (out2 or []):
+                    break
+                if self._is_out(reweights, item, x):
+                    break
+                out2[rep] = item
+                placed = True
+                break
+            if placed:
+                return
+
+    def map_pgs(
+        self,
+        rule: Rule | str,
+        xs: Sequence[int],
+        result_max: int,
+        reweights: Sequence[int] | None = None,
+    ) -> np.ndarray:
+        """Bulk PG mapping (the OSDMapMapping.cc threaded-bulk analog,
+        reference src/osd/OSDMapMapping.cc): map many placement inputs at
+        once. Returns (len(xs), result_max) int32, ITEM_NONE-padded."""
+        out = np.full((len(xs), result_max), ITEM_NONE, np.int32)
+        for i, x in enumerate(xs):
+            row = self.do_rule(rule, int(x), result_max, reweights)
+            out[i, : len(row)] = row
+        return out
+
+    def do_rule(
+        self,
+        rule: Rule | str,
+        x: int,
+        result_max: int,
+        reweights: Sequence[int] | None = None,
+    ) -> list[int]:
+        """Evaluate a rule for input x (crush_do_rule, mapper.c:900).
+
+        Returns up to result_max ids; indep rules pad holes with ITEM_NONE.
+        ``reweights``: per-device 16.16 reweight vector for is_out.
+        """
+        if isinstance(rule, str):
+            rule = self.rules[rule]
+        t = self.tunables
+        tries = t.choose_total_tries + 1
+        result: list[int] = []
+        w: list[int] = []
+        for step in rule.steps:
+            op = step[0]
+            if op == "take":
+                name = step[1]
+                if name not in self.names:
+                    raise KeyError(f"take: unknown bucket {name!r}")
+                w = [self.names[name]]
+            elif op == "emit":
+                result.extend(w[: result_max - len(result)])
+                w = []
+            elif op in ("choose_firstn", "chooseleaf_firstn",
+                        "choose_indep", "chooseleaf_indep"):
+                numrep, type_name = step[1], step[2]
+                if numrep <= 0:
+                    numrep += result_max
+                type_id = self.types[type_name]
+                leaf = op.startswith("chooseleaf")
+                firstn = op.endswith("firstn")
+                recurse_tries = (
+                    1 if t.chooseleaf_descend_once else tries
+                ) if firstn else 1
+                out: list[int] = []
+                out2: list[int] = [] if leaf else None
+                for wid in w:
+                    if wid >= 0 or wid not in self.buckets:
+                        continue
+                    if firstn:
+                        self._choose_firstn(
+                            self.buckets[wid], x, numrep, type_id,
+                            out, out2, reweights, tries, recurse_tries,
+                            leaf,
+                        )
+                    else:
+                        self._choose_indep(
+                            self.buckets[wid], x, numrep, type_id,
+                            out, out2, reweights, tries, recurse_tries,
+                            leaf,
+                        )
+                w = out2 if leaf else out
+            else:
+                raise ValueError(f"unknown rule op {op!r}")
+        return result
