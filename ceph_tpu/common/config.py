@@ -1,0 +1,184 @@
+"""Typed config registry + live proxy.
+
+The shape of the reference's option system (src/common/options.cc — one
+typed schema with metadata; src/common/config.h:70 md_config_t;
+config_proxy.h ConfigProxy; config_obs.h observers), with sources merged in
+the same precedence order: schema defaults < config file < central config db
+(mon) < environment < runtime overrides. ~Levels and runtime-changeable
+flags are preserved; the 2,000-option catalogue grows as subsystems land.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Mapping
+
+class Level(Enum):
+    BASIC = "basic"
+    ADVANCED = "advanced"
+    DEV = "dev"
+
+
+@dataclass
+class Option:
+    name: str
+    type: type = str  # str | int | float | bool
+    default: Any = None
+    description: str = ""
+    level: Level = Level.ADVANCED
+    min: float | None = None
+    max: float | None = None
+    enum_values: tuple = ()
+    runtime: bool = True  # changeable without restart
+
+    def validate(self, value):
+        try:
+            if self.type is bool and isinstance(value, str):
+                value = value.lower() in ("1", "true", "yes", "on")
+            else:
+                value = self.type(value)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"option {self.name}: {value!r} is not {self.type.__name__}"
+            ) from None
+        if self.min is not None and value < self.min:
+            raise ValueError(f"option {self.name}: {value} < min {self.min}")
+        if self.max is not None and value > self.max:
+            raise ValueError(f"option {self.name}: {value} > max {self.max}")
+        if self.enum_values and value not in self.enum_values:
+            raise ValueError(
+                f"option {self.name}: {value!r} not in {self.enum_values}"
+            )
+        return value
+
+
+def global_options() -> list[Option]:
+    """The built-in schema (get_global_options analog). Subsystems extend
+    via ConfigProxy.register()."""
+    return [
+        Option("cluster", str, "ceph-tpu", "cluster name", Level.BASIC),
+        Option("osd_pool_default_size", int, 3, "replica count", min=1),
+        Option("osd_pool_default_min_size", int, 0, "min replicas to serve"),
+        Option("osd_pool_default_pg_num", int, 32, "default pg count", min=1),
+        Option("osd_heartbeat_interval", float, 0.5, "peer ping interval (s)",
+               min=0.01),
+        Option("osd_heartbeat_grace", float, 3.0,
+               "seconds of silence before reporting a peer down", min=0.1),
+        Option("mon_osd_min_down_reporters", int, 1,
+               "distinct reporters required to mark an osd down", min=1),
+        Option("mon_osd_down_out_interval", float, 30.0,
+               "seconds before a down osd is marked out"),
+        Option("osd_erasure_code_plugins", str, "jax_rs lrc shec clay xor",
+               "plugins preloaded at osd start"),
+        Option("osd_recovery_max_active", int, 8,
+               "max concurrent recovery ops", min=1),
+        Option("osd_client_op_priority", int, 63, "client op priority"),
+        Option("ms_inject_socket_failures", int, 0,
+               "1-in-N artificial connection failures (0=off)", Level.DEV),
+        Option("ms_inject_delay_max", float, 0.0,
+               "max artificial delivery delay (s)", Level.DEV),
+        Option("ec_stripe_batch", int, 1024,
+               "stripes per device encode launch", min=1),
+        Option("ec_use_pallas", bool, True,
+               "use fused Pallas kernels on TPU"),
+        Option("log_to_memory_ring", bool, True, "keep crash ring buffer"),
+        Option("debug_default", int, 1, "default subsystem debug level",
+               min=0, max=20),
+    ]
+
+
+class ConfigProxy:
+    """Thread-safe merged view of the config sources + observer fan-out."""
+
+    def __init__(self, conf_file: str | None = None,
+                 overrides: Mapping[str, Any] | None = None):
+        self._lock = threading.RLock()
+        self._schema: dict[str, Option] = {}
+        self._values: dict[str, Any] = {}        # merged non-default values
+        self._sources: dict[str, str] = {}       # name -> source tag
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+        for opt in global_options():
+            self._schema[opt.name] = opt
+        if conf_file and os.path.exists(conf_file):
+            with open(conf_file) as f:
+                for name, value in json.load(f).items():
+                    self._apply(name, value, "file")
+        for name, opt in self._schema.items():
+            env = os.environ.get("CEPH_TPU_" + name.upper())
+            if env is not None:
+                self._apply(name, env, "env")
+        for name, value in (overrides or {}).items():
+            self._apply(name, value, "override")
+
+    # -- schema ----------------------------------------------------------
+    def register(self, options: list[Option]) -> None:
+        with self._lock:
+            for opt in options:
+                if opt.name not in self._schema:
+                    self._schema[opt.name] = opt
+
+    def schema(self) -> dict[str, Option]:
+        with self._lock:
+            return dict(self._schema)
+
+    # -- access ----------------------------------------------------------
+    def _apply(self, name: str, value, source: str):
+        opt = self._schema.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        self._values[name] = opt.validate(value)
+        self._sources[name] = source
+
+    def get(self, name: str):
+        with self._lock:
+            if name in self._values:
+                return self._values[name]
+            return self._schema[name].default
+
+    def __getitem__(self, name: str):
+        return self.get(name)
+
+    def set(self, name: str, value, source: str = "runtime") -> None:
+        """Runtime set (``ceph config set`` analog); notifies observers."""
+        with self._lock:
+            opt = self._schema.get(name)
+            if opt is None:
+                raise KeyError(f"unknown option {name!r}")
+            if not opt.runtime and source == "runtime":
+                raise PermissionError(f"option {name} requires restart")
+            self._apply(name, value, source)
+            observers = list(self._observers.get(name, ()))
+            value = self._values[name]
+        for cb in observers:
+            cb(name, value)
+
+    def apply_central(self, values: Mapping[str, Any]) -> None:
+        """Apply a central-config-db snapshot (MConfig delivery analog,
+        reference mon/MonClient.cc:432). Respects precedence: values set
+        from env or explicit overrides outrank the central db."""
+        for name, value in values.items():
+            if name in self._schema:
+                if self._sources.get(name) in ("env", "override"):
+                    continue
+                self.set(name, value, source="mon")
+
+    def observe(self, name: str, callback: Callable[[str, Any], None]):
+        """Hot-reload observer (config_obs.h analog)."""
+        with self._lock:
+            self._observers.setdefault(name, []).append(callback)
+
+    def show(self) -> dict[str, dict]:
+        """``config show`` analog: every option with value + source."""
+        with self._lock:
+            return {
+                name: {
+                    "value": self.get(name),
+                    "source": self._sources.get(name, "default"),
+                    "level": opt.level.value,
+                }
+                for name, opt in sorted(self._schema.items())
+            }

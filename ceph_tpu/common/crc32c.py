@@ -1,0 +1,74 @@
+"""crc32c (Castagnoli) with native C fast path.
+
+Loads ceph_tpu/native/libceph_tpu_native.so via ctypes (auto-built with
+make on first use; g++/gcc are in the image), falling back to a pure-Python
+table loop. Semantics match ceph_crc32c(seed, buf, len)
+(reference src/common/crc32c.h): callers chain seeds; ECUtil HashInfo uses
+the previous cumulative crc as the seed for each appended shard extent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[1] / "native"
+_SO = _NATIVE_DIR / "libceph_tpu_native.so"
+
+_native = None
+
+
+def _load_native():
+    global _native
+    if _native is not None:
+        return _native
+    if not _SO.exists():
+        try:
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR), "-s"],
+                check=True, capture_output=True, timeout=60,
+            )
+        except Exception:
+            _native = False
+            return False
+    try:
+        lib = ctypes.CDLL(str(_SO))
+        lib.ceph_tpu_crc32c.restype = ctypes.c_uint32
+        lib.ceph_tpu_crc32c.argtypes = (
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t,
+        )
+        _native = lib
+    except OSError:
+        _native = False
+    return _native
+
+
+_TABLE = None
+
+
+def _table():
+    global _TABLE
+    if _TABLE is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            tbl.append(c)
+        _TABLE = tbl
+    return _TABLE
+
+
+def crc32c(crc: int, data: bytes | bytearray | memoryview) -> int:
+    """Castagnoli CRC over ``data`` seeded with ``crc``."""
+    if not isinstance(data, bytes):
+        data = bytes(data)  # bytes pass to ctypes zero-copy
+    lib = _load_native()
+    if lib:
+        return int(lib.ceph_tpu_crc32c(crc & 0xFFFFFFFF, data, len(data)))
+    tbl = _table()
+    c = (~crc) & 0xFFFFFFFF
+    for b in data:
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return (~c) & 0xFFFFFFFF

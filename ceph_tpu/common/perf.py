@@ -1,0 +1,146 @@
+"""Perf counters + histograms.
+
+The metric model of reference src/common/perf_counters.h:154 (typed
+counters: u64 count, time, averages with (sum,count) pairs) and
+src/perf_histogram.h (2D axis-configured histograms), exposed as
+``perf dump``-style nested dicts (admin socket / mgr report payloads).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class CounterType(Enum):
+    U64 = "u64"          # monotonically increasing counter
+    GAUGE = "gauge"      # settable level
+    TIME = "time"        # accumulated seconds
+    LONGRUNAVG = "avg"   # (sum, count) average pair
+
+
+@dataclass
+class _Counter:
+    type: CounterType
+    value: float = 0.0
+    sum: float = 0.0
+    count: int = 0
+
+
+class PerfCounters:
+    """One subsystem's counter set (PerfCounters analog); create via
+    PerfCountersCollection.create()."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: dict[str, _Counter] = {}
+
+    def add(self, key: str, ctype: CounterType = CounterType.U64) -> None:
+        with self._lock:
+            self._counters.setdefault(key, _Counter(ctype))
+
+    def inc(self, key: str, by: float = 1) -> None:
+        with self._lock:
+            c = self._counters[key]
+            c.value += by
+
+    def dec(self, key: str, by: float = 1) -> None:
+        self.inc(key, -by)
+
+    def set(self, key: str, value: float) -> None:
+        with self._lock:
+            self._counters[key].value = value
+
+    def tinc(self, key: str, seconds: float) -> None:
+        """Accumulate elapsed time (or any (sum,count) sample)."""
+        with self._lock:
+            c = self._counters[key]
+            c.sum += seconds
+            c.count += 1
+            c.value = c.sum
+
+    def time(self, key: str):
+        """Context manager measuring a code section into a TIME/AVG counter."""
+        perf = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                perf.tinc(key, time.perf_counter() - self.t0)
+                return False
+
+        return _Timer()
+
+    def dump(self) -> dict:
+        with self._lock:
+            out = {}
+            for key, c in self._counters.items():
+                if c.type == CounterType.LONGRUNAVG or c.count:
+                    out[key] = {"sum": c.sum, "avgcount": c.count}
+                else:
+                    out[key] = c.value
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            for c in self._counters.values():
+                c.value = c.sum = 0.0
+                c.count = 0
+
+
+class Histogram:
+    """Linear/log-binned histogram (perf_histogram.h analog, 1D form)."""
+
+    def __init__(self, name: str, buckets: list[float]):
+        self.name = name
+        self.buckets = list(buckets)  # upper bounds, ascending
+        self.counts = [0] * (len(buckets) + 1)
+        self._lock = threading.Lock()
+
+    def sample(self, value: float) -> None:
+        with self._lock:
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {
+                "buckets": self.buckets,
+                "counts": list(self.counts),
+            }
+
+
+class PerfCountersCollection:
+    """Process-wide registry; the ``perf dump`` aggregation point."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sets: dict[str, PerfCounters] = {}
+        self._hists: dict[str, Histogram] = {}
+
+    def create(self, name: str) -> PerfCounters:
+        with self._lock:
+            return self._sets.setdefault(name, PerfCounters(name))
+
+    def create_histogram(self, name: str, buckets: list[float]) -> Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(name, buckets)
+            return h
+
+    def dump(self) -> dict:
+        with self._lock:
+            out = {name: s.dump() for name, s in self._sets.items()}
+            for name, h in self._hists.items():
+                out[name + "_histogram"] = h.dump()
+            return out

@@ -1,0 +1,12 @@
+"""OSD data plane (reference src/osd, SURVEY.md §2.2).
+
+- ``ec_util``   — stripe_info_t geometry math + per-shard cumulative crc
+  HashInfo (reference osd/ECUtil.h:28-65, ECUtil.cc:123,182).
+- ``ec_backend``— the EC write/read/recovery pipeline over an ObjectStore
+  (reference osd/ECBackend.cc submit/read/recover paths) with async/await
+  replacing the callback pipeline.
+- ``osd_map``   — epoch-versioned cluster map + incrementals
+  (reference osd/OSDMap.h:354, pg_to_raw_osds OSDMap.cc:2585).
+- ``pg``        — placement-group state, log, and peering
+  (reference osd/PeeringState.h:556, PGLog.h).
+"""

@@ -1,0 +1,173 @@
+"""OSDMap: epoch-versioned cluster map + incrementals.
+
+Mirrors reference osd/OSDMap.{h,cc}: pools, osd up/in state + reweights,
+placement pipeline pg_to_raw_osds -> _raw_to_up_osds -> pg_temp overrides
+(reference OSDMap.cc:2585, 2395 crush call, 2472 raw_to_up), and
+OSDMap::Incremental deltas (OSDMap.h:354). Serializable to plain dicts for
+the wire/monitor store.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ceph_tpu.placement.crush_map import CrushMap, ITEM_NONE, Rule
+from ceph_tpu.placement.hashing import crush_hash32_2
+
+NO_OSD = -1  # CRUSH_ITEM_NONE mapped to acting-set hole
+
+
+@dataclass
+class OSDInfo:
+    up: bool = False
+    in_cluster: bool = True
+    weight: int = 0x10000       # in/out reweight, 16.16
+    addr: str = ""
+
+
+@dataclass
+class PoolInfo:
+    pool_id: int
+    name: str
+    pool_type: str = "replicated"           # or "erasure"
+    size: int = 3                            # replicas, or k+m for EC
+    min_size: int = 2
+    pg_num: int = 32
+    crush_rule: str = "replicated_rule"
+    ec_profile: str = ""                     # EC profile name
+
+    def raw_pg_to_pps(self, ps: int) -> int:
+        """Placement seed: stable mod then mix with pool id
+        (pg_pool_t::raw_pg_to_pps semantics)."""
+        return int(crush_hash32_2(ps % self.pg_num, self.pool_id))
+
+
+@dataclass
+class Incremental:
+    epoch: int
+    new_up: dict[int, str] = field(default_factory=dict)       # osd -> addr
+    new_down: list[int] = field(default_factory=list)
+    new_weights: dict[int, int] = field(default_factory=dict)  # 16.16
+    new_pools: list[PoolInfo] = field(default_factory=list)
+    removed_pools: list[int] = field(default_factory=list)
+    new_pg_temp: dict[tuple[int, int], list[int]] = field(default_factory=dict)
+    new_primary_temp: dict[tuple[int, int], int] = field(default_factory=dict)
+
+
+class OSDMap:
+    def __init__(self, crush: CrushMap | None = None):
+        self.epoch = 0
+        self.crush = crush or CrushMap()
+        self.osds: dict[int, OSDInfo] = {}
+        self.pools: dict[int, PoolInfo] = {}
+        self.pg_temp: dict[tuple[int, int], list[int]] = {}
+        self.primary_temp: dict[tuple[int, int], int] = {}
+
+    # -- mutation via incrementals --------------------------------------
+    def apply_incremental(self, inc: Incremental) -> None:
+        if inc.epoch != self.epoch + 1:
+            raise ValueError(
+                f"incremental epoch {inc.epoch} != {self.epoch + 1}"
+            )
+        for osd, addr in inc.new_up.items():
+            info = self.osds.setdefault(osd, OSDInfo())
+            info.up, info.addr = True, addr
+        for osd in inc.new_down:
+            if osd in self.osds:
+                self.osds[osd].up = False
+        for osd, w in inc.new_weights.items():
+            info = self.osds.setdefault(osd, OSDInfo())
+            info.weight = w
+            info.in_cluster = w > 0
+        for pool in inc.new_pools:
+            self.pools[pool.pool_id] = pool
+        for pid in inc.removed_pools:
+            self.pools.pop(pid, None)
+            self.pg_temp = {
+                k: v for k, v in self.pg_temp.items() if k[0] != pid
+            }
+            self.primary_temp = {
+                k: v for k, v in self.primary_temp.items() if k[0] != pid
+            }
+        for pgid, osds in inc.new_pg_temp.items():
+            if osds:
+                self.pg_temp[pgid] = list(osds)
+            else:
+                self.pg_temp.pop(pgid, None)
+        for pgid, osd in inc.new_primary_temp.items():
+            if osd == NO_OSD:
+                self.primary_temp.pop(pgid, None)
+            else:
+                self.primary_temp[pgid] = osd
+        self.epoch = inc.epoch
+
+    # -- queries ---------------------------------------------------------
+    def is_up(self, osd: int) -> bool:
+        return osd in self.osds and self.osds[osd].up
+
+    def reweight_vector(self) -> list[int]:
+        n = max(self.osds, default=-1) + 1
+        vec = [0] * n
+        for osd, info in self.osds.items():
+            vec[osd] = info.weight if info.in_cluster else 0
+        return vec
+
+    # -- placement pipeline ---------------------------------------------
+    def pg_to_raw_osds(self, pool_id: int, ps: int) -> list[int]:
+        """CRUSH evaluation (OSDMap.cc:2395 _pg_to_raw_osds)."""
+        pool = self.pools[pool_id]
+        pps = pool.raw_pg_to_pps(ps)
+        out = self.crush.do_rule(
+            pool.crush_rule, pps, pool.size, self.reweight_vector()
+        )
+        return [NO_OSD if o == ITEM_NONE else o for o in out]
+
+    def raw_to_up_osds(self, pool_id: int, raw: list[int]) -> list[int]:
+        """Drop down/nonexistent OSDs (OSDMap.cc:2472): replicated pools
+        compact the list; EC pools keep positional holes."""
+        pool = self.pools[pool_id]
+        if pool.pool_type == "erasure":
+            return [
+                o if o != NO_OSD and self.is_up(o) else NO_OSD for o in raw
+            ]
+        return [o for o in raw if o != NO_OSD and self.is_up(o)]
+
+    def pg_to_up_acting(self, pool_id: int, ps: int):
+        """(up, up_primary, acting, acting_primary) with pg_temp /
+        primary_temp overrides (OSDMap.cc _get_temp_osds region)."""
+        raw = self.pg_to_raw_osds(pool_id, ps)
+        up = self.raw_to_up_osds(pool_id, raw)
+        acting = list(self.pg_temp.get((pool_id, ps), up))
+        if not acting:
+            acting = up
+        primary = self.primary_temp.get((pool_id, ps))
+        up_primary = next((o for o in up if o != NO_OSD), NO_OSD)
+        acting_primary = (
+            primary if primary is not None
+            else next((o for o in acting if o != NO_OSD), NO_OSD)
+        )
+        return up, up_primary, acting, acting_primary
+
+    # -- serialization ---------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "osds": {
+                str(i): {
+                    "up": o.up, "in": o.in_cluster,
+                    "weight": o.weight, "addr": o.addr,
+                }
+                for i, o in self.osds.items()
+            },
+            "pools": {
+                str(p.pool_id): {
+                    "name": p.name, "type": p.pool_type, "size": p.size,
+                    "min_size": p.min_size, "pg_num": p.pg_num,
+                    "crush_rule": p.crush_rule, "ec_profile": p.ec_profile,
+                }
+                for p in self.pools.values()
+            },
+            "pg_temp": {
+                f"{pid}.{ps}": v for (pid, ps), v in self.pg_temp.items()
+            },
+        }

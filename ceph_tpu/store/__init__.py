@@ -1,0 +1,13 @@
+"""Local object store (reference src/os, SURVEY.md §2.5).
+
+Host-side durability tier: an ObjectStore-style transactional API
+(reference src/os/ObjectStore.h + Transaction.h) with shard-qualified
+object ids (ghobject_t — the EC requirement, reference
+doc/dev/osd_internals/erasure_coding/ecbackend.rst:60-76), a MemStore
+default backend (reference src/os/memstore/MemStore.h:30) and a
+file-backed store; device HBM is a compute/cache tier, not durability.
+"""
+
+from ceph_tpu.store.types import CollectionId, GHObject  # noqa: F401
+from ceph_tpu.store.object_store import ObjectStore, Transaction  # noqa: F401
+from ceph_tpu.store.memstore import MemStore  # noqa: F401

@@ -1,0 +1,181 @@
+"""ECBackend pipeline tests: write/read/RMW/reconstruct/recover/scrub.
+
+The in-process analog of reference TestECBackend.cc + the EC pieces of
+test-erasure-code.sh / test-erasure-eio.sh: full round trips over memstore
+shards, degraded reads, shard recovery, corruption detection."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+from ceph_tpu.osd.ec_backend import (
+    ECBackend,
+    HINFO_ATTR,
+    LocalShard,
+    ShardReadError,
+)
+from ceph_tpu.store import CollectionId, GHObject, MemStore, Transaction
+
+K, M = 4, 2
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture()
+def backend():
+    registry = ErasureCodePluginRegistry()
+    codec = registry.factory(
+        "jax_rs", {"k": str(K), "m": str(M), "technique": "cauchy_good"}
+    )
+    stores = {}
+    shards = {}
+    for i in range(K + M):
+        store = MemStore()
+        cid = CollectionId(1, 0, shard=i)
+        _run(store.queue_transactions(
+            Transaction().create_collection(cid)
+        ))
+        stores[i] = (store, cid)
+        shards[i] = LocalShard(store, cid, pool=1, shard=i)
+    be = ECBackend(codec, shards, stripe_unit=128)
+    be._test_stores = stores
+    return be
+
+
+def _payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, np.uint8
+    ).tobytes()
+
+
+def test_write_read_roundtrip(backend):
+    data = _payload(5000)
+    meta = _run(backend.write("obj1", data))
+    assert meta.size == 5000 and meta.version == 1
+    assert _run(backend.read("obj1")) == data
+    assert _run(backend.read("obj1", 100, 50)) == data[100:150]
+    assert _run(backend.read("obj1", 4990, 100)) == data[4990:]  # clamped
+
+
+def test_append_and_version_bump(backend):
+    a = _payload(1024, 1)
+    b = _payload(512, 2)
+    _run(backend.write("o", a))
+    meta = _run(backend.write("o", b, offset=1024))
+    assert meta.size == 1536 and meta.version == 2
+    assert _run(backend.read("o")) == a + b
+
+
+def test_rmw_partial_overwrite(backend):
+    data = bytearray(_payload(4096, 3))
+    _run(backend.write("o", bytes(data)))
+    patch = b"X" * 700
+    _run(backend.write("o", patch, offset=1000))
+    data[1000:1700] = patch
+    assert _run(backend.read("o")) == bytes(data)
+
+
+def test_degraded_read_reconstructs(backend):
+    data = _payload(8192, 4)
+    _run(backend.write("o", data))
+    # kill data shards 0 and 2 (delete the shard objects)
+    for s in (0, 2):
+        store, cid = backend._test_stores[s]
+        _run(store.queue_transactions(
+            Transaction().remove(cid, GHObject(1, "o", shard=s))
+        ))
+    assert _run(backend.read("o")) == data
+
+
+def test_degraded_read_with_parity_shard_also_lost(backend):
+    """Regression: availability is discovered, not assumed — losing a data
+    shard AND a parity shard must still reconstruct (k survivors exist)."""
+    data = _payload(8192, 41)
+    _run(backend.write("o", data))
+    for s in (1, 4):  # data shard 1 + parity shard 4
+        store, cid = backend._test_stores[s]
+        _run(store.queue_transactions(
+            Transaction().remove(cid, GHObject(1, "o", shard=s))
+        ))
+    assert _run(backend.read("o")) == data
+
+
+def test_too_many_failures_raises(backend):
+    data = _payload(2048, 5)
+    _run(backend.write("o", data))
+    for s in (0, 1, 2):  # m=2, three losses is fatal
+        store, cid = backend._test_stores[s]
+        _run(store.queue_transactions(
+            Transaction().remove(cid, GHObject(1, "o", shard=s))
+        ))
+    with pytest.raises((ShardReadError, IOError)):
+        _run(backend.read("o"))
+
+
+def test_recover_shard_bit_identical(backend):
+    data = _payload(16384, 6)
+    _run(backend.write("o", data))
+    store1, cid1 = backend._test_stores[1]
+    oid1 = GHObject(1, "o", shard=1)
+    original = store1.read(cid1, oid1)
+    _run(store1.queue_transactions(Transaction().remove(cid1, oid1)))
+    _run(backend.recover_shard("o", [1]))
+    assert store1.read(cid1, oid1) == original
+    assert _run(backend.read("o")) == data
+
+
+def test_scrub_clean_and_corruption(backend):
+    data = _payload(4096, 7)
+    _run(backend.write("o", data))
+    report = _run(backend.scrub("o"))
+    assert report["clean"], report
+    # corrupt parity shard 5 on disk
+    store5, cid5 = backend._test_stores[5]
+    oid5 = GHObject(1, "o", shard=5)
+    _run(store5.queue_transactions(
+        Transaction().write(cid5, oid5, 10, b"\xff\x00\xff")
+    ))
+    report = _run(backend.scrub("o"))
+    assert not report["clean"]
+    assert 5 in report["parity_inconsistent"]
+
+
+def test_hinfo_cumulative_on_append(backend):
+    a = _payload(1024, 8)
+    _run(backend.write("o", a))
+    _run(backend.write("o", _payload(1024, 9), offset=1024))
+    raw = _run(backend.shards[0].get_attr("o", HINFO_ATTR))
+    assert raw, "append should maintain hinfo"
+    d = json.loads(raw)
+    assert d["total_chunk_size"] == 512  # 2048 bytes / k=4
+
+
+def test_hinfo_invalidated_on_overwrite(backend):
+    _run(backend.write("o", _payload(4096, 10)))
+    _run(backend.write("o", b"Y" * 100, offset=600))
+    raw = _run(backend.shards[0].get_attr("o", HINFO_ATTR))
+    assert raw == b""
+    report = _run(backend.scrub("o"))
+    assert report["clean"]  # parity still consistent, crc skipped
+
+
+def test_read_missing_object(backend):
+    with pytest.raises(KeyError):
+        _run(backend.read("ghost"))
+
+
+def test_concurrent_writes_serialized(backend):
+    async def hammer():
+        await asyncio.gather(*(
+            backend.write("o", bytes([i]) * 512, offset=i * 512)
+            for i in range(8)
+        ))
+
+    _run(hammer())
+    got = _run(backend.read("o"))
+    assert got == b"".join(bytes([i]) * 512 for i in range(8))

@@ -1,0 +1,63 @@
+"""StripeInfo geometry + HashInfo tests (TestECUtil territory)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.crc32c import crc32c
+from ceph_tpu.osd.ec_util import HashInfo, StripeInfo
+
+
+def test_stripe_offsets():
+    si = StripeInfo(k=4, chunk_size=256)
+    assert si.stripe_width == 1024
+    assert si.logical_to_prev_chunk_offset(1023) == 0
+    assert si.logical_to_prev_chunk_offset(1024) == 256
+    assert si.logical_to_next_chunk_offset(1) == 256
+    assert si.logical_to_prev_stripe_offset(2047) == 1024
+    assert si.logical_to_next_stripe_offset(1) == 1024
+    assert si.aligned_logical_offset_to_chunk_offset(2048) == 512
+    assert si.aligned_chunk_offset_to_logical_offset(512) == 2048
+    with pytest.raises(ValueError):
+        si.aligned_logical_offset_to_chunk_offset(100)
+    start, length = si.offset_len_to_stripe_bounds(1500, 600)
+    assert start == 1024 and length == 2048  # [1500,2100) spans 2 stripes
+
+
+def test_split_merge_roundtrip():
+    si = StripeInfo(k=4, chunk_size=128)
+    data = np.random.default_rng(0).integers(
+        0, 256, 3 * si.stripe_width, np.uint8
+    )
+    stripes = si.split_stripes(data.tobytes())
+    assert stripes.shape == (3, 4, 128)
+    assert np.array_equal(si.merge_stripes(stripes), data)
+    with pytest.raises(ValueError):
+        si.split_stripes(b"x" * 100)
+
+
+def test_shard_bytes_layout():
+    si = StripeInfo(k=2, chunk_size=4)
+    chunks = np.arange(2 * 3 * 4, dtype=np.uint8).reshape(2, 3, 4)
+    shards = si.shard_bytes(chunks)
+    assert len(shards) == 3
+    # shard i = chunk i of stripe 0 then chunk i of stripe 1 (contiguous)
+    assert shards[0].tolist() == [0, 1, 2, 3, 12, 13, 14, 15]
+
+
+def test_hashinfo_cumulative():
+    hi = HashInfo(n=3)
+    s1 = [b"aaaa", b"bbbb", b"cccc"]
+    hi.append(0, s1)
+    assert hi.total_chunk_size == 4
+    for i in range(3):
+        assert hi.get_chunk_hash(i) == crc32c(0xFFFFFFFF, s1[i])
+    s2 = [b"dddd", b"eeee", b"ffff"]
+    hi.append(4, s2)
+    assert hi.get_chunk_hash(0) == crc32c(crc32c(0xFFFFFFFF, b"aaaa"), b"dddd")
+    with pytest.raises(ValueError):
+        hi.append(4, s1)  # stale offset
+    with pytest.raises(ValueError):
+        hi.append(8, [b"x", b"y"])  # wrong shard count
+    # serialization roundtrip
+    hi2 = HashInfo.from_dict(3, hi.to_dict())
+    assert hi2.cumulative_shard_hashes == hi.cumulative_shard_hashes

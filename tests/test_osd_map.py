@@ -1,0 +1,115 @@
+"""OSDMap epoch/incremental/placement tests (TestOSDMap territory)."""
+
+import pytest
+
+from ceph_tpu.osd.osd_map import (
+    Incremental,
+    NO_OSD,
+    OSDMap,
+    PoolInfo,
+)
+from ceph_tpu.placement.crush_map import CrushMap
+
+
+def _map(n_hosts=4, osds_per=3):
+    crush = CrushMap()
+    root = crush.add_bucket("default", "root")
+    osd = 0
+    for h in range(n_hosts):
+        host = crush.add_bucket(f"host{h}", "host")
+        for _ in range(osds_per):
+            crush.add_item(host, osd, 1.0)
+            osd += 1
+        crush.add_item(root, host)
+    crush.create_replicated_rule("replicated_rule", failure_domain="host")
+    crush.create_ec_rule("ec_rule", chunk_count=6, failure_domain="osd")
+    m = OSDMap(crush)
+    inc = Incremental(1)
+    for i in range(osd):
+        inc.new_up[i] = f"osd.{i}:680{i}"
+    inc.new_pools.append(PoolInfo(1, "rbd", "replicated", size=3, pg_num=16))
+    inc.new_pools.append(PoolInfo(
+        2, "ecpool", "erasure", size=6, pg_num=16, crush_rule="ec_rule"
+    ))
+    m.apply_incremental(inc)
+    return m, osd
+
+
+def test_epoch_sequencing():
+    m, _ = _map()
+    assert m.epoch == 1
+    with pytest.raises(ValueError):
+        m.apply_incremental(Incremental(5))
+    m.apply_incremental(Incremental(2))
+    assert m.epoch == 2
+
+
+def test_pg_mapping_replicated():
+    m, n = _map()
+    for ps in range(16):
+        up, upp, acting, actp = m.pg_to_up_acting(1, ps)
+        assert len(up) == 3 and len(set(up)) == 3
+        assert upp == up[0] and actp == acting[0]
+        assert all(0 <= o < n for o in up)
+
+
+def test_pg_mapping_ec_holes_positional():
+    m, n = _map()
+    up, _, _, _ = m.pg_to_up_acting(2, 5)
+    assert len(up) == 6
+    # mark one mapped OSD down -> hole at its position, others unmoved
+    victim = up[2]
+    m.apply_incremental(Incremental(2, new_down=[victim]))
+    up2, _, _, _ = m.pg_to_up_acting(2, 5)
+    assert up2[2] == NO_OSD or up2[2] != victim
+    same = sum(a == b for a, b in zip(up, up2))
+    assert same >= 4
+
+
+def test_down_osd_filtered_replicated():
+    m, n = _map()
+    up, _, _, _ = m.pg_to_up_acting(1, 3)
+    victim = up[0]
+    m.apply_incremental(Incremental(2, new_down=[victim]))
+    up2, _, _, _ = m.pg_to_up_acting(1, 3)
+    assert victim not in up2
+
+
+def test_out_osd_remapped():
+    """weight=0 (out) removes the OSD from CRUSH candidates entirely."""
+    m, n = _map()
+    up, _, _, _ = m.pg_to_up_acting(1, 7)
+    victim = up[1]
+    m.apply_incremental(Incremental(2, new_weights={victim: 0}))
+    up2, _, _, _ = m.pg_to_up_acting(1, 7)
+    assert victim not in up2
+    assert len(up2) == 3  # replaced, not just dropped
+
+
+def test_pg_temp_override():
+    m, n = _map()
+    up, _, acting, actp = m.pg_to_up_acting(1, 0)
+    temp = [up[1], up[2], up[0]]
+    m.apply_incremental(Incremental(2, new_pg_temp={(1, 0): temp}))
+    _, _, acting2, actp2 = m.pg_to_up_acting(1, 0)
+    assert acting2 == temp and actp2 == temp[0]
+    # clearing pg_temp restores crush mapping
+    m.apply_incremental(Incremental(3, new_pg_temp={(1, 0): []}))
+    _, _, acting3, _ = m.pg_to_up_acting(1, 0)
+    assert acting3 == list(up)
+
+
+def test_primary_temp():
+    m, _ = _map()
+    up, _, _, _ = m.pg_to_up_acting(1, 2)
+    m.apply_incremental(Incremental(2, new_primary_temp={(1, 2): up[2]}))
+    _, _, _, actp = m.pg_to_up_acting(1, 2)
+    assert actp == up[2]
+
+
+def test_to_dict_roundtrippable():
+    m, _ = _map()
+    d = m.to_dict()
+    assert d["epoch"] == 1
+    assert d["pools"]["2"]["type"] == "erasure"
+    assert len(d["osds"]) == 12

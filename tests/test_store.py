@@ -1,0 +1,104 @@
+"""ObjectStore/MemStore transaction tests (store_test.cc territory)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.store import CollectionId, GHObject, MemStore, Transaction
+
+CID = CollectionId(1, 0, shard=0)
+OID = GHObject(1, "obj", shard=0)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture()
+def store():
+    s = MemStore()
+    _run(s.queue_transactions(Transaction().create_collection(CID)))
+    return s
+
+
+def test_write_read_roundtrip(store):
+    t = Transaction().write(CID, OID, 0, b"hello").write(CID, OID, 5, b" world")
+    _run(store.queue_transactions(t))
+    assert store.read(CID, OID) == b"hello world"
+    assert store.read(CID, OID, 6, 5) == b"world"
+    assert store.stat(CID, OID)["size"] == 11
+
+
+def test_sparse_write_zero_fills(store):
+    _run(store.queue_transactions(Transaction().write(CID, OID, 8, b"x")))
+    assert store.read(CID, OID) == b"\0" * 8 + b"x"
+
+
+def test_zero_truncate_remove(store):
+    _run(store.queue_transactions(Transaction().write(CID, OID, 0, b"abcdef")))
+    _run(store.queue_transactions(Transaction().zero(CID, OID, 1, 2)))
+    assert store.read(CID, OID) == b"a\0\0def"
+    _run(store.queue_transactions(Transaction().truncate(CID, OID, 3)))
+    assert store.read(CID, OID) == b"a\0\0"
+    _run(store.queue_transactions(Transaction().remove(CID, OID)))
+    assert not store.exists(CID, OID)
+
+
+def test_attrs_and_omap(store):
+    t = (Transaction()
+         .setattr(CID, OID, "hinfo", b"\x01\x02")
+         .omap_setkeys(CID, OID, {"k1": b"v1", "k2": b"v2"}))
+    _run(store.queue_transactions(t))
+    assert store.getattr(CID, OID, "hinfo") == b"\x01\x02"
+    assert store.omap_get(CID, OID) == {"k1": b"v1", "k2": b"v2"}
+    _run(store.queue_transactions(
+        Transaction().rmattr(CID, OID, "hinfo").omap_rmkeys(CID, OID, ["k1"])
+    ))
+    assert store.getattrs(CID, OID) == {}
+    assert store.omap_get(CID, OID) == {"k2": b"v2"}
+
+
+def test_clone_and_rename(store):
+    dst = GHObject(1, "obj-clone", shard=0)
+    _run(store.queue_transactions(
+        Transaction().write(CID, OID, 0, b"data").setattr(CID, OID, "a", b"1")
+    ))
+    _run(store.queue_transactions(Transaction().clone(CID, OID, dst)))
+    _run(store.queue_transactions(Transaction().write(CID, OID, 0, b"DATA")))
+    assert store.read(CID, dst) == b"data"  # clone unaffected
+    assert store.getattr(CID, dst, "a") == b"1"
+    ren = GHObject(1, "obj-renamed", shard=0)
+    _run(store.queue_transactions(Transaction().rename(CID, dst, ren)))
+    assert store.exists(CID, ren) and not store.exists(CID, dst)
+
+
+def test_transaction_atomic_under_failure(store):
+    store.fail_next = RuntimeError("injected")
+    t = Transaction().write(CID, OID, 0, b"never")
+    with pytest.raises(RuntimeError):
+        _run(store.queue_transactions(t))
+    assert not store.exists(CID, OID)
+
+
+def test_missing_collection_and_object(store):
+    with pytest.raises(KeyError):
+        store.read(CollectionId(9, 9), OID)
+    with pytest.raises(KeyError):
+        store.read(CID, GHObject(1, "ghost"))
+
+
+def test_shard_qualified_objects_distinct(store):
+    a = GHObject(1, "x", shard=0)
+    b = GHObject(1, "x", shard=3)
+    _run(store.queue_transactions(
+        Transaction().write(CID, a, 0, b"shard0").write(CID, b, 0, b"shard3")
+    ))
+    assert store.read(CID, a) == b"shard0"
+    assert store.read(CID, b) == b"shard3"
+    assert len(store.list_objects(CID)) == 2
+
+
+def test_rmcoll_requires_empty(store):
+    _run(store.queue_transactions(Transaction().write(CID, OID, 0, b"d")))
+    with pytest.raises(ValueError):
+        _run(store.queue_transactions(Transaction().remove_collection(CID)))
