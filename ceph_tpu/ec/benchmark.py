@@ -130,6 +130,20 @@ def verify_all_erasures(ec, size: int = 4096) -> int:
     for r in range(1, m + 1):
         for lost in itertools.combinations(range(n), r):
             avail = {i: enc[i] for i in range(n) if i not in lost}
+            # Non-MDS codes (lrc, shec) cannot recover every combination;
+            # minimum_to_decode is the feasibility oracle — when it reports
+            # EIO the decode must fail too, never silently corrupt.
+            try:
+                ec.minimum_to_decode(list(lost), list(avail))
+            except IOError:
+                try:
+                    out = ec.decode(list(lost), avail)
+                except IOError:
+                    continue
+                raise AssertionError(
+                    f"minimum_to_decode says lost={lost} is unrecoverable "
+                    "but decode succeeded"
+                )
             out = ec.decode(list(lost), avail)
             for w in lost:
                 if out[w] != enc[w]:

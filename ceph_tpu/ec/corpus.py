@@ -39,6 +39,17 @@ PROFILES: list[tuple[str, dict[str, str]]] = [
     ("jax_rs", {"k": "8", "m": "4", "technique": "isa_cauchy"}),
     ("jax_rs", {"k": "6", "m": "2", "technique": "reed_sol_r6_op"}),
     ("xor", {"k": "3", "m": "1"}),
+    # LRC: generated kml form (BASELINE config #5 family) and explicit layers.
+    ("lrc", {"k": "8", "m": "4", "l": "3"}),
+    ("lrc", {"k": "12", "m": "4", "l": "4"}),
+    (
+        "lrc",
+        {
+            "mapping": "__DD__DD",
+            "layers": '[ [ "_cDD_cDD", "" ], [ "c_DD____", "" ], '
+                      '[ "____cDDD", "" ] ]',
+        },
+    ),
 ]
 
 
@@ -49,6 +60,9 @@ def _payload() -> bytes:
 
 def _case_name(plugin: str, profile: dict[str, str]) -> str:
     items = "_".join(f"{k}={profile[k]}" for k in sorted(profile))
+    if not all(c.isalnum() or c in "=_-,." for c in items) or len(items) > 80:
+        digest = hashlib.sha256(items.encode()).hexdigest()[:12]
+        return f"{plugin}_{digest}"
     return f"{plugin}_{items}"
 
 

@@ -109,8 +109,10 @@ class ErasureCodeInterface(ABC):
         """Reconstruct and concatenate the data chunks (the read path of
         ErasureCodeInterface.h:460)."""
         k = self.get_data_chunk_count()
-        out = self.decode(list(range(k)), chunks)
-        return b"".join(out[i] for i in range(k))
+        mapping = self.get_chunk_mapping()
+        physical = [mapping[i] if mapping else i for i in range(k)]
+        out = self.decode(physical, chunks)
+        return b"".join(out[p] for p in physical)
 
     def create_rule(self, name: str, crush) -> int:
         """Create a placement rule spreading chunks over failure domains

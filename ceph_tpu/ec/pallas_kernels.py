@@ -51,7 +51,8 @@ def _pack_matrix(mout: int) -> np.ndarray:
 
 
 def _kernel(bm_ref, sel_ref, pack_ref, data_ref, out_ref):
-    d = data_ref[0].astype(jnp.float32)  # (k, T)
+    # uint8 -> int32 -> f32: Mosaic cannot lower a direct uint8->f32 cast.
+    d = data_ref[0].astype(jnp.int32).astype(jnp.float32)  # (k, T)
     rep = jnp.dot(sel_ref[:], d, preferred_element_type=jnp.float32)
     rep_i = rep.astype(jnp.int32)
     q = rep_i.shape[0]
@@ -60,7 +61,7 @@ def _kernel(bm_ref, sel_ref, pack_ref, data_ref, out_ref):
     acc = jnp.dot(bm_ref[:], bits, preferred_element_type=jnp.float32)
     pbits = (acc.astype(jnp.int32) & 1).astype(jnp.float32)
     packed = jnp.dot(pack_ref[:], pbits, preferred_element_type=jnp.float32)
-    out_ref[0] = packed.astype(jnp.uint8)
+    out_ref[0] = packed.astype(jnp.int32).astype(jnp.uint8)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

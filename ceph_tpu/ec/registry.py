@@ -23,8 +23,8 @@ ENTRY_POINT = "__erasure_code_init__"
 DEFAULT_PLUGIN_PACKAGE = "ceph_tpu.ec.plugins"
 
 # Built-in plugin set, preloaded like osd_erasure_code_plugins defaults.
-# (lrc/shec/clay join this tuple as they land.)
-BUILTIN_PLUGINS = ("jax_rs", "xor")
+# (shec/clay join this tuple as they land.)
+BUILTIN_PLUGINS = ("jax_rs", "xor", "lrc")
 
 
 class ErasureCodePlugin:
@@ -36,7 +36,11 @@ class ErasureCodePlugin:
 
     def factory(self, profile: Mapping[str, str]) -> ErasureCodeInterface:
         instance = self._factory(profile)
-        instance.init(profile)
+        # Constructors taking a profile already ran init (the common
+        # pattern here); only init again if the instance is still blank,
+        # avoiding a full re-parse (LRC rebuilds every inner codec).
+        if not instance.get_profile():
+            instance.init(profile)
         return instance
 
 

@@ -148,14 +148,28 @@ class CrushMap:
         failure_domain: str = "host",
         root: str = "default",
         device_class: str = "",
+        steps=None,
     ) -> Rule:
         """EC rules use indep (holes allowed, positions stable) —
-        ErasureCodeInterface.h:212 / ErasureCode::create_rule semantics."""
+        ErasureCodeInterface.h:212 / ErasureCode::create_rule semantics.
+
+        ``steps``: optional explicit (op, type, n) triples — the LRC
+        layered-rule form (reference ErasureCodeLrc.cc parse_rule_step),
+        with op in {"choose", "chooseleaf"} — translated to indep ops."""
         if device_class:
             raise NotImplementedError(
                 "crush device classes (class-shadow trees) are not yet "
                 "supported; omit device_class"
             )
+        if steps:
+            rule_steps = [("take", root)]
+            for op, type_name, n in steps:
+                if op not in ("choose", "chooseleaf"):
+                    raise ValueError(f"unknown rule step op {op!r}")
+                # n == 0 means "result_max" — resolved at do_rule time.
+                rule_steps.append((f"{op}_indep", int(n), type_name))
+            rule_steps.append(("emit",))
+            return self.add_rule(Rule(name, rule_steps))
         return self.add_rule(Rule(name, [
             ("take", root),
             ("chooseleaf_indep", chunk_count, failure_domain),
@@ -424,11 +438,18 @@ class CrushMap:
                             leaf,
                         )
                     else:
+                        # Each work-item gets its own slab of numrep
+                        # positions (mapper.c:1019 o+osize per bucket).
+                        slab: list[int] = []
+                        slab2: list[int] | None = [] if leaf else None
                         self._choose_indep(
                             self.buckets[wid], x, numrep, type_id,
-                            out, out2, reweights, tries, recurse_tries,
+                            slab, slab2, reweights, tries, recurse_tries,
                             leaf,
                         )
+                        out.extend(slab)
+                        if leaf:
+                            out2.extend(slab2)
                 w = out2 if leaf else out
             else:
                 raise ValueError(f"unknown rule op {op!r}")
