@@ -94,6 +94,36 @@ def gf_matvec_region(A: np.ndarray, data: np.ndarray) -> np.ndarray:
     return gf_matmul(A, data)
 
 
+def gf_det(A: np.ndarray) -> int:
+    """Determinant of a square GF(2^8) matrix by Gaussian elimination.
+
+    The singularity test SHEC's recoverability search runs per candidate
+    submatrix (analog of determinant.c / calc_determinant in the reference
+    shec plugin, ErasureCodeShec.cc:666)."""
+    A = np.array(A, dtype=np.uint8)
+    n = A.shape[0]
+    if A.shape != (n, n):
+        raise ValueError("matrix must be square")
+    det = 1
+    for col in range(n):
+        pivot = None
+        for row in range(col, n):
+            if A[row, col] != 0:
+                pivot = row
+                break
+        if pivot is None:
+            return 0
+        if pivot != col:
+            A[[col, pivot]] = A[[pivot, col]]
+        det = int(GF_MUL_TABLE[det, A[col, col]])
+        inv_p = GF_INV_TABLE[A[col, col]]
+        A[col] = GF_MUL_TABLE[inv_p, A[col]]
+        for row in range(col + 1, n):
+            if A[row, col] != 0:
+                A[row] ^= GF_MUL_TABLE[A[row, col], A[col]]
+    return det
+
+
 def gf_inv_matrix(A: np.ndarray) -> np.ndarray:
     """Invert a square GF(2^8) matrix by Gauss-Jordan elimination.
 

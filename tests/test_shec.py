@@ -1,0 +1,180 @@
+"""SHEC plugin tests — mirrors reference src/test/erasure-code/
+TestErasureCodeShec{,_all,_arguments}.cc patterns: profile validation,
+round trips, exhaustive erasure sweeps, minimum_to_decode locality."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import gf, reference
+from ceph_tpu.ec.plugins.shec import ErasureCodeShec, shec_parity_matrix
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+CHUNK = 256
+
+
+def make(**kv):
+    return ErasureCodeShec({k: str(v) for k, v in kv.items()})
+
+
+def payload(k, chunk=CHUNK):
+    return b"".join(bytes([ord("A") + i]) * chunk for i in range(k))
+
+
+class TestParse:
+    def test_defaults(self):
+        ec = make()
+        assert (ec.k, ec.m, ec.c) == (4, 3, 2)
+        assert ec.get_chunk_count() == 7
+
+    def test_caps(self):
+        with pytest.raises(ValueError, match="k <= 12"):
+            make(k=13, m=3, c=2)
+        with pytest.raises(ValueError, match="k\\+m <= 20"):
+            make(k=12, m=9, c=2)
+        with pytest.raises(ValueError, match="c="):
+            make(k=4, m=3, c=4)
+        with pytest.raises(ValueError, match="c="):
+            make(k=4, m=3, c=0)
+        with pytest.raises(ValueError, match="w=8"):
+            make(k=4, m=3, c=2, w=16)
+        with pytest.raises(ValueError, match="single"):
+            make(k=4, m=3, c=2, technique="bogus")
+
+    def test_registry(self):
+        reg = ErasureCodePluginRegistry.instance()
+        ec = reg.factory("shec", {"k": "4", "m": "3", "c": "2"})
+        assert ec.get_chunk_count() == 7
+
+
+class TestMatrix:
+    def test_shingles_are_sparse(self):
+        # Each parity row covers ~c*k/m contiguous (wrapping) chunks.
+        M = shec_parity_matrix(6, 3, 2, single=True)
+        assert M.shape == (3, 6)
+        for row in M:
+            assert 0 < np.count_nonzero(row) < 6
+
+    def test_full_coverage(self):
+        # Every data chunk is covered by at least one parity.
+        for k, m, c in [(4, 3, 2), (6, 3, 2), (8, 4, 3), (10, 4, 2)]:
+            for single in (False, True):
+                M = shec_parity_matrix(k, m, c, single)
+                assert np.all(np.count_nonzero(M, axis=0) >= 1), (k, m, c)
+
+    def test_c_equals_m_is_mds(self):
+        # c == m keeps every coefficient: full reed_sol_van parity.
+        from ceph_tpu.ec.matrix import reed_sol_van
+
+        M = shec_parity_matrix(5, 3, 3, single=True)
+        assert np.array_equal(M, reed_sol_van(5, 3)[5:])
+
+
+class TestEncodeDecode:
+    @pytest.mark.parametrize("k,m,c", [(4, 3, 2), (6, 4, 3), (8, 4, 2)])
+    @pytest.mark.parametrize("technique", ["single", "multiple"])
+    def test_round_trip(self, k, m, c, technique):
+        ec = make(k=k, m=m, c=c, technique=technique)
+        data = payload(k)
+        encoded = ec.encode(range(k + m), data)
+        # encode matches the numpy GF oracle bit for bit.
+        stacked = np.stack(
+            [np.frombuffer(encoded[i], np.uint8) for i in range(k)]
+        )
+        expect = reference.encode(ec.generator, stacked)
+        for i in range(k + m):
+            assert np.array_equal(
+                np.frombuffer(encoded[i], np.uint8), expect[i]
+            ), f"chunk {i}"
+        assert ec.decode_concat(encoded) == data
+
+    def test_single_data_erasure(self):
+        ec = make(k=6, m=3, c=2)
+        encoded = ec.encode(range(9), payload(6))
+        for lost in range(6):
+            avail = {i: c for i, c in encoded.items() if i != lost}
+            out = ec.decode([lost], avail)
+            assert out[lost] == encoded[lost]
+
+    def test_parity_erasure_reencoded(self):
+        ec = make(k=4, m=3, c=2)
+        encoded = ec.encode(range(7), payload(4))
+        for lost in range(4, 7):
+            avail = {i: c for i, c in encoded.items() if i != lost}
+            out = ec.decode([lost], avail)
+            assert out[lost] == encoded[lost]
+
+    def test_all_c_erasures_recoverable(self):
+        # SHEC durability: any c failures are recoverable
+        # (TestErasureCodeShec_all sweeps every erasure pattern).
+        ec = make(k=4, m=3, c=2)
+        encoded = ec.encode(range(7), payload(4))
+        for lost in itertools.combinations(range(7), 2):
+            avail = {i: c for i, c in encoded.items() if i not in lost}
+            out = ec.decode(list(lost), avail)
+            for w in lost:
+                assert out[w] == encoded[w], f"lost {lost}, chunk {w}"
+
+    def test_unrecoverable_raises(self):
+        ec = make(k=4, m=3, c=2, technique="single")
+        encoded = ec.encode(range(7), payload(4))
+        # Losing more chunks than any parity subset can cover must raise.
+        with pytest.raises(IOError):
+            avail = {i: c for i, c in encoded.items() if i >= 4}
+            ec.decode([0, 1, 2, 3], avail)
+
+
+class TestMinimumToDecode:
+    def test_want_available_passthrough(self):
+        ec = make(k=4, m=3, c=2)
+        got = ec.minimum_to_decode([1, 2], [0, 1, 2, 3])
+        assert sorted(got) == [1, 2]
+
+    def test_local_repair_reads_fewer_than_k(self):
+        # The point of shingling: one lost data chunk needs only the
+        # covering shingle, not k chunks.
+        ec = make(k=8, m=4, c=2)
+        all_chunks = list(range(12))
+        widths = []
+        for lost in range(8):
+            avail = [i for i in all_chunks if i != lost]
+            got = ec.minimum_to_decode([lost], avail)
+            assert lost not in got or lost in avail
+            widths.append(len(got))
+        assert min(widths) < 8, f"no local repair happened: {widths}"
+
+    def test_minimum_is_sufficient(self):
+        # Decoding from exactly the minimum set must succeed and match.
+        ec = make(k=6, m=3, c=2)
+        encoded = ec.encode(range(9), payload(6))
+        for lost in itertools.combinations(range(9), 2):
+            avail_ids = [i for i in range(9) if i not in lost]
+            got = ec.minimum_to_decode(list(lost), avail_ids)
+            subset = {i: encoded[i] for i in got}
+            out = ec.decode(list(lost), subset)
+            for w in lost:
+                assert out[w] == encoded[w]
+
+    def test_out_of_range_rejected(self):
+        ec = make(k=4, m=3, c=2)
+        with pytest.raises(ValueError, match="out of range"):
+            ec.minimum_to_decode([9], [0, 1, 2])
+
+
+class TestDeterminant:
+    def test_det_matches_singularity(self):
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            A = rng.integers(0, 256, (4, 4), np.uint8)
+            det = gf.gf_det(A)
+            try:
+                gf.gf_inv_matrix(A)
+                invertible = True
+            except ValueError:
+                invertible = False
+            assert (det != 0) == invertible
+
+    def test_det_multiplicative_identity(self):
+        assert gf.gf_det(np.eye(5, dtype=np.uint8)) == 1
+        assert gf.gf_det(np.zeros((3, 3), np.uint8)) == 0
