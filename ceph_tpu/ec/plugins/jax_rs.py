@@ -19,6 +19,7 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
+from ceph_tpu.common.cache import FIFOCache
 from ceph_tpu.ec import reference
 from ceph_tpu.ec.base import ErasureCode
 from ceph_tpu.ec.engine import default_engine
@@ -47,7 +48,7 @@ class ErasureCodeJaxRS(ErasureCode):
         self.technique = DEFAULT_TECHNIQUE
         self.generator: np.ndarray | None = None
         self._engine = default_engine()
-        self._decode_matrix_cache: dict[tuple, np.ndarray] = {}
+        self._decode_matrix_cache: FIFOCache = FIFOCache(512)
         if profile is not None:
             self.init(profile)
 
@@ -126,11 +127,7 @@ class ErasureCodeJaxRS(ErasureCode):
             hit = reference.decode_matrix(
                 self.generator, list(survivors), list(wanted)
             )
-            if len(self._decode_matrix_cache) >= 512:
-                self._decode_matrix_cache.pop(
-                    next(iter(self._decode_matrix_cache))
-                )
-            self._decode_matrix_cache[key] = hit
+            self._decode_matrix_cache.put(key, hit)
         return hit
 
     def decode_chunks(

@@ -27,6 +27,7 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
+from ceph_tpu.common.cache import FIFOCache
 from ceph_tpu.ec import gf
 from ceph_tpu.ec.base import ErasureCode
 from ceph_tpu.ec.engine import default_engine
@@ -121,8 +122,8 @@ class ErasureCodeShec(ErasureCode):
         self.generator: np.ndarray | None = None
         self._engine = default_engine()
         # (want, avail) -> (rows, cols, minimum) — the role of
-        # ErasureCodeShecTableCache (decoding-table LRU per request shape).
-        self._select_cache: dict[tuple, tuple] = {}
+        # ErasureCodeShecTableCache (decoding tables per request shape).
+        self._select_cache: FIFOCache = FIFOCache(512)
         if profile is not None:
             self.init(profile)
 
@@ -232,7 +233,7 @@ class ErasureCodeShec(ErasureCode):
         if best is None:
             # Negative results are cached too — repair loops retry
             # unrecoverable patterns and must not re-pay the 2^m scan.
-            self._cache_select(key, _UNRECOVERABLE)
+            self._select_cache.put(key, _UNRECOVERABLE)
             raise IOError(
                 f"shec cannot recover want={sorted(want)} from "
                 f"avail={sorted(avail)} (no nonsingular submatrix)"
@@ -251,13 +252,8 @@ class ErasureCodeShec(ErasureCode):
                 if any(M[p, j] and j not in want for j in range(k)):
                     minimum.add(cid)
         result = (row_ids, col_ids, minimum)
-        self._cache_select(key, result)
+        self._select_cache.put(key, result)
         return result
-
-    def _cache_select(self, key, value) -> None:
-        if len(self._select_cache) >= 512:
-            self._select_cache.pop(next(iter(self._select_cache)))
-        self._select_cache[key] = value
 
     def _submatrix(self, row_ids: list[int], col_ids: list[int]) -> np.ndarray:
         k = self.k
@@ -291,6 +287,10 @@ class ErasureCodeShec(ErasureCode):
     def encode_chunks_device(self, data):
         """Device-array in/out hot path ((B, k, C) -> (B, k+m, C))."""
         return self._engine.encode(self.generator, data)
+
+    def encode_chunks_batch(self, data) -> np.ndarray:
+        """(B, k, C) -> (B, k+m, C); the stripe-batched hot path."""
+        return np.asarray(self._engine.encode(self.generator, data))
 
     # -- decode ----------------------------------------------------------
     def decode_chunks(
@@ -345,6 +345,59 @@ class ErasureCodeShec(ErasureCode):
             )
             for i, w in enumerate(parity_missing):
                 out[w] = rebuilt[i]
+        return out
+
+
+    def decode_chunks_batch(
+        self, available: Mapping[int, np.ndarray], want_to_read: Sequence[int]
+    ) -> dict[int, np.ndarray]:
+        """Batched reconstruct: available chunks are (B, C) arrays — the
+        shape CLAY's per-round plane batches and ECBackend use."""
+        k = self.k
+        avail = {int(i): np.asarray(c, np.uint8) for i, c in available.items()}
+        want = [int(w) for w in want_to_read]
+        out: dict[int, np.ndarray] = {w: avail[w] for w in want if w in avail}
+        missing = [w for w in want if w not in avail]
+        if not missing:
+            return out
+        rows, cols, _ = self._select_recovery(
+            frozenset(want), frozenset(avail)
+        )
+        data: dict[int, np.ndarray] = {
+            i: avail[i] for i in range(k) if i in avail
+        }
+        if cols:
+            absent = [r for r in rows if r not in avail]
+            if absent:
+                raise IOError(f"shec decode: chunks {absent} not supplied")
+            solve = gf.gf_inv_matrix(self._submatrix(rows, cols))
+            stacked = np.stack([avail[r] for r in rows], axis=1)  # (B, R, C)
+            solved = np.asarray(self._engine.apply(solve, stacked))
+            for i, j in enumerate(cols):
+                data[j] = solved[:, i]
+        for w in missing:
+            if w < k:
+                out[w] = data[w]
+        parity_missing = [w for w in missing if w >= k]
+        if parity_missing:
+            for w in parity_missing:
+                gap = [j for j in range(k)
+                       if self.parity[w - k, j] and j not in data]
+                if gap:
+                    raise IOError(
+                        f"shec decode: parity {w} needs data chunks {gap}"
+                    )
+            ref = next(iter(avail.values()))
+            full = np.zeros((ref.shape[0], k, ref.shape[1]), np.uint8)
+            for j, chunk in data.items():
+                full[:, j] = chunk
+            rebuilt = np.asarray(
+                self._engine.apply(
+                    self.parity[[w - k for w in parity_missing]], full
+                )
+            )
+            for i, w in enumerate(parity_missing):
+                out[w] = rebuilt[:, i]
         return out
 
 

@@ -23,8 +23,7 @@ ENTRY_POINT = "__erasure_code_init__"
 DEFAULT_PLUGIN_PACKAGE = "ceph_tpu.ec.plugins"
 
 # Built-in plugin set, preloaded like osd_erasure_code_plugins defaults.
-# (clay joins this tuple as it lands.)
-BUILTIN_PLUGINS = ("jax_rs", "xor", "lrc", "shec")
+BUILTIN_PLUGINS = ("jax_rs", "xor", "lrc", "shec", "clay")
 
 
 class ErasureCodePlugin:
