@@ -22,7 +22,7 @@ class FIFOCache(Generic[V]):
         return self._data.get(key)
 
     def put(self, key: Hashable, value: V) -> None:
-        if len(self._data) >= self._max:
+        if key not in self._data and len(self._data) >= self._max:
             self._data.pop(next(iter(self._data)))
         self._data[key] = value
 

@@ -209,11 +209,6 @@ class ErasureCodeClay(ErasureCode):
     def get_alignment(self) -> int:
         return self.sub_chunk_no * SC_ALIGN
 
-    def get_chunk_size(self, object_size: int) -> int:
-        align = self.k * self.get_alignment()
-        padded = -(-object_size // align) * align if object_size else align
-        return padded // self.k
-
     # -- node/plane geometry helpers -------------------------------------
     def _node_of(self, chunk_id: int) -> int:
         """Chunk id -> q*t grid node id (parity shifts past the nu
@@ -349,36 +344,49 @@ class ErasureCodeClay(ErasureCode):
                 and self.is_repair(want_to_read, chunks.keys())
                 and chunk_size > next(iter(sizes))):
             return self._repair(want_to_read, chunks, chunk_size)
-        return super().decode(want_to_read, chunks, chunk_size=None)
+        return super().decode(want_to_read, chunks, chunk_size=chunk_size)
 
     def decode_chunks(
         self, available: Mapping[int, np.ndarray], want_to_read: Sequence[int]
     ) -> dict[int, np.ndarray]:
+        batched = {
+            int(i): np.asarray(c, np.uint8)[None]
+            for i, c in available.items()
+        }
+        out = self.decode_chunks_batch(batched, want_to_read)
+        return {w: chunk[0] for w, chunk in out.items()}
+
+    def decode_chunks_batch(
+        self, available: Mapping[int, np.ndarray], want_to_read: Sequence[int]
+    ) -> dict[int, np.ndarray]:
+        """Batched full decode: available chunks are (B, C) arrays (the
+        shape ECBackend's stripe-batched reconstruct path supplies)."""
         avail = {int(i): np.asarray(c, np.uint8) for i, c in available.items()}
         want = [int(w) for w in want_to_read]
         if all(w in avail for w in want):
             return {w: avail[w] for w in want}
         N = self.q * self.t
-        C = next(iter(avail.values())).shape[-1]
+        first = next(iter(avail.values()))
+        B, C = first.shape
         if C % self.sub_chunk_no:
             raise ValueError(
                 f"chunk size {C} not a multiple of sub_chunk_no="
                 f"{self.sub_chunk_no}"
             )
         sc = C // self.sub_chunk_no
-        chunks = np.zeros((1, N, self.sub_chunk_no, sc), np.uint8)
+        chunks = np.zeros((B, N, self.sub_chunk_no, sc), np.uint8)
         erased = set()
         for i in range(self.k + self.m):
             node = self._node_of(i)
             if i in avail:
-                chunks[0, node] = avail[i].reshape(self.sub_chunk_no, sc)
+                chunks[:, node] = avail[i].reshape(B, self.sub_chunk_no, sc)
             else:
                 erased.add(node)
         self._decode_layered(erased, chunks)
         out = {w: avail[w] for w in want if w in avail}
         for w in want:
             if w not in out:
-                out[w] = chunks[0, self._node_of(w)].reshape(C)
+                out[w] = chunks[:, self._node_of(w)].reshape(B, C)
         return out
 
     # -- layered decode (the coupling machine) ----------------------------

@@ -296,57 +296,12 @@ class ErasureCodeShec(ErasureCode):
     def decode_chunks(
         self, available: Mapping[int, np.ndarray], want_to_read: Sequence[int]
     ) -> dict[int, np.ndarray]:
-        k, m = self.k, self.m
-        avail = {int(i): np.asarray(c, np.uint8) for i, c in available.items()}
-        want = [int(w) for w in want_to_read]
-        out: dict[int, np.ndarray] = {w: avail[w] for w in want if w in avail}
-        missing = [w for w in want if w not in avail]
-        if not missing:
-            return out
-        rows, cols, _ = self._select_recovery(
-            frozenset(want), frozenset(avail)
-        )
-        data: dict[int, np.ndarray] = {
-            i: avail[i] for i in range(k) if i in avail
+        batched = {
+            int(i): np.asarray(c, np.uint8)[None]
+            for i, c in available.items()
         }
-        if cols:
-            absent = [r for r in rows if r not in avail]
-            if absent:
-                raise IOError(f"shec decode: chunks {absent} not supplied")
-            sub = self._submatrix(rows, cols)
-            solve = gf.gf_inv_matrix(sub)
-            stacked = np.stack([avail[r] for r in rows])
-            solved = np.asarray(self._engine.apply(solve, stacked))
-            for i, j in enumerate(cols):
-                data[j] = solved[i]
-        for w in missing:
-            if w < k:
-                out[w] = data[w]
-        parity_missing = [w for w in missing if w >= k]
-        if parity_missing:
-            # Re-encode from (possibly reconstructed) data; shingle sparsity
-            # means only covered chunks matter — absent uncovered ones are
-            # zero-filled (coefficient 0 ignores them anyway).
-            for w in parity_missing:
-                gap = [j for j in range(k)
-                       if self.parity[w - k, j] and j not in data]
-                if gap:
-                    raise IOError(
-                        f"shec decode: parity {w} needs data chunks {gap}"
-                    )
-            size = next(iter(avail.values())).shape[0] if avail else 0
-            full = np.zeros((k, size), dtype=np.uint8)
-            for j, chunk in data.items():
-                full[j] = chunk
-            rebuilt = np.asarray(
-                self._engine.apply(
-                    self.parity[[w - k for w in parity_missing]], full
-                )
-            )
-            for i, w in enumerate(parity_missing):
-                out[w] = rebuilt[i]
-        return out
-
+        out = self.decode_chunks_batch(batched, want_to_read)
+        return {w: chunk[0] for w, chunk in out.items()}
 
     def decode_chunks_batch(
         self, available: Mapping[int, np.ndarray], want_to_read: Sequence[int]

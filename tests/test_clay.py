@@ -123,6 +123,32 @@ class TestEncodeDecode:
             single = ec.encode_chunks(batch[b])
             assert np.array_equal(out[b], single)
 
+    def test_batch_decode_matches_single(self):
+        # decode_chunks_batch is the ECBackend reconstruct entry point.
+        ec = make(k=4, m=2)
+        chunk = ec.get_chunk_size(1)
+        rng = np.random.default_rng(11)
+        batch = rng.integers(0, 256, (3, 4, chunk), np.uint8)
+        encoded = np.asarray(ec.encode_chunks_batch(batch))
+        for lost in itertools.combinations(range(6), 2):
+            avail = {i: encoded[:, i] for i in range(6) if i not in lost}
+            out = ec.decode_chunks_batch(avail, list(lost))
+            for w in lost:
+                assert np.array_equal(out[w], encoded[:, w]), \
+                    f"lost {lost}, chunk {w}"
+
+    def test_decode_rejects_mismatched_chunk_size(self):
+        # Repair-sized fragments that can't take the repair path must be
+        # rejected by chunk-size validation, not silently mis-decoded.
+        ec = make(k=4, m=2)
+        chunk = ec.get_chunk_size(1)
+        data = payload(ec)
+        encoded = ec.encode(range(6), data)
+        short = {i: c[: chunk // ec.q] for i, c in encoded.items()
+                 if i not in (0, 1)}
+        with pytest.raises((ValueError, IOError)):
+            ec.decode([0, 1], short, chunk_size=chunk)
+
 
 class TestRepair:
     def test_minimum_to_decode_full_when_not_repair(self):

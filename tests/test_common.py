@@ -136,3 +136,16 @@ def test_crc32c_python_fallback_matches_native():
         assert crcmod.crc32c(123, data) == want
     finally:
         crcmod._native = native
+
+
+# -- FIFOCache -----------------------------------------------------------
+
+def test_fifo_cache_eviction_and_overwrite():
+    from ceph_tpu.common.cache import FIFOCache
+    c = FIFOCache(max_entries=2)
+    c.put("a", 1)
+    c.put("b", 2)
+    c.put("a", 10)          # overwrite must NOT evict "b"
+    assert c.get("a") == 10 and c.get("b") == 2 and len(c) == 2
+    c.put("c", 3)           # full: evicts oldest ("a")
+    assert c.get("a") is None and c.get("b") == 2 and c.get("c") == 3
