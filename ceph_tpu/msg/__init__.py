@@ -1,0 +1,26 @@
+"""Messenger: the host control plane.
+
+The reference moves ALL bytes — control and data — through its epoll
+AsyncMessenger with ProtocolV2 framing (reference src/msg/async/
+AsyncMessenger.h:73, ProtocolV2.cc). TPU-native split: bulk shard data rides
+ICI/DCN collectives (ceph_tpu.parallel); this package carries the control
+plane (maps, peering, heartbeats, client ops) over asyncio with the same
+Messenger/Connection/Dispatcher surface and lossy/lossless reconnect+replay
+semantics (reference src/msg/Messenger.h, Dispatcher.h, Policy.h).
+"""
+
+from ceph_tpu.msg.codec import decode, encode
+from ceph_tpu.msg.message import Message
+from ceph_tpu.msg.messenger import (
+    Connection,
+    Dispatcher,
+    EntityAddr,
+    Messenger,
+    Policy,
+    reset_local_namespace,
+)
+
+__all__ = [
+    "Connection", "Dispatcher", "EntityAddr", "Message", "Messenger",
+    "Policy", "decode", "encode", "reset_local_namespace",
+]

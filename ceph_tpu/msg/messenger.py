@@ -1,0 +1,566 @@
+"""Asyncio Messenger with ProtocolV2-style framing and policies.
+
+Surface mirrors reference src/msg/Messenger.h / Connection.h / Dispatcher.h /
+Policy.h; the wire discipline mirrors src/msg/async/ProtocolV2.cc: banner +
+handshake (entity, connect_seq, in_seq), then crc-protected frames carrying
+seq + piggybacked ack. Lossless-peer policy reconnects and replays unacked
+messages after a drop (the acceptor keeps the Connection object and swaps in
+the new stream, reference ProtocolV2 session-retry); lossy-client policy
+tears down and notifies the dispatcher (ms_handle_reset).
+
+Transports: ``tcp://host:port`` over asyncio sockets, and ``local://name``
+over in-process queue streams (the MemStore analog for networking — hundreds
+of endpoints in one process, no kernel sockets), both under the same framing
+so fault injection (ms_inject_socket_failures, reference
+src/common/options.cc:1075) exercises the real protocol paths.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+from ceph_tpu.common.crc32c import crc32c
+from ceph_tpu.common.log import Dout
+from ceph_tpu.msg.codec import decode, encode
+from ceph_tpu.msg.message import Message
+
+log = Dout("ms")
+
+BANNER = b"ceph-tpu msgr v2\n"
+_FRAME_HDR = struct.Struct("<QQII")      # seq, ack, payload_len, payload_crc
+_LEN = struct.Struct("<I")
+
+_RECONNECT_DELAY = 0.02
+_MAX_RECONNECT_DELAY = 1.0
+
+
+class MessengerError(ConnectionError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# addressing
+
+@dataclass(frozen=True)
+class EntityAddr:
+    """``local://name`` or ``tcp://host:port``."""
+    scheme: str
+    host: str
+    port: int = 0
+
+    @classmethod
+    def parse(cls, addr: str) -> "EntityAddr":
+        scheme, _, rest = addr.partition("://")
+        if scheme == "local":
+            return cls("local", rest)
+        if scheme == "tcp":
+            host, _, port = rest.rpartition(":")
+            return cls("tcp", host, int(port))
+        raise ValueError(f"bad address {addr!r}")
+
+    def __str__(self) -> str:
+        if self.scheme == "local":
+            return f"local://{self.host}"
+        return f"tcp://{self.host}:{self.port}"
+
+
+# ---------------------------------------------------------------------------
+# streams: one byte-pipe interface over tcp sockets or in-process queues
+
+class Stream(Protocol):
+    async def read_exactly(self, n: int) -> bytes: ...
+    def write(self, data: bytes) -> None: ...
+    async def drain(self) -> None: ...
+    def close(self) -> None: ...
+
+
+class TcpStream:
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self._r, self._w = reader, writer
+
+    async def read_exactly(self, n: int) -> bytes:
+        try:
+            return await self._r.readexactly(n)
+        except (asyncio.IncompleteReadError, OSError) as e:
+            raise MessengerError(str(e)) from e
+
+    def write(self, data: bytes) -> None:
+        self._w.write(data)
+
+    async def drain(self) -> None:
+        try:
+            await self._w.drain()
+        except OSError as e:
+            raise MessengerError(str(e)) from e
+
+    def close(self) -> None:
+        try:
+            self._w.close()
+        except Exception:
+            pass
+
+
+class QueueStream:
+    """One direction-pair of in-process byte queues."""
+
+    def __init__(self, rx: asyncio.Queue, tx: asyncio.Queue):
+        self._rx, self._tx = rx, tx
+        self._buf = bytearray()
+        self._closed = False
+
+    @classmethod
+    def pair(cls) -> tuple["QueueStream", "QueueStream"]:
+        a, b = asyncio.Queue(), asyncio.Queue()
+        return cls(a, b), cls(b, a)
+
+    async def read_exactly(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = await self._rx.get()
+            if chunk is None:
+                raise MessengerError("stream closed by peer")
+            self._buf += chunk
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def write(self, data: bytes) -> None:
+        if self._closed:
+            raise MessengerError("stream closed")
+        self._tx.put_nowait(bytes(data))
+
+    async def drain(self) -> None:
+        if self._closed:
+            raise MessengerError("stream closed")
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._tx.put_nowait(None)
+
+
+# local:// listener namespace (reset between tests)
+_LOCAL_LISTENERS: dict[str, "Messenger"] = {}
+
+
+def reset_local_namespace() -> None:
+    _LOCAL_LISTENERS.clear()
+
+
+# ---------------------------------------------------------------------------
+# policy + dispatcher
+
+@dataclass(frozen=True)
+class Policy:
+    """Per-peer-type delivery contract (reference src/msg/Policy.h)."""
+    lossy: bool = False         # drop state on failure vs reconnect+replay
+    server: bool = False        # never initiates reconnect
+
+    @classmethod
+    def lossless_peer(cls) -> "Policy":
+        return cls(lossy=False, server=False)
+
+    @classmethod
+    def lossy_client(cls) -> "Policy":
+        return cls(lossy=True, server=False)
+
+    @classmethod
+    def stateless_server(cls) -> "Policy":
+        return cls(lossy=True, server=True)
+
+    @classmethod
+    def lossless_server(cls) -> "Policy":
+        return cls(lossy=False, server=True)
+
+
+class Dispatcher(Protocol):
+    async def ms_dispatch(self, conn: "Connection", msg: Message) -> None: ...
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        """Lossy connection died; state is gone."""
+
+    def ms_handle_connect(self, conn: "Connection") -> None:
+        """New session established."""
+
+
+# ---------------------------------------------------------------------------
+# connection
+
+class Connection:
+    """One peer session. Survives stream replacement when lossless."""
+
+    def __init__(self, msgr: "Messenger", peer_name: str, peer_addr: str,
+                 policy: Policy, initiator: bool):
+        self.msgr = msgr
+        self.peer_name = peer_name          # may be "" until handshake
+        self.peer_addr = peer_addr
+        self.policy = policy
+        self.initiator = initiator
+        self.out_seq = 0
+        self.in_seq = 0
+        self.connect_seq = 0
+        self._stream: Optional[Stream] = None
+        self._out: asyncio.Queue = asyncio.Queue()
+        self._sent_unacked: deque[tuple[int, bytes]] = deque()
+        self._tasks: list[asyncio.Task] = []
+        self._closed = False
+        self._ready = asyncio.Event()
+
+    # -- public api ------------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        """Queue for ordered delivery (Connection::send_message)."""
+        if self._closed:
+            raise MessengerError(f"connection to {self.peer_addr} closed")
+        self.out_seq += 1
+        payload = encode(msg.to_wire())
+        if not self.policy.lossy:
+            self._sent_unacked.append((self.out_seq, payload))
+        self._out.put_nowait((self.out_seq, payload))
+
+    def mark_down(self) -> None:
+        """Hard-close; no reconnect (Connection::mark_down)."""
+        self._closed = True
+        self._teardown_stream()
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        self.msgr._forget(self)
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed
+
+    # -- internals -------------------------------------------------------
+    def _teardown_stream(self) -> None:
+        if self._stream is not None:
+            self._stream.close()
+            self._stream = None
+        self._ready.clear()
+
+    def _attach(self, stream: Stream, peer_in_seq: int) -> None:
+        """Adopt a fresh stream: purge acked, queue replay of the rest."""
+        self._stream = stream
+        self.connect_seq += 1
+        while self._sent_unacked and self._sent_unacked[0][0] <= peer_in_seq:
+            self._sent_unacked.popleft()
+        pending: list[tuple[int, bytes]] = list(self._sent_unacked)
+        seen = {seq for seq, _ in pending}
+        while not self._out.empty():
+            item = self._out.get_nowait()
+            if item[0] not in seen:
+                pending.append(item)
+        self._out = asyncio.Queue()
+        for item in pending:
+            self._out.put_nowait(item)
+        self._ready.set()
+
+    def _start_io(self) -> None:
+        self._tasks = [
+            asyncio.create_task(self._writer_loop()),
+            asyncio.create_task(self._reader_loop()),
+        ]
+
+    def _stop_io(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks = []
+
+    async def _writer_loop(self) -> None:
+        try:
+            while not self._closed:
+                await self._ready.wait()
+                seq, payload = await self._out.get()
+                stream = self._stream
+                if stream is None:
+                    # stream died between wait and get: requeue and re-wait
+                    self._out.put_nowait((seq, payload))
+                    self._ready.clear()
+                    continue
+                try:
+                    self.msgr._maybe_inject_failure()
+                    hdr = _FRAME_HDR.pack(
+                        seq, self.in_seq, len(payload),
+                        crc32c(0xFFFFFFFF, payload),
+                    )
+                    stream.write(hdr + payload)
+                    await stream.drain()
+                except MessengerError as e:
+                    self._out.put_nowait((seq, payload))
+                    self._on_stream_failure(e)
+        except asyncio.CancelledError:
+            pass
+
+    async def _reader_loop(self) -> None:
+        try:
+            while not self._closed:
+                await self._ready.wait()
+                stream = self._stream
+                if stream is None:
+                    self._ready.clear()
+                    continue
+                try:
+                    raw = await stream.read_exactly(_FRAME_HDR.size)
+                    seq, ack, length, crc = _FRAME_HDR.unpack(raw)
+                    payload = await stream.read_exactly(length)
+                except MessengerError as e:
+                    self._on_stream_failure(e)
+                    continue
+                if crc32c(0xFFFFFFFF, payload) != crc:
+                    self._on_stream_failure(MessengerError("bad frame crc"))
+                    continue
+                while self._sent_unacked and self._sent_unacked[0][0] <= ack:
+                    self._sent_unacked.popleft()
+                if seq <= self.in_seq:
+                    continue                      # replayed duplicate
+                self.in_seq = seq
+                msg = Message.from_wire(decode(payload), seq)
+                await self.msgr._deliver(self, msg)
+        except asyncio.CancelledError:
+            pass
+
+    def _on_stream_failure(self, exc: Exception) -> None:
+        if self._closed or self._stream is None:
+            return
+        log.dout(10, "connection %s -> %s: stream failed: %s",
+                  self.msgr.name, self.peer_addr, exc)
+        self._teardown_stream()
+        if self.policy.lossy:
+            self._closed = True
+            self._stop_io_soon()
+            self.msgr._forget(self)
+            self.msgr._notify_reset(self)
+        elif self.initiator:
+            asyncio.get_running_loop().create_task(self._reconnect_loop())
+        # else: lossless acceptor goes standby; initiator will come back
+
+    def _stop_io_soon(self) -> None:
+        for t in self._tasks:
+            if t is not asyncio.current_task():
+                t.cancel()
+        self._tasks = []
+
+    async def _reconnect_loop(self) -> None:
+        delay = _RECONNECT_DELAY
+        while not self._closed and self._stream is None:
+            await asyncio.sleep(delay * (0.5 + random.random()))
+            delay = min(delay * 2, _MAX_RECONNECT_DELAY)
+            try:
+                await self.msgr._dial(self)
+                return
+            except (MessengerError, OSError, ValueError) as e:
+                log.dout(10, "reconnect %s -> %s failed: %s",
+                          self.msgr.name, self.peer_addr, e)
+
+
+# ---------------------------------------------------------------------------
+# messenger
+
+class Messenger:
+    """Binds an address, accepts sessions, hands out Connections."""
+
+    def __init__(self, name: str, conf=None, nonce: int | None = None):
+        self.name = name                    # entity name, e.g. "osd.3"
+        self.conf = conf
+        self.nonce = nonce if nonce is not None else random.getrandbits(32)
+        self.my_addr: Optional[EntityAddr] = None
+        self.dispatcher: Optional[Dispatcher] = None
+        self.default_policy = Policy.lossless_peer()
+        self.policies: dict[str, Policy] = {}     # peer entity type -> policy
+        self._conns: dict[str, Connection] = {}   # peer addr str -> conn
+        self._accepted: dict[str, Connection] = {}  # peer name -> conn
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._rng = random.Random()
+        self._stopped = False
+
+    # -- setup -----------------------------------------------------------
+    def set_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatcher = d
+
+    def set_policy(self, entity_type: str, policy: Policy) -> None:
+        """Policy for peers whose name starts with ``entity_type.``"""
+        self.policies[entity_type] = policy
+
+    def _policy_for(self, peer_name: str) -> Policy:
+        etype = peer_name.split(".", 1)[0]
+        return self.policies.get(etype, self.default_policy)
+
+    async def bind(self, addr: str) -> None:
+        a = EntityAddr.parse(addr)
+        if a.scheme == "local":
+            if a.host in _LOCAL_LISTENERS:
+                raise MessengerError(f"{addr} already bound")
+            _LOCAL_LISTENERS[a.host] = self
+        else:
+            self._server = await asyncio.start_server(
+                self._on_tcp_accept, a.host, a.port or None
+            )
+            if a.port == 0:
+                a = EntityAddr(
+                    "tcp", a.host, self._server.sockets[0].getsockname()[1]
+                )
+        self.my_addr = a
+
+    async def shutdown(self) -> None:
+        self._stopped = True
+        for conn in list(self._conns.values()) + list(self._accepted.values()):
+            conn.mark_down()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        if (self.my_addr and self.my_addr.scheme == "local"
+                and _LOCAL_LISTENERS.get(self.my_addr.host) is self):
+            del _LOCAL_LISTENERS[self.my_addr.host]
+
+    # -- outgoing --------------------------------------------------------
+    async def connect(self, addr: str, peer_name: str = "") -> Connection:
+        """Get-or-create the session to ``addr``."""
+        conn = self._conns.get(addr)
+        if conn is not None and not conn.is_closed:
+            return conn
+        policy = (self._policy_for(peer_name) if peer_name
+                  else self.default_policy)
+        conn = Connection(self, peer_name, addr, policy, initiator=True)
+        try:
+            await self._dial(conn)
+        except BaseException:
+            conn._closed = True
+            raise
+        self._conns[addr] = conn
+        conn._start_io()
+        return conn
+
+    async def send_to(self, addr: str, msg: Message,
+                      peer_name: str = "") -> Connection:
+        conn = await self.connect(addr, peer_name)
+        conn.send_message(msg)
+        return conn
+
+    async def _dial(self, conn: Connection) -> None:
+        a = EntityAddr.parse(conn.peer_addr)
+        self._maybe_inject_failure()
+        if a.scheme == "local":
+            target = _LOCAL_LISTENERS.get(a.host)
+            if target is None:
+                raise MessengerError(f"no listener at {conn.peer_addr}")
+            ours, theirs = QueueStream.pair()
+            stream: Stream = ours
+            accept_task = asyncio.create_task(
+                target._accept_stream(theirs, str(a))
+            )
+        else:
+            reader, writer = await asyncio.open_connection(a.host, a.port)
+            stream = TcpStream(reader, writer)
+            accept_task = None
+        try:
+            peer = await self._handshake(stream, conn.in_seq,
+                                         conn.connect_seq)
+        except MessengerError:
+            if accept_task is not None:
+                accept_task.cancel()
+            raise
+        conn.peer_name = peer["entity"]
+        conn._attach(stream, peer["in_seq"])
+        if self.dispatcher is not None:
+            self.dispatcher.ms_handle_connect(conn)
+
+    async def _handshake(self, stream: Stream, in_seq: int,
+                         connect_seq: int) -> dict:
+        hello = encode({
+            "entity": self.name, "nonce": self.nonce, "in_seq": in_seq,
+            "connect_seq": connect_seq,
+        })
+        stream.write(BANNER + _LEN.pack(len(hello)) + hello)
+        await stream.drain()
+        banner = await stream.read_exactly(len(BANNER))
+        if banner != BANNER:
+            raise MessengerError(f"bad banner {banner!r}")
+        (n,) = _LEN.unpack(await stream.read_exactly(_LEN.size))
+        peer = decode(await stream.read_exactly(n))
+        if not isinstance(peer, dict) or "entity" not in peer:
+            raise MessengerError("bad handshake payload")
+        return peer
+
+    # -- incoming --------------------------------------------------------
+    async def _on_tcp_accept(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        peername = writer.get_extra_info("peername") or ("?", 0)
+        await self._accept_stream(
+            TcpStream(reader, writer), f"tcp-in://{peername[0]}:{peername[1]}"
+        )
+
+    async def _accept_stream(self, stream: Stream, hint: str) -> None:
+        if self._stopped:
+            stream.close()
+            return
+        try:
+            # read peer hello first so our reply can ride session state
+            banner = await stream.read_exactly(len(BANNER))
+            if banner != BANNER:
+                raise MessengerError(f"bad banner {banner!r}")
+            (n,) = _LEN.unpack(await stream.read_exactly(_LEN.size))
+            peer = decode(await stream.read_exactly(n))
+            peer_name = peer["entity"]
+            conn = self._accepted.get(peer_name)
+            if conn is not None and peer.get("connect_seq", 0) == 0:
+                # peer started a NEW session (its connect_seq reset): our
+                # old session state is stale — drop it (ProtocolV2
+                # RESETSESSION semantics)
+                conn.mark_down()
+                conn = None
+            if conn is None or conn.is_closed:
+                conn = Connection(
+                    self, peer_name, hint, self._policy_for(peer_name),
+                    initiator=False,
+                )
+                self._accepted[peer_name] = conn
+                fresh = True
+            else:
+                conn._stop_io()
+                conn._teardown_stream()
+                fresh = False
+            hello = encode({
+                "entity": self.name, "nonce": self.nonce,
+                "in_seq": conn.in_seq,
+            })
+            stream.write(BANNER + _LEN.pack(len(hello)) + hello)
+            await stream.drain()
+            conn._attach(stream, peer["in_seq"])
+            conn._start_io()
+            if fresh and self.dispatcher is not None:
+                self.dispatcher.ms_handle_connect(conn)
+        except (MessengerError, KeyError, TypeError, ValueError) as e:
+            log.dout(10, "%s: accept failed: %s", self.name, e)
+            stream.close()
+
+    # -- delivery --------------------------------------------------------
+    async def _deliver(self, conn: Connection, msg: Message) -> None:
+        delay_max = self.conf["ms_inject_delay_max"] if self.conf else 0.0
+        if delay_max:
+            await asyncio.sleep(self._rng.random() * delay_max)
+        if self.dispatcher is None:
+            log.dout(1, "%s: no dispatcher, dropping %s", self.name, msg.type)
+            return
+        try:
+            await self.dispatcher.ms_dispatch(conn, msg)
+        except Exception:
+            log.derr("%s: dispatch of %s failed", self.name, msg.type)
+
+    def _maybe_inject_failure(self) -> None:
+        n = self.conf["ms_inject_socket_failures"] if self.conf else 0
+        if n and self._rng.randrange(n) == 0:
+            raise MessengerError("injected socket failure")
+
+    def _forget(self, conn: Connection) -> None:
+        if self._conns.get(conn.peer_addr) is conn:
+            del self._conns[conn.peer_addr]
+        if self._accepted.get(conn.peer_name) is conn:
+            del self._accepted[conn.peer_name]
+
+    def _notify_reset(self, conn: Connection) -> None:
+        if self.dispatcher is not None:
+            self.dispatcher.ms_handle_reset(conn)

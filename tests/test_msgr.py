@@ -1,0 +1,187 @@
+"""Messenger: codec, framing, policies, reconnect+replay, fault injection."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.msg import (
+    Message,
+    Messenger,
+    Policy,
+    decode,
+    encode,
+    reset_local_namespace,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+# ---------------------------------------------------------------------------
+# codec
+
+@pytest.mark.parametrize("value", [
+    None, True, False, 0, -1, 2**40, -(2**70), 3.5, "héllo", b"\x00\xff",
+    [], [1, "a", None], {"k": [1, {"n": b"x"}]}, {"": ""},
+    {"big": 2**100, "neg": -(2**100)},
+])
+def test_codec_roundtrip(value):
+    assert decode(encode(value)) == value
+
+
+def test_codec_rejects_trailing_and_bad_tag():
+    with pytest.raises(ValueError):
+        decode(encode(1) + b"x")
+    with pytest.raises(ValueError):
+        decode(b"\x99")
+    with pytest.raises(TypeError):
+        encode(object())
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+class Collector:
+    def __init__(self):
+        self.messages = []
+        self.resets = []
+        self.got = asyncio.Event()
+
+    async def ms_dispatch(self, conn, msg):
+        self.messages.append((conn.peer_name, msg))
+        self.got.set()
+
+    def ms_handle_reset(self, conn):
+        self.resets.append(conn.peer_name)
+
+    def ms_handle_connect(self, conn):
+        pass
+
+
+async def _wait_for(predicate, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not predicate():
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError("condition not reached")
+        await asyncio.sleep(0.005)
+
+
+async def _make_pair(scheme="local", conf_a=None, conf_b=None):
+    a, b = Messenger("mon.a", conf_a), Messenger("osd.0", conf_b)
+    ca, cb = Collector(), Collector()
+    a.set_dispatcher(ca)
+    b.set_dispatcher(cb)
+    if scheme == "local":
+        await a.bind("local://a")
+        await b.bind("local://b")
+    else:
+        await a.bind("tcp://127.0.0.1:0")
+        await b.bind("tcp://127.0.0.1:0")
+    return a, b, ca, cb
+
+
+# ---------------------------------------------------------------------------
+# basic delivery
+
+@pytest.mark.parametrize("scheme", ["local", "tcp"])
+def test_send_receive_roundtrip(scheme):
+    async def run():
+        a, b, ca, cb = await _make_pair(scheme)
+        await b.send_to(str(a.my_addr), Message("ping", {"x": 1}))
+        await _wait_for(lambda: ca.messages)
+        peer, msg = ca.messages[0]
+        assert peer == "osd.0" and msg.type == "ping" and msg.data == {"x": 1}
+        # reply over the accepted connection
+        conn = a._accepted["osd.0"]
+        conn.send_message(Message("pong", {"y": b"\x01\x02"}))
+        await _wait_for(lambda: cb.messages)
+        assert cb.messages[0][1].data == {"y": b"\x01\x02"}
+        await a.shutdown()
+        await b.shutdown()
+    asyncio.run(run())
+
+
+def test_ordered_delivery_many():
+    async def run():
+        a, b, ca, _ = await _make_pair()
+        conn = await b.connect(str(a.my_addr))
+        for i in range(200):
+            conn.send_message(Message("n", {"i": i}))
+        await _wait_for(lambda: len(ca.messages) == 200)
+        assert [m.data["i"] for _, m in ca.messages] == list(range(200))
+        await a.shutdown()
+        await b.shutdown()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# lossless reconnect + replay under injected socket failures
+
+def test_lossless_replay_under_injected_failures():
+    async def run():
+        conf = ConfigProxy(overrides={"ms_inject_socket_failures": 20})
+        a, b, ca, _ = await _make_pair(conf_a=None, conf_b=conf)
+        conn = await b.connect(str(a.my_addr), peer_name="mon.a")
+        assert not conn.policy.lossy
+        for i in range(500):
+            conn.send_message(Message("n", {"i": i}))
+            if i % 50 == 0:
+                await asyncio.sleep(0.01)
+        await _wait_for(lambda: len(ca.messages) == 500, timeout=30)
+        assert [m.data["i"] for _, m in ca.messages] == list(range(500))
+        await a.shutdown()
+        await b.shutdown()
+    asyncio.run(run())
+
+
+def test_lossy_reset_notifies_dispatcher():
+    async def run():
+        a, b, _, cb = await _make_pair()
+        b.set_policy("mon", Policy.lossy_client())
+        conn = await b.connect(str(a.my_addr), peer_name="mon.a")
+        assert conn.policy.lossy
+        conn.send_message(Message("hello", {}))
+        # kill the acceptor side; lossy initiator must reset, not reconnect
+        await _wait_for(lambda: "osd.0" in a._accepted)
+        a._accepted["osd.0"].mark_down()
+        await _wait_for(lambda: cb.resets)
+        assert cb.resets == ["mon.a"]
+        assert conn.is_closed
+        await a.shutdown()
+        await b.shutdown()
+    asyncio.run(run())
+
+
+def test_connect_to_missing_listener_raises():
+    async def run():
+        b = Messenger("client.1")
+        await b.bind("local://c")
+        with pytest.raises(ConnectionError):
+            await b.connect("local://nowhere")
+        await b.shutdown()
+    asyncio.run(run())
+
+
+def test_mark_down_stops_session():
+    async def run():
+        a, b, ca, _ = await _make_pair()
+        conn = await b.connect(str(a.my_addr))
+        conn.send_message(Message("one", {}))
+        await _wait_for(lambda: ca.messages)
+        conn.mark_down()
+        with pytest.raises(ConnectionError):
+            conn.send_message(Message("two", {}))
+        # a fresh connect opens a new session
+        conn2 = await b.connect(str(a.my_addr))
+        assert conn2 is not conn
+        conn2.send_message(Message("three", {}))
+        await _wait_for(lambda: len(ca.messages) >= 2)
+        assert ca.messages[-1][1].type == "three"
+        await a.shutdown()
+        await b.shutdown()
+    asyncio.run(run())
