@@ -77,6 +77,18 @@ def global_options() -> list[Option]:
         Option("osd_recovery_max_active", int, 8,
                "max concurrent recovery ops", min=1),
         Option("osd_client_op_priority", int, 63, "client op priority"),
+        Option("mon_lease", float, 2.0,
+               "peon lease / liveness window (s)", min=0.1),
+        Option("mon_lease_interval", float, 0.5,
+               "leader lease-renewal period (s)", min=0.05),
+        Option("mon_election_timeout", float, 1.0,
+               "election round timeout (s)", min=0.05),
+        Option("mon_tick_interval", float, 0.5,
+               "monitor periodic tick (s)", min=0.05),
+        Option("mon_accept_timeout", float, 2.0,
+               "paxos accept-phase timeout (s)", min=0.1),
+        Option("auth_shared_key", str, "",
+               "cluster shared auth key ('' = auth disabled)"),
         Option("ms_inject_socket_failures", int, 0,
                "1-in-N artificial connection failures (0=off)", Level.DEV),
         Option("ms_inject_delay_max", float, 0.0,

@@ -1,0 +1,61 @@
+"""ConfigMonitor: the centralized config database.
+
+Reference src/mon/ConfigMonitor.cc: ``ceph config set/get/rm/dump`` stores
+options in the monitor store; every daemon receives the merged snapshot at
+session start and on each change (MConfig delivery, MonClient.cc:432).
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.mon.service import ENOENT_RC, CommandResult, PaxosService
+from ceph_tpu.mon.store import StoreTransaction
+
+PREFIX = "config"
+
+
+class ConfigMonitor(PaxosService):
+    prefix = PREFIX
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        self.values: dict[str, str] = {}
+
+    def refresh(self) -> None:
+        self.values = {
+            key: (self.store.get(PREFIX, key) or b"").decode()
+            for key in self.store.keys(PREFIX)
+        }
+
+    def snapshot(self) -> dict[str, str]:
+        return dict(self.values)
+
+    def preprocess_command(self, cmd: dict) -> CommandResult | None:
+        name = cmd.get("prefix", "")
+        if name == "config dump":
+            return CommandResult(data=self.snapshot())
+        if name == "config get":
+            key = cmd.get("name", "")
+            if key not in self.values:
+                return CommandResult(ENOENT_RC, f"{key!r} not set")
+            return CommandResult(data=self.values[key])
+        return None
+
+    def prepare_command(self, cmd: dict, tx: StoreTransaction
+                        ) -> CommandResult:
+        name = cmd.get("prefix", "")
+        if name == "config set":
+            key, value = cmd["name"], str(cmd["value"])
+            # validate against the local schema when the option is known
+            opt = self.mon.conf.schema().get(key)
+            if opt is not None:
+                try:
+                    opt.validate(value)
+                except ValueError as e:
+                    return CommandResult(ENOENT_RC, str(e))
+            tx.put(PREFIX, key, value.encode())
+            return CommandResult(outs=f"set {key} = {value}")
+        if name == "config rm":
+            key = cmd["name"]
+            tx.erase(PREFIX, key)
+            return CommandResult(outs=f"removed {key}")
+        return super().prepare_command(cmd, tx)

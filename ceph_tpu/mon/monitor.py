@@ -1,0 +1,580 @@
+"""Monitor daemon: sessions, command routing, subscriptions, liveness.
+
+Reference src/mon/Monitor.{h,cc}: elections fix a leader; the leader owns
+paxos proposals and mutating commands; peons serve reads and forward
+mutations (Monitor::forward_request_leader), with replies routed back;
+all daemons keep subscriptions (osdmap/config/monmap) that the monitor
+pushes on every commit; leases double as quorum liveness. Auth is a
+shared-key challenge/response (CephX-lite: proves key possession without
+sending it; the full ticket infrastructure of src/auth/cephx is not
+replicated).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import secrets
+
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.common.log import Dout
+from ceph_tpu.mon.config_monitor import ConfigMonitor
+from ceph_tpu.mon.election import Elector
+from ceph_tpu.mon.osd_monitor import OSDMonitor
+from ceph_tpu.mon.paxos import Paxos
+from ceph_tpu.mon.service import EPERM_RC, CommandResult, EINVAL_RC
+from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
+from ceph_tpu.msg.message import Message
+from ceph_tpu.msg.messenger import Connection, Messenger, Policy
+
+log = Dout("mon")
+
+EAGAIN_RC = -11
+
+
+def auth_proof(key: str, entity: str, nonce: str) -> str:
+    return hmac.new(
+        key.encode(), f"{entity}:{nonce}".encode(), hashlib.sha256
+    ).hexdigest()
+
+
+class MonSession:
+    def __init__(self, conn: Connection):
+        self.conn = conn
+        self.entity = conn.peer_name
+        self.authenticated = False
+        self.challenge: str | None = None
+        self.subs: dict[str, int] = {}       # what -> epoch client has
+
+
+class Monitor:
+    def __init__(self, name: str, monmap: dict[str, str],
+                 conf: ConfigProxy | None = None,
+                 store_path: str | None = None):
+        self.name = name                      # short name, e.g. "a"
+        self.monmap = dict(monmap)            # name -> addr
+        self.conf = conf or ConfigProxy()
+        self.store = MonitorDBStore(store_path)
+        self.msgr = Messenger(f"mon.{name}", self.conf)
+        self.msgr.set_policy("client", Policy.stateless_server())
+        self.msgr.set_policy("osd", Policy.stateless_server())
+        self.msgr.set_policy("mgr", Policy.stateless_server())
+        self.msgr.set_dispatcher(self)
+        self.elector = Elector(self)
+        self.elector.on_win = self._on_win
+        self.elector.on_lose = self._on_lose
+        self.paxos = Paxos(self, self.store)
+        self.paxos.on_commit = self._on_paxos_commit
+        self.osd_monitor = OSDMonitor(self)
+        self.config_monitor = ConfigMonitor(self)
+        self.services = {
+            "osd": self.osd_monitor, "config": self.config_monitor,
+        }
+        self.sessions: dict[int, MonSession] = {}
+        self._routes: dict[int, tuple[Connection, dict]] = {}
+        self._next_rtid = 0
+        self._last_lease = 0.0                # peon: last lease seen
+        self._lease_acks: dict[str, float] = {}
+        # serializes stage-pending -> encode -> propose so two concurrent
+        # mutations can't both build epoch N+1 and lose one's changes
+        self._mutate_lock = asyncio.Lock()
+        self._tasks: list[asyncio.Task] = []
+        self._genesis_inflight = False
+        self._stopped = False
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return sorted(self.monmap).index(self.name)
+
+    def rank_of(self, name: str) -> int:
+        return sorted(self.monmap).index(name)
+
+    def peer_names(self) -> list[str]:
+        return [n for n in self.monmap if n != self.name]
+
+    @property
+    def is_leader(self) -> bool:
+        return (not self.elector.electing
+                and self.elector.leader == self.name)
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self) -> None:
+        await self.msgr.bind(self.monmap[self.name])
+        for svc in self.services.values():
+            svc.refresh()
+        self.elector.start()
+        self._tasks.append(asyncio.create_task(self._tick_loop()))
+
+    async def shutdown(self) -> None:
+        self._stopped = True
+        self.elector.stop()
+        for t in self._tasks:
+            t.cancel()
+        await self.msgr.shutdown()
+        self.store.close()
+
+    def bootstrap(self) -> None:
+        """Quorum is suspect: call a new election (Monitor::bootstrap)."""
+        if self._stopped:
+            return
+        self.paxos.ready = False
+        self.elector.start()
+
+    # -- messaging helpers ------------------------------------------------
+    def send_mon(self, peer: str, msg: Message) -> None:
+        msg.data.setdefault("from", self.name)
+        addr = self.monmap.get(peer)
+        if addr is None:
+            return
+
+        async def _send():
+            try:
+                await self.msgr.send_to(addr, msg, f"mon.{peer}")
+            except (ConnectionError, OSError) as e:
+                log.dout(10, "%s: send to mon.%s failed: %s",
+                         self.name, peer, e)
+
+        asyncio.get_running_loop().create_task(_send())
+
+    # -- election/paxos callbacks -----------------------------------------
+    async def _on_win(self) -> None:
+        self._lease_acks = {}
+        await self.paxos.leader_init()
+
+    async def _on_lose(self) -> None:
+        self.osd_monitor.pending = None
+        self._last_lease = asyncio.get_running_loop().time()
+        await self.paxos.peon_init()
+
+    async def _on_paxos_commit(self) -> None:
+        for svc in self.services.values():
+            svc.refresh()
+        self._push_subscriptions()
+        if (self.is_leader and self.paxos.ready
+                and self.osd_monitor.osdmap.epoch == 0
+                and not self._genesis_inflight):
+            self._genesis_inflight = True
+            asyncio.get_running_loop().create_task(self._propose_genesis())
+
+    async def _propose_genesis(self) -> None:
+        try:
+            if self.store.get_int("osdmap", "last_committed") > 0:
+                return
+            tx = StoreTransaction()
+            for svc in self.services.values():
+                svc.create_initial(tx)
+            log.dout(1, "%s: creating genesis cluster maps", self.name)
+            await self.paxos.propose(tx)
+        except ConnectionError:
+            pass
+        finally:
+            self._genesis_inflight = False
+
+    async def propose_pending(self) -> None:
+        """Commit any staged OSDMonitor incremental."""
+        tx = StoreTransaction()
+        if self.osd_monitor.encode_pending(tx):
+            await self.paxos.propose(tx)
+
+    # -- tick / leases -----------------------------------------------------
+    async def _tick_loop(self) -> None:
+        interval = self.conf["mon_tick_interval"]
+        lease_int = self.conf["mon_lease_interval"]
+        lease = self.conf["mon_lease"]
+        loop = asyncio.get_running_loop()
+        last_lease_sent = 0.0
+        self._last_lease = loop.time()
+        while not self._stopped:
+            try:
+                await asyncio.sleep(min(interval, lease_int))
+            except asyncio.CancelledError:
+                return
+            now = loop.time()
+            if self.is_leader:
+                if now - last_lease_sent >= lease_int:
+                    last_lease_sent = now
+                    for peer in self.elector.quorum:
+                        if peer != self.name:
+                            # baseline so a peer that never acks is
+                            # eventually declared dead
+                            self._lease_acks.setdefault(peer, now)
+                            self.send_mon(peer, Message("paxos_lease", {
+                                "lc": self.paxos.last_committed,
+                            }))
+                dead = [
+                    p for p in self.elector.quorum
+                    if p != self.name
+                    and now - self._lease_acks.get(p, now) > lease * 3
+                ]
+                if dead:
+                    log.dout(1, "%s: lost contact with %s, re-electing",
+                             self.name, dead)
+                    self.bootstrap()
+                    continue
+                try:
+                    async with self._mutate_lock:
+                        await self.osd_monitor.tick()
+                except ConnectionError:
+                    pass
+            elif self.elector.in_quorum():
+                if now - self._last_lease > lease * 3:
+                    log.dout(1, "%s: lease expired, re-electing", self.name)
+                    self.bootstrap()
+
+    # -- dispatcher -------------------------------------------------------
+    def ms_handle_connect(self, conn: Connection) -> None:
+        pass
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        self.sessions.pop(id(conn), None)
+
+    def _session(self, conn: Connection) -> MonSession:
+        s = self.sessions.get(id(conn))
+        if s is None:
+            s = MonSession(conn)
+            self.sessions[id(conn)] = s
+        return s
+
+    def _is_mon_peer(self, conn: Connection, msg: Message) -> bool:
+        sender = msg.data.get("from", "")
+        return (sender in self.monmap
+                and conn.peer_name == f"mon.{sender}")
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        t = msg.type
+        if t.startswith("election_"):
+            if self._is_mon_peer(conn, msg):
+                await self.elector.handle(msg)
+            return
+        if t.startswith("paxos_"):
+            if self._is_mon_peer(conn, msg):
+                await self._dispatch_paxos(msg)
+            return
+        if t == "mon_forward":
+            # forwarded ops can block on a paxos commit whose accepts ride
+            # this very connection — never run them inside the reader loop
+            if self._is_mon_peer(conn, msg):
+                asyncio.get_running_loop().create_task(
+                    self._handle_forward(conn, msg)
+                )
+            return
+        if t == "mon_route_reply":
+            if self._is_mon_peer(conn, msg):
+                self._handle_route_reply(msg)
+            return
+        session = self._session(conn)
+        if t == "auth":
+            self._handle_auth(session, msg)
+            return
+        if not session.authenticated and self.conf["auth_shared_key"]:
+            session.conn.send_message(Message(
+                "auth_bad", {"reason": "unauthenticated"}
+            ))
+            return
+        loop = asyncio.get_running_loop()
+        if t == "mon_subscribe":
+            self._handle_subscribe(session, msg)
+        elif t == "mon_command":
+            # commands block on commits: keep the reader loop free
+            loop.create_task(self._handle_command(session.conn, msg.data))
+        elif t == "osd_boot":
+            loop.create_task(self._handle_osd_boot(session.conn, msg.data))
+        elif t == "osd_failure":
+            loop.create_task(self._handle_osd_failure(msg.data))
+        else:
+            log.dout(5, "%s: ignoring %s from %s", self.name, t,
+                     conn.peer_name)
+
+    async def _dispatch_paxos(self, msg: Message) -> None:
+        if msg.type == "paxos_lease":
+            # only the mon we believe leads may extend our lease — a lease
+            # from anyone else means quorum views diverged
+            if msg.data["from"] == self.elector.leader:
+                self._last_lease = asyncio.get_running_loop().time()
+                self.send_mon(msg.data["from"],
+                              Message("paxos_lease_ack", {}))
+            return
+        if msg.type == "paxos_lease_ack":
+            self._lease_acks[msg.data["from"]] = \
+                asyncio.get_running_loop().time()
+            return
+        handler = {
+            "paxos_collect": self.paxos.handle_collect,
+            "paxos_last": self.paxos.handle_last,
+            "paxos_begin": self.paxos.handle_begin,
+            "paxos_accept": self.paxos.handle_accept,
+            "paxos_commit": self.paxos.handle_commit,
+            "paxos_nak": self.paxos.handle_nak,
+        }.get(msg.type)
+        if handler is not None:
+            await handler(msg)
+
+    # -- auth -------------------------------------------------------------
+    def _handle_auth(self, session: MonSession, msg: Message) -> None:
+        key = self.conf["auth_shared_key"]
+        entity = msg.data.get("entity", session.entity)
+        if not key:
+            session.authenticated = True
+            session.conn.send_message(Message("auth_reply", {"ok": True}))
+            return
+        proof = msg.data.get("proof")
+        if proof is None:
+            session.challenge = secrets.token_hex(16)
+            session.conn.send_message(Message(
+                "auth_challenge", {"nonce": session.challenge}
+            ))
+            return
+        want = (auth_proof(key, entity, session.challenge)
+                if session.challenge else None)
+        if want is not None and hmac.compare_digest(want, str(proof)):
+            session.authenticated = True
+            session.conn.send_message(Message("auth_reply", {"ok": True}))
+        else:
+            session.conn.send_message(Message(
+                "auth_reply", {"ok": False, "reason": "bad proof"}
+            ))
+
+    # -- subscriptions ----------------------------------------------------
+    def _handle_subscribe(self, session: MonSession, msg: Message) -> None:
+        for what, have in msg.data.get("what", {}).items():
+            session.subs[what] = int(have)
+        self._push_to_session(session)
+
+    def _push_subscriptions(self) -> None:
+        for session in list(self.sessions.values()):
+            self._push_to_session(session)
+
+    def _push_to_session(self, session: MonSession) -> None:
+        if session.conn.is_closed:
+            self.sessions.pop(id(session.conn), None)
+            return
+        subs = session.subs
+        try:
+            if "monmap" in subs and subs["monmap"] < 1:
+                session.conn.send_message(Message("mon_map", {
+                    "epoch": 1, "mons": dict(self.monmap),
+                }))
+                subs["monmap"] = 1
+            if "osdmap" in subs:
+                cur = self.osd_monitor.osdmap.epoch
+                if cur > subs["osdmap"]:
+                    incs = self.osd_monitor.incrementals_since(
+                        subs["osdmap"]
+                    ) if subs["osdmap"] > 0 else []
+                    data = {"epoch": cur, "incrementals": incs}
+                    if not incs:
+                        data["full"] = self.osd_monitor.full_map_dict()
+                    session.conn.send_message(Message("osd_map", data))
+                    subs["osdmap"] = cur
+            if "config" in subs:
+                # versioned by paxos commit count: re-pushed after any
+                # commit that could have changed the config db
+                lc = max(1, self.paxos.last_committed)
+                if lc > subs["config"]:
+                    session.conn.send_message(Message("config", {
+                        "values": self.config_monitor.snapshot(),
+                    }))
+                    subs["config"] = lc
+        except ConnectionError:
+            self.sessions.pop(id(session.conn), None)
+
+    # -- commands ---------------------------------------------------------
+    def _route_service(self, cmd: dict):
+        word = str(cmd.get("prefix", "")).split(" ", 1)[0]
+        return self.services.get(word)
+
+    def _mon_command(self, cmd: dict) -> CommandResult | None:
+        name = cmd.get("prefix", "")
+        if name == "status":
+            om = self.osd_monitor.osdmap
+            return CommandResult(data={
+                "mon": {
+                    "quorum": self.elector.quorum,
+                    "leader": self.elector.leader,
+                    "epoch": self.elector.epoch,
+                },
+                "osdmap": {
+                    "epoch": om.epoch,
+                    "num_osds": len(om.osds),
+                    "num_up_osds": sum(
+                        1 for o in om.osds.values() if o.up
+                    ),
+                    "num_in_osds": sum(
+                        1 for o in om.osds.values() if o.in_cluster
+                    ),
+                    "num_pools": len(om.pools),
+                },
+                "health": self._health(),
+            })
+        if name == "health":
+            return CommandResult(data=self._health())
+        if name == "quorum_status":
+            return CommandResult(data={
+                "quorum": self.elector.quorum,
+                "leader": self.elector.leader,
+                "election_epoch": self.elector.epoch,
+            })
+        if name == "mon dump":
+            return CommandResult(data={
+                "epoch": 1, "mons": dict(self.monmap),
+            })
+        return None
+
+    def _health(self) -> dict:
+        om = self.osd_monitor.osdmap
+        checks = {}
+        down = [o for o, i in om.osds.items() if not i.up and i.in_cluster]
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"{len(down)} osds down: {sorted(down)}",
+            }
+        if len(self.elector.quorum) < len(self.monmap):
+            out = sorted(set(self.monmap) - set(self.elector.quorum))
+            checks["MON_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"monitors out of quorum: {out}",
+            }
+        status = "HEALTH_WARN" if checks else "HEALTH_OK"
+        return {"status": status, "checks": checks}
+
+    def _preprocess_local(self, cmd: dict) -> CommandResult | None:
+        svc = self._route_service(cmd)
+        if svc is not None:
+            r = svc.preprocess_command(cmd)
+            if r is not None:
+                return r
+        return self._mon_command(cmd)
+
+    async def _run_command(self, cmd: dict) -> CommandResult:
+        r = self._preprocess_local(cmd)
+        if r is not None:
+            return r
+        svc = self._route_service(cmd)
+        if svc is None:
+            return CommandResult(
+                EINVAL_RC, f"unknown command {cmd.get('prefix')!r}"
+            )
+        if not self.is_leader:
+            return CommandResult(EAGAIN_RC, "not leader")
+        async with self._mutate_lock:
+            tx = StoreTransaction()
+            result = svc.prepare_command(cmd, tx)
+            if result.rc == 0:
+                self.osd_monitor.encode_pending(tx)
+                if not tx.empty():
+                    try:
+                        await self.paxos.propose(tx)
+                    except ConnectionError:
+                        return CommandResult(EAGAIN_RC,
+                                             "lost quorum mid-commit")
+        return result
+
+    async def _handle_command(self, conn: Connection, data: dict) -> None:
+        cmd = data.get("cmd", {})
+        tid = data.get("tid", 0)
+        if self.is_leader:
+            result = await self._run_command(cmd)
+        elif self.elector.in_quorum():
+            # reads are served by any quorum member; mutations forward
+            result = self._preprocess_local(cmd)
+            if result is None:
+                if (self.elector.leader is not None
+                        and not self.elector.electing):
+                    self._forward(conn, "mon_command", data,
+                                  "mon_command_reply")
+                    return
+                result = CommandResult(EAGAIN_RC, "no quorum")
+        else:
+            result = CommandResult(EAGAIN_RC, "not in quorum")
+        self._reply(conn, Message("mon_command_reply",
+                                  {"tid": tid, **result.to_wire()}))
+
+    def _reply(self, conn: Connection, msg: Message) -> None:
+        try:
+            conn.send_message(msg)
+        except ConnectionError:
+            pass
+
+    # -- forwarding (peon -> leader) --------------------------------------
+    def _forward(self, conn: Connection, itype: str, idata: dict,
+                 reply_type: str) -> None:
+        self._next_rtid += 1
+        rtid = self._next_rtid
+        self._routes[rtid] = (conn, idata)
+        self.send_mon(self.elector.leader, Message("mon_forward", {
+            "rtid": rtid, "itype": itype, "idata": idata,
+            "reply_type": reply_type,
+        }))
+
+    async def _handle_forward(self, conn: Connection, msg: Message) -> None:
+        itype = msg.data["itype"]
+        idata = msg.data["idata"]
+        rtid = msg.data["rtid"]
+        reply_type = msg.data.get("reply_type", "")
+        if itype == "mon_command":
+            result = await self._run_command(idata.get("cmd", {}))
+            payload = {"tid": idata.get("tid", 0), **result.to_wire()}
+        elif itype == "osd_boot":
+            payload = await self._prepare_boot(idata)
+        elif itype == "osd_failure":
+            await self._prepare_failure(idata)
+            payload = None
+        else:
+            payload = None
+        if reply_type and payload is not None:
+            self.send_mon(msg.data["from"], Message("mon_route_reply", {
+                "rtid": rtid, "reply_type": reply_type, "payload": payload,
+            }))
+
+    def _handle_route_reply(self, msg: Message) -> None:
+        route = self._routes.pop(int(msg.data["rtid"]), None)
+        if route is None:
+            return
+        conn, _ = route
+        self._reply(conn, Message(msg.data["reply_type"],
+                                  dict(msg.data["payload"])))
+
+    # -- osd boot / failure ------------------------------------------------
+    async def _prepare_boot(self, data: dict) -> dict:
+        osd_id = int(data["id"])
+        async with self._mutate_lock:
+            changed = self.osd_monitor.prepare_boot(
+                osd_id, str(data["addr"]), str(data.get("host", ""))
+            )
+            if changed:
+                try:
+                    await self.propose_pending()
+                except ConnectionError:
+                    return {"epoch": 0}
+        return {"epoch": self.osd_monitor.osdmap.epoch}
+
+    async def _handle_osd_boot(self, conn: Connection, data: dict) -> None:
+        if self.is_leader:
+            payload = await self._prepare_boot(data)
+            self._reply(conn, Message("osd_boot_ack", payload))
+        elif self.elector.leader is not None:
+            self._forward(conn, "osd_boot", data, "osd_boot_ack")
+
+    async def _prepare_failure(self, data: dict) -> None:
+        async with self._mutate_lock:
+            changed = self.osd_monitor.prepare_failure(
+                int(data["target"]), str(data.get("reporter", "")),
+                float(data.get("failed_for", 0.0)),
+            )
+            if changed:
+                try:
+                    await self.propose_pending()
+                except ConnectionError:
+                    pass
+
+    async def _handle_osd_failure(self, data: dict) -> None:
+        if self.is_leader:
+            await self._prepare_failure(data)
+        elif self.elector.leader is not None:
+            self.send_mon(self.elector.leader, Message("mon_forward", {
+                "rtid": 0, "itype": "osd_failure", "idata": data,
+                "reply_type": "",
+            }))

@@ -1,0 +1,122 @@
+"""MonitorDBStore: transactional prefixed KV store with a write-ahead log.
+
+The reference persists all monitor state — paxos versions, each service's
+maps — through one RocksDB-backed transactional store
+(src/mon/MonitorDBStore.h:37). Same shape here: (prefix, key) -> bytes with
+atomic multi-op transactions; durability via an append-only WAL file
+replayed on open (the RocksDB role; a C++ store can slot in behind the same
+interface later).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator
+
+from ceph_tpu.msg.codec import decode, encode
+
+_LEN = struct.Struct("<I")
+
+
+class StoreTransaction:
+    """Atomic batch of put/erase ops (MonitorDBStore::Transaction)."""
+
+    def __init__(self):
+        self.ops: list[tuple] = []
+
+    def put(self, prefix: str, key: str, value: bytes | int
+            ) -> "StoreTransaction":
+        if isinstance(value, int):
+            value = str(value).encode()
+        self.ops.append(("put", prefix, key, bytes(value)))
+        return self
+
+    def erase(self, prefix: str, key: str) -> "StoreTransaction":
+        self.ops.append(("erase", prefix, key))
+        return self
+
+    def erase_prefix(self, prefix: str) -> "StoreTransaction":
+        self.ops.append(("erase_prefix", prefix))
+        return self
+
+    def append(self, other: "StoreTransaction") -> "StoreTransaction":
+        self.ops.extend(other.ops)
+        return self
+
+    def empty(self) -> bool:
+        return not self.ops
+
+    def encode(self) -> bytes:
+        return encode([list(op) for op in self.ops])
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "StoreTransaction":
+        tx = cls()
+        tx.ops = [tuple(op) for op in decode(raw)]
+        return tx
+
+
+class MonitorDBStore:
+    def __init__(self, path: str | None = None):
+        """``path``: directory for the WAL (None = memory only)."""
+        self._data: dict[str, dict[str, bytes]] = {}
+        self._wal = None
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            wal_path = os.path.join(path, "store.wal")
+            if os.path.exists(wal_path):
+                self._replay(wal_path)
+            self._wal = open(wal_path, "ab")
+
+    def _replay(self, wal_path: str) -> None:
+        with open(wal_path, "rb") as f:
+            while True:
+                hdr = f.read(_LEN.size)
+                if len(hdr) < _LEN.size:
+                    break
+                (n,) = _LEN.unpack(hdr)
+                raw = f.read(n)
+                if len(raw) < n:
+                    break           # torn tail write: stop at last good tx
+                self._apply(StoreTransaction.decode(raw))
+
+    def _apply(self, tx: StoreTransaction) -> None:
+        for op in tx.ops:
+            if op[0] == "put":
+                self._data.setdefault(op[1], {})[op[2]] = op[3]
+            elif op[0] == "erase":
+                self._data.get(op[1], {}).pop(op[2], None)
+            elif op[0] == "erase_prefix":
+                self._data.pop(op[1], None)
+            else:
+                raise ValueError(f"bad store op {op[0]!r}")
+
+    def apply_transaction(self, tx: StoreTransaction) -> None:
+        if tx.empty():
+            return
+        if self._wal is not None:
+            raw = tx.encode()
+            self._wal.write(_LEN.pack(len(raw)) + raw)
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+        self._apply(tx)
+
+    # -- reads -----------------------------------------------------------
+    def get(self, prefix: str, key: str) -> bytes | None:
+        return self._data.get(prefix, {}).get(key)
+
+    def get_int(self, prefix: str, key: str, default: int = 0) -> int:
+        raw = self.get(prefix, key)
+        return default if raw is None else int(raw)
+
+    def exists(self, prefix: str, key: str) -> bool:
+        return key in self._data.get(prefix, {})
+
+    def keys(self, prefix: str) -> Iterator[str]:
+        return iter(sorted(self._data.get(prefix, {})))
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None

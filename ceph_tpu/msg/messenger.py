@@ -243,7 +243,9 @@ class Connection:
         self._ready.clear()
 
     def _attach(self, stream: Stream, peer_in_seq: int) -> None:
-        """Adopt a fresh stream: purge acked, queue replay of the rest."""
+        """Adopt a fresh stream: purge acked, queue replay of the rest.
+        The queue OBJECT is reused — a writer task blocked in get() on it
+        must wake when the replay lands, so never swap in a new Queue."""
         self._stream = stream
         self.connect_seq += 1
         while self._sent_unacked and self._sent_unacked[0][0] <= peer_in_seq:
@@ -254,7 +256,6 @@ class Connection:
             item = self._out.get_nowait()
             if item[0] not in seen:
                 pending.append(item)
-        self._out = asyncio.Queue()
         for item in pending:
             self._out.put_nowait(item)
         self._ready.set()
@@ -317,8 +318,16 @@ class Connection:
                     self._sent_unacked.popleft()
                 if seq <= self.in_seq:
                     continue                      # replayed duplicate
+                try:
+                    msg = Message.from_wire(decode(payload), seq)
+                except (ValueError, TypeError, KeyError) as e:
+                    # crc-valid but malformed payload: treat as a stream
+                    # failure, not a reader-task crash
+                    self._on_stream_failure(
+                        MessengerError(f"bad payload: {e}")
+                    )
+                    continue
                 self.in_seq = seq
-                msg = Message.from_wire(decode(payload), seq)
                 await self.msgr._deliver(self, msg)
         except asyncio.CancelledError:
             pass
@@ -373,6 +382,7 @@ class Messenger:
         self.policies: dict[str, Policy] = {}     # peer entity type -> policy
         self._conns: dict[str, Connection] = {}   # peer addr str -> conn
         self._accepted: dict[str, Connection] = {}  # peer name -> conn
+        self._dialing: dict[str, asyncio.Future] = {}  # in-flight connects
         self._server: Optional[asyncio.base_events.Server] = None
         self._rng = random.Random()
         self._stopped = False
@@ -418,20 +428,46 @@ class Messenger:
 
     # -- outgoing --------------------------------------------------------
     async def connect(self, addr: str, peer_name: str = "") -> Connection:
-        """Get-or-create the session to ``addr``."""
+        """Get-or-create the session to ``addr``. Concurrent callers share
+        one dial (no duplicate connect_seq-0 sessions racing each other).
+        A lossless connection is returned even when the first dial fails:
+        messages queue and the reconnect loop delivers them once the peer
+        is reachable (the reference's lazy-connect semantics); a lossy
+        connect failure raises."""
         conn = self._conns.get(addr)
         if conn is not None and not conn.is_closed:
             return conn
-        policy = (self._policy_for(peer_name) if peer_name
-                  else self.default_policy)
-        conn = Connection(self, peer_name, addr, policy, initiator=True)
+        pending = self._dialing.get(addr)
+        if pending is not None:
+            return await asyncio.shield(pending)
+        fut = asyncio.get_running_loop().create_future()
+        self._dialing[addr] = fut
         try:
-            await self._dial(conn)
-        except BaseException:
-            conn._closed = True
+            policy = (self._policy_for(peer_name) if peer_name
+                      else self.default_policy)
+            conn = Connection(self, peer_name, addr, policy, initiator=True)
+            try:
+                await self._dial(conn)
+            except (MessengerError, OSError) as e:
+                if policy.lossy:
+                    conn._closed = True
+                    raise
+                log.dout(10, "%s: initial dial to %s failed (%s); "
+                         "queueing for reconnect", self.name, addr, e)
+                asyncio.get_running_loop().create_task(
+                    conn._reconnect_loop()
+                )
+            self._conns[addr] = conn
+            conn._start_io()
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+                fut.exception()     # mark retrieved for the no-waiter case
             raise
-        self._conns[addr] = conn
-        conn._start_io()
+        finally:
+            del self._dialing[addr]
+        if not fut.done():
+            fut.set_result(conn)
         return conn
 
     async def send_to(self, addr: str, msg: Message,

@@ -41,6 +41,25 @@ class PoolInfo:
         (pg_pool_t::raw_pg_to_pps semantics)."""
         return int(crush_hash32_2(ps % self.pg_num, self.pool_id))
 
+    def to_dict(self) -> dict:
+        return {
+            "pool_id": self.pool_id, "name": self.name,
+            "type": self.pool_type, "size": self.size,
+            "min_size": self.min_size, "pg_num": self.pg_num,
+            "crush_rule": self.crush_rule, "ec_profile": self.ec_profile,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PoolInfo":
+        return cls(
+            pool_id=int(d["pool_id"]), name=d["name"],
+            pool_type=d.get("type", "replicated"),
+            size=int(d.get("size", 3)), min_size=int(d.get("min_size", 2)),
+            pg_num=int(d.get("pg_num", 32)),
+            crush_rule=d.get("crush_rule", "replicated_rule"),
+            ec_profile=d.get("ec_profile", ""),
+        )
+
 
 @dataclass
 class Incremental:
@@ -52,6 +71,67 @@ class Incremental:
     removed_pools: list[int] = field(default_factory=list)
     new_pg_temp: dict[tuple[int, int], list[int]] = field(default_factory=dict)
     new_primary_temp: dict[tuple[int, int], int] = field(default_factory=dict)
+    new_ec_profiles: dict[str, dict] = field(default_factory=dict)
+    removed_ec_profiles: list[str] = field(default_factory=list)
+    new_crush: dict | None = None       # full crush dump when it changed
+
+    # -- wire form (Incremental encode/decode, OSDMap.h:354) -------------
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "new_up": {str(o): a for o, a in self.new_up.items()},
+            "new_down": list(self.new_down),
+            "new_weights": {str(o): w for o, w in self.new_weights.items()},
+            "new_pools": [p.to_dict() for p in self.new_pools],
+            "removed_pools": list(self.removed_pools),
+            "new_pg_temp": {
+                f"{pid}.{ps}": list(v)
+                for (pid, ps), v in self.new_pg_temp.items()
+            },
+            "new_primary_temp": {
+                f"{pid}.{ps}": o
+                for (pid, ps), o in self.new_primary_temp.items()
+            },
+            "new_ec_profiles": {
+                n: dict(p) for n, p in self.new_ec_profiles.items()
+            },
+            "removed_ec_profiles": list(self.removed_ec_profiles),
+            "new_crush": self.new_crush,
+        }
+
+    @staticmethod
+    def _pgid(s: str) -> tuple[int, int]:
+        pid, _, ps = s.partition(".")
+        return int(pid), int(ps)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Incremental":
+        return cls(
+            epoch=int(d["epoch"]),
+            new_up={int(o): a for o, a in d.get("new_up", {}).items()},
+            new_down=[int(o) for o in d.get("new_down", ())],
+            new_weights={
+                int(o): int(w) for o, w in d.get("new_weights", {}).items()
+            },
+            new_pools=[
+                PoolInfo.from_dict(p) for p in d.get("new_pools", ())
+            ],
+            removed_pools=[int(p) for p in d.get("removed_pools", ())],
+            new_pg_temp={
+                cls._pgid(s): [int(o) for o in v]
+                for s, v in d.get("new_pg_temp", {}).items()
+            },
+            new_primary_temp={
+                cls._pgid(s): int(o)
+                for s, o in d.get("new_primary_temp", {}).items()
+            },
+            new_ec_profiles={
+                n: dict(p)
+                for n, p in d.get("new_ec_profiles", {}).items()
+            },
+            removed_ec_profiles=list(d.get("removed_ec_profiles", ())),
+            new_crush=d.get("new_crush"),
+        )
 
 
 class OSDMap:
@@ -62,6 +142,7 @@ class OSDMap:
         self.pools: dict[int, PoolInfo] = {}
         self.pg_temp: dict[tuple[int, int], list[int]] = {}
         self.primary_temp: dict[tuple[int, int], int] = {}
+        self.ec_profiles: dict[str, dict] = {}
 
     # -- mutation via incrementals --------------------------------------
     def apply_incremental(self, inc: Incremental) -> None:
@@ -99,6 +180,12 @@ class OSDMap:
                 self.primary_temp.pop(pgid, None)
             else:
                 self.primary_temp[pgid] = osd
+        for name, profile in inc.new_ec_profiles.items():
+            self.ec_profiles[name] = dict(profile)
+        for name in inc.removed_ec_profiles:
+            self.ec_profiles.pop(name, None)
+        if inc.new_crush is not None:
+            self.crush = CrushMap.from_dict(inc.new_crush)
         self.epoch = inc.epoch
 
     # -- queries ---------------------------------------------------------
@@ -160,14 +247,39 @@ class OSDMap:
                 for i, o in self.osds.items()
             },
             "pools": {
-                str(p.pool_id): {
-                    "name": p.name, "type": p.pool_type, "size": p.size,
-                    "min_size": p.min_size, "pg_num": p.pg_num,
-                    "crush_rule": p.crush_rule, "ec_profile": p.ec_profile,
-                }
-                for p in self.pools.values()
+                str(p.pool_id): p.to_dict() for p in self.pools.values()
             },
             "pg_temp": {
                 f"{pid}.{ps}": v for (pid, ps), v in self.pg_temp.items()
             },
+            "primary_temp": {
+                f"{pid}.{ps}": o
+                for (pid, ps), o in self.primary_temp.items()
+            },
+            "ec_profiles": {n: dict(p) for n, p in self.ec_profiles.items()},
+            "crush": self.crush.to_dict(),
         }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OSDMap":
+        m = cls(CrushMap.from_dict(d["crush"]))
+        m.epoch = int(d["epoch"])
+        for i, o in d.get("osds", {}).items():
+            m.osds[int(i)] = OSDInfo(
+                up=bool(o["up"]), in_cluster=bool(o["in"]),
+                weight=int(o["weight"]), addr=o.get("addr", ""),
+            )
+        for pid, p in d.get("pools", {}).items():
+            m.pools[int(pid)] = PoolInfo.from_dict(p)
+        m.pg_temp = {
+            Incremental._pgid(s): [int(o) for o in v]
+            for s, v in d.get("pg_temp", {}).items()
+        }
+        m.primary_temp = {
+            Incremental._pgid(s): int(o)
+            for s, o in d.get("primary_temp", {}).items()
+        }
+        m.ec_profiles = {
+            n: dict(p) for n, p in d.get("ec_profiles", {}).items()
+        }
+        return m

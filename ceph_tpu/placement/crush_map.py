@@ -176,6 +176,58 @@ class CrushMap:
             ("emit",),
         ]))
 
+    # -- serialization (CrushWrapper encode/decode role) ------------------
+    def to_dict(self) -> dict:
+        return {
+            "tunables": {
+                "choose_total_tries": self.tunables.choose_total_tries,
+                "choose_local_retries": self.tunables.choose_local_retries,
+                "choose_local_fallback_retries":
+                    self.tunables.choose_local_fallback_retries,
+                "chooseleaf_descend_once":
+                    self.tunables.chooseleaf_descend_once,
+                "chooseleaf_vary_r": self.tunables.chooseleaf_vary_r,
+                "chooseleaf_stable": self.tunables.chooseleaf_stable,
+            },
+            "types": dict(self.types),
+            "buckets": [
+                {
+                    "id": b.id, "type_id": b.type_id, "name": b.name,
+                    "alg": b.alg, "items": list(b.items),
+                    "weights": list(b.weights),
+                }
+                for b in self.buckets.values()
+            ],
+            "rules": [
+                {
+                    "name": r.name, "rule_id": r.rule_id,
+                    "steps": [list(s) for s in r.steps],
+                }
+                for r in self.rules.values()
+            ],
+            "max_device": self.max_device,
+            "parent": {str(c): p for c, p in self._parent.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CrushMap":
+        m = cls(Tunables(**d["tunables"]))
+        m.types = {str(k): int(v) for k, v in d["types"].items()}
+        for bd in d["buckets"]:
+            b = Bucket(int(bd["id"]), int(bd["type_id"]), bd["name"],
+                       bd["alg"], list(bd["items"]), list(bd["weights"]))
+            m.buckets[b.id] = b
+            m.names[b.name] = b.id
+        m._next_bucket_id = min(m.buckets, default=0) - 1
+        for rd in d["rules"]:
+            m.rules[rd["name"]] = Rule(
+                rd["name"], [tuple(s) for s in rd["steps"]],
+                int(rd["rule_id"]),
+            )
+        m.max_device = int(d["max_device"])
+        m._parent = {int(c): int(p) for c, p in d["parent"].items()}
+        return m
+
     # -- mapping ---------------------------------------------------------
     def _is_out(self, reweights, item: int, x: int) -> bool:
         """Reweight test (mapper.c:424): probabilistically reject devices

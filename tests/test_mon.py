@@ -1,0 +1,338 @@
+"""Monitor: store WAL, single-mon cluster, 3-mon paxos quorum, leader
+failover, command routing via peons, subscriptions, failure reports."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.mon import MonClient, Monitor, MonitorDBStore
+from ceph_tpu.mon.store import StoreTransaction
+from ceph_tpu.msg import reset_local_namespace
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def fast_conf(**over):
+    overrides = {
+        "mon_lease": 0.4, "mon_lease_interval": 0.1,
+        "mon_election_timeout": 0.3, "mon_tick_interval": 0.1,
+        "mon_accept_timeout": 0.5,
+    }
+    overrides.update(over)
+    return ConfigProxy(overrides=overrides)
+
+
+# ---------------------------------------------------------------------------
+# store
+
+def test_store_wal_replay(tmp_path):
+    path = str(tmp_path / "mon.a")
+    s = MonitorDBStore(path)
+    s.apply_transaction(
+        StoreTransaction().put("p", "k1", b"v1").put("p", "k2", 42)
+    )
+    s.apply_transaction(StoreTransaction().erase("p", "k1"))
+    s.close()
+    s2 = MonitorDBStore(path)
+    assert s2.get("p", "k1") is None
+    assert s2.get_int("p", "k2") == 42
+    assert list(s2.keys("p")) == ["k2"]
+    s2.close()
+
+
+def test_store_torn_tail_ignored(tmp_path):
+    path = str(tmp_path / "mon.b")
+    s = MonitorDBStore(path)
+    s.apply_transaction(StoreTransaction().put("p", "k", b"good"))
+    s.close()
+    with open(f"{path}/store.wal", "ab") as f:
+        f.write(b"\xff\xff\xff\x7f partial")
+    s2 = MonitorDBStore(path)
+    assert s2.get("p", "k") == b"good"
+    s2.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster helpers
+
+async def start_mons(names, conf_factory=fast_conf, store_paths=None):
+    monmap = {n: f"local://mon.{n}" for n in names}
+    mons = []
+    for n in names:
+        mon = Monitor(
+            n, monmap, conf_factory(),
+            store_path=store_paths.get(n) if store_paths else None,
+        )
+        await mon.start()
+        mons.append(mon)
+    return mons
+
+
+async def wait_quorum(mons, timeout=10.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    alive = [m for m in mons if not m._stopped]
+    while True:
+        leaders = {m.elector.leader for m in alive}
+        if (len(leaders) == 1 and None not in leaders
+                and all(not m.elector.electing for m in alive)
+                and any(m.is_leader and m.paxos.ready for m in alive)):
+            return next(m for m in alive if m.is_leader)
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(
+                f"no quorum: {[(m.name, m.elector.leader) for m in alive]}"
+            )
+        await asyncio.sleep(0.02)
+
+
+async def wait_epoch(mons, epoch, timeout=10.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while any(m.osd_monitor.osdmap.epoch < epoch for m in mons
+              if not m._stopped):
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError("epoch not reached")
+        await asyncio.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# single monitor
+
+def test_single_mon_genesis_and_commands():
+    async def run():
+        (mon,) = await start_mons(["a"])
+        await wait_quorum([mon])
+        await wait_epoch([mon], 1)
+        assert "replicated_rule" in mon.osd_monitor.osdmap.crush.rules
+
+        client = MonClient("client.1", mon.monmap, fast_conf())
+        await client.start()
+        r = await client.command("osd pool create", pool="rbd", pg_num=8)
+        assert r["rc"] == 0, r
+        r = await client.command("osd pool ls")
+        assert r["data"] == ["rbd"]
+        r = await client.command(
+            "osd erasure-code-profile set", name="p42",
+            profile={"plugin": "jax_rs", "k": "4", "m": "2"},
+        )
+        assert r["rc"] == 0, r
+        r = await client.command(
+            "osd pool create", pool="ecpool", pool_type="erasure",
+            erasure_code_profile="p42",
+        )
+        assert r["rc"] == 0, r
+        r = await client.command("osd pool get", pool="ecpool")
+        assert r["data"]["size"] == 6 and r["data"]["min_size"] == 5
+        assert r["data"]["type"] == "erasure"
+        assert "ec_p42" in mon.osd_monitor.osdmap.crush.rules
+        r = await client.command("status")
+        assert r["data"]["osdmap"]["num_pools"] == 2
+        await client.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
+
+
+def test_mon_restart_recovers_state(tmp_path):
+    async def run():
+        paths = {"a": str(tmp_path / "mon.a")}
+        (mon,) = await start_mons(["a"], store_paths=paths)
+        await wait_quorum([mon])
+        client = MonClient("client.1", mon.monmap, fast_conf())
+        await client.start()
+        r = await client.command("osd pool create", pool="persist")
+        assert r["rc"] == 0
+        epoch = mon.osd_monitor.osdmap.epoch
+        await client.shutdown()
+        await mon.shutdown()
+        reset_local_namespace()
+
+        (mon2,) = await start_mons(["a"], store_paths=paths)
+        await wait_quorum([mon2])
+        assert mon2.osd_monitor.osdmap.epoch == epoch
+        assert [p.name for p in mon2.osd_monitor.osdmap.pools.values()] \
+            == ["persist"]
+        await mon2.shutdown()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# three-monitor quorum
+
+def test_three_mon_quorum_replicates_commits():
+    async def run():
+        mons = await start_mons(["a", "b", "c"])
+        leader = await wait_quorum(mons)
+        assert leader.name == "a"          # lowest rank wins
+        client = MonClient("client.1", mons[0].monmap, fast_conf())
+        await client.start()
+        r = await client.command("osd pool create", pool="pool1")
+        assert r["rc"] == 0
+        await wait_epoch(mons, leader.osd_monitor.osdmap.epoch)
+        for m in mons:
+            assert [p.name for p in m.osd_monitor.osdmap.pools.values()] \
+                == ["pool1"]
+        r = await client.command("quorum_status")
+        assert r["data"]["quorum"] == ["a", "b", "c"]
+        await client.shutdown()
+        for m in mons:
+            await m.shutdown()
+    asyncio.run(run())
+
+
+def test_command_via_peon_forwarded_to_leader():
+    async def run():
+        mons = await start_mons(["a", "b", "c"])
+        await wait_quorum(mons)
+        # connect the client ONLY to peon c
+        client = MonClient(
+            "client.9", {"c": mons[2].monmap["c"]}, fast_conf()
+        )
+        await client.start()
+        r = await client.command("osd pool create", pool="viapeon")
+        assert r["rc"] == 0, r
+        await wait_epoch(mons, 2)
+        assert any(p.name == "viapeon"
+                   for p in mons[0].osd_monitor.osdmap.pools.values())
+        await client.shutdown()
+        for m in mons:
+            await m.shutdown()
+    asyncio.run(run())
+
+
+def test_leader_failover_and_continued_service():
+    async def run():
+        mons = await start_mons(["a", "b", "c"])
+        leader = await wait_quorum(mons)
+        await wait_epoch(mons, 1)
+        await leader.shutdown()            # kill mon.a
+        rest = [m for m in mons if m is not leader]
+        new_leader = await wait_quorum(rest, timeout=15.0)
+        assert new_leader.name == "b"
+        client = MonClient(
+            "client.2",
+            {m.name: m.monmap[m.name] for m in rest}, fast_conf(),
+        )
+        await client.start()
+        r = await client.command("osd pool create", pool="after", timeout=15)
+        assert r["rc"] == 0, r
+        assert any(p.name == "after"
+                   for p in new_leader.osd_monitor.osdmap.pools.values())
+        await client.shutdown()
+        for m in rest:
+            await m.shutdown()
+    asyncio.run(run())
+
+
+def test_rejoining_mon_catches_up():
+    async def run():
+        mons = await start_mons(["a", "b", "c"])
+        await wait_quorum(mons)
+        await wait_epoch(mons, 1)
+        # kill peon c, commit while it is away, restart it
+        await mons[2].shutdown()
+        client = MonClient("client.3", mons[0].monmap, fast_conf())
+        await client.start()
+        for i in range(3):
+            r = await client.command("osd pool create", pool=f"p{i}",
+                                     timeout=15)
+            assert r["rc"] == 0
+        fresh = Monitor("c", mons[0].monmap, fast_conf())
+        await fresh.start()
+        await wait_quorum([mons[0], mons[1], fresh], timeout=15.0)
+        await wait_epoch([fresh], mons[0].osd_monitor.osdmap.epoch,
+                         timeout=15.0)
+        assert len(fresh.osd_monitor.osdmap.pools) == 3
+        await client.shutdown()
+        for m in (mons[0], mons[1], fresh):
+            await m.shutdown()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# subscriptions + config + auth + failure reports
+
+def test_client_subscription_and_config_push():
+    async def run():
+        (mon,) = await start_mons(["a"])
+        await wait_quorum([mon])
+        conf = fast_conf()
+        client = MonClient("client.5", mon.monmap, conf)
+        await client.start()
+        client.sub_want("osdmap")
+        client.sub_want("config")
+        client.renew_subs()
+        m = await client.wait_for_map(1)
+        assert m.epoch >= 1
+        # a config set must reach the client's ConfigProxy
+        r = await client.command("config set",
+                                 name="osd_recovery_max_active", value="3")
+        assert r["rc"] == 0, r
+        deadline = asyncio.get_running_loop().time() + 5
+        while conf["osd_recovery_max_active"] != 3:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        # map changes are pushed: create a pool, client sees new epoch
+        cur = client.osdmap.epoch
+        await client.command("osd pool create", pool="subs")
+        m = await client.wait_for_map(cur + 1)
+        assert any(p.name == "subs" for p in m.pools.values())
+        await client.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
+
+
+def test_auth_shared_key():
+    async def run():
+        key_conf = lambda: fast_conf(auth_shared_key="sekret")  # noqa: E731
+        (mon,) = await start_mons(["a"], conf_factory=key_conf)
+        await wait_quorum([mon])
+        good = MonClient("client.6", mon.monmap,
+                         fast_conf(auth_shared_key="sekret"))
+        await good.start()
+        r = await good.command("status")
+        assert r["rc"] == 0
+        await good.shutdown()
+        bad = MonClient("client.7", mon.monmap,
+                        fast_conf(auth_shared_key="wrong"))
+        with pytest.raises((ConnectionError, TimeoutError, OSError)):
+            await bad.start(timeout=1.0)
+        await bad.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
+
+
+def test_osd_boot_and_failure_reports():
+    async def run():
+        (mon,) = await start_mons(["a"])
+        await wait_quorum([mon])
+        osd_clients = []
+        for i in range(3):
+            mc = MonClient(f"osd.{i}", mon.monmap, fast_conf())
+            await mc.start()
+            mc.sub_want("osdmap")
+            mc.renew_subs()
+            await mc.send_boot(i, f"local://osd.{i}", host=f"h{i}")
+            osd_clients.append(mc)
+        m = mon.osd_monitor.osdmap
+        assert all(m.is_up(i) for i in range(3))
+        assert {b.name for b in m.crush.buckets.values()} >= \
+            {"default", "h0", "h1", "h2"}
+        # two reporters (min_down_reporters=1) report osd.2 down
+        osd_clients[0].report_failure(2, failed_for=10.0)
+        deadline = asyncio.get_running_loop().time() + 5
+        while mon.osd_monitor.osdmap.is_up(2):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        # subscribers see the down-marking
+        m = await osd_clients[0].wait_for_map(
+            mon.osd_monitor.osdmap.epoch
+        )
+        assert not m.is_up(2)
+        for mc in osd_clients:
+            await mc.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())

@@ -157,12 +157,33 @@ def test_lossy_reset_notifies_dispatcher():
     asyncio.run(run())
 
 
-def test_connect_to_missing_listener_raises():
+def test_lossy_connect_to_missing_listener_raises():
     async def run():
         b = Messenger("client.1")
+        b.set_policy("mon", Policy.lossy_client())
         await b.bind("local://c")
         with pytest.raises(ConnectionError):
-            await b.connect("local://nowhere")
+            await b.connect("local://nowhere", peer_name="mon.a")
+        await b.shutdown()
+    asyncio.run(run())
+
+
+def test_lossless_connect_queues_until_listener_appears():
+    # lazy-connect: a lossless peer conn queues sends while the peer is
+    # down and replays them once it binds
+    async def run():
+        b = Messenger("osd.1")
+        await b.bind("local://b")
+        conn = await b.connect("local://late", peer_name="osd.2")
+        conn.send_message(Message("early", {"i": 1}))
+        await asyncio.sleep(0.05)
+        a = Messenger("osd.2")
+        ca = Collector()
+        a.set_dispatcher(ca)
+        await a.bind("local://late")
+        await _wait_for(lambda: ca.messages, timeout=10)
+        assert ca.messages[0][1].type == "early"
+        await a.shutdown()
         await b.shutdown()
     asyncio.run(run())
 
