@@ -183,13 +183,22 @@ class MonClient:
         return await asyncio.wait_for(fut, timeout)
 
     # -- commands / reports ------------------------------------------------
+    def _live_conn(self):
+        """Drop a dead cached session so retry loops re-hunt instead of
+        spinning on a closed connection."""
+        if self.conn is not None and self.conn.is_closed:
+            self.conn = None
+        return self.conn
+
     async def command(self, prefix: str, timeout: float = 10.0,
                       **args) -> dict:
         """Returns {"rc", "outs", "data"}; raises on session loss."""
         cmd = {"prefix": prefix, **args}
         deadline = asyncio.get_running_loop().time() + timeout
         while True:
-            if self.conn is None:
+            if self._stopped:
+                raise ConnectionError(f"{self.entity}: client stopped")
+            if self._live_conn() is None:
                 await self._wait_for_session(deadline)
             self._tid += 1
             tid = self._tid
@@ -204,7 +213,9 @@ class MonClient:
                              asyncio.get_running_loop().time())
                 )
             except ConnectionError:
-                continue            # session reset: re-hunt + retry
+                self._command_futures.pop(tid, None)
+                await asyncio.sleep(0.05)   # yield; session reset re-hunts
+                continue
             except asyncio.TimeoutError:
                 self._command_futures.pop(tid, None)
                 raise
@@ -216,7 +227,9 @@ class MonClient:
             return reply
 
     async def _wait_for_session(self, deadline: float) -> None:
-        while self.conn is None:
+        while self._live_conn() is None:
+            if self._stopped:
+                raise ConnectionError(f"{self.entity}: client stopped")
             if asyncio.get_running_loop().time() > deadline:
                 raise ConnectionError(f"{self.entity}: no mon session")
             await asyncio.sleep(0.05)
@@ -226,13 +239,16 @@ class MonClient:
         """MOSDBoot: register as up; resolves when the map shows it."""
         deadline = asyncio.get_running_loop().time() + timeout
         while True:
-            if self.conn is None:
+            if self._stopped:
+                raise ConnectionError(f"{self.entity}: client stopped")
+            if self._live_conn() is None:
                 await self._wait_for_session(deadline)
             try:
                 self.conn.send_message(Message("osd_boot", {
                     "id": osd_id, "addr": addr, "host": host,
                 }))
             except ConnectionError:
+                await asyncio.sleep(0.05)
                 continue
             await asyncio.sleep(0.05)
             try:

@@ -97,10 +97,11 @@ class Elector:
             self.epoch = epoch if epoch % 2 == 1 else epoch + 1
         peer_rank = self.mon.rank_of(peer)
         if peer_rank < self.rank:
-            # peer outranks us: defer (Elector::defer)
-            if not self.electing:
-                self.electing = True
-                self.deferred = set()
+            # peer outranks us: defer and ABANDON our own candidacy —
+            # keeping accumulated defers here lets two mons win the same
+            # epoch (Elector::defer resets exactly this state)
+            self.electing = True
+            self.deferred = set()
             self.mon.send_mon(peer, Message(
                 "election_defer", {"epoch": self.epoch},
                 priority=PRIO_HIGHEST,

@@ -25,6 +25,7 @@ from ceph_tpu.mon.osd_monitor import OSDMonitor
 from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.service import EPERM_RC, CommandResult, EINVAL_RC
 from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
+from ceph_tpu.msg.codec import encode as codec_encode
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger, Policy
 
@@ -37,6 +38,18 @@ def auth_proof(key: str, entity: str, nonce: str) -> str:
     return hmac.new(
         key.encode(), f"{entity}:{nonce}".encode(), hashlib.sha256
     ).hexdigest()
+
+
+def sign_mon_message(key: str, mtype: str, data: dict) -> str:
+    """HMAC over the canonical codec form of a mon-internal message, so
+    election/paxos/forward traffic can't be injected by merely claiming a
+    mon entity name in the messenger handshake. (Replay of a captured
+    message is bounded by the pn/epoch/version staleness checks in the
+    paxos and election handlers.)"""
+    body = codec_encode(
+        [mtype, {k: data[k] for k in data if k != "sig"}]
+    )
+    return hmac.new(key.encode(), body, hashlib.sha256).hexdigest()
 
 
 class MonSession:
@@ -125,6 +138,9 @@ class Monitor:
     # -- messaging helpers ------------------------------------------------
     def send_mon(self, peer: str, msg: Message) -> None:
         msg.data.setdefault("from", self.name)
+        key = self.conf["auth_shared_key"]
+        if key:
+            msg.data["sig"] = sign_mon_message(key, msg.type, msg.data)
         addr = self.monmap.get(peer)
         if addr is None:
             return
@@ -239,8 +255,17 @@ class Monitor:
 
     def _is_mon_peer(self, conn: Connection, msg: Message) -> bool:
         sender = msg.data.get("from", "")
-        return (sender in self.monmap
-                and conn.peer_name == f"mon.{sender}")
+        if sender not in self.monmap or conn.peer_name != f"mon.{sender}":
+            return False
+        key = self.conf["auth_shared_key"]
+        if key:
+            want = sign_mon_message(key, msg.type, msg.data)
+            if not hmac.compare_digest(want,
+                                       str(msg.data.get("sig", ""))):
+                log.derr("%s: bad mon message signature from %s (%s)",
+                         self.name, sender, msg.type)
+                return False
+        return True
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         t = msg.type

@@ -73,11 +73,17 @@ class OSDMonitor(PaxosService):
         m.apply_incremental(inc)
         self._stage(tx, m, inc)
 
+    KEEP_EPOCHS = 200      # map history trim (OSDMonitor epoch pruning)
+
     def _stage(self, tx: StoreTransaction, new_map: OSDMap,
                inc: Incremental) -> None:
         tx.put(PREFIX, f"full_{new_map.epoch}", encode(new_map.to_dict()))
         tx.put(PREFIX, f"inc_{inc.epoch}", encode(inc.to_dict()))
         tx.put(PREFIX, "last_committed", new_map.epoch)
+        old = new_map.epoch - self.KEEP_EPOCHS
+        if old > 0:
+            tx.erase(PREFIX, f"full_{old}")
+            tx.erase(PREFIX, f"inc_{old}")
 
     def _pending(self) -> Incremental:
         if self.pending is None or self.pending.epoch != self.osdmap.epoch + 1:
@@ -296,9 +302,11 @@ class OSDMonitor(PaxosService):
             cmd.get("pg_num", self.mon.conf["osd_pool_default_pg_num"])
         )
         pending = self._pending()
-        used = (set(self.osdmap.pools)
-                | {p.pool_id for p in pending.new_pools})
-        pool_id = max(used, default=0) + 1
+        # ids are never reused after deletion (max_pool_id is monotonic)
+        pool_id = max(
+            self.osdmap.max_pool_id,
+            max((p.pool_id for p in pending.new_pools), default=0),
+        ) + 1
         if pool_type == "erasure":
             pname = cmd.get("erasure_code_profile", "default")
             profile = (pending.new_ec_profiles.get(pname)

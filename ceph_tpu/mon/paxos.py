@@ -22,6 +22,7 @@ from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
 log = Dout("mon")
 
 PREFIX = "paxos"
+KEEP_VERSIONS = 500     # trim window (Paxos::trim / paxos_max_join_drift)
 
 
 class Paxos:
@@ -318,6 +319,8 @@ class Paxos:
         tx.put(PREFIX, "last_committed", v)
         tx.erase(PREFIX, "pending_v")
         tx.erase(PREFIX, "pending_pn")
+        if v > KEEP_VERSIONS:
+            tx.erase(PREFIX, str(v - KEEP_VERSIONS))   # Paxos::trim
         self.store.apply_transaction(tx)
         self.last_committed = v
         self._uncommitted = None

@@ -57,17 +57,23 @@ class StoreTransaction:
         return tx
 
 
+COMPACT_BYTES = 16 * 1024 * 1024      # WAL rewrite threshold
+
+
 class MonitorDBStore:
     def __init__(self, path: str | None = None):
         """``path``: directory for the WAL (None = memory only)."""
         self._data: dict[str, dict[str, bytes]] = {}
         self._wal = None
+        self._wal_path: str | None = None
+        self._wal_bytes = 0
         if path is not None:
             os.makedirs(path, exist_ok=True)
-            wal_path = os.path.join(path, "store.wal")
-            if os.path.exists(wal_path):
-                self._replay(wal_path)
-            self._wal = open(wal_path, "ab")
+            self._wal_path = os.path.join(path, "store.wal")
+            if os.path.exists(self._wal_path):
+                self._replay(self._wal_path)
+                self._wal_bytes = os.path.getsize(self._wal_path)
+            self._wal = open(self._wal_path, "ab")
 
     def _replay(self, wal_path: str) -> None:
         with open(wal_path, "rb") as f:
@@ -100,7 +106,28 @@ class MonitorDBStore:
             self._wal.write(_LEN.pack(len(raw)) + raw)
             self._wal.flush()
             os.fsync(self._wal.fileno())
+            self._wal_bytes += _LEN.size + len(raw)
         self._apply(tx)
+        if self._wal is not None and self._wal_bytes > COMPACT_BYTES:
+            self._compact()
+
+    def _compact(self) -> None:
+        """Rewrite the WAL as one snapshot transaction (the RocksDB
+        compaction role): erased/overwritten history is dropped."""
+        snap = StoreTransaction()
+        for prefix, kv in self._data.items():
+            for key, value in kv.items():
+                snap.put(prefix, key, value)
+        raw = snap.encode()
+        tmp = self._wal_path + ".compact"
+        with open(tmp, "wb") as f:
+            f.write(_LEN.pack(len(raw)) + raw)
+            f.flush()
+            os.fsync(f.fileno())
+        self._wal.close()
+        os.replace(tmp, self._wal_path)
+        self._wal = open(self._wal_path, "ab")
+        self._wal_bytes = os.path.getsize(self._wal_path)
 
     # -- reads -----------------------------------------------------------
     def get(self, prefix: str, key: str) -> bytes | None:

@@ -461,7 +461,11 @@ class Messenger:
             conn._start_io()
         except BaseException as e:
             if not fut.done():
-                fut.set_exception(e)
+                # a CancelledError belongs to THIS caller only — waiters
+                # sharing the dial get a ConnectionError, not cancellation
+                shared = (MessengerError(f"dial to {addr} cancelled")
+                          if isinstance(e, asyncio.CancelledError) else e)
+                fut.set_exception(shared)
                 fut.exception()     # mark retrieved for the no-waiter case
             raise
         finally:

@@ -1,0 +1,938 @@
+"""OSD daemon: boot, heartbeats, op dispatch, peering, recovery.
+
+The role of reference src/osd/OSD.{h,cc} + PrimaryLogPG.cc in one async
+daemon: boot registers with the monitor (OSD::init, OSD.cc:3283 ->
+MOSDBoot), map subscriptions drive PG intervals, peer heartbeats feed
+failure reports (handle_osd_ping OSD.cc:5236 -> MOSDFailure), client ops
+dispatch to the primary's op interpreter (do_osd_ops, PrimaryLogPG.cc:5652)
+and fan out to replicas/shards as sub-ops (MOSDRepOp / MOSDECSubOpWrite),
+and recovery rebuilds stale shards after peering.
+
+TPU-native shape: the EC hot path is ONE batched device encode per write
+via ECBackend (ceph_tpu.osd.ec_backend); the daemon is pure host-side
+orchestration around it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Mapping
+
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.common.log import Dout
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.msg.message import PRIO_HIGH, Message
+from ceph_tpu.msg.messenger import Connection, Messenger, Policy
+from ceph_tpu.osd.ec_backend import (
+    HINFO_ATTR,
+    VERSION_ATTR,
+    ECBackend,
+    LocalShard,
+    ShardReadError,
+)
+from ceph_tpu.osd.osd_map import NO_OSD, OSDMap
+from ceph_tpu.osd.pg import (
+    STATE_ACTIVE,
+    STATE_PEERING,
+    STATE_RECOVERING,
+    PG,
+    PGId,
+    PeerInfo,
+    object_to_ps,
+)
+from ceph_tpu.store import CollectionId, GHObject, MemStore, ObjectStore
+from ceph_tpu.store import Transaction as StoreTx
+
+log = Dout("osd")
+
+# op interpreter result codes (errno-style, matching librados)
+OK = 0
+ENOENT_RC = -2
+EIO_RC = -5
+EAGAIN_RC = -11
+EINVAL_RC = -22
+ENOTSUP_RC = -95
+MISDIRECTED_RC = -1000        # resend after map refresh (reference drops)
+
+XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
+
+# message types the embedded MonClient owns
+_MON_TYPES = {
+    "auth_challenge", "auth_reply", "auth_bad", "mon_command_reply",
+    "osd_map", "config", "mon_map",
+}
+
+
+def _enc_cid(cid: CollectionId) -> list:
+    return [cid.pool, cid.pg, cid.shard]
+
+
+def _dec_cid(v: list) -> CollectionId:
+    return CollectionId(int(v[0]), int(v[1]), int(v[2]))
+
+
+def _enc_oid(o: GHObject) -> list:
+    return [o.pool, o.name, o.snap, o.gen, o.shard]
+
+
+def _dec_oid(v: list) -> GHObject:
+    return GHObject(int(v[0]), str(v[1]), int(v[2]), int(v[3]), int(v[4]))
+
+
+def encode_tx(tx: StoreTx) -> list:
+    """Store transaction -> wire form (the ObjectStore::Transaction
+    encode role for MOSDRepOp payloads)."""
+    out = []
+    for op in tx.ops:
+        wire = [op[0]]
+        for arg in op[1:]:
+            if isinstance(arg, CollectionId):
+                wire.append({"_c": _enc_cid(arg)})
+            elif isinstance(arg, GHObject):
+                wire.append({"_o": _enc_oid(arg)})
+            else:
+                wire.append(arg)
+        out.append(wire)
+    return out
+
+
+def decode_tx(wire: list) -> StoreTx:
+    tx = StoreTx()
+    for wop in wire:
+        args = []
+        for arg in wop[1:]:
+            if isinstance(arg, dict) and "_c" in arg:
+                args.append(_dec_cid(arg["_c"]))
+            elif isinstance(arg, dict) and "_o" in arg:
+                args.append(_dec_oid(arg["_o"]))
+            else:
+                args.append(arg)
+        tx.ops.append(tuple([wop[0], *args]))
+    return tx
+
+
+class DeadShard:
+    """ShardIO for an acting-set hole (NO_OSD): every IO fails so the
+    EC backend reconstructs around it."""
+
+    def __init__(self, shard: int):
+        self.shard = shard
+
+    async def _fail(self, *a, **kw):
+        raise ShardReadError(f"shard {self.shard} has no osd")
+
+    write_shard = read_shard = get_attr = remove_shard = stat_shard = _fail
+
+
+class NetworkShard:
+    """ShardIO over sub-ops to a peer OSD (the MOSDECSubOpWrite/Read fan-
+    out, reference ECBackend.cc:2090/1010)."""
+
+    def __init__(self, daemon: "OSDDaemon", osd: int, cid: CollectionId):
+        self.daemon = daemon
+        self.osd = osd
+        self.cid = cid
+
+    async def _sub(self, kind: str, **args):
+        return await self.daemon.send_sub_op(
+            self.osd, kind, cid=_enc_cid(self.cid), **args
+        )
+
+    async def write_shard(self, oid, offset, data, attrs):
+        await self._sub("write", oid=oid, off=offset, data=bytes(data),
+                        attrs={k: bytes(v) for k, v in attrs.items()})
+
+    async def read_shard(self, oid, offset=0, length=None):
+        return await self._sub("read", oid=oid, off=offset, len=length)
+
+    async def get_attr(self, oid, name):
+        return await self._sub("getattr", oid=oid, name=name)
+
+    async def get_attrs(self, oid):
+        return await self._sub("getattrs", oid=oid)
+
+    async def remove_shard(self, oid):
+        await self._sub("remove", oid=oid)
+
+    async def stat_shard(self, oid):
+        return await self._sub("stat", oid=oid)
+
+
+class OSDDaemon:
+    def __init__(self, osd_id: int, monmap: dict[str, str],
+                 conf: ConfigProxy | None = None,
+                 store: ObjectStore | None = None,
+                 addr: str | None = None, host: str = ""):
+        self.osd_id = osd_id
+        self.entity = f"osd.{osd_id}"
+        self.conf = conf or ConfigProxy()
+        self.store = store or MemStore()
+        self.addr = addr or f"local://{self.entity}"
+        self.host = host or f"host-{osd_id}"
+        self.msgr = Messenger(self.entity, self.conf)
+        self.msgr.set_policy("mon", Policy.lossy_client())
+        self.msgr.set_policy("client", Policy.stateless_server())
+        self.msgr.set_dispatcher(self)
+        self.monc = MonClient(self.entity, monmap, self.conf,
+                              msgr=self.msgr)
+        self.monc.on_osdmap = self._on_map
+        self.osdmap: OSDMap | None = None
+        self.pgs: dict[PGId, PG] = {}
+        self._sub_tid = 0
+        self._sub_futures: dict[int, asyncio.Future] = {}
+        # heartbeat state: peer -> last reply time
+        self._hb_last_rx: dict[int, float] = {}
+        self._hb_first_tx: dict[int, float] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+        self._booted = False
+        self._reboot_epoch = 0
+        self._map_lock = asyncio.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self, timeout: float = 20.0) -> None:
+        await self.store.mount()
+        await self.msgr.bind(self.addr)
+        await self.monc.start(timeout)
+        self.monc.sub_want("osdmap")
+        self.monc.sub_want("config")
+        self.monc.renew_subs()
+        await self.monc.send_boot(self.osd_id, str(self.msgr.my_addr),
+                                  host=self.host, timeout=timeout)
+        self._booted = True
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        log.dout(1, "%s: booted at %s", self.entity, self.msgr.my_addr)
+
+    async def shutdown(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for pg in self.pgs.values():
+            if pg.peering_task is not None:
+                pg.peering_task.cancel()
+        await self.monc.shutdown()
+        await self.msgr.shutdown()
+        await self.store.umount()
+
+    # -- dispatch ----------------------------------------------------------
+    def ms_handle_connect(self, conn: Connection) -> None:
+        pass
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        self.monc.ms_handle_reset(conn)
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        t = msg.type
+        if t in _MON_TYPES:
+            await self.monc.ms_dispatch(conn, msg)
+        elif t == "osd_op":
+            # client ops can wait on peering/recovery: off the reader loop
+            asyncio.get_running_loop().create_task(
+                self._handle_osd_op(conn, msg.data)
+            )
+        elif t == "sub_op":
+            asyncio.get_running_loop().create_task(
+                self._handle_sub_op(conn, msg.data)
+            )
+        elif t == "sub_reply":
+            fut = self._sub_futures.pop(int(msg.data["tid"]), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg.data)
+        elif t == "pg_query":
+            self._handle_pg_query(conn, msg.data)
+        elif t == "pg_notify":
+            self._handle_pg_notify(msg.data)
+        elif t == "pg_activate":
+            self._handle_pg_activate(msg.data)
+        elif t == "osd_ping":
+            conn.send_message(Message(
+                "osd_ping_reply", {"from": self.osd_id, "ts": msg.data["ts"]},
+                priority=PRIO_HIGH,
+            ))
+        elif t == "osd_ping_reply":
+            self._hb_last_rx[int(msg.data["from"])] = time.monotonic()
+            self._hb_first_tx.pop(int(msg.data["from"]), None)
+        else:
+            log.dout(5, "%s: ignoring %s", self.entity, t)
+
+    # -- map handling --------------------------------------------------------
+    async def _on_map(self, osdmap: OSDMap) -> None:
+        async with self._map_lock:
+            self.osdmap = osdmap
+            # stop reconnect churn toward peers the map marks down
+            for osd, info in osdmap.osds.items():
+                if not info.up and info.addr and osd != self.osd_id:
+                    conn = self.msgr._conns.get(info.addr)
+                    if conn is not None:
+                        conn.mark_down()
+            await self._scan_pgs()
+        # wrongly marked down while alive: re-assert ourselves (the
+        # reference OSD reboots into the map the same way)
+        me = osdmap.osds.get(self.osd_id)
+        if (self._booted and me is not None and not me.up
+                and osdmap.epoch > self._reboot_epoch):
+            self._reboot_epoch = osdmap.epoch
+            log.dout(1, "%s: map e%d wrongly marks us down, re-booting",
+                     self.entity, osdmap.epoch)
+
+            async def reboot():
+                if self._stopped:
+                    return
+                try:
+                    await self.monc.send_boot(
+                        self.osd_id, str(self.msgr.my_addr),
+                        host=self.host,
+                    )
+                except (ConnectionError, TimeoutError):
+                    pass
+
+            asyncio.get_running_loop().create_task(reboot())
+
+    async def _scan_pgs(self) -> None:
+        """Recompute PG ownership from the current map (the load_pgs /
+        advance_pg flow)."""
+        m = self.osdmap
+        for pool in m.pools.values():
+            for ps in range(pool.pg_num):
+                up, up_primary, acting, primary = m.pg_to_up_acting(
+                    pool.pool_id, ps
+                )
+                pgid = PGId(pool.pool_id, ps)
+                mine = self.osd_id in acting or self.osd_id in up
+                pg = self.pgs.get(pgid)
+                if not mine:
+                    if pg is not None and self.osd_id not in acting:
+                        pg.state = "stray"
+                        pg.primary = NO_OSD     # drop stale primary role
+                        pg.acting = []
+                        if pg.peering_task is not None:
+                            pg.peering_task.cancel()
+                            pg.peering_task = None
+                    continue
+                if pg is None:
+                    pg = PG(pgid, pool, self.osd_id)
+                    self.pgs[pgid] = pg
+                    await self._ensure_collections(pg, acting)
+                pg.pool = pool
+                if not pg.same_interval(acting, up, primary):
+                    pg.start_interval(m.epoch, acting, up, primary)
+                    await self._ensure_collections(pg, acting)
+                    self._make_backend(pg)
+                    if pg.is_primary:
+                        pg.peering_task = asyncio.create_task(
+                            self._peer(pg)
+                        )
+
+    async def _ensure_collections(self, pg: PG, acting: list[int]) -> None:
+        tx = StoreTx()
+        for cid in self._my_cids(pg, acting):
+            tx.create_collection(cid)
+        await self.store.queue_transactions(tx)
+
+    def _my_cids(self, pg: PG, acting: list[int]) -> list[CollectionId]:
+        if pg.is_ec:
+            return [
+                CollectionId(pg.pgid.pool, pg.pgid.ps, shard)
+                for shard, osd in enumerate(acting)
+                if osd == self.osd_id
+            ]
+        return [CollectionId(pg.pgid.pool, pg.pgid.ps)]
+
+    def _make_backend(self, pg: PG) -> None:
+        if not pg.is_primary:
+            pg.backend = None
+            return
+        if pg.is_ec:
+            profile = dict(
+                self.osdmap.ec_profiles.get(pg.pool.ec_profile, {})
+            ) or {"plugin": "jax_rs", "k": "2", "m": "2"}
+            codec = ErasureCodePluginRegistry.instance().factory(
+                profile.get("plugin", "jax_rs"), profile
+            )
+            shards = {}
+            for shard, osd in enumerate(pg.acting):
+                cid = CollectionId(pg.pgid.pool, pg.pgid.ps, shard)
+                if osd == self.osd_id:
+                    shards[shard] = LocalShard(
+                        self.store, cid, pg.pgid.pool, shard
+                    )
+                elif osd == NO_OSD:
+                    shards[shard] = DeadShard(shard)
+                else:
+                    shards[shard] = NetworkShard(self, osd, cid)
+            pg.backend = ECBackend(codec, shards)
+        else:
+            pg.backend = None       # replicated path works on the store
+
+    # -- peering (primary) ---------------------------------------------------
+    async def _peer(self, pg: PG) -> None:
+        """GetInfo -> compute missing -> Activate -> recover (the
+        PeeringMachine Primary path, PeeringState.h:556). Queries are
+        re-sent until every acting shard answers — a peer that was mid-
+        boot for the first round answers a retry."""
+        try:
+            epoch = pg.epoch
+            pg.record_info(self._local_info(pg))
+            next_query = 0.0
+            while not pg.all_infos_in():
+                if pg.epoch != epoch:
+                    return                      # interval changed
+                now = time.monotonic()
+                if now >= next_query:
+                    next_query = now + 1.0
+                    for shard, osd in pg.acting_peers():
+                        if shard in pg.peer_infos:
+                            continue
+                        self._send_osd(osd, Message("pg_query", {
+                            "pgid": [pg.pgid.pool, pg.pgid.ps],
+                            "epoch": epoch,
+                            "shard": shard, "from": self.osd_id,
+                        }, priority=PRIO_HIGH))
+                await asyncio.sleep(0.01)
+            auth = pg.authoritative_versions()
+            missing = pg.compute_missing(auth)
+            for shard, osd in pg.acting_peers():
+                self._send_osd(osd, Message("pg_activate", {
+                    "pgid": [pg.pgid.pool, pg.pgid.ps], "epoch": epoch,
+                }, priority=PRIO_HIGH))
+            if missing:
+                pg.state = STATE_RECOVERING
+                await self._recover(pg, missing)
+                if pg.epoch != epoch:
+                    return
+            pg.state = STATE_ACTIVE
+            self._drain_waiters(pg)
+            log.dout(5, "pg %s: active (recovered %d shards)",
+                     pg.pgid, len(missing))
+        except asyncio.CancelledError:
+            pass
+
+    def _local_info(self, pg: PG) -> PeerInfo:
+        shard = (pg.acting.index(self.osd_id)
+                 if self.osd_id in pg.acting else NO_OSD)
+        return PeerInfo(shard, self.osd_id,
+                        self._inventory(pg, shard))
+
+    def _inventory(self, pg: PG, shard: int) -> dict[str, int]:
+        """name -> version for our shard of this PG (the MOSDPGNotify
+        info payload; versions from object metadata, not pg_log)."""
+        cid = (CollectionId(pg.pgid.pool, pg.pgid.ps, shard) if pg.is_ec
+               else CollectionId(pg.pgid.pool, pg.pgid.ps))
+        out: dict[str, int] = {}
+        try:
+            objects = self.store.list_objects(cid)
+        except KeyError:
+            return out
+        for oid in objects:
+            try:
+                raw = self.store.getattr(cid, oid, VERSION_ATTR)
+                out[oid.name] = int(json.loads(raw)["version"])
+            except (KeyError, ValueError, TypeError):
+                out[oid.name] = 1
+        return out
+
+    def _handle_pg_query(self, conn: Connection, d: dict) -> None:
+        pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
+        pg = self.pgs.get(pgid)
+        shard = int(d["shard"])
+        inventory = self._inventory(pg, shard) if pg is not None else {}
+        conn.send_message(Message("pg_notify", {
+            "pgid": [pgid.pool, pgid.ps], "epoch": d["epoch"],
+            "shard": shard, "osd": self.osd_id, "objects": inventory,
+        }, priority=PRIO_HIGH))
+
+    def _handle_pg_notify(self, d: dict) -> None:
+        pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
+        pg = self.pgs.get(pgid)
+        if pg is None or not pg.is_primary or pg.epoch != int(d["epoch"]):
+            return
+        pg.record_info(PeerInfo(
+            int(d["shard"]), int(d["osd"]),
+            {str(k): int(v) for k, v in d["objects"].items()},
+        ))
+
+    def _handle_pg_activate(self, d: dict) -> None:
+        pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
+        pg = self.pgs.get(pgid)
+        if pg is not None and not pg.is_primary:
+            pg.state = STATE_ACTIVE
+
+    # -- recovery ------------------------------------------------------------
+    async def _recover(self, pg: PG, missing: Mapping[int, list[str]]
+                       ) -> None:
+        """Rebuild stale shards (RecoveryOp READING->WRITING,
+        ECBackend.h:249; replicated push/pull, ReplicatedBackend.cc)."""
+        sem = asyncio.Semaphore(self.conf["osd_recovery_max_active"])
+        if pg.is_ec:
+            by_oid: dict[str, list[int]] = {}
+            for shard, oids in missing.items():
+                for name in oids:
+                    by_oid.setdefault(name, []).append(shard)
+
+            async def recover_one(name: str, shards: list[int]):
+                async with sem:
+                    try:
+                        await pg.backend.recover_shard(name, shards)
+                    except (ShardReadError, IOError) as e:
+                        log.derr("pg %s: recover %s failed: %s",
+                                 pg.pgid, name, e)
+
+            await asyncio.gather(*(
+                recover_one(n, s) for n, s in by_oid.items()
+            ))
+        else:
+            auth = pg.authoritative_versions()
+            cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
+            my_shard = pg.acting.index(self.osd_id)
+            mine = set(missing.get(my_shard, ()))
+
+            async def pull(name: str):
+                """Fetch the newest copy from whichever peer has it."""
+                want = auth[name]
+                for info in pg.peer_infos.values():
+                    if info.objects.get(name, 0) == want \
+                            and info.osd != self.osd_id:
+                        full = await self.send_sub_op(
+                            info.osd, "read_full", cid=_enc_cid(cid),
+                            oid=name,
+                        )
+                        tx = StoreTx()
+                        oid = GHObject(pg.pgid.pool, name)
+                        tx.remove(cid, oid).write(
+                            cid, oid, 0, full["data"]
+                        )
+                        for aname, aval in full["attrs"].items():
+                            tx.setattr(cid, oid, aname, aval)
+                        if full["omap"]:
+                            tx.omap_setkeys(cid, oid, full["omap"])
+                        await self.store.queue_transactions(tx)
+                        return
+                log.derr("pg %s: no source for %s", pg.pgid, name)
+
+            async def push(name: str, osd: int):
+                data = self.store.read(cid, GHObject(pg.pgid.pool, name))
+                obj = GHObject(pg.pgid.pool, name)
+                attrs = self.store.getattrs(cid, obj)
+                omap = self.store.omap_get(cid, obj)
+                tx = StoreTx()
+                tx.remove(cid, obj).write(cid, obj, 0, data)
+                for aname, aval in attrs.items():
+                    tx.setattr(cid, obj, aname, aval)
+                if omap:
+                    tx.omap_setkeys(cid, obj, omap)
+                await self.send_sub_op(osd, "tx", cid=_enc_cid(cid),
+                                       ops=encode_tx(tx))
+
+            async def run_one(coro):
+                async with sem:
+                    try:
+                        await coro
+                    except (ConnectionError, KeyError, IOError) as e:
+                        log.derr("pg %s: recovery error: %s", pg.pgid, e)
+
+            # pull our own stale objects first, then push to stale peers
+            await asyncio.gather(*(run_one(pull(n)) for n in mine))
+            pushes = []
+            for shard, oids in missing.items():
+                osd = pg.acting[shard]
+                if osd in (self.osd_id, NO_OSD):
+                    continue
+                pushes.extend(run_one(push(n, osd)) for n in oids)
+            await asyncio.gather(*pushes)
+
+    def _drain_waiters(self, pg: PG) -> None:
+        waiters, pg.waiting_for_active = pg.waiting_for_active, []
+        for conn, data in waiters:
+            asyncio.get_running_loop().create_task(
+                self._handle_osd_op(conn, data)
+            )
+
+    # -- client ops ----------------------------------------------------------
+    async def _handle_osd_op(self, conn: Connection, d: dict) -> None:
+        tid = d.get("tid", 0)
+        try:
+            pgid = PGId(int(d["pool"]), int(d["ps"]))
+            pg = self.pgs.get(pgid)
+            if (pg is None or not pg.is_primary
+                    or (self.osdmap is not None
+                        and int(d.get("epoch", 0)) > self.osdmap.epoch)):
+                self._reply(conn, tid, MISDIRECTED_RC,
+                            epoch=self.osdmap.epoch if self.osdmap else 0)
+                return
+            if pg.state not in (STATE_ACTIVE,):
+                pg.waiting_for_active.append((conn, d))
+                return
+            rc, results, version = await self._do_ops(
+                pg, str(d["oid"]), list(d["ops"])
+            )
+            self._reply(conn, tid, rc, results=results, version=version)
+        except ShardReadError as e:
+            log.derr("%s: osd_op IO error: %s", self.entity, e)
+            self._reply(conn, tid, EIO_RC)
+        except (KeyError, ValueError, TypeError) as e:
+            log.derr("%s: bad osd_op: %s", self.entity, e)
+            self._reply(conn, tid, EINVAL_RC)
+
+    def _reply(self, conn: Connection, tid: int, rc: int, **extra) -> None:
+        try:
+            conn.send_message(Message(
+                "osd_op_reply", {"tid": tid, "rc": rc, **extra}
+            ))
+        except ConnectionError:
+            pass
+
+    async def _do_ops(self, pg: PG, oid: str, ops: list[dict]):
+        """The op interpreter (do_osd_ops, PrimaryLogPG.cc:5652)."""
+        if pg.is_ec:
+            return await self._do_ops_ec(pg, oid, ops)
+        return await self._do_ops_replicated(pg, oid, ops)
+
+    # -- EC op path ----------------------------------------------------------
+    async def _do_ops_ec(self, pg: PG, oid: str, ops: list[dict]):
+        be: ECBackend = pg.backend
+        results: list[dict] = []
+        version = 0
+        try:
+            for op in ops:
+                kind = op["op"]
+                if kind == "write":
+                    meta = await be.write(oid, op["data"],
+                                          int(op.get("off", 0)))
+                    version = meta.version
+                    results.append({})
+                elif kind == "writefull":
+                    old = await be._read_meta(oid)
+                    if old is not None and old.size > len(op["data"]):
+                        await be.remove(oid)
+                    meta = await be.write(oid, op["data"], 0)
+                    version = meta.version
+                    results.append({})
+                elif kind == "append":
+                    meta = await be._read_meta(oid)
+                    off = meta.size if meta else 0
+                    meta = await be.write(oid, op["data"], off)
+                    version = meta.version
+                    results.append({})
+                elif kind == "read":
+                    data = await be.read(oid, int(op.get("off", 0)),
+                                         op.get("len"))
+                    results.append({"data": data})
+                elif kind == "stat":
+                    meta = await be._read_meta(oid)
+                    if meta is None:
+                        return ENOENT_RC, results, 0
+                    results.append({"size": meta.size,
+                                    "version": meta.version})
+                elif kind == "remove":
+                    meta = await be._read_meta(oid)
+                    if meta is None:
+                        return ENOENT_RC, results, 0
+                    await be.remove(oid)
+                    results.append({})
+                elif kind == "create":
+                    meta = await be._read_meta(oid)
+                    if meta is None:
+                        meta = await be.write(oid, b"", 0)
+                    version = meta.version
+                    results.append({})
+                elif kind == "setxattr":
+                    await be.set_attr(oid, XATTR_PREFIX + op["name"],
+                                      op["value"])
+                    results.append({})
+                elif kind == "getxattr":
+                    raw = await be._get_attr_any(
+                        oid, XATTR_PREFIX + op["name"]
+                    )
+                    if raw is None:
+                        return ENOENT_RC, results, 0
+                    results.append({"value": raw})
+                elif kind == "getxattrs":
+                    attrs = await be.get_attrs(oid)
+                    results.append({"attrs": {
+                        k[len(XATTR_PREFIX):]: v
+                        for k, v in attrs.items()
+                        if k.startswith(XATTR_PREFIX)
+                    }})
+                elif kind.startswith("omap_"):
+                    # parity with the reference: EC pools do not support
+                    # omap (PrimaryLogPG rejects omap ops on EC pools)
+                    return ENOTSUP_RC, results, 0
+                else:
+                    return EINVAL_RC, results, 0
+        except KeyError:
+            return ENOENT_RC, results, 0
+        except ShardReadError as e:
+            log.derr("pg %s: EC op failed: %s", pg.pgid, e)
+            return EIO_RC, results, 0
+        return OK, results, version
+
+    # -- replicated op path ----------------------------------------------------
+    async def _do_ops_replicated(self, pg: PG, oid: str, ops: list[dict]):
+        cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
+        obj = GHObject(pg.pgid.pool, oid)
+        results: list[dict] = []
+        tx = StoreTx()
+        exists = self.store.exists(cid, obj)
+        size = self.store.stat(cid, obj)["size"] if exists else 0
+        version = 0
+        if exists:
+            try:
+                version = int(json.loads(
+                    self.store.getattr(cid, obj, VERSION_ATTR)
+                )["version"])
+            except (KeyError, ValueError):
+                version = 1
+        mutated = False
+        for op in ops:
+            kind = op["op"]
+            if kind == "write":
+                off = int(op.get("off", 0))
+                tx.write(cid, obj, off, op["data"])
+                size = max(size, off + len(op["data"]))
+                mutated = exists = True
+                results.append({})
+            elif kind == "writefull":
+                tx.remove(cid, obj).write(cid, obj, 0, op["data"])
+                size = len(op["data"])
+                mutated = exists = True
+                results.append({})
+            elif kind == "append":
+                tx.write(cid, obj, size, op["data"])
+                size += len(op["data"])
+                mutated = exists = True
+                results.append({})
+            elif kind == "truncate":
+                tx.truncate(cid, obj, int(op["size"]))
+                size = int(op["size"])
+                mutated = exists = True
+                results.append({})
+            elif kind == "create":
+                if not exists:
+                    tx.touch(cid, obj)
+                    mutated = exists = True
+                elif op.get("exclusive"):
+                    return EINVAL_RC, results, version
+                results.append({})
+            elif kind == "read":
+                if not exists:
+                    return ENOENT_RC, results, 0
+                data = self.store.read(cid, obj, int(op.get("off", 0)),
+                                       op.get("len"))
+                results.append({"data": data})
+            elif kind == "stat":
+                if not exists:
+                    return ENOENT_RC, results, 0
+                results.append({"size": size, "version": version})
+            elif kind == "remove":
+                if not exists:
+                    return ENOENT_RC, results, 0
+                tx.remove(cid, obj)
+                mutated = True
+                exists = False
+                results.append({})
+            elif kind == "setxattr":
+                tx.setattr(cid, obj, XATTR_PREFIX + op["name"],
+                           op["value"])
+                mutated = exists = True
+                results.append({})
+            elif kind == "getxattr":
+                try:
+                    raw = self.store.getattr(cid, obj,
+                                             XATTR_PREFIX + op["name"])
+                except KeyError:
+                    return ENOENT_RC, results, version
+                results.append({"value": raw})
+            elif kind == "getxattrs":
+                attrs = self.store.getattrs(cid, obj) if exists else {}
+                results.append({"attrs": {
+                    k[len(XATTR_PREFIX):]: v for k, v in attrs.items()
+                    if k.startswith(XATTR_PREFIX)
+                }})
+            elif kind == "rmxattr":
+                tx.rmattr(cid, obj, XATTR_PREFIX + op["name"])
+                mutated = True
+                results.append({})
+            elif kind == "omap_set":
+                tx.omap_setkeys(cid, obj, {
+                    str(k): bytes(v) for k, v in op["kv"].items()
+                })
+                mutated = exists = True
+                results.append({})
+            elif kind == "omap_get":
+                omap = self.store.omap_get(cid, obj) if exists else {}
+                keys = op.get("keys")
+                if keys is not None:
+                    omap = {k: omap[k] for k in keys if k in omap}
+                results.append({"kv": omap})
+            elif kind == "omap_rm":
+                tx.omap_rmkeys(cid, obj, [str(k) for k in op["keys"]])
+                mutated = True
+                results.append({})
+            else:
+                return EINVAL_RC, results, version
+        if mutated:
+            version += 1
+            if exists:
+                tx.setattr(cid, obj, VERSION_ATTR, json.dumps(
+                    {"size": size, "version": version}
+                ).encode())
+            rc = await self._submit_replicated(pg, tx)
+            if rc != OK:
+                return rc, results, version
+        return OK, results, version
+
+    async def _submit_replicated(self, pg: PG, tx: StoreTx) -> int:
+        """Primary-copy replication: local apply + MOSDRepOp to every
+        replica, ack once >= min_size copies committed
+        (ReplicatedBackend.cc:462; degraded writes allowed down to
+        min_size, recovery heals the rest)."""
+        await self.store.queue_transactions(tx)
+        wire = encode_tx(tx)
+        replicas = [osd for osd in set(pg.acting)
+                    if osd not in (self.osd_id, NO_OSD)]
+        results = await asyncio.gather(*(
+            self.send_sub_op(osd, "tx",
+                             cid=_enc_cid(CollectionId(pg.pgid.pool,
+                                                       pg.pgid.ps)),
+                             ops=wire)
+            for osd in replicas
+        ), return_exceptions=True)
+        committed = 1 + sum(
+            1 for r in results if not isinstance(r, BaseException)
+        )
+        if committed < min(pg.pool.min_size, len(pg.acting)):
+            log.derr("pg %s: only %d/%d copies committed",
+                     pg.pgid, committed, len(pg.acting))
+            return EIO_RC
+        return OK
+
+    # -- sub ops (shard/replica server side) -----------------------------------
+    async def send_sub_op(self, osd: int, kind: str, **args):
+        """Send one sub-op and await its reply (tid-correlated)."""
+        if self.osdmap is None or not self.osdmap.is_up(osd):
+            raise ShardReadError(f"osd.{osd} is down")
+        addr = self.osdmap.osds[osd].addr
+        self._sub_tid += 1
+        tid = self._sub_tid
+        fut = asyncio.get_running_loop().create_future()
+        self._sub_futures[tid] = fut
+        try:
+            await self.msgr.send_to(addr, Message("sub_op", {
+                "tid": tid, "kind": kind, "from": self.osd_id, **args,
+            }, priority=PRIO_HIGH), f"osd.{osd}")
+            reply = await asyncio.wait_for(fut, 10.0)
+        except (ConnectionError, asyncio.TimeoutError) as e:
+            self._sub_futures.pop(tid, None)
+            raise ShardReadError(f"sub_op {kind} to osd.{osd}: {e}") from e
+        rc = int(reply.get("rc", 0))
+        if rc == ENOENT_RC:
+            raise KeyError(args.get("oid", ""))
+        if rc != 0:
+            raise ShardReadError(f"sub_op {kind} on osd.{osd}: rc {rc}")
+        return reply.get("value")
+
+    async def _handle_sub_op(self, conn: Connection, d: dict) -> None:
+        tid = d.get("tid", 0)
+        try:
+            kind = d["kind"]
+            value = None
+            if kind == "tx":
+                await self.store.queue_transactions(
+                    decode_tx(list(d["ops"]))
+                )
+            else:
+                cid = _dec_cid(d["cid"])
+                oid = GHObject(cid.pool, str(d["oid"]), shard=cid.shard)
+                if kind == "write":
+                    tx = StoreTx().write(cid, oid, int(d["off"]),
+                                         d["data"])
+                    for name, val in d.get("attrs", {}).items():
+                        tx.setattr(cid, oid, name, val)
+                    await self.store.queue_transactions(tx)
+                elif kind == "read":
+                    value = self.store.read(cid, oid, int(d["off"]),
+                                            d.get("len"))
+                elif kind == "getattr":
+                    value = self.store.getattr(cid, oid, str(d["name"]))
+                elif kind == "getattrs":
+                    value = dict(self.store.getattrs(cid, oid))
+                elif kind == "remove":
+                    await self.store.queue_transactions(
+                        StoreTx().remove(cid, oid)
+                    )
+                elif kind == "stat":
+                    value = self.store.stat(cid, oid)
+                elif kind == "read_full":
+                    plain = GHObject(cid.pool, str(d["oid"]))
+                    value = {
+                        "data": self.store.read(cid, plain),
+                        "attrs": dict(self.store.getattrs(cid, plain)),
+                        "omap": dict(self.store.omap_get(cid, plain)),
+                    }
+                else:
+                    self._sub_reply(conn, tid, EINVAL_RC)
+                    return
+            self._sub_reply(conn, tid, OK, value)
+        except KeyError:
+            self._sub_reply(conn, tid, ENOENT_RC)
+        except Exception as e:               # noqa: BLE001
+            log.derr("%s: sub_op failed: %s", self.entity, e)
+            self._sub_reply(conn, tid, EIO_RC)
+
+    def _sub_reply(self, conn: Connection, tid: int, rc: int,
+                   value=None) -> None:
+        try:
+            conn.send_message(Message(
+                "sub_reply", {"tid": tid, "rc": rc, "value": value},
+                priority=PRIO_HIGH,
+            ))
+        except ConnectionError:
+            pass
+
+    def _send_osd(self, osd: int, msg: Message) -> None:
+        if self.osdmap is None or osd not in self.osdmap.osds:
+            return
+        addr = self.osdmap.osds[osd].addr
+
+        async def _send():
+            try:
+                await self.msgr.send_to(addr, msg, f"osd.{osd}")
+            except ConnectionError as e:
+                log.dout(10, "%s: send to osd.%d failed: %s",
+                         self.entity, osd, e)
+
+        asyncio.get_running_loop().create_task(_send())
+
+    # -- heartbeats ------------------------------------------------------------
+    async def _heartbeat_loop(self) -> None:
+        """Peer liveness (handle_osd_ping bookkeeping, OSD.cc:5236)."""
+        interval = self.conf["osd_heartbeat_interval"]
+        grace = self.conf["osd_heartbeat_grace"]
+        while not self._stopped:
+            try:
+                await asyncio.sleep(interval)
+            except asyncio.CancelledError:
+                return
+            if self.osdmap is None:
+                continue
+            now = time.monotonic()
+            for osd, info in self.osdmap.osds.items():
+                if osd == self.osd_id or not info.up:
+                    self._hb_last_rx.pop(osd, None)
+                    self._hb_first_tx.pop(osd, None)
+                    continue
+                self._send_osd(osd, Message(
+                    "osd_ping", {"from": self.osd_id, "ts": now},
+                    priority=PRIO_HIGH,
+                ))
+                last = self._hb_last_rx.get(osd)
+                if last is None:
+                    first = self._hb_first_tx.setdefault(osd, now)
+                    silence = now - first
+                else:
+                    silence = now - last
+                if silence > grace:
+                    self.monc.report_failure(osd, silence)

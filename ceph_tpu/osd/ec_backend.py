@@ -87,6 +87,9 @@ class LocalShard:
     async def stat_shard(self, oid):
         return self.store.stat(self.cid, self._oid(oid))
 
+    async def get_attrs(self, oid):
+        return self.store.getattrs(self.cid, self._oid(oid))
+
 
 class ShardReadError(IOError):
     pass
@@ -207,7 +210,8 @@ class ECBackend:
             if old_size > a_start:
                 keep_len = min(old_size, a_start + a_len) - a_start
                 existing = await self._read_logical(
-                    oid, a_start, keep_len, old_size
+                    oid, a_start, keep_len, old_size,
+                    meta.version if meta else None,
                 )
                 buf[:keep_len] = np.frombuffer(existing, np.uint8)
             buf[offset - a_start: end - a_start] = np.frombuffer(
@@ -221,14 +225,35 @@ class ECBackend:
             hattrs = await self._update_hinfo(
                 oid, shard_off, shard_bytes, old_size
             )
-            await asyncio.gather(*(
+            results = await asyncio.gather(*(
                 self.shards[i].write_shard(
                     oid, shard_off, shard_bytes[i].tobytes(),
                     {VERSION_ATTR: meta_attr, HINFO_ATTR: hattrs[i]},
                 )
                 for i in range(self.n)
-            ))
+            ), return_exceptions=True)
+            failed = [i for i, r in enumerate(results)
+                      if isinstance(r, BaseException)]
+            if len(failed) > self.m:
+                raise ShardReadError(
+                    f"write {oid}: {len(failed)} shards failed "
+                    f"({failed}), data unrecoverable beyond m={self.m}"
+                )
+            if failed:
+                # degraded write: reads are safe (stale shards fail the
+                # version check in _read_shard_range) but heal eagerly so
+                # redundancy is restored without waiting for re-peering
+                self._schedule_repair(oid, failed)
             return ECObjectMeta(new_size, new_version)
+
+    def _schedule_repair(self, oid: str, shards: list[int]) -> None:
+        async def repair():
+            try:
+                await self.recover_shard(oid, shards)
+            except (ShardReadError, IOError, KeyError):
+                pass        # shard still down; peering recovery will heal
+
+        asyncio.get_running_loop().create_task(repair())
 
     async def _update_hinfo(self, oid: str, shard_off: int,
                             shard_bytes: list[np.ndarray],
@@ -259,14 +284,29 @@ class ECBackend:
     # -- read ------------------------------------------------------------
     async def _read_shard_range(self, shard: int, oid: str, off: int,
                                 length: int,
-                                shard_size: int | None = None) -> np.ndarray:
+                                shard_size: int | None = None,
+                                version: int | None = None) -> np.ndarray:
         """Read [off, off+length) of a shard. A read shorter than the
         region the shard is KNOWN to hold (from object metadata) is a
         shard failure — truncation must trigger reconstruction, not
-        zero-padded client data (the crc-verify role of handle_sub_read,
+        zero-padded client data. When ``version`` is given, the shard's
+        stored object version must match: a shard that missed a degraded
+        write holds full-length but STALE bytes, and must be treated as
+        failed, not served (the crc/hinfo-verify role of handle_sub_read,
         reference ECBackend.cc:1010)."""
         try:
+            if version is not None:
+                raw_meta = await self.shards[shard].get_attr(
+                    oid, VERSION_ATTR
+                )
+                if int(json.loads(raw_meta)["version"]) != version:
+                    raise ShardReadError(
+                        f"shard {shard}: stale version "
+                        f"(want {version})"
+                    )
             raw = await self.shards[shard].read_shard(oid, off, length)
+        except ShardReadError:
+            raise
         except Exception as e:
             raise ShardReadError(f"shard {shard}: {e}") from e
         expected = length if shard_size is None else max(
@@ -282,7 +322,8 @@ class ECBackend:
         return np.frombuffer(raw, np.uint8)
 
     async def _read_logical(self, oid: str, offset: int, length: int,
-                            obj_size: int) -> bytes:
+                            obj_size: int,
+                            version: int | None = None) -> bytes:
         """Read stripe-aligned logical range, reconstructing if needed."""
         if offset % self.sinfo.stripe_width:
             raise ValueError("offset must be stripe aligned")
@@ -293,13 +334,14 @@ class ECBackend:
 
         want = list(range(self.k))
         results = await asyncio.gather(*(
-            self._read_shard_range(i, oid, coff, clen, ssize) for i in want
+            self._read_shard_range(i, oid, coff, clen, ssize, version)
+            for i in want
         ), return_exceptions=True)
         missing = [i for i, r in enumerate(results)
                    if isinstance(r, BaseException)]
         if missing:
             chunks = await self._reconstruct(
-                oid, coff, clen, missing, results, ssize
+                oid, coff, clen, missing, results, ssize, version
             )
         else:
             chunks = {i: results[i] for i in want}
@@ -313,6 +355,7 @@ class ECBackend:
     async def _reconstruct(
         self, oid: str, coff: int, clen: int,
         missing: Sequence[int], partial, shard_size: int | None = None,
+        version: int | None = None,
     ) -> dict[int, np.ndarray]:
         """minimum_to_decode-driven repair read + batched decode."""
         have = {
@@ -337,7 +380,8 @@ class ECBackend:
             if not extra:
                 break
             fetched = await asyncio.gather(*(
-                self._read_shard_range(s, oid, coff, clen, shard_size)
+                self._read_shard_range(s, oid, coff, clen, shard_size,
+                                       version)
                 for s in extra
             ), return_exceptions=True)
             newly_dead = False
@@ -376,36 +420,99 @@ class ECBackend:
         a_start, a_len = self.sinfo.offset_len_to_stripe_bounds(
             offset, length
         )
-        data = await self._read_logical(oid, a_start, a_len, meta.size)
+        data = await self._read_logical(oid, a_start, a_len, meta.size,
+                                        meta.version)
         rel = offset - a_start
         return data[rel: rel + length]
 
+    # -- object metadata ops (fan-out; metadata is replicated per shard) --
+    async def remove(self, oid: str) -> None:
+        """Remove every shard object. A shard that lacks it is fine; IO
+        failures beyond m mean the removal did not take and must raise
+        (a silently-surviving shard would resurrect the object)."""
+        async def rm(i: int):
+            try:
+                await self.shards[i].remove_shard(oid)
+            except KeyError:
+                pass                # already absent on this shard
+        results = await asyncio.gather(
+            *(rm(i) for i in range(self.n)), return_exceptions=True
+        )
+        failed = [i for i, r in enumerate(results)
+                  if isinstance(r, BaseException)]
+        if len(failed) > self.m:
+            raise ShardReadError(
+                f"remove {oid}: {len(failed)} shards failed ({failed})"
+            )
+
+    async def set_attr(self, oid: str, name: str, value: bytes) -> None:
+        """Set one attr on all shards (zero-length data write carries it);
+        tolerates up to m dead shards like a degraded data write."""
+        results = await asyncio.gather(*(
+            self.shards[i].write_shard(oid, 0, b"", {name: bytes(value)})
+            for i in range(self.n)
+        ), return_exceptions=True)
+        failed = [i for i, r in enumerate(results)
+                  if isinstance(r, BaseException)]
+        if len(failed) > self.m:
+            raise ShardReadError(
+                f"set_attr {oid}: {len(failed)} shards failed ({failed})"
+            )
+
+    async def get_attrs(self, oid: str) -> dict[str, bytes]:
+        """All attrs from the first shard that answers; a shard missing
+        the object does NOT conclude absence (it may have missed a
+        degraded write) — keep trying, like _get_attr_any."""
+        errors = []
+        absent = False
+        for i in range(self.n):
+            try:
+                shard = self.shards[i]
+                getattrs = getattr(shard, "get_attrs", None)
+                if getattrs is not None:
+                    return dict(await getattrs(oid))
+            except KeyError:
+                absent = True
+            except Exception as e:             # noqa: BLE001
+                errors.append((i, e))
+        if absent:
+            return {}
+        raise ShardReadError(f"get_attrs {oid}: {errors}")
+
     # -- recovery --------------------------------------------------------
     async def recover_shard(self, oid: str, lost: Sequence[int]) -> None:
-        """Rebuild lost shard objects from survivors (RecoveryOp)."""
+        """Rebuild lost shard objects from survivors (RecoveryOp).
+        Source shards are version-verified so a stale survivor (missed
+        degraded write) counts as lost, not as a rebuild source."""
+        meta = await self._read_meta(oid)
+        if meta is None:
+            raise KeyError(f"no such object {oid}")
+        shard_len = self.sinfo.logical_to_next_chunk_offset(meta.size)
         lost = list(lost)
-        avail = [i for i in range(self.n) if i not in lost]
-        need = self.ec.minimum_to_decode(lost, avail)
-        sizes = await asyncio.gather(*(
-            self.shards[s].stat_shard(oid) for s in need
-        ))
-        shard_len = max(s["size"] for s in sizes)
-        reads = await asyncio.gather(*(
-            self._read_shard_range(s, oid, 0, shard_len, shard_len)
-            for s in need
-        ))
+        while True:
+            avail = [i for i in range(self.n) if i not in lost]
+            need = self.ec.minimum_to_decode(lost, avail)
+            reads = await asyncio.gather(*(
+                self._read_shard_range(s, oid, 0, shard_len, shard_len,
+                                       meta.version)
+                for s in need
+            ), return_exceptions=True)
+            newly_lost = [
+                s for s, r in zip(need, reads)
+                if isinstance(r, BaseException)
+            ]
+            if not newly_lost:
+                break
+            lost.extend(newly_lost)
         nstripes = shard_len // self.sinfo.chunk_size
         batched = {
             s: arr.reshape(nstripes, self.sinfo.chunk_size)
             for s, arr in zip(need, reads)
         }
         out = self.ec.decode_chunks_batch(batched, lost)
-        meta_raw = await self.shards[next(iter(need))].get_attr(
-            oid, VERSION_ATTR
-        )
-        hinfo_raw = await self.shards[next(iter(need))].get_attr(
-            oid, HINFO_ATTR
-        )
+        good = next(iter(need))
+        meta_raw = await self.shards[good].get_attr(oid, VERSION_ATTR)
+        hinfo_raw = await self.shards[good].get_attr(oid, HINFO_ATTR)
         await asyncio.gather(*(
             self.shards[s].write_shard(
                 oid, 0, np.ascontiguousarray(out[s]).tobytes(),
@@ -437,6 +544,14 @@ class ECBackend:
             stored = reads[i].reshape(nstripes, self.sinfo.chunk_size)
             if not np.array_equal(recomputed[:, i], stored):
                 inconsistent.append(i)
+        stale = []
+        for i in range(self.n):
+            try:
+                raw_meta = await self.shards[i].get_attr(oid, VERSION_ATTR)
+                if int(json.loads(raw_meta)["version"]) != meta.version:
+                    stale.append(i)
+            except Exception:                  # noqa: BLE001
+                stale.append(i)
         crc_mismatch = []
         raw = await self._get_attr_any(oid, HINFO_ATTR) or b""
         if raw:  # empty blob == hinfo invalidated by overwrite
@@ -450,5 +565,6 @@ class ECBackend:
             "object": oid,
             "parity_inconsistent": inconsistent,
             "crc_mismatch": crc_mismatch,
-            "clean": not inconsistent and not crc_mismatch,
+            "stale_version": stale,
+            "clean": not inconsistent and not crc_mismatch and not stale,
         }

@@ -143,6 +143,9 @@ class OSDMap:
         self.pg_temp: dict[tuple[int, int], list[int]] = {}
         self.primary_temp: dict[tuple[int, int], int] = {}
         self.ec_profiles: dict[str, dict] = {}
+        # never reused, even after pool deletion: a recycled id would
+        # alias a dead pool's surviving shard objects into a new pool
+        self.max_pool_id = 0
 
     # -- mutation via incrementals --------------------------------------
     def apply_incremental(self, inc: Incremental) -> None:
@@ -162,6 +165,7 @@ class OSDMap:
             info.in_cluster = w > 0
         for pool in inc.new_pools:
             self.pools[pool.pool_id] = pool
+            self.max_pool_id = max(self.max_pool_id, pool.pool_id)
         for pid in inc.removed_pools:
             self.pools.pop(pid, None)
             self.pg_temp = {
@@ -257,6 +261,7 @@ class OSDMap:
                 for (pid, ps), o in self.primary_temp.items()
             },
             "ec_profiles": {n: dict(p) for n, p in self.ec_profiles.items()},
+            "max_pool_id": self.max_pool_id,
             "crush": self.crush.to_dict(),
         }
 
@@ -282,4 +287,7 @@ class OSDMap:
         m.ec_profiles = {
             n: dict(p) for n, p in d.get("ec_profiles", {}).items()
         }
+        m.max_pool_id = max(
+            int(d.get("max_pool_id", 0)), max(m.pools, default=0)
+        )
         return m

@@ -1,0 +1,150 @@
+"""Placement groups: per-PG state, peering, and recovery planning.
+
+The role of reference src/osd/PG.{h,cc} + PeeringState.{h,cc}: each PG
+tracks its interval (epoch + acting/up sets), runs peering on the primary
+(Initial -> Peering -> Active, the boost::statechart machine of
+PeeringState.h:556 collapsed to explicit async states), and computes what
+needs recovery. Instead of the pg_log/missing-set machinery (PGLog.h), the
+authoritative state is a per-object version inventory gathered from every
+acting shard during peering — the same outcome (per-peer missing sets)
+computed from object metadata rather than replicated op logs.
+
+Object -> PG mapping: ``ps = ceph_str_hash_rjenkins(name) % pg_num``
+(reference pg_pool_t::hash / ceph_str_hash, src/common/ceph_hash.cc).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from ceph_tpu.common.log import Dout
+from ceph_tpu.placement.hashing import ceph_str_hash_rjenkins
+from ceph_tpu.osd.osd_map import NO_OSD, PoolInfo
+
+log = Dout("peering")
+
+
+def object_to_ps(name: str, pg_num: int) -> int:
+    return ceph_str_hash_rjenkins(name) % pg_num
+
+
+@dataclass(frozen=True)
+class PGId:
+    pool: int
+    ps: int
+
+    def __str__(self) -> str:
+        return f"{self.pool}.{self.ps:x}"
+
+
+# PG states (subset of the reference's state names)
+STATE_INITIAL = "initial"
+STATE_PEERING = "peering"
+STATE_ACTIVE = "active"
+STATE_RECOVERING = "active+recovering"
+STATE_REPLICA = "replica"
+
+
+@dataclass
+class PeerInfo:
+    """One shard's inventory reply (the MOSDPGNotify info analog)."""
+    shard: int
+    osd: int
+    objects: dict[str, int] = field(default_factory=dict)  # name -> version
+
+
+class PG:
+    def __init__(self, pgid: PGId, pool: PoolInfo, whoami: int):
+        self.pgid = pgid
+        self.pool = pool
+        self.whoami = whoami
+        self.state = STATE_INITIAL
+        self.epoch = 0                  # interval start epoch
+        self.acting: list[int] = []
+        self.up: list[int] = []
+        self.primary = NO_OSD
+        self.waiting_for_active: list = []   # queued client ops
+        self.peer_infos: dict[int, PeerInfo] = {}   # shard -> info
+        self.missing: dict[int, list[str]] = {}     # shard -> stale oids
+        self.peering_task: asyncio.Task | None = None
+        self.backend = None             # set by the daemon per interval
+
+    # -- interval handling -------------------------------------------------
+    @property
+    def is_primary(self) -> bool:
+        return self.primary == self.whoami
+
+    @property
+    def is_ec(self) -> bool:
+        return self.pool.pool_type == "erasure"
+
+    def acting_shard_of(self, osd: int) -> int:
+        """Shard index this osd holds (EC: positional; replicated: rank)."""
+        return self.acting.index(osd)
+
+    def same_interval(self, acting: list[int], up: list[int],
+                      primary: int) -> bool:
+        return (acting == self.acting and up == self.up
+                and primary == self.primary)
+
+    def start_interval(self, epoch: int, acting: list[int], up: list[int],
+                       primary: int) -> None:
+        """New interval (PeeringState::start_peering_interval,
+        reference PeeringState.cc:547): reset peering state."""
+        self.epoch = epoch
+        self.acting = list(acting)
+        self.up = list(up)
+        self.primary = primary
+        self.peer_infos = {}
+        self.missing = {}
+        if self.peering_task is not None:
+            self.peering_task.cancel()
+            self.peering_task = None
+        self.state = (STATE_PEERING if self.is_primary else STATE_REPLICA)
+        log.dout(10, "pg %s interval e%d acting %s primary %d role %s",
+                 self.pgid, epoch, acting, primary,
+                 "primary" if self.is_primary else "replica")
+
+    # -- peering bookkeeping (primary) -------------------------------------
+    def acting_peers(self) -> list[tuple[int, int]]:
+        """(shard, osd) pairs for every live acting member but us."""
+        return [
+            (shard, osd) for shard, osd in enumerate(self.acting)
+            if osd != NO_OSD and osd != self.whoami
+        ]
+
+    def record_info(self, info: PeerInfo) -> None:
+        self.peer_infos[info.shard] = info
+
+    def all_infos_in(self) -> bool:
+        want = {shard for shard, _ in self.acting_peers()}
+        return want <= set(self.peer_infos)
+
+    def authoritative_versions(self) -> dict[str, int]:
+        """Per-object max version across all acting shards (the
+        authoritative-log choice of PeeringState collapsed to versions)."""
+        auth: dict[str, int] = {}
+        for info in self.peer_infos.values():
+            for name, version in info.objects.items():
+                if version > auth.get(name, 0):
+                    auth[name] = version
+        return auth
+
+    def compute_missing(self, auth: dict[str, int]) -> dict[int, list[str]]:
+        """shard -> objects that shard lacks or holds stale (the missing
+        sets driving recovery, PeeringState/MissingLoc role)."""
+        missing: dict[int, list[str]] = {}
+        for shard, osd in enumerate(self.acting):
+            if osd == NO_OSD:
+                continue
+            have = self.peer_infos[shard].objects \
+                if shard in self.peer_infos else {}
+            stale = [
+                name for name, version in auth.items()
+                if have.get(name, 0) < version
+            ]
+            if stale:
+                missing[shard] = sorted(stale)
+        self.missing = missing
+        return missing

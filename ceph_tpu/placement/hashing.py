@@ -98,6 +98,54 @@ def crush_hash32_4(a, b, c, d):
     return hash_
 
 
+def ceph_str_hash_rjenkins(s: str | bytes) -> int:
+    """Object-name hash (reference src/common/ceph_hash.cc
+    ceph_str_hash_rjenkins): maps an object name to its placement seed
+    ``ps = hash % pg_num`` (pg_pool_t::hash semantics)."""
+    k = s.encode() if isinstance(s, str) else bytes(s)
+    length = len(k)
+    a = np.uint32(0x9E3779B9)
+    b = np.uint32(0x9E3779B9)
+    c = np.uint32(0)
+    pos = 0
+    rem = length
+    with np.errstate(over="ignore"):
+        while rem >= 12:
+            a = a + np.uint32(int.from_bytes(k[pos:pos + 4], "little"))
+            b = b + np.uint32(int.from_bytes(k[pos + 4:pos + 8], "little"))
+            c = c + np.uint32(int.from_bytes(k[pos + 8:pos + 12], "little"))
+            a, b, c = _mix(a, b, c)
+            pos += 12
+            rem -= 12
+        c = c + np.uint32(length)
+        # trailing bytes; c's low byte is reserved for the length
+        t = k[pos:]
+        if rem >= 11:
+            c = c + (np.uint32(t[10]) << np.uint32(24))
+        if rem >= 10:
+            c = c + (np.uint32(t[9]) << np.uint32(16))
+        if rem >= 9:
+            c = c + (np.uint32(t[8]) << np.uint32(8))
+        if rem >= 8:
+            b = b + (np.uint32(t[7]) << np.uint32(24))
+        if rem >= 7:
+            b = b + (np.uint32(t[6]) << np.uint32(16))
+        if rem >= 6:
+            b = b + (np.uint32(t[5]) << np.uint32(8))
+        if rem >= 5:
+            b = b + np.uint32(t[4])
+        if rem >= 4:
+            a = a + (np.uint32(t[3]) << np.uint32(24))
+        if rem >= 3:
+            a = a + (np.uint32(t[2]) << np.uint32(16))
+        if rem >= 2:
+            a = a + (np.uint32(t[1]) << np.uint32(8))
+        if rem >= 1:
+            a = a + np.uint32(t[0])
+        a, b, c = _mix(a, b, c)
+    return int(c)
+
+
 def crush_hash32_5(a, b, c, d, e):
     a, b, c, d, e = _u32(a), _u32(b), _u32(c), _u32(d), _u32(e)
     hash_ = CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e

@@ -179,3 +179,108 @@ def test_concurrent_writes_serialized(backend):
     _run(hammer())
     got = _run(backend.read("o"))
     assert got == b"".join(bytes([i]) * 512 for i in range(8))
+
+
+class FailingShard:
+    """Wraps a LocalShard; writes fail while .down is True."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.down = False
+
+    async def write_shard(self, *a, **kw):
+        if self.down:
+            raise ShardReadError("injected shard write failure")
+        return await self.inner.write_shard(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _make_failing_backend():
+    registry = ErasureCodePluginRegistry()
+    codec = registry.factory(
+        "jax_rs", {"k": str(K), "m": str(M), "technique": "cauchy_good"}
+    )
+    stores, shards = {}, {}
+    for i in range(K + M):
+        store = MemStore()
+        cid = CollectionId(1, 0, shard=i)
+        _run(store.queue_transactions(
+            Transaction().create_collection(cid)
+        ))
+        stores[i] = (store, cid)
+        shards[i] = FailingShard(LocalShard(store, cid, pool=1, shard=i))
+    be = ECBackend(codec, shards, stripe_unit=128)
+    be._test_stores = stores
+    be._test_shards = shards
+    return be
+
+
+def test_degraded_write_stale_shard_not_served():
+    """Regression: a shard that missed a degraded overwrite holds full-
+    length but STALE bytes; the read path must version-check it and
+    reconstruct instead of silently merging old data."""
+    be = _make_failing_backend()
+
+    async def run():
+        v1 = _payload(4096, 10)
+        v2 = _payload(4096, 11)
+        await be.write("o", v1)
+        be._test_shards[1].down = True      # data shard 1 misses the write
+        meta = await be.write("o", v2)      # degraded write succeeds
+        assert meta.version == 2
+        # eager repair was scheduled but cannot fix shard 1 while down;
+        # wait for it to give up
+        await asyncio.sleep(0.05)
+        assert await be.read("o") == v2     # NOT a v1/v2 mix
+        # shard comes back (stale): still must not be served
+        be._test_shards[1].down = False
+        assert await be.read("o") == v2
+        # scrub flags the stale shard
+        report = await be.scrub("o")
+        assert 1 in report["stale_version"] and not report["clean"]
+        # recovery heals it and scrub goes clean
+        await be.recover_shard("o", [1])
+        report = await be.scrub("o")
+        assert report["clean"], report
+    _run(run())
+
+
+def test_degraded_write_eager_repair_heals_transient_failure():
+    be = _make_failing_backend()
+
+    async def run():
+        v1 = _payload(2048, 12)
+        await be.write("o", v1)
+        be._test_shards[2].down = True
+        v2 = _payload(2048, 13)
+        await be.write("o", v2)
+        be._test_shards[2].down = False     # shard back before repair task
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            report = await be.scrub("o")
+            if report["clean"]:
+                break
+        assert report["clean"], report
+        assert await be.read("o") == v2
+    _run(run())
+
+
+def test_remove_raises_when_shards_unreachable():
+    be = _make_failing_backend()
+
+    async def run():
+        await be.write("o", _payload(512, 14))
+
+        class DeadRemove:
+            def __getattr__(self, name):
+                async def fail(*a, **kw):
+                    raise ShardReadError("down")
+                return fail
+
+        for i in range(K + M):
+            be.shards[i] = DeadRemove()
+        with pytest.raises(ShardReadError):
+            await be.remove("o")
+    _run(run())

@@ -336,3 +336,81 @@ def test_osd_boot_and_failure_reports():
             await mc.shutdown()
         await mon.shutdown()
     asyncio.run(run())
+
+
+def test_mon_internal_messages_require_signature():
+    """Regression: with auth_shared_key set, paxos/election/forward
+    messages that merely claim a mon entity name must be rejected."""
+    async def run():
+        key_conf = lambda: fast_conf(auth_shared_key="k3y")  # noqa: E731
+        (mon,) = await start_mons(["a", "b"][:1], conf_factory=key_conf)
+        await wait_quorum([mon])
+        lc_before = mon.paxos.last_committed
+        # impersonate "mon.b" at the messenger level (not in monmap -> and
+        # also with a forged monmap name, no valid signature either way)
+        from ceph_tpu.msg import Message, Messenger
+        from ceph_tpu.mon.store import StoreTransaction
+        evil = Messenger("mon.a")    # claims the real mon's name
+
+        class D:
+            async def ms_dispatch(self, conn, msg):
+                pass
+
+            def ms_handle_reset(self, conn):
+                pass
+
+            def ms_handle_connect(self, conn):
+                pass
+
+        evil.set_dispatcher(D())
+        await evil.bind("local://evil")
+        tx = StoreTransaction().put("config", "injected", b"1")
+        await evil.send_to(mon.monmap["a"], Message("paxos_commit", {
+            "from": "a", "v": lc_before + 1, "value": tx.encode(),
+        }), "mon.a")
+        await asyncio.sleep(0.3)
+        assert mon.store.get("config", "injected") is None
+        assert mon.paxos.last_committed == lc_before
+        await evil.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
+
+
+def test_signed_mon_cluster_still_works():
+    async def run():
+        key_conf = lambda: fast_conf(auth_shared_key="k3y")  # noqa: E731
+        mons = await start_mons(["a", "b", "c"], conf_factory=key_conf)
+        leader = await wait_quorum(mons)
+        client = MonClient("client.1", mons[0].monmap,
+                           fast_conf(auth_shared_key="k3y"))
+        await client.start()
+        r = await client.command("osd pool create", pool="signed")
+        assert r["rc"] == 0, r
+        await wait_epoch(mons, leader.osd_monitor.osdmap.epoch)
+        for m in mons:
+            assert any(p.name == "signed"
+                       for p in m.osd_monitor.osdmap.pools.values())
+        await client.shutdown()
+        for m in mons:
+            await m.shutdown()
+    asyncio.run(run())
+
+
+def test_pool_ids_never_reused():
+    """Regression: a deleted pool's id must not be recycled (stale shard
+    objects would alias into the new pool)."""
+    async def run():
+        (mon,) = await start_mons(["a"])
+        await wait_quorum([mon])
+        client = MonClient("client.1", mon.monmap, fast_conf())
+        await client.start()
+        r1 = await client.command("osd pool create", pool="p1")
+        r2 = await client.command("osd pool create", pool="p2")
+        id2 = r2["data"]["pool_id"]
+        r = await client.command("osd pool delete", pool="p2")
+        assert r["rc"] == 0
+        r3 = await client.command("osd pool create", pool="p3")
+        assert r3["data"]["pool_id"] > id2, (r1, r2, r3)
+        await client.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
