@@ -1,0 +1,15 @@
+"""Client stack: Objecter + librados-shaped API + striper.
+
+The reference's client layers (src/osdc/Objecter.{h,cc} op engine;
+src/librados C/C++ API; src/libradosstriper) as asyncio-native Python:
+clients compute placement themselves from the osdmap (CRUSH is
+client-side — no metadata server in the data path), submit ops to the
+primary OSD, resend on map change, and keep watch registrations alive
+across intervals.
+"""
+
+from ceph_tpu.client.objecter import Objecter
+from ceph_tpu.client.rados import IoCtx, ObjectOperation, Rados
+from ceph_tpu.client.striper import RadosStriper
+
+__all__ = ["IoCtx", "ObjectOperation", "Objecter", "Rados", "RadosStriper"]

@@ -1,0 +1,239 @@
+"""Objecter: the client-side op engine.
+
+Reference src/osdc/Objecter.{h,cc}: computes the target from the osdmap
+(_calc_target :2759 — CRUSH runs HERE, on the client), submits to the
+primary OSD (_op_submit :2369), tracks inflight ops and resends on map
+change or connection reset, and maintains linger (watch) registrations
+that re-arm whenever the target moves (linger_submit / _linger_ops).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable
+
+from ceph_tpu.common.log import Dout
+from ceph_tpu.msg.message import Message
+from ceph_tpu.msg.messenger import Connection, Messenger
+from ceph_tpu.osd.daemon import MISDIRECTED_RC
+from ceph_tpu.osd.pg import object_to_ps
+
+log = Dout("objecter")
+
+EAGAIN_RC = -11
+
+
+class ObjecterError(IOError):
+    pass
+
+
+class LingerOp:
+    """A persistent watch registration (reference LingerOp)."""
+
+    def __init__(self, linger_id: int, pool_id: int, oid: str, cookie: int,
+                 callback: Callable[[bytes], Awaitable[bytes | None]]):
+        self.linger_id = linger_id
+        self.pool_id = pool_id
+        self.oid = oid
+        self.cookie = cookie
+        self.callback = callback
+        self.registered_osd: int | None = None
+
+
+class Objecter:
+    def __init__(self, monc, msgr: Messenger):
+        self.monc = monc
+        self.msgr = msgr
+        self._tid = 0
+        # tid -> (future, osd)
+        self._inflight: dict[int, tuple[asyncio.Future, int]] = {}
+        self._lingers: dict[int, LingerOp] = {}
+        self._next_linger = 0
+        self._stopped = False
+
+    # -- dispatch hooks (driven by the owning client) ---------------------
+    async def handle_message(self, conn: Connection, msg: Message) -> bool:
+        """Returns True when the message was ours."""
+        if msg.type == "osd_op_reply":
+            fut_osd = self._inflight.pop(int(msg.data.get("tid", 0)), None)
+            if fut_osd is not None and not fut_osd[0].done():
+                fut_osd[0].set_result(msg.data)
+            return True
+        if msg.type == "watch_notify":
+            asyncio.get_running_loop().create_task(
+                self._handle_watch_notify(conn, msg.data)
+            )
+            return True
+        return False
+
+    def handle_reset(self, conn: Connection) -> None:
+        """An OSD session died: fail its inflight ops (the callers'
+        retry loops resubmit) and re-arm lingers bound to it."""
+        for tid, (fut, osd) in list(self._inflight.items()):
+            if f"osd.{osd}" == conn.peer_name and not fut.done():
+                del self._inflight[tid]
+                fut.set_exception(ObjecterError("osd session reset"))
+        for linger in self._lingers.values():
+            if (linger.registered_osd is not None
+                    and f"osd.{linger.registered_osd}" == conn.peer_name):
+                linger.registered_osd = None
+                asyncio.get_running_loop().create_task(
+                    self._rearm_linger(linger)
+                )
+
+    async def on_map_change(self, osdmap) -> None:
+        """Re-target lingers whose primary moved (_scan_requests role)."""
+        for linger in self._lingers.values():
+            target = self._target_for(linger.pool_id, linger.oid)
+            if target is not None and target != linger.registered_osd:
+                await self._rearm_linger(linger)
+
+    # -- targeting --------------------------------------------------------
+    def _target_for(self, pool_id: int, oid: str) -> int | None:
+        m = self.monc.osdmap
+        if m is None:
+            return None
+        pool = m.pools.get(pool_id)
+        if pool is None:
+            return None
+        ps = object_to_ps(oid, pool.pg_num)
+        _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+        return primary if primary >= 0 else None
+
+    # -- submission -------------------------------------------------------
+    async def op_submit(self, pool_id: int, oid: str, ops: list[dict],
+                        timeout: float = 30.0) -> dict:
+        """Submit one op batch; retries across map changes, misdirected
+        replies, and session resets until ``timeout``."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while True:
+            if self._stopped:
+                raise ObjecterError("objecter stopped")
+            m = self.monc.osdmap
+            pool = m.pools.get(pool_id) if m is not None else None
+            if pool is None:
+                raise ObjecterError(f"no pool {pool_id}")
+            ps = object_to_ps(oid, pool.pg_num)
+            _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+            if primary < 0:
+                await self._await_newer_map(m.epoch, deadline)
+                continue
+            self._tid += 1
+            tid = self._tid
+            fut = loop.create_future()
+            self._inflight[tid] = (fut, primary)
+            try:
+                await self.msgr.send_to(
+                    m.osds[primary].addr,
+                    Message("osd_op", {
+                        "tid": tid, "pool": pool_id, "ps": ps, "oid": oid,
+                        "epoch": m.epoch, "ops": ops,
+                    }), f"osd.{primary}",
+                )
+                reply = await asyncio.wait_for(
+                    fut, max(0.05, deadline - loop.time())
+                )
+            except (ConnectionError, ObjecterError):
+                self._inflight.pop(tid, None)
+                if loop.time() > deadline:
+                    raise ObjecterError(
+                        f"op on {oid} timed out (osd.{primary} unreachable)"
+                    ) from None
+                await asyncio.sleep(0.1)
+                continue
+            except asyncio.TimeoutError:
+                self._inflight.pop(tid, None)
+                raise ObjecterError(f"op on {oid} timed out") from None
+            if reply["rc"] == MISDIRECTED_RC:
+                await self._await_newer_map(
+                    max(m.epoch, int(reply.get("epoch", 0))) , deadline,
+                    strict=False,
+                )
+                continue
+            return reply
+
+    async def _await_newer_map(self, epoch: int, deadline: float,
+                               strict: bool = True) -> None:
+        loop = asyncio.get_running_loop()
+        if loop.time() > deadline:
+            raise ObjecterError("timed out waiting for a usable osdmap")
+        try:
+            await self.monc.wait_for_map(
+                epoch + 1, timeout=min(1.0, max(0.05,
+                                                deadline - loop.time()))
+            )
+        except asyncio.TimeoutError:
+            if strict:
+                pass        # keep retrying until the op deadline
+        await asyncio.sleep(0.02)
+
+    # -- watch / notify ---------------------------------------------------
+    async def linger_watch(
+        self, pool_id: int, oid: str,
+        callback: Callable[[bytes], Awaitable[bytes | None]],
+    ) -> LingerOp:
+        self._next_linger += 1
+        linger = LingerOp(self._next_linger, pool_id, oid,
+                          cookie=self._next_linger, callback=callback)
+        self._lingers[linger.linger_id] = linger
+        reply = await self.op_submit(pool_id, oid, [
+            {"op": "watch", "cookie": linger.cookie},
+        ])
+        if reply["rc"] != 0:
+            del self._lingers[linger.linger_id]
+            raise ObjecterError(f"watch failed: rc {reply['rc']}")
+        linger.registered_osd = self._target_for(pool_id, oid)
+        return linger
+
+    async def linger_cancel(self, linger: LingerOp) -> None:
+        self._lingers.pop(linger.linger_id, None)
+        try:
+            await self.op_submit(linger.pool_id, linger.oid, [
+                {"op": "unwatch", "cookie": linger.cookie},
+            ], timeout=5.0)
+        except ObjecterError:
+            pass
+
+    async def _rearm_linger(self, linger: LingerOp) -> None:
+        if linger.linger_id not in self._lingers or self._stopped:
+            return
+        try:
+            reply = await self.op_submit(linger.pool_id, linger.oid, [
+                {"op": "watch", "cookie": linger.cookie},
+            ], timeout=10.0)
+            if reply["rc"] == 0:
+                linger.registered_osd = self._target_for(
+                    linger.pool_id, linger.oid
+                )
+        except ObjecterError as e:
+            log.dout(5, "linger re-arm for %s failed: %s", linger.oid, e)
+
+    async def _handle_watch_notify(self, conn: Connection,
+                                   data: dict) -> None:
+        cookie = int(data["cookie"])
+        linger = next(
+            (lg for lg in self._lingers.values() if lg.cookie == cookie),
+            None,
+        )
+        reply = b""
+        if linger is not None:
+            try:
+                out = await linger.callback(bytes(data.get("payload", b"")))
+                reply = out if isinstance(out, (bytes, bytearray)) else b""
+            except Exception:                  # noqa: BLE001
+                log.derr("watch callback for %s raised", data.get("oid"))
+        try:
+            conn.send_message(Message("notify_ack", {
+                "notify_id": data["notify_id"], "cookie": cookie,
+                "reply": bytes(reply),
+            }))
+        except ConnectionError:
+            pass
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        for tid, (fut, _) in self._inflight.items():
+            if not fut.done():
+                fut.set_exception(ObjecterError("shutdown"))
+        self._inflight.clear()

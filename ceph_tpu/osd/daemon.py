@@ -191,6 +191,13 @@ class OSDDaemon:
         self._booted = False
         self._reboot_epoch = 0
         self._map_lock = asyncio.Lock()
+        # watch/notify state:
+        #   (pool, ps, oid) -> {(client entity, cookie): conn}
+        self._watchers: dict[
+            tuple, dict[tuple[str, int], Connection]
+        ] = {}
+        self._notify_id = 0
+        self._notify_waiters: dict[tuple, asyncio.Future] = {}
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, timeout: float = 20.0) -> None:
@@ -223,6 +230,20 @@ class OSDDaemon:
 
     def ms_handle_reset(self, conn: Connection) -> None:
         self.monc.ms_handle_reset(conn)
+        # a dead client takes its watches with it (watch timeout role)
+        for key, watchers in list(self._watchers.items()):
+            for wid, wconn in list(watchers.items()):
+                if wconn is conn:
+                    del watchers[wid]
+            if not watchers:
+                del self._watchers[key]
+        # ...and in-flight notifies must not wait out the timeout for a
+        # watcher that is known dead (PrimaryLogPG completes on reset)
+        for (nid, entity, cookie), fut in list(
+            self._notify_waiters.items()
+        ):
+            if entity == conn.peer_name and not fut.done():
+                fut.set_exception(ConnectionError("watcher gone"))
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         t = msg.type
@@ -247,6 +268,15 @@ class OSDDaemon:
             self._handle_pg_notify(msg.data)
         elif t == "pg_activate":
             self._handle_pg_activate(msg.data)
+        elif t == "notify_ack":
+            # entity taken from the connection, not the message: an ack
+            # can only satisfy the sender's own watch
+            fut = self._notify_waiters.pop(
+                (int(msg.data["notify_id"]), conn.peer_name,
+                 int(msg.data["cookie"])), None
+            )
+            if fut is not None and not fut.done():
+                fut.set_result(bytes(msg.data.get("reply", b"")))
         elif t == "osd_ping":
             conn.send_message(Message(
                 "osd_ping_reply", {"from": self.osd_id, "ts": msg.data["ts"]},
@@ -318,6 +348,12 @@ class OSDDaemon:
                     await self._ensure_collections(pg, acting)
                 pg.pool = pool
                 if not pg.same_interval(acting, up, primary):
+                    # watches do not survive an interval change here:
+                    # clients re-arm their lingers against the new
+                    # primary (Objecter.on_map_change)
+                    for key in [k for k in self._watchers
+                                if k[0] == pgid.pool and k[1] == pgid.ps]:
+                        del self._watchers[key]
                     pg.start_interval(m.epoch, acting, up, primary)
                     await self._ensure_collections(pg, acting)
                     self._make_backend(pg)
@@ -565,8 +601,22 @@ class OSDDaemon:
             if pg.state not in (STATE_ACTIVE,):
                 pg.waiting_for_active.append((conn, d))
                 return
+            ops = list(d["ops"])
+            special = [op for op in ops
+                       if op.get("op") in ("watch", "unwatch", "notify",
+                                           "pgls")]
+            if special:
+                if len(ops) > 1:
+                    # no silent partial execution: these ops don't compose
+                    # into batches here
+                    self._reply(conn, tid, EINVAL_RC, results=[],
+                                version=0)
+                    return
+                await self._do_special_op(conn, pg, str(d["oid"]),
+                                          ops[0], tid)
+                return
             rc, results, version = await self._do_ops(
-                pg, str(d["oid"]), list(d["ops"])
+                pg, str(d["oid"]), ops
             )
             self._reply(conn, tid, rc, results=results, version=version)
         except ShardReadError as e:
@@ -575,6 +625,65 @@ class OSDDaemon:
         except (KeyError, ValueError, TypeError) as e:
             log.derr("%s: bad osd_op: %s", self.entity, e)
             self._reply(conn, tid, EINVAL_RC)
+
+    # -- watch / notify / pgls (the Watch.h:48 + pgls machinery of
+    # PrimaryLogPG, collapsed to a per-PG watcher table) -----------------
+    async def _do_special_op(self, conn: Connection, pg: PG, oid: str,
+                             op: dict, tid: int) -> None:
+        kind = op["op"]
+        key = (pg.pgid.pool, pg.pgid.ps, oid)
+        if kind == "watch":
+            # watchers keyed by (client entity, cookie): cookies are only
+            # unique per client (reference watch_info_t/entity pairing)
+            wid = (conn.peer_name, int(op["cookie"]))
+            self._watchers.setdefault(key, {})[wid] = conn
+            self._reply(conn, tid, OK, results=[{}], version=0)
+        elif kind == "unwatch":
+            wid = (conn.peer_name, int(op["cookie"]))
+            watchers = self._watchers.get(key, {})
+            watchers.pop(wid, None)
+            if not watchers:
+                self._watchers.pop(key, None)
+            self._reply(conn, tid, OK, results=[{}], version=0)
+        elif kind == "notify":
+            self._notify_id += 1
+            nid = self._notify_id
+            payload = bytes(op.get("payload", b""))
+            timeout = float(op.get("timeout", 5.0))
+            watchers = dict(self._watchers.get(key, {}))
+            waiters = {}
+            for (entity, cookie), wconn in watchers.items():
+                fut = asyncio.get_running_loop().create_future()
+                self._notify_waiters[(nid, entity, cookie)] = fut
+                waiters[(entity, cookie)] = fut
+                try:
+                    wconn.send_message(Message("watch_notify", {
+                        "notify_id": nid, "cookie": cookie,
+                        "pool": pg.pgid.pool, "ps": pg.pgid.ps,
+                        "oid": oid, "payload": payload,
+                    }))
+                except ConnectionError:
+                    fut.set_exception(ConnectionError("watcher gone"))
+            acks: dict[str, bytes] = {}
+            timed_out: list[str] = []
+            done = await asyncio.gather(*(
+                asyncio.wait_for(f, timeout) for f in waiters.values()
+            ), return_exceptions=True)
+            for (entity, cookie), result in zip(waiters, done):
+                self._notify_waiters.pop((nid, entity, cookie), None)
+                if isinstance(result, BaseException):
+                    timed_out.append(f"{entity}:{cookie}")
+                else:
+                    acks[f"{entity}:{cookie}"] = bytes(result)
+            self._reply(conn, tid, OK, results=[{
+                "acks": acks, "timeouts": timed_out,
+            }], version=0)
+        elif kind == "pgls":
+            shard = (pg.acting.index(self.osd_id)
+                     if self.osd_id in pg.acting else 0)
+            names = sorted(self._inventory(pg, shard))
+            self._reply(conn, tid, OK, results=[{"objects": names}],
+                        version=0)
 
     def _reply(self, conn: Connection, tid: int, rc: int, **extra) -> None:
         try:

@@ -1,0 +1,239 @@
+"""Client stack: Rados/IoCtx API, ObjectOperation batches, watch/notify,
+object listing, striper, resend across OSD failure."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client import Rados, ObjectOperation, RadosStriper
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.client.striper import StripeLayout
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.mon import Monitor
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.daemon import OSDDaemon
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def fast_conf():
+    return ConfigProxy(overrides={
+        "mon_lease": 0.4, "mon_lease_interval": 0.1,
+        "mon_election_timeout": 0.3, "mon_tick_interval": 0.1,
+        "mon_accept_timeout": 0.5,
+        "osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+        "mon_osd_down_out_interval": 30.0,
+    })
+
+
+async def start_cluster(n_osds=3):
+    monmap = {"a": "local://mon.a"}
+    mon = Monitor("a", monmap, fast_conf())
+    await mon.start()
+    osds = []
+    for i in range(n_osds):
+        osd = OSDDaemon(i, monmap, fast_conf(), host=f"h{i}")
+        await osd.start()
+        osds.append(osd)
+    rados = Rados(monmap, fast_conf(), name="client.admin")
+    await rados.connect()
+    return mon, osds, rados
+
+
+async def stop_cluster(mon, osds, rados, skip=()):
+    await rados.shutdown()
+    for o in osds:
+        if o.osd_id not in skip:
+            await o.shutdown()
+    await mon.shutdown()
+
+
+def test_ioctx_full_api_round_trip():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("data", pg_num=8)
+        assert "data" in await rados.list_pools()
+        io = await rados.open_ioctx("data")
+
+        await io.write_full("obj", b"hello world")
+        assert await io.read("obj") == b"hello world"
+        await io.write("obj", b"WORLD", 6)
+        assert await io.read("obj") == b"hello WORLD"
+        await io.append("obj", b"!!")
+        assert await io.read("obj", 5, 6) == b"WORLD"
+        st = await io.stat("obj")
+        assert st["size"] == 13
+
+        await io.set_xattr("obj", "lang", b"en")
+        assert await io.get_xattr("obj", "lang") == b"en"
+        await io.rm_xattr("obj", "lang")
+        with pytest.raises(RadosError):
+            await io.get_xattr("obj", "lang")
+
+        await io.set_omap("obj", {"a": b"1", "b": b"2"})
+        assert await io.get_omap("obj") == {"a": b"1", "b": b"2"}
+        await io.rm_omap_keys("obj", ["a"])
+        assert await io.get_omap("obj") == {"b": b"2"}
+
+        # multi-op batch: atomic write + xattr
+        op = ObjectOperation().write_full(b"v2").set_xattr("tag", b"x")
+        await io.operate("obj", op)
+        assert await io.read("obj") == b"v2"
+        assert await io.get_xattr("obj", "tag") == b"x"
+
+        await io.write_full("other", b"zzz")
+        names = await io.list_objects()
+        assert names == ["obj", "other"]
+
+        await io.remove("other")
+        assert await io.list_objects() == ["obj"]
+        with pytest.raises(RadosError):
+            await io.read("other")
+
+        st = await rados.get_cluster_stats()
+        assert st["osdmap"]["num_up_osds"] == 3
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_watch_notify():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("wn", pg_num=4)
+        io = await rados.open_ioctx("wn")
+        await io.write_full("watched", b"x")
+
+        got = []
+
+        async def on_notify(payload):
+            got.append(payload)
+            return b"ack:" + payload
+
+        handle = await io.watch("watched", on_notify)
+        result = await io.notify("watched", b"ping")
+        assert got == [b"ping"]
+        assert list(result["acks"].values()) == [b"ack:ping"]
+        assert result["timeouts"] == []
+
+        # second watcher from a second client
+        rados2 = Rados(mon.monmap, fast_conf(), name="client.second")
+        await rados2.connect()
+        io2 = await rados2.open_ioctx("wn")
+        got2 = []
+
+        async def on_notify2(payload):
+            got2.append(payload)
+
+        h2 = await io2.watch("watched", on_notify2)
+        result = await io.notify("watched", b"again")
+        assert got == [b"ping", b"again"] and got2 == [b"again"]
+        assert len(result["acks"]) == 2
+
+        await io2.unwatch(h2)
+        await io.unwatch(handle)
+        result = await io.notify("watched", b"nobody")
+        assert result["acks"] == {} and got == [b"ping", b"again"]
+        await rados2.shutdown()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_objecter_resends_after_osd_failure():
+    async def run():
+        mon, osds, rados = await start_cluster(3)
+        await rados.pool_create("rp", pg_num=4, size=3, min_size=2)
+        io = await rados.open_ioctx("rp")
+        await io.write_full("before", b"pre-failure")
+        # kill the primary of "before"; the op layer must retarget
+        from ceph_tpu.osd.pg import object_to_ps
+        m = rados.monc.osdmap
+        ps = object_to_ps("before", 4)
+        _, _, _, primary = m.pg_to_up_acting(io.pool_id, ps)
+        await osds[primary].shutdown()
+        deadline = asyncio.get_running_loop().time() + 20
+        while mon.osd_monitor.osdmap.is_up(primary):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert await io.read("before") == b"pre-failure"
+        await io.write_full("after", b"post-failure")
+        assert await io.read("after") == b"post-failure"
+        await stop_cluster(mon, osds, rados, skip={primary})
+    asyncio.run(run())
+
+
+def test_watch_survives_primary_failover():
+    async def run():
+        mon, osds, rados = await start_cluster(3)
+        await rados.pool_create("wf", pg_num=4, size=3, min_size=2)
+        io = await rados.open_ioctx("wf")
+        await io.write_full("w", b"x")
+        got = []
+
+        async def cb(payload):
+            got.append(payload)
+
+        await io.watch("w", cb)
+        from ceph_tpu.osd.pg import object_to_ps
+        m = rados.monc.osdmap
+        ps = object_to_ps("w", 4)
+        _, _, _, primary = m.pg_to_up_acting(io.pool_id, ps)
+        await osds[primary].shutdown()
+        deadline = asyncio.get_running_loop().time() + 20
+        while mon.osd_monitor.osdmap.is_up(primary):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        # give the linger time to re-arm on the new primary
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            result = await io.notify("w", b"hello", timeout=2.0)
+            if result["acks"]:
+                break
+        assert got and got[-1] == b"hello"
+        await stop_cluster(mon, osds, rados, skip={primary})
+    asyncio.run(run())
+
+
+def test_striper_round_trip_and_layout():
+    layout = StripeLayout(stripe_unit=1024, stripe_count=3,
+                          object_size=4096)
+    # layout math: block-cyclic over 3 columns, 4 units per object
+    frags = list(layout.map_extent(0, 1024 * 7))
+    assert frags[0] == (0, 0, 1024)
+    assert frags[1] == (1, 0, 1024)
+    assert frags[2] == (2, 0, 1024)
+    assert frags[3] == (0, 1024, 1024)
+
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("sp", pg_num=8)
+        io = await rados.open_ioctx("sp")
+        striper = RadosStriper(io, layout)
+        data = bytes(range(256)) * 64          # 16 KiB > one object set
+        await striper.write("big", data)
+        assert (await striper.stat("big"))["size"] == len(data)
+        assert await striper.read("big") == data
+        assert await striper.read("big", 1000, 3000) == data[3000:4000]
+        # backing objects exist with the reference naming convention
+        names = await io.list_objects()
+        assert "big.0000000000000000" in names
+        assert "big.0000000000000001" in names
+        # sparse write far past the end reads zeros between
+        await striper.write("big", b"tail", 40000)
+        full = await striper.read("big")
+        assert full[:len(data)] == data
+        assert full[len(data):40000] == b"\0" * (40000 - len(data))
+        assert full[40000:] == b"tail"
+        await striper.truncate("big", 100)
+        assert (await striper.stat("big"))["size"] == 100
+        assert await striper.read("big") == data[:100]
+        await striper.remove("big")
+        assert await io.list_objects() == []
+        with pytest.raises(RadosError):
+            await striper.read("big")
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
