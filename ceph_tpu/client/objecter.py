@@ -15,7 +15,7 @@ from typing import Awaitable, Callable
 from ceph_tpu.common.log import Dout
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger
-from ceph_tpu.osd.daemon import MISDIRECTED_RC
+from ceph_tpu.osd.codes import MISDIRECTED_RC
 from ceph_tpu.osd.pg import object_to_ps
 
 log = Dout("objecter")

@@ -100,6 +100,12 @@ class ObjectOperation:
         self.ops.append({"op": "omap_rm", "keys": list(keys)})
         return self
 
+    def call(self, cls: str, method: str,
+             indata: bytes = b"") -> "ObjectOperation":
+        self.ops.append({"op": "call", "cls": cls, "method": method,
+                         "in": bytes(indata)})
+        return self
+
 
 class Rados:
     """Cluster handle (librados rados_t / Rados)."""
@@ -264,6 +270,14 @@ class IoCtx:
 
     async def rm_omap_keys(self, oid: str, keys: list[str]) -> None:
         await self.operate(oid, ObjectOperation().omap_rm(keys))
+
+    async def exec(self, oid: str, cls: str, method: str,
+                   indata: bytes = b"") -> bytes:
+        """rados_exec: run a server-side object-class method."""
+        r = await self.operate(
+            oid, ObjectOperation().call(cls, method, indata)
+        )
+        return r["results"][0]["out"]
 
     # -- listing -----------------------------------------------------------
     async def list_objects(self) -> list[str]:

@@ -1,0 +1,10 @@
+"""Op result codes shared by the OSD op interpreter and the client stack
+(errno-style, matching librados return conventions)."""
+
+OK = 0
+ENOENT_RC = -2
+EIO_RC = -5
+EAGAIN_RC = -11
+EINVAL_RC = -22
+ENOTSUP_RC = -95
+MISDIRECTED_RC = -1000        # resend after map refresh (reference drops)

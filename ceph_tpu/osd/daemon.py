@@ -22,6 +22,7 @@ from typing import Mapping
 
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.log import Dout
+from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.msg.message import PRIO_HIGH, Message
@@ -33,6 +34,15 @@ from ceph_tpu.osd.ec_backend import (
     LocalShard,
     ShardReadError,
 )
+from ceph_tpu.osd.codes import (
+    EAGAIN_RC,
+    EINVAL_RC,
+    EIO_RC,
+    ENOENT_RC,
+    ENOTSUP_RC,
+    MISDIRECTED_RC,
+    OK,
+)
 from ceph_tpu.osd.osd_map import NO_OSD, OSDMap
 from ceph_tpu.osd.pg import (
     STATE_ACTIVE,
@@ -43,19 +53,11 @@ from ceph_tpu.osd.pg import (
     PeerInfo,
     object_to_ps,
 )
+from ceph_tpu.services.cls import ClassRegistry, ClsContext, ClsError
 from ceph_tpu.store import CollectionId, GHObject, MemStore, ObjectStore
 from ceph_tpu.store import Transaction as StoreTx
 
 log = Dout("osd")
-
-# op interpreter result codes (errno-style, matching librados)
-OK = 0
-ENOENT_RC = -2
-EIO_RC = -5
-EAGAIN_RC = -11
-EINVAL_RC = -22
-ENOTSUP_RC = -95
-MISDIRECTED_RC = -1000        # resend after map refresh (reference drops)
 
 XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
 
@@ -191,6 +193,12 @@ class OSDDaemon:
         self._booted = False
         self._reboot_epoch = 0
         self._map_lock = asyncio.Lock()
+        # perf counters (the l_osd_* set, reference OSD.cc:9659 region)
+        self.perf = PerfCounters(self.entity)
+        for key in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
+                    "subop", "recovery_ops"):
+            self.perf.add(key)
+        self.perf.add("op_latency", CounterType.TIME)
         # watch/notify state:
         #   (pool, ps, oid) -> {(client entity, cookie): conn}
         self._watchers: dict[
@@ -255,9 +263,19 @@ class OSDDaemon:
                 self._handle_osd_op(conn, msg.data)
             )
         elif t == "sub_op":
+            self.perf.inc("subop")
             asyncio.get_running_loop().create_task(
                 self._handle_sub_op(conn, msg.data)
             )
+        elif t == "perf_dump":
+            # the admin-socket `perf dump` surface, polled by the mgr
+            try:
+                conn.send_message(Message("perf_dump_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    "counters": self.perf.dump(),
+                }))
+            except ConnectionError:
+                pass
         elif t == "sub_reply":
             fut = self._sub_futures.pop(int(msg.data["tid"]), None)
             if fut is not None and not fut.done():
@@ -512,6 +530,7 @@ class OSDDaemon:
                 async with sem:
                     try:
                         await pg.backend.recover_shard(name, shards)
+                        self.perf.inc("recovery_ops")
                     except (ShardReadError, IOError) as e:
                         log.derr("pg %s: recover %s failed: %s",
                                  pg.pgid, name, e)
@@ -589,6 +608,7 @@ class OSDDaemon:
     # -- client ops ----------------------------------------------------------
     async def _handle_osd_op(self, conn: Connection, d: dict) -> None:
         tid = d.get("tid", 0)
+        op_start = time.monotonic()
         try:
             pgid = PGId(int(d["pool"]), int(d["ps"]))
             pg = self.pgs.get(pgid)
@@ -615,9 +635,26 @@ class OSDDaemon:
                 await self._do_special_op(conn, pg, str(d["oid"]),
                                           ops[0], tid)
                 return
+            # counted only once we actually execute (misdirected resends
+            # and re-queued waiters must not inflate the counters)
+            self.perf.inc("op")
+            for op in ops:
+                kind = op.get("op", "")
+                if kind in ("read", "stat", "getxattr", "getxattrs",
+                            "omap_get"):
+                    self.perf.inc("op_r")
+                elif kind in ("write", "writefull", "append", "truncate",
+                              "remove", "create", "setxattr", "omap_set"):
+                    self.perf.inc("op_w")
+                if isinstance(op.get("data"), (bytes, bytearray)):
+                    self.perf.inc("op_in_bytes", len(op["data"]))
             rc, results, version = await self._do_ops(
                 pg, str(d["oid"]), ops
             )
+            for res in results:
+                if isinstance(res.get("data"), (bytes, bytearray)):
+                    self.perf.inc("op_out_bytes", len(res["data"]))
+            self.perf.tinc("op_latency", time.monotonic() - op_start)
             self._reply(conn, tid, rc, results=results, version=version)
         except ShardReadError as e:
             log.derr("%s: osd_op IO error: %s", self.entity, e)
@@ -765,9 +802,9 @@ class OSDDaemon:
                         for k, v in attrs.items()
                         if k.startswith(XATTR_PREFIX)
                     }})
-                elif kind.startswith("omap_"):
-                    # parity with the reference: EC pools do not support
-                    # omap (PrimaryLogPG rejects omap ops on EC pools)
+                elif kind.startswith("omap_") or kind == "call":
+                    # parity with the reference: EC pools support neither
+                    # omap nor (here) object classes, which depend on it
                     return ENOTSUP_RC, results, 0
                 else:
                     return EINVAL_RC, results, 0
@@ -780,12 +817,16 @@ class OSDDaemon:
 
     # -- replicated op path ----------------------------------------------------
     async def _do_ops_replicated(self, pg: PG, oid: str, ops: list[dict]):
+        """The replicated-pool op interpreter. All reads go through a
+        batch-local overlay of the pending mutations, so every op in the
+        batch — including object-class calls — observes the effects of
+        the ops before it, exactly as the reference's per-op OpContext
+        does; the store itself only changes atomically at submit."""
         cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
         obj = GHObject(pg.pgid.pool, oid)
         results: list[dict] = []
         tx = StoreTx()
         exists = self.store.exists(cid, obj)
-        size = self.store.stat(cid, obj)["size"] if exists else 0
         version = 0
         if exists:
             try:
@@ -795,27 +836,143 @@ class OSDDaemon:
             except (KeyError, ValueError):
                 version = 1
         mutated = False
+
+        # -- batch overlay: lazily materialized object state ------------
+        odata: bytearray | None = None          # None = store is current
+        oxattrs: dict[str, bytes] = {}
+        rm_xattrs: set[str] = set()
+        oomap: dict[str, bytes] = {}
+        rm_omap: set[str] = set()
+
+        def _in_store() -> bool:
+            # an object created by THIS batch (tx.touch) exists logically
+            # but is not in the store until submit
+            return exists and self.store.exists(cid, obj)
+
+        def cur_data() -> bytearray:
+            nonlocal odata
+            if odata is None:
+                odata = bytearray(
+                    self.store.read(cid, obj) if _in_store() else b""
+                )
+            return odata
+
+        def cur_size() -> int:
+            if odata is not None:
+                return len(odata)
+            return self.store.stat(cid, obj)["size"] if _in_store() else 0
+
+        def read_range(off: int, length: int | None) -> bytes:
+            if odata is not None:
+                end = len(odata) if length is None else off + length
+                return bytes(odata[off:end])
+            if not _in_store():
+                return b""
+            return self.store.read(cid, obj, off, length)
+
+        def get_xattr(key: str) -> bytes | None:
+            if key in rm_xattrs:
+                return None
+            if key in oxattrs:
+                return oxattrs[key]
+            if not exists:
+                return None
+            try:
+                return self.store.getattr(cid, obj, key)
+            except KeyError:
+                return None
+
+        def all_xattrs() -> dict[str, bytes]:
+            base = (dict(self.store.getattrs(cid, obj))
+                    if not wiped and _in_store() else {})
+            base.update(oxattrs)
+            for key in rm_xattrs:
+                base.pop(key, None)
+            return base
+
+        def get_omap(keys=None) -> dict[str, bytes]:
+            base = (dict(self.store.omap_get(cid, obj))
+                    if not wiped and _in_store() else {})
+            base.update(oomap)
+            for k in rm_omap:
+                base.pop(k, None)
+            if keys is not None:
+                base = {k: base[k] for k in keys if k in base}
+            return base
+
+        def wipe() -> None:
+            """Object replaced/removed: store state no longer shows
+            through the overlay."""
+            nonlocal odata, wiped
+            odata = bytearray()
+            oxattrs.clear()
+            oomap.clear()
+            rm_xattrs.clear()
+            rm_omap.clear()
+            wiped = True
+
+        wiped = False      # a remove/writefull happened this batch
+
+        def do_write(off: int, data: bytes) -> None:
+            nonlocal mutated, exists
+            d = cur_data()
+            end = off + len(data)
+            if len(d) < end:
+                d.extend(b"\0" * (end - len(d)))
+            d[off:end] = data
+            tx.write(cid, obj, off, data)
+            mutated = exists = True
+
+        def do_write_full(data: bytes) -> None:
+            nonlocal mutated, exists, odata
+            wipe()
+            odata = bytearray(data)
+            tx.remove(cid, obj).write(cid, obj, 0, bytes(data))
+            mutated = exists = True
+
+        def do_setxattr(key: str, value: bytes) -> None:
+            nonlocal mutated, exists
+            oxattrs[key] = bytes(value)
+            rm_xattrs.discard(key)
+            tx.setattr(cid, obj, key, bytes(value))
+            mutated = exists = True
+
+        def do_omap_set(kv: dict[str, bytes]) -> None:
+            nonlocal mutated, exists
+            kv = {str(k): bytes(v) for k, v in kv.items()}
+            oomap.update(kv)
+            rm_omap.difference_update(kv)
+            tx.omap_setkeys(cid, obj, kv)
+            mutated = exists = True
+
+        def do_omap_rm(keys) -> None:
+            nonlocal mutated
+            keys = [str(k) for k in keys]
+            rm_omap.update(keys)
+            for k in keys:
+                oomap.pop(k, None)
+            tx.omap_rmkeys(cid, obj, keys)
+            mutated = True
+
         for op in ops:
             kind = op["op"]
             if kind == "write":
-                off = int(op.get("off", 0))
-                tx.write(cid, obj, off, op["data"])
-                size = max(size, off + len(op["data"]))
-                mutated = exists = True
+                do_write(int(op.get("off", 0)), op["data"])
                 results.append({})
             elif kind == "writefull":
-                tx.remove(cid, obj).write(cid, obj, 0, op["data"])
-                size = len(op["data"])
-                mutated = exists = True
+                do_write_full(op["data"])
                 results.append({})
             elif kind == "append":
-                tx.write(cid, obj, size, op["data"])
-                size += len(op["data"])
-                mutated = exists = True
+                do_write(cur_size(), op["data"])
                 results.append({})
             elif kind == "truncate":
-                tx.truncate(cid, obj, int(op["size"]))
-                size = int(op["size"])
+                nsize = int(op["size"])
+                d = cur_data()
+                if len(d) > nsize:
+                    del d[nsize:]
+                else:
+                    d.extend(b"\0" * (nsize - len(d)))
+                tx.truncate(cid, obj, nsize)
                 mutated = exists = True
                 results.append({})
             elif kind == "create":
@@ -828,65 +985,101 @@ class OSDDaemon:
             elif kind == "read":
                 if not exists:
                     return ENOENT_RC, results, 0
-                data = self.store.read(cid, obj, int(op.get("off", 0)),
-                                       op.get("len"))
-                results.append({"data": data})
+                results.append({
+                    "data": read_range(int(op.get("off", 0)),
+                                       op.get("len")),
+                })
             elif kind == "stat":
                 if not exists:
                     return ENOENT_RC, results, 0
-                results.append({"size": size, "version": version})
+                results.append({"size": cur_size(), "version": version})
             elif kind == "remove":
                 if not exists:
                     return ENOENT_RC, results, 0
+                wipe()
                 tx.remove(cid, obj)
                 mutated = True
                 exists = False
                 results.append({})
             elif kind == "setxattr":
-                tx.setattr(cid, obj, XATTR_PREFIX + op["name"],
-                           op["value"])
-                mutated = exists = True
+                do_setxattr(XATTR_PREFIX + op["name"], op["value"])
                 results.append({})
             elif kind == "getxattr":
-                try:
-                    raw = self.store.getattr(cid, obj,
-                                             XATTR_PREFIX + op["name"])
-                except KeyError:
+                raw = get_xattr(XATTR_PREFIX + op["name"])
+                if raw is None:
                     return ENOENT_RC, results, version
                 results.append({"value": raw})
             elif kind == "getxattrs":
-                attrs = self.store.getattrs(cid, obj) if exists else {}
                 results.append({"attrs": {
-                    k[len(XATTR_PREFIX):]: v for k, v in attrs.items()
+                    k[len(XATTR_PREFIX):]: v
+                    for k, v in all_xattrs().items()
                     if k.startswith(XATTR_PREFIX)
                 }})
             elif kind == "rmxattr":
-                tx.rmattr(cid, obj, XATTR_PREFIX + op["name"])
+                key = XATTR_PREFIX + op["name"]
+                rm_xattrs.add(key)
+                oxattrs.pop(key, None)
+                tx.rmattr(cid, obj, key)
                 mutated = True
                 results.append({})
             elif kind == "omap_set":
-                tx.omap_setkeys(cid, obj, {
-                    str(k): bytes(v) for k, v in op["kv"].items()
-                })
-                mutated = exists = True
+                do_omap_set(op["kv"])
                 results.append({})
             elif kind == "omap_get":
-                omap = self.store.omap_get(cid, obj) if exists else {}
-                keys = op.get("keys")
-                if keys is not None:
-                    omap = {k: omap[k] for k in keys if k in omap}
-                results.append({"kv": omap})
+                results.append({"kv": get_omap(op.get("keys"))})
             elif kind == "omap_rm":
-                tx.omap_rmkeys(cid, obj, [str(k) for k in op["keys"]])
-                mutated = True
+                do_omap_rm(op["keys"])
                 results.append({})
+            elif kind == "call":
+                # server-side object class method (CEPH_OSD_OP_CALL,
+                # do_osd_ops -> ClassHandler); reads/writes go through
+                # the same batch overlay, mutations join tx atomically
+                def _cls_read():
+                    if not exists:
+                        raise ClsError(ENOENT_RC, "no object")
+                    return bytes(read_range(0, None))
+
+                def _cls_stat():
+                    if not exists:
+                        raise ClsError(ENOENT_RC, "no object")
+                    return {"size": cur_size(), "version": version}
+
+                def _cls_getxattr(name: str):
+                    return get_xattr(XATTR_PREFIX + name)
+
+                def _cls_create():
+                    nonlocal mutated, exists
+                    tx.touch(cid, obj)
+                    mutated = exists = True
+
+                ctx = ClsContext(
+                    read=_cls_read,
+                    write_full=lambda data: do_write_full(data),
+                    stat=_cls_stat,
+                    getxattr=_cls_getxattr,
+                    setxattr=lambda name, value: do_setxattr(
+                        XATTR_PREFIX + name, value
+                    ),
+                    omap_get=get_omap,
+                    omap_set=do_omap_set,
+                    omap_rm=do_omap_rm,
+                    create=_cls_create,
+                )
+                try:
+                    out = ClassRegistry.instance().call(
+                        str(op["cls"]), str(op["method"]), ctx,
+                        bytes(op.get("in", b"")),
+                    )
+                except ClsError as e:
+                    return e.rc, results, version
+                results.append({"out": out})
             else:
                 return EINVAL_RC, results, version
         if mutated:
             version += 1
             if exists:
                 tx.setattr(cid, obj, VERSION_ATTR, json.dumps(
-                    {"size": size, "version": version}
+                    {"size": cur_size(), "version": version}
                 ).encode())
             rc = await self._submit_replicated(pg, tx)
             if rc != OK:

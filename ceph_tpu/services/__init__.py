@@ -1,0 +1,22 @@
+"""Services on RADOS.
+
+The reference's service layers (§2.8 of the survey) over the librados-
+shaped client stack:
+
+- ``ceph_tpu.services.cls``  — server-side object classes executed inside
+  the OSD op interpreter (reference src/cls + src/objclass +
+  osd/ClassHandler.cc): RADOS's "stored procedures".
+- ``ceph_tpu.services.rbd``  — block images striped over data objects
+  with v2-style id/header metadata (reference src/librbd).
+- ``ceph_tpu.services.rgw``  — bucket/object gateway with omap bucket
+  indexes (reference src/rgw RGWRados bucket-index pattern).
+- ``ceph_tpu.services.mgr``  — perf-counter aggregation + prometheus
+  text exposition (reference src/mgr + pybind/mgr/prometheus).
+"""
+
+from ceph_tpu.services.cls import ClassRegistry, ClsError
+from ceph_tpu.services.mgr import Mgr
+from ceph_tpu.services.rbd import RBD, Image
+from ceph_tpu.services.rgw import RGWLite
+
+__all__ = ["RBD", "ClassRegistry", "ClsError", "Image", "Mgr", "RGWLite"]

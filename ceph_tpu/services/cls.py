@@ -1,0 +1,253 @@
+"""Object classes: server-side methods executed inside the OSD.
+
+Reference src/cls (40k LoC of plugins), src/objclass (the method API),
+osd/ClassHandler.cc (the dlopen loader): RADOS ops of type
+CEPH_OSD_OP_CALL run named methods against the target object inside the
+op interpreter (PrimaryLogPG do_osd_ops), with the method's mutations
+joining the op's transaction atomically. Here classes are plain Python
+registered in a process-global registry (the "what NOT to port" rule:
+entry points instead of dlopen), and the method context exposes the same
+read/write/xattr/omap surface cls_cxx_* does.
+
+Built-ins mirror the reference's most load-bearing classes:
+``lock`` (cls_lock), ``refcount`` (cls_refcount), ``version``
+(cls_version), and ``rbd`` (the header methods our rbd layer uses).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+ENOENT_RC = -2
+EBUSY_RC = -16
+EEXIST_RC = -17
+EINVAL_RC = -22
+
+
+class ClsError(Exception):
+    def __init__(self, rc: int, msg: str = ""):
+        super().__init__(f"rc={rc} {msg}")
+        self.rc = rc
+
+
+class ClsContext:
+    """Method handle on the target object (cls_method_context_t). The
+    daemon wires these callables to its store + the op's transaction so
+    mutations commit atomically with the rest of the op batch."""
+
+    def __init__(self, *, read, write_full, stat, getxattr, setxattr,
+                 omap_get, omap_set, omap_rm, create):
+        self.read = read                  # () -> bytes (ENOENT -> ClsError)
+        self.write_full = write_full      # (bytes) -> None
+        self.stat = stat                  # () -> {"size", "version"}
+        self.getxattr = getxattr          # (name) -> bytes | None
+        self.setxattr = setxattr          # (name, bytes) -> None
+        self.omap_get = omap_get          # (keys|None) -> dict
+        self.omap_set = omap_set          # (dict) -> None
+        self.omap_rm = omap_rm            # (keys) -> None
+        self.create = create              # () -> None (touch)
+
+
+Method = Callable[[ClsContext, bytes], bytes]
+
+
+class ClassRegistry:
+    """Process-global class/method table (ClassHandler role)."""
+
+    _instance: "ClassRegistry | None" = None
+
+    def __init__(self):
+        self._methods: dict[tuple[str, str], Method] = {}
+
+    @classmethod
+    def instance(cls) -> "ClassRegistry":
+        if cls._instance is None:
+            cls._instance = cls()
+            _register_builtins(cls._instance)
+        return cls._instance
+
+    def register(self, cls_name: str, method: str, fn: Method) -> None:
+        self._methods[(cls_name, method)] = fn
+
+    def get(self, cls_name: str, method: str) -> Method | None:
+        return self._methods.get((cls_name, method))
+
+    def call(self, cls_name: str, method: str, ctx: ClsContext,
+             indata: bytes) -> bytes:
+        fn = self.get(cls_name, method)
+        if fn is None:
+            raise ClsError(
+                EINVAL_RC, f"no method {cls_name}.{method}"
+            )
+        return fn(ctx, indata)
+
+
+# ---------------------------------------------------------------------------
+# built-in classes
+
+
+def _j(indata: bytes) -> dict:
+    try:
+        return json.loads(indata or b"{}")
+    except ValueError as e:
+        raise ClsError(EINVAL_RC, f"bad input: {e}") from None
+
+
+def _register_builtins(reg: ClassRegistry) -> None:
+    # -- cls_lock: advisory object locks (reference src/cls/lock) --------
+    LOCK_KEY = "lock.state"
+
+    def _lock_state(ctx) -> dict:
+        raw = ctx.getxattr(LOCK_KEY)
+        return json.loads(raw) if raw else {"lockers": {}, "type": ""}
+
+    def lock_lock(ctx: ClsContext, indata: bytes) -> bytes:
+        args = _j(indata)
+        name = args.get("name", "lock")
+        locker = args["locker"]
+        ltype = args.get("type", "exclusive")
+        duration = float(args.get("duration", 0))
+        state = _lock_state(ctx)
+        now = time.time()
+        lockers = {
+            lk: info for lk, info in state["lockers"].items()
+            if not info["expires"] or info["expires"] > now
+        }
+        if lockers:
+            others = set(lockers) - {locker}
+            # an exclusive request (or a request against an exclusively-
+            # held lock) fails while ANY other locker remains — a shared
+            # holder cannot upgrade past other shared holders
+            if (ltype == "exclusive" or state["type"] == "exclusive") \
+                    and others:
+                raise ClsError(EBUSY_RC, f"{name} held")
+        lockers[locker] = {
+            "expires": now + duration if duration else 0,
+            "type": ltype,
+        }
+        ctx.setxattr(LOCK_KEY, json.dumps(
+            {"lockers": lockers, "type": ltype}
+        ).encode())
+        return b""
+
+    def lock_unlock(ctx: ClsContext, indata: bytes) -> bytes:
+        args = _j(indata)
+        state = _lock_state(ctx)
+        if args["locker"] not in state["lockers"]:
+            raise ClsError(ENOENT_RC, "not the locker")
+        del state["lockers"][args["locker"]]
+        ctx.setxattr(LOCK_KEY, json.dumps(state).encode())
+        return b""
+
+    def lock_info(ctx: ClsContext, indata: bytes) -> bytes:
+        return json.dumps(_lock_state(ctx)).encode()
+
+    reg.register("lock", "lock", lock_lock)
+    reg.register("lock", "unlock", lock_unlock)
+    reg.register("lock", "get_info", lock_info)
+
+    # -- cls_refcount (reference src/cls/refcount) -----------------------
+    REF_KEY = "refcount.refs"
+
+    def ref_get(ctx: ClsContext, indata: bytes) -> bytes:
+        tag = _j(indata)["tag"]
+        raw = ctx.getxattr(REF_KEY)
+        refs = set(json.loads(raw)) if raw else set()
+        refs.add(tag)
+        ctx.setxattr(REF_KEY, json.dumps(sorted(refs)).encode())
+        return b""
+
+    def ref_put(ctx: ClsContext, indata: bytes) -> bytes:
+        tag = _j(indata)["tag"]
+        raw = ctx.getxattr(REF_KEY)
+        refs = set(json.loads(raw)) if raw else set()
+        refs.discard(tag)
+        ctx.setxattr(REF_KEY, json.dumps(sorted(refs)).encode())
+        return json.dumps({"empty": not refs}).encode()
+
+    def ref_read(ctx: ClsContext, indata: bytes) -> bytes:
+        raw = ctx.getxattr(REF_KEY)
+        return raw or b"[]"
+
+    reg.register("refcount", "get", ref_get)
+    reg.register("refcount", "put", ref_put)
+    reg.register("refcount", "read", ref_read)
+
+    # -- cls_version (reference src/cls/version) -------------------------
+    VER_KEY = "objver"
+
+    def ver_set(ctx: ClsContext, indata: bytes) -> bytes:
+        ctx.setxattr(VER_KEY, json.dumps(_j(indata)["ver"]).encode())
+        return b""
+
+    def ver_read(ctx: ClsContext, indata: bytes) -> bytes:
+        raw = ctx.getxattr(VER_KEY)
+        return raw or b"0"
+
+    def ver_inc(ctx: ClsContext, indata: bytes) -> bytes:
+        raw = ctx.getxattr(VER_KEY)
+        ver = (json.loads(raw) if raw else 0) + 1
+        ctx.setxattr(VER_KEY, json.dumps(ver).encode())
+        return json.dumps(ver).encode()
+
+    reg.register("version", "set", ver_set)
+    reg.register("version", "read", ver_read)
+    reg.register("version", "inc", ver_inc)
+
+    # -- cls_rbd (the header subset our rbd layer uses; reference
+    # src/cls/rbd manages the full v2 feature set) -----------------------
+    def rbd_create(ctx: ClsContext, indata: bytes) -> bytes:
+        args = _j(indata)
+        if ctx.getxattr("rbd.header") is not None:
+            raise ClsError(EEXIST_RC, "image exists")
+        ctx.create()
+        ctx.setxattr("rbd.header", json.dumps({
+            "size": int(args["size"]), "order": int(args["order"]),
+            "object_prefix": args["object_prefix"],
+            "snaps": {}, "snap_seq": 0,
+        }).encode())
+        return b""
+
+    def _header(ctx) -> dict:
+        raw = ctx.getxattr("rbd.header")
+        if raw is None:
+            raise ClsError(ENOENT_RC, "no image header")
+        return json.loads(raw)
+
+    def rbd_get(ctx: ClsContext, indata: bytes) -> bytes:
+        return json.dumps(_header(ctx)).encode()
+
+    def rbd_set_size(ctx: ClsContext, indata: bytes) -> bytes:
+        h = _header(ctx)
+        h["size"] = int(_j(indata)["size"])
+        ctx.setxattr("rbd.header", json.dumps(h).encode())
+        return b""
+
+    def rbd_snap_add(ctx: ClsContext, indata: bytes) -> bytes:
+        args = _j(indata)
+        h = _header(ctx)
+        if args["name"] in h["snaps"]:
+            raise ClsError(EEXIST_RC, "snap exists")
+        h["snap_seq"] += 1
+        h["snaps"][args["name"]] = {
+            "id": h["snap_seq"], "size": h["size"],
+        }
+        ctx.setxattr("rbd.header", json.dumps(h).encode())
+        return json.dumps(h["snap_seq"]).encode()
+
+    def rbd_snap_rm(ctx: ClsContext, indata: bytes) -> bytes:
+        args = _j(indata)
+        h = _header(ctx)
+        if args["name"] not in h["snaps"]:
+            raise ClsError(ENOENT_RC, "no such snap")
+        del h["snaps"][args["name"]]
+        ctx.setxattr("rbd.header", json.dumps(h).encode())
+        return b""
+
+    reg.register("rbd", "create", rbd_create)
+    reg.register("rbd", "get_header", rbd_get)
+    reg.register("rbd", "set_size", rbd_set_size)
+    reg.register("rbd", "snap_add", rbd_snap_add)
+    reg.register("rbd", "snap_rm", rbd_snap_rm)

@@ -1,0 +1,146 @@
+"""Mgr: cluster metrics aggregation + prometheus exposition.
+
+The reference's manager pulls MMgrReport perf-counter payloads from every
+daemon (src/mgr/DaemonServer.h:51) and the prometheus mgr module renders
+them (src/pybind/mgr/prometheus/module.py:1021). Here the mgr polls: it
+asks each up OSD for a ``perf_dump`` (the admin-socket ``perf dump``
+surface, reference common/admin_socket.h:105) and merges the replies with
+monitor status into one snapshot, rendered in the prometheus text
+exposition format with the metric names the reference's module exports
+(ceph_osd_op, ceph_osd_op_in_bytes, ceph_osd_up, ...).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.msg.message import Message
+from ceph_tpu.msg.messenger import Connection, Messenger, Policy
+
+
+class Mgr:
+    def __init__(self, monmap: dict[str, str],
+                 conf: ConfigProxy | None = None, name: str = "mgr.x"):
+        self.conf = conf or ConfigProxy()
+        self.name = name
+        self.msgr = Messenger(name, self.conf)
+        self.msgr.set_policy("mon", Policy.lossy_client())
+        self.msgr.set_policy("osd", Policy.lossy_client())
+        self.msgr.set_dispatcher(self)
+        self.monc = MonClient(name, monmap, self.conf, msgr=self.msgr)
+        self._tid = 0
+        self._futures: dict[int, asyncio.Future] = {}
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if msg.type == "perf_dump_reply":
+            fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg.data.get("counters", {}))
+            return
+        await self.monc.ms_dispatch(conn, msg)
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        self.monc.ms_handle_reset(conn)
+
+    def ms_handle_connect(self, conn: Connection) -> None:
+        pass
+
+    async def start(self, timeout: float = 20.0) -> None:
+        await self.monc.start(timeout)
+        self.monc.sub_want("osdmap")
+        self.monc.renew_subs()
+        await self.monc.wait_for_map(1, timeout)
+
+    async def shutdown(self) -> None:
+        await self.monc.shutdown()
+        await self.msgr.shutdown()
+
+    # -- collection --------------------------------------------------------
+    async def _poll_osd(self, osd: int, addr: str,
+                        timeout: float = 3.0) -> dict | None:
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_running_loop().create_future()
+        self._futures[tid] = fut
+        try:
+            await self.msgr.send_to(
+                addr, Message("perf_dump", {"tid": tid}), f"osd.{osd}"
+            )
+            return await asyncio.wait_for(fut, timeout)
+        except (ConnectionError, asyncio.TimeoutError):
+            self._futures.pop(tid, None)
+            return None
+
+    async def collect(self) -> dict:
+        """One cluster snapshot: mon status + per-osd perf counters."""
+        status = (await self.monc.command("status"))["data"]
+        osdmap = self.monc.osdmap
+        osd_perf: dict[int, dict] = {}
+        if osdmap is not None:
+            polls = {
+                osd: self._poll_osd(osd, info.addr)
+                for osd, info in osdmap.osds.items() if info.up
+            }
+            results = await asyncio.gather(*polls.values())
+            for osd, counters in zip(polls, results):
+                if counters is not None:
+                    osd_perf[osd] = counters
+        return {
+            "status": status,
+            "osds": {
+                osd: {"up": info.up, "in": info.in_cluster}
+                for osd, info in (osdmap.osds.items() if osdmap else ())
+            },
+            "osd_perf": osd_perf,
+        }
+
+    # -- prometheus exposition ---------------------------------------------
+    @staticmethod
+    def prometheus_text(snapshot: dict) -> str:
+        """Render one snapshot in the text exposition format, with the
+        metric names the reference prometheus module exports."""
+        lines: list[str] = []
+
+        def metric(name: str, help_: str, samples: list[tuple[str, float]],
+                   mtype: str = "gauge") -> None:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {mtype}")
+            for labels, value in samples:
+                lines.append(f"{name}{labels} {value:g}")
+
+        st = snapshot["status"]
+        health = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}.get(
+            st["health"]["status"], 2
+        )
+        metric("ceph_health_status", "cluster health (0=ok 1=warn 2=err)",
+               [("", health)])
+        om = st["osdmap"]
+        metric("ceph_osd_stat", "osd counts by state", [
+            ('{state="total"}', om["num_osds"]),
+            ('{state="up"}', om["num_up_osds"]),
+            ('{state="in"}', om["num_in_osds"]),
+        ])
+        metric("ceph_pool_count", "pools", [("", om["num_pools"])])
+        metric("ceph_mon_quorum_count", "monitors in quorum",
+               [("", len(st["mon"]["quorum"]))])
+        up_samples = [
+            (f'{{ceph_daemon="osd.{osd}"}}', 1.0 if info["up"] else 0.0)
+            for osd, info in sorted(snapshot["osds"].items())
+        ]
+        if up_samples:
+            metric("ceph_osd_up", "osd up state", up_samples)
+        # per-osd counters: one prometheus metric per counter key
+        by_key: dict[str, list[tuple[str, float]]] = {}
+        for osd, counters in sorted(snapshot["osd_perf"].items()):
+            for key, value in sorted(counters.items()):
+                if isinstance(value, dict):      # time counters
+                    value = value.get("sum", 0.0)
+                by_key.setdefault(key, []).append(
+                    (f'{{ceph_daemon="osd.{osd}"}}', float(value))
+                )
+        for key, samples in sorted(by_key.items()):
+            metric(f"ceph_osd_{key}", f"osd {key} perf counter", samples,
+                   mtype="counter")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,191 @@
+"""RGW-lite: bucket/object gateway semantics over RADOS.
+
+The storage model of reference src/rgw's RGWRados (rgw_rados.h:400)
+without the HTTP frontends: every bucket has an INDEX object whose omap
+maps key -> entry metadata (the cls_rgw bucket-index pattern — the index
+is maintained server-side so listing never scans data objects), object
+data lives in per-key RADOS objects (striped above 4 MiB, the manifest
+role), and user metadata + etag ride xattrs. S3-visible behaviors kept:
+listing with prefix/marker/max_keys, etag as hex md5, copy, and
+conditional puts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+from ceph_tpu.client.rados import IoCtx, ObjectOperation, RadosError
+from ceph_tpu.client.striper import RadosStriper, StripeLayout
+
+BUCKETS_OID = "rgw.buckets"          # omap: bucket name -> meta
+STRIPE_THRESHOLD = 4 * 1024 * 1024
+
+
+class RGWError(IOError):
+    def __init__(self, code: str, msg: str = ""):
+        super().__init__(f"{code}: {msg}")
+        self.code = code
+
+
+class RGWLite:
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+        self.striper = RadosStriper(ioctx, StripeLayout(
+            stripe_unit=512 * 1024, stripe_count=4,
+            object_size=4 * 1024 * 1024,
+        ))
+
+    # -- buckets -----------------------------------------------------------
+    @staticmethod
+    def _index_oid(bucket: str) -> str:
+        return f"rgw.bucket.index.{bucket}"
+
+    async def create_bucket(self, bucket: str) -> None:
+        existing = await self.list_buckets()
+        if bucket in existing:
+            raise RGWError("BucketAlreadyExists", bucket)
+        await self.ioctx.operate(BUCKETS_OID, ObjectOperation()
+                                 .create()
+                                 .omap_set({bucket: json.dumps({
+                                     "created": time.time(),
+                                 }).encode()}))
+        await self.ioctx.operate(self._index_oid(bucket),
+                                 ObjectOperation().create())
+
+    async def delete_bucket(self, bucket: str) -> None:
+        await self._require_bucket(bucket)
+        index = await self.ioctx.get_omap(self._index_oid(bucket))
+        if index:
+            raise RGWError("BucketNotEmpty", bucket)
+        await self.ioctx.remove(self._index_oid(bucket))
+        await self.ioctx.rm_omap_keys(BUCKETS_OID, [bucket])
+
+    async def list_buckets(self) -> list[str]:
+        try:
+            return sorted(await self.ioctx.get_omap(BUCKETS_OID))
+        except RadosError as e:
+            if e.rc == -2:
+                return []
+            raise
+
+    async def _require_bucket(self, bucket: str) -> None:
+        if bucket not in await self.list_buckets():
+            raise RGWError("NoSuchBucket", bucket)
+
+    # -- objects -----------------------------------------------------------
+    @staticmethod
+    def _data_oid(bucket: str, key: str) -> str:
+        return f"rgw.obj.{bucket}/{key}"
+
+    async def put_object(self, bucket: str, key: str, data: bytes,
+                         content_type: str = "binary/octet-stream",
+                         metadata: dict[str, str] | None = None,
+                         if_none_match: bool = False) -> dict:
+        """S3 PUT. ``if_none_match``: fail when the key exists ('*')."""
+        await self._require_bucket(bucket)
+        index_oid = self._index_oid(bucket)
+        existing = await self.ioctx.get_omap(index_oid, [key])
+        if if_none_match and existing:
+            raise RGWError("PreconditionFailed", key)
+        etag = hashlib.md5(data).hexdigest()
+        oid = self._data_oid(bucket, key)
+        if key in existing:
+            # drop the old data objects first: a smaller striped body
+            # must not inherit the old size xattr / stale tail stripes
+            old = json.loads(existing[key])
+            try:
+                if old.get("striped"):
+                    await self.striper.remove(oid)
+                else:
+                    await self.ioctx.remove(oid)
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+        striped = len(data) > STRIPE_THRESHOLD
+        if striped:
+            await self.striper.write(oid, data)
+        else:
+            op = ObjectOperation().write_full(data)
+            await self.ioctx.operate(oid, op)
+        entry = {
+            "size": len(data), "etag": etag, "mtime": time.time(),
+            "content_type": content_type, "striped": striped,
+            "meta": dict(metadata or {}),
+        }
+        await self.ioctx.set_omap(index_oid, {
+            key: json.dumps(entry).encode(),
+        })
+        return {"etag": etag, "size": len(data)}
+
+    async def _entry(self, bucket: str, key: str) -> dict:
+        await self._require_bucket(bucket)
+        kv = await self.ioctx.get_omap(self._index_oid(bucket), [key])
+        if key not in kv:
+            raise RGWError("NoSuchKey", f"{bucket}/{key}")
+        return json.loads(kv[key])
+
+    async def get_object(self, bucket: str, key: str,
+                         range_: tuple[int, int] | None = None) -> dict:
+        """S3 GET (optionally a byte range, inclusive bounds)."""
+        entry = await self._entry(bucket, key)
+        oid = self._data_oid(bucket, key)
+        if range_ is not None:
+            start, end = range_
+            end = min(end, entry["size"] - 1)
+            length = max(0, end - start + 1)
+            if entry["striped"]:
+                data = await self.striper.read(oid, length, start)
+            else:
+                data = await self.ioctx.read(oid, length, start)
+        elif entry["striped"]:
+            data = await self.striper.read(oid)
+        else:
+            data = await self.ioctx.read(oid)
+        return {"data": data, **entry}
+
+    async def head_object(self, bucket: str, key: str) -> dict:
+        return await self._entry(bucket, key)
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        entry = await self._entry(bucket, key)
+        oid = self._data_oid(bucket, key)
+        if entry["striped"]:
+            await self.striper.remove(oid)
+        else:
+            await self.ioctx.remove(oid)
+        await self.ioctx.rm_omap_keys(self._index_oid(bucket), [key])
+
+    async def copy_object(self, src_bucket: str, src_key: str,
+                          dst_bucket: str, dst_key: str) -> dict:
+        got = await self.get_object(src_bucket, src_key)
+        return await self.put_object(
+            dst_bucket, dst_key, got["data"],
+            content_type=got["content_type"], metadata=got["meta"],
+        )
+
+    async def list_objects(self, bucket: str, prefix: str = "",
+                           marker: str = "",
+                           max_keys: int = 1000) -> dict:
+        """S3 ListObjects: sorted, prefix-filtered, marker-paginated."""
+        await self._require_bucket(bucket)
+        index = await self.ioctx.get_omap(self._index_oid(bucket))
+        keys = sorted(
+            k for k in index
+            if k.startswith(prefix) and k > marker
+        )
+        truncated = len(keys) > max_keys
+        keys = keys[:max_keys]
+        contents = []
+        for k in keys:
+            entry = json.loads(index[k])
+            contents.append({
+                "key": k, "size": entry["size"], "etag": entry["etag"],
+                "mtime": entry["mtime"],
+            })
+        return {
+            "contents": contents,
+            "is_truncated": truncated,
+            "next_marker": keys[-1] if truncated and keys else "",
+        }

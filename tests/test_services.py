@@ -1,0 +1,363 @@
+"""Services: cls object classes, rbd images, rgw-lite gateway, mgr
+metrics + prometheus exposition."""
+
+import asyncio
+import hashlib
+import json
+
+import pytest
+
+from ceph_tpu.client import Rados
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.mon import Monitor
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.daemon import OSDDaemon
+from ceph_tpu.services import RBD, Mgr, RGWLite
+from ceph_tpu.services.rbd import RBDError
+from ceph_tpu.services.rgw import RGWError
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def fast_conf():
+    return ConfigProxy(overrides={
+        "mon_lease": 0.4, "mon_lease_interval": 0.1,
+        "mon_election_timeout": 0.3, "mon_tick_interval": 0.1,
+        "mon_accept_timeout": 0.5,
+        "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 1.0,
+    })
+
+
+async def start_cluster(n_osds=3):
+    monmap = {"a": "local://mon.a"}
+    mon = Monitor("a", monmap, fast_conf())
+    await mon.start()
+    osds = []
+    for i in range(n_osds):
+        osd = OSDDaemon(i, monmap, fast_conf(), host=f"h{i}")
+        await osd.start()
+        osds.append(osd)
+    rados = Rados(monmap, fast_conf())
+    await rados.connect()
+    return mon, osds, rados
+
+
+async def stop_cluster(mon, osds, rados):
+    await rados.shutdown()
+    for o in osds:
+        await o.shutdown()
+    await mon.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# cls
+
+def test_cls_lock_refcount_version():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("meta", pg_num=4)
+        io = await rados.open_ioctx("meta")
+        await io.write_full("obj", b"x")
+
+        # cls_lock: exclusive lock blocks a second locker
+        await io.exec("obj", "lock", "lock", json.dumps(
+            {"locker": "client.a", "type": "exclusive"}
+        ).encode())
+        with pytest.raises(RadosError):
+            await io.exec("obj", "lock", "lock", json.dumps(
+                {"locker": "client.b", "type": "exclusive"}
+            ).encode())
+        info = json.loads(await io.exec("obj", "lock", "get_info"))
+        assert "client.a" in info["lockers"]
+        await io.exec("obj", "lock", "unlock", json.dumps(
+            {"locker": "client.a"}
+        ).encode())
+        # now b can lock
+        await io.exec("obj", "lock", "lock", json.dumps(
+            {"locker": "client.b"}
+        ).encode())
+
+        # cls_refcount
+        await io.exec("obj", "refcount", "get",
+                      json.dumps({"tag": "t1"}).encode())
+        await io.exec("obj", "refcount", "get",
+                      json.dumps({"tag": "t2"}).encode())
+        out = json.loads(await io.exec(
+            "obj", "refcount", "put", json.dumps({"tag": "t1"}).encode()
+        ))
+        assert out["empty"] is False
+        out = json.loads(await io.exec(
+            "obj", "refcount", "put", json.dumps({"tag": "t2"}).encode()
+        ))
+        assert out["empty"] is True
+
+        # cls_version
+        assert json.loads(await io.exec("obj", "version", "read")) == 0
+        assert json.loads(await io.exec("obj", "version", "inc")) == 1
+        assert json.loads(await io.exec("obj", "version", "inc")) == 2
+
+        # unknown method
+        with pytest.raises(RadosError):
+            await io.exec("obj", "nope", "nope")
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_cls_atomic_with_batch():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("meta", pg_num=4)
+        io = await rados.open_ioctx("meta")
+        from ceph_tpu.client import ObjectOperation
+        # write + cls call in ONE op: both land, object replicated
+        op = (ObjectOperation().write_full(b"payload")
+              .call("version", "inc"))
+        r = await io.operate("obj", op)
+        assert json.loads(r["results"][1]["out"]) == 1
+        assert await io.read("obj") == b"payload"
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# rbd
+
+def test_rbd_image_lifecycle():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("rbd", pg_num=8)
+        io = await rados.open_ioctx("rbd")
+        rbd = RBD(io)
+        await rbd.create("vm-disk", size=10 * 1024 * 1024, order=20)
+        assert await rbd.list() == ["vm-disk"]
+        with pytest.raises(RBDError):
+            await rbd.create("vm-disk", size=1024)
+
+        img = await rbd.open("vm-disk")
+        st = img.stat()
+        assert st["size"] == 10 * 1024 * 1024
+        assert st["object_size"] == 1 << 20
+
+        # write across an object boundary
+        blob = bytes(range(256)) * 8192          # 2 MiB
+        await img.write((1 << 20) - 1000, blob)
+        assert await img.read((1 << 20) - 1000, len(blob)) == blob
+        # unwritten regions read as zeros
+        assert await img.read(0, 100) == b"\0" * 100
+        with pytest.raises(RBDError):
+            await img.write(st["size"] - 10, b"x" * 20)
+
+        # snapshots (metadata level)
+        sid = await img.snap_create("s1")
+        assert sid == 1
+        assert [s["name"] for s in img.snap_list()] == ["s1"]
+        await img.snap_remove("s1")
+        assert img.snap_list() == []
+
+        # shrink drops objects beyond the boundary
+        await img.resize(1 << 20)
+        assert img.stat()["size"] == 1 << 20
+        img2 = await rbd.open("vm-disk")
+        assert img2.size == 1 << 20
+
+        await rbd.remove("vm-disk")
+        assert await rbd.list() == []
+        assert await io.list_objects() == ["rbd_directory"]
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# rgw
+
+def test_rgw_bucket_object_lifecycle():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("rgw", pg_num=8)
+        io = await rados.open_ioctx("rgw")
+        gw = RGWLite(io)
+
+        await gw.create_bucket("photos")
+        with pytest.raises(RGWError):
+            await gw.create_bucket("photos")
+        assert await gw.list_buckets() == ["photos"]
+
+        body = b"jpeg-bytes" * 100
+        put = await gw.put_object("photos", "2026/cat.jpg", body,
+                                  content_type="image/jpeg",
+                                  metadata={"camera": "x100"})
+        assert put["etag"] == hashlib.md5(body).hexdigest()
+        got = await gw.get_object("photos", "2026/cat.jpg")
+        assert got["data"] == body
+        assert got["content_type"] == "image/jpeg"
+        assert got["meta"] == {"camera": "x100"}
+        # range get (inclusive bounds, S3 semantics)
+        got = await gw.get_object("photos", "2026/cat.jpg",
+                                  range_=(2, 11))
+        assert got["data"] == body[2:12]
+
+        # conditional put
+        with pytest.raises(RGWError):
+            await gw.put_object("photos", "2026/cat.jpg", b"",
+                                if_none_match=True)
+
+        # listing with prefix/pagination
+        for i in range(5):
+            await gw.put_object("photos", f"2026/d{i}", b"x")
+        await gw.put_object("photos", "other/z", b"y")
+        ls = await gw.list_objects("photos", prefix="2026/")
+        assert [c["key"] for c in ls["contents"]] == [
+            "2026/cat.jpg", "2026/d0", "2026/d1", "2026/d2", "2026/d3",
+            "2026/d4",
+        ]
+        ls = await gw.list_objects("photos", prefix="2026/", max_keys=2)
+        assert ls["is_truncated"] and ls["next_marker"] == "2026/d0"
+        assert [c["key"] for c in ls["contents"]] == [
+            "2026/cat.jpg", "2026/d0",
+        ]
+        ls2 = await gw.list_objects("photos", prefix="2026/",
+                                    marker=ls["next_marker"], max_keys=10)
+        assert [c["key"] for c in ls2["contents"]] == [
+            "2026/d1", "2026/d2", "2026/d3", "2026/d4",
+        ]
+
+        # large object goes through the striper transparently
+        big = bytes(range(256)) * (5 * 4096)     # 5 MiB
+        await gw.put_object("photos", "big.bin", big)
+        got = await gw.get_object("photos", "big.bin")
+        assert got["data"] == big and got["striped"]
+
+        # copy + delete
+        await gw.copy_object("photos", "2026/cat.jpg", "photos", "copy")
+        assert (await gw.get_object("photos", "copy"))["data"] == body
+        with pytest.raises(RGWError):
+            await gw.delete_bucket("photos")     # not empty
+        for key in ["2026/cat.jpg", "copy", "other/z", "big.bin"] + \
+                [f"2026/d{i}" for i in range(5)]:
+            await gw.delete_object("photos", key)
+        await gw.delete_bucket("photos")
+        assert await gw.list_buckets() == []
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# mgr
+
+def test_mgr_collect_and_prometheus():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("data", pg_num=4)
+        io = await rados.open_ioctx("data")
+        await io.write_full("obj", b"x" * 1000)
+        await io.read("obj")
+
+        mgr = Mgr(mon.monmap, fast_conf())
+        await mgr.start()
+        snap = await mgr.collect()
+        assert snap["status"]["osdmap"]["num_up_osds"] == 3
+        assert set(snap["osd_perf"]) == {0, 1, 2}
+        total_ops = sum(c.get("op", 0) for c in snap["osd_perf"].values())
+        assert total_ops >= 2                 # the write + the read
+
+        text = Mgr.prometheus_text(snap)
+        assert "# TYPE ceph_health_status gauge" in text
+        assert 'ceph_osd_stat{state="up"} 3' in text
+        assert 'ceph_osd_up{ceph_daemon="osd.0"} 1' in text
+        assert "ceph_osd_op{" in text
+        assert "ceph_osd_op_in_bytes{" in text
+        await mgr.shutdown()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_batch_ops_see_prior_mutations():
+    """Regression: every op in a batch (including cls calls) must observe
+    the effects of the ops before it, and later ops must see cls writes."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("meta", pg_num=4)
+        io = await rados.open_ioctx("meta")
+        from ceph_tpu.client import ObjectOperation
+        # write_full on a NEW object, then a cls method that reads it,
+        # then a plain read — all one batch
+        op = (ObjectOperation()
+              .write_full(b"fresh")
+              .call("version", "inc")       # cls sees the new object
+              .read())
+        r = await io.operate("brandnew", op)
+        assert json.loads(r["results"][1]["out"]) == 1
+        assert r["results"][2]["data"] == b"fresh"
+        # xattr set by an earlier op is visible to a later getxattr + cls
+        op = (ObjectOperation()
+              .set_xattr("k", b"v")
+              .get_xattr("k"))
+        r = await io.operate("brandnew", op)
+        assert r["results"][1]["value"] == b"v"
+        # remove then stat in one batch -> ENOENT for the stat
+        from ceph_tpu.client.rados import RadosError
+        with pytest.raises(RadosError):
+            await io.operate("brandnew",
+                             ObjectOperation().remove().stat())
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_rgw_overwrite_cleans_old_data():
+    """Regression: overwriting a striped object with a smaller body must
+    not serve the old tail, in either striped or unstriped form."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("rgw", pg_num=8)
+        gw = RGWLite(await rados.open_ioctx("rgw"))
+        await gw.create_bucket("b")
+        big = b"A" * (6 * 1024 * 1024)       # striped
+        small_striped = b"B" * (5 * 1024 * 1024)
+        tiny = b"C" * 100                     # unstriped
+        await gw.put_object("b", "k", big)
+        await gw.put_object("b", "k", small_striped)
+        got = await gw.get_object("b", "k")
+        assert got["data"] == small_striped   # no stale 1 MiB tail
+        await gw.put_object("b", "k", tiny)
+        got = await gw.get_object("b", "k")
+        assert got["data"] == tiny
+        # striped again after unstriped: old stripe xattrs are gone
+        await gw.put_object("b", "k", small_striped)
+        got = await gw.get_object("b", "k")
+        assert got["data"] == small_striped
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_cls_lock_shared_upgrade_blocked():
+    """Regression: a shared holder cannot take an exclusive lock while
+    other shared holders remain."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("meta", pg_num=4)
+        io = await rados.open_ioctx("meta")
+        await io.write_full("obj", b"x")
+        for who in ("client.a", "client.b"):
+            await io.exec("obj", "lock", "lock", json.dumps(
+                {"locker": who, "type": "shared"}
+            ).encode())
+        from ceph_tpu.client.rados import RadosError
+        with pytest.raises(RadosError):
+            await io.exec("obj", "lock", "lock", json.dumps(
+                {"locker": "client.a", "type": "exclusive"}
+            ).encode())
+        # after b unlocks, a CAN upgrade
+        await io.exec("obj", "lock", "unlock", json.dumps(
+            {"locker": "client.b"}
+        ).encode())
+        await io.exec("obj", "lock", "lock", json.dumps(
+            {"locker": "client.a", "type": "exclusive"}
+        ).encode())
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
