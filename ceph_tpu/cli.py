@@ -1,0 +1,302 @@
+"""The ``ceph``/``rados`` CLI surface.
+
+The reference ships ``ceph`` (src/ceph.in, a JSON command-protocol client
+of mon/mgr, command table src/mon/MonCommands.h) and ``rados`` (object
+IO). One entry point covers both here::
+
+    python -m ceph_tpu.cli --conf cluster.json status
+    python -m ceph_tpu.cli osd tree
+    python -m ceph_tpu.cli osd pool create mypool --pg-num 16
+    python -m ceph_tpu.cli osd erasure-code-profile set p1 k=4 m=2
+    python -m ceph_tpu.cli osd pool create ecpool --pool-type erasure \\
+        --profile p1
+    python -m ceph_tpu.cli config set osd_recovery_max_active 4
+    python -m ceph_tpu.cli rados -p mypool put objname ./file
+    python -m ceph_tpu.cli rados -p mypool ls
+
+``--conf`` points at the cluster file DevCluster.write_conf emits
+(default ``./cluster.json``); ``--format json`` switches the human output
+to raw JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ceph_tpu.client.rados import Rados, RadosError
+from ceph_tpu.common.config import ConfigProxy
+
+
+def _load_conf(path: str) -> tuple[dict, ConfigProxy]:
+    with open(path) as f:
+        doc = json.load(f)
+    return doc["monmap"], ConfigProxy(overrides=doc.get("overrides", {}))
+
+
+def _print(result, as_json: bool) -> None:
+    if as_json:
+        print(json.dumps(result, indent=2, default=str))
+        return
+    if isinstance(result, str):
+        print(result)
+    else:
+        print(json.dumps(result, indent=2, default=str))
+
+
+def _render_tree(tree: dict) -> str:
+    lines = ["ID   WEIGHT  TYPE NAME           STATUS  REWEIGHT"]
+
+    def walk(node: dict, depth: int) -> None:
+        indent = "    " * depth
+        if node.get("type") == "osd":
+            lines.append(
+                f"{node['id']:>3}          osd  {indent}{node['name']:<14} "
+                f"{node['status']:<7} {node['reweight']:.5f}"
+            )
+        else:
+            lines.append(
+                f"{node['id']:>3}          {node['type']:<4} "
+                f"{indent}{node['name']}"
+            )
+            for child in node.get("children", ()):
+                walk(child, depth + 1)
+
+    for root in tree.get("nodes", ()):
+        walk(root, 0)
+    return "\n".join(lines)
+
+
+def _render_status(st: dict) -> str:
+    om = st["osdmap"]
+    return "\n".join([
+        "  cluster:",
+        f"    health: {st['health']['status']}",
+        *(f"      {name}: {c['message']}"
+          for name, c in st["health"]["checks"].items()),
+        "  services:",
+        f"    mon: quorum {','.join(st['mon']['quorum'])}"
+        f" (leader {st['mon']['leader']})",
+        f"    osd: {om['num_osds']} osds: {om['num_up_osds']} up,"
+        f" {om['num_in_osds']} in",
+        "  data:",
+        f"    pools: {om['num_pools']}",
+        f"    osdmap epoch: {om['epoch']}",
+    ])
+
+
+async def _run(args) -> int:
+    monmap, conf = _load_conf(args.conf)
+    rados = Rados(monmap, conf, name="client.cli")
+    try:
+        await rados.connect(timeout=args.timeout)
+        return await _dispatch(args, rados)
+    finally:
+        await rados.shutdown()
+
+
+async def _mon(rados: Rados, prefix: str, as_json: bool,
+               render=None, **kw) -> int:
+    r = await rados.mon_command(prefix, **kw)
+    if r["rc"] != 0:
+        print(f"Error: {r['outs']} (rc={r['rc']})", file=sys.stderr)
+        return 1
+    out = r["data"] if r["data"] is not None else r["outs"]
+    if render is not None and not as_json and r["data"] is not None:
+        out = render(r["data"])
+    _print(out, as_json)
+    return 0
+
+
+async def _dispatch(args, rados: Rados) -> int:
+    j = args.format == "json"
+    cmd = args.cmd
+    if cmd == "status":
+        return await _mon(rados, "status", j, render=_render_status)
+    if cmd == "health":
+        return await _mon(rados, "health", j,
+                          render=lambda d: d["status"] + "".join(
+                              f"\n  {k}: {c['message']}"
+                              for k, c in d["checks"].items()))
+    if cmd == "quorum_status":
+        return await _mon(rados, "quorum_status", j)
+    if cmd == "mon":                      # mon dump
+        return await _mon(rados, "mon dump", j)
+    if cmd == "config":
+        if args.action == "set":
+            return await _mon(rados, "config set", j,
+                              name=args.name, value=args.value)
+        if args.action == "get":
+            return await _mon(rados, "config get", j, name=args.name)
+        if args.action == "rm":
+            return await _mon(rados, "config rm", j, name=args.name)
+        return await _mon(rados, "config dump", j)
+    if cmd == "osd":
+        return await _dispatch_osd(args, rados, j)
+    if cmd == "rados":
+        return await _dispatch_rados(args, rados, j)
+    print(f"unknown command {cmd!r}", file=sys.stderr)
+    return 2
+
+
+async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
+    a = args.action
+    if a == "tree":
+        return await _mon(rados, "osd tree", j, render=_render_tree)
+    if a == "dump":
+        return await _mon(rados, "osd dump", j)
+    if a == "stat":
+        return await _mon(rados, "osd stat", j)
+    if a in ("out", "in", "down"):
+        return await _mon(rados, f"osd {a}", j, ids=args.ids)
+    if a == "pool":
+        sub = args.sub
+        if sub == "create":
+            kw = {"pool": args.pool, "pg_num": args.pg_num}
+            if args.pool_type:
+                kw["pool_type"] = args.pool_type
+            if args.profile:
+                kw["erasure_code_profile"] = args.profile
+            if args.size:
+                kw["size"] = args.size
+            return await _mon(rados, "osd pool create", j, **kw)
+        if sub == "ls":
+            return await _mon(rados, "osd pool ls", j,
+                              render=lambda d: "\n".join(d))
+        if sub == "delete":
+            return await _mon(rados, "osd pool delete", j, pool=args.pool)
+        if sub == "get":
+            return await _mon(rados, "osd pool get", j, pool=args.pool)
+        if sub == "set":
+            return await _mon(rados, "osd pool set", j, pool=args.pool,
+                              var=args.var, val=args.val)
+    if a == "erasure-code-profile":
+        sub = args.sub
+        if sub == "set":
+            profile = dict(kv.split("=", 1) for kv in args.kv)
+            return await _mon(rados, "osd erasure-code-profile set", j,
+                              name=args.name, profile=profile)
+        if sub == "get":
+            return await _mon(rados, "osd erasure-code-profile get", j,
+                              name=args.name)
+        if sub == "ls":
+            return await _mon(rados, "osd erasure-code-profile ls", j,
+                              render=lambda d: "\n".join(d))
+        if sub == "rm":
+            return await _mon(rados, "osd erasure-code-profile rm", j,
+                              name=args.name)
+    print(f"unknown osd action {a!r}", file=sys.stderr)
+    return 2
+
+
+async def _dispatch_rados(args, rados: Rados, j: bool) -> int:
+    try:
+        io = await rados.open_ioctx(args.pool)
+        a = args.action
+        if a == "put":
+            data = (sys.stdin.buffer.read() if args.file == "-"
+                    else open(args.file, "rb").read())
+            await io.write_full(args.obj, data)
+            print(f"wrote {len(data)} bytes to {args.obj}")
+        elif a == "get":
+            data = await io.read(args.obj)
+            if args.file == "-":
+                sys.stdout.buffer.write(data)
+            else:
+                with open(args.file, "wb") as f:
+                    f.write(data)
+        elif a == "ls":
+            for name in await io.list_objects():
+                print(name)
+        elif a == "rm":
+            await io.remove(args.obj)
+        elif a == "stat":
+            _print(await io.stat(args.obj), j)
+        else:
+            print(f"unknown rados action {a!r}", file=sys.stderr)
+            return 2
+        return 0
+    except RadosError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ceph-tpu")
+    p.add_argument("--conf", default="cluster.json",
+                   help="cluster conf file (DevCluster.write_conf)")
+    p.add_argument("--format", choices=["plain", "json"], default="plain")
+    p.add_argument("--timeout", type=float, default=15.0)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    sub.add_parser("health")
+    sub.add_parser("quorum_status")
+    sub.add_parser("mon")
+
+    conf = sub.add_parser("config")
+    conf_sub = conf.add_subparsers(dest="action", required=True)
+    cs = conf_sub.add_parser("set")
+    cs.add_argument("name")
+    cs.add_argument("value")
+    for name in ("get", "rm"):
+        c = conf_sub.add_parser(name)
+        c.add_argument("name")
+    conf_sub.add_parser("dump")
+
+    osd = sub.add_parser("osd")
+    osd_sub = osd.add_subparsers(dest="action", required=True)
+    for name in ("tree", "dump", "stat"):
+        osd_sub.add_parser(name)
+    for name in ("out", "in", "down"):
+        o = osd_sub.add_parser(name)
+        o.add_argument("ids", type=int, nargs="+")
+    pool = osd_sub.add_parser("pool")
+    pool_sub = pool.add_subparsers(dest="sub", required=True)
+    pc = pool_sub.add_parser("create")
+    pc.add_argument("pool")
+    pc.add_argument("--pg-num", type=int, default=32, dest="pg_num")
+    pc.add_argument("--pool-type", default="", dest="pool_type")
+    pc.add_argument("--profile", default="")
+    pc.add_argument("--size", type=int, default=0)
+    pool_sub.add_parser("ls")
+    for name in ("delete", "get"):
+        pp = pool_sub.add_parser(name)
+        pp.add_argument("pool")
+    ps = pool_sub.add_parser("set")
+    ps.add_argument("pool")
+    ps.add_argument("var")
+    ps.add_argument("val")
+    prof = osd_sub.add_parser("erasure-code-profile")
+    prof_sub = prof.add_subparsers(dest="sub", required=True)
+    pfs = prof_sub.add_parser("set")
+    pfs.add_argument("name")
+    pfs.add_argument("kv", nargs="*", help="k=v pairs")
+    for name in ("get", "rm"):
+        pf = prof_sub.add_parser(name)
+        pf.add_argument("name")
+    prof_sub.add_parser("ls")
+
+    rados_p = sub.add_parser("rados")
+    rados_p.add_argument("-p", "--pool", required=True)
+    rados_sub = rados_p.add_subparsers(dest="action", required=True)
+    for name in ("put", "get"):
+        r = rados_sub.add_parser(name)
+        r.add_argument("obj")
+        r.add_argument("file")
+    rados_sub.add_parser("ls")
+    rm = rados_sub.add_parser("rm")
+    rm.add_argument("obj")
+    st = rados_sub.add_parser("stat")
+    st.add_argument("obj")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return asyncio.run(_run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -635,22 +635,25 @@ class OSDDaemon:
                 await self._do_special_op(conn, pg, str(d["oid"]),
                                           ops[0], tid)
                 return
-            # counted only once we actually execute (misdirected resends
-            # and re-queued waiters must not inflate the counters)
-            self.perf.inc("op")
-            for op in ops:
-                kind = op.get("op", "")
-                if kind in ("read", "stat", "getxattr", "getxattrs",
-                            "omap_get"):
-                    self.perf.inc("op_r")
-                elif kind in ("write", "writefull", "append", "truncate",
-                              "remove", "create", "setxattr", "omap_set"):
-                    self.perf.inc("op_w")
-                if isinstance(op.get("data"), (bytes, bytearray)):
-                    self.perf.inc("op_in_bytes", len(op["data"]))
             rc, results, version = await self._do_ops(
                 pg, str(d["oid"]), ops
             )
+            # counted on completion only (misdirected resends, re-queued
+            # waiters, and failed batches must not inflate the counters)
+            self.perf.inc("op")
+            if rc == OK:
+                for op in ops:
+                    kind = op.get("op", "")
+                    if kind in ("read", "stat", "getxattr", "getxattrs",
+                                "omap_get"):
+                        self.perf.inc("op_r")
+                    elif kind in ("write", "writefull", "append",
+                                  "truncate", "remove", "create",
+                                  "setxattr", "rmxattr", "omap_set",
+                                  "omap_rm", "call"):
+                        self.perf.inc("op_w")
+                    if isinstance(op.get("data"), (bytes, bytearray)):
+                        self.perf.inc("op_in_bytes", len(op["data"]))
             for res in results:
                 if isinstance(res.get("data"), (bytes, bytearray)):
                     self.perf.inc("op_out_bytes", len(res["data"]))
@@ -760,6 +763,24 @@ class OSDDaemon:
                     meta = await be._read_meta(oid)
                     off = meta.size if meta else 0
                     meta = await be.write(oid, op["data"], off)
+                    version = meta.version
+                    results.append({})
+                elif kind == "truncate":
+                    # overwrite-capable EC pools support truncate; shrink
+                    # is read-back + rewrite (stripe bounds change)
+                    nsize = int(op["size"])
+                    meta = await be._read_meta(oid)
+                    cur = meta.size if meta else 0
+                    if nsize < cur:
+                        keep = await be.read(oid, 0, nsize)
+                        await be.remove(oid)
+                        meta = await be.write(oid, keep, 0)
+                    elif nsize > cur:
+                        meta = await be.write(
+                            oid, b"\0" * (nsize - cur), cur
+                        )
+                    elif meta is None:
+                        meta = await be.write(oid, b"", 0)
                     version = meta.version
                     results.append({})
                 elif kind == "read":
@@ -875,8 +896,8 @@ class OSDDaemon:
                 return None
             if key in oxattrs:
                 return oxattrs[key]
-            if not exists:
-                return None
+            if wiped or not exists:
+                return None     # store xattrs die with a remove/writefull
             try:
                 return self.store.getattr(cid, obj, key)
             except KeyError:

@@ -125,6 +125,7 @@ class ECBackend:
         if set(self.shards) != set(range(self.n)):
             raise ValueError(f"need shards 0..{self.n - 1}")
         self._object_locks: dict[str, tuple[asyncio.Lock, int]] = {}
+        self._repair_tasks: set[asyncio.Task] = set()
 
     def _lock(self, oid: str):
         """Per-object write lock, refcounted so the table doesn't grow
@@ -218,7 +219,11 @@ class ECBackend:
                 bytes(data), np.uint8
             )
             stripes = self.sinfo.split_stripes(buf)
-            chunks = np.asarray(self.ec.encode_chunks_batch(stripes))
+            # device encode off the event loop: a first-time XLA
+            # compile must not stall heartbeats/leases in this process
+            chunks = np.asarray(await asyncio.to_thread(
+                self.ec.encode_chunks_batch, stripes
+            ))
             shard_bytes = self.sinfo.shard_bytes(chunks)
             shard_off = self.sinfo.logical_to_prev_chunk_offset(a_start)
             meta_attr = self._meta_attr(ECObjectMeta(new_size, new_version))
@@ -253,7 +258,9 @@ class ECBackend:
             except (ShardReadError, IOError, KeyError):
                 pass        # shard still down; peering recovery will heal
 
-        asyncio.get_running_loop().create_task(repair())
+        task = asyncio.get_running_loop().create_task(repair())
+        self._repair_tasks.add(task)
+        task.add_done_callback(self._repair_tasks.discard)
 
     async def _update_hinfo(self, oid: str, shard_off: int,
                             shard_bytes: list[np.ndarray],
@@ -398,7 +405,9 @@ class ECBackend:
             s: arr.reshape(nstripes, self.sinfo.chunk_size)
             for s, arr in have.items()
         }
-        out = self.ec.decode_chunks_batch(batched, list(missing))
+        out = await asyncio.to_thread(
+            self.ec.decode_chunks_batch, batched, list(missing)
+        )
         chunks = {}
         for i in range(self.k):
             if i in have:
@@ -509,7 +518,9 @@ class ECBackend:
             s: arr.reshape(nstripes, self.sinfo.chunk_size)
             for s, arr in zip(need, reads)
         }
-        out = self.ec.decode_chunks_batch(batched, lost)
+        out = await asyncio.to_thread(
+            self.ec.decode_chunks_batch, batched, lost
+        )
         good = next(iter(need))
         meta_raw = await self.shards[good].get_attr(oid, VERSION_ATTR)
         hinfo_raw = await self.shards[good].get_attr(oid, HINFO_ATTR)
@@ -538,7 +549,9 @@ class ECBackend:
             [reads[i].reshape(nstripes, self.sinfo.chunk_size)
              for i in range(self.k)], axis=1,
         )
-        recomputed = np.asarray(self.ec.encode_chunks_batch(stripes))
+        recomputed = np.asarray(await asyncio.to_thread(
+            self.ec.encode_chunks_batch, stripes
+        ))
         inconsistent = []
         for i in range(self.k, self.n):
             stored = reads[i].reshape(nstripes, self.sinfo.chunk_size)

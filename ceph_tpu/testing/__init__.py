@@ -1,0 +1,11 @@
+"""Hardening harnesses.
+
+The reference's QA machinery (qa/tasks/ceph_manager.py Thrasher,
+src/test/osd/RadosModel.h model-based op generator) as in-process
+tools driving a DevCluster.
+"""
+
+from ceph_tpu.testing.rados_model import RadosModel
+from ceph_tpu.testing.thrasher import Thrasher
+
+__all__ = ["RadosModel", "Thrasher"]

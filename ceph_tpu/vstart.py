@@ -1,0 +1,129 @@
+"""DevCluster: the in-process vstart.
+
+The reference's src/vstart.sh (1,554 LoC of shell) spins a dev cluster of
+real daemons in a temp dir. Here one object boots monitors + OSDs inside
+the current event loop — over ``local://`` queue transports by default or
+real TCP sockets — hands out connected clients, and can kill/revive
+daemons (the hooks the Thrasher drives). ``write_conf`` emits the
+cluster-connection file the CLI reads.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.mon.monitor import Monitor
+from ceph_tpu.osd.daemon import OSDDaemon
+from ceph_tpu.store import MemStore
+
+FAST_TEST_OVERRIDES = {
+    "mon_lease": 0.4, "mon_lease_interval": 0.1,
+    "mon_election_timeout": 0.3, "mon_tick_interval": 0.1,
+    "mon_accept_timeout": 0.5,
+    # grace must tolerate a first-time XLA compile stalling the shared
+    # in-process event loop; failure-detection tests override it
+    "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 3.0,
+}
+
+
+class DevCluster:
+    def __init__(self, n_mons: int = 1, n_osds: int = 3,
+                 overrides: dict | None = None, tcp: bool = False,
+                 base_port: int = 21000, store_dir: str | None = None):
+        self.n_mons = n_mons
+        self.n_osds = n_osds
+        self.overrides = dict(FAST_TEST_OVERRIDES)
+        self.overrides.update(overrides or {})
+        self.tcp = tcp
+        self.base_port = base_port
+        self.store_dir = store_dir
+        mon_names = [chr(ord("a") + i) for i in range(n_mons)]
+        if tcp:
+            self.monmap = {
+                n: f"tcp://127.0.0.1:{base_port + i}"
+                for i, n in enumerate(mon_names)
+            }
+        else:
+            self.monmap = {n: f"local://mon.{n}" for n in mon_names}
+        self.mons: dict[str, Monitor] = {}
+        self.osds: dict[int, OSDDaemon] = {}
+        self._osd_stores: dict[int, MemStore] = {}
+
+    def conf(self) -> ConfigProxy:
+        return ConfigProxy(overrides=dict(self.overrides))
+
+    def _osd_addr(self, osd_id: int) -> str | None:
+        if self.tcp:
+            return f"tcp://127.0.0.1:{self.base_port + 100 + osd_id}"
+        return None
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        for i, name in enumerate(self.monmap):
+            path = (f"{self.store_dir}/mon.{name}"
+                    if self.store_dir else None)
+            mon = Monitor(name, self.monmap, self.conf(), store_path=path)
+            await mon.start()
+            self.mons[name] = mon
+        for i in range(self.n_osds):
+            await self.start_osd(i)
+
+    async def start_osd(self, osd_id: int) -> OSDDaemon:
+        store = self._osd_stores.setdefault(osd_id, MemStore())
+        osd = OSDDaemon(
+            osd_id, self.monmap, self.conf(), store=store,
+            addr=self._osd_addr(osd_id), host=f"host{osd_id}",
+        )
+        await osd.start()
+        self.osds[osd_id] = osd
+        return osd
+
+    async def kill_osd(self, osd_id: int) -> None:
+        """Hard-stop a daemon; its store survives for revive (the
+        Thrasher kill_osd hook, qa/tasks/ceph_manager.py:248)."""
+        osd = self.osds.pop(osd_id, None)
+        if osd is not None:
+            await osd.shutdown()
+
+    async def revive_osd(self, osd_id: int) -> OSDDaemon:
+        """Restart with the surviving store (revive_osd :480)."""
+        return await self.start_osd(osd_id)
+
+    async def stop(self) -> None:
+        for osd in list(self.osds.values()):
+            await osd.shutdown()
+        self.osds.clear()
+        for mon in self.mons.values():
+            await mon.shutdown()
+        self.mons.clear()
+
+    # -- clients -----------------------------------------------------------
+    async def client(self, name: str = "client.admin") -> Rados:
+        rados = Rados(self.monmap, self.conf(), name=name)
+        await rados.connect()
+        return rados
+
+    async def wait_health_ok(self, timeout: float = 20.0) -> None:
+        import asyncio
+        rados = await self.client("client.health")
+        try:
+            deadline = asyncio.get_running_loop().time() + timeout
+            while True:
+                r = await rados.mon_command("health")
+                if r["rc"] == 0 and r["data"]["status"] == "HEALTH_OK":
+                    return
+                if asyncio.get_running_loop().time() > deadline:
+                    raise TimeoutError(f"health never OK: {r['data']}")
+                await asyncio.sleep(0.1)
+        finally:
+            await rados.shutdown()
+
+    # -- CLI handoff -------------------------------------------------------
+    def write_conf(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({
+                "monmap": self.monmap,
+                "overrides": self.overrides,
+            }, f, indent=2)

@@ -231,8 +231,8 @@ def test_degraded_write_stale_shard_not_served():
         meta = await be.write("o", v2)      # degraded write succeeds
         assert meta.version == 2
         # eager repair was scheduled but cannot fix shard 1 while down;
-        # wait for it to give up
-        await asyncio.sleep(0.05)
+        # wait for it to give up BEFORE reviving the shard
+        await asyncio.gather(*be._repair_tasks, return_exceptions=True)
         assert await be.read("o") == v2     # NOT a v1/v2 mix
         # shard comes back (stale): still must not be served
         be._test_shards[1].down = False

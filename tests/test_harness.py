@@ -1,0 +1,171 @@
+"""DevCluster, CLI, Thrasher, and the model-based random op tester."""
+
+import asyncio
+import io as io_mod
+import json
+import sys
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.testing import RadosModel, Thrasher
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def test_devcluster_boot_and_health():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        await cluster.wait_health_ok()
+        rados = await cluster.client()
+        await rados.pool_create("p", pg_num=4)
+        io = await rados.open_ioctx("p")
+        await io.write_full("o", b"hello")
+        assert await io.read("o") == b"hello"
+        # kill + revive round trip
+        await cluster.kill_osd(2)
+        await cluster.revive_osd(2)
+        await cluster.wait_health_ok()
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    from ceph_tpu import cli
+
+    async def run():
+        # TCP transport: the CLI runs its own event loop in a thread, and
+        # in-process local:// queues cannot cross loops
+        cluster = DevCluster(n_mons=1, n_osds=3, tcp=True,
+                             base_port=21500)
+        await cluster.start()
+        conf_path = str(tmp_path / "cluster.json")
+        cluster.write_conf(conf_path)
+        async def ceph(*argv):
+            # the CLI runs its own loop; to_thread keeps THIS loop (and
+            # the cluster daemons in it) serving while the CLI talks
+            rc = await asyncio.to_thread(
+                cli.main, ["--conf", conf_path, *argv]
+            )
+            out = capsys.readouterr().out
+            return rc, out
+
+        rc, out = await ceph("status")
+        assert rc == 0 and "health: HEALTH_OK" in out and "3 up" in out
+        rc, out = await ceph("osd", "pool", "create", "clipool",
+                             "--pg-num", "8")
+        assert rc == 0
+        rc, out = await ceph("osd", "pool", "ls")
+        assert rc == 0 and "clipool" in out
+        rc, out = await ceph("osd", "erasure-code-profile", "set",
+                             "p1", "k=2", "m=1")
+        assert rc == 0
+        rc, out = await ceph("--format", "json", "osd",
+                             "erasure-code-profile", "get", "p1")
+        assert rc == 0 and json.loads(out)["k"] == "2"
+        rc, out = await ceph("osd", "tree")
+        assert rc == 0 and "host0" in out and "osd.0" in out
+        rc, out = await ceph("config", "set",
+                             "osd_recovery_max_active", "4")
+        assert rc == 0
+        rc, out = await ceph("config", "get", "osd_recovery_max_active")
+        assert rc == 0 and "4" in out
+        # rados put/get/ls through the CLI
+        src = tmp_path / "payload.bin"
+        src.write_bytes(b"cli-payload")
+        rc, out = await ceph("rados", "-p", "clipool", "put", "obj",
+                             str(src))
+        assert rc == 0
+        rc, out = await ceph("rados", "-p", "clipool", "ls")
+        assert rc == 0 and "obj" in out
+        dst = tmp_path / "out.bin"
+        rc, out = await ceph("rados", "-p", "clipool", "get", "obj",
+                             str(dst))
+        assert rc == 0 and dst.read_bytes() == b"cli-payload"
+        rc, out = await ceph("--format", "json", "osd", "stat")
+        assert rc == 0 and json.loads(out)["num_up_osds"] == 3
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_rados_model_replicated_quiet():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        await rados.pool_create("model", pg_num=8, size=3, min_size=2)
+        io = await rados.open_ioctx("model")
+        model = RadosModel(io, seed=7, n_objects=12)
+        await model.run(150)
+        verified = await model.verify_all()
+        assert model.checks > 10 and verified == len(model.model)
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_rados_model_ec_pool():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=4)
+        await cluster.start()
+        rados = await cluster.client()
+        r = await rados.mon_command(
+            "osd erasure-code-profile set", name="m21",
+            profile={"plugin": "jax_rs", "k": "2", "m": "1",
+                     "crush-failure-domain": "osd"},
+        )
+        assert r["rc"] == 0
+        await rados.pool_create("ecmodel", pool_type="erasure",
+                                erasure_code_profile="m21", pg_num=4)
+        io = await rados.open_ioctx("ecmodel")
+        model = RadosModel(io, seed=11, n_objects=8, max_size=1 << 14,
+                           ec=True)
+        await model.run(80)
+        verified = await model.verify_all()
+        assert verified == len(model.model)
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_rados_model_under_thrashing():
+    """The headline hardening test: random ops with an oracle while the
+    thrasher kills and revives OSDs (thrash-erasure-code suite role)."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=4, overrides={
+            "mon_osd_down_out_interval": 300.0,   # no auto-out churn
+        })
+        await cluster.start()
+        rados = await cluster.client()
+        await rados.pool_create("thrash", pg_num=8, size=3, min_size=2)
+        io = await rados.open_ioctx("thrash")
+        model = RadosModel(io, seed=3, n_objects=10, max_size=1 << 14)
+        await model.run(20)                   # seed some state quietly
+        thrasher = Thrasher(cluster, min_live=3, down_interval=0.2,
+                            revive_delay=0.4, seed=5)
+        thrasher.start()
+        try:
+            # keep operating until chaos actually happened
+            for _ in range(40):
+                await model.run(15)
+                if thrasher.kills >= 2 and model.ops_done >= 120:
+                    break
+        finally:
+            await thrasher.stop(revive_all=True)
+        assert thrasher.kills >= 2, thrasher.kills
+        await cluster.wait_health_ok(timeout=30)
+        # let recovery settle, then the full sweep must match the oracle
+        await asyncio.sleep(1.0)
+        verified = await model.verify_all()
+        assert verified == len(model.model)
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
