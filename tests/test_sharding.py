@@ -53,3 +53,10 @@ def test_mesh_validation():
     G = matrix.generator_matrix("reed_sol_van", 4, 1)  # k+m=5 not divisible
     with pytest.raises(ValueError):
         distributed_ec_step(mesh, G, _rand((8, 4, 128)))
+
+
+def test_graft_entry_dryrun_body_on_virtual_mesh():
+    """The driver-graded dryrun path must run on the 8-device CPU mesh."""
+    import __graft_entry__ as graft
+
+    graft._dryrun_body(8)
