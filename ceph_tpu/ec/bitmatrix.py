@@ -40,6 +40,32 @@ def gf_matrix_to_bitmatrix(A: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(out.astype(np.uint8))
 
 
+def expand_bitmatrix_lanes(BM: np.ndarray, lane_bytes: int = 4) -> np.ndarray:
+    """(8m, 8k) bitmatrix -> (8L*m, 8L*k) block matrix for L-byte int lanes.
+
+    When chunk bytes ride packed L-to-a-lane in integer registers (uint8
+    buffers viewed as int32 words), bit p of byte b of chunk i lives at bit
+    8b+p of lane word i.  Byte positions never mix, so the lane-level GF(2)
+    matrix is block-diagonal over b:
+
+        out[8L*j + 8b + q, 8L*i + 8b + p] = BM[8j+q, 8i+p]
+
+    This is what turns the (8m x 8k) bitmatrix into a (32m x 32k) matmul
+    whose contraction dim fills the 128-wide MXU for k=8 (the utilization
+    fix for the small-matrix problem of per-byte bitplanes).
+    """
+    BM = np.asarray(BM, np.uint8)
+    m8, k8 = BM.shape
+    B4 = BM.reshape(m8 // 8, 8, k8 // 8, 8)  # (j, q, i, p)
+    eye = np.eye(lane_bytes, dtype=np.uint8)  # (b, b')
+    # out[j, b, q, i, b', p]
+    out = np.einsum("jqip,bc->jbqicp", B4, eye)
+    L8 = 8 * lane_bytes
+    return np.ascontiguousarray(
+        out.reshape(m8 // 8 * L8, k8 // 8 * L8).astype(np.uint8)
+    )
+
+
 def bytes_to_bitplanes(data: np.ndarray) -> np.ndarray:
     """(..., k, C) uint8 -> (..., 8k, C) 0/1 uint8, rows ordered c*8+j."""
     data = np.asarray(data, np.uint8)

@@ -111,16 +111,61 @@ class BitplaneEngine:
 
     def apply(self, coeff: np.ndarray, data) -> jax.Array:
         """Apply a GF(2^8) coefficient matrix (m, k) to data (B, k, C)."""
-        from ceph_tpu.ec.pallas_kernels import LANE
+        from ceph_tpu.ec.pallas_kernels import (
+            LANE_BYTES,
+            shard_kernel_supported,
+        )
 
         coeff = np.asarray(coeff, np.uint8)
         data = jnp.asarray(data, jnp.uint8)
-        if self.use_pallas and data.shape[-1] % LANE == 0:
+        if (
+            self.use_pallas
+            and data.shape[-1] % LANE_BYTES == 0
+            and shard_kernel_supported(coeff.shape[1], coeff.shape[0])
+        ):
             return self._pallas_applier(coeff)(data)
         mat = self._device_bitmatrix(coeff)
         if data.ndim == 2:
             return _apply_bitmatrix(mat, data[None])[0]
         return _apply_bitmatrix(mat, data)
+
+    def apply_shards(self, coeff: np.ndarray, data) -> jax.Array:
+        """Apply (m, k) coefficients to shard-layout data (k, N) -> (m, N).
+
+        Shard layout = chunk row i is shard i's contiguous byte stream
+        (chunk i of stripe s at columns [s*C, (s+1)*C) — the ECUtil
+        stripe decomposition, reference ECUtil.h:28-65).  The Pallas fast
+        path runs on this layout natively with no transpose.
+        """
+        return self.apply(coeff, data)
+
+    def apply_words(self, coeff: np.ndarray, words) -> jax.Array:
+        """Word-typed hot path: (k, N4) int32 lanes -> (m, N4) int32.
+
+        Device-resident buffers stay int32 end-to-end (no uint8 relayout
+        pass); use pallas_kernels.bytes_to_words/words_to_bytes at the
+        boundaries."""
+        from ceph_tpu.ec.pallas_kernels import (
+            bytes_to_words,
+            shard_kernel_supported,
+            words_to_bytes,
+        )
+
+        coeff = np.asarray(coeff, np.uint8)
+        if self.use_pallas and shard_kernel_supported(
+            coeff.shape[1], coeff.shape[0]
+        ):
+            return self._pallas_applier(coeff).apply_words(words)
+        mat = self._device_bitmatrix(coeff)
+        by = words_to_bytes(jnp.asarray(words))
+        return bytes_to_words(_apply_bitmatrix(mat, by[None])[0])
+
+    def encode_shards(self, generator: np.ndarray, data) -> jax.Array:
+        """Systematic shard-layout encode: (k, N) -> (k+m, N)."""
+        k = generator.shape[1]
+        data = jnp.asarray(data, jnp.uint8)
+        parity = self.apply_shards(generator[k:], data)
+        return jnp.concatenate([data, parity], axis=0)
 
     def encode(self, generator: np.ndarray, data) -> jax.Array:
         """Systematic encode: (B, k, C) -> (B, k+m, C) (data || parity)."""

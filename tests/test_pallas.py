@@ -44,11 +44,44 @@ def test_pallas_decode_matrix_bit_identical():
         assert np.array_equal(got[i], chunks[w])
 
 
-def test_pallas_unaligned_chunk_rejected():
+def test_pallas_unaligned_chunk():
     G = matrix.generator_matrix("reed_sol_van", 4, 2)
     ap = PallasBitplaneApply(G[4:], interpret=True)
+    # Not a multiple of the 4-byte lane: rejected.
     with pytest.raises(ValueError):
-        ap(_rand((4, 100)))
+        ap(_rand((4, 101)))
+    # Multiple of 4 but not of the 128-lane tile: padded internally.
+    data = _rand((4, 100))
+    got = np.asarray(ap(data))
+    assert np.array_equal(got, reference.encode(G, data)[4:])
+
+
+def test_pallas_shard_layout_matches_per_stripe():
+    """(k, B*C) shard-stream layout == per-stripe encode, column for column."""
+    k, m, B, C = 8, 4, 5, 256
+    G = matrix.generator_matrix("reed_sol_van", k, m)
+    stripes = _rand((B, k, C), seed=17)
+    # shard stream: chunk i of stripe s at columns [s*C, (s+1)*C)
+    shard_stream = np.transpose(stripes, (1, 0, 2)).reshape(k, B * C)
+    ap = PallasBitplaneApply(G[k:], interpret=True)
+    got = np.asarray(ap(shard_stream))
+    for s in range(B):
+        expect = reference.encode(G, stripes[s])[k:]
+        assert np.array_equal(got[:, s * C:(s + 1) * C], expect)
+
+
+def test_pallas_word_path_bit_identical():
+    from ceph_tpu.ec.pallas_kernels import bytes_to_words, words_to_bytes
+
+    k, m = 8, 4
+    G = matrix.generator_matrix("cauchy_good", k, m)
+    data = _rand((k, 512), seed=23)
+    ap = PallasBitplaneApply(G[k:], interpret=True)
+    words = bytes_to_words(data)
+    out = words_to_bytes(ap.apply_words(words))
+    assert np.array_equal(np.asarray(out), reference.encode(G, data)[k:])
+    # round trip of the word view itself
+    assert np.array_equal(np.asarray(words_to_bytes(words)), data)
 
 
 def test_engine_pallas_flag_matches_einsum():
