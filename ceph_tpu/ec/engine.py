@@ -129,16 +129,6 @@ class BitplaneEngine:
             return _apply_bitmatrix(mat, data[None])[0]
         return _apply_bitmatrix(mat, data)
 
-    def apply_shards(self, coeff: np.ndarray, data) -> jax.Array:
-        """Apply (m, k) coefficients to shard-layout data (k, N) -> (m, N).
-
-        Shard layout = chunk row i is shard i's contiguous byte stream
-        (chunk i of stripe s at columns [s*C, (s+1)*C) — the ECUtil
-        stripe decomposition, reference ECUtil.h:28-65).  The Pallas fast
-        path runs on this layout natively with no transpose.
-        """
-        return self.apply(coeff, data)
-
     def apply_words(self, coeff: np.ndarray, words) -> jax.Array:
         """Word-typed hot path: (k, N4) int32 lanes -> (m, N4) int32.
 
@@ -161,10 +151,16 @@ class BitplaneEngine:
         return bytes_to_words(_apply_bitmatrix(mat, by[None])[0])
 
     def encode_shards(self, generator: np.ndarray, data) -> jax.Array:
-        """Systematic shard-layout encode: (k, N) -> (k+m, N)."""
+        """Systematic shard-layout encode: (k, N) -> (k+m, N).
+
+        Shard layout = chunk row i is shard i's contiguous byte stream
+        (chunk i of stripe s at columns [s*C, (s+1)*C) — the ECUtil
+        stripe decomposition, reference ECUtil.h:28-65).  The Pallas fast
+        path runs on this layout natively with no transpose.
+        """
         k = generator.shape[1]
         data = jnp.asarray(data, jnp.uint8)
-        parity = self.apply_shards(generator[k:], data)
+        parity = self.apply(generator[k:], data)
         return jnp.concatenate([data, parity], axis=0)
 
     def encode(self, generator: np.ndarray, data) -> jax.Array:

@@ -136,9 +136,13 @@ class PallasShardApply:
         self.interpret = interpret
 
     def _bm32_arg(self):
-        from jax._src.core import trace_state_clean
+        try:
+            from jax._src.core import trace_state_clean
 
-        if trace_state_clean():
+            outside_trace = trace_state_clean()
+        except ImportError:  # private API moved: fall back, always correct
+            outside_trace = False
+        if outside_trace:
             if self._bm32_dev is None:
                 self._bm32_dev = jnp.asarray(self.bm32)
             return self._bm32_dev

@@ -103,6 +103,29 @@ class ErasureCodeJaxRS(ErasureCode):
         buffers)."""
         return self._engine.encode(self.generator, data)
 
+    def encode_shards_device(self, data):
+        """Shard-stream encode: (k, N) uint8 device array -> (k+m, N)."""
+        return self._engine.encode_shards(self.generator, data)
+
+    def encode_words_device(self, words):
+        """Word-typed hot path: (k, N4) int32 shard lanes -> (m, N4) parity
+        lanes, no uint8 relayout (pallas_kernels.bytes_to_words view)."""
+        return self._engine.apply_words(self.generator[self.k:], words)
+
+    def decode_words_device(self, available, want_to_read):
+        """Word-typed reconstruct: available maps chunk id -> (N4,) int32
+        lane arrays; returns (len(want), N4) int32."""
+        import jax.numpy as jnp
+
+        want = [int(w) for w in want_to_read]
+        avail_ids = sorted(int(i) for i in available)
+        if len(avail_ids) < self.k:
+            raise IOError(f"cannot decode {want}")
+        survivors = tuple(avail_ids[: self.k])
+        D = self._decode_matrix(survivors, tuple(want))
+        stacked = jnp.stack([available[s] for s in survivors], axis=0)
+        return self._engine.apply_words(D, stacked)
+
     def decode_chunks_device(self, available, want_to_read):
         """Batched device-resident reconstruct: available maps chunk id ->
         (B, C) device arrays; returns (B, len(want), C) device array."""
