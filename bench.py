@@ -69,6 +69,74 @@ def _recovery_latency_ms(ec, stripes: int = 1024) -> float:
     return dec["seconds"] * 1e3
 
 
+def _clay_repair_gibps(stripes: int = 16, sc: int = 1024) -> float:
+    """cfg4 single-chip: CLAY k=8 m=4 d=11 repair as one device apply of
+    the probed repair operator (recovered bytes per second; helper reads
+    are d*sub/q = 11/4 of the recovered volume)."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec.benchmark import device_seconds_per_iter
+    from ceph_tpu.ec.engine import default_engine
+    from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+    from ceph_tpu.ec.repair_operator import clay_repair_operator
+
+    ec = ErasureCodePluginRegistry().factory(
+        "clay", {"k": "8", "m": "4", "d": "11"}
+    )
+    C = ec.sub_chunk_no * sc
+    data = np.random.default_rng(7).integers(
+        0, 256, (stripes, ec.k, C), np.uint8
+    )
+    chunks = np.asarray(ec.encode_chunks_batch(data))
+    lost = 3
+    R, helpers, planes = clay_repair_operator(ec, lost)
+    flat = np.stack([
+        chunks[:, h].reshape(stripes, ec.sub_chunk_no, sc)[:, planes]
+        for h in helpers
+    ], axis=1).reshape(stripes, len(helpers) * len(planes), sc)
+    eng = default_engine()
+    dev = jnp.asarray(flat)
+
+    def step(i, x):
+        rec = eng.apply(R, x)
+        return x.at[0, 0, 0].set(rec[0, 0, 0] ^ i.astype(jnp.uint8))
+
+    sec = device_seconds_per_iter(step, dev, lo=8, hi=40)
+    return stripes * C / sec / 2**30
+
+
+def _lrc_repair_gibps(stripes: int = 64, C: int = 1 << 20) -> float:
+    """cfg5 single-chip: LRC k=12 m=4 local-group repair (one coefficient
+    row over the l group members) — recovered bytes per second."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec.benchmark import device_seconds_per_iter
+    from ceph_tpu.ec.engine import default_engine
+    from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+    from ceph_tpu.ec.repair_operator import lrc_repair_operator
+
+    from ceph_tpu.ec.pallas_kernels import bytes_to_words
+
+    ec = ErasureCodePluginRegistry().factory(
+        "lrc", {"k": "12", "m": "4", "l": "4"}
+    )
+    lost = 0
+    coeffs, minimum = lrc_repair_operator(ec, lost)
+    # Shard layout: each group member's stream is one contiguous row.
+    group = np.random.default_rng(9).integers(
+        0, 256, (len(minimum), stripes * C), np.uint8
+    )
+    eng = default_engine()
+    words = bytes_to_words(jnp.asarray(group))
+
+    def step(i, x):
+        rec = eng.apply_words(coeffs, x)
+        return x.at[0, 0].set(rec[0, 0] ^ i)
+
+    sec = device_seconds_per_iter(step, words, lo=8, hi=40)
+    return stripes * C / sec / 2**30
+
+
 def main() -> None:
     from ceph_tpu.ec.benchmark import make_codec, run_encode, run_decode, \
         verify_all_erasures
@@ -104,6 +172,11 @@ def main() -> None:
                       erasures=4)
     extra["cfg3_encode_gibps"] = round(enc3["GiBps"], 3)
     extra["cfg3_decode_gibps"] = round(dec3["GiBps"], 3)
+
+    # cfg4/cfg5 single-chip repair (mesh versions run in dryrun_multichip
+    # and tests/test_sharding.py).
+    extra["cfg4_clay_repair_gibps"] = round(_clay_repair_gibps(), 3)
+    extra["cfg5_lrc_repair_gibps"] = round(_lrc_repair_gibps(), 3)
 
     print(
         json.dumps(

@@ -1,0 +1,22 @@
+"""Small JAX helpers shared by the engine and kernels."""
+
+from __future__ import annotations
+
+try:  # private API; resolved once at import so the probe is cheap
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except ImportError:  # pragma: no cover - depends on jax version
+    _trace_state_clean = None
+
+
+def outside_trace() -> bool:
+    """True when no jit/vmap/shard_map trace is active.
+
+    Device-array caches must only be populated outside a trace (a cached
+    tracer poisons later traces); inside a trace the caller should embed
+    the value as a constant instead.  If the probe is unavailable on this
+    jax version, report False — the constant path is always correct, just
+    uncached.
+    """
+    if _trace_state_clean is None:
+        return False
+    return _trace_state_clean()

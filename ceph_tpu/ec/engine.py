@@ -81,6 +81,7 @@ class BitplaneEngine:
                  use_pallas: bool | None = None):
         self._max = max_cached_matrices
         self._cache: dict[bytes, jax.Array] = {}
+        self._np_cache: dict[bytes, np.ndarray] = {}
         self._pallas_cache: dict[bytes, object] = {}
         self.use_pallas = (
             _default_use_pallas() if use_pallas is None else use_pallas
@@ -98,10 +99,19 @@ class BitplaneEngine:
         return hit
 
     def _device_bitmatrix(self, coeff: np.ndarray) -> jax.Array:
+        from ceph_tpu.common.jaxutil import outside_trace
+
+        np_bits = self._cached(
+            self._np_cache, coeff, bm.gf_matrix_to_bitmatrix
+        )
+        if not outside_trace():
+            # Inside an outer trace: embed as a constant; caching a tracer
+            # would poison later traces.
+            return jnp.asarray(np_bits, jnp.bfloat16)
         return self._cached(
             self._cache,
             coeff,
-            lambda c: jnp.asarray(bm.gf_matrix_to_bitmatrix(c), jnp.bfloat16),
+            lambda c: jnp.asarray(np_bits, jnp.bfloat16),
         )
 
     def _pallas_applier(self, coeff: np.ndarray):

@@ -136,13 +136,9 @@ class PallasShardApply:
         self.interpret = interpret
 
     def _bm32_arg(self):
-        try:
-            from jax._src.core import trace_state_clean
+        from ceph_tpu.common.jaxutil import outside_trace
 
-            outside_trace = trace_state_clean()
-        except ImportError:  # private API moved: fall back, always correct
-            outside_trace = False
-        if outside_trace:
+        if outside_trace():
             if self._bm32_dev is None:
                 self._bm32_dev = jnp.asarray(self.bm32)
             return self._bm32_dev

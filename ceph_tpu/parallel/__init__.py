@@ -8,8 +8,17 @@ reference osd/ECBackend.cc:2090-2106 becomes an all_to_all over ICI), and
 repair reads ride all_gather (BASELINE.md config #5 LRC shard-group repair).
 """
 
+from ceph_tpu.parallel.clay_sharding import (  # noqa: F401
+    sharded_clay_repair,
+    sharded_clay_repair_check,
+)
 from ceph_tpu.parallel.ec_sharding import (  # noqa: F401
     distributed_ec_step,
     make_ec_mesh,
     sharded_encode,
+)
+from ceph_tpu.parallel.lrc_sharding import (  # noqa: F401
+    make_group_mesh,
+    sharded_lrc_repair,
+    sharded_lrc_repair_check,
 )

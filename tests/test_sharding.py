@@ -60,3 +60,19 @@ def test_graft_entry_dryrun_body_on_virtual_mesh():
     import __graft_entry__ as graft
 
     graft._dryrun_body(8)
+
+
+def test_sharded_clay_repair_bit_identical(mesh):
+    """BASELINE config #4: CLAY d-helper sub-chunk repair over the mesh."""
+    from ceph_tpu.parallel import sharded_clay_repair_check
+
+    sharded_clay_repair_check(mesh)
+
+
+def test_sharded_lrc_group_repair_bit_identical():
+    """BASELINE config #5: LRC group-local all_gather repair."""
+    import jax
+
+    from ceph_tpu.parallel import sharded_lrc_repair_check
+
+    sharded_lrc_repair_check(jax.devices())
