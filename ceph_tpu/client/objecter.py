@@ -50,6 +50,10 @@ class Objecter:
         self._lingers: dict[int, LingerOp] = {}
         self._next_linger = 0
         self._stopped = False
+        # client-unique reqid base (osd_reqid_t role): lets the OSD dedup
+        # a resubmitted op that already executed with only the reply lost
+        self._reqid_name = f"{msgr.name}.{msgr.nonce:08x}"
+        self._reqid_seq = 0
 
     # -- dispatch hooks (driven by the owning client) ---------------------
     async def handle_message(self, conn: Connection, msg: Message) -> bool:
@@ -107,6 +111,12 @@ class Objecter:
         replies, and session resets until ``timeout``."""
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
+        # one reqid for the whole retry loop: a resend after a session
+        # reset is the SAME logical op, so the OSD can answer from its
+        # completed-op cache instead of re-executing (reference replays
+        # are deduped via osd_reqid_t in the PG log)
+        self._reqid_seq += 1
+        reqid = f"{self._reqid_name}:{self._reqid_seq}"
         while True:
             if self._stopped:
                 raise ObjecterError("objecter stopped")
@@ -128,7 +138,7 @@ class Objecter:
                     m.osds[primary].addr,
                     Message("osd_op", {
                         "tid": tid, "pool": pool_id, "ps": ps, "oid": oid,
-                        "epoch": m.epoch, "ops": ops,
+                        "epoch": m.epoch, "ops": ops, "reqid": reqid,
                     }), f"osd.{primary}",
                 )
                 reply = await asyncio.wait_for(

@@ -176,13 +176,17 @@ class Monitor:
 
     async def _propose_genesis(self) -> None:
         try:
-            if self.store.get_int("osdmap", "last_committed") > 0:
-                return
-            tx = StoreTransaction()
-            for svc in self.services.values():
-                svc.create_initial(tx)
-            log.dout(1, "%s: creating genesis cluster maps", self.name)
-            await self.paxos.propose(tx)
+            # under _mutate_lock: a concurrently staged boot incremental
+            # must serialize on a distinct epoch, not race genesis to
+            # epoch 1 and silently overwrite it
+            async with self._mutate_lock:
+                if self.store.get_int("osdmap", "last_committed") > 0:
+                    return
+                tx = StoreTransaction()
+                for svc in self.services.values():
+                    svc.create_initial(tx)
+                log.dout(1, "%s: creating genesis cluster maps", self.name)
+                await self.paxos.propose(tx)
         except ConnectionError:
             pass
         finally:

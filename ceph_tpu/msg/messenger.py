@@ -320,7 +320,8 @@ class Connection:
                     continue                      # replayed duplicate
                 try:
                     msg = Message.from_wire(decode(payload), seq)
-                except (ValueError, TypeError, KeyError) as e:
+                except (ValueError, TypeError, KeyError, IndexError,
+                        struct.error) as e:
                     # crc-valid but malformed payload: treat as a stream
                     # failure, not a reader-task crash
                     self._on_stream_failure(
@@ -520,7 +521,13 @@ class Messenger:
         if banner != BANNER:
             raise MessengerError(f"bad banner {banner!r}")
         (n,) = _LEN.unpack(await stream.read_exactly(_LEN.size))
-        peer = decode(await stream.read_exactly(n))
+        try:
+            peer = decode(await stream.read_exactly(n))
+        except (ValueError, TypeError, KeyError, IndexError,
+                struct.error) as e:
+            # a truncated/garbled hello raises codec errors, not just
+            # MessengerError — must not escape as a reader-task crash
+            raise MessengerError(f"bad handshake payload: {e}") from e
         if not isinstance(peer, dict) or "entity" not in peer:
             raise MessengerError("bad handshake payload")
         return peer
@@ -544,7 +551,7 @@ class Messenger:
                 raise MessengerError(f"bad banner {banner!r}")
             (n,) = _LEN.unpack(await stream.read_exactly(_LEN.size))
             peer = decode(await stream.read_exactly(n))
-            peer_name = peer["entity"]
+            peer_name = str(peer["entity"])
             conn = self._accepted.get(peer_name)
             if conn is not None and peer.get("connect_seq", 0) == 0:
                 # peer started a NEW session (its connect_seq reset): our
@@ -573,7 +580,8 @@ class Messenger:
             conn._start_io()
             if fresh and self.dispatcher is not None:
                 self.dispatcher.ms_handle_connect(conn)
-        except (MessengerError, KeyError, TypeError, ValueError) as e:
+        except (MessengerError, KeyError, TypeError, ValueError,
+                IndexError, struct.error) as e:
             log.dout(10, "%s: accept failed: %s", self.name, e)
             stream.close()
 

@@ -7,4 +7,5 @@ EIO_RC = -5
 EAGAIN_RC = -11
 EINVAL_RC = -22
 ENOTSUP_RC = -95
+ESTALE_RC = -116              # sub-op from an older PG interval, dropped
 MISDIRECTED_RC = -1000        # resend after map refresh (reference drops)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import json
 import time
+from collections import deque
 from typing import Mapping
 
 from ceph_tpu.common.config import ConfigProxy
@@ -40,6 +41,7 @@ from ceph_tpu.osd.codes import (
     EIO_RC,
     ENOENT_RC,
     ENOTSUP_RC,
+    ESTALE_RC,
     MISDIRECTED_RC,
     OK,
 )
@@ -199,6 +201,13 @@ class OSDDaemon:
                     "subop", "recovery_ops"):
             self.perf.add(key)
         self.perf.add("op_latency", CounterType.TIME)
+        # completed-op cache keyed by client reqid (the osd_reqid_t dedup
+        # the reference keeps in the PG log): a client resend whose first
+        # attempt executed but lost the reply gets the cached result
+        # instead of a second execution of a non-idempotent batch
+        self._reqid_replies: dict[str, dict] = {}
+        self._reqid_order: deque[str] = deque()
+        self._reqid_cap = 4096
         # watch/notify state:
         #   (pool, ps, oid) -> {(client entity, cookie): conn}
         self._watchers: dict[
@@ -511,7 +520,11 @@ class OSDDaemon:
     def _handle_pg_activate(self, d: dict) -> None:
         pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
         pg = self.pgs.get(pgid)
-        if pg is not None and not pg.is_primary:
+        # gate on the interval epoch: an activate from a primary of an
+        # older interval must not flip a re-peering replica active
+        # (require_same_or_newer_map role, reference OSD.cc)
+        if (pg is not None and not pg.is_primary
+                and int(d.get("epoch", 0)) == pg.epoch):
             pg.state = STATE_ACTIVE
 
     # -- recovery ------------------------------------------------------------
@@ -635,9 +648,31 @@ class OSDDaemon:
                 await self._do_special_op(conn, pg, str(d["oid"]),
                                           ops[0], tid)
                 return
+            reqid = str(d.get("reqid", ""))
+            cached = self._reqid_replies.get(reqid) if reqid else None
+            if cached is not None:
+                self._reply(conn, tid, cached["rc"],
+                            results=cached["results"],
+                            version=cached["version"])
+                return
             rc, results, version = await self._do_ops(
                 pg, str(d["oid"]), ops
             )
+            if reqid and any(
+                op.get("op") not in ("read", "stat", "getxattr",
+                                     "getxattrs", "omap_get")
+                for op in ops
+            ):
+                # remember completed mutations only: replaying a read is
+                # harmless, replaying an append is not
+                self._reqid_replies[reqid] = {
+                    "rc": rc, "results": results, "version": version,
+                }
+                self._reqid_order.append(reqid)
+                while len(self._reqid_order) > self._reqid_cap:
+                    self._reqid_replies.pop(
+                        self._reqid_order.popleft(), None
+                    )
             # counted on completion only (misdirected resends, re-queued
             # waiters, and failed batches must not inflate the counters)
             self.perf.inc("op")
@@ -1134,9 +1169,16 @@ class OSDDaemon:
 
     # -- sub ops (shard/replica server side) -----------------------------------
     async def send_sub_op(self, osd: int, kind: str, **args):
-        """Send one sub-op and await its reply (tid-correlated)."""
+        """Send one sub-op and await its reply (tid-correlated). Every
+        sub-op carries the sender's PG interval-start epoch so a stale
+        primary cannot replicate into a PG whose interval has moved on
+        (the require_same_or_newer_map check on MOSDRepOp)."""
         if self.osdmap is None or not self.osdmap.is_up(osd):
             raise ShardReadError(f"osd.{osd} is down")
+        if "iepoch" not in args and "cid" in args:
+            cid = _dec_cid(args["cid"])
+            pg = self.pgs.get(PGId(cid.pool, cid.pg))
+            args["iepoch"] = pg.epoch if pg is not None else 0
         addr = self.osdmap.osds[osd].addr
         self._sub_tid += 1
         tid = self._sub_tid
@@ -1144,7 +1186,8 @@ class OSDDaemon:
         self._sub_futures[tid] = fut
         try:
             await self.msgr.send_to(addr, Message("sub_op", {
-                "tid": tid, "kind": kind, "from": self.osd_id, **args,
+                "tid": tid, "kind": kind, "from": self.osd_id,
+                "epoch": self.osdmap.epoch, **args,
             }, priority=PRIO_HIGH), f"osd.{osd}")
             reply = await asyncio.wait_for(fut, 10.0)
         except (ConnectionError, asyncio.TimeoutError) as e:
@@ -1157,10 +1200,30 @@ class OSDDaemon:
             raise ShardReadError(f"sub_op {kind} on osd.{osd}: rc {rc}")
         return reply.get("value")
 
+    def _sub_op_stale(self, d: dict) -> bool:
+        """True when a sub-op originates from an older PG interval than
+        ours: applying it would let a partitioned ex-primary keep writing
+        into a PG whose interval (and primary) has moved on (the reference
+        drops rep-ops via same_interval_since checks on MOSDRepOp)."""
+        if "cid" not in d:
+            return False
+        cid = _dec_cid(d["cid"])
+        pg = self.pgs.get(PGId(cid.pool, cid.pg))
+        if pg is None:
+            return False            # nothing known to protect yet
+        return int(d.get("iepoch", 0)) < pg.epoch
+
     async def _handle_sub_op(self, conn: Connection, d: dict) -> None:
         tid = d.get("tid", 0)
         try:
             kind = d["kind"]
+            mutating = kind in ("tx", "write", "remove")
+            if mutating and self._sub_op_stale(d):
+                log.dout(5, "%s: dropping stale-interval sub_op %s from "
+                         "osd.%s (iepoch %s)", self.entity, kind,
+                         d.get("from"), d.get("iepoch"))
+                self._sub_reply(conn, tid, ESTALE_RC)
+                return
             value = None
             if kind == "tx":
                 await self.store.queue_transactions(

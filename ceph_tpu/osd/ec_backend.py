@@ -154,22 +154,31 @@ class ECBackend:
         return _Guard()
 
     # -- metadata --------------------------------------------------------
+    async def _attr_all(self, oid: str, name: str) -> list:
+        """Fetch one attr from every shard concurrently (metadata is
+        replicated per shard; one round-trip worst case instead of k+m
+        serial awaits). Each slot is bytes, KeyError (shard affirms the
+        object/attr absent), or another exception (shard unreachable)."""
+        return await asyncio.gather(*(
+            self.shards[i].get_attr(oid, name) for i in range(self.n)
+        ), return_exceptions=True)
+
     async def _get_attr_any(self, oid: str, name: str) -> bytes | None:
-        """Read an attr from the first shard that still has the object
-        (metadata is replicated on every shard). Returns None only when at
-        least one shard affirmatively reports the object absent; if every
-        shard errored transiently, raises — 'unreachable' must never be
-        mistaken for 'does not exist' (a write would then reset version and
-        skip RMW read-back)."""
-        absent = False
+        """Read an attr from any shard that still has the object. Returns
+        None only when at least one shard affirmatively reports it absent;
+        if every shard errored transiently, raises — 'unreachable' must
+        never be mistaken for 'does not exist' (a write would then reset
+        version and skip RMW read-back)."""
+        results = await self._attr_all(oid, name)
         errors = []
-        for i in range(self.n):
-            try:
-                return await self.shards[i].get_attr(oid, name)
-            except KeyError:
+        absent = False
+        for i, r in enumerate(results):
+            if isinstance(r, KeyError):
                 absent = True
-            except Exception as e:
-                errors.append((i, e))
+            elif isinstance(r, BaseException):
+                errors.append((i, r))
+            else:
+                return r
         if absent:
             return None
         raise ShardReadError(
@@ -177,11 +186,36 @@ class ECBackend:
         )
 
     async def _read_meta(self, oid: str) -> ECObjectMeta | None:
-        raw = await self._get_attr_any(oid, VERSION_ATTR)
-        if raw is None:
+        """Authoritative object metadata: the MAX version across all
+        answering shards. Taking the first reply would let a shard that
+        missed a degraded write serve a stale version as authoritative,
+        inverting the stale-shard check (fresh shards would then fail
+        version verification). The peering-time authoritative-version
+        choice, applied per read."""
+        results = await self._attr_all(oid, VERSION_ATTR)
+        best: ECObjectMeta | None = None
+        errors = []
+        absent = False
+        for i, r in enumerate(results):
+            if isinstance(r, KeyError):
+                absent = True
+            elif isinstance(r, BaseException):
+                errors.append((i, r))
+            else:
+                try:
+                    d = json.loads(r)
+                    meta = ECObjectMeta(int(d["size"]), int(d["version"]))
+                except (ValueError, TypeError, KeyError):
+                    continue
+                if best is None or meta.version > best.version:
+                    best = meta
+        if best is not None:
+            return best
+        if absent:
             return None
-        d = json.loads(raw)
-        return ECObjectMeta(d["size"], d["version"])
+        raise ShardReadError(
+            f"all shards unreachable reading meta of {oid}: {errors}"
+        )
 
     @staticmethod
     def _meta_attr(meta: ECObjectMeta) -> bytes:
@@ -456,34 +490,64 @@ class ECBackend:
 
     async def set_attr(self, oid: str, name: str, value: bytes) -> None:
         """Set one attr on all shards (zero-length data write carries it);
-        tolerates up to m dead shards like a degraded data write."""
-        results = await asyncio.gather(*(
-            self.shards[i].write_shard(oid, 0, b"", {name: bytes(value)})
-            for i in range(self.n)
-        ), return_exceptions=True)
-        failed = [i for i, r in enumerate(results)
-                  if isinstance(r, BaseException)]
-        if len(failed) > self.m:
-            raise ShardReadError(
-                f"set_attr {oid}: {len(failed)} shards failed ({failed})"
+        tolerates up to m dead shards like a degraded data write. The
+        per-object version is bumped and rewritten with the attr so a
+        shard that missed the write is distinguishable from a current
+        one (stale-version detection, like the degraded data path)."""
+        async with self._lock(oid):
+            meta = await self._read_meta(oid)
+            new_meta = ECObjectMeta(
+                meta.size if meta else 0,
+                meta.version + 1 if meta else 1,
             )
+            attrs = {name: bytes(value),
+                     VERSION_ATTR: self._meta_attr(new_meta)}
+            results = await asyncio.gather(*(
+                self.shards[i].write_shard(oid, 0, b"", attrs)
+                for i in range(self.n)
+            ), return_exceptions=True)
+            failed = [i for i, r in enumerate(results)
+                      if isinstance(r, BaseException)]
+            if len(failed) > self.m:
+                raise ShardReadError(
+                    f"set_attr {oid}: {len(failed)} shards failed "
+                    f"({failed})"
+                )
+            if failed:
+                self._schedule_repair(oid, failed)
 
     async def get_attrs(self, oid: str) -> dict[str, bytes]:
-        """All attrs from the first shard that answers; a shard missing
-        the object does NOT conclude absence (it may have missed a
-        degraded write) — keep trying, like _get_attr_any."""
+        """All attrs, from the answering shard with the HIGHEST stored
+        version: attr mutations bump the object version (set_attr), so
+        the max-version shard is the one guaranteed current — the first
+        responder may have missed a degraded attr write."""
+        async def fetch(i: int):
+            getattrs = getattr(self.shards[i], "get_attrs", None)
+            if getattrs is None:
+                raise ShardReadError(f"shard {i}: no get_attrs")
+            return dict(await getattrs(oid))
+
+        results = await asyncio.gather(
+            *(fetch(i) for i in range(self.n)), return_exceptions=True
+        )
+        best: dict[str, bytes] | None = None
+        best_version = -1
         errors = []
         absent = False
-        for i in range(self.n):
-            try:
-                shard = self.shards[i]
-                getattrs = getattr(shard, "get_attrs", None)
-                if getattrs is not None:
-                    return dict(await getattrs(oid))
-            except KeyError:
+        for i, r in enumerate(results):
+            if isinstance(r, KeyError):
                 absent = True
-            except Exception as e:             # noqa: BLE001
-                errors.append((i, e))
+            elif isinstance(r, BaseException):
+                errors.append((i, r))
+            else:
+                try:
+                    version = int(json.loads(r[VERSION_ATTR])["version"])
+                except (KeyError, ValueError, TypeError):
+                    version = 0
+                if version > best_version:
+                    best, best_version = r, version
+        if best is not None:
+            return best
         if absent:
             return {}
         raise ShardReadError(f"get_attrs {oid}: {errors}")
@@ -521,13 +585,24 @@ class ECBackend:
         out = await asyncio.to_thread(
             self.ec.decode_chunks_batch, batched, lost
         )
+        # copy the FULL attr set from a version-verified survivor — a
+        # rebuilt shard missing user xattrs would serve stale attr reads
         good = next(iter(need))
-        meta_raw = await self.shards[good].get_attr(oid, VERSION_ATTR)
-        hinfo_raw = await self.shards[good].get_attr(oid, HINFO_ATTR)
+        getattrs = getattr(self.shards[good], "get_attrs", None)
+        if getattrs is not None:
+            attrs = dict(await getattrs(oid))
+        else:
+            attrs = {
+                VERSION_ATTR: await self.shards[good].get_attr(
+                    oid, VERSION_ATTR
+                ),
+                HINFO_ATTR: await self.shards[good].get_attr(
+                    oid, HINFO_ATTR
+                ),
+            }
         await asyncio.gather(*(
             self.shards[s].write_shard(
-                oid, 0, np.ascontiguousarray(out[s]).tobytes(),
-                {VERSION_ATTR: meta_raw, HINFO_ATTR: hinfo_raw},
+                oid, 0, np.ascontiguousarray(out[s]).tobytes(), attrs,
             )
             for s in lost
         ))

@@ -1,0 +1,244 @@
+"""Regression tests for the round-1 advisor findings.
+
+1. Stale-interval sub-ops are NAKed (split-brain writes from an ex-primary);
+   pg_activate is gated on the interval epoch.
+2. Malformed-but-CRC-valid frames (codec struct.error/IndexError) are stream
+   failures, not reader-task crashes: the connection recovers.
+3. Client op resends carry a stable reqid and the OSD answers replays from
+   its completed-op cache instead of re-executing non-idempotent ops.
+4. EC attr mutations bump the object version so stale shards are detectable.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.common.crc32c import crc32c
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+from ceph_tpu.msg import Message, Messenger, Policy, reset_local_namespace
+from ceph_tpu.msg.messenger import _FRAME_HDR
+from ceph_tpu.osd.codes import ESTALE_RC, OK
+from ceph_tpu.osd.daemon import encode_tx
+from ceph_tpu.osd.ec_backend import ECBackend, LocalShard
+from ceph_tpu.osd.pg import object_to_ps
+from ceph_tpu.store import CollectionId, GHObject, MemStore, Transaction
+
+from tests.test_osd_daemon import (   # noqa: F401  (reuse the harness)
+    RawClient,
+    fast_conf,
+    start_cluster,
+    wait_active,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+class FakeConn:
+    """Captures replies for direct handler-level tests."""
+
+    def __init__(self):
+        self.sent = []
+        self.peer_name = "osd.99"
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+
+# ---------------------------------------------------------------------------
+# 1. interval-epoch validation
+
+def test_stale_interval_sub_op_rejected_and_activate_gated():
+    async def run():
+        mon, osds, client = await start_cluster(3, pools=[
+            {"prefix": "osd pool create", "pool": "rep", "pg_num": 4,
+             "size": 3},
+        ])
+        pool_id = next(p.pool_id for p in mon.osd_monitor.osdmap
+                       .pools.values() if p.name == "rep")
+        await wait_active(osds, pool_id)
+        r = await client.op("rep", "obj", [
+            {"op": "write", "off": 0, "data": b"current"},
+        ])
+        assert r["rc"] == 0, r
+
+        ps = object_to_ps("obj", 4)
+        _, _, acting, primary = mon.osd_monitor.osdmap.pg_to_up_acting(
+            pool_id, ps
+        )
+        replica_id = next(o for o in acting if o != primary)
+        replica = osds[replica_id]
+        from ceph_tpu.osd.pg import PGId
+        pg = replica.pgs[PGId(pool_id, ps)]
+
+        cid = CollectionId(pool_id, ps)
+        obj = GHObject(pool_id, "obj")
+        tx = Transaction().write(cid, obj, 0, b"SPLIT-BRAIN")
+        conn = FakeConn()
+        # a sub-op from an interval BEFORE ours must be NAKed, not applied
+        await replica._handle_sub_op(conn, {
+            "tid": 7, "kind": "tx", "from": primary,
+            "cid": [pool_id, ps, -1], "iepoch": pg.epoch - 1,
+            "ops": encode_tx(tx),
+        })
+        assert conn.sent[-1].data["rc"] == ESTALE_RC
+        assert replica.store.read(cid, obj) == b"current"
+
+        # same-interval sub-op still applies
+        conn2 = FakeConn()
+        await replica._handle_sub_op(conn2, {
+            "tid": 8, "kind": "tx", "from": primary,
+            "cid": [pool_id, ps, -1], "iepoch": pg.epoch,
+            "ops": encode_tx(tx),
+        })
+        assert conn2.sent[-1].data["rc"] == OK
+        assert replica.store.read(cid, obj) == b"SPLIT-BRAIN"
+
+        # pg_activate from an older interval must not flip a replica
+        pg.state = "replica"
+        replica._handle_pg_activate({
+            "pgid": [pool_id, ps], "epoch": pg.epoch - 1,
+        })
+        assert pg.state == "replica"
+        replica._handle_pg_activate({
+            "pgid": [pool_id, ps], "epoch": pg.epoch,
+        })
+        assert pg.state == "active"
+
+        await client.shutdown()
+        for o in osds:
+            await o.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# 2. malformed frame handling
+
+def test_malformed_payload_is_stream_failure_not_reader_crash():
+    async def run():
+        got = []
+
+        class Collector:
+            async def ms_dispatch(self, conn, msg):
+                got.append(msg.data)
+
+            def ms_handle_reset(self, conn):
+                pass
+
+            def ms_handle_connect(self, conn):
+                pass
+
+        a = Messenger("osd.1", ConfigProxy())
+        b = Messenger("osd.2", ConfigProxy())
+        b.set_dispatcher(Collector())
+        a.set_dispatcher(Collector())
+        await a.bind("local://a")
+        await b.bind("local://b")
+        conn = await a.send_to("local://b", Message("m", {"n": 1}), "osd.2")
+        for _ in range(100):
+            if got:
+                break
+            await asyncio.sleep(0.01)
+        assert got and got[0]["n"] == 1
+
+        # inject a CRC-valid frame whose payload makes the codec raise
+        # struct.error (truncated int) — before the fix this killed the
+        # peer's reader task and hung the connection forever
+        bad = b"i\x01"
+        hdr = _FRAME_HDR.pack(conn.out_seq + 1, 0, len(bad),
+                              crc32c(0xFFFFFFFF, bad))
+        conn._stream.write(hdr + bad)
+        await conn._stream.drain()
+        await asyncio.sleep(0.05)
+
+        # the lossless session must recover and deliver subsequent traffic
+        conn.send_message(Message("m", {"n": 2}))
+        for _ in range(200):
+            if len(got) >= 2:
+                break
+            await asyncio.sleep(0.01)
+        assert len(got) >= 2 and got[-1]["n"] == 2
+        await a.shutdown()
+        await b.shutdown()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# 3. reqid dedup
+
+def test_reqid_dedup_prevents_double_append():
+    async def run():
+        mon, osds, client = await start_cluster(3, pools=[
+            {"prefix": "osd pool create", "pool": "rep", "pg_num": 4,
+             "size": 3},
+        ])
+        pool_id = next(p.pool_id for p in mon.osd_monitor.osdmap
+                       .pools.values() if p.name == "rep")
+        await wait_active(osds, pool_id)
+
+        m = client.monc.osdmap
+        ps = object_to_ps("dup", 4)
+        _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+
+        async def send(tid):
+            fut = asyncio.get_running_loop().create_future()
+            client._futures[tid] = fut
+            await client.msgr.send_to(
+                m.osds[primary].addr,
+                Message("osd_op", {
+                    "tid": tid, "pool": pool_id, "ps": ps, "oid": "dup",
+                    "epoch": m.epoch, "reqid": "client.77:42",
+                    "ops": [{"op": "append", "data": b"x"}],
+                }), f"osd.{primary}",
+            )
+            return await asyncio.wait_for(fut, 10.0)
+
+        r1 = await send(901)      # executes
+        r2 = await send(902)      # replay: cached reply, NOT re-executed
+        assert r1["rc"] == 0 and r2["rc"] == 0
+        assert r2["version"] == r1["version"]
+        r = await client.op("rep", "dup", [{"op": "read", "off": 0}])
+        assert r["results"][0]["data"] == b"x"      # appended once
+
+        await client.shutdown()
+        for o in osds:
+            await o.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# 4. attr mutation versioning
+
+def test_set_attr_bumps_version():
+    registry = ErasureCodePluginRegistry()
+    codec = registry.factory(
+        "jax_rs", {"k": "4", "m": "2", "technique": "cauchy_good"}
+    )
+    shards = {}
+    for i in range(6):
+        store = MemStore()
+        cid = CollectionId(1, 0, shard=i)
+        asyncio.run(store.queue_transactions(
+            Transaction().create_collection(cid)
+        ))
+        shards[i] = LocalShard(store, cid, pool=1, shard=i)
+    be = ECBackend(codec, shards, stripe_unit=128)
+
+    async def run():
+        await be.write("o", b"payload" * 100)
+        m1 = await be._read_meta("o")
+        await be.set_attr("o", "_u_color", b"red")
+        m2 = await be._read_meta("o")
+        assert m2.version == m1.version + 1
+        assert m2.size == m1.size
+        attrs = await be.get_attrs("o")
+        assert attrs["_u_color"] == b"red"
+    asyncio.run(run())
