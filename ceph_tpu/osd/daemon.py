@@ -58,6 +58,12 @@ from ceph_tpu.osd.pg import (
 from ceph_tpu.services.cls import ClassRegistry, ClsContext, ClsError
 from ceph_tpu.store import CollectionId, GHObject, MemStore, ObjectStore
 from ceph_tpu.store import Transaction as StoreTx
+from ceph_tpu.store.txcodec import (
+    dec_cid as _dec_cid,
+    decode_tx,
+    enc_cid as _enc_cid,
+    encode_tx,
+)
 
 log = Dout("osd")
 
@@ -68,54 +74,6 @@ _MON_TYPES = {
     "auth_challenge", "auth_reply", "auth_bad", "mon_command_reply",
     "osd_map", "config", "mon_map",
 }
-
-
-def _enc_cid(cid: CollectionId) -> list:
-    return [cid.pool, cid.pg, cid.shard]
-
-
-def _dec_cid(v: list) -> CollectionId:
-    return CollectionId(int(v[0]), int(v[1]), int(v[2]))
-
-
-def _enc_oid(o: GHObject) -> list:
-    return [o.pool, o.name, o.snap, o.gen, o.shard]
-
-
-def _dec_oid(v: list) -> GHObject:
-    return GHObject(int(v[0]), str(v[1]), int(v[2]), int(v[3]), int(v[4]))
-
-
-def encode_tx(tx: StoreTx) -> list:
-    """Store transaction -> wire form (the ObjectStore::Transaction
-    encode role for MOSDRepOp payloads)."""
-    out = []
-    for op in tx.ops:
-        wire = [op[0]]
-        for arg in op[1:]:
-            if isinstance(arg, CollectionId):
-                wire.append({"_c": _enc_cid(arg)})
-            elif isinstance(arg, GHObject):
-                wire.append({"_o": _enc_oid(arg)})
-            else:
-                wire.append(arg)
-        out.append(wire)
-    return out
-
-
-def decode_tx(wire: list) -> StoreTx:
-    tx = StoreTx()
-    for wop in wire:
-        args = []
-        for arg in wop[1:]:
-            if isinstance(arg, dict) and "_c" in arg:
-                args.append(_dec_cid(arg["_c"]))
-            elif isinstance(arg, dict) and "_o" in arg:
-                args.append(_dec_oid(arg["_o"]))
-            else:
-                args.append(arg)
-        tx.ops.append(tuple([wop[0], *args]))
-    return tx
 
 
 class DeadShard:

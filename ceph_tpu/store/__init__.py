@@ -11,3 +11,5 @@ file-backed store; device HBM is a compute/cache tier, not durability.
 from ceph_tpu.store.types import CollectionId, GHObject  # noqa: F401
 from ceph_tpu.store.object_store import ObjectStore, Transaction  # noqa: F401
 from ceph_tpu.store.memstore import MemStore  # noqa: F401
+from ceph_tpu.store.walstore import WalStore  # noqa: F401
+from ceph_tpu.store.txcodec import decode_tx, encode_tx  # noqa: F401

@@ -16,7 +16,7 @@ from ceph_tpu.client.rados import Rados
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.mon.monitor import Monitor
 from ceph_tpu.osd.daemon import OSDDaemon
-from ceph_tpu.store import MemStore
+from ceph_tpu.store import MemStore, ObjectStore, WalStore
 
 FAST_TEST_OVERRIDES = {
     "mon_lease": 0.4, "mon_lease_interval": 0.1,
@@ -49,7 +49,7 @@ class DevCluster:
             self.monmap = {n: f"local://mon.{n}" for n in mon_names}
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSDDaemon] = {}
-        self._osd_stores: dict[int, MemStore] = {}
+        self._osd_stores: dict[int, ObjectStore] = {}
 
     def conf(self) -> ConfigProxy:
         return ConfigProxy(overrides=dict(self.overrides))
@@ -70,8 +70,18 @@ class DevCluster:
         for i in range(self.n_osds):
             await self.start_osd(i)
 
+    def _make_osd_store(self, osd_id: int) -> ObjectStore:
+        """With a store_dir, OSD data is durable (WAL + checkpoint) and a
+        revived OSD serves its pre-kill objects from disk; without one it
+        is RAM-only (the MemStore dev default)."""
+        if self.store_dir:
+            return WalStore(f"{self.store_dir}/osd.{osd_id}")
+        return MemStore()
+
     async def start_osd(self, osd_id: int) -> OSDDaemon:
-        store = self._osd_stores.setdefault(osd_id, MemStore())
+        store = self._osd_stores.setdefault(
+            osd_id, self._make_osd_store(osd_id)
+        )
         osd = OSDDaemon(
             osd_id, self.monmap, self.conf(), store=store,
             addr=self._osd_addr(osd_id), host=f"host{osd_id}",
@@ -82,10 +92,14 @@ class DevCluster:
 
     async def kill_osd(self, osd_id: int) -> None:
         """Hard-stop a daemon; its store survives for revive (the
-        Thrasher kill_osd hook, qa/tasks/ceph_manager.py:248)."""
+        Thrasher kill_osd hook, qa/tasks/ceph_manager.py:248). With a
+        store_dir the in-RAM image is dropped too, so revive proves the
+        on-disk WAL/checkpoint serves the data, not a lingering cache."""
         osd = self.osds.pop(osd_id, None)
         if osd is not None:
             await osd.shutdown()
+        if self.store_dir:
+            self._osd_stores.pop(osd_id, None)
 
     async def revive_osd(self, osd_id: int) -> OSDDaemon:
         """Restart with the surviving store (revive_osd :480)."""

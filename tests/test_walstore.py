@@ -1,0 +1,137 @@
+"""WalStore durability: WAL replay, checkpoints, torn tails, and
+restart-with-data through a live cluster (the BlueStore durability
+contract scaled to the framework: an OSD restart serves its own data
+without peer recovery)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.store import (
+    CollectionId,
+    GHObject,
+    Transaction,
+    WalStore,
+)
+
+CID = CollectionId(1, 0, shard=0)
+OID = GHObject(1, "obj", shard=0)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def _new_store(path) -> WalStore:
+    s = WalStore(str(path))
+    _run(s.mount())
+    return s
+
+
+def test_wal_replay_after_crash(tmp_path):
+    """No umount (process crash): a fresh instance replays the WAL."""
+    s = _new_store(tmp_path)
+    _run(s.queue_transactions(
+        Transaction().create_collection(CID)
+        .write(CID, OID, 0, b"hello")
+        .setattr(CID, OID, "a", b"1")
+        .omap_setkeys(CID, OID, {"k": b"v"})
+    ))
+    _run(s.queue_transactions(Transaction().write(CID, OID, 5, b" world")))
+    # crash: no umount, no checkpoint — reopen from the log alone
+    s2 = _new_store(tmp_path)
+    assert s2.read(CID, OID) == b"hello world"
+    assert s2.getattr(CID, OID, "a") == b"1"
+    assert s2.omap_get(CID, OID) == {"k": b"v"}
+
+
+def test_clean_umount_checkpoints(tmp_path):
+    s = _new_store(tmp_path)
+    _run(s.queue_transactions(
+        Transaction().create_collection(CID).write(CID, OID, 0, b"data")
+    ))
+    _run(s.umount())
+    assert (tmp_path / "checkpoint.bin").exists()
+    s2 = _new_store(tmp_path)
+    assert s2.read(CID, OID) == b"data"
+
+
+def test_checkpoint_then_wal_delta(tmp_path):
+    """State = checkpoint + suffix of WAL written after it."""
+    s = WalStore(str(tmp_path), checkpoint_bytes=1)   # checkpoint every tx
+    _run(s.mount())
+    _run(s.queue_transactions(
+        Transaction().create_collection(CID).write(CID, OID, 0, b"base")
+    ))
+    # raise the threshold so the next commit stays in the WAL only
+    s.checkpoint_bytes = 1 << 30
+    _run(s.queue_transactions(Transaction().write(CID, OID, 4, b"+tail")))
+    s2 = _new_store(tmp_path)
+    assert s2.read(CID, OID) == b"base+tail"
+
+
+def test_torn_tail_truncated(tmp_path):
+    s = _new_store(tmp_path)
+    _run(s.queue_transactions(
+        Transaction().create_collection(CID).write(CID, OID, 0, b"good")
+    ))
+    # simulate a crash mid-append: garbage half-frame at the tail
+    with open(tmp_path / "wal.log", "ab") as f:
+        f.write(b"\xff\xff\xff\xff\x00torn")
+    s2 = _new_store(tmp_path)
+    assert s2.read(CID, OID) == b"good"
+    # and the tail was cut so further appends start clean
+    _run(s2.queue_transactions(Transaction().write(CID, OID, 4, b"-more")))
+    s3 = _new_store(tmp_path)
+    assert s3.read(CID, OID) == b"good-more"
+
+
+def test_failed_transaction_not_logged(tmp_path):
+    s = _new_store(tmp_path)
+    _run(s.queue_transactions(Transaction().create_collection(CID)))
+    with pytest.raises(KeyError):
+        _run(s.queue_transactions(
+            Transaction().rmattr(CID, GHObject(1, "ghost", shard=0), "x")
+        ))
+    s2 = _new_store(tmp_path)
+    assert not s2.exists(CID, GHObject(1, "ghost", shard=0))
+    assert s2.list_objects(CID) == []
+
+
+def test_osd_restart_serves_data_without_peer_recovery(tmp_path):
+    """VERDICT #5 'done' criterion: write -> kill OSD -> restart -> data
+    served from its own store. All three OSDs are killed together so
+    nothing could have been recovered from a peer."""
+    from ceph_tpu.vstart import DevCluster
+
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3,
+                             store_dir=str(tmp_path))
+        await cluster.start()
+        rados = await cluster.client()
+        await rados.pool_create("dur", pg_num=4, size=3, min_size=2)
+        io = await rados.open_ioctx("dur")
+        payload = b"survives restart" * 100
+        await io.write_full("persistent", payload)
+        await io.set_xattr("persistent", "tag", b"kept")
+
+        # kill every OSD: no peer holds the data when they come back
+        for i in range(3):
+            await cluster.kill_osd(i)
+        for i in range(3):
+            await cluster.revive_osd(i)
+
+        got = await io.read("persistent")
+        assert got == payload
+        assert await io.get_xattr("persistent", "tag") == b"kept"
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
