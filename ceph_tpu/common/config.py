@@ -76,6 +76,8 @@ def global_options() -> list[Option]:
                "plugins preloaded at osd start"),
         Option("osd_recovery_max_active", int, 8,
                "max concurrent recovery ops", min=1),
+        Option("osd_pg_log_max_entries", int, 250,
+               "retained pg log entries per PG (trim boundary)", min=8),
         Option("osd_client_op_priority", int, 63, "client op priority"),
         Option("mon_lease", float, 2.0,
                "peon lease / liveness window (s)", min=0.1),

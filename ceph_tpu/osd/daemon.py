@@ -32,6 +32,7 @@ from ceph_tpu.osd.ec_backend import (
     HINFO_ATTR,
     VERSION_ATTR,
     ECBackend,
+    ECWriteDegraded,
     LocalShard,
     ShardReadError,
 )
@@ -46,15 +47,18 @@ from ceph_tpu.osd.codes import (
     OK,
 )
 from ceph_tpu.osd.osd_map import NO_OSD, OSDMap
+from ceph_tpu.osd import pg_log
 from ceph_tpu.osd.pg import (
     STATE_ACTIVE,
     STATE_PEERING,
     STATE_RECOVERING,
+    MissingSet,
     PG,
     PGId,
     PeerInfo,
     object_to_ps,
 )
+from ceph_tpu.osd.pg_log import OP_DELETE, OP_MODIFY, LogEntry
 from ceph_tpu.services.cls import ClassRegistry, ClsContext, ClsError
 from ceph_tpu.store import CollectionId, GHObject, MemStore, ObjectStore
 from ceph_tpu.store import Transaction as StoreTx
@@ -80,6 +84,8 @@ class DeadShard:
     """ShardIO for an acting-set hole (NO_OSD): every IO fails so the
     EC backend reconstructs around it."""
 
+    is_dead = True          # an acting hole, not a live-member failure
+
     def __init__(self, shard: int):
         self.shard = shard
 
@@ -103,9 +109,10 @@ class NetworkShard:
             self.osd, kind, cid=_enc_cid(self.cid), **args
         )
 
-    async def write_shard(self, oid, offset, data, attrs):
+    async def write_shard(self, oid, offset, data, attrs, log=None):
         await self._sub("write", oid=oid, off=offset, data=bytes(data),
-                        attrs={k: bytes(v) for k, v in attrs.items()})
+                        attrs={k: bytes(v) for k, v in attrs.items()},
+                        log=log.to_wire() if log is not None else None)
 
     async def read_shard(self, oid, offset=0, length=None):
         return await self._sub("read", oid=oid, off=offset, len=length)
@@ -116,8 +123,9 @@ class NetworkShard:
     async def get_attrs(self, oid):
         return await self._sub("getattrs", oid=oid)
 
-    async def remove_shard(self, oid):
-        await self._sub("remove", oid=oid)
+    async def remove_shard(self, oid, log=None):
+        await self._sub("remove", oid=oid,
+                        log=log.to_wire() if log is not None else None)
 
     async def stat_shard(self, oid):
         return await self._sub("stat", oid=oid)
@@ -156,7 +164,8 @@ class OSDDaemon:
         # perf counters (the l_osd_* set, reference OSD.cc:9659 region)
         self.perf = PerfCounters(self.entity)
         for key in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
-                    "subop", "recovery_ops"):
+                    "subop", "recovery_ops", "peer_inventory_scans",
+                    "peer_backfills"):
             self.perf.add(key)
         self.perf.add("op_latency", CounterType.TIME)
         # completed-op cache keyed by client reqid (the osd_reqid_t dedup
@@ -166,6 +175,9 @@ class OSDDaemon:
         self._reqid_replies: dict[str, dict] = {}
         self._reqid_order: deque[str] = deque()
         self._reqid_cap = 4096
+        # reqid -> future of the attempt currently executing: resends
+        # attach instead of double-executing
+        self._inflight_ops: dict[str, asyncio.Future] = {}
         # watch/notify state:
         #   (pool, ps, oid) -> {(client entity, cookie): conn}
         self._watchers: dict[
@@ -253,6 +265,11 @@ class OSDDaemon:
             self._handle_pg_notify(msg.data)
         elif t == "pg_activate":
             self._handle_pg_activate(msg.data)
+        elif t == "log_trim":
+            pgid = PGId(int(msg.data["pgid"][0]), int(msg.data["pgid"][1]))
+            asyncio.get_running_loop().create_task(
+                self._trim_log(pgid, int(msg.data["limit"]))
+            )
         elif t == "notify_ack":
             # entity taken from the connection, not the message: an ack
             # can only satisfy the sender's own watch
@@ -351,6 +368,9 @@ class OSDDaemon:
         tx = StoreTx()
         for cid in self._my_cids(pg, acting):
             tx.create_collection(cid)
+        # the per-PG meta collection holds this OSD's pg log (one log per
+        # OSD per PG, even when it holds several EC shard collections)
+        tx.create_collection(pg_log.meta_cid(pg.pgid.pool, pg.pgid.ps))
         await self.store.queue_transactions(tx)
 
     def _my_cids(self, pg: PG, acting: list[int]) -> list[CollectionId]:
@@ -384,58 +404,245 @@ class OSDDaemon:
                     shards[shard] = DeadShard(shard)
                 else:
                     shards[shard] = NetworkShard(self, osd, cid)
-            pg.backend = ECBackend(codec, shards)
+
+            def log_hook(oid, op, obj_version, prior_version,
+                         reqid="", pg=pg):
+                entry = pg.next_entry(pg.epoch, oid, op, obj_version,
+                                      prior_version, reqid)
+                self._maybe_trim(pg)
+                return entry
+
+            pg.backend = ECBackend(codec, shards, log_hook=log_hook)
+            pg.ec_k = pg.backend.k
         else:
             pg.backend = None       # replicated path works on the store
 
     # -- peering (primary) ---------------------------------------------------
     async def _peer(self, pg: PG) -> None:
-        """GetInfo -> compute missing -> Activate -> recover (the
-        PeeringMachine Primary path, PeeringState.h:556). Queries are
-        re-sent until every acting shard answers — a peer that was mid-
-        boot for the first round answers a retry."""
+        """GetInfo (log windows) -> authoritative log -> missing sets ->
+        recover -> activate+merge (the PeeringMachine Primary path,
+        PeeringState.h:556, with PGLog-based missing computation instead
+        of full inventories). Queries are re-sent until every acting
+        shard answers — a peer that was mid-boot for the first round
+        answers a retry."""
         try:
             epoch = pg.epoch
-            pg.record_info(self._local_info(pg))
-            next_query = 0.0
-            while not pg.all_infos_in():
-                if pg.epoch != epoch:
-                    return                      # interval changed
-                now = time.monotonic()
-                if now >= next_query:
-                    next_query = now + 1.0
-                    for shard, osd in pg.acting_peers():
-                        if shard in pg.peer_infos:
-                            continue
-                        self._send_osd(osd, Message("pg_query", {
-                            "pgid": [pg.pgid.pool, pg.pgid.ps],
-                            "epoch": epoch,
-                            "shard": shard, "from": self.osd_id,
-                        }, priority=PRIO_HIGH))
-                await asyncio.sleep(0.01)
-            auth = pg.authoritative_versions()
-            missing = pg.compute_missing(auth)
-            for shard, osd in pg.acting_peers():
-                self._send_osd(osd, Message("pg_activate", {
-                    "pgid": [pg.pgid.pool, pg.pgid.ps], "epoch": epoch,
-                }, priority=PRIO_HIGH))
-            if missing:
-                pg.state = STATE_RECOVERING
-                await self._recover(pg, missing)
+            pg.peer_infos = {}      # re-peer of the same interval: fresh
+            local = self._local_info(pg)
+            pg.record_info(local)
+            # an OSD may hold several EC shard positions of one PG: each
+            # position gets an info (same log — one log per OSD per PG)
+            for shard, osd in enumerate(pg.acting):
+                if osd == self.osd_id and shard != local.shard:
+                    pg.record_info(PeerInfo(
+                        shard, self.osd_id, log=dict(local.log),
+                        tail=local.tail,
+                    ))
+            await self._gather(pg, epoch, lambda: pg.all_infos_in(),
+                               lambda shard: shard not in pg.peer_infos,
+                               mode="log")
+            if pg.epoch != epoch:
+                return
+            # new-entry seqs must exceed anything ANY member ever logged
+            # (a reused seq would alias a divergent entry) — including
+            # our own in-flight allocations from a previous interval of
+            # this same PG (never decrease)
+            pg.log_seq = max(
+                [pg.log_seq]
+                + [info.head[1] for info in pg.peer_infos.values()]
+                + [max(info.log, default=0)
+                   for info in pg.peer_infos.values()]
+                + [info.tail for info in pg.peer_infos.values()]
+            )
+            missing = pg.compute_missing()
+            if missing.backfill:
+                # log gaps: fall back to inventory comparison for those
+                # shards (the backfill path)
+                await self._backfill_plan(pg, epoch, missing)
                 if pg.epoch != epoch:
                     return
+            failures = 0
+            if missing.total():
+                pg.state = STATE_RECOVERING
+                failures = await self._recover(pg, missing)
+                if pg.epoch != epoch:
+                    return
+            if failures:
+                # activate DEGRADED without merging logs: merging would
+                # advance the stale member's tail over entries it still
+                # has not applied, permanently hiding the unrecovered
+                # objects. Leaving logs untouched lets the retry round
+                # re-detect exactly the same missing set.
+                log.derr("pg %s: %d objects failed recovery; degraded "
+                         "activate + retry", pg.pgid, failures)
+                for shard, osd in pg.acting_peers():
+                    self._send_osd(osd, Message("pg_activate", {
+                        "pgid": [pg.pgid.pool, pg.pgid.ps],
+                        "epoch": epoch,
+                    }, priority=PRIO_HIGH))
+                pg.state = STATE_ACTIVE
+                self._drain_waiters(pg)
+                self._schedule_repeer(pg, epoch)
+                return
+            # activation: every member merges the authoritative log
+            # window (now fully recovered; for EC already filtered to
+            # reconstructable entries, so rewound entries are REMOVED
+            # from the shards that applied them) so trims and the next
+            # peering round see one consistent history
+            window = {str(s): e.to_wire()
+                      for s, e in missing.auth_log.items()}
+            merge = {
+                "pgid": [pg.pgid.pool, pg.pgid.ps], "epoch": epoch,
+                "log": window, "tail": missing.auth_tail,
+                "floor": pg.log_seq,
+            }
+            await self._merge_log(pg, merge)
+            entries, _ = pg_log.read_log(self.store, pg.pgid.pool,
+                                         pg.pgid.ps)
+            pg.rebuild_reqid_index(entries)
+            for shard, osd in pg.acting_peers():
+                self._send_osd(osd, Message("pg_activate", dict(merge),
+                                            priority=PRIO_HIGH))
             pg.state = STATE_ACTIVE
             self._drain_waiters(pg)
-            log.dout(5, "pg %s: active (recovered %d shards)",
-                     pg.pgid, len(missing))
+            log.dout(5, "pg %s: active (recovered %d objects)",
+                     pg.pgid, missing.total())
         except asyncio.CancelledError:
             pass
+
+    def _schedule_repeer(self, pg: PG, epoch: int,
+                         delay: float = 1.0) -> None:
+        """Retry peering of the same interval after a recovery failure
+        (the reference keeps missing sets and retries recovery; here the
+        peering round IS the recovery planner)."""
+        async def retry():
+            await asyncio.sleep(delay)
+            if pg.epoch == epoch and not self._stopped \
+                    and pg.is_primary:
+                pg.peering_task = asyncio.get_running_loop().create_task(
+                    self._peer(pg)
+                )
+        asyncio.get_running_loop().create_task(retry())
+
+    async def _gather(self, pg: PG, epoch: int, done, want, mode: str
+                      ) -> None:
+        """Re-send pg_query(mode) to acting peers matching ``want`` until
+        ``done()``, respecting interval changes."""
+        next_query = 0.0
+        while not done():
+            if pg.epoch != epoch:
+                return
+            now = time.monotonic()
+            if now >= next_query:
+                next_query = now + 1.0
+                for shard, osd in pg.acting_peers():
+                    if not want(shard):
+                        continue
+                    self._send_osd(osd, Message("pg_query", {
+                        "pgid": [pg.pgid.pool, pg.pgid.ps],
+                        "epoch": epoch, "mode": mode,
+                        "shard": shard, "from": self.osd_id,
+                    }, priority=PRIO_HIGH))
+            await asyncio.sleep(0.01)
+
+    async def _backfill_plan(self, pg: PG, epoch: int,
+                             missing: MissingSet) -> None:
+        """Extend the missing sets for backfill shards via full inventory
+        comparison against the authoritative shard (O(objects) — only
+        for peers whose log no longer connects)."""
+        auth_shard, _, _ = pg.authoritative_log()
+        need_inv = set(missing.backfill) | {auth_shard}
+        for shard in need_inv:
+            # every LOCAL shard position answers synchronously (an OSD
+            # can hold several EC shard collections of one PG)
+            if (0 <= shard < len(pg.acting)
+                    and pg.acting[shard] == self.osd_id
+                    and pg.peer_infos.get(shard) is not None):
+                pg.peer_infos[shard].objects = self._inventory(pg, shard)
+
+        def infos_in():
+            return all(
+                pg.peer_infos.get(s) is not None
+                and pg.peer_infos[s].objects is not None
+                for s in need_inv
+            )
+
+        await self._gather(
+            pg, epoch, infos_in,
+            lambda shard: (shard in need_inv
+                           and pg.peer_infos.get(shard) is not None
+                           and pg.peer_infos[shard].objects is None),
+            mode="inventory",
+        )
+        if pg.epoch != epoch:
+            return
+        self.perf.inc("peer_backfills")
+        auth_inv = pg.peer_infos[auth_shard].objects or {}
+        for shard in missing.backfill:
+            inv = pg.peer_infos[shard].objects or {}
+            need = missing.by_shard.setdefault(shard, {})
+            for name, ver in auth_inv.items():
+                # ANY version mismatch is repaired — an equal-or-higher
+                # version on the backfill peer is divergent (never-acked)
+                # data, not a fresher copy
+                if inv.get(name, 0) != ver:
+                    need[name] = LogEntry(0, 0, name, OP_MODIFY, ver)
+                    missing.sources.setdefault(name, set()).add(auth_shard)
+            for name in inv:
+                if name not in auth_inv:
+                    # deleted while this shard was away
+                    need[name] = LogEntry(0, 0, name, OP_DELETE, 0)
+
+    async def _merge_log(self, pg: PG, d: dict) -> None:
+        """Apply an activation merge: adopt authoritative window entries
+        we lack, drop divergent entries (seq <= floor, not in window),
+        and advance the tail (post-recovery, our data matches the
+        window, so claiming its entries is truthful). Serialized against
+        trim by pg.log_lock — interleaved read-modify-write cycles could
+        otherwise regress the tail over removed entries."""
+        async with pg.log_lock:
+            pool, ps = pg.pgid.pool, pg.pgid.ps
+            entries, tail = pg_log.read_log(self.store, pool, ps)
+            window = {int(s): LogEntry.from_wire(w)
+                      for s, w in d["log"].items()}
+            floor = int(d.get("floor", 0))
+            auth_tail = int(d.get("tail", 0))
+            add = {s: e for s, e in window.items()
+                   if s not in entries or entries[s].epoch != e.epoch}
+            divergent = [s for s in entries
+                         if s <= floor and s not in window
+                         and s > auth_tail]
+            new_tail = max(tail, auth_tail)
+            if not add and not divergent and new_tail == tail:
+                return
+            cid = pg_log.meta_cid(pool, ps)
+            oid = pg_log.meta_oid(pool)
+            tx = StoreTx()
+            for e in add.values():
+                pg_log.append_ops(tx, pool, ps, e)
+            if divergent:
+                tx.omap_rmkeys(cid, oid,
+                               [pg_log.seq_key(s) for s in divergent])
+            tx.setattr(cid, oid, pg_log.TAIL_ATTR,
+                       str(new_tail).encode())
+            await self.store.queue_transactions(tx)
+
+    async def _trim_log(self, pgid: PGId, limit: int) -> None:
+        pg = self.pgs.get(pgid)
+        lock = pg.log_lock if pg is not None else asyncio.Lock()
+        try:
+            async with lock:
+                await pg_log.trim(self.store, pgid.pool, pgid.ps, limit)
+        except (KeyError, ValueError) as e:
+            log.dout(10, "%s: log trim %s failed: %s",
+                     self.entity, pgid, e)
 
     def _local_info(self, pg: PG) -> PeerInfo:
         shard = (pg.acting.index(self.osd_id)
                  if self.osd_id in pg.acting else NO_OSD)
-        return PeerInfo(shard, self.osd_id,
-                        self._inventory(pg, shard))
+        entries, tail = pg_log.read_log(self.store, pg.pgid.pool,
+                                        pg.pgid.ps)
+        return PeerInfo(shard, self.osd_id, log=entries, tail=tail)
 
     def _inventory(self, pg: PG, shard: int) -> dict[str, int]:
         """name -> version for our shard of this PG (the MOSDPGNotify
@@ -459,20 +666,43 @@ class OSDDaemon:
         pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
         pg = self.pgs.get(pgid)
         shard = int(d["shard"])
-        inventory = self._inventory(pg, shard) if pg is not None else {}
-        conn.send_message(Message("pg_notify", {
+        mode = str(d.get("mode", "log"))
+        payload: dict = {
             "pgid": [pgid.pool, pgid.ps], "epoch": d["epoch"],
-            "shard": shard, "osd": self.osd_id, "objects": inventory,
-        }, priority=PRIO_HIGH))
+            "shard": shard, "osd": self.osd_id, "mode": mode,
+        }
+        if mode == "inventory":
+            self.perf.inc("peer_inventory_scans")
+            payload["objects"] = (
+                self._inventory(pg, shard) if pg is not None else {}
+            )
+        else:
+            entries, tail = pg_log.read_log(self.store, pgid.pool,
+                                            pgid.ps)
+            payload["log"] = {str(s): e.to_wire()
+                              for s, e in entries.items()}
+            payload["tail"] = tail
+        conn.send_message(Message("pg_notify", payload,
+                                  priority=PRIO_HIGH))
 
     def _handle_pg_notify(self, d: dict) -> None:
         pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
         pg = self.pgs.get(pgid)
         if pg is None or not pg.is_primary or pg.epoch != int(d["epoch"]):
             return
+        shard = int(d["shard"])
+        if str(d.get("mode", "log")) == "inventory":
+            info = pg.peer_infos.get(shard)
+            if info is not None:
+                info.objects = {
+                    str(k): int(v) for k, v in d["objects"].items()
+                }
+            return
         pg.record_info(PeerInfo(
-            int(d["shard"]), int(d["osd"]),
-            {str(k): int(v) for k, v in d["objects"].items()},
+            shard, int(d["osd"]),
+            log={int(s): LogEntry.from_wire(w)
+                 for s, w in d.get("log", {}).items()},
+            tail=int(d.get("tail", 0)),
         ))
 
     def _handle_pg_activate(self, d: dict) -> None:
@@ -484,90 +714,211 @@ class OSDDaemon:
         if (pg is not None and not pg.is_primary
                 and int(d.get("epoch", 0)) == pg.epoch):
             pg.state = STATE_ACTIVE
+            if "log" in d:
+                async def merge():
+                    try:
+                        await self._merge_log(pg, d)
+                    except (KeyError, ValueError, OSError) as e:
+                        log.derr("%s: activation merge for %s failed: %s",
+                                 self.entity, pg.pgid, e)
+                asyncio.get_running_loop().create_task(merge())
+
+    def _maybe_trim(self, pg: PG) -> None:
+        """Primary-side trim trigger: after enough appends, every acting
+        member trims its own log (PGLog::trim; each OSD only trims its
+        contiguous applied prefix, so an unapplied entry is never
+        silently claimed)."""
+        limit = self.conf["osd_pg_log_max_entries"]
+        if pg.appended_since_trim < max(limit // 2, 8):
+            return
+        pg.appended_since_trim = 0
+        asyncio.get_running_loop().create_task(
+            self._trim_log(pg.pgid, limit)
+        )
+        for shard, osd in pg.acting_peers():
+            self._send_osd(osd, Message("log_trim", {
+                "pgid": [pg.pgid.pool, pg.pgid.ps], "limit": limit,
+            }))
 
     # -- recovery ------------------------------------------------------------
-    async def _recover(self, pg: PG, missing: Mapping[int, list[str]]
-                       ) -> None:
-        """Rebuild stale shards (RecoveryOp READING->WRITING,
-        ECBackend.h:249; replicated push/pull, ReplicatedBackend.cc)."""
+    async def _recover(self, pg: PG, missing: MissingSet) -> int:
+        """Rebuild stale shards per the log-derived missing sets
+        (RecoveryOp READING->WRITING, ECBackend.h:249; replicated
+        push/pull, ReplicatedBackend.cc). Delete entries propagate as
+        removals — an object deleted while a member was away must not
+        resurrect. Returns the number of FAILED recoveries (the caller
+        must not merge/advance logs over unhealed objects)."""
         sem = asyncio.Semaphore(self.conf["osd_recovery_max_active"])
         if pg.is_ec:
-            by_oid: dict[str, list[int]] = {}
-            for shard, oids in missing.items():
-                for name in oids:
-                    by_oid.setdefault(name, []).append(shard)
+            return await self._recover_ec(pg, missing, sem)
+        return await self._recover_replicated(pg, missing, sem)
 
-            async def recover_one(name: str, shards: list[int]):
-                async with sem:
-                    try:
-                        await pg.backend.recover_shard(name, shards)
-                        self.perf.inc("recovery_ops")
-                    except (ShardReadError, IOError) as e:
-                        log.derr("pg %s: recover %s failed: %s",
-                                 pg.pgid, name, e)
+    async def _recover_ec(self, pg: PG, missing: MissingSet,
+                          sem: asyncio.Semaphore) -> int:
+        rebuild: dict[str, list[int]] = {}
+        target_version: dict[str, int] = {}
+        removals: list[tuple[int, str]] = []
+        for shard, need in missing.by_shard.items():
+            for name, entry in need.items():
+                if entry.op == OP_DELETE:
+                    removals.append((shard, name))
+                else:
+                    rebuild.setdefault(name, []).append(shard)
+                    target_version[name] = entry.obj_version
 
-            await asyncio.gather(*(
-                recover_one(n, s) for n, s in by_oid.items()
-            ))
-        else:
-            auth = pg.authoritative_versions()
-            cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
-            my_shard = pg.acting.index(self.osd_id)
-            mine = set(missing.get(my_shard, ()))
+        async def recover_one(name: str, shards: list[int]) -> bool:
+            async with sem:
+                try:
+                    # the log entry names the version to converge to —
+                    # a rewound object's stale shards still advertise
+                    # the dropped (higher) version in their attrs, so
+                    # the internal max-version guess would be wrong
+                    await pg.backend.recover_shard(
+                        name, shards,
+                        version=target_version.get(name) or None,
+                    )
+                    self.perf.inc("recovery_ops")
+                    return True
+                except (ShardReadError, IOError, KeyError) as e:
+                    log.derr("pg %s: recover %s failed: %s",
+                             pg.pgid, name, e)
+                    return False
 
-            async def pull(name: str):
-                """Fetch the newest copy from whichever peer has it."""
-                want = auth[name]
-                for info in pg.peer_infos.values():
-                    if info.objects.get(name, 0) == want \
-                            and info.osd != self.osd_id:
-                        full = await self.send_sub_op(
-                            info.osd, "read_full", cid=_enc_cid(cid),
-                            oid=name,
-                        )
-                        tx = StoreTx()
-                        oid = GHObject(pg.pgid.pool, name)
-                        tx.remove(cid, oid).write(
-                            cid, oid, 0, full["data"]
-                        )
-                        for aname, aval in full["attrs"].items():
-                            tx.setattr(cid, oid, aname, aval)
-                        if full["omap"]:
-                            tx.omap_setkeys(cid, oid, full["omap"])
-                        await self.store.queue_transactions(tx)
-                        return
+        async def remove_one(shard: int, name: str) -> bool:
+            async with sem:
+                try:
+                    await pg.backend.shards[shard].remove_shard(name)
+                    return True
+                except KeyError:
+                    return True
+                except (ShardReadError, IOError) as e:
+                    log.derr("pg %s: recovery-remove %s/%d failed: %s",
+                             pg.pgid, name, shard, e)
+                    return False
+
+        outcomes = await asyncio.gather(
+            *(recover_one(n, s) for n, s in rebuild.items()),
+            *(remove_one(s, n) for s, n in removals),
+        )
+        return sum(1 for ok in outcomes if not ok)
+
+    async def _recover_replicated(self, pg: PG, missing: MissingSet,
+                                  sem: asyncio.Semaphore) -> int:
+        cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
+        my_shard = (pg.acting.index(self.osd_id)
+                    if self.osd_id in pg.acting else NO_OSD)
+
+        def source_osd(name: str) -> int | None:
+            for shard in missing.sources.get(name, ()):
+                osd = pg.acting[shard]
+                if osd not in (self.osd_id, NO_OSD):
+                    return osd
+            return None
+
+        async def pull(name: str, entry: LogEntry):
+            obj = GHObject(pg.pgid.pool, name)
+            if entry.op == OP_DELETE:
+                if self.store.exists(cid, obj):
+                    await self.store.queue_transactions(
+                        StoreTx().remove(cid, obj)
+                    )
+                return
+            osd = source_osd(name)
+            if osd is None:
                 log.derr("pg %s: no source for %s", pg.pgid, name)
+                return
+            full = await self.send_sub_op(osd, "read_full",
+                                          cid=_enc_cid(cid), oid=name)
+            tx = StoreTx()
+            tx.remove(cid, obj).write(cid, obj, 0, full["data"])
+            for aname, aval in full["attrs"].items():
+                tx.setattr(cid, obj, aname, aval)
+            if full["omap"]:
+                tx.omap_setkeys(cid, obj, full["omap"])
+            await self.store.queue_transactions(tx)
 
-            async def push(name: str, osd: int):
-                data = self.store.read(cid, GHObject(pg.pgid.pool, name))
-                obj = GHObject(pg.pgid.pool, name)
+        async def push(name: str, entry: LogEntry, osd: int):
+            tx = StoreTx()
+            obj = GHObject(pg.pgid.pool, name)
+            if entry.op == OP_DELETE:
+                tx.remove(cid, obj)
+            else:
+                data = self.store.read(cid, obj)
                 attrs = self.store.getattrs(cid, obj)
                 omap = self.store.omap_get(cid, obj)
-                tx = StoreTx()
                 tx.remove(cid, obj).write(cid, obj, 0, data)
                 for aname, aval in attrs.items():
                     tx.setattr(cid, obj, aname, aval)
                 if omap:
                     tx.omap_setkeys(cid, obj, omap)
-                await self.send_sub_op(osd, "tx", cid=_enc_cid(cid),
-                                       ops=encode_tx(tx))
+            await self.send_sub_op(osd, "tx", cid=_enc_cid(cid),
+                                   ops=encode_tx(tx))
+            self.perf.inc("recovery_ops")
 
-            async def run_one(coro):
-                async with sem:
-                    try:
-                        await coro
-                    except (ConnectionError, KeyError, IOError) as e:
-                        log.derr("pg %s: recovery error: %s", pg.pgid, e)
+        async def run_one(coro) -> bool:
+            async with sem:
+                try:
+                    await coro
+                    return True
+                except (ConnectionError, KeyError, IOError) as e:
+                    log.derr("pg %s: recovery error: %s", pg.pgid, e)
+                    return False
 
-            # pull our own stale objects first, then push to stale peers
-            await asyncio.gather(*(run_one(pull(n)) for n in mine))
-            pushes = []
-            for shard, oids in missing.items():
-                osd = pg.acting[shard]
-                if osd in (self.osd_id, NO_OSD):
+        # pull our own stale objects first (we push from our copy next)
+        mine = missing.by_shard.get(my_shard, {})
+        pulls = await asyncio.gather(*(
+            run_one(pull(n, e)) for n, e in mine.items()
+        ))
+        pushes = []
+        for shard, need in missing.by_shard.items():
+            osd = pg.acting[shard]
+            if osd in (self.osd_id, NO_OSD):
+                continue
+            pushes.extend(run_one(push(n, e, osd))
+                          for n, e in need.items())
+        outcomes = list(pulls) + list(await asyncio.gather(*pushes))
+        return sum(1 for ok in outcomes if not ok)
+
+    async def _settle_attempt(self, pg: PG, reqid: str):
+        """Resolve a replayed op whose first attempt was allocated this
+        interval but never acked. Returns (rc, version) to reply with,
+        or (None, 0) when the first attempt provably wrote nothing and
+        plain re-execution is correct."""
+        a_oid, a_version = pg.attempted_reqids[reqid]
+        if not pg.is_ec or pg.backend is None:
+            # replicated: the blocking submit already exhausted its
+            # retries; the outcome stays indeterminate until an interval
+            # change lets the pg log decide
+            return EIO_RC, a_version
+        be: ECBackend = pg.backend
+        if a_oid in be._dirty:
+            if not await be.try_heal(a_oid):
+                return MISDIRECTED_RC, 0      # repair still retrying
+        # no dirty shards: decide from what the shards actually hold
+        if a_version == 0:
+            # a delete attempt: re-executing a remove is idempotent
+            pg.attempted_reqids.pop(reqid, None)
+            return None, 0
+        try:
+            have = 0
+            for r in await be._attr_all(a_oid, VERSION_ATTR):
+                if isinstance(r, BaseException):
                     continue
-                pushes.extend(run_one(push(n, osd)) for n in oids)
-            await asyncio.gather(*pushes)
+                try:
+                    if int(json.loads(r)["version"]) >= a_version:
+                        have += 1
+                except (ValueError, TypeError, KeyError):
+                    continue
+        except ShardReadError:
+            return EIO_RC, 0
+        if have >= be.k:
+            # fully readable at the attempted version: committed
+            pg.register_reqid(reqid, pg.log_seq, a_version)
+            return OK, a_version
+        if have == 0:
+            pg.attempted_reqids.pop(reqid, None)
+            return None, 0                    # nothing landed: re-execute
+        return EIO_RC, 0                      # partial beyond repair
 
     def _drain_waiters(self, pg: PG) -> None:
         waiters, pg.waiting_for_active = pg.waiting_for_active, []
@@ -607,22 +958,87 @@ class OSDDaemon:
                                           ops[0], tid)
                 return
             reqid = str(d.get("reqid", ""))
+            mutating = any(
+                op.get("op") not in ("read", "stat", "getxattr",
+                                     "getxattrs", "omap_get")
+                for op in ops
+            )
             cached = self._reqid_replies.get(reqid) if reqid else None
             if cached is not None:
                 self._reply(conn, tid, cached["rc"],
                             results=cached["results"],
                             version=cached["version"])
                 return
-            rc, results, version = await self._do_ops(
-                pg, str(d["oid"]), ops
-            )
-            if reqid and any(
-                op.get("op") not in ("read", "stat", "getxattr",
-                                     "getxattrs", "omap_get")
-                for op in ops
-            ):
-                # remember completed mutations only: replaying a read is
-                # harmless, replaying an append is not
+            # a resend of an op still EXECUTING attaches to the original
+            # attempt instead of re-executing (the reference parks the
+            # replay on the in-progress repop's completion)
+            inflight = self._inflight_ops.get(reqid) if reqid else None
+            if inflight is not None:
+                rc, results, version = await asyncio.shield(inflight)
+                self._reply(conn, tid, rc, results=results,
+                            version=version)
+                return
+            # the log-backed replay check: a resend whose mutation is
+            # already COMMITTED in the pg log (possibly applied under a
+            # previous primary and merged at activation) is answered
+            # from history, never re-executed (osd_reqid_t-in-pg_log
+            # dedup). Read-class ops in the batch still execute — only
+            # mutations are unsafe to replay.
+            if reqid and reqid in pg.reqid_index:
+                _, obj_version = pg.reqid_index[reqid]
+                results = []
+                for op in ops:
+                    if op.get("op") in ("read", "stat", "getxattr",
+                                        "getxattrs", "omap_get"):
+                        _, sub_results, _ = await self._do_ops(
+                            pg, str(d["oid"]), [op]
+                        )
+                        results.append(sub_results[0] if sub_results
+                                       else {})
+                    else:
+                        results.append({})
+                self._reply(conn, tid, OK, results=results,
+                            version=obj_version)
+                return
+            # a resend of an op ATTEMPTED this interval but never acked:
+            # settle the first attempt instead of re-executing (which
+            # would double-apply its already-committed shard writes)
+            if reqid and mutating and reqid in pg.attempted_reqids:
+                rc2, version2 = await self._settle_attempt(pg, reqid)
+                if rc2 is not None:
+                    self._reply(conn, tid, rc2,
+                                results=[{} for _ in ops],
+                                version=version2,
+                                epoch=self.osdmap.epoch
+                                if self.osdmap else 0)
+                    return
+                # first attempt provably wrote nothing: safe re-execute
+            track = bool(reqid) and mutating
+            if track:
+                fut = asyncio.get_running_loop().create_future()
+                self._inflight_ops[reqid] = fut
+            try:
+                rc, results, version = await self._do_ops(
+                    pg, str(d["oid"]), ops, reqid
+                )
+            except BaseException:
+                if track:
+                    self._inflight_ops.pop(reqid, None)
+                    if not fut.done():
+                        fut.set_exception(
+                            ShardReadError("op attempt failed")
+                        )
+                        fut.exception()     # mark retrieved
+                raise
+            if track:
+                self._inflight_ops.pop(reqid, None)
+                if not fut.done():
+                    fut.set_result((rc, results, version))
+            if track and rc == OK:
+                # only a fully-acked commit registers for replay dedup:
+                # registering earlier would falsely ack a failed or
+                # partially-committed attempt from history
+                pg.register_reqid(reqid, pg.log_seq, version)
                 self._reqid_replies[reqid] = {
                     "rc": rc, "results": results, "version": version,
                 }
@@ -726,36 +1142,51 @@ class OSDDaemon:
         except ConnectionError:
             pass
 
-    async def _do_ops(self, pg: PG, oid: str, ops: list[dict]):
+    async def _do_ops(self, pg: PG, oid: str, ops: list[dict],
+                      reqid: str = ""):
         """The op interpreter (do_osd_ops, PrimaryLogPG.cc:5652)."""
         if pg.is_ec:
-            return await self._do_ops_ec(pg, oid, ops)
-        return await self._do_ops_replicated(pg, oid, ops)
+            return await self._do_ops_ec(pg, oid, ops, reqid)
+        return await self._do_ops_replicated(pg, oid, ops, reqid)
 
     # -- EC op path ----------------------------------------------------------
-    async def _do_ops_ec(self, pg: PG, oid: str, ops: list[dict]):
+    async def _do_ops_ec(self, pg: PG, oid: str, ops: list[dict],
+                         batch_reqid: str = ""):
         be: ECBackend = pg.backend
         results: list[dict] = []
         version = 0
+        # EC batches are not atomic across ops (each mutation is its own
+        # shard fan-out), so the reqid rides ONLY the LAST mutating op's
+        # log entry: its presence in the log proves the whole batch ran
+        # to completion — a partial batch must re-execute on replay, not
+        # be answered OK from the first op's entry
+        mutating_kinds = ("write", "writefull", "append", "truncate",
+                          "remove", "create", "setxattr")
+        last_mut = max((i for i, op in enumerate(ops)
+                        if op.get("op") in mutating_kinds), default=-1)
         try:
-            for op in ops:
+            for opi, op in enumerate(ops):
                 kind = op["op"]
+                reqid = batch_reqid if opi == last_mut else ""
                 if kind == "write":
                     meta = await be.write(oid, op["data"],
-                                          int(op.get("off", 0)))
+                                          int(op.get("off", 0)),
+                                          reqid=reqid)
                     version = meta.version
                     results.append({})
                 elif kind == "writefull":
                     old = await be._read_meta(oid)
                     if old is not None and old.size > len(op["data"]):
-                        await be.remove(oid)
-                    meta = await be.write(oid, op["data"], 0)
+                        await be.remove(oid, reqid=reqid)
+                    meta = await be.write(oid, op["data"], 0,
+                                          reqid=reqid)
                     version = meta.version
                     results.append({})
                 elif kind == "append":
                     meta = await be._read_meta(oid)
                     off = meta.size if meta else 0
-                    meta = await be.write(oid, op["data"], off)
+                    meta = await be.write(oid, op["data"], off,
+                                          reqid=reqid)
                     version = meta.version
                     results.append({})
                 elif kind == "truncate":
@@ -767,13 +1198,15 @@ class OSDDaemon:
                     if nsize < cur:
                         keep = await be.read(oid, 0, nsize)
                         await be.remove(oid)
-                        meta = await be.write(oid, keep, 0)
+                        meta = await be.write(oid, keep, 0,
+                                              reqid=reqid)
                     elif nsize > cur:
                         meta = await be.write(
-                            oid, b"\0" * (nsize - cur), cur
+                            oid, b"\0" * (nsize - cur), cur,
+                            reqid=reqid,
                         )
                     elif meta is None:
-                        meta = await be.write(oid, b"", 0)
+                        meta = await be.write(oid, b"", 0, reqid=reqid)
                     version = meta.version
                     results.append({})
                 elif kind == "read":
@@ -790,17 +1223,17 @@ class OSDDaemon:
                     meta = await be._read_meta(oid)
                     if meta is None:
                         return ENOENT_RC, results, 0
-                    await be.remove(oid)
+                    await be.remove(oid, reqid=reqid)
                     results.append({})
                 elif kind == "create":
                     meta = await be._read_meta(oid)
                     if meta is None:
-                        meta = await be.write(oid, b"", 0)
+                        meta = await be.write(oid, b"", 0, reqid=reqid)
                     version = meta.version
                     results.append({})
                 elif kind == "setxattr":
                     await be.set_attr(oid, XATTR_PREFIX + op["name"],
-                                      op["value"])
+                                      op["value"], reqid=reqid)
                     results.append({})
                 elif kind == "getxattr":
                     raw = await be._get_attr_any(
@@ -824,13 +1257,30 @@ class OSDDaemon:
                     return EINVAL_RC, results, 0
         except KeyError:
             return ENOENT_RC, results, 0
+        except ECWriteDegraded as e:
+            # a live shard missed the commit: not acked, but recoverable
+            # (repair already scheduled). Hold the op until the repair
+            # heals it or the interval changes, so a resend arriving
+            # after our MISDIRECTED reply is decided by the pg log
+            # (committed-and-merged answers OK; rewound re-executes) —
+            # never blindly re-executed while the first attempt's shard
+            # writes are still settling.
+            log.dout(5, "pg %s: EC op degraded, client will retry: %s",
+                     pg.pgid, e)
+            epoch = pg.epoch
+            deadline = time.monotonic() + 5.0
+            while pg.epoch == epoch and time.monotonic() < deadline \
+                    and not self._stopped:
+                await asyncio.sleep(0.1)
+            return MISDIRECTED_RC, results, 0
         except ShardReadError as e:
             log.derr("pg %s: EC op failed: %s", pg.pgid, e)
             return EIO_RC, results, 0
         return OK, results, version
 
     # -- replicated op path ----------------------------------------------------
-    async def _do_ops_replicated(self, pg: PG, oid: str, ops: list[dict]):
+    async def _do_ops_replicated(self, pg: PG, oid: str, ops: list[dict],
+                                 reqid: str = ""):
         """The replicated-pool op interpreter. All reads go through a
         batch-local overlay of the pending mutations, so every op in the
         batch — including object-class calls — observes the effects of
@@ -849,6 +1299,7 @@ class OSDDaemon:
                 )["version"])
             except (KeyError, ValueError):
                 version = 1
+        prior_version = version
         mutated = False
 
         # -- batch overlay: lazily materialized object state ------------
@@ -1095,6 +1546,15 @@ class OSDDaemon:
                 tx.setattr(cid, obj, VERSION_ATTR, json.dumps(
                     {"size": cur_size(), "version": version}
                 ).encode())
+            # the pg log entry commits in the SAME transaction as the
+            # mutation on every member (PGLog atomicity contract)
+            entry = pg.next_entry(
+                pg.epoch, oid,
+                OP_MODIFY if exists else OP_DELETE,
+                version if exists else 0, prior_version, reqid,
+            )
+            pg_log.append_ops(tx, pg.pgid.pool, pg.pgid.ps, entry)
+            self._maybe_trim(pg)
             rc = await self._submit_replicated(pg, tx)
             if rc != OK:
                 return rc, results, version
@@ -1102,9 +1562,12 @@ class OSDDaemon:
 
     async def _submit_replicated(self, pg: PG, tx: StoreTx) -> int:
         """Primary-copy replication: local apply + MOSDRepOp to every
-        replica, ack once >= min_size copies committed
-        (ReplicatedBackend.cc:462; degraded writes allowed down to
-        min_size, recovery heals the rest)."""
+        replica; the ack requires EVERY live acting member to commit
+        (the reference semantics — repop completion waits for the whole
+        acting set). This is what makes the pg-log rewind rule safe: an
+        entry absent from the authoritative log was never acked to any
+        client. Degraded operation = acting-set holes (NO_OSD), not
+        skipped live members."""
         await self.store.queue_transactions(tx)
         wire = encode_tx(tx)
         replicas = [osd for osd in set(pg.acting)
@@ -1116,13 +1579,37 @@ class OSDDaemon:
                              ops=wire)
             for osd in replicas
         ), return_exceptions=True)
-        committed = 1 + sum(
-            1 for r in results if not isinstance(r, BaseException)
-        )
-        if committed < min(pg.pool.min_size, len(pg.acting)):
-            log.derr("pg %s: only %d/%d copies committed",
-                     pg.pgid, committed, len(pg.acting))
+        live = 1 + len(replicas)
+        if live < min(pg.pool.min_size, len(pg.acting)):
             return EIO_RC
+        failed = [osd for osd, r in zip(replicas, results)
+                  if isinstance(r, BaseException)]
+        if not failed:
+            return OK
+        # not committed everywhere: BLOCK and keep re-pushing (the
+        # reference repop waits for the whole acting set). Resends of
+        # this reqid attach to this attempt via _inflight_ops. Exit on
+        # interval change (EIO -> the client resends and the pg-log
+        # replay check decides: committed-and-merged answers OK, rewound
+        # re-executes) or after a deadline. MISDIRECTED tells the client
+        # to refresh the map and resend.
+        epoch = pg.epoch
+        cid_wire = _enc_cid(CollectionId(pg.pgid.pool, pg.pgid.ps))
+        deadline = time.monotonic() + 20.0
+        log.dout(5, "pg %s: copies missing on %s; blocking re-push",
+                 pg.pgid, failed)
+        while failed:
+            if pg.epoch != epoch or self._stopped:
+                return MISDIRECTED_RC
+            if time.monotonic() > deadline:
+                return EIO_RC
+            await asyncio.sleep(0.1)
+            retry = await asyncio.gather(*(
+                self.send_sub_op(osd, "tx", cid=cid_wire, ops=wire)
+                for osd in failed
+            ), return_exceptions=True)
+            failed = [osd for osd, r in zip(failed, retry)
+                      if isinstance(r, BaseException)]
         return OK
 
     # -- sub ops (shard/replica server side) -----------------------------------
@@ -1195,6 +1682,7 @@ class OSDDaemon:
                                          d["data"])
                     for name, val in d.get("attrs", {}).items():
                         tx.setattr(cid, oid, name, val)
+                    self._attach_log(tx, cid, d)
                     await self.store.queue_transactions(tx)
                 elif kind == "read":
                     value = self.store.read(cid, oid, int(d["off"]),
@@ -1204,9 +1692,9 @@ class OSDDaemon:
                 elif kind == "getattrs":
                     value = dict(self.store.getattrs(cid, oid))
                 elif kind == "remove":
-                    await self.store.queue_transactions(
-                        StoreTx().remove(cid, oid)
-                    )
+                    tx = StoreTx().remove(cid, oid)
+                    self._attach_log(tx, cid, d)
+                    await self.store.queue_transactions(tx)
                 elif kind == "stat":
                     value = self.store.stat(cid, oid)
                 elif kind == "read_full":
@@ -1225,6 +1713,13 @@ class OSDDaemon:
         except Exception as e:               # noqa: BLE001
             log.derr("%s: sub_op failed: %s", self.entity, e)
             self._sub_reply(conn, tid, EIO_RC)
+
+    def _attach_log(self, tx: StoreTx, cid: CollectionId, d: dict) -> None:
+        """Ride the sender's pg log entry in the same transaction as the
+        shard mutation (per-shard log atomicity, MOSDECSubOpWrite)."""
+        if d.get("log"):
+            pg_log.append_ops(tx, cid.pool, cid.pg,
+                              LogEntry.from_wire(d["log"]))
 
     def _sub_reply(self, conn: Connection, tid: int, rc: int,
                    value=None) -> None:

@@ -43,14 +43,18 @@ VERSION_ATTR = "version"
 
 
 class ShardIO(Protocol):
-    """One shard's IO endpoint (local store or remote OSD)."""
+    """One shard's IO endpoint (local store or remote OSD). ``log`` on
+    mutations is an optional PG log entry applied atomically with the
+    shard write on the owning OSD (the per-shard pg_log ride-along of
+    MOSDECSubOpWrite, reference ECBackend.cc:2090)."""
 
     async def write_shard(self, oid: str, offset: int, data: bytes,
-                          attrs: Mapping[str, bytes]) -> None: ...
+                          attrs: Mapping[str, bytes],
+                          log=None) -> None: ...
     async def read_shard(self, oid: str, offset: int = 0,
                          length: int | None = None) -> bytes: ...
     async def get_attr(self, oid: str, name: str) -> bytes: ...
-    async def remove_shard(self, oid: str) -> None: ...
+    async def remove_shard(self, oid: str, log=None) -> None: ...
     async def stat_shard(self, oid: str) -> dict: ...
 
 
@@ -67,11 +71,17 @@ class LocalShard:
     def _oid(self, name: str) -> GHObject:
         return GHObject(self.pool, name, shard=self.shard)
 
-    async def write_shard(self, oid, offset, data, attrs):
+    def _log_ops(self, t: Transaction, log) -> Transaction:
+        if log is not None:
+            from ceph_tpu.osd import pg_log
+            pg_log.append_ops(t, self.cid.pool, self.cid.pg, log)
+        return t
+
+    async def write_shard(self, oid, offset, data, attrs, log=None):
         t = Transaction().write(self.cid, self._oid(oid), offset, data)
         for name, val in attrs.items():
             t.setattr(self.cid, self._oid(oid), name, val)
-        await self.store.queue_transactions(t)
+        await self.store.queue_transactions(self._log_ops(t, log))
 
     async def read_shard(self, oid, offset=0, length=None):
         return self.store.read(self.cid, self._oid(oid), offset, length)
@@ -79,10 +89,10 @@ class LocalShard:
     async def get_attr(self, oid, name):
         return self.store.getattr(self.cid, self._oid(oid), name)
 
-    async def remove_shard(self, oid):
-        await self.store.queue_transactions(
-            Transaction().remove(self.cid, self._oid(oid))
-        )
+    async def remove_shard(self, oid, log=None):
+        await self.store.queue_transactions(self._log_ops(
+            Transaction().remove(self.cid, self._oid(oid)), log
+        ))
 
     async def stat_shard(self, oid):
         return self.store.stat(self.cid, self._oid(oid))
@@ -93,6 +103,12 @@ class LocalShard:
 
 class ShardReadError(IOError):
     pass
+
+
+class ECWriteDegraded(ShardReadError):
+    """A live shard missed a strict-mode mutation: the op is NOT acked
+    (retryable — the data remains reconstructable and repair is already
+    scheduled), distinct from an unrecoverable >m failure."""
 
 
 @dataclass
@@ -107,9 +123,13 @@ class ECBackend:
         codec,
         shards: Mapping[int, ShardIO],
         stripe_unit: int | None = None,
+        log_hook=None,
     ):
         """``codec``: an initialised ErasureCodeInterface; ``shards``:
-        shard id -> ShardIO for all k+m positions."""
+        shard id -> ShardIO for all k+m positions. ``log_hook(oid, op,
+        obj_version, prior_version)`` (daemon-provided) allocates the PG
+        log entry that rides every shard mutation; None = no logging
+        (standalone/library use)."""
         self.ec = codec
         self.k = codec.get_data_chunk_count()
         self.n = codec.get_chunk_count()
@@ -121,11 +141,24 @@ class ECBackend:
                 f"stripe_unit {unit} not aligned to codec alignment {align}"
             )
         self.sinfo = StripeInfo(self.k, unit)
+        self.log_hook = log_hook
+        # logged mode is STRICT: every live shard must commit a mutation
+        # before it is acked (acting-set holes stay tolerated up to m).
+        # This is what makes log-based rewind safe — an entry absent from
+        # the authoritative log was never acked. Standalone (unlogged)
+        # use keeps the lenient tolerate-and-eager-repair behavior.
+        self.strict = log_hook is not None
         self.shards = dict(shards)
         if set(self.shards) != set(range(self.n)):
             raise ValueError(f"need shards 0..{self.n - 1}")
         self._object_locks: dict[str, tuple[asyncio.Lock, int]] = {}
         self._repair_tasks: set[asyncio.Task] = set()
+        # oid -> shards known stale from a failed mutation: a subsequent
+        # write must heal them FIRST — otherwise its version bump would
+        # make the stale shard pass the per-object version check and
+        # serve corrupt ranges (version granularity is the object, not
+        # the stripe)
+        self._dirty: dict[str, set[int]] = {}
 
     def _lock(self, oid: str):
         """Per-object write lock, refcounted so the table doesn't grow
@@ -223,11 +256,30 @@ class ECBackend:
             {"size": meta.size, "version": meta.version}
         ).encode()
 
+    async def _target_meta(self, oid: str,
+                           version: int | None) -> ECObjectMeta | None:
+        """Metadata at a PINNED version (any shard that matches), or the
+        max-version choice when no target is given."""
+        if version is None:
+            return await self._read_meta(oid)
+        for r in await self._attr_all(oid, VERSION_ATTR):
+            if isinstance(r, BaseException):
+                continue
+            try:
+                d = json.loads(r)
+            except (ValueError, TypeError):
+                continue
+            if int(d.get("version", -1)) == version:
+                return ECObjectMeta(int(d["size"]), version)
+        raise ShardReadError(f"no shard holds {oid} at version {version}")
+
     # -- write -----------------------------------------------------------
     async def write(self, oid: str, data: bytes, offset: int = 0,
-                    version: int | None = None) -> ECObjectMeta:
+                    version: int | None = None,
+                    reqid: str = "") -> ECObjectMeta:
         """Write ``data`` at logical ``offset`` (stripe-granular RMW)."""
         async with self._lock(oid):
+            await self._heal_dirty(oid)
             meta = await self._read_meta(oid)
             old_size = meta.size if meta else 0
             new_version = (
@@ -264,37 +316,138 @@ class ECBackend:
             hattrs = await self._update_hinfo(
                 oid, shard_off, shard_bytes, old_size
             )
+            entry = (self.log_hook(oid, "modify", new_version,
+                                   meta.version if meta else 0, reqid)
+                     if self.log_hook else None)
             results = await asyncio.gather(*(
                 self.shards[i].write_shard(
                     oid, shard_off, shard_bytes[i].tobytes(),
                     {VERSION_ATTR: meta_attr, HINFO_ATTR: hattrs[i]},
+                    log=entry,
                 )
                 for i in range(self.n)
             ), return_exceptions=True)
             failed = [i for i, r in enumerate(results)
                       if isinstance(r, BaseException)]
-            if len(failed) > self.m:
-                raise ShardReadError(
-                    f"write {oid}: {len(failed)} shards failed "
-                    f"({failed}), data unrecoverable beyond m={self.m}"
-                )
-            if failed:
-                # degraded write: reads are safe (stale shards fail the
-                # version check in _read_shard_range) but heal eagerly so
-                # redundancy is restored without waiting for re-peering
-                self._schedule_repair(oid, failed)
+            await self._settle_write_failures(
+                "write", oid, failed,
+                lambda live: self._heal_shards(oid, live, entry),
+                entry,
+            )
             return ECObjectMeta(new_size, new_version)
 
-    def _schedule_repair(self, oid: str, shards: list[int]) -> None:
+    async def _settle_write_failures(self, what: str, oid: str,
+                                     failed: list[int], heal,
+                                     entry=None) -> None:
+        """Resolve a mutation's shard failures. Strict (logged) mode: a
+        live-shard miss is healed SYNCHRONOUSLY (``heal``, e.g. rebuild
+        from the shards that did commit) so the op still acks as fully
+        committed; if healing fails, ECWriteDegraded marks a retryable
+        non-ack. Lenient mode keeps tolerate-and-eager-repair. Beyond m
+        failures the data is unrecoverable either way."""
+        if not failed:
+            return
+        live = [i for i in failed
+                if not getattr(self.shards[i], "is_dead", False)]
+        if len(failed) > self.m:
+            raise ShardReadError(
+                f"{what} {oid}: shards {failed} failed "
+                f"(live: {live}, m={self.m}), beyond recoverability"
+            )
+        if self.strict and live:
+            try:
+                await heal(live)
+            except (ShardReadError, IOError, KeyError) as e:
+                # mark the shards stale (gates later writes on healing
+                # them) and keep a background repair retrying
+                self._schedule_repair(oid, live, entry)
+                raise ECWriteDegraded(
+                    f"{what} {oid}: live shards {live} missed the "
+                    f"commit and healing failed: {e}"
+                ) from e
+        elif live:
+            # degraded write: reads stay safe (stale shards fail the
+            # version check) but heal eagerly so redundancy is restored
+            # without waiting for re-peering
+            self._schedule_repair(oid, live, entry)
+
+    def _schedule_repair(self, oid: str, shards: list[int],
+                         entry=None) -> None:
+        self._dirty.setdefault(oid, set()).update(shards)
+
         async def repair():
             try:
-                await self.recover_shard(oid, shards)
+                await self._heal_shards(oid, shards, entry)
             except (ShardReadError, IOError, KeyError):
-                pass        # shard still down; peering recovery will heal
+                return      # shard still down; heal-on-next-write or
+                            # peering recovery takes over
+            dirty = self._dirty.get(oid)
+            if dirty is not None:
+                dirty.difference_update(shards)
+                if not dirty:
+                    del self._dirty[oid]
 
         task = asyncio.get_running_loop().create_task(repair())
         self._repair_tasks.add(task)
         task.add_done_callback(self._repair_tasks.discard)
+
+    async def _heal_shards(self, oid: str, shards: list[int],
+                           entry=None) -> None:
+        """Bring stale shards current: rebuild from survivors — or, when
+        a quorum of shards affirms the object is GONE (a failed remove
+        left a straggler), propagate the removal instead. ``entry``
+        (when known) is appended to the healed shards' pg logs so the
+        heal commits the HISTORY too: a data-healed shard with a log gap
+        would undercount appliers in the EC peering filter and could get
+        an acked write rewound."""
+        shards = sorted(shards)
+        absent = sum(
+            1 for r in await self._attr_all(oid, VERSION_ATTR)
+            if isinstance(r, KeyError)
+        )
+        if absent >= self.k:
+            for i in shards:
+                try:
+                    await self.shards[i].remove_shard(oid, log=entry)
+                except KeyError:
+                    pass
+            return
+        await self.recover_shard(oid, shards)
+        if entry is not None:
+            await asyncio.gather(*(
+                self.shards[i].write_shard(oid, 0, b"", {}, log=entry)
+                for i in shards
+            ))
+
+    async def _heal_dirty(self, oid: str) -> None:
+        """Called under the object lock before a mutation: stale shards
+        from an earlier failed attempt must be rebuilt before a new
+        version bump could mask them."""
+        dirty = self._dirty.get(oid)
+        if not dirty:
+            return
+        try:
+            await self._heal_shards(oid, sorted(dirty))
+        except (ShardReadError, IOError, KeyError) as e:
+            if self.strict:
+                raise ECWriteDegraded(
+                    f"{oid}: stale shards {sorted(dirty)} from a prior "
+                    f"failed write are unhealed: {e}"
+                ) from e
+            return          # lenient: the new write fails there again,
+                            # keeping the shard detectably stale
+        self._dirty.pop(oid, None)
+
+    async def try_heal(self, oid: str) -> bool:
+        """Settle a prior attempt's shard gaps (used by the daemon when
+        a client replays a not-yet-acked op): True when the object has
+        no dirty shards left."""
+        async with self._lock(oid):
+            try:
+                await self._heal_dirty(oid)
+            except ShardReadError:
+                return False
+            return oid not in self._dirty
 
     async def _update_hinfo(self, oid: str, shard_off: int,
                             shard_bytes: list[np.ndarray],
@@ -469,13 +622,18 @@ class ECBackend:
         return data[rel: rel + length]
 
     # -- object metadata ops (fan-out; metadata is replicated per shard) --
-    async def remove(self, oid: str) -> None:
+    async def remove(self, oid: str, reqid: str = "") -> None:
         """Remove every shard object. A shard that lacks it is fine; IO
         failures beyond m mean the removal did not take and must raise
         (a silently-surviving shard would resurrect the object)."""
+        meta = await self._read_meta(oid) if self.log_hook else None
+        entry = (self.log_hook(oid, "delete", 0,
+                               meta.version if meta else 0, reqid)
+                 if self.log_hook else None)
+
         async def rm(i: int):
             try:
-                await self.shards[i].remove_shard(oid)
+                await self.shards[i].remove_shard(oid, log=entry)
             except KeyError:
                 pass                # already absent on this shard
         results = await asyncio.gather(
@@ -483,18 +641,26 @@ class ECBackend:
         )
         failed = [i for i, r in enumerate(results)
                   if isinstance(r, BaseException)]
-        if len(failed) > self.m:
-            raise ShardReadError(
-                f"remove {oid}: {len(failed)} shards failed ({failed})"
-            )
 
-    async def set_attr(self, oid: str, name: str, value: bytes) -> None:
+        async def heal(live):
+            for i in live:
+                try:
+                    await self.shards[i].remove_shard(oid, log=entry)
+                except KeyError:
+                    pass
+        await self._settle_write_failures("remove", oid, failed, heal,
+                                          entry)
+        self._dirty.pop(oid, None)      # nothing left to be stale about
+
+    async def set_attr(self, oid: str, name: str, value: bytes,
+                       reqid: str = "") -> None:
         """Set one attr on all shards (zero-length data write carries it);
         tolerates up to m dead shards like a degraded data write. The
         per-object version is bumped and rewritten with the attr so a
         shard that missed the write is distinguishable from a current
         one (stale-version detection, like the degraded data path)."""
         async with self._lock(oid):
+            await self._heal_dirty(oid)
             meta = await self._read_meta(oid)
             new_meta = ECObjectMeta(
                 meta.size if meta else 0,
@@ -502,19 +668,20 @@ class ECBackend:
             )
             attrs = {name: bytes(value),
                      VERSION_ATTR: self._meta_attr(new_meta)}
+            entry = (self.log_hook(oid, "modify", new_meta.version,
+                                   meta.version if meta else 0, reqid)
+                     if self.log_hook else None)
             results = await asyncio.gather(*(
-                self.shards[i].write_shard(oid, 0, b"", attrs)
+                self.shards[i].write_shard(oid, 0, b"", attrs, log=entry)
                 for i in range(self.n)
             ), return_exceptions=True)
             failed = [i for i, r in enumerate(results)
                       if isinstance(r, BaseException)]
-            if len(failed) > self.m:
-                raise ShardReadError(
-                    f"set_attr {oid}: {len(failed)} shards failed "
-                    f"({failed})"
-                )
-            if failed:
-                self._schedule_repair(oid, failed)
+            await self._settle_write_failures(
+                "set_attr", oid, failed,
+                lambda live: self._heal_shards(oid, live, entry),
+                entry,
+            )
 
     async def get_attrs(self, oid: str) -> dict[str, bytes]:
         """All attrs, from the answering shard with the HIGHEST stored
@@ -553,11 +720,16 @@ class ECBackend:
         raise ShardReadError(f"get_attrs {oid}: {errors}")
 
     # -- recovery --------------------------------------------------------
-    async def recover_shard(self, oid: str, lost: Sequence[int]) -> None:
+    async def recover_shard(self, oid: str, lost: Sequence[int],
+                            version: int | None = None) -> None:
         """Rebuild lost shard objects from survivors (RecoveryOp).
         Source shards are version-verified so a stale survivor (missed
-        degraded write) counts as lost, not as a rebuild source."""
-        meta = await self._read_meta(oid)
+        degraded write) counts as lost, not as a rebuild source.
+        ``version`` pins the target explicitly (log-driven recovery,
+        incl. REWIND: rebuilding shards that applied a dropped entry
+        back to the prior version — their own attrs advertise the
+        dropped version, so the max-version guess must not be used)."""
+        meta = await self._target_meta(oid, version)
         if meta is None:
             raise KeyError(f"no such object {oid}")
         shard_len = self.sinfo.logical_to_next_chunk_offset(meta.size)

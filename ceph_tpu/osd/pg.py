@@ -4,10 +4,20 @@ The role of reference src/osd/PG.{h,cc} + PeeringState.{h,cc}: each PG
 tracks its interval (epoch + acting/up sets), runs peering on the primary
 (Initial -> Peering -> Active, the boost::statechart machine of
 PeeringState.h:556 collapsed to explicit async states), and computes what
-needs recovery. Instead of the pg_log/missing-set machinery (PGLog.h), the
-authoritative state is a per-object version inventory gathered from every
-acting shard during peering — the same outcome (per-peer missing sets)
-computed from object metadata rather than replicated op logs.
+needs recovery.
+
+Peering is LOG-BASED (PGLog.h / pg_log_entry_t, osd_types.h:4038): every
+acting member reports its retained log window; the authoritative log is
+the one with the max (epoch, seq) head (the max-last-update choice of
+PeeringState::find_best_info); per-peer missing sets are computed from
+which entry seqs each peer has applied; peers whose own log carries
+entries ABOVE the authoritative head or conflicting with it are divergent
+and rewound (their touched objects re-recovered from authoritative
+copies — the whole-object form of rollback, osd_types.h:4244
+can_rollback_to). A peer whose log head predates the authoritative tail
+no longer connects and falls back to BACKFILL: the full object-inventory
+comparison (the log-recovery-vs-backfill split of
+doc/dev/osd_internals/log_based_pg.rst).
 
 Object -> PG mapping: ``ps = ceph_str_hash_rjenkins(name) % pg_num``
 (reference pg_pool_t::hash / ceph_str_hash, src/common/ceph_hash.cc).
@@ -19,6 +29,13 @@ import asyncio
 from dataclasses import dataclass, field
 
 from ceph_tpu.common.log import Dout
+from ceph_tpu.osd.pg_log import (
+    LogEntry,
+    OP_DELETE,
+    OP_MODIFY,
+    head_of,
+    latest_per_object,
+)
 from ceph_tpu.placement.hashing import ceph_str_hash_rjenkins
 from ceph_tpu.osd.osd_map import NO_OSD, PoolInfo
 
@@ -48,10 +65,39 @@ STATE_REPLICA = "replica"
 
 @dataclass
 class PeerInfo:
-    """One shard's inventory reply (the MOSDPGNotify info analog)."""
+    """One shard's peering reply (the MOSDPGNotify info analog): its
+    retained log window + tail; ``objects`` (full inventory) is only
+    populated on the backfill path."""
     shard: int
     osd: int
-    objects: dict[str, int] = field(default_factory=dict)  # name -> version
+    log: dict[int, LogEntry] = field(default_factory=dict)
+    tail: int = 0
+    objects: dict[str, int] | None = None   # name -> version (backfill)
+
+    @property
+    def head(self) -> tuple[int, int]:
+        return head_of(self.log)
+
+
+@dataclass
+class MissingSet:
+    """Recovery plan for one interval (the PeeringState missing-sets +
+    MissingLoc outcome)."""
+    # shard -> {oid: authoritative LogEntry} to recover on that shard
+    by_shard: dict[int, dict[str, LogEntry]] = field(default_factory=dict)
+    # oid -> shards that hold the current version (recovery sources)
+    sources: dict[str, set[int]] = field(default_factory=dict)
+    # shards that need full-inventory backfill instead of log recovery
+    backfill: set[int] = field(default_factory=set)
+    # the AUTHORITATIVE history this interval converges to (for EC,
+    # already filtered to reconstructable entries) — the activation
+    # merge window must be exactly this, so a rewound entry is removed
+    # from every member's log rather than re-adopted
+    auth_log: dict[int, LogEntry] = field(default_factory=dict)
+    auth_tail: int = 0
+
+    def total(self) -> int:
+        return sum(len(v) for v in self.by_shard.values())
 
 
 class PG:
@@ -66,9 +112,23 @@ class PG:
         self.primary = NO_OSD
         self.waiting_for_active: list = []   # queued client ops
         self.peer_infos: dict[int, PeerInfo] = {}   # shard -> info
-        self.missing: dict[int, list[str]] = {}     # shard -> stale oids
+        self.missing = MissingSet()
         self.peering_task: asyncio.Task | None = None
         self.backend = None             # set by the daemon per interval
+        self.ec_k = 0                   # EC data-chunk count (0 = replicated)
+        self.log_seq = 0                # next entry seq (primary allocates)
+        self.appended_since_trim = 0
+        # reqid -> (seq, obj_version): answers client replays from
+        # history (rebuilt from the merged log at activation, so it
+        # survives primary failover)
+        self.reqid_index: dict[str, tuple[int, int]] = {}
+        # reqid -> (oid, obj_version) allocated THIS interval but not
+        # (yet) fully committed: a same-interval resend must settle the
+        # first attempt (heal its shard gaps) instead of re-executing
+        self.attempted_reqids: dict[str, tuple[str, int]] = {}
+        # serializes log maintenance (activation merge vs trim) so their
+        # read-modify-write cycles cannot interleave and regress the tail
+        self.log_lock = asyncio.Lock()
 
     # -- interval handling -------------------------------------------------
     @property
@@ -97,7 +157,11 @@ class PG:
         self.up = list(up)
         self.primary = primary
         self.peer_infos = {}
-        self.missing = {}
+        self.missing = MissingSet()
+        # attempted (allocated, possibly partially committed) reqids are
+        # interval-scoped: across an interval change the merged pg log
+        # is the only truth about what survived
+        self.attempted_reqids = {}
         if self.peering_task is not None:
             self.peering_task.cancel()
             self.peering_task = None
@@ -105,6 +169,44 @@ class PG:
         log.dout(10, "pg %s interval e%d acting %s primary %d role %s",
                  self.pgid, epoch, acting, primary,
                  "primary" if self.is_primary else "replica")
+
+    # -- log bookkeeping ----------------------------------------------------
+    def next_entry(self, epoch: int, oid: str, op: str, obj_version: int,
+                   prior_version: int = 0, reqid: str = "") -> LogEntry:
+        """Primary-side seq allocation for a new mutation's log entry.
+        NOTE: allocation does NOT register the reqid for replay dedup —
+        only a fully-acked commit may (register_reqid); an op that fails
+        after allocation must be re-executable, not falsely acked from
+        history."""
+        self.log_seq += 1
+        self.appended_since_trim += 1
+        if reqid:
+            self.attempted_reqids[reqid] = (oid, obj_version)
+            if len(self.attempted_reqids) > 8192:
+                self.attempted_reqids.clear()   # interval-scoped scratch
+        return LogEntry(self.log_seq, epoch, oid, op, obj_version,
+                        prior_version, reqid)
+
+    def register_reqid(self, reqid: str, seq: int,
+                       obj_version: int) -> None:
+        """Record a COMMITTED mutation for replay dedup."""
+        self.reqid_index[reqid] = (seq, obj_version)
+        if len(self.reqid_index) > 4096:
+            # bounded like the log itself: a replay older than the
+            # retained window re-executes (reference has the same
+            # log-length dedup horizon)
+            for rid in sorted(self.reqid_index,
+                              key=lambda r: self.reqid_index[r][0]
+                              )[:1024]:
+                del self.reqid_index[rid]
+
+    def rebuild_reqid_index(self, entries: dict[int, LogEntry]) -> None:
+        # seq order so a reqid appearing on several entries (e.g. a
+        # writefull's remove+write pair) resolves to the final one
+        self.reqid_index = {
+            entries[s].reqid: (s, entries[s].obj_version)
+            for s in sorted(entries) if entries[s].reqid
+        }
 
     # -- peering bookkeeping (primary) -------------------------------------
     def acting_peers(self) -> list[tuple[int, int]]:
@@ -121,30 +223,118 @@ class PG:
         want = {shard for shard, _ in self.acting_peers()}
         return want <= set(self.peer_infos)
 
-    def authoritative_versions(self) -> dict[str, int]:
-        """Per-object max version across all acting shards (the
-        authoritative-log choice of PeeringState collapsed to versions)."""
-        auth: dict[str, int] = {}
-        for info in self.peer_infos.values():
-            for name, version in info.objects.items():
-                if version > auth.get(name, 0):
-                    auth[name] = version
-        return auth
+    def authoritative_log(self) -> tuple[int, dict[int, LogEntry], int]:
+        """(shard, entries, tail) of the authoritative log: the max
+        (epoch, seq) head wins — across a primary failover the entries a
+        dead primary logged but never committed to min_size carry an
+        OLDER epoch than the new interval's writes, so the live branch
+        wins and the stale branch is rewound (find_best_info role)."""
+        best_shard, best_head = -1, (-1, -1)
+        for shard, info in self.peer_infos.items():
+            if info.head > best_head:
+                best_head = info.head
+                best_shard = shard
+        info = self.peer_infos[best_shard]
+        return best_shard, info.log, info.tail
 
-    def compute_missing(self, auth: dict[str, int]) -> dict[int, list[str]]:
-        """shard -> objects that shard lacks or holds stale (the missing
-        sets driving recovery, PeeringState/MissingLoc role)."""
-        missing: dict[int, list[str]] = {}
+    def compute_missing(self) -> MissingSet:
+        """Set arithmetic over log windows (O(retained entries), never
+        O(objects)): for each acting shard, the authoritative entries it
+        has not applied are its missing set; entries it applied that the
+        authoritative log does not contain are divergent and rewound.
+        Shards whose head predates the authoritative tail get backfill."""
+        _, auth_log, auth_tail = self.authoritative_log()
+        ms = MissingSet()
+
+        def applied(info: PeerInfo, entry: LogEntry) -> bool:
+            """A peer applied an entry if it retains it (same seq AND
+            epoch — a dead branch may have reused the seq in an older
+            epoch) or already trimmed past it (trim only advances over
+            applied entries)."""
+            mine = info.log.get(entry.seq)
+            if mine is not None:
+                return mine.epoch == entry.epoch
+            return entry.seq <= info.tail
+
+        if self.ec_k:
+            # EC reconstructability filter (the can_rollback_to /
+            # min-last-update role of the reference's EC peering): a
+            # mutation applied by fewer than k shards cannot be read
+            # back — keeping it authoritative would leave the object
+            # permanently unreadable. Such an entry was never acked
+            # (strict commit needs every live shard), so rewinding it to
+            # the prior state is safe, and dropping it from the
+            # authoritative window makes the activation merge REMOVE it
+            # from the shards that did apply it.
+            auth_log = dict(auth_log)
+            for seq in sorted(auth_log, reverse=True):
+                e = auth_log[seq]
+                if e.op == OP_DELETE:
+                    continue            # deletes need no reconstruction
+                appliers = sum(
+                    1 for info in self.peer_infos.values()
+                    if applied(info, e)
+                )
+                if appliers < self.ec_k:
+                    del auth_log[seq]
+        auth_latest = latest_per_object(auth_log)
+        ms.auth_log = auth_log
+        ms.auth_tail = auth_tail
+
+        # recovery sources: shards holding the current version of an oid
+        for oid, entry in auth_latest.items():
+            if entry.op == OP_DELETE:
+                continue
+            ms.sources[oid] = {
+                shard for shard, info in self.peer_infos.items()
+                if applied(info, entry)
+            }
+
         for shard, osd in enumerate(self.acting):
             if osd == NO_OSD:
                 continue
-            have = self.peer_infos[shard].objects \
-                if shard in self.peer_infos else {}
-            stale = [
-                name for name, version in auth.items()
-                if have.get(name, 0) < version
-            ]
-            if stale:
-                missing[shard] = sorted(stale)
-        self.missing = missing
-        return missing
+            info = self.peer_infos.get(shard)
+            if info is None:
+                ms.backfill.add(shard)
+                continue
+            if info.head[1] < auth_tail:
+                # log gap: entries this peer missed were trimmed away —
+                # only a full inventory comparison can find its holes
+                ms.backfill.add(shard)
+                continue
+            need: dict[str, LogEntry] = {}
+            for oid, entry in auth_latest.items():
+                if not applied(info, entry):
+                    need[oid] = entry
+            # divergent: applied entries the authoritative branch lacks
+            # (never client-acked — commit requires every live acting
+            # member, so an entry absent from the max-head log reached
+            # no one the client heard from). Rewind to the prior state.
+            for seq, entry in info.log.items():
+                auth_e = auth_log.get(seq)
+                if (auth_e is not None
+                        and auth_e.epoch == entry.epoch) or \
+                        seq <= auth_tail:
+                    continue
+                if entry.oid in need:
+                    continue
+                auth_e = auth_latest.get(entry.oid)
+                if auth_e is not None:
+                    need[entry.oid] = auth_e
+                elif entry.prior_version == 0:
+                    # object born in the divergent branch: remove it
+                    need[entry.oid] = LogEntry(0, 0, entry.oid,
+                                               OP_DELETE, 0)
+                else:
+                    # recover the pre-divergence object from any shard
+                    # that never saw the divergent write
+                    need[entry.oid] = LogEntry(0, 0, entry.oid, OP_MODIFY,
+                                               entry.prior_version)
+                    ms.sources.setdefault(entry.oid, set()).update(
+                        s for s, i2 in self.peer_infos.items()
+                        if not applied(i2, entry)
+                    )
+            if need:
+                ms.by_shard[shard] = need
+        self.missing = ms
+        return ms

@@ -67,11 +67,15 @@ class WalStore(MemStore):
             self._wal_file.flush()
 
     async def umount(self) -> None:
-        if self._wal_file is not None:
-            # clean shutdown: checkpoint so the next mount replays nothing
-            await asyncio.to_thread(self._write_checkpoint)
-            self._wal_file.close()
-            self._wal_file = None
+        # under _commit_lock: a background task's in-flight commit must
+        # not interleave with the checkpoint's snapshot + WAL reset
+        async with self._commit_lock:
+            if self._wal_file is not None:
+                # clean shutdown: checkpoint so the next mount replays
+                # nothing
+                await asyncio.to_thread(self._write_checkpoint)
+                self._wal_file.close()
+                self._wal_file = None
 
     # -- commit path ------------------------------------------------------
     async def _commit(self, txns) -> None:

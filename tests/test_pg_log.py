@@ -1,0 +1,326 @@
+"""PGLog: entry persistence, trim contiguity, log-based missing/divergence
+computation, and O(log)-not-O(objects) peering through a live cluster
+(reference PGLog.{h,cc} + TestPGLog.cc territory)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd import pg_log
+from ceph_tpu.osd.pg import PG, PGId, PeerInfo
+from ceph_tpu.osd.pg_log import LogEntry, OP_DELETE, OP_MODIFY
+from ceph_tpu.osd.osd_map import PoolInfo
+from ceph_tpu.store import MemStore, Transaction
+
+from tests.test_osd_daemon import (   # noqa: F401
+    fast_conf,
+    start_cluster,
+    wait_active,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# unit: persistence + trim
+
+def _store_with_log(entries):
+    s = MemStore()
+    tx = Transaction().create_collection(pg_log.meta_cid(1, 0))
+    for e in entries:
+        pg_log.append_ops(tx, 1, 0, e)
+    _run(s.queue_transactions(tx))
+    return s
+
+
+def test_log_roundtrip_and_wire():
+    e = LogEntry(7, 3, "obj", OP_MODIFY, 4, 3, "client.1:9")
+    assert LogEntry.from_wire(e.to_wire()) == e
+    s = _store_with_log([e, LogEntry(8, 3, "obj2", OP_DELETE, 0, 2)])
+    entries, tail = pg_log.read_log(s, 1, 0)
+    assert tail == 0 and set(entries) == {7, 8}
+    assert entries[7].reqid == "client.1:9"
+    assert entries[8].op == OP_DELETE
+
+
+def test_trim_respects_max_and_advances_tail():
+    s = _store_with_log([
+        LogEntry(i, 1, f"o{i}", OP_MODIFY, 1) for i in range(1, 21)
+    ])
+    _run(pg_log.trim(s, 1, 0, max_entries=5))
+    entries, tail = pg_log.read_log(s, 1, 0)
+    assert tail == 15
+    assert sorted(entries) == [16, 17, 18, 19, 20]
+
+
+def test_trim_gap_pins_tail():
+    """A seq this OSD never applied must never be claimed by the tail:
+    trimming stops below the gap, so peering still sees the hole."""
+    s = _store_with_log([
+        LogEntry(i, 1, f"o{i}", OP_MODIFY, 1)
+        for i in range(1, 31) if i != 4      # entry 4 never applied
+    ])
+    _run(pg_log.trim(s, 1, 0, max_entries=5))
+    entries, tail = pg_log.read_log(s, 1, 0)
+    assert tail == 3                  # pinned below the gap
+    assert 5 in entries               # nothing above the gap was lost
+
+
+# ---------------------------------------------------------------------------
+# unit: missing/divergence computation
+
+def _pg(acting):
+    pool = PoolInfo(pool_id=1, name="p", pool_type="replicated",
+                    size=len(acting), min_size=1, pg_num=1)
+    pg = PG(PGId(1, 0), pool, whoami=acting[0])
+    pg.start_interval(5, acting, acting, acting[0])
+    return pg
+
+
+def _info(shard, osd, entries, tail=0):
+    return PeerInfo(shard, osd, log={e.seq: e for e in entries},
+                    tail=tail)
+
+
+def test_missing_from_log_diff():
+    pg = _pg([0, 1, 2])
+    full = [LogEntry(1, 1, "a", OP_MODIFY, 1),
+            LogEntry(2, 1, "b", OP_MODIFY, 1),
+            LogEntry(3, 2, "a", OP_MODIFY, 2)]
+    pg.record_info(_info(0, 0, full))
+    pg.record_info(_info(1, 1, full[:2]))      # missed a@v2
+    pg.record_info(_info(2, 2, full))
+    ms = pg.compute_missing()
+    assert set(ms.by_shard) == {1}
+    assert list(ms.by_shard[1]) == ["a"]
+    assert ms.by_shard[1]["a"].obj_version == 2
+    assert ms.sources["a"] == {0, 2}
+    assert not ms.backfill
+
+
+def test_trimmed_peer_counts_as_applied():
+    """A peer that applied-and-trimmed an entry is a source, not missing."""
+    pg = _pg([0, 1])
+    e1 = LogEntry(1, 1, "a", OP_MODIFY, 1)
+    e2 = LogEntry(2, 1, "b", OP_MODIFY, 1)
+    pg.record_info(_info(0, 0, [e1, e2]))
+    pg.record_info(_info(1, 1, [e2], tail=1))  # trimmed e1 after applying
+    ms = pg.compute_missing()
+    assert not ms.by_shard and not ms.backfill
+    assert ms.sources["a"] == {0, 1}
+
+
+def test_divergent_branch_rewound():
+    """Entries only a dead primary logged (older epoch) lose to the live
+    branch and their objects are re-recovered on the divergent peer."""
+    pg = _pg([0, 1])
+    shared = [LogEntry(1, 1, "a", OP_MODIFY, 1)]
+    divergent = LogEntry(2, 1, "x", OP_MODIFY, 1, prior_version=0)
+    committed = LogEntry(2, 2, "b", OP_MODIFY, 1)   # newer epoch wins
+    pg.record_info(_info(0, 0, shared + [committed]))
+    pg.record_info(_info(1, 1, shared + [divergent]))
+    ms = pg.compute_missing()
+    need = ms.by_shard[1]
+    # the divergent peer lacks committed b AND must rewind x (born in
+    # the dead branch -> deleted)
+    assert need["b"].obj_version == 1
+    assert need["x"].op == OP_DELETE
+
+
+def test_gap_below_tail_forces_backfill():
+    pg = _pg([0, 1])
+    pg.record_info(_info(0, 0, [LogEntry(s, 2, f"o{s}", OP_MODIFY, 1)
+                                for s in range(50, 60)], tail=49))
+    pg.record_info(_info(1, 1, [LogEntry(3, 1, "old", OP_MODIFY, 1)]))
+    ms = pg.compute_missing()
+    assert 1 in ms.backfill
+
+
+# ---------------------------------------------------------------------------
+# integration: O(log) peering, delete propagation, backfill fallback
+
+def _counter(osds, key):
+    return sum(osd.perf.dump().get(key, 0) for osd in osds)
+
+
+def test_interval_churn_exchanges_log_not_inventory():
+    """VERDICT #6 'done' criterion: peering after churn is O(log). With
+    many objects but connected logs, NO inventory scan happens."""
+    async def run():
+        mon, osds, client = await start_cluster(3, pools=[
+            {"prefix": "osd pool create", "pool": "rep", "pg_num": 4,
+             "size": 3, "min_size": 2},
+        ])
+        pool_id = next(p.pool_id for p in mon.osd_monitor.osdmap
+                       .pools.values() if p.name == "rep")
+        await wait_active(osds, pool_id)
+        for i in range(40):
+            r = await client.op("rep", f"obj{i}", [
+                {"op": "write", "off": 0, "data": b"x" * 64},
+            ])
+            assert r["rc"] == 0
+        base_scans = _counter(osds, "peer_inventory_scans")
+
+        # interval churn: kill a replica, wait for the map, write, revive
+        victim = next(o.osd_id for o in osds
+                      if not any(pg.is_primary for pg in o.pgs.values()))
+        await osds[victim].shutdown()
+        deadline = asyncio.get_running_loop().time() + 15
+        while mon.osd_monitor.osdmap.is_up(victim):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        r = await client.op("rep", "obj0", [
+            {"op": "write", "off": 0, "data": b"v2" * 32},
+        ])
+        assert r["rc"] == 0
+
+        from tests.test_osd_daemon import start_cluster as _  # noqa
+        from ceph_tpu.osd.daemon import OSDDaemon
+        revived = OSDDaemon(victim, {"a": "local://mon.a"}, fast_conf(),
+                            store=osds[victim].store, host=f"h{victim}")
+        await revived.start()
+        osds[victim] = revived
+        await wait_active(osds, pool_id)
+        # the revived replica converges via log diff: stale obj0 healed
+        deadline = asyncio.get_running_loop().time() + 15
+        from ceph_tpu.store import CollectionId, GHObject
+        from ceph_tpu.osd.pg import object_to_ps
+        ps = object_to_ps("obj0", 4)
+        cid = CollectionId(pool_id, ps)
+        while True:
+            try:
+                if revived.store.read(cid, GHObject(pool_id, "obj0")) \
+                        == b"v2" * 32:
+                    break
+            except KeyError:
+                pass
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        # O(log): churn and recovery used zero inventory scans
+        assert _counter(osds, "peer_inventory_scans") == base_scans
+        assert _counter(osds, "peer_backfills") == 0
+        await client.shutdown()
+        for o in osds:
+            await o.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
+
+
+def test_delete_propagates_to_revived_replica():
+    async def run():
+        mon, osds, client = await start_cluster(3, pools=[
+            {"prefix": "osd pool create", "pool": "rep", "pg_num": 4,
+             "size": 3, "min_size": 2},
+        ])
+        pool_id = next(p.pool_id for p in mon.osd_monitor.osdmap
+                       .pools.values() if p.name == "rep")
+        await wait_active(osds, pool_id)
+        r = await client.op("rep", "doomed", [
+            {"op": "write", "off": 0, "data": b"bye"},
+        ])
+        assert r["rc"] == 0
+        victim = next(o.osd_id for o in osds
+                      if not any(pg.is_primary for pg in o.pgs.values()))
+        await osds[victim].shutdown()
+        deadline = asyncio.get_running_loop().time() + 15
+        while mon.osd_monitor.osdmap.is_up(victim):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        r = await client.op("rep", "doomed", [{"op": "remove"}])
+        assert r["rc"] == 0
+
+        from ceph_tpu.osd.daemon import OSDDaemon
+        revived = OSDDaemon(victim, {"a": "local://mon.a"}, fast_conf(),
+                            store=osds[victim].store, host=f"h{victim}")
+        await revived.start()
+        osds[victim] = revived
+        await wait_active(osds, pool_id)
+        # the delete must reach the revived replica (no resurrection)
+        from ceph_tpu.store import CollectionId, GHObject
+        from ceph_tpu.osd.pg import object_to_ps
+        ps = object_to_ps("doomed", 4)
+        cid = CollectionId(pool_id, ps)
+        deadline = asyncio.get_running_loop().time() + 15
+        while revived.store.exists(cid, GHObject(pool_id, "doomed")):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        await client.shutdown()
+        for o in osds:
+            await o.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
+
+
+def test_trimmed_log_falls_back_to_backfill():
+    """A replica that missed more history than the retained log window
+    is healed by the inventory/backfill path, not log diff."""
+    from ceph_tpu.common.config import ConfigProxy
+
+    def small_log_conf():
+        return ConfigProxy(overrides={
+            "mon_lease": 0.4, "mon_lease_interval": 0.1,
+            "mon_election_timeout": 0.3, "mon_tick_interval": 0.1,
+            "mon_accept_timeout": 0.5,
+            "osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 0.6,
+            "mon_osd_down_out_interval": 30.0,
+            "osd_pg_log_max_entries": 40,
+        })
+
+    async def run():
+        mon, osds, client = await start_cluster(3, pools=[
+            {"prefix": "osd pool create", "pool": "rep", "pg_num": 1,
+             "size": 3, "min_size": 2},
+        ], conf_factory=small_log_conf)
+        pool_id = next(p.pool_id for p in mon.osd_monitor.osdmap
+                       .pools.values() if p.name == "rep")
+        await wait_active(osds, pool_id)
+        victim = next(o.osd_id for o in osds
+                      if not any(pg.is_primary for pg in o.pgs.values()))
+        await osds[victim].shutdown()
+        deadline = asyncio.get_running_loop().time() + 15
+        while mon.osd_monitor.osdmap.is_up(victim):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        # write far more entries than the 40-entry log retains, forcing
+        # trims on the live members: the victim's log no longer connects
+        for i in range(300):
+            r = await client.op("rep", f"bulk{i}", [
+                {"op": "write", "off": 0, "data": b"z"},
+            ])
+            assert r["rc"] == 0
+        from ceph_tpu.osd.daemon import OSDDaemon
+        revived = OSDDaemon(victim, {"a": "local://mon.a"},
+                            small_log_conf(),
+                            store=osds[victim].store, host=f"h{victim}")
+        await revived.start()
+        osds[victim] = revived
+        await wait_active(osds, pool_id)
+        assert _counter(osds, "peer_backfills") >= 1
+        # backfill healed everything
+        from ceph_tpu.store import CollectionId, GHObject
+        cid = CollectionId(pool_id, 0)
+        deadline = asyncio.get_running_loop().time() + 20
+        while True:
+            done = all(
+                revived.store.exists(cid, GHObject(pool_id, f"bulk{i}"))
+                for i in range(0, 300, 50)
+            )
+            if done:
+                break
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        await client.shutdown()
+        for o in osds:
+            await o.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
