@@ -106,7 +106,8 @@ class Objecter:
 
     # -- submission -------------------------------------------------------
     async def op_submit(self, pool_id: int, oid: str, ops: list[dict],
-                        timeout: float = 30.0) -> dict:
+                        timeout: float = 30.0,
+                        extra: dict | None = None) -> dict:
         """Submit one op batch; retries across map changes, misdirected
         replies, and session resets until ``timeout``."""
         loop = asyncio.get_running_loop()
@@ -139,6 +140,7 @@ class Objecter:
                     Message("osd_op", {
                         "tid": tid, "pool": pool_id, "ps": ps, "oid": oid,
                         "epoch": m.epoch, "ops": ops, "reqid": reqid,
+                        **(extra or {}),
                     }), f"osd.{primary}",
                 )
                 reply = await asyncio.wait_for(

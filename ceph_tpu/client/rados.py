@@ -211,12 +211,49 @@ class IoCtx:
         self.rados = rados
         self.pool_id = pool_id
         self.pool_name = pool_name
+        # write SnapContext (rados_ioctx_selfmanaged_snap_set_write_ctx)
+        self.snap_seq = 0
+        self.snaps: list[int] = []
+        # read snap (rados_ioctx_snap_set_read); None = head
+        self.read_snap: int | None = None
+
+    def set_snap_context(self, seq: int, snaps: list[int]) -> None:
+        """Mutations carry this SnapContext; the OSD clones the head
+        before its first write under a newer context (COW)."""
+        self.snap_seq = int(seq)
+        self.snaps = sorted(int(s) for s in snaps)
+
+    def snap_set_read(self, snapid: int | None) -> None:
+        """Reads resolve at this snap (None restores head reads)."""
+        self.read_snap = None if snapid is None else int(snapid)
+
+    async def selfmanaged_snap_create(self) -> int:
+        """Allocate a pool snap id and adopt it into the write context."""
+        r = _check(await self.rados.mon_command(
+            "osd pool selfmanaged-snap create", pool=self.pool_name,
+        ), "snap create")
+        snapid = int(r["data"]["snapid"])
+        self.set_snap_context(snapid, [*self.snaps, snapid])
+        return snapid
+
+    async def selfmanaged_snap_remove(self, snapid: int) -> None:
+        _check(await self.rados.mon_command(
+            "osd pool selfmanaged-snap rm", pool=self.pool_name,
+            snapid=int(snapid),
+        ), "snap rm")
+        self.snaps = [s for s in self.snaps if s != snapid]
 
     async def operate(self, oid: str, op: ObjectOperation,
                       timeout: float = 30.0) -> dict:
         """Submit a batched op (IoCtxImpl::operate)."""
+        extra: dict = {}
+        if self.snap_seq:
+            extra["snapc"] = {"seq": self.snap_seq,
+                              "snaps": sorted(self.snaps, reverse=True)}
+        if self.read_snap is not None:
+            extra["snapid"] = self.read_snap
         reply = await self.rados.objecter.op_submit(
-            self.pool_id, oid, op.ops, timeout
+            self.pool_id, oid, op.ops, timeout, extra=extra or None
         )
         if reply["rc"] != 0:
             raise RadosError(reply["rc"], f"operate on {oid!r}")

@@ -234,6 +234,10 @@ class OSDMonitor(PaxosService):
                 return self._cmd_pool_delete(cmd)
             if name == "osd pool set":
                 return self._cmd_pool_set(cmd)
+            if name == "osd pool selfmanaged-snap create":
+                return self._cmd_snap_create(cmd)
+            if name == "osd pool selfmanaged-snap rm":
+                return self._cmd_snap_rm(cmd)
             if name in ("osd out", "osd in", "osd down"):
                 return self._cmd_osd_state(name, cmd)
             if name == "osd crush reweight":
@@ -376,6 +380,46 @@ class OSDMonitor(PaxosService):
             return CommandResult(EINVAL_RC, f"cannot set {var!r}")
         self._pending().new_pools.append(updated)
         return CommandResult(outs=f"set pool {pool.name!r} {var}={val}")
+
+    def _cmd_snap_create(self, cmd: dict) -> CommandResult:
+        """Allocate a self-managed snap id (pg_pool_t snap_seq bump; the
+        rados_ioctx_selfmanaged_snap_create mon path)."""
+        pool = self._pool_by_name(cmd["pool"])
+        if pool is None:
+            return CommandResult(ENOENT_RC, f"no pool {cmd['pool']!r}")
+        if pool.pool_type == "erasure":
+            return CommandResult(
+                EINVAL_RC, "EC pools do not support self-managed snaps"
+            )
+        pending = self._pending()
+        staged = next((p for p in pending.new_pools
+                       if p.pool_id == pool.pool_id), None)
+        updated = staged or PoolInfo.from_dict(pool.to_dict())
+        updated.snap_seq += 1
+        if staged is None:
+            pending.new_pools.append(updated)
+        return CommandResult(outs=f"snap {updated.snap_seq} created",
+                             data={"snapid": updated.snap_seq})
+
+    def _cmd_snap_rm(self, cmd: dict) -> CommandResult:
+        pool = self._pool_by_name(cmd["pool"])
+        if pool is None:
+            return CommandResult(ENOENT_RC, f"no pool {cmd['pool']!r}")
+        snapid = int(cmd["snapid"])
+        if snapid <= 0 or snapid > pool.snap_seq:
+            return CommandResult(ENOENT_RC, f"no snap {snapid}")
+        if snapid in pool.removed_snaps:
+            return CommandResult(outs=f"snap {snapid} already removed")
+        pending = self._pending()
+        staged = next((p for p in pending.new_pools
+                       if p.pool_id == pool.pool_id), None)
+        updated = staged or PoolInfo.from_dict(pool.to_dict())
+        updated.removed_snaps = sorted(set(updated.removed_snaps)
+                                       | {snapid})
+        if staged is None:
+            pending.new_pools.append(updated)
+        return CommandResult(outs=f"snap {snapid} removed",
+                             data={"snapid": snapid})
 
     def _cmd_osd_state(self, name: str, cmd: dict) -> CommandResult:
         ids = [int(i) for i in cmd.get("ids", [])]

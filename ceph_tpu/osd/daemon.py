@@ -47,7 +47,7 @@ from ceph_tpu.osd.codes import (
     OK,
 )
 from ceph_tpu.osd.osd_map import NO_OSD, OSDMap
-from ceph_tpu.osd import pg_log
+from ceph_tpu.osd import pg_log, snaps
 from ceph_tpu.osd.pg import (
     STATE_ACTIVE,
     STATE_PEERING,
@@ -207,6 +207,8 @@ class OSDDaemon:
         for pg in self.pgs.values():
             if pg.peering_task is not None:
                 pg.peering_task.cancel()
+            if pg.snaptrim_task is not None:
+                pg.snaptrim_task.cancel()
         await self.monc.shutdown()
         await self.msgr.shutdown()
         await self.store.umount()
@@ -301,6 +303,9 @@ class OSDDaemon:
                     if conn is not None:
                         conn.mark_down()
             await self._scan_pgs()
+        for pg in self.pgs.values():
+            if pg.state == STATE_ACTIVE:
+                self._kick_snaptrim(pg)
         # wrongly marked down while alive: re-assert ourselves (the
         # reference OSD reboots into the map the same way)
         me = osdmap.osds.get(self.osd_id)
@@ -505,6 +510,7 @@ class OSDDaemon:
                                             priority=PRIO_HIGH))
             pg.state = STATE_ACTIVE
             self._drain_waiters(pg)
+            self._kick_snaptrim(pg)
             log.dout(5, "pg %s: active (recovered %d objects)",
                      pg.pgid, missing.total())
         except asyncio.CancelledError:
@@ -655,12 +661,128 @@ class OSDDaemon:
         except KeyError:
             return out
         for oid in objects:
+            if oid.snap != snaps.NOSNAP:
+                continue        # clones recover with their head
             try:
                 raw = self.store.getattr(cid, oid, VERSION_ATTR)
                 out[oid.name] = int(json.loads(raw)["version"])
             except (KeyError, ValueError, TypeError):
                 out[oid.name] = 1
         return out
+
+    # -- snap trimming (reference snap trimmer + SnapMapper) ---------------
+    def _kick_snaptrim(self, pg: PG) -> None:
+        pool = pg.pool
+        if not pg.is_primary or pg.is_ec or not pool.removed_snaps:
+            return
+        if pg.snaptrim_task is not None:
+            # a snap removed while a trim runs must not be skipped: the
+            # running task re-checks this flag before exiting
+            pg.snaptrim_again = True
+            return
+        task = asyncio.get_running_loop().create_task(self._snaptrim(pg))
+        pg.snaptrim_task = task
+        task.add_done_callback(
+            lambda _t: setattr(pg, "snaptrim_task", None)
+        )
+
+    async def _snaptrim(self, pg: PG) -> None:
+        """Purge removed snaps: the SnapMapper index names the affected
+        objects (no pool scan); each object's SnapSet drops the snap and
+        clones left covering nothing are deleted. Runs as replicated
+        transactions so every member trims identically; idempotent, so a
+        new primary simply re-runs it."""
+        mcid = snaps.mapper_cid(pg.pgid.pool, pg.pgid.ps)
+        moid = snaps.mapper_oid(pg.pgid.pool)
+        while not self._stopped and pg.state == STATE_ACTIVE:
+            pg.snaptrim_again = False
+            worked = False
+            for snapid in list(pg.pool.removed_snaps):
+                try:
+                    omap = self.store.omap_get(mcid, moid)
+                except KeyError:
+                    return
+                prefix = snaps.mapper_prefix(snapid)
+                keys = [k for k in omap if k.startswith(prefix)]
+                for key in keys:
+                    if pg.state != STATE_ACTIVE or self._stopped:
+                        return
+                    worked = True
+                    name = key[len(prefix):]
+                    try:
+                        await self._trim_object_snap(pg, name, snapid,
+                                                     key)
+                    except (ShardReadError, KeyError, ValueError) as e:
+                        log.derr("pg %s: snaptrim %s@%d failed: %s",
+                                 pg.pgid, name, snapid, e)
+                        return      # retry on the next kick, not a spin
+            if not worked and not pg.snaptrim_again:
+                return
+
+    async def _trim_object_snap(self, pg: PG, name: str, snapid: int,
+                                mapper_key: str) -> None:
+        cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
+        head = GHObject(pg.pgid.pool, name)
+        tx = StoreTx()
+        removed_head = False
+        try:
+            ss = snaps.SnapSet.from_attr(
+                self.store.getattr(cid, head, snaps.SS_ATTR)
+            )
+        except (KeyError, ValueError):
+            ss = None
+        if ss is not None:
+            for clone in ss.prune_snap(snapid):
+                tx.remove(cid, snaps.clone_oid(pg.pgid.pool, name, clone))
+            if not ss.clones and not ss.head_exists:
+                tx.remove(cid, head)       # whiteout with nothing left
+                removed_head = True
+            else:
+                tx.setattr(cid, head, snaps.SS_ATTR, ss.to_attr())
+        tx.omap_rmkeys(snaps.mapper_cid(pg.pgid.pool, pg.pgid.ps),
+                       snaps.mapper_oid(pg.pgid.pool), [mapper_key])
+        entry = pg.next_entry(
+            pg.epoch, name,
+            OP_DELETE if removed_head else OP_MODIFY,
+            0 if removed_head else self._obj_version(cid, head),
+        )
+        pg_log.append_ops(tx, pg.pgid.pool, pg.pgid.ps, entry)
+        await self._submit_replicated(pg, tx)
+
+    def _obj_version(self, cid: CollectionId, obj: GHObject) -> int:
+        try:
+            return int(json.loads(
+                self.store.getattr(cid, obj, VERSION_ATTR)
+            )["version"])
+        except (KeyError, ValueError):
+            return 1
+
+    def _rm_mapper_keys(self, tx: StoreTx, pg: PG, name: str) -> None:
+        """Drop every SnapMapper index key naming this object."""
+        mcid = snaps.mapper_cid(pg.pgid.pool, pg.pgid.ps)
+        moid = snaps.mapper_oid(pg.pgid.pool)
+        try:
+            omap = self.store.omap_get(mcid, moid)
+        except KeyError:
+            return
+        keys = [k for k in omap if k.endswith(f"/{name}")]
+        if keys:
+            tx.omap_rmkeys(mcid, moid, keys)
+
+    def _clones_of(self, cid: CollectionId, name: str) -> list[GHObject]:
+        """Snap-clone objects of ``name`` (one collection scan)."""
+        return [cand for cand in self.store.list_objects(cid)
+                if cand.name == name and cand.snap != snaps.NOSNAP]
+
+    def _is_whiteout(self, pg: PG, name: str) -> bool:
+        cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
+        try:
+            ss = snaps.SnapSet.from_attr(self.store.getattr(
+                cid, GHObject(pg.pgid.pool, name), snaps.SS_ATTR
+            ))
+        except (KeyError, ValueError):
+            return False
+        return not ss.head_exists
 
     def _handle_pg_query(self, conn: Connection, d: dict) -> None:
         pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
@@ -815,13 +937,52 @@ class OSDDaemon:
                     return osd
             return None
 
-        async def pull(name: str, entry: LogEntry):
+        def _local_rm(name: str) -> StoreTx:
+            tx = StoreTx()
             obj = GHObject(pg.pgid.pool, name)
+            if self.store.exists(cid, obj):
+                tx.remove(cid, obj)
+            for cand in self._clones_of(cid, name):
+                tx.remove(cid, cand)
+            self._rm_mapper_keys(tx, pg, name)
+            return tx
+
+        def _full_state_tx(name: str, full: dict) -> StoreTx:
+            tx = _local_rm(name)
+            obj = GHObject(pg.pgid.pool, name)
+            tx.write(cid, obj, 0, full["data"])
+            for aname, aval in full["attrs"].items():
+                tx.setattr(cid, obj, aname, aval)
+            if full["omap"]:
+                tx.omap_setkeys(cid, obj, full["omap"])
+            for snapstr, cstate in full.get("clones", {}).items():
+                cobj = snaps.clone_oid(pg.pgid.pool, name, int(snapstr))
+                tx.write(cid, cobj, 0, cstate["data"])
+                for aname, aval in cstate["attrs"].items():
+                    tx.setattr(cid, cobj, aname, aval)
+                if cstate["omap"]:
+                    tx.omap_setkeys(cid, cobj, cstate["omap"])
+            return tx
+
+        async def pull(name: str, entry: LogEntry):
             if entry.op == OP_DELETE:
-                if self.store.exists(cid, obj):
-                    await self.store.queue_transactions(
-                        StoreTx().remove(cid, obj)
-                    )
+                # a delete may have left a whiteout (clones survive):
+                # adopt the source's state when one exists
+                osd = source_osd(name)
+                if osd is not None:
+                    try:
+                        full = await self.send_sub_op(
+                            osd, "read_full", cid=_enc_cid(cid), oid=name
+                        )
+                        await self.store.queue_transactions(
+                            _full_state_tx(name, full)
+                        )
+                        return
+                    except KeyError:
+                        pass            # fully gone on the source too
+                tx = _local_rm(name)
+                if tx.ops:
+                    await self.store.queue_transactions(tx)
                 return
             osd = source_osd(name)
             if osd is None:
@@ -829,20 +990,23 @@ class OSDDaemon:
                 return
             full = await self.send_sub_op(osd, "read_full",
                                           cid=_enc_cid(cid), oid=name)
-            tx = StoreTx()
-            tx.remove(cid, obj).write(cid, obj, 0, full["data"])
-            for aname, aval in full["attrs"].items():
-                tx.setattr(cid, obj, aname, aval)
-            if full["omap"]:
-                tx.omap_setkeys(cid, obj, full["omap"])
-            await self.store.queue_transactions(tx)
+            await self.store.queue_transactions(
+                _full_state_tx(name, full)
+            )
 
         async def push(name: str, entry: LogEntry, osd: int):
             tx = StoreTx()
             obj = GHObject(pg.pgid.pool, name)
-            if entry.op == OP_DELETE:
-                tx.remove(cid, obj)
+            if entry.op == OP_DELETE and not self.store.exists(cid, obj):
+                # fully gone here (trimmed whiteout included): the peer
+                # must drop its head AND any stale clones/mapper keys
+                await self.send_sub_op(osd, "purge", cid=_enc_cid(cid),
+                                       oid=name)
+                self.perf.inc("recovery_ops")
+                return
             else:
+                # the full local state — including a whiteout head and
+                # any snap clones — replaces whatever the peer holds
                 data = self.store.read(cid, obj)
                 attrs = self.store.getattrs(cid, obj)
                 omap = self.store.omap_get(cid, obj)
@@ -851,6 +1015,16 @@ class OSDDaemon:
                     tx.setattr(cid, obj, aname, aval)
                 if omap:
                     tx.omap_setkeys(cid, obj, omap)
+                for cand in self._clones_of(cid, name):
+                    tx.remove(cid, cand)
+                    tx.write(cid, cand, 0, self.store.read(cid, cand))
+                    for aname, aval in self.store.getattrs(
+                        cid, cand
+                    ).items():
+                        tx.setattr(cid, cand, aname, aval)
+                    comap = self.store.omap_get(cid, cand)
+                    if comap:
+                        tx.omap_setkeys(cid, cand, comap)
             await self.send_sub_op(osd, "tx", cid=_enc_cid(cid),
                                    ops=encode_tx(tx))
             self.perf.inc("recovery_ops")
@@ -991,7 +1165,8 @@ class OSDDaemon:
                     if op.get("op") in ("read", "stat", "getxattr",
                                         "getxattrs", "omap_get"):
                         _, sub_results, _ = await self._do_ops(
-                            pg, str(d["oid"]), [op]
+                            pg, str(d["oid"]), [op],
+                            snapid=d.get("snapid"),
                         )
                         results.append(sub_results[0] if sub_results
                                        else {})
@@ -1019,7 +1194,8 @@ class OSDDaemon:
                 self._inflight_ops[reqid] = fut
             try:
                 rc, results, version = await self._do_ops(
-                    pg, str(d["oid"]), ops, reqid
+                    pg, str(d["oid"]), ops, reqid,
+                    d.get("snapc"), d.get("snapid"),
                 )
             except BaseException:
                 if track:
@@ -1130,7 +1306,10 @@ class OSDDaemon:
         elif kind == "pgls":
             shard = (pg.acting.index(self.osd_id)
                      if self.osd_id in pg.acting else 0)
-            names = sorted(self._inventory(pg, shard))
+            names = sorted(
+                n for n in self._inventory(pg, shard)
+                if not self._is_whiteout(pg, n)
+            )
             self._reply(conn, tid, OK, results=[{"objects": names}],
                         version=0)
 
@@ -1143,11 +1322,16 @@ class OSDDaemon:
             pass
 
     async def _do_ops(self, pg: PG, oid: str, ops: list[dict],
-                      reqid: str = ""):
+                      reqid: str = "", snapc: dict | None = None,
+                      snapid: int | None = None):
         """The op interpreter (do_osd_ops, PrimaryLogPG.cc:5652)."""
         if pg.is_ec:
+            if snapc is not None or snapid is not None:
+                # EC pools reject snap machinery (reference restriction)
+                return ENOTSUP_RC, [], 0
             return await self._do_ops_ec(pg, oid, ops, reqid)
-        return await self._do_ops_replicated(pg, oid, ops, reqid)
+        return await self._do_ops_replicated(pg, oid, ops, reqid,
+                                             snapc, snapid)
 
     # -- EC op path ----------------------------------------------------------
     async def _do_ops_ec(self, pg: PG, oid: str, ops: list[dict],
@@ -1280,17 +1464,52 @@ class OSDDaemon:
 
     # -- replicated op path ----------------------------------------------------
     async def _do_ops_replicated(self, pg: PG, oid: str, ops: list[dict],
-                                 reqid: str = ""):
+                                 reqid: str = "",
+                                 snapc: dict | None = None,
+                                 snapid: int | None = None):
         """The replicated-pool op interpreter. All reads go through a
         batch-local overlay of the pending mutations, so every op in the
         batch — including object-class calls — observes the effects of
         the ops before it, exactly as the reference's per-op OpContext
-        does; the store itself only changes atomically at submit."""
+        does; the store itself only changes atomically at submit.
+
+        Snapshots (the make_writeable / find_object_context role of
+        PrimaryLogPG): mutations carrying a SnapContext newer than the
+        object's SnapSet clone the pre-batch head first (copy-on-first-
+        write); ``snapid`` reads resolve through the SnapSet to a clone
+        or the head."""
         cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
-        obj = GHObject(pg.pgid.pool, oid)
+        head = GHObject(pg.pgid.pool, oid)
+        obj = head
         results: list[dict] = []
         tx = StoreTx()
-        exists = self.store.exists(cid, obj)
+        in_store = self.store.exists(cid, head)
+        ss: snaps.SnapSet | None = None
+        if in_store:
+            try:
+                ss = snaps.SnapSet.from_attr(
+                    self.store.getattr(cid, head, snaps.SS_ATTR)
+                )
+            except (KeyError, ValueError):
+                ss = None
+        ss_dirty = False
+        exists = in_store and (ss is None or ss.head_exists)
+        if snapid is not None and snapid != snaps.NOSNAP:
+            # snapshot read: resolve to the covering clone or the head
+            if any(op.get("op") not in ("read", "stat", "getxattr",
+                                        "getxattrs", "omap_get")
+                   for op in ops):
+                return EINVAL_RC, results, 0    # snaps are read-only
+            base = ss if ss is not None else snaps.SnapSet()
+            if not in_store:
+                return ENOENT_RC, results, 0
+            target = base.resolve_read(snapid)
+            if target is None:
+                return ENOENT_RC, results, 0
+            if target != snaps.NOSNAP:
+                obj = snaps.clone_oid(pg.pgid.pool, oid, target)
+                exists = self.store.exists(cid, obj)
+            # head target: fall through with logical head existence
         version = 0
         if exists:
             try:
@@ -1301,6 +1520,41 @@ class OSDDaemon:
                 version = 1
         prior_version = version
         mutated = False
+        cow_done = False
+
+        def maybe_cow() -> None:
+            """Clone the pre-batch head before its first mutation when
+            snaps were taken since it last changed (make_writeable)."""
+            nonlocal cow_done, ss, ss_dirty
+            if cow_done:
+                return
+            cow_done = True
+            if snapc is None:
+                return
+            s = ss if ss is not None else snaps.SnapSet()
+            seq = int(snapc.get("seq", 0))
+            if exists and s.seq < seq:
+                newsnaps = sorted(
+                    int(x) for x in snapc.get("snaps", ())
+                    if int(x) > s.seq
+                )
+                if newsnaps:
+                    cobj = snaps.clone_oid(pg.pgid.pool, oid, seq)
+                    tx.clone(cid, head, cobj)
+                    s.clones.append(seq)
+                    s.clones.sort()
+                    s.clone_snaps[seq] = newsnaps
+                    # SnapMapper index: snap -> object, for the trimmer
+                    tx.omap_setkeys(
+                        snaps.mapper_cid(pg.pgid.pool, pg.pgid.ps),
+                        snaps.mapper_oid(pg.pgid.pool),
+                        {snaps.mapper_key(sn, oid): b""
+                         for sn in newsnaps},
+                    )
+            if seq > s.seq:
+                s.seq = seq
+            ss = s
+            ss_dirty = True
 
         # -- batch overlay: lazily materialized object state ------------
         odata: bytearray | None = None          # None = store is current
@@ -1380,6 +1634,7 @@ class OSDDaemon:
 
         def do_write(off: int, data: bytes) -> None:
             nonlocal mutated, exists
+            maybe_cow()
             d = cur_data()
             end = off + len(data)
             if len(d) < end:
@@ -1390,6 +1645,7 @@ class OSDDaemon:
 
         def do_write_full(data: bytes) -> None:
             nonlocal mutated, exists, odata
+            maybe_cow()
             wipe()
             odata = bytearray(data)
             tx.remove(cid, obj).write(cid, obj, 0, bytes(data))
@@ -1397,6 +1653,7 @@ class OSDDaemon:
 
         def do_setxattr(key: str, value: bytes) -> None:
             nonlocal mutated, exists
+            maybe_cow()
             oxattrs[key] = bytes(value)
             rm_xattrs.discard(key)
             tx.setattr(cid, obj, key, bytes(value))
@@ -1404,6 +1661,7 @@ class OSDDaemon:
 
         def do_omap_set(kv: dict[str, bytes]) -> None:
             nonlocal mutated, exists
+            maybe_cow()
             kv = {str(k): bytes(v) for k, v in kv.items()}
             oomap.update(kv)
             rm_omap.difference_update(kv)
@@ -1412,6 +1670,7 @@ class OSDDaemon:
 
         def do_omap_rm(keys) -> None:
             nonlocal mutated
+            maybe_cow()
             keys = [str(k) for k in keys]
             rm_omap.update(keys)
             for k in keys:
@@ -1432,6 +1691,7 @@ class OSDDaemon:
                 results.append({})
             elif kind == "truncate":
                 nsize = int(op["size"])
+                maybe_cow()
                 d = cur_data()
                 if len(d) > nsize:
                     del d[nsize:]
@@ -1442,6 +1702,7 @@ class OSDDaemon:
                 results.append({})
             elif kind == "create":
                 if not exists:
+                    maybe_cow()
                     tx.touch(cid, obj)
                     mutated = exists = True
                 elif op.get("exclusive"):
@@ -1461,8 +1722,15 @@ class OSDDaemon:
             elif kind == "remove":
                 if not exists:
                     return ENOENT_RC, results, 0
+                maybe_cow()
                 wipe()
                 tx.remove(cid, obj)
+                if ss is not None and ss.clones:
+                    # clones outlive the head: leave a WHITEOUT carrying
+                    # the SnapSet (reference head whiteout semantics)
+                    tx.touch(cid, obj)
+                    ss.head_exists = False
+                    ss_dirty = True
                 mutated = True
                 exists = False
                 results.append({})
@@ -1482,6 +1750,7 @@ class OSDDaemon:
                 }})
             elif kind == "rmxattr":
                 key = XATTR_PREFIX + op["name"]
+                maybe_cow()
                 rm_xattrs.add(key)
                 oxattrs.pop(key, None)
                 tx.rmattr(cid, obj, key)
@@ -1542,7 +1811,16 @@ class OSDDaemon:
                 return EINVAL_RC, results, version
         if mutated:
             version += 1
-            if exists:
+            if ss is not None and exists and not ss.head_exists:
+                ss.head_exists = True       # a write revived a whiteout
+                ss_dirty = True
+            whiteout = (ss is not None and not ss.head_exists
+                        and bool(ss.clones))
+            if ss_dirty and (exists or whiteout):
+                # only onto a live head or whiteout: a plain remove must
+                # not be resurrected by its own SnapSet attr write
+                tx.setattr(cid, head, snaps.SS_ATTR, ss.to_attr())
+            if exists or whiteout:
                 tx.setattr(cid, obj, VERSION_ATTR, json.dumps(
                     {"size": cur_size(), "version": version}
                 ).encode())
@@ -1697,12 +1975,38 @@ class OSDDaemon:
                     await self.store.queue_transactions(tx)
                 elif kind == "stat":
                     value = self.store.stat(cid, oid)
+                elif kind == "purge":
+                    # remove head + clones + snap index keys for a name
+                    # (recovery of a fully-deleted snapped object)
+                    name = str(d["oid"])
+                    tx = StoreTx()
+                    plain = GHObject(cid.pool, name)
+                    if self.store.exists(cid, plain):
+                        tx.remove(cid, plain)
+                    for cand in self._clones_of(cid, name):
+                        tx.remove(cid, cand)
+                    pgid2 = PGId(cid.pool, cid.pg)
+                    pg2 = self.pgs.get(pgid2)
+                    if pg2 is not None:
+                        self._rm_mapper_keys(tx, pg2, name)
+                    if tx.ops:
+                        await self.store.queue_transactions(tx)
                 elif kind == "read_full":
                     plain = GHObject(cid.pool, str(d["oid"]))
+                    clones = {}
+                    for cand in self._clones_of(cid, plain.name):
+                        clones[str(cand.snap)] = {
+                            "data": self.store.read(cid, cand),
+                            "attrs": dict(
+                                self.store.getattrs(cid, cand)
+                            ),
+                            "omap": dict(self.store.omap_get(cid, cand)),
+                        }
                     value = {
                         "data": self.store.read(cid, plain),
                         "attrs": dict(self.store.getattrs(cid, plain)),
                         "omap": dict(self.store.omap_get(cid, plain)),
+                        "clones": clones,
                     }
                 else:
                     self._sub_reply(conn, tid, EINVAL_RC)

@@ -35,6 +35,8 @@ class PoolInfo:
     pg_num: int = 32
     crush_rule: str = "replicated_rule"
     ec_profile: str = ""                     # EC profile name
+    snap_seq: int = 0                        # newest allocated snap id
+    removed_snaps: list = field(default_factory=list)
 
     def raw_pg_to_pps(self, ps: int) -> int:
         """Placement seed: stable mod then mix with pool id
@@ -47,6 +49,8 @@ class PoolInfo:
             "type": self.pool_type, "size": self.size,
             "min_size": self.min_size, "pg_num": self.pg_num,
             "crush_rule": self.crush_rule, "ec_profile": self.ec_profile,
+            "snap_seq": self.snap_seq,
+            "removed_snaps": list(self.removed_snaps),
         }
 
     @classmethod
@@ -58,6 +62,8 @@ class PoolInfo:
             pg_num=int(d.get("pg_num", 32)),
             crush_rule=d.get("crush_rule", "replicated_rule"),
             ec_profile=d.get("ec_profile", ""),
+            snap_seq=int(d.get("snap_seq", 0)),
+            removed_snaps=[int(s) for s in d.get("removed_snaps", ())],
         )
 
 

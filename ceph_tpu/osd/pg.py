@@ -114,6 +114,8 @@ class PG:
         self.peer_infos: dict[int, PeerInfo] = {}   # shard -> info
         self.missing = MissingSet()
         self.peering_task: asyncio.Task | None = None
+        self.snaptrim_task: asyncio.Task | None = None
+        self.snaptrim_again = False
         self.backend = None             # set by the daemon per interval
         self.ec_k = 0                   # EC data-chunk count (0 = replicated)
         self.log_seq = 0                # next entry seq (primary allocates)
@@ -281,10 +283,10 @@ class PG:
         ms.auth_log = auth_log
         ms.auth_tail = auth_tail
 
-        # recovery sources: shards holding the current version of an oid
+        # recovery sources: shards holding the current state of an oid
+        # (delete entries included — a delete can leave a whiteout whose
+        # SnapSet and clones must still be recoverable)
         for oid, entry in auth_latest.items():
-            if entry.op == OP_DELETE:
-                continue
             ms.sources[oid] = {
                 shard for shard, info in self.peer_infos.items()
                 if applied(info, entry)

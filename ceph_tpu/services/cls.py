@@ -230,12 +230,15 @@ def _register_builtins(reg: ClassRegistry) -> None:
         h = _header(ctx)
         if args["name"] in h["snaps"]:
             raise ClsError(EEXIST_RC, "snap exists")
-        h["snap_seq"] += 1
+        # pool-allocated self-managed snap id when given (the real COW
+        # path); header-local allocation kept for metadata-only use
+        snapid = int(args.get("id", 0)) or h["snap_seq"] + 1
+        h["snap_seq"] = max(h["snap_seq"], snapid)
         h["snaps"][args["name"]] = {
-            "id": h["snap_seq"], "size": h["size"],
+            "id": snapid, "size": h["size"],
         }
         ctx.setxattr("rbd.header", json.dumps(h).encode())
-        return json.dumps(h["snap_seq"]).encode()
+        return json.dumps(snapid).encode()
 
     def rbd_snap_rm(ctx: ClsContext, indata: bytes) -> bytes:
         args = _j(indata)

@@ -96,7 +96,10 @@ class Image:
     """An open image handle (librbd rbd_image_t)."""
 
     def __init__(self, ioctx: IoCtx, name: str, image_id: str):
-        self.ioctx = ioctx
+        # a PRIVATE io context: the image's snap context (set at refresh)
+        # must not clobber the caller's ioctx or other open images
+        # (librbd likewise keeps per-image state in ImageCtx)
+        self.ioctx = IoCtx(ioctx.rados, ioctx.pool_id, ioctx.pool_name)
         self.name = name
         self.image_id = image_id
         self.size = 0
@@ -120,6 +123,11 @@ class Image:
         self.order = h["order"]
         self.object_prefix = h["object_prefix"]
         self.snaps = h["snaps"]
+        # image writes carry the image's snap context so data objects
+        # COW-clone on the first write after each snapshot
+        ids = sorted(int(i["id"]) for i in self.snaps.values())
+        if ids:
+            self.ioctx.set_snap_context(max(ids), ids)
 
     def stat(self) -> dict:
         return {
@@ -194,20 +202,26 @@ class Image:
                         raise
         self.size = new_size
 
-    # -- snapshots (metadata-level; COW clones are future work) ----------
+    # -- snapshots (self-managed snaps + object COW clones; the librbd
+    # snap_create/snap_rollback model over the OSD snapshot machinery) --
     async def snap_create(self, snap_name: str) -> int:
-        out = await self.ioctx.exec(
+        snapid = await self.ioctx.selfmanaged_snap_create()
+        await self.ioctx.exec(
             self.header_oid, "rbd", "snap_add",
-            json.dumps({"name": snap_name}).encode(),
+            json.dumps({"name": snap_name, "id": snapid}).encode(),
         )
         await self.refresh()
-        return json.loads(out)
+        return snapid
 
     async def snap_remove(self, snap_name: str) -> None:
+        info = self.snaps.get(snap_name)
+        if info is None:
+            raise RBDError(f"no snap {snap_name!r}")
         await self.ioctx.exec(
             self.header_oid, "rbd", "snap_rm",
             json.dumps({"name": snap_name}).encode(),
         )
+        await self.ioctx.selfmanaged_snap_remove(int(info["id"]))
         await self.refresh()
 
     def snap_list(self) -> list[dict]:
@@ -215,3 +229,50 @@ class Image:
             {"name": name, **info}
             for name, info in sorted(self.snaps.items())
         ]
+
+    async def read_at_snap(self, snap_name: str, offset: int,
+                           length: int) -> bytes:
+        """Read the image as of a snapshot (librbd snap_set + read)."""
+        info = self.snaps.get(snap_name)
+        if info is None:
+            raise RBDError(f"no snap {snap_name!r}")
+        snap_size = int(info["size"])
+        length = max(0, min(length, snap_size - offset))
+        out = bytearray(length)
+        self.ioctx.snap_set_read(int(info["id"]))
+        try:
+            pos = 0
+            for objectno, obj_off, run in self._extents(offset, length):
+                try:
+                    frag = await self.ioctx.read(
+                        self._data_oid(objectno), run, obj_off
+                    )
+                except RadosError as e:
+                    if e.rc != -2:
+                        raise
+                    frag = b""
+                out[pos:pos + len(frag)] = frag
+                pos += run
+        finally:
+            self.ioctx.snap_set_read(None)
+        return bytes(out)
+
+    async def snap_rollback(self, snap_name: str) -> None:
+        """Restore the head image to a snapshot's content (librbd
+        snap_rollback: copy the snap state over the head)."""
+        info = self.snaps.get(snap_name)
+        if info is None:
+            raise RBDError(f"no snap {snap_name!r}")
+        snap_size = int(info["size"])
+        if self.size != snap_size:
+            await self.resize(snap_size)
+        nobjs = -(-snap_size // self.obj_size)
+        for objectno in range(nobjs):
+            want = min(self.obj_size, snap_size - objectno * self.obj_size)
+            frag = await self.read_at_snap(
+                snap_name, objectno * self.obj_size, want
+            )
+            await self.ioctx.operate(
+                self._data_oid(objectno),
+                ObjectOperation().write_full(frag),
+            )

@@ -31,15 +31,21 @@ class RadosModel:
     )
 
     def __init__(self, ioctx: IoCtx, seed: int = 0, n_objects: int = 16,
-                 max_size: int = 1 << 16, ec: bool = False):
+                 max_size: int = 1 << 16, ec: bool = False,
+                 snaps: bool = False):
         self.ioctx = ioctx
         self.rng = random.Random(seed)
         self.names = [f"model-obj-{i}" for i in range(n_objects)]
         self.max_size = max_size
-        self.ec = ec                      # EC pools: no omap
+        self.ec = ec                      # EC pools: no omap, no snaps
         self.model: dict[str, ModelObject] = {}
         self.ops_done = 0
         self.checks = 0
+        # snapshot oracle: snapid -> frozen {name: bytes} pool image at
+        # snap time (the reference runs ceph_test_rados with snap ops
+        # mixed in the same way)
+        self.snaps_enabled = snaps and not ec
+        self.snap_images: dict[int, dict[str, bytes]] = {}
 
     # -- op generation -----------------------------------------------------
     def _blob(self, n: int) -> bytes:
@@ -49,7 +55,11 @@ class RadosModel:
         return self.rng.choice(self.names)
 
     async def step(self) -> None:
-        op = self.rng.choice(self.OPS)
+        ops = self.OPS
+        if self.snaps_enabled:
+            ops = ops + ("snap_create", "snap_read", "snap_read",
+                         "snap_remove")
+        op = self.rng.choice(ops)
         if self.ec and op.startswith("omap"):
             op = "write"
         name = self._pick()
@@ -179,6 +189,49 @@ class RadosModel:
         m.data = bytearray(data)
         m.xattrs = {key: val}
         m.omap.clear()
+
+    # -- snapshot ops ------------------------------------------------------
+    async def _op_snap_create(self, name: str) -> None:
+        if len(self.snap_images) >= 6:
+            return                       # bounded live snaps
+        snapid = await self.ioctx.selfmanaged_snap_create()
+        self.snap_images[snapid] = {
+            n: bytes(m.data) for n, m in self.model.items()
+        }
+
+    async def _op_snap_remove(self, name: str) -> None:
+        if not self.snap_images:
+            return
+        snapid = self.rng.choice(sorted(self.snap_images))
+        await self.ioctx.selfmanaged_snap_remove(snapid)
+        del self.snap_images[snapid]
+
+    async def _op_snap_read(self, name: str) -> None:
+        """Read an object as of a random live snap; the frozen oracle
+        image predicts the exact bytes (or ENOENT)."""
+        if not self.snap_images:
+            return
+        snapid = self.rng.choice(sorted(self.snap_images))
+        image = self.snap_images[snapid]
+        self.ioctx.snap_set_read(snapid)
+        try:
+            data = await self.ioctx.read(name)
+        except RadosError as e:
+            assert e.rc == -2, f"snapread {name}@{snapid}: rc {e.rc}"
+            assert name not in image, (
+                f"snapread {name}@{snapid}: ENOENT but snap image has it"
+            )
+            return
+        finally:
+            self.ioctx.snap_set_read(None)
+        assert name in image, (
+            f"snapread {name}@{snapid}: data but snap image lacks it"
+        )
+        assert data == image[name], (
+            f"snapread {name}@{snapid}: mismatch "
+            f"({len(data)} vs {len(image[name])} bytes)"
+        )
+        self.checks += 1
 
     # -- final sweep -------------------------------------------------------
     async def verify_all(self) -> int:

@@ -1,0 +1,214 @@
+"""Self-managed snapshots: SnapSet resolution, clone-before-first-write,
+whiteouts, snap trimming via the SnapMapper index, and rbd snapshot
+create/read/rollback (reference PrimaryLogPG make_writeable +
+SnapMapper.cc + librbd snapshot territory)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.snaps import NOSNAP, SnapSet
+from ceph_tpu.osd.pg import object_to_ps
+from ceph_tpu.store import CollectionId, GHObject
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+# ---------------------------------------------------------------------------
+# unit: SnapSet
+
+def test_snapset_resolution():
+    ss = SnapSet(seq=7, clones=[3, 7],
+                 clone_snaps={3: [1, 3], 7: [5, 7]})
+    assert ss.resolve_read(1) == 3
+    assert ss.resolve_read(3) == 3
+    assert ss.resolve_read(5) == 7
+    assert ss.resolve_read(7) == 7
+    assert ss.resolve_read(2) is None       # snap 2 never covered
+    assert ss.resolve_read(9) == NOSNAP     # newer than clones: head
+    ss.head_exists = False
+    assert ss.resolve_read(9) is None
+
+
+def test_snapset_prune():
+    ss = SnapSet(seq=7, clones=[3, 7],
+                 clone_snaps={3: [1, 3], 7: [5]})
+    assert ss.prune_snap(1) == []
+    assert ss.clone_snaps[3] == [3]
+    assert ss.prune_snap(5) == [7]          # clone 7 now covers nothing
+    assert ss.clones == [3]
+    assert SnapSet.from_attr(ss.to_attr()) == ss
+
+
+# ---------------------------------------------------------------------------
+# cluster integration
+
+def _pg_primary(cluster, pool_id, oid, pg_num):
+    m = next(iter(cluster.mons.values())).osd_monitor.osdmap
+    ps = object_to_ps(oid, pg_num)
+    _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+    return cluster.osds[primary], ps
+
+
+def test_selfmanaged_snaps_cow_and_trim():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        pool_id = await rados.pool_create("snappool", pg_num=4, size=3,
+                                          min_size=2)
+        io = await rados.open_ioctx("snappool")
+
+        await io.write_full("obj", b"version-one")
+        s1 = await io.selfmanaged_snap_create()
+        await io.write_full("obj", b"version-two!")
+        s2 = await io.selfmanaged_snap_create()
+        await io.append("obj", b"+tail")
+
+        # head and both snaps read their own content
+        assert await io.read("obj") == b"version-two!+tail"
+        io.snap_set_read(s1)
+        assert await io.read("obj") == b"version-one"
+        io.snap_set_read(s2)
+        assert await io.read("obj") == b"version-two!"
+        io.snap_set_read(None)
+
+        # a snapshot of a then-nonexistent object reads ENOENT — also at
+        # the snap whose seq the object was BORN under (regression: head
+        # must only serve snaps strictly newer than its seq)
+        await io.write_full("latecomer", b"born after snaps")
+        from ceph_tpu.client.rados import RadosError
+        for snap in (s1, s2):
+            io.snap_set_read(snap)
+            with pytest.raises(RadosError) as ei:
+                await io.read("latecomer")
+            assert ei.value.rc == -2
+        io.snap_set_read(None)
+
+        # remove the head: snaps survive via the whiteout
+        await io.remove("obj")
+        with pytest.raises(RadosError) as ei:
+            await io.read("obj")
+        assert ei.value.rc == -2
+        io.snap_set_read(s2)
+        assert await io.read("obj") == b"version-two!"
+        io.snap_set_read(None)
+        # pgls does not list the whiteout
+        assert "obj" not in await io.list_objects()
+
+        # recreate the head over the whiteout
+        await io.write_full("obj", b"reborn")
+        assert await io.read("obj") == b"reborn"
+        io.snap_set_read(s1)
+        assert await io.read("obj") == b"version-one"
+        io.snap_set_read(None)
+
+        # snap removal trims the covering clone asynchronously
+        primary, ps = _pg_primary(cluster, pool_id, "obj", 4)
+        cid = CollectionId(pool_id, ps)
+        clone_s1 = GHObject(pool_id, "obj", snap=s1)
+        assert primary.store.exists(cid, clone_s1)
+        await io.selfmanaged_snap_remove(s1)
+        deadline = asyncio.get_running_loop().time() + 15
+        while primary.store.exists(cid, clone_s1):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        # s2 still readable after s1's trim
+        io.snap_set_read(s2)
+        assert await io.read("obj") == b"version-two!"
+        io.snap_set_read(None)
+
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_whiteout_fully_trimmed_away():
+    """Removing the head and every snap leaves nothing behind."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        pool_id = await rados.pool_create("snappool2", pg_num=4, size=3,
+                                          min_size=2)
+        io = await rados.open_ioctx("snappool2")
+        await io.write_full("ghost", b"data")
+        s1 = await io.selfmanaged_snap_create()
+        await io.write_full("ghost", b"data2")   # clone for s1
+        await io.remove("ghost")                 # whiteout (clone lives)
+        await io.selfmanaged_snap_remove(s1)
+        primary, ps = _pg_primary(cluster, pool_id, "ghost", 4)
+        cid = CollectionId(pool_id, ps)
+        deadline = asyncio.get_running_loop().time() + 15
+        while primary.store.exists(cid, GHObject(pool_id, "ghost")):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert not primary.store.exists(
+            cid, GHObject(pool_id, "ghost", snap=s1)
+        )
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_rados_model_with_snap_ops():
+    """Randomized op mix including snap create/read/remove with a frozen
+    per-snap oracle (the reference's ceph_test_rados snap op coverage)."""
+    async def run():
+        from ceph_tpu.testing.rados_model import RadosModel
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        await rados.pool_create("snapmodel", pg_num=8, size=3,
+                                min_size=2)
+        io = await rados.open_ioctx("snapmodel")
+        model = RadosModel(io, seed=23, n_objects=10, snaps=True)
+        await model.run(200)
+        verified = await model.verify_all()
+        assert verified == len(model.model)
+        assert model.checks > 20
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_rbd_snapshot_read_and_rollback():
+    async def run():
+        from ceph_tpu.services.rbd import RBD
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        await rados.pool_create("rbdpool", pg_num=4, size=3, min_size=2)
+        io = await rados.open_ioctx("rbdpool")
+        rbd = RBD(io)
+        await rbd.create("vol", size=1 << 20, order=16)   # 64 KiB objects
+        img = await rbd.open("vol")
+
+        gold = bytes(range(256)) * 256                    # 64 KiB
+        await img.write(0, gold)
+        await img.write(100_000, b"span-two-objects" * 100)
+        await img.snap_create("checkpoint")
+
+        await img.write(0, b"OVERWRITTEN" * 1000)
+        assert (await img.read(0, 11)) == b"OVERWRITTEN"
+        assert (await img.read_at_snap("checkpoint", 0, len(gold))
+                == gold)
+
+        await img.snap_rollback("checkpoint")
+        assert (await img.read(0, len(gold))) == gold
+        assert (await img.read(100_000, 16)) == b"span-two-objects"
+
+        snaps = img.snap_list()
+        assert len(snaps) == 1 and snaps[0]["name"] == "checkpoint"
+        await img.snap_remove("checkpoint")
+        assert img.snap_list() == []
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
