@@ -137,6 +137,32 @@ async def _dispatch(args, rados: Rados) -> int:
         return await _dispatch_osd(args, rados, j)
     if cmd == "rados":
         return await _dispatch_rados(args, rados, j)
+    if cmd == "daemon":
+        # `ceph daemon osd.N <cmd>`: the admin-socket surface
+        kind, _, rest = str(args.target).partition(".")
+        try:
+            osd_id = int(rest)
+        except ValueError:
+            osd_id = -1
+        if kind != "osd" or osd_id < 0:
+            print(f"bad daemon target {args.target!r} (want osd.N)",
+                  file=sys.stderr)
+            return 2
+        msg_type = ("perf_dump" if args.daemon_cmd == "perf"
+                    else "dump_ops")
+        try:
+            reply = await rados.osd_daemon_command(osd_id, msg_type)
+        except RadosError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        if args.daemon_cmd == "perf":
+            out = reply["counters"]
+        elif args.daemon_cmd == "dump_historic_ops":
+            out = reply["historic"]
+        else:
+            out = reply["in_flight"]
+        _print(out, True)
+        return 0
     print(f"unknown command {cmd!r}", file=sys.stderr)
     return 2
 
@@ -244,6 +270,12 @@ def build_parser() -> argparse.ArgumentParser:
         c = conf_sub.add_parser(name)
         c.add_argument("name")
     conf_sub.add_parser("dump")
+
+    daemon = sub.add_parser("daemon")
+    daemon.add_argument("target", help="osd.N")
+    daemon.add_argument("daemon_cmd", choices=[
+        "dump_ops_in_flight", "dump_historic_ops", "perf",
+    ])
 
     osd = sub.add_parser("osd")
     osd_sub = osd.add_subparsers(dest="action", required=True)

@@ -123,9 +123,16 @@ class Rados:
         self.objecter = Objecter(self.monc, self.msgr)
         self.monc.on_osdmap = self.objecter.on_map_change
         self._connected = False
+        self._daemon_tid = 0
+        self._daemon_futs: dict[int, asyncio.Future] = {}
 
     # -- dispatcher demux --------------------------------------------------
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if msg.type in ("perf_dump_reply", "dump_ops_reply"):
+            fut = self._daemon_futs.pop(int(msg.data.get("tid", 0)), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg.data)
+            return
         if await self.objecter.handle_message(conn, msg):
             return
         await self.monc.ms_dispatch(conn, msg)
@@ -156,6 +163,27 @@ class Rados:
     # -- cluster ops -------------------------------------------------------
     async def mon_command(self, prefix: str, **args) -> dict:
         return await self.monc.command(prefix, **args)
+
+    async def osd_daemon_command(self, osd_id: int, msg_type: str,
+                                 timeout: float = 10.0) -> dict:
+        """Send an admin-socket-style request straight to an OSD (the
+        `ceph daemon osd.N <cmd>` path): ``perf_dump`` or ``dump_ops``."""
+        m = self.monc.osdmap
+        info = m.osds.get(osd_id) if m is not None else None
+        if info is None or not info.up or not info.addr:
+            raise RadosError(-2, f"osd.{osd_id} is not up")
+        self._daemon_tid += 1
+        tid = self._daemon_tid
+        fut = asyncio.get_running_loop().create_future()
+        self._daemon_futs[tid] = fut
+        try:
+            await self.msgr.send_to(info.addr,
+                                    Message(msg_type, {"tid": tid}),
+                                    f"osd.{osd_id}")
+            return await asyncio.wait_for(fut, timeout)
+        except (ConnectionError, asyncio.TimeoutError) as e:
+            self._daemon_futs.pop(tid, None)
+            raise RadosError(-110, f"daemon command: {e}") from e
 
     async def get_cluster_stats(self) -> dict:
         return _check(await self.monc.command("status"), "status")["data"]

@@ -78,6 +78,23 @@ def global_options() -> list[Option]:
                "max concurrent recovery ops", min=1),
         Option("osd_pg_log_max_entries", int, 250,
                "retained pg log entries per PG (trim boundary)", min=8),
+        Option("osd_op_queue", str, "mclock_scheduler",
+               "op scheduler: mclock_scheduler or fifo",
+               enum_values=("mclock_scheduler", "fifo")),
+        # dmClock per-class QoS knobs (osd_mclock_scheduler_* analogs);
+        # limit 0 = uncapped
+        Option("osd_mclock_client_res", float, 100.0,
+               "client reservation (ops/s)"),
+        Option("osd_mclock_client_wgt", float, 10.0, "client weight"),
+        Option("osd_mclock_client_lim", float, 0.0, "client limit"),
+        Option("osd_mclock_recovery_res", float, 10.0,
+               "recovery reservation (ops/s)"),
+        Option("osd_mclock_recovery_wgt", float, 1.0, "recovery weight"),
+        Option("osd_mclock_recovery_lim", float, 0.0, "recovery limit"),
+        Option("osd_mclock_scrub_res", float, 5.0,
+               "scrub reservation (ops/s)"),
+        Option("osd_mclock_scrub_wgt", float, 1.0, "scrub weight"),
+        Option("osd_mclock_scrub_lim", float, 0.0, "scrub limit"),
         Option("osd_client_op_priority", int, 63, "client op priority"),
         Option("mon_lease", float, 2.0,
                "peon lease / liveness window (s)", min=0.1),

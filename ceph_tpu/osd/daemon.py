@@ -48,6 +48,8 @@ from ceph_tpu.osd.codes import (
 )
 from ceph_tpu.osd.osd_map import NO_OSD, OSDMap
 from ceph_tpu.osd import pg_log, snaps
+from ceph_tpu.osd.op_tracker import OpTracker
+from ceph_tpu.osd.scheduler import MClockScheduler
 from ceph_tpu.osd.pg import (
     STATE_ACTIVE,
     STATE_PEERING,
@@ -168,6 +170,20 @@ class OSDDaemon:
                     "peer_backfills"):
             self.perf.add(key)
         self.perf.add("op_latency", CounterType.TIME)
+        # QoS op scheduler (mClockScheduler role) + op observability
+        # (OpRequest/OpTracker role)
+        from ceph_tpu.osd.scheduler import ClassProfile
+        self.op_scheduler = MClockScheduler({
+            clazz: ClassProfile(
+                reservation=self.conf[f"osd_mclock_{clazz}_res"],
+                weight=self.conf[f"osd_mclock_{clazz}_wgt"],
+                limit=self.conf[f"osd_mclock_{clazz}_lim"],
+            )
+            for clazz in ("client", "recovery", "scrub")
+        })
+        self.op_tracker = OpTracker()
+        self._use_mclock = (self.conf["osd_op_queue"]
+                            == "mclock_scheduler")
         # completed-op cache keyed by client reqid (the osd_reqid_t dedup
         # the reference keeps in the PG log): a client resend whose first
         # attempt executed but lost the reply gets the cached result
@@ -209,6 +225,7 @@ class OSDDaemon:
                 pg.peering_task.cancel()
             if pg.snaptrim_task is not None:
                 pg.snaptrim_task.cancel()
+        self.op_scheduler.shutdown()
         await self.monc.shutdown()
         await self.msgr.shutdown()
         await self.store.umount()
@@ -248,6 +265,16 @@ class OSDDaemon:
             asyncio.get_running_loop().create_task(
                 self._handle_sub_op(conn, msg.data)
             )
+        elif t == "dump_ops":
+            try:
+                conn.send_message(Message("dump_ops_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    "in_flight": self.op_tracker.dump_ops_in_flight(),
+                    "historic": self.op_tracker.dump_historic_ops(),
+                    "scheduler": self.op_scheduler.stats(),
+                }))
+            except ConnectionError:
+                pass
         elif t == "perf_dump":
             # the admin-socket `perf dump` surface, polled by the mgr
             try:
@@ -682,9 +709,13 @@ class OSDDaemon:
             return
         task = asyncio.get_running_loop().create_task(self._snaptrim(pg))
         pg.snaptrim_task = task
-        task.add_done_callback(
-            lambda _t: setattr(pg, "snaptrim_task", None)
-        )
+
+        def _done(_t):
+            pg.snaptrim_task = None
+            if pg.snaptrim_again and not self._stopped:
+                # a kick raced the task's exit: run another round
+                self._kick_snaptrim(pg)
+        task.add_done_callback(_done)
 
     async def _snaptrim(self, pg: PG) -> None:
         """Purge removed snaps: the SnapMapper index names the affected
@@ -721,6 +752,16 @@ class OSDDaemon:
 
     async def _trim_object_snap(self, pg: PG, name: str, snapid: int,
                                 mapper_key: str) -> None:
+        async with pg.op_lock:
+            # under the PG op lock: a concurrent client write COWs new
+            # clones and rewrites the SnapSet; interleaving would apply
+            # a stale pruned copy over it
+            await self._trim_object_snap_locked(pg, name, snapid,
+                                                mapper_key)
+
+    async def _trim_object_snap_locked(self, pg: PG, name: str,
+                                       snapid: int,
+                                       mapper_key: str) -> None:
         cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
         head = GHObject(pg.pgid.pool, name)
         tx = StoreTx()
@@ -757,6 +798,25 @@ class OSDDaemon:
         except (KeyError, ValueError):
             return 1
 
+    def _mapper_keys_from_ss(self, tx: StoreTx, pg: PG, name: str,
+                             attrs: Mapping[str, bytes]) -> None:
+        """Recovered objects must re-index their snaps: a clone without
+        its SnapMapper keys would never be trimmed on this OSD."""
+        raw = attrs.get(snaps.SS_ATTR)
+        if not raw:
+            return
+        try:
+            ss = snaps.SnapSet.from_attr(raw)
+        except (ValueError, TypeError):
+            return
+        keys = {
+            snaps.mapper_key(sn, name): b""
+            for covered in ss.clone_snaps.values() for sn in covered
+        }
+        if keys:
+            tx.omap_setkeys(snaps.mapper_cid(pg.pgid.pool, pg.pgid.ps),
+                            snaps.mapper_oid(pg.pgid.pool), keys)
+
     def _rm_mapper_keys(self, tx: StoreTx, pg: PG, name: str) -> None:
         """Drop every SnapMapper index key naming this object."""
         mcid = snaps.mapper_cid(pg.pgid.pool, pg.pgid.ps)
@@ -770,9 +830,22 @@ class OSDDaemon:
             tx.omap_rmkeys(mcid, moid, keys)
 
     def _clones_of(self, cid: CollectionId, name: str) -> list[GHObject]:
-        """Snap-clone objects of ``name`` (one collection scan)."""
-        return [cand for cand in self.store.list_objects(cid)
-                if cand.name == name and cand.snap != snaps.NOSNAP]
+        """Snap-clone objects of ``name``. The head's SnapSet enumerates
+        them in O(clones); the full collection scan survives only for a
+        headless leftover (purge of a fully-deleted object)."""
+        try:
+            ss = snaps.SnapSet.from_attr(self.store.getattr(
+                cid, GHObject(cid.pool, name), snaps.SS_ATTR
+            ))
+        except (KeyError, ValueError):
+            return [cand for cand in self.store.list_objects(cid)
+                    if cand.name == name and cand.snap != snaps.NOSNAP]
+        out = []
+        for c in ss.clones:
+            cand = snaps.clone_oid(cid.pool, name, c)
+            if self.store.exists(cid, cand):
+                out.append(cand)
+        return out
 
     def _is_whiteout(self, pg: PG, name: str) -> bool:
         cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
@@ -890,6 +963,8 @@ class OSDDaemon:
 
         async def recover_one(name: str, shards: list[int]) -> bool:
             async with sem:
+                if self._use_mclock:
+                    await self.op_scheduler.acquire("recovery")
                 try:
                     # the log entry names the version to converge to —
                     # a rewound object's stale shards still advertise
@@ -962,6 +1037,7 @@ class OSDDaemon:
                     tx.setattr(cid, cobj, aname, aval)
                 if cstate["omap"]:
                     tx.omap_setkeys(cid, cobj, cstate["omap"])
+            self._mapper_keys_from_ss(tx, pg, name, full["attrs"])
             return tx
 
         async def pull(name: str, entry: LogEntry):
@@ -1025,12 +1101,15 @@ class OSDDaemon:
                     comap = self.store.omap_get(cid, cand)
                     if comap:
                         tx.omap_setkeys(cid, cand, comap)
+                self._mapper_keys_from_ss(tx, pg, name, attrs)
             await self.send_sub_op(osd, "tx", cid=_enc_cid(cid),
                                    ops=encode_tx(tx))
             self.perf.inc("recovery_ops")
 
         async def run_one(coro) -> bool:
             async with sem:
+                if self._use_mclock:
+                    await self.op_scheduler.acquire("recovery")
                 try:
                     await coro
                     return True
@@ -1105,6 +1184,7 @@ class OSDDaemon:
     async def _handle_osd_op(self, conn: Connection, d: dict) -> None:
         tid = d.get("tid", 0)
         op_start = time.monotonic()
+        top = None
         try:
             pgid = PGId(int(d["pool"]), int(d["ps"]))
             pg = self.pgs.get(pgid)
@@ -1118,6 +1198,15 @@ class OSDDaemon:
                 pg.waiting_for_active.append((conn, d))
                 return
             ops = list(d["ops"])
+            top = self.op_tracker.create(
+                "osd_op(%s %s %s)" % (
+                    d.get("reqid", "-"), d.get("oid", "?"),
+                    "+".join(str(op.get("op")) for op in ops),
+                )
+            )
+            if self._use_mclock:
+                await self.op_scheduler.acquire("client")
+            top.mark("dispatched")
             special = [op for op in ops
                        if op.get("op") in ("watch", "unwatch", "notify",
                                            "pgls")]
@@ -1250,6 +1339,12 @@ class OSDDaemon:
         except (KeyError, ValueError, TypeError) as e:
             log.derr("%s: bad osd_op: %s", self.entity, e)
             self._reply(conn, tid, EINVAL_RC)
+        finally:
+            # every exit path closes the tracked op (replay answers,
+            # misdirected replies, errors) so nothing lingers in
+            # dump_ops_in_flight forever
+            if top is not None and not top.done:
+                self.op_tracker.finish(top, "replied")
 
     # -- watch / notify / pgls (the Watch.h:48 + pgls machinery of
     # PrimaryLogPG, collapsed to a per-PG watcher table) -----------------
@@ -1478,6 +1573,15 @@ class OSDDaemon:
         object's SnapSet clone the pre-batch head first (copy-on-first-
         write); ``snapid`` reads resolve through the SnapSet to a clone
         or the head."""
+        async with pg.op_lock:
+            return await self._do_ops_replicated_locked(
+                pg, oid, ops, reqid, snapc, snapid
+            )
+
+    async def _do_ops_replicated_locked(self, pg: PG, oid: str,
+                                        ops: list[dict], reqid: str,
+                                        snapc: dict | None,
+                                        snapid: int | None):
         cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
         head = GHObject(pg.pgid.pool, oid)
         obj = head

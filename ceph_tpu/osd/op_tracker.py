@@ -1,0 +1,86 @@
+"""OpTracker: in-flight op observability.
+
+The role of reference src/osd/OpRequest.{h,cc} + common/TrackedOp.h: every
+client op is registered with a monotonically increasing id and stamps a
+timestamped event at each pipeline stage (received -> queued ->
+executing -> replied, mirroring the reference's mark_* calls such as
+"dequeue_op"/"commit_sent"). Live ops are inspectable via
+dump_ops_in_flight and a bounded history of slow/recent ops via
+dump_historic_ops — the admin-socket surface the reference exposes
+(admin_socket.h:105), served here over the messenger ("dump_ops" message)
+and the CLI.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TrackedOp:
+    opid: int
+    description: str
+    started: float = field(default_factory=time.monotonic)
+    events: list[tuple[float, str]] = field(default_factory=list)
+    done: bool = False
+
+    def mark(self, stage: str) -> None:
+        self.events.append((time.monotonic(), stage))
+
+    @property
+    def age(self) -> float:
+        return time.monotonic() - self.started
+
+    @property
+    def duration(self) -> float:
+        if not self.events:
+            return self.age
+        return self.events[-1][0] - self.started
+
+    def dump(self) -> dict:
+        return {
+            "id": self.opid,
+            "description": self.description,
+            "age": round(self.age, 6),
+            "duration": round(self.duration, 6),
+            "events": [
+                {"t": round(t - self.started, 6), "event": stage}
+                for t, stage in self.events
+            ],
+        }
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 64,
+                 slow_op_seconds: float = 1.0):
+        self._next_id = 0
+        self._inflight: dict[int, TrackedOp] = {}
+        self._history: deque[dict] = deque(maxlen=history_size)
+        self.slow_op_seconds = slow_op_seconds
+        self.slow_ops = 0
+
+    def create(self, description: str) -> TrackedOp:
+        self._next_id += 1
+        op = TrackedOp(self._next_id, description)
+        op.mark("received")
+        self._inflight[op.opid] = op
+        return op
+
+    def finish(self, op: TrackedOp, stage: str = "done") -> None:
+        op.mark(stage)
+        op.done = True
+        self._inflight.pop(op.opid, None)
+        if op.duration >= self.slow_op_seconds:
+            self.slow_ops += 1
+        self._history.append(op.dump())
+
+    def dump_ops_in_flight(self) -> dict:
+        ops = [op.dump() for op in self._inflight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self) -> dict:
+        return {"num_ops": len(self._history),
+                "slow_ops": self.slow_ops,
+                "ops": list(self._history)}

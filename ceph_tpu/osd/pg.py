@@ -131,6 +131,11 @@ class PG:
         # serializes log maintenance (activation merge vs trim) so their
         # read-modify-write cycles cannot interleave and regress the tail
         self.log_lock = asyncio.Lock()
+        # the PG lock of the reference: replicated-pool mutations (and
+        # the snap trimmer) read object state, build a transaction, and
+        # await replication — interleaving two such cycles on one PG
+        # loses updates (version bumps, SnapSet edits)
+        self.op_lock = asyncio.Lock()
 
     # -- interval handling -------------------------------------------------
     @property

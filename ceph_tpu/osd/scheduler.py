@@ -1,0 +1,181 @@
+"""mClock op scheduler: reservation / weight / limit QoS across op
+classes.
+
+The role of reference src/osd/scheduler/mClockScheduler.{h,cc} (dmClock,
+src/dmclock submodule) in asyncio form: every op class (client,
+recovery, scrub — the reference's client / background_recovery /
+background_best_effort) gets a reservation R (guaranteed ops/s), a
+weight W (share of spare capacity), and a limit L (ops/s cap). Each
+submission is stamped with dmClock tags:
+
+    r_tag = max(now, prev_r + 1/R)      reservation clock
+    l_tag = max(now, prev_l + 1/L)      limit clock
+    p_tag = max(now, prev_p + 1/W)      proportional-share clock
+
+Dispatch prefers any op whose reservation tag is due (reservations are
+met first, so a recovery storm cannot push client ops past their
+guaranteed rate), then shares the remainder by weight among ops under
+their limit — the two-phase pull of the dmClock server loop.
+
+Ops are admitted (started), not time-sliced: the scheduler paces op
+STARTS, matching the reference's queue semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ClassProfile:
+    reservation: float       # guaranteed ops/s (0 = none)
+    weight: float            # share of spare capacity
+    limit: float             # ops/s cap (0 = unlimited)
+
+
+DEFAULT_PROFILES = {
+    # the mclock_scheduler built-in "balanced"-style profile shape.
+    # Default limits are 0 (uncapped): the asyncio runtime is not
+    # thread-contended, so out of the box QoS only ORDERS dispatch
+    # (client first via reservation + weight) without pacing anything;
+    # operators enable hard caps per class via configuration, exactly
+    # like tuning osd_mclock_* in the reference.
+    "client": ClassProfile(reservation=100.0, weight=10.0, limit=0.0),
+    "recovery": ClassProfile(reservation=10.0, weight=1.0, limit=0.0),
+    "scrub": ClassProfile(reservation=5.0, weight=1.0, limit=0.0),
+}
+
+
+@dataclass(order=True)
+class _Item:
+    sort_key: float
+    seq: int
+    clazz: str = field(compare=False)
+    r_tag: float = field(compare=False)
+    l_tag: float = field(compare=False)
+    p_tag: float = field(compare=False)
+    fut: asyncio.Future = field(compare=False)
+
+
+class MClockScheduler:
+    def __init__(self, profiles: dict[str, ClassProfile] | None = None,
+                 clock=time.monotonic):
+        self.profiles = dict(profiles or DEFAULT_PROFILES)
+        self.clock = clock
+        self._prev: dict[str, tuple[float, float, float]] = {}
+        self._res_heap: list[_Item] = []      # by r_tag
+        self._prop_heap: list[_Item] = []     # by p_tag
+        self._seq = 0
+        self._dispatched: dict[str, int] = {}
+        self._task: asyncio.Task | None = None
+        self._wake = asyncio.Event()
+
+    # -- submission --------------------------------------------------------
+    async def acquire(self, clazz: str) -> None:
+        """Wait for this op's dispatch slot. Ops of an unknown class run
+        immediately (fail-open: QoS must never wedge the data path)."""
+        prof = self.profiles.get(clazz)
+        if prof is None:
+            return
+        now = self.clock()
+        pr, pl, pp = self._prev.get(clazz, (0.0, 0.0, 0.0))
+        r_tag = (max(now, pr + 1.0 / prof.reservation)
+                 if prof.reservation > 0 else float("inf"))
+        l_tag = (max(now, pl + 1.0 / prof.limit)
+                 if prof.limit > 0 else now)
+        p_tag = (max(now, pp + 1.0 / prof.weight)
+                 if prof.weight > 0 else float("inf"))
+        self._prev[clazz] = (
+            r_tag if r_tag != float("inf") else pr,
+            l_tag,
+            p_tag if p_tag != float("inf") else pp,
+        )
+        self._seq += 1
+        fut = asyncio.get_running_loop().create_future()
+        item = _Item(r_tag, self._seq, clazz, r_tag, l_tag, p_tag, fut)
+        heapq.heappush(self._res_heap, item)
+        heapq.heappush(self._prop_heap,
+                       _Item(p_tag, self._seq, clazz, r_tag, l_tag,
+                             p_tag, fut))
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._dispatch_loop()
+            )
+        self._wake.set()
+        await fut
+
+    def stats(self) -> dict[str, int]:
+        return dict(self._dispatched)
+
+    def shutdown(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        for heap in (self._res_heap, self._prop_heap):
+            for item in heap:
+                if not item.fut.done():
+                    item.fut.set_result(None)
+            heap.clear()
+
+    # -- dispatch ----------------------------------------------------------
+    def _grant(self, item: _Item) -> bool:
+        if item.fut.done():
+            return False                     # granted via the other heap
+        item.fut.set_result(None)
+        self._dispatched[item.clazz] = \
+            self._dispatched.get(item.clazz, 0) + 1
+        return True
+
+    async def _dispatch_loop(self) -> None:
+        while True:
+            now = self.clock()
+            # phase 1: due reservations, in r_tag order
+            granted = False
+            while self._res_heap and (
+                self._res_heap[0].fut.done()
+                or self._res_heap[0].r_tag <= now
+            ):
+                item = heapq.heappop(self._res_heap)
+                if self._grant(item):
+                    granted = True
+                    break
+            if granted:
+                await asyncio.sleep(0)       # let the op start
+                continue
+            # phase 2: weight shares among ops under their limit
+            deferred = []
+            while self._prop_heap:
+                item = self._prop_heap[0]
+                if item.fut.done():
+                    heapq.heappop(self._prop_heap)
+                    continue
+                if item.l_tag <= now:
+                    heapq.heappop(self._prop_heap)
+                    self._grant(item)
+                    granted = True
+                    break
+                deferred.append(heapq.heappop(self._prop_heap))
+            for item in deferred:
+                heapq.heappush(self._prop_heap, item)
+            if granted:
+                await asyncio.sleep(0)
+                continue
+            # nothing eligible: sleep to the earliest future tag
+            tags = []
+            if self._res_heap:
+                tags.append(self._res_heap[0].r_tag)
+            tags.extend(i.l_tag for i in self._prop_heap
+                        if not i.fut.done())
+            if not tags:
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            delay = max(0.0, min(tags) - now)
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(),
+                                       min(delay, 0.05) + 1e-4)
+            except asyncio.TimeoutError:
+                pass

@@ -1,0 +1,185 @@
+"""mClock QoS scheduler + OpTracker (reference mClockScheduler.h:61 /
+TestMClockScheduler.cc + OpRequest.h territory)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.op_tracker import OpTracker
+from ceph_tpu.osd.scheduler import ClassProfile, MClockScheduler
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def test_limit_caps_class_rate():
+    """A class with limit L gets at most ~L dispatches per second."""
+    async def run():
+        sched = MClockScheduler({
+            "bg": ClassProfile(reservation=0.0, weight=1.0, limit=50.0),
+        })
+        start = asyncio.get_running_loop().time()
+        done = 0
+
+        async def one():
+            nonlocal done
+            await sched.acquire("bg")
+            done += 1
+
+        tasks = [asyncio.create_task(one()) for _ in range(100)]
+        await asyncio.sleep(0.5)
+        elapsed = asyncio.get_running_loop().time() - start
+        # 50/s for ~0.5s -> ~25 dispatches; generous bounds for CI noise
+        assert done <= 50 * elapsed + 10, (done, elapsed)
+        sched.shutdown()
+        for t in tasks:
+            t.cancel()
+    asyncio.run(run())
+
+
+def test_reservation_protects_client_from_recovery_storm():
+    """VERDICT #9 'done' criterion: a recovery storm cannot starve
+    client ops — client reservations dispatch at their guaranteed rate
+    while thousands of recovery ops are queued."""
+    async def run():
+        sched = MClockScheduler({
+            "client": ClassProfile(reservation=200.0, weight=10.0,
+                                   limit=0.0),
+            "recovery": ClassProfile(reservation=10.0, weight=1.0,
+                                     limit=100.0),
+        })
+        order: list[str] = []
+
+        async def op(clazz):
+            await sched.acquire(clazz)
+            order.append(clazz)
+
+        # the storm is queued FIRST, then client ops arrive
+        storm = [asyncio.create_task(op("recovery")) for _ in range(2000)]
+        await asyncio.sleep(0.01)
+        clients = [asyncio.create_task(op("client")) for _ in range(40)]
+        await asyncio.wait_for(asyncio.gather(*clients), 10.0)
+
+        # all 40 client ops completed while the storm was still queued
+        recovery_done = sum(1 for c in order if c == "recovery")
+        assert recovery_done < 1000, recovery_done
+        # and client ops were interleaved promptly, not appended at the
+        # tail: the last client op finished before the storm drained
+        assert order.count("client") == 40
+        sched.shutdown()
+        for t in storm:
+            t.cancel()
+    asyncio.run(run())
+
+
+def test_weight_orders_spare_capacity():
+    """With no reservations, GRANT ORDER follows the weights: among any
+    prefix of dispatches, a weight-3 class gets ~3x the grants of a
+    weight-1 class (proportional-share tags)."""
+    async def run():
+        sched = MClockScheduler({
+            "a": ClassProfile(reservation=0.0, weight=300.0, limit=0.0),
+            "b": ClassProfile(reservation=0.0, weight=100.0, limit=0.0),
+        })
+        order: list[str] = []
+
+        async def op(clazz):
+            await sched.acquire(clazz)
+            order.append(clazz)
+
+        tasks = [asyncio.create_task(op("a")) for _ in range(400)]
+        tasks += [asyncio.create_task(op("b")) for _ in range(400)]
+        await asyncio.wait_for(asyncio.gather(*tasks), 20.0)
+        prefix = order[:200]
+        a = prefix.count("a")
+        b = prefix.count("b")
+        assert a + b == 200
+        assert a / max(b, 1) > 1.8, (a, b, "weight 3:1 not honored")
+        sched.shutdown()
+    asyncio.run(run())
+
+
+def test_op_tracker_lifecycle_and_dumps():
+    tracker = OpTracker(history_size=4, slow_op_seconds=0.0)
+    op = tracker.create("osd_op(client.1:5 obj write)")
+    op.mark("dispatched")
+    live = tracker.dump_ops_in_flight()
+    assert live["num_ops"] == 1
+    assert live["ops"][0]["description"].startswith("osd_op")
+    assert [e["event"] for e in live["ops"][0]["events"]] == [
+        "received", "dispatched",
+    ]
+    tracker.finish(op, "replied")
+    assert tracker.dump_ops_in_flight()["num_ops"] == 0
+    hist = tracker.dump_historic_ops()
+    assert hist["num_ops"] == 1 and hist["slow_ops"] == 1
+    # bounded history
+    for i in range(10):
+        tracker.finish(tracker.create(f"op{i}"))
+    assert tracker.dump_historic_ops()["num_ops"] == 4
+
+
+def test_daemon_tracks_and_schedules_ops():
+    """Client ops flow through the scheduler and the tracker surfaces
+    them via the dump_ops message (the admin-socket analog)."""
+    from ceph_tpu.msg import Message
+    from ceph_tpu.vstart import DevCluster
+
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        await rados.pool_create("qos", pg_num=4, size=3, min_size=2)
+        io = await rados.open_ioctx("qos")
+        for i in range(10):
+            await io.write_full(f"o{i}", b"x" * 128)
+
+        osd = next(o for o in cluster.osds.values()
+                   if o.op_scheduler.stats().get("client"))
+        assert osd.op_scheduler.stats()["client"] >= 1
+        hist = osd.op_tracker.dump_historic_ops()
+        assert hist["num_ops"] >= 1
+        events = [e["event"] for e in hist["ops"][-1]["events"]]
+        assert events[0] == "received" and events[-1] == "replied"
+
+        # the wire surface
+        fut = asyncio.get_running_loop().create_future()
+
+        class Probe:
+            async def ms_dispatch(self, conn, msg):
+                if msg.type == "dump_ops_reply" and not fut.done():
+                    fut.set_result(msg.data)
+
+            def ms_handle_reset(self, conn):
+                pass
+
+            def ms_handle_connect(self, conn):
+                pass
+
+        from ceph_tpu.msg import Messenger, Policy
+        probe = Messenger("client.probe", cluster.conf())
+        probe.set_policy("osd", Policy.lossy_client())
+        probe.set_dispatcher(Probe())
+        await probe.bind("local://probe")
+        await probe.send_to(str(osd.msgr.my_addr),
+                            Message("dump_ops", {"tid": 1}),
+                            osd.entity)
+        reply = await asyncio.wait_for(fut, 5.0)
+        assert reply["historic"]["num_ops"] >= 1
+        assert "client" in reply["scheduler"]
+        await probe.shutdown()
+
+        # the librados daemon-command path (`ceph daemon osd.N ...`)
+        reply = await rados.osd_daemon_command(osd.osd_id, "dump_ops")
+        assert reply["historic"]["num_ops"] >= 1
+        perf = await rados.osd_daemon_command(osd.osd_id, "perf_dump")
+        assert "op" in perf["counters"]
+
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
