@@ -137,6 +137,33 @@ async def _dispatch(args, rados: Rados) -> int:
         return await _dispatch_osd(args, rados, j)
     if cmd == "rados":
         return await _dispatch_rados(args, rados, j)
+    if cmd == "pg":
+        # `ceph pg scrub|repair <pool>/<ps>`
+        pool_name, _, ps_str = str(args.pgid).partition("/")
+        m = rados.monc.osdmap
+        pool = next((p for p in m.pools.values()
+                     if p.name == pool_name), None)
+        if pool is None:
+            print(f"no pool {pool_name!r}", file=sys.stderr)
+            return 2
+        try:
+            ps = int(ps_str)
+        except ValueError:
+            print(f"bad pgid {args.pgid!r} (want pool/ps)",
+                  file=sys.stderr)
+            return 2
+        try:
+            report = await rados.pg_scrub(
+                pool.pool_id, ps, repair=args.action == "repair"
+            )
+        except RadosError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        if "error" in report:
+            print(f"Error: {report['error']}", file=sys.stderr)
+            return 1
+        _print(report, True)
+        return 0 if not report.get("errors") else 1
     if cmd == "daemon":
         # `ceph daemon osd.N <cmd>`: the admin-socket surface
         kind, _, rest = str(args.target).partition(".")
@@ -270,6 +297,10 @@ def build_parser() -> argparse.ArgumentParser:
         c = conf_sub.add_parser(name)
         c.add_argument("name")
     conf_sub.add_parser("dump")
+
+    pg = sub.add_parser("pg")
+    pg.add_argument("action", choices=["scrub", "repair"])
+    pg.add_argument("pgid", help="<pool>/<ps>")
 
     daemon = sub.add_parser("daemon")
     daemon.add_argument("target", help="osd.N")

@@ -128,7 +128,8 @@ class Rados:
 
     # -- dispatcher demux --------------------------------------------------
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
-        if msg.type in ("perf_dump_reply", "dump_ops_reply"):
+        if msg.type in ("perf_dump_reply", "dump_ops_reply",
+                        "pg_scrub_reply"):
             fut = self._daemon_futs.pop(int(msg.data.get("tid", 0)), None)
             if fut is not None and not fut.done():
                 fut.set_result(msg.data)
@@ -165,9 +166,11 @@ class Rados:
         return await self.monc.command(prefix, **args)
 
     async def osd_daemon_command(self, osd_id: int, msg_type: str,
-                                 timeout: float = 10.0) -> dict:
+                                 timeout: float = 10.0,
+                                 **args) -> dict:
         """Send an admin-socket-style request straight to an OSD (the
-        `ceph daemon osd.N <cmd>` path): ``perf_dump`` or ``dump_ops``."""
+        `ceph daemon osd.N <cmd>` path): ``perf_dump``, ``dump_ops``,
+        ``pg_scrub``."""
         m = self.monc.osdmap
         info = m.osds.get(osd_id) if m is not None else None
         if info is None or not info.up or not info.addr:
@@ -177,13 +180,31 @@ class Rados:
         fut = asyncio.get_running_loop().create_future()
         self._daemon_futs[tid] = fut
         try:
-            await self.msgr.send_to(info.addr,
-                                    Message(msg_type, {"tid": tid}),
-                                    f"osd.{osd_id}")
+            await self.msgr.send_to(
+                info.addr, Message(msg_type, {"tid": tid, **args}),
+                f"osd.{osd_id}",
+            )
             return await asyncio.wait_for(fut, timeout)
         except (ConnectionError, asyncio.TimeoutError) as e:
             self._daemon_futs.pop(tid, None)
             raise RadosError(-110, f"daemon command: {e}") from e
+
+    async def pg_scrub(self, pool_id: int, ps: int,
+                       repair: bool = False,
+                       timeout: float = 60.0) -> dict:
+        """Scrub (or repair) one PG on its primary (`ceph pg scrub` /
+        `ceph pg repair`)."""
+        m = self.monc.osdmap
+        if m is None or pool_id not in m.pools:
+            raise RadosError(-2, f"no pool {pool_id}")
+        _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+        if primary < 0:
+            raise RadosError(-11, f"pg {pool_id}.{ps} has no primary")
+        reply = await self.osd_daemon_command(
+            primary, "pg_scrub", timeout=timeout,
+            pool=pool_id, ps=ps, repair=repair,
+        )
+        return reply["report"]
 
     async def get_cluster_stats(self) -> dict:
         return _check(await self.monc.command("status"), "status")["data"]

@@ -91,6 +91,8 @@ def global_options() -> list[Option]:
                "recovery reservation (ops/s)"),
         Option("osd_mclock_recovery_wgt", float, 1.0, "recovery weight"),
         Option("osd_mclock_recovery_lim", float, 0.0, "recovery limit"),
+        Option("osd_scrub_interval", float, 0.0,
+               "seconds between automatic PG scrubs (0 = manual only)"),
         Option("osd_mclock_scrub_res", float, 5.0,
                "scrub reservation (ops/s)"),
         Option("osd_mclock_scrub_wgt", float, 1.0, "scrub weight"),

@@ -22,6 +22,7 @@ from collections import deque
 from typing import Mapping
 
 from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.common.log import Dout
 from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
@@ -167,7 +168,7 @@ class OSDDaemon:
         self.perf = PerfCounters(self.entity)
         for key in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
                     "subop", "recovery_ops", "peer_inventory_scans",
-                    "peer_backfills"):
+                    "peer_backfills", "scrub_errors"):
             self.perf.add(key)
         self.perf.add("op_latency", CounterType.TIME)
         # QoS op scheduler (mClockScheduler role) + op observability
@@ -214,6 +215,8 @@ class OSDDaemon:
                                   host=self.host, timeout=timeout)
         self._booted = True
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        if self.conf["osd_scrub_interval"] > 0:
+            self._tasks.append(asyncio.create_task(self._scrub_loop()))
         log.dout(1, "%s: booted at %s", self.entity, self.msgr.my_addr)
 
     async def shutdown(self) -> None:
@@ -264,6 +267,10 @@ class OSDDaemon:
             self.perf.inc("subop")
             asyncio.get_running_loop().create_task(
                 self._handle_sub_op(conn, msg.data)
+            )
+        elif t == "pg_scrub":
+            asyncio.get_running_loop().create_task(
+                self._handle_pg_scrub(conn, msg.data)
             )
         elif t == "dump_ops":
             try:
@@ -798,6 +805,199 @@ class OSDDaemon:
         except (KeyError, ValueError):
             return 1
 
+    # -- scrub (the chunky_scrub / scrub_compare_maps loop, PG.cc:2647,
+    # driven here manually via `pg scrub` or periodically) ---------------
+    def _scrub_digest(self, cid: CollectionId, name: str) -> dict:
+        """Per-object scrub-map entry: content digests a peer compares
+        (ScrubMap::object role)."""
+        obj = GHObject(cid.pool, name)
+        data = self.store.read(cid, obj)
+        attrs = self.store.getattrs(cid, obj)
+        omap = self.store.omap_get(cid, obj)
+        acrc = 0xFFFFFFFF
+        for key in sorted(attrs):
+            acrc = crc32c(acrc, key.encode() + b"\0" + attrs[key])
+        ocrc = 0xFFFFFFFF
+        for key in sorted(omap):
+            ocrc = crc32c(ocrc, key.encode() + b"\0" + omap[key])
+        return {
+            "size": len(data),
+            "data_crc": crc32c(0xFFFFFFFF, data),
+            "attrs_crc": acrc,
+            "omap_crc": ocrc,
+        }
+
+    async def _handle_pg_scrub(self, conn: Connection, d: dict) -> None:
+        tid = d.get("tid", 0)
+        pgid = PGId(int(d["pool"]), int(d["ps"]))
+        pg = self.pgs.get(pgid)
+        if pg is None or not pg.is_primary or pg.state != STATE_ACTIVE:
+            report = {"error": f"pg {pgid} not active-primary here"}
+        else:
+            try:
+                report = await self._scrub_pg(pg, bool(d.get("repair")))
+            except Exception as e:              # noqa: BLE001
+                log.derr("pg %s: scrub failed: %s", pgid, e)
+                report = {"error": f"scrub failed: {e}"}
+        try:
+            conn.send_message(Message("pg_scrub_reply",
+                                      {"tid": tid, "report": report}))
+        except ConnectionError:
+            pass
+
+    async def _scrub_pg(self, pg: PG, repair: bool = False) -> dict:
+        """Scrub every head object of a PG: EC = device-recompute parity
+        and compare (deep scrub is cheap on TPU); replicated = compare
+        content digests across the acting set. ``repair`` heals
+        inconsistencies from the authoritative copy."""
+        my_shard = (pg.acting.index(self.osd_id)
+                    if self.osd_id in pg.acting else 0)
+        names = sorted(self._inventory(pg, my_shard))
+        details = []
+        for name in names:
+            if self._use_mclock:
+                await self.op_scheduler.acquire("scrub")
+            # serialize against mutations: a digest taken while a write
+            # is mid-replication reads false inconsistency, and a repair
+            # push landing after a newer acked write would revert it
+            if pg.is_ec:
+                async with pg.backend._lock(name):
+                    rep = await self._scrub_ec_object(pg, name, repair)
+            else:
+                async with pg.op_lock:
+                    rep = await self._scrub_replicated_object(
+                        pg, name, repair
+                    )
+            if not rep.get("clean"):
+                details.append(rep)
+        self.perf.inc("scrub_errors", len(details))
+        report = {
+            "pgid": str(pg.pgid), "objects": len(names),
+            "errors": len(details), "repaired": repair,
+            "inconsistent": details,
+        }
+        pg.last_scrub = report
+        log.dout(5, "pg %s: scrub done, %d/%d inconsistent",
+                 pg.pgid, len(details), len(names))
+        return report
+
+    async def _scrub_ec_object(self, pg: PG, name: str,
+                               repair: bool) -> dict:
+        try:
+            rep = await pg.backend.scrub(name)
+        except (KeyError, ShardReadError) as e:
+            return {"object": name, "clean": False, "error": str(e)}
+        if repair and not rep["clean"]:
+            # attribution: per-shard hinfo crcs (and stale versions)
+            # pinpoint the corrupt shard; a parity recompute mismatch
+            # alone cannot say WHICH shard rotted — a corrupt data
+            # shard makes every parity column disagree. With a crc/
+            # stale culprit, rebuild it; otherwise the data shards
+            # verified clean, so rebuild the disagreeing parity.
+            culprits = (set(rep.get("crc_mismatch", ()))
+                        | set(rep.get("stale_version", ())))
+            bad = sorted(culprits
+                         or set(rep.get("parity_inconsistent", ())))
+            live = [s for s in bad
+                    if pg.acting[s] != NO_OSD] if bad else []
+            if live:
+                try:
+                    await pg.backend.recover_shard(name, live)
+                    verify = await pg.backend.scrub(name)
+                    rep["repaired"] = live
+                    rep["clean_after_repair"] = verify["clean"]
+                except (ShardReadError, KeyError) as e:
+                    rep["repair_error"] = str(e)
+        return rep
+
+    async def _scrub_replicated_object(self, pg: PG, name: str,
+                                       repair: bool) -> dict:
+        cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
+        try:
+            mine = self._scrub_digest(cid, name)
+        except KeyError:
+            # deleted since the inventory snapshot: nothing to compare
+            return {"object": name, "clean": True, "skipped": "deleted"}
+
+        async def peer_digest(osd: int):
+            return await self.send_sub_op(osd, "scrub_obj",
+                                          cid=_enc_cid(cid), oid=name)
+
+        peers = [osd for osd in pg.acting
+                 if osd not in (self.osd_id, NO_OSD)]
+        results = await asyncio.gather(
+            *(peer_digest(o) for o in peers), return_exceptions=True
+        )
+        bad: list[int] = []
+        for osd, r in zip(peers, results):
+            if isinstance(r, BaseException) or r != mine:
+                bad.append(osd)
+        clean = not bad
+        rep = {"object": name, "clean": clean}
+        if not clean:
+            rep["inconsistent_osds"] = bad
+            if repair:
+                # the primary's copy is authoritative for scrub repair
+                # (pg repair semantics)
+                fixed = []
+                for osd in bad:
+                    try:
+                        await self._push_full_state(pg, cid, name, osd)
+                        fixed.append(osd)
+                    except (ShardReadError, KeyError,
+                            ConnectionError) as e:
+                        rep["repair_error"] = str(e)
+                rep["repaired"] = fixed
+        return rep
+
+    async def _push_full_state(self, pg: PG, cid: CollectionId,
+                               name: str, osd: int) -> None:
+        """Replace a peer's copy (head + clones + snap index) with ours
+        (the scrub-repair push; same shape as recovery push)."""
+        obj = GHObject(pg.pgid.pool, name)
+        tx = StoreTx()
+        data = self.store.read(cid, obj)
+        attrs = self.store.getattrs(cid, obj)
+        omap = self.store.omap_get(cid, obj)
+        tx.remove(cid, obj).write(cid, obj, 0, data)
+        for aname, aval in attrs.items():
+            tx.setattr(cid, obj, aname, aval)
+        if omap:
+            tx.omap_setkeys(cid, obj, omap)
+        for cand in self._clones_of(cid, name):
+            tx.remove(cid, cand)
+            tx.write(cid, cand, 0, self.store.read(cid, cand))
+            for aname, aval in self.store.getattrs(cid, cand).items():
+                tx.setattr(cid, cand, aname, aval)
+            comap = self.store.omap_get(cid, cand)
+            if comap:
+                tx.omap_setkeys(cid, cand, comap)
+        self._mapper_keys_from_ss(tx, pg, name, attrs)
+        await self.send_sub_op(osd, "tx", cid=_enc_cid(cid),
+                               ops=encode_tx(tx))
+
+    async def _scrub_loop(self) -> None:
+        """Background scrubbing (osd_scrub_interval > 0): round-robin
+        one active primary PG per tick."""
+        interval = self.conf["osd_scrub_interval"]
+        cursor = 0
+        while not self._stopped:
+            try:
+                await asyncio.sleep(interval)
+            except asyncio.CancelledError:
+                return
+            ready = [pg for pg in self.pgs.values()
+                     if pg.is_primary and pg.state == STATE_ACTIVE]
+            if not ready:
+                continue
+            pg = ready[cursor % len(ready)]
+            cursor += 1
+            try:
+                await self._scrub_pg(pg)
+            except (ShardReadError, KeyError, ConnectionError) as e:
+                log.derr("pg %s: background scrub failed: %s",
+                         pg.pgid, e)
+
     def _mapper_keys_from_ss(self, tx: StoreTx, pg: PG, name: str,
                              attrs: Mapping[str, bytes]) -> None:
         """Recovered objects must re-index their snaps: a clone without
@@ -1071,39 +1271,16 @@ class OSDDaemon:
             )
 
         async def push(name: str, entry: LogEntry, osd: int):
-            tx = StoreTx()
             obj = GHObject(pg.pgid.pool, name)
             if entry.op == OP_DELETE and not self.store.exists(cid, obj):
                 # fully gone here (trimmed whiteout included): the peer
                 # must drop its head AND any stale clones/mapper keys
                 await self.send_sub_op(osd, "purge", cid=_enc_cid(cid),
                                        oid=name)
-                self.perf.inc("recovery_ops")
-                return
             else:
                 # the full local state — including a whiteout head and
                 # any snap clones — replaces whatever the peer holds
-                data = self.store.read(cid, obj)
-                attrs = self.store.getattrs(cid, obj)
-                omap = self.store.omap_get(cid, obj)
-                tx.remove(cid, obj).write(cid, obj, 0, data)
-                for aname, aval in attrs.items():
-                    tx.setattr(cid, obj, aname, aval)
-                if omap:
-                    tx.omap_setkeys(cid, obj, omap)
-                for cand in self._clones_of(cid, name):
-                    tx.remove(cid, cand)
-                    tx.write(cid, cand, 0, self.store.read(cid, cand))
-                    for aname, aval in self.store.getattrs(
-                        cid, cand
-                    ).items():
-                        tx.setattr(cid, cand, aname, aval)
-                    comap = self.store.omap_get(cid, cand)
-                    if comap:
-                        tx.omap_setkeys(cid, cand, comap)
-                self._mapper_keys_from_ss(tx, pg, name, attrs)
-            await self.send_sub_op(osd, "tx", cid=_enc_cid(cid),
-                                   ops=encode_tx(tx))
+                await self._push_full_state(pg, cid, name, osd)
             self.perf.inc("recovery_ops")
 
         async def run_one(coro) -> bool:
@@ -2079,6 +2256,8 @@ class OSDDaemon:
                     await self.store.queue_transactions(tx)
                 elif kind == "stat":
                     value = self.store.stat(cid, oid)
+                elif kind == "scrub_obj":
+                    value = self._scrub_digest(cid, str(d["oid"]))
                 elif kind == "purge":
                     # remove head + clones + snap index keys for a name
                     # (recovery of a fully-deleted snapped object)

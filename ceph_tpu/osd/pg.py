@@ -116,6 +116,7 @@ class PG:
         self.peering_task: asyncio.Task | None = None
         self.snaptrim_task: asyncio.Task | None = None
         self.snaptrim_again = False
+        self.last_scrub: dict | None = None
         self.backend = None             # set by the daemon per interval
         self.ec_k = 0                   # EC data-chunk count (0 = replicated)
         self.log_seq = 0                # next entry seq (primary allocates)

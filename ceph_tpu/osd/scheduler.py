@@ -17,6 +17,10 @@ met first, so a recovery storm cannot push client ops past their
 guaranteed rate), then shares the remainder by weight among ops under
 their limit — the two-phase pull of the dmClock server loop.
 
+Within one class tags are monotonic, so a per-class FIFO keeps every
+queue head the class's next candidate and each grant costs O(classes)
+(no heap scans — the structure dmClock's ClientRec queues use).
+
 Ops are admitted (started), not time-sliced: the scheduler paces op
 STARTS, matching the reference's queue semantics.
 """
@@ -24,9 +28,9 @@ STARTS, matching the reference's queue semantics.
 from __future__ import annotations
 
 import asyncio
-import heapq
 import time
-from dataclasses import dataclass, field
+from collections import deque
+from dataclasses import dataclass
 
 
 @dataclass
@@ -48,16 +52,15 @@ DEFAULT_PROFILES = {
     "scrub": ClassProfile(reservation=5.0, weight=1.0, limit=0.0),
 }
 
+_INF = float("inf")
 
-@dataclass(order=True)
-class _Item:
-    sort_key: float
-    seq: int
-    clazz: str = field(compare=False)
-    r_tag: float = field(compare=False)
-    l_tag: float = field(compare=False)
-    p_tag: float = field(compare=False)
-    fut: asyncio.Future = field(compare=False)
+
+@dataclass
+class _Req:
+    r_tag: float
+    l_tag: float
+    p_tag: float
+    fut: asyncio.Future
 
 
 class MClockScheduler:
@@ -66,40 +69,36 @@ class MClockScheduler:
         self.profiles = dict(profiles or DEFAULT_PROFILES)
         self.clock = clock
         self._prev: dict[str, tuple[float, float, float]] = {}
-        self._res_heap: list[_Item] = []      # by r_tag
-        self._prop_heap: list[_Item] = []     # by p_tag
-        self._seq = 0
+        self._queues: dict[str, deque[_Req]] = {}
         self._dispatched: dict[str, int] = {}
         self._task: asyncio.Task | None = None
         self._wake = asyncio.Event()
+        self._stopped = False
 
     # -- submission --------------------------------------------------------
     async def acquire(self, clazz: str) -> None:
         """Wait for this op's dispatch slot. Ops of an unknown class run
         immediately (fail-open: QoS must never wedge the data path)."""
         prof = self.profiles.get(clazz)
-        if prof is None:
+        if prof is None or self._stopped:
             return
         now = self.clock()
         pr, pl, pp = self._prev.get(clazz, (0.0, 0.0, 0.0))
         r_tag = (max(now, pr + 1.0 / prof.reservation)
-                 if prof.reservation > 0 else float("inf"))
+                 if prof.reservation > 0 else _INF)
         l_tag = (max(now, pl + 1.0 / prof.limit)
                  if prof.limit > 0 else now)
         p_tag = (max(now, pp + 1.0 / prof.weight)
-                 if prof.weight > 0 else float("inf"))
+                 if prof.weight > 0 else _INF)
         self._prev[clazz] = (
-            r_tag if r_tag != float("inf") else pr,
+            r_tag if r_tag != _INF else pr,
             l_tag,
-            p_tag if p_tag != float("inf") else pp,
+            p_tag if p_tag != _INF else pp,
         )
-        self._seq += 1
         fut = asyncio.get_running_loop().create_future()
-        item = _Item(r_tag, self._seq, clazz, r_tag, l_tag, p_tag, fut)
-        heapq.heappush(self._res_heap, item)
-        heapq.heappush(self._prop_heap,
-                       _Item(p_tag, self._seq, clazz, r_tag, l_tag,
-                             p_tag, fut))
+        self._queues.setdefault(clazz, deque()).append(
+            _Req(r_tag, l_tag, p_tag, fut)
+        )
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(
                 self._dispatch_loop()
@@ -111,68 +110,57 @@ class MClockScheduler:
         return dict(self._dispatched)
 
     def shutdown(self) -> None:
+        """Cancel everything queued: an op blocked in acquire() at
+        daemon teardown must NOT be released to execute against a
+        half-shutdown store/messenger."""
+        self._stopped = True
         if self._task is not None:
             self._task.cancel()
-        for heap in (self._res_heap, self._prop_heap):
-            for item in heap:
-                if not item.fut.done():
-                    item.fut.set_result(None)
-            heap.clear()
+        for q in self._queues.values():
+            for req in q:
+                if not req.fut.done():
+                    req.fut.cancel()
+            q.clear()
 
     # -- dispatch ----------------------------------------------------------
-    def _grant(self, item: _Item) -> bool:
-        if item.fut.done():
-            return False                     # granted via the other heap
-        item.fut.set_result(None)
-        self._dispatched[item.clazz] = \
-            self._dispatched.get(item.clazz, 0) + 1
-        return True
+    def _grant(self, clazz: str) -> None:
+        req = self._queues[clazz].popleft()
+        if not req.fut.done():
+            req.fut.set_result(None)
+            self._dispatched[clazz] = self._dispatched.get(clazz, 0) + 1
 
     async def _dispatch_loop(self) -> None:
-        while True:
+        while not self._stopped:
             now = self.clock()
-            # phase 1: due reservations, in r_tag order
-            granted = False
-            while self._res_heap and (
-                self._res_heap[0].fut.done()
-                or self._res_heap[0].r_tag <= now
-            ):
-                item = heapq.heappop(self._res_heap)
-                if self._grant(item):
-                    granted = True
-                    break
-            if granted:
-                await asyncio.sleep(0)       # let the op start
-                continue
-            # phase 2: weight shares among ops under their limit
-            deferred = []
-            while self._prop_heap:
-                item = self._prop_heap[0]
-                if item.fut.done():
-                    heapq.heappop(self._prop_heap)
-                    continue
-                if item.l_tag <= now:
-                    heapq.heappop(self._prop_heap)
-                    self._grant(item)
-                    granted = True
-                    break
-                deferred.append(heapq.heappop(self._prop_heap))
-            for item in deferred:
-                heapq.heappush(self._prop_heap, item)
-            if granted:
-                await asyncio.sleep(0)
-                continue
-            # nothing eligible: sleep to the earliest future tag
-            tags = []
-            if self._res_heap:
-                tags.append(self._res_heap[0].r_tag)
-            tags.extend(i.l_tag for i in self._prop_heap
-                        if not i.fut.done())
-            if not tags:
+            # drop cancelled heads
+            for q in self._queues.values():
+                while q and q[0].fut.done():
+                    q.popleft()
+            heads = {c: q[0] for c, q in self._queues.items() if q}
+            if not heads:
                 self._wake.clear()
                 await self._wake.wait()
                 continue
-            delay = max(0.0, min(tags) - now)
+            # phase 1: due reservations, earliest r_tag first
+            res_due = [(req.r_tag, c) for c, req in heads.items()
+                       if req.r_tag <= now]
+            if res_due:
+                self._grant(min(res_due)[1])
+                await asyncio.sleep(0)       # let the op start
+                continue
+            # phase 2: weight shares among classes under their limit
+            prop_due = [(req.p_tag, c) for c, req in heads.items()
+                        if req.l_tag <= now]
+            if prop_due:
+                self._grant(min(prop_due)[1])
+                await asyncio.sleep(0)
+                continue
+            # nothing eligible: sleep to the earliest future tag
+            horizon = min(
+                min((req.r_tag for req in heads.values()), default=_INF),
+                min((req.l_tag for req in heads.values()), default=_INF),
+            )
+            delay = max(0.0, horizon - now)
             self._wake.clear()
             try:
                 await asyncio.wait_for(self._wake.wait(),
