@@ -1,0 +1,318 @@
+"""CephFS-lite client: the libcephfs role.
+
+Reference src/client/Client.cc + include/cephfs/libcephfs.h reduced to
+the -lite essentials: path resolution walks dentries via MDS lookups
+with client-side lease caching (the read side of the caps model);
+metadata mutations are MDS round-trips; FILE DATA is read/written
+directly against the data pool (``<ino:x>.<blockno:08x>`` objects) —
+the MDS never touches data. Open files buffer size/mtime and flush them
+to the MDS on close/fsync (the Fc/Fw cap-flush reduced to
+setattr-on-close).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ceph_tpu.client.rados import IoCtx, ObjectOperation, Rados, RadosError
+from ceph_tpu.mds.daemon import (
+    EEXIST,
+    EINVAL,
+    EISDIR,
+    ENOENT,
+    ENOTDIR,
+    block_oid,
+)
+from ceph_tpu.msg.message import Message
+from ceph_tpu.msg.messenger import Connection
+
+
+class FSError(IOError):
+    def __init__(self, rc: int, msg: str = ""):
+        super().__init__(f"rc={rc} {msg}")
+        self.rc = rc
+
+
+class FileHandle:
+    """An open file (Fh): direct data IO + deferred attr flush."""
+
+    def __init__(self, fs: "CephFS", parent: int, name: str,
+                 dentry: dict):
+        self.fs = fs
+        self.parent = parent
+        self.name = name
+        self.ino = int(dentry["ino"])
+        self.size = int(dentry.get("size", 0))
+        self._dirty = False
+        self._closed = False
+
+    # -- data path (never touches the MDS) -----------------------------
+    def _extents(self, offset: int, length: int):
+        bs = self.fs.block_size
+        pos = offset
+        end = offset + length
+        while pos < end:
+            blockno = pos // bs
+            off = pos % bs
+            run = min(bs - off, end - pos)
+            yield blockno, off, run
+            pos += run
+
+    async def write(self, data: bytes, offset: int | None = None) -> int:
+        if self._closed:
+            raise FSError(EINVAL, "closed")
+        if offset is None:
+            offset = self.size
+        pos = 0
+        for blockno, off, run in self._extents(offset, len(data)):
+            await self.fs.data.write(block_oid(self.ino, blockno),
+                                     data[pos:pos + run], off)
+            pos += run
+        self.size = max(self.size, offset + len(data))
+        self._dirty = True
+        return len(data)
+
+    async def read(self, length: int | None = None,
+                   offset: int = 0) -> bytes:
+        if length is None:
+            length = self.size - offset
+        length = max(0, min(length, self.size - offset))
+        out = bytearray(length)
+        pos = 0
+        for blockno, off, run in self._extents(offset, length):
+            try:
+                frag = await self.fs.data.read(
+                    block_oid(self.ino, blockno), run, off
+                )
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+                frag = b""              # sparse block reads as zeros
+            out[pos:pos + len(frag)] = frag
+            pos += run
+        return bytes(out)
+
+    async def truncate(self, size: int) -> None:
+        bs = self.fs.block_size
+        if size < self.size:
+            first_dead = -(-size // bs)
+            last = -(-self.size // bs)
+            for blockno in range(first_dead, last):
+                try:
+                    await self.fs.data.remove(block_oid(self.ino,
+                                                        blockno))
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+            boundary = size % bs
+            if boundary:
+                try:
+                    await self.fs.data.truncate(
+                        block_oid(self.ino, size // bs), boundary
+                    )
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+        self.size = size
+        self._dirty = True
+
+    async def fsync(self) -> None:
+        """Flush buffered attrs to the MDS (cap flush)."""
+        if self._dirty:
+            await self.fs._request("setattr", parent=self.parent,
+                                   name=self.name, size=self.size,
+                                   mtime=time.time())
+            self._dirty = False
+            self.fs._invalidate(self.parent, self.name)
+
+    async def close(self) -> None:
+        if not self._closed:
+            await self.fsync()
+            self._closed = True
+
+
+class CephFS:
+    """A mounted filesystem (ceph_mount)."""
+
+    def __init__(self, rados: Rados, mds_addr: str):
+        self.rados = rados
+        self.mds_addr = mds_addr
+        self.root = 1
+        self.block_size = 1 << 22
+        self.data: IoCtx | None = None
+        self.lease_ttl = 2.0
+        self._tid = 0
+        self._futs: dict[int, asyncio.Future] = {}
+        # (parent_ino, name) -> (dentry, lease expiry): the dentry lease
+        # cache (Client::Dentry + lease_ttl role)
+        self._dcache: dict[tuple[int, str], tuple[dict, float]] = {}
+        self._mounted = False
+        # ride the rados client's messenger: register our reply hook
+        self._orig_dispatch = rados.ms_dispatch
+        rados.msgr.set_dispatcher(self)
+
+    # -- dispatcher chaining ----------------------------------------------
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if msg.type == "mds_reply":
+            fut = self._futs.pop(int(msg.data.get("tid", 0)), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg.data)
+            return
+        await self._orig_dispatch(conn, msg)
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        self.rados.ms_handle_reset(conn)
+
+    def ms_handle_connect(self, conn: Connection) -> None:
+        pass
+
+    # -- mount / requests --------------------------------------------------
+    async def mount(self, timeout: float = 20.0) -> None:
+        reply = await self._request("session", timeout=timeout)
+        self.root = int(reply["root"])
+        self.block_size = int(reply["block_size"])
+        self.lease_ttl = float(reply.get("lease", 2.0))
+        self.data = await self.rados.open_ioctx(reply["data_pool"])
+        self._mounted = True
+
+    async def unmount(self) -> None:
+        self._mounted = False
+        self.rados.msgr.set_dispatcher(self.rados)
+
+    async def _request(self, op: str, timeout: float = 30.0,
+                       **args) -> dict:
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_running_loop().create_future()
+        self._futs[tid] = fut
+        try:
+            await self.rados.msgr.send_to(
+                self.mds_addr,
+                Message("mds_request", {"tid": tid, "op": op, **args}),
+                "mds.x",
+            )
+            reply = await asyncio.wait_for(fut, timeout)
+        except (ConnectionError, asyncio.TimeoutError) as e:
+            self._futs.pop(tid, None)
+            raise FSError(-110, f"mds request {op}: {e}") from e
+        if reply["rc"] != 0:
+            raise FSError(reply["rc"], reply.get("err", op))
+        return reply
+
+    # -- path walking ------------------------------------------------------
+    def _invalidate(self, parent: int, name: str) -> None:
+        self._dcache.pop((parent, name), None)
+
+    async def _lookup(self, parent: int, name: str) -> dict:
+        cached = self._dcache.get((parent, name))
+        if cached is not None and cached[1] > time.monotonic():
+            return cached[0]
+        reply = await self._request("lookup", parent=parent, name=name)
+        dentry = reply["dentry"]
+        self._dcache[(parent, name)] = (
+            dentry, time.monotonic() + float(reply.get("lease", 0)),
+        )
+        return dentry
+
+    @staticmethod
+    def _split(path: str) -> list[str]:
+        return [p for p in path.strip("/").split("/") if p]
+
+    async def _resolve_parent(self, path: str) -> tuple[int, str]:
+        """Walk to the parent of ``path``; returns (parent_ino, name)."""
+        parts = self._split(path)
+        if not parts:
+            raise FSError(EINVAL, "root has no parent")
+        ino = self.root
+        for part in parts[:-1]:
+            dentry = await self._lookup(ino, part)
+            if dentry["type"] != "dir":
+                raise FSError(ENOTDIR, f"{part!r} is not a directory")
+            ino = int(dentry["ino"])
+        return ino, parts[-1]
+
+    async def _resolve(self, path: str) -> dict:
+        parts = self._split(path)
+        if not parts:
+            return {"ino": self.root, "type": "dir", "mode": 0o755,
+                    "size": 0, "mtime": 0.0}
+        parent, name = await self._resolve_parent(path)
+        return await self._lookup(parent, name)
+
+    # -- the libcephfs-shaped surface --------------------------------------
+    async def mkdir(self, path: str, mode: int = 0o755) -> None:
+        parent, name = await self._resolve_parent(path)
+        await self._request("mkdir", parent=parent, name=name, mode=mode)
+        self._invalidate(parent, name)
+
+    async def mkdirs(self, path: str, mode: int = 0o755) -> None:
+        built = ""
+        for part in self._split(path):
+            built += "/" + part
+            try:
+                await self.mkdir(built, mode)
+            except FSError as e:
+                if e.rc != EEXIST:
+                    raise
+
+    async def rmdir(self, path: str) -> None:
+        parent, name = await self._resolve_parent(path)
+        await self._request("rmdir", parent=parent, name=name)
+        self._invalidate(parent, name)
+
+    async def readdir(self, path: str = "/") -> dict[str, dict]:
+        dentry = await self._resolve(path)
+        if dentry["type"] != "dir":
+            raise FSError(ENOTDIR, path)
+        reply = await self._request("readdir", ino=int(dentry["ino"]))
+        return reply["entries"]
+
+    async def stat(self, path: str) -> dict:
+        return dict(await self._resolve(path))
+
+    async def open(self, path: str, flags: str = "r",
+                   mode: int = 0o644) -> FileHandle:
+        """flags: 'r' read, 'w' create+truncate, 'a' create+append,
+        'x' exclusive create."""
+        parent, name = await self._resolve_parent(path)
+        if flags in ("w", "a", "x"):
+            reply = await self._request(
+                "create", parent=parent, name=name, mode=mode,
+                exclusive=flags == "x",
+            )
+            self._invalidate(parent, name)
+            fh = FileHandle(self, parent, name, reply["dentry"])
+            if flags == "w" and fh.size:
+                await fh.truncate(0)
+            return fh
+        dentry = await self._lookup(parent, name)
+        if dentry["type"] == "dir":
+            raise FSError(EISDIR, path)
+        return FileHandle(self, parent, name, dentry)
+
+    async def unlink(self, path: str) -> None:
+        parent, name = await self._resolve_parent(path)
+        await self._request("unlink", parent=parent, name=name)
+        self._invalidate(parent, name)
+
+    async def rename(self, src: str, dst: str) -> None:
+        sp, sn = await self._resolve_parent(src)
+        dp, dn = await self._resolve_parent(dst)
+        await self._request("rename", src_parent=sp, src_name=sn,
+                            dst_parent=dp, dst_name=dn)
+        self._invalidate(sp, sn)
+        self._invalidate(dp, dn)
+
+    # -- convenience (ceph_write_file-style helpers) -----------------------
+    async def write_file(self, path: str, data: bytes) -> None:
+        fh = await self.open(path, "w")
+        await fh.write(data, 0)
+        await fh.close()
+
+    async def read_file(self, path: str) -> bytes:
+        fh = await self.open(path, "r")
+        try:
+            return await fh.read()
+        finally:
+            await fh.close()

@@ -1,0 +1,4 @@
+"""CephFS-lite: metadata service + POSIX-ish client (reference src/mds +
+src/client, SURVEY.md §2.8)."""
+
+from ceph_tpu.mds.daemon import MDSDaemon  # noqa: F401

@@ -1,0 +1,489 @@
+"""MDS-lite: the CephFS metadata server on RADOS objects.
+
+The essentials of reference src/mds (MDSRank.h:133, MDCache.cc,
+Server.cc, MDLog.h:61) at -lite scale:
+
+- The file NAMESPACE lives in RADOS omaps: directory inode ino has a
+  dirfrag object ``<ino:x>.dir`` in the metadata pool whose omap maps
+  child name -> dentry. Inodes are EMBEDDED in their primary dentry
+  (the reference's primary-link inode embedding): type, mode, size,
+  mtime, layout.
+- Every metadata mutation is JOURNALED first (MDLog/LogEvent role): one
+  frame appended to the ``mds_journal`` object, then applied to the
+  dirfrag omaps. Replay on startup re-applies whatever a crash left
+  unapplied (entries are idempotent); the journal is compacted once
+  everything is known applied, persisting the ino allocator watermark
+  (InoTable role).
+- Clients send metadata requests over the messenger (Server.cc
+  handle_client_request); FILE DATA never passes through the MDS —
+  clients stripe it straight to the data pool (the defining CephFS
+  property). Lookup/readdir replies carry a lease TTL (the caps/lease
+  model reduced to read-caching: mutations are always MDS round-trips).
+
+File data layout (client side, reference file layout semantics):
+``<ino:x>.<blockno:08x>`` objects of ``block_size`` bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+
+from ceph_tpu.client.rados import IoCtx, ObjectOperation, Rados, RadosError
+from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.common.log import Dout
+from ceph_tpu.msg.codec import decode, encode
+from ceph_tpu.msg.message import Message
+from ceph_tpu.msg.messenger import Connection, Messenger, Policy
+
+log = Dout("mds")
+
+ROOT_INO = 1
+JOURNAL_OID = "mds_journal"
+TABLE_OID = "mds_inotable"
+_FRAME = struct.Struct("<I")
+
+# errno-style codes shared with the client
+ENOENT = -2
+EEXIST = -17
+ENOTDIR = -20
+EISDIR = -21
+ENOTEMPTY = -39
+EINVAL = -22
+
+
+def dirfrag_oid(ino: int) -> str:
+    return f"{ino:x}.dir"
+
+
+def block_oid(ino: int, blockno: int) -> str:
+    return f"{ino:x}.{blockno:08x}"
+
+
+class MDSError(Exception):
+    def __init__(self, rc: int, msg: str = "",
+                 missing_dentry: bool = False):
+        super().__init__(f"rc={rc} {msg}")
+        self.rc = rc
+        # distinguishes "the NAME is absent in an existing directory"
+        # (create may proceed) from "the directory itself is absent"
+        self.missing_dentry = missing_dentry
+
+
+def _dentry(ino: int, dtype: str, mode: int, size: int = 0) -> dict:
+    now = time.time()
+    return {"ino": ino, "type": dtype, "mode": mode, "size": size,
+            "mtime": now, "ctime": now}
+
+
+class MDSDaemon:
+    def __init__(self, name: str, monmap: dict[str, str],
+                 conf: ConfigProxy | None = None,
+                 addr: str | None = None,
+                 meta_pool: str = "cephfs_meta",
+                 data_pool: str = "cephfs_data",
+                 block_size: int = 1 << 22):
+        self.name = name
+        self.entity = f"mds.{name}"
+        self.conf = conf or ConfigProxy()
+        self.addr = addr or f"local://{self.entity}"
+        self.meta_pool = meta_pool
+        self.data_pool = data_pool
+        self.block_size = block_size
+        # the MDS is itself a RADOS client of the metadata/data pools
+        self.rados = Rados(monmap, self.conf, name=f"client.{self.entity}")
+        self.meta: IoCtx | None = None
+        self.data: IoCtx | None = None
+        self.msgr = Messenger(self.entity, self.conf)
+        self.msgr.set_policy("client", Policy.stateless_server())
+        self.msgr.set_dispatcher(self)
+        self.next_ino = ROOT_INO + 1
+        self.journal_len = 0
+        self._mutate = asyncio.Lock()    # single-MDS serialization
+        self.lease_ttl = 2.0
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self, timeout: float = 20.0) -> None:
+        await self.rados.connect(timeout)
+        self.meta = await self.rados.open_ioctx(self.meta_pool)
+        self.data = await self.rados.open_ioctx(self.data_pool)
+        await self._load_table()
+        await self._replay_journal()
+        # ensure the root dirfrag exists
+        try:
+            await self.meta.operate(dirfrag_oid(ROOT_INO),
+                                    ObjectOperation().create())
+        except RadosError as e:
+            if e.rc != EEXIST:
+                raise
+        await self.msgr.bind(self.addr)
+        log.dout(1, "%s: up at %s (meta=%s data=%s)", self.entity,
+                 self.msgr.my_addr, self.meta_pool, self.data_pool)
+
+    async def shutdown(self) -> None:
+        async with self._mutate:
+            await self._compact_journal()
+        await self.rados.shutdown()
+        await self.msgr.shutdown()
+
+    # -- journal (MDLog) ---------------------------------------------------
+    async def _load_table(self) -> None:
+        try:
+            raw = await self.meta.get_xattr(TABLE_OID, "next_ino")
+            self.next_ino = int(raw)
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
+
+    async def _replay_journal(self) -> None:
+        """Re-apply journaled mutations a crash may have left unapplied
+        (idempotent omap writes; MDLog replay role)."""
+        try:
+            raw = await self.meta.read(JOURNAL_OID)
+        except RadosError as e:
+            if e.rc == ENOENT:
+                return
+            raise
+        pos = 0
+        entries = []
+        while pos + _FRAME.size <= len(raw):
+            (n,) = _FRAME.unpack_from(raw, pos)
+            pos += _FRAME.size
+            if pos + n > len(raw):
+                break                    # torn tail
+            try:
+                entries.append(decode(raw[pos:pos + n]))
+            except (ValueError, TypeError):
+                break
+            pos += n
+        for e in entries:
+            ino = int(e.get("ino", 0))
+            if ino >= self.next_ino:
+                self.next_ino = ino + 1
+            try:
+                await self._apply(e)
+            except (RadosError, MDSError) as err:
+                log.derr("%s: journal replay of %s failed: %s",
+                         self.entity, e.get("op"), err)
+        self.journal_len = len(entries)
+        if entries:
+            await self._compact_journal()
+
+    async def _journal(self, entry: dict) -> None:
+        payload = encode(entry)
+        await self.meta.append(JOURNAL_OID,
+                               _FRAME.pack(len(payload)) + payload)
+        self.journal_len += 1
+
+    async def _compact_journal(self) -> None:
+        """Everything is applied synchronously under the mutate lock, so
+        compaction just persists the ino watermark and resets the log
+        (the journal-expire + InoTable save)."""
+        if self.meta is None:
+            return
+        await self.meta.operate(TABLE_OID, ObjectOperation()
+                                .create()
+                                .set_xattr("next_ino",
+                                           str(self.next_ino).encode()))
+        try:
+            await self.meta.operate(JOURNAL_OID,
+                                    ObjectOperation().write_full(b""))
+        except RadosError:
+            pass
+        self.journal_len = 0
+
+    # -- dirfrag helpers ---------------------------------------------------
+    async def _get_dentry(self, parent: int, name: str) -> dict:
+        try:
+            kv = await self.meta.get_omap(dirfrag_oid(parent), [name])
+        except RadosError as e:
+            raise MDSError(ENOENT, f"no dir {parent:x}") \
+                if e.rc == ENOENT else e
+        if name not in kv:
+            raise MDSError(ENOENT, f"{name!r} not in {parent:x}",
+                           missing_dentry=True)
+        return decode(kv[name])
+
+    async def _set_dentry(self, parent: int, name: str,
+                          dentry: dict) -> None:
+        await self.meta.operate(dirfrag_oid(parent), ObjectOperation()
+                                .create()
+                                .omap_set({name: encode(dentry)}))
+
+    # -- mutation application (idempotent; journal replay re-runs these) --
+    async def _apply(self, e: dict) -> None:
+        op = e["op"]
+        if op in ("mkdir", "create"):
+            dentry = dict(e["dentry"])
+            await self._set_dentry(int(e["parent"]), str(e["name"]),
+                                   dentry)
+            if op == "mkdir":
+                # the dirfrag carries a parent back-pointer so rename
+                # can walk ancestors (cycle detection)
+                await self.meta.operate(
+                    dirfrag_oid(int(e["ino"])),
+                    ObjectOperation().create().set_xattr(
+                        "parent", str(int(e["parent"])).encode()
+                    ),
+                )
+        elif op == "unlink":
+            try:
+                await self.meta.operate(
+                    dirfrag_oid(int(e["parent"])),
+                    ObjectOperation().omap_rm([str(e["name"])]),
+                )
+            except RadosError as err:
+                if err.rc != ENOENT:
+                    raise
+            await self._purge_file(int(e["ino"]), int(e.get("size", 0)))
+        elif op == "rmdir":
+            try:
+                await self.meta.operate(
+                    dirfrag_oid(int(e["parent"])),
+                    ObjectOperation().omap_rm([str(e["name"])]),
+                )
+            except RadosError as err:
+                if err.rc != ENOENT:
+                    raise
+            try:
+                await self.meta.remove(dirfrag_oid(int(e["ino"])))
+            except RadosError as err:
+                if err.rc != ENOENT:
+                    raise
+        elif op == "rename":
+            dentry = dict(e["dentry"])
+            try:
+                await self.meta.operate(
+                    dirfrag_oid(int(e["src_parent"])),
+                    ObjectOperation().omap_rm([str(e["src_name"])]),
+                )
+            except RadosError as err:
+                if err.rc != ENOENT:
+                    raise
+            await self._set_dentry(int(e["dst_parent"]),
+                                   str(e["dst_name"]), dentry)
+            if dentry.get("type") == "dir":
+                # moved directory: refresh its parent back-pointer
+                await self.meta.operate(
+                    dirfrag_oid(int(dentry["ino"])),
+                    ObjectOperation().create().set_xattr(
+                        "parent", str(int(e["dst_parent"])).encode()
+                    ),
+                )
+            if int(e.get("purge_ino", 0)):
+                await self._purge_file(int(e["purge_ino"]),
+                                       int(e.get("purge_size", 0)))
+        elif op == "setattr":
+            await self._set_dentry(int(e["parent"]), str(e["name"]),
+                                   dict(e["dentry"]))
+
+    async def _purge_file(self, ino: int, size: int) -> None:
+        """Delete a file's data objects (the PurgeQueue role, inline)."""
+        if ino <= 0:
+            return
+        nblocks = max(1, -(-size // self.block_size))
+        for b in range(nblocks):
+            try:
+                await self.data.remove(block_oid(ino, b))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+
+    # -- request handling (Server.cc handle_client_request) ---------------
+    def ms_handle_connect(self, conn: Connection) -> None:
+        pass
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        pass
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if msg.type != "mds_request":
+            log.dout(10, "%s: ignoring %s", self.entity, msg.type)
+            return
+        asyncio.get_running_loop().create_task(
+            self._handle_request(conn, msg.data)
+        )
+
+    async def _handle_request(self, conn: Connection, d: dict) -> None:
+        tid = d.get("tid", 0)
+        op = str(d.get("op", ""))
+        try:
+            handler = getattr(self, f"_req_{op}", None)
+            if handler is None:
+                raise MDSError(EINVAL, f"unknown mds op {op!r}")
+            if op in ("lookup", "readdir", "session"):
+                result = await handler(d)
+            else:
+                async with self._mutate:
+                    result = await handler(d)
+                    if self.journal_len >= 256:
+                        await self._compact_journal()
+            reply = {"tid": tid, "rc": 0, **result}
+        except MDSError as e:
+            reply = {"tid": tid, "rc": e.rc, "err": str(e)}
+        except RadosError as e:
+            reply = {"tid": tid, "rc": e.rc, "err": str(e)}
+        try:
+            conn.send_message(Message("mds_reply", reply))
+        except ConnectionError:
+            pass
+
+    # -- ops ---------------------------------------------------------------
+    async def _req_session(self, d: dict) -> dict:
+        """Session open: hand the client the layout it needs for direct
+        data IO (the mdsmap + file-layout handshake)."""
+        return {"root": ROOT_INO, "data_pool": self.data_pool,
+                "block_size": self.block_size,
+                "lease": self.lease_ttl}
+
+    async def _req_lookup(self, d: dict) -> dict:
+        dentry = await self._get_dentry(int(d["parent"]), str(d["name"]))
+        return {"dentry": dentry, "lease": self.lease_ttl}
+
+    async def _req_readdir(self, d: dict) -> dict:
+        ino = int(d["ino"])
+        try:
+            kv = await self.meta.get_omap(dirfrag_oid(ino))
+        except RadosError as e:
+            raise MDSError(ENOENT, f"no dir {ino:x}") \
+                if e.rc == ENOENT else e
+        return {
+            "entries": {name: decode(raw) for name, raw in kv.items()},
+            "lease": self.lease_ttl,
+        }
+
+    async def _alloc_ino(self) -> int:
+        ino = self.next_ino
+        self.next_ino += 1
+        return ino
+
+    async def _ensure_absent(self, parent: int, name: str) -> None:
+        try:
+            await self._get_dentry(parent, name)
+        except MDSError as e:
+            if e.missing_dentry:
+                return
+            raise
+        raise MDSError(EEXIST, f"{name!r} exists")
+
+    async def _req_mkdir(self, d: dict) -> dict:
+        parent, name = int(d["parent"]), str(d["name"])
+        await self._ensure_absent(parent, name)
+        ino = await self._alloc_ino()
+        dentry = _dentry(ino, "dir", int(d.get("mode", 0o755)))
+        entry = {"op": "mkdir", "parent": parent, "name": name,
+                 "ino": ino, "dentry": dentry}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"dentry": dentry}
+
+    async def _req_create(self, d: dict) -> dict:
+        parent, name = int(d["parent"]), str(d["name"])
+        try:
+            existing = await self._get_dentry(parent, name)
+            if d.get("exclusive"):
+                raise MDSError(EEXIST, f"{name!r} exists")
+            if existing["type"] == "dir":
+                raise MDSError(EISDIR, name)
+            return {"dentry": existing}
+        except MDSError as e:
+            if not e.missing_dentry:
+                raise
+        ino = await self._alloc_ino()
+        dentry = _dentry(ino, "file", int(d.get("mode", 0o644)))
+        entry = {"op": "create", "parent": parent, "name": name,
+                 "ino": ino, "dentry": dentry}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"dentry": dentry}
+
+    async def _req_unlink(self, d: dict) -> dict:
+        parent, name = int(d["parent"]), str(d["name"])
+        dentry = await self._get_dentry(parent, name)
+        if dentry["type"] == "dir":
+            raise MDSError(EISDIR, name)
+        entry = {"op": "unlink", "parent": parent, "name": name,
+                 "ino": int(dentry["ino"]),
+                 "size": int(dentry.get("size", 0))}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {}
+
+    async def _req_rmdir(self, d: dict) -> dict:
+        parent, name = int(d["parent"]), str(d["name"])
+        dentry = await self._get_dentry(parent, name)
+        if dentry["type"] != "dir":
+            raise MDSError(ENOTDIR, name)
+        kv = await self.meta.get_omap(dirfrag_oid(int(dentry["ino"])))
+        if kv:
+            raise MDSError(ENOTEMPTY, name)
+        entry = {"op": "rmdir", "parent": parent, "name": name,
+                 "ino": int(dentry["ino"])}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {}
+
+    async def _is_ancestor(self, ino: int, of: int) -> bool:
+        """Walk ``of``'s parent chain to the root looking for ``ino``
+        (Server::handle_client_rename's subtree check)."""
+        cur = of
+        hops = 0
+        while cur != ROOT_INO and hops < 4096:
+            if cur == ino:
+                return True
+            try:
+                raw = await self.meta.get_xattr(dirfrag_oid(cur),
+                                                "parent")
+            except RadosError:
+                return False
+            cur = int(raw)
+            hops += 1
+        return cur == ino
+
+    async def _req_rename(self, d: dict) -> dict:
+        sp, sn = int(d["src_parent"]), str(d["src_name"])
+        dp, dn = int(d["dst_parent"]), str(d["dst_name"])
+        dentry = await self._get_dentry(sp, sn)
+        if dentry["type"] == "dir" and \
+                await self._is_ancestor(int(dentry["ino"]), dp):
+            # renaming a directory into its own subtree would orphan it
+            # as an unreachable cycle
+            raise MDSError(EINVAL, "cannot move a directory into itself")
+        purge_ino = purge_size = 0
+        try:
+            dst = await self._get_dentry(dp, dn)
+            if dst["type"] == "dir":
+                if dentry["type"] != "dir":
+                    raise MDSError(EISDIR, dn)
+                kv = await self.meta.get_omap(dirfrag_oid(int(dst["ino"])))
+                if kv:
+                    raise MDSError(ENOTEMPTY, dn)
+            elif dentry["type"] == "dir":
+                raise MDSError(ENOTDIR, dn)
+            else:
+                purge_ino = int(dst["ino"])      # overwritten file
+                purge_size = int(dst.get("size", 0))
+        except MDSError as e:
+            if not e.missing_dentry:
+                raise
+        entry = {"op": "rename", "src_parent": sp, "src_name": sn,
+                 "dst_parent": dp, "dst_name": dn, "dentry": dentry,
+                 "ino": int(dentry["ino"]),
+                 "purge_ino": purge_ino, "purge_size": purge_size}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"dentry": dentry}
+
+    async def _req_setattr(self, d: dict) -> dict:
+        parent, name = int(d["parent"]), str(d["name"])
+        dentry = await self._get_dentry(parent, name)
+        for key in ("size", "mode"):
+            if key in d and d[key] is not None:
+                dentry[key] = int(d[key])
+        dentry["mtime"] = float(d.get("mtime", time.time()))
+        entry = {"op": "setattr", "parent": parent, "name": name,
+                 "ino": int(dentry["ino"]), "dentry": dentry}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"dentry": dentry}

@@ -759,10 +759,10 @@ class OSDDaemon:
 
     async def _trim_object_snap(self, pg: PG, name: str, snapid: int,
                                 mapper_key: str) -> None:
-        async with pg.op_lock:
-            # under the PG op lock: a concurrent client write COWs new
-            # clones and rewrites the SnapSet; interleaving would apply
-            # a stale pruned copy over it
+        async with pg.obj_lock(name):
+            # under the object's op lock: a concurrent client write COWs
+            # new clones and rewrites the SnapSet; interleaving would
+            # apply a stale pruned copy over it
             await self._trim_object_snap_locked(pg, name, snapid,
                                                 mapper_key)
 
@@ -807,10 +807,7 @@ class OSDDaemon:
 
     # -- scrub (the chunky_scrub / scrub_compare_maps loop, PG.cc:2647,
     # driven here manually via `pg scrub` or periodically) ---------------
-    def _scrub_digest(self, cid: CollectionId, name: str) -> dict:
-        """Per-object scrub-map entry: content digests a peer compares
-        (ScrubMap::object role)."""
-        obj = GHObject(cid.pool, name)
+    def _digest_one(self, cid: CollectionId, obj: GHObject) -> dict:
         data = self.store.read(cid, obj)
         attrs = self.store.getattrs(cid, obj)
         omap = self.store.omap_get(cid, obj)
@@ -826,6 +823,22 @@ class OSDDaemon:
             "attrs_crc": acrc,
             "omap_crc": ocrc,
         }
+
+    def _scrub_digest(self, cid: CollectionId, name: str) -> dict:
+        """Per-object scrub-map entry: content digests of the head AND
+        every snap clone (reference scrub maps include clones — rot in
+        a snapshot must not pass as clean). A missing object digests as
+        {"absent": True} so missing-on-one-member IS an inconsistency."""
+        try:
+            out = {
+                "head": self._digest_one(cid, GHObject(cid.pool, name)),
+                "clones": {},
+            }
+        except KeyError:
+            return {"absent": True}
+        for cand in self._clones_of(cid, name):
+            out["clones"][str(cand.snap)] = self._digest_one(cid, cand)
+        return out
 
     async def _handle_pg_scrub(self, conn: Connection, d: dict) -> None:
         tid = d.get("tid", 0)
@@ -850,9 +863,7 @@ class OSDDaemon:
         and compare (deep scrub is cheap on TPU); replicated = compare
         content digests across the acting set. ``repair`` heals
         inconsistencies from the authoritative copy."""
-        my_shard = (pg.acting.index(self.osd_id)
-                    if self.osd_id in pg.acting else 0)
-        names = sorted(self._inventory(pg, my_shard))
+        names = sorted(await self._scrub_names(pg))
         details = []
         for name in names:
             if self._use_mclock:
@@ -861,10 +872,10 @@ class OSDDaemon:
             # is mid-replication reads false inconsistency, and a repair
             # push landing after a newer acked write would revert it
             if pg.is_ec:
-                async with pg.backend._lock(name):
+                async with pg.backend.object_lock(name):
                     rep = await self._scrub_ec_object(pg, name, repair)
             else:
-                async with pg.op_lock:
+                async with pg.obj_lock(name):
                     rep = await self._scrub_replicated_object(
                         pg, name, repair
                     )
@@ -881,6 +892,29 @@ class OSDDaemon:
                  pg.pgid, len(details), len(names))
         return report
 
+    async def _scrub_names(self, pg: PG) -> set[str]:
+        """Union of object names across every acting member: an object
+        missing on the primary must still be scrubbed (the reference
+        compares scrub maps from ALL members)."""
+        names: set[str] = set()
+        for shard, osd in enumerate(pg.acting):
+            if osd == NO_OSD:
+                continue
+            if osd == self.osd_id:
+                names |= set(self._inventory(pg, shard))
+                continue
+            cid = (CollectionId(pg.pgid.pool, pg.pgid.ps, shard)
+                   if pg.is_ec
+                   else CollectionId(pg.pgid.pool, pg.pgid.ps))
+            try:
+                listed = await self.send_sub_op(
+                    osd, "scrub_list", cid=_enc_cid(cid)
+                )
+                names |= {str(n) for n in listed}
+            except (ShardReadError, KeyError, ConnectionError):
+                pass            # unreachable peer: digest phase flags it
+        return names
+
     async def _scrub_ec_object(self, pg: PG, name: str,
                                repair: bool) -> dict:
         try:
@@ -896,8 +930,21 @@ class OSDDaemon:
             # verified clean, so rebuild the disagreeing parity.
             culprits = (set(rep.get("crc_mismatch", ()))
                         | set(rep.get("stale_version", ())))
-            bad = sorted(culprits
-                         or set(rep.get("parity_inconsistent", ())))
+            if culprits:
+                bad = sorted(culprits)
+            elif rep.get("hinfo"):
+                # data shards verified clean by their crcs: the
+                # disagreeing parity is the rot — safe to recompute
+                bad = sorted(set(rep.get("parity_inconsistent", ())))
+            else:
+                # no per-shard crcs (hinfo invalidated by an overwrite):
+                # a parity mismatch cannot be attributed — recomputing
+                # parity from a possibly-rotten data shard would LAUNDER
+                # the corruption into fresh parity. Leave inconsistent.
+                rep["repair_error"] = (
+                    "unattributable without per-shard crcs (hinfo)"
+                )
+                bad = []
             live = [s for s in bad
                     if pg.acting[s] != NO_OSD] if bad else []
             if live:
@@ -913,11 +960,7 @@ class OSDDaemon:
     async def _scrub_replicated_object(self, pg: PG, name: str,
                                        repair: bool) -> dict:
         cid = CollectionId(pg.pgid.pool, pg.pgid.ps)
-        try:
-            mine = self._scrub_digest(cid, name)
-        except KeyError:
-            # deleted since the inventory snapshot: nothing to compare
-            return {"object": name, "clean": True, "skipped": "deleted"}
+        mine = self._scrub_digest(cid, name)
 
         async def peer_digest(osd: int):
             return await self.send_sub_op(osd, "scrub_obj",
@@ -928,26 +971,64 @@ class OSDDaemon:
         results = await asyncio.gather(
             *(peer_digest(o) for o in peers), return_exceptions=True
         )
-        bad: list[int] = []
+
+        def key(digest) -> str:
+            return json.dumps(digest, sort_keys=True)
+
+        # digest MAJORITY picks the authoritative copy — the primary's
+        # own copy may be the rotten one, and blindly pushing it would
+        # overwrite every good replica (be_select_auth_object role)
+        groups: dict[str, list[int]] = {key(mine): [self.osd_id]}
+        unreachable: list[int] = []
         for osd, r in zip(peers, results):
-            if isinstance(r, BaseException) or r != mine:
-                bad.append(osd)
-        clean = not bad
-        rep = {"object": name, "clean": clean}
-        if not clean:
-            rep["inconsistent_osds"] = bad
+            if isinstance(r, KeyError):
+                groups.setdefault(key({"absent": True}), []).append(osd)
+            elif isinstance(r, BaseException):
+                unreachable.append(osd)
+            else:
+                groups.setdefault(key(r), []).append(osd)
+        best = max(groups.values(), key=len)
+        ties = [g for g in groups.values() if len(g) == len(best)]
+        if len(groups) == 1 and not unreachable:
+            return {"object": name, "clean": True}
+        rep = {"object": name, "clean": False}
+        if len(ties) > 1:
+            # no majority: attribution is indeterminate — blaming one
+            # side would finger a possibly-healthy copy
+            rep["inconsistent_osds"] = sorted(
+                osd for g in groups.values() for osd in g
+            ) + unreachable
+            rep["attribution"] = "indeterminate"
             if repair:
-                # the primary's copy is authoritative for scrub repair
-                # (pg repair semantics)
-                fixed = []
-                for osd in bad:
-                    try:
-                        await self._push_full_state(pg, cid, name, osd)
-                        fixed.append(osd)
-                    except (ShardReadError, KeyError,
-                            ConnectionError) as e:
-                        rep["repair_error"] = str(e)
-                rep["repaired"] = fixed
+                rep["repair_error"] =                     "no digest majority; refusing repair"
+            return rep
+        bad = sorted(
+            osd for g in groups.values() if g is not best for osd in g
+        ) + unreachable
+        rep["inconsistent_osds"] = bad
+        if not repair:
+            return rep
+        fixed = []
+        try:
+            if self.osd_id not in best:
+                # the primary itself is the outlier: adopt a majority
+                # copy before re-pushing
+                src_osd = best[0]
+                full = await self.send_sub_op(src_osd, "read_full",
+                                              cid=_enc_cid(cid),
+                                              oid=name)
+                await self.store.queue_transactions(
+                    self._full_state_tx(pg, cid, name, full)
+                )
+                fixed.append(self.osd_id)
+            for osd in bad:
+                if osd == self.osd_id:
+                    continue
+                await self._push_full_state(pg, cid, name, osd)
+                fixed.append(osd)
+        except (ShardReadError, KeyError, ConnectionError) as e:
+            rep["repair_error"] = str(e)
+        rep["repaired"] = fixed
         return rep
 
     async def _push_full_state(self, pg: PG, cid: CollectionId,
@@ -994,9 +1075,45 @@ class OSDDaemon:
             cursor += 1
             try:
                 await self._scrub_pg(pg)
-            except (ShardReadError, KeyError, ConnectionError) as e:
+            except asyncio.CancelledError:
+                return
+            except Exception as e:              # noqa: BLE001
+                # anything else (interval change mid-scrub, backend
+                # swapped away, ...) must not kill the loop for good
                 log.derr("pg %s: background scrub failed: %s",
                          pg.pgid, e)
+
+    def _local_rm_tx(self, pg: PG, cid: CollectionId,
+                     name: str) -> StoreTx:
+        tx = StoreTx()
+        obj = GHObject(pg.pgid.pool, name)
+        if self.store.exists(cid, obj):
+            tx.remove(cid, obj)
+        for cand in self._clones_of(cid, name):
+            tx.remove(cid, cand)
+        self._rm_mapper_keys(tx, pg, name)
+        return tx
+
+    def _full_state_tx(self, pg: PG, cid: CollectionId, name: str,
+                       full: dict) -> StoreTx:
+        """Replace the local object (head + clones + snap index) with a
+        peer's full state (recovery pull / scrub-repair pull)."""
+        tx = self._local_rm_tx(pg, cid, name)
+        obj = GHObject(pg.pgid.pool, name)
+        tx.write(cid, obj, 0, full["data"])
+        for aname, aval in full["attrs"].items():
+            tx.setattr(cid, obj, aname, aval)
+        if full["omap"]:
+            tx.omap_setkeys(cid, obj, full["omap"])
+        for snapstr, cstate in full.get("clones", {}).items():
+            cobj = snaps.clone_oid(pg.pgid.pool, name, int(snapstr))
+            tx.write(cid, cobj, 0, cstate["data"])
+            for aname, aval in cstate["attrs"].items():
+                tx.setattr(cid, cobj, aname, aval)
+            if cstate["omap"]:
+                tx.omap_setkeys(cid, cobj, cstate["omap"])
+        self._mapper_keys_from_ss(tx, pg, name, full["attrs"])
+        return tx
 
     def _mapper_keys_from_ss(self, tx: StoreTx, pg: PG, name: str,
                              attrs: Mapping[str, bytes]) -> None:
@@ -1213,32 +1330,10 @@ class OSDDaemon:
             return None
 
         def _local_rm(name: str) -> StoreTx:
-            tx = StoreTx()
-            obj = GHObject(pg.pgid.pool, name)
-            if self.store.exists(cid, obj):
-                tx.remove(cid, obj)
-            for cand in self._clones_of(cid, name):
-                tx.remove(cid, cand)
-            self._rm_mapper_keys(tx, pg, name)
-            return tx
+            return self._local_rm_tx(pg, cid, name)
 
         def _full_state_tx(name: str, full: dict) -> StoreTx:
-            tx = _local_rm(name)
-            obj = GHObject(pg.pgid.pool, name)
-            tx.write(cid, obj, 0, full["data"])
-            for aname, aval in full["attrs"].items():
-                tx.setattr(cid, obj, aname, aval)
-            if full["omap"]:
-                tx.omap_setkeys(cid, obj, full["omap"])
-            for snapstr, cstate in full.get("clones", {}).items():
-                cobj = snaps.clone_oid(pg.pgid.pool, name, int(snapstr))
-                tx.write(cid, cobj, 0, cstate["data"])
-                for aname, aval in cstate["attrs"].items():
-                    tx.setattr(cid, cobj, aname, aval)
-                if cstate["omap"]:
-                    tx.omap_setkeys(cid, cobj, cstate["omap"])
-            self._mapper_keys_from_ss(tx, pg, name, full["attrs"])
-            return tx
+            return self._full_state_tx(pg, cid, name, full)
 
         async def pull(name: str, entry: LogEntry):
             if entry.op == OP_DELETE:
@@ -1750,7 +1845,7 @@ class OSDDaemon:
         object's SnapSet clone the pre-batch head first (copy-on-first-
         write); ``snapid`` reads resolve through the SnapSet to a clone
         or the head."""
-        async with pg.op_lock:
+        async with pg.obj_lock(oid):
             return await self._do_ops_replicated_locked(
                 pg, oid, ops, reqid, snapc, snapid
             )
@@ -2235,7 +2330,8 @@ class OSDDaemon:
                 )
             else:
                 cid = _dec_cid(d["cid"])
-                oid = GHObject(cid.pool, str(d["oid"]), shard=cid.shard)
+                oid = GHObject(cid.pool, str(d.get("oid", "")),
+                               shard=cid.shard)
                 if kind == "write":
                     tx = StoreTx().write(cid, oid, int(d["off"]),
                                          d["data"])
@@ -2258,6 +2354,11 @@ class OSDDaemon:
                     value = self.store.stat(cid, oid)
                 elif kind == "scrub_obj":
                     value = self._scrub_digest(cid, str(d["oid"]))
+                elif kind == "scrub_list":
+                    pgid2 = PGId(cid.pool, cid.pg)
+                    pg2 = self.pgs.get(pgid2)
+                    value = (sorted(self._inventory(pg2, cid.shard))
+                             if pg2 is not None else [])
                 elif kind == "purge":
                     # remove head + clones + snap index keys for a name
                     # (recovery of a fully-deleted snapped object)

@@ -186,6 +186,11 @@ class ECBackend:
 
         return _Guard()
 
+    def object_lock(self, oid: str):
+        """Public per-object write-serialization guard (scrub and other
+        external coordinators serialize against mutations with this)."""
+        return self._lock(oid)
+
     # -- metadata --------------------------------------------------------
     async def _attr_all(self, oid: str, name: str) -> list:
         """Fetch one attr from every shard concurrently (metadata is
@@ -826,5 +831,8 @@ class ECBackend:
             "parity_inconsistent": inconsistent,
             "crc_mismatch": crc_mismatch,
             "stale_version": stale,
+            # whether per-shard crc attribution was available: without
+            # it a parity mismatch cannot name the rotten shard
+            "hinfo": bool(raw),
             "clean": not inconsistent and not crc_mismatch and not stale,
         }

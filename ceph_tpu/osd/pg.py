@@ -132,11 +132,13 @@ class PG:
         # serializes log maintenance (activation merge vs trim) so their
         # read-modify-write cycles cannot interleave and regress the tail
         self.log_lock = asyncio.Lock()
-        # the PG lock of the reference: replicated-pool mutations (and
-        # the snap trimmer) read object state, build a transaction, and
-        # await replication — interleaving two such cycles on one PG
-        # loses updates (version bumps, SnapSet edits)
-        self.op_lock = asyncio.Lock()
+        # per-object op locks: replicated-pool mutations, the snap
+        # trimmer, and scrub read object state, build a transaction, and
+        # await replication — interleaving two such cycles on one OBJECT
+        # loses updates (version bumps, SnapSet edits). Object-granular
+        # (not PG-wide) so a scrub's network round-trips never stall
+        # client IO to other objects.
+        self._obj_locks: dict[str, tuple[asyncio.Lock, int]] = {}
 
     # -- interval handling -------------------------------------------------
     @property
@@ -177,6 +179,29 @@ class PG:
         log.dout(10, "pg %s interval e%d acting %s primary %d role %s",
                  self.pgid, epoch, acting, primary,
                  "primary" if self.is_primary else "replica")
+
+    def obj_lock(self, name: str):
+        """Refcounted per-object mutation lock (guard form)."""
+        pg = self
+
+        class _Guard:
+            async def __aenter__(self):
+                lock, refs = pg._obj_locks.get(name, (asyncio.Lock(), 0))
+                pg._obj_locks[name] = (lock, refs + 1)
+                self._lock = lock
+                await lock.acquire()
+                return lock
+
+            async def __aexit__(self, *exc):
+                self._lock.release()
+                lock, refs = pg._obj_locks[name]
+                if refs <= 1:
+                    del pg._obj_locks[name]
+                else:
+                    pg._obj_locks[name] = (lock, refs - 1)
+                return False
+
+        return _Guard()
 
     # -- log bookkeeping ----------------------------------------------------
     def next_entry(self, epoch: int, oid: str, op: str, obj_version: int,

@@ -49,6 +49,7 @@ class DevCluster:
             self.monmap = {n: f"local://mon.{n}" for n in mon_names}
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSDDaemon] = {}
+        self.mdss: dict[str, "object"] = {}
         self._osd_stores: dict[int, ObjectStore] = {}
 
     def conf(self) -> ConfigProxy:
@@ -105,7 +106,24 @@ class DevCluster:
         """Restart with the surviving store (revive_osd :480)."""
         return await self.start_osd(osd_id)
 
+    async def start_mds(self, name: str = "a",
+                        meta_pool: str = "cephfs_meta",
+                        data_pool: str = "cephfs_data",
+                        block_size: int = 1 << 22):
+        """Boot an MDS over existing pools (fs-new + mds boot). The
+        pools must already exist."""
+        from ceph_tpu.mds.daemon import MDSDaemon
+        mds = MDSDaemon(name, self.monmap, self.conf(),
+                        meta_pool=meta_pool, data_pool=data_pool,
+                        block_size=block_size)
+        await mds.start()
+        self.mdss[name] = mds
+        return mds
+
     async def stop(self) -> None:
+        for mds in list(self.mdss.values()):
+            await mds.shutdown()
+        self.mdss.clear()
         for osd in list(self.osds.values()):
             await osd.shutdown()
         self.osds.clear()

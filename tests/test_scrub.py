@@ -70,6 +70,106 @@ def test_replicated_scrub_detects_and_repairs_corruption():
     asyncio.run(run())
 
 
+def test_scrub_repair_heals_corrupt_primary_from_majority():
+    """The primary's own copy rotting must not be pushed over the good
+    replicas: the digest majority elects the authoritative copy and the
+    primary adopts it (be_select_auth_object role)."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        pool_id = await rados.pool_create("scrubpri", pg_num=4, size=3,
+                                          min_size=2)
+        io = await rados.open_ioctx("scrubpri")
+        payload = b"the-good-bytes" * 32
+        await io.write_full("victim", payload)
+        ps, acting, primary = _acting(cluster, pool_id, "victim", 4)
+
+        cid = CollectionId(pool_id, ps)
+        obj = GHObject(pool_id, "victim")
+        await cluster.osds[primary].store.queue_transactions(
+            Transaction().write(cid, obj, 0, b"ROT")
+        )
+        report = await rados.pg_scrub(pool_id, ps, repair=True)
+        bad = report["inconsistent"][0]
+        # the PRIMARY was the outlier and was repaired from the majority
+        assert primary in bad["repaired"]
+        assert cluster.osds[primary].store.read(cid, obj) == payload
+        report = await rados.pg_scrub(pool_id, ps)
+        assert report["errors"] == 0
+        assert await io.read("victim") == payload
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_scrub_finds_object_missing_on_primary():
+    """An object silently lost on the primary is still scrubbed (name
+    union across members) and repaired from the surviving copies."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        pool_id = await rados.pool_create("scrubmiss", pg_num=4, size=3,
+                                          min_size=2)
+        io = await rados.open_ioctx("scrubmiss")
+        payload = b"still-on-replicas" * 16
+        await io.write_full("lost", payload)
+        ps, acting, primary = _acting(cluster, pool_id, "lost", 4)
+        cid = CollectionId(pool_id, ps)
+        obj = GHObject(pool_id, "lost")
+        await cluster.osds[primary].store.queue_transactions(
+            Transaction().remove(cid, obj)
+        )
+        report = await rados.pg_scrub(pool_id, ps)
+        assert report["errors"] == 1
+        assert report["inconsistent"][0]["inconsistent_osds"] \
+            == [primary]
+        report = await rados.pg_scrub(pool_id, ps, repair=True)
+        assert primary in report["inconsistent"][0]["repaired"]
+        assert cluster.osds[primary].store.read(cid, obj) == payload
+        assert await io.read("lost") == payload
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_scrub_detects_corrupt_snapshot_clone():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        pool_id = await rados.pool_create("scrubsnap", pg_num=4, size=3,
+                                          min_size=2)
+        io = await rados.open_ioctx("scrubsnap")
+        await io.write_full("snapobj", b"original")
+        s1 = await io.selfmanaged_snap_create()
+        await io.write_full("snapobj", b"newer-data")   # COW clone
+        ps, acting, primary = _acting(cluster, pool_id, "snapobj", 4)
+        report = await rados.pg_scrub(pool_id, ps)
+        assert report["errors"] == 0
+
+        # rot the CLONE on a replica — the head stays identical
+        replica = next(o for o in acting if o != primary)
+        cid = CollectionId(pool_id, ps)
+        clone = GHObject(pool_id, "snapobj", snap=s1)
+        await cluster.osds[replica].store.queue_transactions(
+            Transaction().write(cid, clone, 0, b"ROT")
+        )
+        report = await rados.pg_scrub(pool_id, ps)
+        assert report["errors"] == 1
+        report = await rados.pg_scrub(pool_id, ps, repair=True)
+        assert replica in report["inconsistent"][0]["repaired"]
+        io.snap_set_read(s1)
+        assert await io.read("snapobj") == b"original"
+        io.snap_set_read(None)
+        assert cluster.osds[replica].store.read(cid, clone) \
+            == b"original"
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
 def test_ec_scrub_detects_and_repairs_shard_corruption():
     async def run():
         cluster = DevCluster(n_mons=1, n_osds=6)
