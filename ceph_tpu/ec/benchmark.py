@@ -122,7 +122,8 @@ def run_encode(ec, size: int, iterations: int, stripes: int) -> dict:
     data = np.random.default_rng(0).integers(
         0, 256, (stripes, k, chunk), dtype=np.uint8
     )
-    if not hasattr(ec, "encode_words_device"):
+    if not hasattr(ec, "encode_words_device") \
+            or getattr(ec, "full_bm", None) is not None:
         # Host-path plugins (lrc/shec/clay orchestration): wall-clock the
         # batch API; results materialize on the host so timing is honest.
         np.asarray(ec.encode_chunks_batch(data))  # warm jit compiles
@@ -166,7 +167,8 @@ def run_decode(ec, size: int, iterations: int, stripes: int,
         0, 256, (stripes, k, chunk), dtype=np.uint8
     )
     lost = list(erased) if erased else list(range(min(erasures, n)))
-    if not hasattr(ec, "encode_words_device"):
+    if not hasattr(ec, "encode_words_device") \
+            or getattr(ec, "full_bm", None) is not None:
         chunks = np.asarray(ec.encode_chunks_batch(data))
         avail = {i: chunks[:, i] for i in range(n) if i not in lost}
         for v in ec.decode_chunks_batch(avail, lost).values():

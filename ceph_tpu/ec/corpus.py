@@ -38,6 +38,17 @@ PROFILES: list[tuple[str, dict[str, str]]] = [
     ("jax_rs", {"k": "10", "m": "4", "technique": "cauchy_good"}),
     ("jax_rs", {"k": "8", "m": "4", "technique": "isa_cauchy"}),
     ("jax_rs", {"k": "6", "m": "2", "technique": "reed_sol_r6_op"}),
+    # bit-schedule techniques (packet-layout GF(2) bitmatrices)
+    ("jax_rs", {"k": "5", "m": "2", "technique": "liberation",
+                "w": "7"}),
+    ("jax_rs", {"k": "6", "m": "2", "technique": "blaum_roth",
+                "w": "6"}),
+    ("jax_rs", {"k": "6", "m": "2", "technique": "liber8tion"}),
+    # wide-symbol RS (GF(2^16)/GF(2^32) via bitmatrix expansion)
+    ("jax_rs", {"k": "5", "m": "3", "technique": "reed_sol_van",
+                "w": "16"}),
+    ("jax_rs", {"k": "4", "m": "2", "technique": "reed_sol_van",
+                "w": "32"}),
     ("xor", {"k": "3", "m": "1"}),
     # LRC: generated kml form (BASELINE config #5 family) and explicit layers.
     ("lrc", {"k": "8", "m": "4", "l": "3"}),

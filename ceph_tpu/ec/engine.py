@@ -55,6 +55,32 @@ def bitplane_apply(bits_matrix: jax.Array, data: jax.Array) -> jax.Array:
 _apply_bitmatrix = jax.jit(bitplane_apply)
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def packet_bitmatrix_apply(bits_matrix: jax.Array, data: jax.Array,
+                           w: int) -> jax.Array:
+    """(P, Q) bf16 0/1 bitmatrix x (B, Q/w chunks, C) uint8 -> (B, P/w, C)
+    in PACKET layout: each chunk is w packets of C/w bytes; output packet
+    r of chunk i is the GF(2) combination selected by bitmatrix row
+    i*w + r (jerasure_schedule_encode semantics). Same MXU formulation
+    as bitplane_apply — bytes unpack to bit planes, 0/1 matmul with f32
+    accumulation, mod 2, repack — with the packet axis as the symbol
+    axis instead of the in-byte bit axis."""
+    B, k, C = data.shape
+    pkt = C // w
+    pk = data.reshape(B, k * w, pkt)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((pk[:, :, :, None] >> shifts[None, None, None, :]) & 1)
+    bits = bits.reshape(B, k * w, pkt * 8).astype(jnp.bfloat16)
+    acc = jnp.einsum(
+        "pq,bqc->bpc", bits_matrix, bits,
+        preferred_element_type=jnp.float32,
+    )
+    obits = (acc.astype(jnp.int32) & 1).reshape(B, -1, pkt, 8)
+    weights = (jnp.int32(1) << jnp.arange(8, dtype=jnp.int32))
+    by = jnp.sum(obits * weights[None, None, None, :], axis=3)
+    return by.astype(jnp.uint8).reshape(B, -1, C)
+
+
 def _default_use_pallas() -> bool:
     """Fused Pallas kernel on real TPU; XLA einsum elsewhere (CPU tests,
     interpret-mode covers the Pallas math there)."""
@@ -89,7 +115,7 @@ class BitplaneEngine:
 
     def _cached(self, cache: dict, coeff: np.ndarray, factory):
         """FIFO-bounded per-coefficient-matrix cache lookup."""
-        key = coeff.tobytes() + bytes(coeff.shape)
+        key = coeff.tobytes() + repr(coeff.shape).encode()
         hit = cache.get(key)
         if hit is None:
             hit = factory(coeff)
@@ -159,6 +185,28 @@ class BitplaneEngine:
         mat = self._device_bitmatrix(coeff)
         by = words_to_bytes(jnp.asarray(words))
         return bytes_to_words(_apply_bitmatrix(mat, by[None])[0])
+
+    def _device_raw_bitmatrix(self, BM: np.ndarray) -> jax.Array:
+        from ceph_tpu.common.jaxutil import outside_trace
+
+        if not outside_trace():
+            return jnp.asarray(BM, jnp.bfloat16)
+        return self._cached(
+            self._cache, BM, lambda b: jnp.asarray(b, jnp.bfloat16)
+        )
+
+    def apply_packets(self, BM: np.ndarray, data, w: int) -> jax.Array:
+        """Apply a RAW GF(2) bitmatrix (rows, k*w) in packet layout to
+        data (B, k, C) with C % w == 0 (the bit-schedule code path:
+        liberation / blaum_roth / liber8tion / w=16,32 RS)."""
+        BM = np.asarray(BM, np.uint8)
+        data = jnp.asarray(data, jnp.uint8)
+        squeeze = data.ndim == 2
+        if squeeze:
+            data = data[None]
+        mat = self._device_raw_bitmatrix(BM)
+        out = packet_bitmatrix_apply(mat, data, w)
+        return out[0] if squeeze else out
 
     def encode_shards(self, generator: np.ndarray, data) -> jax.Array:
         """Systematic shard-layout encode: (k, N) -> (k+m, N).

@@ -23,6 +23,7 @@ from ceph_tpu.common.cache import FIFOCache
 from ceph_tpu.ec import reference
 from ceph_tpu.ec.base import ErasureCode
 from ceph_tpu.ec.engine import default_engine
+from ceph_tpu.ec import bitsched
 from ceph_tpu.ec.matrix import generator_matrix
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
 
@@ -33,7 +34,14 @@ TECHNIQUES = (
     "cauchy_good",
     "isa_vandermonde",
     "isa_cauchy",
+    # bit-schedule techniques (reference ErasureCodeJerasure.h:192-240)
+    "liberation",
+    "blaum_roth",
+    "liber8tion",
 )
+
+# techniques that run as raw GF(2) bitmatrices in packet layout
+BITSCHED_TECHNIQUES = ("liberation", "blaum_roth", "liber8tion")
 
 DEFAULT_K = 2
 DEFAULT_M = 2
@@ -45,8 +53,10 @@ class ErasureCodeJaxRS(ErasureCode):
         super().__init__()
         self.k = DEFAULT_K
         self.m = DEFAULT_M
+        self.w = 8
         self.technique = DEFAULT_TECHNIQUE
         self.generator: np.ndarray | None = None
+        self.full_bm: np.ndarray | None = None
         self._engine = default_engine()
         self._decode_matrix_cache: FIFOCache = FIFOCache(512)
         if profile is not None:
@@ -57,27 +67,68 @@ class ErasureCodeJaxRS(ErasureCode):
         self.k = self.to_int(profile, "k", DEFAULT_K)
         self.m = self.to_int(profile, "m", DEFAULT_M)
         self.technique = str(profile.get("technique", DEFAULT_TECHNIQUE))
-        w = self.to_int(profile, "w", 8)
-        if w != 8:
-            raise ValueError(f"jax_rs supports w=8 only, got w={w}")
         if self.k < 1 or self.m < 1:
             raise ValueError(f"k={self.k} m={self.m} must be >= 1")
-        if self.k + self.m > 256:
-            raise ValueError("k+m must be <= 256 in GF(2^8)")
         if self.technique not in TECHNIQUES:
             raise ValueError(
                 f"unknown technique {self.technique!r}; have {TECHNIQUES}"
             )
-        if self.technique == "isa_vandermonde":
-            # Matrix-safety caps (ErasureCodeIsa.cc:330-360).
-            if self.m > 4:
-                raise ValueError("isa_vandermonde requires m <= 4")
-            if self.m == 4 and self.k > 21:
-                raise ValueError("isa_vandermonde m=4 requires k <= 21")
-        if self.technique == "reed_sol_r6_op" and self.m != 2:
-            raise ValueError("reed_sol_r6_op requires m=2")
-        self.generator = generator_matrix(self.technique, self.k, self.m)
+        default_w = {"liberation": 7, "blaum_roth": 6,
+                     "liber8tion": 8}.get(self.technique, 8)
+        self.w = self.to_int(profile, "w", default_w)
+        self.full_bm = None            # raw-GF(2) bitmatrix mode if set
+        if self.technique in BITSCHED_TECHNIQUES:
+            # bit-schedule RAID-6 family: m=2 fixed, per-technique w
+            if self.m != 2:
+                raise ValueError(f"{self.technique} requires m=2")
+            if self.technique == "liberation":
+                parity = bitsched.liberation_bitmatrix(self.k, self.w)
+            elif self.technique == "blaum_roth":
+                parity = bitsched.blaum_roth_bitmatrix(self.k, self.w)
+            else:
+                if self.w != 8:
+                    raise ValueError("liber8tion requires w=8")
+                parity = bitsched.liber8tion_bitmatrix(self.k)
+            self.full_bm = bitsched.full_bitmatrix(parity, self.k, self.w)
+            self.generator = None
+        elif self.w in (16, 32):
+            # wide-symbol RS: GF(2^w) generator expanded to a bitmatrix
+            # run in packet layout (jerasure w=16/32 semantics)
+            if self.technique != "reed_sol_van":
+                raise ValueError(
+                    f"w={self.w} is supported for reed_sol_van only"
+                )
+            if self.k + self.m > (1 << self.w):
+                raise ValueError(f"k+m must be <= 2^{self.w}")
+            gen = bitsched.reed_sol_van_w(self.k, self.m, self.w)
+            self.full_bm = bitsched.matrix_to_bitmatrix(gen, self.w)
+            self.generator = None
+        else:
+            if self.w != 8:
+                raise ValueError(
+                    f"w={self.w} unsupported for {self.technique} "
+                    f"(w in {{8,16,32}} for reed_sol_van; technique "
+                    f"defaults otherwise)"
+                )
+            if self.k + self.m > 256:
+                raise ValueError("k+m must be <= 256 in GF(2^8)")
+            if self.technique == "isa_vandermonde":
+                # Matrix-safety caps (ErasureCodeIsa.cc:330-360).
+                if self.m > 4:
+                    raise ValueError("isa_vandermonde requires m <= 4")
+                if self.m == 4 and self.k > 21:
+                    raise ValueError("isa_vandermonde m=4 requires k <= 21")
+            if self.technique == "reed_sol_r6_op" and self.m != 2:
+                raise ValueError("reed_sol_r6_op requires m=2")
+            self.generator = generator_matrix(self.technique, self.k,
+                                              self.m)
         self._decode_matrix_cache.clear()
+
+    def get_alignment(self) -> int:
+        base = super().get_alignment()
+        if self.full_bm is None or base % self.w == 0:
+            return base
+        return base * self.w          # chunks must split into w packets
 
     # -- geometry --------------------------------------------------------
     def get_chunk_count(self) -> int:
@@ -88,12 +139,32 @@ class ErasureCodeJaxRS(ErasureCode):
 
     # -- encode ----------------------------------------------------------
     def encode_chunks(self, data_chunks) -> np.ndarray:
-        out = self._engine.encode(self.generator, np.asarray(data_chunks))
-        return np.asarray(out)
+        return np.asarray(self.encode_chunks_batch(
+            np.asarray(data_chunks)
+        ))
 
     def encode_chunks_batch(self, data) -> np.ndarray:
         """(B, k, C) -> (B, k+m, C); the stripe-batched hot path."""
+        if self.full_bm is not None:
+            import jax.numpy as jnp
+
+            data = jnp.asarray(np.asarray(data, np.uint8))
+            squeeze = data.ndim == 2
+            if squeeze:
+                data = data[None]
+            parity = self._engine.apply_packets(
+                self.full_bm[self.k * self.w:], data, self.w
+            )
+            out = jnp.concatenate([data, parity], axis=-2)
+            return np.asarray(out[0] if squeeze else out)
         return np.asarray(self._engine.encode(self.generator, data))
+
+    def _require_gf8(self, what: str) -> None:
+        if self.full_bm is not None:
+            raise NotImplementedError(
+                f"{what}: device word/shard paths serve the GF(2^8) "
+                f"techniques; bit-schedule codes use the packet path"
+            )
 
     def encode_chunks_device(self, data):
         """Device-array in, device-array out — no host round trip.
@@ -101,20 +172,32 @@ class ErasureCodeJaxRS(ErasureCode):
         The hot path for callers that keep stripes resident in HBM (the
         in-memory analog of ceph_erasure_code_benchmark's RAM-resident
         buffers)."""
+        if self.full_bm is not None:
+            import jax.numpy as jnp
+
+            parity = self._engine.apply_packets(
+                self.full_bm[self.k * self.w:], data, self.w
+            )
+            return jnp.concatenate(
+                [jnp.asarray(data, jnp.uint8), parity], axis=-2
+            )
         return self._engine.encode(self.generator, data)
 
     def encode_shards_device(self, data):
         """Shard-stream encode: (k, N) uint8 device array -> (k+m, N)."""
+        self._require_gf8("encode_shards_device")
         return self._engine.encode_shards(self.generator, data)
 
     def encode_words_device(self, words):
         """Word-typed hot path: (k, N4) int32 shard lanes -> (m, N4) parity
         lanes, no uint8 relayout (pallas_kernels.bytes_to_words view)."""
+        self._require_gf8("encode_words_device")
         return self._engine.apply_words(self.generator[self.k:], words)
 
     def decode_words_device(self, available, want_to_read):
         """Word-typed reconstruct: available maps chunk id -> (N4,) int32
         lane arrays; returns (len(want), N4) int32."""
+        self._require_gf8("decode_words_device")
         import jax.numpy as jnp
 
         want = [int(w) for w in want_to_read]
@@ -138,7 +221,7 @@ class ErasureCodeJaxRS(ErasureCode):
         survivors = tuple(avail_ids[: self.k])
         D = self._decode_matrix(survivors, tuple(want))
         stacked = jnp.stack([available[s] for s in survivors], axis=1)
-        return self._engine.apply(D, stacked)
+        return self._apply_decode(D, stacked)
 
     # -- decode ----------------------------------------------------------
     def _decode_matrix(
@@ -147,11 +230,22 @@ class ErasureCodeJaxRS(ErasureCode):
         key = (survivors, wanted)
         hit = self._decode_matrix_cache.get(key)
         if hit is None:
-            hit = reference.decode_matrix(
-                self.generator, list(survivors), list(wanted)
-            )
+            if self.full_bm is not None:
+                hit = bitsched.decode_bitmatrix(
+                    self.full_bm, self.k, self.w,
+                    list(survivors), list(wanted),
+                )
+            else:
+                hit = reference.decode_matrix(
+                    self.generator, list(survivors), list(wanted)
+                )
             self._decode_matrix_cache.put(key, hit)
         return hit
+
+    def _apply_decode(self, D: np.ndarray, stacked):
+        if self.full_bm is not None:
+            return self._engine.apply_packets(D, stacked, self.w)
+        return self._engine.apply(D, stacked)
 
     def decode_chunks(
         self, available: Mapping[int, np.ndarray], want_to_read: Sequence[int]
@@ -169,7 +263,7 @@ class ErasureCodeJaxRS(ErasureCode):
             survivors = tuple(sorted(avail)[: self.k])
             D = self._decode_matrix(survivors, tuple(missing))
             stacked = np.stack([avail[s] for s in survivors])
-            rebuilt = np.asarray(self._engine.apply(D, stacked))
+            rebuilt = np.asarray(self._apply_decode(D, stacked))
             for i, w in enumerate(missing):
                 out[w] = rebuilt[i]
         for w in want:
@@ -193,7 +287,7 @@ class ErasureCodeJaxRS(ErasureCode):
             stacked = np.stack(
                 [avail[s] for s in survivors], axis=1
             )  # (B, k, C)
-            rebuilt = np.asarray(self._engine.apply(D, stacked))  # (B, |missing|, C)
+            rebuilt = np.asarray(self._apply_decode(D, stacked))
             for i, w in enumerate(missing):
                 out[w] = rebuilt[:, i]
         return out

@@ -274,6 +274,15 @@ class MDSDaemon:
             if int(e.get("purge_ino", 0)):
                 await self._purge_file(int(e["purge_ino"]),
                                        int(e.get("purge_size", 0)))
+            if int(e.get("purge_dir_ino", 0)):
+                # a replaced empty directory leaves its dirfrag behind
+                try:
+                    await self.meta.remove(
+                        dirfrag_oid(int(e["purge_dir_ino"]))
+                    )
+                except RadosError as err:
+                    if err.rc != ENOENT:
+                        raise
         elif op == "setattr":
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dict(e["dentry"]))
@@ -450,7 +459,7 @@ class MDSDaemon:
             # renaming a directory into its own subtree would orphan it
             # as an unreachable cycle
             raise MDSError(EINVAL, "cannot move a directory into itself")
-        purge_ino = purge_size = 0
+        purge_ino = purge_size = purge_dir_ino = 0
         try:
             dst = await self._get_dentry(dp, dn)
             if dst["type"] == "dir":
@@ -459,6 +468,7 @@ class MDSDaemon:
                 kv = await self.meta.get_omap(dirfrag_oid(int(dst["ino"])))
                 if kv:
                     raise MDSError(ENOTEMPTY, dn)
+                purge_dir_ino = int(dst["ino"])   # replaced empty dir
             elif dentry["type"] == "dir":
                 raise MDSError(ENOTDIR, dn)
             else:
@@ -470,7 +480,8 @@ class MDSDaemon:
         entry = {"op": "rename", "src_parent": sp, "src_name": sn,
                  "dst_parent": dp, "dst_name": dn, "dentry": dentry,
                  "ino": int(dentry["ino"]),
-                 "purge_ino": purge_ino, "purge_size": purge_size}
+                 "purge_ino": purge_ino, "purge_size": purge_size,
+                 "purge_dir_ino": purge_dir_ino}
         await self._journal(entry)
         await self._apply(entry)
         return {"dentry": dentry}

@@ -1009,7 +1009,23 @@ class OSDDaemon:
         if not repair:
             return rep
         fixed = []
+        auth_absent = best is groups.get(key({"absent": True}))
         try:
+            if auth_absent:
+                # the authoritative state IS deletion: a stale straggler
+                # copy must be purged, not read from
+                for osd in bad:
+                    if osd == self.osd_id:
+                        tx = self._local_rm_tx(pg, cid, name)
+                        if tx.ops:
+                            await self.store.queue_transactions(tx)
+                    else:
+                        await self.send_sub_op(osd, "purge",
+                                               cid=_enc_cid(cid),
+                                               oid=name)
+                    fixed.append(osd)
+                rep["repaired"] = fixed
+                return rep
             if self.osd_id not in best:
                 # the primary itself is the outlier: adopt a majority
                 # copy before re-pushing

@@ -166,22 +166,32 @@ class ECBackend:
         backend = self
 
         class _Guard:
+            @staticmethod
+            def _unref():
+                lock, refs = backend._object_locks[oid]
+                if refs <= 1:
+                    del backend._object_locks[oid]
+                else:
+                    backend._object_locks[oid] = (lock, refs - 1)
+
             async def __aenter__(self):
                 lock, refs = backend._object_locks.get(
                     oid, (asyncio.Lock(), 0)
                 )
                 backend._object_locks[oid] = (lock, refs + 1)
                 self._lock_obj = lock
-                await lock.acquire()
+                try:
+                    await lock.acquire()
+                except BaseException:
+                    # cancelled while waiting: drop the refcount or the
+                    # table entry leaks forever
+                    self._unref()
+                    raise
                 return lock
 
             async def __aexit__(self, *exc):
                 self._lock_obj.release()
-                lock, refs = backend._object_locks[oid]
-                if refs <= 1:
-                    del backend._object_locks[oid]
-                else:
-                    backend._object_locks[oid] = (lock, refs - 1)
+                self._unref()
                 return False
 
         return _Guard()

@@ -185,20 +185,30 @@ class PG:
         pg = self
 
         class _Guard:
-            async def __aenter__(self):
-                lock, refs = pg._obj_locks.get(name, (asyncio.Lock(), 0))
-                pg._obj_locks[name] = (lock, refs + 1)
-                self._lock = lock
-                await lock.acquire()
-                return lock
-
-            async def __aexit__(self, *exc):
-                self._lock.release()
+            @staticmethod
+            def _unref():
                 lock, refs = pg._obj_locks[name]
                 if refs <= 1:
                     del pg._obj_locks[name]
                 else:
                     pg._obj_locks[name] = (lock, refs - 1)
+
+            async def __aenter__(self):
+                lock, refs = pg._obj_locks.get(name, (asyncio.Lock(), 0))
+                pg._obj_locks[name] = (lock, refs + 1)
+                self._lock = lock
+                try:
+                    await lock.acquire()
+                except BaseException:
+                    # cancelled while waiting: drop our refcount or the
+                    # table entry leaks forever
+                    self._unref()
+                    raise
+                return lock
+
+            async def __aexit__(self, *exc):
+                self._lock.release()
+                self._unref()
                 return False
 
         return _Guard()

@@ -98,6 +98,8 @@ def test_engine_pallas_flag_matches_einsum():
     from ceph_tpu.ec import pallas_kernels
 
     applier = pallas_kernels.PallasBitplaneApply(G[k:], interpret=True)
-    pal._pallas_cache[G[k:].tobytes() + bytes(G[k:].shape)] = applier
+    pal._pallas_cache[
+        G[k:].tobytes() + repr(G[k:].shape).encode()
+    ] = applier
     b = np.asarray(pal.encode(G, data))
     assert np.array_equal(a, b)

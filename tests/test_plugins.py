@@ -44,7 +44,10 @@ def test_profile_validation(registry):
     with pytest.raises(ValueError):
         _codec(registry, {"technique": "bogus"})
     with pytest.raises(ValueError):
-        _codec(registry, {"w": "16"})
+        # w=16 is a reed_sol_van-only width (bitmatrix expansion)
+        _codec(registry, {"technique": "cauchy_good", "w": "16"})
+    with pytest.raises(ValueError):
+        _codec(registry, {"w": "24"})
     with pytest.raises(ValueError):
         _codec(registry, {"k": "zebra"})
     with pytest.raises(ValueError):

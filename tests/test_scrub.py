@@ -134,6 +134,40 @@ def test_scrub_finds_object_missing_on_primary():
     asyncio.run(run())
 
 
+def test_scrub_repair_purges_stale_straggler_when_majority_absent():
+    """When the digest majority says the object is GONE, repair deletes
+    the straggler copy instead of trying to read full state from absent
+    peers."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        pool_id = await rados.pool_create("scrubgone", pg_num=4, size=3,
+                                          min_size=2)
+        io = await rados.open_ioctx("scrubgone")
+        await io.write_full("straggler", b"zombie")
+        ps, acting, primary = _acting(cluster, pool_id, "straggler", 4)
+        cid = CollectionId(pool_id, ps)
+        obj = GHObject(pool_id, "straggler")
+        # silently delete on both replicas: the primary's copy is now a
+        # minority straggler whose authoritative state is deletion
+        for osd in acting:
+            if osd != primary:
+                await cluster.osds[osd].store.queue_transactions(
+                    Transaction().remove(cid, obj)
+                )
+        report = await rados.pg_scrub(pool_id, ps)
+        assert report["errors"] == 1
+        report = await rados.pg_scrub(pool_id, ps, repair=True)
+        assert primary in report["inconsistent"][0]["repaired"]
+        assert not cluster.osds[primary].store.exists(cid, obj)
+        report = await rados.pg_scrub(pool_id, ps)
+        assert report["errors"] == 0
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
 def test_scrub_detects_corrupt_snapshot_clone():
     async def run():
         cluster = DevCluster(n_mons=1, n_osds=3)
