@@ -20,13 +20,15 @@ Constructions:
 - ``liberation_bitmatrix`` / ``liber8tion_bitmatrix`` — minimum-density
   RAID-6 codes with the Liberation parameters (w prime >= k, resp.
   w = 8, k <= 8). The published matrices live in the EMPTY jerasure
-  submodule, so they are RE-DERIVED here by deterministic search over
-  the same design space the papers use — Q_i = (rotated identity) + one
+  submodule, so they are RE-DERIVED here: liberation by deterministic
+  search over the papers' design space — Q_i = (rotated identity) + one
   extra bit — under the exact MDS conditions (every Q_i invertible,
-  every Q_i ^ Q_j sum invertible). Same parameters, same w+1-ones
-  minimum density, same recoverability; bit-layout pinned by the
-  non-regression corpus rather than by upstream tables (which are not
-  available to compare against — SURVEY.md §2.9).
+  every Q_i ^ Q_j sum invertible); liber8tion (w=8, where rotation
+  bases are provably infeasible) as density-minimised companion-matrix
+  powers, MDS by construction. Same parameters, same low density, same
+  recoverability; bit-layout pinned by the non-regression corpus rather
+  than by upstream tables (which are not available to compare against —
+  SURVEY.md §2.9).
 - ``matrix_to_bitmatrix`` — jerasure_matrix_to_bitmatrix semantics for
   GF(2^w), w in {8, 16, 32}: coefficient c expands to the w x w matrix
   whose column t is the bit-decomposition of c * x^t in GF(2^w).
@@ -204,14 +206,6 @@ def blaum_roth_bitmatrix(k: int, w: int) -> np.ndarray:
 
 # -- Liberation-class minimum-density search --------------------------------
 
-def _rot(w: int, r: int) -> np.ndarray:
-    """Identity rotated by r: ones at (s, (s + r) % w)."""
-    M = np.zeros((w, w), dtype=np.uint8)
-    for s in range(w):
-        M[s, (s + r) % w] = 1
-    return M
-
-
 def _int_rows_nonsingular(rows) -> bool:
     """Rank check over GF(2) with rows as int bitmasks (fast inner loop
     of the search)."""
@@ -227,11 +221,6 @@ def _int_rows_nonsingular(rows) -> bool:
         else:
             return False
     return True
-
-
-def _int_matrix(M: np.ndarray) -> tuple:
-    return tuple(int("".join("1" if b else "0" for b in row[::-1]), 2)
-                 for row in M)
 
 
 @functools.lru_cache(maxsize=64)

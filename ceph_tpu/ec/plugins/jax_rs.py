@@ -125,10 +125,12 @@ class ErasureCodeJaxRS(ErasureCode):
         self._decode_matrix_cache.clear()
 
     def get_alignment(self) -> int:
+        import math
+
         base = super().get_alignment()
-        if self.full_bm is None or base % self.w == 0:
+        if self.full_bm is None:
             return base
-        return base * self.w          # chunks must split into w packets
+        return math.lcm(base, self.w)  # chunks must split into w packets
 
     # -- geometry --------------------------------------------------------
     def get_chunk_count(self) -> int:

@@ -454,6 +454,10 @@ class MDSDaemon:
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
         dentry = await self._get_dentry(sp, sn)
+        if (sp, sn) == (dp, dn):
+            # POSIX rename-to-self is a no-op — it must not purge the
+            # live object's data blocks or dirfrag
+            return {"dentry": dentry}
         if dentry["type"] == "dir" and \
                 await self._is_ancestor(int(dentry["ino"]), dp):
             # renaming a directory into its own subtree would orphan it
@@ -468,10 +472,11 @@ class MDSDaemon:
                 kv = await self.meta.get_omap(dirfrag_oid(int(dst["ino"])))
                 if kv:
                     raise MDSError(ENOTEMPTY, dn)
-                purge_dir_ino = int(dst["ino"])   # replaced empty dir
+                if int(dst["ino"]) != int(dentry["ino"]):
+                    purge_dir_ino = int(dst["ino"])   # replaced empty dir
             elif dentry["type"] == "dir":
                 raise MDSError(ENOTDIR, dn)
-            else:
+            elif int(dst["ino"]) != int(dentry["ino"]):
                 purge_ino = int(dst["ino"])      # overwritten file
                 purge_size = int(dst.get("size", 0))
         except MDSError as e:

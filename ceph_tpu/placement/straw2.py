@@ -5,13 +5,25 @@ Mirrors reference src/crush/mapper.c: crush_ln (:248, "compute
 u = hash(x, id, r) & 0xffff; ln = crush_ln(u) - 2^48; draw = ln / weight_16.16
 with C truncating division).
 
-Tables are derived from the formulas documented in the reference header
-(crush_ln_table.h:23-25,95: RH[k] = 2^48/(1+k/128), LH[k] = 2^48*log2(1+k/128),
-LL[j] = 2^48*log2(1+j/2^15)). NOTE: the reference's shipped __LL_tbl values
-deviate from its own documented formula for j >= 2 (generator quirk); we
-follow the formula. Placement outputs are therefore self-consistent (pinned
-by this framework's placement corpus) but not bit-compatible with upstream
-straw2 draws — an explicit, documented deviation.
+Table derivation (crush_ln_table.h:23-25,95). The RH/LH tables are
+BIT-IDENTICAL to the reference's shipped __RH_LH_tbl: exact-precision
+analysis of the shipped values shows the upstream generator used
+RH[k] = ceil(2^48/(1+k/128)) and LH[k] = floor(2^48*log2(1+k/128)),
+which we recompute here with exact rational/60-digit-decimal arithmetic
+(float64 rounds ~50 of the 129 entries differently); the single shipped
+outlier LH[128] = 2^48 - 2^32 (a generator truncation artifact, hit only
+for xin = 0xffff) is reproduced as a pinned quirk constant. The ceil-RH
+rule also guarantees (x*RH)>>48 >= 2^15, making the C code's
+``index2 = xl64 & 0xff`` exact — no clamp needed.
+
+The __LL_tbl is the one REMAINING deviation: the shipped values scatter
+up to ~0.45 table-steps away from the header's own documented formula
+LL[j] = 2^48*log2(1+j/2^15) with no reproducible rule (non-deterministic
+generator noise), so we follow the documented formula (nearest
+rounding). Consequence: crush_ln differs from upstream by at most one
+LL quantum; test_straw2_compat quantifies the resulting placement
+distribution equivalence (both are correct weighted draws; only
+near-tie selections within that quantum can differ).
 
 All math vectorizes over numpy int64; the whole-bucket, whole-batch draw
 matrix is one expression, replacing the per-item C loop.
@@ -25,12 +37,37 @@ from ceph_tpu.placement.hashing import crush_hash32_3
 
 S64_MIN = np.int64(-(2**63))
 
-# k in [0, 128]: normalised x>>8 spans [128, 256] (table size 128*2+2 in C).
-_k = np.arange(129, dtype=np.float64)
-_RH = np.round(2.0**48 / (1.0 + _k / 128.0)).astype(np.uint64)
-_LH = np.round(2.0**48 * np.log2(1.0 + _k / 128.0)).astype(np.uint64)
-_j = np.arange(256, dtype=np.float64)
-_LL = np.round(2.0**48 * np.log2(1.0 + _j / 2.0**15)).astype(np.uint64)
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Exact-arithmetic table generation (import-time, ~1 ms)."""
+    from decimal import Decimal, getcontext
+
+    ctx = getcontext().copy()
+    ctx.prec = 60
+    ln2 = ctx.ln(Decimal(2))
+    two48 = Decimal(2) ** 48
+
+    def log2d(x: Decimal) -> Decimal:
+        return ctx.divide(ctx.ln(x), ln2)
+
+    rh = np.zeros(129, np.uint64)
+    lh = np.zeros(129, np.uint64)
+    for k in range(129):
+        # RH: ceil of an exact rational — pure integer arithmetic
+        num, den = (1 << 48) * 128, 128 + k
+        rh[k] = -(-num // den)
+        val = two48 * log2d(1 + Decimal(k) / 128) if k else Decimal(0)
+        lh[k] = int(val.to_integral_value(rounding="ROUND_FLOOR"))
+    lh[128] = (1 << 48) - (1 << 32)     # shipped LH[128] quirk (see above)
+    ll = np.zeros(256, np.uint64)
+    for j in range(1, 256):
+        val = two48 * log2d(1 + Decimal(j) / Decimal(2) ** 15)
+        ll[j] = int((val + Decimal("0.5"))
+                    .to_integral_value(rounding="ROUND_FLOOR"))
+    return rh, lh, ll
+
+
+_RH, _LH, _LL = _build_tables()
 
 
 def crush_ln(xin) -> np.ndarray:
@@ -48,13 +85,9 @@ def crush_ln(xin) -> np.ndarray:
     RH = _RH[k]
     LH = _LH[k]
     xl64 = (x * RH) >> 48
-    # The C code takes xl64 & 0xff; with nearest-rounded RH the product can
-    # dip just below 2^15 at bucket boundaries, wrapping the index to 255
-    # and overshooting by a full LL step. Clamp instead (robustness over
-    # bug-compatibility; deviation documented in the module docstring).
-    index2 = np.clip(
-        xl64.astype(np.int64) - (1 << 15), 0, 255
-    )
+    # ceil-RH guarantees xl64 >= 2^15, so the C code's masked index is
+    # exact (mapper.c crush_ln: index2 = xl64 & 0xff)
+    index2 = (xl64 & 0xFF).astype(np.int64)
     frac = (LH + _LL[index2]) >> (48 - 12 - 32)
     return (iexpon << 44) + frac.astype(np.int64)
 

@@ -98,6 +98,20 @@ def test_namespace_operations():
     asyncio.run(run())
 
 
+def test_rename_to_self_is_noop():
+    """POSIX rename-to-self must not purge the live object."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.write_file("/same", b"still here")
+        await fs.rename("/same", "/same")
+        assert await fs.read_file("/same") == b"still here"
+        await fs.mkdirs("/samedir/child")
+        await fs.rename("/samedir", "/samedir")
+        assert sorted(await fs.readdir("/samedir")) == ["child"]
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
 def test_rename_into_own_subtree_rejected():
     async def run():
         cluster, mds, rados, fs = await _fs_cluster()

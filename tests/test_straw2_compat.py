@@ -1,0 +1,121 @@
+"""straw2 upstream-compatibility validation (VERDICT #10b).
+
+Three layers of cross-validation against reference src/crush/mapper.c +
+crush_ln_table.h:
+
+1. TABLE RULES — the RH/LH derivation (exact ceil/floor arithmetic +
+   the LH[128] quirk) is re-verified against an independent
+   high-precision computation, pinning the bit-identity claim.
+2. FUNCTION ACCURACY — crush_ln is compared against the REAL
+   2^44*log2(x+1) over the entire 16-bit input domain; the error bound
+   also bounds the divergence from upstream's crush_ln (whose only
+   difference is LL-table noise of the same magnitude).
+3. DISTRIBUTION EQUIVALENCE — straw2 draws are statistically
+   indistinguishable from the ideal weighted-exponential order
+   statistics: selection frequencies proportional to weights within
+   tight chi-square bounds, and the fraction of placements that COULD
+   differ from upstream (top-two draw gap within the LL-noise bound) is
+   quantified and small.
+"""
+
+import numpy as np
+
+from ceph_tpu.placement import straw2
+
+
+# the measured supremum of the shipped __LL_tbl's deviation from its
+# documented formula (crush_ln_table.h:95), in 2^48-scale units: the
+# scatter stays below 0.45 of one LL table step (~1.24e10)
+LL_NOISE_SUP_48 = 5.6e9
+
+
+def test_table_rules_match_exact_arithmetic():
+    from decimal import Decimal, getcontext
+
+    getcontext().prec = 70
+    ln2 = Decimal(2).ln()
+    for k in range(129):
+        num, den = (1 << 48) * 128, 128 + k
+        assert int(straw2._RH[k]) == -(-num // den)
+        if k == 0:
+            assert int(straw2._LH[k]) == 0
+        elif k == 128:
+            # upstream generator artifact, reproduced for bit-identity
+            assert int(straw2._LH[k]) == (1 << 48) - (1 << 32)
+        else:
+            exact = Decimal(2) ** 48 * ((1 + Decimal(k) / 128).ln() / ln2)
+            assert int(straw2._LH[k]) == int(
+                exact.to_integral_value(rounding="ROUND_FLOOR")
+            )
+
+
+def test_crush_ln_tracks_real_log_over_full_domain():
+    xs = np.arange(1, 0x10000, dtype=np.int64)
+    got = straw2.crush_ln(xs).astype(np.float64)
+    real = (2.0 ** 44) * np.log2(xs.astype(np.float64) + 1.0)
+    err = np.abs(got - real)
+    # one LL quantum at 2^44 scale: LL-step(2^48)/2^4 ~ 7.7e8; table
+    # interpolation keeps crush_ln well inside two quanta
+    assert float(err.max()) < 1.6e9, float(err.max())
+    # monotone non-decreasing (ordering correctness for draws)
+    assert np.all(np.diff(straw2.crush_ln(xs)) >= 0)
+    # exact anchors: powers of two give exact logs
+    for x in (0, 1, 3, 7, 0x7FFF):
+        assert int(straw2.crush_ln(np.int64(x))) == \
+            round((2 ** 44) * np.log2(x + 1))
+    # xin=0xffff hits the reproduced upstream LH[128] quirk: the result
+    # is 2^28 below the exact log — BIT-compatible with the shipped
+    # table rather than with the real function
+    assert int(straw2.crush_ln(np.int64(0xFFFF))) == \
+        (15 << 44) + (((1 << 48) - (1 << 32)) >> 4)
+
+
+def test_distribution_proportional_to_weights():
+    """The straw2 contract (mapper.c bucket_straw2_choose comment):
+    P(item) = weight_item / sum(weights), independent of the others."""
+    rng_ids = np.array([1, 2, 3, 4])
+    weights = np.array([1, 2, 3, 4]) << 16
+    n = 200_000
+    picks = straw2.straw2_choose(np.arange(n), rng_ids, weights, r=0)
+    total = weights.sum()
+    for item, w in zip(rng_ids, weights):
+        expect = n * w / total
+        got = int((picks == item).sum())
+        sigma = (expect * (1 - w / total)) ** 0.5
+        assert abs(got - expect) < 5 * sigma, (item, got, expect)
+
+
+def test_distribution_stable_under_weight_scaling():
+    ids = np.array([10, 20, 30])
+    w1 = np.array([1, 1, 2]) << 16
+    w2 = np.array([2, 2, 4]) << 16       # same ratios, scaled
+    xs = np.arange(50_000)
+    p1 = straw2.straw2_choose(xs, ids, w1, r=0)
+    p2 = straw2.straw2_choose(xs, ids, w2, r=0)
+    # scaling all weights equally preserves most selections (draws are
+    # ln/weight; equal scaling divides all draws alike up to integer
+    # truncation)
+    agree = float((p1 == p2).mean())
+    assert agree > 0.99, agree
+
+
+def test_upstream_divergence_bound_is_small():
+    """Quantify how many placements COULD differ from upstream: a
+    selection can flip only when the top-two draws are closer than the
+    worst-case perturbation from the LL-table noise. Measured over a
+    large sample, that near-tie fraction is small — the two
+    implementations are distribution-equivalent far beyond any
+    practical rebalancing threshold."""
+    ids = np.arange(1, 9)
+    weights = (np.array([1, 1, 2, 2, 3, 3, 4, 4]) << 16).astype(np.int64)
+    xs = np.arange(100_000)
+    draws = straw2.straw2_draws(xs, ids, weights, r=0)
+    part = np.partition(draws, -2, axis=1)
+    gap = part[:, -1] - part[:, -2]
+    # draw = (crush_ln - 2^48) / w16.16; an LL perturbation of at most
+    # LL_NOISE_SUP_48 >> 4 (44-bit scale) moves a draw by at most that
+    # over the SMALLEST fixed-point weight in play
+    w_min = float(weights.min())
+    bound = 2 * (LL_NOISE_SUP_48 / 16) / w_min
+    flippable = float((gap.astype(np.float64) < bound).mean())
+    assert flippable < 0.02, flippable
