@@ -10,7 +10,11 @@ that re-arm whenever the target moves (linger_submit / _linger_ops).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Awaitable, Callable
+
+import hashlib
+import hmac
 
 from ceph_tpu.common.log import Dout
 from ceph_tpu.msg.message import Message
@@ -54,10 +58,29 @@ class Objecter:
         # a resubmitted op that already executed with only the reply lost
         self._reqid_name = f"{msgr.name}.{msgr.nonce:08x}"
         self._reqid_seq = 0
+        # cephx: OSD sessions we have presented our service ticket on
+        self._osd_authed: set[int] = set()
+        self._osd_auth_futs: dict[int, asyncio.Future] = {}
+        self._osd_auth_locks: dict[int, asyncio.Lock] = {}
 
     # -- dispatch hooks (driven by the owning client) ---------------------
     async def handle_message(self, conn: Connection, msg: Message) -> bool:
         """Returns True when the message was ours."""
+        if msg.type == "osd_auth_challenge":
+            proof = hmac.new(
+                self.monc.osd_session_key.encode(),
+                str(msg.data.get("nonce", "")).encode(), hashlib.sha256,
+            ).hexdigest()
+            try:
+                conn.send_message(Message("osd_auth", {"proof": proof}))
+            except ConnectionError:
+                pass
+            return True
+        if msg.type == "osd_auth_reply":
+            fut = self._osd_auth_futs.pop(id(conn), None)
+            if fut is not None and not fut.done():
+                fut.set_result(bool(msg.data.get("ok")))
+            return True
         if msg.type == "osd_op_reply":
             fut_osd = self._inflight.pop(int(msg.data.get("tid", 0)), None)
             if fut_osd is not None and not fut_osd[0].done():
@@ -73,6 +96,10 @@ class Objecter:
     def handle_reset(self, conn: Connection) -> None:
         """An OSD session died: fail its inflight ops (the callers'
         retry loops resubmit) and re-arm lingers bound to it."""
+        self._osd_authed.discard(id(conn))
+        fut = self._osd_auth_futs.pop(id(conn), None)
+        if fut is not None and not fut.done():
+            fut.set_exception(ObjecterError("osd session reset"))
         for tid, (fut, osd) in list(self._inflight.items()):
             if f"osd.{osd}" == conn.peer_name and not fut.done():
                 del self._inflight[tid]
@@ -130,6 +157,16 @@ class Objecter:
             if primary < 0:
                 await self._await_newer_map(m.epoch, deadline)
                 continue
+            try:
+                await self._ensure_osd_auth(primary, m.osds[primary].addr)
+            except (ConnectionError, ObjecterError,
+                    asyncio.TimeoutError):
+                if loop.time() > deadline:
+                    raise ObjecterError(
+                        f"osd.{primary} auth failed"
+                    ) from None
+                await asyncio.sleep(0.1)
+                continue
             self._tid += 1
             tid = self._tid
             fut = loop.create_future()
@@ -164,6 +201,47 @@ class Objecter:
                 )
                 continue
             return reply
+
+    async def _ensure_osd_auth(self, osd: int, addr: str) -> None:
+        """cephx: present our mon-issued service ticket on this OSD
+        session and prove the session key before the first op (the
+        CephxAuthorizer handshake). No-op when auth is off."""
+        conf = getattr(self.monc, "conf", None)
+        if conf is None or conf["auth_cluster_required"] != "cephx":
+            return
+        conn = await self.msgr.connect(addr, f"osd.{osd}")
+        if id(conn) in self._osd_authed:
+            return
+        lock = self._osd_auth_locks.setdefault(id(conn), asyncio.Lock())
+        async with lock:
+            if id(conn) in self._osd_authed:
+                return
+            for attempt in range(2):
+                ticket = self.monc.osd_ticket
+                if (ticket is None
+                        or float(ticket.get("expires", 0))
+                        < time.time() + 1.0):
+                    # expired or missing: renew over the mon session
+                    # BEFORE presenting (tickets outlive neither the
+                    # secret rotation window nor their own TTL)
+                    await self.monc.renew_ticket()
+                    ticket = self.monc.osd_ticket
+                if ticket is None:
+                    raise ObjecterError("no osd service ticket")
+                fut = asyncio.get_running_loop().create_future()
+                self._osd_auth_futs[id(conn)] = fut
+                conn.send_message(Message("osd_auth",
+                                          {"ticket": ticket}))
+                ok = await asyncio.wait_for(fut, 5.0)
+                if ok:
+                    self._osd_authed.add(id(conn))
+                    break
+                if attempt == 0:
+                    # possibly a just-rotated secret: one renewed retry
+                    await self.monc.renew_ticket()
+                    continue
+                raise ObjecterError(f"osd.{osd} rejected our ticket")
+        self._osd_auth_locks.pop(id(conn), None)
 
     async def _await_newer_map(self, epoch: int, deadline: float,
                                strict: bool = True) -> None:

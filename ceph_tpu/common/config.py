@@ -110,6 +110,15 @@ def global_options() -> list[Option]:
                "paxos accept-phase timeout (s)", min=0.1),
         Option("auth_shared_key", str, "",
                "cluster shared auth key ('' = auth disabled)"),
+        Option("auth_cluster_required", str, "none",
+               "authentication mode: cephx (per-entity keys + tickets) "
+               "or none", enum_values=("none", "cephx")),
+        Option("auth_admin_key", str, "",
+               "bootstrap key for client.admin ('' = generate)"),
+        Option("auth_key", str, "",
+               "this entity's own secret key (cephx mode)"),
+        Option("auth_service_secret_ttl", float, 3600.0,
+               "rotating service-secret / ticket lifetime (s)", min=0.5),
         Option("ms_inject_socket_failures", int, 0,
                "1-in-N artificial connection failures (0=off)", Level.DEV),
         Option("ms_inject_delay_max", float, 0.0,

@@ -37,6 +37,10 @@ class MonClient:
         self.cur_mon: str | None = None
         self.conn: Connection | None = None
         self._authed = asyncio.Event()
+        # cephx grants (the CephxServiceTicket the monitor issues)
+        self.caps: dict[str, str] = {}
+        self.osd_ticket: dict | None = None
+        self.osd_session_key: str = ""
         self._tid = 0
         self._command_futures: dict[int, asyncio.Future] = {}
         self.sub_have: dict[str, int] = {}
@@ -85,6 +89,17 @@ class MonClient:
         if self.sub_have:
             self._send_subscribe()
 
+    async def renew_ticket(self) -> None:
+        """Re-run the auth exchange on the live mon session to refresh
+        the OSD service ticket (ticket renewal before expiry — the
+        CephxClientHandler build_request path)."""
+        conn = self.conn
+        if conn is None:
+            raise ConnectionError("no mon session")
+        self._authed.clear()
+        conn.send_message(Message("auth", {"entity": self.entity}))
+        await asyncio.wait_for(self._authed.wait(), 5.0)
+
     # -- dispatcher -------------------------------------------------------
     def ms_handle_connect(self, conn: Connection) -> None:
         pass
@@ -110,13 +125,24 @@ class MonClient:
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         t = msg.type
         if t == "auth_challenge":
-            key = self.conf["auth_shared_key"]
+            # cephx: prove possession of OUR entity key; legacy: the
+            # cluster shared key
+            key = (self.conf["auth_key"]
+                   if self.conf["auth_cluster_required"] == "cephx"
+                   else self.conf["auth_shared_key"])
             conn.send_message(Message("auth", {
                 "entity": self.entity,
                 "proof": auth_proof(key, self.entity, msg.data["nonce"]),
             }))
         elif t == "auth_reply":
             if msg.data.get("ok"):
+                self.caps = {str(s): str(c) for s, c in
+                             (msg.data.get("caps") or {}).items()}
+                if msg.data.get("osd_ticket") is not None:
+                    self.osd_ticket = dict(msg.data["osd_ticket"])
+                    self.osd_session_key = str(
+                        msg.data.get("osd_session_key", "")
+                    )
                 self._authed.set()
             else:
                 conn.mark_down()

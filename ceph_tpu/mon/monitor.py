@@ -19,6 +19,7 @@ import secrets
 
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.log import Dout
+from ceph_tpu.mon.auth_monitor import AuthMonitor, cap_allows
 from ceph_tpu.mon.config_monitor import ConfigMonitor
 from ceph_tpu.mon.election import Elector
 from ceph_tpu.mon.osd_monitor import OSDMonitor
@@ -58,6 +59,7 @@ class MonSession:
         self.entity = conn.peer_name
         self.authenticated = False
         self.challenge: str | None = None
+        self.caps: dict[str, str] = {}       # cephx: the entity's caps
         self.subs: dict[str, int] = {}       # what -> epoch client has
 
 
@@ -81,8 +83,10 @@ class Monitor:
         self.paxos.on_commit = self._on_paxos_commit
         self.osd_monitor = OSDMonitor(self)
         self.config_monitor = ConfigMonitor(self)
+        self.auth_monitor = AuthMonitor(self)
         self.services = {
             "osd": self.osd_monitor, "config": self.config_monitor,
+            "auth": self.auth_monitor,
         }
         self.sessions: dict[int, MonSession] = {}
         self._routes: dict[int, tuple[Connection, dict]] = {}
@@ -136,9 +140,17 @@ class Monitor:
         self.elector.start()
 
     # -- messaging helpers ------------------------------------------------
+    def _internal_key(self) -> str:
+        """The mon-cluster-internal signing key: the legacy shared key,
+        or (cephx) the admin bootstrap key every monitor holds (the mon.
+        keyring role) — signing must NOT turn off just because the
+        legacy key is empty."""
+        return (self.conf["auth_shared_key"]
+                or (self.conf["auth_admin_key"] if self.cephx else ""))
+
     def send_mon(self, peer: str, msg: Message) -> None:
         msg.data.setdefault("from", self.name)
-        key = self.conf["auth_shared_key"]
+        key = self._internal_key()
         if key:
             msg.data["sig"] = sign_mon_message(key, msg.type, msg.data)
         addr = self.monmap.get(peer)
@@ -236,6 +248,10 @@ class Monitor:
                 try:
                     async with self._mutate_lock:
                         await self.osd_monitor.tick()
+                        if self.cephx:
+                            tx = StoreTransaction()
+                            if self.auth_monitor.maybe_rotate(tx):
+                                await self.paxos.propose(tx)
                 except ConnectionError:
                     pass
             elif self.elector.in_quorum():
@@ -261,7 +277,7 @@ class Monitor:
         sender = msg.data.get("from", "")
         if sender not in self.monmap or conn.peer_name != f"mon.{sender}":
             return False
-        key = self.conf["auth_shared_key"]
+        key = self._internal_key()
         if key:
             want = sign_mon_message(key, msg.type, msg.data)
             if not hmac.compare_digest(want,
@@ -297,7 +313,8 @@ class Monitor:
         if t == "auth":
             self._handle_auth(session, msg)
             return
-        if not session.authenticated and self.conf["auth_shared_key"]:
+        if not session.authenticated and (self.conf["auth_shared_key"]
+                                          or self.cephx):
             session.conn.send_message(Message(
                 "auth_bad", {"reason": "unauthenticated"}
             ))
@@ -307,7 +324,8 @@ class Monitor:
             self._handle_subscribe(session, msg)
         elif t == "mon_command":
             # commands block on commits: keep the reader loop free
-            loop.create_task(self._handle_command(session.conn, msg.data))
+            loop.create_task(self._handle_command(session.conn, msg.data,
+                                                  session))
         elif t == "osd_boot":
             loop.create_task(self._handle_osd_boot(session.conn, msg.data))
         elif t == "osd_failure":
@@ -341,9 +359,16 @@ class Monitor:
             await handler(msg)
 
     # -- auth -------------------------------------------------------------
+    @property
+    def cephx(self) -> bool:
+        return self.conf["auth_cluster_required"] == "cephx"
+
     def _handle_auth(self, session: MonSession, msg: Message) -> None:
+        entity = str(msg.data.get("entity", session.entity))
+        if self.cephx:
+            self._handle_auth_cephx(session, entity, msg)
+            return
         key = self.conf["auth_shared_key"]
-        entity = msg.data.get("entity", session.entity)
         if not key:
             session.authenticated = True
             session.conn.send_message(Message("auth_reply", {"ok": True}))
@@ -364,6 +389,40 @@ class Monitor:
             session.conn.send_message(Message(
                 "auth_reply", {"ok": False, "reason": "bad proof"}
             ))
+
+    def _handle_auth_cephx(self, session: MonSession, entity: str,
+                           msg: Message) -> None:
+        """Per-entity challenge/response against the AuthMonitor key
+        database; success issues an OSD service ticket + session key
+        (the CephxServiceTicket grant)."""
+        key = self.auth_monitor.get_key(entity)
+        proof = msg.data.get("proof")
+        if proof is None:
+            session.challenge = secrets.token_hex(16)
+            session.conn.send_message(Message(
+                "auth_challenge", {"nonce": session.challenge}
+            ))
+            return
+        want = (auth_proof(key, entity, session.challenge)
+                if key and session.challenge else None)
+        if want is None or not hmac.compare_digest(want, str(proof)):
+            session.conn.send_message(Message(
+                "auth_reply", {"ok": False, "reason": "bad credentials"}
+            ))
+            return
+        session.authenticated = True
+        # bind the PROVEN identity: gates must never trust the client-
+        # chosen messenger handshake name
+        session.entity = entity
+        session.caps = {
+            s: str(c)
+            for s, c in self.auth_monitor.get_caps(entity).items()
+        }
+        reply = {"ok": True, "caps": dict(session.caps)}
+        issued = self.auth_monitor.issue_osd_ticket(entity)
+        if issued is not None:
+            reply["osd_ticket"], reply["osd_session_key"] = issued
+        session.conn.send_message(Message("auth_reply", reply))
 
     # -- subscriptions ----------------------------------------------------
     def _handle_subscribe(self, session: MonSession, msg: Message) -> None:
@@ -477,10 +536,13 @@ class Monitor:
                 return r
         return self._mon_command(cmd)
 
-    async def _run_command(self, cmd: dict) -> CommandResult:
-        r = self._preprocess_local(cmd)
-        if r is not None:
-            return r
+    async def _run_command(self, cmd: dict,
+                           skip_preprocess: bool = False
+                           ) -> CommandResult:
+        if not skip_preprocess:
+            r = self._preprocess_local(cmd)
+            if r is not None:
+                return r
         svc = self._route_service(cmd)
         if svc is None:
             return CommandResult(
@@ -501,21 +563,65 @@ class Monitor:
                                              "lost quorum mid-commit")
         return result
 
-    async def _handle_command(self, conn: Connection, data: dict) -> None:
+    def _caps_deny(self, session: MonSession | None, cmd: dict,
+                   mutating: bool) -> CommandResult | None:
+        """cephx MonCap enforcement: reads need any mon cap; anything
+        that stages a mutation needs 'allow *' (or 'allow rw')."""
+        if not self.cephx or session is None:
+            return None
+        prefix = str(cmd.get("prefix", ""))
+        mon_cap = session.caps.get("mon", "")
+        if prefix == "auth service-secrets":
+            # service daemons only: the rotating secrets let the holder
+            # verify and mint session keys
+            etype = session.entity.split(".", 1)[0]
+            if etype in ("osd", "mds", "mgr") or                     cap_allows(mon_cap, write=True):
+                return None
+            return CommandResult(EPERM_RC, "not a service daemon")
+        if prefix.startswith("auth"):
+            # key-database access exposes secrets: admin-only
+            # (the reference gates auth commands behind dedicated caps)
+            if cap_allows(mon_cap, write=True):
+                return None
+            return CommandResult(
+                EPERM_RC, f"auth commands need 'allow *' mon caps"
+            )
+        if not cap_allows(mon_cap, write=mutating):
+            return CommandResult(
+                EPERM_RC,
+                f"entity {session.entity!r} lacks mon caps for "
+                f"{prefix!r}",
+            )
+        return None
+
+    async def _handle_command(self, conn: Connection, data: dict,
+                              session: MonSession | None = None) -> None:
         cmd = data.get("cmd", {})
         tid = data.get("tid", 0)
-        if self.is_leader:
-            result = await self._run_command(cmd)
+        # preprocess ONCE: the result both classifies mutating-ness for
+        # the caps check and serves the read fast path
+        pre = self._preprocess_local(cmd)
+        denied = self._caps_deny(session, cmd, mutating=pre is None)
+        if denied is not None:
+            self._reply(conn, Message("mon_command_reply",
+                                      {"tid": tid, **denied.to_wire()}))
+            return
+        if cmd.get("prefix") == "auth service-secrets":
+            result = CommandResult(
+                data={str(e): s for e, s in
+                      self.auth_monitor.secrets_snapshot().items()}
+            )
+        elif pre is not None:
+            result = pre
+        elif self.is_leader:
+            result = await self._run_command(cmd, skip_preprocess=True)
         elif self.elector.in_quorum():
-            # reads are served by any quorum member; mutations forward
-            result = self._preprocess_local(cmd)
-            if result is None:
-                if (self.elector.leader is not None
-                        and not self.elector.electing):
-                    self._forward(conn, "mon_command", data,
-                                  "mon_command_reply")
-                    return
-                result = CommandResult(EAGAIN_RC, "no quorum")
+            if (self.elector.leader is not None
+                    and not self.elector.electing):
+                self._forward(conn, "mon_command", data,
+                              "mon_command_reply")
+                return
+            result = CommandResult(EAGAIN_RC, "no quorum")
         else:
             result = CommandResult(EAGAIN_RC, "not in quorum")
         self._reply(conn, Message("mon_command_reply",

@@ -9,3 +9,4 @@ EINVAL_RC = -22
 ENOTSUP_RC = -95
 ESTALE_RC = -116              # sub-op from an older PG interval, dropped
 MISDIRECTED_RC = -1000        # resend after map refresh (reference drops)
+EPERM_RC = -1               # operation not permitted (caps)

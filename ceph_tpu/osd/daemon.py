@@ -21,8 +21,13 @@ import time
 from collections import deque
 from typing import Mapping
 
+import hashlib
+import hmac as hmac_mod
+import secrets as secrets_mod
+
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.crc32c import crc32c
+from ceph_tpu.mon.auth_monitor import canonical, cap_allows, verify_ticket
 from ceph_tpu.common.log import Dout
 from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
@@ -39,6 +44,7 @@ from ceph_tpu.osd.ec_backend import (
 )
 from ceph_tpu.osd.codes import (
     EAGAIN_RC,
+    EPERM_RC,
     EINVAL_RC,
     EIO_RC,
     ENOENT_RC,
@@ -75,6 +81,11 @@ from ceph_tpu.store.txcodec import (
 log = Dout("osd")
 
 XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
+
+# read-class client ops (no mutation): ONE definition for the dedup
+# cache policy, the replay path, perf counters, and caps enforcement
+READ_OPS = frozenset({"read", "stat", "getxattr", "getxattrs",
+                      "omap_get"})
 
 # message types the embedded MonClient owns
 _MON_TYPES = {
@@ -195,6 +206,10 @@ class OSDDaemon:
         # reqid -> future of the attempt currently executing: resends
         # attach instead of double-executing
         self._inflight_ops: dict[str, asyncio.Future] = {}
+        # cephx: rotating service secrets (fetched from the mon) and
+        # per-connection client-session auth state
+        self._service_secrets: dict[int, str] = {}
+        self._conn_auth: dict[int, dict] = {}
         # watch/notify state:
         #   (pool, ps, oid) -> {(client entity, cookie): conn}
         self._watchers: dict[
@@ -214,6 +229,8 @@ class OSDDaemon:
         await self.monc.send_boot(self.osd_id, str(self.msgr.my_addr),
                                   host=self.host, timeout=timeout)
         self._booted = True
+        if self.cephx:
+            await self._refresh_service_secrets()
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         if self.conf["osd_scrub_interval"] > 0:
             self._tasks.append(asyncio.create_task(self._scrub_loop()))
@@ -233,12 +250,114 @@ class OSDDaemon:
         await self.msgr.shutdown()
         await self.store.umount()
 
+    # -- cephx -------------------------------------------------------------
+    @property
+    def cephx(self) -> bool:
+        return self.conf["auth_cluster_required"] == "cephx"
+
+    async def _refresh_service_secrets(self) -> None:
+        """Fetch the rotating service secrets over our authenticated mon
+        session (the CephxKeyServer rotating-secrets pull)."""
+        try:
+            r = await self.monc.command("auth service-secrets")
+            if r.get("rc") == 0 and r.get("data"):
+                self._service_secrets = {
+                    int(e): str(s) for e, s in r["data"].items()
+                }
+        except (ConnectionError, asyncio.TimeoutError, KeyError,
+                ValueError) as e:
+            log.derr("%s: service-secret fetch failed: %s",
+                     self.entity, e)
+
+    def _sub_op_sig(self, payload: dict) -> tuple[int, str] | None:
+        """Peer sub-ops are MACed with the current service secret: an
+        endpoint that merely claims an osd.* name in the messenger
+        handshake cannot inject replication traffic."""
+        if not self._service_secrets:
+            return None
+        epoch = max(self._service_secrets)
+        body = canonical({k: v for k, v in payload.items()
+                          if k not in ("sig", "sepoch")})
+        return epoch, hmac_mod.new(
+            self._service_secrets[epoch].encode(), body, hashlib.sha256
+        ).hexdigest()
+
+    async def _sub_op_sig_ok(self, d: dict) -> bool:
+        epoch = int(d.get("sepoch", 0))
+        if epoch not in self._service_secrets:
+            await self._refresh_service_secrets()
+        secret = self._service_secrets.get(epoch)
+        if secret is None:
+            return False
+        body = canonical({k: v for k, v in d.items()
+                          if k not in ("sig", "sepoch")})
+        want = hmac_mod.new(secret.encode(), body,
+                            hashlib.sha256).hexdigest()
+        return hmac_mod.compare_digest(want, str(d.get("sig", "")))
+
+    async def _handle_osd_auth(self, conn: Connection, d: dict) -> None:
+        """Client session auth: verify the mon-issued ticket, then
+        challenge for possession of its session key (the CephxAuthorizer
+        exchange, reference CephxProtocol.h:165-190)."""
+        state = self._conn_auth.setdefault(id(conn), {})
+        if "ticket" in d:
+            ticket = dict(d["ticket"])
+            got = verify_ticket(self._service_secrets, ticket)
+            if got is None and int(ticket.get("epoch", -1)) \
+                    not in self._service_secrets:
+                # a fresher epoch than we hold: pull before rejecting
+                # (the client may have authenticated right after a
+                # rotation)
+                await self._refresh_service_secrets()
+                got = verify_ticket(self._service_secrets, ticket)
+            if got is None:
+                conn.send_message(Message(
+                    "osd_auth_reply",
+                    {"ok": False, "reason": "bad ticket"},
+                ))
+                return
+            entity, caps, session_key = got
+            state.update(entity=entity, caps=caps,
+                         session_key=session_key,
+                         challenge=secrets_mod.token_hex(16),
+                         authed=False)
+            conn.send_message(Message(
+                "osd_auth_challenge", {"nonce": state["challenge"]}
+            ))
+            return
+        proof = str(d.get("proof", ""))
+        want = (hmac_mod.new(
+            state.get("session_key", "").encode(),
+            state.get("challenge", "").encode(), hashlib.sha256,
+        ).hexdigest() if state.get("challenge") else None)
+        if want is not None and hmac_mod.compare_digest(want, proof):
+            state["authed"] = True
+            conn.send_message(Message("osd_auth_reply", {"ok": True}))
+        else:
+            conn.send_message(Message(
+                "osd_auth_reply", {"ok": False, "reason": "bad proof"}
+            ))
+
+    def _client_caps_deny(self, conn: Connection, pg: PG,
+                          ops: list[dict]) -> bool:
+        """OSDCap enforcement on an authenticated client session."""
+        if not self.cephx:
+            return False
+        state = self._conn_auth.get(id(conn))
+        if state is None or not state.get("authed"):
+            return True
+        write = any(op.get("op") not in READ_OPS | {"pgls"}
+                    for op in ops)
+        return not cap_allows(state.get("caps", ""), write=write,
+                              pool=pg.pool.name)
+
     # -- dispatch ----------------------------------------------------------
     def ms_handle_connect(self, conn: Connection) -> None:
         pass
 
     def ms_handle_reset(self, conn: Connection) -> None:
         self.monc.ms_handle_reset(conn)
+        self._conn_auth.pop(id(conn), None)
         # a dead client takes its watches with it (watch timeout role)
         for key, watchers in list(self._watchers.items()):
             for wid, wconn in list(watchers.items()):
@@ -268,6 +387,10 @@ class OSDDaemon:
             asyncio.get_running_loop().create_task(
                 self._handle_sub_op(conn, msg.data)
             )
+        elif t == "osd_auth":
+            asyncio.get_running_loop().create_task(
+                self._handle_osd_auth(conn, msg.data)
+            )
         elif t == "pg_scrub":
             asyncio.get_running_loop().create_task(
                 self._handle_pg_scrub(conn, msg.data)
@@ -292,9 +415,9 @@ class OSDDaemon:
             except ConnectionError:
                 pass
         elif t == "sub_reply":
-            fut = self._sub_futures.pop(int(msg.data["tid"]), None)
-            if fut is not None and not fut.done():
-                fut.set_result(msg.data)
+            asyncio.get_running_loop().create_task(
+                self._handle_sub_reply(msg.data)
+            )
         elif t == "pg_query":
             self._handle_pg_query(conn, msg.data)
         elif t == "pg_notify":
@@ -1486,6 +1609,9 @@ class OSDDaemon:
                 pg.waiting_for_active.append((conn, d))
                 return
             ops = list(d["ops"])
+            if self._client_caps_deny(conn, pg, ops):
+                self._reply(conn, tid, EPERM_RC)
+                return
             top = self.op_tracker.create(
                 "osd_op(%s %s %s)" % (
                     d.get("reqid", "-"), d.get("oid", "?"),
@@ -1509,11 +1635,8 @@ class OSDDaemon:
                                           ops[0], tid)
                 return
             reqid = str(d.get("reqid", ""))
-            mutating = any(
-                op.get("op") not in ("read", "stat", "getxattr",
-                                     "getxattrs", "omap_get")
-                for op in ops
-            )
+            mutating = any(op.get("op") not in READ_OPS
+                           for op in ops)
             cached = self._reqid_replies.get(reqid) if reqid else None
             if cached is not None:
                 self._reply(conn, tid, cached["rc"],
@@ -1539,8 +1662,7 @@ class OSDDaemon:
                 _, obj_version = pg.reqid_index[reqid]
                 results = []
                 for op in ops:
-                    if op.get("op") in ("read", "stat", "getxattr",
-                                        "getxattrs", "omap_get"):
+                    if op.get("op") in READ_OPS:
                         _, sub_results, _ = await self._do_ops(
                             pg, str(d["oid"]), [op],
                             snapid=d.get("snapid"),
@@ -1606,8 +1728,7 @@ class OSDDaemon:
             if rc == OK:
                 for op in ops:
                     kind = op.get("op", "")
-                    if kind in ("read", "stat", "getxattr", "getxattrs",
-                                "omap_get"):
+                    if kind in READ_OPS:
                         self.perf.inc("op_r")
                     elif kind in ("write", "writefull", "append",
                                   "truncate", "remove", "create",
@@ -1888,9 +2009,7 @@ class OSDDaemon:
         exists = in_store and (ss is None or ss.head_exists)
         if snapid is not None and snapid != snaps.NOSNAP:
             # snapshot read: resolve to the covering clone or the head
-            if any(op.get("op") not in ("read", "stat", "getxattr",
-                                        "getxattrs", "omap_get")
-                   for op in ops):
+            if any(op.get("op") not in READ_OPS for op in ops):
                 return EINVAL_RC, results, 0    # snaps are read-only
             base = ss if ss is not None else snaps.SnapSet()
             if not in_store:
@@ -2299,11 +2418,19 @@ class OSDDaemon:
         tid = self._sub_tid
         fut = asyncio.get_running_loop().create_future()
         self._sub_futures[tid] = fut
+        payload = {
+            "tid": tid, "kind": kind, "from": self.osd_id,
+            "epoch": self.osdmap.epoch, **args,
+        }
+        if self.cephx:
+            sig = self._sub_op_sig(payload)
+            if sig is not None:
+                payload["sepoch"], payload["sig"] = sig
         try:
-            await self.msgr.send_to(addr, Message("sub_op", {
-                "tid": tid, "kind": kind, "from": self.osd_id,
-                "epoch": self.osdmap.epoch, **args,
-            }, priority=PRIO_HIGH), f"osd.{osd}")
+            await self.msgr.send_to(addr,
+                                    Message("sub_op", payload,
+                                            priority=PRIO_HIGH),
+                                    f"osd.{osd}")
             reply = await asyncio.wait_for(fut, 10.0)
         except (ConnectionError, asyncio.TimeoutError) as e:
             self._sub_futures.pop(tid, None)
@@ -2314,6 +2441,15 @@ class OSDDaemon:
         if rc != 0:
             raise ShardReadError(f"sub_op {kind} on osd.{osd}: rc {rc}")
         return reply.get("value")
+
+    async def _handle_sub_reply(self, d: dict) -> None:
+        if self.cephx and not await self._sub_op_sig_ok(d):
+            log.derr("%s: dropping unsigned/forged sub_reply",
+                     self.entity)
+            return
+        fut = self._sub_futures.pop(int(d.get("tid", 0)), None)
+        if fut is not None and not fut.done():
+            fut.set_result(d)
 
     def _sub_op_stale(self, d: dict) -> bool:
         """True when a sub-op originates from an older PG interval than
@@ -2330,6 +2466,11 @@ class OSDDaemon:
 
     async def _handle_sub_op(self, conn: Connection, d: dict) -> None:
         tid = d.get("tid", 0)
+        if self.cephx and not await self._sub_op_sig_ok(d):
+            log.derr("%s: rejecting unsigned/forged sub_op from %s",
+                     self.entity, conn.peer_name)
+            self._sub_reply(conn, tid, EPERM_RC)
+            return
         try:
             kind = d["kind"]
             mutating = kind in ("tx", "write", "remove")
@@ -2427,11 +2568,16 @@ class OSDDaemon:
 
     def _sub_reply(self, conn: Connection, tid: int, rc: int,
                    value=None) -> None:
+        payload = {"tid": tid, "rc": rc, "value": value}
+        if self.cephx:
+            # replies carry the same service-secret MAC as requests:
+            # a forged ack would otherwise count as a replica commit
+            sig = self._sub_op_sig(payload)
+            if sig is not None:
+                payload["sepoch"], payload["sig"] = sig
         try:
-            conn.send_message(Message(
-                "sub_reply", {"tid": tid, "rc": rc, "value": value},
-                priority=PRIO_HIGH,
-            ))
+            conn.send_message(Message("sub_reply", payload,
+                                      priority=PRIO_HIGH))
         except ConnectionError:
             pass
 
@@ -2454,11 +2600,17 @@ class OSDDaemon:
         """Peer liveness (handle_osd_ping bookkeeping, OSD.cc:5236)."""
         interval = self.conf["osd_heartbeat_interval"]
         grace = self.conf["osd_heartbeat_grace"]
+        last_secret_pull = time.monotonic()
         while not self._stopped:
             try:
                 await asyncio.sleep(interval)
             except asyncio.CancelledError:
                 return
+            if self.cephx:
+                ttl = self.conf["auth_service_secret_ttl"]
+                if time.monotonic() - last_secret_pull > ttl / 2:
+                    last_secret_pull = time.monotonic()
+                    await self._refresh_service_secrets()
             if self.osdmap is None:
                 continue
             now = time.monotonic()

@@ -31,11 +31,18 @@ FAST_TEST_OVERRIDES = {
 class DevCluster:
     def __init__(self, n_mons: int = 1, n_osds: int = 3,
                  overrides: dict | None = None, tcp: bool = False,
-                 base_port: int = 21000, store_dir: str | None = None):
+                 base_port: int = 21000, store_dir: str | None = None,
+                 cephx: bool = False):
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.overrides = dict(FAST_TEST_OVERRIDES)
         self.overrides.update(overrides or {})
+        self.cephx = cephx
+        if cephx:
+            self.overrides.setdefault("auth_cluster_required", "cephx")
+            self.overrides.setdefault("auth_admin_key",
+                                      "devcluster-admin-secret")
+        self._entity_keys: dict[str, str] = {}
         self.tcp = tcp
         self.base_port = base_port
         self.store_dir = store_dir
@@ -55,6 +62,17 @@ class DevCluster:
     def conf(self) -> ConfigProxy:
         return ConfigProxy(overrides=dict(self.overrides))
 
+    def conf_for(self, entity: str) -> ConfigProxy:
+        """Per-entity config: under cephx, each daemon/client carries its
+        own secret key (the keyring file role)."""
+        o = dict(self.overrides)
+        if self.cephx:
+            if entity == "client.admin":
+                o["auth_key"] = o["auth_admin_key"]
+            elif entity in self._entity_keys:
+                o["auth_key"] = self._entity_keys[entity]
+        return ConfigProxy(overrides=o)
+
     def _osd_addr(self, osd_id: int) -> str | None:
         if self.tcp:
             return f"tcp://127.0.0.1:{self.base_port + 100 + osd_id}"
@@ -68,6 +86,18 @@ class DevCluster:
             mon = Monitor(name, self.monmap, self.conf(), store_path=path)
             await mon.start()
             self.mons[name] = mon
+        if self.cephx:
+            # bootstrap the keyring: admin mints each OSD's entity key
+            # before its daemon boots (the ceph-authtool/cephadm role)
+            admin = await self.client()
+            for i in range(self.n_osds):
+                r = await admin.mon_command(
+                    "auth get-or-create", entity=f"osd.{i}",
+                    caps={"mon": "allow r", "osd": "allow *"},
+                )
+                assert r["rc"] == 0, r
+                self._entity_keys[f"osd.{i}"] = r["data"]["key"]
+            await admin.shutdown()
         for i in range(self.n_osds):
             await self.start_osd(i)
 
@@ -84,7 +114,8 @@ class DevCluster:
             osd_id, self._make_osd_store(osd_id)
         )
         osd = OSDDaemon(
-            osd_id, self.monmap, self.conf(), store=store,
+            osd_id, self.monmap, self.conf_for(f"osd.{osd_id}"),
+            store=store,
             addr=self._osd_addr(osd_id), host=f"host{osd_id}",
         )
         await osd.start()
@@ -132,8 +163,14 @@ class DevCluster:
         self.mons.clear()
 
     # -- clients -----------------------------------------------------------
-    async def client(self, name: str = "client.admin") -> Rados:
-        rados = Rados(self.monmap, self.conf(), name=name)
+    async def client(self, name: str = "client.admin",
+                     key: str | None = None) -> Rados:
+        conf = self.conf_for(name)
+        if key is not None:
+            conf = ConfigProxy(overrides={
+                **self.overrides, "auth_key": key,
+            })
+        rados = Rados(self.monmap, conf, name=name)
         await rados.connect()
         return rados
 

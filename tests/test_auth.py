@@ -1,0 +1,185 @@
+"""CephX-lite auth depth: per-entity keys (AuthMonitor), service
+tickets, OSD-side verification, caps enforcement, rotating secrets
+(reference src/mon/AuthMonitor.cc + src/auth/cephx/CephxProtocol.h
+territory)."""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.mon.auth_monitor import (
+    cap_allows,
+    parse_cap,
+    seal_ticket,
+    verify_ticket,
+)
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+# ---------------------------------------------------------------------------
+# unit: caps + tickets
+
+def test_cap_grammar():
+    assert parse_cap("allow *") == {"perm": "*", "pool": None}
+    assert parse_cap("allow rw pool=data") == {"perm": "rw",
+                                               "pool": "data"}
+    for bad in ("deny *", "allow", "allow x", "allow rw host=a"):
+        with pytest.raises(ValueError):
+            parse_cap(bad)
+    assert cap_allows("allow *", write=True, pool="any")
+    assert cap_allows("allow rw pool=data", write=True, pool="data")
+    assert not cap_allows("allow rw pool=data", write=True, pool="other")
+    assert cap_allows("allow r", write=False, pool="x")
+    assert not cap_allows("allow r", write=True, pool="x")
+    assert not cap_allows("", write=False)
+
+
+def test_ticket_seal_verify_and_rotation_window():
+    secrets = {3: "old-secret", 4: "new-secret"}
+    blob, skey = seal_ticket("new-secret", "client.x", "allow rw", 4, 60)
+    got = verify_ticket(secrets, blob)
+    assert got is not None
+    entity, caps, skey2 = got
+    assert (entity, caps) == ("client.x", "allow rw")
+    assert skey2 == skey
+    # previous-epoch ticket still verifies (rotation window)
+    blob_old, _ = seal_ticket("old-secret", "client.y", "allow r", 3, 60)
+    assert verify_ticket(secrets, blob_old) is not None
+    # unknown epoch, tampered fields, and expiry all fail
+    blob_gone, _ = seal_ticket("ancient", "client.z", "allow *", 1, 60)
+    assert verify_ticket(secrets, blob_gone) is None
+    tampered = dict(blob)
+    tampered["caps"] = "allow *"
+    assert verify_ticket(secrets, tampered) is None
+    expired, _ = seal_ticket("new-secret", "client.x", "allow rw", 4,
+                             -1)
+    assert verify_ticket(secrets, expired) is None
+
+
+# ---------------------------------------------------------------------------
+# cluster integration
+
+def test_cephx_end_to_end():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, cephx=True)
+        await cluster.start()
+        admin = await cluster.client()
+
+        # key database: create a scoped user
+        r = await admin.mon_command(
+            "auth get-or-create", entity="client.app",
+            caps={"mon": "allow r", "osd": "allow rw pool=data"},
+        )
+        assert r["rc"] == 0
+        app_key = r["data"]["key"]
+        assert await admin.pool_create("data", pg_num=4, size=3,
+                                       min_size=2)
+        await admin.pool_create("private", pg_num=4, size=3, min_size=2)
+
+        # the scoped user can do IO in its pool...
+        app = await cluster.client("client.app", key=app_key)
+        io = await app.open_ioctx("data")
+        await io.write_full("obj", b"authorized")
+        assert await io.read("obj") == b"authorized"
+        # ...but not outside it
+        other = await app.open_ioctx("private")
+        with pytest.raises(RadosError) as ei:
+            await other.write_full("x", b"nope")
+        assert ei.value.rc == -1                      # EPERM
+        # read caps do not satisfy mutating mon commands
+        r = await app.mon_command("osd pool create", pool="p2",
+                                  pg_num=4)
+        assert r["rc"] == -1
+        # nor auth-database access
+        r = await app.mon_command("auth ls")
+        assert r["rc"] == -1
+        r = await admin.mon_command("auth ls")
+        assert r["rc"] == 0 and "client.app" in r["data"]
+
+        await app.shutdown()
+        await admin.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_cephx_wrong_key_rejected():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, cephx=True)
+        await cluster.start()
+        # a wrong key can never authenticate: the hunt loop retries
+        # until ITS deadline (ConnectionError) or ours (TimeoutError)
+        with pytest.raises((ConnectionError, TimeoutError)):
+            await asyncio.wait_for(
+                cluster.client("client.evil", key="not-the-key"), 6.0
+            )
+        # unknown entity likewise
+        with pytest.raises((ConnectionError, TimeoutError)):
+            await asyncio.wait_for(
+                cluster.client("client.ghost", key="whatever"), 6.0
+            )
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_cephx_keys_survive_mon_restart(tmp_path):
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, cephx=True,
+                             store_dir=str(tmp_path))
+        await cluster.start()
+        admin = await cluster.client()
+        r = await admin.mon_command(
+            "auth get-or-create", entity="client.keeper",
+            caps={"mon": "allow r", "osd": "allow r"},
+        )
+        key = r["data"]["key"]
+        await admin.shutdown()
+        # restart the monitor: the key database is store-backed
+        mon = cluster.mons.pop("a")
+        await mon.shutdown()
+        from ceph_tpu.mon.monitor import Monitor
+        mon2 = Monitor("a", cluster.monmap, cluster.conf(),
+                       store_path=f"{tmp_path}/mon.a")
+        await mon2.start()
+        cluster.mons["a"] = mon2
+        keeper = await cluster.client("client.keeper", key=key)
+        r = await keeper.mon_command("status")
+        assert r["rc"] == 0
+        await keeper.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_service_secret_rotation_keeps_cluster_working():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, cephx=True, overrides={
+            "auth_service_secret_ttl": 0.6,
+        })
+        await cluster.start()
+        admin = await cluster.client()
+        await admin.pool_create("rot", pg_num=4, size=3, min_size=2)
+        io = await admin.open_ioctx("rot")
+        await io.write_full("before", b"pre-rotation")
+        mon = next(iter(cluster.mons.values()))
+        first_epoch = mon.auth_monitor.secret_epoch
+        deadline = asyncio.get_running_loop().time() + 15
+        while mon.auth_monitor.secret_epoch == first_epoch:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        # IO keeps working across the rotation (previous epoch stays
+        # valid; OSDs refresh their secrets)
+        await io.write_full("after", b"post-rotation")
+        assert await io.read("before") == b"pre-rotation"
+        assert await io.read("after") == b"post-rotation"
+        await admin.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
