@@ -101,7 +101,7 @@ def _clay_repair_gibps(stripes: int = 16, sc: int = 1024) -> float:
         rec = eng.apply(R, x)
         return x.at[0, 0, 0].set(rec[0, 0, 0] ^ i.astype(jnp.uint8))
 
-    sec = device_seconds_per_iter(step, dev, lo=8, hi=40)
+    sec = device_seconds_per_iter(step, dev, lo=32, hi=160)
     return stripes * C / sec / 2**30
 
 
@@ -133,7 +133,7 @@ def _lrc_repair_gibps(stripes: int = 64, C: int = 1 << 20) -> float:
         rec = eng.apply_words(coeffs, x)
         return x.at[0, 0].set(rec[0, 0] ^ i)
 
-    sec = device_seconds_per_iter(step, words, lo=8, hi=40)
+    sec = device_seconds_per_iter(step, words, lo=32, hi=160)
     return stripes * C / sec / 2**30
 
 

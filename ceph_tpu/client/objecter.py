@@ -213,6 +213,14 @@ class Objecter:
         if id(conn) in self._osd_authed:
             return
         lock = self._osd_auth_locks.setdefault(id(conn), asyncio.Lock())
+        try:
+            await self._osd_auth_locked(conn, lock, osd)
+        finally:
+            self._osd_auth_futs.pop(id(conn), None)
+            if not lock.locked():
+                self._osd_auth_locks.pop(id(conn), None)
+
+    async def _osd_auth_locked(self, conn, lock, osd: int) -> None:
         async with lock:
             if id(conn) in self._osd_authed:
                 return
@@ -241,7 +249,6 @@ class Objecter:
                     await self.monc.renew_ticket()
                     continue
                 raise ObjecterError(f"osd.{osd} rejected our ticket")
-        self._osd_auth_locks.pop(id(conn), None)
 
     async def _await_newer_map(self, epoch: int, deadline: float,
                                strict: bool = True) -> None:

@@ -18,7 +18,8 @@ import time
 
 SUBSYSTEMS = (
     "osd", "mon", "ms", "ec", "crush", "objecter", "store", "client",
-    "mgr", "rbd", "rgw", "mds", "config", "heartbeat", "peering",
+    "mgr", "rbd", "rgw", "rgw-sync", "mds", "config", "heartbeat",
+    "peering",
 )
 
 _RING_SIZE = 10000

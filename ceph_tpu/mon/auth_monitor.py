@@ -161,6 +161,9 @@ class AuthMonitor(PaxosService):
         self.secret_epoch = max(self.service_secrets, default=0)
 
     def create_initial(self, tx: StoreTransaction) -> None:
+        # under cephx the Monitor refuses to start without this key
+        # (it doubles as the mon-internal signing key); outside cephx a
+        # generated value is fine (the database is then unused)
         admin_key = (self.mon.conf["auth_admin_key"]
                      or secrets.token_hex(16))
         tx.put(PREFIX, "entity/client.admin", json.dumps({

@@ -37,6 +37,7 @@ class MonClient:
         self.cur_mon: str | None = None
         self.conn: Connection | None = None
         self._authed = asyncio.Event()
+        self._renew_lock = asyncio.Lock()
         # cephx grants (the CephxServiceTicket the monitor issues)
         self.caps: dict[str, str] = {}
         self.osd_ticket: dict | None = None
@@ -92,13 +93,21 @@ class MonClient:
     async def renew_ticket(self) -> None:
         """Re-run the auth exchange on the live mon session to refresh
         the OSD service ticket (ticket renewal before expiry — the
-        CephxClientHandler build_request path)."""
-        conn = self.conn
-        if conn is None:
-            raise ConnectionError("no mon session")
-        self._authed.clear()
-        conn.send_message(Message("auth", {"entity": self.entity}))
-        await asyncio.wait_for(self._authed.wait(), 5.0)
+        CephxClientHandler build_request path). Serialized: interleaved
+        exchanges would cross challenges and tear the session down."""
+        async with self._renew_lock:
+            import time as _time
+
+            t = self.osd_ticket
+            if (t is not None
+                    and float(t.get("expires", 0)) > _time.time() + 2.0):
+                return          # a concurrent renewal already refreshed
+            conn = self.conn
+            if conn is None:
+                raise ConnectionError("no mon session")
+            self._authed.clear()
+            conn.send_message(Message("auth", {"entity": self.entity}))
+            await asyncio.wait_for(self._authed.wait(), 5.0)
 
     # -- dispatcher -------------------------------------------------------
     def ms_handle_connect(self, conn: Connection) -> None:

@@ -118,6 +118,14 @@ class Monitor:
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
+        if self.cephx and not self.conf["auth_admin_key"]:
+            # mon-internal signing derives from this key under cephx;
+            # without it peer identity would rest on the client-chosen
+            # handshake name
+            raise ValueError(
+                "auth_cluster_required=cephx requires auth_admin_key "
+                "(the mon keyring)"
+            )
         await self.msgr.bind(self.monmap[self.name])
         for svc in self.services.values():
             svc.refresh()
@@ -327,12 +335,35 @@ class Monitor:
             loop.create_task(self._handle_command(session.conn, msg.data,
                                                   session))
         elif t == "osd_boot":
-            loop.create_task(self._handle_osd_boot(session.conn, msg.data))
+            if self._osd_identity_ok(session, msg.data.get("id")):
+                loop.create_task(
+                    self._handle_osd_boot(session.conn, msg.data)
+                )
         elif t == "osd_failure":
-            loop.create_task(self._handle_osd_failure(msg.data))
+            if self._osd_identity_ok(session, None):
+                loop.create_task(self._handle_osd_failure(msg.data))
         else:
             log.dout(5, "%s: ignoring %s from %s", self.name, t,
                      conn.peer_name)
+
+    def _osd_identity_ok(self, session: MonSession,
+                         claimed_id) -> bool:
+        """Boot/failure reports come from OSD daemons: under cephx the
+        PROVEN session entity must be an osd (and a boot must name its
+        own id) — a low-privilege client must not mark OSDs down or
+        boot fakes."""
+        if not self.cephx:
+            return True
+        etype, _, eid = session.entity.partition(".")
+        if etype != "osd":
+            log.derr("%s: dropping osd report from %s", self.name,
+                     session.entity)
+            return False
+        if claimed_id is not None and str(claimed_id) != eid:
+            log.derr("%s: %s tried to boot osd.%s", self.name,
+                     session.entity, claimed_id)
+            return False
+        return True
 
     async def _dispatch_paxos(self, msg: Message) -> None:
         if msg.type == "paxos_lease":
@@ -606,7 +637,11 @@ class Monitor:
             self._reply(conn, Message("mon_command_reply",
                                       {"tid": tid, **denied.to_wire()}))
             return
-        if cmd.get("prefix") == "auth service-secrets":
+        if not (self.is_leader or self.elector.in_quorum()):
+            # even reads must not be served from a partitioned monitor's
+            # stale state
+            result = CommandResult(EAGAIN_RC, "not in quorum")
+        elif cmd.get("prefix") == "auth service-secrets":
             result = CommandResult(
                 data={str(e): s for e, s in
                       self.auth_monitor.secrets_snapshot().items()}
@@ -615,15 +650,13 @@ class Monitor:
             result = pre
         elif self.is_leader:
             result = await self._run_command(cmd, skip_preprocess=True)
-        elif self.elector.in_quorum():
-            if (self.elector.leader is not None
-                    and not self.elector.electing):
-                self._forward(conn, "mon_command", data,
-                              "mon_command_reply")
-                return
-            result = CommandResult(EAGAIN_RC, "no quorum")
+        elif (self.elector.leader is not None
+                and not self.elector.electing):
+            self._forward(conn, "mon_command", data,
+                          "mon_command_reply")
+            return
         else:
-            result = CommandResult(EAGAIN_RC, "not in quorum")
+            result = CommandResult(EAGAIN_RC, "no quorum")
         self._reply(conn, Message("mon_command_reply",
                                   {"tid": tid, **result.to_wire()}))
 

@@ -382,7 +382,8 @@ class Messenger:
         self.default_policy = Policy.lossless_peer()
         self.policies: dict[str, Policy] = {}     # peer entity type -> policy
         self._conns: dict[str, Connection] = {}   # peer addr str -> conn
-        self._accepted: dict[str, Connection] = {}  # peer name -> conn
+        # (peer name, peer nonce) -> conn
+        self._accepted: dict[tuple[str, int], Connection] = {}
         self._dialing: dict[str, asyncio.Future] = {}  # in-flight connects
         self._server: Optional[asyncio.base_events.Server] = None
         self._rng = random.Random()
@@ -552,7 +553,12 @@ class Messenger:
             (n,) = _LEN.unpack(await stream.read_exactly(_LEN.size))
             peer = decode(await stream.read_exactly(n))
             peer_name = str(peer["entity"])
-            conn = self._accepted.get(peer_name)
+            # session identity is (entity, nonce) — the reference's
+            # addr+nonce. Name alone would let two concurrent clients
+            # with the same entity name (or a restarted daemon) reset
+            # each other's live sessions in a loop.
+            akey = (peer_name, int(peer.get("nonce", 0)))
+            conn = self._accepted.get(akey)
             if conn is not None and peer.get("connect_seq", 0) == 0:
                 # peer started a NEW session (its connect_seq reset): our
                 # old session state is stale — drop it (ProtocolV2
@@ -564,7 +570,8 @@ class Messenger:
                     self, peer_name, hint, self._policy_for(peer_name),
                     initiator=False,
                 )
-                self._accepted[peer_name] = conn
+                conn._accept_key = akey
+                self._accepted[akey] = conn
                 fresh = True
             else:
                 conn._stop_io()
@@ -606,8 +613,9 @@ class Messenger:
     def _forget(self, conn: Connection) -> None:
         if self._conns.get(conn.peer_addr) is conn:
             del self._conns[conn.peer_addr]
-        if self._accepted.get(conn.peer_name) is conn:
-            del self._accepted[conn.peer_name]
+        akey = getattr(conn, "_accept_key", None)
+        if akey is not None and self._accepted.get(akey) is conn:
+            del self._accepted[akey]
 
     def _notify_reset(self, conn: Connection) -> None:
         if self.dispatcher is not None:

@@ -86,6 +86,7 @@ XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
 # cache policy, the replay path, perf counters, and caps enforcement
 READ_OPS = frozenset({"read", "stat", "getxattr", "getxattrs",
                       "omap_get"})
+_CAPS_READ_OPS = READ_OPS | {"pgls"}
 
 # message types the embedded MonClient owns
 _MON_TYPES = {
@@ -269,6 +270,16 @@ class OSDDaemon:
             log.derr("%s: service-secret fetch failed: %s",
                      self.entity, e)
 
+    def _sign_peer_payload(self, payload: dict) -> dict:
+        """Attach the service-secret MAC to an OSD-peer message payload
+        (peering, trims, pings — same integrity story as sub-ops)."""
+        if self.cephx:
+            sig = self._sub_op_sig(payload)
+            if sig is not None:
+                payload = dict(payload)
+                payload["sepoch"], payload["sig"] = sig
+        return payload
+
     def _sub_op_sig(self, payload: dict) -> tuple[int, str] | None:
         """Peer sub-ops are MACed with the current service secret: an
         endpoint that merely claims an osd.* name in the messenger
@@ -346,7 +357,7 @@ class OSDDaemon:
         state = self._conn_auth.get(id(conn))
         if state is None or not state.get("authed"):
             return True
-        write = any(op.get("op") not in READ_OPS | {"pgls"}
+        write = any(op.get("op") not in _CAPS_READ_OPS
                     for op in ops)
         return not cap_allows(state.get("caps", ""), write=write,
                               pool=pg.pool.name)
@@ -418,6 +429,11 @@ class OSDDaemon:
             asyncio.get_running_loop().create_task(
                 self._handle_sub_reply(msg.data)
             )
+        elif t in ("pg_query", "pg_notify", "pg_activate", "log_trim",
+                   "osd_ping", "osd_ping_reply") and self.cephx \
+                and not await self._sub_op_sig_ok(msg.data):
+            log.derr("%s: dropping unsigned/forged %s from %s",
+                     self.entity, t, conn.peer_name)
         elif t == "pg_query":
             self._handle_pg_query(conn, msg.data)
         elif t == "pg_notify":
@@ -440,7 +456,10 @@ class OSDDaemon:
                 fut.set_result(bytes(msg.data.get("reply", b"")))
         elif t == "osd_ping":
             conn.send_message(Message(
-                "osd_ping_reply", {"from": self.osd_id, "ts": msg.data["ts"]},
+                "osd_ping_reply",
+                self._sign_peer_payload(
+                    {"from": self.osd_id, "ts": msg.data["ts"]}
+                ),
                 priority=PRIO_HIGH,
             ))
         elif t == "osd_ping_reply":
@@ -967,6 +986,20 @@ class OSDDaemon:
         tid = d.get("tid", 0)
         pgid = PGId(int(d["pool"]), int(d["ps"]))
         pg = self.pgs.get(pgid)
+        if self.cephx:
+            state = self._conn_auth.get(id(conn))
+            pool_name = pg.pool.name if pg is not None else None
+            if (state is None or not state.get("authed")
+                    or not cap_allows(state.get("caps", ""), write=True,
+                                      pool=pool_name)):
+                try:
+                    conn.send_message(Message("pg_scrub_reply", {
+                        "tid": tid,
+                        "report": {"error": "permission denied"},
+                    }))
+                except ConnectionError:
+                    pass
+                return
         if pg is None or not pg.is_primary or pg.state != STATE_ACTIVE:
             report = {"error": f"pg {pgid} not active-primary here"}
         else:
@@ -1333,7 +1366,8 @@ class OSDDaemon:
             payload["log"] = {str(s): e.to_wire()
                               for s, e in entries.items()}
             payload["tail"] = tail
-        conn.send_message(Message("pg_notify", payload,
+        conn.send_message(Message("pg_notify",
+                                  self._sign_peer_payload(payload),
                                   priority=PRIO_HIGH))
 
     def _handle_pg_notify(self, d: dict) -> None:
@@ -2584,6 +2618,7 @@ class OSDDaemon:
     def _send_osd(self, osd: int, msg: Message) -> None:
         if self.osdmap is None or osd not in self.osdmap.osds:
             return
+        msg.data.update(self._sign_peer_payload(msg.data))
         addr = self.osdmap.osds[osd].addr
 
         async def _send():

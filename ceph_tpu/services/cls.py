@@ -249,8 +249,58 @@ def _register_builtins(reg: ClassRegistry) -> None:
         ctx.setxattr("rbd.header", json.dumps(h).encode())
         return b""
 
+    # -- cls_rgw bucket data log (the reference's cls_rgw bilog: atomic
+    # server-side seq allocation + entry append, the source multisite
+    # sync tails — src/cls/rgw bucket-index log ops) --------------------
+    def rgw_log_add(ctx: ClsContext, indata: bytes) -> bytes:
+        args = _j(indata)
+        ctx.create()
+        cur = ctx.omap_get(["_seq"]).get("_seq", b"0")
+        seq = int(cur) + 1
+        entry = {
+            "op": str(args["op"]), "key": str(args["key"]),
+            "etag": str(args.get("etag", "")),
+            "mtime": float(args.get("mtime", 0.0)),
+        }
+        ctx.omap_set({
+            "_seq": str(seq).encode(),
+            f"{seq:016d}": json.dumps(entry).encode(),
+        })
+        return json.dumps(seq).encode()
+
+    def rgw_log_list(ctx: ClsContext, indata: bytes) -> bytes:
+        args = _j(indata)
+        after = int(args.get("after", 0))
+        limit = int(args.get("max", 1000))
+        omap = ctx.omap_get()
+        out = []
+        for k in sorted(omap):
+            if k.startswith("_"):
+                continue
+            seq = int(k)
+            if seq > after:
+                out.append({"seq": seq, **json.loads(omap[k])})
+                if len(out) >= limit:
+                    break
+        return json.dumps({
+            "entries": out,
+            "max_seq": int(omap.get("_seq", b"0")),
+        }).encode()
+
+    def rgw_log_trim(ctx: ClsContext, indata: bytes) -> bytes:
+        upto = int(_j(indata)["upto"])
+        omap = ctx.omap_get()
+        dead = [k for k in omap
+                if not k.startswith("_") and int(k) <= upto]
+        if dead:
+            ctx.omap_rm(dead)
+        return b""
+
     reg.register("rbd", "create", rbd_create)
     reg.register("rbd", "get_header", rbd_get)
     reg.register("rbd", "set_size", rbd_set_size)
     reg.register("rbd", "snap_add", rbd_snap_add)
     reg.register("rbd", "snap_rm", rbd_snap_rm)
+    reg.register("rgw", "log_add", rgw_log_add)
+    reg.register("rgw", "log_list", rgw_log_list)
+    reg.register("rgw", "log_trim", rgw_log_trim)

@@ -30,8 +30,11 @@ class RGWError(IOError):
 
 
 class RGWLite:
-    def __init__(self, ioctx: IoCtx):
+    def __init__(self, ioctx: IoCtx, datalog: bool = True):
+        """``datalog``: append every mutation to the per-bucket data log
+        (the cls_rgw bilog) so a multisite sync agent can tail it."""
         self.ioctx = ioctx
+        self.datalog = datalog
         self.striper = RadosStriper(ioctx, StripeLayout(
             stripe_unit=512 * 1024, stripe_count=4,
             object_size=4 * 1024 * 1024,
@@ -41,6 +44,34 @@ class RGWLite:
     @staticmethod
     def _index_oid(bucket: str) -> str:
         return f"rgw.bucket.index.{bucket}"
+
+    @staticmethod
+    def _log_oid(bucket: str) -> str:
+        return f"rgw.bucket.log.{bucket}"
+
+    async def _log(self, bucket: str, op: str, key: str,
+                   etag: str = "") -> None:
+        if not self.datalog:
+            return
+        await self.ioctx.exec(
+            self._log_oid(bucket), "rgw", "log_add",
+            json.dumps({"op": op, "key": key, "etag": etag,
+                        "mtime": time.time()}).encode(),
+        )
+
+    async def log_list(self, bucket: str, after: int = 0,
+                       max_entries: int = 1000) -> dict:
+        out = await self.ioctx.exec(
+            self._log_oid(bucket), "rgw", "log_list",
+            json.dumps({"after": after, "max": max_entries}).encode(),
+        )
+        return json.loads(out)
+
+    async def log_trim(self, bucket: str, upto: int) -> None:
+        await self.ioctx.exec(
+            self._log_oid(bucket), "rgw", "log_trim",
+            json.dumps({"upto": upto}).encode(),
+        )
 
     async def create_bucket(self, bucket: str) -> None:
         existing = await self.list_buckets()
@@ -60,6 +91,11 @@ class RGWLite:
         if index:
             raise RGWError("BucketNotEmpty", bucket)
         await self.ioctx.remove(self._index_oid(bucket))
+        try:
+            await self.ioctx.remove(self._log_oid(bucket))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
         await self.ioctx.rm_omap_keys(BUCKETS_OID, [bucket])
 
     async def list_buckets(self) -> list[str]:
@@ -117,6 +153,7 @@ class RGWLite:
         await self.ioctx.set_omap(index_oid, {
             key: json.dumps(entry).encode(),
         })
+        await self._log(bucket, "put", key, etag)
         return {"etag": etag, "size": len(data)}
 
     async def _entry(self, bucket: str, key: str) -> dict:
@@ -156,6 +193,7 @@ class RGWLite:
         else:
             await self.ioctx.remove(oid)
         await self.ioctx.rm_omap_keys(self._index_oid(bucket), [key])
+        await self._log(bucket, "del", key)
 
     async def copy_object(self, src_bucket: str, src_key: str,
                           dst_bucket: str, dst_key: str) -> dict:

@@ -1,0 +1,169 @@
+"""RGW multisite-lite: asynchronous zone-to-zone data sync.
+
+The role of reference src/rgw/rgw_data_sync.cc (5,054 LoC of coroutine
+machinery) at -lite scale, keeping its defining design: the SOURCE zone
+maintains per-bucket data logs (cls_rgw bilog, appended atomically by
+the gateway on every mutation), and an independent SYNC AGENT on the
+secondary zone tails those logs and replays the mutations — pull-based,
+asynchronous, restartable, with the sync position persisted on the
+SECONDARY (so a restarted agent resumes where it left off, and the
+primary needs no knowledge of its peers). Two phases per bucket, exactly
+like the reference:
+
+- FULL SYNC: a new bucket is bootstrapped by snapshotting the source
+  log position FIRST, then copying every listed object — mutations that
+  land mid-copy are re-applied by the incremental phase (idempotent
+  puts converge).
+- INCREMENTAL: replay log entries past the stored marker; a put copies
+  the object's CURRENT content (replays converge to the newest state),
+  a delete tolerates already-gone keys. Applied entries advance the
+  marker; the source log is trimmed up to the low-water mark
+  (radosgw-admin datalog trim role).
+
+This is the framework's geo/DCN replication analog (SURVEY §2.10
+"cross-cluster" row): the data path between zones is ordinary object
+IO, asynchronous with respect to client writes on the primary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.common.log import Dout
+from ceph_tpu.services.rgw import RGWError, RGWLite
+
+log = Dout("rgw-sync")
+
+STATUS_OID = "rgw.sync.status"       # secondary-side omap: bucket -> seq
+
+
+class RGWSyncAgent:
+    def __init__(self, src: RGWLite, dst: RGWLite,
+                 poll_interval: float = 0.2, trim: bool = True):
+        self.src = src
+        self.dst = dst
+        self.poll_interval = poll_interval
+        self.trim = trim
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+        self.synced_ops = 0
+
+    # -- sync position (persisted on the secondary) ----------------------
+    async def _get_marker(self, bucket: str) -> int | None:
+        try:
+            kv = await self.dst.ioctx.get_omap(STATUS_OID, [bucket])
+        except RadosError as e:
+            if e.rc == -2:
+                return None
+            raise
+        if bucket not in kv:
+            return None
+        return int(kv[bucket])
+
+    async def _set_marker(self, bucket: str, seq: int) -> None:
+        from ceph_tpu.client.rados import ObjectOperation
+
+        await self.dst.ioctx.operate(STATUS_OID, ObjectOperation()
+                                     .create()
+                                     .omap_set({
+                                         bucket: str(seq).encode(),
+                                     }))
+
+    # -- object replay ----------------------------------------------------
+    async def _replicate_put(self, bucket: str, key: str) -> None:
+        try:
+            got = await self.src.get_object(bucket, key)
+        except RGWError as e:
+            if e.code == "NoSuchKey":
+                return          # deleted again since; the del entry follows
+            raise
+        await self.dst.put_object(
+            bucket, key, got["data"],
+            content_type=got.get("content_type", "binary/octet-stream"),
+            metadata=got.get("meta", {}),
+        )
+
+    async def _replicate_del(self, bucket: str, key: str) -> None:
+        try:
+            await self.dst.delete_object(bucket, key)
+        except RGWError as e:
+            if e.code != "NoSuchKey":
+                raise
+
+    # -- phases ------------------------------------------------------------
+    async def _full_sync(self, bucket: str) -> int:
+        """Bootstrap a bucket: log position first, then copy everything
+        (writes racing the copy are covered by incremental replay)."""
+        position = int((await self.src.log_list(bucket, after=0,
+                                                max_entries=1))
+                       .get("max_seq", 0))
+        if bucket not in await self.dst.list_buckets():
+            await self.dst.create_bucket(bucket)
+        marker = ""
+        while True:
+            listing = await self.src.list_objects(bucket, marker=marker)
+            for entry in listing["contents"]:
+                await self._replicate_put(bucket, entry["key"])
+                self.synced_ops += 1
+            if not listing["is_truncated"]:
+                break
+            marker = listing["next_marker"]
+        await self._set_marker(bucket, position)
+        log.dout(5, "full sync of %s done at seq %d", bucket, position)
+        return position
+
+    async def _incremental(self, bucket: str, after: int) -> int:
+        listing = await self.src.log_list(bucket, after=after)
+        last = after
+        for entry in listing["entries"]:
+            if entry["op"] == "put":
+                await self._replicate_put(bucket, entry["key"])
+            elif entry["op"] == "del":
+                await self._replicate_del(bucket, entry["key"])
+            last = int(entry["seq"])
+            self.synced_ops += 1
+        if last != after:
+            await self._set_marker(bucket, last)
+            if self.trim:
+                await self.src.log_trim(bucket, last)
+        return last
+
+    async def sync_once(self) -> int:
+        """One pass over every source bucket; returns ops applied."""
+        before = self.synced_ops
+        for bucket in await self.src.list_buckets():
+            try:
+                marker = await self._get_marker(bucket)
+                if marker is None:
+                    await self._full_sync(bucket)
+                else:
+                    await self._incremental(bucket, marker)
+            except (RGWError, RadosError, ConnectionError) as e:
+                log.derr("sync of bucket %s failed: %s", bucket, e)
+        return self.synced_ops - before
+
+    # -- daemon form -------------------------------------------------------
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while not self._stopped:
+            try:
+                await self.sync_once()
+            except Exception as e:           # noqa: BLE001
+                log.derr("sync pass failed: %s", e)
+            try:
+                await asyncio.sleep(self.poll_interval)
+            except asyncio.CancelledError:
+                return
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass

@@ -32,7 +32,10 @@ class DevCluster:
     def __init__(self, n_mons: int = 1, n_osds: int = 3,
                  overrides: dict | None = None, tcp: bool = False,
                  base_port: int = 21000, store_dir: str | None = None,
-                 cephx: bool = False):
+                 cephx: bool = False, ns: str = ""):
+        """``ns``: local:// address namespace prefix so several
+        DevClusters (zones) can coexist in one process (the multi-zone
+        / geo-replication test topology)."""
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.overrides = dict(FAST_TEST_OVERRIDES)
@@ -53,7 +56,8 @@ class DevCluster:
                 for i, n in enumerate(mon_names)
             }
         else:
-            self.monmap = {n: f"local://mon.{n}" for n in mon_names}
+            self.monmap = {n: f"local://{ns}mon.{n}" for n in mon_names}
+        self.ns = ns
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSDDaemon] = {}
         self.mdss: dict[str, "object"] = {}
@@ -76,7 +80,7 @@ class DevCluster:
     def _osd_addr(self, osd_id: int) -> str | None:
         if self.tcp:
             return f"tcp://127.0.0.1:{self.base_port + 100 + osd_id}"
-        return None
+        return f"local://{self.ns}osd.{osd_id}" if self.ns else None
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -144,7 +148,17 @@ class DevCluster:
         """Boot an MDS over existing pools (fs-new + mds boot). The
         pools must already exist."""
         from ceph_tpu.mds.daemon import MDSDaemon
-        mds = MDSDaemon(name, self.monmap, self.conf(),
+        entity = f"client.mds.{name}"
+        if self.cephx and entity not in self._entity_keys:
+            admin = await self.client()
+            r = await admin.mon_command(
+                "auth get-or-create", entity=entity,
+                caps={"mon": "allow r", "osd": "allow *"},
+            )
+            assert r["rc"] == 0, r
+            self._entity_keys[entity] = r["data"]["key"]
+            await admin.shutdown()
+        mds = MDSDaemon(name, self.monmap, self.conf_for(entity),
                         meta_pool=meta_pool, data_pool=data_pool,
                         block_size=block_size)
         await mds.start()

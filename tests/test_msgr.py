@@ -97,7 +97,8 @@ def test_send_receive_roundtrip(scheme):
         peer, msg = ca.messages[0]
         assert peer == "osd.0" and msg.type == "ping" and msg.data == {"x": 1}
         # reply over the accepted connection
-        conn = a._accepted["osd.0"]
+        conn = next(c for (name, _nonce), c in a._accepted.items()
+                    if name == "osd.0")
         conn.send_message(Message("pong", {"y": b"\x01\x02"}))
         await _wait_for(lambda: cb.messages)
         assert cb.messages[0][1].data == {"y": b"\x01\x02"}
@@ -147,8 +148,11 @@ def test_lossy_reset_notifies_dispatcher():
         assert conn.policy.lossy
         conn.send_message(Message("hello", {}))
         # kill the acceptor side; lossy initiator must reset, not reconnect
-        await _wait_for(lambda: "osd.0" in a._accepted)
-        a._accepted["osd.0"].mark_down()
+        await _wait_for(lambda: any(
+            name == "osd.0" for name, _ in a._accepted
+        ))
+        next(c for (name, _nonce), c in a._accepted.items()
+             if name == "osd.0").mark_down()
         await _wait_for(lambda: cb.resets)
         assert cb.resets == ["mon.a"]
         assert conn.is_closed
