@@ -35,6 +35,10 @@ import time
 
 import numpy as np
 
+from ceph_tpu.common.jaxutil import enable_compile_cache
+
+enable_compile_cache()   # before any jit lowering: reruns skip compiles
+
 ISA_L_BASELINE_GIBPS = 5.0
 
 

@@ -84,7 +84,24 @@ def _render_status(st: dict) -> str:
         "  data:",
         f"    pools: {om['num_pools']}",
         f"    osdmap epoch: {om['epoch']}",
+        *_render_pgmap(st.get("pgmap")),
     ])
+
+
+def _render_pgmap(pgmap: dict | None) -> list[str]:
+    if not pgmap or not pgmap.get("num_pgs"):
+        return []
+    states = ", ".join(
+        f"{n} {s}" for s, n in sorted(pgmap["pgs_by_state"].items())
+    )
+    lines = [
+        f"    pgs: {pgmap['num_pgs']} ({states})",
+        f"    objects: {pgmap['num_objects']}"
+        f" ({pgmap['num_bytes']} bytes)",
+    ]
+    if pgmap.get("degraded_objects"):
+        lines.append(f"    degraded: {pgmap['degraded_objects']} objects")
+    return lines
 
 
 async def _run(args) -> int:
@@ -116,10 +133,56 @@ async def _dispatch(args, rados: Rados) -> int:
     if cmd == "status":
         return await _mon(rados, "status", j, render=_render_status)
     if cmd == "health":
-        return await _mon(rados, "health", j,
-                          render=lambda d: d["status"] + "".join(
-                              f"\n  {k}: {c['message']}"
-                              for k, c in d["checks"].items()))
+        detail = getattr(args, "detail", False)
+
+        def render(d):
+            lines = [d["status"]]
+            for k, c in d["checks"].items():
+                lines.append(f"  {k}: {c['message']}")
+                if detail:
+                    lines.extend(f"    {item}"
+                                 for item in c.get("detail", ()))
+            for k in d.get("muted", ()):
+                lines.append(f"  (muted) {k}")
+            return "\n".join(lines)
+
+        return await _mon(rados, "health detail" if detail else "health",
+                          j, render=render)
+    if cmd == "log":
+        if args.action == "last":
+            return await _mon(
+                rados, "log last", j, num=args.num,
+                render=lambda es: "\n".join(
+                    f"{e['seq']} {e['who']} [{e['level']}] {e['message']}"
+                    for e in es),
+            )
+        return await _mon(rados, "log", j, message=args.message)
+    if cmd == "df":
+        return await _mon(rados, "df", j)
+    if cmd == "balancer":
+        return await _mon(rados, "balancer status", j)
+    if cmd == "progress":
+        return await _mon(rados, "progress", j)
+    if cmd == "crash":
+        if args.action == "ls":
+            return await _mon(rados, "crash ls", j)
+        if args.action == "info":
+            return await _mon(rados, "crash info", j, id=args.id)
+        if args.action == "archive":
+            return await _mon(rados, "crash archive", j, id=args.id)
+        if args.action == "rm":
+            return await _mon(rados, "crash rm", j, id=args.id)
+        return await _mon(rados, "crash post", j,
+                          report=json.loads(args.report))
+    if cmd == "config-key":
+        if args.action == "set":
+            return await _mon(rados, "config-key set", j,
+                              key=args.key, value=args.value)
+        if args.action == "get":
+            return await _mon(rados, "config-key get", j, key=args.key)
+        if args.action == "rm":
+            return await _mon(rados, "config-key rm", j, key=args.key)
+        return await _mon(rados, "config-key ls", j)
     if cmd == "quorum_status":
         return await _mon(rados, "quorum_status", j)
     if cmd == "mon":                      # mon dump
@@ -138,6 +201,8 @@ async def _dispatch(args, rados: Rados) -> int:
     if cmd == "rados":
         return await _dispatch_rados(args, rados, j)
     if cmd == "pg":
+        if args.action == "stat":
+            return await _mon(rados, "pg stat", j)
         # `ceph pg scrub|repair <pool>/<ps>`
         pool_name, _, ps_str = str(args.pgid).partition("/")
         m = rados.monc.osdmap
@@ -165,7 +230,18 @@ async def _dispatch(args, rados: Rados) -> int:
         _print(report, True)
         return 0 if not report.get("errors") else 1
     if cmd == "daemon":
-        # `ceph daemon osd.N <cmd>`: the admin-socket surface
+        if "/" in str(args.target):
+            # `ceph daemon <path/to.asok> <cmd>`: direct unix socket
+            from ceph_tpu.common.admin_socket import admin_command
+            cmd_map = {"perf": "perf dump"}
+            out = await admin_command(
+                args.target, cmd_map.get(args.daemon_cmd,
+                                         args.daemon_cmd)
+            )
+            _print(out, True)
+            return 0 if not (isinstance(out, dict)
+                             and "error" in out) else 1
+        # `ceph daemon osd.N <cmd>`: the same surface over the messenger
         kind, _, rest = str(args.target).partition(".")
         try:
             osd_id = int(rest)
@@ -174,6 +250,12 @@ async def _dispatch(args, rados: Rados) -> int:
         if kind != "osd" or osd_id < 0:
             print(f"bad daemon target {args.target!r} (want osd.N)",
                   file=sys.stderr)
+            return 2
+        if args.daemon_cmd not in ("perf", "dump_ops_in_flight",
+                                   "dump_historic_ops"):
+            print(f"unsupported daemon command {args.daemon_cmd!r} "
+                  "over the messenger (use an .asok path for the full "
+                  "surface)", file=sys.stderr)
             return 2
         msg_type = ("perf_dump" if args.daemon_cmd == "perf"
                     else "dump_ops")
@@ -284,9 +366,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=15.0)
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("status")
-    sub.add_parser("health")
+    health = sub.add_parser("health")
+    health.add_argument("--detail", action="store_true")
     sub.add_parser("quorum_status")
     sub.add_parser("mon")
+    sub.add_parser("df")
+    sub.add_parser("balancer")
+    sub.add_parser("progress")
+    crash = sub.add_parser("crash")
+    crash_sub = crash.add_subparsers(dest="action", required=True)
+    crash_sub.add_parser("ls")
+    for name in ("info", "archive", "rm"):
+        c = crash_sub.add_parser(name)
+        c.add_argument("id")
+    cp = crash_sub.add_parser("post")
+    cp.add_argument("report", help="crash report JSON")
+    ck = sub.add_parser("config-key")
+    ck_sub = ck.add_subparsers(dest="action", required=True)
+    cks = ck_sub.add_parser("set")
+    cks.add_argument("key")
+    cks.add_argument("value")
+    for name in ("get", "rm"):
+        c = ck_sub.add_parser(name)
+        c.add_argument("key")
+    ck_sub.add_parser("ls")
+    logp = sub.add_parser("log")
+    log_sub = logp.add_subparsers(dest="action", required=True)
+    ll = log_sub.add_parser("last")
+    ll.add_argument("num", type=int, nargs="?", default=20)
+    li = log_sub.add_parser("add")
+    li.add_argument("message")
 
     conf = sub.add_parser("config")
     conf_sub = conf.add_subparsers(dest="action", required=True)
@@ -299,14 +408,16 @@ def build_parser() -> argparse.ArgumentParser:
     conf_sub.add_parser("dump")
 
     pg = sub.add_parser("pg")
-    pg.add_argument("action", choices=["scrub", "repair"])
-    pg.add_argument("pgid", help="<pool>/<ps>")
+    pg.add_argument("action", choices=["scrub", "repair", "stat"])
+    pg.add_argument("pgid", nargs="?", help="<pool>/<ps>")
 
     daemon = sub.add_parser("daemon")
-    daemon.add_argument("target", help="osd.N")
-    daemon.add_argument("daemon_cmd", choices=[
-        "dump_ops_in_flight", "dump_historic_ops", "perf",
-    ])
+    daemon.add_argument("target", help="osd.N, or a path to an .asok")
+    daemon.add_argument(
+        "daemon_cmd",
+        help="dump_ops_in_flight | dump_historic_ops | perf | "
+             "(any registered admin-socket command for .asok targets)",
+    )
 
     osd = sub.add_parser("osd")
     osd_sub = osd.add_subparsers(dest="action", required=True)

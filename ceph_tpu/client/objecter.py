@@ -10,6 +10,7 @@ that re-arm whenever the target moves (linger_submit / _linger_ops).
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from typing import Awaitable, Callable
 
@@ -17,6 +18,7 @@ import hashlib
 import hmac
 
 from ceph_tpu.common.log import Dout
+from ceph_tpu.common.tracing import Tracer
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger
 from ceph_tpu.osd.codes import MISDIRECTED_RC
@@ -58,6 +60,7 @@ class Objecter:
         # a resubmitted op that already executed with only the reply lost
         self._reqid_name = f"{msgr.name}.{msgr.nonce:08x}"
         self._reqid_seq = 0
+        self.tracer = Tracer(msgr.name)
         # cephx: OSD sessions we have presented our service ticket on
         self._osd_authed: set[int] = set()
         self._osd_auth_futs: dict[int, asyncio.Future] = {}
@@ -136,7 +139,22 @@ class Objecter:
                         timeout: float = 30.0,
                         extra: dict | None = None) -> dict:
         """Submit one op batch; retries across map changes, misdirected
-        replies, and session resets until ``timeout``."""
+        replies, and session resets until ``timeout``.  A sampled op
+        (trace_probability) opens the root span and carries the trace
+        context to the OSD (OpRequest/zipkin_trace analog)."""
+        prob = float(self.monc.conf["trace_probability"] or 0.0)
+        if prob and random.random() < prob:
+            with self.tracer.span("objecter:op_submit", oid=oid,
+                                  pool=pool_id) as tctx:
+                return await self._op_submit_impl(
+                    pool_id, oid, ops, timeout, extra, tctx
+                )
+        return await self._op_submit_impl(pool_id, oid, ops, timeout,
+                                          extra, None)
+
+    async def _op_submit_impl(self, pool_id: int, oid: str,
+                              ops: list[dict], timeout: float,
+                              extra: dict | None, tctx) -> dict:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         # one reqid for the whole retry loop: a resend after a session
@@ -177,6 +195,7 @@ class Objecter:
                     Message("osd_op", {
                         "tid": tid, "pool": pool_id, "ps": ps, "oid": oid,
                         "epoch": m.epoch, "ops": ops, "reqid": reqid,
+                        **({"tctx": tctx.to_wire()} if tctx else {}),
                         **(extra or {}),
                     }), f"osd.{primary}",
                 )

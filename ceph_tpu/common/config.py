@@ -119,6 +119,14 @@ def global_options() -> list[Option]:
                "this entity's own secret key (cephx mode)"),
         Option("auth_service_secret_ttl", float, 3600.0,
                "rotating service-secret / ticket lifetime (s)", min=0.5),
+        Option("trace_probability", float, 0.0,
+               "fraction of client ops that carry a trace context "
+               "(zipkin_trace analog; 0=off)", min=0.0, max=1.0),
+        Option("ms_dispatch_throttle_bytes", int, 100 << 20,
+               "max bytes of in-dispatch messages per peer type before "
+               "the reader backpressures (0=unlimited)", min=0),
+        Option("admin_socket_dir", str, "",
+               "directory for <entity>.asok admin sockets ('' = off)"),
         Option("ms_inject_socket_failures", int, 0,
                "1-in-N artificial connection failures (0=off)", Level.DEV),
         Option("ms_inject_delay_max", float, 0.0,

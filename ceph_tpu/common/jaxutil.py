@@ -8,6 +8,32 @@ except ImportError:  # pragma: no cover - depends on jax version
     _trace_state_clean = None
 
 
+def enable_compile_cache(path: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at a repo-local dir.
+
+    XLA compiles over the axon tunnel run 20-40s each; the benchmark and
+    the driver's entry checks recompile identical programs every run.
+    The on-disk cache (keyed on the serialized HLO + compile options)
+    makes every run after the first pay only the cache read.  Must be
+    called before the first jit lowering; safe to call repeatedly.
+    """
+    import os
+
+    import jax
+
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (AttributeError, ValueError):  # pragma: no cover - jax version
+        pass
+
+
 def outside_trace() -> bool:
     """True when no jit/vmap/shard_map trace is active.
 

@@ -19,7 +19,7 @@ import time
 SUBSYSTEMS = (
     "osd", "mon", "ms", "ec", "crush", "objecter", "store", "client",
     "mgr", "rbd", "rgw", "rgw-sync", "mds", "config", "heartbeat",
-    "peering",
+    "peering", "asok",
 )
 
 _RING_SIZE = 10000

@@ -1,0 +1,89 @@
+"""Zipkin-lite distributed tracing for the op path.
+
+The role of reference src/common/zipkin_trace.h (:24 ZTracer wrappers)
++ the OpRequest trace hooks (src/osd/OpRequest.h): a sampled client op
+carries a trace context on the wire; every hop (objecter submit, OSD
+op execution, sub-op fan-out, replica apply) records a timed span
+linked by (trace_id, parent span id).  Spans land in a bounded
+per-process ring inspectable via the admin socket / ``dump_traces``
+message, keyed so a cross-daemon trace tree can be reassembled.
+
+Sampling: the root decides (``trace_probability`` config); everything
+downstream of a sampled op traces unconditionally, so a trace is
+always complete.
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+_RING = 4096
+
+
+@dataclass(frozen=True)
+class SpanCtx:
+    trace_id: str
+    span_id: str
+
+    def to_wire(self) -> dict:
+        return {"t": self.trace_id, "s": self.span_id}
+
+    @staticmethod
+    def from_wire(d) -> "SpanCtx | None":
+        if not isinstance(d, dict) or "t" not in d:
+            return None
+        return SpanCtx(str(d["t"]), str(d.get("s", "")))
+
+
+class Tracer:
+    """Per-process span collector (one per daemon entity)."""
+
+    def __init__(self, entity: str):
+        self.entity = entity
+        self.spans: deque[dict] = deque(maxlen=_RING)
+
+    @contextmanager
+    def span(self, name: str, parent: SpanCtx | None = None, **tags):
+        """Record a timed span; yields the child SpanCtx to propagate.
+        Works around both sync and async code (it only stamps clocks)."""
+        ctx = SpanCtx(
+            parent.trace_id if parent else secrets.token_hex(8),
+            secrets.token_hex(4),
+        )
+        t0 = time.time()
+        try:
+            yield ctx
+        finally:
+            self.spans.append({
+                "trace_id": ctx.trace_id,
+                "span_id": ctx.span_id,
+                "parent": parent.span_id if parent else "",
+                "name": name,
+                "entity": self.entity,
+                "start": t0,
+                "duration_ms": round((time.time() - t0) * 1e3, 3),
+                **({"tags": tags} if tags else {}),
+            })
+
+    def dump(self, trace_id: str | None = None) -> list[dict]:
+        return [s for s in self.spans
+                if trace_id is None or s["trace_id"] == trace_id]
+
+
+def assemble_tree(spans: list[dict]) -> list[dict]:
+    """Merge spans (possibly from several daemons) into parent-linked
+    trees sorted by start time — the trace-view the reference gets
+    from its zipkin collector."""
+    by_id = {s["span_id"]: dict(s) for s in spans}
+    roots: list[dict] = []
+    for s in sorted(by_id.values(), key=lambda s: s["start"]):
+        parent = by_id.get(s.get("parent", ""))
+        if parent is not None:
+            parent.setdefault("children", []).append(s)
+        else:
+            roots.append(s)
+    return roots

@@ -58,22 +58,25 @@ def device_seconds_per_iter(step, x0, lo: int = 100, hi: int = 300,
     (dispatch, fetch RTT, loop entry) cancel.  Best-of-``trials`` guards
     against tunnel hiccups.
     """
-    import functools
-
     import jax
+    import jax.numpy as jnp
 
-    @functools.partial(jax.jit, static_argnames=("n",))
-    def loop(x, *, n):
+    # The trip count is a TRACED argument: fori_loop lowers to a
+    # while_loop and one compiled program serves every (lo, hi) pair —
+    # including the widening retries below, which previously each paid a
+    # fresh 20-40s tunnel compile for their new static count.
+    @jax.jit
+    def loop(x, n):
         return jax.lax.fori_loop(0, n, step, x)
 
     def run(n: int) -> float:
         t0 = time.perf_counter()
-        out = loop(x0, n=n)
+        out = loop(x0, jnp.int32(n))
         leaf = jax.tree_util.tree_leaves(out)[0]
         np.asarray(leaf.ravel()[0])  # 1-element fetch forces completion
         return time.perf_counter() - t0
 
-    run(lo), run(hi)  # compile + warm the fetch path
+    run(lo), run(hi)  # compile once + warm the fetch path
     for _ in range(3):
         samples = sorted(
             (run(hi) - run(lo)) / (hi - lo) for _ in range(trials)
@@ -84,7 +87,7 @@ def device_seconds_per_iter(step, x0, lo: int = 100, hi: int = 300,
         # A hiccup during a lo run can flip the diff negative; widen the
         # spread so real per-iteration time dominates and retry (bounded).
         lo, hi = hi, hi * 4
-        run(hi)  # compile/warm the new static iteration count
+        run(hi)  # warm the new count (no recompile: n is traced)
     raise RuntimeError(
         "device timing did not stabilise: per-iteration cost is below "
         "measurement noise even at %d iterations" % hi

@@ -1,8 +1,11 @@
-"""ConfigMonitor: the centralized config database.
+"""ConfigMonitor: the centralized config database + config-key store.
 
 Reference src/mon/ConfigMonitor.cc: ``ceph config set/get/rm/dump`` stores
 options in the monitor store; every daemon receives the merged snapshot at
 session start and on each change (MConfig delivery, MonClient.cc:432).
+``config-key`` is the separate free-form key/value namespace
+(reference src/mon/ConfigKeyService.cc) that mgr modules and tools use
+for arbitrary persisted blobs.
 """
 
 from __future__ import annotations
@@ -11,6 +14,7 @@ from ceph_tpu.mon.service import ENOENT_RC, CommandResult, PaxosService
 from ceph_tpu.mon.store import StoreTransaction
 
 PREFIX = "config"
+KEY_PREFIX = "confkey"
 
 
 class ConfigMonitor(PaxosService):
@@ -38,6 +42,19 @@ class ConfigMonitor(PaxosService):
             if key not in self.values:
                 return CommandResult(ENOENT_RC, f"{key!r} not set")
             return CommandResult(data=self.values[key])
+        if name == "config-key get":
+            raw = self.store.get(KEY_PREFIX, cmd.get("key", ""))
+            if raw is None:
+                return CommandResult(ENOENT_RC,
+                                     f"no key {cmd.get('key')!r}")
+            return CommandResult(data=raw.decode("utf-8", "replace"))
+        if name == "config-key ls":
+            return CommandResult(data=sorted(self.store.keys(KEY_PREFIX)))
+        if name == "config-key exists":
+            key = cmd.get("key", "")
+            return CommandResult(
+                data=self.store.get(KEY_PREFIX, key) is not None
+            )
         return None
 
     def prepare_command(self, cmd: dict, tx: StoreTransaction
@@ -57,5 +74,13 @@ class ConfigMonitor(PaxosService):
         if name == "config rm":
             key = cmd["name"]
             tx.erase(PREFIX, key)
+            return CommandResult(outs=f"removed {key}")
+        if name == "config-key set":
+            key = str(cmd["key"])
+            tx.put(KEY_PREFIX, key, str(cmd.get("value", "")).encode())
+            return CommandResult(outs=f"set {key}")
+        if name == "config-key rm":
+            key = str(cmd["key"])
+            tx.erase(KEY_PREFIX, key)
             return CommandResult(outs=f"removed {key}")
         return super().prepare_command(cmd, tx)

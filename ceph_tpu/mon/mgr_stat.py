@@ -1,0 +1,169 @@
+"""MgrStatMonitor: the mgr-fed PGMap digest at the monitor.
+
+Reference src/mon/MgrStatMonitor.cc: the manager aggregates per-daemon
+MPGStats into a PGMap (src/mon/PGMap.cc) and periodically sends the
+monitor a digest (MMonMgrReport) carrying pg state counts, pool usage,
+and health checks; ``ceph status``'s pgmap section, ``ceph df`` and
+``ceph pg stat`` are all served from that digest, and PG_* health
+checks are derived from it.
+
+Digest shape (all optional, the mgr fills what it knows):
+  {"pgs_by_state": {"active+clean": 10, ...},
+   "num_pgs": N, "num_objects": N, "num_bytes": N,
+   "pools": {pool_id: {"name", "num_pgs", "num_objects", "num_bytes",
+                        "degraded": N}},
+   "degraded_objects": N, "osd_df": {osd: {"bytes_used": N}}}
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.mon.service import (
+    EINVAL_RC,
+    ENOENT_RC,
+    CommandResult,
+    PaxosService,
+)
+from ceph_tpu.mon.store import StoreTransaction
+from ceph_tpu.msg.codec import decode, encode
+
+PREFIX = "mgrstat"
+
+
+class MgrStatMonitor(PaxosService):
+    prefix = PREFIX
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        self.digest: dict = {}
+        self.crashes: dict[str, dict] = {}
+
+    def refresh(self) -> None:
+        raw = self.store.get(PREFIX, "digest")
+        self.digest = decode(raw) if raw is not None else {}
+        self.crashes = {}
+        for key in self.store.keys(PREFIX):
+            if key.startswith("crash/"):
+                craw = self.store.get(PREFIX, key)
+                if craw is not None:
+                    self.crashes[key[len("crash/"):]] = decode(craw)
+
+    # -- status surface ----------------------------------------------------
+    def pgmap_summary(self) -> dict:
+        d = self.digest
+        return {
+            "num_pgs": int(d.get("num_pgs", 0)),
+            "pgs_by_state": dict(d.get("pgs_by_state", {})),
+            "num_objects": int(d.get("num_objects", 0)),
+            "num_bytes": int(d.get("num_bytes", 0)),
+            "degraded_objects": int(d.get("degraded_objects", 0)),
+        }
+
+    def health_checks(self) -> dict[str, dict]:
+        checks: dict[str, dict] = {}
+        d = self.digest
+        # mgr-module checks ride the digest (pg_autoscaler etc.)
+        for code, v in d.get("health_checks", {}).items():
+            if isinstance(v, dict) and "severity" in v:
+                checks[str(code)] = dict(v)
+        recent = [cid for cid, c in self.crashes.items()
+                  if not c.get("archived")]
+        if recent:
+            checks["RECENT_CRASH"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"{len(recent)} daemon crashes not archived",
+                "detail": sorted(recent),
+            }
+        degraded = int(d.get("degraded_objects", 0))
+        if degraded:
+            checks["PG_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "message":
+                    f"Degraded data redundancy: {degraded} objects "
+                    "degraded",
+            }
+        inactive = {
+            s: n for s, n in d.get("pgs_by_state", {}).items()
+            if "active" not in s and n
+        }
+        if inactive:
+            total = sum(inactive.values())
+            checks["PG_AVAILABILITY"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"Reduced data availability: {total} pgs "
+                           f"inactive ({inactive})",
+            }
+        return checks
+
+    # -- commands ----------------------------------------------------------
+    def preprocess_command(self, cmd: dict) -> CommandResult | None:
+        name = cmd.get("prefix", "")
+        if name == "pg stat":
+            return CommandResult(data=self.pgmap_summary())
+        if name == "balancer status":
+            return CommandResult(data=self.digest.get("balancer", {
+                "active": False, "mode": "none",
+            }))
+        if name == "progress":
+            return CommandResult(data=self.digest.get("progress", []))
+        if name == "osd pool autoscale-status":
+            return CommandResult(data=self.digest.get("pg_autoscale",
+                                                      {}))
+        if name == "crash ls":
+            return CommandResult(data=[
+                {"crash_id": cid,
+                 "entity": c.get("entity", "?"),
+                 "timestamp": c.get("timestamp", 0),
+                 "archived": bool(c.get("archived"))}
+                for cid, c in sorted(self.crashes.items())
+            ])
+        if name == "crash info":
+            cid = str(cmd.get("id", ""))
+            if cid not in self.crashes:
+                return CommandResult(ENOENT_RC, f"no crash {cid!r}")
+            return CommandResult(data=self.crashes[cid])
+        if name == "df":
+            pools = {
+                int(pid): dict(p)
+                for pid, p in self.digest.get("pools", {}).items()
+            }
+            return CommandResult(data={
+                "pools": pools,
+                "total_bytes": int(self.digest.get("num_bytes", 0)),
+                "osd_df": self.digest.get("osd_df", {}),
+            })
+        return None
+
+    def prepare_command(self, cmd: dict, tx: StoreTransaction
+                        ) -> CommandResult:
+        name = cmd.get("prefix", "")
+        if name == "mgr report":
+            digest = cmd.get("digest")
+            if not isinstance(digest, dict):
+                return CommandResult(EINVAL_RC, "digest must be a dict")
+            tx.put(PREFIX, "digest", encode(digest))
+            return CommandResult(outs="report accepted")
+        if name == "crash post":
+            report = cmd.get("report")
+            if not isinstance(report, dict) \
+                    or not report.get("crash_id"):
+                return CommandResult(
+                    EINVAL_RC, "report must be a dict with a crash_id"
+                )
+            cid = str(report["crash_id"])
+            tx.put(PREFIX, f"crash/{cid}", encode(dict(report)))
+            return CommandResult(outs=f"posted crash {cid}")
+        if name == "crash archive":
+            cid = str(cmd.get("id", ""))
+            if cid not in self.crashes:
+                return CommandResult(ENOENT_RC, f"no crash {cid!r}")
+            report = dict(self.crashes[cid])
+            report["archived"] = True
+            tx.put(PREFIX, f"crash/{cid}", encode(report))
+            return CommandResult(outs=f"archived crash {cid}")
+        if name == "crash rm":
+            cid = str(cmd.get("id", ""))
+            if cid not in self.crashes:
+                return CommandResult(ENOENT_RC, f"no crash {cid!r}")
+            tx.erase(PREFIX, f"crash/{cid}")
+            return CommandResult(outs=f"removed crash {cid}")
+        return super().prepare_command(cmd, tx)

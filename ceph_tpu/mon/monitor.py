@@ -22,6 +22,9 @@ from ceph_tpu.common.log import Dout
 from ceph_tpu.mon.auth_monitor import AuthMonitor, cap_allows
 from ceph_tpu.mon.config_monitor import ConfigMonitor
 from ceph_tpu.mon.election import Elector
+from ceph_tpu.mon.health_monitor import HealthMonitor
+from ceph_tpu.mon.log_monitor import LogMonitor
+from ceph_tpu.mon.mgr_stat import MgrStatMonitor
 from ceph_tpu.mon.osd_monitor import OSDMonitor
 from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.service import EPERM_RC, CommandResult, EINVAL_RC
@@ -84,10 +87,17 @@ class Monitor:
         self.osd_monitor = OSDMonitor(self)
         self.config_monitor = ConfigMonitor(self)
         self.auth_monitor = AuthMonitor(self)
+        self.log_monitor = LogMonitor(self)
+        self.health_monitor = HealthMonitor(self)
+        self.mgr_stat = MgrStatMonitor(self)
         self.services = {
             "osd": self.osd_monitor, "config": self.config_monitor,
-            "auth": self.auth_monitor,
+            "auth": self.auth_monitor, "log": self.log_monitor,
+            "health": self.health_monitor, "mgr": self.mgr_stat,
         }
+        # cluster-log entries queued by local subsystems (health
+        # transitions etc.), drained into one paxos propose per tick
+        self._pending_logs: list[dict] = []
         self.sessions: dict[int, MonSession] = {}
         self._routes: dict[int, tuple[Connection, dict]] = {}
         self._next_rtid = 0
@@ -131,12 +141,39 @@ class Monitor:
             svc.refresh()
         self.elector.start()
         self._tasks.append(asyncio.create_task(self._tick_loop()))
+        run_dir = self.conf["admin_socket_dir"]
+        if run_dir:
+            from ceph_tpu.common.admin_socket import AdminSocket
+
+            sock = AdminSocket(f"mon.{self.name}")
+            sock.register("mon_status", lambda: {
+                "name": self.name, "rank": self.rank,
+                "quorum": self.elector.quorum,
+                "leader": self.elector.leader,
+                "election_epoch": self.elector.epoch,
+                "paxos_last_committed": self.paxos.last_committed,
+            }, "monitor state")
+            sock.register("quorum_status", lambda: {
+                "quorum": self.elector.quorum,
+                "leader": self.elector.leader,
+            }, "quorum view")
+            sock.register("config show", self.conf.show,
+                          "live configuration")
+            sock.register("health", self.health_monitor.summary,
+                          "aggregated health")
+            await sock.start(run_dir)
+            self.admin_socket = sock
+        else:
+            self.admin_socket = None
 
     async def shutdown(self) -> None:
         self._stopped = True
         self.elector.stop()
         for t in self._tasks:
             t.cancel()
+        if getattr(self, "admin_socket", None) is not None:
+            await self.admin_socket.stop()
+            self.admin_socket = None
         await self.msgr.shutdown()
         self.store.close()
 
@@ -260,12 +297,38 @@ class Monitor:
                             tx = StoreTransaction()
                             if self.auth_monitor.maybe_rotate(tx):
                                 await self.paxos.propose(tx)
+                        # health transitions -> cluster log + mute expiry
+                        logs, mutations = \
+                            self.health_monitor.tick_transitions()
+                        self._pending_logs.extend(logs)
+                        if self._pending_logs or mutations:
+                            tx = StoreTransaction()
+                            self.log_monitor.stage_entries(
+                                self._pending_logs, tx
+                            )
+                            self._pending_logs = []
+                            for key, val in mutations.items():
+                                tx.put(self.health_monitor.prefix, key,
+                                       val)
+                            if not tx.empty():
+                                await self.paxos.propose(tx)
                 except ConnectionError:
                     pass
             elif self.elector.in_quorum():
                 if now - self._last_lease > lease * 3:
                     log.dout(1, "%s: lease expired, re-electing", self.name)
                     self.bootstrap()
+                elif self._pending_logs and \
+                        self.elector.leader is not None:
+                    # peon-queued cluster-log entries ride to the leader
+                    entries, self._pending_logs = self._pending_logs, []
+                    self.send_mon(
+                        self.elector.leader, Message("mon_forward", {
+                            "rtid": 0, "itype": "log",
+                            "idata": {"entries": entries},
+                            "reply_type": "",
+                        })
+                    )
 
     # -- dispatcher -------------------------------------------------------
     def ms_handle_connect(self, conn: Connection) -> None:
@@ -342,6 +405,9 @@ class Monitor:
         elif t == "osd_failure":
             if self._osd_identity_ok(session, None):
                 loop.create_task(self._handle_osd_failure(msg.data))
+        elif t == "log":
+            # MLog: daemons submit cluster-log batches
+            loop.create_task(self._handle_log(msg.data))
         else:
             log.dout(5, "%s: ignoring %s from %s", self.name, t,
                      conn.peer_name)
@@ -502,6 +568,12 @@ class Monitor:
     # -- commands ---------------------------------------------------------
     def _route_service(self, cmd: dict):
         word = str(cmd.get("prefix", "")).split(" ", 1)[0]
+        # pgmap-digest reads and mgr-module surfaces live on the
+        # mgr-stat service (PGMap / balancer / progress / crash)
+        if word in ("pg", "df", "balancer", "progress", "crash"):
+            return self.mgr_stat
+        if word == "config-key":
+            return self.config_monitor
         return self.services.get(word)
 
     def _mon_command(self, cmd: dict) -> CommandResult | None:
@@ -525,10 +597,11 @@ class Monitor:
                     ),
                     "num_pools": len(om.pools),
                 },
-                "health": self._health(),
+                "pgmap": self.mgr_stat.pgmap_summary(),
+                "health": self.health_monitor.summary(),
             })
-        if name == "health":
-            return CommandResult(data=self._health())
+        if name == "osd pool autoscale-status":
+            return self.mgr_stat.preprocess_command(cmd)
         if name == "quorum_status":
             return CommandResult(data={
                 "quorum": self.elector.quorum,
@@ -541,23 +614,17 @@ class Monitor:
             })
         return None
 
-    def _health(self) -> dict:
-        om = self.osd_monitor.osdmap
-        checks = {}
-        down = [o for o, i in om.osds.items() if not i.up and i.in_cluster]
-        if down:
-            checks["OSD_DOWN"] = {
-                "severity": "HEALTH_WARN",
-                "message": f"{len(down)} osds down: {sorted(down)}",
-            }
-        if len(self.elector.quorum) < len(self.monmap):
-            out = sorted(set(self.monmap) - set(self.elector.quorum))
-            checks["MON_DOWN"] = {
-                "severity": "HEALTH_WARN",
-                "message": f"monitors out of quorum: {out}",
-            }
-        status = "HEALTH_WARN" if checks else "HEALTH_OK"
-        return {"status": status, "checks": checks}
+    def cluster_log(self, level: str, message: str,
+                    who: str | None = None) -> None:
+        """Queue a cluster-log entry; the next tick commits it (leader)
+        or forwards it to the leader (peon).  Bounded: under a long
+        election the oldest entries are dropped, not the process."""
+        if len(self._pending_logs) >= 1000:
+            del self._pending_logs[0]
+        self._pending_logs.append({
+            "who": who or f"mon.{self.name}",
+            "level": level, "message": message,
+        })
 
     def _preprocess_local(self, cmd: dict) -> CommandResult | None:
         svc = self._route_service(cmd)
@@ -690,6 +757,9 @@ class Monitor:
         elif itype == "osd_failure":
             await self._prepare_failure(idata)
             payload = None
+        elif itype == "log":
+            await self._handle_log(idata)
+            payload = None
         else:
             payload = None
         if reply_type and payload is not None:
@@ -737,6 +807,25 @@ class Monitor:
                     await self.propose_pending()
                 except ConnectionError:
                     pass
+
+    async def _handle_log(self, data: dict) -> None:
+        entries = [e for e in data.get("entries", [])
+                   if isinstance(e, dict)]
+        if not entries:
+            return
+        if self.is_leader:
+            try:
+                async with self._mutate_lock:
+                    tx = StoreTransaction()
+                    if self.log_monitor.stage_entries(entries, tx):
+                        await self.paxos.propose(tx)
+            except ConnectionError:
+                pass
+        elif self.elector.leader is not None:
+            self.send_mon(self.elector.leader, Message("mon_forward", {
+                "rtid": 0, "itype": "log",
+                "idata": {"entries": entries}, "reply_type": "",
+            }))
 
     async def _handle_osd_failure(self, data: dict) -> None:
         if self.is_leader:

@@ -159,6 +159,20 @@ class OSDMonitor(PaxosService):
             pending.new_down.append(target)
         return True
 
+    def health_checks(self) -> dict[str, dict]:
+        checks: dict[str, dict] = {}
+        down = sorted(
+            o for o, i in self.osdmap.osds.items()
+            if not i.up and i.in_cluster
+        )
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"{len(down)} osds down",
+                "detail": [f"osd.{o} is down" for o in down],
+            }
+        return checks
+
     async def tick(self) -> None:
         """Leader maintenance: age down OSDs out (down_out_interval)."""
         now = time.monotonic()
@@ -246,6 +260,10 @@ class OSDMonitor(PaxosService):
                     float(cmd["weight"]) * 0x10000
                 )
                 return CommandResult(outs=f"reweighted osd.{osd}")
+            if name == "osd pg-upmap-items":
+                return self._cmd_upmap_items(cmd)
+            if name == "osd rm-pg-upmap-items":
+                return self._cmd_rm_upmap_items(cmd)
         except (KeyError, ValueError, TypeError) as e:
             return CommandResult(EINVAL_RC, f"bad command args: {e}")
         return CommandResult(EINVAL_RC, f"unrecognized command {name!r}")
@@ -420,6 +438,43 @@ class OSDMonitor(PaxosService):
             pending.new_pools.append(updated)
         return CommandResult(outs=f"snap {snapid} removed",
                              data={"snapid": snapid})
+
+    def _parse_pgid(self, cmd: dict) -> tuple[int, int] | CommandResult:
+        try:
+            pid_s, _, ps_s = str(cmd["pgid"]).partition(".")
+            pid, ps = int(pid_s), int(ps_s)
+        except (KeyError, ValueError):
+            return CommandResult(EINVAL_RC,
+                                 f"bad pgid {cmd.get('pgid')!r}")
+        pool = self.osdmap.pools.get(pid)
+        if pool is None:
+            return CommandResult(ENOENT_RC, f"no pool {pid}")
+        if not 0 <= ps < pool.pg_num:
+            return CommandResult(ENOENT_RC, f"pg {pid}.{ps} out of range")
+        return pid, ps
+
+    def _cmd_upmap_items(self, cmd: dict) -> CommandResult:
+        """``osd pg-upmap-items <pgid> <from> <to> [...]`` — persistent
+        up-set remap (OSDMonitor's MOSDPGUpmapItems / balancer upmap
+        surface)."""
+        pgid = self._parse_pgid(cmd)
+        if isinstance(pgid, CommandResult):
+            return pgid
+        pairs = [(int(a), int(b)) for a, b in cmd.get("mappings", [])]
+        if not pairs:
+            return CommandResult(EINVAL_RC, "no mappings")
+        for _, to in pairs:
+            if to not in self.osdmap.osds:
+                return CommandResult(ENOENT_RC, f"no osd.{to}")
+        self._pending().new_pg_upmap_items[pgid] = pairs
+        return CommandResult(outs=f"upmap {pgid[0]}.{pgid[1]} {pairs}")
+
+    def _cmd_rm_upmap_items(self, cmd: dict) -> CommandResult:
+        pgid = self._parse_pgid(cmd)
+        if isinstance(pgid, CommandResult):
+            return pgid
+        self._pending().new_pg_upmap_items[pgid] = []
+        return CommandResult(outs=f"removed upmap {pgid[0]}.{pgid[1]}")
 
     def _cmd_osd_state(self, name: str, cmd: dict) -> CommandResult:
         ids = [int(i) for i in cmd.get("ids", [])]

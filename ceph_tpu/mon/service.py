@@ -45,6 +45,11 @@ class PaxosService:
     async def tick(self) -> None:
         """Periodic leader-side maintenance."""
 
+    def health_checks(self) -> dict[str, dict]:
+        """Named health checks this service contributes
+        (health_check_map_t): code -> {severity, message, [detail]}."""
+        return {}
+
     # -- commands ---------------------------------------------------------
     def preprocess_command(self, cmd: dict) -> CommandResult | None:
         """Read-only fast path; None means 'needs the leader + a commit'."""

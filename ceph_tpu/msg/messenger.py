@@ -26,6 +26,7 @@ from typing import Optional, Protocol
 
 from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.common.log import Dout
+from ceph_tpu.common.throttle import Throttle
 from ceph_tpu.msg.codec import decode, encode
 from ceph_tpu.msg.message import Message
 
@@ -160,6 +161,9 @@ class Policy:
     """Per-peer-type delivery contract (reference src/msg/Policy.h)."""
     lossy: bool = False         # drop state on failure vs reconnect+replay
     server: bool = False        # never initiates reconnect
+    # dispatch-throttle budget for this peer type; None = the
+    # ms_dispatch_throttle_bytes config default (Policy.h throttler_bytes)
+    throttler_bytes: int | None = None
 
     @classmethod
     def lossless_peer(cls) -> "Policy":
@@ -329,7 +333,18 @@ class Connection:
                     )
                     continue
                 self.in_seq = seq
-                await self.msgr._deliver(self, msg)
+                throttle = self.msgr._dispatch_throttle(self)
+                if throttle is not None:
+                    # backpressure: the reader stalls (and so does the
+                    # peer's socket) while this peer type's in-dispatch
+                    # budget is exhausted
+                    await throttle.acquire(length)
+                    try:
+                        await self.msgr._deliver(self, msg)
+                    finally:
+                        throttle.release(length)
+                else:
+                    await self.msgr._deliver(self, msg)
         except asyncio.CancelledError:
             pass
 
@@ -388,6 +403,7 @@ class Messenger:
         self._server: Optional[asyncio.base_events.Server] = None
         self._rng = random.Random()
         self._stopped = False
+        self._throttles: dict[str, "Throttle"] = {}  # peer type ->
 
     # -- setup -----------------------------------------------------------
     def set_dispatcher(self, d: Dispatcher) -> None:
@@ -400,6 +416,26 @@ class Messenger:
     def _policy_for(self, peer_name: str) -> Policy:
         etype = peer_name.split(".", 1)[0]
         return self.policies.get(etype, self.default_policy)
+
+    def _dispatch_throttle(self, conn: Connection):
+        """Shared per-peer-type dispatch throttle (Policy throttlers):
+        bounds bytes sitting in dispatch so a flood from one entity
+        class backpressures its sockets instead of ballooning memory."""
+        etype = conn.peer_name.split(".", 1)[0] if conn.peer_name else ""
+        throttle = self._throttles.get(etype)
+        if throttle is None:
+            limit = conn.policy.throttler_bytes
+            if limit is None:
+                limit = (self.conf["ms_dispatch_throttle_bytes"]
+                         if self.conf else 0)
+            if not limit:
+                return None
+            throttle = Throttle(f"msgr-dispatch-{etype or 'any'}", limit)
+            self._throttles[etype] = throttle
+        return throttle
+
+    def throttle_dump(self) -> dict:
+        return {name: t.dump() for name, t in self._throttles.items()}
 
     async def bind(self, addr: str) -> None:
         a = EntityAddr.parse(addr)

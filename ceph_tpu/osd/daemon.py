@@ -16,6 +16,7 @@ orchestration around it.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import time
 from collections import deque
@@ -30,6 +31,7 @@ from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.mon.auth_monitor import canonical, cap_allows, verify_ticket
 from ceph_tpu.common.log import Dout
 from ceph_tpu.common.perf import CounterType, PerfCounters
+from ceph_tpu.common.tracing import SpanCtx, Tracer
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.msg.message import PRIO_HIGH, Message
@@ -79,6 +81,11 @@ from ceph_tpu.store.txcodec import (
 )
 
 log = Dout("osd")
+
+# the active trace span of the op being executed on this task; sub-op
+# fan-out reads it to propagate the trace context across daemons
+_CUR_SPAN: contextvars.ContextVar[SpanCtx | None] = \
+    contextvars.ContextVar("ceph_tpu_cur_span", default=None)
 
 XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
 
@@ -168,6 +175,7 @@ class OSDDaemon:
         self.pgs: dict[PGId, PG] = {}
         self._sub_tid = 0
         self._sub_futures: dict[int, asyncio.Future] = {}
+        self.tracer = Tracer(self.entity)
         # heartbeat state: peer -> last reply time
         self._hb_last_rx: dict[int, float] = {}
         self._hb_first_tx: dict[int, float] = {}
@@ -235,7 +243,46 @@ class OSDDaemon:
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         if self.conf["osd_scrub_interval"] > 0:
             self._tasks.append(asyncio.create_task(self._scrub_loop()))
+        await self._start_admin_socket()
         log.dout(1, "%s: booted at %s", self.entity, self.msgr.my_addr)
+
+    async def _start_admin_socket(self) -> None:
+        """Bind <admin_socket_dir>/<entity>.asok with the reference's
+        introspection surface (admin_socket.h:105): perf dump,
+        dump_ops_in_flight, config show, ..."""
+        run_dir = self.conf["admin_socket_dir"]
+        if not run_dir:
+            return
+        from ceph_tpu.common.admin_socket import AdminSocket
+        from ceph_tpu.common.log import dump_recent
+
+        sock = AdminSocket(self.entity)
+        sock.register("perf dump", self.perf.dump,
+                      "dump perf counters")
+        sock.register("dump_ops_in_flight",
+                      self.op_tracker.dump_ops_in_flight,
+                      "in-flight client ops with stage timestamps")
+        sock.register("dump_historic_ops",
+                      self.op_tracker.dump_historic_ops,
+                      "recent slow/completed ops")
+        sock.register("config show", self.conf.show,
+                      "live configuration")
+        sock.register("dump_throttles", self.msgr.throttle_dump,
+                      "messenger dispatch throttles")
+        sock.register("dump_scheduler", self.op_scheduler.stats,
+                      "op scheduler queue state")
+        sock.register("log dump", dump_recent,
+                      "recent log ring (crash context)")
+        sock.register("dump_traces",
+                      lambda trace_id=None: self.tracer.dump(trace_id),
+                      "collected trace spans (zipkin-lite)")
+        sock.register("status", lambda: {
+            "entity": self.entity,
+            "osdmap_epoch": self.osdmap.epoch if self.osdmap else 0,
+            "num_pgs": len(self.pgs),
+        }, "daemon status")
+        await sock.start(run_dir)
+        self.admin_socket = sock
 
     async def shutdown(self) -> None:
         self._stopped = True
@@ -247,6 +294,9 @@ class OSDDaemon:
             if pg.snaptrim_task is not None:
                 pg.snaptrim_task.cancel()
         self.op_scheduler.shutdown()
+        if getattr(self, "admin_socket", None) is not None:
+            await self.admin_socket.stop()
+            self.admin_socket = None
         await self.monc.shutdown()
         await self.msgr.shutdown()
         await self.store.umount()
@@ -422,6 +472,25 @@ class OSDDaemon:
                 conn.send_message(Message("perf_dump_reply", {
                     "tid": msg.data.get("tid", 0),
                     "counters": self.perf.dump(),
+                }))
+            except ConnectionError:
+                pass
+        elif t == "pg_stats":
+            # MPGStats: per-primary-PG stats for the mgr's PGMap digest
+            try:
+                conn.send_message(Message("pg_stats_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    "pgs": self._pg_stats(),
+                }))
+            except ConnectionError:
+                pass
+        elif t == "dump_traces":
+            try:
+                conn.send_message(Message("dump_traces_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    "spans": self.tracer.dump(
+                        msg.data.get("trace_id")
+                    ),
                 }))
             except ConnectionError:
                 pass
@@ -844,6 +913,82 @@ class OSDDaemon:
                 out[oid.name] = int(json.loads(raw)["version"])
             except (KeyError, ValueError, TypeError):
                 out[oid.name] = 1
+        return out
+
+    _PG_STAT_TTL = 0.5
+
+    def _pg_stats(self) -> list[dict]:
+        """Per-primary-PG stats (the MPGStats payload the mgr folds into
+        its PGMap digest, reference src/messages/MPGStats.h +
+        src/osd/osd_types.h pg_stat_t): reference-style state string,
+        object/byte counts from the primary shard, degraded counts from
+        the missing sets.  The object/byte scan is O(objects), so per-PG
+        results are cached for _PG_STAT_TTL (the reference avoids the
+        scan entirely by maintaining pg_stat_t incrementally per op;
+        a bounded-staleness cache keeps this poll off the op path)."""
+        now = time.monotonic()
+        cache = getattr(self, "_pg_stat_cache", None)
+        if cache is None:
+            cache = self._pg_stat_cache = {}
+        out: list[dict] = []
+        live = set()
+        for pg in self.pgs.values():
+            if not pg.is_primary:
+                continue
+            live.add(pg.pgid)
+            hit = cache.get(pg.pgid)
+            if hit is not None and now - hit[0] < self._PG_STAT_TTL \
+                    and hit[2] == pg.state:
+                out.append(hit[1])
+                continue
+            missing = pg.missing.total() if pg.missing else 0
+            valid_acting = [o for o in pg.acting if o != NO_OSD]
+            state = pg.state
+            if state == STATE_ACTIVE:
+                state = "active+clean" if not missing \
+                    else "active+degraded"
+            elif state == STATE_RECOVERING:
+                state = "active+recovering+degraded"
+            if len(valid_acting) < pg.pool.size:
+                state += "+undersized"
+            num_objects = 0
+            num_bytes = 0
+            cid = (CollectionId(pg.pgid.pool, pg.pgid.ps,
+                                pg.acting_shard_of(self.osd_id))
+                   if pg.is_ec
+                   else CollectionId(pg.pgid.pool, pg.pgid.ps))
+            try:
+                for oid in self.store.list_objects(cid):
+                    if oid.snap != snaps.NOSNAP \
+                            or self._is_whiteout(pg, oid.name):
+                        continue
+                    num_objects += 1
+                    try:
+                        num_bytes += int(
+                            self.store.stat(cid, oid)["size"]
+                        )
+                    except KeyError:
+                        pass
+            except KeyError:
+                pass
+            if pg.is_ec:
+                # primary shard bytes -> logical bytes (k data shards)
+                num_bytes *= getattr(pg, "ec_k", 1) or 1
+            stat = {
+                "pgid": str(pg.pgid),
+                "pool": pg.pgid.pool,
+                "state": state,
+                "num_objects": num_objects,
+                "num_bytes": num_bytes,
+                "degraded": missing,
+                "acting": list(pg.acting),
+                "up": list(pg.up),
+            }
+            cache[pg.pgid] = (now, stat, pg.state)
+            out.append(stat)
+        for pgid in list(cache):
+            if pgid not in live:
+                del cache[pgid]
         return out
 
     # -- snap trimming (reference snap trimmer + SnapMapper) ---------------
@@ -1627,6 +1772,22 @@ class OSDDaemon:
 
     # -- client ops ----------------------------------------------------------
     async def _handle_osd_op(self, conn: Connection, d: dict) -> None:
+        tctx = SpanCtx.from_wire(d.get("tctx"))
+        if tctx is not None:
+            # sampled op: the span covers the full primary-side life,
+            # and the contextvar hands the context to sub-op fan-out
+            with self.tracer.span("osd:do_op", parent=tctx,
+                                  oid=str(d.get("oid", "?"))) as ctx:
+                token = _CUR_SPAN.set(ctx)
+                try:
+                    await self._handle_osd_op_inner(conn, d)
+                finally:
+                    _CUR_SPAN.reset(token)
+            return
+        await self._handle_osd_op_inner(conn, d)
+
+    async def _handle_osd_op_inner(self, conn: Connection,
+                                   d: dict) -> None:
         tid = d.get("tid", 0)
         op_start = time.monotonic()
         top = None
@@ -2437,6 +2598,16 @@ class OSDDaemon:
 
     # -- sub ops (shard/replica server side) -----------------------------------
     async def send_sub_op(self, osd: int, kind: str, **args):
+        ctx = _CUR_SPAN.get()
+        if ctx is not None and "tctx" not in args:
+            with self.tracer.span(f"osd:sub_op:{kind}:send",
+                                  parent=ctx, to=osd) as child:
+                return await self._send_sub_op_impl(
+                    osd, kind, tctx=child.to_wire(), **args
+                )
+        return await self._send_sub_op_impl(osd, kind, **args)
+
+    async def _send_sub_op_impl(self, osd: int, kind: str, **args):
         """Send one sub-op and await its reply (tid-correlated). Every
         sub-op carries the sender's PG interval-start epoch so a stale
         primary cannot replicate into a PG whose interval has moved on
@@ -2499,6 +2670,17 @@ class OSDDaemon:
         return int(d.get("iepoch", 0)) < pg.epoch
 
     async def _handle_sub_op(self, conn: Connection, d: dict) -> None:
+        tctx = SpanCtx.from_wire(d.get("tctx"))
+        if tctx is not None:
+            with self.tracer.span(
+                f"osd:sub_op:{d.get('kind', '?')}", parent=tctx,
+            ):
+                await self._handle_sub_op_inner(conn, d)
+            return
+        await self._handle_sub_op_inner(conn, d)
+
+    async def _handle_sub_op_inner(self, conn: Connection,
+                                   d: dict) -> None:
         tid = d.get("tid", 0)
         if self.cephx and not await self._sub_op_sig_ok(d):
             log.derr("%s: rejecting unsigned/forged sub_op from %s",

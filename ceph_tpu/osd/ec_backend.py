@@ -348,12 +348,14 @@ class ECBackend:
                 "write", oid, failed,
                 lambda live: self._heal_shards(oid, live, entry),
                 entry,
+                causes={i: repr(r) for i, r in enumerate(results)
+                        if isinstance(r, BaseException)},
             )
             return ECObjectMeta(new_size, new_version)
 
     async def _settle_write_failures(self, what: str, oid: str,
                                      failed: list[int], heal,
-                                     entry=None) -> None:
+                                     entry=None, causes=None) -> None:
         """Resolve a mutation's shard failures. Strict (logged) mode: a
         live-shard miss is healed SYNCHRONOUSLY (``heal``, e.g. rebuild
         from the shards that did commit) so the op still acks as fully
@@ -368,6 +370,7 @@ class ECBackend:
             raise ShardReadError(
                 f"{what} {oid}: shards {failed} failed "
                 f"(live: {live}, m={self.m}), beyond recoverability"
+                + (f"; causes: {causes}" if causes else "")
             )
         if self.strict and live:
             try:

@@ -77,6 +77,10 @@ class Incremental:
     removed_pools: list[int] = field(default_factory=list)
     new_pg_temp: dict[tuple[int, int], list[int]] = field(default_factory=dict)
     new_primary_temp: dict[tuple[int, int], int] = field(default_factory=dict)
+    # pgid -> [(from_osd, to_osd), ...] persistent up-set remaps
+    # (OSDMap.h pg_upmap_items; empty list clears the entry)
+    new_pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = \
+        field(default_factory=dict)
     new_ec_profiles: dict[str, dict] = field(default_factory=dict)
     removed_ec_profiles: list[str] = field(default_factory=list)
     new_crush: dict | None = None       # full crush dump when it changed
@@ -97,6 +101,10 @@ class Incremental:
             "new_primary_temp": {
                 f"{pid}.{ps}": o
                 for (pid, ps), o in self.new_primary_temp.items()
+            },
+            "new_pg_upmap_items": {
+                f"{pid}.{ps}": [list(p) for p in pairs]
+                for (pid, ps), pairs in self.new_pg_upmap_items.items()
             },
             "new_ec_profiles": {
                 n: dict(p) for n, p in self.new_ec_profiles.items()
@@ -131,6 +139,10 @@ class Incremental:
                 cls._pgid(s): int(o)
                 for s, o in d.get("new_primary_temp", {}).items()
             },
+            new_pg_upmap_items={
+                cls._pgid(s): [(int(a), int(b)) for a, b in pairs]
+                for s, pairs in d.get("new_pg_upmap_items", {}).items()
+            },
             new_ec_profiles={
                 n: dict(p)
                 for n, p in d.get("new_ec_profiles", {}).items()
@@ -148,6 +160,8 @@ class OSDMap:
         self.pools: dict[int, PoolInfo] = {}
         self.pg_temp: dict[tuple[int, int], list[int]] = {}
         self.primary_temp: dict[tuple[int, int], int] = {}
+        self.pg_upmap_items: dict[tuple[int, int],
+                                  list[tuple[int, int]]] = {}
         self.ec_profiles: dict[str, dict] = {}
         # never reused, even after pool deletion: a recycled id would
         # alias a dead pool's surviving shard objects into a new pool
@@ -180,6 +194,10 @@ class OSDMap:
             self.primary_temp = {
                 k: v for k, v in self.primary_temp.items() if k[0] != pid
             }
+            self.pg_upmap_items = {
+                k: v for k, v in self.pg_upmap_items.items()
+                if k[0] != pid
+            }
         for pgid, osds in inc.new_pg_temp.items():
             if osds:
                 self.pg_temp[pgid] = list(osds)
@@ -190,6 +208,11 @@ class OSDMap:
                 self.primary_temp.pop(pgid, None)
             else:
                 self.primary_temp[pgid] = osd
+        for pgid, pairs in inc.new_pg_upmap_items.items():
+            if pairs:
+                self.pg_upmap_items[pgid] = [tuple(p) for p in pairs]
+            else:
+                self.pg_upmap_items.pop(pgid, None)
         for name, profile in inc.new_ec_profiles.items():
             self.ec_profiles[name] = dict(profile)
         for name in inc.removed_ec_profiles:
@@ -229,10 +252,30 @@ class OSDMap:
             ]
         return [o for o in raw if o != NO_OSD and self.is_up(o)]
 
+    def _apply_upmap(self, pool_id: int, ps: int,
+                     raw: list[int]) -> list[int]:
+        """pg_upmap_items remaps (OSDMap.cc:2425 _apply_upmap): each
+        (from, to) pair replaces ``from`` in the raw set, positionally,
+        when ``to`` is a live, in-cluster OSD not already present."""
+        pairs = self.pg_upmap_items.get((pool_id, ps))
+        if not pairs:
+            return raw
+        out = list(raw)
+        for frm, to in pairs:
+            if to in out or not self.is_up(to) \
+                    or not self.osds[to].in_cluster:
+                continue
+            for i, o in enumerate(out):
+                if o == frm:
+                    out[i] = to
+                    break
+        return out
+
     def pg_to_up_acting(self, pool_id: int, ps: int):
-        """(up, up_primary, acting, acting_primary) with pg_temp /
-        primary_temp overrides (OSDMap.cc _get_temp_osds region)."""
-        raw = self.pg_to_raw_osds(pool_id, ps)
+        """(up, up_primary, acting, acting_primary) with upmap then
+        pg_temp / primary_temp overrides (OSDMap.cc _get_temp_osds)."""
+        raw = self._apply_upmap(pool_id, ps,
+                                self.pg_to_raw_osds(pool_id, ps))
         up = self.raw_to_up_osds(pool_id, raw)
         acting = list(self.pg_temp.get((pool_id, ps), up))
         if not acting:
@@ -266,6 +309,10 @@ class OSDMap:
                 f"{pid}.{ps}": o
                 for (pid, ps), o in self.primary_temp.items()
             },
+            "pg_upmap_items": {
+                f"{pid}.{ps}": [list(p) for p in pairs]
+                for (pid, ps), pairs in self.pg_upmap_items.items()
+            },
             "ec_profiles": {n: dict(p) for n, p in self.ec_profiles.items()},
             "max_pool_id": self.max_pool_id,
             "crush": self.crush.to_dict(),
@@ -289,6 +336,10 @@ class OSDMap:
         m.primary_temp = {
             Incremental._pgid(s): int(o)
             for s, o in d.get("primary_temp", {}).items()
+        }
+        m.pg_upmap_items = {
+            Incremental._pgid(s): [(int(a), int(b)) for a, b in pairs]
+            for s, pairs in d.get("pg_upmap_items", {}).items()
         }
         m.ec_profiles = {
             n: dict(p) for n, p in d.get("ec_profiles", {}).items()

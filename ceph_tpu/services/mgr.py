@@ -22,7 +22,14 @@ from ceph_tpu.msg.messenger import Connection, Messenger, Policy
 
 class Mgr:
     def __init__(self, monmap: dict[str, str],
-                 conf: ConfigProxy | None = None, name: str = "mgr.x"):
+                 conf: ConfigProxy | None = None, name: str = "mgr.x",
+                 modules: list | None = None):
+        from ceph_tpu.services.mgr_modules import (
+            Balancer,
+            PGAutoscaler,
+            Progress,
+        )
+
         self.conf = conf or ConfigProxy()
         self.name = name
         self.msgr = Messenger(name, self.conf)
@@ -32,12 +39,21 @@ class Mgr:
         self.monc = MonClient(name, monmap, self.conf, msgr=self.msgr)
         self._tid = 0
         self._futures: dict[int, asyncio.Future] = {}
+        if modules is None:
+            modules = [Balancer(self), PGAutoscaler(self),
+                       Progress(self)]
+        self.modules = {m.name: m for m in modules}
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if msg.type == "perf_dump_reply":
             fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
             if fut is not None and not fut.done():
                 fut.set_result(msg.data.get("counters", {}))
+            return
+        if msg.type == "pg_stats_reply":
+            fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg.data.get("pgs", []))
             return
         await self.monc.ms_dispatch(conn, msg)
 
@@ -59,14 +75,15 @@ class Mgr:
 
     # -- collection --------------------------------------------------------
     async def _poll_osd(self, osd: int, addr: str,
-                        timeout: float = 3.0) -> dict | None:
+                        timeout: float = 3.0,
+                        what: str = "perf_dump"):
         self._tid += 1
         tid = self._tid
         fut = asyncio.get_running_loop().create_future()
         self._futures[tid] = fut
         try:
             await self.msgr.send_to(
-                addr, Message("perf_dump", {"tid": tid}), f"osd.{osd}"
+                addr, Message(what, {"tid": tid}), f"osd.{osd}"
             )
             return await asyncio.wait_for(fut, timeout)
         except (ConnectionError, asyncio.TimeoutError):
@@ -95,6 +112,93 @@ class Mgr:
             },
             "osd_perf": osd_perf,
         }
+
+    # -- PGMap digest (DaemonServer + PGMap aggregation) -------------------
+    async def collect_pg_stats(self) -> dict[int, list[dict]]:
+        """Poll every up OSD for per-PG stats (the MPGStats pull)."""
+        osdmap = self.monc.osdmap
+        if osdmap is None:
+            return {}
+        polls = {
+            osd: self._poll_osd(osd, info.addr, what="pg_stats")
+            for osd, info in osdmap.osds.items() if info.up
+        }
+        results = await asyncio.gather(*polls.values())
+        return {osd: pgs for osd, pgs in zip(polls, results)
+                if pgs is not None}
+
+    async def build_digest(self) -> dict:
+        """Fold per-OSD PG stats into the PGMap digest the monitor's
+        MgrStatMonitor persists (reference src/mon/PGMap.cc summaries)."""
+        per_osd = await self.collect_pg_stats()
+        pgs_by_state: dict[str, int] = {}
+        pools: dict[int, dict] = {}
+        num_objects = num_bytes = degraded = 0
+        pool_names = {}
+        osd_df: dict[int, dict] = {}
+        osdmap = self.monc.osdmap
+        if osdmap is not None:
+            pool_names = {p.pool_id: p.name
+                          for p in osdmap.pools.values()}
+        seen: set[str] = set()
+        for osd, pgs in sorted(per_osd.items()):
+            osd_bytes = 0
+            for st in pgs:
+                osd_bytes += int(st.get("num_bytes", 0))
+                pgid = str(st.get("pgid"))
+                if pgid in seen:
+                    continue          # one primary report per PG wins
+                seen.add(pgid)
+                state = str(st.get("state", "unknown"))
+                pgs_by_state[state] = pgs_by_state.get(state, 0) + 1
+                num_objects += int(st.get("num_objects", 0))
+                num_bytes += int(st.get("num_bytes", 0))
+                degraded += int(st.get("degraded", 0))
+                pid = int(st.get("pool", 0))
+                p = pools.setdefault(pid, {
+                    "name": pool_names.get(pid, str(pid)),
+                    "num_pgs": 0, "num_objects": 0, "num_bytes": 0,
+                    "degraded": 0,
+                })
+                p["num_pgs"] += 1
+                p["num_objects"] += int(st.get("num_objects", 0))
+                p["num_bytes"] += int(st.get("num_bytes", 0))
+                p["degraded"] += int(st.get("degraded", 0))
+            osd_df[osd] = {"bytes_used": osd_bytes}
+        return {
+            "pgs_by_state": pgs_by_state,
+            "num_pgs": len(seen),
+            "num_objects": num_objects,
+            "num_bytes": num_bytes,
+            "degraded_objects": degraded,
+            "pools": pools,
+            "osd_df": osd_df,
+        }
+
+    async def report(self) -> dict:
+        """One aggregation + module + push cycle (MMonMgrReport)."""
+        digest = await self.build_digest()
+        health: dict = {}
+        for mod in self.modules.values():
+            observe = getattr(mod, "observe_digest", None)
+            if observe is not None:
+                observe(digest)
+            await mod.serve_once()
+            digest.update(mod.digest_contrib())
+            health.update(mod.health_checks())
+        if health:
+            digest["health_checks"] = health
+        await self.monc.command("mgr report", digest=digest)
+        return digest
+
+    async def report_loop(self, interval: float = 1.0) -> None:
+        """Periodic digest push; run as a task alongside the mgr."""
+        while True:
+            try:
+                await self.report()
+            except (ConnectionError, asyncio.TimeoutError, KeyError):
+                pass
+            await asyncio.sleep(interval)
 
     # -- prometheus exposition ---------------------------------------------
     @staticmethod

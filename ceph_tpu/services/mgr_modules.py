@@ -1,0 +1,210 @@
+"""Mgr module framework: balancer, pg_autoscaler, progress.
+
+The reference manager embeds Python modules (src/mgr/ActivePyModules.cc;
+src/pybind/mgr/*) that observe cluster maps/stats and act through mon
+commands.  Here a module is an object the Mgr drives on its report
+cycle: ``serve_once`` may issue mon commands (the balancer's upmap
+moves), ``digest_contrib`` folds module state into the PGMap digest the
+monitor persists (so ``ceph balancer status`` / ``ceph progress`` are
+served mon-side), and ``health_checks`` raises module health warnings
+(the pg_autoscaler's POOL_TOO_FEW_PGS).
+
+Crash reporting (reference src/pybind/mgr/crash) lives mon-side in
+MgrStatMonitor ("crash post/ls/info/archive" commands + RECENT_CRASH
+health check); no mgr loop is needed for it.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class MgrModule:
+    name = ""
+    can_run = True
+
+    def __init__(self, mgr):
+        self.mgr = mgr
+
+    async def serve_once(self) -> None:
+        """One maintenance pass, called per mgr report cycle."""
+
+    def digest_contrib(self) -> dict:
+        """Extra digest sections (merged into the mgr report)."""
+        return {}
+
+    def health_checks(self) -> dict[str, dict]:
+        return {}
+
+
+class Balancer(MgrModule):
+    """Upmap balancer: even out per-OSD PG counts.
+
+    The reference balancer's upmap mode (src/pybind/mgr/balancer/
+    module.py + OSDMap::calc_pg_upmaps): find the most- and least-loaded
+    OSDs by PG count and move one PG between them with a persistent
+    ``osd pg-upmap-items`` remap.  One move per cycle keeps peering
+    churn bounded; convergence comes from repetition.
+    """
+
+    name = "balancer"
+    max_deviation = 1          # stop when max-min <= this
+
+    def __init__(self, mgr, active: bool = True):
+        super().__init__(mgr)
+        self.active = active
+        self.last_optimize = ""
+        self.optimizations = 0
+
+    def _pg_distribution(self):
+        """(pg counts per up-OSD, pg -> up set) over all pools."""
+        m = self.mgr.monc.osdmap
+        counts = {o: 0 for o, i in m.osds.items()
+                  if i.up and i.in_cluster}
+        placement = {}
+        for pool in m.pools.values():
+            for ps in range(pool.pg_num):
+                up, _, _, _ = m.pg_to_up_acting(pool.pool_id, ps)
+                placement[(pool.pool_id, ps)] = up
+                for o in up:
+                    if o in counts:
+                        counts[o] += 1
+        return counts, placement
+
+    async def serve_once(self) -> None:
+        if not self.active or self.mgr.monc.osdmap is None:
+            return
+        counts, placement = self._pg_distribution()
+        if len(counts) < 2:
+            return
+        hot = max(counts, key=lambda o: counts[o])
+        cold = min(counts, key=lambda o: counts[o])
+        if counts[hot] - counts[cold] <= self.max_deviation:
+            self.last_optimize = "balanced"
+            return
+        m = self.mgr.monc.osdmap
+        for (pid, ps), up in placement.items():
+            if hot in up and cold not in up:
+                # hot may sit in the up set via an existing (a -> hot)
+                # remap; rewriting that pair to (a -> cold) keeps one
+                # hop per raw slot (appending (hot, cold) would be dead
+                # weight: hot is not in the raw set)
+                pairs = list(m.pg_upmap_items.get((pid, ps), []))
+                for i, (frm, to) in enumerate(pairs):
+                    if to == hot:
+                        pairs[i] = (frm, cold)
+                        break
+                else:
+                    pairs.append((hot, cold))
+                r = await self.mgr.monc.command(
+                    "osd pg-upmap-items", pgid=f"{pid}.{ps}",
+                    mappings=[list(p) for p in pairs],
+                )
+                if r["rc"] == 0:
+                    self.optimizations += 1
+                    self.last_optimize = (
+                        f"moved pg {pid}.{ps} osd.{hot} -> osd.{cold}"
+                    )
+                return
+
+    def digest_contrib(self) -> dict:
+        return {"balancer": {
+            "active": self.active,
+            "mode": "upmap",
+            "optimizations": self.optimizations,
+            "last_optimize": self.last_optimize,
+        }}
+
+
+class PGAutoscaler(MgrModule):
+    """pg_num advisor (reference src/pybind/mgr/pg_autoscaler in warn
+    mode): the ideal PG count per pool is ~100 PGs per OSD spread over
+    the pool's replicas/shards, rounded to a power of two.  PG
+    *splitting* is not implemented in the OSD, so this module only
+    raises health warnings (mode=warn) rather than resizing pools.
+    """
+
+    name = "pg_autoscaler"
+    target_per_osd = 100
+
+    def _recommendations(self) -> dict[str, dict]:
+        m = self.mgr.monc.osdmap
+        if m is None:
+            return {}
+        n_osds = sum(1 for i in m.osds.values()
+                     if i.up and i.in_cluster)
+        if not n_osds:
+            return {}
+        out = {}
+        for pool in m.pools.values():
+            ideal = max(1, n_osds * self.target_per_osd // max(
+                pool.size, 1))
+            # round down to a power of two
+            p2 = 1 << (ideal.bit_length() - 1)
+            if pool.pg_num * 4 <= p2:
+                out[pool.name] = {
+                    "pg_num": pool.pg_num, "ideal": p2, "kind": "few"}
+            elif pool.pg_num >= p2 * 8 and pool.pg_num > 32:
+                out[pool.name] = {
+                    "pg_num": pool.pg_num, "ideal": p2, "kind": "many"}
+        return out
+
+    def health_checks(self) -> dict[str, dict]:
+        recs = self._recommendations()
+        checks = {}
+        few = {n: r for n, r in recs.items() if r["kind"] == "few"}
+        if few:
+            checks["POOL_TOO_FEW_PGS"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"{len(few)} pools have too few PGs: " + ", ".join(
+                    f"{n} ({r['pg_num']} < ideal {r['ideal']})"
+                    for n, r in sorted(few.items())),
+            }
+        many = {n: r for n, r in recs.items() if r["kind"] == "many"}
+        if many:
+            checks["POOL_TOO_MANY_PGS"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"{len(many)} pools have too many PGs: "
+                + ", ".join(f"{n} ({r['pg_num']} > ideal {r['ideal']})"
+                            for n, r in sorted(many.items())),
+            }
+        return checks
+
+    def digest_contrib(self) -> dict:
+        return {"pg_autoscale": self._recommendations()}
+
+
+class Progress(MgrModule):
+    """Recovery progress events (reference src/pybind/mgr/progress):
+    when degraded objects appear, an event tracks the fraction healed;
+    it completes when the count returns to zero."""
+
+    name = "progress"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._events: dict[str, dict] = {}
+        self._peak = 0
+
+    def observe_digest(self, digest: dict) -> None:
+        degraded = int(digest.get("degraded_objects", 0))
+        ev = self._events.get("recovery")
+        if degraded > 0:
+            self._peak = max(self._peak, degraded)
+            if ev is None or "finished" in ev:
+                ev = {"id": "recovery", "started": time.time()}
+                self._events["recovery"] = ev
+            ev["message"] = (
+                f"Recovering degraded objects ({degraded} remaining)"
+            )
+            ev["progress"] = 1.0 - degraded / max(self._peak, 1)
+        elif ev is not None:
+            ev["message"] = "Recovery complete"
+            ev["progress"] = 1.0
+            ev["finished"] = time.time()
+            self._peak = 0
+
+    def digest_contrib(self) -> dict:
+        return {"progress": sorted(
+            self._events.values(), key=lambda e: e["id"]
+        )}

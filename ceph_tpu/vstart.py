@@ -61,6 +61,7 @@ class DevCluster:
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSDDaemon] = {}
         self.mdss: dict[str, "object"] = {}
+        self.mgrs: dict[str, "object"] = {}
         self._osd_stores: dict[int, ObjectStore] = {}
 
     def conf(self) -> ConfigProxy:
@@ -165,7 +166,37 @@ class DevCluster:
         self.mdss[name] = mds
         return mds
 
+    async def start_mgr(self, name: str = "x", report_interval: float = 0.2):
+        """Boot a manager that aggregates OSD pg stats into the PGMap
+        digest and pushes it to the mon (the mgr daemon role)."""
+        import asyncio
+
+        from ceph_tpu.services.mgr import Mgr
+        entity = f"mgr.{name}"
+        if self.cephx and entity not in self._entity_keys:
+            admin = await self.client()
+            r = await admin.mon_command(
+                "auth get-or-create", entity=entity,
+                caps={"mon": "allow *", "osd": "allow *"},
+            )
+            assert r["rc"] == 0, r
+            self._entity_keys[entity] = r["data"]["key"]
+            await admin.shutdown()
+        mgr = Mgr(self.monmap, self.conf_for(entity), name=entity)
+        await mgr.start()
+        mgr._report_task = asyncio.get_running_loop().create_task(
+            mgr.report_loop(report_interval)
+        )
+        self.mgrs[name] = mgr
+        return mgr
+
     async def stop(self) -> None:
+        for mgr in list(self.mgrs.values()):
+            task = getattr(mgr, "_report_task", None)
+            if task is not None:
+                task.cancel()
+            await mgr.shutdown()
+        self.mgrs.clear()
         for mds in list(self.mdss.values()):
             await mds.shutdown()
         self.mdss.clear()

@@ -1,0 +1,255 @@
+"""Mgr modules + upmap: balancer, pg_autoscaler, crash, config-key.
+
+Covers the reference surfaces src/pybind/mgr/balancer (upmap mode via
+OSDMap pg_upmap_items + `osd pg-upmap-items`), pg_autoscaler (warn
+mode health checks), mgr/crash (post/ls/info/archive + RECENT_CRASH),
+and src/mon/ConfigKeyService (config-key set/get/ls/rm).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.osd_map import Incremental, OSDMap
+from ceph_tpu.placement.crush_map import CrushMap
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def _flat_map(n_osds: int, pool_size: int = 2, pg_num: int = 16) -> OSDMap:
+    crush = CrushMap()
+    crush.add_bucket("default", "root")
+    crush.create_replicated_rule("replicated_rule", failure_domain="osd")
+    m = OSDMap()
+    inc = Incremental(1, new_crush=crush.to_dict())
+    m.apply_incremental(inc)
+    inc2 = Incremental(2)
+    for i in range(n_osds):
+        inc2.new_up[i] = f"local://osd.{i}"
+        inc2.new_weights[i] = 0x10000
+    from ceph_tpu.osd.osd_map import PoolInfo
+    inc2.new_pools.append(PoolInfo(1, "p", "replicated", size=pool_size,
+                                   min_size=1, pg_num=pg_num,
+                                   crush_rule="replicated_rule"))
+    m.apply_incremental(inc2)
+    crush2 = CrushMap.from_dict(m.crush.to_dict())
+    for i in range(n_osds):
+        hb = crush2.add_bucket(f"h{i}", "host")
+        crush2.add_item("default", hb)
+        crush2.add_item(f"h{i}", i)
+    inc3 = Incremental(3, new_crush=crush2.to_dict())
+    m.apply_incremental(inc3)
+    return m
+
+
+def test_upmap_remaps_placement():
+    m = _flat_map(4)
+    pid, ps = 1, 0
+    up0, _, _, _ = m.pg_to_up_acting(pid, ps)
+    frm = up0[0]
+    to = next(o for o in range(4) if o not in up0)
+    inc = Incremental(m.epoch + 1,
+                      new_pg_upmap_items={(pid, ps): [(frm, to)]})
+    m.apply_incremental(inc)
+    up1, _, acting1, _ = m.pg_to_up_acting(pid, ps)
+    assert to in up1 and frm not in up1
+    assert up1 == acting1
+    # other PGs untouched
+    for other in range(1, m.pools[pid].pg_num):
+        upo, _, _, _ = m.pg_to_up_acting(pid, other)
+        assert upo == m.pg_to_up_acting(pid, other)[0]
+    # a remap to a down OSD is ignored
+    inc2 = Incremental(m.epoch + 1, new_down=[to])
+    m.apply_incremental(inc2)
+    up2, _, _, _ = m.pg_to_up_acting(pid, ps)
+    assert to not in up2
+    # removal restores the CRUSH mapping
+    inc3 = Incremental(m.epoch + 1, new_pg_upmap_items={(pid, ps): []})
+    m.apply_incremental(inc3)
+    inc4 = Incremental(m.epoch + 1, new_up={to: "local://x"})
+    m.apply_incremental(inc4)
+    up4, _, _, _ = m.pg_to_up_acting(pid, ps)
+    assert up4 == up0
+    # wire round-trip preserves upmap entries
+    m.pg_upmap_items[(pid, ps)] = [(0, 3)]
+    m2 = OSDMap.from_dict(m.to_dict())
+    assert m2.pg_upmap_items == {(pid, ps): [(0, 3)]}
+
+
+def test_balancer_rewrites_chained_upmap():
+    """Regression: when the hot OSD holds a PG via an existing
+    (a -> hot) remap, the balancer must rewrite that pair to
+    (a -> cold) — appending (hot -> cold) would be dead (hot is not in
+    the raw set) and the PG would bounce back to its raw OSD."""
+    async def run():
+        from ceph_tpu.services.mgr_modules import Balancer
+
+        m = _flat_map(4, pool_size=1, pg_num=1)
+        up0, _, _, _ = m.pg_to_up_acting(1, 0)
+        raw_osd = up0[0]
+        hot = next(o for o in range(4) if o != raw_osd)
+        inc = Incremental(m.epoch + 1,
+                          new_pg_upmap_items={(1, 0): [(raw_osd, hot)]})
+        m.apply_incremental(inc)
+        up1, _, _, _ = m.pg_to_up_acting(1, 0)
+        assert up1 == [hot]
+
+        sent = {}
+
+        class FakeMonc:
+            osdmap = m
+
+            async def command(self, prefix, **kw):
+                sent.update(kw, prefix=prefix)
+                return {"rc": 0}
+
+        class FakeMgr:
+            monc = FakeMonc()
+
+        bal = Balancer(FakeMgr())
+        cold = next(o for o in range(4) if o not in (hot, raw_osd))
+        # force the move deterministically: hot has the only PG
+        counts, placement = bal._pg_distribution()
+        assert counts[hot] == 1
+        bal.max_deviation = 0
+        await bal.serve_once()
+        assert sent.get("prefix") == "osd pg-upmap-items", sent
+        pairs = [tuple(p) for p in sent["mappings"]]
+        # the chain was rewritten, not extended
+        assert len(pairs) == 1
+        assert pairs[0][0] == raw_osd and pairs[0][1] != hot
+        # applying it actually moves the PG off the hot OSD
+        inc2 = Incremental(
+            m.epoch + 1,
+            new_pg_upmap_items={(1, 0): list(pairs)},
+        )
+        m.apply_incremental(inc2)
+        up2, _, _, _ = m.pg_to_up_acting(1, 0)
+        assert up2 == [pairs[0][1]]
+
+    asyncio.run(run())
+
+
+def test_balancer_converges_pg_counts():
+    async def run():
+        from ceph_tpu.services.mgr_modules import Balancer
+
+        cluster = DevCluster(n_mons=1, n_osds=4)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="bal",
+                                        pg_num=32, size=2)
+            assert r["rc"] == 0, r
+            await cluster.wait_health_ok()
+            mgr = await cluster.start_mgr()
+            bal = mgr.modules["balancer"]
+            assert isinstance(bal, Balancer)
+
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                counts, _ = bal._pg_distribution()
+                if counts and max(counts.values()) - min(
+                        counts.values()) <= bal.max_deviation:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    (counts, bal.last_optimize)
+                await asyncio.sleep(0.3)
+            assert bal.optimizations > 0
+            r = await rados.mon_command("balancer status")
+            assert r["data"]["mode"] == "upmap"
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_autoscaler_warns_on_tiny_pool():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="tiny",
+                                        pg_num=1, size=3)
+            assert r["rc"] == 0, r
+            await cluster.start_mgr()
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                r = await rados.mon_command("health")
+                if "POOL_TOO_FEW_PGS" in r["data"]["checks"]:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    r["data"]
+                await asyncio.sleep(0.3)
+            r = await rados.mon_command("osd pool autoscale-status")
+            assert "tiny" in r["data"]
+            assert r["data"]["tiny"]["kind"] == "few"
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_crash_lifecycle_and_config_key():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            report = {"crash_id": "2026-07-30_osd.1_deadbeef",
+                      "entity": "osd.1", "timestamp": 1785000000.0,
+                      "backtrace": ["frame1", "frame2"]}
+            r = await rados.mon_command("crash post", report=report)
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("crash ls")
+            assert [c["crash_id"] for c in r["data"]] == \
+                [report["crash_id"]]
+            r = await rados.mon_command("crash info",
+                                        id=report["crash_id"])
+            assert r["data"]["backtrace"] == ["frame1", "frame2"]
+            r = await rados.mon_command("health")
+            assert "RECENT_CRASH" in r["data"]["checks"]
+            r = await rados.mon_command("crash archive",
+                                        id=report["crash_id"])
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("health")
+            assert "RECENT_CRASH" not in r["data"]["checks"]
+            r = await rados.mon_command("crash rm",
+                                        id=report["crash_id"])
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("crash ls")
+            assert r["data"] == []
+
+            # config-key: the free-form kv namespace
+            r = await rados.mon_command("config-key set",
+                                        key="mgr/test/blob", value="v1")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("config-key get",
+                                        key="mgr/test/blob")
+            assert r["data"] == "v1"
+            r = await rados.mon_command("config-key ls")
+            assert "mgr/test/blob" in r["data"]
+            r = await rados.mon_command("config-key exists",
+                                        key="mgr/test/blob")
+            assert r["data"] is True
+            r = await rados.mon_command("config-key rm",
+                                        key="mgr/test/blob")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("config-key get",
+                                        key="mgr/test/blob")
+            assert r["rc"] != 0
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,169 @@
+"""Monitor depth: cluster log, health checks/mutes, mgr-fed PGMap.
+
+Covers the round-2 additions mirroring reference src/mon/LogMonitor.cc,
+HealthMonitor.cc, MgrStatMonitor.cc + PGMap.cc: daemon/CLI log entries
+replicate through paxos; health aggregates service checks with mute
+semantics and logs transitions; the mgr polls per-PG stats off the OSDs,
+folds them into a digest, and `status`/`pg stat`/`df` serve it.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _write_some(cluster, pool="logpool", n=6):
+    rados = await cluster.client()
+    r = await rados.mon_command("osd pool create", pool=pool, pg_num=8,
+                                size=2)
+    assert r["rc"] == 0, r
+    ioctx = await rados.open_ioctx(pool)
+    for i in range(n):
+        await ioctx.write_full(f"obj-{i}", b"x" * 100 * (i + 1))
+    return rados, ioctx
+
+
+def test_cluster_log_and_health_transitions():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados, _ = await _write_some(cluster)
+            await cluster.wait_health_ok()
+
+            # CLI-injected entry lands in `log last`
+            r = await rados.mon_command("log", message="hello world",
+                                        who="client.test")
+            assert r["rc"] == 0, r
+            await asyncio.sleep(0.3)
+            r = await rados.mon_command("log last", num=50)
+            assert r["rc"] == 0
+            msgs = [e["message"] for e in r["data"]]
+            assert "hello world" in msgs
+
+            # kill an OSD -> OSD_DOWN check + "Health check failed" log
+            await cluster.kill_osd(2)
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                r = await rados.mon_command("health detail")
+                if "OSD_DOWN" in r["data"]["checks"]:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    r["data"]
+                await asyncio.sleep(0.2)
+            detail = r["data"]["checks"]["OSD_DOWN"]
+            assert detail["severity"] == "HEALTH_WARN"
+            assert "osd.2 is down" in detail.get("detail", [])
+
+            await asyncio.sleep(0.5)
+            r = await rados.mon_command("log last", num=50, level="warn")
+            assert any("OSD_DOWN" in e["message"] for e in r["data"]), \
+                r["data"]
+
+            # mute -> health OK again; unmute -> WARN returns
+            r = await rados.mon_command("health mute", code="OSD_DOWN")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("health")
+            assert r["data"]["status"] == "HEALTH_OK"
+            assert "OSD_DOWN" in r["data"].get("muted", [])
+            r = await rados.mon_command("health unmute", code="OSD_DOWN")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("health")
+            assert r["data"]["status"] == "HEALTH_WARN"
+
+            # revive -> check clears + "Health check cleared" logged
+            await cluster.revive_osd(2)
+            await cluster.wait_health_ok()
+            await asyncio.sleep(0.5)
+            r = await rados.mon_command("log last", num=100)
+            assert any("Health check cleared: OSD_DOWN" in e["message"]
+                       for e in r["data"]), [e["message"]
+                                             for e in r["data"]]
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_nonsticky_mute_clears_with_check():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            await cluster.wait_health_ok()
+            await cluster.kill_osd(1)
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                r = await rados.mon_command("health")
+                if r["data"]["status"] != "HEALTH_OK":
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+            await rados.mon_command("health mute", code="OSD_DOWN")
+            await cluster.revive_osd(1)
+            await cluster.wait_health_ok()
+            await asyncio.sleep(0.5)
+            # the mute must have evaporated with the check
+            mon = next(iter(cluster.mons.values()))
+            assert "OSD_DOWN" not in mon.health_monitor.mutes
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_mgr_pgmap_digest():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados, ioctx = await _write_some(cluster, pool="statpool",
+                                             n=5)
+            await cluster.wait_health_ok()
+            await cluster.start_mgr()
+
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                r = await rados.mon_command("pg stat")
+                assert r["rc"] == 0, r
+                if r["data"]["num_objects"] >= 5 and \
+                        r["data"]["num_pgs"] >= 8:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    r["data"]
+                await asyncio.sleep(0.3)
+            summary = r["data"]
+            assert summary["num_bytes"] >= sum(
+                100 * (i + 1) for i in range(5)
+            )
+            assert any("active" in s
+                       for s in summary["pgs_by_state"]), summary
+
+            # status carries the pgmap section
+            r = await rados.mon_command("status")
+            assert r["data"]["pgmap"]["num_objects"] >= 5
+
+            # df: per-pool rollup
+            r = await rados.mon_command("df")
+            assert r["rc"] == 0
+            pools = {p["name"]: p for p in r["data"]["pools"].values()}
+            assert pools["statpool"]["num_objects"] >= 5
+            assert r["data"]["osd_df"], r["data"]
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
