@@ -125,6 +125,9 @@ def global_options() -> list[Option]:
         Option("ms_dispatch_throttle_bytes", int, 100 << 20,
                "max bytes of in-dispatch messages per peer type before "
                "the reader backpressures (0=unlimited)", min=0),
+        Option("osd_client_message_size_cap", int, 500 << 20,
+               "max bytes of client op payloads in flight per OSD; "
+               "held for each op's LIFETIME (0=unlimited)", min=0),
         Option("admin_socket_dir", str, "",
                "directory for <entity>.asok admin sockets ('' = off)"),
         Option("ms_inject_socket_failures", int, 0,

@@ -406,8 +406,15 @@ class Monitor:
             if self._osd_identity_ok(session, None):
                 loop.create_task(self._handle_osd_failure(msg.data))
         elif t == "log":
-            # MLog: daemons submit cluster-log batches
-            loop.create_task(self._handle_log(msg.data))
+            # MLog: daemons submit cluster-log batches.  The entries'
+            # 'who' is forced to the PROVEN session entity so a client
+            # cannot forge attribution into the operator's log.
+            entries = [
+                {**e, "who": session.entity}
+                for e in msg.data.get("entries", ())
+                if isinstance(e, dict)
+            ]
+            loop.create_task(self._handle_log({"entries": entries}))
         else:
             log.dout(5, "%s: ignoring %s from %s", self.name, t,
                      conn.peer_name)

@@ -119,6 +119,7 @@ class OSDMonitor(PaxosService):
         info = self.osdmap.osds.get(osd_id)
         if info is not None and info.up and info.addr == addr:
             return False        # no change: don't stage an empty epoch
+        self.mon.cluster_log("info", f"osd.{osd_id} boot ({addr})")
         pending = self._pending()
         pending.new_up[osd_id] = addr
         if info is None:
@@ -154,6 +155,9 @@ class OSDMonitor(PaxosService):
         if len(reports) < self.mon.conf["mon_osd_min_down_reporters"]:
             return False
         del self.failure_reports[target]
+        self.mon.cluster_log(
+            "warn", f"osd.{target} failed ({len(reports)} reporters)"
+        )
         pending = self._pending()
         if target not in pending.new_down:
             pending.new_down.append(target)
@@ -188,6 +192,10 @@ class OSDMonitor(PaxosService):
                 del self.down_pending_out[osd]
                 changed = True
                 log.dout(1, "osd.%d down too long, marking out", osd)
+                self.mon.cluster_log(
+                    "warn", f"osd.{osd} marked out after being down "
+                    f"{interval:g}s"
+                )
         if changed:
             await self.mon.propose_pending()
 

@@ -335,9 +335,13 @@ class Connection:
                 self.in_seq = seq
                 throttle = self.msgr._dispatch_throttle(self)
                 if throttle is not None:
-                    # backpressure: the reader stalls (and so does the
-                    # peer's socket) while this peer type's in-dispatch
-                    # budget is exhausted
+                    # Backpressure while the message is in DISPATCH
+                    # (decode -> handler entry).  Handlers that detach
+                    # long work into tasks leave dispatch quickly; the
+                    # op-lifetime memory bound for those is the OSD's
+                    # client-message throttle (osd daemon), the same
+                    # two-layer split as the reference's dispatch
+                    # throttle + osd_client_message_size_cap.
                     await throttle.acquire(length)
                     try:
                         await self.msgr._deliver(self, msg)

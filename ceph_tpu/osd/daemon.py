@@ -176,6 +176,14 @@ class OSDDaemon:
         self._sub_tid = 0
         self._sub_futures: dict[int, asyncio.Future] = {}
         self.tracer = Tracer(self.entity)
+        # op-LIFETIME memory bound on client payloads (the reference's
+        # osd_client_message_size_cap throttle): held from op arrival to
+        # completion, so a flood backpressures instead of ballooning RAM
+        from ceph_tpu.common.throttle import Throttle
+
+        self.client_throttle = Throttle(
+            "osd-client-bytes", self.conf["osd_client_message_size_cap"]
+        )
         # heartbeat state: peer -> last reply time
         self._hb_last_rx: dict[int, float] = {}
         self._hb_first_tx: dict[int, float] = {}
@@ -1772,6 +1780,20 @@ class OSDDaemon:
 
     # -- client ops ----------------------------------------------------------
     async def _handle_osd_op(self, conn: Connection, d: dict) -> None:
+        # op-lifetime payload budget: acquired before any work, released
+        # when the op (including its fan-out and reply) is done
+        cost = 256 + sum(
+            len(op.get("data") or b"") for op in d.get("ops", ())
+            if isinstance(op, dict)
+        )
+        await self.client_throttle.acquire(cost)
+        try:
+            await self._handle_osd_op_traced(conn, d)
+        finally:
+            self.client_throttle.release(cost)
+
+    async def _handle_osd_op_traced(self, conn: Connection,
+                                    d: dict) -> None:
         tctx = SpanCtx.from_wire(d.get("tctx"))
         if tctx is not None:
             # sampled op: the span covers the full primary-side life,

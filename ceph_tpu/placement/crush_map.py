@@ -7,8 +7,18 @@ straw2 draws vectorized per bucket. Tunables default to the reference's
 modern profile (choose_total_tries=50, chooseleaf_descend_once/vary_r/stable
 on, local retries off).
 
-Buckets are straw2 (the modern default; reference deprecates straw) or
-uniform (equal weights). Device ids >= 0; bucket ids < 0.
+Buckets are straw2 (the modern default; reference deprecates straw),
+uniform (equal weights), list (sequential weighted draw — cheap adds at
+the head, reference crush.h CRUSH_BUCKET_LIST), or tree (log-depth
+weighted binary descent, CRUSH_BUCKET_TREE).  list/tree follow the
+published algorithms over our own layout (implicit heap for tree) and
+are not bit-compatible with upstream's node numbering — legacy algs
+kept for API parity; straw2 is the placement-stable choice and IS
+bit-compatible.  Device ids >= 0; bucket ids < 0.
+
+choose_args (CrushWrapper choose_args / weight-sets): named alternative
+per-bucket weight vectors consulted during bucket draws, letting a
+balancer skew placement without touching the real hierarchy weights.
 """
 
 from __future__ import annotations
@@ -18,7 +28,7 @@ from typing import Sequence
 
 import numpy as np
 
-from ceph_tpu.placement.hashing import crush_hash32_2
+from ceph_tpu.placement.hashing import crush_hash32_2, crush_hash32_4
 from ceph_tpu.placement.straw2 import straw2_draws
 
 ITEM_NONE = 0x7FFFFFFF  # CRUSH_ITEM_NONE: indep hole marker
@@ -77,6 +87,10 @@ class CrushMap:
         self.max_device = 0
         self._next_bucket_id = -1
         self._parent: dict[int, int] = {}  # child bucket id -> parent id
+        # weight-set name -> bucket id -> alternative weights (16.16)
+        self.choose_args: dict[str, dict[int, list[int]]] = {}
+        self._active_weights: dict[int, list[int]] | None = None
+        self._tree_heap_cache: dict[tuple, tuple[list[int], int]] = {}
 
     # -- construction (builder.c / CrushWrapper facade) ------------------
     def add_type(self, name: str) -> int:
@@ -207,6 +221,10 @@ class CrushMap:
             ],
             "max_device": self.max_device,
             "parent": {str(c): p for c, p in self._parent.items()},
+            "choose_args": {
+                name: {str(b): list(w) for b, w in per_bucket.items()}
+                for name, per_bucket in self.choose_args.items()
+            },
         }
 
     @classmethod
@@ -226,6 +244,11 @@ class CrushMap:
             )
         m.max_device = int(d["max_device"])
         m._parent = {int(c): int(p) for c, p in d["parent"].items()}
+        m.choose_args = {
+            str(name): {int(b): [int(x) for x in w]
+                        for b, w in per_bucket.items()}
+            for name, per_bucket in d.get("choose_args", {}).items()
+        }
         return m
 
     # -- mapping ---------------------------------------------------------
@@ -243,6 +266,13 @@ class CrushMap:
             return True
         return (int(crush_hash32_2(x, item)) & 0xFFFF) >= w
 
+    def _bucket_weights(self, bucket: Bucket) -> list[int]:
+        if self._active_weights is not None:
+            override = self._active_weights.get(bucket.id)
+            if override is not None and len(override) == len(bucket.items):
+                return override
+        return bucket.weights
+
     def _bucket_choose(self, bucket: Bucket, x: int, r: int) -> int:
         if bucket.alg == "uniform":
             # uniform buckets: hash-pick ignoring weights
@@ -250,8 +280,83 @@ class CrushMap:
                 bucket.items
             )
             return bucket.items[idx]
-        draws = straw2_draws(x, bucket.items, bucket.weights, r)
+        if bucket.alg == "list":
+            return self._list_choose(bucket, x, r)
+        if bucket.alg == "tree":
+            return self._tree_choose(bucket, x, r)
+        draws = straw2_draws(x, bucket.items,
+                             self._bucket_weights(bucket), r)
         return bucket.items[int(np.argmax(draws))]
+
+    def _list_choose(self, bucket: Bucket, x: int, r: int) -> int:
+        """List bucket: sequential weighted draw from the most recently
+        added item (crush.h CRUSH_BUCKET_LIST; O(1) when adding at the
+        head, O(n) lookup).  For each item the draw succeeds with
+        probability item_weight / weight_of_remaining_suffix."""
+        weights = self._bucket_weights(bucket)
+        n = len(bucket.items)
+        prefix = [0] * n           # prefix[j] = sum(weights[:j+1])
+        acc = 0
+        for j in range(n):
+            acc += weights[j]
+            prefix[j] = acc
+        # iterate newest (tail) first; item j wins with probability
+        # weights[j] / weight(items[0..j]); j == 0 is the certain floor
+        for j in range(n - 1, -1, -1):
+            if prefix[j] <= 0:
+                continue
+            draw = int(crush_hash32_4(x, bucket.items[j], r, bucket.id))
+            draw &= 0xFFFF
+            if (draw * prefix[j]) >> 16 < weights[j]:
+                return bucket.items[j]
+        return bucket.items[0]
+
+    def _tree_heap(self, bucket: Bucket,
+                   weights: list[int]) -> tuple[list[int], int]:
+        """Implicit-heap subtree weights for a tree bucket, cached per
+        (bucket, weight vector) so a draw is O(log n), not O(n log n).
+        The cache key uses the weight list's identity + a content
+        fingerprint: bucket.weights mutates in place on add_item, and
+        choose_args vectors are distinct list objects."""
+        key = (bucket.id, id(weights), len(weights), sum(weights))
+        cached = self._tree_heap_cache.get(key)
+        if cached is not None:
+            return cached
+        n = len(bucket.items)
+        leaf_total = 1
+        while leaf_total < n:
+            leaf_total *= 2
+        first_leaf = leaf_total - 1
+        heap = [0] * (first_leaf + leaf_total)
+        for i in range(n):
+            heap[first_leaf + i] = weights[i]
+        for k in range(first_leaf - 1, -1, -1):
+            heap[k] = heap[2 * k + 1] + heap[2 * k + 2]
+        self._tree_heap_cache[key] = (heap, first_leaf)
+        if len(self._tree_heap_cache) > 4096:
+            self._tree_heap_cache.clear()
+        return heap, first_leaf
+
+    def _tree_choose(self, bucket: Bucket, x: int, r: int) -> int:
+        """Tree bucket: weighted binary descent over an implicit heap of
+        subtree weights (crush.h CRUSH_BUCKET_TREE; O(log n) draws).
+        Node k's children are 2k+1 / 2k+2 in the heap; leaves map to
+        items in order."""
+        weights = self._bucket_weights(bucket)
+        n = len(bucket.items)
+        if n == 1:
+            return bucket.items[0]
+        heap, first_leaf = self._tree_heap(bucket, weights)
+        k = 0
+        while k < first_leaf:
+            left, right = 2 * k + 1, 2 * k + 2
+            lw = heap[left]
+            total = lw + heap[right]
+            if total <= 0:
+                return bucket.items[0]
+            draw = int(crush_hash32_4(x, bucket.id, r, k)) & 0xFFFF
+            k = left if (draw * total) >> 16 < lw else right
+        return bucket.items[k - first_leaf]
 
     def _choose_firstn(
         self, bucket: Bucket, x: int, numrep: int, type_id: int,
@@ -445,14 +550,26 @@ class CrushMap:
         x: int,
         result_max: int,
         reweights: Sequence[int] | None = None,
+        choose_args: str | None = None,
     ) -> list[int]:
         """Evaluate a rule for input x (crush_do_rule, mapper.c:900).
 
         Returns up to result_max ids; indep rules pad holes with ITEM_NONE.
         ``reweights``: per-device 16.16 reweight vector for is_out.
+        ``choose_args``: name of a weight-set whose per-bucket weights
+        override the hierarchy weights during draws (CrushWrapper
+        choose_args); unknown names fall back to the real weights.
         """
         if isinstance(rule, str):
             rule = self.rules[rule]
+        self._active_weights = self.choose_args.get(choose_args or "")
+        try:
+            return self._do_rule_steps(rule, x, result_max, reweights)
+        finally:
+            self._active_weights = None
+
+    def _do_rule_steps(self, rule: Rule, x: int, result_max: int,
+                       reweights) -> list[int]:
         t = self.tunables
         tries = t.choose_total_tries + 1
         result: list[int] = []

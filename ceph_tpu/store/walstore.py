@@ -43,17 +43,26 @@ _WAL_MAGIC = b"ceph-tpu-wal-1\n"
 
 class WalStore(MemStore):
     def __init__(self, path: str, checkpoint_bytes: int = 16 << 20,
-                 sync: bool = False):
+                 sync: bool = False, native: bool | None = None):
         """``sync``: os.fsync every append (power-loss durability); off by
         default — process-crash durability (the DevCluster/test contract)
-        needs only the flush."""
+        needs only the flush.  ``native``: use the C++ wal engine
+        (wal_engine.cc) for the append/replay/checkpoint file tier; None
+        = auto (native when the .so builds).  Both tiers share one
+        on-disk format, so files migrate freely between them."""
         super().__init__()
         self.path = Path(path)
         self.wal_path = self.path / "wal.log"
         self.ckpt_path = self.path / "checkpoint.bin"
         self.checkpoint_bytes = checkpoint_bytes
         self.sync = sync
-        self._wal_file = None
+        if native is None:
+            from ceph_tpu.store import native_wal
+
+            native = native_wal.available()
+        self.native = bool(native)
+        self._wal_file = None          # python tier file handle
+        self._nwal = None              # native tier NativeWal handle
         self._commit_lock = asyncio.Lock()
 
     # -- mount / umount ---------------------------------------------------
@@ -61,25 +70,38 @@ class WalStore(MemStore):
         self.path.mkdir(parents=True, exist_ok=True)
         self._load_checkpoint()
         self._replay_wal()
-        self._wal_file = open(self.wal_path, "ab")
-        if self._wal_file.tell() == 0:
-            self._wal_file.write(_WAL_MAGIC)
-            self._wal_file.flush()
+        if self.native:
+            from ceph_tpu.store.native_wal import NativeWal
+
+            self._nwal = NativeWal(str(self.wal_path), self.sync)
+        else:
+            self._wal_file = open(self.wal_path, "ab")
+            if self._wal_file.tell() == 0:
+                self._wal_file.write(_WAL_MAGIC)
+                self._wal_file.flush()
+
+    @property
+    def _mounted(self) -> bool:
+        return self._wal_file is not None or self._nwal is not None
 
     async def umount(self) -> None:
         # under _commit_lock: a background task's in-flight commit must
         # not interleave with the checkpoint's snapshot + WAL reset
         async with self._commit_lock:
-            if self._wal_file is not None:
+            if self._mounted:
                 # clean shutdown: checkpoint so the next mount replays
                 # nothing
                 await asyncio.to_thread(self._write_checkpoint)
+            if self._wal_file is not None:
                 self._wal_file.close()
                 self._wal_file = None
+            if self._nwal is not None:
+                self._nwal.close()
+                self._nwal = None
 
     # -- commit path ------------------------------------------------------
     async def _commit(self, txns) -> None:
-        if self._wal_file is None:
+        if not self._mounted:
             raise RuntimeError("WalStore not mounted")
         if self.commit_delay:
             await asyncio.sleep(self.commit_delay)
@@ -87,25 +109,29 @@ class WalStore(MemStore):
             exc, self.fail_next = self.fail_next, None
             raise exc
         payload = encode([encode_tx(t) for t in txns])
-        frame = _FRAME.pack(len(payload), crc32c(0xFFFFFFFF, payload))
         async with self._commit_lock:
             # validate first: an invalid transaction must raise without
             # reaching the log (replay applies the log unconditionally)
             with self._lock:
                 self._validate(txns)
-            await asyncio.to_thread(self._append, frame + payload)
+            size = await asyncio.to_thread(self._append, payload)
             with self._lock:
                 for t in txns:
                     for op in t.ops:
                         self._apply(op)
-            if self._wal_file.tell() >= self.checkpoint_bytes:
+            if size >= self.checkpoint_bytes:
                 await asyncio.to_thread(self._write_checkpoint)
 
-    def _append(self, raw: bytes) -> None:
-        self._wal_file.write(raw)
+    def _append(self, payload: bytes) -> int:
+        """Framed append; returns WAL size after the write."""
+        if self._nwal is not None:
+            return self._nwal.append(payload)
+        frame = _FRAME.pack(len(payload), crc32c(0xFFFFFFFF, payload))
+        self._wal_file.write(frame + payload)
         self._wal_file.flush()
         if self.sync:
             os.fsync(self._wal_file.fileno())
+        return self._wal_file.tell()
 
     # -- checkpoint -------------------------------------------------------
     def _dump_state(self) -> bytes:
@@ -127,6 +153,12 @@ class WalStore(MemStore):
         Runs with _commit_lock held (caller) so no commit interleaves
         between snapshot and WAL reset."""
         blob = self._dump_state()
+        if self._nwal is not None:
+            from ceph_tpu.store import native_wal
+
+            native_wal.write_checkpoint(str(self.ckpt_path), blob)
+            self._nwal.reset()
+            return
         tmp = self.ckpt_path.with_suffix(".tmp")
         with open(tmp, "wb") as f:
             f.write(_CKPT_MAGIC)
@@ -144,18 +176,9 @@ class WalStore(MemStore):
             os.fsync(self._wal_file.fileno())
 
     def _load_checkpoint(self) -> None:
-        if not self.ckpt_path.exists():
+        blob = self._read_checkpoint_blob()
+        if blob is None:
             return
-        raw = self.ckpt_path.read_bytes()
-        if not raw.startswith(_CKPT_MAGIC):
-            return
-        body = raw[len(_CKPT_MAGIC):]
-        if len(body) < _FRAME.size:
-            return
-        length, crc = _FRAME.unpack_from(body)
-        blob = body[_FRAME.size:_FRAME.size + length]
-        if len(blob) != length or crc32c(0xFFFFFFFF, blob) != crc:
-            return                      # torn checkpoint: fall back to WAL
         with self._lock:
             self._colls.clear()
             self._objs.clear()
@@ -169,8 +192,67 @@ class WalStore(MemStore):
                     )
                     self._objs[oid.key()] = oid
 
+    def _read_checkpoint_blob(self) -> bytes | None:
+        if self.native:
+            from ceph_tpu.store import native_wal
+
+            return native_wal.read_checkpoint(str(self.ckpt_path))
+        if not self.ckpt_path.exists():
+            return None
+        raw = self.ckpt_path.read_bytes()
+        if not raw.startswith(_CKPT_MAGIC):
+            return None
+        body = raw[len(_CKPT_MAGIC):]
+        if len(body) < _FRAME.size:
+            return None
+        length, crc = _FRAME.unpack_from(body)
+        blob = body[_FRAME.size:_FRAME.size + length]
+        if len(blob) != length or crc32c(0xFFFFFFFF, blob) != crc:
+            return None                 # torn checkpoint: fall back to WAL
+        return blob
+
     # -- replay -----------------------------------------------------------
+    def _apply_payload(self, payload: bytes) -> bool:
+        """Decode + apply one WAL record; False stops the replay."""
+        try:
+            txns = [decode_tx(w) for w in decode(payload)]
+        except (ValueError, TypeError, KeyError, IndexError,
+                struct.error):
+            return False
+        with self._lock:
+            for t in txns:
+                for op in t.ops:
+                    try:
+                        self._apply(op)
+                    except (KeyError, ValueError):
+                        # an op the image rejects on replay (e.g. the
+                        # pre-crash validate allowed it against state
+                        # we no longer reconstruct identically) must
+                        # not abort recovery of later transactions
+                        pass
+        return True
+
     def _replay_wal(self) -> None:
+        if self.native:
+            from ceph_tpu.store import native_wal
+
+            # The engine validates frames and truncates any crc-torn
+            # tail.  A crc-valid but UNDECODABLE record must also end
+            # the log (the Python tier's truncate-at-good invariant):
+            # leaving it would poison every replay after future appends,
+            # silently losing all post-poison transactions on crash.
+            payloads = native_wal.replay(str(self.wal_path))
+            good = len(_WAL_MAGIC)
+            for payload in payloads:
+                if not self._apply_payload(payload):
+                    try:
+                        with open(self.wal_path, "r+b") as f:
+                            f.truncate(good)
+                    except OSError:
+                        pass
+                    break
+                good += _FRAME.size + len(payload)
+            return
         if not self.wal_path.exists():
             return
         raw = self.wal_path.read_bytes()
@@ -185,22 +267,8 @@ class WalStore(MemStore):
             payload = raw[start:end]
             if crc32c(0xFFFFFFFF, payload) != crc:
                 break
-            try:
-                txns = [decode_tx(w) for w in decode(payload)]
-            except (ValueError, TypeError, KeyError, IndexError,
-                    struct.error):
+            if not self._apply_payload(payload):
                 break
-            with self._lock:
-                for t in txns:
-                    for op in t.ops:
-                        try:
-                            self._apply(op)
-                        except (KeyError, ValueError):
-                            # an op the image rejects on replay (e.g. the
-                            # pre-crash validate allowed it against state
-                            # we no longer reconstruct identically) must
-                            # not abort recovery of later transactions
-                            pass
             good = end
             pos = end
         if good < len(raw):

@@ -134,12 +134,13 @@ def test_daemon_admin_sockets_live_cluster(tmp_path):
     asyncio.run(run())
 
 
-def test_dispatch_throttle_backpressures_flood():
-    """A tiny client-type throttle must stall a flood of big writes
-    without deadlocking or dropping them (reader backpressure)."""
+def test_client_throttle_backpressures_flood():
+    """A tiny op-lifetime client throttle must stall a flood of big
+    writes — concurrent ops queue on the budget and ALL still complete
+    (osd_client_message_size_cap semantics)."""
     async def run():
         cluster = DevCluster(n_mons=1, n_osds=2, overrides={
-            "ms_dispatch_throttle_bytes": 64 * 1024,
+            "osd_client_message_size_cap": 64 * 1024,
         })
         await cluster.start()
         try:
@@ -154,13 +155,17 @@ def test_dispatch_throttle_backpressures_flood():
             ))
             for i in range(12):
                 assert await ioctx.read(f"obj-{i}") == payload
-            # the throttle actually engaged somewhere (client-type msgs)
-            waited = any(
-                t["wait"] > 0 or t["get"] > 0
-                for osd in cluster.osds.values()
-                for t in osd.msgr.throttle_dump().values()
-            )
-            assert waited
+            # ops genuinely WAITED on the budget (not just accounted)
+            waited = sum(o.client_throttle.dump()["wait"]
+                         for o in cluster.osds.values())
+            held = sum(o.client_throttle.dump()["val"]
+                       for o in cluster.osds.values())
+            assert waited > 0
+            assert held == 0               # all budget returned
+            # messenger dispatch throttles exist + fully released too
+            for osd in cluster.osds.values():
+                for t in osd.msgr.throttle_dump().values():
+                    assert t["val"] == 0
             await rados.shutdown()
         finally:
             await cluster.stop()

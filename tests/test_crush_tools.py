@@ -1,0 +1,145 @@
+"""CRUSH completeness: list/tree buckets, compiler, tester, choose_args.
+
+Reference surfaces: crush.h bucket algs, src/crush/CrushCompiler.cc
+(crushtool -c/-d round trip), CrushTester.cc (--test utilization),
+CrushWrapper choose_args weight-sets.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.placement.compiler import (
+    CompileError,
+    compile_text,
+    decompile,
+)
+from ceph_tpu.placement.crush_map import ITEM_NONE, CrushMap, Rule
+from ceph_tpu.placement.tester import simulate
+
+
+def build_map(alg: str = "straw2", n_hosts: int = 4,
+              osds_per_host: int = 2) -> CrushMap:
+    m = CrushMap()
+    root = m.add_bucket("default", "root", alg)
+    dev = 0
+    for h in range(n_hosts):
+        hb = m.add_bucket(f"host{h}", "host", alg)
+        for _ in range(osds_per_host):
+            m.add_item(hb, dev)
+            dev += 1
+        m.add_item(root, hb)
+    m.create_replicated_rule("data", failure_domain="host")
+    return m
+
+
+@pytest.mark.parametrize("alg", ["straw2", "list", "tree", "uniform"])
+def test_bucket_algs_place_and_spread(alg):
+    m = build_map(alg)
+    counts = {}
+    for x in range(2000):
+        row = m.do_rule("data", x, 3)
+        assert len(row) == 3
+        assert len(set(row)) == 3           # distinct osds
+        hosts = {o // 2 for o in row}
+        assert len(hosts) == 3              # distinct failure domains
+        for o in row:
+            counts[o] = counts.get(o, 0) + 1
+    # every device sees traffic; equal weights -> roughly even spread
+    assert sorted(counts) == list(range(8))
+    vals = np.array(list(counts.values()), float)
+    assert vals.std() / vals.mean() < 0.35, counts
+
+
+@pytest.mark.parametrize("alg", ["straw2", "list", "tree"])
+def test_bucket_weight_skew(alg):
+    """A double-weight device should draw ~2x the placements."""
+    m = CrushMap()
+    root = m.add_bucket("default", "root", alg)
+    m.add_item(root, 0, 1.0)
+    m.add_item(root, 1, 2.0)
+    m.add_item(root, 2, 1.0)
+    m.add_rule(Rule("pick1", [("take", "default"),
+                              ("choose_firstn", 1, "osd"), ("emit",)]))
+    counts = {0: 0, 1: 0, 2: 0}
+    for x in range(4000):
+        counts[m.do_rule("pick1", x, 1)[0]] += 1
+    ratio = counts[1] / max(counts[0] + counts[2], 1)
+    assert 0.7 < ratio < 1.4, counts       # ~1.0: osd.1 == half the weight
+
+
+def test_compiler_round_trip():
+    m = build_map("straw2")
+    m.buckets[m.names["host0"]].alg = "list"
+    m.buckets[m.names["host1"]].alg = "tree"
+    ec = m.create_ec_rule("ecrule", 6, failure_domain="osd")
+    m.choose_args["balanced"] = {
+        m.names["default"]: [0x18000, 0x10000, 0x10000, 0x8000],
+    }
+    text = decompile(m)
+    m2 = compile_text(text)
+    # identical placement behavior is the real round-trip oracle
+    for rule in ("data", "ecrule"):
+        rep = 3 if rule == "data" else 6
+        for x in range(500):
+            assert m.do_rule(rule, x, rep) == m2.do_rule(rule, x, rep)
+    for x in range(200):
+        assert m.do_rule("data", x, 3, choose_args="balanced") == \
+            m2.do_rule("data", x, 3, choose_args="balanced")
+    # and the text is stable under a second round trip
+    assert decompile(m2) == text
+
+
+def test_compiler_rejects_garbage():
+    with pytest.raises(CompileError):
+        compile_text("bogus line\n")
+    with pytest.raises(CompileError):
+        compile_text("host h1 {\n id -2\n")       # unterminated
+    with pytest.raises(CompileError):
+        compile_text(
+            "type 0 osd\ntype 1 root\nroot default {\n"
+            "  id -1\n  alg straw9\n}\n"
+        )
+
+
+def test_choose_args_skews_placement():
+    m = build_map("straw2", n_hosts=2, osds_per_host=1)
+    root_id = m.names["default"]
+    # all weight on host1's subtree in the weight-set
+    m.choose_args["drain0"] = {root_id: [0, 0x10000]}
+    base = [m.do_rule("data", x, 1)[0] for x in range(300)]
+    skew = [m.do_rule("data", x, 1, choose_args="drain0")[0]
+            for x in range(300)]
+    assert set(base) == {0, 1}
+    assert set(skew) == {1}                 # host0 fully drained
+    # unknown weight-set name falls back to the real weights
+    assert [m.do_rule("data", x, 1, choose_args="nope")[0]
+            for x in range(300)] == base
+
+
+def test_tester_report():
+    m = build_map("straw2")
+    report = simulate(m, "data", 3, 0, 2000)
+    assert report["bad_mappings"] == 0
+    assert report["placed"] == 6000
+    assert len(report["devices"]) == 8
+    for dev in report["devices"].values():
+        assert abs(dev["deviation"]) < dev["expected"] * 0.5
+    # EC rule with indep holes: undersized cluster -> bad mappings count
+    tiny = CrushMap()
+    root = tiny.add_bucket("default", "root")
+    tiny.add_item(root, 0)
+    tiny.add_item(root, 1)
+    tiny.create_ec_rule("ec", 4, failure_domain="osd")
+    rep = simulate(tiny, "ec", 4, 0, 50)
+    assert rep["bad_mappings"] == 50
+
+
+def test_tester_cli(tmp_path):
+    from ceph_tpu.placement import tester
+
+    m = build_map()
+    path = tmp_path / "map.txt"
+    path.write_text(decompile(m))
+    rc = tester.main(["--map", str(path), "--rule", "data",
+                      "--num-rep", "3", "--max-x", "200"])
+    assert rc == 0
