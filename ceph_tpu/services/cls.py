@@ -243,9 +243,72 @@ def _register_builtins(reg: ClassRegistry) -> None:
     def rbd_snap_rm(ctx: ClsContext, indata: bytes) -> bytes:
         args = _j(indata)
         h = _header(ctx)
-        if args["name"] not in h["snaps"]:
+        info = h["snaps"].get(args["name"])
+        if info is None:
             raise ClsError(ENOENT_RC, "no such snap")
+        if info.get("protected"):
+            # reference cls_rbd refuses to remove a protected snap
+            raise ClsError(EBUSY_RC, "snap is protected")
         del h["snaps"][args["name"]]
+        ctx.setxattr("rbd.header", json.dumps(h).encode())
+        return b""
+
+    def rbd_snap_protect(ctx: ClsContext, indata: bytes) -> bytes:
+        args = _j(indata)
+        h = _header(ctx)
+        info = h["snaps"].get(args["name"])
+        if info is None:
+            raise ClsError(ENOENT_RC, "no such snap")
+        info["protected"] = True
+        ctx.setxattr("rbd.header", json.dumps(h).encode())
+        return b""
+
+    def rbd_snap_unprotect(ctx: ClsContext, indata: bytes) -> bytes:
+        args = _j(indata)
+        h = _header(ctx)
+        info = h["snaps"].get(args["name"])
+        if info is None:
+            raise ClsError(ENOENT_RC, "no such snap")
+        info["protected"] = False
+        ctx.setxattr("rbd.header", json.dumps(h).encode())
+        return b""
+
+    def rbd_set_parent(ctx: ClsContext, indata: bytes) -> bytes:
+        """Record the clone's parent link (cls_rbd set_parent):
+        {pool, image_id, snap_id, snap_name, overlap}."""
+        args = _j(indata)
+        h = _header(ctx)
+        if h.get("parent"):
+            raise ClsError(EEXIST_RC, "parent already set")
+        h["parent"] = {
+            "pool": str(args["pool"]),
+            "image_id": str(args["image_id"]),
+            "snap_id": int(args["snap_id"]),
+            "snap_name": str(args.get("snap_name", "")),
+            "overlap": int(args["overlap"]),
+        }
+        ctx.setxattr("rbd.header", json.dumps(h).encode())
+        return b""
+
+    def rbd_set_parent_overlap(ctx: ClsContext, indata: bytes) -> bytes:
+        """Clip the parent overlap (cls_rbd set_parent overlap update on
+        shrink); only downward — growing back must not resurrect
+        truncated parent data."""
+        args = _j(indata)
+        h = _header(ctx)
+        if not h.get("parent"):
+            raise ClsError(ENOENT_RC, "no parent")
+        new = int(args["overlap"])
+        if new < int(h["parent"]["overlap"]):
+            h["parent"]["overlap"] = new
+            ctx.setxattr("rbd.header", json.dumps(h).encode())
+        return b""
+
+    def rbd_remove_parent(ctx: ClsContext, indata: bytes) -> bytes:
+        h = _header(ctx)
+        if not h.get("parent"):
+            raise ClsError(ENOENT_RC, "no parent")
+        h["parent"] = None
         ctx.setxattr("rbd.header", json.dumps(h).encode())
         return b""
 
@@ -301,6 +364,11 @@ def _register_builtins(reg: ClassRegistry) -> None:
     reg.register("rbd", "set_size", rbd_set_size)
     reg.register("rbd", "snap_add", rbd_snap_add)
     reg.register("rbd", "snap_rm", rbd_snap_rm)
+    reg.register("rbd", "snap_protect", rbd_snap_protect)
+    reg.register("rbd", "snap_unprotect", rbd_snap_unprotect)
+    reg.register("rbd", "set_parent", rbd_set_parent)
+    reg.register("rbd", "set_parent_overlap", rbd_set_parent_overlap)
+    reg.register("rbd", "remove_parent", rbd_remove_parent)
     reg.register("rgw", "log_add", rgw_log_add)
     reg.register("rgw", "log_list", rgw_log_list)
     reg.register("rgw", "log_trim", rgw_log_trim)

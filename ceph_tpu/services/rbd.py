@@ -6,9 +6,19 @@ image metadata (managed here by the ``rbd`` object class, the cls_rbd
 role), ``rbd_directory`` lists images, and data lives in
 ``rbd_data.<id>.<objectno:%016x>`` objects of ``2^order`` bytes. IO maps
 block extents onto data objects (the io/ImageRequest -> ObjectRequest
-pipeline collapsed to direct extent math). Snapshots are tracked in the
-header (create/list/remove); object-level COW clones are not implemented
-in this round.
+pipeline collapsed to direct extent math).
+
+Round-2 feature depth:
+- snapshot-based COW clones (librbd clone/flatten, cls_rbd parent
+  links): a child reads through to its protected parent snap for
+  unwritten extents (clipped to the overlap) and copies the parent
+  block up on first write (the io/CopyupRequest role); ``rbd_children``
+  tracks clones so unprotect refuses while children exist.
+- object map (src/librbd/ObjectMap.h): a per-image existence bitmap in
+  ``rbd_object_map.<id>``; reads skip the OSD round-trip for known-
+  absent objects, rebuildable by scanning.
+- optional write-back cache (client/object_cacher.py, the osdc
+  ObjectCacher role) layered ABOVE copyup/object-map dispatch.
 """
 
 from __future__ import annotations
@@ -19,6 +29,7 @@ import secrets
 from ceph_tpu.client.rados import IoCtx, ObjectOperation, RadosError
 
 DIRECTORY_OID = "rbd_directory"
+CHILDREN_OID = "rbd_children"
 DEFAULT_ORDER = 22          # 4 MiB objects
 
 
@@ -33,7 +44,8 @@ class RBD:
         self.ioctx = ioctx
 
     async def create(self, name: str, size: int,
-                     order: int = DEFAULT_ORDER) -> None:
+                     order: int = DEFAULT_ORDER,
+                     object_map: bool = True) -> str:
         if not 12 <= order <= 26:
             raise RBDError(f"order {order} out of range")
         image_id = secrets.token_hex(8)
@@ -54,9 +66,60 @@ class RBD:
                 "object_prefix": f"rbd_data.{image_id}",
             }).encode(),
         )
+        if object_map:
+            nbits = -(-size // (1 << order))
+            await self.ioctx.operate(
+                f"rbd_object_map.{image_id}",
+                ObjectOperation().write_full(bytes(-(-nbits // 8))),
+            )
         await self.ioctx.operate(DIRECTORY_OID, ObjectOperation()
                                  .create()
                                  .omap_set({name: image_id.encode()}))
+        return image_id
+
+    async def clone(self, parent_name: str, snap_name: str,
+                    child_name: str, object_map: bool = True) -> None:
+        """Snapshot-based COW clone (librbd rbd_clone): the child starts
+        as a read-through view of parent@snap and diverges on write."""
+        parent = await self.open(parent_name)
+        info = parent.snaps.get(snap_name)
+        if info is None:
+            raise RBDError(f"no snap {snap_name!r}")
+        if not info.get("protected"):
+            raise RBDError(
+                f"snap {snap_name!r} must be protected before cloning"
+            )
+        child_id = await self.create(
+            child_name, int(info["size"]), parent.order,
+            object_map=object_map,
+        )
+        await self.ioctx.exec(
+            f"rbd_header.{child_id}", "rbd", "set_parent",
+            json.dumps({
+                "pool": self.ioctx.pool_name,
+                "image_id": parent.image_id,
+                "snap_id": int(info["id"]),
+                "snap_name": snap_name,
+                "overlap": int(info["size"]),
+            }).encode(),
+        )
+        await self.ioctx.operate(CHILDREN_OID, ObjectOperation()
+                                 .create().omap_set({
+                                     _child_key(parent.image_id,
+                                                int(info["id"]),
+                                                child_id):
+                                     child_name.encode(),
+                                 }))
+
+    async def children(self, parent_name: str,
+                       snap_name: str) -> list[str]:
+        """Clone names hanging off parent@snap (rbd children)."""
+        parent = await self.open(parent_name)
+        info = parent.snaps.get(snap_name)
+        if info is None:
+            raise RBDError(f"no snap {snap_name!r}")
+        return await _children_of(self.ioctx, parent.image_id,
+                                  int(info["id"]))
 
     async def list(self) -> list[str]:
         try:
@@ -68,17 +131,37 @@ class RBD:
 
     async def remove(self, name: str) -> None:
         img = await self.open(name)
+        if img.snaps:
+            raise RBDError(
+                f"image {name!r} has snapshots; remove them first"
+            )
         data_objs = [
             o for o in await self.ioctx.list_objects()
             if o.startswith(img.object_prefix + ".")
         ]
         for oid in data_objs:
             await self.ioctx.remove(oid)
+        if img.parent is not None:
+            # unlink from the parent's child registry
+            try:
+                await self.ioctx.rm_omap_keys(CHILDREN_OID, [
+                    _child_key(img.parent["image_id"],
+                               int(img.parent["snap_id"]),
+                               img.image_id),
+                ])
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+        try:
+            await self.ioctx.remove(f"rbd_object_map.{img.image_id}")
+        except RadosError as e:
+            if e.rc != -2:
+                raise
         await self.ioctx.remove(f"rbd_header.{img.image_id}")
         await self.ioctx.remove(f"rbd_id.{name}")
         await self.ioctx.rm_omap_keys(DIRECTORY_OID, [name])
 
-    async def open(self, name: str) -> "Image":
+    async def open(self, name: str, cache: bool = False) -> "Image":
         try:
             image_id = (await self.ioctx.get_xattr(
                 f"rbd_id.{name}", "id"
@@ -87,15 +170,34 @@ class RBD:
             if e.rc == -2:
                 raise RBDError(f"no image {name!r}") from e
             raise
-        img = Image(self.ioctx, name, image_id)
+        img = Image(self.ioctx, name, image_id, cache=cache)
         await img.refresh()
         return img
+
+
+def _child_key(parent_id: str, snap_id: int, child_id: str) -> str:
+    return f"{parent_id}@{snap_id}/{child_id}"
+
+
+async def _children_of(ioctx: IoCtx, parent_id: str,
+                       snap_id: int) -> list[str]:
+    """Clone names registered under parent@snap in rbd_children."""
+    prefix = _child_key(parent_id, snap_id, "")
+    try:
+        omap = await ioctx.get_omap(CHILDREN_OID)
+    except RadosError as e:
+        if e.rc == -2:
+            return []
+        raise
+    return sorted(v.decode() for k, v in omap.items()
+                  if k.startswith(prefix))
 
 
 class Image:
     """An open image handle (librbd rbd_image_t)."""
 
-    def __init__(self, ioctx: IoCtx, name: str, image_id: str):
+    def __init__(self, ioctx: IoCtx, name: str, image_id: str,
+                 cache: bool = False):
         # a PRIVATE io context: the image's snap context (set at refresh)
         # must not clobber the caller's ioctx or other open images
         # (librbd likewise keeps per-image state in ImageCtx)
@@ -106,6 +208,21 @@ class Image:
         self.order = DEFAULT_ORDER
         self.object_prefix = f"rbd_data.{image_id}"
         self.snaps: dict[str, dict] = {}
+        self.parent: dict | None = None
+        self._parent_img: "Image | None" = None
+        self._om: bytearray | None = None      # object map bitmap
+        # The map's ABSENT answer is only trustworthy for the handle
+        # that maintains it (the reference gates the object map behind
+        # the exclusive lock; a non-owner's copy can go stale the moment
+        # another client writes).  A handle becomes authoritative once
+        # it mutates the map itself (write/rebuild).
+        self._om_auth = False
+        self._cache = None
+        if cache:
+            from ceph_tpu.client.object_cacher import ObjectCacher
+
+            self._cache = ObjectCacher(self._cache_fetch,
+                                       self._cache_writeback)
 
     @property
     def header_oid(self) -> str:
@@ -123,11 +240,96 @@ class Image:
         self.order = h["order"]
         self.object_prefix = h["object_prefix"]
         self.snaps = h["snaps"]
+        self.parent = h.get("parent") or None
+        self._parent_img = None
         # image writes carry the image's snap context so data objects
         # COW-clone on the first write after each snapshot
         ids = sorted(int(i["id"]) for i in self.snaps.values())
         if ids:
             self.ioctx.set_snap_context(max(ids), ids)
+        try:
+            self._om = bytearray(await self.ioctx.read(self._om_oid))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            self._om = None         # object-map feature off
+
+    async def close(self) -> None:
+        if self._cache is not None:
+            await self._cache.flush()
+
+    # -- object map (src/librbd/ObjectMap.h bitmap) -----------------------
+    @property
+    def _om_oid(self) -> str:
+        return f"rbd_object_map.{self.image_id}"
+
+    def _om_test(self, objectno: int) -> bool:
+        """True = object may exist; False = definitely absent."""
+        if self._om is None:
+            return True
+        byte = objectno >> 3
+        if byte >= len(self._om):
+            return False
+        return bool(self._om[byte] & (1 << (objectno & 7)))
+
+    async def _om_set(self, objectno: int) -> None:
+        if self._om is None:
+            return
+        self._om_auth = True
+        if self._om_test(objectno):
+            return
+        byte = objectno >> 3
+        if byte >= len(self._om):
+            self._om.extend(bytes(byte + 1 - len(self._om)))
+        self._om[byte] |= 1 << (objectno & 7)
+        # persisted BEFORE the data write lands (may-exist is safe;
+        # definitely-absent with data present would corrupt reads)
+        await self.ioctx.operate(
+            self._om_oid, ObjectOperation().write_full(bytes(self._om))
+        )
+
+    async def object_map_rebuild(self) -> None:
+        """Rescan data objects into a fresh bitmap (rbd object-map
+        rebuild)."""
+        nobjs = -(-self.size // self.obj_size)
+        om = bytearray(-(-nobjs // 8) or 1)
+        for objectno in range(nobjs):
+            try:
+                await self.ioctx.stat(self._data_oid(objectno))
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+                continue
+            om[objectno >> 3] |= 1 << (objectno & 7)
+        self._om = om
+        self._om_auth = True
+        await self.ioctx.operate(
+            self._om_oid, ObjectOperation().write_full(bytes(om))
+        )
+
+    # -- parent COW (librbd clone read-through + CopyupRequest) -----------
+    async def _parent_image(self) -> "Image | None":
+        if self.parent is None:
+            return None
+        if self._parent_img is None:
+            pool = self.parent["pool"]
+            pio = (self.ioctx if pool == self.ioctx.pool_name
+                   else await self.ioctx.rados.open_ioctx(pool))
+            img = Image(pio, "", self.parent["image_id"])
+            await img.refresh()
+            self._parent_img = img
+        return self._parent_img
+
+    async def _parent_range(self, img_off: int, want: int) -> bytes:
+        """Parent bytes for [img_off, img_off+want), clipped to the
+        overlap; shorter/empty result means zeros."""
+        if self.parent is None or img_off >= self.parent["overlap"]:
+            return b""
+        want = min(want, self.parent["overlap"] - img_off)
+        parent = await self._parent_image()
+        return await parent._read_extents(
+            img_off, want, snapid=int(self.parent["snap_id"])
+        )
 
     def stat(self) -> dict:
         return {
@@ -150,34 +352,158 @@ class Image:
             yield objectno, obj_off, run
             pos += run
 
+    # -- object IO dispatch (the io/ObjectRequest layer: object map ->
+    # parent COW -> OSD; the optional cache sits above all of it) ---------
+    async def _obj_exists(self, objectno: int) -> bool:
+        if self._om is not None and self._om_auth:
+            return self._om_test(objectno)
+        try:
+            await self.ioctx.stat(self._data_oid(objectno))
+            return True
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            return False
+
+    async def _obj_read_direct(self, objectno: int, obj_off: int,
+                               run: int, snapid: int | None = None
+                               ) -> bytes:
+        """One object's bytes with parent fallback; short = zeros."""
+        frag = None
+        if snapid is None and self._om is not None and self._om_auth \
+                and not self._om_test(objectno):
+            frag = b""              # known-absent: skip the round trip
+        else:
+            if snapid is not None:
+                self.ioctx.snap_set_read(snapid)
+            try:
+                frag = await self.ioctx.read(
+                    self._data_oid(objectno), run, obj_off
+                )
+                return frag
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+                frag = b""
+            finally:
+                if snapid is not None:
+                    self.ioctx.snap_set_read(None)
+        # absent from this image: a clone reads through to the parent
+        if self.parent is not None:
+            return await self._parent_range(
+                objectno * self.obj_size + obj_off, run
+            )
+        return frag
+
+    async def _obj_write(self, objectno: int, obj_off: int,
+                         data: bytes) -> None:
+        oid = self._data_oid(objectno)
+        if self.parent is not None and \
+                not await self._obj_exists(objectno):
+            # copyup (io/CopyupRequest): materialize the parent block in
+            # the child before the first write so reads never see a
+            # half-diverged object
+            base = bytearray(
+                await self._parent_range(objectno * self.obj_size,
+                                         self.obj_size)
+            )
+            end = obj_off + len(data)
+            if len(base) < end:
+                base.extend(bytes(end - len(base)))
+            base[obj_off:end] = data
+            await self._om_set(objectno)
+            await self.ioctx.operate(
+                oid, ObjectOperation().write_full(bytes(base))
+            )
+            return
+        await self._om_set(objectno)
+        await self.ioctx.write(oid, data, obj_off)
+
+    # cache plumbing: fetch/writeback close over the dispatch above
+    async def _cache_fetch(self, objectno: int) -> bytes:
+        return await self._obj_read_direct(objectno, 0, self.obj_size)
+
+    async def _cache_writeback(self, objectno: int,
+                               data: bytes) -> None:
+        await self._om_set(objectno)
+        await self.ioctx.operate(
+            self._data_oid(objectno),
+            ObjectOperation().write_full(data),
+        )
+
+    async def _read_extents(self, offset: int, length: int,
+                            snapid: int | None = None) -> bytes:
+        out = bytearray(length)
+        pos = 0
+        for objectno, obj_off, run in self._extents(offset, length):
+            if snapid is None and self._cache is not None:
+                frag = await self._cache.read(objectno, obj_off, run)
+            else:
+                frag = await self._obj_read_direct(objectno, obj_off,
+                                                   run, snapid)
+            out[pos:pos + len(frag)] = frag
+            pos += run
+        return bytes(out)
+
     async def write(self, offset: int, data: bytes) -> None:
         if offset + len(data) > self.size:
             raise RBDError("write past end of image")
         pos = 0
         for objectno, obj_off, run in self._extents(offset, len(data)):
-            await self.ioctx.write(
-                self._data_oid(objectno), data[pos:pos + run], obj_off
-            )
+            chunk = data[pos:pos + run]
+            if self._cache is not None:
+                await self._cache.write(objectno, obj_off, chunk)
+            else:
+                await self._obj_write(objectno, obj_off, chunk)
             pos += run
 
     async def read(self, offset: int, length: int) -> bytes:
         length = max(0, min(length, self.size - offset))
-        out = bytearray(length)
-        pos = 0
-        for objectno, obj_off, run in self._extents(offset, length):
-            try:
-                frag = await self.ioctx.read(
-                    self._data_oid(objectno), run, obj_off
-                )
-            except RadosError as e:
-                if e.rc != -2:
-                    raise
-                frag = b""          # unwritten object: zeros
-            out[pos:pos + len(frag)] = frag
-            pos += run
-        return bytes(out)
+        return await self._read_extents(offset, length)
+
+    async def flush(self) -> None:
+        if self._cache is not None:
+            await self._cache.flush()
+
+    async def flatten(self) -> None:
+        """Copy every still-inherited parent block into the child and
+        sever the parent link (librbd flatten)."""
+        if self.parent is None:
+            raise RBDError("image has no parent")
+        if self._cache is not None:
+            await self._cache.flush()
+        nobjs = -(-self.size // self.obj_size)
+        for objectno in range(nobjs):
+            if await self._obj_exists(objectno):
+                continue
+            block = await self._parent_range(
+                objectno * self.obj_size, self.obj_size
+            )
+            if not block.rstrip(b"\x00"):
+                continue            # all-zero: absent reads the same
+            await self._om_set(objectno)
+            await self.ioctx.operate(
+                self._data_oid(objectno),
+                ObjectOperation().write_full(block),
+            )
+        await self.ioctx.exec(self.header_oid, "rbd", "remove_parent",
+                              b"{}")
+        try:
+            await self.ioctx.rm_omap_keys(CHILDREN_OID, [
+                _child_key(self.parent["image_id"],
+                           int(self.parent["snap_id"]), self.image_id),
+            ])
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+        self.parent = None
+        self._parent_img = None
+        # cached blocks that hold parent-fallback data remain
+        # byte-correct after the flatten copied those bytes up
 
     async def resize(self, new_size: int) -> None:
+        if self._cache is not None:
+            await self._cache.flush()
         await self.ioctx.exec(
             self.header_oid, "rbd", "set_size",
             json.dumps({"size": new_size}).encode(),
@@ -191,6 +517,11 @@ class Image:
                 except RadosError as e:
                     if e.rc != -2:
                         raise
+                if self._om is not None \
+                        and objectno >> 3 < len(self._om):
+                    self._om[objectno >> 3] &= ~(1 << (objectno & 7))
+                if self._cache is not None:
+                    await self._cache.discard(objectno)
             boundary = new_size % self.obj_size
             if boundary:
                 try:
@@ -200,11 +531,31 @@ class Image:
                 except RadosError as e:
                     if e.rc != -2:
                         raise
+                if self._cache is not None:
+                    await self._cache.discard(new_size // self.obj_size)
+            if self._om is not None:
+                await self.ioctx.operate(
+                    self._om_oid,
+                    ObjectOperation().write_full(bytes(self._om)),
+                )
+            # a shrunk clone inherits less of its parent — persisted,
+            # or a reopen/regrow would resurrect truncated parent data
+            if self.parent is not None \
+                    and self.parent["overlap"] > new_size:
+                await self.ioctx.exec(
+                    self.header_oid, "rbd", "set_parent_overlap",
+                    json.dumps({"overlap": new_size}).encode(),
+                )
+                self.parent["overlap"] = new_size
         self.size = new_size
 
     # -- snapshots (self-managed snaps + object COW clones; the librbd
     # snap_create/snap_rollback model over the OSD snapshot machinery) --
     async def snap_create(self, snap_name: str) -> int:
+        if self._cache is not None:
+            # the snapshot must capture every acked write (librbd
+            # flushes its cache before snap_create)
+            await self._cache.flush()
         snapid = await self.ioctx.selfmanaged_snap_create()
         await self.ioctx.exec(
             self.header_oid, "rbd", "snap_add",
@@ -212,6 +563,34 @@ class Image:
         )
         await self.refresh()
         return snapid
+
+    async def snap_protect(self, snap_name: str) -> None:
+        """Required before cloning (librbd snap_protect)."""
+        if snap_name not in self.snaps:
+            raise RBDError(f"no snap {snap_name!r}")
+        await self.ioctx.exec(
+            self.header_oid, "rbd", "snap_protect",
+            json.dumps({"name": snap_name}).encode(),
+        )
+        await self.refresh()
+
+    async def snap_unprotect(self, snap_name: str) -> None:
+        """Refuses while clones exist (the reference walks every pool's
+        rbd_children; ours is pool-local)."""
+        info = self.snaps.get(snap_name)
+        if info is None:
+            raise RBDError(f"no snap {snap_name!r}")
+        kids = await _children_of(self.ioctx, self.image_id,
+                                  int(info["id"]))
+        if kids:
+            raise RBDError(
+                f"snap {snap_name!r} has children: {kids}"
+            )
+        await self.ioctx.exec(
+            self.header_oid, "rbd", "snap_unprotect",
+            json.dumps({"name": snap_name}).encode(),
+        )
+        await self.refresh()
 
     async def snap_remove(self, snap_name: str) -> None:
         info = self.snaps.get(snap_name)
@@ -232,30 +611,18 @@ class Image:
 
     async def read_at_snap(self, snap_name: str, offset: int,
                            length: int) -> bytes:
-        """Read the image as of a snapshot (librbd snap_set + read)."""
+        """Read the image as of a snapshot (librbd snap_set + read).
+        Clone objects not yet copied up at snap time read through to
+        the parent, like head reads."""
         info = self.snaps.get(snap_name)
         if info is None:
             raise RBDError(f"no snap {snap_name!r}")
+        if self._cache is not None:
+            await self._cache.flush()
         snap_size = int(info["size"])
         length = max(0, min(length, snap_size - offset))
-        out = bytearray(length)
-        self.ioctx.snap_set_read(int(info["id"]))
-        try:
-            pos = 0
-            for objectno, obj_off, run in self._extents(offset, length):
-                try:
-                    frag = await self.ioctx.read(
-                        self._data_oid(objectno), run, obj_off
-                    )
-                except RadosError as e:
-                    if e.rc != -2:
-                        raise
-                    frag = b""
-                out[pos:pos + len(frag)] = frag
-                pos += run
-        finally:
-            self.ioctx.snap_set_read(None)
-        return bytes(out)
+        return await self._read_extents(offset, length,
+                                        snapid=int(info["id"]))
 
     async def snap_rollback(self, snap_name: str) -> None:
         """Restore the head image to a snapshot's content (librbd
@@ -272,7 +639,10 @@ class Image:
             frag = await self.read_at_snap(
                 snap_name, objectno * self.obj_size, want
             )
+            await self._om_set(objectno)
             await self.ioctx.operate(
                 self._data_oid(objectno),
                 ObjectOperation().write_full(frag),
             )
+            if self._cache is not None:
+                await self._cache.discard(objectno)

@@ -23,6 +23,113 @@ BUCKETS_OID = "rgw.buckets"          # omap: bucket name -> meta
 STRIPE_THRESHOLD = 4 * 1024 * 1024
 
 
+USERS_OID = "rgw.users"              # omap: uid -> user record json
+KEYS_OID = "rgw.users.keys"          # omap: access key -> uid
+
+_PERM_ORDER = {"READ": 0, "WRITE": 1, "FULL_CONTROL": 2}
+_CANNED_ACLS = ("private", "public-read", "public-read-write",
+                "authenticated-read")
+ANONYMOUS = "anonymous"
+
+
+class RGWUsers:
+    """User database + S3-style key auth (the rgw_user / RGWUserCtl
+    role, reference src/rgw/rgw_user.cc + radosgw-admin user ops):
+    records in one omap object, an access-key index for login, per-user
+    quota fields, and an HMAC check standing in for SigV4."""
+
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+
+    async def create(self, uid: str, display_name: str = "",
+                     max_size: int = 0, max_objects: int = 0) -> dict:
+        import secrets as _secrets
+
+        existing = await self._all()
+        if uid in existing:
+            raise RGWError("UserAlreadyExists", uid)
+        rec = {
+            "uid": uid, "display_name": display_name or uid,
+            "access_key": _secrets.token_hex(10).upper(),
+            "secret_key": _secrets.token_hex(20),
+            "quota": {"max_size": int(max_size),
+                      "max_objects": int(max_objects)},
+            "suspended": False,
+        }
+        await self.ioctx.operate(USERS_OID, ObjectOperation()
+                                 .create()
+                                 .omap_set({uid: json.dumps(rec)
+                                            .encode()}))
+        await self.ioctx.operate(KEYS_OID, ObjectOperation()
+                                 .create()
+                                 .omap_set({rec["access_key"]:
+                                            uid.encode()}))
+        return rec
+
+    async def _all(self) -> dict[str, dict]:
+        try:
+            return {
+                uid: json.loads(raw) for uid, raw in
+                (await self.ioctx.get_omap(USERS_OID)).items()
+            }
+        except RadosError as e:
+            if e.rc == -2:
+                return {}
+            raise
+
+    async def get(self, uid: str) -> dict:
+        try:
+            kv = await self.ioctx.get_omap(USERS_OID, [uid])
+        except RadosError as e:
+            if e.rc == -2:
+                kv = {}
+            else:
+                raise
+        if uid not in kv:
+            raise RGWError("NoSuchUser", uid)
+        return json.loads(kv[uid])
+
+    async def list(self) -> list[str]:
+        return sorted(await self._all())
+
+    async def remove(self, uid: str) -> None:
+        rec = await self.get(uid)
+        await self.ioctx.rm_omap_keys(USERS_OID, [uid])
+        await self.ioctx.rm_omap_keys(KEYS_OID, [rec["access_key"]])
+
+    async def set_quota(self, uid: str, max_size: int = 0,
+                        max_objects: int = 0) -> None:
+        rec = await self.get(uid)
+        rec["quota"] = {"max_size": int(max_size),
+                        "max_objects": int(max_objects)}
+        await self.ioctx.set_omap(USERS_OID,
+                                  {uid: json.dumps(rec).encode()})
+
+    async def authenticate(self, access_key: str, signature: str,
+                           string_to_sign: bytes) -> str:
+        """hmac-sha256(secret, string_to_sign) == signature -> uid
+        (the SigV4 role collapsed to one hmac)."""
+        import hmac as _hmac
+
+        try:
+            kv = await self.ioctx.get_omap(KEYS_OID, [access_key])
+        except RadosError as e:
+            if e.rc == -2:
+                kv = {}
+            else:
+                raise
+        if access_key not in kv:
+            raise RGWError("InvalidAccessKeyId", access_key)
+        rec = await self.get(kv[access_key].decode())
+        want = _hmac.new(rec["secret_key"].encode(), string_to_sign,
+                         hashlib.sha256).hexdigest()
+        if not _hmac.compare_digest(want, signature):
+            raise RGWError("SignatureDoesNotMatch", access_key)
+        if rec.get("suspended"):
+            raise RGWError("AccessDenied", "user suspended")
+        return rec["uid"]
+
+
 class RGWError(IOError):
     def __init__(self, code: str, msg: str = ""):
         super().__init__(f"{code}: {msg}")
@@ -30,15 +137,210 @@ class RGWError(IOError):
 
 
 class RGWLite:
-    def __init__(self, ioctx: IoCtx, datalog: bool = True):
+    def __init__(self, ioctx: IoCtx, datalog: bool = True,
+                 user: str | None = None,
+                 users: "RGWUsers | None" = None):
         """``datalog``: append every mutation to the per-bucket data log
-        (the cls_rgw bilog) so a multisite sync agent can tail it."""
+        (the cls_rgw bilog) so a multisite sync agent can tail it.
+        ``user``: the acting identity for ACL/quota enforcement (None =
+        system/admin context, every check bypassed — the pre-round-2
+        behavior); ``users``: the user db backing quota lookups."""
         self.ioctx = ioctx
         self.datalog = datalog
+        self.user = user
+        self.users = users
         self.striper = RadosStriper(ioctx, StripeLayout(
             stripe_unit=512 * 1024, stripe_count=4,
             object_size=4 * 1024 * 1024,
         ))
+
+    def as_user(self, user: str | None) -> "RGWLite":
+        """A handle acting as ``user`` over the same pool."""
+        return RGWLite(self.ioctx, self.datalog, user, self.users)
+
+    # -- ACL (rgw_acl.cc canned subset + explicit grants) ------------------
+    async def _bucket_meta(self, bucket: str) -> dict:
+        try:
+            kv = await self.ioctx.get_omap(BUCKETS_OID, [bucket])
+        except RadosError as e:
+            if e.rc == -2:
+                kv = {}
+            else:
+                raise
+        if bucket not in kv:
+            raise RGWError("NoSuchBucket", bucket)
+        return json.loads(kv[bucket])
+
+    async def _put_bucket_meta(self, bucket: str, meta: dict) -> None:
+        await self.ioctx.set_omap(
+            BUCKETS_OID, {bucket: json.dumps(meta).encode()}
+        )
+
+    def _acl_allows(self, owner: str, acl: dict, need: str) -> bool:
+        if self.user is None:
+            return True             # system context
+        if self.user == owner:
+            return True
+        canned = acl.get("canned", "private")
+        if canned == "public-read-write":
+            return True
+        if canned == "public-read" and need == "READ":
+            return True
+        if canned == "authenticated-read" and need == "READ" \
+                and self.user != ANONYMOUS:
+            return True
+        for grant in acl.get("grants", ()):
+            if grant.get("grantee") in (self.user, "*") and \
+                    _PERM_ORDER.get(grant.get("perm"), -1) >= \
+                    _PERM_ORDER[need]:
+                return True
+        return False
+
+    async def _check_bucket(self, bucket: str, need: str) -> dict:
+        meta = await self._bucket_meta(bucket)
+        if not self._acl_allows(meta.get("owner", ""),
+                                meta.get("acl", {}), need):
+            raise RGWError("AccessDenied", f"{bucket} ({need})")
+        return meta
+
+    async def put_bucket_acl(self, bucket: str, canned: str = "private",
+                             grants: list[dict] | None = None) -> None:
+        if canned not in _CANNED_ACLS:
+            raise RGWError("InvalidArgument", canned)
+        meta = await self._bucket_meta(bucket)
+        if self.user is not None and self.user != meta.get("owner"):
+            raise RGWError("AccessDenied", bucket)
+        meta["acl"] = {"canned": canned, "grants": list(grants or ())}
+        await self._put_bucket_meta(bucket, meta)
+
+    async def get_bucket_acl(self, bucket: str) -> dict:
+        meta = await self._bucket_meta(bucket)
+        return {"owner": meta.get("owner", ""),
+                "acl": meta.get("acl", {"canned": "private"})}
+
+    # -- quota (rgw_quota.cc: user + bucket ceilings) ----------------------
+    async def _bucket_usage(self, bucket: str) -> tuple[int, int]:
+        """(bytes, objects) from the bucket index — computed on demand
+        (the reference keeps rolling stats in the index header; at our
+        scale a scan is exact and race-free)."""
+        try:
+            index = await self.ioctx.get_omap(self._index_oid(bucket))
+        except RadosError as e:
+            if e.rc == -2:
+                return 0, 0
+            raise
+        sizes = [json.loads(v)["size"] for v in index.values()]
+        return sum(sizes), len(sizes)
+
+    async def set_bucket_quota(self, bucket: str, max_size: int = 0,
+                               max_objects: int = 0) -> None:
+        meta = await self._bucket_meta(bucket)
+        if self.user is not None and self.user != meta.get("owner"):
+            raise RGWError("AccessDenied", bucket)
+        meta["quota"] = {"max_size": int(max_size),
+                         "max_objects": int(max_objects)}
+        await self._put_bucket_meta(bucket, meta)
+
+    async def _check_quota(self, bucket: str, meta: dict,
+                           incoming: int, replaced_size: int,
+                           is_replace: bool) -> None:
+        bq = meta.get("quota") or {}
+        uq = {}
+        owner = meta.get("owner", "")
+        if self.users is not None and owner:
+            try:
+                uq = (await self.users.get(owner)).get("quota") or {}
+            except RGWError:
+                uq = {}
+        if not bq.get("max_size") and not bq.get("max_objects") \
+                and not uq.get("max_size") and not uq.get("max_objects"):
+            return
+        used_bytes, used_objs = await self._bucket_usage(bucket)
+        new_bytes = used_bytes - replaced_size + incoming
+        new_objs = used_objs + (0 if is_replace else 1)
+        if bq.get("max_size") and new_bytes > bq["max_size"]:
+            raise RGWError("QuotaExceeded", f"bucket {bucket} size")
+        if bq.get("max_objects") and new_objs > bq["max_objects"]:
+            raise RGWError("QuotaExceeded", f"bucket {bucket} objects")
+        if uq.get("max_size") or uq.get("max_objects"):
+            total_bytes = total_objs = 0
+            for b in await self.list_buckets():
+                try:
+                    if (await self._bucket_meta(b)).get("owner") \
+                            != owner:
+                        continue
+                except RGWError:
+                    continue
+                bb, bo = await self._bucket_usage(b)
+                if b == bucket:
+                    bb, bo = new_bytes, new_objs
+                total_bytes += bb
+                total_objs += bo
+            if uq.get("max_size") and total_bytes > uq["max_size"]:
+                raise RGWError("QuotaExceeded", f"user {owner} size")
+            if uq.get("max_objects") and total_objs > uq["max_objects"]:
+                raise RGWError("QuotaExceeded", f"user {owner} objects")
+
+    # -- lifecycle (rgw_lc.cc: expiration rules + the LC worker) ----------
+    async def put_lifecycle(self, bucket: str,
+                            rules: list[dict]) -> None:
+        """rules: [{id, prefix, status, expiration_days |
+        expiration_seconds}]."""
+        meta = await self._bucket_meta(bucket)
+        if self.user is not None and self.user != meta.get("owner"):
+            raise RGWError("AccessDenied", bucket)
+        for r in rules:
+            if "expiration_days" not in r \
+                    and "expiration_seconds" not in r:
+                raise RGWError("InvalidArgument",
+                               f"rule {r.get('id')}: no expiration")
+        meta["lifecycle"] = [dict(r) for r in rules]
+        await self._put_bucket_meta(bucket, meta)
+
+    async def get_lifecycle(self, bucket: str) -> list[dict]:
+        return (await self._bucket_meta(bucket)).get("lifecycle", [])
+
+    async def delete_lifecycle(self, bucket: str) -> None:
+        meta = await self._bucket_meta(bucket)
+        if self.user is not None and self.user != meta.get("owner"):
+            raise RGWError("AccessDenied", bucket)
+        meta.pop("lifecycle", None)
+        await self._put_bucket_meta(bucket, meta)
+
+    async def lc_process(self, now: float | None = None) -> dict:
+        """One LC worker pass over every bucket (RGWLC::process):
+        delete objects whose age exceeds an Enabled rule's expiration.
+        Returns bucket -> [expired keys removed]."""
+        now = time.time() if now is None else now
+        removed: dict[str, list[str]] = {}
+        sys_self = self if self.user is None else self.as_user(None)
+        for bucket in await self.list_buckets():
+            try:
+                rules = (await self._bucket_meta(bucket)) \
+                    .get("lifecycle", [])
+            except RGWError:
+                continue
+            active = [r for r in rules
+                      if r.get("status", "Enabled") == "Enabled"]
+            if not active:
+                continue
+            listing = await sys_self.list_objects(bucket,
+                                                  max_keys=1 << 30)
+            for obj in listing["contents"]:
+                age = now - float(obj["mtime"])
+                for r in active:
+                    if not obj["key"].startswith(r.get("prefix", "")):
+                        continue
+                    limit = (float(r["expiration_seconds"])
+                             if "expiration_seconds" in r
+                             else float(r["expiration_days"]) * 86400)
+                    if age > limit:
+                        await sys_self.delete_object(bucket,
+                                                     obj["key"])
+                        removed.setdefault(bucket, []).append(
+                            obj["key"])
+                        break
+        return removed
 
     # -- buckets -----------------------------------------------------------
     @staticmethod
@@ -74,6 +376,8 @@ class RGWLite:
         )
 
     async def create_bucket(self, bucket: str) -> None:
+        if self.user == ANONYMOUS:
+            raise RGWError("AccessDenied", "anonymous cannot create")
         existing = await self.list_buckets()
         if bucket in existing:
             raise RGWError("BucketAlreadyExists", bucket)
@@ -81,12 +385,16 @@ class RGWLite:
                                  .create()
                                  .omap_set({bucket: json.dumps({
                                      "created": time.time(),
+                                     "owner": self.user or "",
+                                     "acl": {"canned": "private"},
                                  }).encode()}))
         await self.ioctx.operate(self._index_oid(bucket),
                                  ObjectOperation().create())
 
     async def delete_bucket(self, bucket: str) -> None:
-        await self._require_bucket(bucket)
+        meta = await self._bucket_meta(bucket)
+        if self.user is not None and self.user != meta.get("owner"):
+            raise RGWError("AccessDenied", bucket)
         index = await self.ioctx.get_omap(self._index_oid(bucket))
         if index:
             raise RGWError("BucketNotEmpty", bucket)
@@ -120,11 +428,17 @@ class RGWLite:
                          metadata: dict[str, str] | None = None,
                          if_none_match: bool = False) -> dict:
         """S3 PUT. ``if_none_match``: fail when the key exists ('*')."""
-        await self._require_bucket(bucket)
+        meta = await self._check_bucket(bucket, "WRITE")
         index_oid = self._index_oid(bucket)
         existing = await self.ioctx.get_omap(index_oid, [key])
         if if_none_match and existing:
             raise RGWError("PreconditionFailed", key)
+        await self._check_quota(
+            bucket, meta, len(data),
+            replaced_size=(json.loads(existing[key])["size"]
+                           if key in existing else 0),
+            is_replace=key in existing,
+        )
         etag = hashlib.md5(data).hexdigest()
         oid = self._data_oid(bucket, key)
         if key in existing:
@@ -156,8 +470,9 @@ class RGWLite:
         await self._log(bucket, "put", key, etag)
         return {"etag": etag, "size": len(data)}
 
-    async def _entry(self, bucket: str, key: str) -> dict:
-        await self._require_bucket(bucket)
+    async def _entry(self, bucket: str, key: str,
+                     need: str = "READ") -> dict:
+        await self._check_bucket(bucket, need)
         kv = await self.ioctx.get_omap(self._index_oid(bucket), [key])
         if key not in kv:
             raise RGWError("NoSuchKey", f"{bucket}/{key}")
@@ -186,7 +501,7 @@ class RGWLite:
         return await self._entry(bucket, key)
 
     async def delete_object(self, bucket: str, key: str) -> None:
-        entry = await self._entry(bucket, key)
+        entry = await self._entry(bucket, key, need="WRITE")
         oid = self._data_oid(bucket, key)
         if entry["striped"]:
             await self.striper.remove(oid)
@@ -207,7 +522,7 @@ class RGWLite:
                            marker: str = "",
                            max_keys: int = 1000) -> dict:
         """S3 ListObjects: sorted, prefix-filtered, marker-paginated."""
-        await self._require_bucket(bucket)
+        await self._check_bucket(bucket, "READ")
         index = await self.ioctx.get_omap(self._index_oid(bucket))
         keys = sorted(
             k for k in index

@@ -1,0 +1,291 @@
+"""RBD depth: COW clones, object map, write-back cache.
+
+Reference surfaces: librbd clone/flatten + cls_rbd parent links +
+io/CopyupRequest (child reads through to parent@snap, copies up on
+first write), src/librbd/ObjectMap.h (existence bitmap short-circuits
+reads), osdc/ObjectCacher.h (client write-back cache above the object
+dispatch).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.object_cacher import ObjectCacher
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rbd import RBD, RBDError
+from tests.test_services import fast_conf, start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+ORDER = 14                      # 16 KiB objects keep the test light
+BLK = 1 << ORDER
+
+
+async def _rbd(rados, pool="rbdp"):
+    await rados.pool_create(pool, pg_num=8)
+    return RBD(await rados.open_ioctx(pool))
+
+
+def test_clone_read_through_and_copyup():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("parent", 4 * BLK, order=ORDER)
+            p = await rbd.open("parent")
+            await p.write(0, b"A" * BLK)
+            await p.write(2 * BLK, b"C" * 100)
+            await p.snap_create("s1")
+
+            # cloning an unprotected snap is refused
+            with pytest.raises(RBDError):
+                await rbd.clone("parent", "s1", "child")
+            await p.snap_protect("s1")
+            await rbd.clone("parent", "s1", "child")
+            assert await rbd.children("parent", "s1") == ["child"]
+
+            c = await rbd.open("child")
+            assert c.parent is not None
+            # read-through: child sees the parent's snap content
+            assert await c.read(0, BLK) == b"A" * BLK
+            assert (await c.read(2 * BLK, 200))[:100] == b"C" * 100
+            assert await c.read(3 * BLK, 10) == b"\x00" * 10
+
+            # parent divergence after the snap must NOT leak into child
+            await p.write(0, b"Z" * BLK)
+            assert await c.read(0, BLK) == b"A" * BLK
+
+            # partial write -> copyup: rest of the block stays parental
+            await c.write(100, b"x" * 50)
+            got = await c.read(0, BLK)
+            assert got[:100] == b"A" * 100
+            assert got[100:150] == b"x" * 50
+            assert got[150:] == b"A" * (BLK - 150)
+            # parent unchanged by child writes
+            assert await p.read_at_snap("s1", 0, BLK) == b"A" * BLK
+
+            # unprotect refused while the child exists
+            with pytest.raises(RBDError):
+                await p.snap_unprotect("s1")
+
+            # flatten severs the link; content identical afterwards
+            before = await c.read(0, 4 * BLK)
+            await c.flatten()
+            assert c.parent is None
+            assert await c.read(0, 4 * BLK) == before
+            assert await rbd.children("parent", "s1") == []
+            await p.snap_unprotect("s1")
+            await p.snap_remove("s1")
+
+            # reopen: flattened child still reads its own data
+            c2 = await rbd.open("child")
+            assert c2.parent is None
+            assert (await c2.read(0, BLK))[100:150] == b"x" * 50
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_clone_remove_and_protected_snap_rules():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("p2", 2 * BLK, order=ORDER)
+            img = await rbd.open("p2")
+            await img.write(0, b"base" * 64)
+            await img.snap_create("gold")
+            await img.snap_protect("gold")
+            await rbd.clone("p2", "gold", "c2")
+
+            # removing a protected snap is refused at the cls layer
+            with pytest.raises(Exception):
+                await img.snap_remove("gold")
+            # removing an image with snapshots is refused
+            with pytest.raises(RBDError):
+                await rbd.remove("p2")
+            # removing the clone unlinks it from rbd_children
+            await rbd.remove("c2")
+            assert await rbd.children("p2", "gold") == []
+            await img.snap_unprotect("gold")
+            await img.snap_remove("gold")
+            await rbd.remove("p2")
+            assert await rbd.list() == []
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_clone_shrink_persists_overlap():
+    """Regression: shrinking a clone must persist the clipped parent
+    overlap — a reopen + regrow must read zeros in the truncated range,
+    not resurrected parent bytes."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("pov", 4 * BLK, order=ORDER)
+            p = await rbd.open("pov")
+            await p.write(0, b"P" * 4 * BLK)
+            await p.snap_create("s")
+            await p.snap_protect("s")
+            await rbd.clone("pov", "s", "cov")
+            c = await rbd.open("cov")
+            assert await c.read(3 * BLK, 4) == b"PPPP"
+            await c.resize(2 * BLK)
+            await c.resize(4 * BLK)
+            assert await c.read(3 * BLK, 4) == b"\x00" * 4
+            # survives a fresh open (header carries the clipped overlap)
+            c2 = await rbd.open("cov")
+            assert c2.parent["overlap"] == 2 * BLK
+            assert await c2.read(3 * BLK, 4) == b"\x00" * 4
+            assert await c2.read(BLK, 4) == b"PPPP"   # still inherited
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_object_map_tracks_and_skips():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("om", 8 * BLK, order=ORDER)
+            img = await rbd.open("om")
+            assert img._om is not None
+            await img.write(0, b"a")
+            await img.write(5 * BLK + 7, b"b")
+            assert img._om_test(0) and img._om_test(5)
+            assert not img._om_test(1) and not img._om_test(7)
+            # reopen reloads the persisted bitmap
+            img2 = await rbd.open("om")
+            assert img2._om_test(5) and not img2._om_test(3)
+            # reads agree with a rebuilt map
+            await img2.object_map_rebuild()
+            assert img2._om_test(0) and img2._om_test(5)
+            assert not img2._om_test(2)
+            # shrink clears bits
+            await img2.resize(2 * BLK)
+            assert not img2._om_test(5)
+            img3 = await rbd.open("om")
+            assert not img3._om_test(5)
+
+            # object-map-off images still work (feature gate)
+            await rbd.create("nom", 2 * BLK, order=ORDER,
+                             object_map=False)
+            plain = await rbd.open("nom")
+            assert plain._om is None
+            await plain.write(10, b"z")
+            assert (await plain.read(10, 1)) == b"z"
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_writeback_cache_semantics():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("cim", 4 * BLK, order=ORDER)
+            img = await rbd.open("cim", cache=True)
+            await img.write(0, b"hello")
+            await img.write(BLK + 5, b"world")
+            # read-your-writes from cache, nothing flushed yet
+            assert await img.read(0, 5) == b"hello"
+            assert img._cache.stats()["flushes"] == 0
+            # a second (uncached) handle does NOT see unflushed writes
+            raw = await rbd.open("cim")
+            assert await raw.read(0, 5) == b"\x00" * 5
+            await img.flush()
+            assert await raw.read(0, 5) == b"hello"
+            assert await raw.read(BLK + 5, 5) == b"world"
+            # snapshot flushes the cache first
+            await img.write(2 * BLK, b"presnap")
+            await img.snap_create("s")
+            await raw.refresh()     # pick up the new snap in the header
+            assert await raw.read_at_snap("s", 2 * BLK, 7) == b"presnap"
+            # close flushes
+            await img.write(3 * BLK, b"tail")
+            await img.close()
+            assert await raw.read(3 * BLK, 4) == b"tail"
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_cached_clone_copyup():
+    """Cache above parent COW: fetch pulls parent bytes, writeback
+    persists the merged block with the object map updated."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("cp", 2 * BLK, order=ORDER)
+            p = await rbd.open("cp")
+            await p.write(0, b"P" * BLK)
+            await p.snap_create("s")
+            await p.snap_protect("s")
+            await rbd.clone("cp", "s", "cc")
+            c = await rbd.open("cc", cache=True)
+            assert await c.read(10, 5) == b"P" * 5
+            await c.write(100, b"new")
+            assert (await c.read(98, 7)) == b"PPnewPP"
+            await c.close()
+            # flushed through: an uncached handle sees the merged block
+            raw = await rbd.open("cc")
+            got = await raw.read(0, BLK)
+            assert got[:100] == b"P" * 100
+            assert got[100:103] == b"new"
+            assert got[103:] == b"P" * (BLK - 103)
+            assert raw._om_test(0)
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_object_cacher_unit():
+    async def run():
+        backing: dict[int, bytes] = {0: b"0123456789"}
+        async def fetch(k):
+            return backing.get(k, b"")
+        async def writeback(k, data):
+            backing[k] = data
+
+        c = ObjectCacher(fetch, writeback, max_dirty=100,
+                         max_objects=3)
+        assert await c.read(0, 2, 4) == b"2345"
+        assert c.stats()["misses"] == 1
+        assert await c.read(0, 0, 4) == b"0123"
+        assert c.stats()["hits"] == 1
+        # short-object tail reads as zeros
+        assert await c.read(0, 8, 6) == b"89\x00\x00\x00\x00"
+        # write extends + dirties, flush persists
+        await c.write(0, 10, b"AB")
+        assert backing[0] == b"0123456789"
+        await c.flush()
+        assert backing[0] == b"0123456789AB"
+        # dirty budget forces oldest-first writeback
+        await c.write(1, 0, b"x" * 60)
+        await c.write(2, 0, b"y" * 60)   # 120 > 100 -> flush oldest
+        assert backing.get(1) == b"x" * 60
+        # LRU eviction of clean objects under the count budget
+        await c.read(3, 0, 1)
+        await c.read(4, 0, 1)
+        assert c.stats()["objects"] <= 3
+        assert c.stats()["evictions"] >= 1
+
+    asyncio.run(run())
