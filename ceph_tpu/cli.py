@@ -183,6 +183,16 @@ async def _dispatch(args, rados: Rados) -> int:
         if args.action == "rm":
             return await _mon(rados, "config-key rm", j, key=args.key)
         return await _mon(rados, "config-key ls", j)
+    if cmd == "fs":
+        if args.action == "new":
+            return await _mon(rados, "fs new", j, fs_name=args.fs_name,
+                              metadata=args.metadata, data=args.data)
+        if args.action == "rm":
+            return await _mon(rados, "fs rm", j, fs_name=args.fs_name,
+                              force=args.force)
+        return await _mon(rados, "fs ls", j)
+    if cmd == "mds":
+        return await _mon(rados, "mds stat", j)
     if cmd == "quorum_status":
         return await _mon(rados, "quorum_status", j)
     if cmd == "mon":                      # mon dump
@@ -390,6 +400,18 @@ def build_parser() -> argparse.ArgumentParser:
         c = ck_sub.add_parser(name)
         c.add_argument("key")
     ck_sub.add_parser("ls")
+    fs = sub.add_parser("fs")
+    fs_sub = fs.add_subparsers(dest="action", required=True)
+    fs_sub.add_parser("ls")
+    fn = fs_sub.add_parser("new")
+    fn.add_argument("fs_name")
+    fn.add_argument("metadata")
+    fn.add_argument("data")
+    fr = fs_sub.add_parser("rm")
+    fr.add_argument("fs_name")
+    fr.add_argument("--force", action="store_true")
+    mds = sub.add_parser("mds")
+    mds.add_argument("action", choices=["stat"])
     logp = sub.add_parser("log")
     log_sub = logp.add_subparsers(dest="action", required=True)
     ll = log_sub.add_parser("last")

@@ -135,6 +135,31 @@ class FileHandle:
 class CephFS:
     """A mounted filesystem (ceph_mount)."""
 
+    @classmethod
+    async def connect(cls, rados: Rados, fs_name: str = "cephfs",
+                   timeout: float = 10.0) -> "CephFS":
+        """Discover the active MDS from the monitor's FSMap (``mds
+        stat``) instead of a hardcoded address (the reference client's
+        mdsmap subscription role)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            r = await rados.mon_command("mds stat")
+            if r["rc"] not in (0, -11):
+                # only EAGAIN (no quorum yet) is transient; a cap
+                # denial or unknown command must surface, not time out
+                raise FSError(r["rc"], r["outs"])
+            active = None
+            if r["rc"] == 0:
+                active = (r["data"]["filesystems"]
+                          .get(fs_name, {}).get("active"))
+            if active is not None:
+                return cls(rados, active["addr"])
+            if asyncio.get_running_loop().time() > deadline:
+                raise FSError(
+                    -110, f"no active mds for fs {fs_name!r}"
+                )
+            await asyncio.sleep(0.1)
+
     def __init__(self, rados: Rados, mds_addr: str):
         self.rados = rados
         self.mds_addr = mds_addr

@@ -18,6 +18,8 @@ side effects (object map update) the owner needs.
 from __future__ import annotations
 
 import asyncio
+
+from ceph_tpu.common.lockdep import DLock
 from collections import OrderedDict
 from typing import Awaitable, Callable
 
@@ -44,7 +46,7 @@ class ObjectCacher:
         self.max_objects = max_objects
         self._objects: "OrderedDict[object, _CachedObject]" = \
             OrderedDict()
-        self._lock = asyncio.Lock()
+        self._lock = DLock("object-cacher")
         # stats (perf-counter shaped)
         self.hits = 0
         self.misses = 0
@@ -91,12 +93,6 @@ class ObjectCacher:
             self._objects.move_to_end(key)
             if self.dirty_bytes > self.max_dirty:
                 await self._flush_locked(oldest_only=True)
-
-    async def truncate(self, key, size: int) -> None:
-        async with self._lock:
-            obj = await self._get(key)
-            del obj.data[size:]
-            obj.dirty = True
 
     async def discard(self, key) -> None:
         async with self._lock:

@@ -119,6 +119,10 @@ def global_options() -> list[Option]:
                "this entity's own secret key (cephx mode)"),
         Option("auth_service_secret_ttl", float, 3600.0,
                "rotating service-secret / ticket lifetime (s)", min=0.5),
+        Option("mds_beacon_interval", float, 0.5,
+               "mds -> mon beacon period (s)", min=0.05),
+        Option("mds_beacon_grace", float, 3.0,
+               "beacon silence before an mds is failed (s)", min=0.1),
         Option("trace_probability", float, 0.0,
                "fraction of client ops that carry a trace context "
                "(zipkin_trace analog; 0=off)", min=0.0, max=1.0),

@@ -30,6 +30,7 @@ import asyncio
 import struct
 import time
 
+from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.client.rados import IoCtx, ObjectOperation, Rados, RadosError
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.log import Dout
@@ -83,9 +84,12 @@ class MDSDaemon:
                  addr: str | None = None,
                  meta_pool: str = "cephfs_meta",
                  data_pool: str = "cephfs_data",
-                 block_size: int = 1 << 22):
+                 block_size: int = 1 << 22,
+                 fs_name: str = "cephfs"):
         self.name = name
         self.entity = f"mds.{name}"
+        self.fs_name = fs_name
+        self._beacon_task = None
         self.conf = conf or ConfigProxy()
         self.addr = addr or f"local://{self.entity}"
         self.meta_pool = meta_pool
@@ -100,7 +104,7 @@ class MDSDaemon:
         self.msgr.set_dispatcher(self)
         self.next_ino = ROOT_INO + 1
         self.journal_len = 0
-        self._mutate = asyncio.Lock()    # single-MDS serialization
+        self._mutate = DLock("mds-mutate")  # single-MDS serialization
         self.lease_ttl = 2.0
 
     # -- lifecycle ---------------------------------------------------------
@@ -118,10 +122,32 @@ class MDSDaemon:
             if e.rc != EEXIST:
                 raise
         await self.msgr.bind(self.addr)
+        self._beacon_task = asyncio.create_task(self._beacon_loop())
         log.dout(1, "%s: up at %s (meta=%s data=%s)", self.entity,
                  self.msgr.my_addr, self.meta_pool, self.data_pool)
 
+    async def _beacon_loop(self) -> None:
+        """MMDSBeacon: announce (name, addr, fs) to the monitor so the
+        FSMap tracks this daemon and clients can discover the active
+        MDS (reference Beacon.cc)."""
+        interval = self.conf["mds_beacon_interval"]
+        while True:
+            conn = self.rados.monc.conn
+            if conn is not None and not conn.is_closed:
+                try:
+                    conn.send_message(Message("mds_beacon", {
+                        "name": self.name,
+                        "addr": str(self.msgr.my_addr),
+                        "fs": self.fs_name,
+                    }))
+                except ConnectionError:
+                    pass
+            await asyncio.sleep(interval)
+
     async def shutdown(self) -> None:
+        if self._beacon_task is not None:
+            self._beacon_task.cancel()
+            self._beacon_task = None
         async with self._mutate:
             await self._compact_journal()
         await self.rados.shutdown()
@@ -307,12 +333,25 @@ class MDSDaemon:
         pass
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if msg.type == "mds_takeover":
+            # promotion after a failover: our table/journal view dates
+            # from boot — re-sync before serving mutations, or inos the
+            # failed active allocated could be handed out again
+            asyncio.get_running_loop().create_task(self._resync())
+            return
         if msg.type != "mds_request":
             log.dout(10, "%s: ignoring %s", self.entity, msg.type)
             return
         asyncio.get_running_loop().create_task(
             self._handle_request(conn, msg.data)
         )
+
+    async def _resync(self) -> None:
+        async with self._mutate:
+            await self._load_table()
+            await self._replay_journal()
+        log.dout(1, "%s: resynced for takeover (next_ino=%d)",
+                 self.entity, self.next_ino)
 
     async def _handle_request(self, conn: Connection, d: dict) -> None:
         tid = d.get("tid", 0)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable
 
+from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.log import Dout
 from ceph_tpu.mon.monitor import auth_proof
@@ -37,7 +38,7 @@ class MonClient:
         self.cur_mon: str | None = None
         self.conn: Connection | None = None
         self._authed = asyncio.Event()
-        self._renew_lock = asyncio.Lock()
+        self._renew_lock = DLock("monc-renew")
         # cephx grants (the CephxServiceTicket the monitor issues)
         self.caps: dict[str, str] = {}
         self.osd_ticket: dict | None = None

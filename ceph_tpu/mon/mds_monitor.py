@@ -1,0 +1,251 @@
+"""MDSMonitor: the FSMap service (filesystems + MDS daemon states).
+
+Reference src/mon/MDSMonitor.cc + src/mds/FSMap.cc: ``fs new`` binds a
+named filesystem to its metadata/data pools; MDS daemons announce
+themselves with beacons (MMDSBeacon) and the monitor assigns roles —
+one active per filesystem, the rest standby; a beacon-silent active is
+failed over to a standby; clients discover the active MDS address from
+the map (``mds stat``).
+
+Proposals are staged only on STATE changes (registration, role moves,
+failover); routine beacons refresh leader-local liveness without
+touching paxos — the reference's beacon path makes the same split.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ceph_tpu.mon.service import (
+    EEXIST_RC,
+    EINVAL_RC,
+    ENOENT_RC,
+    CommandResult,
+    PaxosService,
+)
+from ceph_tpu.mon.store import StoreTransaction
+from ceph_tpu.msg.codec import decode, encode
+
+PREFIX = "mdsmap"
+
+STATE_ACTIVE = "up:active"
+STATE_STANDBY = "up:standby"
+STATE_DOWN = "down"
+
+
+class MDSMonitor(PaxosService):
+    prefix = PREFIX
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        self.epoch = 0
+        self.filesystems: dict[str, dict] = {}
+        self.mds: dict[str, dict] = {}       # name -> {addr, fs, state}
+        self._last_beacon: dict[str, float] = {}   # leader-local
+        self.pending = False
+
+    # -- state ------------------------------------------------------------
+    def refresh(self) -> None:
+        raw = self.store.get(PREFIX, "fsmap")
+        if raw is None:
+            return
+        m = decode(raw)
+        self.epoch = int(m["epoch"])
+        self.filesystems = {str(k): dict(v)
+                            for k, v in m["filesystems"].items()}
+        self.mds = {str(k): dict(v) for k, v in m["mds"].items()}
+
+    def _stage(self, tx: StoreTransaction) -> None:
+        self.epoch += 1
+        tx.put(PREFIX, "fsmap", encode({
+            "epoch": self.epoch,
+            "filesystems": self.filesystems,
+            "mds": self.mds,
+        }))
+
+    def encode_pending(self, tx: StoreTransaction) -> bool:
+        if not self.pending:
+            return False
+        self.pending = False
+        self._stage(tx)
+        return True
+
+    # -- beacons (MMDSBeacon) ---------------------------------------------
+    def handle_beacon(self, name: str, addr: str, fs: str) -> bool:
+        """Record liveness; returns True when a map change was staged
+        (registration, address change, or a role assignment)."""
+        self._last_beacon[name] = time.monotonic()
+        info = self.mds.get(name)
+        if info is not None and info["addr"] == addr \
+                and info["state"] != STATE_DOWN:
+            return False
+        self.mds[name] = {
+            "addr": addr, "fs": fs,
+            "state": self._pick_state(name, fs),
+        }
+        self.pending = True
+        return True
+
+    def _pick_state(self, name: str, fs: str) -> str:
+        active = [n for n, i in self.mds.items()
+                  if n != name and i["fs"] == fs
+                  and i["state"] == STATE_ACTIVE]
+        return STATE_STANDBY if active else STATE_ACTIVE
+
+    async def tick(self) -> None:
+        """Leader: age out beacon-silent daemons and fail over."""
+        grace = self.mon.conf["mds_beacon_grace"]
+        now = time.monotonic()
+        changed = False
+        for name, info in self.mds.items():
+            if info["state"] == STATE_DOWN:
+                continue
+            last = self._last_beacon.get(name)
+            if last is None:
+                # first sight since this mon became leader: start the
+                # clock now rather than instantly failing the daemon
+                self._last_beacon[name] = now
+                continue
+            if now - last > grace:
+                was_active = info["state"] == STATE_ACTIVE
+                info["state"] = STATE_DOWN
+                changed = True
+                self.mon.cluster_log(
+                    "warn", f"mds.{name} failed (no beacon for "
+                    f"{grace:g}s)"
+                )
+                if was_active:
+                    standby = next(
+                        (n for n, i in self.mds.items()
+                         if i["fs"] == info["fs"]
+                         and i["state"] == STATE_STANDBY), None,
+                    )
+                    if standby is not None:
+                        self.mds[standby]["state"] = STATE_ACTIVE
+                        self.mon.cluster_log(
+                            "info", f"mds.{standby} takes over as "
+                            f"active for fs {info['fs']!r}"
+                        )
+                        # the standby's in-memory table/journal view is
+                        # as old as its boot; tell it to resync BEFORE
+                        # clients discover it (an ino handed out by the
+                        # failed active must never be re-allocated)
+                        self._notify_takeover(
+                            standby, self.mds[standby]["addr"]
+                        )
+        if changed:
+            self.pending = True
+            await self.mon.propose_pending()
+
+    def _notify_takeover(self, name: str, addr: str) -> None:
+        import asyncio
+
+        from ceph_tpu.msg.message import Message
+
+        async def _send():
+            try:
+                await self.mon.msgr.send_to(
+                    addr, Message("mds_takeover", {"name": name}),
+                    f"mds.{name}",
+                )
+            except (ConnectionError, OSError):
+                pass        # the mds will also resync on its own terms
+
+        asyncio.get_running_loop().create_task(_send())
+
+    # -- health ------------------------------------------------------------
+    def health_checks(self) -> dict[str, dict]:
+        checks: dict[str, dict] = {}
+        down = sorted(n for n, i in self.mds.items()
+                      if i["state"] == STATE_DOWN)
+        if down:
+            checks["MDS_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"{len(down)} mds daemons down",
+                "detail": [f"mds.{n} is down" for n in down],
+            }
+        for fs in self.filesystems:
+            if not any(i["fs"] == fs and i["state"] == STATE_ACTIVE
+                       for i in self.mds.values()):
+                checks["FS_WITH_FAILED_MDS"] = {
+                    "severity": "HEALTH_ERR",
+                    "message": f"filesystem {fs!r} has no active mds",
+                }
+        return checks
+
+    # -- commands ----------------------------------------------------------
+    def _fs_pools_exist(self, meta: str, data: str) -> bool:
+        names = {p.name for p in
+                 self.mon.osd_monitor.osdmap.pools.values()}
+        return meta in names and data in names
+
+    def preprocess_command(self, cmd: dict) -> CommandResult | None:
+        name = cmd.get("prefix", "")
+        if name == "fs ls":
+            return CommandResult(data=[
+                {"name": fs, **info}
+                for fs, info in sorted(self.filesystems.items())
+            ])
+        if name == "mds stat":
+            out = {}
+            for fs in self.filesystems:
+                members = {n: i for n, i in self.mds.items()
+                           if i["fs"] == fs}
+                active = next((
+                    {"name": n, "addr": i["addr"]}
+                    for n, i in members.items()
+                    if i["state"] == STATE_ACTIVE), None)
+                out[fs] = {
+                    "active": active,
+                    "standby": sorted(
+                        n for n, i in members.items()
+                        if i["state"] == STATE_STANDBY),
+                    "down": sorted(
+                        n for n, i in members.items()
+                        if i["state"] == STATE_DOWN),
+                }
+            return CommandResult(data={"epoch": self.epoch,
+                                       "filesystems": out})
+        return None
+
+    def prepare_command(self, cmd: dict, tx: StoreTransaction
+                        ) -> CommandResult:
+        name = cmd.get("prefix", "")
+        if name == "fs new":
+            fs = str(cmd.get("fs_name", ""))
+            meta, data = str(cmd.get("metadata", "")), \
+                str(cmd.get("data", ""))
+            if not fs or not meta or not data:
+                return CommandResult(
+                    EINVAL_RC, "fs new <fs_name> <metadata> <data>"
+                )
+            if fs in self.filesystems:
+                return CommandResult(EEXIST_RC, f"fs {fs!r} exists")
+            if not self._fs_pools_exist(meta, data):
+                return CommandResult(
+                    ENOENT_RC, f"pools {meta!r}/{data!r} must exist"
+                )
+            self.filesystems[fs] = {
+                "meta_pool": meta, "data_pool": data,
+                "created": time.time(),
+            }
+            self._stage(tx)
+            return CommandResult(outs=f"filesystem {fs!r} created")
+        if name == "fs rm":
+            fs = str(cmd.get("fs_name", ""))
+            if fs not in self.filesystems:
+                return CommandResult(ENOENT_RC, f"no fs {fs!r}")
+            if any(i["fs"] == fs and i["state"] == STATE_ACTIVE
+                   for i in self.mds.values()) \
+                    and not cmd.get("force"):
+                return CommandResult(
+                    EINVAL_RC,
+                    f"fs {fs!r} has an active mds (use force)"
+                )
+            del self.filesystems[fs]
+            for info in self.mds.values():
+                if info["fs"] == fs:
+                    info["state"] = STATE_DOWN
+            self._stage(tx)
+            return CommandResult(outs=f"filesystem {fs!r} removed")
+        return super().prepare_command(cmd, tx)

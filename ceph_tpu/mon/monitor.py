@@ -17,6 +17,7 @@ import hashlib
 import hmac
 import secrets
 
+from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.log import Dout
 from ceph_tpu.mon.auth_monitor import AuthMonitor, cap_allows
@@ -24,6 +25,7 @@ from ceph_tpu.mon.config_monitor import ConfigMonitor
 from ceph_tpu.mon.election import Elector
 from ceph_tpu.mon.health_monitor import HealthMonitor
 from ceph_tpu.mon.log_monitor import LogMonitor
+from ceph_tpu.mon.mds_monitor import MDSMonitor
 from ceph_tpu.mon.mgr_stat import MgrStatMonitor
 from ceph_tpu.mon.osd_monitor import OSDMonitor
 from ceph_tpu.mon.paxos import Paxos
@@ -90,10 +92,12 @@ class Monitor:
         self.log_monitor = LogMonitor(self)
         self.health_monitor = HealthMonitor(self)
         self.mgr_stat = MgrStatMonitor(self)
+        self.mds_monitor = MDSMonitor(self)
         self.services = {
             "osd": self.osd_monitor, "config": self.config_monitor,
             "auth": self.auth_monitor, "log": self.log_monitor,
             "health": self.health_monitor, "mgr": self.mgr_stat,
+            "fs": self.mds_monitor,
         }
         # cluster-log entries queued by local subsystems (health
         # transitions etc.), drained into one paxos propose per tick
@@ -105,7 +109,7 @@ class Monitor:
         self._lease_acks: dict[str, float] = {}
         # serializes stage-pending -> encode -> propose so two concurrent
         # mutations can't both build epoch N+1 and lose one's changes
-        self._mutate_lock = asyncio.Lock()
+        self._mutate_lock = DLock("mon-mutate")
         self._tasks: list[asyncio.Task] = []
         self._genesis_inflight = False
         self._stopped = False
@@ -250,9 +254,11 @@ class Monitor:
             self._genesis_inflight = False
 
     async def propose_pending(self) -> None:
-        """Commit any staged OSDMonitor incremental."""
+        """Commit any staged OSDMonitor incremental / FSMap change."""
         tx = StoreTransaction()
-        if self.osd_monitor.encode_pending(tx):
+        changed = self.osd_monitor.encode_pending(tx)
+        changed = self.mds_monitor.encode_pending(tx) or changed
+        if changed:
             await self.paxos.propose(tx)
 
     # -- tick / leases -----------------------------------------------------
@@ -293,6 +299,7 @@ class Monitor:
                 try:
                     async with self._mutate_lock:
                         await self.osd_monitor.tick()
+                        await self.mds_monitor.tick()
                         if self.cephx:
                             tx = StoreTransaction()
                             if self.auth_monitor.maybe_rotate(tx):
@@ -405,6 +412,9 @@ class Monitor:
         elif t == "osd_failure":
             if self._osd_identity_ok(session, None):
                 loop.create_task(self._handle_osd_failure(msg.data))
+        elif t == "mds_beacon":
+            # MMDSBeacon: liveness + registration
+            loop.create_task(self._handle_mds_beacon(msg.data))
         elif t == "log":
             # MLog: daemons submit cluster-log batches.  The entries'
             # 'who' is forced to the PROVEN session entity so a client
@@ -581,6 +591,8 @@ class Monitor:
             return self.mgr_stat
         if word == "config-key":
             return self.config_monitor
+        if word == "mds":
+            return self.mds_monitor
         return self.services.get(word)
 
     def _mon_command(self, cmd: dict) -> CommandResult | None:
@@ -767,6 +779,9 @@ class Monitor:
         elif itype == "log":
             await self._handle_log(idata)
             payload = None
+        elif itype == "mds_beacon":
+            await self._handle_mds_beacon(idata)
+            payload = None
         else:
             payload = None
         if reply_type and payload is not None:
@@ -814,6 +829,25 @@ class Monitor:
                     await self.propose_pending()
                 except ConnectionError:
                     pass
+
+    async def _handle_mds_beacon(self, data: dict) -> None:
+        name = str(data.get("name", ""))
+        addr = str(data.get("addr", ""))
+        fs = str(data.get("fs", ""))
+        if not name or not addr:
+            return
+        if self.is_leader:
+            try:
+                async with self._mutate_lock:
+                    if self.mds_monitor.handle_beacon(name, addr, fs):
+                        await self.propose_pending()
+            except ConnectionError:
+                pass
+        elif self.elector.leader is not None:
+            self.send_mon(self.elector.leader, Message("mon_forward", {
+                "rtid": 0, "itype": "mds_beacon", "idata": data,
+                "reply_type": "",
+            }))
 
     async def _handle_log(self, data: dict) -> None:
         entries = [e for e in data.get("entries", [])

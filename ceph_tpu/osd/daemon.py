@@ -26,6 +26,7 @@ import hashlib
 import hmac as hmac_mod
 import secrets as secrets_mod
 
+from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.mon.auth_monitor import canonical, cap_allows, verify_ticket
@@ -191,7 +192,7 @@ class OSDDaemon:
         self._stopped = False
         self._booted = False
         self._reboot_epoch = 0
-        self._map_lock = asyncio.Lock()
+        self._map_lock = DLock("osd-map")
         # perf counters (the l_osd_* set, reference OSD.cc:9659 region)
         self.perf = PerfCounters(self.entity)
         for key in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",

@@ -28,6 +28,7 @@ from __future__ import annotations
 import asyncio
 from dataclasses import dataclass, field
 
+from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.log import Dout
 from ceph_tpu.osd.pg_log import (
     LogEntry,
@@ -131,7 +132,7 @@ class PG:
         self.attempted_reqids: dict[str, tuple[str, int]] = {}
         # serializes log maintenance (activation merge vs trim) so their
         # read-modify-write cycles cannot interleave and regress the tail
-        self.log_lock = asyncio.Lock()
+        self.log_lock = DLock("pg-log")
         # per-object op locks: replicated-pool mutations, the snap
         # trimmer, and scrub read object state, build a transaction, and
         # await replication — interleaving two such cycles on one OBJECT

@@ -282,8 +282,23 @@ class Image:
         if byte >= len(self._om):
             self._om.extend(bytes(byte + 1 - len(self._om)))
         self._om[byte] |= 1 << (objectno & 7)
-        # persisted BEFORE the data write lands (may-exist is safe;
-        # definitely-absent with data present would corrupt reads)
+        # Persisted BEFORE the data write lands (may-exist is safe;
+        # definitely-absent with data present would corrupt reads).
+        # OR-merge with the on-disk map: bits are only ever SET here,
+        # so merging prevents one handle's stale view from clearing
+        # another writer's bits (lost update); "may exist" bits that
+        # survive a concurrent shrink are safe by definition.
+        try:
+            disk = bytearray(await self.ioctx.read(self._om_oid))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            disk = bytearray()
+        if len(disk) < len(self._om):
+            disk.extend(bytes(len(self._om) - len(disk)))
+        for i, b in enumerate(self._om):
+            disk[i] |= b
+        self._om = disk
         await self.ioctx.operate(
             self._om_oid, ObjectOperation().write_full(bytes(self._om))
         )

@@ -45,8 +45,13 @@ class RGWUsers:
                      max_size: int = 0, max_objects: int = 0) -> dict:
         import secrets as _secrets
 
-        existing = await self._all()
-        if uid in existing:
+        try:
+            kv = await self.ioctx.get_omap(USERS_OID, [uid])
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            kv = {}
+        if uid in kv:
             raise RGWError("UserAlreadyExists", uid)
         rec = {
             "uid": uid, "display_name": display_name or uid,
@@ -214,7 +219,11 @@ class RGWLite:
         await self._put_bucket_meta(bucket, meta)
 
     async def get_bucket_acl(self, bucket: str) -> dict:
+        """Owner-only, like S3's READ_ACP default: grant lists and
+        ownership are not disclosed to mere readers."""
         meta = await self._bucket_meta(bucket)
+        if self.user is not None and self.user != meta.get("owner"):
+            raise RGWError("AccessDenied", bucket)
         return {"owner": meta.get("owner", ""),
                 "acl": meta.get("acl", {"canned": "private"})}
 
@@ -298,7 +307,10 @@ class RGWLite:
         await self._put_bucket_meta(bucket, meta)
 
     async def get_lifecycle(self, bucket: str) -> list[dict]:
-        return (await self._bucket_meta(bucket)).get("lifecycle", [])
+        meta = await self._bucket_meta(bucket)
+        if self.user is not None and self.user != meta.get("owner"):
+            raise RGWError("AccessDenied", bucket)
+        return meta.get("lifecycle", [])
 
     async def delete_lifecycle(self, bucket: str) -> None:
         meta = await self._bucket_meta(bucket)
@@ -413,10 +425,6 @@ class RGWLite:
             if e.rc == -2:
                 return []
             raise
-
-    async def _require_bucket(self, bucket: str) -> None:
-        if bucket not in await self.list_buckets():
-            raise RGWError("NoSuchBucket", bucket)
 
     # -- objects -----------------------------------------------------------
     @staticmethod

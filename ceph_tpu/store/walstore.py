@@ -24,6 +24,7 @@ import os
 import struct
 from pathlib import Path
 
+from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.msg.codec import decode, encode
 from ceph_tpu.store.memstore import MemStore, _Obj
@@ -63,7 +64,7 @@ class WalStore(MemStore):
         self.native = bool(native)
         self._wal_file = None          # python tier file handle
         self._nwal = None              # native tier NativeWal handle
-        self._commit_lock = asyncio.Lock()
+        self._commit_lock = DLock("store-commit")
 
     # -- mount / umount ---------------------------------------------------
     async def mount(self) -> None:

@@ -145,10 +145,17 @@ class DevCluster:
     async def start_mds(self, name: str = "a",
                         meta_pool: str = "cephfs_meta",
                         data_pool: str = "cephfs_data",
-                        block_size: int = 1 << 22):
+                        block_size: int = 1 << 22,
+                        fs_name: str = "cephfs"):
         """Boot an MDS over existing pools (fs-new + mds boot). The
-        pools must already exist."""
+        pools must already exist; the filesystem is registered in the
+        monitor's FSMap when not already present."""
         from ceph_tpu.mds.daemon import MDSDaemon
+        admin = await self.client()
+        r = await admin.mon_command("fs new", fs_name=fs_name,
+                                    metadata=meta_pool, data=data_pool)
+        assert r["rc"] in (0, -17), r       # EEXIST on restart is fine
+        await admin.shutdown()
         entity = f"client.mds.{name}"
         if self.cephx and entity not in self._entity_keys:
             admin = await self.client()
@@ -159,9 +166,14 @@ class DevCluster:
             assert r["rc"] == 0, r
             self._entity_keys[entity] = r["data"]["key"]
             await admin.shutdown()
+        addr = None
+        if self.tcp:
+            addr = (f"tcp://127.0.0.1:"
+                    f"{self.base_port + 200 + len(self.mdss)}")
         mds = MDSDaemon(name, self.monmap, self.conf_for(entity),
+                        addr=addr,
                         meta_pool=meta_pool, data_pool=data_pool,
-                        block_size=block_size)
+                        block_size=block_size, fs_name=fs_name)
         await mds.start()
         self.mdss[name] = mds
         return mds

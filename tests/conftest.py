@@ -15,3 +15,10 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# lockdep: validate asyncio lock acquisition ORDER across the whole
+# suite (reference common/lockdep role) — a violation raises at the
+# offending acquisition, failing that test with the two sites involved
+from ceph_tpu.common.lockdep import lockdep_enable  # noqa: E402
+
+lockdep_enable()

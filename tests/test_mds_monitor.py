@@ -1,0 +1,155 @@
+"""MDSMonitor / FSMap: fs commands, beacons, discovery, failover.
+
+Reference surfaces: src/mon/MDSMonitor.cc (fs new/ls/rm, beacon
+handling, failover to standby), src/mds/FSMap.cc, Beacon.cc, and the
+client's mdsmap-based discovery of the active MDS.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS, FSError
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _pools(rados):
+    for pool in ("cephfs_meta", "cephfs_data"):
+        r = await rados.mon_command("osd pool create", pool=pool,
+                                    pg_num=8, size=2)
+        assert r["rc"] == 0, r
+
+
+def test_fs_commands_and_discovery():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+            "mds_beacon_interval": 0.1, "mds_beacon_grace": 1.0,
+        })
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            # fs new requires existing pools
+            r = await rados.mon_command("fs new", fs_name="cephfs",
+                                        metadata="cephfs_meta",
+                                        data="cephfs_data")
+            assert r["rc"] == -2, r
+            await _pools(rados)
+            mds = await cluster.start_mds()    # registers fs + boots
+            r = await rados.mon_command("fs ls")
+            assert [f["name"] for f in r["data"]] == ["cephfs"]
+            assert r["data"][0]["meta_pool"] == "cephfs_meta"
+            r = await rados.mon_command("fs new", fs_name="cephfs",
+                                        metadata="cephfs_meta",
+                                        data="cephfs_data")
+            assert r["rc"] == -17              # EEXIST
+
+            # beacon -> active in mds stat
+            deadline = asyncio.get_running_loop().time() + 10
+            while True:
+                r = await rados.mon_command("mds stat")
+                active = r["data"]["filesystems"]["cephfs"]["active"]
+                if active is not None:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, r
+                await asyncio.sleep(0.1)
+            assert active["name"] == "a"
+
+            # client discovery via the FSMap, then real IO
+            fs = await CephFS.connect(rados)
+            await fs.mount()
+            fd = await fs.open("/hello.txt", "w")
+            await fd.write(b"fsmap!")
+            await fd.close()
+            fd = await fs.open("/hello.txt", "r")
+            assert await fd.read() == b"fsmap!"
+            await fd.close()
+            await fs.unmount()
+
+            # rm refuses while active, force works
+            r = await rados.mon_command("fs rm", fs_name="cephfs")
+            assert r["rc"] == -22, r
+            r = await rados.mon_command("fs rm", fs_name="cephfs",
+                                        force=True)
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("fs ls")
+            assert r["data"] == []
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_mds_failover_to_standby():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+            "mds_beacon_interval": 0.1, "mds_beacon_grace": 0.8,
+        })
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            await _pools(rados)
+            mds_a = await cluster.start_mds("a")
+            mds_b = await cluster.start_mds("b")
+
+            async def stat():
+                r = await rados.mon_command("mds stat")
+                return r["data"]["filesystems"]["cephfs"]
+
+            deadline = asyncio.get_running_loop().time() + 10
+            while True:
+                s = await stat()
+                if s["active"] and s["standby"]:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, s
+                await asyncio.sleep(0.1)
+            assert s["active"]["name"] == "a"
+            assert s["standby"] == ["b"]
+
+            # write through mds.a
+            fs = await CephFS.connect(rados)
+            await fs.mount()
+            fd = await fs.open("/f", "w")
+            await fd.write(b"before-failover")
+            await fd.close()
+            await fs.unmount()
+
+            # kill the active: the standby must take over
+            await mds_a.shutdown()
+            del cluster.mdss["a"]
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                s = await stat()
+                if s["active"] and s["active"]["name"] == "b":
+                    break
+                assert asyncio.get_running_loop().time() < deadline, s
+                await asyncio.sleep(0.1)
+            assert "a" in s["down"]
+
+            # MDS_DOWN health surfaces
+            r = await rados.mon_command("health detail")
+            assert "MDS_DOWN" in r["data"]["checks"]
+
+            # discovery now lands on mds.b; data written via a is there
+            fs2 = await CephFS.connect(rados)
+            await fs2.mount()
+            fd = await fs2.open("/f", "r")
+            assert await fd.read() == b"before-failover"
+            await fd.close()
+            fd = await fs2.open("/g", "w")
+            await fd.write(b"after")
+            await fd.close()
+            await fs2.unmount()
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
