@@ -90,6 +90,8 @@ class MDSDaemon:
         self.entity = f"mds.{name}"
         self.fs_name = fs_name
         self._beacon_task = None
+        self._last_state: str | None = None
+        self._rados_dispatch = None
         self.conf = conf or ConfigProxy()
         self.addr = addr or f"local://{self.entity}"
         self.meta_pool = meta_pool
@@ -122,6 +124,10 @@ class MDSDaemon:
             if e.rc != EEXIST:
                 raise
         await self.msgr.bind(self.addr)
+        # intercept beacon acks on the rados mon session (chained
+        # dispatcher, the CephFS-client pattern)
+        self._rados_dispatch = self.rados.ms_dispatch
+        self.rados.msgr.set_dispatcher(self)
         self._beacon_task = asyncio.create_task(self._beacon_loop())
         log.dout(1, "%s: up at %s (meta=%s data=%s)", self.entity,
                  self.msgr.my_addr, self.meta_pool, self.data_pool)
@@ -330,7 +336,8 @@ class MDSDaemon:
         pass
 
     def ms_handle_reset(self, conn: Connection) -> None:
-        pass
+        if self._rados_dispatch is not None:
+            self.rados.ms_handle_reset(conn)
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if msg.type == "mds_takeover":
@@ -339,8 +346,21 @@ class MDSDaemon:
             # failed active allocated could be handed out again
             asyncio.get_running_loop().create_task(self._resync())
             return
+        if msg.type == "mds_beacon_ack":
+            # backup resync trigger: acks report our fsmap state, so a
+            # standby->active transition is seen even when the leader's
+            # one-shot takeover notify was lost
+            state = str(msg.data.get("state", ""))
+            if state == "up:active" and self._last_state == "up:standby":
+                asyncio.get_running_loop().create_task(self._resync())
+            self._last_state = state
+            return
         if msg.type != "mds_request":
-            log.dout(10, "%s: ignoring %s", self.entity, msg.type)
+            if self._rados_dispatch is not None:
+                # mon/rados traffic rides our shared dispatcher hook
+                await self._rados_dispatch(conn, msg)
+            else:
+                log.dout(10, "%s: ignoring %s", self.entity, msg.type)
             return
         asyncio.get_running_loop().create_task(
             self._handle_request(conn, msg.data)

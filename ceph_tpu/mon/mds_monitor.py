@@ -149,7 +149,9 @@ class MDSMonitor(PaxosService):
                     f"mds.{name}",
                 )
             except (ConnectionError, OSError):
-                pass        # the mds will also resync on its own terms
+                # backup path: the mds also resyncs when its beacon
+                # acks report the standby->active transition
+                pass
 
         asyncio.get_running_loop().create_task(_send())
 

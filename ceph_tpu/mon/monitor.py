@@ -413,8 +413,17 @@ class Monitor:
             if self._osd_identity_ok(session, None):
                 loop.create_task(self._handle_osd_failure(msg.data))
         elif t == "mds_beacon":
-            # MMDSBeacon: liveness + registration
+            # MMDSBeacon: liveness + registration.  Every mon acks with
+            # its fsmap view of the sender's state — the daemon detects
+            # standby->active transitions from the ack stream even when
+            # the leader's one-shot takeover notify was lost.
             loop.create_task(self._handle_mds_beacon(msg.data))
+            info = self.mds_monitor.mds.get(str(msg.data.get("name")))
+            if info is not None:
+                self._reply(conn, Message("mds_beacon_ack", {
+                    "state": info["state"],
+                    "epoch": self.mds_monitor.epoch,
+                }))
         elif t == "log":
             # MLog: daemons submit cluster-log batches.  The entries'
             # 'who' is forced to the PROVEN session entity so a client

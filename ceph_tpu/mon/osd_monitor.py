@@ -402,6 +402,15 @@ class OSDMonitor(PaxosService):
             updated.min_size = int(val)
         elif var == "pg_num":
             updated.pg_num = int(val)
+        elif var == "hit_set_type":
+            if val not in ("", "bloom"):
+                return CommandResult(EINVAL_RC,
+                                     "hit_set_type must be '' or 'bloom'")
+            updated.hit_set_type = str(val)
+        elif var == "hit_set_period":
+            updated.hit_set_period = float(val)
+        elif var == "hit_set_count":
+            updated.hit_set_count = int(val)
         else:
             return CommandResult(EINVAL_RC, f"cannot set {var!r}")
         self._pending().new_pools.append(updated)

@@ -493,6 +493,23 @@ class OSDDaemon:
                 }))
             except ConnectionError:
                 pass
+        elif t in ("hit_set_ls", "hit_set_contains"):
+            pg = self.pgs.get(PGId(int(msg.data.get("pool", -1)),
+                                   int(msg.data.get("ps", 0))))
+            if pg is None or not pg.is_primary:
+                reply = {"error": "not primary"}
+            elif t == "hit_set_ls":
+                reply = self._hitset_ls(pg)
+            else:
+                reply = self._hitset_contains(
+                    pg, str(msg.data.get("name", ""))
+                )
+            try:
+                conn.send_message(Message(f"{t}_reply", {
+                    "tid": msg.data.get("tid", 0), **reply,
+                }))
+            except ConnectionError:
+                pass
         elif t == "dump_traces":
             try:
                 conn.send_message(Message("dump_traces_reply", {
@@ -922,6 +939,100 @@ class OSDDaemon:
                 out[oid.name] = int(json.loads(raw)["version"])
             except (KeyError, ValueError, TypeError):
                 out[oid.name] = 1
+        return out
+
+    # -- hit sets (reference osd/HitSet.cc + pg hit_set_* machinery) ------
+    def _hitset_record(self, pg: PG, name: str) -> None:
+        """Track an object access in the PG's current bloom set;
+        rotate + archive when the period elapses."""
+        pool = pg.pool
+        if pool.hit_set_type != "bloom" or not pg.is_primary \
+                or not name:
+            return
+        from ceph_tpu.osd.hitset import BloomHitSet
+
+        cache = getattr(self, "_hit_sets", None)
+        if cache is None:
+            cache = self._hit_sets = {}
+        now = time.monotonic()
+        entry = cache.get(pg.pgid)
+        if entry is None:
+            entry = cache[pg.pgid] = [BloomHitSet(seed=hash(pg.pgid)
+                                                  & 0xFFFF), now]
+        hs, start = entry
+        hs.insert(name)
+        period = pool.hit_set_period
+        if period and now - start >= period:
+            cache[pg.pgid] = [BloomHitSet(seed=hs.seed), now]
+            asyncio.get_running_loop().create_task(
+                self._hitset_archive(pg, hs, start)
+            )
+
+    def _hitset_cid(self, pg: PG) -> CollectionId:
+        return (CollectionId(pg.pgid.pool, pg.pgid.ps,
+                             pg.acting_shard_of(self.osd_id))
+                if pg.is_ec
+                else CollectionId(pg.pgid.pool, pg.pgid.ps))
+
+    async def _hitset_archive(self, pg: PG, hs, start: float) -> None:
+        """Persist a filled set; trim archives beyond hit_set_count."""
+        from ceph_tpu.msg.codec import encode as cenc
+
+        cid = self._hitset_cid(pg)
+        meta_oid = GHObject(pg.pgid.pool, "hit_set_meta")
+        key = f"{start:017.6f}"
+        tx = StoreTx()
+        tx.write(cid, GHObject(pg.pgid.pool, f"hit_set_{key}"), 0,
+                 cenc(hs.to_dict()))
+        tx.omap_setkeys(cid, meta_oid, {key: b""})
+        try:
+            await self.store.queue_transactions(tx)
+            archived = sorted(self.store.omap_get(cid, meta_oid))
+            excess = archived[:-pg.pool.hit_set_count] \
+                if pg.pool.hit_set_count > 0 else archived
+            if excess:
+                tx2 = StoreTx()
+                for old in excess:
+                    tx2.remove(cid, GHObject(pg.pgid.pool,
+                                             f"hit_set_{old}"))
+                tx2.omap_rmkeys(cid, meta_oid, list(excess))
+                await self.store.queue_transactions(tx2)
+        except (KeyError, ValueError, OSError) as e:
+            log.derr("%s: hit_set archive failed: %s", self.entity, e)
+
+    def _hitset_ls(self, pg: PG) -> dict:
+        cache = getattr(self, "_hit_sets", None) or {}
+        entry = cache.get(pg.pgid)
+        cid = self._hitset_cid(pg)
+        try:
+            archived = sorted(self.store.omap_get(
+                cid, GHObject(pg.pgid.pool, "hit_set_meta")
+            ))
+        except KeyError:
+            archived = []
+        return {
+            "current_inserts": entry[0].count if entry else 0,
+            "archived": archived,
+        }
+
+    def _hitset_contains(self, pg: PG, name: str) -> dict:
+        from ceph_tpu.msg.codec import decode as cdec
+        from ceph_tpu.osd.hitset import BloomHitSet
+
+        cache = getattr(self, "_hit_sets", None) or {}
+        entry = cache.get(pg.pgid)
+        out = {"current": bool(entry and entry[0].contains(name)),
+               "archives": {}}
+        cid = self._hitset_cid(pg)
+        for key in self._hitset_ls(pg)["archived"]:
+            try:
+                raw = self.store.read(
+                    cid, GHObject(pg.pgid.pool, f"hit_set_{key}")
+                )
+                out["archives"][key] = \
+                    BloomHitSet.from_dict(cdec(raw)).contains(name)
+            except (KeyError, ValueError):
+                out["archives"][key] = False
         return out
 
     _PG_STAT_TTL = 0.5
@@ -1839,6 +1950,7 @@ class OSDDaemon:
             if self._use_mclock:
                 await self.op_scheduler.acquire("client")
             top.mark("dispatched")
+            self._hitset_record(pg, str(d.get("oid", "")))
             special = [op for op in ops
                        if op.get("op") in ("watch", "unwatch", "notify",
                                            "pgls")]

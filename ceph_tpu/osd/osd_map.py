@@ -36,6 +36,9 @@ class PoolInfo:
     crush_rule: str = "replicated_rule"
     ec_profile: str = ""                     # EC profile name
     snap_seq: int = 0                        # newest allocated snap id
+    hit_set_type: str = ""                   # "" = off, or "bloom"
+    hit_set_period: float = 0.0              # seconds per archived set
+    hit_set_count: int = 4                   # archived sets kept
     removed_snaps: list = field(default_factory=list)
 
     def raw_pg_to_pps(self, ps: int) -> int:
@@ -51,6 +54,9 @@ class PoolInfo:
             "crush_rule": self.crush_rule, "ec_profile": self.ec_profile,
             "snap_seq": self.snap_seq,
             "removed_snaps": list(self.removed_snaps),
+            "hit_set_type": self.hit_set_type,
+            "hit_set_period": self.hit_set_period,
+            "hit_set_count": self.hit_set_count,
         }
 
     @classmethod
@@ -64,6 +70,9 @@ class PoolInfo:
             ec_profile=d.get("ec_profile", ""),
             snap_seq=int(d.get("snap_seq", 0)),
             removed_snaps=[int(s) for s in d.get("removed_snaps", ())],
+            hit_set_type=str(d.get("hit_set_type", "")),
+            hit_set_period=float(d.get("hit_set_period", 0.0)),
+            hit_set_count=int(d.get("hit_set_count", 4)),
         )
 
 

@@ -1,0 +1,172 @@
+"""rbd: the block-image admin CLI.
+
+The role of reference src/tools/rbd (rbd create/ls/info/snap/clone/...):
+a thin command surface over services.rbd against a cluster conf file
+(DevCluster.write_conf), plus import/export to local files.
+
+Usage:
+    python -m ceph_tpu.rbd_tool --conf cluster.json --pool rbd \
+        create img1 --size 8388608
+    python -m ceph_tpu.rbd_tool ... snap create img1@s1
+    python -m ceph_tpu.rbd_tool ... clone img1@s1 img2
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ceph_tpu.services.rbd import RBD, RBDError
+
+
+def _image_spec(spec: str) -> tuple[str, str | None]:
+    name, _, snap = spec.partition("@")
+    return name, (snap or None)
+
+
+async def _run(args) -> int:
+    from ceph_tpu.cli import _load_conf
+    from ceph_tpu.client.rados import Rados
+
+    monmap, conf = _load_conf(args.conf)
+    rados = Rados(monmap, conf, name="client.rbd-tool")
+    try:
+        await rados.connect(timeout=args.timeout)
+        ioctx = await rados.open_ioctx(args.pool)
+        rbd = RBD(ioctx)
+        out = await _dispatch(args, rbd)
+        if out is not None:
+            print(json.dumps(out, indent=2, default=str))
+        return 0
+    except (RBDError, KeyError) as e:
+        print(f"rbd: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await rados.shutdown()
+
+
+async def _dispatch(args, rbd: RBD):
+    cmd = args.cmd
+    if cmd == "create":
+        await rbd.create(args.image, args.size, order=args.order,
+                         object_map=not args.no_object_map)
+        return None
+    if cmd == "ls":
+        return await rbd.list()
+    if cmd == "info":
+        img = await rbd.open(args.image)
+        info = img.stat()
+        info["snaps"] = img.snap_list()
+        if img.parent is not None:
+            info["parent"] = img.parent
+        return info
+    if cmd == "rm":
+        await rbd.remove(args.image)
+        return None
+    if cmd == "resize":
+        img = await rbd.open(args.image)
+        await img.resize(args.size)
+        return None
+    if cmd == "children":
+        name, snap = _image_spec(args.snap_spec)
+        if snap is None:
+            raise RBDError("children wants image@snap")
+        return await rbd.children(name, snap)
+    if cmd == "clone":
+        name, snap = _image_spec(args.snap_spec)
+        if snap is None:
+            raise RBDError("clone wants parent image@snap")
+        await rbd.clone(name, snap, args.child)
+        return None
+    if cmd == "flatten":
+        img = await rbd.open(args.image)
+        await img.flatten()
+        return None
+    if cmd == "object-map":
+        img = await rbd.open(args.image)
+        await img.object_map_rebuild()
+        return None
+    if cmd == "export":
+        img = await rbd.open(args.image)
+        data = await img.read(0, img.size)
+        with open(args.path, "wb") as f:
+            f.write(data)
+        return {"exported": len(data)}
+    if cmd == "import":
+        with open(args.path, "rb") as f:
+            data = f.read()
+        await rbd.create(args.image, len(data), order=args.order)
+        img = await rbd.open(args.image)
+        await img.write(0, data)
+        return {"imported": len(data)}
+    if cmd == "snap":
+        name, snap = _image_spec(args.snap_spec)
+        img = await rbd.open(name)
+        if args.snap_cmd == "ls":
+            return img.snap_list()
+        if snap is None:
+            raise RBDError(f"snap {args.snap_cmd} wants image@snap")
+        if args.snap_cmd == "create":
+            await img.snap_create(snap)
+        elif args.snap_cmd == "rm":
+            await img.snap_remove(snap)
+        elif args.snap_cmd == "protect":
+            await img.snap_protect(snap)
+        elif args.snap_cmd == "unprotect":
+            await img.snap_unprotect(snap)
+        elif args.snap_cmd == "rollback":
+            await img.snap_rollback(snap)
+        return None
+    raise RBDError(f"unknown command {cmd!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="rbd", description=__doc__)
+    p.add_argument("--conf", default="cluster.json")
+    p.add_argument("--pool", default="rbd")
+    p.add_argument("--timeout", type=float, default=15.0)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("create")
+    c.add_argument("image")
+    c.add_argument("--size", type=int, required=True)
+    c.add_argument("--order", type=int, default=22)
+    c.add_argument("--no-object-map", action="store_true")
+    sub.add_parser("ls")
+    for name in ("info", "rm", "flatten"):
+        x = sub.add_parser(name)
+        x.add_argument("image")
+    r = sub.add_parser("resize")
+    r.add_argument("image")
+    r.add_argument("--size", type=int, required=True)
+    om = sub.add_parser("object-map")
+    om.add_argument("om_cmd", choices=["rebuild"])
+    om.add_argument("image")
+    ch = sub.add_parser("children")
+    ch.add_argument("snap_spec", help="image@snap")
+    cl = sub.add_parser("clone")
+    cl.add_argument("snap_spec", help="parent image@snap")
+    cl.add_argument("child")
+    for name in ("export", "import"):
+        x = sub.add_parser(name)
+        x.add_argument("image")
+        x.add_argument("path")
+        if name == "import":
+            x.add_argument("--order", type=int, default=22)
+    sn = sub.add_parser("snap")
+    sn.add_argument("snap_cmd", choices=[
+        "create", "ls", "rm", "protect", "unprotect", "rollback",
+    ])
+    sn.add_argument("snap_spec", help="image[@snap]")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return asyncio.run(_run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

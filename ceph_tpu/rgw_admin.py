@@ -1,0 +1,145 @@
+"""radosgw-admin: the object-gateway admin CLI.
+
+The role of reference src/rgw/rgw_admin.cc reduced to the surfaces our
+RGW-lite implements: user management + quotas, bucket listing/stats,
+ACLs, lifecycle processing.
+
+Usage:
+    python -m ceph_tpu.rgw_admin --conf cluster.json --pool rgw \
+        user create --uid alice
+    python -m ceph_tpu.rgw_admin ... bucket stats --bucket site
+    python -m ceph_tpu.rgw_admin ... lc process
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers
+
+
+async def _run(args) -> int:
+    from ceph_tpu.cli import _load_conf
+    from ceph_tpu.client.rados import Rados
+
+    monmap, conf = _load_conf(args.conf)
+    rados = Rados(monmap, conf, name="client.rgw-admin")
+    try:
+        await rados.connect(timeout=args.timeout)
+        ioctx = await rados.open_ioctx(args.pool)
+        users = RGWUsers(ioctx)
+        gw = RGWLite(ioctx, users=users)   # admin/system context
+        out = await _dispatch(args, gw, users)
+        if out is not None:
+            print(json.dumps(out, indent=2, default=str))
+        return 0
+    except (RGWError, KeyError) as e:
+        print(f"radosgw-admin: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await rados.shutdown()
+
+
+async def _dispatch(args, gw: RGWLite, users: RGWUsers):
+    if args.cmd == "user":
+        if args.sub == "create":
+            return await users.create(
+                args.uid, args.display_name,
+                max_size=args.max_size, max_objects=args.max_objects,
+            )
+        if args.sub == "ls":
+            return await users.list()
+        if args.sub == "info":
+            return await users.get(args.uid)
+        if args.sub == "rm":
+            await users.remove(args.uid)
+            return None
+    if args.cmd == "quota":
+        await users.set_quota(args.uid, max_size=args.max_size,
+                              max_objects=args.max_objects)
+        return None
+    if args.cmd == "bucket":
+        if args.sub == "ls":
+            return await gw.list_buckets()
+        if args.sub == "stats":
+            size, count = await gw._bucket_usage(args.bucket)
+            meta = await gw._bucket_meta(args.bucket)
+            return {
+                "bucket": args.bucket,
+                "owner": meta.get("owner", ""),
+                "size_bytes": size,
+                "num_objects": count,
+                "quota": meta.get("quota", {}),
+            }
+        if args.sub == "quota":
+            await gw.set_bucket_quota(args.bucket,
+                                      max_size=args.max_size,
+                                      max_objects=args.max_objects)
+            return None
+        if args.sub == "acl":
+            await gw.put_bucket_acl(args.bucket, args.canned)
+            return None
+    if args.cmd == "lc":
+        if args.sub == "process":
+            return await gw.lc_process()
+        if args.sub == "get":
+            return await gw.get_lifecycle(args.bucket)
+    raise RGWError("InvalidArgument", f"{args.cmd} {args.sub}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="radosgw-admin",
+                                description=__doc__)
+    p.add_argument("--conf", default="cluster.json")
+    p.add_argument("--pool", default="rgw")
+    p.add_argument("--timeout", type=float, default=15.0)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    user = sub.add_parser("user")
+    user_sub = user.add_subparsers(dest="sub", required=True)
+    uc = user_sub.add_parser("create")
+    uc.add_argument("--uid", required=True)
+    uc.add_argument("--display-name", default="")
+    uc.add_argument("--max-size", type=int, default=0)
+    uc.add_argument("--max-objects", type=int, default=0)
+    user_sub.add_parser("ls")
+    for name in ("info", "rm"):
+        x = user_sub.add_parser(name)
+        x.add_argument("--uid", required=True)
+
+    quota = sub.add_parser("quota")
+    quota.add_argument("sub", choices=["set"])
+    quota.add_argument("--uid", required=True)
+    quota.add_argument("--max-size", type=int, default=0)
+    quota.add_argument("--max-objects", type=int, default=0)
+
+    bucket = sub.add_parser("bucket")
+    bucket_sub = bucket.add_subparsers(dest="sub", required=True)
+    bucket_sub.add_parser("ls")
+    for name in ("stats", "quota", "acl"):
+        x = bucket_sub.add_parser(name)
+        x.add_argument("--bucket", required=True)
+        if name == "quota":
+            x.add_argument("--max-size", type=int, default=0)
+            x.add_argument("--max-objects", type=int, default=0)
+        if name == "acl":
+            x.add_argument("--canned", default="private")
+
+    lc = sub.add_parser("lc")
+    lc_sub = lc.add_subparsers(dest="sub", required=True)
+    lc_sub.add_parser("process")
+    lg = lc_sub.add_parser("get")
+    lg.add_argument("--bucket", required=True)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return asyncio.run(_run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -312,6 +312,27 @@ def _register_builtins(reg: ClassRegistry) -> None:
         ctx.setxattr("rbd.header", json.dumps(h).encode())
         return b""
 
+    # -- cls_bitmap (the atomic-update half of cls_rbd's object-map ops:
+    # the OR happens INSIDE the OSD op, so two clients merging bits can
+    # never lose each other's update to a read-modify-write race) ------
+    def bitmap_or(ctx: ClsContext, indata: bytes) -> bytes:
+        import base64
+
+        incoming = base64.b64decode(_j(indata)["bits_b64"])
+        try:
+            current = bytearray(ctx.read())
+        except ClsError:
+            current = bytearray()
+        if len(current) < len(incoming):
+            current.extend(bytes(len(incoming) - len(current)))
+        for i, b in enumerate(incoming):
+            current[i] |= b
+        ctx.create()
+        ctx.write_full(bytes(current))
+        return base64.b64encode(bytes(current))
+
+    reg.register("bitmap", "or", bitmap_or)
+
     # -- cls_rgw bucket data log (the reference's cls_rgw bilog: atomic
     # server-side seq allocation + entry append, the source multisite
     # sync tails — src/cls/rgw bucket-index log ops) --------------------

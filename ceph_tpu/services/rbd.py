@@ -284,24 +284,19 @@ class Image:
         self._om[byte] |= 1 << (objectno & 7)
         # Persisted BEFORE the data write lands (may-exist is safe;
         # definitely-absent with data present would corrupt reads).
-        # OR-merge with the on-disk map: bits are only ever SET here,
-        # so merging prevents one handle's stale view from clearing
-        # another writer's bits (lost update); "may exist" bits that
-        # survive a concurrent shrink are safe by definition.
-        try:
-            disk = bytearray(await self.ioctx.read(self._om_oid))
-        except RadosError as e:
-            if e.rc != -2:
-                raise
-            disk = bytearray()
-        if len(disk) < len(self._om):
-            disk.extend(bytes(len(self._om) - len(disk)))
-        for i, b in enumerate(self._om):
-            disk[i] |= b
-        self._om = disk
-        await self.ioctx.operate(
-            self._om_oid, ObjectOperation().write_full(bytes(self._om))
+        # The merge happens SERVER-SIDE in one atomic class op
+        # (cls bitmap.or), so two writer handles can never lose each
+        # other's bits to a read-modify-write race; the reply is the
+        # merged map, refreshing our view for free.
+        import base64 as _b64
+
+        merged = await self.ioctx.exec(
+            self._om_oid, "bitmap", "or",
+            json.dumps({
+                "bits_b64": _b64.b64encode(bytes(self._om)).decode(),
+            }).encode(),
         )
+        self._om = bytearray(_b64.b64decode(merged))
 
     async def object_map_rebuild(self) -> None:
         """Rescan data objects into a fresh bitmap (rbd object-map

@@ -187,7 +187,10 @@ class RGWLite:
         if self.user == owner:
             return True
         canned = acl.get("canned", "private")
-        if canned == "public-read-write":
+        # canned publics grant data access only — never FULL_CONTROL
+        # (ACL/quota/lifecycle administration stays with the owner and
+        # explicit FULL_CONTROL grantees)
+        if canned == "public-read-write" and need in ("READ", "WRITE"):
             return True
         if canned == "public-read" and need == "READ":
             return True
@@ -212,18 +215,14 @@ class RGWLite:
                              grants: list[dict] | None = None) -> None:
         if canned not in _CANNED_ACLS:
             raise RGWError("InvalidArgument", canned)
-        meta = await self._bucket_meta(bucket)
-        if self.user is not None and self.user != meta.get("owner"):
-            raise RGWError("AccessDenied", bucket)
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
         meta["acl"] = {"canned": canned, "grants": list(grants or ())}
         await self._put_bucket_meta(bucket, meta)
 
     async def get_bucket_acl(self, bucket: str) -> dict:
-        """Owner-only, like S3's READ_ACP default: grant lists and
-        ownership are not disclosed to mere readers."""
-        meta = await self._bucket_meta(bucket)
-        if self.user is not None and self.user != meta.get("owner"):
-            raise RGWError("AccessDenied", bucket)
+        """Owner / FULL_CONTROL grantees only (the READ_ACP gate):
+        grant lists and ownership are not disclosed to mere readers."""
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
         return {"owner": meta.get("owner", ""),
                 "acl": meta.get("acl", {"canned": "private"})}
 
@@ -243,9 +242,7 @@ class RGWLite:
 
     async def set_bucket_quota(self, bucket: str, max_size: int = 0,
                                max_objects: int = 0) -> None:
-        meta = await self._bucket_meta(bucket)
-        if self.user is not None and self.user != meta.get("owner"):
-            raise RGWError("AccessDenied", bucket)
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
         meta["quota"] = {"max_size": int(max_size),
                          "max_objects": int(max_objects)}
         await self._put_bucket_meta(bucket, meta)
@@ -295,9 +292,7 @@ class RGWLite:
                             rules: list[dict]) -> None:
         """rules: [{id, prefix, status, expiration_days |
         expiration_seconds}]."""
-        meta = await self._bucket_meta(bucket)
-        if self.user is not None and self.user != meta.get("owner"):
-            raise RGWError("AccessDenied", bucket)
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
         for r in rules:
             if "expiration_days" not in r \
                     and "expiration_seconds" not in r:
@@ -307,15 +302,11 @@ class RGWLite:
         await self._put_bucket_meta(bucket, meta)
 
     async def get_lifecycle(self, bucket: str) -> list[dict]:
-        meta = await self._bucket_meta(bucket)
-        if self.user is not None and self.user != meta.get("owner"):
-            raise RGWError("AccessDenied", bucket)
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
         return meta.get("lifecycle", [])
 
     async def delete_lifecycle(self, bucket: str) -> None:
-        meta = await self._bucket_meta(bucket)
-        if self.user is not None and self.user != meta.get("owner"):
-            raise RGWError("AccessDenied", bucket)
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
         meta.pop("lifecycle", None)
         await self._put_bucket_meta(bucket, meta)
 

@@ -151,20 +151,21 @@ class DevCluster:
         pools must already exist; the filesystem is registered in the
         monitor's FSMap when not already present."""
         from ceph_tpu.mds.daemon import MDSDaemon
-        admin = await self.client()
-        r = await admin.mon_command("fs new", fs_name=fs_name,
-                                    metadata=meta_pool, data=data_pool)
-        assert r["rc"] in (0, -17), r       # EEXIST on restart is fine
-        await admin.shutdown()
         entity = f"client.mds.{name}"
-        if self.cephx and entity not in self._entity_keys:
-            admin = await self.client()
-            r = await admin.mon_command(
-                "auth get-or-create", entity=entity,
-                caps={"mon": "allow r", "osd": "allow *"},
-            )
-            assert r["rc"] == 0, r
-            self._entity_keys[entity] = r["data"]["key"]
+        admin = await self.client()
+        try:
+            r = await admin.mon_command("fs new", fs_name=fs_name,
+                                        metadata=meta_pool,
+                                        data=data_pool)
+            assert r["rc"] in (0, -17), r   # EEXIST on restart is fine
+            if self.cephx and entity not in self._entity_keys:
+                r = await admin.mon_command(
+                    "auth get-or-create", entity=entity,
+                    caps={"mon": "allow r", "osd": "allow *"},
+                )
+                assert r["rc"] == 0, r
+                self._entity_keys[entity] = r["data"]["key"]
+        finally:
             await admin.shutdown()
         addr = None
         if self.tcp:

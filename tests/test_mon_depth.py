@@ -125,6 +125,52 @@ def test_nonsticky_mute_clears_with_check():
     asyncio.run(run())
 
 
+def test_stale_subscriber_catches_up_past_trim_window():
+    """A subscriber that slept past the mon's incremental-trim window
+    must receive a FULL map, not a gap (OSDMonitor epoch pruning +
+    the subscription push path)."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=2)
+        await cluster.start()
+        try:
+            mon = next(iter(cluster.mons.values()))
+            mon.osd_monitor.KEEP_EPOCHS = 4      # tiny trim window
+            rados = await cluster.client()
+            base_epoch = mon.osd_monitor.osdmap.epoch
+            # churn way past the window
+            for i in range(10):
+                r = await rados.mon_command("osd pool create",
+                                            pool=f"churn-{i}",
+                                            pg_num=4, size=2)
+                assert r["rc"] == 0, r
+            cur = mon.osd_monitor.osdmap.epoch
+            assert cur - base_epoch >= 10
+            # the early incrementals are gone from the store
+            assert mon.store.get("osdmap", f"inc_{base_epoch}") is None
+
+            # a client claiming an ancient epoch resubscribes
+            stale = await cluster.client("client.stale")
+            stale.monc.sub_have["osdmap"] = 1
+            stale.monc.osdmap = None
+            stale.monc.renew_subs()
+            deadline = asyncio.get_running_loop().time() + 10
+            while True:
+                m = stale.monc.osdmap
+                if m is not None and m.epoch >= cur:
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            # the recovered map is complete, not a partial delta
+            names = {p.name for p in m.pools.values()}
+            assert {f"churn-{i}" for i in range(10)} <= names
+            await stale.shutdown()
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
 def test_mgr_pgmap_digest():
     async def run():
         cluster = DevCluster(n_mons=1, n_osds=3)
