@@ -408,8 +408,14 @@ class OSDMonitor(PaxosService):
                                      "hit_set_type must be '' or 'bloom'")
             updated.hit_set_type = str(val)
         elif var == "hit_set_period":
+            if float(val) < 0:
+                return CommandResult(EINVAL_RC,
+                                     "hit_set_period must be >= 0")
             updated.hit_set_period = float(val)
         elif var == "hit_set_count":
+            if int(val) < 1:
+                return CommandResult(EINVAL_RC,
+                                     "hit_set_count must be >= 1")
             updated.hit_set_count = int(val)
         else:
             return CommandResult(EINVAL_RC, f"cannot set {var!r}")

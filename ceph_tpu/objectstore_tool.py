@@ -25,11 +25,9 @@ from ceph_tpu.store.types import NO_GEN, NO_SHARD, CollectionId, GHObject
 from ceph_tpu.store.walstore import WalStore
 
 
-def _cid_str(cid: CollectionId) -> str:
-    s = f"{cid.pool}.{cid.pg}"
-    if cid.shard >= 0:
-        s += f"s{cid.shard}"
-    return s
+# collection keys use CollectionId.__str__ (hex pg, the store's own
+# naming) so listings cross-reference the on-disk collection names;
+# --ps is therefore parsed as hex
 
 
 def _oid_json(oid: GHObject) -> dict:
@@ -51,7 +49,7 @@ async def _run(args) -> int:
             out = {}
             for cid in sorted(store.list_collections(),
                               key=lambda c: (c.pool, c.pg, c.shard)):
-                out[_cid_str(cid)] = [
+                out[str(cid)] = [
                     _oid_json(o) for o in store.list_objects(cid)
                 ]
             print(json.dumps(out, indent=2))
@@ -105,7 +103,8 @@ def main(argv=None) -> int:
     p.add_argument("--op", required=True,
                    choices=["list", "dump", "export", "info"])
     p.add_argument("--pool", type=int, default=0)
-    p.add_argument("--ps", type=int, default=0)
+    p.add_argument("--ps", type=lambda s: int(s, 16),
+               default=0, help="pg id (hex, as listed)")
     p.add_argument("--shard", type=int, default=NO_SHARD)
     p.add_argument("--snap", type=int, default=-2)
     p.add_argument("--name", default="")

@@ -962,10 +962,12 @@ class OSDDaemon:
         hs, start = entry
         hs.insert(name)
         period = pool.hit_set_period
-        if period and now - start >= period:
+        if period > 0 and now - start >= period:
             cache[pg.pgid] = [BloomHitSet(seed=hs.seed), now]
+            # archive keys are WALL time: monotonic restarts at boot
+            # and would sort fresh sets before persisted old ones
             asyncio.get_running_loop().create_task(
-                self._hitset_archive(pg, hs, start)
+                self._hitset_archive(pg, hs, time.time())
             )
 
     def _hitset_cid(self, pg: PG) -> CollectionId:

@@ -534,13 +534,16 @@ class CrushMap:
         xs: Sequence[int],
         result_max: int,
         reweights: Sequence[int] | None = None,
+        choose_args: str | None = None,
     ) -> np.ndarray:
         """Bulk PG mapping (the OSDMapMapping.cc threaded-bulk analog,
         reference src/osd/OSDMapMapping.cc): map many placement inputs at
-        once. Returns (len(xs), result_max) int32, ITEM_NONE-padded."""
+        once. Returns (len(xs), result_max) int32, ITEM_NONE-padded.
+        See placement.bulk.map_pgs_bulk for the vectorized machine."""
         out = np.full((len(xs), result_max), ITEM_NONE, np.int32)
         for i, x in enumerate(xs):
-            row = self.do_rule(rule, int(x), result_max, reweights)
+            row = self.do_rule(rule, int(x), result_max, reweights,
+                               choose_args)
             out[i, : len(row)] = row
         return out
 

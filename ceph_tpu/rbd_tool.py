@@ -30,7 +30,12 @@ async def _run(args) -> int:
     from ceph_tpu.cli import _load_conf
     from ceph_tpu.client.rados import Rados
 
-    monmap, conf = _load_conf(args.conf)
+    try:
+        monmap, conf = _load_conf(args.conf)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"rbd: bad conf {args.conf!r}: {e}",
+              file=sys.stderr)
+        return 1
     rados = Rados(monmap, conf, name="client.rbd-tool")
     try:
         await rados.connect(timeout=args.timeout)
@@ -40,7 +45,7 @@ async def _run(args) -> int:
         if out is not None:
             print(json.dumps(out, indent=2, default=str))
         return 0
-    except (RBDError, KeyError) as e:
+    except (IOError, KeyError) as e:
         print(f"rbd: {e}", file=sys.stderr)
         return 1
     finally:

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import time
 
+from ceph_tpu.placement.crush_map import ITEM_NONE
+
 
 class MgrModule:
     name = ""
@@ -57,14 +59,27 @@ class Balancer(MgrModule):
         self.optimizations = 0
 
     def _pg_distribution(self):
-        """(pg counts per up-OSD, pg -> up set) over all pools."""
+        """(pg counts per up-OSD, pg -> up set) over all pools.
+
+        The full-map scan rides the vectorized bulk mapper (one
+        masked-numpy rule evaluation per pool instead of a per-PG
+        python loop — the OSDMapMapping role); upmap/pg_temp overrides
+        still apply per PG on top of the raw CRUSH rows."""
+        from ceph_tpu.placement.bulk import map_pgs_bulk
+
         m = self.mgr.monc.osdmap
         counts = {o: 0 for o, i in m.osds.items()
                   if i.up and i.in_cluster}
         placement = {}
+        rw = m.reweight_vector()
         for pool in m.pools.values():
-            for ps in range(pool.pg_num):
-                up, _, _, _ = m.pg_to_up_acting(pool.pool_id, ps)
+            xs = [pool.raw_pg_to_pps(ps) for ps in range(pool.pg_num)]
+            raw_rows = map_pgs_bulk(m.crush, pool.crush_rule, xs,
+                                    pool.size, rw)
+            for ps, raw in enumerate(raw_rows):
+                raw = [int(o) for o in raw if o != ITEM_NONE]
+                raw = m._apply_upmap(pool.pool_id, ps, raw)
+                up = m.raw_to_up_osds(pool.pool_id, raw)
                 placement[(pool.pool_id, ps)] = up
                 for o in up:
                     if o in counts:

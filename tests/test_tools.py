@@ -160,7 +160,7 @@ def test_objectstore_tool(tmp_path, capsys):
         (c, o) for c, o in listing.items()
         if any(e["name"] == "obj-A" for e in o)
     )
-    pool_s, ps_s = cid.split(".")
+    pool_s, ps_s = cid.split(".")   # ps is hex (store naming)
     rc = objectstore_tool.main([
         "--data-path", data_path, "--op", "dump",
         "--pool", pool_s, "--ps", ps_s, "--name", "obj-A",
