@@ -42,6 +42,9 @@ def _parse_args(argv=None):
                    help="exhaustively verify all erasure combinations "
                         "(decode_erasures sweep)")
     p.add_argument("--json", action="store_true", help="emit one JSON line")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="wrap the run in jax.profiler.trace(DIR) — "
+                        "inspect with tensorboard/xprof")
     return p.parse_args(argv)
 
 
@@ -251,16 +254,27 @@ def verify_all_erasures(ec, size: int = 4096) -> int:
 def main(argv=None) -> dict:
     args = _parse_args(argv)
     ec = make_codec(args.plugin, args.parameter)
-    if args.verify:
-        n = verify_all_erasures(ec)
-        result = {"workload": "verify", "combinations": n, "ok": True}
-    elif args.workload == "encode":
-        result = run_encode(ec, args.size, args.iterations, args.stripes)
-    else:
-        result = run_decode(
-            ec, args.size, args.iterations, args.stripes,
-            args.erasures, args.erased,
-        )
+    profiler = None
+    if args.profile:
+        import jax.profiler as profiler
+
+        profiler.start_trace(args.profile)
+    try:
+        if args.verify:
+            n = verify_all_erasures(ec)
+            result = {"workload": "verify", "combinations": n,
+                      "ok": True}
+        elif args.workload == "encode":
+            result = run_encode(ec, args.size, args.iterations,
+                                args.stripes)
+        else:
+            result = run_decode(
+                ec, args.size, args.iterations, args.stripes,
+                args.erasures, args.erased,
+            )
+    finally:
+        if profiler is not None:
+            profiler.stop_trace()
     result["plugin"] = args.plugin
     result["profile"] = ec.get_profile()
     print(json.dumps(result) if args.json else result)

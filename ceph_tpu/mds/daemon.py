@@ -129,6 +129,23 @@ class MDSDaemon:
         self._rados_dispatch = self.rados.ms_dispatch
         self.rados.msgr.set_dispatcher(self)
         self._beacon_task = asyncio.create_task(self._beacon_loop())
+        run_dir = self.conf["admin_socket_dir"]
+        if run_dir:
+            from ceph_tpu.common.admin_socket import AdminSocket
+
+            sock = AdminSocket(self.entity)
+            sock.register("status", lambda: {
+                "entity": self.entity, "fs": self.fs_name,
+                "state": self._last_state or "booting",
+                "next_ino": self.next_ino,
+                "journal_len": self.journal_len,
+            }, "mds state")
+            sock.register("config show", self.conf.show,
+                          "live configuration")
+            await sock.start(run_dir)
+            self.admin_socket = sock
+        else:
+            self.admin_socket = None
         log.dout(1, "%s: up at %s (meta=%s data=%s)", self.entity,
                  self.msgr.my_addr, self.meta_pool, self.data_pool)
 
@@ -151,6 +168,9 @@ class MDSDaemon:
             await asyncio.sleep(interval)
 
     async def shutdown(self) -> None:
+        if getattr(self, "admin_socket", None) is not None:
+            await self.admin_socket.stop()
+            self.admin_socket = None
         if self._beacon_task is not None:
             self._beacon_task.cancel()
             self._beacon_task = None

@@ -111,6 +111,7 @@ class Monitor:
         # mutations can't both build epoch N+1 and lose one's changes
         self._mutate_lock = DLock("mon-mutate")
         self._tasks: list[asyncio.Task] = []
+        self._send_tasks: set[asyncio.Task] = set()
         self._genesis_inflight = False
         self._stopped = False
 
@@ -175,6 +176,8 @@ class Monitor:
         self.elector.stop()
         for t in self._tasks:
             t.cancel()
+        for t in list(self._send_tasks):
+            t.cancel()
         if getattr(self, "admin_socket", None) is not None:
             await self.admin_socket.stop()
             self.admin_socket = None
@@ -213,7 +216,9 @@ class Monitor:
                 log.dout(10, "%s: send to mon.%s failed: %s",
                          self.name, peer, e)
 
-        asyncio.get_running_loop().create_task(_send())
+        task = asyncio.get_running_loop().create_task(_send())
+        self._send_tasks.add(task)
+        task.add_done_callback(self._send_tasks.discard)
 
     # -- election/paxos callbacks -----------------------------------------
     async def _on_win(self) -> None:

@@ -408,7 +408,7 @@ class OSDMonitor(PaxosService):
                                      "hit_set_type must be '' or 'bloom'")
             updated.hit_set_type = str(val)
         elif var == "hit_set_period":
-            if float(val) < 0:
+            if not float(val) >= 0:      # rejects negatives AND NaN
                 return CommandResult(EINVAL_RC,
                                      "hit_set_period must be >= 0")
             updated.hit_set_period = float(val)

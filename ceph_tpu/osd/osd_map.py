@@ -280,12 +280,20 @@ class OSDMap:
                     break
         return out
 
+    def raw_row_to_up(self, pool_id: int, ps: int,
+                      raw: list[int]) -> list[int]:
+        """CRUSH row -> up set: ITEM_NONE normalization, upmap remap,
+        down-filtering — shared by pg_to_up_acting and bulk-mapping
+        consumers (the balancer) so the pipelines cannot drift."""
+        raw = [NO_OSD if o == ITEM_NONE else o for o in raw]
+        raw = self._apply_upmap(pool_id, ps, raw)
+        return self.raw_to_up_osds(pool_id, raw)
+
     def pg_to_up_acting(self, pool_id: int, ps: int):
         """(up, up_primary, acting, acting_primary) with upmap then
         pg_temp / primary_temp overrides (OSDMap.cc _get_temp_osds)."""
-        raw = self._apply_upmap(pool_id, ps,
+        up = self.raw_row_to_up(pool_id, ps,
                                 self.pg_to_raw_osds(pool_id, ps))
-        up = self.raw_to_up_osds(pool_id, raw)
         acting = list(self.pg_temp.get((pool_id, ps), up))
         if not acting:
             acting = up

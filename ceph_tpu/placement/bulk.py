@@ -134,7 +134,9 @@ def map_pgs_bulk(m: CrushMap, rule: Rule | str, xs, result_max: int,
         op, numrep, type_name = rule.steps[1]
         if numrep <= 0:
             numrep += result_max
-        numrep = min(numrep, result_max)
+        # numrep stays UNCAPPED: the scalar machine computes every
+        # replica slot and only emit truncates, so a skipped slot can
+        # be backfilled by a later one (bit-identity requires the same)
         type_id = m.types[type_name]
         leaf = op.startswith("chooseleaf")
         take_id = m.names[rule.steps[0][1]]

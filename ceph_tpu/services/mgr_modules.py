@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import time
 
-from ceph_tpu.placement.crush_map import ITEM_NONE
 
 
 class MgrModule:
@@ -77,9 +76,8 @@ class Balancer(MgrModule):
             raw_rows = map_pgs_bulk(m.crush, pool.crush_rule, xs,
                                     pool.size, rw)
             for ps, raw in enumerate(raw_rows):
-                raw = [int(o) for o in raw if o != ITEM_NONE]
-                raw = m._apply_upmap(pool.pool_id, ps, raw)
-                up = m.raw_to_up_osds(pool.pool_id, raw)
+                up = m.raw_row_to_up(pool.pool_id, ps,
+                                     [int(o) for o in raw])
                 placement[(pool.pool_id, ps)] = up
                 for o in up:
                     if o in counts:

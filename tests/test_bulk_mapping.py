@@ -102,6 +102,40 @@ def test_oversubscribed_and_choose_args():
     )
 
 
+def test_chooseleaf_with_reweights_bit_identity():
+    """The balancer's production shape: chooseleaf over hosts with a
+    live reweight vector (leaf-level rejection branch)."""
+    m = build(29, hosts=5, per_host=3)
+    m.create_replicated_rule("data", failure_domain="host")
+    xs = list(range(600))
+    rw = [0x10000] * 15
+    rw[4] = 0          # out
+    rw[9] = 0x4000     # 25% accept
+    rw[14] = 0x8000    # 50% accept
+    got = map_pgs_bulk(m, "data", xs, 3, reweights=rw)
+    want = _scalar(m, "data", xs, 3, reweights=rw)
+    np.testing.assert_array_equal(got, want)
+    assert not (got == 4).any()
+
+
+def test_numrep_exceeding_result_max_backfills():
+    """Regression: a rule whose explicit numrep exceeds result_max must
+    compute every replica slot (a skipped slot backfills from a later
+    one) and only truncate at emit — the scalar semantics."""
+    m = build(31, hosts=5, per_host=1)
+    m.tunables.choose_total_tries = 1      # force frequent skips
+    m.add_rule(Rule("wide", [("take", "default"),
+                             ("chooseleaf_firstn", 4, "host"),
+                             ("emit",)]))
+    xs = list(range(400))
+    got = map_pgs_bulk(m, "wide", xs, 3)
+    want = _scalar(m, "wide", xs, 3)
+    np.testing.assert_array_equal(got, want)
+    # the scenario is real: some row actually used the 4th slot
+    full = (got != ITEM_NONE).all(axis=1)
+    assert full.any()
+
+
 def test_unsupported_shapes_fall_back():
     m = build(17)
     m.create_ec_rule("ec", 4, failure_domain="osd")  # indep -> fallback

@@ -125,6 +125,49 @@ def test_nonsticky_mute_clears_with_check():
     asyncio.run(run())
 
 
+def test_three_mon_log_and_health_quorum():
+    """The round-2 mon services survive a real quorum: log entries
+    route peon -> leader, health aggregates identically from any mon,
+    and the cluster log replicates through paxos to every member."""
+    async def run():
+        cluster = DevCluster(n_mons=3, n_osds=3)
+        await cluster.start()
+        try:
+            rados, _ = await _write_some(cluster, pool="q3")
+            await cluster.wait_health_ok()
+            r = await rados.mon_command("log", message="quorum-entry",
+                                        who="client.q3")
+            assert r["rc"] == 0, r
+            await asyncio.sleep(0.5)
+            # every monitor's replicated log holds the entry
+            for mon in cluster.mons.values():
+                msgs = [e["message"] for e in mon.log_monitor.entries]
+                assert "quorum-entry" in msgs, (mon.name, msgs[-5:])
+            # osd boot events were cluster-logged through the leader
+            r = await rados.mon_command("log last", num=100)
+            assert any("boot" in e["message"] for e in r["data"])
+            # health agrees across a failure no matter who answers
+            await cluster.kill_osd(1)
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                r = await rados.mon_command("health")
+                if r["data"]["status"] == "HEALTH_WARN":
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+            assert "OSD_DOWN" in r["data"]["checks"]
+            statuses = {
+                m.health_monitor.summary()["status"]
+                for m in cluster.mons.values()
+            }
+            assert statuses == {"HEALTH_WARN"}
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
 def test_stale_subscriber_catches_up_past_trim_window():
     """A subscriber that slept past the mon's incremental-trim window
     must receive a FULL map, not a gap (OSDMonitor epoch pruning +
