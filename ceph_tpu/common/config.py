@@ -126,6 +126,9 @@ def global_options() -> list[Option]:
         Option("trace_probability", float, 0.0,
                "fraction of client ops that carry a trace context "
                "(zipkin_trace analog; 0=off)", min=0.0, max=1.0),
+        Option("ms_secure_mode", bool, False,
+               "AES-256-GCM on-wire frame encryption (crypto_onwire "
+               "analog); needs a configured auth key on every daemon"),
         Option("ms_dispatch_throttle_bytes", int, 100 << 20,
                "max bytes of in-dispatch messages per peer type before "
                "the reader backpressures (0=unlimited)", min=0),

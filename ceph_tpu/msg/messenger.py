@@ -34,6 +34,7 @@ log = Dout("ms")
 
 BANNER = b"ceph-tpu msgr v2\n"
 _FRAME_HDR = struct.Struct("<QQII")      # seq, ack, payload_len, payload_crc
+_AAD = struct.Struct("<QQI")             # secure mode: header fields as AAD
 _LEN = struct.Struct("<I")
 
 _RECONNECT_DELAY = 0.02
@@ -214,6 +215,9 @@ class Connection:
         self._tasks: list[asyncio.Task] = []
         self._closed = False
         self._ready = asyncio.Event()
+        # (AESGCM, tx_nonce_prefix, rx_nonce_prefix) when secure mode
+        # negotiated (crypto_onwire role); None = plaintext frames
+        self._onwire = None
 
     # -- public api ------------------------------------------------------
     def send_message(self, msg: Message) -> None:
@@ -288,11 +292,27 @@ class Connection:
                     continue
                 try:
                     self.msgr._maybe_inject_failure()
-                    hdr = _FRAME_HDR.pack(
-                        seq, self.in_seq, len(payload),
-                        crc32c(0xFFFFFFFF, payload),
-                    )
-                    stream.write(hdr + payload)
+                    wire = payload
+                    if self._onwire is not None:
+                        # AES-GCM per frame, nonce = direction prefix +
+                        # seq.  The header (seq, ack, length) rides as
+                        # AAD: CRC alone would let an active attacker
+                        # rewrite the ack and silently purge unreplayed
+                        # messages from a lossless session.
+                        ack = self.in_seq
+                        aad = _AAD.pack(seq, ack, len(payload) + 16)
+                        wire = self._onwire[0].encrypt(
+                            self._onwire[1] + seq.to_bytes(8, "little"),
+                            payload, aad,
+                        )
+                        hdr = _FRAME_HDR.pack(seq, ack, len(wire),
+                                              crc32c(0xFFFFFFFF, wire))
+                    else:
+                        hdr = _FRAME_HDR.pack(
+                            seq, self.in_seq, len(wire),
+                            crc32c(0xFFFFFFFF, wire),
+                        )
+                    stream.write(hdr + wire)
                     await stream.drain()
                 except MessengerError as e:
                     self._out.put_nowait((seq, payload))
@@ -318,6 +338,20 @@ class Connection:
                 if crc32c(0xFFFFFFFF, payload) != crc:
                     self._on_stream_failure(MessengerError("bad frame crc"))
                     continue
+                if self._onwire is not None:
+                    try:
+                        payload = self._onwire[0].decrypt(
+                            self._onwire[2]
+                            + seq.to_bytes(8, "little"),
+                            payload, _AAD.pack(seq, ack, length),
+                        )
+                    except Exception:
+                        # InvalidTag: tampered frame OR tampered header
+                        # (aad covers seq/ack/length) or key mismatch
+                        self._on_stream_failure(
+                            MessengerError("onwire auth failed")
+                        )
+                        continue
                 while self._sent_unacked and self._sent_unacked[0][0] <= ack:
                     self._sent_unacked.popleft()
                 if seq <= self.in_seq:
@@ -539,23 +573,94 @@ class Messenger:
             stream = TcpStream(reader, writer)
             accept_task = None
         try:
-            peer = await self._handshake(stream, conn.in_seq,
-                                         conn.connect_seq)
+            ours, peer = await self._handshake(stream, conn.in_seq,
+                                               conn.connect_seq)
+            conn.peer_name = peer["entity"]
+            self._setup_onwire(conn, ours, peer)
         except MessengerError:
+            # covers the secure-mode checks too: a leaked accept task
+            # would otherwise keep a dead server-side session alive
             if accept_task is not None:
                 accept_task.cancel()
             raise
-        conn.peer_name = peer["entity"]
         conn._attach(stream, peer["in_seq"])
         if self.dispatcher is not None:
             self.dispatcher.ms_handle_connect(conn)
 
-    async def _handshake(self, stream: Stream, in_seq: int,
-                         connect_seq: int) -> dict:
-        hello = encode({
+    # -- secure mode (reference msg/async/crypto_onwire.{h,cc}: AES-GCM
+    # on-wire encryption negotiated in the handshake) --------------------
+    def _secure_wanted(self) -> bool:
+        return bool(self.conf and self.conf["ms_secure_mode"])
+
+    def _onwire_secret(self) -> str:
+        # DELIBERATELY the shared deployment key only: per-entity cephx
+        # keys differ on each end, so deriving from them would yield
+        # mismatched GCM keys that fail every frame with no diagnostic
+        # (per-entity secure mode needs ticket-negotiated session keys)
+        return self.conf["auth_shared_key"] if self.conf else ""
+
+    def _setup_onwire(self, conn: Connection, ours: dict,
+                      theirs: dict) -> None:
+        """Derive per-connection AES-256-GCM state after the handshake.
+        Both sides HKDF the deployment secret over the canonicalized
+        FULL hello pair: the per-session random salts make every
+        (re)connection's key fresh (seq-based nonces can never repeat
+        under one key), and binding entity/nonce/in_seq/connect_seq
+        into the derivation means a tampered handshake yields
+        mismatched keys — frames fail authentication instead of the
+        peer acting on forged session state."""
+        want = self._secure_wanted()
+        if bool(theirs.get("secure")) != want:
+            raise MessengerError(
+                "secure-mode mismatch with peer "
+                f"{theirs.get('entity')!r} (ours={want})"
+            )
+        if not want:
+            return
+        secret = self._onwire_secret()
+        if not secret:
+            raise MessengerError(
+                "ms_secure_mode requires auth_shared_key"
+            )
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+        def canon(h: dict) -> tuple:
+            return (str(h.get("entity")), int(h.get("nonce", 0)),
+                    int(h.get("in_seq", 0)),
+                    int(h.get("connect_seq", -1)),
+                    str(h.get("session_salt", "")))
+
+        pair = sorted([canon(ours), canon(theirs)])
+        key = HKDF(
+            algorithm=hashes.SHA256(), length=32,
+            salt=b"ceph-tpu-onwire-v1",
+            info=repr(pair).encode(),
+        ).derive(secret.encode())
+        lower = canon(ours) == pair[0]
+        tx = b"\x00\x00\x00" + (b"\x00" if lower else b"\x01")
+        rx = b"\x00\x00\x00" + (b"\x01" if lower else b"\x00")
+        conn._onwire = (AESGCM(key), tx, rx)
+
+    def _make_hello(self, in_seq: int, connect_seq: int) -> dict:
+        hello = {
             "entity": self.name, "nonce": self.nonce, "in_seq": in_seq,
             "connect_seq": connect_seq,
-        })
+            "secure": self._secure_wanted(),
+        }
+        if hello["secure"]:
+            # fresh per-session randomness: every (re)connection's GCM
+            # key differs, so seq-based nonces never repeat under a key
+            import secrets
+
+            hello["session_salt"] = secrets.token_hex(16)
+        return hello
+
+    async def _handshake(self, stream: Stream, in_seq: int,
+                         connect_seq: int) -> tuple[dict, dict]:
+        ours = self._make_hello(in_seq, connect_seq)
+        hello = encode(ours)
         stream.write(BANNER + _LEN.pack(len(hello)) + hello)
         await stream.drain()
         banner = await stream.read_exactly(len(BANNER))
@@ -571,7 +676,7 @@ class Messenger:
             raise MessengerError(f"bad handshake payload: {e}") from e
         if not isinstance(peer, dict) or "entity" not in peer:
             raise MessengerError("bad handshake payload")
-        return peer
+        return ours, peer
 
     # -- incoming --------------------------------------------------------
     async def _on_tcp_accept(self, reader: asyncio.StreamReader,
@@ -617,12 +722,11 @@ class Messenger:
                 conn._stop_io()
                 conn._teardown_stream()
                 fresh = False
-            hello = encode({
-                "entity": self.name, "nonce": self.nonce,
-                "in_seq": conn.in_seq,
-            })
+            ours = self._make_hello(conn.in_seq, -1)
+            hello = encode(ours)
             stream.write(BANNER + _LEN.pack(len(hello)) + hello)
             await stream.drain()
+            self._setup_onwire(conn, ours, peer)
             conn._attach(stream, peer["in_seq"])
             conn._start_io()
             if fresh and self.dispatcher is not None:
