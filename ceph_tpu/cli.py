@@ -296,6 +296,23 @@ async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
         return await _mon(rados, "osd stat", j)
     if a in ("out", "in", "down"):
         return await _mon(rados, f"osd {a}", j, ids=args.ids)
+    if a == "tier":
+        sub = args.sub
+        if sub == "add":
+            return await _mon(rados, "osd tier add", j,
+                              pool=args.pool, tierpool=args.tierpool)
+        if sub == "remove":
+            return await _mon(rados, "osd tier remove", j,
+                              pool=args.pool, tierpool=args.tierpool)
+        if sub == "cache-mode":
+            return await _mon(rados, "osd tier cache-mode", j,
+                              pool=args.pool, mode=args.mode)
+        if sub == "set-overlay":
+            return await _mon(rados, "osd tier set-overlay", j,
+                              pool=args.pool,
+                              overlaypool=args.tierpool)
+        return await _mon(rados, "osd tier remove-overlay", j,
+                          pool=args.pool)
     if a == "pool":
         sub = args.sub
         if sub == "create":
@@ -448,6 +465,20 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("out", "in", "down"):
         o = osd_sub.add_parser(name)
         o.add_argument("ids", type=int, nargs="+")
+    tier = osd_sub.add_parser("tier")
+    tier_sub = tier.add_subparsers(dest="sub", required=True)
+    for name in ("add", "remove"):
+        t = tier_sub.add_parser(name)
+        t.add_argument("pool")
+        t.add_argument("tierpool")
+    tcm = tier_sub.add_parser("cache-mode")
+    tcm.add_argument("pool")
+    tcm.add_argument("mode", choices=["none", "writeback", "readonly"])
+    tso = tier_sub.add_parser("set-overlay")
+    tso.add_argument("pool")
+    tso.add_argument("tierpool")
+    tro = tier_sub.add_parser("remove-overlay")
+    tro.add_argument("pool")
     pool = osd_sub.add_parser("pool")
     pool_sub = pool.add_subparsers(dest="sub", required=True)
     pc = pool_sub.add_parser("create")

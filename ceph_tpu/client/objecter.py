@@ -21,10 +21,12 @@ from ceph_tpu.common.log import Dout
 from ceph_tpu.common.tracing import Tracer
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger
-from ceph_tpu.osd.codes import MISDIRECTED_RC
+from ceph_tpu.osd.codes import MISDIRECTED_RC, READ_OPS
 from ceph_tpu.osd.pg import object_to_ps
 
 log = Dout("objecter")
+
+_READ_OP_NAMES = READ_OPS | {"pgls"}
 
 EAGAIN_RC = -11
 
@@ -170,8 +172,19 @@ class Objecter:
             pool = m.pools.get(pool_id) if m is not None else None
             if pool is None:
                 raise ObjecterError(f"no pool {pool_id}")
+            # cache-tier overlay redirect (Objecter::_calc_target's
+            # read_tier/write_tier handling): ops targeting the base
+            # pool are sent to the cache pool instead; re-evaluated
+            # every retry so an overlay change mid-op takes effect
+            mutating = any(op.get("op") not in _READ_OP_NAMES
+                           for op in ops)
+            tier_id = pool.write_tier if mutating else pool.read_tier
+            target_pool_id = pool_id
+            if tier_id >= 0 and tier_id in m.pools:
+                target_pool_id = tier_id
+                pool = m.pools[tier_id]
             ps = object_to_ps(oid, pool.pg_num)
-            _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+            _, _, _, primary = m.pg_to_up_acting(target_pool_id, ps)
             if primary < 0:
                 await self._await_newer_map(m.epoch, deadline)
                 continue
@@ -193,7 +206,8 @@ class Objecter:
                 await self.msgr.send_to(
                     m.osds[primary].addr,
                     Message("osd_op", {
-                        "tid": tid, "pool": pool_id, "ps": ps, "oid": oid,
+                        "tid": tid, "pool": target_pool_id, "ps": ps,
+                        "oid": oid,
                         "epoch": m.epoch, "ops": ops, "reqid": reqid,
                         **({"tctx": tctx.to_wire()} if tctx else {}),
                         **(extra or {}),

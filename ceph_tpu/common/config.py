@@ -119,6 +119,8 @@ def global_options() -> list[Option]:
                "this entity's own secret key (cephx mode)"),
         Option("auth_service_secret_ttl", float, 3600.0,
                "rotating service-secret / ticket lifetime (s)", min=0.5),
+        Option("osd_agent_interval", float, 1.0,
+               "cache-tier flush/evict agent period (s; 0=off)", min=0.0),
         Option("mds_beacon_interval", float, 0.5,
                "mds -> mon beacon period (s)", min=0.05),
         Option("mds_beacon_grace", float, 3.0,

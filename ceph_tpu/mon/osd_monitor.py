@@ -272,6 +272,8 @@ class OSDMonitor(PaxosService):
                 return self._cmd_upmap_items(cmd)
             if name == "osd rm-pg-upmap-items":
                 return self._cmd_rm_upmap_items(cmd)
+            if name.startswith("osd tier"):
+                return self._cmd_tier(name, cmd)
         except (KeyError, ValueError, TypeError) as e:
             return CommandResult(EINVAL_RC, f"bad command args: {e}")
         return CommandResult(EINVAL_RC, f"unrecognized command {name!r}")
@@ -391,11 +393,13 @@ class OSDMonitor(PaxosService):
         return CommandResult(outs=f"pool {pool.name!r} removed")
 
     def _cmd_pool_set(self, cmd: dict) -> CommandResult:
-        pool = self._pool_by_name(cmd["pool"])
-        if pool is None:
-            return CommandResult(ENOENT_RC, f"no pool {cmd['pool']!r}")
+        # reuse the pending-staged copy: a pool-set in the same epoch
+        # as tier/snap commands must compose, not silently win the
+        # last-entry-wins apply and revert their fields
+        updated = self._staged_pool(cmd["pool"])
+        if isinstance(updated, CommandResult):
+            return updated
         var, val = cmd["var"], cmd["val"]
-        updated = PoolInfo.from_dict(pool.to_dict())
         if var == "size":
             updated.size = int(val)
         elif var == "min_size":
@@ -417,10 +421,13 @@ class OSDMonitor(PaxosService):
                 return CommandResult(EINVAL_RC,
                                      "hit_set_count must be >= 1")
             updated.hit_set_count = int(val)
+        elif var == "target_max_objects":
+            updated.target_max_objects = max(0, int(val))
+        elif var == "target_max_bytes":
+            updated.target_max_bytes = max(0, int(val))
         else:
             return CommandResult(EINVAL_RC, f"cannot set {var!r}")
-        self._pending().new_pools.append(updated)
-        return CommandResult(outs=f"set pool {pool.name!r} {var}={val}")
+        return CommandResult(outs=f"set pool {updated.name!r} {var}={val}")
 
     def _cmd_snap_create(self, cmd: dict) -> CommandResult:
         """Allocate a self-managed snap id (pg_pool_t snap_seq bump; the
@@ -498,6 +505,100 @@ class OSDMonitor(PaxosService):
             return pgid
         self._pending().new_pg_upmap_items[pgid] = []
         return CommandResult(outs=f"removed upmap {pgid[0]}.{pgid[1]}")
+
+    def _staged_pool(self, name: str) -> "PoolInfo | CommandResult":
+        """A mutable copy of a pool staged into the pending incremental
+        (reusing an already-staged copy so multi-field tier commands in
+        one epoch compose)."""
+        pool = self._pool_by_name(name)
+        if pool is None:
+            return CommandResult(ENOENT_RC, f"no pool {name!r}")
+        pending = self._pending()
+        staged = next((p for p in pending.new_pools
+                       if p.pool_id == pool.pool_id), None)
+        if staged is not None:
+            return staged
+        updated = PoolInfo.from_dict(pool.to_dict())
+        pending.new_pools.append(updated)
+        return updated
+
+    def _cmd_tier(self, name: str, cmd: dict) -> CommandResult:
+        """Cache-tier wiring (OSDMonitor 'osd tier *' commands):
+        add/remove the tier link, set the cache mode, and point the
+        base pool's client overlay at the cache."""
+        if name == "osd tier add":
+            base = self._staged_pool(cmd["pool"])
+            cache = self._staged_pool(cmd["tierpool"])
+            for r in (base, cache):
+                if isinstance(r, CommandResult):
+                    return r
+            if cache.tier_of >= 0:
+                return CommandResult(EINVAL_RC,
+                                     f"{cache.name!r} is already a tier")
+            if base.tier_of >= 0 or cache.pool_id == base.pool_id:
+                return CommandResult(EINVAL_RC, "invalid tier pair")
+            cache.tier_of = base.pool_id
+            return CommandResult(
+                outs=f"{cache.name!r} is now a tier of {base.name!r}"
+            )
+        if name == "osd tier cache-mode":
+            cache = self._staged_pool(cmd["pool"])
+            if isinstance(cache, CommandResult):
+                return cache
+            mode = str(cmd.get("mode", ""))
+            if mode not in ("none", "writeback", "readonly"):
+                return CommandResult(
+                    EINVAL_RC, "mode must be none|writeback|readonly"
+                )
+            if cache.tier_of < 0:
+                return CommandResult(EINVAL_RC,
+                                     f"{cache.name!r} is not a tier")
+            cache.cache_mode = "" if mode == "none" else mode
+            return CommandResult(outs=f"cache-mode {mode}")
+        if name == "osd tier set-overlay":
+            base = self._staged_pool(cmd["pool"])
+            cache = self._staged_pool(cmd["overlaypool"])
+            for r in (base, cache):
+                if isinstance(r, CommandResult):
+                    return r
+            if cache.tier_of != base.pool_id:
+                return CommandResult(
+                    EINVAL_RC,
+                    f"{cache.name!r} is not a tier of {base.name!r}"
+                )
+            if not cache.cache_mode:
+                return CommandResult(EINVAL_RC,
+                                     "set cache-mode before the overlay")
+            base.read_tier = cache.pool_id
+            # readonly caches serve reads only: writes keep hitting the
+            # base directly (stale-cache caveat matches the reference)
+            base.write_tier = (cache.pool_id
+                               if cache.cache_mode == "writeback"
+                               else -1)
+            return CommandResult(outs="overlay set")
+        if name == "osd tier remove-overlay":
+            base = self._staged_pool(cmd["pool"])
+            if isinstance(base, CommandResult):
+                return base
+            base.read_tier = -1
+            base.write_tier = -1
+            return CommandResult(outs="overlay removed")
+        if name == "osd tier remove":
+            base = self._staged_pool(cmd["pool"])
+            cache = self._staged_pool(cmd["tierpool"])
+            for r in (base, cache):
+                if isinstance(r, CommandResult):
+                    return r
+            if cache.tier_of != base.pool_id:
+                return CommandResult(EINVAL_RC, "not a tier of that pool")
+            if base.read_tier == cache.pool_id \
+                    or base.write_tier == cache.pool_id:
+                return CommandResult(EINVAL_RC,
+                                     "remove the overlay first")
+            cache.tier_of = -1
+            cache.cache_mode = ""
+            return CommandResult(outs="tier removed")
+        return CommandResult(EINVAL_RC, f"unrecognized command {name!r}")
 
     def _cmd_osd_state(self, name: str, cmd: dict) -> CommandResult:
         ids = [int(i) for i in cmd.get("ids", [])]

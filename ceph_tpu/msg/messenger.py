@@ -576,7 +576,12 @@ class Messenger:
             ours, peer = await self._handshake(stream, conn.in_seq,
                                                conn.connect_seq)
             conn.peer_name = peer["entity"]
-            self._setup_onwire(conn, ours, peer)
+            conn._onwire = self._derive_onwire(ours, peer)
+            if conn._onwire is not None:
+                # server confirms first; our confirm completes the
+                # mutual key proof before any state is trusted
+                await self._exchange_confirm(stream, conn._onwire,
+                                             send_first=False)
         except MessengerError:
             # covers the secure-mode checks too: a leaked accept task
             # would otherwise keep a dead server-side session alive
@@ -599,8 +604,49 @@ class Messenger:
         # (per-entity secure mode needs ticket-negotiated session keys)
         return self.conf["auth_shared_key"] if self.conf else ""
 
+    _CONFIRM_NONCE = (2**64 - 1).to_bytes(8, "little")
+    _CONFIRM_TEXT = b"ceph-tpu-onwire-confirm"
+
+    def _confirm_blob(self, onwire) -> bytes:
+        aes, tx, _ = onwire
+        return aes.encrypt(tx + self._CONFIRM_NONCE,
+                           self._CONFIRM_TEXT, None)
+
+    def _verify_confirm(self, onwire, blob: bytes) -> None:
+        aes, _, rx = onwire
+        try:
+            if aes.decrypt(rx + self._CONFIRM_NONCE, blob, None) \
+                    == self._CONFIRM_TEXT:
+                return
+        except Exception:
+            pass
+        raise MessengerError("onwire key confirmation failed")
+
+    async def _exchange_confirm(self, stream: Stream, onwire,
+                                send_first: bool) -> None:
+        """Mutual key confirmation: each side proves it derived the
+        same GCM key BEFORE any handshake field is acted upon — a
+        keyless attacker can complete the plaintext hello exchange but
+        never this, so forged in_seq/connect_seq values are discarded
+        with the connection instead of purging/resetting live session
+        state."""
+        mine = self._confirm_blob(onwire)
+        if send_first:
+            stream.write(_LEN.pack(len(mine)) + mine)
+            await stream.drain()
+        (n,) = _LEN.unpack(await stream.read_exactly(_LEN.size))
+        if n > 256:
+            raise MessengerError("oversized confirm")
+        self._verify_confirm(onwire, await stream.read_exactly(n))
+        if not send_first:
+            stream.write(_LEN.pack(len(mine)) + mine)
+            await stream.drain()
+
     def _setup_onwire(self, conn: Connection, ours: dict,
                       theirs: dict) -> None:
+        conn._onwire = self._derive_onwire(ours, theirs)
+
+    def _derive_onwire(self, ours: dict, theirs: dict):
         """Derive per-connection AES-256-GCM state after the handshake.
         Both sides HKDF the deployment secret over the canonicalized
         FULL hello pair: the per-session random salts make every
@@ -616,7 +662,7 @@ class Messenger:
                 f"{theirs.get('entity')!r} (ours={want})"
             )
         if not want:
-            return
+            return None
         secret = self._onwire_secret()
         if not secret:
             raise MessengerError(
@@ -641,7 +687,7 @@ class Messenger:
         lower = canon(ours) == pair[0]
         tx = b"\x00\x00\x00" + (b"\x00" if lower else b"\x01")
         rx = b"\x00\x00\x00" + (b"\x01" if lower else b"\x00")
-        conn._onwire = (AESGCM(key), tx, rx)
+        return (AESGCM(key), tx, rx)
 
     def _make_hello(self, in_seq: int, connect_seq: int) -> dict:
         hello = {
@@ -703,14 +749,36 @@ class Messenger:
             # with the same entity name (or a restarted daemon) reset
             # each other's live sessions in a loop.
             akey = (peer_name, int(peer.get("nonce", 0)))
-            conn = self._accepted.get(akey)
-            if conn is not None and peer.get("connect_seq", 0) == 0:
-                # peer started a NEW session (its connect_seq reset): our
-                # old session state is stale — drop it (ProtocolV2
+            existing = self._accepted.get(akey)
+            reset = existing is not None \
+                and peer.get("connect_seq", 0) == 0
+            reuse = (existing is not None and not reset
+                     and not existing.is_closed)
+            # NOTHING destructive happens yet: in secure mode the peer
+            # must first prove it derived the same key, or a keyless
+            # attacker replaying/forging a hello could reset a live
+            # session (connect_seq=0) or purge its unacked queue
+            ours = self._make_hello(
+                existing.in_seq if reuse else 0, -1
+            )
+            hello = encode(ours)
+            stream.write(BANNER + _LEN.pack(len(hello)) + hello)
+            await stream.drain()
+            onwire = self._derive_onwire(ours, peer)
+            if onwire is not None:
+                await self._exchange_confirm(stream, onwire,
+                                             send_first=True)
+            if reset:
+                # peer started a NEW session (its connect_seq reset):
+                # our old session state is stale — drop it (ProtocolV2
                 # RESETSESSION semantics)
-                conn.mark_down()
-                conn = None
-            if conn is None or conn.is_closed:
+                existing.mark_down()
+            if reuse:
+                conn = existing
+                conn._stop_io()
+                conn._teardown_stream()
+                fresh = False
+            else:
                 conn = Connection(
                     self, peer_name, hint, self._policy_for(peer_name),
                     initiator=False,
@@ -718,15 +786,7 @@ class Messenger:
                 conn._accept_key = akey
                 self._accepted[akey] = conn
                 fresh = True
-            else:
-                conn._stop_io()
-                conn._teardown_stream()
-                fresh = False
-            ours = self._make_hello(conn.in_seq, -1)
-            hello = encode(ours)
-            stream.write(BANNER + _LEN.pack(len(hello)) + hello)
-            await stream.drain()
-            self._setup_onwire(conn, ours, peer)
+            conn._onwire = onwire
             conn._attach(stream, peer["in_seq"])
             conn._start_io()
             if fresh and self.dispatcher is not None:

@@ -10,3 +10,9 @@ ENOTSUP_RC = -95
 ESTALE_RC = -116              # sub-op from an older PG interval, dropped
 MISDIRECTED_RC = -1000        # resend after map refresh (reference drops)
 EPERM_RC = -1               # operation not permitted (caps)
+
+# op kinds that never mutate — ONE definition shared by the OSD op
+# interpreter (dedup/replay classification) and the client Objecter
+# (cache-tier read/write routing); pgls is a read-class special op
+READ_OPS = frozenset({"read", "stat", "getxattr", "getxattrs",
+                      "omap_get"})

@@ -55,6 +55,7 @@ from ceph_tpu.osd.codes import (
     ESTALE_RC,
     MISDIRECTED_RC,
     OK,
+    READ_OPS,
 )
 from ceph_tpu.osd.osd_map import NO_OSD, OSDMap
 from ceph_tpu.osd import pg_log, snaps
@@ -92,8 +93,6 @@ XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
 
 # read-class client ops (no mutation): ONE definition for the dedup
 # cache policy, the replay path, perf counters, and caps enforcement
-READ_OPS = frozenset({"read", "stat", "getxattr", "getxattrs",
-                      "omap_get"})
 _CAPS_READ_OPS = READ_OPS | {"pgls"}
 
 # message types the embedded MonClient owns
@@ -176,6 +175,13 @@ class OSDDaemon:
         self.pgs: dict[PGId, PG] = {}
         self._sub_tid = 0
         self._sub_futures: dict[int, asyncio.Future] = {}
+        # cache-tier client state (this OSD as a client of base pools)
+        self._tier_tid = 0
+        self._tier_seq = 0
+        self._tier_futs: dict[int, asyncio.Future] = {}
+        self._tier_promoting: dict[tuple, asyncio.Future] = {}
+        self._tier_authed: set[int] = set()
+        self._tier_auth_state: dict[int, dict] = {}
         self.tracer = Tracer(self.entity)
         # op-LIFETIME memory bound on client payloads (the reference's
         # osd_client_message_size_cap throttle): held from op arrival to
@@ -252,6 +258,10 @@ class OSDDaemon:
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         if self.conf["osd_scrub_interval"] > 0:
             self._tasks.append(asyncio.create_task(self._scrub_loop()))
+        if self.conf["osd_agent_interval"] > 0:
+            self._tasks.append(
+                asyncio.create_task(self._tier_agent_loop())
+            )
         await self._start_admin_socket()
         log.dout(1, "%s: booted at %s", self.entity, self.msgr.my_addr)
 
@@ -418,8 +428,16 @@ class OSDDaemon:
             return True
         write = any(op.get("op") not in _CAPS_READ_OPS
                     for op in ops)
-        return not cap_allows(state.get("caps", ""), write=write,
-                              pool=pg.pool.name)
+        caps = state.get("caps", "")
+        pools = [pg.pool.name]
+        if pg.pool.tier_of >= 0 and self.osdmap is not None:
+            # overlay-redirected clients hold caps scoped to the BASE
+            # pool's name; either name authorizes the cache pool
+            base = self.osdmap.pools.get(pg.pool.tier_of)
+            if base is not None:
+                pools.append(base.name)
+        return not any(cap_allows(caps, write=write, pool=p)
+                       for p in pools)
 
     # -- dispatch ----------------------------------------------------------
     def ms_handle_connect(self, conn: Connection) -> None:
@@ -428,6 +446,13 @@ class OSDDaemon:
     def ms_handle_reset(self, conn: Connection) -> None:
         self.monc.ms_handle_reset(conn)
         self._conn_auth.pop(id(conn), None)
+        self._tier_authed.discard(id(conn))
+        state = self._tier_auth_state.pop(id(conn), None)
+        if state is not None and not state["fut"].done():
+            state["fut"].set_exception(
+                ConnectionError("tier auth session reset")
+            )
+            state["fut"].exception()
         # a dead client takes its watches with it (watch timeout role)
         for key, watchers in list(self._watchers.items()):
             for wid, wconn in list(watchers.items()):
@@ -493,6 +518,29 @@ class OSDDaemon:
                 }))
             except ConnectionError:
                 pass
+        elif t == "osd_op_reply":
+            # replies to OUR tier client ops (promote/flush/propagate)
+            fut = self._tier_futs.pop(int(msg.data.get("tid", 0)), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg.data)
+        elif t == "osd_auth_challenge":
+            # our tier-client authorizer exchange with a peer OSD
+            state = self._tier_auth_state.get(id(conn))
+            if state is not None:
+                proof = hmac_mod.new(
+                    state["session_key"].encode(),
+                    str(msg.data.get("nonce", "")).encode(),
+                    hashlib.sha256,
+                ).hexdigest()
+                try:
+                    conn.send_message(Message("osd_auth",
+                                              {"proof": proof}))
+                except ConnectionError:
+                    pass
+        elif t == "osd_auth_reply":
+            state = self._tier_auth_state.pop(id(conn), None)
+            if state is not None and not state["fut"].done():
+                state["fut"].set_result(bool(msg.data.get("ok")))
         elif t in ("hit_set_ls", "hit_set_contains"):
             pg = self.pgs.get(PGId(int(msg.data.get("pool", -1)),
                                    int(msg.data.get("ps", 0))))
@@ -940,6 +988,264 @@ class OSDDaemon:
             except (KeyError, ValueError, TypeError):
                 out[oid.name] = 1
         return out
+
+    # -- cache tiering (the PrimaryLogPG tiering agent + promote path:
+    # reference src/osd/PrimaryLogPG.cc agent_work/maybe_promote) ---------
+    TIER_DIRTY = "tier.dirty"          # user-xattr namespace
+
+    def _tier_cid(self, pg: PG) -> CollectionId:
+        return CollectionId(pg.pgid.pool, pg.pgid.ps)
+
+    async def _tier_ensure_auth(self, osd: int, addr: str) -> None:
+        """cephx leg of the tier client: this OSD holds the rotating
+        service secrets, so it SELF-MINTS a service ticket (exactly
+        what the mon would issue it) and runs the same authorizer
+        exchange the client Objecter does."""
+        if not self.cephx:
+            return
+        conn = await self.msgr.connect(addr, f"osd.{osd}")
+        if id(conn) in self._tier_authed:
+            return
+        if not self._service_secrets:
+            await self._refresh_service_secrets()
+        from ceph_tpu.mon.auth_monitor import seal_ticket
+
+        epoch = max(self._service_secrets)
+        ticket, session_key = seal_ticket(
+            self._service_secrets[epoch], self.entity, "allow *",
+            epoch, self.conf["auth_service_secret_ttl"],
+        )
+        fut = asyncio.get_running_loop().create_future()
+        self._tier_auth_state[id(conn)] = {
+            "session_key": session_key, "fut": fut,
+        }
+        conn.send_message(Message("osd_auth", {"ticket": ticket}))
+        ok = await asyncio.wait_for(fut, 5.0)
+        if not ok:
+            raise ShardReadError(f"tier auth to osd.{osd} failed")
+        self._tier_authed.add(id(conn))
+
+    async def _tier_base_op(self, pool_id: int, oid: str,
+                            ops: list[dict], timeout: float = 10.0):
+        """The OSD acting as a client of the base pool (the proxied /
+        flush IO of the tiering agent): target the base primary from
+        the osdmap, correlate the osd_op_reply, retry across map churn
+        with one reqid so the base dedups replays."""
+        self._tier_seq += 1
+        reqid = f"{self.entity}.tier:{self._tier_seq}"
+        deadline = time.monotonic() + timeout
+        while True:
+            m = self.osdmap
+            pool = m.pools.get(pool_id) if m is not None else None
+            if pool is None:
+                raise ShardReadError(f"tier base pool {pool_id} gone")
+            ps = object_to_ps(oid, pool.pg_num)
+            _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+            if primary >= 0:
+                self._tier_tid += 1
+                tid = self._tier_tid
+                fut = asyncio.get_running_loop().create_future()
+                self._tier_futs[tid] = fut
+                try:
+                    await self._tier_ensure_auth(
+                        primary, m.osds[primary].addr
+                    )
+                    await self.msgr.send_to(
+                        m.osds[primary].addr, Message("osd_op", {
+                            "tid": tid, "pool": pool_id, "ps": ps,
+                            "oid": oid, "epoch": m.epoch, "ops": ops,
+                            "reqid": reqid, "tier": True,
+                        }), f"osd.{primary}",
+                    )
+                    reply = await asyncio.wait_for(
+                        fut, max(0.5, deadline - time.monotonic())
+                    )
+                    rc = int(reply.get("rc", 0))
+                    if rc != MISDIRECTED_RC:
+                        return (rc, reply.get("results", []),
+                                int(reply.get("version", 0)))
+                except (ConnectionError, asyncio.TimeoutError):
+                    self._tier_futs.pop(tid, None)
+            if time.monotonic() > deadline:
+                raise ShardReadError(
+                    f"tier op on {oid!r} to pool {pool_id} timed out"
+                )
+            await asyncio.sleep(0.1)
+
+    def _tier_has_object(self, pg: PG, oid: str) -> bool:
+        try:
+            return self.store.exists(self._tier_cid(pg),
+                                     GHObject(pg.pgid.pool, oid))
+        except KeyError:
+            return False
+
+    async def _tier_promote(self, pg: PG, oid: str) -> None:
+        """Pull a missing object up from the base pool through the
+        normal backend write path (so replicas get it too); a promoted
+        object starts CLEAN — flush has nothing to do until a client
+        mutates it."""
+        rc, results, _ = await self._tier_base_op(
+            pg.pool.tier_of, oid,
+            [{"op": "read", "off": 0}, {"op": "getxattrs"}],
+        )
+        if rc == ENOENT_RC:
+            return                   # base miss: op sees ENOENT naturally
+        if rc != OK:
+            raise ShardReadError(f"promote of {oid!r} failed: rc {rc}")
+        data = bytes(results[0].get("data", b""))
+        promote_ops = [{"op": "writefull", "data": data}]
+        for name, value in (results[1].get("attrs") or {}).items():
+            if not str(name).startswith("tier."):
+                promote_ops.append({"op": "setxattr", "name": name,
+                                    "value": value})
+        prc, _, _ = await self._do_ops(pg, oid, promote_ops)
+        if prc != OK:
+            raise ShardReadError(f"promote write of {oid!r}: rc {prc}")
+        log.dout(10, "%s: promoted %s from pool %d", self.entity, oid,
+                 pg.pool.tier_of)
+
+    async def _tier_prepare(self, pg: PG, oid: str, ops: list[dict],
+                            mutating: bool) -> tuple[list[dict], int]:
+        """Cache-pool op preamble: promote on miss, tag writeback
+        mutations dirty IN THE SAME BATCH (atomic with the data), and
+        propagate deletes to the base synchronously so an evicted
+        object cannot resurrect from stale base state."""
+        pool = pg.pool
+        if pool.tier_of < 0 or not pool.cache_mode \
+                or not pg.is_primary:
+            return ops, 0
+        pure_delete = all(op.get("op") == "remove" for op in ops)
+        if oid and not pure_delete \
+                and not self._tier_has_object(pg, oid):
+            # one promote per object at a time: a concurrent op awaits
+            # the winner instead of racing a second promote that could
+            # clobber a just-committed client write with stale base data
+            key = (pg.pgid, oid)
+            inflight = self._tier_promoting.get(key)
+            if inflight is not None:
+                await asyncio.shield(inflight)
+            elif not self._tier_has_object(pg, oid):
+                fut = asyncio.get_running_loop().create_future()
+                self._tier_promoting[key] = fut
+                try:
+                    await self._tier_promote(pg, oid)
+                    fut.set_result(None)
+                except BaseException as e:
+                    fut.set_exception(e)
+                    fut.exception()
+                    raise
+                finally:
+                    self._tier_promoting.pop(key, None)
+        if not mutating or pool.cache_mode != "writeback":
+            return ops, 0
+        if any(op.get("op") == "remove" for op in ops):
+            rc, _, _ = await self._tier_base_op(
+                pool.tier_of, oid, [{"op": "remove"}]
+            )
+            if rc not in (OK, ENOENT_RC):
+                raise ShardReadError(
+                    f"tier delete of {oid!r} in base: rc {rc}"
+                )
+            return ops, 0
+        return ops + [{"op": "setxattr", "name": self.TIER_DIRTY,
+                       "value": b"1"}], 1
+
+    async def _tier_agent_loop(self) -> None:
+        """Flush/evict agent (PrimaryLogPG agent_work): push dirty
+        objects to the base pool, then evict clean cold objects (the
+        current hit set is the recency signal) above the pool's
+        target_max_objects ceiling."""
+        interval = self.conf["osd_agent_interval"]
+        while not self._stopped:
+            try:
+                await asyncio.sleep(interval)
+                for pg in list(self.pgs.values()):
+                    pool = pg.pool
+                    if (not pg.is_primary or pg.state != STATE_ACTIVE
+                            or pool.tier_of < 0
+                            or pool.cache_mode != "writeback"):
+                        continue
+                    await self._tier_agent_pg(pg)
+            except asyncio.CancelledError:
+                return
+            except (ShardReadError, KeyError, ValueError,
+                    ConnectionError) as e:
+                log.dout(5, "%s: tier agent pass failed: %s",
+                         self.entity, e)
+
+    async def _tier_agent_pg(self, pg: PG) -> None:
+        cid = self._tier_cid(pg)
+        try:
+            heads = [o.name for o in self.store.list_objects(cid)
+                     if o.snap == snaps.NOSNAP
+                     and not o.name.startswith("hit_set_")]
+        except KeyError:
+            return
+        dirty_attr = XATTR_PREFIX + self.TIER_DIRTY
+        clean: list[str] = []
+        for name in heads:
+            obj = GHObject(pg.pgid.pool, name)
+            try:
+                self.store.getattr(cid, obj, dirty_attr)
+            except KeyError:
+                clean.append(name)
+                continue
+            await self._tier_flush(pg, cid, obj)
+            clean.append(name)
+        # target_max_objects is POOL-wide; each PG polices its share
+        # (the reference agent divides the target over the PG count)
+        ceiling = pg.pool.target_max_objects
+        per_pg = ceiling // max(pg.pool.pg_num, 1)
+        if ceiling and len(heads) > per_pg:
+            cache = getattr(self, "_hit_sets", None) or {}
+            entry = cache.get(pg.pgid)
+            hot = (lambda n: entry[0].contains(n)) if entry \
+                else (lambda n: False)
+            victims = sorted(clean, key=lambda n: (hot(n), n))
+            for name in victims[: len(heads) - per_pg]:
+                # re-check at the last moment: a client write during
+                # this pass re-dirties; evicting it would lose the
+                # acknowledged write (base only has the older flush)
+                try:
+                    self.store.getattr(cid, GHObject(pg.pgid.pool, name),
+                                       dirty_attr)
+                    continue                 # dirty again: keep it
+                except KeyError:
+                    pass
+                # direct _do_ops: eviction must NOT propagate the
+                # delete to the base (the flushed copy IS the data)
+                await self._do_ops(pg, name, [{"op": "remove"}])
+                log.dout(10, "%s: evicted %s", self.entity, name)
+
+    async def _tier_flush(self, pg: PG, cid: CollectionId,
+                          obj: GHObject) -> None:
+        data = self.store.read(cid, obj)
+        flush_ops: list[dict] = [{"op": "writefull",
+                                  "data": bytes(data)}]
+        for name, value in self.store.getattrs(cid, obj).items():
+            if name.startswith(XATTR_PREFIX) and not name.startswith(
+                    XATTR_PREFIX + "tier."):
+                flush_ops.append({
+                    "op": "setxattr",
+                    "name": name[len(XATTR_PREFIX):],
+                    "value": bytes(value),
+                })
+        v0 = self._obj_version(cid, obj)
+        rc, _, _ = await self._tier_base_op(pg.pool.tier_of, obj.name,
+                                            flush_ops)
+        if rc != OK:
+            raise ShardReadError(
+                f"flush of {obj.name!r} to base: rc {rc}"
+            )
+        try:
+            unchanged = self._obj_version(cid, obj) == v0
+        except KeyError:
+            return                   # deleted mid-flush: nothing to clear
+        if unchanged:
+            await self._do_ops(pg, obj.name,
+                               [{"op": "rmxattr",
+                                 "name": self.TIER_DIRTY}])
+        # else: re-dirtied mid-flush — stays dirty, next pass reflushes
 
     # -- hit sets (reference osd/HitSet.cc + pg hit_set_* machinery) ------
     def _hitset_record(self, pg: PG, name: str) -> None:
@@ -2021,13 +2327,25 @@ class OSDDaemon:
                 # first attempt provably wrote nothing: safe re-execute
             track = bool(reqid) and mutating
             if track:
+                # registered BEFORE any await (the tier preamble blocks
+                # on network promotes): a resend during that window must
+                # attach to this attempt, not double-execute
                 fut = asyncio.get_running_loop().create_future()
                 self._inflight_ops[reqid] = fut
             try:
+                # cache tiering: promote-on-miss from the base pool,
+                # mark writeback mutations dirty in the same batch, and
+                # push deletes through to the base so an evicted object
+                # cannot resurrect from stale base data
+                exec_ops, trim_results = await self._tier_prepare(
+                    pg, str(d["oid"]), ops, mutating
+                )
                 rc, results, version = await self._do_ops(
-                    pg, str(d["oid"]), ops, reqid,
+                    pg, str(d["oid"]), exec_ops, reqid,
                     d.get("snapc"), d.get("snapid"),
                 )
+                if trim_results and rc == OK:
+                    results = results[:-trim_results]
             except BaseException:
                 if track:
                     self._inflight_ops.pop(reqid, None)

@@ -39,6 +39,14 @@ class PoolInfo:
     hit_set_type: str = ""                   # "" = off, or "bloom"
     hit_set_period: float = 0.0              # seconds per archived set
     hit_set_count: int = 4                   # archived sets kept
+    # cache tiering (pg_pool_t tier fields): a cache pool points at its
+    # base via tier_of; the base redirects clients via read/write_tier
+    tier_of: int = -1                        # base pool id (cache pools)
+    read_tier: int = -1                      # overlay for reads (base)
+    write_tier: int = -1                     # overlay for writes (base)
+    cache_mode: str = ""                     # "", writeback, readonly
+    target_max_objects: int = 0              # eviction ceiling (cache)
+    target_max_bytes: int = 0
     removed_snaps: list = field(default_factory=list)
 
     def raw_pg_to_pps(self, ps: int) -> int:
@@ -57,6 +65,12 @@ class PoolInfo:
             "hit_set_type": self.hit_set_type,
             "hit_set_period": self.hit_set_period,
             "hit_set_count": self.hit_set_count,
+            "tier_of": self.tier_of,
+            "read_tier": self.read_tier,
+            "write_tier": self.write_tier,
+            "cache_mode": self.cache_mode,
+            "target_max_objects": self.target_max_objects,
+            "target_max_bytes": self.target_max_bytes,
         }
 
     @classmethod
@@ -73,6 +87,12 @@ class PoolInfo:
             hit_set_type=str(d.get("hit_set_type", "")),
             hit_set_period=float(d.get("hit_set_period", 0.0)),
             hit_set_count=int(d.get("hit_set_count", 4)),
+            tier_of=int(d.get("tier_of", -1)),
+            read_tier=int(d.get("read_tier", -1)),
+            write_tier=int(d.get("write_tier", -1)),
+            cache_mode=str(d.get("cache_mode", "")),
+            target_max_objects=int(d.get("target_max_objects", 0)),
+            target_max_bytes=int(d.get("target_max_bytes", 0)),
         )
 
 

@@ -1,0 +1,208 @@
+"""Cache tiering: overlay redirect, promote-on-miss, writeback
+flush/evict, delete propagation.
+
+Reference surfaces: pg_pool_t tier fields + OSDMonitor `osd tier *`
+commands, Objecter::_calc_target read/write_tier redirect, and the
+PrimaryLogPG tiering agent (promote, flush dirty to base, evict clean
+cold objects by HitSet recency).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _tiered_cluster(agent_interval=0.2, target_max=0):
+    cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+        "osd_agent_interval": agent_interval,
+    })
+    await cluster.start()
+    rados = await cluster.client()
+    for pool in ("base", "hot"):
+        r = await rados.mon_command("osd pool create", pool=pool,
+                                    pg_num=4, size=2)
+        assert r["rc"] == 0, r
+    r = await rados.mon_command("osd tier add", pool="base",
+                                tierpool="hot")
+    assert r["rc"] == 0, r
+    r = await rados.mon_command("osd tier cache-mode", pool="hot",
+                                mode="writeback")
+    assert r["rc"] == 0, r
+    r = await rados.mon_command("osd tier set-overlay", pool="base",
+                                overlaypool="hot")
+    assert r["rc"] == 0, r
+    if target_max:
+        r = await rados.mon_command("osd pool set", pool="hot",
+                                    var="target_max_objects",
+                                    val=target_max)
+        assert r["rc"] == 0, r
+    # clients need the tiered map before ops route correctly
+    await asyncio.sleep(0.3)
+    return cluster, rados
+
+
+def _pool_id(cluster, name):
+    mon = next(iter(cluster.mons.values()))
+    return next(p.pool_id for p in mon.osd_monitor.osdmap.pools.values()
+                if p.name == name)
+
+
+def _cache_objects(cluster, pool_id):
+    """Head object names present in the cache pool across OSD stores."""
+    from ceph_tpu.osd import snaps
+    names = set()
+    for osd in cluster.osds.values():
+        for cid in osd.store.list_collections():
+            if cid.pool == pool_id:
+                names |= {o.name for o in osd.store.list_objects(cid)
+                          if o.snap == snaps.NOSNAP}
+    # internal bookkeeping objects are not client data
+    return {n for n in names
+            if not n.startswith(("_", "hit_set_"))}
+
+
+def test_tier_commands_validate():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=2)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            for pool in ("b", "c"):
+                await rados.mon_command("osd pool create", pool=pool,
+                                        pg_num=4, size=2)
+            r = await rados.mon_command("osd tier set-overlay",
+                                        pool="b", overlaypool="c")
+            assert r["rc"] != 0           # not a tier yet
+            r = await rados.mon_command("osd tier add", pool="b",
+                                        tierpool="c")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("osd tier add", pool="b",
+                                        tierpool="c")
+            assert r["rc"] != 0           # already a tier
+            r = await rados.mon_command("osd tier set-overlay",
+                                        pool="b", overlaypool="c")
+            assert r["rc"] != 0           # mode not set
+            r = await rados.mon_command("osd tier cache-mode",
+                                        pool="c", mode="writeback")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("osd tier set-overlay",
+                                        pool="b", overlaypool="c")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("osd tier remove", pool="b",
+                                        tierpool="c")
+            assert r["rc"] != 0           # overlay still set
+            r = await rados.mon_command("osd tier remove-overlay",
+                                        pool="b")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("osd tier remove", pool="b",
+                                        tierpool="c")
+            assert r["rc"] == 0, r
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_writeback_redirect_flush_and_promote():
+    async def run():
+        cluster, rados = await _tiered_cluster()
+        try:
+            hot_id = _pool_id(cluster, "hot")
+            base_id = _pool_id(cluster, "base")
+            base_io = await rados.open_ioctx("base")
+
+            # client writes TO THE BASE POOL land in the cache tier
+            await base_io.write_full("obj1", b"hot-data")
+            assert "obj1" in _cache_objects(cluster, hot_id)
+            assert await base_io.read("obj1") == b"hot-data"
+
+            # the agent flushes it down to the base pool
+            deadline = asyncio.get_running_loop().time() + 10
+            while "obj1" not in _cache_objects(cluster, base_id):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+
+            # an object written directly to base (pre-tiering data)
+            # promotes into the cache on first access
+            mon = next(iter(cluster.mons.values()))
+            # bypass the overlay by writing via a direct hot-less op:
+            # drop the overlay, write, restore it
+            r = await rados.mon_command("osd tier remove-overlay",
+                                        pool="base")
+            assert r["rc"] == 0, r
+            await asyncio.sleep(0.3)
+            await base_io.write_full("cold-obj", b"cold-data")
+            assert "cold-obj" not in _cache_objects(cluster, hot_id)
+            r = await rados.mon_command("osd tier set-overlay",
+                                        pool="base", overlaypool="hot")
+            assert r["rc"] == 0, r
+            await asyncio.sleep(0.3)
+            assert await base_io.read("cold-obj") == b"cold-data"
+            assert "cold-obj" in _cache_objects(cluster, hot_id)
+
+            # partial overwrite of a non-resident object promotes
+            # first, so the merged result is correct
+            r = await rados.mon_command("osd tier remove-overlay",
+                                        pool="base")
+            await asyncio.sleep(0.3)
+            await base_io.write_full("merge-obj", b"AAAABBBB")
+            r = await rados.mon_command("osd tier set-overlay",
+                                        pool="base", overlaypool="hot")
+            await asyncio.sleep(0.3)
+            await base_io.write("merge-obj", b"XX", 2)
+            assert await base_io.read("merge-obj") == b"AAXXBBBB"
+
+            # delete through the overlay kills base + cache copies:
+            # no resurrection after eviction
+            await base_io.remove("obj1")
+            await asyncio.sleep(0.5)
+            assert "obj1" not in _cache_objects(cluster, hot_id)
+            assert "obj1" not in _cache_objects(cluster, base_id)
+            with pytest.raises(Exception):
+                await base_io.read("obj1")
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_eviction_respects_ceiling_dirty_and_recency():
+    async def run():
+        cluster, rados = await _tiered_cluster(target_max=3)
+        try:
+            hot_id = _pool_id(cluster, "hot")
+            base_id = _pool_id(cluster, "base")
+            base_io = await rados.open_ioctx("base")
+            for i in range(6):
+                await base_io.write_full(f"e{i}", f"v{i}".encode())
+            # agent flushes all, then evicts down to the ceiling
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                cache = _cache_objects(cluster, hot_id)
+                flushed = _cache_objects(cluster, base_id)
+                if len(cache) <= 3 and len(flushed) == 6:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    (cache, flushed)
+                await asyncio.sleep(0.2)
+            # every object still reads correctly (evicted ones
+            # re-promote from the flushed base copy)
+            for i in range(6):
+                assert await base_io.read(f"e{i}") == f"v{i}".encode()
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
