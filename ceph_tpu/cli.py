@@ -296,6 +296,8 @@ async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
         return await _mon(rados, "osd stat", j)
     if a in ("out", "in", "down"):
         return await _mon(rados, f"osd {a}", j, ids=args.ids)
+    if a in ("set", "unset"):
+        return await _mon(rados, f"osd {a}", j, flag=args.flag)
     if a == "tier":
         sub = args.sub
         if sub == "add":
@@ -465,6 +467,9 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("out", "in", "down"):
         o = osd_sub.add_parser(name)
         o.add_argument("ids", type=int, nargs="+")
+    for name in ("set", "unset"):
+        o = osd_sub.add_parser(name)
+        o.add_argument("flag")
     tier = osd_sub.add_parser("tier")
     tier_sub = tier.add_subparsers(dest="sub", required=True)
     for name in ("add", "remove"):

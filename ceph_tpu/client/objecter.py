@@ -21,12 +21,12 @@ from ceph_tpu.common.log import Dout
 from ceph_tpu.common.tracing import Tracer
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger
-from ceph_tpu.osd.codes import MISDIRECTED_RC, READ_OPS
+from ceph_tpu.osd.codes import MISDIRECTED_RC, READ_CLASS_OPS
 from ceph_tpu.osd.pg import object_to_ps
 
 log = Dout("objecter")
 
-_READ_OP_NAMES = READ_OPS | {"pgls"}
+_READ_OP_NAMES = READ_CLASS_OPS
 
 EAGAIN_RC = -11
 

@@ -116,6 +116,9 @@ class OSDMonitor(PaxosService):
     # -- boot / failure ---------------------------------------------------
     def prepare_boot(self, osd_id: int, addr: str, host: str) -> bool:
         """MOSDBoot: mark up, ensure crush location (OSDMonitor boot)."""
+        if "noup" in self.osdmap.flags:
+            log.dout(1, "noup set: ignoring boot from osd.%d", osd_id)
+            return False
         info = self.osdmap.osds.get(osd_id)
         if info is not None and info.up and info.addr == addr:
             return False        # no change: don't stage an empty epoch
@@ -123,7 +126,11 @@ class OSDMonitor(PaxosService):
         pending = self._pending()
         pending.new_up[osd_id] = addr
         if info is None:
-            pending.new_weights[osd_id] = 0x10000
+            # noin: a new OSD registers but stays OUT until the
+            # operator weights it in
+            pending.new_weights[osd_id] = (
+                0 if "noin" in self.osdmap.flags else 0x10000
+            )
         crush = self.osdmap.crush
         if osd_id >= crush.max_device or not any(
             osd_id in b.items for b in crush.buckets.values()
@@ -145,6 +152,8 @@ class OSDMonitor(PaxosService):
     def prepare_failure(self, target: int, reporter: str,
                         failed_for: float) -> bool:
         """MOSDFailure accounting (prepare_failure/check_failure)."""
+        if "nodown" in self.osdmap.flags:
+            return False
         if not self.osdmap.is_up(target):
             return False
         grace = self.mon.conf["osd_heartbeat_grace"]
@@ -175,6 +184,12 @@ class OSDMonitor(PaxosService):
                 "message": f"{len(down)} osds down",
                 "detail": [f"osd.{o} is down" for o in down],
             }
+        if self.osdmap.flags:
+            checks["OSDMAP_FLAGS"] = {
+                "severity": "HEALTH_WARN",
+                "message": (", ".join(sorted(self.osdmap.flags))
+                            + " flag(s) set"),
+            }
         return checks
 
     async def tick(self) -> None:
@@ -182,6 +197,8 @@ class OSDMonitor(PaxosService):
         now = time.monotonic()
         interval = self.mon.conf["mon_osd_down_out_interval"]
         changed = False
+        if "noout" in self.osdmap.flags:
+            return
         for osd, since in list(self.down_pending_out.items()):
             info = self.osdmap.osds.get(osd)
             if info is None or info.up or not info.in_cluster:
@@ -274,6 +291,8 @@ class OSDMonitor(PaxosService):
                 return self._cmd_rm_upmap_items(cmd)
             if name.startswith("osd tier"):
                 return self._cmd_tier(name, cmd)
+            if name in ("osd set", "osd unset"):
+                return self._cmd_flag(name == "osd set", cmd)
         except (KeyError, ValueError, TypeError) as e:
             return CommandResult(EINVAL_RC, f"bad command args: {e}")
         return CommandResult(EINVAL_RC, f"unrecognized command {name!r}")
@@ -599,6 +618,34 @@ class OSDMonitor(PaxosService):
             cache.cache_mode = ""
             return CommandResult(outs="tier removed")
         return CommandResult(EINVAL_RC, f"unrecognized command {name!r}")
+
+    # every accepted flag is ENFORCED somewhere (noout: tick out-aging;
+    # noin: boot weight; noup: boot; nodown: failure reports; pause:
+    # OSD op path; norecover/nobackfill: peering recovery gate;
+    # noscrub: scrub loop) — accepting a no-op flag would lie to the
+    # operator
+    FLAGS = ("noout", "noin", "noup", "nodown", "pause", "norecover",
+             "nobackfill", "noscrub")
+
+    def _cmd_flag(self, setting: bool, cmd: dict) -> CommandResult:
+        """`osd set/unset <flag>` (the CEPH_OSDMAP_* cluster flags)."""
+        flag = str(cmd.get("flag", ""))
+        if flag not in self.FLAGS:
+            return CommandResult(
+                EINVAL_RC, f"flag must be one of {self.FLAGS}"
+            )
+        pending = self._pending()
+        if setting:
+            if flag not in pending.set_flags:
+                pending.set_flags.append(flag)
+            self.mon.cluster_log("warn", f"osdmap flag {flag} set")
+        else:
+            if flag not in pending.unset_flags:
+                pending.unset_flags.append(flag)
+            self.mon.cluster_log("info", f"osdmap flag {flag} unset")
+        return CommandResult(
+            outs=f"{flag} is {'set' if setting else 'unset'}"
+        )
 
     def _cmd_osd_state(self, name: str, cmd: dict) -> CommandResult:
         ids = [int(i) for i in cmd.get("ids", [])]

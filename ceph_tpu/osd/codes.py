@@ -16,3 +16,5 @@ EPERM_RC = -1               # operation not permitted (caps)
 # (cache-tier read/write routing); pgls is a read-class special op
 READ_OPS = frozenset({"read", "stat", "getxattr", "getxattrs",
                       "omap_get"})
+# ...including the read-class special ops (caps + client-side routing)
+READ_CLASS_OPS = READ_OPS | {"pgls"}

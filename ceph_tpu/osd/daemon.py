@@ -55,6 +55,7 @@ from ceph_tpu.osd.codes import (
     ESTALE_RC,
     MISDIRECTED_RC,
     OK,
+    READ_CLASS_OPS,
     READ_OPS,
 )
 from ceph_tpu.osd.osd_map import NO_OSD, OSDMap
@@ -93,7 +94,7 @@ XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
 
 # read-class client ops (no mutation): ONE definition for the dedup
 # cache policy, the replay path, perf counters, and caps enforcement
-_CAPS_READ_OPS = READ_OPS | {"pgls"}
+_CAPS_READ_OPS = READ_CLASS_OPS
 
 # message types the embedded MonClient owns
 _MON_TYPES = {
@@ -250,9 +251,20 @@ class OSDDaemon:
         self.monc.sub_want("osdmap")
         self.monc.sub_want("config")
         self.monc.renew_subs()
-        await self.monc.send_boot(self.osd_id, str(self.msgr.my_addr),
-                                  host=self.host, timeout=timeout)
-        self._booted = True
+        try:
+            await self.monc.send_boot(self.osd_id,
+                                      str(self.msgr.my_addr),
+                                      host=self.host, timeout=timeout)
+            self._booted = True
+        except TimeoutError:
+            # e.g. the noup flag: keep the daemon alive and keep
+            # offering the boot until the mon accepts it (the reference
+            # OSD waits in preboot, it does not die)
+            log.dout(1, "%s: boot not acknowledged yet (noup?); "
+                     "retrying in the background", self.entity)
+            self._tasks.append(
+                asyncio.create_task(self._boot_retry_loop())
+            )
         if self.cephx:
             await self._refresh_service_secrets()
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
@@ -264,6 +276,18 @@ class OSDDaemon:
             )
         await self._start_admin_socket()
         log.dout(1, "%s: booted at %s", self.entity, self.msgr.my_addr)
+
+    async def _boot_retry_loop(self) -> None:
+        while not self._stopped and not self._booted:
+            try:
+                await self.monc.send_boot(
+                    self.osd_id, str(self.msgr.my_addr),
+                    host=self.host, timeout=5.0,
+                )
+                self._booted = True
+                log.dout(1, "%s: boot accepted", self.entity)
+            except (TimeoutError, ConnectionError, asyncio.TimeoutError):
+                await asyncio.sleep(1.0)
 
     async def _start_admin_socket(self) -> None:
         """Bind <admin_socket_dir>/<entity>.asok with the reference's
@@ -779,6 +803,22 @@ class OSDDaemon:
                 + [info.tail for info in pg.peer_infos.values()]
             )
             missing = pg.compute_missing()
+            flags = self.osdmap.flags if self.osdmap else set()
+            if missing.total() and ("norecover" in flags
+                                    or "nobackfill" in flags):
+                # recovery administratively gated: activate degraded
+                # and let the repeer retry once the flag clears
+                log.dout(1, "pg %s: recovery gated by osdmap flags %s",
+                         pg.pgid, sorted(flags))
+                for shard, osd in pg.acting_peers():
+                    self._send_osd(osd, Message("pg_activate", {
+                        "pgid": [pg.pgid.pool, pg.pgid.ps],
+                        "epoch": epoch,
+                    }, priority=PRIO_HIGH))
+                pg.state = STATE_ACTIVE
+                self._drain_waiters(pg)
+                self._schedule_repeer(pg, epoch, delay=1.0)
+                return
             if missing.backfill:
                 # log gaps: fall back to inventory comparison for those
                 # shards (the backfill path)
@@ -1006,6 +1046,16 @@ class OSDDaemon:
         conn = await self.msgr.connect(addr, f"osd.{osd}")
         if id(conn) in self._tier_authed:
             return
+        existing = self._tier_auth_state.get(id(conn))
+        if existing is not None:
+            # single-flight: a concurrent caller's exchange is already
+            # running; clobbering its state would orphan its future
+            ok = await asyncio.shield(
+                asyncio.wait_for(existing["fut"], 5.0)
+            )
+            if not ok:
+                raise ShardReadError(f"tier auth to osd.{osd} failed")
+            return
         if not self._service_secrets:
             await self._refresh_service_secrets()
         from ceph_tpu.mon.auth_monitor import seal_ticket
@@ -1020,7 +1070,7 @@ class OSDDaemon:
             "session_key": session_key, "fut": fut,
         }
         conn.send_message(Message("osd_auth", {"ticket": ticket}))
-        ok = await asyncio.wait_for(fut, 5.0)
+        ok = await asyncio.wait_for(asyncio.shield(fut), 5.0)
         if not ok:
             raise ShardReadError(f"tier auth to osd.{osd} failed")
         self._tier_authed.add(id(conn))
@@ -1086,7 +1136,8 @@ class OSDDaemon:
         mutates it."""
         rc, results, _ = await self._tier_base_op(
             pg.pool.tier_of, oid,
-            [{"op": "read", "off": 0}, {"op": "getxattrs"}],
+            [{"op": "read", "off": 0}, {"op": "getxattrs"},
+             {"op": "omap_get", "keys": None}],
         )
         if rc == ENOENT_RC:
             return                   # base miss: op sees ENOENT naturally
@@ -1098,6 +1149,9 @@ class OSDDaemon:
             if not str(name).startswith("tier."):
                 promote_ops.append({"op": "setxattr", "name": name,
                                     "value": value})
+        omap = results[2].get("kv") or {}
+        if omap:
+            promote_ops.append({"op": "omap_set", "kv": dict(omap)})
         prc, _, _ = await self._do_ops(pg, oid, promote_ops)
         if prc != OK:
             raise ShardReadError(f"promote write of {oid!r}: rc {prc}")
@@ -1192,10 +1246,15 @@ class OSDDaemon:
                 continue
             await self._tier_flush(pg, cid, obj)
             clean.append(name)
-        # target_max_objects is POOL-wide; each PG polices its share
-        # (the reference agent divides the target over the PG count)
+        # target_max_objects is POOL-wide; each PG polices its share,
+        # remainder spread over the low pg ids so the shares SUM to the
+        # ceiling (a floor of 0 everywhere would thrash-evict the whole
+        # cache each pass)
         ceiling = pg.pool.target_max_objects
-        per_pg = ceiling // max(pg.pool.pg_num, 1)
+        pg_num = max(pg.pool.pg_num, 1)
+        per_pg = ceiling // pg_num + (
+            1 if pg.pgid.ps < ceiling % pg_num else 0
+        )
         if ceiling and len(heads) > per_pg:
             cache = getattr(self, "_hit_sets", None) or {}
             entry = cache.get(pg.pgid)
@@ -1203,18 +1262,23 @@ class OSDDaemon:
                 else (lambda n: False)
             victims = sorted(clean, key=lambda n: (hot(n), n))
             for name in victims[: len(heads) - per_pg]:
-                # re-check at the last moment: a client write during
-                # this pass re-dirties; evicting it would lose the
-                # acknowledged write (base only has the older flush)
-                try:
-                    self.store.getattr(cid, GHObject(pg.pgid.pool, name),
-                                       dirty_attr)
-                    continue                 # dirty again: keep it
-                except KeyError:
-                    pass
-                # direct _do_ops: eviction must NOT propagate the
-                # delete to the base (the flushed copy IS the data)
-                await self._do_ops(pg, name, [{"op": "remove"}])
+                # dirty re-check + remove under the SAME object lock
+                # client writes serialize on: a write landing mid-pass
+                # re-dirties and must never be evicted (base only has
+                # the older flush). Direct backend call: eviction must
+                # NOT propagate the delete to the base.
+                async with pg.obj_lock(name):
+                    try:
+                        self.store.getattr(
+                            cid, GHObject(pg.pgid.pool, name),
+                            dirty_attr,
+                        )
+                        continue             # dirty again: keep it
+                    except KeyError:
+                        pass
+                    await self._do_ops_replicated_locked(
+                        pg, name, [{"op": "remove"}], "", None, None
+                    )
                 log.dout(10, "%s: evicted %s", self.entity, name)
 
     async def _tier_flush(self, pg: PG, cid: CollectionId,
@@ -1230,6 +1294,12 @@ class OSDDaemon:
                     "name": name[len(XATTR_PREFIX):],
                     "value": bytes(value),
                 })
+        try:
+            omap = self.store.omap_get(cid, obj)
+        except KeyError:
+            omap = {}
+        if omap:
+            flush_ops.append({"op": "omap_set", "kv": dict(omap)})
         v0 = self._obj_version(cid, obj)
         rc, _, _ = await self._tier_base_op(pg.pool.tier_of, obj.name,
                                             flush_ops)
@@ -1812,6 +1882,9 @@ class OSDDaemon:
                 await asyncio.sleep(interval)
             except asyncio.CancelledError:
                 return
+            if self.osdmap is not None \
+                    and "noscrub" in self.osdmap.flags:
+                continue
             ready = [pg for pg in self.pgs.values()
                      if pg.is_primary and pg.state == STATE_ACTIVE]
             if not ready:
@@ -2241,6 +2314,14 @@ class OSDDaemon:
                         and int(d.get("epoch", 0)) > self.osdmap.epoch)):
                 self._reply(conn, tid, MISDIRECTED_RC,
                             epoch=self.osdmap.epoch if self.osdmap else 0)
+                return
+            if self.osdmap is not None \
+                    and "pause" in self.osdmap.flags:
+                # paused cluster (CEPH_OSDMAP_PAUSERD/WR): the client's
+                # retry loop re-presents the op until unpause publishes
+                # a new epoch (or its own timeout expires)
+                self._reply(conn, tid, MISDIRECTED_RC,
+                            epoch=self.osdmap.epoch)
                 return
             if pg.state not in (STATE_ACTIVE,):
                 pg.waiting_for_active.append((conn, d))

@@ -110,6 +110,9 @@ class Incremental:
     # (OSDMap.h pg_upmap_items; empty list clears the entry)
     new_pg_upmap_items: dict[tuple[int, int], list[tuple[int, int]]] = \
         field(default_factory=dict)
+    # cluster flags (CEPH_OSDMAP_* bits as strings: noout, nodown, ...)
+    set_flags: list[str] = field(default_factory=list)
+    unset_flags: list[str] = field(default_factory=list)
     new_ec_profiles: dict[str, dict] = field(default_factory=dict)
     removed_ec_profiles: list[str] = field(default_factory=list)
     new_crush: dict | None = None       # full crush dump when it changed
@@ -135,6 +138,8 @@ class Incremental:
                 f"{pid}.{ps}": [list(p) for p in pairs]
                 for (pid, ps), pairs in self.new_pg_upmap_items.items()
             },
+            "set_flags": list(self.set_flags),
+            "unset_flags": list(self.unset_flags),
             "new_ec_profiles": {
                 n: dict(p) for n, p in self.new_ec_profiles.items()
             },
@@ -172,6 +177,8 @@ class Incremental:
                 cls._pgid(s): [(int(a), int(b)) for a, b in pairs]
                 for s, pairs in d.get("new_pg_upmap_items", {}).items()
             },
+            set_flags=[str(f) for f in d.get("set_flags", ())],
+            unset_flags=[str(f) for f in d.get("unset_flags", ())],
             new_ec_profiles={
                 n: dict(p)
                 for n, p in d.get("new_ec_profiles", {}).items()
@@ -191,6 +198,7 @@ class OSDMap:
         self.primary_temp: dict[tuple[int, int], int] = {}
         self.pg_upmap_items: dict[tuple[int, int],
                                   list[tuple[int, int]]] = {}
+        self.flags: set[str] = set()
         self.ec_profiles: dict[str, dict] = {}
         # never reused, even after pool deletion: a recycled id would
         # alias a dead pool's surviving shard objects into a new pool
@@ -242,6 +250,8 @@ class OSDMap:
                 self.pg_upmap_items[pgid] = [tuple(p) for p in pairs]
             else:
                 self.pg_upmap_items.pop(pgid, None)
+        self.flags |= set(inc.set_flags)
+        self.flags -= set(inc.unset_flags)
         for name, profile in inc.new_ec_profiles.items():
             self.ec_profiles[name] = dict(profile)
         for name in inc.removed_ec_profiles:
@@ -350,6 +360,7 @@ class OSDMap:
                 f"{pid}.{ps}": [list(p) for p in pairs]
                 for (pid, ps), pairs in self.pg_upmap_items.items()
             },
+            "flags": sorted(self.flags),
             "ec_profiles": {n: dict(p) for n, p in self.ec_profiles.items()},
             "max_pool_id": self.max_pool_id,
             "crush": self.crush.to_dict(),
@@ -378,6 +389,7 @@ class OSDMap:
             Incremental._pgid(s): [(int(a), int(b)) for a, b in pairs]
             for s, pairs in d.get("pg_upmap_items", {}).items()
         }
+        m.flags = {str(f) for f in d.get("flags", ())}
         m.ec_profiles = {
             n: dict(p) for n, p in d.get("ec_profiles", {}).items()
         }

@@ -287,6 +287,184 @@ class RGWLite:
             if uq.get("max_objects") and total_objs > uq["max_objects"]:
                 raise RGWError("QuotaExceeded", f"user {owner} objects")
 
+    # -- multipart upload (rgw_multi.cc: initiate/part/complete/abort) ----
+    @staticmethod
+    def _mp_meta_oid(bucket: str, key: str, upload_id: str) -> str:
+        return f"rgw.multipart.{bucket}/{key}.{upload_id}"
+
+    @staticmethod
+    def _mp_part_oid(bucket: str, key: str, upload_id: str,
+                     part: int) -> str:
+        return f"rgw.part.{bucket}/{key}.{upload_id}.{part:05d}"
+
+    async def initiate_multipart(self, bucket: str, key: str,
+                                 content_type: str =
+                                 "binary/octet-stream",
+                                 metadata: dict | None = None) -> str:
+        """S3 CreateMultipartUpload -> upload id."""
+        import secrets as _secrets
+
+        await self._check_bucket(bucket, "WRITE")
+        upload_id = _secrets.token_hex(8)
+        await self.ioctx.operate(
+            self._mp_meta_oid(bucket, key, upload_id),
+            ObjectOperation().create().omap_set({
+                "_meta": json.dumps({
+                    "key": key, "initiated": time.time(),
+                    "content_type": content_type,
+                    "meta": dict(metadata or {}),
+                    "owner": self.user or "",
+                }).encode(),
+            }),
+        )
+        return upload_id
+
+    async def _mp_meta(self, bucket: str, key: str,
+                       upload_id: str) -> dict:
+        try:
+            omap = await self.ioctx.get_omap(
+                self._mp_meta_oid(bucket, key, upload_id)
+            )
+        except RadosError as e:
+            if e.rc == -2:
+                raise RGWError("NoSuchUpload", upload_id) from e
+            raise
+        return omap
+
+    async def upload_part(self, bucket: str, key: str, upload_id: str,
+                          part_number: int, data: bytes) -> dict:
+        """S3 UploadPart; re-uploading a part number replaces it."""
+        if not 1 <= part_number <= 10000:
+            raise RGWError("InvalidArgument", "part number 1..10000")
+        meta = await self._check_bucket(bucket, "WRITE")
+        await self._mp_meta(bucket, key, upload_id)
+        await self._check_quota(bucket, meta, len(data),
+                                replaced_size=0, is_replace=False)
+        etag = hashlib.md5(data).hexdigest()
+        await self.ioctx.operate(
+            self._mp_part_oid(bucket, key, upload_id, part_number),
+            ObjectOperation().write_full(data),
+        )
+        await self.ioctx.set_omap(
+            self._mp_meta_oid(bucket, key, upload_id), {
+                f"part.{part_number:05d}": json.dumps({
+                    "etag": etag, "size": len(data),
+                }).encode(),
+            },
+        )
+        return {"etag": etag, "part_number": part_number}
+
+    async def list_parts(self, bucket: str, key: str,
+                         upload_id: str) -> list[dict]:
+        omap = await self._mp_meta(bucket, key, upload_id)
+        return [
+            {"part_number": int(k.split(".", 1)[1]),
+             **json.loads(v)}
+            for k, v in sorted(omap.items())
+            if k.startswith("part.")
+        ]
+
+    async def complete_multipart(self, bucket: str, key: str,
+                                 upload_id: str,
+                                 parts: list[tuple[int, str]]) -> dict:
+        """S3 CompleteMultipartUpload: validates the client's part list
+        (numbers ascending, etags matching), records a MANIFEST entry —
+        the object body stays in the part objects, read through the
+        manifest like the reference's RGWObjManifest."""
+        await self._check_bucket(bucket, "WRITE")
+        uploaded = {p["part_number"]: p
+                    for p in await self.list_parts(bucket, key,
+                                                   upload_id)}
+        if not parts:
+            raise RGWError("InvalidArgument", "empty part list")
+        manifest = []
+        total = 0
+        digest_md5 = hashlib.md5()
+        last = 0
+        for num, etag in parts:
+            if num <= last:
+                raise RGWError("InvalidPartOrder", str(num))
+            last = num
+            have = uploaded.get(num)
+            if have is None or have["etag"] != etag:
+                raise RGWError("InvalidPart", str(num))
+            manifest.append({
+                "oid": self._mp_part_oid(bucket, key, upload_id, num),
+                "size": have["size"], "etag": etag,
+            })
+            total += have["size"]
+            digest_md5.update(bytes.fromhex(etag))
+        meta_omap = await self._mp_meta(bucket, key, upload_id)
+        info = json.loads(meta_omap["_meta"])
+        # the assembled size is the real quota event (parts are not in
+        # the bucket index, so per-part checks cannot see each other)
+        bucket_meta = await self._bucket_meta(bucket)
+        existing0 = await self.ioctx.get_omap(self._index_oid(bucket),
+                                              [key])
+        await self._check_quota(
+            bucket, bucket_meta, total,
+            replaced_size=(json.loads(existing0[key])["size"]
+                           if key in existing0 else 0),
+            is_replace=key in existing0,
+        )
+        # the S3 multipart etag form: md5-of-part-md5s + part count
+        etag = f"{digest_md5.hexdigest()}-{len(manifest)}"
+        # drop uploaded-but-unused parts
+        used = {m["oid"] for m in manifest}
+        for num in uploaded:
+            oid = self._mp_part_oid(bucket, key, upload_id, num)
+            if oid not in used:
+                try:
+                    await self.ioctx.remove(oid)
+                except RadosError as e:
+                    if e.rc != -2:
+                        raise
+        # replacing an existing plain/multipart object: clean old data
+        existing = await self.ioctx.get_omap(self._index_oid(bucket),
+                                             [key])
+        if key in existing:
+            await self.delete_object(bucket, key)
+        entry = {
+            "size": total, "etag": etag, "mtime": time.time(),
+            "content_type": info["content_type"], "striped": False,
+            "meta": info["meta"], "multipart": manifest,
+        }
+        await self.ioctx.set_omap(self._index_oid(bucket), {
+            key: json.dumps(entry).encode(),
+        })
+        await self.ioctx.remove(
+            self._mp_meta_oid(bucket, key, upload_id)
+        )
+        await self._log(bucket, "put", key, etag)
+        return {"etag": etag, "size": total}
+
+    async def abort_multipart(self, bucket: str, key: str,
+                              upload_id: str) -> None:
+        await self._check_bucket(bucket, "WRITE")
+        for p in await self.list_parts(bucket, key, upload_id):
+            try:
+                await self.ioctx.remove(self._mp_part_oid(
+                    bucket, key, upload_id, p["part_number"]
+                ))
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+        await self.ioctx.remove(
+            self._mp_meta_oid(bucket, key, upload_id)
+        )
+
+    async def list_multipart_uploads(self, bucket: str) -> list[dict]:
+        await self._check_bucket(bucket, "READ")
+        prefix = f"rgw.multipart.{bucket}/"
+        out = []
+        for oid in await self.ioctx.list_objects():
+            if not oid.startswith(prefix):
+                continue
+            rest = oid[len(prefix):]
+            key, _, upload_id = rest.rpartition(".")
+            out.append({"key": key, "upload_id": upload_id})
+        return sorted(out, key=lambda u: (u["key"], u["upload_id"]))
+
     # -- lifecycle (rgw_lc.cc: expiration rules + the LC worker) ----------
     async def put_lifecycle(self, bucket: str,
                             rules: list[dict]) -> None:
@@ -445,7 +623,14 @@ class RGWLite:
             # must not inherit the old size xattr / stale tail stripes
             old = json.loads(existing[key])
             try:
-                if old.get("striped"):
+                if old.get("multipart"):
+                    for part in old["multipart"]:
+                        try:
+                            await self.ioctx.remove(part["oid"])
+                        except RadosError as e:
+                            if e.rc != -2:
+                                raise
+                elif old.get("striped"):
                     await self.striper.remove(oid)
                 else:
                     await self.ioctx.remove(oid)
@@ -482,7 +667,10 @@ class RGWLite:
         """S3 GET (optionally a byte range, inclusive bounds)."""
         entry = await self._entry(bucket, key)
         oid = self._data_oid(bucket, key)
-        if range_ is not None:
+        if entry.get("multipart"):
+            data = await self._read_manifest(entry["multipart"],
+                                             entry["size"], range_)
+        elif range_ is not None:
             start, end = range_
             end = min(end, entry["size"] - 1)
             length = max(0, end - start + 1)
@@ -496,13 +684,44 @@ class RGWLite:
             data = await self.ioctx.read(oid)
         return {"data": data, **entry}
 
+    async def _read_manifest(self, manifest: list[dict], size: int,
+                             range_: tuple[int, int] | None) -> bytes:
+        """Read through a multipart manifest (RGWObjManifest role):
+        only the parts overlapping the requested range are fetched."""
+        start, end = (0, size - 1) if range_ is None else range_
+        end = min(end, size - 1)
+        if end < start:
+            return b""
+        chunks = []
+        pos = 0
+        for part in manifest:
+            psize = int(part["size"])
+            pstart, pend = pos, pos + psize - 1
+            pos += psize
+            if pend < start:
+                continue
+            if pstart > end:
+                break
+            off = max(0, start - pstart)
+            length = min(pend, end) - (pstart + off) + 1
+            chunks.append(await self.ioctx.read(part["oid"], length,
+                                                off))
+        return b"".join(chunks)
+
     async def head_object(self, bucket: str, key: str) -> dict:
         return await self._entry(bucket, key)
 
     async def delete_object(self, bucket: str, key: str) -> None:
         entry = await self._entry(bucket, key, need="WRITE")
         oid = self._data_oid(bucket, key)
-        if entry["striped"]:
+        if entry.get("multipart"):
+            for part in entry["multipart"]:
+                try:
+                    await self.ioctx.remove(part["oid"])
+                except RadosError as e:
+                    if e.rc != -2:
+                        raise
+        elif entry["striped"]:
             await self.striper.remove(oid)
         else:
             await self.ioctx.remove(oid)

@@ -1,0 +1,185 @@
+"""OSDMap cluster flags + RGW multipart upload.
+
+Reference surfaces: CEPH_OSDMAP_* flags (`ceph osd set noout|pause|...`
+with OSDMonitor/OSD enforcement + OSDMAP_FLAGS health) and
+src/rgw/rgw_multi.cc (initiate/upload-part/complete/abort with the
+manifest read path and the md5-of-md5s etag).
+"""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rgw import RGWError, RGWLite
+from ceph_tpu.vstart import DevCluster
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def test_noout_and_nodown_gate_map_changes():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+            "mon_osd_down_out_interval": 0.5,
+            "osd_heartbeat_grace": 0.8,
+            "osd_heartbeat_interval": 0.1,
+        })
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd set", flag="bogus")
+            assert r["rc"] != 0
+            r = await rados.mon_command("osd set", flag="noout")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("health")
+            assert "OSDMAP_FLAGS" in r["data"]["checks"]
+
+            await cluster.kill_osd(2)
+            # the failure marks it down, but noout keeps it IN
+            deadline = asyncio.get_running_loop().time() + 15
+            mon = next(iter(cluster.mons.values()))
+            while True:
+                info = mon.osd_monitor.osdmap.osds[2]
+                if not info.up:
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            await asyncio.sleep(1.5)       # well past down_out_interval
+            assert mon.osd_monitor.osdmap.osds[2].in_cluster
+            r = await rados.mon_command("osd unset", flag="noout")
+            assert r["rc"] == 0, r
+            deadline = asyncio.get_running_loop().time() + 15
+            while mon.osd_monitor.osdmap.osds[2].in_cluster:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+
+            # nodown: failure reports are ignored entirely
+            r = await rados.mon_command("osd set", flag="nodown")
+            assert r["rc"] == 0, r
+            await cluster.kill_osd(1)
+            await asyncio.sleep(2.0)
+            assert mon.osd_monitor.osdmap.osds[1].up
+            r = await rados.mon_command("osd unset", flag="nodown")
+            assert r["rc"] == 0, r
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_pause_blocks_and_resumes_io():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=2)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="p",
+                                        pg_num=4, size=2)
+            assert r["rc"] == 0, r
+            ioctx = await rados.open_ioctx("p")
+            await ioctx.write_full("pre", b"1")
+
+            r = await rados.mon_command("osd set", flag="pause")
+            assert r["rc"] == 0, r
+            await asyncio.sleep(0.3)   # daemons learn the flag
+
+            write_task = asyncio.create_task(
+                ioctx.write_full("during", b"2")
+            )
+            await asyncio.sleep(0.8)
+            assert not write_task.done()     # IO is actually blocked
+
+            r = await rados.mon_command("osd unset", flag="pause")
+            assert r["rc"] == 0, r
+            await asyncio.wait_for(write_task, 15)
+            assert await ioctx.read("during") == b"2"
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_multipart_upload_lifecycle():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            gw = RGWLite(await rados.open_ioctx("rgw"))
+            await gw.create_bucket("mp")
+
+            upload = await gw.initiate_multipart("mp", "big.bin")
+            p1 = b"A" * 70000
+            p2 = b"B" * 50000
+            p3 = b"C" * 30
+            r1 = await gw.upload_part("mp", "big.bin", upload, 1, p1)
+            # re-upload replaces part 2
+            await gw.upload_part("mp", "big.bin", upload, 2, b"zz")
+            r2 = await gw.upload_part("mp", "big.bin", upload, 2, p2)
+            r3 = await gw.upload_part("mp", "big.bin", upload, 3, p3)
+            parts = await gw.list_parts("mp", "big.bin", upload)
+            assert [p["part_number"] for p in parts] == [1, 2, 3]
+            assert await gw.list_multipart_uploads("mp") == [
+                {"key": "big.bin", "upload_id": upload},
+            ]
+
+            # wrong etag / bad order refused
+            with pytest.raises(RGWError):
+                await gw.complete_multipart("mp", "big.bin", upload,
+                                            [(1, "deadbeef")])
+            with pytest.raises(RGWError):
+                await gw.complete_multipart(
+                    "mp", "big.bin", upload,
+                    [(2, r2["etag"]), (1, r1["etag"])],
+                )
+
+            done = await gw.complete_multipart(
+                "mp", "big.bin", upload,
+                [(1, r1["etag"]), (2, r2["etag"]), (3, r3["etag"])],
+            )
+            assert done["size"] == len(p1) + len(p2) + len(p3)
+            want_etag = hashlib.md5(
+                bytes.fromhex(r1["etag"]) + bytes.fromhex(r2["etag"])
+                + bytes.fromhex(r3["etag"])
+            ).hexdigest() + "-3"
+            assert done["etag"] == want_etag
+
+            got = await gw.get_object("mp", "big.bin")
+            assert got["data"] == p1 + p2 + p3
+            assert got["etag"] == want_etag
+            # ranged read crossing a part boundary
+            got = await gw.get_object("mp", "big.bin",
+                                      range_=(69998, 70003))
+            assert got["data"] == b"AA" + b"BBBB"
+            # upload meta is gone; listing shows the final object
+            assert await gw.list_multipart_uploads("mp") == []
+            listing = await gw.list_objects("mp")
+            assert listing["contents"][0]["size"] == done["size"]
+
+            # delete removes the part objects too
+            await gw.delete_object("mp", "big.bin")
+            leftovers = [o for o in await gw.ioctx.list_objects()
+                         if o.startswith("rgw.part.")]
+            assert leftovers == []
+
+            # abort cleans up a half-done upload
+            up2 = await gw.initiate_multipart("mp", "dropped")
+            await gw.upload_part("mp", "dropped", up2, 1, b"x" * 10)
+            await gw.abort_multipart("mp", "dropped", up2)
+            assert await gw.list_multipart_uploads("mp") == []
+            leftovers = [o for o in await gw.ioctx.list_objects()
+                         if o.startswith(("rgw.part.",
+                                          "rgw.multipart."))]
+            assert leftovers == []
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
