@@ -41,6 +41,38 @@ enable_compile_cache()   # before any jit lowering: reruns skip compiles
 
 ISA_L_BASELINE_GIBPS = 5.0
 
+INIT_TIMEOUT_S = 180.0
+
+
+def _init_backend_with_watchdog() -> None:
+    """Fail FAST with a parseable result when the TPU cannot be
+    claimed (a killed process can wedge the chip's grant for a long
+    time — see .claude/skills/verify): a hang here would otherwise eat
+    the caller's entire timeout with no output at all."""
+    import threading
+
+    done = threading.Event()
+
+    def _watchdog():
+        if not done.wait(INIT_TIMEOUT_S):
+            print(json.dumps({
+                "metric": "ec_encode_k8_m4_4KiB_stripes",
+                "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
+                "extra": {
+                    "error": "TPU backend init timed out "
+                             f"({INIT_TIMEOUT_S:.0f}s): chip claim "
+                             "unavailable (wedged grant?)",
+                },
+            }), flush=True)
+            import os
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    import jax
+
+    jax.devices()            # blocks while the chip claim is held
+    done.set()
+
 
 def _cpu_reference_encode_gibps() -> float:
     """BASELINE config #1: reed_sol_van k=4 m=2, 1MiB, in-repo CPU ref."""
@@ -142,6 +174,7 @@ def _lrc_repair_gibps(stripes: int = 64, C: int = 1 << 20) -> float:
 
 
 def main() -> None:
+    _init_backend_with_watchdog()
     from ceph_tpu.ec.benchmark import make_codec, run_encode, run_decode, \
         verify_all_erasures
 

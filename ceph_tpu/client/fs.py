@@ -20,6 +20,7 @@ from ceph_tpu.mds.daemon import (
     EEXIST,
     EINVAL,
     EISDIR,
+    ELOOP,
     ENOENT,
     ENOTDIR,
     block_oid,
@@ -244,26 +245,54 @@ class CephFS:
     def _split(path: str) -> list[str]:
         return [p for p in path.strip("/").split("/") if p]
 
+    _MAX_SYMLINKS = 10             # ELOOP bound (SYMLOOP_MAX role)
+
     async def _resolve_parent(self, path: str) -> tuple[int, str]:
-        """Walk to the parent of ``path``; returns (parent_ino, name)."""
+        """Walk to the parent of ``path``; returns (parent_ino, name).
+        Symlinks in intermediate components are followed."""
         parts = self._split(path)
         if not parts:
             raise FSError(EINVAL, "root has no parent")
-        ino = self.root
-        for part in parts[:-1]:
-            dentry = await self._lookup(ino, part)
-            if dentry["type"] != "dir":
-                raise FSError(ENOTDIR, f"{part!r} is not a directory")
-            ino = int(dentry["ino"])
-        return ino, parts[-1]
+        if len(parts) == 1:
+            return self.root, parts[0]
+        dirent = await self._resolve("/" + "/".join(parts[:-1]))
+        if dirent["type"] != "dir":
+            raise FSError(ENOTDIR, f"{path!r}: not a directory")
+        return int(dirent["ino"]), parts[-1]
 
-    async def _resolve(self, path: str) -> dict:
+    async def _resolve(self, path: str, follow: bool = True,
+                       _depth: int | None = None) -> dict:
+        """Path walk with symlink traversal (Client::path_walk role):
+        intermediate symlinks always follow; the FINAL component
+        follows only when ``follow`` (stat vs lstat semantics)."""
+        depth = self._MAX_SYMLINKS if _depth is None else _depth
         parts = self._split(path)
         if not parts:
             return {"ino": self.root, "type": "dir", "mode": 0o755,
                     "size": 0, "mtime": 0.0}
-        parent, name = await self._resolve_parent(path)
-        return await self._lookup(parent, name)
+        ino = self.root
+        for i, part in enumerate(parts):
+            dentry = await self._lookup(ino, part)
+            last = i == len(parts) - 1
+            if dentry["type"] == "symlink" and (follow or not last):
+                if depth <= 0:
+                    raise FSError(ELOOP, f"{path!r}: symlink loop")
+                target = str(dentry.get("target", ""))
+                rest = "/".join(parts[i + 1:])
+                if target.startswith("/"):
+                    newpath = target
+                else:
+                    newpath = "/" + "/".join(parts[:i]) + "/" + target
+                if rest:
+                    newpath += "/" + rest
+                return await self._resolve(newpath, follow,
+                                           depth - 1)
+            if not last:
+                if dentry["type"] != "dir":
+                    raise FSError(ENOTDIR,
+                                  f"{part!r} is not a directory")
+                ino = int(dentry["ino"])
+        return dentry
 
     # -- the libcephfs-shaped surface --------------------------------------
     async def mkdir(self, path: str, mode: int = 0o755) -> None:
@@ -296,11 +325,42 @@ class CephFS:
     async def stat(self, path: str) -> dict:
         return dict(await self._resolve(path))
 
+    async def lstat(self, path: str) -> dict:
+        """Like stat but does not follow a final-component symlink."""
+        return dict(await self._resolve(path, follow=False))
+
+    async def symlink(self, target: str, linkpath: str) -> None:
+        """ceph_symlink: create a symbolic link at ``linkpath``."""
+        parent, name = await self._resolve_parent(linkpath)
+        await self._request("symlink", parent=parent, name=name,
+                            target=target)
+        self._invalidate(parent, name)
+
+    async def readlink(self, path: str) -> str:
+        dentry = await self._resolve(path, follow=False)
+        if dentry["type"] != "symlink":
+            raise FSError(EINVAL, f"{path!r} is not a symlink")
+        return str(dentry.get("target", ""))
+
     async def open(self, path: str, flags: str = "r",
                    mode: int = 0o644) -> FileHandle:
         """flags: 'r' read, 'w' create+truncate, 'a' create+append,
         'x' exclusive create."""
         parent, name = await self._resolve_parent(path)
+        if flags in ("w", "a"):
+            # POSIX open(O_CREAT) follows an existing final symlink:
+            # the create/truncate lands on the TARGET, never on the
+            # link's own inode ('x' keeps EEXIST via the MDS)
+            try:
+                existing = await self._lookup(parent, name)
+            except FSError as e:
+                if e.rc != ENOENT:
+                    raise
+                existing = None
+            if existing is not None \
+                    and existing["type"] == "symlink":
+                resolved = await self._follow_link_path(path, existing)
+                parent, name = await self._resolve_parent(resolved)
         if flags in ("w", "a", "x"):
             reply = await self._request(
                 "create", parent=parent, name=name, mode=mode,
@@ -312,9 +372,42 @@ class CephFS:
                 await fh.truncate(0)
             return fh
         dentry = await self._lookup(parent, name)
+        if dentry["type"] == "symlink":
+            # read-open follows the link chain; the REAL file's
+            # (parent, name) is kept so attr flushes (fsync/close)
+            # land on the target dentry, not the link's
+            resolved = await self._follow_link_path(path, dentry)
+            parent, name = await self._resolve_parent(resolved)
+            dentry = await self._lookup(parent, name)
         if dentry["type"] == "dir":
             raise FSError(EISDIR, path)
         return FileHandle(self, parent, name, dentry)
+
+    async def _follow_link_path(self, path: str, dentry: dict) -> str:
+        """Resolve a symlink dentry at ``path`` to its FINAL non-link
+        path (chains bounded like _resolve)."""
+        hops = self._MAX_SYMLINKS
+        cur_path = path
+        while dentry["type"] == "symlink":
+            if hops <= 0:
+                raise FSError(ELOOP, f"{path!r}: symlink loop")
+            hops -= 1
+            tpath = str(dentry.get("target", ""))
+            if not tpath.startswith("/"):
+                dirname = "/".join(self._split(cur_path)[:-1])
+                tpath = f"/{dirname}/{tpath}" if dirname \
+                    else f"/{tpath}"
+            cur_path = tpath
+            try:
+                parent, name = await self._resolve_parent(tpath)
+                dentry = await self._lookup(parent, name)
+            except FSError as e:
+                if e.rc == ENOENT:
+                    # dangling link: creating through it creates the
+                    # TARGET (POSIX O_CREAT-through-symlink)
+                    return cur_path
+                raise
+        return cur_path
 
     async def unlink(self, path: str) -> None:
         parent, name = await self._resolve_parent(path)

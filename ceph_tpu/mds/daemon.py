@@ -51,6 +51,7 @@ EEXIST = -17
 ENOTDIR = -20
 EISDIR = -21
 ENOTEMPTY = -39
+ELOOP = -40
 EINVAL = -22
 
 
@@ -480,6 +481,25 @@ class MDSDaemon:
                 raise
         ino = await self._alloc_ino()
         dentry = _dentry(ino, "file", int(d.get("mode", 0o644)))
+        entry = {"op": "create", "parent": parent, "name": name,
+                 "ino": ino, "dentry": dentry}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"dentry": dentry}
+
+    async def _req_symlink(self, d: dict) -> dict:
+        """Server::handle_client_symlink: a dentry of type symlink
+        whose target string rides the embedded inode."""
+        parent, name = int(d["parent"]), str(d["name"])
+        try:
+            await self._get_dentry(parent, name)
+            raise MDSError(EEXIST, f"{name!r} exists")
+        except MDSError as e:
+            if not e.missing_dentry:
+                raise
+        ino = await self._alloc_ino()
+        dentry = _dentry(ino, "symlink", 0o777)
+        dentry["target"] = str(d.get("target", ""))
         entry = {"op": "create", "parent": parent, "name": name,
                  "ino": ino, "dentry": dentry}
         await self._journal(entry)

@@ -635,11 +635,18 @@ class OSDMonitor(PaxosService):
                 EINVAL_RC, f"flag must be one of {self.FLAGS}"
             )
         pending = self._pending()
+        # the LAST command wins within one pending epoch: leaving the
+        # flag on the opposite list would make apply (set then unset)
+        # silently resolve set-after-unset to unset
         if setting:
+            if flag in pending.unset_flags:
+                pending.unset_flags.remove(flag)
             if flag not in pending.set_flags:
                 pending.set_flags.append(flag)
             self.mon.cluster_log("warn", f"osdmap flag {flag} set")
         else:
+            if flag in pending.set_flags:
+                pending.set_flags.remove(flag)
             if flag not in pending.unset_flags:
                 pending.unset_flags.append(flag)
             self.mon.cluster_log("info", f"osdmap flag {flag} unset")

@@ -806,17 +806,12 @@ class OSDDaemon:
             flags = self.osdmap.flags if self.osdmap else set()
             if missing.total() and ("norecover" in flags
                                     or "nobackfill" in flags):
-                # recovery administratively gated: activate degraded
-                # and let the repeer retry once the flag clears
+                # recovery administratively gated: the PG stays PARKED
+                # (ops queue on waiting_for_active) — activating with
+                # holes would serve ENOENT/stale data for durable,
+                # acknowledged objects
                 log.dout(1, "pg %s: recovery gated by osdmap flags %s",
                          pg.pgid, sorted(flags))
-                for shard, osd in pg.acting_peers():
-                    self._send_osd(osd, Message("pg_activate", {
-                        "pgid": [pg.pgid.pool, pg.pgid.ps],
-                        "epoch": epoch,
-                    }, priority=PRIO_HIGH))
-                pg.state = STATE_ACTIVE
-                self._drain_waiters(pg)
                 self._schedule_repeer(pg, epoch, delay=1.0)
                 return
             if missing.backfill:
@@ -1050,8 +1045,8 @@ class OSDDaemon:
         if existing is not None:
             # single-flight: a concurrent caller's exchange is already
             # running; clobbering its state would orphan its future
-            ok = await asyncio.shield(
-                asyncio.wait_for(existing["fut"], 5.0)
+            ok = await asyncio.wait_for(
+                asyncio.shield(existing["fut"]), 5.0
             )
             if not ok:
                 raise ShardReadError(f"tier auth to osd.{osd} failed")

@@ -420,9 +420,9 @@ class RGWLite:
                     if e.rc != -2:
                         raise
         # replacing an existing plain/multipart object: clean old data
-        existing = await self.ioctx.get_omap(self._index_oid(bucket),
-                                             [key])
-        if key in existing:
+        # (existing0 was read for the quota check just above; nothing
+        # mutates the index in between)
+        if key in existing0:
             await self.delete_object(bucket, key)
         entry = {
             "size": total, "etag": etag, "mtime": time.time(),

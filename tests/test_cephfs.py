@@ -6,6 +6,7 @@ import asyncio
 import pytest
 
 from ceph_tpu.client.fs import CephFS, FSError
+from ceph_tpu.mds.daemon import ELOOP
 from ceph_tpu.mds.daemon import block_oid, dirfrag_oid
 from ceph_tpu.msg import reset_local_namespace
 from ceph_tpu.vstart import DevCluster
@@ -236,4 +237,75 @@ def test_lease_cache_serves_repeat_lookups():
             await fs.stat("/cached")       # within the lease TTL
         assert fs._tid == before           # no MDS round-trips
         await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_symlinks():
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.mkdir("/real")
+        await fs.write_file("/real/data.txt", b"via-link")
+
+        # absolute symlink to a file, followed by open/stat
+        await fs.symlink("/real/data.txt", "/alias")
+        assert await fs.readlink("/alias") == "/real/data.txt"
+        st = await fs.stat("/alias")           # follows
+        assert st["type"] == "file"
+        lst = await fs.lstat("/alias")         # does not follow
+        assert lst["type"] == "symlink"
+        assert await fs.read_file("/alias") == b"via-link"
+
+        # symlinked DIRECTORY in an intermediate component
+        await fs.symlink("/real", "/shortcut")
+        assert await fs.read_file("/shortcut/data.txt") == b"via-link"
+        assert "data.txt" in await fs.readdir("/shortcut")
+
+        # relative target resolves against the link's directory
+        await fs.symlink("data.txt", "/real/rel")
+        assert await fs.read_file("/real/rel") == b"via-link"
+
+        # dangling link: lstat works, follow raises ENOENT
+        await fs.symlink("/nowhere", "/dangling")
+        assert (await fs.lstat("/dangling"))["type"] == "symlink"
+        with pytest.raises(FSError):
+            await fs.stat("/dangling")
+
+        # loops terminate with ELOOP
+        await fs.symlink("/loop-b", "/loop-a")
+        await fs.symlink("/loop-a", "/loop-b")
+        with pytest.raises(FSError) as e:
+            await fs.stat("/loop-a")
+        assert e.value.rc == ELOOP
+
+        # WRITING through a link lands on the target, not the link
+        await fs.write_file("/alias", b"updated-via-link")
+        assert await fs.read_file("/real/data.txt") == \
+            b"updated-via-link"
+        assert (await fs.lstat("/alias"))["type"] == "symlink"
+        # creating through a dangling link creates the TARGET
+        await fs.symlink("/real/made-by-link", "/creator")
+        await fs.write_file("/creator", b"materialized")
+        assert await fs.read_file("/real/made-by-link") == \
+            b"materialized"
+        assert (await fs.lstat("/creator"))["type"] == "symlink"
+
+        # duplicate refused; unlink removes just the link
+        with pytest.raises(FSError):
+            await fs.symlink("/elsewhere", "/alias")
+        await fs.unlink("/alias")
+        assert await fs.read_file("/real/data.txt") == \
+            b"updated-via-link"
+        names = await fs.readdir("/")
+        assert "alias" not in names
+
+        # symlinks survive an MDS restart (journaled like any dentry)
+        await mds.shutdown()
+        del cluster.mdss["a"]
+        mds2 = await cluster.start_mds(name="a2")
+        fs2 = CephFS(rados, str(mds2.msgr.my_addr))
+        await fs2.mount()
+        assert await fs2.readlink("/shortcut") == "/real"
+        assert await fs2.read_file("/shortcut/data.txt") == \
+            b"updated-via-link"
+        await _teardown(cluster, rados, fs2)
     asyncio.run(run())
