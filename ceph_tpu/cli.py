@@ -298,6 +298,13 @@ async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
         return await _mon(rados, f"osd {a}", j, ids=args.ids)
     if a in ("set", "unset"):
         return await _mon(rados, f"osd {a}", j, flag=args.flag)
+    if a == "getcrushmap":
+        return await _mon(rados, "osd getcrushmap", j,
+                          render=lambda text: text)
+    if a == "setcrushmap":
+        text = (sys.stdin.read() if args.file == "-"
+                else open(args.file).read())
+        return await _mon(rados, "osd setcrushmap", j, map=text)
     if a == "tier":
         sub = args.sub
         if sub == "add":
@@ -470,6 +477,10 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("set", "unset"):
         o = osd_sub.add_parser(name)
         o.add_argument("flag")
+    osd_sub.add_parser("getcrushmap")
+    scm = osd_sub.add_parser("setcrushmap")
+    scm.add_argument("file", nargs="?", default="-",
+                     help="compiled map text ('-' = stdin)")
     tier = osd_sub.add_parser("tier")
     tier_sub = tier.add_subparsers(dest="sub", required=True)
     for name in ("add", "remove"):

@@ -247,6 +247,33 @@ class CephFS:
 
     _MAX_SYMLINKS = 10             # ELOOP bound (SYMLOOP_MAX role)
 
+    @staticmethod
+    def _normalize(parts: list[str]) -> list[str]:
+        """Lexical '.'/'..' collapse ('..' above root stays at root) —
+        the MDS stores no dot dentries, so joins must resolve them."""
+        out: list[str] = []
+        for p in parts:
+            if p == "." or not p:
+                continue
+            if p == "..":
+                if out:
+                    out.pop()
+                continue
+            out.append(p)
+        return out
+
+    @classmethod
+    def _join_link(cls, base_parts: list[str], target: str,
+                   rest: list[str]) -> str:
+        """New path after substituting a symlink target: absolute
+        targets restart at root, relative ones join the link's own
+        directory; '.'/'..' collapse lexically."""
+        if target.startswith("/"):
+            parts = target.split("/") + rest
+        else:
+            parts = list(base_parts) + target.split("/") + rest
+        return "/" + "/".join(cls._normalize(parts))
+
     async def _resolve_parent(self, path: str) -> tuple[int, str]:
         """Walk to the parent of ``path``; returns (parent_ino, name).
         Symlinks in intermediate components are followed."""
@@ -277,14 +304,10 @@ class CephFS:
             if dentry["type"] == "symlink" and (follow or not last):
                 if depth <= 0:
                     raise FSError(ELOOP, f"{path!r}: symlink loop")
-                target = str(dentry.get("target", ""))
-                rest = "/".join(parts[i + 1:])
-                if target.startswith("/"):
-                    newpath = target
-                else:
-                    newpath = "/" + "/".join(parts[:i]) + "/" + target
-                if rest:
-                    newpath += "/" + rest
+                newpath = self._join_link(
+                    parts[:i], str(dentry.get("target", "")),
+                    parts[i + 1:],
+                )
                 return await self._resolve(newpath, follow,
                                            depth - 1)
             if not last:
@@ -359,13 +382,30 @@ class CephFS:
                 existing = None
             if existing is not None \
                     and existing["type"] == "symlink":
-                resolved = await self._follow_link_path(path, existing)
-                parent, name = await self._resolve_parent(resolved)
+                _, parent, name, _ = await self._follow_link_path(
+                    path, existing
+                )
         if flags in ("w", "a", "x"):
-            reply = await self._request(
-                "create", parent=parent, name=name, mode=mode,
-                exclusive=flags == "x",
-            )
+            for _ in range(3):
+                try:
+                    reply = await self._request(
+                        "create", parent=parent, name=name, mode=mode,
+                        exclusive=flags == "x",
+                    )
+                    break
+                except FSError as e:
+                    # ELOOP: a symlink appeared at the name between our
+                    # lookup and the create (the MDS refuses to hand a
+                    # link dentry out as a file) — re-resolve + retry
+                    if e.rc != ELOOP:
+                        raise
+                    self._invalidate(parent, name)
+                    dentry = await self._lookup(parent, name)
+                    _, parent, name, _ = await self._follow_link_path(
+                        path, dentry
+                    )
+            else:
+                raise FSError(ELOOP, f"{path!r}: create/symlink race")
             self._invalidate(parent, name)
             fh = FileHandle(self, parent, name, reply["dentry"])
             if flags == "w" and fh.size:
@@ -376,38 +416,41 @@ class CephFS:
             # read-open follows the link chain; the REAL file's
             # (parent, name) is kept so attr flushes (fsync/close)
             # land on the target dentry, not the link's
-            resolved = await self._follow_link_path(path, dentry)
-            parent, name = await self._resolve_parent(resolved)
-            dentry = await self._lookup(parent, name)
+            resolved, parent, name, dentry = \
+                await self._follow_link_path(path, dentry)
+            if dentry is None:
+                raise FSError(ENOENT, resolved)
         if dentry["type"] == "dir":
             raise FSError(EISDIR, path)
         return FileHandle(self, parent, name, dentry)
 
-    async def _follow_link_path(self, path: str, dentry: dict) -> str:
+    async def _follow_link_path(
+        self, path: str, dentry: dict
+    ) -> tuple[str, int, str, dict | None]:
         """Resolve a symlink dentry at ``path`` to its FINAL non-link
-        path (chains bounded like _resolve)."""
+        location (chains bounded like _resolve).  Returns (path,
+        parent_ino, name, dentry-or-None); a None dentry means the
+        final target is dangling — creating through it creates the
+        TARGET (POSIX O_CREAT-through-symlink)."""
         hops = self._MAX_SYMLINKS
         cur_path = path
+        parent, name = await self._resolve_parent(path)
         while dentry["type"] == "symlink":
             if hops <= 0:
                 raise FSError(ELOOP, f"{path!r}: symlink loop")
             hops -= 1
-            tpath = str(dentry.get("target", ""))
-            if not tpath.startswith("/"):
-                dirname = "/".join(self._split(cur_path)[:-1])
-                tpath = f"/{dirname}/{tpath}" if dirname \
-                    else f"/{tpath}"
-            cur_path = tpath
+            cur_path = self._join_link(
+                self._split(cur_path)[:-1],
+                str(dentry.get("target", "")), [],
+            )
+            parent, name = await self._resolve_parent(cur_path)
             try:
-                parent, name = await self._resolve_parent(tpath)
                 dentry = await self._lookup(parent, name)
             except FSError as e:
                 if e.rc == ENOENT:
-                    # dangling link: creating through it creates the
-                    # TARGET (POSIX O_CREAT-through-symlink)
-                    return cur_path
+                    return cur_path, parent, name, None
                 raise
-        return cur_path
+        return cur_path, parent, name, dentry
 
     async def unlink(self, path: str) -> None:
         parent, name = await self._resolve_parent(path)

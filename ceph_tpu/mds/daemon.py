@@ -475,6 +475,13 @@ class MDSDaemon:
                 raise MDSError(EEXIST, f"{name!r} exists")
             if existing["type"] == "dir":
                 raise MDSError(EISDIR, name)
+            if existing["type"] == "symlink":
+                # the MDS cannot follow (resolution is client-side):
+                # answering with the link dentry would let the client
+                # write data blocks under the LINK's inode.  The client
+                # re-resolves and retries at the target (a race with a
+                # concurrent symlink creation lands here).
+                raise MDSError(ELOOP, f"{name!r} is a symlink")
             return {"dentry": existing}
         except MDSError as e:
             if not e.missing_dentry:

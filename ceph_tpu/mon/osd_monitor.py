@@ -233,6 +233,10 @@ class OSDMonitor(PaxosService):
             })
         if name == "osd tree":
             return CommandResult(data=self._tree())
+        if name == "osd getcrushmap":
+            from ceph_tpu.placement.compiler import decompile
+
+            return CommandResult(data=decompile(self.osdmap.crush))
         if name == "osd getmap":
             epoch = int(cmd.get("epoch", self.osdmap.epoch))
             raw = self.store.get(PREFIX, f"full_{epoch}")
@@ -293,6 +297,8 @@ class OSDMonitor(PaxosService):
                 return self._cmd_tier(name, cmd)
             if name in ("osd set", "osd unset"):
                 return self._cmd_flag(name == "osd set", cmd)
+            if name == "osd setcrushmap":
+                return self._cmd_setcrushmap(cmd)
         except (KeyError, ValueError, TypeError) as e:
             return CommandResult(EINVAL_RC, f"bad command args: {e}")
         return CommandResult(EINVAL_RC, f"unrecognized command {name!r}")
@@ -624,6 +630,29 @@ class OSDMonitor(PaxosService):
     # OSD op path; norecover/nobackfill: peering recovery gate;
     # noscrub: scrub loop) — accepting a no-op flag would lie to the
     # operator
+    def _cmd_setcrushmap(self, cmd: dict) -> CommandResult:
+        """``osd setcrushmap`` with the compiler text form (the
+        crushtool -c | ceph osd setcrushmap pipeline): the candidate
+        map must still satisfy every pool's rule."""
+        from ceph_tpu.placement.compiler import CompileError, compile_text
+
+        try:
+            new_crush = compile_text(str(cmd.get("map", "")))
+        except CompileError as e:
+            return CommandResult(EINVAL_RC, f"compile failed: {e}")
+        staged = (self.pending.new_pools
+                  if self.pending is not None else [])
+        for pool in list(self.osdmap.pools.values()) + list(staged):
+            if pool.crush_rule not in new_crush.rules:
+                return CommandResult(
+                    EINVAL_RC,
+                    f"pool {pool.name!r} needs rule "
+                    f"{pool.crush_rule!r}, absent from the new map",
+                )
+        self._pending().new_crush = new_crush.to_dict()
+        self.mon.cluster_log("warn", "crush map replaced by operator")
+        return CommandResult(outs="set crush map")
+
     FLAGS = ("noout", "noin", "noup", "nodown", "pause", "norecover",
              "nobackfill", "noscrub")
 

@@ -812,7 +812,7 @@ class OSDDaemon:
                 # acknowledged objects
                 log.dout(1, "pg %s: recovery gated by osdmap flags %s",
                          pg.pgid, sorted(flags))
-                self._schedule_repeer(pg, epoch, delay=1.0)
+                self._schedule_recovery_ungate(pg, epoch)
                 return
             if missing.backfill:
                 # log gaps: fall back to inventory comparison for those
@@ -869,6 +869,24 @@ class OSDDaemon:
                      pg.pgid, missing.total())
         except asyncio.CancelledError:
             pass
+
+    def _schedule_recovery_ungate(self, pg: PG, epoch: int) -> None:
+        """Wait out norecover/nobackfill WITHOUT re-running the whole
+        peer log-query exchange every tick: the flag lives in our own
+        osdmap, so poll it locally and only re-peer once it clears."""
+        async def wait_clear():
+            try:
+                while not self._stopped and pg.epoch == epoch:
+                    flags = self.osdmap.flags if self.osdmap else set()
+                    if "norecover" not in flags \
+                            and "nobackfill" not in flags:
+                        self._schedule_repeer(pg, epoch, delay=0.0)
+                        return
+                    await asyncio.sleep(0.5)
+            except asyncio.CancelledError:
+                pass
+
+        asyncio.get_running_loop().create_task(wait_clear())
 
     def _schedule_repeer(self, pg: PG, epoch: int,
                          delay: float = 1.0) -> None:

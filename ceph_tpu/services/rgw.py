@@ -419,10 +419,13 @@ class RGWLite:
                 except RadosError as e:
                     if e.rc != -2:
                         raise
-        # replacing an existing plain/multipart object: clean old data
-        # (existing0 was read for the quota check just above; nothing
-        # mutates the index in between)
-        if key in existing0:
+        # replacing an existing plain/multipart object: clean old data.
+        # Re-read the index HERE: awaits since existing0 (quota check,
+        # part cleanup) give concurrent PUT/DELETEs of the same key a
+        # window — a stale snapshot would leak a racer's data objects
+        existing = await self.ioctx.get_omap(self._index_oid(bucket),
+                                             [key])
+        if key in existing:
             await self.delete_object(bucket, key)
         entry = {
             "size": total, "etag": etag, "mtime": time.time(),

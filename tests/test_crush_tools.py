@@ -134,6 +134,61 @@ def test_tester_report():
     assert rep["bad_mappings"] == 50
 
 
+def test_get_set_crushmap_round_trip():
+    """`osd getcrushmap` | edit | `osd setcrushmap`: the crushtool
+    pipeline against a live monitor, including rule-safety refusal."""
+    import asyncio
+
+    from ceph_tpu.msg import reset_local_namespace
+    from ceph_tpu.vstart import DevCluster
+
+    async def run():
+        reset_local_namespace()
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="p",
+                                        pg_num=4, size=2)
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("osd getcrushmap")
+            assert r["rc"] == 0
+            text = r["data"]
+            assert "replicated_rule" in text
+
+            # an edit dropping a pool's rule is refused
+            broken = text.replace("rule replicated_rule",
+                                  "rule renamed_rule")
+            r = await rados.mon_command("osd setcrushmap", map=broken)
+            assert r["rc"] != 0 and "replicated_rule" in r["outs"]
+
+            # a compatible edit (extra rule) round-trips and commits
+            extra = text.replace(
+                "# end crush map",
+                "rule extra_rule {\n\tid 9\n\ttype replicated\n"
+                "\tstep take default\n"
+                "\tstep chooseleaf firstn 0 type host\n"
+                "\tstep emit\n}\n# end crush map",
+            )
+            r = await rados.mon_command("osd setcrushmap", map=extra)
+            assert r["rc"] == 0, r
+            deadline = asyncio.get_running_loop().time() + 10
+            mon = next(iter(cluster.mons.values()))
+            while "extra_rule" not in mon.osd_monitor.osdmap.crush.rules:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            # IO still works on the edited map
+            ioctx = await rados.open_ioctx("p")
+            await ioctx.write_full("after-edit", b"ok")
+            assert await ioctx.read("after-edit") == b"ok"
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+            reset_local_namespace()
+
+    asyncio.run(run())
+
+
 def test_tester_cli(tmp_path):
     from ceph_tpu.placement import tester
 
