@@ -380,9 +380,10 @@ class CephFS:
                 if e.rc != ENOENT:
                     raise
                 existing = None
+            cpath = path
             if existing is not None \
                     and existing["type"] == "symlink":
-                _, parent, name, _ = await self._follow_link_path(
+                cpath, parent, name, _ = await self._follow_link_path(
                     path, existing
                 )
         if flags in ("w", "a", "x"):
@@ -401,9 +402,10 @@ class CephFS:
                         raise
                     self._invalidate(parent, name)
                     dentry = await self._lookup(parent, name)
-                    _, parent, name, _ = await self._follow_link_path(
-                        path, dentry
-                    )
+                    # the retry's relative-target base is the path we
+                    # FOLLOWED to, not the original user path
+                    cpath, parent, name, _ = \
+                        await self._follow_link_path(cpath, dentry)
             else:
                 raise FSError(ELOOP, f"{path!r}: create/symlink race")
             self._invalidate(parent, name)

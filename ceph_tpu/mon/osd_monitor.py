@@ -636,6 +636,14 @@ class OSDMonitor(PaxosService):
         map must still satisfy every pool's rule."""
         from ceph_tpu.placement.compiler import CompileError, compile_text
 
+        if self.pending is not None \
+                and self.pending.new_crush is not None:
+            # e.g. an OSD boot staged a host/bucket insertion this
+            # round; replacing it wholesale would silently drop that
+            # OSD from CRUSH — the operator retries after the commit
+            return CommandResult(
+                -11, "crush edits pending in this epoch; retry"
+            )
         try:
             new_crush = compile_text(str(cmd.get("map", "")))
         except CompileError as e:

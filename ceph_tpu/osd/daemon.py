@@ -182,6 +182,7 @@ class OSDDaemon:
         self._tier_futs: dict[int, asyncio.Future] = {}
         self._tier_promoting: dict[tuple, asyncio.Future] = {}
         self._tier_authed: set[int] = set()
+        self._ungate_tasks: set[asyncio.Task] = set()
         self._tier_auth_state: dict[int, dict] = {}
         self.tracer = Tracer(self.entity)
         # op-LIFETIME memory bound on client payloads (the reference's
@@ -776,6 +777,11 @@ class OSDDaemon:
         try:
             epoch = pg.epoch
             pg.peer_infos = {}      # re-peer of the same interval: fresh
+            if pg.backend is not None \
+                    and getattr(pg.backend, "extent_cache", None):
+                # a (re)peer may rewind objects via direct store txs —
+                # cached extents from before the round are untrustworthy
+                pg.backend.extent_cache.clear()
             local = self._local_info(pg)
             pg.record_info(local)
             # an OSD may hold several EC shard positions of one PG: each
@@ -886,7 +892,9 @@ class OSDDaemon:
             except asyncio.CancelledError:
                 pass
 
-        asyncio.get_running_loop().create_task(wait_clear())
+        task = asyncio.get_running_loop().create_task(wait_clear())
+        self._ungate_tasks.add(task)
+        task.add_done_callback(self._ungate_tasks.discard)
 
     def _schedule_repeer(self, pg: PG, epoch: int,
                          delay: float = 1.0) -> None:

@@ -117,6 +117,108 @@ class ECObjectMeta:
     version: int
 
 
+class ExtentCache:
+    """Logical-extent cache for the EC overwrite pipeline (the role of
+    reference src/osd/ExtentCache.h: pin recently written extents so a
+    sub-stripe overwrite can merge WITHOUT re-reading + decoding k
+    shards).  Lives inside one primary's ECBackend — all mutations flow
+    through it under the per-object lock, and the backend (with its
+    cache) is rebuilt at every peering interval, so coherence holds by
+    construction.  Extents are coalesced per object; the whole cache is
+    LRU-bounded by bytes."""
+
+    def __init__(self, max_bytes: int = 8 << 20):
+        from collections import OrderedDict
+
+        self.max_bytes = max_bytes
+        # oid -> sorted list of [start, bytearray] non-overlapping
+        self._objs: "OrderedDict[str, list]" = OrderedDict()
+        self._bytes = 0              # running total (trim is O(evicted))
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, oid: str, start: int, length: int) -> bytes | None:
+        """The extent IFF fully covered; None = caller must read."""
+        if length <= 0:
+            return b""
+        extents = self._objs.get(oid)
+        if extents is None:
+            self.misses += 1
+            return None
+        for estart, data in extents:
+            if estart <= start and start + length <= estart + len(data):
+                self._objs.move_to_end(oid)
+                self.hits += 1
+                return bytes(data[start - estart:
+                                  start - estart + length])
+        self.misses += 1
+        return None
+
+    def note_write(self, oid: str, start: int, data: bytes) -> None:
+        """Record the post-write logical content of an aligned region,
+        coalescing with overlapping/adjacent extents."""
+        if not len(data):
+            return
+        extents = self._objs.setdefault(oid, [])
+        new_start, new_end = start, start + len(data)
+        merged = bytearray(data)
+        keep = []
+        for estart, edata in extents:
+            eend = estart + len(edata)
+            if eend < new_start or estart > new_end:
+                keep.append([estart, edata])
+                continue
+            # overlap/adjacency: splice the older bytes around the new
+            if estart < new_start:
+                merged = edata[: new_start - estart] + merged
+                new_start = estart
+            if eend > new_end:
+                merged = merged + edata[len(edata) - (eend - new_end):]
+                new_end = eend
+        keep.append([new_start, bytearray(merged)])
+        keep.sort(key=lambda e: e[0])
+        self._bytes -= sum(len(d) for _, d in extents)
+        self._bytes += sum(len(d) for _, d in keep)
+        self._objs[oid] = keep
+        self._objs.move_to_end(oid)
+        self._trim()
+
+    def invalidate(self, oid: str) -> None:
+        extents = self._objs.pop(oid, None)
+        if extents:
+            self._bytes -= sum(len(d) for _, d in extents)
+
+    def clear(self) -> None:
+        self._objs.clear()
+        self._bytes = 0
+
+    def _trim(self) -> None:
+        while self._bytes > self.max_bytes and len(self._objs) > 1:
+            _, extents = self._objs.popitem(last=False)
+            self._bytes -= sum(len(d) for _, d in extents)
+        # a single giant object must honor the budget too (a sequential
+        # writer coalesces into one ever-growing extent): shed lowest-
+        # offset bytes — farthest from a streaming tail — keeping the
+        # hot tail cached
+        while self._bytes > self.max_bytes and self._objs:
+            _, extents = next(iter(self._objs.items()))
+            if not extents:
+                self._objs.popitem(last=False)
+                continue
+            over = self._bytes - self.max_bytes
+            start, data = extents[0]
+            if len(data) <= over:
+                extents.pop(0)
+                self._bytes -= len(data)
+            else:
+                extents[0] = [start + over, data[over:]]
+                self._bytes -= over
+
+    def stats(self) -> dict:
+        return {"objects": len(self._objs), "bytes": self._bytes,
+                "hits": self.hits, "misses": self.misses}
+
+
 class ECBackend:
     def __init__(
         self,
@@ -153,6 +255,7 @@ class ECBackend:
             raise ValueError(f"need shards 0..{self.n - 1}")
         self._object_locks: dict[str, tuple[asyncio.Lock, int]] = {}
         self._repair_tasks: set[asyncio.Task] = set()
+        self.extent_cache = ExtentCache()
         # oid -> shards known stale from a failed mutation: a subsequent
         # write must heal them FIRST — otherwise its version bump would
         # make the stale shard pass the per-object version check and
@@ -308,13 +411,17 @@ class ECBackend:
                 offset, len(data)
             )
             buf = np.zeros(a_len, np.uint8)
-            # RMW: read back surviving logical bytes around the write
+            # RMW: read back surviving logical bytes around the write —
+            # the extent cache (ExtentCache role) serves back-to-back
+            # overwrites without re-reading + decoding k shards
             if old_size > a_start:
                 keep_len = min(old_size, a_start + a_len) - a_start
-                existing = await self._read_logical(
-                    oid, a_start, keep_len, old_size,
-                    meta.version if meta else None,
-                )
+                existing = self.extent_cache.get(oid, a_start, keep_len)
+                if existing is None:
+                    existing = await self._read_logical(
+                        oid, a_start, keep_len, old_size,
+                        meta.version if meta else None,
+                    )
                 buf[:keep_len] = np.frombuffer(existing, np.uint8)
             buf[offset - a_start: end - a_start] = np.frombuffer(
                 bytes(data), np.uint8
@@ -334,23 +441,33 @@ class ECBackend:
             entry = (self.log_hook(oid, "modify", new_version,
                                    meta.version if meta else 0, reqid)
                      if self.log_hook else None)
-            results = await asyncio.gather(*(
-                self.shards[i].write_shard(
-                    oid, shard_off, shard_bytes[i].tobytes(),
-                    {VERSION_ATTR: meta_attr, HINFO_ATTR: hattrs[i]},
-                    log=entry,
+            try:
+                results = await asyncio.gather(*(
+                    self.shards[i].write_shard(
+                        oid, shard_off, shard_bytes[i].tobytes(),
+                        {VERSION_ATTR: meta_attr,
+                         HINFO_ATTR: hattrs[i]},
+                        log=entry,
+                    )
+                    for i in range(self.n)
+                ), return_exceptions=True)
+                failed = [i for i, r in enumerate(results)
+                          if isinstance(r, BaseException)]
+                await self._settle_write_failures(
+                    "write", oid, failed,
+                    lambda live: self._heal_shards(oid, live, entry),
+                    entry,
+                    causes={i: repr(r) for i, r in enumerate(results)
+                            if isinstance(r, BaseException)},
                 )
-                for i in range(self.n)
-            ), return_exceptions=True)
-            failed = [i for i, r in enumerate(results)
-                      if isinstance(r, BaseException)]
-            await self._settle_write_failures(
-                "write", oid, failed,
-                lambda live: self._heal_shards(oid, live, entry),
-                entry,
-                causes={i: repr(r) for i, r in enumerate(results)
-                        if isinstance(r, BaseException)},
-            )
+            except BaseException:
+                # unsettled on-disk outcome (failure OR cancellation
+                # mid-gather, when a subset of shards already hold the
+                # new bytes): cached extents can no longer be trusted
+                self.extent_cache.invalidate(oid)
+                raise
+            self.extent_cache.note_write(oid, a_start,
+                                         buf.tobytes())
             return ECObjectMeta(new_size, new_version)
 
     async def _settle_write_failures(self, what: str, oid: str,
@@ -644,31 +761,37 @@ class ECBackend:
         """Remove every shard object. A shard that lacks it is fine; IO
         failures beyond m mean the removal did not take and must raise
         (a silently-surviving shard would resurrect the object)."""
-        meta = await self._read_meta(oid) if self.log_hook else None
-        entry = (self.log_hook(oid, "delete", 0,
-                               meta.version if meta else 0, reqid)
-                 if self.log_hook else None)
+        async with self._lock(oid):
+            # invalidate INSIDE the object lock: outside it, a write
+            # already past its gather could note_write AFTER this
+            # invalidate and resurrect pre-delete bytes in the cache
+            self.extent_cache.invalidate(oid)
+            meta = await self._read_meta(oid) if self.log_hook else None
+            entry = (self.log_hook(oid, "delete", 0,
+                                   meta.version if meta else 0, reqid)
+                     if self.log_hook else None)
 
-        async def rm(i: int):
-            try:
-                await self.shards[i].remove_shard(oid, log=entry)
-            except KeyError:
-                pass                # already absent on this shard
-        results = await asyncio.gather(
-            *(rm(i) for i in range(self.n)), return_exceptions=True
-        )
-        failed = [i for i, r in enumerate(results)
-                  if isinstance(r, BaseException)]
-
-        async def heal(live):
-            for i in live:
+            async def rm(i: int):
                 try:
                     await self.shards[i].remove_shard(oid, log=entry)
                 except KeyError:
-                    pass
-        await self._settle_write_failures("remove", oid, failed, heal,
-                                          entry)
-        self._dirty.pop(oid, None)      # nothing left to be stale about
+                    pass            # already absent on this shard
+            results = await asyncio.gather(
+                *(rm(i) for i in range(self.n)), return_exceptions=True
+            )
+            failed = [i for i, r in enumerate(results)
+                      if isinstance(r, BaseException)]
+
+            async def heal(live):
+                for i in live:
+                    try:
+                        await self.shards[i].remove_shard(oid,
+                                                          log=entry)
+                    except KeyError:
+                        pass
+            await self._settle_write_failures("remove", oid, failed,
+                                              heal, entry)
+            self._dirty.pop(oid, None)  # nothing left to be stale about
 
     async def set_attr(self, oid: str, name: str, value: bytes,
                        reqid: str = "") -> None:
