@@ -249,6 +249,11 @@ class OSDDaemon:
         await self.store.mount()
         await self.msgr.bind(self.addr)
         await self.monc.start(timeout)
+        if self.cephx:
+            # BEFORE the map subscription: a revived OSD's first map
+            # triggers peering immediately, and unsigned pg_queries
+            # (no secrets yet) would be dropped by every peer
+            await self._refresh_service_secrets()
         self.monc.sub_want("osdmap")
         self.monc.sub_want("config")
         self.monc.renew_subs()
@@ -266,8 +271,6 @@ class OSDDaemon:
             self._tasks.append(
                 asyncio.create_task(self._boot_retry_loop())
             )
-        if self.cephx:
-            await self._refresh_service_secrets()
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         if self.conf["osd_scrub_interval"] > 0:
             self._tasks.append(asyncio.create_task(self._scrub_loop()))
@@ -869,6 +872,10 @@ class OSDDaemon:
                 self._send_osd(osd, Message("pg_activate", dict(merge),
                                             priority=PRIO_HIGH))
             pg.state = STATE_ACTIVE
+            # a CLEAN activation has nothing missing: keeping the
+            # pre-recovery set would report active+degraded (and a
+            # degraded PGMap digest) forever after recovery succeeded
+            pg.missing = MissingSet()
             self._drain_waiters(pg)
             self._kick_snaptrim(pg)
             log.dout(5, "pg %s: active (recovered %d objects)",

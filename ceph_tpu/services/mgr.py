@@ -39,6 +39,7 @@ class Mgr:
         self.monc = MonClient(name, monmap, self.conf, msgr=self.msgr)
         self._tid = 0
         self._futures: dict[int, asyncio.Future] = {}
+        self.admin_socket = None
         if modules is None:
             modules = [Balancer(self), PGAutoscaler(self),
                        Progress(self)]
@@ -68,8 +69,26 @@ class Mgr:
         self.monc.sub_want("osdmap")
         self.monc.renew_subs()
         await self.monc.wait_for_map(1, timeout)
+        run_dir = self.conf["admin_socket_dir"]
+        if run_dir:
+            from ceph_tpu.common.admin_socket import AdminSocket
+
+            sock = AdminSocket(self.name)
+            sock.register("status", lambda: {
+                "entity": self.name,
+                "modules": sorted(self.modules),
+                "osdmap_epoch": (self.monc.osdmap.epoch
+                                 if self.monc.osdmap else 0),
+            }, "mgr state")
+            sock.register("config show", self.conf.show,
+                          "live configuration")
+            await sock.start(run_dir)
+            self.admin_socket = sock
 
     async def shutdown(self) -> None:
+        if self.admin_socket is not None:
+            await self.admin_socket.stop()
+            self.admin_socket = None
         await self.monc.shutdown()
         await self.msgr.shutdown()
 

@@ -234,7 +234,8 @@ class DevCluster:
 
     async def wait_health_ok(self, timeout: float = 20.0) -> None:
         import asyncio
-        rados = await self.client("client.health")
+        # client.admin: the only entity guaranteed a key under cephx
+        rados = await self.client()
         try:
             deadline = asyncio.get_running_loop().time() + timeout
             while True:

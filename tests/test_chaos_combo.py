@@ -1,0 +1,125 @@
+"""Integration chaos: the round-2 subsystems running TOGETHER.
+
+One cluster with cephx auth, AES-GCM secure mode, a writeback cache
+tier, an mgr with modules, and an MDS — while OSDs get killed and
+revived mid-workload.  Cross-subsystem seams (tier client auth under
+cephx, secure-mode reconnect/rekey during failover, PGMap digests over
+a churning map) are exactly where isolated suites cannot look.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def test_everything_on_under_failures():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=4, cephx=True, overrides={
+            "ms_secure_mode": True,
+            "auth_shared_key": "combo-secret",
+            "osd_agent_interval": 0.2,
+            "osd_heartbeat_grace": 2.0,
+        })
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            for pool, kw in (("base", {}), ("hot", {}),
+                             ("plain", {})):
+                r = await rados.mon_command(
+                    "osd pool create", pool=pool, pg_num=4, size=3,
+                    **kw,
+                )
+                assert r["rc"] == 0, r
+            for prefix, kw in (
+                ("osd tier add", {"pool": "base",
+                                  "tierpool": "hot"}),
+                ("osd tier cache-mode", {"pool": "hot",
+                                         "mode": "writeback"}),
+                ("osd tier set-overlay", {"pool": "base",
+                                          "overlaypool": "hot"}),
+            ):
+                r = await rados.mon_command(prefix, **kw)
+                assert r["rc"] == 0, r
+            await cluster.wait_health_ok()
+            await cluster.start_mgr()
+            for pool in ("cephfs_meta", "cephfs_data"):
+                r = await rados.mon_command("osd pool create",
+                                            pool=pool, pg_num=4,
+                                            size=3)
+                assert r["rc"] == 0, r
+            mds = await cluster.start_mds()
+            from ceph_tpu.client.fs import CephFS
+            fs = await CephFS.connect(rados)
+            await fs.mount()
+            await fs.write_file("/pre-failure.txt", b"fs-pre")
+            await asyncio.sleep(0.5)
+            # the autoscaler rightly dislikes 4-PG pools; mute it so
+            # health convergence below reflects the FAILURE story
+            r = await rados.mon_command("health mute",
+                                        code="POOL_TOO_FEW_PGS")
+            assert r["rc"] == 0, r
+
+            base_io = await rados.open_ioctx("base")
+            plain_io = await rados.open_ioctx("plain")
+            model: dict[str, bytes] = {}
+
+            async def write_batch(tag, n=8):
+                for i in range(n):
+                    key = f"{tag}-{i}"
+                    val = f"{tag}:{i}".encode() * 30
+                    model[key] = val
+                    io = base_io if i % 2 else plain_io
+                    await io.write_full(key, val)
+
+            await write_batch("pre")
+            # kill an OSD mid-workload; keep writing through the churn
+            await cluster.kill_osd(3)
+            await write_batch("during")
+            # secure-mode sessions rekey through the failure; tiering
+            # keeps promoting/flushing with 3 OSDs
+            await asyncio.sleep(1.0)
+            await cluster.revive_osd(3)
+            await write_batch("post")
+            await cluster.wait_health_ok(40)
+
+            # the filesystem lived through the churn too
+            await fs.write_file("/post-failure.txt", b"fs-post")
+            assert await fs.read_file("/pre-failure.txt") == b"fs-pre"
+            assert await fs.read_file("/post-failure.txt") == b"fs-post"
+            await fs.unmount()
+
+            # every acknowledged write reads back through the overlay
+            # (same parity expression the write path used)
+            for key, val in model.items():
+                i = int(key.rsplit("-", 1)[1])
+                io = base_io if i % 2 else plain_io
+                assert await io.read(key) == val, key
+
+            # mgr digest converged over the churned map
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                r = await rados.mon_command("pg stat")
+                if r["rc"] == 0 and r["data"]["num_objects"] >= \
+                        len(model):
+                    break
+                assert asyncio.get_running_loop().time() < deadline, r
+                await asyncio.sleep(0.3)
+            # the cluster log recorded the failure story
+            r = await rados.mon_command("log last", num=200)
+            msgs = " ".join(e["message"] for e in r["data"])
+            assert "boot" in msgs
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
