@@ -362,10 +362,92 @@ async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
     return 2
 
 
+async def _rados_bench(io, args) -> dict:
+    """`rados bench` (reference src/common/obj_bencher.cc): timed
+    write or sequential-read workload with concurrency, reporting
+    throughput, IOPS, and latency percentiles."""
+    import time as _time
+
+    import math
+    import secrets as _secrets
+
+    payload = b"\xa5" * args.block_size
+    seconds = args.seconds
+    concurrency = args.concurrency
+    lat: list[float] = []
+    done = 0
+    total_bytes = 0
+    # run-scoped prefix: cleanup must only touch THIS run's objects,
+    # never a prior --no-cleanup run's seq dataset
+    run_prefix = f"bench_{_secrets.token_hex(4)}_"
+    stop_at = _time.monotonic() + seconds
+
+    if args.mode == "seq":
+        names = sorted(o for o in await io.list_objects()
+                       if o.startswith("bench_"))
+        if not names:
+            raise RadosError(-2, "no bench_ objects; run write "
+                                 "with --no-cleanup first")
+
+    async def worker(wid: int):
+        nonlocal done, total_bytes
+        i = 0
+        while _time.monotonic() < stop_at:
+            t0 = _time.monotonic()
+            if args.mode == "write":
+                await io.write_full(f"{run_prefix}{wid}_{i}", payload)
+                nbytes = len(payload)
+            else:
+                nbytes = len(await io.read(
+                    names[(wid + i) % len(names)]
+                ))
+            lat.append(_time.monotonic() - t0)
+            done += 1
+            total_bytes += nbytes
+            i += 1
+
+    t0 = _time.monotonic()
+    await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    elapsed = _time.monotonic() - t0
+    if args.mode == "write" and not args.no_cleanup:
+        for o in await io.list_objects():
+            if o.startswith(run_prefix):
+                await io.remove(o)
+    lat.sort()
+
+    def pct(p: float) -> float:
+        """Nearest-rank percentile (ceil(p*n)-1)."""
+        if not lat:
+            return 0.0
+        return lat[max(0, math.ceil(p * len(lat)) - 1)]
+
+    return {
+        "mode": args.mode,
+        "seconds": round(elapsed, 3),
+        "ops": done,
+        "block_size": args.block_size,
+        "concurrency": concurrency,
+        "iops": round(done / elapsed, 2) if elapsed else 0.0,
+        "MBps": round(total_bytes / elapsed / 2**20, 3)
+        if elapsed else 0.0,
+        "lat_ms": {
+            "avg": round(sum(lat) / len(lat) * 1e3, 3) if lat else 0,
+            "p50": round(pct(0.50) * 1e3, 3),
+            "p95": round(pct(0.95) * 1e3, 3),
+            "p99": round(pct(0.99) * 1e3, 3),
+            "max": round((lat[-1] if lat else 0) * 1e3, 3),
+        },
+    }
+
+
 async def _dispatch_rados(args, rados: Rados, j: bool) -> int:
     try:
         io = await rados.open_ioctx(args.pool)
         a = args.action
+        if a == "bench":
+            report = await _rados_bench(io, args)
+            _print(report, True)
+            return 0
         if a == "put":
             data = (sys.stdin.buffer.read() if args.file == "-"
                     else open(args.file, "rb").read())
@@ -529,6 +611,13 @@ def build_parser() -> argparse.ArgumentParser:
         r.add_argument("obj")
         r.add_argument("file")
     rados_sub.add_parser("ls")
+    bench = rados_sub.add_parser("bench")
+    bench.add_argument("seconds", type=int)
+    bench.add_argument("mode", choices=["write", "seq"])
+    bench.add_argument("-b", "--block-size", type=int,
+                       default=4 << 20)
+    bench.add_argument("-t", "--concurrency", type=int, default=16)
+    bench.add_argument("--no-cleanup", action="store_true")
     rm = rados_sub.add_parser("rm")
     rm.add_argument("obj")
     st = rados_sub.add_parser("stat")

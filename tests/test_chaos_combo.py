@@ -33,11 +33,9 @@ def test_everything_on_under_failures():
         await cluster.start()
         try:
             rados = await cluster.client()
-            for pool, kw in (("base", {}), ("hot", {}),
-                             ("plain", {})):
+            for pool in ("base", "hot", "plain"):
                 r = await rados.mon_command(
                     "osd pool create", pool=pool, pg_num=4, size=3,
-                    **kw,
                 )
                 assert r["rc"] == 0, r
             for prefix, kw in (
@@ -57,7 +55,7 @@ def test_everything_on_under_failures():
                                             pool=pool, pg_num=4,
                                             size=3)
                 assert r["rc"] == 0, r
-            mds = await cluster.start_mds()
+            await cluster.start_mds()
             from ceph_tpu.client.fs import CephFS
             fs = await CephFS.connect(rados)
             await fs.mount()
