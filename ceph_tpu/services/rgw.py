@@ -237,8 +237,31 @@ class RGWLite:
             if e.rc == -2:
                 return 0, 0
             raise
-        sizes = [json.loads(v)["size"] for v in index.values()]
-        return sum(sizes), len(sizes)
+        entries = {k: json.loads(v) for k, v in index.items()}
+        entries = {k: e for k, e in entries.items()
+                   if not e.get("delete_marker")}
+        total = sum(e["size"] for e in entries.values())
+        count = len(entries)
+        # non-current versions hold real bytes too.  Current versions
+        # are keyed by (object key, version id): the id alone is
+        # ambiguous — every adopted pre-versioning object is 'null'
+        current = {(k, e.get("version_id"))
+                   for k, e in entries.items()}
+        try:
+            vomap = await self.ioctx.get_omap(
+                self._versions_oid(bucket))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            vomap = {}
+        for vk, raw in vomap.items():
+            key, _, vid = vk.partition("\x00")
+            v = json.loads(raw)
+            if v.get("delete_marker") or (key, vid) in current:
+                continue
+            total += int(v.get("size", 0))
+            count += 1
+        return total, count
 
     async def set_bucket_quota(self, bucket: str, max_size: int = 0,
                                max_objects: int = 0) -> None:
@@ -286,6 +309,269 @@ class RGWLite:
                 raise RGWError("QuotaExceeded", f"user {owner} size")
             if uq.get("max_objects") and total_objs > uq["max_objects"]:
                 raise RGWError("QuotaExceeded", f"user {owner} objects")
+
+    # -- object versioning (rgw_rados versioned-bucket model) -------------
+    @staticmethod
+    def _versions_oid(bucket: str) -> str:
+        return f"rgw.bucket.versions.{bucket}"
+
+    @staticmethod
+    def _vkey(key: str, version_id: str) -> str:
+        return f"{key}\x00{version_id}"
+
+    async def put_bucket_versioning(self, bucket: str,
+                                    enabled: bool) -> None:
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        meta["versioning"] = "enabled" if enabled else "suspended"
+        await self._put_bucket_meta(bucket, meta)
+
+    async def get_bucket_versioning(self, bucket: str) -> str:
+        meta = await self._check_bucket(bucket, "READ")
+        return meta.get("versioning", "")
+
+    async def _adopt_null_version(self, bucket: str, key: str,
+                                  old: dict) -> None:
+        """A current entry written BEFORE versioning was enabled has no
+        version record; S3 keeps it as the 'null' version — without
+        this, overwriting it would orphan its data forever."""
+        if old.get("version_id") or old.get("delete_marker"):
+            return
+        adopted = dict(old)
+        adopted["version_id"] = "null"
+        adopted.setdefault("data_oid", self._data_oid(bucket, key))
+        await self._record_version(bucket, key, adopted)
+
+    async def _suspended_replaced(self, bucket: str, key: str,
+                                  existing_raw) -> tuple[int, bool]:
+        """(freed_bytes, replaces_a_counted_object) for a suspended-
+        state overwrite: the stored 'null' version is what dies; a
+        non-null current entry survives as history and frees nothing."""
+        try:
+            recs = await self.ioctx.get_omap(
+                self._versions_oid(bucket),
+                [self._vkey(key, "null")])
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            recs = {}
+        if recs:
+            rec = json.loads(next(iter(recs.values())))
+            if rec.get("delete_marker"):
+                return 0, False       # markers hold no counted bytes
+            return int(rec.get("size", 0)), True
+        if existing_raw is not None:
+            old = json.loads(existing_raw)
+            if not old.get("version_id") \
+                    and not old.get("delete_marker"):
+                return int(old.get("size", 0)), True
+        return 0, False
+
+    async def _remove_null_version(self, bucket: str,
+                                   key: str) -> None:
+        """Drop the existing 'null' version record and its data.
+        Suspended-state PUT/DELETE *replace* the null version (S3
+        suspended-bucket semantics) rather than stacking history."""
+        vkey = self._vkey(key, "null")
+        try:
+            recs = await self.ioctx.get_omap(
+                self._versions_oid(bucket), [vkey])
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            return
+        if vkey not in recs:
+            return
+        await self._remove_entry_data(bucket, key,
+                                      json.loads(recs[vkey]))
+        await self.ioctx.rm_omap_keys(self._versions_oid(bucket),
+                                      [vkey])
+
+    async def _remove_entry_data(self, bucket: str, key: str,
+                                 rec: dict) -> None:
+        """Best-effort removal of an entry's data objects (plain,
+        striped, or multipart); tolerant of already-gone objects."""
+        try:
+            if rec.get("multipart"):
+                for part in rec["multipart"]:
+                    try:
+                        await self.ioctx.remove(part["oid"])
+                    except RadosError as e:
+                        if e.rc != -2:
+                            raise
+            elif rec.get("striped"):
+                await self.striper.remove(
+                    rec.get("data_oid", self._data_oid(bucket, key)))
+            elif not rec.get("delete_marker"):
+                await self.ioctx.remove(
+                    rec.get("data_oid", self._data_oid(bucket, key)))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+
+    def _new_version_id(self) -> str:
+        import secrets as _secrets
+
+        # time-ordered prefix so listing versions newest-first is a
+        # reverse lexical sort
+        return f"{int(time.time() * 1e6):016x}{_secrets.token_hex(4)}"
+
+    async def _record_version(self, bucket: str, key: str,
+                              entry: dict) -> None:
+        await self.ioctx.operate(
+            self._versions_oid(bucket),
+            ObjectOperation().create().omap_set({
+                self._vkey(key, entry["version_id"]):
+                json.dumps(entry).encode(),
+            }),
+        )
+
+    async def list_object_versions(self, bucket: str,
+                                   prefix: str = "") -> list[dict]:
+        """Newest-first per key (S3 ListObjectVersions)."""
+        await self._check_bucket(bucket, "READ")
+        meta = await self._bucket_meta(bucket)
+        try:
+            omap = await self.ioctx.get_omap(self._versions_oid(bucket))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            if not meta.get("versioning"):
+                return []
+            omap = {}
+        current = await self.ioctx.get_omap(self._index_oid(bucket))
+        current_entries = {k: json.loads(v)
+                           for k, v in current.items()}
+        current_vid = {k: e.get("version_id")
+                       for k, e in current_entries.items()}
+        out = []
+        have = {tuple(vk.partition("\x00")[::2]) for vk in omap}
+        for k, e in (current_entries.items()
+                     if meta.get("versioning") else ()):
+            # pre-versioning current: implicit, un-recorded 'null'
+            if not k.startswith(prefix) or e.get("version_id") \
+                    or e.get("delete_marker") or (k, "null") in have:
+                continue
+            out.append({
+                "key": k, "version_id": "null",
+                "size": e.get("size", 0), "etag": e.get("etag", ""),
+                "mtime": e.get("mtime", 0.0),
+                "is_latest": True, "delete_marker": False,
+            })
+        for vk, raw in omap.items():
+            key, _, vid = vk.partition("\x00")
+            if not key.startswith(prefix):
+                continue
+            e = json.loads(raw)
+            out.append({
+                "key": key, "version_id": vid,
+                "size": e.get("size", 0), "etag": e.get("etag", ""),
+                "mtime": e.get("mtime", 0.0),
+                "is_latest": current_vid.get(key) == vid,
+                "delete_marker": bool(e.get("delete_marker")),
+            })
+        # newest-first within each key, by write time: the adopted
+        # 'null' version keeps its original (oldest) mtime while a
+        # suspended-state 'null' PUT is genuinely newest — lexical
+        # version-id order would missort 'null' ('n' > any hex digit)
+        out.sort(key=lambda v: (
+            v["mtime"],
+            "" if v["version_id"] == "null" else v["version_id"],
+        ), reverse=True)
+        out.sort(key=lambda v: v["key"])      # stable: keys ascending
+        return out
+
+    async def get_object_version(self, bucket: str, key: str,
+                                 version_id: str) -> dict:
+        """GET ?versionId= — any stored version, marker or not."""
+        await self._check_bucket(bucket, "READ")
+        try:
+            kv = await self.ioctx.get_omap(
+                self._versions_oid(bucket),
+                [self._vkey(key, version_id)],
+            )
+        except RadosError as e:
+            if e.rc == -2:
+                kv = {}
+            else:
+                raise
+        if not kv and version_id == "null":
+            cur = await self.ioctx.get_omap(self._index_oid(bucket),
+                                            [key])
+            if key in cur:
+                e = json.loads(cur[key])
+                if not e.get("version_id") \
+                        and not e.get("delete_marker"):
+                    kv = {key: cur[key]}
+        if not kv:
+            raise RGWError("NoSuchVersion", f"{key}@{version_id}")
+        entry = json.loads(next(iter(kv.values())))
+        if entry.get("delete_marker"):
+            raise RGWError("MethodNotAllowed",
+                           f"{key}@{version_id} is a delete marker")
+        oid = entry.get("data_oid", self._data_oid(bucket, key))
+        if entry.get("multipart"):
+            data = await self._read_manifest(entry["multipart"],
+                                             entry["size"], None)
+        elif entry.get("striped"):
+            data = await self.striper.read(oid)
+        else:
+            data = await self.ioctx.read(oid)
+        return {"data": data, **entry}
+
+    async def delete_object_version(self, bucket: str, key: str,
+                                    version_id: str) -> None:
+        """DELETE ?versionId=: permanently removes that version; when
+        it was current, the next-newest version is promoted (markers
+        included)."""
+        meta = await self._check_bucket(bucket, "WRITE")
+        vkey = self._vkey(key, version_id)
+        try:
+            kv = await self.ioctx.get_omap(self._versions_oid(bucket),
+                                           [vkey])
+        except RadosError as e:
+            if e.rc == -2:
+                kv = {}
+            else:
+                raise
+        if not kv and version_id == "null":
+            cur = await self.ioctx.get_omap(self._index_oid(bucket),
+                                            [key])
+            if key in cur:
+                e = json.loads(cur[key])
+                if not e.get("version_id") \
+                        and not e.get("delete_marker"):
+                    await self._remove_entry_data(bucket, key, e)
+                    await self.ioctx.rm_omap_keys(
+                        self._index_oid(bucket), [key])
+                    await self._log(bucket, "del-version", key)
+                    return
+        if not kv:
+            raise RGWError("NoSuchVersion", f"{key}@{version_id}")
+        entry = json.loads(next(iter(kv.values())))
+        await self._remove_entry_data(bucket, key, entry)
+        await self.ioctx.rm_omap_keys(self._versions_oid(bucket),
+                                      [vkey])
+        # promote the next-newest remaining version when the deleted
+        # one was current
+        current = await self.ioctx.get_omap(self._index_oid(bucket),
+                                            [key])
+        if key in current and json.loads(current[key]).get(
+                "version_id") == version_id:
+            remaining = [
+                v for v in await self.list_object_versions(
+                    bucket, prefix=key)
+                if v["key"] == key
+            ]
+            if remaining:
+                vk = self._vkey(key, remaining[0]["version_id"])
+                raw = (await self.ioctx.get_omap(
+                    self._versions_oid(bucket), [vk]))[vk]
+                await self.ioctx.set_omap(self._index_oid(bucket),
+                                          {key: raw})
+            else:
+                await self.ioctx.rm_omap_keys(self._index_oid(bucket),
+                                              [key])
+            await self._log(bucket, "del-version", key)
 
     # -- multipart upload (rgw_multi.cc: initiate/part/complete/abort) ----
     @staticmethod
@@ -401,12 +687,20 @@ class RGWLite:
         bucket_meta = await self._bucket_meta(bucket)
         existing0 = await self.ioctx.get_omap(self._index_oid(bucket),
                                               [key])
-        await self._check_quota(
-            bucket, bucket_meta, total,
-            replaced_size=(json.loads(existing0[key])["size"]
-                           if key in existing0 else 0),
-            is_replace=key in existing0,
-        )
+        versioned = bucket_meta.get("versioning") == "enabled"
+        suspended = bucket_meta.get("versioning") == "suspended"
+        if versioned:
+            replaced, is_replace = 0, False
+        elif suspended:
+            replaced, is_replace = await self._suspended_replaced(
+                bucket, key, existing0.get(key))
+        else:
+            replaced = (json.loads(existing0[key])["size"]
+                        if key in existing0 else 0)
+            is_replace = key in existing0
+        await self._check_quota(bucket, bucket_meta, total,
+                                replaced_size=replaced,
+                                is_replace=is_replace)
         # the S3 multipart etag form: md5-of-part-md5s + part count
         etag = f"{digest_md5.hexdigest()}-{len(manifest)}"
         # drop uploaded-but-unused parts
@@ -425,13 +719,32 @@ class RGWLite:
         # window — a stale snapshot would leak a racer's data objects
         existing = await self.ioctx.get_omap(self._index_oid(bucket),
                                              [key])
-        if key in existing:
-            await self.delete_object(bucket, key)
         entry = {
             "size": total, "etag": etag, "mtime": time.time(),
             "content_type": info["content_type"], "striped": False,
             "meta": info["meta"], "multipart": manifest,
         }
+        if versioned:
+            # the assembled object is a NEW version; prior current
+            # (incl. pre-versioning 'null') survives as history
+            if key in existing:
+                await self._adopt_null_version(
+                    bucket, key, json.loads(existing[key])
+                )
+            entry["version_id"] = self._new_version_id()
+            await self._record_version(bucket, key, entry)
+        elif suspended:
+            # the assembled object REPLACES the 'null' version (same
+            # rule as a suspended PUT); other versions survive
+            await self._remove_null_version(bucket, key)
+            if key in existing:
+                old = json.loads(existing[key])
+                if not old.get("version_id"):
+                    await self._remove_entry_data(bucket, key, old)
+            entry["version_id"] = "null"
+            await self._record_version(bucket, key, entry)
+        elif key in existing:
+            await self.delete_object(bucket, key)
         await self.ioctx.set_omap(self._index_oid(bucket), {
             key: json.dumps(entry).encode(),
         })
@@ -439,7 +752,10 @@ class RGWLite:
             self._mp_meta_oid(bucket, key, upload_id)
         )
         await self._log(bucket, "put", key, etag)
-        return {"etag": etag, "size": total}
+        out = {"etag": etag, "size": total}
+        if entry.get("version_id") and not suspended:
+            out["version_id"] = entry["version_id"]
+        return out
 
     async def abort_multipart(self, bucket: str, key: str,
                               upload_id: str) -> None:
@@ -582,6 +898,15 @@ class RGWLite:
         index = await self.ioctx.get_omap(self._index_oid(bucket))
         if index:
             raise RGWError("BucketNotEmpty", bucket)
+        try:
+            if await self.ioctx.get_omap(self._versions_oid(bucket)):
+                # ghost history must not leak into a recreated bucket
+                raise RGWError("BucketNotEmpty",
+                               f"{bucket} still has object versions")
+            await self.ioctx.remove(self._versions_oid(bucket))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
         await self.ioctx.remove(self._index_oid(bucket))
         try:
             await self.ioctx.remove(self._log_oid(bucket))
@@ -611,35 +936,46 @@ class RGWLite:
         meta = await self._check_bucket(bucket, "WRITE")
         index_oid = self._index_oid(bucket)
         existing = await self.ioctx.get_omap(index_oid, [key])
-        if if_none_match and existing:
+        if if_none_match and existing and \
+                not json.loads(existing[key]).get("delete_marker"):
             raise RGWError("PreconditionFailed", key)
-        await self._check_quota(
-            bucket, meta, len(data),
-            replaced_size=(json.loads(existing[key])["size"]
-                           if key in existing else 0),
-            is_replace=key in existing,
-        )
+        versioned = meta.get("versioning") == "enabled"
+        suspended = meta.get("versioning") == "suspended"
+        if versioned:
+            replaced, is_replace = 0, False
+        elif suspended:
+            replaced, is_replace = await self._suspended_replaced(
+                bucket, key, existing.get(key))
+        else:
+            replaced = (json.loads(existing[key])["size"]
+                        if key in existing else 0)
+            is_replace = key in existing
+        await self._check_quota(bucket, meta, len(data),
+                                replaced_size=replaced,
+                                is_replace=is_replace)
         etag = hashlib.md5(data).hexdigest()
         oid = self._data_oid(bucket, key)
-        if key in existing:
+        if versioned:
+            # every PUT is a NEW version: prior data objects survive
+            # under their own version ids (rgw versioned-bucket model)
+            version_id = self._new_version_id()
+            oid = f"{oid}\x00v\x00{version_id}"
+            if key in existing:
+                await self._adopt_null_version(
+                    bucket, key, json.loads(existing[key])
+                )
+        elif key in existing:
             # drop the old data objects first: a smaller striped body
             # must not inherit the old size xattr / stale tail stripes
             old = json.loads(existing[key])
-            try:
-                if old.get("multipart"):
-                    for part in old["multipart"]:
-                        try:
-                            await self.ioctx.remove(part["oid"])
-                        except RadosError as e:
-                            if e.rc != -2:
-                                raise
-                elif old.get("striped"):
-                    await self.striper.remove(oid)
-                else:
-                    await self.ioctx.remove(oid)
-            except RadosError as e:
-                if e.rc != -2:
-                    raise
+            if suspended:
+                # a suspended-state PUT REPLACES the 'null' version;
+                # every other version's data stays retrievable
+                await self._remove_null_version(bucket, key)
+            # data owned by a (non-null) version record stays
+            # retrievable through the version API — never clean it
+            if not old.get("version_id"):
+                await self._remove_entry_data(bucket, key, old)
         striped = len(data) > STRIPE_THRESHOLD
         if striped:
             await self.striper.write(oid, data)
@@ -650,12 +986,22 @@ class RGWLite:
             "size": len(data), "etag": etag, "mtime": time.time(),
             "content_type": content_type, "striped": striped,
             "meta": dict(metadata or {}),
+            "data_oid": oid,
         }
+        if versioned:
+            entry["version_id"] = version_id
+            await self._record_version(bucket, key, entry)
+        elif suspended:
+            entry["version_id"] = "null"
+            await self._record_version(bucket, key, entry)
         await self.ioctx.set_omap(index_oid, {
             key: json.dumps(entry).encode(),
         })
         await self._log(bucket, "put", key, etag)
-        return {"etag": etag, "size": len(data)}
+        out = {"etag": etag, "size": len(data)}
+        if versioned:
+            out["version_id"] = version_id
+        return out
 
     async def _entry(self, bucket: str, key: str,
                      need: str = "READ") -> dict:
@@ -663,13 +1009,16 @@ class RGWLite:
         kv = await self.ioctx.get_omap(self._index_oid(bucket), [key])
         if key not in kv:
             raise RGWError("NoSuchKey", f"{bucket}/{key}")
-        return json.loads(kv[key])
+        entry = json.loads(kv[key])
+        if entry.get("delete_marker"):
+            raise RGWError("NoSuchKey", f"{bucket}/{key}")
+        return entry
 
     async def get_object(self, bucket: str, key: str,
                          range_: tuple[int, int] | None = None) -> dict:
         """S3 GET (optionally a byte range, inclusive bounds)."""
         entry = await self._entry(bucket, key)
-        oid = self._data_oid(bucket, key)
+        oid = entry.get("data_oid", self._data_oid(bucket, key))
         if entry.get("multipart"):
             data = await self._read_manifest(entry["multipart"],
                                              entry["size"], range_)
@@ -715,20 +1064,53 @@ class RGWLite:
         return await self._entry(bucket, key)
 
     async def delete_object(self, bucket: str, key: str) -> None:
-        entry = await self._entry(bucket, key, need="WRITE")
-        oid = self._data_oid(bucket, key)
-        if entry.get("multipart"):
-            for part in entry["multipart"]:
-                try:
-                    await self.ioctx.remove(part["oid"])
-                except RadosError as e:
-                    if e.rc != -2:
-                        raise
-        elif entry["striped"]:
-            await self.striper.remove(oid)
-        else:
-            await self.ioctx.remove(oid)
-        await self.ioctx.rm_omap_keys(self._index_oid(bucket), [key])
+        meta = await self._check_bucket(bucket, "WRITE")
+        state = meta.get("versioning", "")
+        index_oid = self._index_oid(bucket)
+        kv = await self.ioctx.get_omap(index_oid, [key])
+        entry = json.loads(kv[key]) if key in kv else None
+        if state == "enabled":
+            # versioned DELETE always succeeds: data survives and a
+            # delete MARKER becomes current — stacking on prior
+            # markers and absent keys alike (S3 semantics)
+            if entry is not None and not entry.get("delete_marker"):
+                await self._adopt_null_version(bucket, key, entry)
+            version_id = self._new_version_id()
+            marker = {
+                "size": 0, "etag": "", "mtime": time.time(),
+                "delete_marker": True, "version_id": version_id,
+                "striped": False, "meta": {},
+            }
+            await self._record_version(bucket, key, marker)
+            await self.ioctx.set_omap(index_oid, {
+                key: json.dumps(marker).encode(),
+            })
+            await self._log(bucket, "del", key)
+            return
+        if state == "suspended":
+            # suspended DELETE replaces the 'null' version with a null
+            # delete marker; versioned history is untouched.  A
+            # pre-versioning current entry IS the implicit null
+            # version — its data dies with it, or it leaks forever
+            await self._remove_null_version(bucket, key)
+            if entry is not None and not entry.get("version_id") \
+                    and not entry.get("delete_marker"):
+                await self._remove_entry_data(bucket, key, entry)
+            marker = {
+                "size": 0, "etag": "", "mtime": time.time(),
+                "delete_marker": True, "version_id": "null",
+                "striped": False, "meta": {},
+            }
+            await self._record_version(bucket, key, marker)
+            await self.ioctx.set_omap(index_oid, {
+                key: json.dumps(marker).encode(),
+            })
+            await self._log(bucket, "del", key)
+            return
+        if entry is None or entry.get("delete_marker"):
+            raise RGWError("NoSuchKey", f"{bucket}/{key}")
+        await self._remove_entry_data(bucket, key, entry)
+        await self.ioctx.rm_omap_keys(index_oid, [key])
         await self._log(bucket, "del", key)
 
     async def copy_object(self, src_bucket: str, src_key: str,
@@ -745,19 +1127,24 @@ class RGWLite:
         """S3 ListObjects: sorted, prefix-filtered, marker-paginated."""
         await self._check_bucket(bucket, "READ")
         index = await self.ioctx.get_omap(self._index_oid(bucket))
-        keys = sorted(
-            k for k in index
-            if k.startswith(prefix) and k > marker
-        )
-        truncated = len(keys) > max_keys
-        keys = keys[:max_keys]
         contents = []
-        for k in keys:
+        truncated = False
+        # lazy parse: stop after filling the page + 1 (truncation
+        # probe) instead of json-decoding the whole bucket per listing
+        for k in sorted(index):
+            if not k.startswith(prefix) or k <= marker:
+                continue
             entry = json.loads(index[k])
+            if entry.get("delete_marker"):
+                continue
+            if len(contents) == max_keys:
+                truncated = True
+                break
             contents.append({
                 "key": k, "size": entry["size"], "etag": entry["etag"],
                 "mtime": entry["mtime"],
             })
+        keys = [c["key"] for c in contents]
         return {
             "contents": contents,
             "is_truncated": truncated,

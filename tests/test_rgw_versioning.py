@@ -1,0 +1,361 @@
+"""RGW object versioning: versioned buckets, delete markers, version
+listing/get/delete (S3 ListObjectVersions / GET?versionId semantics
+over the rgw versioned-bucket model)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rgw import RGWError, RGWLite
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def test_versioned_bucket_lifecycle():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            gw = RGWLite(await rados.open_ioctx("rgw"))
+            await gw.create_bucket("vb")
+            assert await gw.get_bucket_versioning("vb") == ""
+            await gw.put_bucket_versioning("vb", True)
+            assert await gw.get_bucket_versioning("vb") == "enabled"
+
+            r1 = await gw.put_object("vb", "doc", b"v1-content")
+            r2 = await gw.put_object("vb", "doc", b"v2-content")
+            assert r1["version_id"] != r2["version_id"]
+
+            # current GET serves the newest; old versions retrievable
+            assert (await gw.get_object("vb", "doc"))["data"] == \
+                b"v2-content"
+            got = await gw.get_object_version("vb", "doc",
+                                              r1["version_id"])
+            assert got["data"] == b"v1-content"
+
+            versions = await gw.list_object_versions("vb")
+            assert [v["version_id"] for v in versions] == \
+                [r2["version_id"], r1["version_id"]]
+            assert versions[0]["is_latest"] is True
+            assert versions[1]["is_latest"] is False
+
+            # DELETE inserts a marker: key vanishes from listings but
+            # every version (and the data) survives
+            await gw.delete_object("vb", "doc")
+            with pytest.raises(RGWError):
+                await gw.get_object("vb", "doc")
+            assert (await gw.list_objects("vb"))["contents"] == []
+            versions = await gw.list_object_versions("vb")
+            assert len(versions) == 3
+            assert versions[0]["delete_marker"] is True
+            got = await gw.get_object_version("vb", "doc",
+                                              r2["version_id"])
+            assert got["data"] == b"v2-content"
+
+            # deleting the MARKER's version restores the object
+            await gw.delete_object_version(
+                "vb", "doc", versions[0]["version_id"]
+            )
+            assert (await gw.get_object("vb", "doc"))["data"] == \
+                b"v2-content"
+            assert len(await gw.list_object_versions("vb")) == 2
+
+            # permanently deleting the current version promotes v1
+            await gw.delete_object_version("vb", "doc",
+                                           r2["version_id"])
+            assert (await gw.get_object("vb", "doc"))["data"] == \
+                b"v1-content"
+            with pytest.raises(RGWError):
+                await gw.get_object_version("vb", "doc",
+                                            r2["version_id"])
+            # ... and deleting the last version empties the key
+            await gw.delete_object_version("vb", "doc",
+                                           r1["version_id"])
+            with pytest.raises(RGWError):
+                await gw.get_object("vb", "doc")
+            assert await gw.list_object_versions("vb") == []
+
+            # unversioned buckets keep the old overwrite semantics
+            await gw.create_bucket("plain")
+            r = await gw.put_object("plain", "x", b"a")
+            assert "version_id" not in r
+            await gw.put_object("plain", "x", b"b")
+            assert await gw.list_object_versions("plain") == []
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_versioning_with_prefix_and_multiple_keys():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            gw = RGWLite(await rados.open_ioctx("rgw"))
+            await gw.create_bucket("vb")
+            await gw.put_bucket_versioning("vb", True)
+            for key in ("logs/a", "logs/b", "data/c"):
+                await gw.put_object("vb", key, b"1")
+                await gw.put_object("vb", key, b"2")
+            logs = await gw.list_object_versions("vb", prefix="logs/")
+            assert {v["key"] for v in logs} == {"logs/a", "logs/b"}
+            assert len(logs) == 4
+            assert sum(v["is_latest"] for v in logs) == 2
+            # listing current objects is unchanged
+            listing = await gw.list_objects("vb")
+            assert [c["key"] for c in listing["contents"]] == \
+                ["data/c", "logs/a", "logs/b"]
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+def test_versioning_interactions_with_legacy_paths():
+    """Versioning meeting the OLDER subsystems: pre-versioning objects
+    ('null' version adoption), suspension, multipart, quota, and bucket
+    deletion — the seams S3 pins down precisely."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            gw = RGWLite(await rados.open_ioctx("rgw"))
+
+            # -- pre-versioning object survives as the 'null' version
+            await gw.create_bucket("vb")
+            await gw.put_object("vb", "old", b"pre-versioning")
+            await gw.put_bucket_versioning("vb", True)
+            r2 = await gw.put_object("vb", "old", b"second")
+            versions = await gw.list_object_versions("vb")
+            assert {v["version_id"] for v in versions} == \
+                {"null", r2["version_id"]}
+            got = await gw.get_object_version("vb", "old", "null")
+            assert got["data"] == b"pre-versioning"
+
+            # ... and a versioned DELETE of a pre-versioning current
+            # also preserves it as 'null'
+            await gw.create_bucket("vb2")
+            await gw.put_object("vb2", "k", b"legacy")
+            await gw.put_bucket_versioning("vb2", True)
+            await gw.delete_object("vb2", "k")
+            vs = await gw.list_object_versions("vb2")
+            assert any(v.get("delete_marker") for v in vs)
+            assert (await gw.get_object_version("vb2", "k", "null")
+                    )["data"] == b"legacy"
+
+            # -- suspension: a PUT becomes the new 'null' version and
+            # must NOT destroy other versions' data (S3 suspended rule)
+            await gw.put_bucket_versioning("vb", False)
+            assert await gw.get_bucket_versioning("vb") == "suspended"
+            await gw.put_object("vb", "old", b"suspended-write")
+            assert (await gw.get_object("vb", "old"))["data"] == \
+                b"suspended-write"
+            assert (await gw.get_object_version(
+                "vb", "old", r2["version_id"]))["data"] == b"second"
+            # ...and it REPLACED the pre-versioning null version
+            assert (await gw.get_object_version("vb", "old", "null")
+                    )["data"] == b"suspended-write"
+            # suspended DELETE: null delete marker, history untouched
+            await gw.delete_object("vb", "old")
+            with pytest.raises(RGWError):
+                await gw.get_object("vb", "old")
+            assert (await gw.get_object_version(
+                "vb", "old", r2["version_id"]))["data"] == b"second"
+            vs = [v for v in await gw.list_object_versions("vb")
+                  if v["version_id"] == "null"]
+            assert len(vs) == 1 and vs[0]["delete_marker"] is True
+
+            # -- multipart completion in a versioned bucket
+            await gw.create_bucket("mp")
+            await gw.put_bucket_versioning("mp", True)
+            first = await gw.put_object("mp", "big", b"small-one")
+            up = await gw.initiate_multipart("mp", "big")
+            part_data = b"P" * (5 * 1024)
+            e1 = await gw.upload_part("mp", "big", up, 1, part_data)
+            e2 = await gw.upload_part("mp", "big", up, 2, part_data)
+            done = await gw.complete_multipart(
+                "mp", "big", up, [(1, e1["etag"]), (2, e2["etag"])]
+            )
+            assert done.get("version_id")
+            assert (await gw.get_object("mp", "big"))["data"] == \
+                part_data * 2
+            # the small first version survived the multipart replace
+            assert (await gw.get_object_version(
+                "mp", "big", first["version_id"]))["data"] == \
+                b"small-one"
+
+            # -- quota counts non-current versions
+            await gw.create_bucket("q")
+            await gw.put_bucket_versioning("q", True)
+            await gw.set_bucket_quota("q", max_size=100)
+            await gw.put_object("q", "k", b"x" * 60)
+            with pytest.raises(RGWError) as ei:
+                await gw.put_object("q", "k", b"y" * 60)
+            assert "QuotaExceeded" in str(ei.value)
+
+            # -- delete_bucket refuses while versions remain
+            await gw.delete_object_version("vb2", "k", "null")
+            vs = await gw.list_object_versions("vb2")
+            assert len(vs) == 1 and vs[0]["delete_marker"]
+            # marker is the current index entry too: remove it
+            await gw.delete_object_version(
+                "vb2", "k", vs[0]["version_id"]
+            )
+            await gw.delete_bucket("vb2")     # now empty: succeeds
+            with pytest.raises(RGWError):
+                await gw.list_objects("vb2")
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+def test_versioning_null_ordering_and_multipart_versions():
+    """Review regressions: 'null' must sort as its WRITE TIME (not
+    lexically newest), promotion must restore the true next-newest,
+    and multipart-manifest versions must be readable/deletable."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            gw = RGWLite(await rados.open_ioctx("rgw"))
+
+            # adopted null is the OLDEST: deleting the current version
+            # promotes the middle one, never content A
+            await gw.create_bucket("ord")
+            await gw.put_object("ord", "k", b"A-oldest")
+            await gw.put_bucket_versioning("ord", True)
+            rb = await gw.put_object("ord", "k", b"B-middle")
+            rc = await gw.put_object("ord", "k", b"C-newest")
+            vs = await gw.list_object_versions("ord")
+            assert [v["version_id"] for v in vs] == \
+                [rc["version_id"], rb["version_id"], "null"]
+            await gw.delete_object_version("ord", "k",
+                                           rc["version_id"])
+            assert (await gw.get_object("ord", "k"))["data"] == \
+                b"B-middle"
+
+            # a suspended-state null PUT is genuinely the newest
+            await gw.put_bucket_versioning("ord", False)
+            await gw.put_object("ord", "k", b"D-suspended")
+            vs = await gw.list_object_versions("ord")
+            assert vs[0]["version_id"] == "null"
+            assert vs[0]["is_latest"] is True
+
+            # multipart versions: GET ?versionId reads the manifest;
+            # version delete walks it (and promotes correctly)
+            await gw.create_bucket("mpv")
+            await gw.put_bucket_versioning("mpv", True)
+            plain = await gw.put_object("mpv", "obj", b"plain-v1")
+            up = await gw.initiate_multipart("mpv", "obj")
+            pd = b"Q" * 4096
+            p1 = await gw.upload_part("mpv", "obj", up, 1, pd)
+            p2 = await gw.upload_part("mpv", "obj", up, 2, pd)
+            done = await gw.complete_multipart(
+                "mpv", "obj", up,
+                [(1, p1["etag"]), (2, p2["etag"])],
+            )
+            got = await gw.get_object_version("mpv", "obj",
+                                              done["version_id"])
+            assert got["data"] == pd * 2
+            await gw.delete_object_version("mpv", "obj",
+                                           done["version_id"])
+            assert (await gw.get_object("mpv", "obj"))["data"] == \
+                b"plain-v1"
+            with pytest.raises(RGWError):
+                await gw.get_object_version("mpv", "obj",
+                                            done["version_id"])
+
+            # suspended overwrite quota: only the dying null version
+            # is credited, not the surviving versioned current
+            await gw.create_bucket("sq")
+            await gw.put_bucket_versioning("sq", True)
+            await gw.put_object("sq", "k", b"h" * 80)   # history
+            await gw.put_bucket_versioning("sq", False)
+            await gw.set_bucket_quota("sq", max_size=100)
+            with pytest.raises(RGWError) as ei:
+                # 80 history + 60 new = 140 > 100 even though the
+                # "replaced" current entry is 80 bytes
+                await gw.put_object("sq", "k", b"n" * 60)
+            assert "QuotaExceeded" in str(ei.value)
+            await gw.put_object("sq", "k", b"n" * 15)   # 95: fits
+            # replacing the null version frees ITS bytes
+            await gw.put_object("sq", "k", b"m" * 18)   # 98: fits
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+def test_versioning_marker_stacking_and_implicit_null():
+    """Review regressions: repeated versioned DELETEs stack markers,
+    suspended DELETE frees pre-versioning data, the implicit 'null'
+    version is visible before any overwrite, and If-None-Match treats
+    a marker-latest key as absent."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            gw = RGWLite(await rados.open_ioctx("rgw"))
+
+            # implicit null: visible to the whole version API without
+            # waiting for an overwrite to adopt it
+            await gw.create_bucket("vb")
+            await gw.put_object("vb", "old", b"legacy-data")
+            await gw.put_bucket_versioning("vb", True)
+            vs = await gw.list_object_versions("vb")
+            assert [(v["version_id"], v["is_latest"]) for v in vs] == \
+                [("null", True)]
+            assert (await gw.get_object_version("vb", "old", "null")
+                    )["data"] == b"legacy-data"
+
+            # stacking markers: S3 DELETE succeeds repeatedly
+            await gw.delete_object("vb", "old")
+            await gw.delete_object("vb", "old")
+            markers = [v for v in await gw.list_object_versions("vb")
+                       if v["delete_marker"]]
+            assert len(markers) == 2
+            # ...and even on a key that never existed
+            await gw.delete_object("vb", "ghost")
+            ghost = [v for v in await gw.list_object_versions("vb")
+                     if v["key"] == "ghost"]
+            assert len(ghost) == 1 and ghost[0]["delete_marker"]
+
+            # If-None-Match: marker-latest key counts as absent,
+            # so the conditional PUT succeeds...
+            r = await gw.put_object("vb", "old", b"reborn",
+                                    if_none_match=True)
+            assert r.get("version_id")
+            # ...and fails once a real object is latest again
+            with pytest.raises(RGWError):
+                await gw.put_object("vb", "old", b"x",
+                                    if_none_match=True)
+
+            # implicit-null delete removes entry + data
+            await gw.create_bucket("n2")
+            await gw.put_object("n2", "k", b"bye")
+            await gw.put_bucket_versioning("n2", True)
+            await gw.delete_object_version("n2", "k", "null")
+            with pytest.raises(RGWError):
+                await gw.get_object("n2", "k")
+            assert await gw.list_object_versions("n2") == []
+
+            # suspended DELETE of a pre-versioning object frees its
+            # bytes (quota-visible) and leaves only the null marker
+            await gw.create_bucket("sd")
+            await gw.put_object("sd", "k", b"d" * 80)
+            await gw.put_bucket_versioning("sd", True)
+            await gw.put_bucket_versioning("sd", False)
+            await gw.set_bucket_quota("sd", max_size=100)
+            await gw.delete_object("sd", "k")
+            # 80 bytes freed: a fresh 90-byte write fits under 100
+            await gw.put_object("sd", "k2", b"e" * 90)
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
