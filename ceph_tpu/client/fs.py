@@ -125,6 +125,7 @@ class FileHandle:
                                    name=self.name, size=self.size,
                                    mtime=time.time())
             self._dirty = False
+            self.fs._invalidate_ino(self.ino)
             self.fs._invalidate(self.parent, self.name)
 
     async def close(self) -> None:
@@ -229,6 +230,14 @@ class CephFS:
     # -- path walking ------------------------------------------------------
     def _invalidate(self, parent: int, name: str) -> None:
         self._dcache.pop((parent, name), None)
+
+    def _invalidate_ino(self, ino: int) -> None:
+        """Drop every cached dentry of this inode: hard links give one
+        inode several (parent, name) cache slots, and an attr flush
+        through one name must not leave the others serving stale size."""
+        for key in [k for k, v in self._dcache.items()
+                    if int(v[0].get("ino", 0)) == ino]:
+            self._dcache.pop(key, None)
 
     async def _lookup(self, parent: int, name: str) -> dict:
         cached = self._dcache.get((parent, name))
@@ -454,9 +463,27 @@ class CephFS:
                 raise
         return cur_path, parent, name, dentry
 
+    async def link(self, src: str, dst: str) -> None:
+        """ceph_link: hard link — ``dst`` becomes another name for
+        ``src``'s inode (symlinks in ``src`` are followed)."""
+        sparent, sname = await self._resolve_parent(src)
+        sdentry = await self._lookup(sparent, sname)
+        if sdentry["type"] == "symlink":
+            _, sparent, sname, sdentry = await self._follow_link_path(
+                src, sdentry)
+            if sdentry is None:
+                raise FSError(ENOENT, src)
+        dparent, dname = await self._resolve_parent(dst)
+        await self._request("link", src_parent=sparent, src_name=sname,
+                            parent=dparent, name=dname)
+        self._invalidate_ino(int(sdentry["ino"]))
+        self._invalidate(sparent, sname)
+        self._invalidate(dparent, dname)
+
     async def unlink(self, path: str) -> None:
         parent, name = await self._resolve_parent(path)
-        await self._request("unlink", parent=parent, name=name)
+        reply = await self._request("unlink", parent=parent, name=name)
+        self._invalidate_ino(int(reply.get("ino", 0)))
         self._invalidate(parent, name)
 
     async def rename(self, src: str, dst: str) -> None:

@@ -43,6 +43,7 @@ log = Dout("mds")
 ROOT_INO = 1
 JOURNAL_OID = "mds_journal"
 TABLE_OID = "mds_inotable"
+ANCHOR_OID = "mds_anchortab"
 _FRAME = struct.Struct("<I")
 
 # errno-style codes shared with the client
@@ -53,6 +54,7 @@ EISDIR = -21
 ENOTEMPTY = -39
 ELOOP = -40
 EINVAL = -22
+EPERM = -1
 
 
 def dirfrag_oid(ino: int) -> str:
@@ -336,9 +338,42 @@ class MDSDaemon:
                 except RadosError as err:
                     if err.rc != ENOENT:
                         raise
+            if int(e.get("anchor_ino", 0)):
+                await self._anchor_put(int(e["anchor_ino"]),
+                                       e.get("anchor"))
         elif op == "setattr":
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dict(e["dentry"]))
+        elif op == "link":
+            await self._set_dentry(int(e["parent"]), str(e["name"]),
+                                   dict(e["remote_dentry"]))
+            await self._set_dentry(int(e["pp"]), str(e["pn"]),
+                                   dict(e["primary_dentry"]))
+            await self._anchor_put(int(e["ino"]), dict(e["anchor"]))
+        elif op == "unlink_remote":
+            try:
+                await self.meta.operate(
+                    dirfrag_oid(int(e["parent"])),
+                    ObjectOperation().omap_rm([str(e["name"])]),
+                )
+            except RadosError as err:
+                if err.rc != ENOENT:
+                    raise
+            await self._set_dentry(int(e["pp"]), str(e["pn"]),
+                                   dict(e["primary_dentry"]))
+            await self._anchor_put(int(e["ino"]), e.get("anchor"))
+        elif op == "promote_link":
+            try:
+                await self.meta.operate(
+                    dirfrag_oid(int(e["parent"])),
+                    ObjectOperation().omap_rm([str(e["name"])]),
+                )
+            except RadosError as err:
+                if err.rc != ENOENT:
+                    raise
+            await self._set_dentry(int(e["np"]), str(e["nn"]),
+                                   dict(e["primary_dentry"]))
+            await self._anchor_put(int(e["ino"]), e.get("anchor"))
 
     async def _purge_file(self, ino: int, size: int) -> None:
         """Delete a file's data objects (the PurgeQueue role, inline)."""
@@ -351,6 +386,85 @@ class MDSDaemon:
             except RadosError as e:
                 if e.rc != ENOENT:
                     raise
+
+    # -- hard links (remote dentries + the reference's anchortable) -------
+    # The inode stays EMBEDDED in one primary dentry; other names are
+    # remote dentries {"remote": True, "ino": N}.  While nlink > 1 the
+    # anchortable omap maps ino -> {"primary": [p, n], "remotes":
+    # [[p, n], ...]} so remotes resolve and unlink can promote
+    # (reference src/mds/AnchorTable-era design, kept as server state).
+    async def _anchor_get(self, ino: int) -> dict | None:
+        try:
+            kv = await self.meta.get_omap(ANCHOR_OID, [str(ino)])
+        except RadosError as e:
+            if e.rc == ENOENT:
+                return None
+            raise
+        return decode(kv[str(ino)]) if str(ino) in kv else None
+
+    async def _anchor_put(self, ino: int, rec: dict | None) -> None:
+        if rec is None:
+            try:
+                await self.meta.operate(
+                    ANCHOR_OID, ObjectOperation().omap_rm([str(ino)]))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+        else:
+            await self.meta.operate(
+                ANCHOR_OID, ObjectOperation().create()
+                .omap_set({str(ino): encode(rec)}))
+
+    async def _primary_of(self, ino: int,
+                          rec: dict | None = None
+                          ) -> tuple[int, str, dict]:
+        if rec is None:
+            rec = await self._anchor_get(ino)
+        if rec is None:
+            raise MDSError(ENOENT, f"no anchor for {ino:x}")
+        pp, pn = int(rec["primary"][0]), str(rec["primary"][1])
+        return pp, pn, await self._get_dentry(pp, pn)
+
+    async def _resolve_remote(self, dentry: dict) -> dict:
+        """A remote dentry's visible attrs are the primary's inode."""
+        if not dentry.get("remote"):
+            return dentry
+        _, _, primary = await self._primary_of(int(dentry["ino"]))
+        return {**primary, "remote": True}
+
+    async def _unlink_plan(self, parent: int, name: str,
+                           dentry: dict) -> dict:
+        """The journal entry that removes one name of a file, hardlink-
+        aware: remotes decrement, a linked primary promotes a remote to
+        carry the inode, and only the LAST name purges data."""
+        ino = int(dentry["ino"])
+        if dentry.get("remote"):
+            rec = await self._anchor_get(ino)
+            pp, pn, primary = await self._primary_of(ino, rec)
+            primary = dict(primary)
+            nl = int(primary.get("nlink", 1)) - 1
+            primary["nlink"] = nl
+            remotes = [r for r in rec["remotes"]
+                       if [int(r[0]), str(r[1])] != [parent, name]]
+            new_rec = (None if nl <= 1 else
+                       {"primary": [pp, pn], "remotes": remotes})
+            return {"op": "unlink_remote", "parent": parent,
+                    "name": name, "ino": ino, "pp": pp, "pn": pn,
+                    "primary_dentry": primary, "anchor": new_rec}
+        nl = int(dentry.get("nlink", 1))
+        if nl > 1:
+            rec = await self._anchor_get(ino)
+            np, nn = int(rec["remotes"][0][0]), str(rec["remotes"][0][1])
+            promoted = dict(dentry)
+            promoted["nlink"] = nl - 1
+            new_rec = (None if nl - 1 <= 1 else
+                       {"primary": [np, nn],
+                        "remotes": rec["remotes"][1:]})
+            return {"op": "promote_link", "parent": parent,
+                    "name": name, "ino": ino, "np": np, "nn": nn,
+                    "primary_dentry": promoted, "anchor": new_rec}
+        return {"op": "unlink", "parent": parent, "name": name,
+                "ino": ino, "size": int(dentry.get("size", 0))}
 
     # -- request handling (Server.cc handle_client_request) ---------------
     def ms_handle_connect(self, conn: Connection) -> None:
@@ -428,6 +542,7 @@ class MDSDaemon:
 
     async def _req_lookup(self, d: dict) -> dict:
         dentry = await self._get_dentry(int(d["parent"]), str(d["name"]))
+        dentry = await self._resolve_remote(dentry)
         return {"dentry": dentry, "lease": self.lease_ttl}
 
     async def _req_readdir(self, d: dict) -> dict:
@@ -437,10 +552,14 @@ class MDSDaemon:
         except RadosError as e:
             raise MDSError(ENOENT, f"no dir {ino:x}") \
                 if e.rc == ENOENT else e
-        return {
-            "entries": {name: decode(raw) for name, raw in kv.items()},
-            "lease": self.lease_ttl,
-        }
+        entries = {name: decode(raw) for name, raw in kv.items()}
+        for name, de in entries.items():
+            if de.get("remote"):
+                try:
+                    entries[name] = await self._resolve_remote(de)
+                except MDSError:
+                    pass        # racing unlink: show the raw entry
+        return {"entries": entries, "lease": self.lease_ttl}
 
     async def _alloc_ino(self) -> int:
         ino = self.next_ino
@@ -482,7 +601,7 @@ class MDSDaemon:
                 # re-resolves and retries at the target (a race with a
                 # concurrent symlink creation lands here).
                 raise MDSError(ELOOP, f"{name!r} is a symlink")
-            return {"dentry": existing}
+            return {"dentry": await self._resolve_remote(existing)}
         except MDSError as e:
             if not e.missing_dentry:
                 raise
@@ -513,17 +632,43 @@ class MDSDaemon:
         await self._apply(entry)
         return {"dentry": dentry}
 
+    async def _req_link(self, d: dict) -> dict:
+        """Hard link (Server::handle_client_link): a REMOTE dentry at
+        (parent, name) referencing the primary's inode."""
+        sp, sn = int(d["src_parent"]), str(d["src_name"])
+        dp, dn = int(d["parent"]), str(d["name"])
+        dentry = await self._get_dentry(sp, sn)
+        if dentry.get("remote"):
+            # keep link chains flat: always link to the primary
+            sp, sn, dentry = await self._primary_of(int(dentry["ino"]))
+        if dentry["type"] != "file":
+            raise MDSError(EPERM, "hard links are file-only")
+        await self._ensure_absent(dp, dn)
+        ino = int(dentry["ino"])
+        primary = dict(dentry)
+        primary["nlink"] = int(dentry.get("nlink", 1)) + 1
+        rec = await self._anchor_get(ino) or \
+            {"primary": [sp, sn], "remotes": []}
+        anchor = {"primary": rec["primary"],
+                  "remotes": list(rec["remotes"]) + [[dp, dn]]}
+        entry = {"op": "link", "parent": dp, "name": dn, "ino": ino,
+                 "remote_dentry": {"type": "file", "remote": True,
+                                   "ino": ino},
+                 "pp": sp, "pn": sn, "primary_dentry": primary,
+                 "anchor": anchor}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"dentry": {**primary, "remote": True}}
+
     async def _req_unlink(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
         dentry = await self._get_dentry(parent, name)
         if dentry["type"] == "dir":
             raise MDSError(EISDIR, name)
-        entry = {"op": "unlink", "parent": parent, "name": name,
-                 "ino": int(dentry["ino"]),
-                 "size": int(dentry.get("size", 0))}
+        entry = await self._unlink_plan(parent, name, dentry)
         await self._journal(entry)
         await self._apply(entry)
-        return {}
+        return {"ino": int(dentry["ino"])}
 
     async def _req_rmdir(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
@@ -582,17 +727,47 @@ class MDSDaemon:
                     purge_dir_ino = int(dst["ino"])   # replaced empty dir
             elif dentry["type"] == "dir":
                 raise MDSError(ENOTDIR, dn)
-            elif int(dst["ino"]) != int(dentry["ino"]):
-                purge_ino = int(dst["ino"])      # overwritten file
-                purge_size = int(dst.get("size", 0))
+            elif int(dst["ino"]) == int(dentry["ino"]):
+                # POSIX: renaming between two hard links of the same
+                # file does NOTHING (both names stay)
+                return {"dentry": dentry}
+            else:
+                if dst.get("remote") or int(dst.get("nlink", 1)) > 1:
+                    # replacing one name of a hardlinked file: run the
+                    # link-aware unlink first — its data must survive
+                    # under the other names
+                    pre = await self._unlink_plan(dp, dn, dst)
+                    await self._journal(pre)
+                    await self._apply(pre)
+                else:
+                    purge_ino = int(dst["ino"])   # overwritten file
+                    purge_size = int(dst.get("size", 0))
         except MDSError as e:
             if not e.missing_dentry:
                 raise
+        anchor_ino, anchor = 0, None
+        if dentry.get("remote") or int(dentry.get("nlink", 1)) > 1:
+            # the moved name is one of a hardlinked file's names: its
+            # anchortable pointer must follow the rename
+            anchor_ino = int(dentry["ino"])
+            rec = await self._anchor_get(anchor_ino)
+            if rec is not None:
+                if dentry.get("remote"):
+                    anchor = {"primary": rec["primary"], "remotes": [
+                        ([dp, dn] if [int(r[0]), str(r[1])] == [sp, sn]
+                         else r) for r in rec["remotes"]
+                    ]}
+                else:
+                    anchor = {"primary": [dp, dn],
+                              "remotes": rec["remotes"]}
+            else:
+                anchor_ino = 0
         entry = {"op": "rename", "src_parent": sp, "src_name": sn,
                  "dst_parent": dp, "dst_name": dn, "dentry": dentry,
                  "ino": int(dentry["ino"]),
                  "purge_ino": purge_ino, "purge_size": purge_size,
-                 "purge_dir_ino": purge_dir_ino}
+                 "purge_dir_ino": purge_dir_ino,
+                 "anchor_ino": anchor_ino, "anchor": anchor}
         await self._journal(entry)
         await self._apply(entry)
         return {"dentry": dentry}
@@ -600,6 +775,9 @@ class MDSDaemon:
     async def _req_setattr(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
         dentry = await self._get_dentry(parent, name)
+        if dentry.get("remote"):
+            parent, name, dentry = await self._primary_of(
+                int(dentry["ino"]))
         for key in ("size", "mode"):
             if key in d and d[key] is not None:
                 dentry[key] = int(d[key])

@@ -83,7 +83,12 @@ async def _dispatch(args, rbd: RBD):
         name, snap = _image_spec(args.snap_spec)
         if snap is None:
             raise RBDError("clone wants parent image@snap")
-        await rbd.clone(name, snap, args.child)
+        child = args.child
+        dest = None
+        if "/" in child:            # cross-pool: pool/child syntax
+            dpool, child = child.split("/", 1)
+            dest = RBD(await rbd.ioctx.rados.open_ioctx(dpool))
+        await rbd.clone(name, snap, child, dest=dest)
         return None
     if cmd == "flatten":
         img = await rbd.open(args.image)

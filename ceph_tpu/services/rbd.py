@@ -78,9 +78,14 @@ class RBD:
         return image_id
 
     async def clone(self, parent_name: str, snap_name: str,
-                    child_name: str, object_map: bool = True) -> None:
+                    child_name: str, object_map: bool = True,
+                    dest: "RBD | None" = None) -> None:
         """Snapshot-based COW clone (librbd rbd_clone): the child starts
-        as a read-through view of parent@snap and diverges on write."""
+        as a read-through view of parent@snap and diverges on write.
+        ``dest`` places the child in another pool (cross-pool clone);
+        the parent link records the parent's pool so reads route there.
+        """
+        dest = dest or self
         parent = await self.open(parent_name)
         info = parent.snaps.get(snap_name)
         if info is None:
@@ -89,11 +94,11 @@ class RBD:
             raise RBDError(
                 f"snap {snap_name!r} must be protected before cloning"
             )
-        child_id = await self.create(
+        child_id = await dest.create(
             child_name, int(info["size"]), parent.order,
             object_map=object_map,
         )
-        await self.ioctx.exec(
+        await dest.ioctx.exec(
             f"rbd_header.{child_id}", "rbd", "set_parent",
             json.dumps({
                 "pool": self.ioctx.pool_name,
@@ -103,12 +108,16 @@ class RBD:
                 "overlap": int(info["size"]),
             }).encode(),
         )
+        # the registry lives in the PARENT's pool: unprotect checks it
+        label = (child_name if dest is self or
+                 dest.ioctx.pool_name == self.ioctx.pool_name
+                 else f"{dest.ioctx.pool_name}/{child_name}")
         await self.ioctx.operate(CHILDREN_OID, ObjectOperation()
                                  .create().omap_set({
                                      _child_key(parent.image_id,
                                                 int(info["id"]),
                                                 child_id):
-                                     child_name.encode(),
+                                     label.encode(),
                                  }))
 
     async def children(self, parent_name: str,
@@ -142,9 +151,12 @@ class RBD:
         for oid in data_objs:
             await self.ioctx.remove(oid)
         if img.parent is not None:
-            # unlink from the parent's child registry
+            # unlink from the registry in the PARENT's pool
+            ppool = img.parent.get("pool", self.ioctx.pool_name)
+            pio = (self.ioctx if ppool == self.ioctx.pool_name
+                   else await self.ioctx.rados.open_ioctx(ppool))
             try:
-                await self.ioctx.rm_omap_keys(CHILDREN_OID, [
+                await pio.rm_omap_keys(CHILDREN_OID, [
                     _child_key(img.parent["image_id"],
                                int(img.parent["snap_id"]),
                                img.image_id),
@@ -498,8 +510,11 @@ class Image:
             )
         await self.ioctx.exec(self.header_oid, "rbd", "remove_parent",
                               b"{}")
+        ppool = self.parent.get("pool", self.ioctx.pool_name)
+        pio = (self.ioctx if ppool == self.ioctx.pool_name
+               else await self.ioctx.rados.open_ioctx(ppool))
         try:
-            await self.ioctx.rm_omap_keys(CHILDREN_OID, [
+            await pio.rm_omap_keys(CHILDREN_OID, [
                 _child_key(self.parent["image_id"],
                            int(self.parent["snap_id"]), self.image_id),
             ])

@@ -571,7 +571,7 @@ class RGWLite:
             else:
                 await self.ioctx.rm_omap_keys(self._index_oid(bucket),
                                               [key])
-            await self._log(bucket, "del-version", key)
+        await self._log(bucket, "del-version", key)
 
     # -- multipart upload (rgw_multi.cc: initiate/part/complete/abort) ----
     @staticmethod

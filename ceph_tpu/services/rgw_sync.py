@@ -92,6 +92,23 @@ class RGWSyncAgent:
             if e.code != "NoSuchKey":
                 raise
 
+    async def _reconcile(self, bucket: str, key: str) -> None:
+        """Mirror the key's CURRENT source state.  Version-level ops
+        (del-version restores/promotions) change what is current
+        without being a plain put/del, so re-read and converge."""
+        try:
+            got = await self.src.get_object(bucket, key)
+        except RGWError as e:
+            if e.code != "NoSuchKey":
+                raise
+            await self._replicate_del(bucket, key)
+            return
+        await self.dst.put_object(
+            bucket, key, got["data"],
+            content_type=got.get("content_type", "binary/octet-stream"),
+            metadata=got.get("meta", {}),
+        )
+
     # -- phases ------------------------------------------------------------
     async def _full_sync(self, bucket: str) -> int:
         """Bootstrap a bucket: log position first, then copy everything
@@ -122,6 +139,9 @@ class RGWSyncAgent:
                 await self._replicate_put(bucket, entry["key"])
             elif entry["op"] == "del":
                 await self._replicate_del(bucket, entry["key"])
+            else:
+                # del-version &co: converge on current source state
+                await self._reconcile(bucket, entry["key"])
             last = int(entry["seq"])
             self.synced_ops += 1
         if last != after:

@@ -309,3 +309,108 @@ def test_symlinks():
             b"updated-via-link"
         await _teardown(cluster, rados, fs2)
     asyncio.run(run())
+
+def test_hardlinks():
+    """Hard links: remote dentries + anchortable (reference remote-
+    dentry design).  Both names read/write the one inode; data
+    survives until the LAST name is unlinked; unlinking the primary
+    promotes a remote to carry the inode."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.mkdirs("/a/b")
+        await fs.write_file("/a/file", b"shared-bytes")
+        await fs.link("/a/file", "/a/b/alias")
+        # one inode, two names, nlink visible through both
+        s1 = await fs.stat("/a/file")
+        s2 = await fs.stat("/a/b/alias")
+        assert s1["ino"] == s2["ino"]
+        assert s1.get("nlink", 1) == 2 and s2.get("nlink", 1) == 2
+        assert await fs.read_file("/a/b/alias") == b"shared-bytes"
+        # a write through the ALIAS is visible through the original
+        await fs.write_file("/a/b/alias", b"rewritten-via-alias!")
+        assert await fs.read_file("/a/file") == b"rewritten-via-alias!"
+        assert (await fs.stat("/a/file"))["size"] == \
+            len(b"rewritten-via-alias!")
+
+        # unlinking the PRIMARY promotes the alias; data survives
+        await fs.unlink("/a/file")
+        assert await fs.read_file("/a/b/alias") == \
+            b"rewritten-via-alias!"
+        assert (await fs.stat("/a/b/alias")).get("nlink", 1) == 1
+        with pytest.raises(FSError):
+            await fs.stat("/a/file")
+        # last unlink purges the data objects
+        ino = (await fs.stat("/a/b/alias"))["ino"]
+        await fs.unlink("/a/b/alias")
+        objs = await (await rados.open_ioctx("cephfs_data")) \
+            .list_objects()
+        assert not [o for o in objs if o.startswith(f"{ino:x}.")]
+
+        # three names; remove remotes first, then primary
+        await fs.write_file("/tri", b"3-links")
+        await fs.link("/tri", "/tri2")
+        await fs.link("/tri2", "/tri3")   # linking a link stays flat
+        assert (await fs.stat("/tri"))["nlink"] == 3
+        await fs.unlink("/tri2")
+        assert (await fs.stat("/tri3"))["nlink"] == 2
+        await fs.unlink("/tri")           # promote to /tri3
+        assert await fs.read_file("/tri3") == b"3-links"
+
+        # rename one name of a linked file: link keeps working
+        await fs.link("/tri3", "/tri4")
+        await fs.rename("/tri4", "/a/moved")
+        assert await fs.read_file("/a/moved") == b"3-links"
+        await fs.write_file("/a/moved", b"moved-write")
+        assert await fs.read_file("/tri3") == b"moved-write"
+        # rename between two links of the SAME file: POSIX no-op
+        await fs.rename("/tri3", "/a/moved")
+        assert await fs.read_file("/tri3") == b"moved-write"
+        assert await fs.read_file("/a/moved") == b"moved-write"
+
+        # rename ONTO one name of a linked file: other name survives
+        await fs.write_file("/clobber", b"incoming")
+        await fs.rename("/clobber", "/a/moved")
+        assert await fs.read_file("/a/moved") == b"incoming"
+        assert await fs.read_file("/tri3") == b"moved-write"
+
+        # hardlinks are file-only
+        with pytest.raises(FSError):
+            await fs.link("/a/b", "/dirlink")
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_hardlinks_survive_mds_restart():
+    """Anchortable + remote dentries are RADOS state: a fresh MDS
+    resolves links and promotion still works after replay."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.write_file("/f", b"durable-link")
+        await fs.link("/f", "/g")
+        await fs.unmount()
+        await mds.shutdown()
+        del cluster.mdss["a"]
+        mds2 = await cluster.start_mds(name="b", block_size=4096)
+        fs2 = CephFS(rados, str(mds2.msgr.my_addr))
+        await fs2.mount()
+        assert await fs2.read_file("/g") == b"durable-link"
+        assert (await fs2.stat("/g"))["nlink"] == 2
+        await fs2.unlink("/f")            # promotion after restart
+        assert await fs2.read_file("/g") == b"durable-link"
+        await _teardown(cluster, rados, fs2)
+    asyncio.run(run())
+
+def test_unlink_invalidates_other_link_names():
+    """Unlinking one name of a hardlinked file must not leave the
+    OTHER cached names serving stale nlink/size for the lease TTL —
+    even when the unlinked leaf was never looked up by this client."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.write_file("/f", b"x" * 10)
+        await fs.link("/f", "/g")
+        assert (await fs.stat("/g"))["nlink"] == 2   # /g now cached
+        fs._invalidate(fs.root, "f")   # simulate: /f leaf not cached
+        await fs.unlink("/f")
+        assert (await fs.stat("/g"))["nlink"] == 1
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())

@@ -289,3 +289,60 @@ def test_object_cacher_unit():
         assert c.stats()["evictions"] >= 1
 
     asyncio.run(run())
+
+def test_cross_pool_clone():
+    """A clone can live in a different pool than its parent: reads
+    route through the parent link's pool; the parent-pool child
+    registry still blocks unprotect and is unlinked on remove/flatten
+    (reference librbd cross-pool clone v2)."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("parentp", pg_num=8)
+            await rados.pool_create("childp", pg_num=8)
+            prbd = RBD(await rados.open_ioctx("parentp"))
+            crbd = RBD(await rados.open_ioctx("childp"))
+            await prbd.create("base", 4 << 20)
+            img = await prbd.open("base")
+            payload = b"cross-pool!" * 100
+            await img.write(0, payload)
+            await img.snap_create("gold")
+            await img.snap_protect("gold")
+
+            await prbd.clone("base", "gold", "copy", dest=crbd)
+            assert "copy" in await crbd.list()
+            assert "copy" not in await prbd.list()
+            # registry (parent pool) names the foreign-pool child
+            kids = await prbd.children("base", "gold")
+            assert kids == ["childp/copy"]
+            # unprotect refuses while the cross-pool child exists
+            pimg = await prbd.open("base")
+            with pytest.raises(RBDError):
+                await pimg.snap_unprotect("gold")
+
+            child = await crbd.open("copy")
+            assert await child.read(0, len(payload)) == payload
+            # child diverges without touching the parent
+            await child.write(0, b"DIVERGED")
+            assert (await child.read(0, 8)) == b"DIVERGED"
+            assert (await (await prbd.open("base")).read(0, 8)) == \
+                payload[:8]
+
+            # flatten severs the link and unlinks in the parent pool
+            await child.flatten()
+            assert await prbd.children("base", "gold") == []
+            await pimg.snap_unprotect("gold")
+            assert await child.read(0, 8) == b"DIVERGED"
+
+            # remove() of a still-linked cross-pool child unlinks too
+            await pimg.snap_protect("gold")
+            await prbd.clone("base", "gold", "copy2", dest=crbd)
+            assert await prbd.children("base", "gold") == \
+                ["childp/copy2"]
+            await crbd.remove("copy2")
+            assert await prbd.children("base", "gold") == []
+            await pimg.snap_unprotect("gold")
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())

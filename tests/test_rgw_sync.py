@@ -125,3 +125,53 @@ def test_multisite_background_agent_converges():
         await c1.stop()
         await c2.stop()
     asyncio.run(run())
+
+def test_version_level_ops_reconcile():
+    """del-version datalog entries change what is CURRENT without
+    being a plain put/del: the replica must converge by re-reading
+    source state (marker removal restores; promotion rolls back)."""
+    async def run():
+        c1, r1, primary = await _zone("z1-")
+        c2, r2, secondary = await _zone("z2-")
+
+        await primary.create_bucket("vb")
+        await primary.put_bucket_versioning("vb", True)
+        r_old = await primary.put_object("vb", "k", b"version-1")
+        r_new = await primary.put_object("vb", "k", b"version-2")
+        agent = RGWSyncAgent(primary, secondary)
+        await agent.sync_once()
+        assert (await secondary.get_object("vb", "k"))["data"] == \
+            b"version-2"
+
+        # deleting the CURRENT version promotes v1: replica rolls back
+        await primary.delete_object_version("vb", "k",
+                                            r_new["version_id"])
+        await agent.sync_once()
+        assert (await secondary.get_object("vb", "k"))["data"] == \
+            b"version-1"
+
+        # marker insert + marker removal: replica follows both ways
+        await primary.delete_object("vb", "k")
+        await agent.sync_once()
+        with pytest.raises(RGWError):
+            await secondary.get_object("vb", "k")
+        marker = (await primary.list_object_versions("vb"))[0]
+        await primary.delete_object_version("vb", "k",
+                                            marker["version_id"])
+        await agent.sync_once()
+        assert (await secondary.get_object("vb", "k"))["data"] == \
+            b"version-1"
+
+        # deleting a NON-current version still logs (audit/no-op sync)
+        r3 = await primary.put_object("vb", "k", b"version-3")
+        await primary.delete_object_version("vb", "k",
+                                            r_old["version_id"])
+        await agent.sync_once()
+        assert (await secondary.get_object("vb", "k"))["data"] == \
+            b"version-3"
+
+        await r1.shutdown()
+        await r2.shutdown()
+        await c1.stop()
+        await c2.stop()
+    asyncio.run(run())
