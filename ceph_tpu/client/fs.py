@@ -489,8 +489,12 @@ class CephFS:
     async def rename(self, src: str, dst: str) -> None:
         sp, sn = await self._resolve_parent(src)
         dp, dn = await self._resolve_parent(dst)
-        await self._request("rename", src_parent=sp, src_name=sn,
-                            dst_parent=dp, dst_name=dn)
+        reply = await self._request("rename", src_parent=sp,
+                                    src_name=sn, dst_parent=dp,
+                                    dst_name=dn)
+        # a clobbered hardlinked dst changed its inode's nlink: drop
+        # every cached name of that inode, not just the two renamed
+        self._invalidate_ino(int(reply.get("unlinked_ino", 0) or 0))
         self._invalidate(sp, sn)
         self._invalidate(dp, dn)
 

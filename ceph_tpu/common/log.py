@@ -18,7 +18,8 @@ import time
 
 SUBSYSTEMS = (
     "osd", "mon", "ms", "ec", "crush", "objecter", "store", "client",
-    "mgr", "rbd", "rgw", "rgw-sync", "mds", "config", "heartbeat",
+    "mgr", "rbd", "rgw", "rgw-sync", "rgw-http", "mds", "config",
+    "heartbeat",
     "peering", "asok",
 )
 

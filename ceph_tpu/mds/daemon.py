@@ -705,6 +705,7 @@ class MDSDaemon:
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
         dentry = await self._get_dentry(sp, sn)
+        unlinked_ino = 0
         if (sp, sn) == (dp, dn):
             # POSIX rename-to-self is a no-op — it must not purge the
             # live object's data blocks or dirfrag
@@ -732,6 +733,7 @@ class MDSDaemon:
                 # file does NOTHING (both names stay)
                 return {"dentry": dentry}
             else:
+                unlinked_ino = int(dst["ino"])
                 if dst.get("remote") or int(dst.get("nlink", 1)) > 1:
                     # replacing one name of a hardlinked file: run the
                     # link-aware unlink first — its data must survive
@@ -770,7 +772,7 @@ class MDSDaemon:
                  "anchor_ino": anchor_ino, "anchor": anchor}
         await self._journal(entry)
         await self._apply(entry)
-        return {"dentry": dentry}
+        return {"dentry": dentry, "unlinked_ino": unlinked_ino}
 
     async def _req_setattr(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])

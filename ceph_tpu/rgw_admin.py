@@ -62,6 +62,10 @@ async def _dispatch(args, gw: RGWLite, users: RGWUsers):
         if args.sub == "rm":
             await users.remove(args.uid)
             return None
+        if args.sub in ("suspend", "enable"):
+            await users.set_suspended(args.uid,
+                                      args.sub == "suspend")
+            return None
     if args.cmd == "quota":
         await users.set_quota(args.uid, max_size=args.max_size,
                               max_objects=args.max_objects)
@@ -110,6 +114,9 @@ def build_parser() -> argparse.ArgumentParser:
     uc.add_argument("--display-name", default="")
     uc.add_argument("--max-size", type=int, default=0)
     uc.add_argument("--max-objects", type=int, default=0)
+    for sname in ("suspend", "enable"):
+        sp_ = user_sub.add_parser(sname)
+        sp_.add_argument("--uid", required=True)
     user_sub.add_parser("ls")
     for name in ("info", "rm"):
         x = user_sub.add_parser(name)

@@ -110,6 +110,15 @@ class RGWUsers:
         await self.ioctx.set_omap(USERS_OID,
                                   {uid: json.dumps(rec).encode()})
 
+    async def set_suspended(self, uid: str,
+                            suspended: bool = True) -> None:
+        """radosgw-admin user suspend/enable: a suspended user fails
+        every auth path (library HMAC and the HTTP frontend's SigV4)."""
+        rec = await self.get(uid)
+        rec["suspended"] = bool(suspended)
+        await self.ioctx.set_omap(USERS_OID,
+                                  {uid: json.dumps(rec).encode()})
+
     async def authenticate(self, access_key: str, signature: str,
                            string_to_sign: bytes) -> str:
         """hmac-sha256(secret, string_to_sign) == signature -> uid

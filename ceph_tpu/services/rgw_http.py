@@ -1,0 +1,672 @@
+"""S3 REST frontend for RGW-lite: the asio/beast frontend role.
+
+The reference serves S3 over HTTP through an embedded server
+(src/rgw/rgw_asio_frontend.cc) that parses requests into RGWOps
+(rgw_rest_s3.cc) and authenticates AWS Signature V4 headers
+(rgw_auth_s3.cc).  This frontend does the same on asyncio streams:
+
+- HTTP/1.1 keep-alive parsing (request line, headers, Content-Length
+  bodies) without any web framework — the runtime stays stdlib.
+- AWS SigV4 verification against the RGWUsers key table: canonical
+  request -> string-to-sign -> derived signing key, exactly the
+  published algorithm, so any stock S3 SDK signs compatibly.  No
+  Authorization header means the ``anonymous`` identity.
+- Routing: service (/), bucket (/b), object (/b/k) levels with the S3
+  subresources (?versioning ?versions ?uploads ?lifecycle ?acl
+  ?delete ?partNumber&uploadId), Range/ETag/x-amz-meta-* headers and
+  XML bodies in the S3 namespace.
+
+Every operation funnels into :class:`RGWLite` ``as_user(uid)`` so ACL,
+quota, versioning and datalog behavior is identical to the library
+path the rest of the framework (multisite sync, radosgw-admin) uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from email.utils import formatdate
+
+from ceph_tpu.common.log import Dout
+from ceph_tpu.services.rgw import ANONYMOUS, RGWError, RGWLite, RGWUsers
+
+log = Dout("rgw-http")
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+_MAX_BODY = 256 * 1024 * 1024
+_EMPTY_SHA = hashlib.sha256(b"").hexdigest()
+
+# RGWError code -> HTTP status (rgw_common.cc rgw_http_s3_errors)
+_STATUS = {
+    "AccessDenied": 403,
+    "SignatureDoesNotMatch": 403,
+    "InvalidAccessKeyId": 403,
+    "NoSuchBucket": 404,
+    "NoSuchKey": 404,
+    "NoSuchVersion": 404,
+    "NoSuchUpload": 404,
+    "NoSuchLifecycleConfiguration": 404,
+    "BucketNotEmpty": 409,
+    "BucketAlreadyExists": 409,
+    "PreconditionFailed": 412,
+    "QuotaExceeded": 403,
+    "MethodNotAllowed": 405,
+    "InvalidRange": 416,
+}
+
+
+class _HTTPError(Exception):
+    def __init__(self, status: int, code: str, msg: str = ""):
+        self.status = status
+        self.code = code
+        self.msg = msg
+
+
+class _Request:
+    def __init__(self, method: str, raw_path: str,
+                 headers: dict[str, str], body: bytes):
+        self.method = method
+        self.headers = headers
+        self.body = body
+        path, _, query = raw_path.partition("?")
+        self.raw_path = path
+        self.path = urllib.parse.unquote(path)
+        self.query: dict[str, str] = {}
+        self.raw_query = query
+        for part in query.split("&") if query else ():
+            k, _, v = part.partition("=")
+            self.query[urllib.parse.unquote(k)] = urllib.parse.unquote(v)
+
+    def header(self, name: str, default: str = "") -> str:
+        return self.headers.get(name.lower(), default)
+
+
+# -- SigV4 (rgw_auth_s3.cc) -----------------------------------------------
+def _sig_key(secret: str, date: str, region: str, service: str) -> bytes:
+    k = hmac.new(b"AWS4" + secret.encode(), date.encode(),
+                 hashlib.sha256).digest()
+    for part in (region, service, "aws4_request"):
+        k = hmac.new(k, part.encode(), hashlib.sha256).digest()
+    return k
+
+
+def _canonical_query(raw_query: str) -> str:
+    pairs = []
+    for part in raw_query.split("&") if raw_query else ():
+        k, eq, v = part.partition("=")
+        pairs.append((urllib.parse.unquote(k), urllib.parse.unquote(v)))
+    enc = urllib.parse.quote
+    return "&".join(
+        f"{enc(k, safe='-_.~')}={enc(v, safe='-_.~')}"
+        for k, v in sorted(pairs)
+    )
+
+
+def sigv4_string_to_sign(req: _Request, signed_headers: list[str],
+                         scope: str, amz_date: str) -> str:
+    payload_hash = req.header("x-amz-content-sha256")
+    if payload_hash in ("", "UNSIGNED-PAYLOAD"):
+        payload_hash = (payload_hash or
+                        hashlib.sha256(req.body).hexdigest())
+    canon_headers = "".join(
+        f"{h}:{' '.join(req.header(h).split())}\n" for h in signed_headers
+    )
+    canon_uri = urllib.parse.quote(req.path, safe="/-_.~")
+    canonical = "\n".join([
+        req.method, canon_uri, _canonical_query(req.raw_query),
+        canon_headers, ";".join(signed_headers), payload_hash,
+    ])
+    return "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest(),
+    ])
+
+
+def sigv4_sign(req: _Request, access_key: str, secret_key: str,
+               region: str = "us-east-1") -> str:
+    """Produce the Authorization header a stock SDK would (the client
+    half; the frontend verifies with the same canonicalization)."""
+    amz_date = req.header("x-amz-date")
+    day = amz_date[:8]
+    scope = f"{day}/{region}/s3/aws4_request"
+    signed = sorted(h for h in req.headers
+                    if h == "host" or h.startswith("x-amz-"))
+    sts = sigv4_string_to_sign(req, signed, scope, amz_date)
+    sig = hmac.new(_sig_key(secret_key, day, region, "s3"),
+                   sts.encode(), hashlib.sha256).hexdigest()
+    return (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+
+
+class S3Frontend:
+    """One listening S3 endpoint over an RGWLite handle."""
+
+    def __init__(self, rgw: RGWLite, users: RGWUsers | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 region: str = "us-east-1",
+                 system_users: frozenset[str] = frozenset()):
+        self.rgw = rgw
+        self.users = users if users is not None else rgw.users
+        self.host = host
+        self.port = port
+        self.region = region
+        self.system_users = system_users
+        self._server: asyncio.AbstractServer | None = None
+        self._reqid = 0
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._client, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.dout(1, "s3 frontend on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- connection loop ---------------------------------------------------
+    async def _client(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except _HTTPError as e:
+                    status, headers, body = self._error(
+                        e.status, e.code, e.msg)
+                    stub = _Request("GET", "/", {}, b"")
+                    await self._respond(writer, stub, status, headers,
+                                        body, keep=False)
+                    break
+                if req is None:
+                    break
+                keep = req.header("connection", "keep-alive") != "close"
+                try:
+                    status, headers, body = await self._route(req)
+                except _HTTPError as e:
+                    status, headers, body = self._error(e.status, e.code,
+                                                        e.msg)
+                except RGWError as e:
+                    status, headers, body = self._error(
+                        _STATUS.get(e.code, 400), e.code, str(e)
+                    )
+                await self._respond(writer, req, status, headers, body,
+                                    keep)
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _read_request(self,
+                            reader: asyncio.StreamReader
+                            ) -> _Request | None:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError):
+            return None
+        lines = head.decode("latin-1").split("\r\n")
+        try:
+            method, raw_path, _version = lines[0].split(" ", 2)
+        except ValueError:
+            raise _HTTPError(400, "InvalidRequest", "bad request line")
+        headers: dict[str, str] = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", "0") or "0")
+        if length > _MAX_BODY:
+            raise _HTTPError(400, "EntityTooLarge", str(length))
+        body = await reader.readexactly(length) if length else b""
+        return _Request(method.upper(), raw_path, headers, body)
+
+    async def _respond(self, writer: asyncio.StreamWriter, req: _Request,
+                       status: int, headers: dict, body: bytes,
+                       keep: bool) -> None:
+        self._reqid += 1
+        reason = {200: "OK", 204: "No Content", 206: "Partial Content",
+                  403: "Forbidden", 404: "Not Found"}.get(status, "S3")
+        out = [f"HTTP/1.1 {status} {reason}"]
+        base = {
+            "x-amz-request-id": f"{self._reqid:016x}",
+            "date": formatdate(usegmt=True),
+            "content-length": str(len(body)),
+            "connection": "keep-alive" if keep else "close",
+        }
+        base.update(headers)
+        for k, v in base.items():
+            out.append(f"{k}: {v}")
+        payload = "\r\n".join(out).encode("latin-1") + b"\r\n\r\n"
+        if req.method != "HEAD":
+            payload += body
+        writer.write(payload)
+        await writer.drain()
+
+    @staticmethod
+    def _error(status: int, code: str, msg: str = ""):
+        root = ET.Element("Error")
+        ET.SubElement(root, "Code").text = code
+        ET.SubElement(root, "Message").text = msg
+        body = ET.tostring(root, xml_declaration=True,
+                           encoding="unicode").encode()
+        return status, {"content-type": "application/xml"}, body
+
+    # -- auth (rgw_auth_s3.cc) --------------------------------------------
+    async def _identify(self, req: _Request) -> str:
+        auth = req.header("authorization")
+        if not auth:
+            return ANONYMOUS
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            raise _HTTPError(400, "InvalidArgument", "unsupported auth")
+        fields = {}
+        for part in auth[len("AWS4-HMAC-SHA256 "):].split(","):
+            k, _, v = part.strip().partition("=")
+            fields[k] = v
+        try:
+            cred = fields["Credential"].split("/")
+            access_key, day, region = cred[0], cred[1], cred[2]
+            signed = fields["SignedHeaders"].split(";")
+            their_sig = fields["Signature"]
+        except (KeyError, IndexError):
+            raise _HTTPError(400, "InvalidArgument", "malformed auth")
+        if self.users is None:
+            raise _HTTPError(403, "InvalidAccessKeyId", access_key)
+        uid, secret = await self._lookup_key(access_key)
+        scope = f"{day}/{region}/s3/aws4_request"
+        sts = sigv4_string_to_sign(req, signed, scope,
+                                   req.header("x-amz-date"))
+        want = hmac.new(_sig_key(secret, day, region, "s3"),
+                        sts.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, their_sig):
+            raise _HTTPError(403, "SignatureDoesNotMatch", access_key)
+        declared = req.header("x-amz-content-sha256")
+        if declared and declared != "UNSIGNED-PAYLOAD" and \
+                declared != hashlib.sha256(req.body).hexdigest():
+            # a valid signature over a LIED-ABOUT payload hash must
+            # not authorize the actual body (replay/tamper guard)
+            raise _HTTPError(400, "XAmzContentSHA256Mismatch",
+                             "payload hash mismatch")
+        return uid
+
+    async def _lookup_key(self, access_key: str) -> tuple[str, str]:
+        from ceph_tpu.services.rgw import KEYS_OID
+        from ceph_tpu.client.rados import RadosError
+
+        try:
+            kv = await self.users.ioctx.get_omap(KEYS_OID, [access_key])
+        except RadosError as e:
+            if e.rc == -2:
+                kv = {}
+            else:
+                raise
+        if access_key not in kv:
+            raise _HTTPError(403, "InvalidAccessKeyId", access_key)
+        uid = kv[access_key].decode()
+        rec = await self.users.get(uid)
+        if rec.get("suspended"):
+            raise _HTTPError(403, "AccessDenied", f"{uid} suspended")
+        return uid, rec["secret_key"]
+
+    # -- routing (rgw_rest_s3.cc RGWHandler_REST_S3) ----------------------
+    async def _route(self, req: _Request):
+        uid = await self._identify(req)
+        gw = self.rgw.as_user(None if uid in self.system_users
+                              else uid)
+        parts = req.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        if not bucket:
+            return await self._service(req, gw)
+        if not key:
+            return await self._bucket(req, gw, bucket)
+        return await self._object(req, gw, bucket, key)
+
+    async def _service(self, req: _Request, gw: RGWLite):
+        if req.method != "GET":
+            raise _HTTPError(405, "MethodNotAllowed", req.method)
+        root = ET.Element("ListAllMyBucketsResult", xmlns=XMLNS)
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = gw.user or "admin"
+        buckets = ET.SubElement(root, "Buckets")
+        for name in await gw.list_buckets():
+            try:
+                meta = await gw._check_bucket(name, "READ")
+            except RGWError:
+                continue                 # not ours / not readable
+            b = ET.SubElement(buckets, "Bucket")
+            ET.SubElement(b, "Name").text = name
+            ET.SubElement(b, "CreationDate").text = _iso(
+                meta.get("created", 0.0))
+        return self._xml(root)
+
+    async def _bucket(self, req: _Request, gw: RGWLite, bucket: str):
+        q = req.query
+        if req.method == "PUT":
+            if "versioning" in q:
+                cfg = ET.fromstring(req.body.decode() or
+                                    "<VersioningConfiguration/>")
+                status = cfg.findtext(_ns("Status"), default="",
+                                      namespaces=None) or \
+                    cfg.findtext("Status", default="")
+                await gw.put_bucket_versioning(bucket,
+                                               status == "Enabled")
+                return 200, {}, b""
+            if "lifecycle" in q:
+                rules = _parse_lifecycle(req.body)
+                await gw.put_lifecycle(bucket, rules)
+                return 200, {}, b""
+            if "acl" in q:
+                canned = req.header("x-amz-acl", "private")
+                await gw.put_bucket_acl(bucket, canned)
+                return 200, {}, b""
+            await gw.create_bucket(bucket)
+            return 200, {"location": f"/{bucket}"}, b""
+        if req.method == "DELETE":
+            if "lifecycle" in q:
+                await gw.delete_lifecycle(bucket)
+                return 204, {}, b""
+            await gw.delete_bucket(bucket)
+            return 204, {}, b""
+        if req.method == "HEAD":
+            await gw._check_bucket(bucket, "READ")
+            return 200, {}, b""
+        if req.method == "POST" and "delete" in q:
+            return await self._bulk_delete(req, gw, bucket)
+        if req.method != "GET":
+            raise _HTTPError(405, "MethodNotAllowed", req.method)
+        if "versioning" in q:
+            state = await gw.get_bucket_versioning(bucket)
+            root = ET.Element("VersioningConfiguration", xmlns=XMLNS)
+            if state:
+                ET.SubElement(root, "Status").text = \
+                    "Enabled" if state == "enabled" else "Suspended"
+            return self._xml(root)
+        if "versions" in q:
+            return await self._list_versions(req, gw, bucket)
+        if "uploads" in q:
+            root = ET.Element("ListMultipartUploadsResult", xmlns=XMLNS)
+            ET.SubElement(root, "Bucket").text = bucket
+            for up in await gw.list_multipart_uploads(bucket):
+                u = ET.SubElement(root, "Upload")
+                ET.SubElement(u, "Key").text = up["key"]
+                ET.SubElement(u, "UploadId").text = up["upload_id"]
+            return self._xml(root)
+        if "lifecycle" in q:
+            rules = await gw.get_lifecycle(bucket)
+            if not rules:
+                raise _HTTPError(404, "NoSuchLifecycleConfiguration",
+                                 bucket)
+            root = ET.Element("LifecycleConfiguration", xmlns=XMLNS)
+            for rule in rules:
+                r = ET.SubElement(root, "Rule")
+                ET.SubElement(r, "ID").text = rule.get("id", "")
+                ET.SubElement(r, "Prefix").text = rule.get("prefix", "")
+                ET.SubElement(r, "Status").text = "Enabled"
+                exp = ET.SubElement(r, "Expiration")
+                ET.SubElement(exp, "Days").text = \
+                    str(rule.get("expiration_days", 0))
+            return self._xml(root)
+        if "acl" in q:
+            acl = await gw.get_bucket_acl(bucket)
+            root = ET.Element("AccessControlPolicy", xmlns=XMLNS)
+            ET.SubElement(ET.SubElement(root, "Owner"), "ID").text = \
+                acl.get("owner", "")
+            ET.SubElement(root, "CannedACL").text = \
+                acl.get("canned", "private")
+            return self._xml(root)
+        return await self._list_objects(req, gw, bucket)
+
+    async def _list_objects(self, req: _Request, gw: RGWLite,
+                            bucket: str):
+        q = req.query
+        v2 = q.get("list-type") == "2"
+        marker = q.get("continuation-token" if v2 else "marker", "") or \
+            q.get("start-after", "")
+        listing = await gw.list_objects(
+            bucket, prefix=q.get("prefix", ""), marker=marker,
+            max_keys=int(q.get("max-keys", "1000")),
+        )
+        root = ET.Element("ListBucketResult", xmlns=XMLNS)
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "Prefix").text = q.get("prefix", "")
+        ET.SubElement(root, "IsTruncated").text = \
+            "true" if listing["is_truncated"] else "false"
+        ET.SubElement(root, "KeyCount" if v2 else "MaxKeys").text = \
+            str(len(listing["contents"]))
+        if listing["is_truncated"]:
+            tag = "NextContinuationToken" if v2 else "NextMarker"
+            ET.SubElement(root, tag).text = listing["next_marker"]
+        for c in listing["contents"]:
+            e = ET.SubElement(root, "Contents")
+            ET.SubElement(e, "Key").text = c["key"]
+            ET.SubElement(e, "Size").text = str(c["size"])
+            ET.SubElement(e, "ETag").text = f'"{c["etag"]}"'
+            ET.SubElement(e, "LastModified").text = _iso(c["mtime"])
+        return self._xml(root)
+
+    async def _list_versions(self, req: _Request, gw: RGWLite,
+                             bucket: str):
+        versions = await gw.list_object_versions(
+            bucket, prefix=req.query.get("prefix", ""))
+        root = ET.Element("ListVersionsResult", xmlns=XMLNS)
+        ET.SubElement(root, "Name").text = bucket
+        for v in versions:
+            tag = "DeleteMarker" if v["delete_marker"] else "Version"
+            e = ET.SubElement(root, tag)
+            ET.SubElement(e, "Key").text = v["key"]
+            ET.SubElement(e, "VersionId").text = v["version_id"]
+            ET.SubElement(e, "IsLatest").text = \
+                "true" if v["is_latest"] else "false"
+            ET.SubElement(e, "LastModified").text = _iso(v["mtime"])
+            if not v["delete_marker"]:
+                ET.SubElement(e, "Size").text = str(v["size"])
+                ET.SubElement(e, "ETag").text = f'"{v["etag"]}"'
+        return self._xml(root)
+
+    async def _bulk_delete(self, req: _Request, gw: RGWLite,
+                           bucket: str):
+        doc = ET.fromstring(req.body.decode())
+        root = ET.Element("DeleteResult", xmlns=XMLNS)
+        for obj in doc.iter():
+            if not obj.tag.endswith("Object"):
+                continue
+            key = obj.findtext(_ns("Key")) or obj.findtext("Key") or ""
+            try:
+                await gw.delete_object(bucket, key)
+                d = ET.SubElement(root, "Deleted")
+                ET.SubElement(d, "Key").text = key
+            except RGWError as e:
+                er = ET.SubElement(root, "Error")
+                ET.SubElement(er, "Key").text = key
+                ET.SubElement(er, "Code").text = e.code
+        return self._xml(root)
+
+    async def _object(self, req: _Request, gw: RGWLite, bucket: str,
+                      key: str):
+        q = req.query
+        if req.method == "POST":
+            if "uploads" in q:
+                upload_id = await gw.initiate_multipart(
+                    bucket, key,
+                    content_type=req.header("content-type",
+                                            "binary/octet-stream"),
+                    metadata=_meta_headers(req),
+                )
+                root = ET.Element("InitiateMultipartUploadResult",
+                                  xmlns=XMLNS)
+                ET.SubElement(root, "Bucket").text = bucket
+                ET.SubElement(root, "Key").text = key
+                ET.SubElement(root, "UploadId").text = upload_id
+                return self._xml(root)
+            if "uploadId" in q:
+                parts = _parse_complete(req.body)
+                done = await gw.complete_multipart(bucket, key,
+                                                   q["uploadId"], parts)
+                root = ET.Element("CompleteMultipartUploadResult",
+                                  xmlns=XMLNS)
+                ET.SubElement(root, "Key").text = key
+                ET.SubElement(root, "ETag").text = f'"{done["etag"]}"'
+                hdrs = {}
+                if done.get("version_id"):
+                    hdrs["x-amz-version-id"] = done["version_id"]
+                status, xh, body = self._xml(root)
+                xh.update(hdrs)
+                return status, xh, body
+            raise _HTTPError(400, "InvalidArgument", "bad POST")
+        if req.method == "PUT":
+            if "partNumber" in q and "uploadId" in q:
+                part = await gw.upload_part(
+                    bucket, key, q["uploadId"], int(q["partNumber"]),
+                    req.body,
+                )
+                return 200, {"etag": f'"{part["etag"]}"'}, b""
+            src = req.header("x-amz-copy-source")
+            if src:
+                sb, _, sk = src.lstrip("/").partition("/")
+                out = await gw.copy_object(sb, urllib.parse.unquote(sk),
+                                           bucket, key)
+                root = ET.Element("CopyObjectResult", xmlns=XMLNS)
+                ET.SubElement(root, "ETag").text = f'"{out["etag"]}"'
+                return self._xml(root)
+            out = await gw.put_object(
+                bucket, key, req.body,
+                content_type=req.header("content-type",
+                                        "binary/octet-stream"),
+                metadata=_meta_headers(req),
+                if_none_match=req.header("if-none-match") == "*",
+            )
+            hdrs = {"etag": f'"{out["etag"]}"'}
+            if out.get("version_id"):
+                hdrs["x-amz-version-id"] = out["version_id"]
+            return 200, hdrs, b""
+        if req.method == "DELETE":
+            if "uploadId" in q:
+                await gw.abort_multipart(bucket, key, q["uploadId"])
+                return 204, {}, b""
+            if "versionId" in q:
+                await gw.delete_object_version(bucket, key,
+                                               q["versionId"])
+                return 204, {}, b""
+            await gw.delete_object(bucket, key)
+            return 204, {}, b""
+        if req.method in ("GET", "HEAD"):
+            if "versionId" in q:
+                got = await gw.get_object_version(bucket, key,
+                                                  q["versionId"])
+                hdrs = _obj_headers(got)
+                hdrs["x-amz-version-id"] = q["versionId"]
+                return 200, hdrs, got["data"]
+            if req.method == "HEAD":
+                entry = await gw.head_object(bucket, key)
+                return 200, _obj_headers({**entry, "data": b""}), b""
+            rng = _parse_range(req.header("range"))
+            if rng is not None and rng[0] == "suffix":
+                size = int((await gw.head_object(bucket, key))["size"])
+                rng = (max(0, size - int(rng[1])), size - 1)
+            got = await gw.get_object(bucket, key, range_=rng)
+            hdrs = _obj_headers(got)
+            if got.get("version_id"):
+                hdrs["x-amz-version-id"] = got["version_id"]
+            if rng is not None:
+                end = min(rng[1], got["size"] - 1)
+                hdrs["content-range"] = \
+                    f"bytes {rng[0]}-{end}/{got['size']}"
+                hdrs["content-length"] = str(len(got["data"]))
+                return 206, hdrs, got["data"]
+            return 200, hdrs, got["data"]
+        raise _HTTPError(405, "MethodNotAllowed", req.method)
+
+    @staticmethod
+    def _xml(root: ET.Element):
+        body = ET.tostring(root, xml_declaration=True,
+                           encoding="unicode").encode()
+        return 200, {"content-type": "application/xml"}, body
+
+
+# -- helpers ---------------------------------------------------------------
+def _ns(tag: str) -> str:
+    return f"{{{XMLNS}}}{tag}"
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts))
+
+
+def _meta_headers(req: _Request) -> dict[str, str]:
+    return {k[len("x-amz-meta-"):]: v for k, v in req.headers.items()
+            if k.startswith("x-amz-meta-")}
+
+
+def _obj_headers(got: dict) -> dict[str, str]:
+    hdrs = {
+        "content-type": got.get("content_type", "binary/octet-stream"),
+        "etag": f'"{got.get("etag", "")}"',
+        "last-modified": formatdate(got.get("mtime", 0.0), usegmt=True),
+        "content-length": str(len(got.get("data", b""))
+                              or got.get("size", 0)),
+    }
+    for k, v in (got.get("meta") or {}).items():
+        hdrs[f"x-amz-meta-{k}"] = str(v)
+    return hdrs
+
+
+def _parse_range(value: str) -> tuple[int, int] | tuple[str, int] | None:
+    """'bytes=a-b' -> (a, b); 'bytes=a-' -> (a, huge); 'bytes=-n' ->
+    ("suffix", n).  Anything malformed (multi-range, garbage) returns
+    None: RFC 7233 allows ignoring Range and serving the full body."""
+    if not value.startswith("bytes="):
+        return None
+    start_s, _, end_s = value[len("bytes="):].partition("-")
+    try:
+        if not start_s:
+            n = int(end_s)
+            return ("suffix", n) if n > 0 else None
+        return int(start_s), int(end_s) if end_s else (1 << 62)
+    except ValueError:
+        return None
+
+
+def _parse_complete(body: bytes) -> list[tuple[int, str]]:
+    doc = ET.fromstring(body.decode())
+    parts: list[tuple[int, str]] = []
+    for el in doc.iter():
+        if not el.tag.endswith("Part"):
+            continue
+        num = el.findtext(_ns("PartNumber")) or \
+            el.findtext("PartNumber") or "0"
+        etag = (el.findtext(_ns("ETag")) or el.findtext("ETag")
+                or "").strip('"')
+        parts.append((int(num), etag))
+    return parts
+
+
+def _parse_lifecycle(body: bytes) -> list[dict]:
+    doc = ET.fromstring(body.decode())
+    rules = []
+    for el in doc.iter():
+        if not el.tag.endswith("Rule"):
+            continue
+        days = el.findtext(f"{_ns('Expiration')}/{_ns('Days')}") or \
+            el.findtext("Expiration/Days") or "0"
+        rules.append({
+            "id": el.findtext(_ns("ID")) or el.findtext("ID") or "",
+            "prefix": (el.findtext(_ns("Prefix"))
+                       or el.findtext("Prefix") or ""),
+            "status": "Enabled", "expiration_days": int(days),
+        })
+    return rules

@@ -62,6 +62,7 @@ class DevCluster:
         self.osds: dict[int, OSDDaemon] = {}
         self.mdss: dict[str, "object"] = {}
         self.mgrs: dict[str, "object"] = {}
+        self.rgws: list["object"] = []
         self._osd_stores: dict[int, ObjectStore] = {}
 
     def conf(self) -> ConfigProxy:
@@ -203,7 +204,35 @@ class DevCluster:
         self.mgrs[name] = mgr
         return mgr
 
+    async def start_rgw(self, pool: str = "rgw", port: int = 0,
+                        host: str = "127.0.0.1"):
+        """Boot an S3 HTTP endpoint over ``pool`` (the radosgw daemon
+        role): returns (frontend, users) — callers mint users
+        through ``users`` and point any SigV4 client at the port."""
+        from ceph_tpu.services.rgw import RGWLite, RGWUsers
+        from ceph_tpu.services.rgw_http import S3Frontend
+
+        rados = await self.client()
+        m = rados.monc.osdmap
+        if pool not in [p.name for p in
+                        (m.pools.values() if m else ())]:
+            r = await rados.mon_command("osd pool create", pool=pool,
+                                        pg_num=8)
+            assert r["rc"] == 0, r
+        ioctx = await rados.open_ioctx(pool)
+        users = RGWUsers(ioctx)
+        gw = RGWLite(ioctx, users=users)
+        fe = S3Frontend(gw, users=users, host=host, port=port)
+        await fe.start()
+        fe._rados = rados
+        self.rgws.append(fe)
+        return fe, users
+
     async def stop(self) -> None:
+        for fe in self.rgws:
+            await fe.stop()
+            await fe._rados.shutdown()
+        self.rgws.clear()
         for mgr in list(self.mgrs.values()):
             task = getattr(mgr, "_report_task", None)
             if task is not None:

@@ -414,3 +414,20 @@ def test_unlink_invalidates_other_link_names():
         assert (await fs.stat("/g"))["nlink"] == 1
         await _teardown(cluster, rados, fs)
     asyncio.run(run())
+
+def test_rename_clobber_invalidates_other_link_names():
+    """rename() onto one name of a hardlinked file must drop the
+    OTHER cached names of the clobbered inode (same staleness class
+    as unlink; the MDS reply carries the unlinked ino)."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.write_file("/x", b"x" * 8)
+        await fs.link("/x", "/y")
+        assert (await fs.stat("/y"))["nlink"] == 2   # cache /y
+        await fs.write_file("/z", b"incoming")
+        await fs.rename("/z", "/x")
+        assert (await fs.stat("/y"))["nlink"] == 1
+        assert await fs.read_file("/y") == b"x" * 8
+        assert await fs.read_file("/x") == b"incoming"
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
