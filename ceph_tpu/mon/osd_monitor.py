@@ -392,7 +392,8 @@ class OSDMonitor(PaxosService):
             pool = PoolInfo(
                 pool_id, name, "erasure", size=n,
                 min_size=int(cmd.get("min_size", min(k + 1, n))),
-                pg_num=pg_num, crush_rule=rule_name, ec_profile=pname,
+                pg_num=pg_num, pgp_num=pg_num,
+                crush_rule=rule_name, ec_profile=pname,
             )
         else:
             size = int(
@@ -403,7 +404,7 @@ class OSDMonitor(PaxosService):
                 or max(1, size - 1)
             pool = PoolInfo(
                 pool_id, name, "replicated", size=size, min_size=min_size,
-                pg_num=pg_num,
+                pg_num=pg_num, pgp_num=pg_num,
                 crush_rule=cmd.get("crush_rule", "replicated_rule"),
             )
         pending.new_pools.append(pool)
@@ -430,7 +431,28 @@ class OSDMonitor(PaxosService):
         elif var == "min_size":
             updated.min_size = int(val)
         elif var == "pg_num":
-            updated.pg_num = int(val)
+            n = int(val)
+            if n < updated.pg_num:
+                return CommandResult(
+                    EINVAL_RC, "pg_num may only increase (PG merging "
+                    "is not supported)")
+            if not updated.pgp_num:
+                # legacy pool in pgp-follows-pg mode: pin placement to
+                # the OLD pg_num or children would move in the same
+                # epoch the split runs (no backfill source)
+                updated.pgp_num = updated.pg_num
+            updated.pg_num = n
+        elif var == "pgp_num":
+            n = int(val)
+            cur_pgp = updated.pgp_num or updated.pg_num
+            if n < cur_pgp:
+                return CommandResult(EINVAL_RC,
+                                     "pgp_num may only increase")
+            if n > updated.pg_num:
+                return CommandResult(
+                    EINVAL_RC, f"pgp_num {n} > pg_num "
+                    f"{updated.pg_num}")
+            updated.pgp_num = n
         elif var == "hit_set_type":
             if val not in ("", "bloom"):
                 return CommandResult(EINVAL_RC,

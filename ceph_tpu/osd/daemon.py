@@ -71,6 +71,7 @@ from ceph_tpu.osd.pg import (
     PGId,
     PeerInfo,
     object_to_ps,
+    split_parent,
 )
 from ceph_tpu.osd.pg_log import OP_DELETE, OP_MODIFY, LogEntry
 from ceph_tpu.services.cls import ClassRegistry, ClsContext, ClsError
@@ -201,6 +202,13 @@ class OSDDaemon:
         self._booted = False
         self._reboot_epoch = 0
         self._map_lock = DLock("osd-map")
+        # pool -> pg_num as of the last map we fully processed, so a
+        # growth is detected exactly once.  PERSISTED in the store's
+        # superblock (the reference's OSDSuperblock role): an OSD that
+        # was down across a pg_num increase must still split on boot,
+        # or parent-stranded objects read ENOENT forever.
+        self._pool_pg_num: dict[int, int] = {}
+        self._superblock_loaded = False
         # perf counters (the l_osd_* set, reference OSD.cc:9659 region)
         self.perf = PerfCounters(self.entity)
         for key in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
@@ -601,6 +609,7 @@ class OSDDaemon:
                 self._handle_sub_reply(msg.data)
             )
         elif t in ("pg_query", "pg_notify", "pg_activate", "log_trim",
+                   "pg_stray", "pg_purge_stray",
                    "osd_ping", "osd_ping_reply") and self.cephx \
                 and not await self._sub_op_sig_ok(msg.data):
             log.derr("%s: dropping unsigned/forged %s from %s",
@@ -611,6 +620,12 @@ class OSDDaemon:
             self._handle_pg_notify(msg.data)
         elif t == "pg_activate":
             self._handle_pg_activate(msg.data)
+        elif t == "pg_stray":
+            self._handle_pg_stray(msg.data)
+        elif t == "pg_purge_stray":
+            asyncio.get_running_loop().create_task(
+                self._handle_pg_purge_stray(msg.data)
+            )
         elif t == "log_trim":
             pgid = PGId(int(msg.data["pgid"][0]), int(msg.data["pgid"][1]))
             asyncio.get_running_loop().create_task(
@@ -675,9 +690,155 @@ class OSDDaemon:
 
             asyncio.get_running_loop().create_task(reboot())
 
+    _SUPER_CID = CollectionId(-1, 0)
+    _SUPER_OID = GHObject(-1, "_osd_superblock")
+
+    def _load_superblock(self) -> None:
+        try:
+            omap = self.store.omap_get(self._SUPER_CID, self._SUPER_OID)
+        except KeyError:
+            omap = {}
+        self._pool_pg_num = {int(k): int(v) for k, v in omap.items()}
+        self._superblock_loaded = True
+
+    async def _save_superblock(self) -> None:
+        tx = StoreTx()
+        try:
+            self.store.list_objects(self._SUPER_CID)
+        except KeyError:
+            tx.create_collection(self._SUPER_CID)
+        tx.touch(self._SUPER_CID, self._SUPER_OID)
+        tx.omap_setkeys(self._SUPER_CID, self._SUPER_OID, {
+            str(pid): str(n).encode()
+            for pid, n in self._pool_pg_num.items()
+        })
+        await self.store.queue_transactions(tx)
+
+    async def _split_pgs(self) -> None:
+        """PG splitting (the reference's PG::split_into +
+        OSD::split_pgs): when a pool's pg_num grows, every locally
+        held parent collection is partitioned — objects whose
+        stable-mod ps moved land in the child collection.  Placement
+        follows pgp_num, which still points children at the parent's
+        OSDs, so the split is purely local; a later pgp_num increase
+        migrates whole children through normal peering/backfill."""
+        if not self._superblock_loaded:
+            self._load_superblock()
+        m = self.osdmap
+        changed = False
+        for pool in m.pools.values():
+            old_n = self._pool_pg_num.get(pool.pool_id, pool.pg_num)
+            if self._pool_pg_num.get(pool.pool_id) != pool.pg_num:
+                self._pool_pg_num[pool.pool_id] = pool.pg_num
+                changed = True
+            if pool.pg_num <= old_n:
+                continue
+            parents = set()
+            for cid in list(self.store.list_collections()):
+                if cid.pool != pool.pool_id or cid.pg >= old_n \
+                        or cid.shard == pg_log.META_SHARD:
+                    continue
+                parents.add(cid.pg)
+                await self._split_collection(cid, old_n, pool.pg_num)
+            for ps in sorted(parents):
+                await self._split_log(pool.pool_id, ps, old_n,
+                                      pool.pg_num)
+        if changed:
+            await self._save_superblock()
+
+    async def _split_collection(self, cid, old_n: int,
+                                new_n: int) -> None:
+        children: set = set()
+        tx = StoreTx()
+        for oid in list(self.store.list_objects(cid)):
+            if oid.name.startswith(("_", "hit_set")):
+                continue              # PG-local metadata stays put
+            new_ps = object_to_ps(oid.name, new_n)
+            if new_ps == cid.pg:
+                continue
+            child = CollectionId(cid.pool, new_ps, cid.shard)
+            if child not in children:
+                children.add(child)
+                try:
+                    self.store.list_objects(child)
+                except KeyError:
+                    tx.create_collection(child)
+            data = self.store.read(cid, oid)
+            tx.touch(child, oid)
+            if data:
+                tx.write(child, oid, 0, data)
+            else:
+                tx.truncate(child, oid, 0)
+            for aname, aval in self.store.getattrs(cid, oid).items():
+                tx.setattr(child, oid, aname, aval)
+            omap = self.store.omap_get(cid, oid)
+            if omap:
+                tx.omap_setkeys(child, oid, omap)
+            tx.remove(cid, oid)
+        if len(tx):
+            await self.store.queue_transactions(tx)
+            log.dout(1, "%s: split %s.%x -> %d children (%d ops)",
+                     self.entity, cid.pool, cid.pg, len(children),
+                     len(tx))
+
+    async def _split_log(self, pool_id: int, ps: int, old_n: int,
+                         new_n: int) -> None:
+        """Give every child a full COPY of the parent's pg_log (tail
+        included) — the reference's PGLog::split_out_child role.
+        Without history a remapped child peers over EMPTY logs,
+        declares itself clean, and split-off objects become
+        unreachable.  A copy (rather than a partition) keeps both logs
+        gap-free: trim's contiguous-prefix safety rule stays intact,
+        and entries for objects that hashed elsewhere are inert — all
+        replicas hold identical copies, so nothing reads as missing,
+        client replay dedup keeps working for moved objects, and the
+        foreign entries age out with normal trimming."""
+        entries, tail = pg_log.read_log(self.store, pool_id, ps)
+        if not entries and not tail:
+            return
+        children = [c for c in range(old_n, new_n)
+                    if split_parent(c, old_n) == ps]
+        tx = StoreTx()
+        for child_ps in children:
+            ccid = pg_log.meta_cid(pool_id, child_ps)
+            try:
+                self.store.list_objects(ccid)
+            except KeyError:
+                tx.create_collection(ccid)
+            for e in entries.values():
+                pg_log.append_ops(tx, pool_id, child_ps, e)
+            tx.setattr(ccid, pg_log.meta_oid(pool_id),
+                       pg_log.TAIL_ATTR, str(tail).encode())
+        if len(tx):
+            await self.store.queue_transactions(tx)
+
+    def _resurrect_strays(self) -> None:
+        """A rebooted OSD may hold collections for PGs the current map
+        assigns entirely elsewhere; without a pg object they would
+        never announce (or be purged) and their data would be
+        unreachable forever."""
+        m = self.osdmap
+        for cid in list(self.store.list_collections()):
+            pool = m.pools.get(cid.pool)
+            if pool is None or cid.shard == pg_log.META_SHARD \
+                    or not 0 <= cid.pg < pool.pg_num:
+                continue
+            pgid = PGId(cid.pool, cid.pg)
+            if pgid in self.pgs:
+                continue
+            up, up_primary, acting, primary = m.pg_to_up_acting(
+                cid.pool, cid.pg)
+            if self.osd_id in acting or self.osd_id in up:
+                continue              # the ownership loop handles it
+            pg = PG(pgid, pool, self.osd_id)
+            pg.state = "stray"
+            self.pgs[pgid] = pg
+
     async def _scan_pgs(self) -> None:
         """Recompute PG ownership from the current map (the load_pgs /
         advance_pg flow)."""
+        await self._split_pgs()
+        self._resurrect_strays()
         m = self.osdmap
         for pool in m.pools.values():
             for ps in range(pool.pg_num):
@@ -695,6 +856,15 @@ class OSDDaemon:
                         if pg.peering_task is not None:
                             pg.peering_task.cancel()
                             pg.peering_task = None
+                    if pg is not None and pg.state == "stray" \
+                        and up_primary != NO_OSD \
+                            and up_primary != self.osd_id:
+                        # a wholesale remap (upmap / pgp_num change)
+                        # can hand a PG to a DISJOINT acting set: the
+                        # new primary peers over empty members unless
+                        # former holders announce themselves
+                        # (reference MNotifyRec from strays)
+                        self._notify_stray(pg, pgid, up_primary)
                     continue
                 if pg is None:
                     pg = PG(pgid, pool, self.osd_id)
@@ -770,6 +940,73 @@ class OSDDaemon:
             pg.backend = None       # replicated path works on the store
 
     # -- peering (primary) ---------------------------------------------------
+    def _notify_stray(self, pg: PG, pgid: PGId, primary: int) -> None:
+        entries, tail = pg_log.read_log(self.store, pgid.pool, pgid.ps)
+        try:
+            if not entries and not self.store.list_objects(
+                    CollectionId(pgid.pool, pgid.ps)):
+                return                    # nothing worth announcing
+        except KeyError:
+            return
+        held = sorted({
+            c.shard for c in self.store.list_collections()
+            if c.pool == pgid.pool and c.pg == pgid.ps
+            and c.shard >= 0
+        })
+        self._send_osd(primary, Message("pg_stray",
+                       self._sign_peer_payload({
+                           "pgid": [pgid.pool, pgid.ps],
+                           "osd": self.osd_id,
+                           "log": {str(seq): e.to_wire()
+                                   for seq, e in entries.items()},
+                           "tail": tail,
+                           "shards": held,
+                       }), priority=PRIO_HIGH))
+
+    def _handle_pg_stray(self, d: dict) -> None:
+        pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
+        pg = self.pgs.get(pgid)
+        if pg is None or not pg.is_primary:
+            return
+        osd = int(d["osd"])
+        if osd in pg.acting:
+            return
+        info = PeerInfo(
+            PG.stray_shard(osd), osd,
+            log={int(s): LogEntry.from_wire(w)
+                 for s, w in d.get("log", {}).items()},
+            tail=int(d.get("tail", 0)),
+        )
+        info.ec_shards = [int(x) for x in d.get("shards", ())]
+        known = pg.stray_sources.get(osd)
+        pg.stray_sources[osd] = info
+        if pg.peering_task is not None and not pg.peering_task.done():
+            pg.record_info(info)          # mid-peer arrival counts too
+        elif known is None or known.head != info.head:
+            # the announcement changes the authoritative picture:
+            # re-peer so recovery can pull from this holder
+            self._schedule_repeer(pg, pg.epoch, delay=0.0)
+
+    async def _handle_pg_purge_stray(self, d: dict) -> None:
+        """The primary finished a clean interval with our data merged:
+        drop the stray copy (reference PG::purge_strays)."""
+        pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
+        pg = self.pgs.get(pgid)
+        if pg is None or pg.state != "stray" \
+                or self.osd_id in pg.acting:
+            return
+        tx = StoreTx()
+        for cid in list(self.store.list_collections()):
+            if cid.pool != pgid.pool or cid.pg != pgid.ps:
+                continue
+            for oid in list(self.store.list_objects(cid)):
+                tx.remove(cid, oid)
+            tx.remove_collection(cid)
+        if len(tx):
+            await self.store.queue_transactions(tx)
+        self.pgs.pop(pgid, None)
+        log.dout(5, "%s: purged stray pg %s", self.entity, pgid)
+
     async def _peer(self, pg: PG) -> None:
         """GetInfo (log windows) -> authoritative log -> missing sets ->
         recover -> activate+merge (the PeeringMachine Primary path,
@@ -787,6 +1024,11 @@ class OSDDaemon:
                 pg.backend.extent_cache.clear()
             local = self._local_info(pg)
             pg.record_info(local)
+            for osd, sinfo in list(pg.stray_sources.items()):
+                if osd in pg.acting:          # promoted since announce
+                    pg.stray_sources.pop(osd, None)
+                    continue
+                pg.record_info(sinfo)
             # an OSD may hold several EC shard positions of one PG: each
             # position gets an info (same log — one log per OSD per PG)
             for shard, osd in enumerate(pg.acting):
@@ -876,6 +1118,13 @@ class OSDDaemon:
             # pre-recovery set would report active+degraded (and a
             # degraded PGMap digest) forever after recovery succeeded
             pg.missing = MissingSet()
+            for osd in list(pg.stray_sources):
+                self._send_osd(osd, Message(
+                    "pg_purge_stray", self._sign_peer_payload({
+                        "pgid": [pg.pgid.pool, pg.pgid.ps],
+                        "epoch": epoch,
+                    }), priority=PRIO_HIGH))
+            pg.stray_sources.clear()
             self._drain_waiters(pg)
             self._kick_snaptrim(pg)
             log.dout(5, "pg %s: active (recovered %d objects)",
@@ -928,7 +1177,7 @@ class OSDDaemon:
             now = time.monotonic()
             if now >= next_query:
                 next_query = now + 1.0
-                for shard, osd in pg.acting_peers():
+                for shard, osd in pg.query_peers():
                     if not want(shard):
                         continue
                     self._send_osd(osd, Message("pg_query", {
@@ -1039,22 +1288,33 @@ class OSDDaemon:
 
     def _inventory(self, pg: PG, shard: int) -> dict[str, int]:
         """name -> version for our shard of this PG (the MOSDPGNotify
-        info payload; versions from object metadata, not pg_log)."""
-        cid = (CollectionId(pg.pgid.pool, pg.pgid.ps, shard) if pg.is_ec
-               else CollectionId(pg.pgid.pool, pg.pgid.ps))
+        info payload; versions from object metadata, not pg_log).  A
+        STRAY answering with its virtual shard id reports the union of
+        whatever shard collections it still holds — the acting-position
+        cid would not exist under the virtual id."""
+        if pg.is_ec and shard <= PG.STRAY_SHARD_BASE:
+            cids = [c for c in self.store.list_collections()
+                    if c.pool == pg.pgid.pool and c.pg == pg.pgid.ps
+                    and c.shard >= 0]
+        elif pg.is_ec:
+            cids = [CollectionId(pg.pgid.pool, pg.pgid.ps, shard)]
+        else:
+            cids = [CollectionId(pg.pgid.pool, pg.pgid.ps)]
         out: dict[str, int] = {}
-        try:
-            objects = self.store.list_objects(cid)
-        except KeyError:
-            return out
-        for oid in objects:
-            if oid.snap != snaps.NOSNAP:
-                continue        # clones recover with their head
+        for cid in cids:
             try:
-                raw = self.store.getattr(cid, oid, VERSION_ATTR)
-                out[oid.name] = int(json.loads(raw)["version"])
-            except (KeyError, ValueError, TypeError):
-                out[oid.name] = 1
+                objects = self.store.list_objects(cid)
+            except KeyError:
+                continue
+            for oid in objects:
+                if oid.snap != snaps.NOSNAP:
+                    continue    # clones recover with their head
+                try:
+                    raw = self.store.getattr(cid, oid, VERSION_ATTR)
+                    ver = int(json.loads(raw)["version"])
+                except (KeyError, ValueError, TypeError):
+                    ver = 1
+                out[oid.name] = max(out.get(oid.name, 0), ver)
         return out
 
     # -- cache tiering (the PrimaryLogPG tiering agent + promote path:
@@ -2125,6 +2385,49 @@ class OSDDaemon:
                     rebuild.setdefault(name, []).append(shard)
                     target_version[name] = entry.obj_version
 
+        stray_pos: dict[int, int] = {}     # EC position -> stray osd
+        for sosd, sinfo in pg.stray_sources.items():
+            for pos in getattr(sinfo, "ec_shards", ()):
+                stray_pos.setdefault(int(pos), sosd)
+
+        async def stray_shard_copy(name: str,
+                                   shards: list[int]) -> bool:
+            """Whole-shard copy from former holders (wholesale remap:
+            nothing among the acting set can reconstruct)."""
+            if not all(t in stray_pos for t in shards):
+                log.derr("pg %s: stray copy %s: positions %s not "
+                         "all announced (%s)", pg.pgid, name, shards,
+                         stray_pos)
+                return False
+            for t in shards:
+                scid = CollectionId(pg.pgid.pool, pg.pgid.ps, t)
+                try:
+                    full = await self.send_sub_op(
+                        stray_pos[t], "read_full",
+                        cid=_enc_cid(scid), oid=name,
+                    )
+                except (KeyError, IOError) as e:
+                    log.derr("pg %s: stray copy %s shard %d from "
+                             "osd.%d failed: %r", pg.pgid, name, t,
+                             stray_pos[t], e)
+                    return False
+                obj = GHObject(pg.pgid.pool, name, shard=t)
+                tx = StoreTx()
+                tx.remove(scid, obj).write(scid, obj, 0, full["data"])
+                for aname, aval in full["attrs"].items():
+                    tx.setattr(scid, obj, aname, aval)
+                if full["omap"]:
+                    tx.omap_setkeys(scid, obj, full["omap"])
+                target = pg.acting[t]
+                if target == self.osd_id:
+                    await self.store.queue_transactions(tx)
+                else:
+                    await self.send_sub_op(target, "tx",
+                                           cid=_enc_cid(scid),
+                                           ops=encode_tx(tx))
+            self.perf.inc("recovery_ops")
+            return True
+
         async def recover_one(name: str, shards: list[int]) -> bool:
             async with sem:
                 if self._use_mclock:
@@ -2141,6 +2444,8 @@ class OSDDaemon:
                     self.perf.inc("recovery_ops")
                     return True
                 except (ShardReadError, IOError, KeyError) as e:
+                    if await stray_shard_copy(name, shards):
+                        return True
                     log.derr("pg %s: recover %s failed: %s",
                              pg.pgid, name, e)
                     return False
@@ -2171,7 +2476,7 @@ class OSDDaemon:
 
         def source_osd(name: str) -> int | None:
             for shard in missing.sources.get(name, ()):
-                osd = pg.acting[shard]
+                osd = pg.shard_osd(shard)
                 if osd not in (self.osd_id, NO_OSD):
                     return osd
             return None
@@ -3313,7 +3618,11 @@ class OSDDaemon:
                     if tx.ops:
                         await self.store.queue_transactions(tx)
                 elif kind == "read_full":
-                    plain = GHObject(cid.pool, str(d["oid"]))
+                    # a sharded cid (EC) stores shard-decorated oids
+                    plain = (GHObject(cid.pool, str(d["oid"]),
+                                      shard=cid.shard)
+                             if cid.shard >= 0
+                             else GHObject(cid.pool, str(d["oid"])))
                     clones = {}
                     for cand in self._clones_of(cid, plain.name):
                         clones[str(cand.snap)] = {

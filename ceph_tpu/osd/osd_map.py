@@ -33,6 +33,7 @@ class PoolInfo:
     size: int = 3                            # replicas, or k+m for EC
     min_size: int = 2
     pg_num: int = 32
+    pgp_num: int = 0            # 0 = follow pg_num (set at create)
     crush_rule: str = "replicated_rule"
     ec_profile: str = ""                     # EC profile name
     snap_seq: int = 0                        # newest allocated snap id
@@ -52,13 +53,18 @@ class PoolInfo:
     def raw_pg_to_pps(self, ps: int) -> int:
         """Placement seed: stable mod then mix with pool id
         (pg_pool_t::raw_pg_to_pps semantics)."""
-        return int(crush_hash32_2(ps % self.pg_num, self.pool_id))
+        from ceph_tpu.osd.pg import ceph_stable_mod, pg_num_mask
+
+        pgp = self.pgp_num or self.pg_num
+        return int(crush_hash32_2(
+            ceph_stable_mod(ps, pgp, pg_num_mask(pgp)), self.pool_id))
 
     def to_dict(self) -> dict:
         return {
             "pool_id": self.pool_id, "name": self.name,
             "type": self.pool_type, "size": self.size,
             "min_size": self.min_size, "pg_num": self.pg_num,
+            "pgp_num": self.pgp_num,
             "crush_rule": self.crush_rule, "ec_profile": self.ec_profile,
             "snap_seq": self.snap_seq,
             "removed_snaps": list(self.removed_snaps),
@@ -80,6 +86,7 @@ class PoolInfo:
             pool_type=d.get("type", "replicated"),
             size=int(d.get("size", 3)), min_size=int(d.get("min_size", 2)),
             pg_num=int(d.get("pg_num", 32)),
+            pgp_num=int(d.get("pgp_num", 0)),
             crush_rule=d.get("crush_rule", "replicated_rule"),
             ec_profile=d.get("ec_profile", ""),
             snap_seq=int(d.get("snap_seq", 0)),

@@ -43,8 +43,29 @@ from ceph_tpu.osd.osd_map import NO_OSD, PoolInfo
 log = Dout("peering")
 
 
+def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
+    """The reference's ceph_stable_mod (common/ceph_hash): modulo that
+    is STABLE under pg_num growth — an object's ps either stays put or
+    moves to exactly one child (ps + 2^k), never elsewhere.  This is
+    what makes PG splitting a local parent->child partition."""
+    return x & bmask if (x & bmask) < b else x & (bmask >> 1)
+
+
+def pg_num_mask(pg_num: int) -> int:
+    return (1 << max(pg_num - 1, 0).bit_length()) - 1
+
+
 def object_to_ps(name: str, pg_num: int) -> int:
-    return ceph_str_hash_rjenkins(name) % pg_num
+    return ceph_stable_mod(ceph_str_hash_rjenkins(name), pg_num,
+                           pg_num_mask(pg_num))
+
+
+def split_parent(ps: int, old_pg_num: int) -> int:
+    """The parent a child ps splits FROM under the stable-mod family:
+    clear high bits until the ps existed at old_pg_num."""
+    while ps >= old_pg_num:
+        ps &= ~(1 << (ps.bit_length() - 1))
+    return ps
 
 
 @dataclass(frozen=True)
@@ -113,6 +134,10 @@ class PG:
         self.primary = NO_OSD
         self.waiting_for_active: list = []   # queued client ops
         self.peer_infos: dict[int, PeerInfo] = {}   # shard -> info
+        # osd -> PeerInfo announced by a NON-acting holder of this PG
+        # (a stray after a wholesale remap); consulted by peering as
+        # an extra authoritative-log/recovery source
+        self.stray_sources: dict[int, PeerInfo] = {}
         self.missing = MissingSet()
         self.peering_task: asyncio.Task | None = None
         self.snaptrim_task: asyncio.Task | None = None
@@ -253,6 +278,29 @@ class PG:
         }
 
     # -- peering bookkeeping (primary) -------------------------------------
+    STRAY_SHARD_BASE = -100     # virtual shard ids for stray sources
+
+    @classmethod
+    def stray_shard(cls, osd: int) -> int:
+        return cls.STRAY_SHARD_BASE - osd
+
+    def shard_osd(self, shard: int) -> int:
+        """Resolve a shard id (acting position OR stray virtual id) to
+        its OSD."""
+        if 0 <= shard < len(self.acting):
+            return self.acting[shard]
+        if shard <= self.STRAY_SHARD_BASE:
+            return self.STRAY_SHARD_BASE - shard
+        return NO_OSD
+
+    def query_peers(self) -> list[tuple[int, int]]:
+        """(shard, osd) pairs peering may query: acting members plus
+        announced stray holders (reference: prior-set members)."""
+        return self.acting_peers() + [
+            (info.shard, info.osd)
+            for info in self.stray_sources.values()
+        ]
+
     def acting_peers(self) -> list[tuple[int, int]]:
         """(shard, osd) pairs for every live acting member but us."""
         return [
@@ -322,6 +370,15 @@ class PG:
                 if appliers < self.ec_k:
                     del auth_log[seq]
         auth_latest = latest_per_object(auth_log)
+        # post-split logs are full parent COPIES: entries for objects
+        # that hash to a sibling PG are inert history, not missing
+        # data — recovering them here would pull objects this PG does
+        # not own (loud, wasted rounds while members process the new
+        # map at different times)
+        auth_latest = {
+            oid: e for oid, e in auth_latest.items()
+            if object_to_ps(oid, self.pool.pg_num) == self.pgid.ps
+        }
         ms.auth_log = auth_log
         ms.auth_tail = auth_tail
 

@@ -489,10 +489,11 @@ class RGWLite:
         out.sort(key=lambda v: v["key"])      # stable: keys ascending
         return out
 
-    async def get_object_version(self, bucket: str, key: str,
-                                 version_id: str) -> dict:
-        """GET ?versionId= — any stored version, marker or not."""
-        await self._check_bucket(bucket, "READ")
+    async def _lookup_version_entry(self, bucket: str, key: str,
+                                    version_id: str) -> dict:
+        """The stored record for key@version_id ('null' falls back to
+        an un-adopted pre-versioning current); raises on markers so
+        GET and HEAD stay bit-identical in their semantics."""
         try:
             kv = await self.ioctx.get_omap(
                 self._versions_oid(bucket),
@@ -517,6 +518,14 @@ class RGWLite:
         if entry.get("delete_marker"):
             raise RGWError("MethodNotAllowed",
                            f"{key}@{version_id} is a delete marker")
+        return entry
+
+    async def get_object_version(self, bucket: str, key: str,
+                                 version_id: str) -> dict:
+        """GET ?versionId= — any stored version, marker or not."""
+        await self._check_bucket(bucket, "READ")
+        entry = await self._lookup_version_entry(bucket, key,
+                                                 version_id)
         oid = entry.get("data_oid", self._data_oid(bucket, key))
         if entry.get("multipart"):
             data = await self._read_manifest(entry["multipart"],
@@ -526,6 +535,14 @@ class RGWLite:
         else:
             data = await self.ioctx.read(oid)
         return {"data": data, **entry}
+
+    async def head_object_version(self, bucket: str, key: str,
+                                  version_id: str) -> dict:
+        """HEAD ?versionId=: the version's metadata without reading
+        its (possibly huge) body."""
+        await self._check_bucket(bucket, "READ")
+        return await self._lookup_version_entry(bucket, key,
+                                                version_id)
 
     async def delete_object_version(self, bucket: str, key: str,
                                     version_id: str) -> None:

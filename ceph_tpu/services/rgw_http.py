@@ -198,6 +198,15 @@ class S3Frontend:
                     status, headers, body = self._error(
                         _STATUS.get(e.code, 400), e.code, str(e)
                     )
+                except (ValueError, ET.ParseError) as e:
+                    # malformed numbers/XML/params from the client:
+                    # a 400, never a dropped connection
+                    status, headers, body = self._error(
+                        400, "InvalidArgument", str(e))
+                except Exception as e:     # noqa: BLE001 — serve 500
+                    log.dout(1, "request failed: %r", e)
+                    status, headers, body = self._error(
+                        500, "InternalError", type(e).__name__)
                 await self._respond(writer, req, status, headers, body,
                                     keep)
                 if not keep:
@@ -566,6 +575,12 @@ class S3Frontend:
             return 204, {}, b""
         if req.method in ("GET", "HEAD"):
             if "versionId" in q:
+                if req.method == "HEAD":
+                    entry = await gw.head_object_version(
+                        bucket, key, q["versionId"])
+                    hdrs = _obj_headers({**entry, "data": b""})
+                    hdrs["x-amz-version-id"] = q["versionId"]
+                    return 200, hdrs, b""
                 got = await gw.get_object_version(bucket, key,
                                                   q["versionId"])
                 hdrs = _obj_headers(got)

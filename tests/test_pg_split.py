@@ -1,0 +1,414 @@
+"""PG splitting: pg_num growth partitions parent PGs into children
+locally (stable-mod hashing keeps moves parent->child only), pgp_num
+then migrates children through normal peering (the reference's
+PG::split_into + pg_num/pgp_num two-step)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.pg import object_to_ps, split_parent
+from ceph_tpu.store import CollectionId
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _wait_clean(rados, pool_name, deadline_s=30):
+    deadline = asyncio.get_running_loop().time() + deadline_s
+    while True:
+        r = await rados.mon_command("status")
+        if r["rc"] == 0 and \
+                r["data"]["health"]["status"] == "HEALTH_OK":
+            return
+        assert asyncio.get_running_loop().time() < deadline, r
+        await asyncio.sleep(0.2)
+
+
+def test_split_preserves_objects_and_partitions():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("data", pg_num=4)
+            io = await rados.open_ioctx("data")
+            model = {}
+            for i in range(60):
+                key = f"obj-{i:03d}"
+                val = f"payload-{i}".encode() * 20
+                model[key] = val
+                await io.write_full(key, val)
+                if i % 3 == 0:
+                    await io.set_omap(key, {"k": str(i).encode()})
+                    await io.set_xattr(key, "tag", b"t")
+
+            pool_id = next(pl.pool_id for pl in
+                           rados.monc.osdmap.pools.values()
+                           if pl.name == "data")
+            r = await rados.mon_command("osd pool set", pool="data",
+                                        var="pg_num", val="8")
+            assert r["rc"] == 0, r
+            # merging is refused
+            r = await rados.mon_command("osd pool set", pool="data",
+                                        var="pg_num", val="2")
+            assert r["rc"] != 0
+            # pgp_num above pg_num is refused
+            r = await rados.mon_command("osd pool set", pool="data",
+                                        var="pgp_num", val="16")
+            assert r["rc"] != 0
+
+            # every object still readable through the client path
+            # (clients now hash over 8 PGs)
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                try:
+                    for key, val in model.items():
+                        assert await io.read(key) == val
+                    break
+                except (IOError, AssertionError):
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+            # omap/xattr rode the split
+            assert (await io.get_omap("obj-003"))["k"] == b"3"
+            assert await io.get_xattr("obj-003", "tag") == b"t"
+
+            # store-level: each object lives in exactly the collection
+            # its NEW ps names, and parents kept only their survivors
+            for osd in osds:
+                for cid in osd.store.list_collections():
+                    if cid.pool != pool_id or cid.shard < -1:
+                        continue       # skip pg-meta collections
+                    for oid in osd.store.list_objects(cid):
+                        if oid.name.startswith(("_", "hit_set")):
+                            continue
+                        assert object_to_ps(oid.name, 8) == cid.pg, \
+                            (cid, oid.name)
+            # both halves are populated (split really happened)
+            child_objs = 0
+            for cid in osds[0].store.list_collections():
+                if cid.pool == pool_id and cid.pg >= 4 \
+                        and cid.shard >= -1:
+                    child_objs += len([
+                        o for o in osds[0].store.list_objects(cid)
+                        if not o.name.startswith(("_", "hit_set"))
+                    ])
+            assert child_objs > 0
+
+            # writes to split-off keys work and land in child PGs
+            await io.write_full("post-split", b"new-data")
+            assert await io.read("post-split") == b"new-data"
+
+            # pgp_num bump migrates children; cluster re-converges and
+            # data survives
+            r = await rados.mon_command("osd pool set", pool="data",
+                                        var="pgp_num", val="8")
+            assert r["rc"] == 0, r
+            await _wait_clean(rados, "data")
+            for key, val in model.items():
+                assert await io.read(key) == val
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_split_ec_pool():
+    """EC parents split per shard collection; k+m placement intact."""
+    async def run():
+        mon, osds, rados = await start_cluster(n_osds=5)
+        try:
+            r = await rados.mon_command(
+                "osd erasure-code-profile set", name="p32",
+                profile={"plugin": "jax_rs", "k": "3", "m": "2",
+                         "crush-failure-domain": "osd"},
+            )
+            assert r["rc"] == 0, r
+            r = await rados.mon_command(
+                "osd pool create", pool="ec", pg_num=2,
+                pool_type="erasure", erasure_code_profile="p32",
+            )
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("ec")
+            model = {}
+            for i in range(24):
+                key = f"e{i:02d}"
+                val = bytes([i]) * 700
+                model[key] = val
+                await io.write_full(key, val)
+
+            r = await rados.mon_command("osd pool set", pool="ec",
+                                        var="pg_num", val="4")
+            assert r["rc"] == 0, r
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                try:
+                    for key, val in model.items():
+                        assert await io.read(key) == val
+                    break
+                except (IOError, AssertionError):
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_stable_mod_split_invariant():
+    """Growth only ever moves an object from a parent to one of that
+    parent's children (the property that makes splitting local)."""
+    for old_n in (1, 2, 3, 4, 6, 8, 11):
+        for new_n in (old_n, old_n + 1, 2 * old_n, 2 * old_n + 5):
+            for i in range(300):
+                a = object_to_ps(f"o-{i}", old_n)
+                b = object_to_ps(f"o-{i}", new_n)
+                assert split_parent(b, old_n) == a
+
+
+def test_split_after_restart():
+    """An OSD that was DOWN while pg_num grew must split on boot: the
+    last-seen pg_num is persisted in the store superblock, not just
+    process memory."""
+    async def run():
+        from ceph_tpu.osd.daemon import OSDDaemon
+        from tests.test_services import fast_conf
+
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("data", pg_num=4)
+            io = await rados.open_ioctx("data")
+            model = {}
+            for i in range(40):
+                key = f"obj-{i:03d}"
+                model[key] = f"v{i}".encode() * 10
+                await io.write_full(key, model[key])
+
+            # osd.2 goes down (store survives); pg_num grows meanwhile
+            store2 = osds[2].store
+            monmap = dict(osds[2].monc.monmap)
+            await osds[2].shutdown()
+            r = await rados.mon_command("osd pool set", pool="data",
+                                        var="pg_num", val="8")
+            assert r["rc"] == 0, r
+            await asyncio.sleep(1.0)
+
+            # reboot osd.2 on the SAME store: its first map processing
+            # must split the stale parent collections
+            osd2 = OSDDaemon(2, monmap, fast_conf(), store=store2,
+                             host="h2")
+            await osd2.start()
+            osds[2] = osd2
+            await asyncio.sleep(1.5)
+            pool_id = next(pl.pool_id for pl in
+                           rados.monc.osdmap.pools.values()
+                           if pl.name == "data")
+            for cid in osd2.store.list_collections():
+                if cid.pool != pool_id or cid.shard < -1:
+                    continue
+                for oid in osd2.store.list_objects(cid):
+                    if oid.name.startswith(("_", "hit_set")):
+                        continue
+                    assert object_to_ps(oid.name, 8) == cid.pg, \
+                        (cid, oid.name)
+            # and the data serves
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                try:
+                    for key, val in model.items():
+                        assert await io.read(key) == val
+                    break
+                except (IOError, AssertionError):
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_remap_to_disjoint_acting_set_recovers_via_strays():
+    """Whole-PG migration to OSDs holding nothing: former holders
+    announce themselves (stray notify), the new acting set recovers
+    from them, and the strays are purged after the clean interval.
+    Exercised here via upmap (the same machinery pgp_num changes and
+    balancer moves ride)."""
+    async def run():
+        mon, osds, rados = await start_cluster(n_osds=6)
+        try:
+            r = await rados.mon_command("osd pool create", pool="app",
+                                        pg_num=2, size=2)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("app")
+            model = {}
+            for i in range(30):
+                key = f"k{i:02d}"
+                model[key] = bytes([65 + i % 26]) * 120
+                await io.write_full(key, model[key])
+
+            pool_id = next(pl.pool_id for pl in
+                           rados.monc.osdmap.pools.values()
+                           if pl.name == "app")
+            # force pg 0 onto a DISJOINT pair via upmap
+            up0 = rados.monc.osdmap.pg_to_up_acting(pool_id, 0)[0]
+            free = [o for o in range(6) if o not in up0][:2]
+            pairs = [[a, b] for a, b in zip(up0, free)]
+            r = await rados.mon_command(
+                "osd pg-upmap-items", pgid=f"{pool_id}.0",
+                mappings=pairs,
+            )
+            assert r["rc"] == 0, r
+
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                try:
+                    for key, val in model.items():
+                        assert await io.read(key) == val, key
+                    break
+                except (IOError, AssertionError):
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.3)
+            # the new holders really hold the pg-0 objects locally
+            from ceph_tpu.store import CollectionId
+            for osd_id in free:
+                objs = {o.name for o in osds[osd_id].store.list_objects(
+                    CollectionId(pool_id, 0))}
+                want = {k for k in model if object_to_ps(k, 2) == 0}
+                assert want <= objs, (osd_id, want - objs)
+            # strays eventually purge their copies
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                leftover = [
+                    o for o in up0
+                    if any(c.pool == pool_id and c.pg == 0 and
+                           c.shard >= -1
+                           for c in osds[o].store.list_collections())
+                ]
+                if not leftover:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(f"strays kept data: {leftover}")
+                await asyncio.sleep(0.3)
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_ec_remap_to_disjoint_set_recovers_via_strays():
+    """EC PGs moved to empty OSDs recover by whole-shard copies from
+    the former holders (parity reconstruction has no acting sources)."""
+    async def run():
+        mon, osds, rados = await start_cluster(n_osds=6)
+        try:
+            r = await rados.mon_command(
+                "osd erasure-code-profile set", name="p21",
+                profile={"plugin": "jax_rs", "k": "2", "m": "1",
+                         "crush-failure-domain": "osd"},
+            )
+            assert r["rc"] == 0, r
+            r = await rados.mon_command(
+                "osd pool create", pool="ec", pg_num=2,
+                pool_type="erasure", erasure_code_profile="p21",
+            )
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("ec")
+            model = {}
+            for i in range(16):
+                key = f"e{i:02d}"
+                model[key] = bytes([97 + i % 26]) * 500
+                await io.write_full(key, model[key])
+
+            pool_id = next(pl.pool_id for pl in
+                           rados.monc.osdmap.pools.values()
+                           if pl.name == "ec")
+            up0 = rados.monc.osdmap.pg_to_up_acting(pool_id, 0)[0]
+            free = [o for o in range(6) if o not in up0][:3]
+            r = await rados.mon_command(
+                "osd pg-upmap-items", pgid=f"{pool_id}.0",
+                mappings=[[a, b] for a, b in zip(up0, free)],
+            )
+            assert r["rc"] == 0, r
+
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                try:
+                    for key, val in model.items():
+                        assert await io.read(key) == val, key
+                    break
+                except (IOError, AssertionError):
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.3)
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_stray_announces_after_reboot():
+    """A former holder that was DOWN across the remap must still serve
+    its data after rebooting: on-disk collections resurrect as stray
+    PGs that announce to the new primary."""
+    async def run():
+        from ceph_tpu.osd.daemon import OSDDaemon
+        from tests.test_services import fast_conf
+
+        mon, osds, rados = await start_cluster(n_osds=5)
+        try:
+            r = await rados.mon_command("osd pool create", pool="app",
+                                        pg_num=2, size=2)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("app")
+            model = {}
+            for i in range(20):
+                key = f"k{i:02d}"
+                model[key] = bytes([48 + i % 10]) * 90
+                await io.write_full(key, model[key])
+
+            pool_id = next(pl.pool_id for pl in
+                           rados.monc.osdmap.pools.values()
+                           if pl.name == "app")
+            up0 = rados.monc.osdmap.pg_to_up_acting(pool_id, 0)[0]
+            # take the whole old acting set down (stores survive),
+            # remap pg 0 to the untouched OSDs, then reboot the old
+            # holders — they come back as strays and hand the data over
+            downed = {o: osds[o].store for o in up0}
+            monmap = dict(osds[0].monc.monmap)
+            for o in up0:
+                await osds[o].shutdown()
+            free = [o for o in range(5) if o not in up0][:2]
+            r = await rados.mon_command(
+                "osd pg-upmap-items", pgid=f"{pool_id}.0",
+                mappings=[[a, b] for a, b in zip(up0, free)],
+            )
+            assert r["rc"] == 0, r
+            await asyncio.sleep(1.0)
+            for o, store in downed.items():
+                nd = OSDDaemon(o, monmap, fast_conf(), store=store,
+                               host=f"h{o}")
+                await nd.start()
+                osds[o] = nd
+
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                try:
+                    for key, val in model.items():
+                        assert await io.read(key) == val, key
+                    break
+                except (IOError, AssertionError):
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.3)
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
