@@ -432,6 +432,9 @@ class OSDMonitor(PaxosService):
             updated.min_size = int(val)
         elif var == "pg_num":
             n = int(val)
+            if n == updated.pg_num:
+                # no-op: do not stage an epoch for an unchanged value
+                return CommandResult(outs=f"pg_num is already {n}")
             if n < updated.pg_num:
                 return CommandResult(
                     EINVAL_RC, "pg_num may only increase (PG merging "
@@ -445,6 +448,8 @@ class OSDMonitor(PaxosService):
         elif var == "pgp_num":
             n = int(val)
             cur_pgp = updated.pgp_num or updated.pg_num
+            if n == cur_pgp:
+                return CommandResult(outs=f"pgp_num is already {n}")
             if n < cur_pgp:
                 return CommandResult(EINVAL_RC,
                                      "pgp_num may only increase")
@@ -453,6 +458,12 @@ class OSDMonitor(PaxosService):
                     EINVAL_RC, f"pgp_num {n} > pg_num "
                     f"{updated.pg_num}")
             updated.pgp_num = n
+        elif var == "pg_autoscale_mode":
+            if val not in ("off", "warn", "on"):
+                return CommandResult(
+                    EINVAL_RC, "pg_autoscale_mode must be "
+                    "off|warn|on")
+            updated.pg_autoscale_mode = str(val)
         elif var == "hit_set_type":
             if val not in ("", "bloom"):
                 return CommandResult(EINVAL_RC,

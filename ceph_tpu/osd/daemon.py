@@ -743,6 +743,8 @@ class OSDDaemon:
             for ps in sorted(parents):
                 await self._split_log(pool.pool_id, ps, old_n,
                                       pool.pg_num)
+                await self._split_snapmapper(pool.pool_id, ps,
+                                             pool.pg_num)
         if changed:
             await self._save_superblock()
 
@@ -751,8 +753,6 @@ class OSDDaemon:
         children: set = set()
         tx = StoreTx()
         for oid in list(self.store.list_objects(cid)):
-            if oid.name.startswith(("_", "hit_set")):
-                continue              # PG-local metadata stays put
             new_ps = object_to_ps(oid.name, new_n)
             if new_ps == cid.pg:
                 continue
@@ -833,6 +833,38 @@ class OSDDaemon:
             pg = PG(pgid, pool, self.osd_id)
             pg.state = "stray"
             self.pgs[pgid] = pg
+
+    async def _split_snapmapper(self, pool_id: int, ps: int,
+                                new_n: int) -> None:
+        """Move snap->clone index keys (the SnapMapper role) with
+        their objects: a clone whose mapper key stays in the parent
+        would never be trimmed after the split (space leak + reads at
+        deleted snaps succeeding)."""
+        try:
+            omap = self.store.omap_get(snaps.mapper_cid(pool_id, ps),
+                                       snaps.mapper_oid(pool_id))
+        except KeyError:
+            return
+        moved: dict[int, dict[str, bytes]] = {}
+        for key, val in omap.items():
+            _, _, name = key.partition("/")
+            new_ps = object_to_ps(name, new_n)
+            if new_ps != ps:
+                moved.setdefault(new_ps, {})[key] = val
+        if not moved:
+            return
+        tx = StoreTx()
+        for child_ps, kv in moved.items():
+            ccid = snaps.mapper_cid(pool_id, child_ps)
+            try:
+                self.store.list_objects(ccid)
+            except KeyError:
+                tx.create_collection(ccid)
+            tx.omap_setkeys(ccid, snaps.mapper_oid(pool_id), kv)
+        tx.omap_rmkeys(snaps.mapper_cid(pool_id, ps),
+                       snaps.mapper_oid(pool_id),
+                       [k for kv in moved.values() for k in kv])
+        await self.store.queue_transactions(tx)
 
     async def _scan_pgs(self) -> None:
         """Recompute PG ownership from the current map (the load_pgs /
@@ -1025,7 +1057,11 @@ class OSDDaemon:
             local = self._local_info(pg)
             pg.record_info(local)
             for osd, sinfo in list(pg.stray_sources.items()):
-                if osd in pg.acting:          # promoted since announce
+                info = (self.osdmap.osds.get(osd)
+                        if self.osdmap else None)
+                if osd in pg.acting or info is None or not info.up:
+                    # promoted since announce, or the stray died: a
+                    # dead source would pin the gather loop forever
                     pg.stray_sources.pop(osd, None)
                     continue
                 pg.record_info(sinfo)
@@ -1069,6 +1105,14 @@ class OSDDaemon:
                 # log gaps: fall back to inventory comparison for those
                 # shards (the backfill path)
                 await self._backfill_plan(pg, epoch, missing)
+                if pg.epoch != epoch:
+                    return
+            if pg.stray_sources:
+                # a post-remap write makes the NEW interval's log
+                # authoritative, hiding everything the strays hold —
+                # reconcile object-by-object or the clean-activation
+                # purge would delete the only copies
+                await self._stray_reconcile(pg, epoch, missing)
                 if pg.epoch != epoch:
                     return
             failures = 0
@@ -1186,6 +1230,58 @@ class OSDDaemon:
                         "shard": shard, "from": self.osd_id,
                     }, priority=PRIO_HIGH))
             await asyncio.sleep(0.01)
+
+    async def _stray_reconcile(self, pg: PG, epoch: int,
+                               missing: MissingSet) -> None:
+        """Pull objects that exist ONLY on stray sources into the
+        acting set before activation.  An object the acting set
+        already holds wins (its state is what clients have been
+        served since the interval started); a stray that does not
+        answer its inventory query is dropped for this round — and
+        must NOT be purged as if consumed."""
+        need_inv = [i.shard for o, i in pg.stray_sources.items()
+                    if pg.peer_infos.get(i.shard) is not None]
+        if not need_inv:
+            return
+
+        def infos_in():
+            return all(pg.peer_infos[s].objects is not None
+                       for s in need_inv)
+
+        try:
+            await asyncio.wait_for(self._gather(
+                pg, epoch, infos_in,
+                lambda shard: (shard in need_inv
+                               and pg.peer_infos.get(shard) is not None
+                               and pg.peer_infos[shard].objects is None),
+                mode="inventory",
+            ), timeout=10.0)
+        except asyncio.TimeoutError:
+            # unanswered strays cannot be trusted as consumed: forget
+            # them (no purge) and continue with who answered
+            for osd, sinfo in list(pg.stray_sources.items()):
+                if pg.peer_infos.get(sinfo.shard) is not None \
+                        and pg.peer_infos[sinfo.shard].objects is None:
+                    pg.stray_sources.pop(osd, None)
+                    pg.peer_infos.pop(sinfo.shard, None)
+        if pg.epoch != epoch:
+            return
+        my_shard = (pg.acting.index(self.osd_id)
+                    if self.osd_id in pg.acting else 0)
+        local_inv = self._inventory(pg, my_shard)
+        for osd, sinfo in pg.stray_sources.items():
+            sinv = (pg.peer_infos.get(sinfo.shard).objects
+                    if pg.peer_infos.get(sinfo.shard) else None) or {}
+            for name, ver in sinv.items():
+                if name in local_inv:
+                    continue          # acting state wins
+                for shard, aosd in enumerate(pg.acting):
+                    if aosd == NO_OSD:
+                        continue
+                    missing.by_shard.setdefault(shard, {})[name] = \
+                        LogEntry(0, 0, name, OP_MODIFY, int(ver))
+                missing.sources.setdefault(name, set()).add(
+                    sinfo.shard)
 
     async def _backfill_plan(self, pg: PG, epoch: int,
                              missing: MissingSet) -> None:
@@ -1635,10 +1731,10 @@ class OSDDaemon:
             )
 
     def _hitset_cid(self, pg: PG) -> CollectionId:
-        return (CollectionId(pg.pgid.pool, pg.pgid.ps,
-                             pg.acting_shard_of(self.osd_id))
-                if pg.is_ec
-                else CollectionId(pg.pgid.pool, pg.pgid.ps))
+        # PG-local stats live in the META collection: the DATA
+        # collections must contain only client objects, or splitting
+        # would have to guess which names are internal
+        return pg_log.meta_cid(pg.pgid.pool, pg.pgid.ps)
 
     async def _hitset_archive(self, pg: PG, hs, start: float) -> None:
         """Persist a filled set; trim archives beyond hit_set_count."""

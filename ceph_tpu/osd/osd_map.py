@@ -34,6 +34,7 @@ class PoolInfo:
     min_size: int = 2
     pg_num: int = 32
     pgp_num: int = 0            # 0 = follow pg_num (set at create)
+    pg_autoscale_mode: str = "warn"     # off | warn | on
     crush_rule: str = "replicated_rule"
     ec_profile: str = ""                     # EC profile name
     snap_seq: int = 0                        # newest allocated snap id
@@ -65,6 +66,7 @@ class PoolInfo:
             "type": self.pool_type, "size": self.size,
             "min_size": self.min_size, "pg_num": self.pg_num,
             "pgp_num": self.pgp_num,
+            "pg_autoscale_mode": self.pg_autoscale_mode,
             "crush_rule": self.crush_rule, "ec_profile": self.ec_profile,
             "snap_seq": self.snap_seq,
             "removed_snaps": list(self.removed_snaps),
@@ -87,6 +89,7 @@ class PoolInfo:
             size=int(d.get("size", 3)), min_size=int(d.get("min_size", 2)),
             pg_num=int(d.get("pg_num", 32)),
             pgp_num=int(d.get("pgp_num", 0)),
+            pg_autoscale_mode=str(d.get("pg_autoscale_mode", "warn")),
             crush_rule=d.get("crush_rule", "replicated_rule"),
             ec_profile=d.get("ec_profile", ""),
             snap_seq=int(d.get("snap_seq", 0)),

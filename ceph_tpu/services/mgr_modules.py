@@ -130,15 +130,54 @@ class Balancer(MgrModule):
 
 
 class PGAutoscaler(MgrModule):
-    """pg_num advisor (reference src/pybind/mgr/pg_autoscaler in warn
-    mode): the ideal PG count per pool is ~100 PGs per OSD spread over
-    the pool's replicas/shards, rounded to a power of two.  PG
-    *splitting* is not implemented in the OSD, so this module only
-    raises health warnings (mode=warn) rather than resizing pools.
+    """pg_num autoscaler (reference src/pybind/mgr/pg_autoscaler):
+    the ideal PG count per pool is ~100 PGs per OSD spread over the
+    pool's replicas/shards, rounded to a power of two.  Pools in
+    warn mode (default) get health warnings; pools set to
+    ``pg_autoscale_mode on`` are resized — pg_num first (a local
+    split), then pgp_num (placement migration) once the split landed.
     """
 
     name = "pg_autoscaler"
     target_per_osd = 100
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._last_cmd: dict[tuple, int] = {}
+
+    async def _apply(self, pool: str, var: str, val: int) -> None:
+        if self._last_cmd.get((pool, var)) == int(val):
+            return                  # waiting for the map to catch up
+        self._last_cmd[(pool, var)] = int(val)
+        try:
+            await self.mgr.monc.command(
+                "osd pool set", pool=pool, var=var, val=str(val))
+        except (ConnectionError, TimeoutError):
+            self._last_cmd.pop((pool, var), None)   # retry next cycle
+
+    async def serve_once(self) -> None:
+        """ACTIVE mode (pool pg_autoscale_mode=on): apply the
+        recommendation the reference's module applies — grow pg_num
+        stepwise (PG splitting is local while pgp_num trails), then
+        advance pgp_num so placement follows."""
+        m = self.mgr.monc.osdmap
+        if m is None:
+            return
+        recs = self._recommendations()
+        for pool in m.pools.values():
+            if pool.pg_autoscale_mode != "on":
+                continue
+            pgp = pool.pgp_num or pool.pg_num
+            if pgp < pool.pg_num:
+                # finish migrating the previous split first
+                await self._apply(pool.name, "pgp_num", pool.pg_num)
+                continue
+            rec = recs.get(pool.name)
+            if rec and rec["kind"] == "few":
+                # bounded step: at most 4x per cycle keeps split +
+                # migration churn digestible
+                await self._apply(pool.name, "pg_num",
+                                  min(rec["ideal"], pool.pg_num * 4))
 
     def _recommendations(self) -> dict[str, dict]:
         m = self.mgr.monc.osdmap
@@ -163,7 +202,11 @@ class PGAutoscaler(MgrModule):
         return out
 
     def health_checks(self) -> dict[str, dict]:
-        recs = self._recommendations()
+        m = self.mgr.monc.osdmap
+        modes = ({p.name: p.pg_autoscale_mode
+                  for p in m.pools.values()} if m else {})
+        recs = {n: r for n, r in self._recommendations().items()
+                if modes.get(n, "warn") == "warn"}
         checks = {}
         few = {n: r for n, r in recs.items() if r["kind"] == "few"}
         if few:

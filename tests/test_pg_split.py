@@ -85,8 +85,6 @@ def test_split_preserves_objects_and_partitions():
                     if cid.pool != pool_id or cid.shard < -1:
                         continue       # skip pg-meta collections
                     for oid in osd.store.list_objects(cid):
-                        if oid.name.startswith(("_", "hit_set")):
-                            continue
                         assert object_to_ps(oid.name, 8) == cid.pg, \
                             (cid, oid.name)
             # both halves are populated (split really happened)
@@ -94,10 +92,8 @@ def test_split_preserves_objects_and_partitions():
             for cid in osds[0].store.list_collections():
                 if cid.pool == pool_id and cid.pg >= 4 \
                         and cid.shard >= -1:
-                    child_objs += len([
-                        o for o in osds[0].store.list_objects(cid)
-                        if not o.name.startswith(("_", "hit_set"))
-                    ])
+                    child_objs += len(
+                        osds[0].store.list_objects(cid))
             assert child_objs > 0
 
             # writes to split-off keys work and land in child PGs
@@ -213,8 +209,6 @@ def test_split_after_restart():
                 if cid.pool != pool_id or cid.shard < -1:
                     continue
                 for oid in osd2.store.list_objects(cid):
-                    if oid.name.startswith(("_", "hit_set")):
-                        continue
                     assert object_to_ps(oid.name, 8) == cid.pg, \
                         (cid, oid.name)
             # and the data serves
@@ -398,6 +392,185 @@ def test_stray_announces_after_reboot():
                 await nd.start()
                 osds[o] = nd
 
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                try:
+                    for key, val in model.items():
+                        assert await io.read(key) == val, key
+                    break
+                except (IOError, AssertionError):
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.3)
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_pg_autoscaler_active_mode():
+    """pg_autoscale_mode=on: the mgr module grows pg_num (split) and
+    then pgp_num (migration) toward the ideal without operator help;
+    warn-mode pools only get health warnings."""
+    async def run():
+        from ceph_tpu.vstart import DevCluster
+
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="auto",
+                                        pg_num=2, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("auto")
+            model = {}
+            for i in range(30):
+                key = f"a{i:02d}"
+                model[key] = bytes([i]) * 64
+                await io.write_full(key, model[key])
+
+            mgr = await cluster.start_mgr()
+            scaler = mgr.modules["pg_autoscaler"]
+            scaler.target_per_osd = 8   # ideal: 3*8//3 = 8 PGs
+            r = await rados.mon_command(
+                "osd pool set", pool="auto",
+                var="pg_autoscale_mode", val="on")
+            assert r["rc"] == 0, r
+
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                pool = next((p for p in
+                             (rados.monc.osdmap.pools.values()
+                              if rados.monc.osdmap else ())
+                             if p.name == "auto"), None)
+                if pool and pool.pg_num == 8 and pool.pgp_num == 8:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    (pool.pg_num if pool else None,
+                     pool.pgp_num if pool else None)
+                await asyncio.sleep(0.3)
+            # data intact through autonomous split + migration
+            for key, val in model.items():
+                assert await io.read(key) == val, key
+            # a warn-mode pool is not touched
+            r = await rados.mon_command("osd pool create", pool="warn",
+                                        pg_num=2, size=3)
+            assert r["rc"] == 0, r
+            await asyncio.sleep(1.0)
+            pool = next(p for p in rados.monc.osdmap.pools.values()
+                        if p.name == "warn")
+            assert pool.pg_num == 2
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_split_moves_internal_looking_names_and_snap_index():
+    """Review regressions: client objects named like internals
+    ('hit_set_x', '_config') split normally (internal state lives in
+    the META collection now), and the snap->clone index moves with its
+    objects so snap trimming still works after a split."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("data", pg_num=2)
+            io = await rados.open_ioctx("data")
+            tricky = ["hit_set_backup", "_config", "_pglog-lookalike"]
+            for name in tricky:
+                await io.write_full(name, b"user-data:" + name.encode())
+            # snapshot + COW clone that will ride the split
+            for i in range(8):
+                await io.write_full(f"s{i}", b"v1" * 40)
+            snap1 = await io.selfmanaged_snap_create()
+            for i in range(8):
+                await io.write_full(f"s{i}", b"v2" * 40)
+
+            r = await rados.mon_command("osd pool set", pool="data",
+                                        var="pg_num", val="8")
+            assert r["rc"] == 0, r
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                try:
+                    for name in tricky:
+                        got = await io.read(name)
+                        assert got == b"user-data:" + name.encode()
+                    break
+                except (IOError, AssertionError):
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+            # snap reads work across the split
+            sio = await rados.open_ioctx("data")
+            sio.snap_set_read(snap1)
+            for i in range(8):
+                assert await sio.read(f"s{i}") == b"v1" * 40
+            sio.snap_set_read(None)
+
+            # removing the snapshot trims every clone, including ones
+            # whose mapper keys moved to child PGs
+            await io.selfmanaged_snap_remove(snap1)
+            from ceph_tpu.osd import snaps as snapsmod
+            deadline = asyncio.get_running_loop().time() + 25
+            while True:
+                leftover = []
+                for osd in osds:
+                    for cid in osd.store.list_collections():
+                        if cid.shard is not None and cid.shard < -1:
+                            continue
+                        for oid in osd.store.list_objects(cid):
+                            if oid.snap != snapsmod.NOSNAP:
+                                leftover.append((osd.osd_id, str(cid),
+                                                 oid.name, oid.snap))
+                if not leftover:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(f"untrimmed clones: "
+                                         f"{leftover[:6]}")
+                await asyncio.sleep(0.3)
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_remap_with_racing_write_keeps_stray_objects():
+    """Review regression: a write landing on the freshly-remapped
+    (empty) acting set must not let clean activation purge the
+    strays' objects — stray inventories reconcile before activation."""
+    async def run():
+        mon, osds, rados = await start_cluster(n_osds=6)
+        try:
+            r = await rados.mon_command("osd pool create", pool="app",
+                                        pg_num=1, size=2)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("app")
+            model = {}
+            for i in range(25):
+                key = f"old{i:02d}"
+                model[key] = bytes([i + 1]) * 150
+                await io.write_full(key, model[key])
+
+            pool_id = next(pl.pool_id for pl in
+                           rados.monc.osdmap.pools.values()
+                           if pl.name == "app")
+            up0 = rados.monc.osdmap.pg_to_up_acting(pool_id, 0)[0]
+            free = [o for o in range(6) if o not in up0][:2]
+            r = await rados.mon_command(
+                "osd pg-upmap-items", pgid=f"{pool_id}.0",
+                mappings=[[a, b] for a, b in zip(up0, free)],
+            )
+            assert r["rc"] == 0, r
+            # race: fire writes at the new acting set immediately
+            for i in range(5):
+                key = f"new{i}"
+                model[key] = b"racer" * 30
+                try:
+                    await asyncio.wait_for(
+                        io.write_full(key, model[key]), 10)
+                except asyncio.TimeoutError:
+                    pass
             deadline = asyncio.get_running_loop().time() + 30
             while True:
                 try:
