@@ -23,6 +23,7 @@ from ceph_tpu.mds.daemon import (
     ELOOP,
     ENOENT,
     ENOTDIR,
+    EROFS,
     block_oid,
 )
 from ceph_tpu.msg.message import Message
@@ -39,12 +40,13 @@ class FileHandle:
     """An open file (Fh): direct data IO + deferred attr flush."""
 
     def __init__(self, fs: "CephFS", parent: int, name: str,
-                 dentry: dict):
+                 dentry: dict, snapid: int = 0):
         self.fs = fs
         self.parent = parent
         self.name = name
         self.ino = int(dentry["ino"])
         self.size = int(dentry.get("size", 0))
+        self.snapid = snapid        # >0: read-only snapshot view
         self._dirty = False
         self._closed = False
 
@@ -63,6 +65,8 @@ class FileHandle:
     async def write(self, data: bytes, offset: int | None = None) -> int:
         if self._closed:
             raise FSError(EINVAL, "closed")
+        if self.snapid:
+            raise FSError(EROFS, "snapshots are read-only")
         if offset is None:
             offset = self.size
         pos = 0
@@ -81,9 +85,11 @@ class FileHandle:
         length = max(0, min(length, self.size - offset))
         out = bytearray(length)
         pos = 0
+        data_io = (await self.fs._snap_data(self.snapid)
+                   if self.snapid else self.fs.data)
         for blockno, off, run in self._extents(offset, length):
             try:
-                frag = await self.fs.data.read(
+                frag = await data_io.read(
                     block_oid(self.ino, blockno), run, off
                 )
             except RadosError as e:
@@ -95,6 +101,8 @@ class FileHandle:
         return bytes(out)
 
     async def truncate(self, size: int) -> None:
+        if self.snapid:
+            raise FSError(EROFS, "snapshots are read-only")
         bs = self.fs.block_size
         if size < self.size:
             first_dead = -(-size // bs)
@@ -174,6 +182,7 @@ class CephFS:
         # (parent_ino, name) -> (dentry, lease expiry): the dentry lease
         # cache (Client::Dentry + lease_ttl role)
         self._dcache: dict[tuple[int, str], tuple[dict, float]] = {}
+        self._snap_ioctx: dict[int, IoCtx] = {}
         self._mounted = False
         # ride the rados client's messenger: register our reply hook
         self._orig_dispatch = rados.ms_dispatch
@@ -225,11 +234,16 @@ class CephFS:
             raise FSError(-110, f"mds request {op}: {e}") from e
         if reply["rc"] != 0:
             raise FSError(reply["rc"], reply.get("err", op))
+        snapc = reply.get("snapc")
+        if snapc and self.data is not None:
+            self.data.set_snap_context(int(snapc.get("seq", 0)),
+                                       [int(x) for x in
+                                        snapc.get("snaps", ())])
         return reply
 
     # -- path walking ------------------------------------------------------
     def _invalidate(self, parent: int, name: str) -> None:
-        self._dcache.pop((parent, name), None)
+        self._dcache.pop((parent, name, 0), None)
 
     def _invalidate_ino(self, ino: int) -> None:
         """Drop every cached dentry of this inode: hard links give one
@@ -239,13 +253,15 @@ class CephFS:
                     if int(v[0].get("ino", 0)) == ino]:
             self._dcache.pop(key, None)
 
-    async def _lookup(self, parent: int, name: str) -> dict:
-        cached = self._dcache.get((parent, name))
+    async def _lookup(self, parent: int, name: str,
+                      snapid: int = 0) -> dict:
+        cached = self._dcache.get((parent, name, snapid))
         if cached is not None and cached[1] > time.monotonic():
             return cached[0]
-        reply = await self._request("lookup", parent=parent, name=name)
+        reply = await self._request("lookup", parent=parent, name=name,
+                                    snapid=snapid)
         dentry = reply["dentry"]
-        self._dcache[(parent, name)] = (
+        self._dcache[(parent, name, snapid)] = (
             dentry, time.monotonic() + float(reply.get("lease", 0)),
         )
         return dentry
@@ -307,8 +323,35 @@ class CephFS:
             return {"ino": self.root, "type": "dir", "mode": 0o755,
                     "size": 0, "mtime": 0.0}
         ino = self.root
-        for i, part in enumerate(parts):
-            dentry = await self._lookup(ino, part)
+        snapid = 0
+        i = 0
+        while i < len(parts):
+            part = parts[i]
+            if part == ".snap":
+                # entering the snapshot namespace of the CURRENT dir
+                # (the CephFS .snap pseudo-directory): the next
+                # component names the snapshot; everything after
+                # resolves against the frozen dirfrags
+                if snapid:
+                    raise FSError(EINVAL, ".snap inside a snapshot")
+                if i + 1 >= len(parts):
+                    return {"ino": ino, "type": "dir", "mode": 0o555,
+                            "size": 0, "mtime": 0.0, "snapdir": True,
+                            "snap_of": ino}
+                reply = await self._request("lssnap", ino=ino)
+                info = reply["snaps"].get(parts[i + 1])
+                if info is None:
+                    raise FSError(ENOENT,
+                                  f"no snapshot {parts[i + 1]!r}")
+                snapid = int(info["snapid"])
+                if i + 1 == len(parts) - 1:
+                    return {"ino": ino, "type": "dir", "mode": 0o555,
+                            "size": 0,
+                            "mtime": float(info["created"]),
+                            "snapid": snapid}
+                i += 2
+                continue
+            dentry = await self._lookup(ino, part, snapid)
             last = i == len(parts) - 1
             if dentry["type"] == "symlink" and (follow or not last):
                 if depth <= 0:
@@ -324,7 +367,39 @@ class CephFS:
                     raise FSError(ENOTDIR,
                                   f"{part!r} is not a directory")
                 ino = int(dentry["ino"])
+            if snapid:
+                dentry = {**dentry, "snapid": snapid}
+            i += 1
         return dentry
+
+    async def _snap_data(self, snapid: int) -> IoCtx:
+        """A data-pool handle whose reads resolve at ``snapid``."""
+        io = self._snap_ioctx.get(snapid)
+        if io is None:
+            io = await self.rados.open_ioctx(self.data.pool_name)
+            io.snap_set_read(snapid)
+            self._snap_ioctx[snapid] = io
+        return io
+
+    async def mksnap(self, path: str, name: str) -> int:
+        """ceph_mksnap: snapshot the subtree at ``path`` (readable as
+        ``path/.snap/name/...``)."""
+        dentry = await self._resolve(path)
+        if dentry["type"] != "dir":
+            raise FSError(ENOTDIR, path)
+        reply = await self._request("mksnap", ino=int(dentry["ino"]),
+                                    name=name)
+        return int(reply["snapid"])
+
+    async def rmsnap(self, path: str, name: str) -> None:
+        dentry = await self._resolve(path)
+        await self._request("rmsnap", ino=int(dentry["ino"]),
+                            name=name)
+
+    async def listsnaps(self, path: str) -> dict[str, dict]:
+        dentry = await self._resolve(path)
+        reply = await self._request("lssnap", ino=int(dentry["ino"]))
+        return reply["snaps"]
 
     # -- the libcephfs-shaped surface --------------------------------------
     async def mkdir(self, path: str, mode: int = 0o755) -> None:
@@ -351,7 +426,16 @@ class CephFS:
         dentry = await self._resolve(path)
         if dentry["type"] != "dir":
             raise FSError(ENOTDIR, path)
-        reply = await self._request("readdir", ino=int(dentry["ino"]))
+        if dentry.get("snapdir"):
+            reply = await self._request("lssnap",
+                                        ino=int(dentry["snap_of"]))
+            return {name: {"ino": dentry["snap_of"], "type": "dir",
+                           "mode": 0o555, "size": 0,
+                           "mtime": float(info["created"])}
+                    for name, info in reply["snaps"].items()}
+        reply = await self._request("readdir", ino=int(dentry["ino"]),
+                                    snapid=int(dentry.get("snapid",
+                                                          0)))
         return reply["entries"]
 
     async def stat(self, path: str) -> dict:
@@ -378,6 +462,16 @@ class CephFS:
                    mode: int = 0o644) -> FileHandle:
         """flags: 'r' read, 'w' create+truncate, 'a' create+append,
         'x' exclusive create."""
+        if ".snap" in self._split(path):
+            dentry = await self._resolve(path)
+            if not dentry.get("snapid"):
+                raise FSError(EISDIR, path)
+            if flags != "r":
+                raise FSError(EROFS, "snapshots are read-only")
+            if dentry["type"] == "dir":
+                raise FSError(EISDIR, path)
+            return FileHandle(self, 0, "", dentry,
+                              snapid=int(dentry["snapid"]))
         parent, name = await self._resolve_parent(path)
         if flags in ("w", "a"):
             # POSIX open(O_CREAT) follows an existing final symlink:

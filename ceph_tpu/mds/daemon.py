@@ -55,10 +55,18 @@ ENOTEMPTY = -39
 ELOOP = -40
 EINVAL = -22
 EPERM = -1
+EROFS = -30
 
 
 def dirfrag_oid(ino: int) -> str:
     return f"{ino:x}.dir"
+
+
+def snap_dirfrag_oid(ino: int, snapid: int) -> str:
+    return f"{ino:x}.dir.snap.{snapid}"
+
+
+SNAPTABLE_OID = "mds_snaptable"
 
 
 def block_oid(ino: int, blockno: int) -> str:
@@ -117,6 +125,8 @@ class MDSDaemon:
         await self.rados.connect(timeout)
         self.meta = await self.rados.open_ioctx(self.meta_pool)
         self.data = await self.rados.open_ioctx(self.data_pool)
+        self.snaps: dict[int, dict] = {}
+        await self._load_snaptable()
         await self._load_table()
         await self._replay_journal()
         # ensure the root dirfrag exists
@@ -183,6 +193,26 @@ class MDSDaemon:
         await self.msgr.shutdown()
 
     # -- journal (MDLog) ---------------------------------------------------
+    async def _load_snaptable(self) -> None:
+        try:
+            omap = await self.meta.get_omap(SNAPTABLE_OID)
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
+            omap = {}
+        self.snaps = {int(k): decode(v) for k, v in omap.items()}
+        self._apply_snapc()
+
+    def _apply_snapc(self) -> None:
+        """Keep the MDS's own data-pool writes (purges) COW-correct
+        under the live snap set."""
+        ids = sorted(self.snaps)
+        self.data.set_snap_context(max(ids, default=0), ids)
+
+    def _snapc_wire(self) -> dict:
+        ids = sorted(self.snaps)
+        return {"seq": max(ids, default=0), "snaps": ids}
+
     async def _load_table(self) -> None:
         try:
             raw = await self.meta.get_xattr(TABLE_OID, "next_ino")
@@ -249,9 +279,12 @@ class MDSDaemon:
         self.journal_len = 0
 
     # -- dirfrag helpers ---------------------------------------------------
-    async def _get_dentry(self, parent: int, name: str) -> dict:
+    async def _get_dentry(self, parent: int, name: str,
+                          snapid: int = 0) -> dict:
+        oid = (snap_dirfrag_oid(parent, snapid) if snapid
+               else dirfrag_oid(parent))
         try:
-            kv = await self.meta.get_omap(dirfrag_oid(parent), [name])
+            kv = await self.meta.get_omap(oid, [name])
         except RadosError as e:
             raise MDSError(ENOENT, f"no dir {parent:x}") \
                 if e.rc == ENOENT else e
@@ -344,6 +377,59 @@ class MDSDaemon:
         elif op == "setattr":
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dict(e["dentry"]))
+        elif op == "mksnap":
+            await self.meta.operate(SNAPTABLE_OID, ObjectOperation()
+                                    .create().omap_set({
+                                        str(int(e["snapid"])):
+                                        encode(dict(e["info"])),
+                                    }))
+            self.snaps[int(e["snapid"])] = dict(e["info"])
+            self._apply_snapc()
+        elif op == "rmsnap":
+            # cleanup lives HERE so journal replay after a crash
+            # re-runs it (idempotent: removals tolerate ENOENT); the
+            # walk follows the snapshot's own FROZEN dirfrags, so a
+            # directory renamed out of the subtree after mksnap is
+            # still found
+            snapid = int(e["snapid"])
+            queue = [int(e["ino"])]
+            seen = set()
+            while queue:
+                dino = queue.pop()
+                if dino in seen:
+                    continue
+                seen.add(dino)
+                try:
+                    kv = await self.meta.get_omap(
+                        snap_dirfrag_oid(dino, snapid))
+                except RadosError as err:
+                    if err.rc != ENOENT:
+                        raise
+                    kv = {}
+                for raw in kv.values():
+                    de = decode(raw)
+                    if de.get("type") == "dir":
+                        queue.append(int(de["ino"]))
+                try:
+                    await self.meta.remove(
+                        snap_dirfrag_oid(dino, snapid))
+                except RadosError as err:
+                    if err.rc != ENOENT:
+                        raise
+            try:
+                await self.data.selfmanaged_snap_remove(snapid)
+            except (RadosError, KeyError, ValueError):
+                pass              # already trimmed on a replay
+            try:
+                await self.meta.operate(
+                    SNAPTABLE_OID,
+                    ObjectOperation().omap_rm([str(snapid)]),
+                )
+            except RadosError as err:
+                if err.rc != ENOENT:
+                    raise
+            self.snaps.pop(snapid, None)
+            self._apply_snapc()
         elif op == "link":
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dict(e["remote_dentry"]))
@@ -515,7 +601,7 @@ class MDSDaemon:
             handler = getattr(self, f"_req_{op}", None)
             if handler is None:
                 raise MDSError(EINVAL, f"unknown mds op {op!r}")
-            if op in ("lookup", "readdir", "session"):
+            if op in ("lookup", "readdir", "session", "lssnap"):
                 result = await handler(d)
             else:
                 async with self._mutate:
@@ -523,6 +609,9 @@ class MDSDaemon:
                     if self.journal_len >= 256:
                         await self._compact_journal()
             reply = {"tid": tid, "rc": 0, **result}
+            # every reply carries the live snapc: clients must COW
+            # data writes under new snaps without a dedicated fetch
+            reply.setdefault("snapc", self._snapc_wire())
         except MDSError as e:
             reply = {"tid": tid, "rc": e.rc, "err": str(e)}
         except RadosError as e:
@@ -541,14 +630,21 @@ class MDSDaemon:
                 "lease": self.lease_ttl}
 
     async def _req_lookup(self, d: dict) -> dict:
-        dentry = await self._get_dentry(int(d["parent"]), str(d["name"]))
-        dentry = await self._resolve_remote(dentry)
-        return {"dentry": dentry, "lease": self.lease_ttl}
+        dentry = await self._get_dentry(int(d["parent"]),
+                                        str(d["name"]),
+                                        int(d.get("snapid", 0)))
+        if not d.get("snapid"):
+            dentry = await self._resolve_remote(dentry)
+        return {"dentry": dentry, "lease": self.lease_ttl,
+                "snapc": self._snapc_wire()}
 
     async def _req_readdir(self, d: dict) -> dict:
         ino = int(d["ino"])
+        snapid = int(d.get("snapid", 0))
         try:
-            kv = await self.meta.get_omap(dirfrag_oid(ino))
+            kv = await self.meta.get_omap(
+                snap_dirfrag_oid(ino, snapid) if snapid
+                else dirfrag_oid(ino))
         except RadosError as e:
             raise MDSError(ENOENT, f"no dir {ino:x}") \
                 if e.rc == ENOENT else e
@@ -631,6 +727,86 @@ class MDSDaemon:
         await self._journal(entry)
         await self._apply(entry)
         return {"dentry": dentry}
+
+    async def _walk_subtree(self, ino: int) -> list[int]:
+        """Directory inos of the subtree rooted at ``ino`` (BFS; -lite
+        scale walks eagerly like the reference's snaprealm open)."""
+        out, queue = [], [ino]
+        while queue:
+            cur = queue.pop()
+            out.append(cur)
+            try:
+                kv = await self.meta.get_omap(dirfrag_oid(cur))
+            except RadosError as e:
+                if e.rc == ENOENT:
+                    continue
+                raise
+            for raw in kv.values():
+                de = decode(raw)
+                if de.get("type") == "dir":
+                    queue.append(int(de["ino"]))
+        return out
+
+    async def _req_mksnap(self, d: dict) -> dict:
+        """Snapshot of the subtree at dir ``ino`` (Server::mksnap):
+        metadata = dirfrag copies under a snap suffix; file data =
+        RADOS self-managed snap, COWed by every client's snapc."""
+        ino, name = int(d["ino"]), str(d["name"])
+        if any(i["name"] == name and int(i["ino"]) == ino
+               for i in self.snaps.values()):
+            raise MDSError(EEXIST, f"snap {name!r} exists")
+        snapid = await self.data.selfmanaged_snap_create()
+        # copy the subtree's dirfrags FIRST (idempotent, unreferenced
+        # until the journal entry lands — a crash leaves only orphans)
+        for dino in await self._walk_subtree(ino):
+            try:
+                kv = await self.meta.get_omap(dirfrag_oid(dino))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+                kv = {}
+            frozen: dict[str, bytes] = {}
+            for dname, raw in kv.items():
+                de = decode(raw)
+                if de.get("remote"):
+                    # hard-link stubs carry no inode attrs and the
+                    # live anchortable may move after the snapshot:
+                    # freeze the resolved inode NOW
+                    try:
+                        de = dict(await self._resolve_remote(de))
+                        de.pop("remote", None)
+                    except MDSError:
+                        pass      # racing unlink: keep the stub
+                frozen[dname] = encode(de)
+            op = ObjectOperation().create()
+            if frozen:
+                op.omap_set(frozen)
+            await self.meta.operate(snap_dirfrag_oid(dino, snapid), op)
+        entry = {"op": "mksnap", "snapid": snapid,
+                 "info": {"name": name, "ino": ino,
+                          "created": time.time()}}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"snapid": snapid, "snapc": self._snapc_wire()}
+
+    async def _req_rmsnap(self, d: dict) -> dict:
+        ino, name = int(d["ino"]), str(d["name"])
+        snapid = next((sid for sid, i in self.snaps.items()
+                       if i["name"] == name and int(i["ino"]) == ino),
+                      None)
+        if snapid is None:
+            raise MDSError(ENOENT, f"no snap {name!r}")
+        entry = {"op": "rmsnap", "snapid": snapid, "ino": ino}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"snapc": self._snapc_wire()}
+
+    async def _req_lssnap(self, d: dict) -> dict:
+        ino = int(d["ino"])
+        return {"snaps": {
+            i["name"]: {"snapid": sid, "created": i["created"]}
+            for sid, i in self.snaps.items() if int(i["ino"]) == ino
+        }, "snapc": self._snapc_wire()}
 
     async def _req_link(self, d: dict) -> dict:
         """Hard link (Server::handle_client_link): a REMOTE dentry at

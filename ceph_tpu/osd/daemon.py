@@ -73,7 +73,12 @@ from ceph_tpu.osd.pg import (
     object_to_ps,
     split_parent,
 )
-from ceph_tpu.osd.pg_log import OP_DELETE, OP_MODIFY, LogEntry
+from ceph_tpu.osd.pg_log import (
+    OP_DELETE,
+    OP_MODIFY,
+    LogEntry,
+    latest_per_object,
+)
 from ceph_tpu.services.cls import ClassRegistry, ClsContext, ClsError
 from ceph_tpu.store import CollectionId, GHObject, MemStore, ObjectStore
 from ceph_tpu.store import Transaction as StoreTx
@@ -1269,17 +1274,24 @@ class OSDDaemon:
         my_shard = (pg.acting.index(self.osd_id)
                     if self.osd_id in pg.acting else 0)
         local_inv = self._inventory(pg, my_shard)
+        # an object the authoritative history DELETED must not be
+        # resurrected from a stale stray's copy
+        deleted = {
+            e.oid for e in latest_per_object(missing.auth_log).values()
+            if e.op == OP_DELETE
+        }
         for osd, sinfo in pg.stray_sources.items():
             sinv = (pg.peer_infos.get(sinfo.shard).objects
                     if pg.peer_infos.get(sinfo.shard) else None) or {}
             for name, ver in sinv.items():
-                if name in local_inv:
-                    continue          # acting state wins
+                if name in local_inv or name in deleted:
+                    continue          # acting state / history wins
                 for shard, aosd in enumerate(pg.acting):
                     if aosd == NO_OSD:
                         continue
-                    missing.by_shard.setdefault(shard, {})[name] = \
-                        LogEntry(0, 0, name, OP_MODIFY, int(ver))
+                    missing.by_shard.setdefault(shard, {}).setdefault(
+                        name, LogEntry(0, 0, name, OP_MODIFY,
+                                       int(ver)))
                 missing.sources.setdefault(name, set()).add(
                     sinfo.shard)
 
@@ -1615,8 +1627,7 @@ class OSDDaemon:
         cid = self._tier_cid(pg)
         try:
             heads = [o.name for o in self.store.list_objects(cid)
-                     if o.snap == snaps.NOSNAP
-                     and not o.name.startswith("hit_set_")]
+                     if o.snap == snaps.NOSNAP]
         except KeyError:
             return
         dirty_attr = XATTR_PREFIX + self.TIER_DIRTY

@@ -431,3 +431,119 @@ def test_rename_clobber_invalidates_other_link_names():
         assert await fs.read_file("/x") == b"incoming"
         await _teardown(cluster, rados, fs)
     asyncio.run(run())
+
+def test_cephfs_snapshots():
+    """.snap directories (reference SnapServer/snaprealm at -lite
+    scale): mksnap freezes a subtree's metadata (dirfrag copies) and
+    data (RADOS self-managed snap + client snapc COW); snapshots are
+    read-only; rmsnap trims both."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.mkdirs("/proj/src")
+        await fs.write_file("/proj/src/main.py", b"print('v1')")
+        await fs.write_file("/proj/notes.txt", b"first draft")
+
+        snapid = await fs.mksnap("/proj", "rel-1")
+        assert snapid > 0
+        assert "rel-1" in await fs.listsnaps("/proj")
+        # mutate AFTER the snapshot: new content, new files, deletions
+        await fs.write_file("/proj/src/main.py", b"print('v2-longer')")
+        await fs.write_file("/proj/src/new.py", b"added later")
+        await fs.unlink("/proj/notes.txt")
+
+        # the live tree shows the new state...
+        assert await fs.read_file("/proj/src/main.py") == \
+            b"print('v2-longer')"
+        with pytest.raises(FSError):
+            await fs.stat("/proj/notes.txt")
+        # ...the snapshot serves the frozen state
+        assert await fs.read_file("/proj/.snap/rel-1/src/main.py") == \
+            b"print('v1')"
+        assert await fs.read_file("/proj/.snap/rel-1/notes.txt") == \
+            b"first draft"
+        with pytest.raises(FSError):
+            await fs.stat("/proj/.snap/rel-1/src/new.py")
+        entries = await fs.readdir("/proj/.snap/rel-1/src")
+        assert sorted(entries) == ["main.py"]
+        # the .snap pseudo-dir lists snapshots
+        assert sorted(await fs.readdir("/proj/.snap")) == ["rel-1"]
+
+        # snapshots are read-only
+        with pytest.raises(FSError) as ei:
+            await fs.write_file("/proj/.snap/rel-1/src/main.py", b"x")
+        assert ei.value.rc == -30   # EROFS
+
+        # a second snapshot captures the new state independently
+        await fs.mksnap("/proj", "rel-2")
+        assert await fs.read_file("/proj/.snap/rel-2/src/new.py") == \
+            b"added later"
+        assert await fs.read_file("/proj/.snap/rel-1/src/main.py") == \
+            b"print('v1')"
+
+        # rmsnap: the name disappears; the other snapshot survives
+        await fs.rmsnap("/proj", "rel-1")
+        with pytest.raises(FSError):
+            await fs.read_file("/proj/.snap/rel-1/src/main.py")
+        assert await fs.read_file("/proj/.snap/rel-2/src/main.py") == \
+            b"print('v2-longer')"
+        await fs.rmsnap("/proj", "rel-2")
+        assert await fs.listsnaps("/proj") == {}
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_cephfs_snapshots_survive_mds_restart():
+    """The snap table and dirfrag copies are RADOS state: a fresh MDS
+    serves existing snapshots after journal replay."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.write_file("/keep.txt", b"frozen")
+        await fs.mksnap("/", "before")
+        await fs.write_file("/keep.txt", b"changed")
+        await fs.unmount()
+        await mds.shutdown()
+        del cluster.mdss["a"]
+        mds2 = await cluster.start_mds(name="b", block_size=4096)
+        fs2 = CephFS(rados, str(mds2.msgr.my_addr))
+        await fs2.mount()
+        assert await fs2.read_file("/.snap/before/keep.txt") == \
+            b"frozen"
+        assert await fs2.read_file("/keep.txt") == b"changed"
+        await fs2.rmsnap("/", "before")
+        await _teardown(cluster, rados, fs2)
+    asyncio.run(run())
+
+def test_snapshots_with_links_and_renames():
+    """Review regressions: hard links freeze with real inode attrs,
+    symlinks resolve inside .snap, and rmsnap cleans frozen dirfrags
+    even when a subdir was renamed out of the subtree after mksnap."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.mkdirs("/proj/sub")
+        await fs.mkdirs("/other")
+        await fs.write_file("/proj/a.txt", b"linked-bytes")
+        await fs.link("/proj/a.txt", "/proj/sub/hard.txt")
+        await fs.symlink("sub", "/proj/lnk")
+        subino = (await fs.stat("/proj/sub"))["ino"]
+
+        await fs.mksnap("/proj", "s1")
+        # hard link reads its frozen content through the snapshot
+        got = await fs.read_file("/proj/.snap/s1/sub/hard.txt")
+        assert got == b"linked-bytes"
+        # ...even after the primary name is gone from the live tree
+        await fs.unlink("/proj/a.txt")
+        assert await fs.read_file("/proj/.snap/s1/sub/hard.txt") == \
+            b"linked-bytes"
+        # relative symlink traversal stays inside the snapshot
+        assert await fs.read_file("/proj/.snap/s1/lnk/hard.txt") == \
+            b"linked-bytes"
+
+        # move the subdir OUT of the snapped subtree, then rmsnap:
+        # the frozen dirfrag for the moved dir must still be cleaned
+        await fs.rename("/proj/sub", "/other/sub")
+        await fs.rmsnap("/proj", "s1")
+        from ceph_tpu.mds.daemon import snap_dirfrag_oid
+        assert await mds.meta.get_omap(
+            snap_dirfrag_oid(subino, 1)) == {}
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
