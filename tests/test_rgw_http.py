@@ -311,3 +311,39 @@ def test_frontend_hardening():
             await stop_cluster(mon, osds, rados)
 
     asyncio.run(run())
+
+
+def test_frontend_malformed_inputs_get_http_errors():
+    """Garbage numbers/XML answer 400 (never a dropped connection);
+    versioned HEAD returns headers without reading the body."""
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        try:
+            await cli.request("PUT", "/b")
+            await cli.request("PUT", "/b?versioning",
+                              b"<VersioningConfiguration><Status>"
+                              b"Enabled</Status>"
+                              b"</VersioningConfiguration>")
+            st, h, _ = await cli.request("PUT", "/b/k", b"d" * 5000)
+            vid = h["x-amz-version-id"]
+
+            st, _, body = await cli.request("GET", "/b?max-keys=abc")
+            assert st == 400
+            assert ET.fromstring(body).findtext("Code") == \
+                "InvalidArgument"
+            st, _, _ = await cli.request(
+                "PUT", "/b/k?partNumber=x&uploadId=u", b"p")
+            assert st == 400
+            st, _, _ = await cli.request("POST", "/b?delete",
+                                         b"<not-xml")
+            assert st == 400
+            # the connection machinery survived all of the above
+            st, h, body = await cli.request(
+                "HEAD", f"/b/k?versionId={vid}")
+            assert st == 200 and body == b""
+            assert h["content-length"] == "5000"
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
