@@ -585,3 +585,57 @@ def test_remap_with_racing_write_keeps_stray_objects():
             await stop_cluster(mon, osds, rados)
 
     asyncio.run(run())
+
+
+def test_split_during_osd_failures():
+    """Chaos: grow pg_num/pgp_num while an OSD dies and revives
+    mid-split. Every acknowledged write must survive the combined
+    split + failure + migration churn."""
+    async def run():
+        from ceph_tpu.vstart import DevCluster
+
+        cluster = DevCluster(n_mons=1, n_osds=4, overrides={
+            "osd_heartbeat_grace": 2.0,
+        })
+        await cluster.start()
+        rados = None
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="app",
+                                        pg_num=4, size=3)
+            assert r["rc"] == 0, r
+            await cluster.wait_health_ok()
+            io = await rados.open_ioctx("app")
+            model = {}
+
+            async def put(tag, n=12):
+                for i in range(n):
+                    key = f"{tag}/{i:03d}"
+                    model[key] = f"{tag}-{i}".encode() * 30
+                    await io.write_full(key, model[key])
+
+            await put("pre")
+            # split while killing an OSD
+            r = await rados.mon_command("osd pool set", pool="app",
+                                        var="pg_num", val="16")
+            assert r["rc"] == 0, r
+            await cluster.kill_osd(3)
+            await put("during-split")
+            # migrate placement while the OSD is still down
+            r = await rados.mon_command("osd pool set", pool="app",
+                                        var="pgp_num", val="16")
+            assert r["rc"] == 0, r
+            await put("during-migrate")
+            await asyncio.sleep(1.0)
+            await cluster.revive_osd(3)
+            await put("post")
+            await cluster.wait_health_ok(60)
+
+            for key, val in model.items():
+                assert await io.read(key) == val, key
+        finally:
+            if rados is not None:
+                await rados.shutdown()
+            await cluster.stop()
+
+    asyncio.run(run())
