@@ -338,6 +338,19 @@ async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
                               render=lambda d: "\n".join(d))
         if sub == "delete":
             return await _mon(rados, "osd pool delete", j, pool=args.pool)
+        if sub == "autoscale-status":
+            def render(d):
+                if not d:
+                    return "all pools within autoscale targets"
+                lines = [f"{'POOL':<20}{'PG_NUM':>8}{'IDEAL':>8}"
+                         f"{'STATE':>8}"]
+                for name, r in sorted(d.items()):
+                    lines.append(f"{name:<20}{r['pg_num']:>8}"
+                                 f"{r['ideal']:>8}{r['kind']:>8}")
+                return "\n".join(lines)
+
+            return await _mon(rados, "osd pool autoscale-status", j,
+                              render=render)
         if sub == "get":
             return await _mon(rados, "osd pool get", j, pool=args.pool)
         if sub == "set":
@@ -586,6 +599,7 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--profile", default="")
     pc.add_argument("--size", type=int, default=0)
     pool_sub.add_parser("ls")
+    pool_sub.add_parser("autoscale-status")
     for name in ("delete", "get"):
         pp = pool_sub.add_parser(name)
         pp.add_argument("pool")

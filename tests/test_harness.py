@@ -65,6 +65,11 @@ def test_cli_end_to_end(tmp_path, capsys):
         assert rc == 0
         rc, out = await ceph("osd", "pool", "ls")
         assert rc == 0 and "clipool" in out
+        rc, out = await ceph("osd", "pool", "set", "clipool",
+                             "pg_num", "16")
+        assert rc == 0
+        rc, out = await ceph("osd", "pool", "autoscale-status")
+        assert rc == 0
         rc, out = await ceph("osd", "erasure-code-profile", "set",
                              "p1", "k=2", "m=1")
         assert rc == 0
