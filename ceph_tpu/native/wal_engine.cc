@@ -51,6 +51,7 @@ uint32_t get_u32(const uint8_t *p) {
 }
 
 int flush_handle(Handle *h) {
+  if (!h->f) return -1;
   if (fflush(h->f) != 0) return -1;
   if (h->sync && fsync(fileno(h->f)) != 0) return -1;
   return 0;
@@ -102,6 +103,7 @@ void *we_open(const char *path, int sync) {
 // (the checkpoint-threshold input) or -1 on error.
 long we_append(void *hv, const uint8_t *payload, size_t len) {
   Handle *h = (Handle *)hv;
+  if (!h->f) return -1;
   uint8_t hdr[8];
   put_u32(hdr, (uint32_t)len);
   put_u32(hdr + 4,
@@ -116,9 +118,12 @@ long we_append(void *hv, const uint8_t *payload, size_t len) {
 // Truncate the WAL back to just its magic (post-checkpoint reset).
 int we_reset(void *hv) {
   Handle *h = (Handle *)hv;
+  // Reopen into a temp FILE* first so a failed fopen leaves the old
+  // handle usable instead of a NULL f that later appends dereference.
+  FILE *nf = fopen(h->path.c_str(), "wb");
+  if (!nf) return -1;
   if (h->f) fclose(h->f);
-  h->f = fopen(h->path.c_str(), "wb");
-  if (!h->f) return -1;
+  h->f = nf;
   fwrite(WAL_MAGIC, 1, WAL_MAGIC_LEN, h->f);
   return flush_handle(h);
 }

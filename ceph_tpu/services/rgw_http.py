@@ -238,7 +238,12 @@ class S3Frontend:
                 continue
             k, _, v = line.partition(":")
             headers[k.strip().lower()] = v.strip()
-        length = int(headers.get("content-length", "0") or "0")
+        try:
+            length = int(headers.get("content-length", "0") or "0")
+        except ValueError:
+            raise _HTTPError(400, "InvalidArgument", "bad content-length")
+        if length < 0:
+            raise _HTTPError(400, "InvalidArgument", "bad content-length")
         if length > _MAX_BODY:
             raise _HTTPError(400, "EntityTooLarge", str(length))
         body = await reader.readexactly(length) if length else b""
@@ -295,10 +300,11 @@ class S3Frontend:
             raise _HTTPError(400, "InvalidArgument", "malformed auth")
         if self.users is None:
             raise _HTTPError(403, "InvalidAccessKeyId", access_key)
+        amz_date = req.header("x-amz-date")
+        self._check_request_time(amz_date, day)
         uid, secret = await self._lookup_key(access_key)
         scope = f"{day}/{region}/s3/aws4_request"
-        sts = sigv4_string_to_sign(req, signed, scope,
-                                   req.header("x-amz-date"))
+        sts = sigv4_string_to_sign(req, signed, scope, amz_date)
         want = hmac.new(_sig_key(secret, day, region, "s3"),
                         sts.encode(), hashlib.sha256).hexdigest()
         if not hmac.compare_digest(want, their_sig):
@@ -311,6 +317,26 @@ class S3Frontend:
             raise _HTTPError(400, "XAmzContentSHA256Mismatch",
                              "payload hash mismatch")
         return uid
+
+    # Reference rgw_auth_s3.cc rejects requests whose signed timestamp
+    # drifts more than RGW_AUTH_GRACE (15 min) from the server clock —
+    # without this a captured signed request replays forever.
+    _SKEW_S = 15 * 60
+
+    def _check_request_time(self, amz_date: str, cred_day: str) -> None:
+        import calendar
+
+        try:
+            ts = calendar.timegm(
+                time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            raise _HTTPError(403, "AccessDenied", "bad x-amz-date")
+        if amz_date[:8] != cred_day:
+            raise _HTTPError(
+                403, "SignatureDoesNotMatch",
+                "credential scope date mismatch")
+        if abs(time.time() - ts) > self._SKEW_S:
+            raise _HTTPError(403, "RequestTimeTooSkewed", amz_date)
 
     async def _lookup_key(self, access_key: str) -> tuple[str, str]:
         from ceph_tpu.services.rgw import KEYS_OID

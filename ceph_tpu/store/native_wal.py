@@ -90,6 +90,8 @@ class NativeWal:
 def replay(path: str) -> list[bytes]:
     """Validated WAL payloads; truncates a torn tail in place."""
     lib = _lib()
+    if lib is None:
+        raise OSError("native wal engine unavailable")
     out = ctypes.c_void_p()
     out_len = ctypes.c_size_t()
     if lib.we_replay(str(path).encode(), ctypes.byref(out),
@@ -113,6 +115,8 @@ def replay(path: str) -> list[bytes]:
 
 def write_checkpoint(path: str, blob: bytes) -> None:
     lib = _lib()
+    if lib is None:
+        raise OSError("native wal engine unavailable")
     if lib.we_write_checkpoint(str(path).encode(), blob,
                                len(blob)) != 0:
         raise OSError(f"we_write_checkpoint({path}) failed")
@@ -121,6 +125,8 @@ def write_checkpoint(path: str, blob: bytes) -> None:
 def read_checkpoint(path: str) -> bytes | None:
     """Validated checkpoint blob, or None (absent/torn: WAL-only)."""
     lib = _lib()
+    if lib is None:
+        raise OSError("native wal engine unavailable")
     out = ctypes.c_void_p()
     out_len = ctypes.c_size_t()
     rc = lib.we_read_checkpoint(str(path).encode(), ctypes.byref(out),
