@@ -233,6 +233,11 @@ class OSDMonitor(PaxosService):
             })
         if name == "osd tree":
             return CommandResult(data=self._tree())
+        if name == "osd crush class ls":
+            return CommandResult(data=self.osdmap.crush.device_classes())
+        if name == "osd crush class ls-osd":
+            return CommandResult(data=self.osdmap.crush.class_devices(
+                str(cmd.get("class", ""))))
         if name == "osd getcrushmap":
             from ceph_tpu.placement.compiler import decompile
 
@@ -283,6 +288,9 @@ class OSDMonitor(PaxosService):
                 return self._cmd_snap_rm(cmd)
             if name in ("osd out", "osd in", "osd down"):
                 return self._cmd_osd_state(name, cmd)
+            if name in ("osd crush set-device-class",
+                        "osd crush rm-device-class"):
+                return self._cmd_device_class(name, cmd)
             if name == "osd crush reweight":
                 osd = int(cmd["id"])
                 self._pending().new_weights[osd] = int(
@@ -386,8 +394,12 @@ class OSDMonitor(PaxosService):
                                  self.osdmap.crush.to_dict()))
                 if rule_name not in new_crush.rules:
                     fd = profile.get("crush-failure-domain", "host")
-                    new_crush.create_ec_rule(rule_name, n,
-                                             failure_domain=fd)
+                    new_crush.create_ec_rule(
+                        rule_name, n, failure_domain=fd,
+                        root=profile.get("crush-root", "default"),
+                        device_class=profile.get("crush-device-class",
+                                                 ""),
+                    )
                 pending.new_crush = new_crush.to_dict()
             pool = PoolInfo(
                 pool_id, name, "erasure", size=n,
@@ -739,6 +751,33 @@ class OSDMonitor(PaxosService):
                     pending.new_down.append(osd)
         return CommandResult(outs=f"{name} {ids}")
 
+    def _cmd_device_class(self, name: str, cmd: dict) -> CommandResult:
+        """``osd crush set-device-class <class> <ids>`` /
+        ``rm-device-class <ids>`` (OSDMonitor.cc device-class commands):
+        tag devices so class-restricted rules (shadow trees) see them."""
+        ids = cmd.get("ids", cmd.get("id"))
+        if ids is None:
+            return CommandResult(-22, "ids required")
+        if not isinstance(ids, (list, tuple)):
+            ids = [ids]
+        cls = str(cmd.get("class", ""))
+        if name.endswith("set-device-class") and not cls:
+            return CommandResult(-22, "class required")
+        pending = self._pending()
+        crush = (CrushMap.from_dict(pending.new_crush)
+                 if pending.new_crush
+                 else CrushMap.from_dict(self.osdmap.crush.to_dict()))
+        done = []
+        for raw in ids:
+            osd = int(str(raw).removeprefix("osd."))
+            crush.set_item_class(
+                osd, cls if name.endswith("set-device-class") else "")
+            done.append(osd)
+        pending.new_crush = crush.to_dict()
+        verb = "set" if name.endswith("set-device-class") else "removed"
+        return CommandResult(
+            outs=f"{verb} class {cls or '(none)'} on osds {done}")
+
     def _tree(self) -> dict:
         """``osd tree`` output: nested buckets + device states."""
         crush = self.osdmap.crush
@@ -762,6 +801,6 @@ class OSDMonitor(PaxosService):
 
         roots = [
             b.id for b in crush.buckets.values()
-            if b.id not in crush._parent
+            if b.id not in crush._parent and not crush.is_shadow(b.id)
         ]
         return {"nodes": [node(r) for r in sorted(roots, reverse=True)]}

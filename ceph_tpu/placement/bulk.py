@@ -37,6 +37,8 @@ def _supported(m: CrushMap, rule: Rule) -> bool:
         return False
     if rule.steps[0][0] != "take" or rule.steps[2][0] != ("emit",)[0]:
         return False
+    if len(rule.steps[0]) > 2 and rule.steps[0][2]:
+        return False        # class-shadow take: scalar fallback
     op = rule.steps[1][0]
     if op not in ("choose_firstn", "chooseleaf_firstn"):
         return False

@@ -7,7 +7,7 @@ that form back, round-tripping every feature our map model supports
 firstn/indep rules).  Grammar follows the reference's map file format:
 
     tunable <name> <value>
-    device <id> osd.<id>
+    device <id> osd.<id> [class <name>]
     type <id> <name>
     <type> <name> {
         id <negative-id>
@@ -17,7 +17,7 @@ firstn/indep rules).  Grammar follows the reference's map file format:
     rule <name> {
         id <n>
         type replicated|erasure
-        step take <bucket>
+        step take <bucket> [class <name>]
         step choose|chooseleaf firstn|indep <n> type <type>
         step emit
     }
@@ -47,7 +47,9 @@ def decompile(m: CrushMap) -> str:
     out.append("")
     out.append("# devices")
     for dev in sorted(_devices_in_use(m)):
-        out.append(f"device {dev} osd.{dev}")
+        cls = m.class_map.get(dev)
+        suffix = f" class {cls}" if cls else ""
+        out.append(f"device {dev} osd.{dev}{suffix}")
     out.append("")
     out.append("# types")
     for tname, tid in sorted(m.types.items(), key=lambda kv: kv[1]):
@@ -70,10 +72,16 @@ def decompile(m: CrushMap) -> str:
 
     for b in sorted(m.buckets.values(), key=lambda b: b.id,
                     reverse=True):
+        if m.is_shadow(b.id):
+            continue                # derived "~class" trees never print
         emit(b)
     for b in ordered:
         out.append(f"{type_names[b.type_id]} {b.name} {{")
         out.append(f"\tid {b.id}")
+        # persistent shadow ids (reference crushtool "id -N class ..."):
+        # they feed draw hashes, so the text form must round-trip them
+        for cls, sid in sorted(m.class_bucket.get(b.id, {}).items()):
+            out.append(f"\tid {sid} class {cls}")
         out.append(f"\talg {b.alg}")
         for item, w in zip(b.items, b.weights):
             iname = (f"osd.{item}" if item >= 0
@@ -90,7 +98,9 @@ def decompile(m: CrushMap) -> str:
         out.append(f"\ttype {kind}")
         for step in r.steps:
             if step[0] == "take":
-                out.append(f"\tstep take {step[1]}")
+                cls = step[2] if len(step) > 2 and step[2] else ""
+                out.append(f"\tstep take {step[1]}"
+                           + (f" class {cls}" if cls else ""))
             elif step[0] == "emit":
                 out.append("\tstep emit")
             else:
@@ -103,6 +113,8 @@ def decompile(m: CrushMap) -> str:
     for name, per_bucket in sorted(m.choose_args.items()):
         out.append(f"choose_args {name} {{")
         for bid, ws in sorted(per_bucket.items(), reverse=True):
+            if m.is_shadow(bid):
+                continue
             ws_txt = " ".join(f"{w / 0x10000:.5f}" for w in ws)
             out.append(f"\tbucket {m.buckets[bid].name} weights {ws_txt}")
         out.append("}")
@@ -112,7 +124,10 @@ def decompile(m: CrushMap) -> str:
 
 
 def _devices_in_use(m: CrushMap) -> set[int]:
-    return {i for b in m.buckets.values() for i in b.items if i >= 0}
+    # classed-but-bucketless devices must still print, or their class
+    # assignment would vanish on a getcrushmap/setcrushmap round trip
+    return {i for b in m.buckets.values()
+            for i in b.items if i >= 0} | set(m.class_map)
 
 
 # -- compile ----------------------------------------------------------------
@@ -125,6 +140,7 @@ def compile_text(text: str) -> CrushMap:
     ]
     tunables = Tunables()
     types: dict[int, str] = {}
+    device_classes: dict[int, str] = {}
     bucket_blocks: list[tuple[str, str, list[list[str]]]] = []
     rule_blocks: list[tuple[str, list[list[str]]]] = []
     ca_blocks: list[tuple[str, list[list[str]]]] = []
@@ -140,7 +156,10 @@ def compile_text(text: str) -> CrushMap:
                 setattr(tunables, tok[1], int(tok[2]))
             i += 1
         elif tok[0] == "device":
-            i += 1                  # devices are implied by bucket items
+            # devices are implied by bucket items; only class sticks
+            if len(tok) >= 5 and tok[3] == "class":
+                device_classes[int(tok[1])] = tok[4]
+            i += 1
         elif tok[0] == "type":
             if len(tok) != 3:
                 raise CompileError(f"bad type line: {lines[i]!r}")
@@ -168,6 +187,8 @@ def compile_text(text: str) -> CrushMap:
             )
     for type_name, name, body in bucket_blocks:
         _compile_bucket(m, type_name, name, body)
+    for dev, cls in device_classes.items():
+        m.set_item_class(dev, cls)
     for name, body in rule_blocks:
         _compile_rule(m, name, body)
     for name, body in ca_blocks:
@@ -198,9 +219,13 @@ def _compile_bucket(m: CrushMap, type_name: str, name: str,
     bid = None
     alg = "straw2"
     items: list[tuple[str, float | None]] = []
+    class_ids: dict[str, int] = {}
     for tok in body:
         if tok[0] == "id":
-            bid = int(tok[1])
+            if len(tok) >= 4 and tok[2] == "class":
+                class_ids[tok[3]] = int(tok[1])
+            else:
+                bid = int(tok[1])
         elif tok[0] == "alg":
             if tok[1] not in ("straw2", "uniform", "list", "tree"):
                 raise CompileError(f"bucket {name!r}: bad alg {tok[1]!r}")
@@ -224,6 +249,10 @@ def _compile_bucket(m: CrushMap, type_name: str, name: str,
         m.buckets[bid] = b
         m.names[name] = bid
         m._next_bucket_id = min(m._next_bucket_id, bid - 1)
+    if class_ids:
+        m.class_bucket[b.id] = class_ids
+        m._next_bucket_id = min(
+            [m._next_bucket_id] + [s - 1 for s in class_ids.values()])
     for iname, w in items:
         if iname.startswith("osd."):
             m.add_item(b, int(iname[4:]), w)
@@ -245,7 +274,13 @@ def _compile_rule(m: CrushMap, name: str, body: list[list[str]]) -> None:
             pass                    # informative; op mode encodes it
         elif tok[0] == "step":
             if tok[1] == "take":
-                steps.append(("take", tok[2]))
+                if len(tok) >= 5 and tok[3] == "class":
+                    steps.append(("take", tok[2], tok[4]))
+                elif len(tok) == 3:
+                    steps.append(("take", tok[2]))
+                else:
+                    raise CompileError(
+                        f"rule {name!r}: bad step {tok!r}")
             elif tok[1] == "emit":
                 steps.append(("emit",))
             elif tok[1] in ("choose", "chooseleaf"):

@@ -67,7 +67,7 @@ class Rule:
     steps: list[tuple]
     rule_id: int = -1
     # step forms:
-    #   ("take", bucket_name)
+    #   ("take", bucket_name[, device_class])
     #   ("choose_firstn" | "chooseleaf_firstn" |
     #    "choose_indep"  | "chooseleaf_indep", num, type_name)
     #   ("emit",)
@@ -91,6 +91,16 @@ class CrushMap:
         self.choose_args: dict[str, dict[int, list[int]]] = {}
         self._active_weights: dict[int, list[int]] | None = None
         self._tree_heap_cache: dict[tuple, tuple[list[int], int]] = {}
+        # device classes (CrushWrapper.h:68 class_map; :458 shadow trees)
+        self.class_map: dict[int, str] = {}     # device id -> class name
+        # orig bucket id -> class -> shadow bucket id.  PERSISTENT (like
+        # the reference's class_bucket): shadow ids feed the draw hashes
+        # through parent items, so they must survive rebuilds and
+        # serialization or class-restricted placement would reshuffle.
+        self.class_bucket: dict[int, dict[str, int]] = {}
+        self._shadow_ids: set[int] = set()      # derived shadow buckets
+        self._shadow_gen: dict[int, int] = {}   # shadow id -> gen built
+        self._topo_gen = 0                      # bumped on any topo edit
 
     # -- construction (builder.c / CrushWrapper facade) ------------------
     def add_type(self, name: str) -> int:
@@ -108,6 +118,7 @@ class CrushMap:
         b = Bucket(bid, self.add_type(type_name), name, alg)
         self.buckets[bid] = b
         self.names[name] = bid
+        self._topo_gen += 1
         return b
 
     def add_item(self, bucket: Bucket | str, item: int | Bucket,
@@ -131,6 +142,7 @@ class CrushMap:
         bucket.items.append(item_id)
         bucket.weights.append(w)
         self._propagate_weight(bucket)
+        self._topo_gen += 1
 
     def _propagate_weight(self, bucket: Bucket) -> None:
         """Refresh ancestors' stored weight for ``bucket`` subtrees."""
@@ -141,16 +153,107 @@ class CrushMap:
             parent.weights[idx] = child.weight
             child = parent
 
+    # -- device classes (CrushWrapper.h:68,458 class-shadow trees) --------
+    def set_item_class(self, device_id: int, class_name: str) -> None:
+        """Assign a device class (``osd crush set-device-class``,
+        CrushWrapper::set_item_class).  Empty name removes the class."""
+        if device_id < 0:
+            raise ValueError("classes apply to devices, not buckets")
+        if class_name:
+            self.class_map[device_id] = str(class_name)
+        else:
+            self.class_map.pop(device_id, None)
+        self._topo_gen += 1
+
+    def get_item_class(self, device_id: int) -> str | None:
+        return self.class_map.get(device_id)
+
+    def class_devices(self, class_name: str) -> list[int]:
+        return sorted(d for d, c in self.class_map.items()
+                      if c == class_name)
+
+    def device_classes(self) -> list[str]:
+        return sorted(set(self.class_map.values()))
+
+    def is_shadow(self, bucket_id: int) -> bool:
+        return bucket_id in self._shadow_ids
+
+    def _class_shadow(self, bucket: Bucket, cls: str) -> Bucket | None:
+        """The class-filtered shadow of ``bucket`` (reference
+        CrushWrapper.h:458 class_bucket / "~class" trees): same shape,
+        only devices of ``cls`` kept, empty subtrees pruned, weights the
+        filtered subtree sums.  Shadows are derived state — rebuilt
+        lazily whenever the real topology or class_map changed, never
+        serialized.  Returns None when the subtree holds no such device.
+        """
+        name = f"{bucket.name}~{cls}"
+        sid = self.class_bucket.get(bucket.id, {}).get(cls)
+        if sid is not None and self._shadow_gen.get(sid) == self._topo_gen:
+            return self.buckets[sid]
+        items: list[int] = []
+        weights: list[int] = []
+        positions: list[int] = []       # original item positions kept
+        for pos, (item, w) in enumerate(zip(bucket.items, bucket.weights)):
+            if item >= 0:
+                if self.class_map.get(item) == cls:
+                    items.append(item)
+                    weights.append(w)
+                    positions.append(pos)
+            else:
+                sub = self._class_shadow(self.buckets[item], cls)
+                if sub is not None:
+                    items.append(sub.id)
+                    weights.append(sub.weight)
+                    positions.append(pos)
+        if sid is not None:
+            self._drop_shadow(sid)
+        if not items:
+            return None
+        if sid is None:
+            sid = self._next_bucket_id
+            self._next_bucket_id -= 1
+            self.class_bucket.setdefault(bucket.id, {})[cls] = sid
+        sb = Bucket(sid, bucket.type_id, name, bucket.alg, items, weights)
+        self.buckets[sid] = sb
+        self.names[name] = sid
+        self._shadow_ids.add(sid)
+        self._shadow_gen[sid] = self._topo_gen
+        # project weight-sets onto the kept positions so the balancer's
+        # choose_args steer class-restricted draws too: device positions
+        # keep their override weight, child positions use the shadow
+        # child's filtered weight (CrushWrapper choose_args size path)
+        for per_bucket in self.choose_args.values():
+            override = per_bucket.get(bucket.id)
+            if override is None or len(override) != len(bucket.items):
+                continue
+            per_bucket[sid] = [
+                override[p] if bucket.items[p] >= 0 else weights[j]
+                for j, p in enumerate(positions)
+            ]
+        return sb
+
+    def _drop_shadow(self, sid: int) -> None:
+        b = self.buckets.pop(sid, None)
+        if b is not None and self.names.get(b.name) == sid:
+            del self.names[b.name]
+        self._shadow_ids.discard(sid)
+        self._shadow_gen.pop(sid, None)
+        for per_bucket in self.choose_args.values():
+            per_bucket.pop(sid, None)
+
     def add_rule(self, rule: Rule) -> Rule:
         rule.rule_id = len(self.rules) if rule.rule_id < 0 else rule.rule_id
         self.rules[rule.name] = rule
         return rule
 
     def create_replicated_rule(
-        self, name: str, failure_domain: str = "host", root: str = "default"
+        self, name: str, failure_domain: str = "host",
+        root: str = "default", device_class: str = "",
     ) -> Rule:
+        take = (("take", root, device_class) if device_class
+                else ("take", root))
         return self.add_rule(Rule(name, [
-            ("take", root),
+            take,
             ("chooseleaf_firstn", 0, failure_domain),
             ("emit",),
         ]))
@@ -169,14 +272,15 @@ class CrushMap:
 
         ``steps``: optional explicit (op, type, n) triples — the LRC
         layered-rule form (reference ErasureCodeLrc.cc parse_rule_step),
-        with op in {"choose", "chooseleaf"} — translated to indep ops."""
-        if device_class:
-            raise NotImplementedError(
-                "crush device classes (class-shadow trees) are not yet "
-                "supported; omit device_class"
-            )
+        with op in {"choose", "chooseleaf"} — translated to indep ops.
+
+        ``device_class``: restrict placement to devices of that class by
+        taking the class-shadow tree (OSDMonitor.cc:9891
+        ``erasure-code-profile set … crush-device-class``)."""
+        take = (("take", root, device_class) if device_class
+                else ("take", root))
         if steps:
-            rule_steps = [("take", root)]
+            rule_steps = [take]
             for op, type_name, n in steps:
                 if op not in ("choose", "chooseleaf"):
                     raise ValueError(f"unknown rule step op {op!r}")
@@ -185,7 +289,7 @@ class CrushMap:
             rule_steps.append(("emit",))
             return self.add_rule(Rule(name, rule_steps))
         return self.add_rule(Rule(name, [
-            ("take", root),
+            take,
             ("chooseleaf_indep", chunk_count, failure_domain),
             ("emit",),
         ]))
@@ -211,6 +315,7 @@ class CrushMap:
                     "weights": list(b.weights),
                 }
                 for b in self.buckets.values()
+                if b.id not in self._shadow_ids   # derived, rebuildable
             ],
             "rules": [
                 {
@@ -222,8 +327,14 @@ class CrushMap:
             "max_device": self.max_device,
             "parent": {str(c): p for c, p in self._parent.items()},
             "choose_args": {
-                name: {str(b): list(w) for b, w in per_bucket.items()}
+                name: {str(b): list(w) for b, w in per_bucket.items()
+                       if b not in self._shadow_ids}
                 for name, per_bucket in self.choose_args.items()
+            },
+            "class_map": {str(d): c for d, c in self.class_map.items()},
+            "class_bucket": {
+                str(b): dict(per_cls)
+                for b, per_cls in self.class_bucket.items()
             },
         }
 
@@ -249,6 +360,16 @@ class CrushMap:
                         for b, w in per_bucket.items()}
             for name, per_bucket in d.get("choose_args", {}).items()
         }
+        m.class_map = {int(dev): str(c)
+                       for dev, c in d.get("class_map", {}).items()}
+        m.class_bucket = {
+            int(b): {str(c): int(sid) for c, sid in per_cls.items()}
+            for b, per_cls in d.get("class_bucket", {}).items()
+        }
+        shadow_ids = [sid for per in m.class_bucket.values()
+                      for sid in per.values()]
+        m._next_bucket_id = min(
+            [m._next_bucket_id] + [s - 1 for s in shadow_ids])
         return m
 
     # -- mapping ---------------------------------------------------------
@@ -315,10 +436,10 @@ class CrushMap:
                    weights: list[int]) -> tuple[list[int], int]:
         """Implicit-heap subtree weights for a tree bucket, cached per
         (bucket, weight vector) so a draw is O(log n), not O(n log n).
-        The cache key uses the weight list's identity + a content
-        fingerprint: bucket.weights mutates in place on add_item, and
-        choose_args vectors are distinct list objects."""
-        key = (bucket.id, id(weights), len(weights), sum(weights))
+        The key is the weight *content*: bucket.weights mutates in place
+        on add_item and choose_args vectors are distinct list objects, so
+        identity/fingerprint keys could alias stale heaps."""
+        key = (bucket.id, tuple(weights))
         cached = self._tree_heap_cache.get(key)
         if cached is not None:
             return cached
@@ -583,7 +704,14 @@ class CrushMap:
                 name = step[1]
                 if name not in self.names:
                     raise KeyError(f"take: unknown bucket {name!r}")
-                w = [self.names[name]]
+                cls = step[2] if len(step) > 2 else ""
+                if cls:
+                    shadow = self._class_shadow(
+                        self.buckets[self.names[name]], cls)
+                    # no device of that class under the root: empty map
+                    w = [] if shadow is None else [shadow.id]
+                else:
+                    w = [self.names[name]]
             elif op == "emit":
                 result.extend(w[: result_max - len(result)])
                 w = []

@@ -188,6 +188,51 @@ def test_replicated_pool_io_and_omap():
     asyncio.run(run())
 
 
+def test_ec_pool_on_device_class():
+    """erasure-code-profile crush-device-class restricts placement to
+    the class-shadow subtree (OSDMonitor.cc:9891 + CrushWrapper.h:458):
+    an ssd-profile EC pool must never place a chunk on an hdd OSD."""
+    async def run():
+        mon, osds, client = await start_cluster(6, pools=[
+            {"prefix": "osd crush set-device-class", "class": "ssd",
+             "ids": [0, 1, 2]},
+            {"prefix": "osd crush set-device-class", "class": "hdd",
+             "ids": [3, 4, 5]},
+            {"prefix": "osd erasure-code-profile set", "name": "pssd",
+             "profile": {"plugin": "jax_rs", "k": "2", "m": "1",
+                         "crush-failure-domain": "osd",
+                         "crush-device-class": "ssd"}},
+            {"prefix": "osd pool create", "pool": "ecssd", "pg_num": 8,
+             "pool_type": "erasure", "erasure_code_profile": "pssd"},
+        ])
+        osdmap = mon.osd_monitor.osdmap
+        pool_id = next(p.pool_id for p in osdmap.pools.values()
+                       if p.name == "ecssd")
+        await wait_active(osds, pool_id)
+        for ps in range(8):
+            _, _, acting, _ = \
+                mon.osd_monitor.osdmap.pg_to_up_acting(pool_id, ps)
+            real = [o for o in acting if o >= 0]
+            assert real and set(real) <= {0, 1, 2}, \
+                f"ps={ps}: hdd osd in acting {acting}"
+        r = await client.op("ecssd", "obj", [
+            {"op": "write", "off": 0, "data": b"classy" * 100},
+        ])
+        assert r["rc"] == 0, r
+        r = await client.op("ecssd", "obj", [{"op": "read", "off": 0}])
+        assert r["results"][0]["data"] == b"classy" * 100
+        cls_ls = await client.monc.command("osd crush class ls")
+        assert cls_ls["data"] == ["hdd", "ssd"]
+        ls_osd = await client.monc.command("osd crush class ls-osd",
+                                           **{"class": "ssd"})
+        assert ls_osd["data"] == [0, 1, 2]
+        await client.shutdown()
+        for o in osds:
+            await o.shutdown()
+        await mon.shutdown()
+    asyncio.run(run())
+
+
 def test_ec_pool_io_round_trip():
     async def run():
         mon, osds, client = await start_cluster(6, pools=[

@@ -217,10 +217,134 @@ def test_top_down_construction_weight_propagation():
     assert len(m.do_rule(rule, 1, 2)) == 2
 
 
-def test_ec_rule_device_class_unsupported():
-    m, _ = _cluster()
-    with pytest.raises(NotImplementedError):
-        m.create_ec_rule("x", 4, device_class="ssd")
+# -- device classes (CrushWrapper.h:68,458 class-shadow trees) -----------
+
+def _classed_cluster():
+    """3 racks x 3 hosts x 2 osds; even osd ids are ssd, odd are hdd."""
+    m, n = _cluster()
+    for d in range(n):
+        m.set_item_class(d, "ssd" if d % 2 == 0 else "hdd")
+    return m, n
+
+
+def test_device_class_restricts_placement():
+    m, n = _classed_cluster()
+    rule = m.create_ec_rule("ec-ssd", 4, failure_domain="host",
+                            device_class="ssd")
+    for x in range(200):
+        out = m.do_rule(rule, x, 4)
+        real = [o for o in out if o != cm.ITEM_NONE]
+        assert real, f"x={x}: empty mapping"
+        assert all(o % 2 == 0 for o in real), f"x={x}: non-ssd in {out}"
+
+
+def test_device_class_replicated_rule():
+    m, n = _classed_cluster()
+    rule = m.create_replicated_rule("rep-hdd", failure_domain="host",
+                                    device_class="hdd")
+    for x in range(100):
+        out = m.do_rule(rule, x, 3)
+        assert len(out) == 3
+        assert all(o % 2 == 1 for o in out)
+
+
+def test_device_class_failure_domains_respected():
+    m, n = _classed_cluster()
+    host_of = {}
+    for b in m.buckets.values():
+        if b.type_id == m.types["host"] and not m.is_shadow(b.id):
+            for it in b.items:
+                host_of[it] = b.name
+    rule = m.create_ec_rule("ec-ssd", 4, failure_domain="host",
+                            device_class="ssd")
+    for x in range(100):
+        real = [o for o in m.do_rule(rule, x, 4) if o != cm.ITEM_NONE]
+        hosts = [host_of[o] for o in real]
+        assert len(set(hosts)) == len(hosts)
+
+
+def test_device_class_missing_class_maps_empty():
+    m, n = _classed_cluster()
+    rule = m.create_ec_rule("ec-nvme", 4, failure_domain="host",
+                            device_class="nvme")
+    out = m.do_rule(rule, 5, 4)
+    assert out in ([], [cm.ITEM_NONE] * 4) or all(
+        o == cm.ITEM_NONE for o in out)
+
+
+def test_device_class_shadow_tracks_topology():
+    """Shadow trees rebuild when devices are added or reclassed."""
+    m, n = _classed_cluster()
+    rule = m.create_replicated_rule("rep-ssd", failure_domain="osd",
+                                    device_class="ssd")
+    seen_before = {o for x in range(300) for o in m.do_rule(rule, x, 2)}
+    assert all(o % 2 == 0 for o in seen_before)
+    # reclass an hdd as ssd: it must become placeable
+    m.set_item_class(1, "ssd")
+    seen_after = {o for x in range(600) for o in m.do_rule(rule, x, 2)}
+    assert 1 in seen_after
+    # and back: it must disappear again
+    m.set_item_class(1, "hdd")
+    seen_final = {o for x in range(300) for o in m.do_rule(rule, x, 2)}
+    assert 1 not in seen_final
+
+
+def test_device_class_stability_within_class():
+    """Mappings for the ssd rule don't move when an hdd device joins —
+    the shadow tree only sees its own class (the whole point of shadow
+    trees vs filtering after the draw)."""
+    m, n = _classed_cluster()
+    rule = m.create_replicated_rule("rep-ssd", failure_domain="host",
+                                    device_class="ssd")
+    before = [m.do_rule(rule, x, 3) for x in range(100)]
+    host0 = m.buckets[m.names["rack0-host0"]]
+    m.add_item(host0, n, 1.0)
+    m.set_item_class(n, "hdd")
+    after = [m.do_rule(rule, x, 3) for x in range(100)]
+    assert before == after
+
+
+def test_device_class_serialization_roundtrip():
+    m, n = _classed_cluster()
+    rule = m.create_ec_rule("ec-ssd", 4, failure_domain="host",
+                            device_class="ssd")
+    out1 = [m.do_rule(rule, x, 4) for x in range(50)]
+    m2 = cm.CrushMap.from_dict(m.to_dict())
+    assert m2.class_map == m.class_map
+    out2 = [m2.do_rule("ec-ssd", x, 4) for x in range(50)]
+    assert out1 == out2
+    # shadow buckets never serialize
+    d = m.to_dict()
+    assert all("~" not in b["name"] for b in d["buckets"])
+
+
+def test_device_class_compiler_roundtrip():
+    from ceph_tpu.placement.compiler import compile_text, decompile
+
+    m, n = _classed_cluster()
+    m.create_ec_rule("ec-ssd", 4, failure_domain="host",
+                     device_class="ssd")
+    out1 = [m.do_rule("ec-ssd", x, 4) for x in range(50)]
+    text = decompile(m)             # carries "id -N class ssd" lines
+    assert "class ssd" in text
+    assert "~" not in text          # shadow buckets themselves don't print
+    m2 = compile_text(text)
+    assert m2.class_map == m.class_map
+    assert m2.class_bucket == m.class_bucket
+    assert [m2.do_rule("ec-ssd", x, 4) for x in range(50)] == out1
+    # round-trip again: decompile(compile(decompile())) is stable
+    assert decompile(m2) == text
+
+
+def test_device_class_take_in_rule_text():
+    from ceph_tpu.placement.compiler import compile_text
+
+    m, _ = _classed_cluster()
+    m.create_ec_rule("e", 4, failure_domain="host", device_class="ssd")
+    from ceph_tpu.placement.compiler import decompile
+    assert "step take default class ssd" in decompile(m)
+    m2 = compile_text(decompile(m))
+    assert m2.rules["e"].steps[0] == ("take", "default", "ssd")
 
 
 def test_take_unknown_bucket():
