@@ -198,12 +198,39 @@ class BitplaneEngine:
     def apply_packets(self, BM: np.ndarray, data, w: int) -> jax.Array:
         """Apply a RAW GF(2) bitmatrix (rows, k*w) in packet layout to
         data (B, k, C) with C % w == 0 (the bit-schedule code path:
-        liberation / blaum_roth / liber8tion / w=16,32 RS)."""
+        liberation / blaum_roth / liber8tion / w=16,32 RS).
+
+        Fast path: an XOR schedule over packets IS a GF(2^8) coefficient
+        matrix with entries in {0, 1} acting on packet rows (coefficient
+        1 = the 8x8 identity bitmatrix), so the data reshaped to
+        (B, k*w, C/w) packet rows feeds the same Pallas shard kernel as
+        the GF(2^8) codes — int32 lanes, int8 MXU contraction, no bf16
+        bit-plane inflation.  Wide matrices (w=16/32 RS) run blocked
+        over the contraction dim."""
+        from ceph_tpu.ec.pallas_kernels import shard_kernel_supported
+
         BM = np.asarray(BM, np.uint8)
         data = jnp.asarray(data, jnp.uint8)
         squeeze = data.ndim == 2
         if squeeze:
             data = data[None]
+        B, k, C = data.shape
+        pkt = C // w
+        rows = BM.shape[0]
+        if (
+            self.use_pallas
+            and pkt % 4 == 0
+            and rows % w == 0
+            and shard_kernel_supported(BM.shape[1], rows)
+        ):
+            applier = self._pallas_applier(BM)
+            flat = data.reshape(B, k * w, pkt)
+            flat = jnp.transpose(flat, (1, 0, 2)).reshape(k * w, B * pkt)
+            par = applier(flat)                      # (rows, B*pkt) bytes
+            out = jnp.transpose(
+                par.reshape(rows, B, pkt), (1, 0, 2)
+            ).reshape(B, rows // w, C)
+            return out[0] if squeeze else out
         mat = self._device_raw_bitmatrix(BM)
         out = packet_bitmatrix_apply(mat, data, w)
         return out[0] if squeeze else out

@@ -44,16 +44,21 @@ LANE = 128          # int32 lanes per tile row must be a multiple of this
 LANE_BYTES = 4      # bytes packed per int32 lane
 DEFAULT_TILE = 8192  # int32 lanes per grid step (32 KiB of data per row)
 
-# Largest (32m x 32k) int8 matrix we keep resident in VMEM (1 MiB).
+# Largest int8 matrix BLOCK kept resident in VMEM per grid step (1 MiB).
+# Bigger matrices (wide-symbol w=16/32 bitmatrices) run the same kernel
+# blocked over the contraction dim with XOR accumulation in the output.
 _MAX_MATRIX_BYTES = 1 << 20
+# Budget for the (32*mout, tile) int32 accumulator produced by the MXU.
+_ACC_BUDGET_BYTES = 4 << 20
 
 
 def shard_kernel_supported(kin: int, mout: int) -> bool:
-    return (32 * kin) * (32 * mout) <= _MAX_MATRIX_BYTES
+    return _pick_kblk(kin, mout) > 0
 
 
 def _kernel(bm_ref, data_ref, out_ref, *, mout):
-    d = data_ref[:]  # (k, T) int32
+    kb = pl.program_id(1)
+    d = data_ref[:]  # (kblk, T) int32
     kin, T = d.shape
     shift = jax.lax.broadcasted_iota(jnp.int32, (1, 32, 1), 1)
     # (k, 32, T): plane 8b+p of chunk i -> row 32i + 8b + p after collapse.
@@ -63,31 +68,59 @@ def _kernel(bm_ref, data_ref, out_ref, *, mout):
     )
     accb = (acc & 1).reshape(mout, 32, T)
     # Disjoint bit positions: sum == OR, exact even into the sign bit.
-    out_ref[:] = jnp.sum(accb << shift, axis=1)
+    partial = jnp.sum(accb << shift, axis=1)
+
+    @pl.when(kb == 0)
+    def _init():
+        out_ref[:] = partial
+
+    @pl.when(kb > 0)
+    def _accum():
+        # GF(2) accumulation across contraction blocks.
+        out_ref[:] = out_ref[:] ^ partial
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
-def _pallas_apply_words(bm32, words, *, tile, interpret=False):
+@functools.partial(jax.jit, static_argnames=("tile", "kblk", "interpret"))
+def _pallas_apply_words(bm32, words, *, tile, kblk, interpret=False):
     kin, n4 = words.shape
     mout = bm32.shape[0] // 32
+    kblocks = kin // kblk
     return pl.pallas_call(
         functools.partial(_kernel, mout=mout),
-        grid=(n4 // tile,),
+        # kb is the fast axis: all contraction blocks of one output tile
+        # run consecutively, so the XOR accumulation revisits a resident
+        # out block.
+        grid=(n4 // tile, kblocks),
         in_specs=[
-            pl.BlockSpec(bm32.shape, lambda t: (0, 0),
+            pl.BlockSpec((bm32.shape[0], 32 * kblk), lambda t, kb: (0, kb),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((kin, tile), lambda t: (0, t),
+            pl.BlockSpec((kblk, tile), lambda t, kb: (kb, t),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((mout, tile), lambda t: (0, t),
+        out_specs=pl.BlockSpec((mout, tile), lambda t, kb: (0, t),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((mout, n4), jnp.int32),
         interpret=interpret,
     )(bm32, words)
 
 
-def _pick_tile(n4: int) -> int:
+def _pick_kblk(kin: int, mout: int) -> int:
+    """Contraction symbols per block: the (32*mout, 32*kblk) int8 matrix
+    block must fit _MAX_MATRIX_BYTES.  When blocking (kblk < kin), the
+    Mosaic lowering needs block dims divisible by (8, 128), so kblk must
+    be a multiple of 8 (32*8 = 256 lane columns).  Returns 0 when even
+    one 8-symbol block exceeds the budget (kernel unsupported)."""
+    if 32 * mout * 32 * kin <= _MAX_MATRIX_BYTES:
+        return kin                          # whole matrix in one block
+    kblk = (_MAX_MATRIX_BYTES // (32 * mout * 32)) // 8 * 8
+    return min(kblk, kin // 8 * 8)
+
+
+def _pick_tile(n4: int, mout: int) -> int:
     t = DEFAULT_TILE
+    # MXU accumulator is (32*mout, tile) int32: stay inside the budget.
+    while t > LANE and 32 * mout * t * 4 > _ACC_BUDGET_BYTES:
+        t //= 2
     while t > LANE and n4 % t:
         t //= 2
     return t
@@ -131,6 +164,12 @@ class PallasShardApply:
         # trace, so constructing the applier inside an outer jit never
         # leaks a tracer.
         bm32 = bm.expand_bitmatrix_lanes(bm.gf_matrix_to_bitmatrix(coeff))
+        self.kblk = _pick_kblk(self.kin, self.mout)
+        self.kpad = -(-self.kin // self.kblk) * self.kblk
+        if self.kpad != self.kin:
+            # zero-pad contraction columns to a whole number of blocks;
+            # the matching zero data rows contribute nothing
+            bm32 = np.pad(bm32, ((0, 0), (0, 32 * (self.kpad - self.kin))))
         self.bm32 = np.asarray(bm32, np.int8)
         self._bm32_dev: jax.Array | None = None
         self.interpret = interpret
@@ -150,11 +189,12 @@ class PallasShardApply:
         if kin != self.kin:
             raise ValueError(f"expected {self.kin} chunk rows, got {kin}")
         pad = (-n4) % LANE
-        if pad:
-            words = jnp.pad(words, ((0, 0), (0, pad)))
+        rpad = self.kpad - self.kin
+        if pad or rpad:
+            words = jnp.pad(words, ((0, rpad), (0, pad)))
         out = _pallas_apply_words(
-            self._bm32_arg(), words, tile=_pick_tile(n4 + pad),
-            interpret=self.interpret,
+            self._bm32_arg(), words, tile=_pick_tile(n4 + pad, self.mout),
+            kblk=self.kblk, interpret=self.interpret,
         )
         return out[:, :n4] if pad else out
 

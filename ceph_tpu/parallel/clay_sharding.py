@@ -18,8 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ceph_tpu.ec import bitmatrix as bm
-from ceph_tpu.ec.engine import bitplane_apply
+from ceph_tpu.ec.engine import default_engine
 from ceph_tpu.ec.repair_operator import clay_repair_operator
 
 shard_map = jax.shard_map
@@ -40,7 +39,7 @@ def sharded_clay_repair(mesh, ec, chunks, lost: int) -> jax.Array:
     if C % ec.sub_chunk_no:
         raise ValueError(f"C={C} not a multiple of {ec.sub_chunk_no}")
     R, helpers, planes = clay_repair_operator(ec, lost)
-    rbits = jnp.asarray(bm.gf_matrix_to_bitmatrix(R), jnp.bfloat16)
+    eng = default_engine()
     planes_np = np.asarray(planes, np.int64)
     helpers_np = np.asarray(helpers, np.int64)
     sub = ec.sub_chunk_no
@@ -60,7 +59,9 @@ def sharded_clay_repair(mesh, ec, chunks, lost: int) -> jax.Array:
             full = jax.lax.all_gather(local, "cs", axis=1, tiled=True)
             helper = full[:, helpers_np]  # (b, d, P, sc) — drops the lost
             flat = helper.reshape(b, d * pcnt, C // sub)
-            rec = bitplane_apply(rbits, flat)  # (b, sub, sc)
+            # Engine dispatch: Pallas shard kernel on TPU (int32 lanes,
+            # int8 MXU), bit-identical XLA einsum elsewhere.
+            rec = eng.apply(R, flat)  # (b, sub, sc)
             return rec.reshape(b, C)
 
         return shard_map(

@@ -27,9 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 shard_map = jax.shard_map
 
-from ceph_tpu.ec import bitmatrix as bm
 from ceph_tpu.ec import reference
-from ceph_tpu.ec.engine import bitplane_apply as _apply_bits
+from ceph_tpu.ec.engine import default_engine
 
 
 def make_ec_mesh(devices=None, cs: int = 1) -> Mesh:
@@ -42,18 +41,15 @@ def make_ec_mesh(devices=None, cs: int = 1) -> Mesh:
     return Mesh(arr, ("dp", "cs"))
 
 
-def _encode_bits_matrix(generator: np.ndarray) -> jnp.ndarray:
-    k = generator.shape[1]
-    return jnp.asarray(bm.gf_matrix_to_bitmatrix(generator[k:]), jnp.bfloat16)
-
-
 def sharded_encode(mesh: Mesh, generator: np.ndarray, data) -> jax.Array:
     """Encode a stripe batch sharded over every mesh device.
 
     data: (B, k, C) uint8, B divisible by the total device count.
     Returns (B, k+m, C), batch-sharded the same way.
     """
-    mat = _encode_bits_matrix(generator)
+    k = generator.shape[1]
+    parity_coeff = np.asarray(generator[k:], np.uint8)
+    eng = default_engine()
     batch_spec = P(("dp", "cs"), None, None)
     data = jax.device_put(
         jnp.asarray(data, jnp.uint8), NamedSharding(mesh, batch_spec)
@@ -62,7 +58,8 @@ def sharded_encode(mesh: Mesh, generator: np.ndarray, data) -> jax.Array:
     @jax.jit
     def step(d):
         def local(d_blk):
-            parity = _apply_bits(mat, d_blk)
+            # Engine dispatch: Pallas shard kernel on TPU, einsum on CPU.
+            parity = eng.apply(parity_coeff, d_blk)
             return jnp.concatenate([d_blk, parity], axis=1)
 
         return shard_map(
@@ -89,11 +86,14 @@ def distributed_ec_step(
     cs = mesh.shape["cs"]
     if n % cs:
         raise ValueError(f"k+m={n} must be divisible by cs={cs}")
-    enc_mat = _encode_bits_matrix(generator)
+    parity_coeff = np.asarray(generator[k:], np.uint8)
+    eng = default_engine()
 
     survivors = [i for i in range(n) if i != lost_chunk][:k]
-    D = reference.decode_matrix(generator, survivors, [lost_chunk])
-    dec_mat = jnp.asarray(bm.gf_matrix_to_bitmatrix(D), jnp.bfloat16)
+    D = np.asarray(
+        reference.decode_matrix(generator, survivors, [lost_chunk]),
+        np.uint8,
+    )
     surv_idx = jnp.asarray(survivors, jnp.int32)
 
     batch_spec = P(("dp", "cs"), None, None)
@@ -104,7 +104,7 @@ def distributed_ec_step(
     @jax.jit
     def step(d):
         def body(d_blk):  # (b, k, C) per device, b = B/(dp*cs)
-            parity = _apply_bits(enc_mat, d_blk)
+            parity = eng.apply(parity_coeff, d_blk)
             chunks = jnp.concatenate([d_blk, parity], axis=1)  # (b, n, C)
             # Chunk fan-out over ICI: device j of the cs-group ends up with
             # chunk columns [j*n/cs, (j+1)*n/cs) of all cs*b group stripes.
@@ -121,7 +121,7 @@ def distributed_ec_step(
                 shard, "cs", axis=1, tiled=True
             )  # (cs*b, n, C)
             surv = jnp.take(full, surv_idx, axis=1)  # (cs*b, k, C)
-            repaired = _apply_bits(dec_mat, surv)[:, 0]  # (cs*b, C)
+            repaired = eng.apply(D, surv)[:, 0]  # (cs*b, C)
             return shard, repaired
 
         return shard_map(

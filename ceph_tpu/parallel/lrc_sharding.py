@@ -24,8 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ceph_tpu.ec import bitmatrix as bm
-from ceph_tpu.ec.engine import bitplane_apply
+from ceph_tpu.ec.engine import default_engine
 from ceph_tpu.ec.repair_operator import lrc_repair_operator
 
 shard_map = jax.shard_map
@@ -74,7 +73,7 @@ def sharded_lrc_repair(mesh, ec, chunks, lost: int) -> np.ndarray:
                 "profile is not group-local"
             )
         row[0, cid - g_lost * per_group] = coeffs[0, j]
-    rbits = jnp.asarray(bm.gf_matrix_to_bitmatrix(row), jnp.bfloat16)
+    eng = default_engine()
 
     padded = jnp.zeros((B, groups, gpad, C), jnp.uint8)
     padded = padded.at[:, :, :per_group].set(
@@ -94,7 +93,8 @@ def sharded_lrc_repair(mesh, ec, chunks, lost: int) -> np.ndarray:
             grp = jax.lax.all_gather(
                 blk[:, 0, 0], "gs", axis=1, tiled=True
             )  # (b, gpad, C)
-            rec = bitplane_apply(rbits, grp)  # (b, 1, C)
+            # Engine dispatch: Pallas shard kernel on TPU, einsum on CPU.
+            rec = eng.apply(row, grp)  # (b, 1, C)
             return rec[:, None]  # (b, 1, 1, C)
 
         return shard_map(
