@@ -59,7 +59,7 @@ def test_clean_umount_checkpoints(tmp_path):
         Transaction().create_collection(CID).write(CID, OID, 0, b"data")
     ))
     _run(s.umount())
-    assert (tmp_path / "checkpoint.bin").exists()
+    assert list((tmp_path / "ckpt").glob("*.seg"))
     s2 = _new_store(tmp_path)
     assert s2.read(CID, OID) == b"data"
 
@@ -134,4 +134,307 @@ def test_osd_restart_serves_data_without_peer_recovery(tmp_path):
         assert await io.get_xattr("persistent", "tag") == b"kept"
         await rados.shutdown()
         await cluster.stop()
+    asyncio.run(run())
+
+
+# -- incremental segment checkpoints (BlueStore O(txn)-commit property) --
+
+CID2 = CollectionId(2, 0, shard=0)
+OID2 = GHObject(2, "obj2", shard=0)
+
+
+def test_checkpoint_rewrites_only_dirty_segments(tmp_path):
+    """A checkpoint triggered by writes to one collection must not
+    rewrite (or even touch) the other collection's segment."""
+    async def run():
+        s = WalStore(str(tmp_path), checkpoint_bytes=1 << 30)
+        await s.mount()
+        await s.queue_transactions(
+            Transaction().create_collection(CID)
+            .write(CID, OID, 0, b"cold data")
+        )
+        await s.queue_transactions(
+            Transaction().create_collection(CID2)
+            .write(CID2, OID2, 0, b"hot")
+        )
+        await s.umount()                     # both segments written
+        seg_a = s._seg_path(CID)
+        seg_b = s._seg_path(CID2)
+        assert seg_a.exists() and seg_b.exists()
+        stat_a = seg_a.stat()
+
+        s2 = WalStore(str(tmp_path), checkpoint_bytes=1)  # every commit
+        await s2.mount()
+        await s2.queue_transactions(
+            Transaction().write(CID2, OID2, 0, b"hot2")
+        )
+        if s2._ckpt_task is not None:
+            await s2._ckpt_task
+        st_a2 = seg_a.stat()
+        assert (st_a2.st_mtime_ns, st_a2.st_ino) == \
+            (stat_a.st_mtime_ns, stat_a.st_ino), "clean segment rewritten"
+        await s2.umount()
+
+        s3 = WalStore(str(tmp_path))
+        await s3.mount()
+        assert s3.read(CID, OID) == b"cold data"
+        assert s3.read(CID2, OID2) == b"hot2"
+        await s3.umount()
+    asyncio.run(run())
+
+
+def test_commit_does_not_wait_for_segment_io(tmp_path):
+    """Commits issued while a background checkpoint is writing segments
+    complete without waiting for the segment IO (snapshot-then-release:
+    the commit path only pays the WAL roll + dirty memcpy)."""
+    async def run():
+        import time
+
+        s = WalStore(str(tmp_path), checkpoint_bytes=1)
+        await s.mount()
+        real_write = s._commit_segments
+
+        def slow_write(snap, compact):
+            time.sleep(0.5)          # segment IO made artificially slow
+            real_write(snap, compact)
+
+        await s.queue_transactions(
+            Transaction().create_collection(CID).write(CID, OID, 0, b"x")
+        )
+        if s._ckpt_task is not None:
+            await s._ckpt_task       # settle the first checkpoint
+        s._commit_segments = slow_write
+        t0 = time.perf_counter()
+        await s.queue_transactions(
+            Transaction().write(CID, OID, 0, b"y")  # triggers checkpoint
+        )
+        await s.queue_transactions(
+            Transaction().write(CID, OID, 1, b"z")  # during segment IO
+        )
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.4, f"commit stalled {elapsed:.2f}s on IO"
+        assert s._ckpt_task is not None and not s._ckpt_task.done()
+        await s._ckpt_task
+        await s.umount()
+        s2 = WalStore(str(tmp_path))
+        await s2.mount()
+        assert s2.read(CID, OID) == b"yz"
+        await s2.umount()
+    asyncio.run(run())
+
+
+def test_interrupted_checkpoint_wal_old_recovers(tmp_path):
+    """Crash between the WAL roll and segment completion: wal.old +
+    wal.log both replay, and mount compacts them away."""
+    async def run():
+        s = WalStore(str(tmp_path), checkpoint_bytes=1)
+        await s.mount()
+
+        def fail_write(snap, compact):
+            raise OSError("disk full")
+
+        s._commit_segments = fail_write
+        await s.queue_transactions(
+            Transaction().create_collection(CID).write(CID, OID, 0, b"AB")
+        )
+        task = s._ckpt_task
+        assert task is not None
+        with pytest.raises(OSError):
+            await task
+        assert (tmp_path / "wal.old").exists()
+        # post-failure commits keep appending to the fresh wal.log
+        s._commit_segments = lambda snap, compact: None  # trigger skips
+        await s.queue_transactions(
+            Transaction().write(CID, OID, 2, b"CD")
+        )
+        # hard crash (no umount)
+        if s._nwal is not None:
+            s._nwal.close(); s._nwal = None
+        if s._wal_file is not None:
+            s._wal_file.close(); s._wal_file = None
+
+        s2 = WalStore(str(tmp_path))
+        await s2.mount()
+        assert s2.read(CID, OID) == b"ABCD"
+        assert not (tmp_path / "wal.old").exists()   # compacted
+        await s2.umount()
+    asyncio.run(run())
+
+
+def test_legacy_checkpoint_bin_migrates(tmp_path):
+    """A pre-segment whole-image checkpoint.bin loads and converts to
+    per-collection segments on mount."""
+    import os
+    import struct as _st
+
+    from ceph_tpu.common.crc32c import crc32c as _crc
+    from ceph_tpu.msg.codec import encode as _enc
+    from ceph_tpu.store.txcodec import enc_cid, enc_oid
+
+    blob = _enc([[enc_cid(CID), [[enc_oid(OID), b"legacy!", {}, {}]]]])
+    raw = b"ceph-tpu-ckpt-1\n" + _st.pack(
+        "<II", len(blob), _crc(0xFFFFFFFF, blob)) + blob
+    os.makedirs(tmp_path, exist_ok=True)
+    (tmp_path / "checkpoint.bin").write_bytes(raw)
+
+    s = _new_store(tmp_path)
+    assert s.read(CID, OID) == b"legacy!"
+    assert not (tmp_path / "checkpoint.bin").exists()
+    assert s._seg_path(CID).exists()
+    _run(s.umount())
+    s2 = _new_store(tmp_path)
+    assert s2.read(CID, OID) == b"legacy!"
+    _run(s2.umount())
+
+
+def test_collection_removal_drops_segment(tmp_path):
+    async def run():
+        s = WalStore(str(tmp_path), checkpoint_bytes=1 << 30)
+        await s.mount()
+        await s.queue_transactions(
+            Transaction().create_collection(CID).write(CID, OID, 0, b"x")
+        )
+        await s.umount()
+        assert s._seg_path(CID).exists()
+        s2 = WalStore(str(tmp_path))
+        await s2.mount()
+        await s2.queue_transactions(
+            Transaction().remove(CID, OID).remove_collection(CID)
+        )
+        await s2.umount()
+        assert not s2._seg_path(CID).exists()
+        s3 = WalStore(str(tmp_path))
+        await s3.mount()
+        with pytest.raises(Exception):
+            s3.read(CID, OID)
+        await s3.umount()
+    asyncio.run(run())
+
+
+def _hard_crash(s):
+    if s._nwal is not None:
+        s._nwal.close(); s._nwal = None
+    if s._wal_file is not None:
+        s._wal_file.close(); s._wal_file = None
+
+
+def test_manifest_roll_forward_no_clone_reapply(tmp_path):
+    """Crash AFTER the checkpoint's commit record (manifest) but before
+    publish: mount must roll phase 2 forward and must NOT replay wal.old
+    — re-applying a clone over post-checkpoint state would copy the
+    cloned object's NEW content over the snapshot."""
+    async def run():
+        OIDB = GHObject(1, "objB", shard=0)
+        s = WalStore(str(tmp_path), checkpoint_bytes=1 << 30)
+        await s.mount()
+        await s.queue_transactions(
+            Transaction().create_collection(CID).write(CID, OID, 0, b"orig")
+        )
+        await s.queue_transactions(Transaction().clone(CID, OID, OIDB))
+        await s.queue_transactions(Transaction().write(CID, OID, 0, b"new!"))
+        # checkpoint whose publish "crashes" right after the manifest
+        s._publish_manifest = lambda compact, entries: None
+        s.checkpoint_bytes = 1
+        await s.queue_transactions(Transaction().write(CID, OID, 0, b"NEW2"))
+        if s._ckpt_task is not None:
+            await s._ckpt_task
+        assert (tmp_path / "ckpt.manifest").exists()
+        assert (tmp_path / "wal.old").exists()
+        _hard_crash(s)
+
+        s2 = WalStore(str(tmp_path))
+        await s2.mount()
+        assert s2.read(CID, OIDB) == b"orig", \
+            "clone re-applied over post-checkpoint state"
+        assert s2.read(CID, OID) == b"NEW2"
+        assert not (tmp_path / "ckpt.manifest").exists()
+        assert not (tmp_path / "wal.old").exists()
+        await s2.umount()
+    asyncio.run(run())
+
+
+def test_manifest_phase1_crash_discards_strays(tmp_path):
+    """Crash BEFORE the commit record: .seg.new strays are discarded and
+    wal.old + wal.log replay exactly over the old segments."""
+    async def run():
+        s = WalStore(str(tmp_path), checkpoint_bytes=1 << 30)
+        await s.mount()
+        await s.queue_transactions(
+            Transaction().create_collection(CID).write(CID, OID, 0, b"AB")
+        )
+        real = s._write_framed
+
+        def fail_manifest(path, blob):
+            if path == s.manifest_path:
+                raise OSError("crash before commit record")
+            real(path, blob)
+
+        s._write_framed = fail_manifest
+        s.checkpoint_bytes = 1
+        await s.queue_transactions(Transaction().write(CID, OID, 2, b"CD"))
+        task = s._ckpt_task
+        with pytest.raises(OSError):
+            await task
+        assert list((tmp_path / "ckpt").glob("*.seg.new"))
+        assert (tmp_path / "wal.old").exists()
+        _hard_crash(s)
+
+        s2 = WalStore(str(tmp_path))
+        await s2.mount()
+        assert s2.read(CID, OID) == b"ABCD"
+        assert not list((tmp_path / "ckpt").glob("*.seg.new"))
+        await s2.umount()
+        s3 = WalStore(str(tmp_path))
+        await s3.mount()
+        assert s3.read(CID, OID) == b"ABCD"
+        await s3.umount()
+    asyncio.run(run())
+
+
+def test_umount_after_failed_checkpoint_keeps_logs(tmp_path):
+    """umount with a failed background checkpoint (wal.old present) must
+    not raise, must not flush (the untracked delta lives only in the
+    logs), and the next mount recovers everything."""
+    async def run():
+        s = WalStore(str(tmp_path), checkpoint_bytes=1)
+        await s.mount()
+
+        def fail(snap, compact):
+            raise OSError("disk full")
+
+        s._commit_segments = fail
+        await s.queue_transactions(
+            Transaction().create_collection(CID).write(CID, OID, 0, b"keep")
+        )
+        await s.umount()            # swallows the OSError, keeps wal.old
+        assert (tmp_path / "wal.old").exists()
+
+        s2 = WalStore(str(tmp_path))
+        await s2.mount()
+        assert s2.read(CID, OID) == b"keep"
+        assert not (tmp_path / "wal.old").exists()
+        await s2.umount()
+    asyncio.run(run())
+
+
+def test_umount_flush_failure_keeps_wal(tmp_path):
+    """A clean-shutdown flush that fails before its commit record must
+    leave wal.log (and the dirty set) intact — no committed transaction
+    may be lost."""
+    async def run():
+        s = WalStore(str(tmp_path), checkpoint_bytes=1 << 30)
+        await s.mount()
+        await s.queue_transactions(
+            Transaction().create_collection(CID).write(CID, OID, 0, b"X")
+        )
+
+        def fail(snap, compact):
+            raise OSError("disk full")
+
+        s._commit_segments = fail
+        await s.umount()            # swallows the failure
+        s2 = WalStore(str(tmp_path))
+        await s2.mount()
+        assert s2.read(CID, OID) == b"X"
+        await s2.umount()
     asyncio.run(run())
