@@ -37,8 +37,6 @@ def _supported(m: CrushMap, rule: Rule) -> bool:
         return False
     if rule.steps[0][0] != "take" or rule.steps[2][0] != ("emit",)[0]:
         return False
-    if len(rule.steps[0]) > 2 and rule.steps[0][2]:
-        return False        # class-shadow take: scalar fallback
     op = rule.steps[1][0]
     if op not in ("choose_firstn", "chooseleaf_firstn"):
         return False
@@ -141,7 +139,17 @@ def map_pgs_bulk(m: CrushMap, rule: Rule | str, xs, result_max: int,
         # be backfilled by a later one (bit-identity requires the same)
         type_id = m.types[type_name]
         leaf = op.startswith("chooseleaf")
-        take_id = m.names[rule.steps[0][1]]
+        step0 = rule.steps[0]
+        cls = step0[2] if len(step0) > 2 else ""
+        if cls:
+            # class-restricted take: walk the shadow tree (an ordinary
+            # bucket tree) so classed pools keep the vectorized path
+            shadow = m._class_shadow(m.buckets[m.names[step0[1]]], cls)
+            if shadow is None:
+                return np.full((X, result_max), ITEM_NONE, np.int32)
+            take_id = shadow.id
+        else:
+            take_id = m.names[step0[1]]
         tries = m.tunables.choose_total_tries + 1
 
         out = np.full((X, numrep), np.int64(ITEM_NONE), np.int64)

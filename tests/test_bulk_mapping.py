@@ -164,3 +164,25 @@ def test_bulk_faster_than_scalar():
     _scalar(m, "data", xs[:512], 3)
     scalar_t = (time.perf_counter() - t0) * (len(xs) / 512)
     assert bulk_t < scalar_t, (bulk_t, scalar_t)
+
+
+def test_class_restricted_rule_stays_vectorized():
+    """A device-class take runs the vectorized machine over the shadow
+    tree, bit-identical to the scalar machine (and actually restricted)."""
+    m = build(23, hosts=6, per_host=2)
+    for d in range(12):
+        m.set_item_class(d, "ssd" if d % 2 == 0 else "hdd")
+    m.create_replicated_rule("rep-ssd", failure_domain="host",
+                             device_class="ssd")
+    xs = list(range(300))
+    got = map_pgs_bulk(m, "rep-ssd", xs, 3)
+    want = _scalar(m, "rep-ssd", xs, 3)
+    np.testing.assert_array_equal(got, want)
+    real = got[got != ITEM_NONE]
+    assert len(real) and (real % 2 == 0).all()
+    # absent class: empty mapping rows, same as scalar
+    m.create_replicated_rule("rep-nvme", failure_domain="host",
+                             device_class="nvme")
+    got2 = map_pgs_bulk(m, "rep-nvme", xs, 3)
+    np.testing.assert_array_equal(got2, _scalar(m, "rep-nvme", xs, 3))
+    assert (got2 == ITEM_NONE).all()
