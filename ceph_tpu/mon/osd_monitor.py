@@ -767,16 +767,18 @@ class OSDMonitor(PaxosService):
         crush = (CrushMap.from_dict(pending.new_crush)
                  if pending.new_crush
                  else CrushMap.from_dict(self.osdmap.crush.to_dict()))
+        # known = in a crush bucket OR registered in the OSDMap (the
+        # reference checks osdmap.exists(id) and will create the crush
+        # item later); truly unknown ids are rejected (-ENOENT) so no
+        # phantom entry round-trips in the map forever
         present = {i for b in crush.buckets.values()
-                   for i in b.items if i >= 0}
+                   for i in b.items if i >= 0} | set(self.osdmap.osds)
         done = []
         for raw in ids:
             osd = int(str(raw).removeprefix("osd."))
             if osd not in present:
-                # reference OSDMonitor rejects unknown ids (-ENOENT);
-                # a phantom entry would round-trip in the map forever
-                return CommandResult(ENOENT_RC, f"osd.{osd} does not "
-                                     "exist in the crush map")
+                return CommandResult(ENOENT_RC,
+                                     f"osd.{osd} does not exist")
             crush.set_item_class(
                 osd, cls if name.endswith("set-device-class") else "")
             done.append(osd)

@@ -174,6 +174,8 @@ def test_class_restricted_rule_stays_vectorized():
         m.set_item_class(d, "ssd" if d % 2 == 0 else "hdd")
     m.create_replicated_rule("rep-ssd", failure_domain="host",
                              device_class="ssd")
+    from ceph_tpu.placement.bulk import _supported
+    assert _supported(m, m.rules["rep-ssd"])  # really the vec machine
     xs = list(range(300))
     got = map_pgs_bulk(m, "rep-ssd", xs, 3)
     want = _scalar(m, "rep-ssd", xs, 3)
