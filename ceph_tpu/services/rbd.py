@@ -173,17 +173,42 @@ class RBD:
         await self.ioctx.remove(f"rbd_id.{name}")
         await self.ioctx.rm_omap_keys(DIRECTORY_OID, [name])
 
-    async def open(self, name: str, cache: bool = False) -> "Image":
+    async def image_id(self, name: str) -> str:
+        """name -> image id (the rbd_id.<name> lookup); RBDError when
+        absent.  Needs no open Image handle (journal-mode mirroring
+        reads a dead primary's journal by id alone)."""
         try:
-            image_id = (await self.ioctx.get_xattr(
+            return (await self.ioctx.get_xattr(
                 f"rbd_id.{name}", "id"
             )).decode()
         except RadosError as e:
             if e.rc == -2:
                 raise RBDError(f"no image {name!r}") from e
             raise
+
+    async def image_header(self, image_id: str) -> dict:
+        """Decoded rbd_header metadata for an image id."""
+        return json.loads(await self.ioctx.exec(
+            f"rbd_header.{image_id}", "rbd", "get_header"
+        ))
+
+    async def open(self, name: str, cache: bool = False,
+                   journaled: bool = False) -> "Image":
+        """``journaled``: mutations append to the image journal before
+        applying (librbd feature JOURNALING), and opening replays any
+        entries a crashed writer appended but never applied."""
+        image_id = await self.image_id(name)
         img = Image(self.ioctx, name, image_id, cache=cache)
         await img.refresh()
+        if journaled:
+            from ceph_tpu.services.rbd_journal import (
+                ImageJournal,
+                replay_to_image,
+            )
+
+            img._journal = ImageJournal(self.ioctx, image_id)
+            await img._journal.register()
+            await replay_to_image(img, img._journal)
         return img
 
 
@@ -230,6 +255,10 @@ class Image:
         # it mutates the map itself (write/rebuild).
         self._om_auth = False
         self._cache = None
+        # image journal (librbd Journal.cc): set by RBD.open(journaled=)
+        self._journal = None
+        self._j_last = -1           # newest appended-and-applied tid
+        self._j_uncommitted = 0
         if cache:
             from ceph_tpu.client.object_cacher import ObjectCacher
 
@@ -269,6 +298,9 @@ class Image:
     async def close(self) -> None:
         if self._cache is not None:
             await self._cache.flush()
+        await self._j_commit()
+        if self._journal is not None:
+            await self._journal.trim()
 
     # -- object map (src/librbd/ObjectMap.h bitmap) -----------------------
     @property
@@ -467,9 +499,40 @@ class Image:
             pos += run
         return bytes(out)
 
-    async def write(self, offset: int, data: bytes) -> None:
+    _COMMIT_BATCH = 16      # journal commit-position update cadence
+
+    async def _j_append(self, event: int, args: dict) -> None:
+        """Journal-first mutation ordering: the entry is durable before
+        the image changes (the write is acked at journal-safe; a crash
+        in between is covered by open-time replay)."""
+        self._j_last = await self._journal.append(event, args)
+
+    async def _j_applied(self) -> None:
+        """Lazily advance the commit position (batched like the
+        reference's commit interval, flushed on flush/close)."""
+        self._j_uncommitted += 1
+        if self._j_uncommitted >= self._COMMIT_BATCH:
+            await self._j_commit()
+
+    async def _j_commit(self) -> None:
+        if self._journal is not None and self._j_uncommitted:
+            if self._cache is not None:
+                # an entry is only "applied" once its data is durable:
+                # committing past writes still in the volatile cache
+                # would make replay skip exactly the crash window the
+                # journal exists to cover
+                await self._cache.flush()
+            await self._journal.commit(self._j_last)
+            self._j_uncommitted = 0
+
+    async def write(self, offset: int, data: bytes,
+                    _journal: bool = True) -> None:
         if offset + len(data) > self.size:
             raise RBDError("write past end of image")
+        if self._journal is not None and _journal:
+            from ceph_tpu.services.rbd_journal import EV_WRITE
+
+            await self._j_append(EV_WRITE, {"off": offset, "data": data})
         pos = 0
         for objectno, obj_off, run in self._extents(offset, len(data)):
             chunk = data[pos:pos + run]
@@ -478,6 +541,8 @@ class Image:
             else:
                 await self._obj_write(objectno, obj_off, chunk)
             pos += run
+        if self._journal is not None and _journal:
+            await self._j_applied()
 
     async def read(self, offset: int, length: int) -> bytes:
         length = max(0, min(length, self.size - offset))
@@ -486,6 +551,7 @@ class Image:
     async def flush(self) -> None:
         if self._cache is not None:
             await self._cache.flush()
+        await self._j_commit()
 
     async def flatten(self) -> None:
         """Copy every still-inherited parent block into the child and
@@ -526,9 +592,13 @@ class Image:
         # cached blocks that hold parent-fallback data remain
         # byte-correct after the flatten copied those bytes up
 
-    async def resize(self, new_size: int) -> None:
+    async def resize(self, new_size: int, _journal: bool = True) -> None:
         if self._cache is not None:
             await self._cache.flush()
+        if self._journal is not None and _journal:
+            from ceph_tpu.services.rbd_journal import EV_RESIZE
+
+            await self._j_append(EV_RESIZE, {"size": new_size})
         await self.ioctx.exec(
             self.header_oid, "rbd", "set_size",
             json.dumps({"size": new_size}).encode(),
@@ -573,20 +643,29 @@ class Image:
                 )
                 self.parent["overlap"] = new_size
         self.size = new_size
+        if self._journal is not None and _journal:
+            await self._j_applied()
 
     # -- snapshots (self-managed snaps + object COW clones; the librbd
     # snap_create/snap_rollback model over the OSD snapshot machinery) --
-    async def snap_create(self, snap_name: str) -> int:
+    async def snap_create(self, snap_name: str,
+                          _journal: bool = True) -> int:
         if self._cache is not None:
             # the snapshot must capture every acked write (librbd
             # flushes its cache before snap_create)
             await self._cache.flush()
+        if self._journal is not None and _journal:
+            from ceph_tpu.services.rbd_journal import EV_SNAP_CREATE
+
+            await self._j_append(EV_SNAP_CREATE, {"name": snap_name})
         snapid = await self.ioctx.selfmanaged_snap_create()
         await self.ioctx.exec(
             self.header_oid, "rbd", "snap_add",
             json.dumps({"name": snap_name, "id": snapid}).encode(),
         )
         await self.refresh()
+        if self._journal is not None and _journal:
+            await self._j_applied()
         return snapid
 
     async def snap_protect(self, snap_name: str) -> None:
@@ -617,16 +696,23 @@ class Image:
         )
         await self.refresh()
 
-    async def snap_remove(self, snap_name: str) -> None:
+    async def snap_remove(self, snap_name: str,
+                          _journal: bool = True) -> None:
         info = self.snaps.get(snap_name)
         if info is None:
             raise RBDError(f"no snap {snap_name!r}")
+        if self._journal is not None and _journal:
+            from ceph_tpu.services.rbd_journal import EV_SNAP_REMOVE
+
+            await self._j_append(EV_SNAP_REMOVE, {"name": snap_name})
         await self.ioctx.exec(
             self.header_oid, "rbd", "snap_rm",
             json.dumps({"name": snap_name}).encode(),
         )
         await self.ioctx.selfmanaged_snap_remove(int(info["id"]))
         await self.refresh()
+        if self._journal is not None and _journal:
+            await self._j_applied()
 
     def snap_list(self) -> list[dict]:
         return [
@@ -649,15 +735,20 @@ class Image:
         return await self._read_extents(offset, length,
                                         snapid=int(info["id"]))
 
-    async def snap_rollback(self, snap_name: str) -> None:
+    async def snap_rollback(self, snap_name: str,
+                            _journal: bool = True) -> None:
         """Restore the head image to a snapshot's content (librbd
         snap_rollback: copy the snap state over the head)."""
         info = self.snaps.get(snap_name)
         if info is None:
             raise RBDError(f"no snap {snap_name!r}")
+        if self._journal is not None and _journal:
+            from ceph_tpu.services.rbd_journal import EV_SNAP_ROLLBACK
+
+            await self._j_append(EV_SNAP_ROLLBACK, {"name": snap_name})
         snap_size = int(info["size"])
         if self.size != snap_size:
-            await self.resize(snap_size)
+            await self.resize(snap_size, _journal=False)
         nobjs = -(-snap_size // self.obj_size)
         for objectno in range(nobjs):
             want = min(self.obj_size, snap_size - objectno * self.obj_size)
@@ -671,3 +762,5 @@ class Image:
             )
             if self._cache is not None:
                 await self._cache.discard(objectno)
+        if self._journal is not None and _journal:
+            await self._j_applied()

@@ -1,18 +1,30 @@
-"""rbd-mirror-lite: snapshot-based cross-cluster image replication.
+"""rbd-mirror-lite: cross-cluster image replication, both modes.
 
-The role of reference src/tools/rbd_mirror (ImageReplayer.cc) in its
-modern SNAPSHOT-BASED mode (journal mode is the legacy path): the mirror
-daemon periodically takes a mirror snapshot on the primary image, ships
-the delta since the last mirrored snapshot to the secondary cluster, and
-marks the same snapshot there — the secondary is a crash-consistent
-point-in-time copy that advances snapshot by snapshot. Resumability
-falls out of the snapshot names themselves: the newest mirror snapshot
-present on BOTH sides is the sync base, so a restarted daemon (or a
-re-pointed one) needs no extra state.
+The role of reference src/tools/rbd_mirror (ImageReplayer.cc):
 
-Delta computation reads the image at the new and base snapshots and
-ships only changed blocks (the diff-iterate role; the -lite tradeoff is
-reading both versions instead of consulting an object map).
+SNAPSHOT mode (RBDMirror): the daemon periodically takes a mirror
+snapshot on the primary image, ships the delta since the last mirrored
+snapshot to the secondary cluster, and marks the same snapshot there —
+the secondary is a crash-consistent point-in-time copy that advances
+snapshot by snapshot.  Resumability falls out of the snapshot names
+themselves: the newest mirror snapshot present on BOTH sides is the
+sync base, so a restarted daemon needs no extra state.
+
+JOURNAL mode (JournalReplayer): the daemon registers as a client of
+the primary image's journal (services/rbd_journal.py, the
+src/journal/Journaler.h:32 role) and TAILS the entry stream, applying
+each event to the secondary image and persisting its commit position
+in the journal header (ImageReplayer.cc replay path).  Because the
+journal — not the image — is the source of truth, the secondary
+converges even on entries the crashed primary appended but never
+applied, and a restarted replayer resumes exactly at its commit
+position.  Consumed objects are trimmed once every registered client
+has passed them.
+
+Delta computation in snapshot mode reads the image at the new and base
+snapshots and ships only changed blocks (the diff-iterate role; the
+-lite tradeoff is reading both versions instead of consulting an
+object map).
 """
 
 from __future__ import annotations
@@ -124,6 +136,91 @@ class RBDMirror:
                 await self.sync_once()
             except Exception as e:           # noqa: BLE001
                 log.derr("mirror pass failed: %s", e)
+            try:
+                await asyncio.sleep(self.poll_interval)
+            except asyncio.CancelledError:
+                return
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+
+class JournalReplayer:
+    """Journal-mode mirroring (ImageReplayer.cc): tail the primary
+    image's journal and apply its entries to the secondary image."""
+
+    def __init__(self, src: RBD, dst: RBD, client_id: str = "mirror",
+                 poll_interval: float = 0.2):
+        self.src = src
+        self.dst = dst
+        self.client_id = client_id
+        self.poll_interval = poll_interval
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+        self.entries_applied = 0
+
+    async def _src_image_meta(self, name: str) -> tuple[str, dict]:
+        image_id = await self.src.image_id(name)
+        return image_id, await self.src.image_header(image_id)
+
+    async def replay_image(self, name: str) -> int:
+        """Apply every journal entry newer than this replayer's commit
+        position to the secondary; returns entries applied.  Reads ONLY
+        the journal and the primary header — the primary image handle
+        may be dead (the crash-consistency property journal mode buys
+        over snapshot mode)."""
+        from ceph_tpu.services.rbd_journal import (
+            ImageJournal,
+            apply_event,
+        )
+
+        image_id, header = await self._src_image_meta(name)
+        journal = ImageJournal(self.src.ioctx, image_id,
+                               client_id=self.client_id)
+        pos = await journal.register()
+        try:
+            dst_img = await self.dst.open(name)
+        except RBDError:
+            await self.dst.create(name, size=int(header["size"]),
+                                  order=int(header["order"]))
+            dst_img = await self.dst.open(name)
+        applied = 0
+        last = pos
+        async for tid, event, args in journal.entries_after(pos):
+            await apply_event(dst_img, event, args)
+            last = tid
+            applied += 1
+        if applied:
+            await journal.commit(last)
+            await journal.trim()
+        await dst_img.close()
+        self.entries_applied += applied
+        return applied
+
+    async def sync_once(self) -> int:
+        total = 0
+        for name in await self.src.list():
+            try:
+                total += await self.replay_image(name)
+            except (RBDError, IOError) as e:
+                log.derr("journal replay of %s failed: %s", name, e)
+        return total
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while not self._stopped:
+            try:
+                await self.sync_once()
+            except Exception as e:           # noqa: BLE001
+                log.derr("journal replay pass failed: %s", e)
             try:
                 await asyncio.sleep(self.poll_interval)
             except asyncio.CancelledError:
