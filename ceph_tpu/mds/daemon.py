@@ -281,17 +281,36 @@ class MDSDaemon:
     # -- dirfrag helpers ---------------------------------------------------
     async def _get_dentry(self, parent: int, name: str,
                           snapid: int = 0) -> dict:
-        oid = (snap_dirfrag_oid(parent, snapid) if snapid
-               else dirfrag_oid(parent))
-        try:
-            kv = await self.meta.get_omap(oid, [name])
-        except RadosError as e:
-            raise MDSError(ENOENT, f"no dir {parent:x}") \
-                if e.rc == ENOENT else e
+        if snapid:
+            kv = await self._snap_view(parent, snapid, [name])
+        else:
+            try:
+                kv = await self.meta.get_omap(dirfrag_oid(parent),
+                                              [name])
+            except RadosError as e:
+                raise MDSError(ENOENT, f"no dir {parent:x}") \
+                    if e.rc == ENOENT else e
         if name not in kv:
             raise MDSError(ENOENT, f"{name!r} not in {parent:x}",
                            missing_dentry=True)
         return decode(kv[name])
+
+    async def _snap_view(self, dino: int, snapid: int,
+                         names: list[str] | None = None) -> dict:
+        """A directory's omap AS OF a snapshot: the frozen COW copy when
+        one exists (the dirfrag diverged since the snap), else the live
+        dirfrag (unchanged since — reference SnapRealm resolution)."""
+        try:
+            return await self.meta.get_omap(
+                snap_dirfrag_oid(dino, snapid), names)
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
+        try:
+            return await self.meta.get_omap(dirfrag_oid(dino), names)
+        except RadosError as e:
+            raise MDSError(ENOENT, f"no dir {dino:x}") \
+                if e.rc == ENOENT else e
 
     async def _set_dentry(self, parent: int, name: str,
                           dentry: dict) -> None:
@@ -299,9 +318,112 @@ class MDSDaemon:
                                 .create()
                                 .omap_set({name: encode(dentry)}))
 
+    # -- snap realms (COW; reference src/mds/SnapRealm.h) ------------------
+    # mksnap records ONLY the realm (snapid, root ino) — O(1).  The cost
+    # moves to the first post-snap mutation of each dirfrag: _cow_freeze
+    # copies the pre-mutation omap to the snap suffix exactly once
+    # (exclusive create), and snapshot reads resolve frozen-else-live
+    # (_snap_view).  A directory renamed out of a realm keeps its
+    # membership through a "past_snaps" xattr (the realm past_parents
+    # role), merged along the ancestry walk.
+    async def _parent_chain(self, dino: int) -> list[int]:
+        chain = [dino]
+        cur = dino
+        hops = 0
+        while cur != ROOT_INO and hops < 4096:
+            try:
+                raw = await self.meta.get_xattr(dirfrag_oid(cur),
+                                                "parent")
+            except RadosError:
+                break
+            cur = int(raw)
+            chain.append(cur)
+            hops += 1
+        return chain
+
+    async def _covering_snaps(self, dino: int) -> list[int]:
+        """Live snapids whose realm covers directory ``dino``: realm
+        root on the ancestry chain, or sticky past_snaps membership
+        recorded on any chain member at rename time."""
+        if not self.snaps:
+            return []
+        chain = await self._parent_chain(dino)
+        chain_set = set(chain)
+        covered = {sid for sid, info in self.snaps.items()
+                   if int(info["ino"]) in chain_set}
+        remaining = set(self.snaps) - covered
+        if remaining:
+            for link in chain:
+                if not remaining:
+                    break
+                try:
+                    raw = await self.meta.get_xattr(
+                        dirfrag_oid(link), "past_snaps")
+                except RadosError:
+                    continue
+                sticky = {int(s) for s in decode(raw)}
+                covered |= sticky & remaining
+                remaining -= sticky
+        return sorted(covered)
+
+    async def _cow_freeze(self, dino: int) -> None:
+        """Copy ``dino``'s live dirfrag to every covering snapshot that
+        has no frozen copy yet — called BEFORE any mutation of that
+        dirfrag.  Idempotent (exclusive create: the first, pre-mutation
+        freeze wins), so journal replay re-running a mutation cannot
+        re-freeze post-mutation state."""
+        if not self.snaps:
+            return
+        for snapid in await self._covering_snaps(dino):
+            oid = snap_dirfrag_oid(dino, snapid)
+            try:
+                await self.meta.stat(oid)
+                continue                      # already frozen
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+            try:
+                kv = await self.meta.get_omap(dirfrag_oid(dino))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+                return                        # no dirfrag to freeze
+            frozen: dict[str, bytes] = {}
+            for dname, raw in kv.items():
+                de = decode(raw)
+                if de.get("remote"):
+                    # hard-link stubs carry no inode attrs; freeze the
+                    # inode resolved AT THE SNAPID — any post-snap attr
+                    # change froze the primary's dirfrag first, so the
+                    # snap-view resolution returns as-of-snap attrs
+                    try:
+                        de = dict(await self._resolve_remote(de,
+                                                             snapid))
+                        de.pop("remote", None)
+                    except MDSError:
+                        pass                  # racing unlink: keep stub
+                frozen[dname] = encode(de)
+            op = ObjectOperation().create(exclusive=True)
+            if frozen:
+                op.omap_set(frozen)
+            try:
+                await self.meta.operate(oid, op)
+            except RadosError as e:
+                if e.rc != EEXIST:
+                    raise
+
     # -- mutation application (idempotent; journal replay re-runs these) --
     async def _apply(self, e: dict) -> None:
         op = e["op"]
+        # COW-freeze every dirfrag this op mutates BEFORE mutating it
+        # (snapshot views then resolve frozen-else-live)
+        for key in ("parent", "src_parent", "dst_parent", "pp", "np"):
+            if key in e:
+                await self._cow_freeze(int(e[key]))
+        if op == "rmdir":
+            await self._cow_freeze(int(e["ino"]))       # doomed dirfrag
+        if op == "rename" and int(e.get("purge_dir_ino", 0)):
+            await self._cow_freeze(int(e["purge_dir_ino"]))
         if op in ("mkdir", "create"):
             dentry = dict(e["dentry"])
             await self._set_dentry(int(e["parent"]), str(e["name"]),
@@ -353,12 +475,23 @@ class MDSDaemon:
                                    str(e["dst_name"]), dentry)
             if dentry.get("type") == "dir":
                 # moved directory: refresh its parent back-pointer
-                await self.meta.operate(
-                    dirfrag_oid(int(dentry["ino"])),
-                    ObjectOperation().create().set_xattr(
-                        "parent", str(int(e["dst_parent"])).encode()
-                    ),
+                op_x = ObjectOperation().create().set_xattr(
+                    "parent", str(int(e["dst_parent"])).encode()
                 )
+                merged = {int(s) for s in e.get("past_snaps", ())}
+                if merged:
+                    # sticky realm membership (SnapRealm past_parents)
+                    try:
+                        raw = await self.meta.get_xattr(
+                            dirfrag_oid(int(dentry["ino"])),
+                            "past_snaps")
+                        merged |= {int(s) for s in decode(raw)}
+                    except RadosError:
+                        pass
+                    op_x.set_xattr("past_snaps",
+                                   encode(sorted(merged)))
+                await self.meta.operate(
+                    dirfrag_oid(int(dentry["ino"])), op_x)
             if int(e.get("purge_ino", 0)):
                 await self._purge_file(int(e["purge_ino"]),
                                        int(e.get("purge_size", 0)))
@@ -388,9 +521,10 @@ class MDSDaemon:
         elif op == "rmsnap":
             # cleanup lives HERE so journal replay after a crash
             # re-runs it (idempotent: removals tolerate ENOENT); the
-            # walk follows the snapshot's own FROZEN dirfrags, so a
+            # walk follows the snapshot VIEW (frozen-else-live), so a
             # directory renamed out of the subtree after mksnap is
-            # still found
+            # still reachable through its frozen parent, and dirfrags
+            # that never diverged have nothing to remove
             snapid = int(e["snapid"])
             queue = [int(e["ino"])]
             seen = set()
@@ -400,11 +534,8 @@ class MDSDaemon:
                     continue
                 seen.add(dino)
                 try:
-                    kv = await self.meta.get_omap(
-                        snap_dirfrag_oid(dino, snapid))
-                except RadosError as err:
-                    if err.rc != ENOENT:
-                        raise
+                    kv = await self._snap_view(dino, snapid)
+                except MDSError:
                     kv = {}
                 for raw in kv.values():
                     de = decode(raw)
@@ -502,20 +633,28 @@ class MDSDaemon:
                 .omap_set({str(ino): encode(rec)}))
 
     async def _primary_of(self, ino: int,
-                          rec: dict | None = None
-                          ) -> tuple[int, str, dict]:
+                          rec: dict | None = None,
+                          snapid: int = 0) -> tuple[int, str, dict]:
         if rec is None:
             rec = await self._anchor_get(ino)
         if rec is None:
             raise MDSError(ENOENT, f"no anchor for {ino:x}")
         pp, pn = int(rec["primary"][0]), str(rec["primary"][1])
-        return pp, pn, await self._get_dentry(pp, pn)
+        return pp, pn, await self._get_dentry(pp, pn, snapid)
 
-    async def _resolve_remote(self, dentry: dict) -> dict:
-        """A remote dentry's visible attrs are the primary's inode."""
+    async def _resolve_remote(self, dentry: dict,
+                              snapid: int = 0) -> dict:
+        """A remote dentry's visible attrs are the primary's inode.
+        With ``snapid``, the primary resolves through the snap view
+        (frozen-else-live): any post-snap attr change froze the
+        primary's dirfrag first, so the attrs are as-of-snap.  The
+        anchor pointer itself is live — a -lite approximation; frozen
+        dirfrags store stubs pre-resolved so this path only serves
+        not-yet-diverged directories."""
         if not dentry.get("remote"):
             return dentry
-        _, _, primary = await self._primary_of(int(dentry["ino"]))
+        _, _, primary = await self._primary_of(int(dentry["ino"]),
+                                               snapid=snapid)
         return {**primary, "remote": True}
 
     async def _unlink_plan(self, parent: int, name: str,
@@ -630,29 +769,35 @@ class MDSDaemon:
                 "lease": self.lease_ttl}
 
     async def _req_lookup(self, d: dict) -> dict:
+        snapid = int(d.get("snapid", 0))
         dentry = await self._get_dentry(int(d["parent"]),
-                                        str(d["name"]),
-                                        int(d.get("snapid", 0)))
-        if not d.get("snapid"):
-            dentry = await self._resolve_remote(dentry)
+                                        str(d["name"]), snapid)
+        if dentry.get("remote"):
+            try:
+                dentry = await self._resolve_remote(dentry, snapid)
+            except MDSError:
+                if not snapid:
+                    raise          # snap stub mid-unlink: serve as-is
         return {"dentry": dentry, "lease": self.lease_ttl,
                 "snapc": self._snapc_wire()}
 
     async def _req_readdir(self, d: dict) -> dict:
         ino = int(d["ino"])
         snapid = int(d.get("snapid", 0))
-        try:
-            kv = await self.meta.get_omap(
-                snap_dirfrag_oid(ino, snapid) if snapid
-                else dirfrag_oid(ino))
-        except RadosError as e:
-            raise MDSError(ENOENT, f"no dir {ino:x}") \
-                if e.rc == ENOENT else e
+        if snapid:
+            kv = await self._snap_view(ino, snapid)
+        else:
+            try:
+                kv = await self.meta.get_omap(dirfrag_oid(ino))
+            except RadosError as e:
+                raise MDSError(ENOENT, f"no dir {ino:x}") \
+                    if e.rc == ENOENT else e
         entries = {name: decode(raw) for name, raw in kv.items()}
         for name, de in entries.items():
             if de.get("remote"):
                 try:
-                    entries[name] = await self._resolve_remote(de)
+                    entries[name] = await self._resolve_remote(de,
+                                                               snapid)
                 except MDSError:
                     pass        # racing unlink: show the raw entry
         return {"entries": entries, "lease": self.lease_ttl}
@@ -748,40 +893,16 @@ class MDSDaemon:
         return out
 
     async def _req_mksnap(self, d: dict) -> dict:
-        """Snapshot of the subtree at dir ``ino`` (Server::mksnap):
-        metadata = dirfrag copies under a snap suffix; file data =
+        """Snapshot of the subtree at dir ``ino`` (Server::mksnap) as a
+        COW SNAP REALM (reference SnapRealm.h): O(1) regardless of
+        subtree size — just a snapid + realm record.  Metadata diverges
+        lazily (_cow_freeze on first mutation per dirfrag); file data =
         RADOS self-managed snap, COWed by every client's snapc."""
         ino, name = int(d["ino"]), str(d["name"])
         if any(i["name"] == name and int(i["ino"]) == ino
                for i in self.snaps.values()):
             raise MDSError(EEXIST, f"snap {name!r} exists")
         snapid = await self.data.selfmanaged_snap_create()
-        # copy the subtree's dirfrags FIRST (idempotent, unreferenced
-        # until the journal entry lands — a crash leaves only orphans)
-        for dino in await self._walk_subtree(ino):
-            try:
-                kv = await self.meta.get_omap(dirfrag_oid(dino))
-            except RadosError as e:
-                if e.rc != ENOENT:
-                    raise
-                kv = {}
-            frozen: dict[str, bytes] = {}
-            for dname, raw in kv.items():
-                de = decode(raw)
-                if de.get("remote"):
-                    # hard-link stubs carry no inode attrs and the
-                    # live anchortable may move after the snapshot:
-                    # freeze the resolved inode NOW
-                    try:
-                        de = dict(await self._resolve_remote(de))
-                        de.pop("remote", None)
-                    except MDSError:
-                        pass      # racing unlink: keep the stub
-                frozen[dname] = encode(de)
-            op = ObjectOperation().create()
-            if frozen:
-                op.omap_set(frozen)
-            await self.meta.operate(snap_dirfrag_oid(dino, snapid), op)
         entry = {"op": "mksnap", "snapid": snapid,
                  "info": {"name": name, "ino": ino,
                           "created": time.time()}}
@@ -940,12 +1061,19 @@ class MDSDaemon:
                               "remotes": rec["remotes"]}
             else:
                 anchor_ino = 0
+        past_snaps: list[int] = []
+        if dentry["type"] == "dir" and self.snaps:
+            # realm membership at the OLD location must stick to the
+            # moved subtree (SnapRealm past_parents): its descendants'
+            # ancestry walk picks these up through this dirfrag
+            past_snaps = await self._covering_snaps(int(dentry["ino"]))
         entry = {"op": "rename", "src_parent": sp, "src_name": sn,
                  "dst_parent": dp, "dst_name": dn, "dentry": dentry,
                  "ino": int(dentry["ino"]),
                  "purge_ino": purge_ino, "purge_size": purge_size,
                  "purge_dir_ino": purge_dir_ino,
-                 "anchor_ino": anchor_ino, "anchor": anchor}
+                 "anchor_ino": anchor_ino, "anchor": anchor,
+                 "past_snaps": past_snaps}
         await self._journal(entry)
         await self._apply(entry)
         return {"dentry": dentry, "unlinked_ino": unlinked_ino}

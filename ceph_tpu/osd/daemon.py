@@ -3442,6 +3442,10 @@ class OSDDaemon:
                 do_omap_set(op["kv"])
                 results.append({})
             elif kind == "omap_get":
+                if not exists:
+                    # reference do_osd_ops: omap reads on a missing
+                    # object are -ENOENT, same as read/stat/getxattr
+                    return ENOENT_RC, results, 0
                 results.append({"kv": get_omap(op.get("keys"))})
             elif kind == "omap_rm":
                 do_omap_rm(op["keys"])

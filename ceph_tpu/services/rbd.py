@@ -27,6 +27,15 @@ import json
 import secrets
 
 from ceph_tpu.client.rados import IoCtx, ObjectOperation, RadosError
+from ceph_tpu.services.rbd_journal import (
+    EV_RESIZE,
+    EV_SNAP_CREATE,
+    EV_SNAP_REMOVE,
+    EV_SNAP_ROLLBACK,
+    EV_WRITE,
+    ImageJournal,
+    replay_to_image,
+)
 
 DIRECTORY_OID = "rbd_directory"
 CHILDREN_OID = "rbd_children"
@@ -201,11 +210,6 @@ class RBD:
         img = Image(self.ioctx, name, image_id, cache=cache)
         await img.refresh()
         if journaled:
-            from ceph_tpu.services.rbd_journal import (
-                ImageJournal,
-                replay_to_image,
-            )
-
             img._journal = ImageJournal(self.ioctx, image_id)
             await img._journal.register()
             await replay_to_image(img, img._journal)
@@ -530,8 +534,6 @@ class Image:
         if offset + len(data) > self.size:
             raise RBDError("write past end of image")
         if self._journal is not None and _journal:
-            from ceph_tpu.services.rbd_journal import EV_WRITE
-
             await self._j_append(EV_WRITE, {"off": offset, "data": data})
         pos = 0
         for objectno, obj_off, run in self._extents(offset, len(data)):
@@ -596,8 +598,6 @@ class Image:
         if self._cache is not None:
             await self._cache.flush()
         if self._journal is not None and _journal:
-            from ceph_tpu.services.rbd_journal import EV_RESIZE
-
             await self._j_append(EV_RESIZE, {"size": new_size})
         await self.ioctx.exec(
             self.header_oid, "rbd", "set_size",
@@ -655,8 +655,6 @@ class Image:
             # flushes its cache before snap_create)
             await self._cache.flush()
         if self._journal is not None and _journal:
-            from ceph_tpu.services.rbd_journal import EV_SNAP_CREATE
-
             await self._j_append(EV_SNAP_CREATE, {"name": snap_name})
         snapid = await self.ioctx.selfmanaged_snap_create()
         await self.ioctx.exec(
@@ -702,8 +700,6 @@ class Image:
         if info is None:
             raise RBDError(f"no snap {snap_name!r}")
         if self._journal is not None and _journal:
-            from ceph_tpu.services.rbd_journal import EV_SNAP_REMOVE
-
             await self._j_append(EV_SNAP_REMOVE, {"name": snap_name})
         await self.ioctx.exec(
             self.header_oid, "rbd", "snap_rm",
@@ -743,8 +739,6 @@ class Image:
         if info is None:
             raise RBDError(f"no snap {snap_name!r}")
         if self._journal is not None and _journal:
-            from ceph_tpu.services.rbd_journal import EV_SNAP_ROLLBACK
-
             await self._j_append(EV_SNAP_ROLLBACK, {"name": snap_name})
         snap_size = int(info["size"])
         if self.size != snap_size:

@@ -145,19 +145,37 @@ class ImageJournal:
         return tid
 
     # -- replay / tail ------------------------------------------------
+    async def trim_horizon(self) -> int:
+        """First tid that can still be read (everything below was
+        trimmed).  A client whose position is older than this cannot
+        catch up from the journal alone (it needs a full image sync —
+        the reference ImageReplayer bootstrap)."""
+        kv = await self._header()
+        return int(kv.get("trimmed", b"0")) * self.per_obj
+
     async def entries_after(self, tid: int):
         """Yield (tid, event, args) for every entry with tid > ``tid``
-        in order (the Journaler replay/tail read path)."""
+        in order (the Journaler replay/tail read path).  A missing
+        object BELOW the committed floor is a crash-trimmed gap and is
+        skipped; the first missing object at or past the floor is the
+        tail."""
         kv = await self._header()
+        floor = max(
+            [_TID.unpack(v)[0]
+             for k, v in kv.items() if k.startswith("client.")] or [0]
+        )
         objno = max(int(kv.get("trimmed", b"0")),
                     (tid + 1) // self.per_obj)
         while True:
             try:
                 raw = await self.ioctx.read(self._data_oid(objno))
             except RadosError as e:
-                if e.rc == -2:
-                    return
-                raise
+                if e.rc != -2:
+                    raise
+                if (objno + 1) * self.per_obj <= floor:
+                    objno += 1          # crash-trimmed gap: keep going
+                    continue
+                return
             for payload in _split_frames(raw):
                 etid, event, args = decode(payload)
                 if etid > tid:
@@ -227,11 +245,14 @@ def _split_frames(raw: bytes) -> list[bytes]:
     return out
 
 
-async def replay_to_image(img, journal: ImageJournal) -> int:
-    """Apply every journal entry newer than the image client's commit
-    position to the image (librbd Journal replay on open); returns the
-    count applied.  Entries are absolute-state ops, safe to re-apply."""
-    pos = await journal.committed()
+async def replay_to_image(img, journal: ImageJournal,
+                          from_tid: int | None = None) -> int:
+    """Apply every journal entry newer than the commit position (or
+    ``from_tid``) to the image (librbd Journal replay on open / the
+    ImageReplayer apply loop); returns the count applied.  Entries are
+    absolute-state ops, safe to re-apply.  The commit position only
+    advances after the applied data is durable (cache flushed)."""
+    pos = await journal.committed() if from_tid is None else from_tid
     applied = 0
     last = pos
     async for tid, event, args in journal.entries_after(pos):
@@ -239,6 +260,8 @@ async def replay_to_image(img, journal: ImageJournal) -> int:
         last = tid
         applied += 1
     if applied:
+        if getattr(img, "_cache", None) is not None:
+            await img._cache.flush()
         await journal.commit(last)
     return applied
 

@@ -164,40 +164,71 @@ class JournalReplayer:
         self._task: asyncio.Task | None = None
         self._stopped = False
         self.entries_applied = 0
+        self.images_bootstrapped = 0
+        self._journals: dict[str, object] = {}   # name -> ImageJournal
 
     async def _src_image_meta(self, name: str) -> tuple[str, dict]:
         image_id = await self.src.image_id(name)
         return image_id, await self.src.image_header(image_id)
+
+    async def _bootstrap(self, name: str, dst_img) -> None:
+        """Full image sync (ImageReplayer bootstrap): the journal was
+        trimmed past this client's position, so the entry stream alone
+        cannot reconstruct the secondary.  Copy the primary's current
+        blocks; journal entries past the trim horizon then re-apply
+        idempotently on top."""
+        src_img = await self.src.open(name)
+        if dst_img.size != src_img.size:
+            await dst_img.resize(src_img.size)
+        bs = src_img.obj_size
+        for off in range(0, src_img.size, bs):
+            want = min(bs, src_img.size - off)
+            await dst_img.write(off, await src_img.read(off, want))
+        self.images_bootstrapped += 1
+        log.dout(5, "journal mirror bootstrapped %s (%d bytes)", name,
+                 src_img.size)
 
     async def replay_image(self, name: str) -> int:
         """Apply every journal entry newer than this replayer's commit
         position to the secondary; returns entries applied.  Reads ONLY
         the journal and the primary header — the primary image handle
         may be dead (the crash-consistency property journal mode buys
-        over snapshot mode)."""
+        over snapshot mode).  A journal trimmed past our position
+        triggers a full-image bootstrap first."""
         from ceph_tpu.services.rbd_journal import (
             ImageJournal,
-            apply_event,
+            replay_to_image,
         )
 
-        image_id, header = await self._src_image_meta(name)
-        journal = ImageJournal(self.src.ioctx, image_id,
-                               client_id=self.client_id)
-        pos = await journal.register()
+        journal = self._journals.get(name)
+        if journal is None:
+            image_id, _ = await self._src_image_meta(name)
+            journal = ImageJournal(self.src.ioctx, image_id,
+                                   client_id=self.client_id)
+            await journal.register()
+            self._journals[name] = journal
         try:
             dst_img = await self.dst.open(name)
         except RBDError:
+            _, header = await self._src_image_meta(name)
             await self.dst.create(name, size=int(header["size"]),
                                   order=int(header["order"]))
             dst_img = await self.dst.open(name)
-        applied = 0
-        last = pos
-        async for tid, event, args in journal.entries_after(pos):
-            await apply_event(dst_img, event, args)
-            last = tid
-            applied += 1
+        pos = await journal.committed()
+        horizon = await journal.trim_horizon()
+        from_tid = None
+        if pos + 1 < horizon:
+            await self._bootstrap(name, dst_img)
+            # the copy subsumes every trimmed entry; surviving entries
+            # re-apply idempotently on top of it
+            from_tid = horizon - 1
+        applied = await replay_to_image(dst_img, journal,
+                                        from_tid=from_tid)
+        if from_tid is not None and applied == 0:
+            # bootstrap with an empty surviving stream: persist the
+            # position or every pass would re-bootstrap
+            await journal.commit(from_tid)
         if applied:
-            await journal.commit(last)
             await journal.trim()
         await dst_img.close()
         self.entries_applied += applied

@@ -171,10 +171,19 @@ class RadosModel:
 
     async def _op_omap_get(self, name: str) -> None:
         m = self.model.get(name)
-        kv = await self.ioctx.get_omap(name) \
-            if m is not None or not self.ec else {}
         if m is None:
+            if not self.ec:
+                # reference do_osd_ops: omap reads on a missing object
+                # are -ENOENT
+                try:
+                    await self.ioctx.get_omap(name)
+                    raise AssertionError(
+                        f"omap_get on absent {name} must ENOENT")
+                except RadosError as e:
+                    assert e.rc == -2, e
+                self.checks += 1
             return
+        kv = await self.ioctx.get_omap(name)
         assert kv == m.omap, f"omap {name}: {kv} != {m.omap}"
         self.checks += 1
 

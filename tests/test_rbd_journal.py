@@ -249,3 +249,43 @@ def test_journal_tail_survives_interrupted_trim():
         await r.shutdown()
         await c.stop()
     asyncio.run(run())
+
+
+def test_journal_mirror_bootstraps_after_trim():
+    """A replayer registering AFTER the journal was trimmed (its
+    position predates the horizon) must full-sync the image instead of
+    silently skipping the trimmed entries."""
+    async def run():
+        c1, r1, src = await _zone("jC-")
+        c2, r2, dst = await _zone("jD-")
+        await src.create("vol", size=1 << 15, order=14)
+        img = await src.open("vol", journaled=True)
+        # small journal objects so trim actually removes entries
+        img._journal.per_obj = 4
+        for i in range(10):
+            await img.write(i * 100, b"%02d" % i)
+        await img.close()                 # commits + trims (only client)
+        horizon = await img._journal.trim_horizon()
+        assert horizon > 0, "test needs a trimmed journal"
+
+        rep = JournalReplayer(src, dst)
+        # replayer's journal handle must agree on the segment size
+        from ceph_tpu.services.rbd_journal import ImageJournal
+        image_id = await src.image_id("vol")
+        j = ImageJournal(src.ioctx, image_id, client_id="mirror",
+                        per_obj=4)
+        await j.register()
+        rep._journals["vol"] = j
+        await rep.sync_once()
+        assert rep.images_bootstrapped == 1
+        dimg = await dst.open("vol")
+        for i in range(10):
+            assert await dimg.read(i * 100, 2) == b"%02d" % i
+        # second pass: no re-bootstrap, nothing new
+        await rep.sync_once()
+        assert rep.images_bootstrapped == 1
+        await r1.shutdown()
+        await r2.shutdown()
+        await c1.stop()
+        await c2.stop()
+    asyncio.run(run())
