@@ -163,16 +163,26 @@ class CephFS:
                 active = (r["data"]["filesystems"]
                           .get(fs_name, {}).get("active"))
             if active is not None:
-                return cls(rados, active["addr"])
+                return cls(rados, active["addr"], fs_name=fs_name)
             if asyncio.get_running_loop().time() > deadline:
                 raise FSError(
                     -110, f"no active mds for fs {fs_name!r}"
                 )
             await asyncio.sleep(0.1)
 
-    def __init__(self, rados: Rados, mds_addr: str):
+    def __init__(self, rados: Rados, mds_addr: str,
+                 fs_name: str = "cephfs"):
         self.rados = rados
         self.mds_addr = mds_addr
+        self.fs_name = fs_name
+        # rank -> address (multi-active; refreshed from mds stat on a
+        # redirect to a rank we do not know yet)
+        self._rank_addrs: dict[int, str] = {0: mds_addr}
+        # rank -> snapids from that rank's last reply: each rank only
+        # knows its own realms, so the data-pool snap context is the
+        # UNION — a snap-unaware rank's reply must not regress it and
+        # un-COW another rank's live snapshot
+        self._snapc_by_rank: dict[int, set[int]] = {}
         self.root = 1
         self.block_size = 1 << 22
         self.data: IoCtx | None = None
@@ -216,29 +226,58 @@ class CephFS:
         self._mounted = False
         self.rados.msgr.set_dispatcher(self.rados)
 
+    async def _addr_for_rank(self, rank: int) -> str:
+        addr = self._rank_addrs.get(rank)
+        if addr is not None:
+            return addr
+        r = await self.rados.mon_command("mds stat")
+        if r["rc"] == 0:
+            for a in (r["data"]["filesystems"]
+                      .get(self.fs_name, {}).get("actives", ())):
+                self._rank_addrs[int(a["rank"])] = str(a["addr"])
+        addr = self._rank_addrs.get(rank)
+        if addr is None:
+            raise FSError(-110, f"no active mds for rank {rank}")
+        return addr
+
     async def _request(self, op: str, timeout: float = 30.0,
-                       **args) -> dict:
-        self._tid += 1
-        tid = self._tid
-        fut = asyncio.get_running_loop().create_future()
-        self._futs[tid] = fut
-        try:
-            await self.rados.msgr.send_to(
-                self.mds_addr,
-                Message("mds_request", {"tid": tid, "op": op, **args}),
-                "mds.x",
-            )
-            reply = await asyncio.wait_for(fut, timeout)
-        except (ConnectionError, asyncio.TimeoutError) as e:
-            self._futs.pop(tid, None)
-            raise FSError(-110, f"mds request {op}: {e}") from e
+                       _addr: str | None = None, **args) -> dict:
+        rank = 0
+        for _hop in range(4):
+            self._tid += 1
+            tid = self._tid
+            fut = asyncio.get_running_loop().create_future()
+            self._futs[tid] = fut
+            try:
+                await self.rados.msgr.send_to(
+                    _addr or self.mds_addr,
+                    Message("mds_request",
+                            {"tid": tid, "op": op, **args}),
+                    "mds.x",
+                )
+                reply = await asyncio.wait_for(fut, timeout)
+            except (ConnectionError, asyncio.TimeoutError) as e:
+                self._futs.pop(tid, None)
+                raise FSError(-110, f"mds request {op}: {e}") from e
+            if "redirect_rank" in reply:
+                # the directory lives in another rank's subtree: retry
+                # there (Client follows the mdsmap the same way)
+                rank = int(reply["redirect_rank"])
+                if _hop >= 2:
+                    # ping-pong: our cached addr is stale (failover) —
+                    # force a refresh from the fsmap
+                    self._rank_addrs.pop(rank, None)
+                _addr = await self._addr_for_rank(rank)
+                continue
+            break
         if reply["rc"] != 0:
             raise FSError(reply["rc"], reply.get("err", op))
         snapc = reply.get("snapc")
         if snapc and self.data is not None:
-            self.data.set_snap_context(int(snapc.get("seq", 0)),
-                                       [int(x) for x in
-                                        snapc.get("snaps", ())])
+            self._snapc_by_rank[rank] = {
+                int(x) for x in snapc.get("snaps", ())}
+            union = sorted(set().union(*self._snapc_by_rank.values()))
+            self.data.set_snap_context(max(union, default=0), union)
         return reply
 
     # -- path walking ------------------------------------------------------
@@ -390,6 +429,16 @@ class CephFS:
         reply = await self._request("mksnap", ino=int(dentry["ino"]),
                                     name=name)
         return int(reply["snapid"])
+
+    async def export_dir(self, path: str, rank: int) -> None:
+        """Delegate the subtree at ``path`` to another active MDS rank
+        (the ``ceph mds export dir`` / Migrator role; operator API)."""
+        dentry = await self._resolve(path)
+        if dentry["type"] != "dir":
+            raise FSError(ENOTDIR, path)
+        await self._request("export_dir", ino=int(dentry["ino"]),
+                            rank=int(rank))
+        self._dcache.clear()
 
     async def rmsnap(self, path: str, name: str) -> None:
         dentry = await self._resolve(path)

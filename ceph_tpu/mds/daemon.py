@@ -44,7 +44,14 @@ ROOT_INO = 1
 JOURNAL_OID = "mds_journal"
 TABLE_OID = "mds_inotable"
 ANCHOR_OID = "mds_anchortab"
+SUBTREE_OID = "mds_subtree_map"
 _FRAME = struct.Struct("<I")
+# rank r allocates inos from r * RANK_INO_BASE (per-rank InoTable
+# partitions; reference preallocates per-rank ino ranges)
+RANK_INO_BASE = 1 << 40
+EBUSY = -16
+EXDEV = -18
+EREMOTE_RANK = -66          # client retries at reply["redirect_rank"]
 
 # errno-style codes shared with the client
 ENOENT = -2
@@ -75,12 +82,15 @@ def block_oid(ino: int, blockno: int) -> str:
 
 class MDSError(Exception):
     def __init__(self, rc: int, msg: str = "",
-                 missing_dentry: bool = False):
+                 missing_dentry: bool = False,
+                 redirect_rank: int | None = None):
         super().__init__(f"rc={rc} {msg}")
         self.rc = rc
         # distinguishes "the NAME is absent in an existing directory"
         # (create may proceed) from "the directory itself is absent"
         self.missing_dentry = missing_dentry
+        # EREMOTE_RANK: the rank the client should retry at
+        self.redirect_rank = redirect_rank
 
 
 def _dentry(ino: int, dtype: str, mode: int, size: int = 0) -> dict:
@@ -117,8 +127,14 @@ class MDSDaemon:
         self.msgr.set_dispatcher(self)
         self.next_ino = ROOT_INO + 1
         self.journal_len = 0
-        self._mutate = DLock("mds-mutate")  # single-MDS serialization
+        self._mutate = DLock("mds-mutate")  # per-rank serialization
         self.lease_ttl = 2.0
+        # multi-active: this daemon's rank (assigned by the MDSMonitor)
+        # and the subtree delegation map (dir ino -> authoritative rank;
+        # the Migrator/subtree-auth role, reference Migrator.h:50)
+        self.rank = 0
+        self._subtrees: dict[int, int] = {}
+        self._auth_cache: dict[int, int] = {}  # dir ino -> auth rank
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, timeout: float = 20.0) -> None:
@@ -127,6 +143,7 @@ class MDSDaemon:
         self.data = await self.rados.open_ioctx(self.data_pool)
         self.snaps: dict[int, dict] = {}
         await self._load_snaptable()
+        await self._load_subtrees()
         await self._load_table()
         await self._replay_journal()
         # ensure the root dirfrag exists
@@ -213,19 +230,46 @@ class MDSDaemon:
         ids = sorted(self.snaps)
         return {"seq": max(ids, default=0), "snaps": ids}
 
+    @property
+    def _journal_oid(self) -> str:
+        # per-rank journals: two actives must never interleave frames
+        # or compact each other's unapplied entries
+        return (JOURNAL_OID if self.rank == 0
+                else f"{JOURNAL_OID}.{self.rank}")
+
+    @property
+    def _table_key(self) -> str:
+        return ("next_ino" if self.rank == 0
+                else f"next_ino.{self.rank}")
+
+    def _ino_floor(self) -> int:
+        return (ROOT_INO + 1 if self.rank == 0
+                else self.rank * RANK_INO_BASE + 1)
+
     async def _load_table(self) -> None:
+        self.next_ino = self._ino_floor()
         try:
-            raw = await self.meta.get_xattr(TABLE_OID, "next_ino")
-            self.next_ino = int(raw)
+            raw = await self.meta.get_xattr(TABLE_OID, self._table_key)
+            self.next_ino = max(self.next_ino, int(raw))
         except RadosError as e:
             if e.rc != ENOENT:
                 raise
+
+    async def _load_subtrees(self) -> None:
+        try:
+            omap = await self.meta.get_omap(SUBTREE_OID)
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
+            omap = {}
+        self._subtrees = {int(k): int(v) for k, v in omap.items()}
+        self._auth_cache.clear()
 
     async def _replay_journal(self) -> None:
         """Re-apply journaled mutations a crash may have left unapplied
         (idempotent omap writes; MDLog replay role)."""
         try:
-            raw = await self.meta.read(JOURNAL_OID)
+            raw = await self.meta.read(self._journal_oid)
         except RadosError as e:
             if e.rc == ENOENT:
                 return
@@ -242,9 +286,16 @@ class MDSDaemon:
             except (ValueError, TypeError):
                 break
             pos += n
+        lo = self._ino_floor()
+        hi = (self.rank + 1) * RANK_INO_BASE if self.rank \
+            else RANK_INO_BASE
         for e in entries:
             ino = int(e.get("ino", 0))
-            if ino >= self.next_ino:
+            # only inos from OUR partition move the watermark: a journal
+            # entry touching a foreign rank's inode (e.g. an unlink
+            # after an export round trip) must not teleport this rank's
+            # allocator into that partition (duplicate ino allocation)
+            if lo <= ino < hi and ino >= self.next_ino:
                 self.next_ino = ino + 1
             try:
                 await self._apply(e)
@@ -257,7 +308,7 @@ class MDSDaemon:
 
     async def _journal(self, entry: dict) -> None:
         payload = encode(entry)
-        await self.meta.append(JOURNAL_OID,
+        await self.meta.append(self._journal_oid,
                                _FRAME.pack(len(payload)) + payload)
         self.journal_len += 1
 
@@ -269,10 +320,10 @@ class MDSDaemon:
             return
         await self.meta.operate(TABLE_OID, ObjectOperation()
                                 .create()
-                                .set_xattr("next_ino",
+                                .set_xattr(self._table_key,
                                            str(self.next_ino).encode()))
         try:
-            await self.meta.operate(JOURNAL_OID,
+            await self.meta.operate(self._journal_oid,
                                     ObjectOperation().write_full(b""))
         except RadosError:
             pass
@@ -474,7 +525,9 @@ class MDSDaemon:
             await self._set_dentry(int(e["dst_parent"]),
                                    str(e["dst_name"]), dentry)
             if dentry.get("type") == "dir":
-                # moved directory: refresh its parent back-pointer
+                # moved directory: ancestry chains changed
+                self._auth_cache.clear()
+                # refresh its parent back-pointer
                 op_x = ObjectOperation().create().set_xattr(
                     "parent", str(int(e["dst_parent"])).encode()
                 )
@@ -702,16 +755,23 @@ class MDSDaemon:
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if msg.type == "mds_takeover":
             # promotion after a failover: our table/journal view dates
-            # from boot — re-sync before serving mutations, or inos the
-            # failed active allocated could be handed out again
+            # from boot — re-sync (at the ASSIGNED rank) before serving
+            # mutations, or inos the failed active allocated could be
+            # handed out again
+            self.rank = int(msg.data.get("rank", self.rank))
             asyncio.get_running_loop().create_task(self._resync())
             return
         if msg.type == "mds_beacon_ack":
             # backup resync trigger: acks report our fsmap state, so a
-            # standby->active transition is seen even when the leader's
-            # one-shot takeover notify was lost
+            # standby->active transition (and our assigned rank) is
+            # seen even when the leader's one-shot notify was lost
             state = str(msg.data.get("state", ""))
-            if state == "up:active" and self._last_state == "up:standby":
+            rank = int(msg.data.get("rank", self.rank))
+            if state == "up:active" and (
+                    self._last_state == "up:standby"
+                    or (rank >= 0 and rank != self.rank)):
+                if rank >= 0:
+                    self.rank = rank
                 asyncio.get_running_loop().create_task(self._resync())
             self._last_state = state
             return
@@ -728,10 +788,54 @@ class MDSDaemon:
 
     async def _resync(self) -> None:
         async with self._mutate:
+            await self._load_snaptable()
+            await self._load_subtrees()
             await self._load_table()
             await self._replay_journal()
-        log.dout(1, "%s: resynced for takeover (next_ino=%d)",
-                 self.entity, self.next_ino)
+        log.dout(1, "%s: resynced for takeover (rank=%d next_ino=%d)",
+                 self.entity, self.rank, self.next_ino)
+
+    async def _auth_rank(self, dino: int) -> int:
+        """The rank authoritative for directory ``dino``: the nearest
+        subtree-map entry on its ancestry chain, default rank 0 (the
+        CDir subtree-auth resolution).  Memoized — invalidated on map
+        reload, export, and directory renames (which change chains)."""
+        if not self._subtrees:
+            return 0
+        hit = self._auth_cache.get(dino)
+        if hit is not None:
+            return hit
+        rank = 0
+        for link in await self._parent_chain(dino):
+            r = self._subtrees.get(link)
+            if r is not None:
+                rank = r
+                break
+        if len(self._auth_cache) > 65536:
+            self._auth_cache.clear()
+        self._auth_cache[dino] = rank
+        return rank
+
+    async def _check_auth(self, d: dict, op: str) -> None:
+        """Serve only requests for directories this rank is
+        authoritative over; others get a redirect the client follows
+        (the reference forwards between MDSs; -lite redirects)."""
+        if op == "session":
+            return
+        # rename routes by its SOURCE parent (the rank that owns the
+        # dentry being moved); its handler separately declines
+        # cross-rank destinations with EXDEV
+        dino = int(d.get("src_parent",
+                         d.get("parent", d.get("ino", ROOT_INO))))
+        auth = await self._auth_rank(dino)
+        if auth != self.rank:
+            # maybe our map is stale (a fresh export): refresh once
+            await self._load_subtrees()
+            auth = await self._auth_rank(dino)
+        if auth != self.rank:
+            raise MDSError(EREMOTE_RANK,
+                           f"dir {dino:x} is served by rank {auth}",
+                           redirect_rank=auth)
 
     async def _handle_request(self, conn: Connection, d: dict) -> None:
         tid = d.get("tid", 0)
@@ -740,6 +844,7 @@ class MDSDaemon:
             handler = getattr(self, f"_req_{op}", None)
             if handler is None:
                 raise MDSError(EINVAL, f"unknown mds op {op!r}")
+            await self._check_auth(d, op)
             if op in ("lookup", "readdir", "session", "lssnap"):
                 result = await handler(d)
             else:
@@ -753,6 +858,8 @@ class MDSDaemon:
             reply.setdefault("snapc", self._snapc_wire())
         except MDSError as e:
             reply = {"tid": tid, "rc": e.rc, "err": str(e)}
+            if e.redirect_rank is not None:
+                reply["redirect_rank"] = e.redirect_rank
         except RadosError as e:
             reply = {"tid": tid, "rc": e.rc, "err": str(e)}
         try:
@@ -902,6 +1009,13 @@ class MDSDaemon:
         if any(i["name"] == name and int(i["ino"]) == ino
                for i in self.snaps.values()):
             raise MDSError(EEXIST, f"snap {name!r} exists")
+        for s, r in self._subtrees.items():
+            if r != self.rank and (s == ino
+                                   or await self._is_ancestor(ino, s)):
+                raise MDSError(
+                    EINVAL, f"subtree {s:x} inside the realm is "
+                    f"delegated to rank {r}; snapshots must not span "
+                    "rank boundaries")
         snapid = await self.data.selfmanaged_snap_create()
         entry = {"op": "mksnap", "snapid": snapid,
                  "info": {"name": name, "ino": ino,
@@ -909,6 +1023,80 @@ class MDSDaemon:
         await self._journal(entry)
         await self._apply(entry)
         return {"snapid": snapid, "snapc": self._snapc_wire()}
+
+    async def _req_export_dir(self, d: dict) -> dict:
+        """Delegate the subtree at dir ``ino`` to another active rank
+        (the Migrator.h:50 subtree export, journal-coordinated: every
+        mutation this rank made is applied + compacted before the map
+        entry commits, so the importing rank starts from durable
+        state — the -lite design keeps no dirty MDS cache to migrate).
+        """
+        ino, rank = int(d["ino"]), int(d["rank"])
+        if rank < 0 or rank > 64:
+            raise MDSError(EINVAL, f"bad rank {rank}")
+        if rank != self.rank and not await self._rank_is_active(rank):
+            # a typo'd rank would blackhole the subtree: every client
+            # op would redirect to a rank nobody holds
+            raise MDSError(EINVAL, f"rank {rank} has no active mds")
+        try:
+            await self.meta.stat(dirfrag_oid(ino))
+        except RadosError as e:
+            raise MDSError(ENOENT, f"no dir {ino:x}") \
+                if e.rc == ENOENT else e
+        if await self._covering_snaps(ino):
+            raise MDSError(
+                EINVAL, "cannot export a subtree under a live snapshot")
+        await self._check_no_boundary_anchors(ino)
+        await self._compact_journal()
+        if rank == 0 and ino not in self._subtrees:
+            return {"rank": rank}
+        if rank == 0:
+            await self.meta.operate(
+                SUBTREE_OID, ObjectOperation().omap_rm([str(ino)]))
+            self._subtrees.pop(ino, None)
+        else:
+            await self.meta.operate(
+                SUBTREE_OID, ObjectOperation().create()
+                .omap_set({str(ino): str(rank).encode()}))
+            self._subtrees[ino] = rank
+        self._auth_cache.clear()
+        log.dout(1, "%s: exported dir %x to rank %d", self.entity,
+                 ino, rank)
+        return {"rank": rank}
+
+    async def _rank_is_active(self, rank: int) -> bool:
+        try:
+            r = await self.rados.mon_command("mds stat")
+        except (ConnectionError, OSError):
+            return False
+        if r.get("rc") != 0:
+            return False
+        actives = (r["data"]["filesystems"]
+                   .get(self.fs_name, {}).get("actives", ()))
+        return any(int(a.get("rank", -1)) == rank for a in actives)
+
+    async def _check_no_boundary_anchors(self, ino: int) -> None:
+        """Hard links whose names straddle the export boundary would
+        put the primary and remotes under different authorities (the
+        same hazard the EXDEV link guard prevents going forward)."""
+        try:
+            omap = await self.meta.get_omap(ANCHOR_OID)
+        except RadosError as e:
+            if e.rc == ENOENT:
+                return
+            raise
+        for raw in omap.values():
+            rec = decode(raw)
+            names = [rec["primary"]] + list(rec.get("remotes", ()))
+            inside = []
+            for p, _ in names:
+                p = int(p)
+                inside.append(p == ino
+                              or await self._is_ancestor(ino, p))
+            if any(inside) and not all(inside):
+                raise MDSError(
+                    EBUSY, "a hard link spans the export boundary; "
+                    "unlink it first")
 
     async def _req_rmsnap(self, d: dict) -> dict:
         ino, name = int(d["ino"]), str(d["name"])
@@ -934,6 +1122,11 @@ class MDSDaemon:
         (parent, name) referencing the primary's inode."""
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["parent"]), str(d["name"])
+        if await self._auth_rank(sp) != self.rank \
+                or await self._auth_rank(dp) != self.rank:
+            # hard links across rank boundaries would put the anchor
+            # and primary under different authorities
+            raise MDSError(EXDEV, "link crosses a rank boundary")
         dentry = await self._get_dentry(sp, sn)
         if dentry.get("remote"):
             # keep link chains flat: always link to the primary
@@ -972,6 +1165,8 @@ class MDSDaemon:
         dentry = await self._get_dentry(parent, name)
         if dentry["type"] != "dir":
             raise MDSError(ENOTDIR, name)
+        if int(dentry["ino"]) in self._subtrees:
+            raise MDSError(EBUSY, f"{name!r} is a subtree export root")
         kv = await self.meta.get_omap(dirfrag_oid(int(dentry["ino"])))
         if kv:
             raise MDSError(ENOTEMPTY, name)
@@ -1001,7 +1196,15 @@ class MDSDaemon:
     async def _req_rename(self, d: dict) -> dict:
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
+        if await self._auth_rank(dp) != self.rank:
+            # a rename landing in another rank's subtree needs the
+            # reference's multi-MDS witness protocol; -lite declines
+            # (the client surfaces EXDEV like a cross-mount rename)
+            raise MDSError(EXDEV, "rename crosses a rank boundary")
         dentry = await self._get_dentry(sp, sn)
+        if dentry.get("type") == "dir" \
+                and int(dentry["ino"]) in self._subtrees:
+            raise MDSError(EBUSY, f"{sn!r} is a subtree export root")
         unlinked_ino = 0
         if (sp, sn) == (dp, dn):
             # POSIX rename-to-self is a no-op — it must not purge the

@@ -79,18 +79,57 @@ class MDSMonitor(PaxosService):
         if info is not None and info["addr"] == addr \
                 and info["state"] != STATE_DOWN:
             return False
+        state, rank = self._pick_role(name, fs)
         self.mds[name] = {
-            "addr": addr, "fs": fs,
-            "state": self._pick_state(name, fs),
+            "addr": addr, "fs": fs, "state": state, "rank": rank,
         }
+        if state == STATE_ACTIVE:
+            # a daemon assigned straight to an active rank (no standby
+            # phase) must learn its rank NOW, not at the next beacon
+            # ack — it would otherwise serve with rank-0 journal/table
+            self._notify_takeover(name, addr)
         self.pending = True
         return True
 
-    def _pick_state(self, name: str, fs: str) -> str:
-        active = [n for n, i in self.mds.items()
-                  if n != name and i["fs"] == fs
-                  and i["state"] == STATE_ACTIVE]
-        return STATE_STANDBY if active else STATE_ACTIVE
+    def _held_ranks(self, fs: str, skip: str = "") -> set[int]:
+        return {int(i.get("rank", 0)) for n, i in self.mds.items()
+                if n != skip and i["fs"] == fs
+                and i["state"] == STATE_ACTIVE}
+
+    def _pick_role(self, name: str, fs: str) -> tuple[str, int]:
+        """Fill active ranks 0..max_mds-1 (FSMap rank assignment);
+        everyone else stands by."""
+        max_mds = int(self.filesystems.get(fs, {}).get("max_mds", 1))
+        held = self._held_ranks(fs, skip=name)
+        for rank in range(max_mds):
+            if rank not in held:
+                return STATE_ACTIVE, rank
+        return STATE_STANDBY, -1
+
+    def promote_standbys(self, fs: str) -> bool:
+        """Fill vacant ranks from standbys (after max_mds raise or a
+        failover); returns True when the map changed."""
+        changed = False
+        while True:
+            max_mds = int(self.filesystems.get(fs, {}).get("max_mds", 1))
+            held = self._held_ranks(fs)
+            vacant = next((r for r in range(max_mds) if r not in held),
+                          None)
+            if vacant is None:
+                return changed
+            standby = next((n for n, i in self.mds.items()
+                            if i["fs"] == fs
+                            and i["state"] == STATE_STANDBY), None)
+            if standby is None:
+                return changed
+            self.mds[standby]["state"] = STATE_ACTIVE
+            self.mds[standby]["rank"] = vacant
+            self.mon.cluster_log(
+                "info", f"mds.{standby} takes rank {vacant} for fs "
+                f"{fs!r}"
+            )
+            self._notify_takeover(standby, self.mds[standby]["addr"])
+            changed = True
 
     async def tick(self) -> None:
         """Leader: age out beacon-silent daemons and fail over."""
@@ -115,24 +154,12 @@ class MDSMonitor(PaxosService):
                     f"{grace:g}s)"
                 )
                 if was_active:
-                    standby = next(
-                        (n for n, i in self.mds.items()
-                         if i["fs"] == info["fs"]
-                         and i["state"] == STATE_STANDBY), None,
-                    )
-                    if standby is not None:
-                        self.mds[standby]["state"] = STATE_ACTIVE
-                        self.mon.cluster_log(
-                            "info", f"mds.{standby} takes over as "
-                            f"active for fs {info['fs']!r}"
-                        )
-                        # the standby's in-memory table/journal view is
-                        # as old as its boot; tell it to resync BEFORE
-                        # clients discover it (an ino handed out by the
-                        # failed active must never be re-allocated)
-                        self._notify_takeover(
-                            standby, self.mds[standby]["addr"]
-                        )
+                    # the standby's in-memory table/journal view is as
+                    # old as its boot; promote_standbys notifies it to
+                    # resync for the failed rank BEFORE clients discover
+                    # it (an ino handed out by the failed active must
+                    # never be re-allocated)
+                    self.promote_standbys(info["fs"])
         if changed:
             self.pending = True
             await self.mon.propose_pending()
@@ -142,10 +169,13 @@ class MDSMonitor(PaxosService):
 
         from ceph_tpu.msg.message import Message
 
+        rank = int(self.mds.get(name, {}).get("rank", 0))
+
         async def _send():
             try:
                 await self.mon.msgr.send_to(
-                    addr, Message("mds_takeover", {"name": name}),
+                    addr, Message("mds_takeover",
+                                  {"name": name, "rank": rank}),
                     f"mds.{name}",
                 )
             except (ConnectionError, OSError):
@@ -193,12 +223,22 @@ class MDSMonitor(PaxosService):
             for fs in self.filesystems:
                 members = {n: i for n, i in self.mds.items()
                            if i["fs"] == fs}
-                active = next((
-                    {"name": n, "addr": i["addr"]}
-                    for n, i in members.items()
-                    if i["state"] == STATE_ACTIVE), None)
+                actives = sorted(
+                    ({"name": n, "addr": i["addr"],
+                      "rank": int(i.get("rank", 0))}
+                     for n, i in members.items()
+                     if i["state"] == STATE_ACTIVE),
+                    key=lambda a: a["rank"])
+                rank0 = next((a for a in actives if a["rank"] == 0),
+                             None)
                 out[fs] = {
-                    "active": active,
+                    # rank-0 kept under the legacy "active" key
+                    "active": ({"name": rank0["name"],
+                                "addr": rank0["addr"]}
+                               if rank0 else None),
+                    "actives": actives,
+                    "max_mds": int(self.filesystems[fs].get(
+                        "max_mds", 1)),
                     "standby": sorted(
                         n for n, i in members.items()
                         if i["state"] == STATE_STANDBY),
@@ -229,10 +269,25 @@ class MDSMonitor(PaxosService):
                 )
             self.filesystems[fs] = {
                 "meta_pool": meta, "data_pool": data,
-                "created": time.time(),
+                "created": time.time(), "max_mds": 1,
             }
             self._stage(tx)
             return CommandResult(outs=f"filesystem {fs!r} created")
+        if name == "fs set_max_mds":
+            fs = str(cmd.get("fs_name", ""))
+            if fs not in self.filesystems:
+                return CommandResult(ENOENT_RC, f"no fs {fs!r}")
+            try:
+                n = int(cmd.get("max_mds", 1))
+            except (TypeError, ValueError):
+                return CommandResult(EINVAL_RC, "max_mds must be int")
+            if not 1 <= n <= 16:
+                return CommandResult(EINVAL_RC,
+                                     "max_mds must be in [1, 16]")
+            self.filesystems[fs]["max_mds"] = n
+            self.promote_standbys(fs)
+            self._stage(tx)
+            return CommandResult(outs=f"fs {fs!r} max_mds = {n}")
         if name == "fs rm":
             fs = str(cmd.get("fs_name", ""))
             if fs not in self.filesystems:

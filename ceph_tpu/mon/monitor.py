@@ -427,6 +427,7 @@ class Monitor:
             if info is not None:
                 self._reply(conn, Message("mds_beacon_ack", {
                     "state": info["state"],
+                    "rank": int(info.get("rank", 0)),
                     "epoch": self.mds_monitor.epoch,
                 }))
         elif t == "log":

@@ -1,0 +1,181 @@
+"""Multi-active MDS: rank assignment, subtree export, client
+redirects, rank failover (reference Migrator.h:50 subtree export +
+FSMap multi-rank territory at -lite scale)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS, FSError
+from ceph_tpu.mds.daemon import RANK_INO_BASE
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _two_rank_cluster(block_size=4096):
+    cluster = DevCluster(n_mons=1, n_osds=3)
+    await cluster.start()
+    admin = await cluster.client()
+    await admin.pool_create("cephfs_meta", pg_num=4, size=3, min_size=2)
+    await admin.pool_create("cephfs_data", pg_num=4, size=3, min_size=2)
+    mds_a = await cluster.start_mds(name="a", block_size=block_size)
+    mds_b = await cluster.start_mds(name="b", block_size=block_size)
+    r = await admin.mon_command("fs set_max_mds", fs_name="cephfs",
+                                max_mds=2)
+    assert r["rc"] == 0, r
+    # wait for rank 1 to be assigned and for mds b to learn it
+    deadline = asyncio.get_running_loop().time() + 10
+    while True:
+        r = await admin.mon_command("mds stat")
+        actives = r["data"]["filesystems"]["cephfs"]["actives"]
+        if len(actives) == 2 and mds_b.rank == 1:
+            break
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"rank 1 never became active: {actives}")
+        await asyncio.sleep(0.05)
+    await admin.shutdown()
+    rados = await cluster.client("client.fs")
+    fs = CephFS(rados, str(mds_a.msgr.my_addr))
+    await fs.mount()
+    return cluster, mds_a, mds_b, rados, fs
+
+
+async def _teardown(cluster, rados, fs):
+    await fs.unmount()
+    await rados.shutdown()
+    await cluster.stop()
+
+
+def test_two_ranks_serve_disjoint_subtrees():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        assert mds_a.rank == 0 and mds_b.rank == 1
+
+        await fs.mkdirs("/shared/sub")
+        await fs.write_file("/root-file", b"rank0")
+        await fs.export_dir("/shared", 1)
+
+        # ops under /shared are transparently redirected to rank 1
+        await fs.write_file("/shared/sub/f1", b"served by rank1")
+        assert await fs.read_file("/shared/sub/f1") == b"served by rank1"
+        await fs.mkdir("/shared/newdir")
+        assert sorted(await fs.readdir("/shared")) == ["newdir", "sub"]
+        # rank 1 allocates from its own ino partition (no collisions
+        # with rank 0's InoTable)
+        st = await fs.stat("/shared/newdir")
+        assert int(st["ino"]) >= RANK_INO_BASE
+        # root stays at rank 0
+        assert await fs.read_file("/root-file") == b"rank0"
+        st0 = await fs.stat("/root-file")
+        assert int(st0["ino"]) < RANK_INO_BASE
+
+        # authority really is enforced server-side: asking rank 0
+        # directly for the exported dir gets a redirect, not service
+        from ceph_tpu.mds.daemon import EREMOTE_RANK
+        sub_ino = int((await fs.stat("/shared"))["ino"])
+        reply = await fs._request("readdir", ino=sub_ino,
+                                  _addr=str(mds_b.msgr.my_addr))
+        assert reply["rc"] == 0          # rank 1 serves it
+        try:
+            # bypass redirect-following by talking to the socket level:
+            # handler must answer EREMOTE_RANK + redirect_rank
+            import ceph_tpu.msg.message as mm
+            fut = asyncio.get_running_loop().create_future()
+            fs._tid += 1
+            fs._futs[fs._tid] = fut
+            await rados.msgr.send_to(
+                str(mds_a.msgr.my_addr),
+                mm.Message("mds_request", {
+                    "tid": fs._tid, "op": "readdir", "ino": sub_ino}),
+                "mds.a")
+            raw = await asyncio.wait_for(fut, 10)
+            assert raw["rc"] == EREMOTE_RANK
+            assert raw["redirect_rank"] == 1
+        finally:
+            pass
+
+        # renames WITHIN the delegated subtree route to rank 1 and work
+        await fs.write_file("/shared/sub/mv-src", b"moving")
+        await fs.rename("/shared/sub/mv-src", "/shared/mv-dst")
+        assert await fs.read_file("/shared/mv-dst") == b"moving"
+        # cross-rank rename / link are declined (EXDEV), not corrupted
+        with pytest.raises(FSError) as ei:
+            await fs.rename("/root-file", "/shared/moved")
+        assert ei.value.rc == -18
+        with pytest.raises(FSError) as ei:
+            await fs.rename("/shared/mv-dst", "/escaped")
+        assert ei.value.rc == -18
+        await fs.write_file("/shared/lfile", b"x")
+        with pytest.raises(FSError) as ei:
+            await fs.link("/shared/lfile", "/rootlink")
+        assert ei.value.rc == -18
+        # export root removal is refused while delegated
+        with pytest.raises(FSError) as ei:
+            await fs.rename("/shared", "/renamed")
+        assert ei.value.rc == -16
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_rank1_failover_standby_takes_over():
+    """Chaos criterion: kill the rank-1 MDS mid-service; a standby is
+    promoted to rank 1 (resyncing the rank's journal) and the client
+    keeps operating under the exported subtree."""
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        await fs.mkdirs("/shared")
+        await fs.export_dir("/shared", 1)
+        await fs.write_file("/shared/before", b"pre-kill")
+
+        # a standby waits in the wings
+        mds_c = await cluster.start_mds(name="c", block_size=4096)
+        await asyncio.sleep(0.2)
+        assert mds_c.rank == 0 and mds_c._last_state != "up:active"
+
+        await mds_b.shutdown()           # rank 1 dies silently
+        del cluster.mdss["b"]
+        deadline = asyncio.get_running_loop().time() + 15
+        while mds_c._last_state != "up:active":
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError("standby never promoted")
+            await asyncio.sleep(0.05)
+        assert mds_c.rank == 1
+        # give the resync a beat, then keep working under /shared
+        await asyncio.sleep(0.3)
+        fs._rank_addrs.pop(1, None)      # drop the dead daemon's addr
+        assert await fs.read_file("/shared/before") == b"pre-kill"
+        await fs.write_file("/shared/after", b"post-failover")
+        assert await fs.read_file("/shared/after") == b"post-failover"
+        assert sorted(await fs.readdir("/shared")) == \
+            ["after", "before"]
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_snapshots_refuse_rank_boundaries():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        await fs.mkdirs("/area/inner")
+        await fs.export_dir("/area/inner", 1)
+        with pytest.raises(FSError) as ei:
+            await fs.mksnap("/area", "spanning")
+        assert ei.value.rc == -22
+        # a snapshot fully inside one rank's region is fine
+        await fs.mkdirs("/solo")
+        await fs.write_file("/solo/f", b"v1")
+        await fs.mksnap("/solo", "ok")
+        await fs.write_file("/solo/f", b"v2")
+        assert await fs.read_file("/solo/.snap/ok/f") == b"v1"
+        # and exporting under a live snapshot is refused
+        with pytest.raises(FSError) as ei:
+            await fs.export_dir("/solo", 1)
+        assert ei.value.rc == -22
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
