@@ -243,30 +243,50 @@ class CephFS:
     async def _request(self, op: str, timeout: float = 30.0,
                        _addr: str | None = None, **args) -> dict:
         rank = 0
-        for _hop in range(4):
+        for _hop in range(6):
             self._tid += 1
             tid = self._tid
             fut = asyncio.get_running_loop().create_future()
             self._futs[tid] = fut
+            payload = {"tid": tid, "op": op, **args}
+            if _hop >= 2:
+                # ping-ponging between ranks: tell the server to skip
+                # its subtree-map refresh throttle (a fresh export is
+                # still propagating)
+                payload["refresh_subtrees"] = True
             try:
                 await self.rados.msgr.send_to(
                     _addr or self.mds_addr,
-                    Message("mds_request",
-                            {"tid": tid, "op": op, **args}),
+                    Message("mds_request", payload),
                     "mds.x",
                 )
                 reply = await asyncio.wait_for(fut, timeout)
             except (ConnectionError, asyncio.TimeoutError) as e:
                 self._futs.pop(tid, None)
+                if rank != 0 and _hop < 3:
+                    # the redirected-to rank may have failed over to a
+                    # new address: drop the cached addr and re-resolve
+                    # from the fsmap before giving up
+                    stale = self._rank_addrs.pop(rank, None)
+                    try:
+                        _addr = await self._addr_for_rank(rank)
+                    except FSError:
+                        raise FSError(-110,
+                                      f"mds request {op}: {e}") from e
+                    if _addr != stale:
+                        continue
                 raise FSError(-110, f"mds request {op}: {e}") from e
             if "redirect_rank" in reply:
                 # the directory lives in another rank's subtree: retry
                 # there (Client follows the mdsmap the same way)
                 rank = int(reply["redirect_rank"])
                 if _hop >= 2:
-                    # ping-pong: our cached addr is stale (failover) —
-                    # force a refresh from the fsmap
+                    # ping-pong: either our cached addr is stale
+                    # (failover) or the MDSs' subtree maps are still
+                    # propagating a fresh export — refresh the addr and
+                    # give their refresh throttles a beat
                     self._rank_addrs.pop(rank, None)
+                    await asyncio.sleep(0.4)
                 _addr = await self._addr_for_rank(rank)
                 continue
             break

@@ -135,6 +135,7 @@ class MDSDaemon:
         self.rank = 0
         self._subtrees: dict[int, int] = {}
         self._auth_cache: dict[int, int] = {}  # dir ino -> auth rank
+        self._subtrees_loaded = 0.0            # refresh throttle stamp
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, timeout: float = 20.0) -> None:
@@ -264,6 +265,7 @@ class MDSDaemon:
             omap = {}
         self._subtrees = {int(k): int(v) for k, v in omap.items()}
         self._auth_cache.clear()
+        self._subtrees_loaded = time.monotonic()
 
     async def _replay_journal(self) -> None:
         """Re-apply journaled mutations a crash may have left unapplied
@@ -800,21 +802,32 @@ class MDSDaemon:
         subtree-map entry on its ancestry chain, default rank 0 (the
         CDir subtree-auth resolution).  Memoized — invalidated on map
         reload, export, and directory renames (which change chains)."""
-        if not self._subtrees:
-            return 0
+        return (await self._auth_rank_ex(dino))[0]
+
+    async def _auth_rank_ex(self, dino: int) -> tuple[int, bool]:
+        """(auth rank, explicit): ``explicit`` is False when resolution
+        fell through to the rank-0 default — the caller may want to
+        refresh the map before trusting it (a fresh export toward us
+        looks exactly like that)."""
+        if not self._subtrees and self.rank == 0:
+            return 0, True
         hit = self._auth_cache.get(dino)
         if hit is not None:
-            return hit
-        rank = 0
+            return hit, True
+        rank, explicit = 0, False
         for link in await self._parent_chain(dino):
             r = self._subtrees.get(link)
             if r is not None:
-                rank = r
+                rank, explicit = r, True
                 break
-        if len(self._auth_cache) > 65536:
-            self._auth_cache.clear()
-        self._auth_cache[dino] = rank
-        return rank
+        if explicit or (rank == self.rank == 0):
+            # defaulted results are cacheable for rank 0 (it IS the
+            # default); other ranks must keep re-deriving them so a
+            # fresh export toward them is noticed (refresh trigger)
+            if len(self._auth_cache) > 65536:
+                self._auth_cache.clear()
+            self._auth_cache[dino] = rank
+        return rank, explicit
 
     async def _check_auth(self, d: dict, op: str) -> None:
         """Serve only requests for directories this rank is
@@ -827,9 +840,17 @@ class MDSDaemon:
         # cross-rank destinations with EXDEV
         dino = int(d.get("src_parent",
                          d.get("parent", d.get("ino", ROOT_INO))))
-        auth = await self._auth_rank(dino)
-        if auth != self.rank:
-            # maybe our map is stale (a fresh export): refresh once
+        auth, explicit = await self._auth_rank_ex(dino)
+        if auth != self.rank and (
+                not explicit
+                or d.get("refresh_subtrees")
+                or time.monotonic() - self._subtrees_loaded > 1.0):
+            # maybe our map is stale (a fresh export toward us looks
+            # like a default-fallback miss, and a ping-ponging client
+            # sends refresh_subtrees): refresh — but when an explicit
+            # entry already explains the redirect, throttle; redirecting
+            # is the NORMAL case for rank 0 (clients start there) and an
+            # omap read per op would tax the hot path
             await self._load_subtrees()
             auth = await self._auth_rank(dino)
         if auth != self.rank:
@@ -1048,12 +1069,20 @@ class MDSDaemon:
                 EINVAL, "cannot export a subtree under a live snapshot")
         await self._check_no_boundary_anchors(ino)
         await self._compact_journal()
-        if rank == 0 and ino not in self._subtrees:
-            return {"rank": rank}
-        if rank == 0:
-            await self.meta.operate(
-                SUBTREE_OID, ObjectOperation().omap_rm([str(ino)]))
-            self._subtrees.pop(ino, None)
+        # an entry is only redundant when it matches what the PARENT
+        # chain already resolves to; "back to rank 0" under a delegated
+        # ancestor needs an explicit {ino: 0} override, not a removal
+        parent_auth = 0
+        for link in (await self._parent_chain(ino))[1:]:
+            r = self._subtrees.get(link)
+            if r is not None:
+                parent_auth = r
+                break
+        if rank == parent_auth:
+            if ino in self._subtrees:
+                await self.meta.operate(
+                    SUBTREE_OID, ObjectOperation().omap_rm([str(ino)]))
+                self._subtrees.pop(ino, None)
         else:
             await self.meta.operate(
                 SUBTREE_OID, ObjectOperation().create()

@@ -23,6 +23,62 @@ BUCKETS_OID = "rgw.buckets"          # omap: bucket name -> meta
 STRIPE_THRESHOLD = 4 * 1024 * 1024
 
 
+# -- SSE-C (reference rgw_crypt.cc customer-key encryption) ---------------
+# AES-256-CTR with a per-object random nonce: the keystream is seekable
+# (counter = nonce + byte_offset/16), so ranged GETs decrypt any window
+# without reading from zero — the role of the reference's chunk-aligned
+# AES-CBC scheme.  The key is never stored; only its MD5 rides the index
+# entry so GETs can validate the presented key (S3 SSE-C contract).
+
+def sse_begin(key: bytes) -> dict:
+    import secrets as _secrets
+
+    if len(key) != 32:
+        raise RGWError("InvalidArgument", "SSE-C key must be 32 bytes")
+    return {
+        "alg": "AES256",
+        "key_md5": hashlib.md5(key).hexdigest(),
+        "nonce": _secrets.token_bytes(16).hex(),
+    }
+
+
+def sse_crypt(key: bytes, nonce: bytes, offset: int,
+              data: bytes) -> bytes:
+    """En/decrypt ``data`` as the CTR keystream window starting at byte
+    ``offset`` of the object (CTR: encrypt == decrypt)."""
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher,
+        algorithms,
+        modes,
+    )
+
+    counter = (int.from_bytes(nonce, "big") + offset // 16) % (1 << 128)
+    enc = Cipher(
+        algorithms.AES(key),
+        modes.CTR(counter.to_bytes(16, "big")),
+    ).encryptor()
+    skip = offset % 16
+    if skip:
+        enc.update(b"\0" * skip)        # discard partial-block keystream
+    return enc.update(data)
+
+
+def sse_check(entry: dict, key: bytes | None) -> None:
+    """S3 semantics: an SSE-C object requires the matching key on every
+    read; presenting a key for a plaintext object is an error too."""
+    sse = entry.get("sse")
+    if sse is None:
+        if key is not None:
+            raise RGWError("InvalidRequest",
+                           "object is not SSE-C encrypted")
+        return
+    if key is None:
+        raise RGWError("InvalidRequest",
+                       "object is SSE-C encrypted; key required")
+    if hashlib.md5(key).hexdigest() != sse["key_md5"]:
+        raise RGWError("AccessDenied", "SSE-C key mismatch")
+
+
 USERS_OID = "rgw.users"              # omap: uid -> user record json
 KEYS_OID = "rgw.users.keys"          # omap: access key -> uid
 
@@ -142,6 +198,83 @@ class RGWUsers:
         if rec.get("suspended"):
             raise RGWError("AccessDenied", "user suspended")
         return rec["uid"]
+
+
+class StreamingPut:
+    """One chunked PUT in flight (rgw_putobj processor role): write()
+    places each chunk at its running offset (striper for large bodies),
+    md5/SSE state accumulate incrementally, complete() publishes the
+    index entry, abort() removes whatever landed."""
+
+    def __init__(self, rgw: "RGWLite", ctx: dict, length: int,
+                 content_type: str, metadata: dict,
+                 sse: dict | None = None):
+        self._rgw = rgw
+        self._ctx = ctx
+        self.length = length
+        self._content_type = content_type
+        self._metadata = metadata
+        self._sse = sse
+        self._sse_key: bytes | None = None
+        self._pos = 0
+        self._md5 = hashlib.md5()
+        self._striped = length > STRIPE_THRESHOLD
+        self._buf = bytearray() if not self._striped else None
+
+    def set_sse_key(self, key: bytes) -> None:
+        self._sse = sse_begin(key)
+        self._sse_key = key
+
+    async def write(self, chunk: bytes) -> None:
+        if self._pos + len(chunk) > self.length:
+            await self.abort()
+            raise RGWError("InvalidArgument",
+                           "body exceeds declared Content-Length")
+        self._md5.update(chunk)
+        if self._sse_key is not None:
+            chunk = sse_crypt(self._sse_key,
+                              bytes.fromhex(self._sse["nonce"]),
+                              self._pos, chunk)
+        if self._striped:
+            await self._rgw.striper.write(self._ctx["oid"], chunk,
+                                          offset=self._pos)
+        else:
+            self._buf += chunk
+        self._pos += len(chunk)
+
+    async def complete(self) -> dict:
+        if self._pos != self.length:
+            await self.abort()
+            raise RGWError("IncompleteBody",
+                           f"{self._pos} of {self.length} bytes")
+        if not self._striped:
+            await self._rgw.ioctx.operate(
+                self._ctx["oid"],
+                ObjectOperation().write_full(bytes(self._buf)))
+        # replaced object's data is dropped only now — with the new
+        # bytes fully down, just before the index flips to them
+        dc = self._ctx.get("deferred_cleanup")
+        if dc is not None:
+            bucket, key = self._ctx["bucket"], self._ctx["key"]
+            if dc[0] == "null":
+                await self._rgw._remove_null_version(bucket, key)
+            else:
+                await self._rgw._remove_entry_data(bucket, key, dc[1])
+        return await self._rgw._finish_put(
+            self._ctx, self.length, self._md5.hexdigest(),
+            self._striped, self._content_type, self._metadata,
+            self._sse)
+
+    async def abort(self) -> None:
+        """Drop any data already landed; the index was never touched."""
+        try:
+            if self._striped:
+                await self._rgw.striper.remove(self._ctx["oid"])
+            else:
+                await self._rgw.ioctx.remove(self._ctx["oid"])
+        except RadosError as e:
+            if e.rc != -2:
+                raise
 
 
 class RGWError(IOError):
@@ -954,11 +1087,19 @@ class RGWLite:
     def _data_oid(bucket: str, key: str) -> str:
         return f"rgw.obj.{bucket}/{key}"
 
-    async def put_object(self, bucket: str, key: str, data: bytes,
-                         content_type: str = "binary/octet-stream",
-                         metadata: dict[str, str] | None = None,
-                         if_none_match: bool = False) -> dict:
-        """S3 PUT. ``if_none_match``: fail when the key exists ('*')."""
+    async def _prepare_put(self, bucket: str, key: str, length: int,
+                           if_none_match: bool,
+                           defer_cleanup: bool = False) -> dict:
+        """Everything a PUT decides BEFORE any body byte lands: ACL,
+        preconditions, quota (against the declared length), versioning
+        mode, target oid, and old-data cleanup.  Shared by the buffered
+        and streaming paths.
+
+        ``defer_cleanup`` (streaming): the replaced object's data must
+        survive until complete() — an aborted stream (disconnect, hash
+        mismatch) would otherwise have destroyed a durable object whose
+        index entry still stands.  The stream writes to a UNIQUE oid
+        and cleanup happens after the index flips to it."""
         meta = await self._check_bucket(bucket, "WRITE")
         index_oid = self._index_oid(bucket)
         existing = await self.ioctx.get_omap(index_oid, [key])
@@ -976,11 +1117,12 @@ class RGWLite:
             replaced = (json.loads(existing[key])["size"]
                         if key in existing else 0)
             is_replace = key in existing
-        await self._check_quota(bucket, meta, len(data),
+        await self._check_quota(bucket, meta, length,
                                 replaced_size=replaced,
                                 is_replace=is_replace)
-        etag = hashlib.md5(data).hexdigest()
         oid = self._data_oid(bucket, key)
+        version_id = None
+        deferred = None
         if versioned:
             # every PUT is a NEW version: prior data objects survive
             # under their own version ids (rgw versioned-bucket model)
@@ -990,6 +1132,18 @@ class RGWLite:
                 await self._adopt_null_version(
                     bucket, key, json.loads(existing[key])
                 )
+        elif defer_cleanup:
+            # unique data oid: an aborted stream removes only its own
+            # bytes; the old object stays intact and indexed
+            import secrets as _secrets
+
+            oid = f"{oid}\x00s\x00{_secrets.token_hex(8)}"
+            if key in existing:
+                old = json.loads(existing[key])
+                if suspended:
+                    deferred = ("null", None)
+                elif not old.get("version_id"):
+                    deferred = ("entry", old)
         elif key in existing:
             # drop the old data objects first: a smaller striped body
             # must not inherit the old size xattr / stale tail stripes
@@ -1002,29 +1156,80 @@ class RGWLite:
             # retrievable through the version API — never clean it
             if not old.get("version_id"):
                 await self._remove_entry_data(bucket, key, old)
+        return {"bucket": bucket, "key": key, "oid": oid,
+                "index_oid": index_oid, "versioned": versioned,
+                "suspended": suspended, "version_id": version_id,
+                "deferred_cleanup": deferred}
+
+    async def begin_put(self, bucket: str, key: str, length: int,
+                        content_type: str = "binary/octet-stream",
+                        metadata: dict[str, str] | None = None,
+                        if_none_match: bool = False,
+                        sse: dict | None = None) -> "StreamingPut":
+        """Chunked S3 PUT session (the beast frontend's streaming body
+        path): validation happens up front against the declared length,
+        then body chunks land at their striper offsets without ever
+        buffering the whole object."""
+        ctx = await self._prepare_put(bucket, key, length,
+                                      if_none_match,
+                                      defer_cleanup=True)
+        return StreamingPut(self, ctx, length, content_type,
+                            dict(metadata or {}), sse)
+
+    async def put_object(self, bucket: str, key: str, data: bytes,
+                         content_type: str = "binary/octet-stream",
+                         metadata: dict[str, str] | None = None,
+                         if_none_match: bool = False,
+                         sse_key: bytes | None = None) -> dict:
+        """S3 PUT. ``if_none_match``: fail when the key exists ('*').
+        ``sse_key``: SSE-C customer key (32 bytes, AES-256)."""
+        ctx = await self._prepare_put(bucket, key, len(data),
+                                      if_none_match)
+        etag = hashlib.md5(data).hexdigest()
+        size = len(data)
+        sse = None
+        if sse_key is not None:
+            sse = sse_begin(sse_key)
+            data = sse_crypt(sse_key, bytes.fromhex(sse["nonce"]),
+                             0, data)
+        oid = ctx["oid"]
         striped = len(data) > STRIPE_THRESHOLD
         if striped:
             await self.striper.write(oid, data)
         else:
             op = ObjectOperation().write_full(data)
             await self.ioctx.operate(oid, op)
+        return await self._finish_put(ctx, size, etag, striped,
+                                      content_type,
+                                      dict(metadata or {}), sse)
+
+    async def _finish_put(self, ctx: dict, size: int, etag: str,
+                          striped: bool, content_type: str,
+                          metadata: dict, sse: dict | None) -> dict:
+        """Publish the index entry once the data is down (shared by
+        buffered and streaming PUTs)."""
+        bucket, key = ctx["bucket"], ctx["key"]
+        versioned = ctx["versioned"]
+        version_id = ctx["version_id"]
         entry = {
-            "size": len(data), "etag": etag, "mtime": time.time(),
+            "size": size, "etag": etag, "mtime": time.time(),
             "content_type": content_type, "striped": striped,
-            "meta": dict(metadata or {}),
-            "data_oid": oid,
+            "meta": metadata,
+            "data_oid": ctx["oid"],
         }
+        if sse is not None:
+            entry["sse"] = sse
         if versioned:
             entry["version_id"] = version_id
             await self._record_version(bucket, key, entry)
-        elif suspended:
+        elif ctx["suspended"]:
             entry["version_id"] = "null"
             await self._record_version(bucket, key, entry)
-        await self.ioctx.set_omap(index_oid, {
+        await self.ioctx.set_omap(ctx["index_oid"], {
             key: json.dumps(entry).encode(),
         })
         await self._log(bucket, "put", key, etag)
-        out = {"etag": etag, "size": len(data)}
+        out = {"etag": etag, "size": size}
         if versioned:
             out["version_id"] = version_id
         return out
@@ -1041,26 +1246,68 @@ class RGWLite:
         return entry
 
     async def get_object(self, bucket: str, key: str,
-                         range_: tuple[int, int] | None = None) -> dict:
-        """S3 GET (optionally a byte range, inclusive bounds)."""
+                         range_: tuple[int, int] | None = None,
+                         sse_key: bytes | None = None) -> dict:
+        """S3 GET (optionally a byte range, inclusive bounds).
+        ``sse_key``: the SSE-C customer key for encrypted objects."""
         entry = await self._entry(bucket, key)
+        sse_check(entry, sse_key)
+        data = await self._read_entry_data(bucket, key, entry, range_)
+        if sse_key is not None:
+            start = range_[0] if range_ is not None else 0
+            data = sse_crypt(sse_key,
+                             bytes.fromhex(entry["sse"]["nonce"]),
+                             start, data)
+        return {"data": data, **entry}
+
+    async def _read_entry_data(self, bucket: str, key: str,
+                               entry: dict,
+                               range_: tuple[int, int] | None) -> bytes:
         oid = entry.get("data_oid", self._data_oid(bucket, key))
         if entry.get("multipart"):
-            data = await self._read_manifest(entry["multipart"],
+            return await self._read_manifest(entry["multipart"],
                                              entry["size"], range_)
-        elif range_ is not None:
+        if range_ is not None:
             start, end = range_
             end = min(end, entry["size"] - 1)
             length = max(0, end - start + 1)
             if entry["striped"]:
-                data = await self.striper.read(oid, length, start)
-            else:
-                data = await self.ioctx.read(oid, length, start)
-        elif entry["striped"]:
-            data = await self.striper.read(oid)
-        else:
-            data = await self.ioctx.read(oid)
-        return {"data": data, **entry}
+                return await self.striper.read(oid, length, start)
+            return await self.ioctx.read(oid, length, start)
+        if entry["striped"]:
+            return await self.striper.read(oid)
+        return await self.ioctx.read(oid)
+
+    async def stream_object(self, bucket: str, key: str,
+                            range_: tuple[int, int] | None = None,
+                            sse_key: bytes | None = None,
+                            chunk: int = 1 << 20,
+                            entry: dict | None = None):
+        """Chunked S3 GET: returns (entry, async-generator) so the
+        frontend never buffers the whole body (the beast frontend's
+        streaming response path).  ``entry``: pass a just-fetched index
+        entry to skip the re-read."""
+        if entry is None:
+            entry = await self._entry(bucket, key)
+        sse_check(entry, sse_key)
+        size = int(entry["size"])
+        start, end = (0, size - 1) if range_ is None else range_
+        end = min(end, size - 1)
+        nonce = (bytes.fromhex(entry["sse"]["nonce"])
+                 if sse_key is not None else b"")
+
+        async def gen():
+            pos = start
+            while pos <= end:
+                n = min(chunk, end - pos + 1)
+                data = await self._read_entry_data(
+                    bucket, key, entry, (pos, pos + n - 1))
+                if sse_key is not None:
+                    data = sse_crypt(sse_key, nonce, pos, data)
+                yield data
+                pos += n
+
+        return entry, gen()
 
     async def _read_manifest(self, manifest: list[dict], size: int,
                              range_: tuple[int, int] | None) -> bytes:

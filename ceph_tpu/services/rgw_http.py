@@ -37,7 +37,9 @@ from ceph_tpu.services.rgw import ANONYMOUS, RGWError, RGWLite, RGWUsers
 log = Dout("rgw-http")
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
-_MAX_BODY = 256 * 1024 * 1024
+_MAX_BODY = 256 * 1024 * 1024       # buffered (non-streaming) bodies only
+_STREAM_MIN = 1 << 20               # PUT bodies this big stream
+_STREAM_CHUNK = 1 << 20
 _EMPTY_SHA = hashlib.sha256(b"").hexdigest()
 
 # RGWError code -> HTTP status (rgw_common.cc rgw_http_s3_errors)
@@ -72,6 +74,11 @@ class _Request:
         self.method = method
         self.headers = headers
         self.body = body
+        # streaming PUT bodies: the socket reader + declared length;
+        # consumed tracks how much the handler actually drained
+        self.stream = None
+        self.content_length = len(body)
+        self.stream_consumed = 0
         path, _, query = raw_path.partition("?")
         self.raw_path = path
         self.path = urllib.parse.unquote(path)
@@ -189,6 +196,11 @@ class S3Frontend:
                 if req is None:
                     break
                 keep = req.header("connection", "keep-alive") != "close"
+                if req.stream is not None:
+                    # default pessimistic: only a fully drained body
+                    # leaves the socket reusable
+                    keep_after_stream = keep
+                    keep = False
                 try:
                     status, headers, body = await self._route(req)
                 except _HTTPError as e:
@@ -207,6 +219,9 @@ class S3Frontend:
                     log.dout(1, "request failed: %r", e)
                     status, headers, body = self._error(
                         500, "InternalError", type(e).__name__)
+                if req.stream is not None and \
+                        req.stream_consumed >= req.content_length:
+                    keep = keep_after_stream
                 await self._respond(writer, req, status, headers, body,
                                     keep)
                 if not keep:
@@ -244,31 +259,69 @@ class S3Frontend:
             raise _HTTPError(400, "InvalidArgument", "bad content-length")
         if length < 0:
             raise _HTTPError(400, "InvalidArgument", "bad content-length")
+        req = _Request(method.upper(), raw_path, headers, b"")
+        req.content_length = length
+        if self._should_stream(req, length):
+            # body stays on the socket; the object handler drains it
+            # chunk by chunk into RGWLite (no whole-body buffering)
+            req.stream = reader
+            return req
         if length > _MAX_BODY:
+            # bound only BUFFERED bodies (non-streamable requests);
+            # large uploads ride the streaming path or multipart
             raise _HTTPError(400, "EntityTooLarge", str(length))
-        body = await reader.readexactly(length) if length else b""
-        return _Request(method.upper(), raw_path, headers, body)
+        req.body = await reader.readexactly(length) if length else b""
+        return req
+
+    @staticmethod
+    def _should_stream(req: _Request, length: int) -> bool:
+        """Plain object PUTs with a declared payload hash stream; the
+        hash header is required so SigV4 verifies from headers alone
+        and the body sha256 is enforced incrementally."""
+        if req.method != "PUT" or length < _STREAM_MIN:
+            return False
+        if not req.header("x-amz-content-sha256"):
+            return False
+        parts = req.path.lstrip("/").split("/", 1)
+        if len(parts) < 2 or not parts[1]:
+            return False                # not an object-level request
+        blocked = {"partNumber", "uploadId", "acl", "versioning",
+                   "lifecycle", "tagging"}
+        if blocked & set(req.query):
+            return False
+        if req.header("x-amz-copy-source"):
+            return False
+        return True
 
     async def _respond(self, writer: asyncio.StreamWriter, req: _Request,
-                       status: int, headers: dict, body: bytes,
+                       status: int, headers: dict, body,
                        keep: bool) -> None:
         self._reqid += 1
         reason = {200: "OK", 204: "No Content", 206: "Partial Content",
                   403: "Forbidden", 404: "Not Found"}.get(status, "S3")
         out = [f"HTTP/1.1 {status} {reason}"]
+        streaming = not isinstance(body, (bytes, bytearray))
         base = {
             "x-amz-request-id": f"{self._reqid:016x}",
             "date": formatdate(usegmt=True),
-            "content-length": str(len(body)),
             "connection": "keep-alive" if keep else "close",
         }
-        base.update(headers)
+        if not streaming:
+            base["content-length"] = str(len(body))
+        base.update(headers)    # streaming callers set content-length
         for k, v in base.items():
             out.append(f"{k}: {v}")
-        payload = "\r\n".join(out).encode("latin-1") + b"\r\n\r\n"
+        head = "\r\n".join(out).encode("latin-1") + b"\r\n\r\n"
+        writer.write(head)
         if req.method != "HEAD":
-            payload += body
-        writer.write(payload)
+            if streaming:
+                # async-generator body: chunks flow straight from RADOS
+                # to the socket, never materializing the whole object
+                async for chunk in body:
+                    writer.write(chunk)
+                    await writer.drain()
+            else:
+                writer.write(bytes(body))
         await writer.drain()
 
     @staticmethod
@@ -310,7 +363,8 @@ class S3Frontend:
         if not hmac.compare_digest(want, their_sig):
             raise _HTTPError(403, "SignatureDoesNotMatch", access_key)
         declared = req.header("x-amz-content-sha256")
-        if declared and declared != "UNSIGNED-PAYLOAD" and \
+        if req.stream is None and declared and \
+                declared != "UNSIGNED-PAYLOAD" and \
                 declared != hashlib.sha256(req.body).hexdigest():
             # a valid signature over a LIED-ABOUT payload hash must
             # not authorize the actual body (replay/tamper guard)
@@ -578,16 +632,25 @@ class S3Frontend:
                 root = ET.Element("CopyObjectResult", xmlns=XMLNS)
                 ET.SubElement(root, "ETag").text = f'"{out["etag"]}"'
                 return self._xml(root)
-            out = await gw.put_object(
-                bucket, key, req.body,
-                content_type=req.header("content-type",
-                                        "binary/octet-stream"),
-                metadata=_meta_headers(req),
-                if_none_match=req.header("if-none-match") == "*",
-            )
+            sse_key = _sse_key_headers(req)
+            if req.stream is not None:
+                out = await self._streaming_put(req, gw, bucket, key,
+                                                sse_key)
+            else:
+                out = await gw.put_object(
+                    bucket, key, req.body,
+                    content_type=req.header("content-type",
+                                            "binary/octet-stream"),
+                    metadata=_meta_headers(req),
+                    if_none_match=req.header("if-none-match") == "*",
+                    sse_key=sse_key,
+                )
             hdrs = {"etag": f'"{out["etag"]}"'}
             if out.get("version_id"):
                 hdrs["x-amz-version-id"] = out["version_id"]
+            if sse_key is not None:
+                hdrs["x-amz-server-side-encryption-customer-algorithm"] \
+                    = "AES256"
             return 200, hdrs, b""
         if req.method == "DELETE":
             if "uploadId" in q:
@@ -601,25 +664,57 @@ class S3Frontend:
             return 204, {}, b""
         if req.method in ("GET", "HEAD"):
             if "versionId" in q:
+                from ceph_tpu.services.rgw import sse_check, sse_crypt
+
+                sse_key = _sse_key_headers(req)
                 if req.method == "HEAD":
                     entry = await gw.head_object_version(
                         bucket, key, q["versionId"])
+                    sse_check(entry, sse_key)
                     hdrs = _obj_headers({**entry, "data": b""})
                     hdrs["x-amz-version-id"] = q["versionId"]
                     return 200, hdrs, b""
                 got = await gw.get_object_version(bucket, key,
                                                   q["versionId"])
+                sse_check(got, sse_key)
+                if sse_key is not None:
+                    got["data"] = sse_crypt(
+                        sse_key, bytes.fromhex(got["sse"]["nonce"]),
+                        0, got["data"])
                 hdrs = _obj_headers(got)
                 hdrs["x-amz-version-id"] = q["versionId"]
                 return 200, hdrs, got["data"]
+            sse_key = _sse_key_headers(req)
             if req.method == "HEAD":
                 entry = await gw.head_object(bucket, key)
+                from ceph_tpu.services.rgw import sse_check
+                sse_check(entry, sse_key)
                 return 200, _obj_headers({**entry, "data": b""}), b""
+            entry = await gw.head_object(bucket, key)
             rng = _parse_range(req.header("range"))
             if rng is not None and rng[0] == "suffix":
-                size = int((await gw.head_object(bucket, key))["size"])
+                size = int(entry["size"])
                 rng = (max(0, size - int(rng[1])), size - 1)
-            got = await gw.get_object(bucket, key, range_=rng)
+            if int(entry["size"]) >= _STREAM_MIN:
+                # large bodies stream straight from RADOS to the socket
+                entry, gen = await gw.stream_object(
+                    bucket, key, range_=rng, sse_key=sse_key,
+                    chunk=_STREAM_CHUNK, entry=entry)
+                hdrs = _obj_headers({**entry, "data": b""})
+                if entry.get("version_id"):
+                    hdrs["x-amz-version-id"] = entry["version_id"]
+                size = int(entry["size"])
+                if rng is not None:
+                    end = min(rng[1], size - 1)
+                    length = max(0, end - rng[0] + 1)
+                    hdrs["content-range"] = \
+                        f"bytes {rng[0]}-{end}/{size}"
+                    hdrs["content-length"] = str(length)
+                    return 206, hdrs, gen
+                hdrs["content-length"] = str(size)
+                return 200, hdrs, gen
+            got = await gw.get_object(bucket, key, range_=rng,
+                                      sse_key=sse_key)
             hdrs = _obj_headers(got)
             if got.get("version_id"):
                 hdrs["x-amz-version-id"] = got["version_id"]
@@ -631,6 +726,45 @@ class S3Frontend:
                 return 206, hdrs, got["data"]
             return 200, hdrs, got["data"]
         raise _HTTPError(405, "MethodNotAllowed", req.method)
+
+    async def _streaming_put(self, req: _Request, gw: RGWLite,
+                             bucket: str, key: str,
+                             sse_key: bytes | None) -> dict:
+        """Drain the socket body straight into an RGWLite streaming
+        session, hashing as it goes; the declared x-amz-content-sha256
+        is enforced at the end (a signed-over hash that lied about the
+        body must not publish the object — same guard as the buffered
+        path, applied post-stream like S3 does)."""
+        sp = await gw.begin_put(
+            bucket, key, req.content_length,
+            content_type=req.header("content-type",
+                                    "binary/octet-stream"),
+            metadata=_meta_headers(req),
+            if_none_match=req.header("if-none-match") == "*",
+        )
+        if sse_key is not None:
+            sp.set_sse_key(sse_key)
+        declared = req.header("x-amz-content-sha256")
+        sha = (hashlib.sha256()
+               if declared and declared != "UNSIGNED-PAYLOAD" else None)
+        try:
+            remaining = req.content_length
+            while remaining:
+                chunk = await req.stream.readexactly(
+                    min(_STREAM_CHUNK, remaining))
+                req.stream_consumed += len(chunk)
+                remaining -= len(chunk)
+                if sha is not None:
+                    sha.update(chunk)
+                await sp.write(chunk)
+        except (Exception, asyncio.CancelledError):
+            await sp.abort()
+            raise
+        if sha is not None and sha.hexdigest() != declared:
+            await sp.abort()
+            raise _HTTPError(400, "XAmzContentSHA256Mismatch",
+                             "payload hash mismatch")
+        return await sp.complete()
 
     @staticmethod
     def _xml(root: ET.Element):
@@ -653,6 +787,36 @@ def _meta_headers(req: _Request) -> dict[str, str]:
             if k.startswith("x-amz-meta-")}
 
 
+_SSE_PREFIX = "x-amz-server-side-encryption-customer-"
+
+
+def _sse_key_headers(req: _Request) -> bytes | None:
+    """Parse the S3 SSE-C header triple (rgw_crypt.cc
+    rgw_s3_prepare_encrypt): algorithm must be AES256, the key is
+    base64, and the md5 header (when sent) must match the key."""
+    import base64
+
+    alg = req.header(_SSE_PREFIX + "algorithm")
+    if not alg:
+        return None
+    if alg != "AES256":
+        raise _HTTPError(400, "InvalidArgument",
+                         f"unsupported SSE-C algorithm {alg!r}")
+    try:
+        key = base64.b64decode(req.header(_SSE_PREFIX + "key"),
+                               validate=True)
+    except Exception:
+        raise _HTTPError(400, "InvalidArgument", "bad SSE-C key")
+    if len(key) != 32:
+        raise _HTTPError(400, "InvalidArgument",
+                         "SSE-C key must be 256 bits")
+    md5h = req.header(_SSE_PREFIX + "key-md5")
+    if md5h and base64.b64encode(
+            hashlib.md5(key).digest()).decode() != md5h:
+        raise _HTTPError(400, "InvalidArgument", "SSE-C key md5 mismatch")
+    return key
+
+
 def _obj_headers(got: dict) -> dict[str, str]:
     hdrs = {
         "content-type": got.get("content_type", "binary/octet-stream"),
@@ -663,6 +827,10 @@ def _obj_headers(got: dict) -> dict[str, str]:
     }
     for k, v in (got.get("meta") or {}).items():
         hdrs[f"x-amz-meta-{k}"] = str(v)
+    sse = got.get("sse")
+    if sse:
+        hdrs[_SSE_PREFIX + "algorithm"] = sse.get("alg", "AES256")
+        hdrs[_SSE_PREFIX + "key-md5"] = sse.get("key_md5", "")
     return hdrs
 
 

@@ -147,9 +147,10 @@ def test_rank1_failover_standby_takes_over():
                 raise TimeoutError("standby never promoted")
             await asyncio.sleep(0.05)
         assert mds_c.rank == 1
-        # give the resync a beat, then keep working under /shared
+        # give the resync a beat, then keep working under /shared —
+        # the client must recover from its stale rank-1 address on its
+        # own (ConnectionError -> fsmap re-resolve)
         await asyncio.sleep(0.3)
-        fs._rank_addrs.pop(1, None)      # drop the dead daemon's addr
         assert await fs.read_file("/shared/before") == b"pre-kill"
         await fs.write_file("/shared/after", b"post-failover")
         assert await fs.read_file("/shared/after") == b"post-failover"
@@ -177,5 +178,24 @@ def test_snapshots_refuse_rank_boundaries():
         with pytest.raises(FSError) as ei:
             await fs.export_dir("/solo", 1)
         assert ei.value.rc == -22
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_nested_export_back_to_rank0():
+    """Exporting a child of a delegated subtree back to rank 0 needs an
+    explicit override entry, not a silent no-op."""
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        await fs.mkdirs("/a/b")
+        await fs.export_dir("/a", 1)
+        await fs.export_dir("/a/b", 0)
+        await fs.write_file("/a/b/f0", b"rank0 again")
+        st = await fs.stat("/a/b/f0")
+        assert int(st["ino"]) < RANK_INO_BASE, \
+            "nested export back to rank 0 was a no-op"
+        await fs.write_file("/a/f1", b"rank1")
+        st1 = await fs.stat("/a/f1")
+        assert int(st1["ino"]) >= RANK_INO_BASE
         await _teardown(cluster, rados, fs)
     asyncio.run(run())

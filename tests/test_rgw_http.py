@@ -347,3 +347,171 @@ def test_frontend_malformed_inputs_get_http_errors():
             await stop_cluster(mon, osds, rados)
 
     asyncio.run(run())
+
+
+def test_streaming_put_and_get():
+    """Bodies past _STREAM_MIN never buffer whole: PUT streams from the
+    socket into RGWLite (quota checked up front, sha256 enforced at the
+    end) and GET streams back chunk by chunk."""
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        await cli.request("PUT", "/big")
+        payload = bytes(range(256)) * 8192          # 2 MiB > _STREAM_MIN
+        st, hdrs, _ = await cli.request("PUT", "/big/blob", payload)
+        assert st == 200, hdrs
+        import hashlib as _h
+        assert hdrs["etag"] == f'"{_h.md5(payload).hexdigest()}"'
+
+        st, hdrs, got = await cli.request("GET", "/big/blob")
+        assert st == 200
+        assert got == payload
+        assert hdrs["content-length"] == str(len(payload))
+        # ranged GET through the streaming path
+        st, hdrs, got = await cli.request(
+            "GET", "/big/blob", headers={"range": "bytes=100-1048675"})
+        assert st == 206
+        assert got == payload[100:1048676]
+
+        # a lying payload hash must NOT publish the object
+        bad = {"x-amz-content-sha256": _h.sha256(b"other").hexdigest()}
+        st, hdrs, _ = await cli.request("PUT", "/big/liar", payload,
+                                        headers=bad)
+        assert st in (400, 403)
+        st, _, _ = await cli.request("GET", "/big/liar")
+        assert st == 404
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_sse_c_roundtrip():
+    """SSE-C (rgw_crypt.cc role): the stored bytes are ciphertext, GET
+    with the right key decrypts (including ranges), wrong/missing keys
+    are refused, HEAD validates too."""
+    import base64
+
+    def sse_headers(key: bytes) -> dict:
+        return {
+            "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key":
+                base64.b64encode(key).decode(),
+            "x-amz-server-side-encryption-customer-key-md5":
+                base64.b64encode(
+                    hashlib.md5(key).digest()).decode(),
+        }
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        await cli.request("PUT", "/safe")
+        key = bytes(range(32))
+        secret = b"top secret bytes" * 64
+        st, hdrs, _ = await cli.request("PUT", "/safe/doc", secret,
+                                        headers=sse_headers(key))
+        assert st == 200, hdrs
+        assert hdrs[
+            "x-amz-server-side-encryption-customer-algorithm"] == "AES256"
+
+        # the bytes at rest are NOT the plaintext
+        gw = fe.rgw
+        entry = await gw.head_object("safe", "doc")
+        raw = await gw.ioctx.read(entry["data_oid"])
+        assert raw != secret and len(raw) == len(secret)
+
+        st, hdrs, got = await cli.request("GET", "/safe/doc",
+                                          headers=sse_headers(key))
+        assert st == 200 and got == secret
+        # ranged decrypt (CTR seek)
+        st, _, got = await cli.request(
+            "GET", "/safe/doc",
+            headers={**sse_headers(key), "range": "bytes=17-200"})
+        assert st == 206 and got == secret[17:201]
+        # wrong key / missing key refused
+        st, _, _ = await cli.request("GET", "/safe/doc",
+                                     headers=sse_headers(b"\x01" * 32))
+        assert st in (400, 403)
+        st, _, _ = await cli.request("GET", "/safe/doc")
+        assert st == 400
+        st, _, _ = await cli.request("HEAD", "/safe/doc")
+        assert st == 400
+        st, _, _ = await cli.request("HEAD", "/safe/doc",
+                                     headers=sse_headers(key))
+        assert st == 200
+
+        # streaming-sized SSE-C body round-trips too
+        big = bytes(range(256)) * 8192              # 2 MiB
+        st, _, _ = await cli.request("PUT", "/safe/big", big,
+                                     headers=sse_headers(key))
+        assert st == 200
+        st, _, got = await cli.request("GET", "/safe/big",
+                                       headers=sse_headers(key))
+        assert st == 200 and got == big
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_aborted_streaming_put_preserves_old_object():
+    """A streaming PUT that fails (hash mismatch / disconnect) must not
+    destroy the durable object it was replacing (the stream writes to
+    its own oid; the old data drops only after the index flips)."""
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        await cli.request("PUT", "/keep")
+        old = b"precious" * 200_000          # 1.5 MiB (streams)
+        st, _, _ = await cli.request("PUT", "/keep/obj", old)
+        assert st == 200
+
+        new = b"replacement" * 200_000
+        bad = {"x-amz-content-sha256":
+               hashlib.sha256(b"lie").hexdigest()}
+        st, _, _ = await cli.request("PUT", "/keep/obj", new,
+                                     headers=bad)
+        assert st in (400, 403)
+        # the OLD object is fully intact and served
+        st, _, got = await cli.request("GET", "/keep/obj")
+        assert st == 200 and got == old
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_sse_c_versioned_get():
+    """GET/HEAD ?versionId enforce SSE-C too: no key (or a wrong key)
+    must never leak ciphertext with a 200."""
+    import base64
+
+    def sse_headers(key: bytes) -> dict:
+        return {
+            "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key":
+                base64.b64encode(key).decode(),
+        }
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        await cli.request("PUT", "/vb")
+        st, _, _ = await cli.request(
+            "PUT", "/vb?versioning",
+            b'<VersioningConfiguration><Status>Enabled</Status>'
+            b'</VersioningConfiguration>')
+        assert st == 200
+        key = bytes(range(32))
+        secret = b"versioned secret!" * 10
+        st, hdrs, _ = await cli.request("PUT", "/vb/doc", secret,
+                                        headers=sse_headers(key))
+        assert st == 200
+        vid = hdrs["x-amz-version-id"]
+
+        st, _, got = await cli.request(
+            "GET", f"/vb/doc?versionId={vid}")
+        assert st == 400, "versioned GET leaked SSE-C object"
+        st, _, got = await cli.request(
+            "GET", f"/vb/doc?versionId={vid}",
+            headers=sse_headers(key))
+        assert st == 200 and got == secret
+        st, _, _ = await cli.request(
+            "HEAD", f"/vb/doc?versionId={vid}")
+        assert st == 400
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
