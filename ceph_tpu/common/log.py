@@ -19,7 +19,7 @@ import time
 SUBSYSTEMS = (
     "osd", "mon", "ms", "ec", "crush", "objecter", "store", "client",
     "mgr", "rbd", "rgw", "rgw-sync", "rgw-http", "mds", "config",
-    "heartbeat",
+    "dashboard", "heartbeat",
     "peering", "asok",
 )
 

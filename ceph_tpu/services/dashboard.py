@@ -1,0 +1,238 @@
+"""Dashboard-lite: the mgr's operator-facing HTTP surface.
+
+The read-only core of reference src/pybind/mgr/dashboard (scope per its
+status pages, not the 11 MB web app) plus the prometheus module's
+exposition endpoint, on one asyncio server:
+
+- ``GET /api/status``  cluster status JSON: health checks, mon quorum,
+  osd/pg/pool summaries, the OSD tree, MDS ranks, and the recent
+  cluster log — assembled from the same mon commands the CLI uses.
+- ``GET /metrics``     prometheus text exposition of the mgr's last
+  digest (the pybind/mgr/prometheus serve role).
+- ``GET /``            one self-refreshing HTML page rendering the
+  status for a browser.
+
+Read-only by construction: the handler has no POST routes and never
+calls a mutating mon command.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import html
+import json
+import time
+
+from ceph_tpu.common.log import Dout
+
+log = Dout("dashboard")
+
+
+class Dashboard:
+    def __init__(self, mgr, host: str = "127.0.0.1", port: int = 0):
+        self.mgr = mgr
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._metrics_cache: tuple[float, bytes] = (0.0, b"")
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.dout(1, "dashboard on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- http --------------------------------------------------------------
+    async def _client(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+            line = head.split(b"\r\n", 1)[0].decode("latin-1")
+            method, path, _ = (line.split(" ", 2) + ["", ""])[:3]
+            path = path.split("?", 1)[0]
+            if method != "GET":
+                body, ctype, status = b"read-only", "text/plain", 405
+            elif path == "/api/status":
+                body = json.dumps(await self._status()).encode()
+                ctype, status = "application/json", 200
+            elif path == "/metrics":
+                # collect() messages every OSD; cache briefly so an
+                # aggressive scraper doesn't multiply cluster traffic
+                ts, cached = self._metrics_cache
+                if time.monotonic() - ts < 1.0:
+                    body = cached
+                else:
+                    snap = await self.mgr.collect()
+                    body = self.mgr.prometheus_text(snap).encode()
+                    self._metrics_cache = (time.monotonic(), body)
+                ctype, status = "text/plain; version=0.0.4", 200
+            elif path == "/":
+                body = (await self._html()).encode()
+                ctype, status = "text/html; charset=utf-8", 200
+            else:
+                body, ctype, status = b"not found", "text/plain", 404
+            writer.write(
+                f"HTTP/1.1 {status} X\r\ncontent-type: {ctype}\r\n"
+                f"content-length: {len(body)}\r\n"
+                f"connection: close\r\n\r\n".encode() + body)
+            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception as e:          # noqa: BLE001 — serve a 500
+            try:
+                msg = f"internal error: {type(e).__name__}".encode()
+                writer.write(
+                    b"HTTP/1.1 500 X\r\ncontent-type: text/plain\r\n"
+                    + f"content-length: {len(msg)}\r\n\r\n".encode()
+                    + msg)
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    # -- data assembly -----------------------------------------------------
+    async def _mon(self, prefix: str, **args):
+        try:
+            r = await self.mgr.monc.command(prefix, **args)
+        except (ConnectionError, asyncio.TimeoutError):
+            return None
+        return r.get("data") if r.get("rc") == 0 else None
+
+    async def _status(self) -> dict:
+        out: dict = {"ts": time.time()}
+        out["status"] = await self._mon("status")
+        out["health"] = await self._mon("health")
+        out["osd_tree"] = await self._mon("osd tree")
+        out["mds"] = await self._mon("mds stat")
+        out["log"] = await self._mon("log last", num=50) or []
+        digest = getattr(self.mgr, "last_digest", None) or {}
+        out["pgmap"] = {
+            k: digest.get(k) for k in
+            ("pgs_by_state", "num_pgs", "num_objects", "num_bytes",
+             "degraded_objects", "pools", "osd_df")
+            if k in digest
+        }
+        return out
+
+    # -- html rendering ----------------------------------------------------
+    async def _html(self) -> str:
+        s = await self._status()
+        esc = html.escape
+        health = s.get("health") or {}
+        checks = health.get("checks") or {}
+        hstatus = health.get("status", "UNKNOWN")
+        color = {"HEALTH_OK": "#2a2", "HEALTH_WARN": "#f90",
+                 "HEALTH_ERR": "#d22"}.get(hstatus, "#888")
+        rows: list[str] = []
+
+        def section(title: str, inner: str) -> None:
+            rows.append(f"<h2>{esc(title)}</h2>{inner}")
+
+        def table(headers: list[str], body_rows: list[list[str]]) -> str:
+            head = "".join(f"<th>{esc(h)}</th>" for h in headers)
+            body = "".join(
+                "<tr>" + "".join(f"<td>{c}</td>" for c in r) + "</tr>"
+                for r in body_rows)
+            return (f"<table><thead><tr>{head}</tr></thead>"
+                    f"<tbody>{body}</tbody></table>")
+
+        section("Health", (
+            f'<p class="pill" style="background:{color}">'
+            f"{esc(hstatus)}</p>"
+            + (table(["check", "severity", "message"], [
+                [esc(k), esc(v.get("severity", "")),
+                 esc(v.get("message", ""))]
+                for k, v in sorted(checks.items())
+            ]) if checks else "<p>no active health checks</p>")))
+
+        pg = s.get("pgmap") or {}
+        states = pg.get("pgs_by_state") or {}
+        section("PGs", table(["state", "count"], [
+            [esc(k), str(v)] for k, v in sorted(states.items())
+        ]) + f"<p>{pg.get('num_pgs', 0)} pgs, "
+            f"{pg.get('num_objects', 0)} objects, "
+            f"{pg.get('num_bytes', 0)} bytes, "
+            f"{pg.get('degraded_objects', 0)} degraded</p>")
+
+        pools = pg.get("pools") or {}
+        section("Pools", table(
+            ["pool", "pgs", "objects", "bytes", "degraded"], [
+                [esc(str(p.get("name", pid))), str(p.get("num_pgs", 0)),
+                 str(p.get("num_objects", 0)),
+                 str(p.get("num_bytes", 0)), str(p.get("degraded", 0))]
+                for pid, p in sorted(pools.items(),
+                                     key=lambda kv: str(kv[0]))
+            ]))
+
+        tree = s.get("osd_tree") or {}
+        tree_rows: list[list[str]] = []
+
+        def walk(node: dict, depth: int) -> None:
+            pad = "&nbsp;" * 4 * depth
+            status = node.get("status", "")
+            badge = (f'<span style="color:'
+                     f'{"#2a2" if status == "up" else "#d22"}">'
+                     f"{esc(status)}</span>" if status else "")
+            tree_rows.append([
+                pad + esc(node.get("name", "?")),
+                esc(node.get("type", "")), badge,
+                esc(f"{node.get('reweight', '')}"),
+            ])
+            for child in node.get("children", ()):
+                walk(child, depth + 1)
+
+        for root in tree.get("nodes", ()):
+            walk(root, 0)
+        section("OSD tree", table(["name", "type", "status", "reweight"],
+                                  tree_rows))
+
+        mds = s.get("mds") or {}
+        mds_rows = []
+        for fs, info in sorted((mds.get("filesystems") or {}).items()):
+            for a in info.get("actives", ()):
+                mds_rows.append([esc(fs), str(a.get("rank", 0)),
+                                 esc(a.get("name", "")), "active"])
+            for n in info.get("standby", ()):
+                mds_rows.append([esc(fs), "-", esc(n), "standby"])
+            for n in info.get("down", ()):
+                mds_rows.append([esc(fs), "-", esc(n), "down"])
+        if mds_rows:
+            section("MDS", table(["fs", "rank", "name", "state"],
+                                 mds_rows))
+
+        logs = s.get("log") or []
+        section("Cluster log", table(["when", "level", "who", "message"], [
+            [esc(time.strftime("%H:%M:%S",
+                               time.localtime(e.get("stamp", 0)))),
+             esc(e.get("level", "")), esc(e.get("who", "")),
+             esc(e.get("message", ""))]
+            for e in logs[-25:][::-1]
+        ]))
+
+        return (
+            "<!doctype html><html><head>"
+            '<meta charset="utf-8">'
+            '<meta http-equiv="refresh" content="5">'
+            "<title>ceph_tpu dashboard</title><style>"
+            "body{font-family:sans-serif;margin:2em;color:#223}"
+            "table{border-collapse:collapse;margin:.5em 0}"
+            "td,th{border:1px solid #ccd;padding:.25em .6em;"
+            "text-align:left;font-size:.9em}"
+            "th{background:#eef}h2{margin:.8em 0 .2em}"
+            ".pill{display:inline-block;color:#fff;padding:.2em .8em;"
+            "border-radius:1em;font-weight:bold}"
+            "</style></head><body><h1>ceph_tpu</h1>"
+            + "".join(rows) + "</body></html>"
+        )

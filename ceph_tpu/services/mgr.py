@@ -44,6 +44,7 @@ class Mgr:
             modules = [Balancer(self), PGAutoscaler(self),
                        Progress(self)]
         self.modules = {m.name: m for m in modules}
+        self.last_digest: dict | None = None
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if msg.type == "perf_dump_reply":
@@ -89,6 +90,10 @@ class Mgr:
         if self.admin_socket is not None:
             await self.admin_socket.stop()
             self.admin_socket = None
+        dash = getattr(self, "dashboard", None)
+        if dash is not None:
+            await dash.stop()
+            self.dashboard = None
         await self.monc.shutdown()
         await self.msgr.shutdown()
 
@@ -207,6 +212,7 @@ class Mgr:
             health.update(mod.health_checks())
         if health:
             digest["health_checks"] = health
+        self.last_digest = digest       # dashboard/metrics snapshot
         await self.monc.command("mgr report", digest=digest)
         return digest
 

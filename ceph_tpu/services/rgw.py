@@ -207,14 +207,15 @@ class StreamingPut:
     index entry, abort() removes whatever landed."""
 
     def __init__(self, rgw: "RGWLite", ctx: dict, length: int,
-                 content_type: str, metadata: dict,
-                 sse: dict | None = None):
+                 content_type: str, metadata: dict):
         self._rgw = rgw
         self._ctx = ctx
         self.length = length
         self._content_type = content_type
         self._metadata = metadata
-        self._sse = sse
+        # SSE-C only via set_sse_key: an sse record without the key
+        # would store plaintext under an entry claiming encryption
+        self._sse: dict | None = None
         self._sse_key: bytes | None = None
         self._pos = 0
         self._md5 = hashlib.md5()
@@ -251,15 +252,17 @@ class StreamingPut:
             await self._rgw.ioctx.operate(
                 self._ctx["oid"],
                 ObjectOperation().write_full(bytes(self._buf)))
-        # replaced object's data is dropped only now — with the new
-        # bytes fully down, just before the index flips to them
-        dc = self._ctx.get("deferred_cleanup")
-        if dc is not None:
-            bucket, key = self._ctx["bucket"], self._ctx["key"]
-            if dc[0] == "null":
+        # replaced object's data (and version-store adoption) happen
+        # only now — with the new bytes fully down, just before the
+        # index flips to them; an aborted stream never reaches here
+        bucket, key = self._ctx["bucket"], self._ctx["key"]
+        for action, arg in self._ctx.get("deferred_cleanup") or ():
+            if action == "adopt":
+                await self._rgw._adopt_null_version(bucket, key, arg)
+            elif action == "null":
                 await self._rgw._remove_null_version(bucket, key)
             else:
-                await self._rgw._remove_entry_data(bucket, key, dc[1])
+                await self._rgw._remove_entry_data(bucket, key, arg)
         return await self._rgw._finish_put(
             self._ctx, self.length, self._md5.hexdigest(),
             self._striped, self._content_type, self._metadata,
@@ -1122,8 +1125,16 @@ class RGWLite:
                                 is_replace=is_replace)
         oid = self._data_oid(bucket, key)
         version_id = None
-        deferred = None
-        if versioned:
+        deferred: list[tuple] = []
+        if versioned and defer_cleanup:
+            version_id = self._new_version_id()
+            oid = f"{oid}\x00v\x00{version_id}"
+            if key in existing:
+                # adopting the pre-versioning entry as 'null' must wait
+                # for complete(): an aborted stream must leave the
+                # version store untouched
+                deferred.append(("adopt", json.loads(existing[key])))
+        elif versioned:
             # every PUT is a NEW version: prior data objects survive
             # under their own version ids (rgw versioned-bucket model)
             version_id = self._new_version_id()
@@ -1141,9 +1152,9 @@ class RGWLite:
             if key in existing:
                 old = json.loads(existing[key])
                 if suspended:
-                    deferred = ("null", None)
-                elif not old.get("version_id"):
-                    deferred = ("entry", old)
+                    deferred.append(("null", None))
+                if not old.get("version_id"):
+                    deferred.append(("entry", old))
         elif key in existing:
             # drop the old data objects first: a smaller striped body
             # must not inherit the old size xattr / stale tail stripes
@@ -1164,8 +1175,7 @@ class RGWLite:
     async def begin_put(self, bucket: str, key: str, length: int,
                         content_type: str = "binary/octet-stream",
                         metadata: dict[str, str] | None = None,
-                        if_none_match: bool = False,
-                        sse: dict | None = None) -> "StreamingPut":
+                        if_none_match: bool = False) -> "StreamingPut":
         """Chunked S3 PUT session (the beast frontend's streaming body
         path): validation happens up front against the declared length,
         then body chunks land at their striper offsets without ever
@@ -1174,7 +1184,7 @@ class RGWLite:
                                       if_none_match,
                                       defer_cleanup=True)
         return StreamingPut(self, ctx, length, content_type,
-                            dict(metadata or {}), sse)
+                            dict(metadata or {}))
 
     async def put_object(self, bucket: str, key: str, data: bytes,
                          content_type: str = "binary/octet-stream",

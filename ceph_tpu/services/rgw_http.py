@@ -32,7 +32,14 @@ import xml.etree.ElementTree as ET
 from email.utils import formatdate
 
 from ceph_tpu.common.log import Dout
-from ceph_tpu.services.rgw import ANONYMOUS, RGWError, RGWLite, RGWUsers
+from ceph_tpu.services.rgw import (
+    ANONYMOUS,
+    RGWError,
+    RGWLite,
+    RGWUsers,
+    sse_check,
+    sse_crypt,
+)
 
 log = Dout("rgw-http")
 
@@ -317,9 +324,19 @@ class S3Frontend:
             if streaming:
                 # async-generator body: chunks flow straight from RADOS
                 # to the socket, never materializing the whole object
-                async for chunk in body:
-                    writer.write(chunk)
-                    await writer.drain()
+                try:
+                    async for chunk in body:
+                        writer.write(chunk)
+                        await writer.drain()
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    raise
+                except Exception as e:     # noqa: BLE001
+                    # backend failure mid-stream: the status line is
+                    # gone, so the only honest signal is a truncated
+                    # body + closed connection (what beast does too)
+                    log.derr("streaming GET aborted: %r", e)
+                    await body.aclose()
+                    raise ConnectionError("stream aborted") from e
             else:
                 writer.write(bytes(body))
         await writer.drain()
@@ -664,8 +681,6 @@ class S3Frontend:
             return 204, {}, b""
         if req.method in ("GET", "HEAD"):
             if "versionId" in q:
-                from ceph_tpu.services.rgw import sse_check, sse_crypt
-
                 sse_key = _sse_key_headers(req)
                 if req.method == "HEAD":
                     entry = await gw.head_object_version(
@@ -687,7 +702,6 @@ class S3Frontend:
             sse_key = _sse_key_headers(req)
             if req.method == "HEAD":
                 entry = await gw.head_object(bucket, key)
-                from ceph_tpu.services.rgw import sse_check
                 sse_check(entry, sse_key)
                 return 200, _obj_headers({**entry, "data": b""}), b""
             entry = await gw.head_object(bucket, key)
@@ -829,8 +843,16 @@ def _obj_headers(got: dict) -> dict[str, str]:
         hdrs[f"x-amz-meta-{k}"] = str(v)
     sse = got.get("sse")
     if sse:
+        import base64
+
         hdrs[_SSE_PREFIX + "algorithm"] = sse.get("alg", "AES256")
-        hdrs[_SSE_PREFIX + "key-md5"] = sse.get("key_md5", "")
+        # the wire form of the header is base64(md5), matching what the
+        # client sent; the index stores the hex digest
+        try:
+            hdrs[_SSE_PREFIX + "key-md5"] = base64.b64encode(
+                bytes.fromhex(sse.get("key_md5", ""))).decode()
+        except ValueError:
+            pass
     return hdrs
 
 

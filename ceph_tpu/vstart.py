@@ -180,9 +180,14 @@ class DevCluster:
         self.mdss[name] = mds
         return mds
 
-    async def start_mgr(self, name: str = "x", report_interval: float = 0.2):
+    async def start_mgr(self, name: str = "x",
+                        report_interval: float = 0.2,
+                        dashboard: bool = False,
+                        dashboard_port: int = 0):
         """Boot a manager that aggregates OSD pg stats into the PGMap
-        digest and pushes it to the mon (the mgr daemon role)."""
+        digest and pushes it to the mon (the mgr daemon role).
+        ``dashboard``: also serve the read-only HTTP status page +
+        /api/status + /metrics (mgr.dashboard holds (host, port))."""
         import asyncio
 
         from ceph_tpu.services.mgr import Mgr
@@ -201,6 +206,12 @@ class DevCluster:
         mgr._report_task = asyncio.get_running_loop().create_task(
             mgr.report_loop(report_interval)
         )
+        if dashboard:
+            from ceph_tpu.services.dashboard import Dashboard
+
+            dash = Dashboard(mgr, port=dashboard_port)
+            mgr.dashboard = dash
+            await dash.start()
         self.mgrs[name] = mgr
         return mgr
 

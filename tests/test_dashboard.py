@@ -1,0 +1,111 @@
+"""Dashboard-lite: the mgr's read-only HTTP status surface
+(reference src/pybind/mgr/dashboard status scope + prometheus serve)."""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.dashboard import Dashboard
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _http_get(host, port, path):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(f"GET {path} HTTP/1.1\r\nhost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    return status, body
+
+
+def test_dashboard_status_metrics_and_page():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="dash",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("dash")
+            await io.write_full("obj1", b"x" * 1000)
+            mgr = await cluster.start_mgr()
+            # let a digest land
+            deadline = asyncio.get_running_loop().time() + 20
+            while not (mgr.last_digest or {}).get("num_pgs"):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+
+            dash = Dashboard(mgr)
+            host, port = await dash.start()
+
+            # JSON status: health + pg states + osd tree + log
+            st, body = await _http_get(host, port, "/api/status")
+            assert st == 200
+            s = json.loads(body)
+            assert s["health"]["status"] in ("HEALTH_OK", "HEALTH_WARN")
+            assert s["pgmap"]["num_pgs"] >= 8
+            states = s["pgmap"]["pgs_by_state"]
+            assert sum(states.values()) == s["pgmap"]["num_pgs"]
+            names = {n["name"] for n in s["osd_tree"]["nodes"]}
+            assert "default" in names
+            assert isinstance(s["log"], list) and s["log"]
+
+            # prometheus exposition serves the same snapshot
+            st, body = await _http_get(host, port, "/metrics")
+            assert st == 200
+            assert b"ceph" in body or b"# TYPE" in body
+
+            # the HTML page renders every section
+            st, body = await _http_get(host, port, "/")
+            assert st == 200
+            text = body.decode()
+            for frag in ("Health", "PGs", "Pools", "OSD tree",
+                         "Cluster log", "osd.0"):
+                assert frag in text, f"missing {frag!r}"
+
+            # read-only: mutations are refused
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(b"POST /api/status HTTP/1.1\r\nhost: x\r\n"
+                         b"content-length: 0\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            assert b" 405 " in raw.split(b"\r\n", 1)[0]
+            st, _ = await _http_get(host, port, "/nope")
+            assert st == 404
+
+            await dash.stop()
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_dashboard_via_vstart():
+    """start_mgr(dashboard=True) wires the endpoint into the dev
+    cluster and shutdown closes it."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            mgr = await cluster.start_mgr(dashboard=True)
+            host, port = mgr.dashboard.host, mgr.dashboard.port
+            st, body = await _http_get(host, port, "/api/status")
+            assert st == 200 and b"health" in body
+        finally:
+            await cluster.stop()
+        with pytest.raises((ConnectionError, OSError)):
+            await _http_get(host, port, "/api/status")
+    asyncio.run(run())

@@ -515,3 +515,52 @@ def test_sse_c_versioned_get():
         await fe.stop()
         await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_aborted_streaming_put_suspended_and_versioned():
+    """Review regressions: (a) a suspended-bucket streaming PUT over a
+    pre-versioning object cleans BOTH the null record and the old data;
+    (b) an aborted versioned streaming PUT leaves the version store
+    untouched (no premature null adoption)."""
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        await cli.request("PUT", "/vb2")
+        pre = b"pre-versioning" * 100_000            # 1.3 MiB
+        st, _, _ = await cli.request("PUT", "/vb2/k", pre)
+        assert st == 200
+        st, _, _ = await cli.request(
+            "PUT", "/vb2?versioning",
+            b"<VersioningConfiguration><Status>Enabled</Status>"
+            b"</VersioningConfiguration>")
+        assert st == 200
+        # (b) aborted versioned streaming PUT: version list unchanged
+        bad = {"x-amz-content-sha256":
+               hashlib.sha256(b"nope").hexdigest()}
+        st, _, _ = await cli.request("PUT", "/vb2/k", pre + b"!",
+                                     headers=bad)
+        assert st in (400, 403)
+        st, _, body = await cli.request("GET", "/vb2?versions")
+        assert st == 200
+        root = ET.fromstring(body)
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        vers = root.findall(f"{ns}Version")
+        latest = [v for v in vers
+                  if v.find(f"{ns}IsLatest").text == "true"]
+        assert len(latest) == 1, "aborted PUT mutated the version store"
+        st, _, got = await cli.request("GET", "/vb2/k")
+        assert st == 200 and got == pre
+
+        # (a) suspend, then a streaming overwrite must not orphan data
+        st, _, _ = await cli.request(
+            "PUT", "/vb2?versioning",
+            b"<VersioningConfiguration><Status>Suspended</Status>"
+            b"</VersioningConfiguration>")
+        assert st == 200
+        new = b"suspended-overwrite" * 100_000
+        st, _, _ = await cli.request("PUT", "/vb2/k", new)
+        assert st == 200
+        st, _, got = await cli.request("GET", "/vb2/k")
+        assert st == 200 and got == new
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
