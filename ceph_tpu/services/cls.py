@@ -342,10 +342,12 @@ def _register_builtins(reg: ClassRegistry) -> None:
         cur = ctx.omap_get(["_seq"]).get("_seq", b"0")
         seq = int(cur) + 1
         entry = {
-            "op": str(args["op"]), "key": str(args["key"]),
+            "op": str(args.get("op", "")), "key": str(args["key"]),
             "etag": str(args.get("etag", "")),
             "mtime": float(args.get("mtime", 0.0)),
         }
+        # extra fields (pubsub event records) ride along untouched
+        entry.update({k: v for k, v in args.items() if k not in entry})
         ctx.omap_set({
             "_seq": str(seq).encode(),
             f"{seq:016d}": json.dumps(entry).encode(),

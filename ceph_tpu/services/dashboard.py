@@ -112,11 +112,13 @@ class Dashboard:
 
     async def _status(self) -> dict:
         out: dict = {"ts": time.time()}
-        out["status"] = await self._mon("status")
-        out["health"] = await self._mon("health")
-        out["osd_tree"] = await self._mon("osd tree")
-        out["mds"] = await self._mon("mds stat")
-        out["log"] = await self._mon("log last", num=50) or []
+        # the five mon reads are independent: fetch them concurrently
+        (out["status"], out["health"], out["osd_tree"], out["mds"],
+         logs) = await asyncio.gather(
+            self._mon("status"), self._mon("health"),
+            self._mon("osd tree"), self._mon("mds stat"),
+            self._mon("log last", num=50))
+        out["log"] = logs or []
         digest = getattr(self.mgr, "last_digest", None) or {}
         out["pgmap"] = {
             k: digest.get(k) for k in

@@ -81,6 +81,7 @@ def sse_check(entry: dict, key: bytes | None) -> None:
 
 USERS_OID = "rgw.users"              # omap: uid -> user record json
 KEYS_OID = "rgw.users.keys"          # omap: access key -> uid
+STS_KEYS_OID = "rgw.users.sts"       # omap: temp access key -> record
 
 _PERM_ORDER = {"READ": 0, "WRITE": 1, "FULL_CONTROL": 2}
 _CANNED_ACLS = ("private", "public-read", "public-read-write",
@@ -174,6 +175,54 @@ class RGWUsers:
         rec["suspended"] = bool(suspended)
         await self.ioctx.set_omap(USERS_OID,
                                   {uid: json.dumps(rec).encode()})
+
+    # -- STS (rgw_sts.cc AssumeRole role, -lite) ---------------------------
+    async def sts_assume(self, uid: str, ttl: int = 3600,
+                         role: str = "assumed-role") -> dict:
+        """Mint temporary credentials for ``uid`` (GetSessionToken /
+        AssumeRole): a time-bounded access/secret pair plus a session
+        token the frontend requires on every signed request."""
+        import secrets as _secrets
+
+        rec = await self.get(uid)
+        if rec.get("suspended"):
+            raise RGWError("AccessDenied", f"{uid} suspended")
+        if not 1 <= int(ttl) <= 12 * 3600:
+            raise RGWError("InvalidArgument", "ttl out of range")
+        creds = {
+            "uid": uid, "role": str(role),
+            "access_key": "STS" + _secrets.token_hex(8).upper(),
+            "secret_key": _secrets.token_hex(20),
+            "session_token": _secrets.token_hex(24),
+            "expiration": time.time() + int(ttl),
+        }
+        await self.ioctx.operate(STS_KEYS_OID, ObjectOperation()
+                                 .create()
+                                 .omap_set({creds["access_key"]:
+                                            json.dumps(creds)
+                                            .encode()}))
+        return creds
+
+    async def sts_get(self, access_key: str) -> dict | None:
+        """The live temp-credential record, or None (absent/expired —
+        expired records are reaped on lookup)."""
+        try:
+            kv = await self.ioctx.get_omap(STS_KEYS_OID, [access_key])
+        except RadosError as e:
+            if e.rc == -2:
+                return None
+            raise
+        if access_key not in kv:
+            return None
+        rec = json.loads(kv[access_key])
+        if rec["expiration"] < time.time():
+            try:
+                await self.ioctx.rm_omap_keys(STS_KEYS_OID,
+                                              [access_key])
+            except RadosError:
+                pass
+            return None
+        return rec
 
     async def authenticate(self, access_key: str, signature: str,
                            string_to_sign: bytes) -> str:
@@ -299,6 +348,9 @@ class RGWLite:
         self.datalog = datalog
         self.user = user
         self.users = users
+        # bucket -> (fetched_at, notification configs); shared across
+        # as_user handles so invalidation is seen by every identity
+        self._notif_cache: dict[str, tuple[float, list]] = {}
         self.striper = RadosStriper(ioctx, StripeLayout(
             stripe_unit=512 * 1024, stripe_count=4,
             object_size=4 * 1024 * 1024,
@@ -306,7 +358,9 @@ class RGWLite:
 
     def as_user(self, user: str | None) -> "RGWLite":
         """A handle acting as ``user`` over the same pool."""
-        return RGWLite(self.ioctx, self.datalog, user, self.users)
+        child = RGWLite(self.ioctx, self.datalog, user, self.users)
+        child._notif_cache = self._notif_cache
+        return child
 
     # -- ACL (rgw_acl.cc canned subset + explicit grants) ------------------
     async def _bucket_meta(self, bucket: str) -> dict:
@@ -1014,13 +1068,128 @@ class RGWLite:
         return f"rgw.bucket.log.{bucket}"
 
     async def _log(self, bucket: str, op: str, key: str,
-                   etag: str = "") -> None:
-        if not self.datalog:
+                   etag: str = "", event: str | None = None) -> None:
+        """``event``: explicit S3 event name when the op name alone is
+        ambiguous (a versioned DELETE logs 'del' but the S3 event is
+        DeleteMarkerCreated)."""
+        if self.datalog:
+            await self.ioctx.exec(
+                self._log_oid(bucket), "rgw", "log_add",
+                json.dumps({"op": op, "key": key, "etag": etag,
+                            "mtime": time.time()}).encode(),
+            )
+        await self._notify(bucket, op, key, etag, event)
+
+    # -- bucket notifications / pubsub (rgw_pubsub.cc role) ---------------
+    # Notification configs live in the bucket meta; events land in
+    # per-topic queue objects (same seq-allocating rgw cls as the
+    # datalog) and are consumed PULL-style (topic_pull/topic_trim — the
+    # reference pubsub sync module's pull mode).
+    _EVENT_OF_OP = {
+        "put": "s3:ObjectCreated:Put",
+        "del": "s3:ObjectRemoved:Delete",
+        # permanent removal of a specific version IS a Delete; marker
+        # creation passes an explicit event at the call site
+        "del-version": "s3:ObjectRemoved:Delete",
+    }
+
+    @staticmethod
+    def _topic_oid(topic: str) -> str:
+        return f"rgw.pubsub.topic.{topic}"
+
+    async def put_bucket_notification(
+            self, bucket: str, topic: str,
+            events: list[str] | None = None) -> None:
+        meta = await self._check_bucket(bucket, "WRITE")
+        cfgs = [c for c in meta.get("notifications", ())
+                if c["topic"] != topic]
+        cfgs.append({"topic": str(topic),
+                     "events": list(events or ["s3:ObjectCreated:*",
+                                               "s3:ObjectRemoved:*"])})
+        meta["notifications"] = cfgs
+        await self._put_bucket_meta(bucket, meta)
+        self._notif_cache.pop(bucket, None)
+
+    async def set_bucket_notifications(self, bucket: str,
+                                       configs: list[dict]) -> None:
+        """REPLACE the whole notification document (S3
+        PutBucketNotificationConfiguration semantics — an empty list is
+        how clients disable notifications; there is no DELETE API)."""
+        meta = await self._check_bucket(bucket, "WRITE")
+        meta["notifications"] = [
+            {"topic": str(c["topic"]),
+             "events": list(c.get("events")
+                            or ["s3:ObjectCreated:*",
+                                "s3:ObjectRemoved:*"])}
+            for c in configs
+        ]
+        await self._put_bucket_meta(bucket, meta)
+        self._notif_cache.pop(bucket, None)
+
+    async def get_bucket_notification(self, bucket: str) -> list[dict]:
+        meta = await self._check_bucket(bucket, "READ")
+        return list(meta.get("notifications", ()))
+
+    async def delete_bucket_notification(
+            self, bucket: str, topic: str | None = None) -> None:
+        meta = await self._check_bucket(bucket, "WRITE")
+        meta["notifications"] = [
+            c for c in meta.get("notifications", ())
+            if topic is not None and c["topic"] != topic
+        ]
+        await self._put_bucket_meta(bucket, meta)
+        self._notif_cache.pop(bucket, None)
+
+    @staticmethod
+    def _event_match(pattern: str, event: str) -> bool:
+        return (pattern == event
+                or (pattern.endswith("*")
+                    and event.startswith(pattern[:-1])))
+
+    async def _notify(self, bucket: str, op: str, key: str,
+                      etag: str, event: str | None = None) -> None:
+        event = event or self._EVENT_OF_OP.get(op)
+        if event is None:
             return
+        now = time.time()
+        cached = self._notif_cache.get(bucket)
+        if cached is None or now - cached[0] > 5.0:
+            try:
+                meta = await self._bucket_meta(bucket)
+            except RGWError:
+                return
+            if len(self._notif_cache) > 4096:
+                self._notif_cache.clear()
+            cached = (now, list(meta.get("notifications", ())))
+            self._notif_cache[bucket] = cached
+        for cfg in cached[1]:
+            if any(self._event_match(p, event)
+                   for p in cfg.get("events", ())):
+                await self.ioctx.exec(
+                    self._topic_oid(cfg["topic"]), "rgw", "log_add",
+                    json.dumps({
+                        "op": "notify", "key": key, "etag": etag,
+                        "mtime": now, "eventName": event,
+                        "bucket": bucket, "eventTime": now,
+                    }).encode(),
+                )
+
+    async def topic_pull(self, topic: str, after: int = 0,
+                         max_events: int = 1000) -> dict:
+        """Consume queued events (pull mode): {'events': [...],
+        'last': seq} — pass ``last`` back as ``after`` to resume."""
+        out = json.loads(await self.ioctx.exec(
+            self._topic_oid(topic), "rgw", "log_list",
+            json.dumps({"after": after, "max": max_events}).encode(),
+        ))
+        entries = out.get("entries", [])
+        last = entries[-1]["seq"] if entries else after
+        return {"events": entries, "last": last}
+
+    async def topic_trim(self, topic: str, upto: int) -> None:
         await self.ioctx.exec(
-            self._log_oid(bucket), "rgw", "log_add",
-            json.dumps({"op": op, "key": key, "etag": etag,
-                        "mtime": time.time()}).encode(),
+            self._topic_oid(topic), "rgw", "log_trim",
+            json.dumps({"upto": upto}).encode(),
         )
 
     async def log_list(self, bucket: str, after: int = 0,
@@ -1052,6 +1221,8 @@ class RGWLite:
                                  }).encode()}))
         await self.ioctx.operate(self._index_oid(bucket),
                                  ObjectOperation().create())
+        # a recreated name must not inherit the old bucket's configs
+        self._notif_cache.pop(bucket, None)
 
     async def delete_bucket(self, bucket: str) -> None:
         meta = await self._bucket_meta(bucket)
@@ -1069,6 +1240,7 @@ class RGWLite:
         except RadosError as e:
             if e.rc != -2:
                 raise
+        self._notif_cache.pop(bucket, None)
         await self.ioctx.remove(self._index_oid(bucket))
         try:
             await self.ioctx.remove(self._log_oid(bucket))
@@ -1368,7 +1540,8 @@ class RGWLite:
             await self.ioctx.set_omap(index_oid, {
                 key: json.dumps(marker).encode(),
             })
-            await self._log(bucket, "del", key)
+            await self._log(bucket, "del", key,
+                            event="s3:ObjectRemoved:DeleteMarkerCreated")
             return
         if state == "suspended":
             # suspended DELETE replaces the 'null' version with a null
@@ -1388,7 +1561,8 @@ class RGWLite:
             await self.ioctx.set_omap(index_oid, {
                 key: json.dumps(marker).encode(),
             })
-            await self._log(bucket, "del", key)
+            await self._log(bucket, "del", key,
+                            event="s3:ObjectRemoved:DeleteMarkerCreated")
             return
         if entry is None or entry.get("delete_marker"):
             raise RGWError("NoSuchKey", f"{bucket}/{key}")

@@ -293,7 +293,7 @@ class S3Frontend:
         if len(parts) < 2 or not parts[1]:
             return False                # not an object-level request
         blocked = {"partNumber", "uploadId", "acl", "versioning",
-                   "lifecycle", "tagging"}
+                   "lifecycle", "tagging", "notification", "delete"}
         if blocked & set(req.query):
             return False
         if req.header("x-amz-copy-source"):
@@ -372,7 +372,12 @@ class S3Frontend:
             raise _HTTPError(403, "InvalidAccessKeyId", access_key)
         amz_date = req.header("x-amz-date")
         self._check_request_time(amz_date, day)
-        uid, secret = await self._lookup_key(access_key)
+        uid, secret, session_token = await self._lookup_key(access_key)
+        if session_token is not None and not hmac.compare_digest(
+                session_token, req.header("x-amz-security-token")):
+            # STS credentials are only valid with their session token
+            # (reference rgw_sts.cc session validation)
+            raise _HTTPError(403, "InvalidToken", access_key)
         scope = f"{day}/{region}/s3/aws4_request"
         sts = sigv4_string_to_sign(req, signed, scope, amz_date)
         want = hmac.new(_sig_key(secret, day, region, "s3"),
@@ -409,7 +414,11 @@ class S3Frontend:
         if abs(time.time() - ts) > self._SKEW_S:
             raise _HTTPError(403, "RequestTimeTooSkewed", amz_date)
 
-    async def _lookup_key(self, access_key: str) -> tuple[str, str]:
+    async def _lookup_key(self, access_key: str
+                          ) -> tuple[str, str, str | None]:
+        """(uid, signing secret, required session token or None):
+        permanent keys resolve through the user db, STS temp keys
+        through the time-bounded credential table."""
         from ceph_tpu.services.rgw import KEYS_OID
         from ceph_tpu.client.rados import RadosError
 
@@ -421,12 +430,20 @@ class S3Frontend:
             else:
                 raise
         if access_key not in kv:
-            raise _HTTPError(403, "InvalidAccessKeyId", access_key)
+            sts_rec = await self.users.sts_get(access_key)
+            if sts_rec is None:
+                raise _HTTPError(403, "InvalidAccessKeyId", access_key)
+            rec = await self.users.get(sts_rec["uid"])
+            if rec.get("suspended"):
+                raise _HTTPError(403, "AccessDenied",
+                                 f"{sts_rec['uid']} suspended")
+            return (sts_rec["uid"], sts_rec["secret_key"],
+                    sts_rec["session_token"])
         uid = kv[access_key].decode()
         rec = await self.users.get(uid)
         if rec.get("suspended"):
             raise _HTTPError(403, "AccessDenied", f"{uid} suspended")
-        return uid, rec["secret_key"]
+        return uid, rec["secret_key"], None
 
     # -- routing (rgw_rest_s3.cc RGWHandler_REST_S3) ----------------------
     async def _route(self, req: _Request):
@@ -480,6 +497,25 @@ class S3Frontend:
                 canned = req.header("x-amz-acl", "private")
                 await gw.put_bucket_acl(bucket, canned)
                 return 200, {}, b""
+            if "notification" in q:
+                # S3 PutBucketNotificationConfiguration REPLACES the
+                # whole document (an empty one disables notifications)
+                cfg = ET.fromstring(req.body.decode() or
+                                    "<NotificationConfiguration/>")
+                configs = []
+                for tc in (list(cfg.findall(_ns("TopicConfiguration")))
+                           or list(cfg.findall("TopicConfiguration"))):
+                    topic = (tc.findtext(_ns("Topic"))
+                             or tc.findtext("Topic") or "")
+                    topic = topic.rsplit(":", 1)[-1]     # arn -> name
+                    events = [e.text for e in
+                              (tc.findall(_ns("Event"))
+                               or tc.findall("Event")) if e.text]
+                    if topic:
+                        configs.append({"topic": topic,
+                                        "events": events})
+                await gw.set_bucket_notifications(bucket, configs)
+                return 200, {}, b""
             await gw.create_bucket(bucket)
             return 200, {"location": f"/{bucket}"}, b""
         if req.method == "DELETE":
@@ -526,6 +562,16 @@ class S3Frontend:
                 exp = ET.SubElement(r, "Expiration")
                 ET.SubElement(exp, "Days").text = \
                     str(rule.get("expiration_days", 0))
+            return self._xml(root)
+        if "notification" in q:
+            cfgs = await gw.get_bucket_notification(bucket)
+            root = ET.Element("NotificationConfiguration", xmlns=XMLNS)
+            for c in cfgs:
+                tc = ET.SubElement(root, "TopicConfiguration")
+                ET.SubElement(tc, "Topic").text = \
+                    f"arn:aws:sns:::{c['topic']}"
+                for e in c.get("events", ()):
+                    ET.SubElement(tc, "Event").text = e
             return self._xml(root)
         if "acl" in q:
             acl = await gw.get_bucket_acl(bucket)

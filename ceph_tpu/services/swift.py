@@ -1,0 +1,305 @@
+"""Swift REST frontend for RGW-lite: the rgw_rest_swift.h role.
+
+The reference serves the OpenStack Swift dialect off the same RGWRados
+store as S3 (src/rgw/rgw_rest_swift.{h,cc}); this frontend serves the
+Swift v1 core off the same :class:`RGWLite`, so a container created
+over Swift is a bucket over S3 and vice versa:
+
+- TempAuth handshake (``GET /auth/v1.0`` with ``X-Auth-User`` /
+  ``X-Auth-Key``) returning ``X-Auth-Token`` + ``X-Storage-Url``; the
+  token is self-validating (uid + expiry + HMAC over the user's secret
+  key), so no server-side token table is needed.
+- Account:   ``GET /v1/AUTH_<uid>``        container listing (JSON)
+- Container: ``PUT/GET/HEAD/DELETE /v1/AUTH_<uid>/<container>``
+- Object:    ``PUT/GET/HEAD/DELETE/POST /v1/AUTH_<uid>/<c>/<obj>``
+  with ``X-Object-Meta-*`` metadata, Range reads, and POST metadata
+  replacement (Swift semantics).
+
+Authorization rides RGWLite ``as_user`` exactly like the S3 frontend,
+so ACL/quota/versioning behavior is shared.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import json
+import time
+from email.utils import formatdate
+
+from ceph_tpu.common.log import Dout
+from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers
+
+log = Dout("rgw-http")
+
+_MAX_BODY = 256 * 1024 * 1024
+TOKEN_TTL = 24 * 3600
+
+# RGWError -> Swift status
+_STATUS = {
+    "AccessDenied": 403,
+    "NoSuchBucket": 404,
+    "NoSuchKey": 404,
+    "BucketNotEmpty": 409,
+    "BucketAlreadyExists": 202,    # Swift PUT container is idempotent
+    "QuotaExceeded": 413,
+}
+
+
+def _mint_token(uid: str, secret: str, now: float | None = None) -> str:
+    exp = int((now or time.time()) + TOKEN_TTL)
+    mac = hmac.new(secret.encode(), f"{uid}:{exp}".encode(),
+                   hashlib.sha256).hexdigest()[:32]
+    return f"AUTH_tk{uid}:{exp}:{mac}"
+
+
+class SwiftFrontend:
+    """One listening Swift endpoint over an RGWLite handle."""
+
+    def __init__(self, rgw: RGWLite, users: RGWUsers | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.rgw = rgw
+        self.users = users if users is not None else rgw.users
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.dout(1, "swift frontend on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- http plumbing -----------------------------------------------------
+    async def _client(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                head = await reader.readuntil(b"\r\n\r\n")
+                lines = head.decode("latin-1").split("\r\n")
+                try:
+                    method, path, _ = lines[0].split(" ", 2)
+                except ValueError:
+                    break
+                hdrs = {}
+                for ln in lines[1:]:
+                    if ln:
+                        k, _, v = ln.partition(":")
+                        hdrs[k.strip().lower()] = v.strip()
+                try:
+                    length = int(hdrs.get("content-length", "0") or 0)
+                except ValueError:
+                    length = -1
+                if not 0 <= length <= _MAX_BODY:
+                    status, rh, body = 400, {}, b"bad content-length"
+                else:
+                    data = await reader.readexactly(length) \
+                        if length else b""
+                    try:
+                        status, rh, body = await self._route(
+                            method.upper(), path, hdrs, data)
+                    except RGWError as e:
+                        status = _STATUS.get(e.code, 400)
+                        rh, body = {}, str(e).encode()
+                    except (ValueError, KeyError) as e:
+                        status, rh, body = 400, {}, repr(e).encode()
+                keep = hdrs.get("connection", "keep-alive") != "close"
+                base = {"date": formatdate(usegmt=True),
+                        "connection":
+                            "keep-alive" if keep else "close"}
+                base.update(rh)
+                # handlers (e.g. HEAD object) may have set the entity
+                # size already; only fill in the actual body length
+                base.setdefault("content-length", str(len(body)))
+                out = [f"HTTP/1.1 {status} S"]
+                out += [f"{k}: {v}" for k, v in base.items()]
+                payload = "\r\n".join(out).encode("latin-1") \
+                    + b"\r\n\r\n"
+                if method.upper() != "HEAD":
+                    payload += body
+                writer.write(payload)
+                await writer.drain()
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError,
+                asyncio.LimitOverrunError, OSError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    # -- auth (TempAuth) ---------------------------------------------------
+    async def _auth_handshake(self, hdrs: dict):
+        user = hdrs.get("x-auth-user", "")
+        key = hdrs.get("x-auth-key", "")
+        uid = user.split(":", 1)[0]
+        try:
+            rec = await self.users.get(uid)
+        except RGWError:
+            return 401, {}, b"bad credentials"
+        if rec.get("suspended") or key != rec["secret_key"]:
+            return 401, {}, b"bad credentials"
+        token = _mint_token(uid, rec["secret_key"])
+        url = f"http://{self.host}:{self.port}/v1/AUTH_{uid}"
+        return 200, {"x-auth-token": token,
+                     "x-storage-token": token,
+                     "x-storage-url": url}, b""
+
+    async def _validate_token(self, token: str) -> str:
+        """Token -> uid, or raise AccessDenied."""
+        try:
+            rest = token.removeprefix("AUTH_tk")
+            uid, exp_s, mac = rest.rsplit(":", 2)
+            exp = int(exp_s)
+        except ValueError:
+            raise RGWError("AccessDenied", "malformed token")
+        if exp < time.time():
+            raise RGWError("AccessDenied", "token expired")
+        try:
+            rec = await self.users.get(uid)
+        except RGWError:
+            raise RGWError("AccessDenied", "unknown account")
+        want = hmac.new(rec["secret_key"].encode(),
+                        f"{uid}:{exp}".encode(),
+                        hashlib.sha256).hexdigest()[:32]
+        if not hmac.compare_digest(want, mac):
+            raise RGWError("AccessDenied", "bad token")
+        if rec.get("suspended"):
+            raise RGWError("AccessDenied", f"{uid} suspended")
+        return uid
+
+    # -- routing (RGWHandler_REST_SWIFT) -----------------------------------
+    async def _route(self, method: str, raw_path: str, hdrs: dict,
+                     body: bytes):
+        path = raw_path.split("?", 1)[0]
+        if path.rstrip("/") == "/auth/v1.0":
+            return await self._auth_handshake(hdrs)
+        uid = await self._validate_token(hdrs.get("x-auth-token", ""))
+        parts = [p for p in path.split("/") if p]
+        # /v1/AUTH_<account>[/container[/object...]]
+        if len(parts) < 2 or parts[0] != "v1" \
+                or not parts[1].startswith("AUTH_"):
+            return 404, {}, b"not found"
+        account = parts[1][len("AUTH_"):]
+        if account != uid:
+            raise RGWError("AccessDenied", "cross-account access")
+        gw = self.rgw.as_user(uid)
+        if len(parts) == 2:
+            return await self._account(method, gw, uid)
+        container = parts[2]
+        if len(parts) == 3:
+            return await self._container(method, gw, container)
+        obj = "/".join(parts[3:])
+        return await self._object(method, gw, container, obj, hdrs,
+                                  body)
+
+    async def _account(self, method: str, gw: RGWLite, uid: str):
+        if method not in ("GET", "HEAD"):
+            return 405, {}, b""
+        out = []
+        for b in await gw.list_buckets():
+            try:
+                meta = await gw._bucket_meta(b)
+            except RGWError:
+                continue
+            if meta.get("owner") != uid:
+                continue
+            nobj, nbytes = await gw._bucket_usage(b)
+            out.append({"name": b, "count": nobj, "bytes": nbytes})
+        return 200, {"content-type": "application/json",
+                     "x-account-container-count": str(len(out))}, \
+            json.dumps(out).encode()
+
+    async def _container(self, method: str, gw: RGWLite, name: str):
+        if method == "PUT":
+            try:
+                await gw.create_bucket(name)
+                return 201, {}, b""
+            except RGWError as e:
+                if e.code == "BucketAlreadyExists":
+                    return 202, {}, b""     # Swift: idempotent accept
+                raise
+        if method == "DELETE":
+            await gw.delete_bucket(name)
+            return 204, {}, b""
+        if method in ("GET", "HEAD"):
+            listing = await gw.list_objects(name, max_keys=10000)
+            out = [{
+                "name": c["key"], "bytes": c["size"],
+                "hash": c["etag"],
+                "last_modified": _iso(c["mtime"]),
+            } for c in listing["contents"]]
+            return 200, {"content-type": "application/json",
+                         "x-container-object-count": str(len(out))}, \
+                json.dumps(out).encode()
+        return 405, {}, b""
+
+    async def _object(self, method: str, gw: RGWLite, container: str,
+                      obj: str, hdrs: dict, body: bytes):
+        if method == "PUT":
+            meta = {k[len("x-object-meta-"):]: v
+                    for k, v in hdrs.items()
+                    if k.startswith("x-object-meta-")}
+            out = await gw.put_object(
+                container, obj, body,
+                content_type=hdrs.get("content-type",
+                                      "application/octet-stream"),
+                metadata=meta)
+            return 201, {"etag": out["etag"]}, b""
+        if method == "POST":
+            # Swift POST REPLACES the object metadata set (unlike S3
+            # copy-with-metadata); -lite rewrites the index entry
+            await gw._check_bucket(container, "WRITE")
+            entry = await gw.head_object(container, obj)
+            entry["meta"] = {k[len("x-object-meta-"):]: v
+                             for k, v in hdrs.items()
+                             if k.startswith("x-object-meta-")}
+            await gw.ioctx.set_omap(gw._index_oid(container), {
+                obj: json.dumps(entry).encode()})
+            return 202, {}, b""
+        if method == "DELETE":
+            await gw.delete_object(container, obj)
+            return 204, {}, b""
+        if method in ("GET", "HEAD"):
+            rng = None
+            rh = hdrs.get("range", "")
+            if rh.startswith("bytes=") and "-" in rh[6:]:
+                a, _, b = rh[6:].partition("-")
+                if a:
+                    rng = (int(a), int(b) if b else (1 << 62))
+            if method == "HEAD":
+                entry = await gw.head_object(container, obj)
+                return 200, _obj_headers(entry), b""
+            got = await gw.get_object(container, obj, range_=rng)
+            status = 206 if rng is not None else 200
+            return status, _obj_headers(got), got["data"]
+        return 405, {}, b""
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000000",
+                         time.gmtime(ts))
+
+
+def _obj_headers(entry: dict) -> dict:
+    hdrs = {
+        "content-type": entry.get("content_type",
+                                  "application/octet-stream"),
+        "etag": entry.get("etag", ""),
+        "x-timestamp": str(entry.get("mtime", 0.0)),
+        "content-length": str(entry.get("size", 0)),
+    }
+    for k, v in (entry.get("meta") or {}).items():
+        hdrs[f"x-object-meta-{k}"] = str(v)
+    return hdrs

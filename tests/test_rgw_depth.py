@@ -8,6 +8,7 @@ ceilings), rgw_lc.cc (expiration rules + the LC worker pass).
 import asyncio
 import hashlib
 import hmac
+import json
 import time
 
 import pytest
@@ -202,4 +203,76 @@ def test_lifecycle_expiration():
         finally:
             await stop_cluster(mon, osds, rados)
 
+    asyncio.run(run())
+
+
+def test_bucket_notifications_pubsub():
+    """rgw_pubsub.cc role: topic configs on a bucket, events queued on
+    put/delete, pull-mode consumption + trim, wildcard matching."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("rgw", pg_num=8)
+        ioctx = await rados.open_ioctx("rgw")
+        gw = RGWLite(ioctx)
+        await gw.create_bucket("events")
+        await gw.put_bucket_notification(
+            "events", "creations", ["s3:ObjectCreated:*"])
+        await gw.put_bucket_notification(
+            "events", "everything")
+        assert len(await gw.get_bucket_notification("events")) == 2
+
+        await gw.put_object("events", "a", b"1")
+        await gw.delete_object("events", "a")
+        await gw.put_object("events", "b", b"2")
+
+        got = await gw.topic_pull("creations")
+        names = [e["eventName"] for e in got["events"]]
+        assert names == ["s3:ObjectCreated:Put",
+                         "s3:ObjectCreated:Put"]
+        assert [e["key"] for e in got["events"]] == ["a", "b"]
+        all_got = await gw.topic_pull("everything")
+        assert [e["eventName"] for e in all_got["events"]] == [
+            "s3:ObjectCreated:Put", "s3:ObjectRemoved:Delete",
+            "s3:ObjectCreated:Put"]
+        # trim consumes; a fresh pull resumes after the trim point
+        await gw.topic_trim("creations", got["last"])
+        assert (await gw.topic_pull("creations"))["events"] == []
+        # removing the config stops the flow (cache invalidated)
+        await gw.delete_bucket_notification("events", "creations")
+        await gw.put_object("events", "c", b"3")
+        assert (await gw.topic_pull("creations"))["events"] == []
+        assert len((await gw.topic_pull(
+            "everything", after=all_got["last"]))["events"]) == 1
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_sts_temporary_credentials():
+    """rgw_sts.cc role: AssumeRole-style temp creds sign requests only
+    with their session token and die at expiry."""
+    import time as _time
+
+    from ceph_tpu.services.rgw import RGWUsers
+
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("rgw", pg_num=8)
+        ioctx = await rados.open_ioctx("rgw")
+        users = RGWUsers(ioctx)
+        await users.create("carol")
+        creds = await users.sts_assume("carol", ttl=3600)
+        assert creds["access_key"].startswith("STS")
+        rec = await users.sts_get(creds["access_key"])
+        assert rec is not None and rec["uid"] == "carol"
+        # expiry reaps the record
+        expired = await users.sts_assume("carol", ttl=1)
+        await ioctx.set_omap(
+            "rgw.users.sts",
+            {expired["access_key"]: json.dumps(
+                {**expired, "expiration": _time.time() - 5}
+            ).encode()})
+        assert await users.sts_get(expired["access_key"]) is None
+        with pytest.raises(RGWError):
+            await users.sts_assume("ghost")
+        await stop_cluster(mon, osds, rados)
     asyncio.run(run())

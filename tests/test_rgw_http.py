@@ -564,3 +564,53 @@ def test_aborted_streaming_put_suspended_and_versioned():
         await fe.stop()
         await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_notification_rest_and_sts_signed_request():
+    """?notification config over REST queues events; STS temp creds
+    sign S3 requests only with their session token."""
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        await cli.request("PUT", "/nb")
+        cfg = (b'<NotificationConfiguration>'
+               b'<TopicConfiguration>'
+               b'<Topic>arn:aws:sns:::mytopic</Topic>'
+               b'<Event>s3:ObjectCreated:*</Event>'
+               b'</TopicConfiguration></NotificationConfiguration>')
+        st, _, _ = await cli.request("PUT", "/nb?notification", cfg)
+        assert st == 200
+        st, _, body = await cli.request("GET", "/nb?notification")
+        assert st == 200 and b"mytopic" in body
+        st, _, _ = await cli.request("PUT", "/nb/obj", b"data")
+        assert st == 200
+        got = await fe.rgw.topic_pull("mytopic")
+        assert [e["eventName"] for e in got["events"]] == \
+            ["s3:ObjectCreated:Put"]
+        assert got["events"][0]["bucket"] == "nb"
+        # an empty document DISABLES notifications (replace semantics)
+        st, _, _ = await cli.request(
+            "PUT", "/nb?notification",
+            b"<NotificationConfiguration/>")
+        assert st == 200
+        st, _, _ = await cli.request("PUT", "/nb/obj2", b"more")
+        assert st == 200
+        got2 = await fe.rgw.topic_pull("mytopic", after=got["last"])
+        assert got2["events"] == [], "empty config did not disable"
+
+        # STS: a temp-cred client works WITH its token, fails without
+        creds = await users.sts_assume("alice", ttl=600)
+        sts_cli = S3HttpClient("127.0.0.1", fe.port,
+                               creds["access_key"],
+                               creds["secret_key"])
+        st, _, _ = await sts_cli.request(
+            "GET", "/nb", headers={
+                "x-amz-security-token": creds["session_token"]})
+        assert st == 200
+        st, _, _ = await sts_cli.request("GET", "/nb")
+        assert st == 403                    # missing session token
+        st, _, _ = await sts_cli.request(
+            "GET", "/nb", headers={"x-amz-security-token": "forged"})
+        assert st == 403
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
