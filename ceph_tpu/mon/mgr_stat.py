@@ -105,6 +105,11 @@ class MgrStatMonitor(PaxosService):
             }))
         if name == "progress":
             return CommandResult(data=self.digest.get("progress", []))
+        if name == "device ls":
+            return CommandResult(data=self.digest.get("device_health",
+                                                      {}))
+        if name == "telemetry show":
+            return CommandResult(data=self.digest.get("telemetry", {}))
         if name == "osd pool autoscale-status":
             return CommandResult(data=self.digest.get("pg_autoscale",
                                                       {}))

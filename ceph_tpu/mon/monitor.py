@@ -602,7 +602,8 @@ class Monitor:
         word = str(cmd.get("prefix", "")).split(" ", 1)[0]
         # pgmap-digest reads and mgr-module surfaces live on the
         # mgr-stat service (PGMap / balancer / progress / crash)
-        if word in ("pg", "df", "balancer", "progress", "crash"):
+        if word in ("pg", "df", "balancer", "progress", "crash",
+                    "device", "telemetry"):
             return self.mgr_stat
         if word == "config-key":
             return self.config_monitor

@@ -26,8 +26,10 @@ class Mgr:
                  modules: list | None = None):
         from ceph_tpu.services.mgr_modules import (
             Balancer,
+            DeviceHealth,
             PGAutoscaler,
             Progress,
+            Telemetry,
         )
 
         self.conf = conf or ConfigProxy()
@@ -42,7 +44,8 @@ class Mgr:
         self.admin_socket = None
         if modules is None:
             modules = [Balancer(self), PGAutoscaler(self),
-                       Progress(self)]
+                       Progress(self), DeviceHealth(self),
+                       Telemetry(self)]
         self.modules = {m.name: m for m in modules}
         self.last_digest: dict | None = None
 
@@ -200,18 +203,21 @@ class Mgr:
         }
 
     async def report(self) -> dict:
-        """One aggregation + module + push cycle (MMonMgrReport)."""
+        """One aggregation + module + push cycle (MMonMgrReport).
+        Two passes: serve + health first, so modules that OBSERVE the
+        digest (telemetry) see health_checks populated."""
         digest = await self.build_digest()
         health: dict = {}
+        for mod in self.modules.values():
+            await mod.serve_once()
+            health.update(mod.health_checks())
+        if health:
+            digest["health_checks"] = health
         for mod in self.modules.values():
             observe = getattr(mod, "observe_digest", None)
             if observe is not None:
                 observe(digest)
-            await mod.serve_once()
             digest.update(mod.digest_contrib())
-            health.update(mod.health_checks())
-        if health:
-            digest["health_checks"] = health
         self.last_digest = digest       # dashboard/metrics snapshot
         await self.monc.command("mgr report", digest=digest)
         return digest

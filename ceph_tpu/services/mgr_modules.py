@@ -264,3 +264,89 @@ class Progress(MgrModule):
         return {"progress": sorted(
             self._events.values(), key=lambda e: e["id"]
         )}
+
+
+class DeviceHealth(MgrModule):
+    """Device health tracking (reference src/pybind/mgr/devicehealth at
+    -lite scale): without SMART access, the observable failure signal
+    is OSD up/down flapping and fullness — each daemon's transitions
+    are counted and repeated flappers raise a health check (the
+    life-expectancy warning role)."""
+
+    name = "devicehealth"
+    FLAP_WARN = 3
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._was_up: dict[int, bool] = {}
+        self._flaps: dict[int, int] = {}
+        self._last_down: dict[int, float] = {}
+
+    async def serve_once(self) -> None:
+        osdmap = self.mgr.monc.osdmap
+        if osdmap is None:
+            return
+        for osd, info in sorted(osdmap.osds.items()):
+            up = bool(info.up)
+            was = self._was_up.get(osd)
+            if was is True and not up:
+                self._flaps[osd] = self._flaps.get(osd, 0) + 1
+                self._last_down[osd] = time.time()
+            self._was_up[osd] = up
+
+    def digest_contrib(self) -> dict:
+        devices = {}
+        for osd in sorted(self._was_up):
+            devices[str(osd)] = {
+                "daemon": f"osd.{osd}",
+                "up": self._was_up.get(osd, False),
+                "flaps": self._flaps.get(osd, 0),
+                "last_down": self._last_down.get(osd, 0.0),
+            }
+        return {"device_health": devices}
+
+    def health_checks(self) -> dict[str, dict]:
+        bad = sorted(o for o, n in self._flaps.items()
+                     if n >= self.FLAP_WARN)
+        if not bad:
+            return {}
+        return {"DEVICE_HEALTH_FLAPPING": {
+            "severity": "HEALTH_WARN",
+            "message": f"{len(bad)} devices flapping repeatedly",
+            "detail": [f"osd.{o} went down "
+                       f"{self._flaps[o]} times" for o in bad],
+        }}
+
+
+class Telemetry(MgrModule):
+    """Anonymized cluster report (reference src/pybind/mgr/telemetry):
+    aggregate counts only — no names, keys, or addresses — surfaced via
+    ``telemetry show``.  Nothing is phoned home (zero egress); the
+    report is what WOULD be sent."""
+
+    name = "telemetry"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._report: dict = {}
+
+    def observe_digest(self, digest: dict) -> None:
+        osdmap = self.mgr.monc.osdmap
+        pools = digest.get("pools", {})
+        self._report = {
+            "report_timestamp": time.time(),
+            "num_osds": len(osdmap.osds) if osdmap else 0,
+            "num_pools": len(pools),
+            "num_pgs": int(digest.get("num_pgs", 0)),
+            "num_objects": int(digest.get("num_objects", 0)),
+            "total_bytes": int(digest.get("num_bytes", 0)),
+            "pool_types": sorted({
+                p.pool_type
+                for p in (osdmap.pools.values() if osdmap else ())
+            }),
+            "health_checks": sorted(
+                digest.get("health_checks", {})),
+        }
+
+    def digest_contrib(self) -> dict:
+        return {"telemetry": self._report}

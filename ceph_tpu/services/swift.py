@@ -99,10 +99,13 @@ class SwiftFrontend:
                 except ValueError:
                     length = -1
                 if not 0 <= length <= _MAX_BODY:
+                    # the unread body would desynchronize a reused
+                    # connection: answer and close
                     status, rh, body = 400, {}, b"bad content-length"
+                    hdrs["connection"] = "close"
                 else:
                     data = await reader.readexactly(length) \
-                        if length else b""
+                        if length > 0 else b""
                     try:
                         status, rh, body = await self._route(
                             method.upper(), path, hdrs, data)
@@ -148,7 +151,8 @@ class SwiftFrontend:
             rec = await self.users.get(uid)
         except RGWError:
             return 401, {}, b"bad credentials"
-        if rec.get("suspended") or key != rec["secret_key"]:
+        if rec.get("suspended") or not hmac.compare_digest(
+                key, rec["secret_key"]):
             return 401, {}, b"bad credentials"
         token = _mint_token(uid, rec["secret_key"])
         url = f"http://{self.host}:{self.port}/v1/AUTH_{uid}"
@@ -182,7 +186,13 @@ class SwiftFrontend:
     # -- routing (RGWHandler_REST_SWIFT) -----------------------------------
     async def _route(self, method: str, raw_path: str, hdrs: dict,
                      body: bytes):
-        path = raw_path.split("?", 1)[0]
+        import urllib.parse
+
+        path, _, rawq = raw_path.partition("?")
+        query = {}
+        for part in rawq.split("&") if rawq else ():
+            k, _, v = part.partition("=")
+            query[urllib.parse.unquote(k)] = urllib.parse.unquote(v)
         if path.rstrip("/") == "/auth/v1.0":
             return await self._auth_handshake(hdrs)
         uid = await self._validate_token(hdrs.get("x-auth-token", ""))
@@ -199,7 +209,7 @@ class SwiftFrontend:
             return await self._account(method, gw, uid)
         container = parts[2]
         if len(parts) == 3:
-            return await self._container(method, gw, container)
+            return await self._container(method, gw, container, query)
         obj = "/".join(parts[3:])
         return await self._object(method, gw, container, obj, hdrs,
                                   body)
@@ -221,7 +231,9 @@ class SwiftFrontend:
                      "x-account-container-count": str(len(out))}, \
             json.dumps(out).encode()
 
-    async def _container(self, method: str, gw: RGWLite, name: str):
+    async def _container(self, method: str, gw: RGWLite, name: str,
+                         query: dict | None = None):
+        query = query or {}
         if method == "PUT":
             try:
                 await gw.create_bucket(name)
@@ -234,15 +246,26 @@ class SwiftFrontend:
             await gw.delete_bucket(name)
             return 204, {}, b""
         if method in ("GET", "HEAD"):
-            listing = await gw.list_objects(name, max_keys=10000)
+            # Swift listing semantics: ?limit= caps the page, ?marker=
+            # resumes after a name, ?prefix= filters — clients page
+            # through arbitrarily large containers
+            try:
+                limit = min(int(query.get("limit", 10000)), 10000)
+            except ValueError:
+                limit = 10000
+            listing = await gw.list_objects(
+                name, prefix=query.get("prefix", ""),
+                marker=query.get("marker", ""), max_keys=limit)
             out = [{
                 "name": c["key"], "bytes": c["size"],
                 "hash": c["etag"],
                 "last_modified": _iso(c["mtime"]),
             } for c in listing["contents"]]
-            return 200, {"content-type": "application/json",
-                         "x-container-object-count": str(len(out))}, \
-                json.dumps(out).encode()
+            rh = {"content-type": "application/json",
+                  "x-container-object-count": str(len(out))}
+            if listing.get("is_truncated"):
+                rh["x-container-truncated"] = "true"
+            return 200, rh, json.dumps(out).encode()
         return 405, {}, b""
 
     async def _object(self, method: str, gw: RGWLite, container: str,
@@ -282,8 +305,16 @@ class SwiftFrontend:
                 entry = await gw.head_object(container, obj)
                 return 200, _obj_headers(entry), b""
             got = await gw.get_object(container, obj, range_=rng)
-            status = 206 if rng is not None else 200
-            return status, _obj_headers(got), got["data"]
+            rh = _obj_headers(got)
+            if rng is not None:
+                # the entity is the RANGE: frame it correctly or a
+                # keep-alive peer blocks waiting for the full size
+                size = int(got.get("size", 0))
+                end = min(rng[1], size - 1)
+                rh["content-length"] = str(len(got["data"]))
+                rh["content-range"] = f"bytes {rng[0]}-{end}/{size}"
+                return 206, rh, got["data"]
+            return 200, rh, got["data"]
         return 405, {}, b""
 
 

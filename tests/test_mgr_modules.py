@@ -253,3 +253,56 @@ def test_crash_lifecycle_and_config_key():
             await cluster.stop()
 
     asyncio.run(run())
+
+
+def test_devicehealth_and_telemetry():
+    """devicehealth counts OSD flaps (health check at 3+); telemetry
+    publishes an anonymized counts-only report via 'telemetry show'."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="tm",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("tm")
+            await io.write_full("o", b"x" * 500)
+            mgr = await cluster.start_mgr()
+
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                r = await rados.mon_command("telemetry show")
+                t = r["data"]
+                if r["rc"] == 0 and t.get("num_pgs"):
+                    break
+                assert asyncio.get_running_loop().time() < deadline, r
+                await asyncio.sleep(0.2)
+            assert t["num_osds"] == 3
+            assert t["num_pools"] >= 1
+            assert t["total_bytes"] >= 500
+            assert "replicated" in t["pool_types"]
+            # counts only: nothing identifying leaks into the report
+            flat = str(t)
+            assert "tm" not in t.get("pool_types", [])
+            assert "local://" not in flat and "tcp://" not in flat
+
+            # device ls reflects up state; flap counting sees a bounce
+            r = await rados.mon_command("device ls")
+            assert r["rc"] == 0 and set(r["data"]) == {"0", "1", "2"}
+            dh = mgr.modules["devicehealth"]
+            # simulate observed transitions (mon-grace cycles are slow)
+            dh._was_up[2] = True
+            osd_info = mgr.monc.osdmap.osds[2]
+            was = osd_info.up
+            osd_info.up = False
+            await dh.serve_once()
+            osd_info.up = was
+            assert dh._flaps[2] == 1
+            dh._flaps[2] = 3
+            checks = dh.health_checks()
+            assert "DEVICE_HEALTH_FLAPPING" in checks
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())

@@ -114,10 +114,14 @@ def test_nonsticky_mute_clears_with_check():
             await rados.mon_command("health mute", code="OSD_DOWN")
             await cluster.revive_osd(1)
             await cluster.wait_health_ok()
-            await asyncio.sleep(0.5)
-            # the mute must have evaporated with the check
+            # the mute must evaporate with the check; clearing rides a
+            # health tick — poll, don't trust a fixed sleep under load
             mon = next(iter(cluster.mons.values()))
-            assert "OSD_DOWN" not in mon.health_monitor.mutes
+            deadline = asyncio.get_running_loop().time() + 10
+            while "OSD_DOWN" in mon.health_monitor.mutes:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    mon.health_monitor.mutes
+                await asyncio.sleep(0.2)
             await rados.shutdown()
         finally:
             await cluster.stop()

@@ -201,18 +201,30 @@ def test_split_after_restart():
                              host="h2")
             await osd2.start()
             osds[2] = osd2
-            await asyncio.sleep(1.5)
             pool_id = next(pl.pool_id for pl in
                            rados.monc.osdmap.pools.values()
                            if pl.name == "data")
-            for cid in osd2.store.list_collections():
-                if cid.pool != pool_id or cid.shard < -1:
-                    continue
-                for oid in osd2.store.list_objects(cid):
-                    assert object_to_ps(oid.name, 8) == cid.pg, \
-                        (cid, oid.name)
+            # map processing + split are asynchronous to boot: poll
+            # instead of a fixed sleep (a loaded host lags arbitrarily)
+            deadline = asyncio.get_running_loop().time() + 40
+            while True:
+                try:
+                    checked = 0
+                    for cid in osd2.store.list_collections():
+                        if cid.pool != pool_id or cid.shard < -1:
+                            continue
+                        for oid in osd2.store.list_objects(cid):
+                            assert object_to_ps(oid.name, 8) == cid.pg, \
+                                (cid, oid.name)
+                            checked += 1
+                    assert checked > 0
+                    break
+                except AssertionError:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
             # and the data serves
-            deadline = asyncio.get_running_loop().time() + 20
+            deadline = asyncio.get_running_loop().time() + 40
             while True:
                 try:
                     for key, val in model.items():

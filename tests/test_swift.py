@@ -104,11 +104,13 @@ def test_swift_auth_and_object_lifecycle():
             host, port, "HEAD", "/v1/AUTH_bob/photos/a/b.jpg", auth)
         assert st == 200 and body == b""
         assert rh["content-length"] == str(9 * 100)
-        # ranged read
-        st, _, body = await _req(
+        # ranged read: the frame advertises the RANGE length
+        st, rh, body = await _req(
             host, port, "GET", "/v1/AUTH_bob/photos/a/b.jpg",
             {**auth, "range": "bytes=0-3"})
         assert st == 206 and body == b"jpeg"
+        assert rh["content-length"] == "4"
+        assert rh["content-range"] == "bytes 0-3/900"
         # POST replaces metadata
         st, _, _ = await _req(
             host, port, "POST", "/v1/AUTH_bob/photos/a/b.jpg",
@@ -125,6 +127,24 @@ def test_swift_auth_and_object_lifecycle():
         objs = json.loads(body)
         assert [o["name"] for o in objs] == ["a/b.jpg"]
         assert objs[0]["bytes"] == 900
+        # marker/limit pagination walks large containers
+        for i in range(3):
+            await _req(host, port, "PUT",
+                       f"/v1/AUTH_bob/photos/p{i}", auth, b"x")
+        seen, marker = [], ""
+        while True:
+            st, rh, body = await _req(
+                host, port, "GET",
+                f"/v1/AUTH_bob/photos?limit=2&marker={marker}", auth)
+            page = json.loads(body)
+            seen += [o["name"] for o in page]
+            if rh.get("x-container-truncated") != "true":
+                break
+            marker = page[-1]["name"]
+        assert seen == ["a/b.jpg", "p0", "p1", "p2"]
+        for i in range(3):
+            await _req(host, port, "DELETE",
+                       f"/v1/AUTH_bob/photos/p{i}", auth)
 
         # delete chain
         st, _, _ = await _req(host, port, "DELETE",
