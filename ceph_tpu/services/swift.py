@@ -152,7 +152,9 @@ class SwiftFrontend:
         except RGWError:
             return 401, {}, b"bad credentials"
         if rec.get("suspended") or not hmac.compare_digest(
-                key, rec["secret_key"]):
+                key.encode(), rec["secret_key"].encode()):
+            # bytes compare: str compare_digest raises on non-ASCII
+            # header values, which must be a 401, not a dead socket
             return 401, {}, b"bad credentials"
         token = _mint_token(uid, rec["secret_key"])
         url = f"http://{self.host}:{self.port}/v1/AUTH_{uid}"
@@ -250,9 +252,15 @@ class SwiftFrontend:
             # resumes after a name, ?prefix= filters — clients page
             # through arbitrarily large containers
             try:
-                limit = min(int(query.get("limit", 10000)), 10000)
+                limit = max(0, min(int(query.get("limit", 10000)),
+                                   10000))
             except ValueError:
                 limit = 10000
+            if limit == 0:
+                # terminal empty page (never "truncated": a paging
+                # client could not advance its marker and would spin)
+                return 200, {"content-type": "application/json",
+                             "x-container-object-count": "0"}, b"[]"
             listing = await gw.list_objects(
                 name, prefix=query.get("prefix", ""),
                 marker=query.get("marker", ""), max_keys=limit)
@@ -307,9 +315,14 @@ class SwiftFrontend:
             got = await gw.get_object(container, obj, range_=rng)
             rh = _obj_headers(got)
             if rng is not None:
+                size = int(got.get("size", 0))
+                if rng[0] >= size:
+                    # unsatisfiable range: 416, never a 206 whose
+                    # Content-Range would read end < start
+                    return 416, {"content-range": f"bytes */{size}"}, \
+                        b""
                 # the entity is the RANGE: frame it correctly or a
                 # keep-alive peer blocks waiting for the full size
-                size = int(got.get("size", 0))
                 end = min(rng[1], size - 1)
                 rh["content-length"] = str(len(got["data"]))
                 rh["content-range"] = f"bytes {rng[0]}-{end}/{size}"

@@ -12,4 +12,5 @@ from ceph_tpu.store.types import CollectionId, GHObject  # noqa: F401
 from ceph_tpu.store.object_store import ObjectStore, Transaction  # noqa: F401
 from ceph_tpu.store.memstore import MemStore  # noqa: F401
 from ceph_tpu.store.walstore import WalStore  # noqa: F401
+from ceph_tpu.store.filestore import FileStore  # noqa: F401
 from ceph_tpu.store.txcodec import decode_tx, encode_tx  # noqa: F401

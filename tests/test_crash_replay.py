@@ -17,8 +17,21 @@ import struct
 import pytest
 
 from ceph_tpu.msg import reset_local_namespace
-from ceph_tpu.store import CollectionId, GHObject, Transaction, WalStore
+from ceph_tpu.store import (
+    CollectionId,
+    FileStore,
+    GHObject,
+    Transaction,
+    WalStore,
+)
 from ceph_tpu.store import native_wal
+
+
+def _make_store(path, kind: str):
+    if kind == "file":
+        return FileStore(str(path), wal_max=1 << 30, native=False)
+    native = kind == "native"
+    return WalStore(str(path), checkpoint_bytes=1 << 30, native=native)
 
 _FRAME = struct.Struct("<II")
 _WAL_MAGIC = b"ceph-tpu-wal-1\n"
@@ -64,15 +77,16 @@ def _op_sequence() -> list[Transaction]:
 
 
 def _state(store) -> dict:
-    """Full image fingerprint: every collection's objects with data,
-    attrs and omap."""
+    """Full image fingerprint via the public ObjectStore read API so
+    the sweep covers RAM-resident (WalStore) and disk-resident
+    (FileStore) tiers identically."""
     out = {}
-    with store._lock:
-        for cid, objs in store._colls.items():
-            out[repr(cid)] = {
-                key: (bytes(o.data), dict(o.attrs), dict(o.omap))
-                for key, o in objs.items()
-            }
+    for cid in store.list_collections():
+        out[repr(cid)] = {
+            o.key(): (store.read(cid, o), store.getattrs(cid, o),
+                      store.omap_get(cid, o))
+            for o in store.list_objects(cid)
+        }
     return out
 
 
@@ -80,11 +94,11 @@ def _run(coro):
     return asyncio.run(coro)
 
 
-def _build_wal(tmp_path, native: bool):
+def _build_wal(tmp_path, kind: str):
     """Commit the fixed sequence (no umount: everything stays in the
     WAL) and capture the oracle state after each prefix."""
     src = tmp_path / "src"
-    store = WalStore(str(src), checkpoint_bytes=1 << 30, native=native)
+    store = _make_store(src, kind)
 
     async def fill():
         await store.mount()
@@ -111,15 +125,39 @@ def _build_wal(tmp_path, native: bool):
     return src, raw, prefixes, frame_ends
 
 
-def _mount_at(tmp_path, src, raw: bytes, cut: int, native: bool,
-              case: str) -> dict:
-    """Copy the store dir, truncate the WAL at ``cut``, mount, return
-    the recovered state (and verify post-recovery appends work)."""
+def _prefix_tree(tmp_path, kind: str, n: int):
+    """A store directory whose FILESYSTEM state is the first ``n``
+    transactions, cleanly applied (no WAL residue)."""
+    dst = tmp_path / f"pfx{n}-{kind}"
+    if dst.exists():
+        return dst
+    store = _make_store(dst, kind)
+
+    async def fill():
+        await store.mount()
+        for t in _op_sequence()[:n]:
+            await store.queue_transactions(t)
+        await store.umount()
+
+    _run(fill())
+    (dst / "wal.log").unlink(missing_ok=True)
+    return dst
+
+
+def _mount_at(tmp_path, src, raw: bytes, cut: int, kind: str,
+              case: str, applied: int | None = None) -> dict:
+    """Build the crash image — WAL truncated at ``cut`` over a
+    filesystem/image reflecting ``applied`` cleanly-applied frames
+    (None = the WAL-image stores, whose state IS the WAL) — mount, and
+    return the recovered state (post-recovery appends verified too)."""
     reset_local_namespace()
-    dst = tmp_path / f"cut{cut}-{int(native)}"
-    shutil.copytree(src, dst)
+    dst = tmp_path / f"cut{cut}-{kind}"
+    if applied is None:
+        shutil.copytree(src, dst)
+    else:
+        shutil.copytree(_prefix_tree(tmp_path, kind, applied), dst)
     (dst / "wal.log").write_bytes(raw[:cut])
-    store = WalStore(str(dst), checkpoint_bytes=1 << 30, native=native)
+    store = _make_store(dst, kind)
 
     async def check():
         await store.mount()
@@ -136,7 +174,7 @@ def _mount_at(tmp_path, src, raw: bytes, cut: int, native: bool,
             store._nwal.close(); store._nwal = None
         if store._wal_file is not None:
             store._wal_file.close(); store._wal_file = None
-        s2 = WalStore(str(dst), checkpoint_bytes=1 << 30, native=native)
+        s2 = _make_store(dst, kind)
         await s2.mount()
         st2 = _state(s2)
         await s2.umount()
@@ -156,53 +194,59 @@ def _expected_prefix(frame_ends, prefixes, cut: int) -> dict:
     return prefixes[n]
 
 
-@pytest.mark.parametrize("native", [False, True])
-def test_crash_replay_every_tail_byte(tmp_path, native):
+@pytest.mark.parametrize("kind", ["python", "native", "file"])
+def test_crash_replay_every_tail_byte(tmp_path, kind):
     """Truncate at EVERY byte boundary of the last two frames plus every
     frame boundary in the log: recovered state must equal the committed
     prefix at each point."""
-    if native and not native_wal.available():
+    if kind == "native" and not native_wal.available():
         pytest.skip("native wal engine not built")
-    src, raw, prefixes, frame_ends = _build_wal(tmp_path, native)
+    src, raw, prefixes, frame_ends = _build_wal(tmp_path, kind)
 
     cuts = set(frame_ends)                      # clean frame boundaries
     cuts.add(len(_WAL_MAGIC))                   # empty log
     start = frame_ends[-3] if len(frame_ends) >= 3 else len(_WAL_MAGIC)
     cuts.update(range(start, len(raw) + 1))     # every tail byte
     for cut in sorted(cuts):
-        got = _mount_at(tmp_path, src, raw, cut, native, f"cut={cut}")
+        applied = None
+        if kind == "file":
+            # the filesystem lags the WAL by one committed txn: replay
+            # must roll the lagging frame forward, ignore the torn tail
+            applied = max(0, sum(1 for e in frame_ends if e <= cut) - 1)
+        got = _mount_at(tmp_path, src, raw, cut, kind, f"cut={cut}",
+                        applied=applied)
         want = _expected_prefix(frame_ends, prefixes, cut)
         assert got == want, f"cut={cut}: state diverged from prefix"
 
 
-@pytest.mark.parametrize("native", [False, True])
-def test_crash_between_append_and_apply(tmp_path, native):
+@pytest.mark.parametrize("kind", ["python", "native"])
+def test_crash_between_append_and_apply(tmp_path, kind):
     """A frame fully appended but the process killed before ack (the
     append-then-apply window): on remount the transaction IS recovered —
     the WAL write is the commit point, exactly one outcome per frame."""
-    if native and not native_wal.available():
+    if kind == "native" and not native_wal.available():
         pytest.skip("native wal engine not built")
-    src, raw, prefixes, frame_ends = _build_wal(tmp_path, native)
+    src, raw, prefixes, frame_ends = _build_wal(tmp_path, kind)
     for i, end in enumerate(frame_ends):
         if i % 3:
             continue                            # sample every 3rd frame
-        got = _mount_at(tmp_path, src, raw, end, native, f"frame={i}")
+        got = _mount_at(tmp_path, src, raw, end, kind, f"frame={i}")
         assert got == prefixes[i + 1], \
             f"frame {i}: fully-appended txn not recovered"
 
 
-@pytest.mark.parametrize("native", [False, True])
-def test_crash_replay_corrupt_interior_bit(tmp_path, native):
+@pytest.mark.parametrize("kind", ["python", "native"])
+def test_crash_replay_corrupt_interior_bit(tmp_path, kind):
     """A flipped bit INSIDE an interior frame ends replay at the longest
     valid prefix before it (crc discipline), never applies garbage."""
-    if native and not native_wal.available():
+    if kind == "native" and not native_wal.available():
         pytest.skip("native wal engine not built")
-    src, raw, prefixes, frame_ends = _build_wal(tmp_path, native)
+    src, raw, prefixes, frame_ends = _build_wal(tmp_path, kind)
     victim = 4                                   # corrupt frame 5's body
     pos = frame_ends[victim] + _FRAME.size + 2
     mutated = bytearray(raw)
     mutated[pos] ^= 0x40
-    got = _mount_at(tmp_path, src, bytes(mutated), len(raw), native,
+    got = _mount_at(tmp_path, src, bytes(mutated), len(raw), kind,
                     "bitflip")
     assert got == prefixes[victim + 1], \
         "corrupt interior frame did not stop replay at the valid prefix"

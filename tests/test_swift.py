@@ -189,3 +189,37 @@ def test_swift_s3_interop_and_isolation():
         await fe.stop()
         await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_swift_edge_cases():
+    """Review regressions: non-ASCII auth key -> 401; limit=0 is a
+    terminal empty page; out-of-range Range -> 416; negative limit
+    does not bypass the page cap."""
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = await _swift()
+        st, _, _ = await _req(host, port, "GET", "/auth/v1.0",
+                              {"x-auth-user": "bob",
+                               "x-auth-key": "café"})
+        assert st == 401
+        st, rh, _ = await _req(host, port, "GET", "/auth/v1.0",
+                               {"x-auth-user": "bob",
+                                "x-auth-key": bob["secret_key"]})
+        auth = {"x-auth-token": rh["x-auth-token"]}
+        await _req(host, port, "PUT", "/v1/AUTH_bob/c", auth)
+        await _req(host, port, "PUT", "/v1/AUTH_bob/c/o", auth,
+                   b"x" * 100)
+        st, rh, body = await _req(host, port, "GET",
+                                  "/v1/AUTH_bob/c?limit=0", auth)
+        assert st == 200 and body == b"[]"
+        assert "x-container-truncated" not in rh
+        st, _, body = await _req(host, port, "GET",
+                                 "/v1/AUTH_bob/c?limit=-5", auth)
+        assert st == 200 and body == b"[]"
+        st, rh, body = await _req(
+            host, port, "GET", "/v1/AUTH_bob/c/o",
+            {**auth, "range": "bytes=100-200"})
+        assert st == 416
+        assert rh["content-range"] == "bytes */100"
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
