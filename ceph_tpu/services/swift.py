@@ -309,6 +309,10 @@ class SwiftFrontend:
                 a, _, b = rh[6:].partition("-")
                 if a:
                     rng = (int(a), int(b) if b else (1 << 62))
+                    if rng[1] < rng[0]:
+                        # RFC 9110: a syntactically inverted range is
+                        # INVALID — ignore it and serve the full body
+                        rng = None
             if method == "HEAD":
                 entry = await gw.head_object(container, obj)
                 return 200, _obj_headers(entry), b""

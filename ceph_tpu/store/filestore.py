@@ -80,6 +80,7 @@ class FileStore(ObjectStore):
         import threading
 
         self._lock = threading.Lock()
+        self._epoch = 0             # WAL turnover count (stamp prefix)
         self.commit_delay = 0.0
         self.fail_next: Exception | None = None
 
@@ -101,6 +102,7 @@ class FileStore(ObjectStore):
     async def mount(self) -> None:
         self.path.mkdir(parents=True, exist_ok=True)
         self.coll_root.mkdir(exist_ok=True)
+        self._epoch = self._get_applied()[0]
         self._replay_wal()
         self._open_wal()
         self._reset_wal()           # replayed == applied: start clean
@@ -135,20 +137,41 @@ class FileStore(ObjectStore):
             self._wal_file.flush()
             if self.sync:
                 os.fsync(self._wal_file.fileno())
+        self._epoch += 1
         self._set_applied(len(_WAL_MAGIC))
 
     def _set_applied(self, offset: int) -> None:
         """Advance the committed-position marker (FileJournal
-        committed_seq): frames at or below it never replay."""
+        committed_seq): frames at or below it never replay.  The file
+        holds "epoch offset"; the epoch bumps on every WAL turnover so
+        frame STAMPS (epoch << 48 | offset) stay monotonic across
+        resets."""
         tmp = self.applied_path.with_suffix(".applied.tmp")
-        tmp.write_bytes(str(int(offset)).encode())
+        with open(tmp, "wb") as f:
+            f.write(f"{self._epoch} {int(offset)}".encode())
+            if self.sync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, self.applied_path)
+        if self.sync:
+            # a regressed marker after power loss would re-replay
+            # already-applied frames (the corruption the marker
+            # prevents): make the rename itself durable
+            dfd = os.open(self.path, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
 
-    def _get_applied(self) -> int:
+    def _get_applied(self) -> tuple[int, int]:
         try:
-            return int(self.applied_path.read_bytes())
+            epoch_s, off_s = self.applied_path.read_bytes().split()
+            return int(epoch_s), int(off_s)
         except (FileNotFoundError, ValueError):
-            return len(_WAL_MAGIC)
+            return 0, len(_WAL_MAGIC)
+
+    def _stamp(self, offset: int) -> int:
+        return (self._epoch << 48) | offset
 
     # -- commit ------------------------------------------------------------
     async def _commit(self, txns: list[Transaction]) -> None:
@@ -163,7 +186,8 @@ class FileStore(ObjectStore):
         async with self._commit_lock:
             self._validate(txns)
             size = await asyncio.to_thread(self._append, payload)
-            await asyncio.to_thread(self._apply_txns, txns)
+            await asyncio.to_thread(self._apply_txns, txns,
+                                    self._stamp(size))
             self._set_applied(size)
             if size >= self.wal_max:
                 # everything below is applied to the FS: safe turnover
@@ -179,11 +203,11 @@ class FileStore(ObjectStore):
             os.fsync(self._wal_file.fileno())
         return self._wal_file.tell()
 
-    def _apply_txns(self, txns) -> None:
+    def _apply_txns(self, txns, stamp: int) -> None:
         with self._lock:
             for t in txns:
                 for op in t.ops:
-                    self._apply(op)
+                    self._apply(op, stamp)
 
     def _validate(self, txns: list[Transaction]) -> None:
         """All-or-nothing dry run against the filesystem (the MemStore
@@ -221,10 +245,7 @@ class FileStore(ObjectStore):
             for op in t.ops:
                 name = op[0]
                 if name == "mkcoll":
-                    if not cstate.get(op[1], True):
-                        cstate[op[1]] = True    # recreate after rmcoll
-                    else:
-                        cstate.setdefault(op[1], True)
+                    cstate[op[1]] = True
                 elif name == "rmcoll":
                     d = self._coll_dir(op[1])
                     # empty = no sidecars beyond the batch's removals
@@ -264,13 +285,24 @@ class FileStore(ObjectStore):
             raw = self._mpath(cid, oid).read_bytes()
         except FileNotFoundError:
             raise KeyError(f"no object {oid} in {cid}") from None
-        _, attrs, omap = decode(raw)
-        return dict(attrs), dict(omap)
+        rec = decode(raw)
+        return dict(rec[1]), dict(rec[2])
 
-    def _write_meta(self, cid, oid, attrs: dict, omap: dict) -> None:
+    def _read_sidecar_stamp(self, cid, oid) -> int:
+        """The frame stamp that last CREATED this sidecar via a
+        state-reading op (clone/rename destination); 0 otherwise."""
+        try:
+            raw = self._mpath(cid, oid).read_bytes()
+        except FileNotFoundError:
+            return 0
+        rec = decode(raw)
+        return int(rec[3]) if len(rec) > 3 else 0
+
+    def _write_meta(self, cid, oid, attrs: dict, omap: dict,
+                    stamp: int = 0) -> None:
         p = self._mpath(cid, oid)
         tmp = p.with_suffix(".m.tmp")
-        blob = encode([enc_oid(oid), attrs, omap])
+        blob = encode([enc_oid(oid), attrs, omap, int(stamp)])
         with open(tmp, "wb") as f:
             f.write(blob)
             if self.sync:
@@ -307,7 +339,7 @@ class FileStore(ObjectStore):
                 os.fsync(f.fileno())
 
     # -- mutation application (idempotent for WAL replay) ------------------
-    def _apply(self, op: tuple) -> None:
+    def _apply(self, op: tuple, stamp: int = 0) -> None:
         name = op[0]
         if name == "mkcoll":
             self._coll_dir(op[1]).mkdir(parents=True, exist_ok=True)
@@ -369,6 +401,11 @@ class FileStore(ObjectStore):
             self._write_meta(cid, oid, attrs, omap)
         elif name == "clone":
             _, cid, src, dst = op
+            if stamp and self._read_sidecar_stamp(cid, dst) >= stamp:
+                # replay of a frame whose clone ALREADY landed: a
+                # re-copy would read the source's post-frame state (a
+                # later write in the same frame) into the clone
+                return
             try:
                 attrs, omap = self._read_meta(cid, src)
             except KeyError:
@@ -377,9 +414,11 @@ class FileStore(ObjectStore):
 
             shutil.copyfile(self._dpath(cid, src),
                             self._dpath(cid, dst))
-            self._write_meta(cid, dst, attrs, omap)
+            self._write_meta(cid, dst, attrs, omap, stamp=stamp)
         elif name == "rename":
             _, cid, src, dst = op
+            if stamp and self._read_sidecar_stamp(cid, dst) >= stamp:
+                return              # replay: this rename already landed
             if not self._mpath(cid, src).exists():
                 return              # replay: already moved
             # crash-idempotent ordering: destination sidecar first (the
@@ -387,7 +426,7 @@ class FileStore(ObjectStore):
             # data file, then retire the source name — a replay resumed
             # from ANY point re-runs the remaining steps safely
             attrs, omap = self._read_meta(cid, src)
-            self._write_meta(cid, dst, attrs, omap)
+            self._write_meta(cid, dst, attrs, omap, stamp=stamp)
             if self._dpath(cid, src).exists():
                 os.replace(self._dpath(cid, src), self._dpath(cid, dst))
             elif not self._dpath(cid, dst).exists():
@@ -404,7 +443,7 @@ class FileStore(ObjectStore):
             payloads = native_wal.replay(str(self.wal_path))
         else:
             payloads = self._python_replay()
-        applied = self._get_applied()
+        _, applied = self._get_applied()
         pos = len(_WAL_MAGIC)
         for payload in payloads:
             pos += _FRAME.size + len(payload)
@@ -414,10 +453,11 @@ class FileStore(ObjectStore):
                 txns = [decode_tx(w) for w in decode(payload)]
             except (ValueError, TypeError, KeyError, struct.error):
                 break               # undecodable record ends the log
+            stamp = self._stamp(pos)
             for t in txns:
                 for op in t.ops:
                     try:
-                        self._apply(op)
+                        self._apply(op, stamp)
                     except (KeyError, ValueError, OSError):
                         pass        # tolerated like WalStore replay
 
@@ -481,8 +521,7 @@ class FileStore(ObjectStore):
         with self._lock:
             out = []
             for p in self._require_dir(cid).glob("*.m"):
-                enc_o, _, _ = decode(p.read_bytes())
-                out.append(dec_oid(enc_o))
+                out.append(dec_oid(decode(p.read_bytes())[0]))
             return sorted(out, key=lambda o: o.key())
 
     def list_collections(self) -> list[CollectionId]:

@@ -16,7 +16,7 @@ from ceph_tpu.client.rados import Rados
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.mon.monitor import Monitor
 from ceph_tpu.osd.daemon import OSDDaemon
-from ceph_tpu.store import MemStore, ObjectStore, WalStore
+from ceph_tpu.store import FileStore, MemStore, ObjectStore, WalStore
 
 FAST_TEST_OVERRIDES = {
     "mon_lease": 0.4, "mon_lease_interval": 0.1,
@@ -32,6 +32,7 @@ class DevCluster:
     def __init__(self, n_mons: int = 1, n_osds: int = 3,
                  overrides: dict | None = None, tcp: bool = False,
                  base_port: int = 21000, store_dir: str | None = None,
+                 store_kind: str = "wal",
                  cephx: bool = False, ns: str = ""):
         """``ns``: local:// address namespace prefix so several
         DevClusters (zones) can coexist in one process (the multi-zone
@@ -49,6 +50,7 @@ class DevCluster:
         self.tcp = tcp
         self.base_port = base_port
         self.store_dir = store_dir
+        self.store_kind = store_kind
         mon_names = [chr(ord("a") + i) for i in range(n_mons)]
         if tcp:
             self.monmap = {
@@ -108,11 +110,16 @@ class DevCluster:
             await self.start_osd(i)
 
     def _make_osd_store(self, osd_id: int) -> ObjectStore:
-        """With a store_dir, OSD data is durable (WAL + checkpoint) and a
-        revived OSD serves its pre-kill objects from disk; without one it
-        is RAM-only (the MemStore dev default)."""
+        """With a store_dir, OSD data is durable and a revived OSD
+        serves its pre-kill objects from disk; without one it is
+        RAM-only (the MemStore dev default).  ``store_kind`` picks the
+        durable tier: "wal" (RAM image + WAL/checkpoints) or "file"
+        (fully disk-resident; capacity bounded by disk)."""
         if self.store_dir:
-            return WalStore(f"{self.store_dir}/osd.{osd_id}")
+            base = f"{self.store_dir}/osd.{osd_id}"
+            if self.store_kind == "file":
+                return FileStore(base)
+            return WalStore(base)
         return MemStore()
 
     async def start_osd(self, osd_id: int) -> OSDDaemon:

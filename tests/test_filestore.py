@@ -273,3 +273,57 @@ def test_filestore_rejects_op_on_removed_collection(tmp_path):
         assert s.list_collections() == [CID]
         await s.umount()
     asyncio.run(run())
+
+
+def test_devcluster_on_filestore_kill_revive(tmp_path):
+    """DevCluster(store_kind='file'): a killed OSD revives from its
+    on-disk files (no RAM image survives the kill)."""
+    from ceph_tpu.vstart import DevCluster
+
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3,
+                             store_dir=str(tmp_path),
+                             store_kind="file")
+        await cluster.start()
+        rados = await cluster.client()
+        await rados.pool_create("fk", pg_num=4, size=3, min_size=2)
+        io = await rados.open_ioctx("fk")
+        await io.write_full("persist", b"revive-me" * 50)
+        await cluster.kill_osd(1)
+        await cluster.revive_osd(1)
+        assert isinstance(cluster.osds[1].store, FileStore)
+        assert await io.read("persist") == b"revive-me" * 50
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
+
+
+def test_filestore_clone_frame_marker_lag(tmp_path):
+    """Review regression: a [clone(head->snap), write(head)] frame that
+    fully applied but crashed BEFORE the marker advanced must not, on
+    replay, re-copy the post-write head into the snapshot clone."""
+    async def run():
+        head = GHObject(1, "head", shard=0)
+        snap = GHObject(1, "snap", shard=0)
+        s = await _new(tmp_path)
+        await s.queue_transactions(
+            Transaction().create_collection(CID)
+            .write(CID, head, 0, b"OLD-DATA"))
+        marker = s.applied_path.read_bytes()
+        # the snapshot-COW frame: clone then overwrite, one transaction
+        await s.queue_transactions(
+            Transaction().clone(CID, head, snap)
+            .write(CID, head, 0, b"NEW-DATA"))
+        # crash window: frame applied, marker never advanced
+        s.applied_path.write_bytes(marker)
+        if s._nwal is not None:
+            s._nwal.close(); s._nwal = None
+        if s._wal_file is not None:
+            s._wal_file.close(); s._wal_file = None
+
+        s2 = await _new(tmp_path)
+        assert s2.read(CID, head) == b"NEW-DATA"
+        assert s2.read(CID, snap) == b"OLD-DATA", \
+            "replay re-cloned post-write head into the snapshot"
+        await s2.umount()
+    asyncio.run(run())
