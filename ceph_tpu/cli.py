@@ -190,9 +190,17 @@ async def _dispatch(args, rados: Rados) -> int:
         if args.action == "rm":
             return await _mon(rados, "fs rm", j, fs_name=args.fs_name,
                               force=args.force)
+        if args.action == "set_max_mds":
+            return await _mon(rados, "fs set_max_mds", j,
+                              fs_name=args.fs_name,
+                              max_mds=args.max_mds)
         return await _mon(rados, "fs ls", j)
     if cmd == "mds":
         return await _mon(rados, "mds stat", j)
+    if cmd == "device":
+        return await _mon(rados, "device ls", j)
+    if cmd == "telemetry":
+        return await _mon(rados, "telemetry show", j)
     if cmd == "quorum_status":
         return await _mon(rados, "quorum_status", j)
     if cmd == "mon":                      # mon dump
@@ -531,8 +539,15 @@ def build_parser() -> argparse.ArgumentParser:
     fr = fs_sub.add_parser("rm")
     fr.add_argument("fs_name")
     fr.add_argument("--force", action="store_true")
+    fm = fs_sub.add_parser("set_max_mds")
+    fm.add_argument("fs_name")
+    fm.add_argument("max_mds", type=int)
     mds = sub.add_parser("mds")
     mds.add_argument("action", choices=["stat"])
+    dev = sub.add_parser("device")
+    dev.add_argument("action", choices=["ls"])
+    tel = sub.add_parser("telemetry")
+    tel.add_argument("action", choices=["show"])
     logp = sub.add_parser("log")
     log_sub = logp.add_subparsers(dest="action", required=True)
     ll = log_sub.add_parser("last")

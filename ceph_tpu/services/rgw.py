@@ -15,6 +15,7 @@ from __future__ import annotations
 import hashlib
 import json
 import time
+import zlib
 
 from ceph_tpu.client.rados import IoCtx, ObjectOperation, RadosError
 from ceph_tpu.client.striper import RadosStriper, StripeLayout
@@ -518,6 +519,25 @@ class RGWLite:
     def _vkey(key: str, version_id: str) -> str:
         return f"{key}\x00{version_id}"
 
+    async def put_bucket_compression(self, bucket: str,
+                                     alg: str | None = "zlib") -> None:
+        """Per-bucket at-rest compression (rgw_compression.cc role):
+        buffered object PUTs store zlib-deflated bytes when it actually
+        shrinks them; S3-visible size/etag stay the ORIGINAL object's.
+        ``None`` disables (existing objects stay as stored)."""
+        if alg not in (None, "zlib"):
+            raise RGWError("InvalidArgument", f"unknown algorithm {alg}")
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        if alg is None:
+            meta.pop("compression", None)
+        else:
+            meta["compression"] = alg
+        await self._put_bucket_meta(bucket, meta)
+
+    async def get_bucket_compression(self, bucket: str) -> str | None:
+        meta = await self._check_bucket(bucket, "READ")
+        return meta.get("compression")
+
     async def put_bucket_versioning(self, bucket: str,
                                     enabled: bool) -> None:
         meta = await self._check_bucket(bucket, "FULL_CONTROL")
@@ -716,14 +736,9 @@ class RGWLite:
         await self._check_bucket(bucket, "READ")
         entry = await self._lookup_version_entry(bucket, key,
                                                  version_id)
-        oid = entry.get("data_oid", self._data_oid(bucket, key))
-        if entry.get("multipart"):
-            data = await self._read_manifest(entry["multipart"],
-                                             entry["size"], None)
-        elif entry.get("striped"):
-            data = await self.striper.read(oid)
-        else:
-            data = await self.ioctx.read(oid)
+        data = await self._read_entry_data(bucket, key, entry, None)
+        if entry.get("comp"):
+            data = zlib.decompress(data)
         return {"data": data, **entry}
 
     async def head_object_version(self, bucket: str, key: str,
@@ -1342,7 +1357,8 @@ class RGWLite:
         return {"bucket": bucket, "key": key, "oid": oid,
                 "index_oid": index_oid, "versioned": versioned,
                 "suspended": suspended, "version_id": version_id,
-                "deferred_cleanup": deferred}
+                "deferred_cleanup": deferred,
+                "compression": meta.get("compression")}
 
     async def begin_put(self, bucket: str, key: str, length: int,
                         content_type: str = "binary/octet-stream",
@@ -1369,6 +1385,14 @@ class RGWLite:
                                       if_none_match)
         etag = hashlib.md5(data).hexdigest()
         size = len(data)
+        comp = None
+        if ctx.get("compression") == "zlib" and sse_key is None:
+            # compress-at-rest (rgw_compression.cc): only kept when it
+            # actually shrinks; S3-visible size/etag stay the original
+            packed = zlib.compress(data, 6)
+            if len(packed) < len(data):
+                data = packed
+                comp = {"alg": "zlib", "stored_size": len(packed)}
         sse = None
         if sse_key is not None:
             sse = sse_begin(sse_key)
@@ -1383,11 +1407,13 @@ class RGWLite:
             await self.ioctx.operate(oid, op)
         return await self._finish_put(ctx, size, etag, striped,
                                       content_type,
-                                      dict(metadata or {}), sse)
+                                      dict(metadata or {}), sse,
+                                      comp=comp)
 
     async def _finish_put(self, ctx: dict, size: int, etag: str,
                           striped: bool, content_type: str,
-                          metadata: dict, sse: dict | None) -> dict:
+                          metadata: dict, sse: dict | None,
+                          comp: dict | None = None) -> dict:
         """Publish the index entry once the data is down (shared by
         buffered and streaming PUTs)."""
         bucket, key = ctx["bucket"], ctx["key"]
@@ -1401,6 +1427,8 @@ class RGWLite:
         }
         if sse is not None:
             entry["sse"] = sse
+        if comp is not None:
+            entry["comp"] = comp
         if versioned:
             entry["version_id"] = version_id
             await self._record_version(bucket, key, entry)
@@ -1434,6 +1462,15 @@ class RGWLite:
         ``sse_key``: the SSE-C customer key for encrypted objects."""
         entry = await self._entry(bucket, key)
         sse_check(entry, sse_key)
+        if entry.get("comp"):
+            # compressed at rest: ranges slice the INFLATED bytes
+            raw = await self._read_entry_data(bucket, key, entry, None)
+            data = zlib.decompress(raw)
+            if range_ is not None:
+                start, end = range_
+                end = min(end, entry["size"] - 1)
+                data = data[start:end + 1]
+            return {"data": data, **entry}
         data = await self._read_entry_data(bucket, key, entry, range_)
         if sse_key is not None:
             start = range_[0] if range_ is not None else 0
@@ -1472,6 +1509,22 @@ class RGWLite:
         if entry is None:
             entry = await self._entry(bucket, key)
         sse_check(entry, sse_key)
+        if entry.get("comp"):
+            # at-rest compression has no random access (-lite trades
+            # the reference's block map for whole-object inflate); read
+            # through the GIVEN entry so the headers the caller already
+            # built and the body can never describe different objects
+            raw = await self._read_entry_data(bucket, key, entry, None)
+            data = zlib.decompress(raw)
+            if range_ is not None:
+                start, end = range_
+                end = min(end, int(entry["size"]) - 1)
+                data = data[start:end + 1]
+
+            async def one():
+                yield data
+
+            return entry, one()
         size = int(entry["size"])
         start, end = (0, size - 1) if range_ is None else range_
         end = min(end, size - 1)
