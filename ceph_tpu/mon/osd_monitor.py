@@ -14,6 +14,7 @@ import time
 from ceph_tpu.common.log import Dout
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
 from ceph_tpu.mon.service import (
+    EBUSY_RC,
     EEXIST_RC,
     EINVAL_RC,
     ENOENT_RC,
@@ -447,24 +448,56 @@ class OSDMonitor(PaxosService):
             if n == updated.pg_num:
                 # no-op: do not stage an epoch for an unchanged value
                 return CommandResult(outs=f"pg_num is already {n}")
+            if n < 1:
+                return CommandResult(EINVAL_RC, "pg_num must be >= 1")
             if n < updated.pg_num:
-                return CommandResult(
-                    EINVAL_RC, "pg_num may only increase (PG merging "
-                    "is not supported)")
-            if not updated.pgp_num:
-                # legacy pool in pgp-follows-pg mode: pin placement to
-                # the OLD pg_num or children would move in the same
-                # epoch the split runs (no backfill source)
-                updated.pgp_num = updated.pg_num
-            updated.pg_num = n
+                # MERGE: only once placement already folded the merge
+                # sources onto their targets (pgp_num == n) — the
+                # ready-to-merge precondition; every OSD then holds
+                # source and target colocated and the fold is local
+                cur_pgp = updated.pgp_num or updated.pg_num
+                committed = self.osdmap.pools.get(updated.pool_id)
+                committed_pgp = (committed.pgp_num or committed.pg_num
+                                 if committed else 0)
+                if cur_pgp != n or committed_pgp != n:
+                    # the COMMITTED map must carry the pgp step too, or
+                    # back-to-back set commands would compose into one
+                    # epoch and merge before any migration even starts
+                    return CommandResult(
+                        EINVAL_RC,
+                        f"merging requires pgp_num {n} first "
+                        f"(committed {committed_pgp}): decrease "
+                        "pgp_num, wait for the migration to settle, "
+                        "then shrink pg_num")
+                blocked = self._merge_unsettled(updated.pool_id)
+                if blocked:
+                    return CommandResult(
+                        EBUSY_RC, f"not ready to merge: {blocked}; "
+                        "wait for the migration to settle and retry")
+                # merged-away PGs must not leave ghost upmap entries
+                # that would re-apply on a future re-split (pg_temp
+                # for the pool is already empty: _merge_unsettled
+                # blocks while any exists)
+                pend = self._pending()
+                for (pid, ps) in list(self.osdmap.pg_upmap_items):
+                    if pid == updated.pool_id and ps >= n:
+                        pend.new_pg_upmap_items[(pid, ps)] = []
+                updated.pg_num = n
+                updated.pgp_num = n
+            else:
+                if not updated.pgp_num:
+                    # legacy pool in pgp-follows-pg mode: pin placement
+                    # to the OLD pg_num or children would move in the
+                    # same epoch the split runs (no backfill source)
+                    updated.pgp_num = updated.pg_num
+                updated.pg_num = n
         elif var == "pgp_num":
             n = int(val)
             cur_pgp = updated.pgp_num or updated.pg_num
             if n == cur_pgp:
                 return CommandResult(outs=f"pgp_num is already {n}")
-            if n < cur_pgp:
-                return CommandResult(EINVAL_RC,
-                                     "pgp_num may only increase")
+            if n < 1:
+                return CommandResult(EINVAL_RC, "pgp_num must be >= 1")
             if n > updated.pg_num:
                 return CommandResult(
                     EINVAL_RC, f"pgp_num {n} > pg_num "
@@ -750,6 +783,26 @@ class OSDMonitor(PaxosService):
                 if osd not in pending.new_down:
                     pending.new_down.append(osd)
         return CommandResult(outs=f"{name} {ids}")
+
+    def _merge_unsettled(self, pool_id: int) -> str | None:
+        """The mon-visible ready-to-merge signals (the reference gates
+        per-PG ready_to_merge reports; -lite uses what the mon holds):
+        in-flight placement overrides mean the fold migration has not
+        settled, and a PGMap digest (when an mgr runs) showing
+        degradation means replicas are not yet identical."""
+        if any(pid == pool_id for (pid, _ps) in self.osdmap.pg_temp):
+            return "pg_temp overrides still active for this pool"
+        digest = getattr(self.mon.mgr_stat, "digest", None) or {}
+        pools = digest.get("pools") or {}
+        pool_stats = pools.get(pool_id) or pools.get(str(pool_id))
+        if pool_stats and int(pool_stats.get("degraded", 0)) > 0:
+            return "pool has degraded objects"
+        for state, count in (digest.get("pgs_by_state") or {}).items():
+            if count and any(tok in state for tok in
+                             ("peering", "recovering", "backfill",
+                              "degraded", "down", "incomplete")):
+                return f"cluster has {count} pgs {state}"
+        return None
 
     def _cmd_device_class(self, name: str, cmd: dict) -> CommandResult:
         """``osd crush set-device-class <class> <ids>`` /

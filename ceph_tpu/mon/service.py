@@ -12,6 +12,7 @@ from __future__ import annotations
 from ceph_tpu.mon.store import StoreTransaction
 
 OK = 0
+EBUSY_RC = -16
 EEXIST_RC = -17
 EINVAL_RC = -22
 ENOENT_RC = -2

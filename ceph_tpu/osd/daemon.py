@@ -204,6 +204,9 @@ class OSDDaemon:
         self._hb_first_tx: dict[int, float] = {}
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
+        # merge deferral retry (one in flight; _scan_pgs serialized)
+        self._merge_retry_pending = False
+        self._scan_lock = asyncio.Lock()
         self._booted = False
         self._reboot_epoch = 0
         self._map_lock = DLock("osd-map")
@@ -733,7 +736,11 @@ class OSDDaemon:
         changed = False
         for pool in m.pools.values():
             old_n = self._pool_pg_num.get(pool.pool_id, pool.pg_num)
-            if self._pool_pg_num.get(pool.pool_id) != pool.pg_num:
+            if self._pool_pg_num.get(pool.pool_id, 0) < pool.pg_num:
+                # only ADOPT growth (and first sight): a decrease is
+                # the merge edge and _merge_pgs records it only after
+                # the fold actually ran — otherwise a deferred merge
+                # would lose its trigger forever
                 self._pool_pg_num[pool.pool_id] = pool.pg_num
                 changed = True
             if pool.pg_num <= old_n:
@@ -753,6 +760,147 @@ class OSDDaemon:
         if changed:
             await self._save_superblock()
 
+    async def _merge_pgs(self) -> None:
+        """PG merging (the reference's PG merge machinery at -lite
+        scale): when a pool's pg_num SHRINKS, every locally held child
+        collection (ps >= new pg_num) folds into its stable-mod parent.
+        The monitor only permits the decrease after pgp_num already
+        equals the target, so source and target PGs are COLOCATED on
+        the same OSDs (the reference's ready-to-merge precondition) and
+        the fold is purely local and deterministic across replicas:
+        objects + snap-mapper keys move to the parent, the child's log
+        is dropped (all replicas hold identical clean copies, so the
+        parents' logs alone stay consistent; client replay dedup for
+        the child's recent ops is the documented -lite cost), and the
+        child collections disappear."""
+        if not self._superblock_loaded:
+            self._load_superblock()
+        m = self.osdmap
+        for pool in m.pools.values():
+            old_n = self._pool_pg_num.get(pool.pool_id, pool.pg_num)
+            new_n = pool.pg_num
+            if new_n >= old_n:
+                continue            # superblock edge: set only by us
+            if not self._merge_safe_locally(pool.pool_id, new_n):
+                # a local PG in the fold set is still peering/
+                # recovering (the mon gate is map-level; this is the
+                # per-OSD belt and braces): defer and retry — the
+                # superblock keeps the edge alive across deferrals
+                self._schedule_merge_retry()
+                continue
+            for cid in list(self.store.list_collections()):
+                if cid.pool != pool.pool_id or cid.pg < new_n:
+                    continue
+                parent_ps = split_parent(cid.pg, new_n)
+                if cid.shard == pg_log.META_SHARD:
+                    await self._merge_meta(cid, parent_ps)
+                else:
+                    await self._merge_collection(cid, parent_ps)
+                self.pgs.pop(PGId(pool.pool_id, cid.pg), None)
+                log.dout(1, "%s: merged %s.%x -> %x", self.entity,
+                         cid.pool, cid.pg, parent_ps)
+            self._pool_pg_num[pool.pool_id] = new_n
+            await self._save_superblock()
+            # one more pass shortly: a peer still behind this epoch
+            # could have recreated a child while we folded
+            self._schedule_merge_retry()
+
+    _MERGE_OK_STATES = ("active", "active+clean", "stray", "initial",
+                        "replica")
+
+    def _merge_safe_locally(self, pool_id: int, new_n: int) -> bool:
+        """True when every local PG in the FOLD SET (the merging
+        children and the parents receiving them) is in a quiescent
+        state; unrelated PGs of the pool don't block the fold."""
+        relevant = set()
+        for pgid in self.pgs:
+            if pgid.pool == pool_id and pgid.ps >= new_n:
+                relevant.add(pgid.ps)
+                relevant.add(split_parent(pgid.ps, new_n))
+        for pgid, pg in self.pgs.items():
+            if pgid.pool != pool_id or pgid.ps not in relevant:
+                continue
+            if pg.state not in self._MERGE_OK_STATES:
+                return False
+        return True
+
+    def _schedule_merge_retry(self) -> None:
+        if self._merge_retry_pending:
+            return
+        self._merge_retry_pending = True
+
+        async def _retry():
+            await asyncio.sleep(0.5)
+            self._merge_retry_pending = False
+            if not self._stopped:
+                try:
+                    await self._scan_pgs()
+                except Exception as e:      # noqa: BLE001
+                    log.derr("%s: deferred merge rescan failed: %r",
+                             self.entity, e)
+
+        # tracked so shutdown cancels a pending retry cleanly
+        self._tasks.append(
+            asyncio.get_running_loop().create_task(_retry()))
+
+    def _copy_object(self, tx: "StoreTx", src_cid, dst_cid, oid) -> None:
+        """Stage a full object copy (data + xattrs + omap) into ``tx``
+        — the shared move primitive of split and merge."""
+        data = self.store.read(src_cid, oid)
+        tx.touch(dst_cid, oid)
+        if data:
+            tx.write(dst_cid, oid, 0, data)
+        else:
+            tx.truncate(dst_cid, oid, 0)
+        for aname, aval in self.store.getattrs(src_cid, oid).items():
+            tx.setattr(dst_cid, oid, aname, aval)
+        omap = self.store.omap_get(src_cid, oid)
+        if omap:
+            tx.omap_setkeys(dst_cid, oid, omap)
+
+    async def _merge_collection(self, cid, parent_ps: int) -> None:
+        """Fold a child DATA collection into (pool, parent_ps, shard)."""
+        parent = CollectionId(cid.pool, parent_ps, cid.shard)
+        tx = StoreTx()
+        try:
+            self.store.list_objects(parent)
+        except KeyError:
+            tx.create_collection(parent)
+        for oid in list(self.store.list_objects(cid)):
+            self._copy_object(tx, cid, parent, oid)
+            tx.remove(cid, oid)
+        tx.remove_collection(cid)
+        await self.store.queue_transactions(tx)
+
+    async def _merge_meta(self, cid, parent_ps: int) -> None:
+        """Fold a child META collection: snap-mapper keys merge into
+        the parent's mapper, every OTHER meta object (hitset archives
+        etc.) moves across wholesale; only the child's pg_log is
+        dropped (see _merge_pgs)."""
+        pcid = pg_log.meta_cid(cid.pool, parent_ps)
+        tx = StoreTx()
+        try:
+            self.store.list_objects(pcid)
+        except KeyError:
+            tx.create_collection(pcid)
+        try:
+            mapper = self.store.omap_get(cid,
+                                         snaps.mapper_oid(cid.pool))
+        except KeyError:
+            mapper = {}
+        if mapper:
+            tx.touch(pcid, snaps.mapper_oid(cid.pool))
+            tx.omap_setkeys(pcid, snaps.mapper_oid(cid.pool), mapper)
+        skip = {pg_log.meta_oid(cid.pool).key(),
+                snaps.mapper_oid(cid.pool).key()}
+        for oid in list(self.store.list_objects(cid)):
+            if oid.key() not in skip \
+                    and not self.store.exists(pcid, oid):
+                self._copy_object(tx, cid, pcid, oid)
+            tx.remove(cid, oid)
+        tx.remove_collection(cid)
+        await self.store.queue_transactions(tx)
+
     async def _split_collection(self, cid, old_n: int,
                                 new_n: int) -> None:
         children: set = set()
@@ -768,17 +916,7 @@ class OSDDaemon:
                     self.store.list_objects(child)
                 except KeyError:
                     tx.create_collection(child)
-            data = self.store.read(cid, oid)
-            tx.touch(child, oid)
-            if data:
-                tx.write(child, oid, 0, data)
-            else:
-                tx.truncate(child, oid, 0)
-            for aname, aval in self.store.getattrs(cid, oid).items():
-                tx.setattr(child, oid, aname, aval)
-            omap = self.store.omap_get(cid, oid)
-            if omap:
-                tx.omap_setkeys(child, oid, omap)
+            self._copy_object(tx, cid, child, oid)
             tx.remove(cid, oid)
         if len(tx):
             await self.store.queue_transactions(tx)
@@ -873,7 +1011,13 @@ class OSDDaemon:
 
     async def _scan_pgs(self) -> None:
         """Recompute PG ownership from the current map (the load_pgs /
-        advance_pg flow)."""
+        advance_pg flow).  Serialized: a deferred-merge retry must not
+        interleave with a map-driven scan mid-fold."""
+        async with self._scan_lock:
+            await self._scan_pgs_locked()
+
+    async def _scan_pgs_locked(self) -> None:
+        await self._merge_pgs()     # before _split_pgs persists pg_num
         await self._split_pgs()
         self._resurrect_strays()
         m = self.osdmap
@@ -3646,6 +3790,15 @@ class OSDDaemon:
         cid = _dec_cid(d["cid"])
         pg = self.pgs.get(PGId(cid.pool, cid.pg))
         if pg is None:
+            # a write into a ps OUTSIDE our map's range from a sender
+            # who is NOT ahead of us is a behind-peer writing into a
+            # merged-away PG: applying it would resurrect a folded
+            # child collection (an ahead sender — iepoch > our map —
+            # is the split-forward case and stays allowed)
+            pool = self.osdmap.pools.get(cid.pool)
+            if pool is not None and cid.pg >= pool.pg_num \
+                    and int(d.get("iepoch", 0)) <= self.osdmap.epoch:
+                return True
             return False            # nothing known to protect yet
         return int(d.get("iepoch", 0)) < pg.epoch
 

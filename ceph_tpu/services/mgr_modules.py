@@ -140,10 +140,12 @@ class PGAutoscaler(MgrModule):
 
     name = "pg_autoscaler"
     target_per_osd = 100
+    MERGE_GRACE_S = 60.0        # operator merge window before catch-up
 
     def __init__(self, mgr):
         super().__init__(mgr)
         self._last_cmd: dict[tuple, int] = {}
+        self._pgp_lag_since: dict[str, float] = {}
 
     async def _apply(self, pool: str, var: str, val: int) -> None:
         if self._last_cmd.get((pool, var)) == int(val):
@@ -169,9 +171,22 @@ class PGAutoscaler(MgrModule):
                 continue
             pgp = pool.pgp_num or pool.pg_num
             if pgp < pool.pg_num:
-                # finish migrating the previous split first
-                await self._apply(pool.name, "pgp_num", pool.pg_num)
+                # pgp trailing pg_num is either our own split waiting
+                # for its migration step OR an operator's merge
+                # two-step in progress.  Finish our own immediately;
+                # anything else gets a grace window (the merge shrinks
+                # pg_num within it) before we assume an abandoned
+                # split and finish the migration — this also survives
+                # a mgr restart losing the in-memory intent.
+                ours = self._last_cmd.get(
+                    (pool.name, "pg_num")) == pool.pg_num
+                first = self._pgp_lag_since.setdefault(
+                    pool.name, time.time())
+                if ours or time.time() - first > self.MERGE_GRACE_S:
+                    await self._apply(pool.name, "pgp_num",
+                                      pool.pg_num)
                 continue
+            self._pgp_lag_since.pop(pool.name, None)
             rec = recs.get(pool.name)
             if rec and rec["kind"] == "few":
                 # bounded step: at most 4x per cycle keeps split +

@@ -651,3 +651,184 @@ def test_split_during_osd_failures():
             await cluster.stop()
 
     asyncio.run(run())
+
+
+# -- PG merging (pg_num decrease; the reference merge machinery) ---------
+
+def test_pg_merge_requires_pgp_first_then_folds():
+    """pg_num decrease is gated on pgp_num == target (ready-to-merge
+    colocation); the merge then folds child collections into their
+    stable-mod parents on every OSD with all data intact."""
+    async def run():
+        from ceph_tpu.osd.pg_log import META_SHARD
+
+        mon, osds, rados = await start_cluster()
+        try:
+            r = await rados.mon_command("osd pool create", pool="m",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("m")
+            model = {}
+            for i in range(40):
+                key = f"mobj-{i:03d}"
+                model[key] = f"v{i}".encode() * 20
+                await io.write_full(key, model[key])
+            await io.set_omap("mobj-000", {"k": b"v"})
+
+            # guard: merging without the pgp step is refused
+            r = await rados.mon_command("osd pool set", pool="m",
+                                        var="pg_num", val="4")
+            assert r["rc"] != 0 and "pgp_num" in r["outs"]
+
+            r = await rados.mon_command("osd pool set", pool="m",
+                                        var="pgp_num", val="4")
+            assert r["rc"] == 0, r
+            await _wait_clean(rados, "m")
+            r = await rados.mon_command("osd pool set", pool="m",
+                                        var="pg_num", val="4")
+            assert r["rc"] == 0, r
+
+            # every OSD folds: no collection with ps >= 4 remains and
+            # every object sits in its stable-mod home
+            pool_id = next(p.pool_id for p in
+                           rados.monc.osdmap.pools.values()
+                           if p.name == "m")
+            deadline = asyncio.get_running_loop().time() + 30
+            while True:
+                try:
+                    for osd in osds:
+                        for cid in osd.store.list_collections():
+                            if cid.pool != pool_id:
+                                continue
+                            assert cid.pg < 4, f"unmerged: {cid}"
+                            if cid.shard == META_SHARD:
+                                continue
+                            for oid in osd.store.list_objects(cid):
+                                assert object_to_ps(oid.name, 4) == \
+                                    cid.pg, (cid, oid.name)
+                    break
+                except AssertionError:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+
+            # all acked data reads back (including omap)
+            for key, val in model.items():
+                assert await io.read(key) == val, key
+            assert await io.get_omap("mobj-000") == {"k": b"v"}
+            # and writes keep landing in the merged world
+            await io.write_full("post-merge", b"new")
+            assert await io.read("post-merge") == b"new"
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_pg_split_then_merge_round_trip():
+    """Grow 4->8 (split + pgp migration), then shrink back 8->4: the
+    full two-step in both directions with the same data set."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            r = await rados.mon_command("osd pool create", pool="rt",
+                                        pg_num=4, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("rt")
+            model = {}
+            for i in range(30):
+                key = f"rt-{i:03d}"
+                model[key] = f"x{i}".encode() * 15
+                await io.write_full(key, model[key])
+
+            for var, val in (("pg_num", "8"), ("pgp_num", "8")):
+                r = await rados.mon_command("osd pool set", pool="rt",
+                                            var=var, val=val)
+                assert r["rc"] == 0, r
+            await _wait_clean(rados, "rt")
+            for key, val in model.items():
+                assert await io.read(key) == val, key
+
+            for var, val in (("pgp_num", "4"), ("pg_num", "4")):
+                r = await rados.mon_command("osd pool set", pool="rt",
+                                            var=var, val=val)
+                assert r["rc"] == 0, r
+            await _wait_clean(rados, "rt")
+            deadline = asyncio.get_running_loop().time() + 30
+            pool_id = next(p.pool_id for p in
+                           rados.monc.osdmap.pools.values()
+                           if p.name == "rt")
+            while True:
+                stale = [
+                    cid for osd in osds
+                    for cid in osd.store.list_collections()
+                    if cid.pool == pool_id and cid.pg >= 4
+                ]
+                if not stale:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    stale
+                await asyncio.sleep(0.2)
+            for key, val in model.items():
+                assert await io.read(key) == val, key
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_pg_merge_survives_restart():
+    """An OSD that was down through the merge folds on boot (superblock
+    pg_num), same as the split-after-restart contract."""
+    async def run():
+        from ceph_tpu.osd.daemon import OSDDaemon
+        from tests.test_services import fast_conf
+
+        mon, osds, rados = await start_cluster()
+        try:
+            r = await rados.mon_command("osd pool create", pool="mr",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("mr")
+            model = {}
+            for i in range(30):
+                key = f"mr-{i:03d}"
+                model[key] = f"z{i}".encode() * 12
+                await io.write_full(key, model[key])
+
+            store2 = osds[2].store
+            monmap = dict(osds[2].monc.monmap)
+            await osds[2].shutdown()
+            for var, val in (("pgp_num", "4"), ("pg_num", "4")):
+                r = await rados.mon_command("osd pool set", pool="mr",
+                                            var=var, val=val)
+                assert r["rc"] == 0, r
+            await asyncio.sleep(1.0)
+
+            osd2 = OSDDaemon(2, monmap, fast_conf(), store=store2,
+                             host="h2")
+            await osd2.start()
+            osds[2] = osd2
+            pool_id = next(p.pool_id for p in
+                           rados.monc.osdmap.pools.values()
+                           if p.name == "mr")
+            deadline = asyncio.get_running_loop().time() + 40
+            while True:
+                stale = [cid for cid in osd2.store.list_collections()
+                         if cid.pool == pool_id and cid.pg >= 4]
+                if not stale:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    stale
+                await asyncio.sleep(0.2)
+            deadline = asyncio.get_running_loop().time() + 40
+            while True:
+                try:
+                    for key, val in model.items():
+                        assert await io.read(key) == val
+                    break
+                except (IOError, AssertionError):
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
