@@ -839,9 +839,13 @@ class OSDDaemon:
                     log.derr("%s: deferred merge rescan failed: %r",
                              self.entity, e)
 
-        # tracked so shutdown cancels a pending retry cleanly
-        self._tasks.append(
-            asyncio.get_running_loop().create_task(_retry()))
+        # tracked so shutdown cancels a pending retry cleanly, and
+        # self-pruning so repeated deferrals don't accumulate handles
+        task = asyncio.get_running_loop().create_task(_retry())
+        self._tasks.append(task)
+        task.add_done_callback(
+            lambda t: self._tasks.remove(t)
+            if t in self._tasks else None)
 
     def _copy_object(self, tx: "StoreTx", src_cid, dst_cid, oid) -> None:
         """Stage a full object copy (data + xattrs + omap) into ``tx``
@@ -867,7 +871,12 @@ class OSDDaemon:
         except KeyError:
             tx.create_collection(parent)
         for oid in list(self.store.list_objects(cid)):
-            self._copy_object(tx, cid, parent, oid)
+            # a copy already in the parent is NEWER: post-flip client
+            # writes land there while a deferred fold waits (behind-
+            # peer writes into the child are ESTALE-rejected), so the
+            # child's copy must never clobber it
+            if not self.store.exists(parent, oid):
+                self._copy_object(tx, cid, parent, oid)
             tx.remove(cid, oid)
         tx.remove_collection(cid)
         await self.store.queue_transactions(tx)

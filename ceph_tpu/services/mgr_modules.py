@@ -147,6 +147,18 @@ class PGAutoscaler(MgrModule):
         self._last_cmd: dict[tuple, int] = {}
         self._pgp_lag_since: dict[str, float] = {}
 
+    def _cluster_busy(self) -> bool:
+        digest = getattr(self.mgr, "last_digest", None) or {}
+        if int(digest.get("degraded_objects", 0)):
+            return True
+        for state, count in (digest.get("pgs_by_state")
+                             or {}).items():
+            if count and any(tok in state for tok in
+                             ("peering", "recovering", "backfill",
+                              "degraded")):
+                return True
+        return False
+
     async def _apply(self, pool: str, var: str, val: int) -> None:
         if self._last_cmd.get((pool, var)) == int(val):
             return                  # waiting for the map to catch up
@@ -180,6 +192,12 @@ class PGAutoscaler(MgrModule):
                 # a mgr restart losing the in-memory intent.
                 ours = self._last_cmd.get(
                     (pool.name, "pg_num")) == pool.pg_num
+                if self._cluster_busy():
+                    # a migration is in flight (possibly the merge's
+                    # own fold step): never fight it, and restart the
+                    # grace clock so it only burns while settled
+                    self._pgp_lag_since[pool.name] = time.time()
+                    continue
                 first = self._pgp_lag_since.setdefault(
                     pool.name, time.time())
                 if ours or time.time() - first > self.MERGE_GRACE_S:

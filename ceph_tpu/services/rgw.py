@@ -610,6 +610,8 @@ class RGWLite:
         """Best-effort removal of an entry's data objects (plain,
         striped, or multipart); tolerant of already-gone objects."""
         try:
+            if rec.get("slo"):
+                return              # segments are independent objects
             if rec.get("multipart"):
                 for part in rec["multipart"]:
                     try:
@@ -1360,6 +1362,57 @@ class RGWLite:
                 "deferred_cleanup": deferred,
                 "compression": meta.get("compression")}
 
+    async def put_slo_manifest(self, bucket: str, key: str,
+                               segments: list[dict],
+                               content_type: str =
+                               "application/octet-stream",
+                               metadata: dict | None = None) -> dict:
+        """Swift Static Large Object manifest (rgw SLO support in
+        rgw_rest_swift): ``segments`` are {"bucket", "key"} (+optional
+        "etag"/"size_bytes" to validate); the stored entry reuses the
+        multipart manifest read path, so plain GETs concatenate and
+        range/stream like any multipart object.  Segments must be
+        plain-stored (not striped/compressed/SSE-C/multipart) and stay
+        independent objects — deleting the manifest leaves them."""
+        if not segments:
+            raise RGWError("InvalidArgument", "empty SLO manifest")
+        manifest = []
+        descr = []
+        etags = hashlib.md5()
+        total = 0
+        for seg in segments:
+            sb, sk = str(seg["bucket"]), str(seg["key"])
+            entry = await self._entry(sb, sk)
+            if entry.get("striped") or entry.get("multipart") \
+                    or entry.get("sse") or entry.get("comp"):
+                raise RGWError(
+                    "InvalidArgument",
+                    f"SLO segment {sb}/{sk} must be a plain object")
+            if "etag" in seg and seg["etag"] and \
+                    seg["etag"] != entry["etag"]:
+                raise RGWError("InvalidArgument",
+                               f"segment {sb}/{sk} etag mismatch")
+            if "size_bytes" in seg and seg["size_bytes"] and \
+                    int(seg["size_bytes"]) != int(entry["size"]):
+                raise RGWError("InvalidArgument",
+                               f"segment {sb}/{sk} size mismatch")
+            oid = entry.get("data_oid", self._data_oid(sb, sk))
+            manifest.append({"oid": oid, "size": int(entry["size"])})
+            descr.append({"name": f"/{sb}/{sk}",
+                          "etag": entry["etag"],
+                          "bytes": int(entry["size"])})
+            etags.update(entry["etag"].encode())
+            total += int(entry["size"])
+        # quota: the manifest stores no NEW bytes (segments already
+        # paid); charge zero or every SLO byte would count twice
+        ctx = await self._prepare_put(bucket, key, 0, False)
+        meta = dict(metadata or {})
+        meta["slo_segments"] = descr        # faithful manifest echo
+        return await self._finish_put(
+            ctx, total, f"{etags.hexdigest()}-{len(manifest)}",
+            False, content_type, meta, None, multipart=manifest,
+            slo=True)
+
     async def begin_put(self, bucket: str, key: str, length: int,
                         content_type: str = "binary/octet-stream",
                         metadata: dict[str, str] | None = None,
@@ -1413,7 +1466,9 @@ class RGWLite:
     async def _finish_put(self, ctx: dict, size: int, etag: str,
                           striped: bool, content_type: str,
                           metadata: dict, sse: dict | None,
-                          comp: dict | None = None) -> dict:
+                          comp: dict | None = None,
+                          multipart: list | None = None,
+                          slo: bool = False) -> dict:
         """Publish the index entry once the data is down (shared by
         buffered and streaming PUTs)."""
         bucket, key = ctx["bucket"], ctx["key"]
@@ -1429,6 +1484,12 @@ class RGWLite:
             entry["sse"] = sse
         if comp is not None:
             entry["comp"] = comp
+        if multipart is not None:
+            entry["multipart"] = multipart
+        if slo:
+            # Swift SLO: the manifest only REFERENCES independent
+            # segment objects — deleting it must not delete them
+            entry["slo"] = True
         if versioned:
             entry["version_id"] = version_id
             await self._record_version(bucket, key, entry)

@@ -214,7 +214,7 @@ class SwiftFrontend:
             return await self._container(method, gw, container, query)
         obj = "/".join(parts[3:])
         return await self._object(method, gw, container, obj, hdrs,
-                                  body)
+                                  body, query)
 
     async def _account(self, method: str, gw: RGWLite, uid: str):
         if method not in ("GET", "HEAD"):
@@ -277,11 +277,60 @@ class SwiftFrontend:
         return 405, {}, b""
 
     async def _object(self, method: str, gw: RGWLite, container: str,
-                      obj: str, hdrs: dict, body: bytes):
+                      obj: str, hdrs: dict, body: bytes,
+                      query: dict | None = None):
+        query = query or {}
+        mm = query.get("multipart-manifest", "")
+        if method == "PUT" and mm == "put":
+            # SLO manifest: JSON [{path, etag?, size_bytes?}, ...]
+            try:
+                listing = json.loads(body.decode())
+                segments = []
+                for s in listing:
+                    sb, _, sk = str(s["path"]).lstrip("/").partition("/")
+                    if not sb or not sk:
+                        raise ValueError(s.get("path"))
+                    segments.append({
+                        "bucket": sb, "key": sk,
+                        "etag": s.get("etag", ""),
+                        "size_bytes": s.get("size_bytes", 0),
+                    })
+            except (ValueError, TypeError, KeyError) as e:
+                return 400, {}, f"bad manifest: {e!r}".encode()
+            out = await gw.put_slo_manifest(
+                container, obj, segments,
+                content_type=hdrs.get("content-type",
+                                      "application/octet-stream"),
+                metadata={k[len("x-object-meta-"):]: v
+                          for k, v in hdrs.items()
+                          if k.startswith("x-object-meta-")})
+            return 201, {"etag": out["etag"]}, b""
+        if method == "GET" and mm == "get":
+            entry = await gw.head_object(container, obj)
+            descr = _slo_descr(entry)
+            if descr is None:
+                return 400, {}, b"not an SLO manifest"
+            return 200, {"content-type": "application/json"}, \
+                json.dumps(descr).encode()
+        if method == "DELETE" and mm == "delete":
+            # delete the manifest AND its segments (Swift semantics)
+            entry = await gw.head_object(container, obj)
+            descr = _slo_descr(entry) or []
+            await gw.delete_object(container, obj)
+            for s in descr:
+                sb, _, sk = str(s["name"]).lstrip("/").partition("/")
+                try:
+                    await gw.delete_object(sb, sk)
+                except RGWError:
+                    pass            # already gone / foreign container
+            return 204, {}, b""
         if method == "PUT":
+            # slo_segments is SERVER-owned metadata: a client header
+            # forging it would poison manifest introspection/delete
             meta = {k[len("x-object-meta-"):]: v
                     for k, v in hdrs.items()
-                    if k.startswith("x-object-meta-")}
+                    if k.startswith("x-object-meta-")
+                    and k != "x-object-meta-slo_segments"}
             out = await gw.put_object(
                 container, obj, body,
                 content_type=hdrs.get("content-type",
@@ -333,6 +382,18 @@ class SwiftFrontend:
                 return 206, rh, got["data"]
             return 200, rh, got["data"]
         return 405, {}, b""
+
+
+def _slo_descr(entry: dict) -> list | None:
+    """The trusted manifest description: entry['slo'] is set only by
+    put_slo_manifest (user metadata cannot forge the server flag)."""
+    if not entry.get("slo"):
+        return None
+    descr = (entry.get("meta") or {}).get("slo_segments")
+    if not isinstance(descr, list) or not all(
+            isinstance(s, dict) and "name" in s for s in descr):
+        return None
+    return descr
 
 
 def _iso(ts: float) -> str:

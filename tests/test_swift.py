@@ -223,3 +223,109 @@ def test_swift_edge_cases():
         await fe.stop()
         await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_swift_slo_manifest():
+    """Static Large Objects (SLO): segmented upload + manifest PUT,
+    concatenated GET with ranges, manifest introspection, and
+    manifest-with-segments delete; a plain DELETE leaves segments."""
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = await _swift()
+        st, rh, _ = await _req(host, port, "GET", "/auth/v1.0",
+                               {"x-auth-user": "bob",
+                                "x-auth-key": bob["secret_key"]})
+        auth = {"x-auth-token": rh["x-auth-token"]}
+        await _req(host, port, "PUT", "/v1/AUTH_bob/segs", auth)
+        await _req(host, port, "PUT", "/v1/AUTH_bob/docs", auth)
+        parts = [b"alpha" * 100, b"beta" * 200, b"gamma" * 50]
+        for i, p in enumerate(parts):
+            st, _, _ = await _req(host, port, "PUT",
+                                  f"/v1/AUTH_bob/segs/part{i}", auth, p)
+            assert st == 201
+        manifest = json.dumps([
+            {"path": f"/segs/part{i}", "size_bytes": len(p)}
+            for i, p in enumerate(parts)
+        ]).encode()
+        st, _, body = await _req(
+            host, port, "PUT",
+            "/v1/AUTH_bob/docs/big?multipart-manifest=put", auth,
+            manifest)
+        assert st == 201, body
+        whole = b"".join(parts)
+        st, rh, body = await _req(host, port, "GET",
+                                  "/v1/AUTH_bob/docs/big", auth)
+        assert st == 200 and body == whole
+        assert rh["content-length"] == str(len(whole))
+        # ranged read across a segment boundary
+        st, _, body = await _req(
+            host, port, "GET", "/v1/AUTH_bob/docs/big",
+            {**auth, "range": "bytes=480-520"})
+        assert st == 206 and body == whole[480:521]
+        # manifest introspection
+        st, _, body = await _req(
+            host, port, "GET",
+            "/v1/AUTH_bob/docs/big?multipart-manifest=get", auth)
+        descr = json.loads(body)
+        assert [d["name"] for d in descr] == [
+            "/segs/part0", "/segs/part1", "/segs/part2"]
+        # size mismatch rejected
+        bad = json.dumps([{"path": "/segs/part0",
+                           "size_bytes": 1}]).encode()
+        st, _, _ = await _req(
+            host, port, "PUT",
+            "/v1/AUTH_bob/docs/bad?multipart-manifest=put", auth, bad)
+        assert st == 400
+        # plain DELETE of the manifest leaves the segments
+        st, _, _ = await _req(host, port, "DELETE",
+                              "/v1/AUTH_bob/docs/big", auth)
+        assert st == 204
+        st, _, body = await _req(host, port, "GET",
+                                 "/v1/AUTH_bob/segs/part0", auth)
+        assert st == 200 and body == parts[0]
+        # manifest-with-segments delete removes both
+        st, _, _ = await _req(
+            host, port, "PUT",
+            "/v1/AUTH_bob/docs/big2?multipart-manifest=put", auth,
+            manifest)
+        assert st == 201
+        st, _, _ = await _req(
+            host, port, "DELETE",
+            "/v1/AUTH_bob/docs/big2?multipart-manifest=delete", auth)
+        assert st == 204
+        st, _, _ = await _req(host, port, "GET",
+                              "/v1/AUTH_bob/segs/part0", auth)
+        assert st == 404
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_swift_slo_metadata_not_forgeable():
+    """A client header cannot forge SLO state: the manifest flag is
+    server-owned, so introspection refuses and manifest-delete just
+    deletes the object (no crash, no phantom segment deletes)."""
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = await _swift()
+        st, rh, _ = await _req(host, port, "GET", "/auth/v1.0",
+                               {"x-auth-user": "bob",
+                                "x-auth-key": bob["secret_key"]})
+        auth = {"x-auth-token": rh["x-auth-token"]}
+        await _req(host, port, "PUT", "/v1/AUTH_bob/c", auth)
+        st, _, _ = await _req(
+            host, port, "PUT", "/v1/AUTH_bob/c/fake",
+            {**auth, "x-object-meta-slo_segments": "x"}, b"data")
+        assert st == 201
+        st, rh2, _ = await _req(host, port, "HEAD",
+                                "/v1/AUTH_bob/c/fake", auth)
+        assert "x-object-meta-slo_segments" not in rh2
+        st, _, _ = await _req(
+            host, port, "GET",
+            "/v1/AUTH_bob/c/fake?multipart-manifest=get", auth)
+        assert st == 400
+        st, _, _ = await _req(
+            host, port, "DELETE",
+            "/v1/AUTH_bob/c/fake?multipart-manifest=delete", auth)
+        assert st == 204
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
