@@ -31,6 +31,28 @@ STRIPE_THRESHOLD = 4 * 1024 * 1024
 # AES-CBC scheme.  The key is never stored; only its MD5 rides the index
 # entry so GETs can validate the presented key (S3 SSE-C contract).
 
+def manifest_window(sizes: list[int], start: int, end: int
+                    ) -> list[tuple[int, int, int]]:
+    """(segment index, offset-in-segment, length) triples covering the
+    inclusive byte range [start, end] of the concatenation — the one
+    overlap computation multipart reads, SLO and DLO all share."""
+    out = []
+    if end < start:
+        return out
+    pos = 0
+    for i, psize in enumerate(sizes):
+        pstart, pend = pos, pos + psize - 1
+        pos += psize
+        if psize <= 0 or pend < start:
+            continue
+        if pstart > end:
+            break
+        off = max(0, start - pstart)
+        length = min(pend, end) - (pstart + off) + 1
+        out.append((i, off, length))
+    return out
+
+
 def sse_begin(key: bytes) -> dict:
     import secrets as _secrets
 
@@ -1611,22 +1633,11 @@ class RGWLite:
         only the parts overlapping the requested range are fetched."""
         start, end = (0, size - 1) if range_ is None else range_
         end = min(end, size - 1)
-        if end < start:
-            return b""
         chunks = []
-        pos = 0
-        for part in manifest:
-            psize = int(part["size"])
-            pstart, pend = pos, pos + psize - 1
-            pos += psize
-            if pend < start:
-                continue
-            if pstart > end:
-                break
-            off = max(0, start - pstart)
-            length = min(pend, end) - (pstart + off) + 1
-            chunks.append(await self.ioctx.read(part["oid"], length,
-                                                off))
+        for i, off, length in manifest_window(
+                [int(p["size"]) for p in manifest], start, end):
+            chunks.append(await self.ioctx.read(
+                manifest[i]["oid"], length, off))
         return b"".join(chunks)
 
     async def head_object(self, bucket: str, key: str) -> dict:

@@ -216,6 +216,44 @@ class SwiftFrontend:
         return await self._object(method, gw, container, obj, hdrs,
                                   body, query)
 
+    async def _dlo_read(self, method: str, gw: RGWLite, entry: dict,
+                        dlo: str, rng):
+        """Dynamic Large Object GET/HEAD: concatenate every object
+        under <container>/<prefix> in name order (Swift DLO
+        semantics)."""
+        from ceph_tpu.services.rgw import manifest_window
+
+        sc, _, prefix = dlo.lstrip("/").partition("/")
+        segs = []
+        marker = ""
+        while True:
+            listing = await gw.list_objects(sc, prefix=prefix,
+                                            marker=marker,
+                                            max_keys=1000)
+            segs += listing["contents"]
+            if not listing.get("is_truncated"):
+                break
+            marker = segs[-1]["key"]
+        total = sum(int(c["size"]) for c in segs)
+        if method == "HEAD":
+            return 200, _dlo_headers(entry, total), b""
+        start, end = (0, total - 1) if rng is None else \
+            (rng[0], min(rng[1], total - 1))
+        if rng is not None and start >= total:
+            return 416, {"content-range": f"bytes */{total}"}, b""
+        chunks = []
+        for i, off, length in manifest_window(
+                [int(c["size"]) for c in segs], start, end):
+            got = await gw.get_object(
+                sc, segs[i]["key"], range_=(off, off + length - 1))
+            chunks.append(got["data"])
+        body = b"".join(chunks)
+        hdrs = _dlo_headers(entry, len(body))
+        if rng is not None:
+            hdrs["content-range"] = f"bytes {start}-{end}/{total}"
+            return 206, hdrs, body
+        return 200, hdrs, body
+
     async def _account(self, method: str, gw: RGWLite, uid: str):
         if method not in ("GET", "HEAD"):
             return 405, {}, b""
@@ -327,10 +365,13 @@ class SwiftFrontend:
         if method == "PUT":
             # slo_segments is SERVER-owned metadata: a client header
             # forging it would poison manifest introspection/delete
-            meta = {k[len("x-object-meta-"):]: v
-                    for k, v in hdrs.items()
-                    if k.startswith("x-object-meta-")
-                    and k != "x-object-meta-slo_segments"}
+            meta = _client_meta(hdrs)
+            dlo = hdrs.get("x-object-manifest", "")
+            if dlo:
+                # DLO: zero-byte manifest whose GET concatenates every
+                # object under <container>/<prefix> (Swift dynamic
+                # large objects)
+                meta["dlo_manifest"] = dlo
             out = await gw.put_object(
                 container, obj, body,
                 content_type=hdrs.get("content-type",
@@ -339,12 +380,20 @@ class SwiftFrontend:
             return 201, {"etag": out["etag"]}, b""
         if method == "POST":
             # Swift POST REPLACES the object metadata set (unlike S3
-            # copy-with-metadata); -lite rewrites the index entry
+            # copy-with-metadata); -lite rewrites the index entry.
+            # X-Object-Manifest follows Swift semantics: present sets
+            # the DLO pointer, absent drops it (clients re-send it to
+            # keep a manifest through a metadata update).
             await gw._check_bucket(container, "WRITE")
             entry = await gw.head_object(container, obj)
-            entry["meta"] = {k[len("x-object-meta-"):]: v
-                             for k, v in hdrs.items()
-                             if k.startswith("x-object-meta-")}
+            meta = _client_meta(hdrs)
+            slo = (entry.get("meta") or {}).get("slo_segments")
+            if slo is not None:
+                meta["slo_segments"] = slo     # server-owned: sticky
+            dlo = hdrs.get("x-object-manifest", "")
+            if dlo and not entry.get("slo"):
+                meta["dlo_manifest"] = dlo
+            entry["meta"] = meta
             await gw.ioctx.set_omap(gw._index_oid(container), {
                 obj: json.dumps(entry).encode()})
             return 202, {}, b""
@@ -364,8 +413,17 @@ class SwiftFrontend:
                         rng = None
             if method == "HEAD":
                 entry = await gw.head_object(container, obj)
+                dlo = (entry.get("meta") or {}).get("dlo_manifest")
+                if dlo and not entry.get("slo"):
+                    return await self._dlo_read("HEAD", gw, entry,
+                                                dlo, rng)
                 return 200, _obj_headers(entry), b""
             got = await gw.get_object(container, obj, range_=rng)
+            dlo = (got.get("meta") or {}).get("dlo_manifest")
+            if dlo and not got.get("slo"):
+                # a manifest's stored body is empty: the probe wasted
+                # nothing and the hot plain-GET path stays one read
+                return await self._dlo_read("GET", gw, got, dlo, rng)
             rh = _obj_headers(got)
             if rng is not None:
                 size = int(got.get("size", 0))
@@ -382,6 +440,25 @@ class SwiftFrontend:
                 return 206, rh, got["data"]
             return 200, rh, got["data"]
         return 405, {}, b""
+
+
+_SERVER_META = ("slo_segments", "dlo_manifest")
+
+
+def _client_meta(hdrs: dict) -> dict:
+    """x-object-meta-* minus the server-owned keys (forging them would
+    poison manifest introspection/resolution)."""
+    return {k[len("x-object-meta-"):]: v
+            for k, v in hdrs.items()
+            if k.startswith("x-object-meta-")
+            and k[len("x-object-meta-"):] not in _SERVER_META}
+
+
+def _dlo_headers(entry: dict, size: int) -> dict:
+    hdrs = _obj_headers(entry)
+    hdrs["content-length"] = str(size)
+    hdrs["x-object-manifest"] = entry["meta"]["dlo_manifest"]
+    return hdrs
 
 
 def _slo_descr(entry: dict) -> list | None:
@@ -410,5 +487,6 @@ def _obj_headers(entry: dict) -> dict:
         "content-length": str(entry.get("size", 0)),
     }
     for k, v in (entry.get("meta") or {}).items():
-        hdrs[f"x-object-meta-{k}"] = str(v)
+        if k not in _SERVER_META:
+            hdrs[f"x-object-meta-{k}"] = str(v)
     return hdrs

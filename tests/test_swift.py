@@ -326,6 +326,58 @@ def test_swift_slo_metadata_not_forgeable():
             host, port, "DELETE",
             "/v1/AUTH_bob/c/fake?multipart-manifest=delete", auth)
         assert st == 204
+        # DLO pointer is server-owned too: the meta-header form is
+        # stripped, only the real X-Object-Manifest header counts
+        st, _, _ = await _req(
+            host, port, "PUT", "/v1/AUTH_bob/c/fake2",
+            {**auth, "x-object-meta-dlo_manifest": "c/"}, b"own-body")
+        assert st == 201
+        st, _, body = await _req(host, port, "GET",
+                                 "/v1/AUTH_bob/c/fake2", auth)
+        assert st == 200 and body == b"own-body"
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_swift_dlo_manifest():
+    """Dynamic Large Objects: X-Object-Manifest prefix concatenation
+    with ranges; new segments appear dynamically."""
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = await _swift()
+        st, rh, _ = await _req(host, port, "GET", "/auth/v1.0",
+                               {"x-auth-user": "bob",
+                                "x-auth-key": bob["secret_key"]})
+        auth = {"x-auth-token": rh["x-auth-token"]}
+        await _req(host, port, "PUT", "/v1/AUTH_bob/segs", auth)
+        await _req(host, port, "PUT", "/v1/AUTH_bob/docs", auth)
+        parts = [b"one" * 40, b"two" * 60]
+        for i, p in enumerate(parts):
+            await _req(host, port, "PUT",
+                       f"/v1/AUTH_bob/segs/dlo/{i:03d}", auth, p)
+        st, _, _ = await _req(
+            host, port, "PUT", "/v1/AUTH_bob/docs/stream",
+            {**auth, "x-object-manifest": "segs/dlo/"}, b"")
+        assert st == 201
+        whole = b"".join(parts)
+        st, rh2, body = await _req(host, port, "GET",
+                                   "/v1/AUTH_bob/docs/stream", auth)
+        assert st == 200 and body == whole
+        assert rh2["x-object-manifest"] == "segs/dlo/"
+        st, rh2, body = await _req(host, port, "HEAD",
+                                   "/v1/AUTH_bob/docs/stream", auth)
+        assert rh2["content-length"] == str(len(whole))
+        # ranged across the boundary
+        st, _, body = await _req(
+            host, port, "GET", "/v1/AUTH_bob/docs/stream",
+            {**auth, "range": "bytes=100-150"})
+        assert st == 206 and body == whole[100:151]
+        # DLO is dynamic: a new segment extends the object
+        await _req(host, port, "PUT",
+                   "/v1/AUTH_bob/segs/dlo/004", auth, b"three" * 20)
+        st, _, body = await _req(host, port, "GET",
+                                 "/v1/AUTH_bob/docs/stream", auth)
+        assert body == whole + b"three" * 20
         await fe.stop()
         await stop_cluster(mon, osds, rados)
     asyncio.run(run())
