@@ -832,3 +832,64 @@ def test_pg_merge_survives_restart():
         finally:
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_pg_merge_gate_blocks_on_unsettled_signals():
+    """The mon's ready-to-merge signals: staged-epoch composition,
+    pg_temp overrides, and digest degradation each block the shrink."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            r = await rados.mon_command("osd pool create", pool="g",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            pool_id = next(p.pool_id for p in
+                           rados.monc.osdmap.pools.values()
+                           if p.name == "g")
+            osd_mon = mon.osd_monitor
+
+            # shrinking pg_num before the committed map carries the
+            # matching pgp step must refuse — otherwise back-to-back
+            # set commands would compose and merge before migration
+            r = await rados.mon_command("osd pool set", pool="g",
+                                        var="pg_num", val="4")
+            assert r["rc"] == -22 and "pgp_num" in r["outs"], r
+
+            r = await rados.mon_command("osd pool set", pool="g",
+                                        var="pgp_num", val="4")
+            assert r["rc"] == 0
+            await _wait_clean(rados, "g")
+
+            # digest degradation blocks
+            osd_mon.mon.mgr_stat.digest = {
+                "pools": {pool_id: {"degraded": 3}},
+                "pgs_by_state": {"active+clean": 8},
+            }
+            r = await rados.mon_command("osd pool set", pool="g",
+                                        var="pg_num", val="4")
+            assert r["rc"] == -16 and "degraded" in r["outs"], r
+
+            # transitional pg states block
+            osd_mon.mon.mgr_stat.digest = {
+                "pools": {},
+                "pgs_by_state": {"active+recovering+degraded": 1},
+            }
+            r = await rados.mon_command("osd pool set", pool="g",
+                                        var="pg_num", val="4")
+            assert r["rc"] == -16, r
+
+            # pg_temp overrides block
+            osd_mon.mon.mgr_stat.digest = {}
+            osd_mon.osdmap.pg_temp[(pool_id, 2)] = [0, 1]
+            r = await rados.mon_command("osd pool set", pool="g",
+                                        var="pg_num", val="4")
+            assert r["rc"] == -16 and "pg_temp" in r["outs"], r
+            del osd_mon.osdmap.pg_temp[(pool_id, 2)]
+
+            # settled: the shrink passes
+            r = await rados.mon_command("osd pool set", pool="g",
+                                        var="pg_num", val="4")
+            assert r["rc"] == 0, r
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
