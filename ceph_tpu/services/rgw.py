@@ -12,6 +12,7 @@ conditional puts.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 import time
@@ -272,6 +273,34 @@ class RGWUsers:
         return rec["uid"]
 
 
+COMP_BLOCK = 4 * 1024 * 1024
+
+
+def deflate_if_smaller(data: bytes) -> tuple[bytes, dict | None]:
+    """Whole-body at-rest deflate (rgw_compression.cc role for small
+    objects): kept only when it actually shrinks."""
+    packed = zlib.compress(data, 6)
+    if len(packed) < len(data):
+        return packed, {"alg": "zlib", "stored_size": len(packed)}
+    return data, None
+
+
+def comp_window(blocks, start: int, end: int):
+    """Map an inclusive INFLATED byte range onto independently-deflated
+    blocks (the reference's compression block map, rgw_compression.h
+    RGWCompressionInfo role): (stored_off, stored_len, skip, take)
+    per intersecting block — inflate the block's stored bytes, then
+    slice inflated[skip:skip+take].  The overlap math is
+    manifest_window over the inflated block sizes; this only adds the
+    stored-offset prefix sum."""
+    stored_off = [0]
+    for _, stored_len in blocks:
+        stored_off.append(stored_off[-1] + stored_len)
+    return [(stored_off[i], blocks[i][1], skip, take)
+            for i, skip, take in manifest_window(
+                [b[0] for b in blocks], start, end)]
+
+
 class StreamingPut:
     """One chunked PUT in flight (rgw_putobj processor role): write()
     places each chunk at its running offset (striper for large bodies),
@@ -293,10 +322,26 @@ class StreamingPut:
         self._md5 = hashlib.md5()
         self._striped = length > STRIPE_THRESHOLD
         self._buf = bytearray() if not self._striped else None
+        # at-rest compression rides the stream: striped bodies deflate
+        # per COMP_BLOCK into a block map so reads keep random access
+        # and bounded memory; small ones stay buffered and compress at
+        # complete() exactly like the buffered path
+        self._comp_alg = (ctx.get("compression")
+                          if ctx.get("compression") == "zlib" else None)
+        self._cpos = 0
+        self._blkbuf = bytearray() if self._striped else None
+        self._blocks: list[list[int]] = []
 
     def set_sse_key(self, key: bytes) -> None:
+        if self._pos:
+            raise RGWError("InvalidRequest",
+                           "SSE-C key must be set before the first "
+                           "body chunk")
         self._sse = sse_begin(key)
         self._sse_key = key
+        # SSE-C excludes at-rest compression (ciphertext doesn't
+        # deflate), matching the buffered put_object path
+        self._comp_alg = None
 
     async def write(self, chunk: bytes) -> None:
         if self._pos + len(chunk) > self.length:
@@ -309,21 +354,48 @@ class StreamingPut:
                               bytes.fromhex(self._sse["nonce"]),
                               self._pos, chunk)
         if self._striped:
-            await self._rgw.striper.write(self._ctx["oid"], chunk,
-                                          offset=self._pos)
+            if self._comp_alg is not None:
+                self._blkbuf += chunk
+                while len(self._blkbuf) >= COMP_BLOCK:
+                    await self._emit_block(
+                        bytes(self._blkbuf[:COMP_BLOCK]))
+                    del self._blkbuf[:COMP_BLOCK]
+            else:
+                await self._rgw.striper.write(self._ctx["oid"], chunk,
+                                              offset=self._pos)
         else:
             self._buf += chunk
         self._pos += len(chunk)
+
+    async def _emit_block(self, raw: bytes) -> None:
+        # each block deflates independently (always kept: a streamed
+        # body can't be un-written, and per-block zlib framing is
+        # ~0.03% worst case) so reads seek straight to any block
+        packed = zlib.compress(raw, 6)
+        await self._rgw.striper.write(self._ctx["oid"], packed,
+                                      offset=self._cpos)
+        self._blocks.append([len(raw), len(packed)])
+        self._cpos += len(packed)
 
     async def complete(self) -> dict:
         if self._pos != self.length:
             await self.abort()
             raise RGWError("IncompleteBody",
                            f"{self._pos} of {self.length} bytes")
-        if not self._striped:
+        comp = None
+        if self._striped and self._comp_alg is not None:
+            if self._blkbuf:
+                await self._emit_block(bytes(self._blkbuf))
+                self._blkbuf.clear()
+            comp = {"alg": "zlib", "stored_size": self._cpos,
+                    "blocks": self._blocks}
+        elif not self._striped:
+            data = bytes(self._buf)
+            if self._comp_alg is not None:
+                data, comp = deflate_if_smaller(data)
             await self._rgw.ioctx.operate(
                 self._ctx["oid"],
-                ObjectOperation().write_full(bytes(self._buf)))
+                ObjectOperation().write_full(data))
         # replaced object's data (and version-store adoption) happen
         # only now — with the new bytes fully down, just before the
         # index flips to them; an aborted stream never reaches here
@@ -338,7 +410,7 @@ class StreamingPut:
         return await self._rgw._finish_put(
             self._ctx, self.length, self._md5.hexdigest(),
             self._striped, self._content_type, self._metadata,
-            self._sse)
+            self._sse, comp=comp)
 
     async def abort(self) -> None:
         """Drop any data already landed; the index was never touched."""
@@ -760,9 +832,11 @@ class RGWLite:
         await self._check_bucket(bucket, "READ")
         entry = await self._lookup_version_entry(bucket, key,
                                                  version_id)
-        data = await self._read_entry_data(bucket, key, entry, None)
         if entry.get("comp"):
-            data = zlib.decompress(data)
+            data = await self._inflate_read(entry, None)
+        else:
+            data = await self._read_entry_data(bucket, key, entry,
+                                               None)
         return {"data": data, **entry}
 
     async def head_object_version(self, bucket: str, key: str,
@@ -1462,12 +1536,9 @@ class RGWLite:
         size = len(data)
         comp = None
         if ctx.get("compression") == "zlib" and sse_key is None:
-            # compress-at-rest (rgw_compression.cc): only kept when it
-            # actually shrinks; S3-visible size/etag stay the original
-            packed = zlib.compress(data, 6)
-            if len(packed) < len(data):
-                data = packed
-                comp = {"alg": "zlib", "stored_size": len(packed)}
+            # compress-at-rest (rgw_compression.cc): S3-visible
+            # size/etag stay the original
+            data, comp = deflate_if_smaller(data)
         sse = None
         if sse_key is not None:
             sse = sse_begin(sse_key)
@@ -1547,12 +1618,7 @@ class RGWLite:
         sse_check(entry, sse_key)
         if entry.get("comp"):
             # compressed at rest: ranges slice the INFLATED bytes
-            raw = await self._read_entry_data(bucket, key, entry, None)
-            data = zlib.decompress(raw)
-            if range_ is not None:
-                start, end = range_
-                end = min(end, entry["size"] - 1)
-                data = data[start:end + 1]
+            data = await self._inflate_read(entry, range_)
             return {"data": data, **entry}
         data = await self._read_entry_data(bucket, key, entry, range_)
         if sse_key is not None:
@@ -1561,6 +1627,40 @@ class RGWLite:
                              bytes.fromhex(entry["sse"]["nonce"]),
                              start, data)
         return {"data": data, **entry}
+
+    async def _read_stored(self, entry: dict, off: int,
+                           length: int) -> bytes:
+        """Stored (possibly deflated) bytes by STORED offset — never
+        clamped by the inflated size, which deflate can exceed."""
+        oid = entry["data_oid"]
+        if entry["striped"]:
+            return await self.striper.read(oid, length, off)
+        return await self.ioctx.read(oid, length, off)
+
+    async def _inflate_read(self, entry: dict,
+                            range_: tuple[int, int] | None) -> bytes:
+        """Read an at-rest-compressed entry's INFLATED bytes. Blocked
+        objects (streamed PUTs) inflate only the blocks the range
+        touches; legacy whole-body deflate inflates everything."""
+        size = int(entry["size"])
+        start, end = (0, size - 1) if range_ is None else range_
+        end = min(end, size - 1)
+        if end < start:
+            return b""
+        blocks = entry["comp"].get("blocks")
+        if blocks is None:
+            raw = await self._read_stored(
+                entry, 0, entry["comp"]["stored_size"])
+            return zlib.decompress(raw)[start:end + 1]
+        async def one(soff, slen, skip, take):
+            raw = await self._read_stored(entry, soff, slen)
+            return zlib.decompress(raw)[skip:skip + take]
+
+        # the windows are independent stored ranges: fetch + inflate
+        # them concurrently (the result is buffered whole either way)
+        out = await asyncio.gather(*(
+            one(*w) for w in comp_window(blocks, start, end)))
+        return b"".join(out)
 
     async def _read_entry_data(self, bucket: str, key: str,
                                entry: dict,
@@ -1593,21 +1693,31 @@ class RGWLite:
             entry = await self._entry(bucket, key)
         sse_check(entry, sse_key)
         if entry.get("comp"):
-            # at-rest compression has no random access (-lite trades
-            # the reference's block map for whole-object inflate); read
-            # through the GIVEN entry so the headers the caller already
-            # built and the body can never describe different objects
-            raw = await self._read_entry_data(bucket, key, entry, None)
-            data = zlib.decompress(raw)
-            if range_ is not None:
-                start, end = range_
-                end = min(end, int(entry["size"]) - 1)
-                data = data[start:end + 1]
+            # read through the GIVEN entry so the headers the caller
+            # already built and the body can never describe different
+            # objects
+            blocks = entry["comp"].get("blocks")
+            if blocks is None:
+                # legacy whole-body deflate (small buffered puts)
+                data = await self._inflate_read(entry, range_)
 
-            async def one():
-                yield data
+                async def one():
+                    yield data
 
-            return entry, one()
+                return entry, one()
+            size = int(entry["size"])
+            start, end = (0, size - 1) if range_ is None else range_
+            end = min(end, size - 1)
+            windows = comp_window(blocks, start, end)
+
+            async def blocked():
+                # one block in memory at a time: the block map keeps
+                # streamed GETs of compressed objects bounded
+                for soff, slen, skip, take in windows:
+                    raw = await self._read_stored(entry, soff, slen)
+                    yield zlib.decompress(raw)[skip:skip + take]
+
+            return entry, blocked()
         size = int(entry["size"])
         start, end = (0, size - 1) if range_ is None else range_
         end = min(end, size - 1)

@@ -334,3 +334,94 @@ def test_bucket_compression_at_rest():
         assert (await gw.get_object("cb", "doc"))["data"] == body
         await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_streaming_put_compresses_at_rest():
+    """Streaming PUTs deflate in flight: large bodies ride the striper
+    at compressed offsets, small ones compress at complete() like the
+    buffered path, SSE-C streams stay uncompressed."""
+    import zlib
+
+    async def run():
+        mon, osds, rados = await start_cluster()
+        await rados.pool_create("rgw", pg_num=8)
+        ioctx = await rados.open_ioctx("rgw")
+        gw = RGWLite(ioctx)
+        await gw.create_bucket("sb")
+        await gw.put_bucket_compression("sb", "zlib")
+
+        # striped (> 4 MiB declared) body, streamed in ragged chunks
+        body = b"stream and deflate " * (5 * 1024 * 1024 // 19 + 1)
+        put = await gw.begin_put("sb", "big", len(body))
+        pos = 0
+        for n in (1 << 20, 3, 2 << 20, 1):
+            await put.write(body[pos:pos + n])
+            pos += n
+        await put.write(body[pos:])
+        out = await put.complete()
+        assert out["size"] == len(body)
+        entry = await gw.head_object("sb", "big")
+        assert entry["comp"]["alg"] == "zlib"
+        assert entry["comp"]["stored_size"] < len(body) // 2
+        raw = await gw.striper.read(entry["data_oid"])
+        assert len(raw) == entry["comp"]["stored_size"]
+        blocks = entry["comp"]["blocks"]
+        blk = 4 * 1024 * 1024
+        assert len(blocks) == (len(body) + blk - 1) // blk
+        assert sum(b[0] for b in blocks) == len(body)
+        off, inflated = 0, bytearray()
+        for _, stored_len in blocks:
+            inflated += zlib.decompress(raw[off:off + stored_len])
+            off += stored_len
+        assert bytes(inflated) == body
+        got = await gw.get_object("sb", "big")
+        assert got["data"] == body
+        # a range crossing a block boundary touches exactly two blocks
+        got = await gw.get_object("sb", "big",
+                                  range_=(blk - 7, blk + 6))
+        assert got["data"] == body[blk - 7:blk + 7]
+        got = await gw.get_object("sb", "big",
+                                  range_=(len(body) - 20,
+                                          len(body) + 99))
+        assert got["data"] == body[-20:]
+        # streamed GET inflates block-by-block, never the whole body
+        _, gen = await gw.stream_object("sb", "big")
+        chunks = [c async for c in gen]
+        assert max(len(c) for c in chunks) <= blk
+        assert b"".join(chunks) == body
+        _, gen = await gw.stream_object("sb", "big",
+                                        range_=(blk - 3, blk + 2))
+        assert b"".join([c async for c in gen]) == body[blk - 3:blk + 3]
+
+        # small streamed body: buffered-path semantics (kept only when
+        # it shrinks)
+        put = await gw.begin_put("sb", "small", 4096)
+        await put.write(b"x" * 4096)
+        await put.complete()
+        entry = await gw.head_object("sb", "small")
+        assert entry["comp"]["stored_size"] < 4096
+        assert (await gw.get_object("sb", "small"))["data"] == b"x" * 4096
+        import secrets
+        noise = secrets.token_bytes(4096)
+        put = await gw.begin_put("sb", "noise", 4096)
+        await put.write(noise)
+        await put.complete()
+        assert "comp" not in await gw.head_object("sb", "noise")
+
+        # SSE-C wins over compression (ciphertext doesn't deflate)
+        key = b"k" * 32
+        put = await gw.begin_put("sb", "enc", 4096)
+        put.set_sse_key(key)
+        with pytest.raises(RGWError):
+            late = await gw.begin_put("sb", "late", 8)
+            await late.write(b"1234")
+            late.set_sse_key(key)
+        await late.abort()
+        await put.write(b"y" * 4096)
+        await put.complete()
+        entry = await gw.head_object("sb", "enc")
+        assert "comp" not in entry and "sse" in entry
+        got = await gw.get_object("sb", "enc", sse_key=key)
+        assert got["data"] == b"y" * 4096
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
