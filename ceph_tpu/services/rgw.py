@@ -827,16 +827,28 @@ class RGWLite:
         return entry
 
     async def get_object_version(self, bucket: str, key: str,
-                                 version_id: str) -> dict:
-        """GET ?versionId= — any stored version, marker or not."""
+                                 version_id: str,
+                                 sse_key: bytes | None = None) -> dict:
+        """GET ?versionId= — any stored version, marker or not.
+        ``sse_key``: SSE-C decryption, including multipart versions
+        whose parts carry their own nonces."""
         await self._check_bucket(bucket, "READ")
         entry = await self._lookup_version_entry(bucket, key,
                                                  version_id)
+        sse_check(entry, sse_key)
         if entry.get("comp"):
             data = await self._inflate_read(entry, None)
+        elif sse_key is not None and entry["sse"].get("multipart"):
+            data = await self._read_manifest(
+                entry["multipart"], int(entry["size"]), None,
+                sse_key=sse_key)
         else:
             data = await self._read_entry_data(bucket, key, entry,
                                                None)
+            if sse_key is not None:
+                data = sse_crypt(
+                    sse_key, bytes.fromhex(entry["sse"]["nonce"]),
+                    0, data)
         return {"data": data, **entry}
 
     async def head_object_version(self, bucket: str, key: str,
@@ -947,8 +959,12 @@ class RGWLite:
         return omap
 
     async def upload_part(self, bucket: str, key: str, upload_id: str,
-                          part_number: int, data: bytes) -> dict:
-        """S3 UploadPart; re-uploading a part number replaces it."""
+                          part_number: int, data: bytes,
+                          sse_key: bytes | None = None) -> dict:
+        """S3 UploadPart; re-uploading a part number replaces it.
+        ``sse_key``: SSE-C — each part encrypts under its own nonce at
+        part-relative offsets (rgw_crypt.cc multipart rule: the part
+        boundary resets the counter, so the assembled read can seek)."""
         if not 1 <= part_number <= 10000:
             raise RGWError("InvalidArgument", "part number 1..10000")
         meta = await self._check_bucket(bucket, "WRITE")
@@ -956,15 +972,19 @@ class RGWLite:
         await self._check_quota(bucket, meta, len(data),
                                 replaced_size=0, is_replace=False)
         etag = hashlib.md5(data).hexdigest()
+        rec = {"etag": etag, "size": len(data)}
+        if sse_key is not None:
+            sse = sse_begin(sse_key)
+            data = sse_crypt(sse_key, bytes.fromhex(sse["nonce"]),
+                             0, data)
+            rec["sse"] = sse
         await self.ioctx.operate(
             self._mp_part_oid(bucket, key, upload_id, part_number),
             ObjectOperation().write_full(data),
         )
         await self.ioctx.set_omap(
             self._mp_meta_oid(bucket, key, upload_id), {
-                f"part.{part_number:05d}": json.dumps({
-                    "etag": etag, "size": len(data),
-                }).encode(),
+                f"part.{part_number:05d}": json.dumps(rec).encode(),
             },
         )
         return {"etag": etag, "part_number": part_number}
@@ -995,6 +1015,7 @@ class RGWLite:
         manifest = []
         total = 0
         digest_md5 = hashlib.md5()
+        sse_md5s: set = set()
         last = 0
         for num, etag in parts:
             if num <= last:
@@ -1003,12 +1024,27 @@ class RGWLite:
             have = uploaded.get(num)
             if have is None or have["etag"] != etag:
                 raise RGWError("InvalidPart", str(num))
-            manifest.append({
+            item = {
                 "oid": self._mp_part_oid(bucket, key, upload_id, num),
                 "size": have["size"], "etag": etag,
-            })
+            }
+            psse = have.get("sse")
+            if psse is not None:
+                item["nonce"] = psse["nonce"]
+            sse_md5s.add(psse["key_md5"] if psse else None)
+            manifest.append(item)
             total += have["size"]
             digest_md5.update(bytes.fromhex(etag))
+        entry_sse = None
+        if sse_md5s != {None}:
+            # encrypted parts: every part must be under the SAME key,
+            # and a plaintext part cannot hide inside an SSE-C object
+            if None in sse_md5s or len(sse_md5s) != 1:
+                raise RGWError(
+                    "InvalidRequest",
+                    "all parts must use the same SSE-C key")
+            entry_sse = {"alg": "AES256", "key_md5": sse_md5s.pop(),
+                         "multipart": True}
         meta_omap = await self._mp_meta(bucket, key, upload_id)
         info = json.loads(meta_omap["_meta"])
         # the assembled size is the real quota event (parts are not in
@@ -1053,6 +1089,8 @@ class RGWLite:
             "content_type": info["content_type"], "striped": False,
             "meta": info["meta"], "multipart": manifest,
         }
+        if entry_sse is not None:
+            entry["sse"] = entry_sse
         if versioned:
             # the assembled object is a NEW version; prior current
             # (incl. pre-versioning 'null') survives as history
@@ -1620,6 +1658,11 @@ class RGWLite:
             # compressed at rest: ranges slice the INFLATED bytes
             data = await self._inflate_read(entry, range_)
             return {"data": data, **entry}
+        if sse_key is not None and entry["sse"].get("multipart"):
+            data = await self._read_manifest(
+                entry["multipart"], int(entry["size"]), range_,
+                sse_key=sse_key)
+            return {"data": data, **entry}
         data = await self._read_entry_data(bucket, key, entry, range_)
         if sse_key is not None:
             start = range_[0] if range_ is not None else 0
@@ -1721,6 +1764,27 @@ class RGWLite:
         size = int(entry["size"])
         start, end = (0, size - 1) if range_ is None else range_
         end = min(end, size - 1)
+        if sse_key is not None and entry["sse"].get("multipart"):
+            manifest = entry["multipart"]
+            windows = manifest_window(
+                [int(p["size"]) for p in manifest], start, end)
+
+            async def gen_mp():
+                # per-part nonces: decrypt at part-relative offsets,
+                # chunk-bounded so huge parts never buffer whole
+                for i, off, length in windows:
+                    part = manifest[i]
+                    pnonce = bytes.fromhex(part["nonce"])
+                    pos, rem = off, length
+                    while rem > 0:
+                        n = min(chunk, rem)
+                        data = await self.ioctx.read(part["oid"], n,
+                                                     pos)
+                        yield sse_crypt(sse_key, pnonce, pos, data)
+                        pos += n
+                        rem -= n
+
+            return entry, gen_mp()
         nonce = (bytes.fromhex(entry["sse"]["nonce"])
                  if sse_key is not None else b"")
 
@@ -1738,16 +1802,23 @@ class RGWLite:
         return entry, gen()
 
     async def _read_manifest(self, manifest: list[dict], size: int,
-                             range_: tuple[int, int] | None) -> bytes:
+                             range_: tuple[int, int] | None,
+                             sse_key: bytes | None = None) -> bytes:
         """Read through a multipart manifest (RGWObjManifest role):
-        only the parts overlapping the requested range are fetched."""
+        only the parts overlapping the requested range are fetched.
+        ``sse_key``: decrypt SSE-C parts with their per-part nonce at
+        part-relative offsets."""
         start, end = (0, size - 1) if range_ is None else range_
         end = min(end, size - 1)
         chunks = []
         for i, off, length in manifest_window(
                 [int(p["size"]) for p in manifest], start, end):
-            chunks.append(await self.ioctx.read(
-                manifest[i]["oid"], length, off))
+            raw = await self.ioctx.read(manifest[i]["oid"], length, off)
+            if sse_key is not None and manifest[i].get("nonce"):
+                raw = sse_crypt(
+                    sse_key, bytes.fromhex(manifest[i]["nonce"]),
+                    off, raw)
+            chunks.append(raw)
         return b"".join(chunks)
 
     async def head_object(self, bucket: str, key: str) -> dict:

@@ -684,7 +684,7 @@ class S3Frontend:
             if "partNumber" in q and "uploadId" in q:
                 part = await gw.upload_part(
                     bucket, key, q["uploadId"], int(q["partNumber"]),
-                    req.body,
+                    req.body, sse_key=_sse_key_headers(req),
                 )
                 return 200, {"etag": f'"{part["etag"]}"'}, b""
             src = req.header("x-amz-copy-source")
@@ -735,13 +735,8 @@ class S3Frontend:
                     hdrs = _obj_headers({**entry, "data": b""})
                     hdrs["x-amz-version-id"] = q["versionId"]
                     return 200, hdrs, b""
-                got = await gw.get_object_version(bucket, key,
-                                                  q["versionId"])
-                sse_check(got, sse_key)
-                if sse_key is not None:
-                    got["data"] = sse_crypt(
-                        sse_key, bytes.fromhex(got["sse"]["nonce"]),
-                        0, got["data"])
+                got = await gw.get_object_version(
+                    bucket, key, q["versionId"], sse_key=sse_key)
                 hdrs = _obj_headers(got)
                 hdrs["x-amz-version-id"] = q["versionId"]
                 return 200, hdrs, got["data"]

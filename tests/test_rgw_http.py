@@ -614,3 +614,61 @@ def test_notification_rest_and_sts_signed_request():
         await fe.stop()
         await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_multipart_sse_c_over_rest():
+    """SSE-C headers on UploadPart encrypt each part; the assembled
+    object GETs back (full + seam-spanning range) only with the key."""
+    import base64
+
+    def sse_headers(key: bytes) -> dict:
+        return {
+            "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key":
+                base64.b64encode(key).decode(),
+            "x-amz-server-side-encryption-customer-key-md5":
+                base64.b64encode(
+                    hashlib.md5(key).digest()).decode(),
+        }
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+        key = b"q" * 32
+        try:
+            await cli.request("PUT", "/eb")
+            st, _, body = await cli.request("POST", "/eb/obj?uploads")
+            upid = ET.fromstring(body).find("s3:UploadId", ns).text
+            p1, p2 = b"A" * 70000, b"B" * 50000
+            st, h1, _ = await cli.request(
+                "PUT", f"/eb/obj?partNumber=1&uploadId={upid}", p1,
+                headers=sse_headers(key))
+            assert st == 200
+            st, h2, _ = await cli.request(
+                "PUT", f"/eb/obj?partNumber=2&uploadId={upid}", p2,
+                headers=sse_headers(key))
+            done_xml = (
+                "<CompleteMultipartUpload>"
+                f"<Part><PartNumber>1</PartNumber>"
+                f"<ETag>{h1['etag']}</ETag></Part>"
+                f"<Part><PartNumber>2</PartNumber>"
+                f"<ETag>{h2['etag']}</ETag></Part>"
+                "</CompleteMultipartUpload>").encode()
+            st, _, _ = await cli.request(
+                "POST", f"/eb/obj?uploadId={upid}", done_xml)
+            assert st == 200
+            st, _, got = await cli.request("GET", "/eb/obj",
+                                           headers=sse_headers(key))
+            assert st == 200 and got == p1 + p2
+            st, _, got = await cli.request(
+                "GET", "/eb/obj",
+                headers={**sse_headers(key),
+                         "range": "bytes=69998-70001"})
+            assert st == 206 and got == b"AABB"
+            st, _, _ = await cli.request("GET", "/eb/obj")
+            assert st == 400
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
