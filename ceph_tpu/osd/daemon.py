@@ -884,8 +884,13 @@ class OSDDaemon:
     async def _merge_meta(self, cid, parent_ps: int) -> None:
         """Fold a child META collection: snap-mapper keys merge into
         the parent's mapper, every OTHER meta object (hitset archives
-        etc.) moves across wholesale; only the child's pg_log is
-        dropped (see _merge_pgs)."""
+        etc.) moves across wholesale; the child's pg_log is dropped
+        (the reference's merge_from empties the result log too,
+        PGLog.h:791) but its reqid -> obj_version dedup pairs fold
+        into the parent's _merged_reqids sidecar so client replays of
+        the child's recent ops still answer from history.  Every
+        replica folds identical clean child state, so the sidecar is
+        bit-identical across the acting set."""
         pcid = pg_log.meta_cid(cid.pool, parent_ps)
         tx = StoreTx()
         try:
@@ -900,8 +905,38 @@ class OSDDaemon:
         if mapper:
             tx.touch(pcid, snaps.mapper_oid(cid.pool))
             tx.omap_setkeys(pcid, snaps.mapper_oid(cid.pool), mapper)
+        merged = pg_log.read_merged_reqids(self.store, cid.pool,
+                                           parent_ps)
+        merged.update(pg_log.read_merged_reqids(self.store, cid.pool,
+                                                cid.pg))
+        entries, _ = pg_log.read_log(self.store, cid.pool, cid.pg)
+        # fresh child-log pairs get ordinals past everything inherited,
+        # in child seq order — the eviction cap then drops oldest-first
+        nxt = max((o for o, _ in merged.values()), default=0) + 1
+        for s in sorted(entries):          # final entry per reqid wins
+            if entries[s].reqid:
+                merged[entries[s].reqid] = (nxt, entries[s].obj_version)
+                nxt += 1
+        if merged:
+            if len(merged) > pg_log.MERGED_REQIDS_CAP:
+                keep = sorted(merged, key=lambda r: (merged[r], r)
+                              )[-pg_log.MERGED_REQIDS_CAP:]
+                merged = {r: merged[r] for r in keep}
+            moid = pg_log.merged_reqids_oid(cid.pool)
+            tx.touch(pcid, moid)
+            tx.omap_setkeys(pcid, moid, {
+                r: f"{o},{v}".encode()
+                for r, (o, v) in merged.items()})
+            # the parent usually keeps its interval across the fold
+            # (same acting set), so activation won't reload: feed the
+            # live index directly too
+            ppg = self.pgs.get(PGId(cid.pool, parent_ps))
+            if ppg is not None:
+                for rid, (_, v) in merged.items():
+                    ppg.reqid_index.setdefault(rid, (0, v))
         skip = {pg_log.meta_oid(cid.pool).key(),
-                snaps.mapper_oid(cid.pool).key()}
+                snaps.mapper_oid(cid.pool).key(),
+                pg_log.merged_reqids_oid(cid.pool).key()}
         for oid in list(self.store.list_objects(cid)):
             if oid.key() not in skip \
                     and not self.store.exists(pcid, oid):
@@ -946,7 +981,13 @@ class OSDDaemon:
         client replay dedup keeps working for moved objects, and the
         foreign entries age out with normal trimming."""
         entries, tail = pg_log.read_log(self.store, pool_id, ps)
-        if not entries and not tail:
+        try:
+            sidecar = self.store.omap_get(
+                pg_log.meta_cid(pool_id, ps),
+                pg_log.merged_reqids_oid(pool_id))
+        except KeyError:
+            sidecar = {}
+        if not entries and not tail and not sidecar:
             return
         children = [c for c in range(old_n, new_n)
                     if split_parent(c, old_n) == ps]
@@ -961,6 +1002,12 @@ class OSDDaemon:
                 pg_log.append_ops(tx, pool_id, child_ps, e)
             tx.setattr(ccid, pg_log.meta_oid(pool_id),
                        pg_log.TAIL_ATTR, str(tail).encode())
+            if sidecar:
+                # merge-preserved dedup follows the log copy: replays
+                # of pre-merge ops keep answering after a re-split
+                moid = pg_log.merged_reqids_oid(pool_id)
+                tx.touch(ccid, moid)
+                tx.omap_setkeys(ccid, moid, dict(sidecar))
         if len(tx):
             await self.store.queue_transactions(tx)
 
@@ -1312,6 +1359,10 @@ class OSDDaemon:
             entries, _ = pg_log.read_log(self.store, pg.pgid.pool,
                                          pg.pgid.ps)
             pg.rebuild_reqid_index(entries)
+            for rid, (_, v) in pg_log.read_merged_reqids(
+                    self.store, pg.pgid.pool, pg.pgid.ps).items():
+                # merge-preserved dedup: seq 0 so live entries win
+                pg.reqid_index.setdefault(rid, (0, v))
             for shard, osd in pg.acting_peers():
                 self._send_osd(osd, Message("pg_activate", dict(merge),
                                             priority=PRIO_HIGH))

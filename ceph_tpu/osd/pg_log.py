@@ -42,6 +42,39 @@ def meta_oid(pool: int) -> GHObject:
     return GHObject(pool, "_pglog", shard=META_SHARD)
 
 
+def merged_reqids_oid(pool: int) -> GHObject:
+    """Sidecar dedup table for PG merges: the reference empties the
+    merged log (PGLog.h:791 merge_from), losing client-replay dedup for
+    the source's recent ops — here the source's reqid -> obj_version
+    pairs survive the fold in this meta object and feed reqid_index at
+    activation (seq 0, so live log entries always win)."""
+    return GHObject(pool, "_merged_reqids", shard=META_SHARD)
+
+
+MERGED_REQIDS_CAP = 4096
+
+
+def read_merged_reqids(store: ObjectStore, pool: int,
+                       ps: int) -> dict[str, tuple[int, int]]:
+    """reqid -> (fold ordinal, obj_version) pairs preserved across PG
+    merges.  The ordinal is a PG-wide insertion counter (obj_version is
+    per-object, useless for recency) so the eviction cap drops the
+    OLDEST preserved ops, deterministically on every replica."""
+    try:
+        omap = store.omap_get(meta_cid(pool, ps),
+                              merged_reqids_oid(pool))
+    except KeyError:
+        return {}
+    out = {}
+    for k, v in omap.items():
+        try:
+            o, _, ver = v.decode().partition(",")
+            out[k] = (int(o), int(ver))
+        except (TypeError, ValueError, AttributeError):
+            continue
+    return out
+
+
 def seq_key(seq: int) -> str:
     """The omap key for a seq (zero-padded: ordered scan = log order)."""
     return f"{seq:016d}"

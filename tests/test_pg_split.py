@@ -893,3 +893,124 @@ def test_pg_merge_gate_blocks_on_unsettled_signals():
         finally:
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_pg_merge_preserves_replay_dedup():
+    """The source PG's reqid -> version pairs survive the fold (the
+    _merged_reqids sidecar): a client replay of a pre-merge mutation is
+    answered from history by the merged parent, never re-executed."""
+    async def run():
+        from ceph_tpu.msg import Message
+
+        mon, osds, rados = await start_cluster()
+        try:
+            r = await rados.mon_command("osd pool create", pool="d",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            pool_id = next(p.pool_id for p in
+                           rados.monc.osdmap.pools.values()
+                           if p.name == "d")
+            io = await rados.open_ioctx("d")
+            await io.write_full("warm", b"w")      # pool fully peered
+            oid = "dd-1"                           # ps 5 under 8 -> 1
+            assert object_to_ps(oid, 8) == 5
+
+            async def send_raw(ops, reqid, pg_num):
+                m = rados.monc.osdmap
+                ps = object_to_ps(oid, pg_num)
+                _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+                obj = rados.objecter
+                await obj._ensure_osd_auth(primary,
+                                           m.osds[primary].addr)
+                obj._tid += 1
+                tid = obj._tid
+                fut = asyncio.get_running_loop().create_future()
+                obj._inflight[tid] = (fut, primary)
+                await obj.msgr.send_to(
+                    m.osds[primary].addr,
+                    Message("osd_op", {
+                        "tid": tid, "pool": pool_id, "ps": ps,
+                        "oid": oid, "epoch": m.epoch, "ops": ops,
+                        "reqid": reqid,
+                    }), f"osd.{primary}")
+                return await asyncio.wait_for(fut, 10.0)
+
+            r1 = await send_raw([{"op": "writefull", "data": b"A"}],
+                                "client.99:7", 8)
+            assert r1["rc"] == 0, r1
+            await io.write_full(oid, b"B")         # later state
+
+            r = await rados.mon_command("osd pool set", pool="d",
+                                        var="pgp_num", val="4")
+            assert r["rc"] == 0, r
+            await _wait_clean(rados, "d")
+            r = await rados.mon_command("osd pool set", pool="d",
+                                        var="pg_num", val="4")
+            assert r["rc"] == 0, r
+            deadline = asyncio.get_running_loop().time() + 30
+            while any(cid.pg >= 4
+                      for osd in osds
+                      for cid in osd.store.list_collections()
+                      if cid.pool == pool_id):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+            await _wait_clean(rados, "d")
+
+            # drop in-memory completed-op caches so the answer can only
+            # come from the fold-preserved dedup table
+            for osd in osds:
+                osd._reqid_replies.clear()
+                osd._reqid_order.clear()
+
+            r2 = await send_raw([{"op": "writefull", "data": b"A"}],
+                                "client.99:7", 4)
+            assert r2["rc"] == 0, r2
+            assert r2["version"] == r1["version"], (r1, r2)
+            assert await io.read(oid) == b"B"      # never re-executed
+
+            # grow back 4->8: children inherit the sidecar with the
+            # log copy, so the replay still answers after a re-split
+            for var, val in (("pg_num", "8"), ("pgp_num", "8")):
+                r = await rados.mon_command("osd pool set", pool="d",
+                                            var=var, val=val)
+                assert r["rc"] == 0, r
+            await _wait_clean(rados, "d")
+            for osd in osds:
+                osd._reqid_replies.clear()
+                osd._reqid_order.clear()
+            r2b = await send_raw([{"op": "writefull", "data": b"A"}],
+                                 "client.99:7", 8)
+            assert r2b["rc"] == 0 and                 r2b["version"] == r1["version"], (r1, r2b)
+            assert await io.read(oid) == b"B"
+            for var, val in (("pgp_num", "4"),):
+                r = await rados.mon_command("osd pool set", pool="d",
+                                            var=var, val=val)
+                assert r["rc"] == 0, r
+            await _wait_clean(rados, "d")
+            r = await rados.mon_command("osd pool set", pool="d",
+                                        var="pg_num", val="4")
+            assert r["rc"] == 0, r
+            await _wait_clean(rados, "d")
+
+            # restart the parent's primary: activation must reload the
+            # sidecar from disk and keep answering the replay
+            from ceph_tpu.osd.daemon import OSDDaemon
+            from tests.test_services import fast_conf
+            m = rados.monc.osdmap
+            _, _, _, prim = m.pg_to_up_acting(pool_id,
+                                              object_to_ps(oid, 4))
+            await osds[prim].shutdown()
+            revived = OSDDaemon(prim, {"a": "local://mon.a"},
+                                fast_conf(), store=osds[prim].store,
+                                host=f"h{prim}")
+            await revived.start()
+            osds[prim] = revived
+            await _wait_clean(rados, "d")
+            r3 = await send_raw([{"op": "writefull", "data": b"A"}],
+                                "client.99:7", 4)
+            assert r3["rc"] == 0, r3
+            assert r3["version"] == r1["version"], (r1, r3)
+            assert await io.read(oid) == b"B"
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
