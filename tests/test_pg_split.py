@@ -206,7 +206,7 @@ def test_split_after_restart():
                            if pl.name == "data")
             # map processing + split are asynchronous to boot: poll
             # instead of a fixed sleep (a loaded host lags arbitrarily)
-            deadline = asyncio.get_running_loop().time() + 40
+            deadline = asyncio.get_running_loop().time() + 120
             while True:
                 try:
                     checked = 0
@@ -224,7 +224,7 @@ def test_split_after_restart():
                         raise
                     await asyncio.sleep(0.2)
             # and the data serves
-            deadline = asyncio.get_running_loop().time() + 40
+            deadline = asyncio.get_running_loop().time() + 120
             while True:
                 try:
                     for key, val in model.items():
@@ -810,7 +810,7 @@ def test_pg_merge_survives_restart():
             pool_id = next(p.pool_id for p in
                            rados.monc.osdmap.pools.values()
                            if p.name == "mr")
-            deadline = asyncio.get_running_loop().time() + 40
+            deadline = asyncio.get_running_loop().time() + 120
             while True:
                 stale = [cid for cid in osd2.store.list_collections()
                          if cid.pool == pool_id and cid.pg >= 4]
@@ -819,7 +819,7 @@ def test_pg_merge_survives_restart():
                 assert asyncio.get_running_loop().time() < deadline, \
                     stale
                 await asyncio.sleep(0.2)
-            deadline = asyncio.get_running_loop().time() + 40
+            deadline = asyncio.get_running_loop().time() + 120
             while True:
                 try:
                     for key, val in model.items():
