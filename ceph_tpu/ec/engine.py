@@ -109,6 +109,7 @@ class BitplaneEngine:
         self._cache: dict[bytes, jax.Array] = {}
         self._np_cache: dict[bytes, np.ndarray] = {}
         self._pallas_cache: dict[bytes, object] = {}
+        self._grouped_cache: dict[bytes, object] = {}
         self.use_pallas = (
             _default_use_pallas() if use_pallas is None else use_pallas
         )
@@ -145,6 +146,20 @@ class BitplaneEngine:
 
         return self._cached(self._pallas_cache, coeff, PallasBitplaneApply)
 
+    def _grouped_applier(self, coeff: np.ndarray):
+        """Sparse-grouped applier for repair operators, or None when the
+        matrix is too dense/small for grouping to pay (cached either way)."""
+        from ceph_tpu.ec.pallas_kernels import GroupedPlan, PallasGroupedApply
+
+        def factory(c):
+            plan = GroupedPlan(c)
+            if not plan.profitable:
+                return _NOT_GROUPABLE
+            return PallasGroupedApply(c, plan=plan)
+
+        hit = self._cached(self._grouped_cache, coeff, factory)
+        return None if hit is _NOT_GROUPABLE else hit
+
     def apply(self, coeff: np.ndarray, data) -> jax.Array:
         """Apply a GF(2^8) coefficient matrix (m, k) to data (B, k, C)."""
         from ceph_tpu.ec.pallas_kernels import (
@@ -154,12 +169,12 @@ class BitplaneEngine:
 
         coeff = np.asarray(coeff, np.uint8)
         data = jnp.asarray(data, jnp.uint8)
-        if (
-            self.use_pallas
-            and data.shape[-1] % LANE_BYTES == 0
-            and shard_kernel_supported(coeff.shape[1], coeff.shape[0])
-        ):
-            return self._pallas_applier(coeff)(data)
+        if self.use_pallas and data.shape[-1] % LANE_BYTES == 0:
+            grouped = self._grouped_applier(coeff)
+            if grouped is not None:
+                return grouped(data)
+            if shard_kernel_supported(coeff.shape[1], coeff.shape[0]):
+                return self._pallas_applier(coeff)(data)
         mat = self._device_bitmatrix(coeff)
         if data.ndim == 2:
             return _apply_bitmatrix(mat, data[None])[0]
@@ -178,10 +193,12 @@ class BitplaneEngine:
         )
 
         coeff = np.asarray(coeff, np.uint8)
-        if self.use_pallas and shard_kernel_supported(
-            coeff.shape[1], coeff.shape[0]
-        ):
-            return self._pallas_applier(coeff).apply_words(words)
+        if self.use_pallas:
+            grouped = self._grouped_applier(coeff)
+            if grouped is not None:
+                return grouped.apply_words(jnp.asarray(words))
+            if shard_kernel_supported(coeff.shape[1], coeff.shape[0]):
+                return self._pallas_applier(coeff).apply_words(words)
         mat = self._device_bitmatrix(coeff)
         by = words_to_bytes(jnp.asarray(words))
         return bytes_to_words(_apply_bitmatrix(mat, by[None])[0])
@@ -258,6 +275,9 @@ class BitplaneEngine:
         parity = self.apply(generator[k:], data)
         out = jnp.concatenate([data, parity], axis=-2)
         return out[0] if squeeze else out
+
+
+_NOT_GROUPABLE = object()
 
 
 @functools.cache

@@ -126,6 +126,34 @@ def _pick_tile(n4: int, mout: int) -> int:
     return t
 
 
+def _device_cached(np_arr: np.ndarray, slot):
+    """Device copy of a numpy kernel constant.  Outside a trace the copy
+    is cached (returned as the new slot value); under an outer trace it
+    is embedded as a fresh constant and the slot stays untouched (a
+    cached tracer would poison later traces).  Returns (array, slot)."""
+    from ceph_tpu.common.jaxutil import outside_trace
+
+    if not outside_trace():
+        return jnp.asarray(np_arr), slot
+    if slot is None:
+        slot = jnp.asarray(np_arr)
+    return slot, slot
+
+
+def _pick_gtile(n4: int, cmax: int, grp: int) -> int:
+    """Grouped-kernel tile: the dominant VMEM tenants per grid step are
+    the (2, 32*cmax, tile) int8 bit expansion, the (2, cmax, tile) int32
+    data block, and the (2, 32*grp, tile) int32 accumulator — keep their
+    sum near half of the ~16 MiB VMEM."""
+    per_col = 2 * cmax * (32 + 4) + 2 * grp * 32 * 4
+    t = DEFAULT_TILE
+    while t > LANE and per_col * t > (8 << 20):
+        t //= 2
+    while t > LANE and n4 % t:
+        t //= 2
+    return t
+
+
 def bytes_to_words(data) -> jax.Array:
     """(..., N) uint8 -> (..., N/4) int32 lane view (N % 4 == 0)."""
     data = jnp.asarray(data, jnp.uint8)
@@ -140,6 +168,184 @@ def words_to_bytes(words) -> jax.Array:
     """(..., N4) int32 -> (..., 4*N4) uint8, inverse of bytes_to_words."""
     by = jax.lax.bitcast_convert_type(words, jnp.uint8)
     return by.reshape(*words.shape[:-1], words.shape[-1] * LANE_BYTES)
+
+
+def _greedy_groups(nz: np.ndarray, grp_rows: int) -> list[list[int]]:
+    """Partition rows into groups of grp_rows minimizing union supports:
+    seed each group with the unassigned row of largest support, then add
+    the rows whose supports add the fewest new columns."""
+    mout = nz.shape[0]
+    sups = [frozenset(np.nonzero(nz[i])[0]) for i in range(mout)]
+    unassigned = set(range(mout))
+    groups: list[list[int]] = []
+    while unassigned:
+        seed = max(unassigned, key=lambda r: len(sups[r]))
+        unassigned.remove(seed)
+        grp, union = [seed], set(sups[seed])
+        while len(grp) < grp_rows and unassigned:
+            best = min(unassigned, key=lambda r: len(sups[r] - union))
+            unassigned.remove(best)
+            grp.append(best)
+            union |= sups[best]
+        groups.append(grp)
+    return groups
+
+
+class GroupedPlan:
+    """Row-grouped sparse factorization of a GF(2^8) coefficient matrix.
+
+    Repair operators (ceph_tpu.ec.repair_operator) are sparse: CLAY
+    k=8 m=4 d=11 single-chunk repair is a (64, 176) matrix with ~15
+    nonzeros per row (reference repair_one_lost_chunk touches only the
+    d helpers' repair planes plus coupling partners,
+    ErasureCodeClay.cc:462-646).  The dense shard kernel pays the full
+    (32*mout, 32*kin) contraction regardless; grouping rows by shared
+    column support and gathering only those columns cuts the MACs by
+    the density factor while keeping the MXU fed with 128-row tiles.
+    """
+
+    GRP_ROWS = 4        # 4 GF rows -> 128 bit rows: one full MXU tile
+
+    def __init__(self, coeff: np.ndarray):
+        coeff = np.asarray(coeff, np.uint8)
+        self.mout, self.kin = coeff.shape
+        nz = coeff != 0
+        grp = self.GRP_ROWS
+        natural = [list(range(g, min(g + grp, self.mout)))
+                   for g in range(0, self.mout, grp)]
+        greedy = _greedy_groups(nz, grp)
+
+        def cmax_of(groups):
+            return max(
+                max(1, int(nz[g].any(axis=0).sum())) for g in groups
+            )
+
+        groups = min((natural, greedy), key=cmax_of)
+        cmax = -(-cmax_of(groups) // 8) * 8
+        if len(groups) % 2:
+            groups = groups + [[]]      # pair padding (zero group)
+        G = len(groups)
+        # Profitability: grouped MACs vs the dense contraction, AND the
+        # per-pair (2, 32*grp, 32*cmax) bitmatrix block must fit the
+        # VMEM budget (the dense path's _MAX_MATRIX_BYTES analog —
+        # without this, a wide-support sparse matrix would route to a
+        # kernel Mosaic cannot allocate).
+        self.mac_ratio = (G * grp * cmax) / float(self.mout * self.kin)
+        self.profitable = (
+            cmax < self.kin
+            and self.mac_ratio <= 0.6
+            and 2 * 32 * grp * 32 * cmax <= _MAX_MATRIX_BYTES
+        )
+        self.cmax, self.groups = cmax, groups
+        if not self.profitable:
+            return                      # skip the bitmatrix build
+        self.cols = np.zeros((G, cmax), np.int32)     # gathered columns
+        bms = np.zeros((G, 32 * grp, 32 * cmax), np.int8)
+        for gi, rows in enumerate(groups):
+            sup = np.nonzero(nz[rows].any(axis=0))[0] if rows else \
+                np.zeros(0, np.int64)
+            self.cols[gi, :len(sup)] = sup
+            if len(rows) == 0:
+                continue
+            sub = np.zeros((grp, cmax), np.uint8)
+            sub[:len(rows), :len(sup)] = coeff[rows][:, sup]
+            bms[gi] = bm.expand_bitmatrix_lanes(
+                bm.gf_matrix_to_bitmatrix(sub)
+            )
+        self.bms = bms
+        # Real output rows sit at (group, slot) positions; padding slots
+        # (short groups, the pair-padding group) are interleaved.  Map
+        # kernel row order back to caller row order in one gather.
+        real_pos = [gi * grp + j
+                    for gi, rows in enumerate(groups)
+                    for j in range(len(rows))]
+        flat_rows = [r for rows in groups for r in rows]
+        order = np.argsort(np.asarray(flat_rows, np.int64), kind="stable")
+        self.gather_rows = np.asarray(real_pos, np.int64)[order]
+
+
+def _gkernel(bm_ref, data_ref, out_ref, *, grp_rows):
+    d = data_ref[:]                     # (2, cmax, T) int32: two groups
+    _, cin, T = d.shape
+    shift = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 32, 1), 2)
+    bits = ((d[:, :, None, :] >> shift) & 1).reshape(2, cin * 32, T)
+    acc = jax.lax.dot_general(
+        bm_ref[:], bits.astype(jnp.int8),
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )                                   # (2, 32*grp, T)
+    accb = (acc & 1).reshape(2, grp_rows, 32, T)
+    packed = jnp.sum(accb << shift, axis=2)       # (2, grp, T)
+    out_ref[:] = packed.reshape(2 * grp_rows, T)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "grp_rows", "interpret"))
+def _pallas_apply_grouped(bms, gath, *, tile, grp_rows, interpret=False):
+    G, cmax, n4 = gath.shape
+    return pl.pallas_call(
+        functools.partial(_gkernel, grp_rows=grp_rows),
+        grid=(n4 // tile, G // 2),
+        in_specs=[
+            pl.BlockSpec((2, 32 * grp_rows, bms.shape[2]),
+                         lambda t, g: (g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, cmax, tile), lambda t, g: (g, 0, t),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((2 * grp_rows, tile), lambda t, g: (g, t),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((G * grp_rows, n4), jnp.int32),
+        interpret=interpret,
+    )(bms, gath)
+
+
+class PallasGroupedApply:
+    """Sparse-grouped variant of PallasShardApply for repair operators.
+
+    Same external contract ((k, N)/(B, k, C) uint8 in, parity rows out,
+    bit-identical); internally gathers each row group's column support
+    and runs a batched 128-row MXU contraction per group pair.
+    """
+
+    def __init__(self, coeff: np.ndarray, interpret: bool = False,
+                 plan: GroupedPlan | None = None):
+        self.plan = plan or GroupedPlan(coeff)
+        if not self.plan.profitable:
+            raise ValueError("matrix too dense for the grouped kernel")
+        self.mout, self.kin = self.plan.mout, self.plan.kin
+        self._bms_dev: jax.Array | None = None
+        self.interpret = interpret
+
+    def _bms_arg(self):
+        arr, self._bms_dev = _device_cached(self.plan.bms, self._bms_dev)
+        return arr
+
+    def apply_words(self, words) -> jax.Array:
+        """(k, N4) int32 -> (m, N4) int32; pads N4 to a LANE multiple."""
+        kin, n4 = words.shape
+        if kin != self.kin:
+            raise ValueError(f"expected {self.kin} chunk rows, got {kin}")
+        pad = (-n4) % LANE
+        if pad:
+            words = jnp.pad(words, ((0, 0), (0, pad)))
+        gath = words[self.plan.cols]        # (G, cmax, N4)
+        tile = _pick_gtile(n4 + pad, self.plan.cmax, self.plan.GRP_ROWS)
+        out = _pallas_apply_grouped(
+            self._bms_arg(), gath, tile=tile,
+            grp_rows=self.plan.GRP_ROWS, interpret=self.interpret,
+        )
+        out = out[self.plan.gather_rows]
+        return out[:, :n4] if pad else out
+
+    def __call__(self, data) -> jax.Array:
+        data = jnp.asarray(data, jnp.uint8)
+        if data.ndim == 2:
+            return words_to_bytes(self.apply_words(bytes_to_words(data)))
+        batch, kin, C = data.shape
+        flat = jnp.transpose(data, (1, 0, 2)).reshape(kin, batch * C)
+        par = words_to_bytes(self.apply_words(bytes_to_words(flat)))
+        return jnp.transpose(
+            par.reshape(self.mout, batch, C), (1, 0, 2)
+        )
 
 
 class PallasShardApply:
@@ -175,13 +381,8 @@ class PallasShardApply:
         self.interpret = interpret
 
     def _bm32_arg(self):
-        from ceph_tpu.common.jaxutil import outside_trace
-
-        if outside_trace():
-            if self._bm32_dev is None:
-                self._bm32_dev = jnp.asarray(self.bm32)
-            return self._bm32_dev
-        return jnp.asarray(self.bm32)  # constant under an outer trace
+        arr, self._bm32_dev = _device_cached(self.bm32, self._bm32_dev)
+        return arr
 
     def apply_words(self, words) -> jax.Array:
         """(k, N4) int32 -> (m, N4) int32; pads N4 to a LANE multiple."""
