@@ -199,6 +199,26 @@ async def _dispatch(args, rados: Rados) -> int:
         return await _mon(rados, "mds stat", j)
     if cmd == "device":
         return await _mon(rados, "device ls", j)
+    if cmd == "orch":
+        if args.action == "ls":
+            return await _mon(rados, "orch ls", j)
+        if args.action == "ps":
+            return await _mon(rados, "orch ps", j)
+        if args.action == "host":
+            return await _mon(rados, "orch host ls", j)
+        if args.action == "status":
+            return await _mon(rados, "orch status", j)
+        if args.action == "apply":
+            return await _mon(rados, "orch apply", j,
+                              service_type=args.service_type,
+                              count=args.count,
+                              unmanaged=args.unmanaged)
+        if args.action == "rm":
+            return await _mon(rados, "orch rm", j,
+                              service_type=args.service_type)
+        if args.action == "daemon":
+            return await _mon(rados, "orch daemon rm", j,
+                              name=args.name)
     if cmd == "telemetry":
         return await _mon(rados, "telemetry show", j)
     if cmd == "quorum_status":
@@ -546,6 +566,22 @@ def build_parser() -> argparse.ArgumentParser:
     mds.add_argument("action", choices=["stat"])
     dev = sub.add_parser("device")
     dev.add_argument("action", choices=["ls"])
+    orch = sub.add_parser("orch")
+    orch_sub = orch.add_subparsers(dest="action", required=True)
+    orch_sub.add_parser("ls")
+    orch_sub.add_parser("ps")
+    orch_sub.add_parser("status")
+    oh = orch_sub.add_parser("host")
+    oh.add_argument("host_action", choices=["ls"])
+    oa = orch_sub.add_parser("apply")
+    oa.add_argument("service_type", choices=["osd", "mds", "rgw"])
+    oa.add_argument("count", type=int)
+    oa.add_argument("--unmanaged", action="store_true")
+    orm = orch_sub.add_parser("rm")
+    orm.add_argument("service_type")
+    od = orch_sub.add_parser("daemon")
+    od.add_argument("daemon_action", choices=["rm"])
+    od.add_argument("name")
     tel = sub.add_parser("telemetry")
     tel.add_argument("action", choices=["show"])
     logp = sub.add_parser("log")

@@ -28,6 +28,14 @@ from ceph_tpu.msg.codec import decode, encode
 
 PREFIX = "mgrstat"
 
+# One definition of the orch <-> config-key store contract: the mon
+# writes specs/tombstones here, the mgr orchestrator module reads them
+# back via config-key commands.
+from ceph_tpu.mon.config_monitor import KEY_PREFIX as CONFKEY_PREFIX
+
+ORCH_SPEC_PREFIX = "orch/spec/"
+ORCH_RM_PREFIX = "orch/rm/"
+
 
 class MgrStatMonitor(PaxosService):
     prefix = PREFIX
@@ -94,9 +102,110 @@ class MgrStatMonitor(PaxosService):
             }
         return checks
 
+    # -- orch surface ------------------------------------------------------
+    # ``ceph orch`` commands (reference src/pybind/mgr/orchestrator
+    # module.py command handlers): specs persist as orch/spec/<type>
+    # keys in the config-key store; the mgr orchestrator module
+    # (services/orchestrator.py, which imports THESE constants)
+    # reconciles and reports inventory through the digest.
+    _ORCH_SPEC_PREFIX = ORCH_SPEC_PREFIX
+    _ORCH_RM_PREFIX = ORCH_RM_PREFIX
+    _CONFKEY = CONFKEY_PREFIX
+
+    def _orch_specs(self) -> dict[str, dict]:
+        import json
+
+        specs = {}
+        for key in self.store.keys(self._CONFKEY):
+            if not key.startswith(self._ORCH_SPEC_PREFIX):
+                continue
+            raw = self.store.get(self._CONFKEY, key)
+            try:
+                specs[key[len(self._ORCH_SPEC_PREFIX):]] = \
+                    json.loads((raw or b"{}").decode())
+            except ValueError:
+                continue
+        return specs
+
+    def _orch_preprocess(self, cmd: dict) -> CommandResult | None:
+        name = cmd.get("prefix", "")
+        orch = self.digest.get("orchestrator", {})
+        if name == "orch ls":
+            daemons = orch.get("daemons", [])
+            out = {}
+            for stype, spec in sorted(self._orch_specs().items()):
+                out[stype] = {
+                    "service_type": stype,
+                    "target": 0 if spec.get("deleted")
+                    else int(spec.get("count", 0)),
+                    "running": sum(1 for d in daemons
+                                   if d.get("type") == stype),
+                    "unmanaged": bool(spec.get("unmanaged")),
+                    "deleted": bool(spec.get("deleted")),
+                }
+            return CommandResult(data=out)
+        if name == "orch ps":
+            return CommandResult(data=orch.get("daemons", []))
+        if name == "orch host ls":
+            return CommandResult(data=orch.get("hosts", []))
+        if name == "orch status":
+            return CommandResult(data={
+                "available": bool(orch.get("available")),
+                "backend": "devcluster" if orch.get("available")
+                else None,
+                "last_actions": orch.get("last_actions", []),
+            })
+        return None
+
+    def _orch_prepare(self, cmd: dict, tx: StoreTransaction
+                      ) -> CommandResult | None:
+        import json
+
+        name = cmd.get("prefix", "")
+        if name == "orch apply":
+            stype = str(cmd.get("service_type", ""))
+            if stype not in ("osd", "mds", "rgw"):
+                return CommandResult(
+                    EINVAL_RC, f"unknown service type {stype!r}")
+            try:
+                count = int(cmd.get("count", 0))
+            except (TypeError, ValueError):
+                return CommandResult(EINVAL_RC, "count must be an int")
+            if count < 0 or count > 1000:
+                return CommandResult(EINVAL_RC,
+                                     f"count {count} out of range")
+            spec = {"service_type": stype, "count": count,
+                    "unmanaged": bool(cmd.get("unmanaged", False))}
+            tx.put(self._CONFKEY, self._ORCH_SPEC_PREFIX + stype,
+                   json.dumps(spec).encode())
+            return CommandResult(
+                outs=f"Scheduled {stype} update (count {count})")
+        if name == "orch rm":
+            stype = str(cmd.get("service_type", ""))
+            specs = self._orch_specs()
+            if stype not in specs:
+                return CommandResult(ENOENT_RC,
+                                     f"no spec for {stype!r}")
+            spec = dict(specs[stype])
+            spec["deleted"] = True
+            spec["unmanaged"] = False
+            tx.put(self._CONFKEY, self._ORCH_SPEC_PREFIX + stype,
+                   json.dumps(spec).encode())
+            return CommandResult(outs=f"Removing service {stype}")
+        if name == "orch daemon rm":
+            dname = str(cmd.get("name", ""))
+            if "." not in dname:
+                return CommandResult(
+                    EINVAL_RC, f"bad daemon name {dname!r}")
+            tx.put(self._CONFKEY, self._ORCH_RM_PREFIX + dname, b"1")
+            return CommandResult(outs=f"Scheduled removal of {dname}")
+        return None
+
     # -- commands ----------------------------------------------------------
     def preprocess_command(self, cmd: dict) -> CommandResult | None:
         name = cmd.get("prefix", "")
+        if name.startswith("orch"):
+            return self._orch_preprocess(cmd)
         if name == "pg stat":
             return CommandResult(data=self.pgmap_summary())
         if name == "balancer status":
@@ -141,6 +250,10 @@ class MgrStatMonitor(PaxosService):
     def prepare_command(self, cmd: dict, tx: StoreTransaction
                         ) -> CommandResult:
         name = cmd.get("prefix", "")
+        if name.startswith("orch"):
+            r = self._orch_prepare(cmd, tx)
+            if r is not None:
+                return r
         if name == "mgr report":
             digest = cmd.get("digest")
             if not isinstance(digest, dict):

@@ -43,9 +43,11 @@ class Mgr:
         self._futures: dict[int, asyncio.Future] = {}
         self.admin_socket = None
         if modules is None:
+            from ceph_tpu.services.orchestrator import Orchestrator
+
             modules = [Balancer(self), PGAutoscaler(self),
                        Progress(self), DeviceHealth(self),
-                       Telemetry(self)]
+                       Telemetry(self), Orchestrator(self)]
         self.modules = {m.name: m for m in modules}
         self.last_digest: dict | None = None
 

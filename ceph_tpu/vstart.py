@@ -123,6 +123,21 @@ class DevCluster:
         return MemStore()
 
     async def start_osd(self, osd_id: int) -> OSDDaemon:
+        entity = f"osd.{osd_id}"
+        if self.cephx and entity not in self._entity_keys:
+            # an OSD created after bootstrap (orchestrator scale-up,
+            # tests adding daemons) mints its key on demand like
+            # start_mds/start_mgr do
+            admin = await self.client()
+            try:
+                r = await admin.mon_command(
+                    "auth get-or-create", entity=entity,
+                    caps={"mon": "allow r", "osd": "allow *"},
+                )
+                assert r["rc"] == 0, r
+                self._entity_keys[entity] = r["data"]["key"]
+            finally:
+                await admin.shutdown()
         store = self._osd_stores.setdefault(
             osd_id, self._make_osd_store(osd_id)
         )
@@ -190,11 +205,15 @@ class DevCluster:
     async def start_mgr(self, name: str = "x",
                         report_interval: float = 0.2,
                         dashboard: bool = False,
-                        dashboard_port: int = 0):
+                        dashboard_port: int = 0,
+                        orchestrate: bool = False):
         """Boot a manager that aggregates OSD pg stats into the PGMap
         digest and pushes it to the mon (the mgr daemon role).
         ``dashboard``: also serve the read-only HTTP status page +
-        /api/status + /metrics (mgr.dashboard holds (host, port))."""
+        /api/status + /metrics (mgr.dashboard holds (host, port)).
+        ``orchestrate``: attach this DevCluster as the orchestrator
+        backend (the cephadm role — ``ceph orch apply`` then really
+        creates/removes daemons in this cluster)."""
         import asyncio
 
         from ceph_tpu.services.mgr import Mgr
@@ -209,6 +228,11 @@ class DevCluster:
             self._entity_keys[entity] = r["data"]["key"]
             await admin.shutdown()
         mgr = Mgr(self.monmap, self.conf_for(entity), name=entity)
+        if orchestrate:
+            from ceph_tpu.services.orchestrator import DevClusterBackend
+
+            mgr.modules["orchestrator"].backend = \
+                DevClusterBackend(self)
         await mgr.start()
         mgr._report_task = asyncio.get_running_loop().create_task(
             mgr.report_loop(report_interval)
@@ -243,6 +267,10 @@ class DevCluster:
         fe = S3Frontend(gw, users=users, host=host, port=port)
         await fe.start()
         fe._rados = rados
+        # stable daemon identity: list positions shift on removal, so
+        # the orchestrator names rgw daemons by this monotonic id
+        self._rgw_seq = getattr(self, "_rgw_seq", -1) + 1
+        fe._orch_id = self._rgw_seq
         self.rgws.append(fe)
         return fe, users
 

@@ -97,6 +97,16 @@ def test_cli_end_to_end(tmp_path, capsys):
         assert rc == 0 and dst.read_bytes() == b"cli-payload"
         rc, out = await ceph("--format", "json", "osd", "stat")
         assert rc == 0 and json.loads(out)["num_up_osds"] == 3
+        # orch surface (no backend attached: specs store fine, status
+        # reports unavailable)
+        rc, out = await ceph("orch", "apply", "osd", "3")
+        assert rc == 0
+        rc, out = await ceph("--format", "json", "orch", "ls")
+        assert rc == 0 and json.loads(out)["osd"]["target"] == 3
+        rc, out = await ceph("--format", "json", "orch", "status")
+        assert rc == 0 and json.loads(out)["available"] is False
+        rc, out = await ceph("orch", "rm", "osd")
+        assert rc == 0
         await cluster.stop()
     asyncio.run(run())
 

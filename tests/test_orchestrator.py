@@ -1,0 +1,248 @@
+"""Orchestrator module: ``ceph orch`` declarative service placement.
+
+Reference src/pybind/mgr/orchestrator (command surface + ServiceSpec
+store) and src/pybind/mgr/cephadm (the converging serve loop).  Specs
+persist in the mon config-key store; the mgr module reconciles the
+DevCluster (the cephadm-on-localhost backend) onto them.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _wait(pred, timeout=30.0, what=""):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        r = await pred()
+        if r:
+            return r
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"timed out waiting for {what}"
+        await asyncio.sleep(0.2)
+
+
+def test_orch_apply_scales_osds_up_and_down():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            await cluster.start_mgr(orchestrate=True)
+
+            r = await rados.mon_command("orch status")
+            assert r["rc"] == 0
+            # status reflects availability once a digest landed
+            await _wait(lambda: _status_available(rados),
+                        what="orch backend availability")
+
+            # scale up: 3 -> 5 OSDs, created by the reconciler
+            r = await rados.mon_command("orch apply",
+                                        service_type="osd", count=5)
+            assert r["rc"] == 0, r
+            await _wait(lambda: _n_osds_up(rados, 5),
+                        what="scale-up to 5 osds")
+            assert set(cluster.osds) == {0, 1, 2, 3, 4}
+
+            # orch ls shows target vs running converged
+            r = await rados.mon_command("orch ls")
+            assert r["rc"] == 0
+            await _wait(lambda: _ls_running(rados, "osd", 5),
+                        what="orch ls running count")
+
+            # scale down: 5 -> 4 removes the newest daemon
+            r = await rados.mon_command("orch apply",
+                                        service_type="osd", count=4)
+            assert r["rc"] == 0, r
+            await _wait(lambda: _cluster_osds(cluster, 4),
+                        what="scale-down to 4 osds")
+            assert 4 not in cluster.osds
+
+            # orch ps lists daemons incl. the mgr itself
+            r = await rados.mon_command("orch ps")
+            names = {d["name"] for d in r["data"]}
+            assert "osd.0" in names and "mgr.x" in names
+            r = await rados.mon_command("orch host ls")
+            assert "host0" in r["data"]
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+async def _status_available(rados):
+    r = await rados.mon_command("orch status")
+    return r["rc"] == 0 and r["data"]["available"]
+
+
+async def _n_osds_up(rados, n):
+    r = await rados.mon_command("status")
+    return r["rc"] == 0 and r["data"]["osdmap"]["num_up_osds"] == n
+
+
+async def _ls_running(rados, stype, n):
+    r = await rados.mon_command("orch ls")
+    row = (r["data"] or {}).get(stype)
+    return r["rc"] == 0 and row and row["running"] == n \
+        and row["target"] == n
+
+
+async def _cluster_osds(cluster, n):
+    return len(cluster.osds) == n
+
+
+def test_orch_managed_daemon_rm_is_healed_unmanaged_is_not():
+    """``orch daemon rm`` removes a daemon; a managed spec re-creates
+    it next cycle (the cephadm convergence property), an unmanaged spec
+    leaves the gap alone."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            await cluster.start_mgr(orchestrate=True)
+            r = await rados.mon_command("orch apply",
+                                        service_type="osd", count=3)
+            assert r["rc"] == 0, r
+            await _wait(lambda: _status_available(rados),
+                        what="backend")
+
+            # managed: removal is healed (a new osd id appears)
+            r = await rados.mon_command("orch daemon rm", name="osd.1")
+            assert r["rc"] == 0, r
+
+            async def healed():
+                return 1 not in cluster.osds and len(cluster.osds) == 3
+
+            await _wait(healed, what="osd.1 removed and healed back")
+
+            # unmanaged: removal sticks
+            r = await rados.mon_command("orch apply",
+                                        service_type="osd", count=3,
+                                        unmanaged=True)
+            assert r["rc"] == 0, r
+            await asyncio.sleep(0.5)          # let the spec land
+            victim = max(cluster.osds)
+            r = await rados.mon_command("orch daemon rm",
+                                        name=f"osd.{victim}")
+            assert r["rc"] == 0, r
+            await _wait(lambda: _cluster_osds(cluster, 2),
+                        what="unmanaged removal")
+            for _ in range(5):
+                await asyncio.sleep(0.2)
+                assert len(cluster.osds) == 2   # stays removed
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_orch_rm_drains_service_and_spec_survives_mgr_restart():
+    """``orch rm`` drains a service to zero then retires the spec; a
+    spec survives a mgr restart (it lives in the mon config-key store,
+    not mgr memory)."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=2)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            mgr = await cluster.start_mgr(orchestrate=True)
+            r = await rados.mon_command("orch apply",
+                                        service_type="osd", count=4)
+            assert r["rc"] == 0, r
+            await _wait(lambda: _cluster_osds(cluster, 4),
+                        what="scale to 4")
+
+            # mgr restart: spec persists mon-side, reconcile resumes
+            task = mgr._report_task
+            task.cancel()
+            await mgr.shutdown()
+            cluster.mgrs.clear()
+            await cluster.kill_osd(max(cluster.osds))
+            assert len(cluster.osds) == 3
+            await cluster.start_mgr(orchestrate=True)
+            await _wait(lambda: _cluster_osds(cluster, 4),
+                        what="re-converged after mgr restart")
+
+            # drain the whole service
+            r = await rados.mon_command("orch rm", service_type="osd")
+            assert r["rc"] == 0, r
+            await _wait(lambda: _cluster_osds(cluster, 0),
+                        what="drain to zero")
+            # spec retired from the store
+            async def spec_gone():
+                g = await rados.mon_command("config-key ls")
+                return not any(k.startswith("orch/spec/")
+                               for k in g["data"])
+            await _wait(spec_gone, what="spec retirement")
+            # rm of a missing spec errors
+            r = await rados.mon_command("orch rm", service_type="osd")
+            assert r["rc"] != 0
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_orch_scale_up_under_cephx():
+    """Orchestrator-created OSDs mint their cephx keys on demand (the
+    bootstrap in DevCluster.start only covers the initial set)."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=2, cephx=True)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            await cluster.start_mgr(orchestrate=True)
+            r = await rados.mon_command("orch apply",
+                                        service_type="osd", count=3)
+            assert r["rc"] == 0, r
+            await _wait(lambda: _cluster_osds(cluster, 3), timeout=45,
+                        what="cephx scale-up to 3")
+            await _wait(lambda: _n_osds_up(rados, 3), timeout=45,
+                        what="osd.2 authenticated and up")
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_orch_apply_validation():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=1)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("orch apply",
+                                        service_type="mon", count=1)
+            assert r["rc"] != 0
+            r = await rados.mon_command("orch apply",
+                                        service_type="osd", count=-2)
+            assert r["rc"] != 0
+            r = await rados.mon_command("orch apply",
+                                        service_type="osd",
+                                        count="many")
+            assert r["rc"] != 0
+            r = await rados.mon_command("orch daemon rm", name="osd1")
+            assert r["rc"] != 0
+            # without a mgr/backend, orch status reports unavailable
+            r = await rados.mon_command("orch status")
+            assert r["rc"] == 0 and not r["data"]["available"]
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
