@@ -498,12 +498,61 @@ class RGWLite:
                 return True
         return False
 
-    async def _check_bucket(self, bucket: str, need: str) -> dict:
+    async def _check_bucket(self, bucket: str, need: str,
+                            action: str | None = None,
+                            key: str | None = None) -> dict:
+        """ACL + bucket-policy gate (the rgw_op.cc verify_permission
+        order: policy Deny short-circuits, policy Allow grants, no
+        match falls back to the ACL).
+
+        Policy applies ONLY at call sites that name an IAM ``action``
+        (the object data path).  Bucket administration and config ops
+        pass no action and stay owner/ACL-gated: an object-data grant
+        (s3:PutObject on bucket/*) must never open notification/
+        versioning/ACL configuration, and the owner can always delete
+        a bad policy (no lockout)."""
         meta = await self._bucket_meta(bucket)
+        policy = meta.get("policy")
+        if policy is not None and self.user is not None \
+                and action is not None:
+            from ceph_tpu.services import iam
+
+            resource = f"{bucket}/{key}" if key is not None else bucket
+            verdict = iam.evaluate(policy, self.user, action, resource)
+            if verdict == "deny":
+                raise RGWError("AccessDenied",
+                               f"{bucket} ({action} denied by policy)")
+            if verdict == "allow":
+                return meta
         if not self._acl_allows(meta.get("owner", ""),
                                 meta.get("acl", {}), need):
             raise RGWError("AccessDenied", f"{bucket} ({need})")
         return meta
+
+    # -- bucket policy (rgw_iam_policy.cc) ---------------------------------
+    async def put_bucket_policy(self, bucket: str,
+                                policy: str | dict) -> None:
+        from ceph_tpu.services import iam
+
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        try:
+            doc = iam.validate(policy)
+        except iam.PolicyError as e:
+            raise RGWError("MalformedPolicy", str(e)) from None
+        meta["policy"] = doc
+        await self._put_bucket_meta(bucket, meta)
+
+    async def get_bucket_policy(self, bucket: str) -> dict:
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        policy = meta.get("policy")
+        if policy is None:
+            raise RGWError("NoSuchBucketPolicy", bucket)
+        return policy
+
+    async def delete_bucket_policy(self, bucket: str) -> None:
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        meta.pop("policy", None)
+        await self._put_bucket_meta(bucket, meta)
 
     async def put_bucket_acl(self, bucket: str, canned: str = "private",
                              grants: list[dict] | None = None) -> None:
@@ -743,7 +792,8 @@ class RGWLite:
     async def list_object_versions(self, bucket: str,
                                    prefix: str = "") -> list[dict]:
         """Newest-first per key (S3 ListObjectVersions)."""
-        await self._check_bucket(bucket, "READ")
+        await self._check_bucket(bucket, "READ",
+                                 action="s3:ListBucketVersions")
         meta = await self._bucket_meta(bucket)
         try:
             omap = await self.ioctx.get_omap(self._versions_oid(bucket))
@@ -832,7 +882,8 @@ class RGWLite:
         """GET ?versionId= — any stored version, marker or not.
         ``sse_key``: SSE-C decryption, including multipart versions
         whose parts carry their own nonces."""
-        await self._check_bucket(bucket, "READ")
+        await self._check_bucket(bucket, "READ",
+                                 action="s3:GetObjectVersion", key=key)
         entry = await self._lookup_version_entry(bucket, key,
                                                  version_id)
         sse_check(entry, sse_key)
@@ -855,7 +906,8 @@ class RGWLite:
                                   version_id: str) -> dict:
         """HEAD ?versionId=: the version's metadata without reading
         its (possibly huge) body."""
-        await self._check_bucket(bucket, "READ")
+        await self._check_bucket(bucket, "READ",
+                                 action="s3:GetObjectVersion", key=key)
         return await self._lookup_version_entry(bucket, key,
                                                 version_id)
 
@@ -864,7 +916,8 @@ class RGWLite:
         """DELETE ?versionId=: permanently removes that version; when
         it was current, the next-newest version is promoted (markers
         included)."""
-        meta = await self._check_bucket(bucket, "WRITE")
+        meta = await self._check_bucket(
+            bucket, "WRITE", action="s3:DeleteObjectVersion", key=key)
         vkey = self._vkey(key, version_id)
         try:
             kv = await self.ioctx.get_omap(self._versions_oid(bucket),
@@ -931,7 +984,8 @@ class RGWLite:
         """S3 CreateMultipartUpload -> upload id."""
         import secrets as _secrets
 
-        await self._check_bucket(bucket, "WRITE")
+        await self._check_bucket(bucket, "WRITE",
+                                 action="s3:PutObject", key=key)
         upload_id = _secrets.token_hex(8)
         await self.ioctx.operate(
             self._mp_meta_oid(bucket, key, upload_id),
@@ -967,7 +1021,8 @@ class RGWLite:
         boundary resets the counter, so the assembled read can seek)."""
         if not 1 <= part_number <= 10000:
             raise RGWError("InvalidArgument", "part number 1..10000")
-        meta = await self._check_bucket(bucket, "WRITE")
+        meta = await self._check_bucket(
+            bucket, "WRITE", action="s3:PutObject", key=key)
         await self._mp_meta(bucket, key, upload_id)
         await self._check_quota(bucket, meta, len(data),
                                 replaced_size=0, is_replace=False)
@@ -1006,7 +1061,8 @@ class RGWLite:
         (numbers ascending, etags matching), records a MANIFEST entry —
         the object body stays in the part objects, read through the
         manifest like the reference's RGWObjManifest."""
-        await self._check_bucket(bucket, "WRITE")
+        await self._check_bucket(bucket, "WRITE",
+                                 action="s3:PutObject", key=key)
         uploaded = {p["part_number"]: p
                     for p in await self.list_parts(bucket, key,
                                                    upload_id)}
@@ -1126,7 +1182,8 @@ class RGWLite:
 
     async def abort_multipart(self, bucket: str, key: str,
                               upload_id: str) -> None:
-        await self._check_bucket(bucket, "WRITE")
+        await self._check_bucket(
+            bucket, "WRITE", action="s3:AbortMultipartUpload", key=key)
         for p in await self.list_parts(bucket, key, upload_id):
             try:
                 await self.ioctx.remove(self._mp_part_oid(
@@ -1140,7 +1197,8 @@ class RGWLite:
         )
 
     async def list_multipart_uploads(self, bucket: str) -> list[dict]:
-        await self._check_bucket(bucket, "READ")
+        await self._check_bucket(
+            bucket, "READ", action="s3:ListBucketMultipartUploads")
         prefix = f"rgw.multipart.{bucket}/"
         out = []
         for oid in await self.ioctx.list_objects():
@@ -1426,7 +1484,8 @@ class RGWLite:
         mismatch) would otherwise have destroyed a durable object whose
         index entry still stands.  The stream writes to a UNIQUE oid
         and cleanup happens after the index flips to it."""
-        meta = await self._check_bucket(bucket, "WRITE")
+        meta = await self._check_bucket(bucket, "WRITE",
+                                        action="s3:PutObject", key=key)
         index_oid = self._index_oid(bucket)
         existing = await self.ioctx.get_omap(index_oid, [key])
         if if_none_match and existing and \
@@ -1638,7 +1697,8 @@ class RGWLite:
 
     async def _entry(self, bucket: str, key: str,
                      need: str = "READ") -> dict:
-        await self._check_bucket(bucket, need)
+        await self._check_bucket(bucket, need,
+                                 action="s3:GetObject", key=key)
         kv = await self.ioctx.get_omap(self._index_oid(bucket), [key])
         if key not in kv:
             raise RGWError("NoSuchKey", f"{bucket}/{key}")
@@ -1825,7 +1885,8 @@ class RGWLite:
         return await self._entry(bucket, key)
 
     async def delete_object(self, bucket: str, key: str) -> None:
-        meta = await self._check_bucket(bucket, "WRITE")
+        meta = await self._check_bucket(
+            bucket, "WRITE", action="s3:DeleteObject", key=key)
         state = meta.get("versioning", "")
         index_oid = self._index_oid(bucket)
         kv = await self.ioctx.get_omap(index_oid, [key])
@@ -1888,7 +1949,8 @@ class RGWLite:
                            marker: str = "",
                            max_keys: int = 1000) -> dict:
         """S3 ListObjects: sorted, prefix-filtered, marker-paginated."""
-        await self._check_bucket(bucket, "READ")
+        await self._check_bucket(bucket, "READ",
+                                 action="s3:ListBucket")
         index = await self.ioctx.get_omap(self._index_oid(bucket))
         contents = []
         truncated = False

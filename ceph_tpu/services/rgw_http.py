@@ -59,6 +59,8 @@ _STATUS = {
     "NoSuchVersion": 404,
     "NoSuchUpload": 404,
     "NoSuchLifecycleConfiguration": 404,
+    "NoSuchBucketPolicy": 404,
+    "MalformedPolicy": 400,
     "BucketNotEmpty": 409,
     "BucketAlreadyExists": 409,
     "PreconditionFailed": 412,
@@ -493,6 +495,12 @@ class S3Frontend:
                 rules = _parse_lifecycle(req.body)
                 await gw.put_lifecycle(bucket, rules)
                 return 200, {}, b""
+            if "policy" in q:
+                # PutBucketPolicy: the body is the JSON document
+                # itself; bytes go straight to validate (a non-UTF-8
+                # body is MalformedPolicy, not a decode crash)
+                await gw.put_bucket_policy(bucket, req.body)
+                return 204, {}, b""
             if "acl" in q:
                 canned = req.header("x-amz-acl", "private")
                 await gw.put_bucket_acl(bucket, canned)
@@ -522,10 +530,15 @@ class S3Frontend:
             if "lifecycle" in q:
                 await gw.delete_lifecycle(bucket)
                 return 204, {}, b""
+            if "policy" in q:
+                await gw.delete_bucket_policy(bucket)
+                return 204, {}, b""
             await gw.delete_bucket(bucket)
             return 204, {}, b""
         if req.method == "HEAD":
-            await gw._check_bucket(bucket, "READ")
+            # S3 HeadBucket requires s3:ListBucket
+            await gw._check_bucket(bucket, "READ",
+                                   action="s3:ListBucket")
             return 200, {}, b""
         if req.method == "POST" and "delete" in q:
             return await self._bulk_delete(req, gw, bucket)
@@ -573,6 +586,12 @@ class S3Frontend:
                 for e in c.get("events", ()):
                     ET.SubElement(tc, "Event").text = e
             return self._xml(root)
+        if "policy" in q:
+            import json as _json
+
+            policy = await gw.get_bucket_policy(bucket)
+            return 200, {"content-type": "application/json"}, \
+                _json.dumps(policy).encode()
         if "acl" in q:
             acl = await gw.get_bucket_acl(bucket)
             root = ET.Element("AccessControlPolicy", xmlns=XMLNS)

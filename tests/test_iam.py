@@ -1,0 +1,302 @@
+"""Bucket policies: IAM document validation + evaluation + enforcement.
+
+Reference src/rgw/rgw_iam_policy.{h,cc} (policy parse/eval) and the
+rgw_op.cc verify_permission order: explicit Deny short-circuits,
+policy Allow grants without consulting ACLs, no match falls back to
+the ACL path.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services import iam
+from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+# -- unit: validation ------------------------------------------------------
+
+def _doc(*stmts):
+    return {"Version": "2012-10-17", "Statement": list(stmts)}
+
+
+def test_validate_rejects_unsupported_and_malformed():
+    bad = [
+        "not json {",
+        {"Statement": []},
+        _doc({"Effect": "Maybe", "Principal": "*",
+              "Action": "s3:GetObject", "Resource": "b/*"}),
+        # Condition must be rejected, not ignored (silently ignoring
+        # would over-grant)
+        _doc({"Effect": "Allow", "Principal": "*",
+              "Action": "s3:GetObject", "Resource": "b/*",
+              "Condition": {"IpAddress": {"aws:SourceIp": "1.2.3.4"}}}),
+        _doc({"Effect": "Allow", "Principal": "*",
+              "Action": "s3:LaunchRocket", "Resource": "b/*"}),
+        _doc({"Effect": "Allow", "Principal": "*",
+              "Action": "s3:GetObject"}),                 # no Resource
+        _doc({"Effect": "Allow", "Principal": "*",
+              "Action": "s3:GetObject", "NotAction": "s3:PutObject",
+              "Resource": "b/*"}),                        # both
+        _doc({"Effect": "Allow", "Principal": {"Service": "ec2"},
+              "Action": "s3:GetObject", "Resource": "b/*"}),
+    ]
+    for doc in bad:
+        with pytest.raises(iam.PolicyError):
+            iam.validate(doc)
+    ok = _doc({"Effect": "Allow",
+               "Principal": {"AWS": ["arn:aws:iam:::user/alice"]},
+               "Action": ["s3:GetObject", "s3:List*"],
+               "Resource": ["arn:aws:s3:::b", "arn:aws:s3:::b/*"]})
+    assert iam.validate(ok) is ok
+
+
+def test_evaluate_deny_wins_and_wildcards():
+    doc = _doc(
+        {"Effect": "Allow", "Principal": "*",
+         "Action": "s3:*", "Resource": "arn:aws:s3:::b/*"},
+        {"Effect": "Deny",
+         "Principal": {"AWS": ["arn:aws:iam:::user/eve"]},
+         "Action": "s3:GetObject", "Resource": "arn:aws:s3:::b/secret*"},
+    )
+    iam.validate(doc)
+    assert iam.evaluate(doc, "alice", "s3:GetObject", "b/x") == "allow"
+    assert iam.evaluate(doc, "eve", "s3:GetObject", "b/x") == "allow"
+    assert iam.evaluate(doc, "eve", "s3:GetObject",
+                        "b/secret.txt") == "deny"
+    # deny wins over a matching allow
+    assert iam.evaluate(doc, "eve", "s3:PutObject",
+                        "b/secret.txt") == "allow"
+    # unmatched resource falls through
+    assert iam.evaluate(doc, "alice", "s3:GetObject", "c/x") == "default"
+
+
+def test_evaluate_notaction():
+    doc = _doc({"Effect": "Deny", "Principal": "*",
+                "NotAction": "s3:GetObject",
+                "Resource": "arn:aws:s3:::b/*"})
+    iam.validate(doc)
+    assert iam.evaluate(doc, "u", "s3:GetObject", "b/k") == "default"
+    assert iam.evaluate(doc, "u", "s3:PutObject", "b/k") == "deny"
+
+
+def test_validate_rejects_notresource_and_inert_admin_actions():
+    with pytest.raises(iam.PolicyError):
+        iam.validate(_doc({"Effect": "Allow", "Principal": "*",
+                           "Action": "s3:GetObject",
+                           "Resource": "b/*",
+                           "NotResource": "b/secret/*"}))
+    # admin actions are never policy-evaluated -> granting them would
+    # be silently inert, so validation refuses the document
+    with pytest.raises(iam.PolicyError):
+        iam.validate(_doc({"Effect": "Allow", "Principal": "*",
+                           "Action": "s3:PutBucketAcl",
+                           "Resource": "b"}))
+
+
+def test_wildcards_are_star_and_question_only():
+    """AWS policy wildcards: brackets are literal (fnmatch character
+    classes would silently bypass Deny statements)."""
+    doc = _doc(
+        {"Effect": "Allow", "Principal": "*", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::b/*"},
+        {"Effect": "Deny", "Principal": "*", "Action": "s3:GetObject",
+         "Resource": "arn:aws:s3:::b/report[1].pdf"},
+    )
+    iam.validate(doc)
+    assert iam.evaluate(doc, "u", "s3:GetObject",
+                        "b/report[1].pdf") == "deny"
+    assert iam.evaluate(doc, "u", "s3:GetObject",
+                        "b/report1.pdf") == "allow"
+    # ? matches exactly one character
+    q = _doc({"Effect": "Allow", "Principal": "*",
+              "Action": "s3:GetObject",
+              "Resource": "arn:aws:s3:::b/v?.txt"})
+    assert iam.evaluate(q, "u", "s3:GetObject", "b/v1.txt") == "allow"
+    assert iam.evaluate(q, "u", "s3:GetObject",
+                        "b/v12.txt") == "default"
+
+
+# -- integration: RGWLite enforcement --------------------------------------
+
+def test_policy_grants_and_denies_cross_user_access():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("iam", pg_num=8)
+            ioctx = await rados.open_ioctx("iam")
+            users = RGWUsers(ioctx)
+            gw = RGWLite(ioctx, users=users)
+            await users.create("owner")
+            await users.create("alice")
+            await users.create("eve")
+            own = gw.as_user("owner")
+            await own.create_bucket("b")
+            await own.put_object("b", "pub/x", b"data-x")
+            await own.put_object("b", "priv/y", b"data-y")
+
+            alice = gw.as_user("alice")
+            # private bucket: no access without a policy
+            with pytest.raises(RGWError):
+                await alice.get_object("b", "pub/x")
+
+            await own.put_bucket_policy("b", {
+                "Version": "2012-10-17",
+                "Statement": [
+                    {"Effect": "Allow",
+                     "Principal": {"AWS": [
+                         "arn:aws:iam:::user/alice"]},
+                     "Action": ["s3:GetObject", "s3:ListBucket"],
+                     "Resource": ["arn:aws:s3:::b",
+                                  "arn:aws:s3:::b/pub/*"]},
+                    {"Effect": "Deny",
+                     "Principal": {"AWS": [
+                         "arn:aws:iam:::user/owner"]},
+                     "Action": "s3:GetObject",
+                     "Resource": "arn:aws:s3:::b/priv/*"},
+                ],
+            })
+            # alice can read the granted prefix + list, nothing else
+            got = await alice.get_object("b", "pub/x")
+            assert got["data"] == b"data-x"
+            await alice.list_objects("b")
+            with pytest.raises(RGWError):
+                await alice.get_object("b", "priv/y")
+            with pytest.raises(RGWError):
+                await alice.put_object("b", "pub/new", b"nope")
+            # eve (not a principal) still locked out
+            with pytest.raises(RGWError):
+                await gw.as_user("eve").get_object("b", "pub/x")
+            # explicit Deny beats even the bucket owner on the data path
+            with pytest.raises(RGWError):
+                await own.get_object("b", "priv/y")
+            # ... but the owner can always remove the policy (no
+            # lockout: policy admin is never policy-gated)
+            await own.delete_bucket_policy("b")
+            assert (await own.get_object("b", "priv/y"))["data"] == \
+                b"data-y"
+            # malformed documents are rejected
+            with pytest.raises(RGWError):
+                await own.put_bucket_policy("b", "{bad json")
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_policy_delete_and_multipart_actions():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("iam2", pg_num=8)
+            ioctx = await rados.open_ioctx("iam2")
+            users = RGWUsers(ioctx)
+            gw = RGWLite(ioctx, users=users)
+            await users.create("owner")
+            await users.create("bob")
+            own = gw.as_user("owner")
+            await own.create_bucket("m")
+            await own.put_bucket_policy("m", {
+                "Version": "2012-10-17",
+                "Statement": [{
+                    "Effect": "Allow",
+                    "Principal": {"AWS": ["arn:aws:iam:::user/bob"]},
+                    "Action": ["s3:PutObject", "s3:GetObject",
+                               "s3:AbortMultipartUpload"],
+                    "Resource": "arn:aws:s3:::m/*",
+                }],
+            })
+            bob = gw.as_user("bob")
+            await bob.put_object("m", "k", b"bob-data")
+            # object-data grants must NOT open bucket configuration
+            # (policy applies to the data path only; config stays
+            # owner/ACL-gated)
+            with pytest.raises(RGWError):
+                await bob.set_bucket_notifications("m", [])
+            with pytest.raises(RGWError):
+                await bob.put_bucket_versioning("m", True)
+            assert (await bob.get_object("m", "k"))["data"] == \
+                b"bob-data"
+            # s3:DeleteObject was NOT granted
+            with pytest.raises(RGWError):
+                await bob.delete_object("m", "k")
+            # multipart rides PutObject + AbortMultipartUpload
+            up = await bob.initiate_multipart("m", "big")
+            await bob.upload_part("m", "big", up, 1, b"p" * 128)
+            await bob.abort_multipart("m", "big", up)
+            # ListBucket not granted: listing falls to ACL -> denied
+            with pytest.raises(RGWError):
+                await bob.list_objects("m")
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+# -- REST: ?policy subresource ---------------------------------------------
+
+def test_policy_rest_roundtrip():
+    """PUT/GET/DELETE /bucket?policy (S3 PutBucketPolicy family) and
+    cross-user enforcement through the SigV4 frontend."""
+    import json as _json
+
+    from tests.test_rgw_http import S3HttpClient, _frontend
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        try:
+            bob = await users.create("bob")
+            bcli = S3HttpClient(fe.host, fe.port, bob["access_key"],
+                                bob["secret_key"])
+            st, _, _ = await cli.request("PUT", "/pb")
+            assert st == 200
+            st, _, _ = await cli.request("PUT", "/pb/k", b"v")
+            assert st in (200, 201)
+            # bob denied pre-policy
+            st, _, _ = await bcli.request("GET", "/pb/k")
+            assert st == 403
+            doc = {"Version": "2012-10-17", "Statement": [{
+                "Effect": "Allow",
+                "Principal": {"AWS": ["arn:aws:iam:::user/bob"]},
+                "Action": "s3:GetObject",
+                "Resource": "arn:aws:s3:::pb/*",
+            }]}
+            st, _, _ = await cli.request(
+                "PUT", "/pb?policy", _json.dumps(doc).encode())
+            assert st == 204
+            st, _, body = await cli.request("GET", "/pb?policy")
+            assert st == 200 and _json.loads(body)["Statement"]
+            st, _, body = await bcli.request("GET", "/pb/k")
+            assert st == 200 and body == b"v"
+            # still no write grant
+            st, _, _ = await bcli.request("PUT", "/pb/new", b"x")
+            assert st == 403
+            # malformed policy -> 400 MalformedPolicy
+            st, _, body = await cli.request(
+                "PUT", "/pb?policy", b"{not json")
+            assert st == 400 and b"MalformedPolicy" in body
+            # non-UTF-8 body is a client error too, never a 500
+            st, _, body = await cli.request(
+                "PUT", "/pb?policy", b"\xff\xfe{}")
+            assert st == 400 and b"MalformedPolicy" in body
+            st, _, _ = await cli.request("DELETE", "/pb?policy")
+            assert st == 204
+            st, _, _ = await bcli.request("GET", "/pb/k")
+            assert st == 403
+            st, _, body = await cli.request("GET", "/pb?policy")
+            assert st == 404 and b"NoSuchBucketPolicy" in body
+        finally:
+            await fe.stop()
+            from tests.test_services import stop_cluster as _stop
+            await _stop(mon, osds, rados)
+
+    asyncio.run(run())
