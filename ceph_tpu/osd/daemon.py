@@ -2696,10 +2696,60 @@ class OSDDaemon:
                     rebuild.setdefault(name, []).append(shard)
                     target_version[name] = entry.obj_version
 
-        stray_pos: dict[int, int] = {}     # EC position -> stray osd
+        # EC position -> ALL announcing former holders (MissingLoc is a
+        # location SET: a dead/stale first announcer must not mask a
+        # usable second source for the same position)
+        stray_pos: dict[int, list[int]] = {}
         for sosd, sinfo in pg.stray_sources.items():
             for pos in getattr(sinfo, "ec_shards", ()):
-                stray_pos.setdefault(int(pos), sosd)
+                srcs = stray_pos.setdefault(int(pos), [])
+                if sosd not in srcs:
+                    srcs.append(sosd)
+
+        async def stray_read(pos: int, name: str, version: int,
+                             shard_len: int):
+            """Extra decode source for positions the acting set cannot
+            serve (partial-overlap remap): a version-verified read from
+            a former holder, falling through the announcer list.
+            Raises ShardReadError so the backend's retry loop treats
+            an unusable position like any failed shard."""
+            from ceph_tpu.osd.ec_backend import (
+                VERSION_ATTR,
+                ShardReadError,
+            )
+
+            scid = CollectionId(pg.pgid.pool, pg.pgid.ps, int(pos))
+            last = f"shard {pos}: no stray source"
+            for sosd in stray_pos.get(int(pos), ()):
+                try:
+                    full = await self.send_sub_op(
+                        sosd, "read_full", cid=_enc_cid(scid),
+                        oid=name,
+                    )
+                except (KeyError, IOError, ConnectionError) as e:
+                    last = f"shard {pos}: stray osd.{sosd}: {e!r}"
+                    continue
+                try:
+                    sver = int(json.loads(
+                        full["attrs"][VERSION_ATTR])["version"])
+                except (KeyError, ValueError, TypeError):
+                    last = (f"shard {pos}: stray osd.{sosd} "
+                            "corrupt version attr")
+                    continue
+                if version is not None and sver != version:
+                    last = (f"shard {pos}: stray osd.{sosd} stale "
+                            f"version {sver} (want {version})")
+                    continue
+                data = full["data"]
+                if shard_len is not None and len(data) < shard_len:
+                    last = (f"shard {pos}: stray short read "
+                            f"{len(data)} < {shard_len}")
+                    continue
+                import numpy as _np
+
+                return (_np.frombuffer(data[:shard_len], _np.uint8),
+                        dict(full["attrs"]))
+            raise ShardReadError(last)
 
         async def stray_shard_copy(name: str,
                                    shards: list[int]) -> bool:
@@ -2712,15 +2762,19 @@ class OSDDaemon:
                 return False
             for t in shards:
                 scid = CollectionId(pg.pgid.pool, pg.pgid.ps, t)
-                try:
-                    full = await self.send_sub_op(
-                        stray_pos[t], "read_full",
-                        cid=_enc_cid(scid), oid=name,
-                    )
-                except (KeyError, IOError) as e:
-                    log.derr("pg %s: stray copy %s shard %d from "
-                             "osd.%d failed: %r", pg.pgid, name, t,
-                             stray_pos[t], e)
+                full = None
+                for sosd in stray_pos[t]:
+                    try:
+                        full = await self.send_sub_op(
+                            sosd, "read_full",
+                            cid=_enc_cid(scid), oid=name,
+                        )
+                        break
+                    except (KeyError, IOError) as e:
+                        log.derr("pg %s: stray copy %s shard %d from "
+                                 "osd.%d failed: %r", pg.pgid, name,
+                                 t, sosd, e)
+                if full is None:
                     return False
                 obj = GHObject(pg.pgid.pool, name, shard=t)
                 tx = StoreTx()
@@ -2751,6 +2805,8 @@ class OSDDaemon:
                     await pg.backend.recover_shard(
                         name, shards,
                         version=target_version.get(name) or None,
+                        stray_read=stray_read if stray_pos else None,
+                        stray_positions=sorted(stray_pos),
                     )
                     self.perf.inc("recovery_ops")
                     return True

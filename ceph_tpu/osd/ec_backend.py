@@ -862,26 +862,91 @@ class ECBackend:
 
     # -- recovery --------------------------------------------------------
     async def recover_shard(self, oid: str, lost: Sequence[int],
-                            version: int | None = None) -> None:
+                            version: int | None = None,
+                            stray_read=None,
+                            stray_positions: Sequence[int] = ()) -> None:
         """Rebuild lost shard objects from survivors (RecoveryOp).
         Source shards are version-verified so a stale survivor (missed
         degraded write) counts as lost, not as a rebuild source.
         ``version`` pins the target explicitly (log-driven recovery,
         incl. REWIND: rebuilding shards that applied a dropped entry
         back to the prior version — their own attrs advertise the
-        dropped version, so the max-version guess must not be used)."""
-        meta = await self._target_meta(oid, version)
+        dropped version, so the max-version guess must not be used).
+        ``stray_read(pos, oid, version, shard_len)``: optional extra
+        source — when an acting shard cannot serve a position, a
+        former holder (stray after a partial remap) is read instead,
+        so decode can MIX acting and stray shards (the reference pulls
+        from any peer in the missing-loc set, MissingLoc)."""
+        try:
+            meta = await self._target_meta(oid, version)
+        except ShardReadError:
+            meta = None
+        # probe results (pos -> (arr, attrs, version)) are reused by
+        # read_source below — a stray shard is fetched once, not twice
+        probe: dict[int, tuple[np.ndarray, dict, int]] = {}
+        if meta is None and stray_read is not None:
+            # no acting shard even knows the object (total remap):
+            # probe the strays for its metadata before deciding.  With
+            # no pinned version the MAX across strays wins (the
+            # _read_meta rule — a stale stray that missed a degraded
+            # write must not pin recovery to its dropped version).
+            best = None
+            for pos in stray_positions:
+                try:
+                    arr, attrs = await stray_read(pos, oid, version,
+                                                  None)
+                    d = json.loads(attrs[VERSION_ATTR])
+                    cand = ECObjectMeta(int(d["size"]),
+                                        int(d["version"]))
+                except (ShardReadError, KeyError, ValueError,
+                        TypeError):
+                    continue
+                probe[pos] = (arr, attrs, cand.version)
+                if version is not None:
+                    best = cand
+                    break
+                if best is None or cand.version > best.version:
+                    best = cand
+            meta = best
         if meta is None:
             raise KeyError(f"no such object {oid}")
         shard_len = self.sinfo.logical_to_next_chunk_offset(meta.size)
+
+        # Positions a stray might serve: still rebuild targets (in
+        # ``lost``) but USABLE as decode sources — the partial-overlap
+        # case where acting + strays together reach k even though
+        # neither alone does.
+        stray_avail: set[int] = set(stray_positions or ()) \
+            if stray_read is not None else set()
+
+        stray_attrs: dict[int, dict] = {}    # positions served by strays
+
+        async def read_source(s: int) -> np.ndarray:
+            try:
+                return await self._read_shard_range(
+                    s, oid, 0, shard_len, shard_len, meta.version
+                )
+            except ShardReadError:
+                if stray_read is None or s not in stray_avail:
+                    raise
+                cached = probe.get(s)
+                if cached is not None and cached[2] == meta.version \
+                        and len(cached[0]) >= shard_len:
+                    arr, attrs = cached[0][:shard_len], cached[1]
+                else:
+                    arr, attrs = await stray_read(
+                        s, oid, meta.version, shard_len
+                    )
+                stray_attrs[s] = attrs
+                return arr
+
         lost = list(lost)
         while True:
-            avail = [i for i in range(self.n) if i not in lost]
+            avail = [i for i in range(self.n)
+                     if i not in lost or i in stray_avail]
             need = self.ec.minimum_to_decode(lost, avail)
             reads = await asyncio.gather(*(
-                self._read_shard_range(s, oid, 0, shard_len, shard_len,
-                                       meta.version)
-                for s in need
+                read_source(s) for s in need
             ), return_exceptions=True)
             newly_lost = [
                 s for s, r in zip(need, reads)
@@ -889,7 +954,10 @@ class ECBackend:
             ]
             if not newly_lost:
                 break
-            lost.extend(newly_lost)
+            for s in newly_lost:
+                stray_avail.discard(s)
+                if s not in lost:
+                    lost.append(s)
         nstripes = shard_len // self.sinfo.chunk_size
         batched = {
             s: arr.reshape(nstripes, self.sinfo.chunk_size)
@@ -899,20 +967,26 @@ class ECBackend:
             self.ec.decode_chunks_batch, batched, lost
         )
         # copy the FULL attr set from a version-verified survivor — a
-        # rebuilt shard missing user xattrs would serve stale attr reads
-        good = next(iter(need))
-        getattrs = getattr(self.shards[good], "get_attrs", None)
-        if getattrs is not None:
-            attrs = dict(await getattrs(oid))
+        # rebuilt shard missing user xattrs would serve stale attr
+        # reads.  Prefer an acting source; when every source was a
+        # stray (total remap), its verified attr set serves the role.
+        acting_ok = [s for s in need if s not in stray_attrs]
+        if acting_ok:
+            good = acting_ok[0]
+            getattrs = getattr(self.shards[good], "get_attrs", None)
+            if getattrs is not None:
+                attrs = dict(await getattrs(oid))
+            else:
+                attrs = {
+                    VERSION_ATTR: await self.shards[good].get_attr(
+                        oid, VERSION_ATTR
+                    ),
+                    HINFO_ATTR: await self.shards[good].get_attr(
+                        oid, HINFO_ATTR
+                    ),
+                }
         else:
-            attrs = {
-                VERSION_ATTR: await self.shards[good].get_attr(
-                    oid, VERSION_ATTR
-                ),
-                HINFO_ATTR: await self.shards[good].get_attr(
-                    oid, HINFO_ATTR
-                ),
-            }
+            attrs = dict(stray_attrs[next(iter(need))])
         await asyncio.gather(*(
             self.shards[s].write_shard(
                 oid, 0, np.ascontiguousarray(out[s]).tobytes(), attrs,
