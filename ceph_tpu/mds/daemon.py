@@ -45,6 +45,18 @@ JOURNAL_OID = "mds_journal"
 TABLE_OID = "mds_inotable"
 ANCHOR_OID = "mds_anchortab"
 SUBTREE_OID = "mds_subtree_map"
+# Cross-rank rename commit records (witness-lite slave-commit log):
+# omap keys "commit:<token>" / "abort:<token>" (token = per-attempt
+# random hex) on one shared object, mutated ONLY through the atomic
+# cls rename_wal methods (services/cls.py) so the commit/abort race
+# has a single winner.  The DESTINATION claims "commit" in the same
+# apply that links the dentry; the SOURCE claims "abort" when
+# resolving an ambiguous timeout.  The marker — not the destination
+# dirfrag's current state — is what timeout resolution and replay
+# repair key off: a dst dentry later unlinked or renamed away must
+# still count as COMMITTED.
+RENAME_LOG_OID = "mds_rename_log"
+ECANCELED = -125
 _FRAME = struct.Struct("<I")
 # rank r allocates inos from r * RANK_INO_BASE (per-rank InoTable
 # partitions; reference preallocates per-rank ino ranges)
@@ -136,6 +148,16 @@ class MDSDaemon:
         self._subtrees: dict[int, int] = {}
         self._auth_cache: dict[int, int] = {}  # dir ino -> auth rank
         self._subtrees_loaded = 0.0            # refresh throttle stamp
+        # rank-to-rank requests (cross-rank rename import): this MDS
+        # acts as a CLIENT of the peer rank over the same wire ops
+        self._peer_pending: dict[int, "asyncio.Future"] = {}
+        self._peer_tid = 0
+        # open cross-rank rename intents (token -> intent entry):
+        # survive journal compaction, resolved by replay repair
+        self._open_intents: dict[str, dict] = {}
+        # (parent, name) pairs pinned by an in-flight cross-rank
+        # rename (mutations on them get EBUSY — the xlock role)
+        self._busy_names: set[tuple[int, str]] = set()
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, timeout: float = 20.0) -> None:
@@ -304,32 +326,92 @@ class MDSDaemon:
             except (RadosError, MDSError) as err:
                 log.derr("%s: journal replay of %s failed: %s",
                          self.entity, e.get("op"), err)
-        self.journal_len = len(entries)
+        # dangling cross-rank rename intents are only COLLECTED here;
+        # resolution waits for _resync (post rank assignment) — a
+        # freshly booting daemon replays with the DEFAULT rank and
+        # must not abort a live rank's in-flight renames
+        self._open_intents = {}
+        for e in entries:
+            op = e.get("op")
+            token = str(e.get("token", ""))
+            if op == "rename_export_intent":
+                self._open_intents[token] = e
+            elif op in ("rename_export_finish",
+                        "rename_export_abort"):
+                self._open_intents.pop(token, None)
         if entries:
             await self._compact_journal()
+
+    async def _repair_rename_intents(self) -> None:
+        """Resolve dangling cross-rank rename intents (run from
+        _resync, once THIS daemon's rank assignment is known): the
+        atomic COMMIT MARKER decides — not the destination dirfrag's
+        current state, which later unlinks/renames at the still-live
+        destination rank could flip.  Committed: complete the source
+        unlink.  Not committed: the abort-unless-committed claim wins
+        the race against a still-queued import, roll back."""
+        import json as _json
+
+        for token, e in list(self._open_intents.items()):
+            sp, sn = int(e["src_parent"]), str(e["src_name"])
+            ino = int(e.get("ino", 0))
+            committed = await self._rename_resolve_abort(token)
+            if committed:
+                fin = {"op": "rename_export_finish",
+                       "src_parent": sp, "src_name": sn, "ino": ino,
+                       "token": token}
+                await self._journal(fin)
+                await self._apply(fin)
+                await self._rename_clear(token)
+                log.dout(1, "%s: completed dangling cross-rank "
+                         "rename of %s", self.entity, sn)
+            else:
+                await self._journal({"op": "rename_export_abort",
+                                     "src_parent": sp,
+                                     "src_name": sn, "ino": ino,
+                                     "token": token})
+        # sweep long-dead markers (aborts whose import never arrived,
+        # commits re-created by a destination replay)
+        try:
+            await self.meta.exec(
+                RENAME_LOG_OID, "rename_wal", "gc",
+                _json.dumps({"max_age": 3600.0}).encode())
+        except RadosError:
+            pass
 
     async def _journal(self, entry: dict) -> None:
         payload = encode(entry)
         await self.meta.append(self._journal_oid,
                                _FRAME.pack(len(payload)) + payload)
         self.journal_len += 1
+        op = entry.get("op")
+        if op == "rename_export_intent":
+            self._open_intents[str(entry.get("token", ""))] = entry
+        elif op in ("rename_export_finish", "rename_export_abort"):
+            self._open_intents.pop(str(entry.get("token", "")), None)
 
     async def _compact_journal(self) -> None:
         """Everything is applied synchronously under the mutate lock, so
-        compaction just persists the ino watermark and resets the log
-        (the journal-expire + InoTable save)."""
+        compaction persists the ino watermark and resets the log (the
+        journal-expire + InoTable save) — EXCEPT open cross-rank rename
+        intents, which are rewritten into the fresh log: destroying a
+        dangling intent would disarm the replay repair it exists for."""
         if self.meta is None:
             return
         await self.meta.operate(TABLE_OID, ObjectOperation()
                                 .create()
                                 .set_xattr(self._table_key,
                                            str(self.next_ino).encode()))
+        keep = b""
+        for e in self._open_intents.values():
+            raw = encode(e)
+            keep += _FRAME.pack(len(raw)) + raw
         try:
             await self.meta.operate(self._journal_oid,
-                                    ObjectOperation().write_full(b""))
+                                    ObjectOperation().write_full(keep))
         except RadosError:
             pass
-        self.journal_len = 0
+        self.journal_len = len(self._open_intents)
 
     # -- dirfrag helpers ---------------------------------------------------
     async def _get_dentry(self, parent: int, name: str,
@@ -562,6 +644,41 @@ class MDSDaemon:
             if int(e.get("anchor_ino", 0)):
                 await self._anchor_put(int(e["anchor_ino"]),
                                        e.get("anchor"))
+        elif op == "import_dentry":
+            # cross-rank rename, destination half.  The ATOMIC commit
+            # claim gates the link — in the live path AND on journal
+            # replay: a crash after journaling but before apply leaves
+            # the claim unmade, the source's timeout wins the abort,
+            # and the replayed entry must then link NOTHING (or the
+            # file would exist under both names).  The marker is
+            # durable even if the dentry is later unlinked/renamed —
+            # it is what timeout resolution and replay repair consult.
+            ok = True
+            if e.get("token"):
+                ok = await self._rename_mark_commit(str(e["token"]))
+            if ok:
+                if e.get("pre"):
+                    await self._apply(dict(e["pre"]))
+                await self._set_dentry(int(e["parent"]),
+                                       str(e["name"]),
+                                       dict(e["dentry"]))
+                if int(e.get("purge_ino", 0)):
+                    await self._purge_file(int(e["purge_ino"]),
+                                           int(e.get("purge_size",
+                                                     0)))
+        elif op == "rename_export_finish":
+            # cross-rank rename, source half: drop the exported name
+            # only — the inode lives on under the destination rank
+            try:
+                await self.meta.operate(
+                    dirfrag_oid(int(e["src_parent"])),
+                    ObjectOperation().omap_rm([str(e["src_name"])]),
+                )
+            except RadosError as err:
+                if err.rc != ENOENT:
+                    raise
+        elif op in ("rename_export_intent", "rename_export_abort"):
+            pass          # journal markers; resolved by replay repair
         elif op == "setattr":
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dict(e["dentry"]))
@@ -777,6 +894,12 @@ class MDSDaemon:
                 asyncio.get_running_loop().create_task(self._resync())
             self._last_state = state
             return
+        if msg.type == "mds_reply" and \
+                int(msg.data.get("tid", -1)) in self._peer_pending:
+            fut = self._peer_pending.pop(int(msg.data["tid"]))
+            if not fut.done():
+                fut.set_result(msg.data)
+            return
         if msg.type != "mds_request":
             if self._rados_dispatch is not None:
                 # mon/rados traffic rides our shared dispatcher hook
@@ -794,6 +917,7 @@ class MDSDaemon:
             await self._load_subtrees()
             await self._load_table()
             await self._replay_journal()
+            await self._repair_rename_intents()
         log.dout(1, "%s: resynced for takeover (rank=%d next_ino=%d)",
                  self.entity, self.rank, self.next_ino)
 
@@ -866,7 +990,10 @@ class MDSDaemon:
             if handler is None:
                 raise MDSError(EINVAL, f"unknown mds op {op!r}")
             await self._check_auth(d, op)
-            if op in ("lookup", "readdir", "session", "lssnap"):
+            if op in ("lookup", "readdir", "session", "lssnap",
+                      "rename"):
+                # reads need no lock; rename manages its own (it must
+                # release the mutate lock across its peer RPC)
                 result = await handler(d)
             else:
                 async with self._mutate:
@@ -946,6 +1073,7 @@ class MDSDaemon:
 
     async def _req_mkdir(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
+        self._guard_busy((parent, name))
         await self._ensure_absent(parent, name)
         ino = await self._alloc_ino()
         dentry = _dentry(ino, "dir", int(d.get("mode", 0o755)))
@@ -957,6 +1085,7 @@ class MDSDaemon:
 
     async def _req_create(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
+        self._guard_busy((parent, name))
         try:
             existing = await self._get_dentry(parent, name)
             if d.get("exclusive"):
@@ -986,6 +1115,7 @@ class MDSDaemon:
         """Server::handle_client_symlink: a dentry of type symlink
         whose target string rides the embedded inode."""
         parent, name = int(d["parent"]), str(d["name"])
+        self._guard_busy((parent, name))
         try:
             await self._get_dentry(parent, name)
             raise MDSError(EEXIST, f"{name!r} exists")
@@ -1093,16 +1223,99 @@ class MDSDaemon:
                  ino, rank)
         return {"rank": rank}
 
-    async def _rank_is_active(self, rank: int) -> bool:
+    async def _active_entry(self, rank: int) -> dict | None:
+        """This fs's fsmap entry for an active ``rank``, or None."""
         try:
             r = await self.rados.mon_command("mds stat")
         except (ConnectionError, OSError):
-            return False
+            return None
         if r.get("rc") != 0:
-            return False
-        actives = (r["data"]["filesystems"]
-                   .get(self.fs_name, {}).get("actives", ()))
-        return any(int(a.get("rank", -1)) == rank for a in actives)
+            return None
+        for a in (r["data"]["filesystems"]
+                  .get(self.fs_name, {}).get("actives", ())):
+            if int(a.get("rank", -1)) == rank:
+                return a
+        return None
+
+    async def _rank_addr(self, rank: int) -> str:
+        a = await self._active_entry(rank)
+        if a is None:
+            raise MDSError(EXDEV, f"rank {rank} has no active mds")
+        return str(a["addr"])
+
+    # -- cross-rank rename commit log (atomic cls rename_wal ops) ----------
+    async def _rename_mark_commit(self, token: str) -> bool:
+        """Atomically claim the commit marker; False when the source
+        already claimed abort.  Errors other than the abort verdict
+        propagate — a transient read failure must retry, not silently
+        decide the race."""
+        import json as _json
+
+        try:
+            await self.meta.exec(
+                RENAME_LOG_OID, "rename_wal", "commit",
+                _json.dumps({"token": token}).encode())
+            return True
+        except RadosError as e:
+            if e.rc == ECANCELED:
+                return False
+            raise
+
+    async def _rename_resolve_abort(self, token: str) -> bool:
+        """Atomically: claim the abort marker unless the commit marker
+        exists.  Returns True when the rename COMMITTED."""
+        import json as _json
+
+        out = await self.meta.exec(
+            RENAME_LOG_OID, "rename_wal", "abort",
+            _json.dumps({"token": token}).encode())
+        return bool(_json.loads(out)["committed"])
+
+    async def _rename_marker_state(self, token: str) -> dict:
+        import json as _json
+
+        out = await self.meta.exec(
+            RENAME_LOG_OID, "rename_wal", "get",
+            _json.dumps({"token": token}).encode())
+        return _json.loads(out)
+
+    async def _rename_clear(self, token: str) -> None:
+        import json as _json
+
+        try:
+            await self.meta.exec(
+                RENAME_LOG_OID, "rename_wal", "clear",
+                _json.dumps({"token": token}).encode())
+        except RadosError:
+            pass                      # gc sweeps leaks
+
+    async def _peer_request(self, rank: int, payload: dict,
+                            timeout: float = 10.0) -> dict:
+        """One request to a peer active rank (slave-request role,
+        reference MMDSSlaveRequest): same wire op surface a client
+        uses, awaited by tid.  The timeout also breaks the theoretical
+        deadlock of two opposite-direction cross-rank renames each
+        holding its own rank's mutate lock."""
+        addr = await self._rank_addr(rank)
+        self._peer_tid += 1
+        tid = self._peer_tid
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._peer_pending[tid] = fut
+        try:
+            await self.msgr.send_to(
+                addr, Message("mds_request", {**payload, "tid": tid}),
+                f"mds-rank{rank}",
+            )
+            return await asyncio.wait_for(fut, timeout)
+        except (ConnectionError, asyncio.TimeoutError) as e:
+            raise MDSError(EXDEV,
+                           f"rank {rank} unreachable: {e!r}") from None
+        finally:
+            self._peer_pending.pop(tid, None)
+
+    async def _rank_is_active(self, rank: int) -> bool:
+        return await self._active_entry(rank) is not None
 
     async def _check_no_boundary_anchors(self, ino: int) -> None:
         """Hard links whose names straddle the export boundary would
@@ -1151,6 +1364,7 @@ class MDSDaemon:
         (parent, name) referencing the primary's inode."""
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["parent"]), str(d["name"])
+        self._guard_busy((sp, sn), (dp, dn))
         if await self._auth_rank(sp) != self.rank \
                 or await self._auth_rank(dp) != self.rank:
             # hard links across rank boundaries would put the anchor
@@ -1181,6 +1395,7 @@ class MDSDaemon:
 
     async def _req_unlink(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
+        self._guard_busy((parent, name))
         dentry = await self._get_dentry(parent, name)
         if dentry["type"] == "dir":
             raise MDSError(EISDIR, name)
@@ -1191,6 +1406,7 @@ class MDSDaemon:
 
     async def _req_rmdir(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
+        self._guard_busy((parent, name))
         dentry = await self._get_dentry(parent, name)
         if dentry["type"] != "dir":
             raise MDSError(ENOTDIR, name)
@@ -1222,14 +1438,178 @@ class MDSDaemon:
             hops += 1
         return cur == ino
 
-    async def _req_rename(self, d: dict) -> dict:
+    async def _req_import_dentry(self, d: dict) -> dict:
+        """Cross-rank rename, DESTINATION half (witness-lite slave
+        request, reference Server::handle_slave_rename_prep role):
+        link an existing inode's dentry into a directory this rank is
+        authoritative over, applying POSIX rename overwrite semantics
+        to any existing destination.  Routed by ``parent`` so
+        _check_auth enforces authority; journaled locally."""
+        dp, dn = int(d["parent"]), str(d["name"])
+        dentry = dict(d["dentry"])
+        token = str(d.get("token", ""))
+        if dentry.get("type") == "dir":
+            raise MDSError(EXDEV, "directory import not supported")
+        purge_ino = purge_size = 0
+        unlinked_ino = 0
+        pre = None
+        try:
+            dst = await self._get_dentry(dp, dn)
+            if dst["type"] == "dir":
+                raise MDSError(EISDIR, dn)
+            if int(dst["ino"]) == int(dentry["ino"]):
+                return {"dentry": dst}      # retried import: done
+            unlinked_ino = int(dst["ino"])
+            if dst.get("remote") or int(dst.get("nlink", 1)) > 1:
+                # replaced hardlinked dst: the link-aware unlink rides
+                # INSIDE the import entry so it only applies once the
+                # commit claim wins (an aborted import must not have
+                # unlinked anything)
+                pre = await self._unlink_plan(dp, dn, dst)
+            else:
+                purge_ino = int(dst["ino"])
+                purge_size = int(dst.get("size", 0))
+        except MDSError as e:
+            if not e.missing_dentry:
+                raise
+        entry = {"op": "import_dentry", "parent": dp, "name": dn,
+                 "ino": int(dentry["ino"]), "dentry": dentry,
+                 "purge_ino": purge_ino, "purge_size": purge_size,
+                 "token": token, "pre": pre}
+        await self._journal(entry)
+        await self._apply(entry)
+        if token:
+            state = await self._rename_marker_state(token)
+            if not state.get("committed"):
+                # the source claimed abort first (resolved a timeout):
+                # _apply skipped the link; tell the (possibly still
+                # listening) source the rename did not happen
+                raise MDSError(EXDEV,
+                               "rename aborted by the source rank")
+        return {"dentry": dentry, "unlinked_ino": unlinked_ino}
+
+    def _guard_busy(self, *pairs: tuple[int, str]) -> None:
+        """Mutations on a (parent, name) with a cross-rank rename in
+        flight get EBUSY: the source name must stay stable while the
+        export protocol runs WITHOUT the rank-wide mutate lock held
+        across the peer RPC (the slave-request xlock role)."""
+        for pair in pairs:
+            if pair in self._busy_names:
+                raise MDSError(
+                    EBUSY, f"{pair[1]!r}: cross-rank rename in flight")
+
+    async def _rename_cross_rank(self, d: dict,
+                                 dst_rank: int) -> dict:
+        """Cross-rank FILE rename (witness-lite): journal an export
+        intent, ask the destination rank to import the dentry, then
+        unlink the source name.  The mutate lock is NOT held across
+        the peer RPC — the source name is pinned by the busy-names
+        guard instead, so the rank keeps serving.  A dangling intent
+        resolves by the atomic commit marker (the slave-commit /
+        rollback decision, reference rename two-phase).  Directory and
+        hardlinked renames still decline with EXDEV — subtree
+        authority migration and anchor authority are single-rank.
+
+        Caller holds the mutate lock for THIS phase (validate +
+        intent); it is released before the RPC and re-taken for the
+        finish."""
+        import secrets as _secrets
+
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
-        if await self._auth_rank(dp) != self.rank:
-            # a rename landing in another rank's subtree needs the
-            # reference's multi-MDS witness protocol; -lite declines
-            # (the client surfaces EXDEV like a cross-mount rename)
-            raise MDSError(EXDEV, "rename crosses a rank boundary")
+        dentry = await self._get_dentry(sp, sn)
+        if dentry.get("type") == "dir":
+            raise MDSError(EXDEV,
+                           "directory rename crosses a rank boundary")
+        if dentry.get("remote") or int(dentry.get("nlink", 1)) > 1:
+            raise MDSError(EXDEV,
+                           "hardlinked rename crosses a rank boundary")
+        token = _secrets.token_hex(8)
+        intent = {"op": "rename_export_intent", "src_parent": sp,
+                  "src_name": sn, "dst_parent": dp, "dst_name": dn,
+                  "ino": int(dentry["ino"]), "dentry": dentry,
+                  "token": token}
+        await self._journal(intent)
+        self._busy_names.add((sp, sn))
+        return {"_phase2": (d, dst_rank, token, dentry)}
+
+    async def _rename_cross_rank_finish(self, phase1: dict) -> dict:
+        """Phases 2+3: peer RPC WITHOUT the mutate lock, then the
+        journaled finish/abort under it (caller manages locks)."""
+        d, dst_rank, token, dentry = phase1["_phase2"]
+        sp, sn = int(d["src_parent"]), str(d["src_name"])
+        dp, dn = int(d["dst_parent"]), str(d["dst_name"])
+        payload = {"op": "import_dentry", "parent": dp, "name": dn,
+                   "dentry": dentry, "token": token}
+        reply = None
+        try:
+            reply = await self._peer_request(dst_rank, payload,
+                                             timeout=5.0)
+            if int(reply.get("rc", EXDEV)) != 0 and \
+                    reply.get("redirect_rank") is not None:
+                # destination subtree moved mid-flight: one retry at
+                # the rank the redirect names
+                reply = await self._peer_request(
+                    int(reply["redirect_rank"]), payload, timeout=5.0)
+        except MDSError:
+            reply = None
+        async with self._mutate:
+            if reply is None:
+                # AMBIGUOUS: the peer may have committed before
+                # dying/stalling — the atomic abort-unless-committed
+                # claim decides, with exactly one winner
+                committed = await self._rename_resolve_abort(token)
+                if not committed:
+                    await self._journal({
+                        "op": "rename_export_abort",
+                        "src_parent": sp, "src_name": sn,
+                        "ino": int(dentry["ino"]), "token": token})
+                    raise MDSError(
+                        EXDEV, "destination rank unreachable; "
+                        "rename rolled back")
+                reply = {"rc": 0}       # committed after all
+            elif int(reply.get("rc", EXDEV)) != 0:
+                # unambiguous refusal from the destination
+                await self._journal({"op": "rename_export_abort",
+                                     "src_parent": sp,
+                                     "src_name": sn,
+                                     "ino": int(dentry["ino"]),
+                                     "token": token})
+                raise MDSError(int(reply.get("rc", EXDEV)),
+                               str(reply.get("err", "import failed")))
+            fin = {"op": "rename_export_finish", "src_parent": sp,
+                   "src_name": sn, "ino": int(dentry["ino"]),
+                   "token": token}
+            await self._journal(fin)
+            await self._apply(fin)
+        await self._rename_clear(token)
+        return {"dentry": dentry,
+                "unlinked_ino": int(reply.get("unlinked_ino", 0))}
+
+    async def _req_rename(self, d: dict) -> dict:
+        """Rename entry point — manages its own locking: same-rank
+        renames run wholly under the mutate lock; cross-rank renames
+        hold it only for the intent and finish phases, pinning the
+        source name with the busy guard across the peer RPC."""
+        sp, sn = int(d["src_parent"]), str(d["src_name"])
+        dp, dn = int(d["dst_parent"]), str(d["dst_name"])
+        async with self._mutate:
+            self._guard_busy((sp, sn), (dp, dn))
+            dst_rank = await self._auth_rank(dp)
+            if dst_rank == self.rank:
+                result = await self._rename_same_rank(d)
+                if self.journal_len >= 256:
+                    await self._compact_journal()
+                return result
+            phase1 = await self._rename_cross_rank(d, dst_rank)
+        try:
+            return await self._rename_cross_rank_finish(phase1)
+        finally:
+            self._busy_names.discard((sp, sn))
+
+    async def _rename_same_rank(self, d: dict) -> dict:
+        sp, sn = int(d["src_parent"]), str(d["src_name"])
+        dp, dn = int(d["dst_parent"]), str(d["dst_name"])
         dentry = await self._get_dentry(sp, sn)
         if dentry.get("type") == "dir" \
                 and int(dentry["ino"]) in self._subtrees:
@@ -1312,6 +1692,7 @@ class MDSDaemon:
 
     async def _req_setattr(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
+        self._guard_busy((parent, name))
         dentry = await self._get_dentry(parent, name)
         if dentry.get("remote"):
             parent, name, dentry = await self._primary_of(

@@ -23,6 +23,7 @@ from typing import Callable
 ENOENT_RC = -2
 EBUSY_RC = -16
 EEXIST_RC = -17
+ECANCELED_RC = -125
 EINVAL_RC = -22
 
 
@@ -194,6 +195,62 @@ def _register_builtins(reg: ClassRegistry) -> None:
 
     reg.register("version", "set", ver_set)
     reg.register("version", "read", ver_read)
+
+    # -- cls rename_wal: cross-rank rename commit records (the MDS
+    # witness-lite protocol's slave-commit log).  The commit/abort
+    # race must be decided ATOMICALLY per token; the op interpreter's
+    # per-object serialization provides that here, the role the
+    # reference fills with the master/slave journal handshake.
+    # Keys: "commit:<token>" / "abort:<token>", value = epoch stamp
+    # (consumed by gc).
+    def rn_commit(ctx: ClsContext, indata: bytes) -> bytes:
+        token = str(_j(indata)["token"])
+        ctx.create()
+        if ctx.omap_get([f"abort:{token}"]):
+            raise ClsError(ECANCELED_RC, "rename aborted")
+        ctx.omap_set({f"commit:{token}": str(time.time()).encode()})
+        return b""
+
+    def rn_abort(ctx: ClsContext, indata: bytes) -> bytes:
+        token = str(_j(indata)["token"])
+        ctx.create()
+        if ctx.omap_get([f"commit:{token}"]):
+            return json.dumps({"committed": True}).encode()
+        ctx.omap_set({f"abort:{token}": str(time.time()).encode()})
+        return json.dumps({"committed": False}).encode()
+
+    def rn_get(ctx: ClsContext, indata: bytes) -> bytes:
+        token = str(_j(indata)["token"])
+        kv = ctx.omap_get([f"commit:{token}", f"abort:{token}"])
+        return json.dumps({
+            "committed": f"commit:{token}" in kv,
+            "aborted": f"abort:{token}" in kv,
+        }).encode()
+
+    def rn_clear(ctx: ClsContext, indata: bytes) -> bytes:
+        token = str(_j(indata)["token"])
+        ctx.omap_rm([f"commit:{token}", f"abort:{token}"])
+        return b""
+
+    def rn_gc(ctx: ClsContext, indata: bytes) -> bytes:
+        max_age = float(_j(indata).get("max_age", 3600.0))
+        now = time.time()
+        dead = []
+        for k, v in ctx.omap_get(None).items():
+            try:
+                if now - float(v) > max_age:
+                    dead.append(k)
+            except (TypeError, ValueError):
+                dead.append(k)
+        if dead:
+            ctx.omap_rm(dead)
+        return json.dumps({"removed": len(dead)}).encode()
+
+    reg.register("rename_wal", "commit", rn_commit)
+    reg.register("rename_wal", "abort", rn_abort)
+    reg.register("rename_wal", "get", rn_get)
+    reg.register("rename_wal", "clear", rn_clear)
+    reg.register("rename_wal", "gc", rn_gc)
     reg.register("version", "inc", ver_inc)
 
     # -- cls_rbd (the header subset our rbd layer uses; reference

@@ -105,16 +105,34 @@ def test_two_ranks_serve_disjoint_subtrees():
         await fs.write_file("/shared/sub/mv-src", b"moving")
         await fs.rename("/shared/sub/mv-src", "/shared/mv-dst")
         assert await fs.read_file("/shared/mv-dst") == b"moving"
-        # cross-rank rename / link are declined (EXDEV), not corrupted
+        # cross-rank FILE renames run the witness-lite export protocol
+        await fs.rename("/root-file", "/shared/moved")
+        assert await fs.read_file("/shared/moved") == b"rank0"
+        with pytest.raises(FSError):
+            await fs.stat("/root-file")         # source name gone
+        await fs.rename("/shared/mv-dst", "/escaped")
+        assert await fs.read_file("/escaped") == b"moving"
+        # ... with POSIX overwrite semantics at the destination
+        await fs.write_file("/clobber-src", b"new-content")
+        await fs.write_file("/shared/clobber-dst", b"old-content")
+        await fs.rename("/clobber-src", "/shared/clobber-dst")
+        assert await fs.read_file("/shared/clobber-dst") == \
+            b"new-content"
+        # directory renames still decline (subtree authority is
+        # single-rank), as do cross-rank hard links
+        await fs.mkdir("/adir")
         with pytest.raises(FSError) as ei:
-            await fs.rename("/root-file", "/shared/moved")
-        assert ei.value.rc == -18
-        with pytest.raises(FSError) as ei:
-            await fs.rename("/shared/mv-dst", "/escaped")
+            await fs.rename("/adir", "/shared/adir")
         assert ei.value.rc == -18
         await fs.write_file("/shared/lfile", b"x")
         with pytest.raises(FSError) as ei:
             await fs.link("/shared/lfile", "/rootlink")
+        assert ei.value.rc == -18
+        # hardlinked files decline the cross-rank path too
+        await fs.write_file("/hl-a", b"hl")
+        await fs.link("/hl-a", "/hl-b")
+        with pytest.raises(FSError) as ei:
+            await fs.rename("/hl-a", "/shared/hl-moved")
         assert ei.value.rc == -18
         # export root removal is refused while delegated
         with pytest.raises(FSError) as ei:
@@ -198,4 +216,142 @@ def test_nested_export_back_to_rank0():
         st1 = await fs.stat("/a/f1")
         assert int(st1["ino"]) >= RANK_INO_BASE
         await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_cross_rank_rename_crash_replay():
+    """Crash between the destination's import and the source's finish:
+    the dangling intent resolves on replay — import committed means
+    the source name is unlinked (completion), otherwise rollback."""
+    async def run():
+        from ceph_tpu.mds.daemon import ROOT_INO
+
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.mkdir("/shared")
+            await fs.export_dir("/shared", 1)
+            shared_ino = int((await fs.stat("/shared"))["ino"])
+
+            # COMMITTED case: intent journaled, import applied at rank
+            # 1, then rank 0 "crashes" before its finish
+            await fs.write_file("/crash-src", b"crash-data")
+            dentry = await mds_a._get_dentry(ROOT_INO, "crash-src")
+            await mds_a._journal({
+                "op": "rename_export_intent", "src_parent": ROOT_INO,
+                "src_name": "crash-src", "dst_parent": shared_ino,
+                "dst_name": "crash-dst", "ino": int(dentry["ino"]),
+                "dentry": dentry, "token": "t-commit",
+            })
+            await mds_b._req_import_dentry({
+                "parent": shared_ino, "name": "crash-dst",
+                "dentry": dentry, "token": "t-commit",
+            })
+            # ABORT case: intent journaled, import never happened
+            await fs.write_file("/abort-src", b"abort-data")
+            d2 = await mds_a._get_dentry(ROOT_INO, "abort-src")
+            await mds_a._journal({
+                "op": "rename_export_intent", "src_parent": ROOT_INO,
+                "src_name": "abort-src", "dst_parent": shared_ino,
+                "dst_name": "abort-dst", "ino": int(d2["ino"]),
+                "dentry": d2, "token": "t-abort",
+            })
+
+            # crash + reboot rank 0 (journal and dirfrags live in
+            # RADOS; the daemon restarts over the same pools)
+            # HARD crash (a clean shutdown compacts the journal, and a
+            # dangling intent can only exist after a crash — the
+            # mutate lock covers the whole live protocol)
+            name = mds_a.name
+            mds_a._beacon_task.cancel()
+            mds_a._beacon_task = None
+            await mds_a.rados.shutdown()
+            await mds_a.msgr.shutdown()
+            cluster.mdss.pop(name, None)
+            mds_a2 = await cluster.start_mds(name=name)
+            deadline = asyncio.get_running_loop().time() + 30
+            while mds_a2._last_state != "up:active" \
+                    or mds_a2.rank != 0:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise TimeoutError("restarted mds never active")
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(0.3)          # let the resync land
+
+            # fresh client: the old messenger caches a connection to
+            # the dead incarnation's local:// queue
+            rados2 = await cluster.client("client.fs2")
+            fs2 = CephFS(rados2, str(mds_a2.msgr.my_addr))
+            await fs2.mount()
+            # committed: source gone, destination serves the data
+            with pytest.raises(FSError):
+                await fs2.stat("/crash-src")
+            assert await fs2.read_file("/shared/crash-dst") == \
+                b"crash-data"
+            # aborted: source intact, destination absent
+            assert await fs2.read_file("/abort-src") == b"abort-data"
+            with pytest.raises(FSError):
+                await fs2.stat("/shared/abort-dst")
+            await fs2.unmount()
+            await rados2.shutdown()
+        finally:
+            await _teardown(cluster, rados, fs)
+
+    asyncio.run(run())
+
+
+def test_cross_rank_rename_protocol_guards():
+    """(a) A late import declines when the source already resolved the
+    timeout as aborted (the abort-intent key); (b) journal compaction
+    preserves open intents instead of disarming the replay repair."""
+    async def run():
+        import pytest as _pytest
+
+        from ceph_tpu.mds.daemon import ROOT_INO, MDSError
+
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.mkdir("/shared")
+            await fs.export_dir("/shared", 1)
+            shared_ino = int((await fs.stat("/shared"))["ino"])
+            await fs.write_file("/late-src", b"late")
+            dentry = await mds_a._get_dentry(ROOT_INO, "late-src")
+
+            # (a) source timed out and claimed the abort; the stalled
+            # import arrives afterwards and must decline atomically
+            committed = await mds_a._rename_resolve_abort("tok-late")
+            assert committed is False
+            with _pytest.raises(MDSError):
+                await mds_b._req_import_dentry({
+                    "parent": shared_ino, "name": "late-dst",
+                    "dentry": dentry, "token": "tok-late",
+                })
+            with _pytest.raises(FSError):
+                await fs.stat("/shared/late-dst")
+            # and conversely: once a commit is claimed, the source's
+            # abort resolution reports committed
+            assert await mds_b._rename_mark_commit("tok-won")
+            assert await mds_a._rename_resolve_abort("tok-won") is True
+
+            # (b) compaction keeps an open intent alive
+            await mds_a._journal({
+                "op": "rename_export_intent", "src_parent": ROOT_INO,
+                "src_name": "late-src", "dst_parent": shared_ino,
+                "dst_name": "late-dst", "ino": int(dentry["ino"]),
+                "dentry": dentry, "token": "tok-keep",
+            })
+            await mds_a._compact_journal()
+            raw = await mds_a.meta.read(mds_a._journal_oid)
+            assert b"tok-keep" in raw
+            assert mds_a.journal_len == 1
+            # closing the intent lets compaction empty the log again
+            await mds_a._journal({
+                "op": "rename_export_abort", "src_parent": ROOT_INO,
+                "src_name": "late-src", "ino": int(dentry["ino"]),
+                "token": "tok-keep",
+            })
+            await mds_a._compact_journal()
+            raw = await mds_a.meta.read(mds_a._journal_oid)
+            assert raw == b""
+        finally:
+            await _teardown(cluster, rados, fs)
+
     asyncio.run(run())
