@@ -125,6 +125,17 @@ def global_options() -> list[Option]:
                "mds -> mon beacon period (s)", min=0.05),
         Option("mds_beacon_grace", float, 3.0,
                "beacon silence before an mds is failed (s)", min=0.1),
+        Option("mds_decay_halflife", float, 5.0,
+               "halflife of mds dirfrag popularity counters (s)",
+               min=0.1),
+        Option("mds_bal_interval", float, 0.0,
+               "mds balancer tick period (s; 0=off)", min=0.0),
+        Option("mds_bal_min_rebalance", float, 0.25,
+               "export only when this rank's load exceeds the mean "
+               "by this fraction of the mean", min=0.0),
+        Option("mds_bal_min_start", float, 8.0,
+               "minimum load excess (decayed request counts) worth "
+               "exporting a subtree for", min=0.0),
         Option("trace_probability", float, 0.0,
                "fraction of client ops that carry a trace context "
                "(zipkin_trace analog; 0=off)", min=0.0, max=1.0),

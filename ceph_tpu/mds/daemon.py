@@ -158,6 +158,12 @@ class MDSDaemon:
         # (parent, name) pairs pinned by an in-flight cross-rank
         # rename (mutations on them get EBUSY — the xlock role)
         self._busy_names: set[tuple[int, str]] = set()
+        # balancer (MDBalancer.h:33 role): decaying per-directory
+        # request popularity (DecayCounter semantics, one shared
+        # lazy-decay stamp for the whole map)
+        self._pop: dict[int, float] = {}
+        self._pop_stamp = time.monotonic()
+        self._balance_task = None
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, timeout: float = 20.0) -> None:
@@ -182,6 +188,9 @@ class MDSDaemon:
         self._rados_dispatch = self.rados.ms_dispatch
         self.rados.msgr.set_dispatcher(self)
         self._beacon_task = asyncio.create_task(self._beacon_loop())
+        if self.conf["mds_bal_interval"] > 0:
+            self._balance_task = asyncio.create_task(
+                self._balance_loop())
         run_dir = self.conf["admin_socket_dir"]
         if run_dir:
             from ceph_tpu.common.admin_socket import AdminSocket
@@ -215,6 +224,7 @@ class MDSDaemon:
                         "name": self.name,
                         "addr": str(self.msgr.my_addr),
                         "fs": self.fs_name,
+                        "load": round(self.my_load(), 3),
                     }))
                 except ConnectionError:
                     pass
@@ -227,6 +237,9 @@ class MDSDaemon:
         if self._beacon_task is not None:
             self._beacon_task.cancel()
             self._beacon_task = None
+        if self._balance_task is not None:
+            self._balance_task.cancel()
+            self._balance_task = None
         async with self._mutate:
             await self._compact_journal()
         await self.rados.shutdown()
@@ -953,17 +966,18 @@ class MDSDaemon:
             self._auth_cache[dino] = rank
         return rank, explicit
 
-    async def _check_auth(self, d: dict, op: str) -> None:
+    async def _check_auth(self, d: dict, op: str) -> int:
         """Serve only requests for directories this rank is
         authoritative over; others get a redirect the client follows
-        (the reference forwards between MDSs; -lite redirects)."""
-        if op == "session":
-            return
+        (the reference forwards between MDSs; -lite redirects).
+        Returns the directory ino the request was routed by."""
         # rename routes by its SOURCE parent (the rank that owns the
         # dentry being moved); its handler separately declines
         # cross-rank destinations with EXDEV
         dino = int(d.get("src_parent",
                          d.get("parent", d.get("ino", ROOT_INO))))
+        if op in ("session", "get_load"):
+            return dino
         auth, explicit = await self._auth_rank_ex(dino)
         if auth != self.rank and (
                 not explicit
@@ -981,6 +995,7 @@ class MDSDaemon:
             raise MDSError(EREMOTE_RANK,
                            f"dir {dino:x} is served by rank {auth}",
                            redirect_rank=auth)
+        return dino
 
     async def _handle_request(self, conn: Connection, d: dict) -> None:
         tid = d.get("tid", 0)
@@ -989,14 +1004,22 @@ class MDSDaemon:
             handler = getattr(self, f"_req_{op}", None)
             if handler is None:
                 raise MDSError(EINVAL, f"unknown mds op {op!r}")
-            await self._check_auth(d, op)
+            dino = await self._check_auth(d, op)
+            if op not in ("session", "get_load", "export_dir"):
+                # balancer popularity: the directory the auth check
+                # routed by (exports are administrative, not load)
+                self._note_pop(dino)
             if op in ("lookup", "readdir", "session", "lssnap",
-                      "rename"):
+                      "rename", "get_load"):
                 # reads need no lock; rename manages its own (it must
                 # release the mutate lock across its peer RPC)
                 result = await handler(d)
             else:
                 async with self._mutate:
+                    # authority may have moved (a balancer export)
+                    # while this op queued on the lock: re-check, or
+                    # the mutation would land in a foreign dirfrag
+                    await self._check_auth(d, op)
                     result = await handler(d)
                     if self.journal_len >= 256:
                         await self._compact_journal()
@@ -1197,6 +1220,14 @@ class MDSDaemon:
         if await self._covering_snaps(ino):
             raise MDSError(
                 EINVAL, "cannot export a subtree under a live snapshot")
+        for bp, bn in self._busy_names:
+            # a cross-rank rename in flight under the subtree holds
+            # only its name pins across the peer RPC; exporting now
+            # would let its finish half journal into a foreign dirfrag
+            if bp == ino or await self._is_ancestor(ino, bp):
+                raise MDSError(
+                    EBUSY, f"cross-rank rename in flight under "
+                    f"{ino:x} ({bp:x}/{bn})")
         await self._check_no_boundary_anchors(ino)
         await self._compact_journal()
         # an entry is only redundant when it matches what the PARENT
@@ -1219,9 +1250,120 @@ class MDSDaemon:
                 .omap_set({str(ino): str(rank).encode()}))
             self._subtrees[ino] = rank
         self._auth_cache.clear()
+        # the subtree's popularity belongs to the importing rank now —
+        # stale pops would inflate my_load (and the balancer's "need")
+        # with load this rank no longer serves
+        if rank != self.rank:
+            for dino in list(self._pop):
+                if dino == ino or await self._is_ancestor(ino, dino):
+                    self._pop.pop(dino, None)
         log.dout(1, "%s: exported dir %x to rank %d", self.entity,
                  ino, rank)
         return {"rank": rank}
+
+    # -- balancer (MDBalancer.h:33 + MHeartbeat load exchange) -------------
+    def _decay_pops(self) -> None:
+        """Lazy exponential decay of the whole popularity map
+        (DecayCounter role with a single shared stamp)."""
+        now = time.monotonic()
+        half = self.conf["mds_decay_halflife"]
+        dt = now - self._pop_stamp
+        if dt < half / 8:
+            return
+        f = 0.5 ** (dt / half)
+        self._pop = {i: p * f for i, p in self._pop.items()
+                     if p * f > 0.01}
+        self._pop_stamp = now
+
+    def _note_pop(self, dino: int) -> None:
+        self._decay_pops()
+        self._pop[dino] = self._pop.get(dino, 0.0) + 1.0
+
+    def my_load(self) -> float:
+        """This rank's decayed request load (mds_load_t role)."""
+        self._decay_pops()
+        return sum(self._pop.values())
+
+    async def _req_get_load(self, d: dict) -> dict:
+        """Rank-to-rank load exchange (the MHeartbeat role: the
+        balancing rank polls instead of every rank broadcasting)."""
+        return {"load": self.my_load()}
+
+    async def _balance_loop(self) -> None:
+        interval = self.conf["mds_bal_interval"]
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.balance_once()
+            except (MDSError, RadosError, ConnectionError, OSError):
+                pass              # transient peer/mon trouble: next tick
+
+    async def balance_once(self) -> dict | None:
+        """One balancer pass (MDBalancer::tick + prep_rebalance): poll
+        the other actives' loads; when this rank carries more than its
+        share of the decayed request load, export the subtree whose
+        aggregated popularity best matches the excess to the
+        least-loaded rank.  Returns {ino, rank, load} on export."""
+        r = await self.rados.mon_command("mds stat")
+        if r.get("rc") != 0:
+            return None
+        actives = (r["data"]["filesystems"]
+                   .get(self.fs_name, {}).get("actives", ()))
+        if len(actives) < 2:
+            return None
+        peers = [int(a["rank"]) for a in actives
+                 if int(a["rank"]) != self.rank]
+        replies = await asyncio.gather(
+            *(self._peer_request(r, {"op": "get_load"}, timeout=5.0)
+              for r in peers), return_exceptions=True)
+        loads: dict[int, float] = {self.rank: self.my_load()}
+        for rank, rep in zip(peers, replies):
+            if isinstance(rep, BaseException) or rep.get("rc") != 0:
+                return None   # a blind rebalance could thrash: skip
+            loads[rank] = float(rep.get("load", 0.0))
+        if not any(int(a["rank"]) == self.rank for a in actives):
+            return None
+        mean = sum(loads.values()) / len(loads)
+        need = loads[self.rank] - mean
+        if need < max(self.conf["mds_bal_min_start"],
+                      mean * self.conf["mds_bal_min_rebalance"]):
+            return None
+        target = min((r for r in loads if r != self.rank),
+                     key=lambda r: (loads[r], r))
+        return await self._export_for_balance(need, target)
+
+    async def _export_for_balance(self, need: float,
+                                  target: int) -> dict | None:
+        """Aggregate per-directory popularity up the ancestry (within
+        this rank's authority) and export the subtree whose load is
+        closest to ``need``.  Candidates that cannot export (live
+        snapshot realm, boundary anchors, a concurrent rename) are
+        skipped, not fatal."""
+        self._decay_pops()
+        agg: dict[int, float] = {}
+        for dino, p in list(self._pop.items()):
+            for link in await self._parent_chain(dino):
+                if await self._auth_rank(link) != self.rank:
+                    break         # left our territory
+                agg[link] = agg.get(link, 0.0) + p
+        # strict improvement only: moving load L changes this rank's
+        # deviation from need to |need - L|, so 0 < L < 2*need shrinks
+        # it — and the < bound is the anti-ping-pong hysteresis (once
+        # balanced, re-exporting the same subtree can't improve)
+        cands = [(i, load) for i, load in agg.items()
+                 if i != ROOT_INO and need * 0.25 <= load < need * 2]
+        cands.sort(key=lambda kv: (abs(kv[1] - need), kv[0]))
+        for ino, load in cands:
+            try:
+                async with self._mutate:
+                    await self._req_export_dir(
+                        {"ino": ino, "rank": target})
+            except (MDSError, RadosError):
+                continue          # snaps/anchors/rename races: next
+            log.dout(1, "%s: balancer exported %x (load %.1f) to "
+                     "rank %d", self.entity, ino, load, target)
+            return {"ino": ino, "rank": target, "load": load}
+        return None
 
     async def _active_entry(self, rank: int) -> dict | None:
         """This fs's fsmap entry for an active ``rank``, or None."""

@@ -42,6 +42,7 @@ class MDSMonitor(PaxosService):
         self.filesystems: dict[str, dict] = {}
         self.mds: dict[str, dict] = {}       # name -> {addr, fs, state}
         self._last_beacon: dict[str, float] = {}   # leader-local
+        self._loads: dict[str, float] = {}         # leader-local
         self.pending = False
 
     # -- state ------------------------------------------------------------
@@ -71,10 +72,12 @@ class MDSMonitor(PaxosService):
         return True
 
     # -- beacons (MMDSBeacon) ---------------------------------------------
-    def handle_beacon(self, name: str, addr: str, fs: str) -> bool:
+    def handle_beacon(self, name: str, addr: str, fs: str,
+                      load: float = 0.0) -> bool:
         """Record liveness; returns True when a map change was staged
         (registration, address change, or a role assignment)."""
         self._last_beacon[name] = time.monotonic()
+        self._loads[name] = float(load)   # observability only, no paxos
         info = self.mds.get(name)
         if info is not None and info["addr"] == addr \
                 and info["state"] != STATE_DOWN:
@@ -225,7 +228,8 @@ class MDSMonitor(PaxosService):
                            if i["fs"] == fs}
                 actives = sorted(
                     ({"name": n, "addr": i["addr"],
-                      "rank": int(i.get("rank", 0))}
+                      "rank": int(i.get("rank", 0)),
+                      "load": round(self._loads.get(n, 0.0), 3)}
                      for n, i in members.items()
                      if i["state"] == STATE_ACTIVE),
                     key=lambda a: a["rank"])

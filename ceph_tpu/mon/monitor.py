@@ -855,7 +855,9 @@ class Monitor:
         if self.is_leader:
             try:
                 async with self._mutate_lock:
-                    if self.mds_monitor.handle_beacon(name, addr, fs):
+                    if self.mds_monitor.handle_beacon(
+                            name, addr, fs,
+                            float(data.get("load", 0.0))):
                         await self.propose_pending()
             except ConnectionError:
                 pass

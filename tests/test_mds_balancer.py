@@ -1,0 +1,141 @@
+"""MDS balancer: load-driven automatic subtree rebalancing across
+active ranks (reference MDBalancer.h:33 tick/prep_rebalance +
+MHeartbeat load exchange, at -lite scale)."""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _two_rank_cluster():
+    cluster = DevCluster(n_mons=1, n_osds=3)
+    await cluster.start()
+    admin = await cluster.client()
+    await admin.pool_create("cephfs_meta", pg_num=4, size=3, min_size=2)
+    await admin.pool_create("cephfs_data", pg_num=4, size=3, min_size=2)
+    mds_a = await cluster.start_mds(name="a", block_size=4096)
+    mds_b = await cluster.start_mds(name="b", block_size=4096)
+    r = await admin.mon_command("fs set_max_mds", fs_name="cephfs",
+                                max_mds=2)
+    assert r["rc"] == 0, r
+    deadline = asyncio.get_running_loop().time() + 10
+    while True:
+        r = await admin.mon_command("mds stat")
+        actives = r["data"]["filesystems"]["cephfs"]["actives"]
+        if len(actives) == 2 and mds_b.rank == 1:
+            break
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"rank 1 never active: {actives}")
+        await asyncio.sleep(0.05)
+    await admin.shutdown()
+    rados = await cluster.client("client.fs")
+    fs = CephFS(rados, str(mds_a.msgr.my_addr))
+    await fs.mount()
+    return cluster, mds_a, mds_b, rados, fs
+
+
+async def _teardown(cluster, rados, fs):
+    await fs.unmount()
+    await rados.shutdown()
+    await cluster.stop()
+
+
+def test_balancer_exports_hot_subtree():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.mkdir("/hot")
+            await fs.mkdir("/cold")
+            await fs.write_file("/cold/one", b"x")
+            # hammer /hot on rank 0: every create is one pop point
+            # against the /hot dirfrag.  The root-level writes keep
+            # /hot's share under the 2*need anti-ping-pong bound (a
+            # subtree carrying ALL the load can't improve balance by
+            # moving — it just relocates the hot spot).
+            for i in range(60):
+                await fs.write_file(f"/hot/f{i}", b"")
+            for i in range(25):
+                await fs.write_file(f"/r{i}", b"")
+            hot_ino = int((await fs.stat("/hot"))["ino"])
+            assert mds_a.my_load() > 70
+            res = await mds_a.balance_once()
+            assert res is not None
+            assert res["rank"] == 1 and res["ino"] == hot_ino
+            assert mds_a._subtrees.get(hot_ino) == 1
+            # the exported subtree's popularity left with it
+            assert mds_a._pop.get(hot_ino) is None
+            # clients keep working via redirects; rank 1 serves /hot
+            await fs.write_file("/hot/after", b"rank1 now")
+            assert await fs.read_file("/hot/after") == b"rank1 now"
+            from ceph_tpu.mds.daemon import RANK_INO_BASE
+            st = await fs.stat("/hot/after")
+            assert int(st["ino"]) >= RANK_INO_BASE
+            # a second pass with the excess gone is a no-op
+            assert await mds_a.balance_once() is None
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_balancer_noop_when_balanced():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            # barely-warm rank 0: below mds_bal_min_start excess
+            await fs.mkdir("/d")
+            await fs.write_file("/d/f", b"x")
+            assert await mds_a.balance_once() is None
+            assert mds_a._subtrees == {}
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_popularity_decays():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            mds_a._pop = {5: 8.0}
+            # backdate two halflives: 8.0 -> 2.0
+            half = mds_a.conf["mds_decay_halflife"]
+            mds_a._pop_stamp = time.monotonic() - 2 * half
+            assert abs(mds_a.my_load() - 2.0) < 0.05
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_loads_visible_in_mds_stat():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.mkdir("/busy")
+            for i in range(20):
+                await fs.write_file(f"/busy/f{i}", b"")
+            # wait for a beacon to carry the load to the monitor
+            deadline = asyncio.get_running_loop().time() + 5
+            while True:
+                r = await rados.mon_command("mds stat")
+                actives = (r["data"]["filesystems"]["cephfs"]
+                           ["actives"])
+                a0 = next(a for a in actives if a["rank"] == 0)
+                if a0.get("load", 0.0) > 5:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise TimeoutError(f"load never reported: {a0}")
+                await asyncio.sleep(0.1)
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
