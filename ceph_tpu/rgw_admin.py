@@ -74,15 +74,22 @@ async def _dispatch(args, gw: RGWLite, users: RGWUsers):
         if args.sub == "ls":
             return await gw.list_buckets()
         if args.sub == "stats":
-            size, count = await gw._bucket_usage(args.bucket)
             meta = await gw._bucket_meta(args.bucket)
+            size, count = await gw._bucket_usage(args.bucket, meta)
             return {
                 "bucket": args.bucket,
                 "owner": meta.get("owner", ""),
                 "size_bytes": size,
                 "num_objects": count,
+                "num_shards": int(meta.get("index_shards", 1)),
                 "quota": meta.get("quota", {}),
             }
+        if args.sub == "reshard":
+            if args.abort:
+                await gw.reshard_abort(args.bucket)
+                return {"bucket": args.bucket, "aborted": True}
+            return await gw.reshard_bucket(args.bucket,
+                                           args.num_shards)
         if args.sub == "quota":
             await gw.set_bucket_quota(args.bucket,
                                       max_size=args.max_size,
@@ -96,6 +103,11 @@ async def _dispatch(args, gw: RGWLite, users: RGWUsers):
             return await gw.lc_process()
         if args.sub == "get":
             return await gw.get_lifecycle(args.bucket)
+    if args.cmd == "gc":
+        if args.sub == "list":
+            return await gw.gc_list()
+        if args.sub == "process":
+            return {"reaped": await gw.gc_process()}
     raise RGWError("InvalidArgument", f"{args.cmd} {args.sub}")
 
 
@@ -131,6 +143,10 @@ def build_parser() -> argparse.ArgumentParser:
     bucket = sub.add_parser("bucket")
     bucket_sub = bucket.add_subparsers(dest="sub", required=True)
     bucket_sub.add_parser("ls")
+    rs = bucket_sub.add_parser("reshard")
+    rs.add_argument("--bucket", required=True)
+    rs.add_argument("--num-shards", type=int, default=2)
+    rs.add_argument("--abort", action="store_true")
     for name in ("stats", "quota", "acl"):
         x = bucket_sub.add_parser(name)
         x.add_argument("--bucket", required=True)
@@ -145,6 +161,11 @@ def build_parser() -> argparse.ArgumentParser:
     lc_sub.add_parser("process")
     lg = lc_sub.add_parser("get")
     lg.add_argument("--bucket", required=True)
+
+    gc = sub.add_parser("gc")
+    gc_sub = gc.add_subparsers(dest="sub", required=True)
+    gc_sub.add_parser("list")
+    gc_sub.add_parser("process")
     return p
 
 

@@ -433,16 +433,25 @@ class RGWError(IOError):
 class RGWLite:
     def __init__(self, ioctx: IoCtx, datalog: bool = True,
                  user: str | None = None,
-                 users: "RGWUsers | None" = None):
+                 users: "RGWUsers | None" = None,
+                 gc_min_wait: float = 0.0,
+                 auto_reshard_objs: int = 0):
         """``datalog``: append every mutation to the per-bucket data log
         (the cls_rgw bilog) so a multisite sync agent can tail it.
         ``user``: the acting identity for ACL/quota enforcement (None =
         system/admin context, every check bypassed — the pre-round-2
-        behavior); ``users``: the user db backing quota lookups."""
+        behavior); ``users``: the user db backing quota lookups.
+        ``gc_min_wait``: >0 defers data-object deletion to the GC queue
+        for that many seconds (rgw_gc_obj_min_wait; 0 = delete inline).
+        ``auto_reshard_objs``: >0 doubles a bucket's index shards when
+        any one shard exceeds this many entries (rgw dynamic
+        resharding's rgw_max_objs_per_shard; 0 = off)."""
         self.ioctx = ioctx
         self.datalog = datalog
         self.user = user
         self.users = users
+        self.gc_min_wait = gc_min_wait
+        self.auto_reshard_objs = auto_reshard_objs
         # bucket -> (fetched_at, notification configs); shared across
         # as_user handles so invalidation is seen by every identity
         self._notif_cache: dict[str, tuple[float, list]] = {}
@@ -453,7 +462,8 @@ class RGWLite:
 
     def as_user(self, user: str | None) -> "RGWLite":
         """A handle acting as ``user`` over the same pool."""
-        child = RGWLite(self.ioctx, self.datalog, user, self.users)
+        child = RGWLite(self.ioctx, self.datalog, user, self.users,
+                        self.gc_min_wait, self.auto_reshard_objs)
         child._notif_cache = self._notif_cache
         return child
 
@@ -570,16 +580,13 @@ class RGWLite:
                 "acl": meta.get("acl", {"canned": "private"})}
 
     # -- quota (rgw_quota.cc: user + bucket ceilings) ----------------------
-    async def _bucket_usage(self, bucket: str) -> tuple[int, int]:
+    async def _bucket_usage(self, bucket: str,
+                            meta: dict | None = None
+                            ) -> tuple[int, int]:
         """(bytes, objects) from the bucket index — computed on demand
         (the reference keeps rolling stats in the index header; at our
         scale a scan is exact and race-free)."""
-        try:
-            index = await self.ioctx.get_omap(self._index_oid(bucket))
-        except RadosError as e:
-            if e.rc == -2:
-                return 0, 0
-            raise
+        index = await self._index_all(bucket, meta)
         entries = {k: json.loads(v) for k, v in index.items()}
         entries = {k: e for k, e in entries.items()
                    if not e.get("delete_marker")}
@@ -627,7 +634,7 @@ class RGWLite:
         if not bq.get("max_size") and not bq.get("max_objects") \
                 and not uq.get("max_size") and not uq.get("max_objects"):
             return
-        used_bytes, used_objs = await self._bucket_usage(bucket)
+        used_bytes, used_objs = await self._bucket_usage(bucket, meta)
         new_bytes = used_bytes - replaced_size + incoming
         new_objs = used_objs + (0 if is_replace else 1)
         if bq.get("max_size") and new_bytes > bq["max_size"]:
@@ -750,27 +757,30 @@ class RGWLite:
 
     async def _remove_entry_data(self, bucket: str, key: str,
                                  rec: dict) -> None:
-        """Best-effort removal of an entry's data objects (plain,
-        striped, or multipart); tolerant of already-gone objects."""
-        try:
-            if rec.get("slo"):
-                return              # segments are independent objects
-            if rec.get("multipart"):
-                for part in rec["multipart"]:
-                    try:
-                        await self.ioctx.remove(part["oid"])
-                    except RadosError as e:
-                        if e.rc != -2:
-                            raise
-            elif rec.get("striped"):
-                await self.striper.remove(
-                    rec.get("data_oid", self._data_oid(bucket, key)))
-            elif not rec.get("delete_marker"):
-                await self.ioctx.remove(
-                    rec.get("data_oid", self._data_oid(bucket, key)))
-        except RadosError as e:
-            if e.rc != -2:
-                raise
+        """Removal of an entry's data objects (plain, striped, or
+        multipart); tolerant of already-gone objects.  With
+        ``gc_min_wait`` > 0 the objects are queued for deferred GC
+        deletion instead (rgw_gc tail deletion: the index entry dies
+        now, the data dies after the grace window)."""
+        items: list = []
+        if rec.get("slo"):
+            return                  # segments are independent objects
+        if rec.get("multipart"):
+            items += [["plain", p["oid"]] for p in rec["multipart"]]
+        elif rec.get("striped"):
+            items.append(["striped",
+                          rec.get("data_oid",
+                                  self._data_oid(bucket, key))])
+        elif not rec.get("delete_marker"):
+            items.append(["plain",
+                          rec.get("data_oid",
+                                  self._data_oid(bucket, key))])
+        if not items:
+            return
+        if self.gc_min_wait > 0:
+            await self._gc_enqueue(items, bucket, key)
+        else:
+            await self._gc_delete(items)
 
     def _new_version_id(self) -> str:
         import secrets as _secrets
@@ -803,7 +813,7 @@ class RGWLite:
             if not meta.get("versioning"):
                 return []
             omap = {}
-        current = await self.ioctx.get_omap(self._index_oid(bucket))
+        current = await self._index_all(bucket, meta)
         current_entries = {k: json.loads(v)
                            for k, v in current.items()}
         current_vid = {k: e.get("version_id")
@@ -861,8 +871,7 @@ class RGWLite:
             else:
                 raise
         if not kv and version_id == "null":
-            cur = await self.ioctx.get_omap(self._index_oid(bucket),
-                                            [key])
+            cur = await self._index_get(bucket, key)
             if key in cur:
                 e = json.loads(cur[key])
                 if not e.get("version_id") \
@@ -928,15 +937,13 @@ class RGWLite:
             else:
                 raise
         if not kv and version_id == "null":
-            cur = await self.ioctx.get_omap(self._index_oid(bucket),
-                                            [key])
+            cur = await self._index_get(bucket, key, meta)
             if key in cur:
                 e = json.loads(cur[key])
                 if not e.get("version_id") \
                         and not e.get("delete_marker"):
                     await self._remove_entry_data(bucket, key, e)
-                    await self.ioctx.rm_omap_keys(
-                        self._index_oid(bucket), [key])
+                    await self._index_rm(bucket, meta, key)
                     await self._log(bucket, "del-version", key)
                     return
         if not kv:
@@ -947,8 +954,7 @@ class RGWLite:
                                       [vkey])
         # promote the next-newest remaining version when the deleted
         # one was current
-        current = await self.ioctx.get_omap(self._index_oid(bucket),
-                                            [key])
+        current = await self._index_get(bucket, key, meta)
         if key in current and json.loads(current[key]).get(
                 "version_id") == version_id:
             remaining = [
@@ -960,11 +966,9 @@ class RGWLite:
                 vk = self._vkey(key, remaining[0]["version_id"])
                 raw = (await self.ioctx.get_omap(
                     self._versions_oid(bucket), [vk]))[vk]
-                await self.ioctx.set_omap(self._index_oid(bucket),
-                                          {key: raw})
+                await self._index_set(bucket, meta, key, raw)
             else:
-                await self.ioctx.rm_omap_keys(self._index_oid(bucket),
-                                              [key])
+                await self._index_rm(bucket, meta, key)
         await self._log(bucket, "del-version", key)
 
     # -- multipart upload (rgw_multi.cc: initiate/part/complete/abort) ----
@@ -1106,8 +1110,8 @@ class RGWLite:
         # the assembled size is the real quota event (parts are not in
         # the bucket index, so per-part checks cannot see each other)
         bucket_meta = await self._bucket_meta(bucket)
-        existing0 = await self.ioctx.get_omap(self._index_oid(bucket),
-                                              [key])
+        self._index_writable(bucket_meta)
+        existing0 = await self._index_get(bucket, key, bucket_meta)
         versioned = bucket_meta.get("versioning") == "enabled"
         suspended = bucket_meta.get("versioning") == "suspended"
         if versioned:
@@ -1138,8 +1142,7 @@ class RGWLite:
         # Re-read the index HERE: awaits since existing0 (quota check,
         # part cleanup) give concurrent PUT/DELETEs of the same key a
         # window — a stale snapshot would leak a racer's data objects
-        existing = await self.ioctx.get_omap(self._index_oid(bucket),
-                                             [key])
+        existing = await self._index_get(bucket, key, bucket_meta)
         entry = {
             "size": total, "etag": etag, "mtime": time.time(),
             "content_type": info["content_type"], "striped": False,
@@ -1168,13 +1171,13 @@ class RGWLite:
             await self._record_version(bucket, key, entry)
         elif key in existing:
             await self.delete_object(bucket, key)
-        await self.ioctx.set_omap(self._index_oid(bucket), {
-            key: json.dumps(entry).encode(),
-        })
+        await self._index_set(bucket, bucket_meta, key,
+                              json.dumps(entry).encode())
         await self.ioctx.remove(
             self._mp_meta_oid(bucket, key, upload_id)
         )
         await self._log(bucket, "put", key, etag)
+        await self._maybe_auto_reshard(bucket, bucket_meta, key)
         out = {"etag": etag, "size": total}
         if entry.get("version_id") and not suspended:
             out["version_id"] = entry["version_id"]
@@ -1267,9 +1270,285 @@ class RGWLite:
                         break
         return removed
 
+    # -- bucket index shards (cls_rgw index + rgw_reshard.cc role) ---------
+    @staticmethod
+    def _index_shard_oids(bucket: str, meta: dict) -> list[str]:
+        """The bucket's index shard objects.  An unsharded gen-0 bucket
+        keeps the legacy single-object name; sharded (or resharded)
+        buckets spread keys over ``.g<gen>.<shard>`` objects — the
+        generation bumps on every reshard so the old and new shard sets
+        never collide (reference RGWBucketReshard new-instance ids)."""
+        shards = max(1, int(meta.get("index_shards", 1)))
+        gen = int(meta.get("index_gen", 0))
+        if shards == 1 and gen == 0:
+            return [f"rgw.bucket.index.{bucket}"]
+        return [f"rgw.bucket.index.{bucket}.g{gen}.{s}"
+                for s in range(shards)]
+
+    @staticmethod
+    def _index_oid_for(bucket: str, meta: dict, key: str) -> str:
+        """The shard object holding ``key`` (ceph_str_hash role)."""
+        oids = RGWLite._index_shard_oids(bucket, meta)
+        if len(oids) == 1:
+            return oids[0]
+        return oids[zlib.crc32(key.encode()) % len(oids)]
+
+    async def _index_all(self, bucket: str,
+                         meta: dict | None = None) -> dict:
+        """Merged key -> raw entry across every index shard."""
+        if meta is None:
+            meta = await self._bucket_meta(bucket)
+
+        async def one(oid: str) -> dict:
+            try:
+                return await self.ioctx.get_omap(oid)
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+                return {}
+
+        out: dict[str, bytes] = {}
+        for kv in await asyncio.gather(
+                *(one(o) for o in self._index_shard_oids(bucket,
+                                                         meta))):
+            out.update(kv)
+        return out
+
+    async def _index_get(self, bucket: str, key: str,
+                         meta: dict | None = None) -> dict:
+        if meta is None:
+            meta = await self._bucket_meta(bucket)
+        try:
+            return await self.ioctx.get_omap(
+                self._index_oid_for(bucket, meta, key), [key])
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            return {}
+
+    @staticmethod
+    def _index_writable(meta: dict) -> None:
+        """Index writes are blocked while a reshard copies entries
+        (the reference blocks with a cls guard + retry; clients see a
+        retryable 503)."""
+        if meta.get("resharding"):
+            raise RGWError("ServiceUnavailable",
+                           "bucket index is resharding; retry")
+
+    async def _index_set(self, bucket: str, meta: dict, key: str,
+                         raw: bytes) -> None:
+        self._index_writable(meta)
+        await self.ioctx.set_omap(
+            self._index_oid_for(bucket, meta, key), {key: raw})
+
+    async def _index_rm(self, bucket: str, meta: dict,
+                        key: str) -> None:
+        self._index_writable(meta)
+        await self.ioctx.rm_omap_keys(
+            self._index_oid_for(bucket, meta, key), [key])
+
+    async def reshard_bucket(self, bucket: str,
+                             num_shards: int) -> dict:
+        """Reshard the bucket index to ``num_shards`` shard objects
+        (rgw_reshard.cc RGWBucketReshard::execute): flag the bucket,
+        copy entries into a new generation of shard objects, flip the
+        meta, drop the old set.  A second copy sweep picks up writers
+        that raced the flag; the one-await window left open is the
+        -lite stand-in for the reference's cls-guard retry protocol."""
+        if not 1 <= num_shards <= 1024:
+            raise RGWError("InvalidArgument",
+                           f"num_shards {num_shards} not in [1,1024]")
+        meta = await self._bucket_meta(bucket)
+        if self.user is not None and self.user != meta.get("owner"):
+            raise RGWError("AccessDenied", bucket)
+        if meta.get("resharding"):
+            raise RGWError("OperationAborted",
+                           f"reshard of {bucket} already in progress")
+        old_oids = self._index_shard_oids(bucket, meta)
+        new_meta = {**meta, "index_shards": num_shards,
+                    "index_gen": int(meta.get("index_gen", 0)) + 1}
+        meta["resharding"] = True
+        meta["reshard_target"] = num_shards
+        await self._put_bucket_meta(bucket, meta)
+        for oid in self._index_shard_oids(bucket, new_meta):
+            await self.ioctx.operate(oid, ObjectOperation().create())
+        moved: set[str] = set()
+        for _sweep in range(2):
+            for old in old_oids:
+                try:
+                    kv = await self.ioctx.get_omap(old)
+                except RadosError as e:
+                    if e.rc != -2:
+                        raise
+                    continue
+                batches: dict[str, dict] = {}
+                for k, v in kv.items():
+                    batches.setdefault(
+                        self._index_oid_for(bucket, new_meta, k),
+                        {})[k] = v
+                    moved.add(k)
+                for oid, kvs in batches.items():
+                    await self.ioctx.set_omap(oid, kvs)
+        final = dict(new_meta)
+        final.pop("resharding", None)
+        final.pop("reshard_target", None)
+        await self._put_bucket_meta(bucket, final)
+        for old in old_oids:
+            try:
+                await self.ioctx.remove(old)
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+        return {"bucket": bucket, "num_shards": num_shards,
+                "objects": len(moved)}
+
+    async def reshard_abort(self, bucket: str) -> None:
+        """Clear a reshard wedged by a crash mid-copy: drop the
+        half-written next-generation shard objects and unblock
+        writes (radosgw-admin reshard cancel)."""
+        meta = await self._bucket_meta(bucket)
+        if not meta.get("resharding"):
+            return
+        target = int(meta.get("reshard_target", 1))
+        next_meta = {**meta, "index_shards": target,
+                     "index_gen": int(meta.get("index_gen", 0)) + 1}
+        for oid in self._index_shard_oids(bucket, next_meta):
+            try:
+                await self.ioctx.remove(oid)
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+        meta.pop("resharding", None)
+        meta.pop("reshard_target", None)
+        await self._put_bucket_meta(bucket, meta)
+
+    async def _maybe_auto_reshard(self, bucket: str, meta: dict,
+                                  key: str) -> None:
+        """Dynamic resharding (rgw_reshard.cc RGWReshard daemon role):
+        after a put, when the target shard outgrows the per-shard
+        object cap, double the shard count.  Checks only the one shard
+        the put touched, so the cost is one omap read per put."""
+        if self.auto_reshard_objs <= 0:
+            return
+        try:
+            n = len(await self.ioctx.get_omap(
+                self._index_oid_for(bucket, meta, key)))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            return
+        if n <= self.auto_reshard_objs:
+            return
+        shards = max(1, int(meta.get("index_shards", 1)))
+        if shards * 2 > 1024:
+            return                # at the cap: the put already landed
+        try:
+            await self.as_user(None).reshard_bucket(bucket, shards * 2)
+        except RGWError as e:
+            if e.code not in ("OperationAborted",
+                              "ServiceUnavailable"):
+                raise             # concurrent reshard already running
+
+    # -- garbage collection (rgw_gc.cc deferred tail deletion) -------------
+    GC_OID = "rgw.gc"
+
+    async def _gc_enqueue(self, items: list, bucket: str,
+                          key: str) -> None:
+        """Queue data objects for deferred deletion; keys sort by
+        expiry so gc_process stops at the first unexpired entry.
+        ``bucket``/``key`` ride along for the reap-time liveness
+        check: plain puts reuse the deterministic per-key oid, so a
+        key re-created inside the grace window holds LIVE data at an
+        oid a stale GC entry names (the reference avoids this with
+        per-write tail tags; -lite checks liveness when reaping)."""
+        import secrets as _secrets
+
+        expire = time.time() + self.gc_min_wait
+        await self.ioctx.operate(
+            self.GC_OID, ObjectOperation().create().omap_set({
+                f"{expire:020.6f}.{_secrets.token_hex(6)}":
+                    json.dumps({"bucket": bucket, "key": key,
+                                "items": items}).encode(),
+            }))
+
+    async def _live_oids(self, bucket: str, key: str) -> set[str]:
+        """Every data oid the bucket CURRENTLY references for ``key``
+        (index entry + all version records): a GC entry must never
+        delete these — they belong to a re-created or overwritten
+        object, not the dead one that was enqueued."""
+        def oids_of(rec: dict) -> list[str]:
+            if rec.get("delete_marker"):
+                return []
+            if rec.get("multipart"):
+                return [p["oid"] for p in rec["multipart"]]
+            return [rec.get("data_oid", self._data_oid(bucket, key))]
+
+        live: set[str] = set()
+        try:
+            kv = await self._index_get(bucket, key)
+        except RGWError:
+            return live                   # bucket itself is gone
+        if key in kv:
+            live.update(oids_of(json.loads(kv[key])))
+        try:
+            vomap = await self.ioctx.get_omap(
+                self._versions_oid(bucket))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            vomap = {}
+        prefix = key + "\x00"
+        for vk, raw in vomap.items():
+            if vk.startswith(prefix):
+                live.update(oids_of(json.loads(raw)))
+        return live
+
+    async def _gc_delete(self, items: list) -> None:
+        for kind, oid in items:
+            try:
+                if kind == "striped":
+                    await self.striper.remove(oid)
+                else:
+                    await self.ioctx.remove(oid)
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+
+    async def gc_list(self) -> list[dict]:
+        try:
+            omap = await self.ioctx.get_omap(self.GC_OID)
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            return []
+        out = []
+        for k, v in sorted(omap.items()):
+            parts = k.rsplit(".", 2)
+            ent = json.loads(v)
+            out.append({"tag": k,
+                        "expire": float(parts[0] + "." + parts[1]),
+                        **ent})
+        return out
+
+    async def gc_process(self, now: float | None = None) -> int:
+        """Reap expired GC entries (RGWGC::process); returns the
+        number of queue entries deleted."""
+        now = time.time() if now is None else now
+        reaped = 0
+        for ent in await self.gc_list():
+            if ent["expire"] > now:
+                break                     # sorted by expiry
+            live = await self._live_oids(ent["bucket"], ent["key"])
+            await self._gc_delete([it for it in ent["items"]
+                                   if it[1] not in live])
+            await self.ioctx.rm_omap_keys(self.GC_OID, [ent["tag"]])
+            reaped += 1
+        return reaped
+
     # -- buckets -----------------------------------------------------------
     @staticmethod
     def _index_oid(bucket: str) -> str:
+        """Legacy unsharded index oid (gen-0 single shard only)."""
         return f"rgw.bucket.index.{bucket}"
 
     @staticmethod
@@ -1437,7 +1716,7 @@ class RGWLite:
         meta = await self._bucket_meta(bucket)
         if self.user is not None and self.user != meta.get("owner"):
             raise RGWError("AccessDenied", bucket)
-        index = await self.ioctx.get_omap(self._index_oid(bucket))
+        index = await self._index_all(bucket, meta)
         if index:
             raise RGWError("BucketNotEmpty", bucket)
         try:
@@ -1450,7 +1729,12 @@ class RGWLite:
             if e.rc != -2:
                 raise
         self._notif_cache.pop(bucket, None)
-        await self.ioctx.remove(self._index_oid(bucket))
+        for oid in self._index_shard_oids(bucket, meta):
+            try:
+                await self.ioctx.remove(oid)
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
         try:
             await self.ioctx.remove(self._log_oid(bucket))
         except RadosError as e:
@@ -1486,8 +1770,9 @@ class RGWLite:
         and cleanup happens after the index flips to it."""
         meta = await self._check_bucket(bucket, "WRITE",
                                         action="s3:PutObject", key=key)
-        index_oid = self._index_oid(bucket)
-        existing = await self.ioctx.get_omap(index_oid, [key])
+        self._index_writable(meta)
+        index_oid = self._index_oid_for(bucket, meta, key)
+        existing = await self._index_get(bucket, key, meta)
         if if_none_match and existing and \
                 not json.loads(existing[key]).get("delete_marker"):
             raise RGWError("PreconditionFailed", key)
@@ -1552,7 +1837,7 @@ class RGWLite:
         return {"bucket": bucket, "key": key, "oid": oid,
                 "index_oid": index_oid, "versioned": versioned,
                 "suspended": suspended, "version_id": version_id,
-                "deferred_cleanup": deferred,
+                "deferred_cleanup": deferred, "meta": meta,
                 "compression": meta.get("compression")}
 
     async def put_slo_manifest(self, bucket: str, key: str,
@@ -1690,6 +1975,8 @@ class RGWLite:
             key: json.dumps(entry).encode(),
         })
         await self._log(bucket, "put", key, etag)
+        await self._maybe_auto_reshard(bucket, ctx.get("meta", {}),
+                                       key)
         out = {"etag": etag, "size": size}
         if versioned:
             out["version_id"] = version_id
@@ -1697,9 +1984,9 @@ class RGWLite:
 
     async def _entry(self, bucket: str, key: str,
                      need: str = "READ") -> dict:
-        await self._check_bucket(bucket, need,
-                                 action="s3:GetObject", key=key)
-        kv = await self.ioctx.get_omap(self._index_oid(bucket), [key])
+        meta = await self._check_bucket(bucket, need,
+                                        action="s3:GetObject", key=key)
+        kv = await self._index_get(bucket, key, meta)
         if key not in kv:
             raise RGWError("NoSuchKey", f"{bucket}/{key}")
         entry = json.loads(kv[key])
@@ -1888,8 +2175,9 @@ class RGWLite:
         meta = await self._check_bucket(
             bucket, "WRITE", action="s3:DeleteObject", key=key)
         state = meta.get("versioning", "")
-        index_oid = self._index_oid(bucket)
-        kv = await self.ioctx.get_omap(index_oid, [key])
+        self._index_writable(meta)
+        index_oid = self._index_oid_for(bucket, meta, key)
+        kv = await self._index_get(bucket, key, meta)
         entry = json.loads(kv[key]) if key in kv else None
         if state == "enabled":
             # versioned DELETE always succeeds: data survives and a
@@ -1949,9 +2237,9 @@ class RGWLite:
                            marker: str = "",
                            max_keys: int = 1000) -> dict:
         """S3 ListObjects: sorted, prefix-filtered, marker-paginated."""
-        await self._check_bucket(bucket, "READ",
-                                 action="s3:ListBucket")
-        index = await self.ioctx.get_omap(self._index_oid(bucket))
+        meta = await self._check_bucket(bucket, "READ",
+                                        action="s3:ListBucket")
+        index = await self._index_all(bucket, meta)
         contents = []
         truncated = False
         # lazy parse: stop after filling the page + 1 (truncation

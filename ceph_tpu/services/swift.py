@@ -265,7 +265,7 @@ class SwiftFrontend:
                 continue
             if meta.get("owner") != uid:
                 continue
-            nobj, nbytes = await gw._bucket_usage(b)
+            nbytes, nobj = await gw._bucket_usage(b)
             out.append({"name": b, "count": nobj, "bytes": nbytes})
         return 200, {"content-type": "application/json",
                      "x-account-container-count": str(len(out))}, \
@@ -394,8 +394,9 @@ class SwiftFrontend:
             if dlo and not entry.get("slo"):
                 meta["dlo_manifest"] = dlo
             entry["meta"] = meta
-            await gw.ioctx.set_omap(gw._index_oid(container), {
-                obj: json.dumps(entry).encode()})
+            bmeta = await gw._bucket_meta(container)
+            await gw._index_set(container, bmeta, obj,
+                                json.dumps(entry).encode())
             return 202, {}, b""
         if method == "DELETE":
             await gw.delete_object(container, obj)

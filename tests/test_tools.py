@@ -117,6 +117,24 @@ def test_rgw_admin_tool(tmp_path, capsys):
             assert json.loads(capsys.readouterr().out)["quota"][
                 "max_objects"] == 5
             assert await tool("lc", "process") == 0
+            capsys.readouterr()
+            # index resharding through the admin surface
+            assert await tool("bucket", "reshard", "--bucket", "b1",
+                              "--num-shards", "4") == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["num_shards"] == 4 and out["objects"] == 1
+            assert await tool("bucket", "stats", "--bucket", "b1") == 0
+            assert json.loads(capsys.readouterr().out)[
+                "num_shards"] == 4
+            assert (await gw.get_object("b1", "k"))["data"] == b"x" * 500
+            # deferred GC through the admin surface
+            gw_gc = RGWLite(io, users=RGWUsers(io),
+                            gc_min_wait=3600).as_user("alice")
+            await gw_gc.delete_object("b1", "k")
+            assert await tool("gc", "list") == 0
+            assert len(json.loads(capsys.readouterr().out)) == 1
+            assert await tool("gc", "process") == 0   # not yet expired
+            assert json.loads(capsys.readouterr().out)["reaped"] == 0
             await rados.shutdown()
         finally:
             await cluster.stop()
