@@ -127,6 +127,58 @@ async def _mon(rados: Rados, prefix: str, as_json: bool,
     return 0
 
 
+async def _fs_volumes(rados: Rados, args, as_json: bool) -> int:
+    """``ceph fs subvolume`` / ``fs subvolumegroup`` verbs (reference
+    mgr volumes module surface), driven over a mounted CephFS."""
+    from ceph_tpu.client.fs import CephFS, FSError
+    from ceph_tpu.services.volumes import VolumeManager
+
+    fs = await CephFS.connect(rados, args.fs_name)
+    await fs.mount()
+    try:
+        vm = VolumeManager(fs)
+        group = getattr(args, "group", None)
+        try:
+            if args.action == "subvolumegroup":
+                if args.verb == "create":
+                    await vm.group_create(args.name)
+                    out = None
+                elif args.verb == "rm":
+                    await vm.group_rm(args.name)
+                    out = None
+                else:
+                    out = await vm.group_ls()
+            elif args.verb == "create":
+                out = {"path": await vm.create(
+                    args.name, group, size=args.size)}
+            elif args.verb == "rm":
+                await vm.rm(args.name, group, force=args.force)
+                out = None
+            elif args.verb == "getpath":
+                out = await vm.getpath(args.name, group)
+            elif args.verb == "info":
+                out = await vm.info(args.name, group)
+            elif args.verb == "snapshot":
+                if args.snap_verb == "create":
+                    out = {"snapid": await vm.snapshot_create(
+                        args.name, args.snap, group)}
+                elif args.snap_verb == "rm":
+                    await vm.snapshot_rm(args.name, args.snap, group)
+                    out = None
+                else:
+                    out = await vm.snapshot_ls(args.name, group)
+            else:
+                out = await vm.ls(group)
+        except FSError as e:
+            print(f"Error: {e} (rc={e.rc})", file=sys.stderr)
+            return 1
+        if out is not None:
+            _print(out, as_json)
+        return 0
+    finally:
+        await fs.unmount()
+
+
 async def _dispatch(args, rados: Rados) -> int:
     j = args.format == "json"
     cmd = args.cmd
@@ -183,6 +235,8 @@ async def _dispatch(args, rados: Rados) -> int:
         if args.action == "rm":
             return await _mon(rados, "config-key rm", j, key=args.key)
         return await _mon(rados, "config-key ls", j)
+    if cmd == "insights":
+        return await _mon(rados, "insights", j)
     if cmd == "fs":
         if args.action == "new":
             return await _mon(rados, "fs new", j, fs_name=args.fs_name,
@@ -194,6 +248,8 @@ async def _dispatch(args, rados: Rados) -> int:
             return await _mon(rados, "fs set_max_mds", j,
                               fs_name=args.fs_name,
                               max_mds=args.max_mds)
+        if args.action in ("subvolume", "subvolumegroup"):
+            return await _fs_volumes(rados, args, j)
         return await _mon(rados, "fs ls", j)
     if cmd == "mds":
         return await _mon(rados, "mds stat", j)
@@ -562,6 +618,34 @@ def build_parser() -> argparse.ArgumentParser:
     fm = fs_sub.add_parser("set_max_mds")
     fm.add_argument("fs_name")
     fm.add_argument("max_mds", type=int)
+    sv = fs_sub.add_parser("subvolume")
+    sv_sub = sv.add_subparsers(dest="verb", required=True)
+    svc = sv_sub.add_parser("create")
+    svc.add_argument("name")
+    svc.add_argument("--size", type=int, default=0)
+    svr = sv_sub.add_parser("rm")
+    svr.add_argument("name")
+    svr.add_argument("--force", action="store_true")
+    sv_sub.add_parser("ls")
+    for vname in ("getpath", "info"):
+        x = sv_sub.add_parser(vname)
+        x.add_argument("name")
+    svs = sv_sub.add_parser("snapshot")
+    svs.add_argument("snap_verb", choices=["create", "rm", "ls"])
+    svs.add_argument("name")
+    svs.add_argument("snap", nargs="?", default="")
+    for sp_ in (svc, svr, *[sv_sub.choices[v]
+                            for v in ("ls", "getpath", "info")], svs):
+        sp_.add_argument("--group", default=None)
+        sp_.add_argument("--fs-name", dest="fs_name",
+                         default="cephfs")
+    svg = fs_sub.add_parser("subvolumegroup")
+    svg.add_argument("verb", choices=["create", "rm", "ls"])
+    svg.add_argument("name", nargs="?", default="")
+    svg.add_argument("--fs-name", dest="fs_name", default="cephfs")
+
+    ins = sub.add_parser("insights")
+    ins.add_argument("action", nargs="?", default="report")
     mds = sub.add_parser("mds")
     mds.add_argument("action", choices=["stat"])
     dev = sub.add_parser("device")

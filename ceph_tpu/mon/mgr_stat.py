@@ -219,6 +219,8 @@ class MgrStatMonitor(PaxosService):
                                                       {}))
         if name == "telemetry show":
             return CommandResult(data=self.digest.get("telemetry", {}))
+        if name == "insights":
+            return CommandResult(data=self.digest.get("insights", {}))
         if name == "osd pool autoscale-status":
             return CommandResult(data=self.digest.get("pg_autoscale",
                                                       {}))

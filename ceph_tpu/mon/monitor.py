@@ -603,7 +603,7 @@ class Monitor:
         # pgmap-digest reads and mgr-module surfaces live on the
         # mgr-stat service (PGMap / balancer / progress / crash)
         if word in ("pg", "df", "balancer", "progress", "crash",
-                    "device", "telemetry", "orch"):
+                    "device", "telemetry", "orch", "insights"):
             return self.mgr_stat
         if word == "config-key":
             return self.config_monitor

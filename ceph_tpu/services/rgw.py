@@ -1282,7 +1282,10 @@ class RGWLite:
         gen = int(meta.get("index_gen", 0))
         if shards == 1 and gen == 0:
             return [f"rgw.bucket.index.{bucket}"]
-        return [f"rgw.bucket.index.{bucket}.g{gen}.{s}"
+        # NUL separators: bucket names may legally contain dots and
+        # digits, so a dotted suffix would collide with the legacy oid
+        # of a bucket literally named "<bucket>.g<gen>.<n>"
+        return [f"rgw.bucket.index\x00{bucket}\x00g{gen}.{s}"
                 for s in range(shards)]
 
     @staticmethod
@@ -1373,22 +1376,31 @@ class RGWLite:
         for oid in self._index_shard_oids(bucket, new_meta):
             await self.ioctx.operate(oid, ObjectOperation().create())
         moved: set[str] = set()
-        for _sweep in range(2):
+        placed: dict[str, str] = {}     # key -> new shard oid
+        for sweep in range(2):
+            merged: dict[str, bytes] = {}
             for old in old_oids:
                 try:
-                    kv = await self.ioctx.get_omap(old)
+                    merged.update(await self.ioctx.get_omap(old))
                 except RadosError as e:
                     if e.rc != -2:
                         raise
-                    continue
-                batches: dict[str, dict] = {}
-                for k, v in kv.items():
-                    batches.setdefault(
-                        self._index_oid_for(bucket, new_meta, k),
-                        {})[k] = v
-                    moved.add(k)
-                for oid, kvs in batches.items():
-                    await self.ioctx.set_omap(oid, kvs)
+            batches: dict[str, dict] = {}
+            for k, v in merged.items():
+                oid = self._index_oid_for(bucket, new_meta, k)
+                batches.setdefault(oid, {})[k] = v
+                placed[k] = oid
+                moved.add(k)
+            for oid, kvs in batches.items():
+                await self.ioctx.set_omap(oid, kvs)
+            if sweep == 1:
+                # a DELETE that raced the flag dropped its key from an
+                # old shard after sweep 0 copied it: the copy must
+                # propagate removals too, or the flip resurrects an
+                # index entry whose data is gone
+                for k in set(placed) - set(merged):
+                    await self.ioctx.rm_omap_keys(placed[k], [k])
+                    moved.discard(k)
         final = dict(new_meta)
         final.pop("resharding", None)
         final.pop("reshard_target", None)
@@ -1697,6 +1709,8 @@ class RGWLite:
     async def create_bucket(self, bucket: str) -> None:
         if self.user == ANONYMOUS:
             raise RGWError("AccessDenied", "anonymous cannot create")
+        if not bucket or any(ord(c) < 0x20 for c in bucket):
+            raise RGWError("InvalidBucketName", repr(bucket))
         existing = await self.list_buckets()
         if bucket in existing:
             raise RGWError("BucketAlreadyExists", bucket)
@@ -1834,6 +1848,15 @@ class RGWLite:
             # retrievable through the version API — never clean it
             if not old.get("version_id"):
                 await self._remove_entry_data(bucket, key, old)
+        if self.gc_min_wait > 0 and "\x00" not in oid:
+            # deferred GC must NEVER share an oid with a later write:
+            # an in-place striped overwrite would inherit the old size
+            # xattr + tail stripes, and representation changes would
+            # leak.  Unique per-write tail oids (the reference's tail
+            # tag) make deferral safe for every shape.
+            import secrets as _secrets
+
+            oid = f"{oid}\x00g\x00{_secrets.token_hex(8)}"
         return {"bucket": bucket, "key": key, "oid": oid,
                 "index_oid": index_oid, "versioned": versioned,
                 "suspended": suspended, "version_id": version_id,

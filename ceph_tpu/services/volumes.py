@@ -1,0 +1,152 @@
+"""Volumes: CephFS subvolume management (mgr volumes module role).
+
+Reference src/pybind/mgr/volumes: subvolumes are operator-managed
+directory trees under ``/volumes/<group>/<name>`` with a ``.meta``
+sidecar (the reference stores the same under a uuid indirection and a
+``.meta`` config file), created/removed/listed through ``ceph fs
+subvolume`` verbs.  Subvolume snapshots ride the MDS snap realms
+(``.snap`` of the subvolume root).
+
+-lite divergence: no uuid indirection layer and no async purge queue —
+removal walks the tree inline (trees are operator-scale here); quota is
+recorded in the meta sidecar (advisory, as before the reference wired
+subvolume quotas into the MDS).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ceph_tpu.client.fs import CephFS, FSError
+
+NO_GROUP = "_nogroup"
+META = ".meta"
+ENOENT = -2
+EEXIST = -17
+ENOTEMPTY = -39
+EINVAL = -22
+
+
+class VolumeManager:
+    def __init__(self, fs: CephFS):
+        self.fs = fs
+
+    # -- paths -------------------------------------------------------------
+    @staticmethod
+    def _group_path(group: str | None) -> str:
+        return f"/volumes/{group or NO_GROUP}"
+
+    @classmethod
+    def _subvol_path(cls, name: str, group: str | None) -> str:
+        if "/" in name or name.startswith("."):
+            raise FSError(EINVAL, f"bad subvolume name {name!r}")
+        return f"{cls._group_path(group)}/{name}"
+
+    # -- groups ------------------------------------------------------------
+    async def group_create(self, group: str, mode: int = 0o755) -> None:
+        if "/" in group or group.startswith((".", "_")):
+            raise FSError(EINVAL, f"bad group name {group!r}")
+        await self.fs.mkdirs(self._group_path(group), mode)
+
+    async def group_ls(self) -> list[str]:
+        try:
+            names = await self.fs.readdir("/volumes")
+        except FSError as e:
+            if e.rc != ENOENT:
+                raise
+            return []
+        return sorted(n for n in names if n != NO_GROUP)
+
+    async def group_rm(self, group: str) -> None:
+        path = self._group_path(group)
+        if await self.fs.readdir(path):
+            raise FSError(ENOTEMPTY,
+                          f"group {group!r} still has subvolumes")
+        await self.fs.rmdir(path)
+
+    # -- subvolumes ---------------------------------------------------------
+    async def create(self, name: str, group: str | None = None,
+                     mode: int = 0o755, size: int = 0) -> str:
+        """Create the subvolume directory + meta sidecar; returns the
+        data path handed to mounters (``fs subvolume getpath``)."""
+        path = self._subvol_path(name, group)
+        try:
+            await self.fs.stat(path)
+            raise FSError(EEXIST, f"subvolume {name!r} exists")
+        except FSError as e:
+            if e.rc != ENOENT:
+                raise
+        await self.fs.mkdirs(path, mode)
+        await self.fs.write_file(f"{path}/{META}", json.dumps({
+            "name": name, "group": group or NO_GROUP,
+            "created": time.time(), "mode": mode, "size": size,
+            "state": "complete",
+        }).encode())
+        return path
+
+    async def ls(self, group: str | None = None) -> list[str]:
+        try:
+            names = await self.fs.readdir(self._group_path(group))
+        except FSError as e:
+            if e.rc != ENOENT:
+                raise
+            return []
+        return sorted(names)
+
+    async def getpath(self, name: str, group: str | None = None) -> str:
+        path = self._subvol_path(name, group)
+        await self.fs.stat(path)           # ENOENT surfaces here
+        return path
+
+    async def info(self, name: str, group: str | None = None) -> dict:
+        path = await self.getpath(name, group)
+        meta = json.loads(await self.fs.read_file(f"{path}/{META}"))
+        entries = await self.fs.readdir(path)
+        meta["path"] = path
+        meta["entries"] = sum(1 for n in entries if n != META)
+        meta["snapshots"] = sorted(await self.snapshot_ls(name, group))
+        return meta
+
+    async def rm(self, name: str, group: str | None = None,
+                 force: bool = False) -> None:
+        """Remove the subvolume tree.  Refuses while snapshots cover
+        it (matching the reference's snapshot-retention refusal)
+        unless ``force`` also removes the snapshots first."""
+        path = await self.getpath(name, group)
+        snaps = await self.snapshot_ls(name, group)
+        if snaps:
+            if not force:
+                raise FSError(ENOTEMPTY,
+                              f"subvolume {name!r} has snapshots "
+                              f"{snaps}; use force")
+            for s in snaps:
+                await self.fs.rmsnap(path, s)
+        await self._rmtree(path)
+
+    async def _rmtree(self, path: str) -> None:
+        """Depth-first removal (the reference defers this to an async
+        purge-queue thread; inline at -lite scale)."""
+        for name, d in sorted((await self.fs.readdir(path)).items()):
+            child = f"{path}/{name}"
+            if d.get("type") == "dir":
+                await self._rmtree(child)
+            else:
+                await self.fs.unlink(child)
+        await self.fs.rmdir(path)
+
+    # -- snapshots (subvolume .snap realms) ---------------------------------
+    async def snapshot_create(self, name: str, snap: str,
+                              group: str | None = None) -> int:
+        path = await self.getpath(name, group)
+        return await self.fs.mksnap(path, snap)
+
+    async def snapshot_ls(self, name: str,
+                          group: str | None = None) -> list[str]:
+        path = await self.getpath(name, group)
+        return sorted(await self.fs.listsnaps(path))
+
+    async def snapshot_rm(self, name: str, snap: str,
+                          group: str | None = None) -> None:
+        path = await self.getpath(name, group)
+        await self.fs.rmsnap(path, snap)

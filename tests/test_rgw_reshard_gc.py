@@ -46,7 +46,8 @@ def test_reshard_preserves_objects_and_ops():
             objects = set(await ioctx.list_objects())
             assert "rgw.bucket.index.b" not in objects
             assert sum(1 for o in objects
-                       if o.startswith("rgw.bucket.index.b.g1.")) == 4
+                       if o.startswith(
+                           "rgw.bucket.index\x00b\x00g1.")) == 4
             # listing merges shards; every object still readable
             listing = await gw.list_objects("b")
             assert [c["key"] for c in listing["contents"]] == \
@@ -198,6 +199,94 @@ def test_gc_spares_recreated_objects():
             assert await gw.gc_process(now=time.time() + 200) == 1
             assert [o for o in await ioctx.list_objects()
                     if o.startswith("rgw.obj.b/")] == []
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_gc_striped_overwrite_and_shape_change():
+    """Striped overwrites with GC on must not inherit the old size
+    xattr / tail stripes, and striped->plain shape changes must not
+    leak the old stripes: every write gets a unique tail oid."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, ioctx = await _gw(rados, gc_min_wait=60.0)
+            await gw.create_bucket("b")
+            big = bytes(range(256)) * (5 * 4096)      # 5 MiB, striped
+            smaller = b"\xab" * (9 * 512 * 1024)      # 4.5 MiB, striped
+            await gw.put_object("b", "k", big)
+            assert (await gw.head_object("b", "k"))["striped"]
+            await gw.put_object("b", "k", smaller)
+            got = await gw.get_object("b", "k")
+            assert got["size"] == len(smaller)
+            assert got["data"] == smaller             # no stale tail
+            # striped -> plain shape change
+            await gw.put_object("b", "k", b"tiny")
+            assert (await gw.get_object("b", "k"))["data"] == b"tiny"
+            # reaping the two dead generations leaves the live object
+            assert await gw.gc_process(now=time.time() + 61) == 2
+            assert (await gw.get_object("b", "k"))["data"] == b"tiny"
+            # exactly one data generation remains on disk
+            gens = {o.split("\x00")[0] for o in
+                    await ioctx.list_objects()
+                    if o.startswith("rgw.obj.b/")}
+            datas = [o for o in await ioctx.list_objects()
+                     if o.startswith("rgw.obj.b/")]
+            assert gens == {"rgw.obj.b/k"} and len(datas) == 1
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_reshard_propagates_racing_delete():
+    """A DELETE that lands on an old shard between the two copy
+    sweeps must not be resurrected by the flip."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, ioctx = await _gw(rados)
+            await gw.create_bucket("b")
+            for i in range(4):
+                await gw.put_object("b", f"k{i}", b"x")
+            old_oid = "rgw.bucket.index.b"
+            orig = ioctx.get_omap
+            state = {"sweeps": 0}
+
+            async def hooked(oid, keys=None):
+                out = await (orig(oid) if keys is None
+                             else orig(oid, keys))
+                if oid == old_oid and keys is None:
+                    state["sweeps"] += 1
+                    if state["sweeps"] == 1:
+                        # raced DELETE: key vanishes from the old
+                        # shard after sweep 0 already copied it
+                        await ioctx.rm_omap_keys(old_oid, ["k1"])
+                return out
+
+            ioctx.get_omap = hooked
+            try:
+                res = await gw.reshard_bucket("b", 2)
+            finally:
+                ioctx.get_omap = orig
+            assert res["objects"] == 3
+            keys = [c["key"] for c in
+                    (await gw.list_objects("b"))["contents"]]
+            assert keys == ["k0", "k2", "k3"]       # k1 stays dead
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_bucket_names_with_control_chars_refused():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, _ = await _gw(rados)
+            for bad in ("", "a\x00b", "a\nb"):
+                with pytest.raises(RGWError) as ei:
+                    await gw.create_bucket(bad)
+                assert ei.value.code == "InvalidBucketName"
         finally:
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())

@@ -1,0 +1,154 @@
+"""mgr volumes (CephFS subvolumes) + insights modules.
+
+Reference surfaces: src/pybind/mgr/volumes (fs subvolume/
+subvolumegroup verbs over /volumes trees with .meta sidecars),
+src/pybind/mgr/insights (health history + crash summary report).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS, FSError
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.volumes import VolumeManager
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _fs_cluster():
+    cluster = DevCluster(n_mons=1, n_osds=3)
+    await cluster.start()
+    admin = await cluster.client()
+    await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                            min_size=2)
+    await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                            min_size=2)
+    await cluster.start_mds(name="a", block_size=4096)
+    rados = await cluster.client("client.fs")
+    fs = await CephFS.connect(rados)
+    await fs.mount()
+    return cluster, admin, rados, fs
+
+
+def test_subvolume_lifecycle():
+    async def run():
+        cluster, admin, rados, fs = await _fs_cluster()
+        try:
+            vm = VolumeManager(fs)
+            path = await vm.create("db", size=1 << 20)
+            assert path == "/volumes/_nogroup/db"
+            assert await vm.ls() == ["db"]
+            assert await vm.getpath("db") == path
+            # the subvolume is usable as a plain directory tree
+            await fs.write_file(f"{path}/table", b"rows")
+            assert await fs.read_file(f"{path}/table") == b"rows"
+            info = await vm.info("db")
+            assert info["size"] == 1 << 20
+            assert info["entries"] == 1
+            assert info["state"] == "complete"
+            # duplicate create refuses
+            with pytest.raises(FSError):
+                await vm.create("db")
+            # groups partition the namespace
+            await vm.group_create("prod")
+            assert await vm.group_ls() == ["prod"]
+            p2 = await vm.create("db", group="prod")
+            assert p2 == "/volumes/prod/db"
+            assert await vm.ls(group="prod") == ["db"]
+            # removal is recursive; the group must be empty to die
+            await fs.mkdir(f"{path}/deep")
+            await fs.write_file(f"{path}/deep/f", b"x")
+            await vm.rm("db")
+            assert await vm.ls() == []
+            with pytest.raises(FSError):
+                await vm.group_rm("prod")
+            await vm.rm("db", group="prod")
+            await vm.group_rm("prod")
+            assert await vm.group_ls() == []
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_subvolume_snapshots():
+    async def run():
+        cluster, admin, rados, fs = await _fs_cluster()
+        try:
+            vm = VolumeManager(fs)
+            path = await vm.create("snappy")
+            await fs.write_file(f"{path}/keep", b"v1")
+            await vm.snapshot_create("snappy", "s1")
+            await fs.write_file(f"{path}/keep", b"v2")
+            assert await vm.snapshot_ls("snappy") == ["s1"]
+            # snapshot content is browsable through .snap
+            assert await fs.read_file(
+                f"{path}/.snap/s1/keep") == b"v1"
+            assert await fs.read_file(f"{path}/keep") == b"v2"
+            # rm refuses while snapshots exist, force removes them
+            with pytest.raises(FSError):
+                await vm.rm("snappy")
+            await vm.rm("snappy", force=True)
+            assert await vm.ls() == []
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_insights_report():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=2)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="p",
+                                        pg_num=8, size=2)
+            assert r["rc"] == 0, r
+            # a posted crash must show up unarchived in the report
+            r = await rados.mon_command("crash post", report={
+                "crash_id": "2026-07-31_deadbeef",
+                "entity": "osd.0", "timestamp": 1753900000.0,
+                "backtrace": ["frame"],
+            })
+            assert r["rc"] == 0, r
+            mgr = await cluster.start_mgr()
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                r = await rados.mon_command("insights")
+                rep = r["data"]
+                if r["rc"] == 0 and rep.get("crash_count", 0) > 0:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, rep
+                await asyncio.sleep(0.2)
+            assert "2026-07-31_deadbeef" in rep["unarchived_crashes"]
+            # pools with too few replicas etc. raise health checks the
+            # history accumulates; at minimum the dict exists
+            assert isinstance(rep["health_history"], dict)
+            assert rep["generated"] > 0
+            # archiving the crash clears it from the next report
+            r = await rados.mon_command("crash archive",
+                                        id="2026-07-31_deadbeef")
+            assert r["rc"] == 0, r
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                rep = (await rados.mon_command("insights"))["data"]
+                if rep.get("crash_count") == 0:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, rep
+                await asyncio.sleep(0.2)
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
