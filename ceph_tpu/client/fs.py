@@ -187,23 +187,38 @@ class CephFS:
         self.block_size = 1 << 22
         self.data: IoCtx | None = None
         self.lease_ttl = 2.0
-        self._tid = 0
         self._futs: dict[int, asyncio.Future] = {}
         # (parent_ino, name) -> (dentry, lease expiry): the dentry lease
         # cache (Client::Dentry + lease_ttl role)
         self._dcache: dict[tuple[int, str], tuple[dict, float]] = {}
         self._snap_ioctx: dict[int, IoCtx] = {}
         self._mounted = False
-        # ride the rados client's messenger: register our reply hook
-        self._orig_dispatch = rados.ms_dispatch
+        # session-unique tid space: two mounts sharing one rados
+        # messenger must never mistake each other's replies
+        import secrets as _secrets
+
+        self._tid = _secrets.randbits(40) << 20
+        # ride the rados client's messenger: register our reply hook,
+        # CHAINING to whatever dispatcher is already installed (an
+        # earlier CephFS mount or the rados client itself) so stacked
+        # mounts on one handle all keep receiving their traffic
+        self._prev_dispatcher = getattr(rados.msgr, "dispatcher",
+                                        None) or rados
+        self._orig_dispatch = self._prev_dispatcher.ms_dispatch
         rados.msgr.set_dispatcher(self)
 
     # -- dispatcher chaining ----------------------------------------------
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if msg.type == "mds_reply":
-            fut = self._futs.pop(int(msg.data.get("tid", 0)), None)
+            tid = int(msg.data.get("tid", 0))
+            fut = self._futs.pop(tid, None)
             if fut is not None and not fut.done():
                 fut.set_result(msg.data)
+                return
+            if fut is None and isinstance(self._prev_dispatcher,
+                                          CephFS):
+                # not ours: a stacked earlier mount may own this tid
+                await self._orig_dispatch(conn, msg)
             return
         await self._orig_dispatch(conn, msg)
 
@@ -224,7 +239,11 @@ class CephFS:
 
     async def unmount(self) -> None:
         self._mounted = False
-        self.rados.msgr.set_dispatcher(self.rados)
+        if getattr(self.rados.msgr, "dispatcher", None) is self:
+            # restore the dispatcher BELOW us; an unmount out of stack
+            # order leaves our (inert, forwarding) hook in place
+            # rather than cutting a still-live mount out of the chain
+            self.rados.msgr.set_dispatcher(self._prev_dispatcher)
 
     async def _addr_for_rank(self, rank: int) -> str:
         addr = self._rank_addrs.get(rank)

@@ -347,10 +347,14 @@ class MDSDaemon:
         for e in entries:
             op = e.get("op")
             token = str(e.get("token", ""))
-            if op == "rename_export_intent":
+            if op in ("rename_export_intent", "link_export_intent",
+                      "unlink_remote_intent"):
                 self._open_intents[token] = e
             elif op in ("rename_export_finish",
-                        "rename_export_abort"):
+                        "rename_export_abort",
+                        "link_export_finish", "link_export_abort",
+                        "unlink_remote_finish",
+                        "unlink_remote_abort"):
                 self._open_intents.pop(token, None)
         if entries:
             await self._compact_journal()
@@ -366,23 +370,53 @@ class MDSDaemon:
         import json as _json
 
         for token, e in list(self._open_intents.items()):
-            sp, sn = int(e["src_parent"]), str(e["src_name"])
+            op = str(e.get("op"))
             ino = int(e.get("ino", 0))
             committed = await self._rename_resolve_abort(token)
-            if committed:
-                fin = {"op": "rename_export_finish",
-                       "src_parent": sp, "src_name": sn, "ino": ino,
-                       "token": token}
-                await self._journal(fin)
-                await self._apply(fin)
-                await self._rename_clear(token)
-                log.dout(1, "%s: completed dangling cross-rank "
-                         "rename of %s", self.entity, sn)
-            else:
-                await self._journal({"op": "rename_export_abort",
-                                     "src_parent": sp,
-                                     "src_name": sn, "ino": ino,
+            if not committed:
+                abort_op = {"rename_export_intent":
+                            "rename_export_abort",
+                            "link_export_intent": "link_export_abort",
+                            "unlink_remote_intent":
+                            "unlink_remote_abort"}[op]
+                await self._journal({"op": abort_op, "ino": ino,
+                                     **{k: e[k] for k in
+                                        ("src_parent", "src_name")
+                                        if k in e},
                                      "token": token})
+                continue
+            if op == "rename_export_intent":
+                fin = {"op": "rename_export_finish",
+                       "src_parent": int(e["src_parent"]),
+                       "src_name": str(e["src_name"]), "ino": ino,
+                       "token": token}
+            elif op == "link_export_intent":
+                # the destination materialized the remote name before
+                # the crash: rebuild the finish from CURRENT primary
+                # state (it was never incremented — the finish is what
+                # increments, and a journaled finish clears the intent)
+                pp, pn = int(e["pp"]), str(e["pn"])
+                primary = dict(await self._get_dentry(pp, pn))
+                primary["nlink"] = int(primary.get("nlink", 1)) + 1
+                rec = await self._anchor_get(ino) or \
+                    {"primary": [pp, pn], "remotes": []}
+                fin = {"op": "link_export_finish", "pp": pp, "pn": pn,
+                       "ino": ino, "primary_dentry": primary,
+                       "anchor": {"primary": rec["primary"],
+                                  "remotes": list(rec["remotes"])
+                                  + [[int(e["parent"]),
+                                      str(e["name"])]]},
+                       "token": token}
+            else:                       # unlink_remote_intent
+                fin = {"op": "unlink_remote_finish",
+                       "parent": int(e["parent"]),
+                       "name": str(e["name"]), "ino": ino,
+                       "token": token}
+            await self._journal(fin)
+            await self._apply(fin)
+            await self._rename_clear(token)
+            log.dout(1, "%s: completed dangling %s (token %s)",
+                     self.entity, op, token)
         # sweep long-dead markers (aborts whose import never arrived,
         # commits re-created by a destination replay)
         try:
@@ -398,9 +432,12 @@ class MDSDaemon:
                                _FRAME.pack(len(payload)) + payload)
         self.journal_len += 1
         op = entry.get("op")
-        if op == "rename_export_intent":
+        if op in ("rename_export_intent", "link_export_intent",
+                  "unlink_remote_intent"):
             self._open_intents[str(entry.get("token", ""))] = entry
-        elif op in ("rename_export_finish", "rename_export_abort"):
+        elif op in ("rename_export_finish", "rename_export_abort",
+                    "link_export_finish", "link_export_abort",
+                    "unlink_remote_finish", "unlink_remote_abort"):
             self._open_intents.pop(str(entry.get("token", "")), None)
 
     async def _compact_journal(self) -> None:
@@ -675,6 +712,24 @@ class MDSDaemon:
                 await self._set_dentry(int(e["parent"]),
                                        str(e["name"]),
                                        dict(e["dentry"]))
+                if dict(e["dentry"]).get("type") == "dir":
+                    # imported directory: its ancestry chain now runs
+                    # through THIS rank's territory — refresh the
+                    # back-pointer and drop stale auth resolutions
+                    await self.meta.operate(
+                        dirfrag_oid(int(e["ino"])),
+                        ObjectOperation().create().set_xattr(
+                            "parent", str(int(e["parent"])).encode()
+                        ),
+                    )
+                    self._auth_cache.clear()
+                if int(e.get("purge_dir_ino", 0)):
+                    try:
+                        await self.meta.remove(
+                            dirfrag_oid(int(e["purge_dir_ino"])))
+                    except RadosError as err:
+                        if err.rc != ENOENT:
+                            raise
                 if int(e.get("purge_ino", 0)):
                     await self._purge_file(int(e["purge_ino"]),
                                            int(e.get("purge_size",
@@ -690,8 +745,51 @@ class MDSDaemon:
             except RadosError as err:
                 if err.rc != ENOENT:
                     raise
-        elif op in ("rename_export_intent", "rename_export_abort"):
+            # an exported DIRECTORY's descendants now resolve through
+            # the destination's chain; cached auths are stale
+            self._auth_cache.clear()
+        elif op in ("rename_export_intent", "rename_export_abort",
+                    "link_export_intent", "link_export_abort",
+                    "unlink_remote_intent", "unlink_remote_abort"):
             pass          # journal markers; resolved by replay repair
+        elif op == "import_link":
+            # cross-rank link, destination half: the commit claim
+            # gates the remote dentry exactly like import_dentry
+            ok = True
+            if e.get("token"):
+                ok = await self._rename_mark_commit(str(e["token"]))
+            if ok:
+                await self._set_dentry(int(e["parent"]),
+                                       str(e["name"]),
+                                       dict(e["remote_dentry"]))
+        elif op == "link_export_finish":
+            # cross-rank link, primary half: nlink + anchor land only
+            # after the destination's commit is known (idempotent
+            # absolute writes on replay)
+            await self._set_dentry(int(e["pp"]), str(e["pn"]),
+                                   dict(e["primary_dentry"]))
+            await self._anchor_put(int(e["ino"]), dict(e["anchor"]))
+        elif op == "update_primary":
+            # cross-rank remote-unlink, primary half (claim-gated)
+            ok = True
+            if e.get("token"):
+                ok = await self._rename_mark_commit(str(e["token"]))
+            if ok:
+                await self._set_dentry(int(e["pp"]), str(e["pn"]),
+                                       dict(e["primary_dentry"]))
+                await self._anchor_put(int(e["ino"]), e.get("anchor"))
+        elif op == "unlink_remote_finish":
+            # cross-rank remote-unlink, name half: drop the remote
+            # dentry only — the primary's rank already adjusted
+            # nlink/anchor under the commit claim
+            try:
+                await self.meta.operate(
+                    dirfrag_oid(int(e["parent"])),
+                    ObjectOperation().omap_rm([str(e["name"])]),
+                )
+            except RadosError as err:
+                if err.rc != ENOENT:
+                    raise
         elif op == "setattr":
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dict(e["dentry"]))
@@ -841,6 +939,29 @@ class MDSDaemon:
         _, _, primary = await self._primary_of(int(dentry["ino"]),
                                                snapid=snapid)
         return {**primary, "remote": True}
+
+    async def _plan_unlink_guard(self, dentry: dict) -> None:
+        """_unlink_plan mutates the primary dentry (remote drop) or
+        promotes the first remote IN PLACE; decline when that dirfrag
+        belongs to another rank — cross-rank link teardown must funnel
+        through the update_primary protocol, not a foreign omap
+        write."""
+        ino = int(dentry.get("ino", 0))
+        if dentry.get("remote"):
+            rec = await self._anchor_get(ino)
+            if rec is not None and await self._auth_rank(
+                    int(rec["primary"][0])) != self.rank:
+                raise MDSError(
+                    EXDEV, "replaces one name of a cross-rank link; "
+                    "unlink it first")
+        elif int(dentry.get("nlink", 1)) > 1:
+            rec = await self._anchor_get(ino)
+            if rec is not None and rec["remotes"] and \
+                    await self._auth_rank(
+                        int(rec["remotes"][0][0])) != self.rank:
+                raise MDSError(
+                    EXDEV, "would promote a foreign remote; "
+                    "remove the remote name first")
 
     async def _unlink_plan(self, parent: int, name: str,
                            dentry: dict) -> dict:
@@ -1010,9 +1131,11 @@ class MDSDaemon:
                 # routed by (exports are administrative, not load)
                 self._note_pop(dino)
             if op in ("lookup", "readdir", "session", "lssnap",
-                      "rename", "get_load"):
-                # reads need no lock; rename manages its own (it must
-                # release the mutate lock across its peer RPC)
+                      "rename", "link", "unlink", "setattr",
+                      "get_load"):
+                # reads need no lock; rename/link/unlink/setattr
+                # manage their own (each must release the mutate lock
+                # across a cross-rank peer RPC)
                 result = await handler(d)
             else:
                 async with self._mutate:
@@ -1503,48 +1626,217 @@ class MDSDaemon:
 
     async def _req_link(self, d: dict) -> dict:
         """Hard link (Server::handle_client_link): a REMOTE dentry at
-        (parent, name) referencing the primary's inode."""
+        (parent, name) referencing the primary's inode.  Routed by the
+        SOURCE parent, so the primary's rank runs this; a foreign
+        destination parent runs the witness-lite export protocol
+        (an import_link peer request gated by the atomic commit
+        marker), keeping every anchor write on the primary's rank."""
+        import secrets as _secrets
+
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["parent"]), str(d["name"])
-        self._guard_busy((sp, sn), (dp, dn))
-        if await self._auth_rank(sp) != self.rank \
-                or await self._auth_rank(dp) != self.rank:
-            # hard links across rank boundaries would put the anchor
-            # and primary under different authorities
-            raise MDSError(EXDEV, "link crosses a rank boundary")
-        dentry = await self._get_dentry(sp, sn)
-        if dentry.get("remote"):
-            # keep link chains flat: always link to the primary
-            sp, sn, dentry = await self._primary_of(int(dentry["ino"]))
-        if dentry["type"] != "file":
-            raise MDSError(EPERM, "hard links are file-only")
-        await self._ensure_absent(dp, dn)
-        ino = int(dentry["ino"])
-        primary = dict(dentry)
-        primary["nlink"] = int(dentry.get("nlink", 1)) + 1
-        rec = await self._anchor_get(ino) or \
-            {"primary": [sp, sn], "remotes": []}
-        anchor = {"primary": rec["primary"],
-                  "remotes": list(rec["remotes"]) + [[dp, dn]]}
-        entry = {"op": "link", "parent": dp, "name": dn, "ino": ino,
-                 "remote_dentry": {"type": "file", "remote": True,
-                                   "ino": ino},
-                 "pp": sp, "pn": sn, "primary_dentry": primary,
-                 "anchor": anchor}
-        await self._journal(entry)
-        await self._apply(entry)
+        async with self._mutate:
+            # authority may have moved while this op queued on the
+            # lock (a balancer export): re-check, as the locked
+            # handler branch does for other mutations
+            await self._check_auth(d, "link")
+            self._guard_busy((sp, sn), (dp, dn))
+            dentry = await self._get_dentry(sp, sn)
+            if dentry.get("remote"):
+                # keep link chains flat: always link to the primary
+                sp, sn, dentry = await self._primary_of(
+                    int(dentry["ino"]))
+                if await self._auth_rank(sp) != self.rank:
+                    raise MDSError(
+                        EXDEV, "link through a foreign primary; "
+                        "link from the primary name instead")
+                # the primary name itself may be pinned by another
+                # in-flight cross-rank link/unlink
+                self._guard_busy((sp, sn))
+            if dentry["type"] != "file":
+                raise MDSError(EPERM, "hard links are file-only")
+            ino = int(dentry["ino"])
+            primary = dict(dentry)
+            primary["nlink"] = int(dentry.get("nlink", 1)) + 1
+            rec = await self._anchor_get(ino) or \
+                {"primary": [sp, sn], "remotes": []}
+            anchor = {"primary": rec["primary"],
+                      "remotes": list(rec["remotes"]) + [[dp, dn]]}
+            dst_rank = await self._auth_rank(dp)
+            if dst_rank == self.rank:
+                await self._ensure_absent(dp, dn)
+                entry = {"op": "link", "parent": dp, "name": dn,
+                         "ino": ino,
+                         "remote_dentry": {"type": "file",
+                                           "remote": True,
+                                           "ino": ino},
+                         "pp": sp, "pn": sn,
+                         "primary_dentry": primary, "anchor": anchor}
+                await self._journal(entry)
+                await self._apply(entry)
+                if self.journal_len >= 256:
+                    await self._compact_journal()
+                return {"dentry": {**primary, "remote": True}}
+            # cross-rank: intent first, RPC without the lock
+            token = _secrets.token_hex(8)
+            await self._journal({
+                "op": "link_export_intent", "pp": sp, "pn": sn,
+                "parent": dp, "name": dn, "ino": ino,
+                "token": token})
+            self._busy_names.add((sp, sn))
+        try:
+            return await self._link_cross_rank_finish(
+                sp, sn, dp, dn, ino, primary, anchor, dst_rank, token)
+        finally:
+            self._busy_names.discard((sp, sn))
+
+    async def _link_cross_rank_finish(self, sp, sn, dp, dn, ino,
+                                      primary, anchor, dst_rank,
+                                      token) -> dict:
+        await self._two_phase_finish(
+            dst_rank,
+            {"op": "import_link", "parent": dp, "name": dn,
+             "remote_dentry": {"type": "file", "remote": True,
+                               "ino": ino},
+             "token": token},
+            token,
+            {"op": "link_export_abort", "ino": ino, "token": token},
+            {"op": "link_export_finish", "pp": sp, "pn": sn,
+             "ino": ino, "primary_dentry": primary,
+             "anchor": anchor, "token": token},
+            "destination rank unreachable; link rolled back")
         return {"dentry": {**primary, "remote": True}}
 
-    async def _req_unlink(self, d: dict) -> dict:
-        parent, name = int(d["parent"]), str(d["name"])
-        self._guard_busy((parent, name))
-        dentry = await self._get_dentry(parent, name)
-        if dentry["type"] == "dir":
-            raise MDSError(EISDIR, name)
-        entry = await self._unlink_plan(parent, name, dentry)
+    async def _req_import_link(self, d: dict) -> dict:
+        """Cross-rank link, DESTINATION half: materialize the remote
+        dentry in a directory this rank serves, gated by the commit
+        marker exactly like import_dentry."""
+        dp, dn = int(d["parent"]), str(d["name"])
+        token = str(d.get("token", ""))
+        try:
+            dst = await self._get_dentry(dp, dn)
+            if int(dst.get("ino", 0)) == \
+                    int(dict(d["remote_dentry"])["ino"]) \
+                    and dst.get("remote"):
+                return {"dentry": dst}      # retried import: done
+            raise MDSError(EEXIST, dn)
+        except MDSError as e:
+            if not e.missing_dentry:
+                raise
+        entry = {"op": "import_link", "parent": dp, "name": dn,
+                 "ino": int(dict(d["remote_dentry"])["ino"]),
+                 "remote_dentry": dict(d["remote_dentry"]),
+                 "token": token}
         await self._journal(entry)
         await self._apply(entry)
-        return {"ino": int(dentry["ino"])}
+        if token:
+            state = await self._rename_marker_state(token)
+            if not state.get("committed"):
+                raise MDSError(EXDEV,
+                               "link aborted by the source rank")
+        return {"dentry": dict(d["remote_dentry"])}
+
+    async def _req_unlink(self, d: dict) -> dict:
+        """Unlink — self-managed locking: a remote dentry whose
+        primary lives on another rank runs the witness-lite
+        update_primary protocol (nlink/anchor mutate on the primary's
+        rank, name removal here), releasing the lock across the RPC."""
+        import secrets as _secrets
+
+        parent, name = int(d["parent"]), str(d["name"])
+        cross = None
+        async with self._mutate:
+            # re-check: a balancer export may have moved authority
+            # while this op queued on the lock
+            await self._check_auth(d, "unlink")
+            self._guard_busy((parent, name))
+            dentry = await self._get_dentry(parent, name)
+            if dentry["type"] == "dir":
+                raise MDSError(EISDIR, name)
+            ino = int(dentry["ino"])
+            if dentry.get("remote"):
+                rec = await self._anchor_get(ino)
+                if rec is not None:
+                    pp, pn = int(rec["primary"][0]), \
+                        str(rec["primary"][1])
+                    prim_rank = await self._auth_rank(pp)
+                    if prim_rank != self.rank:
+                        token = _secrets.token_hex(8)
+                        await self._journal({
+                            "op": "unlink_remote_intent",
+                            "parent": parent, "name": name,
+                            "ino": ino, "pp": pp, "pn": pn,
+                            "token": token})
+                        self._busy_names.add((parent, name))
+                        cross = (token, prim_rank, pp)
+            if cross is None:
+                await self._plan_unlink_guard(dentry)
+                entry = await self._unlink_plan(parent, name, dentry)
+                await self._journal(entry)
+                await self._apply(entry)
+                if self.journal_len >= 256:
+                    await self._compact_journal()
+                return {"ino": ino}
+        token, prim_rank, pp = cross
+        try:
+            return await self._unlink_remote_cross(
+                parent, name, ino, pp, prim_rank, token)
+        finally:
+            self._busy_names.discard((parent, name))
+
+    async def _unlink_remote_cross(self, parent: int, name: str,
+                                   ino: int, pp: int, prim_rank: int,
+                                   token: str) -> dict:
+        await self._two_phase_finish(
+            prim_rank,
+            {"op": "update_primary", "parent": pp, "ino": ino,
+             "drop_remote": [parent, name], "token": token},
+            token,
+            {"op": "unlink_remote_abort", "ino": ino,
+             "token": token},
+            {"op": "unlink_remote_finish", "parent": parent,
+             "name": name, "ino": ino, "token": token},
+            "primary rank unreachable; unlink rolled back")
+        return {"ino": ino}
+
+    async def _req_update_primary(self, d: dict) -> dict:
+        """Cross-rank remote-unlink, PRIMARY half: decrement nlink and
+        drop the remote name from the anchor, gated by the commit
+        marker (slave-commit role).  Routed by ``parent`` (the
+        primary's directory) so this rank's authority is enforced;
+        runs under the normal handler lock."""
+        ino = int(d["ino"])
+        drop = [int(d["drop_remote"][0]), str(d["drop_remote"][1])]
+        token = str(d.get("token", ""))
+        rec = await self._anchor_get(ino)
+        if rec is None:
+            raise MDSError(ENOENT, f"no anchor for {ino:x}")
+        pp, pn = int(rec["primary"][0]), str(rec["primary"][1])
+        self._guard_busy((pp, pn))
+        primary = dict(await self._get_dentry(pp, pn))
+        remotes = [[int(r[0]), str(r[1])] for r in rec["remotes"]]
+        if drop not in remotes:
+            # retried request whose first attempt already applied
+            if token and (await self._rename_marker_state(token)
+                          ).get("committed"):
+                return {"dentry": primary}
+            raise MDSError(ENOENT, f"{drop} not a link of {ino:x}")
+        nl = int(primary.get("nlink", 1)) - 1
+        primary["nlink"] = nl
+        kept = [r for r in remotes if r != drop]
+        anchor = (None if nl <= 1 else
+                  {"primary": [pp, pn], "remotes": kept})
+        entry = {"op": "update_primary", "pp": pp, "pn": pn,
+                 "ino": ino, "primary_dentry": primary,
+                 "anchor": anchor, "token": token}
+        await self._journal(entry)
+        await self._apply(entry)
+        if token:
+            state = await self._rename_marker_state(token)
+            if not state.get("committed"):
+                raise MDSError(EXDEV,
+                               "unlink aborted by the remote's rank")
+        return {"dentry": primary}
 
     async def _req_rmdir(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
@@ -1590,33 +1882,61 @@ class MDSDaemon:
         dp, dn = int(d["parent"]), str(d["name"])
         dentry = dict(d["dentry"])
         token = str(d.get("token", ""))
-        if dentry.get("type") == "dir":
-            raise MDSError(EXDEV, "directory import not supported")
-        purge_ino = purge_size = 0
+        is_dir = dentry.get("type") == "dir"
+        if is_dir:
+            # destination-side re-validation with THIS rank's view:
+            # the source checked too, but its snap table only holds
+            # realms it serves — a snapshot rooted in OUR territory is
+            # invisible to it
+            if await self._covering_snaps(dp):
+                raise MDSError(
+                    EXDEV, "cross-rank directory rename under a "
+                    "live snapshot")
+            if await self._is_ancestor(int(dentry["ino"]), dp):
+                raise MDSError(EINVAL,
+                               "cannot move a directory into itself")
+        purge_ino = purge_size = purge_dir_ino = 0
         unlinked_ino = 0
         pre = None
         try:
             dst = await self._get_dentry(dp, dn)
-            if dst["type"] == "dir":
+        except MDSError as e:
+            if not e.missing_dentry:
+                raise
+            dst = None
+        if dst is not None:
+            if is_dir:
+                if dst["type"] != "dir":
+                    raise MDSError(ENOTDIR, dn)
+                if int(dst["ino"]) == int(dentry["ino"]):
+                    return {"dentry": dst}  # retried import: done
+                if int(dst["ino"]) in self._subtrees:
+                    raise MDSError(
+                        EBUSY, f"{dn!r} is a subtree export root")
+                if await self.meta.get_omap(
+                        dirfrag_oid(int(dst["ino"]))):
+                    raise MDSError(ENOTEMPTY, dn)
+                purge_dir_ino = int(dst["ino"])   # replaced empty dir
+            elif dst["type"] == "dir":
                 raise MDSError(EISDIR, dn)
-            if int(dst["ino"]) == int(dentry["ino"]):
+            elif int(dst["ino"]) == int(dentry["ino"]):
                 return {"dentry": dst}      # retried import: done
-            unlinked_ino = int(dst["ino"])
-            if dst.get("remote") or int(dst.get("nlink", 1)) > 1:
+            elif dst.get("remote") or int(dst.get("nlink", 1)) > 1:
                 # replaced hardlinked dst: the link-aware unlink rides
                 # INSIDE the import entry so it only applies once the
                 # commit claim wins (an aborted import must not have
                 # unlinked anything)
+                await self._plan_unlink_guard(dst)
+                unlinked_ino = int(dst["ino"])
                 pre = await self._unlink_plan(dp, dn, dst)
             else:
+                unlinked_ino = int(dst["ino"])
                 purge_ino = int(dst["ino"])
                 purge_size = int(dst.get("size", 0))
-        except MDSError as e:
-            if not e.missing_dentry:
-                raise
         entry = {"op": "import_dentry", "parent": dp, "name": dn,
                  "ino": int(dentry["ino"]), "dentry": dentry,
                  "purge_ino": purge_ino, "purge_size": purge_size,
+                 "purge_dir_ino": purge_dir_ino,
                  "token": token, "pre": pre}
         await self._journal(entry)
         await self._apply(entry)
@@ -1648,9 +1968,11 @@ class MDSDaemon:
         the peer RPC — the source name is pinned by the busy-names
         guard instead, so the rank keeps serving.  A dangling intent
         resolves by the atomic commit marker (the slave-commit /
-        rollback decision, reference rename two-phase).  Directory and
-        hardlinked renames still decline with EXDEV — subtree
-        authority migration and anchor authority are single-rank.
+        rollback decision, reference rename two-phase).  DIRECTORY
+        renames ride the same protocol (authority follows the new
+        ancestry chain; Migrator.h:50 rename-export role) behind the
+        invariant checks below; hardlinked renames still decline with
+        EXDEV — anchor authority is single-rank.
 
         Caller holds the mutate lock for THIS phase (validate +
         intent); it is released before the RPC and re-taken for the
@@ -1661,9 +1983,29 @@ class MDSDaemon:
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
         dentry = await self._get_dentry(sp, sn)
         if dentry.get("type") == "dir":
-            raise MDSError(EXDEV,
-                           "directory rename crosses a rank boundary")
-        if dentry.get("remote") or int(dentry.get("nlink", 1)) > 1:
+            # cross-rank DIRECTORY rename: the same two-phase protocol
+            # works because dirfrags live in shared RADOS — only the
+            # dentry, the parent back-pointer, and AUTHORITY move.
+            # Refuse the shapes whose invariants span ranks:
+            ino_d = int(dentry["ino"])
+            if ino_d in self._subtrees:
+                raise MDSError(EBUSY,
+                               f"{sn!r} is a subtree export root")
+            for s in self._subtrees:
+                if s != ino_d and await self._is_ancestor(ino_d, s):
+                    raise MDSError(
+                        EXDEV, "a delegated subtree boundary lies "
+                        "inside the moved directory")
+            await self._check_no_boundary_anchors(ino_d)
+            if await self._covering_snaps(ino_d) \
+                    or await self._covering_snaps(dp):
+                raise MDSError(
+                    EXDEV, "cross-rank directory rename under a "
+                    "live snapshot")
+            if await self._is_ancestor(ino_d, dp):
+                raise MDSError(EINVAL,
+                               "cannot move a directory into itself")
+        elif dentry.get("remote") or int(dentry.get("nlink", 1)) > 1:
             raise MDSError(EXDEV,
                            "hardlinked rename crosses a rank boundary")
         token = _secrets.token_hex(8)
@@ -1675,56 +2017,64 @@ class MDSDaemon:
         self._busy_names.add((sp, sn))
         return {"_phase2": (d, dst_rank, token, dentry)}
 
-    async def _rename_cross_rank_finish(self, phase1: dict) -> dict:
-        """Phases 2+3: peer RPC WITHOUT the mutate lock, then the
-        journaled finish/abort under it (caller manages locks)."""
-        d, dst_rank, token, dentry = phase1["_phase2"]
-        sp, sn = int(d["src_parent"]), str(d["src_name"])
-        dp, dn = int(d["dst_parent"]), str(d["dst_name"])
-        payload = {"op": "import_dentry", "parent": dp, "name": dn,
-                   "dentry": dentry, "token": token}
+    async def _two_phase_finish(self, dst_rank: int, payload: dict,
+                                token: str, abort_entry: dict,
+                                finish_entry: dict,
+                                unreachable: str) -> dict:
+        """The shared skeleton of every witness-lite protocol's phases
+        2+3 (caller does NOT hold the mutate lock): peer RPC (one
+        redirect retry), then under the lock either the journaled
+        finish, or — on an AMBIGUOUS no-reply — whatever the atomic
+        abort-unless-committed claim decides (exactly one winner; the
+        peer may have committed before dying).  Returns the peer
+        reply ({"rc": 0} when resolved committed)."""
         reply = None
         try:
             reply = await self._peer_request(dst_rank, payload,
                                              timeout=5.0)
             if int(reply.get("rc", EXDEV)) != 0 and \
                     reply.get("redirect_rank") is not None:
-                # destination subtree moved mid-flight: one retry at
-                # the rank the redirect names
+                # target subtree moved mid-flight: one retry at the
+                # rank the redirect names
                 reply = await self._peer_request(
                     int(reply["redirect_rank"]), payload, timeout=5.0)
         except MDSError:
             reply = None
         async with self._mutate:
             if reply is None:
-                # AMBIGUOUS: the peer may have committed before
-                # dying/stalling — the atomic abort-unless-committed
-                # claim decides, with exactly one winner
                 committed = await self._rename_resolve_abort(token)
                 if not committed:
-                    await self._journal({
-                        "op": "rename_export_abort",
-                        "src_parent": sp, "src_name": sn,
-                        "ino": int(dentry["ino"]), "token": token})
-                    raise MDSError(
-                        EXDEV, "destination rank unreachable; "
-                        "rename rolled back")
+                    await self._journal(abort_entry)
+                    raise MDSError(EXDEV, unreachable)
                 reply = {"rc": 0}       # committed after all
             elif int(reply.get("rc", EXDEV)) != 0:
-                # unambiguous refusal from the destination
-                await self._journal({"op": "rename_export_abort",
-                                     "src_parent": sp,
-                                     "src_name": sn,
-                                     "ino": int(dentry["ino"]),
-                                     "token": token})
+                # unambiguous refusal from the peer
+                await self._journal(abort_entry)
                 raise MDSError(int(reply.get("rc", EXDEV)),
-                               str(reply.get("err", "import failed")))
-            fin = {"op": "rename_export_finish", "src_parent": sp,
-                   "src_name": sn, "ino": int(dentry["ino"]),
-                   "token": token}
-            await self._journal(fin)
-            await self._apply(fin)
+                               str(reply.get("err", "peer refused")))
+            await self._journal(finish_entry)
+            await self._apply(finish_entry)
         await self._rename_clear(token)
+        return reply
+
+    async def _rename_cross_rank_finish(self, phase1: dict) -> dict:
+        """Phases 2+3: peer RPC WITHOUT the mutate lock, then the
+        journaled finish/abort under it (caller manages locks)."""
+        d, dst_rank, token, dentry = phase1["_phase2"]
+        sp, sn = int(d["src_parent"]), str(d["src_name"])
+        dp, dn = int(d["dst_parent"]), str(d["dst_name"])
+        reply = await self._two_phase_finish(
+            dst_rank,
+            {"op": "import_dentry", "parent": dp, "name": dn,
+             "dentry": dentry, "token": token},
+            token,
+            {"op": "rename_export_abort", "src_parent": sp,
+             "src_name": sn, "ino": int(dentry["ino"]),
+             "token": token},
+            {"op": "rename_export_finish", "src_parent": sp,
+             "src_name": sn, "ino": int(dentry["ino"]),
+             "token": token},
+            "destination rank unreachable; rename rolled back")
         return {"dentry": dentry,
                 "unlinked_ino": int(reply.get("unlinked_ino", 0))}
 
@@ -1736,6 +2086,9 @@ class MDSDaemon:
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
         async with self._mutate:
+            # re-check: a balancer export may have moved authority
+            # while this op queued on the lock
+            await self._check_auth(d, "rename")
             self._guard_busy((sp, sn), (dp, dn))
             dst_rank = await self._auth_rank(dp)
             if dst_rank == self.rank:
@@ -1766,6 +2119,16 @@ class MDSDaemon:
             # renaming a directory into its own subtree would orphan it
             # as an unreachable cycle
             raise MDSError(EINVAL, "cannot move a directory into itself")
+        if dentry.get("remote"):
+            # moving one name of a cross-rank link repoints an anchor
+            # another rank owns: decline BEFORE any mutation (a failed
+            # rename must leave the destination intact)
+            rec0 = await self._anchor_get(int(dentry["ino"]))
+            if rec0 is not None and await self._auth_rank(
+                    int(rec0["primary"][0])) != self.rank:
+                raise MDSError(EXDEV,
+                               "renames one name of a cross-rank "
+                               "link; unlink + relink instead")
         purge_ino = purge_size = purge_dir_ino = 0
         try:
             dst = await self._get_dentry(dp, dn)
@@ -1789,6 +2152,7 @@ class MDSDaemon:
                     # replacing one name of a hardlinked file: run the
                     # link-aware unlink first — its data must survive
                     # under the other names
+                    await self._plan_unlink_guard(dst)
                     pre = await self._unlink_plan(dp, dn, dst)
                     await self._journal(pre)
                     await self._apply(pre)
@@ -1801,7 +2165,9 @@ class MDSDaemon:
         anchor_ino, anchor = 0, None
         if dentry.get("remote") or int(dentry.get("nlink", 1)) > 1:
             # the moved name is one of a hardlinked file's names: its
-            # anchortable pointer must follow the rename
+            # anchortable pointer must follow the rename (the
+            # cross-rank-link shape was already declined up top,
+            # before any destination mutation)
             anchor_ino = int(dentry["ino"])
             rec = await self._anchor_get(anchor_ino)
             if rec is not None:
@@ -1833,18 +2199,42 @@ class MDSDaemon:
         return {"dentry": dentry, "unlinked_ino": unlinked_ino}
 
     async def _req_setattr(self, d: dict) -> dict:
-        parent, name = int(d["parent"]), str(d["name"])
-        self._guard_busy((parent, name))
-        dentry = await self._get_dentry(parent, name)
-        if dentry.get("remote"):
-            parent, name, dentry = await self._primary_of(
-                int(dentry["ino"]))
-        for key in ("size", "mode"):
-            if key in d and d[key] is not None:
-                dentry[key] = int(d[key])
-        dentry["mtime"] = float(d.get("mtime", time.time()))
-        entry = {"op": "setattr", "parent": parent, "name": name,
-                 "ino": int(dentry["ino"]), "dentry": dentry}
-        await self._journal(entry)
-        await self._apply(entry)
-        return {"dentry": dentry}
+        """Setattr — self-managed locking: an attr flush against a
+        remote whose primary lives on another rank is FORWARDED there
+        (that rank's journal + lock own the primary's dirfrag; writing
+        it from here would race them), with our lock released across
+        the RPC."""
+        forward_rank = None
+        async with self._mutate:
+            await self._check_auth(d, "setattr")
+            parent, name = int(d["parent"]), str(d["name"])
+            self._guard_busy((parent, name))
+            dentry = await self._get_dentry(parent, name)
+            if dentry.get("remote"):
+                parent, name, dentry = await self._primary_of(
+                    int(dentry["ino"]))
+                prim_rank = await self._auth_rank(parent)
+                if prim_rank != self.rank:
+                    forward_rank = prim_rank
+                else:
+                    self._guard_busy((parent, name))
+            if forward_rank is None:
+                for key in ("size", "mode"):
+                    if key in d and d[key] is not None:
+                        dentry[key] = int(d[key])
+                dentry["mtime"] = float(d.get("mtime", time.time()))
+                entry = {"op": "setattr", "parent": parent,
+                         "name": name, "ino": int(dentry["ino"]),
+                         "dentry": dentry}
+                await self._journal(entry)
+                await self._apply(entry)
+                return {"dentry": dentry}
+        reply = await self._peer_request(
+            forward_rank,
+            {**{k: d[k] for k in ("size", "mode", "mtime") if k in d},
+             "op": "setattr", "parent": parent, "name": name},
+            timeout=5.0)
+        if int(reply.get("rc", EXDEV)) != 0:
+            raise MDSError(int(reply.get("rc", EXDEV)),
+                           str(reply.get("err", "setattr failed")))
+        return {"dentry": dict(reply["dentry"])}

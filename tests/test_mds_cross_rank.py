@@ -1,0 +1,256 @@
+"""Cross-rank directory renames + cross-rank hard links (witness-lite
+two-phase protocols over the shared commit-marker log).
+
+Reference roles: Server::handle_slave_rename_prep / Migrator.h:50
+(rename export), MDentryLink/slave link requests (cross-rank links),
+anchor-table authority (all anchor writes funnel through the primary's
+rank via the update_primary peer op)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS, FSError
+from ceph_tpu.mds.daemon import EBUSY, EINVAL, EXDEV, RANK_INO_BASE
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _two_rank_cluster(block_size=4096):
+    cluster = DevCluster(n_mons=1, n_osds=3)
+    await cluster.start()
+    admin = await cluster.client()
+    await admin.pool_create("cephfs_meta", pg_num=4, size=3, min_size=2)
+    await admin.pool_create("cephfs_data", pg_num=4, size=3, min_size=2)
+    mds_a = await cluster.start_mds(name="a", block_size=block_size)
+    mds_b = await cluster.start_mds(name="b", block_size=block_size)
+    r = await admin.mon_command("fs set_max_mds", fs_name="cephfs",
+                                max_mds=2)
+    assert r["rc"] == 0, r
+    deadline = asyncio.get_running_loop().time() + 10
+    while True:
+        r = await admin.mon_command("mds stat")
+        actives = r["data"]["filesystems"]["cephfs"]["actives"]
+        if len(actives) == 2 and mds_b.rank == 1:
+            break
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"rank 1 never active: {actives}")
+        await asyncio.sleep(0.05)
+    await admin.shutdown()
+    rados = await cluster.client("client.fs")
+    fs = CephFS(rados, str(mds_a.msgr.my_addr))
+    await fs.mount()
+    await fs.mkdir("/shared")
+    await fs.export_dir("/shared", 1)
+    return cluster, mds_a, mds_b, rados, fs
+
+
+async def _teardown(cluster, rados, fs):
+    await fs.unmount()
+    await rados.shutdown()
+    await cluster.stop()
+
+
+def test_dir_rename_moves_deep_tree_and_authority():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.mkdirs("/proj/src/deep")
+            await fs.write_file("/proj/src/deep/f", b"deep")
+            await fs.write_file("/proj/top", b"top")
+            await fs.rename("/proj", "/shared/proj")
+            assert await fs.read_file("/shared/proj/src/deep/f") \
+                == b"deep"
+            assert await fs.read_file("/shared/proj/top") == b"top"
+            # authority followed the chain: rank 1 allocates new inos
+            await fs.write_file("/shared/proj/src/n", b"")
+            st = await fs.stat("/shared/proj/src/n")
+            assert int(st["ino"]) >= RANK_INO_BASE
+            # overwrite semantics: onto an EMPTY dir replaces it
+            await fs.mkdir("/e1")
+            await fs.mkdir("/shared/victim")
+            await fs.rename("/e1", "/shared/victim")
+            # ... onto a non-empty dir refuses
+            await fs.mkdir("/e2")
+            with pytest.raises(FSError) as ei:
+                await fs.rename("/e2", "/shared/proj")
+            assert ei.value.rc == -39          # ENOTEMPTY
+            # ... a dir onto a file refuses
+            await fs.write_file("/shared/afile", b"")
+            with pytest.raises(FSError) as ei:
+                await fs.rename("/e2", "/shared/afile")
+            assert ei.value.rc == -20          # ENOTDIR
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_dir_rename_guards():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            # an export root cannot move
+            with pytest.raises(FSError) as ei:
+                await fs.rename("/shared", "/moved")
+            assert ei.value.rc == EBUSY
+            # a dir CONTAINING a delegated boundary cannot move
+            await fs.mkdirs("/outer/inner")
+            await fs.export_dir("/outer/inner", 1)
+            with pytest.raises(FSError) as ei:
+                await fs.rename("/outer", "/shared/outer")
+            assert ei.value.rc == EXDEV
+            # under a live snapshot: refused (either side)
+            await fs.mkdir("/snapped")
+            await fs.mksnap("/snapped", "s")
+            await fs.mkdir("/snapped/sub")
+            with pytest.raises(FSError) as ei:
+                await fs.rename("/snapped/sub", "/shared/sub")
+            assert ei.value.rc == EXDEV
+            # cycle: moving a dir into its own subtree (via the
+            # cross-rank path) is refused
+            await fs.mkdir("/cyc")
+            await fs.export_dir("/cyc", 1)
+            await fs.mkdir("/cyc/in")
+            with pytest.raises(FSError) as ei:
+                await fs.rename("/cyc", "/cyc/in/cyc2")
+            assert ei.value.rc in (EBUSY, EINVAL)
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_cross_rank_link_lifecycle():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            # primary on rank 1, link name on rank 0
+            await fs.write_file("/shared/data", b"linked")
+            await fs.link("/shared/data", "/alias")
+            assert await fs.read_file("/alias") == b"linked"
+            st = await fs.stat("/alias")
+            st2 = await fs.stat("/shared/data")
+            assert int(st["ino"]) == int(st2["ino"])
+            assert int(st2["nlink"]) == 2
+            # writing through either name is visible through both
+            await fs.write_file("/alias", b"rewritten")
+            assert await fs.read_file("/shared/data") == b"rewritten"
+            # unlink the REMOTE name: update_primary runs on rank 1
+            await fs.unlink("/alias")
+            assert await fs.read_file("/shared/data") == b"rewritten"
+            assert int((await fs.stat("/shared/data"))["nlink"]) == 1
+            # re-link, then removing the PRIMARY first is declined
+            # (promote would cross ranks) until the remote is gone
+            await fs.link("/shared/data", "/alias2")
+            with pytest.raises(FSError) as ei:
+                await fs.unlink("/shared/data")
+            assert ei.value.rc == EXDEV
+            await fs.unlink("/alias2")
+            await fs.unlink("/shared/data")       # now fine
+            # duplicate destination name: EEXIST surfaces
+            await fs.write_file("/shared/p", b"")
+            await fs.write_file("/taken", b"")
+            with pytest.raises(FSError) as ei:
+                await fs.link("/shared/p", "/taken")
+            assert ei.value.rc == -17
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_cross_rank_link_rename_guard():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.write_file("/shared/f", b"x")
+            await fs.link("/shared/f", "/name")
+            # renaming the remote name of a cross-rank link declines
+            # (anchor repoint would span ranks)
+            with pytest.raises(FSError) as ei:
+                await fs.rename("/name", "/name2")
+            assert ei.value.rc == EXDEV
+            # replacing it via rename declines the same way
+            await fs.write_file("/other", b"y")
+            with pytest.raises(FSError) as ei:
+                await fs.rename("/other", "/name")
+            assert ei.value.rc == EXDEV
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_dir_rename_intent_crash_repair():
+    """A crash between the destination's commit and the source's
+    finish: the replayed intent resolves by the commit marker and the
+    source name is dropped (no dir under two names)."""
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.mkdir("/limbo")
+            await fs.write_file("/limbo/f", b"v")
+            # run phase 1 + the import by hand, then "crash" before
+            # the source finish
+            d = {"src_parent": 1, "src_name": "limbo",
+                 "dst_parent": int((await fs.stat("/shared"))["ino"]),
+                 "dst_name": "limbo"}
+            async with mds_a._mutate:
+                phase1 = await mds_a._rename_cross_rank(d, 1)
+            _, _, token, dentry = phase1["_phase2"]
+            reply = await mds_a._peer_request(1, {
+                "op": "import_dentry",
+                "parent": d["dst_parent"], "name": "limbo",
+                "dentry": dentry, "token": token})
+            assert reply.get("rc") == 0
+            mds_a._busy_names.discard((1, "limbo"))
+            # simulated crash: repair runs at next resync
+            await mds_a._resync()
+            # destination name serves; source name is gone
+            assert await fs.read_file("/shared/limbo/f") == b"v"
+            fs._dcache.clear()       # drop the client's stale lease
+            with pytest.raises(FSError):
+                await fs.stat("/limbo")
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_link_intent_crash_repair():
+    """Crash after the destination materialized the remote dentry but
+    before the primary applied nlink/anchor: repair completes the
+    finish from the commit marker."""
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            # primary on rank 0 this time; link name under /shared
+            await fs.write_file("/primary", b"p")
+            import secrets
+            token = secrets.token_hex(8)
+            dp = int((await fs.stat("/shared"))["ino"])
+            dentry = await mds_a._get_dentry(1, "primary")
+            ino = int(dentry["ino"])
+            await mds_a._journal({
+                "op": "link_export_intent", "pp": 1, "pn": "primary",
+                "parent": dp, "name": "lnk", "ino": ino,
+                "token": token})
+            reply = await mds_a._peer_request(1, {
+                "op": "import_link", "parent": dp, "name": "lnk",
+                "remote_dentry": {"type": "file", "remote": True,
+                                  "ino": ino},
+                "token": token})
+            assert reply.get("rc") == 0
+            # crash before the finish: repair must land nlink+anchor
+            await mds_a._resync()
+            assert int((await fs.stat("/primary"))["nlink"]) == 2
+            assert await fs.read_file("/shared/lnk") == b"p"
+            rec = await mds_a._anchor_get(ino)
+            assert [dp, "lnk"] in [[int(r[0]), str(r[1])]
+                                   for r in rec["remotes"]]
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())

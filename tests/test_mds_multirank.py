@@ -118,17 +118,31 @@ def test_two_ranks_serve_disjoint_subtrees():
         await fs.rename("/clobber-src", "/shared/clobber-dst")
         assert await fs.read_file("/shared/clobber-dst") == \
             b"new-content"
-        # directory renames still decline (subtree authority is
-        # single-rank), as do cross-rank hard links
+        # DIRECTORY renames cross rank boundaries too: the dentry,
+        # parent back-pointer, and authority move; content stays put
         await fs.mkdir("/adir")
-        with pytest.raises(FSError) as ei:
-            await fs.rename("/adir", "/shared/adir")
-        assert ei.value.rc == -18
+        await fs.write_file("/adir/inner", b"dir payload")
+        await fs.rename("/adir", "/shared/adir")
+        assert await fs.read_file("/shared/adir/inner") \
+            == b"dir payload"
+        with pytest.raises(FSError):
+            await fs.stat("/adir")
+        # new children of the moved dir are served by rank 1 (its
+        # chain now runs through /shared)
+        await fs.write_file("/shared/adir/new", b"rank1")
+        st = await fs.stat("/shared/adir/new")
+        assert int(st["ino"]) >= RANK_INO_BASE
+        # ... and back out again
+        await fs.rename("/shared/adir", "/adir-back")
+        assert await fs.read_file("/adir-back/inner") == b"dir payload"
+        # cross-rank hard links run the update_primary protocol
         await fs.write_file("/shared/lfile", b"x")
-        with pytest.raises(FSError) as ei:
-            await fs.link("/shared/lfile", "/rootlink")
-        assert ei.value.rc == -18
-        # hardlinked files decline the cross-rank path too
+        await fs.link("/shared/lfile", "/rootlink")
+        assert await fs.read_file("/rootlink") == b"x"
+        await fs.unlink("/rootlink")       # remote side teardown
+        assert await fs.read_file("/shared/lfile") == b"x"
+        # hardlinked files still decline the cross-rank RENAME path
+        # (anchor repoint would span ranks)
         await fs.write_file("/hl-a", b"hl")
         await fs.link("/hl-a", "/hl-b")
         with pytest.raises(FSError) as ei:
