@@ -1717,8 +1717,14 @@ class MDSDaemon:
             dst = await self._get_dentry(dp, dn)
             if int(dst.get("ino", 0)) == \
                     int(dict(d["remote_dentry"])["ino"]) \
-                    and dst.get("remote"):
-                return {"dentry": dst}      # retried import: done
+                    and dst.get("remote") and token and \
+                    (await self._rename_marker_state(token)
+                     ).get("committed"):
+                # a RETRY of this very request (token committed);
+                # a fresh link() to an occupied name is EEXIST like
+                # the same-rank path — treating it as done would
+                # double-count nlink at the primary's finish
+                return {"dentry": dst}
             raise MDSError(EEXIST, dn)
         except MDSError as e:
             if not e.missing_dentry:
@@ -2228,12 +2234,20 @@ class MDSDaemon:
                          "dentry": dentry}
                 await self._journal(entry)
                 await self._apply(entry)
+                if self.journal_len >= 256:
+                    await self._compact_journal()
                 return {"dentry": dentry}
-        reply = await self._peer_request(
-            forward_rank,
-            {**{k: d[k] for k in ("size", "mode", "mtime") if k in d},
-             "op": "setattr", "parent": parent, "name": name},
-            timeout=5.0)
+        payload = {**{k: d[k] for k in ("size", "mode", "mtime")
+                      if k in d},
+                   "op": "setattr", "parent": parent, "name": name}
+        reply = await self._peer_request(forward_rank, payload,
+                                         timeout=5.0)
+        if int(reply.get("rc", EXDEV)) != 0 and \
+                reply.get("redirect_rank") is not None:
+            # the primary's subtree moved between resolution and the
+            # RPC (balancer export): one retry where the redirect says
+            reply = await self._peer_request(
+                int(reply["redirect_rank"]), payload, timeout=5.0)
         if int(reply.get("rc", EXDEV)) != 0:
             raise MDSError(int(reply.get("rc", EXDEV)),
                            str(reply.get("err", "setattr failed")))

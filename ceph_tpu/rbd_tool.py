@@ -129,6 +129,16 @@ async def _dispatch(args, rbd: RBD):
         elif args.snap_cmd == "rollback":
             await img.snap_rollback(snap)
         return None
+    if cmd == "lock":
+        img = await rbd.open(args.image)
+        if args.lock_cmd == "ls":
+            info = await img.lock_info()
+            return [{"locker": lk, **v}
+                    for lk, v in sorted(info.get("lockers",
+                                                 {}).items())]
+        if args.lock_cmd == "break":
+            await img.break_lock(args.locker)
+            return None
     raise RBDError(f"unknown command {cmd!r}")
 
 
@@ -165,6 +175,13 @@ def build_parser() -> argparse.ArgumentParser:
         x.add_argument("path")
         if name == "import":
             x.add_argument("--order", type=int, default=22)
+    lk = sub.add_parser("lock")
+    lk_sub = lk.add_subparsers(dest="lock_cmd", required=True)
+    lkl = lk_sub.add_parser("ls")
+    lkl.add_argument("image")
+    lkb = lk_sub.add_parser("break")
+    lkb.add_argument("image")
+    lkb.add_argument("locker")
     sn = sub.add_parser("snap")
     sn.add_argument("snap_cmd", choices=[
         "create", "ls", "rm", "protect", "unprotect", "rollback",

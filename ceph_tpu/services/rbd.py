@@ -23,8 +23,10 @@ Round-2 feature depth:
 
 from __future__ import annotations
 
+import asyncio
 import json
 import secrets
+import time
 
 from ceph_tpu.client.rados import IoCtx, ObjectOperation, RadosError
 from ceph_tpu.services.rbd_journal import (
@@ -202,12 +204,19 @@ class RBD:
         ))
 
     async def open(self, name: str, cache: bool = False,
-                   journaled: bool = False) -> "Image":
+                   journaled: bool = False,
+                   exclusive: bool = False,
+                   lock_duration: float = 30.0) -> "Image":
         """``journaled``: mutations append to the image journal before
         applying (librbd feature JOURNALING), and opening replays any
-        entries a crashed writer appended but never applied."""
+        entries a crashed writer appended but never applied.
+        ``exclusive``: single-writer coordination (EXCLUSIVE_LOCK
+        feature) — the first mutation acquires the image lock,
+        contenders request a cooperative handoff, and a dead owner's
+        lease expires after ``lock_duration``."""
         image_id = await self.image_id(name)
-        img = Image(self.ioctx, name, image_id, cache=cache)
+        img = Image(self.ioctx, name, image_id, cache=cache,
+                    exclusive=exclusive, lock_duration=lock_duration)
         await img.refresh()
         if journaled:
             img._journal = ImageJournal(self.ioctx, image_id)
@@ -238,7 +247,8 @@ class Image:
     """An open image handle (librbd rbd_image_t)."""
 
     def __init__(self, ioctx: IoCtx, name: str, image_id: str,
-                 cache: bool = False):
+                 cache: bool = False, exclusive: bool = False,
+                 lock_duration: float = 30.0):
         # a PRIVATE io context: the image's snap context (set at refresh)
         # must not clobber the caller's ioctx or other open images
         # (librbd likewise keeps per-image state in ImageCtx)
@@ -263,6 +273,20 @@ class Image:
         self._journal = None
         self._j_last = -1           # newest appended-and-applied tid
         self._j_uncommitted = 0
+        # exclusive lock (librbd ExclusiveLock.cc / ManagedLock.cc
+        # over cls_lock): single-writer coordination on the header.
+        # -lite fencing is the LEASE — the owner renews at D/3 and
+        # refuses local writes once its lease lapses, so a paused
+        # owner cannot race whoever acquired after expiry (the
+        # reference fences harder, via osd blocklisting).
+        self._excl = exclusive
+        self._lock_duration = lock_duration
+        self._locker_id = f"img.{image_id}.{secrets.token_hex(4)}"
+        self._lock_owner = False
+        self._lock_until = 0.0            # monotonic lease horizon
+        self._lock_renew_task = None
+        self._lock_watch = None
+        self._releasing = False
         if cache:
             from ceph_tpu.client.object_cacher import ObjectCacher
 
@@ -305,6 +329,14 @@ class Image:
         await self._j_commit()
         if self._journal is not None:
             await self._journal.trim()
+        if self._lock_renew_task is not None:
+            self._lock_renew_task.cancel()
+            self._lock_renew_task = None
+        if self._lock_owner:
+            await self.release_exclusive_lock()
+        if self._lock_watch is not None:
+            await self.ioctx.unwatch(self._lock_watch)
+            self._lock_watch = None
 
     # -- object map (src/librbd/ObjectMap.h bitmap) -----------------------
     @property
@@ -529,8 +561,141 @@ class Image:
             await self._journal.commit(self._j_last)
             self._j_uncommitted = 0
 
+    # -- exclusive lock (ExclusiveLock.cc over cls_lock) -------------------
+    RBD_LOCK_NAME = "rbd_lock"
+
+    async def lock_info(self) -> dict:
+        return json.loads(await self.ioctx.exec(
+            self.header_oid, "lock", "get_info", b"{}"))
+
+    async def _lock_try(self) -> bool:
+        try:
+            await self.ioctx.exec(
+                self.header_oid, "lock", "lock",
+                json.dumps({"name": self.RBD_LOCK_NAME,
+                             "locker": self._locker_id,
+                             "type": "exclusive",
+                             "duration": self._lock_duration}).encode())
+            return True
+        except RadosError as e:
+            if e.rc == -16:
+                return False
+            raise
+
+    async def acquire_exclusive_lock(self,
+                                     timeout: float = 10.0) -> None:
+        """Become the image's single writer.  A live owner is asked to
+        release (cooperative transition via a header notify); a dead
+        owner's lease simply expires."""
+        if self._lock_owner and time.monotonic() < self._lock_until:
+            return
+        deadline = time.monotonic() + timeout
+        while True:
+            before = time.monotonic()
+            if await self._lock_try():
+                self._lock_owner = True
+                self._lock_until = before + self._lock_duration
+                self._releasing = False
+                if self._lock_watch is None:
+                    self._lock_watch = await self.ioctx.watch(
+                        self.header_oid, self._lock_notify)
+                if self._lock_renew_task is None:
+                    self._lock_renew_task = asyncio.create_task(
+                        self._lock_renew_loop())
+                return
+            try:
+                await self.ioctx.notify(
+                    self.header_oid,
+                    json.dumps({"op": "request_lock"}).encode(),
+                    timeout=2.0)
+            except RadosError:
+                pass
+            if time.monotonic() > deadline:
+                info = await self.lock_info()
+                raise RBDError(
+                    f"image {self.name!r} is exclusively locked by "
+                    f"{sorted(info.get('lockers', {}))}")
+            await asyncio.sleep(0.1)
+
+    async def _lock_notify(self, payload: bytes) -> bytes | None:
+        try:
+            msg = json.loads(payload or b"{}")
+        except ValueError:
+            return None
+        if msg.get("op") == "request_lock" and self._lock_owner \
+                and not self._releasing:
+            # hand off at a quiescent point, not mid-notify-callback
+            self._releasing = True
+            asyncio.get_running_loop().create_task(
+                self.release_exclusive_lock())
+        return b"ack"
+
+    async def _lock_renew_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._lock_duration / 3)
+            if not self._lock_owner:
+                continue
+            before = time.monotonic()
+            try:
+                renewed = await self._lock_try()
+            except RadosError:
+                continue      # transient (PG unavailable): next tick
+            if renewed:
+                self._lock_until = before + self._lock_duration
+            else:
+                # lease lapsed and someone else owns the image now
+                await self._fence_lost_lock()
+
+    async def release_exclusive_lock(self) -> None:
+        """Flush and give the lock up (the cooperative handoff)."""
+        if not self._lock_owner:
+            self._releasing = False
+            return
+        await self.flush()
+        self._lock_owner = False
+        self._releasing = False
+        try:
+            await self.ioctx.exec(
+                self.header_oid, "lock", "unlock",
+                json.dumps({"locker": self._locker_id}).encode())
+        except RadosError:
+            pass                 # already expired / broken: same end
+
+    async def break_lock(self, locker: str) -> None:
+        """Force-remove another client's lock (rbd lock break): for
+        owners that died without a lease (or an operator who cannot
+        wait one out)."""
+        try:
+            await self.ioctx.exec(
+                self.header_oid, "lock", "unlock",
+                json.dumps({"locker": locker}).encode())
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+
+    async def _fence_lost_lock(self) -> None:
+        """The lease lapsed while we may hold dirty state: DISCARD the
+        write-back cache rather than let a later flush overwrite
+        whatever the next owner wrote in between (the reference fences
+        via osd blocklisting; -lite drops the stale dirty blocks)."""
+        self._lock_owner = False
+        if self._cache is not None:
+            for key in list(self._cache._objects):
+                await self._cache.discard(key)
+        self._om_auth = False      # the map may be stale too
+
+    async def _ensure_lock(self) -> None:
+        if not self._excl:
+            return
+        if not self._lock_owner:
+            await self.acquire_exclusive_lock()
+        elif time.monotonic() >= self._lock_until:
+            await self._fence_lost_lock()
+            await self.acquire_exclusive_lock()
+
     async def write(self, offset: int, data: bytes,
                     _journal: bool = True) -> None:
+        await self._ensure_lock()
         if offset + len(data) > self.size:
             raise RBDError("write past end of image")
         if self._journal is not None and _journal:
@@ -558,6 +723,7 @@ class Image:
     async def flatten(self) -> None:
         """Copy every still-inherited parent block into the child and
         sever the parent link (librbd flatten)."""
+        await self._ensure_lock()
         if self.parent is None:
             raise RBDError("image has no parent")
         if self._cache is not None:
@@ -595,6 +761,7 @@ class Image:
         # byte-correct after the flatten copied those bytes up
 
     async def resize(self, new_size: int, _journal: bool = True) -> None:
+        await self._ensure_lock()
         if self._cache is not None:
             await self._cache.flush()
         if self._journal is not None and _journal:
@@ -650,6 +817,7 @@ class Image:
     # snap_create/snap_rollback model over the OSD snapshot machinery) --
     async def snap_create(self, snap_name: str,
                           _journal: bool = True) -> int:
+        await self._ensure_lock()
         if self._cache is not None:
             # the snapshot must capture every acked write (librbd
             # flushes its cache before snap_create)
@@ -696,6 +864,7 @@ class Image:
 
     async def snap_remove(self, snap_name: str,
                           _journal: bool = True) -> None:
+        await self._ensure_lock()
         info = self.snaps.get(snap_name)
         if info is None:
             raise RBDError(f"no snap {snap_name!r}")
@@ -735,6 +904,7 @@ class Image:
                             _journal: bool = True) -> None:
         """Restore the head image to a snapshot's content (librbd
         snap_rollback: copy the snap state over the head)."""
+        await self._ensure_lock()
         info = self.snaps.get(snap_name)
         if info is None:
             raise RBDError(f"no snap {snap_name!r}")

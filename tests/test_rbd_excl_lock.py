@@ -1,0 +1,167 @@
+"""RBD exclusive lock: single-writer coordination on the image header.
+
+Reference surfaces: src/librbd/ExclusiveLock.cc + ManagedLock.cc over
+cls_lock — auto-acquire on first mutation, cooperative handoff via a
+header notify, lease expiry for dead owners, operator break-lock."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rbd import RBD, RBDError
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+ORDER = 14
+
+
+async def _rbd(rados, pool="rbdl"):
+    await rados.pool_create(pool, pg_num=8)
+    return RBD(await rados.open_ioctx(pool))
+
+
+def test_cooperative_handoff():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("img", 4 << ORDER, order=ORDER)
+            a = await rbd.open("img", exclusive=True)
+            b = await rbd.open("img", exclusive=True)
+            # first mutation auto-acquires
+            await a.write(0, b"A" * 100)
+            assert a._lock_owner
+            info = await a.lock_info()
+            assert list(info["lockers"]) == [a._locker_id]
+            # B's write requests a handoff; A releases cooperatively
+            await b.write(100, b"B" * 100)
+            assert b._lock_owner and not a._lock_owner
+            # both writes landed
+            assert await b.read(0, 200) == b"A" * 100 + b"B" * 100
+            # and back again
+            await a.write(200, b"C" * 10)
+            assert a._lock_owner and not b._lock_owner
+            assert await a.read(200, 10) == b"C" * 10
+            await a.close()
+            await b.close()
+            # closing released everything
+            c = await rbd.open("img")
+            assert (await c.lock_info()).get("lockers", {}) == {}
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_dead_owner_lease_expires():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("img", 4 << ORDER, order=ORDER)
+            a = await rbd.open("img", exclusive=True,
+                               lock_duration=0.5)
+            await a.write(0, b"x")
+            # simulate death: stop renewing, stop answering notifies
+            a._lock_renew_task.cancel()
+            a._lock_renew_task = None
+            await rados.objecter.linger_cancel(a._lock_watch)
+            a._lock_watch = None
+            b = await rbd.open("img", exclusive=True)
+            # B acquires once the lease lapses
+            await b.write(0, b"y")
+            assert b._lock_owner
+            # the lapsed owner refuses its own writes locally until it
+            # re-acquires (lease fencing) — its next write must first
+            # win the lock back from B, which cooperates
+            await a.write(1, b"z")
+            assert a._lock_owner and not b._lock_owner
+            await a.close()
+            await b.close()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_break_lock_and_tool():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("img", 4 << ORDER, order=ORDER)
+            a = await rbd.open("img", exclusive=True,
+                               lock_duration=3600.0)
+            await a.write(0, b"x")
+            # a wedged owner with a long lease: the operator breaks it
+            a._lock_renew_task.cancel()
+            a._lock_renew_task = None
+            await rados.objecter.linger_cancel(a._lock_watch)
+            a._lock_watch = None
+            b = await rbd.open("img", exclusive=True)
+            with pytest.raises(RBDError):
+                await b.acquire_exclusive_lock(timeout=0.5)
+            info = await b.lock_info()
+            victim = next(iter(info["lockers"]))
+            await b.break_lock(victim)
+            await b.write(0, b"y")
+            assert b._lock_owner
+            await b.close()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_lease_loss_discards_stale_dirty_cache():
+    """A paused owner's unflushed write-back blocks must NOT overwrite
+    the next owner's data after re-acquisition (lease fencing)."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("img", 4 << ORDER, order=ORDER)
+            a = await rbd.open("img", exclusive=True, cache=True,
+                               lock_duration=0.4)
+            await a.write(0, b"stale-old")     # dirty in cache only
+            # pause A past its lease (dead to notifies, no renewals)
+            a._lock_renew_task.cancel()
+            a._lock_renew_task = None
+            await rados.objecter.linger_cancel(a._lock_watch)
+            a._lock_watch = None
+            await asyncio.sleep(0.5)
+            b = await rbd.open("img", exclusive=True)
+            await b.write(0, b"fresh-new")
+            # A resumes: its next write re-acquires but the stale
+            # dirty block must be gone — flush must not resurrect it
+            await a.write(100, b"later")
+            await a.flush()
+            assert await a.read(0, 9) == b"fresh-new"
+            assert await a.read(100, 5) == b"later"
+            await a.close()
+            await b.close()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_unlocked_handles_unaffected():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            rbd = await _rbd(rados)
+            await rbd.create("img", 4 << ORDER, order=ORDER)
+            img = await rbd.open("img")          # exclusive off
+            await img.write(0, b"plain")
+            assert not img._lock_owner
+            assert (await img.lock_info()).get("lockers", {}) == {}
+            assert await img.read(0, 5) == b"plain"
+            await img.close()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
