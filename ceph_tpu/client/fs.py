@@ -37,10 +37,17 @@ class FSError(IOError):
 
 
 class FileHandle:
-    """An open file (Fh): direct data IO + deferred attr flush."""
+    """An open file (Fh): direct data IO + deferred attr flush.
+
+    With the exclusive write cap (``capped``, the Fw/Fb slice of the
+    reference cap model) writes BUFFER client-side per block and flush
+    on fsync/close/recall — the MDS recalls the cap when any other
+    client opens the file, so readers see flushed bytes.  Without it,
+    writes go straight to RADOS (write-through)."""
 
     def __init__(self, fs: "CephFS", parent: int, name: str,
-                 dentry: dict, snapid: int = 0):
+                 dentry: dict, snapid: int = 0,
+                 capped: bool = False):
         self.fs = fs
         self.parent = parent
         self.name = name
@@ -49,6 +56,12 @@ class FileHandle:
         self.snapid = snapid        # >0: read-only snapshot view
         self._dirty = False
         self._closed = False
+        self._cap = capped
+        self._buf: dict[int, bytearray] = {}   # blockno -> content
+        # serializes buffer mutation against recall-driven flushes: a
+        # write suspended in a block load must not slip its insert in
+        # after the recall already flushed-and-cleared
+        self._buf_lock = asyncio.Lock()
 
     # -- data path (never touches the MDS) -----------------------------
     def _extents(self, offset: int, length: int):
@@ -69,14 +82,50 @@ class FileHandle:
             raise FSError(EROFS, "snapshots are read-only")
         if offset is None:
             offset = self.size
-        pos = 0
-        for blockno, off, run in self._extents(offset, len(data)):
-            await self.fs.data.write(block_oid(self.ino, blockno),
-                                     data[pos:pos + run], off)
-            pos += run
+        buffered = False
+        if self._cap:
+            async with self._buf_lock:
+                if self._cap:     # re-check: a recall may have won
+                    buffered = True
+                    pos = 0
+                    for blockno, off, run in self._extents(
+                            offset, len(data)):
+                        blk = await self._load_block(blockno)
+                        if len(blk) < off + run:
+                            blk.extend(b"\x00" * (off + run
+                                                  - len(blk)))
+                        blk[off:off + run] = data[pos:pos + run]
+                        pos += run
+        if not buffered:
+            pos = 0
+            for blockno, off, run in self._extents(offset, len(data)):
+                await self.fs.data.write(block_oid(self.ino, blockno),
+                                         data[pos:pos + run], off)
+                pos += run
         self.size = max(self.size, offset + len(data))
         self._dirty = True
         return len(data)
+
+    async def _load_block(self, blockno: int) -> bytearray:
+        blk = self._buf.get(blockno)
+        if blk is None:
+            try:
+                blk = bytearray(await self.fs.data.read(
+                    block_oid(self.ino, blockno)))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+                blk = bytearray()
+            self._buf[blockno] = blk
+        return blk
+
+    async def _flush_buffer(self) -> None:
+        async with self._buf_lock:
+            for blockno in sorted(self._buf):
+                await self.fs.data.write_full(
+                    block_oid(self.ino, blockno),
+                    bytes(self._buf[blockno]))
+            self._buf.clear()
 
     async def read(self, length: int | None = None,
                    offset: int = 0) -> bytes:
@@ -88,14 +137,17 @@ class FileHandle:
         data_io = (await self.fs._snap_data(self.snapid)
                    if self.snapid else self.fs.data)
         for blockno, off, run in self._extents(offset, length):
-            try:
-                frag = await data_io.read(
-                    block_oid(self.ino, blockno), run, off
-                )
-            except RadosError as e:
-                if e.rc != ENOENT:
-                    raise
-                frag = b""              # sparse block reads as zeros
+            if blockno in self._buf:
+                frag = bytes(self._buf[blockno][off:off + run])
+            else:
+                try:
+                    frag = await data_io.read(
+                        block_oid(self.ino, blockno), run, off
+                    )
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+                    frag = b""          # sparse block reads as zeros
             out[pos:pos + len(frag)] = frag
             pos += run
         return bytes(out)
@@ -103,6 +155,7 @@ class FileHandle:
     async def truncate(self, size: int) -> None:
         if self.snapid:
             raise FSError(EROFS, "snapshots are read-only")
+        await self._flush_buffer()      # buffered blocks first
         bs = self.fs.block_size
         if size < self.size:
             first_dead = -(-size // bs)
@@ -127,7 +180,8 @@ class FileHandle:
         self._dirty = True
 
     async def fsync(self) -> None:
-        """Flush buffered attrs to the MDS (cap flush)."""
+        """Flush buffered blocks, then buffered attrs (cap flush)."""
+        await self._flush_buffer()
         if self._dirty:
             await self.fs._request("setattr", parent=self.parent,
                                    name=self.name, size=self.size,
@@ -140,6 +194,21 @@ class FileHandle:
         if not self._closed:
             await self.fsync()
             self._closed = True
+            if self._cap:
+                self._cap = False
+                siblings = self.fs._open_caps.get(self.ino)
+                if siblings is not None:
+                    siblings.discard(self)
+                    if siblings:
+                        return    # another handle still uses the cap
+                    self.fs._open_caps.pop(self.ino, None)
+                try:
+                    await self.fs._request("release_cap",
+                                           parent=self.parent,
+                                           name=self.name,
+                                           ino=self.ino)
+                except FSError:
+                    pass          # MDS revoked/restarted: same end
 
 
 class CephFS:
@@ -192,6 +261,10 @@ class CephFS:
         # cache (Client::Dentry + lease_ttl role)
         self._dcache: dict[tuple[int, str], tuple[dict, float]] = {}
         self._snap_ioctx: dict[int, IoCtx] = {}
+        # ino -> set of local FileHandles sharing the conn's exclusive
+        # write cap (the MDS grant is per-session; the cap releases
+        # only when the LAST handle closes)
+        self._open_caps: dict[int, set] = {}
         self._mounted = False
         # session-unique tid space: two mounts sharing one rados
         # messenger must never mistake each other's replies
@@ -209,6 +282,11 @@ class CephFS:
 
     # -- dispatcher chaining ----------------------------------------------
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if msg.type == "cap_recall":
+            asyncio.get_running_loop().create_task(
+                self._handle_cap_recall(conn,
+                                        int(msg.data.get("ino", 0))))
+            return
         if msg.type == "mds_reply":
             tid = int(msg.data.get("tid", 0))
             fut = self._futs.pop(tid, None)
@@ -221,6 +299,22 @@ class CephFS:
                 await self._orig_dispatch(conn, msg)
             return
         await self._orig_dispatch(conn, msg)
+
+    async def _handle_cap_recall(self, conn: Connection,
+                                 ino: int) -> None:
+        """The MDS wants the write cap back: degrade every local
+        handle to write-through FIRST (so racing writes stop
+        buffering), then flush blocks and attrs, then ack."""
+        for fh in self._open_caps.pop(ino, ()):
+            fh._cap = False
+            try:
+                await fh.fsync()
+            except (FSError, RadosError):
+                pass              # revocation proceeds regardless
+        try:
+            conn.send_message(Message("cap_release", {"ino": ino}))
+        except ConnectionError:
+            pass
 
     def ms_handle_reset(self, conn: Connection) -> None:
         self.rados.ms_handle_reset(conn)
@@ -582,7 +676,7 @@ class CephFS:
                 try:
                     reply = await self._request(
                         "create", parent=parent, name=name, mode=mode,
-                        exclusive=flags == "x",
+                        exclusive=flags == "x", want_cap=True,
                     )
                     break
                 except FSError as e:
@@ -600,7 +694,32 @@ class CephFS:
             else:
                 raise FSError(ELOOP, f"{path!r}: create/symlink race")
             self._invalidate(parent, name)
-            fh = FileHandle(self, parent, name, reply["dentry"])
+            capped = reply.get("cap") == "w"    # piggybacked grant
+            dentry = reply["dentry"]
+            if not capped:
+                # contended (another session holds the cap): the
+                # explicit open_file can wait for the recall
+                try:
+                    cap = await self._request(
+                        "open_file", parent=parent, name=name,
+                        write=True)
+                    capped = cap.get("cap") == "w"
+                    # post-recall attrs: the evicted holder's flush
+                    # may have grown the file past the create reply
+                    dentry = dict(cap.get("dentry", dentry))
+                except FSError:
+                    pass          # cap-less open still works
+            fh = FileHandle(self, parent, name, dentry,
+                            capped=capped)
+            if capped:
+                ino = fh.ino
+                if ino in self._open_caps:
+                    # sibling handles share one per-session grant —
+                    # make the new handle see their buffered bytes
+                    for sib in list(self._open_caps[ino]):
+                        await sib.fsync()
+                        fh.size = max(fh.size, sib.size)
+                self._open_caps.setdefault(ino, set()).add(fh)
             if flags == "w" and fh.size:
                 await fh.truncate(0)
             return fh
@@ -615,6 +734,27 @@ class CephFS:
                 raise FSError(ENOENT, resolved)
         if dentry["type"] == "dir":
             raise FSError(EISDIR, path)
+        ino = int(dentry["ino"])
+        if ino in self._open_caps:
+            # OUR session holds the cap: flush locally (no recall —
+            # the MDS skips holders' own connections) so this read
+            # handle sees the buffered bytes and true size
+            for sib in list(self._open_caps[ino]):
+                await sib.fsync()
+                dentry = {**dentry,
+                          "size": max(int(dentry.get("size", 0)),
+                                      sib.size)}
+        elif dentry.get("cap_held"):
+            # another session may hold a write cap (flag rides the
+            # cached dentry): pay the recall round-trip; uncapped
+            # files skip it entirely
+            try:
+                cap = await self._request("open_file", parent=parent,
+                                          name=name, write=False)
+                dentry = dict(cap.get("dentry", dentry))
+                self._invalidate(parent, name)
+            except FSError:
+                pass
         return FileHandle(self, parent, name, dentry)
 
     async def _follow_link_path(

@@ -158,6 +158,13 @@ class MDSDaemon:
         # (parent, name) pairs pinned by an in-flight cross-rank
         # rename (mutations on them get EBUSY — the xlock role)
         self._busy_names: set[tuple[int, str]] = set()
+        # file write caps (Locker.cc/Capability.h reduced to the
+        # -lite slice: ONE exclusive buffered-write cap per file ino,
+        # granted at open, recalled when anyone else opens the file).
+        # Volatile by design — an MDS restart drops grants, like the
+        # reference before client reconnect replays them.
+        self._caps: dict[int, dict] = {}       # ino -> {conn, holder}
+        self._cap_waiters: dict[int, list] = {}   # ino -> [futures]
         # balancer (MDBalancer.h:33 role): decaying per-directory
         # request popularity (DecayCounter semantics, one shared
         # lazy-decay stamp for the whole map)
@@ -1028,6 +1035,15 @@ class MDSDaemon:
                 asyncio.get_running_loop().create_task(self._resync())
             self._last_state = state
             return
+        if msg.type == "cap_release":
+            # fire-and-forget release from a recalled client (the
+            # request-path release_cap covers the clean-close case)
+            ino = int(msg.data.get("ino", 0))
+            holder = self._caps.get(ino)
+            if holder is not None and holder["conn"] is conn:
+                self._caps.pop(ino, None)
+            self._cap_resolve(ino)
+            return
         if msg.type == "mds_reply" and \
                 int(msg.data.get("tid", -1)) in self._peer_pending:
             fut = self._peer_pending.pop(int(msg.data["tid"]))
@@ -1125,6 +1141,7 @@ class MDSDaemon:
             handler = getattr(self, f"_req_{op}", None)
             if handler is None:
                 raise MDSError(EINVAL, f"unknown mds op {op!r}")
+            d["_conn"] = conn       # cap ops key grants on the session
             dino = await self._check_auth(d, op)
             if op not in ("session", "get_load", "export_dir"):
                 # balancer popularity: the directory the auth check
@@ -1132,10 +1149,11 @@ class MDSDaemon:
                 self._note_pop(dino)
             if op in ("lookup", "readdir", "session", "lssnap",
                       "rename", "link", "unlink", "setattr",
-                      "get_load"):
+                      "get_load", "open_file", "release_cap"):
                 # reads need no lock; rename/link/unlink/setattr
                 # manage their own (each must release the mutate lock
-                # across a cross-rank peer RPC)
+                # across a cross-rank peer RPC); cap ops await client
+                # recalls and touch only the volatile cap table
                 result = await handler(d)
             else:
                 async with self._mutate:
@@ -1179,6 +1197,12 @@ class MDSDaemon:
             except MDSError:
                 if not snapid:
                     raise          # snap stub mid-unlink: serve as-is
+        if dentry.get("type") == "file" \
+                and int(dentry["ino"]) in self._caps:
+            # a write cap is out on this file: readers use this (it
+            # rides the cached dentry) to decide whether an open
+            # needs the recall round-trip
+            dentry = {**dentry, "cap_held": True}
         return {"dentry": dentry, "lease": self.lease_ttl,
                 "snapc": self._snapc_wire()}
 
@@ -1229,6 +1253,18 @@ class MDSDaemon:
         await self._apply(entry)
         return {"dentry": dentry}
 
+    def _cap_grant_if_free(self, ino: int, conn) -> bool:
+        """Grant the write cap when uncontended (no recall, no wait —
+        safe under the mutate lock).  The reference likewise issues
+        caps in the open/create reply; the contended case falls back
+        to the client's open_file request, which can wait."""
+        holder = self._caps.get(ino)
+        if holder is not None and not holder["conn"].is_closed \
+                and holder["conn"] is not conn:
+            return False
+        self._caps[ino] = {"conn": conn, "holder": ""}
+        return True
+
     async def _req_create(self, d: dict) -> dict:
         parent, name = int(d["parent"]), str(d["name"])
         self._guard_busy((parent, name))
@@ -1245,7 +1281,11 @@ class MDSDaemon:
                 # re-resolves and retries at the target (a race with a
                 # concurrent symlink creation lands here).
                 raise MDSError(ELOOP, f"{name!r} is a symlink")
-            return {"dentry": await self._resolve_remote(existing)}
+            out = {"dentry": await self._resolve_remote(existing)}
+            if d.get("want_cap") and self._cap_grant_if_free(
+                    int(existing["ino"]), d.get("_conn")):
+                out["cap"] = "w"
+            return out
         except MDSError as e:
             if not e.missing_dentry:
                 raise
@@ -1255,7 +1295,11 @@ class MDSDaemon:
                  "ino": ino, "dentry": dentry}
         await self._journal(entry)
         await self._apply(entry)
-        return {"dentry": dentry}
+        out = {"dentry": dentry}
+        if d.get("want_cap") and self._cap_grant_if_free(
+                ino, d.get("_conn")):
+            out["cap"] = "w"
+        return out
 
     async def _req_symlink(self, d: dict) -> dict:
         """Server::handle_client_symlink: a dentry of type symlink
@@ -1352,6 +1396,20 @@ class MDSDaemon:
                     EBUSY, f"cross-rank rename in flight under "
                     f"{ino:x} ({bp:x}/{bn})")
         await self._check_no_boundary_anchors(ino)
+        # force-revoke EVERY cap this rank granted (no waiting — the
+        # holder's flush needs the very lock this export holds): the
+        # client flushes on receiving the recall and its setattr
+        # follows the post-export redirect.  Conservative (all caps,
+        # not just the subtree's) but exports are rare
+        for cap_ino in list(self._caps):
+            holder = self._caps.pop(cap_ino)
+            self._cap_resolve(cap_ino)
+            if not holder["conn"].is_closed:
+                try:
+                    holder["conn"].send_message(
+                        Message("cap_recall", {"ino": cap_ino}))
+                except ConnectionError:
+                    pass
         await self._compact_journal()
         # an entry is only redundant when it matches what the PARENT
         # chain already resolves to; "back to rank 0" under a delegated
@@ -1411,6 +1469,82 @@ class MDSDaemon:
         """Rank-to-rank load exchange (the MHeartbeat role: the
         balancing rank polls instead of every rank broadcasting)."""
         return {"load": self.my_load()}
+
+    # -- file write caps (Locker/Capability, the -lite slice) --------------
+    async def _cap_recall(self, ino: int,
+                          timeout: float = 3.0) -> None:
+        """Ask the holder to flush + release; force-revoke on timeout
+        or a dead connection (the reference's laggy-client cap
+        revocation)."""
+        holder = self._caps.get(ino)
+        if holder is None:
+            return
+        conn = holder["conn"]
+        if not conn.is_closed:
+            fut = asyncio.get_running_loop().create_future()
+            waiters = self._cap_waiters.setdefault(ino, [])
+            waiters.append(fut)
+            try:
+                conn.send_message(Message("cap_recall", {"ino": ino}))
+                await asyncio.wait_for(fut, timeout)
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
+            finally:
+                # remove only OUR future; a concurrent recall of the
+                # same ino keeps its own (single-slot clobbering made
+                # the second opener burn the full timeout)
+                if fut in self._cap_waiters.get(ino, ()):
+                    self._cap_waiters[ino].remove(fut)
+                if not self._cap_waiters.get(ino):
+                    self._cap_waiters.pop(ino, None)
+        self._caps.pop(ino, None)
+
+    def _cap_resolve(self, ino: int) -> None:
+        for fut in self._cap_waiters.pop(ino, ()):
+            if not fut.done():
+                fut.set_result(None)
+
+    async def _req_open_file(self, d: dict) -> dict:
+        """Open-time cap negotiation: a WRITE open takes the file's
+        exclusive buffered-write cap (recalling any other holder
+        first); a READ open just recalls — the holder's buffered bytes
+        and size must be flushed before the reader looks."""
+        parent, name = int(d["parent"]), str(d["name"])
+        conn = d.get("_conn")
+
+        async def fresh() -> dict:
+            # reply attrs must be the PRIMARY's (a remote stub has no
+            # size), post-flush when a recall just happened
+            de = await self._get_dentry(parent, name)
+            return (await self._resolve_remote(de)
+                    if de.get("remote") else de)
+
+        dentry = await self._get_dentry(parent, name)
+        if dentry["type"] != "file":
+            raise MDSError(EISDIR, name)
+        ino = int(dentry["ino"])    # remote stub shares the link ino
+        if not d.get("write"):
+            holder = self._caps.get(ino)
+            if holder is not None and holder["conn"] is not conn:
+                await self._cap_recall(ino)
+            return {"cap": "r", "dentry": await fresh()}
+        for _ in range(8):        # bounded: each pass evicts a holder
+            holder = self._caps.get(ino)
+            if holder is None or holder["conn"].is_closed \
+                    or holder["conn"] is conn:
+                self._caps[ino] = {"conn": conn,
+                                   "holder": str(d.get("who", ""))}
+                return {"cap": "w", "dentry": await fresh()}
+            await self._cap_recall(ino)
+        raise MDSError(EBUSY, f"cap on {ino:x} cannot be claimed")
+
+    async def _req_release_cap(self, d: dict) -> dict:
+        ino = int(d.get("ino", 0))
+        holder = self._caps.get(ino)
+        if holder is not None and holder["conn"] is d.get("_conn"):
+            self._caps.pop(ino, None)
+        self._cap_resolve(ino)
+        return {}
 
     async def _balance_loop(self) -> None:
         interval = self.conf["mds_bal_interval"]

@@ -1,0 +1,207 @@
+"""CephFS file write caps: exclusive buffered-write capability with
+MDS-driven recall (the Locker.cc / Capability.h model reduced to its
+-lite slice: one Fw/Fb holder per SESSION per file, granted in the
+create reply when uncontended, recalled when any other client opens
+the file — read or write; sibling handles in one session share the
+grant, which releases when the last of them closes)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.mds.daemon import block_oid
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _cluster():
+    cluster = DevCluster(n_mons=1, n_osds=3)
+    await cluster.start()
+    admin = await cluster.client()
+    await admin.pool_create("cephfs_meta", pg_num=4, size=3, min_size=2)
+    await admin.pool_create("cephfs_data", pg_num=4, size=3, min_size=2)
+    mds = await cluster.start_mds(name="a", block_size=4096)
+    await admin.shutdown()
+    return cluster, mds
+
+
+async def _mount(cluster, who):
+    rados = await cluster.client(f"client.{who}")
+    fs = await CephFS.connect(rados)
+    await fs.mount()
+    return rados, fs
+
+
+def test_cap_buffers_until_flush():
+    async def run():
+        cluster, mds = await _cluster()
+        ra, fa = await _mount(cluster, "a")
+        try:
+            fh = await fa.open("/f", "w")
+            assert fh._cap
+            await fh.write(b"buffered bytes")
+            ino = fh.ino
+            # nothing on RADOS yet: the write lives in the cap buffer
+            with pytest.raises(RadosError):
+                await fa.data.read(block_oid(ino, 0))
+            # the holder reads its own buffer
+            assert await fh.read(8, 0) == b"buffered"
+            await fh.fsync()
+            assert await fa.data.read(block_oid(ino, 0)) \
+                == b"buffered bytes"
+            await fh.close()
+            # cap released: the MDS table is clean
+            assert mds._caps == {}
+        finally:
+            await fa.unmount()
+            await ra.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_reader_open_recalls_writer():
+    async def run():
+        cluster, mds = await _cluster()
+        ra, fa = await _mount(cluster, "a")
+        rb, fb = await _mount(cluster, "b")
+        try:
+            fh = await fa.open("/shared.log", "w")
+            await fh.write(b"line one\n")
+            # B's read-open forces A to flush: content AND size arrive
+            rh = await fb.open("/shared.log", "r")
+            assert rh.size == 9
+            assert await rh.read() == b"line one\n"
+            assert not fh._cap          # A degraded to write-through
+            # A keeps writing (write-through now); B sees it after
+            # reopening (its own handle reads directly)
+            await fh.write(b"line two\n")
+            assert (await fb.open("/shared.log", "r")).size >= 9
+            await fh.close()
+        finally:
+            await fa.unmount()
+            await fb.unmount()
+            await ra.shutdown()
+            await rb.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_writer_handoff():
+    async def run():
+        cluster, mds = await _cluster()
+        ra, fa = await _mount(cluster, "a")
+        rb, fb = await _mount(cluster, "b")
+        try:
+            ha = await fa.open("/db", "w")
+            await ha.write(b"A" * 100)
+            hb = await fb.open("/db", "a")
+            assert hb._cap and not ha._cap
+            assert len(mds._caps) == 1
+            # A's buffered bytes were flushed by the recall; B appends
+            # after them
+            assert hb.size == 100
+            await hb.write(b"B" * 50)
+            await hb.close()
+            await ha.close()
+            final = await fa.open("/db", "r")
+            assert await final.read() == b"A" * 100 + b"B" * 50
+        finally:
+            await fa.unmount()
+            await fb.unmount()
+            await ra.shutdown()
+            await rb.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_dead_holder_revoked_on_timeout():
+    async def run():
+        cluster, mds = await _cluster()
+        ra, fa = await _mount(cluster, "a")
+        rb, fb = await _mount(cluster, "b")
+        try:
+            ha = await fa.open("/zombie", "w")
+            await ha.write(b"lost forever")
+            # A vanishes without closing: drop its recall handling so
+            # the MDS recall goes unanswered
+            fa._open_caps.clear()
+            ino = ha.ino
+            mds._caps[ino]["conn"] = next(iter(
+                mds._caps.values()))["conn"]
+            orig = fa._handle_cap_recall
+
+            async def ignore(conn, i):
+                return None
+            fa._handle_cap_recall = ignore
+            t0 = asyncio.get_running_loop().time()
+            hb = await fb.open("/zombie", "w")
+            assert hb._cap
+            # the grant waited out the 3s recall timeout, then revoked
+            assert asyncio.get_running_loop().time() - t0 >= 2.5
+            await hb.write(b"new owner")
+            await hb.close()
+            assert (await fb.open("/zombie", "r")).size == 9
+        finally:
+            await fa.unmount()
+            await fb.unmount()
+            await ra.shutdown()
+            await rb.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_convenience_paths_ride_caps_cleanly():
+    async def run():
+        cluster, mds = await _cluster()
+        ra, fa = await _mount(cluster, "a")
+        try:
+            await fa.write_file("/plain", b"direct")
+            assert await fa.read_file("/plain") == b"direct"
+            assert mds._caps == {}      # grant released at close
+        finally:
+            await fa.unmount()
+            await ra.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_sibling_handles_share_one_grant():
+    """Two write handles in ONE session share the per-session cap:
+    closing the first must not release the grant under the second,
+    and a same-session read handle sees the buffered bytes."""
+    async def run():
+        cluster, mds = await _cluster()
+        ra, fa = await _mount(cluster, "a")
+        rb, fb = await _mount(cluster, "b")
+        try:
+            h1 = await fa.open("/f", "w")
+            await h1.write(b"one")
+            h2 = await fa.open("/f", "a")
+            assert h1._cap and h2._cap
+            await h1.close()            # grant must survive: h2 lives
+            assert len(mds._caps) == 1
+            await h2.write(b"-two")
+            # same-session read handle: local flush, no recall needed
+            rh = await fa.open("/f", "r")
+            assert await rh.read() == b"one-two"
+            # another session's reader still recalls and sees all
+            rh2 = await fb.open("/f", "r")
+            assert await rh2.read() == b"one-two"
+            await h2.close()
+            assert mds._caps == {}
+        finally:
+            await fa.unmount()
+            await fb.unmount()
+            await ra.shutdown()
+            await rb.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
