@@ -250,6 +250,29 @@ async def _dispatch(args, rados: Rados) -> int:
                               max_mds=args.max_mds)
         if args.action in ("subvolume", "subvolumegroup"):
             return await _fs_volumes(rados, args, j)
+        if args.action == "snap-schedule":
+            import json as _json
+            if args.verb == "add":
+                return await _mon(
+                    rados, "config-key set", j,
+                    key=f"snap_sched/{args.path.lstrip('/')}",
+                    value=_json.dumps({
+                        "period": args.period, "retain": args.retain,
+                        "fs": args.fs_name}))
+            if args.verb == "rm":
+                return await _mon(
+                    rados, "config-key rm", j,
+                    key=f"snap_sched/{args.path.lstrip('/')}")
+            if args.verb == "status":
+                return await _mon(rados, "snap-schedule status", j)
+            r = await rados.mon_command("config-key ls")
+            if r["rc"] != 0:
+                print(f"Error: {r['outs']}", file=sys.stderr)
+                return 1
+            _print(sorted("/" + k[len("snap_sched/"):]
+                          for k in r["data"]
+                          if k.startswith("snap_sched/")), j)
+            return 0
         return await _mon(rados, "fs ls", j)
     if cmd == "mds":
         return await _mon(rados, "mds stat", j)
@@ -643,6 +666,17 @@ def build_parser() -> argparse.ArgumentParser:
     svg.add_argument("verb", choices=["create", "rm", "ls"])
     svg.add_argument("name", nargs="?", default="")
     svg.add_argument("--fs-name", dest="fs_name", default="cephfs")
+    ssch = fs_sub.add_parser("snap-schedule")
+    ssch_sub = ssch.add_subparsers(dest="verb", required=True)
+    ssa = ssch_sub.add_parser("add")
+    ssa.add_argument("path")
+    ssa.add_argument("--period", type=float, required=True)
+    ssa.add_argument("--retain", type=int, default=0)
+    ssa.add_argument("--fs-name", dest="fs_name", default="cephfs")
+    ssr = ssch_sub.add_parser("rm")
+    ssr.add_argument("path")
+    ssch_sub.add_parser("ls")
+    ssch_sub.add_parser("status")
 
     ins = sub.add_parser("insights")
     ins.add_argument("action", nargs="?", default="report")

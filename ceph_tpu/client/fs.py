@@ -713,13 +713,19 @@ class CephFS:
                             capped=capped)
             if capped:
                 ino = fh.ino
-                if ino in self._open_caps:
-                    # sibling handles share one per-session grant —
-                    # make the new handle see their buffered bytes
-                    for sib in list(self._open_caps[ino]):
-                        await sib.fsync()
-                        fh.size = max(fh.size, sib.size)
-                self._open_caps.setdefault(ino, set()).add(fh)
+                # register BEFORE the sibling awaits: a recall landing
+                # mid-flush then clears this handle's cap too, instead
+                # of leaving it buffering against a revoked grant
+                siblings = self._open_caps.setdefault(ino, set())
+                others = [s for s in siblings if s is not fh]
+                siblings.add(fh)
+                for sib in others:
+                    # share the grant's view: the new handle must see
+                    # the siblings' buffered bytes
+                    await sib.fsync()
+                    fh.size = max(fh.size, sib.size)
+                if fh not in self._open_caps.get(ino, ()):
+                    fh._cap = False   # recalled while we flushed
             if flags == "w" and fh.size:
                 await fh.truncate(0)
             return fh

@@ -1041,8 +1041,11 @@ class MDSDaemon:
             ino = int(msg.data.get("ino", 0))
             holder = self._caps.get(ino)
             if holder is not None and holder["conn"] is conn:
+                # only the CURRENT holder's release frees waiters; a
+                # late release from an already-revoked holder must
+                # not wake recalls aimed at the new grant
                 self._caps.pop(ino, None)
-            self._cap_resolve(ino)
+                self._cap_resolve(ino)
             return
         if msg.type == "mds_reply" and \
                 int(msg.data.get("tid", -1)) in self._peer_pending:
@@ -1497,7 +1500,10 @@ class MDSDaemon:
                     self._cap_waiters[ino].remove(fut)
                 if not self._cap_waiters.get(ino):
                     self._cap_waiters.pop(ino, None)
-        self._caps.pop(ino, None)
+        if self._caps.get(ino) is holder:
+            # pop only the grant WE recalled: the table may already
+            # carry a fresh grant made while this recall waited
+            self._caps.pop(ino, None)
 
     def _cap_resolve(self, ino: int) -> None:
         for fut in self._cap_waiters.pop(ino, ()):
@@ -1543,7 +1549,7 @@ class MDSDaemon:
         holder = self._caps.get(ino)
         if holder is not None and holder["conn"] is d.get("_conn"):
             self._caps.pop(ino, None)
-        self._cap_resolve(ino)
+            self._cap_resolve(ino)
         return {}
 
     async def _balance_loop(self) -> None:

@@ -221,6 +221,9 @@ class MgrStatMonitor(PaxosService):
             return CommandResult(data=self.digest.get("telemetry", {}))
         if name == "insights":
             return CommandResult(data=self.digest.get("insights", {}))
+        if name == "snap-schedule status":
+            return CommandResult(
+                data=self.digest.get("snap_schedule", {}))
         if name == "osd pool autoscale-status":
             return CommandResult(data=self.digest.get("pg_autoscale",
                                                       {}))

@@ -603,7 +603,8 @@ class Monitor:
         # pgmap-digest reads and mgr-module surfaces live on the
         # mgr-stat service (PGMap / balancer / progress / crash)
         if word in ("pg", "df", "balancer", "progress", "crash",
-                    "device", "telemetry", "orch", "insights"):
+                    "device", "telemetry", "orch", "insights",
+                    "snap-schedule"):
             return self.mgr_stat
         if word == "config-key":
             return self.config_monitor

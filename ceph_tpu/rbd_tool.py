@@ -129,6 +129,17 @@ async def _dispatch(args, rbd: RBD):
         elif args.snap_cmd == "rollback":
             await img.snap_rollback(snap)
         return None
+    if cmd in ("deep-cp", "migrate"):
+        dst = args.dst
+        dest = None
+        if "/" in dst:              # cross-pool: pool/name syntax
+            dpool, dst = dst.split("/", 1)
+            dest = RBD(await rbd.ioctx.rados.open_ioctx(dpool))
+        if cmd == "deep-cp":
+            await rbd.deep_copy(args.src, dst, dest=dest)
+        else:
+            await rbd.migrate(args.src, dst, dest=dest)
+        return None
     if cmd == "lock":
         img = await rbd.open(args.image)
         if args.lock_cmd == "ls":
@@ -175,6 +186,10 @@ def build_parser() -> argparse.ArgumentParser:
         x.add_argument("path")
         if name == "import":
             x.add_argument("--order", type=int, default=22)
+    for name in ("deep-cp", "migrate"):
+        x = sub.add_parser(name)
+        x.add_argument("src")
+        x.add_argument("dst")
     lk = sub.add_parser("lock")
     lk_sub = lk.add_subparsers(dest="lock_cmd", required=True)
     lkl = lk_sub.add_parser("ls")

@@ -30,6 +30,7 @@ class Mgr:
             Insights,
             PGAutoscaler,
             Progress,
+            SnapSchedule,
             Telemetry,
         )
 
@@ -49,7 +50,7 @@ class Mgr:
             modules = [Balancer(self), PGAutoscaler(self),
                        Progress(self), DeviceHealth(self),
                        Telemetry(self), Insights(self),
-                       Orchestrator(self)]
+                       SnapSchedule(self), Orchestrator(self)]
         self.modules = {m.name: m for m in modules}
         self.last_digest: dict | None = None
 
@@ -94,6 +95,10 @@ class Mgr:
             self.admin_socket = sock
 
     async def shutdown(self) -> None:
+        for mod in self.modules.values():
+            stop = getattr(mod, "stop", None)
+            if stop is not None:
+                await stop()
         if self.admin_socket is not None:
             await self.admin_socket.stop()
             self.admin_socket = None

@@ -385,6 +385,108 @@ class Telemetry(MgrModule):
         return {"telemetry": self._report}
 
 
+class SnapSchedule(MgrModule):
+    """Scheduled CephFS snapshots (reference pybind/mgr/snap_schedule):
+    schedules live in the mon config-key store as
+    ``snap_sched/<path>`` -> {"period": secs, "retain": n, "fs":
+    name}; each report cycle takes due snapshots (``scheduled-<ts>``)
+    and prunes beyond the retention count.  The module mounts the
+    filesystem itself, as the reference module does through its own
+    libcephfs handle."""
+
+    name = "snap_schedule"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._rados = None
+        self._fs = None
+        self._last: dict[str, float] = {}
+        self._status: dict[str, dict] = {}
+
+    async def _mount(self, fs_name: str):
+        from ceph_tpu.client.fs import CephFS
+        from ceph_tpu.client.rados import Rados
+
+        if self._fs is not None and self._fs.fs_name == fs_name \
+                and self._fs._mounted:
+            return self._fs
+        if self._fs is not None and self._fs._mounted:
+            await self._fs.unmount()   # switching fs: no leaked session
+        if self._rados is None:
+            # the mgr's own entity: reuses its auth identity/key
+            self._rados = Rados(self.mgr.monc.monmap, self.mgr.conf,
+                                name=self.mgr.name)
+            await self._rados.connect(timeout=10.0)
+        self._fs = await CephFS.connect(self._rados, fs_name,
+                                        timeout=5.0)
+        await self._fs.mount(timeout=10.0)
+        return self._fs
+
+    async def stop(self) -> None:
+        if self._fs is not None and self._fs._mounted:
+            await self._fs.unmount()
+            self._fs = None
+        if self._rados is not None:
+            await self._rados.shutdown()
+            self._rados = None
+
+    async def serve_once(self) -> None:
+        import asyncio
+        import json
+
+        from ceph_tpu.client.fs import FSError
+
+        try:
+            r = await self.mgr.monc.command("config-key ls")
+        except (ConnectionError, asyncio.TimeoutError):
+            return
+        if r.get("rc") != 0:
+            return
+        now = time.time()
+        active: set[str] = set()
+        for key in r["data"]:
+            if not key.startswith("snap_sched/"):
+                continue
+            path = "/" + key[len("snap_sched/"):].lstrip("/")
+            active.add(path)
+            try:
+                g = await self.mgr.monc.command("config-key get",
+                                                key=key)
+                spec = json.loads(g["data"]) if g.get("rc") == 0 \
+                    else {}
+            except (ConnectionError, asyncio.TimeoutError,
+                    ValueError):
+                continue
+            period = float(spec.get("period", 3600.0))
+            retain = int(spec.get("retain", 0))
+            if now - self._last.get(path, 0.0) < period:
+                continue
+            try:
+                fs = await self._mount(str(spec.get("fs", "cephfs")))
+                await fs.mksnap(path, f"scheduled-{int(now * 1000)}")
+                self._last[path] = now
+                snaps = sorted(n for n in await fs.listsnaps(path)
+                               if n.startswith("scheduled-"))
+                if retain > 0:
+                    for old in snaps[:-retain]:
+                        await fs.rmsnap(path, old)
+                    snaps = snaps[-retain:]
+                self._status[path] = {
+                    "last": now, "period": period, "retain": retain,
+                    "scheduled_snaps": len(snaps),
+                }
+            except (FSError, ConnectionError, OSError,
+                    asyncio.TimeoutError) as e:
+                self._status[path] = {"error": str(e),
+                                      "period": period}
+        # a removed schedule must vanish from the status report too
+        self._status = {p: s for p, s in self._status.items()
+                        if p in active}
+
+    def digest_contrib(self) -> dict:
+        return {"snap_schedule": self._status}
+
+
 class Insights(MgrModule):
     """Insights report (reference src/pybind/mgr/insights): accumulate
     health-check HISTORY — not just the instantaneous state — and fold

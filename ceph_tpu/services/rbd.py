@@ -184,6 +184,78 @@ class RBD:
         await self.ioctx.remove(f"rbd_id.{name}")
         await self.ioctx.rm_omap_keys(DIRECTORY_OID, [name])
 
+    async def deep_copy(self, src_name: str, dst_name: str,
+                        dest: "RBD | None" = None) -> None:
+        """Full image copy INCLUDING the snapshot history (librbd
+        deep-copy, src/librbd/deep_copy/): each source snapshot is
+        replayed onto the destination in id order — copy the data as
+        of the snap, snapshot the destination — then the head follows.
+        The result is standalone (parent links are flattened away) and
+        sparse regions stay sparse (all-zero object-size chunks are
+        skipped)."""
+        dest = dest or self
+        src = await self.open(src_name)
+        try:
+            await dest.create(dst_name, src.size, src.order,
+                              object_map=src._om is not None)
+            dst = await dest.open(dst_name)
+            zero = bytes(src.obj_size)
+            written: set[int] = set()   # dst objects holding data
+
+            async def copy_state(size: int, reader) -> None:
+                if dst.size != size:
+                    await dst.resize(size)
+                for objectno in range(-(-size // src.obj_size)):
+                    off = objectno * src.obj_size
+                    chunk = await reader(off,
+                                         min(src.obj_size,
+                                             size - off))
+                    if chunk and chunk != zero[:len(chunk)]:
+                        await dst.write(off, chunk)
+                        written.add(objectno)
+                    elif objectno in written:
+                        # zeroed since an earlier copied state: the
+                        # destination must not carry the stale bytes
+                        # forward (COW keeps them in the prior snap)
+                        await dst.write(off, zero[:len(chunk)])
+
+            for snap_name, info in sorted(
+                    src.snaps.items(), key=lambda kv: int(kv[1]["id"])):
+                await copy_state(
+                    int(info["size"]),
+                    lambda off, ln, s=snap_name:
+                        src.read_at_snap(s, off, ln))
+                await dst.snap_create(snap_name)
+                if info.get("protected"):
+                    await dst.snap_protect(snap_name)
+            await copy_state(src.size, src.read)
+            await dst.close()
+        finally:
+            await src.close()
+
+    async def migrate(self, src_name: str, dst_name: str,
+                      dest: "RBD | None" = None) -> None:
+        """Move an image (rbd migration prepare/execute/commit run
+        back to back, without the live-IO window): deep-copy, verify
+        the destination opens, then remove the source — snapshots must
+        be unprotected first, as for any remove."""
+        dest = dest or self
+        src = await self.open(src_name)
+        protected = [n for n, i in src.snaps.items()
+                     if i.get("protected")]
+        await src.close()
+        if protected:
+            raise RBDError(
+                f"unprotect snaps {protected} before migrating "
+                f"(clones would lose their parent)")
+        await self.deep_copy(src_name, dst_name, dest=dest)
+        await (await dest.open(dst_name)).close()   # sanity
+        img = await self.open(src_name)
+        for snap_name in list(img.snaps):
+            await img.snap_remove(snap_name)
+        await img.close()
+        await self.remove(src_name)
+
     async def image_id(self, name: str) -> str:
         """name -> image id (the rbd_id.<name> lookup); RBDError when
         absent.  Needs no open Image handle (journal-mode mirroring
