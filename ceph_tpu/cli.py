@@ -251,12 +251,15 @@ async def _dispatch(args, rados: Rados) -> int:
         if args.action in ("subvolume", "subvolumegroup"):
             return await _fs_volumes(rados, args, j)
         if args.action == "snap-schedule":
-            import json as _json
             if args.verb == "add":
+                if args.period <= 0:
+                    print("Error: --period must be positive",
+                          file=sys.stderr)
+                    return 1
                 return await _mon(
                     rados, "config-key set", j,
                     key=f"snap_sched/{args.path.lstrip('/')}",
-                    value=_json.dumps({
+                    value=json.dumps({
                         "period": args.period, "retain": args.retain,
                         "fs": args.fs_name}))
             if args.verb == "rm":
@@ -267,7 +270,8 @@ async def _dispatch(args, rados: Rados) -> int:
                 return await _mon(rados, "snap-schedule status", j)
             r = await rados.mon_command("config-key ls")
             if r["rc"] != 0:
-                print(f"Error: {r['outs']}", file=sys.stderr)
+                print(f"Error: {r['outs']} (rc={r['rc']})",
+                      file=sys.stderr)
                 return 1
             _print(sorted("/" + k[len("snap_sched/"):]
                           for k in r["data"]

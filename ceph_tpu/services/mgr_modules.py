@@ -412,15 +412,33 @@ class SnapSchedule(MgrModule):
             return self._fs
         if self._fs is not None and self._fs._mounted:
             await self._fs.unmount()   # switching fs: no leaked session
+        self._fs = None
         if self._rados is None:
             # the mgr's own entity: reuses its auth identity/key
             self._rados = Rados(self.mgr.monc.monmap, self.mgr.conf,
                                 name=self.mgr.name)
             await self._rados.connect(timeout=10.0)
-        self._fs = await CephFS.connect(self._rados, fs_name,
-                                        timeout=5.0)
-        await self._fs.mount(timeout=10.0)
-        return self._fs
+        fs = await CephFS.connect(self._rados, fs_name, timeout=5.0)
+        try:
+            await fs.mount(timeout=10.0)
+        except BaseException:
+            # connect installed a dispatcher link on the shared rados
+            # messenger: unhook it, or failed attempts stack forever
+            await fs.unmount()
+            raise
+        self._fs = fs
+        return fs
+
+    async def _drop_mount(self) -> None:
+        """Forget the cached mount after an error: the next cycle
+        re-discovers the active MDS from the FSMap, so a failover to a
+        new address heals instead of erroring forever."""
+        if self._fs is not None:
+            try:
+                await self._fs.unmount()
+            except (ConnectionError, OSError):
+                pass
+            self._fs = None
 
     async def stop(self) -> None:
         if self._fs is not None and self._fs._mounted:
@@ -452,13 +470,18 @@ class SnapSchedule(MgrModule):
             try:
                 g = await self.mgr.monc.command("config-key get",
                                                 key=key)
-                spec = json.loads(g["data"]) if g.get("rc") == 0 \
-                    else {}
+                if g.get("rc") != 0:
+                    continue      # removed between ls and get
+                spec = json.loads(g["data"])
             except (ConnectionError, asyncio.TimeoutError,
                     ValueError):
                 continue
             period = float(spec.get("period", 3600.0))
             retain = int(spec.get("retain", 0))
+            if period <= 0:
+                self._status[path] = {"error": "non-positive period",
+                                      "period": period}
+                continue
             if now - self._last.get(path, 0.0) < period:
                 continue
             try:
@@ -479,6 +502,7 @@ class SnapSchedule(MgrModule):
                     asyncio.TimeoutError) as e:
                 self._status[path] = {"error": str(e),
                                       "period": period}
+                await self._drop_mount()   # heal across MDS failover
         # a removed schedule must vanish from the status report too
         self._status = {p: s for p, s in self._status.items()
                         if p in active}

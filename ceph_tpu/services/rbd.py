@@ -200,24 +200,38 @@ class RBD:
                               object_map=src._om is not None)
             dst = await dest.open(dst_name)
             zero = bytes(src.obj_size)
-            written: set[int] = set()   # dst objects holding data
+            import hashlib
+
+            # objectno -> digest of the dst content as of the LAST
+            # copied state: unchanged objects are skipped, so each
+            # state writes only its delta (reference deep_copy's
+            # snap-delta behavior) instead of re-COWing everything
+            state: dict[int, bytes] = {}
 
             async def copy_state(size: int, reader) -> None:
                 if dst.size != size:
                     await dst.resize(size)
+                    nobj = -(-size // src.obj_size)
+                    for k in [k for k in state if k >= nobj]:
+                        del state[k]
                 for objectno in range(-(-size // src.obj_size)):
                     off = objectno * src.obj_size
                     chunk = await reader(off,
                                          min(src.obj_size,
                                              size - off))
-                    if chunk and chunk != zero[:len(chunk)]:
-                        await dst.write(off, chunk)
-                        written.add(objectno)
-                    elif objectno in written:
-                        # zeroed since an earlier copied state: the
-                        # destination must not carry the stale bytes
-                        # forward (COW keeps them in the prior snap)
-                        await dst.write(off, zero[:len(chunk)])
+                    if not chunk or chunk == zero[:len(chunk)]:
+                        if objectno in state:
+                            # zeroed since an earlier state: the dst
+                            # must not carry the stale bytes forward
+                            # (COW keeps them in the prior snap)
+                            await dst.write(off, zero[:len(chunk)])
+                            del state[objectno]
+                        continue
+                    digest = hashlib.md5(chunk).digest()
+                    if state.get(objectno) == digest:
+                        continue            # unchanged since last state
+                    await dst.write(off, chunk)
+                    state[objectno] = digest
 
             for snap_name, info in sorted(
                     src.snaps.items(), key=lambda kv: int(kv[1]["id"])):
