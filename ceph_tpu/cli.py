@@ -250,6 +250,25 @@ async def _dispatch(args, rados: Rados) -> int:
                               max_mds=args.max_mds)
         if args.action in ("subvolume", "subvolumegroup"):
             return await _fs_volumes(rados, args, j)
+        if args.action == "quota":
+            from ceph_tpu.client.fs import CephFS, FSError
+
+            fsc = await CephFS.connect(rados, args.fs_name)
+            await fsc.mount()
+            try:
+                if args.verb == "set":
+                    out = await fsc.setquota(
+                        args.path, max_bytes=args.max_bytes,
+                        max_files=args.max_files)
+                else:
+                    out = await fsc.getquota(args.path)
+            except FSError as e:
+                print(f"Error: {e} (rc={e.rc})", file=sys.stderr)
+                return 1
+            finally:
+                await fsc.unmount()
+            _print(out, j)
+            return 0
         if args.action == "snap-schedule":
             if args.verb == "add":
                 if args.period <= 0:
@@ -670,6 +689,17 @@ def build_parser() -> argparse.ArgumentParser:
     svg.add_argument("verb", choices=["create", "rm", "ls"])
     svg.add_argument("name", nargs="?", default="")
     svg.add_argument("--fs-name", dest="fs_name", default="cephfs")
+    fq = fs_sub.add_parser("quota")
+    fq_sub = fq.add_subparsers(dest="verb", required=True)
+    fqs = fq_sub.add_parser("set")
+    fqs.add_argument("path")
+    fqs.add_argument("--max-bytes", type=int, default=0)
+    fqs.add_argument("--max-files", type=int, default=0)
+    fqg = fq_sub.add_parser("get")
+    fqg.add_argument("path")
+    for sp_ in (fqs, fqg):
+        sp_.add_argument("--fs-name", dest="fs_name",
+                         default="cephfs")
     ssch = fs_sub.add_parser("snap-schedule")
     ssch_sub = ssch.add_subparsers(dest="verb", required=True)
     ssa = ssch_sub.add_parser("add")

@@ -563,6 +563,27 @@ class CephFS:
                                     name=name)
         return int(reply["snapid"])
 
+    async def setquota(self, path: str, max_bytes: int = 0,
+                       max_files: int = 0) -> dict:
+        """Directory quota (the setfattr ceph.quota.max_bytes/
+        max_files surface); both zero clears it."""
+        dentry = await self._resolve(path)
+        if dentry.get("type") != "dir":
+            raise FSError(ENOTDIR, path)
+        reply = await self._request("setquota",
+                                    ino=int(dentry["ino"]),
+                                    parent=int(dentry["ino"]),
+                                    max_bytes=max_bytes,
+                                    max_files=max_files)
+        return reply["quota"]
+
+    async def getquota(self, path: str) -> dict:
+        dentry = await self._resolve(path)
+        reply = await self._request("getquota",
+                                    ino=int(dentry["ino"]),
+                                    parent=int(dentry["ino"]))
+        return {"quota": reply["quota"], "usage": reply.get("usage")}
+
     async def export_dir(self, path: str, rank: int) -> None:
         """Delegate the subtree at ``path`` to another active MDS rank
         (the ``ceph mds export dir`` / Migrator role; operator API)."""

@@ -63,6 +63,7 @@ _FRAME = struct.Struct("<I")
 RANK_INO_BASE = 1 << 40
 EBUSY = -16
 EXDEV = -18
+EDQUOT = -122
 EREMOTE_RANK = -66          # client retries at reply["redirect_rank"]
 
 # errno-style codes shared with the client
@@ -86,6 +87,7 @@ def snap_dirfrag_oid(ino: int, snapid: int) -> str:
 
 
 SNAPTABLE_OID = "mds_snaptable"
+QUOTATABLE_OID = "mds_quotatab"
 
 
 def block_oid(ino: int, blockno: int) -> str:
@@ -158,6 +160,13 @@ class MDSDaemon:
         # (parent, name) pairs pinned by an in-flight cross-rank
         # rename (mutations on them get EBUSY — the xlock role)
         self._busy_names: set[tuple[int, str]] = set()
+        # directory quotas (reference client/mds vxattr quotas,
+        # quota.h quota_info_t): dir ino -> {max_bytes, max_files};
+        # usage is accounted lazily per quota root (first enforcement
+        # walks the subtree once, then increments ride each op — the
+        # rstat propagation role, without the per-ancestor journaling)
+        self.quotas: dict[int, dict] = {}
+        self._qusage: dict[int, dict] = {}
         # file write caps (Locker.cc/Capability.h reduced to the
         # -lite slice: ONE exclusive buffered-write cap per file ino,
         # granted at open, recalled when anyone else opens the file).
@@ -179,6 +188,7 @@ class MDSDaemon:
         self.data = await self.rados.open_ioctx(self.data_pool)
         self.snaps: dict[int, dict] = {}
         await self._load_snaptable()
+        await self._load_quotatable()
         await self._load_subtrees()
         await self._load_table()
         await self._replay_journal()
@@ -263,6 +273,16 @@ class MDSDaemon:
         self.snaps = {int(k): decode(v) for k, v in omap.items()}
         self._apply_snapc()
 
+    async def _load_quotatable(self) -> None:
+        try:
+            omap = await self.meta.get_omap(QUOTATABLE_OID)
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
+            omap = {}
+        self.quotas = {int(k): decode(v) for k, v in omap.items()}
+        self._qusage.clear()
+
     def _apply_snapc(self) -> None:
         """Keep the MDS's own data-pool writes (purges) COW-correct
         under the live snap set."""
@@ -308,6 +328,9 @@ class MDSDaemon:
         self._subtrees = {int(k): int(v) for k, v in omap.items()}
         self._auth_cache.clear()
         self._subtrees_loaded = time.monotonic()
+        # quota knowledge rides the same refresh cadence: a rank that
+        # just imported a realm root must enforce its quota
+        await self._load_quotatable()
 
     async def _replay_journal(self) -> None:
         """Re-apply journaled mutations a crash may have left unapplied
@@ -755,6 +778,7 @@ class MDSDaemon:
             # an exported DIRECTORY's descendants now resolve through
             # the destination's chain; cached auths are stale
             self._auth_cache.clear()
+            self._quota_invalidate()
         elif op in ("rename_export_intent", "rename_export_abort",
                     "link_export_intent", "link_export_abort",
                     "unlink_remote_intent", "unlink_remote_abort"):
@@ -800,6 +824,25 @@ class MDSDaemon:
         elif op == "setattr":
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dict(e["dentry"]))
+        elif op == "setquota":
+            ino = int(e["ino"])
+            q = {"max_bytes": int(e["max_bytes"]),
+                 "max_files": int(e["max_files"])}
+            if not q["max_bytes"] and not q["max_files"]:
+                try:
+                    await self.meta.operate(
+                        QUOTATABLE_OID,
+                        ObjectOperation().omap_rm([str(ino)]))
+                except RadosError as err:
+                    if err.rc != ENOENT:
+                        raise
+                self.quotas.pop(ino, None)
+                self._qusage.pop(ino, None)
+            else:
+                await self.meta.operate(
+                    QUOTATABLE_OID, ObjectOperation().create()
+                    .omap_set({str(ino): encode(q)}))
+                self.quotas[ino] = q
         elif op == "mksnap":
             await self.meta.operate(SNAPTABLE_OID, ObjectOperation()
                                     .create().omap_set({
@@ -1067,6 +1110,7 @@ class MDSDaemon:
     async def _resync(self) -> None:
         async with self._mutate:
             await self._load_snaptable()
+            await self._load_quotatable()
             await self._load_subtrees()
             await self._load_table()
             await self._replay_journal()
@@ -1248,12 +1292,14 @@ class MDSDaemon:
         parent, name = int(d["parent"]), str(d["name"])
         self._guard_busy((parent, name))
         await self._ensure_absent(parent, name)
+        qroots = await self._quota_check(parent, add_files=1)
         ino = await self._alloc_ino()
         dentry = _dentry(ino, "dir", int(d.get("mode", 0o755)))
         entry = {"op": "mkdir", "parent": parent, "name": name,
                  "ino": ino, "dentry": dentry}
         await self._journal(entry)
         await self._apply(entry)
+        self._quota_charge(qroots, files=1)
         return {"dentry": dentry}
 
     def _cap_grant_if_free(self, ino: int, conn) -> bool:
@@ -1292,12 +1338,14 @@ class MDSDaemon:
         except MDSError as e:
             if not e.missing_dentry:
                 raise
+        qroots = await self._quota_check(parent, add_files=1)
         ino = await self._alloc_ino()
         dentry = _dentry(ino, "file", int(d.get("mode", 0o644)))
         entry = {"op": "create", "parent": parent, "name": name,
                  "ino": ino, "dentry": dentry}
         await self._journal(entry)
         await self._apply(entry)
+        self._quota_charge(qroots, files=1)
         out = {"dentry": dentry}
         if d.get("want_cap") and self._cap_grant_if_free(
                 ino, d.get("_conn")):
@@ -1315,6 +1363,7 @@ class MDSDaemon:
         except MDSError as e:
             if not e.missing_dentry:
                 raise
+        qroots = await self._quota_check(parent, add_files=1)
         ino = await self._alloc_ino()
         dentry = _dentry(ino, "symlink", 0o777)
         dentry["target"] = str(d.get("target", ""))
@@ -1322,6 +1371,7 @@ class MDSDaemon:
                  "ino": ino, "dentry": dentry}
         await self._journal(entry)
         await self._apply(entry)
+        self._quota_charge(qroots, files=1)
         return {"dentry": dentry}
 
     async def _walk_subtree(self, ino: int) -> list[int]:
@@ -1399,6 +1449,13 @@ class MDSDaemon:
                     EBUSY, f"cross-rank rename in flight under "
                     f"{ino:x} ({bp:x}/{bn})")
         await self._check_no_boundary_anchors(ino)
+        for q in self.quotas:
+            if q != ino and await self._is_ancestor(q, ino):
+                # accounting is single-rank (the setquota EXDEV
+                # mirror): a realm must not span the delegation
+                raise MDSError(
+                    EXDEV, f"subtree lies inside quota realm {q:x}; "
+                    "clear the quota or export the realm root")
         # force-revoke EVERY cap this rank granted (no waiting — the
         # holder's flush needs the very lock this export holds): the
         # client flushes on receiving the recall and its setattr
@@ -1434,6 +1491,7 @@ class MDSDaemon:
                 .omap_set({str(ino): str(rank).encode()}))
             self._subtrees[ino] = rank
         self._auth_cache.clear()
+        self._quota_invalidate()
         # the subtree's popularity belongs to the importing rank now —
         # stale pops would inflate my_load (and the balancer's "need")
         # with load this rank no longer serves
@@ -1472,6 +1530,105 @@ class MDSDaemon:
         """Rank-to-rank load exchange (the MHeartbeat role: the
         balancing rank polls instead of every rank broadcasting)."""
         return {"load": self.my_load()}
+
+    # -- directory quotas (quota_info_t + rstat accounting, -lite) ---------
+    async def _quota_roots(self, dino: int) -> list[int]:
+        """Quota realms covering directory ``dino`` (every ancestor
+        with a quota record, itself included)."""
+        if not self.quotas:
+            return []
+        return [link for link in await self._parent_chain(dino)
+                if link in self.quotas]
+
+    async def _quota_usage(self, qino: int) -> dict:
+        """Cached {bytes, files} under quota root ``qino``; first use
+        walks the subtree (files + dirs count as entries, like
+        rfiles+rsubdirs), then per-op increments keep it current."""
+        u = self._qusage.get(qino)
+        if u is not None:
+            return u
+        total = files = 0
+        for dino in await self._walk_subtree(qino):
+            try:
+                kv = await self.meta.get_omap(dirfrag_oid(dino))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+                continue
+            for raw in kv.values():
+                de = decode(raw)
+                files += 1
+                if de.get("type") == "file" \
+                        and not de.get("remote"):
+                    total += int(de.get("size", 0))
+        u = {"bytes": total, "files": files}
+        self._qusage[qino] = u
+        return u
+
+    async def _quota_check(self, dino: int, add_files: int = 0,
+                           add_bytes: int = 0) -> list[int]:
+        """EDQUOT when the op would push any covering realm over its
+        limit; returns the realms so the caller can charge them after
+        the apply."""
+        roots = await self._quota_roots(dino)
+        for q in roots:
+            lim = self.quotas[q]
+            u = await self._quota_usage(q)
+            if add_files > 0 and int(lim.get("max_files", 0)) \
+                    and u["files"] + add_files > lim["max_files"]:
+                raise MDSError(EDQUOT,
+                               f"quota max_files exceeded on {q:x}")
+            if add_bytes > 0 and int(lim.get("max_bytes", 0)) \
+                    and u["bytes"] + add_bytes > lim["max_bytes"]:
+                raise MDSError(EDQUOT,
+                               f"quota max_bytes exceeded on {q:x}")
+        return roots
+
+    def _quota_charge(self, roots: list[int], files: int = 0,
+                      nbytes: int = 0) -> None:
+        for q in roots:
+            u = self._qusage.get(q)
+            if u is not None:
+                u["files"] += files
+                u["bytes"] += nbytes
+
+    def _quota_invalidate(self) -> None:
+        """Renames/imports/exports move whole subtrees between realms:
+        recount lazily instead of computing subtree deltas."""
+        self._qusage.clear()
+
+    async def _req_setquota(self, d: dict) -> dict:
+        """Set/clear a directory quota (the client setfattr
+        ceph.quota.* surface)."""
+        ino = int(d["ino"])
+        try:
+            await self.meta.stat(dirfrag_oid(ino))
+        except RadosError as e:
+            raise MDSError(ENOENT, f"no dir {ino:x}") \
+                if e.rc == ENOENT else e
+        max_bytes = max(0, int(d.get("max_bytes", 0)))
+        max_files = max(0, int(d.get("max_files", 0)))
+        for s, r in self._subtrees.items():
+            if r != self.rank and await self._is_ancestor(ino, s):
+                raise MDSError(
+                    EXDEV, f"subtree {s:x} inside the quota realm is "
+                    f"delegated to rank {r}; quota accounting is "
+                    "single-rank")
+        entry = {"op": "setquota", "ino": ino,
+                 "max_bytes": max_bytes, "max_files": max_files}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"quota": self.quotas.get(ino,
+                                         {"max_bytes": 0,
+                                          "max_files": 0})}
+
+    async def _req_getquota(self, d: dict) -> dict:
+        ino = int(d["ino"])
+        q = self.quotas.get(ino)
+        if q is None:
+            return {"quota": {"max_bytes": 0, "max_files": 0},
+                    "usage": None}
+        return {"quota": q, "usage": await self._quota_usage(ino)}
 
     # -- file write caps (Locker/Capability, the -lite slice) --------------
     async def _cap_recall(self, ino: int,
@@ -1805,6 +1962,7 @@ class MDSDaemon:
             dst_rank = await self._auth_rank(dp)
             if dst_rank == self.rank:
                 await self._ensure_absent(dp, dn)
+                qroots = await self._quota_check(dp, add_files=1)
                 entry = {"op": "link", "parent": dp, "name": dn,
                          "ino": ino,
                          "remote_dentry": {"type": "file",
@@ -1814,6 +1972,7 @@ class MDSDaemon:
                          "primary_dentry": primary, "anchor": anchor}
                 await self._journal(entry)
                 await self._apply(entry)
+                self._quota_charge(qroots, files=1)
                 if self.journal_len >= 256:
                     await self._compact_journal()
                 return {"dentry": {**primary, "remote": True}}
@@ -1869,12 +2028,14 @@ class MDSDaemon:
         except MDSError as e:
             if not e.missing_dentry:
                 raise
+        qroots = await self._quota_check(dp, add_files=1)
         entry = {"op": "import_link", "parent": dp, "name": dn,
                  "ino": int(dict(d["remote_dentry"])["ino"]),
                  "remote_dentry": dict(d["remote_dentry"]),
                  "token": token}
         await self._journal(entry)
         await self._apply(entry)
+        self._quota_charge(qroots, files=1)
         if token:
             state = await self._rename_marker_state(token)
             if not state.get("committed"):
@@ -1920,6 +2081,15 @@ class MDSDaemon:
                 entry = await self._unlink_plan(parent, name, dentry)
                 await self._journal(entry)
                 await self._apply(entry)
+                if entry["op"] == "promote_link":
+                    # the primary dentry (and its bytes) moved into
+                    # the promoted remote's directory: realms crossed
+                    self._quota_invalidate()
+                else:
+                    self._quota_charge(
+                        await self._quota_roots(parent), files=-1,
+                        nbytes=-(int(entry.get("size", 0))
+                                 if entry["op"] == "unlink" else 0))
                 if self.journal_len >= 256:
                     await self._compact_journal()
                 return {"ino": ino}
@@ -1943,6 +2113,7 @@ class MDSDaemon:
             {"op": "unlink_remote_finish", "parent": parent,
              "name": name, "ino": ino, "token": token},
             "primary rank unreachable; unlink rolled back")
+        self._quota_charge(await self._quota_roots(parent), files=-1)
         return {"ino": ino}
 
     async def _req_update_primary(self, d: dict) -> dict:
@@ -1999,6 +2170,7 @@ class MDSDaemon:
                  "ino": int(dentry["ino"])}
         await self._journal(entry)
         await self._apply(entry)
+        self._quota_charge(await self._quota_roots(parent), files=-1)
         return {}
 
     async def _is_ancestor(self, ino: int, of: int) -> bool:
@@ -2079,6 +2251,10 @@ class MDSDaemon:
                 unlinked_ino = int(dst["ino"])
                 purge_ino = int(dst["ino"])
                 purge_size = int(dst.get("size", 0))
+        await self._quota_check(
+            dp, add_files=1,
+            add_bytes=int(dentry.get("size", 0))
+            if dentry.get("type") == "file" else 0)
         entry = {"op": "import_dentry", "parent": dp, "name": dn,
                  "ino": int(dentry["ino"]), "dentry": dentry,
                  "purge_ino": purge_ino, "purge_size": purge_size,
@@ -2086,6 +2262,7 @@ class MDSDaemon:
                  "token": token, "pre": pre}
         await self._journal(entry)
         await self._apply(entry)
+        self._quota_invalidate()
         if token:
             state = await self._rename_marker_state(token)
             if not state.get("committed"):
@@ -2342,6 +2519,10 @@ class MDSDaemon:
                  "past_snaps": past_snaps}
         await self._journal(entry)
         await self._apply(entry)
+        if sp != dp:
+            # the moved entry (or subtree) may have changed quota
+            # realms: recount lazily
+            self._quota_invalidate()
         return {"dentry": dentry, "unlinked_ino": unlinked_ino}
 
     async def _req_setattr(self, d: dict) -> dict:
@@ -2365,15 +2546,20 @@ class MDSDaemon:
                 else:
                     self._guard_busy((parent, name))
             if forward_rank is None:
+                old_size = int(dentry.get("size", 0))
                 for key in ("size", "mode"):
                     if key in d and d[key] is not None:
                         dentry[key] = int(d[key])
                 dentry["mtime"] = float(d.get("mtime", time.time()))
+                delta = int(dentry.get("size", 0)) - old_size
+                qroots = await self._quota_check(
+                    parent, add_bytes=max(0, delta))
                 entry = {"op": "setattr", "parent": parent,
                          "name": name, "ino": int(dentry["ino"]),
                          "dentry": dentry}
                 await self._journal(entry)
                 await self._apply(entry)
+                self._quota_charge(qroots, nbytes=delta)
                 if self.journal_len >= 256:
                     await self._compact_journal()
                 return {"dentry": dentry}

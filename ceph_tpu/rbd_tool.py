@@ -140,6 +140,18 @@ async def _dispatch(args, rbd: RBD):
         else:
             await rbd.migrate(args.src, dst, dest=dest)
         return None
+    if cmd == "trash":
+        if args.trash_cmd == "mv":
+            return {"id": await rbd.trash_move(args.image,
+                                               delay=args.delay)}
+        if args.trash_cmd == "ls":
+            return await rbd.trash_list()
+        if args.trash_cmd == "restore":
+            return {"name": await rbd.trash_restore(
+                args.image_id, args.name or None)}
+        if args.trash_cmd == "rm":
+            await rbd.trash_remove(args.image_id, force=args.force)
+            return None
     if cmd == "lock":
         img = await rbd.open(args.image)
         if args.lock_cmd == "ls":
@@ -190,6 +202,18 @@ def build_parser() -> argparse.ArgumentParser:
         x = sub.add_parser(name)
         x.add_argument("src")
         x.add_argument("dst")
+    tr = sub.add_parser("trash")
+    tr_sub = tr.add_subparsers(dest="trash_cmd", required=True)
+    trm = tr_sub.add_parser("mv")
+    trm.add_argument("image")
+    trm.add_argument("--delay", type=float, default=0.0)
+    tr_sub.add_parser("ls")
+    trr = tr_sub.add_parser("restore")
+    trr.add_argument("image_id")
+    trr.add_argument("--name", default="")
+    trx = tr_sub.add_parser("rm")
+    trx.add_argument("image_id")
+    trx.add_argument("--force", action="store_true")
     lk = sub.add_parser("lock")
     lk_sub = lk.add_subparsers(dest="lock_cmd", required=True)
     lkl = lk_sub.add_parser("ls")

@@ -502,7 +502,12 @@ class SnapSchedule(MgrModule):
                     asyncio.TimeoutError) as e:
                 self._status[path] = {"error": str(e),
                                       "period": period}
-                await self._drop_mount()   # heal across MDS failover
+                if not isinstance(e, FSError) or e.rc == -110:
+                    # connection-shaped failure: drop the mount so
+                    # the next cycle re-discovers the active MDS.  A
+                    # plain op error (ENOENT path, EDQUOT, ...) keeps
+                    # the healthy session for the remaining paths
+                    await self._drop_mount()
         # a removed schedule must vanish from the status report too
         self._status = {p: s for p, s in self._status.items()
                         if p in active}

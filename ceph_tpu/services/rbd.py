@@ -41,6 +41,7 @@ from ceph_tpu.services.rbd_journal import (
 
 DIRECTORY_OID = "rbd_directory"
 CHILDREN_OID = "rbd_children"
+TRASH_OID = "rbd_trash"
 DEFAULT_ORDER = 22          # 4 MiB objects
 
 
@@ -183,6 +184,99 @@ class RBD:
         await self.ioctx.remove(f"rbd_header.{img.image_id}")
         await self.ioctx.remove(f"rbd_id.{name}")
         await self.ioctx.rm_omap_keys(DIRECTORY_OID, [name])
+
+    # -- trash (librbd trash_move/restore/remove, cls_rbd trash) -----------
+    async def trash_move(self, name: str, delay: float = 0.0) -> str:
+        """Move an image to the trash (rbd trash mv): the name is
+        freed immediately, the data survives until trash_remove —
+        refused before ``delay`` seconds pass (--expires-at role).
+        Images with clone children cannot leave the namespace."""
+        img = await self.open(name)
+        for snap_name, info in img.snaps.items():
+            if info.get("protected") and await _children_of(
+                    self.ioctx, img.image_id, int(info["id"])):
+                raise RBDError(
+                    f"image {name!r} has cloned children under "
+                    f"snap {snap_name!r}")
+        await self.ioctx.operate(TRASH_OID, ObjectOperation()
+                                 .create().omap_set({
+                                     img.image_id: json.dumps({
+                                         "name": name,
+                                         "deleted_at": time.time(),
+                                         "deferment_end":
+                                         time.time() + delay,
+                                     }).encode()}))
+        await self.ioctx.remove(f"rbd_id.{name}")
+        await self.ioctx.rm_omap_keys(DIRECTORY_OID, [name])
+        return img.image_id
+
+    async def trash_list(self) -> list[dict]:
+        try:
+            omap = await self.ioctx.get_omap(TRASH_OID)
+        except RadosError as e:
+            if e.rc == -2:
+                return []
+            raise
+        return sorted(({"id": k, **json.loads(v)}
+                       for k, v in omap.items()),
+                      key=lambda e: e["deleted_at"])
+
+    async def _trash_entry(self, image_id: str) -> dict:
+        try:
+            kv = await self.ioctx.get_omap(TRASH_OID, [image_id])
+        except RadosError as e:
+            if e.rc == -2:
+                kv = {}
+            else:
+                raise
+        if image_id not in kv:
+            raise RBDError(f"no trashed image {image_id!r}")
+        return json.loads(kv[image_id])
+
+    async def trash_restore(self, image_id: str,
+                            new_name: str | None = None) -> str:
+        """Bring a trashed image back (rbd trash restore), under its
+        old name or a new one."""
+        ent = await self._trash_entry(image_id)
+        name = new_name or str(ent["name"])
+        try:
+            await self.ioctx.get_xattr(f"rbd_id.{name}", "id")
+            raise RBDError(f"image {name!r} exists")
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+        await self.ioctx.operate(
+            f"rbd_id.{name}", ObjectOperation().create()
+            .set_xattr("id", image_id.encode()))
+        await self.ioctx.operate(DIRECTORY_OID, ObjectOperation()
+                                 .create()
+                                 .omap_set({name:
+                                            image_id.encode()}))
+        await self.ioctx.rm_omap_keys(TRASH_OID, [image_id])
+        return name
+
+    async def trash_remove(self, image_id: str,
+                           force: bool = False) -> None:
+        """Purge a trashed image's data for good; refused while the
+        deferment window holds (unless forced)."""
+        ent = await self._trash_entry(image_id)
+        if not force and time.time() < float(ent["deferment_end"]):
+            raise RBDError(
+                f"deferment expires in "
+                f"{float(ent['deferment_end']) - time.time():.0f}s "
+                f"(use force)")
+        # restore under a reserved name so the normal remove path
+        # (snap cleanup, child unlink, object sweep) does the work
+        tmp = f".trash-purge.{image_id}"
+        await self.trash_restore(image_id, tmp)
+        img = await self.open(tmp)
+        for snap_name in list(img.snaps):
+            info = img.snaps[snap_name]
+            if info.get("protected"):
+                await img.snap_unprotect(snap_name)
+            await img.snap_remove(snap_name)
+        await img.close()
+        await self.remove(tmp)
 
     async def deep_copy(self, src_name: str, dst_name: str,
                         dest: "RBD | None" = None) -> None:

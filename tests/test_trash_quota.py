@@ -1,0 +1,172 @@
+"""rbd trash (deferred image deletion) + CephFS directory quotas.
+
+Reference surfaces: librbd trash_move/restore/remove + `rbd trash`,
+and the client quota vxattrs (ceph.quota.max_bytes/max_files,
+quota_info_t) with rstat-style usage accounting."""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS, FSError
+from ceph_tpu.mds.daemon import EDQUOT
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rbd import RBD, RBDError
+from ceph_tpu.vstart import DevCluster
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+ORDER = 14
+BLK = 1 << ORDER
+
+
+def test_trash_lifecycle():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rbdt", pg_num=8)
+            rbd = RBD(await rados.open_ioctx("rbdt"))
+            await rbd.create("vm", 2 * BLK, order=ORDER)
+            img = await rbd.open("vm")
+            await img.write(0, b"precious")
+            await img.close()
+            image_id = await rbd.trash_move("vm", delay=3600.0)
+            # the name is free immediately; the data survives
+            assert await rbd.list() == []
+            with pytest.raises(RBDError):
+                await rbd.open("vm")
+            ent = (await rbd.trash_list())[0]
+            assert ent["id"] == image_id and ent["name"] == "vm"
+            # purge refused inside the deferment window
+            with pytest.raises(RBDError):
+                await rbd.trash_remove(image_id)
+            # restore under a new name, content intact
+            assert await rbd.trash_restore(image_id, "vm2") == "vm2"
+            back = await rbd.open("vm2")
+            assert await back.read(0, 8) == b"precious"
+            await back.close()
+            assert await rbd.trash_list() == []
+            # trash again and force-purge: everything is gone
+            await rbd.trash_move("vm2", delay=3600.0)
+            await rbd.trash_remove(image_id, force=True)
+            assert await rbd.trash_list() == []
+            leftovers = [o for o in await rbd.ioctx.list_objects()
+                         if image_id in o]
+            assert leftovers == []
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_trash_refuses_images_with_children():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rbdt", pg_num=8)
+            rbd = RBD(await rados.open_ioctx("rbdt"))
+            await rbd.create("parent", 2 * BLK, order=ORDER)
+            img = await rbd.open("parent")
+            await img.write(0, b"base")
+            await img.snap_create("s")
+            await img.snap_protect("s")
+            await img.close()
+            await rbd.clone("parent", "s", "child")
+            with pytest.raises(RBDError):
+                await rbd.trash_move("parent")
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+async def _fs_cluster():
+    cluster = DevCluster(n_mons=1, n_osds=3)
+    await cluster.start()
+    admin = await cluster.client()
+    await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                            min_size=2)
+    await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                            min_size=2)
+    mds = await cluster.start_mds(name="a", block_size=4096)
+    rados = await cluster.client("client.fs")
+    fs = await CephFS.connect(rados)
+    await fs.mount()
+    return cluster, mds, admin, rados, fs
+
+
+def test_quota_max_files():
+    async def run():
+        cluster, mds, admin, rados, fs = await _fs_cluster()
+        try:
+            await fs.mkdirs("/proj/sub")
+            await fs.write_file("/proj/pre", b"x")
+            q = await fs.setquota("/proj", max_files=4)
+            assert q["max_files"] == 4
+            # usage counts existing entries (sub + pre = 2)
+            got = await fs.getquota("/proj")
+            assert got["usage"]["files"] == 2
+            await fs.write_file("/proj/sub/three", b"")
+            await fs.mkdir("/proj/four")
+            with pytest.raises(FSError) as ei:
+                await fs.write_file("/proj/five", b"")
+            assert ei.value.rc == EDQUOT
+            with pytest.raises(FSError) as ei:
+                await fs.mkdir("/proj/sub/five")
+            assert ei.value.rc == EDQUOT
+            # freeing an entry makes room again
+            await fs.unlink("/proj/pre")
+            await fs.write_file("/proj/five", b"")
+            # outside the realm: unlimited
+            await fs.write_file("/free", b"")
+            # clearing the quota lifts the limit
+            await fs.setquota("/proj")
+            await fs.write_file("/proj/six", b"")
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_quota_max_bytes():
+    async def run():
+        cluster, mds, admin, rados, fs = await _fs_cluster()
+        try:
+            await fs.mkdir("/cap")
+            await fs.setquota("/cap", max_bytes=10000)
+            await fs.write_file("/cap/a", b"x" * 6000)
+            assert (await fs.getquota("/cap"))["usage"]["bytes"] \
+                == 6000
+            # the size flush that would exceed the realm is refused
+            with pytest.raises(FSError) as ei:
+                await fs.write_file("/cap/b", b"y" * 6000)
+            assert ei.value.rc == EDQUOT
+            # shrinking frees budget
+            fh = await fs.open("/cap/a", "w")      # truncates to 0
+            await fh.close()
+            await fs.write_file("/cap/b", b"y" * 6000)
+            # quota survives an MDS restart (journaled + table object)
+            await mds.shutdown()
+            del cluster.mdss["a"]
+            mds2 = await cluster.start_mds(name="a2",
+                                           block_size=4096)
+            fs2 = CephFS(rados, str(mds2.msgr.my_addr))
+            await fs2.mount()
+            with pytest.raises(FSError) as ei:
+                await fs2.write_file("/cap/c", b"z" * 6000)
+            assert ei.value.rc == EDQUOT
+            await fs2.unmount()
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
