@@ -280,8 +280,10 @@ class MDSDaemon:
             if e.rc != ENOENT:
                 raise
             omap = {}
-        self.quotas = {int(k): decode(v) for k, v in omap.items()}
-        self._qusage.clear()
+        new = {int(k): decode(v) for k, v in omap.items()}
+        if new != self.quotas:
+            self.quotas = new
+            self._qusage.clear()
 
     def _apply_snapc(self) -> None:
         """Keep the MDS's own data-pool writes (purges) COW-correct
@@ -1566,11 +1568,15 @@ class MDSDaemon:
         return u
 
     async def _quota_check(self, dino: int, add_files: int = 0,
-                           add_bytes: int = 0) -> list[int]:
+                           add_bytes: int = 0,
+                           roots: list[int] | None = None
+                           ) -> list[int]:
         """EDQUOT when the op would push any covering realm over its
         limit; returns the realms so the caller can charge them after
-        the apply."""
-        roots = await self._quota_roots(dino)
+        the apply.  ``roots``: check these realms instead of dino's
+        full chain (renames charge only the NET-GAINING realms)."""
+        if roots is None:
+            roots = await self._quota_roots(dino)
         for q in roots:
             lim = self.quotas[q]
             u = await self._quota_usage(q)
@@ -2504,6 +2510,19 @@ class MDSDaemon:
                               "remotes": rec["remotes"]}
             else:
                 anchor_ino = 0
+        if self.quotas:
+            # admission into realms the move NET-GAINS (shared
+            # ancestors see no change); matches the cross-rank
+            # import_dentry check
+            src_roots = set(await self._quota_roots(sp))
+            gain = [q for q in await self._quota_roots(dp)
+                    if q not in src_roots]
+            if gain:
+                await self._quota_check(
+                    dp, add_files=1,
+                    add_bytes=int(dentry.get("size", 0))
+                    if dentry.get("type") == "file" else 0,
+                    roots=gain)
         past_snaps: list[int] = []
         if dentry["type"] == "dir" and self.snaps:
             # realm membership at the OLD location must stick to the
@@ -2519,10 +2538,9 @@ class MDSDaemon:
                  "past_snaps": past_snaps}
         await self._journal(entry)
         await self._apply(entry)
-        if sp != dp:
-            # the moved entry (or subtree) may have changed quota
-            # realms: recount lazily
-            self._quota_invalidate()
+        # realms changed (cross-dir move) or an overwrite purged the
+        # destination (same-dir too): recount lazily
+        self._quota_invalidate()
         return {"dentry": dentry, "unlinked_ino": unlinked_ino}
 
     async def _req_setattr(self, d: dict) -> dict:

@@ -258,25 +258,46 @@ class RBD:
     async def trash_remove(self, image_id: str,
                            force: bool = False) -> None:
         """Purge a trashed image's data for good; refused while the
-        deferment window holds (unless forced)."""
+        deferment window holds (unless forced).  The purge works on
+        the image id directly — the image NEVER reappears in the live
+        namespace, and a failure partway leaves it listed in the
+        trash (header ops are name-independent, and the trash entry
+        is removed last)."""
         ent = await self._trash_entry(image_id)
         if not force and time.time() < float(ent["deferment_end"]):
             raise RBDError(
                 f"deferment expires in "
                 f"{float(ent['deferment_end']) - time.time():.0f}s "
                 f"(use force)")
-        # restore under a reserved name so the normal remove path
-        # (snap cleanup, child unlink, object sweep) does the work
-        tmp = f".trash-purge.{image_id}"
-        await self.trash_restore(image_id, tmp)
-        img = await self.open(tmp)
+        img = Image(self.ioctx, f"<trash:{image_id}>", image_id)
+        await img.refresh()
         for snap_name in list(img.snaps):
-            info = img.snaps[snap_name]
-            if info.get("protected"):
+            if img.snaps[snap_name].get("protected"):
                 await img.snap_unprotect(snap_name)
             await img.snap_remove(snap_name)
-        await img.close()
-        await self.remove(tmp)
+        for oid in [o for o in await self.ioctx.list_objects()
+                    if o.startswith(img.object_prefix + ".")]:
+            await self.ioctx.remove(oid)
+        if img.parent is not None:
+            ppool = img.parent.get("pool", self.ioctx.pool_name)
+            pio = (self.ioctx if ppool == self.ioctx.pool_name
+                   else await self.ioctx.rados.open_ioctx(ppool))
+            try:
+                await pio.rm_omap_keys(CHILDREN_OID, [
+                    _child_key(img.parent["image_id"],
+                               int(img.parent["snap_id"]),
+                               image_id),
+                ])
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+        try:
+            await self.ioctx.remove(f"rbd_object_map.{image_id}")
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+        await self.ioctx.remove(img.header_oid)
+        await self.ioctx.rm_omap_keys(TRASH_OID, [image_id])
 
     async def deep_copy(self, src_name: str, dst_name: str,
                         dest: "RBD | None" = None) -> None:

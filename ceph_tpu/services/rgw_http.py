@@ -123,23 +123,90 @@ def _canonical_query(raw_query: str) -> str:
 
 
 def sigv4_string_to_sign(req: _Request, signed_headers: list[str],
-                         scope: str, amz_date: str) -> str:
-    payload_hash = req.header("x-amz-content-sha256")
-    if payload_hash in ("", "UNSIGNED-PAYLOAD"):
-        payload_hash = (payload_hash or
-                        hashlib.sha256(req.body).hexdigest())
+                         scope: str, amz_date: str,
+                         payload_hash: str | None = None,
+                         raw_query: str | None = None) -> str:
+    """The ONE SigV4 canonicalization (header auth, presigned
+    verification, and URL generation all feed through here so the
+    folding/quoting rules can never drift apart).  ``payload_hash``:
+    presigned mode forces UNSIGNED-PAYLOAD; ``raw_query``: presigned
+    verification signs the query minus X-Amz-Signature."""
+    if payload_hash is None:
+        payload_hash = req.header("x-amz-content-sha256")
+        if payload_hash in ("", "UNSIGNED-PAYLOAD"):
+            payload_hash = (payload_hash or
+                            hashlib.sha256(req.body).hexdigest())
     canon_headers = "".join(
         f"{h}:{' '.join(req.header(h).split())}\n" for h in signed_headers
     )
     canon_uri = urllib.parse.quote(req.path, safe="/-_.~")
     canonical = "\n".join([
-        req.method, canon_uri, _canonical_query(req.raw_query),
+        req.method, canon_uri,
+        _canonical_query(req.raw_query if raw_query is None
+                         else raw_query),
         canon_headers, ";".join(signed_headers), payload_hash,
     ])
     return "\n".join([
         "AWS4-HMAC-SHA256", amz_date, scope,
         hashlib.sha256(canonical.encode()).hexdigest(),
     ])
+
+
+def _parse_scope_date(amz_date: str, cred_day: str) -> float:
+    """x-amz-date -> epoch seconds, enforcing the credential-scope
+    day match (shared by header auth and presigned verification)."""
+    import calendar
+
+    try:
+        ts = calendar.timegm(time.strptime(amz_date,
+                                           "%Y%m%dT%H%M%SZ"))
+    except ValueError:
+        raise _HTTPError(403, "AccessDenied", "bad x-amz-date")
+    if amz_date[:8] != cred_day:
+        raise _HTTPError(403, "SignatureDoesNotMatch",
+                         "credential scope date mismatch")
+    return ts
+
+
+def presign_url(method: str, host: str, port: int, bucket: str,
+                key: str, access_key: str, secret_key: str,
+                expires: int = 3600, region: str = "us-east-1",
+                session_token: str | None = None,
+                amz_date: str | None = None) -> str:
+    """Generate a presigned URL (the SDK generate_presigned_url /
+    reference query-string auth role): anyone holding the URL can
+    perform ``method`` on bucket/key until it expires.  The signature
+    covers method, path, the X-Amz-* query parameters, and the host
+    header; the payload is UNSIGNED-PAYLOAD, as presigned requests
+    always are."""
+    amz_date = amz_date or time.strftime("%Y%m%dT%H%M%SZ",
+                                         time.gmtime())
+    day = amz_date[:8]
+    scope = f"{day}/{region}/s3/aws4_request"
+    path = "/" + "/".join(
+        urllib.parse.quote(seg, safe="-_.~")
+        for seg in f"{bucket}/{key}".split("/"))
+    host_hdr = f"{host}:{port}"
+    params = [
+        ("X-Amz-Algorithm", "AWS4-HMAC-SHA256"),
+        ("X-Amz-Credential", f"{access_key}/{scope}"),
+        ("X-Amz-Date", amz_date),
+        ("X-Amz-Expires", str(int(expires))),
+        ("X-Amz-SignedHeaders", "host"),
+    ]
+    if session_token is not None:
+        params.append(("X-Amz-Security-Token", session_token))
+    enc = urllib.parse.quote
+    query = "&".join(f"{enc(k, safe='-_.~')}={enc(v, safe='-_.~')}"
+                     for k, v in sorted(params))
+    req = _Request(method, f"{path}?{query}",
+                   {"host": host_hdr}, b"")
+    sts = sigv4_string_to_sign(req, ["host"], scope, amz_date,
+                               payload_hash="UNSIGNED-PAYLOAD")
+    sig = hmac.new(_sig_key(secret_key, day, region, "s3"),
+                   sts.encode(), hashlib.sha256).hexdigest()
+    return (f"http://{host_hdr}{path}?{query}"
+            f"&X-Amz-Signature={sig}")
 
 
 def sigv4_sign(req: _Request, access_key: str, secret_key: str,
@@ -356,6 +423,9 @@ class S3Frontend:
     async def _identify(self, req: _Request) -> str:
         auth = req.header("authorization")
         if not auth:
+            if req.query.get("X-Amz-Algorithm") \
+                    == "AWS4-HMAC-SHA256":
+                return await self._identify_presigned(req)
             return ANONYMOUS
         if not auth.startswith("AWS4-HMAC-SHA256 "):
             raise _HTTPError(400, "InvalidArgument", "unsupported auth")
@@ -396,23 +466,60 @@ class S3Frontend:
                              "payload hash mismatch")
         return uid
 
+    async def _identify_presigned(self, req: _Request) -> str:
+        """Query-string (presigned URL) SigV4 auth — reference
+        rgw_auth_s3.cc query-string mode: the signature rides the
+        query parameters, the payload is UNSIGNED, and validity is
+        bounded by X-Amz-Date + X-Amz-Expires instead of the clock
+        skew alone."""
+        q = req.query
+        try:
+            cred = q["X-Amz-Credential"].split("/")
+            access_key, day, region = cred[0], cred[1], cred[2]
+            amz_date = q["X-Amz-Date"]
+            expires = int(q["X-Amz-Expires"])
+            signed = q["X-Amz-SignedHeaders"].split(";")
+            their_sig = q["X-Amz-Signature"]
+        except (KeyError, IndexError, ValueError):
+            raise _HTTPError(400, "InvalidArgument",
+                             "malformed presigned query")
+        if not 1 <= expires <= 604800:
+            raise _HTTPError(400, "InvalidArgument",
+                             "X-Amz-Expires out of range")
+        ts = _parse_scope_date(amz_date, day)
+        now = time.time()
+        if now > ts + expires:
+            raise _HTTPError(403, "AccessDenied",
+                             "Request has expired")
+        if ts > now + self._SKEW_S:
+            raise _HTTPError(403, "RequestTimeTooSkewed", amz_date)
+        if self.users is None:
+            raise _HTTPError(403, "InvalidAccessKeyId", access_key)
+        uid, secret, session_token = await self._lookup_key(access_key)
+        if session_token is not None and not hmac.compare_digest(
+                session_token, q.get("X-Amz-Security-Token", "")):
+            raise _HTTPError(403, "InvalidToken", access_key)
+        scope = f"{day}/{region}/s3/aws4_request"
+        # the canonical query is everything EXCEPT the signature
+        sts = sigv4_string_to_sign(
+            req, signed, scope, amz_date,
+            payload_hash="UNSIGNED-PAYLOAD",
+            raw_query="&".join(
+                part for part in req.raw_query.split("&")
+                if not part.startswith("X-Amz-Signature=")))
+        want = hmac.new(_sig_key(secret, day, region, "s3"),
+                        sts.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, their_sig):
+            raise _HTTPError(403, "SignatureDoesNotMatch", access_key)
+        return uid
+
     # Reference rgw_auth_s3.cc rejects requests whose signed timestamp
     # drifts more than RGW_AUTH_GRACE (15 min) from the server clock —
     # without this a captured signed request replays forever.
     _SKEW_S = 15 * 60
 
     def _check_request_time(self, amz_date: str, cred_day: str) -> None:
-        import calendar
-
-        try:
-            ts = calendar.timegm(
-                time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
-        except ValueError:
-            raise _HTTPError(403, "AccessDenied", "bad x-amz-date")
-        if amz_date[:8] != cred_day:
-            raise _HTTPError(
-                403, "SignatureDoesNotMatch",
-                "credential scope date mismatch")
+        ts = _parse_scope_date(amz_date, cred_day)
         if abs(time.time() - ts) > self._SKEW_S:
             raise _HTTPError(403, "RequestTimeTooSkewed", amz_date)
 
