@@ -669,6 +669,88 @@ class RGWLite:
     def _vkey(key: str, version_id: str) -> str:
         return f"{key}\x00{version_id}"
 
+    # -- CORS (rgw_cors.cc) ------------------------------------------------
+    async def put_bucket_cors(self, bucket: str,
+                              rules: list[dict]) -> None:
+        """rules: [{allowed_origins, allowed_methods,
+        allowed_headers?, expose_headers?, max_age_seconds?}] —
+        origins may carry one ``*`` wildcard, as S3 allows."""
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        if not rules:
+            # S3 rejects a rule-less document (MalformedXML): an
+            # empty config must not shadow NoSuchCORSConfiguration
+            raise RGWError("InvalidArgument",
+                           "CORSConfiguration needs at least one rule")
+        for r in rules:
+            if not r.get("allowed_origins") \
+                    or not r.get("allowed_methods"):
+                raise RGWError("InvalidArgument",
+                               "rule needs origins + methods")
+            bad = [m for m in r["allowed_methods"]
+                   if m not in ("GET", "PUT", "POST", "DELETE",
+                                "HEAD")]
+            if bad:
+                raise RGWError("InvalidArgument",
+                               f"unsupported methods {bad}")
+            multi = [p for p in r["allowed_origins"]
+                     if p.count("*") > 1]
+            if multi:
+                raise RGWError("InvalidRequest",
+                               f"origins allow at most one '*': "
+                               f"{multi}")
+        meta["cors"] = [dict(r) for r in rules]
+        await self._put_bucket_meta(bucket, meta)
+
+    async def get_bucket_cors(self, bucket: str) -> list[dict]:
+        # a config document: owner-gated like policy/notification
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        cors = meta.get("cors")
+        if cors is None:
+            raise RGWError("NoSuchCORSConfiguration", bucket)
+        return cors
+
+    async def delete_bucket_cors(self, bucket: str) -> None:
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        meta.pop("cors", None)
+        await self._put_bucket_meta(bucket, meta)
+
+    @staticmethod
+    def _cors_pattern_ok(pat: str, value: str) -> bool:
+        """One-'*'-wildcard match (rgw_cors.cc host_name_matches),
+        shared by origin and AllowedHeader evaluation."""
+        if pat == "*":
+            return True
+        head, star, tail = pat.partition("*")
+        if not star:
+            return pat == value
+        return (value.startswith(head) and value.endswith(tail)
+                and len(value) >= len(head) + len(tail))
+
+    @staticmethod
+    def cors_match(rules: list[dict], origin: str,
+                   method: str) -> dict | None:
+        """First rule matching (origin, method)."""
+        for r in rules:
+            if method in r.get("allowed_methods", ()) and any(
+                    RGWLite._cors_pattern_ok(p, origin)
+                    for p in r.get("allowed_origins", ())):
+                return r
+        return None
+
+    @staticmethod
+    def cors_header_grant(rule: dict,
+                          requested: list[str]) -> list[str] | None:
+        """The requested headers when EVERY one is allowed by the
+        rule (wildcard patterns included), else None — a preflight
+        with any disallowed header must fail, not silently grant a
+        subset the browser will reject anyway."""
+        allowed = [h.lower() for h in rule.get("allowed_headers", ())]
+        for h in requested:
+            if not any(RGWLite._cors_pattern_ok(p, h.lower())
+                       for p in allowed):
+                return None
+        return requested
+
     async def put_bucket_compression(self, bucket: str,
                                      alg: str | None = "zlib") -> None:
         """Per-bucket at-rest compression (rgw_compression.cc role):

@@ -60,6 +60,7 @@ _STATUS = {
     "NoSuchUpload": 404,
     "NoSuchLifecycleConfiguration": 404,
     "NoSuchBucketPolicy": 404,
+    "NoSuchCORSConfiguration": 404,
     "MalformedPolicy": 400,
     "BucketNotEmpty": 409,
     "BucketAlreadyExists": 409,
@@ -298,6 +299,9 @@ class S3Frontend:
                 if req.stream is not None and \
                         req.stream_consumed >= req.content_length:
                     keep = keep_after_stream
+                if req.header("origin"):
+                    headers = {**headers,
+                               **await self._cors_headers(req)}
                 await self._respond(writer, req, status, headers, body,
                                     keep)
                 if not keep:
@@ -554,8 +558,85 @@ class S3Frontend:
             raise _HTTPError(403, "AccessDenied", f"{uid} suspended")
         return uid, rec["secret_key"], None
 
+    # -- CORS (rgw_cors.cc: preflight + response decoration) --------------
+    async def _bucket_cors_rules(self, bucket: str) -> list[dict]:
+        """The bucket's CORS rules via the system context — CORS
+        evaluation is configuration, not an authorized data access
+        (preflights are unsigned by design)."""
+        from ceph_tpu.client.rados import RadosError
+
+        if not bucket:
+            return []
+        try:
+            meta = await self.rgw._bucket_meta(bucket)
+        except (RGWError, RadosError):
+            return []
+        return meta.get("cors") or []
+
+    async def _cors_rule(self, req: _Request,
+                         method: str) -> tuple[dict | None, dict]:
+        """(matched rule, base response headers) for the request's
+        bucket + Origin — the one lookup both the preflight and the
+        response decoration share."""
+        bucket = req.path.lstrip("/").split("/", 1)[0]
+        rules = await self._bucket_cors_rules(bucket)
+        origin = req.header("origin")
+        rule = RGWLite.cors_match(rules, origin, method)
+        if rule is None:
+            return None, {}
+        return rule, {
+            "access-control-allow-origin":
+                "*" if rule["allowed_origins"] == ["*"] else origin,
+            "vary": "Origin",
+        }
+
+    async def _cors_headers(self, req: _Request) -> dict[str, str]:
+        if req.method == "OPTIONS":
+            return {}     # the preflight handler already decorated
+        rule, out = await self._cors_rule(req, req.method)
+        if rule is None:
+            return {}
+        if rule.get("expose_headers"):
+            out["access-control-expose-headers"] = \
+                ",".join(rule["expose_headers"])
+        return out
+
+    async def _preflight(self, req: _Request):
+        """OPTIONS preflight (RGWOp_CORS): match Origin + requested
+        method against the bucket's rules; never authenticated."""
+        origin = req.header("origin")
+        want = req.header("access-control-request-method")
+        if not origin or not want:
+            raise _HTTPError(400, "InvalidArgument",
+                             "preflight needs Origin + "
+                             "Access-Control-Request-Method")
+        rule, headers = await self._cors_rule(req, want)
+        if rule is None:
+            raise _HTTPError(403, "AccessDenied", "CORSResponse: no "
+                             "matching rule")
+        headers["access-control-allow-methods"] = \
+            ",".join(rule["allowed_methods"])
+        want_headers = req.header("access-control-request-headers")
+        if want_headers:
+            grant = RGWLite.cors_header_grant(
+                rule, [h.strip() for h in want_headers.split(",")
+                       if h.strip()])
+            if grant is None:
+                # a disallowed requested header fails the WHOLE
+                # preflight (S3 semantics) — a silent subset grant
+                # would still be rejected by the browser, opaquely
+                raise _HTTPError(403, "AccessDenied",
+                                 "CORSResponse: header not allowed")
+            headers["access-control-allow-headers"] = ",".join(grant)
+        if rule.get("max_age_seconds"):
+            headers["access-control-max-age"] = \
+                str(rule["max_age_seconds"])
+        return 200, headers, b""
+
     # -- routing (rgw_rest_s3.cc RGWHandler_REST_S3) ----------------------
     async def _route(self, req: _Request):
+        if req.method == "OPTIONS":
+            return await self._preflight(req)
         uid = await self._identify(req)
         gw = self.rgw.as_user(None if uid in self.system_users
                               else uid)
@@ -612,6 +693,10 @@ class S3Frontend:
                 canned = req.header("x-amz-acl", "private")
                 await gw.put_bucket_acl(bucket, canned)
                 return 200, {}, b""
+            if "cors" in q:
+                await gw.put_bucket_cors(bucket,
+                                         _parse_cors(req.body))
+                return 200, {}, b""
             if "notification" in q:
                 # S3 PutBucketNotificationConfiguration REPLACES the
                 # whole document (an empty one disables notifications)
@@ -634,6 +719,9 @@ class S3Frontend:
             await gw.create_bucket(bucket)
             return 200, {"location": f"/{bucket}"}, b""
         if req.method == "DELETE":
+            if "cors" in q:
+                await gw.delete_bucket_cors(bucket)
+                return 204, {}, b""
             if "lifecycle" in q:
                 await gw.delete_lifecycle(bucket)
                 return 204, {}, b""
@@ -651,6 +739,23 @@ class S3Frontend:
             return await self._bulk_delete(req, gw, bucket)
         if req.method != "GET":
             raise _HTTPError(405, "MethodNotAllowed", req.method)
+        if "cors" in q:
+            rules = await gw.get_bucket_cors(bucket)
+            root = ET.Element("CORSConfiguration", xmlns=XMLNS)
+            for rule in rules:
+                r = ET.SubElement(root, "CORSRule")
+                for o in rule.get("allowed_origins", ()):
+                    ET.SubElement(r, "AllowedOrigin").text = o
+                for m in rule.get("allowed_methods", ()):
+                    ET.SubElement(r, "AllowedMethod").text = m
+                for h in rule.get("allowed_headers", ()):
+                    ET.SubElement(r, "AllowedHeader").text = h
+                for h in rule.get("expose_headers", ()):
+                    ET.SubElement(r, "ExposeHeader").text = h
+                if rule.get("max_age_seconds"):
+                    ET.SubElement(r, "MaxAgeSeconds").text = \
+                        str(rule["max_age_seconds"])
+            return self._xml(root)
         if "versioning" in q:
             state = await gw.get_bucket_versioning(bucket)
             root = ET.Element("VersioningConfiguration", xmlns=XMLNS)
@@ -1051,6 +1156,33 @@ def _parse_complete(body: bytes) -> list[tuple[int, str]]:
                 or "").strip('"')
         parts.append((int(num), etag))
     return parts
+
+
+def _parse_cors(body: bytes) -> list[dict]:
+    """CORSConfiguration XML -> rule dicts (namespaced or not)."""
+    cfg = ET.fromstring(body.decode() or "<CORSConfiguration/>")
+
+    def texts(rule, tag):
+        return [e.text for e in (rule.findall(_ns(tag))
+                                 or rule.findall(tag)) if e.text]
+
+    rules = []
+    for r in (list(cfg.findall(_ns("CORSRule")))
+              or list(cfg.findall("CORSRule"))):
+        rule = {
+            "allowed_origins": texts(r, "AllowedOrigin"),
+            "allowed_methods": texts(r, "AllowedMethod"),
+        }
+        if texts(r, "AllowedHeader"):
+            rule["allowed_headers"] = texts(r, "AllowedHeader")
+        if texts(r, "ExposeHeader"):
+            rule["expose_headers"] = texts(r, "ExposeHeader")
+        age = (r.findtext(_ns("MaxAgeSeconds"))
+               or r.findtext("MaxAgeSeconds"))
+        if age:
+            rule["max_age_seconds"] = int(age)
+        rules.append(rule)
+    return rules
 
 
 def _parse_lifecycle(body: bytes) -> list[dict]:

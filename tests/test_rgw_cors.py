@@ -1,0 +1,162 @@
+"""RGW CORS: bucket configuration, OPTIONS preflight, and response
+decoration (reference rgw_cors.cc + RGWOp_CORS)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers
+from ceph_tpu.services.rgw_http import S3Frontend
+from tests.test_rgw_http import S3HttpClient
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+CORS_XML = b"""<CORSConfiguration>
+  <CORSRule>
+    <AllowedOrigin>https://app.example.com</AllowedOrigin>
+    <AllowedOrigin>https://*.trusted.io</AllowedOrigin>
+    <AllowedMethod>GET</AllowedMethod>
+    <AllowedMethod>PUT</AllowedMethod>
+    <AllowedHeader>*</AllowedHeader>
+    <ExposeHeader>etag</ExposeHeader>
+    <MaxAgeSeconds>600</MaxAgeSeconds>
+  </CORSRule>
+</CORSConfiguration>"""
+
+
+def test_cors_end_to_end():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            ioctx = await rados.open_ioctx("rgw")
+            users = RGWUsers(ioctx)
+            alice = await users.create("alice")
+            gw = RGWLite(ioctx, users=users)
+            fe = S3Frontend(gw, users=users)
+            host, port = await fe.start()
+            cli = S3HttpClient(host, port, alice["access_key"],
+                               alice["secret_key"])
+            anon = S3HttpClient(host, port)
+            try:
+                st, _, _ = await cli.request("PUT", "/web", b"")
+                assert st == 200
+                st, _, _ = await cli.request("PUT", "/web/a.js",
+                                             b"js")
+                assert st == 200
+                # configure CORS over the REST surface
+                st, _, _ = await cli.request("PUT", "/web?cors",
+                                             CORS_XML)
+                assert st == 200, st
+                st, _, body = await cli.request("GET", "/web?cors")
+                assert st == 200 and b"AllowedOrigin" in body
+                # preflight from an allowed origin (unsigned)
+                st, h, _ = await anon.request(
+                    "OPTIONS", "/web/a.js", headers={
+                        "origin": "https://app.example.com",
+                        "access-control-request-method": "PUT",
+                        "access-control-request-headers":
+                            "content-type,x-custom",
+                    })
+                assert st == 200, st
+                assert h["access-control-allow-origin"] == \
+                    "https://app.example.com"
+                assert "PUT" in h["access-control-allow-methods"]
+                assert "content-type" in \
+                    h["access-control-allow-headers"]
+                assert h["access-control-max-age"] == "600"
+                # wildcard origin pattern matches subdomains
+                st, h, _ = await anon.request(
+                    "OPTIONS", "/web/a.js", headers={
+                        "origin": "https://api.trusted.io",
+                        "access-control-request-method": "GET",
+                    })
+                assert st == 200
+                # disallowed origin or method: 403
+                st, _, _ = await anon.request(
+                    "OPTIONS", "/web/a.js", headers={
+                        "origin": "https://evil.example.net",
+                        "access-control-request-method": "GET",
+                    })
+                assert st == 403
+                st, _, _ = await anon.request(
+                    "OPTIONS", "/web/a.js", headers={
+                        "origin": "https://app.example.com",
+                        "access-control-request-method": "DELETE",
+                    })
+                assert st == 403
+                # actual GET carries the decoration + expose headers
+                st, h, body = await cli.request(
+                    "GET", "/web/a.js",
+                    headers={"origin": "https://app.example.com"})
+                assert st == 200 and body == b"js"
+                assert h["access-control-allow-origin"] == \
+                    "https://app.example.com"
+                assert h["access-control-expose-headers"] == "etag"
+                # delete the config: preflight stops matching
+                st, _, _ = await cli.request("DELETE", "/web?cors")
+                assert st == 204
+                st, _, _ = await anon.request(
+                    "OPTIONS", "/web/a.js", headers={
+                        "origin": "https://app.example.com",
+                        "access-control-request-method": "GET",
+                    })
+                assert st == 403
+                st, _, _ = await cli.request("GET", "/web?cors")
+                assert st == 404
+            finally:
+                await fe.stop()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_cors_store_validation():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            ioctx = await rados.open_ioctx("rgw")
+            gw = RGWLite(ioctx, users=RGWUsers(ioctx))
+            await gw.create_bucket("b")
+            with pytest.raises(RGWError):
+                await gw.put_bucket_cors("b", [{"allowed_origins":
+                                                ["*"]}])
+            with pytest.raises(RGWError):
+                await gw.put_bucket_cors("b", [
+                    {"allowed_origins": ["*"],
+                     "allowed_methods": ["PATCH"]}])
+            with pytest.raises(RGWError):
+                await gw.put_bucket_cors("b", [])    # empty config
+            with pytest.raises(RGWError):            # two wildcards
+                await gw.put_bucket_cors("b", [
+                    {"allowed_origins": ["https://*.x.*"],
+                     "allowed_methods": ["GET"]}])
+            # header grants: all-or-nothing, wildcard patterns work
+            rule = {"allowed_origins": ["*"],
+                    "allowed_methods": ["GET"],
+                    "allowed_headers": ["content-type", "x-amz-*"]}
+            assert RGWLite.cors_header_grant(
+                rule, ["Content-Type", "x-amz-date"]) is not None
+            assert RGWLite.cors_header_grant(
+                rule, ["Content-Type", "x-custom"]) is None
+            assert RGWLite.cors_match(
+                [{"allowed_origins": ["https://*.x.io"],
+                  "allowed_methods": ["GET"]}],
+                "https://a.x.io", "GET") is not None
+            # the wildcard must not match overlapping prefix/suffix
+            assert RGWLite.cors_match(
+                [{"allowed_origins": ["https://a*a.io"],
+                  "allowed_methods": ["GET"]}],
+                "https://a.io", "GET") is None
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
