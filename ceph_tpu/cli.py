@@ -610,6 +610,29 @@ async def _dispatch_rados(args, rados: Rados, j: bool) -> int:
             await io.remove(args.obj)
         elif a == "stat":
             _print(await io.stat(args.obj), j)
+        elif a == "listomapkeys":
+            for k in sorted(await io.get_omap(args.obj)):
+                print(k)
+        elif a == "getomapval":
+            kv = await io.get_omap(args.obj, [args.key])
+            if args.key not in kv:
+                print(f"no key {args.key!r}", file=sys.stderr)
+                return 1
+            sys.stdout.buffer.write(kv[args.key])
+        elif a == "setomapval":
+            await io.set_omap(args.obj,
+                              {args.key: args.value.encode()})
+        elif a == "rmomapkey":
+            await io.rm_omap_keys(args.obj, [args.key])
+        elif a == "listxattr":
+            for k in sorted(await io.get_xattrs(args.obj)):
+                print(k)
+        elif a == "getxattr":
+            sys.stdout.buffer.write(
+                await io.get_xattr(args.obj, args.key))
+        elif a == "setxattr":
+            await io.set_xattr(args.obj, args.key,
+                               args.value.encode())
         else:
             print(f"unknown rados action {a!r}", file=sys.stderr)
             return 2
@@ -828,6 +851,18 @@ def build_parser() -> argparse.ArgumentParser:
         r.add_argument("obj")
         r.add_argument("file")
     rados_sub.add_parser("ls")
+    for name in ("listomapkeys", "listxattr"):
+        r = rados_sub.add_parser(name)
+        r.add_argument("obj")
+    for name in ("getomapval", "getxattr", "rmomapkey"):
+        r = rados_sub.add_parser(name)
+        r.add_argument("obj")
+        r.add_argument("key")
+    for name in ("setomapval", "setxattr"):
+        r = rados_sub.add_parser(name)
+        r.add_argument("obj")
+        r.add_argument("key")
+        r.add_argument("value")
     bench = rados_sub.add_parser("bench")
     bench.add_argument("seconds", type=int)
     bench.add_argument("mode", choices=["write", "seq"])

@@ -83,6 +83,10 @@ class ObjectOperation:
         self.ops.append({"op": "getxattr", "name": name})
         return self
 
+    def get_xattrs(self) -> "ObjectOperation":
+        self.ops.append({"op": "getxattrs"})
+        return self
+
     def rm_xattr(self, name: str) -> "ObjectOperation":
         self.ops.append({"op": "rmxattr", "name": name})
         return self
@@ -346,6 +350,10 @@ class IoCtx:
 
     async def rm_xattr(self, oid: str, name: str) -> None:
         await self.operate(oid, ObjectOperation().rm_xattr(name))
+
+    async def get_xattrs(self, oid: str) -> dict[str, bytes]:
+        r = await self.operate(oid, ObjectOperation().get_xattrs())
+        return r["results"][0]["attrs"]
 
     async def get_omap(self, oid: str,
                        keys: list[str] | None = None) -> dict[str, bytes]:

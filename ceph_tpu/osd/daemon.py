@@ -3362,6 +3362,8 @@ class OSDDaemon:
                         return ENOENT_RC, results, 0
                     results.append({"value": raw})
                 elif kind == "getxattrs":
+                    if await be._read_meta(oid) is None:
+                        return ENOENT_RC, results, 0
                     attrs = await be.get_attrs(oid)
                     results.append({"attrs": {
                         k[len(XATTR_PREFIX):]: v
@@ -3685,6 +3687,8 @@ class OSDDaemon:
                     return ENOENT_RC, results, version
                 results.append({"value": raw})
             elif kind == "getxattrs":
+                if not exists:
+                    return ENOENT_RC, results, version
                 results.append({"attrs": {
                     k[len(XATTR_PREFIX):]: v
                     for k, v in all_xattrs().items()

@@ -694,10 +694,12 @@ class RGWLite:
                                f"unsupported methods {bad}")
             multi = [p for p in r["allowed_origins"]
                      if p.count("*") > 1]
+            multi += [p for p in r.get("allowed_headers", ())
+                      if p.count("*") > 1]
             if multi:
                 raise RGWError("InvalidRequest",
-                               f"origins allow at most one '*': "
-                               f"{multi}")
+                               f"origins/headers allow at most one "
+                               f"'*': {multi}")
         meta["cors"] = [dict(r) for r in rules]
         await self._put_bucket_meta(bucket, meta)
 

@@ -241,6 +241,9 @@ class S3Frontend:
         self.system_users = system_users
         self._server: asyncio.AbstractServer | None = None
         self._reqid = 0
+        # bucket -> (fetched_at, cors rules): decoration must not
+        # double bucket-meta reads on every Origin-bearing request
+        self._cors_cache: dict[str, tuple[float, list]] = {}
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
@@ -562,16 +565,28 @@ class S3Frontend:
     async def _bucket_cors_rules(self, bucket: str) -> list[dict]:
         """The bucket's CORS rules via the system context — CORS
         evaluation is configuration, not an authorized data access
-        (preflights are unsigned by design)."""
+        (preflights are unsigned by design).  A 1s TTL cache keeps
+        the decoration hook from doubling bucket-meta reads on every
+        Origin-bearing request."""
+        import time as _time
+
         from ceph_tpu.client.rados import RadosError
 
         if not bucket:
             return []
+        hit = self._cors_cache.get(bucket)
+        now = _time.monotonic()
+        if hit is not None and now - hit[0] < 1.0:
+            return hit[1]
         try:
             meta = await self.rgw._bucket_meta(bucket)
+            rules = meta.get("cors") or []
         except (RGWError, RadosError):
-            return []
-        return meta.get("cors") or []
+            rules = []
+        self._cors_cache[bucket] = (now, rules)
+        if len(self._cors_cache) > 4096:
+            self._cors_cache.clear()
+        return rules
 
     async def _cors_rule(self, req: _Request,
                          method: str) -> tuple[dict | None, dict]:
@@ -584,11 +599,17 @@ class S3Frontend:
         rule = RGWLite.cors_match(rules, origin, method)
         if rule is None:
             return None, {}
-        return rule, {
+        base = {
             "access-control-allow-origin":
                 "*" if rule["allowed_origins"] == ["*"] else origin,
             "vary": "Origin",
         }
+        if base["access-control-allow-origin"] != "*":
+            # echoing a specific origin implies credentialed use is
+            # allowed (S3 sends this; browsers require it for
+            # fetch(..., credentials: 'include'))
+            base["access-control-allow-credentials"] = "true"
+        return rule, base
 
     async def _cors_headers(self, req: _Request) -> dict[str, str]:
         if req.method == "OPTIONS":
@@ -628,7 +649,7 @@ class S3Frontend:
                 raise _HTTPError(403, "AccessDenied",
                                  "CORSResponse: header not allowed")
             headers["access-control-allow-headers"] = ",".join(grant)
-        if rule.get("max_age_seconds"):
+        if rule.get("max_age_seconds") is not None:
             headers["access-control-max-age"] = \
                 str(rule["max_age_seconds"])
         return 200, headers, b""
@@ -696,6 +717,7 @@ class S3Frontend:
             if "cors" in q:
                 await gw.put_bucket_cors(bucket,
                                          _parse_cors(req.body))
+                self._cors_cache.pop(bucket, None)
                 return 200, {}, b""
             if "notification" in q:
                 # S3 PutBucketNotificationConfiguration REPLACES the
@@ -721,6 +743,7 @@ class S3Frontend:
         if req.method == "DELETE":
             if "cors" in q:
                 await gw.delete_bucket_cors(bucket)
+                self._cors_cache.pop(bucket, None)
                 return 204, {}, b""
             if "lifecycle" in q:
                 await gw.delete_lifecycle(bucket)
@@ -752,7 +775,7 @@ class S3Frontend:
                     ET.SubElement(r, "AllowedHeader").text = h
                 for h in rule.get("expose_headers", ()):
                     ET.SubElement(r, "ExposeHeader").text = h
-                if rule.get("max_age_seconds"):
+                if rule.get("max_age_seconds") is not None:
                     ET.SubElement(r, "MaxAgeSeconds").text = \
                         str(rule["max_age_seconds"])
             return self._xml(root)
@@ -1173,10 +1196,11 @@ def _parse_cors(body: bytes) -> list[dict]:
             "allowed_origins": texts(r, "AllowedOrigin"),
             "allowed_methods": texts(r, "AllowedMethod"),
         }
-        if texts(r, "AllowedHeader"):
-            rule["allowed_headers"] = texts(r, "AllowedHeader")
-        if texts(r, "ExposeHeader"):
-            rule["expose_headers"] = texts(r, "ExposeHeader")
+        for tag, field in (("AllowedHeader", "allowed_headers"),
+                           ("ExposeHeader", "expose_headers")):
+            vals = texts(r, tag)
+            if vals:
+                rule[field] = vals
         age = (r.findtext(_ns("MaxAgeSeconds"))
                or r.findtext("MaxAgeSeconds"))
         if age:

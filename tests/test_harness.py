@@ -95,6 +95,41 @@ def test_cli_end_to_end(tmp_path, capsys):
         rc, out = await ceph("rados", "-p", "clipool", "get", "obj",
                              str(dst))
         assert rc == 0 and dst.read_bytes() == b"cli-payload"
+        # omap / xattr operator verbs (the rados tool surface)
+        rc, _ = await ceph("rados", "-p", "clipool", "setomapval",
+                           "obj", "k1", "v1")
+        assert rc == 0
+        rc, out = await ceph("rados", "-p", "clipool",
+                             "listomapkeys", "obj")
+        assert rc == 0 and "k1" in out
+        rc, out = await ceph("rados", "-p", "clipool", "getomapval",
+                             "obj", "k1")
+        assert rc == 0 and "v1" in out
+        rc, _ = await ceph("rados", "-p", "clipool", "rmomapkey",
+                           "obj", "k1")
+        assert rc == 0
+        rc, out = await ceph("rados", "-p", "clipool",
+                             "listomapkeys", "obj")
+        assert rc == 0 and "k1" not in out
+        rc, _ = await ceph("rados", "-p", "clipool", "setxattr",
+                           "obj", "mime", "text/plain")
+        assert rc == 0
+        rc, out = await ceph("rados", "-p", "clipool", "listxattr",
+                             "obj")
+        assert rc == 0 and "mime" in out
+        rc, out = await ceph("rados", "-p", "clipool", "getxattr",
+                             "obj", "mime")
+        assert rc == 0 and "text/plain" in out
+        rc, out = await ceph("rados", "-p", "clipool", "stat", "obj")
+        assert rc == 0
+        rc, _ = await ceph("rados", "-p", "clipool", "rm", "obj")
+        assert rc == 0
+        # absent objects error (not an empty listing) like real rados
+        rc, _ = await ceph("rados", "-p", "clipool", "listxattr",
+                           "obj")
+        assert rc == 1
+        rc, out = await ceph("rados", "-p", "clipool", "ls")
+        assert rc == 0 and "obj" not in out
         rc, out = await ceph("--format", "json", "osd", "stat")
         assert rc == 0 and json.loads(out)["num_up_osds"] == 3
         # orch surface (no backend attached: specs store fine, status
