@@ -130,10 +130,12 @@ def _recovery_latency_ms(ec, stripes: int = 1024) -> float:
     return dec["seconds"] * 1e3
 
 
-def _clay_repair_gibps(stripes: int = 16, sc: int = 1024) -> float:
+def _clay_repair_gibps(stripes: int = 128, sc: int = 1024) -> float:
     """cfg4 single-chip: CLAY k=8 m=4 d=11 repair as one device apply of
     the probed repair operator (recovered bytes per second; helper reads
-    are d*sub/q = 11/4 of the recovered volume)."""
+    are d*sub/q = 11/4 of the recovered volume).  128 stripes x 64 KiB
+    chunks is the whole-chunk-recovery shape — a 16-stripe batch (~3 MB
+    per apply) measured launch overhead, not the kernel."""
     import jax.numpy as jnp
 
     from ceph_tpu.ec.benchmark import device_seconds_per_iter

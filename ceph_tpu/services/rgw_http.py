@@ -568,14 +568,12 @@ class S3Frontend:
         (preflights are unsigned by design).  A 1s TTL cache keeps
         the decoration hook from doubling bucket-meta reads on every
         Origin-bearing request."""
-        import time as _time
-
         from ceph_tpu.client.rados import RadosError
 
         if not bucket:
             return []
         hit = self._cors_cache.get(bucket)
-        now = _time.monotonic()
+        now = time.monotonic()
         if hit is not None and now - hit[0] < 1.0:
             return hit[1]
         try:
@@ -593,22 +591,25 @@ class S3Frontend:
         """(matched rule, base response headers) for the request's
         bucket + Origin — the one lookup both the preflight and the
         response decoration share."""
+        origin = req.header("origin")
+        if not origin:
+            return None, {}      # no Origin, no CORS evaluation
         bucket = req.path.lstrip("/").split("/", 1)[0]
         rules = await self._bucket_cors_rules(bucket)
-        origin = req.header("origin")
         rule = RGWLite.cors_match(rules, origin, method)
         if rule is None:
             return None, {}
-        base = {
-            "access-control-allow-origin":
-                "*" if rule["allowed_origins"] == ["*"] else origin,
-            "vary": "Origin",
-        }
-        if base["access-control-allow-origin"] != "*":
-            # echoing a specific origin implies credentialed use is
-            # allowed (S3 sends this; browsers require it for
-            # fetch(..., credentials: 'include'))
+        base = {"vary": "Origin"}
+        # the credentials grant keys off WHICH pattern matched: only
+        # a NON-wildcard pattern may echo the origin with
+        # allow-credentials (wildcard + credentials is the exact
+        # combination the browser * ban exists to prevent)
+        if any(p != "*" and RGWLite._cors_pattern_ok(p, origin)
+               for p in rule.get("allowed_origins", ())):
+            base["access-control-allow-origin"] = origin
             base["access-control-allow-credentials"] = "true"
+        else:
+            base["access-control-allow-origin"] = "*"
         return rule, base
 
     async def _cors_headers(self, req: _Request) -> dict[str, str]:

@@ -160,3 +160,55 @@ def test_cors_store_validation():
         finally:
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_cors_wildcard_never_grants_credentials():
+    """A rule mixing '*' with specific origins must answer a
+    non-listed origin with allow-origin '*' and NO credentials grant
+    (wildcard + credentials is the combination browsers ban)."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            ioctx = await rados.open_ioctx("rgw")
+            users = RGWUsers(ioctx)
+            alice = await users.create("alice")
+            gw = RGWLite(ioctx, users=users)
+            fe = S3Frontend(gw, users=users)
+            host, port = await fe.start()
+            cli = S3HttpClient(host, port, alice["access_key"],
+                               alice["secret_key"])
+            anon = S3HttpClient(host, port)
+            try:
+                st, _, _ = await cli.request("PUT", "/mix", b"")
+                assert st == 200
+                st, _, _ = await cli.request(
+                    "PUT", "/mix?cors",
+                    b"<CORSConfiguration><CORSRule>"
+                    b"<AllowedOrigin>*</AllowedOrigin>"
+                    b"<AllowedOrigin>https://app.example.com"
+                    b"</AllowedOrigin>"
+                    b"<AllowedMethod>GET</AllowedMethod>"
+                    b"</CORSRule></CORSConfiguration>")
+                assert st == 200
+                # unlisted origin: wildcard answer, no credentials
+                st, h, _ = await anon.request(
+                    "OPTIONS", "/mix/x", headers={
+                        "origin": "https://other.net",
+                        "access-control-request-method": "GET"})
+                assert st == 200
+                assert h["access-control-allow-origin"] == "*"
+                assert "access-control-allow-credentials" not in h
+                # the listed origin gets the credentialed echo
+                st, h, _ = await anon.request(
+                    "OPTIONS", "/mix/x", headers={
+                        "origin": "https://app.example.com",
+                        "access-control-request-method": "GET"})
+                assert h["access-control-allow-origin"] == \
+                    "https://app.example.com"
+                assert h["access-control-allow-credentials"] == "true"
+            finally:
+                await fe.stop()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
