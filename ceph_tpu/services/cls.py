@@ -449,6 +449,34 @@ def _register_builtins(reg: ClassRegistry) -> None:
     reg.register("rbd", "set_parent", rbd_set_parent)
     reg.register("rbd", "set_parent_overlap", rbd_set_parent_overlap)
     reg.register("rbd", "remove_parent", rbd_remove_parent)
+    def rgw_tag_update(ctx: ClsContext, indata: bytes) -> bytes:
+        """Atomically patch the 'tags' field of one JSON omap entry
+        (the cls_rgw obj_tags role): a read-modify-write done HERE is
+        a single OSD op, so it can never revert a concurrent PUT's
+        entry the way a client-side RMW could.  ``expect_etag``: skip
+        (not fail) when the entry's etag moved on — tags must never
+        attach to a different writer's object.  ``expect_object``:
+        refuse delete markers."""
+        args = _j(indata)
+        key = str(args["key"])
+        kv = ctx.omap_get([key])
+        if key not in kv:
+            raise ClsError(ENOENT_RC, f"no entry {key!r}")
+        entry = json.loads(kv[key])
+        if args.get("expect_object") and entry.get("delete_marker"):
+            raise ClsError(ENOENT_RC, f"{key!r} is a delete marker")
+        want = args.get("expect_etag")
+        if want is not None and entry.get("etag") != want:
+            return json.dumps({"applied": False}).encode()
+        tags = args.get("tags")
+        if tags:
+            entry["tags"] = {str(k): str(v) for k, v in tags.items()}
+        else:
+            entry.pop("tags", None)
+        ctx.omap_set({key: json.dumps(entry).encode()})
+        return json.dumps({"applied": True}).encode()
+
+    reg.register("rgw", "tag_update", rgw_tag_update)
     reg.register("rgw", "log_add", rgw_log_add)
     reg.register("rgw", "log_list", rgw_log_list)
     reg.register("rgw", "log_trim", rgw_log_trim)

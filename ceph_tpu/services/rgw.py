@@ -669,6 +669,75 @@ class RGWLite:
     def _vkey(key: str, version_id: str) -> str:
         return f"{key}\x00{version_id}"
 
+    # -- object tagging (rgw_tag.cc / rgw_obj_tags) ------------------------
+    @staticmethod
+    def validate_tags(tags: dict[str, str]) -> None:
+        """One validator for every tag ingestion path (?tagging body,
+        x-amz-tagging header, library calls)."""
+        if len(tags) > 10:
+            raise RGWError("InvalidTag", "at most 10 tags")
+        for k, v in tags.items():
+            if not k or len(k) > 128 or len(str(v)) > 256:
+                raise RGWError("InvalidTag", k)
+
+    async def _tag_update(self, bucket: str, meta: dict, key: str,
+                          tags: dict[str, str] | None,
+                          expect_etag: str | None = None) -> bool:
+        """Atomic tag patch on the index entry (and the matching
+        versions-omap record, so ?versionId reads and later history
+        agree) via the rgw cls — a client-side read-modify-write
+        could silently revert a concurrent PUT's entry."""
+        self._index_writable(meta)
+        payload = {"key": key, "tags": tags or {},
+                   "expect_object": True}
+        if expect_etag is not None:
+            payload["expect_etag"] = expect_etag
+        try:
+            out = json.loads(await self.ioctx.exec(
+                self._index_oid_for(bucket, meta, key), "rgw",
+                "tag_update", json.dumps(payload).encode()))
+        except RadosError as e:
+            if e.rc == -2:
+                raise RGWError("NoSuchKey", f"{bucket}/{key}")
+            raise
+        if not out.get("applied"):
+            return False
+        # mirror onto the version record when one exists
+        kv = await self._index_get(bucket, key, meta)
+        if key in kv:
+            vid = json.loads(kv[key]).get("version_id")
+            if vid:
+                try:
+                    await self.ioctx.exec(
+                        self._versions_oid(bucket), "rgw",
+                        "tag_update", json.dumps({
+                            "key": self._vkey(key, vid),
+                            "tags": tags or {}}).encode())
+                except RadosError as e:
+                    if e.rc != -2:
+                        raise
+        return True
+
+    async def put_object_tagging(self, bucket: str, key: str,
+                                 tags: dict[str, str]) -> None:
+        """S3 PutObjectTagging on the CURRENT version's entry."""
+        meta = await self._check_bucket(
+            bucket, "WRITE", action="s3:PutObjectTagging", key=key)
+        self.validate_tags(tags)
+        await self._tag_update(bucket, meta, key, dict(tags))
+
+    async def get_object_tagging(self, bucket: str,
+                                 key: str) -> dict[str, str]:
+        entry = await self._entry(bucket, key,
+                                  action="s3:GetObjectTagging")
+        return dict(entry.get("tags") or {})
+
+    async def delete_object_tagging(self, bucket: str,
+                                    key: str) -> None:
+        meta = await self._check_bucket(
+            bucket, "WRITE", action="s3:DeleteObjectTagging", key=key)
+        await self._tag_update(bucket, meta, key, None)
+
     # -- CORS (rgw_cors.cc) ------------------------------------------------
     async def put_bucket_cors(self, bucket: str,
                               rules: list[dict]) -> None:
@@ -1343,6 +1412,15 @@ class RGWLite:
                 for r in active:
                     if not obj["key"].startswith(r.get("prefix", "")):
                         continue
+                    want = r.get("tags") or {}
+                    if want:
+                        # tag-filtered rule (S3 lifecycle Filter/Tag):
+                        # tags ride the listing, so no per-object
+                        # refetch and no race against deletions
+                        have = obj.get("tags") or {}
+                        if any(have.get(k) != v
+                               for k, v in want.items()):
+                            continue
                     limit = (float(r["expiration_seconds"])
                              if "expiration_seconds" in r
                              else float(r["expiration_days"]) * 86400)
@@ -2016,9 +2094,13 @@ class RGWLite:
                          content_type: str = "binary/octet-stream",
                          metadata: dict[str, str] | None = None,
                          if_none_match: bool = False,
-                         sse_key: bytes | None = None) -> dict:
+                         sse_key: bytes | None = None,
+                         tags: dict[str, str] | None = None) -> dict:
         """S3 PUT. ``if_none_match``: fail when the key exists ('*').
-        ``sse_key``: SSE-C customer key (32 bytes, AES-256)."""
+        ``sse_key``: SSE-C customer key (32 bytes, AES-256).
+        ``tags``: object tags (the x-amz-tagging header)."""
+        if tags:
+            self.validate_tags(tags)
         ctx = await self._prepare_put(bucket, key, len(data),
                                       if_none_match)
         etag = hashlib.md5(data).hexdigest()
@@ -2043,14 +2125,15 @@ class RGWLite:
         return await self._finish_put(ctx, size, etag, striped,
                                       content_type,
                                       dict(metadata or {}), sse,
-                                      comp=comp)
+                                      comp=comp, tags=tags)
 
     async def _finish_put(self, ctx: dict, size: int, etag: str,
                           striped: bool, content_type: str,
                           metadata: dict, sse: dict | None,
                           comp: dict | None = None,
                           multipart: list | None = None,
-                          slo: bool = False) -> dict:
+                          slo: bool = False,
+                          tags: dict | None = None) -> dict:
         """Publish the index entry once the data is down (shared by
         buffered and streaming PUTs)."""
         bucket, key = ctx["bucket"], ctx["key"]
@@ -2072,6 +2155,8 @@ class RGWLite:
             # Swift SLO: the manifest only REFERENCES independent
             # segment objects — deleting it must not delete them
             entry["slo"] = True
+        if tags:
+            entry["tags"] = {str(k): str(v) for k, v in tags.items()}
         if versioned:
             entry["version_id"] = version_id
             await self._record_version(bucket, key, entry)
@@ -2090,9 +2175,10 @@ class RGWLite:
         return out
 
     async def _entry(self, bucket: str, key: str,
-                     need: str = "READ") -> dict:
+                     need: str = "READ",
+                     action: str = "s3:GetObject") -> dict:
         meta = await self._check_bucket(bucket, need,
-                                        action="s3:GetObject", key=key)
+                                        action=action, key=key)
         kv = await self._index_get(bucket, key, meta)
         if key not in kv:
             raise RGWError("NoSuchKey", f"{bucket}/{key}")
@@ -2360,10 +2446,13 @@ class RGWLite:
             if len(contents) == max_keys:
                 truncated = True
                 break
-            contents.append({
+            item = {
                 "key": k, "size": entry["size"], "etag": entry["etag"],
                 "mtime": entry["mtime"],
-            })
+            }
+            if entry.get("tags"):
+                item["tags"] = entry["tags"]
+            contents.append(item)
         keys = [c["key"] for c in contents]
         return {
             "contents": contents,

@@ -936,6 +936,10 @@ class S3Frontend:
                 return status, xh, body
             raise _HTTPError(400, "InvalidArgument", "bad POST")
         if req.method == "PUT":
+            if "tagging" in q:
+                await gw.put_object_tagging(bucket, key,
+                                            _parse_tagging(req.body))
+                return 200, {}, b""
             if "partNumber" in q and "uploadId" in q:
                 part = await gw.upload_part(
                     bucket, key, q["uploadId"], int(q["partNumber"]),
@@ -952,8 +956,20 @@ class S3Frontend:
                 return self._xml(root)
             sse_key = _sse_key_headers(req)
             if req.stream is not None:
+                htags = _header_tags(req)
+                if htags:
+                    # reject BEFORE the body streams: a tag error must
+                    # not surface after the object was created
+                    RGWLite.validate_tags(htags)
                 out = await self._streaming_put(req, gw, bucket, key,
                                                 sse_key)
+                if htags:
+                    # the PUT itself authorized the write; attach the
+                    # tags to OUR upload only (etag-guarded: a racing
+                    # overwrite must not inherit them)
+                    meta_b = await gw._bucket_meta(bucket)
+                    await gw._tag_update(bucket, meta_b, key, htags,
+                                         expect_etag=out["etag"])
             else:
                 out = await gw.put_object(
                     bucket, key, req.body,
@@ -962,6 +978,7 @@ class S3Frontend:
                     metadata=_meta_headers(req),
                     if_none_match=req.header("if-none-match") == "*",
                     sse_key=sse_key,
+                    tags=_header_tags(req),
                 )
             hdrs = {"etag": f'"{out["etag"]}"'}
             if out.get("version_id"):
@@ -971,6 +988,9 @@ class S3Frontend:
                     = "AES256"
             return 200, hdrs, b""
         if req.method == "DELETE":
+            if "tagging" in q:
+                await gw.delete_object_tagging(bucket, key)
+                return 204, {}, b""
             if "uploadId" in q:
                 await gw.abort_multipart(bucket, key, q["uploadId"])
                 return 204, {}, b""
@@ -981,6 +1001,15 @@ class S3Frontend:
             await gw.delete_object(bucket, key)
             return 204, {}, b""
         if req.method in ("GET", "HEAD"):
+            if "tagging" in q and req.method == "GET":
+                tags = await gw.get_object_tagging(bucket, key)
+                root = ET.Element("Tagging", xmlns=XMLNS)
+                ts = ET.SubElement(root, "TagSet")
+                for k, v in sorted(tags.items()):
+                    t = ET.SubElement(ts, "Tag")
+                    ET.SubElement(t, "Key").text = k
+                    ET.SubElement(t, "Value").text = v
+                return self._xml(root)
             if "versionId" in q:
                 sse_key = _sse_key_headers(req)
                 if req.method == "HEAD":
@@ -1208,6 +1237,31 @@ def _parse_cors(body: bytes) -> list[dict]:
             rule["max_age_seconds"] = int(age)
         rules.append(rule)
     return rules
+
+
+def _parse_tagging(body: bytes) -> dict[str, str]:
+    """Tagging XML -> {key: value}."""
+    cfg = ET.fromstring(body.decode() or "<Tagging/>")
+    ts = (cfg.find(_ns("TagSet")) if cfg.find(_ns("TagSet"))
+          is not None else cfg.find("TagSet"))
+    tags: dict[str, str] = {}
+    for t in (list(ts.findall(_ns("Tag"))) or list(ts.findall("Tag"))
+              ) if ts is not None else ():
+        k = t.findtext(_ns("Key")) or t.findtext("Key") or ""
+        v = t.findtext(_ns("Value")) or t.findtext("Value") or ""
+        if k:
+            tags[k] = v
+    return tags
+
+
+def _header_tags(req: _Request) -> dict[str, str]:
+    """The x-amz-tagging header: URL-encoded key=value pairs."""
+    raw = req.header("x-amz-tagging")
+    if not raw:
+        return {}
+    return {urllib.parse.unquote_plus(k): urllib.parse.unquote_plus(v)
+            for k, _, v in (p.partition("=")
+                            for p in raw.split("&")) if k}
 
 
 def _parse_lifecycle(body: bytes) -> list[dict]:

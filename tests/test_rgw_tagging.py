@@ -1,0 +1,142 @@
+"""S3 object tagging + tag-filtered lifecycle (reference
+rgw_obj_tags / rgw_lc.cc Filter/Tag)."""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers
+from ceph_tpu.services.rgw_http import S3Frontend
+from tests.test_rgw_http import S3HttpClient
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def test_tagging_store_and_lifecycle():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            ioctx = await rados.open_ioctx("rgw")
+            gw = RGWLite(ioctx, users=RGWUsers(ioctx))
+            await gw.create_bucket("b")
+            await gw.put_object("b", "tmp/a", b"x",
+                                tags={"class": "scratch"})
+            await gw.put_object("b", "tmp/b", b"y",
+                                tags={"class": "keep"})
+            await gw.put_object("b", "tmp/c", b"z")
+            assert await gw.get_object_tagging("b", "tmp/a") == \
+                {"class": "scratch"}
+            # tag CRUD on an existing object
+            await gw.put_object_tagging("b", "tmp/c",
+                                        {"team": "ops", "env": "ci"})
+            assert (await gw.get_object_tagging("b", "tmp/c"))[
+                "team"] == "ops"
+            await gw.delete_object_tagging("b", "tmp/c")
+            assert await gw.get_object_tagging("b", "tmp/c") == {}
+            with pytest.raises(RGWError):
+                await gw.put_object_tagging("b", "missing", {"a": "b"})
+            with pytest.raises(RGWError):   # limits
+                await gw.put_object_tagging(
+                    "b", "tmp/a", {f"k{i}": "v" for i in range(11)})
+            # lifecycle expiring ONLY class=scratch
+            await gw.put_lifecycle("b", [{
+                "id": "scratch", "prefix": "tmp/",
+                "expiration_seconds": 1, "tags": {"class":
+                                                  "scratch"}}])
+            removed = await gw.lc_process(now=time.time() + 5)
+            assert removed.get("b") == ["tmp/a"]
+            assert (await gw.get_object("b", "tmp/b"))["data"] == b"y"
+            assert (await gw.get_object("b", "tmp/c"))["data"] == b"z"
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_tagging_versioned_and_markers():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            ioctx = await rados.open_ioctx("rgw")
+            gw = RGWLite(ioctx, users=RGWUsers(ioctx))
+            await gw.create_bucket("v")
+            await gw.put_bucket_versioning("v", "enabled")
+            v1 = (await gw.put_object("v", "k", b"one"))["version_id"]
+            await gw.put_object_tagging("v", "k", {"gen": "1"})
+            # the version record mirrors the tags: history keeps them
+            rec = await gw.head_object_version("v", "k", v1)
+            assert rec.get("tags") == {"gen": "1"}
+            v2 = (await gw.put_object("v", "k", b"two"))["version_id"]
+            await gw.put_object_tagging("v", "k", {"gen": "2"})
+            assert (await gw.head_object_version("v", "k", v1)
+                    ).get("tags") == {"gen": "1"}
+            assert (await gw.head_object_version("v", "k", v2)
+                    ).get("tags") == {"gen": "2"}
+            # a delete-marker current refuses tagging ops (NoSuchKey)
+            await gw.delete_object("v", "k")
+            with pytest.raises(RGWError):
+                await gw.put_object_tagging("v", "k", {"x": "y"})
+            with pytest.raises(RGWError):
+                await gw.delete_object_tagging("v", "k")
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_tagging_rest_surface():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            ioctx = await rados.open_ioctx("rgw")
+            users = RGWUsers(ioctx)
+            alice = await users.create("alice")
+            gw = RGWLite(ioctx, users=users)
+            fe = S3Frontend(gw, users=users)
+            host, port = await fe.start()
+            cli = S3HttpClient(host, port, alice["access_key"],
+                               alice["secret_key"])
+            try:
+                st, _, _ = await cli.request("PUT", "/b", b"")
+                assert st == 200
+                # tags ride the x-amz-tagging header on PUT
+                st, _, _ = await cli.request(
+                    "PUT", "/b/doc", b"body",
+                    headers={"x-amz-tagging":
+                             "env=prod&owner=web%20team"})
+                assert st == 200
+                st, _, body = await cli.request("GET",
+                                                "/b/doc?tagging")
+                assert st == 200
+                assert b"<Key>env</Key>" in body
+                assert b"<Value>prod</Value>" in body
+                assert b"web team" in body
+                # PUT ?tagging replaces the whole set
+                st, _, _ = await cli.request(
+                    "PUT", "/b/doc?tagging",
+                    b"<Tagging><TagSet><Tag><Key>only</Key>"
+                    b"<Value>one</Value></Tag></TagSet></Tagging>")
+                assert st == 200
+                st, _, body = await cli.request("GET",
+                                                "/b/doc?tagging")
+                assert b"only" in body and b"env" not in body
+                st, _, _ = await cli.request("DELETE",
+                                             "/b/doc?tagging")
+                assert st == 204
+                st, _, body = await cli.request("GET",
+                                                "/b/doc?tagging")
+                assert st == 200 and b"<Tag>" not in body
+            finally:
+                await fe.stop()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
