@@ -474,7 +474,9 @@ def _register_builtins(reg: ClassRegistry) -> None:
         else:
             entry.pop("tags", None)
         ctx.omap_set({key: json.dumps(entry).encode()})
-        return json.dumps({"applied": True}).encode()
+        return json.dumps({"applied": True,
+                           "version_id":
+                           entry.get("version_id")}).encode()
 
     reg.register("rgw", "tag_update", rgw_tag_update)
     reg.register("rgw", "log_add", rgw_log_add)

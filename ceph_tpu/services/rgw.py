@@ -702,41 +702,88 @@ class RGWLite:
             raise
         if not out.get("applied"):
             return False
-        # mirror onto the version record when one exists
+        # mirror onto the version record of the entry the cls ACTUALLY
+        # patched (its reply carries the version_id — re-reading the
+        # index here could see a racing writer's entry and mis-tag it)
+        vid = out.get("version_id")
+        if vid:
+            try:
+                await self.ioctx.exec(
+                    self._versions_oid(bucket), "rgw",
+                    "tag_update", json.dumps({
+                        "key": self._vkey(key, vid),
+                        "tags": tags or {}}).encode())
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+        # a bilog entry so multisite sync replicates the tag change
         kv = await self._index_get(bucket, key, meta)
         if key in kv:
-            vid = json.loads(kv[key]).get("version_id")
-            if vid:
-                try:
-                    await self.ioctx.exec(
-                        self._versions_oid(bucket), "rgw",
-                        "tag_update", json.dumps({
-                            "key": self._vkey(key, vid),
-                            "tags": tags or {}}).encode())
-                except RadosError as e:
-                    if e.rc != -2:
-                        raise
+            await self._log(bucket, "put", key,
+                            json.loads(kv[key]).get("etag", ""))
         return True
 
+    async def _tag_update_version(self, bucket: str, key: str,
+                                  version_id: str,
+                                  tags: dict | None) -> None:
+        """Tag a SPECIFIC version's record; when that version is also
+        current, the index entry follows (etag-keyed through the
+        version record's etag)."""
+        try:
+            await self.ioctx.exec(
+                self._versions_oid(bucket), "rgw", "tag_update",
+                json.dumps({"key": self._vkey(key, version_id),
+                            "tags": tags or {},
+                            "expect_object": True}).encode())
+        except RadosError as e:
+            if e.rc == -2:
+                raise RGWError("NoSuchVersion",
+                               f"{key}@{version_id}")
+            raise
+        meta = await self._bucket_meta(bucket)
+        kv = await self._index_get(bucket, key, meta)
+        if key in kv and json.loads(kv[key]).get(
+                "version_id") == version_id:
+            await self._tag_update(bucket, meta, key, tags)
+
     async def put_object_tagging(self, bucket: str, key: str,
-                                 tags: dict[str, str]) -> None:
-        """S3 PutObjectTagging on the CURRENT version's entry."""
+                                 tags: dict[str, str],
+                                 version_id: str | None = None
+                                 ) -> None:
+        """S3 PutObjectTagging (?versionId targets that version)."""
         meta = await self._check_bucket(
             bucket, "WRITE", action="s3:PutObjectTagging", key=key)
         self.validate_tags(tags)
-        await self._tag_update(bucket, meta, key, dict(tags))
+        if version_id:
+            await self._tag_update_version(bucket, key, version_id,
+                                           dict(tags))
+        else:
+            await self._tag_update(bucket, meta, key, dict(tags))
 
-    async def get_object_tagging(self, bucket: str,
-                                 key: str) -> dict[str, str]:
-        entry = await self._entry(bucket, key,
-                                  action="s3:GetObjectTagging")
+    async def get_object_tagging(self, bucket: str, key: str,
+                                 version_id: str | None = None
+                                 ) -> dict[str, str]:
+        if version_id:
+            await self._check_bucket(
+                bucket, "READ", action="s3:GetObjectTagging",
+                key=key)
+            entry = await self._lookup_version_entry(bucket, key,
+                                                     version_id)
+        else:
+            entry = await self._entry(bucket, key,
+                                      action="s3:GetObjectTagging")
         return dict(entry.get("tags") or {})
 
-    async def delete_object_tagging(self, bucket: str,
-                                    key: str) -> None:
+    async def delete_object_tagging(self, bucket: str, key: str,
+                                    version_id: str | None = None
+                                    ) -> None:
         meta = await self._check_bucket(
             bucket, "WRITE", action="s3:DeleteObjectTagging", key=key)
-        await self._tag_update(bucket, meta, key, None)
+        if version_id:
+            await self._tag_update_version(bucket, key, version_id,
+                                           None)
+        else:
+            await self._tag_update(bucket, meta, key, None)
 
     # -- CORS (rgw_cors.cc) ------------------------------------------------
     async def put_bucket_cors(self, bucket: str,
@@ -2424,6 +2471,7 @@ class RGWLite:
         return await self.put_object(
             dst_bucket, dst_key, got["data"],
             content_type=got["content_type"], metadata=got["meta"],
+            tags=got.get("tags") or None,
         )
 
     async def list_objects(self, bucket: str, prefix: str = "",

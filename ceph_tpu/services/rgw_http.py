@@ -811,6 +811,14 @@ class S3Frontend:
                 exp = ET.SubElement(r, "Expiration")
                 ET.SubElement(exp, "Days").text = \
                     str(rule.get("expiration_days", 0))
+                if rule.get("tags"):
+                    flt = ET.SubElement(r, "Filter")
+                    holder = (ET.SubElement(flt, "And")
+                              if len(rule["tags"]) > 1 else flt)
+                    for k, v in sorted(rule["tags"].items()):
+                        t = ET.SubElement(holder, "Tag")
+                        ET.SubElement(t, "Key").text = k
+                        ET.SubElement(t, "Value").text = v
             return self._xml(root)
         if "notification" in q:
             cfgs = await gw.get_bucket_notification(bucket)
@@ -937,8 +945,9 @@ class S3Frontend:
             raise _HTTPError(400, "InvalidArgument", "bad POST")
         if req.method == "PUT":
             if "tagging" in q:
-                await gw.put_object_tagging(bucket, key,
-                                            _parse_tagging(req.body))
+                await gw.put_object_tagging(
+                    bucket, key, _parse_tagging(req.body),
+                    version_id=q.get("versionId"))
                 return 200, {}, b""
             if "partNumber" in q and "uploadId" in q:
                 part = await gw.upload_part(
@@ -955,21 +964,30 @@ class S3Frontend:
                 ET.SubElement(root, "ETag").text = f'"{out["etag"]}"'
                 return self._xml(root)
             sse_key = _sse_key_headers(req)
+            htags = _header_tags(req)
+            if htags:
+                # validate AND authorize before any body lands: S3
+                # requires s3:PutObjectTagging to set tags on PUT,
+                # and a tag error must not surface post-creation
+                RGWLite.validate_tags(htags)
+                meta_b = await gw._check_bucket(
+                    bucket, "WRITE", action="s3:PutObjectTagging",
+                    key=key)
             if req.stream is not None:
-                htags = _header_tags(req)
-                if htags:
-                    # reject BEFORE the body streams: a tag error must
-                    # not surface after the object was created
-                    RGWLite.validate_tags(htags)
                 out = await self._streaming_put(req, gw, bucket, key,
                                                 sse_key)
                 if htags:
-                    # the PUT itself authorized the write; attach the
-                    # tags to OUR upload only (etag-guarded: a racing
-                    # overwrite must not inherit them)
-                    meta_b = await gw._bucket_meta(bucket)
-                    await gw._tag_update(bucket, meta_b, key, htags,
-                                         expect_etag=out["etag"])
+                    # attach to OUR upload only (etag-guarded: a
+                    # racing overwrite must not inherit them); a
+                    # racing delete means there is nothing to tag —
+                    # the PUT itself still succeeded
+                    try:
+                        await gw._tag_update(bucket, meta_b, key,
+                                             htags,
+                                             expect_etag=out["etag"])
+                    except RGWError as e:
+                        if e.code != "NoSuchKey":
+                            raise
             else:
                 out = await gw.put_object(
                     bucket, key, req.body,
@@ -978,7 +996,7 @@ class S3Frontend:
                     metadata=_meta_headers(req),
                     if_none_match=req.header("if-none-match") == "*",
                     sse_key=sse_key,
-                    tags=_header_tags(req),
+                    tags=htags,
                 )
             hdrs = {"etag": f'"{out["etag"]}"'}
             if out.get("version_id"):
@@ -989,7 +1007,8 @@ class S3Frontend:
             return 200, hdrs, b""
         if req.method == "DELETE":
             if "tagging" in q:
-                await gw.delete_object_tagging(bucket, key)
+                await gw.delete_object_tagging(
+                    bucket, key, version_id=q.get("versionId"))
                 return 204, {}, b""
             if "uploadId" in q:
                 await gw.abort_multipart(bucket, key, q["uploadId"])
@@ -1002,7 +1021,8 @@ class S3Frontend:
             return 204, {}, b""
         if req.method in ("GET", "HEAD"):
             if "tagging" in q and req.method == "GET":
-                tags = await gw.get_object_tagging(bucket, key)
+                tags = await gw.get_object_tagging(
+                    bucket, key, version_id=q.get("versionId"))
                 root = ET.Element("Tagging", xmlns=XMLNS)
                 ts = ET.SubElement(root, "TagSet")
                 for k, v in sorted(tags.items()):
@@ -1272,10 +1292,26 @@ def _parse_lifecycle(body: bytes) -> list[dict]:
             continue
         days = el.findtext(f"{_ns('Expiration')}/{_ns('Days')}") or \
             el.findtext("Expiration/Days") or "0"
-        rules.append({
+        rule = {
             "id": el.findtext(_ns("ID")) or el.findtext("ID") or "",
             "prefix": (el.findtext(_ns("Prefix"))
-                       or el.findtext("Prefix") or ""),
+                       or el.findtext("Prefix")
+                       or el.findtext(f"{_ns('Filter')}/{_ns('Prefix')}")
+                       or el.findtext("Filter/Prefix") or ""),
             "status": "Enabled", "expiration_days": int(days),
-        })
+        }
+        # <Filter><Tag> / <Filter><And><Tag>...: dropping a tag
+        # filter silently would expire objects it was protecting
+        tags = {}
+        for tag_el in el.iter():
+            if tag_el.tag.endswith("Tag"):
+                k = (tag_el.findtext(_ns("Key"))
+                     or tag_el.findtext("Key") or "")
+                v = (tag_el.findtext(_ns("Value"))
+                     or tag_el.findtext("Value") or "")
+                if k:
+                    tags[k] = v
+        if tags:
+            rule["tags"] = tags
+        rules.append(rule)
     return rules

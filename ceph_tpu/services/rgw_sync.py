@@ -83,6 +83,7 @@ class RGWSyncAgent:
             bucket, key, got["data"],
             content_type=got.get("content_type", "binary/octet-stream"),
             metadata=got.get("meta", {}),
+            tags=got.get("tags") or None,
         )
 
     async def _replicate_del(self, bucket: str, key: str) -> None:
@@ -107,6 +108,7 @@ class RGWSyncAgent:
             bucket, key, got["data"],
             content_type=got.get("content_type", "binary/octet-stream"),
             metadata=got.get("meta", {}),
+            tags=got.get("tags") or None,
         )
 
     # -- phases ------------------------------------------------------------

@@ -186,3 +186,96 @@ def test_round3_features_together_under_failures(tmp_path):
             await cluster.stop()
 
     asyncio.run(run())
+
+
+def test_session_features_together_under_failures(tmp_path):
+    """Late-round-3 integration: balancer-driven subtree moves,
+    cross-rank directory renames and hard links, write caps with
+    recall, and directory quotas all running on a FileStore-backed
+    two-rank cluster while an OSD is killed and revived."""
+    from ceph_tpu.client.fs import CephFS, FSError
+    from ceph_tpu.mds.daemon import EDQUOT
+
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3,
+                             store_dir=str(tmp_path),
+                             store_kind="file")
+        await cluster.start()
+        try:
+            admin = await cluster.client()
+            await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                                    min_size=2)
+            await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                                    min_size=2)
+            mds_a = await cluster.start_mds(name="a", block_size=4096)
+            mds_b = await cluster.start_mds(name="b", block_size=4096)
+            r = await admin.mon_command("fs set_max_mds",
+                                        fs_name="cephfs", max_mds=2)
+            assert r["rc"] == 0, r
+            deadline = asyncio.get_running_loop().time() + 15
+            while mds_b.rank != 1:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            ra = await cluster.client("client.w")
+            fa = CephFS(ra, str(mds_a.msgr.my_addr))
+            await fa.mount()
+            rb = await cluster.client("client.r")
+            fb = CephFS(rb, str(mds_b.msgr.my_addr))
+            await fb.mount()
+
+            # build load on rank 0, let the balancer move the hot dir
+            await fa.mkdir("/hot")
+            for i in range(60):
+                await fa.write_file(f"/hot/f{i}", b"")
+            for i in range(25):
+                await fa.write_file(f"/r{i}", b"")
+            hot_ino = int((await fa.stat("/hot"))["ino"])
+            res = await mds_a.balance_once()
+            assert res is not None and res["ino"] == hot_ino
+
+            # quota on a rank-0 dir; kill an OSD mid-workload
+            await fa.mkdir("/capped")
+            await fa.setquota("/capped", max_files=3)
+            await cluster.kill_osd(1)
+            await fa.write_file("/capped/a", b"1")
+            await fa.write_file("/capped/b", b"2")
+            with pytest.raises(FSError) as ei:
+                await fa.write_file("/capped/c", b"3")
+                await fa.write_file("/capped/d", b"4")
+            assert ei.value.rc == EDQUOT
+
+            # caps: writer buffers under the failure, reader recall
+            # flushes (different session => MDS recall round trip)
+            wh = await fa.open("/capped/a", "w")
+            await wh.write(b"buffered-under-failure")
+            rh = await fb.open("/capped/a", "r")
+            assert await rh.read() == b"buffered-under-failure"
+            await wh.close()
+
+            # cross-rank dir rename INTO the balanced subtree, and a
+            # cross-rank hard link out of it, all with osd.1 down
+            await fa.mkdirs("/proj/src")
+            await fa.write_file("/proj/src/m.py", b"code")
+            await fa.rename("/proj", "/hot/proj")
+            assert await fa.read_file("/hot/proj/src/m.py") == b"code"
+            await fa.write_file("/hot/lib", b"elf")
+            await fa.link("/hot/lib", "/alias")
+            assert await fa.read_file("/alias") == b"elf"
+
+            await cluster.revive_osd(1)
+            # everything still consistent after recovery
+            fa._dcache.clear()
+            assert await fa.read_file("/hot/proj/src/m.py") == b"code"
+            await fa.unlink("/alias")
+            assert await fa.read_file("/hot/lib") == b"elf"
+            assert (await fa.getquota("/capped"))["quota"][
+                "max_files"] == 3
+            await admin.shutdown()
+            await fa.unmount()
+            await fb.unmount()
+            await ra.shutdown()
+            await rb.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())

@@ -135,6 +135,29 @@ def test_tagging_rest_surface():
                 st, _, body = await cli.request("GET",
                                                 "/b/doc?tagging")
                 assert st == 200 and b"<Tag>" not in body
+                # lifecycle Filter/Tag round-trips over REST — a
+                # dropped filter would expire protected objects
+                st, _, _ = await cli.request(
+                    "PUT", "/b?lifecycle",
+                    b"<LifecycleConfiguration><Rule>"
+                    b"<ID>temps</ID><Filter><Tag>"
+                    b"<Key>class</Key><Value>tmp</Value>"
+                    b"</Tag></Filter><Status>Enabled</Status>"
+                    b"<Expiration><Days>1</Days></Expiration>"
+                    b"</Rule></LifecycleConfiguration>")
+                assert st == 200
+                st, _, body = await cli.request("GET",
+                                                "/b?lifecycle")
+                assert st == 200 and b"<Key>class</Key>" in body
+                rules = await gw.as_user("alice").get_lifecycle("b")
+                assert rules[0]["tags"] == {"class": "tmp"}
+                # copy preserves tags
+                agw = gw.as_user("alice")
+                await agw.put_object("b", "src", b"x",
+                                     tags={"keep": "yes"})
+                await agw.copy_object("b", "src", "b", "dst")
+                assert await agw.get_object_tagging("b", "dst") == \
+                    {"keep": "yes"}
             finally:
                 await fe.stop()
         finally:
