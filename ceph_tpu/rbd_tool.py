@@ -140,6 +140,53 @@ async def _dispatch(args, rbd: RBD):
         else:
             await rbd.migrate(args.src, dst, dest=dest)
         return None
+    if cmd == "image-meta":
+        img = await rbd.open(args.image)
+        if args.meta_cmd == "set":
+            await img.meta_set(args.key, args.value)
+            return None
+        if args.meta_cmd == "get":
+            return await img.meta_get(args.key)
+        if args.meta_cmd == "ls":
+            return await img.meta_list()
+        if args.meta_cmd == "rm":
+            await img.meta_remove(args.key)
+            return None
+    if cmd == "bench":
+        img = await rbd.open(args.image)
+        import secrets as _secrets
+        import time as _time
+
+        if args.io_size <= 0 or args.io_size > img.size:
+            raise RBDError("--io-size must be in [1, image size]")
+        payload = b"\xa5" * args.io_size
+        rng = _secrets.SystemRandom()
+        nops = args.io_total // args.io_size
+        lat = []
+        t0 = _time.perf_counter()
+        for _ in range(nops):
+            off = rng.randrange(
+                max(1, img.size - args.io_size)
+            ) // 512 * 512
+            t1 = _time.perf_counter()
+            if args.io_type == "write":
+                await img.write(off, payload)
+            else:
+                await img.read(off, args.io_size)
+            lat.append(_time.perf_counter() - t1)
+        elapsed = _time.perf_counter() - t0
+        await img.close()
+        lat.sort()
+        return {
+            "ops": nops, "seconds": round(elapsed, 3),
+            "iops": round(nops / elapsed, 1),
+            "MiB_per_s": round(nops * args.io_size / elapsed
+                               / (1 << 20), 2),
+            "lat_p50_ms": round(lat[len(lat) // 2] * 1e3, 3)
+            if lat else 0.0,
+            "lat_p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3)
+            if lat else 0.0,
+        }
     if cmd == "trash":
         if args.trash_cmd == "mv":
             return {"id": await rbd.trash_move(args.image,
@@ -202,6 +249,21 @@ def build_parser() -> argparse.ArgumentParser:
         x = sub.add_parser(name)
         x.add_argument("src")
         x.add_argument("dst")
+    im = sub.add_parser("image-meta")
+    im_sub = im.add_subparsers(dest="meta_cmd", required=True)
+    for name in ("set", "get", "rm", "ls"):
+        x = im_sub.add_parser(name)
+        x.add_argument("image")
+        if name != "ls":
+            x.add_argument("key")
+        if name == "set":
+            x.add_argument("value")
+    bn = sub.add_parser("bench")
+    bn.add_argument("image")
+    bn.add_argument("--io-type", choices=["write", "read"],
+                    default="write")
+    bn.add_argument("--io-size", type=int, default=4096)
+    bn.add_argument("--io-total", type=int, default=4 << 20)
     tr = sub.add_parser("trash")
     tr_sub = tr.add_subparsers(dest="trash_cmd", required=True)
     trm = tr_sub.add_parser("mv")

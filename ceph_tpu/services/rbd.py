@@ -762,6 +762,39 @@ class Image:
             await self._journal.commit(self._j_last)
             self._j_uncommitted = 0
 
+    # -- image metadata (librbd metadata_set/get/list, cls_rbd) ------------
+    _META_PREFIX = "meta."
+
+    async def meta_set(self, key: str, value: str) -> None:
+        """rbd image-meta set: free-form key/value on the header
+        (the conf_* override namespace included)."""
+        if not key:
+            raise RBDError("empty metadata key")
+        await self.ioctx.set_omap(
+            self.header_oid,
+            {self._META_PREFIX + key: str(value).encode()})
+
+    async def meta_get(self, key: str) -> str:
+        kv = await self.ioctx.get_omap(self.header_oid,
+                                       [self._META_PREFIX + key])
+        if self._META_PREFIX + key not in kv:
+            raise RBDError(f"no metadata key {key!r}")
+        return kv[self._META_PREFIX + key].decode()
+
+    async def meta_list(self) -> dict[str, str]:
+        omap = await self.ioctx.get_omap(self.header_oid)
+        return {k[len(self._META_PREFIX):]: v.decode()
+                for k, v in sorted(omap.items())
+                if k.startswith(self._META_PREFIX)}
+
+    async def meta_remove(self, key: str) -> None:
+        kv = await self.ioctx.get_omap(self.header_oid,
+                                       [self._META_PREFIX + key])
+        if self._META_PREFIX + key not in kv:
+            raise RBDError(f"no metadata key {key!r}")
+        await self.ioctx.rm_omap_keys(self.header_oid,
+                                      [self._META_PREFIX + key])
+
     # -- exclusive lock (ExclusiveLock.cc over cls_lock) -------------------
     RBD_LOCK_NAME = "rbd_lock"
 

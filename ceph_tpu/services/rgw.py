@@ -717,18 +717,23 @@ class RGWLite:
                 if e.rc != -2:
                     raise
         # a bilog entry so multisite sync replicates the tag change
+        # (a DISTINCT op: ObjectCreated subscribers must not see a
+        # creation event for a tag write; the sync tailer's reconcile
+        # branch converges unknown ops on source state, tags included)
         kv = await self._index_get(bucket, key, meta)
         if key in kv:
-            await self._log(bucket, "put", key,
+            await self._log(bucket, "put-tagging", key,
                             json.loads(kv[key]).get("etag", ""))
         return True
 
-    async def _tag_update_version(self, bucket: str, key: str,
-                                  version_id: str,
+    async def _tag_update_version(self, bucket: str, meta: dict,
+                                  key: str, version_id: str,
                                   tags: dict | None) -> None:
         """Tag a SPECIFIC version's record; when that version is also
-        current, the index entry follows (etag-keyed through the
-        version record's etag)."""
+        current, the index entry follows, etag-guarded so a racing
+        overwrite's entry never inherits the old version's tags."""
+        self._index_writable(meta)     # BEFORE any write: a 503 must
+        # not leave version and index records disagreeing
         try:
             await self.ioctx.exec(
                 self._versions_oid(bucket), "rgw", "tag_update",
@@ -740,11 +745,12 @@ class RGWLite:
                 raise RGWError("NoSuchVersion",
                                f"{key}@{version_id}")
             raise
-        meta = await self._bucket_meta(bucket)
         kv = await self._index_get(bucket, key, meta)
-        if key in kv and json.loads(kv[key]).get(
-                "version_id") == version_id:
-            await self._tag_update(bucket, meta, key, tags)
+        if key in kv:
+            cur = json.loads(kv[key])
+            if cur.get("version_id") == version_id:
+                await self._tag_update(bucket, meta, key, tags,
+                                       expect_etag=cur.get("etag"))
 
     async def put_object_tagging(self, bucket: str, key: str,
                                  tags: dict[str, str],
@@ -755,8 +761,8 @@ class RGWLite:
             bucket, "WRITE", action="s3:PutObjectTagging", key=key)
         self.validate_tags(tags)
         if version_id:
-            await self._tag_update_version(bucket, key, version_id,
-                                           dict(tags))
+            await self._tag_update_version(bucket, meta, key,
+                                           version_id, dict(tags))
         else:
             await self._tag_update(bucket, meta, key, dict(tags))
 
@@ -780,8 +786,8 @@ class RGWLite:
         meta = await self._check_bucket(
             bucket, "WRITE", action="s3:DeleteObjectTagging", key=key)
         if version_id:
-            await self._tag_update_version(bucket, key, version_id,
-                                           None)
+            await self._tag_update_version(bucket, meta, key,
+                                           version_id, None)
         else:
             await self._tag_update(bucket, meta, key, None)
 
@@ -1796,6 +1802,7 @@ class RGWLite:
     # reference pubsub sync module's pull mode).
     _EVENT_OF_OP = {
         "put": "s3:ObjectCreated:Put",
+        "put-tagging": "s3:ObjectTagging:Put",
         "del": "s3:ObjectRemoved:Delete",
         # permanent removal of a specific version IS a Delete; marker
         # creation passes an explicit event at the call site

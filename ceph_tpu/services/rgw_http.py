@@ -1297,7 +1297,10 @@ def _parse_lifecycle(body: bytes) -> list[dict]:
             "prefix": (el.findtext(_ns("Prefix"))
                        or el.findtext("Prefix")
                        or el.findtext(f"{_ns('Filter')}/{_ns('Prefix')}")
-                       or el.findtext("Filter/Prefix") or ""),
+                       or el.findtext("Filter/Prefix")
+                       or el.findtext(f"{_ns('Filter')}/{_ns('And')}"
+                                      f"/{_ns('Prefix')}")
+                       or el.findtext("Filter/And/Prefix") or ""),
             "status": "Enabled", "expiration_days": int(days),
         }
         # <Filter><Tag> / <Filter><And><Tag>...: dropping a tag

@@ -70,6 +70,32 @@ def test_rbd_tool(tmp_path, capsys):
             assert info["snaps"][0]["name"] == "s1"
             # errors surface as rc 1
             assert await tool("info", "missing") == 1
+            # image metadata (librbd metadata_set/get/list)
+            assert await tool("image-meta", "set", "img2",
+                              "conf_rbd_cache", "false") == 0
+            assert await tool("image-meta", "get", "img2",
+                              "conf_rbd_cache") == 0
+            assert "false" in capsys.readouterr().out
+            assert await tool("image-meta", "set", "img2",
+                              "owner", "ops") == 0
+            assert await tool("image-meta", "ls", "img2") == 0
+            out = capsys.readouterr().out
+            assert "conf_rbd_cache" in out and "owner" in out
+            assert await tool("image-meta", "rm", "img2",
+                              "owner") == 0
+            assert await tool("image-meta", "get", "img2",
+                              "owner") == 1
+            # rbd bench: one small write pass reports throughput
+            assert await tool("create", "benchimg", "--size",
+                              "1048576") == 0
+            capsys.readouterr()
+            assert await tool("bench", "benchimg", "--io-size",
+                              "4096", "--io-total", "65536") == 0
+            rep = json.loads(capsys.readouterr().out)
+            assert rep["ops"] == 16 and rep["iops"] > 0
+            assert await tool("bench", "benchimg", "--io-type",
+                              "read", "--io-size", "4096",
+                              "--io-total", "32768") == 0
         finally:
             await cluster.stop()
 
