@@ -1254,6 +1254,38 @@ class RGWLite:
         )
         return {"etag": etag, "part_number": part_number}
 
+    async def upload_part_copy(self, bucket: str, key: str,
+                               upload_id: str, part_number: int,
+                               src_bucket: str, src_key: str,
+                               src_range: tuple[int, int]
+                               | None = None,
+                               sse_key: bytes | None = None,
+                               src_sse_key: bytes
+                               | None = None) -> dict:
+        """S3 UploadPartCopy: a part sourced from an existing object
+        (optionally a byte range) — reads ride the normal authorized
+        GET path, the part lands like any uploaded part.
+        ``sse_key``/``src_sse_key``: destination-part / copy-source
+        SSE-C customer keys."""
+        if src_range is not None:
+            a, b = src_range
+            if a < 0 or b < a:
+                raise RGWError("InvalidArgument",
+                               f"bad copy range {src_range}")
+        got = await self.get_object(src_bucket, src_key,
+                                    range_=src_range,
+                                    sse_key=src_sse_key)
+        if src_range is not None and                 len(got["data"]) != src_range[1] - src_range[0] + 1:
+            # S3 rejects ranges past the source's end instead of
+            # clamping: silent truncation would corrupt the assembly
+            raise RGWError("InvalidArgument",
+                           "copy range exceeds the source size")
+        if not got["data"]:
+            raise RGWError("InvalidRequest", "copy source is empty")
+        return await self.upload_part(bucket, key, upload_id,
+                                      part_number, got["data"],
+                                      sse_key=sse_key)
+
     async def list_parts(self, bucket: str, key: str,
                          upload_id: str) -> list[dict]:
         omap = await self._mp_meta(bucket, key, upload_id)

@@ -950,6 +950,34 @@ class S3Frontend:
                     version_id=q.get("versionId"))
                 return 200, {}, b""
             if "partNumber" in q and "uploadId" in q:
+                src = req.header("x-amz-copy-source")
+                if src:
+                    # UploadPartCopy: source object (+ optional
+                    # x-amz-copy-source-range, inclusive bounds)
+                    sb, _, sk = src.lstrip("/").partition("/")
+                    rng = None
+                    rh = req.header("x-amz-copy-source-range")
+                    if rh:
+                        if not rh.startswith("bytes="):
+                            # a malformed range must not silently
+                            # become a whole-object copy
+                            raise _HTTPError(400, "InvalidArgument",
+                                             f"bad range {rh!r}")
+                        a, _, b = rh[6:].partition("-")
+                        try:
+                            rng = (int(a), int(b))
+                        except ValueError:
+                            raise _HTTPError(400, "InvalidRange", rh)
+                    part = await gw.upload_part_copy(
+                        bucket, key, q["uploadId"],
+                        int(q["partNumber"]), sb,
+                        urllib.parse.unquote(sk), src_range=rng,
+                        sse_key=_sse_key_headers(req),
+                        src_sse_key=_copy_source_sse_key(req))
+                    root = ET.Element("CopyPartResult", xmlns=XMLNS)
+                    ET.SubElement(root, "ETag").text = \
+                        f'"{part["etag"]}"'
+                    return self._xml(root)
                 part = await gw.upload_part(
                     bucket, key, q["uploadId"], int(q["partNumber"]),
                     req.body, sse_key=_sse_key_headers(req),
@@ -1147,6 +1175,30 @@ def _meta_headers(req: _Request) -> dict[str, str]:
 
 
 _SSE_PREFIX = "x-amz-server-side-encryption-customer-"
+
+
+def _copy_source_sse_key(req: _Request) -> bytes | None:
+    """The copy-source SSE-C key (x-amz-copy-source-server-side-
+    encryption-customer-*): same validation as the destination
+    triple."""
+    import base64
+
+    pfx = "x-amz-copy-source-server-side-encryption-customer-"
+    alg = req.header(pfx + "algorithm")
+    if not alg:
+        return None
+    if alg != "AES256":
+        raise _HTTPError(400, "InvalidArgument",
+                         f"unsupported SSE-C algorithm {alg!r}")
+    try:
+        key = base64.b64decode(req.header(pfx + "key"),
+                               validate=True)
+    except Exception:
+        raise _HTTPError(400, "InvalidArgument", "bad SSE-C key")
+    if len(key) != 32:
+        raise _HTTPError(400, "InvalidArgument",
+                         "SSE-C key must be 256 bits")
+    return key
 
 
 def _sse_key_headers(req: _Request) -> bytes | None:
