@@ -1275,7 +1275,8 @@ class RGWLite:
         got = await self.get_object(src_bucket, src_key,
                                     range_=src_range,
                                     sse_key=src_sse_key)
-        if src_range is not None and                 len(got["data"]) != src_range[1] - src_range[0] + 1:
+        if src_range is not None and \
+                len(got["data"]) != src_range[1] - src_range[0] + 1:
             # S3 rejects ranges past the source's end instead of
             # clamping: silent truncation would corrupt the assembly
             raise RGWError("InvalidArgument",

@@ -1178,12 +1178,22 @@ _SSE_PREFIX = "x-amz-server-side-encryption-customer-"
 
 
 def _copy_source_sse_key(req: _Request) -> bytes | None:
-    """The copy-source SSE-C key (x-amz-copy-source-server-side-
-    encryption-customer-*): same validation as the destination
-    triple."""
+    """The copy-source SSE-C key triple (x-amz-copy-source-server-
+    side-encryption-customer-*): identical validation to the
+    destination's, by construction."""
+    return _sse_key_headers(
+        req, "x-amz-copy-source-server-side-encryption-customer-")
+
+
+def _sse_key_headers(req: _Request,
+                     prefix: str | None = None) -> bytes | None:
+    """Parse an S3 SSE-C header triple (rgw_crypt.cc
+    rgw_s3_prepare_encrypt): algorithm must be AES256, the key is
+    base64, and the md5 header (when sent) must match the key.
+    ``prefix``: the copy-source variant's header namespace."""
     import base64
 
-    pfx = "x-amz-copy-source-server-side-encryption-customer-"
+    pfx = prefix or _SSE_PREFIX
     alg = req.header(pfx + "algorithm")
     if not alg:
         return None
@@ -1198,30 +1208,7 @@ def _copy_source_sse_key(req: _Request) -> bytes | None:
     if len(key) != 32:
         raise _HTTPError(400, "InvalidArgument",
                          "SSE-C key must be 256 bits")
-    return key
-
-
-def _sse_key_headers(req: _Request) -> bytes | None:
-    """Parse the S3 SSE-C header triple (rgw_crypt.cc
-    rgw_s3_prepare_encrypt): algorithm must be AES256, the key is
-    base64, and the md5 header (when sent) must match the key."""
-    import base64
-
-    alg = req.header(_SSE_PREFIX + "algorithm")
-    if not alg:
-        return None
-    if alg != "AES256":
-        raise _HTTPError(400, "InvalidArgument",
-                         f"unsupported SSE-C algorithm {alg!r}")
-    try:
-        key = base64.b64decode(req.header(_SSE_PREFIX + "key"),
-                               validate=True)
-    except Exception:
-        raise _HTTPError(400, "InvalidArgument", "bad SSE-C key")
-    if len(key) != 32:
-        raise _HTTPError(400, "InvalidArgument",
-                         "SSE-C key must be 256 bits")
-    md5h = req.header(_SSE_PREFIX + "key-md5")
+    md5h = req.header(pfx + "key-md5")
     if md5h and base64.b64encode(
             hashlib.md5(key).digest()).decode() != md5h:
         raise _HTTPError(400, "InvalidArgument", "SSE-C key md5 mismatch")

@@ -254,3 +254,54 @@ def test_link_intent_crash_repair():
         finally:
             await _teardown(cluster, rados, fs)
     asyncio.run(run())
+
+
+def test_unlink_remote_intent_crash_repair():
+    """Crash between the primary's commit (update_primary applied on
+    the other rank) and the local finish: repair must complete the
+    name removal; an uncommitted intent must roll back cleanly."""
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            # cross-rank link: primary on rank 1, remote on rank 0
+            await fs.write_file("/shared/data", b"v")
+            await fs.link("/shared/data", "/name")
+            ino = int((await fs.stat("/shared/data"))["ino"])
+            import secrets
+            token = secrets.token_hex(8)
+            # phase 1 by hand on rank 0, then the peer RPC, then
+            # "crash" before the local finish
+            await mds_a._journal({
+                "op": "unlink_remote_intent", "parent": 1,
+                "name": "name", "ino": ino,
+                "pp": int((await fs.stat("/shared"))["ino"]),
+                "pn": "data", "token": token})
+            reply = await mds_a._peer_request(1, {
+                "op": "update_primary",
+                "parent": int((await fs.stat("/shared"))["ino"]),
+                "ino": ino, "drop_remote": [1, "name"],
+                "token": token})
+            assert reply.get("rc") == 0, reply
+            await mds_a._resync()        # simulated restart + repair
+            fs._dcache.clear()
+            # the remote name is gone; the primary survives at nlink 1
+            with pytest.raises(FSError):
+                await fs.stat("/name")
+            assert int((await fs.stat("/shared/data"))["nlink"]) == 1
+            assert await fs.read_file("/shared/data") == b"v"
+
+            # uncommitted intent (no peer RPC ever sent): rolls back
+            await fs.link("/shared/data", "/name2")
+            token2 = secrets.token_hex(8)
+            await mds_a._journal({
+                "op": "unlink_remote_intent", "parent": 1,
+                "name": "name2", "ino": ino,
+                "pp": int((await fs.stat("/shared"))["ino"]),
+                "pn": "data", "token": token2})
+            await mds_a._resync()
+            fs._dcache.clear()
+            assert await fs.read_file("/name2") == b"v"   # still there
+            assert int((await fs.stat("/shared/data"))["nlink"]) == 2
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
