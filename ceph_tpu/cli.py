@@ -248,6 +248,28 @@ async def _dispatch(args, rados: Rados) -> int:
             return await _mon(rados, "fs set_max_mds", j,
                               fs_name=args.fs_name,
                               max_mds=args.max_mds)
+        if args.action == "status":
+            def render(d):
+                lines = []
+                for fsn, info in sorted(d.items()):
+                    lines.append(f"{fsn} - max_mds {info['max_mds']}")
+                    for rk in info["ranks"]:
+                        lines.append(
+                            f"  rank {rk['rank']}  {rk['name']:<12}"
+                            f" {rk['state']:<12}"
+                            f" load {rk['load']:g}")
+                    if info["standbys"]:
+                        lines.append("  standbys: "
+                                     + ", ".join(info["standbys"]))
+                    if info.get("down"):
+                        lines.append("  DOWN: "
+                                     + ", ".join(info["down"]))
+                    lines.append(f"  pools: {info['meta_pool']} "
+                                 f"(meta) / {info['data_pool']} "
+                                 f"(data)")
+                return "\n".join(lines)
+
+            return await _mon(rados, "fs status", j, render=render)
         if args.action in ("subvolume", "subvolumegroup"):
             return await _fs_volumes(rados, args, j)
         if args.action == "quota":
@@ -677,6 +699,7 @@ def build_parser() -> argparse.ArgumentParser:
     fs = sub.add_parser("fs")
     fs_sub = fs.add_subparsers(dest="action", required=True)
     fs_sub.add_parser("ls")
+    fs_sub.add_parser("status")
     fn = fs_sub.add_parser("new")
     fn.add_argument("fs_name")
     fn.add_argument("metadata")

@@ -214,6 +214,28 @@ class MDSMonitor(PaxosService):
                  self.mon.osd_monitor.osdmap.pools.values()}
         return meta in names and data in names
 
+    def _fs_summary(self, fs: str) -> dict:
+        """Per-fs member aggregation shared by 'mds stat' and
+        'fs status' (one source of truth for rank/load reporting)."""
+        members = {n: i for n, i in self.mds.items()
+                   if i["fs"] == fs}
+        return {
+            "actives": sorted(
+                ({"name": n, "addr": i["addr"],
+                  "rank": int(i.get("rank", 0)),
+                  "state": i["state"],
+                  "load": round(self._loads.get(n, 0.0), 3)}
+                 for n, i in members.items()
+                 if i["state"] == STATE_ACTIVE),
+                key=lambda a: a["rank"]),
+            "standby": sorted(n for n, i in members.items()
+                              if i["state"] == STATE_STANDBY),
+            "down": sorted(n for n, i in members.items()
+                           if i["state"] == STATE_DOWN),
+            "max_mds": int(self.filesystems.get(fs, {}).get(
+                "max_mds", 1)),
+        }
+
     def preprocess_command(self, cmd: dict) -> CommandResult | None:
         name = cmd.get("prefix", "")
         if name == "fs ls":
@@ -221,34 +243,43 @@ class MDSMonitor(PaxosService):
                 {"name": fs, **info}
                 for fs, info in sorted(self.filesystems.items())
             ])
+        if name == "fs status":
+            # the `ceph fs status` operator summary: per-rank state
+            # with the beacon-carried load (mds_bal load exchange);
+            # DOWN daemons stay visible — hiding a failed rank from
+            # the diagnostic command would defeat its purpose
+            out = {}
+            for fs in self.filesystems:
+                s = self._fs_summary(fs)
+                out[fs] = {
+                    "ranks": [{"rank": a["rank"], "name": a["name"],
+                               "state": a["state"],
+                               "load": a["load"]}
+                              for a in s["actives"]],
+                    "standbys": s["standby"],
+                    "down": s["down"],
+                    "meta_pool": self.filesystems[fs].get(
+                        "meta_pool", ""),
+                    "data_pool": self.filesystems[fs].get(
+                        "data_pool", ""),
+                    "max_mds": s["max_mds"],
+                }
+            return CommandResult(data=out)
         if name == "mds stat":
             out = {}
             for fs in self.filesystems:
-                members = {n: i for n, i in self.mds.items()
-                           if i["fs"] == fs}
-                actives = sorted(
-                    ({"name": n, "addr": i["addr"],
-                      "rank": int(i.get("rank", 0)),
-                      "load": round(self._loads.get(n, 0.0), 3)}
-                     for n, i in members.items()
-                     if i["state"] == STATE_ACTIVE),
-                    key=lambda a: a["rank"])
-                rank0 = next((a for a in actives if a["rank"] == 0),
-                             None)
+                s = self._fs_summary(fs)
+                rank0 = next((a for a in s["actives"]
+                              if a["rank"] == 0), None)
                 out[fs] = {
                     # rank-0 kept under the legacy "active" key
                     "active": ({"name": rank0["name"],
                                 "addr": rank0["addr"]}
                                if rank0 else None),
-                    "actives": actives,
-                    "max_mds": int(self.filesystems[fs].get(
-                        "max_mds", 1)),
-                    "standby": sorted(
-                        n for n, i in members.items()
-                        if i["state"] == STATE_STANDBY),
-                    "down": sorted(
-                        n for n, i in members.items()
-                        if i["state"] == STATE_DOWN),
+                    "actives": s["actives"],
+                    "max_mds": s["max_mds"],
+                    "standby": s["standby"],
+                    "down": s["down"],
                 }
             return CommandResult(data={"epoch": self.epoch,
                                        "filesystems": out})

@@ -139,3 +139,29 @@ def test_loads_visible_in_mds_stat():
         finally:
             await _teardown(cluster, rados, fs)
     asyncio.run(run())
+
+
+def test_fs_status_verb():
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.mkdir("/d")
+            for i in range(12):
+                await fs.write_file(f"/d/f{i}", b"")
+            r = await rados.mon_command("fs status")
+            assert r["rc"] == 0, r
+            info = r["data"]["cephfs"]
+            assert [rk["rank"] for rk in info["ranks"]] == [0, 1]
+            assert info["max_mds"] == 2
+            assert info["meta_pool"] == "cephfs_meta"
+            # loads appear once a beacon carries them
+            deadline = asyncio.get_running_loop().time() + 5
+            while True:
+                r = await rados.mon_command("fs status")
+                if r["data"]["cephfs"]["ranks"][0]["load"] > 5:
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
