@@ -446,6 +446,18 @@ async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
         return await _mon(rados, "osd dump", j)
     if a == "stat":
         return await _mon(rados, "osd stat", j)
+    if a == "df":
+        def render(d):
+            lines = ["ID  STATE IN  WEIGHT   USED"]
+            for r in d["nodes"]:
+                lines.append(
+                    f"{r['id']:<3} {'up' if r['up'] else 'down':<5} "
+                    f"{'in' if r['in'] else 'out':<3} "
+                    f"{r['weight']:<8g} {r['bytes_used']}")
+            lines.append(f"TOTAL used {d['total_bytes_used']}")
+            return "\n".join(lines)
+
+        return await _mon(rados, "osd df", j, render=render)
     if a in ("out", "in", "down"):
         return await _mon(rados, f"osd {a}", j, ids=args.ids)
     if a in ("set", "unset"):
@@ -813,7 +825,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     osd = sub.add_parser("osd")
     osd_sub = osd.add_subparsers(dest="action", required=True)
-    for name in ("tree", "dump", "stat"):
+    for name in ("tree", "dump", "stat", "df"):
         osd_sub.add_parser(name)
     for name in ("out", "in", "down"):
         o = osd_sub.add_parser(name)

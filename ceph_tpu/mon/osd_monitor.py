@@ -232,6 +232,22 @@ class OSDMonitor(PaxosService):
                 "num_osds": len(self.osdmap.osds),
                 "num_up_osds": up, "num_in_osds": inc,
             })
+        if name == "osd df":
+            # per-OSD utilization (reference `ceph osd df`): weights
+            # from the map, bytes from the mgr's PGMap digest
+            used = self.mon.mgr_stat.digest.get("osd_df", {})
+            rows = []
+            for osd, info in sorted(self.osdmap.osds.items()):
+                u = used.get(osd) or used.get(str(osd)) or {}
+                rows.append({
+                    "id": osd, "up": info.up,
+                    "in": info.in_cluster,
+                    "weight": round(info.weight / 0x10000, 4),
+                    "bytes_used": int(u.get("bytes_used", 0)),
+                })
+            total = sum(r["bytes_used"] for r in rows)
+            return CommandResult(data={"nodes": rows,
+                                       "total_bytes_used": total})
         if name == "osd tree":
             return CommandResult(data=self._tree())
         if name == "osd crush class ls":

@@ -219,3 +219,40 @@ def test_rados_model_under_thrashing():
         await rados.shutdown()
         await cluster.stop()
     asyncio.run(run())
+
+
+def test_osd_df_cli(tmp_path):
+    from ceph_tpu import cli
+
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="p",
+                                        pg_num=8, size=2)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("p")
+            await io.write_full("obj", b"x" * 5000)
+            await cluster.start_mgr()
+            conf = tmp_path / "c.json"
+            cluster.write_conf(str(conf))
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                r = await rados.mon_command("osd df")
+                assert r["rc"] == 0, r
+                nodes = r["data"]["nodes"]
+                assert len(nodes) == 3
+                # primaries report their PGs' bytes (one copy)
+                if r["data"]["total_bytes_used"] >= 5000:
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+            args = cli.build_parser().parse_args(
+                ["--conf", str(conf), "osd", "df"])
+            assert await cli._run(args) == 0
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
