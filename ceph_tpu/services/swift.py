@@ -211,7 +211,8 @@ class SwiftFrontend:
             return await self._account(method, gw, uid)
         container = parts[2]
         if len(parts) == 3:
-            return await self._container(method, gw, container, query)
+            return await self._container(method, gw, container, query,
+                                         hdrs)
         obj = "/".join(parts[3:])
         return await self._object(method, gw, container, obj, hdrs,
                                   body, query)
@@ -272,20 +273,48 @@ class SwiftFrontend:
             json.dumps(out).encode()
 
     async def _container(self, method: str, gw: RGWLite, name: str,
-                         query: dict | None = None):
+                         query: dict | None = None,
+                         hdrs: dict | None = None):
         query = query or {}
+        hdrs = hdrs or {}
         if method == "PUT":
+            cmeta = _container_meta_headers(hdrs)
             try:
                 await gw.create_bucket(name)
-                return 201, {}, b""
+                status = 201
             except RGWError as e:
-                if e.code == "BucketAlreadyExists":
-                    return 202, {}, b""     # Swift: idempotent accept
-                raise
+                if e.code != "BucketAlreadyExists":
+                    raise
+                status = 202            # Swift: idempotent accept
+                if cmeta[0] or cmeta[1]:
+                    # an EXISTING container's metadata is owner-gated
+                    # (the create path made us the owner already)
+                    await gw._check_bucket(name, "FULL_CONTROL")
+            if cmeta[0] or cmeta[1]:
+                await self._apply_container_meta(gw, name, cmeta)
+            return status, {}, b""
+        if method == "POST":
+            # Swift container metadata update: x-container-meta-* sets,
+            # x-remove-container-meta-* deletes (rgw_rest_swift's
+            # REST_Swift container POST)
+            await gw._check_bucket(name, "FULL_CONTROL")
+            await self._apply_container_meta(
+                gw, name, _container_meta_headers(hdrs))
+            return 204, {}, b""
         if method == "DELETE":
             await gw.delete_bucket(name)
             return 204, {}, b""
         if method in ("GET", "HEAD"):
+            # container headers reflect the WHOLE container (Swift
+            # semantics), independent of the listing page below
+            bmeta = await gw._check_bucket(name, "READ")
+            nbytes, nobj = await gw._bucket_usage(name, bmeta)
+            rh = {"content-type": "application/json",
+                  "x-container-object-count": str(nobj),
+                  "x-container-bytes-used": str(nbytes)}
+            for k, v in sorted((bmeta.get("swift_meta")
+                                or {}).items()):
+                rh[f"x-container-meta-{k}"] = v
             # Swift listing semantics: ?limit= caps the page, ?marker=
             # resumes after a name, ?prefix= filters — clients page
             # through arbitrarily large containers
@@ -297,8 +326,7 @@ class SwiftFrontend:
             if limit == 0:
                 # terminal empty page (never "truncated": a paging
                 # client could not advance its marker and would spin)
-                return 200, {"content-type": "application/json",
-                             "x-container-object-count": "0"}, b"[]"
+                return 200, rh, b"[]"
             listing = await gw.list_objects(
                 name, prefix=query.get("prefix", ""),
                 marker=query.get("marker", ""), max_keys=limit)
@@ -307,12 +335,22 @@ class SwiftFrontend:
                 "hash": c["etag"],
                 "last_modified": _iso(c["mtime"]),
             } for c in listing["contents"]]
-            rh = {"content-type": "application/json",
-                  "x-container-object-count": str(len(out))}
             if listing.get("is_truncated"):
                 rh["x-container-truncated"] = "true"
             return 200, rh, json.dumps(out).encode()
         return 405, {}, b""
+
+    @staticmethod
+    async def _apply_container_meta(gw: RGWLite, name: str,
+                                    cmeta: tuple[dict, list]) -> None:
+        sets, removes = cmeta
+        bmeta = await gw._bucket_meta(name)
+        stored = dict(bmeta.get("swift_meta") or {})
+        stored.update(sets)
+        for k in removes:
+            stored.pop(k, None)
+        bmeta["swift_meta"] = stored
+        await gw._put_bucket_meta(name, bmeta)
 
     async def _object(self, method: str, gw: RGWLite, container: str,
                       obj: str, hdrs: dict, body: bytes,
@@ -444,6 +482,19 @@ class SwiftFrontend:
 
 
 _SERVER_META = ("slo_segments", "dlo_manifest")
+
+
+def _container_meta_headers(hdrs: dict) -> tuple[dict, list]:
+    """(sets, removes) from x-container-meta-* /
+    x-remove-container-meta-* headers."""
+    sets = {k[len("x-container-meta-"):]: v
+            for k, v in hdrs.items()
+            if k.startswith("x-container-meta-")
+            and len(k) > len("x-container-meta-")}
+    removes = [k[len("x-remove-container-meta-"):]
+               for k in hdrs
+               if k.startswith("x-remove-container-meta-")]
+    return sets, removes
 
 
 def _client_meta(hdrs: dict) -> dict:

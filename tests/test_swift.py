@@ -381,3 +381,52 @@ def test_swift_dlo_manifest():
         await fe.stop()
         await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_container_metadata():
+    """Swift container metadata: POST sets/removes
+    x-container-meta-*, GET/HEAD echo them with bytes-used."""
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = await _swift()
+        try:
+            st, rh, _ = await _req(host, port, "GET", "/auth/v1.0",
+                                   {"x-auth-user": "bob:swift",
+                                    "x-auth-key": bob["secret_key"]})
+            assert st == 200
+            auth = {"x-auth-token": rh["x-auth-token"]}
+            st, _, _ = await _req(
+                host, port, "PUT", "/v1/AUTH_bob/c1",
+                {**auth, "x-container-meta-project": "apollo"})
+            assert st == 201
+            st, _, _ = await _req(
+                host, port, "PUT", "/v1/AUTH_bob/c1/o1", auth,
+                b"12345")
+            assert st == 201
+            st, h, _ = await _req(host, port, "GET",
+                                  "/v1/AUTH_bob/c1", auth)
+            assert st == 200
+            assert h["x-container-meta-project"] == "apollo"
+            assert h["x-container-bytes-used"] == "5"
+            # POST updates + removes
+            st, _, _ = await _req(
+                host, port, "POST", "/v1/AUTH_bob/c1",
+                {**auth, "x-container-meta-tier": "gold",
+                 "x-remove-container-meta-project": "1"})
+            assert st == 204
+            st, h, _ = await _req(host, port, "HEAD",
+                                  "/v1/AUTH_bob/c1", auth)
+            assert h["x-container-meta-tier"] == "gold"
+            assert "x-container-meta-project" not in h
+            # idempotent re-PUT with headers also updates
+            st, _, _ = await _req(
+                host, port, "PUT", "/v1/AUTH_bob/c1",
+                {**auth, "x-container-meta-owner": "ops"})
+            assert st == 202
+            st, h, _ = await _req(host, port, "HEAD",
+                                  "/v1/AUTH_bob/c1", auth)
+            assert h["x-container-meta-owner"] == "ops"
+            assert h["x-container-meta-tier"] == "gold"
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
