@@ -154,6 +154,9 @@ async def _fs_volumes(rados: Rados, args, as_json: bool) -> int:
             elif args.verb == "rm":
                 await vm.rm(args.name, group, force=args.force)
                 out = None
+            elif args.verb == "resize":
+                out = await vm.resize(args.name, args.size, group,
+                                      no_shrink=args.no_shrink)
             elif args.verb == "getpath":
                 out = await vm.getpath(args.name, group)
             elif args.verb == "info":
@@ -730,6 +733,10 @@ def build_parser() -> argparse.ArgumentParser:
     svr = sv_sub.add_parser("rm")
     svr.add_argument("name")
     svr.add_argument("--force", action="store_true")
+    svz = sv_sub.add_parser("resize")
+    svz.add_argument("name")
+    svz.add_argument("size", type=int)
+    svz.add_argument("--no-shrink", action="store_true")
     sv_sub.add_parser("ls")
     for vname in ("getpath", "info"):
         x = sv_sub.add_parser(vname)
@@ -738,7 +745,7 @@ def build_parser() -> argparse.ArgumentParser:
     svs.add_argument("snap_verb", choices=["create", "rm", "ls"])
     svs.add_argument("name")
     svs.add_argument("snap", nargs="?", default="")
-    for sp_ in (svc, svr, *[sv_sub.choices[v]
+    for sp_ in (svc, svr, svz, *[sv_sub.choices[v]
                             for v in ("ls", "getpath", "info")], svs):
         sp_.add_argument("--group", default=None)
         sp_.add_argument("--fs-name", dest="fs_name",

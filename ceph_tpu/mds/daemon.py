@@ -678,6 +678,7 @@ class MDSDaemon:
             except RadosError as err:
                 if err.rc != ENOENT:
                     raise
+            await self._quota_drop(int(e["ino"]))
         elif op == "rename":
             dentry = dict(e["dentry"])
             try:
@@ -723,6 +724,7 @@ class MDSDaemon:
                 except RadosError as err:
                     if err.rc != ENOENT:
                         raise
+                await self._quota_drop(int(e["purge_dir_ino"]))
             if int(e.get("anchor_ino", 0)):
                 await self._anchor_put(int(e["anchor_ino"]),
                                        e.get("anchor"))
@@ -831,13 +833,13 @@ class MDSDaemon:
             q = {"max_bytes": int(e["max_bytes"]),
                  "max_files": int(e["max_files"])}
             if not q["max_bytes"] and not q["max_files"]:
-                try:
-                    await self.meta.operate(
-                        QUOTATABLE_OID,
-                        ObjectOperation().omap_rm([str(ino)]))
-                except RadosError as err:
-                    if err.rc != ENOENT:
-                        raise
+                # create() first: clearing against a never-created
+                # table object must be a no-op, and the clear must
+                # reach the TABLE even when this rank's cache is
+                # stale (a realm root imported from another rank)
+                await self.meta.operate(
+                    QUOTATABLE_OID, ObjectOperation().create()
+                    .omap_rm([str(ino)]))
                 self.quotas.pop(ino, None)
                 self._qusage.pop(ino, None)
             else:
@@ -1534,6 +1536,18 @@ class MDSDaemon:
         return {"load": self.my_load()}
 
     # -- directory quotas (quota_info_t + rstat accounting, -lite) ---------
+    async def _quota_drop(self, ino: int) -> None:
+        """A quota'd directory was removed (rmdir / replaced-empty-dir
+        purge): its record must die with it, or the table leaks an
+        entry the realm-split export check iterates forever."""
+        if ino not in self.quotas:
+            return
+        await self.meta.operate(
+            QUOTATABLE_OID,
+            ObjectOperation().create().omap_rm([str(ino)]))
+        self.quotas.pop(ino, None)
+        self._qusage.pop(ino, None)
+
     async def _quota_roots(self, dino: int) -> list[int]:
         """Quota realms covering directory ``dino`` (every ancestor
         with a quota record, itself included)."""
@@ -1549,6 +1563,11 @@ class MDSDaemon:
         u = self._qusage.get(qino)
         if u is not None:
             return u
+        u = await self._compute_usage(qino)
+        self._qusage[qino] = u
+        return u
+
+    async def _compute_usage(self, qino: int) -> dict:
         total = files = 0
         for dino in await self._walk_subtree(qino):
             try:
@@ -1563,9 +1582,7 @@ class MDSDaemon:
                 if de.get("type") == "file" \
                         and not de.get("remote"):
                     total += int(de.get("size", 0))
-        u = {"bytes": total, "files": files}
-        self._qusage[qino] = u
-        return u
+        return {"bytes": total, "files": files}
 
     async def _quota_check(self, dino: int, add_files: int = 0,
                            add_bytes: int = 0,
@@ -1632,8 +1649,11 @@ class MDSDaemon:
         ino = int(d["ino"])
         q = self.quotas.get(ino)
         if q is None:
+            # usage is still answered (uncached walk): resize
+            # --no-shrink and `subvolume info` need it regardless of
+            # whether a limit is currently set
             return {"quota": {"max_bytes": 0, "max_files": 0},
-                    "usage": None}
+                    "usage": await self._compute_usage(ino)}
         return {"quota": q, "usage": await self._quota_usage(ino)}
 
     # -- file write caps (Locker/Capability, the -lite slice) --------------

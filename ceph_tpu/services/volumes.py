@@ -8,9 +8,10 @@ subvolume`` verbs.  Subvolume snapshots ride the MDS snap realms
 (``.snap`` of the subvolume root).
 
 -lite divergence: no uuid indirection layer and no async purge queue —
-removal walks the tree inline (trees are operator-scale here); quota is
-recorded in the meta sidecar (advisory, as before the reference wired
-subvolume quotas into the MDS).
+removal walks the tree inline (trees are operator-scale here).  A
+subvolume's size IS enforced: it becomes a max_bytes directory quota
+on the subvolume root (the ceph.quota vxattr wiring), adjustable with
+``fs subvolume resize``.
 """
 
 from __future__ import annotations
@@ -69,7 +70,10 @@ class VolumeManager:
     async def create(self, name: str, group: str | None = None,
                      mode: int = 0o755, size: int = 0) -> str:
         """Create the subvolume directory + meta sidecar; returns the
-        data path handed to mounters (``fs subvolume getpath``)."""
+        data path handed to mounters (``fs subvolume getpath``).
+        ``size`` > 0 becomes an ENFORCED max_bytes quota on the
+        subvolume root (the reference wires subvolume size to the
+        quota vxattr the same way)."""
         path = self._subvol_path(name, group)
         try:
             await self.fs.stat(path)
@@ -83,7 +87,31 @@ class VolumeManager:
             "created": time.time(), "mode": mode, "size": size,
             "state": "complete",
         }).encode())
+        if size > 0:
+            await self.fs.setquota(path, max_bytes=size)
         return path
+
+    async def resize(self, name: str, new_size: int,
+                     group: str | None = None,
+                     no_shrink: bool = False) -> dict:
+        """fs subvolume resize: adjust the max_bytes quota (0 =
+        infinite).  ``no_shrink`` refuses a target below current
+        usage, like the reference's --no_shrink."""
+        path = await self.getpath(name, group)
+        if new_size < 0:
+            raise FSError(EINVAL, "size must be >= 0")
+        if no_shrink and new_size > 0:
+            got = await self.fs.getquota(path)
+            used = (got.get("usage") or {}).get("bytes", 0)
+            if new_size < used:
+                raise FSError(EINVAL,
+                              f"target {new_size} < used {used}")
+        await self.fs.setquota(path, max_bytes=new_size)
+        meta = json.loads(await self.fs.read_file(f"{path}/{META}"))
+        meta["size"] = new_size
+        await self.fs.write_file(f"{path}/{META}",
+                                 json.dumps(meta).encode())
+        return {"path": path, "size": new_size}
 
     async def ls(self, group: str | None = None) -> list[str]:
         try:
@@ -106,6 +134,9 @@ class VolumeManager:
         meta["path"] = path
         meta["entries"] = sum(1 for n in entries if n != META)
         meta["snapshots"] = sorted(await self.snapshot_ls(name, group))
+        q = await self.fs.getquota(path)
+        meta["quota"] = q["quota"]
+        meta["bytes_used"] = (q.get("usage") or {}).get("bytes", 0)
         return meta
 
     async def rm(self, name: str, group: str | None = None,
@@ -122,7 +153,7 @@ class VolumeManager:
                               f"{snaps}; use force")
             for s in snaps:
                 await self.fs.rmsnap(path, s)
-        await self._rmtree(path)
+        await self._rmtree(path)     # rmdir drops the quota record
 
     async def _rmtree(self, path: str) -> None:
         """Depth-first removal (the reference defers this to an async

@@ -152,3 +152,52 @@ def test_insights_report():
         finally:
             await cluster.stop()
     asyncio.run(run())
+
+
+def test_subvolume_size_is_enforced():
+    """A subvolume's size is a real max_bytes quota: writes past it
+    fail with EDQUOT, and resize adjusts the ceiling."""
+    from ceph_tpu.mds.daemon import EDQUOT
+
+    async def run():
+        cluster, admin, rados, fs = await _fs_cluster()
+        try:
+            vm = VolumeManager(fs)
+            path = await vm.create("boxed", size=8000)
+            await fs.write_file(f"{path}/a", b"x" * 6000)
+            with pytest.raises(FSError) as ei:
+                await fs.write_file(f"{path}/b", b"y" * 6000)
+            assert ei.value.rc == EDQUOT
+            info = await vm.info("boxed")
+            assert info["quota"]["max_bytes"] == 8000
+            assert info["bytes_used"] >= 6000
+            # grow: the blocked write now fits
+            await vm.resize("boxed", 20000)
+            await fs.write_file(f"{path}/b", b"y" * 6000)
+            # no_shrink refuses going below usage
+            with pytest.raises(FSError):
+                await vm.resize("boxed", 1000, no_shrink=True)
+            # plain shrink is allowed (existing data stays)
+            await vm.resize("boxed", 1000)
+            with pytest.raises(FSError):
+                await fs.write_file(f"{path}/c", b"z" * 500)
+            # resize to 0 = infinite
+            await vm.resize("boxed", 0)
+            await fs.write_file(f"{path}/c", b"z" * 500)
+            # no_shrink works even when NO quota is currently set
+            # (usage must still be computed, not assumed zero)
+            path2 = await vm.create("free")           # size 0
+            await fs.write_file(f"{path2}/big", b"b" * 5000)
+            with pytest.raises(FSError):
+                await vm.resize("free", 100, no_shrink=True)
+            # rm clears the quota record with the tree (server-side:
+            # the rmdir drops it)
+            await vm.rm("boxed")
+            await vm.rm("free")
+            assert await vm.ls() == []
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
