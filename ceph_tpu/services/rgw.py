@@ -191,6 +191,19 @@ class RGWUsers:
         await self.ioctx.set_omap(USERS_OID,
                                   {uid: json.dumps(rec).encode()})
 
+    async def set_swift_meta(self, uid: str,
+                             meta: dict[str, str],
+                             rec: dict | None = None) -> None:
+        """Swift account metadata (X-Account-Meta-*), on the user
+        record like the reference's RGWUserInfo attrs.  ``rec``: the
+        caller's already-loaded record (skips a re-read that would
+        widen the lost-update window)."""
+        if rec is None:
+            rec = await self.get(uid)
+        rec["swift_meta"] = {str(k): str(v) for k, v in meta.items()}
+        await self.ioctx.set_omap(
+            USERS_OID, {uid: json.dumps(rec).encode()})
+
     async def set_suspended(self, uid: str,
                             suspended: bool = True) -> None:
         """radosgw-admin user suspend/enable: a suspended user fails

@@ -208,7 +208,7 @@ class SwiftFrontend:
             raise RGWError("AccessDenied", "cross-account access")
         gw = self.rgw.as_user(uid)
         if len(parts) == 2:
-            return await self._account(method, gw, uid)
+            return await self._account(method, gw, uid, hdrs)
         container = parts[2]
         if len(parts) == 3:
             return await self._container(method, gw, container, query,
@@ -255,7 +255,21 @@ class SwiftFrontend:
             return 206, hdrs, body
         return 200, hdrs, body
 
-    async def _account(self, method: str, gw: RGWLite, uid: str):
+    async def _account(self, method: str, gw: RGWLite, uid: str,
+                       hdrs: dict | None = None):
+        hdrs = hdrs or {}
+        if method == "POST":
+            # Swift account metadata (x-account-meta-* sets,
+            # x-remove-account-meta-* deletes), kept on the user
+            # record like the reference's user attrs
+            rec = await self.users.get(uid)
+            stored = dict(rec.get("swift_meta") or {})
+            sets, removes = _meta_headers_for(hdrs, "account")
+            stored.update(sets)
+            for k in removes:
+                stored.pop(k, None)
+            await self.users.set_swift_meta(uid, stored, rec=rec)
+            return 204, {}, b""
         if method not in ("GET", "HEAD"):
             return 405, {}, b""
         out = []
@@ -268,9 +282,16 @@ class SwiftFrontend:
                 continue
             nbytes, nobj = await gw._bucket_usage(b)
             out.append({"name": b, "count": nobj, "bytes": nbytes})
-        return 200, {"content-type": "application/json",
-                     "x-account-container-count": str(len(out))}, \
-            json.dumps(out).encode()
+        rh = {"content-type": "application/json",
+              "x-account-container-count": str(len(out)),
+              "x-account-object-count":
+                  str(sum(c["count"] for c in out)),
+              "x-account-bytes-used":
+                  str(sum(c["bytes"] for c in out))}
+        rec = await self.users.get(uid)
+        for k, v in sorted((rec.get("swift_meta") or {}).items()):
+            rh[f"x-account-meta-{k}"] = v
+        return 200, rh, json.dumps(out).encode()
 
     async def _container(self, method: str, gw: RGWLite, name: str,
                          query: dict | None = None,
@@ -484,17 +505,20 @@ class SwiftFrontend:
 _SERVER_META = ("slo_segments", "dlo_manifest")
 
 
-def _container_meta_headers(hdrs: dict) -> tuple[dict, list]:
-    """(sets, removes) from x-container-meta-* /
-    x-remove-container-meta-* headers."""
-    sets = {k[len("x-container-meta-"):]: v
-            for k, v in hdrs.items()
-            if k.startswith("x-container-meta-")
-            and len(k) > len("x-container-meta-")}
-    removes = [k[len("x-remove-container-meta-"):]
-               for k in hdrs
-               if k.startswith("x-remove-container-meta-")]
+def _meta_headers_for(hdrs: dict, scope: str) -> tuple[dict, list]:
+    """(sets, removes) from x-<scope>-meta-* /
+    x-remove-<scope>-meta-* headers (scope: container / account)."""
+    pfx = f"x-{scope}-meta-"
+    rm_pfx = f"x-remove-{scope}-meta-"
+    sets = {k[len(pfx):]: v for k, v in hdrs.items()
+            if k.startswith(pfx) and len(k) > len(pfx)}
+    removes = [k[len(rm_pfx):] for k in hdrs
+               if k.startswith(rm_pfx)]
     return sets, removes
+
+
+def _container_meta_headers(hdrs: dict) -> tuple[dict, list]:
+    return _meta_headers_for(hdrs, "container")
 
 
 def _client_meta(hdrs: dict) -> dict:

@@ -106,11 +106,16 @@ class VolumeManager:
             if new_size < used:
                 raise FSError(EINVAL,
                               f"target {new_size} < used {used}")
-        await self.fs.setquota(path, max_bytes=new_size)
+        # clear -> sidecar write -> apply: the META rewrite lives
+        # INSIDE the realm, so writing it under either the old or the
+        # new limit could EDQUOT a legal resize
+        await self.fs.setquota(path)
         meta = json.loads(await self.fs.read_file(f"{path}/{META}"))
         meta["size"] = new_size
         await self.fs.write_file(f"{path}/{META}",
                                  json.dumps(meta).encode())
+        if new_size > 0:
+            await self.fs.setquota(path, max_bytes=new_size)
         return {"path": path, "size": new_size}
 
     async def ls(self, group: str | None = None) -> list[str]:

@@ -430,3 +430,40 @@ def test_container_metadata():
             await fe.stop()
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_account_metadata():
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = await _swift()
+        try:
+            st, rh, _ = await _req(host, port, "GET", "/auth/v1.0",
+                                   {"x-auth-user": "bob:swift",
+                                    "x-auth-key": bob["secret_key"]})
+            auth = {"x-auth-token": rh["x-auth-token"]}
+            st, _, _ = await _req(
+                host, port, "POST", "/v1/AUTH_bob",
+                {**auth, "x-account-meta-billing": "monthly"})
+            assert st == 204
+            st, _, _ = await _req(host, port, "PUT",
+                                  "/v1/AUTH_bob/c", auth)
+            assert st == 201
+            st, _, _ = await _req(host, port, "PUT",
+                                  "/v1/AUTH_bob/c/o", auth, b"12345678")
+            assert st == 201
+            st, h, _ = await _req(host, port, "GET",
+                                  "/v1/AUTH_bob", auth)
+            assert st == 200
+            assert h["x-account-meta-billing"] == "monthly"
+            assert h["x-account-bytes-used"] == "8"
+            assert h["x-account-object-count"] == "1"
+            st, _, _ = await _req(
+                host, port, "POST", "/v1/AUTH_bob",
+                {**auth, "x-remove-account-meta-billing": "1"})
+            assert st == 204
+            st, h, _ = await _req(host, port, "HEAD",
+                                  "/v1/AUTH_bob", auth)
+            assert "x-account-meta-billing" not in h
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())

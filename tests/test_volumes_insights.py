@@ -190,6 +190,11 @@ def test_subvolume_size_is_enforced():
             await fs.write_file(f"{path2}/big", b"b" * 5000)
             with pytest.raises(FSError):
                 await vm.resize("free", 100, no_shrink=True)
+            # plain shrink below usage on a previously-unlimited
+            # subvolume: the .meta rewrite grows the JSON and must
+            # not be charged against the new tighter limit
+            await vm.resize("free", 1000)
+            assert (await vm.info("free"))["size"] == 1000
             # rm clears the quota record with the tree (server-side:
             # the rmdir drops it)
             await vm.rm("boxed")
