@@ -172,6 +172,12 @@ class MDSDaemon:
         # granted at open, recalled when anyone else opens the file).
         # Volatile by design — an MDS restart drops grants, like the
         # reference before client reconnect replays them.
+        # client sessions (SessionMap role): stable sid -> info; fed
+        # by session opens, trimmed on reset, listable/evictable via
+        # the admin socket.  Monotonic ids — id(conn) values recycle
+        # after GC and a stale sid could evict the wrong client
+        self._sessions: dict[int, dict] = {}
+        self._next_sid = 0
         self._caps: dict[int, dict] = {}       # ino -> {conn, holder}
         self._cap_waiters: dict[int, list] = {}   # ino -> [futures]
         # balancer (MDBalancer.h:33 role): decaying per-directory
@@ -221,6 +227,10 @@ class MDSDaemon:
             }, "mds state")
             sock.register("config show", self.conf.show,
                           "live configuration")
+            sock.register("session ls", self.session_ls,
+                          "live client sessions + cap counts")
+            sock.register("session evict", self.session_evict,
+                          "session evict <id>: revoke caps + close")
             await sock.start(run_dir)
             self.admin_socket = sock
         else:
@@ -1056,6 +1066,15 @@ class MDSDaemon:
         pass
 
     def ms_handle_reset(self, conn: Connection) -> None:
+        for sid, s in list(self._sessions.items()):
+            if s["conn"] is conn:
+                self._sessions.pop(sid, None)
+        # a dead client's caps must not stall later recalls for the
+        # full timeout: drop its grants and wake any waiters
+        for ino, holder in list(self._caps.items()):
+            if holder["conn"] is conn:
+                self._caps.pop(ino, None)
+                self._cap_resolve(ino)
         if self._rados_dispatch is not None:
             self.rados.ms_handle_reset(conn)
 
@@ -1234,6 +1253,15 @@ class MDSDaemon:
     async def _req_session(self, d: dict) -> dict:
         """Session open: hand the client the layout it needs for direct
         data IO (the mdsmap + file-layout handshake)."""
+        conn = d.get("_conn")
+        if conn is not None and not any(
+                s["conn"] is conn for s in self._sessions.values()):
+            self._next_sid += 1
+            self._sessions[self._next_sid] = {
+                "conn": conn,
+                "client": conn.peer_name or conn.peer_addr,
+                "opened": time.time(),
+            }
         return {"root": ROOT_INO, "data_pool": self.data_pool,
                 "block_size": self.block_size,
                 "lease": self.lease_ttl}
@@ -1506,6 +1534,38 @@ class MDSDaemon:
         log.dout(1, "%s: exported dir %x to rank %d", self.entity,
                  ino, rank)
         return {"rank": rank}
+
+    # -- client sessions (SessionMap / session evict) ----------------------
+    def session_ls(self) -> list[dict]:
+        """Live client sessions with the caps each one holds."""
+        out = []
+        for sid, s in sorted(self._sessions.items()):
+            if s["conn"].is_closed:
+                continue
+            out.append({
+                "id": sid, "client": s["client"],
+                "opened": s["opened"],
+                "num_caps": sum(1 for h in self._caps.values()
+                                if h["conn"] is s["conn"]),
+            })
+        return out
+
+    async def session_evict(self, sid) -> dict:
+        """Evict one client (Server::kill_session): revoke its caps
+        (waking any pending recalls) and close its connection — the
+        laggy/misbehaving-client remedy."""
+        s = self._sessions.pop(int(sid), None)
+        if s is None:
+            return {"evicted": False}
+        conn = s["conn"]
+        for ino, holder in list(self._caps.items()):
+            if holder["conn"] is conn:
+                self._caps.pop(ino, None)
+                self._cap_resolve(ino)
+        conn.mark_down()      # hard close, no replay (kill_session)
+        log.dout(1, "%s: evicted client session %s", self.entity,
+                 s["client"])
+        return {"evicted": True, "client": s["client"]}
 
     # -- balancer (MDBalancer.h:33 + MHeartbeat load exchange) -------------
     def _decay_pops(self) -> None:

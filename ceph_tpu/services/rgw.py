@@ -192,14 +192,13 @@ class RGWUsers:
                                   {uid: json.dumps(rec).encode()})
 
     async def set_swift_meta(self, uid: str,
-                             meta: dict[str, str],
-                             rec: dict | None = None) -> None:
+                             meta: dict[str, str]) -> None:
         """Swift account metadata (X-Account-Meta-*), on the user
-        record like the reference's RGWUserInfo attrs.  ``rec``: the
-        caller's already-loaded record (skips a re-read that would
-        widen the lost-update window)."""
-        if rec is None:
-            rec = await self.get(uid)
+        record like the reference's RGWUserInfo attrs.  Re-reads the
+        record and patches ONLY swift_meta: a client-driven account
+        POST must not write a stale whole record over a concurrent
+        admin mutation (e.g. set_suspended)."""
+        rec = await self.get(uid)
         rec["swift_meta"] = {str(k): str(v) for k, v in meta.items()}
         await self.ioctx.set_omap(
             USERS_OID, {uid: json.dumps(rec).encode()})

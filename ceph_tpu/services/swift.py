@@ -268,7 +268,7 @@ class SwiftFrontend:
             stored.update(sets)
             for k in removes:
                 stored.pop(k, None)
-            await self.users.set_swift_meta(uid, stored, rec=rec)
+            await self.users.set_swift_meta(uid, stored)
             return 204, {}, b""
         if method not in ("GET", "HEAD"):
             return 405, {}, b""

@@ -108,14 +108,30 @@ class VolumeManager:
                               f"target {new_size} < used {used}")
         # clear -> sidecar write -> apply: the META rewrite lives
         # INSIDE the realm, so writing it under either the old or the
-        # new limit could EDQUOT a legal resize
+        # new limit could EDQUOT a legal resize.  A failure mid-window
+        # re-applies the OLD limit — an error must not leave the
+        # subvolume silently unlimited (a process crash in the window
+        # still can; the next resize heals it).
+        old_limit = int((await self.fs.getquota(path))["quota"]
+                        .get("max_bytes", 0))
         await self.fs.setquota(path)
-        meta = json.loads(await self.fs.read_file(f"{path}/{META}"))
-        meta["size"] = new_size
-        await self.fs.write_file(f"{path}/{META}",
-                                 json.dumps(meta).encode())
-        if new_size > 0:
-            await self.fs.setquota(path, max_bytes=new_size)
+        applied = False
+        try:
+            meta = json.loads(
+                await self.fs.read_file(f"{path}/{META}"))
+            meta["size"] = new_size
+            await self.fs.write_file(f"{path}/{META}",
+                                     json.dumps(meta).encode())
+            if new_size > 0:
+                await self.fs.setquota(path, max_bytes=new_size)
+            applied = True
+        finally:
+            if not applied and old_limit > 0:
+                try:
+                    await self.fs.setquota(path,
+                                           max_bytes=old_limit)
+                except FSError:
+                    pass
         return {"path": path, "size": new_size}
 
     async def ls(self, group: str | None = None) -> list[str]:

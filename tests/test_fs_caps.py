@@ -205,3 +205,53 @@ def test_sibling_handles_share_one_grant():
             await rb.shutdown()
             await cluster.stop()
     asyncio.run(run())
+
+
+def test_session_ls_and_evict(tmp_path):
+    """MDS client sessions (SessionMap role): session ls shows live
+    clients with cap counts; evict revokes caps (waking pending
+    recalls) and closes the connection."""
+    from ceph_tpu.common.admin_socket import admin_command
+
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+            "admin_socket_dir": str(tmp_path)})
+        await cluster.start()
+        admin = await cluster.client()
+        await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                                min_size=2)
+        await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                                min_size=2)
+        mds = await cluster.start_mds(name="a", block_size=4096)
+        try:
+            ra, fa = await _mount(cluster, "w1")
+            rb, fb = await _mount(cluster, "w2")
+            fh = await fa.open("/f", "w")
+            await fh.write(b"held")
+            sessions = mds.session_ls()
+            assert len(sessions) == 2
+            holder = next(s for s in sessions if s["num_caps"] == 1)
+            # evict the cap holder through the ADMIN SOCKET surface
+            sock = mds.admin_socket
+            out = await admin_command(sock.path, "session ls")
+            assert len(out) == 2
+            out = await admin_command(sock.path, "session evict",
+                                      sid=holder["id"])
+            assert out["evicted"] is True
+            assert len(mds.session_ls()) == 1
+            # the evicted client's cap is gone: B acquires instantly
+            # (no 3s recall timeout) and reads fresh state
+            hb = await fb.open("/f", "w")
+            assert hb._cap
+            await hb.close()
+            await fb.unmount()
+            await rb.shutdown()
+            # evicting an unknown id is a clean no-op
+            out = await admin_command(sock.path, "session evict",
+                                      sid=99999)
+            assert out["evicted"] is False
+            await ra.shutdown()
+        finally:
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
