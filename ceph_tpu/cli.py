@@ -168,6 +168,9 @@ async def _fs_volumes(rados: Rados, args, as_json: bool) -> int:
                 elif args.snap_verb == "rm":
                     await vm.snapshot_rm(args.name, args.snap, group)
                     out = None
+                elif args.snap_verb == "clone":
+                    out = {"path": await vm.snapshot_clone(
+                        args.name, args.snap, args.target, group)}
                 else:
                     out = await vm.snapshot_ls(args.name, group)
             else:
@@ -742,9 +745,11 @@ def build_parser() -> argparse.ArgumentParser:
         x = sv_sub.add_parser(vname)
         x.add_argument("name")
     svs = sv_sub.add_parser("snapshot")
-    svs.add_argument("snap_verb", choices=["create", "rm", "ls"])
+    svs.add_argument("snap_verb",
+                     choices=["create", "rm", "ls", "clone"])
     svs.add_argument("name")
     svs.add_argument("snap", nargs="?", default="")
+    svs.add_argument("target", nargs="?", default="")
     for sp_ in (svc, svr, svz, *[sv_sub.choices[v]
                             for v in ("ls", "getpath", "info")], svs):
         sp_.add_argument("--group", default=None)

@@ -40,7 +40,7 @@ class VolumeManager:
 
     @classmethod
     def _subvol_path(cls, name: str, group: str | None) -> str:
-        if "/" in name or name.startswith("."):
+        if not name or "/" in name or name.startswith("."):
             raise FSError(EINVAL, f"bad subvolume name {name!r}")
         return f"{cls._group_path(group)}/{name}"
 
@@ -100,8 +100,8 @@ class VolumeManager:
         path = await self.getpath(name, group)
         if new_size < 0:
             raise FSError(EINVAL, "size must be >= 0")
+        got = await self.fs.getquota(path)
         if no_shrink and new_size > 0:
-            got = await self.fs.getquota(path)
             used = (got.get("usage") or {}).get("bytes", 0)
             if new_size < used:
                 raise FSError(EINVAL,
@@ -112,8 +112,7 @@ class VolumeManager:
         # re-applies the OLD limit — an error must not leave the
         # subvolume silently unlimited (a process crash in the window
         # still can; the next resize heals it).
-        old_limit = int((await self.fs.getquota(path))["quota"]
-                        .get("max_bytes", 0))
+        old_limit = int(got["quota"].get("max_bytes", 0))
         await self.fs.setquota(path)
         applied = False
         try:
@@ -202,3 +201,58 @@ class VolumeManager:
                           group: str | None = None) -> None:
         path = await self.getpath(name, group)
         await self.fs.rmsnap(path, snap)
+
+    async def snapshot_clone(self, name: str, snap: str,
+                             target: str,
+                             group: str | None = None,
+                             target_group: str | None = None) -> str:
+        """Clone a subvolume snapshot into a NEW subvolume (the
+        volumes module's `subvolume snapshot clone`; synchronous here
+        — the reference runs it through an async cloner thread)."""
+        if not target:
+            raise FSError(EINVAL, "clone needs a target name")
+        src = await self.getpath(name, group)
+        if snap not in await self.fs.listsnaps(src):
+            raise FSError(ENOENT, f"no snapshot {snap!r}")
+        src_meta = json.loads(
+            await self.fs.read_file(f"{src}/{META}"))
+        dst = await self.create(target, target_group,
+                                mode=int(src_meta.get("mode",
+                                                      0o755)),
+                                size=int(src_meta.get("size", 0)))
+        # in-progress marker (the reference's clone state tracking):
+        # a half-copied target must never read as a good clone
+        await self._set_state(dst, "cloning")
+        try:
+            await self._copy_tree(f"{src}/.snap/{snap}", dst,
+                                  root=True)
+        except BaseException:
+            try:
+                await self.rm(target, target_group, force=True)
+            except FSError:
+                pass               # partial target survives as
+                                   # state='cloning', visibly broken
+            raise
+        await self._set_state(dst, "complete")
+        return dst
+
+    async def _set_state(self, path: str, state: str) -> None:
+        meta = json.loads(await self.fs.read_file(f"{path}/{META}"))
+        meta["state"] = state
+        await self.fs.write_file(f"{path}/{META}",
+                                 json.dumps(meta).encode())
+
+    async def _copy_tree(self, src: str, dst: str,
+                         root: bool = False) -> None:
+        for entry, d in sorted((await self.fs.readdir(src)).items()):
+            if root and entry == META:
+                continue   # ONLY the root sidecar is server-owned;
+                           # a nested user file named .meta must copy
+            s, t = f"{src}/{entry}", f"{dst}/{entry}"
+            if d.get("type") == "dir":
+                await self.fs.mkdir(t)
+                await self._copy_tree(s, t)
+            elif d.get("type") == "symlink":
+                await self.fs.symlink(await self.fs.readlink(s), t)
+            else:
+                await self.fs.write_file(t, await self.fs.read_file(s))

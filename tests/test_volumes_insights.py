@@ -206,3 +206,36 @@ def test_subvolume_size_is_enforced():
             await admin.shutdown()
             await cluster.stop()
     asyncio.run(run())
+
+
+def test_snapshot_clone():
+    async def run():
+        cluster, admin, rados, fs = await _fs_cluster()
+        try:
+            vm = VolumeManager(fs)
+            path = await vm.create("golden", size=1 << 20)
+            await fs.mkdir(f"{path}/cfg")
+            await fs.write_file(f"{path}/cfg/app.conf", b"v1")
+            await fs.symlink("cfg/app.conf", f"{path}/link")
+            await vm.snapshot_create("golden", "release")
+            # post-snapshot divergence must NOT appear in the clone
+            await fs.write_file(f"{path}/cfg/app.conf", b"v2")
+            dst = await vm.snapshot_clone("golden", "release",
+                                          "staging")
+            assert dst == "/volumes/_nogroup/staging"
+            assert await fs.read_file(f"{dst}/cfg/app.conf") == b"v1"
+            assert await fs.read_file(f"{dst}/link") == b"v1"
+            # the clone inherits the source's size limit
+            info = await vm.info("staging")
+            assert info["quota"]["max_bytes"] == 1 << 20
+            # and is fully independent
+            await fs.write_file(f"{dst}/cfg/app.conf", b"patched")
+            assert await fs.read_file(f"{path}/cfg/app.conf") == b"v2"
+            with pytest.raises(FSError):
+                await vm.snapshot_clone("golden", "nope", "x")
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
