@@ -1711,10 +1711,26 @@ class OSDDaemon:
                         fut, max(0.5, deadline - time.monotonic())
                     )
                     rc = int(reply.get("rc", 0))
-                    if rc != MISDIRECTED_RC:
+                    if rc == EPERM_RC:
+                        # revive-time auth race: the base primary
+                        # rotated its service secrets while our
+                        # ticket aged — refresh the secrets, re-run
+                        # the authorizer exchange, and retry within
+                        # the deadline instead of surfacing EIO
+                        self._tier_authed.discard(id(
+                            await self.msgr.connect(
+                                m.osds[primary].addr,
+                                f"osd.{primary}")))
+                        await self._refresh_service_secrets()
+                    elif rc != MISDIRECTED_RC:
                         return (rc, reply.get("results", []),
                                 int(reply.get("version", 0)))
                 except (ConnectionError, asyncio.TimeoutError):
+                    self._tier_futs.pop(tid, None)
+                except ShardReadError:
+                    # a failed re-auth exchange (stale ticket bounced)
+                    # is part of the same transient window: keep
+                    # retrying until the deadline
                     self._tier_futs.pop(tid, None)
             if time.monotonic() > deadline:
                 raise ShardReadError(
