@@ -27,6 +27,7 @@ File data layout (client side, reference file layout semantics):
 from __future__ import annotations
 
 import asyncio
+import secrets
 import struct
 import time
 
@@ -482,6 +483,13 @@ class MDSDaemon:
                     "unlink_remote_finish", "unlink_remote_abort"):
             self._open_intents.pop(str(entry.get("token", "")), None)
 
+    async def _maybe_compact(self) -> None:
+        """Roll the journal when it has grown past the apply window
+        (every mutation is applied synchronously, so anything beyond
+        open intents is dead weight)."""
+        if self.journal_len >= 256:
+            await self._compact_journal()
+
     async def _compact_journal(self) -> None:
         """Everything is applied synchronously under the mutate lock, so
         compaction persists the ino watermark and resets the log (the
@@ -640,6 +648,17 @@ class MDSDaemon:
                     raise
 
     # -- mutation application (idempotent; journal replay re-runs these) --
+    async def _rm_dentry(self, parent: int, name: str) -> None:
+        """Remove one dentry, tolerating an absent dirfrag (journal
+        replay re-applies removals idempotently)."""
+        try:
+            await self.meta.operate(
+                dirfrag_oid(parent),
+                ObjectOperation().omap_rm([name]))
+        except RadosError as err:
+            if err.rc != ENOENT:
+                raise
+
     async def _apply(self, e: dict) -> None:
         op = e["op"]
         # COW-freeze every dirfrag this op mutates BEFORE mutating it
@@ -665,24 +684,12 @@ class MDSDaemon:
                     ),
                 )
         elif op == "unlink":
-            try:
-                await self.meta.operate(
-                    dirfrag_oid(int(e["parent"])),
-                    ObjectOperation().omap_rm([str(e["name"])]),
-                )
-            except RadosError as err:
-                if err.rc != ENOENT:
-                    raise
+            await self._rm_dentry(int(e["parent"]),
+                                  str(e["name"]))
             await self._purge_file(int(e["ino"]), int(e.get("size", 0)))
         elif op == "rmdir":
-            try:
-                await self.meta.operate(
-                    dirfrag_oid(int(e["parent"])),
-                    ObjectOperation().omap_rm([str(e["name"])]),
-                )
-            except RadosError as err:
-                if err.rc != ENOENT:
-                    raise
+            await self._rm_dentry(int(e["parent"]),
+                                  str(e["name"]))
             try:
                 await self.meta.remove(dirfrag_oid(int(e["ino"])))
             except RadosError as err:
@@ -691,14 +698,8 @@ class MDSDaemon:
             await self._quota_drop(int(e["ino"]))
         elif op == "rename":
             dentry = dict(e["dentry"])
-            try:
-                await self.meta.operate(
-                    dirfrag_oid(int(e["src_parent"])),
-                    ObjectOperation().omap_rm([str(e["src_name"])]),
-                )
-            except RadosError as err:
-                if err.rc != ENOENT:
-                    raise
+            await self._rm_dentry(int(e["src_parent"]),
+                                  str(e["src_name"]))
             await self._set_dentry(int(e["dst_parent"]),
                                    str(e["dst_name"]), dentry)
             if dentry.get("type") == "dir":
@@ -781,14 +782,8 @@ class MDSDaemon:
         elif op == "rename_export_finish":
             # cross-rank rename, source half: drop the exported name
             # only — the inode lives on under the destination rank
-            try:
-                await self.meta.operate(
-                    dirfrag_oid(int(e["src_parent"])),
-                    ObjectOperation().omap_rm([str(e["src_name"])]),
-                )
-            except RadosError as err:
-                if err.rc != ENOENT:
-                    raise
+            await self._rm_dentry(int(e["src_parent"]),
+                                  str(e["src_name"]))
             # an exported DIRECTORY's descendants now resolve through
             # the destination's chain; cached auths are stale
             self._auth_cache.clear()
@@ -827,14 +822,8 @@ class MDSDaemon:
             # cross-rank remote-unlink, name half: drop the remote
             # dentry only — the primary's rank already adjusted
             # nlink/anchor under the commit claim
-            try:
-                await self.meta.operate(
-                    dirfrag_oid(int(e["parent"])),
-                    ObjectOperation().omap_rm([str(e["name"])]),
-                )
-            except RadosError as err:
-                if err.rc != ENOENT:
-                    raise
+            await self._rm_dentry(int(e["parent"]),
+                                  str(e["name"]))
         elif op == "setattr":
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dict(e["dentry"]))
@@ -915,26 +904,14 @@ class MDSDaemon:
                                    dict(e["primary_dentry"]))
             await self._anchor_put(int(e["ino"]), dict(e["anchor"]))
         elif op == "unlink_remote":
-            try:
-                await self.meta.operate(
-                    dirfrag_oid(int(e["parent"])),
-                    ObjectOperation().omap_rm([str(e["name"])]),
-                )
-            except RadosError as err:
-                if err.rc != ENOENT:
-                    raise
+            await self._rm_dentry(int(e["parent"]),
+                                  str(e["name"]))
             await self._set_dentry(int(e["pp"]), str(e["pn"]),
                                    dict(e["primary_dentry"]))
             await self._anchor_put(int(e["ino"]), e.get("anchor"))
         elif op == "promote_link":
-            try:
-                await self.meta.operate(
-                    dirfrag_oid(int(e["parent"])),
-                    ObjectOperation().omap_rm([str(e["name"])]),
-                )
-            except RadosError as err:
-                if err.rc != ENOENT:
-                    raise
+            await self._rm_dentry(int(e["parent"]),
+                                  str(e["name"]))
             await self._set_dentry(int(e["np"]), str(e["nn"]),
                                    dict(e["primary_dentry"]))
             await self._anchor_put(int(e["ino"]), e.get("anchor"))
@@ -1232,8 +1209,7 @@ class MDSDaemon:
                     # the mutation would land in a foreign dirfrag
                     await self._check_auth(d, op)
                     result = await handler(d)
-                    if self.journal_len >= 256:
-                        await self._compact_journal()
+                    await self._maybe_compact()
             reply = {"tid": tid, "rc": 0, **result}
             # every reply carries the live snapc: clients must COW
             # data writes under new snaps without a dedicated fetch
@@ -2014,8 +1990,6 @@ class MDSDaemon:
         destination parent runs the witness-lite export protocol
         (an import_link peer request gated by the atomic commit
         marker), keeping every anchor write on the primary's rank."""
-        import secrets as _secrets
-
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["parent"]), str(d["name"])
         async with self._mutate:
@@ -2059,11 +2033,10 @@ class MDSDaemon:
                 await self._journal(entry)
                 await self._apply(entry)
                 self._quota_charge(qroots, files=1)
-                if self.journal_len >= 256:
-                    await self._compact_journal()
+                await self._maybe_compact()
                 return {"dentry": {**primary, "remote": True}}
             # cross-rank: intent first, RPC without the lock
-            token = _secrets.token_hex(8)
+            token = secrets.token_hex(8)
             await self._journal({
                 "op": "link_export_intent", "pp": sp, "pn": sn,
                 "parent": dp, "name": dn, "ino": ino,
@@ -2134,8 +2107,6 @@ class MDSDaemon:
         primary lives on another rank runs the witness-lite
         update_primary protocol (nlink/anchor mutate on the primary's
         rank, name removal here), releasing the lock across the RPC."""
-        import secrets as _secrets
-
         parent, name = int(d["parent"]), str(d["name"])
         cross = None
         async with self._mutate:
@@ -2154,7 +2125,7 @@ class MDSDaemon:
                         str(rec["primary"][1])
                     prim_rank = await self._auth_rank(pp)
                     if prim_rank != self.rank:
-                        token = _secrets.token_hex(8)
+                        token = secrets.token_hex(8)
                         await self._journal({
                             "op": "unlink_remote_intent",
                             "parent": parent, "name": name,
@@ -2176,8 +2147,7 @@ class MDSDaemon:
                         await self._quota_roots(parent), files=-1,
                         nbytes=-(int(entry.get("size", 0))
                                  if entry["op"] == "unlink" else 0))
-                if self.journal_len >= 256:
-                    await self._compact_journal()
+                await self._maybe_compact()
                 return {"ino": ino}
         token, prim_rank, pp = cross
         try:
@@ -2386,8 +2356,6 @@ class MDSDaemon:
         Caller holds the mutate lock for THIS phase (validate +
         intent); it is released before the RPC and re-taken for the
         finish."""
-        import secrets as _secrets
-
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
         dentry = await self._get_dentry(sp, sn)
@@ -2417,7 +2385,7 @@ class MDSDaemon:
         elif dentry.get("remote") or int(dentry.get("nlink", 1)) > 1:
             raise MDSError(EXDEV,
                            "hardlinked rename crosses a rank boundary")
-        token = _secrets.token_hex(8)
+        token = secrets.token_hex(8)
         intent = {"op": "rename_export_intent", "src_parent": sp,
                   "src_name": sn, "dst_parent": dp, "dst_name": dn,
                   "ino": int(dentry["ino"]), "dentry": dentry,
@@ -2502,8 +2470,7 @@ class MDSDaemon:
             dst_rank = await self._auth_rank(dp)
             if dst_rank == self.rank:
                 result = await self._rename_same_rank(d)
-                if self.journal_len >= 256:
-                    await self._compact_journal()
+                await self._maybe_compact()
                 return result
             phase1 = await self._rename_cross_rank(d, dst_rank)
         try:
@@ -2658,8 +2625,7 @@ class MDSDaemon:
                 await self._journal(entry)
                 await self._apply(entry)
                 self._quota_charge(qroots, nbytes=delta)
-                if self.journal_len >= 256:
-                    await self._compact_journal()
+                await self._maybe_compact()
                 return {"dentry": dentry}
         payload = {**{k: d[k] for k in ("size", "mode", "mtime")
                       if k in d},

@@ -1684,6 +1684,7 @@ class OSDDaemon:
         self._tier_seq += 1
         reqid = f"{self.entity}.tier:{self._tier_seq}"
         deadline = time.monotonic() + timeout
+        reauths = 0
         while True:
             m = self.osdmap
             pool = m.pools.get(pool_id) if m is not None else None
@@ -1711,12 +1712,16 @@ class OSDDaemon:
                         fut, max(0.5, deadline - time.monotonic())
                     )
                     rc = int(reply.get("rc", 0))
-                    if rc == EPERM_RC:
+                    if rc == EPERM_RC and reauths < 3:
                         # revive-time auth race: the base primary
                         # rotated its service secrets while our
                         # ticket aged — refresh the secrets, re-run
-                        # the authorizer exchange, and retry within
-                        # the deadline instead of surfacing EIO
+                        # the authorizer exchange, and retry.  A
+                        # PERSISTENT denial is not transient: after a
+                        # few attempts surface the real EPERM rather
+                        # than spinning mon refreshes into a
+                        # misleading timeout
+                        reauths += 1
                         self._tier_authed.discard(id(
                             await self.msgr.connect(
                                 m.osds[primary].addr,

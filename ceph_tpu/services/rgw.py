@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import secrets
 import time
 import zlib
 
@@ -55,14 +56,12 @@ def manifest_window(sizes: list[int], start: int, end: int
 
 
 def sse_begin(key: bytes) -> dict:
-    import secrets as _secrets
-
     if len(key) != 32:
         raise RGWError("InvalidArgument", "SSE-C key must be 32 bytes")
     return {
         "alg": "AES256",
         "key_md5": hashlib.md5(key).hexdigest(),
-        "nonce": _secrets.token_bytes(16).hex(),
+        "nonce": secrets.token_bytes(16).hex(),
     }
 
 
@@ -124,8 +123,6 @@ class RGWUsers:
 
     async def create(self, uid: str, display_name: str = "",
                      max_size: int = 0, max_objects: int = 0) -> dict:
-        import secrets as _secrets
-
         try:
             kv = await self.ioctx.get_omap(USERS_OID, [uid])
         except RadosError as e:
@@ -136,8 +133,8 @@ class RGWUsers:
             raise RGWError("UserAlreadyExists", uid)
         rec = {
             "uid": uid, "display_name": display_name or uid,
-            "access_key": _secrets.token_hex(10).upper(),
-            "secret_key": _secrets.token_hex(20),
+            "access_key": secrets.token_hex(10).upper(),
+            "secret_key": secrets.token_hex(20),
             "quota": {"max_size": int(max_size),
                       "max_objects": int(max_objects)},
             "suspended": False,
@@ -218,8 +215,6 @@ class RGWUsers:
         """Mint temporary credentials for ``uid`` (GetSessionToken /
         AssumeRole): a time-bounded access/secret pair plus a session
         token the frontend requires on every signed request."""
-        import secrets as _secrets
-
         rec = await self.get(uid)
         if rec.get("suspended"):
             raise RGWError("AccessDenied", f"{uid} suspended")
@@ -227,9 +222,9 @@ class RGWUsers:
             raise RGWError("InvalidArgument", "ttl out of range")
         creds = {
             "uid": uid, "role": str(role),
-            "access_key": "STS" + _secrets.token_hex(8).upper(),
-            "secret_key": _secrets.token_hex(20),
-            "session_token": _secrets.token_hex(24),
+            "access_key": "STS" + secrets.token_hex(8).upper(),
+            "secret_key": secrets.token_hex(20),
+            "session_token": secrets.token_hex(24),
             "expiration": time.time() + int(ttl),
         }
         await self.ioctx.operate(STS_KEYS_OID, ObjectOperation()
@@ -1001,11 +996,9 @@ class RGWLite:
             await self._gc_delete(items)
 
     def _new_version_id(self) -> str:
-        import secrets as _secrets
-
         # time-ordered prefix so listing versions newest-first is a
         # reverse lexical sort
-        return f"{int(time.time() * 1e6):016x}{_secrets.token_hex(4)}"
+        return f"{int(time.time() * 1e6):016x}{secrets.token_hex(4)}"
 
     async def _record_version(self, bucket: str, key: str,
                               entry: dict) -> None:
@@ -1204,11 +1197,9 @@ class RGWLite:
                                  "binary/octet-stream",
                                  metadata: dict | None = None) -> str:
         """S3 CreateMultipartUpload -> upload id."""
-        import secrets as _secrets
-
         await self._check_bucket(bucket, "WRITE",
                                  action="s3:PutObject", key=key)
-        upload_id = _secrets.token_hex(8)
+        upload_id = secrets.token_hex(8)
         await self.ioctx.operate(
             self._mp_meta_oid(bucket, key, upload_id),
             ObjectOperation().create().omap_set({
@@ -1733,12 +1724,10 @@ class RGWLite:
         key re-created inside the grace window holds LIVE data at an
         oid a stale GC entry names (the reference avoids this with
         per-write tail tags; -lite checks liveness when reaping)."""
-        import secrets as _secrets
-
         expire = time.time() + self.gc_min_wait
         await self.ioctx.operate(
             self.GC_OID, ObjectOperation().create().omap_set({
-                f"{expire:020.6f}.{_secrets.token_hex(6)}":
+                f"{expire:020.6f}.{secrets.token_hex(6)}":
                     json.dumps({"bucket": bucket, "key": key,
                                 "items": items}).encode(),
             }))
@@ -2088,9 +2077,7 @@ class RGWLite:
         elif defer_cleanup:
             # unique data oid: an aborted stream removes only its own
             # bytes; the old object stays intact and indexed
-            import secrets as _secrets
-
-            oid = f"{oid}\x00s\x00{_secrets.token_hex(8)}"
+            oid = f"{oid}\x00s\x00{secrets.token_hex(8)}"
             if key in existing:
                 old = json.loads(existing[key])
                 if suspended:
@@ -2115,9 +2102,7 @@ class RGWLite:
             # xattr + tail stripes, and representation changes would
             # leak.  Unique per-write tail oids (the reference's tail
             # tag) make deferral safe for every shape.
-            import secrets as _secrets
-
-            oid = f"{oid}\x00g\x00{_secrets.token_hex(8)}"
+            oid = f"{oid}\x00g\x00{secrets.token_hex(8)}"
         return {"bucket": bucket, "key": key, "oid": oid,
                 "index_oid": index_oid, "versioned": versioned,
                 "suspended": suspended, "version_id": version_id,
