@@ -2513,22 +2513,46 @@ class RGWLite:
 
     async def list_objects(self, bucket: str, prefix: str = "",
                            marker: str = "",
-                           max_keys: int = 1000) -> dict:
-        """S3 ListObjects: sorted, prefix-filtered, marker-paginated."""
+                           max_keys: int = 1000,
+                           delimiter: str = "") -> dict:
+        """S3 ListObjects: sorted, prefix-filtered, marker-paginated.
+        ``delimiter`` rolls keys sharing prefix..delimiter up into
+        common_prefixes (the folder-browsing view); common prefixes
+        count toward max_keys, as S3 counts them."""
         meta = await self._check_bucket(bucket, "READ",
                                         action="s3:ListBucket")
         index = await self._index_all(bucket, meta)
-        contents = []
+        contents: list = []
+        prefixes: list[str] = []
+        seen_prefixes: set[str] = set()
         truncated = False
+        last = ""
         # lazy parse: stop after filling the page + 1 (truncation
         # probe) instead of json-decoding the whole bucket per listing
         for k in sorted(index):
             if not k.startswith(prefix) or k <= marker:
                 continue
+            if delimiter:
+                rest = k[len(prefix):]
+                pos = rest.find(delimiter)
+                if pos >= 0:
+                    cp = prefix + rest[:pos + len(delimiter)]
+                    if cp in seen_prefixes or cp == marker:
+                        continue      # rolled up / prior page
+                    # a marker STRICTLY inside the group (start-after
+                    # on a member key) must not hide the group: keys
+                    # past it still roll up, as S3 rolls them
+                    if len(contents) + len(prefixes) == max_keys:
+                        truncated = True
+                        break
+                    seen_prefixes.add(cp)
+                    prefixes.append(cp)
+                    last = cp
+                    continue
             entry = json.loads(index[k])
             if entry.get("delete_marker"):
                 continue
-            if len(contents) == max_keys:
+            if len(contents) + len(prefixes) == max_keys:
                 truncated = True
                 break
             item = {
@@ -2538,9 +2562,10 @@ class RGWLite:
             if entry.get("tags"):
                 item["tags"] = entry["tags"]
             contents.append(item)
-        keys = [c["key"] for c in contents]
+            last = k
         return {
             "contents": contents,
+            "common_prefixes": prefixes,
             "is_truncated": truncated,
-            "next_marker": keys[-1] if truncated and keys else "",
+            "next_marker": last if truncated else "",
         }

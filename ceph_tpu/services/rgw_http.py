@@ -852,17 +852,25 @@ class S3Frontend:
         v2 = q.get("list-type") == "2"
         marker = q.get("continuation-token" if v2 else "marker", "") or \
             q.get("start-after", "")
+        max_keys = int(q.get("max-keys", "1000"))
         listing = await gw.list_objects(
             bucket, prefix=q.get("prefix", ""), marker=marker,
-            max_keys=int(q.get("max-keys", "1000")),
+            max_keys=max_keys, delimiter=q.get("delimiter", ""),
         )
         root = ET.Element("ListBucketResult", xmlns=XMLNS)
         ET.SubElement(root, "Name").text = bucket
         ET.SubElement(root, "Prefix").text = q.get("prefix", "")
+        if q.get("delimiter"):
+            ET.SubElement(root, "Delimiter").text = q["delimiter"]
+        for cp in listing.get("common_prefixes", ()):
+            e = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(e, "Prefix").text = cp
         ET.SubElement(root, "IsTruncated").text = \
             "true" if listing["is_truncated"] else "false"
         ET.SubElement(root, "KeyCount" if v2 else "MaxKeys").text = \
-            str(len(listing["contents"]))
+            str(len(listing["contents"])
+                + len(listing.get("common_prefixes", ()))
+                if v2 else max_keys)
         if listing["is_truncated"]:
             tag = "NextContinuationToken" if v2 else "NextMarker"
             ET.SubElement(root, tag).text = listing["next_marker"]

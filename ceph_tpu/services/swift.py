@@ -350,12 +350,18 @@ class SwiftFrontend:
                 return 200, rh, b"[]"
             listing = await gw.list_objects(
                 name, prefix=query.get("prefix", ""),
-                marker=query.get("marker", ""), max_keys=limit)
+                marker=query.get("marker", ""), max_keys=limit,
+                delimiter=query.get("delimiter", ""))
             out = [{
                 "name": c["key"], "bytes": c["size"],
                 "hash": c["etag"],
                 "last_modified": _iso(c["mtime"]),
             } for c in listing["contents"]]
+            # Swift renders rolled-up prefixes as subdir entries
+            out += [{"subdir": cp}
+                    for cp in listing.get("common_prefixes", ())]
+            out.sort(key=lambda e: e.get("name", e.get("subdir",
+                                                       "")))
             if listing.get("is_truncated"):
                 rh["x-container-truncated"] = "true"
             return 200, rh, json.dumps(out).encode()

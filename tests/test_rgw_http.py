@@ -714,3 +714,111 @@ def test_upload_part_copy_rest():
             await fe.stop()
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_list_objects_delimiter():
+    """Delimiter listing: keys sharing prefix..delimiter roll up into
+    CommonPrefixes (counted toward max-keys, as S3 counts them), and
+    NextMarker pagination resumes past a rolled-up prefix.
+
+    Reference rgw/rgw_rados.cc cls_bucket_list + rgw_op.cc
+    RGWListBucket: common-prefix roll-up happens server-side."""
+    NS = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        try:
+            await cli.request("PUT", "/photos")
+            for k in ("2024/jan/a.jpg", "2024/jan/b.jpg",
+                      "2024/feb/c.jpg", "2025/mar/d.jpg",
+                      "index.html", "readme.txt"):
+                await cli.request("PUT", f"/photos/{k}", body=b"x")
+            st, _, body = await cli.request("GET",
+                                            "/photos?delimiter=/")
+            assert st == 200
+            doc = ET.fromstring(body)
+            cps = [e.text for e in doc.findall(
+                "s3:CommonPrefixes/s3:Prefix", NS)]
+            assert cps == ["2024/", "2025/"]
+            keys = [e.text for e in doc.findall(
+                "s3:Contents/s3:Key", NS)]
+            assert keys == ["index.html", "readme.txt"]
+            assert doc.findtext("s3:Delimiter", None, NS) == "/"
+            # prefix + delimiter: browse one level down
+            st, _, body = await cli.request(
+                "GET", "/photos?delimiter=/&prefix=2024/")
+            doc = ET.fromstring(body)
+            cps = [e.text for e in doc.findall(
+                "s3:CommonPrefixes/s3:Prefix", NS)]
+            assert cps == ["2024/feb/", "2024/jan/"]
+            assert not doc.findall("s3:Contents", NS)
+            # pagination: max-keys=1 pages prefix-by-prefix; the
+            # marker (a common prefix) must skip ALL keys under it
+            st, _, body = await cli.request(
+                "GET", "/photos?delimiter=/&max-keys=1")
+            doc = ET.fromstring(body)
+            assert doc.findtext("s3:IsTruncated", None, NS) == "true"
+            nm = doc.findtext("s3:NextMarker", None, NS)
+            assert nm == "2024/"
+            st, _, body = await cli.request(
+                "GET", f"/photos?delimiter=/&max-keys=1&marker={nm}")
+            doc = ET.fromstring(body)
+            cps = [e.text for e in doc.findall(
+                "s3:CommonPrefixes/s3:Prefix", NS)]
+            assert cps == ["2025/"]
+            # ListObjectsV2 with delimiter: same roll-up; KeyCount
+            # counts contents + prefixes
+            st, _, body = await cli.request(
+                "GET", "/photos?list-type=2&delimiter=/")
+            doc = ET.fromstring(body)
+            cps = [e.text for e in doc.findall(
+                "s3:CommonPrefixes/s3:Prefix", NS)]
+            assert cps == ["2024/", "2025/"]
+            assert doc.findtext("s3:KeyCount", None, NS) == "4"
+            # v2 continuation: token pages past rolled-up prefixes
+            st, _, body = await cli.request(
+                "GET", "/photos?list-type=2&delimiter=/&max-keys=3")
+            doc = ET.fromstring(body)
+            tok = doc.findtext("s3:NextContinuationToken", None, NS)
+            assert tok == "index.html"
+            st, _, body = await cli.request(
+                "GET", "/photos?list-type=2&delimiter=/"
+                       f"&continuation-token={tok}")
+            doc = ET.fromstring(body)
+            keys = [e.text for e in doc.findall(
+                "s3:Contents/s3:Key", NS)]
+            assert keys == ["readme.txt"]
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_delimiter_marker_inside_group():
+    """A marker/start-after STRICTLY inside a prefix group must not
+    hide the group: later member keys still roll up into its
+    CommonPrefix (S3 semantics; review regression)."""
+    NS = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        try:
+            await cli.request("PUT", "/b")
+            for k in ("2024/jan/a.jpg", "2024/jan/b.jpg", "zz"):
+                await cli.request("PUT", f"/b/{k}", body=b"x")
+            st, _, body = await cli.request(
+                "GET", "/b?list-type=2&delimiter=/"
+                       "&start-after=2024/jan/a.jpg")
+            doc = ET.fromstring(body)
+            cps = [e.text for e in doc.findall(
+                "s3:CommonPrefixes/s3:Prefix", NS)]
+            assert cps == ["2024/"]       # b.jpg rolls up, not hidden
+            keys = [e.text for e in doc.findall(
+                "s3:Contents/s3:Key", NS)]
+            assert keys == ["zz"]
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())

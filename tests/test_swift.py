@@ -467,3 +467,35 @@ def test_account_metadata():
             await fe.stop()
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_container_listing_delimiter():
+    """Swift delimiter listing: rolled-up prefixes render as subdir
+    entries interleaved in name order with objects (reference
+    rgw/rgw_rest_swift.cc RGWListBucket_ObjStore_SWIFT)."""
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = await _swift()
+        try:
+            st, h, _ = await _req(host, port, "GET", "/auth/v1.0",
+                                  {"x-auth-user": "bob:swift",
+                                   "x-auth-key": bob["secret_key"]})
+            tok = {"x-auth-token": h["x-auth-token"]}
+            url = h["x-storage-url"]
+            acct = "/" + url.split("/", 3)[3]
+            await _req(host, port, "PUT", f"{acct}/photos", tok)
+            for k in ("a/1", "a/2", "b/3", "top"):
+                await _req(host, port, "PUT", f"{acct}/photos/{k}",
+                           tok, body=b"x")
+            st, h, body = await _req(
+                host, port, "GET",
+                f"{acct}/photos?format=json&delimiter=/", tok)
+            assert st == 200
+            entries = json.loads(body)
+            assert [e.get("name", e.get("subdir")) for e in entries] \
+                == ["a/", "b/", "top"]
+            assert entries[0] == {"subdir": "a/"}
+            assert entries[2]["bytes"] == 1
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
