@@ -468,6 +468,17 @@ async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
         return await _mon(rados, f"osd {a}", j, ids=args.ids)
     if a in ("set", "unset"):
         return await _mon(rados, f"osd {a}", j, flag=args.flag)
+    if a == "blocklist":
+        if args.bl_action == "ls":
+            def render(d):
+                rows = [f"{k}  expires {v:.0f}"
+                        for k, v in sorted(d["blocklist"].items())]
+                return "\n".join(rows) or "(empty)"
+            return await _mon(rados, "osd blocklist ls", j,
+                              render=render)
+        return await _mon(rados, "osd blocklist", j,
+                          action=args.bl_action, entity=args.entity,
+                          expire=args.expire)
     if a == "getcrushmap":
         return await _mon(rados, "osd getcrushmap", j,
                           render=lambda text: text)
@@ -845,6 +856,12 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("set", "unset"):
         o = osd_sub.add_parser(name)
         o.add_argument("flag")
+    bl = osd_sub.add_parser("blocklist")
+    bl.add_argument("bl_action", choices=["add", "rm", "ls"])
+    bl.add_argument("entity", nargs="?", default="",
+                    help="client instance 'entity:nonce' or bare entity")
+    bl.add_argument("--expire", type=float, default=3600.0,
+                    help="seconds until the entry lapses (add)")
     osd_sub.add_parser("getcrushmap")
     scm = osd_sub.add_parser("setcrushmap")
     scm.add_argument("file", nargs="?", default="-",

@@ -120,6 +120,8 @@ class Rados:
         self.conf = conf or ConfigProxy()
         self.name = name
         self.msgr = Messenger(name, self.conf)
+        # "entity:nonce" — the OSDMap blocklist key for THIS instance
+        self.instance_id = f"{name}:{self.msgr.nonce}"
         self.msgr.set_policy("mon", Policy.lossy_client())
         self.msgr.set_policy("osd", Policy.lossy_client())
         self.msgr.set_dispatcher(self)

@@ -1526,22 +1526,39 @@ class MDSDaemon:
             })
         return out
 
-    async def session_evict(self, sid) -> dict:
+    async def session_evict(self, sid, blocklist=False) -> dict:
         """Evict one client (Server::kill_session): revoke its caps
         (waking any pending recalls) and close its connection — the
-        laggy/misbehaving-client remedy."""
+        laggy/misbehaving-client remedy.  ``blocklist`` additionally
+        fences the client INSTANCE at the OSDs via the OSDMap
+        blocklist (the reference evicts this way by default: caps
+        alone cannot stop direct RADOS data writes already in
+        flight)."""
         s = self._sessions.pop(int(sid), None)
         if s is None:
             return {"evicted": False}
         conn = s["conn"]
+        blocked = False
+        if blocklist and conn.peer_name:
+            # fence FIRST: releasing caps wakes recall waiters, and a
+            # new holder must never write concurrently with the
+            # evictee's still-in-flight RADOS ops
+            ent = f"{conn.peer_name}:{conn.peer_nonce}"
+            try:
+                r = await self.rados.mon_command(
+                    "osd blocklist", action="add", entity=ent)
+                blocked = r.get("rc") == 0
+            except (RadosError, ConnectionError, OSError):
+                pass          # eviction still proceeds unfenced
         for ino, holder in list(self._caps.items()):
             if holder["conn"] is conn:
                 self._caps.pop(ino, None)
                 self._cap_resolve(ino)
         conn.mark_down()      # hard close, no replay (kill_session)
-        log.dout(1, "%s: evicted client session %s", self.entity,
-                 s["client"])
-        return {"evicted": True, "client": s["client"]}
+        log.dout(1, "%s: evicted client session %s%s", self.entity,
+                 s["client"], " (blocklisted)" if blocked else "")
+        return {"evicted": True, "client": s["client"],
+                "blocklisted": blocked}
 
     # -- balancer (MDBalancer.h:33 + MHeartbeat load exchange) -------------
     def _decay_pops(self) -> None:

@@ -277,6 +277,13 @@ class OSDMonitor(PaxosService):
             return CommandResult(
                 data=[p.name for p in self.osdmap.pools.values()]
             )
+        if name == "osd blocklist ls":
+            now = time.time()
+            return CommandResult(data={
+                "blocklist": {k: v for k, v in
+                              self.osdmap.blocklist.items()
+                              if v > now},
+            })
         if name == "osd pool get":
             pool = self._pool_by_name(cmd.get("pool", ""))
             if pool is None:
@@ -322,6 +329,8 @@ class OSDMonitor(PaxosService):
                 return self._cmd_tier(name, cmd)
             if name in ("osd set", "osd unset"):
                 return self._cmd_flag(name == "osd set", cmd)
+            if name == "osd blocklist":
+                return self._cmd_blocklist(cmd)
             if name == "osd setcrushmap":
                 return self._cmd_setcrushmap(cmd)
         except (KeyError, ValueError, TypeError) as e:
@@ -757,6 +766,42 @@ class OSDMonitor(PaxosService):
 
     FLAGS = ("noout", "noin", "noup", "nodown", "pause", "norecover",
              "nobackfill", "noscrub")
+
+    def _cmd_blocklist(self, cmd: dict) -> CommandResult:
+        """osd blocklist add/rm (OSDMonitor blocklist role): fence a
+        client instance ("entity:nonce") or every instance of an
+        entity (bare name) until the expiry walltime.  Expired
+        entries are pruned with each staged change."""
+        action = str(cmd.get("action", "add"))
+        ent = str(cmd.get("entity", ""))
+        if not ent:
+            return CommandResult(EINVAL_RC, "entity required")
+        pending = self._pending()
+        now = time.time()
+        if action == "add":
+            expire = float(cmd.get("expire", 3600.0))
+            if expire <= 0:
+                return CommandResult(EINVAL_RC, "expire must be > 0")
+            pending.new_blocklist[ent] = now + expire
+        elif action == "rm":
+            if ent not in self.osdmap.blocklist \
+                    and ent not in pending.new_blocklist:
+                return CommandResult(ENOENT_RC,
+                                     f"{ent} not blocklisted")
+            pending.new_blocklist.pop(ent, None)
+            pending.old_blocklist.append(ent)
+        else:
+            return CommandResult(EINVAL_RC,
+                                 f"unknown action {action!r}")
+        for k, until in self.osdmap.blocklist.items():
+            # never prune a key being (re-)staged this epoch: apply()
+            # runs new_blocklist before old_blocklist, so the prune
+            # would delete the fresh entry in the same epoch
+            if until <= now and k not in pending.old_blocklist \
+                    and k not in pending.new_blocklist:
+                pending.old_blocklist.append(k)
+        return CommandResult(
+            outs=f"blocklist {action} {ent}")
 
     def _cmd_flag(self, setting: bool, cmd: dict) -> CommandResult:
         """`osd set/unset <flag>` (the CEPH_OSDMAP_* cluster flags)."""

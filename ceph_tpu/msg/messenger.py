@@ -203,6 +203,7 @@ class Connection:
                  policy: Policy, initiator: bool):
         self.msgr = msgr
         self.peer_name = peer_name          # may be "" until handshake
+        self.peer_nonce = 0                 # peer instance id (handshake)
         self.peer_addr = peer_addr
         self.policy = policy
         self.initiator = initiator
@@ -576,6 +577,7 @@ class Messenger:
             ours, peer = await self._handshake(stream, conn.in_seq,
                                                conn.connect_seq)
             conn.peer_name = peer["entity"]
+            conn.peer_nonce = int(peer.get("nonce", 0))
             conn._onwire = self._derive_onwire(ours, peer)
             if conn._onwire is not None:
                 # server confirms first; our confirm completes the
@@ -783,6 +785,7 @@ class Messenger:
                     self, peer_name, hint, self._policy_for(peer_name),
                     initiator=False,
                 )
+                conn.peer_nonce = akey[1]
                 conn._accept_key = akey
                 self._accepted[akey] = conn
                 fresh = True

@@ -8,6 +8,7 @@ EAGAIN_RC = -11
 EINVAL_RC = -22
 ENOTSUP_RC = -95
 ESTALE_RC = -116              # sub-op from an older PG interval, dropped
+EBLOCKLISTED_RC = -108        # client instance fenced by the OSDMap
 MISDIRECTED_RC = -1000        # resend after map refresh (reference drops)
 EPERM_RC = -1               # operation not permitted (caps)
 

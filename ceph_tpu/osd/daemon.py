@@ -53,6 +53,7 @@ from ceph_tpu.osd.codes import (
     ENOENT_RC,
     ENOTSUP_RC,
     ESTALE_RC,
+    EBLOCKLISTED_RC,
     MISDIRECTED_RC,
     OK,
     READ_CLASS_OPS,
@@ -3035,6 +3036,13 @@ class OSDDaemon:
                         and int(d.get("epoch", 0)) > self.osdmap.epoch)):
                 self._reply(conn, tid, MISDIRECTED_RC,
                             epoch=self.osdmap.epoch if self.osdmap else 0)
+                return
+            if self.osdmap is not None and self.osdmap.is_blocklisted(
+                    conn.peer_name, conn.peer_nonce, time.time()):
+                # fenced client (OSDMap blocklist): hard-refuse, the
+                # reference returns EBLOCKLISTED the same way
+                self._reply(conn, tid, EBLOCKLISTED_RC,
+                            epoch=self.osdmap.epoch)
                 return
             if self.osdmap is not None \
                     and "pause" in self.osdmap.flags:

@@ -125,6 +125,10 @@ class Incremental:
     unset_flags: list[str] = field(default_factory=list)
     new_ec_profiles: dict[str, dict] = field(default_factory=dict)
     removed_ec_profiles: list[str] = field(default_factory=list)
+    # client fencing (OSDMap.h blocklist role): "entity:nonce" (one
+    # instance) or bare "entity" (every instance) -> expiry walltime
+    new_blocklist: dict[str, float] = field(default_factory=dict)
+    old_blocklist: list[str] = field(default_factory=list)
     new_crush: dict | None = None       # full crush dump when it changed
 
     # -- wire form (Incremental encode/decode, OSDMap.h:354) -------------
@@ -154,6 +158,9 @@ class Incremental:
                 n: dict(p) for n, p in self.new_ec_profiles.items()
             },
             "removed_ec_profiles": list(self.removed_ec_profiles),
+            "new_blocklist": {k: float(v)
+                              for k, v in self.new_blocklist.items()},
+            "old_blocklist": list(self.old_blocklist),
             "new_crush": self.new_crush,
         }
 
@@ -194,6 +201,11 @@ class Incremental:
                 for n, p in d.get("new_ec_profiles", {}).items()
             },
             removed_ec_profiles=list(d.get("removed_ec_profiles", ())),
+            new_blocklist={
+                str(k): float(v)
+                for k, v in d.get("new_blocklist", {}).items()
+            },
+            old_blocklist=[str(k) for k in d.get("old_blocklist", ())],
             new_crush=d.get("new_crush"),
         )
 
@@ -210,6 +222,9 @@ class OSDMap:
                                   list[tuple[int, int]]] = {}
         self.flags: set[str] = set()
         self.ec_profiles: dict[str, dict] = {}
+        # fenced clients: "entity:nonce" or bare "entity" -> expiry
+        # walltime (OSDMap.h blocklist role)
+        self.blocklist: dict[str, float] = {}
         # never reused, even after pool deletion: a recycled id would
         # alias a dead pool's surviving shard objects into a new pool
         self.max_pool_id = 0
@@ -266,6 +281,10 @@ class OSDMap:
             self.ec_profiles[name] = dict(profile)
         for name in inc.removed_ec_profiles:
             self.ec_profiles.pop(name, None)
+        for ent, until in inc.new_blocklist.items():
+            self.blocklist[ent] = float(until)
+        for ent in inc.old_blocklist:
+            self.blocklist.pop(ent, None)
         if inc.new_crush is not None:
             self.crush = CrushMap.from_dict(inc.new_crush)
         self.epoch = inc.epoch
@@ -346,6 +365,17 @@ class OSDMap:
         return up, up_primary, acting, acting_primary
 
     # -- serialization ---------------------------------------------------
+    def is_blocklisted(self, entity: str, nonce: int,
+                       now: float) -> bool:
+        """True when this client instance is fenced: an exact
+        "entity:nonce" entry or a bare "entity" entry (all instances)
+        that has not expired (OSDMap::is_blocklisted role)."""
+        for key in (f"{entity}:{nonce}", entity):
+            until = self.blocklist.get(key)
+            if until is not None and until > now:
+                return True
+        return False
+
     def to_dict(self) -> dict:
         return {
             "epoch": self.epoch,
@@ -372,6 +402,7 @@ class OSDMap:
             },
             "flags": sorted(self.flags),
             "ec_profiles": {n: dict(p) for n, p in self.ec_profiles.items()},
+            "blocklist": {k: float(v) for k, v in self.blocklist.items()},
             "max_pool_id": self.max_pool_id,
             "crush": self.crush.to_dict(),
         }
@@ -400,6 +431,8 @@ class OSDMap:
             for s, pairs in d.get("pg_upmap_items", {}).items()
         }
         m.flags = {str(f) for f in d.get("flags", ())}
+        m.blocklist = {str(k): float(v)
+                       for k, v in d.get("blocklist", {}).items()}
         m.ec_profiles = {
             n: dict(p) for n, p in d.get("ec_profiles", {}).items()
         }

@@ -2542,6 +2542,9 @@ class RGWLite:
                     # a marker STRICTLY inside the group (start-after
                     # on a member key) must not hide the group: keys
                     # past it still roll up, as S3 rolls them
+                    if json.loads(index[k]).get("delete_marker"):
+                        continue      # a dead member alone must not
+                                      # surface a phantom prefix
                     if len(contents) + len(prefixes) == max_keys:
                         truncated = True
                         break

@@ -1,0 +1,195 @@
+"""OSDMap client blocklist (fencing): `osd blocklist add/rm/ls` at
+the mon stages map epochs whose entries every OSD enforces against
+the op source's (entity, nonce) session identity — the reference's
+OSDMap.h blocklist + OSDMonitor blocklist commands, returning
+EBLOCKLISTED.  MDS eviction can fence the evicted instance the same
+way (Server::kill_session + blocklist, the default in the
+reference)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS
+from ceph_tpu.client.rados import Rados, RadosError
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.codes import EBLOCKLISTED_RC
+from ceph_tpu.vstart import DevCluster
+from tests.test_services import fast_conf, start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _wait_blocked(ioctx, oid, want=True, deadline=10.0):
+    """Poll until the OSDs' maps catch up and ops from this client
+    are (or are no longer) fenced."""
+    end = asyncio.get_running_loop().time() + deadline
+    while True:
+        try:
+            await ioctx.write_full(oid, b"probe")
+            blocked = False
+        except RadosError as e:
+            if e.rc != EBLOCKLISTED_RC:
+                raise
+            blocked = True
+        if blocked == want:
+            return
+        assert asyncio.get_running_loop().time() < end, \
+            f"never reached blocked={want}"
+        await asyncio.sleep(0.1)
+
+
+def test_blocklist_instance_fencing():
+    async def run():
+        mon, osds, admin = await start_cluster()
+        r = await admin.mon_command("osd pool create", pool="p",
+                                    pg_num=8, size=3)
+        assert r["rc"] == 0, r
+        victim = Rados({"a": "local://mon.a"}, fast_conf())
+        await victim.connect()
+        vx = await victim.open_ioctx("p")
+        await vx.write_full("obj", b"before")
+        # fence the exact instance
+        r = await admin.mon_command("osd blocklist", action="add",
+                                    entity=victim.instance_id)
+        assert r["rc"] == 0, r
+        await _wait_blocked(vx, "obj", want=True)
+        # reads are fenced too
+        with pytest.raises(RadosError) as ei:
+            await vx.read("obj")
+        assert ei.value.rc == EBLOCKLISTED_RC
+        # the admin instance is untouched
+        ax = await admin.open_ioctx("p")
+        assert await ax.read("obj") == b"before"
+        # ls shows the entry
+        r = await admin.mon_command("osd blocklist ls")
+        assert victim.instance_id in r["data"]["blocklist"]
+        # a NEW instance of the same entity name is NOT fenced
+        # (instance-level entry), and rm lifts the fence
+        r = await admin.mon_command("osd blocklist", action="rm",
+                                    entity=victim.instance_id)
+        assert r["rc"] == 0, r
+        await _wait_blocked(vx, "obj", want=False)
+        r = await admin.mon_command("osd blocklist", action="rm",
+                                    entity="client.ghost")
+        assert r["rc"] != 0          # unknown entry refuses
+        await victim.shutdown()
+        await stop_cluster(mon, osds, admin)
+    asyncio.run(run())
+
+
+def test_blocklist_bare_entity_and_expiry():
+    async def run():
+        mon, osds, admin = await start_cluster()
+        r = await admin.mon_command("osd pool create", pool="p",
+                                    pg_num=8, size=3)
+        assert r["rc"] == 0, r
+        victim = Rados({"a": "local://mon.a"}, fast_conf())
+        await victim.connect()
+        name = victim.instance_id.rsplit(":", 1)[0]
+        vx = await victim.open_ioctx("p")
+        # bare-entity entry fences EVERY instance of the name
+        r = await admin.mon_command("osd blocklist", action="add",
+                                    entity=name)
+        assert r["rc"] == 0, r
+        await _wait_blocked(vx, "o1", want=True)
+        v2 = Rados({"a": "local://mon.a"}, fast_conf())
+        await v2.connect()
+        v2x = await v2.open_ioctx("p")
+        with pytest.raises(RadosError) as ei:
+            await v2x.write_full("o2", b"x")
+        assert ei.value.rc == EBLOCKLISTED_RC
+        await v2.shutdown()
+        # a short expiry lapses without an explicit rm
+        r = await admin.mon_command("osd blocklist", action="rm",
+                                    entity=name)
+        assert r["rc"] == 0, r
+        r = await admin.mon_command("osd blocklist", action="add",
+                                    entity=victim.instance_id,
+                                    expire=0.5)
+        assert r["rc"] == 0, r
+        await _wait_blocked(vx, "o1", want=True)
+        await _wait_blocked(vx, "o1", want=False)   # entry lapsed
+        # expire must be positive
+        r = await admin.mon_command("osd blocklist", action="add",
+                                    entity=name, expire=-1)
+        assert r["rc"] != 0
+        await victim.shutdown()
+        await stop_cluster(mon, osds, admin)
+    asyncio.run(run())
+
+
+def test_mds_evict_blocklists(tmp_path):
+    """session_evict(blocklist=True) fences the evicted client's
+    DIRECT data-pool IO, not just its MDS session — caps alone
+    cannot stop in-flight RADOS writes (why the reference blocklists
+    on eviction by default)."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+            "admin_socket_dir": str(tmp_path)})
+        await cluster.start()
+        admin = await cluster.client()
+        await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                                min_size=2)
+        await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                                min_size=2)
+        mds = await cluster.start_mds(name="a", block_size=4096)
+        try:
+            rc = await cluster.client("client.w")
+            fs = await CephFS.connect(rc)
+            await fs.mount()
+            await fs.write_file("/f", b"alive")
+            sid = mds.session_ls()[0]["id"]
+            out = await mds.session_evict(sid, blocklist=True)
+            assert out["evicted"] and out["blocklisted"], out
+            # the evicted instance's direct data-pool IO is fenced
+            await _wait_blocked(fs.data, "stray", want=True)
+            # a FRESH client (new nonce) works: the fence is
+            # instance-scoped
+            rc2 = await cluster.client("client.w")
+            fs2 = await CephFS.connect(rc2)
+            await fs2.mount()
+            assert await fs2.read_file("/f") == b"alive"
+            await fs2.unmount()
+            await rc2.shutdown()
+            await rc.shutdown()
+        finally:
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_readd_after_expiry_sticks():
+    """Re-adding an entry whose previous incarnation expired must
+    fence again: the mon's expiry prune must not cancel a key being
+    re-staged in the same epoch (review regression)."""
+    async def run():
+        mon, osds, admin = await start_cluster()
+        r = await admin.mon_command("osd pool create", pool="p",
+                                    pg_num=8, size=3)
+        assert r["rc"] == 0, r
+        victim = Rados({"a": "local://mon.a"}, fast_conf())
+        await victim.connect()
+        vx = await victim.open_ioctx("p")
+        r = await admin.mon_command("osd blocklist", action="add",
+                                    entity=victim.instance_id,
+                                    expire=0.3)
+        assert r["rc"] == 0, r
+        await _wait_blocked(vx, "o", want=True)
+        await _wait_blocked(vx, "o", want=False)     # lapsed
+        # re-add AFTER expiry: the stale map entry must be pruned
+        # without taking the fresh one down with it
+        r = await admin.mon_command("osd blocklist", action="add",
+                                    entity=victim.instance_id)
+        assert r["rc"] == 0, r
+        await _wait_blocked(vx, "o", want=True)
+        r = await admin.mon_command("osd blocklist ls")
+        assert victim.instance_id in r["data"]["blocklist"]
+        await victim.shutdown()
+        await stop_cluster(mon, osds, admin)
+    asyncio.run(run())

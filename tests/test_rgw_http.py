@@ -822,3 +822,32 @@ def test_delimiter_marker_inside_group():
             await stop_cluster(mon, osds, rados)
 
     asyncio.run(run())
+
+
+def test_delimiter_skips_delete_marker_groups():
+    """A prefix group whose only members are delete-marker-current
+    must not surface a phantom CommonPrefix (review regression)."""
+    NS = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        try:
+            await cli.request("PUT", "/b")
+            await cli.request("PUT", "/b?versioning",
+                              body=b"<VersioningConfiguration>"
+                                   b"<Status>Enabled</Status>"
+                                   b"</VersioningConfiguration>")
+            await cli.request("PUT", "/b/dead/x", body=b"x")
+            await cli.request("PUT", "/b/live/y", body=b"y")
+            st, _, _ = await cli.request("DELETE", "/b/dead/x")
+            assert st == 204
+            st, _, body = await cli.request("GET", "/b?delimiter=/")
+            doc = ET.fromstring(body)
+            cps = [e.text for e in doc.findall(
+                "s3:CommonPrefixes/s3:Prefix", NS)]
+            assert cps == ["live/"]       # no phantom "dead/"
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
