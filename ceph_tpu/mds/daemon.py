@@ -1540,14 +1540,21 @@ class MDSDaemon:
         conn = s["conn"]
         blocked = False
         if blocklist and conn.peer_name:
-            # fence FIRST: releasing caps wakes recall waiters, and a
-            # new holder must never write concurrently with the
-            # evictee's still-in-flight RADOS ops
+            # fence BEFORE releasing caps (which wakes recall waiters
+            # and grants a new writer), then wait for the fencing
+            # epoch to publish — the reference's
+            # wait_for_latest_osdmap step after a blocklist.  OSDs
+            # still apply the map asynchronously; because ops carry
+            # the sender's epoch and OSDs refuse ops newer than their
+            # map, a new holder that has the fencing epoch cannot
+            # race the evictee on an OSD that has not seen it.
             ent = f"{conn.peer_name}:{conn.peer_nonce}"
             try:
                 r = await self.rados.mon_command(
                     "osd blocklist", action="add", entity=ent)
                 blocked = r.get("rc") == 0
+                if blocked:
+                    await self._wait_blocklist_published(ent)
             except (RadosError, ConnectionError, OSError):
                 pass          # eviction still proceeds unfenced
         for ino, holder in list(self._caps.items()):
@@ -1559,6 +1566,20 @@ class MDSDaemon:
                  s["client"], " (blocklisted)" if blocked else "")
         return {"evicted": True, "client": s["client"],
                 "blocklisted": blocked}
+
+    async def _wait_blocklist_published(self, ent: str,
+                                        timeout: float = 5.0) -> None:
+        """Poll the mon until the fencing entry is visible in the
+        published map (bounded; eviction proceeds either way)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                r = await self.rados.mon_command("osd blocklist ls")
+            except (RadosError, ConnectionError, OSError):
+                return
+            if r.get("rc") == 0 and ent in r["data"]["blocklist"]:
+                return
+            await asyncio.sleep(0.05)
 
     # -- balancer (MDBalancer.h:33 + MHeartbeat load exchange) -------------
     def _decay_pops(self) -> None:

@@ -1054,6 +1054,7 @@ class RGWLite:
                 "mtime": e.get("mtime", 0.0),
                 "is_latest": current_vid.get(key) == vid,
                 "delete_marker": bool(e.get("delete_marker")),
+                "tags": dict(e.get("tags") or {}),
             })
         # newest-first within each key, by write time: the adopted
         # 'null' version keeps its original (oldest) mtime while a
@@ -1455,16 +1456,22 @@ class RGWLite:
         return sorted(out, key=lambda u: (u["key"], u["upload_id"]))
 
     # -- lifecycle (rgw_lc.cc: expiration rules + the LC worker) ----------
+    _LC_ACTIONS = ("expiration_days", "expiration_seconds",
+                   "noncurrent_days", "noncurrent_seconds",
+                   "abort_mpu_days", "abort_mpu_seconds")
+
     async def put_lifecycle(self, bucket: str,
                             rules: list[dict]) -> None:
-        """rules: [{id, prefix, status, expiration_days |
-        expiration_seconds}]."""
+        """rules: [{id, prefix, status} + at least one action:
+        expiration_days/_seconds (current versions),
+        noncurrent_days/_seconds (NoncurrentVersionExpiration),
+        abort_mpu_days/_seconds (AbortIncompleteMultipartUpload
+        DaysAfterInitiation)]."""
         meta = await self._check_bucket(bucket, "FULL_CONTROL")
         for r in rules:
-            if "expiration_days" not in r \
-                    and "expiration_seconds" not in r:
+            if not any(k in r for k in self._LC_ACTIONS):
                 raise RGWError("InvalidArgument",
-                               f"rule {r.get('id')}: no expiration")
+                               f"rule {r.get('id')}: no action")
         meta["lifecycle"] = [dict(r) for r in rules]
         await self._put_bucket_meta(bucket, meta)
 
@@ -1477,10 +1484,25 @@ class RGWLite:
         meta.pop("lifecycle", None)
         await self._put_bucket_meta(bucket, meta)
 
+    @staticmethod
+    def _lc_limit(r: dict, kind: str) -> float | None:
+        """The rule's threshold in seconds for one action kind
+        ("expiration"/"noncurrent"/"abort_mpu"), or None."""
+        if f"{kind}_seconds" in r:
+            return float(r[f"{kind}_seconds"])
+        if f"{kind}_days" in r:
+            return float(r[f"{kind}_days"]) * 86400
+        return None
+
     async def lc_process(self, now: float | None = None) -> dict:
         """One LC worker pass over every bucket (RGWLC::process):
-        delete objects whose age exceeds an Enabled rule's expiration.
-        Returns bucket -> [expired keys removed]."""
+        delete current versions whose age exceeds an Enabled rule's
+        expiration, permanently delete NONCURRENT versions whose
+        time-since-superseded exceeds a noncurrent rule (S3 measures
+        from when the version became noncurrent — the successor's
+        write time — not from its own), and abort incomplete
+        multipart uploads past DaysAfterInitiation.  Returns
+        bucket -> [expired keys removed]."""
         now = time.time() if now is None else now
         removed: dict[str, list[str]] = {}
         sys_self = self if self.user is None else self.as_user(None)
@@ -1494,32 +1516,110 @@ class RGWLite:
                       if r.get("status", "Enabled") == "Enabled"]
             if not active:
                 continue
-            listing = await sys_self.list_objects(bucket,
-                                                  max_keys=1 << 30)
-            for obj in listing["contents"]:
-                age = now - float(obj["mtime"])
+            got = removed.setdefault(bucket, [])
+            if any(self._lc_limit(r, "expiration") is not None
+                   for r in active):
+                await self._lc_expire_current(sys_self, bucket,
+                                              active, now, got)
+            if any(self._lc_limit(r, "noncurrent") is not None
+                   for r in active):
+                await self._lc_expire_noncurrent(sys_self, bucket,
+                                                 active, now, got)
+            if any(self._lc_limit(r, "abort_mpu") is not None
+                   for r in active):
+                await self._lc_abort_mpus(sys_self, bucket, active,
+                                          now, got)
+            if not got:
+                del removed[bucket]
+        return removed
+
+    async def _lc_expire_current(self, sys_self, bucket: str,
+                                 active: list[dict], now: float,
+                                 got: list[str]) -> None:
+        listing = await sys_self.list_objects(bucket,
+                                              max_keys=1 << 30)
+        for obj in listing["contents"]:
+            age = now - float(obj["mtime"])
+            for r in active:
+                limit = self._lc_limit(r, "expiration")
+                if limit is None:
+                    continue
+                if not obj["key"].startswith(r.get("prefix", "")):
+                    continue
+                want = r.get("tags") or {}
+                if want:
+                    # tag-filtered rule (S3 lifecycle Filter/Tag):
+                    # tags ride the listing, so no per-object
+                    # refetch and no race against deletions
+                    have = obj.get("tags") or {}
+                    if any(have.get(k) != v
+                           for k, v in want.items()):
+                        continue
+                if age > limit:
+                    await sys_self.delete_object(bucket, obj["key"])
+                    got.append(obj["key"])
+                    break
+
+    async def _lc_expire_noncurrent(self, sys_self, bucket: str,
+                                    active: list[dict], now: float,
+                                    got: list[str]) -> None:
+        """NoncurrentVersionExpiration (rgw_lc.cc
+        LCOpAction_NonCurrentExpiration role)."""
+        versions = await sys_self.list_object_versions(bucket)
+        by_key: dict[str, list[dict]] = {}
+        for v in versions:
+            by_key.setdefault(v["key"], []).append(v)
+        for key, vs in by_key.items():
+            vs.sort(key=lambda v: (-float(v["mtime"]),
+                                   not v["is_latest"]))
+            # vs[0] is current; each older version became noncurrent
+            # when its SUCCESSOR was written
+            for succ, v in zip(vs, vs[1:]):
+                if v["is_latest"]:
+                    continue
+                since = now - float(succ["mtime"])
                 for r in active:
-                    if not obj["key"].startswith(r.get("prefix", "")):
+                    limit = self._lc_limit(r, "noncurrent")
+                    if limit is None or not key.startswith(
+                            r.get("prefix", "")):
                         continue
                     want = r.get("tags") or {}
                     if want:
-                        # tag-filtered rule (S3 lifecycle Filter/Tag):
-                        # tags ride the listing, so no per-object
-                        # refetch and no race against deletions
-                        have = obj.get("tags") or {}
-                        if any(have.get(k) != v
-                               for k, v in want.items()):
+                        # the filter evaluates each VERSION's own tag
+                        # set (a dev-tagged version must survive a
+                        # prod-scoped rule)
+                        have = v.get("tags") or {}
+                        if any(have.get(k) != t
+                               for k, t in want.items()):
                             continue
-                    limit = (float(r["expiration_seconds"])
-                             if "expiration_seconds" in r
-                             else float(r["expiration_days"]) * 86400)
-                    if age > limit:
-                        await sys_self.delete_object(bucket,
-                                                     obj["key"])
-                        removed.setdefault(bucket, []).append(
-                            obj["key"])
+                    if since > limit:
+                        await sys_self.delete_object_version(
+                            bucket, key, v["version_id"])
+                        got.append(f"{key}@{v['version_id']}")
                         break
-        return removed
+
+    async def _lc_abort_mpus(self, sys_self, bucket: str,
+                             active: list[dict], now: float,
+                             got: list[str]) -> None:
+        """AbortIncompleteMultipartUpload (DaysAfterInitiation)."""
+        for up in await sys_self.list_multipart_uploads(bucket):
+            try:
+                m = await sys_self._mp_meta(bucket, up["key"],
+                                            up["upload_id"])
+            except RGWError:
+                continue        # completed/aborted underneath us
+            info = json.loads(m["_meta"])
+            age = now - float(info.get("initiated", now))
+            for r in active:
+                limit = self._lc_limit(r, "abort_mpu")
+                if limit is None or not up["key"].startswith(
+                        r.get("prefix", "")):
+                    continue
+                if age > limit:
+                    await sys_self.abort_multipart(
+                        bucket, up["key"], up["upload_id"])
+                    got.append(f"{up['key']}+{up['upload_id']}")
+                    break
 
     # -- bucket index shards (cls_rgw index + rgw_reshard.cc role) ---------
     @staticmethod

@@ -807,10 +807,20 @@ class S3Frontend:
                 r = ET.SubElement(root, "Rule")
                 ET.SubElement(r, "ID").text = rule.get("id", "")
                 ET.SubElement(r, "Prefix").text = rule.get("prefix", "")
-                ET.SubElement(r, "Status").text = "Enabled"
-                exp = ET.SubElement(r, "Expiration")
-                ET.SubElement(exp, "Days").text = \
-                    str(rule.get("expiration_days", 0))
+                ET.SubElement(r, "Status").text = \
+                    rule.get("status", "Enabled")
+                for field, outer, inner in (
+                        ("expiration_days", "Expiration", "Days"),
+                        ("noncurrent_days",
+                         "NoncurrentVersionExpiration",
+                         "NoncurrentDays"),
+                        ("abort_mpu_days",
+                         "AbortIncompleteMultipartUpload",
+                         "DaysAfterInitiation")):
+                    if field in rule:
+                        e = ET.SubElement(r, outer)
+                        ET.SubElement(e, inner).text = \
+                            str(rule[field])
                 if rule.get("tags"):
                     flt = ET.SubElement(r, "Filter")
                     holder = (ET.SubElement(flt, "And")
@@ -1337,8 +1347,6 @@ def _parse_lifecycle(body: bytes) -> list[dict]:
     for el in doc.iter():
         if not el.tag.endswith("Rule"):
             continue
-        days = el.findtext(f"{_ns('Expiration')}/{_ns('Days')}") or \
-            el.findtext("Expiration/Days") or "0"
         rule = {
             "id": el.findtext(_ns("ID")) or el.findtext("ID") or "",
             "prefix": (el.findtext(_ns("Prefix"))
@@ -1348,8 +1356,23 @@ def _parse_lifecycle(body: bytes) -> list[dict]:
                        or el.findtext(f"{_ns('Filter')}/{_ns('And')}"
                                       f"/{_ns('Prefix')}")
                        or el.findtext("Filter/And/Prefix") or ""),
-            "status": "Enabled", "expiration_days": int(days),
+            "status": (el.findtext(_ns("Status"))
+                       or el.findtext("Status") or "Enabled"),
         }
+        # each action element maps to its own rule field; an absent
+        # element must stay absent (a defaulted 0-day expiration
+        # would expire the whole prefix immediately)
+        for xml_path, field in (
+                (("Expiration", "Days"), "expiration_days"),
+                (("NoncurrentVersionExpiration", "NoncurrentDays"),
+                 "noncurrent_days"),
+                (("AbortIncompleteMultipartUpload",
+                  "DaysAfterInitiation"), "abort_mpu_days")):
+            outer, inner = xml_path
+            v = el.findtext(f"{_ns(outer)}/{_ns(inner)}") or \
+                el.findtext(f"{outer}/{inner}")
+            if v is not None:
+                rule[field] = int(v)
         # <Filter><Tag> / <Filter><And><Tag>...: dropping a tag
         # filter silently would expire objects it was protecting
         tags = {}

@@ -851,3 +851,90 @@ def test_delimiter_skips_delete_marker_groups():
             await stop_cluster(mon, osds, rados)
 
     asyncio.run(run())
+
+
+def test_lifecycle_rest_all_actions():
+    """Lifecycle XML round-trips all three action kinds; a rule whose
+    only action is noncurrent/abort must NOT grow a phantom 0-day
+    Expiration (which would expire the prefix immediately)."""
+    NS = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        try:
+            await cli.request("PUT", "/b")
+            body = (b"<LifecycleConfiguration>"
+                    b"<Rule><ID>nc</ID><Prefix>v/</Prefix>"
+                    b"<Status>Enabled</Status>"
+                    b"<NoncurrentVersionExpiration>"
+                    b"<NoncurrentDays>7</NoncurrentDays>"
+                    b"</NoncurrentVersionExpiration></Rule>"
+                    b"<Rule><ID>mpu</ID><Prefix></Prefix>"
+                    b"<Status>Enabled</Status>"
+                    b"<AbortIncompleteMultipartUpload>"
+                    b"<DaysAfterInitiation>3</DaysAfterInitiation>"
+                    b"</AbortIncompleteMultipartUpload></Rule>"
+                    b"<Rule><ID>exp</ID><Prefix>logs/</Prefix>"
+                    b"<Status>Enabled</Status>"
+                    b"<Expiration><Days>30</Days></Expiration>"
+                    b"</Rule>"
+                    b"</LifecycleConfiguration>")
+            st, _, _ = await cli.request("PUT", "/b?lifecycle",
+                                         body=body)
+            assert st == 200
+            st, _, body = await cli.request("GET", "/b?lifecycle")
+            assert st == 200
+            doc = ET.fromstring(body)
+            rules = doc.findall("s3:Rule", NS)
+            by_id = {r.findtext("s3:ID", None, NS): r for r in rules}
+            assert set(by_id) == {"nc", "mpu", "exp"}
+            nc = by_id["nc"]
+            assert nc.findtext(
+                "s3:NoncurrentVersionExpiration/s3:NoncurrentDays",
+                None, NS) == "7"
+            assert nc.find("s3:Expiration", NS) is None  # no phantom
+            assert by_id["mpu"].findtext(
+                "s3:AbortIncompleteMultipartUpload"
+                "/s3:DaysAfterInitiation", None, NS) == "3"
+            assert by_id["exp"].findtext(
+                "s3:Expiration/s3:Days", None, NS) == "30"
+            # an action-free rule is refused, not defaulted
+            st, _, _ = await cli.request(
+                "PUT", "/b?lifecycle",
+                body=b"<LifecycleConfiguration><Rule><ID>x</ID>"
+                     b"<Prefix>p/</Prefix><Status>Enabled</Status>"
+                     b"</Rule></LifecycleConfiguration>")
+            assert st == 400
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_lifecycle_status_roundtrip():
+    """<Status>Disabled</Status> must survive the PUT/GET round-trip
+    — a paused rule silently flipped to Enabled would delete objects
+    its owner explicitly protected (review regression)."""
+    NS = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        try:
+            await cli.request("PUT", "/b")
+            st, _, _ = await cli.request(
+                "PUT", "/b?lifecycle",
+                body=b"<LifecycleConfiguration><Rule><ID>paused</ID>"
+                     b"<Prefix>x/</Prefix><Status>Disabled</Status>"
+                     b"<Expiration><Days>1</Days></Expiration>"
+                     b"</Rule></LifecycleConfiguration>")
+            assert st == 200
+            st, _, body = await cli.request("GET", "/b?lifecycle")
+            doc = ET.fromstring(body)
+            assert doc.findtext("s3:Rule/s3:Status", None, NS) \
+                == "Disabled"
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
