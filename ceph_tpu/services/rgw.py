@@ -1472,6 +1472,26 @@ class RGWLite:
             if not any(k in r for k in self._LC_ACTIONS):
                 raise RGWError("InvalidArgument",
                                f"rule {r.get('id')}: no action")
+            for k in self._LC_ACTIONS:
+                if k in r and float(r[k]) <= 0:
+                    # an explicit 0 would expire the whole prefix on
+                    # the next pass; S3 rejects non-positive Days
+                    raise RGWError("InvalidArgument",
+                                   f"rule {r.get('id')}: {k} must "
+                                   f"be positive")
+            if r.get("status", "Enabled") not in ("Enabled",
+                                                 "Disabled"):
+                raise RGWError("MalformedXML",
+                               f"rule {r.get('id')}: bad status "
+                               f"{r.get('status')!r}")
+            if r.get("tags") and any(k.startswith("abort_mpu")
+                                     for k in r):
+                # S3 refuses Filter/Tag on AbortIncompleteMultipart-
+                # Upload: uploads have no tags to match, so the rule
+                # would abort everything the filter meant to protect
+                raise RGWError("InvalidArgument",
+                               f"rule {r.get('id')}: tag filters "
+                               f"cannot scope multipart aborts")
         meta["lifecycle"] = [dict(r) for r in rules]
         await self._put_bucket_meta(bucket, meta)
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import hmac
+import math
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -809,18 +810,27 @@ class S3Frontend:
                 ET.SubElement(r, "Prefix").text = rule.get("prefix", "")
                 ET.SubElement(r, "Status").text = \
                     rule.get("status", "Enabled")
-                for field, outer, inner in (
-                        ("expiration_days", "Expiration", "Days"),
-                        ("noncurrent_days",
+                for kind, outer, inner in (
+                        ("expiration", "Expiration", "Days"),
+                        ("noncurrent",
                          "NoncurrentVersionExpiration",
                          "NoncurrentDays"),
-                        ("abort_mpu_days",
+                        ("abort_mpu",
                          "AbortIncompleteMultipartUpload",
                          "DaysAfterInitiation")):
-                    if field in rule:
-                        e = ET.SubElement(r, outer)
-                        ET.SubElement(e, inner).text = \
-                            str(rule[field])
+                    if f"{kind}_days" in rule:
+                        days = int(rule[f"{kind}_days"])
+                    elif f"{kind}_seconds" in rule:
+                        # S3 XML has no seconds granularity: round a
+                        # store-API seconds rule UP to whole days so
+                        # the emitted document stays valid and
+                        # re-PUTtable (never sharper than the rule)
+                        days = max(1, math.ceil(
+                            float(rule[f"{kind}_seconds"]) / 86400))
+                    else:
+                        continue
+                    e = ET.SubElement(r, outer)
+                    ET.SubElement(e, inner).text = str(days)
                 if rule.get("tags"):
                     flt = ET.SubElement(r, "Filter")
                     holder = (ET.SubElement(flt, "And")

@@ -168,7 +168,7 @@ def test_lifecycle_expiration():
                 {"id": "expire-logs", "prefix": "logs/",
                  "status": "Enabled", "expiration_days": 1},
                 {"id": "disabled", "prefix": "keep/",
-                 "status": "Disabled", "expiration_days": 0},
+                 "status": "Disabled", "expiration_days": 1},
             ])
             assert len(await gw.get_lifecycle("lc")) == 2
             with pytest.raises(RGWError):

@@ -938,3 +938,67 @@ def test_lifecycle_status_roundtrip():
             await stop_cluster(mon, osds, rados)
 
     asyncio.run(run())
+
+
+def test_lifecycle_validation_and_seconds_render():
+    """Non-positive day counts, unknown Status text, and tag-scoped
+    multipart aborts are refused; a store-API seconds rule renders
+    as whole (rounded-up) days so GET output stays re-PUTtable
+    (review regressions)."""
+    NS = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        try:
+            await cli.request("PUT", "/b")
+            for bad in (
+                    b"<Expiration><Days>0</Days></Expiration>",
+                    b"<AbortIncompleteMultipartUpload>"
+                    b"<DaysAfterInitiation>0</DaysAfterInitiation>"
+                    b"</AbortIncompleteMultipartUpload>"):
+                st, _, _ = await cli.request(
+                    "PUT", "/b?lifecycle",
+                    body=b"<LifecycleConfiguration><Rule>"
+                         b"<ID>z</ID><Prefix></Prefix>"
+                         b"<Status>Enabled</Status>" + bad +
+                         b"</Rule></LifecycleConfiguration>")
+                assert st == 400, bad
+            # typo'd Status must not silently disable the rule
+            st, _, _ = await cli.request(
+                "PUT", "/b?lifecycle",
+                body=b"<LifecycleConfiguration><Rule><ID>z</ID>"
+                     b"<Prefix></Prefix><Status>enabled</Status>"
+                     b"<Expiration><Days>1</Days></Expiration>"
+                     b"</Rule></LifecycleConfiguration>")
+            assert st == 400
+            # tag filter + multipart abort is an S3-invalid combo
+            st, _, _ = await cli.request(
+                "PUT", "/b?lifecycle",
+                body=b"<LifecycleConfiguration><Rule><ID>z</ID>"
+                     b"<Filter><Tag><Key>env</Key>"
+                     b"<Value>dev</Value></Tag></Filter>"
+                     b"<Status>Enabled</Status>"
+                     b"<AbortIncompleteMultipartUpload>"
+                     b"<DaysAfterInitiation>1</DaysAfterInitiation>"
+                     b"</AbortIncompleteMultipartUpload>"
+                     b"</Rule></LifecycleConfiguration>")
+            assert st == 400
+            # seconds rule set via the store API renders as days
+            st, _, _ = await cli.request("PUT", "/b2")
+            await fe.rgw.as_user("alice").put_lifecycle("b2", [
+                {"id": "s", "prefix": "", "status": "Enabled",
+                 "noncurrent_seconds": 90000}])
+            st, _, body = await cli.request("GET", "/b2?lifecycle")
+            doc = ET.fromstring(body)
+            assert doc.findtext(
+                "s3:Rule/s3:NoncurrentVersionExpiration"
+                "/s3:NoncurrentDays", None, NS) == "2"   # ceil(90000/86400)
+            # and the emitted document re-PUTs cleanly
+            st, _, _ = await cli.request("PUT", "/b2?lifecycle",
+                                         body=body)
+            assert st == 200
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
