@@ -402,9 +402,19 @@ async def _dispatch(args, rados: Rados) -> int:
             # `ceph daemon <path/to.asok> <cmd>`: direct unix socket
             from ceph_tpu.common.admin_socket import admin_command
             cmd_map = {"perf": "perf dump"}
+            # bare tokens extend the command ("scrub start" typed
+            # unquoted); key=value tokens become arguments
+            words = [args.daemon_cmd]
+            kw = {}
+            for tok in args.kv:
+                if "=" in tok:
+                    k, _, v = tok.partition("=")
+                    kw[k] = v
+                else:
+                    words.append(tok)
+            prefix = " ".join(words)
             out = await admin_command(
-                args.target, cmd_map.get(args.daemon_cmd,
-                                         args.daemon_cmd)
+                args.target, cmd_map.get(prefix, prefix), **kw
             )
             _print(out, True)
             return 0 if not (isinstance(out, dict)
@@ -845,6 +855,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="dump_ops_in_flight | dump_historic_ops | perf | "
              "(any registered admin-socket command for .asok targets)",
     )
+    daemon.add_argument("kv", nargs="*", metavar="key=value",
+                        help="command arguments (.asok targets)")
 
     osd = sub.add_parser("osd")
     osd_sub = osd.add_subparsers(dest="action", required=True)

@@ -92,7 +92,10 @@ class AdminSocket:
             writer.close()
 
 
-async def admin_command(path: str, prefix: str, **args):
+async def admin_command(path: str, prefix: str, /, **args):
+    if "prefix" in args:
+        # would silently replace the command being run
+        raise ValueError("'prefix' is not a valid command argument")
     """Client side of the protocol (the ``ceph daemon`` CLI leg)."""
     reader, writer = await asyncio.open_unix_connection(path)
     try:

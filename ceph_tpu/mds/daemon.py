@@ -181,6 +181,10 @@ class MDSDaemon:
         self._next_sid = 0
         self._caps: dict[int, dict] = {}       # ino -> {conn, holder}
         self._cap_waiters: dict[int, list] = {}   # ino -> [futures]
+        # forward-scrub damage table (DamageTable.h role): findings
+        # survive until explicitly acked (damage rm)
+        self._damage: list[dict] = []
+        self._damage_seq = 0
         # balancer (MDBalancer.h:33 role): decaying per-directory
         # request popularity (DecayCounter semantics, one shared
         # lazy-decay stamp for the whole map)
@@ -232,6 +236,13 @@ class MDSDaemon:
                           "live client sessions + cap counts")
             sock.register("session evict", self.session_evict,
                           "session evict <id>: revoke caps + close")
+            sock.register("scrub start", self.scrub_start,
+                          "forward scrub: walk + validate metadata "
+                          "(repair=true fixes what it can)")
+            sock.register("damage ls", self.damage_ls,
+                          "damage table entries")
+            sock.register("damage rm", self.damage_rm,
+                          "damage rm <id>: ack one entry")
             await sock.start(run_dir)
             self.admin_socket = sock
         else:
@@ -1580,6 +1591,188 @@ class MDSDaemon:
             if r.get("rc") == 0 and ent in r["data"]["blocklist"]:
                 return
             await asyncio.sleep(0.05)
+
+    # -- forward scrub (MDCache scrub + DamageTable roles) -----------------
+    def _note_damage(self, dtype: str, ino: int, **info) -> None:
+        """Append unless an identical finding (ignoring id/repaired)
+        is already tabled — re-scrubbing an unrepaired defect must
+        not grow the table (the reference DamageTable dedupes)."""
+        sig = {k: v for k, v in info.items() if k != "repaired"}
+        for d in self._damage:
+            if d["damage_type"] == dtype and d["ino"] == ino \
+                    and {k: v for k, v in d.items()
+                         if k not in ("id", "damage_type", "ino",
+                                      "repaired")} == sig:
+                return
+        self._damage_seq += 1
+        self._damage.append({"id": self._damage_seq,
+                             "damage_type": dtype, "ino": ino,
+                             **info})
+
+    def damage_ls(self) -> list[dict]:
+        return list(self._damage)
+
+    def damage_rm(self, id) -> dict:
+        n = len(self._damage)
+        self._damage = [d for d in self._damage
+                        if d["id"] != int(id)]
+        return {"removed": n - len(self._damage)}
+
+    async def scrub_start(self, path: str = "/",
+                          repair=False) -> dict:
+        """Forward scrub (`ceph tell mds scrub start` role): walk the
+        namespace under ``path`` within THIS rank's authority and
+        validate the metadata invariants the -lite design maintains —
+        dirfrag parent back-pointers match the containing directory,
+        child dirfrags exist, remote dentries resolve through a
+        consistent anchortable record, and quota-table records/usage
+        match a fresh subtree recount.  ``repair=true`` fixes what is
+        mechanically fixable (back-pointers, usage cache, records for
+        dead dirs); everything found lands in the damage table."""
+        repair = repair in (True, "true", "1", 1)
+        async with self._mutate:
+            return await self._scrub_locked(path, repair)
+
+    async def _scrub_locked(self, path: str, repair: bool) -> dict:
+        root = ROOT_INO
+        if path not in ("", "/"):
+            for part in path.strip("/").split("/"):
+                d = await self._get_dentry(root, part)
+                if d.get("type") != "dir":
+                    raise MDSError(EINVAL,
+                                   f"{path!r}: not a directory")
+                root = int(d["ino"])
+        checked = dirs = 0
+        found: list[dict] = []
+
+        def note(dtype: str, ino: int, **info):
+            self._note_damage(dtype, ino, **info)
+            found.append({"damage_type": dtype, "ino": ino, **info})
+
+        subtree = await self._walk_subtree(root)
+        for dino in subtree:
+            if await self._auth_rank(dino) != self.rank:
+                continue             # a peer rank scrubs its own
+            try:
+                kv = await self.meta.get_omap(dirfrag_oid(dino))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+                continue
+            dirs += 1
+            for name, raw in kv.items():
+                de = decode(raw)
+                checked += 1
+                if de.get("type") == "dir":
+                    await self._scrub_dir_child(dino, name, de,
+                                                repair, note)
+                elif de.get("remote"):
+                    await self._scrub_remote(dino, name, de,
+                                             repair, note)
+        await self._scrub_quotas(set(subtree), repair, note)
+        return {"scrubbed_dirs": dirs, "checked_dentries": checked,
+                "damage": found, "repaired": repair}
+
+    async def _scrub_dir_child(self, parent: int, name: str,
+                               de: dict, repair: bool,
+                               note) -> None:
+        """Child dirfrag must exist and its parent back-pointer must
+        name the dirfrag that holds its dentry (the backtrace
+        invariant renames maintain)."""
+        cino = int(de["ino"])
+        try:
+            raw = await self.meta.get_xattr(dirfrag_oid(cino),
+                                            "parent")
+            back = int(raw)
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
+            back = None
+        if back is None:
+            note("missing_dirfrag_or_backtrace", cino,
+                 parent=parent, name=name,
+                 repaired=repair)
+            if repair:
+                await self.meta.operate(
+                    dirfrag_oid(cino),
+                    ObjectOperation().create().set_xattr(
+                        "parent", str(parent).encode()))
+        elif back != parent:
+            note("bad_backtrace", cino, parent=parent, name=name,
+                 points_at=back, repaired=repair)
+            if repair:
+                await self.meta.operate(
+                    dirfrag_oid(cino),
+                    ObjectOperation().set_xattr(
+                        "parent", str(parent).encode()))
+
+    async def _scrub_remote(self, parent: int, name: str, de: dict,
+                            repair: bool, note) -> None:
+        """A remote dentry must resolve through its anchortable
+        record, and the record's primary dentry must really exist
+        (the reference scrub's remote-link pass)."""
+        ino = int(de["ino"])
+        rec = await self._anchor_get(ino)
+        listed = rec is not None and (
+            [parent, name] in [list(r) for r in
+                               rec.get("remotes", ())])
+        primary_ok = False
+        if rec is not None and rec.get("primary"):
+            pp, pn = rec["primary"]
+            try:
+                pd = await self._get_dentry(int(pp), str(pn))
+                primary_ok = int(pd.get("ino", 0)) == ino                     and not pd.get("remote")
+            except MDSError:
+                primary_ok = False
+        if rec is None or not listed or not primary_ok:
+            note("dangling_remote", ino, parent=parent, name=name,
+                 anchored=rec is not None, listed=listed,
+                 primary_ok=primary_ok, repaired=repair)
+            if repair:
+                # the data's one nameable copy is the primary; a
+                # remote that cannot resolve is dead weight
+                await self._rm_dentry(parent, name)
+                if rec is not None and listed:
+                    rec["remotes"] = [
+                        r for r in rec["remotes"]
+                        if list(r) != [parent, name]]
+                    await self._anchor_put(
+                        ino, rec if rec["remotes"]
+                        or rec.get("primary") else None)
+
+    async def _scrub_quotas(self, subtree: set[int], repair: bool,
+                            note) -> None:
+        """Quota records must point at live directories and cached
+        usage must match a fresh recount (rstat consistency).  Only
+        realms inside the scrubbed subtree are touched — a scoped
+        scrub must not mutate state it was not asked to visit.
+        Exception: a record for a DEAD directory is checked from any
+        scope that could never walk to it anyway."""
+        for qino, lim in list(self.quotas.items()):
+            if await self._auth_rank(qino) != self.rank:
+                continue
+            if qino not in subtree and ROOT_INO not in subtree:
+                continue
+            try:
+                await self.meta.get_omap(dirfrag_oid(qino))
+                alive = True
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+                alive = False
+            if not alive:
+                note("quota_record_for_dead_dir", qino,
+                     limits=dict(lim), repaired=repair)
+                if repair:
+                    await self._quota_drop(qino)
+                continue
+            cached = self._qusage.get(qino)
+            fresh = await self._compute_usage(qino)
+            if cached is not None and cached != fresh:
+                note("quota_usage_drift", qino, cached=dict(cached),
+                     actual=dict(fresh), repaired=repair)
+                if repair:
+                    self._qusage[qino] = fresh
 
     # -- balancer (MDBalancer.h:33 + MHeartbeat load exchange) -------------
     def _decay_pops(self) -> None:

@@ -1473,7 +1473,16 @@ class RGWLite:
                 raise RGWError("InvalidArgument",
                                f"rule {r.get('id')}: no action")
             for k in self._LC_ACTIONS:
-                if k in r and float(r[k]) <= 0:
+                if k not in r:
+                    continue
+                try:
+                    val = float(r[k])
+                except (TypeError, ValueError):
+                    raise RGWError("InvalidArgument",
+                                   f"rule {r.get('id')}: {k}="
+                                   f"{r[k]!r} is not a number") \
+                        from None
+                if val <= 0:
                     # an explicit 0 would expire the whole prefix on
                     # the next pass; S3 rejects non-positive Days
                     raise RGWError("InvalidArgument",

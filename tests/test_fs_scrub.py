@@ -1,0 +1,229 @@
+"""CephFS forward scrub + damage table (reference MDCache scrub /
+`ceph tell mds scrub start` + DamageTable.h): walk the namespace,
+validate backtraces, remote-link anchors and quota records, repair
+what is mechanically fixable, remember the rest until acked."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS
+from ceph_tpu.common.admin_socket import admin_command
+from ceph_tpu.mds.daemon import ANCHOR_OID, dirfrag_oid
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _fs_cluster(tmp_path):
+    cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+        "admin_socket_dir": str(tmp_path)})
+    await cluster.start()
+    admin = await cluster.client()
+    await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                            min_size=2)
+    await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                            min_size=2)
+    mds = await cluster.start_mds(name="a", block_size=4096)
+    rados = await cluster.client("client.fs")
+    fs = await CephFS.connect(rados)
+    await fs.mount()
+    return cluster, admin, mds, rados, fs
+
+
+def test_scrub_clean_tree(tmp_path):
+    async def run():
+        cluster, admin, mds, rados, fs = await _fs_cluster(tmp_path)
+        try:
+            await fs.mkdir("/a")
+            await fs.mkdir("/a/b")
+            await fs.write_file("/a/b/f", b"x" * 100)
+            await fs.link("/a/b/f", "/a/hard")
+            await fs.setquota("/a", max_bytes=1 << 20)
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start")
+            assert out["damage"] == []
+            assert out["scrubbed_dirs"] >= 3
+            assert out["checked_dentries"] >= 4
+            assert mds.damage_ls() == []
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_scrub_detects_and_repairs_backtrace(tmp_path):
+    async def run():
+        cluster, admin, mds, rados, fs = await _fs_cluster(tmp_path)
+        try:
+            await fs.mkdir("/d")
+            await fs.mkdir("/d/sub")
+            sub = await fs.stat("/d/sub")
+            # corrupt the back-pointer (what a lost rename repair
+            # would leave behind)
+            await mds.meta.set_xattr(dirfrag_oid(sub["ino"]),
+                                     "parent", b"1")
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start", path="/d")
+            assert [d["damage_type"] for d in out["damage"]] \
+                == ["bad_backtrace"]
+            # damage persists in the table until acked
+            table = await admin_command(mds.admin_socket.path,
+                                        "damage ls")
+            assert len(table) == 1 and not table[0]["repaired"]
+            # repair pass fixes it; a rescrub comes back clean
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start", path="/d",
+                                      repair=True)
+            assert out["damage"][0]["repaired"] is True
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start", path="/d")
+            assert out["damage"] == []
+            # ack the table entries
+            for d in await admin_command(mds.admin_socket.path,
+                                         "damage ls"):
+                r = await admin_command(mds.admin_socket.path,
+                                        "damage rm", id=d["id"])
+                assert r["removed"] == 1
+            assert mds.damage_ls() == []
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_scrub_dangling_remote(tmp_path):
+    async def run():
+        cluster, admin, mds, rados, fs = await _fs_cluster(tmp_path)
+        try:
+            await fs.write_file("/f", b"data")
+            await fs.link("/f", "/alias")
+            st = await fs.stat("/f")
+            # nuke the anchortable record: the remote cannot resolve
+            from ceph_tpu.client.rados import ObjectOperation
+            await mds.meta.operate(
+                ANCHOR_OID,
+                ObjectOperation().omap_rm([str(st["ino"])]))
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start")
+            kinds = [d["damage_type"] for d in out["damage"]]
+            assert "dangling_remote" in kinds
+            # repair drops the dead name; the primary survives
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start", repair=True)
+            fs._dcache.clear()
+            assert await fs.read_file("/f") == b"data"
+            with pytest.raises(Exception):
+                await fs.read_file("/alias")
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start")
+            assert out["damage"] == []
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_scrub_quota_drift_and_dead_record(tmp_path):
+    async def run():
+        cluster, admin, mds, rados, fs = await _fs_cluster(tmp_path)
+        try:
+            await fs.mkdir("/q")
+            await fs.setquota("/q", max_bytes=10 ** 6)
+            await fs.write_file("/q/f", b"z" * 500)
+            # skew the cached usage (simulated accounting bug)
+            q = await fs.stat("/q")
+            mds._qusage[q["ino"]] = {"bytes": 1, "files": 99}
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start", repair=True)
+            drift = [d for d in out["damage"]
+                     if d["damage_type"] == "quota_usage_drift"]
+            assert drift and drift[0]["actual"]["bytes"] == 500
+            got = await fs.getquota("/q")
+            assert got["usage"]["bytes"] == 500
+            # a quota record whose directory died (crash between
+            # rmdir and record drop) is reaped on repair
+            mds.quotas[0xdead] = {"max_bytes": 5}
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start", repair=True)
+            kinds = [d["damage_type"] for d in out["damage"]]
+            assert "quota_record_for_dead_dir" in kinds
+            assert 0xdead not in mds.quotas
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_scrub_dedup_and_scoping(tmp_path):
+    """Re-scrubbing an unrepaired defect must not duplicate its
+    damage entry, and a path-scoped scrub must not touch quota
+    realms outside its subtree (review regressions)."""
+    async def run():
+        cluster, admin, mds, rados, fs = await _fs_cluster(tmp_path)
+        try:
+            await fs.mkdir("/d")
+            await fs.mkdir("/d/sub")
+            await fs.mkdir("/other")
+            await fs.setquota("/other", max_bytes=10 ** 6)
+            await fs.write_file("/other/f", b"q" * 100)
+            sub = await fs.stat("/d/sub")
+            await mds.meta.set_xattr(dirfrag_oid(sub["ino"]),
+                                     "parent", b"1")
+            for _ in range(3):
+                await admin_command(mds.admin_socket.path,
+                                    "scrub start", path="/d")
+            assert len(mds.damage_ls()) == 1      # deduped
+            # scoped scrub leaves the foreign realm's cache alone
+            other = await fs.stat("/other")
+            mds._qusage[other["ino"]] = {"bytes": 7, "files": 7}
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start", path="/d",
+                                      repair=True)
+            kinds = [d["damage_type"] for d in out["damage"]]
+            assert "quota_usage_drift" not in kinds
+            assert mds._qusage[other["ino"]] == {"bytes": 7,
+                                                 "files": 7}
+            # the full scrub DOES see and repair it
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start", repair=True)
+            kinds = [d["damage_type"] for d in out["damage"]]
+            assert "quota_usage_drift" in kinds
+            assert mds._qusage[other["ino"]]["bytes"] == 100
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_admin_command_prefix_guard(tmp_path):
+    """A kv argument literally named 'prefix' must raise, not
+    silently replace the command being run (review regression)."""
+    async def run():
+        cluster, admin, mds, rados, fs = await _fs_cluster(tmp_path)
+        try:
+            with pytest.raises(ValueError):
+                await admin_command(mds.admin_socket.path, "perf",
+                                    prefix="session evict")
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())

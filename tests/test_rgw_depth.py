@@ -617,3 +617,24 @@ def test_lc_noncurrent_tag_filter_and_status():
             await stop_cluster(mon, osds, rados)
 
     asyncio.run(run())
+
+
+def test_lc_malformed_action_value_is_invalid_argument():
+    """A non-numeric action value must surface as the S3-shaped
+    InvalidArgument, not a bare ValueError/500 (review
+    regression)."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, _ = await _gw(rados)
+            await gw.create_bucket("b")
+            for bad in ("tomorrow", None, [1]):
+                with pytest.raises(RGWError) as ei:
+                    await gw.put_lifecycle("b", [
+                        {"id": "r", "prefix": "",
+                         "expiration_days": bad}])
+                assert ei.value.code == "InvalidArgument"
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
