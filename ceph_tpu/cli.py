@@ -413,9 +413,13 @@ async def _dispatch(args, rados: Rados) -> int:
                 else:
                     words.append(tok)
             prefix = " ".join(words)
-            out = await admin_command(
-                args.target, cmd_map.get(prefix, prefix), **kw
-            )
+            try:
+                out = await admin_command(
+                    args.target, cmd_map.get(prefix, prefix), **kw
+                )
+            except ValueError as e:
+                print(f"bad daemon arguments: {e}", file=sys.stderr)
+                return 2
             _print(out, True)
             return 0 if not (isinstance(out, dict)
                              and "error" in out) else 1
@@ -428,6 +432,10 @@ async def _dispatch(args, rados: Rados) -> int:
         if kind != "osd" or osd_id < 0:
             print(f"bad daemon target {args.target!r} (want osd.N)",
                   file=sys.stderr)
+            return 2
+        if args.kv:
+            print("daemon arguments are only supported for .asok "
+                  "targets", file=sys.stderr)
             return 2
         if args.daemon_cmd not in ("perf", "dump_ops_in_flight",
                                    "dump_historic_ops"):

@@ -93,10 +93,10 @@ class AdminSocket:
 
 
 async def admin_command(path: str, prefix: str, /, **args):
+    """Client side of the protocol (the ``ceph daemon`` CLI leg)."""
     if "prefix" in args:
         # would silently replace the command being run
         raise ValueError("'prefix' is not a valid command argument")
-    """Client side of the protocol (the ``ceph daemon`` CLI leg)."""
     reader, writer = await asyncio.open_unix_connection(path)
     try:
         writer.write(json.dumps({"prefix": prefix, **args}).encode()

@@ -95,6 +95,13 @@ def block_oid(ino: int, blockno: int) -> str:
     return f"{ino:x}.{blockno:08x}"
 
 
+def backtrace_oid(ino: int) -> str:
+    """Per-file backtrace object in the DATA pool (the reference
+    stores backtrace xattrs on object 0; a sidecar here keeps
+    'block 0 absent' meaning 'no data flushed yet')."""
+    return f"{ino:x}.bt"
+
+
 class MDSError(Exception):
     def __init__(self, rc: int, msg: str = "",
                  missing_dentry: bool = False,
@@ -685,6 +692,10 @@ class MDSDaemon:
             dentry = dict(e["dentry"])
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dentry)
+            if op == "create":
+                await self._write_backtrace(int(e["ino"]),
+                                            int(e["parent"]),
+                                            str(e["name"]), dentry)
             if op == "mkdir":
                 # the dirfrag carries a parent back-pointer so rename
                 # can walk ancestors (cycle detection)
@@ -713,6 +724,12 @@ class MDSDaemon:
                                   str(e["src_name"]))
             await self._set_dentry(int(e["dst_parent"]),
                                    str(e["dst_name"]), dentry)
+            if dentry.get("type") in ("file", "symlink") \
+                    and not dentry.get("remote"):
+                await self._write_backtrace(int(dentry["ino"]),
+                                            int(e["dst_parent"]),
+                                            str(e["dst_name"]),
+                                            dentry)
             if dentry.get("type") == "dir":
                 # moved directory: ancestry chains changed
                 self._auth_cache.clear()
@@ -768,6 +785,13 @@ class MDSDaemon:
                 await self._set_dentry(int(e["parent"]),
                                        str(e["name"]),
                                        dict(e["dentry"]))
+                de_imp = dict(e["dentry"])
+                if de_imp.get("type") in ("file", "symlink") \
+                        and not de_imp.get("remote"):
+                    await self._write_backtrace(int(de_imp["ino"]),
+                                                int(e["parent"]),
+                                                str(e["name"]),
+                                                de_imp)
                 if dict(e["dentry"]).get("type") == "dir":
                     # imported directory: its ancestry chain now runs
                     # through THIS rank's territory — refresh the
@@ -920,12 +944,20 @@ class MDSDaemon:
             await self._set_dentry(int(e["pp"]), str(e["pn"]),
                                    dict(e["primary_dentry"]))
             await self._anchor_put(int(e["ino"]), e.get("anchor"))
+            await self._write_backtrace(int(e["ino"]), int(e["pp"]),
+                                        str(e["pn"]),
+                                        dict(e["primary_dentry"]))
         elif op == "promote_link":
             await self._rm_dentry(int(e["parent"]),
                                   str(e["name"]))
             await self._set_dentry(int(e["np"]), str(e["nn"]),
                                    dict(e["primary_dentry"]))
             await self._anchor_put(int(e["ino"]), e.get("anchor"))
+            # the primary dentry moved: a stale backtrace would let a
+            # data-scan inject resurrect the DELETED old name
+            await self._write_backtrace(int(e["ino"]), int(e["np"]),
+                                        str(e["nn"]),
+                                        dict(e["primary_dentry"]))
 
     async def _purge_file(self, ino: int, size: int) -> None:
         """Delete a file's data objects (the PurgeQueue role, inline)."""
@@ -938,6 +970,11 @@ class MDSDaemon:
             except RadosError as e:
                 if e.rc != ENOENT:
                     raise
+        try:
+            await self.data.remove(backtrace_oid(ino))
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
 
     # -- hard links (remote dentries + the reference's anchortable) -------
     # The inode stays EMBEDDED in one primary dentry; other names are
@@ -1592,6 +1629,31 @@ class MDSDaemon:
                 return
             await asyncio.sleep(0.05)
 
+    async def _write_backtrace(self, ino: int, parent: int,
+                               name: str,
+                               dentry: dict | None = None) -> None:
+        """File backtrace sidecar in the DATA pool (the reference
+        writes parent/name backtrace xattrs on object 0):
+        cephfs-data-scan rebuilds dentries from these when the
+        metadata pool is lost.  Symlinks record their target too —
+        they have no data objects, so the sidecar is their ONLY
+        recoverable trace.  Best effort: data-plane trouble must not
+        fail the metadata op."""
+        if self.data is None:
+            return
+        bt = {"parent": parent, "name": name}
+        if dentry is not None:
+            bt["type"] = dentry.get("type", "file")
+            if dentry.get("type") == "symlink":
+                bt["target"] = dentry.get("target", "")
+        try:
+            await self.data.operate(
+                backtrace_oid(ino),
+                ObjectOperation().create().set_xattr(
+                    "backtrace", encode(bt)))
+        except RadosError:
+            pass
+
     # -- forward scrub (MDCache scrub + DamageTable roles) -----------------
     def _note_damage(self, dtype: str, ino: int, **info) -> None:
         """Append unless an identical finding (ignoring id/repaired)
@@ -1680,6 +1742,7 @@ class MDSDaemon:
         name the dirfrag that holds its dentry (the backtrace
         invariant renames maintain)."""
         cino = int(de["ino"])
+        corrupt = False
         try:
             raw = await self.meta.get_xattr(dirfrag_oid(cino),
                                             "parent")
@@ -1688,6 +1751,19 @@ class MDSDaemon:
             if e.rc != ENOENT:
                 raise
             back = None
+        except (ValueError, TypeError):
+            # garbage in the xattr is exactly the corruption class
+            # scrub exists to find — table it, never abort the walk
+            back, corrupt = None, True
+        if corrupt:
+            note("corrupt_backtrace", cino, parent=parent,
+                 name=name, repaired=repair)
+            if repair:
+                await self.meta.operate(
+                    dirfrag_oid(cino),
+                    ObjectOperation().set_xattr(
+                        "parent", str(parent).encode()))
+            return
         if back is None:
             note("missing_dirfrag_or_backtrace", cino,
                  parent=parent, name=name,
@@ -1724,21 +1800,57 @@ class MDSDaemon:
                 primary_ok = int(pd.get("ino", 0)) == ino                     and not pd.get("remote")
             except MDSError:
                 primary_ok = False
-        if rec is None or not listed or not primary_ok:
-            note("dangling_remote", ino, parent=parent, name=name,
-                 anchored=rec is not None, listed=listed,
-                 primary_ok=primary_ok, repaired=repair)
+        if rec is not None and primary_ok and not listed:
+            # primary fine, this name just fell off the listing: the
+            # LEAST destructive repair is to restore the listing
+            note("unlisted_remote", ino, parent=parent, name=name,
+                 repaired=repair)
             if repair:
-                # the data's one nameable copy is the primary; a
-                # remote that cannot resolve is dead weight
+                rec.setdefault("remotes", []).append([parent, name])
+                await self._anchor_put(ino, rec)
+            return
+        if rec is not None and listed and not primary_ok:
+            # the PRIMARY is the casualty, not this name: deleting a
+            # working remote would orphan the data — promote it
+            note("dead_primary", ino, parent=parent, name=name,
+                 repaired=repair)
+            if repair:
+                size = await self._size_from_data(ino)
+                promoted = _dentry(ino, "file", 0o644, size)
+                await self._set_dentry(parent, name, promoted)
+                rec["primary"] = [parent, name]
+                rec["remotes"] = [
+                    r for r in rec.get("remotes", ())
+                    if list(r) != [parent, name]]
+                if rec["remotes"]:
+                    await self._anchor_put(ino, rec)
+                else:
+                    await self._anchor_put(ino, None)
+            return
+        if rec is None:
+            note("dangling_remote", ino, parent=parent, name=name,
+                 repaired=repair)
+            if repair:
+                # nothing resolvable remains behind this name: the
+                # anchor record is gone, so the remote is dead weight
                 await self._rm_dentry(parent, name)
-                if rec is not None and listed:
-                    rec["remotes"] = [
-                        r for r in rec["remotes"]
-                        if list(r) != [parent, name]]
-                    await self._anchor_put(
-                        ino, rec if rec["remotes"]
-                        or rec.get("primary") else None)
+
+    async def _size_from_data(self, ino: int) -> int:
+        """Recover a file's size from its data blocks (repair-path
+        only: O(pool listing))."""
+        best = 0
+        prefix = f"{ino:x}."
+        for oid in await self.data.list_objects():
+            if not oid.startswith(prefix) or oid.endswith(".bt"):
+                continue
+            try:
+                block = int(oid[len(prefix):], 16)
+            except ValueError:
+                continue
+            st = await self.data.stat(oid)
+            best = max(best, block * self.block_size
+                       + int(st.get("size", 0)))
+        return best
 
     async def _scrub_quotas(self, subtree: set[int], repair: bool,
                             note) -> None:

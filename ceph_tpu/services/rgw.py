@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import math
 import secrets
 import time
 import zlib
@@ -1482,7 +1483,7 @@ class RGWLite:
                                    f"rule {r.get('id')}: {k}="
                                    f"{r[k]!r} is not a number") \
                         from None
-                if val <= 0:
+                if not math.isfinite(val) or val <= 0:
                     # an explicit 0 would expire the whole prefix on
                     # the next pass; S3 rejects non-positive Days
                     raise RGWError("InvalidArgument",

@@ -1,0 +1,189 @@
+"""cephfs-data-scan: metadata reconstruction from the data pool
+(reference src/tools/cephfs/DataScan.cc scan_extents/scan_inodes)."""
+
+import asyncio
+import contextlib
+import io
+import json
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS
+from ceph_tpu import cephfs_data_scan as ds
+from ceph_tpu.mds.daemon import backtrace_oid, dirfrag_oid
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def run_tool(conf, *argv):
+    buf = io.StringIO()
+    args = ds.build_parser().parse_args(["--conf", conf, *argv])
+    with contextlib.redirect_stdout(buf):
+        rc = await ds._run(args)
+    return rc, json.loads(buf.getvalue())
+
+
+def test_data_scan_rebuilds_lost_metadata(tmp_path):
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        admin = await cluster.client()
+        await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                                min_size=2)
+        await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                                min_size=2)
+        mds = await cluster.start_mds(name="a", block_size=4096)
+        conf = str(tmp_path / "c.json")
+        cluster.write_conf(conf)
+        try:
+            rc = await cluster.client("client.w")
+            fs = await CephFS.connect(rc)
+            await fs.mount()
+            await fs.mkdir("/docs")
+            await fs.write_file("/docs/big", b"A" * 10000)   # 3 blocks
+            await fs.write_file("/docs/small", b"hi")
+            await fs.write_file("/top", b"rooted")
+            st_big = await fs.stat("/docs/big")
+            docs = await fs.stat("/docs")
+            # scan sees exact sizes + backtraces
+            code, rep = await run_tool(conf, "--block-size", "4096",
+                                       "scan")
+            rec = rep[f"{st_big['ino']:x}"]
+            assert rec["size"] == 10000 and rec["blocks"] == 3
+            assert rec["parent"] == docs["ino"]
+            assert rec["name"] == "big"
+            # DISASTER: both file dentries vanish from /docs
+            from ceph_tpu.client.rados import ObjectOperation
+            await mds.meta.operate(
+                dirfrag_oid(docs["ino"]),
+                ObjectOperation().omap_rm(["big", "small"]))
+            fs._dcache.clear()
+            with pytest.raises(Exception):
+                await fs.read_file("/docs/big")
+            # inject puts them back at their backtraced homes
+            code, rep = await run_tool(conf, "--block-size", "4096",
+                                       "inject")
+            names = {(l["parent"], l["name"])
+                     for l in rep["linked"]}
+            assert (docs["ino"], "big") in names
+            assert (docs["ino"], "small") in names
+            assert rep["lost_found"] == []
+            fs._dcache.clear()
+            assert await fs.read_file("/docs/big") == b"A" * 10000
+            assert await fs.read_file("/docs/small") == b"hi"
+            # intact files are left alone on a rerun
+            code, rep = await run_tool(conf, "--block-size", "4096",
+                                       "inject")
+            assert rep["linked"] == []
+            assert len(rep["already_present"]) >= 3
+            await fs.unmount()
+            await rc.shutdown()
+        finally:
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_data_scan_orphans_to_lost_found(tmp_path):
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        admin = await cluster.client()
+        await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                                min_size=2)
+        await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                                min_size=2)
+        mds = await cluster.start_mds(name="a", block_size=4096)
+        conf = str(tmp_path / "c.json")
+        cluster.write_conf(conf)
+        try:
+            rc = await cluster.client("client.w")
+            fs = await CephFS.connect(rc)
+            await fs.mount()
+            await fs.mkdir("/gone")
+            await fs.write_file("/gone/orphan", b"remnant")
+            st = await fs.stat("/gone/orphan")
+            gone = await fs.stat("/gone")
+            # the whole parent directory is lost: dentry AND dirfrag
+            from ceph_tpu.client.rados import ObjectOperation
+            await mds.meta.operate(
+                dirfrag_oid(1), ObjectOperation().omap_rm(["gone"]))
+            await mds.meta.remove(dirfrag_oid(gone["ino"]))
+            # also a file whose backtrace sidecar is gone entirely
+            await fs.write_file("/nobt", b"x" * 5000)
+            st2 = await fs.stat("/nobt")
+            await mds.data.remove(backtrace_oid(st2["ino"]))
+            await mds.meta.operate(
+                dirfrag_oid(1), ObjectOperation().omap_rm(["nobt"]))
+            code, rep = await run_tool(conf, "--block-size", "4096",
+                                       "inject")
+            assert set(rep["lost_found"]) == {st["ino"], st2["ino"]}
+            fs._dcache.clear()
+            got = await fs.read_file(f"/lost+found/{st['ino']:x}")
+            assert got == b"remnant"
+            assert (await fs.stat(
+                f"/lost+found/{st2['ino']:x}"))["size"] == 5000
+            names = await fs.readdir("/lost+found")
+            assert len(names) == 2
+            await fs.unmount()
+            await rc.shutdown()
+        finally:
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_backtrace_follows_promote_and_symlinks(tmp_path):
+    """A promoted hardlink rewrites its backtrace (a stale one would
+    let inject resurrect the deleted old name), and symlinks recover
+    with their targets (review regressions)."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        admin = await cluster.client()
+        await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                                min_size=2)
+        await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                                min_size=2)
+        mds = await cluster.start_mds(name="a", block_size=4096)
+        conf = str(tmp_path / "c.json")
+        cluster.write_conf(conf)
+        try:
+            rc = await cluster.client("client.w")
+            fs = await CephFS.connect(rc)
+            await fs.mount()
+            await fs.write_file("/a", b"linked")
+            await fs.link("/a", "/b")
+            await fs.unlink("/a")        # promote: /b is primary now
+            st = await fs.stat("/b")
+            # inject must NOT resurrect /a (backtrace moved to /b)
+            code, rep = await run_tool(conf, "--block-size", "4096",
+                                       "inject")
+            assert rep["linked"] == [], rep
+            fs._dcache.clear()
+            with pytest.raises(Exception):
+                await fs.read_file("/a")
+            # symlink: lost dentry comes back WITH its target
+            await fs.symlink("b", "/ln")
+            from ceph_tpu.client.rados import ObjectOperation
+            await mds.meta.operate(
+                dirfrag_oid(1), ObjectOperation().omap_rm(["ln"]))
+            code, rep = await run_tool(conf, "--block-size", "4096",
+                                       "inject")
+            assert [l["name"] for l in rep["linked"]] == ["ln"]
+            fs._dcache.clear()
+            assert await fs.readlink("/ln") == "b"
+            assert await fs.read_file("/ln") == b"linked"  # follows
+            await fs.unmount()
+            await rc.shutdown()
+        finally:
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())

@@ -227,3 +227,46 @@ def test_admin_command_prefix_guard(tmp_path):
             await admin.shutdown()
             await cluster.stop()
     asyncio.run(run())
+
+
+def test_scrub_repair_promotes_dead_primary(tmp_path):
+    """When the anchor's primary dentry is lost but a remote name
+    still works, repair must PROMOTE the remote — deleting the last
+    working name would orphan the data (review regression).  A
+    corrupt parent back-pointer must also be tabled, not abort the
+    scrub."""
+    async def run():
+        cluster, admin, mds, rados, fs = await _fs_cluster(tmp_path)
+        try:
+            await fs.write_file("/orig", b"keep me safe")
+            await fs.link("/orig", "/mirror")
+            st = await fs.stat("/orig")
+            from ceph_tpu.client.rados import ObjectOperation
+            # the primary dentry is destroyed by corruption
+            await mds.meta.operate(
+                dirfrag_oid(1), ObjectOperation().omap_rm(["orig"]))
+            # plus a second, unrelated corruption: garbage backtrace
+            await fs.mkdir("/dd")
+            dd = await fs.stat("/dd")
+            await mds.meta.set_xattr(dirfrag_oid(dd["ino"]),
+                                     "parent", b"not-a-number")
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start")
+            kinds = sorted(d["damage_type"] for d in out["damage"])
+            assert kinds == ["corrupt_backtrace", "dead_primary"]
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start", repair=True)
+            fs._dcache.clear()
+            # the remote was promoted: data reachable, size right
+            assert await fs.read_file("/mirror") == b"keep me safe"
+            assert (await fs.stat("/mirror"))["size"] == 12
+            assert (await fs.stat("/mirror"))["ino"] == st["ino"]
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start")
+            assert out["damage"] == []
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
