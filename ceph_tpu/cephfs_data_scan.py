@@ -45,6 +45,7 @@ async def scan_pool(data, block_size: int) -> dict[int, dict]:
     name}.  Size is exact for our write pattern (the tail block's
     real length); backtrace absence leaves parent/name None."""
     inos: dict[int, dict] = {}
+    tails: dict[int, int] = {}         # ino -> highest block seen
     for oid in await data.list_objects():
         m = _BLOCK_RE.match(oid)
         if m:
@@ -53,12 +54,7 @@ async def scan_pool(data, block_size: int) -> dict[int, dict]:
                                         "parent": None, "name": None,
                                         "type": "file"})
             rec["blocks"] += 1
-            # stat, never read: recovery must not stream the whole
-            # pool through memory to learn object lengths
-            tail = int((await data.stat(oid)).get("size", 0))
-            size = block * block_size + tail
-            if size > rec["size"]:
-                rec["size"] = size
+            tails[ino] = max(tails.get(ino, -1), block)
             continue
         m = _BT_RE.match(oid)
         if m:
@@ -68,32 +64,40 @@ async def scan_pool(data, block_size: int) -> dict[int, dict]:
                                         "type": "file"})
             try:
                 bt = decode(await data.get_xattr(oid, "backtrace"))
-                rec["parent"] = int(bt["parent"])
-                rec["name"] = str(bt["name"])
-                rec["type"] = str(bt.get("type", "file"))
-                if rec["type"] == "symlink":
-                    rec["target"] = str(bt.get("target", ""))
+                # parse FULLY before assigning: a truncated record
+                # must not leave a half-filled backtrace (parent set,
+                # name None) for inject to trip over
+                parent, name = int(bt["parent"]), str(bt["name"])
+                btype = str(bt.get("type", "file"))
+                target = str(bt.get("target", "")) \
+                    if btype == "symlink" else None
             except (RadosError, KeyError, ValueError, TypeError):
-                pass          # scan is best-effort; inject handles it
+                continue      # scan is best-effort; inject handles it
+            rec["parent"], rec["name"] = parent, name
+            rec["type"] = btype
+            if target is not None:
+                rec["target"] = target
+    # one stat per ino (the tail block alone fixes the size), not
+    # one per object: recovery cost scales with files, not blocks
+    for ino, top in tails.items():
+        from ceph_tpu.mds.daemon import block_oid
+        tail = int((await data.stat(block_oid(ino, top)))
+                   .get("size", 0))
+        inos[ino]["size"] = top * block_size + tail
     return inos
 
 
 async def _dirfrag_alive(meta, dino: int) -> bool:
     try:
-        await meta.get_omap(dirfrag_oid(dino))
+        # stat, not get_omap: liveness must not pull a large
+        # directory's full dentry listing per probe
+        await meta.stat(dirfrag_oid(dino))
         return True
     except RadosError as e:
         if e.rc != ENOENT:
             raise
-        # an EMPTY dirfrag object has no omap but exists with a
-        # parent back-pointer; probe the xattr before declaring dead
-        try:
-            await meta.get_xattr(dirfrag_oid(dino), "parent")
-            return True
-        except RadosError as e2:
-            if e2.rc != ENOENT:
-                raise
-            return dino == ROOT_INO
+        # the root dirfrag is created lazily on its first dentry
+        return dino == ROOT_INO
 
 
 async def _dentry_for(meta, dino: int, name: str) -> dict | None:
@@ -119,11 +123,18 @@ async def inject(meta, inos: dict[int, dict]) -> dict:
     ``lost+found/<ino:x>``."""
     linked, existing, lost = [], [], []
     lf_ino = None
+    alive_cache: dict[int, bool] = {}
+
+    async def parent_alive(dino: int) -> bool:
+        if dino not in alive_cache:
+            alive_cache[dino] = await _dirfrag_alive(meta, dino)
+        return alive_cache[dino]
+
     for ino in sorted(inos):
         rec = inos[ino]
         target = None
-        if rec["parent"] is not None and await _dirfrag_alive(
-                meta, rec["parent"]):
+        if rec["parent"] is not None and rec["name"] is not None \
+                and await parent_alive(rec["parent"]):
             cur = await _dentry_for(meta, rec["parent"], rec["name"])
             if cur is None:
                 target = (rec["parent"], rec["name"])

@@ -574,6 +574,74 @@ async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
     return 2
 
 
+async def _rados_export(io, path: str) -> int:
+    """`rados export`: archive every object's data + xattrs + omap as
+    one framed stream (reference src/tools/rados PoolDump).  Wire
+    format: 4-byte LE length + encoded {oid, data, xattrs, omap} per
+    object, so import replays in one pass without loading the pool
+    into memory."""
+    import struct as _struct
+    from ceph_tpu.msg.codec import encode as _enc
+    out = sys.stdout.buffer if path == "-" else open(path, "wb")
+    n = 0
+    try:
+        for oid in sorted(await io.list_objects()):
+            data = await io.read(oid)
+            xattrs = await io.get_xattrs(oid)
+            omap = await io.get_omap(oid)
+            rec = _enc({"oid": oid, "data": data,
+                        "xattrs": dict(xattrs), "omap": dict(omap)})
+            out.write(_struct.pack("<I", len(rec)) + rec)
+            n += 1
+    finally:
+        if path != "-":
+            out.close()
+    return n
+
+
+async def _rados_import(io, path: str) -> int:
+    """`rados import`: replay an export archive.  Existing objects
+    are overwritten whole (data, xattrs and omap all become the
+    archived state) — the reference's default as well."""
+    import struct as _struct
+    from ceph_tpu.client.rados import ObjectOperation, RadosError
+    from ceph_tpu.msg.codec import decode as _dec
+    src = sys.stdin.buffer if path == "-" else open(path, "rb")
+    n = 0
+    try:
+        while True:
+            hdr = src.read(4)
+            if not hdr:
+                break
+            if len(hdr) < 4:
+                raise ValueError("truncated archive header")
+            (ln,) = _struct.unpack("<I", hdr)
+            raw = src.read(ln)
+            if len(raw) < ln:
+                raise ValueError("truncated archive record")
+            rec = _dec(raw)
+            try:
+                # drop first: surviving extra omap keys / xattrs on
+                # an existing object would make "restore" a merge
+                await io.remove(str(rec["oid"]))
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+            op = ObjectOperation().create() \
+                .write_full(rec.get("data") or b"")
+            for k, v in (rec.get("xattrs") or {}).items():
+                op = op.set_xattr(k, v)
+            omap = rec.get("omap") or {}
+            if omap:
+                op = op.omap_set(omap)
+            await io.operate(str(rec["oid"]), op)
+            n += 1
+    finally:
+        if path != "-":
+            src.close()
+    return n
+
+
 async def _rados_bench(io, args) -> dict:
     """`rados bench` (reference src/common/obj_bencher.cc): timed
     write or sequential-read workload with concurrency, reporting
@@ -659,6 +727,14 @@ async def _dispatch_rados(args, rados: Rados, j: bool) -> int:
         if a == "bench":
             report = await _rados_bench(io, args)
             _print(report, True)
+            return 0
+        if a == "export":
+            n = await _rados_export(io, args.file)
+            print(f"exported {n} objects", file=sys.stderr)
+            return 0
+        if a == "import":
+            n = await _rados_import(io, args.file)
+            print(f"imported {n} objects", file=sys.stderr)
             return 0
         if a == "put":
             data = (sys.stdin.buffer.read() if args.file == "-"
@@ -947,6 +1023,9 @@ def build_parser() -> argparse.ArgumentParser:
         r.add_argument("obj")
         r.add_argument("key")
         r.add_argument("value")
+    for name in ("export", "import"):
+        r = rados_sub.add_parser(name)
+        r.add_argument("file", help="archive path ('-' = stdout/in)")
     bench = rados_sub.add_parser("bench")
     bench.add_argument("seconds", type=int)
     bench.add_argument("mode", choices=["write", "seq"])

@@ -1818,6 +1818,11 @@ class MDSDaemon:
                 size = await self._size_from_data(ino)
                 promoted = _dentry(ino, "file", 0o644, size)
                 await self._set_dentry(parent, name, promoted)
+                # the promoted name is the backtraced home now — a
+                # stale sidecar would let data-scan resurrect the
+                # dead primary's old name
+                await self._write_backtrace(ino, parent, name,
+                                            promoted)
                 rec["primary"] = [parent, name]
                 rec["remotes"] = [
                     r for r in rec.get("remotes", ())
@@ -1827,12 +1832,13 @@ class MDSDaemon:
                 else:
                     await self._anchor_put(ino, None)
             return
-        if rec is None:
+        if rec is None or (not listed and not primary_ok):
+            # no anchor record at all, or a record that neither lists
+            # this name nor backs a live primary: nothing resolvable
+            # remains behind the remote — it is dead weight
             note("dangling_remote", ino, parent=parent, name=name,
-                 repaired=repair)
+                 anchored=rec is not None, repaired=repair)
             if repair:
-                # nothing resolvable remains behind this name: the
-                # anchor record is gone, so the remote is dead weight
                 await self._rm_dentry(parent, name)
 
     async def _size_from_data(self, ino: int) -> int:

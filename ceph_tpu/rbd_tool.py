@@ -207,7 +207,8 @@ async def _dispatch(args, rbd: RBD):
                     for lk, v in sorted(info.get("lockers",
                                                  {}).items())]
         if args.lock_cmd == "break":
-            await img.break_lock(args.locker)
+            await img.break_lock(args.locker,
+                                 blocklist=args.blocklist)
             return None
     raise RBDError(f"unknown command {cmd!r}")
 
@@ -283,6 +284,9 @@ def build_parser() -> argparse.ArgumentParser:
     lkb = lk_sub.add_parser("break")
     lkb.add_argument("image")
     lkb.add_argument("locker")
+    lkb.add_argument("--blocklist", action="store_true",
+                     help="fence the owner's client instance at the "
+                          "OSDs before breaking (reference default)")
     sn = sub.add_parser("snap")
     sn.add_argument("snap_cmd", choices=[
         "create", "ls", "rm", "protect", "unprotect", "rollback",

@@ -482,7 +482,10 @@ class Image:
         # reference fences harder, via osd blocklisting).
         self._excl = exclusive
         self._lock_duration = lock_duration
-        self._locker_id = f"img.{image_id}.{secrets.token_hex(4)}"
+        # instance id first so `rbd lock break --blocklist` can
+        # fence the owner: "entity:nonce@img.<id>.<uniq>"
+        self._locker_id = (f"{ioctx.rados.instance_id}@"
+                           f"img.{image_id}.{secrets.token_hex(4)}")
         self._lock_owner = False
         self._lock_until = 0.0            # monotonic lease horizon
         self._lock_renew_task = None
@@ -895,10 +898,35 @@ class Image:
         except RadosError:
             pass                 # already expired / broken: same end
 
-    async def break_lock(self, locker: str) -> None:
+    async def break_lock(self, locker: str,
+                         blocklist: bool = False) -> None:
         """Force-remove another client's lock (rbd lock break): for
         owners that died without a lease (or an operator who cannot
-        wait one out)."""
+        wait one out).  ``blocklist`` additionally fences the former
+        owner's client instance at the OSDs FIRST — the reference's
+        default for break: without it, the dead owner's in-flight
+        writes can land after the new owner takes over.  The locker
+        cookie carries the instance id ("entity:nonce") when the
+        lock was taken by this stack's acquire_exclusive_lock."""
+        if blocklist:
+            if "@" not in locker:
+                # nothing to fence: blocklisting the raw cookie would
+                # report success while the dead owner's in-flight
+                # writes still land — the exact window the flag
+                # exists to close
+                raise RBDError(
+                    f"locker {locker!r} carries no instance id; "
+                    f"break without --blocklist or fence manually")
+            ent = locker.split("@", 1)[0]
+            try:
+                r = await self.ioctx.rados.mon_command(
+                    "osd blocklist", action="add", entity=ent)
+                if r.get("rc") != 0:
+                    raise RBDError(
+                        f"blocklist of {ent!r} refused: {r}")
+            except RadosError as e:
+                raise RBDError(f"blocklist of {ent!r} failed: "
+                               f"{e}") from e
         try:
             await self.ioctx.exec(
                 self.header_oid, "lock", "unlock",

@@ -193,3 +193,40 @@ def test_readd_after_expiry_sticks():
         await victim.shutdown()
         await stop_cluster(mon, osds, admin)
     asyncio.run(run())
+
+
+def test_rbd_break_lock_blocklists():
+    """`rbd lock break --blocklist` fences the former owner's client
+    instance before removing the lock: its queued data writes land
+    on the floor, not on top of the new owner's (reference
+    break_lock + blocklist default)."""
+    from ceph_tpu.services.rbd import RBD
+
+    async def run():
+        mon, osds, admin = await start_cluster()
+        r = await admin.mon_command("osd pool create", pool="rbd",
+                                    pg_num=8, size=3)
+        assert r["rc"] == 0, r
+        owner = Rados({"a": "local://mon.a"}, fast_conf())
+        await owner.connect()
+        oio = await owner.open_ioctx("rbd")
+        rbd = RBD(oio)
+        await rbd.create("disk", 1 << 22)
+        img = await rbd.open("disk", exclusive=True)
+        await img.write(0, b"owner data")     # takes the lock
+        info = await img.lock_info()
+        locker = next(iter(info["lockers"]))
+        assert locker.startswith(owner.instance_id + "@")
+        # operator breaks the lock WITH fencing from another client
+        aio = await admin.open_ioctx("rbd")
+        admin_rbd = RBD(aio)
+        img2 = await admin_rbd.open("disk")
+        await img2.break_lock(locker, blocklist=True)
+        assert (await img2.lock_info()).get("lockers", {}) == {}
+        # the old owner's direct IO is fenced once maps propagate
+        await _wait_blocked(oio, "stray-probe", want=True)
+        await img2.close()
+        await img.close()
+        await owner.shutdown()
+        await stop_cluster(mon, osds, admin)
+    asyncio.run(run())

@@ -187,3 +187,47 @@ def test_backtrace_follows_promote_and_symlinks(tmp_path):
             await admin.shutdown()
             await cluster.stop()
     asyncio.run(run())
+
+
+def test_promote_repair_updates_backtrace(tmp_path):
+    """After scrub-repair promotes a remote, data-scan inject must
+    NOT resurrect the dead primary's name (review regression)."""
+    from ceph_tpu.common.admin_socket import admin_command
+
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+            "admin_socket_dir": str(tmp_path)})
+        await cluster.start()
+        admin = await cluster.client()
+        await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                                min_size=2)
+        await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                                min_size=2)
+        mds = await cluster.start_mds(name="a", block_size=4096)
+        conf = str(tmp_path / "c.json")
+        cluster.write_conf(conf)
+        try:
+            rc = await cluster.client("client.w")
+            fs = await CephFS.connect(rc)
+            await fs.mount()
+            await fs.write_file("/orig", b"payload")
+            await fs.link("/orig", "/mirror")
+            from ceph_tpu.client.rados import ObjectOperation
+            await mds.meta.operate(
+                dirfrag_oid(1), ObjectOperation().omap_rm(["orig"]))
+            await admin_command(mds.admin_socket.path,
+                                "scrub start", repair=True)
+            # inject must see /mirror as the backtraced home
+            code, rep = await run_tool(conf, "--block-size", "4096",
+                                       "inject")
+            assert rep["linked"] == [], rep
+            fs._dcache.clear()
+            with pytest.raises(Exception):
+                await fs.read_file("/orig")
+            assert await fs.read_file("/mirror") == b"payload"
+            await fs.unmount()
+            await rc.shutdown()
+        finally:
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())

@@ -270,3 +270,40 @@ def test_scrub_repair_promotes_dead_primary(tmp_path):
             await admin.shutdown()
             await cluster.stop()
     asyncio.run(run())
+
+
+def test_scrub_remote_with_dead_anchor_listing(tmp_path):
+    """An anchor record that neither lists the remote nor backs a
+    live primary must still be tabled and repaired (review
+    regression: the case fell through silently)."""
+    async def run():
+        cluster, admin, mds, rados, fs = await _fs_cluster(tmp_path)
+        try:
+            await fs.write_file("/f", b"data")
+            await fs.link("/f", "/r")
+            st = await fs.stat("/f")
+            # corrupt the anchor: keep the record but empty it
+            await mds._anchor_put(st["ino"], {"primary": None,
+                                              "remotes": []})
+            # and destroy the primary dentry
+            from ceph_tpu.client.rados import ObjectOperation
+            await mds.meta.operate(
+                dirfrag_oid(1), ObjectOperation().omap_rm(["f"]))
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start")
+            kinds = [d["damage_type"] for d in out["damage"]]
+            assert "dangling_remote" in kinds
+            await admin_command(mds.admin_socket.path,
+                                "scrub start", repair=True)
+            fs._dcache.clear()
+            with pytest.raises(Exception):
+                await fs.read_file("/r")
+            out = await admin_command(mds.admin_socket.path,
+                                      "scrub start")
+            assert out["damage"] == []
+        finally:
+            await fs.unmount()
+            await rados.shutdown()
+            await admin.shutdown()
+            await cluster.stop()
+    asyncio.run(run())
