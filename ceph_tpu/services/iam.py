@@ -33,6 +33,9 @@ KNOWN_ACTIONS = frozenset({
     "s3:PutObject", "s3:DeleteObject", "s3:DeleteObjectVersion",
     "s3:ListBucket", "s3:ListBucketVersions",
     "s3:ListBucketMultipartUploads", "s3:AbortMultipartUpload",
+    "s3:PutObjectRetention", "s3:GetObjectRetention",
+    "s3:PutObjectLegalHold", "s3:GetObjectLegalHold",
+    "s3:BypassGovernanceRetention",
 })
 
 

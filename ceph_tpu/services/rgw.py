@@ -905,6 +905,13 @@ class RGWLite:
     async def put_bucket_versioning(self, bucket: str,
                                     enabled: bool) -> None:
         meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        if not enabled and (meta.get("object_lock")
+                            or {}).get("enabled"):
+            # suspension would let the implicit-null overwrite path
+            # destroy WORM-protected data (S3 forbids it too)
+            raise RGWError("InvalidBucketState",
+                           "object-lock buckets cannot suspend "
+                           "versioning")
         meta["versioning"] = "enabled" if enabled else "suspended"
         await self._put_bucket_meta(bucket, meta)
 
@@ -1134,12 +1141,20 @@ class RGWLite:
                                                 version_id)
 
     async def delete_object_version(self, bucket: str, key: str,
-                                    version_id: str) -> None:
+                                    version_id: str,
+                                    bypass_governance: bool = False
+                                    ) -> None:
         """DELETE ?versionId=: permanently removes that version; when
         it was current, the next-newest version is promoted (markers
-        included)."""
+        included).  Object-lock retention and legal holds block this
+        (markers never do — they destroy no data); GOVERNANCE yields
+        to ``bypass_governance`` only when the caller also holds
+        s3:BypassGovernanceRetention."""
         meta = await self._check_bucket(
             bucket, "WRITE", action="s3:DeleteObjectVersion", key=key)
+        if bypass_governance:
+            bypass_governance = await self._bypass_allowed(bucket,
+                                                           key)
         vkey = self._vkey(key, version_id)
         try:
             kv = await self.ioctx.get_omap(self._versions_oid(bucket),
@@ -1155,6 +1170,10 @@ class RGWLite:
                 e = json.loads(cur[key])
                 if not e.get("version_id") \
                         and not e.get("delete_marker"):
+                    why = self._lock_blocks_delete(
+                        e, bypass_governance)
+                    if why:
+                        raise RGWError("AccessDenied", why)
                     await self._remove_entry_data(bucket, key, e)
                     await self._index_rm(bucket, meta, key)
                     await self._log(bucket, "del-version", key)
@@ -1162,6 +1181,10 @@ class RGWLite:
         if not kv:
             raise RGWError("NoSuchVersion", f"{key}@{version_id}")
         entry = json.loads(next(iter(kv.values())))
+        if not entry.get("delete_marker"):
+            why = self._lock_blocks_delete(entry, bypass_governance)
+            if why:
+                raise RGWError("AccessDenied", why)
         await self._remove_entry_data(bucket, key, entry)
         await self.ioctx.rm_omap_keys(self._versions_oid(bucket),
                                       [vkey])
@@ -1197,10 +1220,17 @@ class RGWLite:
     async def initiate_multipart(self, bucket: str, key: str,
                                  content_type: str =
                                  "binary/octet-stream",
-                                 metadata: dict | None = None) -> str:
-        """S3 CreateMultipartUpload -> upload id."""
-        await self._check_bucket(bucket, "WRITE",
-                                 action="s3:PutObject", key=key)
+                                 metadata: dict | None = None,
+                                 lock: dict | None = None) -> str:
+        """S3 CreateMultipartUpload -> upload id.  ``lock``: object
+        -lock headers ride the INITIATE (S3 applies them to the
+        assembled object at complete)."""
+        meta = await self._check_bucket(bucket, "WRITE",
+                                       action="s3:PutObject", key=key)
+        if lock:
+            # validate now: a bad mode must fail the initiate, not
+            # the complete after every part is uploaded
+            self._stage_lock({"meta": meta}, lock)
         upload_id = secrets.token_hex(8)
         await self.ioctx.operate(
             self._mp_meta_oid(bucket, key, upload_id),
@@ -1210,6 +1240,7 @@ class RGWLite:
                     "content_type": content_type,
                     "meta": dict(metadata or {}),
                     "owner": self.user or "",
+                    "lock": lock,
                 }).encode(),
             }),
         )
@@ -1394,6 +1425,15 @@ class RGWLite:
         }
         if entry_sse is not None:
             entry["sse"] = entry_sse
+        # WORM state for the ASSEMBLED object: initiate-time headers
+        # or the bucket default (the buffered/streaming paths stage
+        # this in _prepare_put; multipart assembles its own entry)
+        lock_ctx = {"meta": bucket_meta}
+        self._stage_lock(lock_ctx, info.get("lock"))
+        if lock_ctx.get("lock_retention"):
+            entry["retention"] = lock_ctx["lock_retention"]
+        if lock_ctx.get("lock_legal_hold"):
+            entry["legal_hold"] = True
         if versioned:
             # the assembled object is a NEW version; prior current
             # (incl. pre-versioning 'null') survives as history
@@ -1455,6 +1495,220 @@ class RGWLite:
             key, _, upload_id = rest.rpartition(".")
             out.append({"key": key, "upload_id": upload_id})
         return sorted(out, key=lambda u: (u["key"], u["upload_id"]))
+
+    # -- S3 Object Lock (rgw_object_lock.cc: WORM retention) --------------
+    _LOCK_MODES = ("GOVERNANCE", "COMPLIANCE")
+
+    async def put_object_lock_config(self, bucket: str,
+                                     mode: str | None = None,
+                                     days: int = 0,
+                                     years: int = 0) -> None:
+        """PutObjectLockConfiguration: the bucket DEFAULT retention
+        new versions inherit.  Only valid on buckets created with
+        object lock (S3's InvalidBucketState rule)."""
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        if not (meta.get("object_lock") or {}).get("enabled"):
+            raise RGWError("InvalidBucketState",
+                           "bucket was not created with object lock")
+        cfg: dict = {"enabled": True}
+        if mode is not None:
+            if mode not in self._LOCK_MODES:
+                raise RGWError("MalformedXML", f"bad mode {mode!r}")
+            if bool(days) == bool(years):
+                raise RGWError("MalformedXML",
+                               "exactly one of days/years")
+            if (days or years) <= 0:
+                raise RGWError("InvalidArgument",
+                               "retention period must be positive")
+            cfg["mode"] = mode
+            cfg["days"] = int(days)
+            cfg["years"] = int(years)
+        meta["object_lock"] = cfg
+        await self._put_bucket_meta(bucket, meta)
+
+    async def get_object_lock_config(self, bucket: str) -> dict:
+        meta = await self._check_bucket(bucket, "READ")
+        cfg = meta.get("object_lock")
+        if not cfg:
+            raise RGWError("ObjectLockConfigurationNotFoundError",
+                           bucket)
+        return dict(cfg)
+
+    def _default_retention_until(self, meta: dict) -> dict | None:
+        cfg = meta.get("object_lock") or {}
+        if not cfg.get("mode"):
+            return None
+        period = (cfg.get("days", 0) * 86400
+                  + cfg.get("years", 0) * 365 * 86400)
+        return {"mode": cfg["mode"], "until": time.time() + period}
+
+    async def _lock_entry(self, bucket: str, key: str,
+                          version_id: str | None,
+                          need: str = "WRITE",
+                          action: str = "s3:PutObjectRetention"):
+        """(entry, write-back) for the version a lock op targets:
+        current index entry when no version_id, else the version
+        record.  write-back persists a mutated entry to BOTH the
+        version table and (when current) the index."""
+        meta = await self._check_bucket(bucket, need, action=action,
+                                       key=key)
+        if not (meta.get("object_lock") or {}).get("enabled"):
+            raise RGWError("InvalidRequest",
+                           "bucket has no object lock")
+        kv = await self._index_get(bucket, key, meta)
+        cur = json.loads(kv[key]) if key in kv else None
+        if version_id is None:
+            if cur is None or cur.get("delete_marker"):
+                raise RGWError("NoSuchKey", f"{bucket}/{key}")
+            entry = cur
+        else:
+            try:
+                recs = await self.ioctx.get_omap(
+                    self._versions_oid(bucket),
+                    [self._vkey(key, version_id)])
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+                recs = {}
+            if not recs:
+                raise RGWError("NoSuchVersion",
+                               f"{key}@{version_id}")
+            entry = json.loads(next(iter(recs.values())))
+
+        async def write_back(e: dict) -> None:
+            vid = e.get("version_id")
+            if vid:
+                await self.ioctx.set_omap(
+                    self._versions_oid(bucket),
+                    {self._vkey(key, vid): json.dumps(e).encode()})
+            if cur is not None and cur.get("version_id") \
+                    == e.get("version_id"):
+                await self._index_set(bucket, meta, key,
+                                      json.dumps(e).encode())
+        return entry, write_back
+
+    async def put_object_retention(self, bucket: str, key: str,
+                                   mode: str, until: float,
+                                   version_id: str | None = None,
+                                   bypass_governance: bool = False
+                                   ) -> None:
+        """PutObjectRetention.  COMPLIANCE can never be shortened or
+        downgraded; GOVERNANCE changes that loosen protection need
+        the bypass flag (s3:BypassGovernanceRetention role)."""
+        if mode not in self._LOCK_MODES:
+            raise RGWError("MalformedXML", f"bad mode {mode!r}")
+        if until <= time.time():
+            raise RGWError("InvalidArgument",
+                           "retain-until must be in the future")
+        entry, write_back = await self._lock_entry(bucket, key,
+                                                   version_id)
+        old = entry.get("retention")
+        if old:
+            loosens = (until < float(old["until"])
+                       or (old["mode"] == "COMPLIANCE"
+                           and mode != "COMPLIANCE"))
+            if loosens and old["mode"] == "COMPLIANCE":
+                raise RGWError("AccessDenied",
+                               "COMPLIANCE retention cannot be "
+                               "loosened")
+            if loosens and not (
+                    bypass_governance
+                    and await self._bypass_allowed(bucket, key)):
+                raise RGWError("AccessDenied",
+                               "governance bypass required")
+        entry["retention"] = {"mode": mode, "until": float(until)}
+        await write_back(entry)
+
+    async def get_object_retention(self, bucket: str, key: str,
+                                   version_id: str | None = None
+                                   ) -> dict:
+        entry, _ = await self._lock_entry(
+            bucket, key, version_id, need="READ",
+            action="s3:GetObjectRetention")
+        ret = entry.get("retention")
+        if not ret:
+            raise RGWError("NoSuchObjectLockConfiguration", key)
+        return dict(ret)
+
+    async def put_object_legal_hold(self, bucket: str, key: str,
+                                    status: bool,
+                                    version_id: str | None = None
+                                    ) -> None:
+        entry, write_back = await self._lock_entry(
+            bucket, key, version_id,
+            action="s3:PutObjectLegalHold")
+        entry["legal_hold"] = bool(status)
+        await write_back(entry)
+
+    async def get_object_legal_hold(self, bucket: str, key: str,
+                                    version_id: str | None = None
+                                    ) -> str:
+        entry, _ = await self._lock_entry(
+            bucket, key, version_id, need="READ",
+            action="s3:GetObjectLegalHold")
+        return "ON" if entry.get("legal_hold") else "OFF"
+
+    def _stage_lock(self, ctx: dict, lock: dict | None) -> None:
+        """Resolve the new version's lock state into the put ctx:
+        explicit headers win, else the bucket default retention.
+        Explicit lock state on a bucket without object lock is an
+        InvalidRequest, as S3 refuses it."""
+        meta = ctx.get("meta") or {}
+        enabled = (meta.get("object_lock") or {}).get("enabled")
+        if lock:
+            if not enabled:
+                raise RGWError("InvalidRequest",
+                               "bucket has no object lock")
+            if lock.get("mode"):
+                if lock["mode"] not in self._LOCK_MODES:
+                    raise RGWError("InvalidArgument",
+                                   f"bad mode {lock['mode']!r}")
+                until = float(lock.get("until", 0))
+                if until <= time.time():
+                    raise RGWError("InvalidArgument",
+                                   "retain-until must be in the "
+                                   "future")
+                ctx["lock_retention"] = {"mode": lock["mode"],
+                                         "until": until}
+            if lock.get("legal_hold"):
+                ctx["lock_legal_hold"] = True
+        if enabled and "lock_retention" not in ctx:
+            # the bucket default applies whenever no EXPLICIT
+            # retention came with the put — a legal-hold header must
+            # not suppress a COMPLIANCE default
+            default = self._default_retention_until(meta)
+            if default:
+                ctx["lock_retention"] = default
+
+    async def _bypass_allowed(self, bucket: str, key: str) -> bool:
+        """A requested governance bypass only counts when the caller
+        holds s3:BypassGovernanceRetention (owner ACL or policy) —
+        otherwise the header is a no-op, as S3 treats it."""
+        try:
+            await self._check_bucket(
+                bucket, "WRITE",
+                action="s3:BypassGovernanceRetention", key=key)
+            return True
+        except RGWError:
+            return False
+
+    @staticmethod
+    def _lock_blocks_delete(entry: dict,
+                            bypass_governance: bool) -> str | None:
+        """Why a permanent delete of this version is forbidden, or
+        None.  Delete MARKERS are never blocked — they destroy no
+        data (S3 semantics)."""
+        if entry.get("legal_hold"):
+            return "version is under legal hold"
+        ret = entry.get("retention")
+        if ret and float(ret["until"]) > time.time():
+            if ret["mode"] == "COMPLIANCE":
+                return "COMPLIANCE retention until " \
+                    f"{ret['until']:.0f}"
+            if not bypass_governance:
+                return "GOVERNANCE retention until " \
+                    f"{ret['until']:.0f} (bypass required)"
+        return None
 
     # -- lifecycle (rgw_lc.cc: expiration rules + the LC worker) ----------
     _LC_ACTIONS = ("expiration_days", "expiration_seconds",
@@ -1623,8 +1877,13 @@ class RGWLite:
                                for k, t in want.items()):
                             continue
                     if since > limit:
-                        await sys_self.delete_object_version(
-                            bucket, key, v["version_id"])
+                        try:
+                            await sys_self.delete_object_version(
+                                bucket, key, v["version_id"])
+                        except RGWError as err:
+                            if err.code != "AccessDenied":
+                                raise
+                            break   # object-lock protected: skip
                         got.append(f"{key}@{v['version_id']}")
                         break
 
@@ -2086,7 +2345,11 @@ class RGWLite:
             json.dumps({"upto": upto}).encode(),
         )
 
-    async def create_bucket(self, bucket: str) -> None:
+    async def create_bucket(self, bucket: str,
+                            object_lock: bool = False) -> None:
+        """``object_lock``: WORM bucket (rgw_object_lock role) —
+        versioning is enabled atomically with it, as S3 requires;
+        the flag cannot be added to an existing bucket."""
         if self.user == ANONYMOUS:
             raise RGWError("AccessDenied", "anonymous cannot create")
         if not bucket or any(ord(c) < 0x20 for c in bucket):
@@ -2094,13 +2357,18 @@ class RGWLite:
         existing = await self.list_buckets()
         if bucket in existing:
             raise RGWError("BucketAlreadyExists", bucket)
+        meta = {
+            "created": time.time(),
+            "owner": self.user or "",
+            "acl": {"canned": "private"},
+        }
+        if object_lock:
+            meta["object_lock"] = {"enabled": True}
+            meta["versioning"] = "enabled"
         await self.ioctx.operate(BUCKETS_OID, ObjectOperation()
                                  .create()
-                                 .omap_set({bucket: json.dumps({
-                                     "created": time.time(),
-                                     "owner": self.user or "",
-                                     "acl": {"canned": "private"},
-                                 }).encode()}))
+                                 .omap_set({bucket: json.dumps(
+                                     meta).encode()}))
         await self.ioctx.operate(self._index_oid(bucket),
                                  ObjectOperation().create())
         # a recreated name must not inherit the old bucket's configs
@@ -2151,7 +2419,8 @@ class RGWLite:
 
     async def _prepare_put(self, bucket: str, key: str, length: int,
                            if_none_match: bool,
-                           defer_cleanup: bool = False) -> dict:
+                           defer_cleanup: bool = False,
+                           lock: dict | None = None) -> dict:
         """Everything a PUT decides BEFORE any body byte lands: ACL,
         preconditions, quota (against the declared length), versioning
         mode, target oid, and old-data cleanup.  Shared by the buffered
@@ -2233,11 +2502,16 @@ class RGWLite:
             # leak.  Unique per-write tail oids (the reference's tail
             # tag) make deferral safe for every shape.
             oid = f"{oid}\x00g\x00{secrets.token_hex(8)}"
-        return {"bucket": bucket, "key": key, "oid": oid,
-                "index_oid": index_oid, "versioned": versioned,
-                "suspended": suspended, "version_id": version_id,
-                "deferred_cleanup": deferred, "meta": meta,
-                "compression": meta.get("compression")}
+        ctx = {"bucket": bucket, "key": key, "oid": oid,
+               "index_oid": index_oid, "versioned": versioned,
+               "suspended": suspended, "version_id": version_id,
+               "deferred_cleanup": deferred, "meta": meta,
+               "compression": meta.get("compression")}
+        # EVERY put shape flows through here — buffered, streaming,
+        # multipart complete, SLO — so WORM state cannot be dodged
+        # by picking a body size (the streaming-path hole)
+        self._stage_lock(ctx, lock)
+        return ctx
 
     async def put_slo_manifest(self, bucket: str, key: str,
                                segments: list[dict],
@@ -2293,14 +2567,15 @@ class RGWLite:
     async def begin_put(self, bucket: str, key: str, length: int,
                         content_type: str = "binary/octet-stream",
                         metadata: dict[str, str] | None = None,
-                        if_none_match: bool = False) -> "StreamingPut":
+                        if_none_match: bool = False,
+                        lock: dict | None = None) -> "StreamingPut":
         """Chunked S3 PUT session (the beast frontend's streaming body
         path): validation happens up front against the declared length,
         then body chunks land at their striper offsets without ever
         buffering the whole object."""
         ctx = await self._prepare_put(bucket, key, length,
                                       if_none_match,
-                                      defer_cleanup=True)
+                                      defer_cleanup=True, lock=lock)
         return StreamingPut(self, ctx, length, content_type,
                             dict(metadata or {}))
 
@@ -2309,14 +2584,17 @@ class RGWLite:
                          metadata: dict[str, str] | None = None,
                          if_none_match: bool = False,
                          sse_key: bytes | None = None,
-                         tags: dict[str, str] | None = None) -> dict:
+                         tags: dict[str, str] | None = None,
+                         lock: dict | None = None) -> dict:
         """S3 PUT. ``if_none_match``: fail when the key exists ('*').
         ``sse_key``: SSE-C customer key (32 bytes, AES-256).
-        ``tags``: object tags (the x-amz-tagging header)."""
+        ``tags``: object tags (the x-amz-tagging header).
+        ``lock``: explicit object-lock state for the new version:
+        {mode, until, legal_hold} (x-amz-object-lock-* headers)."""
         if tags:
             self.validate_tags(tags)
         ctx = await self._prepare_put(bucket, key, len(data),
-                                      if_none_match)
+                                      if_none_match, lock=lock)
         etag = hashlib.md5(data).hexdigest()
         size = len(data)
         comp = None
@@ -2371,6 +2649,10 @@ class RGWLite:
             entry["slo"] = True
         if tags:
             entry["tags"] = {str(k): str(v) for k, v in tags.items()}
+        if ctx.get("lock_retention"):
+            entry["retention"] = ctx["lock_retention"]
+        if ctx.get("lock_legal_hold"):
+            entry["legal_hold"] = True
         if versioned:
             entry["version_id"] = version_id
             await self._record_version(bucket, key, entry)

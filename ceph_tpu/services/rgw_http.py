@@ -24,6 +24,7 @@ path the rest of the framework (multisite sync, radosgw-admin) uses.
 from __future__ import annotations
 
 import asyncio
+import calendar
 import hashlib
 import hmac
 import math
@@ -62,6 +63,9 @@ _STATUS = {
     "NoSuchLifecycleConfiguration": 404,
     "NoSuchBucketPolicy": 404,
     "NoSuchCORSConfiguration": 404,
+    "ObjectLockConfigurationNotFoundError": 404,
+    "InvalidBucketState": 409,
+    "NoSuchObjectLockConfiguration": 404,
     "MalformedPolicy": 400,
     "BucketNotEmpty": 409,
     "BucketAlreadyExists": 409,
@@ -370,7 +374,8 @@ class S3Frontend:
         if len(parts) < 2 or not parts[1]:
             return False                # not an object-level request
         blocked = {"partNumber", "uploadId", "acl", "versioning",
-                   "lifecycle", "tagging", "notification", "delete"}
+                   "lifecycle", "tagging", "notification", "delete",
+                   "retention", "legal-hold", "object-lock"}
         if blocked & set(req.query):
             return False
         if req.header("x-amz-copy-source"):
@@ -740,7 +745,15 @@ class S3Frontend:
                                         "events": events})
                 await gw.set_bucket_notifications(bucket, configs)
                 return 200, {}, b""
-            await gw.create_bucket(bucket)
+            if "object-lock" in q:
+                mode, days, years = _parse_lock_config(req.body)
+                await gw.put_object_lock_config(bucket, mode,
+                                                days=days,
+                                                years=years)
+                return 200, {}, b""
+            await gw.create_bucket(bucket, object_lock=req.header(
+                "x-amz-bucket-object-lock-enabled",
+                "").lower() == "true")
             return 200, {"location": f"/{bucket}"}, b""
         if req.method == "DELETE":
             if "cors" in q:
@@ -797,6 +810,20 @@ class S3Frontend:
                 u = ET.SubElement(root, "Upload")
                 ET.SubElement(u, "Key").text = up["key"]
                 ET.SubElement(u, "UploadId").text = up["upload_id"]
+            return self._xml(root)
+        if "object-lock" in q:
+            cfg = await gw.get_object_lock_config(bucket)
+            root = ET.Element("ObjectLockConfiguration", xmlns=XMLNS)
+            ET.SubElement(root, "ObjectLockEnabled").text = "Enabled"
+            if cfg.get("mode"):
+                rule = ET.SubElement(root, "Rule")
+                dr = ET.SubElement(rule, "DefaultRetention")
+                ET.SubElement(dr, "Mode").text = cfg["mode"]
+                if cfg.get("days"):
+                    ET.SubElement(dr, "Days").text = str(cfg["days"])
+                if cfg.get("years"):
+                    ET.SubElement(dr, "Years").text = \
+                        str(cfg["years"])
             return self._xml(root)
         if "lifecycle" in q:
             rules = await gw.get_lifecycle(bucket)
@@ -949,6 +976,7 @@ class S3Frontend:
                     content_type=req.header("content-type",
                                             "binary/octet-stream"),
                     metadata=_meta_headers(req),
+                    lock=_lock_headers(req),
                 )
                 root = ET.Element("InitiateMultipartUploadResult",
                                   xmlns=XMLNS)
@@ -975,6 +1003,21 @@ class S3Frontend:
             if "tagging" in q:
                 await gw.put_object_tagging(
                     bucket, key, _parse_tagging(req.body),
+                    version_id=q.get("versionId"))
+                return 200, {}, b""
+            if "retention" in q:
+                mode, until = _parse_retention(req.body)
+                await gw.put_object_retention(
+                    bucket, key, mode, until,
+                    version_id=q.get("versionId"),
+                    bypass_governance=req.header(
+                        "x-amz-bypass-governance-retention",
+                        "").lower() == "true")
+                return 200, {}, b""
+            if "legal-hold" in q:
+                status = _parse_legal_hold(req.body)
+                await gw.put_object_legal_hold(
+                    bucket, key, status,
                     version_id=q.get("versionId"))
                 return 200, {}, b""
             if "partNumber" in q and "uploadId" in q:
@@ -1052,6 +1095,7 @@ class S3Frontend:
                     metadata=_meta_headers(req),
                     if_none_match=req.header("if-none-match") == "*",
                     sse_key=sse_key,
+                    lock=_lock_headers(req),
                     tags=htags,
                 )
             hdrs = {"etag": f'"{out["etag"]}"'}
@@ -1070,12 +1114,30 @@ class S3Frontend:
                 await gw.abort_multipart(bucket, key, q["uploadId"])
                 return 204, {}, b""
             if "versionId" in q:
-                await gw.delete_object_version(bucket, key,
-                                               q["versionId"])
+                await gw.delete_object_version(
+                    bucket, key, q["versionId"],
+                    bypass_governance=req.header(
+                        "x-amz-bypass-governance-retention",
+                        "").lower() == "true")
                 return 204, {}, b""
             await gw.delete_object(bucket, key)
             return 204, {}, b""
         if req.method in ("GET", "HEAD"):
+            if "retention" in q and req.method == "GET":
+                ret = await gw.get_object_retention(
+                    bucket, key, version_id=q.get("versionId"))
+                root = ET.Element("Retention", xmlns=XMLNS)
+                ET.SubElement(root, "Mode").text = ret["mode"]
+                ET.SubElement(root, "RetainUntilDate").text = \
+                    time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                  time.gmtime(ret["until"]))
+                return self._xml(root)
+            if "legal-hold" in q and req.method == "GET":
+                st = await gw.get_object_legal_hold(
+                    bucket, key, version_id=q.get("versionId"))
+                root = ET.Element("LegalHold", xmlns=XMLNS)
+                ET.SubElement(root, "Status").text = st
+                return self._xml(root)
             if "tagging" in q and req.method == "GET":
                 tags = await gw.get_object_tagging(
                     bucket, key, version_id=q.get("versionId"))
@@ -1324,6 +1386,70 @@ def _parse_cors(body: bytes) -> list[dict]:
             rule["max_age_seconds"] = int(age)
         rules.append(rule)
     return rules
+
+
+def _lock_headers(req: _Request) -> dict | None:
+    """x-amz-object-lock-{mode,retain-until-date,legal-hold} on PUT
+    object: the new version's explicit lock state."""
+    mode = req.header("x-amz-object-lock-mode")
+    raw = req.header("x-amz-object-lock-retain-until-date")
+    hold = req.header("x-amz-object-lock-legal-hold", "").upper()
+    if not mode and not raw and not hold:
+        return None
+    lock: dict = {}
+    if mode or raw:
+        if not (mode and raw):
+            raise _HTTPError(400, "InvalidArgument",
+                             "mode and retain-until-date go "
+                             "together")
+        try:
+            until = calendar.timegm(time.strptime(
+                raw.split(".")[0].rstrip("Z"), "%Y-%m-%dT%H:%M:%S"))
+        except ValueError:
+            raise _HTTPError(400, "InvalidArgument",
+                             f"bad retain-until-date {raw!r}")
+        lock["mode"] = mode
+        lock["until"] = float(until)
+    if hold == "ON":
+        lock["legal_hold"] = True
+    return lock
+
+
+def _parse_retention(body: bytes) -> tuple[str, float]:
+    doc = ET.fromstring(body.decode())
+    mode = doc.findtext(_ns("Mode")) or doc.findtext("Mode") or ""
+    raw = doc.findtext(_ns("RetainUntilDate")) or \
+        doc.findtext("RetainUntilDate") or ""
+    try:
+        until = calendar.timegm(time.strptime(
+            raw.split(".")[0].rstrip("Z"), "%Y-%m-%dT%H:%M:%S"))
+    except ValueError:
+        raise _HTTPError(400, "MalformedXML",
+                         f"bad RetainUntilDate {raw!r}")
+    return mode, float(until)
+
+
+def _parse_legal_hold(body: bytes) -> bool:
+    doc = ET.fromstring(body.decode())
+    st = (doc.findtext(_ns("Status")) or doc.findtext("Status")
+          or "").upper()
+    if st not in ("ON", "OFF"):
+        raise _HTTPError(400, "MalformedXML", f"bad status {st!r}")
+    return st == "ON"
+
+
+def _parse_lock_config(body: bytes) -> tuple[str | None, int, int]:
+    doc = ET.fromstring(body.decode())
+    dr = doc.find(f"{_ns('Rule')}/{_ns('DefaultRetention')}")
+    if dr is None:
+        dr = doc.find("Rule/DefaultRetention")
+    if dr is None:
+        return None, 0, 0
+    mode = dr.findtext(_ns("Mode")) or dr.findtext("Mode") or ""
+    days = int(dr.findtext(_ns("Days")) or dr.findtext("Days") or 0)
+    years = int(dr.findtext(_ns("Years"))
+                or dr.findtext("Years") or 0)
+    return mode, days, years
 
 
 def _parse_tagging(body: bytes) -> dict[str, str]:

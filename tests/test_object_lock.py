@@ -1,0 +1,254 @@
+"""S3 Object Lock (reference rgw/rgw_object_lock.{h,cc} + the
+RGWPutObjRetention/RGWPutObjLegalHold ops): WORM buckets — versioning
+enabled atomically at creation, default retention inherited by new
+versions, per-version retention/legal holds, and permanent-delete
+enforcement (COMPLIANCE immutable, GOVERNANCE bypassable, markers
+always allowed)."""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _gw(rados):
+    await rados.pool_create("rgw", pg_num=8)
+    ioctx = await rados.open_ioctx("rgw")
+    users = RGWUsers(ioctx)
+    alice = await users.create("alice")
+    return RGWLite(ioctx, users=users).as_user("alice"), alice
+
+
+def test_object_lock_lifecycle():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, _ = await _gw(rados)
+            await gw.create_bucket("vault", object_lock=True)
+            # lock implies versioning, which cannot be suspended
+            assert await gw.get_bucket_versioning("vault") \
+                == "enabled"
+            with pytest.raises(RGWError) as ei:
+                await gw.put_bucket_versioning("vault", False)
+            assert ei.value.code == "InvalidBucketState"
+            # config on a non-lock bucket refuses
+            await gw.create_bucket("plain")
+            with pytest.raises(RGWError) as ei:
+                await gw.put_object_lock_config("plain",
+                                                "GOVERNANCE", days=1)
+            assert ei.value.code == "InvalidBucketState"
+            # default retention config round-trips
+            await gw.put_object_lock_config("vault", "GOVERNANCE",
+                                            days=30)
+            cfg = await gw.get_object_lock_config("vault")
+            assert cfg["mode"] == "GOVERNANCE" and cfg["days"] == 30
+            with pytest.raises(RGWError):
+                await gw.put_object_lock_config("vault", "BAD",
+                                                days=1)
+            with pytest.raises(RGWError):
+                await gw.put_object_lock_config("vault",
+                                                "COMPLIANCE",
+                                                days=1, years=1)
+            # new versions inherit the default retention
+            out = await gw.put_object("vault", "doc", b"v1")
+            ret = await gw.get_object_retention("vault", "doc")
+            assert ret["mode"] == "GOVERNANCE"
+            assert ret["until"] > time.time() + 29 * 86400
+            # permanent delete: blocked without bypass, OK with
+            with pytest.raises(RGWError) as ei:
+                await gw.delete_object_version(
+                    "vault", "doc", out["version_id"])
+            assert ei.value.code == "AccessDenied"
+            # a delete MARKER is always allowed (destroys no data)
+            await gw.delete_object("vault", "doc")
+            vs = await gw.list_object_versions("vault")
+            assert any(v["delete_marker"] for v in vs)
+            await gw.delete_object_version(
+                "vault", "doc", out["version_id"],
+                bypass_governance=True)
+            assert [v for v in
+                    await gw.list_object_versions("vault")
+                    if not v["delete_marker"]] == []
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_compliance_and_legal_hold():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, _ = await _gw(rados)
+            await gw.create_bucket("vault", object_lock=True)
+            until = time.time() + 3600
+            out = await gw.put_object(
+                "vault", "evidence", b"immutable",
+                lock={"mode": "COMPLIANCE", "until": until})
+            # COMPLIANCE: bypass does NOT help
+            with pytest.raises(RGWError) as ei:
+                await gw.delete_object_version(
+                    "vault", "evidence", out["version_id"],
+                    bypass_governance=True)
+            assert "COMPLIANCE" in str(ei.value)
+            # cannot shorten or downgrade
+            with pytest.raises(RGWError):
+                await gw.put_object_retention(
+                    "vault", "evidence", "GOVERNANCE",
+                    time.time() + 7200,
+                    version_id=out["version_id"],
+                    bypass_governance=True)
+            # extending is allowed
+            await gw.put_object_retention(
+                "vault", "evidence", "COMPLIANCE", until + 3600,
+                version_id=out["version_id"])
+            # legal hold blocks independently of retention
+            out2 = await gw.put_object("vault", "hold-me", b"x",
+                                       lock={"legal_hold": True})
+            assert await gw.get_object_legal_hold(
+                "vault", "hold-me") == "ON"
+            with pytest.raises(RGWError) as ei:
+                await gw.delete_object_version(
+                    "vault", "hold-me", out2["version_id"],
+                    bypass_governance=True)
+            assert "legal hold" in str(ei.value)
+            await gw.put_object_legal_hold("vault", "hold-me",
+                                           False)
+            await gw.delete_object_version(
+                "vault", "hold-me", out2["version_id"])
+            # explicit lock state on a plain bucket refuses
+            await gw.create_bucket("plain")
+            with pytest.raises(RGWError) as ei:
+                await gw.put_object("plain", "x", b"y",
+                                    lock={"legal_hold": True})
+            assert ei.value.code == "InvalidRequest"
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_lifecycle_skips_locked_versions():
+    """The LC worker's noncurrent pass must step around WORM-held
+    versions instead of erroring or deleting them."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, _ = await _gw(rados)
+            await gw.create_bucket("vault", object_lock=True)
+            out1 = await gw.put_object(
+                "vault", "doc", b"v1",
+                lock={"mode": "COMPLIANCE",
+                      "until": time.time() + 10 ** 6})
+            await asyncio.sleep(0.02)
+            await gw.put_object("vault", "doc", b"v2")
+            t_super = time.time()
+            await gw.put_lifecycle("vault", [
+                {"id": "nc", "prefix": "", "status": "Enabled",
+                 "noncurrent_seconds": 10}])
+            removed = await gw.lc_process(now=t_super + 3600)
+            assert removed == {}            # held version survived
+            vs = await gw.list_object_versions("vault")
+            assert len([v for v in vs
+                        if not v["delete_marker"]]) == 2
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_lock_covers_every_put_shape():
+    """WORM staging rides _prepare_put, so streaming PUTs, multipart
+    completes, and copies inherit the bucket default too — a body
+    size must not pick protection off (review regression)."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, _ = await _gw(rados)
+            await gw.create_bucket("vault", object_lock=True)
+            await gw.put_object_lock_config("vault", "COMPLIANCE",
+                                            days=30)
+            # streaming put
+            sp = await gw.begin_put("vault", "stream", 1 << 20)
+            await sp.write(b"S" * (1 << 20))
+            out = await sp.complete()
+            ret = await gw.get_object_retention("vault", "stream")
+            assert ret["mode"] == "COMPLIANCE"
+            with pytest.raises(RGWError):
+                await gw.delete_object_version(
+                    "vault", "stream", out["version_id"],
+                    bypass_governance=True)
+            # multipart
+            up = await gw.initiate_multipart("vault", "mp")
+            await gw.upload_part("vault", "mp", up, 1,
+                                 b"M" * (5 << 20))
+            parts = await gw.list_parts("vault", "mp", up)
+            done = await gw.complete_multipart(
+                "vault", "mp", up,
+                [(p["part_number"], p["etag"]) for p in parts])
+            ret = await gw.get_object_retention("vault", "mp")
+            assert ret["mode"] == "COMPLIANCE"
+            # copy into the vault
+            await gw.create_bucket("src")
+            await gw.put_object("src", "o", b"copy me")
+            await gw.copy_object("src", "o", "vault", "copied")
+            ret = await gw.get_object_retention("vault", "copied")
+            assert ret["mode"] == "COMPLIANCE"
+            # legal-hold-only header must NOT suppress the default
+            out = await gw.put_object("vault", "held", b"x",
+                                      lock={"legal_hold": True})
+            ret = await gw.get_object_retention("vault", "held")
+            assert ret["mode"] == "COMPLIANCE"
+            await gw.put_object_legal_hold("vault", "held", False)
+            with pytest.raises(RGWError):
+                await gw.delete_object_version(
+                    "vault", "held", out["version_id"],
+                    bypass_governance=True)
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_governance_bypass_needs_permission():
+    """The bypass header is inert without
+    s3:BypassGovernanceRetention — a policy Deny turns GOVERNANCE
+    into a real lock even for writers (review regression)."""
+    import time as _t
+
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, _ = await _gw(rados)
+            await gw.create_bucket("vault", object_lock=True)
+            out = await gw.put_object(
+                "vault", "doc", b"x",
+                lock={"mode": "GOVERNANCE",
+                      "until": _t.time() + 3600})
+            await gw.put_bucket_policy("vault", {
+                "Version": "2012-10-17",
+                "Statement": [{
+                    "Effect": "Deny", "Principal": "*",
+                    "Action": "s3:BypassGovernanceRetention",
+                    "Resource": "arn:aws:s3:::vault/*",
+                }],
+            })
+            with pytest.raises(RGWError) as ei:
+                await gw.delete_object_version(
+                    "vault", "doc", out["version_id"],
+                    bypass_governance=True)
+            assert ei.value.code == "AccessDenied"
+            await gw.delete_bucket_policy("vault")
+            await gw.delete_object_version(
+                "vault", "doc", out["version_id"],
+                bypass_governance=True)
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
