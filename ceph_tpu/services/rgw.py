@@ -1429,7 +1429,8 @@ class RGWLite:
         # or the bucket default (the buffered/streaming paths stage
         # this in _prepare_put; multipart assembles its own entry)
         lock_ctx = {"meta": bucket_meta}
-        self._stage_lock(lock_ctx, info.get("lock"))
+        self._stage_lock(lock_ctx, info.get("lock"),
+                         validate=False)
         if lock_ctx.get("lock_retention"):
             entry["retention"] = lock_ctx["lock_retention"]
         if lock_ctx.get("lock_legal_hold"):
@@ -1574,6 +1575,11 @@ class RGWLite:
                 raise RGWError("NoSuchVersion",
                                f"{key}@{version_id}")
             entry = json.loads(next(iter(recs.values())))
+            if entry.get("delete_marker"):
+                # S3 answers 405: a marker destroys no data, so
+                # "protection" on one would be a lie nothing enforces
+                raise RGWError("MethodNotAllowed",
+                               "object lock on a delete marker")
 
         async def write_back(e: dict) -> None:
             vid = e.get("version_id")
@@ -1648,11 +1654,15 @@ class RGWLite:
             action="s3:GetObjectLegalHold")
         return "ON" if entry.get("legal_hold") else "OFF"
 
-    def _stage_lock(self, ctx: dict, lock: dict | None) -> None:
+    def _stage_lock(self, ctx: dict, lock: dict | None,
+                    validate: bool = True) -> None:
         """Resolve the new version's lock state into the put ctx:
         explicit headers win, else the bucket default retention.
         Explicit lock state on a bucket without object lock is an
-        InvalidRequest, as S3 refuses it."""
+        InvalidRequest, as S3 refuses it.  ``validate=False`` replays
+        values validated at an earlier request (multipart complete
+        re-staging initiate-time headers: a retain-until date that
+        lapsed DURING the upload must not strand the parts)."""
         meta = ctx.get("meta") or {}
         enabled = (meta.get("object_lock") or {}).get("enabled")
         if lock:
@@ -1660,14 +1670,15 @@ class RGWLite:
                 raise RGWError("InvalidRequest",
                                "bucket has no object lock")
             if lock.get("mode"):
-                if lock["mode"] not in self._LOCK_MODES:
-                    raise RGWError("InvalidArgument",
-                                   f"bad mode {lock['mode']!r}")
                 until = float(lock.get("until", 0))
-                if until <= time.time():
-                    raise RGWError("InvalidArgument",
-                                   "retain-until must be in the "
-                                   "future")
+                if validate:
+                    if lock["mode"] not in self._LOCK_MODES:
+                        raise RGWError("InvalidArgument",
+                                       f"bad mode {lock['mode']!r}")
+                    if until <= time.time():
+                        raise RGWError("InvalidArgument",
+                                       "retain-until must be in the "
+                                       "future")
                 ctx["lock_retention"] = {"mode": lock["mode"],
                                          "until": until}
             if lock.get("legal_hold"):

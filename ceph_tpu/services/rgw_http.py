@@ -1218,6 +1218,7 @@ class S3Frontend:
                                     "binary/octet-stream"),
             metadata=_meta_headers(req),
             if_none_match=req.header("if-none-match") == "*",
+            lock=_lock_headers(req),
         )
         if sse_key is not None:
             sp.set_sse_key(sse_key)
@@ -1315,6 +1316,13 @@ def _obj_headers(got: dict) -> dict[str, str]:
     }
     for k, v in (got.get("meta") or {}).items():
         hdrs[f"x-amz-meta-{k}"] = str(v)
+    ret = got.get("retention")
+    if ret:
+        hdrs["x-amz-object-lock-mode"] = ret["mode"]
+        hdrs["x-amz-object-lock-retain-until-date"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(float(ret["until"])))
+    if got.get("legal_hold"):
+        hdrs["x-amz-object-lock-legal-hold"] = "ON"
     sse = got.get("sse")
     if sse:
         import base64

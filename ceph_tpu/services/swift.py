@@ -208,6 +208,8 @@ class SwiftFrontend:
             raise RGWError("AccessDenied", "cross-account access")
         gw = self.rgw.as_user(uid)
         if len(parts) == 2:
+            if method == "POST" and "bulk-delete" in query:
+                return await self._bulk_delete(gw, hdrs, body)
             return await self._account(method, gw, uid, hdrs)
         container = parts[2]
         if len(parts) == 3:
@@ -254,6 +256,43 @@ class SwiftFrontend:
             hdrs["content-range"] = f"bytes {start}-{end}/{total}"
             return 206, hdrs, body
         return 200, hdrs, body
+
+    async def _bulk_delete(self, gw: RGWLite, hdrs: dict,
+                           body: bytes):
+        """Swift bulk delete (POST ?bulk-delete, the bulk middleware):
+        newline-separated "container/object" (or bare "container")
+        paths, per-item outcomes summarised in a JSON report — one
+        bad item must not abort the rest."""
+        import urllib.parse
+        paths = [urllib.parse.unquote(ln.strip())
+                 for ln in body.decode(errors="replace").splitlines()
+                 if ln.strip()]
+        if len(paths) > 10000:
+            return 413, {}, b"too many items"
+        deleted = not_found = 0
+        errors: list[list[str]] = []
+        for p in paths:
+            container, _, obj = p.lstrip("/").partition("/")
+            try:
+                if obj:
+                    await gw.delete_object(container, obj)
+                else:
+                    await gw.delete_bucket(container)
+                deleted += 1
+            except RGWError as e:
+                if e.code in ("NoSuchKey", "NoSuchBucket"):
+                    not_found += 1
+                else:
+                    errors.append([p, e.code])
+        report = {
+            "Number Deleted": deleted,
+            "Number Not Found": not_found,
+            "Response Status": "200 OK" if not errors
+            else "400 Bad Request",
+            "Errors": errors,
+        }
+        return (200, {"content-type": "application/json"},
+                json.dumps(report).encode())
 
     async def _account(self, method: str, gw: RGWLite, uid: str,
                        hdrs: dict | None = None):
@@ -379,6 +418,48 @@ class SwiftFrontend:
         bmeta["swift_meta"] = stored
         await gw._put_bucket_meta(name, bmeta)
 
+    async def _reap_if_expired(self, gw: RGWLite, container: str,
+                               obj: str, entry: dict) -> bool:
+        """Swift object expiry on the read path: an object past its
+        X-Delete-At reads as absent and is deleted inline (the
+        object-expirer daemon's reconciliation, collapsed)."""
+        exp = (entry.get("meta") or {}).get("delete_at")
+        if exp is None or float(exp) > time.time():
+            return False
+        try:
+            await gw.delete_object(container, obj)
+        except RGWError:
+            pass                  # already raced away
+        return True
+
+    async def expirer_pass(self, now: float | None = None) -> dict:
+        """One object-expirer sweep over every container (Swift's
+        object-expirer daemon role): reap objects whose X-Delete-At
+        has passed.  Returns container -> [reaped names]."""
+        now = time.time() if now is None else now
+        gw = self.rgw
+        reaped: dict[str, list[str]] = {}
+        for container in await gw.list_buckets():
+            # ONE index read per container, not one head per object:
+            # the entries already carry the meta the check needs
+            try:
+                bmeta = await gw._bucket_meta(container)
+                index = await gw._index_all(container, bmeta)
+            except RGWError:
+                continue
+            for key, raw in index.items():
+                entry = json.loads(raw)
+                if entry.get("delete_marker"):
+                    continue
+                exp = (entry.get("meta") or {}).get("delete_at")
+                if exp is not None and float(exp) <= now:
+                    try:
+                        await gw.delete_object(container, key)
+                    except RGWError:
+                        continue
+                    reaped.setdefault(container, []).append(key)
+        return reaped
+
     async def _object(self, method: str, gw: RGWLite, container: str,
                       obj: str, hdrs: dict, body: bytes,
                       query: dict | None = None):
@@ -431,6 +512,9 @@ class SwiftFrontend:
             # slo_segments is SERVER-owned metadata: a client header
             # forging it would poison manifest introspection/delete
             meta = _client_meta(hdrs)
+            exp = _parse_expiry(hdrs)
+            if exp is not None:
+                meta["delete_at"] = exp
             dlo = hdrs.get("x-object-manifest", "")
             if dlo:
                 # DLO: zero-byte manifest whose GET concatenates every
@@ -451,7 +535,20 @@ class SwiftFrontend:
             # keep a manifest through a metadata update).
             await gw._check_bucket(container, "WRITE")
             entry = await gw.head_object(container, obj)
+            if await self._reap_if_expired(gw, container, obj,
+                                           entry):
+                return 404, {}, b""      # updating a ghost lies
             meta = _client_meta(hdrs)
+            exp = _parse_expiry(hdrs)
+            if exp is not None:
+                meta["delete_at"] = exp
+            elif "x-remove-delete-at" not in hdrs:
+                # POST replaces the meta set, but expiry survives
+                # unless explicitly removed (Swift keeps X-Delete-At
+                # through metadata updates)
+                old_exp = (entry.get("meta") or {}).get("delete_at")
+                if old_exp is not None:
+                    meta["delete_at"] = old_exp
             slo = (entry.get("meta") or {}).get("slo_segments")
             if slo is not None:
                 meta["slo_segments"] = slo     # server-owned: sticky
@@ -479,12 +576,17 @@ class SwiftFrontend:
                         rng = None
             if method == "HEAD":
                 entry = await gw.head_object(container, obj)
+                if await self._reap_if_expired(gw, container, obj,
+                                               entry):
+                    return 404, {}, b""
                 dlo = (entry.get("meta") or {}).get("dlo_manifest")
                 if dlo and not entry.get("slo"):
                     return await self._dlo_read("HEAD", gw, entry,
                                                 dlo, rng)
                 return 200, _obj_headers(entry), b""
             got = await gw.get_object(container, obj, range_=rng)
+            if await self._reap_if_expired(gw, container, obj, got):
+                return 404, {}, b""
             dlo = (got.get("meta") or {}).get("dlo_manifest")
             if dlo and not got.get("slo"):
                 # a manifest's stored body is empty: the probe wasted
@@ -508,7 +610,23 @@ class SwiftFrontend:
         return 405, {}, b""
 
 
-_SERVER_META = ("slo_segments", "dlo_manifest")
+_SERVER_META = ("slo_segments", "dlo_manifest", "delete_at")
+
+
+def _parse_expiry(hdrs: dict) -> float | None:
+    """X-Delete-At (epoch) / X-Delete-After (relative seconds) —
+    Swift object expiry.  Past or non-numeric values are 400s."""
+    at = hdrs.get("x-delete-at")
+    after = hdrs.get("x-delete-after")
+    if at is None and after is None:
+        return None
+    # non-numeric values raise ValueError, which the dispatcher
+    # renders as the 400 Swift answers
+    when = float(at) if at is not None \
+        else time.time() + float(after)
+    if when <= time.time():
+        raise ValueError("X-Delete-At is in the past")
+    return when
 
 
 def _meta_headers_for(hdrs: dict, scope: str) -> tuple[dict, list]:
@@ -571,4 +689,7 @@ def _obj_headers(entry: dict) -> dict:
     for k, v in (entry.get("meta") or {}).items():
         if k not in _SERVER_META:
             hdrs[f"x-object-meta-{k}"] = str(v)
+    exp = (entry.get("meta") or {}).get("delete_at")
+    if exp is not None:
+        hdrs["x-delete-at"] = str(int(float(exp)))
     return hdrs

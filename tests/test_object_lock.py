@@ -252,3 +252,46 @@ def test_governance_bypass_needs_permission():
         finally:
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_lock_edges_from_review():
+    """Markers reject lock ops (405-shaped), a retain-until that
+    lapses during a multipart upload does not strand the parts, and
+    read headers surface lock state (review regressions)."""
+    import time as _t
+
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, _ = await _gw(rados)
+            await gw.create_bucket("vault", object_lock=True)
+            await gw.put_object("vault", "doc", b"x")
+            await gw.delete_object("vault", "doc")     # marker
+            vs = await gw.list_object_versions("vault")
+            mvid = next(v["version_id"] for v in vs
+                        if v["delete_marker"])
+            with pytest.raises(RGWError) as ei:
+                await gw.put_object_legal_hold(
+                    "vault", "doc", True, version_id=mvid)
+            assert ei.value.code == "MethodNotAllowed"
+            # multipart: initiate with a SHORT retain-until, complete
+            # after it lapsed — the assembled object must land (with
+            # the already-expired retention, which no longer blocks)
+            up = await gw.initiate_multipart(
+                "vault", "mp",
+                lock={"mode": "GOVERNANCE",
+                      "until": _t.time() + 0.2})
+            await gw.upload_part("vault", "mp", up, 1, b"P" * 100)
+            await asyncio.sleep(0.3)
+            parts = await gw.list_parts("vault", "mp", up)
+            done = await gw.complete_multipart(
+                "vault", "mp", up,
+                [(p["part_number"], p["etag"]) for p in parts])
+            ret = await gw.get_object_retention("vault", "mp")
+            assert ret["mode"] == "GOVERNANCE"
+            # lapsed retention no longer blocks the delete
+            await gw.delete_object_version("vault", "mp",
+                                           done["version_id"])
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())

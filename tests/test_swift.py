@@ -499,3 +499,123 @@ def test_container_listing_delimiter():
             await fe.stop()
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_swift_object_expiry():
+    """X-Delete-At / X-Delete-After (Swift object expiry): expired
+    objects read as 404 and are reaped inline; the expirer pass
+    sweeps them in bulk; POST keeps expiry unless removed."""
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = \
+            await _swift_session()
+        tok, acct = await _token(host, port, bob)
+        await _req(host, port, "PUT", f"{acct}/c", tok)
+        # relative expiry: lives now, dies after the horizon
+        st, _, _ = await _req(host, port, "PUT", f"{acct}/c/soon",
+                              {**tok, "x-delete-after": "0.3"},
+                              body=b"temp")
+        assert st == 201
+        st, h, body = await _req(host, port, "GET", f"{acct}/c/soon",
+                                 tok)
+        assert st == 200 and "x-delete-at" in h
+        # POST metadata update keeps the expiry
+        st, _, _ = await _req(host, port, "POST", f"{acct}/c/soon",
+                              {**tok, "x-object-meta-color": "red"})
+        assert st == 202
+        st, h, _ = await _req(host, port, "HEAD", f"{acct}/c/soon",
+                              tok)
+        assert "x-delete-at" in h
+        await asyncio.sleep(0.4)
+        st, _, _ = await _req(host, port, "GET", f"{acct}/c/soon",
+                              tok)
+        assert st == 404
+        # absolute past / junk values are 400s
+        st, _, _ = await _req(host, port, "PUT", f"{acct}/c/bad",
+                              {**tok, "x-delete-at": "12"}, body=b"x")
+        assert st == 400
+        st, _, _ = await _req(host, port, "PUT", f"{acct}/c/bad",
+                              {**tok, "x-delete-at": "soon"},
+                              body=b"x")
+        assert st == 400
+        # expirer pass reaps without a read touching the object
+        st, _, _ = await _req(host, port, "PUT", f"{acct}/c/swept",
+                              {**tok, "x-delete-after": "0.1"},
+                              body=b"y")
+        await asyncio.sleep(0.2)
+        reaped = await fe.expirer_pass()
+        assert reaped == {"c": ["swept"]}
+        listing = await gw.as_user("bob").list_objects("c")
+        assert listing["contents"] == []
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_swift_bulk_delete():
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = \
+            await _swift_session()
+        tok, acct = await _token(host, port, bob)
+        await _req(host, port, "PUT", f"{acct}/c1", tok)
+        await _req(host, port, "PUT", f"{acct}/c2", tok)
+        for k in ("a", "b"):
+            await _req(host, port, "PUT", f"{acct}/c1/{k}", tok,
+                       body=b"x")
+        body = b"c1/a\nc1/b\nc1/ghost\nc2\n"
+        st, h, out = await _req(host, port, "POST",
+                                f"{acct}?bulk-delete", tok,
+                                body=body)
+        assert st == 200
+        rep = json.loads(out)
+        assert rep["Number Deleted"] == 3       # a, b, and c2
+        assert rep["Number Not Found"] == 1
+        assert rep["Errors"] == []
+        # non-empty container delete surfaces as an error entry
+        await _req(host, port, "PUT", f"{acct}/c1/keep", tok,
+                   body=b"x")
+        st, _, out = await _req(host, port, "POST",
+                                f"{acct}?bulk-delete", tok,
+                                body=b"c1\n")
+        rep = json.loads(out)
+        assert rep["Errors"] and rep["Errors"][0][1] == \
+            "BucketNotEmpty"
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+async def _swift_session():
+    mon, osds, rados, fe, gw, bob, host, port = await _swift()
+    return mon, osds, rados, fe, gw, bob, host, port
+
+
+async def _token(host, port, bob):
+    st, h, _ = await _req(host, port, "GET", "/auth/v1.0",
+                          {"x-auth-user": "bob:swift",
+                           "x-auth-key": bob["secret_key"]})
+    tok = {"x-auth-token": h["x-auth-token"]}
+    acct = "/" + h["x-storage-url"].split("/", 3)[3]
+    return tok, acct
+
+
+def test_swift_post_to_expired_is_404():
+    """POST (metadata update) to an expired-but-unswept object must
+    404, not 202 a ghost (review regression)."""
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = \
+            await _swift_session()
+        tok, acct = await _token(host, port, bob)
+        await _req(host, port, "PUT", f"{acct}/c", tok)
+        st, _, _ = await _req(host, port, "PUT", f"{acct}/c/ghost",
+                              {**tok, "x-delete-after": "0.1"},
+                              body=b"x")
+        assert st == 201
+        await asyncio.sleep(0.2)
+        st, _, _ = await _req(host, port, "POST", f"{acct}/c/ghost",
+                              {**tok, "x-object-meta-a": "b"})
+        assert st == 404
+        listing = await gw.as_user("bob").list_objects("c")
+        assert listing["contents"] == []       # reaped by the POST
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
