@@ -537,6 +537,19 @@ async def _dispatch_osd(args, rados: Rados, j: bool) -> int:
                               render=lambda d: "\n".join(d))
         if sub == "delete":
             return await _mon(rados, "osd pool delete", j, pool=args.pool)
+        if sub == "set-quota":
+            return await _mon(rados, "osd pool set-quota", j,
+                              pool=args.pool, field=args.field,
+                              value=args.value)
+        if sub == "get-quota":
+            def render(d):
+                return (f"quotas for pool '{d['pool']}':\n"
+                        f"  max bytes  : {d['quota_max_bytes'] or 'N/A'}\n"
+                        f"  max objects: {d['quota_max_objects'] or 'N/A'}"
+                        + ("\n  FULL (writes blocked)" if d["full"]
+                           else ""))
+            return await _mon(rados, "osd pool get-quota", j,
+                              pool=args.pool, render=render)
         if sub == "autoscale-status":
             def render(d):
                 if not d:
@@ -993,6 +1006,12 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("pool")
     ps.add_argument("var")
     ps.add_argument("val")
+    pq = pool_sub.add_parser("set-quota")
+    pq.add_argument("pool")
+    pq.add_argument("field", choices=["max_bytes", "max_objects"])
+    pq.add_argument("value", type=int)
+    gq = pool_sub.add_parser("get-quota")
+    gq.add_argument("pool")
     prof = osd_sub.add_parser("erasure-code-profile")
     prof_sub = prof.add_subparsers(dest="sub", required=True)
     pfs = prof_sub.add_parser("set")

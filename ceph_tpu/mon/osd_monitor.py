@@ -175,6 +175,15 @@ class OSDMonitor(PaxosService):
 
     def health_checks(self) -> dict[str, dict]:
         checks: dict[str, dict] = {}
+        full = sorted(p.name for p in self.osdmap.pools.values()
+                      if p.full_quota)
+        if full:
+            checks["POOL_FULL"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"{len(full)} pool(s) reached quota",
+                "detail": [f"pool '{n}' is full (quota)"
+                           for n in full],
+            }
         down = sorted(
             o for o, i in self.osdmap.osds.items()
             if not i.up and i.in_cluster
@@ -199,6 +208,10 @@ class OSDMonitor(PaxosService):
         interval = self.mon.conf["mon_osd_down_out_interval"]
         changed = False
         if "noout" in self.osdmap.flags:
+            # noout only suppresses the auto-out sweep; quota
+            # enforcement still runs
+            if self.check_pool_quotas():
+                await self.mon.propose_pending()
             return
         for osd, since in list(self.down_pending_out.items()):
             info = self.osdmap.osds.get(osd)
@@ -214,6 +227,8 @@ class OSDMonitor(PaxosService):
                     "warn", f"osd.{osd} marked out after being down "
                     f"{interval:g}s"
                 )
+        if self.check_pool_quotas():
+            changed = True
         if changed:
             await self.mon.propose_pending()
 
@@ -277,6 +292,17 @@ class OSDMonitor(PaxosService):
             return CommandResult(
                 data=[p.name for p in self.osdmap.pools.values()]
             )
+        if name == "osd pool get-quota":
+            pool = self._pool_by_name(cmd.get("pool", ""))
+            if pool is None:
+                return CommandResult(ENOENT_RC,
+                                     f"no pool {cmd.get('pool')!r}")
+            return CommandResult(data={
+                "pool": pool.name,
+                "quota_max_bytes": pool.quota_max_bytes,
+                "quota_max_objects": pool.quota_max_objects,
+                "full": pool.full_quota,
+            })
         if name == "osd blocklist ls":
             now = time.time()
             return CommandResult(data={
@@ -331,6 +357,8 @@ class OSDMonitor(PaxosService):
                 return self._cmd_flag(name == "osd set", cmd)
             if name == "osd blocklist":
                 return self._cmd_blocklist(cmd)
+            if name == "osd pool set-quota":
+                return self._cmd_pool_quota(cmd)
             if name == "osd setcrushmap":
                 return self._cmd_setcrushmap(cmd)
         except (KeyError, ValueError, TypeError) as e:
@@ -766,6 +794,64 @@ class OSDMonitor(PaxosService):
 
     FLAGS = ("noout", "noin", "noup", "nodown", "pause", "norecover",
              "nobackfill", "noscrub")
+
+    def _cmd_pool_quota(self, cmd: dict) -> CommandResult:
+        """osd pool set-quota <pool> max_bytes|max_objects <val>
+        (0 clears).  The limit is staged on the pool; enforcement
+        rides the quota sweep against the PGMap digest."""
+        pool = self._pool_by_name(cmd.get("pool", ""))
+        if pool is None:
+            return CommandResult(ENOENT_RC,
+                                 f"no pool {cmd.get('pool')!r}")
+        field = str(cmd.get("field", ""))
+        if field not in ("max_bytes", "max_objects"):
+            return CommandResult(EINVAL_RC,
+                                 f"field must be max_bytes or "
+                                 f"max_objects, not {field!r}")
+        val = int(cmd.get("value", 0))
+        if val < 0:
+            return CommandResult(EINVAL_RC, "value must be >= 0")
+        import copy
+        updated = copy.deepcopy(pool)
+        setattr(updated, f"quota_{field}", val)
+        if val == 0 and updated.quota_max_bytes == 0 \
+                and updated.quota_max_objects == 0:
+            updated.full_quota = False      # cleared limits unfence
+        self._pending().new_pools.append(updated)
+        return CommandResult(
+            outs=f"set-quota {field}={val} on pool {pool.name}")
+
+    def check_pool_quotas(self) -> bool:
+        """Compare each pool's usage (PGMap digest) against its
+        quota; stage full_quota transitions.  True when a map change
+        was staged (OSDMonitor::check_full_pools role)."""
+        digest = getattr(self.mon.mgr_stat, "digest", None) or {}
+        pstats = digest.get("pools", {})
+        changed = False
+        for pid, pool in self.osdmap.pools.items():
+            if not pool.quota_max_bytes \
+                    and not pool.quota_max_objects:
+                continue
+            st = pstats.get(pid) or pstats.get(str(pid)) or {}
+            over = (
+                (pool.quota_max_bytes
+                 and int(st.get("num_bytes", 0))
+                 >= pool.quota_max_bytes)
+                or (pool.quota_max_objects
+                    and int(st.get("num_objects", 0))
+                    >= pool.quota_max_objects))
+            if bool(over) == pool.full_quota:
+                continue
+            import copy
+            updated = copy.deepcopy(pool)
+            updated.full_quota = bool(over)
+            self._pending().new_pools.append(updated)
+            changed = True
+            self.mon.cluster_log(
+                "warn" if over else "info",
+                f"pool '{pool.name}' is "
+                f"{'full (quota)' if over else 'no longer full'}")
+        return changed
 
     def _cmd_blocklist(self, cmd: dict) -> CommandResult:
         """osd blocklist add/rm (OSDMonitor blocklist role): fence a

@@ -9,6 +9,7 @@ EINVAL_RC = -22
 ENOTSUP_RC = -95
 ESTALE_RC = -116              # sub-op from an older PG interval, dropped
 EBLOCKLISTED_RC = -108        # client instance fenced by the OSDMap
+EDQUOT_RC = -122              # pool quota exceeded (FULL_QUOTA)
 MISDIRECTED_RC = -1000        # resend after map refresh (reference drops)
 EPERM_RC = -1               # operation not permitted (caps)
 

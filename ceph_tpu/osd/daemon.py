@@ -54,6 +54,7 @@ from ceph_tpu.osd.codes import (
     ENOTSUP_RC,
     ESTALE_RC,
     EBLOCKLISTED_RC,
+    EDQUOT_RC,
     MISDIRECTED_RC,
     OK,
     READ_CLASS_OPS,
@@ -102,6 +103,11 @@ XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
 # read-class client ops (no mutation): ONE definition for the dedup
 # cache policy, the replay path, perf counters, and caps enforcement
 _CAPS_READ_OPS = READ_CLASS_OPS
+# space-reclaiming ops stay allowed on a FULL_QUOTA pool: blocking
+# deletes would make a full pool unrecoverable (the reference exempts
+# delete-class ops the same way)
+_QUOTA_EXEMPT_OPS = frozenset({"remove", "delete", "omap_rm",
+                               "rmxattr"})
 
 # message types the embedded MonClient owns
 _MON_TYPES = {
@@ -3042,6 +3048,18 @@ class OSDDaemon:
                 # fenced client (OSDMap blocklist): hard-refuse, the
                 # reference returns EBLOCKLISTED the same way
                 self._reply(conn, tid, EBLOCKLISTED_RC,
+                            epoch=self.osdmap.epoch)
+                return
+            pinfo = (self.osdmap.pools.get(pgid.pool)
+                     if self.osdmap is not None else None)
+            if pinfo is not None and pinfo.full_quota and any(
+                    isinstance(op, dict)
+                    and op.get("op") not in READ_CLASS_OPS
+                    and op.get("op") not in _QUOTA_EXEMPT_OPS
+                    for op in d.get("ops", ())):
+                # pool over quota (pg_pool_t FLAG_FULL_QUOTA): writes
+                # answer EDQUOT until the mon's sweep clears the flag
+                self._reply(conn, tid, EDQUOT_RC,
                             epoch=self.osdmap.epoch)
                 return
             if self.osdmap is not None \

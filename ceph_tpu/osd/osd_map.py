@@ -49,6 +49,12 @@ class PoolInfo:
     cache_mode: str = ""                     # "", writeback, readonly
     target_max_objects: int = 0              # eviction ceiling (cache)
     target_max_bytes: int = 0
+    # pool quotas (pg_pool_t quota_max_*): the mon raises full_quota
+    # when the PGMap digest shows usage at/over a limit; OSDs then
+    # refuse writes with EDQUOT until usage drops and it clears
+    quota_max_bytes: int = 0
+    quota_max_objects: int = 0
+    full_quota: bool = False
     removed_snaps: list = field(default_factory=list)
 
     def raw_pg_to_pps(self, ps: int) -> int:
@@ -79,6 +85,9 @@ class PoolInfo:
             "cache_mode": self.cache_mode,
             "target_max_objects": self.target_max_objects,
             "target_max_bytes": self.target_max_bytes,
+            "quota_max_bytes": self.quota_max_bytes,
+            "quota_max_objects": self.quota_max_objects,
+            "full_quota": self.full_quota,
         }
 
     @classmethod
@@ -103,6 +112,9 @@ class PoolInfo:
             cache_mode=str(d.get("cache_mode", "")),
             target_max_objects=int(d.get("target_max_objects", 0)),
             target_max_bytes=int(d.get("target_max_bytes", 0)),
+            quota_max_bytes=int(d.get("quota_max_bytes", 0)),
+            quota_max_objects=int(d.get("quota_max_objects", 0)),
+            full_quota=bool(d.get("full_quota", False)),
         )
 
 

@@ -423,8 +423,7 @@ class SwiftFrontend:
         """Swift object expiry on the read path: an object past its
         X-Delete-At reads as absent and is deleted inline (the
         object-expirer daemon's reconciliation, collapsed)."""
-        exp = (entry.get("meta") or {}).get("delete_at")
-        if exp is None or float(exp) > time.time():
+        if not _expired(entry, time.time()):
             return False
         try:
             await gw.delete_object(container, obj)
@@ -451,8 +450,7 @@ class SwiftFrontend:
                 entry = json.loads(raw)
                 if entry.get("delete_marker"):
                     continue
-                exp = (entry.get("meta") or {}).get("delete_at")
-                if exp is not None and float(exp) <= now:
+                if _expired(entry, now):
                     try:
                         await gw.delete_object(container, key)
                     except RGWError:
@@ -481,13 +479,17 @@ class SwiftFrontend:
                     })
             except (ValueError, TypeError, KeyError) as e:
                 return 400, {}, f"bad manifest: {e!r}".encode()
+            slo_meta = {k[len("x-object-meta-"):]: v
+                        for k, v in hdrs.items()
+                        if k.startswith("x-object-meta-")}
+            exp = _parse_expiry(hdrs)
+            if exp is not None:
+                slo_meta["delete_at"] = exp
             out = await gw.put_slo_manifest(
                 container, obj, segments,
                 content_type=hdrs.get("content-type",
                                       "application/octet-stream"),
-                metadata={k[len("x-object-meta-"):]: v
-                          for k, v in hdrs.items()
-                          if k.startswith("x-object-meta-")})
+                metadata=slo_meta)
             return 201, {"etag": out["etag"]}, b""
         if method == "GET" and mm == "get":
             entry = await gw.head_object(container, obj)
@@ -624,9 +626,17 @@ def _parse_expiry(hdrs: dict) -> float | None:
     # renders as the 400 Swift answers
     when = float(at) if at is not None \
         else time.time() + float(after)
-    if when <= time.time():
+    if not when > time.time():
+        # the inverted comparison catches NaN too — storing it would
+        # read as instantly-expired (silent data loss on first GET)
         raise ValueError("X-Delete-At is in the past")
     return when
+
+
+def _expired(entry: dict, now: float) -> bool:
+    """ONE expiry predicate for the read-path reap and the sweep."""
+    exp = (entry.get("meta") or {}).get("delete_at")
+    return exp is not None and float(exp) <= now
 
 
 def _meta_headers_for(hdrs: dict, scope: str) -> tuple[dict, list]:

@@ -1,0 +1,141 @@
+"""Pool quotas (pg_pool_t quota_max_bytes/objects + the mon's
+full-pool sweep, OSDMonitor::check_full_pools role): `osd pool
+set-quota` stages limits, the leader's tick compares PGMap digest
+usage and raises full_quota on the pool, OSDs then answer EDQUOT to
+writes until usage drops below the limit again."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.codes import EDQUOT_RC
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _wait(cond, deadline=25.0, every=0.1):
+    end = asyncio.get_running_loop().time() + deadline
+    while True:
+        if await cond():
+            return
+        assert asyncio.get_running_loop().time() < end, "timeout"
+        await asyncio.sleep(every)
+
+
+def test_pool_quota_enforcement():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        mgr = await cluster.start_mgr()
+        try:
+            r = await rados.mon_command("osd pool create", pool="q",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("q")
+            await io.write_full("seed", b"x" * 4096)
+            # limits: 3 objects max
+            r = await rados.mon_command("osd pool set-quota",
+                                        pool="q",
+                                        field="max_objects", value=3)
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("osd pool get-quota",
+                                        pool="q")
+            assert r["data"]["quota_max_objects"] == 3
+            assert r["data"]["full"] is False
+            await io.write_full("o2", b"y")
+            await io.write_full("o3", b"z")
+            # digest catches up -> pool goes full -> writes EDQUOT
+
+            async def is_full():
+                r = await rados.mon_command("osd pool get-quota",
+                                            pool="q")
+                return r["data"]["full"]
+            await _wait(is_full)
+
+            async def write_blocked():
+                try:
+                    await io.write_full("o4", b"w")
+                    return False
+                except RadosError as e:
+                    assert e.rc == EDQUOT_RC, e
+                    return True
+            await _wait(write_blocked)
+            # reads still work on a full pool
+            assert await io.read("seed") == b"x" * 4096
+            # health surfaces the condition
+            r = await rados.mon_command("health")
+            assert "POOL_FULL" in r["data"]["checks"]
+            # deleting below the limit unfences
+            await io.remove("o2")
+            await io.remove("o3")
+
+            async def unblocked():
+                try:
+                    await io.write_full("o4", b"w")
+                    return True
+                except RadosError as e:
+                    if e.rc != EDQUOT_RC:
+                        raise
+                    return False
+            await _wait(unblocked)
+            # clearing the quota drops the flag immediately with it
+            r = await rados.mon_command("osd pool set-quota",
+                                        pool="q",
+                                        field="max_objects", value=0)
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("osd pool get-quota",
+                                        pool="q")
+            assert r["data"]["quota_max_objects"] == 0
+            # bad field refuses
+            r = await rados.mon_command("osd pool set-quota",
+                                        pool="q", field="max_shoes",
+                                        value=1)
+            assert r["rc"] != 0
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_truncate_cannot_grow_full_pool():
+    """truncate is NOT quota-exempt: extending an object would grow
+    usage past the quota forever (review regression); deletes stay
+    allowed."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        mgr = await cluster.start_mgr()
+        try:
+            r = await rados.mon_command("osd pool create", pool="q",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("q")
+            await io.write_full("obj", b"x" * 5000)
+            r = await rados.mon_command("osd pool set-quota",
+                                        pool="q", field="max_bytes",
+                                        value=4000)
+            assert r["rc"] == 0, r
+
+            async def blocked():
+                try:
+                    await io.truncate("obj", 1 << 20)
+                    return False
+                except RadosError as e:
+                    assert e.rc == EDQUOT_RC, e
+                    return True
+            await _wait(blocked)
+            await io.remove("obj")      # reclaim still allowed
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())

@@ -507,7 +507,7 @@ def test_swift_object_expiry():
     sweeps them in bulk; POST keeps expiry unless removed."""
     async def run():
         mon, osds, rados, fe, gw, bob, host, port = \
-            await _swift_session()
+            await _swift()
         tok, acct = await _token(host, port, bob)
         await _req(host, port, "PUT", f"{acct}/c", tok)
         # relative expiry: lives now, dies after the horizon
@@ -554,7 +554,7 @@ def test_swift_object_expiry():
 def test_swift_bulk_delete():
     async def run():
         mon, osds, rados, fe, gw, bob, host, port = \
-            await _swift_session()
+            await _swift()
         tok, acct = await _token(host, port, bob)
         await _req(host, port, "PUT", f"{acct}/c1", tok)
         await _req(host, port, "PUT", f"{acct}/c2", tok)
@@ -584,11 +584,6 @@ def test_swift_bulk_delete():
     asyncio.run(run())
 
 
-async def _swift_session():
-    mon, osds, rados, fe, gw, bob, host, port = await _swift()
-    return mon, osds, rados, fe, gw, bob, host, port
-
-
 async def _token(host, port, bob):
     st, h, _ = await _req(host, port, "GET", "/auth/v1.0",
                           {"x-auth-user": "bob:swift",
@@ -603,7 +598,7 @@ def test_swift_post_to_expired_is_404():
     404, not 202 a ghost (review regression)."""
     async def run():
         mon, osds, rados, fe, gw, bob, host, port = \
-            await _swift_session()
+            await _swift()
         tok, acct = await _token(host, port, bob)
         await _req(host, port, "PUT", f"{acct}/c", tok)
         st, _, _ = await _req(host, port, "PUT", f"{acct}/c/ghost",
@@ -616,6 +611,36 @@ def test_swift_post_to_expired_is_404():
         assert st == 404
         listing = await gw.as_user("bob").list_objects("c")
         assert listing["contents"] == []       # reaped by the POST
+        await fe.stop()
+        await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_expiry_rejects_nan_and_covers_slo():
+    """NaN expiry must 400 (it reads as instantly-expired), and SLO
+    manifests honor X-Delete-After like plain objects (review
+    regressions)."""
+    async def run():
+        mon, osds, rados, fe, gw, bob, host, port = await _swift()
+        tok, acct = await _token(host, port, bob)
+        await _req(host, port, "PUT", f"{acct}/c", tok)
+        st, _, _ = await _req(host, port, "PUT", f"{acct}/c/x",
+                              {**tok, "x-delete-at": "nan"},
+                              body=b"d")
+        assert st == 400
+        # SLO manifest with expiry
+        await _req(host, port, "PUT", f"{acct}/c/seg1", tok,
+                   body=b"S" * 100)
+        manifest = json.dumps([{"path": "c/seg1"}]).encode()
+        st, _, _ = await _req(
+            host, port, "PUT",
+            f"{acct}/c/big?multipart-manifest=put",
+            {**tok, "x-delete-after": "0.1"}, body=manifest)
+        assert st == 201
+        await asyncio.sleep(0.2)
+        st, _, _ = await _req(host, port, "HEAD", f"{acct}/c/big",
+                              tok)
+        assert st == 404
         await fe.stop()
         await stop_cluster(mon, osds, rados)
     asyncio.run(run())
