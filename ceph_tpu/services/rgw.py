@@ -1497,6 +1497,31 @@ class RGWLite:
             out.append({"key": key, "upload_id": upload_id})
         return sorted(out, key=lambda u: (u["key"], u["upload_id"]))
 
+    # -- static website hosting (rgw_website.cc role) ---------------------
+    async def put_bucket_website(self, bucket: str, index_doc: str,
+                                 error_doc: str = "") -> None:
+        """PutBucketWebsite: serve the bucket as a website for
+        ANONYMOUS browsers — directory paths resolve to the index
+        document, missing keys to the error document."""
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        if not index_doc or "/" in index_doc:
+            raise RGWError("InvalidArgument",
+                           f"bad index document {index_doc!r}")
+        meta["website"] = {"index": index_doc, "error": error_doc}
+        await self._put_bucket_meta(bucket, meta)
+
+    async def get_bucket_website(self, bucket: str) -> dict:
+        meta = await self._check_bucket(bucket, "READ")
+        cfg = meta.get("website")
+        if not cfg:
+            raise RGWError("NoSuchWebsiteConfiguration", bucket)
+        return dict(cfg)
+
+    async def delete_bucket_website(self, bucket: str) -> None:
+        meta = await self._check_bucket(bucket, "FULL_CONTROL")
+        meta.pop("website", None)
+        await self._put_bucket_meta(bucket, meta)
+
     # -- S3 Object Lock (rgw_object_lock.cc: WORM retention) --------------
     _LOCK_MODES = ("GOVERNANCE", "COMPLIANCE")
 

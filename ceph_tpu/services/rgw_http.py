@@ -63,6 +63,7 @@ _STATUS = {
     "NoSuchLifecycleConfiguration": 404,
     "NoSuchBucketPolicy": 404,
     "NoSuchCORSConfiguration": 404,
+    "NoSuchWebsiteConfiguration": 404,
     "ObjectLockConfigurationNotFoundError": 404,
     "InvalidBucketState": 409,
     "NoSuchObjectLockConfiguration": 404,
@@ -671,11 +672,49 @@ class S3Frontend:
         parts = req.path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
+        if uid == ANONYMOUS and bucket and not req.query \
+                and req.method in ("GET", "HEAD"):
+            web = await self._maybe_website(req, gw, bucket, key)
+            if web is not None:
+                return web
         if not bucket:
             return await self._service(req, gw)
         if not key:
             return await self._bucket(req, gw, bucket)
         return await self._object(req, gw, bucket, key)
+
+    async def _maybe_website(self, req: _Request, gw: RGWLite,
+                             bucket: str, key: str):
+        """Static-website semantics for anonymous browsers on a
+        website-configured bucket (rgw_website.cc): directory paths
+        resolve to the index document, missing keys to the error
+        document (served WITH a 404).  None = not a website bucket,
+        fall through to plain S3 handling."""
+        try:
+            cfg = (await gw._bucket_meta(bucket)).get("website")
+        except RGWError:
+            return None
+        if not cfg:
+            return None
+        want = key
+        if not want or want.endswith("/"):
+            want = want + cfg["index"]
+        try:
+            got = await gw.get_object(bucket, want)
+            return 200, _obj_headers(got), (
+                b"" if req.method == "HEAD" else got["data"])
+        except RGWError as e:
+            if e.code not in ("NoSuchKey", "AccessDenied"):
+                raise
+        err_key = cfg.get("error")
+        if err_key:
+            try:
+                got = await gw.get_object(bucket, err_key)
+                return 404, _obj_headers(got), (
+                    b"" if req.method == "HEAD" else got["data"])
+            except RGWError:
+                pass
+        raise _HTTPError(404, "NoSuchKey", want)
 
     async def _service(self, req: _Request, gw: RGWLite):
         if req.method != "GET":
@@ -751,6 +790,16 @@ class S3Frontend:
                                                 days=days,
                                                 years=years)
                 return 200, {}, b""
+            if "website" in q:
+                doc = ET.fromstring(req.body.decode())
+                idx = (doc.findtext(f"{_ns('IndexDocument')}"
+                                    f"/{_ns('Suffix')}")
+                       or doc.findtext("IndexDocument/Suffix") or "")
+                err = (doc.findtext(f"{_ns('ErrorDocument')}"
+                                    f"/{_ns('Key')}")
+                       or doc.findtext("ErrorDocument/Key") or "")
+                await gw.put_bucket_website(bucket, idx, err)
+                return 200, {}, b""
             await gw.create_bucket(bucket, object_lock=req.header(
                 "x-amz-bucket-object-lock-enabled",
                 "").lower() == "true")
@@ -765,6 +814,9 @@ class S3Frontend:
                 return 204, {}, b""
             if "policy" in q:
                 await gw.delete_bucket_policy(bucket)
+                return 204, {}, b""
+            if "website" in q:
+                await gw.delete_bucket_website(bucket)
                 return 204, {}, b""
             await gw.delete_bucket(bucket)
             return 204, {}, b""
@@ -810,6 +862,15 @@ class S3Frontend:
                 u = ET.SubElement(root, "Upload")
                 ET.SubElement(u, "Key").text = up["key"]
                 ET.SubElement(u, "UploadId").text = up["upload_id"]
+            return self._xml(root)
+        if "website" in q:
+            cfg = await gw.get_bucket_website(bucket)
+            root = ET.Element("WebsiteConfiguration", xmlns=XMLNS)
+            idx = ET.SubElement(root, "IndexDocument")
+            ET.SubElement(idx, "Suffix").text = cfg["index"]
+            if cfg.get("error"):
+                err = ET.SubElement(root, "ErrorDocument")
+                ET.SubElement(err, "Key").text = cfg["error"]
             return self._xml(root)
         if "object-lock" in q:
             cfg = await gw.get_object_lock_config(bucket)

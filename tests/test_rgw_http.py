@@ -1002,3 +1002,63 @@ def test_lifecycle_validation_and_seconds_render():
             await stop_cluster(mon, osds, rados)
 
     asyncio.run(run())
+
+
+def test_static_website_hosting():
+    """S3 static website (rgw_website.cc role): ?website config
+    round-trips; anonymous browsers get index-document resolution on
+    directory paths and the error document (with a 404) on missing
+    keys; signed requests keep plain API semantics."""
+    async def run():
+        mon, osds, rados, fe, users, cli = await _frontend()
+        try:
+            host, port = fe.host, fe.port
+            anon = S3HttpClient(host, port)
+            await cli.request("PUT", "/site")
+            for k, body in (("index.html", b"<h1>home</h1>"),
+                            ("docs/index.html", b"<h1>docs</h1>"),
+                            ("404.html", b"<h1>lost</h1>")):
+                await cli.request("PUT", f"/site/{k}", body=body)
+            # public-read so the anonymous browser can see it
+            st, _, _ = await cli.request(
+                "PUT", "/site?acl",
+                headers={"x-amz-acl": "public-read"})
+            assert st == 200
+            st, _, _ = await cli.request(
+                "PUT", "/site?website",
+                body=b"<WebsiteConfiguration>"
+                     b"<IndexDocument><Suffix>index.html</Suffix>"
+                     b"</IndexDocument>"
+                     b"<ErrorDocument><Key>404.html</Key>"
+                     b"</ErrorDocument></WebsiteConfiguration>")
+            assert st == 200
+            st, _, body = await cli.request("GET", "/site?website")
+            assert st == 200 and b"index.html" in body
+            # anonymous: root serves the index
+            st, h, body = await anon.request("GET", "/site")
+            assert st == 200 and body == b"<h1>home</h1>"
+            # directory path -> its index
+            st, _, body = await anon.request("GET", "/site/docs/")
+            assert st == 200 and body == b"<h1>docs</h1>"
+            # missing key -> error doc WITH 404
+            st, _, body = await anon.request("GET", "/site/nope")
+            assert st == 404 and body == b"<h1>lost</h1>"
+            # plain object fetch still works
+            st, _, body = await anon.request("GET",
+                                             "/site/index.html")
+            assert st == 200 and body == b"<h1>home</h1>"
+            # SIGNED bucket GET keeps API semantics (a listing)
+            st, _, body = await cli.request("GET", "/site")
+            assert st == 200 and b"ListBucketResult" in body
+            # delete clears; anon root becomes the plain ACL answer
+            st, _, _ = await cli.request("DELETE", "/site?website")
+            assert st == 204
+            st, _, body = await cli.request("GET", "/site?website")
+            assert st == 404
+            st, _, body = await anon.request("GET", "/site")
+            assert b"ListBucketResult" in body   # public-read list
+        finally:
+            await fe.stop()
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
