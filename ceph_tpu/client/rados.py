@@ -13,6 +13,8 @@ objecter->op_submit :672.
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import contextvars
 from typing import Awaitable, Callable
 
 from ceph_tpu.common.config import ConfigProxy
@@ -32,6 +34,26 @@ def _check(reply: dict, what: str) -> dict:
     if reply["rc"] != 0:
         raise RadosError(reply["rc"], f"{what}: {reply.get('outs', '')}")
     return reply
+
+
+# CEPH_OSD_FLAG_FULL_TRY analog: ops issued while this is set carry a
+# "full_try" wire flag and the OSD lets them through a FULL_QUOTA pool
+# (the reference flags delete-flow ops the same way so a full pool can
+# still be emptied).  A contextvar, so one `with full_try():` covers an
+# entire async delete flow — every nested await inherits it.
+_FULL_TRY: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "rados_full_try", default=False
+)
+
+
+@contextlib.contextmanager
+def full_try():
+    """All ops issued inside carry CEPH_OSD_FLAG_FULL_TRY semantics."""
+    tok = _FULL_TRY.set(True)
+    try:
+        yield
+    finally:
+        _FULL_TRY.reset(tok)
 
 
 class ObjectOperation:
@@ -308,6 +330,8 @@ class IoCtx:
                               "snaps": sorted(self.snaps, reverse=True)}
         if self.read_snap is not None:
             extra["snapid"] = self.read_snap
+        if _FULL_TRY.get():
+            extra["flags"] = ["full_try"]
         reply = await self.rados.objecter.op_submit(
             self.pool_id, oid, op.ops, timeout, extra=extra or None
         )

@@ -105,7 +105,9 @@ XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
 _CAPS_READ_OPS = READ_CLASS_OPS
 # space-reclaiming ops stay allowed on a FULL_QUOTA pool: blocking
 # deletes would make a full pool unrecoverable (the reference exempts
-# delete-class ops the same way)
+# delete-class ops the same way).  Ops carrying the "full_try" wire
+# flag (CEPH_OSD_FLAG_FULL_TRY — RGW delete flows whose sideband
+# writes net-reclaim space) bypass the quota check entirely.
 _QUOTA_EXEMPT_OPS = frozenset({"remove", "delete", "omap_rm",
                                "rmxattr"})
 
@@ -3052,7 +3054,8 @@ class OSDDaemon:
                 return
             pinfo = (self.osdmap.pools.get(pgid.pool)
                      if self.osdmap is not None else None)
-            if pinfo is not None and pinfo.full_quota and any(
+            if (pinfo is not None and pinfo.full_quota
+                    and "full_try" not in d.get("flags", ())) and any(
                     isinstance(op, dict)
                     and op.get("op") not in READ_CLASS_OPS
                     and op.get("op") not in _QUOTA_EXEMPT_OPS

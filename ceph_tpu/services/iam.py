@@ -36,6 +36,7 @@ KNOWN_ACTIONS = frozenset({
     "s3:PutObjectRetention", "s3:GetObjectRetention",
     "s3:PutObjectLegalHold", "s3:GetObjectLegalHold",
     "s3:BypassGovernanceRetention",
+    "s3:PutObjectTagging", "s3:GetObjectTagging", "s3:DeleteObjectTagging",
 })
 
 

@@ -20,11 +20,27 @@ import secrets
 import time
 import zlib
 
-from ceph_tpu.client.rados import IoCtx, ObjectOperation, RadosError
+from ceph_tpu.client.rados import (IoCtx, ObjectOperation, RadosError,
+                                   full_try)
 from ceph_tpu.client.striper import RadosStriper, StripeLayout
 
 BUCKETS_OID = "rgw.buckets"          # omap: bucket name -> meta
 STRIPE_THRESHOLD = 4 * 1024 * 1024
+
+
+def _reclaims_space(fn):
+    """Delete-flow methods run under CEPH_OSD_FLAG_FULL_TRY semantics:
+    their sideband writes (bilog 'call' append, versioned delete-marker
+    omap_set, GC-enqueue create+omap_set) must not bounce with EDQUOT on
+    a quota-full pool, or users could never delete their way back under
+    quota (the reference flags delete-class ops the same way)."""
+    import functools
+
+    @functools.wraps(fn)
+    async def wrapper(*a, **kw):
+        with full_try():
+            return await fn(*a, **kw)
+    return wrapper
 
 
 # -- SSE-C (reference rgw_crypt.cc customer-key encryption) ---------------
@@ -1140,6 +1156,7 @@ class RGWLite:
         return await self._lookup_version_entry(bucket, key,
                                                 version_id)
 
+    @_reclaims_space
     async def delete_object_version(self, bucket: str, key: str,
                                     version_id: str,
                                     bypass_governance: bool = False
@@ -1468,6 +1485,7 @@ class RGWLite:
             out["version_id"] = entry["version_id"]
         return out
 
+    @_reclaims_space
     async def abort_multipart(self, bucket: str, key: str,
                               upload_id: str) -> None:
         await self._check_bucket(
@@ -1814,6 +1832,7 @@ class RGWLite:
             return float(r[f"{kind}_days"]) * 86400
         return None
 
+    @_reclaims_space
     async def lc_process(self, now: float | None = None) -> dict:
         """One LC worker pass over every bucket (RGWLC::process):
         delete current versions whose age exceeds an Enabled rule's
@@ -2216,6 +2235,7 @@ class RGWLite:
                         **ent})
         return out
 
+    @_reclaims_space
     async def gc_process(self, now: float | None = None) -> int:
         """Reap expired GC entries (RGWGC::process); returns the
         number of queue entries deleted."""
@@ -2410,6 +2430,7 @@ class RGWLite:
         # a recreated name must not inherit the old bucket's configs
         self._notif_cache.pop(bucket, None)
 
+    @_reclaims_space
     async def delete_bucket(self, bucket: str) -> None:
         meta = await self._bucket_meta(bucket)
         if self.user is not None and self.user != meta.get("owner"):
@@ -2896,6 +2917,7 @@ class RGWLite:
     async def head_object(self, bucket: str, key: str) -> dict:
         return await self._entry(bucket, key)
 
+    @_reclaims_space
     async def delete_object(self, bucket: str, key: str) -> None:
         meta = await self._check_bucket(
             bucket, "WRITE", action="s3:DeleteObject", key=key)

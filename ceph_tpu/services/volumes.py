@@ -36,6 +36,13 @@ class VolumeManager:
     # -- paths -------------------------------------------------------------
     @staticmethod
     def _group_path(group: str | None) -> str:
+        # Same validation as group_create: the FS client collapses ".."
+        # lexically, so an unvalidated group like "../.." would aim every
+        # subvolume verb (including rm --force) outside /volumes.
+        if group is not None and (
+            "/" in group or group.startswith((".", "_")) or not group
+        ):
+            raise FSError(EINVAL, f"bad group name {group!r}")
         return f"/volumes/{group or NO_GROUP}"
 
     @classmethod

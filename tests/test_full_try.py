@@ -1,0 +1,130 @@
+"""FULL_TRY delete flows on a quota-full pool (round-3 advisor medium).
+
+An S3/Swift DELETE is not a bare RADOS remove: it also appends to the
+bucket bilog ('call'), writes versioned delete markers ('omap_set') and
+enqueues deferred GC work ('create'+'omap_set').  Without the
+CEPH_OSD_FLAG_FULL_TRY analog those sideband writes bounce with EDQUOT
+on a FULL_QUOTA pool and users can never delete their way back under
+quota — the exact deadlock the delete exemption exists to prevent
+(reference: full-try flagged ops pass the pool-full check).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError, full_try
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.codes import EDQUOT_RC
+from ceph_tpu.services.rgw import RGWLite, RGWUsers
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _wait(cond, deadline=25.0, every=0.1):
+    end = asyncio.get_running_loop().time() + deadline
+    while True:
+        if await cond():
+            return
+        assert asyncio.get_running_loop().time() < end, "timeout"
+        await asyncio.sleep(every)
+
+
+def test_s3_delete_from_full_pool():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        await cluster.start_mgr()
+        try:
+            r = await rados.mon_command("osd pool create", pool="rgwq",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("rgwq")
+            gw = RGWLite(io, users=RGWUsers(io), gc_min_wait=3600)
+            await gw.create_bucket("b")
+            await gw.put_object("b", "big", b"x" * 8192)
+            await gw.create_bucket("v")
+            await gw.put_bucket_versioning("v", True)
+            await gw.put_object("v", "vkey", b"y" * 4096)
+            # choke the pool: anything above 1 KiB is over quota
+            r = await rados.mon_command("osd pool set-quota",
+                                        pool="rgwq",
+                                        field="max_bytes", value=1024)
+            assert r["rc"] == 0, r
+
+            async def is_full():
+                r = await rados.mon_command("osd pool get-quota",
+                                            pool="rgwq")
+                return r["data"]["full"]
+            await _wait(is_full)
+
+            # plain writes really are fenced (the quota works)...
+            async def put_blocked():
+                try:
+                    await gw.put_object("b", "more", b"z" * 4096)
+                    return False
+                except RadosError as e:
+                    assert e.rc == EDQUOT_RC, e
+                    return True
+            await _wait(put_blocked)
+
+            # ...but DELETE flows pass end-to-end despite their
+            # sideband writes: GC enqueue (create+omap_set) ...
+            await gw.delete_object("b", "big")
+            assert await gw.gc_list(), "delete should have enqueued GC"
+            # ... versioned delete-marker write (omap_set) ...
+            await gw.delete_object("v", "vkey")
+            listing = await gw.list_object_versions("v")
+            assert any(v.get("delete_marker") for v in listing)
+            # ... and the deferred reap itself (rm + bookkeeping).
+            assert await gw.gc_process(now=time.time() + 7200) >= 1
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_full_try_scope_is_bounded():
+    """The contextvar flags exactly the ops inside the with-block —
+    ordinary writes outside it still answer EDQUOT."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        await cluster.start_mgr()
+        try:
+            r = await rados.mon_command("osd pool create", pool="ft",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("ft")
+            await io.write_full("seed", b"s" * 4096)
+            r = await rados.mon_command("osd pool set-quota",
+                                        pool="ft",
+                                        field="max_bytes", value=1024)
+            assert r["rc"] == 0, r
+
+            async def blocked():
+                try:
+                    await io.write_full("w", b"w")
+                    return False
+                except RadosError as e:
+                    assert e.rc == EDQUOT_RC, e
+                    return True
+            await _wait(blocked)
+            with full_try():
+                await io.write_full("w", b"w")   # flagged: passes
+            with pytest.raises(RadosError) as ei:
+                await io.write_full("w2", b"w")  # unflagged again
+            assert ei.value.rc == EDQUOT_RC
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
