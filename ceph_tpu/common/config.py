@@ -108,6 +108,9 @@ def global_options() -> list[Option]:
                "monitor periodic tick (s)", min=0.05),
         Option("mon_accept_timeout", float, 2.0,
                "paxos accept-phase timeout (s)", min=0.1),
+        Option("mon_sync_timeout", float, 5.0,
+               "store-sync per-chunk timeout before retrying with "
+               "another provider (s)", min=0.1),
         Option("auth_shared_key", str, "",
                "cluster shared auth key ('' = auth disabled)"),
         Option("auth_cluster_required", str, "none",

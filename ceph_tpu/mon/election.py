@@ -53,8 +53,14 @@ class Elector:
         log.dout(5, "%s: starting election epoch %d",
                  self.mon.name, self.epoch)
         for peer in self.mon.peer_names():
+            # the candidacy carries our paxos position: peers refuse to
+            # defer to a candidate beyond their trim window (it could
+            # never catch up as leader and would roll history back)
             self.mon.send_mon(peer, Message(
-                "election_propose", {"epoch": self.epoch},
+                "election_propose", {
+                    "epoch": self.epoch,
+                    "lc": self.mon.paxos.last_committed,
+                },
                 priority=PRIO_HIGHEST,
             ))
         self._arm_timeout()
@@ -85,16 +91,40 @@ class Elector:
         peer = msg.data.get("from", "")
         epoch = int(msg.data["epoch"])
         if msg.type == "election_propose":
-            await self._handle_propose(peer, epoch)
+            await self._handle_propose(peer, epoch,
+                                       msg.data.get("lc"))
         elif msg.type == "election_defer":
             await self._handle_defer(peer, epoch)
         elif msg.type == "election_victory":
             await self._handle_victory(peer, epoch,
                                        list(msg.data["quorum"]))
 
-    async def _handle_propose(self, peer: str, epoch: int) -> None:
+    async def _handle_propose(self, peer: str, epoch: int,
+                              peer_lc: int | None = None) -> None:
         if epoch > self.epoch:
             self.epoch = epoch if epoch % 2 == 1 else epoch + 1
+        sync = getattr(self.mon, "sync", None)
+        if sync is not None and sync.syncing:
+            # mid-store-sync we sit elections out ENTIRELY (no defer):
+            # deferring would put us in the winner's quorum, whose
+            # paxos accepts we cannot answer with a half-built store —
+            # the quorum must form from the remaining majority
+            return
+        from ceph_tpu.mon.paxos import KEEP_VERSIONS
+
+        if (peer_lc is not None
+                and int(peer_lc) + KEEP_VERSIONS
+                <= self.mon.paxos.last_committed):
+            # candidate is beyond the trim window: it must sync, not
+            # lead — advise and push our own candidacy regardless of
+            # rank (probe-phase protection, Monitor.cc:1442)
+            self.mon.send_mon(peer, Message(
+                "mon_sync_advise",
+                {"lc": self.mon.paxos.last_committed},
+            ))
+            if not self.electing:
+                self.start()
+            return
         peer_rank = self.mon.rank_of(peer)
         if peer_rank < self.rank:
             # peer outranks us: defer and ABANDON our own candidacy —

@@ -30,6 +30,7 @@ from ceph_tpu.mon.mgr_stat import MgrStatMonitor
 from ceph_tpu.mon.osd_monitor import OSDMonitor
 from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.service import EPERM_RC, CommandResult, EINVAL_RC
+from ceph_tpu.mon.sync import MonSync
 from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
 from ceph_tpu.msg.codec import encode as codec_encode
 from ceph_tpu.msg.message import Message
@@ -86,6 +87,7 @@ class Monitor:
         self.elector.on_lose = self._on_lose
         self.paxos = Paxos(self, self.store)
         self.paxos.on_commit = self._on_paxos_commit
+        self.sync = MonSync(self)
         self.osd_monitor = OSDMonitor(self)
         self.config_monitor = ConfigMonitor(self)
         self.auth_monitor = AuthMonitor(self)
@@ -174,6 +176,7 @@ class Monitor:
     async def shutdown(self) -> None:
         self._stopped = True
         self.elector.stop()
+        self.sync.stop()
         for t in self._tasks:
             t.cancel()
         for t in list(self._send_tasks):
@@ -187,6 +190,10 @@ class Monitor:
     def bootstrap(self) -> None:
         """Quorum is suspect: call a new election (Monitor::bootstrap)."""
         if self._stopped:
+            return
+        if self.sync.syncing:
+            # mid-store-sync our state is unusable for elections; the
+            # sync completion path bootstraps when the store is whole
             return
         self.paxos.ready = False
         self.elector.start()
@@ -380,6 +387,10 @@ class Monitor:
             if self._is_mon_peer(conn, msg):
                 await self._dispatch_paxos(msg)
             return
+        if t.startswith("mon_sync_"):
+            if self._is_mon_peer(conn, msg):
+                await self._dispatch_sync(msg)
+            return
         if t == "mon_forward":
             # forwarded ops can block on a paxos commit whose accepts ride
             # this very connection — never run them inside the reader loop
@@ -463,7 +474,23 @@ class Monitor:
             return False
         return True
 
+    async def _dispatch_sync(self, msg: Message) -> None:
+        t = msg.type
+        if t == "mon_sync_advise":
+            self.sync.maybe_start(msg.data["from"],
+                                  int(msg.data["lc"]))
+        elif t == "mon_sync_start":
+            await self.sync.handle_start(msg)
+        elif t == "mon_sync_chunk":
+            await self.sync.handle_chunk(msg)
+        elif t == "mon_sync_chunk_ack":
+            await self.sync.handle_ack(msg)
+
     async def _dispatch_paxos(self, msg: Message) -> None:
+        if self.sync.syncing:
+            # a half-replaced store must neither accept nor share paxos
+            # state; the completion path re-elects and catches up
+            return
         if msg.type == "paxos_lease":
             # only the mon we believe leads may extend our lease — a lease
             # from anyone else means quorum views diverged

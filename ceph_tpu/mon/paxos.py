@@ -75,6 +75,22 @@ class Paxos:
     def version_value(self, v: int) -> bytes | None:
         return self.store.get(PREFIX, str(v))
 
+    def reload_from_store(self) -> None:
+        """Adopt a store that was just replaced wholesale (full-store
+        sync): all in-memory paxos state restarts from the new store's
+        truth; any queued proposals are stale by definition."""
+        self.last_committed = self.store.get_int(PREFIX, "last_committed")
+        self.accepted_pn = self.store.get_int(PREFIX, "accepted_pn")
+        self._uncommitted = None
+        self._inflight = None
+        self._collect_acks = {}
+        self.collecting = False
+        self.ready = False
+        self._queue, queue = [], self._queue
+        for _, fut in queue:
+            if not fut.done():
+                fut.set_exception(ConnectionError("store sync"))
+
     def _reset_proposals(self) -> None:
         """Role changed mid-proposal: fail waiters, recover our own
         durably-accepted value so collect can re-propose it."""
@@ -182,6 +198,14 @@ class Paxos:
         # catch lagging peons up
         for peer, ack in self._collect_acks.items():
             peon_lc = int(ack["last_committed"])
+            if (peon_lc < self.last_committed
+                    and self.version_value(peon_lc + 1) is None):
+                # the peon is beyond the trim window: incremental
+                # catch-up is impossible — advise a full-store sync
+                # (Monitor::sync_start role, Monitor.cc:1442)
+                self._send(peer, "mon_sync_advise",
+                           {"lc": self.last_committed})
+                continue
             for v in range(peon_lc + 1, self.last_committed + 1):
                 raw = self.version_value(v)
                 if raw is not None:

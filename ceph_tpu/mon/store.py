@@ -143,6 +143,16 @@ class MonitorDBStore:
     def keys(self, prefix: str) -> Iterator[str]:
         return iter(sorted(self._data.get(prefix, {})))
 
+    def prefixes(self) -> list[str]:
+        return sorted(self._data)
+
+    def iter_all(self) -> Iterator[tuple[str, str, bytes]]:
+        """Every (prefix, key, value) — the store-sync provider's
+        snapshot iteration (MonitorDBStore::get_iterator role)."""
+        for prefix in sorted(self._data):
+            for key in sorted(self._data[prefix]):
+                yield prefix, key, self._data[prefix][key]
+
     def close(self) -> None:
         if self._wal is not None:
             self._wal.close()
