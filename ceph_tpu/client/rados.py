@@ -282,6 +282,9 @@ class Rados:
         return IoCtx(self, pool.pool_id, pool_name)
 
 
+NS_SEP = "\x00"     # wire oid of a namespaced object: "<ns>\x00<name>"
+
+
 class IoCtx:
     """Per-pool IO context (librados rados_ioctx_t / IoCtx)."""
 
@@ -289,11 +292,28 @@ class IoCtx:
         self.rados = rados
         self.pool_id = pool_id
         self.pool_name = pool_name
+        # rados_ioctx_set_namespace: "" = the default namespace.  The
+        # namespace rides the wire INSIDE the oid ("<ns>\x00<name>") so
+        # placement, replication, recovery and scrub treat namespaced
+        # objects like any other; the OSD splits it back out for cap
+        # enforcement (the hobject_t nspace role).
+        self.namespace = ""
         # write SnapContext (rados_ioctx_selfmanaged_snap_set_write_ctx)
         self.snap_seq = 0
         self.snaps: list[int] = []
         # read snap (rados_ioctx_snap_set_read); None = head
         self.read_snap: int | None = None
+
+    def set_namespace(self, namespace: str) -> None:
+        """rados_ioctx_set_namespace ('' = default)."""
+        if NS_SEP in namespace:
+            raise ValueError("namespace may not contain NUL")
+        self.namespace = str(namespace)
+
+    def _noid(self, oid: str) -> str:
+        if NS_SEP in oid:
+            raise ValueError("object name may not contain NUL")
+        return f"{self.namespace}{NS_SEP}{oid}" if self.namespace else oid
 
     def set_snap_context(self, seq: int, snaps: list[int]) -> None:
         """Mutations carry this SnapContext; the OSD clones the head
@@ -333,7 +353,8 @@ class IoCtx:
         if _FULL_TRY.get():
             extra["flags"] = ["full_try"]
         reply = await self.rados.objecter.op_submit(
-            self.pool_id, oid, op.ops, timeout, extra=extra or None
+            self.pool_id, self._noid(oid), op.ops, timeout,
+            extra=extra or None
         )
         if reply["rc"] != 0:
             raise RadosError(reply["rc"], f"operate on {oid!r}")
@@ -409,7 +430,11 @@ class IoCtx:
         names: set[str] = set()
         for ps in range(pool.pg_num):
             names.update(await self._pgls(ps))
-        return sorted(names)
+        if self.namespace:
+            pre = self.namespace + NS_SEP
+            return sorted(n[len(pre):] for n in names
+                          if n.startswith(pre))
+        return sorted(n for n in names if NS_SEP not in n)
 
     async def _pgls(self, ps: int) -> list[str]:
         objecter = self.rados.objecter
@@ -460,7 +485,7 @@ class IoCtx:
         """Register a watch; callback receives each notify payload and may
         return a reply blob (rados_watch3 semantics)."""
         return await self.rados.objecter.linger_watch(
-            self.pool_id, oid, callback
+            self.pool_id, self._noid(oid), callback
         )
 
     async def unwatch(self, handle: LingerOp) -> None:

@@ -58,8 +58,10 @@ def canonical(d: dict) -> bytes:
 # -- caps ------------------------------------------------------------------
 
 def parse_cap(spec: str) -> dict:
-    """``allow *`` / ``allow rw [pool=name]`` / ``allow r [pool=name]``
-    -> {"perm": "*"|"rw"|"r", "pool": name|None}."""
+    """``allow *`` / ``allow rw [pool=name] [namespace=ns]`` ->
+    {"perm": "*"|"rw"|"r", "pool": name|None, "namespace": ns|None}.
+    No namespace clause matches every namespace; ``namespace=`` (empty)
+    matches only the default one (reference OSDCap nspace semantics)."""
     parts = str(spec).split()
     if not parts or parts[0] != "allow" or len(parts) < 2:
         raise ValueError(f"bad cap spec {spec!r}")
@@ -67,15 +69,19 @@ def parse_cap(spec: str) -> dict:
     if perm not in ("*", "rw", "r"):
         raise ValueError(f"bad cap perm {perm!r}")
     pool = None
+    namespace = None
     for extra in parts[2:]:
         if extra.startswith("pool="):
             pool = extra[len("pool="):]
+        elif extra.startswith("namespace="):
+            namespace = extra[len("namespace="):]
         else:
             raise ValueError(f"bad cap qualifier {extra!r}")
-    return {"perm": perm, "pool": pool}
+    return {"perm": perm, "pool": pool, "namespace": namespace}
 
 
-def cap_allows(spec: str, write: bool, pool: str | None = None) -> bool:
+def cap_allows(spec: str, write: bool, pool: str | None = None,
+               namespace: str | None = None) -> bool:
     """Does a cap spec permit this access? Empty spec denies."""
     if not spec:
         return False
@@ -85,6 +91,9 @@ def cap_allows(spec: str, write: bool, pool: str | None = None) -> bool:
         return False
     if cap["pool"] is not None and pool is not None \
             and cap["pool"] != pool:
+        return False
+    if cap["namespace"] is not None and namespace is not None \
+            and cap["namespace"] != namespace:
         return False
     if cap["perm"] == "*":
         return True

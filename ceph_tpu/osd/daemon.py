@@ -472,7 +472,7 @@ class OSDDaemon:
             ))
 
     def _client_caps_deny(self, conn: Connection, pg: PG,
-                          ops: list[dict]) -> bool:
+                          ops: list[dict], oid: str = "") -> bool:
         """OSDCap enforcement on an authenticated client session."""
         if not self.cephx:
             return False
@@ -489,7 +489,11 @@ class OSDDaemon:
             base = self.osdmap.pools.get(pg.pool.tier_of)
             if base is not None:
                 pools.append(base.name)
-        return not any(cap_allows(caps, write=write, pool=p)
+        # the oid carries its rados namespace as "<ns>\x00<name>"
+        # (hobject_t nspace role); caps may be namespace-scoped
+        ns = oid.split("\x00", 1)[0] if "\x00" in oid else ""
+        return not any(cap_allows(caps, write=write, pool=p,
+                                  namespace=ns)
                        for p in pools)
 
     # -- dispatch ----------------------------------------------------------
@@ -3077,7 +3081,8 @@ class OSDDaemon:
                 pg.waiting_for_active.append((conn, d))
                 return
             ops = list(d["ops"])
-            if self._client_caps_deny(conn, pg, ops):
+            if self._client_caps_deny(conn, pg, ops,
+                                      str(d.get("oid", ""))):
                 self._reply(conn, tid, EPERM_RC)
                 return
             top = self.op_tracker.create(

@@ -17,6 +17,8 @@ shaped client stack:
 from ceph_tpu.services.cls import ClassRegistry, ClsError
 from ceph_tpu.services.mgr import Mgr
 from ceph_tpu.services.rbd import RBD, Image
+from ceph_tpu.services.rbd_group import RBDGroups
 from ceph_tpu.services.rgw import RGWLite
 
-__all__ = ["RBD", "ClassRegistry", "ClsError", "Image", "Mgr", "RGWLite"]
+__all__ = ["RBD", "RBDGroups", "ClassRegistry", "ClsError", "Image",
+           "Mgr", "RGWLite"]

@@ -42,6 +42,7 @@ from ceph_tpu.services.rbd_journal import (
 DIRECTORY_OID = "rbd_directory"
 CHILDREN_OID = "rbd_children"
 TRASH_OID = "rbd_trash"
+NAMESPACES_OID = "rbd_namespaces"   # default-ns omap: name -> meta
 DEFAULT_ORDER = 22          # 4 MiB objects
 
 
@@ -50,16 +51,78 @@ class RBDError(IOError):
 
 
 class RBD:
-    """Image management (librbd rbd_create/rbd_remove/rbd_list)."""
+    """Image management (librbd rbd_create/rbd_remove/rbd_list).
+
+    Namespaces (reference src/librbd/api/Namespace.cc): a handle whose
+    IoCtx carries a rados namespace (``ioctx.set_namespace``) scopes
+    every image object — directory, headers, data — to it, so listings
+    and lookups are isolated per namespace and namespace-scoped OSD
+    caps (``allow rw pool=p namespace=ns``) fence clients off at the
+    OSD.  The namespace registry itself lives in the pool's DEFAULT
+    namespace (the rbd_namespace object role)."""
 
     def __init__(self, ioctx: IoCtx):
         self.ioctx = ioctx
+
+    def _default_io(self) -> IoCtx:
+        """A default-namespace view of the same pool (the namespace
+        registry must be visible from every namespace handle)."""
+        if not self.ioctx.namespace:
+            return self.ioctx
+        return IoCtx(self.ioctx.rados, self.ioctx.pool_id,
+                     self.ioctx.pool_name)
+
+    # -- namespaces (librbd/api/Namespace.cc) ------------------------------
+    async def namespace_create(self, name: str) -> None:
+        if not name or "/" in name or "\x00" in name:
+            raise RBDError(f"bad namespace name {name!r}")
+        io = self._default_io()
+        existing = await self.namespace_list()
+        if name in existing:
+            raise RBDError(f"namespace {name!r} exists")
+        await io.operate(NAMESPACES_OID, ObjectOperation()
+                         .create()
+                         .omap_set({name: json.dumps(
+                             {"created_at": time.time()}).encode()}))
+
+    async def namespace_list(self) -> list[str]:
+        io = self._default_io()
+        try:
+            return sorted(await io.get_omap(NAMESPACES_OID))
+        except RadosError as e:
+            if e.rc == -2:
+                return []
+            raise
+
+    async def namespace_exists(self, name: str) -> bool:
+        return name in await self.namespace_list()
+
+    async def namespace_remove(self, name: str) -> None:
+        """Refuse while the namespace still holds images (reference
+        Namespace::remove returns -EBUSY)."""
+        io = self._default_io()
+        if name not in await self.namespace_list():
+            raise RBDError(f"no namespace {name!r}")
+        ns_io = IoCtx(self.ioctx.rados, self.ioctx.pool_id,
+                      self.ioctx.pool_name)
+        ns_io.set_namespace(name)
+        if await RBD(ns_io).list():
+            raise RBDError(f"namespace {name!r} still has images")
+        await io.rm_omap_keys(NAMESPACES_OID, [name])
+
+    async def _check_namespace(self) -> None:
+        if self.ioctx.namespace and not await self.namespace_exists(
+                self.ioctx.namespace):
+            raise RBDError(
+                f"namespace {self.ioctx.namespace!r} does not exist"
+            )
 
     async def create(self, name: str, size: int,
                      order: int = DEFAULT_ORDER,
                      object_map: bool = True) -> str:
         if not 12 <= order <= 26:
             raise RBDError(f"order {order} out of range")
+        await self._check_namespace()
         image_id = secrets.token_hex(8)
         id_oid = f"rbd_id.{name}"
         try:
@@ -152,6 +215,16 @@ class RBD:
 
     async def remove(self, name: str) -> None:
         img = await self.open(name)
+        try:
+            await self.ioctx.get_xattr(f"rbd_header.{img.image_id}",
+                                       "group")
+            raise RBDError(
+                f"image {name!r} belongs to a group; remove it from "
+                "the group first"
+            )
+        except RadosError as e:
+            if e.rc != -2:
+                raise
         if img.snaps:
             raise RBDError(
                 f"image {name!r} has snapshots; remove them first"
@@ -454,6 +527,7 @@ class Image:
         # must not clobber the caller's ioctx or other open images
         # (librbd likewise keeps per-image state in ImageCtx)
         self.ioctx = IoCtx(ioctx.rados, ioctx.pool_id, ioctx.pool_name)
+        self.ioctx.set_namespace(ioctx.namespace)
         self.name = name
         self.image_id = image_id
         self.size = 0
@@ -839,6 +913,12 @@ class Image:
                 if self._lock_renew_task is None:
                     self._lock_renew_task = asyncio.create_task(
                         self._lock_renew_loop())
+                # the image may have changed hands while we were not
+                # the owner: adopt the current header — especially the
+                # snap context, or our next write would overwrite a
+                # snapshot another owner just took instead of COWing
+                # (librbd refreshes after the lock acquires too)
+                await self.refresh()
                 return
             try:
                 await self.ioctx.notify(

@@ -30,9 +30,12 @@ def _clean_local():
 # unit: caps + tickets
 
 def test_cap_grammar():
-    assert parse_cap("allow *") == {"perm": "*", "pool": None}
-    assert parse_cap("allow rw pool=data") == {"perm": "rw",
-                                               "pool": "data"}
+    assert parse_cap("allow *") == {"perm": "*", "pool": None,
+                                    "namespace": None}
+    assert parse_cap("allow rw pool=data") == {
+        "perm": "rw", "pool": "data", "namespace": None}
+    assert parse_cap("allow rw pool=data namespace=ns1") == {
+        "perm": "rw", "pool": "data", "namespace": "ns1"}
     for bad in ("deny *", "allow", "allow x", "allow rw host=a"):
         with pytest.raises(ValueError):
             parse_cap(bad)
@@ -42,6 +45,17 @@ def test_cap_grammar():
     assert cap_allows("allow r", write=False, pool="x")
     assert not cap_allows("allow r", write=True, pool="x")
     assert not cap_allows("", write=False)
+    # namespace scoping: no clause matches every namespace; a clause
+    # matches exactly its namespace ("" = default only)
+    spec = "allow rw pool=data namespace=ns1"
+    assert cap_allows(spec, write=True, pool="data", namespace="ns1")
+    assert not cap_allows(spec, write=True, pool="data", namespace="")
+    assert not cap_allows(spec, write=True, pool="data",
+                          namespace="ns2")
+    assert cap_allows("allow rw pool=data", write=True, pool="data",
+                      namespace="ns2")
+    assert not cap_allows("allow rw pool=data namespace=", write=True,
+                          pool="data", namespace="ns2")
 
 
 def test_ticket_seal_verify_and_rotation_window():
