@@ -282,7 +282,11 @@ class Rados:
         return IoCtx(self, pool.pool_id, pool_name)
 
 
-NS_SEP = "\x00"     # wire oid of a namespaced object: "<ns>\x00<name>"
+# Wire oid of a namespaced object: "\x1d<ns>\x1d<name>".  The leading
+# group-separator marker cannot collide with ordinary oids (RGW index
+# shards legitimately embed NULs, so "<ns>\x00<name>" would be
+# ambiguous); default-namespace oids ride unchanged.
+NS_SEP = "\x1d"
 
 
 class IoCtx:
@@ -293,7 +297,7 @@ class IoCtx:
         self.pool_id = pool_id
         self.pool_name = pool_name
         # rados_ioctx_set_namespace: "" = the default namespace.  The
-        # namespace rides the wire INSIDE the oid ("<ns>\x00<name>") so
+        # namespace rides the wire INSIDE the oid (see NS_SEP) so
         # placement, replication, recovery and scrub treat namespaced
         # objects like any other; the OSD splits it back out for cap
         # enforcement (the hobject_t nspace role).
@@ -307,13 +311,15 @@ class IoCtx:
     def set_namespace(self, namespace: str) -> None:
         """rados_ioctx_set_namespace ('' = default)."""
         if NS_SEP in namespace:
-            raise ValueError("namespace may not contain NUL")
+            raise ValueError("namespace may not contain \\x1d")
         self.namespace = str(namespace)
 
     def _noid(self, oid: str) -> str:
-        if NS_SEP in oid:
-            raise ValueError("object name may not contain NUL")
-        return f"{self.namespace}{NS_SEP}{oid}" if self.namespace else oid
+        if oid.startswith(NS_SEP):
+            raise ValueError("object name may not start with \\x1d")
+        if self.namespace:
+            return f"{NS_SEP}{self.namespace}{NS_SEP}{oid}"
+        return oid
 
     def set_snap_context(self, seq: int, snaps: list[int]) -> None:
         """Mutations carry this SnapContext; the OSD clones the head
@@ -431,10 +437,10 @@ class IoCtx:
         for ps in range(pool.pg_num):
             names.update(await self._pgls(ps))
         if self.namespace:
-            pre = self.namespace + NS_SEP
+            pre = NS_SEP + self.namespace + NS_SEP
             return sorted(n[len(pre):] for n in names
                           if n.startswith(pre))
-        return sorted(n for n in names if NS_SEP not in n)
+        return sorted(n for n in names if not n.startswith(NS_SEP))
 
     async def _pgls(self, ps: int) -> list[str]:
         objecter = self.rados.objecter

@@ -489,9 +489,10 @@ class OSDDaemon:
             base = self.osdmap.pools.get(pg.pool.tier_of)
             if base is not None:
                 pools.append(base.name)
-        # the oid carries its rados namespace as "<ns>\x00<name>"
+        # the oid carries its rados namespace as "\x1d<ns>\x1d<name>"
         # (hobject_t nspace role); caps may be namespace-scoped
-        ns = oid.split("\x00", 1)[0] if "\x00" in oid else ""
+        ns = oid[1:].split("\x1d", 1)[0] if oid.startswith("\x1d") \
+            else ""
         return not any(cap_allows(caps, write=write, pool=p,
                                   namespace=ns)
                        for p in pools)

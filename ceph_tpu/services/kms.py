@@ -1,0 +1,201 @@
+"""KMS integration for RGW server-side encryption (SSE-KMS / SSE-S3).
+
+Reference: src/rgw/rgw_kms.h — RGW never stores master keys; it asks a
+KMS backend (vault / kmip / testing) to wrap a fresh per-object data
+key under a named, versioned master key, and stores only the wrapped
+blob with the object (rgw_crypt.cc wiring).  Rotating a master key adds
+a NEW version for future wraps; every old version is kept, so objects
+wrapped before rotation still unwrap — the property the S3 API
+guarantees and the tests pin.
+
+Backends:
+- ``ConfigKeyKMS``: master keys live in the monitor's config-key store
+  (the reference's testing backend keeps them in ceph config likewise)
+  under ``<prefix>/<key_id>/<version>``.
+- ``LocalKMS``: in-process dict, for unit tests without a cluster.
+
+Data keys are 32-byte AES-256 keys, wrapped with AES-256-GCM under the
+master key (authenticated: a tampered blob fails loudly, it cannot
+decrypt to garbage).
+"""
+
+from __future__ import annotations
+
+import secrets
+
+
+class KMSError(IOError):
+    pass
+
+
+def _wrap(master: bytes, plaintext: bytes) -> dict:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    nonce = secrets.token_bytes(12)
+    ct = AESGCM(master).encrypt(nonce, plaintext, b"rgw-kms")
+    return {"nonce": nonce.hex(), "ct": ct.hex()}
+
+
+def _unwrap(master: bytes, blob: dict) -> bytes:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    try:
+        return AESGCM(master).decrypt(
+            bytes.fromhex(blob["nonce"]), bytes.fromhex(blob["ct"]),
+            b"rgw-kms",
+        )
+    except (InvalidTag, ValueError, KeyError) as e:
+        raise KMSError(f"data key unwrap failed: {e}") from e
+
+
+class KMS:
+    """Backend interface (rgw_kms.h RGWKMS role)."""
+
+    async def create_key(self, key_id: str) -> None:
+        raise NotImplementedError
+
+    async def rotate_key(self, key_id: str) -> int:
+        """Add a new master-key version; returns the new version."""
+        raise NotImplementedError
+
+    async def list_keys(self) -> list[str]:
+        raise NotImplementedError
+
+    async def generate_data_key(self, key_id: str
+                                ) -> tuple[bytes, dict]:
+        """-> (plaintext 32-byte data key, wrapped blob to store)."""
+        raise NotImplementedError
+
+    async def unwrap_data_key(self, key_id: str, wrapped: dict
+                              ) -> bytes:
+        raise NotImplementedError
+
+    # shared wrap bookkeeping over backend-provided master storage
+    async def _master(self, key_id: str, version: int) -> bytes:
+        raise NotImplementedError
+
+    async def _current_version(self, key_id: str,
+                               create: bool = False) -> int:
+        raise NotImplementedError
+
+
+class _MasterKeyKMS(KMS):
+    """Wrap/unwrap over any versioned master-key storage."""
+
+    async def generate_data_key(self, key_id: str
+                                ) -> tuple[bytes, dict]:
+        version = await self._current_version(key_id, create=True)
+        master = await self._master(key_id, version)
+        dk = secrets.token_bytes(32)
+        blob = _wrap(master, dk)
+        blob["v"] = version
+        blob["key_id"] = key_id
+        return dk, blob
+
+    async def unwrap_data_key(self, key_id: str, wrapped: dict
+                              ) -> bytes:
+        version = int(wrapped.get("v", 1))
+        master = await self._master(key_id, version)
+        return _unwrap(master, wrapped)
+
+
+class LocalKMS(_MasterKeyKMS):
+    """In-memory test backend."""
+
+    def __init__(self):
+        self._keys: dict[str, list[bytes]] = {}
+
+    async def create_key(self, key_id: str) -> None:
+        self._keys.setdefault(key_id, [secrets.token_bytes(32)])
+
+    async def rotate_key(self, key_id: str) -> int:
+        if key_id not in self._keys:
+            raise KMSError(f"no such key {key_id!r}")
+        self._keys[key_id].append(secrets.token_bytes(32))
+        return len(self._keys[key_id])
+
+    async def list_keys(self) -> list[str]:
+        return sorted(self._keys)
+
+    async def _master(self, key_id: str, version: int) -> bytes:
+        versions = self._keys.get(key_id)
+        if versions is None or not 1 <= version <= len(versions):
+            raise KMSError(f"no key {key_id!r} v{version}")
+        return versions[version - 1]
+
+    async def _current_version(self, key_id: str,
+                               create: bool = False) -> int:
+        if key_id not in self._keys:
+            if not create:
+                raise KMSError(f"no such key {key_id!r}")
+            await self.create_key(key_id)
+        return len(self._keys[key_id])
+
+
+class ConfigKeyKMS(_MasterKeyKMS):
+    """Master keys in the monitor config-key store (the reference's
+    testing backend keeps them in ceph config the same way):
+    ``<prefix>/<key_id>/v<version>`` -> hex key material,
+    ``<prefix>/<key_id>/current`` -> version number."""
+
+    def __init__(self, rados, prefix: str = "rgw/crypt"):
+        self.rados = rados
+        self.prefix = prefix.rstrip("/")
+
+    async def _get(self, key: str) -> str | None:
+        r = await self.rados.mon_command("config-key get", key=key)
+        if r["rc"] != 0:
+            return None
+        return r["data"]
+
+    async def _set(self, key: str, value: str) -> None:
+        r = await self.rados.mon_command("config-key set", key=key,
+                                         value=value)
+        if r["rc"] != 0:
+            raise KMSError(f"config-key set {key!r} failed: {r}")
+
+    async def create_key(self, key_id: str) -> None:
+        cur = await self._get(f"{self.prefix}/{key_id}/current")
+        if cur is not None:
+            return
+        await self._set(f"{self.prefix}/{key_id}/v1",
+                        secrets.token_bytes(32).hex())
+        await self._set(f"{self.prefix}/{key_id}/current", "1")
+
+    async def rotate_key(self, key_id: str) -> int:
+        cur = await self._get(f"{self.prefix}/{key_id}/current")
+        if cur is None:
+            raise KMSError(f"no such key {key_id!r}")
+        nxt = int(cur) + 1
+        await self._set(f"{self.prefix}/{key_id}/v{nxt}",
+                        secrets.token_bytes(32).hex())
+        await self._set(f"{self.prefix}/{key_id}/current", str(nxt))
+        return nxt
+
+    async def list_keys(self) -> list[str]:
+        r = await self.rados.mon_command("config-key ls")
+        if r["rc"] != 0:
+            return []
+        pre = self.prefix + "/"
+        out = set()
+        for k in r["data"]:
+            if k.startswith(pre) and k.endswith("/current"):
+                out.add(k[len(pre):-len("/current")])
+        return sorted(out)
+
+    async def _master(self, key_id: str, version: int) -> bytes:
+        raw = await self._get(f"{self.prefix}/{key_id}/v{version}")
+        if raw is None:
+            raise KMSError(f"no key {key_id!r} v{version}")
+        return bytes.fromhex(raw)
+
+    async def _current_version(self, key_id: str,
+                               create: bool = False) -> int:
+        cur = await self._get(f"{self.prefix}/{key_id}/current")
+        if cur is None:
+            if not create:
+                raise KMSError(f"no such key {key_id!r}")
+            await self.create_key(key_id)
+            return 1
+        return int(cur)

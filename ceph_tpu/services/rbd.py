@@ -74,7 +74,7 @@ class RBD:
 
     # -- namespaces (librbd/api/Namespace.cc) ------------------------------
     async def namespace_create(self, name: str) -> None:
-        if not name or "/" in name or "\x00" in name:
+        if not name or "/" in name or "\x1d" in name:
             raise RBDError(f"bad namespace name {name!r}")
         io = self._default_io()
         existing = await self.namespace_list()

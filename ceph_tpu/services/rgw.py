@@ -105,12 +105,19 @@ def sse_crypt(key: bytes, nonce: bytes, offset: int,
 
 def sse_check(entry: dict, key: bytes | None) -> None:
     """S3 semantics: an SSE-C object requires the matching key on every
-    read; presenting a key for a plaintext object is an error too."""
+    read; presenting a key for a plaintext object is an error too.
+    KMS-managed entries (SSE-KMS / SSE-S3, marked by a wrapped data
+    key) are server-decrypted — presenting an SSE-C key is an error."""
     sse = entry.get("sse")
     if sse is None:
         if key is not None:
             raise RGWError("InvalidRequest",
                            "object is not SSE-C encrypted")
+        return
+    if sse.get("wrapped") is not None:
+        if key is not None:
+            raise RGWError("InvalidRequest",
+                           "object is KMS-encrypted, not SSE-C")
         return
     if key is None:
         raise RGWError("InvalidRequest",
@@ -367,6 +374,18 @@ class StreamingPut:
         # deflate), matching the buffered put_object path
         self._comp_alg = None
 
+    def set_sse_kms(self, data_key: bytes, sse_record: dict) -> None:
+        """SSE-KMS / SSE-S3 streaming: encrypt under a KMS-wrapped
+        data key (from RGWLite._kms_begin); the record (with the
+        wrapped blob) rides the entry."""
+        if self._pos:
+            raise RGWError("InvalidRequest",
+                           "encryption must start before the first "
+                           "body chunk")
+        self._sse = dict(sse_record)
+        self._sse_key = data_key
+        self._comp_alg = None
+
     async def write(self, chunk: bytes) -> None:
         if self._pos + len(chunk) > self.length:
             await self.abort()
@@ -459,7 +478,8 @@ class RGWLite:
                  user: str | None = None,
                  users: "RGWUsers | None" = None,
                  gc_min_wait: float = 0.0,
-                 auto_reshard_objs: int = 0):
+                 auto_reshard_objs: int = 0,
+                 kms=None):
         """``datalog``: append every mutation to the per-bucket data log
         (the cls_rgw bilog) so a multisite sync agent can tail it.
         ``user``: the acting identity for ACL/quota enforcement (None =
@@ -476,6 +496,8 @@ class RGWLite:
         self.users = users
         self.gc_min_wait = gc_min_wait
         self.auto_reshard_objs = auto_reshard_objs
+        # KMS backend for SSE-KMS / SSE-S3 (services.kms; rgw_kms.h)
+        self.kms = kms
         # bucket -> (fetched_at, notification configs); shared across
         # as_user handles so invalidation is seen by every identity
         self._notif_cache: dict[str, tuple[float, list]] = {}
@@ -487,9 +509,56 @@ class RGWLite:
     def as_user(self, user: str | None) -> "RGWLite":
         """A handle acting as ``user`` over the same pool."""
         child = RGWLite(self.ioctx, self.datalog, user, self.users,
-                        self.gc_min_wait, self.auto_reshard_objs)
+                        self.gc_min_wait, self.auto_reshard_objs,
+                        kms=self.kms)
         child._notif_cache = self._notif_cache
         return child
+
+    # -- SSE-KMS / SSE-S3 (rgw_kms.h + rgw_crypt.cc wiring) ---------------
+    DEFAULT_KMS_KEY = "rgw/default"      # x-amz-...-aws-kms-key-id absent
+    SSE_S3_KEY = "rgw/sse-s3"            # zone-managed SSE-S3 master key
+
+    async def _kms_begin(self, alg: str, key_id: str | None
+                         ) -> tuple[bytes, dict]:
+        """Fresh per-object data key + the sse record to store (the
+        wrapped blob rides the entry; the plaintext key never lands)."""
+        if self.kms is None:
+            raise RGWError("InvalidRequest",
+                           "server-side encryption requires a KMS")
+        if alg == "aws:kms":
+            key_id = key_id or self.DEFAULT_KMS_KEY
+        elif alg == "AES256":
+            key_id = self.SSE_S3_KEY     # SSE-S3: zone-managed key
+        else:
+            raise RGWError("InvalidArgument",
+                           f"bad server-side encryption {alg!r}")
+        dk, wrapped = await self.kms.generate_data_key(key_id)
+        return dk, {
+            "alg": alg, "key_id": key_id, "wrapped": wrapped,
+            "nonce": secrets.token_bytes(16).hex(),
+        }
+
+    async def _entry_sse_key(self, entry: dict,
+                             sse_key: bytes | None) -> bytes | None:
+        """Resolve the data key that decrypts ``entry`` — the
+        presented SSE-C key, a KMS unwrap, or None for plaintext."""
+        from ceph_tpu.services.kms import KMSError
+
+        sse_check(entry, sse_key)
+        sse = entry.get("sse")
+        if sse is None:
+            return None
+        if sse.get("wrapped") is not None:
+            if self.kms is None:
+                raise RGWError("InvalidRequest",
+                               "object is KMS-encrypted but no KMS "
+                               "is configured")
+            try:
+                return await self.kms.unwrap_data_key(
+                    sse["key_id"], sse["wrapped"])
+            except KMSError as e:
+                raise RGWError("AccessDenied", str(e)) from e
+        return sse_key
 
     # -- ACL (rgw_acl.cc canned subset + explicit grants) ------------------
     async def _bucket_meta(self, bucket: str) -> dict:
@@ -1131,19 +1200,19 @@ class RGWLite:
                                  action="s3:GetObjectVersion", key=key)
         entry = await self._lookup_version_entry(bucket, key,
                                                  version_id)
-        sse_check(entry, sse_key)
+        dk = await self._entry_sse_key(entry, sse_key)
         if entry.get("comp"):
             data = await self._inflate_read(entry, None)
-        elif sse_key is not None and entry["sse"].get("multipart"):
+        elif dk is not None and entry["sse"].get("multipart"):
             data = await self._read_manifest(
                 entry["multipart"], int(entry["size"]), None,
-                sse_key=sse_key)
+                sse_key=dk)
         else:
             data = await self._read_entry_data(bucket, key, entry,
                                                None)
-            if sse_key is not None:
+            if dk is not None:
                 data = sse_crypt(
-                    sse_key, bytes.fromhex(entry["sse"]["nonce"]),
+                    dk, bytes.fromhex(entry["sse"]["nonce"]),
                     0, data)
         return {"data": data, **entry}
 
@@ -1238,16 +1307,25 @@ class RGWLite:
                                  content_type: str =
                                  "binary/octet-stream",
                                  metadata: dict | None = None,
-                                 lock: dict | None = None) -> str:
+                                 lock: dict | None = None,
+                                 sse: str | None = None,
+                                 kms_key_id: str | None = None) -> str:
         """S3 CreateMultipartUpload -> upload id.  ``lock``: object
         -lock headers ride the INITIATE (S3 applies them to the
-        assembled object at complete)."""
+        assembled object at complete).  ``sse``/``kms_key_id``:
+        SSE-KMS / SSE-S3 — one data key is wrapped at initiate and
+        every part encrypts under it (its own nonce per part)."""
         meta = await self._check_bucket(bucket, "WRITE",
                                        action="s3:PutObject", key=key)
         if lock:
             # validate now: a bad mode must fail the initiate, not
             # the complete after every part is uploaded
             self._stage_lock({"meta": meta}, lock)
+        sse_kms = None
+        if sse is not None:
+            _, rec = await self._kms_begin(sse, kms_key_id)
+            sse_kms = {"alg": rec["alg"], "key_id": rec["key_id"],
+                       "wrapped": rec["wrapped"]}
         upload_id = secrets.token_hex(8)
         await self.ioctx.operate(
             self._mp_meta_oid(bucket, key, upload_id),
@@ -1258,6 +1336,7 @@ class RGWLite:
                     "meta": dict(metadata or {}),
                     "owner": self.user or "",
                     "lock": lock,
+                    "sse_kms": sse_kms,
                 }).encode(),
             }),
         )
@@ -1286,12 +1365,29 @@ class RGWLite:
             raise RGWError("InvalidArgument", "part number 1..10000")
         meta = await self._check_bucket(
             bucket, "WRITE", action="s3:PutObject", key=key)
-        await self._mp_meta(bucket, key, upload_id)
+        info = json.loads(
+            (await self._mp_meta(bucket, key, upload_id))["_meta"])
         await self._check_quota(bucket, meta, len(data),
                                 replaced_size=0, is_replace=False)
         etag = hashlib.md5(data).hexdigest()
         rec = {"etag": etag, "size": len(data)}
-        if sse_key is not None:
+        if info.get("sse_kms") is not None:
+            if sse_key is not None:
+                raise RGWError("InvalidRequest",
+                               "upload uses KMS encryption, not SSE-C")
+            from ceph_tpu.services.kms import KMSError
+
+            sk = info["sse_kms"]
+            try:
+                dk = await self.kms.unwrap_data_key(sk["key_id"],
+                                                    sk["wrapped"])
+            except (AttributeError, KMSError) as e:
+                raise RGWError("InvalidRequest",
+                               f"KMS unwrap failed: {e}") from e
+            nonce = secrets.token_bytes(16).hex()
+            data = sse_crypt(dk, bytes.fromhex(nonce), 0, data)
+            rec["sse"] = {"nonce": nonce, "kms": True}
+        elif sse_key is not None:
             sse = sse_begin(sse_key)
             data = sse_crypt(sse_key, bytes.fromhex(sse["nonce"]),
                              0, data)
@@ -1364,6 +1460,9 @@ class RGWLite:
                                                    upload_id)}
         if not parts:
             raise RGWError("InvalidArgument", "empty part list")
+        meta_omap = await self._mp_meta(bucket, key, upload_id)
+        info = json.loads(meta_omap["_meta"])
+        kms_mode = info.get("sse_kms") is not None
         manifest = []
         total = 0
         digest_md5 = hashlib.md5()
@@ -1383,12 +1482,20 @@ class RGWLite:
             psse = have.get("sse")
             if psse is not None:
                 item["nonce"] = psse["nonce"]
-            sse_md5s.add(psse["key_md5"] if psse else None)
+            if kms_mode:
+                if psse is None or not psse.get("kms"):
+                    raise RGWError(
+                        "InvalidRequest",
+                        "plaintext part inside a KMS-encrypted upload")
+            else:
+                sse_md5s.add(psse.get("key_md5") if psse else None)
             manifest.append(item)
             total += have["size"]
             digest_md5.update(bytes.fromhex(etag))
         entry_sse = None
-        if sse_md5s != {None}:
+        if kms_mode:
+            entry_sse = {**info["sse_kms"], "multipart": True}
+        elif sse_md5s != {None}:
             # encrypted parts: every part must be under the SAME key,
             # and a plaintext part cannot hide inside an SSE-C object
             if None in sse_md5s or len(sse_md5s) != 1:
@@ -1397,8 +1504,6 @@ class RGWLite:
                     "all parts must use the same SSE-C key")
             entry_sse = {"alg": "AES256", "key_md5": sse_md5s.pop(),
                          "multipart": True}
-        meta_omap = await self._mp_meta(bucket, key, upload_id)
-        info = json.loads(meta_omap["_meta"])
         # the assembled size is the real quota event (parts are not in
         # the bucket index, so per-part checks cannot see each other)
         bucket_meta = await self._bucket_meta(bucket)
@@ -2642,25 +2747,39 @@ class RGWLite:
                          if_none_match: bool = False,
                          sse_key: bytes | None = None,
                          tags: dict[str, str] | None = None,
-                         lock: dict | None = None) -> dict:
+                         lock: dict | None = None,
+                         sse: str | None = None,
+                         kms_key_id: str | None = None) -> dict:
         """S3 PUT. ``if_none_match``: fail when the key exists ('*').
         ``sse_key``: SSE-C customer key (32 bytes, AES-256).
+        ``sse``: server-managed encryption — "aws:kms" (SSE-KMS, key
+        named by ``kms_key_id``) or "AES256" (SSE-S3, zone key); the
+        x-amz-server-side-encryption header.
         ``tags``: object tags (the x-amz-tagging header).
         ``lock``: explicit object-lock state for the new version:
         {mode, until, legal_hold} (x-amz-object-lock-* headers)."""
         if tags:
             self.validate_tags(tags)
+        if sse is not None and sse_key is not None:
+            raise RGWError("InvalidArgument",
+                           "SSE-C and server-side encryption are "
+                           "mutually exclusive")
         ctx = await self._prepare_put(bucket, key, len(data),
                                       if_none_match, lock=lock)
         etag = hashlib.md5(data).hexdigest()
         size = len(data)
         comp = None
-        if ctx.get("compression") == "zlib" and sse_key is None:
+        if ctx.get("compression") == "zlib" and sse_key is None \
+                and sse is None:
             # compress-at-rest (rgw_compression.cc): S3-visible
             # size/etag stay the original
             data, comp = deflate_if_smaller(data)
-        sse = None
-        if sse_key is not None:
+        if sse is not None:
+            dk, kms_sse = await self._kms_begin(sse, kms_key_id)
+            data = sse_crypt(dk, bytes.fromhex(kms_sse["nonce"]),
+                             0, data)
+            sse = kms_sse
+        elif sse_key is not None:
             sse = sse_begin(sse_key)
             data = sse_crypt(sse_key, bytes.fromhex(sse["nonce"]),
                              0, data)
@@ -2744,22 +2863,23 @@ class RGWLite:
                          range_: tuple[int, int] | None = None,
                          sse_key: bytes | None = None) -> dict:
         """S3 GET (optionally a byte range, inclusive bounds).
-        ``sse_key``: the SSE-C customer key for encrypted objects."""
+        ``sse_key``: the SSE-C customer key for encrypted objects;
+        SSE-KMS / SSE-S3 objects decrypt server-side via the KMS."""
         entry = await self._entry(bucket, key)
-        sse_check(entry, sse_key)
+        dk = await self._entry_sse_key(entry, sse_key)
         if entry.get("comp"):
             # compressed at rest: ranges slice the INFLATED bytes
             data = await self._inflate_read(entry, range_)
             return {"data": data, **entry}
-        if sse_key is not None and entry["sse"].get("multipart"):
+        if dk is not None and entry["sse"].get("multipart"):
             data = await self._read_manifest(
                 entry["multipart"], int(entry["size"]), range_,
-                sse_key=sse_key)
+                sse_key=dk)
             return {"data": data, **entry}
         data = await self._read_entry_data(bucket, key, entry, range_)
-        if sse_key is not None:
+        if dk is not None:
             start = range_[0] if range_ is not None else 0
-            data = sse_crypt(sse_key,
+            data = sse_crypt(dk,
                              bytes.fromhex(entry["sse"]["nonce"]),
                              start, data)
         return {"data": data, **entry}
@@ -2973,12 +3093,23 @@ class RGWLite:
         await self._log(bucket, "del", key)
 
     async def copy_object(self, src_bucket: str, src_key: str,
-                          dst_bucket: str, dst_key: str) -> dict:
-        got = await self.get_object(src_bucket, src_key)
+                          dst_bucket: str, dst_key: str,
+                          src_sse_key: bytes | None = None,
+                          sse_key: bytes | None = None,
+                          sse: str | None = None,
+                          kms_key_id: str | None = None) -> dict:
+        """S3 CopyObject.  A KMS-encrypted source decrypts server-side
+        (no key needed); SSE-C sources need ``src_sse_key``.  The
+        destination re-encrypts per ``sse``/``kms_key_id``/``sse_key``
+        — copies never splice ciphertext, so source and destination
+        keys are independent (rgw_crypt.cc copy rule)."""
+        got = await self.get_object(src_bucket, src_key,
+                                    sse_key=src_sse_key)
         return await self.put_object(
             dst_bucket, dst_key, got["data"],
             content_type=got["content_type"], metadata=got["meta"],
             tags=got.get("tags") or None,
+            sse_key=sse_key, sse=sse, kms_key_id=kms_key_id,
         )
 
     async def list_objects(self, bucket: str, prefix: str = "",

@@ -1032,12 +1032,14 @@ class S3Frontend:
         q = req.query
         if req.method == "POST":
             if "uploads" in q:
+                kms_alg, kms_key = _sse_kms_headers(req)
                 upload_id = await gw.initiate_multipart(
                     bucket, key,
                     content_type=req.header("content-type",
                                             "binary/octet-stream"),
                     metadata=_meta_headers(req),
                     lock=_lock_headers(req),
+                    sse=kms_alg, kms_key_id=kms_key,
                 )
                 root = ET.Element("InitiateMultipartUploadResult",
                                   xmlns=XMLNS)
@@ -1118,12 +1120,21 @@ class S3Frontend:
             src = req.header("x-amz-copy-source")
             if src:
                 sb, _, sk = src.lstrip("/").partition("/")
-                out = await gw.copy_object(sb, urllib.parse.unquote(sk),
-                                           bucket, key)
+                kms_alg, kms_key = _sse_kms_headers(req)
+                out = await gw.copy_object(
+                    sb, urllib.parse.unquote(sk), bucket, key,
+                    src_sse_key=_copy_source_sse_key(req),
+                    sse_key=_sse_key_headers(req),
+                    sse=kms_alg, kms_key_id=kms_key)
                 root = ET.Element("CopyObjectResult", xmlns=XMLNS)
                 ET.SubElement(root, "ETag").text = f'"{out["etag"]}"'
                 return self._xml(root)
             sse_key = _sse_key_headers(req)
+            kms_alg, kms_key = _sse_kms_headers(req)
+            if kms_alg is not None and sse_key is not None:
+                raise _HTTPError(400, "InvalidArgument",
+                                 "SSE-C and x-amz-server-side-"
+                                 "encryption are mutually exclusive")
             htags = _header_tags(req)
             if htags:
                 # validate AND authorize before any body lands: S3
@@ -1135,7 +1146,8 @@ class S3Frontend:
                     key=key)
             if req.stream is not None:
                 out = await self._streaming_put(req, gw, bucket, key,
-                                                sse_key)
+                                                sse_key, kms_alg,
+                                                kms_key)
                 if htags:
                     # attach to OUR upload only (etag-guarded: a
                     # racing overwrite must not inherit them); a
@@ -1158,6 +1170,7 @@ class S3Frontend:
                     sse_key=sse_key,
                     lock=_lock_headers(req),
                     tags=htags,
+                    sse=kms_alg, kms_key_id=kms_key,
                 )
             hdrs = {"etag": f'"{out["etag"]}"'}
             if out.get("version_id"):
@@ -1165,6 +1178,11 @@ class S3Frontend:
             if sse_key is not None:
                 hdrs["x-amz-server-side-encryption-customer-algorithm"] \
                     = "AES256"
+            if kms_alg is not None:
+                hdrs["x-amz-server-side-encryption"] = kms_alg
+                if kms_alg == "aws:kms":
+                    hdrs["x-amz-server-side-encryption-aws-kms-key-id"] \
+                        = kms_key or RGWLite.DEFAULT_KMS_KEY
             return 200, hdrs, b""
         if req.method == "DELETE":
             if "tagging" in q:
@@ -1267,7 +1285,9 @@ class S3Frontend:
 
     async def _streaming_put(self, req: _Request, gw: RGWLite,
                              bucket: str, key: str,
-                             sse_key: bytes | None) -> dict:
+                             sse_key: bytes | None,
+                             kms_alg: str | None = None,
+                             kms_key: str | None = None) -> dict:
         """Drain the socket body straight into an RGWLite streaming
         session, hashing as it goes; the declared x-amz-content-sha256
         is enforced at the end (a signed-over hash that lied about the
@@ -1283,6 +1303,9 @@ class S3Frontend:
         )
         if sse_key is not None:
             sp.set_sse_key(sse_key)
+        elif kms_alg is not None:
+            dk, rec = await gw._kms_begin(kms_alg, kms_key)
+            sp.set_sse_kms(dk, rec)
         declared = req.header("x-amz-content-sha256")
         sha = (hashlib.sha256()
                if declared and declared != "UNSIGNED-PAYLOAD" else None)
@@ -1327,6 +1350,24 @@ def _meta_headers(req: _Request) -> dict[str, str]:
 
 
 _SSE_PREFIX = "x-amz-server-side-encryption-customer-"
+
+
+def _sse_kms_headers(req: _Request) -> tuple[str | None, str | None]:
+    """Server-managed encryption headers (rgw_crypt.cc SSE-KMS /
+    SSE-S3): x-amz-server-side-encryption ∈ {aws:kms, AES256} plus the
+    optional x-amz-server-side-encryption-aws-kms-key-id."""
+    alg = req.header("x-amz-server-side-encryption")
+    if not alg:
+        return None, None
+    if alg not in ("aws:kms", "AES256"):
+        raise _HTTPError(400, "InvalidArgument",
+                         f"unsupported server-side encryption {alg!r}")
+    key_id = req.header(
+        "x-amz-server-side-encryption-aws-kms-key-id") or None
+    if key_id and alg != "aws:kms":
+        raise _HTTPError(400, "InvalidArgument",
+                         "a KMS key id requires aws:kms")
+    return alg, key_id
 
 
 def _copy_source_sse_key(req: _Request) -> bytes | None:
@@ -1385,7 +1426,14 @@ def _obj_headers(got: dict) -> dict[str, str]:
     if got.get("legal_hold"):
         hdrs["x-amz-object-lock-legal-hold"] = "ON"
     sse = got.get("sse")
-    if sse:
+    if sse and sse.get("wrapped") is not None:
+        # KMS-managed (SSE-KMS / SSE-S3): server-side headers, never
+        # the customer-key ones
+        hdrs["x-amz-server-side-encryption"] = sse.get("alg", "aws:kms")
+        if sse.get("alg") == "aws:kms":
+            hdrs["x-amz-server-side-encryption-aws-kms-key-id"] = \
+                sse.get("key_id", "")
+    elif sse:
         import base64
 
         hdrs[_SSE_PREFIX + "algorithm"] = sse.get("alg", "AES256")

@@ -1,0 +1,224 @@
+"""SSE-KMS / SSE-S3 server-managed encryption (round-3 missing #3;
+reference src/rgw/rgw_kms.h + rgw_crypt.cc).
+
+Per-object data keys wrapped under named, versioned KMS master keys;
+the wrapped blob rides the index entry, plaintext keys never land.
+Key rotation adds a version — old objects keep decrypting (the pinned
+property).  Covers buffered + multipart + copy paths, the mon
+config-key-store test KMS, and the REST header surface.
+"""
+
+import asyncio
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.kms import ConfigKeyKMS, KMSError, LocalKMS
+from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers
+from ceph_tpu.services.rgw_http import S3Frontend
+
+from tests.test_services import start_cluster, stop_cluster
+from tests.test_rgw_http import S3HttpClient
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _gw(rados, kms, pool="kmsp"):
+    await rados.pool_create(pool, pg_num=8)
+    ioctx = await rados.open_ioctx(pool)
+    return RGWLite(ioctx, users=RGWUsers(ioctx), kms=kms)
+
+
+def test_kms_roundtrip_and_rotation():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            kms = LocalKMS()
+            gw = await _gw(rados, kms)
+            await gw.create_bucket("b")
+
+            out = await gw.put_object("b", "old", b"secret-v1" * 100,
+                                      sse="aws:kms")
+            assert out["etag"]
+            # stored ciphertext, entry carries the wrapped key only
+            entry = await gw._entry("b", "old")
+            assert entry["sse"]["alg"] == "aws:kms"
+            assert entry["sse"]["key_id"] == RGWLite.DEFAULT_KMS_KEY
+            assert entry["sse"]["wrapped"]["v"] == 1
+            raw = await gw.ioctx.read(entry["data_oid"])
+            assert b"secret-v1" not in raw
+            # transparent decrypt; presenting an SSE-C key is an error
+            got = await gw.get_object("b", "old")
+            assert got["data"] == b"secret-v1" * 100
+            with pytest.raises(RGWError, match="KMS-encrypted"):
+                await gw.get_object("b", "old", sse_key=b"k" * 32)
+            # ranged read decrypts the window
+            got = await gw.get_object("b", "old", range_=(9, 17))
+            assert got["data"] == b"secret-v1"
+
+            # ROTATE: new objects wrap under v2, old ones still decrypt
+            assert await kms.rotate_key(RGWLite.DEFAULT_KMS_KEY) == 2
+            await gw.put_object("b", "new", b"secret-v2",
+                                sse="aws:kms")
+            e2 = await gw._entry("b", "new")
+            assert e2["sse"]["wrapped"]["v"] == 2
+            assert (await gw.get_object("b", "old"))["data"] == \
+                b"secret-v1" * 100
+            assert (await gw.get_object("b", "new"))["data"] == \
+                b"secret-v2"
+
+            # SSE-S3: zone-managed key, same transparency
+            await gw.put_object("b", "s3enc", b"zone-key-data",
+                                sse="AES256")
+            e3 = await gw._entry("b", "s3enc")
+            assert e3["sse"]["alg"] == "AES256"
+            assert e3["sse"]["key_id"] == RGWLite.SSE_S3_KEY
+            assert (await gw.get_object("b", "s3enc"))["data"] == \
+                b"zone-key-data"
+
+            # explicit key id + tampered wrapped blob fails loudly
+            await gw.put_object("b", "named", b"x", sse="aws:kms",
+                                kms_key_id="teamA/key1")
+            e4 = await gw._entry("b", "named")
+            assert e4["sse"]["key_id"] == "teamA/key1"
+            with pytest.raises(KMSError):
+                await kms.unwrap_data_key(
+                    "teamA/key1",
+                    {**e4["sse"]["wrapped"], "ct": "00" * 48})
+            await rados.shutdown()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_kms_multipart_and_copy():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            kms = LocalKMS()
+            gw = await _gw(rados, kms)
+            await gw.create_bucket("b")
+
+            up = await gw.initiate_multipart("b", "mp", sse="aws:kms")
+            p1 = await gw.upload_part("b", "mp", up, 1, b"A" * 5000)
+            p2 = await gw.upload_part("b", "mp", up, 2, b"B" * 3000)
+            # SSE-C part inside a KMS upload refuses
+            with pytest.raises(RGWError, match="KMS"):
+                await gw.upload_part("b", "mp", up, 3, b"C",
+                                     sse_key=b"k" * 32)
+            out = await gw.complete_multipart(
+                "b", "mp", up,
+                [(1, p1["etag"]), (2, p2["etag"])])
+            assert out["etag"].endswith("-2")
+            got = await gw.get_object("b", "mp")
+            assert got["data"] == b"A" * 5000 + b"B" * 3000
+            got = await gw.get_object("b", "mp", range_=(4998, 5001))
+            assert got["data"] == b"AABB"
+
+            # rotation does not break the assembled object either
+            await kms.rotate_key(RGWLite.DEFAULT_KMS_KEY)
+            assert (await gw.get_object("b", "mp"))["data"][:4] == \
+                b"AAAA"
+
+            # copy: KMS source decrypts server-side; destination
+            # re-encrypts under its own policy
+            await gw.copy_object("b", "mp", "b", "plain-copy")
+            e = await gw._entry("b", "plain-copy")
+            assert "sse" not in e
+            assert (await gw.get_object("b", "plain-copy"))["data"] \
+                == b"A" * 5000 + b"B" * 3000
+            await gw.copy_object("b", "plain-copy", "b", "kms-copy",
+                                 sse="aws:kms", kms_key_id="cp/key")
+            e = await gw._entry("b", "kms-copy")
+            assert e["sse"]["key_id"] == "cp/key"
+            assert (await gw.get_object("b", "kms-copy"))["data"] == \
+                b"A" * 5000 + b"B" * 3000
+            await rados.shutdown()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_config_key_store_kms():
+    """The ConfigKeyKMS holds master keys in the monitor's config-key
+    store: they survive the gateway, list properly, and rotation keeps
+    old versions available (reference testing backend semantics)."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            kms = ConfigKeyKMS(rados)
+            gw = await _gw(rados, kms)
+            await gw.create_bucket("b")
+            await gw.put_object("b", "o", b"config-key-backed",
+                                sse="aws:kms")
+            await kms.rotate_key(RGWLite.DEFAULT_KMS_KEY)
+            await gw.put_object("b", "o2", b"post-rotation",
+                                sse="aws:kms")
+            assert (await gw.get_object("b", "o"))["data"] == \
+                b"config-key-backed"
+            assert (await gw.get_object("b", "o2"))["data"] == \
+                b"post-rotation"
+            assert RGWLite.DEFAULT_KMS_KEY in await kms.list_keys()
+            # the material really is in the mon store
+            r = await rados.mon_command(
+                "config-key get",
+                key=f"rgw/crypt/{RGWLite.DEFAULT_KMS_KEY}/current")
+            assert r["rc"] == 0 and r["data"] == "2"
+            # a FRESH kms handle (new gateway instance) still unwraps
+            gw2 = RGWLite(gw.ioctx, users=gw.users,
+                          kms=ConfigKeyKMS(rados))
+            assert (await gw2.get_object("b", "o"))["data"] == \
+                b"config-key-backed"
+            await rados.shutdown()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_kms_rest_headers():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            ioctx = await rados.open_ioctx("rgw")
+            users = RGWUsers(ioctx)
+            alice = await users.create("alice")
+            gw = RGWLite(ioctx, users=users, kms=LocalKMS())
+            fe = S3Frontend(gw, users=users)
+            host, port = await fe.start()
+            cli = S3HttpClient(host, port, alice["access_key"],
+                               alice["secret_key"])
+            st, _, _ = await cli.request("PUT", "/b")
+            assert st == 200
+            st, hdrs, _ = await cli.request(
+                "PUT", "/b/enc", b"header-driven",
+                headers={"x-amz-server-side-encryption": "aws:kms"})
+            assert st == 200, hdrs
+            assert hdrs["x-amz-server-side-encryption"] == "aws:kms"
+            assert hdrs["x-amz-server-side-encryption-aws-kms-key-id"] \
+                == RGWLite.DEFAULT_KMS_KEY
+            st, hdrs, body = await cli.request("GET", "/b/enc")
+            assert st == 200 and body == b"header-driven"
+            assert hdrs["x-amz-server-side-encryption"] == "aws:kms"
+            # HEAD carries the encryption headers too
+            st, hdrs, _ = await cli.request("HEAD", "/b/enc")
+            assert st == 200
+            assert hdrs["x-amz-server-side-encryption"] == "aws:kms"
+            # bad algorithm refused
+            st, _, body = await cli.request(
+                "PUT", "/b/bad", b"x",
+                headers={"x-amz-server-side-encryption": "rot13"})
+            assert st == 400
+            assert ET.fromstring(body).findtext("Code") == \
+                "InvalidArgument"
+            await fe.stop()
+            await rados.shutdown()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
