@@ -108,6 +108,49 @@ async def _dispatch(args, gw: RGWLite, users: RGWUsers):
             return await gw.gc_list()
         if args.sub == "process":
             return {"reaped": await gw.gc_process()}
+    if args.cmd in ("realm", "zonegroup", "zone", "period"):
+        from ceph_tpu.services.rgw_zone import RealmStore
+
+        store = RealmStore(gw.ioctx)
+        if args.cmd == "realm":
+            if args.sub == "create":
+                return await store.realm_create(args.rgw_realm)
+            if args.sub == "list":
+                return await store.realm_list()
+            if args.sub == "get":
+                return await store.realm_get(args.rgw_realm)
+        if args.cmd == "zonegroup":
+            if args.sub == "create":
+                return await store.zonegroup_create(
+                    args.rgw_realm, args.rgw_zonegroup,
+                    master=args.master)
+            if args.sub == "list":
+                return await store.zonegroup_list(args.rgw_realm)
+        if args.cmd == "zone":
+            if args.sub == "create":
+                return await store.zone_create(
+                    args.rgw_realm, args.rgw_zonegroup,
+                    args.rgw_zone, endpoint=args.endpoint,
+                    master=args.master)
+            if args.sub == "modify":
+                return await store.zone_modify(
+                    args.rgw_realm, args.rgw_zonegroup,
+                    args.rgw_zone,
+                    endpoint=args.endpoint or None,
+                    master=args.master or None)
+            if args.sub == "rm":
+                await store.zone_rm(args.rgw_realm,
+                                    args.rgw_zonegroup, args.rgw_zone)
+                return {"removed": args.rgw_zone}
+        if args.cmd == "period":
+            if args.sub == "update":
+                return await store.period_update(args.rgw_realm,
+                                                 commit=args.commit)
+            if args.sub == "get":
+                return await store.period_get(
+                    args.rgw_realm, args.period_id or None)
+            if args.sub == "list":
+                return await store.period_list(args.rgw_realm)
     raise RGWError("InvalidArgument", f"{args.cmd} {args.sub}")
 
 
@@ -166,6 +209,45 @@ def build_parser() -> argparse.ArgumentParser:
     gc_sub = gc.add_subparsers(dest="sub", required=True)
     gc_sub.add_parser("list")
     gc_sub.add_parser("process")
+
+    # multisite config model (rgw_zone.h realm/zonegroup/zone/period)
+    realm = sub.add_parser("realm")
+    realm_sub = realm.add_subparsers(dest="sub", required=True)
+    for name in ("create", "get"):
+        x = realm_sub.add_parser(name)
+        x.add_argument("--rgw-realm", required=True)
+    realm_sub.add_parser("list")
+
+    zg = sub.add_parser("zonegroup")
+    zg_sub = zg.add_subparsers(dest="sub", required=True)
+    zgc = zg_sub.add_parser("create")
+    zgc.add_argument("--rgw-realm", required=True)
+    zgc.add_argument("--rgw-zonegroup", required=True)
+    zgc.add_argument("--master", action="store_true")
+    zgl = zg_sub.add_parser("list")
+    zgl.add_argument("--rgw-realm", required=True)
+
+    zone = sub.add_parser("zone")
+    zone_sub = zone.add_subparsers(dest="sub", required=True)
+    for name in ("create", "modify", "rm"):
+        x = zone_sub.add_parser(name)
+        x.add_argument("--rgw-realm", required=True)
+        x.add_argument("--rgw-zonegroup", required=True)
+        x.add_argument("--rgw-zone", required=True)
+        if name != "rm":
+            x.add_argument("--endpoint", default="")
+            x.add_argument("--master", action="store_true")
+
+    period = sub.add_parser("period")
+    period_sub = period.add_subparsers(dest="sub", required=True)
+    pu = period_sub.add_parser("update")
+    pu.add_argument("--rgw-realm", required=True)
+    pu.add_argument("--commit", action="store_true")
+    pg = period_sub.add_parser("get")
+    pg.add_argument("--rgw-realm", required=True)
+    pg.add_argument("--period-id", default="")
+    pl = period_sub.add_parser("list")
+    pl.add_argument("--rgw-realm", required=True)
     return p
 
 

@@ -1,0 +1,372 @@
+"""RGW multisite configuration model: realm / zonegroup / zone / period.
+
+Reference src/rgw/rgw_zone.h (RGWRealm :918-921, RGWZoneGroup,
+RGWZoneParams, RGWPeriod): multisite topology is not ad-hoc zone pairs
+but a REALM whose configuration evolves through immutable, epoch-
+numbered PERIODS.  Zonegroup/zone verbs stage changes; nothing takes
+effect until ``period update --commit`` publishes a new period — the
+unit gateways and sync agents reconfigure on, with no restarts.  The
+commit bumps the realm epoch, links the new period to its predecessor,
+and notifies the realm's control object so running daemons react
+immediately (watch/notify; polling remains the fallback).
+
+Storage (the ``.rgw.root`` pool role) in one pool:
+- ``rgw.realms``                omap: realm name -> realm record
+- ``rgw.realm.periods.<realm>`` omap: period id -> period record
+- ``rgw.realm.staging.<realm>`` staged (uncommitted) topology json
+- ``rgw.realm.ctl.<realm>``     watch/notify target for period commits
+
+The SyncOrchestrator consumes periods: given gateway handles per zone,
+it runs one RGWSyncAgent per secondary zone pulling from the
+zonegroup's master, tearing down / spinning up agents as period
+commits change the topology (rgw_period_pusher.cc + RGWRealmReloader
+role).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import secrets
+import time
+
+from ceph_tpu.client.rados import IoCtx, ObjectOperation, RadosError
+from ceph_tpu.common.log import Dout
+from ceph_tpu.services.rgw import RGWError
+
+log = Dout("rgw-sync")
+
+REALMS_OID = "rgw.realms"
+
+
+def _empty_topology() -> dict:
+    return {"zonegroups": {}}
+
+
+class RealmStore:
+    """Realm/zonegroup/zone/period verbs over one config pool."""
+
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+
+    @staticmethod
+    def _periods_oid(realm: str) -> str:
+        return f"rgw.realm.periods.{realm}"
+
+    @staticmethod
+    def _staging_oid(realm: str) -> str:
+        return f"rgw.realm.staging.{realm}"
+
+    @staticmethod
+    def ctl_oid(realm: str) -> str:
+        return f"rgw.realm.ctl.{realm}"
+
+    # -- realms -----------------------------------------------------------
+    async def realm_create(self, name: str) -> dict:
+        if not name or "/" in name:
+            raise RGWError("InvalidArgument", f"bad realm name {name!r}")
+        if name in await self.realm_list():
+            raise RGWError("InvalidArgument", f"realm {name!r} exists")
+        rec = {
+            "id": secrets.token_hex(8), "name": name,
+            "current_period": "", "epoch": 0,
+        }
+        await self.ioctx.operate(REALMS_OID, ObjectOperation()
+                                 .create()
+                                 .omap_set({name: json.dumps(
+                                     rec).encode()}))
+        await self.ioctx.operate(self._staging_oid(name),
+                                 ObjectOperation().create()
+                                 .write_full(json.dumps(
+                                     _empty_topology()).encode()))
+        await self.ioctx.operate(self.ctl_oid(name),
+                                 ObjectOperation().create())
+        return rec
+
+    async def realm_list(self) -> list[str]:
+        try:
+            return sorted(await self.ioctx.get_omap(REALMS_OID))
+        except RadosError as e:
+            if e.rc == -2:
+                return []
+            raise
+
+    async def realm_get(self, name: str) -> dict:
+        try:
+            kv = await self.ioctx.get_omap(REALMS_OID, [name])
+        except RadosError as e:
+            if e.rc == -2:
+                kv = {}
+            else:
+                raise
+        if name not in kv:
+            raise RGWError("NoSuchKey", f"no realm {name!r}")
+        return json.loads(kv[name])
+
+    async def _realm_put(self, rec: dict) -> None:
+        await self.ioctx.set_omap(REALMS_OID, {
+            rec["name"]: json.dumps(rec).encode(),
+        })
+
+    # -- staged topology --------------------------------------------------
+    async def _staging(self, realm: str) -> dict:
+        await self.realm_get(realm)
+        try:
+            raw = await self.ioctx.read(self._staging_oid(realm))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            return _empty_topology()
+        return json.loads(raw) if raw else _empty_topology()
+
+    async def _stage(self, realm: str, topo: dict) -> None:
+        await self.ioctx.operate(
+            self._staging_oid(realm),
+            ObjectOperation().write_full(json.dumps(topo).encode()),
+        )
+
+    async def zonegroup_create(self, realm: str, name: str,
+                               master: bool = False) -> dict:
+        topo = await self._staging(realm)
+        if name in topo["zonegroups"]:
+            raise RGWError("InvalidArgument",
+                           f"zonegroup {name!r} exists")
+        zg = {"name": name, "master": bool(master),
+              "master_zone": "", "zones": {}}
+        if master:
+            for other in topo["zonegroups"].values():
+                other["master"] = False
+        topo["zonegroups"][name] = zg
+        await self._stage(realm, topo)
+        return zg
+
+    async def zonegroup_list(self, realm: str) -> list[str]:
+        return sorted((await self._staging(realm))["zonegroups"])
+
+    async def zone_create(self, realm: str, zonegroup: str, name: str,
+                          endpoint: str = "",
+                          master: bool = False) -> dict:
+        topo = await self._staging(realm)
+        zg = topo["zonegroups"].get(zonegroup)
+        if zg is None:
+            raise RGWError("NoSuchKey",
+                           f"no zonegroup {zonegroup!r}")
+        for other in topo["zonegroups"].values():
+            if name in other["zones"]:
+                raise RGWError("InvalidArgument",
+                               f"zone {name!r} exists")
+        zone = {"name": name, "endpoint": endpoint}
+        zg["zones"][name] = zone
+        if master or not zg["master_zone"]:
+            zg["master_zone"] = name
+        await self._stage(realm, topo)
+        return zone
+
+    async def zone_modify(self, realm: str, zonegroup: str, name: str,
+                          endpoint: str | None = None,
+                          master: bool | None = None) -> dict:
+        topo = await self._staging(realm)
+        zg = topo["zonegroups"].get(zonegroup)
+        if zg is None or name not in zg["zones"]:
+            raise RGWError("NoSuchKey", f"no zone {name!r}")
+        if endpoint is not None:
+            zg["zones"][name]["endpoint"] = endpoint
+        if master:
+            zg["master_zone"] = name
+        await self._stage(realm, topo)
+        return zg["zones"][name]
+
+    async def zone_rm(self, realm: str, zonegroup: str,
+                      name: str) -> None:
+        topo = await self._staging(realm)
+        zg = topo["zonegroups"].get(zonegroup)
+        if zg is None or name not in zg["zones"]:
+            raise RGWError("NoSuchKey", f"no zone {name!r}")
+        if zg["master_zone"] == name:
+            raise RGWError("InvalidArgument",
+                           "cannot remove the master zone; promote "
+                           "another first")
+        del zg["zones"][name]
+        await self._stage(realm, topo)
+
+    # -- periods ----------------------------------------------------------
+    async def period_update(self, realm: str,
+                            commit: bool = False) -> dict:
+        """Staged topology -> a NEW period; with ``commit`` it becomes
+        the realm's current period (epoch += 1) and the realm control
+        object is notified so live daemons reconfigure (the reference's
+        period commit + RGWRealmNotify)."""
+        rec = await self.realm_get(realm)
+        topo = await self._staging(realm)
+        masters = [zg for zg in topo["zonegroups"].values()
+                   if zg["zones"]]
+        if commit and not masters:
+            raise RGWError("InvalidArgument",
+                           "cannot commit an empty period")
+        period = {
+            "id": secrets.token_hex(8),
+            "realm": realm,
+            "epoch": rec["epoch"] + 1,
+            "predecessor": rec["current_period"],
+            "staged_at": time.time(),
+            "committed": bool(commit),
+            "topology": topo,
+        }
+        await self.ioctx.operate(
+            self._periods_oid(realm),
+            ObjectOperation().create().omap_set({
+                period["id"]: json.dumps(period).encode(),
+            }),
+        )
+        if commit:
+            rec["current_period"] = period["id"]
+            rec["epoch"] = period["epoch"]
+            await self._realm_put(rec)
+            try:
+                await self.ioctx.notify(
+                    self.ctl_oid(realm),
+                    json.dumps({"period": period["id"],
+                                "epoch": period["epoch"]}).encode(),
+                    timeout=2.0)
+            except RadosError:
+                pass        # no watchers yet: polling catches up
+        return period
+
+    async def period_get(self, realm: str,
+                         period_id: str | None = None) -> dict:
+        """A period by id, or the realm's CURRENT committed period."""
+        if period_id is None:
+            rec = await self.realm_get(realm)
+            period_id = rec["current_period"]
+            if not period_id:
+                raise RGWError("NoSuchKey",
+                               f"realm {realm!r} has no committed "
+                               "period")
+        try:
+            kv = await self.ioctx.get_omap(self._periods_oid(realm),
+                                           [period_id])
+        except RadosError as e:
+            if e.rc == -2:
+                kv = {}
+            else:
+                raise
+        if period_id not in kv:
+            raise RGWError("NoSuchKey", f"no period {period_id!r}")
+        return json.loads(kv[period_id])
+
+    async def period_list(self, realm: str) -> list[dict]:
+        try:
+            omap = await self.ioctx.get_omap(self._periods_oid(realm))
+        except RadosError as e:
+            if e.rc == -2:
+                return []
+            raise
+        return sorted((json.loads(v) for v in omap.values()),
+                      key=lambda p: p["epoch"])
+
+
+class SyncOrchestrator:
+    """Runs the sync topology a committed period describes.
+
+    ``gateways`` maps zone name -> RGWLite handle (each zone is a
+    pool/cluster of its own; the handle is its data plane).  For every
+    zonegroup, each non-master zone gets one RGWSyncAgent pulling from
+    the master zone.  A period commit (watch/notify on the realm ctl
+    object, or the poll fallback) atomically re-plans: agents for
+    removed zones stop, new zones start, unchanged pairs keep their
+    markers (sync positions live on the secondary, so replans lose
+    nothing)."""
+
+    def __init__(self, store: RealmStore, realm: str,
+                 gateways: dict, poll_interval: float = 0.5):
+        from ceph_tpu.services.rgw_sync import RGWSyncAgent
+
+        self._agent_cls = RGWSyncAgent
+        self.store = store
+        self.realm = realm
+        self.gateways = dict(gateways)
+        self.poll_interval = poll_interval
+        self.period_id: str | None = None
+        self.agents: dict[tuple[str, str], object] = {}
+        self._task: asyncio.Task | None = None
+        self._watch = None
+        self._kick = asyncio.Event()
+        self._stopped = False
+
+    async def start(self) -> None:
+        try:
+            self._watch = await self.store.ioctx.watch(
+                self.store.ctl_oid(self.realm), self._notified)
+        except RadosError:
+            self._watch = None           # polling only
+        self._task = asyncio.get_running_loop().create_task(
+            self._run())
+
+    async def _notified(self, payload: bytes) -> bytes | None:
+        self._kick.set()
+        return b"ack"
+
+    async def _run(self) -> None:
+        while not self._stopped:
+            try:
+                await self._maybe_replan()
+            except (RGWError, RadosError, ConnectionError) as e:
+                log.derr("orchestrator replan failed: %s", e)
+            try:
+                await asyncio.wait_for(self._kick.wait(),
+                                       self.poll_interval)
+            except asyncio.TimeoutError:
+                pass
+            except asyncio.CancelledError:
+                return
+            self._kick.clear()
+
+    async def _maybe_replan(self) -> None:
+        try:
+            period = await self.store.period_get(self.realm)
+        except RGWError:
+            return                       # nothing committed yet
+        if period["id"] == self.period_id:
+            return
+        await self._apply(period)
+
+    async def _apply(self, period: dict) -> None:
+        want: dict[tuple[str, str], tuple] = {}
+        for zg in period["topology"]["zonegroups"].values():
+            master = zg.get("master_zone")
+            if not master or master not in self.gateways:
+                continue
+            for zname in zg["zones"]:
+                if zname == master or zname not in self.gateways:
+                    continue
+                want[(master, zname)] = (self.gateways[master],
+                                        self.gateways[zname])
+        # stop agents the new period no longer wants
+        for pair in [p for p in self.agents if p not in want]:
+            await self.agents.pop(pair).stop()
+        # start the new ones
+        for pair, (src, dst) in want.items():
+            if pair not in self.agents:
+                agent = self._agent_cls(src, dst)
+                agent.start()
+                self.agents[pair] = agent
+        self.period_id = period["id"]
+        log.dout(1, "realm %s now at period %s (%d sync agents)",
+                 self.realm, period["id"], len(self.agents))
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        for agent in self.agents.values():
+            await agent.stop()
+        self.agents = {}
+        if self._watch is not None:
+            try:
+                await self.store.ioctx.unwatch(self._watch)
+            except RadosError:
+                pass

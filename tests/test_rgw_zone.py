@@ -1,0 +1,152 @@
+"""RGW realm / zonegroup / zone / period config model (round-3 missing
+#4; reference src/rgw/rgw_zone.h:918-921 RGWRealm/RGWPeriod).
+
+Zonegroup/zone verbs stage changes; only ``period update --commit``
+publishes them — and a running SyncOrchestrator re-plans its sync
+agents from the new period WITHOUT restarts (RGWRealmReloader role).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rgw import RGWError, RGWLite
+from ceph_tpu.services.rgw_zone import RealmStore, SyncOrchestrator
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _zone(ns: str):
+    cluster = DevCluster(n_mons=1, n_osds=3, ns=ns)
+    await cluster.start()
+    rados = await cluster.client(f"client.{ns}admin")
+    await rados.pool_create("rgw", pg_num=4, size=3, min_size=2)
+    io = await rados.open_ioctx("rgw")
+    return cluster, rados, RGWLite(io)
+
+
+async def _wait(cond, deadline=15.0, every=0.05):
+    end = asyncio.get_running_loop().time() + deadline
+    while True:
+        if await cond():
+            return
+        assert asyncio.get_running_loop().time() < end, "timeout"
+        await asyncio.sleep(every)
+
+
+def test_period_model_staging_and_commit():
+    async def run():
+        cluster, rados, gw = await _zone("zr-")
+        try:
+            store = RealmStore(gw.ioctx)
+            realm = await store.realm_create("gold")
+            assert await store.realm_list() == ["gold"]
+            assert realm["epoch"] == 0 and not realm["current_period"]
+
+            await store.zonegroup_create("gold", "us", master=True)
+            await store.zone_create("gold", "us", "us-east",
+                                    endpoint="http://east")
+            await store.zone_create("gold", "us", "us-west",
+                                    endpoint="http://west")
+            # staged only: no committed period yet
+            with pytest.raises(RGWError, match="no committed"):
+                await store.period_get("gold")
+
+            p1 = await store.period_update("gold", commit=True)
+            assert p1["epoch"] == 1 and p1["committed"]
+            assert p1["predecessor"] == ""
+            cur = await store.period_get("gold")
+            zg = cur["topology"]["zonegroups"]["us"]
+            assert zg["master_zone"] == "us-east"
+            assert sorted(zg["zones"]) == ["us-east", "us-west"]
+
+            # further staging is invisible until the next commit
+            await store.zone_create("gold", "us", "us-central")
+            cur = await store.period_get("gold")
+            assert "us-central" not in \
+                cur["topology"]["zonegroups"]["us"]["zones"]
+            p2 = await store.period_update("gold", commit=True)
+            assert p2["epoch"] == 2 and p2["predecessor"] == p1["id"]
+            cur = await store.period_get("gold")
+            assert "us-central" in \
+                cur["topology"]["zonegroups"]["us"]["zones"]
+            # full period history, epoch-ordered
+            hist = await store.period_list("gold")
+            assert [p["epoch"] for p in hist] == [1, 2]
+            # the master zone cannot be dropped
+            with pytest.raises(RGWError, match="master"):
+                await store.zone_rm("gold", "us", "us-east")
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_period_commit_reconfigures_sync_without_restarts():
+    async def run():
+        c1, r1, east = await _zone("ze-")
+        c2, r2, west = await _zone("zw-")
+        c3, r3, south = await _zone("zs-")
+        orch = None
+        try:
+            store = RealmStore(east.ioctx)       # config rides zone east
+            await store.realm_create("gold")
+            await store.zonegroup_create("gold", "us", master=True)
+            await store.zone_create("gold", "us", "east", master=True)
+            await store.zone_create("gold", "us", "west")
+            await store.period_update("gold", commit=True)
+
+            orch = SyncOrchestrator(
+                store, "gold",
+                {"east": east, "west": west, "south": south},
+                poll_interval=0.1)
+            await orch.start()
+            await _wait(lambda: asyncio.sleep(0, len(orch.agents) == 1))
+
+            await east.create_bucket("b")
+            await east.put_object("b", "k", b"to-west")
+
+            async def west_has():
+                try:
+                    return (await west.get_object("b", "k"))["data"] \
+                        == b"to-west"
+                except RGWError:
+                    return False
+            await _wait(west_has)
+
+            # RECONFIGURE via period commit: zone south joins — the
+            # running orchestrator picks it up, nothing restarts
+            await store.zone_create("gold", "us", "south")
+            await store.period_update("gold", commit=True)
+            await _wait(lambda: asyncio.sleep(0, len(orch.agents) == 2))
+
+            async def south_has():
+                try:
+                    return (await south.get_object("b", "k"))["data"] \
+                        == b"to-west"
+                except RGWError:
+                    return False
+            await _wait(south_has)
+
+            # and a zone can leave the same way
+            await store.zone_rm("gold", "us", "west")
+            await store.period_update("gold", commit=True)
+            await _wait(lambda: asyncio.sleep(0, len(orch.agents) == 1))
+            assert ("east", "south") in orch.agents
+            await r1.shutdown()
+            await r2.shutdown()
+            await r3.shutdown()
+        finally:
+            if orch is not None:
+                await orch.stop()
+            await c1.stop()
+            await c2.stop()
+            await c3.stop()
+    asyncio.run(run())
