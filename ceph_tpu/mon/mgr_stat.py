@@ -36,6 +36,10 @@ from ceph_tpu.mon.config_monitor import KEY_PREFIX as CONFKEY_PREFIX
 ORCH_SPEC_PREFIX = "orch/spec/"
 ORCH_RM_PREFIX = "orch/rm/"
 
+# mirrored by services/mgr_perf.py (the modules read what we stage)
+_PQ_SPEC_PREFIX = "mgr/osd_perf_query/"
+_TRASH_SCHED_PREFIX = "mgr/rbd_support/trash_sched/"
+
 
 class MgrStatMonitor(PaxosService):
     prefix = PREFIX
@@ -250,6 +254,53 @@ class MgrStatMonitor(PaxosService):
                 "total_bytes": int(self.digest.get("num_bytes", 0)),
                 "osd_df": self.digest.get("osd_df", {}),
             })
+        if name == "iostat":
+            return CommandResult(data=self.digest.get("iostat", {}))
+        if name == "rbd perf image iostat":
+            rs = self.digest.get("rbd_support", {})
+            return CommandResult(data=rs.get("image_iostat", {}))
+        if name == "rbd trash purge schedule ls":
+            import json
+
+            out = []
+            for key in self.store.keys(CONFKEY_PREFIX):
+                if not key.startswith(_TRASH_SCHED_PREFIX):
+                    continue
+                raw = self.store.get(CONFKEY_PREFIX, key)
+                try:
+                    spec = json.loads(raw) if raw else {}
+                except ValueError:
+                    spec = {}
+                out.append({
+                    "pool": key[len(_TRASH_SCHED_PREFIX):], **spec,
+                })
+            return CommandResult(data=out)
+        if name == "rbd trash purge schedule status":
+            rs = self.digest.get("rbd_support", {})
+            return CommandResult(data=rs.get("trash_schedules", {}))
+        if name == "osd perf query ls":
+            import json
+
+            out = []
+            for key in self.store.keys(CONFKEY_PREFIX):
+                if not key.startswith(_PQ_SPEC_PREFIX):
+                    continue
+                raw = self.store.get(CONFKEY_PREFIX, key)
+                try:
+                    spec = json.loads(raw) if raw else {}
+                except ValueError:
+                    spec = {}
+                out.append({"qid": int(key[len(_PQ_SPEC_PREFIX):]),
+                            **spec})
+            return CommandResult(data=out)
+        if name == "osd perf counters get":
+            q = self.digest.get("osd_perf_query", {})
+            qid = str(cmd.get("qid", ""))
+            if qid not in q:
+                return CommandResult(
+                    ENOENT_RC, f"no perf query {qid!r} (not installed "
+                    "yet, or unknown)")
+            return CommandResult(data=q[qid])
         return None
 
     def prepare_command(self, cmd: dict, tx: StoreTransaction
@@ -265,6 +316,56 @@ class MgrStatMonitor(PaxosService):
                 return CommandResult(EINVAL_RC, "digest must be a dict")
             tx.put(PREFIX, "digest", encode(digest))
             return CommandResult(outs="report accepted")
+        if name == "rbd trash purge schedule add":
+            import json
+
+            pool = str(cmd.get("pool", ""))
+            if not pool:
+                return CommandResult(EINVAL_RC, "pool required")
+            try:
+                interval = float(cmd.get("interval", 900))
+            except (TypeError, ValueError):
+                return CommandResult(EINVAL_RC,
+                                     "interval must be seconds")
+            if interval <= 0:
+                return CommandResult(EINVAL_RC, "interval must be > 0")
+            tx.put(CONFKEY_PREFIX, _TRASH_SCHED_PREFIX + pool,
+                   json.dumps({"interval": interval}).encode())
+            return CommandResult(
+                outs=f"trash purge every {interval:g}s on {pool!r}")
+        if name == "rbd trash purge schedule rm":
+            pool = str(cmd.get("pool", ""))
+            if self.store.get(CONFKEY_PREFIX,
+                              _TRASH_SCHED_PREFIX + pool) is None:
+                return CommandResult(ENOENT_RC,
+                                     f"no schedule for {pool!r}")
+            tx.erase(CONFKEY_PREFIX, _TRASH_SCHED_PREFIX + pool)
+            return CommandResult(outs=f"schedule for {pool!r} removed")
+        if name == "osd perf query add":
+            import json
+
+            qtype = str(cmd.get("type", ""))
+            if qtype not in ("by_pool", "by_client", "rbd_image",
+                            "by_object_prefix"):
+                return CommandResult(EINVAL_RC,
+                                     f"unknown query type {qtype!r}")
+            qids = [
+                int(k[len(_PQ_SPEC_PREFIX):])
+                for k in self.store.keys(CONFKEY_PREFIX)
+                if k.startswith(_PQ_SPEC_PREFIX)
+            ]
+            qid = max(qids, default=0) + 1
+            tx.put(CONFKEY_PREFIX, f"{_PQ_SPEC_PREFIX}{qid}",
+                   json.dumps({"type": qtype}).encode())
+            return CommandResult(data={"qid": qid},
+                                 outs=f"added query {qid}")
+        if name == "osd perf query rm":
+            qid = str(cmd.get("qid", ""))
+            if self.store.get(CONFKEY_PREFIX,
+                              _PQ_SPEC_PREFIX + qid) is None:
+                return CommandResult(ENOENT_RC, f"no query {qid!r}")
+            tx.erase(CONFKEY_PREFIX, _PQ_SPEC_PREFIX + qid)
+            return CommandResult(outs=f"removed query {qid}")
         if name == "crash post":
             report = cmd.get("report")
             if not isinstance(report, dict) \

@@ -626,12 +626,16 @@ class Monitor:
 
     # -- commands ---------------------------------------------------------
     def _route_service(self, cmd: dict):
-        word = str(cmd.get("prefix", "")).split(" ", 1)[0]
+        prefix = str(cmd.get("prefix", ""))
+        word = prefix.split(" ", 1)[0]
         # pgmap-digest reads and mgr-module surfaces live on the
         # mgr-stat service (PGMap / balancer / progress / crash)
         if word in ("pg", "df", "balancer", "progress", "crash",
                     "device", "telemetry", "orch", "insights",
-                    "snap-schedule"):
+                    "snap-schedule", "rbd", "iostat"):
+            return self.mgr_stat
+        if prefix.startswith("osd perf "):
+            # mgr osd_perf_query module surface, not the OSDMonitor
             return self.mgr_stat
         if word == "config-key":
             return self.config_monitor

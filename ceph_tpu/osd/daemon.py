@@ -257,6 +257,10 @@ class OSDDaemon:
         # reqid -> future of the attempt currently executing: resends
         # attach instead of double-executing
         self._inflight_ops: dict[str, asyncio.Future] = {}
+        # dynamic perf queries (OSDPerfMetricQuery role): qid -> spec,
+        # and qid -> {group key -> counters} accumulated per client op
+        self._perf_queries: dict[int, dict] = {}
+        self._pq_counters: dict[int, dict[str, dict]] = {}
         # cephx: rotating service secrets (fetched from the mon) and
         # per-connection client-session auth state
         self._service_secrets: dict[int, str] = {}
@@ -573,6 +577,38 @@ class OSDDaemon:
                 conn.send_message(Message("pg_stats_reply", {
                     "tid": msg.data.get("tid", 0),
                     "pgs": self._pg_stats(),
+                }))
+            except ConnectionError:
+                pass
+        elif t == "perf_query_add":
+            # dynamic perf query (reference OSDPerfMetricQuery, the
+            # mgr osd_perf_query / rbd_support data source): group
+            # client ops by the spec's key until removed
+            qid = int(msg.data.get("qid", 0))
+            self._perf_queries[qid] = dict(msg.data.get("spec", {}))
+            self._pq_counters.setdefault(qid, {})
+            try:
+                conn.send_message(Message("perf_query_reply", {
+                    "tid": msg.data.get("tid", 0), "qid": qid,
+                }))
+            except ConnectionError:
+                pass
+        elif t == "perf_query_rm":
+            qid = int(msg.data.get("qid", 0))
+            self._perf_queries.pop(qid, None)
+            self._pq_counters.pop(qid, None)
+            try:
+                conn.send_message(Message("perf_query_reply", {
+                    "tid": msg.data.get("tid", 0), "qid": qid,
+                }))
+            except ConnectionError:
+                pass
+        elif t == "perf_query_dump":
+            qid = int(msg.data.get("qid", 0))
+            try:
+                conn.send_message(Message("perf_query_dump_reply", {
+                    "tid": msg.data.get("tid", 0), "qid": qid,
+                    "counters": self._pq_counters.get(qid, {}),
                 }))
             except ConnectionError:
                 pass
@@ -2049,6 +2085,47 @@ class OSDDaemon:
 
     _PG_STAT_TTL = 0.5
 
+    def _perf_query_account(self, pg, conn, oid: str, ops, results,
+                            lat: float) -> None:
+        """Accumulate one completed client op into every active
+        dynamic perf query (OSDPerfMetricCollector role).  Group keys
+        per spec type: pool name, proven client entity, rbd image id
+        (parsed from rbd_data.<id>.<objno> names — the rbd_support
+        image-iostat source), or the first dotted name component."""
+        # strip the rados-namespace wire prefix ("\x1d<ns>\x1d<name>")
+        name = oid[1:].split("\x1d", 1)[1] if oid.startswith("\x1d") \
+            and "\x1d" in oid[1:] else oid
+        for qid, spec in self._perf_queries.items():
+            t = spec.get("type", "")
+            if t == "by_pool":
+                key = pg.pool.name
+            elif t == "by_client":
+                key = str(getattr(conn, "peer_name", "") or "?")
+            elif t == "rbd_image":
+                if not name.startswith("rbd_data."):
+                    continue
+                key = name[len("rbd_data."):].rsplit(".", 1)[0]
+            elif t == "by_object_prefix":
+                key = name.split(".", 1)[0]
+            else:
+                continue
+            c = self._pq_counters.setdefault(qid, {}).setdefault(key, {
+                "ops": 0, "read_ops": 0, "write_ops": 0,
+                "bytes_in": 0, "bytes_out": 0, "lat_sum": 0.0,
+            })
+            c["ops"] += 1
+            c["lat_sum"] += lat
+            for op in ops:
+                if op.get("op") in READ_OPS:
+                    c["read_ops"] += 1
+                else:
+                    c["write_ops"] += 1
+                if isinstance(op.get("data"), (bytes, bytearray)):
+                    c["bytes_in"] += len(op["data"])
+            for res in results:
+                if isinstance(res.get("data"), (bytes, bytearray)):
+                    c["bytes_out"] += len(res["data"])
+
     def _pg_stats(self) -> list[dict]:
         """Per-primary-PG stats (the MPGStats payload the mgr folds into
         its PGMap digest, reference src/messages/MPGStats.h +
@@ -3228,6 +3305,10 @@ class OSDDaemon:
                 if isinstance(res.get("data"), (bytes, bytearray)):
                     self.perf.inc("op_out_bytes", len(res["data"]))
             self.perf.tinc("op_latency", time.monotonic() - op_start)
+            if self._perf_queries and rc == OK:
+                self._perf_query_account(
+                    pg, conn, str(d.get("oid", "")), ops, results,
+                    time.monotonic() - op_start)
             self._reply(conn, tid, rc, results=results, version=version)
         except ShardReadError as e:
             log.derr("%s: osd_op IO error: %s", self.entity, e)

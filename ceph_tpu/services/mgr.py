@@ -45,12 +45,19 @@ class Mgr:
         self._futures: dict[int, asyncio.Future] = {}
         self.admin_socket = None
         if modules is None:
+            from ceph_tpu.services.mgr_perf import (
+                IOStat,
+                OSDPerfQuery,
+                RBDSupport,
+            )
             from ceph_tpu.services.orchestrator import Orchestrator
 
+            pq = OSDPerfQuery(self)
             modules = [Balancer(self), PGAutoscaler(self),
                        Progress(self), DeviceHealth(self),
                        Telemetry(self), Insights(self),
-                       SnapSchedule(self), Orchestrator(self)]
+                       SnapSchedule(self), Orchestrator(self),
+                       pq, RBDSupport(self, pq), IOStat(self)]
         self.modules = {m.name: m for m in modules}
         self.last_digest: dict | None = None
 
@@ -64,6 +71,11 @@ class Mgr:
             fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
             if fut is not None and not fut.done():
                 fut.set_result(msg.data.get("pgs", []))
+            return
+        if msg.type in ("perf_query_reply", "perf_query_dump_reply"):
+            fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
+            if fut is not None and not fut.done():
+                fut.set_result(dict(msg.data))
             return
         await self.monc.ms_dispatch(conn, msg)
 
@@ -120,6 +132,24 @@ class Mgr:
         try:
             await self.msgr.send_to(
                 addr, Message(what, {"tid": tid}), f"osd.{osd}"
+            )
+            return await asyncio.wait_for(fut, timeout)
+        except (ConnectionError, asyncio.TimeoutError):
+            self._futures.pop(tid, None)
+            return None
+
+    async def osd_request(self, osd: int, addr: str, mtype: str,
+                          timeout: float = 3.0, **data):
+        """One request/reply exchange with an OSD (dynamic perf query
+        control + dump); None on timeout/unreachable."""
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_running_loop().create_future()
+        self._futures[tid] = fut
+        try:
+            await self.msgr.send_to(
+                addr, Message(mtype, {"tid": tid, **data}),
+                f"osd.{osd}"
             )
             return await asyncio.wait_for(fut, timeout)
         except (ConnectionError, asyncio.TimeoutError):

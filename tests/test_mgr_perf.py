@@ -1,0 +1,181 @@
+"""mgr osd_perf_query / rbd_support / iostat modules (round-3 missing
+#5/#6; reference src/pybind/mgr/rbd_support/module.py:14-16,148,
+osd_perf_query/module.py:23).
+
+Round trips the whole chain: CLI command -> mon config-key spec ->
+mgr module installs dynamic perf queries on OSDs / runs scheduled
+trash purges -> results ride the digest -> CLI reads them back.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rbd import RBD
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _wait(cond, deadline=20.0, every=0.1):
+    end = asyncio.get_running_loop().time() + deadline
+    while True:
+        if await cond():
+            return
+        assert asyncio.get_running_loop().time() < end, "timeout"
+        await asyncio.sleep(every)
+
+
+def test_scheduled_trash_purge_fires():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        await cluster.start_mgr()
+        try:
+            r = await rados.mon_command("osd pool create", pool="rbdp",
+                                        pg_num=8, size=2)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("rbdp")
+            rbd = RBD(io)
+            await rbd.create("doomed", 1 << 20, order=20)
+            await rbd.trash_move("doomed")          # no deferment
+            assert len(await rbd.trash_list()) == 1
+
+            r = await rados.mon_command(
+                "rbd trash purge schedule add", pool="rbdp",
+                interval=0.3)
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("rbd trash purge schedule ls")
+            assert r["rc"] == 0
+            assert r["data"][0]["pool"] == "rbdp"
+
+            async def purged():
+                return not await rbd.trash_list()
+            await _wait(purged)
+
+            async def status_shows():
+                r = await rados.mon_command(
+                    "rbd trash purge schedule status")
+                st = r["data"].get("rbdp", {})
+                return st.get("purged_total", 0) >= 1
+            await _wait(status_shows)
+
+            # deferred entries survive the purge until their window
+            await rbd.create("keep", 1 << 20, order=20)
+            await rbd.trash_move("keep", delay=3600)
+            await asyncio.sleep(0.8)
+            assert len(await rbd.trash_list()) == 1
+
+            r = await rados.mon_command(
+                "rbd trash purge schedule rm", pool="rbdp")
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("rbd trash purge schedule ls")
+            assert r["data"] == []
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_rbd_perf_image_iostat_shows_live_ops():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        await cluster.start_mgr()
+        try:
+            r = await rados.mon_command("osd pool create", pool="rbdp",
+                                        pg_num=8, size=2)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("rbdp")
+            rbd = RBD(io)
+            await rbd.create("busy", 1 << 22, order=20)
+            img = await rbd.open("busy")
+            image_id = img.image_id
+
+            stop = asyncio.Event()
+
+            async def writer():
+                i = 0
+                while not stop.is_set():
+                    await img.write((i % 4) * 4096, b"x" * 4096)
+                    i += 1
+                    await asyncio.sleep(0.01)
+            wtask = asyncio.get_running_loop().create_task(writer())
+
+            async def iostat_live():
+                r = await rados.mon_command("rbd perf image iostat")
+                if r["rc"] != 0:
+                    return False
+                st = r["data"].get(image_id)
+                return bool(st) and st["ops"] > 0 \
+                    and st["wr_bytes_per_sec"] > 0
+            await _wait(iostat_live)
+            stop.set()
+            await wtask
+            await img.close()
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
+
+
+def test_osd_perf_query_and_iostat():
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        rados = await cluster.client()
+        await cluster.start_mgr()
+        try:
+            r = await rados.mon_command("osd pool create", pool="p1",
+                                        pg_num=8, size=2)
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("osd perf query add",
+                                        type="by_pool")
+            assert r["rc"] == 0, r
+            qid = r["data"]["qid"]
+            r = await rados.mon_command("osd perf query ls")
+            assert any(q["qid"] == qid and q["type"] == "by_pool"
+                       for q in r["data"])
+
+            io = await rados.open_ioctx("p1")
+
+            async def counters_show():
+                # the query installs on the NEXT mgr cycle: keep
+                # producing ops so installation always sees traffic
+                for i in range(5):
+                    await io.write_full(f"o{i}", b"d" * 1024)
+                r = await rados.mon_command("osd perf counters get",
+                                            qid=qid)
+                if r["rc"] != 0:
+                    return False
+                c = r["data"]["counters"].get("p1")
+                return bool(c) and c["write_ops"] >= 5 \
+                    and c["bytes_in"] >= 5 * 1024
+            await _wait(counters_show)
+
+            # cluster-wide iostat rates react to the IO
+            async def iostat_nonzero():
+                r = await rados.mon_command("iostat")
+                return r["rc"] == 0 and "ops_per_sec" in r["data"]
+            await _wait(iostat_nonzero)
+
+            r = await rados.mon_command("osd perf query rm", qid=qid)
+            assert r["rc"] == 0, r
+            r = await rados.mon_command("osd perf query ls")
+            assert not any(q["qid"] == qid for q in r["data"])
+            # unknown query type refused
+            r = await rados.mon_command("osd perf query add",
+                                        type="by_moon_phase")
+            assert r["rc"] != 0
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
