@@ -185,6 +185,70 @@ class SwiftFrontend:
             raise RGWError("AccessDenied", f"{uid} suspended")
         return uid
 
+    # -- TempURL (reference rgw_swift_auth.h:176 TempURLEngine) ------------
+    async def _temp_url(self, method: str, path: str, query: dict,
+                        hdrs: dict, body: bytes):
+        """Validate ``?temp_url_sig=&temp_url_expires=`` pre-signed
+        access against the account's Temp-URL keys (the
+        X-Account-Meta-Temp-URL-Key / -Key-2 metadata), then execute
+        the object op as the account.  Signature = HMAC(key,
+        "<method>\\n<expires>\\n<path>"), sha1 or sha256 by digest
+        length; prefix mode signs "prefix:<path-prefix>" and admits
+        any object under it.  HEAD is allowed with a GET or PUT
+        signature (Swift tempurl middleware rules)."""
+        parts = [p for p in path.split("/") if p]
+        if len(parts) < 4 or parts[0] != "v1" \
+                or not parts[1].startswith("AUTH_"):
+            return 401, {}, b"temp url requires an object path"
+        account = parts[1][len("AUTH_"):]
+        container = parts[2]
+        obj = "/".join(parts[3:])
+        try:
+            expires = int(query["temp_url_expires"])
+        except ValueError:
+            return 401, {}, b"bad temp_url_expires"
+        if time.time() > expires:
+            return 401, {}, b"temp url expired"
+        try:
+            rec = await self.users.get(account)
+        except RGWError:
+            return 401, {}, b"bad temp url"
+        meta = rec.get("swift_meta") or {}
+        keys = [meta[k] for k in ("temp-url-key", "temp-url-key-2")
+                if meta.get(k)]
+        if not keys or rec.get("suspended"):
+            return 401, {}, b"no temp-url keys set"
+        sig = str(query["temp_url_sig"]).lower()
+        digestmod = {40: hashlib.sha1, 64: hashlib.sha256}.get(
+            len(sig))
+        if digestmod is None:
+            return 401, {}, b"bad signature"
+        prefix = query.get("temp_url_prefix")
+        if prefix is not None:
+            if not obj.startswith(prefix):
+                return 401, {}, b"object outside signed prefix"
+            signed_path = f"/v1/AUTH_{account}/{container}/{prefix}"
+            body_of = lambda m: f"{m}\n{expires}\nprefix:{signed_path}"
+        else:
+            signed_path = path
+            body_of = lambda m: f"{m}\n{expires}\n{signed_path}"
+        # HEAD validates with a GET or PUT signature too
+        methods = {"HEAD": ("HEAD", "GET", "PUT")}.get(method,
+                                                       (method,))
+        if method not in ("GET", "HEAD", "PUT"):
+            return 401, {}, b"method not allowed for temp urls"
+        ok = any(
+            hmac.compare_digest(
+                hmac.new(key.encode(), body_of(m).encode(),
+                         digestmod).hexdigest(), sig)
+            for key in keys for m in methods
+        )
+        if not ok:
+            return 401, {}, b"invalid temp url signature"
+        gw = self.rgw.as_user(account)
+        return await self._object(method, gw, container, obj, hdrs,
+                                  body, {})
+
     # -- routing (RGWHandler_REST_SWIFT) -----------------------------------
     async def _route(self, method: str, raw_path: str, hdrs: dict,
                      body: bytes):
@@ -197,6 +261,11 @@ class SwiftFrontend:
             query[urllib.parse.unquote(k)] = urllib.parse.unquote(v)
         if path.rstrip("/") == "/auth/v1.0":
             return await self._auth_handshake(hdrs)
+        if "temp_url_sig" in query and "temp_url_expires" in query:
+            # pre-signed access: no token at all (TempURLEngine,
+            # reference rgw_swift_auth.h:176)
+            return await self._temp_url(method, path, query, hdrs,
+                                        body)
         uid = await self._validate_token(hdrs.get("x-auth-token", ""))
         parts = [p for p in path.split("/") if p]
         # /v1/AUTH_<account>[/container[/object...]]
