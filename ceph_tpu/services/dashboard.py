@@ -1,24 +1,36 @@
 """Dashboard-lite: the mgr's operator-facing HTTP surface.
 
-The read-only core of reference src/pybind/mgr/dashboard (scope per its
-status pages, not the 11 MB web app) plus the prometheus module's
-exposition endpoint, on one asyncio server:
+The core of reference src/pybind/mgr/dashboard (scope per its status +
+management pages, not the 11 MB web app) plus the prometheus module's
+exposition endpoint and the restful module's programmatic API
+(src/pybind/mgr/restful/module.py:36 role), on one asyncio server:
 
 - ``GET /api/status``  cluster status JSON: health checks, mon quorum,
   osd/pg/pool summaries, the OSD tree, MDS ranks, and the recent
   cluster log — assembled from the same mon commands the CLI uses.
+- ``GET /api/osd`` / ``GET /api/pool``  resource listings (restful).
 - ``GET /metrics``     prometheus text exposition of the mgr's last
   digest (the pybind/mgr/prometheus serve role).
 - ``GET /``            one self-refreshing HTML page rendering the
-  status for a browser.
+  status for a browser, with an operations panel driving the API.
 
-Read-only by construction: the handler has no POST routes and never
-calls a mutating mon command.
+Management surface (token-gated; disabled unless an ``api_token`` is
+configured — reads stay open):
+
+- ``POST /api/pool``              {"pool", "pg_num", "size"?}
+- ``DELETE /api/pool/<name>``
+- ``POST /api/osd/<id>/out|in|down``
+- ``POST /api/osd_flags``         {"flag", "set": bool}  (noout &c)
+- ``POST /api/health/mute``       {"code", "ttl"?} / ``.../unmute``
+
+Every write maps 1:1 onto an existing, paxos-audited mon command —
+the dashboard adds reach, not new authority.
 """
 
 from __future__ import annotations
 
 import asyncio
+import hmac as hmac_mod
 import html
 import json
 import time
@@ -29,10 +41,12 @@ log = Dout("dashboard")
 
 
 class Dashboard:
-    def __init__(self, mgr, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, mgr, host: str = "127.0.0.1", port: int = 0,
+                 api_token: str | None = None):
         self.mgr = mgr
         self.host = host
         self.port = port
+        self.api_token = api_token
         self._server: asyncio.AbstractServer | None = None
         self._metrics_cache: tuple[float, bytes] = (0.0, b"")
 
@@ -54,13 +68,32 @@ class Dashboard:
                       writer: asyncio.StreamWriter) -> None:
         try:
             head = await reader.readuntil(b"\r\n\r\n")
-            line = head.split(b"\r\n", 1)[0].decode("latin-1")
+            headers = {}
+            head_lines = head.decode("latin-1").split("\r\n")
+            line = head_lines[0]
+            for ln in head_lines[1:]:
+                k, _, v = ln.partition(":")
+                headers[k.strip().lower()] = v.strip()
             method, path, _ = (line.split(" ", 2) + ["", ""])[:3]
             path = path.split("?", 1)[0]
-            if method != "GET":
-                body, ctype, status = b"read-only", "text/plain", 405
+            req_body = b""
+            clen = int(headers.get("content-length", 0) or 0)
+            if clen:
+                req_body = await reader.readexactly(min(clen, 1 << 20))
+            if method in ("POST", "DELETE"):
+                status, body = await self._mutate(method, path,
+                                                  headers, req_body)
+                ctype = "application/json"
+            elif method != "GET":
+                body, ctype, status = b"bad method", "text/plain", 405
             elif path == "/api/status":
                 body = json.dumps(await self._status()).encode()
+                ctype, status = "application/json", 200
+            elif path == "/api/osd":
+                body = json.dumps(await self._osd_list()).encode()
+                ctype, status = "application/json", 200
+            elif path == "/api/pool":
+                body = json.dumps(await self._pool_list()).encode()
                 ctype, status = "application/json", 200
             elif path == "/metrics":
                 # collect() messages every OSD; cache briefly so an
@@ -101,6 +134,90 @@ class Dashboard:
                 await writer.wait_closed()
             except (ConnectionError, OSError):
                 pass
+
+    # -- management API (restful module + dashboard write surface) ---------
+    def _authorized(self, headers: dict) -> bool:
+        if not self.api_token:
+            return False            # writes disabled entirely
+        auth = headers.get("authorization", "")
+        tok = auth[len("Bearer "):] if auth.startswith("Bearer ") \
+            else headers.get("x-auth-token", "")
+        return hmac_mod.compare_digest(tok, self.api_token)
+
+    async def _mutate(self, method: str, path: str, headers: dict,
+                      raw: bytes) -> tuple[int, bytes]:
+        def reply(status: int, **data) -> tuple[int, bytes]:
+            return status, json.dumps(data).encode()
+
+        if not self._authorized(headers):
+            return reply(403, error="missing or bad api token")
+        try:
+            args = json.loads(raw) if raw else {}
+            if not isinstance(args, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as e:
+            return reply(400, error=f"bad body: {e}")
+        parts = [p for p in path.split("/") if p]
+        if not parts or parts[0] != "api":
+            return reply(404, error="unknown route")
+
+        async def mon(prefix: str, **kw):
+            r = await self.mgr.monc.command(prefix, **kw)
+            if r.get("rc") != 0:
+                return reply(409, error=r.get("outs", "refused"),
+                             rc=r.get("rc"))
+            return reply(200, ok=True, result=r.get("data"))
+
+        route = parts[1:]
+        if method == "POST" and route == ["pool"]:
+            pool = str(args.get("pool", ""))
+            if not pool:
+                return reply(400, error="pool name required")
+            return await mon(
+                "osd pool create", pool=pool,
+                pg_num=int(args.get("pg_num", 8)),
+                size=int(args.get("size", 3)))
+        if method == "DELETE" and len(route) == 2 \
+                and route[0] == "pool":
+            return await mon("osd pool delete", pool=route[1])
+        if method == "POST" and len(route) == 3 \
+                and route[0] == "osd" and route[2] in ("out", "in",
+                                                       "down"):
+            try:
+                osd = int(route[1])
+            except ValueError:
+                return reply(400, error=f"bad osd id {route[1]!r}")
+            return await mon(f"osd {route[2]}", ids=[osd])
+        if method == "POST" and route == ["osd_flags"]:
+            flag = str(args.get("flag", ""))
+            if not flag:
+                return reply(400, error="flag required")
+            verb = "osd set" if args.get("set", True) else "osd unset"
+            return await mon(verb, flag=flag)
+        if method == "POST" and route == ["health", "mute"]:
+            return await mon("health mute",
+                             code=str(args.get("code", "")),
+                             sticky=bool(args.get("sticky", False)))
+        if method == "POST" and route == ["health", "unmute"]:
+            return await mon("health unmute",
+                             code=str(args.get("code", "")))
+        return reply(404, error="unknown route")
+
+    async def _osd_list(self) -> list[dict]:
+        dump = await self._mon("osd dump") or {}
+        return [
+            {"osd": int(oid), **info}
+            for oid, info in sorted(
+                (dump.get("osds") or {}).items(),
+                key=lambda kv: int(kv[0]))
+        ]
+
+    async def _pool_list(self) -> list[dict]:
+        dump = await self._mon("osd dump") or {}
+        pools = dump.get("pools") or {}
+        return [dict(p, pool_id=int(pid))
+                for pid, p in sorted(pools.items(),
+                                     key=lambda kv: str(kv[0]))]
 
     # -- data assembly -----------------------------------------------------
     async def _mon(self, prefix: str, **args):
@@ -199,6 +316,55 @@ class Dashboard:
             walk(root, 0)
         section("OSD tree", table(["name", "type", "status", "reweight"],
                                   tree_rows))
+
+        if self.api_token:
+            # operations panel: every button drives the token-gated
+            # management API (the dashboard write surface)
+            section("Operations", """
+<p>api token: <input id="tok" type="password" size="24"></p>
+<p>osd <input id="osdid" size="4" value="0">
+ <button onclick="osd('out')">out</button>
+ <button onclick="osd('in')">in</button>
+ <button onclick="osd('down')">down</button></p>
+<p>flag <input id="flag" size="10" value="noout">
+ <button onclick="flags(true)">set</button>
+ <button onclick="flags(false)">unset</button></p>
+<p>pool <input id="pool" size="12">
+ <button onclick="mkpool()">create</button>
+ <button onclick="rmpool()">delete</button></p>
+<p>mute <input id="code" size="14" value="OSD_DOWN">
+ <button onclick="mute(true)">mute</button>
+ <button onclick="mute(false)">unmute</button></p>
+<pre id="out"></pre>
+<script>
+async function call(method, path, body) {
+  const r = await fetch(path, {method: method,
+    headers: {"authorization": "Bearer " +
+              document.getElementById("tok").value},
+    body: body ? JSON.stringify(body) : undefined});
+  document.getElementById("out").textContent = await r.text();
+}
+function osd(verb) {
+  call("POST", "/api/osd/" +
+       document.getElementById("osdid").value + "/" + verb);
+}
+function flags(on) {
+  call("POST", "/api/osd_flags",
+       {flag: document.getElementById("flag").value, set: on});
+}
+function mkpool() {
+  call("POST", "/api/pool",
+       {pool: document.getElementById("pool").value});
+}
+function rmpool() {
+  call("DELETE", "/api/pool/" +
+       document.getElementById("pool").value);
+}
+function mute(on) {
+  call("POST", "/api/health/" + (on ? "mute" : "unmute"),
+       {code: document.getElementById("code").value});
+}
+</script>""")
 
         mds = s.get("mds") or {}
         mds_rows = []

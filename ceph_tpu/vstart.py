@@ -206,6 +206,7 @@ class DevCluster:
                         report_interval: float = 0.2,
                         dashboard: bool = False,
                         dashboard_port: int = 0,
+                        dashboard_token: str | None = None,
                         orchestrate: bool = False):
         """Boot a manager that aggregates OSD pg stats into the PGMap
         digest and pushes it to the mon (the mgr daemon role).
@@ -240,7 +241,8 @@ class DevCluster:
         if dashboard:
             from ceph_tpu.services.dashboard import Dashboard
 
-            dash = Dashboard(mgr, port=dashboard_port)
+            dash = Dashboard(mgr, port=dashboard_port,
+                             api_token=dashboard_token)
             mgr.dashboard = dash
             await dash.start()
         self.mgrs[name] = mgr

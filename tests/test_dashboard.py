@@ -75,14 +75,14 @@ def test_dashboard_status_metrics_and_page():
                          "Cluster log", "osd.0"):
                 assert frag in text, f"missing {frag!r}"
 
-            # read-only: mutations are refused
+            # without an api token the write surface is fully disabled
             reader, writer = await asyncio.open_connection(host, port)
             writer.write(b"POST /api/status HTTP/1.1\r\nhost: x\r\n"
                          b"content-length: 0\r\n\r\n")
             await writer.drain()
             raw = await reader.read()
             writer.close()
-            assert b" 405 " in raw.split(b"\r\n", 1)[0]
+            assert b" 403 " in raw.split(b"\r\n", 1)[0]
             st, _ = await _http_get(host, port, "/nope")
             assert st == 404
 
@@ -108,4 +108,131 @@ def test_dashboard_via_vstart():
             await cluster.stop()
         with pytest.raises((ConnectionError, OSError)):
             await _http_get(host, port, "/api/status")
+    asyncio.run(run())
+
+
+async def _http(host, port, method, path, body=None, token=None):
+    reader, writer = await asyncio.open_connection(host, port)
+    payload = json.dumps(body).encode() if body is not None else b""
+    hdrs = [f"{method} {path} HTTP/1.1", "host: x",
+            f"content-length: {len(payload)}"]
+    if token is not None:
+        hdrs.append(f"authorization: Bearer {token}")
+    writer.write("\r\n".join(hdrs).encode() + b"\r\n\r\n" + payload)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, rbody = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b" ", 2)[1])
+    return status, rbody
+
+
+def test_dashboard_write_surface():
+    """Round-3 missing #8: the management write surface — pool
+    create/delete, OSD out/in, cluster flags, health mute — over the
+    token-gated HTTP API, each mapping onto a mon command whose result
+    health/status reflects."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            mgr = await cluster.start_mgr(
+                dashboard=True, dashboard_token="s3cr3t")
+            host, port = mgr.dashboard.host, mgr.dashboard.port
+
+            # no/bad token: refused; read surface stays open
+            st, _ = await _http(host, port, "POST", "/api/pool",
+                                {"pool": "nope"})
+            assert st == 403
+            st, _ = await _http(host, port, "POST", "/api/pool",
+                                {"pool": "nope"}, token="wrong")
+            assert st == 403
+            st, _ = await _http(host, port, "GET", "/api/status")
+            assert st == 200
+
+            # pool create shows up cluster-wide; delete removes it
+            st, body = await _http(host, port, "POST", "/api/pool",
+                                   {"pool": "webpool", "pg_num": 8,
+                                    "size": 2}, token="s3cr3t")
+            assert st == 200, body
+            r = await rados.mon_command("osd dump")
+            names = {p["name"] for p in r["data"]["pools"].values()}
+            assert "webpool" in names
+            st, body = await _http(host, port, "GET", "/api/pool")
+            assert st == 200
+            assert any(p["name"] == "webpool"
+                       for p in json.loads(body))
+            st, _ = await _http(host, port, "DELETE",
+                                "/api/pool/webpool", token="s3cr3t")
+            assert st == 200
+            r = await rados.mon_command("osd dump")
+            names = {p["name"] for p in r["data"]["pools"].values()}
+            assert "webpool" not in names
+
+            # flip osd.1 out and back; the map reflects both
+            st, body = await _http(host, port, "POST",
+                                   "/api/osd/1/out", token="s3cr3t")
+            assert st == 200, body
+
+            async def osd1_out():
+                r = await rados.mon_command("osd dump")
+                return r["data"]["osds"]["1"]["in"] is False
+            deadline = asyncio.get_running_loop().time() + 10
+            while not await osd1_out():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            st, _ = await _http(host, port, "POST", "/api/osd/1/in",
+                                token="s3cr3t")
+            assert st == 200
+            while await osd1_out():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+
+            # cluster flag set/unset
+            st, _ = await _http(host, port, "POST", "/api/osd_flags",
+                                {"flag": "noout", "set": True},
+                                token="s3cr3t")
+            assert st == 200
+            r = await rados.mon_command("osd dump")
+            assert "noout" in r["data"]["flags"]
+            st, _ = await _http(host, port, "POST", "/api/osd_flags",
+                                {"flag": "noout", "set": False},
+                                token="s3cr3t")
+            assert st == 200
+            r = await rados.mon_command("osd dump")
+            assert "noout" not in r["data"]["flags"]
+
+            # health mute round-trip: kill an osd, mute the check
+            await cluster.kill_osd(2)
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                r = await rados.mon_command("health")
+                if "OSD_DOWN" in r["data"]["checks"]:
+                    break
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+            st, _ = await _http(host, port, "POST",
+                                "/api/health/mute",
+                                {"code": "OSD_DOWN"}, token="s3cr3t")
+            assert st == 200
+            r = await rados.mon_command("health")
+            assert r["data"]["status"] == "HEALTH_OK"
+            st, _ = await _http(host, port, "POST",
+                                "/api/health/unmute",
+                                {"code": "OSD_DOWN"}, token="s3cr3t")
+            assert st == 200
+            r = await rados.mon_command("health")
+            assert r["data"]["status"] == "HEALTH_WARN"
+
+            # bad routes/args answer structured errors
+            st, _ = await _http(host, port, "POST", "/api/osd/x/out",
+                                token="s3cr3t")
+            assert st == 400
+            st, _ = await _http(host, port, "POST", "/api/mystery",
+                                token="s3cr3t")
+            assert st == 404
+            await rados.shutdown()
+        finally:
+            await cluster.stop()
     asyncio.run(run())
