@@ -264,6 +264,72 @@ class GroupedPlan:
         self.gather_rows = np.asarray(real_pos, np.int64)[order]
 
 
+def _gkernel_fused(bm_ref, data_ref, out_ref, *, grp_rows, cols,
+                   kin):
+    """ALL row groups in one launch: the (kin, T) input block is read
+    once, bit-expanded once, and each group's support columns are
+    selected IN VMEM with static indices (no HBM-visible gather — the
+    paired kernel's host-side ``words[cols]`` materialized a
+    support-amplified array every apply, which is what made CLAY
+    repair launch/traffic-bound, round-3 weak #2).  HBM traffic is
+    input once + output once per tile: the roofline optimum."""
+    d = data_ref[:]                          # (kin, T) int32
+    _, T = d.shape
+    shift = jax.lax.broadcasted_iota(jnp.int32, (1, 32, 1), 1)
+    bits = ((d[:, None, :] >> shift) & 1).reshape(kin * 32, T) \
+        .astype(jnp.int8)
+    for g in range(len(cols)):               # static unroll over groups
+        sel = jnp.concatenate(
+            [jax.lax.slice_in_dim(bits, 32 * c, 32 * (c + 1))
+             for c in cols[g]], axis=0)      # (32*cmax, T)
+        acc = jnp.dot(bm_ref[g], sel,
+                      preferred_element_type=jnp.int32)
+        accb = (acc & 1).reshape(grp_rows, 32, T)
+        packed = jnp.sum(accb << shift, axis=1)      # (grp, T)
+        out_ref[g * grp_rows:(g + 1) * grp_rows, :] = packed
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tile", "grp_rows", "cols",
+                                    "interpret"))
+def _pallas_apply_grouped_fused(bms, words, *, tile, grp_rows, cols,
+                                interpret=False):
+    kin, n4 = words.shape
+    G = bms.shape[0]
+    return pl.pallas_call(
+        functools.partial(_gkernel_fused, grp_rows=grp_rows,
+                          cols=cols, kin=kin),
+        grid=(n4 // tile,),
+        in_specs=[
+            pl.BlockSpec(bms.shape, lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kin, tile), lambda t: (0, t),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((G * grp_rows, tile), lambda t: (0, t),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((G * grp_rows, n4), jnp.int32),
+        interpret=interpret,
+    )(bms, words)
+
+
+def _pick_fused_tile(n4: int, kin: int, cmax: int, grp: int,
+                     G: int) -> int:
+    """Fused-kernel tile: VMEM tenants per grid step are the whole
+    (G, 32*grp, 32*cmax) int8 bitmatrix, the double-buffered (kin,
+    tile) int32 input, the (32*kin, tile) int8 bit expansion, one
+    (32*cmax, tile) int8 selection, and the (G*grp, tile) int32
+    output — keep the tile-dependent sum near ~10 MiB."""
+    fixed = G * 32 * grp * 32 * cmax
+    per_col = 2 * kin * 4 + 32 * kin + 32 * cmax + 2 * G * grp * 4
+    t = DEFAULT_TILE
+    while t > LANE and fixed + per_col * t > (10 << 20):
+        t //= 2
+    while t > LANE and n4 % t:
+        t //= 2
+    return t
+
+
 def _gkernel(bm_ref, data_ref, out_ref, *, grp_rows):
     d = data_ref[:]                     # (2, cmax, T) int32: two groups
     _, cin, T = d.shape
@@ -327,13 +393,32 @@ class PallasGroupedApply:
         pad = (-n4) % LANE
         if pad:
             words = jnp.pad(words, ((0, 0), (0, pad)))
-        gath = words[self.plan.cols]        # (G, cmax, N4)
-        tile = _pick_gtile(n4 + pad, self.plan.cmax, self.plan.GRP_ROWS)
+        plan = self.plan
+        G = len(plan.groups)
+        # fused single-launch path: whole bitmatrix resident, static
+        # in-VMEM column selection, input read once — preferred
+        # whenever the bitmatrix set fits (the paired fallback covers
+        # huge supports)
+        if G * 32 * plan.GRP_ROWS * 32 * plan.cmax <= (6 << 20):
+            tile = _pick_fused_tile(n4 + pad, self.kin, plan.cmax,
+                                    plan.GRP_ROWS, G)
+            if tile >= LANE and (n4 + pad) % tile == 0:
+                cols = tuple(tuple(int(c) for c in row)
+                             for row in plan.cols)
+                out = _pallas_apply_grouped_fused(
+                    self._bms_arg(), words, tile=tile,
+                    grp_rows=plan.GRP_ROWS, cols=cols,
+                    interpret=self.interpret,
+                )
+                out = out[plan.gather_rows]
+                return out[:, :n4] if pad else out
+        gath = words[plan.cols]             # (G, cmax, N4)
+        tile = _pick_gtile(n4 + pad, plan.cmax, plan.GRP_ROWS)
         out = _pallas_apply_grouped(
             self._bms_arg(), gath, tile=tile,
-            grp_rows=self.plan.GRP_ROWS, interpret=self.interpret,
+            grp_rows=plan.GRP_ROWS, interpret=self.interpret,
         )
-        out = out[self.plan.gather_rows]
+        out = out[plan.gather_rows]
         return out[:, :n4] if pad else out
 
     def __call__(self, data) -> jax.Array:

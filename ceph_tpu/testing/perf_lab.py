@@ -1,0 +1,320 @@
+"""Headline-kernel perf lab: one-chip experiments behind a watchdog.
+
+Round-3 weak #3: the headline encode (k=8 m=4, 4 KiB stripes) measured
+~110 GiB/s while cfg2/cfg3 best runs showed 380-490 — the kernel's
+ceiling is higher than the headline config reaches.  This lab isolates
+WHERE the time goes so the fix is aimed, not guessed:
+
+- ``roof_copy``      pure HBM copy through a pallas kernel — what the
+                     tunnel-measured "100% of bandwidth" actually is
+- ``roof_matmul``    the int8 contraction alone on pre-expanded bits
+- ``enc_base``       the production encode step (dense shard kernel)
+- ``enc_row_carry``  same kernel, loop carry mutates ONE ROW instead
+                     of the whole buffer (isolates carry-copy cost)
+- ``enc_tile_<n>``   tile-size sweep
+- ``unpack_only``    bit expansion + repack without the matmul
+
+Each experiment uses the serial-fori differencing protocol
+(ceph_tpu.ec.benchmark.device_seconds_per_iter).  Results append to
+PERF_LAB.jsonl.  Run:  python -m ceph_tpu.testing.perf_lab [names...]
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from ceph_tpu.common.jaxutil import enable_compile_cache
+
+K, M = 8, 4
+STRIPES = 16384
+CHUNK = 512                      # bytes per chunk (4 KiB stripe / 8)
+N4 = STRIPES * CHUNK * K // 4 // K   # int32 lanes per row
+
+
+def _data_words():
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec.pallas_kernels import bytes_to_words
+
+    data = np.random.default_rng(0).integers(
+        0, 256, (K, STRIPES * CHUNK), np.uint8)
+    return bytes_to_words(jnp.asarray(data))
+
+
+def _codec():
+    from ceph_tpu.ec.benchmark import make_codec
+
+    return make_codec("jax_rs", ["k=8", "m=4",
+                                 "technique=reed_sol_van"])
+
+
+def _gibps(nbytes: int, sec: float) -> float:
+    return nbytes / sec / 2**30
+
+
+def exp_enc_base() -> dict:
+    """Production headline step: full-buffer carry + dense kernel."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec.benchmark import device_seconds_per_iter
+
+    ec = _codec()
+    words = _data_words()
+
+    def step(i, w):
+        p = ec.encode_words_device(w)
+        return w.at[0, 0].set(p[0, 0] ^ i)
+
+    sec = device_seconds_per_iter(step, words, lo=64, hi=320)
+    return {"sec": sec, "gibps": _gibps(K * N4 * 4, sec)}
+
+
+def exp_enc_row_carry() -> dict:
+    """Carry updates one whole ROW via dynamic_update_slice: if this
+    runs much faster than enc_base, the full-buffer carry copy is the
+    headline's hidden cost."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec.benchmark import device_seconds_per_iter
+
+    ec = _codec()
+    words = _data_words()
+
+    def step(i, w):
+        p = ec.encode_words_device(w)
+        row = jax.lax.dynamic_slice_in_dim(w, 0, 1, 0) ^ p[0:1]
+        return jax.lax.dynamic_update_slice_in_dim(w, row, 0, 0)
+
+    sec = device_seconds_per_iter(step, words, lo=64, hi=320)
+    return {"sec": sec, "gibps": _gibps(K * N4 * 4, sec)}
+
+
+def _tile_exp(tile: int):
+    def run() -> dict:
+        import jax.numpy as jnp
+
+        from ceph_tpu.ec import pallas_kernels as pk
+        from ceph_tpu.ec.benchmark import device_seconds_per_iter
+
+        ap = pk.PallasShardApply(
+            np.asarray(_codec().generator[K:], np.uint8))
+        words = _data_words()
+
+        def step(i, w):
+            p = pk._pallas_apply_words(
+                ap._bm32_arg(), w, tile=tile, kblk=ap.kblk)
+            return w.at[0, 0].set(p[0, 0] ^ i)
+
+        sec = device_seconds_per_iter(step, words, lo=64, hi=320)
+        return {"sec": sec, "gibps": _gibps(K * N4 * 4, sec),
+                "tile": tile}
+    return run
+
+
+def exp_roof_copy() -> dict:
+    """Pure HBM->HBM copy at the headline's working-set size: the
+    practical bandwidth ceiling on this chip/tunnel."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ceph_tpu.ec.benchmark import device_seconds_per_iter
+
+    words = _data_words()
+    kin, n4 = words.shape
+    tile = 8192
+
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] ^ 1
+
+    @jax.jit
+    def copy(w):
+        return pl.pallas_call(
+            kernel,
+            grid=(n4 // tile,),
+            in_specs=[pl.BlockSpec((kin, tile), lambda t: (0, t),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((kin, tile), lambda t: (0, t),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((kin, n4), jnp.int32),
+        )(w)
+
+    def step(i, w):
+        o = copy(w)
+        return w.at[0, 0].set(o[0, 0] ^ i)
+
+    sec = device_seconds_per_iter(step, words, lo=64, hi=320)
+    # traffic: read + write of the whole buffer
+    return {"sec": sec, "gibps": _gibps(K * N4 * 4, sec),
+            "traffic_gibps": _gibps(2 * K * N4 * 4, sec)}
+
+
+def exp_unpack_only() -> dict:
+    """Bit expansion + repack WITHOUT the matmul: the VPU-side cost of
+    the current formulation in isolation."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ceph_tpu.ec.benchmark import device_seconds_per_iter
+
+    words = _data_words()
+    kin, n4 = words.shape
+    tile = 8192
+
+    def kernel(x_ref, o_ref):
+        d = x_ref[:]
+        shift = jax.lax.broadcasted_iota(jnp.int32, (1, 32, 1), 1)
+        bits = ((d[:, None, :] >> shift) & 1)        # (kin, 32, T)
+        o_ref[:] = jnp.sum(bits << shift, axis=1)    # repack == d
+
+    @jax.jit
+    def f(w):
+        return pl.pallas_call(
+            kernel,
+            grid=(n4 // tile,),
+            in_specs=[pl.BlockSpec((kin, tile), lambda t: (0, t),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((kin, tile), lambda t: (0, t),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((kin, n4), jnp.int32),
+        )(w)
+
+    def step(i, w):
+        o = f(w)
+        return w.at[0, 0].set(o[0, 0] ^ i)
+
+    sec = device_seconds_per_iter(step, words, lo=64, hi=320)
+    return {"sec": sec, "gibps": _gibps(K * N4 * 4, sec)}
+
+
+def exp_roof_matmul() -> dict:
+    """The int8 contraction on PRE-EXPANDED bits: MXU throughput with
+    no unpack/pack on the critical path."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ceph_tpu.ec import bitmatrix as bm
+    from ceph_tpu.ec.benchmark import device_seconds_per_iter
+
+    ec = _codec()
+    bm32 = np.asarray(bm.expand_bitmatrix_lanes(
+        bm.gf_matrix_to_bitmatrix(
+            np.asarray(ec.generator[K:], np.uint8))), np.int8)
+    n4 = N4 // 8          # bits are 8x the data: shrink to fit HBM
+    bits = np.random.default_rng(1).integers(
+        0, 2, (K * 32, n4), np.int8)
+    tile = 4096
+
+    def kernel(bm_ref, b_ref, o_ref):
+        o_ref[:] = jnp.dot(bm_ref[:], b_ref[:],
+                           preferred_element_type=jnp.int32)
+
+    @jax.jit
+    def f(b):
+        return pl.pallas_call(
+            kernel,
+            grid=(n4 // tile,),
+            in_specs=[
+                pl.BlockSpec(bm32.shape, lambda t: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((K * 32, tile), lambda t: (0, t),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((M * 32, tile), lambda t: (0, t),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((M * 32, n4), jnp.int32),
+        )(jnp.asarray(bm32), b)
+
+    dev = jnp.asarray(bits)
+
+    def step(i, b):
+        o = f(b)
+        return b.at[0, 0].set((o[0, 0] ^ i).astype(jnp.int8))
+
+    sec = device_seconds_per_iter(step, dev, lo=64, hi=320)
+    # "data equivalent": bits represent n4*4 data bytes per row-set
+    return {"sec": sec, "data_gibps": _gibps(K * n4 * 4, sec),
+            "macs_per_sec": (M * 32) * (K * 32) * n4 / sec}
+
+
+def exp_clay_repair() -> dict:
+    """cfg4 with the fused grouped kernel (bench geometry)."""
+    import bench as bench_mod
+
+    t0 = time.perf_counter()
+    g = bench_mod._clay_repair_gibps()
+    return {"gibps": g, "wall": time.perf_counter() - t0}
+
+
+EXPERIMENTS = {
+    "roof_copy": exp_roof_copy,
+    "roof_matmul": exp_roof_matmul,
+    "unpack_only": exp_unpack_only,
+    "enc_base": exp_enc_base,
+    "enc_row_carry": exp_enc_row_carry,
+    "enc_tile_2048": _tile_exp(2048),
+    "enc_tile_4096": _tile_exp(4096),
+    "enc_tile_8192": _tile_exp(8192),
+    "enc_tile_16384": _tile_exp(16384),
+    "clay_repair": exp_clay_repair,
+}
+
+
+def main(argv=None) -> None:
+    names = (argv or sys.argv[1:]) or list(EXPERIMENTS)
+    enable_compile_cache()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+    import threading
+
+    budget = float(os.environ.get("PERF_LAB_BUDGET_S", 1500))
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(budget):
+            print(json.dumps({"error": f"budget {budget:.0f}s hit"}),
+                  flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+
+    jax.devices()
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out_path = os.path.join(here, "PERF_LAB.jsonl")
+    for name in names:
+        fn = EXPERIMENTS.get(name)
+        if fn is None:
+            print(f"unknown experiment {name!r}", file=sys.stderr)
+            continue
+        try:
+            t0 = time.perf_counter()
+            result = fn()
+            result["wall"] = round(time.perf_counter() - t0, 2)
+        except Exception as e:      # noqa: BLE001 — record and go on
+            result = {"error": f"{type(e).__name__}: {e}"}
+        rec = {"exp": name, **result,
+               "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                   time.gmtime())}
+        print(json.dumps(rec), flush=True)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    done.set()
+
+
+if __name__ == "__main__":
+    main()
