@@ -245,20 +245,71 @@ def _split_frames(raw: bytes) -> list[bytes]:
     return out
 
 
+def coalesce_writes(extents: list[tuple[int, bytes]]
+                    ) -> list[tuple[int, bytes]]:
+    """Merge a run of write extents into their final overlay (later
+    writes win) — the replay-side extent coalescing of the reference's
+    journal batching: N overlapping small writes hit the image once,
+    not N times.  Returns sorted, disjoint (offset, data) extents."""
+    merged: list[tuple[int, bytearray]] = []
+    for off, data in extents:
+        end = off + len(data)
+        keep: list[tuple[int, bytearray]] = []
+        for moff, mdata in merged:
+            mend = moff + len(mdata)
+            if mend <= off or moff >= end:
+                keep.append((moff, mdata))      # disjoint: untouched
+                continue
+            # overlap: the new write overlays; keep the old extent's
+            # non-overlapped head/tail
+            if moff < off:
+                keep.append((moff, mdata[:off - moff]))
+            if mend > end:
+                keep.append((end, mdata[end - moff:]))
+        keep.append((off, bytearray(data)))
+        merged = keep
+    merged.sort(key=lambda e: e[0])
+    # join adjacent extents so replay issues the fewest image writes
+    out: list[tuple[int, bytes]] = []
+    for off, data in merged:
+        if out and out[-1][0] + len(out[-1][1]) == off:
+            out[-1] = (out[-1][0], out[-1][1] + bytes(data))
+        else:
+            out.append((off, bytes(data)))
+    return out
+
+
 async def replay_to_image(img, journal: ImageJournal,
                           from_tid: int | None = None) -> int:
     """Apply every journal entry newer than the commit position (or
     ``from_tid``) to the image (librbd Journal replay on open / the
     ImageReplayer apply loop); returns the count applied.  Entries are
-    absolute-state ops, safe to re-apply.  The commit position only
-    advances after the applied data is durable (cache flushed)."""
+    absolute-state ops, safe to re-apply.  Runs of consecutive WRITE
+    events coalesce into their final overlay before touching the image
+    (non-write events are barriers — a resize or snap between writes
+    keeps its ordering).  The commit position only advances after the
+    applied data is durable (cache flushed)."""
     pos = await journal.committed() if from_tid is None else from_tid
     applied = 0
     last = pos
+    pending: list[tuple[int, bytes]] = []
+
+    async def flush_writes() -> None:
+        for off, data in coalesce_writes(pending):
+            if off + len(data) > img.size:
+                await img.resize(off + len(data), _journal=False)
+            await img.write(off, data, _journal=False)
+        pending.clear()
+
     async for tid, event, args in journal.entries_after(pos):
-        await apply_event(img, event, args)
+        if event == EV_WRITE:
+            pending.append((int(args["off"]), bytes(args["data"])))
+        else:
+            await flush_writes()
+            await apply_event(img, event, args)
         last = tid
         applied += 1
+    await flush_writes()
     if applied:
         if getattr(img, "_cache", None) is not None:
             await img._cache.flush()

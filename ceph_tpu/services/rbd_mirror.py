@@ -175,18 +175,28 @@ class JournalReplayer:
         """Full image sync (ImageReplayer bootstrap): the journal was
         trimmed past this client's position, so the entry stream alone
         cannot reconstruct the secondary.  Copy the primary's current
-        blocks; journal entries past the trim horizon then re-apply
-        idempotently on top."""
+        blocks — SPARSELY: the object map answers which blocks exist,
+        so an almost-empty image syncs in a handful of reads, not
+        size/obj_size of them (the reference's object-map-aware sync)."""
         src_img = await self.src.open(name)
         if dst_img.size != src_img.size:
             await dst_img.resize(src_img.size)
         bs = src_img.obj_size
-        for off in range(0, src_img.size, bs):
+        copied = 0
+        for objno in range(-(-src_img.size // bs)):
+            off = objno * bs
             want = min(bs, src_img.size - off)
+            if not await src_img._obj_exists(objno):
+                if await dst_img._obj_exists(objno):
+                    # divergent secondary block with no primary
+                    # counterpart: zero it, or it survives the sync
+                    await dst_img.write(off, b"\0" * want)
+                continue
             await dst_img.write(off, await src_img.read(off, want))
+            copied += want
         self.images_bootstrapped += 1
-        log.dout(5, "journal mirror bootstrapped %s (%d bytes)", name,
-                 src_img.size)
+        log.dout(5, "journal mirror bootstrapped %s (%d of %d bytes)",
+                 name, copied, src_img.size)
 
     async def replay_image(self, name: str) -> int:
         """Apply every journal entry newer than this replayer's commit

@@ -289,3 +289,111 @@ def test_journal_mirror_bootstraps_after_trim():
         await c1.stop()
         await c2.stop()
     asyncio.run(run())
+
+
+def test_coalesce_writes_unit():
+    """Replay-side extent coalescing: later writes win, adjacency
+    joins, barriers are the caller's concern (round-3 weak #6)."""
+    from ceph_tpu.services.rbd_journal import coalesce_writes
+
+    # later write overlays an earlier one
+    out = coalesce_writes([(0, b"aaaa"), (2, b"BB")])
+    assert out == [(0, b"aaBB")]
+    # partial overlap keeps head and tail of the older extent
+    out = coalesce_writes([(0, b"xxxxxxxx"), (2, b"YY"), (4, b"Z")])
+    assert out == [(0, b"xxYYZxxx")]
+    # disjoint extents stay disjoint; adjacent ones join
+    out = coalesce_writes([(0, b"ab"), (10, b"cd"), (2, b"ef")])
+    assert out == [(0, b"abef"), (10, b"cd")]
+    # same-offset rewrites collapse to the last one
+    out = coalesce_writes([(4, b"old!"), (4, b"new!")])
+    assert out == [(4, b"new!")]
+    assert coalesce_writes([]) == []
+
+
+def test_journal_replay_coalesces_into_final_overlay():
+    """N overlapping journaled writes replay as few merged image
+    writes, and the replayed content is the overlay a serial replay
+    would produce — with a resize barrier ordered in between."""
+    async def run():
+        c1, r1, src = await _zone("jc1-")
+        c2, r2, dst = await _zone("jc2-")
+        try:
+            await src.create("img", 1 << 20, order=18)
+            img = await src.open("img", journaled=True)
+            # many overlapping writes to one region + a shrink + more
+            for i in range(8):
+                await img.write(i * 512, bytes([i]) * 1024)
+            await img.resize(1 << 19)
+            await img.write(0, b"F" * 256)
+            await img.close()
+
+            replayer = JournalReplayer(src, dst)
+            applied = await replayer.sync_once()
+            assert applied >= 10
+            want_img = await src.open("img")
+            got_img = await dst.open("img")
+            assert got_img.size == want_img.size
+            want = await want_img.read(0, 8192)
+            got = await got_img.read(0, 8192)
+            assert got == want
+            await want_img.close()
+            await got_img.close()
+            await r1.shutdown()
+            await r2.shutdown()
+        finally:
+            await c1.stop()
+            await c2.stop()
+    asyncio.run(run())
+
+
+def test_bootstrap_is_sparse_and_heals_divergence():
+    """Bootstrap after trim copies only ALLOCATED primary blocks (the
+    object-map-aware sync) and zeroes secondary blocks the primary
+    does not have."""
+    async def run():
+        c1, r1, src = await _zone("jb1-")
+        c2, r2, dst = await _zone("jb2-")
+        try:
+            # big image, tiny allocation: one object at the start
+            await src.create("img", 1 << 22, order=18)
+            img = await src.open("img", journaled=True)
+            await img.write(0, b"live")
+            await img.close()
+
+            # secondary exists with DIVERGENT data in a block the
+            # primary never wrote
+            await dst.create("img", 1 << 22, order=18)
+            dimg = await dst.open("img")
+            await dimg.write(1 << 20, b"stale-divergence")
+            await dimg.close()
+
+            # force a bootstrap: trim the journal while only the
+            # master client is registered, THEN let the mirror
+            # register — its fresh position predates the horizon
+            img = await src.open("img", journaled=True)
+            img._journal.per_obj = 4
+            for i in range(10):
+                await img.write(0, b"live")
+            await img.close()          # commits + trims (only client)
+            assert await img._journal.trim_horizon() > 0
+
+            replayer = JournalReplayer(src, dst)
+            image_id = await src.image_id("img")
+            j = ImageJournal(src.ioctx, image_id, client_id="mirror",
+                             per_obj=4)
+            await j.register()
+            replayer._journals["img"] = j
+            await replayer.sync_once()
+            assert replayer.images_bootstrapped == 1
+            got = await dst.open("img")
+            assert await got.read(0, 4) == b"live"
+            # the divergent block was healed to the primary's state
+            assert await got.read(1 << 20, 16) == b"\0" * 16
+            await got.close()
+            await r1.shutdown()
+            await r2.shutdown()
+        finally:
+            await c1.stop()
+            await c2.stop()
+    asyncio.run(run())
