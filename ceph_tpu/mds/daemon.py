@@ -1208,7 +1208,7 @@ class MDSDaemon:
         # cross-rank destinations with EXDEV
         dino = int(d.get("src_parent",
                          d.get("parent", d.get("ino", ROOT_INO))))
-        if op in ("session", "get_load"):
+        if op in ("session", "get_load", "subtree_refresh"):
             return dino
         auth, explicit = await self._auth_rank_ex(dino)
         if auth != self.rank and (
@@ -1238,13 +1238,15 @@ class MDSDaemon:
                 raise MDSError(EINVAL, f"unknown mds op {op!r}")
             d["_conn"] = conn       # cap ops key grants on the session
             dino = await self._check_auth(d, op)
-            if op not in ("session", "get_load", "export_dir"):
+            if op not in ("session", "get_load", "export_dir",
+                          "subtree_refresh"):
                 # balancer popularity: the directory the auth check
                 # routed by (exports are administrative, not load)
                 self._note_pop(dino)
             if op in ("lookup", "readdir", "session", "lssnap",
                       "rename", "link", "unlink", "setattr",
-                      "get_load", "open_file", "release_cap"):
+                      "get_load", "open_file", "release_cap",
+                      "subtree_refresh"):
                 # reads need no lock; rename/link/unlink/setattr
                 # manage their own (each must release the mutate lock
                 # across a cross-rank peer RPC); cap ops await client
@@ -1555,9 +1557,45 @@ class MDSDaemon:
             for dino in list(self._pop):
                 if dino == ino or await self._is_ancestor(ino, dino):
                     self._pop.pop(dino, None)
+        # PUSH the new map to every other active rank (MExportDirNotify
+        # role): peers adopt the delegation immediately instead of
+        # discovering it on their next redirect miss (round-3 weak #5:
+        # propagation was refresh-on-redirect only).  Best-effort —
+        # redirect-refresh remains the safety net for missed pushes.
+        await self._push_subtree_update()
         log.dout(1, "%s: exported dir %x to rank %d", self.entity,
                  ino, rank)
         return {"rank": rank}
+
+    async def _push_subtree_update(self) -> None:
+        try:
+            r = await self.rados.mon_command("mds stat")
+        except (IOError, ConnectionError):
+            return
+        if r.get("rc") != 0:
+            return
+        actives = (r["data"]["filesystems"]
+                   .get(self.fs_name, {}).get("actives", ()))
+        peers = [int(a["rank"]) for a in actives
+                 if int(a["rank"]) != self.rank]
+        if not peers:
+            return
+        replies = await asyncio.gather(
+            *(self._peer_request(p, {"op": "subtree_refresh"},
+                                 timeout=2.0) for p in peers),
+            return_exceptions=True)
+        for p, rep in zip(peers, replies):
+            if isinstance(rep, BaseException):
+                log.dout(5, "%s: subtree push to rank %d missed: %s",
+                         self.entity, p, rep)
+
+    async def _req_subtree_refresh(self, d: dict) -> dict:
+        """Peer push after an export: adopt the shared subtree map NOW
+        (throttle bypassed) so the very next client op routes by the
+        new delegation."""
+        await self._load_subtrees()
+        self._auth_cache.clear()
+        return {}
 
     # -- client sessions (SessionMap / session evict) ----------------------
     def session_ls(self) -> list[dict]:

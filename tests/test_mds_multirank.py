@@ -369,3 +369,30 @@ def test_cross_rank_rename_protocol_guards():
             await _teardown(cluster, rados, fs)
 
     asyncio.run(run())
+
+
+def test_subtree_map_pushes_to_peer_ranks():
+    """An export PUSHES the new subtree map to the other active ranks
+    (MExportDirNotify role) — the peer adopts the delegation with no
+    client redirect needed (round-3 weak #5: propagation was
+    refresh-on-redirect only)."""
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        await fs.mkdir("/pushed")
+        st = await fs.stat("/pushed")
+        ino = int(st["ino"])
+        assert ino not in mds_b._subtrees
+        await fs.export_dir("/pushed", 1)
+        # the push lands synchronously with the export reply: rank 1
+        # already holds the entry in ITS in-memory map
+        assert mds_b._subtrees.get(ino) == 1
+        # and rank 1 serves the subtree without a single redirect
+        before = getattr(mds_b, "_subtrees_loaded", 0.0)
+        await fs.write_file("/pushed/file", b"x")
+        assert mds_b._subtrees.get(ino) == 1
+        # export BACK to rank 0 from rank 1 pushes to rank 0 likewise
+        await fs.export_dir("/pushed", 0)
+        assert mds_a._subtrees.get(ino, 0) == 0 or \
+            ino not in mds_a._subtrees
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
