@@ -409,13 +409,16 @@ class MDSDaemon:
             op = e.get("op")
             token = str(e.get("token", ""))
             if op in ("rename_export_intent", "link_export_intent",
-                      "unlink_remote_intent"):
+                      "unlink_remote_intent",
+                      "promote_export_intent"):
                 self._open_intents[token] = e
             elif op in ("rename_export_finish",
                         "rename_export_abort",
                         "link_export_finish", "link_export_abort",
                         "unlink_remote_finish",
-                        "unlink_remote_abort"):
+                        "unlink_remote_abort",
+                        "promote_export_finish",
+                        "promote_export_abort"):
                 self._open_intents.pop(token, None)
         if entries:
             await self._compact_journal()
@@ -439,7 +442,9 @@ class MDSDaemon:
                             "rename_export_abort",
                             "link_export_intent": "link_export_abort",
                             "unlink_remote_intent":
-                            "unlink_remote_abort"}[op]
+                            "unlink_remote_abort",
+                            "promote_export_intent":
+                            "promote_export_abort"}[op]
                 await self._journal({"op": abort_op, "ino": ino,
                                      **{k: e[k] for k in
                                         ("src_parent", "src_name")
@@ -459,14 +464,22 @@ class MDSDaemon:
                 pp, pn = int(e["pp"]), str(e["pn"])
                 primary = dict(await self._get_dentry(pp, pn))
                 primary["nlink"] = int(primary.get("nlink", 1)) + 1
-                rec = await self._anchor_get(ino) or \
-                    {"primary": [pp, pn], "remotes": []}
+                rec = await self._anchor_get(ino)
+                base = rec or {"primary": [pp, pn], "remotes": []}
                 fin = {"op": "link_export_finish", "pp": pp, "pn": pn,
                        "ino": ino, "primary_dentry": primary,
-                       "anchor": {"primary": rec["primary"],
-                                  "remotes": list(rec["remotes"])
-                                  + [[int(e["parent"]),
-                                      str(e["name"])]]},
+                       "anchor": await self._anchor_next(ino, {
+                           "primary": base["primary"],
+                           "remotes": list(base["remotes"])
+                           + [[int(e["parent"]),
+                               str(e["name"])]]}),
+                       "token": token}
+            elif op == "promote_export_intent":
+                # the remote's rank adopted the primary before the
+                # crash: drop our old primary NAME (never the data)
+                fin = {"op": "promote_export_finish",
+                       "parent": int(e["parent"]),
+                       "name": str(e["name"]), "ino": ino,
                        "token": token}
             else:                       # unlink_remote_intent
                 fin = {"op": "unlink_remote_finish",
@@ -494,11 +507,12 @@ class MDSDaemon:
         self.journal_len += 1
         op = entry.get("op")
         if op in ("rename_export_intent", "link_export_intent",
-                  "unlink_remote_intent"):
+                  "unlink_remote_intent", "promote_export_intent"):
             self._open_intents[str(entry.get("token", ""))] = entry
         elif op in ("rename_export_finish", "rename_export_abort",
                     "link_export_finish", "link_export_abort",
-                    "unlink_remote_finish", "unlink_remote_abort"):
+                    "unlink_remote_finish", "unlink_remote_abort",
+                    "promote_export_finish", "promote_export_abort"):
             self._open_intents.pop(str(entry.get("token", "")), None)
 
     async def _maybe_compact(self) -> None:
@@ -825,7 +839,8 @@ class MDSDaemon:
             self._quota_invalidate()
         elif op in ("rename_export_intent", "rename_export_abort",
                     "link_export_intent", "link_export_abort",
-                    "unlink_remote_intent", "unlink_remote_abort"):
+                    "unlink_remote_intent", "unlink_remote_abort",
+                    "promote_export_intent", "promote_export_abort"):
             pass          # journal markers; resolved by replay repair
         elif op == "import_link":
             # cross-rank link, destination half: the commit claim
@@ -857,6 +872,29 @@ class MDSDaemon:
             # cross-rank remote-unlink, name half: drop the remote
             # dentry only — the primary's rank already adjusted
             # nlink/anchor under the commit claim
+            await self._rm_dentry(int(e["parent"]),
+                                  str(e["name"]))
+        elif op == "import_promoted":
+            # cross-rank promotion, remote-name half (claim-gated):
+            # the remote dentry becomes the inode's primary and the
+            # anchor moves with it
+            ok = True
+            if e.get("token"):
+                ok = await self._rename_mark_commit(str(e["token"]))
+            if ok:
+                await self._set_dentry(int(e["parent"]),
+                                       str(e["name"]),
+                                       dict(e["primary_dentry"]))
+                await self._anchor_put(int(e["ino"]), e.get("anchor"))
+                # a stale backtrace would let data-scan resurrect the
+                # deleted old primary name (promote_link parity)
+                await self._write_backtrace(int(e["ino"]),
+                                            int(e["parent"]),
+                                            str(e["name"]),
+                                            dict(e["primary_dentry"]))
+        elif op == "promote_export_finish":
+            # cross-rank promotion, old-primary half: drop the NAME
+            # only — the inode lives on under the promoted primary
             await self._rm_dentry(int(e["parent"]),
                                   str(e["name"]))
         elif op == "setattr":
@@ -982,7 +1020,8 @@ class MDSDaemon:
     # anchortable omap maps ino -> {"primary": [p, n], "remotes":
     # [[p, n], ...]} so remotes resolve and unlink can promote
     # (reference src/mds/AnchorTable-era design, kept as server state).
-    async def _anchor_get(self, ino: int) -> dict | None:
+    async def _anchor_get_raw(self, ino: int) -> dict | None:
+        """The stored record, tombstones included (version source)."""
         try:
             kv = await self.meta.get_omap(ANCHOR_OID, [str(ino)])
         except RadosError as e:
@@ -991,18 +1030,39 @@ class MDSDaemon:
             raise
         return decode(kv[str(ino)]) if str(ino) in kv else None
 
+    async def _anchor_get(self, ino: int) -> dict | None:
+        rec = await self._anchor_get_raw(ino)
+        return None if rec is None or rec.get("dead") else rec
+
+    async def _anchor_next(self, ino: int,
+                           new: dict | None) -> dict:
+        """The next anchor state, version-stamped at PLAN time so a
+        journal replay re-applies exactly the version it applied live.
+        Anchors are written from MORE THAN ONE rank's journal (the
+        primary moves ranks on cross-rank promotion), so replay-
+        ordering cannot come from one journal's sequence — it comes
+        from the record version: _anchor_put keeps the newest write,
+        and deletion is a versioned TOMBSTONE (the version must keep
+        counting across delete/recreate cycles, so the raw stored
+        record — dead or live — is the version source)."""
+        raw = await self._anchor_get_raw(ino)
+        v = (int(raw.get("v", 0)) if raw else 0) + 1
+        if new is None:
+            return {"dead": True, "v": v}
+        return {**new, "v": v}
+
     async def _anchor_put(self, ino: int, rec: dict | None) -> None:
+        raw = await self._anchor_get_raw(ino)
+        cur_v = int(raw.get("v", 0)) if raw else 0
         if rec is None:
-            try:
-                await self.meta.operate(
-                    ANCHOR_OID, ObjectOperation().omap_rm([str(ino)]))
-            except RadosError as e:
-                if e.rc != ENOENT:
-                    raise
-        else:
-            await self.meta.operate(
-                ANCHOR_OID, ObjectOperation().create()
-                .omap_set({str(ino): encode(rec)}))
+            rec = {"dead": True, "v": cur_v + 1}
+        elif "v" not in rec:
+            rec = {**rec, "v": cur_v + 1}     # unplanned (scrub) write
+        elif int(rec["v"]) <= cur_v:
+            return        # stale replayed write: a newer state landed
+        await self.meta.operate(
+            ANCHOR_OID, ObjectOperation().create()
+            .omap_set({str(ino): encode(rec)}))
 
     async def _primary_of(self, ino: int,
                           rec: dict | None = None,
@@ -1066,8 +1126,9 @@ class MDSDaemon:
             primary["nlink"] = nl
             remotes = [r for r in rec["remotes"]
                        if [int(r[0]), str(r[1])] != [parent, name]]
-            new_rec = (None if nl <= 1 else
-                       {"primary": [pp, pn], "remotes": remotes})
+            new_rec = await self._anchor_next(
+                ino, None if nl <= 1 else
+                {"primary": [pp, pn], "remotes": remotes})
             return {"op": "unlink_remote", "parent": parent,
                     "name": name, "ino": ino, "pp": pp, "pn": pn,
                     "primary_dentry": primary, "anchor": new_rec}
@@ -1077,9 +1138,10 @@ class MDSDaemon:
             np, nn = int(rec["remotes"][0][0]), str(rec["remotes"][0][1])
             promoted = dict(dentry)
             promoted["nlink"] = nl - 1
-            new_rec = (None if nl - 1 <= 1 else
-                       {"primary": [np, nn],
-                        "remotes": rec["remotes"][1:]})
+            new_rec = await self._anchor_next(
+                ino, None if nl - 1 <= 1 else
+                {"primary": [np, nn],
+                 "remotes": rec["remotes"][1:]})
             return {"op": "promote_link", "parent": parent,
                     "name": name, "ino": ino, "np": np, "nn": nn,
                     "primary_dentry": promoted, "anchor": new_rec}
@@ -1845,6 +1907,7 @@ class MDSDaemon:
                  repaired=repair)
             if repair:
                 rec.setdefault("remotes", []).append([parent, name])
+                rec.pop("v", None)      # live repair: bump past stored
                 await self._anchor_put(ino, rec)
             return
         if rec is not None and listed and not primary_ok:
@@ -1865,6 +1928,7 @@ class MDSDaemon:
                 rec["remotes"] = [
                     r for r in rec.get("remotes", ())
                     if list(r) != [parent, name]]
+                rec.pop("v", None)      # live repair: bump past stored
                 if rec["remotes"]:
                     await self._anchor_put(ino, rec)
                 else:
@@ -2340,6 +2404,8 @@ class MDSDaemon:
             raise
         for raw in omap.values():
             rec = decode(raw)
+            if rec.get("dead"):
+                continue          # versioned tombstone, not a link
             names = [rec["primary"]] + list(rec.get("remotes", ()))
             inside = []
             for p, _ in names:
@@ -2402,10 +2468,12 @@ class MDSDaemon:
             ino = int(dentry["ino"])
             primary = dict(dentry)
             primary["nlink"] = int(dentry.get("nlink", 1)) + 1
-            rec = await self._anchor_get(ino) or \
-                {"primary": [sp, sn], "remotes": []}
-            anchor = {"primary": rec["primary"],
-                      "remotes": list(rec["remotes"]) + [[dp, dn]]}
+            rec = await self._anchor_get(ino)
+            base = rec or {"primary": [sp, sn], "remotes": []}
+            anchor = await self._anchor_next(ino, {
+                "primary": base["primary"],
+                "remotes": list(base["remotes"]) + [[dp, dn]],
+            })
             dst_rank = await self._auth_rank(dp)
             if dst_rank == self.rank:
                 await self._ensure_absent(dp, dn)
@@ -2520,6 +2588,35 @@ class MDSDaemon:
                             "token": token})
                         self._busy_names.add((parent, name))
                         cross = (token, prim_rank, pp)
+            elif int(dentry.get("nlink", 1)) > 1:
+                # unlinking a PRIMARY whose first remote lives on a
+                # foreign rank: the promotion (primary dentry + anchor
+                # move to the remote's rank) runs the witness-lite
+                # two-phase protocol instead of declining (round-3
+                # weak #5 closed for the direct-unlink case)
+                rec = await self._anchor_get(ino)
+                if rec is not None and rec["remotes"]:
+                    np, nn = int(rec["remotes"][0][0]), \
+                        str(rec["remotes"][0][1])
+                    rem_rank = await self._auth_rank(np)
+                    if rem_rank != self.rank:
+                        nl = int(dentry.get("nlink", 1))
+                        promoted = dict(dentry)
+                        promoted["nlink"] = nl - 1
+                        promoted.pop("remote", None)
+                        new_rec = await self._anchor_next(
+                            ino, None if nl - 1 <= 1 else
+                            {"primary": [np, nn],
+                             "remotes": rec["remotes"][1:]})
+                        token = secrets.token_hex(8)
+                        await self._journal({
+                            "op": "promote_export_intent",
+                            "parent": parent, "name": name,
+                            "ino": ino, "np": np, "nn": nn,
+                            "token": token})
+                        self._busy_names.add((parent, name))
+                        cross = ("promote", token, rem_rank, np, nn,
+                                 promoted, new_rec)
             if cross is None:
                 await self._plan_unlink_guard(dentry)
                 entry = await self._unlink_plan(parent, name, dentry)
@@ -2536,12 +2633,75 @@ class MDSDaemon:
                                  if entry["op"] == "unlink" else 0))
                 await self._maybe_compact()
                 return {"ino": ino}
+        if cross[0] == "promote":
+            _, token, rem_rank, np, nn, promoted, new_rec = cross
+            try:
+                return await self._promote_export_cross(
+                    parent, name, ino, rem_rank, np, nn, promoted,
+                    new_rec, token)
+            finally:
+                self._busy_names.discard((parent, name))
         token, prim_rank, pp = cross
         try:
             return await self._unlink_remote_cross(
                 parent, name, ino, pp, prim_rank, token)
         finally:
             self._busy_names.discard((parent, name))
+
+    async def _promote_export_cross(self, parent: int, name: str,
+                                    ino: int, rem_rank: int, np: int,
+                                    nn: str, promoted: dict,
+                                    new_rec, token: str) -> dict:
+        """Cross-rank link promotion: the remote's rank adopts the
+        primary dentry + anchor under the commit claim; this rank's
+        finish drops the old primary NAME only (the inode lives on
+        under the new primary — no purge)."""
+        await self._two_phase_finish(
+            rem_rank,
+            {"op": "import_promoted", "parent": np, "name": nn,
+             "ino": ino, "primary_dentry": promoted,
+             "anchor": new_rec, "token": token},
+            token,
+            {"op": "promote_export_abort", "ino": ino,
+             "token": token},
+            {"op": "promote_export_finish", "parent": parent,
+             "name": name, "ino": ino, "token": token},
+            "remote rank unreachable; unlink rolled back")
+        # the primary (and its bytes) moved into the remote's realm
+        self._quota_invalidate()
+        return {"ino": ino}
+
+    async def _req_import_promoted(self, d: dict) -> dict:
+        """Peer half of the cross-rank promotion (routed by the remote
+        name's parent, so _check_auth enforces OUR authority): replace
+        the remote dentry with the promoted primary, adopt the anchor.
+        Claim-gated exactly like import_dentry/import_link."""
+        np, nn = int(d["parent"]), str(d["name"])
+        token = str(d.get("token", ""))
+        try:
+            cur = await self._get_dentry(np, nn)
+        except MDSError as e:
+            if not e.missing_dentry:
+                raise
+            raise MDSError(ENOENT, f"remote name {nn!r} vanished")
+        if int(cur.get("ino", 0)) != int(d["ino"]):
+            raise MDSError(EINVAL,
+                           "dentry no longer names the expected inode")
+        if not cur.get("remote"):
+            return {"dentry": cur}      # retried import: already done
+        entry = {"op": "import_promoted", "parent": np, "name": nn,
+                 "ino": int(d["ino"]),
+                 "primary_dentry": dict(d["primary_dentry"]),
+                 "anchor": d.get("anchor"), "token": token}
+        await self._journal(entry)
+        await self._apply(entry)
+        self._quota_invalidate()
+        if token:
+            state = await self._rename_marker_state(token)
+            if not state.get("committed"):
+                raise MDSError(EXDEV,
+                               "promotion aborted by the source rank")
+        return {"dentry": dict(d["primary_dentry"])}
 
     async def _unlink_remote_cross(self, parent: int, name: str,
                                    ino: int, pp: int, prim_rank: int,
@@ -2584,8 +2744,9 @@ class MDSDaemon:
         nl = int(primary.get("nlink", 1)) - 1
         primary["nlink"] = nl
         kept = [r for r in remotes if r != drop]
-        anchor = (None if nl <= 1 else
-                  {"primary": [pp, pn], "remotes": kept})
+        anchor = await self._anchor_next(
+            ino, None if nl <= 1 else
+            {"primary": [pp, pn], "remotes": kept})
         entry = {"op": "update_primary", "pp": pp, "pn": pn,
                  "ino": ino, "primary_dentry": primary,
                  "anchor": anchor, "token": token}
@@ -2935,13 +3096,16 @@ class MDSDaemon:
             rec = await self._anchor_get(anchor_ino)
             if rec is not None:
                 if dentry.get("remote"):
-                    anchor = {"primary": rec["primary"], "remotes": [
-                        ([dp, dn] if [int(r[0]), str(r[1])] == [sp, sn]
-                         else r) for r in rec["remotes"]
-                    ]}
+                    anchor = await self._anchor_next(anchor_ino, {
+                        "primary": rec["primary"], "remotes": [
+                            ([dp, dn]
+                             if [int(r[0]), str(r[1])] == [sp, sn]
+                             else r) for r in rec["remotes"]
+                        ]})
                 else:
-                    anchor = {"primary": [dp, dn],
-                              "remotes": rec["remotes"]}
+                    anchor = await self._anchor_next(anchor_ino, {
+                        "primary": [dp, dn],
+                        "remotes": rec["remotes"]})
             else:
                 anchor_ino = 0
         if self.quotas:

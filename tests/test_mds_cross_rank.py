@@ -145,14 +145,17 @@ def test_cross_rank_link_lifecycle():
             await fs.unlink("/alias")
             assert await fs.read_file("/shared/data") == b"rewritten"
             assert int((await fs.stat("/shared/data"))["nlink"]) == 1
-            # re-link, then removing the PRIMARY first is declined
-            # (promote would cross ranks) until the remote is gone
+            # re-link, then remove the PRIMARY first: the promotion
+            # crosses ranks via the import_promoted two-phase protocol
+            # (the remote name becomes the primary on ITS rank)
             await fs.link("/shared/data", "/alias2")
-            with pytest.raises(FSError) as ei:
-                await fs.unlink("/shared/data")
-            assert ei.value.rc == EXDEV
-            await fs.unlink("/alias2")
-            await fs.unlink("/shared/data")       # now fine
+            await fs.unlink("/shared/data")
+            fs._dcache.clear()
+            assert await fs.read_file("/alias2") == b"rewritten"
+            st = await fs.stat("/alias2")
+            assert int(st["nlink"]) == 1
+            assert not st.get("remote")
+            await fs.unlink("/alias2")            # last name: purges
             # duplicate destination name: EEXIST surfaces
             await fs.write_file("/shared/p", b"")
             await fs.write_file("/taken", b"")
@@ -302,6 +305,62 @@ def test_unlink_remote_intent_crash_repair():
             fs._dcache.clear()
             assert await fs.read_file("/name2") == b"v"   # still there
             assert int((await fs.stat("/shared/data"))["nlink"]) == 2
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_promote_export_intent_crash_repair():
+    """Cross-rank PROMOTION crash windows: committed on the remote's
+    rank but crashed before the local finish -> repair drops the old
+    primary name (never the data); an uncommitted intent rolls back
+    and the link is fully intact."""
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            # primary "/shared/data" on rank 1, remote "/name" on 0;
+            # unlink the PRIMARY: rank 1 runs promote_export
+            await fs.write_file("/shared/data", b"payload")
+            await fs.link("/shared/data", "/name")
+            ino = int((await fs.stat("/shared/data"))["ino"])
+            shared = int((await fs.stat("/shared"))["ino"])
+            import secrets
+            token = secrets.token_hex(8)
+            promoted = dict(await mds_b._get_dentry(shared, "data"))
+            promoted["nlink"] = 1
+            promoted.pop("remote", None)
+            # the production plan always journals a VERSIONED anchor
+            # state (tombstone for deletion) — replay-safe by version
+            tomb = await mds_b._anchor_next(ino, None)
+            await mds_b._journal({
+                "op": "promote_export_intent", "parent": shared,
+                "name": "data", "ino": ino, "np": 1, "nn": "name",
+                "token": token})
+            reply = await mds_b._peer_request(0, {
+                "op": "import_promoted", "parent": 1, "name": "name",
+                "ino": ino, "primary_dentry": promoted,
+                "anchor": tomb, "token": token})
+            assert reply.get("rc") == 0, reply
+            await mds_b._resync()       # simulated crash + repair
+            fs._dcache.clear()
+            with pytest.raises(FSError):
+                await fs.stat("/shared/data")
+            st = await fs.stat("/name")
+            assert int(st["nlink"]) == 1 and not st.get("remote")
+            assert await fs.read_file("/name") == b"payload"
+
+            # uncommitted intent: rolls back, both names intact
+            await fs.link("/name", "/shared/back")
+            token2 = secrets.token_hex(8)
+            await mds_a._journal({
+                "op": "promote_export_intent", "parent": 1,
+                "name": "name", "ino": ino, "np": shared,
+                "nn": "back", "token": token2})
+            await mds_a._resync()
+            fs._dcache.clear()
+            assert await fs.read_file("/name") == b"payload"
+            assert int((await fs.stat("/name"))["nlink"]) == 2
+            assert await fs.read_file("/shared/back") == b"payload"
         finally:
             await _teardown(cluster, rados, fs)
     asyncio.run(run())
