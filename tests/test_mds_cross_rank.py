@@ -167,22 +167,83 @@ def test_cross_rank_link_lifecycle():
     asyncio.run(run())
 
 
-def test_cross_rank_link_rename_guard():
+def test_cross_rank_link_rename_repoint():
     async def run():
         cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
         try:
             await fs.write_file("/shared/f", b"x")
             await fs.link("/shared/f", "/name")
-            # renaming the remote name of a cross-rank link declines
-            # (anchor repoint would span ranks)
-            with pytest.raises(FSError) as ei:
-                await fs.rename("/name", "/name2")
-            assert ei.value.rc == EXDEV
-            # replacing it via rename declines the same way
+            # renaming the remote name of a cross-rank link runs the
+            # repoint protocol on the primary's rank (weak #5 closed)
+            await fs.rename("/name", "/name2")
+            fs._dcache.clear()
+            with pytest.raises(FSError):
+                await fs.stat("/name")
+            assert await fs.read_file("/name2") == b"x"
+            assert int((await fs.stat("/name2"))["ino"]) == \
+                int((await fs.stat("/shared/f"))["ino"])
+            # the anchor tracks the new name: unlinking it through
+            # update_primary still works end-to-end
+            await fs.unlink("/name2")
+            assert int((await fs.stat("/shared/f"))["nlink"]) == 1
+            # REPLACING a name of a cross-rank link still declines
+            # (it would nest a link teardown inside the repoint)
+            await fs.link("/shared/f", "/name3")
             await fs.write_file("/other", b"y")
             with pytest.raises(FSError) as ei:
-                await fs.rename("/other", "/name")
+                await fs.rename("/other", "/name3")
             assert ei.value.rc == EXDEV
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_repoint_intent_crash_repair():
+    """Crash windows of the remote-name rename: a committed repoint
+    completes the name move on repair; an uncommitted one rolls back
+    with the original name intact."""
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.write_file("/shared/f", b"x")
+            await fs.link("/shared/f", "/name")
+            ino = int((await fs.stat("/shared/f"))["ino"])
+            shared = int((await fs.stat("/shared"))["ino"])
+            import secrets
+            token = secrets.token_hex(8)
+            dentry = dict(await mds_a._get_dentry(1, "name"))
+            await mds_a._journal({
+                "op": "repoint_intent", "src_parent": 1,
+                "src_name": "name", "dst_parent": 1,
+                "dst_name": "moved", "ino": ino,
+                "dentry": dentry, "token": token})
+            reply = await mds_a._peer_request(1, {
+                "op": "repoint_remote", "parent": shared,
+                "ino": ino, "old": [1, "name"],
+                "new": [1, "moved"], "token": token})
+            assert reply.get("rc") == 0, reply
+            await mds_a._resync()       # crash before the local finish
+            fs._dcache.clear()
+            with pytest.raises(FSError):
+                await fs.stat("/name")
+            assert await fs.read_file("/moved") == b"x"
+            await fs.unlink("/moved")   # anchor points at the new name
+            assert int((await fs.stat("/shared/f"))["nlink"]) == 1
+
+            # uncommitted intent: rolls back, the name stays put
+            await fs.link("/shared/f", "/back")
+            token2 = secrets.token_hex(8)
+            await mds_a._journal({
+                "op": "repoint_intent", "src_parent": 1,
+                "src_name": "back", "dst_parent": 1,
+                "dst_name": "ghost", "ino": ino,
+                "dentry": dict(await mds_a._get_dentry(1, "back")),
+                "token": token2})
+            await mds_a._resync()
+            fs._dcache.clear()
+            assert await fs.read_file("/back") == b"x"
+            with pytest.raises(FSError):
+                await fs.stat("/ghost")
         finally:
             await _teardown(cluster, rados, fs)
     asyncio.run(run())
