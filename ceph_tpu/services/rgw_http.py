@@ -58,6 +58,8 @@ _STATUS = {
     "InvalidAccessKeyId": 403,
     "NoSuchBucket": 404,
     "NoSuchKey": 404,
+    "NoSuchUser": 404,
+    "UserAlreadyExists": 409,
     "NoSuchVersion": 404,
     "NoSuchUpload": 404,
     "NoSuchLifecycleConfiguration": 404,
@@ -677,11 +679,99 @@ class S3Frontend:
             web = await self._maybe_website(req, gw, bucket, key)
             if web is not None:
                 return web
+        if bucket == "admin":
+            return await self._admin(req, uid, key)
         if not bucket:
             return await self._service(req, gw)
         if not key:
             return await self._bucket(req, gw, bucket)
         return await self._object(req, gw, bucket, key)
+
+    # -- admin ops API (reference RGWRESTMgr_Admin: /admin/user,
+    # /admin/bucket, /admin/usage, /admin/metadata/*) ------------------
+    async def _admin(self, req: _Request, uid: str, sub: str):
+        """The radosgw admin ops REST surface: JSON in/out, reachable
+        only by SYSTEM users (the reference gates on the user's
+        system flag)."""
+        import json as _json
+
+        if uid not in self.system_users:
+            raise _HTTPError(403, "AccessDenied",
+                             "admin API requires a system user")
+
+        def jout(status: int, data) -> tuple[int, dict, bytes]:
+            body = _json.dumps(data, default=str).encode()
+            return status, {"content-type": "application/json"}, body
+
+        q = req.query
+        gw = self.rgw.as_user(None)
+        if sub == "user":
+            tuid = q.get("uid", "")
+            if req.method == "GET":
+                if not tuid:
+                    return jout(200, await self.users.list())
+                return jout(200, await self.users.get(tuid))
+            if req.method == "PUT":
+                rec = await self.users.create(
+                    tuid, q.get("display-name", ""),
+                    max_size=int(q.get("max-size", 0) or 0),
+                    max_objects=int(q.get("max-objects", 0) or 0))
+                return jout(201, rec)
+            if req.method == "POST":
+                if "suspended" in q:
+                    await self.users.set_suspended(
+                        tuid, q["suspended"] in ("1", "true", "True"))
+                if "max-size" in q or "max-objects" in q:
+                    await self.users.set_quota(
+                        tuid,
+                        max_size=int(q.get("max-size", 0) or 0),
+                        max_objects=int(q.get("max-objects", 0) or 0))
+                return jout(200, await self.users.get(tuid))
+            if req.method == "DELETE":
+                await self.users.remove(tuid)
+                return jout(200, {"removed": tuid})
+        elif sub == "bucket":
+            tb = q.get("bucket", "")
+            if req.method == "GET":
+                if not tb:
+                    return jout(200, await gw.list_buckets())
+                meta = await gw._bucket_meta(tb)
+                nbytes, nobj = await gw._bucket_usage(tb)
+                return jout(200, {
+                    "bucket": tb, "owner": meta.get("owner", ""),
+                    "num_objects": nobj, "size_bytes": nbytes,
+                    "index_shards": int(meta.get("index_shards", 1)),
+                    "versioning": meta.get("versioning", ""),
+                })
+            if req.method == "DELETE":
+                await gw.delete_bucket(tb)
+                return jout(200, {"removed": tb})
+        elif sub == "usage":
+            if req.method == "GET":
+                out = {}
+                for b in await gw.list_buckets():
+                    try:
+                        meta = await gw._bucket_meta(b)
+                        nbytes, nobj = await gw._bucket_usage(b)
+                    except RGWError:
+                        continue
+                    u = out.setdefault(meta.get("owner", ""), {
+                        "buckets": 0, "objects": 0, "bytes": 0})
+                    u["buckets"] += 1
+                    u["objects"] += nobj
+                    u["bytes"] += nbytes
+                return jout(200, out)
+        elif sub.startswith("metadata"):
+            # rgw_rest_metadata.h: enumerate metadata entries by type
+            mtype = sub.partition("/")[2] or q.get("type", "")
+            if req.method == "GET":
+                if mtype == "user":
+                    return jout(200, await self.users.list())
+                if mtype == "bucket":
+                    return jout(200, await gw.list_buckets())
+                return jout(200, ["user", "bucket"])
+        raise _HTTPError(405, "MethodNotAllowed",
+                         f"{req.method} /admin/{sub}")
 
     async def _maybe_website(self, req: _Request, gw: RGWLite,
                              bucket: str, key: str):

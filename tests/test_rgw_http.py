@@ -1062,3 +1062,82 @@ def test_static_website_hosting():
             await stop_cluster(mon, osds, rados)
 
     asyncio.run(run())
+
+
+def test_admin_ops_api():
+    """Admin ops REST (reference RGWRESTMgr_Admin /admin/user,
+    /admin/bucket, /admin/usage, rgw_rest_metadata.h): system users
+    only, JSON round trips driving the same user/bucket machinery as
+    radosgw-admin."""
+    import json as _json
+
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgw", pg_num=8)
+            ioctx = await rados.open_ioctx("rgw")
+            users = RGWUsers(ioctx)
+            admin = await users.create("sysadmin")
+            alice = await users.create("alice")
+            gw = RGWLite(ioctx, users=users)
+            fe = S3Frontend(gw, users=users,
+                            system_users=frozenset({"sysadmin"}))
+            host, port = await fe.start()
+            sys_cli = S3HttpClient(host, port, admin["access_key"],
+                                   admin["secret_key"])
+            user_cli = S3HttpClient(host, port, alice["access_key"],
+                                    alice["secret_key"])
+
+            # non-system users are fenced off the whole surface
+            st, _, _ = await user_cli.request("GET", "/admin/user")
+            assert st == 403
+            # user lifecycle: create, info, modify (suspend), delete
+            st, _, body = await sys_cli.request(
+                "PUT", "/admin/user?uid=bob&display-name=Bob")
+            assert st == 201
+            bob = _json.loads(body)
+            assert bob["uid"] == "bob" and bob["access_key"]
+            st, _, body = await sys_cli.request("GET", "/admin/user")
+            assert "bob" in _json.loads(body)
+            st, _, body = await sys_cli.request(
+                "POST", "/admin/user?uid=bob&suspended=1")
+            assert _json.loads(body)["suspended"] is True
+            # a suspended user cannot act
+            bob_cli = S3HttpClient(host, port, bob["access_key"],
+                                   bob["secret_key"])
+            st, _, _ = await bob_cli.request("PUT", "/bobs-bucket")
+            assert st == 403
+            st, _, _ = await sys_cli.request(
+                "DELETE", "/admin/user?uid=bob")
+            assert st == 200
+            st, _, _ = await sys_cli.request(
+                "GET", "/admin/user?uid=bob")
+            assert st == 404
+
+            # bucket stats + usage roll-up
+            st, _, _ = await user_cli.request("PUT", "/abucket")
+            assert st == 200
+            st, _, _ = await user_cli.request("PUT", "/abucket/k",
+                                              b"x" * 1000)
+            assert st == 200
+            st, _, body = await sys_cli.request(
+                "GET", "/admin/bucket?bucket=abucket")
+            stats = _json.loads(body)
+            assert stats["owner"] == "alice"
+            assert stats["num_objects"] == 1
+            assert stats["size_bytes"] >= 1000
+            st, _, body = await sys_cli.request("GET", "/admin/usage")
+            usage = _json.loads(body)
+            assert usage["alice"]["objects"] == 1
+            # metadata enumeration
+            st, _, body = await sys_cli.request(
+                "GET", "/admin/metadata/user")
+            assert "alice" in _json.loads(body)
+            st, _, body = await sys_cli.request(
+                "GET", "/admin/metadata/bucket")
+            assert "abucket" in _json.loads(body)
+            await fe.stop()
+            await rados.shutdown()
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
