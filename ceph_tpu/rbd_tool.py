@@ -40,6 +40,8 @@ async def _run(args) -> int:
     try:
         await rados.connect(timeout=args.timeout)
         ioctx = await rados.open_ioctx(args.pool)
+        if getattr(args, "namespace", ""):
+            ioctx.set_namespace(args.namespace)
         rbd = RBD(ioctx)
         out = await _dispatch(args, rbd)
         if out is not None:
@@ -54,6 +56,49 @@ async def _run(args) -> int:
 
 async def _dispatch(args, rbd: RBD):
     cmd = args.cmd
+    if cmd == "group":
+        from ceph_tpu.services.rbd_group import RBDGroups
+
+        groups = RBDGroups(rbd)
+        g = args.group_args
+        gc = args.group_cmd
+        if gc == "create":
+            return {"id": await groups.create(g[0])}
+        if gc == "ls":
+            return await groups.list()
+        if gc == "rm":
+            await groups.remove(g[0])
+            return None
+        if gc == "rename":
+            await groups.rename(g[0], g[1])
+            return None
+        if gc == "image-add":
+            await groups.image_add(g[0], g[1])
+            return None
+        if gc == "image-rm":
+            await groups.image_remove(g[0], g[1])
+            return None
+        if gc == "image-ls":
+            return await groups.image_list(g[0])
+        if gc == "snap-create":
+            return {"id": await groups.snap_create(g[0], g[1])}
+        if gc == "snap-ls":
+            return await groups.snap_list(g[0])
+        if gc == "snap-rm":
+            await groups.snap_remove(g[0], g[1])
+            return None
+        if gc == "snap-rollback":
+            await groups.snap_rollback(g[0], g[1])
+            return None
+    if cmd == "namespace":
+        if args.ns_cmd == "create":
+            await rbd.namespace_create(args.ns_name)
+            return None
+        if args.ns_cmd == "ls":
+            return await rbd.namespace_list()
+        if args.ns_cmd == "rm":
+            await rbd.namespace_remove(args.ns_name)
+            return None
     if cmd == "create":
         await rbd.create(args.image, args.size, order=args.order,
                          object_map=not args.no_object_map)
@@ -217,8 +262,23 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="rbd", description=__doc__)
     p.add_argument("--conf", default="cluster.json")
     p.add_argument("--pool", default="rbd")
+    p.add_argument("--namespace", default="",
+                   help="rados namespace scoping every image op")
     p.add_argument("--timeout", type=float, default=15.0)
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    grp = sub.add_parser("group")
+    grp.add_argument("group_cmd", choices=[
+        "create", "ls", "rm", "rename", "image-add", "image-rm",
+        "image-ls", "snap-create", "snap-ls", "snap-rm",
+        "snap-rollback",
+    ])
+    grp.add_argument("group_args", nargs="*",
+                     help="group [image|snap|new-name]")
+
+    ns = sub.add_parser("namespace")
+    ns.add_argument("ns_cmd", choices=["create", "ls", "rm"])
+    ns.add_argument("ns_name", nargs="?", default="")
 
     c = sub.add_parser("create")
     c.add_argument("image")

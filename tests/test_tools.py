@@ -278,3 +278,69 @@ def test_rados_export_import_roundtrip(tmp_path):
         await rados.shutdown()
         await cluster.stop()
     asyncio.run(run())
+
+
+def test_rbd_tool_groups_and_namespaces(tmp_path, capsys):
+    from ceph_tpu import rbd_tool
+
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            await rados.pool_create("rbd", pg_num=8)
+            await rados.shutdown()
+            conf = tmp_path / "cluster.json"
+            cluster.write_conf(str(conf))
+
+            async def tool(*argv):
+                args = rbd_tool.build_parser().parse_args(
+                    ["--conf", str(conf), *argv]
+                )
+                return await rbd_tool._run(args)
+
+            # groups: create, membership, consistent snap, rollback
+            assert await tool("create", "a", "--size", "262144",
+                              "--order", "14") == 0
+            assert await tool("create", "b", "--size", "262144",
+                              "--order", "14") == 0
+            assert await tool("group", "create", "g1") == 0
+            assert await tool("group", "image-add", "g1", "a") == 0
+            assert await tool("group", "image-add", "g1", "b") == 0
+            capsys.readouterr()
+            assert await tool("group", "image-ls", "g1") == 0
+            out = capsys.readouterr().out
+            assert '"a"' in out and '"b"' in out
+            assert await tool("group", "snap-create", "g1",
+                              "cp") == 0
+            capsys.readouterr()
+            assert await tool("group", "snap-ls", "g1") == 0
+            assert "complete" in capsys.readouterr().out
+            assert await tool("group", "snap-rollback", "g1",
+                              "cp") == 0
+            assert await tool("group", "snap-rm", "g1", "cp") == 0
+            assert await tool("group", "image-rm", "g1", "a") == 0
+            assert await tool("group", "rm", "g1") == 0
+
+            # namespaces: registry + scoped image ops
+            assert await tool("namespace", "create", "ns1") == 0
+            capsys.readouterr()
+            assert await tool("namespace", "ls") == 0
+            assert "ns1" in capsys.readouterr().out
+            assert await tool("--namespace", "ns1", "create", "nimg",
+                              "--size", "131072", "--order",
+                              "14") == 0
+            capsys.readouterr()
+            assert await tool("--namespace", "ns1", "ls") == 0
+            assert "nimg" in capsys.readouterr().out
+            capsys.readouterr()
+            assert await tool("ls") == 0   # default ns: not visible
+            assert "nimg" not in capsys.readouterr().out
+            # non-empty namespace refuses to die; empty one goes
+            assert await tool("namespace", "rm", "ns1") == 1
+            assert await tool("--namespace", "ns1", "rm",
+                              "nimg") == 0
+            assert await tool("namespace", "rm", "ns1") == 0
+        finally:
+            await cluster.stop()
+    asyncio.run(run())
