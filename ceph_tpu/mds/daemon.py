@@ -1300,7 +1300,8 @@ class MDSDaemon:
         # cross-rank destinations with EXDEV
         dino = int(d.get("src_parent",
                          d.get("parent", d.get("ino", ROOT_INO))))
-        if op in ("session", "get_load", "subtree_refresh"):
+        if op in ("session", "get_load", "subtree_refresh",
+                  "snap_refresh"):
             return dino
         auth, explicit = await self._auth_rank_ex(dino)
         if auth != self.rank and (
@@ -1331,14 +1332,14 @@ class MDSDaemon:
             d["_conn"] = conn       # cap ops key grants on the session
             dino = await self._check_auth(d, op)
             if op not in ("session", "get_load", "export_dir",
-                          "subtree_refresh"):
+                          "subtree_refresh", "snap_refresh"):
                 # balancer popularity: the directory the auth check
                 # routed by (exports are administrative, not load)
                 self._note_pop(dino)
             if op in ("lookup", "readdir", "session", "lssnap",
                       "rename", "link", "unlink", "setattr",
                       "get_load", "open_file", "release_cap",
-                      "subtree_refresh"):
+                      "subtree_refresh", "snap_refresh"):
                 # reads need no lock; rename/link/unlink/setattr
                 # manage their own (each must release the mutate lock
                 # across a cross-rank peer RPC); cap ops await client
@@ -1553,20 +1554,53 @@ class MDSDaemon:
         if any(i["name"] == name and int(i["ino"]) == ino
                for i in self.snaps.values()):
             raise MDSError(EEXIST, f"snap {name!r} exists")
+        await self._load_subtrees()      # a stale map must not skip a
+        realm_ranks = set()              # rank owning realm territory
         for s, r in self._subtrees.items():
             if r != self.rank and (s == ino
                                    or await self._is_ancestor(ino, s)):
-                raise MDSError(
-                    EINVAL, f"subtree {s:x} inside the realm is "
-                    f"delegated to rank {r}; snapshots must not span "
-                    "rank boundaries")
+                realm_ranks.add(r)
         snapid = await self.data.selfmanaged_snap_create()
         entry = {"op": "mksnap", "snapid": snapid,
                  "info": {"name": name, "ino": ino,
                           "created": time.time()}}
         await self._journal(entry)
         await self._apply(entry)
+        if realm_ranks:
+            # the realm SPANS delegated subtrees (round-3 weak #5):
+            # every owning rank must ADOPT the snapid (reload the
+            # shared snaptable into its snapc) before mksnap returns,
+            # or its next mutation under the realm would skip the COW
+            # freeze.  Adoption is required, not best-effort — a rank
+            # that cannot adopt fails the mksnap and the snap rolls
+            # back (a restarting rank reloads the table at boot).
+            failed = None
+            for r in sorted(realm_ranks):
+                try:
+                    reply = await self._peer_request(
+                        r, {"op": "snap_refresh"}, timeout=5.0)
+                    if int(reply.get("rc", -1)) != 0:
+                        failed = (r, reply.get("err", "refused"))
+                        break
+                except MDSError as e:
+                    failed = (r, str(e))
+                    break
+            if failed is not None:
+                rollback = {"op": "rmsnap", "snapid": snapid,
+                            "ino": ino}
+                await self._journal(rollback)
+                await self._apply(rollback)
+                raise MDSError(
+                    EXDEV, f"rank {failed[0]} could not adopt the "
+                    f"snapshot ({failed[1]}); mksnap rolled back")
         return {"snapid": snapid, "snapc": self._snapc_wire()}
+
+    async def _req_snap_refresh(self, d: dict) -> dict:
+        """Peer push after mksnap/rmsnap on a realm that spans our
+        territory: adopt the shared snaptable NOW so the very next
+        mutation COW-freezes under the new snap."""
+        await self._load_snaptable()
+        return {}
 
     async def _req_export_dir(self, d: dict) -> dict:
         """Delegate the subtree at dir ``ino`` to another active rank
@@ -2457,6 +2491,17 @@ class MDSDaemon:
         entry = {"op": "rmsnap", "snapid": snapid, "ino": ino}
         await self._journal(entry)
         await self._apply(entry)
+        # drop the dead snapid from spanning ranks' snapc too (best-
+        # effort: a stale entry only costs wasted freezes, never
+        # correctness; boot reload heals it)
+        for s, r in self._subtrees.items():
+            if r != self.rank and (s == ino
+                                   or await self._is_ancestor(ino, s)):
+                try:
+                    await self._peer_request(
+                        r, {"op": "snap_refresh"}, timeout=2.0)
+                except MDSError:
+                    pass
         return {"snapc": self._snapc_wire()}
 
     async def _req_lssnap(self, d: dict) -> dict:
