@@ -1,0 +1,16 @@
+#!/bin/bash
+# THE one detached claim-waiter (verify SKILL.md: never run two JAX
+# processes at once; never externally kill a claiming process).  Serial
+# loop: full bench -> on-chip identity record -> perf-lab roofline
+# experiments, each self-bounding via its own in-process watchdog, then
+# a cool-down.  Successes append to BENCH_LOCAL.jsonl / HW_IDENTITY.jsonl
+# / PERF_LAB.jsonl at the repo root; each fresh python process picks up
+# the latest committed kernel code.
+cd /root/repo || exit 1
+while true; do
+  BENCH_BUDGET_S=2700 python bench.py           >> /tmp/waiter_bench.log 2>&1
+  HW_ID_BUDGET_S=1500 python scripts/hw_identity.py >> /tmp/waiter_id.log 2>&1
+  PERF_LAB_BUDGET_S=2400 python -m ceph_tpu.testing.perf_lab \
+                                                >> /tmp/waiter_lab.log 2>&1
+  sleep 1500
+done

@@ -1,0 +1,90 @@
+"""On-chip bit-identity check, decoupled from the benchmark.
+
+Runs the archived corpus check (corpus/ digests were generated ON TPU)
+on whatever backend `jax.devices()` yields and appends one auditable
+record to HW_IDENTITY.jsonl at the repo root: platform, device kind,
+pass/fail, per-corpus-file digest-of-digests, UTC timestamp.  The point
+(VERDICT r4 weak #6): hardware bit-identity evidence should not depend
+on a full bench run finishing — the claim-waiter runs this whenever it
+wins the chip.
+
+Self-bounding: an in-process watchdog hard-exits at HW_ID_BUDGET_S so a
+wedged chip grant can never leave an externally-killable process mid-
+claim (see .claude/skills/verify/SKILL.md — an external SIGKILL wedges
+the grant for hours).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+BUDGET_S = float(os.environ.get("HW_ID_BUDGET_S", 1200))
+
+
+def main() -> int:
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(BUDGET_S):
+            print(json.dumps({"error": f"budget {BUDGET_S:.0f}s hit "
+                              "before chip claim/check finished"}),
+                  flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
+    from ceph_tpu.common.jaxutil import enable_compile_cache
+
+    enable_compile_cache()
+    import jax
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    kind = getattr(devs[0], "device_kind", "?")
+
+    from ceph_tpu.ec import corpus
+
+    t0 = time.perf_counter()
+    failures = corpus.check()
+    wall = time.perf_counter() - t0
+
+    # digest-of-digests over the archived corpus so the record pins
+    # exactly WHICH expected bytes this hardware reproduced
+    h = hashlib.sha256()
+    for path in sorted(corpus.CORPUS_DIR.glob("*.json")):
+        h.update(path.read_bytes())
+    rec = {
+        "check": "ec_corpus_bit_identity",
+        "platform": platform,
+        "device_kind": str(kind),
+        "n_devices": len(devs),
+        "ok": not failures,
+        "failures": failures,
+        "corpus_sha256": h.hexdigest(),
+        "wall_s": round(wall, 2),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    # HW_IDENTITY.jsonl is the ON-HARDWARE evidence trail: a CPU
+    # fallback run (tunnel down, JAX_PLATFORMS override) proves nothing
+    # about the chip and must not satisfy the per-round hardware record,
+    # so CPU results print but are never appended.
+    if platform != "cpu":
+        with open(os.path.join(HERE, "HW_IDENTITY.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    else:
+        rec["skipped_append"] = "cpu backend; not hardware evidence"
+    print(json.dumps(rec), flush=True)
+    done.set()
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
