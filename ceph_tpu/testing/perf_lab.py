@@ -32,9 +32,24 @@ import numpy as np
 from ceph_tpu.common.jaxutil import enable_compile_cache
 
 K, M = 8, 4
-STRIPES = 16384
+# PERF_LAB_STRIPES=256 (with interpret-mode kernels) lets the variant
+# experiments' bit-identity checks run on CPU CI; the default is the
+# headline geometry for on-chip measurement.
+STRIPES = int(os.environ.get("PERF_LAB_STRIPES", 16384))
+if STRIPES % 64:
+    # every experiment assumes n4 % 8192 == 0 (grid = n4 // tile with no
+    # remainder handling); n4 = STRIPES*128, so STRIPES must be a
+    # multiple of 64 or throughput silently inflates over unwritten tail
+    raise ValueError(f"PERF_LAB_STRIPES={STRIPES} must be a multiple of 64")
 CHUNK = 512                      # bytes per chunk (4 KiB stripe / 8)
 N4 = STRIPES * CHUNK * K // 4 // K   # int32 lanes per row
+
+
+def _interp() -> bool:
+    """Interpret-mode pallas on non-TPU backends (correctness only)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
 
 
 def _data_words():
@@ -249,6 +264,268 @@ def exp_roof_matmul() -> dict:
             "macs_per_sec": (M * 32) * (K * 32) * n4 / sec}
 
 
+def _dense_ap():
+    from ceph_tpu.ec import pallas_kernels as pk
+
+    return pk.PallasShardApply(
+        np.asarray(_codec().generator[K:], np.uint8),
+        interpret=_interp())
+
+
+def _check_and_time(step, x0, expect, got_fn, nbytes) -> dict:
+    """Bit-check a variant against the production kernel (one scalar
+    fetch), then time it with the serial-loop protocol.  On CPU the
+    check still runs (interpret-mode kernels) but timing is skipped —
+    interpret-mode numbers mean nothing."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec.benchmark import device_seconds_per_iter
+
+    ok = bool(jnp.array_equal(expect, got_fn()))
+    if not ok:
+        return {"error": "variant output != production kernel"}
+    if _interp():
+        return {"bit_identical": True, "skipped_timing": "non-tpu backend"}
+    sec = device_seconds_per_iter(step, x0, lo=64, hi=320)
+    return {"sec": sec, "gibps": _gibps(nbytes, sec), "bit_identical": True}
+
+
+def exp_enc_cmp_expand() -> dict:
+    """Variant A: bit expansion via mask-AND + compare-to-zero producing
+    int8 directly — drops the int32 plane intermediate AND the separate
+    astype(int8) relayout of the production kernel (the round-4 estimate
+    puts that cast at ~8 VPU ops per data byte of the ~36 total)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ap = _dense_ap()
+    words = _data_words()
+    kin, n4 = words.shape
+    mout, tile = M, 8192
+
+    def kernel(bm_ref, d_ref, o_ref):
+        d = d_ref[:]
+        shift = jax.lax.broadcasted_iota(jnp.int32, (1, 32, 1), 1)
+        mask = jnp.left_shift(jnp.int32(1), shift)
+        bits = ((d[:, None, :] & mask) != 0).astype(jnp.int8) \
+            .reshape(kin * 32, tile)
+        acc = jnp.dot(bm_ref[:], bits, preferred_element_type=jnp.int32)
+        accb = (acc & 1).reshape(mout, 32, tile)
+        o_ref[:] = jnp.sum(accb << shift, axis=1)
+
+    @jax.jit
+    def f(w):
+        return pl.pallas_call(
+            kernel,
+            grid=(n4 // tile,),
+            in_specs=[
+                pl.BlockSpec(ap.bm32.shape, lambda t: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((kin, tile), lambda t: (0, t),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((mout, tile), lambda t: (0, t),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((mout, n4), jnp.int32),
+            interpret=_interp(),
+        )(ap._bm32_arg(), w)
+
+    def step(i, w):
+        p = f(w)
+        return w.at[0, 0].set(p[0, 0] ^ i)
+
+    return _check_and_time(step, words, ap.apply_words(words),
+                           lambda: f(words), K * N4 * 4)
+
+
+def exp_enc_u8_expand() -> dict:
+    """Variant B: uint8-native formulation.  Input rides as (k, 4, N/4)
+    uint8 (slot s = contiguous quarter of the byte stream — slot choice
+    is free because GF matrix encode is column-independent; the slot
+    plays the lane-expansion byte position, so the PRODUCTION bitmatrix
+    applies unchanged).  Expansion and output are int8-width VPU ops: if
+    Mosaic vectorizes int8 packed (4/lane-word), expansion cost drops
+    ~4x vs the int32 shift path."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ap = _dense_ap()
+    words = _data_words()
+    kin, n4 = words.shape
+    mout, tile = M, 8192
+    from ceph_tpu.ec.pallas_kernels import words_to_bytes
+
+    # same bytes as the production words (words_to_bytes inverts the
+    # packing) so the bit-identity check can never drift out of sync
+    x8 = words_to_bytes(words).reshape(K, 4, STRIPES * CHUNK // 4)
+    nq = x8.shape[2]
+
+    def kernel(bm_ref, d_ref, o_ref):
+        d = d_ref[:]                               # (kin, 4, T) uint8
+        shift8 = jax.lax.broadcasted_iota(
+            jnp.uint8, (1, 1, 8, 1), 2)
+        bits = ((d[:, :, None, :] >> shift8) & 1) \
+            .reshape(kin * 32, tile).astype(jnp.int8)
+        acc = jnp.dot(bm_ref[:], bits, preferred_element_type=jnp.int32)
+        accb = (acc & 1).reshape(mout, 4, 8, tile)
+        s32 = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 8, 1), 2)
+        o_ref[:] = jnp.sum(accb << s32, axis=2).astype(jnp.uint8)
+
+    @jax.jit
+    def f(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(nq // tile,),
+            in_specs=[
+                pl.BlockSpec(ap.bm32.shape, lambda t: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((kin, 4, tile), lambda t: (0, 0, t),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((mout, 4, tile), lambda t: (0, 0, t),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((mout, 4, nq), jnp.uint8),
+            interpret=_interp(),
+        )(ap._bm32_arg(), x)
+
+    # expected: production parity bytes, re-sliced into quarters.  Slot s
+    # here = byte position s of each int32 word in the production lane
+    # layout, so compare against the production BYTE stream re-packed the
+    # same way: bytes b of word w sit interleaved; production words (m,
+    # n4) -> bytes (m, n4, 4) -> slot view needs byte p of quarter q at
+    # word... simplest exact check: run both on the SAME byte semantics.
+    # Production words were packed from the byte stream little-endian:
+    # word w = bytes[4w..4w+3].  Our slot layout instead assigns byte
+    # column c of quarter q to (lane-expansion position q, column c).
+    # Both are valid encodings of the same GF columns; equality must be
+    # checked per-column: parity of byte stream column j is the same in
+    # both (GF is column-independent), so compare our (m, 4, nq) output
+    # against the production parity BYTE STREAM reshaped (m, 4, nq).
+    expect = words_to_bytes(ap.apply_words(words)).reshape(mout, 4, nq)
+
+    def step(i, x):
+        p = f(x)
+        return x.at[0, 0, 0].set(p[0, 0, 0] ^ i.astype(jnp.uint8))
+
+    return _check_and_time(step, x8, expect, lambda: f(x8), K * N4 * 4)
+
+
+def exp_enc_split2() -> dict:
+    """Variant C: software-pipelined halves — the body processes two
+    independent half-tiles so the scheduler may overlap half 2's VPU
+    expansion with half 1's MXU contraction (within one grid step the
+    expand->matmul->pack chain is otherwise serial)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ap = _dense_ap()
+    words = _data_words()
+    kin, n4 = words.shape
+    mout, tile = M, 8192
+    half = tile // 2
+
+    def kernel(bm_ref, d_ref, o_ref):
+        shift = jax.lax.broadcasted_iota(jnp.int32, (1, 32, 1), 1)
+        B = bm_ref[:]
+        for h in range(2):
+            d = d_ref[:, h * half:(h + 1) * half]
+            bits = ((d[:, None, :] >> shift) & 1).reshape(kin * 32, half)
+            acc = jnp.dot(B, bits.astype(jnp.int8),
+                          preferred_element_type=jnp.int32)
+            accb = (acc & 1).reshape(mout, 32, half)
+            o_ref[:, h * half:(h + 1) * half] = \
+                jnp.sum(accb << shift, axis=1)
+
+    @jax.jit
+    def f(w):
+        return pl.pallas_call(
+            kernel,
+            grid=(n4 // tile,),
+            in_specs=[
+                pl.BlockSpec(ap.bm32.shape, lambda t: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((kin, tile), lambda t: (0, t),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((mout, tile), lambda t: (0, t),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((mout, n4), jnp.int32),
+            interpret=_interp(),
+        )(ap._bm32_arg(), w)
+
+    def step(i, w):
+        p = f(w)
+        return w.at[0, 0].set(p[0, 0] ^ i)
+
+    return _check_and_time(step, words, ap.apply_words(words),
+                           lambda: f(words), K * N4 * 4)
+
+
+def exp_enc_u8_split2() -> dict:
+    """Variants B+C combined: uint8-native expansion AND pipelined
+    halves."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ap = _dense_ap()
+    words = _data_words()
+    kin, n4 = words.shape
+    mout, tile = M, 8192
+    half = tile // 2
+    from ceph_tpu.ec.pallas_kernels import words_to_bytes
+
+    # same bytes as the production words (words_to_bytes inverts the
+    # packing) so the bit-identity check can never drift out of sync
+    x8 = words_to_bytes(words).reshape(K, 4, STRIPES * CHUNK // 4)
+    nq = x8.shape[2]
+
+    def kernel(bm_ref, d_ref, o_ref):
+        B = bm_ref[:]
+        shift8 = jax.lax.broadcasted_iota(jnp.uint8, (1, 1, 8, 1), 2)
+        s32 = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 8, 1), 2)
+        for h in range(2):
+            d = d_ref[:, :, h * half:(h + 1) * half]
+            bits = ((d[:, :, None, :] >> shift8) & 1) \
+                .reshape(kin * 32, half).astype(jnp.int8)
+            acc = jnp.dot(B, bits, preferred_element_type=jnp.int32)
+            accb = (acc & 1).reshape(mout, 4, 8, half)
+            o_ref[:, :, h * half:(h + 1) * half] = \
+                jnp.sum(accb << s32, axis=2).astype(jnp.uint8)
+
+    @jax.jit
+    def f(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(nq // tile,),
+            in_specs=[
+                pl.BlockSpec(ap.bm32.shape, lambda t: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((kin, 4, tile), lambda t: (0, 0, t),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((mout, 4, tile), lambda t: (0, 0, t),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((mout, 4, nq), jnp.uint8),
+            interpret=_interp(),
+        )(ap._bm32_arg(), x)
+
+    expect = words_to_bytes(ap.apply_words(words)).reshape(mout, 4, nq)
+
+    def step(i, x):
+        p = f(x)
+        return x.at[0, 0, 0].set(p[0, 0, 0] ^ i.astype(jnp.uint8))
+
+    return _check_and_time(step, x8, expect, lambda: f(x8), K * N4 * 4)
+
+
 def exp_clay_repair() -> dict:
     """cfg4 with the fused grouped kernel (bench geometry)."""
     import bench as bench_mod
@@ -268,6 +545,10 @@ EXPERIMENTS = {
     "enc_tile_4096": _tile_exp(4096),
     "enc_tile_8192": _tile_exp(8192),
     "enc_tile_16384": _tile_exp(16384),
+    "enc_cmp_expand": exp_enc_cmp_expand,
+    "enc_u8_expand": exp_enc_u8_expand,
+    "enc_split2": exp_enc_split2,
+    "enc_u8_split2": exp_enc_u8_split2,
     "clay_repair": exp_clay_repair,
 }
 
