@@ -101,8 +101,11 @@ async def _dirfrag_alive(meta, dino: int) -> bool:
 
 
 async def _dentry_for(meta, dino: int, name: str) -> dict | None:
+    from ceph_tpu.mds.daemon import frag_oid_for_name
+
     try:
-        kv = await meta.get_omap(dirfrag_oid(dino), [name])
+        kv = await meta.get_omap(
+            await frag_oid_for_name(meta, dino, name), [name])
     except RadosError as e:
         if e.rc != ENOENT:
             raise
@@ -111,7 +114,9 @@ async def _dentry_for(meta, dino: int, name: str) -> dict | None:
 
 
 async def _link(meta, dino: int, name: str, dentry: dict) -> None:
-    await meta.operate(dirfrag_oid(dino),
+    from ceph_tpu.mds.daemon import frag_oid_for_name
+
+    await meta.operate(await frag_oid_for_name(meta, dino, name),
                        ObjectOperation().create().omap_set(
                            {name: encode(dentry)}))
 

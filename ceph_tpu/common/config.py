@@ -139,6 +139,16 @@ def global_options() -> list[Option]:
         Option("mds_bal_min_start", float, 8.0,
                "minimum load excess (decayed request counts) worth "
                "exporting a subtree for", min=0.0),
+        Option("mds_bal_split_size", int, 10000,
+               "dirfrag entry count that triggers a split "
+               "(reference mds_bal_split_size)", min=4),
+        Option("mds_bal_merge_size", int, 50,
+               "combined sibling entry count below which sibling "
+               "dirfrags merge back (reference mds_bal_merge_size)",
+               min=0),
+        Option("mds_bal_split_bits", int, 1,
+               "hash bits added per dirfrag split (2^bits children; "
+               "reference mds_bal_split_bits)", min=1, max=4),
         Option("trace_probability", float, 0.0,
                "fraction of client ops that carry a trace context "
                "(zipkin_trace analog; 0=off)", min=0.0, max=1.0),

@@ -87,6 +87,75 @@ def snap_dirfrag_oid(ino: int, snapid: int) -> str:
     return f"{ino:x}.dir.snap.{snapid}"
 
 
+# -- directory fragmentation (reference CDir::split/merge CDir.cc:994,
+# 1096 and MDCache::adjust_dir_fragments MDCache.cc:11187) --------------
+# Dentries are partitioned over FRAGMENTS of the 32-bit rjenkins hash
+# space (the reference hashes dentry names with ceph_str_hash for the
+# same purpose).  The fragtree — the leaf list of (bits, value) pairs,
+# where a leaf covers names whose hash's top `bits` bits equal `value`
+# — rides a "fragtree" xattr on the BASE dirfrag object <ino>.dir.  The
+# base object always exists for a live directory and keeps the metadata
+# xattrs (parent, past_snaps); with the trivial tree [(0, 0)] it also
+# holds the dentries (the unfragmented layout every pre-frag test and
+# tool knows).  A split moves entries into <ino>.dir.<bits>_<value:x>
+# sibling objects; snapshot COW copies stay single-object (frozen views
+# are read-only, so one omap is the simpler correct layout).  Splits
+# and merges are journaled ("fragment" entries) and idempotent under
+# crash replay.
+
+ROOT_FRAG = (0, 0)
+MAX_FRAG_BITS = 8
+
+
+def frag_oid(ino: int, bits: int, value: int) -> str:
+    if bits == 0:
+        return dirfrag_oid(ino)
+    return f"{ino:x}.dir.{bits}_{value:x}"
+
+
+def frag_contains(bits: int, value: int, h: int) -> bool:
+    return bits == 0 or (h >> (32 - bits)) == value
+
+
+def frag_for(tree: list[tuple[int, int]], name: str) -> tuple[int, int]:
+    """The fragtree leaf covering ``name`` (fragtree_t::operator[])."""
+    from ceph_tpu.placement.hashing import ceph_str_hash_rjenkins
+
+    h = ceph_str_hash_rjenkins(name)
+    for b, v in tree:
+        if frag_contains(b, v, h):
+            return (b, v)
+    return ROOT_FRAG        # malformed tree: base object fallback
+
+
+async def fragtree_of(meta, dino: int) -> list[tuple[int, int]]:
+    """Read a directory's fragtree (trivial when the xattr or the base
+    object is absent — the OSD returns ENOENT for both).  Any other
+    error propagates: silently defaulting on e.g. EIO would route a
+    write into the base object of a fragmented directory, where no
+    lookup would ever find it again.  Module-level so offline tools
+    (cephfs-data-scan) share the exact routing the daemon uses."""
+    try:
+        raw = await meta.get_xattr(dirfrag_oid(dino), "fragtree")
+    except RadosError as e:
+        if e.rc != ENOENT:
+            raise
+        return [ROOT_FRAG]
+    try:
+        tree = [(int(b), int(v)) for b, v in decode(raw)]
+        return tree or [ROOT_FRAG]
+    except (ValueError, TypeError):
+        return [ROOT_FRAG]
+
+
+async def frag_oid_for_name(meta, dino: int, name: str) -> str:
+    """The object holding (or destined to hold) ``name``'s dentry.
+    (For the trivial tree frag_for returns ROOT_FRAG and frag_oid maps
+    it to the base object — no special case needed.)"""
+    tree = await fragtree_of(meta, dino)
+    return frag_oid(dino, *frag_for(tree, name))
+
+
 SNAPTABLE_OID = "mds_snaptable"
 QUOTATABLE_OID = "mds_quotatab"
 
@@ -198,6 +267,13 @@ class MDSDaemon:
         self._pop: dict[int, float] = {}
         self._pop_stamp = time.monotonic()
         self._balance_task = None
+        # per-frag entry counts ((ino, bits, value) -> n): lazily
+        # initialized, incrementally maintained, drive split/merge
+        # (the CDir::fnode fragstat role)
+        self._frag_counts: dict[tuple[int, int, int], int] = {}
+        # per-ino fragtree cache (CInode dirfragtree role); cleared
+        # with _auth_cache and on local split/merge/removal
+        self._ftree_cache: dict[int, list[tuple[int, int]]] = {}
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, timeout: float = 20.0) -> None:
@@ -358,6 +434,7 @@ class MDSDaemon:
             omap = {}
         self._subtrees = {int(k): int(v) for k, v in omap.items()}
         self._auth_cache.clear()
+        self._ftree_cache.clear()
         self._subtrees_loaded = time.monotonic()
         # quota knowledge rides the same refresh cadence: a rank that
         # just imported a realm root must enforce its quota
@@ -559,17 +636,86 @@ class MDSDaemon:
         self.journal_len = len(self._open_intents)
 
     # -- dirfrag helpers ---------------------------------------------------
+    async def _fragtree(self, dino: int,
+                        refresh: bool = False) -> list[tuple[int, int]]:
+        """Per-ino fragtree cache (the CInode dirfragtree role):
+        invalidated on local split/merge/removal and wherever the
+        auth map changes (an importing rank must re-learn trees the
+        exporter reshaped).  ``refresh`` forces a re-read — the read
+        paths use it to close the lock-free race with a concurrent
+        split/merge."""
+        if not refresh:
+            t = self._ftree_cache.get(dino)
+            if t is not None:
+                return t
+        t = await fragtree_of(self.meta, dino)
+        if len(self._ftree_cache) > 65536:
+            self._ftree_cache.clear()
+        self._ftree_cache[dino] = t
+        return t
+
+    async def _dir_all(self, dino: int) -> dict[str, bytes]:
+        """Union of all live dirfrag omaps (the readdir/scrub/empty-
+        check view).  Raises RadosError ENOENT exactly when the
+        directory's base object is gone (same contract the single-
+        object layout had).  A frag ENOENT mid-walk means a concurrent
+        split/merge retired the object after we read the tree: re-read
+        the tree and restart (bounded)."""
+        for attempt in range(3):
+            tree = await self._fragtree(dino, refresh=attempt > 0)
+            if tree == [ROOT_FRAG]:
+                return await self.meta.get_omap(dirfrag_oid(dino))
+            out: dict[str, bytes] = {}
+            stale = False
+            for b, v in tree:
+                try:
+                    out.update(await self.meta.get_omap(
+                        frag_oid(dino, b, v)))
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+                    stale = True
+                    break
+            if not stale:
+                return out
+        # tree still names a missing frag object: a crashed split's
+        # hole (scrub's territory) — serve what exists
+        out = {}
+        for b, v in tree:
+            try:
+                out.update(await self.meta.get_omap(
+                    frag_oid(dino, b, v)))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+        return out
+
     async def _get_dentry(self, parent: int, name: str,
                           snapid: int = 0) -> dict:
         if snapid:
             kv = await self._snap_view(parent, snapid, [name])
         else:
-            try:
-                kv = await self.meta.get_omap(dirfrag_oid(parent),
-                                              [name])
-            except RadosError as e:
-                raise MDSError(ENOENT, f"no dir {parent:x}") \
-                    if e.rc == ENOENT else e
+            kv = None
+            for attempt in range(3):
+                tree = await self._fragtree(parent,
+                                            refresh=attempt > 0)
+                trivial = tree == [ROOT_FRAG]
+                oid = frag_oid(parent, *frag_for(tree, name))
+                try:
+                    kv = await self.meta.get_omap(oid, [name])
+                    break
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+                    if trivial:
+                        raise MDSError(ENOENT, f"no dir {parent:x}")
+                    # fragmented dir: ENOENT here usually means a
+                    # concurrent split/merge retired this frag after
+                    # the (cached) tree read — retry with a fresh
+                    # tree; if it persists, the name is absent (the
+                    # base object, our liveness witness, just served
+                    # the fragtree)
+                    kv = {}
         if name not in kv:
             raise MDSError(ENOENT, f"{name!r} not in {parent:x}",
                            missing_dentry=True)
@@ -579,7 +725,9 @@ class MDSDaemon:
                          names: list[str] | None = None) -> dict:
         """A directory's omap AS OF a snapshot: the frozen COW copy when
         one exists (the dirfrag diverged since the snap), else the live
-        dirfrag (unchanged since — reference SnapRealm resolution)."""
+        dirfrag (unchanged since — reference SnapRealm resolution).
+        Frozen copies are single-object; the live fallback routes
+        through the fragtree."""
         try:
             return await self.meta.get_omap(
                 snap_dirfrag_oid(dino, snapid), names)
@@ -587,16 +735,209 @@ class MDSDaemon:
             if e.rc != ENOENT:
                 raise
         try:
-            return await self.meta.get_omap(dirfrag_oid(dino), names)
+            if names is None:
+                return await self._dir_all(dino)
+            tree = await self._fragtree(dino)
+            if tree == [ROOT_FRAG]:
+                return await self.meta.get_omap(dirfrag_oid(dino),
+                                                names)
+            out: dict[str, bytes] = {}
+            groups: dict[tuple[int, int], list[str]] = {}
+            for n in names:
+                groups.setdefault(frag_for(tree, n), []).append(n)
+            for (b, v), ns in groups.items():
+                try:
+                    out.update(await self.meta.get_omap(
+                        frag_oid(dino, b, v), ns))
+                except RadosError as e2:
+                    if e2.rc != ENOENT:
+                        raise
+            return out
         except RadosError as e:
             raise MDSError(ENOENT, f"no dir {dino:x}") \
                 if e.rc == ENOENT else e
 
     async def _set_dentry(self, parent: int, name: str,
                           dentry: dict) -> None:
-        await self.meta.operate(dirfrag_oid(parent), ObjectOperation()
+        tree = await self._fragtree(parent)
+        b, v = frag_for(tree, name)
+        oid = frag_oid(parent, b, v)
+        # counts track ENTRIES, not operations: an overwrite (setattr,
+        # journal-replay re-apply) must not move the split trigger
+        try:
+            existed = name in await self.meta.get_omap(oid, [name])
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
+            existed = False
+        await self.meta.operate(oid, ObjectOperation()
                                 .create()
                                 .omap_set({name: encode(dentry)}))
+        if not existed:
+            await self._frag_note_add(parent, b, v)
+
+    # -- dirfrag split/merge (CDir.cc:994 split / :1096 merge) -------------
+    async def _frag_count(self, dino: int, b: int, v: int) -> int:
+        """Cached entry count of one frag (initialized by one omap
+        read, then maintained incrementally — the reference keeps the
+        same count in CDir::fnode fragstat)."""
+        key = (dino, b, v)
+        c = self._frag_counts.get(key)
+        if c is None:
+            try:
+                c = len(await self.meta.get_omap(frag_oid(dino, b, v)))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+                c = 0
+            self._frag_counts[key] = c
+        return c
+
+    async def _frag_note_add(self, dino: int, b: int, v: int) -> None:
+        c = await self._frag_count(dino, b, v)
+        self._frag_counts[(dino, b, v)] = c = c + 1
+        split_bits = int(self.conf["mds_bal_split_bits"])
+        if c > int(self.conf["mds_bal_split_size"]) \
+                and b + split_bits <= MAX_FRAG_BITS:
+            entry = {"op": "fragment", "ino": dino, "bits": b,
+                     "value": v, "nbits": split_bits}
+            await self._journal(entry)
+            await self._apply(entry)
+
+    async def _frag_note_rm(self, dino: int, b: int, v: int) -> None:
+        key = (dino, b, v)
+        if key in self._frag_counts:
+            self._frag_counts[key] = max(0, self._frag_counts[key] - 1)
+        if b == 0:
+            return
+        # merge check: this frag and its sibling together below the
+        # merge threshold -> fold back into the parent frag
+        sib = (b, v ^ 1)
+        tree = await self._fragtree(dino)
+        if (b, v) not in tree or sib not in tree:
+            return                    # sibling further split: no merge
+        total = await self._frag_count(dino, b, v) \
+            + await self._frag_count(dino, *sib)
+        if total < int(self.conf["mds_bal_merge_size"]):
+            entry = {"op": "fragment", "ino": dino, "bits": b - 1,
+                     "value": v >> 1, "nbits": -1}
+            await self._journal(entry)
+            await self._apply(entry)
+
+    async def _apply_fragment(self, dino: int, b: int, v: int,
+                              nb: int) -> None:
+        """Idempotent split (nb>0: frag (b,v) -> 2^nb children) or
+        merge (nb<0: children of (b,v) -> (b,v)).  Journal-replayable:
+        a crash between any two steps re-runs to the same state, and a
+        completed entry's re-apply only re-runs the source cleanup."""
+        from ceph_tpu.placement.hashing import ceph_str_hash_rjenkins
+
+        tree = await self._fragtree(dino)
+        if nb > 0:
+            children = [(b + nb, (v << nb) + i) for i in range(1 << nb)]
+            if (b, v) not in tree:
+                # already applied; finish the (idempotent) source
+                # cleanup a crash may have cut off
+                await self._frag_cleanup(dino, b, v)
+                return
+            try:
+                kv = await self.meta.get_omap(frag_oid(dino, b, v))
+            except RadosError as e:
+                if e.rc != ENOENT:
+                    raise
+                kv = {}
+            parts: dict[tuple[int, int], dict] = {c: {} for c in children}
+            shift = 32 - (b + nb)
+            for name, raw in kv.items():
+                h = ceph_str_hash_rjenkins(name)
+                parts[(b + nb, h >> shift)][name] = raw
+            for c, ckv in parts.items():
+                op = ObjectOperation().create()
+                if ckv:
+                    op.omap_set(ckv)
+                await self.meta.operate(frag_oid(dino, *c), op)
+            newtree = sorted([t for t in tree if t != (b, v)]
+                             + children)
+            await self.meta.operate(
+                dirfrag_oid(dino), ObjectOperation().create().set_xattr(
+                    "fragtree", encode([list(t) for t in newtree])))
+            await self._frag_cleanup(dino, b, v, keys=list(kv))
+        else:
+            children = [(b + 1, (v << 1) + i) for i in (0, 1)]
+            if not all(c in tree for c in children):
+                for c in children:       # completed: re-run cleanup
+                    if c not in tree:
+                        await self._frag_cleanup(dino, *c)
+                return
+            union: dict[str, bytes] = {}
+            for c in children:
+                try:
+                    union.update(await self.meta.get_omap(
+                        frag_oid(dino, *c)))
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+            op = ObjectOperation().create()
+            if union:
+                op.omap_set(union)
+            await self.meta.operate(frag_oid(dino, b, v), op)
+            newtree = sorted([t for t in tree if t not in children]
+                             + [(b, v)])
+            if newtree == [ROOT_FRAG]:
+                newtree = []             # trivial tree: drop the xattr
+            await self.meta.operate(
+                dirfrag_oid(dino), ObjectOperation().create().set_xattr(
+                    "fragtree", encode([list(t) for t in newtree])))
+            for c in children:
+                await self._frag_cleanup(dino, *c)
+        # stale counters and the cached tree die with the old layout
+        for key in [k for k in self._frag_counts if k[0] == dino]:
+            del self._frag_counts[key]
+        self._ftree_cache.pop(dino, None)
+
+    async def _frag_cleanup(self, dino: int, b: int, v: int,
+                            keys: list[str] | None = None) -> None:
+        """Remove a retired source frag.  The base object (frag 0/0)
+        is never removed — it carries the fragtree/parent xattrs — its
+        omap entries are cleared instead."""
+        if (b, v) == ROOT_FRAG:
+            if keys is None:
+                try:
+                    keys = list(await self.meta.get_omap(
+                        dirfrag_oid(dino)))
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+                    return
+            if keys:
+                try:
+                    await self.meta.operate(
+                        dirfrag_oid(dino),
+                        ObjectOperation().omap_rm(keys))
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+            return
+        try:
+            await self.meta.remove(frag_oid(dino, b, v))
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
+
+    async def _remove_dir_objects(self, ino: int) -> None:
+        """Remove every object of a dying directory (rmdir / replaced-
+        empty-dir purge): all fragtree leaves, then the base."""
+        for b, v in await self._fragtree(ino):
+            if (b, v) != ROOT_FRAG:
+                await self._frag_cleanup(ino, b, v)
+        try:
+            await self.meta.remove(dirfrag_oid(ino))
+        except RadosError as e:
+            if e.rc != ENOENT:
+                raise
+        for key in [k for k in self._frag_counts if k[0] == ino]:
+            del self._frag_counts[key]
+        self._ftree_cache.pop(ino, None)
 
     # -- snap realms (COW; reference src/mds/SnapRealm.h) ------------------
     # mksnap records ONLY the realm (snapid, root ino) — O(1).  The cost
@@ -663,7 +1004,7 @@ class MDSDaemon:
                 if e.rc != ENOENT:
                     raise
             try:
-                kv = await self.meta.get_omap(dirfrag_oid(dino))
+                kv = await self._dir_all(dino)
             except RadosError as e:
                 if e.rc != ENOENT:
                     raise
@@ -696,13 +1037,20 @@ class MDSDaemon:
     async def _rm_dentry(self, parent: int, name: str) -> None:
         """Remove one dentry, tolerating an absent dirfrag (journal
         replay re-applies removals idempotently)."""
+        tree = await self._fragtree(parent)
+        b, v = frag_for(tree, name)
+        oid = frag_oid(parent, b, v)
         try:
-            await self.meta.operate(
-                dirfrag_oid(parent),
-                ObjectOperation().omap_rm([name]))
+            existed = name in await self.meta.get_omap(oid, [name])
+            if existed:
+                await self.meta.operate(
+                    oid, ObjectOperation().omap_rm([name]))
         except RadosError as err:
             if err.rc != ENOENT:
                 raise
+            return
+        if existed:
+            await self._frag_note_rm(parent, b, v)
 
     async def _apply(self, e: dict) -> None:
         op = e["op"]
@@ -715,7 +1063,11 @@ class MDSDaemon:
             await self._cow_freeze(int(e["ino"]))       # doomed dirfrag
         if op == "rename" and int(e.get("purge_dir_ino", 0)):
             await self._cow_freeze(int(e["purge_dir_ino"]))
-        if op in ("mkdir", "create"):
+        if op == "fragment":
+            await self._apply_fragment(int(e["ino"]), int(e["bits"]),
+                                       int(e["value"]),
+                                       int(e["nbits"]))
+        elif op in ("mkdir", "create"):
             dentry = dict(e["dentry"])
             await self._set_dentry(int(e["parent"]), str(e["name"]),
                                    dentry)
@@ -739,11 +1091,7 @@ class MDSDaemon:
         elif op == "rmdir":
             await self._rm_dentry(int(e["parent"]),
                                   str(e["name"]))
-            try:
-                await self.meta.remove(dirfrag_oid(int(e["ino"])))
-            except RadosError as err:
-                if err.rc != ENOENT:
-                    raise
+            await self._remove_dir_objects(int(e["ino"]))
             await self._quota_drop(int(e["ino"]))
         elif op == "rename":
             dentry = dict(e["dentry"])
@@ -760,6 +1108,7 @@ class MDSDaemon:
             if dentry.get("type") == "dir":
                 # moved directory: ancestry chains changed
                 self._auth_cache.clear()
+                self._ftree_cache.clear()
                 # refresh its parent back-pointer
                 op_x = ObjectOperation().create().set_xattr(
                     "parent", str(int(e["dst_parent"])).encode()
@@ -782,14 +1131,8 @@ class MDSDaemon:
                 await self._purge_file(int(e["purge_ino"]),
                                        int(e.get("purge_size", 0)))
             if int(e.get("purge_dir_ino", 0)):
-                # a replaced empty directory leaves its dirfrag behind
-                try:
-                    await self.meta.remove(
-                        dirfrag_oid(int(e["purge_dir_ino"]))
-                    )
-                except RadosError as err:
-                    if err.rc != ENOENT:
-                        raise
+                # a replaced empty directory leaves its dirfrags behind
+                await self._remove_dir_objects(int(e["purge_dir_ino"]))
                 await self._quota_drop(int(e["purge_dir_ino"]))
             if int(e.get("anchor_ino", 0)):
                 await self._anchor_put(int(e["anchor_ino"]),
@@ -830,13 +1173,10 @@ class MDSDaemon:
                         ),
                     )
                     self._auth_cache.clear()
+                    self._ftree_cache.clear()
                 if int(e.get("purge_dir_ino", 0)):
-                    try:
-                        await self.meta.remove(
-                            dirfrag_oid(int(e["purge_dir_ino"])))
-                    except RadosError as err:
-                        if err.rc != ENOENT:
-                            raise
+                    await self._remove_dir_objects(
+                        int(e["purge_dir_ino"]))
                 if int(e.get("purge_ino", 0)):
                     await self._purge_file(int(e["purge_ino"]),
                                            int(e.get("purge_size",
@@ -849,6 +1189,7 @@ class MDSDaemon:
             # an exported DIRECTORY's descendants now resolve through
             # the destination's chain; cached auths are stale
             self._auth_cache.clear()
+            self._ftree_cache.clear()
             self._quota_invalidate()
         elif op in ("rename_export_intent", "rename_export_abort",
                     "link_export_intent", "link_export_abort",
@@ -1287,6 +1628,7 @@ class MDSDaemon:
             # fresh export toward them is noticed (refresh trigger)
             if len(self._auth_cache) > 65536:
                 self._auth_cache.clear()
+                self._ftree_cache.clear()
             self._auth_cache[dino] = rank
         return rank, explicit
 
@@ -1404,6 +1746,42 @@ class MDSDaemon:
         return {"dentry": dentry, "lease": self.lease_ttl,
                 "snapc": self._snapc_wire()}
 
+    async def _req_fragment(self, d: dict) -> dict:
+        """Manual dirfrag split/merge (the 'ceph tell mds.N dirfrag
+        split / merge' surface, reference MDSRank command_dirfrag_split
+        / command_dirfrag_merge).  nbits > 0 splits leaf (bits, value)
+        into 2^nbits children; nbits == -1 merges (bits, value)'s two
+        children back."""
+        ino = int(d["ino"])
+        b, v = int(d.get("bits", 0)), int(d.get("value", 0))
+        nb = int(d.get("nbits", 1))
+        try:
+            await self.meta.stat(dirfrag_oid(ino))
+        except RadosError as e:
+            raise MDSError(ENOENT, f"no dir {ino:x}") \
+                if e.rc == ENOENT else e
+        tree = await self._fragtree(ino)
+        if nb > 0:
+            if b + nb > MAX_FRAG_BITS:
+                raise MDSError(EINVAL,
+                               f"split past {MAX_FRAG_BITS} bits")
+            if (b, v) not in tree:
+                raise MDSError(EINVAL, f"no leaf {b}_{v:x} in the "
+                               "fragtree")
+        elif nb == -1:
+            kids = [(b + 1, (v << 1) + i) for i in (0, 1)]
+            if not all(c in tree for c in kids):
+                raise MDSError(EINVAL,
+                               f"{b}_{v:x} has no mergeable children")
+        else:
+            raise MDSError(EINVAL, f"bad nbits {nb}")
+        entry = {"op": "fragment", "ino": ino, "bits": b, "value": v,
+                 "nbits": nb}
+        await self._journal(entry)
+        await self._apply(entry)
+        return {"fragtree": [list(t) for t in
+                             await self._fragtree(ino)]}
+
     async def _req_readdir(self, d: dict) -> dict:
         ino = int(d["ino"])
         snapid = int(d.get("snapid", 0))
@@ -1411,7 +1789,7 @@ class MDSDaemon:
             kv = await self._snap_view(ino, snapid)
         else:
             try:
-                kv = await self.meta.get_omap(dirfrag_oid(ino))
+                kv = await self._dir_all(ino)
             except RadosError as e:
                 raise MDSError(ENOENT, f"no dir {ino:x}") \
                     if e.rc == ENOENT else e
@@ -1533,7 +1911,7 @@ class MDSDaemon:
             cur = queue.pop()
             out.append(cur)
             try:
-                kv = await self.meta.get_omap(dirfrag_oid(cur))
+                kv = await self._dir_all(cur)
             except RadosError as e:
                 if e.rc == ENOENT:
                     continue
@@ -1675,6 +2053,7 @@ class MDSDaemon:
                 .omap_set({str(ino): str(rank).encode()}))
             self._subtrees[ino] = rank
         self._auth_cache.clear()
+        self._ftree_cache.clear()
         self._quota_invalidate()
         # the subtree's popularity belongs to the importing rank now —
         # stale pops would inflate my_load (and the balancer's "need")
@@ -1721,6 +2100,7 @@ class MDSDaemon:
         new delegation."""
         await self._load_subtrees()
         self._auth_cache.clear()
+        self._ftree_cache.clear()
         return {}
 
     # -- client sessions (SessionMap / session evict) ----------------------
@@ -1880,12 +2260,29 @@ class MDSDaemon:
             if await self._auth_rank(dino) != self.rank:
                 continue             # a peer rank scrubs its own
             try:
-                kv = await self.meta.get_omap(dirfrag_oid(dino))
+                kv = await self._dir_all(dino)
             except RadosError as e:
                 if e.rc != ENOENT:
                     raise
                 continue
             dirs += 1
+            tree = await self._fragtree(dino)
+            if tree != [ROOT_FRAG]:
+                # every fragtree leaf must have its object (a crashed
+                # split's journal replay normally rebuilds these; a
+                # lost journal leaves the hole for scrub)
+                for fb, fv in tree:
+                    try:
+                        await self.meta.stat(frag_oid(dino, fb, fv))
+                    except RadosError as e:
+                        if e.rc != ENOENT:
+                            raise
+                        note("missing_dirfrag_fragment", dino,
+                             frag=f"{fb}_{fv:x}", repaired=repair)
+                        if repair:
+                            await self.meta.operate(
+                                frag_oid(dino, fb, fv),
+                                ObjectOperation().create())
             for name, raw in kv.items():
                 de = decode(raw)
                 checked += 1
@@ -2038,7 +2435,7 @@ class MDSDaemon:
             if qino not in subtree and ROOT_INO not in subtree:
                 continue
             try:
-                await self.meta.get_omap(dirfrag_oid(qino))
+                await self.meta.stat(dirfrag_oid(qino))
                 alive = True
             except RadosError as e:
                 if e.rc != ENOENT:
@@ -2122,7 +2519,7 @@ class MDSDaemon:
         total = files = 0
         for dino in await self._walk_subtree(qino):
             try:
-                kv = await self.meta.get_omap(dirfrag_oid(dino))
+                kv = await self._dir_all(dino)
             except RadosError as e:
                 if e.rc != ENOENT:
                     raise
@@ -2842,7 +3239,7 @@ class MDSDaemon:
             raise MDSError(ENOTDIR, name)
         if int(dentry["ino"]) in self._subtrees:
             raise MDSError(EBUSY, f"{name!r} is a subtree export root")
-        kv = await self.meta.get_omap(dirfrag_oid(int(dentry["ino"])))
+        kv = await self._dir_all(int(dentry["ino"]))
         if kv:
             raise MDSError(ENOTEMPTY, name)
         entry = {"op": "rmdir", "parent": parent, "name": name,
@@ -2910,8 +3307,7 @@ class MDSDaemon:
                 if int(dst["ino"]) in self._subtrees:
                     raise MDSError(
                         EBUSY, f"{dn!r} is a subtree export root")
-                if await self.meta.get_omap(
-                        dirfrag_oid(int(dst["ino"]))):
+                if await self._dir_all(int(dst["ino"])):
                     raise MDSError(ENOTEMPTY, dn)
                 purge_dir_ino = int(dst["ino"])   # replaced empty dir
             elif dst["type"] == "dir":
@@ -3235,7 +3631,7 @@ class MDSDaemon:
             if dst["type"] == "dir":
                 if dentry["type"] != "dir":
                     raise MDSError(EISDIR, dn)
-                kv = await self.meta.get_omap(dirfrag_oid(int(dst["ino"])))
+                kv = await self._dir_all(int(dst["ino"]))
                 if kv:
                     raise MDSError(ENOTEMPTY, dn)
                 if int(dst["ino"]) != int(dentry["ino"]):

@@ -1,0 +1,289 @@
+"""MDS directory fragmentation: dirfrag split/merge.
+
+Reference: CDir::split / CDir::merge (src/mds/CDir.cc:994,1096) and
+MDCache::adjust_dir_fragments (src/mds/MDCache.cc:11187).  Here the
+fragtree rides a "fragtree" xattr on the base dirfrag object and splits
+partition the 32-bit rjenkins hash of the dentry name; splits/merges
+are journaled "fragment" entries, idempotent under crash replay.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.client.fs import CephFS, FSError
+from ceph_tpu.mds.daemon import (ROOT_FRAG, dirfrag_oid, frag_for,
+                                 frag_oid, fragtree_of)
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _fs_cluster(**overrides):
+    cluster = DevCluster(n_mons=1, n_osds=3, overrides=overrides)
+    await cluster.start()
+    admin = await cluster.client()
+    await admin.pool_create("cephfs_meta", pg_num=4, size=3, min_size=2)
+    await admin.pool_create("cephfs_data", pg_num=4, size=3, min_size=2)
+    await admin.shutdown()
+    mds = await cluster.start_mds(block_size=4096)
+    rados = await cluster.client("client.fs")
+    fs = CephFS(rados, str(mds.msgr.my_addr))
+    await fs.mount()
+    return cluster, mds, rados, fs
+
+
+async def _teardown(cluster, rados, fs):
+    await fs.unmount()
+    await rados.shutdown()
+    await cluster.stop()
+
+
+async def _dino(fs, mds, path):
+    st = await fs.stat(path)
+    return int(st["ino"])
+
+
+def test_auto_split_then_lookup_readdir_unlink():
+    """Crossing mds_bal_split_size fragments the directory; every
+    name-level and listing-level operation stays correct across
+    frags."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster(
+            mds_bal_split_size=8, mds_bal_merge_size=0)
+        await fs.mkdir("/big")
+        names = [f"f{i:03d}" for i in range(40)]
+        for n in names:
+            await fs.write_file(f"/big/{n}", b"x")
+        dino = await _dino(fs, mds, "/big")
+
+        tree = await fragtree_of(mds.meta, dino)
+        assert tree != [ROOT_FRAG], "directory should have split"
+        assert len(tree) >= 2
+        # base omap must be empty (dentries moved to frag objects);
+        # the base object still exists as the metadata anchor
+        base = await mds.meta.get_omap(dirfrag_oid(dino))
+        assert base == {}
+        # every fragtree leaf has its object, and the union matches
+        union = {}
+        for b, v in tree:
+            union.update(await mds.meta.get_omap(frag_oid(dino, b, v)))
+        assert sorted(union) == names
+        # name-level routing: each dentry sits in ITS hash frag
+        for n in names[:8]:
+            b, v = frag_for(tree, n)
+            kv = await mds.meta.get_omap(frag_oid(dino, b, v), [n])
+            assert n in kv
+
+        # client-visible behavior
+        fs._dcache.clear()
+        listing = await fs.readdir("/big")
+        assert sorted(listing) == names
+        for n in names[:5]:
+            st = await fs.stat(f"/big/{n}")
+            assert st["type"] == "file"
+        assert (await fs.read_file(f"/big/{names[0]}")) == b"x"
+
+        # mutations across frags
+        await fs.unlink(f"/big/{names[0]}")
+        await fs.rename(f"/big/{names[1]}", f"/big/renamed")
+        fs._dcache.clear()
+        listing = await fs.readdir("/big")
+        assert names[0] not in listing and names[1] not in listing
+        assert "renamed" in listing
+        with pytest.raises(FSError) as ei:
+            await fs.stat(f"/big/{names[0]}")
+        assert ei.value.rc == -2
+        # rmdir of a non-empty fragmented dir still refuses
+        with pytest.raises(FSError) as ei:
+            await fs.rmdir("/big")
+        assert ei.value.rc == -39
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_merge_back_to_trivial():
+    """Deleting most entries merges frags back; the base object ends
+    holding the survivors again (trivial fragtree)."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster(
+            mds_bal_split_size=8, mds_bal_merge_size=6)
+        await fs.mkdir("/d")
+        names = [f"f{i:03d}" for i in range(24)]
+        for n in names:
+            await fs.write_file(f"/d/{n}", b"x")
+        dino = await _dino(fs, mds, "/d")
+        assert await fragtree_of(mds.meta, dino) != [ROOT_FRAG]
+
+        for n in names[:-2]:
+            await fs.unlink(f"/d/{n}")
+        tree = await fragtree_of(mds.meta, dino)
+        assert tree == [ROOT_FRAG], f"expected full merge, got {tree}"
+        base = await mds.meta.get_omap(dirfrag_oid(dino))
+        assert sorted(base) == names[-2:]
+        fs._dcache.clear()
+        assert sorted(await fs.readdir("/d")) == names[-2:]
+        # and the dir can empty out + be removed entirely
+        for n in names[-2:]:
+            await fs.unlink(f"/d/{n}")
+        await fs.rmdir("/d")
+        fs._dcache.clear()
+        assert "d" not in await fs.readdir("/")
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_manual_fragment_request_and_replay_idempotency():
+    """The 'dirfrag split/merge' admin surface, plus journal-replay
+    semantics: a fragment entry journaled but not applied (crash
+    before apply) is applied by replay; re-applying a completed entry
+    is a no-op."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.mkdir("/m")
+        names = [f"e{i}" for i in range(10)]
+        for n in names:
+            await fs.write_file(f"/m/{n}", b"y")
+        dino = await _dino(fs, mds, "/m")
+
+        # manual split 0/0 -> 2 bits = 4 children
+        r = await fs._request("fragment", ino=dino, bits=0, value=0,
+                              nbits=2)
+        tree = [tuple(t) for t in r["fragtree"]]
+        assert sorted(tree) == [(2, 0), (2, 1), (2, 2), (2, 3)]
+        fs._dcache.clear()
+        assert sorted(await fs.readdir("/m")) == names
+
+        # re-apply the same entry (journal replay after a crash that
+        # lost nothing): state unchanged
+        entry = {"op": "fragment", "ino": dino, "bits": 0, "value": 0,
+                 "nbits": 2}
+        await mds._apply(entry)
+        assert sorted(
+            [tuple(t) for t in
+             (await fragtree_of(mds.meta, dino))]) == sorted(tree)
+        fs._dcache.clear()
+        assert sorted(await fs.readdir("/m")) == names
+
+        # split an invalid leaf -> EINVAL
+        with pytest.raises(FSError) as ei:
+            await fs._request("fragment", ino=dino, bits=0, value=0,
+                              nbits=1)
+        assert ei.value.rc == -22
+
+        # merge back down to trivial: 2-bit children merge pairwise
+        await fs._request("fragment", ino=dino, bits=1, value=0,
+                          nbits=-1)
+        await fs._request("fragment", ino=dino, bits=1, value=1,
+                          nbits=-1)
+        await fs._request("fragment", ino=dino, bits=0, value=0,
+                          nbits=-1)
+        assert await fragtree_of(mds.meta, dino) == [ROOT_FRAG]
+        fs._dcache.clear()
+        assert sorted(await fs.readdir("/m")) == names
+
+        # crash-before-apply: journal a split WITHOUT applying, then
+        # replay the journal — the split must land exactly once
+        await mds._journal({"op": "fragment", "ino": dino, "bits": 0,
+                            "value": 0, "nbits": 1})
+        await mds._replay_journal()
+        tree = await fragtree_of(mds.meta, dino)
+        assert sorted(tree) == [(1, 0), (1, 1)]
+        fs._dcache.clear()
+        assert sorted(await fs.readdir("/m")) == names
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_snapshot_of_fragmented_dir():
+    """COW freeze of a fragmented directory writes ONE combined snap
+    object; the snap view shows the union as of the snapshot while the
+    live dir diverges."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster(
+            mds_bal_split_size=8, mds_bal_merge_size=0)
+        await fs.mkdir("/s")
+        names = [f"f{i:02d}" for i in range(20)]
+        for n in names:
+            await fs.write_file(f"/s/{n}", b"z")
+        dino = await _dino(fs, mds, "/s")
+        assert await fragtree_of(mds.meta, dino) != [ROOT_FRAG]
+
+        await fs.mksnap("/s", "snap1")
+        await fs.unlink(f"/s/{names[0]}")
+        await fs.write_file("/s/new", b"post")
+
+        fs._dcache.clear()
+        live = await fs.readdir("/s")
+        assert names[0] not in live and "new" in live
+        snap = await fs.readdir("/s/.snap/snap1")
+        assert sorted(snap) == names          # pre-mutation union
+        assert (await fs.read_file(f"/s/.snap/snap1/{names[0]}")) == b"z"
+        await fs.rmsnap("/s", "snap1")
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_fragmented_dir_under_multi_active_export():
+    """A fragmented directory delegated to another active rank keeps
+    serving lookups/readdirs/mutations through the redirect path (the
+    fragtree and frag objects live in shared RADOS, so authority moves
+    without copying)."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+            "mds_bal_split_size": 8, "mds_bal_merge_size": 0})
+        await cluster.start()
+        admin = await cluster.client()
+        await admin.pool_create("cephfs_meta", pg_num=4, size=3,
+                                min_size=2)
+        await admin.pool_create("cephfs_data", pg_num=4, size=3,
+                                min_size=2)
+        mds_a = await cluster.start_mds(name="a", block_size=4096)
+        mds_b = await cluster.start_mds(name="b", block_size=4096)
+        r = await admin.mon_command("fs set_max_mds",
+                                    fs_name="cephfs", max_mds=2)
+        assert r["rc"] == 0, r
+        deadline = asyncio.get_running_loop().time() + 10
+        while mds_b.rank != 1:
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError("rank 1 never became active")
+            await asyncio.sleep(0.05)
+        await admin.shutdown()
+        assert {mds_a.rank, mds_b.rank} == {0, 1}
+        rados = await cluster.client("client.fs")
+        fs = CephFS(rados, str(mds_a.msgr.my_addr))
+        await fs.mount()
+
+        await fs.mkdir("/exp")
+        names = [f"f{i:03d}" for i in range(24)]
+        for n in names:
+            await fs.write_file(f"/exp/{n}", b"x")
+        dino = await _dino(fs, mds_a, "/exp")
+        tree = await fragtree_of(mds_a.meta, dino)
+        assert tree != [ROOT_FRAG]
+
+        other = mds_b if mds_a.rank == 0 else mds_a
+        await fs.export_dir("/exp", other.rank)
+        fs._dcache.clear()
+        assert sorted(await fs.readdir("/exp")) == names
+        st = await fs.stat(f"/exp/{names[3]}")
+        assert st["type"] == "file"
+        # mutations under the importing rank route into the same frags
+        await fs.write_file("/exp/after_export", b"w")
+        await fs.unlink(f"/exp/{names[0]}")
+        fs._dcache.clear()
+        listing = await fs.readdir("/exp")
+        assert "after_export" in listing and names[0] not in listing
+        # the importing rank sees the same fragtree and routes by it
+        assert sorted(await fragtree_of(other.meta, dino)) == \
+            sorted(tree)
+        await fs.unmount()
+        await rados.shutdown()
+        await cluster.stop()
+    asyncio.run(run())
