@@ -124,6 +124,12 @@ def global_options() -> list[Option]:
                "rotating service-secret / ticket lifetime (s)", min=0.5),
         Option("osd_agent_interval", float, 1.0,
                "cache-tier flush/evict agent period (s; 0=off)", min=0.0),
+        Option("osd_ec_mesh_cs", int, 0,
+               "chunk-sharding axis size of the distributed EC data "
+               "plane mesh (0 = single-device EC; >0 = shard encode/"
+               "decode batches over all local jax devices with a "
+               "('dp','cs') mesh, cs dividing the device count)",
+               min=0),
         Option("mds_beacon_interval", float, 0.5,
                "mds -> mon beacon period (s)", min=0.05),
         Option("mds_beacon_grace", float, 3.0,

@@ -226,6 +226,20 @@ class ErasureCodeJaxRS(ErasureCode):
         return self._apply_decode(D, stacked)
 
     # -- decode ----------------------------------------------------------
+    def decode_selection(
+        self, available_ids, missing
+    ) -> tuple[tuple[int, ...], np.ndarray]:
+        """Deterministic survivor choice + decode matrix, shared by the
+        single-device path (decode_chunks_batch) AND the distributed
+        mesh plane (osd.ec_backend._decode_batch).  One definition, so
+        the two planes can never drift apart and silently build
+        different decode matrices (cross-plane bit-identity depends on
+        this)."""
+        survivors = tuple(sorted(int(i) for i in available_ids)[: self.k])
+        return survivors, self._decode_matrix(survivors,
+                                              tuple(int(m)
+                                                    for m in missing))
+
     def _decode_matrix(
         self, survivors: tuple[int, ...], wanted: tuple[int, ...]
     ) -> np.ndarray:
@@ -284,8 +298,7 @@ class ErasureCodeJaxRS(ErasureCode):
         if missing:
             if len(avail) < self.k:
                 raise IOError(f"cannot decode {missing}")
-            survivors = tuple(sorted(avail)[: self.k])
-            D = self._decode_matrix(survivors, tuple(missing))
+            survivors, D = self.decode_selection(avail, missing)
             stacked = np.stack(
                 [avail[s] for s in survivors], axis=1
             )  # (B, k, C)

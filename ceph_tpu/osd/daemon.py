@@ -93,6 +93,10 @@ from ceph_tpu.store.txcodec import (
 
 log = Dout("osd")
 
+# process-wide EC data-plane meshes (cs -> jax Mesh): jax devices are a
+# process resource, so every OSD in one test process shares the mesh
+_EC_MESH_CACHE: dict[int, object] = {}
+
 # the active trace span of the op being executed on this task; sub-op
 # fan-out reads it to propagate the trace context across daemons
 _CUR_SPAN: contextvars.ContextVar[SpanCtx | None] = \
@@ -278,6 +282,11 @@ class OSDDaemon:
         await self.store.mount()
         await self.msgr.bind(self.addr)
         await self.monc.start(timeout)
+        if int(self.conf["osd_ec_mesh_cs"]) > 0:
+            # build the EC data-plane mesh OFF the event loop before
+            # any PG needs it: first-time jax runtime init blocks for
+            # seconds and would stall heartbeats/leases mid-peering
+            await asyncio.to_thread(self._ec_mesh)
         if self.cephx:
             # BEFORE the map subscription: a revived OSD's first map
             # triggers peering immediately, and unsigned pg_queries
@@ -1191,6 +1200,31 @@ class OSDDaemon:
             ]
         return [CollectionId(pg.pgid.pool, pg.pgid.ps)]
 
+    def _ec_mesh(self):
+        """Distributed EC data-plane mesh (osd_ec_mesh_cs > 0): one
+        ('dp','cs') mesh over all local jax devices, built once per
+        process (OSDs in one process share the devices).  Invalid
+        geometry degrades to the single-device plane with a warning —
+        a config typo must not keep PGs from going active."""
+        cs = int(self.conf["osd_ec_mesh_cs"])
+        if cs <= 0:
+            return None
+        mesh = _EC_MESH_CACHE.get(cs)
+        if mesh is None:
+            import jax
+
+            from ceph_tpu.parallel.ec_sharding import make_ec_mesh
+
+            devs = jax.devices()
+            if len(devs) < cs or len(devs) % cs:
+                log.derr("osd.%d: osd_ec_mesh_cs=%d does not divide "
+                         "the %d local devices; using single-device "
+                         "EC", self.osd_id, cs, len(devs))
+                return None
+            mesh = make_ec_mesh(devs, cs=cs)
+            _EC_MESH_CACHE[cs] = mesh
+        return mesh
+
     def _make_backend(self, pg: PG) -> None:
         if not pg.is_primary:
             pg.backend = None
@@ -1221,7 +1255,8 @@ class OSDDaemon:
                 self._maybe_trim(pg)
                 return entry
 
-            pg.backend = ECBackend(codec, shards, log_hook=log_hook)
+            pg.backend = ECBackend(codec, shards, log_hook=log_hook,
+                                   mesh=self._ec_mesh())
             pg.ec_k = pg.backend.k
         else:
             pg.backend = None       # replicated path works on the store

@@ -226,12 +226,19 @@ class ECBackend:
         shards: Mapping[int, ShardIO],
         stripe_unit: int | None = None,
         log_hook=None,
+        mesh=None,
     ):
         """``codec``: an initialised ErasureCodeInterface; ``shards``:
         shard id -> ShardIO for all k+m positions. ``log_hook(oid, op,
         obj_version, prior_version)`` (daemon-provided) allocates the PG
         log entry that rides every shard mutation; None = no logging
-        (standalone/library use)."""
+        (standalone/library use).  ``mesh``: an optional
+        jax.sharding.Mesh with ('dp', 'cs') axes — when given and the
+        codec is a generator-matrix code, encode/decode batches run the
+        distributed data plane (parallel/ec_sharding.ShardedApplier)
+        instead of the single-device codec path, bit-identically (the
+        multi-chip analog of the per-shard sub-op fan-out,
+        reference osd/ECBackend.cc:2090-2106,2364)."""
         self.ec = codec
         self.k = codec.get_data_chunk_count()
         self.n = codec.get_chunk_count()
@@ -262,6 +269,21 @@ class ECBackend:
         # serve corrupt ranges (version granularity is the object, not
         # the stripe)
         self._dirty: dict[str, set[int]] = {}
+        # distributed data plane: generator-matrix codecs only (dense
+        # device codecs expose .generator + encode_words_device; the
+        # orchestration plugins — lrc/shec/clay — keep their own
+        # layered paths)
+        gen = getattr(codec, "generator", None)
+        self.mesh = mesh if (
+            mesh is not None and gen is not None
+            and hasattr(codec, "encode_words_device")
+        ) else None
+        self._mesh_gen = np.asarray(gen, np.uint8) \
+            if self.mesh is not None else None
+        self._mesh_appliers: dict[tuple, object] = {}
+        # observability: proves which plane served a batch (tests and
+        # perf counters read these)
+        self.mesh_stats = {"encodes": 0, "decodes": 0}
 
     def _lock(self, oid: str):
         """Per-object write lock, refcounted so the table doesn't grow
@@ -303,6 +325,72 @@ class ECBackend:
         """Public per-object write-serialization guard (scrub and other
         external coordinators serialize against mutations with this)."""
         return self._lock(oid)
+
+    # -- codec dispatch (single-device vs distributed mesh plane) ---------
+    _MESH_APPLIER_CAP = 64
+
+    def _mesh_applier(self, key: tuple, coeff_fn):
+        """Bounded compile cache (FIFO, like the codec's decode-matrix
+        cache): each entry pins a jitted XLA executable, and survivor/
+        lost combinations are combinatorial in a long-lived OSD.
+        ``coeff_fn`` builds the coefficient matrix only on a miss —
+        steady-state degraded reads are matrix-math-free."""
+        ap = self._mesh_appliers.get(key)
+        if ap is None:
+            from ceph_tpu.parallel.ec_sharding import ShardedApplier
+
+            while len(self._mesh_appliers) >= self._MESH_APPLIER_CAP:
+                self._mesh_appliers.pop(
+                    next(iter(self._mesh_appliers)))
+            ap = ShardedApplier(self.mesh, coeff_fn())
+            self._mesh_appliers[key] = ap
+        return ap
+
+    async def _encode_batch(self, stripes: np.ndarray) -> np.ndarray:
+        """(B, k, C) -> (B, k+m, C), through the mesh plane when one is
+        configured (parity = sharded generator apply; data rows pass
+        through, so the result is bit-identical to the codec path)."""
+        if self.mesh is not None:
+            ap = self._mesh_applier(
+                ("enc",), lambda: self._mesh_gen[self.k:])
+            parity = await asyncio.to_thread(ap, stripes)
+            self.mesh_stats["encodes"] += 1
+            return np.concatenate(
+                [np.asarray(stripes, np.uint8), parity], axis=1)
+        return np.asarray(await asyncio.to_thread(
+            self.ec.encode_chunks_batch, stripes
+        ))
+
+    async def _decode_batch(self, batched: dict, missing: list) -> dict:
+        """Batched reconstruct through the mesh plane when configured.
+        Survivor selection mirrors the codec's decode_chunks_batch
+        (sorted available, first k) so both planes build the same
+        decode matrix — bit-identity by construction."""
+        missing = [int(w) for w in missing]
+        if self.mesh is not None:
+            avail = {int(i): np.asarray(c, np.uint8)
+                     for i, c in batched.items()}
+            todo = [w for w in missing if w not in avail]
+            out = {w: avail[w] for w in missing if w in avail}
+            if todo:
+                if len(avail) < self.k:
+                    raise IOError(f"cannot decode {todo}")
+                # survivor choice + decode matrix come from the ONE
+                # shared definition (codec.decode_selection, itself
+                # FIFO-cached) so the two planes cannot drift apart
+                survivors, D = self.ec.decode_selection(avail, todo)
+                ap = self._mesh_applier(
+                    ("dec", survivors, tuple(todo)), lambda: D)
+                stacked = np.stack([avail[s] for s in survivors],
+                                   axis=1)
+                rebuilt = await asyncio.to_thread(ap, stacked)
+                for i, w in enumerate(todo):
+                    out[w] = rebuilt[:, i]
+                self.mesh_stats["decodes"] += 1
+            return out
+        return await asyncio.to_thread(
+            self.ec.decode_chunks_batch, batched, missing
+        )
 
     # -- metadata --------------------------------------------------------
     async def _attr_all(self, oid: str, name: str) -> list:
@@ -429,9 +517,7 @@ class ECBackend:
             stripes = self.sinfo.split_stripes(buf)
             # device encode off the event loop: a first-time XLA
             # compile must not stall heartbeats/leases in this process
-            chunks = np.asarray(await asyncio.to_thread(
-                self.ec.encode_chunks_batch, stripes
-            ))
+            chunks = await self._encode_batch(stripes)
             shard_bytes = self.sinfo.shard_bytes(chunks)
             shard_off = self.sinfo.logical_to_prev_chunk_offset(a_start)
             meta_attr = self._meta_attr(ECObjectMeta(new_size, new_version))
@@ -727,9 +813,7 @@ class ECBackend:
             s: arr.reshape(nstripes, self.sinfo.chunk_size)
             for s, arr in have.items()
         }
-        out = await asyncio.to_thread(
-            self.ec.decode_chunks_batch, batched, list(missing)
-        )
+        out = await self._decode_batch(batched, list(missing))
         chunks = {}
         for i in range(self.k):
             if i in have:
@@ -963,9 +1047,7 @@ class ECBackend:
             s: arr.reshape(nstripes, self.sinfo.chunk_size)
             for s, arr in zip(need, reads)
         }
-        out = await asyncio.to_thread(
-            self.ec.decode_chunks_batch, batched, lost
-        )
+        out = await self._decode_batch(batched, lost)
         # copy the FULL attr set from a version-verified survivor — a
         # rebuilt shard missing user xattrs would serve stale attr
         # reads.  Prefer an acting source; when every source was a
@@ -1011,9 +1093,7 @@ class ECBackend:
             [reads[i].reshape(nstripes, self.sinfo.chunk_size)
              for i in range(self.k)], axis=1,
         )
-        recomputed = np.asarray(await asyncio.to_thread(
-            self.ec.encode_chunks_batch, stripes
-        ))
+        recomputed = await self._encode_batch(stripes)
         inconsistent = []
         for i in range(self.k, self.n):
             stored = reads[i].reshape(nstripes, self.sinfo.chunk_size)

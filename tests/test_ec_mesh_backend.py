@@ -1,0 +1,197 @@
+"""Distributed EC data plane wired into ECBackend (VERDICT r4 #4).
+
+With a ('dp','cs') jax.sharding.Mesh configured, ECBackend encode and
+decode batches run through parallel/ec_sharding.ShardedApplier —
+sharded over the 8-device virtual CPU mesh in CI — bit-identically to
+the single-device codec path.  The cluster-level test proves a real PG
+write and a shard recovery ride the sharded plane inside a running
+OSD cluster (the role of the per-shard sub-op fan-out + recovery
+reads, reference osd/ECBackend.cc:2090-2106,2364).
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.ec_backend import ECBackend, LocalShard, VERSION_ATTR
+from ceph_tpu.parallel.ec_sharding import ShardedApplier, make_ec_mesh
+from ceph_tpu.store import CollectionId, MemStore, Transaction
+
+K, M = 4, 2
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _make_backend(mesh):
+    registry = ErasureCodePluginRegistry()
+    codec = registry.factory(
+        "jax_rs", {"k": str(K), "m": str(M), "technique": "cauchy_good"}
+    )
+    shards = {}
+    stores = {}
+    for i in range(K + M):
+        store = MemStore()
+        cid = CollectionId(1, 0, shard=i)
+        await store.queue_transactions(
+            Transaction().create_collection(cid))
+        stores[i] = (store, cid)
+        shards[i] = LocalShard(store, cid, pool=1, shard=i)
+    be = ECBackend(codec, shards, stripe_unit=128, mesh=mesh)
+    be._test_stores = stores
+    return be
+
+
+def _payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, np.uint8).tobytes()
+
+
+def test_sharded_applier_matches_codec():
+    """ShardedApplier output == codec encode, any batch size (padding
+    path included)."""
+    registry = ErasureCodePluginRegistry()
+    codec = registry.factory(
+        "jax_rs", {"k": str(K), "m": str(M), "technique": "cauchy_good"}
+    )
+    mesh = make_ec_mesh(cs=2)
+    gen = np.asarray(codec.generator, np.uint8)
+    ap = ShardedApplier(mesh, gen[K:])
+    for batch in (1, 3, 8, 13):
+        data = np.random.default_rng(batch).integers(
+            0, 256, (batch, K, 64), np.uint8)
+        want = np.asarray(codec.encode_chunks_batch(data))
+        parity = ap(data)
+        assert np.array_equal(parity, want[:, K:]), f"batch={batch}"
+
+
+def test_backend_mesh_write_read_recover_bit_identical():
+    """The same writes through mesh and single-device backends leave
+    byte-identical shard objects; recovery through the mesh plane
+    rebuilds byte-identical shards."""
+    async def run():
+        mesh = make_ec_mesh(cs=2)
+        be_mesh = await _make_backend(mesh)
+        be_solo = await _make_backend(None)
+        assert be_mesh.mesh is not None and be_solo.mesh is None
+
+        data = _payload(5000)
+        await be_mesh.write("obj", data)
+        await be_solo.write("obj", data)
+        assert be_mesh.mesh_stats["encodes"] >= 1
+
+        # every shard object byte-identical across the two planes
+        from ceph_tpu.store import GHObject
+
+        for i in range(K + M):
+            s_m, cid_m = be_mesh._test_stores[i]
+            s_s, cid_s = be_solo._test_stores[i]
+            oid = GHObject(1, "obj", shard=i)
+            a = s_m.read(cid_m, oid, 0, 1 << 20)
+            b = s_s.read(cid_s, oid, 0, 1 << 20)
+            assert a == b, f"shard {i} diverged between planes"
+
+        # RMW overwrite through the mesh plane
+        await be_mesh.write("obj", _payload(700, seed=9), offset=300)
+        await be_solo.write("obj", _payload(700, seed=9), offset=300)
+        assert (await be_mesh.read("obj")) == (await be_solo.read("obj"))
+
+        # degraded read (decode) + full shard recovery via the mesh
+        for lost in (0, K + 1):          # a data shard and a parity shard
+            store, cid = be_mesh._test_stores[lost]
+            await store.queue_transactions(
+                Transaction().remove(cid, GHObject(1, "obj",
+                                                   shard=lost)))
+        dec0 = be_mesh.mesh_stats["decodes"]
+        assert (await be_mesh.read("obj")) == (await be_solo.read("obj"))
+        assert be_mesh.mesh_stats["decodes"] > dec0
+
+        await be_mesh.recover_shard("obj", [0, K + 1])
+        for i in (0, K + 1):
+            s_m, cid_m = be_mesh._test_stores[i]
+            s_s, cid_s = be_solo._test_stores[i]
+            oid = GHObject(1, "obj", shard=i)
+            assert s_m.read(cid_m, oid, 0, 1 << 20) == \
+                s_s.read(cid_s, oid, 0, 1 << 20), \
+                f"recovered shard {i} diverged"
+    _run(run())
+
+
+def test_cluster_pg_write_and_recovery_ride_the_mesh():
+    """OSD-cluster proof on the 8-device virtual mesh: an EC-pool PG
+    write and a shard recovery run the sharded data plane (mesh_stats
+    move) and stay correct end-to-end."""
+    from tests.test_osd_daemon import start_cluster, wait_active
+
+    async def run():
+        from ceph_tpu.common.config import ConfigProxy
+
+        def conf():
+            return ConfigProxy(overrides={
+                "mon_lease": 0.4, "mon_lease_interval": 0.1,
+                "mon_election_timeout": 0.3, "mon_tick_interval": 0.1,
+                "mon_accept_timeout": 0.5,
+                "osd_heartbeat_interval": 0.1,
+                "osd_heartbeat_grace": 0.6,
+                "mon_osd_down_out_interval": 30.0,
+                "osd_ec_mesh_cs": 2,
+            })
+
+        mon, osds, client = await start_cluster(6, conf_factory=conf,
+                                                pools=[
+            {"prefix": "osd erasure-code-profile set", "name": "p42",
+             "profile": {"plugin": "jax_rs", "k": "4", "m": "2",
+                         "crush-failure-domain": "osd"}},
+            {"prefix": "osd pool create", "pool": "ecm", "pg_num": 4,
+             "pool_type": "erasure", "erasure_code_profile": "p42"},
+        ])
+        pool_id = next(p.pool_id for p in mon.osd_monitor.osdmap
+                       .pools.values() if p.name == "ecm")
+        await wait_active(osds, pool_id)
+
+        payload = bytes(range(256)) * 64      # 16 KiB
+        r = await client.op("ecm", "big", [
+            {"op": "write", "off": 0, "data": payload},
+        ])
+        assert r["rc"] == 0, r
+        r = await client.op("ecm", "big", [{"op": "read", "off": 0}])
+        assert r["results"][0]["data"] == payload
+
+        backends = [pg.backend for osd in osds
+                    for pg in osd.pgs.values()
+                    if pg.pgid.pool == pool_id and pg.backend]
+        assert backends, "no EC backends instantiated"
+        assert all(b.mesh is not None for b in backends), \
+            "mesh not configured on the PG backends"
+        assert sum(b.mesh_stats["encodes"] for b in backends) >= 1, \
+            "write did not ride the sharded plane"
+
+        # recovery: rebuild a lost shard through the mesh decode on
+        # the primary that served the write
+        be = next(b for b in backends if b.mesh_stats["encodes"] >= 1)
+        await be.shards[0].remove_shard("big")
+        d0 = be.mesh_stats["decodes"]
+        await be.recover_shard("big", [0])
+        assert be.mesh_stats["decodes"] > d0, \
+            "recovery did not ride the sharded plane"
+        r = await client.op("ecm", "big", [{"op": "read", "off": 0}])
+        assert r["results"][0]["data"] == payload
+
+        await client.shutdown()
+        for o in osds:
+            await o.shutdown()
+        await mon.shutdown()
+
+    _run(run())
