@@ -124,6 +124,11 @@ def global_options() -> list[Option]:
                "rotating service-secret / ticket lifetime (s)", min=0.5),
         Option("osd_agent_interval", float, 1.0,
                "cache-tier flush/evict agent period (s; 0=off)", min=0.0),
+        Option("store_compression_algorithm", str, "",
+               "inline at-rest compression of the object store's WAL "
+               "records and checkpoint segments ('' = off; zlib, zstd, "
+               "lzma, bz2 — the BlueStore compress-on-write role)",
+               enum_values=("", "zlib", "zstd", "lzma", "bz2")),
         Option("osd_ec_mesh_cs", int, 0,
                "chunk-sharding axis size of the distributed EC data "
                "plane mesh (0 = single-device EC; >0 = shard encode/"

@@ -20,6 +20,8 @@ import secrets
 import time
 import zlib
 
+from ceph_tpu.common.compressor import get_compressor, list_compressors
+
 from ceph_tpu.client.rados import (IoCtx, ObjectOperation, RadosError,
                                    full_try)
 from ceph_tpu.client.striper import RadosStriper, StripeLayout
@@ -307,12 +309,15 @@ class RGWUsers:
 COMP_BLOCK = 4 * 1024 * 1024
 
 
-def deflate_if_smaller(data: bytes) -> tuple[bytes, dict | None]:
-    """Whole-body at-rest deflate (rgw_compression.cc role for small
-    objects): kept only when it actually shrinks."""
-    packed = zlib.compress(data, 6)
+def deflate_if_smaller(data: bytes,
+                       alg: str = "zlib") -> tuple[bytes, dict | None]:
+    """Whole-body at-rest compression (rgw_compression.cc role for
+    small objects) through the shared compressor registry
+    (common/compressor, Compressor.h:33): kept only when it actually
+    shrinks."""
+    packed = get_compressor(alg).compress(data)
     if len(packed) < len(data):
-        return packed, {"alg": "zlib", "stored_size": len(packed)}
+        return packed, {"alg": alg, "stored_size": len(packed)}
     return data, None
 
 
@@ -358,7 +363,8 @@ class StreamingPut:
         # and bounded memory; small ones stay buffered and compress at
         # complete() exactly like the buffered path
         self._comp_alg = (ctx.get("compression")
-                          if ctx.get("compression") == "zlib" else None)
+                          if ctx.get("compression") in list_compressors()
+                          else None)
         self._cpos = 0
         self._blkbuf = bytearray() if self._striped else None
         self._blocks: list[list[int]] = []
@@ -411,10 +417,10 @@ class StreamingPut:
         self._pos += len(chunk)
 
     async def _emit_block(self, raw: bytes) -> None:
-        # each block deflates independently (always kept: a streamed
-        # body can't be un-written, and per-block zlib framing is
+        # each block compresses independently (always kept: a streamed
+        # body can't be un-written, and per-block framing overhead is
         # ~0.03% worst case) so reads seek straight to any block
-        packed = zlib.compress(raw, 6)
+        packed = get_compressor(self._comp_alg).compress(raw)
         await self._rgw.striper.write(self._ctx["oid"], packed,
                                       offset=self._cpos)
         self._blocks.append([len(raw), len(packed)])
@@ -430,12 +436,12 @@ class StreamingPut:
             if self._blkbuf:
                 await self._emit_block(bytes(self._blkbuf))
                 self._blkbuf.clear()
-            comp = {"alg": "zlib", "stored_size": self._cpos,
+            comp = {"alg": self._comp_alg, "stored_size": self._cpos,
                     "blocks": self._blocks}
         elif not self._striped:
             data = bytes(self._buf)
             if self._comp_alg is not None:
-                data, comp = deflate_if_smaller(data)
+                data, comp = deflate_if_smaller(data, self._comp_alg)
             await self._rgw.ioctx.operate(
                 self._ctx["oid"],
                 ObjectOperation().write_full(data))
@@ -971,10 +977,11 @@ class RGWLite:
     async def put_bucket_compression(self, bucket: str,
                                      alg: str | None = "zlib") -> None:
         """Per-bucket at-rest compression (rgw_compression.cc role):
-        buffered object PUTs store zlib-deflated bytes when it actually
-        shrinks them; S3-visible size/etag stay the ORIGINAL object's.
-        ``None`` disables (existing objects stay as stored)."""
-        if alg not in (None, "zlib"):
+        object PUTs store compressed bytes through the shared registry
+        (common/compressor — zlib/zstd/lzma/bz2); S3-visible size/etag
+        stay the ORIGINAL object's.  ``None`` disables (existing
+        objects stay as stored, each entry remembering its alg)."""
+        if alg is not None and alg not in list_compressors():
             raise RGWError("InvalidArgument", f"unknown algorithm {alg}")
         meta = await self._check_bucket(bucket, "FULL_CONTROL")
         if alg is None:
@@ -2769,11 +2776,11 @@ class RGWLite:
         etag = hashlib.md5(data).hexdigest()
         size = len(data)
         comp = None
-        if ctx.get("compression") == "zlib" and sse_key is None \
-                and sse is None:
+        if ctx.get("compression") in list_compressors() \
+                and sse_key is None and sse is None:
             # compress-at-rest (rgw_compression.cc): S3-visible
             # size/etag stay the original
-            data, comp = deflate_if_smaller(data)
+            data, comp = deflate_if_smaller(data, ctx["compression"])
         if sse is not None:
             dk, kms_sse = await self._kms_begin(sse, kms_key_id)
             data = sse_crypt(dk, bytes.fromhex(kms_sse["nonce"]),
@@ -2903,14 +2910,15 @@ class RGWLite:
         end = min(end, size - 1)
         if end < start:
             return b""
+        comp = get_compressor(entry["comp"].get("alg", "zlib"))
         blocks = entry["comp"].get("blocks")
         if blocks is None:
             raw = await self._read_stored(
                 entry, 0, entry["comp"]["stored_size"])
-            return zlib.decompress(raw)[start:end + 1]
+            return comp.decompress(raw)[start:end + 1]
         async def one(soff, slen, skip, take):
             raw = await self._read_stored(entry, soff, slen)
-            return zlib.decompress(raw)[skip:skip + take]
+            return comp.decompress(raw)[skip:skip + take]
 
         # the windows are independent stored ranges: fetch + inflate
         # them concurrently (the result is buffered whole either way)
@@ -2965,13 +2973,14 @@ class RGWLite:
             start, end = (0, size - 1) if range_ is None else range_
             end = min(end, size - 1)
             windows = comp_window(blocks, start, end)
+            comp_dec = get_compressor(entry["comp"].get("alg", "zlib"))
 
             async def blocked():
                 # one block in memory at a time: the block map keeps
                 # streamed GETs of compressed objects bounded
                 for soff, slen, skip, take in windows:
                     raw = await self._read_stored(entry, soff, slen)
-                    yield zlib.decompress(raw)[skip:skip + take]
+                    yield comp_dec.decompress(raw)[skip:skip + take]
 
             return entry, blocked()
         size = int(entry["size"])

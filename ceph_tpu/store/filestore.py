@@ -41,6 +41,7 @@ import struct
 from pathlib import Path
 
 from ceph_tpu.common.crc32c import crc32c
+from ceph_tpu.common.compressor import envelope_pack, envelope_unpack, get_compressor
 from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.msg.codec import decode, encode
 from ceph_tpu.store.object_store import ObjectStore, Transaction
@@ -60,7 +61,17 @@ _WAL_MAGIC = b"ceph-tpu-wal-1\n"
 
 class FileStore(ObjectStore):
     def __init__(self, path: str, wal_max: int = 64 << 20,
-                 sync: bool = False, native: bool | None = None):
+                 sync: bool = False, native: bool | None = None,
+                 compression: str | None = None):
+        """``compression``: inline at-rest compression of WAL records
+        (common/compressor envelope: per-record alg + raw len + raw
+        crc32c).  Object data/meta files stay raw — they are random-
+        access range files; the durable transaction stream is the
+        tier this option covers (WalStore compresses its checkpoint
+        segments too, making it the full BlueStore-analog)."""
+        if compression:
+            get_compressor(compression)
+        self.compression = compression or None
         self.path = Path(path)
         self.wal_path = self.path / "wal.log"
         self.applied_path = self.path / "wal.applied"
@@ -194,6 +205,7 @@ class FileStore(ObjectStore):
                 self._reset_wal()
 
     def _append(self, payload: bytes) -> int:
+        payload = envelope_pack(payload, self.compression)
         if self._nwal is not None:
             return self._nwal.append(payload)
         frame = _FRAME.pack(len(payload), crc32c(0xFFFFFFFF, payload))
@@ -450,7 +462,8 @@ class FileStore(ObjectStore):
             if pos <= applied:
                 continue            # already on the filesystem
             try:
-                txns = [decode_tx(w) for w in decode(payload)]
+                txns = [decode_tx(w) for w in decode(
+                    envelope_unpack(payload))]
             except (ValueError, TypeError, KeyError, struct.error):
                 break               # undecodable record ends the log
             stamp = self._stamp(pos)

@@ -43,6 +43,8 @@ import struct
 from pathlib import Path
 
 from ceph_tpu.common.lockdep import DLock
+from ceph_tpu.common.compressor import envelope_pack, envelope_unpack, \
+    get_compressor
 from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.msg.codec import decode, encode
 from ceph_tpu.store.memstore import MemStore, _Obj
@@ -62,14 +64,25 @@ _WAL_MAGIC = b"ceph-tpu-wal-1\n"
 
 class WalStore(MemStore):
     def __init__(self, path: str, checkpoint_bytes: int = 16 << 20,
-                 sync: bool = False, native: bool | None = None):
+                 sync: bool = False, native: bool | None = None,
+                 compression: str | None = None):
         """``sync``: os.fsync every append (power-loss durability); off by
         default — process-crash durability (the DevCluster/test contract)
         needs only the flush.  ``native``: use the C++ wal engine
         (wal_engine.cc) for the append/replay/checkpoint file tier; None
         = auto (native when the .so builds).  Both tiers share one
-        on-disk format, so files migrate freely between them."""
+        on-disk format, so files migrate freely between them.
+        ``compression``: inline at-rest compression of WAL records and
+        checkpoint segments (the BlueStore compress-on-write role,
+        reference os/bluestore/BlueStore.cc) — every stored extent
+        carries the algorithm name plus the raw length and crc32c of
+        the uncompressed bytes (common/compressor envelope), so reads
+        verify per-extent integrity and files written under any
+        algorithm (or none) stay readable."""
         super().__init__()
+        if compression:
+            get_compressor(compression)    # unknown alg fails at mount
+        self.compression = compression or None
         self.path = Path(path)
         self.wal_path = self.path / "wal.log"
         self.wal_old_path = self.path / "wal.old"
@@ -196,6 +209,7 @@ class WalStore(MemStore):
 
     def _append(self, payload: bytes) -> int:
         """Framed append; returns WAL size after the write."""
+        payload = envelope_pack(payload, self.compression)
         if self._nwal is not None:
             return self._nwal.append(payload)
         frame = _FRAME.pack(len(payload), crc32c(0xFFFFFFFF, payload))
@@ -233,6 +247,7 @@ class WalStore(MemStore):
 
     def _write_framed(self, path: Path, blob: bytes) -> None:
         """Atomic framed file write (tmp + fsync + rename), either tier."""
+        blob = envelope_pack(blob, self.compression)
         if self.native:
             from ceph_tpu.store import native_wal
 
@@ -408,7 +423,13 @@ class WalStore(MemStore):
         if self.native:
             from ceph_tpu.store import native_wal
 
-            return native_wal.read_checkpoint(str(path))
+            blob = native_wal.read_checkpoint(str(path))
+            if blob is None:
+                return None
+            try:
+                return envelope_unpack(blob)
+            except ValueError:
+                return None
         if not path.exists():
             return None
         raw = path.read_bytes()
@@ -421,13 +442,17 @@ class WalStore(MemStore):
         blob = body[_FRAME.size:_FRAME.size + length]
         if len(blob) != length or crc32c(0xFFFFFFFF, blob) != crc:
             return None                 # torn checkpoint: fall back to WAL
-        return blob
+        try:
+            return envelope_unpack(blob)
+        except ValueError:
+            return None        # failed extent integrity: treat as torn
 
     # -- replay -----------------------------------------------------------
     def _apply_payload(self, payload: bytes) -> bool:
         """Decode + apply one WAL record; False stops the replay."""
         try:
-            txns = [decode_tx(w) for w in decode(payload)]
+            txns = [decode_tx(w) for w in decode(
+                envelope_unpack(payload))]
         except (ValueError, TypeError, KeyError, IndexError,
                 struct.error):
             return False

@@ -117,9 +117,11 @@ class DevCluster:
         (fully disk-resident; capacity bounded by disk)."""
         if self.store_dir:
             base = f"{self.store_dir}/osd.{osd_id}"
+            comp = str(self.conf()["store_compression_algorithm"]) \
+                or None
             if self.store_kind == "file":
-                return FileStore(base)
-            return WalStore(base)
+                return FileStore(base, compression=comp)
+            return WalStore(base, compression=comp)
         return MemStore()
 
     async def start_osd(self, osd_id: int) -> OSDDaemon:
