@@ -507,6 +507,11 @@ class RGWLite:
         # bucket -> (fetched_at, notification configs); shared across
         # as_user handles so invalidation is seen by every identity
         self._notif_cache: dict[str, tuple[float, list]] = {}
+        # push-mode delivery state (rgw_notify.cc persistent topics):
+        # topic -> (worker task, wake event); topic meta cache.  Shared
+        # across as_user handles like _notif_cache.
+        self._pushers: dict[str, tuple] = {}
+        self._topics_cache: dict[str, tuple[float, dict | None]] = {}
         self.striper = RadosStriper(ioctx, StripeLayout(
             stripe_unit=512 * 1024, stripe_count=4,
             object_size=4 * 1024 * 1024,
@@ -518,6 +523,8 @@ class RGWLite:
                         self.gc_min_wait, self.auto_reshard_objs,
                         kms=self.kms)
         child._notif_cache = self._notif_cache
+        child._pushers = self._pushers
+        child._topics_cache = self._topics_cache
         return child
 
     # -- SSE-KMS / SSE-S3 (rgw_kms.h + rgw_crypt.cc wiring) ---------------
@@ -2480,6 +2487,244 @@ class RGWLite:
                         "bucket": bucket, "eventTime": now,
                     }).encode(),
                 )
+                # push mode: wake (or revive after a restart) the
+                # topic's delivery worker
+                tmeta = await self._topic_meta(cfg["topic"])
+                if tmeta is not None and tmeta.get("push_endpoint"):
+                    self._ensure_pusher(cfg["topic"], tmeta)
+
+    # -- persistent topics + push-mode delivery ---------------------------
+    # rgw_pubsub_push.h:20 (RGWPubSubEndpoint) + rgw_notify.cc
+    # persistent-topic semantics: events land in the per-topic queue
+    # (the at-least-once source of truth) regardless of mode; a topic
+    # with a push_endpoint gets a worker that delivers in order,
+    # advances a DURABLE cursor xattr only after an ack (or after
+    # parking an exhausted event in <topic>.deadletter), and backs off
+    # exponentially between attempts.  A restart resumes from the
+    # cursor: delivery is at-least-once, never lossy.
+    TOPICS_OID = "rgw.pubsub.topics"
+
+    async def create_topic(self, name: str,
+                           push_endpoint: str | None = None,
+                           ack_level: str = "broker",
+                           max_retries: int = 5,
+                           retry_sleep: float = 0.05,
+                           opaque: str = "") -> dict:
+        """Create/replace a topic (radosgw-admin topic create +
+        attributes: push-endpoint URL, ack level, OpaqueData)."""
+        if push_endpoint:
+            from ceph_tpu.services.rgw_push import PushEndpoint
+
+            PushEndpoint.make(push_endpoint, ack_level)  # validate now
+        meta = {"name": str(name), "push_endpoint": push_endpoint,
+                "ack_level": ack_level,
+                "max_retries": int(max_retries),
+                "retry_sleep": float(retry_sleep),
+                "opaque": str(opaque), "created": time.time()}
+        await self.ioctx.operate(
+            self.TOPICS_OID, ObjectOperation().create()
+            .omap_set({str(name): json.dumps(meta).encode()}))
+        self._topics_cache.pop(str(name), None)
+        # replace semantics: a live worker was built from the OLD meta
+        # (endpoint/ack/retries) — stop it; the new one starts now or,
+        # for a pull-only topic, never
+        self._stop_pusher(str(name))
+        if push_endpoint:
+            self._ensure_pusher(str(name), meta)
+        return meta
+
+    async def get_topic(self, name: str) -> dict:
+        t = await self._topic_meta(name)
+        if t is None:
+            raise RGWError("NoSuchTopic", name)
+        return t
+
+    async def list_topics(self) -> list[str]:
+        try:
+            return sorted(await self.ioctx.get_omap(self.TOPICS_OID))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            return []
+
+    async def delete_topic(self, name: str) -> None:
+        self._stop_pusher(name)
+        try:
+            await self.ioctx.operate(
+                self.TOPICS_OID, ObjectOperation().omap_rm([str(name)]))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+        self._topics_cache.pop(str(name), None)
+        for oid in (self._topic_oid(name),
+                    self._topic_oid(name) + ".deadletter"):
+            try:
+                await self.ioctx.remove(oid)
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+
+    async def _topic_meta(self, name: str) -> dict | None:
+        now = time.time()
+        cached = self._topics_cache.get(name)
+        if cached is not None and now - cached[0] <= 5.0:
+            return cached[1]
+        try:
+            kv = await self.ioctx.get_omap(self.TOPICS_OID, [str(name)])
+            meta = json.loads(kv[str(name)]) if str(name) in kv else None
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            meta = None
+        if len(self._topics_cache) > 4096:
+            self._topics_cache.clear()
+        self._topics_cache[name] = (now, meta)
+        return meta
+
+    def _ensure_pusher(self, topic: str, meta: dict) -> None:
+        cur = self._pushers.get(topic)
+        if cur is not None and not cur[0].done():
+            cur[1].set()
+            return
+        ev = asyncio.Event()
+        ev.set()
+        task = asyncio.get_running_loop().create_task(
+            self._push_loop(topic, meta, ev))
+        self._pushers[topic] = (task, ev)
+
+    def _stop_pusher(self, topic: str) -> None:
+        cur = self._pushers.pop(topic, None)
+        if cur is not None:
+            cur[0].cancel()
+
+    async def start_push(self) -> None:
+        """Spawn delivery workers for every push topic (the restart
+        hook: events queued before a process restart must not wait for
+        new traffic on their topic — rgw_notify.cc starts its
+        persistent-queue workers at init the same way)."""
+        try:
+            kv = await self.ioctx.get_omap(self.TOPICS_OID)
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            return
+        for name, raw in kv.items():
+            try:
+                meta = json.loads(raw)
+            except ValueError:
+                continue
+            if meta.get("push_endpoint"):
+                self._ensure_pusher(name, meta)
+
+    async def stop_push(self) -> None:
+        """Cancel + drain every push worker (test/shutdown hook)."""
+        tasks = [t for t, _ in self._pushers.values()]
+        self._pushers.clear()
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    @staticmethod
+    def _event_payload(topic: str, opaque: str, e: dict) -> bytes:
+        """S3 notification record shape (what the reference's HTTP
+        endpoint POSTs, rgw_pubsub.cc json_format_versioned_event)."""
+        return json.dumps({"Records": [{
+            "eventVersion": "2.2",
+            "eventSource": "ceph:s3",
+            "eventName": e.get("eventName", ""),
+            "eventTime": e.get("eventTime", 0),
+            "s3": {"bucket": {"name": e.get("bucket", "")},
+                   "object": {"key": e.get("key", ""),
+                              "eTag": e.get("etag", "")}},
+            "opaqueData": opaque,
+            "topic": topic,
+        }]}).encode()
+
+    async def _push_loop(self, topic: str, meta: dict,
+                         ev: asyncio.Event) -> None:
+        from ceph_tpu.services.rgw_push import DeliveryError, \
+            PushEndpoint
+
+        ep = PushEndpoint.make(meta["push_endpoint"],
+                               meta.get("ack_level", "broker"))
+        oid = self._topic_oid(topic)
+        try:
+            cursor = int(await self.ioctx.get_xattr(oid, "push_cursor"))
+        except RadosError as e:
+            if e.rc != -2:
+                raise      # a transient read error must not reset the
+            cursor = 0     # cursor and mass-redeliver the whole queue
+        except ValueError:
+            cursor = 0
+        retries = int(meta.get("max_retries", 5))
+        sleep0 = float(meta.get("retry_sleep", 0.05))
+        while True:
+            try:
+                batch = await self.topic_pull(topic, after=cursor)
+                events = batch["events"]
+            except RadosError as e:
+                if e.rc != -2:
+                    raise              # real failure, not an empty topic
+                events = []            # queue object not created yet
+            if not events:
+                ev.clear()
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            for e in events:
+                payload = self._event_payload(
+                    topic, meta.get("opaque", ""), e)
+                delivered = False
+                for attempt in range(retries + 1):
+                    try:
+                        await ep.send(payload)
+                        delivered = True
+                        break
+                    except DeliveryError:
+                        if attempt < retries:   # no backoff after the
+                            await asyncio.sleep(   # last attempt
+                                min(sleep0 * (2 ** attempt), 2.0))
+                if not delivered:
+                    # dead-letter: park and move on so one dead
+                    # endpoint cannot wedge the topic forever; the
+                    # event stays inspectable via deadletter_pull
+                    # the DL log allocates its own seq: the event's
+                    # original topic seq must not ride along, or it
+                    # would clobber deadletter_pull's pagination cursor
+                    parked = {k: v for k, v in e.items()
+                              if k != "seq"}
+                    await self.ioctx.exec(
+                        oid + ".deadletter", "rgw", "log_add",
+                        json.dumps(parked).encode())
+                cursor = int(e["seq"])
+                # durable ack: a restarted worker resumes past this
+                # event (at-least-once — a crash between send and
+                # this write redelivers)
+                await self.ioctx.set_xattr(
+                    oid, "push_cursor", str(cursor).encode())
+
+    async def deadletter_pull(self, topic: str, after: int = 0,
+                              max_events: int = 1000) -> dict:
+        """Inspect events whose delivery exhausted max_retries."""
+        try:
+            out = json.loads(await self.ioctx.exec(
+                self._topic_oid(topic) + ".deadletter", "rgw",
+                "log_list",
+                json.dumps({"after": after,
+                            "max": max_events}).encode()))
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            return {"events": [], "last": after}
+        entries = out.get("entries", [])
+        return {"events": entries,
+                "last": entries[-1]["seq"] if entries else after}
 
     async def topic_pull(self, topic: str, after: int = 0,
                          max_events: int = 1000) -> dict:

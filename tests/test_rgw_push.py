@@ -1,0 +1,235 @@
+"""RGW push-mode notification delivery (VERDICT r4 #6).
+
+Reference: rgw_pubsub_push.h:20 RGWPubSubEndpoint + rgw_notify.cc
+persistent topics — HTTP endpoint push with at-least-once retry,
+exponential backoff, a durable delivery cursor, and a dead-letter
+queue.  The integration tests run a real local asyncio HTTP receiver
+and prove an object PUT reaches it through failures.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rgw import RGWError, RGWLite
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+class Receiver:
+    """Minimal HTTP/1.1 POST receiver: records bodies, can fail the
+    first N requests with 500 to exercise the retry path."""
+
+    def __init__(self, fail_first: int = 0):
+        self.records: list[dict] = []
+        self.requests = 0
+        self.fail_first = fail_first
+        self._server = None
+        self.port = 0
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def _handle(self, reader, writer):
+        try:
+            length = 0
+            while True:
+                line = await reader.readline()
+                if not line or line == b"\r\n":
+                    break
+                if line.lower().startswith(b"content-length:"):
+                    length = int(line.split(b":")[1])
+            body = await reader.readexactly(length) if length else b""
+            self.requests += 1
+            if self.requests <= self.fail_first:
+                writer.write(b"HTTP/1.1 500 Boom\r\n"
+                             b"Content-Length: 0\r\n\r\n")
+            else:
+                self.records.append(json.loads(body))
+                writer.write(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Length: 0\r\n\r\n")
+            await writer.drain()
+        finally:
+            writer.close()
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def _wait(cond, timeout=10.0, what="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.02)
+
+
+async def _gw(rados, pool="rgwp"):
+    await rados.pool_create(pool, pg_num=8)
+    ioctx = await rados.open_ioctx(pool)
+    return RGWLite(ioctx), ioctx
+
+
+def test_put_reaches_http_receiver_through_failures():
+    """An object PUT is pushed to the endpoint even when the endpoint
+    answers 500 for the first attempts (at-least-once retry +
+    backoff)."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        recv = await Receiver(fail_first=2).start()
+        try:
+            gw, ioctx = await _gw(rados)
+            await gw.create_bucket("nb")
+            meta = await gw.create_topic(
+                "t1", push_endpoint=f"http://127.0.0.1:{recv.port}/ev",
+                max_retries=6, retry_sleep=0.02, opaque="tenant-7")
+            assert meta["push_endpoint"].endswith("/ev")
+            assert (await gw.get_topic("t1"))["opaque"] == "tenant-7"
+            assert await gw.list_topics() == ["t1"]
+            await gw.put_bucket_notification("nb", "t1")
+
+            await gw.put_object("nb", "hello.txt", b"payload")
+            await _wait(lambda: recv.records, what="push delivery")
+            rec = recv.records[0]["Records"][0]
+            assert rec["eventName"] == "s3:ObjectCreated:Put"
+            assert rec["s3"]["bucket"]["name"] == "nb"
+            assert rec["s3"]["object"]["key"] == "hello.txt"
+            assert rec["opaqueData"] == "tenant-7"
+            assert recv.requests >= 3          # two 500s then the ack
+
+            # deletion events push too, in order
+            await gw.delete_object("nb", "hello.txt")
+            await _wait(lambda: len(recv.records) >= 2,
+                        what="delete event")
+            assert recv.records[1]["Records"][0]["eventName"] \
+                .startswith("s3:ObjectRemoved")
+            # nothing dead-lettered
+            assert (await gw.deadletter_pull("t1"))["events"] == []
+            await gw.stop_push()
+        finally:
+            await recv.stop()
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_endpoint_down_then_up_and_durable_cursor():
+    """Events queued while the endpoint is unreachable deliver once it
+    comes up; a NEW gateway handle (restart analog) resumes from the
+    durable cursor without redelivering acked events."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        recv = Receiver()
+        try:
+            gw, ioctx = await _gw(rados)
+            await gw.create_bucket("nb")
+            # reserve a port by starting + stopping a throwaway server
+            probe = await Receiver().start()
+            port = probe.port
+            await probe.stop()
+            await gw.create_topic(
+                "t2", push_endpoint=f"http://127.0.0.1:{port}/",
+                max_retries=10, retry_sleep=0.05)
+            await gw.put_bucket_notification("nb", "t2")
+            await gw.put_object("nb", "a", b"1")     # endpoint is DOWN
+            await asyncio.sleep(0.3)
+            assert recv.records == []
+            # bring the endpoint up on the reserved port mid-retry
+            recv.port = port
+            recv._server = await asyncio.start_server(
+                recv._handle, "127.0.0.1", port)
+            await _wait(lambda: recv.records, what="recovery delivery")
+            key0 = recv.records[0]["Records"][0]["s3"]["object"]["key"]
+            assert key0 == "a"
+
+            # restart analog 1: stop workers with an event already
+            # QUEUED but undelivered, then start_push on a fresh
+            # handle — delivery must resume with NO new traffic
+            await recv.stop()
+            await gw.put_object("nb", "b", b"2")
+            await asyncio.sleep(0.05)
+            await gw.stop_push()           # 'b' is queued, unacked
+            recv._server = await asyncio.start_server(
+                recv._handle, "127.0.0.1", port)
+            gw2 = RGWLite(ioctx)
+            await gw2.start_push()
+            await _wait(lambda: len(recv.records) >= 2,
+                        what="start_push recovery delivery")
+            # restart analog 2: new traffic also revives the worker,
+            # resuming from the durable cursor (no duplicates)
+            await gw2.stop_push()
+            gw3 = RGWLite(ioctx)
+            await gw3.put_object("nb", "c", b"3")
+            await _wait(lambda: len(recv.records) >= 3,
+                        what="post-restart delivery")
+            keys = [r["Records"][0]["s3"]["object"]["key"]
+                    for r in recv.records]
+            assert keys == ["a", "b", "c"]     # in order, no dupes
+            await gw3.stop_push()
+        finally:
+            await recv.stop()
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_dead_letter_queue_and_topic_lifecycle():
+    """Exhausted retries park the event in <topic>.deadletter and the
+    worker moves on; delete_topic stops the worker and removes the
+    queues; unsupported schemes are rejected at create."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        recv = await Receiver().start()
+        try:
+            gw, ioctx = await _gw(rados)
+            await gw.create_bucket("nb")
+            # port 1 on localhost: connection always refused
+            await gw.create_topic(
+                "dead", push_endpoint="http://127.0.0.1:1/",
+                max_retries=1, retry_sleep=0.01)
+            await gw.put_bucket_notification("nb", "dead")
+            await gw.put_object("nb", "doomed", b"x")
+            await _wait(lambda: True, timeout=0.01)
+
+            async def dead():
+                return (await gw.deadletter_pull("dead"))["events"]
+
+            deadline = asyncio.get_running_loop().time() + 10
+            while not await dead():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            events = await dead()
+            assert events[0]["key"] == "doomed"
+
+            # a later event to a now-working endpoint still flows on a
+            # different topic (one dead topic cannot wedge others)
+            await gw.create_topic(
+                "ok", push_endpoint=f"http://127.0.0.1:{recv.port}/")
+            await gw.set_bucket_notifications(
+                "nb", [{"topic": "ok"}])
+            await gw.put_object("nb", "fine", b"y")
+            await _wait(lambda: recv.records, what="good delivery")
+
+            await gw.delete_topic("dead")
+            assert await gw.list_topics() == ["ok"]
+            with pytest.raises(RGWError):
+                await gw.get_topic("dead")
+            with pytest.raises(ValueError):
+                await gw.create_topic(
+                    "bad", push_endpoint="kafka://broker:9092/t")
+            await gw.stop_push()
+        finally:
+            await recv.stop()
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
