@@ -19,19 +19,55 @@ _SO = _NATIVE_DIR / "libceph_tpu_native.so"
 _native = None
 
 
+def _stale() -> bool:
+    """The .so is rebuilt when missing OR older than any source (the
+    binary is NOT committed — CI and first use build it from the
+    in-tree C/C++ sources via the Makefile)."""
+    try:
+        if not _SO.exists():
+            return True
+        so_mtime = _SO.stat().st_mtime
+        for src in _NATIVE_DIR.iterdir():
+            if src.suffix in (".c", ".cc", ".h") \
+                    or src.name == "Makefile":
+                if src.stat().st_mtime > so_mtime:
+                    return True
+        return False
+    except OSError:
+        return True        # racing build/cleanup: (re)build to be sure
+
+
+def _build() -> bool:
+    """Build in a scratch dir and publish with an atomic rename:
+    concurrent first-use builds (parallel test workers, several
+    daemons in one checkout) each produce a complete .so and the last
+    replace wins — a reader can never CDLL a half-linked file."""
+    import os
+    import shutil
+    import tempfile
+
+    try:
+        with tempfile.TemporaryDirectory(dir=_NATIVE_DIR) as td:
+            for src in _NATIVE_DIR.iterdir():
+                if src.suffix in (".c", ".cc", ".h") \
+                        or src.name == "Makefile":
+                    shutil.copy(src, td)
+            subprocess.run(["make", "-C", td, "-s"], check=True,
+                           capture_output=True, timeout=120)
+            os.replace(os.path.join(td, "libceph_tpu_native.so"),
+                       _SO)
+        return True
+    except Exception:
+        return False
+
+
 def _load_native():
     global _native
     if _native is not None:
         return _native
-    if not _SO.exists():
-        try:
-            subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR), "-s"],
-                check=True, capture_output=True, timeout=60,
-            )
-        except Exception:
-            _native = False
-            return False
+    if _stale() and not _build():
+        _native = False
+        return False
     try:
         lib = ctypes.CDLL(str(_SO))
         lib.ceph_tpu_crc32c.restype = ctypes.c_uint32

@@ -2663,51 +2663,67 @@ class RGWLite:
         retries = int(meta.get("max_retries", 5))
         sleep0 = float(meta.get("retry_sleep", 0.05))
         while True:
+            # cross-handle reconfiguration: another gateway sharing the
+            # pool may have replaced (or deleted) this topic — the
+            # worker re-reads the (5s-cached) meta and respawns itself
+            # with fresh attributes rather than pushing to a dead
+            # endpoint forever
+            fresh = await self._topic_meta(topic)
+            if fresh is None:
+                return                        # topic deleted
+            if fresh != meta:
+                if self._pushers.get(topic, (None,))[0] is \
+                        asyncio.current_task():
+                    self._pushers.pop(topic, None)
+                if fresh.get("push_endpoint"):
+                    self._ensure_pusher(topic, fresh)
+                return
             try:
                 batch = await self.topic_pull(topic, after=cursor)
                 events = batch["events"]
+                for e in events:
+                    payload = self._event_payload(
+                        topic, meta.get("opaque", ""), e)
+                    delivered = False
+                    for attempt in range(retries + 1):
+                        try:
+                            await ep.send(payload)
+                            delivered = True
+                            break
+                        except DeliveryError:
+                            if attempt < retries:  # no backoff after
+                                await asyncio.sleep(  # the last try
+                                    min(sleep0 * (2 ** attempt), 2.0))
+                    if not delivered:
+                        # dead-letter: park and move on so one dead
+                        # endpoint cannot wedge the topic forever.
+                        # The DL log allocates its own seq — the
+                        # original topic seq must not ride along or
+                        # it would clobber deadletter_pull's cursor
+                        parked = {k: v for k, v in e.items()
+                                  if k != "seq"}
+                        await self.ioctx.exec(
+                            oid + ".deadletter", "rgw", "log_add",
+                            json.dumps(parked).encode())
+                    cursor = int(e["seq"])
+                    # durable ack: a restarted worker resumes past
+                    # this event (at-least-once — a crash between
+                    # send and this write redelivers)
+                    await self.ioctx.set_xattr(
+                        oid, "push_cursor", str(cursor).encode())
             except RadosError as e:
                 if e.rc != -2:
-                    raise              # real failure, not an empty topic
-                events = []            # queue object not created yet
+                    # transient cluster trouble (failover, timeout):
+                    # the worker must survive it, not die with a
+                    # backlog — back off and retry
+                    await asyncio.sleep(1.0)
+                events = []            # rc=-2: queue not created yet
             if not events:
                 ev.clear()
                 try:
                     await asyncio.wait_for(ev.wait(), timeout=1.0)
                 except asyncio.TimeoutError:
                     pass
-                continue
-            for e in events:
-                payload = self._event_payload(
-                    topic, meta.get("opaque", ""), e)
-                delivered = False
-                for attempt in range(retries + 1):
-                    try:
-                        await ep.send(payload)
-                        delivered = True
-                        break
-                    except DeliveryError:
-                        if attempt < retries:   # no backoff after the
-                            await asyncio.sleep(   # last attempt
-                                min(sleep0 * (2 ** attempt), 2.0))
-                if not delivered:
-                    # dead-letter: park and move on so one dead
-                    # endpoint cannot wedge the topic forever; the
-                    # event stays inspectable via deadletter_pull
-                    # the DL log allocates its own seq: the event's
-                    # original topic seq must not ride along, or it
-                    # would clobber deadletter_pull's pagination cursor
-                    parked = {k: v for k, v in e.items()
-                              if k != "seq"}
-                    await self.ioctx.exec(
-                        oid + ".deadletter", "rgw", "log_add",
-                        json.dumps(parked).encode())
-                cursor = int(e["seq"])
-                # durable ack: a restarted worker resumes past this
-                # event (at-least-once — a crash between send and
-                # this write redelivers)
-                await self.ioctx.set_xattr(
-                    oid, "push_cursor", str(cursor).encode())
 
     async def deadletter_pull(self, topic: str, after: int = 0,
                               max_events: int = 1000) -> dict:

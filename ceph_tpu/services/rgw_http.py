@@ -266,6 +266,9 @@ class S3Frontend:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        # push workers outliving the rados client would loop against a
+        # shut-down connection (warnings + racing teardown writes)
+        await self.rgw.stop_push()
 
     # -- connection loop ---------------------------------------------------
     async def _client(self, reader: asyncio.StreamReader,

@@ -268,6 +268,9 @@ class DevCluster:
         ioctx = await rados.open_ioctx(pool)
         users = RGWUsers(ioctx)
         gw = RGWLite(ioctx, users=users)
+        # restart recovery: spawn push workers for topics with queued
+        # events so delivery never waits for new traffic
+        await gw.start_push()
         fe = S3Frontend(gw, users=users, host=host, port=port)
         await fe.start()
         fe._rados = rados
