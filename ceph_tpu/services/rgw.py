@@ -21,10 +21,13 @@ import time
 import zlib
 
 from ceph_tpu.common.compressor import get_compressor, list_compressors
+from ceph_tpu.common.log import Dout
 
 from ceph_tpu.client.rados import (IoCtx, ObjectOperation, RadosError,
                                    full_try)
 from ceph_tpu.client.striper import RadosStriper, StripeLayout
+
+rgw_log = Dout("rgw")
 
 BUCKETS_OID = "rgw.buckets"          # omap: bucket name -> meta
 STRIPE_THRESHOLD = 4 * 1024 * 1024
@@ -2662,44 +2665,69 @@ class RGWLite:
             cursor = 0
         retries = int(meta.get("max_retries", 5))
         sleep0 = float(meta.get("retry_sleep", 0.05))
+        down_sleep = sleep0                  # unreachable-endpoint backoff
         while True:
-            # cross-handle reconfiguration: another gateway sharing the
-            # pool may have replaced (or deleted) this topic — the
-            # worker re-reads the (5s-cached) meta and respawns itself
-            # with fresh attributes rather than pushing to a dead
-            # endpoint forever
-            fresh = await self._topic_meta(topic)
-            if fresh is None:
-                return                        # topic deleted
-            if fresh != meta:
-                if self._pushers.get(topic, (None,))[0] is \
-                        asyncio.current_task():
-                    self._pushers.pop(topic, None)
-                if fresh.get("push_endpoint"):
-                    self._ensure_pusher(topic, fresh)
-                return
             try:
+                # cross-handle reconfiguration: another gateway sharing
+                # the pool may have replaced (or deleted) this topic —
+                # re-read the (5s-cached) meta and respawn with fresh
+                # attributes rather than pushing to a dead endpoint
+                # forever
+                fresh = await self._topic_meta(topic)
+                if fresh is None:
+                    return                    # topic deleted
+                if fresh != meta:
+                    if self._pushers.get(topic, (None,))[0] is \
+                            asyncio.current_task():
+                        self._pushers.pop(topic, None)
+                    if fresh.get("push_endpoint"):
+                        self._ensure_pusher(topic, fresh)
+                    return
                 batch = await self.topic_pull(topic, after=cursor)
                 events = batch["events"]
                 for e in events:
                     payload = self._event_payload(
                         topic, meta.get("opaque", ""), e)
                     delivered = False
+                    rejected = False
                     for attempt in range(retries + 1):
                         try:
                             await ep.send(payload)
                             delivered = True
                             break
-                        except DeliveryError:
+                        except DeliveryError as de:
+                            rejected = de.connected
+                            if not de.connected:
+                                break   # dead endpoint: the outer
+                                        # down_sleep paces reconnects
                             if attempt < retries:  # no backoff after
                                 await asyncio.sleep(  # the last try
                                     min(sleep0 * (2 ** attempt), 2.0))
+                    if not delivered and not rejected:
+                        # UNREACHABLE endpoint (restart backlog before
+                        # the consumer is up, network partition): the
+                        # reference's persistent queues keep retrying
+                        # within retention rather than discarding —
+                        # hold position, back off, re-attempt later
+                        rgw_log.dout(
+                            5, "push %s: endpoint unreachable at seq "
+                            "%s; retrying in %.1fs", topic, e["seq"],
+                            down_sleep)
+                        await asyncio.sleep(down_sleep)
+                        down_sleep = min(down_sleep * 2, 5.0)
+                        break
+                    down_sleep = sleep0
                     if not delivered:
-                        # dead-letter: park and move on so one dead
-                        # endpoint cannot wedge the topic forever.
+                        # the endpoint ANSWERED and rejected through
+                        # every retry: dead-letter and move on so one
+                        # rejecting consumer cannot wedge the topic.
                         # The DL log allocates its own seq — the
                         # original topic seq must not ride along or
                         # it would clobber deadletter_pull's cursor
+                        rgw_log.derr(
+                            "push %s: endpoint rejected event seq %s "
+                            "%d times; dead-lettering", topic,
+                            e["seq"], retries + 1)
                         parked = {k: v for k, v in e.items()
                                   if k != "seq"}
                         await self.ioctx.exec(
@@ -2715,7 +2743,9 @@ class RGWLite:
                 if e.rc != -2:
                     # transient cluster trouble (failover, timeout):
                     # the worker must survive it, not die with a
-                    # backlog — back off and retry
+                    # backlog — back off, log, retry
+                    rgw_log.derr("push %s: rados error %s; backing "
+                                 "off", topic, e)
                     await asyncio.sleep(1.0)
                 events = []            # rc=-2: queue not created yet
             if not events:

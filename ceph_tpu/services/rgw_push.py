@@ -23,7 +23,15 @@ import urllib.parse
 
 
 class DeliveryError(Exception):
-    """One delivery attempt failed (connect/send/status)."""
+    """One delivery attempt failed.  ``connected`` distinguishes an
+    endpoint that ANSWERED and rejected (dead-letter material after
+    retries) from one that was unreachable (keep retrying with backoff
+    — the reference's persistent queues retry within the retention
+    window rather than discarding while a consumer is down)."""
+
+    def __init__(self, msg: str, connected: bool = False):
+        super().__init__(msg)
+        self.connected = connected
 
 
 async def _http_post(url: str, body: bytes,
@@ -52,7 +60,8 @@ async def _http_post(url: str, body: bytes,
         status_line = await asyncio.wait_for(reader.readline(), timeout)
         parts = status_line.split()
         if len(parts) < 2 or not parts[0].startswith(b"HTTP/"):
-            raise DeliveryError(f"bad status line {status_line!r}")
+            raise DeliveryError(f"bad status line {status_line!r}",
+                                connected=True)
         return int(parts[1])
     except DeliveryError:
         raise
@@ -95,4 +104,5 @@ class HTTPPushEndpoint(PushEndpoint):
     async def send(self, payload: bytes) -> None:
         status = await _http_post(self.url, payload, self.timeout)
         if self.ack_level != "none" and not 200 <= status < 300:
-            raise DeliveryError(f"endpoint answered {status}")
+            raise DeliveryError(f"endpoint answered {status}",
+                                connected=True)

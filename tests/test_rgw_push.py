@@ -146,6 +146,10 @@ def test_endpoint_down_then_up_and_durable_cursor():
             await gw.put_object("nb", "a", b"1")     # endpoint is DOWN
             await asyncio.sleep(0.3)
             assert recv.records == []
+            # an UNREACHABLE endpoint must not dead-letter: the worker
+            # holds position and keeps retrying (reference persistent-
+            # queue retention semantics)
+            assert (await gw.deadletter_pull("t2"))["events"] == []
             # bring the endpoint up on the reserved port mid-retry
             recv.port = port
             recv._server = await asyncio.start_server(
@@ -185,18 +189,21 @@ def test_endpoint_down_then_up_and_durable_cursor():
 
 
 def test_dead_letter_queue_and_topic_lifecycle():
-    """Exhausted retries park the event in <topic>.deadletter and the
-    worker moves on; delete_topic stops the worker and removes the
-    queues; unsupported schemes are rejected at create."""
+    """An endpoint that ANSWERS and rejects through every retry gets
+    the event dead-lettered and the worker moves on (an UNREACHABLE
+    endpoint is retried instead — see the down-then-up test);
+    delete_topic stops the worker and removes the queues; unsupported
+    schemes are rejected at create."""
     async def run():
         mon, osds, rados = await start_cluster()
         recv = await Receiver().start()
+        rejecter = await Receiver(fail_first=10 ** 9).start()
         try:
             gw, ioctx = await _gw(rados)
             await gw.create_bucket("nb")
-            # port 1 on localhost: connection always refused
             await gw.create_topic(
-                "dead", push_endpoint="http://127.0.0.1:1/",
+                "dead",
+                push_endpoint=f"http://127.0.0.1:{rejecter.port}/",
                 max_retries=1, retry_sleep=0.01)
             await gw.put_bucket_notification("nb", "dead")
             await gw.put_object("nb", "doomed", b"x")
@@ -231,5 +238,6 @@ def test_dead_letter_queue_and_topic_lifecycle():
             await gw.stop_push()
         finally:
             await recv.stop()
+            await rejecter.stop()
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
