@@ -568,7 +568,10 @@ class MDSDaemon:
                        "src_name": str(e["src_name"]),
                        "dst_parent": int(e["dst_parent"]),
                        "dst_name": str(e["dst_name"]), "ino": ino,
-                       "dentry": dict(e["dentry"]), "token": token}
+                       "dentry": dict(e["dentry"]), "token": token,
+                       "pre": e.get("pre"),
+                       "purge_ino": int(e.get("purge_ino", 0)),
+                       "purge_size": int(e.get("purge_size", 0))}
             else:                       # unlink_remote_intent
                 fin = {"op": "unlink_remote_finish",
                        "parent": int(e["parent"]),
@@ -1174,6 +1177,13 @@ class MDSDaemon:
                     )
                     self._auth_cache.clear()
                     self._ftree_cache.clear()
+                if int(e.get("anchor_ino", 0)):
+                    # hardlinked primary imported from another rank:
+                    # the anchor's primary pointer follows the inode
+                    # under the same commit claim (versioned write —
+                    # replay-safe from either rank's journal)
+                    await self._anchor_put(int(e["anchor_ino"]),
+                                           e.get("anchor"))
                 if int(e.get("purge_dir_ino", 0)):
                     await self._remove_dir_objects(
                         int(e["purge_dir_ino"]))
@@ -1207,12 +1217,19 @@ class MDSDaemon:
                 await self._anchor_put(int(e["ino"]),
                                        dict(e["anchor"]))
         elif op == "repoint_finish":
-            # remote-name rename, name half: move the remote dentry
+            # remote-name rename, name half: the replaced destination
+            # (if any) tears down FIRST — it rides inside this entry
+            # so an aborted repoint never unlinked it
+            if e.get("pre"):
+                await self._apply(dict(e["pre"]))
             await self._rm_dentry(int(e["src_parent"]),
                                   str(e["src_name"]))
             await self._set_dentry(int(e["dst_parent"]),
                                    str(e["dst_name"]),
                                    dict(e["dentry"]))
+            if int(e.get("purge_ino", 0)):
+                await self._purge_file(int(e["purge_ino"]),
+                                       int(e.get("purge_size", 0)))
         elif op == "import_link":
             # cross-rank link, destination half: the commit claim
             # gates the remote dentry exactly like import_dentry
@@ -1492,6 +1509,11 @@ class MDSDaemon:
         if dentry.get("remote"):
             rec = await self._anchor_get(ino)
             pp, pn, primary = await self._primary_of(ino, rec)
+            # the primary may be pinned by an in-flight two-phase
+            # protocol (cross-rank rename/repoint): mutating nlink or
+            # the anchor under it would clobber that protocol's
+            # absolute writes
+            self._guard_busy((pp, pn))
             primary = dict(primary)
             nl = int(primary.get("nlink", 1)) - 1
             primary["nlink"] = nl
@@ -1507,6 +1529,7 @@ class MDSDaemon:
         if nl > 1:
             rec = await self._anchor_get(ino)
             np, nn = int(rec["remotes"][0][0]), str(rec["remotes"][0][1])
+            self._guard_busy((np, nn))    # same pin rule as above
             promoted = dict(dentry)
             promoted["nlink"] = nl - 1
             new_rec = await self._anchor_next(
@@ -1955,11 +1978,7 @@ class MDSDaemon:
             failed = None
             for r in sorted(realm_ranks):
                 try:
-                    reply = await self._peer_request(
-                        r, {"op": "snap_refresh"}, timeout=5.0)
-                    if int(reply.get("rc", -1)) != 0:
-                        failed = (r, reply.get("err", "refused"))
-                        break
+                    await self._require_snap_adoption(r)
                 except MDSError as e:
                     failed = (r, str(e))
                     break
@@ -1972,6 +1991,17 @@ class MDSDaemon:
                     EXDEV, f"rank {failed[0]} could not adopt the "
                     f"snapshot ({failed[1]}); mksnap rolled back")
         return {"snapid": snapid, "snapc": self._snapc_wire()}
+
+    async def _require_snap_adoption(self, rank: int) -> None:
+        """Required snaptable-adoption push (shared by mksnap on
+        spanning realms and export-under-snapshot): the peer rank must
+        reload the shared snaptable NOW, or its next mutation under
+        the realm would skip the COW freeze.  Raises on any failure —
+        adoption is required, never best-effort."""
+        reply = await self._peer_request(rank, {"op": "snap_refresh"},
+                                         timeout=5.0)
+        if int(reply.get("rc", -1)) != 0:
+            raise MDSError(EXDEV, str(reply.get("err", "refused")))
 
     async def _req_snap_refresh(self, d: dict) -> dict:
         """Peer push after mksnap/rmsnap on a realm that spans our
@@ -1999,9 +2029,20 @@ class MDSDaemon:
         except RadosError as e:
             raise MDSError(ENOENT, f"no dir {ino:x}") \
                 if e.rc == ENOENT else e
-        if await self._covering_snaps(ino):
-            raise MDSError(
-                EINVAL, "cannot export a subtree under a live snapshot")
+        if rank != self.rank and await self._covering_snaps(ino):
+            # exporting under a LIVE snapshot (formerly declined): the
+            # importing rank must adopt the shared snaptable BEFORE
+            # authority moves, or its first post-import mutation under
+            # the realm would skip the COW freeze — the same required-
+            # adoption push mksnap uses for realms that already span
+            # ranks (round-4 snaptable adoption; MExportDir + snap
+            # realm open in the reference Migrator)
+            try:
+                await self._require_snap_adoption(rank)
+            except MDSError as e:
+                raise MDSError(
+                    EXDEV, f"rank {rank} could not adopt the live "
+                    f"snapshot ({e}); export declined")
         for bp, bn in self._busy_names:
             # a cross-rank rename in flight under the subtree holds
             # only its name pins across the peer RPC; exporting now
@@ -3303,6 +3344,14 @@ class MDSDaemon:
                 if dst["type"] != "dir":
                     raise MDSError(ENOTDIR, dn)
                 if int(dst["ino"]) == int(dentry["ino"]):
+                    if token and not (await self._rename_marker_state(
+                            token)).get("committed"):
+                        # FRESH request, not a retry: a same-ino dst
+                        # appeared — acking without committing would
+                        # make the source drop its name (orphan)
+                        raise MDSError(EEXIST,
+                                       f"{dn!r} already names the "
+                                       "inode")
                     return {"dentry": dst}  # retried import: done
                 if int(dst["ino"]) in self._subtrees:
                     raise MDSError(
@@ -3313,6 +3362,10 @@ class MDSDaemon:
             elif dst["type"] == "dir":
                 raise MDSError(EISDIR, dn)
             elif int(dst["ino"]) == int(dentry["ino"]):
+                if token and not (await self._rename_marker_state(
+                        token)).get("committed"):
+                    raise MDSError(EEXIST,
+                                   f"{dn!r} already names the inode")
                 return {"dentry": dst}      # retried import: done
             elif dst.get("remote") or int(dst.get("nlink", 1)) > 1:
                 # replaced hardlinked dst: the link-aware unlink rides
@@ -3334,7 +3387,9 @@ class MDSDaemon:
                  "ino": int(dentry["ino"]), "dentry": dentry,
                  "purge_ino": purge_ino, "purge_size": purge_size,
                  "purge_dir_ino": purge_dir_ino,
-                 "token": token, "pre": pre}
+                 "token": token, "pre": pre,
+                 "anchor": d.get("anchor"),
+                 "anchor_ino": int(d.get("anchor_ino", 0) or 0)}
         await self._journal(entry)
         await self._apply(entry)
         self._quota_invalidate()
@@ -3369,8 +3424,10 @@ class MDSDaemon:
         rollback decision, reference rename two-phase).  DIRECTORY
         renames ride the same protocol (authority follows the new
         ancestry chain; Migrator.h:50 rename-export role) behind the
-        invariant checks below; hardlinked renames still decline with
-        EXDEV — anchor authority is single-rank.
+        invariant checks below.  Hardlinked PRIMARY renames move too:
+        the versioned anchor's primary pointer rides the import under
+        its commit claim (r5); only REMOTE names headed to a third
+        rank still decline.
 
         Caller holds the mutate lock for THIS phase (validate +
         intent); it is released before the RPC and re-taken for the
@@ -3378,6 +3435,20 @@ class MDSDaemon:
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
         dentry = await self._get_dentry(sp, sn)
+        try:
+            dst0 = await self._get_dentry(dp, dn)
+        except MDSError as e:
+            if not e.missing_dentry and e.rc != ENOENT:
+                raise
+            dst0 = None
+        if dst0 is not None and \
+                int(dst0.get("ino", 0)) == int(dentry["ino"]):
+            # POSIX: renaming onto another name of the SAME inode does
+            # nothing — running the protocol would let the import's
+            # retried-request short-circuit ack without committing and
+            # the finish would then orphan the inode by dropping the
+            # source name
+            return {"noop": dict(dentry)}
         if dentry.get("type") == "dir":
             # cross-rank DIRECTORY rename: the same two-phase protocol
             # works because dirfrags live in shared RADOS — only the
@@ -3401,17 +3472,44 @@ class MDSDaemon:
             if await self._is_ancestor(ino_d, dp):
                 raise MDSError(EINVAL,
                                "cannot move a directory into itself")
-        elif dentry.get("remote") or int(dentry.get("nlink", 1)) > 1:
+        elif dentry.get("remote"):
+            # moving a REMOTE name into a third rank's directory would
+            # nest the anchor repoint (primary's rank) inside the
+            # dentry import (destination rank) — a three-party
+            # protocol; rename it within its own rank or unlink+relink
             raise MDSError(EXDEV,
-                           "hardlinked rename crosses a rank boundary")
+                           "moves a remote name across a rank "
+                           "boundary; rename within its rank or "
+                           "unlink + relink")
+        anchor = None
+        anchor_ino = 0
+        if int(dentry.get("nlink", 1)) > 1:
+            # hardlinked PRIMARY moving ranks (formerly declined): the
+            # anchor's primary pointer must follow the inode.  The
+            # versioned record (put-if-newer + tombstones) makes the
+            # write replay-safe from EITHER rank's journal, and the
+            # destination applies it under the same commit claim that
+            # gates the dentry — an aborted rename leaves the anchor
+            # untouched.  Remote names elsewhere stay valid: they
+            # resolve by ino through this record.
+            rec = await self._anchor_get(int(dentry["ino"]))
+            if rec is not None:
+                anchor_ino = int(dentry["ino"])
+                anchor = await self._anchor_next(anchor_ino, {
+                    "primary": [dp, dn],
+                    "remotes": [[int(r[0]), str(r[1])]
+                                for r in rec.get("remotes", ())],
+                })
         token = secrets.token_hex(8)
         intent = {"op": "rename_export_intent", "src_parent": sp,
                   "src_name": sn, "dst_parent": dp, "dst_name": dn,
                   "ino": int(dentry["ino"]), "dentry": dentry,
-                  "token": token}
+                  "token": token, "anchor": anchor,
+                  "anchor_ino": anchor_ino}
         await self._journal(intent)
         self._busy_names.add((sp, sn))
-        return {"_phase2": (d, dst_rank, token, dentry)}
+        return {"_phase2": (d, dst_rank, token, dentry, anchor,
+                            anchor_ino)}
 
     async def _two_phase_finish(self, dst_rank: int, payload: dict,
                                 token: str, abort_entry: dict,
@@ -3456,13 +3554,15 @@ class MDSDaemon:
     async def _rename_cross_rank_finish(self, phase1: dict) -> dict:
         """Phases 2+3: peer RPC WITHOUT the mutate lock, then the
         journaled finish/abort under it (caller manages locks)."""
-        d, dst_rank, token, dentry = phase1["_phase2"]
+        (d, dst_rank, token, dentry, anchor,
+         anchor_ino) = phase1["_phase2"]
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
         reply = await self._two_phase_finish(
             dst_rank,
             {"op": "import_dentry", "parent": dp, "name": dn,
-             "dentry": dentry, "token": token},
+             "dentry": dentry, "token": token,
+             "anchor": anchor, "anchor_ino": anchor_ino},
             token,
             {"op": "rename_export_abort", "src_parent": sp,
              "src_name": sn, "ino": int(dentry["ino"]),
@@ -3496,12 +3596,20 @@ class MDSDaemon:
                     return result
             else:
                 phase1 = await self._rename_cross_rank(d, dst_rank)
+                if "noop" in phase1:
+                    # POSIX rename between two names of one inode
+                    return {"dentry": phase1["noop"]}
         if repoint is not None:
+            if isinstance(repoint, dict) and "noop" in repoint:
+                # POSIX rename between two names of one inode
+                return {"dentry": repoint["noop"]}
             try:
                 return await self._repoint_remote_finish(repoint)
             finally:
                 self._busy_names.discard((sp, sn))
                 self._busy_names.discard((dp, dn))
+                for pin in repoint[-1]:
+                    self._busy_names.discard(pin)
         try:
             return await self._rename_cross_rank_finish(phase1)
         finally:
@@ -3512,9 +3620,10 @@ class MDSDaemon:
         rank (round-3 weak #5): the anchor repoint runs as a claim-
         gated peer op on the primary's rank, then the name moves here.
         Returns the phase-1 state, or None for every other rename
-        shape (caller holds the mutate lock).  Replacing an existing
-        destination stays declined — it would nest a second link
-        teardown inside the repoint."""
+        shape (caller holds the mutate lock).  A destination with a
+        LOCAL teardown is replaced (the plan rides the claim-gated
+        finish, r5); only a destination needing its own foreign-rank
+        teardown still declines."""
         sp, sn = int(d["src_parent"]), str(d["src_name"])
         dp, dn = int(d["dst_parent"]), str(d["dst_name"])
         if (sp, sn) == (dp, dn):
@@ -3530,26 +3639,58 @@ class MDSDaemon:
         prim_rank = await self._auth_rank(pp)
         if prim_rank == self.rank:
             return None                  # same-rank path handles it
+        # rename-REPLACING while repointing (formerly declined): a
+        # destination whose teardown is LOCAL rides inside the
+        # claim-gated finish entry, exactly like import_dentry's
+        # ``pre`` — an aborted repoint must not have unlinked it.  A
+        # destination needing its OWN foreign-rank teardown still
+        # declines (_plan_unlink_guard): that would nest a second
+        # two-phase protocol inside this one.
+        purge_ino = purge_size = 0
+        pre = None
         try:
-            await self._get_dentry(dp, dn)
-            raise MDSError(
-                EXDEV, "replaces a name while repointing a "
-                "cross-rank link; unlink the destination first")
+            dst = await self._get_dentry(dp, dn)
         except MDSError as e:
             if not e.missing_dentry:
                 raise
+            dst = None
+        if dst is not None:
+            if dst.get("type") == "dir":
+                raise MDSError(EISDIR, dn)
+            if int(dst.get("ino", 0)) == ino:
+                # POSIX: renaming between two names of the same inode
+                # does nothing (both names stay)
+                return {"noop": dict(dentry)}
+            await self._plan_unlink_guard(dst)
+            if dst.get("remote") or int(dst.get("nlink", 1)) > 1:
+                pre = await self._unlink_plan(dp, dn, dst)
+            else:
+                purge_ino = int(dst["ino"])
+                purge_size = int(dst.get("size", 0))
+        # the replaced destination's teardown plan holds ABSOLUTE
+        # nlink/anchor values: the names it touches must stay pinned
+        # across the unlocked RPC window or a concurrent link/unlink
+        # on them would be clobbered at finish
+        extra_pins = []
+        if pre is not None and pre["op"] == "unlink_remote":
+            extra_pins.append((int(pre["pp"]), str(pre["pn"])))
+        elif pre is not None and pre["op"] == "promote_link":
+            extra_pins.append((int(pre["np"]), str(pre["nn"])))
         token = secrets.token_hex(8)
         await self._journal({
             "op": "repoint_intent", "src_parent": sp, "src_name": sn,
             "dst_parent": dp, "dst_name": dn, "ino": ino,
-            "dentry": dict(dentry), "token": token})
+            "dentry": dict(dentry), "token": token, "pre": pre,
+            "purge_ino": purge_ino, "purge_size": purge_size})
         self._busy_names.add((sp, sn))
         self._busy_names.add((dp, dn))
+        self._busy_names.update(extra_pins)
         return (token, prim_rank, pp, ino, sp, sn, dp, dn,
-                dict(dentry))
+                dict(dentry), pre, purge_ino, purge_size, extra_pins)
 
     async def _repoint_remote_finish(self, phase1) -> dict:
-        (token, prim_rank, pp, ino, sp, sn, dp, dn, dentry) = phase1
+        (token, prim_rank, pp, ino, sp, sn, dp, dn, dentry,
+         pre, purge_ino, purge_size, extra_pins) = phase1
         await self._two_phase_finish(
             prim_rank,
             {"op": "repoint_remote", "parent": pp, "ino": ino,
@@ -3558,7 +3699,9 @@ class MDSDaemon:
             {"op": "repoint_abort", "ino": ino, "token": token},
             {"op": "repoint_finish", "src_parent": sp,
              "src_name": sn, "dst_parent": dp, "dst_name": dn,
-             "ino": ino, "dentry": dentry, "token": token},
+             "ino": ino, "dentry": dentry, "token": token,
+             "pre": pre, "purge_ino": purge_ino,
+             "purge_size": purge_size},
             "primary rank unreachable; rename rolled back")
         self._quota_invalidate()
         return {"dentry": dentry}

@@ -265,7 +265,7 @@ def test_dir_rename_intent_crash_repair():
                  "dst_name": "limbo"}
             async with mds_a._mutate:
                 phase1 = await mds_a._rename_cross_rank(d, 1)
-            _, _, token, dentry = phase1["_phase2"]
+            _, _, token, dentry, _, _ = phase1["_phase2"]
             reply = await mds_a._peer_request(1, {
                 "op": "import_dentry",
                 "parent": d["dst_parent"], "name": "limbo",
@@ -422,6 +422,133 @@ def test_promote_export_intent_crash_repair():
             assert await fs.read_file("/name") == b"payload"
             assert int((await fs.stat("/name"))["nlink"]) == 2
             assert await fs.read_file("/shared/back") == b"payload"
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_repoint_replace_destination():
+    """Rename-REPLACING a name of a cross-rank link (formerly EXDEV):
+    a destination whose teardown is local rides inside the claim-gated
+    repoint finish — plain files purge, local hardlink names run the
+    link-aware unlink; a destination needing its OWN foreign-rank
+    teardown still declines."""
+    async def run():
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            # primary on rank 1, remote names on rank 0
+            await fs.write_file("/shared/prim", b"payload")
+            await fs.link("/shared/prim", "/rl")
+            await fs.link("/shared/prim", "/rl2")
+
+            # plain-file destination: replaced + purged
+            await fs.write_file("/victim", b"doomed")
+            await fs.rename("/rl", "/victim")
+            fs._dcache.clear()
+            assert await fs.read_file("/victim") == b"payload"
+            with pytest.raises(FSError):
+                await fs.stat("/rl")
+            st = await fs.stat("/shared/prim")
+            assert int(st["nlink"]) == 3       # prim + victim + rl2
+
+            # destination that is one name of a LOCAL hardlink pair:
+            # the link-aware unlink rides the finish (the other name
+            # keeps the data)
+            await fs.write_file("/h1", b"h-data")
+            await fs.link("/h1", "/h2")
+            await fs.rename("/rl2", "/h2")
+            fs._dcache.clear()
+            assert await fs.read_file("/h2") == b"payload"
+            assert await fs.read_file("/h1") == b"h-data"
+            assert int((await fs.stat("/h1"))["nlink"]) == 1
+
+            # destination that is a remote of ANOTHER cross-rank link:
+            # its teardown would need the foreign primary's rank —
+            # still declined
+            await fs.write_file("/shared/p2", b"other")
+            await fs.link("/shared/p2", "/r3")
+            await fs.link("/shared/prim", "/rl4")
+            with pytest.raises(FSError) as ei:
+                await fs.rename("/rl4", "/r3")
+            assert ei.value.rc == EXDEV
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_repoint_replace_crash_repair():
+    """Crash between the primary rank's commit and the name rank's
+    finish with a replaced destination pending: repair completes the
+    finish INCLUDING the destination teardown and purge."""
+    async def run():
+        from ceph_tpu.mds.daemon import ROOT_INO
+
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.write_file("/shared/prim", b"payload")
+            await fs.link("/shared/prim", "/rl")
+            await fs.write_file("/victim", b"doomed")
+            d = {"src_parent": ROOT_INO, "src_name": "rl",
+                 "dst_parent": ROOT_INO, "dst_name": "victim"}
+            async with mds_a._mutate:
+                phase1 = await mds_a._maybe_repoint_remote(d)
+            assert phase1 is not None and not isinstance(phase1, dict)
+            (token, prim_rank, pp, ino, sp, sn, dp, dn, dentry,
+             pre, purge_ino, purge_size, extra_pins) = phase1
+            assert purge_ino                  # plain dst: purge path
+            reply = await mds_a._peer_request(prim_rank, {
+                "op": "repoint_remote", "parent": pp, "ino": ino,
+                "old": [sp, sn], "new": [dp, dn], "token": token})
+            assert reply.get("rc") == 0
+            mds_a._busy_names.discard((sp, sn))
+            mds_a._busy_names.discard((dp, dn))
+            # simulated crash before the finish: repair completes it
+            await mds_a._resync()
+            fs._dcache.clear()
+            assert await fs.read_file("/victim") == b"payload"
+            with pytest.raises(FSError):
+                await fs.stat("/rl")
+            rec = await mds_a._anchor_get(ino)
+            assert [dp, dn] in [list(r) for r in rec["remotes"]]
+        finally:
+            await _teardown(cluster, rados, fs)
+    asyncio.run(run())
+
+
+def test_hardlinked_primary_move_crash_repair():
+    """Crash after the destination imported a hardlinked PRIMARY (its
+    anchor update rides the same commit claim) but before the source
+    finish: repair drops the source name; the remote keeps resolving
+    through the moved primary."""
+    async def run():
+        from ceph_tpu.mds.daemon import ROOT_INO
+
+        cluster, mds_a, mds_b, rados, fs = await _two_rank_cluster()
+        try:
+            await fs.write_file("/hp", b"hp-data")
+            await fs.link("/hp", "/hp2")
+            dp = int((await fs.stat("/shared"))["ino"])
+            d = {"src_parent": ROOT_INO, "src_name": "hp",
+                 "dst_parent": dp, "dst_name": "hp-m"}
+            async with mds_a._mutate:
+                phase1 = await mds_a._rename_cross_rank(d, 1)
+            (_, _, token, dentry, anchor,
+             anchor_ino) = phase1["_phase2"]
+            assert anchor_ino and anchor is not None
+            reply = await mds_a._peer_request(1, {
+                "op": "import_dentry", "parent": dp, "name": "hp-m",
+                "dentry": dentry, "token": token,
+                "anchor": anchor, "anchor_ino": anchor_ino})
+            assert reply.get("rc") == 0
+            mds_a._busy_names.discard((ROOT_INO, "hp"))
+            await mds_a._resync()
+            fs._dcache.clear()
+            assert await fs.read_file("/shared/hp-m") == b"hp-data"
+            assert await fs.read_file("/hp2") == b"hp-data"
+            with pytest.raises(FSError):
+                await fs.stat("/hp")
+            rec = await mds_a._anchor_get(anchor_ino)
+            assert list(rec["primary"]) == [dp, "hp-m"]
         finally:
             await _teardown(cluster, rados, fs)
     asyncio.run(run())

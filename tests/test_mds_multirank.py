@@ -141,13 +141,21 @@ def test_two_ranks_serve_disjoint_subtrees():
         assert await fs.read_file("/rootlink") == b"x"
         await fs.unlink("/rootlink")       # remote side teardown
         assert await fs.read_file("/shared/lfile") == b"x"
-        # hardlinked files still decline the cross-rank RENAME path
-        # (anchor repoint would span ranks)
+        # hardlinked PRIMARY renames cross ranks too (r5): the anchor's
+        # primary pointer follows the inode under the import's commit
+        # claim, and the remote name keeps resolving
         await fs.write_file("/hl-a", b"hl")
         await fs.link("/hl-a", "/hl-b")
-        with pytest.raises(FSError) as ei:
-            await fs.rename("/hl-a", "/shared/hl-moved")
-        assert ei.value.rc == -18
+        await fs.rename("/hl-a", "/shared/hl-moved")
+        fs._dcache.clear()
+        assert await fs.read_file("/shared/hl-moved") == b"hl"
+        assert await fs.read_file("/hl-b") == b"hl"     # via anchor
+        with pytest.raises(FSError):
+            await fs.stat("/hl-a")
+        # the link teardown still works after the move: dropping the
+        # remote leaves the moved primary; its data survives
+        await fs.unlink("/hl-b")
+        assert await fs.read_file("/shared/hl-moved") == b"hl"
         # export root removal is refused while delegated
         with pytest.raises(FSError) as ei:
             await fs.rename("/shared", "/renamed")
@@ -209,10 +217,19 @@ def test_snapshot_rank_boundary_rules():
         await fs.mksnap("/solo", "ok")
         await fs.write_file("/solo/f", b"v2")
         assert await fs.read_file("/solo/.snap/ok/f") == b"v1"
-        # and exporting under a live snapshot is refused
-        with pytest.raises(FSError) as ei:
-            await fs.export_dir("/solo", 1)
-        assert ei.value.rc == -22
+        # exporting under a live snapshot ADOPTS (r5): the importing
+        # rank refreshes the snaptable before authority moves, so
+        # post-export mutations COW-freeze and the snap view keeps
+        # reading as-of-snap state across the boundary
+        await fs.export_dir("/solo", 1)
+        assert mds_b.snaps, "importing rank did not adopt the snap"
+        await fs.write_file("/solo/f", b"v3")       # rank-1 mutation
+        await fs.write_file("/solo/g", b"new")
+        fs._dcache.clear()
+        assert await fs.read_file("/solo/.snap/ok/f") == b"v1"
+        assert sorted(await fs.readdir("/solo/.snap/ok")) == ["f"]
+        assert await fs.read_file("/solo/f") == b"v3"
+        await fs.rmsnap("/solo", "ok")
         await _teardown(cluster, rados, fs)
     asyncio.run(run())
 
