@@ -199,3 +199,174 @@ class ConfigKeyKMS(_MasterKeyKMS):
             await self.create_key(key_id)
             return 1
         return int(cur)
+
+
+class VaultKMS(_MasterKeyKMS):
+    """HashiCorp-Vault KV-v2 backend (reference rgw_kms.cc
+    VaultSecretEngine, rgw_crypt_vault_* options): master-key versions
+    are KV-v2 secret versions under ``<mount>/data/<prefix>/<key_id>``
+    with ``{"data": {"key": <hex>}}`` payloads, authenticated by the
+    ``X-Vault-Token`` header.  Rotation writes a NEW secret version
+    (Vault KV auto-increments); every old version stays readable with
+    ``?version=N``, which is what keeps pre-rotation objects
+    decryptable.  Speaks plain HTTP/1.1 over asyncio (the reference
+    shells out to libcurl the same way)."""
+
+    def __init__(self, addr: str, token: str,
+                 mount: str = "secret", prefix: str = "rgw",
+                 timeout: float = 5.0):
+        self.addr = addr.rstrip("/")
+        self.token = token
+        self.mount = mount.strip("/")
+        self.prefix = prefix.strip("/")
+        self.timeout = timeout
+
+    def _data_path(self, key_id: str) -> str:
+        return f"/v1/{self.mount}/data/{self.prefix}/{key_id}"
+
+    async def _request(self, method: str, path: str,
+                       body: dict | None = None) -> tuple[int, dict]:
+        import asyncio
+        import json as _json
+        import ssl as ssl_mod
+        import urllib.parse
+
+        u = urllib.parse.urlsplit(self.addr + path)
+        host, port = u.hostname or "", u.port or 8200
+        # production Vault is TLS-only: an https:// address MUST get a
+        # TLS socket, or the X-Vault-Token would cross in cleartext
+        ctx = ssl_mod.create_default_context() \
+            if u.scheme == "https" else None
+        payload = _json.dumps(body).encode() if body is not None \
+            else b""
+        writer = None
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port, ssl=ctx),
+                self.timeout)
+            target = u.path + (f"?{u.query}" if u.query else "")
+            req = (f"{method} {target} HTTP/1.1\r\n"
+                   f"Host: {host}\r\n"
+                   f"X-Vault-Token: {self.token}\r\n"
+                   "Content-Type: application/json\r\n"
+                   f"Content-Length: {len(payload)}\r\n"
+                   "Connection: close\r\n\r\n").encode() + payload
+            writer.write(req)
+            await asyncio.wait_for(writer.drain(), self.timeout)
+            status_line = await asyncio.wait_for(reader.readline(),
+                                                self.timeout)
+            status = int(status_line.split()[1])
+            length = None
+            chunked = False
+            while True:
+                line = await asyncio.wait_for(reader.readline(),
+                                              self.timeout)
+                if not line or line == b"\r\n":
+                    break
+                low = line.lower()
+                if low.startswith(b"content-length:"):
+                    length = int(line.split(b":")[1])
+                elif low.startswith(b"transfer-encoding:") and \
+                        b"chunked" in low:
+                    chunked = True
+            if chunked:
+                # real Vault (Go net/http) chunks larger responses;
+                # treating them as empty would turn existing keys
+                # into 'malformed vault secret' errors
+                raw = b""
+                while True:
+                    szline = await asyncio.wait_for(
+                        reader.readline(), self.timeout)
+                    size = int(szline.split(b";")[0], 16)
+                    if size == 0:
+                        await asyncio.wait_for(reader.readline(),
+                                               self.timeout)
+                        break
+                    raw += await asyncio.wait_for(
+                        reader.readexactly(size), self.timeout)
+                    await asyncio.wait_for(reader.readexactly(2),
+                                           self.timeout)
+            elif length:
+                raw = await asyncio.wait_for(
+                    reader.readexactly(length), self.timeout)
+            elif length is None:
+                # Connection: close with neither header: body runs
+                # to EOF
+                raw = await asyncio.wait_for(reader.read(),
+                                             self.timeout)
+            else:
+                raw = b"{}"
+            try:
+                return status, _json.loads(raw or b"{}")
+            except ValueError:
+                return status, {}
+        except (OSError, ValueError, IndexError,
+                asyncio.TimeoutError) as e:
+            raise KMSError(f"vault {method} {path}: {e}") from e
+        finally:
+            if writer is not None:
+                writer.close()
+                try:
+                    await writer.wait_closed()
+                except OSError:
+                    pass
+
+    async def create_key(self, key_id: str) -> None:
+        status, _ = await self._request("GET", self._data_path(key_id))
+        if status == 200:
+            return                      # already exists
+        if status == 403:
+            raise KMSError("vault permission denied")
+        if status != 404:
+            raise KMSError(f"vault answered {status}")
+        status, _ = await self._request(
+            "POST", self._data_path(key_id),
+            {"data": {"key": secrets.token_bytes(32).hex()}})
+        if status not in (200, 204):
+            raise KMSError(f"vault key create answered {status}")
+
+    async def rotate_key(self, key_id: str) -> int:
+        status, out = await self._request("GET",
+                                          self._data_path(key_id))
+        if status != 200:
+            raise KMSError(f"no such key {key_id!r} ({status})")
+        status, out = await self._request(
+            "POST", self._data_path(key_id),
+            {"data": {"key": secrets.token_bytes(32).hex()}})
+        if status not in (200, 204):
+            raise KMSError(f"vault rotate answered {status}")
+        return int(out.get("data", {}).get("version", 0))
+
+    async def list_keys(self) -> list[str]:
+        status, out = await self._request(
+            "LIST", f"/v1/{self.mount}/metadata/{self.prefix}")
+        if status != 200:
+            return []
+        return sorted(out.get("data", {}).get("keys", ()))
+
+    async def _master(self, key_id: str, version: int) -> bytes:
+        status, out = await self._request(
+            "GET", self._data_path(key_id) + f"?version={version}")
+        if status != 200:
+            raise KMSError(f"no key {key_id!r} v{version} ({status})")
+        try:
+            return bytes.fromhex(out["data"]["data"]["key"])
+        except (KeyError, ValueError) as e:
+            raise KMSError(f"malformed vault secret: {e}") from e
+
+    async def _current_version(self, key_id: str,
+                               create: bool = False) -> int:
+        status, out = await self._request("GET",
+                                          self._data_path(key_id))
+        if status != 200:
+            if not create:
+                raise KMSError(f"no such key {key_id!r}")
+            await self.create_key(key_id)
+            status, out = await self._request(
+                "GET", self._data_path(key_id))
+            if status != 200:
+                raise KMSError(f"vault key create raced ({status})")
+        try:
+            return int(out["data"]["metadata"]["version"])
+        except (KeyError, ValueError) as e:
+            raise KMSError(f"malformed vault secret: {e}") from e

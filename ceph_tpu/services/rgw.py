@@ -2864,6 +2864,12 @@ class RGWLite:
                 raise
         await self.ioctx.rm_omap_keys(BUCKETS_OID, [bucket])
 
+    async def head_bucket(self, bucket: str) -> dict:
+        """S3 HeadBucket: existence + access probe without pulling the
+        whole bucket table or index (the rgw_file facade's per-call
+        liveness check)."""
+        return await self._check_bucket(bucket, "READ")
+
     async def list_buckets(self) -> list[str]:
         try:
             return sorted(await self.ioctx.get_omap(BUCKETS_OID))

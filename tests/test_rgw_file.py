@@ -1,0 +1,144 @@
+"""NFS-style file facade over RGW (reference rgw_file.cc / librgw +
+nfs-ganesha FSAL_RGW role): buckets as top-level directories, '/'
+separated keys as paths, explicit marker-object directories, readdir
+over delimiter listings, copy+unlink renames."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rgw import RGWLite
+from ceph_tpu.services.rgw_file import (EEXIST, EISDIR, ENOENT,
+                                        ENOTEMPTY, FSError,
+                                        RGWFileSystem)
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def test_rgw_file_namespace_round_trip():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgwf", pg_num=8)
+            ioctx = await rados.open_ioctx("rgwf")
+            fs = RGWFileSystem(RGWLite(ioctx))
+
+            # buckets are root directories
+            await fs.mkdir("/exports")
+            assert (await fs.getattr("/exports"))["type"] == "dir"
+            assert await fs.readdir("/") == {"exports": {"type": "dir"}}
+            with pytest.raises(FSError) as ei:
+                await fs.getattr("/nosuch")
+            assert ei.value.errno == ENOENT
+
+            # nested dirs via marker objects; parents enforced
+            await fs.mkdir("/exports/a")
+            await fs.mkdir("/exports/a/b")
+            with pytest.raises(FSError) as ei:
+                await fs.mkdir("/exports/x/y")
+            assert ei.value.errno == ENOENT
+            with pytest.raises(FSError) as ei:
+                await fs.mkdir("/exports/a")
+            assert ei.value.errno == EEXIST
+
+            # files: write / read / partial read / offset RMW
+            await fs.write("/exports/a/hello.txt", b"hello world")
+            st = await fs.getattr("/exports/a/hello.txt")
+            assert st["type"] == "file" and st["size"] == 11
+            assert await fs.read("/exports/a/hello.txt") == \
+                b"hello world"
+            assert await fs.read("/exports/a/hello.txt", 6, 5) == \
+                b"world"
+            await fs.write("/exports/a/hello.txt", b"WORLD", offset=6)
+            assert await fs.read("/exports/a/hello.txt") == \
+                b"hello WORLD"
+            await fs.write("/exports/a/hello.txt", b"!", offset=11)
+            assert await fs.read("/exports/a/hello.txt") == \
+                b"hello WORLD!"
+
+            # readdir: dirs + files, marker object hidden
+            await fs.write("/exports/a/b/deep.bin", b"x" * 100)
+            listing = await fs.readdir("/exports/a")
+            assert listing == {
+                "b": {"type": "dir"},
+                "hello.txt": {"type": "file", "size": 12,
+                              "mtime": listing["hello.txt"]["mtime"]},
+            }
+            assert sorted(await fs.readdir("/exports")) == ["a"]
+
+            # type confusion guards
+            with pytest.raises(FSError) as ei:
+                await fs.readdir("/exports/a/hello.txt")
+            assert ei.value.errno == -20          # ENOTDIR
+            with pytest.raises(FSError) as ei:
+                await fs.unlink("/exports/a/b")
+            assert ei.value.errno == EISDIR
+
+            # rmdir: refuses non-empty, works when emptied
+            with pytest.raises(FSError) as ei:
+                await fs.rmdir("/exports/a")
+            assert ei.value.errno == ENOTEMPTY
+            await fs.unlink("/exports/a/b/deep.bin")
+            await fs.rmdir("/exports/a/b")
+            fresh = await fs.readdir("/exports/a")
+            assert sorted(fresh) == ["hello.txt"]
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
+
+
+def test_rgw_file_rename_and_statfs():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            await rados.pool_create("rgwf", pg_num=8)
+            ioctx = await rados.open_ioctx("rgwf")
+            gw = RGWLite(ioctx)
+            fs = RGWFileSystem(gw)
+            await fs.mkdir("/vol")
+            await fs.mkdir("/vol/src")
+            await fs.write("/vol/src/f1", b"one")
+            await fs.write("/vol/src/f2", b"two-two")
+
+            # file rename within and across directories
+            await fs.rename("/vol/src/f1", "/vol/src/renamed")
+            assert await fs.read("/vol/src/renamed") == b"one"
+            with pytest.raises(FSError):
+                await fs.getattr("/vol/src/f1")
+
+            # directory rename moves every member (marker included)
+            await fs.rename("/vol/src", "/vol/dst")
+            assert sorted(await fs.readdir("/vol/dst")) == \
+                ["f2", "renamed"]
+            with pytest.raises(FSError):
+                await fs.getattr("/vol/src")
+            assert await fs.read("/vol/dst/f2") == b"two-two"
+
+            # the facade is just a view: the same objects serve S3
+            s3 = await gw.list_objects("vol", prefix="dst/")
+            assert {c["key"] for c in s3["contents"]} == \
+                {"dst/", "dst/f2", "dst/renamed"}
+
+            stat = await fs.statfs()
+            assert stat["files"] >= 2 and stat["bytes"] == \
+                len(b"one") + len(b"two-two")
+
+            # empty-bucket rmdir
+            await fs.unlink("/vol/dst/f2")
+            await fs.unlink("/vol/dst/renamed")
+            await fs.rmdir("/vol/dst")
+            with pytest.raises(FSError) as ei:
+                await fs.rmdir("/nosuchbucket")
+            assert ei.value.errno == ENOENT
+            await fs.rmdir("/vol")
+            assert await fs.readdir("/") == {}
+        finally:
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
