@@ -95,6 +95,18 @@ class Dashboard:
             elif path == "/api/pool":
                 body = json.dumps(await self._pool_list()).encode()
                 ctype, status = "application/json", 200
+            elif path in self._GET_MON_ROUTES:
+                prefix, kw = self._GET_MON_ROUTES[path]
+                data = await self._mon(prefix, **kw)
+                if data is None:
+                    # a mon outage/election must read as a failed
+                    # poll, not a successful empty one
+                    body = json.dumps(
+                        {"error": "mon command failed"}).encode()
+                    ctype, status = "application/json", 503
+                else:
+                    body = json.dumps(data).encode()
+                    ctype, status = "application/json", 200
             elif path == "/metrics":
                 # collect() messages every OSD; cache briefly so an
                 # aggressive scraper doesn't multiply cluster traffic
@@ -134,6 +146,20 @@ class Dashboard:
                 await writer.wait_closed()
             except (ConnectionError, OSError):
                 pass
+
+    # read-only resource routes (the restful module's GET surface):
+    # each maps straight onto one paxos-consistent mon command
+    _GET_MON_ROUTES = {
+        "/api/health": ("health", {}),
+        "/api/mon": ("mon dump", {}),
+        "/api/quorum": ("quorum_status", {}),
+        "/api/df": ("df", {}),
+        "/api/osd_df": ("osd df", {}),
+        "/api/pg": ("pg stat", {}),
+        "/api/fs": ("fs status", {}),
+        "/api/crush": ("osd tree", {}),
+        "/api/log": ("log last", {"num": 100}),
+    }
 
     # -- management API (restful module + dashboard write surface) ---------
     def _authorized(self, headers: dict) -> bool:
@@ -229,12 +255,16 @@ class Dashboard:
 
     async def _status(self) -> dict:
         out: dict = {"ts": time.time()}
-        # the five mon reads are independent: fetch them concurrently
+        # seven mon reads, all independent: fetch concurrently ("df"
+        # is NOT fetched — its payload is the mgr digest this process
+        # already holds in last_digest)
         (out["status"], out["health"], out["osd_tree"], out["mds"],
-         logs) = await asyncio.gather(
+         logs, out["fs"], out["quorum"]) = \
+            await asyncio.gather(
             self._mon("status"), self._mon("health"),
             self._mon("osd tree"), self._mon("mds stat"),
-            self._mon("log last", num=50))
+            self._mon("log last", num=50),
+            self._mon("fs status"), self._mon("quorum_status"))
         out["log"] = logs or []
         digest = getattr(self.mgr, "last_digest", None) or {}
         out["pgmap"] = {
@@ -293,6 +323,31 @@ class Dashboard:
                  str(p.get("num_bytes", 0)), str(p.get("degraded", 0))]
                 for pid, p in sorted(pools.items(),
                                      key=lambda kv: str(kv[0]))
+            ]))
+
+        section("Capacity",
+                f"<p>{pg.get('num_bytes', 0)} bytes stored in "
+                f"{pg.get('num_objects', 0)} objects</p>")
+
+        fsmap = s.get("fs") or {}
+        fs_rows = []
+        for fsname, info in sorted(fsmap.items()):
+            if not isinstance(info, dict):
+                continue
+            ranks = ", ".join(
+                f"{r.get('rank')}:{r.get('name')}({r.get('state')})"
+                for r in info.get("ranks", ()))
+            fs_rows.append([esc(str(fsname)), esc(ranks),
+                            esc(str(info.get("standbys", ""))),
+                            esc(str(info.get("down", "")))])
+        if fs_rows:
+            section("Filesystems", table(
+                ["fs", "ranks", "standbys", "down"], fs_rows))
+
+        q = s.get("quorum") or {}
+        if q:
+            section("Monitors", table(["", ""], [
+                [esc(k), esc(str(v))] for k, v in sorted(q.items())
             ]))
 
         tree = s.get("osd_tree") or {}

@@ -236,3 +236,60 @@ def test_dashboard_write_surface():
         finally:
             await cluster.stop()
     asyncio.run(run())
+
+
+def test_dashboard_resource_routes_and_sections():
+    """The restful GET surface (health/mon/quorum/df/pg/fs/crush/log/
+    osd_df) and the page's capacity/monitor sections."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            rados = await cluster.client()
+            r = await rados.mon_command("osd pool create", pool="dd",
+                                        pg_num=8, size=3)
+            assert r["rc"] == 0, r
+            io = await rados.open_ioctx("dd")
+            await io.write_full("obj1", b"y" * 2000)
+            mgr = await cluster.start_mgr()
+            deadline = asyncio.get_running_loop().time() + 20
+            while not (mgr.last_digest or {}).get("num_pgs"):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.2)
+            dash = Dashboard(mgr)
+            host, port = await dash.start()
+
+            async def jget(path):
+                st, body = await _http_get(host, port, path)
+                assert st == 200, (path, st)
+                return json.loads(body)
+
+            health = await jget("/api/health")
+            assert health["status"].startswith("HEALTH_")
+            mons = await jget("/api/mon")
+            assert "a" in mons["mons"]
+            quorum = await jget("/api/quorum")
+            assert quorum["leader"] is not None
+            df = await jget("/api/df")
+            pools = {str(p.get("name")) for p in df["pools"].values()}
+            assert "dd" in pools
+            pg = await jget("/api/pg")
+            assert pg                         # pg stat digest present
+            crush = await jget("/api/crush")
+            assert crush.get("nodes")
+            logs = await jget("/api/log")
+            assert isinstance(logs, list)
+            osd_df = await jget("/api/osd_df")
+            assert osd_df is not None
+            fs = await jget("/api/fs")
+            assert fs == {} or isinstance(fs, dict)
+
+            st, page = await _http_get(host, port, "/")
+            assert st == 200
+            text = page.decode()
+            assert "Capacity" in text and "Monitors" in text
+            assert "dd" in text          # pools table names the pool
+            await dash.stop()
+        finally:
+            await cluster.stop()
+    asyncio.run(run())

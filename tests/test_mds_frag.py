@@ -287,3 +287,48 @@ def test_fragmented_dir_under_multi_active_export():
         await rados.shutdown()
         await cluster.stop()
     asyncio.run(run())
+
+
+def test_large_directory_spans_many_frags():
+    """The scaling wall the feature exists for (VERDICT r4 #3): a large
+    directory spreads over MANY frag objects — no single omap object
+    holds more than ~split_size entries — through multi-level 2-bit
+    splits, with listing and per-name routing staying exact."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster(
+            mds_bal_split_size=256, mds_bal_merge_size=0,
+            mds_bal_split_bits=2)
+        await fs.mkdir("/scale")
+        dino = await _dino(fs, mds, "/scale")
+        names = [f"entry{i:06d}" for i in range(3000)]
+        for i, n in enumerate(names):
+            await mds._set_dentry(dino, n, {
+                "ino": 0x20000 + i, "type": "file", "mode": 0o644,
+                "size": 0, "mtime": 0.0, "ctime": 0.0})
+
+        tree = await mds._fragtree(dino)
+        assert len(tree) >= 8, f"only {len(tree)} leaves"
+        assert max(b for b, _ in tree) >= 4, "no multi-level split"
+        # no frag object holds more than the split threshold (+ the
+        # in-flight slack of one trigger window)
+        sizes = {}
+        union = {}
+        for b, v in tree:
+            kv = await mds.meta.get_omap(frag_oid(dino, b, v))
+            sizes[(b, v)] = len(kv)
+            union.update(kv)
+        assert max(sizes.values()) <= 256 + 4, sizes
+        assert len(union) == len(names)
+        assert sorted(union) == names
+        # base object: metadata anchor only
+        assert await mds.meta.get_omap(dirfrag_oid(dino)) == {}
+        # per-name routing resolves every sampled entry
+        for n in names[::251]:
+            d = await mds._get_dentry(dino, n)
+            assert d["type"] == "file"
+        # the client view agrees
+        fs._dcache.clear()
+        listing = await fs.readdir("/scale")
+        assert len(listing) == len(names)
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
