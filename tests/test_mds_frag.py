@@ -332,3 +332,76 @@ def test_large_directory_spans_many_frags():
         assert len(listing) == len(names)
         await _teardown(cluster, rados, fs)
     asyncio.run(run())
+
+
+def test_frag_churn_against_model():
+    """Model-checked churn (the RadosModel/thrasher pattern of §4):
+    random create/unlink/rename traffic with tiny split/merge
+    thresholds drives constant fragmentation churn; the namespace must
+    match a dict model exactly at every checkpoint, and the frag
+    invariants (union == model, base empty iff fragmented, routing
+    exact) must hold after every reshape."""
+    async def run():
+        import random
+
+        cluster, mds, rados, fs = await _fs_cluster(
+            mds_bal_split_size=4, mds_bal_merge_size=4,
+            mds_bal_split_bits=1)
+        await fs.mkdir("/t")
+        dino = await _dino(fs, mds, "/t")
+        rng = random.Random(42)
+        pool = [f"n{i:02d}" for i in range(40)]
+        model: dict[str, bytes] = {}
+
+        async def check():
+            tree = await mds._fragtree(dino)
+            union = {}
+            from ceph_tpu.client.rados import RadosError
+
+            for b, v in tree:
+                try:
+                    kv = await mds.meta.get_omap(frag_oid(dino, b, v))
+                except RadosError as e:
+                    if e.rc != -2:
+                        raise
+                    kv = {}
+                union.update(kv)
+            assert sorted(union) == sorted(model), (
+                f"union {sorted(union)} != model {sorted(model)} "
+                f"tree {tree}")
+            if tree != [ROOT_FRAG]:
+                assert await mds.meta.get_omap(dirfrag_oid(dino)) == {}
+            # routing: every live name resolves through its frag
+            for n in model:
+                d = await mds._get_dentry(dino, n)
+                assert int(d["ino"]) != 0
+
+        for step in range(300):
+            name = rng.choice(pool)
+            op = rng.random()
+            if op < 0.5 and name not in model:
+                body = name.encode()
+                await fs.write_file(f"/t/{name}", body)
+                model[name] = body
+            elif op < 0.8 and name in model:
+                await fs.unlink(f"/t/{name}")
+                del model[name]
+            elif name in model:
+                dst = rng.choice(pool)
+                if dst == name:
+                    continue
+                await fs.rename(f"/t/{name}", f"/t/{dst}")
+                model[dst] = model.pop(name)
+            if step % 60 == 59:
+                fs._dcache.clear()
+                await check()
+                listing = await fs.readdir("/t")
+                assert sorted(listing) == sorted(model)
+
+        fs._dcache.clear()
+        await check()
+        # final deep verification incl. data
+        for n, body in model.items():
+            assert await fs.read_file(f"/t/{n}") == body
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())

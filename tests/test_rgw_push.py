@@ -241,3 +241,47 @@ def test_dead_letter_queue_and_topic_lifecycle():
             await rejecter.stop()
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_topic_replace_switches_live_worker_endpoint():
+    """Replacing a topic's endpoint — even from a DIFFERENT gateway
+    handle sharing the pool — redirects the live worker: it re-reads
+    the (5s-cached) meta each cycle and respawns itself with the new
+    attributes."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        recv_a = await Receiver().start()
+        recv_b = await Receiver().start()
+        try:
+            gw, ioctx = await _gw(rados)
+            await gw.create_bucket("nb")
+            await gw.create_topic(
+                "sw", push_endpoint=f"http://127.0.0.1:{recv_a.port}/")
+            await gw.put_bucket_notification("nb", "sw")
+            await gw.put_object("nb", "one", b"1")
+            await _wait(lambda: recv_a.records, what="delivery to A")
+
+            # another handle replaces the endpoint
+            gw2 = RGWLite(ioctx)
+            await gw2.create_topic(
+                "sw", push_endpoint=f"http://127.0.0.1:{recv_b.port}/")
+            # expire the FIRST handle's worker meta cache so its next
+            # cycle sees the replacement (prod: <=5s staleness window)
+            gw._topics_cache.clear()
+            await gw.put_object("nb", "two", b"2")
+            await _wait(lambda: recv_b.records, timeout=15,
+                        what="delivery to B after replace")
+            keys_b = [r["Records"][0]["s3"]["object"]["key"]
+                      for r in recv_b.records]
+            assert "two" in keys_b
+            # nothing new landed at A after the switch
+            keys_a = [r["Records"][0]["s3"]["object"]["key"]
+                      for r in recv_a.records]
+            assert "two" not in keys_a
+            await gw.stop_push()
+            await gw2.stop_push()
+        finally:
+            await recv_a.stop()
+            await recv_b.stop()
+            await stop_cluster(mon, osds, rados)
+    asyncio.run(run())
