@@ -405,3 +405,34 @@ def test_frag_churn_against_model():
             assert await fs.read_file(f"/t/{n}") == body
         await _teardown(cluster, rados, fs)
     asyncio.run(run())
+
+
+def test_split_after_snapshot_preserves_snap_view():
+    """mksnap on an UNFRAGMENTED dir, then a split (physical relayout,
+    no logical change — no COW trigger), then a mutation (first COW
+    freeze, reading the union of the new layout): the snap view must
+    show exactly the pre-snap content."""
+    async def run():
+        cluster, mds, rados, fs = await _fs_cluster()
+        await fs.mkdir("/o")
+        names = [f"f{i:02d}" for i in range(12)]
+        for n in names:
+            await fs.write_file(f"/o/{n}", b"pre")
+        dino = await _dino(fs, mds, "/o")
+        await fs.mksnap("/o", "s")
+        # split AFTER the snapshot, before any freeze happened
+        await fs._request("fragment", ino=dino, bits=0, value=0,
+                          nbits=2)
+        assert len(await fragtree_of(mds.meta, dino)) == 4
+        # first post-snap mutation freezes from the NEW layout
+        await fs.unlink(f"/o/{names[0]}")
+        await fs.write_file("/o/post", b"new")
+        fs._dcache.clear()
+        snap = await fs.readdir("/o/.snap/s")
+        assert sorted(snap) == names          # exact pre-snap content
+        assert (await fs.read_file(f"/o/.snap/s/{names[0]}")) == b"pre"
+        live = await fs.readdir("/o")
+        assert names[0] not in live and "post" in live
+        await fs.rmsnap("/o", "s")
+        await _teardown(cluster, rados, fs)
+    asyncio.run(run())
