@@ -30,6 +30,12 @@ from ceph_tpu.store import native_wal
 def _make_store(path, kind: str):
     if kind == "file":
         return FileStore(str(path), wal_max=1 << 30, native=False)
+    if kind == "zstd":
+        # inline at-rest compression tier: the envelope rides INSIDE
+        # frame payloads, so the same byte-level crash semantics must
+        # hold (a torn compressed record == a torn record)
+        return WalStore(str(path), checkpoint_bytes=1 << 30,
+                        native=False, compression="zstd")
     native = kind == "native"
     return WalStore(str(path), checkpoint_bytes=1 << 30, native=native)
 
@@ -194,7 +200,8 @@ def _expected_prefix(frame_ends, prefixes, cut: int) -> dict:
     return prefixes[n]
 
 
-@pytest.mark.parametrize("kind", ["python", "native", "file"])
+@pytest.mark.parametrize("kind", ["python", "native", "file",
+                                  "zstd"])
 def test_crash_replay_every_tail_byte(tmp_path, kind):
     """Truncate at EVERY byte boundary of the last two frames plus every
     frame boundary in the log: recovered state must equal the committed
@@ -219,7 +226,7 @@ def test_crash_replay_every_tail_byte(tmp_path, kind):
         assert got == want, f"cut={cut}: state diverged from prefix"
 
 
-@pytest.mark.parametrize("kind", ["python", "native"])
+@pytest.mark.parametrize("kind", ["python", "native", "zstd"])
 def test_crash_between_append_and_apply(tmp_path, kind):
     """A frame fully appended but the process killed before ack (the
     append-then-apply window): on remount the transaction IS recovered —
@@ -235,7 +242,7 @@ def test_crash_between_append_and_apply(tmp_path, kind):
             f"frame {i}: fully-appended txn not recovered"
 
 
-@pytest.mark.parametrize("kind", ["python", "native"])
+@pytest.mark.parametrize("kind", ["python", "native", "zstd"])
 def test_crash_replay_corrupt_interior_bit(tmp_path, kind):
     """A flipped bit INSIDE an interior frame ends replay at the longest
     valid prefix before it (crc discipline), never applies garbage."""
