@@ -285,3 +285,32 @@ def test_topic_replace_switches_live_worker_endpoint():
             await recv_b.stop()
             await stop_cluster(mon, osds, rados)
     asyncio.run(run())
+
+
+def test_ack_level_none_accepts_any_status():
+    """ack_level='none' (fire-and-forget): a 500-answering endpoint
+    still acks — the request must merely reach it; only an
+    UNREACHABLE endpoint counts as failed."""
+    from ceph_tpu.services.rgw_push import (DeliveryError,
+                                            PushEndpoint)
+
+    async def run():
+        recv = await Receiver(fail_first=10 ** 9).start()
+        try:
+            ep = PushEndpoint.make(
+                f"http://127.0.0.1:{recv.port}/", ack_level="none")
+            await ep.send(b'{"Records": []}')       # 500 -> still ok
+            assert recv.requests == 1
+            broker = PushEndpoint.make(
+                f"http://127.0.0.1:{recv.port}/", ack_level="broker")
+            with pytest.raises(DeliveryError) as ei:
+                await broker.send(b"{}")
+            assert ei.value.connected            # answered-and-rejected
+            down = PushEndpoint.make("http://127.0.0.1:1/",
+                                     ack_level="none")
+            with pytest.raises(DeliveryError) as ei:
+                await down.send(b"{}")
+            assert not ei.value.connected        # unreachable
+        finally:
+            await recv.stop()
+    asyncio.run(run())
