@@ -17,7 +17,9 @@ from typing import Awaitable, Callable
 import hashlib
 import hmac
 
+from ceph_tpu.common.backoff import ExpBackoff
 from ceph_tpu.common.log import Dout
+from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.common.tracing import Tracer
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger
@@ -63,6 +65,10 @@ class Objecter:
         self._reqid_name = f"{msgr.name}.{msgr.nonce:08x}"
         self._reqid_seq = 0
         self.tracer = Tracer(msgr.name)
+        # resend/timeout observability (l_osdc_* role)
+        self.perf = PerfCounters(f"objecter.{msgr.name}")
+        for _k in ("op_resend", "op_timeout", "map_waits", "op_remap"):
+            self.perf.add(_k, CounterType.U64)
         # cephx: OSD sessions we have presented our service ticket on
         self._osd_authed: set[int] = set()
         self._osd_auth_futs: dict[int, asyncio.Future] = {}
@@ -118,7 +124,23 @@ class Objecter:
                 )
 
     async def on_map_change(self, osdmap) -> None:
-        """Re-target lingers whose primary moved (_scan_requests role)."""
+        """_scan_requests role, run on every new osdmap: fail the
+        in-flight ops whose session OSD the new map marks down — their
+        reply will never come (the daemon is gone; an in-process
+        transport surfaces no reset for a message sent into the gap
+        between death and the map recording it), so without this rescan
+        they would sit out the whole op deadline. The submit loop
+        recomputes the target from the new map and resends; reqid dedup
+        on the OSD makes a replay of an executed mutation safe. Lingers
+        whose primary moved re-arm on the new one."""
+        for tid, (fut, osd) in list(self._inflight.items()):
+            if fut.done() or osdmap.is_up(osd):
+                continue
+            del self._inflight[tid]
+            self.perf.inc("op_remap")
+            fut.set_exception(ObjecterError(
+                f"osd.{osd} went down (map e{osdmap.epoch})"
+            ))
         for linger in self._lingers.values():
             target = self._target_for(linger.pool_id, linger.oid)
             if target is not None and target != linger.registered_osd:
@@ -138,12 +160,14 @@ class Objecter:
 
     # -- submission -------------------------------------------------------
     async def op_submit(self, pool_id: int, oid: str, ops: list[dict],
-                        timeout: float = 30.0,
+                        timeout: float | None = None,
                         extra: dict | None = None) -> dict:
         """Submit one op batch; retries across map changes, misdirected
         replies, and session resets until ``timeout``.  A sampled op
         (trace_probability) opens the root span and carries the trace
         context to the OSD (OpRequest/zipkin_trace analog)."""
+        if timeout is None:
+            timeout = float(self.monc.conf["client_op_deadline"])
         prob = float(self.monc.conf["trace_probability"] or 0.0)
         if prob and random.random() < prob:
             with self.tracer.span("objecter:op_submit", oid=oid,
@@ -165,6 +189,13 @@ class Objecter:
         # are deduped via osd_reqid_t in the PG log)
         self._reqid_seq += 1
         reqid = f"{self._reqid_name}:{self._reqid_seq}"
+        # capped exponential backoff between resends, jitter seeded from
+        # the reqid so a run replays the exact sleep schedule
+        backoff = ExpBackoff(
+            base=float(self.monc.conf["client_backoff_base"]),
+            cap=float(self.monc.conf["client_backoff_max"]),
+            seed=reqid, name="resend",
+        )
         while True:
             if self._stopped:
                 raise ObjecterError("objecter stopped")
@@ -193,10 +224,13 @@ class Objecter:
             except (ConnectionError, ObjecterError,
                     asyncio.TimeoutError):
                 if loop.time() > deadline:
+                    self.perf.inc("op_timeout")
                     raise ObjecterError(
                         f"osd.{primary} auth failed"
                     ) from None
-                await asyncio.sleep(0.1)
+                self.perf.inc("op_resend")
+                await asyncio.sleep(min(backoff.next_delay(),
+                                        max(0.0, deadline - loop.time())))
                 continue
             self._tid += 1
             tid = self._tid
@@ -219,13 +253,17 @@ class Objecter:
             except (ConnectionError, ObjecterError):
                 self._inflight.pop(tid, None)
                 if loop.time() > deadline:
+                    self.perf.inc("op_timeout")
                     raise ObjecterError(
                         f"op on {oid} timed out (osd.{primary} unreachable)"
                     ) from None
-                await asyncio.sleep(0.1)
+                self.perf.inc("op_resend")
+                await asyncio.sleep(min(backoff.next_delay(),
+                                        max(0.0, deadline - loop.time())))
                 continue
             except asyncio.TimeoutError:
                 self._inflight.pop(tid, None)
+                self.perf.inc("op_timeout")
                 raise ObjecterError(f"op on {oid} timed out") from None
             if reply["rc"] == MISDIRECTED_RC:
                 await self._await_newer_map(
@@ -287,7 +325,9 @@ class Objecter:
                                strict: bool = True) -> None:
         loop = asyncio.get_running_loop()
         if loop.time() > deadline:
+            self.perf.inc("op_timeout")
             raise ObjecterError("timed out waiting for a usable osdmap")
+        self.perf.inc("map_waits")
         try:
             await self.monc.wait_for_map(
                 epoch + 1, timeout=min(1.0, max(0.05,

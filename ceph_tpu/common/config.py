@@ -175,9 +175,28 @@ def global_options() -> list[Option]:
         Option("admin_socket_dir", str, "",
                "directory for <entity>.asok admin sockets ('' = off)"),
         Option("ms_inject_socket_failures", int, 0,
-               "1-in-N artificial connection failures (0=off)", Level.DEV),
+               "1-in-N artificial connection failures (0=off); alias of "
+               "failpoint msgr.send", Level.DEV),
         Option("ms_inject_delay_max", float, 0.0,
-               "max artificial delivery delay (s)", Level.DEV),
+               "max artificial delivery delay (s); alias of failpoint "
+               "msgr.deliver", Level.DEV),
+        Option("failpoint", str, "",
+               "failpoint spec applied at daemon start: "
+               "name=mode[:arg][:arg],... (see common/failpoint.py)",
+               Level.DEV, runtime=True),
+        Option("failpoint_seed", int, 0,
+               "deterministic seed for failpoint prob/chaos draws "
+               "(0 = leave registry seed alone)", Level.DEV),
+        Option("client_backoff_base", float, 0.05,
+               "initial client resend/hunt backoff (s)", min=0.0),
+        Option("client_backoff_max", float, 1.0,
+               "cap on client resend/hunt backoff (s)", min=0.0),
+        Option("client_op_deadline", float, 30.0,
+               "default per-op deadline for Objecter ops (s)", min=0.1),
+        Option("osd_ec_hedge_read_timeout", float, 0.0,
+               "hedge an EC shard read after this many seconds: fan out "
+               "to surviving shards and reconstruct via minimum_to_decode "
+               "(0 = off)", Level.ADVANCED, min=0.0),
         Option("ec_stripe_batch", int, 1024,
                "stripes per device encode launch", min=1),
         Option("ec_use_pallas", bool, True,

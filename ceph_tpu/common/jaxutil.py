@@ -34,6 +34,43 @@ def enable_compile_cache(path: str | None = None) -> None:
         pass
 
 
+def resolve_shard_map():
+    """Return the shard_map entry point for the installed jax.
+
+    jax >= 0.6 exports ``jax.shard_map``; older releases (the pinned
+    0.4.x toolchain included) only ship
+    ``jax.experimental.shard_map.shard_map``, whose replication-check
+    kwarg is still spelled ``check_rep`` (renamed ``check_vma`` when it
+    graduated).  Callers use the modern spelling; the wrapper translates
+    for the old entry point.  Resolved lazily so the import never breaks
+    module collection on either version.
+    """
+    import functools
+    import inspect
+
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm  # type: ignore
+    try:
+        params = inspect.signature(sm).parameters
+    except (TypeError, ValueError):
+        return sm
+    if "check_vma" in params:
+        return sm
+
+    @functools.wraps(sm)
+    def compat(*args, **kwargs):
+        if "check_vma" in kwargs:
+            val = kwargs.pop("check_vma")
+            if "check_rep" in params:
+                kwargs["check_rep"] = val
+        return sm(*args, **kwargs)
+
+    return compat
+
+
 def outside_trace() -> bool:
     """True when no jit/vmap/shard_map trace is active.
 

@@ -20,7 +20,7 @@ SUBSYSTEMS = (
     "osd", "mon", "ms", "ec", "crush", "objecter", "store", "client",
     "mgr", "rbd", "rgw", "rgw-sync", "rgw-http", "mds", "config",
     "dashboard", "heartbeat",
-    "peering", "asok",
+    "peering", "asok", "failpoint",
 )
 
 _RING_SIZE = 10000

@@ -31,6 +31,7 @@ import secrets
 import struct
 import time
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.client.rados import IoCtx, ObjectOperation, Rados, RadosError
 from ceph_tpu.common.config import ConfigProxy
@@ -277,6 +278,7 @@ class MDSDaemon:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, timeout: float = 20.0) -> None:
+        fp.apply_conf(self.conf)
         await self.rados.connect(timeout)
         self.meta = await self.rados.open_ioctx(self.meta_pool)
         self.data = await self.rados.open_ioctx(self.data_pool)
@@ -326,6 +328,7 @@ class MDSDaemon:
                           "damage table entries")
             sock.register("damage rm", self.damage_rm,
                           "damage rm <id>: ack one entry")
+            fp.register_admin_commands(sock)
             await sock.start(run_dir)
             self.admin_socket = sock
         else:
@@ -592,6 +595,8 @@ class MDSDaemon:
             pass
 
     async def _journal(self, entry: dict) -> None:
+        if fp.ACTIVE:
+            await fp.fire("mds.journal_flush")
         payload = encode(entry)
         await self.meta.append(self._journal_oid,
                                _FRAME.pack(len(payload)) + payload)

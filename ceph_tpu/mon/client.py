@@ -12,9 +12,11 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable
 
+from ceph_tpu.common.backoff import ExpBackoff
 from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.log import Dout
+from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.mon.monitor import auth_proof
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger, Policy
@@ -31,6 +33,9 @@ class MonClient:
         self.monmap = dict(monmap)
         self.conf = conf or ConfigProxy()
         self.msgr = msgr or Messenger(entity, self.conf)
+        self.perf = PerfCounters(f"monc.{entity}")
+        for _k in ("hunt_retries", "hunt_timeouts"):
+            self.perf.add(_k, CounterType.U64)
         self._own_msgr = msgr is None
         self.msgr.set_policy("mon", Policy.lossy_client())
         if self.msgr.dispatcher is None:
@@ -66,8 +71,16 @@ class MonClient:
             self.conn.mark_down()
 
     async def _hunt(self, timeout: float = 10.0) -> None:
-        """Try monitors (rank order) until one authenticates us."""
-        deadline = asyncio.get_running_loop().time() + timeout
+        """Try monitors (rank order) until one authenticates us,
+        backing off exponentially (capped, deterministic jitter) between
+        full sweeps so a mon outage doesn't see lock-step re-dials."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        backoff = ExpBackoff(
+            base=float(self.conf["client_backoff_base"]),
+            cap=float(self.conf["client_backoff_max"]),
+            seed=self.entity, name="hunt",
+        )
         last_err: Exception | None = None
         while not self._stopped:
             for name in sorted(self.monmap):
@@ -76,11 +89,14 @@ class MonClient:
                     return
                 except (ConnectionError, OSError, TimeoutError) as e:
                     last_err = e
-            if asyncio.get_running_loop().time() > deadline:
+            if loop.time() > deadline:
+                self.perf.inc("hunt_timeouts")
                 raise ConnectionError(
                     f"{self.entity}: no monitor reachable: {last_err}"
                 )
-            await asyncio.sleep(0.1)
+            self.perf.inc("hunt_retries")
+            await asyncio.sleep(min(backoff.next_delay(),
+                                    max(0.0, deadline - loop.time())))
 
     async def _open_session(self, name: str) -> None:
         self._authed.clear()

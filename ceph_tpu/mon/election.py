@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.log import Dout
 from ceph_tpu.msg.message import PRIO_HIGHEST, Message
 
@@ -52,6 +53,15 @@ class Elector:
         self.deferred = {self.mon.name}
         log.dout(5, "%s: starting election epoch %d",
                  self.mon.name, self.epoch)
+        if fp.ACTIVE:
+            try:
+                fp.fire_sync("mon.election")
+            except fp.FailPointError as e:
+                # injected disruption: propose nothing; the armed
+                # timeout retries the election (Elector::expire path)
+                log.derr("%s: election suppressed: %s", self.mon.name, e)
+                self._arm_timeout()
+                return
         for peer in self.mon.peer_names():
             # the candidacy carries our paxos position: peers refuse to
             # defer to a candidate beyond their trim window (it could

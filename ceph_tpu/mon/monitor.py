@@ -17,6 +17,7 @@ import hashlib
 import hmac
 import secrets
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.log import Dout
@@ -143,6 +144,7 @@ class Monitor:
                 "auth_cluster_required=cephx requires auth_admin_key "
                 "(the mon keyring)"
             )
+        fp.apply_conf(self.conf)
         await self.msgr.bind(self.monmap[self.name])
         for svc in self.services.values():
             svc.refresh()
@@ -168,6 +170,7 @@ class Monitor:
                           "live configuration")
             sock.register("health", self.health_monitor.summary,
                           "aggregated health")
+            fp.register_admin_commands(sock)
             await sock.start(run_dir)
             self.admin_socket = sock
         else:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.log import Dout
 from ceph_tpu.msg.message import PRIO_HIGHEST, Message
 from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
@@ -338,6 +339,10 @@ class Paxos:
         await self._maybe_propose()
 
     def _commit(self, v: int, raw: bytes) -> None:
+        if fp.ACTIVE:
+            # injected commit failure: the value stays durably accepted
+            # (pending_v/pending_pn), so recovery re-proposes it
+            fp.fire_sync("mon.paxos_commit")
         tx = StoreTransaction.decode(raw)
         tx.put(PREFIX, str(v), raw)
         tx.put(PREFIX, "last_committed", v)

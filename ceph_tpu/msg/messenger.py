@@ -24,6 +24,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.common.log import Dout
 from ceph_tpu.common.throttle import Throttle
@@ -559,7 +560,7 @@ class Messenger:
 
     async def _dial(self, conn: Connection) -> None:
         a = EntityAddr.parse(conn.peer_addr)
-        self._maybe_inject_failure()
+        self._maybe_inject_failure("msgr.dial")
         if a.scheme == "local":
             target = _LOCAL_LISTENERS.get(a.host)
             if target is None:
@@ -738,6 +739,14 @@ class Messenger:
         if self._stopped:
             stream.close()
             return
+        if fp.ACTIVE:
+            try:
+                await fp.fire("msgr.accept")
+            except fp.FailPointError as e:
+                log.dout(10, "%s: accept rejected by failpoint: %s",
+                         self.name, e)
+                stream.close()
+                return
         try:
             # read peer hello first so our reply can ride session state
             banner = await stream.read_exactly(len(BANNER))
@@ -801,6 +810,13 @@ class Messenger:
 
     # -- delivery --------------------------------------------------------
     async def _deliver(self, conn: Connection, msg: Message) -> None:
+        if fp.ACTIVE:
+            try:
+                await fp.fire("msgr.deliver")
+            except fp.FailPointError as e:
+                log.dout(10, "%s: dropping %s (failpoint: %s)",
+                         self.name, msg.type, e)
+                return
         delay_max = self.conf["ms_inject_delay_max"] if self.conf else 0.0
         if delay_max:
             await asyncio.sleep(self._rng.random() * delay_max)
@@ -812,7 +828,15 @@ class Messenger:
         except Exception:
             log.derr("%s: dispatch of %s failed", self.name, msg.type)
 
-    def _maybe_inject_failure(self) -> None:
+    def _maybe_inject_failure(self, point: str = "msgr.send") -> None:
+        # named failpoints are the unified injection path; the legacy
+        # ms_inject_socket_failures knob remains a per-messenger alias
+        if fp.ACTIVE:
+            try:
+                fp.fire_sync(point)
+            except fp.FailPointError as e:
+                raise MessengerError(
+                    f"injected socket failure ({e})") from None
         n = self.conf["ms_inject_socket_failures"] if self.conf else 0
         if n and self._rng.randrange(n) == 0:
             raise MessengerError("injected socket failure")

@@ -26,6 +26,7 @@ import hashlib
 import hmac as hmac_mod
 import secrets as secrets_mod
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.crc32c import crc32c
@@ -194,7 +195,9 @@ class OSDDaemon:
         self.osdmap: OSDMap | None = None
         self.pgs: dict[PGId, PG] = {}
         self._sub_tid = 0
-        self._sub_futures: dict[int, asyncio.Future] = {}
+        # sub-op tid -> (reply future, target osd); the target lets a
+        # new map fail the wait the moment it marks that osd down
+        self._sub_futures: dict[int, tuple[asyncio.Future, int]] = {}
         # cache-tier client state (this OSD as a client of base pools)
         self._tier_tid = 0
         self._tier_seq = 0
@@ -279,6 +282,7 @@ class OSDDaemon:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, timeout: float = 20.0) -> None:
+        fp.apply_conf(self.conf)
         await self.store.mount()
         await self.msgr.bind(self.addr)
         await self.monc.start(timeout)
@@ -366,6 +370,7 @@ class OSDDaemon:
             "osdmap_epoch": self.osdmap.epoch if self.osdmap else 0,
             "num_pgs": len(self.pgs),
         }, "daemon status")
+        fp.register_admin_commands(sock)
         await sock.start(run_dir)
         self.admin_socket = sock
 
@@ -731,6 +736,17 @@ class OSDDaemon:
                     conn = self.msgr._conns.get(info.addr)
                     if conn is not None:
                         conn.mark_down()
+            # sub-ops awaiting a reply from a now-down peer will never
+            # get one — fail them now instead of letting each burn the
+            # full sub-op timeout (the client-side Objecter rescans its
+            # inflight set on map change the same way)
+            for tid, (fut, osd) in list(self._sub_futures.items()):
+                me = osdmap.osds.get(osd)
+                if (me is None or not me.up) and not fut.done():
+                    del self._sub_futures[tid]
+                    fut.set_exception(ConnectionError(
+                        f"osd.{osd} marked down (map e{osdmap.epoch})"
+                    ))
             await self._scan_pgs()
         for pg in self.pgs.values():
             if pg.state == STATE_ACTIVE:
@@ -1255,8 +1271,11 @@ class OSDDaemon:
                 self._maybe_trim(pg)
                 return entry
 
+            hedge = float(self.conf["osd_ec_hedge_read_timeout"])
             pg.backend = ECBackend(codec, shards, log_hook=log_hook,
-                                   mesh=self._ec_mesh())
+                                   mesh=self._ec_mesh(),
+                                   hedge_timeout=hedge or None,
+                                   perf=self.perf)
             pg.ec_k = pg.backend.k
         else:
             pg.backend = None       # replicated path works on the store
@@ -2825,6 +2844,11 @@ class OSDDaemon:
         removals — an object deleted while a member was away must not
         resurrect. Returns the number of FAILED recoveries (the caller
         must not merge/advance logs over unhealed objects)."""
+        if fp.ACTIVE:
+            try:
+                await fp.fire("osd.recovery")
+            except fp.FailPointError:
+                return 1            # injected: retry on a later pass
         sem = asyncio.Semaphore(self.conf["osd_recovery_max_active"])
         if pg.is_ec:
             return await self._recover_ec(pg, missing, sem)
@@ -3968,6 +3992,11 @@ class OSDDaemon:
         entry absent from the authoritative log was never acked to any
         client. Degraded operation = acting-set holes (NO_OSD), not
         skipped live members."""
+        # interval snapshot BEFORE the fan-out: a replica dying mid-send
+        # costs the sub-op timeout, and the map recording it can land
+        # during that wait — a snapshot taken after would compare the
+        # re-push loop against the NEW interval and never exit
+        epoch = pg.epoch
         await self.store.queue_transactions(tx)
         wire = encode_tx(tx)
         replicas = [osd for osd in set(pg.acting)
@@ -3993,7 +4022,6 @@ class OSDDaemon:
         # replay check decides: committed-and-merged answers OK, rewound
         # re-executes) or after a deadline. MISDIRECTED tells the client
         # to refresh the map and resend.
-        epoch = pg.epoch
         cid_wire = _enc_cid(CollectionId(pg.pgid.pool, pg.pgid.ps))
         deadline = time.monotonic() + 20.0
         log.dout(5, "pg %s: copies missing on %s; blocking re-push",
@@ -4038,7 +4066,7 @@ class OSDDaemon:
         self._sub_tid += 1
         tid = self._sub_tid
         fut = asyncio.get_running_loop().create_future()
-        self._sub_futures[tid] = fut
+        self._sub_futures[tid] = (fut, osd)
         payload = {
             "tid": tid, "kind": kind, "from": self.osd_id,
             "epoch": self.osdmap.epoch, **args,
@@ -4068,9 +4096,9 @@ class OSDDaemon:
             log.derr("%s: dropping unsigned/forged sub_reply",
                      self.entity)
             return
-        fut = self._sub_futures.pop(int(d.get("tid", 0)), None)
-        if fut is not None and not fut.done():
-            fut.set_result(d)
+        entry = self._sub_futures.pop(int(d.get("tid", 0)), None)
+        if entry is not None and not entry[0].done():
+            entry[0].set_result(d)
 
     def _sub_op_stale(self, d: dict) -> bool:
         """True when a sub-op originates from an older PG interval than
@@ -4107,6 +4135,12 @@ class OSDDaemon:
     async def _handle_sub_op_inner(self, conn: Connection,
                                    d: dict) -> None:
         tid = d.get("tid", 0)
+        if fp.ACTIVE:
+            try:
+                await fp.fire("osd.sub_op")
+            except fp.FailPointError:
+                self._sub_reply(conn, tid, EIO_RC)
+                return
         if self.cephx and not await self._sub_op_sig_ok(d):
             log.derr("%s: rejecting unsigned/forged sub_op from %s",
                      self.entity, conn.peer_name)
@@ -4259,6 +4293,11 @@ class OSDDaemon:
                     await self._refresh_service_secrets()
             if self.osdmap is None:
                 continue
+            if fp.ACTIVE:
+                try:
+                    fp.fire_sync("osd.heartbeat")
+                except fp.FailPointError:
+                    continue        # injected silence: skip this round
             now = time.monotonic()
             for osd, info in self.osdmap.osds.items():
                 if osd == self.osd_id or not info.up:

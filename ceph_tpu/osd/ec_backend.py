@@ -34,7 +34,9 @@ from typing import Mapping, Protocol, Sequence
 
 import numpy as np
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.crc32c import crc32c
+from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.osd.ec_util import HashInfo, StripeInfo
 from ceph_tpu.store import CollectionId, GHObject, ObjectStore, Transaction
 
@@ -227,6 +229,8 @@ class ECBackend:
         stripe_unit: int | None = None,
         log_hook=None,
         mesh=None,
+        hedge_timeout: float | None = None,
+        perf: PerfCounters | None = None,
     ):
         """``codec``: an initialised ErasureCodeInterface; ``shards``:
         shard id -> ShardIO for all k+m positions. ``log_hook(oid, op,
@@ -284,6 +288,13 @@ class ECBackend:
         # observability: proves which plane served a batch (tests and
         # perf counters read these)
         self.mesh_stats = {"encodes": 0, "decodes": 0}
+        # hedged reads: a data-shard read still pending after
+        # hedge_timeout seconds is raced against a minimum_to_decode
+        # reconstruction from the surviving shards (None/0 = off)
+        self.hedge_timeout = hedge_timeout or None
+        self.perf = perf if perf is not None else PerfCounters("ec")
+        for _k in ("hedge_issued", "hedge_won", "hedge_lost"):
+            self.perf.add(_k, CounterType.U64)
 
     def _lock(self, oid: str):
         """Per-object write lock, refcounted so the table doesn't grow
@@ -710,6 +721,9 @@ class ECBackend:
         failed, not served (the crc/hinfo-verify role of handle_sub_read,
         reference ECBackend.cc:1010)."""
         try:
+            if fp.ACTIVE:
+                await fp.fire("ec.shard_read")
+                await fp.fire(f"ec.shard_read.{shard}")
             if version is not None:
                 raw_meta = await self.shards[shard].get_attr(
                     oid, VERSION_ATTR
@@ -748,24 +762,110 @@ class ECBackend:
         ssize = self.sinfo.logical_to_next_chunk_offset(obj_size)
 
         want = list(range(self.k))
-        results = await asyncio.gather(*(
-            self._read_shard_range(i, oid, coff, clen, ssize, version)
-            for i in want
-        ), return_exceptions=True)
-        missing = [i for i, r in enumerate(results)
-                   if isinstance(r, BaseException)]
-        if missing:
-            chunks = await self._reconstruct(
-                oid, coff, clen, missing, results, ssize, version
+        if self.hedge_timeout:
+            chunks = await self._read_chunks_hedged(
+                oid, coff, clen, ssize, version, want
             )
         else:
-            chunks = {i: results[i] for i in want}
+            results = await asyncio.gather(*(
+                self._read_shard_range(i, oid, coff, clen, ssize, version)
+                for i in want
+            ), return_exceptions=True)
+            missing = [i for i, r in enumerate(results)
+                       if isinstance(r, BaseException)]
+            if missing:
+                chunks = await self._reconstruct(
+                    oid, coff, clen, missing, results, ssize, version
+                )
+            else:
+                chunks = {i: results[i] for i in want}
         stripes = np.stack(
             [chunks[i].reshape(nstripes, self.sinfo.chunk_size)
              for i in range(self.k)], axis=1,
         )
         flat = self.sinfo.merge_stripes(stripes)
         return flat[:length].tobytes()
+
+    async def _read_chunks_hedged(
+        self, oid: str, coff: int, clen: int, ssize: int | None,
+        version: int | None, want: list[int],
+    ) -> dict[int, np.ndarray]:
+        """Hedged shard fan-in: wait ``hedge_timeout`` for the direct
+        data-shard reads; shards still pending are treated as slow and
+        raced against a minimum_to_decode reconstruction from the
+        surviving shards (the tail-latency hedge of degraded-read
+        literature).  Bit-identical to the direct path — the race only
+        decides WHERE the bytes come from, the decode math is the same
+        GF(2^8) inverse the failure path uses."""
+        tasks = {
+            i: asyncio.create_task(
+                self._read_shard_range(i, oid, coff, clen, ssize,
+                                       version))
+            for i in want
+        }
+        await asyncio.wait(tasks.values(), timeout=self.hedge_timeout)
+        slow = [i for i in want if not tasks[i].done()]
+        results = [
+            (tasks[i].exception() if tasks[i].done()
+             and tasks[i].exception() is not None
+             else tasks[i].result() if tasks[i].done()
+             else ShardReadError(f"shard {i}: hedged (slow)"))
+            for i in want
+        ]
+        failed = [i for i in want
+                  if tasks[i].done() and tasks[i].exception() is not None]
+        if not slow:
+            if failed:
+                return await self._reconstruct(
+                    oid, coff, clen, failed, results, ssize, version)
+            return {i: tasks[i].result() for i in want}
+        # hedge fires: reconstruct failed+slow positions from survivors
+        # while the stragglers keep running; first full answer wins
+        self.perf.inc("hedge_issued")
+        missing = failed + slow
+        rec = asyncio.create_task(self._reconstruct(
+            oid, coff, clen, missing, results, ssize, version))
+        slow_all = asyncio.ensure_future(asyncio.gather(
+            *(tasks[i] for i in slow), return_exceptions=True))
+        pending = {rec, slow_all}
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                if rec in done and rec.exception() is None:
+                    self.perf.inc("hedge_won")
+                    return rec.result()
+                if slow_all in done:
+                    sres = slow_all.result()
+                    if not failed and not any(
+                            isinstance(r, BaseException) for r in sres):
+                        self.perf.inc("hedge_lost")
+                        return {i: tasks[i].result() for i in want}
+                # a path failed (or landed unusable): wait for the other
+        finally:
+            rec.cancel()
+            slow_all.cancel()
+            for i in slow:
+                tasks[i].cancel()
+            # retrieve loser-side results so cancellation doesn't log
+            # "exception was never retrieved" for the racing futures
+            await asyncio.gather(rec, slow_all, return_exceptions=True)
+        # neither path produced a clean answer on its own: re-evaluate
+        # with every read that DID land (a slow-but-successful shard can
+        # rescue a reconstruction that lacked survivors)
+        final: list = []
+        for i in want:
+            t = tasks[i]
+            if t.done() and not t.cancelled() and t.exception() is None:
+                final.append(t.result())
+            else:
+                final.append(ShardReadError(f"shard {i}: unavailable"))
+        missing2 = [i for i, r in zip(want, final)
+                    if isinstance(r, BaseException)]
+        if not missing2:
+            return {i: r for i, r in zip(want, final)}
+        return await self._reconstruct(
+            oid, coff, clen, missing2, final, ssize, version)
 
     async def _reconstruct(
         self, oid: str, coff: int, clen: int,

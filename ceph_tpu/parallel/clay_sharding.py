@@ -21,7 +21,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ceph_tpu.ec.engine import default_engine
 from ceph_tpu.ec.repair_operator import clay_repair_operator
 
-shard_map = jax.shard_map
+from ceph_tpu.common.jaxutil import resolve_shard_map
+
+shard_map = resolve_shard_map()
 
 
 def sharded_clay_repair(mesh, ec, chunks, lost: int) -> jax.Array:

@@ -25,7 +25,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-shard_map = jax.shard_map
+from ceph_tpu.common.jaxutil import resolve_shard_map
+
+shard_map = resolve_shard_map()
 
 from ceph_tpu.ec import reference
 from ceph_tpu.ec.engine import default_engine

@@ -27,7 +27,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ceph_tpu.ec.engine import default_engine
 from ceph_tpu.ec.repair_operator import lrc_repair_operator
 
-shard_map = jax.shard_map
+from ceph_tpu.common.jaxutil import resolve_shard_map
+
+shard_map = resolve_shard_map()
 
 # Profile used by sharded_lrc_repair_check (and the dryrun gate): 4 local
 # groups of l+1 = 5 chunks.  Callers needing the device-count constraint

@@ -40,6 +40,7 @@ import os
 import struct
 from pathlib import Path
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.common.compressor import envelope_pack, envelope_unpack, get_compressor
 from ceph_tpu.common.lockdep import DLock
@@ -193,6 +194,8 @@ class FileStore(ObjectStore):
         if self.fail_next is not None:
             exc, self.fail_next = self.fail_next, None
             raise exc
+        if fp.ACTIVE:
+            await fp.fire("store.wal_commit")
         payload = encode([encode_tx(t) for t in txns])
         async with self._commit_lock:
             self._validate(txns)
@@ -202,6 +205,8 @@ class FileStore(ObjectStore):
             self._set_applied(size)
             if size >= self.wal_max:
                 # everything below is applied to the FS: safe turnover
+                if fp.ACTIVE:
+                    fp.fire_sync("store.checkpoint")
                 self._reset_wal()
 
     def _append(self, payload: bytes) -> int:

@@ -42,6 +42,7 @@ import os
 import struct
 from pathlib import Path
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.compressor import envelope_pack, envelope_unpack, \
     get_compressor
@@ -192,6 +193,8 @@ class WalStore(MemStore):
         if self.fail_next is not None:
             exc, self.fail_next = self.fail_next, None
             raise exc
+        if fp.ACTIVE:
+            await fp.fire("store.wal_commit")
         payload = encode([encode_tx(t) for t in txns])
         async with self._commit_lock:
             # validate first: an invalid transaction must raise without
@@ -372,6 +375,10 @@ class WalStore(MemStore):
             self._dirty.clear()
 
         async def _bg():
+            if fp.ACTIVE:
+                # failing here leaves wal.old + wal in place: mount-time
+                # compaction recovers, exactly like a torn background write
+                await fp.fire("store.checkpoint")
             await asyncio.to_thread(self._commit_segments, snap, False)
 
         self._ckpt_task = asyncio.get_running_loop().create_task(_bg())

@@ -1,0 +1,128 @@
+"""Seeded chaos harness: Thrasher + failpoints + RadosModel oracle.
+
+The qa thrash-erasure-code suites compose three independent chaos sources
+(daemon kill/revive, socket-failure injection, model-based random ops with
+an oracle) but leave the interleaving to wall-clock timers, so no run ever
+replays.  This harness derives EVERYTHING from one seed:
+
+- an abstract event plan (kill / revive / failpoint arm / clear / calm)
+  generated from the seed alone, before the cluster exists;
+- concrete kill/revive victims drawn from the Thrasher's seeded rng;
+- failpoint prob/delay draws via ``failpoint.set_seed``;
+- the op stream and its oracle via ``RadosModel(seed=...)``.
+
+Events are applied between op batches (op count, never wall clock), so two
+runs with the same seed produce the SAME recorded schedule, and the model's
+invariants must hold in both.  ``run_chaos`` is the one-call entry point;
+tests compare ``result["schedule"]`` across runs.
+"""
+
+from __future__ import annotations
+
+import random
+
+from ceph_tpu.common import failpoint as fp
+from ceph_tpu.testing.rados_model import RadosModel
+from ceph_tpu.testing.thrasher import Thrasher
+
+#: mild, self-healing faults the planner can arm (index-addressed so the
+#: plan is stable even if parameters are tuned)
+FAILPOINT_MENU: list[tuple[str, str, dict]] = [
+    ("msgr.deliver", "delay", {"delay": 0.01}),
+    ("osd.sub_op", "delay", {"delay": 0.01}),
+    ("msgr.send", "prob", {"p": 0.02}),
+    ("osd.recovery", "delay", {"delay": 0.02}),
+]
+
+
+class ChaosHarness:
+    def __init__(self, seed: int = 0, n_osds: int = 4, n_batches: int = 10,
+                 batch: int = 8, pool_size: int = 3, min_size: int = 2):
+        self.seed = seed
+        self.n_osds = n_osds
+        self.n_batches = n_batches
+        self.batch = batch
+        self.pool_size = pool_size
+        self.min_size = min_size
+        self.schedule: list[tuple] = []       # recorded (step, event, arg)
+
+    def plan(self) -> list[tuple]:
+        """Abstract event plan from the seed alone (no cluster state)."""
+        rng = random.Random(f"chaos-plan:{self.seed}")
+        plan = []
+        for b in range(self.n_batches):
+            r = rng.random()
+            if r < 0.20:
+                plan.append((b, "kill", None))
+            elif r < 0.40:
+                plan.append((b, "revive", None))
+            elif r < 0.60:
+                plan.append((b, "fp_set",
+                             rng.randrange(len(FAILPOINT_MENU))))
+            elif r < 0.75:
+                plan.append((b, "fp_clear", None))
+            else:
+                plan.append((b, "calm", None))
+        return plan
+
+    async def run(self) -> dict:
+        from ceph_tpu.vstart import DevCluster
+
+        fp.fp_clear()
+        fp.set_seed(self.seed)
+        self.schedule = []
+        cluster = DevCluster(n_mons=1, n_osds=self.n_osds, overrides={
+            "mon_osd_down_out_interval": 300.0,   # no auto-out churn
+        })
+        await cluster.start()
+        rados = await cluster.client()
+        await rados.pool_create("chaos", pg_num=8, size=self.pool_size,
+                                min_size=self.min_size)
+        io = await rados.open_ioctx("chaos")
+        model = RadosModel(io, seed=self.seed, n_objects=8,
+                           max_size=1 << 14)
+        thrasher = Thrasher(cluster, min_live=self.n_osds - 1,
+                            seed=self.seed)
+        try:
+            await model.run(self.batch)       # seed some state quietly
+            for step, event, arg in self.plan():
+                if event == "kill":
+                    victim = await thrasher.kill_one()
+                    self.schedule.append((step, "kill", victim))
+                elif event == "revive":
+                    osd = await thrasher.revive_oldest()
+                    self.schedule.append((step, "revive", osd))
+                elif event == "fp_set":
+                    name, mode, kw = FAILPOINT_MENU[arg]
+                    fp.fp_set(name, mode, **kw)
+                    self.schedule.append((step, "fp_set", name))
+                elif event == "fp_clear":
+                    fp.fp_clear()
+                    fp.set_seed(self.seed)
+                    self.schedule.append((step, "fp_clear", None))
+                else:
+                    self.schedule.append((step, "calm", None))
+                await model.run(self.batch)
+        finally:
+            fp.fp_clear()
+            while thrasher.dead:
+                if await thrasher.revive_oldest() is None:
+                    break
+        await cluster.wait_health_ok(timeout=30)
+        verified = await model.verify_all()
+        await rados.shutdown()
+        await cluster.stop()
+        return {
+            "seed": self.seed,
+            "schedule": list(self.schedule),
+            "verified": verified,
+            "checks": model.checks,
+            "ops_done": model.ops_done,
+            "kills": thrasher.kills,
+            "revives": thrasher.revives,
+        }
+
+
+async def run_chaos(seed: int = 0, **kw) -> dict:
+    """One deterministic chaos run; see ChaosHarness."""
+    return await ChaosHarness(seed=seed, **kw).run()

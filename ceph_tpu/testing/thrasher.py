@@ -49,6 +49,36 @@ class Thrasher:
                 self.revives += 1
             self.dead.clear()
 
+    # -- single deterministic decisions (chaos-harness composition) -----
+    async def kill_one(self) -> int | None:
+        """Down one random live OSD (respecting min_live); returns its
+        id, or None when no kill is allowed.  Drawing the victim from
+        the seeded rng keeps a scheduled chaos run replayable."""
+        live = sorted(self.cluster.osds)
+        if len(live) <= self.min_live:
+            return None
+        victim = self.rng.choice(live)
+        log.dout(1, "thrasher: killing osd.%d", victim)
+        await self.cluster.kill_osd(victim)
+        self.dead.add(victim)
+        self.kills += 1
+        return victim
+
+    async def revive_oldest(self) -> int | None:
+        """Revive the longest-dead OSD; returns its id or None."""
+        if not self.dead:
+            return None
+        osd_id = sorted(self.dead)[0]
+        log.dout(1, "thrasher: reviving osd.%d", osd_id)
+        try:
+            await self.cluster.revive_osd(osd_id)
+        except (ConnectionError, TimeoutError) as e:
+            log.derr("thrasher: revive osd.%d failed: %s", osd_id, e)
+            return None
+        self.dead.discard(osd_id)
+        self.revives += 1
+        return osd_id
+
     async def _loop(self) -> None:
         while not self._stopped.is_set():
             try:
@@ -58,13 +88,7 @@ class Thrasher:
                 return
             except asyncio.TimeoutError:
                 pass
-            live = sorted(self.cluster.osds)
-            if len(live) > self.min_live:
-                victim = self.rng.choice(live)
-                log.dout(1, "thrasher: killing osd.%d", victim)
-                await self.cluster.kill_osd(victim)
-                self.dead.add(victim)
-                self.kills += 1
+            await self.kill_one()
             # revive the longest-dead osd after a delay
             if self.dead:
                 try:
@@ -74,12 +98,4 @@ class Thrasher:
                     return
                 except asyncio.TimeoutError:
                     pass
-                osd_id = sorted(self.dead)[0]
-                log.dout(1, "thrasher: reviving osd.%d", osd_id)
-                try:
-                    await self.cluster.revive_osd(osd_id)
-                    self.dead.discard(osd_id)
-                    self.revives += 1
-                except (ConnectionError, TimeoutError) as e:
-                    log.derr("thrasher: revive osd.%d failed: %s",
-                             osd_id, e)
+                await self.revive_oldest()

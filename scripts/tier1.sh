@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Tier-1 gate: the exact ROADMAP.md verify command, plus a fast
+# collection-only smoke mode for CI pre-checks.
+#
+#   scripts/tier1.sh                run the full tier-1 suite
+#   scripts/tier1.sh --collect-only just prove collection is clean
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--collect-only" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        --collect-only -m 'not slow' -p no:cacheprovider \
+        -p no:xdist -p no:randomly
+fi
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc

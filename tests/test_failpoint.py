@@ -1,0 +1,260 @@
+"""Failpoint registry, backoff, hedged EC reads, seeded chaos replay.
+
+The robustness surface in one place: the cluster-wide named-failpoint
+registry (ceph_tpu.common.failpoint), the deterministic retry backoff,
+the hedged read path of ECBackend under an injected shard stall, and the
+one-seed-replays-everything property of the chaos harness."""
+
+import asyncio
+import errno
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common import failpoint as fp
+from ceph_tpu.common.backoff import ExpBackoff
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.osd.ec_backend import ECBackend, LocalShard, ShardReadError
+from ceph_tpu.store import CollectionId, MemStore, Transaction
+
+K, M = 4, 2
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.fp_clear()
+    fp.set_seed(0)
+    yield
+    fp.fp_clear()
+    fp.set_seed(0)
+
+
+# -- registry ------------------------------------------------------------
+def test_modes_and_active_flag():
+    assert fp.ACTIVE is False
+    f = fp.fp_set("x.point", "error", errno=errno.ENOSPC)
+    assert fp.ACTIVE is True
+    with pytest.raises(fp.FailPointError) as ei:
+        fp.fire_sync("x.point")
+    assert ei.value.errno == errno.ENOSPC
+    assert ei.value.failpoint == "x.point"
+    assert f.fired == 1
+
+    fp.fp_set("x.point", "crash")
+    with pytest.raises(fp.FailPointCrash):
+        fp.fire_sync("x.point")
+
+    fp.fp_set("x.point", "off")
+    fp.fire_sync("x.point")          # inert
+    assert fp.ACTIVE is False
+
+    fp.fp_clear("x.point")
+    assert fp.fp_get("x.point") is None
+
+
+def test_count_exhaustion_flips_off():
+    fp.fp_set("x.count", "error", count=2)
+    for _ in range(2):
+        with pytest.raises(fp.FailPointError):
+            fp.fire_sync("x.count")
+    fp.fire_sync("x.count")          # exhausted: inert again
+    assert fp.fp_get("x.count").mode == "off"
+    assert fp.ACTIVE is False
+
+
+def test_delay_mode_sleeps_async_only():
+    fp.fp_set("x.delay", "delay", delay=0.01)
+
+    async def fire():
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await fp.fire("x.delay")
+        return loop.time() - t0
+
+    assert _run(fire()) >= 0.01
+    fp.fire_sync("x.delay")          # counted, not slept, no raise
+    assert fp.fp_get("x.delay").fired >= 2
+
+
+def test_prob_draws_are_seeded():
+    def draws(seed):
+        fp.fp_clear()
+        fp.set_seed(seed)
+        fp.fp_set("x.prob", "prob", p=0.5)
+        out = []
+        for _ in range(64):
+            try:
+                fp.fire_sync("x.prob")
+                out.append(0)
+            except fp.FailPointError:
+                out.append(1)
+        return out
+
+    assert draws(7) == draws(7)
+    assert draws(7) != draws(8)
+
+
+def test_legacy_aliases_translate():
+    fp.fp_set("ms_inject_socket_failures", "prob", p=1.0)
+    assert fp.fp_get("msgr.send").mode == "prob"
+    fp.fp_set("ms_inject_delay_max", "delay", delay=0.25)
+    assert fp.fp_get("msgr.deliver").delay == 0.25
+    fp.fp_clear("ms_inject_socket_failures")
+    assert fp.fp_get("msgr.send") is None
+
+
+def test_apply_spec_grammar():
+    fp.apply_spec("osd.sub_op=delay:0.05,msgr.send=prob:0.25:107,"
+                  "mon.paxos_commit=error")
+    assert fp.fp_get("osd.sub_op").describe() == {
+        "mode": "delay", "delay": 0.05, "hits": 0, "fired": 0,
+    }
+    assert fp.fp_get("msgr.send").p == 0.25
+    assert fp.fp_get("msgr.send").errno == 107
+    assert fp.fp_get("mon.paxos_commit").mode == "error"
+    assert set(fp.ls()) == {"osd.sub_op", "msgr.send", "mon.paxos_commit"}
+
+
+def test_admin_socket_verbs():
+    registered = {}
+
+    class FakeAsok:
+        def register(self, prefix, handler, help=""):
+            registered[prefix] = handler
+
+    fp.register_admin_commands(FakeAsok())
+    assert set(registered) == {"failpoint ls", "failpoint set",
+                               "failpoint clear"}
+    out = registered["failpoint set"](name="a.b", mode="delay",
+                                      delay="0.5")
+    assert out == {"a.b": {"mode": "delay", "delay": 0.5,
+                           "hits": 0, "fired": 0}}
+    assert "a.b" in registered["failpoint ls"]()
+    assert registered["failpoint clear"](name="a.b") == {"cleared": "a.b"}
+    assert fp.fp_get("a.b") is None
+
+
+# -- backoff -------------------------------------------------------------
+def test_backoff_caps_and_replays():
+    a = ExpBackoff(base=0.05, cap=0.4, factor=2.0, seed=3, name="t")
+    b = ExpBackoff(base=0.05, cap=0.4, factor=2.0, seed=3, name="t")
+    da = [a.next_delay() for _ in range(8)]
+    db = [b.next_delay() for _ in range(8)]
+    assert da == db                       # same (seed, name) -> same jitter
+    assert all(d <= 0.4 for d in da)      # cap holds through the jitter
+    assert da[0] < da[-1]                 # grows toward the cap
+    c = ExpBackoff(base=0.05, cap=0.4, factor=2.0, seed=4, name="t")
+    assert [c.next_delay() for _ in range(8)] != da
+    a.reset()
+    assert [a.next_delay() for _ in range(8)] != da  # jitter stream advances
+
+
+# -- hedged EC reads -----------------------------------------------------
+@pytest.fixture()
+def hedged_backend():
+    registry = ErasureCodePluginRegistry()
+    codec = registry.factory(
+        "jax_rs", {"k": str(K), "m": str(M), "technique": "cauchy_good"}
+    )
+    shards = {}
+    for i in range(K + M):
+        store = MemStore()
+        cid = CollectionId(1, 0, shard=i)
+        _run(store.queue_transactions(
+            Transaction().create_collection(cid)
+        ))
+        shards[i] = LocalShard(store, cid, pool=1, shard=i)
+    return ECBackend(codec, shards, stripe_unit=128, hedge_timeout=0.05)
+
+
+def _payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, np.uint8
+    ).tobytes()
+
+
+def test_hedged_read_healthy_path_does_not_hedge(hedged_backend):
+    data = _payload(4096)
+    _run(hedged_backend.write("obj", data))
+    assert _run(hedged_backend.read("obj")) == data
+    assert hedged_backend.perf.dump()["hedge_issued"] == 0
+
+
+def test_hedged_read_bit_identical_under_shard_stall(hedged_backend):
+    data = _payload(8192, seed=5)
+    _run(hedged_backend.write("obj", data))
+    healthy = _run(hedged_backend.read("obj"))
+    assert healthy == data
+
+    # stall ONE data shard well past the hedge timeout: the read must
+    # fan out and reconstruct from the survivors, bit-identically
+    fp.fp_set("ec.shard_read.2", "delay", delay=0.5)
+    assert _run(hedged_backend.read("obj")) == data
+    d = hedged_backend.perf.dump()
+    assert d["hedge_issued"] == 1
+    assert d["hedge_won"] == 1
+
+
+def test_hedged_read_beyond_m_stalls_waits_for_stragglers(hedged_backend):
+    data = _payload(8192, seed=6)
+    _run(hedged_backend.write("obj", data))
+    # m+1 slow shards: reconstruction is impossible, so the hedge loses
+    # and the stragglers' direct reads must still serve the bytes
+    for i in (1, 2, 3):
+        fp.fp_set(f"ec.shard_read.{i}", "delay", delay=0.2)
+    assert _run(hedged_backend.read("obj")) == data
+    d = hedged_backend.perf.dump()
+    assert d["hedge_issued"] == 1
+    assert d["hedge_lost"] == 1
+
+
+def test_shard_read_error_failpoint_reconstructs(hedged_backend):
+    data = _payload(4096, seed=7)
+    _run(hedged_backend.write("obj", data))
+    fp.fp_set("ec.shard_read.0", "error")
+    assert _run(hedged_backend.read("obj")) == data
+
+
+# -- seeded chaos --------------------------------------------------------
+@pytest.fixture()
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def test_chaos_two_runs_same_seed_same_schedule(_clean_local):
+    from ceph_tpu.testing import run_chaos
+
+    async def twice():
+        r1 = await run_chaos(seed=12)
+        reset_local_namespace()
+        r2 = await run_chaos(seed=12)
+        return r1, r2
+
+    r1, r2 = _run(twice())
+    assert r1["schedule"] == r2["schedule"]
+    assert r1["schedule"], "plan produced no events"
+    assert r1["verified"] and r2["verified"]
+    assert r1["checks"] > 0 and r1["ops_done"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_multiple_seeds_verify(_clean_local):
+    from ceph_tpu.testing import run_chaos
+
+    async def sweep():
+        out = []
+        for seed in (0, 7):
+            reset_local_namespace()
+            out.append(await run_chaos(seed=seed))
+        return out
+
+    for r in _run(sweep()):
+        assert r["verified"]
+        assert r["kills"] <= r["revives"] + 1
