@@ -186,12 +186,20 @@ def _acquire_backend_with_budget() -> None:
             time.sleep(min(30.0 * attempt, 120.0))
 
 
+class BudgetExceeded(TimeoutError):
+    """_guard_budget's refusal to start a stage.  A DEDICATED type so
+    the __main__ fallback can distinguish 'the claim ate the budget'
+    (environment failure -> stale headline applies) from any other
+    TimeoutError — a mid-measurement socket timeout must NOT masquerade
+    as a budget refusal and publish a stale value."""
+
+
 def _guard_budget(stage: str) -> None:
     """Refuse to start a timed stage there is no budget left to finish —
     the watchdog would kill it mid-flight anyway (weak #1: re-verify the
     claim/budget immediately before each timed section)."""
     if _elapsed() > BUDGET_S - 90:
-        raise TimeoutError(
+        raise BudgetExceeded(
             f"budget exhausted before stage {stage!r} "
             f"({_elapsed():.0f}s elapsed of {BUDGET_S:.0f}s)"
         )
@@ -420,13 +428,14 @@ if __name__ == "__main__":
         main()
     except BaseException as exc:
         if not _SUCCESS_PRINTED:
-            # TimeoutError here is _guard_budget refusing to start a
+            # BudgetExceeded is _guard_budget refusing to start a
             # stage (claim ate the budget) — an environment failure, so
             # the stale value applies; anything else (a correctness-gate
-            # or measurement failure) must report 0.0.
+            # or measurement failure, including a bare socket/measure
+            # TimeoutError) must report 0.0.
             _print_fallback(
                 f"bench failed after {_elapsed():.0f}s: {exc!r}",
                 provisional=False,
-                allow_stale=isinstance(exc, TimeoutError),
+                allow_stale=isinstance(exc, BudgetExceeded),
             )
         raise

@@ -1059,7 +1059,29 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# offline tool passthrough: `ceph-tpu tool <name> ...` hands argv to
+# the DR tool suite's own entry points.  These operate on STOPPED
+# daemons' store directories, so no cluster connection is attempted —
+# they must work precisely when the cluster is gone.
+_TOOLS = {
+    "monstore": "ceph_tpu.tools.monstore_tool",
+    "osdmap": "ceph_tpu.tools.osdmaptool",
+    "monmap": "ceph_tpu.tools.monmaptool",
+    "objectstore": "ceph_tpu.objectstore_tool",
+}
+
+
 def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["tool"]:
+        if len(argv) < 2 or argv[1] not in _TOOLS:
+            names = "|".join(sorted(_TOOLS))
+            print(f"usage: ceph-tpu tool {{{names}}} ...",
+                  file=sys.stderr)
+            return 2
+        import importlib
+
+        return importlib.import_module(_TOOLS[argv[1]]).main(argv[2:])
     args = build_parser().parse_args(argv)
     return asyncio.run(_run(args))
 

@@ -78,6 +78,10 @@ def global_options() -> list[Option]:
                "max concurrent recovery ops", min=1),
         Option("osd_pg_log_max_entries", int, 250,
                "retained pg log entries per PG (trim boundary)", min=8),
+        Option("osd_map_history_keep", int, 64,
+               "full OSDMap epochs each OSD persists in its meta "
+               "collection (the mon-store rebuild harvest source; "
+               "0 = off)", min=0),
         Option("osd_op_queue", str, "mclock_scheduler",
                "op scheduler: mclock_scheduler or fifo",
                enum_values=("mclock_scheduler", "fifo")),

@@ -724,6 +724,20 @@ class MDSDaemon:
                     # base object, our liveness witness, just served
                     # the fragtree)
                     kv = {}
+        if name not in kv and not snapid:
+            # name miss through a CACHED tree: a split/merge since the
+            # cache fill may have moved the name to a sibling frag that
+            # still exists (no ENOENT to trip the retry above) — one
+            # forced re-read before declaring the name absent
+            fresh = await self._fragtree(parent, refresh=True)
+            if frag_for(fresh, name) != frag_for(tree, name):
+                oid = frag_oid(parent, *frag_for(fresh, name))
+                try:
+                    kv = await self.meta.get_omap(oid, [name])
+                except RadosError as e:
+                    if e.rc != ENOENT:
+                        raise
+                    kv = {}
         if name not in kv:
             raise MDSError(ENOENT, f"{name!r} not in {parent:x}",
                            missing_dentry=True)
@@ -767,7 +781,13 @@ class MDSDaemon:
 
     async def _set_dentry(self, parent: int, name: str,
                           dentry: dict) -> None:
-        tree = await self._fragtree(parent)
+        # writing into a directory OUTSIDE this rank's subtrees (a
+        # cross-rank rename destination import, replay of a foreign
+        # chain): the owning rank may have split/merged the tree
+        # without our invalidation hooks firing — force a re-read so
+        # the dentry lands in a live frag, not a retired one
+        foreign = (await self._auth_rank(parent)) != self.rank
+        tree = await self._fragtree(parent, refresh=foreign)
         b, v = frag_for(tree, name)
         oid = frag_oid(parent, b, v)
         # counts track ENTRIES, not operations: an overwrite (setattr,

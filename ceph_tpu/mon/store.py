@@ -111,14 +111,19 @@ class MonitorDBStore:
         if self._wal is not None and self._wal_bytes > COMPACT_BYTES:
             self._compact()
 
-    def _compact(self) -> None:
-        """Rewrite the WAL as one snapshot transaction (the RocksDB
-        compaction role): erased/overwritten history is dropped."""
+    def snapshot_tx(self) -> StoreTransaction:
+        """The whole store as one transaction (compaction and the
+        offline rebuild tool's install payload share this shape)."""
         snap = StoreTransaction()
         for prefix, kv in self._data.items():
             for key, value in kv.items():
                 snap.put(prefix, key, value)
-        raw = snap.encode()
+        return snap
+
+    def _compact(self) -> None:
+        """Rewrite the WAL as one snapshot transaction (the RocksDB
+        compaction role): erased/overwritten history is dropped."""
+        raw = self.snapshot_tx().encode()
         tmp = self._wal_path + ".compact"
         with open(tmp, "wb") as f:
             f.write(_LEN.pack(len(raw)) + raw)
@@ -128,6 +133,46 @@ class MonitorDBStore:
         os.replace(tmp, self._wal_path)
         self._wal = open(self._wal_path, "ab")
         self._wal_bytes = os.path.getsize(self._wal_path)
+
+    # -- offline access (monstore_tool) ----------------------------------
+    @classmethod
+    def open_readonly(cls, path: str) -> "MonitorDBStore":
+        """Replay an existing store WAL WITHOUT opening it for append:
+        the offline dump/inspect path of monstore_tool — a live monitor
+        (or a second tool invocation) keeps exclusive write ownership.
+        Raises FileNotFoundError when no store exists at ``path``."""
+        wal = os.path.join(path, "store.wal")
+        if not os.path.exists(wal):
+            raise FileNotFoundError(f"no monitor store at {path}")
+        st = cls(None)
+        st._replay(wal)
+        return st
+
+    @staticmethod
+    def install(path: str, tx: StoreTransaction) -> str:
+        """Two-phase atomic store swap (the rebuild commit): phase 1
+        writes the complete new store as one snapshot frame to a
+        sidecar file and makes it durable; phase 2 publishes it with a
+        single atomic rename.  A crash between the phases leaves the
+        old store untouched; a pre-existing store is preserved as
+        ``store.wal.old`` for forensics.  Returns the WAL path."""
+        os.makedirs(path, exist_ok=True)
+        wal = os.path.join(path, "store.wal")
+        raw = tx.encode()
+        tmp = wal + ".new"
+        with open(tmp, "wb") as f:                 # phase 1: prepare
+            f.write(_LEN.pack(len(raw)) + raw)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(wal):                    # keep the corpse
+            os.replace(wal, wal + ".old")
+        os.replace(tmp, wal)                       # phase 2: commit
+        dfd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        return wal
 
     # -- reads -----------------------------------------------------------
     def get(self, prefix: str, key: str) -> bytes | None:

@@ -29,6 +29,60 @@ from ceph_tpu.store.walstore import WalStore
 # naming) so listings cross-reference the on-disk collection names;
 # --ps is therefore parsed as hex
 
+# The OSD's meta collection (mirrors OSDDaemon._SUPER_CID/_SUPER_OID/
+# _MAPS_OID — asserted identical by tests): superblock omap plus the
+# bounded OSDMap-epoch history monstore_tool harvests for rebuild.
+META_CID = CollectionId(-1, 0)
+SUPERBLOCK_OID = GHObject(-1, "_osd_superblock")
+MAPS_OID = GHObject(-1, "_osd_maps")
+
+
+def open_store(data_path: str):
+    """Offline store for a stopped OSD's directory: sniff the on-disk
+    layout — ``colls/`` marks a FileStore, anything else mounts as a
+    WalStore (checkpoint + WAL replay, exactly as the OSD would)."""
+    import os
+
+    if os.path.isdir(os.path.join(data_path, "colls")):
+        from ceph_tpu.store.filestore import FileStore
+
+        return FileStore(data_path)
+    return WalStore(data_path)
+
+
+async def harvest_meta(data_path: str) -> dict:
+    """Read one stopped OSD's DR-harvest material (the update-mon-db
+    source): every persisted full OSDMap epoch, the superblock's
+    pool->pg_num view, and the last rotating-service-secret snapshot.
+    Returns {"epochs": {epoch: map_dict}, "pool_pg_num": {...},
+    "service_secrets": {epoch: secret}}."""
+    from ceph_tpu.msg.codec import decode
+
+    store = open_store(data_path)
+    await store.mount()
+    try:
+        out = {"epochs": {}, "pool_pg_num": {}, "service_secrets": {}}
+        try:
+            omap = store.omap_get(META_CID, MAPS_OID)
+        except KeyError:
+            omap = {}
+        for k, v in omap.items():
+            if k.startswith("full_"):
+                out["epochs"][int(k[len("full_"):])] = decode(v)
+            elif k == "service_secrets":
+                out["service_secrets"] = {
+                    int(e): str(s)
+                    for e, s in json.loads(v).items()
+                }
+        try:
+            sb = store.omap_get(META_CID, SUPERBLOCK_OID)
+        except KeyError:
+            sb = {}
+        out["pool_pg_num"] = {int(k): int(v) for k, v in sb.items()}
+        return out
+    finally:
+        await store.umount()
+
 
 def _oid_json(oid: GHObject) -> dict:
     out = {"name": oid.name}
@@ -42,7 +96,17 @@ def _oid_json(oid: GHObject) -> dict:
 
 
 async def _run(args) -> int:
-    store = WalStore(args.data_path)
+    if args.op == "meta":
+        meta = await harvest_meta(args.data_path)
+        print(json.dumps({
+            "data_path": args.data_path,
+            "osdmap_epochs": sorted(meta["epochs"]),
+            "newest_epoch": max(meta["epochs"], default=0),
+            "pool_pg_num": meta["pool_pg_num"],
+            "service_secret_epochs": sorted(meta["service_secrets"]),
+        }, indent=2))
+        return 0
+    store = open_store(args.data_path)
     await store.mount()
     try:
         if args.op == "list":
@@ -101,7 +165,7 @@ def main(argv=None) -> int:
     p.add_argument("--data-path", required=True,
                    help="a WalStore directory (osd store_dir)")
     p.add_argument("--op", required=True,
-                   choices=["list", "dump", "export", "info"])
+                   choices=["list", "dump", "export", "info", "meta"])
     p.add_argument("--pool", type=int, default=0)
     p.add_argument("--ps", type=lambda s: int(s, 16),
                default=0, help="pg id (hex, as listed)")

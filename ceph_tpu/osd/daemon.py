@@ -36,6 +36,7 @@ from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.common.tracing import SpanCtx, Tracer
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
 from ceph_tpu.mon.client import MonClient
+from ceph_tpu.msg.codec import encode
 from ceph_tpu.msg.message import PRIO_HIGH, Message
 from ceph_tpu.msg.messenger import Connection, Messenger, Policy
 from ceph_tpu.osd.ec_backend import (
@@ -748,6 +749,13 @@ class OSDDaemon:
                         f"osd.{osd} marked down (map e{osdmap.epoch})"
                     ))
             await self._scan_pgs()
+            try:
+                await self._save_map_history(osdmap)
+            except Exception as e:  # noqa: BLE001
+                # harvest metadata is best-effort; map handling and
+                # peering must never stall on it
+                log.derr("%s: map-history persist failed: %s",
+                         self.entity, e)
         for pg in self.pgs.values():
             if pg.state == STATE_ACTIVE:
                 self._kick_snaptrim(pg)
@@ -775,6 +783,42 @@ class OSDDaemon:
 
     _SUPER_CID = CollectionId(-1, 0)
     _SUPER_OID = GHObject(-1, "_osd_superblock")
+    # DR harvest metadata: a bounded history of full OSDMaps plus the
+    # latest rotating-service-secret snapshot, persisted beside the
+    # superblock so an offline `monstore_tool rebuild` has map + auth
+    # material to read after total monitor loss (the reference's
+    # OSD::store_map / ceph-objectstore-tool update-mon-db source)
+    _MAPS_OID = GHObject(-1, "_osd_maps")
+
+    async def _save_map_history(self, osdmap: OSDMap) -> None:
+        keep = int(self.conf["osd_map_history_keep"])
+        if keep <= 0 or osdmap.epoch <= 0:
+            return
+        try:
+            cur = self.store.omap_get(self._SUPER_CID, self._MAPS_OID)
+        except KeyError:
+            cur = {}
+        key = f"full_{osdmap.epoch:010d}"
+        if key in cur:
+            return
+        tx = StoreTx()
+        try:
+            self.store.list_objects(self._SUPER_CID)
+        except KeyError:
+            tx.create_collection(self._SUPER_CID)
+        tx.touch(self._SUPER_CID, self._MAPS_OID)
+        kv = {key: encode(osdmap.to_dict())}
+        if self._service_secrets:
+            kv["service_secrets"] = json.dumps({
+                str(e): s for e, s in self._service_secrets.items()
+            }).encode()
+        tx.omap_setkeys(self._SUPER_CID, self._MAPS_OID, kv)
+        epochs = sorted(k for k in cur if k.startswith("full_"))
+        epochs.append(key)
+        if len(epochs) > keep:
+            tx.omap_rmkeys(self._SUPER_CID, self._MAPS_OID,
+                           epochs[:len(epochs) - keep])
+        await self.store.queue_transactions(tx)
 
     def _load_superblock(self) -> None:
         try:

@@ -285,6 +285,7 @@ class ECBackend:
         self._mesh_gen = np.asarray(gen, np.uint8) \
             if self.mesh is not None else None
         self._mesh_appliers: dict[tuple, object] = {}
+        self._mesh_enc_applier = None   # pinned write-path encoder
         # observability: proves which plane served a batch (tests and
         # perf counters read these)
         self.mesh_stats = {"encodes": 0, "decodes": 0}
@@ -341,11 +342,22 @@ class ECBackend:
     _MESH_APPLIER_CAP = 64
 
     def _mesh_applier(self, key: tuple, coeff_fn):
-        """Bounded compile cache (FIFO, like the codec's decode-matrix
-        cache): each entry pins a jitted XLA executable, and survivor/
-        lost combinations are combinatorial in a long-lived OSD.
+        """Bounded compile cache (LRU): each entry pins a jitted XLA
+        executable, and survivor/lost combinations are combinatorial
+        in a long-lived OSD.  The ``('enc',)`` write-path encoder is
+        PINNED outside the bounded table — a burst of 64 distinct
+        decode combos (a wide failure) must not evict the encoder into
+        a repeated XLA recompile on every subsequent write.
         ``coeff_fn`` builds the coefficient matrix only on a miss —
         steady-state degraded reads are matrix-math-free."""
+        if key == ("enc",):
+            ap = self._mesh_enc_applier
+            if ap is None:
+                from ceph_tpu.parallel.ec_sharding import ShardedApplier
+
+                ap = ShardedApplier(self.mesh, coeff_fn())
+                self._mesh_enc_applier = ap
+            return ap
         ap = self._mesh_appliers.get(key)
         if ap is None:
             from ceph_tpu.parallel.ec_sharding import ShardedApplier
@@ -354,6 +366,11 @@ class ECBackend:
                 self._mesh_appliers.pop(
                     next(iter(self._mesh_appliers)))
             ap = ShardedApplier(self.mesh, coeff_fn())
+            self._mesh_appliers[key] = ap
+        else:
+            # LRU, not FIFO: re-insert on hit so the eviction scan's
+            # first key is always the least-recently-used entry
+            self._mesh_appliers.pop(key)
             self._mesh_appliers[key] = ap
         return ap
 

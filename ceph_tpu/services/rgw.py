@@ -2655,14 +2655,25 @@ class RGWLite:
         ep = PushEndpoint.make(meta["push_endpoint"],
                                meta.get("ack_level", "broker"))
         oid = self._topic_oid(topic)
-        try:
-            cursor = int(await self.ioctx.get_xattr(oid, "push_cursor"))
-        except RadosError as e:
-            if e.rc != -2:
-                raise      # a transient read error must not reset the
-            cursor = 0     # cursor and mass-redeliver the whole queue
-        except ValueError:
-            cursor = 0
+        # cursor load rides the same backoff-retry as the batch loop:
+        # a transient RadosError (failover while the worker spawns)
+        # must neither kill the delivery worker nor reset the cursor
+        # and mass-redeliver the whole queue
+        while True:
+            try:
+                cursor = int(await self.ioctx.get_xattr(
+                    oid, "push_cursor"))
+                break
+            except RadosError as e:
+                if e.rc == -2:
+                    cursor = 0     # topic never delivered before
+                    break
+                rgw_log.derr("push %s: cursor load error %s; backing "
+                             "off", topic, e)
+                await asyncio.sleep(1.0)
+            except ValueError:
+                cursor = 0
+                break
         retries = int(meta.get("max_retries", 5))
         sleep0 = float(meta.get("retry_sleep", 0.05))
         down_sleep = sleep0                  # unreachable-endpoint backoff

@@ -265,6 +265,17 @@ class RGWFileSystem:
                 return
             if not sk:
                 raise FSError(EINVAL, "cannot rename a bucket")
+            if db == sb and dk == sk:
+                return     # POSIX: rename to itself is a no-op (the
+                           # copy+delete loop would destroy the tree)
+            if db == sb and dk.startswith(sk + "/"):
+                # POSIX EINVAL: a directory cannot become a
+                # descendant of itself — the member copy loop would
+                # chase keys it is creating and leave a half-moved
+                # tree on both sides of the prefix
+                raise FSError(EINVAL,
+                              f"cannot move {src} into its own "
+                              f"subtree {dst}")
             # directory: move every member, paginated — a truncated
             # listing would silently split the tree across src and dst
             dprefix = (dk + "/") if dk else ""

@@ -33,10 +33,14 @@ class DevCluster:
                  overrides: dict | None = None, tcp: bool = False,
                  base_port: int = 21000, store_dir: str | None = None,
                  store_kind: str = "wal",
-                 cephx: bool = False, ns: str = ""):
+                 cephx: bool = False, ns: str = "",
+                 monmap: dict[str, str] | None = None):
         """``ns``: local:// address namespace prefix so several
         DevClusters (zones) can coexist in one process (the multi-zone
-        / geo-replication test topology)."""
+        / geo-replication test topology).  ``monmap``: explicit
+        name->addr map overriding the generated one — the DR restart
+        path boots a rebuilt cluster against a monmaptool-authored
+        quorum this way."""
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.overrides = dict(FAST_TEST_OVERRIDES)
@@ -59,6 +63,8 @@ class DevCluster:
             }
         else:
             self.monmap = {n: f"local://{ns}mon.{n}" for n in mon_names}
+        if monmap is not None:
+            self.monmap = dict(monmap)
         self.ns = ns
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSDDaemon] = {}
@@ -88,12 +94,8 @@ class DevCluster:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
-        for i, name in enumerate(self.monmap):
-            path = (f"{self.store_dir}/mon.{name}"
-                    if self.store_dir else None)
-            mon = Monitor(name, self.monmap, self.conf(), store_path=path)
-            await mon.start()
-            self.mons[name] = mon
+        for name in self.monmap:
+            await self.start_mon(name)
         if self.cephx:
             # bootstrap the keyring: admin mints each OSD's entity key
             # before its daemon boots (the ceph-authtool/cephadm role)
@@ -151,6 +153,24 @@ class DevCluster:
         await osd.start()
         self.osds[osd_id] = osd
         return osd
+
+    async def start_mon(self, name: str) -> Monitor:
+        """(Re)start one monitor over whatever its store directory
+        holds — after a ``monstore_tool rebuild`` this is the DR
+        restart path."""
+        path = (f"{self.store_dir}/mon.{name}"
+                if self.store_dir else None)
+        mon = Monitor(name, self.monmap, self.conf(), store_path=path)
+        await mon.start()
+        self.mons[name] = mon
+        return mon
+
+    async def kill_mon(self, name: str) -> None:
+        """Hard-stop one monitor; its store directory survives on disk
+        for offline surgery (the kill-all-mons DR scenario driver)."""
+        mon = self.mons.pop(name, None)
+        if mon is not None:
+            await mon.shutdown()
 
     async def kill_osd(self, osd_id: int) -> None:
         """Hard-stop a daemon; its store survives for revive (the

@@ -4,6 +4,10 @@
 #
 #   scripts/tier1.sh                run the full tier-1 suite
 #   scripts/tier1.sh --collect-only just prove collection is clean
+#   scripts/tier1.sh --tools-smoke  DR tool CLI entry points: --help of
+#                                   every tool + a tiny fixture run, so
+#                                   entry-point breakage is caught
+#                                   without the slow e2e
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -11,6 +15,46 @@ if [ "${1:-}" = "--collect-only" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         --collect-only -m 'not slow' -p no:cacheprovider \
         -p no:xdist -p no:randomly
+fi
+
+if [ "${1:-}" = "--tools-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    for mod in ceph_tpu.tools.monstore_tool ceph_tpu.tools.osdmaptool \
+               ceph_tpu.tools.monmaptool ceph_tpu.objectstore_tool; do
+        python -m "$mod" --help > /dev/null
+        echo "ok: $mod --help"
+    done
+    smoke=$(mktemp -d)
+    trap 'rm -rf "$smoke"' EXIT
+    # monmaptool fixture: create, add, rm, print round-trip
+    python -m ceph_tpu.tools.monmaptool "$smoke/cluster.json" --create \
+        --add a local://mon.a --add b local://mon.b > /dev/null
+    python -m ceph_tpu.tools.monmaptool "$smoke/cluster.json" --rm b \
+        > /dev/null
+    python -m ceph_tpu.tools.monmaptool "$smoke/cluster.json" --print \
+        | grep -c 'local://mon.a' > /dev/null
+    echo "ok: monmaptool fixture"
+    # monstore_tool fixture: install a tiny store, dump + get it back
+    python - "$smoke" <<'EOF'
+import sys
+from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
+tx = StoreTransaction().put("osdmap", "last_committed", 3)
+MonitorDBStore.install(sys.argv[1] + "/mon.smoke", tx)
+EOF
+    python -m ceph_tpu.tools.monstore_tool dump \
+        --store-path "$smoke/mon.smoke" | grep -c last_committed \
+        > /dev/null
+    python -m ceph_tpu.tools.monstore_tool get \
+        --store-path "$smoke/mon.smoke" osdmap last_committed \
+        | grep -c '"value": 3' > /dev/null
+    echo "ok: monstore_tool fixture"
+    # cli passthrough dispatch
+    python -m ceph_tpu.cli tool monmap "$smoke/cluster.json" --print \
+        > /dev/null
+    echo "ok: cli tool passthrough"
+    echo "TOOLS_SMOKE_PASSED"
+    exit 0
 fi
 
 rm -f /tmp/_t1.log
