@@ -2,13 +2,17 @@
 
 The role of reference src/rgw/rgw_admin.cc reduced to the surfaces our
 RGW-lite implements: user management + quotas, bucket listing/stats,
-ACLs, lifecycle processing.
+ACLs, lifecycle processing, zone placement targets (per-storage-class
+data pools).
 
 Usage:
     python -m ceph_tpu.rgw_admin --conf cluster.json --pool rgw \
         user create --uid alice
     python -m ceph_tpu.rgw_admin ... bucket stats --bucket site
     python -m ceph_tpu.rgw_admin ... lc process
+    python -m ceph_tpu.rgw_admin ... zone placement add \
+        --storage-class COLD --data-pool rgw.cold \
+        --ec-profile rgw_cold --create-pool
 """
 
 from __future__ import annotations
@@ -108,6 +112,28 @@ async def _dispatch(args, gw: RGWLite, users: RGWUsers):
             return await gw.gc_list()
         if args.sub == "process":
             return {"reaped": await gw.gc_process()}
+    if args.cmd == "zone" and args.sub == "placement":
+        # placement targets live in the zone's own pool — no realm
+        # topology required (rgw_zone.h RGWZonePlacementInfo verbs)
+        from ceph_tpu.services.rgw_zone import ZonePlacement
+
+        zp = ZonePlacement(gw.ioctx)
+        if args.psub in ("add", "modify"):
+            fn = zp.add if args.psub == "add" else zp.modify
+            return await fn(
+                args.placement_id,
+                storage_class=args.storage_class,
+                data_pool=args.data_pool,
+                compression=args.compression,
+                ec_profile=args.ec_profile,
+                ec_k=args.ec_k, ec_m=args.ec_m,
+                create_pool=args.create_pool, pg_num=args.pg_num)
+        if args.psub == "rm":
+            await zp.rm(args.placement_id,
+                        args.storage_class or None)
+            return {"removed": args.placement_id}
+        if args.psub == "ls":
+            return await zp.ls()
     if args.cmd in ("realm", "zonegroup", "zone", "period"):
         from ceph_tpu.services.rgw_zone import RealmStore
 
@@ -237,6 +263,24 @@ def build_parser() -> argparse.ArgumentParser:
         if name != "rm":
             x.add_argument("--endpoint", default="")
             x.add_argument("--master", action="store_true")
+    # zone placement targets: per-storage-class data pools
+    placement = zone_sub.add_parser("placement")
+    pl_sub = placement.add_subparsers(dest="psub", required=True)
+    for name in ("add", "modify"):
+        x = pl_sub.add_parser(name)
+        x.add_argument("--placement-id", default="default-placement")
+        x.add_argument("--storage-class", default="STANDARD")
+        x.add_argument("--data-pool", default="")
+        x.add_argument("--compression", default="")
+        x.add_argument("--ec-profile", default="")
+        x.add_argument("--ec-k", type=int, default=2)
+        x.add_argument("--ec-m", type=int, default=1)
+        x.add_argument("--create-pool", action="store_true")
+        x.add_argument("--pg-num", type=int, default=8)
+    plrm = pl_sub.add_parser("rm")
+    plrm.add_argument("--placement-id", default="default-placement")
+    plrm.add_argument("--storage-class", default="")
+    pl_sub.add_parser("ls")
 
     period = sub.add_parser("period")
     period_sub = period.add_subparsers(dest="sub", required=True)

@@ -25,6 +25,16 @@ configured — reads stay open):
 
 Every write maps 1:1 onto an existing, paxos-audited mon command —
 the dashboard adds reach, not new authority.
+
+Object-gateway panels (shown when a vstart RGW attaches itself via
+``attach_rgw``; the JSON routes ride the same token gate as the
+management API because placement records and lifecycle policies name
+internal pools):
+
+- ``GET /api/rgw/placement``          zone placement targets: every
+  storage class with its data pool / compression / EC profile.
+- ``GET /api/rgw/lifecycle``          per-bucket lifecycle rules
+  (expiration + transition); ``?bucket=<name>`` narrows to one.
 """
 
 from __future__ import annotations
@@ -47,8 +57,13 @@ class Dashboard:
         self.host = host
         self.port = port
         self.api_token = api_token
+        self.rgw = None             # RGWLite, via attach_rgw()
         self._server: asyncio.AbstractServer | None = None
         self._metrics_cache: tuple[float, bytes] = (0.0, b"")
+
+    def attach_rgw(self, gw) -> None:
+        """Expose an RGWLite's placement + lifecycle state read-only."""
+        self.rgw = gw
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
@@ -75,7 +90,12 @@ class Dashboard:
                 k, _, v = ln.partition(":")
                 headers[k.strip().lower()] = v.strip()
             method, path, _ = (line.split(" ", 2) + ["", ""])[:3]
-            path = path.split("?", 1)[0]
+            path, _, rawq = path.partition("?")
+            query: dict[str, str] = {}
+            for pair in rawq.split("&"):
+                if pair:
+                    k, _, v = pair.partition("=")
+                    query[k] = v
             req_body = b""
             clen = int(headers.get("content-length", 0) or 0)
             if clen:
@@ -107,6 +127,9 @@ class Dashboard:
                 else:
                     body = json.dumps(data).encode()
                     ctype, status = "application/json", 200
+            elif path in ("/api/rgw/placement", "/api/rgw/lifecycle"):
+                status, body = await self._rgw_get(path, headers, query)
+                ctype = "application/json"
             elif path == "/metrics":
                 # collect() messages every OSD; cache briefly so an
                 # aggressive scraper doesn't multiply cluster traffic
@@ -228,6 +251,40 @@ class Dashboard:
             return await mon("health unmute",
                              code=str(args.get("code", "")))
         return reply(404, error="unknown route")
+
+    # -- object gateway (placement targets + lifecycle) --------------------
+    async def _rgw_get(self, path: str, headers: dict,
+                       query: dict) -> tuple[int, bytes]:
+        def reply(status: int, data) -> tuple[int, bytes]:
+            return status, json.dumps(data).encode()
+
+        # placement records name internal pools and lifecycle rules
+        # reveal bucket names — gate like the management API
+        if not self._authorized(headers):
+            return reply(403, {"error": "missing or bad api token"})
+        if self.rgw is None:
+            return reply(503, {"error": "no rgw attached"})
+        if path == "/api/rgw/placement":
+            return reply(200, await self._rgw_placement())
+        return reply(200, await self._rgw_lifecycle(
+            query.get("bucket") or None))
+
+    async def _rgw_placement(self) -> list[dict]:
+        from ceph_tpu.services.rgw_zone import ZonePlacement
+        return await ZonePlacement(self.rgw.ioctx).ls()
+
+    async def _rgw_lifecycle(self, bucket: str | None = None) -> dict:
+        out: dict = {}
+        names = [bucket] if bucket else await self.rgw.list_buckets()
+        for name in names:
+            try:
+                meta = await self.rgw._bucket_meta(name)
+            except Exception:               # noqa: BLE001 — racing rm
+                continue
+            rules = meta.get("lifecycle") or []
+            if rules:
+                out[name] = rules
+        return out
 
     async def _osd_list(self) -> list[dict]:
         dump = await self._mon("osd dump") or {}
@@ -371,6 +428,61 @@ class Dashboard:
             walk(root, 0)
         section("OSD tree", table(["name", "type", "status", "reweight"],
                                   tree_rows))
+
+        if self.rgw is not None:
+            # object-gateway panels: where each storage class lands
+            # and which buckets have tiering/expiration policies
+            try:
+                placements = await self._rgw_placement()
+            except Exception:           # noqa: BLE001 — rgw racing
+                placements = []
+            pl_rows = []
+            for rec in placements:
+                classes = rec.get("storage_classes") or {}
+                for cls, c in sorted(classes.items()):
+                    pl_rows.append([
+                        esc(rec.get("id", "")), esc(cls),
+                        esc(c.get("pool", "") or "(zone pool)"),
+                        esc(c.get("compression", "") or "-"),
+                        esc(c.get("ec_profile", "") or "-")])
+            section("RGW placement targets", table(
+                ["placement", "class", "data pool", "compression",
+                 "ec profile"], pl_rows)
+                if pl_rows else "<p>no placement targets</p>")
+
+            try:
+                lc = await self._rgw_lifecycle()
+            except Exception:           # noqa: BLE001
+                lc = {}
+            lc_rows = []
+            for bname, rules in sorted(lc.items()):
+                for r in rules:
+                    acts = []
+                    for kind, label in (
+                            ("expiration", "expire"),
+                            ("noncurrent", "expire-noncurrent"),
+                            ("abort_mpu", "abort-mpu"),
+                            ("transition", "transition"),
+                            ("noncurrent_transition",
+                             "transition-noncurrent")):
+                        if f"{kind}_seconds" in r:
+                            t = f"{r[f'{kind}_seconds']}s"
+                        elif f"{kind}_days" in r:
+                            t = f"{r[f'{kind}_days']}d"
+                        else:
+                            continue
+                        cls = r.get(f"{kind}_class", "")
+                        acts.append(f"{label} {t}"
+                                    + (f" → {cls}" if cls else ""))
+                    lc_rows.append([
+                        esc(bname), esc(r.get("id", "")),
+                        esc(r.get("prefix", "") or "-"),
+                        esc(r.get("status", "")),
+                        esc("; ".join(acts))])
+            if lc_rows:
+                section("RGW lifecycle", table(
+                    ["bucket", "rule", "prefix", "status", "actions"],
+                    lc_rows))
 
         if self.api_token:
             # operations panel: every button drives the token-gated

@@ -372,6 +372,11 @@ class StreamingPut:
         self._blkbuf = bytearray() if self._striped else None
         self._blocks: list[list[int]] = []
 
+    async def _handles(self):
+        # the placement pool this object's storage class resolved to
+        # (zone pool when ctx carries none)
+        return await self._rgw._data_handles(self._ctx.get("pool"))
+
     def set_sse_key(self, key: bytes) -> None:
         if self._pos:
             raise RGWError("InvalidRequest",
@@ -413,8 +418,9 @@ class StreamingPut:
                         bytes(self._blkbuf[:COMP_BLOCK]))
                     del self._blkbuf[:COMP_BLOCK]
             else:
-                await self._rgw.striper.write(self._ctx["oid"], chunk,
-                                              offset=self._pos)
+                _, striper = await self._handles()
+                await striper.write(self._ctx["oid"], chunk,
+                                    offset=self._pos)
         else:
             self._buf += chunk
         self._pos += len(chunk)
@@ -424,8 +430,9 @@ class StreamingPut:
         # body can't be un-written, and per-block framing overhead is
         # ~0.03% worst case) so reads seek straight to any block
         packed = get_compressor(self._comp_alg).compress(raw)
-        await self._rgw.striper.write(self._ctx["oid"], packed,
-                                      offset=self._cpos)
+        _, striper = await self._handles()
+        await striper.write(self._ctx["oid"], packed,
+                            offset=self._cpos)
         self._blocks.append([len(raw), len(packed)])
         self._cpos += len(packed)
 
@@ -445,7 +452,8 @@ class StreamingPut:
             data = bytes(self._buf)
             if self._comp_alg is not None:
                 data, comp = deflate_if_smaller(data, self._comp_alg)
-            await self._rgw.ioctx.operate(
+            ioctx, _ = await self._handles()
+            await ioctx.operate(
                 self._ctx["oid"],
                 ObjectOperation().write_full(data))
         # replaced object's data (and version-store adoption) happen
@@ -467,10 +475,11 @@ class StreamingPut:
     async def abort(self) -> None:
         """Drop any data already landed; the index was never touched."""
         try:
+            ioctx, striper = await self._handles()
             if self._striped:
-                await self._rgw.striper.remove(self._ctx["oid"])
+                await striper.remove(self._ctx["oid"])
             else:
-                await self._rgw.ioctx.remove(self._ctx["oid"])
+                await ioctx.remove(self._ctx["oid"])
         except RadosError as e:
             if e.rc != -2:
                 raise
@@ -519,6 +528,11 @@ class RGWLite:
             stripe_unit=512 * 1024, stripe_count=4,
             object_size=4 * 1024 * 1024,
         ))
+        # per-storage-class data pool handles (zone placement targets):
+        # pool name -> (IoCtx, RadosStriper).  Shared across as_user
+        # handles like the caches above so one gateway keeps one handle
+        # per tier pool.
+        self._pool_handles: dict[str, tuple] = {}
 
     def as_user(self, user: str | None) -> "RGWLite":
         """A handle acting as ``user`` over the same pool."""
@@ -528,7 +542,50 @@ class RGWLite:
         child._notif_cache = self._notif_cache
         child._pushers = self._pushers
         child._topics_cache = self._topics_cache
+        child._pool_handles = self._pool_handles
         return child
+
+    # -- storage classes / placement pools (rgw_placement_rule) -----------
+    async def _data_handles(self, pool: str | None):
+        """(IoCtx, RadosStriper) for the pool an object's tail lives
+        in.  Falsy / zone-pool -> the gateway's own handles; anything
+        else (a COLD/ARCHIVE class's EC pool) opens once and caches.
+        Index omaps, version records, and multipart metadata always
+        stay in the zone pool — only tails move."""
+        if not pool or pool == self.ioctx.pool_name:
+            return self.ioctx, self.striper
+        got = self._pool_handles.get(pool)
+        if got is None:
+            rados = self.ioctx.rados
+            try:
+                ioctx = await rados.open_ioctx(pool)
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+                # our osdmap may lag a pool another client just
+                # created; wait briefly, then retry once
+                try:
+                    await rados._wait_pool(pool, timeout=5.0)
+                except Exception:
+                    raise RGWError(
+                        "InvalidStorageClass",
+                        f"placement pool {pool!r} does not exist",
+                    ) from None
+                ioctx = await rados.open_ioctx(pool)
+            got = (ioctx, RadosStriper(ioctx, StripeLayout(
+                stripe_unit=512 * 1024, stripe_count=4,
+                object_size=4 * 1024 * 1024,
+            )))
+            self._pool_handles[pool] = got
+        return got
+
+    async def _class_placement(self, storage_class: str) -> dict:
+        """Resolve a storage class through the zone's placement target
+        ({"pool", "compression"}); InvalidStorageClass for classes no
+        placement defines — the error a PUT with a bogus
+        x-amz-storage-class must surface."""
+        from ceph_tpu.services.rgw_zone import ZonePlacement
+        return await ZonePlacement(self.ioctx).resolve(storage_class)
 
     # -- SSE-KMS / SSE-S3 (rgw_kms.h + rgw_crypt.cc wiring) ---------------
     DEFAULT_KMS_KEY = "rgw/default"      # x-amz-...-aws-kms-key-id absent
@@ -1086,18 +1143,23 @@ class RGWLite:
         deletion instead (rgw_gc tail deletion: the index entry dies
         now, the data dies after the grace window)."""
         items: list = []
+        # items carry the tail's placement pool as a third element so
+        # cold-tier tails die in their own pool (absent/None = zone
+        # pool; 2-element entries from older GC queues still parse)
+        pool = rec.get("pool")
         if rec.get("slo"):
             return                  # segments are independent objects
         if rec.get("multipart"):
-            items += [["plain", p["oid"]] for p in rec["multipart"]]
+            items += [["plain", p["oid"], pool]
+                      for p in rec["multipart"]]
         elif rec.get("striped"):
             items.append(["striped",
                           rec.get("data_oid",
-                                  self._data_oid(bucket, key))])
+                                  self._data_oid(bucket, key)), pool])
         elif not rec.get("delete_marker"):
             items.append(["plain",
                           rec.get("data_oid",
-                                  self._data_oid(bucket, key))])
+                                  self._data_oid(bucket, key)), pool])
         if not items:
             return
         if self.gc_min_wait > 0:
@@ -1147,25 +1209,31 @@ class RGWLite:
             if not k.startswith(prefix) or e.get("version_id") \
                     or e.get("delete_marker") or (k, "null") in have:
                 continue
-            out.append({
+            item = {
                 "key": k, "version_id": "null",
                 "size": e.get("size", 0), "etag": e.get("etag", ""),
                 "mtime": e.get("mtime", 0.0),
                 "is_latest": True, "delete_marker": False,
-            })
+            }
+            if e.get("storage_class"):
+                item["storage_class"] = e["storage_class"]
+            out.append(item)
         for vk, raw in omap.items():
             key, _, vid = vk.partition("\x00")
             if not key.startswith(prefix):
                 continue
             e = json.loads(raw)
-            out.append({
+            item = {
                 "key": key, "version_id": vid,
                 "size": e.get("size", 0), "etag": e.get("etag", ""),
                 "mtime": e.get("mtime", 0.0),
                 "is_latest": current_vid.get(key) == vid,
                 "delete_marker": bool(e.get("delete_marker")),
                 "tags": dict(e.get("tags") or {}),
-            })
+            }
+            if e.get("storage_class"):
+                item["storage_class"] = e["storage_class"]
+            out.append(item)
         # newest-first within each key, by write time: the adopted
         # 'null' version keeps its original (oldest) mtime while a
         # suspended-state 'null' PUT is genuinely newest — lexical
@@ -1223,7 +1291,7 @@ class RGWLite:
         elif dk is not None and entry["sse"].get("multipart"):
             data = await self._read_manifest(
                 entry["multipart"], int(entry["size"]), None,
-                sse_key=dk)
+                sse_key=dk, pool=entry.get("pool"))
         else:
             data = await self._read_entry_data(bucket, key, entry,
                                                None)
@@ -1326,18 +1394,30 @@ class RGWLite:
                                  metadata: dict | None = None,
                                  lock: dict | None = None,
                                  sse: str | None = None,
-                                 kms_key_id: str | None = None) -> str:
+                                 kms_key_id: str | None = None,
+                                 storage_class: str | None = None
+                                 ) -> str:
         """S3 CreateMultipartUpload -> upload id.  ``lock``: object
         -lock headers ride the INITIATE (S3 applies them to the
         assembled object at complete).  ``sse``/``kms_key_id``:
         SSE-KMS / SSE-S3 — one data key is wrapped at initiate and
-        every part encrypts under it (its own nonce per part)."""
+        every part encrypts under it (its own nonce per part).
+        ``storage_class``: x-amz-storage-class from the initiate —
+        every part inherits it, so part bodies land directly in the
+        class's placement pool."""
         meta = await self._check_bucket(bucket, "WRITE",
                                        action="s3:PutObject", key=key)
         if lock:
             # validate now: a bad mode must fail the initiate, not
             # the complete after every part is uploaded
             self._stage_lock({"meta": meta}, lock)
+        sclass = (storage_class or "").strip() or None
+        pool = None
+        if sclass and sclass != "STANDARD":
+            pool = (await self._class_placement(sclass)).get("pool") \
+                or None
+        else:
+            sclass = None
         sse_kms = None
         if sse is not None:
             _, rec = await self._kms_begin(sse, kms_key_id)
@@ -1354,6 +1434,8 @@ class RGWLite:
                     "owner": self.user or "",
                     "lock": lock,
                     "sse_kms": sse_kms,
+                    "storage_class": sclass,
+                    "pool": pool,
                 }).encode(),
             }),
         )
@@ -1409,7 +1491,10 @@ class RGWLite:
             data = sse_crypt(sse_key, bytes.fromhex(sse["nonce"]),
                              0, data)
             rec["sse"] = sse
-        await self.ioctx.operate(
+        # part bodies land in the upload's storage class pool (the
+        # meta omap stays in the zone pool)
+        data_ioctx, _ = await self._data_handles(info.get("pool"))
+        await data_ioctx.operate(
             self._mp_part_oid(bucket, key, upload_id, part_number),
             ObjectOperation().write_full(data),
         )
@@ -1543,12 +1628,13 @@ class RGWLite:
         # the S3 multipart etag form: md5-of-part-md5s + part count
         etag = f"{digest_md5.hexdigest()}-{len(manifest)}"
         # drop uploaded-but-unused parts
+        data_ioctx, _ = await self._data_handles(info.get("pool"))
         used = {m["oid"] for m in manifest}
         for num in uploaded:
             oid = self._mp_part_oid(bucket, key, upload_id, num)
             if oid not in used:
                 try:
-                    await self.ioctx.remove(oid)
+                    await data_ioctx.remove(oid)
                 except RadosError as e:
                     if e.rc != -2:
                         raise
@@ -1562,6 +1648,10 @@ class RGWLite:
             "content_type": info["content_type"], "striped": False,
             "meta": info["meta"], "multipart": manifest,
         }
+        if info.get("storage_class"):
+            entry["storage_class"] = info["storage_class"]
+        if info.get("pool"):
+            entry["pool"] = info["pool"]
         if entry_sse is not None:
             entry["sse"] = entry_sse
         # WORM state for the ASSEMBLED object: initiate-time headers
@@ -1612,10 +1702,15 @@ class RGWLite:
                               upload_id: str) -> None:
         await self._check_bucket(
             bucket, "WRITE", action="s3:AbortMultipartUpload", key=key)
-        for p in await self.list_parts(bucket, key, upload_id):
+        omap = await self._mp_meta(bucket, key, upload_id)
+        info = json.loads(omap["_meta"])
+        data_ioctx, _ = await self._data_handles(info.get("pool"))
+        for k in omap:
+            if not k.startswith("part."):
+                continue
             try:
-                await self.ioctx.remove(self._mp_part_oid(
-                    bucket, key, upload_id, p["part_number"]
+                await data_ioctx.remove(self._mp_part_oid(
+                    bucket, key, upload_id, int(k.split(".", 1)[1])
                 ))
             except RadosError as e:
                 if e.rc != -2:
@@ -1889,7 +1984,10 @@ class RGWLite:
     # -- lifecycle (rgw_lc.cc: expiration rules + the LC worker) ----------
     _LC_ACTIONS = ("expiration_days", "expiration_seconds",
                    "noncurrent_days", "noncurrent_seconds",
-                   "abort_mpu_days", "abort_mpu_seconds")
+                   "abort_mpu_days", "abort_mpu_seconds",
+                   "transition_days", "transition_seconds",
+                   "noncurrent_transition_days",
+                   "noncurrent_transition_seconds")
 
     async def put_lifecycle(self, bucket: str,
                             rules: list[dict]) -> None:
@@ -1897,10 +1995,18 @@ class RGWLite:
         expiration_days/_seconds (current versions),
         noncurrent_days/_seconds (NoncurrentVersionExpiration),
         abort_mpu_days/_seconds (AbortIncompleteMultipartUpload
-        DaysAfterInitiation)]."""
+        DaysAfterInitiation), transition_days/_seconds +
+        transition_class (S3 Transition: move current versions into a
+        storage class), noncurrent_transition_days/_seconds +
+        noncurrent_transition_class (NoncurrentVersionTransition)]."""
         meta = await self._check_bucket(bucket, "FULL_CONTROL")
         for r in rules:
-            if not any(k in r for k in self._LC_ACTIONS):
+            # a lone StorageClass counts as an (incomplete) action so
+            # it reaches the both-or-neither check below instead of
+            # reading as "no action at all"
+            if not any(k in r for k in self._LC_ACTIONS
+                       + ("transition_class",
+                          "noncurrent_transition_class")):
                 raise RGWError("InvalidArgument",
                                f"rule {r.get('id')}: no action")
             for k in self._LC_ACTIONS:
@@ -1932,6 +2038,38 @@ class RGWLite:
                 raise RGWError("InvalidArgument",
                                f"rule {r.get('id')}: tag filters "
                                f"cannot scope multipart aborts")
+            for kind in ("transition", "noncurrent_transition"):
+                limit = self._lc_limit(r, kind)
+                cls = r.get(f"{kind}_class")
+                if limit is None and cls is None:
+                    continue
+                if limit is None or not cls:
+                    raise RGWError(
+                        "MalformedXML",
+                        f"rule {r.get('id')}: {kind} needs both a "
+                        f"time and a StorageClass")
+                if cls == "STANDARD":
+                    # objects start in STANDARD: a transition into it
+                    # is a transition to the same class
+                    raise RGWError(
+                        "InvalidArgument",
+                        f"rule {r.get('id')}: cannot transition to "
+                        f"STANDARD")
+                # the class must resolve NOW: a rule naming a class no
+                # placement defines would stall the LC worker later
+                await self._class_placement(cls)
+                # expiration-vs-transition precedence: within a rule
+                # the expiration must outlive the transition or the
+                # move is a wasted write on a doomed object (S3
+                # rejects this combination outright)
+                exp_kind = ("expiration" if kind == "transition"
+                            else "noncurrent")
+                exp = self._lc_limit(r, exp_kind)
+                if exp is not None and exp <= limit:
+                    raise RGWError(
+                        "InvalidArgument",
+                        f"rule {r.get('id')}: {exp_kind} expiration "
+                        f"must be later than the {kind}")
         meta["lifecycle"] = [dict(r) for r in rules]
         await self._put_bucket_meta(bucket, meta)
 
@@ -1947,7 +2085,8 @@ class RGWLite:
     @staticmethod
     def _lc_limit(r: dict, kind: str) -> float | None:
         """The rule's threshold in seconds for one action kind
-        ("expiration"/"noncurrent"/"abort_mpu"), or None."""
+        ("expiration"/"noncurrent"/"abort_mpu"/"transition"/
+        "noncurrent_transition"), or None."""
         if f"{kind}_seconds" in r:
             return float(r[f"{kind}_seconds"])
         if f"{kind}_days" in r:
@@ -1961,9 +2100,15 @@ class RGWLite:
         expiration, permanently delete NONCURRENT versions whose
         time-since-superseded exceeds a noncurrent rule (S3 measures
         from when the version became noncurrent — the successor's
-        write time — not from its own), and abort incomplete
-        multipart uploads past DaysAfterInitiation.  Returns
-        bucket -> [expired keys removed]."""
+        write time — not from its own), abort incomplete multipart
+        uploads past DaysAfterInitiation, then TRANSITION current and
+        noncurrent versions into their rules' target storage classes
+        (the data-mover phase: bodies are re-written bit-identical
+        into the class's placement pool — the EC cold pool for
+        COLD-style classes — and the head repoints atomically).
+        Expirations run first so a doomed object is never moved.
+        Returns bucket -> [keys removed or transitioned ("k->CLASS",
+        "k@vid->CLASS")]."""
         now = time.time() if now is None else now
         removed: dict[str, list[str]] = {}
         sys_self = self if self.user is None else self.as_user(None)
@@ -1990,16 +2135,40 @@ class RGWLite:
                    for r in active):
                 await self._lc_abort_mpus(sys_self, bucket, active,
                                           now, got)
+            if any(self._lc_limit(r, "transition") is not None
+                   for r in active):
+                await self._lc_transition_current(sys_self, bucket,
+                                                  active, now, got)
+            if any(self._lc_limit(r, "noncurrent_transition")
+                   is not None for r in active):
+                await self._lc_transition_noncurrent(
+                    sys_self, bucket, active, now, got)
             if not got:
                 del removed[bucket]
         return removed
 
+    @staticmethod
+    async def _lc_walk(sys_self, bucket: str, page: int = 1000):
+        """Marker-paginated LC bucket walk: the worker sees every
+        current object while holding at most one page in memory — a
+        million-object bucket no longer materializes a single giant
+        listing per pass."""
+        marker = ""
+        while True:
+            listing = await sys_self.list_objects(bucket,
+                                                  marker=marker,
+                                                  max_keys=page)
+            for obj in listing["contents"]:
+                yield obj
+            if not listing["is_truncated"] \
+                    or not listing["next_marker"]:
+                return
+            marker = listing["next_marker"]
+
     async def _lc_expire_current(self, sys_self, bucket: str,
                                  active: list[dict], now: float,
                                  got: list[str]) -> None:
-        listing = await sys_self.list_objects(bucket,
-                                              max_keys=1 << 30)
-        for obj in listing["contents"]:
+        async for obj in self._lc_walk(sys_self, bucket):
             age = now - float(obj["mtime"])
             for r in active:
                 limit = self._lc_limit(r, "expiration")
@@ -2031,8 +2200,13 @@ class RGWLite:
         for v in versions:
             by_key.setdefault(v["key"], []).append(v)
         for key, vs in by_key.items():
-            vs.sort(key=lambda v: (-float(v["mtime"]),
-                                   not v["is_latest"]))
+            # is_latest is the PRIMARY sort key: a current version
+            # whose mtime ties (or trails — an adopted pre-versioning
+            # 'null' that got re-promoted) an older version must still
+            # sort first, or the pairing below would treat it as
+            # noncurrent and expire the live object
+            vs.sort(key=lambda v: (not v["is_latest"],
+                                   -float(v["mtime"])))
             # vs[0] is current; each older version became noncurrent
             # when its SUCCESSOR was written
             for succ, v in zip(vs, vs[1:]):
@@ -2086,6 +2260,217 @@ class RGWLite:
                         bucket, up["key"], up["upload_id"])
                     got.append(f"{up['key']}+{up['upload_id']}")
                     break
+
+    async def _lc_transition_current(self, sys_self, bucket: str,
+                                     active: list[dict], now: float,
+                                     got: list[str]) -> None:
+        """Current-version transitions (rgw_lc.cc
+        LCOpAction_Transition role): the expiration phases already ran
+        this pass, so anything still listed is not doomed — move its
+        bytes and repoint the head."""
+        async for obj in self._lc_walk(sys_self, bucket):
+            age = now - float(obj["mtime"])
+            for r in active:
+                limit = self._lc_limit(r, "transition")
+                if limit is None:
+                    continue
+                if not obj["key"].startswith(r.get("prefix", "")):
+                    continue
+                want = r.get("tags") or {}
+                if want:
+                    have = obj.get("tags") or {}
+                    if any(have.get(k) != v
+                           for k, v in want.items()):
+                        continue
+                if age <= limit:
+                    continue
+                target = r["transition_class"]
+                if obj.get("storage_class",
+                           "STANDARD") == target:
+                    continue
+                try:
+                    moved = await sys_self._transition_object(
+                        bucket, obj["key"], None, target)
+                except RGWError as err:
+                    # SSE-C (no key available) or placement trouble:
+                    # skip this object, keep the pass going
+                    rgw_log.dout(5, "lc: transition %s/%s "
+                                 "refused: %s", bucket, obj["key"],
+                                 err)
+                    break
+                if moved:
+                    got.append(f"{obj['key']}->{target}")
+                break
+
+    async def _lc_transition_noncurrent(self, sys_self, bucket: str,
+                                        active: list[dict],
+                                        now: float,
+                                        got: list[str]) -> None:
+        """NoncurrentVersionTransition: same successor-write-time
+        clock as noncurrent expiration — a version's transition age
+        starts when it STOPPED being current."""
+        versions = await sys_self.list_object_versions(bucket)
+        by_key: dict[str, list[dict]] = {}
+        for v in versions:
+            by_key.setdefault(v["key"], []).append(v)
+        for key, vs in by_key.items():
+            vs.sort(key=lambda v: (not v["is_latest"],
+                                   -float(v["mtime"])))
+            for succ, v in zip(vs, vs[1:]):
+                if v["is_latest"] or v["delete_marker"]:
+                    continue
+                since = now - float(succ["mtime"])
+                for r in active:
+                    limit = self._lc_limit(r,
+                                           "noncurrent_transition")
+                    if limit is None or not key.startswith(
+                            r.get("prefix", "")):
+                        continue
+                    want = r.get("tags") or {}
+                    if want:
+                        have = v.get("tags") or {}
+                        if any(have.get(k) != t
+                               for k, t in want.items()):
+                            continue
+                    if since <= limit:
+                        continue
+                    target = r["noncurrent_transition_class"]
+                    if v.get("storage_class",
+                             "STANDARD") == target:
+                        continue
+                    try:
+                        moved = await sys_self._transition_object(
+                            bucket, key, v["version_id"], target)
+                    except RGWError as err:
+                        rgw_log.dout(5, "lc: transition %s/%s@%s "
+                                     "refused: %s", bucket, key,
+                                     v["version_id"], err)
+                        break
+                    if moved:
+                        got.append(
+                            f"{key}@{v['version_id']}->{target}")
+                    break
+
+    async def _transition_object(self, bucket: str, key: str,
+                                 version_id: str | None,
+                                 target_class: str) -> bool:
+        """Move one object/version's stored bytes into
+        ``target_class``'s placement pool and atomically repoint its
+        head (RGWLC::transition): the S3-visible identity — body
+        bytes, etag, version-id, mtime, tags, lock state, SSE and
+        compression envelopes — is preserved bit-for-bit; only
+        storage_class/pool/data oids change.  The stored (possibly
+        deflated/encrypted) bytes are copied VERBATIM through the
+        normal write path — into an EC pool that means batched
+        stripes through the Objecter→ECBackend encode pipeline — then
+        the old tail is reclaimed through the usual GC path.  Returns
+        False when there is nothing to move (already in the class,
+        delete marker, SLO manifest); raises InvalidRequest for SSE-C
+        objects — the lifecycle worker holds no customer key, the
+        same conflict a PUT refuses."""
+        place = await self._class_placement(target_class)
+        pool = place.get("pool") or None
+        meta = await self._bucket_meta(bucket)
+        if version_id is None:
+            kv = await self._index_get(bucket, key, meta)
+            if key not in kv:
+                raise RGWError("NoSuchKey", f"{bucket}/{key}")
+            entry = json.loads(kv[key])
+        else:
+            vkey = self._vkey(key, version_id)
+            try:
+                kv = await self.ioctx.get_omap(
+                    self._versions_oid(bucket), [vkey])
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
+                kv = {}
+            if vkey not in kv:
+                raise RGWError("NoSuchVersion",
+                               f"{key}@{version_id}")
+            entry = json.loads(kv[vkey])
+        if entry.get("delete_marker") or entry.get("slo"):
+            return False
+        if entry.get("storage_class", "STANDARD") == target_class:
+            return False
+        sse = entry.get("sse")
+        if sse is not None and "wrapped" not in sse:
+            # SSE-C: only the customer holds the key.  Re-placing the
+            # ciphertext would work mechanically, but S3 (and our PUT
+            # path) treat server-initiated handling of SSE-C objects
+            # without the key as a conflict — refuse identically.
+            raise RGWError("InvalidRequest",
+                           f"{key}: SSE-C objects cannot transition "
+                           f"without the customer key")
+        old = dict(entry)
+        src_ioctx, src_striper = await self._data_handles(
+            entry.get("pool"))
+        dst_ioctx, dst_striper = await self._data_handles(pool)
+        # NEW unique tail oids (\x00t\x00 tag): in-place moves would
+        # collide when source and target share a pool, and the GC
+        # liveness check compares oids — a reused name would make the
+        # old tail look live forever
+        tag = secrets.token_hex(8)
+        if entry.get("multipart"):
+            new_manifest = []
+            for p in entry["multipart"]:
+                raw = await src_ioctx.read(p["oid"])
+                new_oid = f"{p['oid']}\x00t\x00{tag}"
+                await dst_ioctx.operate(
+                    new_oid, ObjectOperation().write_full(raw))
+                new_manifest.append({**p, "oid": new_oid})
+            entry["multipart"] = new_manifest
+        else:
+            old_oid = entry.get("data_oid",
+                                self._data_oid(bucket, key))
+            new_oid = f"{self._data_oid(bucket, key)}\x00t\x00{tag}"
+            if entry.get("striped"):
+                raw = await src_striper.read(old_oid)
+                await dst_striper.write(new_oid, raw)
+            else:
+                raw = await src_ioctx.read(old_oid)
+                if entry.get("comp") is None and sse is None \
+                        and place.get("compression") \
+                        in list_compressors():
+                    # the class's inline compression composes with
+                    # the move: an uncompressed, unencrypted body
+                    # deflates exactly as a fresh PUT into the class
+                    # would (S3-visible size/etag unchanged)
+                    raw, comp = deflate_if_smaller(
+                        raw, place["compression"])
+                    if comp is not None:
+                        entry["comp"] = comp
+                await dst_ioctx.operate(
+                    new_oid, ObjectOperation().write_full(raw))
+            entry["data_oid"] = new_oid
+        entry["storage_class"] = target_class
+        if pool:
+            entry["pool"] = pool
+        else:
+            entry.pop("pool", None)
+        raw_entry = json.dumps(entry).encode()
+        # atomic repoint: flip the version record first (history
+        # readers), then the bucket index when this record is the
+        # current one — each flip is a single omap set, so readers
+        # see either the old head or the new, never a mix
+        if version_id is not None:
+            await self.ioctx.set_omap(
+                self._versions_oid(bucket), {vkey: raw_entry})
+            cur = await self._index_get(bucket, key, meta)
+            if key in cur and json.loads(cur[key]) \
+                    .get("version_id") == version_id:
+                await self._index_set(bucket, meta, key, raw_entry)
+        else:
+            if entry.get("version_id"):
+                await self.ioctx.set_omap(
+                    self._versions_oid(bucket), {
+                        self._vkey(key, entry["version_id"]):
+                        raw_entry,
+                    })
+            await self._index_set(bucket, meta, key, raw_entry)
+        # reclaim the old tail (deferred through GC when configured)
+        await self._remove_entry_data(bucket, key, old)
+        return True
 
     # -- bucket index shards (cls_rgw index + rgw_reshard.cc role) ---------
     @staticmethod
@@ -2331,14 +2716,19 @@ class RGWLite:
         return live
 
     async def _gc_delete(self, items: list) -> None:
-        for kind, oid in items:
+        for it in items:
+            kind, oid = it[0], it[1]
             try:
+                ioctx, striper = await self._data_handles(
+                    it[2] if len(it) > 2 else None)
                 if kind == "striped":
-                    await self.striper.remove(oid)
+                    await striper.remove(oid)
                 else:
-                    await self.ioctx.remove(oid)
-            except RadosError as e:
-                if e.rc != -2:
+                    await ioctx.remove(oid)
+            except (RadosError, RGWError) as e:
+                # -2 / a deleted placement pool: the tail is already
+                # gone either way
+                if isinstance(e, RadosError) and e.rc != -2:
                     raise
 
     async def gc_list(self) -> list[dict]:
@@ -2897,7 +3287,8 @@ class RGWLite:
     async def _prepare_put(self, bucket: str, key: str, length: int,
                            if_none_match: bool,
                            defer_cleanup: bool = False,
-                           lock: dict | None = None) -> dict:
+                           lock: dict | None = None,
+                           storage_class: str | None = None) -> dict:
         """Everything a PUT decides BEFORE any body byte lands: ACL,
         preconditions, quota (against the declared length), versioning
         mode, target oid, and old-data cleanup.  Shared by the buffered
@@ -2983,7 +3374,18 @@ class RGWLite:
                "index_oid": index_oid, "versioned": versioned,
                "suspended": suspended, "version_id": version_id,
                "deferred_cleanup": deferred, "meta": meta,
-               "compression": meta.get("compression")}
+               "compression": meta.get("compression"),
+               "storage_class": None, "pool": None}
+        sclass = (storage_class or "").strip()
+        if sclass and sclass != "STANDARD":
+            # x-amz-storage-class routes the tail through the zone's
+            # placement target for that class; the class's inline
+            # compression overrides the bucket's
+            place = await self._class_placement(sclass)
+            ctx["storage_class"] = sclass
+            ctx["pool"] = place.get("pool") or None
+            if place.get("compression"):
+                ctx["compression"] = place["compression"]
         # EVERY put shape flows through here — buffered, streaming,
         # multipart complete, SLO — so WORM state cannot be dodged
         # by picking a body size (the streaming-path hole)
@@ -3045,14 +3447,17 @@ class RGWLite:
                         content_type: str = "binary/octet-stream",
                         metadata: dict[str, str] | None = None,
                         if_none_match: bool = False,
-                        lock: dict | None = None) -> "StreamingPut":
+                        lock: dict | None = None,
+                        storage_class: str | None = None
+                        ) -> "StreamingPut":
         """Chunked S3 PUT session (the beast frontend's streaming body
         path): validation happens up front against the declared length,
         then body chunks land at their striper offsets without ever
         buffering the whole object."""
         ctx = await self._prepare_put(bucket, key, length,
                                       if_none_match,
-                                      defer_cleanup=True, lock=lock)
+                                      defer_cleanup=True, lock=lock,
+                                      storage_class=storage_class)
         return StreamingPut(self, ctx, length, content_type,
                             dict(metadata or {}))
 
@@ -3064,7 +3469,8 @@ class RGWLite:
                          tags: dict[str, str] | None = None,
                          lock: dict | None = None,
                          sse: str | None = None,
-                         kms_key_id: str | None = None) -> dict:
+                         kms_key_id: str | None = None,
+                         storage_class: str | None = None) -> dict:
         """S3 PUT. ``if_none_match``: fail when the key exists ('*').
         ``sse_key``: SSE-C customer key (32 bytes, AES-256).
         ``sse``: server-managed encryption — "aws:kms" (SSE-KMS, key
@@ -3072,7 +3478,9 @@ class RGWLite:
         x-amz-server-side-encryption header.
         ``tags``: object tags (the x-amz-tagging header).
         ``lock``: explicit object-lock state for the new version:
-        {mode, until, legal_hold} (x-amz-object-lock-* headers)."""
+        {mode, until, legal_hold} (x-amz-object-lock-* headers).
+        ``storage_class``: x-amz-storage-class — the tail lands in the
+        class's placement pool (STANDARD/None = the zone pool)."""
         if tags:
             self.validate_tags(tags)
         if sse is not None and sse_key is not None:
@@ -3080,7 +3488,8 @@ class RGWLite:
                            "SSE-C and server-side encryption are "
                            "mutually exclusive")
         ctx = await self._prepare_put(bucket, key, len(data),
-                                      if_none_match, lock=lock)
+                                      if_none_match, lock=lock,
+                                      storage_class=storage_class)
         etag = hashlib.md5(data).hexdigest()
         size = len(data)
         comp = None
@@ -3099,12 +3508,13 @@ class RGWLite:
             data = sse_crypt(sse_key, bytes.fromhex(sse["nonce"]),
                              0, data)
         oid = ctx["oid"]
+        ioctx, striper = await self._data_handles(ctx.get("pool"))
         striped = len(data) > STRIPE_THRESHOLD
         if striped:
-            await self.striper.write(oid, data)
+            await striper.write(oid, data)
         else:
             op = ObjectOperation().write_full(data)
-            await self.ioctx.operate(oid, op)
+            await ioctx.operate(oid, op)
         return await self._finish_put(ctx, size, etag, striped,
                                       content_type,
                                       dict(metadata or {}), sse,
@@ -3128,6 +3538,13 @@ class RGWLite:
             "meta": metadata,
             "data_oid": ctx["oid"],
         }
+        # storage class + tail pool ride the head record (the
+        # RGWObjManifest's placement rule); absent = STANDARD in the
+        # zone pool, so pre-tiering entries parse unchanged
+        if ctx.get("storage_class"):
+            entry["storage_class"] = ctx["storage_class"]
+        if ctx.get("pool"):
+            entry["pool"] = ctx["pool"]
         if sse is not None:
             entry["sse"] = sse
         if comp is not None:
@@ -3189,7 +3606,7 @@ class RGWLite:
         if dk is not None and entry["sse"].get("multipart"):
             data = await self._read_manifest(
                 entry["multipart"], int(entry["size"]), range_,
-                sse_key=dk)
+                sse_key=dk, pool=entry.get("pool"))
             return {"data": data, **entry}
         data = await self._read_entry_data(bucket, key, entry, range_)
         if dk is not None:
@@ -3204,9 +3621,10 @@ class RGWLite:
         """Stored (possibly deflated) bytes by STORED offset — never
         clamped by the inflated size, which deflate can exceed."""
         oid = entry["data_oid"]
+        ioctx, striper = await self._data_handles(entry.get("pool"))
         if entry["striped"]:
-            return await self.striper.read(oid, length, off)
-        return await self.ioctx.read(oid, length, off)
+            return await striper.read(oid, length, off)
+        return await ioctx.read(oid, length, off)
 
     async def _inflate_read(self, entry: dict,
                             range_: tuple[int, int] | None) -> bytes:
@@ -3240,17 +3658,19 @@ class RGWLite:
         oid = entry.get("data_oid", self._data_oid(bucket, key))
         if entry.get("multipart"):
             return await self._read_manifest(entry["multipart"],
-                                             entry["size"], range_)
+                                             entry["size"], range_,
+                                             pool=entry.get("pool"))
+        ioctx, striper = await self._data_handles(entry.get("pool"))
         if range_ is not None:
             start, end = range_
             end = min(end, entry["size"] - 1)
             length = max(0, end - start + 1)
             if entry["striped"]:
-                return await self.striper.read(oid, length, start)
-            return await self.ioctx.read(oid, length, start)
+                return await striper.read(oid, length, start)
+            return await ioctx.read(oid, length, start)
         if entry["striped"]:
-            return await self.striper.read(oid)
-        return await self.ioctx.read(oid)
+            return await striper.read(oid)
+        return await ioctx.read(oid)
 
     async def stream_object(self, bucket: str, key: str,
                             range_: tuple[int, int] | None = None,
@@ -3298,6 +3718,7 @@ class RGWLite:
             manifest = entry["multipart"]
             windows = manifest_window(
                 [int(p["size"]) for p in manifest], start, end)
+            mp_ioctx, _ = await self._data_handles(entry.get("pool"))
 
             async def gen_mp():
                 # per-part nonces: decrypt at part-relative offsets,
@@ -3308,8 +3729,8 @@ class RGWLite:
                     pos, rem = off, length
                     while rem > 0:
                         n = min(chunk, rem)
-                        data = await self.ioctx.read(part["oid"], n,
-                                                     pos)
+                        data = await mp_ioctx.read(part["oid"], n,
+                                                   pos)
                         yield sse_crypt(sse_key, pnonce, pos, data)
                         pos += n
                         rem -= n
@@ -3333,17 +3754,20 @@ class RGWLite:
 
     async def _read_manifest(self, manifest: list[dict], size: int,
                              range_: tuple[int, int] | None,
-                             sse_key: bytes | None = None) -> bytes:
+                             sse_key: bytes | None = None,
+                             pool: str | None = None) -> bytes:
         """Read through a multipart manifest (RGWObjManifest role):
         only the parts overlapping the requested range are fetched.
         ``sse_key``: decrypt SSE-C parts with their per-part nonce at
-        part-relative offsets."""
+        part-relative offsets.  ``pool``: the placement pool the parts
+        live in (zone pool when None)."""
         start, end = (0, size - 1) if range_ is None else range_
         end = min(end, size - 1)
+        ioctx, _ = await self._data_handles(pool)
         chunks = []
         for i, off, length in manifest_window(
                 [int(p["size"]) for p in manifest], start, end):
-            raw = await self.ioctx.read(manifest[i]["oid"], length, off)
+            raw = await ioctx.read(manifest[i]["oid"], length, off)
             if sse_key is not None and manifest[i].get("nonce"):
                 raw = sse_crypt(
                     sse_key, bytes.fromhex(manifest[i]["nonce"]),
@@ -3414,12 +3838,15 @@ class RGWLite:
                           src_sse_key: bytes | None = None,
                           sse_key: bytes | None = None,
                           sse: str | None = None,
-                          kms_key_id: str | None = None) -> dict:
+                          kms_key_id: str | None = None,
+                          storage_class: str | None = None) -> dict:
         """S3 CopyObject.  A KMS-encrypted source decrypts server-side
         (no key needed); SSE-C sources need ``src_sse_key``.  The
         destination re-encrypts per ``sse``/``kms_key_id``/``sse_key``
         — copies never splice ciphertext, so source and destination
-        keys are independent (rgw_crypt.cc copy rule)."""
+        keys are independent (rgw_crypt.cc copy rule).
+        ``storage_class``: the DESTINATION's class (a copy is a fresh
+        PUT; the source's class does not follow the bytes)."""
         got = await self.get_object(src_bucket, src_key,
                                     sse_key=src_sse_key)
         return await self.put_object(
@@ -3427,6 +3854,7 @@ class RGWLite:
             content_type=got["content_type"], metadata=got["meta"],
             tags=got.get("tags") or None,
             sse_key=sse_key, sse=sse, kms_key_id=kms_key_id,
+            storage_class=storage_class,
         )
 
     async def list_objects(self, bucket: str, prefix: str = "",
@@ -3482,6 +3910,8 @@ class RGWLite:
             }
             if entry.get("tags"):
                 item["tags"] = entry["tags"]
+            if entry.get("storage_class"):
+                item["storage_class"] = entry["storage_class"]
             contents.append(item)
             last = k
         return {

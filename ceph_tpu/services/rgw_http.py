@@ -76,6 +76,9 @@ _STATUS = {
     "QuotaExceeded": 403,
     "MethodNotAllowed": 405,
     "InvalidRange": 416,
+    "MalformedXML": 400,
+    "InvalidStorageClass": 400,
+    "NotImplemented": 501,
 }
 
 
@@ -1012,6 +1015,24 @@ class S3Frontend:
                         continue
                     e = ET.SubElement(r, outer)
                     ET.SubElement(e, inner).text = str(days)
+                for kind, outer, inner in (
+                        ("transition", "Transition", "Days"),
+                        ("noncurrent_transition",
+                         "NoncurrentVersionTransition",
+                         "NoncurrentDays")):
+                    cls = rule.get(f"{kind}_class")
+                    if not cls:
+                        continue
+                    if f"{kind}_days" in rule:
+                        days = int(rule[f"{kind}_days"])
+                    elif f"{kind}_seconds" in rule:
+                        days = max(1, math.ceil(
+                            float(rule[f"{kind}_seconds"]) / 86400))
+                    else:
+                        continue
+                    e = ET.SubElement(r, outer)
+                    ET.SubElement(e, inner).text = str(days)
+                    ET.SubElement(e, "StorageClass").text = cls
                 if rule.get("tags"):
                     flt = ET.SubElement(r, "Filter")
                     holder = (ET.SubElement(flt, "And")
@@ -1081,6 +1102,8 @@ class S3Frontend:
             ET.SubElement(e, "Size").text = str(c["size"])
             ET.SubElement(e, "ETag").text = f'"{c["etag"]}"'
             ET.SubElement(e, "LastModified").text = _iso(c["mtime"])
+            ET.SubElement(e, "StorageClass").text = \
+                c.get("storage_class", "STANDARD")
         return self._xml(root)
 
     async def _list_versions(self, req: _Request, gw: RGWLite,
@@ -1100,6 +1123,8 @@ class S3Frontend:
             if not v["delete_marker"]:
                 ET.SubElement(e, "Size").text = str(v["size"])
                 ET.SubElement(e, "ETag").text = f'"{v["etag"]}"'
+                ET.SubElement(e, "StorageClass").text = \
+                    v.get("storage_class", "STANDARD")
         return self._xml(root)
 
     async def _bulk_delete(self, req: _Request, gw: RGWLite,
@@ -1133,6 +1158,7 @@ class S3Frontend:
                     metadata=_meta_headers(req),
                     lock=_lock_headers(req),
                     sse=kms_alg, kms_key_id=kms_key,
+                    storage_class=req.header("x-amz-storage-class"),
                 )
                 root = ET.Element("InitiateMultipartUploadResult",
                                   xmlns=XMLNS)
@@ -1218,7 +1244,8 @@ class S3Frontend:
                     sb, urllib.parse.unquote(sk), bucket, key,
                     src_sse_key=_copy_source_sse_key(req),
                     sse_key=_sse_key_headers(req),
-                    sse=kms_alg, kms_key_id=kms_key)
+                    sse=kms_alg, kms_key_id=kms_key,
+                    storage_class=req.header("x-amz-storage-class"))
                 root = ET.Element("CopyObjectResult", xmlns=XMLNS)
                 ET.SubElement(root, "ETag").text = f'"{out["etag"]}"'
                 return self._xml(root)
@@ -1264,6 +1291,7 @@ class S3Frontend:
                     lock=_lock_headers(req),
                     tags=htags,
                     sse=kms_alg, kms_key_id=kms_key,
+                    storage_class=req.header("x-amz-storage-class"),
                 )
             hdrs = {"etag": f'"{out["etag"]}"'}
             if out.get("version_id"):
@@ -1393,6 +1421,7 @@ class S3Frontend:
             metadata=_meta_headers(req),
             if_none_match=req.header("if-none-match") == "*",
             lock=_lock_headers(req),
+            storage_class=req.header("x-amz-storage-class"),
         )
         if sse_key is not None:
             sp.set_sse_key(sse_key)
@@ -1511,6 +1540,10 @@ def _obj_headers(got: dict) -> dict[str, str]:
     }
     for k, v in (got.get("meta") or {}).items():
         hdrs[f"x-amz-meta-{k}"] = str(v)
+    if got.get("storage_class"):
+        # only non-STANDARD classes are stored; S3 likewise omits the
+        # header for STANDARD objects
+        hdrs["x-amz-storage-class"] = got["storage_class"]
     ret = got.get("retention")
     if ret:
         hdrs["x-amz-object-lock-mode"] = ret["mode"]
@@ -1713,12 +1746,50 @@ def _parse_lifecycle(body: bytes) -> list[dict]:
                 (("NoncurrentVersionExpiration", "NoncurrentDays"),
                  "noncurrent_days"),
                 (("AbortIncompleteMultipartUpload",
-                  "DaysAfterInitiation"), "abort_mpu_days")):
+                  "DaysAfterInitiation"), "abort_mpu_days"),
+                (("Transition", "Days"), "transition_days"),
+                (("NoncurrentVersionTransition", "NoncurrentDays"),
+                 "noncurrent_transition_days")):
             outer, inner = xml_path
             v = el.findtext(f"{_ns(outer)}/{_ns(inner)}") or \
                 el.findtext(f"{outer}/{inner}")
             if v is not None:
-                rule[field] = int(v)
+                try:
+                    rule[field] = int(v)
+                except ValueError:
+                    # a non-numeric <Days> is the CLIENT's document
+                    # error: 400 MalformedXML, never an unhandled
+                    # ValueError turning into a 500
+                    raise _HTTPError(
+                        400, "MalformedXML",
+                        f"{outer}/{inner}: {v!r} is not an integer"
+                    ) from None
+        # unsupported action variants must be REJECTED, not dropped:
+        # silently ignoring <Date> would disable the expiry or
+        # transition the client asked for on a date we never check
+        for outer in ("Expiration", "Transition",
+                      "NoncurrentVersionExpiration",
+                      "NoncurrentVersionTransition"):
+            if el.find(f"{_ns(outer)}/{_ns('Date')}") is not None or \
+                    el.find(f"{outer}/Date") is not None:
+                raise _HTTPError(
+                    501, "NotImplemented",
+                    f"{outer}/Date is not supported; use Days")
+        if el.find(f"{_ns('Expiration')}/"
+                   f"{_ns('ExpiredObjectDeleteMarker')}") is not None \
+                or el.find("Expiration/ExpiredObjectDeleteMarker") \
+                is not None:
+            raise _HTTPError(501, "NotImplemented",
+                             "ExpiredObjectDeleteMarker is not "
+                             "supported")
+        for outer, field in (
+                ("Transition", "transition_class"),
+                ("NoncurrentVersionTransition",
+                 "noncurrent_transition_class")):
+            v = el.findtext(f"{_ns(outer)}/{_ns('StorageClass')}") or \
+                el.findtext(f"{outer}/StorageClass")
+            if v:
+                rule[field] = v
         # <Filter><Tag> / <Filter><And><Tag>...: dropping a tag
         # filter silently would expire objects it was protecting
         tags = {}

@@ -38,6 +38,203 @@ log = Dout("rgw-sync")
 
 REALMS_OID = "rgw.realms"
 
+# -- zone placement targets (rgw_zone.h RGWZonePlacementInfo) -------------
+PLACEMENT_OID = "rgw.zone.placement"
+DEFAULT_PLACEMENT = "default-placement"
+
+
+class ZonePlacement:
+    """Zone placement targets + per-class data pools (the reference's
+    RGWZonePlacementInfo / rgw_placement_rule pair): a named placement
+    maps each STORAGE CLASS to the RADOS pool its object tails live in,
+    plus optional per-class inline compression.  STANDARD is implicit
+    and resolves to the zone's own (replicated, hot) pool; COLD/
+    ARCHIVE-style classes typically name an erasure-coded pool created
+    from an EC profile, so every lifecycle transition into them drives
+    bulk writes through the Objecter→ECBackend encode path.
+
+    Stored as one omap object in the zone's pool:
+    ``rgw.zone.placement``  omap: placement id -> placement record
+    {"id", "storage_classes": {class: {"pool", "compression",
+    "ec_profile"?}}}.  Administered via ``rgw-admin zone placement
+    add/modify/rm/ls``."""
+
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+
+    async def _all(self) -> dict[str, dict]:
+        try:
+            omap = await self.ioctx.get_omap(PLACEMENT_OID)
+        except RadosError as e:
+            if e.rc == -2:
+                return {}
+            raise
+        return {k: json.loads(v) for k, v in omap.items()}
+
+    async def get(self, placement_id: str = DEFAULT_PLACEMENT) -> dict:
+        recs = await self._all()
+        if placement_id not in recs:
+            raise RGWError("NoSuchKey",
+                           f"no placement {placement_id!r}")
+        return recs[placement_id]
+
+    async def ls(self) -> list[dict]:
+        return [rec for _, rec in sorted((await self._all()).items())]
+
+    async def _put(self, rec: dict) -> None:
+        await self.ioctx.operate(
+            PLACEMENT_OID, ObjectOperation().create().omap_set({
+                rec["id"]: json.dumps(rec).encode(),
+            }))
+
+    @staticmethod
+    def _check_class_name(storage_class: str) -> None:
+        if not storage_class or not all(
+                c.isalnum() or c in "_-" for c in storage_class):
+            raise RGWError("InvalidStorageClass",
+                           f"bad storage class {storage_class!r}")
+
+    async def _set_class(self, placement_id: str, storage_class: str,
+                         data_pool: str, compression: str,
+                         ec_profile: str, ec_k: int, ec_m: int,
+                         create_pool: bool, pg_num: int,
+                         modify: bool) -> dict:
+        from ceph_tpu.common.compressor import list_compressors
+
+        self._check_class_name(storage_class)
+        if compression and compression not in list_compressors():
+            raise RGWError("InvalidArgument",
+                           f"unknown compression {compression!r}")
+        if storage_class != "STANDARD" and not data_pool and not modify:
+            raise RGWError("InvalidArgument",
+                           f"storage class {storage_class!r} needs a "
+                           "--data-pool (STANDARD alone rides the "
+                           "zone's own pool)")
+        recs = await self._all()
+        rec = recs.get(placement_id) or {"id": placement_id,
+                                         "storage_classes": {}}
+        have = storage_class in rec["storage_classes"]
+        if modify and not have:
+            raise RGWError("NoSuchKey",
+                           f"{placement_id!r} has no class "
+                           f"{storage_class!r}")
+        if not modify and have:
+            raise RGWError("InvalidArgument",
+                           f"class {storage_class!r} exists in "
+                           f"{placement_id!r}; use modify")
+        cls = dict(rec["storage_classes"].get(storage_class) or {})
+        if data_pool or not modify:
+            cls["pool"] = data_pool
+        if compression or not modify:
+            cls["compression"] = compression
+        if ec_profile:
+            cls["ec_profile"] = ec_profile
+        if create_pool and cls.get("pool"):
+            await self.ensure_pool(cls["pool"],
+                                   ec_profile=cls.get("ec_profile", ""),
+                                   ec_k=ec_k, ec_m=ec_m, pg_num=pg_num)
+        rec["storage_classes"][storage_class] = cls
+        await self._put(rec)
+        return rec
+
+    async def add(self, placement_id: str = DEFAULT_PLACEMENT,
+                  storage_class: str = "STANDARD",
+                  data_pool: str = "", compression: str = "",
+                  ec_profile: str = "", ec_k: int = 2, ec_m: int = 1,
+                  create_pool: bool = False, pg_num: int = 8) -> dict:
+        """Add one storage class to a placement target (creating the
+        placement on first use).  ``create_pool``: provision the data
+        pool too — erasure-coded from ``ec_profile`` (created with
+        k/m when absent) or replicated when no profile is named."""
+        return await self._set_class(placement_id, storage_class,
+                                     data_pool, compression,
+                                     ec_profile, ec_k, ec_m,
+                                     create_pool, pg_num, modify=False)
+
+    async def modify(self, placement_id: str = DEFAULT_PLACEMENT,
+                     storage_class: str = "STANDARD",
+                     data_pool: str = "", compression: str = "",
+                     ec_profile: str = "", ec_k: int = 2,
+                     ec_m: int = 1, create_pool: bool = False,
+                     pg_num: int = 8) -> dict:
+        """Update an existing class; empty fields keep their value."""
+        return await self._set_class(placement_id, storage_class,
+                                     data_pool, compression,
+                                     ec_profile, ec_k, ec_m,
+                                     create_pool, pg_num, modify=True)
+
+    async def rm(self, placement_id: str = DEFAULT_PLACEMENT,
+                 storage_class: str | None = None) -> None:
+        """Drop one storage class, or the whole placement target when
+        no class is named.  The data pool itself is never deleted —
+        objects already placed there must stay readable."""
+        recs = await self._all()
+        if placement_id not in recs:
+            raise RGWError("NoSuchKey",
+                           f"no placement {placement_id!r}")
+        if storage_class is None:
+            await self.ioctx.rm_omap_keys(PLACEMENT_OID,
+                                          [placement_id])
+            return
+        rec = recs[placement_id]
+        if storage_class not in rec["storage_classes"]:
+            raise RGWError("NoSuchKey",
+                           f"{placement_id!r} has no class "
+                           f"{storage_class!r}")
+        del rec["storage_classes"][storage_class]
+        await self._put(rec)
+
+    async def resolve(self, storage_class: str,
+                      placement_id: str = DEFAULT_PLACEMENT) -> dict:
+        """{"pool", "compression"} for a storage class.  STANDARD
+        always resolves (zone pool, no forced compression) even with
+        no placement configured; any other class must be registered or
+        the caller gets InvalidStorageClass — exactly what a PUT with
+        a bogus x-amz-storage-class should see."""
+        if storage_class == "STANDARD":
+            try:
+                rec = await self.get(placement_id)
+                cls = rec["storage_classes"].get("STANDARD")
+            except RGWError:
+                cls = None
+            return dict(cls) if cls else {"pool": "", "compression": ""}
+        try:
+            rec = await self.get(placement_id)
+        except RGWError:
+            raise RGWError("InvalidStorageClass",
+                           f"no placement target defines "
+                           f"{storage_class!r}") from None
+        cls = rec["storage_classes"].get(storage_class)
+        if cls is None:
+            raise RGWError("InvalidStorageClass",
+                           f"{placement_id!r} does not define "
+                           f"{storage_class!r}")
+        return dict(cls)
+
+    async def ensure_pool(self, pool: str, ec_profile: str = "",
+                          ec_k: int = 2, ec_m: int = 1,
+                          pg_num: int = 8) -> None:
+        """Provision a class's data pool when absent: erasure-coded
+        from ``ec_profile`` (set from k/m if the profile is new) or
+        replicated otherwise — the same mon plumbing vstart uses."""
+        rados = self.ioctx.rados
+        if pool in await rados.list_pools():
+            return
+        kw: dict = {"pg_num": pg_num}
+        if ec_profile:
+            r = await rados.mon_command(
+                "osd erasure-code-profile set", name=ec_profile,
+                profile={"plugin": "jax_rs", "k": str(ec_k),
+                         "m": str(ec_m),
+                         "crush-failure-domain": "osd"})
+            if r["rc"] not in (0, -17):
+                raise RGWError("InvalidArgument",
+                               f"ec profile {ec_profile!r}: "
+                               f"{r.get('outs', r['rc'])}")
+            kw.update(pool_type="erasure",
+                      erasure_code_profile=ec_profile)
+        await rados.pool_create(pool, **kw)
+
 
 def _empty_topology() -> dict:
     return {"zonegroups": {}}

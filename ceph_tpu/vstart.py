@@ -271,12 +271,24 @@ class DevCluster:
         return mgr
 
     async def start_rgw(self, pool: str = "rgw", port: int = 0,
-                        host: str = "127.0.0.1"):
+                        host: str = "127.0.0.1",
+                        cold_pool: str | None = None,
+                        cold_class: str = "COLD",
+                        cold_compression: str = "",
+                        ec_k: int = 2, ec_m: int = 1):
         """Boot an S3 HTTP endpoint over ``pool`` (the radosgw daemon
         role): returns (frontend, users) — callers mint users
-        through ``users`` and point any SigV4 client at the port."""
-        from ceph_tpu.services.rgw import RGWLite, RGWUsers
+        through ``users`` and point any SigV4 client at the port.
+
+        ``cold_pool``: also provision an ERASURE-CODED pool (profile
+        jax_rs k/m over osd failure domains) and register it as
+        storage class ``cold_class`` in the default placement target —
+        the hot(replicated)/cold(EC) tiering layout lifecycle
+        transitions move data across.  ``cold_compression``: inline
+        compression for the cold class ("zlib"/"zstd"/...)."""
+        from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers
         from ceph_tpu.services.rgw_http import S3Frontend
+        from ceph_tpu.services.rgw_zone import ZonePlacement
 
         rados = await self.client()
         m = rados.monc.osdmap
@@ -288,6 +300,19 @@ class DevCluster:
         ioctx = await rados.open_ioctx(pool)
         users = RGWUsers(ioctx)
         gw = RGWLite(ioctx, users=users)
+        if cold_pool:
+            zp = ZonePlacement(ioctx)
+            await zp.ensure_pool(cold_pool,
+                                 ec_profile=f"rgw_{cold_pool}",
+                                 ec_k=ec_k, ec_m=ec_m)
+            try:
+                await zp.add(storage_class=cold_class,
+                             data_pool=cold_pool,
+                             compression=cold_compression)
+            except RGWError as e:
+                # a restart re-registering the same class is fine
+                if e.code != "InvalidArgument":
+                    raise
         # restart recovery: spawn push workers for topics with queued
         # events so delivery never waits for new traffic
         await gw.start_push()
@@ -299,6 +324,11 @@ class DevCluster:
         self._rgw_seq = getattr(self, "_rgw_seq", -1) + 1
         fe._orch_id = self._rgw_seq
         self.rgws.append(fe)
+        # surface placement/lifecycle panels on any running dashboard
+        for mgr in self.mgrs.values():
+            dash = getattr(mgr, "dashboard", None)
+            if dash is not None:
+                dash.attach_rgw(gw)
         return fe, users
 
     async def stop(self) -> None:

@@ -8,6 +8,10 @@
 #                                   every tool + a tiny fixture run, so
 #                                   entry-point breakage is caught
 #                                   without the slow e2e
+#   scripts/tier1.sh --lc-smoke     hot→EC-cold tiering end to end: a
+#                                   vstart cluster with a cold EC pool,
+#                                   one PUT, one lifecycle transition
+#                                   pass, and a bit-identical read-back
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -54,6 +58,62 @@ EOF
         > /dev/null
     echo "ok: cli tool passthrough"
     echo "TOOLS_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--lc-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    python - <<'EOF'
+import asyncio
+import hashlib
+import time
+
+
+async def main():
+    from ceph_tpu.vstart import DevCluster
+
+    cluster = DevCluster(n_mons=1, n_osds=3)
+    await cluster.start()
+    try:
+        fe, users = await cluster.start_rgw(cold_pool="rgw.cold",
+                                            cold_compression="zlib")
+        gw = fe.rgw
+        print("ok: vstart rgw + EC cold pool (jax_rs k=2,m=1)")
+
+        await gw.create_bucket("smoke")
+        body = bytes(range(256)) * 256
+        out = await gw.put_object("smoke", "obj", body,
+                                  tags={"tier": "me"})
+        assert out["etag"] == hashlib.md5(body).hexdigest()
+        head = await gw.head_object("smoke", "obj")
+        assert "storage_class" not in head      # hot = STANDARD
+        print("ok: PUT landed hot (STANDARD)")
+
+        await gw.put_lifecycle("smoke", [
+            {"id": "tier", "prefix": "", "status": "Enabled",
+             "transition_seconds": 1, "transition_class": "COLD"},
+        ])
+        moved = await gw.lc_process(now=time.time() + 5)
+        assert moved == {"smoke": ["obj->COLD"]}, moved
+        print("ok: lc_process transitioned obj -> COLD")
+
+        head = await gw.head_object("smoke", "obj")
+        assert head["storage_class"] == "COLD", head
+        assert head["pool"] == "rgw.cold", head
+        got = await gw.get_object("smoke", "obj")
+        assert got["data"] == body
+        assert head["etag"] == out["etag"]
+        assert head["tags"] == {"tier": "me"}
+        print("ok: EC cold read-back bit-identical "
+              "(body, etag, tags)")
+    finally:
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+    echo "LC_SMOKE_PASSED"
     exit 0
 fi
 

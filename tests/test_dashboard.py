@@ -293,3 +293,73 @@ def test_dashboard_resource_routes_and_sections():
         finally:
             await cluster.stop()
     asyncio.run(run())
+
+
+def test_dashboard_rgw_placement_and_lifecycle_panels():
+    """The object-gateway surface: /api/rgw/placement and
+    /api/rgw/lifecycle ride the management token gate (they name
+    internal pools), return 503 until an RGW attaches, and the HTML
+    page grows placement + lifecycle panels once vstart wires one
+    in."""
+    async def run():
+        cluster = DevCluster(n_mons=1, n_osds=3)
+        await cluster.start()
+        try:
+            mgr = await cluster.start_mgr(dashboard=True,
+                                          dashboard_token="tok")
+            host, port = mgr.dashboard.host, mgr.dashboard.port
+
+            # token-gated like every management route
+            st, _ = await _http(host, port, "GET",
+                                "/api/rgw/placement")
+            assert st == 403
+            # authorized but no gateway attached yet
+            st, body = await _http(host, port, "GET",
+                                   "/api/rgw/placement", token="tok")
+            assert st == 503 and b"no rgw" in body
+
+            fe, users = await cluster.start_rgw(
+                cold_pool="rgw.cold", cold_compression="zlib")
+            gw = fe.rgw
+            await gw.create_bucket("b")
+            await gw.put_lifecycle("b", [
+                {"id": "tier", "prefix": "logs/",
+                 "status": "Enabled", "transition_days": 30,
+                 "transition_class": "COLD",
+                 "expiration_days": 90},
+            ])
+
+            st, body = await _http(host, port, "GET",
+                                   "/api/rgw/placement", token="tok")
+            assert st == 200
+            recs = json.loads(body)
+            cold = recs[0]["storage_classes"]["COLD"]
+            assert cold["pool"] == "rgw.cold"
+            assert cold["compression"] == "zlib"
+
+            st, body = await _http(host, port, "GET",
+                                   "/api/rgw/lifecycle", token="tok")
+            assert st == 200
+            rules = json.loads(body)
+            assert rules["b"][0]["transition_class"] == "COLD"
+            # ?bucket= narrows; unknown buckets read as empty
+            st, body = await _http(host, port, "GET",
+                                   "/api/rgw/lifecycle?bucket=b",
+                                   token="tok")
+            assert list(json.loads(body)) == ["b"]
+            st, body = await _http(host, port, "GET",
+                                   "/api/rgw/lifecycle?bucket=nope",
+                                   token="tok")
+            assert json.loads(body) == {}
+
+            # the HTML page renders both panels
+            st, page = await _http_get(host, port, "/")
+            assert st == 200
+            text = page.decode()
+            assert "RGW placement targets" in text
+            assert "rgw.cold" in text
+            assert "RGW lifecycle" in text
+            assert "transition 30d" in text and "COLD" in text
+        finally:
+            await cluster.stop()
+    asyncio.run(run())

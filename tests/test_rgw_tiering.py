@@ -1,0 +1,446 @@
+"""RGW tiering: zone placement targets, storage classes on the
+object path, and the lifecycle transition engine (hot → EC-cold).
+
+Reference surfaces: rgw_zone.h RGWZonePlacementInfo (per-class data
+pools), rgw_rados.cc manifest placement rules, rgw_lc.cc
+LCOpAction_Transition / LCOpAction_NonCurrentTransition.
+"""
+
+import asyncio
+import hashlib
+import json
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.services.rgw import RGWError, RGWLite
+from ceph_tpu.services.rgw_zone import ZonePlacement
+from tests.test_services import start_cluster, stop_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+async def _gw(rados, pool="rgwt"):
+    await rados.pool_create(pool, pg_num=8)
+    ioctx = await rados.open_ioctx(pool)
+    return RGWLite(ioctx), ioctx
+
+
+async def _cold(ioctx, pool="rgwt.cold", compression=""):
+    """Register a COLD class backed by a k=2,m=1 EC pool."""
+    zp = ZonePlacement(ioctx)
+    await zp.add(storage_class="COLD", data_pool=pool,
+                 compression=compression,
+                 ec_profile=f"ecp_{pool.replace('.', '_')}",
+                 create_pool=True)
+    return zp
+
+
+def test_placement_admin_and_put_storage_class():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, ioctx = await _gw(rados)
+            zp = ZonePlacement(ioctx)
+
+            # validation: non-STANDARD needs a pool, names are checked,
+            # modify requires an existing class
+            with pytest.raises(RGWError) as e:
+                await zp.add(storage_class="COLD")
+            assert e.value.code == "InvalidArgument"
+            with pytest.raises(RGWError) as e:
+                await zp.add(storage_class="bad class", data_pool="x")
+            assert e.value.code == "InvalidStorageClass"
+            with pytest.raises(RGWError) as e:
+                await zp.modify(storage_class="COLD", data_pool="x")
+            assert e.value.code == "NoSuchKey"
+
+            await zp.add(storage_class="COLD", data_pool="rgwt.cold",
+                         ec_profile="ecp_cold", create_pool=True)
+            assert "rgwt.cold" in await rados.list_pools()
+            with pytest.raises(RGWError) as e:        # add twice
+                await zp.add(storage_class="COLD",
+                             data_pool="rgwt.cold")
+            assert e.value.code == "InvalidArgument"
+
+            recs = await zp.ls()
+            assert [r["id"] for r in recs] == ["default-placement"]
+            assert recs[0]["storage_classes"]["COLD"]["pool"] == \
+                "rgwt.cold"
+            # modify adds compression, keeps the pool
+            await zp.modify(storage_class="COLD", compression="zlib")
+            got = await zp.resolve("COLD")
+            assert got["pool"] == "rgwt.cold"
+            assert got["compression"] == "zlib"
+            # STANDARD always resolves; unknown classes never do
+            assert (await zp.resolve("STANDARD"))["pool"] == ""
+            with pytest.raises(RGWError) as e:
+                await zp.resolve("GLACIER")
+            assert e.value.code == "InvalidStorageClass"
+
+            # PUT straight into the class: head/list carry it, the
+            # tail physically lands in the EC cold pool
+            await gw.create_bucket("b")
+            body = bytes(range(256)) * 64
+            out = await gw.put_object("b", "k", body,
+                                      storage_class="COLD",
+                                      tags={"team": "a"})
+            assert out["etag"] == hashlib.md5(body).hexdigest()
+            head = await gw.head_object("b", "k")
+            assert head["storage_class"] == "COLD"
+            assert head["pool"] == "rgwt.cold"
+            got = await gw.get_object("b", "k")
+            assert got["data"] == body
+            cold_io = await rados.open_ioctx("rgwt.cold")
+            assert (await cold_io.stat(head["data_oid"]))["size"] > 0
+            listing = await gw.list_objects("b")
+            assert listing["contents"][0]["storage_class"] == "COLD"
+
+            # a bogus class is refused exactly like a bad request
+            with pytest.raises(RGWError) as e:
+                await gw.put_object("b", "k2", b"x",
+                                    storage_class="GLACIER")
+            assert e.value.code == "InvalidStorageClass"
+
+            # multipart inherits the upload's class for every part
+            up = await gw.initiate_multipart("b", "mp",
+                                             storage_class="COLD")
+            p1 = await gw.upload_part("b", "mp", up, 1, b"a" * 5000)
+            p2 = await gw.upload_part("b", "mp", up, 2, b"b" * 5000)
+            await gw.complete_multipart("b", "mp", up, [
+                (1, p1["etag"]), (2, p2["etag"])])
+            mp_head = await gw.head_object("b", "mp")
+            assert mp_head["storage_class"] == "COLD"
+            for part in mp_head["multipart"]:
+                assert (await cold_io.stat(part["oid"]))["size"] > 0
+            assert (await gw.get_object("b", "mp"))["data"] == \
+                b"a" * 5000 + b"b" * 5000
+
+            # rm drops the class but never the pool
+            await zp.rm(storage_class="COLD")
+            with pytest.raises(RGWError):
+                await zp.resolve("COLD")
+            assert "rgwt.cold" in await rados.list_pools()
+            # objects already placed stay readable
+            assert (await gw.get_object("b", "k"))["data"] == body
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_lc_transition_current_to_ec_cold():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, ioctx = await _gw(rados)
+            await _cold(ioctx)
+            await gw.create_bucket("b")
+
+            body = bytes(range(256)) * 512          # 128 KiB
+            big = b"\x5a" * (4 * 1024 * 1024 + 3)   # striped tail
+            await gw.put_object("b", "logs/a", body,
+                                tags={"team": "a"})
+            await gw.put_object("b", "logs/big", big)
+            await gw.put_object("b", "keep/x", b"hot")
+            old_head = await gw.head_object("b", "logs/a")
+            old_oid = old_head["data_oid"]
+
+            await gw.put_lifecycle("b", [
+                {"id": "tier", "prefix": "logs/", "status": "Enabled",
+                 "transition_seconds": 1,
+                 "transition_class": "COLD"},
+            ])
+            # too fresh: nothing moves
+            assert await gw.lc_process() == {}
+            moved = await gw.lc_process(now=time.time() + 5)
+            assert sorted(moved["b"]) == ["logs/a->COLD",
+                                          "logs/big->COLD"]
+
+            # identity preserved bit-for-bit; placement flipped
+            head = await gw.head_object("b", "logs/a")
+            assert head["storage_class"] == "COLD"
+            assert head["pool"] == "rgwt.cold"
+            assert head["etag"] == old_head["etag"]
+            assert head["tags"] == {"team": "a"}
+            assert (await gw.get_object("b", "logs/a"))["data"] == body
+            assert (await gw.get_object("b", "logs/big"))["data"] == big
+            # non-matching prefix untouched
+            keep = await gw.head_object("b", "keep/x")
+            assert "storage_class" not in keep
+
+            # the new tail is in the EC pool; the hot tail is gone
+            cold_io = await rados.open_ioctx("rgwt.cold")
+            assert (await cold_io.stat(head["data_oid"]))["size"] > 0
+            with pytest.raises(RadosError):
+                await ioctx.stat(old_oid)
+
+            # idempotent: a second pass finds nothing to move
+            assert await gw.lc_process(now=time.time() + 10) == {}
+
+            # ListObjects reflects the new class
+            listing = await gw.list_objects("b", prefix="logs/")
+            assert all(c["storage_class"] == "COLD"
+                       for c in listing["contents"])
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_lc_versioned_noncurrent_transition_and_expiration():
+    """NoncurrentVersionTransition + NoncurrentVersionExpiration on
+    one versioned bucket: noncurrent versions tier into EC cold (ages
+    measured from the successor's write time), then expire later; the
+    current version never moves."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, ioctx = await _gw(rados)
+            await _cold(ioctx)
+            await gw.create_bucket("vb")
+            await gw.put_bucket_versioning("vb", True)
+
+            v1 = (await gw.put_object("vb", "k", b"one"))["version_id"]
+            v2 = (await gw.put_object("vb", "k", b"two"))["version_id"]
+            v3 = (await gw.put_object("vb", "k", b"three"))["version_id"]
+
+            await gw.put_lifecycle("vb", [
+                {"id": "tier-nc", "prefix": "",
+                 "status": "Enabled",
+                 "noncurrent_transition_seconds": 1,
+                 "noncurrent_transition_class": "COLD",
+                 "noncurrent_seconds": 3600},
+            ])
+            moved = await gw.lc_process(now=time.time() + 10)
+            assert sorted(moved["vb"]) == sorted(
+                [f"k@{v1}->COLD", f"k@{v2}->COLD"])
+
+            # versions keep their ids and bodies, now from the EC pool
+            for vid, want in ((v1, b"one"), (v2, b"two")):
+                h = await gw.head_object_version("vb", "k", vid)
+                assert h["storage_class"] == "COLD"
+                assert h["pool"] == "rgwt.cold"
+                got = await gw.get_object_version("vb", "k", vid)
+                assert got["data"] == want
+            # the current version stays hot
+            cur = await gw.head_object("vb", "k")
+            assert "storage_class" not in cur
+            assert (await gw.get_object("vb", "k"))["data"] == b"three"
+            vers = await gw.list_object_versions("vb")
+            by_vid = {v["version_id"]: v for v in vers}
+            assert by_vid[v1]["storage_class"] == "COLD"
+            assert by_vid[v3].get("storage_class") is None
+
+            # much later the same rule's expiration removes the
+            # (already cold) noncurrent versions; current survives
+            removed = await gw.lc_process(now=time.time() + 7200)
+            assert sorted(removed["vb"]) == sorted(
+                [f"k@{v1}", f"k@{v2}"])
+            assert (await gw.get_object("vb", "k"))["data"] == b"three"
+            assert len(await gw.list_object_versions("vb")) == 1
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_lc_noncurrent_sort_protects_current_on_mtime_collision():
+    """Regression for the noncurrent sort: is_latest must be the
+    PRIMARY key.  A current version whose mtime TRAILS a noncurrent
+    one (an adopted/re-promoted 'null') sorted after it under the old
+    mtime-first ordering, so the pairing loop never saw the older
+    version as noncurrent — it silently never expired — and any
+    version it did see aged against the wrong successor's clock."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, ioctx = await _gw(rados)
+            await gw.create_bucket("tb")
+            await gw.put_bucket_versioning("tb", True)
+            v1 = (await gw.put_object("tb", "k", b"old"))["version_id"]
+            v2 = (await gw.put_object("tb", "k", b"cur"))["version_id"]
+
+            # rewrite the mtimes so the CURRENT version (v2) is older
+            # than the noncurrent v1 — the adversarial ordering
+            void = gw._versions_oid("tb")
+            omap = await ioctx.get_omap(void)
+            recs = {k: json.loads(v) for k, v in omap.items()}
+            recs[gw._vkey("k", v1)]["mtime"] = 2000.0
+            recs[gw._vkey("k", v2)]["mtime"] = 1000.0
+            await ioctx.set_omap(void, {
+                k: json.dumps(r).encode() for k, r in recs.items()})
+            meta = await gw._bucket_meta("tb")
+            cur = json.loads((await gw._index_get("tb", "k",
+                                                  meta))["k"])
+            cur["mtime"] = 1000.0
+            await gw._index_set("tb", meta, "k",
+                                json.dumps(cur).encode())
+
+            await gw.put_lifecycle("tb", [
+                {"id": "nc", "prefix": "", "status": "Enabled",
+                 "noncurrent_seconds": 1},
+            ])
+            removed = await gw.lc_process(now=3000.0)
+            # only the genuinely-noncurrent v1 dies; the current v2
+            # (older mtime!) survives with its body intact
+            assert removed["tb"] == [f"k@{v1}"]
+            assert (await gw.get_object("tb", "k"))["data"] == b"cur"
+            vers = await gw.list_object_versions("tb")
+            assert [v["version_id"] for v in vers] == [v2]
+            assert vers[0]["is_latest"]
+
+            # exact-tie sanity: identical mtimes must also keep the
+            # current version first
+            v3 = (await gw.put_object("tb", "k", b"tie"))["version_id"]
+            omap = await ioctx.get_omap(void)
+            recs = {k: json.loads(v) for k, v in omap.items()}
+            for r in recs.values():
+                r["mtime"] = 5000.0
+            await ioctx.set_omap(void, {
+                k: json.dumps(r).encode() for k, r in recs.items()})
+            removed = await gw.lc_process(now=9000.0)
+            assert removed["tb"] == [f"k@{v2}"]
+            assert (await gw.get_object("tb", "k"))["data"] == b"tie"
+            assert [v["version_id"]
+                    for v in await gw.list_object_versions("tb")] \
+                == [v3]
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_transition_refuses_sse_c_and_rule_validation():
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, ioctx = await _gw(rados)
+            await _cold(ioctx)
+            await gw.create_bucket("b")
+            await gw.put_object("b", "sec", b"customer-held key")
+            # dress the head as SSE-C — alg/key_md5/nonce but no
+            # "wrapped" KMS envelope — without needing the optional
+            # cryptography module: the refusal only reads the record
+            meta = await gw._bucket_meta("b")
+            rec = json.loads((await gw._index_get("b", "sec",
+                                                  meta))["sec"])
+            rec["sse"] = {"alg": "AES256", "key_md5": "m",
+                          "nonce": "00" * 16}
+            await gw._index_set("b", meta, "sec",
+                                json.dumps(rec).encode())
+
+            # the worker holds no customer key: the object must stay
+            # put, exactly as a server-initiated PUT would be refused
+            with pytest.raises(RGWError) as e:
+                await gw._transition_object("b", "sec", None, "COLD")
+            assert e.value.code == "InvalidRequest"
+
+            await gw.put_lifecycle("b", [
+                {"id": "t", "prefix": "", "status": "Enabled",
+                 "transition_seconds": 1,
+                 "transition_class": "COLD"},
+            ])
+            out = await gw.lc_process(now=time.time() + 10)
+            assert out == {}            # refused, pass kept going
+            head = await gw.head_object("b", "sec")
+            assert "storage_class" not in head
+            assert await ioctx.read(head["data_oid"]) == \
+                b"customer-held key"
+
+            # a server-managed envelope ("wrapped" dek rides the head)
+            # transitions fine — the ciphertext moves verbatim
+            await gw.put_object("b", "kms", b"server-held key")
+            rec = json.loads((await gw._index_get("b", "kms",
+                                                  meta))["kms"])
+            rec["sse"] = {"wrapped": "deadbeef", "nonce": "00" * 16}
+            await gw._index_set("b", meta, "kms",
+                                json.dumps(rec).encode())
+            out = await gw.lc_process(now=time.time() + 10)
+            assert out["b"] == ["kms->COLD"]
+            head = await gw.head_object("b", "kms")
+            assert head["storage_class"] == "COLD"
+            assert head["sse"] == {"wrapped": "deadbeef",
+                                   "nonce": "00" * 16}
+            cold_io = await rados.open_ioctx("rgwt.cold")
+            assert await cold_io.read(head["data_oid"]) == \
+                b"server-held key"
+
+            # rule validation: time+class travel together, STANDARD
+            # is not a transition target, unresolvable classes are
+            # rejected at PUT-lifecycle time, and the expiration must
+            # outlive the transition
+            for bad, code in (
+                ({"id": "r", "transition_seconds": 5},
+                 "MalformedXML"),
+                ({"id": "r", "transition_class": "COLD"},
+                 "MalformedXML"),
+                ({"id": "r", "transition_seconds": 5,
+                  "transition_class": "STANDARD"},
+                 "InvalidArgument"),
+                ({"id": "r", "transition_seconds": 5,
+                  "transition_class": "GLACIER"},
+                 "InvalidStorageClass"),
+                ({"id": "r", "transition_seconds": 10,
+                  "transition_class": "COLD",
+                  "expiration_seconds": 5},
+                 "InvalidArgument"),
+                ({"id": "r", "noncurrent_transition_seconds": 10,
+                  "noncurrent_transition_class": "COLD",
+                  "noncurrent_seconds": 10},
+                 "InvalidArgument"),
+            ):
+                with pytest.raises(RGWError) as e:
+                    await gw.put_lifecycle("b", [
+                        dict(bad, prefix="", status="Enabled")])
+                assert e.value.code == code, bad
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
+
+
+def test_transition_composes_with_compression():
+    """A class with inline compression deflates the moved body exactly
+    as a fresh PUT into the class would: S3-visible size/etag stay the
+    original, the read path re-inflates bit-identically."""
+    async def run():
+        mon, osds, rados = await start_cluster()
+        try:
+            gw, ioctx = await _gw(rados)
+            await _cold(ioctx, compression="zlib")
+            await gw.create_bucket("b")
+            body = b"squeeze me " * 4096
+            await gw.put_object("b", "k", body)
+            before = await gw.head_object("b", "k")
+            assert "comp" not in before
+
+            await gw.put_lifecycle("b", [
+                {"id": "t", "prefix": "", "status": "Enabled",
+                 "transition_seconds": 1,
+                 "transition_class": "COLD"},
+            ])
+            moved = await gw.lc_process(now=time.time() + 10)
+            assert moved["b"] == ["k->COLD"]
+
+            head = await gw.head_object("b", "k")
+            assert head["storage_class"] == "COLD"
+            assert head["comp"] is not None
+            assert head["size"] == len(body)
+            assert head["etag"] == before["etag"]
+            # the stored tail is genuinely smaller than the body
+            cold_io = await rados.open_ioctx("rgwt.cold")
+            st = await cold_io.stat(head["data_oid"])
+            assert 0 < st["size"] < len(body)
+            assert (await gw.get_object("b", "k"))["data"] == body
+        finally:
+            await stop_cluster(mon, osds, rados)
+
+    asyncio.run(run())
