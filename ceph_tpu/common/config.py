@@ -205,6 +205,25 @@ def global_options() -> list[Option]:
                "stripes per device encode launch", min=1),
         Option("ec_use_pallas", bool, True,
                "use fused Pallas kernels on TPU"),
+        Option("osd_ec_coalesce", bool, True,
+               "coalesce concurrent in-flight EC ops' encode/decode "
+               "batches into shared device launches (cross-op "
+               "micro-batching; amortizes per-launch dispatch cost "
+               "for small-write workloads)"),
+        Option("osd_ec_coalesce_window_us", float, 200.0,
+               "adaptive micro-window an EC op may wait for batchmates "
+               "before its coalesced launch flushes (microseconds; "
+               "flushes immediately when no other op is in flight)",
+               Level.ADVANCED, min=0.0),
+        Option("osd_ec_coalesce_max_stripes", int, 4096,
+               "pending stripe count that forces an immediate coalesced "
+               "flush regardless of the window", Level.ADVANCED, min=1),
+        Option("ec_pallas_encode_variant", str, "",
+               "Pallas encode kernel formulation ('' = production "
+               "kernel; variants are bit-identical, promoted from the "
+               "round-5 perf lab for on-chip timing)", Level.ADVANCED,
+               enum_values=("", "enc_cmp_expand", "enc_u8_expand",
+                            "enc_split2", "enc_u8_split2")),
         Option("log_to_memory_ring", bool, True, "keep crash ring buffer"),
         Option("debug_default", int, 1, "default subsystem debug level",
                min=0, max=20),

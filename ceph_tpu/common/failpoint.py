@@ -40,8 +40,9 @@ Aliases: the legacy messenger knobs remain valid point names —
 Well-known names threaded through the tree: ``msgr.send``, ``msgr.accept``,
 ``msgr.dial``, ``msgr.deliver``, ``store.wal_commit``, ``store.checkpoint``,
 ``osd.heartbeat``, ``osd.recovery``, ``osd.sub_op``, ``mon.paxos_commit``,
-``mon.election``, ``mds.journal_flush``, ``ec.shard_read`` (plus
-``ec.shard_read.<i>`` for a single shard).
+``mon.election``, ``mds.journal_flush``, ``ec.shard_read`` and
+``ec.shard_write`` (plus ``ec.shard_read.<i>`` / ``ec.shard_write.<i>``
+for a single shard).
 """
 
 from __future__ import annotations

@@ -81,6 +81,37 @@ def packet_bitmatrix_apply(bits_matrix: jax.Array, data: jax.Array,
     return by.astype(jnp.uint8).reshape(B, -1, C)
 
 
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n (n >= 1).
+
+    Shape-bucketing policy for batched device launches: every distinct
+    leading batch dim B traces/compiles a fresh XLA program (jit caches
+    are shape-keyed), so a workload with arbitrary stripe counts pays an
+    unbounded compile stream.  Rounding B up to a power of two bounds the
+    compiled-program population to ceil(log2(max B)) + 1 buckets per
+    codec geometry while wasting < 2x compute worst-case — and GF matrix
+    region ops are row-independent, so zero-padded rows never perturb
+    real rows (bit-identity is preserved by construction)."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def pad_batch_pow2(arr: np.ndarray) -> tuple[np.ndarray, int]:
+    """Zero-pad the leading (batch/stripe) axis of ``arr`` up to its
+    pow2_bucket.  Returns (padded, original_B); callers slice the result
+    back to original_B rows.  No-op (no copy) when B is already a
+    bucket size."""
+    arr = np.asarray(arr, np.uint8)
+    b = arr.shape[0]
+    bp = pow2_bucket(b)
+    if bp == b:
+        return arr, b
+    pad = np.zeros((bp - b,) + arr.shape[1:], np.uint8)
+    return np.concatenate([arr, pad], axis=0), b
+
+
 def _default_use_pallas() -> bool:
     """Fused Pallas kernel on real TPU; XLA einsum elsewhere (CPU tests,
     interpret-mode covers the Pallas math there)."""

@@ -56,6 +56,34 @@ def shard_kernel_supported(kin: int, mout: int) -> bool:
     return _pick_kblk(kin, mout) > 0
 
 
+# -- encode-variant selection (promoted from testing/perf_lab round 5) ----
+#
+# Alternative kernel formulations of the same GF(2) contraction, all
+# bit-identical to the production kernel (interpret-mode corpus check in
+# CI; perf_lab timed them on-chip).  Selected process-wide via conf
+# ``ec_pallas_encode_variant`` so the chip waiter can flip the default
+# the moment a grant lands.  Variants assume an unblocked contraction
+# (kblocks == 1); matrices big enough to need contraction blocking keep
+# the production kernel.
+ENCODE_VARIANTS = ("", "enc_cmp_expand", "enc_u8_expand",
+                   "enc_split2", "enc_u8_split2")
+_encode_variant = ""
+
+
+def set_encode_variant(name: str) -> None:
+    """Select the Pallas encode kernel formulation ("" = production)."""
+    global _encode_variant
+    if name not in ENCODE_VARIANTS:
+        raise ValueError(
+            f"unknown encode variant {name!r}; one of {ENCODE_VARIANTS}"
+        )
+    _encode_variant = name
+
+
+def get_encode_variant() -> str:
+    return _encode_variant
+
+
 def _kernel(bm_ref, data_ref, out_ref, *, mout):
     kb = pl.program_id(1)
     d = data_ref[:]  # (kblk, T) int32
@@ -124,6 +152,131 @@ def _pick_tile(n4: int, mout: int) -> int:
     while t > LANE and n4 % t:
         t //= 2
     return t
+
+
+def _kernel_cmp_expand(bm_ref, data_ref, out_ref, *, mout):
+    """Variant enc_cmp_expand: bit expansion via mask-AND + compare-to-
+    zero producing int8 directly — drops the int32 plane intermediate
+    AND the separate astype(int8) relayout of the production kernel."""
+    d = data_ref[:]
+    kin, T = d.shape
+    shift = jax.lax.broadcasted_iota(jnp.int32, (1, 32, 1), 1)
+    mask = jnp.left_shift(jnp.int32(1), shift)
+    bits = ((d[:, None, :] & mask) != 0).astype(jnp.int8) \
+        .reshape(kin * 32, T)
+    acc = jnp.dot(bm_ref[:], bits, preferred_element_type=jnp.int32)
+    accb = (acc & 1).reshape(mout, 32, T)
+    out_ref[:] = jnp.sum(accb << shift, axis=1)
+
+
+def _kernel_split2(bm_ref, data_ref, out_ref, *, mout):
+    """Variant enc_split2: software-pipelined halves — two independent
+    half-tiles per body so the scheduler may overlap half 2's VPU
+    expansion with half 1's MXU contraction."""
+    kin, T = data_ref.shape
+    half = T // 2
+    shift = jax.lax.broadcasted_iota(jnp.int32, (1, 32, 1), 1)
+    B = bm_ref[:]
+    for h in range(2):
+        d = data_ref[:, h * half:(h + 1) * half]
+        bits = ((d[:, None, :] >> shift) & 1).reshape(kin * 32, half)
+        acc = jnp.dot(B, bits.astype(jnp.int8),
+                      preferred_element_type=jnp.int32)
+        accb = (acc & 1).reshape(mout, 32, half)
+        out_ref[:, h * half:(h + 1) * half] = \
+            jnp.sum(accb << shift, axis=1)
+
+
+def _kernel_u8(bm_ref, data_ref, out_ref, *, mout):
+    """Variant enc_u8_expand: uint8-native formulation.  Input rides as
+    (k, 4, N/4) uint8 (slot q = contiguous quarter of the byte stream;
+    the slot plays the lane-expansion byte position, so the production
+    bitmatrix applies unchanged).  Expansion and output are int8-width
+    VPU ops."""
+    d = data_ref[:]                               # (kin, 4, T) uint8
+    kin, _, T = d.shape
+    shift8 = jax.lax.broadcasted_iota(jnp.uint8, (1, 1, 8, 1), 2)
+    bits = ((d[:, :, None, :] >> shift8) & 1) \
+        .reshape(kin * 32, T).astype(jnp.int8)
+    acc = jnp.dot(bm_ref[:], bits, preferred_element_type=jnp.int32)
+    accb = (acc & 1).reshape(mout, 4, 8, T)
+    s32 = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 8, 1), 2)
+    out_ref[:] = jnp.sum(accb << s32, axis=2).astype(jnp.uint8)
+
+
+def _kernel_u8_split2(bm_ref, data_ref, out_ref, *, mout):
+    """Variant enc_u8_split2: uint8-native expansion AND pipelined
+    halves."""
+    kin, _, T = data_ref.shape
+    half = T // 2
+    B = bm_ref[:]
+    shift8 = jax.lax.broadcasted_iota(jnp.uint8, (1, 1, 8, 1), 2)
+    s32 = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 8, 1), 2)
+    for h in range(2):
+        d = data_ref[:, :, h * half:(h + 1) * half]
+        bits = ((d[:, :, None, :] >> shift8) & 1) \
+            .reshape(kin * 32, half).astype(jnp.int8)
+        acc = jnp.dot(B, bits, preferred_element_type=jnp.int32)
+        accb = (acc & 1).reshape(mout, 4, 8, half)
+        out_ref[:, :, h * half:(h + 1) * half] = \
+            jnp.sum(accb << s32, axis=2).astype(jnp.uint8)
+
+
+_WORD_VARIANT_KERNELS = {
+    "enc_cmp_expand": _kernel_cmp_expand,
+    "enc_split2": _kernel_split2,
+}
+_U8_VARIANT_KERNELS = {
+    "enc_u8_expand": _kernel_u8,
+    "enc_u8_split2": _kernel_u8_split2,
+}
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tile", "variant", "interpret"))
+def _pallas_apply_words_variant(bm32, words, *, tile, variant,
+                                interpret=False):
+    """Word-layout variant launch (unblocked contraction only)."""
+    kin, n4 = words.shape
+    mout = bm32.shape[0] // 32
+    return pl.pallas_call(
+        functools.partial(_WORD_VARIANT_KERNELS[variant], mout=mout),
+        grid=(n4 // tile,),
+        in_specs=[
+            pl.BlockSpec(bm32.shape, lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kin, tile), lambda t: (0, t),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((mout, tile), lambda t: (0, t),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((mout, n4), jnp.int32),
+        interpret=interpret,
+    )(bm32, words)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tile", "variant", "interpret"))
+def _pallas_apply_u8_variant(bm32, x8, *, tile, variant,
+                             interpret=False):
+    """u8-slot-layout variant launch: (kin, 4, nq) uint8 in,
+    (mout, 4, nq) uint8 out (slot q = quarter q of the byte stream)."""
+    kin, _, nq = x8.shape
+    mout = bm32.shape[0] // 32
+    return pl.pallas_call(
+        functools.partial(_U8_VARIANT_KERNELS[variant], mout=mout),
+        grid=(nq // tile,),
+        in_specs=[
+            pl.BlockSpec(bm32.shape, lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((kin, 4, tile), lambda t: (0, 0, t),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((mout, 4, tile), lambda t: (0, 0, t),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((mout, 4, nq), jnp.uint8),
+        interpret=interpret,
+    )(bm32, x8)
 
 
 def _device_cached(np_arr: np.ndarray, slot):
@@ -478,6 +631,30 @@ class PallasShardApply:
         rpad = self.kpad - self.kin
         if pad or rpad:
             words = jnp.pad(words, ((0, rpad), (0, pad)))
+        # variant dispatch: alternate kernel formulations cover only the
+        # unblocked contraction (kblocks == 1); blocked matrices keep
+        # the production kernel
+        variant = _encode_variant
+        if variant and self.kblk == self.kin:
+            tile = _pick_tile(n4 + pad, self.mout)
+            if variant in _WORD_VARIANT_KERNELS:
+                out = _pallas_apply_words_variant(
+                    self._bm32_arg(), words, tile=tile,
+                    variant=variant, interpret=self.interpret,
+                )
+            else:
+                # u8 slot layout: quarter q of each row's byte stream
+                # rides slot q; invert by flattening slots back into the
+                # byte stream and repacking little-endian lanes
+                x8 = words_to_bytes(words).reshape(kin, 4, n4 + pad)
+                out8 = _pallas_apply_u8_variant(
+                    self._bm32_arg(), x8, tile=tile,
+                    variant=variant, interpret=self.interpret,
+                )
+                out = bytes_to_words(
+                    out8.reshape(self.mout, 4 * (n4 + pad))
+                )
+            return out[:, :n4] if pad else out
         out = _pallas_apply_words(
             self._bm32_arg(), words, tile=_pick_tile(n4 + pad, self.mout),
             kblk=self.kblk, interpret=self.interpret,

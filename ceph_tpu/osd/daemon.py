@@ -336,6 +336,18 @@ class OSDDaemon:
             except (TimeoutError, ConnectionError, asyncio.TimeoutError):
                 await asyncio.sleep(1.0)
 
+    def _ec_coalesce_stats(self) -> dict:
+        """Admin-socket ``ec coalesce stats``: every primary EC PG's
+        CoalescedLauncher lifetime counters (per-PG; the perf counters
+        aggregate the same signals daemon-wide)."""
+        out = {}
+        for pgid, pg in self.pgs.items():
+            be = getattr(pg, "backend", None)
+            if be is None or getattr(be, "coalescer", None) is None:
+                continue
+            out[str(pgid)] = be.coalescer.stats()
+        return out
+
     async def _start_admin_socket(self) -> None:
         """Bind <admin_socket_dir>/<entity>.asok with the reference's
         introspection surface (admin_socket.h:105): perf dump,
@@ -371,6 +383,8 @@ class OSDDaemon:
             "osdmap_epoch": self.osdmap.epoch if self.osdmap else 0,
             "num_pgs": len(self.pgs),
         }, "daemon status")
+        sock.register("ec coalesce stats", self._ec_coalesce_stats,
+                      "per-PG EC cross-op coalescer state")
         fp.register_admin_commands(sock)
         await sock.start(run_dir)
         self.admin_socket = sock
@@ -1316,10 +1330,21 @@ class OSDDaemon:
                 return entry
 
             hedge = float(self.conf["osd_ec_hedge_read_timeout"])
-            pg.backend = ECBackend(codec, shards, log_hook=log_hook,
-                                   mesh=self._ec_mesh(),
-                                   hedge_timeout=hedge or None,
-                                   perf=self.perf)
+            variant = str(self.conf["ec_pallas_encode_variant"])
+            if variant:
+                from ceph_tpu.ec import pallas_kernels
+                pallas_kernels.set_encode_variant(variant)
+            pg.backend = ECBackend(
+                codec, shards, log_hook=log_hook,
+                mesh=self._ec_mesh(),
+                hedge_timeout=hedge or None,
+                perf=self.perf,
+                coalesce=bool(self.conf["osd_ec_coalesce"]),
+                coalesce_window_us=float(
+                    self.conf["osd_ec_coalesce_window_us"]),
+                coalesce_max_stripes=int(
+                    self.conf["osd_ec_coalesce_max_stripes"]),
+            )
             pg.ec_k = pg.backend.k
         else:
             pg.backend = None       # replicated path works on the store

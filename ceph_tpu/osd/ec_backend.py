@@ -80,6 +80,9 @@ class LocalShard:
         return t
 
     async def write_shard(self, oid, offset, data, attrs, log=None):
+        if fp.ACTIVE:
+            await fp.fire("ec.shard_write")
+            await fp.fire(f"ec.shard_write.{self.shard}")
         t = Transaction().write(self.cid, self._oid(oid), offset, data)
         for name, val in attrs.items():
             t.setattr(self.cid, self._oid(oid), name, val)
@@ -138,6 +141,16 @@ class ExtentCache:
         self._bytes = 0              # running total (trim is O(evicted))
         self.hits = 0
         self.misses = 0
+        # invalidation generations: a writer captures generation(oid)
+        # before its (possibly coalesced, so arbitrarily delayed) encode
+        # and passes it to note_write, which drops the note if an
+        # invalidate() landed in between — a completed-late write must
+        # not resurrect extents that were invalidated while it was in
+        # flight.  The per-oid ints are tiny and the backend (with its
+        # cache) is rebuilt every peering interval, so growth is bounded
+        # by the interval's invalidated-object count.
+        self._epoch = 0
+        self._gen: dict[str, int] = {}
 
     def get(self, oid: str, start: int, length: int) -> bytes | None:
         """The extent IFF fully covered; None = caller must read."""
@@ -156,9 +169,19 @@ class ExtentCache:
         self.misses += 1
         return None
 
-    def note_write(self, oid: str, start: int, data: bytes) -> None:
+    def generation(self, oid: str) -> tuple[int, int]:
+        """Invalidation generation token for ``oid``; capture before a
+        write's encode, hand back to note_write (see __init__)."""
+        return (self._epoch, self._gen.get(oid, 0))
+
+    def note_write(self, oid: str, start: int, data: bytes,
+                   gen: tuple[int, int] | None = None) -> None:
         """Record the post-write logical content of an aligned region,
-        coalescing with overlapping/adjacent extents."""
+        coalescing with overlapping/adjacent extents.  ``gen`` (from
+        generation()) suppresses the note when an invalidate()/clear()
+        superseded it while the write was in flight."""
+        if gen is not None and gen != self.generation(oid):
+            return
         if not len(data):
             return
         extents = self._objs.setdefault(oid, [])
@@ -186,11 +209,14 @@ class ExtentCache:
         self._trim()
 
     def invalidate(self, oid: str) -> None:
+        self._gen[oid] = self._gen.get(oid, 0) + 1
         extents = self._objs.pop(oid, None)
         if extents:
             self._bytes -= sum(len(d) for _, d in extents)
 
     def clear(self) -> None:
+        self._epoch += 1
+        self._gen.clear()
         self._objs.clear()
         self._bytes = 0
 
@@ -221,6 +247,210 @@ class ExtentCache:
                 "hits": self.hits, "misses": self.misses}
 
 
+class _CoalesceItem:
+    """One op's parked launch request (payload + result future)."""
+
+    __slots__ = ("payload", "nstripes", "fut", "t0")
+
+    def __init__(self, payload, nstripes, fut, t0):
+        self.payload = payload
+        self.nstripes = nstripes
+        self.fut = fut
+        self.t0 = t0
+
+
+class CoalescedLauncher:
+    """Cross-op micro-batcher for device EC launches (the tentpole of
+    the dynamic-batching fix for per-op dispatch overhead: PERF.md shows
+    the kernel is 3-4x faster when a batch amortizes fixed launch/pack
+    costs, yet each OSD op used to dispatch its own handful of stripes).
+
+    Concurrent in-flight ops enqueue their stripe blocks keyed by launch
+    geometry — ``('enc',)`` for encode, ``('dec', survivors, todo)`` for
+    decode, so mixed failure patterns never share a decode matrix — and
+    a single flusher task concatenates batchmates along the leading
+    stripe axis and runs ONE device launch per key, scattering each op's
+    slice back to its waiter.
+
+    Adaptive micro-window: a flush happens at the FIRST of
+      - every in-flight backend op is already parked here (idle: no
+        batchmate can arrive, so waiting longer only adds latency),
+      - ``max_stripes`` pending stripes,
+      - ``window_us`` elapsed since the oldest parked op.
+
+    Failure isolation: a batchmate's exception (shape error, codec
+    raise, cancelled waiter) fails only that op.  Cancelled waiters are
+    dropped at flush time; a failed batched launch falls back to a
+    transparent per-op solo retry so batchmates still get results.
+    """
+
+    def __init__(self, backend, window_us: float = 200.0,
+                 max_stripes: int = 4096):
+        self.backend = backend
+        self.window_s = max(0.0, float(window_us)) / 1e6
+        self.max_stripes = max(1, int(max_stripes))
+        self._items: dict[tuple, list[_CoalesceItem]] = {}
+        self._npending = 0          # parked ops not yet flushed
+        self._nstripes = 0
+        self._flusher: asyncio.Task | None = None
+        self._wake: asyncio.Event | None = None
+        self._loop = None
+        # lifetime stats (admin socket `ec coalesce stats`; the perf
+        # counters aggregate across backends per daemon)
+        self.launches = 0
+        self.ops = 0
+        self.solo_retries = 0
+        self.failed_ops = 0
+        self.cancelled_waiters = 0
+
+    def _bind_loop(self, loop) -> None:
+        # A backend may be driven through several event loops over its
+        # life (tests run one backend under repeated asyncio.run);
+        # asyncio primitives are loop-bound, so rebind lazily.  Parked
+        # state never survives a loop: every submitter awaits its future
+        # inside the old loop, so the queues are empty by construction
+        # when a new loop first submits.
+        self._loop = loop
+        self._wake = asyncio.Event()
+        self._flusher = None
+        self._items = {}
+        self._npending = 0
+        self._nstripes = 0
+
+    def notify(self) -> None:
+        """Re-evaluate the flush condition (an op completed, so the
+        idle test may newly hold)."""
+        if self._wake is not None:
+            try:
+                if asyncio.get_running_loop() is self._loop:
+                    self._wake.set()
+            except RuntimeError:
+                pass
+
+    async def submit(self, key: tuple, payload, nstripes: int):
+        """Park one launch request; resolves with this op's slice of
+        the coalesced result."""
+        loop = asyncio.get_running_loop()
+        if loop is not self._loop:
+            self._bind_loop(loop)
+        item = _CoalesceItem(payload, int(nstripes),
+                             loop.create_future(), loop.time())
+        self._items.setdefault(key, []).append(item)
+        self._npending += 1
+        self._nstripes += item.nstripes
+        if self._flusher is None or self._flusher.done():
+            self._flusher = loop.create_task(self._run_flusher())
+        self._wake.set()
+        try:
+            return await item.fut
+        except asyncio.CancelledError:
+            self.cancelled_waiters += 1
+            raise
+
+    async def _run_flusher(self) -> None:
+        loop = self._loop
+        try:
+            while self._npending:
+                while True:
+                    if self._nstripes >= self.max_stripes:
+                        break
+                    if self._npending >= self.backend._inflight_ops:
+                        break       # idle: no batchmate can arrive
+                    oldest = min(it.t0 for items in self._items.values()
+                                 for it in items)
+                    remaining = oldest + self.window_s - loop.time()
+                    if remaining <= 0:
+                        break
+                    self._wake.clear()
+                    try:
+                        await asyncio.wait_for(self._wake.wait(),
+                                               remaining)
+                    except asyncio.TimeoutError:
+                        break
+                batches = self._items
+                self._items = {}
+                self._npending = 0
+                self._nstripes = 0
+                for key, items in batches.items():
+                    await self._flush_key(key, items)
+        finally:
+            # flusher teardown (daemon shutdown cancels it): fail any
+            # still-parked waiters instead of leaving them hung
+            for items in self._items.values():
+                for it in items:
+                    if not it.fut.done():
+                        it.fut.cancel()
+            self._items = {}
+            self._npending = 0
+            self._nstripes = 0
+
+    async def _flush_key(self, key: tuple,
+                         items: list[_CoalesceItem]) -> None:
+        be = self.backend
+        # a waiter cancelled while parked: drop its payload — the
+        # remaining batchmates must neither wait for it nor fail
+        live = [it for it in items if not it.fut.done()]
+        if not live:
+            return
+        now = self._loop.time()
+        for it in live:
+            be.perf.tinc("ec_coalesce_wait_us", (now - it.t0) * 1e6)
+        try:
+            outs = await be._coalesce_launch(
+                key, [it.payload for it in live])
+        except asyncio.CancelledError:
+            raise
+        except BaseException as exc:
+            if len(live) == 1:
+                self.launches += 1
+                self.failed_ops += 1
+                if not live[0].fut.done():
+                    live[0].fut.set_exception(exc)
+                return
+            # failure isolation: one batchmate poisoned the batch
+            # (shape mismatch, codec raise) — transparent solo retry
+            # so only the actually-broken op(s) fail
+            for it in live:
+                if it.fut.done():
+                    continue
+                self.solo_retries += 1
+                self.launches += 1
+                try:
+                    out = (await be._coalesce_launch(
+                        key, [it.payload]))[0]
+                except asyncio.CancelledError:
+                    raise
+                except BaseException as solo_exc:
+                    self.failed_ops += 1
+                    it.fut.set_exception(solo_exc)
+                else:
+                    it.fut.set_result(out)
+            return
+        self.launches += 1
+        self.ops += len(live)
+        be.perf.inc("ec_coalesce_launches")
+        be.perf.inc("ec_coalesce_ops", len(live))
+        be.perf.tinc("ec_coalesce_occupancy", len(live))
+        for it, out in zip(live, outs):
+            if not it.fut.done():
+                it.fut.set_result(out)
+
+    def stats(self) -> dict:
+        return {
+            "window_us": self.window_s * 1e6,
+            "max_stripes": self.max_stripes,
+            "launches": self.launches,
+            "ops": self.ops,
+            "occupancy": (self.ops / self.launches
+                          if self.launches else 0.0),
+            "solo_retries": self.solo_retries,
+            "failed_ops": self.failed_ops,
+            "cancelled_waiters": self.cancelled_waiters,
+            "pending_ops": self._npending,
+            "pending_stripes": self._nstripes,
+        }
+
+
 class ECBackend:
     def __init__(
         self,
@@ -231,6 +461,9 @@ class ECBackend:
         mesh=None,
         hedge_timeout: float | None = None,
         perf: PerfCounters | None = None,
+        coalesce: bool = True,
+        coalesce_window_us: float = 200.0,
+        coalesce_max_stripes: int = 4096,
     ):
         """``codec``: an initialised ErasureCodeInterface; ``shards``:
         shard id -> ShardIO for all k+m positions. ``log_hook(oid, op,
@@ -287,15 +520,30 @@ class ECBackend:
         self._mesh_appliers: dict[tuple, object] = {}
         self._mesh_enc_applier = None   # pinned write-path encoder
         # observability: proves which plane served a batch (tests and
-        # perf counters read these)
-        self.mesh_stats = {"encodes": 0, "decodes": 0}
+        # perf counters read these).  *_buckets record the DISTINCT
+        # padded batch dims launched — the pow2 shape-bucketing bound on
+        # compiled XLA programs is asserted against them.
+        self.mesh_stats = {"encodes": 0, "decodes": 0,
+                           "encode_buckets": set(),
+                           "decode_buckets": set()}
         # hedged reads: a data-shard read still pending after
         # hedge_timeout seconds is raced against a minimum_to_decode
         # reconstruction from the surviving shards (None/0 = off)
         self.hedge_timeout = hedge_timeout or None
         self.perf = perf if perf is not None else PerfCounters("ec")
-        for _k in ("hedge_issued", "hedge_won", "hedge_lost"):
+        for _k in ("hedge_issued", "hedge_won", "hedge_lost",
+                   "ec_coalesce_launches", "ec_coalesce_ops",
+                   "ec_coalesce_pad_waste", "ec_device_launches"):
             self.perf.add(_k, CounterType.U64)
+        for _k in ("ec_coalesce_occupancy", "ec_coalesce_wait_us"):
+            self.perf.add(_k, CounterType.LONGRUNAVG)
+        # cross-op micro-batching of device launches (the tentpole):
+        # ops in flight concurrently share one encode/decode launch
+        self._inflight_ops = 0
+        self.coalescer = CoalescedLauncher(
+            self, window_us=coalesce_window_us,
+            max_stripes=coalesce_max_stripes,
+        ) if coalesce else None
 
     def _lock(self, oid: str):
         """Per-object write lock, refcounted so the table doesn't grow
@@ -377,29 +625,59 @@ class ECBackend:
     async def _encode_batch(self, stripes: np.ndarray) -> np.ndarray:
         """(B, k, C) -> (B, k+m, C), through the mesh plane when one is
         configured (parity = sharded generator apply; data rows pass
-        through, so the result is bit-identical to the codec path)."""
+        through, so the result is bit-identical to the codec path).
+
+        The batch dim is shape-bucketed: B pads up to a power of two
+        (zero stripes; rows are independent, result sliced back) so the
+        program/applier cache holds at most ceil(log2(max B)) + 1
+        distinct encode shapes per codec instead of one per stripe
+        count."""
+        from ceph_tpu.ec.engine import pad_batch_pow2
+
+        stripes, b = pad_batch_pow2(stripes)
+        if stripes.shape[0] != b:
+            self.perf.inc("ec_coalesce_pad_waste", stripes.shape[0] - b)
+        self.mesh_stats["encode_buckets"].add(stripes.shape[0])
+        self.perf.inc("ec_device_launches")
         if self.mesh is not None:
             ap = self._mesh_applier(
                 ("enc",), lambda: self._mesh_gen[self.k:])
             parity = await asyncio.to_thread(ap, stripes)
             self.mesh_stats["encodes"] += 1
             return np.concatenate(
-                [np.asarray(stripes, np.uint8), parity], axis=1)
+                [np.asarray(stripes, np.uint8), parity], axis=1)[:b]
         return np.asarray(await asyncio.to_thread(
             self.ec.encode_chunks_batch, stripes
-        ))
+        ))[:b]
 
     async def _decode_batch(self, batched: dict, missing: list) -> dict:
         """Batched reconstruct through the mesh plane when configured.
         Survivor selection mirrors the codec's decode_chunks_batch
         (sorted available, first k) so both planes build the same
-        decode matrix — bit-identity by construction."""
+        decode matrix — bit-identity by construction.  Batch dim
+        shape-bucketed like _encode_batch."""
         missing = [int(w) for w in missing]
+        b = next(iter(batched.values())).shape[0] if batched else 0
+        if b:
+            from ceph_tpu.ec.engine import pow2_bucket
+
+            bp = pow2_bucket(b)
+            if bp != b:
+                self.perf.inc("ec_coalesce_pad_waste", bp - b)
+                batched = {
+                    s: np.concatenate([
+                        np.asarray(c, np.uint8),
+                        np.zeros((bp - b,) + np.shape(c)[1:], np.uint8),
+                    ], axis=0)
+                    for s, c in batched.items()
+                }
+            self.mesh_stats["decode_buckets"].add(bp)
+        self.perf.inc("ec_device_launches")
         if self.mesh is not None:
             avail = {int(i): np.asarray(c, np.uint8)
                      for i, c in batched.items()}
             todo = [w for w in missing if w not in avail]
-            out = {w: avail[w] for w in missing if w in avail}
+            out = {w: avail[w][:b] for w in missing if w in avail}
             if todo:
                 if len(avail) < self.k:
                     raise IOError(f"cannot decode {todo}")
@@ -413,12 +691,105 @@ class ECBackend:
                                    axis=1)
                 rebuilt = await asyncio.to_thread(ap, stacked)
                 for i, w in enumerate(todo):
-                    out[w] = rebuilt[:, i]
+                    out[w] = rebuilt[:b, i]
                 self.mesh_stats["decodes"] += 1
             return out
-        return await asyncio.to_thread(
+        out = await asyncio.to_thread(
             self.ec.decode_chunks_batch, batched, missing
         )
+        return {w: np.asarray(c)[:b] for w, c in out.items()}
+
+    # -- cross-op coalescing (CoalescedLauncher front ends) ---------------
+    async def _coalesced_encode(self, stripes: np.ndarray) -> np.ndarray:
+        """Encode entry for in-flight ops: parks the stripe block on the
+        per-backend CoalescedLauncher (one device launch shared across
+        concurrent batchmates) or falls through to the direct path when
+        coalescing is off.  Shape validation happens HERE, before the op
+        joins a batch, so a malformed op can only fail itself."""
+        stripes = np.asarray(stripes, np.uint8)
+        if self.coalescer is None:
+            return await self._encode_batch(stripes)
+        if stripes.ndim != 3 or stripes.shape[1] != self.k \
+                or stripes.shape[2] != self.sinfo.chunk_size:
+            raise ValueError(
+                f"encode batch shape {stripes.shape} != "
+                f"(B, {self.k}, {self.sinfo.chunk_size})"
+            )
+        return await self.coalescer.submit(
+            ("enc",), stripes, stripes.shape[0])
+
+    async def _coalesced_decode(self, batched: dict,
+                                missing: list) -> dict:
+        """Decode entry for in-flight ops.  Coalescing groups strictly
+        by (available shards, decode targets): only ops with the SAME
+        failure pattern share a launch — and hence a decode matrix."""
+        missing = [int(w) for w in missing]
+        if self.coalescer is None:
+            return await self._decode_batch(batched, missing)
+        avail = {int(s): np.asarray(c, np.uint8)
+                 for s, c in batched.items()}
+        bs = {c.shape[0] for c in avail.values()}
+        if not avail or len(bs) != 1 or any(
+                c.ndim != 2 or c.shape[1] != self.sinfo.chunk_size
+                for c in avail.values()):
+            raise ValueError(
+                f"decode batch shapes "
+                f"{ {s: np.shape(c) for s, c in avail.items()} } "
+                f"not uniform (B, {self.sinfo.chunk_size})"
+            )
+        key = ("dec", tuple(sorted(avail)), tuple(missing))
+        return await self.coalescer.submit(key, avail, bs.pop())
+
+    async def _coalesce_launch(self, key: tuple, payloads: list):
+        """One device launch for a list of batchmate payloads (called
+        only by the CoalescedLauncher): concatenate along the leading
+        stripe axis, run the direct batch path (which shape-buckets),
+        scatter the slices back in order."""
+        if key[0] == "enc":
+            if len(payloads) == 1:
+                return [await self._encode_batch(payloads[0])]
+            sizes = [p.shape[0] for p in payloads]
+            out = await self._encode_batch(
+                np.concatenate(payloads, axis=0))
+            res, off = [], 0
+            for sz in sizes:
+                res.append(out[off:off + sz])
+                off += sz
+            return res
+        _, shards, todo = key
+        if len(payloads) == 1:
+            return [await self._decode_batch(payloads[0], list(todo))]
+        sizes = [next(iter(p.values())).shape[0] for p in payloads]
+        cat = {
+            s: np.concatenate([p[s] for p in payloads], axis=0)
+            for s in shards
+        }
+        out = await self._decode_batch(cat, list(todo))
+        res, off = [], 0
+        for sz in sizes:
+            res.append({w: c[off:off + sz] for w, c in out.items()})
+            off += sz
+        return res
+
+    def _track_op(self):
+        """In-flight op accounting for the coalescer's adaptive window:
+        when every tracked op is parked in the launcher, nothing else
+        can arrive and the flush happens immediately (the idle case — a
+        solo writer never pays the window)."""
+        backend = self
+
+        class _Track:
+            async def __aenter__(self):
+                backend._inflight_ops += 1
+                return self
+
+            async def __aexit__(self, *exc):
+                backend._inflight_ops -= 1
+                if backend.coalescer is not None:
+                    backend.coalescer.notify()
+                return False
+
+        return _Track()
 
     # -- metadata --------------------------------------------------------
     async def _attr_all(self, oid: str, name: str) -> list:
@@ -512,8 +883,13 @@ class ECBackend:
                     version: int | None = None,
                     reqid: str = "") -> ECObjectMeta:
         """Write ``data`` at logical ``offset`` (stripe-granular RMW)."""
-        async with self._lock(oid):
+        async with self._track_op(), self._lock(oid):
             await self._heal_dirty(oid)
+            # capture the cache generation BEFORE the RMW read/encode:
+            # if a concurrent invalidate() lands while our (possibly
+            # coalesced) encode is in flight, note_write below becomes
+            # a no-op instead of resurrecting stale extents
+            cache_gen = self.extent_cache.generation(oid)
             meta = await self._read_meta(oid)
             old_size = meta.size if meta else 0
             new_version = (
@@ -545,7 +921,7 @@ class ECBackend:
             stripes = self.sinfo.split_stripes(buf)
             # device encode off the event loop: a first-time XLA
             # compile must not stall heartbeats/leases in this process
-            chunks = await self._encode_batch(stripes)
+            chunks = await self._coalesced_encode(stripes)
             shard_bytes = self.sinfo.shard_bytes(chunks)
             shard_off = self.sinfo.logical_to_prev_chunk_offset(a_start)
             meta_attr = self._meta_attr(ECObjectMeta(new_size, new_version))
@@ -581,7 +957,7 @@ class ECBackend:
                 self.extent_cache.invalidate(oid)
                 raise
             self.extent_cache.note_write(oid, a_start,
-                                         buf.tobytes())
+                                         buf.tobytes(), gen=cache_gen)
             return ECObjectMeta(new_size, new_version)
 
     async def _settle_write_failures(self, what: str, oid: str,
@@ -930,7 +1306,7 @@ class ECBackend:
             s: arr.reshape(nstripes, self.sinfo.chunk_size)
             for s, arr in have.items()
         }
-        out = await self._decode_batch(batched, list(missing))
+        out = await self._coalesced_decode(batched, list(missing))
         chunks = {}
         for i in range(self.k):
             if i in have:
@@ -941,21 +1317,22 @@ class ECBackend:
 
     async def read(self, oid: str, offset: int = 0,
                    length: int | None = None) -> bytes:
-        meta = await self._read_meta(oid)
-        if meta is None:
-            raise KeyError(f"no such object {oid}")
-        if length is None:
-            length = meta.size - offset
-        length = max(0, min(length, meta.size - offset))
-        if length == 0:
-            return b""
-        a_start, a_len = self.sinfo.offset_len_to_stripe_bounds(
-            offset, length
-        )
-        data = await self._read_logical(oid, a_start, a_len, meta.size,
-                                        meta.version)
-        rel = offset - a_start
-        return data[rel: rel + length]
+        async with self._track_op():
+            meta = await self._read_meta(oid)
+            if meta is None:
+                raise KeyError(f"no such object {oid}")
+            if length is None:
+                length = meta.size - offset
+            length = max(0, min(length, meta.size - offset))
+            if length == 0:
+                return b""
+            a_start, a_len = self.sinfo.offset_len_to_stripe_bounds(
+                offset, length
+            )
+            data = await self._read_logical(oid, a_start, a_len,
+                                            meta.size, meta.version)
+            rel = offset - a_start
+            return data[rel: rel + length]
 
     # -- object metadata ops (fan-out; metadata is replicated per shard) --
     async def remove(self, oid: str, reqid: str = "") -> None:
@@ -1066,6 +1443,16 @@ class ECBackend:
                             version: int | None = None,
                             stray_read=None,
                             stray_positions: Sequence[int] = ()) -> None:
+        async with self._track_op():
+            return await self._recover_shard_impl(
+                oid, lost, version=version, stray_read=stray_read,
+                stray_positions=stray_positions,
+            )
+
+    async def _recover_shard_impl(
+            self, oid: str, lost: Sequence[int],
+            version: int | None = None, stray_read=None,
+            stray_positions: Sequence[int] = ()) -> None:
         """Rebuild lost shard objects from survivors (RecoveryOp).
         Source shards are version-verified so a stale survivor (missed
         degraded write) counts as lost, not as a rebuild source.
@@ -1164,7 +1551,7 @@ class ECBackend:
             s: arr.reshape(nstripes, self.sinfo.chunk_size)
             for s, arr in zip(need, reads)
         }
-        out = await self._decode_batch(batched, lost)
+        out = await self._coalesced_decode(batched, lost)
         # copy the FULL attr set from a version-verified survivor — a
         # rebuilt shard missing user xattrs would serve stale attr
         # reads.  Prefer an acting source; when every source was a
@@ -1195,6 +1582,10 @@ class ECBackend:
 
     # -- scrub -----------------------------------------------------------
     async def scrub(self, oid: str) -> dict:
+        async with self._track_op():
+            return await self._scrub_impl(oid)
+
+    async def _scrub_impl(self, oid: str) -> dict:
         """Deep scrub: recompute parity from data shards on device and
         compare against stored parity + hinfo crcs. Returns a report."""
         meta = await self._read_meta(oid)
@@ -1210,7 +1601,7 @@ class ECBackend:
             [reads[i].reshape(nstripes, self.sinfo.chunk_size)
              for i in range(self.k)], axis=1,
         )
-        recomputed = await self._encode_batch(stripes)
+        recomputed = await self._coalesced_encode(stripes)
         inconsistent = []
         for i in range(self.k, self.n):
             stored = reads[i].reshape(nstripes, self.sinfo.chunk_size)

@@ -37,13 +37,19 @@ FAILPOINT_MENU: list[tuple[str, str, dict]] = [
 
 class ChaosHarness:
     def __init__(self, seed: int = 0, n_osds: int = 4, n_batches: int = 10,
-                 batch: int = 8, pool_size: int = 3, min_size: int = 2):
+                 batch: int = 8, pool_size: int = 3, min_size: int = 2,
+                 ec: bool = False):
         self.seed = seed
         self.n_osds = n_osds
         self.n_batches = n_batches
         self.batch = batch
         self.pool_size = pool_size
         self.min_size = min_size
+        # ec=True: the chaos pool is erasure-coded (jax_rs k=2 m=1), so
+        # the op stream drives the EC write/read/reconstruct path — with
+        # cross-op coalescing on by default, concurrent model ops share
+        # device launches under kill/revive/failpoint churn
+        self.ec = ec
         self.schedule: list[tuple] = []       # recorded (step, event, arg)
 
     def plan(self) -> list[tuple]:
@@ -76,11 +82,23 @@ class ChaosHarness:
         })
         await cluster.start()
         rados = await cluster.client()
-        await rados.pool_create("chaos", pg_num=8, size=self.pool_size,
-                                min_size=self.min_size)
+        if self.ec:
+            r = await rados.mon_command(
+                "osd erasure-code-profile set", name="chaos_ec",
+                profile={"plugin": "jax_rs", "k": "2", "m": "1",
+                         "crush-failure-domain": "osd"})
+            if r["rc"] not in (0, -17):
+                raise RuntimeError(f"ec profile: {r}")
+            await rados.pool_create("chaos", pg_num=8,
+                                    pool_type="erasure",
+                                    erasure_code_profile="chaos_ec")
+        else:
+            await rados.pool_create("chaos", pg_num=8,
+                                    size=self.pool_size,
+                                    min_size=self.min_size)
         io = await rados.open_ioctx("chaos")
         model = RadosModel(io, seed=self.seed, n_objects=8,
-                           max_size=1 << 14)
+                           max_size=1 << 14, ec=self.ec)
         thrasher = Thrasher(cluster, min_live=self.n_osds - 1,
                             seed=self.seed)
         try:

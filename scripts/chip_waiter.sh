@@ -9,6 +9,10 @@
 cd /root/repo || exit 1
 while true; do
   BENCH_BUDGET_S=2700 python bench.py           >> /tmp/waiter_bench.log 2>&1
+  # cfg6 standalone too (cheap): even if the full bench dies at a later
+  # stage, the first unwedged pass still captures on-chip coalescing
+  # numbers (launch counts + wall-clock ratio) in BENCH_LOCAL.jsonl
+  python bench.py --cfg6                        >> /tmp/waiter_bench.log 2>&1
   HW_ID_BUDGET_S=1500 python scripts/hw_identity.py >> /tmp/waiter_id.log 2>&1
   PERF_LAB_BUDGET_S=2400 python -m ceph_tpu.testing.perf_lab \
                                                 >> /tmp/waiter_lab.log 2>&1

@@ -12,6 +12,12 @@
 #                                   vstart cluster with a cold EC pool,
 #                                   one PUT, one lifecycle transition
 #                                   pass, and a bit-identical read-back
+#   scripts/tier1.sh --coalesce-smoke
+#                                   EC cross-op coalescing end to end: a
+#                                   vstart cluster with an EC pool, 64
+#                                   concurrent 4 KiB writes, assert
+#                                   ec_coalesce_launches < ops/4 and a
+#                                   bit-identical read-back
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -114,6 +120,60 @@ async def main():
 asyncio.run(main())
 EOF
     echo "LC_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--coalesce-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    python - <<'EOF'
+import asyncio
+
+
+async def main():
+    from ceph_tpu.vstart import DevCluster
+
+    cluster = DevCluster(n_mons=1, n_osds=3)
+    await cluster.start()
+    try:
+        rados = await cluster.client()
+        r = await rados.mon_command(
+            "osd erasure-code-profile set", name="coalsmoke",
+            profile={"plugin": "jax_rs", "k": "2", "m": "1",
+                     "crush-failure-domain": "osd"})
+        assert r["rc"] in (0, -17), r
+        await rados.pool_create("coal", pg_num=1, pool_type="erasure",
+                                erasure_code_profile="coalsmoke")
+        io = await rados.open_ioctx("coal")
+        print("ok: vstart cluster + EC pool (jax_rs k=2,m=1, 1 pg)")
+
+        datas = {f"obj-{i}": bytes([i]) * 4096 for i in range(64)}
+        await asyncio.gather(*(
+            io.write_full(o, d) for o, d in datas.items()
+        ))
+        print("ok: 64 concurrent 4KiB writes acked")
+        for o, d in datas.items():
+            got = await io.read(o)
+            assert got == d, f"read-back mismatch on {o}"
+        print("ok: bit-identical read-back (64/64)")
+
+        launches = ops = 0
+        for osd in cluster.osds.values():
+            dump = osd.perf.dump()
+            launches += dump.get("ec_coalesce_launches", 0)
+            ops += dump.get("ec_coalesce_ops", 0)
+        print(f"ok: coalescer saw {int(ops)} ops in "
+              f"{int(launches)} launches")
+        assert ops >= 64, (launches, ops)
+        assert launches < ops / 4, (
+            f"coalescing too weak: {launches} launches for {ops} ops")
+    finally:
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+    echo "COALESCE_SMOKE_PASSED"
     exit 0
 fi
 

@@ -142,3 +142,59 @@ def test_failed_write_invalidates():
         assert await be.read("obj") == b"C" * 100
 
     asyncio.run(run())
+
+
+def test_generation_token_suppresses_stale_note():
+    """note_write(gen=...) drops the note when an invalidate()/clear()
+    landed after the token was captured — a coalesced write completing
+    LATE must not resurrect extents invalidated while it was parked."""
+    cache = ExtentCache()
+    cache.note_write("obj", 0, b"A" * 64)
+    gen = cache.generation("obj")
+    # no intervening invalidation: the token is still live
+    cache.note_write("obj", 64, b"B" * 64, gen=gen)
+    assert cache.get("obj", 0, 128) == b"A" * 64 + b"B" * 64
+
+    gen = cache.generation("obj")
+    cache.invalidate("obj")
+    cache.note_write("obj", 0, b"C" * 128, gen=gen)     # stale: dropped
+    assert cache.get("obj", 0, 128) is None
+    # per-object: another oid's token is unaffected by the invalidate
+    g2 = cache.generation("other")
+    cache.note_write("other", 0, b"D" * 32, gen=g2)
+    assert cache.get("other", 0, 32) == b"D" * 32
+
+    gen = cache.generation("obj")
+    cache.clear()                                        # epoch bump
+    cache.note_write("obj", 0, b"E" * 64, gen=gen)
+    assert cache.get("obj", 0, 64) is None
+
+
+def test_invalidate_during_inflight_coalesced_write():
+    """Backend-level race: an invalidation landing while a write is
+    PARKED in the coalescer must win — the late-completing write commits
+    its shards but must not note stale bytes into the cache."""
+    async def run():
+        be, store = await _backend()
+        # a normal coalesced write DOES populate the cache (baseline,
+        # so the suppression below isn't vacuous)
+        await be.write("warm", b"W" * 512, 0)
+        assert be.extent_cache.get("warm", 0, 512) is not None
+
+        # hold the flusher open: a long window + more claimed in-flight
+        # ops than parked, so neither flush condition fires on its own
+        be.coalescer.window_s = 60.0
+        be._inflight_ops = 3
+        t = asyncio.ensure_future(be.write("obj", b"A" * 512, 0))
+        await asyncio.sleep(0.01)          # let it park in submit()
+        assert not t.done()
+        be.extent_cache.invalidate("obj")  # race: lands mid-flight
+        be._inflight_ops = 1               # idle -> flush now
+        be.coalescer.notify()
+        await t
+        # shards committed, cache did NOT take the stale note
+        assert be.extent_cache.get("obj", 0, 512) is None
+        assert await be.read("obj") == b"A" * 512
+        be._inflight_ops = 0
+
+    asyncio.run(run())

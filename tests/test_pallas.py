@@ -274,3 +274,49 @@ def test_engine_pallas_flag_matches_einsum():
     ] = applier
     b = np.asarray(pal.encode(G, data))
     assert np.array_equal(a, b)
+
+
+@pytest.mark.parametrize("variant", [
+    "enc_cmp_expand", "enc_u8_expand", "enc_split2", "enc_u8_split2",
+])
+@pytest.mark.parametrize(
+    "technique,k,m",
+    [
+        ("reed_sol_van", 8, 4),
+        ("cauchy_good", 10, 4),
+        ("isa_vandermonde", 8, 3),
+    ],
+)
+def test_encode_variant_bit_identical(variant, technique, k, m):
+    """Promoted perf-lab encode variants: with ec_pallas_encode_variant
+    set, PallasShardApply must stay bit-identical to the production
+    kernel over representative corpus geometries (this is the CI gate —
+    a variant that diverges in interpret mode never reaches a chip)."""
+    from ceph_tpu.ec.pallas_kernels import (
+        PallasShardApply, bytes_to_words, get_encode_variant,
+        set_encode_variant, words_to_bytes)
+
+    G = matrix.generator_matrix(technique, k, m)
+    ap = PallasShardApply(G[k:], interpret=True)
+    # non-tile-aligned column count exercises the pad path too
+    data = _rand((k, 4096 + 512), seed=k * 31 + m)
+    words = bytes_to_words(data)
+    base = np.asarray(ap.apply_words(words))
+    assert get_encode_variant() == ""
+    set_encode_variant(variant)
+    try:
+        got = np.asarray(ap.apply_words(words))
+    finally:
+        set_encode_variant("")
+    assert np.array_equal(got, base)
+    assert np.array_equal(
+        words_to_bytes(got), reference.encode(G, data)[k:])
+
+
+def test_encode_variant_unknown_rejected():
+    from ceph_tpu.ec.pallas_kernels import (
+        get_encode_variant, set_encode_variant)
+
+    with pytest.raises(ValueError, match="unknown encode variant"):
+        set_encode_variant("enc_nope")
+    assert get_encode_variant() == ""
