@@ -397,6 +397,38 @@ async def _dispatch(args, rados: Rados) -> int:
             return 1
         _print(report, True)
         return 0 if not report.get("errors") else 1
+    if cmd == "trace":
+        # `ceph trace collect <trace_id>`: fan dump_traces across the
+        # mon and every up OSD, dedupe by span id, and print ONE
+        # reassembled parent-linked tree — the cluster-wide view of a
+        # sampled op (the zipkin-collector role, served by the CLI)
+        from ceph_tpu.common.tracing import assemble_tree
+        spans: list[dict] = []
+        try:
+            r = await rados.mon_command("dump_traces",
+                                        trace_id=args.trace_id)
+            if r.get("rc") == 0:
+                spans.extend((r.get("data") or {}).get("spans", []))
+        except (RadosError, ConnectionError, asyncio.TimeoutError):
+            pass
+        m = rados.monc.osdmap
+        for osd, info in sorted((m.osds if m is not None else {})
+                                .items()):
+            if not info.up:
+                continue
+            try:
+                reply = await rados.osd_daemon_command(
+                    osd, "dump_traces", trace_id=args.trace_id)
+            except (RadosError, asyncio.TimeoutError):
+                continue
+            spans.extend(reply.get("spans", []))
+        seen: dict = {}
+        for s in spans:
+            seen.setdefault(str(s.get("span_id")), s)
+        tree = assemble_tree(list(seen.values()))
+        _print({"trace_id": args.trace_id, "num_spans": len(seen),
+                "spans": tree}, True)
+        return 0 if tree else 1
     if cmd == "daemon":
         if "/" in str(args.target):
             # `ceph daemon <path/to.asok> <cmd>`: direct unix socket
@@ -438,7 +470,8 @@ async def _dispatch(args, rados: Rados) -> int:
                   "targets", file=sys.stderr)
             return 2
         if args.daemon_cmd not in ("perf", "dump_ops_in_flight",
-                                   "dump_historic_ops"):
+                                   "dump_historic_ops",
+                                   "dump_historic_slow_ops"):
             print(f"unsupported daemon command {args.daemon_cmd!r} "
                   "over the messenger (use an .asok path for the full "
                   "surface)", file=sys.stderr)
@@ -454,6 +487,8 @@ async def _dispatch(args, rados: Rados) -> int:
             out = reply["counters"]
         elif args.daemon_cmd == "dump_historic_ops":
             out = reply["historic"]
+        elif args.daemon_cmd == "dump_historic_slow_ops":
+            out = reply["historic_slow"]
         else:
             out = reply["in_flight"]
         _print(out, True)
@@ -945,11 +980,17 @@ def build_parser() -> argparse.ArgumentParser:
     pg.add_argument("action", choices=["scrub", "repair", "stat"])
     pg.add_argument("pgid", nargs="?", help="<pool>/<ps>")
 
+    trace = sub.add_parser("trace")
+    trace.add_argument("action", choices=["collect"])
+    trace.add_argument("trace_id", help="trace id from a span dump "
+                       "or a slow-op record")
+
     daemon = sub.add_parser("daemon")
     daemon.add_argument("target", help="osd.N, or a path to an .asok")
     daemon.add_argument(
         "daemon_cmd",
-        help="dump_ops_in_flight | dump_historic_ops | perf | "
+        help="dump_ops_in_flight | dump_historic_ops | "
+             "dump_historic_slow_ops | perf | "
              "(any registered admin-socket command for .asok targets)",
     )
     daemon.add_argument("kv", nargs="*", metavar="key=value",

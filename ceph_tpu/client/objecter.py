@@ -20,7 +20,7 @@ import hmac
 from ceph_tpu.common.backoff import ExpBackoff
 from ceph_tpu.common.log import Dout
 from ceph_tpu.common.perf import CounterType, PerfCounters
-from ceph_tpu.common.tracing import Tracer
+from ceph_tpu.common.tracing import Tracer, current_span
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger
 from ceph_tpu.osd.codes import MISDIRECTED_RC, READ_CLASS_OPS
@@ -165,13 +165,17 @@ class Objecter:
         """Submit one op batch; retries across map changes, misdirected
         replies, and session resets until ``timeout``.  A sampled op
         (trace_probability) opens the root span and carries the trace
-        context to the OSD (OpRequest/zipkin_trace analog)."""
+        context to the OSD (OpRequest/zipkin_trace analog).  When an
+        ambient span is already active (an RGW request opened one),
+        the submit traces unconditionally UNDER it — downstream of a
+        sampled root, everything traces, so a trace is complete."""
         if timeout is None:
             timeout = float(self.monc.conf["client_op_deadline"])
+        parent = current_span()
         prob = float(self.monc.conf["trace_probability"] or 0.0)
-        if prob and random.random() < prob:
-            with self.tracer.span("objecter:op_submit", oid=oid,
-                                  pool=pool_id) as tctx:
+        if parent is not None or (prob and random.random() < prob):
+            with self.tracer.span("objecter:op_submit", parent=parent,
+                                  oid=oid, pool=pool_id) as tctx:
                 return await self._op_submit_impl(
                     pool_id, oid, ops, timeout, extra, tctx
                 )

@@ -167,6 +167,15 @@ def global_options() -> list[Option]:
         Option("trace_probability", float, 0.0,
                "fraction of client ops that carry a trace context "
                "(zipkin_trace analog; 0=off)", min=0.0, max=1.0),
+        Option("osd_op_complaint_time", float, 1.0,
+               "an op in flight (or finished) past this many seconds "
+               "counts as slow: beaconed to the mon for the SLOW_OPS "
+               "health check and retained in the forensic ring",
+               min=0.01, runtime=True),
+        Option("osd_slow_op_history", int, 20,
+               "how many of the slowest ops keep their full event "
+               "timeline + span tree (dump_historic_slow_ops)",
+               Level.ADVANCED, min=1),
         Option("ms_secure_mode", bool, False,
                "AES-256-GCM on-wire frame encryption (crypto_onwire "
                "analog); needs a configured auth key on every daemon"),

@@ -8,6 +8,7 @@ src/perf_histogram.h (2D axis-configured histograms), exposed as
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -19,6 +20,77 @@ class CounterType(Enum):
     GAUGE = "gauge"      # settable level
     TIME = "time"        # accumulated seconds
     LONGRUNAVG = "avg"   # (sum, count) average pair
+    HISTOGRAM = "hist"   # log2-bucketed distribution (perf_histogram.h)
+
+
+# log2 histogram layout: bucket i counts samples with value <= 2**i
+# (bucket 0 is le=1, bucket 30 is le=2**30); the last bucket is the
+# +Inf overflow.  Unit-agnostic — latency instrumentation records
+# microseconds by convention (counter names carry a _us suffix), so
+# the span is 1us .. ~18min before overflow.
+HIST_BUCKETS = 32
+
+
+def bucket_index(value: float) -> int:
+    """The log2 bucket a sample lands in (exact at power-of-2 edges:
+    2**k goes to le=2**k, 2**k + eps to le=2**(k+1))."""
+    if value <= 1.0:
+        return 0
+    m, e = math.frexp(value)           # value = m * 2**e, m in [0.5, 1)
+    idx = e - 1 if m == 0.5 else e
+    return min(idx, HIST_BUCKETS - 1)
+
+
+def bucket_le(i: int) -> float:
+    """Inclusive upper bound of bucket i (+Inf for the overflow)."""
+    if i >= HIST_BUCKETS - 1:
+        return math.inf
+    return float(1 << i)
+
+
+def hist_merge(a: dict | None, b: dict | None) -> dict:
+    """Merge two dumped histograms (elementwise bucket sum) — the mgr
+    aggregates per-daemon dumps into cluster series with this."""
+    if not a:
+        a = {"buckets": [], "sum": 0.0, "count": 0}
+    if not b:
+        b = {"buckets": [], "sum": 0.0, "count": 0}
+    ab, bb = list(a.get("buckets", ())), list(b.get("buckets", ()))
+    n = max(len(ab), len(bb), HIST_BUCKETS)
+    ab += [0] * (n - len(ab))
+    bb += [0] * (n - len(bb))
+    return {
+        "buckets": [x + y for x, y in zip(ab, bb)],
+        "sum": float(a.get("sum", 0.0)) + float(b.get("sum", 0.0)),
+        "count": int(a.get("count", 0)) + int(b.get("count", 0)),
+    }
+
+
+def hist_quantile(h: dict, q: float) -> float:
+    """Quantile estimate from a dumped histogram: locate the bucket
+    holding rank q*count, linearly interpolate inside it (Prometheus
+    histogram_quantile semantics).  Overflow bucket returns its lower
+    bound.  Exact and deterministic given the bucket counts."""
+    counts = list(h.get("buckets", ()))
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0.0
+    last = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= rank:
+            lo = 0.0 if i == 0 else bucket_le(i - 1)
+            hi = bucket_le(i)
+            if math.isinf(hi):
+                return lo
+            frac = min(1.0, max(0.0, (rank - cum) / c))
+            return lo + (hi - lo) * frac
+        cum += c
+        last = bucket_le(i)
+    return last if not math.isinf(last) else bucket_le(HIST_BUCKETS - 2)
 
 
 @dataclass
@@ -27,6 +99,7 @@ class _Counter:
     value: float = 0.0
     sum: float = 0.0
     count: int = 0
+    buckets: list[int] = field(default_factory=list)
 
 
 class PerfCounters:
@@ -40,7 +113,20 @@ class PerfCounters:
 
     def add(self, key: str, ctype: CounterType = CounterType.U64) -> None:
         with self._lock:
-            self._counters.setdefault(key, _Counter(ctype))
+            if key in self._counters:
+                return
+            c = _Counter(ctype)
+            if ctype == CounterType.HISTOGRAM:
+                c.buckets = [0] * HIST_BUCKETS
+            self._counters[key] = c
+
+    def hinc(self, key: str, value: float) -> None:
+        """Record one sample into a HISTOGRAM counter."""
+        with self._lock:
+            c = self._counters[key]
+            c.buckets[bucket_index(value)] += 1
+            c.sum += value
+            c.count += 1
 
     def inc(self, key: str, by: float = 1) -> None:
         with self._lock:
@@ -81,17 +167,30 @@ class PerfCounters:
         with self._lock:
             out = {}
             for key, c in self._counters.items():
-                if c.type == CounterType.LONGRUNAVG or c.count:
+                if c.type == CounterType.HISTOGRAM:
+                    out[key] = {"buckets": list(c.buckets),
+                                "sum": c.sum, "count": c.count}
+                elif c.type == CounterType.LONGRUNAVG or c.count:
                     out[key] = {"sum": c.sum, "avgcount": c.count}
                 else:
                     out[key] = c.value
             return out
+
+    def quantile(self, key: str, q: float) -> float:
+        """Quantile of a live HISTOGRAM counter (hist_quantile on a
+        point-in-time dump)."""
+        with self._lock:
+            c = self._counters[key]
+            h = {"buckets": list(c.buckets), "count": c.count}
+        return hist_quantile(h, q)
 
     def reset(self) -> None:
         with self._lock:
             for c in self._counters.values():
                 c.value = c.sum = 0.0
                 c.count = 0
+                if c.buckets:
+                    c.buckets = [0] * len(c.buckets)
 
 
 class Histogram:

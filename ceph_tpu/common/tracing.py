@@ -15,6 +15,7 @@ always complete.
 
 from __future__ import annotations
 
+import contextvars
 import secrets
 import time
 from collections import deque
@@ -39,6 +40,31 @@ class SpanCtx:
         return SpanCtx(str(d["t"]), str(d.get("s", "")))
 
 
+# The task-local active span: set where an op's span is opened (RGW
+# request handler, OSD do_op, EC per-op submit) and read at the next
+# layer down (objecter, EC coalescer, messenger) so causality crosses
+# module boundaries without threading a ctx argument through every
+# signature.  A contextvar — each asyncio task sees its own value.
+_ACTIVE: contextvars.ContextVar[SpanCtx | None] = contextvars.ContextVar(
+    "tracing_active_span", default=None
+)
+
+
+def current_span() -> SpanCtx | None:
+    """The ambient SpanCtx of the running task, if any."""
+    return _ACTIVE.get()
+
+
+@contextmanager
+def use_span(ctx: SpanCtx | None):
+    """Make ``ctx`` the ambient span for the enclosed block."""
+    tok = _ACTIVE.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _ACTIVE.reset(tok)
+
+
 class Tracer:
     """Per-process span collector (one per daemon entity)."""
 
@@ -54,7 +80,10 @@ class Tracer:
             parent.trace_id if parent else secrets.token_hex(8),
             secrets.token_hex(4),
         )
-        t0 = time.time()
+        # wall-clock start for cross-daemon ordering, monotonic clock
+        # for the duration (an NTP step must not yield negative spans)
+        start = time.time()
+        t0 = time.perf_counter()
         try:
             yield ctx
         finally:
@@ -64,10 +93,30 @@ class Tracer:
                 "parent": parent.span_id if parent else "",
                 "name": name,
                 "entity": self.entity,
-                "start": t0,
-                "duration_ms": round((time.time() - t0) * 1e3, 3),
+                "start": start,
+                "duration_ms": round(
+                    (time.perf_counter() - t0) * 1e3, 3),
                 **({"tags": tags} if tags else {}),
             })
+
+    def record(self, name: str, parent: SpanCtx, start: float,
+               duration_ms: float, **tags) -> SpanCtx:
+        """Append a pre-measured span (no context manager).  For work
+        shared across ops — a coalesced device launch serves many
+        traces at once, so the one measured interval is recorded once
+        per interested parent."""
+        ctx = SpanCtx(parent.trace_id, secrets.token_hex(4))
+        self.spans.append({
+            "trace_id": ctx.trace_id,
+            "span_id": ctx.span_id,
+            "parent": parent.span_id,
+            "name": name,
+            "entity": self.entity,
+            "start": start,
+            "duration_ms": round(duration_ms, 3),
+            **({"tags": tags} if tags else {}),
+        })
+        return ctx
 
     def dump(self, trace_id: str | None = None) -> list[dict]:
         return [s for s in self.spans
@@ -81,9 +130,16 @@ def assemble_tree(spans: list[dict]) -> list[dict]:
     by_id = {s["span_id"]: dict(s) for s in spans}
     roots: list[dict] = []
     for s in sorted(by_id.values(), key=lambda s: s["start"]):
-        parent = by_id.get(s.get("parent", ""))
+        pid = s.get("parent", "")
+        parent = by_id.get(pid)
         if parent is not None:
             parent.setdefault("children", []).append(s)
         else:
+            # a span naming a parent that isn't in the set (fell out
+            # of the ring, or a daemon wasn't collected) is promoted
+            # to a root but marked, so partial traces are
+            # distinguishable from genuinely root spans
+            if pid:
+                s["orphan"] = True
             roots.append(s)
     return roots

@@ -323,3 +323,18 @@ class MonClient:
             }))
         except ConnectionError:
             pass
+
+    def send_osd_beacon(self, osd_id: int, slow_inflight: int = 0,
+                        slow_total: int = 0) -> None:
+        """MOSDBeacon (fire-and-forget): periodic daemon health digest
+        feeding the mon's SLOW_OPS check."""
+        if self.conn is None or self.conn.is_closed:
+            return
+        try:
+            self.conn.send_message(Message("osd_beacon", {
+                "id": osd_id,
+                "slow_inflight": int(slow_inflight),
+                "slow_total": int(slow_total),
+            }))
+        except ConnectionError:
+            pass

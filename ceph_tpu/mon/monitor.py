@@ -33,6 +33,7 @@ from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.service import EPERM_RC, CommandResult, EINVAL_RC
 from ceph_tpu.mon.sync import MonSync
 from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
+from ceph_tpu.common.tracing import Tracer
 from ceph_tpu.msg.codec import encode as codec_encode
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger, Policy
@@ -88,6 +89,10 @@ class Monitor:
         self.elector.on_lose = self._on_lose
         self.paxos = Paxos(self, self.store)
         self.paxos.on_commit = self._on_paxos_commit
+        # span collector: paxos commits record here; ``trace collect``
+        # pulls the ring via the "dump_traces" mon command
+        self.tracer = Tracer(f"mon.{name}")
+        self.paxos.tracer = self.tracer
         self.sync = MonSync(self)
         self.osd_monitor = OSDMonitor(self)
         self.config_monitor = ConfigMonitor(self)
@@ -431,6 +436,12 @@ class Monitor:
         elif t == "osd_failure":
             if self._osd_identity_ok(session, None):
                 loop.create_task(self._handle_osd_failure(msg.data))
+        elif t == "osd_beacon":
+            # MOSDBeacon: periodic daemon health digest (slow-op
+            # counts) feeding the SLOW_OPS health check; fire-and-
+            # forget, identity-gated like failure reports
+            if self._osd_identity_ok(session, msg.data.get("id")):
+                loop.create_task(self._handle_osd_beacon(msg.data))
         elif t == "mds_beacon":
             # MMDSBeacon: liveness + registration.  Every mon acks with
             # its fsmap view of the sender's state — the daemon detects
@@ -682,6 +693,14 @@ class Monitor:
             return CommandResult(data={
                 "epoch": 1, "mons": dict(self.monmap),
             })
+        if name == "dump_traces":
+            # this mon's span rings (daemon + messenger): one shard of
+            # a cluster-wide ``trace collect`` reassembly
+            tid = cmd.get("trace_id") or None
+            return CommandResult(data={
+                "spans": (self.tracer.dump(tid)
+                          + self.msgr.tracer.dump(tid)),
+            })
         return None
 
     def cluster_log(self, level: str, message: str,
@@ -833,6 +852,9 @@ class Monitor:
         elif itype == "mds_beacon":
             await self._handle_mds_beacon(idata)
             payload = None
+        elif itype == "osd_beacon":
+            await self._handle_osd_beacon(idata)
+            payload = None
         else:
             payload = None
         if reply_type and payload is not None:
@@ -899,6 +921,19 @@ class Monitor:
         elif self.elector.leader is not None:
             self.send_mon(self.elector.leader, Message("mon_forward", {
                 "rtid": 0, "itype": "mds_beacon", "idata": data,
+                "reply_type": "",
+            }))
+
+    async def _handle_osd_beacon(self, data: dict) -> None:
+        """Slow-op digest from an OSD.  Leader-local ephemeral state
+        (no paxos propose — the reports age out on their own and are
+        re-sent every heartbeat, so losing them on an election costs
+        one beacon interval, not correctness)."""
+        if self.is_leader:
+            self.osd_monitor.note_beacon(data)
+        elif self.elector.leader is not None:
+            self.send_mon(self.elector.leader, Message("mon_forward", {
+                "rtid": 0, "itype": "osd_beacon", "idata": data,
                 "reply_type": "",
             }))
 

@@ -50,6 +50,11 @@ class OSDMonitor(PaxosService):
         # failure bookkeeping: target osd -> {reporter: report time}
         self.failure_reports: dict[int, dict[str, float]] = {}
         self.down_pending_out: dict[int, float] = {}
+        # slow-op beacons (leader-local, ephemeral): osd id ->
+        # {"inflight": n, "total": n, "t": monotonic receive time}.
+        # Drives the SLOW_OPS health check; re-sent every heartbeat,
+        # so stale entries just age out.
+        self.slow_op_reports: dict[int, dict] = {}
 
     # -- state ------------------------------------------------------------
     def refresh(self) -> None:
@@ -173,8 +178,51 @@ class OSDMonitor(PaxosService):
             pending.new_down.append(target)
         return True
 
+    def note_beacon(self, data: dict) -> None:
+        """MOSDBeacon digest: remember the sender's slow-op counts for
+        the SLOW_OPS health check (ephemeral — never proposed)."""
+        try:
+            osd = int(data["id"])
+        except (KeyError, TypeError, ValueError):
+            return
+        self.slow_op_reports[osd] = {
+            "inflight": int(data.get("slow_inflight", 0) or 0),
+            "total": int(data.get("slow_total", 0) or 0),
+            "t": time.monotonic(),
+        }
+
+    _BEACON_STALE = 60.0    # drop reports older than this (a dead OSD
+                            # must not pin SLOW_OPS forever)
+
+    def _slow_op_check(self) -> dict | None:
+        now = time.monotonic()
+        for osd, rep in list(self.slow_op_reports.items()):
+            if (now - rep["t"] > self._BEACON_STALE
+                    or not self.osdmap.is_up(osd)):
+                del self.slow_op_reports[osd]
+        slow = {o: r for o, r in self.slow_op_reports.items()
+                if r["inflight"] > 0}
+        if not slow:
+            return None
+        total = sum(r["inflight"] for r in slow.values())
+        worst = max(slow, key=lambda o: slow[o]["inflight"])
+        return {
+            "severity": "HEALTH_WARN",
+            "message": (f"{total} slow ops, oldest complaints on "
+                        f"osd.{worst} "
+                        f"({slow[worst]['inflight']} slow)"),
+            "detail": [
+                f"osd.{o} has {r['inflight']} slow ops in flight "
+                f"({r['total']} lifetime)"
+                for o, r in sorted(slow.items())
+            ],
+        }
+
     def health_checks(self) -> dict[str, dict]:
         checks: dict[str, dict] = {}
+        slow = self._slow_op_check()
+        if slow is not None:
+            checks["SLOW_OPS"] = slow
         full = sorted(p.name for p in self.osdmap.pools.values()
                       if p.full_quota)
         if full:

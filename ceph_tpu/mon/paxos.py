@@ -13,10 +13,12 @@ Paxos::extend_lease / lease_ack_timeout.
 from __future__ import annotations
 
 import asyncio
+from contextlib import nullcontext
 from typing import Awaitable, Callable
 
 from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.log import Dout
+from ceph_tpu.common.tracing import current_span
 from ceph_tpu.msg.message import PRIO_HIGHEST, Message
 from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
 
@@ -42,6 +44,10 @@ class Paxos:
         self._accept_timer: asyncio.Task | None = None
         self.ready = False       # collect finished; proposals allowed
         self.on_commit: Callable[[], Awaitable[None]] | None = None
+        # span collector (Monitor-provided): each commit records a
+        # "mon:paxos_commit" span so a traced mutation shows its
+        # consensus step in the reassembled tree
+        self.tracer = None
         # restore any locally accepted-but-uncommitted value
         raw = store.get(PREFIX, "pending_v")
         if raw is not None:
@@ -339,20 +345,26 @@ class Paxos:
         await self._maybe_propose()
 
     def _commit(self, v: int, raw: bytes) -> None:
-        if fp.ACTIVE:
-            # injected commit failure: the value stays durably accepted
-            # (pending_v/pending_pn), so recovery re-proposes it
-            fp.fire_sync("mon.paxos_commit")
-        tx = StoreTransaction.decode(raw)
-        tx.put(PREFIX, str(v), raw)
-        tx.put(PREFIX, "last_committed", v)
-        tx.erase(PREFIX, "pending_v")
-        tx.erase(PREFIX, "pending_pn")
-        if v > KEEP_VERSIONS:
-            tx.erase(PREFIX, str(v - KEEP_VERSIONS))   # Paxos::trim
-        self.store.apply_transaction(tx)
-        self.last_committed = v
-        self._uncommitted = None
+        span = (self.tracer.span("mon:paxos_commit",
+                                 parent=current_span(), v=v,
+                                 bytes=len(raw))
+                if self.tracer is not None else nullcontext())
+        with span:
+            if fp.ACTIVE:
+                # injected commit failure: the value stays durably
+                # accepted (pending_v/pending_pn), so recovery
+                # re-proposes it
+                fp.fire_sync("mon.paxos_commit")
+            tx = StoreTransaction.decode(raw)
+            tx.put(PREFIX, str(v), raw)
+            tx.put(PREFIX, "last_committed", v)
+            tx.erase(PREFIX, "pending_v")
+            tx.erase(PREFIX, "pending_pn")
+            if v > KEEP_VERSIONS:
+                tx.erase(PREFIX, str(v - KEEP_VERSIONS))  # Paxos::trim
+            self.store.apply_transaction(tx)
+            self.last_committed = v
+            self._uncommitted = None
 
     def _learn_commit(self, v: int, raw: bytes) -> None:
         if v == self.last_committed + 1:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import random
 import struct
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Optional, Protocol
@@ -27,7 +28,9 @@ from typing import Optional, Protocol
 from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.common.log import Dout
+from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.common.throttle import Throttle
+from ceph_tpu.common.tracing import SpanCtx, Tracer
 from ceph_tpu.msg.codec import decode, encode
 from ceph_tpu.msg.message import Message
 
@@ -444,6 +447,14 @@ class Messenger:
         self._rng = random.Random()
         self._stopped = False
         self._throttles: dict[str, "Throttle"] = {}  # peer type ->
+        # dispatch-hop observability: how long ms_dispatch holds each
+        # delivered message (histogram, us), and — for messages whose
+        # payload carries a trace context — a span for the hop, so
+        # queueing/dispatch time shows up inside the op's trace tree
+        self.perf = PerfCounters(f"{name}:msgr")
+        self.perf.add("dispatch", CounterType.U64)
+        self.perf.add("dispatch_latency_us", CounterType.HISTOGRAM)
+        self.tracer = Tracer(name)
 
     # -- setup -----------------------------------------------------------
     def set_dispatcher(self, d: Dispatcher) -> None:
@@ -823,10 +834,22 @@ class Messenger:
         if self.dispatcher is None:
             log.dout(1, "%s: no dispatcher, dropping %s", self.name, msg.type)
             return
+        tctx = (SpanCtx.from_wire(msg.data.get("tctx"))
+                if isinstance(msg.data, dict) else None)
+        t0 = time.perf_counter()
         try:
-            await self.dispatcher.ms_dispatch(conn, msg)
+            if tctx is not None:
+                with self.tracer.span("msgr:dispatch", parent=tctx,
+                                      type=msg.type):
+                    await self.dispatcher.ms_dispatch(conn, msg)
+            else:
+                await self.dispatcher.ms_dispatch(conn, msg)
         except Exception:
             log.derr("%s: dispatch of %s failed", self.name, msg.type)
+        finally:
+            self.perf.inc("dispatch")
+            self.perf.hinc("dispatch_latency_us",
+                           (time.perf_counter() - t0) * 1e6)
 
     def _maybe_inject_failure(self, point: str = "msgr.send") -> None:
         # named failpoints are the unified injection path; the legacy

@@ -16,7 +16,6 @@ orchestration around it.
 from __future__ import annotations
 
 import asyncio
-import contextvars
 import json
 import time
 from collections import deque
@@ -33,7 +32,12 @@ from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.mon.auth_monitor import canonical, cap_allows, verify_ticket
 from ceph_tpu.common.log import Dout
 from ceph_tpu.common.perf import CounterType, PerfCounters
-from ceph_tpu.common.tracing import SpanCtx, Tracer
+from ceph_tpu.common.tracing import (
+    SpanCtx,
+    Tracer,
+    current_span,
+    use_span,
+)
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.msg.codec import encode
@@ -99,10 +103,9 @@ log = Dout("osd")
 # process resource, so every OSD in one test process shares the mesh
 _EC_MESH_CACHE: dict[int, object] = {}
 
-# the active trace span of the op being executed on this task; sub-op
-# fan-out reads it to propagate the trace context across daemons
-_CUR_SPAN: contextvars.ContextVar[SpanCtx | None] = \
-    contextvars.ContextVar("ceph_tpu_cur_span", default=None)
+# the active trace span of the op being executed on this task lives in
+# common.tracing's shared contextvar (current_span/use_span): sub-op
+# fan-out, the EC coalescer, and the messenger all read it there
 
 XATTR_PREFIX = "_u_"          # user xattrs, kept clear of internal attrs
 
@@ -241,6 +244,9 @@ class OSDDaemon:
                     "peer_backfills", "scrub_errors"):
             self.perf.add(key)
         self.perf.add("op_latency", CounterType.TIME)
+        # log2 latency distribution (perf_histogram role): the tail
+        # the averages above cannot show; microseconds
+        self.perf.add("op_latency_us", CounterType.HISTOGRAM)
         # QoS op scheduler (mClockScheduler role) + op observability
         # (OpRequest/OpTracker role)
         from ceph_tpu.osd.scheduler import ClassProfile
@@ -252,7 +258,10 @@ class OSDDaemon:
             )
             for clazz in ("client", "recovery", "scrub")
         })
-        self.op_tracker = OpTracker()
+        self.op_tracker = OpTracker(
+            slow_op_seconds=float(self.conf["osd_op_complaint_time"]),
+            slow_history_size=int(self.conf["osd_slow_op_history"]),
+        )
         self._use_mclock = (self.conf["osd_op_queue"]
                             == "mclock_scheduler")
         # completed-op cache keyed by client reqid (the osd_reqid_t dedup
@@ -336,6 +345,21 @@ class OSDDaemon:
             except (TimeoutError, ConnectionError, asyncio.TimeoutError):
                 await asyncio.sleep(1.0)
 
+    def _perf_dump_all(self) -> dict:
+        """perf dump + the messenger's own counters under a ``msgr_``
+        prefix, so the dispatch-latency histogram rides the same
+        surface the mgr already polls."""
+        out = self.perf.dump()
+        for k, v in self.msgr.perf.dump().items():
+            out[f"msgr_{k}"] = v
+        return out
+
+    def _dump_traces_all(self, trace_id=None) -> list[dict]:
+        """Daemon spans + the messenger's dispatch-hop spans: one
+        reply covers every ring this process keeps."""
+        return (self.tracer.dump(trace_id)
+                + self.msgr.tracer.dump(trace_id))
+
     def _ec_coalesce_stats(self) -> dict:
         """Admin-socket ``ec coalesce stats``: every primary EC PG's
         CoalescedLauncher lifetime counters (per-PG; the perf counters
@@ -359,7 +383,7 @@ class OSDDaemon:
         from ceph_tpu.common.log import dump_recent
 
         sock = AdminSocket(self.entity)
-        sock.register("perf dump", self.perf.dump,
+        sock.register("perf dump", self._perf_dump_all,
                       "dump perf counters")
         sock.register("dump_ops_in_flight",
                       self.op_tracker.dump_ops_in_flight,
@@ -367,6 +391,9 @@ class OSDDaemon:
         sock.register("dump_historic_ops",
                       self.op_tracker.dump_historic_ops,
                       "recent slow/completed ops")
+        sock.register("dump_historic_slow_ops",
+                      self.op_tracker.dump_historic_slow_ops,
+                      "slowest ops with event timeline + span tree")
         sock.register("config show", self.conf.show,
                       "live configuration")
         sock.register("dump_throttles", self.msgr.throttle_dump,
@@ -375,8 +402,7 @@ class OSDDaemon:
                       "op scheduler queue state")
         sock.register("log dump", dump_recent,
                       "recent log ring (crash context)")
-        sock.register("dump_traces",
-                      lambda trace_id=None: self.tracer.dump(trace_id),
+        sock.register("dump_traces", self._dump_traces_all,
                       "collected trace spans (zipkin-lite)")
         sock.register("status", lambda: {
             "entity": self.entity,
@@ -587,6 +613,8 @@ class OSDDaemon:
                     "tid": msg.data.get("tid", 0),
                     "in_flight": self.op_tracker.dump_ops_in_flight(),
                     "historic": self.op_tracker.dump_historic_ops(),
+                    "historic_slow":
+                        self.op_tracker.dump_historic_slow_ops(),
                     "scheduler": self.op_scheduler.stats(),
                 }))
             except ConnectionError:
@@ -596,7 +624,7 @@ class OSDDaemon:
             try:
                 conn.send_message(Message("perf_dump_reply", {
                     "tid": msg.data.get("tid", 0),
-                    "counters": self.perf.dump(),
+                    "counters": self._perf_dump_all(),
                 }))
             except ConnectionError:
                 pass
@@ -685,7 +713,7 @@ class OSDDaemon:
             try:
                 conn.send_message(Message("dump_traces_reply", {
                     "tid": msg.data.get("tid", 0),
-                    "spans": self.tracer.dump(
+                    "spans": self._dump_traces_all(
                         msg.data.get("trace_id")
                     ),
                 }))
@@ -1339,6 +1367,7 @@ class OSDDaemon:
                 mesh=self._ec_mesh(),
                 hedge_timeout=hedge or None,
                 perf=self.perf,
+                tracer=self.tracer,
                 coalesce=bool(self.conf["osd_ec_coalesce"]),
                 coalesce_window_us=float(
                     self.conf["osd_ec_coalesce_window_us"]),
@@ -3233,11 +3262,15 @@ class OSDDaemon:
             # and the contextvar hands the context to sub-op fan-out
             with self.tracer.span("osd:do_op", parent=tctx,
                                   oid=str(d.get("oid", "?"))) as ctx:
-                token = _CUR_SPAN.set(ctx)
-                try:
+                with use_span(ctx):
                     await self._handle_osd_op_inner(conn, d)
-                finally:
-                    _CUR_SPAN.reset(token)
+            # the do_op span itself only lands in the ring here; if
+            # the op was slow enough to be retained, (re)attach the
+            # now-complete span tree to its forensic record
+            if self.op_tracker.has_slow_trace(ctx.trace_id):
+                self.op_tracker.attach_spans(
+                    ctx.trace_id, self.tracer.dump(ctx.trace_id)
+                )
             return
         await self._handle_osd_op_inner(conn, d)
 
@@ -3297,6 +3330,9 @@ class OSDDaemon:
                     "+".join(str(op.get("op")) for op in ops),
                 )
             )
+            span = current_span()
+            if span is not None:
+                top.trace_id = span.trace_id
             if self._use_mclock:
                 await self.op_scheduler.acquire("client")
             top.mark("dispatched")
@@ -3433,6 +3469,8 @@ class OSDDaemon:
                 if isinstance(res.get("data"), (bytes, bytearray)):
                     self.perf.inc("op_out_bytes", len(res["data"]))
             self.perf.tinc("op_latency", time.monotonic() - op_start)
+            self.perf.hinc("op_latency_us",
+                           (time.monotonic() - op_start) * 1e6)
             if self._perf_queries and rc == OK:
                 self._perf_query_account(
                     pg, conn, str(d.get("oid", "")), ops, results,
@@ -3449,7 +3487,10 @@ class OSDDaemon:
             # misdirected replies, errors) so nothing lingers in
             # dump_ops_in_flight forever
             if top is not None and not top.done:
-                self.op_tracker.finish(top, "replied")
+                spans = (self.tracer.dump(top.trace_id)
+                         if top.trace_id and top.age
+                         >= self.op_tracker.slow_op_seconds else None)
+                self.op_tracker.finish(top, "replied", spans=spans)
 
     # -- watch / notify / pgls (the Watch.h:48 + pgls machinery of
     # PrimaryLogPG, collapsed to a per-PG watcher table) -----------------
@@ -4111,7 +4152,7 @@ class OSDDaemon:
 
     # -- sub ops (shard/replica server side) -----------------------------------
     async def send_sub_op(self, osd: int, kind: str, **args):
-        ctx = _CUR_SPAN.get()
+        ctx = current_span()
         if ctx is not None and "tctx" not in args:
             with self.tracer.span(f"osd:sub_op:{kind}:send",
                                   parent=ctx, to=osd) as child:
@@ -4367,6 +4408,18 @@ class OSDDaemon:
                     fp.fire_sync("osd.heartbeat")
                 except fp.FailPointError:
                     continue        # injected silence: skip this round
+            # slow-op beacon (MOSDBeacon role): the LIVE slow count is
+            # what raises — and, back at zero, clears — the mon's
+            # SLOW_OPS health check.  Re-reading the complaint time
+            # each round picks up runtime `config set`.
+            self.op_tracker.slow_op_seconds = float(
+                self.conf["osd_op_complaint_time"]
+            )
+            self.monc.send_osd_beacon(
+                self.osd_id,
+                slow_inflight=self.op_tracker.slow_inflight(),
+                slow_total=self.op_tracker.slow_ops,
+            )
             now = time.monotonic()
             for osd, info in self.osdmap.osds.items():
                 if osd == self.osd_id or not info.up:

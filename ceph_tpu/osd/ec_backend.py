@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from dataclasses import dataclass
 from typing import Mapping, Protocol, Sequence
 
@@ -37,6 +38,7 @@ import numpy as np
 from ceph_tpu.common import failpoint as fp
 from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.common.perf import CounterType, PerfCounters
+from ceph_tpu.common.tracing import current_span
 from ceph_tpu.osd.ec_util import HashInfo, StripeInfo
 from ceph_tpu.store import CollectionId, GHObject, ObjectStore, Transaction
 
@@ -248,15 +250,18 @@ class ExtentCache:
 
 
 class _CoalesceItem:
-    """One op's parked launch request (payload + result future)."""
+    """One op's parked launch request (payload + result future).
+    ``span``: the submitting op's ambient SpanCtx (if the op is
+    sampled) — the shared launch is recorded under it at flush."""
 
-    __slots__ = ("payload", "nstripes", "fut", "t0")
+    __slots__ = ("payload", "nstripes", "fut", "t0", "span")
 
-    def __init__(self, payload, nstripes, fut, t0):
+    def __init__(self, payload, nstripes, fut, t0, span=None):
         self.payload = payload
         self.nstripes = nstripes
         self.fut = fut
         self.t0 = t0
+        self.span = span
 
 
 class CoalescedLauncher:
@@ -334,7 +339,8 @@ class CoalescedLauncher:
         if loop is not self._loop:
             self._bind_loop(loop)
         item = _CoalesceItem(payload, int(nstripes),
-                             loop.create_future(), loop.time())
+                             loop.create_future(), loop.time(),
+                             span=current_span())
         self._items.setdefault(key, []).append(item)
         self._npending += 1
         self._nstripes += item.nstripes
@@ -394,7 +400,11 @@ class CoalescedLauncher:
             return
         now = self._loop.time()
         for it in live:
-            be.perf.tinc("ec_coalesce_wait_us", (now - it.t0) * 1e6)
+            wait_us = (now - it.t0) * 1e6
+            be.perf.tinc("ec_coalesce_wait_us", wait_us)
+            be.perf.hinc("ec_coalesce_wait_hist_us", wait_us)
+        wall0 = time.time()
+        t0 = time.perf_counter()
         try:
             outs = await be._coalesce_launch(
                 key, [it.payload for it in live])
@@ -426,11 +436,23 @@ class CoalescedLauncher:
                 else:
                     it.fut.set_result(out)
             return
+        launch_ms = (time.perf_counter() - t0) * 1e3
         self.launches += 1
         self.ops += len(live)
         be.perf.inc("ec_coalesce_launches")
         be.perf.inc("ec_coalesce_ops", len(live))
         be.perf.tinc("ec_coalesce_occupancy", len(live))
+        if be.tracer is not None:
+            # one measured device launch serves every sampled
+            # batchmate: record the same interval once per interested
+            # parent so each trace tree shows the shared launch
+            nstripes = sum(it.nstripes for it in live)
+            for it in live:
+                if it.span is not None:
+                    be.tracer.record(
+                        "osd:ec:launch", it.span, wall0, launch_ms,
+                        op=key[0], occupancy=len(live),
+                        stripes=nstripes)
         for it, out in zip(live, outs):
             if not it.fut.done():
                 it.fut.set_result(out)
@@ -461,6 +483,7 @@ class ECBackend:
         mesh=None,
         hedge_timeout: float | None = None,
         perf: PerfCounters | None = None,
+        tracer=None,
         coalesce: bool = True,
         coalesce_window_us: float = 200.0,
         coalesce_max_stripes: int = 4096,
@@ -531,12 +554,18 @@ class ECBackend:
         # reconstruction from the surviving shards (None/0 = off)
         self.hedge_timeout = hedge_timeout or None
         self.perf = perf if perf is not None else PerfCounters("ec")
+        # shared Tracer (daemon-provided): sampled ops get their
+        # coalesced device launch recorded into their trace tree
+        self.tracer = tracer
         for _k in ("hedge_issued", "hedge_won", "hedge_lost",
                    "ec_coalesce_launches", "ec_coalesce_ops",
                    "ec_coalesce_pad_waste", "ec_device_launches"):
             self.perf.add(_k, CounterType.U64)
         for _k in ("ec_coalesce_occupancy", "ec_coalesce_wait_us"):
             self.perf.add(_k, CounterType.LONGRUNAVG)
+        for _k in ("ec_encode_launch_us", "ec_decode_launch_us",
+                   "ec_coalesce_wait_hist_us"):
+            self.perf.add(_k, CounterType.HISTOGRAM)
         # cross-op micro-batching of device launches (the tentpole):
         # ops in flight concurrently share one encode/decode launch
         self._inflight_ops = 0
@@ -639,16 +668,22 @@ class ECBackend:
             self.perf.inc("ec_coalesce_pad_waste", stripes.shape[0] - b)
         self.mesh_stats["encode_buckets"].add(stripes.shape[0])
         self.perf.inc("ec_device_launches")
+        t0 = time.perf_counter()
         if self.mesh is not None:
             ap = self._mesh_applier(
                 ("enc",), lambda: self._mesh_gen[self.k:])
             parity = await asyncio.to_thread(ap, stripes)
             self.mesh_stats["encodes"] += 1
+            self.perf.hinc("ec_encode_launch_us",
+                           (time.perf_counter() - t0) * 1e6)
             return np.concatenate(
                 [np.asarray(stripes, np.uint8), parity], axis=1)[:b]
-        return np.asarray(await asyncio.to_thread(
+        out = np.asarray(await asyncio.to_thread(
             self.ec.encode_chunks_batch, stripes
         ))[:b]
+        self.perf.hinc("ec_encode_launch_us",
+                       (time.perf_counter() - t0) * 1e6)
+        return out
 
     async def _decode_batch(self, batched: dict, missing: list) -> dict:
         """Batched reconstruct through the mesh plane when configured.
@@ -673,6 +708,7 @@ class ECBackend:
                 }
             self.mesh_stats["decode_buckets"].add(bp)
         self.perf.inc("ec_device_launches")
+        t0 = time.perf_counter()
         if self.mesh is not None:
             avail = {int(i): np.asarray(c, np.uint8)
                      for i, c in batched.items()}
@@ -693,10 +729,14 @@ class ECBackend:
                 for i, w in enumerate(todo):
                     out[w] = rebuilt[:b, i]
                 self.mesh_stats["decodes"] += 1
+            self.perf.hinc("ec_decode_launch_us",
+                           (time.perf_counter() - t0) * 1e6)
             return out
         out = await asyncio.to_thread(
             self.ec.decode_chunks_batch, batched, missing
         )
+        self.perf.hinc("ec_decode_launch_us",
+                       (time.perf_counter() - t0) * 1e6)
         return {w: np.asarray(c)[:b] for w, c in out.items()}
 
     # -- cross-op coalescing (CoalescedLauncher front ends) ---------------

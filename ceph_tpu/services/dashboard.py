@@ -130,6 +130,9 @@ class Dashboard:
             elif path in ("/api/rgw/placement", "/api/rgw/lifecycle"):
                 status, body = await self._rgw_get(path, headers, query)
                 ctype = "application/json"
+            elif path == "/api/trace":
+                status, body = await self._trace_get(headers, query)
+                ctype = "application/json"
             elif path == "/metrics":
                 # collect() messages every OSD; cache briefly so an
                 # aggressive scraper doesn't multiply cluster traffic
@@ -251,6 +254,23 @@ class Dashboard:
             return await mon("health unmute",
                              code=str(args.get("code", "")))
         return reply(404, error="unknown route")
+
+    # -- tracing -----------------------------------------------------------
+    async def _trace_get(self, headers: dict,
+                         query: dict) -> tuple[int, bytes]:
+        """``GET /api/trace?trace_id=<id>``: cluster-wide span
+        reassembly via the mgr's dump_traces fan-out.  Token-gated —
+        span tags carry object names and pool ids."""
+        def reply(status: int, data) -> tuple[int, bytes]:
+            return status, json.dumps(data).encode()
+
+        if not self._authorized(headers):
+            return reply(403, {"error": "missing or bad api token"})
+        trace_id = query.get("trace_id", "")
+        if not trace_id:
+            return reply(400, {"error": "trace_id required"})
+        tree = await self.mgr.collect_trace(trace_id)
+        return reply(200, {"trace_id": trace_id, "spans": tree})
 
     # -- object gateway (placement targets + lifecycle) --------------------
     async def _rgw_get(self, path: str, headers: dict,

@@ -13,8 +13,11 @@ exposition format with the metric names the reference's module exports
 from __future__ import annotations
 
 import asyncio
+import math
 
 from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.common.perf import bucket_le, hist_merge, hist_quantile
+from ceph_tpu.common.tracing import assemble_tree
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger, Policy
@@ -76,6 +79,11 @@ class Mgr:
             fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
             if fut is not None and not fut.done():
                 fut.set_result(dict(msg.data))
+            return
+        if msg.type == "dump_traces_reply":
+            fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg.data.get("spans", []))
             return
         await self.monc.ms_dispatch(conn, msg)
 
@@ -178,6 +186,33 @@ class Mgr:
             },
             "osd_perf": osd_perf,
         }
+
+    async def collect_trace(self, trace_id: str) -> list[dict]:
+        """Cluster-wide trace reassembly: fan ``dump_traces`` across
+        every up OSD plus the mon's span ring, dedupe by span id, and
+        assemble ONE parent-linked tree (the ``trace collect``
+        backend and the dashboard's /api/trace payload)."""
+        spans: list[dict] = []
+        osdmap = self.monc.osdmap
+        if osdmap is not None:
+            polls = {
+                osd: self.osd_request(osd, info.addr, "dump_traces",
+                                      trace_id=trace_id)
+                for osd, info in osdmap.osds.items() if info.up
+            }
+            for got in await asyncio.gather(*polls.values()):
+                if got:
+                    spans.extend(got)
+        try:
+            mon = await self.monc.command("dump_traces",
+                                          trace_id=trace_id)
+            spans.extend((mon.get("data") or {}).get("spans", []))
+        except (ConnectionError, asyncio.TimeoutError, KeyError):
+            pass
+        seen: dict[str, dict] = {}
+        for s in spans:
+            seen.setdefault(str(s.get("span_id")), s)
+        return assemble_tree(list(seen.values()))
 
     # -- PGMap digest (DaemonServer + PGMap aggregation) -------------------
     async def collect_pg_stats(self) -> dict[int, list[dict]]:
@@ -305,16 +340,59 @@ class Mgr:
         ]
         if up_samples:
             metric("ceph_osd_up", "osd up state", up_samples)
-        # per-osd counters: one prometheus metric per counter key
-        by_key: dict[str, list[tuple[str, float]]] = {}
+        # per-osd counters, split by dump shape: scalars stay one
+        # metric per key; (sum, avgcount) pairs export as *_sum /
+        # *_count (NOT collapsed to the sum — the count is what turns
+        # a total into a rate); log2 histograms export the full
+        # prometheus histogram triplet *_bucket{le=...} (cumulative) /
+        # *_sum / *_count per daemon, plus cluster-merged p50/p99
+        # gauges (hist_merge across daemons, hist_quantile).
+        scalars: dict[str, list[tuple[str, float]]] = {}
+        pairs: dict[str, list[tuple[str, float, float]]] = {}
+        hists: dict[str, list[tuple[str, dict]]] = {}
+        merged: dict[str, dict] = {}
         for osd, counters in sorted(snapshot["osd_perf"].items()):
+            lab = f'{{ceph_daemon="osd.{osd}"}}'
             for key, value in sorted(counters.items()):
-                if isinstance(value, dict):      # time counters
-                    value = value.get("sum", 0.0)
-                by_key.setdefault(key, []).append(
-                    (f'{{ceph_daemon="osd.{osd}"}}', float(value))
-                )
-        for key, samples in sorted(by_key.items()):
+                if isinstance(value, dict) and "buckets" in value:
+                    hists.setdefault(key, []).append(
+                        (f"osd.{osd}", value))
+                    merged[key] = hist_merge(merged.get(key), value)
+                elif isinstance(value, dict):
+                    pairs.setdefault(key, []).append(
+                        (lab, float(value.get("sum", 0.0)),
+                         float(value.get("avgcount", 0))))
+                else:
+                    scalars.setdefault(key, []).append(
+                        (lab, float(value)))
+        for key, samples in sorted(scalars.items()):
             metric(f"ceph_osd_{key}", f"osd {key} perf counter", samples,
                    mtype="counter")
+        for key, entries in sorted(pairs.items()):
+            metric(f"ceph_osd_{key}_sum", f"osd {key} total",
+                   [(lab, s) for lab, s, _ in entries], mtype="counter")
+            metric(f"ceph_osd_{key}_count", f"osd {key} samples",
+                   [(lab, c) for lab, _, c in entries], mtype="counter")
+        for key, entries in sorted(hists.items()):
+            base = f"ceph_osd_{key}"
+            lines.append(f"# HELP {base} osd {key} log2 histogram")
+            lines.append(f"# TYPE {base} histogram")
+            for daemon, h in entries:
+                cum = 0
+                for i, c in enumerate(h.get("buckets", ())):
+                    cum += int(c)
+                    le = bucket_le(i)
+                    le_s = "+Inf" if math.isinf(le) else f"{le:g}"
+                    lines.append(
+                        f'{base}_bucket{{ceph_daemon="{daemon}",'
+                        f'le="{le_s}"}} {cum:g}')
+                lines.append(f'{base}_sum{{ceph_daemon="{daemon}"}} '
+                             f'{float(h.get("sum", 0.0)):g}')
+                lines.append(f'{base}_count{{ceph_daemon="{daemon}"}} '
+                             f'{int(h.get("count", 0)):g}')
+            m = merged[key]
+            metric(f"{base}_quantile",
+                   f"cluster-merged {key} quantiles",
+                   [('{q="0.5"}', hist_quantile(m, 0.5)),
+                    ('{q="0.99"}', hist_quantile(m, 0.99))])
         return "\n".join(lines) + "\n"

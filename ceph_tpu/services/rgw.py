@@ -16,12 +16,15 @@ import asyncio
 import hashlib
 import json
 import math
+import random
 import secrets
 import time
 import zlib
+from contextlib import contextmanager
 
 from ceph_tpu.common.compressor import get_compressor, list_compressors
 from ceph_tpu.common.log import Dout
+from ceph_tpu.common.tracing import Tracer, use_span
 
 from ceph_tpu.client.rados import (IoCtx, ObjectOperation, RadosError,
                                    full_try)
@@ -533,6 +536,11 @@ class RGWLite:
         # handles like the caches above so one gateway keeps one handle
         # per tier pool.
         self._pool_handles: dict[str, tuple] = {}
+        # request tracing (zipkin-lite): sampled S3 requests open the
+        # root span here, so the whole rgw -> objecter -> OSD path
+        # reassembles into one tree.  One ring per gateway, shared
+        # across as_user handles like the caches above.
+        self.tracer = Tracer("rgw")
 
     def as_user(self, user: str | None) -> "RGWLite":
         """A handle acting as ``user`` over the same pool."""
@@ -543,7 +551,26 @@ class RGWLite:
         child._pushers = self._pushers
         child._topics_cache = self._topics_cache
         child._pool_handles = self._pool_handles
+        child.tracer = self.tracer
         return child
+
+    @contextmanager
+    def _trace_root(self, name: str, **tags):
+        """Open a sampled root span for one S3 request and make it the
+        ambient span — the objecter sees it via current_span() and
+        parents every resulting RADOS op under the request (the
+        rgw_trace/req_state->trace linkage).  Yields None unsampled."""
+        try:
+            prob = float(
+                self.ioctx.rados.conf["trace_probability"] or 0.0)
+        except (KeyError, TypeError, ValueError, AttributeError):
+            prob = 0.0
+        if not prob or random.random() >= prob:
+            yield None
+            return
+        with self.tracer.span(name, **tags) as ctx:
+            with use_span(ctx):
+                yield ctx
 
     # -- storage classes / placement pools (rgw_placement_rule) -----------
     async def _data_handles(self, pool: str | None):
@@ -3481,6 +3508,17 @@ class RGWLite:
         {mode, until, legal_hold} (x-amz-object-lock-* headers).
         ``storage_class``: x-amz-storage-class — the tail lands in the
         class's placement pool (STANDARD/None = the zone pool)."""
+        with self._trace_root("rgw:put", bucket=bucket, key=key,
+                              size=len(data)):
+            return await self._put_object_impl(
+                bucket, key, data, content_type, metadata,
+                if_none_match, sse_key, tags, lock, sse, kms_key_id,
+                storage_class)
+
+    async def _put_object_impl(self, bucket, key, data, content_type,
+                               metadata, if_none_match, sse_key, tags,
+                               lock, sse, kms_key_id,
+                               storage_class) -> dict:
         if tags:
             self.validate_tags(tags)
         if sse is not None and sse_key is not None:
@@ -3597,6 +3635,12 @@ class RGWLite:
         """S3 GET (optionally a byte range, inclusive bounds).
         ``sse_key``: the SSE-C customer key for encrypted objects;
         SSE-KMS / SSE-S3 objects decrypt server-side via the KMS."""
+        with self._trace_root("rgw:get", bucket=bucket, key=key):
+            return await self._get_object_impl(bucket, key, range_,
+                                               sse_key)
+
+    async def _get_object_impl(self, bucket, key, range_,
+                               sse_key) -> dict:
         entry = await self._entry(bucket, key)
         dk = await self._entry_sse_key(entry, sse_key)
         if entry.get("comp"):

@@ -18,6 +18,12 @@
 #                                   concurrent 4 KiB writes, assert
 #                                   ec_coalesce_launches < ops/4 and a
 #                                   bit-identical read-back
+#   scripts/tier1.sh --obs-smoke    op observability end to end: a
+#                                   vstart cluster, one traced write
+#                                   whose >=4-span tree reassembles,
+#                                   /metrics serving histogram _bucket
+#                                   series, and an injected 2s op
+#                                   raising then clearing SLOW_OPS
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -174,6 +180,107 @@ async def main():
 asyncio.run(main())
 EOF
     echo "COALESCE_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--obs-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    python - <<'EOF'
+import asyncio
+
+
+async def main():
+    from ceph_tpu.common import failpoint as fp
+    from ceph_tpu.common.tracing import assemble_tree
+    from ceph_tpu.vstart import DevCluster
+
+    cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+        "trace_probability": 1.0,
+        "osd_op_complaint_time": 0.5,
+        "osd_heartbeat_interval": 0.1,
+    })
+    await cluster.start()
+    try:
+        rados = await cluster.client()
+        await rados.pool_create("obs", pg_num=4, size=3)
+        io = await rados.open_ioctx("obs")
+        await io.write_full("traced", b"\xab" * 4096)
+        print("ok: vstart cluster + traced 4KiB write")
+
+        spans = list(rados.objecter.tracer.dump())
+        root = next(s for s in spans
+                    if s["name"] == "objecter:op_submit"
+                    and s["tags"]["oid"] == "traced")
+        tid = root["trace_id"]
+        for osd_id in cluster.osds:
+            reply = await rados.osd_daemon_command(
+                osd_id, "dump_traces", trace_id=tid)
+            spans.extend(reply["spans"])
+        mine = [s for s in spans if s["trace_id"] == tid]
+        tree = assemble_tree(mine)
+        assert len(tree) == 1 and \
+            tree[0]["name"] == "objecter:op_submit", tree
+        assert len(mine) >= 4, sorted(s["name"] for s in mine)
+        print(f"ok: trace {tid} reassembled into one tree "
+              f"({len(mine)} spans)")
+
+        mgr = await cluster.start_mgr(dashboard=True)
+        host, port = mgr.dashboard.host, mgr.dashboard.port
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(b"GET /metrics HTTP/1.1\r\nhost: x\r\n\r\n")
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        assert b" 200 " in raw.split(b"\r\n", 1)[0], raw[:200]
+        text = raw.partition(b"\r\n\r\n")[2].decode()
+        assert "op_latency_us_bucket{" in text, text[:2000]
+        assert 'le="+Inf"' in text
+        assert "op_latency_us_count" in text
+        print("ok: /metrics serves histogram _bucket/_sum/_count")
+
+        async def checks():
+            r = await rados.mon_command("health detail")
+            assert r["rc"] == 0, r
+            return r["data"]["checks"]
+
+        fp.fp_set("osd.sub_op", "delay", delay=2.0)
+        writer_task = asyncio.ensure_future(
+            io.write_full("stuck", b"y" * 512))
+        deadline = asyncio.get_running_loop().time() + 15.0
+        while True:
+            c = await checks()
+            if "SLOW_OPS" in c:
+                break
+            assert asyncio.get_running_loop().time() < deadline, c
+            await asyncio.sleep(0.05)
+        print("ok: injected 2s op raised SLOW_OPS "
+              f"({c['SLOW_OPS']['message']})")
+
+        fp.fp_clear("osd.sub_op")
+        await writer_task
+        deadline = asyncio.get_running_loop().time() + 15.0
+        while True:
+            c = await checks()
+            if "SLOW_OPS" not in c:
+                break
+            assert asyncio.get_running_loop().time() < deadline, c
+            await asyncio.sleep(0.05)
+        print("ok: SLOW_OPS cleared after the op completed")
+
+        recs = []
+        for osd_id in cluster.osds:
+            reply = await rados.osd_daemon_command(osd_id, "dump_ops")
+            recs.extend(reply["historic_slow"]["ops"])
+        assert recs, "no OSD retained the slow op"
+        print(f"ok: forensic ring retained {len(recs)} slow op(s)")
+    finally:
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+    echo "OBS_SMOKE_PASSED"
     exit 0
 fi
 
