@@ -227,12 +227,29 @@ def global_options() -> list[Option]:
         Option("osd_ec_coalesce_max_stripes", int, 4096,
                "pending stripe count that forces an immediate coalesced "
                "flush regardless of the window", Level.ADVANCED, min=1),
-        Option("ec_pallas_encode_variant", str, "",
+        Option("ec_pallas_encode_variant", str, "auto",
                "Pallas encode kernel formulation ('' = production "
-               "kernel; variants are bit-identical, promoted from the "
-               "round-5 perf lab for on-chip timing)", Level.ADVANCED,
-               enum_values=("", "enc_cmp_expand", "enc_u8_expand",
-                            "enc_split2", "enc_u8_split2")),
+               "kernel; 'auto' = the perf-lab winner enc_u8_expand on "
+               "a TPU backend, production elsewhere; variants are "
+               "bit-identical, promoted from the round-5 perf lab for "
+               "on-chip timing)", Level.ADVANCED,
+               enum_values=("", "auto", "enc_cmp_expand",
+                            "enc_u8_expand", "enc_split2",
+                            "enc_u8_split2")),
+        Option("osd_ec_resident", bool, True,
+               "keep EC shard streams device-resident in a shared "
+               "DeviceShardCache so repeated ops feed the kernel "
+               "without host round-trips (host copies only at the "
+               "client boundary and on store persistence)"),
+        Option("osd_ec_resident_max_bytes", int, 256 << 20,
+               "byte budget of the per-daemon device shard cache; "
+               "crossing it evicts LRU entries to the low watermark",
+               Level.ADVANCED, min=1 << 20),
+        Option("osd_ec_resident_writeback", bool, False,
+               "defer shard-data persistence to cache evict/flush "
+               "(attrs-only store commit per write); honored only in "
+               "lenient (unlogged) mode — logged acks require the "
+               "store commit", Level.ADVANCED),
         Option("log_to_memory_ring", bool, True, "keep crash ring buffer"),
         Option("debug_default", int, 1, "default subsystem debug level",
                min=0, max=20),

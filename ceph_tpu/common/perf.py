@@ -163,6 +163,19 @@ class PerfCounters:
 
         return _Timer()
 
+    def value(self, key: str, default: float = 0.0) -> float:
+        """Point read of a scalar counter (U64/GAUGE/TIME); LONGRUNAVG
+        and HISTOGRAM return their accumulated sum.  Missing counters
+        return ``default`` — bench/smoke assertions poll by name
+        without caring whether registration already happened."""
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                return default
+            if c.type in (CounterType.LONGRUNAVG, CounterType.HISTOGRAM):
+                return c.sum
+            return c.value
+
     def dump(self) -> dict:
         with self._lock:
             out = {}

@@ -112,6 +112,17 @@ def pad_batch_pow2(arr: np.ndarray) -> tuple[np.ndarray, int]:
     return np.concatenate([arr, pad], axis=0), b
 
 
+def pad_batch_pow2_device(arr) -> tuple[jax.Array, int]:
+    """pad_batch_pow2 for a device-resident batch: the zero padding is
+    allocated on device so the array never round-trips through host."""
+    b = int(arr.shape[0])
+    bp = pow2_bucket(b)
+    if bp == b:
+        return arr, b
+    pad = jnp.zeros((bp - b,) + tuple(arr.shape[1:]), jnp.uint8)
+    return jnp.concatenate([arr, pad], axis=0), b
+
+
 def _default_use_pallas() -> bool:
     """Fused Pallas kernel on real TPU; XLA einsum elsewhere (CPU tests,
     interpret-mode covers the Pallas math there)."""

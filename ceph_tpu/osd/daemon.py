@@ -372,6 +372,32 @@ class OSDDaemon:
             out[str(pgid)] = be.coalescer.stats()
         return out
 
+    def _resident_cache(self):
+        """The daemon's ONE DeviceShardCache, shared by every primary
+        EC backend (namespaced per PG) so the byte budget is a daemon
+        property, not a per-PG one."""
+        if getattr(self, "_resident_cache_obj", None) is None:
+            from ceph_tpu.store.device_cache import DeviceShardCache
+            self._resident_cache_obj = DeviceShardCache(
+                max_bytes=int(self.conf["osd_ec_resident_max_bytes"]),
+                perf=self.perf,
+            )
+        return self._resident_cache_obj
+
+    def _ec_resident_stats(self) -> dict:
+        """Admin-socket ``ec resident stats``: the shared device-shard
+        cache plus each primary EC PG's residency view."""
+        out = {}
+        cache = getattr(self, "_resident_cache_obj", None)
+        if cache is not None:
+            out["cache"] = cache.stats()
+        for pgid, pg in self.pgs.items():
+            be = getattr(pg, "backend", None)
+            if be is None or not hasattr(be, "resident_stats"):
+                continue
+            out[str(pgid)] = be.resident_stats()
+        return out
+
     async def _start_admin_socket(self) -> None:
         """Bind <admin_socket_dir>/<entity>.asok with the reference's
         introspection surface (admin_socket.h:105): perf dump,
@@ -411,6 +437,8 @@ class OSDDaemon:
         }, "daemon status")
         sock.register("ec coalesce stats", self._ec_coalesce_stats,
                       "per-PG EC cross-op coalescer state")
+        sock.register("ec resident stats", self._ec_resident_stats,
+                      "device-resident EC shard cache state")
         fp.register_admin_commands(sock)
         await sock.start(run_dir)
         self.admin_socket = sock
@@ -430,6 +458,16 @@ class OSDDaemon:
             self.admin_socket = None
         await self.monc.shutdown()
         await self.msgr.shutdown()
+        # spill any dirty device-resident shard streams BEFORE the
+        # store unmounts — device HBM is a cache tier, not durability
+        for pg in self.pgs.values():
+            be = getattr(pg, "backend", None)
+            if be is not None and getattr(be, "resident", None) \
+                    is not None:
+                try:
+                    await be.flush_resident()
+                except Exception:
+                    log.exception("resident flush failed on shutdown")
         await self.store.umount()
 
     # -- cephx -------------------------------------------------------------
@@ -716,6 +754,15 @@ class OSDDaemon:
                     "spans": self._dump_traces_all(
                         msg.data.get("trace_id")
                     ),
+                }))
+            except ConnectionError:
+                pass
+        elif t == "ec_resident_stats":
+            # the admin-socket `ec resident stats` surface over the wire
+            try:
+                conn.send_message(Message("ec_resident_stats_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    **self._ec_resident_stats(),
                 }))
             except ConnectionError:
                 pass
@@ -1362,6 +1409,14 @@ class OSDDaemon:
             if variant:
                 from ceph_tpu.ec import pallas_kernels
                 pallas_kernels.set_encode_variant(variant)
+            resident = None
+            resident_ns = f"{pg.pgid.pool}.{pg.pgid.ps}"
+            if bool(self.conf["osd_ec_resident"]):
+                resident = self._resident_cache()
+                # a rebuilt backend (peering, acting-set change) must
+                # not inherit residency decided under the old acting
+                # set — log rewind may have rewritten shard data
+                resident.drop_ns(resident_ns)
             pg.backend = ECBackend(
                 codec, shards, log_hook=log_hook,
                 mesh=self._ec_mesh(),
@@ -1373,6 +1428,10 @@ class OSDDaemon:
                     self.conf["osd_ec_coalesce_window_us"]),
                 coalesce_max_stripes=int(
                     self.conf["osd_ec_coalesce_max_stripes"]),
+                resident=resident,
+                resident_ns=resident_ns,
+                resident_writeback=bool(
+                    self.conf["osd_ec_resident_writeback"]),
             )
             pg.ec_k = pg.backend.k
         else:

@@ -41,6 +41,8 @@ from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.common.tracing import current_span
 from ceph_tpu.osd.ec_util import HashInfo, StripeInfo
 from ceph_tpu.store import CollectionId, GHObject, ObjectStore, Transaction
+from ceph_tpu.store.device_cache import (DeviceShardCache,
+                                         register_resident_counters)
 
 HINFO_ATTR = "hinfo"
 VERSION_ATTR = "version"
@@ -487,6 +489,10 @@ class ECBackend:
         coalesce: bool = True,
         coalesce_window_us: float = 200.0,
         coalesce_max_stripes: int = 4096,
+        resident=None,
+        resident_ns: str = "",
+        resident_writeback: bool = False,
+        resident_max_bytes: int = 256 << 20,
     ):
         """``codec``: an initialised ErasureCodeInterface; ``shards``:
         shard id -> ShardIO for all k+m positions. ``log_hook(oid, op,
@@ -566,6 +572,31 @@ class ECBackend:
         for _k in ("ec_encode_launch_us", "ec_decode_launch_us",
                    "ec_coalesce_wait_hist_us"):
             self.perf.add(_k, CounterType.HISTOGRAM)
+        # device residency (opt-in): keep shard streams on device in a
+        # DeviceShardCache so repeated ops feed the kernel without host
+        # round-trips.  Requires a codec with device-array entry points
+        # and is mutually exclusive with the mesh plane (the sharded
+        # applier owns its own placement).  The transfer counters are
+        # registered unconditionally — the non-resident paths account
+        # their modeled host<->device traffic under the same names, so
+        # cfg7's A/B reads one counter pair either way.
+        register_resident_counters(self.perf)
+        self.resident: DeviceShardCache | None = None
+        self.resident_ns = resident_ns
+        self.resident_writeback = False
+        if resident is not None and resident is not False \
+                and self.mesh is None \
+                and hasattr(codec, "encode_chunks_device") \
+                and hasattr(codec, "decode_chunks_device"):
+            self.resident = resident if isinstance(
+                resident, DeviceShardCache
+            ) else DeviceShardCache(max_bytes=resident_max_bytes,
+                                    perf=self.perf)
+            # write-back defers shard-data persistence to evict/flush;
+            # strict (logged) mode acks require the store commit, so it
+            # stays write-through there
+            self.resident_writeback = bool(resident_writeback) \
+                and not self.strict
         # cross-op micro-batching of device launches (the tentpole):
         # ops in flight concurrently share one encode/decode launch
         self._inflight_ops = 0
@@ -651,23 +682,74 @@ class ECBackend:
             self._mesh_appliers[key] = ap
         return ap
 
-    async def _encode_batch(self, stripes: np.ndarray) -> np.ndarray:
+    # -- host<->device boundary ------------------------------------------
+    #
+    # Both data-path flavors account the logical bytes that cross the
+    # host<->device boundary under ec_resident_h2d_bytes /
+    # ec_resident_d2h_bytes: the resident path counts at its real
+    # conversion points (_to_host/_to_device, cache spill), the classic
+    # numpy path counts the modeled launch traffic (stripes up, chunks
+    # down) in _encode_batch/_decode_batch.  Deterministic on CPU —
+    # that's what makes the cfg7 A/B counter-verified without a chip.
+
+    @staticmethod
+    def _is_device(arr) -> bool:
+        """True for jax arrays (the resident representation); numpy /
+        bytes are the host representation."""
+        return not isinstance(
+            arr, (np.ndarray, bytes, bytearray, memoryview))
+
+    def _to_host(self, arr) -> np.ndarray:
+        """Materialize on host, counting the transfer when it crosses."""
+        if isinstance(arr, np.ndarray):
+            return arr
+        out = np.asarray(arr)
+        self.perf.inc("ec_resident_d2h_bytes", out.nbytes)
+        return out
+
+    def _to_device(self, arr):
+        """Upload to device, counting the transfer when it crosses."""
+        if not self._is_device(arr):
+            arr = np.asarray(arr, np.uint8)
+            self.perf.inc("ec_resident_h2d_bytes", arr.nbytes)
+            import jax.numpy as jnp
+            return jnp.asarray(arr)
+        return arr
+
+    async def _encode_batch(self, stripes) -> np.ndarray:
         """(B, k, C) -> (B, k+m, C), through the mesh plane when one is
         configured (parity = sharded generator apply; data rows pass
         through, so the result is bit-identical to the codec path).
+        A device-resident batch (jax array in) encodes through the
+        codec's device entry point and stays on device.
 
         The batch dim is shape-bucketed: B pads up to a power of two
         (zero stripes; rows are independent, result sliced back) so the
         program/applier cache holds at most ceil(log2(max B)) + 1
         distinct encode shapes per codec instead of one per stripe
         count."""
-        from ceph_tpu.ec.engine import pad_batch_pow2
+        from ceph_tpu.ec.engine import pad_batch_pow2, pad_batch_pow2_device
 
+        if self._is_device(stripes):
+            stripes, b = pad_batch_pow2_device(stripes)
+            if stripes.shape[0] != b:
+                self.perf.inc("ec_coalesce_pad_waste",
+                              stripes.shape[0] - b)
+            self.mesh_stats["encode_buckets"].add(int(stripes.shape[0]))
+            self.perf.inc("ec_device_launches")
+            t0 = time.perf_counter()
+            out = await asyncio.to_thread(
+                self.ec.encode_chunks_device, stripes)
+            self.perf.hinc("ec_encode_launch_us",
+                           (time.perf_counter() - t0) * 1e6)
+            return out[:b]
+        in_bytes = stripes.nbytes if hasattr(stripes, "nbytes") else 0
         stripes, b = pad_batch_pow2(stripes)
         if stripes.shape[0] != b:
             self.perf.inc("ec_coalesce_pad_waste", stripes.shape[0] - b)
         self.mesh_stats["encode_buckets"].add(stripes.shape[0])
         self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_resident_h2d_bytes", in_bytes)
         t0 = time.perf_counter()
         if self.mesh is not None:
             ap = self._mesh_applier(
@@ -676,13 +758,16 @@ class ECBackend:
             self.mesh_stats["encodes"] += 1
             self.perf.hinc("ec_encode_launch_us",
                            (time.perf_counter() - t0) * 1e6)
-            return np.concatenate(
+            out = np.concatenate(
                 [np.asarray(stripes, np.uint8), parity], axis=1)[:b]
+            self.perf.inc("ec_resident_d2h_bytes", out.nbytes)
+            return out
         out = np.asarray(await asyncio.to_thread(
             self.ec.encode_chunks_batch, stripes
         ))[:b]
         self.perf.hinc("ec_encode_launch_us",
                        (time.perf_counter() - t0) * 1e6)
+        self.perf.inc("ec_resident_d2h_bytes", out.nbytes)
         return out
 
     async def _decode_batch(self, batched: dict, missing: list) -> dict:
@@ -692,7 +777,11 @@ class ECBackend:
         decode matrix — bit-identity by construction.  Batch dim
         shape-bucketed like _encode_batch."""
         missing = [int(w) for w in missing]
+        if self.resident is not None and any(
+                self._is_device(c) for c in batched.values()):
+            return await self._decode_batch_device(batched, missing)
         b = next(iter(batched.values())).shape[0] if batched else 0
+        in_bytes = sum(c.nbytes for c in batched.values())
         if b:
             from ceph_tpu.ec.engine import pow2_bucket
 
@@ -708,6 +797,7 @@ class ECBackend:
                 }
             self.mesh_stats["decode_buckets"].add(bp)
         self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_resident_h2d_bytes", in_bytes)
         t0 = time.perf_counter()
         if self.mesh is not None:
             avail = {int(i): np.asarray(c, np.uint8)
@@ -727,7 +817,9 @@ class ECBackend:
                                    axis=1)
                 rebuilt = await asyncio.to_thread(ap, stacked)
                 for i, w in enumerate(todo):
-                    out[w] = rebuilt[:b, i]
+                    out[w] = np.asarray(rebuilt[:b, i])
+                    self.perf.inc("ec_resident_d2h_bytes",
+                                  out[w].nbytes)
                 self.mesh_stats["decodes"] += 1
             self.perf.hinc("ec_decode_launch_us",
                            (time.perf_counter() - t0) * 1e6)
@@ -737,7 +829,46 @@ class ECBackend:
         )
         self.perf.hinc("ec_decode_launch_us",
                        (time.perf_counter() - t0) * 1e6)
-        return {w: np.asarray(c)[:b] for w, c in out.items()}
+        res = {w: np.asarray(c)[:b] for w, c in out.items()}
+        # only rebuilt chunks cross back down; available targets are
+        # passed through as the same host arrays
+        self.perf.inc("ec_resident_d2h_bytes", sum(
+            c.nbytes for w, c in res.items() if w not in batched))
+        return res
+
+    async def _decode_batch_device(self, batched: dict,
+                                   missing: list) -> dict:
+        """_decode_batch for a (possibly mixed) device-resident batch:
+        host chunks are promoted to device (counted uploads), rebuilt
+        targets come back as device arrays, and available targets pass
+        through in whatever representation they arrived in."""
+        from ceph_tpu.ec.engine import pad_batch_pow2_device
+
+        avail = {int(s): self._to_device(c) for s, c in batched.items()}
+        b = next(iter(avail.values())).shape[0] if avail else 0
+        if b:
+            padded = {}
+            for s, c in avail.items():
+                padded[s], _ = pad_batch_pow2_device(c)
+            bp = next(iter(padded.values())).shape[0]
+            if bp != b:
+                self.perf.inc("ec_coalesce_pad_waste", bp - b)
+            self.mesh_stats["decode_buckets"].add(int(bp))
+            avail = padded
+        self.perf.inc("ec_device_launches")
+        t0 = time.perf_counter()
+        out = {w: batched[w][:b] for w in missing if w in batched}
+        todo = [w for w in missing if w not in batched]
+        if todo:
+            if len(avail) < self.k:
+                raise IOError(f"cannot decode {todo}")
+            rebuilt = await asyncio.to_thread(
+                self.ec.decode_chunks_device, avail, todo)
+            for i, w in enumerate(todo):
+                out[w] = rebuilt[:b, i]
+        self.perf.hinc("ec_decode_launch_us",
+                       (time.perf_counter() - t0) * 1e6)
+        return out
 
     # -- cross-op coalescing (CoalescedLauncher front ends) ---------------
     async def _coalesced_encode(self, stripes: np.ndarray) -> np.ndarray:
@@ -745,8 +876,11 @@ class ECBackend:
         per-backend CoalescedLauncher (one device launch shared across
         concurrent batchmates) or falls through to the direct path when
         coalescing is off.  Shape validation happens HERE, before the op
-        joins a batch, so a malformed op can only fail itself."""
-        stripes = np.asarray(stripes, np.uint8)
+        joins a batch, so a malformed op can only fail itself.  Device
+        batches (the resident write path) ride the same launcher and
+        stay on device end to end."""
+        if not self._is_device(stripes):
+            stripes = np.asarray(stripes, np.uint8)
         if self.coalescer is None:
             return await self._encode_batch(stripes)
         if stripes.ndim != 3 or stripes.shape[1] != self.k \
@@ -766,8 +900,10 @@ class ECBackend:
         missing = [int(w) for w in missing]
         if self.coalescer is None:
             return await self._decode_batch(batched, missing)
-        avail = {int(s): np.asarray(c, np.uint8)
-                 for s, c in batched.items()}
+        avail = {
+            int(s): c if self._is_device(c) else np.asarray(c, np.uint8)
+            for s, c in batched.items()
+        }
         bs = {c.shape[0] for c in avail.values()}
         if not avail or len(bs) != 1 or any(
                 c.ndim != 2 or c.shape[1] != self.sinfo.chunk_size
@@ -789,25 +925,51 @@ class ECBackend:
             if len(payloads) == 1:
                 return [await self._encode_batch(payloads[0])]
             sizes = [p.shape[0] for p in payloads]
-            out = await self._encode_batch(
-                np.concatenate(payloads, axis=0))
+            any_dev = any(self._is_device(p) for p in payloads)
+            if any_dev:
+                # mixed batch: host batchmates are promoted (counted
+                # uploads) so the whole launch stays on device; their
+                # slices come back down below
+                import jax.numpy as jnp
+                cat = jnp.concatenate(
+                    [self._to_device(p) for p in payloads], axis=0)
+            else:
+                cat = np.concatenate(payloads, axis=0)
+            out = await self._encode_batch(cat)
             res, off = [], 0
-            for sz in sizes:
-                res.append(out[off:off + sz])
+            for p, sz in zip(payloads, sizes):
+                sl = out[off:off + sz]
+                if any_dev and not self._is_device(p):
+                    sl = self._to_host(sl)
+                res.append(sl)
                 off += sz
             return res
         _, shards, todo = key
         if len(payloads) == 1:
             return [await self._decode_batch(payloads[0], list(todo))]
         sizes = [next(iter(p.values())).shape[0] for p in payloads]
-        cat = {
-            s: np.concatenate([p[s] for p in payloads], axis=0)
-            for s in shards
-        }
+        any_dev = any(
+            self._is_device(c) for p in payloads for c in p.values())
+        if any_dev:
+            import jax.numpy as jnp
+            cat = {
+                s: jnp.concatenate(
+                    [self._to_device(p[s]) for p in payloads], axis=0)
+                for s in shards
+            }
+        else:
+            cat = {
+                s: np.concatenate([p[s] for p in payloads], axis=0)
+                for s in shards
+            }
         out = await self._decode_batch(cat, list(todo))
         res, off = [], 0
-        for sz in sizes:
-            res.append({w: c[off:off + sz] for w, c in out.items()})
+        for p, sz in zip(payloads, sizes):
+            host_op = not any(self._is_device(c) for c in p.values())
+            sl = {w: c[off:off + sz] for w, c in out.items()}
+            if any_dev and host_op:
+                sl = {w: self._to_host(c) for w, c in sl.items()}
+            res.append(sl)
             off += sz
         return res
 
@@ -942,39 +1104,76 @@ class ECBackend:
             a_start, a_len = self.sinfo.offset_len_to_stripe_bounds(
                 offset, len(data)
             )
-            buf = np.zeros(a_len, np.uint8)
-            # RMW: read back surviving logical bytes around the write —
-            # the extent cache (ExtentCache role) serves back-to-back
-            # overwrites without re-reading + decoding k shards
-            if old_size > a_start:
-                keep_len = min(old_size, a_start + a_len) - a_start
-                existing = self.extent_cache.get(oid, a_start, keep_len)
-                if existing is None:
-                    existing = await self._read_logical(
-                        oid, a_start, keep_len, old_size,
-                        meta.version if meta else None,
-                    )
-                buf[:keep_len] = np.frombuffer(existing, np.uint8)
-            buf[offset - a_start: end - a_start] = np.frombuffer(
-                bytes(data), np.uint8
-            )
-            stripes = self.sinfo.split_stripes(buf)
+            buf = None
+            if self.resident is not None:
+                # device-resident RMW: the stripe batch is assembled on
+                # device (resident shard gather + client-byte upload)
+                # and never materializes as host bytes
+                stripes = await self._resident_stripes(
+                    oid, a_start, a_len, offset, end, data, old_size,
+                    meta.version if meta else None,
+                )
+            else:
+                buf = np.zeros(a_len, np.uint8)
+                # RMW: read back surviving logical bytes around the
+                # write — the extent cache (ExtentCache role) serves
+                # back-to-back overwrites without re-reading + decoding
+                # k shards
+                if old_size > a_start:
+                    keep_len = min(old_size, a_start + a_len) - a_start
+                    existing = self.extent_cache.get(oid, a_start,
+                                                     keep_len)
+                    if existing is None:
+                        existing = await self._read_logical(
+                            oid, a_start, keep_len, old_size,
+                            meta.version if meta else None,
+                        )
+                    buf[:keep_len] = np.frombuffer(existing, np.uint8)
+                buf[offset - a_start: end - a_start] = np.frombuffer(
+                    bytes(data), np.uint8
+                )
+                stripes = self.sinfo.split_stripes(buf)
             # device encode off the event loop: a first-time XLA
             # compile must not stall heartbeats/leases in this process
             chunks = await self._coalesced_encode(stripes)
-            shard_bytes = self.sinfo.shard_bytes(chunks)
             shard_off = self.sinfo.logical_to_prev_chunk_offset(a_start)
             meta_attr = self._meta_attr(ECObjectMeta(new_size, new_version))
-            hattrs = await self._update_hinfo(
-                oid, shard_off, shard_bytes, old_size
-            )
+            streams = None
+            if buf is None:
+                streams = self.sinfo.shard_streams(chunks)
+                if self.resident_writeback:
+                    # shard data stays device-resident; the store gets
+                    # an attrs-only commit now and the bytes on
+                    # evict/flush.  hinfo tracking needs host bytes, so
+                    # it is invalidated (overwrite semantics).
+                    data_bytes = [b""] * self.n
+                    write_off = 0
+                    hattrs = [b""] * self.n
+                else:
+                    # write-through: ONE counted download of the
+                    # encoded shard streams at the store-persistence
+                    # boundary
+                    host = self._to_host(streams)
+                    shard_bytes = [host[i] for i in range(self.n)]
+                    hattrs = await self._update_hinfo(
+                        oid, shard_off, shard_bytes, old_size
+                    )
+                    data_bytes = [c.tobytes() for c in shard_bytes]
+                    write_off = shard_off
+            else:
+                shard_bytes = self.sinfo.shard_bytes(chunks)
+                hattrs = await self._update_hinfo(
+                    oid, shard_off, shard_bytes, old_size
+                )
+                data_bytes = [c.tobytes() for c in shard_bytes]
+                write_off = shard_off
             entry = (self.log_hook(oid, "modify", new_version,
                                    meta.version if meta else 0, reqid)
                      if self.log_hook else None)
             try:
                 results = await asyncio.gather(*(
                     self.shards[i].write_shard(
-                        oid, shard_off, shard_bytes[i].tobytes(),
+                        oid, write_off, data_bytes[i],
                         {VERSION_ATTR: meta_attr,
                          HINFO_ATTR: hattrs[i]},
                         log=entry,
@@ -995,10 +1194,205 @@ class ECBackend:
                 # mid-gather, when a subset of shards already hold the
                 # new bytes): cached extents can no longer be trusted
                 self.extent_cache.invalidate(oid)
+                if self.resident is not None:
+                    self.resident.drop_object(self.resident_ns, oid)
                 raise
-            self.extent_cache.note_write(oid, a_start,
-                                         buf.tobytes(), gen=cache_gen)
+            if streams is not None:
+                await self._resident_install(
+                    oid, shard_off, streams, new_version, old_size)
+            else:
+                self.extent_cache.note_write(oid, a_start,
+                                             buf.tobytes(),
+                                             gen=cache_gen)
             return ECObjectMeta(new_size, new_version)
+
+    # -- device residency (DeviceShardCache integration) ------------------
+    async def _resident_stripes(self, oid: str, a_start: int, a_len: int,
+                                offset: int, end: int, data,
+                                old_size: int, version):
+        """Assemble the write's (B, k, C) stripe batch on device.
+
+        Only the client's new bytes are uploaded; surviving bytes
+        around the write come from the resident data-shard entries (a
+        pure device gather).  A residency miss falls back to the host
+        read path (_read_logical handles reconstruction and hedging)
+        with ONE counted upload of the surrounding bytes."""
+        import jax.numpy as jnp
+
+        new = np.frombuffer(bytes(data), np.uint8)
+        keep_len = (min(old_size, a_start + a_len) - a_start
+                    if old_size > a_start else 0)
+        if keep_len <= 0 and new.size == a_len:
+            flat = self._to_device(new)
+        else:
+            base = None
+            if keep_len > 0:
+                base = self._resident_logical(
+                    oid, a_start, a_len, keep_len, old_size, version)
+                if base is None:
+                    existing = self.extent_cache.get(oid, a_start,
+                                                     keep_len)
+                    if existing is None:
+                        existing = await self._read_logical(
+                            oid, a_start, keep_len, old_size, version)
+                    host = np.zeros(a_len, np.uint8)
+                    host[:keep_len] = np.frombuffer(existing, np.uint8)
+                    base = self._to_device(host)
+            if base is None:
+                base = jnp.zeros(a_len, jnp.uint8)
+            flat = base.at[offset - a_start: end - a_start].set(
+                self._to_device(new))
+        return flat.reshape(-1, self.k, self.sinfo.chunk_size)
+
+    def _resident_logical(self, oid: str, a_start: int, a_len: int,
+                          keep_len: int, old_size: int, version):
+        """Device gather of logical bytes [a_start, a_start + a_len)
+        from the resident data-shard entries (bytes past keep_len are
+        zeroed, matching the host RMW buffer), or None when any needed
+        shard segment is not resident at the object's version."""
+        import jax.numpy as jnp
+
+        C = self.sinfo.chunk_size
+        nstripes = a_len // self.sinfo.stripe_width
+        coff = self.sinfo.aligned_logical_offset_to_chunk_offset(a_start)
+        clen = nstripes * C
+        ssize = self.sinfo.logical_to_next_chunk_offset(old_size)
+        need = min(coff + clen, ssize)
+        segs = []
+        for i in range(self.k):
+            ent = self.resident.get(self.resident_ns, oid, i)
+            if ent is None or (version is not None
+                               and ent.version != version):
+                return None
+            arr = ent.arr
+            if arr.shape[0] < need:
+                return None
+            seg = arr[coff: coff + clen]
+            if seg.shape[0] < clen:
+                seg = jnp.concatenate([
+                    seg, jnp.zeros(clen - seg.shape[0], jnp.uint8)])
+            segs.append(seg)
+        flat = self.sinfo.stack_shard_streams(jnp.stack(segs), nstripes)
+        if keep_len < a_len:
+            # zero the RMW buffer past the surviving bytes, as the host
+            # path's zero-initialized buf does
+            flat = jnp.where(
+                jnp.arange(a_len) < keep_len, flat, jnp.uint8(0))
+        return flat
+
+    async def _resident_install(self, oid: str, shard_off: int, streams,
+                                version: int, old_size: int) -> None:
+        """Install the write's encoded shard streams into the resident
+        cache (spliced over any prior entry), then enforce the byte
+        budget.  Write-back entries are dirty — the cache's spill hook
+        persists them on evict/flush."""
+        import jax.numpy as jnp
+
+        cache = self.resident
+        dirty = self.resident_writeback
+        clen = int(streams.shape[1])
+        old_len = self.sinfo.logical_to_next_chunk_offset(old_size)
+        for i in range(self.n):
+            seg = streams[i]
+            ent = cache.get(self.resident_ns, oid, i, count=False)
+            if ent is not None and not (
+                    shard_off == 0 and clen >= ent.arr.shape[0]):
+                base = ent.arr
+                if base.shape[0] < shard_off + clen:
+                    base = jnp.concatenate([
+                        base,
+                        jnp.zeros(shard_off + clen - base.shape[0],
+                                  jnp.uint8),
+                    ])
+                arr = base.at[shard_off: shard_off + clen].set(seg)
+            elif ent is None and not (shard_off == 0
+                                      and clen >= old_len):
+                if not dirty:
+                    # write-through partial write over a non-resident
+                    # object: the store stays authoritative; don't
+                    # cache a stream we only partially know
+                    continue
+                # write-back MUST materialize the full stream — the
+                # store just got an attrs-only commit, so the cache is
+                # about to hold the only complete copy
+                try:
+                    raw = await self.shards[i].read_shard(oid, 0,
+                                                          old_len)
+                except Exception:
+                    # source unreadable (dead shard): the stream stays
+                    # reconstructable from the other entries; mark the
+                    # shard for repair instead of failing the ack
+                    self._dirty.setdefault(oid, set()).add(i)
+                    continue
+                host = np.zeros(max(old_len, shard_off + clen),
+                                np.uint8)
+                host[:len(raw)] = np.frombuffer(raw, np.uint8)
+                arr = self._to_device(host) \
+                    .at[shard_off: shard_off + clen].set(seg)
+            else:
+                arr = seg
+            cache.put(self.resident_ns, oid, i, arr, version,
+                      dirty=dirty, spill=self._resident_spill)
+        if cache.over_high:
+            await cache.evict()
+
+    async def _resident_spill(self, oid: str, shard: int,
+                              payload: np.ndarray) -> None:
+        """Cache spill hook: persist a dirty entry's full shard stream
+        (write-back durability path, also the flush-on-shutdown hook)."""
+        await self.shards[shard].write_shard(oid, 0, payload.tobytes(),
+                                             {})
+
+    def _resident_read(self, shard: int, oid: str, off: int,
+                       length: int, shard_size, version):
+        """Serve a shard-range read from the resident cache, or None to
+        fall through to the store.  Clean entries serve only when the
+        requested version matches (the cached stream then equals the
+        store bytes, version-attr check elided); raw reads
+        (version=None, scrub) go to the store so corruption checks see
+        real store bytes.  Dirty entries are the ONLY complete copy —
+        they serve raw reads too, and a version mismatch raises rather
+        than falling through to a stale store."""
+        ent = self.resident.get(self.resident_ns, oid, shard)
+        if ent is None:
+            return None
+        if version is not None and ent.version != version:
+            if ent.dirty:
+                raise ShardReadError(
+                    f"shard {shard}: resident entry superseded "
+                    f"(want v{version}, have v{ent.version})")
+            return None
+        if version is None and not ent.dirty:
+            return None
+        arr = ent.arr
+        expected = length if shard_size is None else max(
+            0, min(length, shard_size - off))
+        if arr.shape[0] < off + expected:
+            return None
+        seg = arr[off: off + length]
+        if seg.shape[0] < length:
+            import jax.numpy as jnp
+            seg = jnp.concatenate([
+                seg, jnp.zeros(length - seg.shape[0], jnp.uint8)])
+        return seg
+
+    async def flush_resident(self) -> None:
+        """Spill every dirty resident entry to the store (shutdown /
+        export hook; a no-op in write-through mode)."""
+        if self.resident is not None:
+            await self.resident.flush(self.resident_ns)
+
+    def resident_stats(self) -> dict:
+        """Residency cache stats for this backend's namespace plus the
+        transfer counters (the `ec resident stats` asok payload)."""
+        if self.resident is None:
+            return {"enabled": False}
+        out = {"enabled": True,
+               "writeback": self.resident_writeback,
+               **self.resident.stats(ns=self.resident_ns)}
+        for key in ("ec_resident_h2d_bytes", "ec_resident_d2h_bytes"):
+            out[key] = int(self.perf.value(key))
+        return out
 
     async def _settle_write_failures(self, what: str, oid: str,
                                      failed: list[int], heal,
@@ -1157,6 +1551,14 @@ class ECBackend:
             if fp.ACTIVE:
                 await fp.fire("ec.shard_read")
                 await fp.fire(f"ec.shard_read.{shard}")
+            if self.resident is not None:
+                hit = self._resident_read(shard, oid, off, length,
+                                          shard_size, version)
+                if hit is not None:
+                    # served from the device-resident stream: no store
+                    # round trip, no host materialization (downstream
+                    # consumers convert at the client boundary only)
+                    return hit
             if version is not None:
                 raw_meta = await self.shards[shard].get_attr(
                     oid, VERSION_ATTR
@@ -1212,8 +1614,11 @@ class ECBackend:
                 )
             else:
                 chunks = {i: results[i] for i in want}
+        # the Objecter/client boundary: resident chunks materialize to
+        # host HERE (one counted copy of the payload), not per-launch
         stripes = np.stack(
-            [chunks[i].reshape(nstripes, self.sinfo.chunk_size)
+            [self._to_host(chunks[i]).reshape(nstripes,
+                                              self.sinfo.chunk_size)
              for i in range(self.k)], axis=1,
         )
         flat = self.sinfo.merge_stripes(stripes)
@@ -1351,6 +1756,8 @@ class ECBackend:
         for i in range(self.k):
             if i in have:
                 chunks[i] = have[i]
+            elif self._is_device(out[i]):
+                chunks[i] = out[i].reshape(-1)
             else:
                 chunks[i] = np.ascontiguousarray(out[i]).reshape(-1)
         return chunks
@@ -1384,6 +1791,8 @@ class ECBackend:
             # already past its gather could note_write AFTER this
             # invalidate and resurrect pre-delete bytes in the cache
             self.extent_cache.invalidate(oid)
+            if self.resident is not None:
+                self.resident.drop_object(self.resident_ns, oid)
             meta = await self._read_meta(oid) if self.log_hook else None
             entry = (self.log_hook(oid, "delete", 0,
                                    meta.version if meta else 0, reqid)
@@ -1441,6 +1850,11 @@ class ECBackend:
                 lambda live: self._heal_shards(oid, live, entry),
                 entry,
             )
+            if self.resident is not None:
+                # shard data is untouched; restamp resident entries so
+                # version-matched reads keep hitting
+                self.resident.bump_version(self.resident_ns, oid,
+                                           new_meta.version)
 
     async def get_attrs(self, oid: str) -> dict[str, bytes]:
         """All attrs, from the answering shard with the HIGHEST stored
@@ -1615,10 +2029,18 @@ class ECBackend:
             attrs = dict(stray_attrs[next(iter(need))])
         await asyncio.gather(*(
             self.shards[s].write_shard(
-                oid, 0, np.ascontiguousarray(out[s]).tobytes(), attrs,
+                oid, 0,
+                np.ascontiguousarray(self._to_host(out[s])).tobytes(),
+                attrs,
             )
             for s in lost
         ))
+        if self.resident is not None:
+            # rebuilt store content supersedes whatever the cache held
+            # for these positions (a clean entry would be identical,
+            # but dropping is unconditionally safe)
+            for s in lost:
+                self.resident.drop(self.resident_ns, oid, s)
 
     # -- scrub -----------------------------------------------------------
     async def scrub(self, oid: str) -> dict:
@@ -1636,6 +2058,10 @@ class ECBackend:
             self._read_shard_range(i, oid, 0, shard_len, shard_len)
             for i in range(self.n)
         ))
+        # raw (version=None) reads come from the store except for dirty
+        # write-back entries; materialize those once for the host-side
+        # comparisons below
+        reads = [self._to_host(r) for r in reads]
         nstripes = shard_len // self.sinfo.chunk_size
         stripes = np.stack(
             [reads[i].reshape(nstripes, self.sinfo.chunk_size)
@@ -1660,7 +2086,10 @@ class ECBackend:
         if raw:  # empty blob == hinfo invalidated by overwrite
             hinfo = HashInfo.from_dict(self.n, json.loads(raw))
             for i in range(self.n):
-                shard_view = reads[i].tobytes()[: hinfo.total_chunk_size]
+                # slice the array view first, THEN convert: one copy of
+                # the crc'd prefix instead of materializing the whole
+                # shard stream and slicing the bytes
+                shard_view = reads[i][: hinfo.total_chunk_size].tobytes()
                 if crc32c(0xFFFFFFFF, shard_view) != \
                         hinfo.get_chunk_hash(i):
                     crc_mismatch.append(i)

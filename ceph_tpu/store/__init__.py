@@ -14,3 +14,4 @@ from ceph_tpu.store.memstore import MemStore  # noqa: F401
 from ceph_tpu.store.walstore import WalStore  # noqa: F401
 from ceph_tpu.store.filestore import FileStore  # noqa: F401
 from ceph_tpu.store.txcodec import decode_tx, encode_tx  # noqa: F401
+from ceph_tpu.store.device_cache import DeviceShardCache  # noqa: F401

@@ -18,6 +18,13 @@
 #                                   concurrent 4 KiB writes, assert
 #                                   ec_coalesce_launches < ops/4 and a
 #                                   bit-identical read-back
+#   scripts/tier1.sh --resident-smoke
+#                                   device-resident EC data path end to
+#                                   end: a vstart cluster with an EC
+#                                   pool, 64 writes warming the shard
+#                                   cache, then 64 reads asserting zero
+#                                   host->device bytes on the hot path
+#                                   and a bit-identical read-back
 #   scripts/tier1.sh --obs-smoke    op observability end to end: a
 #                                   vstart cluster, one traced write
 #                                   whose >=4-span tree reassembles,
@@ -180,6 +187,72 @@ async def main():
 asyncio.run(main())
 EOF
     echo "COALESCE_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--resident-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    python - <<'EOF'
+import asyncio
+
+
+async def main():
+    from ceph_tpu.vstart import DevCluster
+
+    cluster = DevCluster(n_mons=1, n_osds=3)
+    await cluster.start()
+    try:
+        rados = await cluster.client()
+        r = await rados.mon_command(
+            "osd erasure-code-profile set", name="ressmoke",
+            profile={"plugin": "jax_rs", "k": "2", "m": "1",
+                     "crush-failure-domain": "osd"})
+        assert r["rc"] in (0, -17), r
+        await rados.pool_create("res", pg_num=1, pool_type="erasure",
+                                erasure_code_profile="ressmoke")
+        io = await rados.open_ioctx("res")
+        print("ok: vstart cluster + EC pool (jax_rs k=2,m=1, 1 pg)")
+
+        datas = {f"obj-{i}": bytes([i]) * 4096 for i in range(64)}
+        await asyncio.gather(*(
+            io.write_full(o, d) for o, d in datas.items()
+        ))
+        print("ok: 64 writes warmed the resident shard cache")
+
+        def summed(key):
+            return sum(osd.perf.dump().get(key, 0)
+                       for osd in cluster.osds.values())
+
+        h2d0 = summed("ec_resident_h2d_bytes")
+        for o, d in datas.items():
+            got = await io.read(o)
+            assert got == d, f"read-back mismatch on {o}"
+        print("ok: bit-identical read-back (64/64)")
+
+        h2d = summed("ec_resident_h2d_bytes") - h2d0
+        hits = summed("ec_resident_hits")
+        assert h2d == 0, (
+            f"hot-path read uploaded {h2d} bytes host->device")
+        assert hits >= 64, f"resident cache barely hit: {hits}"
+        print(f"ok: warm read phase moved 0 bytes host->device "
+              f"({int(hits)} cache hits)")
+
+        entries = 0
+        for osd_id in cluster.osds:
+            stats = await rados.osd_daemon_command(
+                osd_id, "ec_resident_stats")
+            entries += stats.get("cache", {}).get("entries", 0)
+        assert entries > 0, "no OSD reported cached resident shards"
+        print(f"ok: ec_resident_stats admin command reports "
+              f"{entries} cached shards")
+    finally:
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+    echo "RESIDENT_SMOKE_PASSED"
     exit 0
 fi
 

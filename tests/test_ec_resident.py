@@ -1,0 +1,334 @@
+"""Device-resident EC data path: DeviceShardCache + resident backend.
+
+The residency tier must be invisible to clients: corpus-profile
+bit-identity through the device-resident write/read path, a full
+write -> evict -> read-back cycle landing on the store copy, coalesced
+launches with mixed resident/non-resident batchmates, and the cache's
+LRU/watermark/spill/flush mechanics (dirty data is never dropped).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common import failpoint as fp
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+from ceph_tpu.osd.ec_backend import ECBackend, LocalShard
+from ceph_tpu.store.device_cache import DeviceShardCache
+from ceph_tpu.store.memstore import MemStore
+from ceph_tpu.store.object_store import Transaction
+from ceph_tpu.store.types import CollectionId
+
+# jax_rs slices of the corpus matrix (PROFILES in ceph_tpu/ec/corpus.py)
+# spanning dense, bit-schedule, and wide-symbol techniques — all ride
+# the same encode_chunks_device/decode_chunks_device entry points
+RESIDENT_PROFILES = [
+    {"k": "4", "m": "2", "technique": "reed_sol_van"},
+    {"k": "10", "m": "4", "technique": "cauchy_good"},
+    {"k": "5", "m": "2", "technique": "liberation", "w": "7"},
+    {"k": "5", "m": "3", "technique": "reed_sol_van", "w": "16"},
+]
+
+
+async def _backend(profile=None, unit=128, **kw):
+    profile = profile or {"k": "4", "m": "2",
+                          "technique": "reed_sol_van"}
+    codec = ErasureCodePluginRegistry().factory("jax_rs", profile)
+    align = getattr(codec, "get_alignment", lambda: 1)()
+    unit = -(-unit // align) * align      # bit-schedule codecs need k*w
+    store = MemStore()
+    shards = {}
+    for i in range(codec.get_chunk_count()):
+        cid = CollectionId(1, 0, shard=i)
+        await store.queue_transactions(
+            Transaction().create_collection(cid)
+        )
+        shards[i] = LocalShard(store, cid, pool=1, shard=i)
+    return ECBackend(codec, shards, stripe_unit=unit, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.fp_clear()
+    yield
+    fp.fp_clear()
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# -- DeviceShardCache mechanics -------------------------------------------
+
+
+def _arr(n, fill=0):
+    return np.full(n, fill, np.uint8)
+
+
+def test_cache_lru_watermark_eviction():
+    """Budget crossings evict LRU-first down to the low watermark;
+    get() refreshes recency."""
+    cache = DeviceShardCache(max_bytes=1024, low_watermark=0.5)
+    for i in range(4):
+        cache.put("pg", f"o{i}", 0, _arr(256, i), version=1)
+    assert cache.bytes == 1024 and not cache.over_high
+    cache.get("pg", "o0", 0)              # o0 becomes most-recent
+    cache.put("pg", "o4", 0, _arr(256, 4), version=1)
+    assert cache.over_high
+    _run(cache.evict())
+    assert cache.bytes <= 512
+    assert cache.get("pg", "o0", 0) is not None   # refreshed, survived
+    assert cache.get("pg", "o1", 0) is None       # LRU, evicted
+    assert cache.evictions == 3
+    st = cache.stats()
+    assert st["entries"] == 2 and st["evictions"] == 3
+    assert st["hits"] == 2 and st["misses"] == 1
+
+
+def test_cache_dirty_spill_on_evict_and_flush():
+    """Dirty entries spill (host bytes reach the callback) before
+    dropping; flush persists without dropping and marks clean; a
+    failing spill never loses the only copy."""
+    spilled = {}
+
+    async def spill(oid, shard, host):
+        spilled[(oid, shard)] = bytes(host)
+
+    async def bad_spill(oid, shard, host):
+        raise OSError("store degraded")
+
+    cache = DeviceShardCache(max_bytes=512, low_watermark=0.5)
+    cache.put("pg", "a", 0, _arr(256, 7), version=1,
+              dirty=True, spill=spill)
+    cache.put("pg", "b", 0, _arr(256, 9), version=1,
+              dirty=True, spill=spill)
+    _run(cache.flush())
+    assert spilled[("a", 0)] == b"\x07" * 256
+    assert spilled[("b", 0)] == b"\x09" * 256
+    st = cache.stats()
+    assert st["entries"] == 2 and st["dirty_entries"] == 0
+
+    # dirty again, then evict: spill fires before the drop
+    spilled.clear()
+    cache.put("pg", "a", 0, _arr(256, 8), version=2,
+              dirty=True, spill=spill)
+    cache.put("pg", "c", 0, _arr(256, 1), version=1,
+              dirty=True, spill=spill)
+    assert cache.over_high
+    _run(cache.evict(target=0))
+    assert spilled[("a", 0)] == b"\x08" * 256
+    assert cache.stats()["entries"] == 0
+
+    # failing spill: evict skips the entry, flush raises after trying all
+    cache.put("pg", "d", 0, _arr(256, 3), version=1,
+              dirty=True, spill=bad_spill)
+    _run(cache.evict(target=0))
+    assert cache.get("pg", "d", 0, count=False) is not None
+    with pytest.raises(OSError):
+        _run(cache.flush())
+
+
+def test_cache_drop_scopes_and_bump_version():
+    cache = DeviceShardCache(max_bytes=4096)
+    for ns in ("1.0", "1.1"):
+        for shard in range(3):
+            cache.put(ns, "obj", shard, _arr(64), version=1)
+    cache.drop("1.0", "obj", 0)
+    assert cache.stats(ns="1.0")["entries"] == 2
+    cache.bump_version("1.1", "obj", 5)
+    assert cache.get("1.1", "obj", 2, count=False).version == 5
+    assert cache.get("1.0", "obj", 1, count=False).version == 1
+    cache.drop_object("1.1", "obj")
+    assert cache.stats(ns="1.1")["entries"] == 0
+    cache.drop_ns("1.0")
+    assert cache.bytes == 0
+
+
+# -- resident backend: corpus bit-identity --------------------------------
+
+
+@pytest.mark.parametrize(
+    "profile", RESIDENT_PROFILES,
+    ids=lambda p: f"k{p['k']}m{p['m']}_{p['technique']}")
+def test_resident_corpus_payload_bit_identical(profile):
+    """The corpus payload (deliberately unaligned) written through the
+    device-resident path reads back bit-identical — both from the cache
+    and, after a full eviction, from the persisted store copy."""
+    from ceph_tpu.ec.corpus import _payload
+
+    async def run():
+        be = await _backend(profile, resident=True)
+        assert be.resident is not None
+        payload = _payload()
+        await be.write("corpus", payload)
+        assert await be.read("corpus") == payload      # cache-served
+        await be.resident.evict(target=0)
+        assert await be.read("corpus") == payload      # store-served
+
+    _run(run())
+
+
+def test_resident_write_evict_readback_cycle():
+    """write -> sub-stripe overwrite -> evict -> read-back, in both
+    write-through and write-back modes; write-back uploads only the
+    client payload on the overwrite."""
+    async def run(writeback):
+        be = await _backend(resident=True, resident_writeback=writeback)
+        assert be.resident_writeback is writeback
+        data = bytearray(bytes(range(256)) * 16)       # 4 KiB, 8 stripes
+        await be.write("cyc", bytes(data))
+        h2d0 = be.perf.value("ec_resident_h2d_bytes")
+        patch = b"\xee" * 96
+        await be.write("cyc", patch, offset=700)
+        data[700:796] = patch
+        if writeback:
+            # resident RMW: only the 96 client bytes cross to device
+            assert be.perf.value("ec_resident_h2d_bytes") - h2d0 == 96
+        assert await be.read("cyc") == bytes(data)
+        await be.flush_resident()
+        await be.resident.evict(target=0)
+        assert be.resident.stats()["entries"] == 0
+        assert await be.read("cyc") == bytes(data)     # store copy
+        st = be.resident_stats()
+        assert st["enabled"] and st["evictions"] >= be.k
+
+    _run(run(False))
+    _run(run(True))
+
+
+def test_resident_remove_and_version_coherence():
+    """remove() drops residency; a stale clean entry (version behind
+    the object) is bypassed in favour of the store."""
+    async def run():
+        be = await _backend(resident=True)
+        await be.write("gone", b"\x42" * 1024)
+        await be.remove("gone")
+        assert be.resident.stats()["entries"] == 0
+        with pytest.raises(Exception):
+            await be.read("gone")
+
+        await be.write("attr", b"\x17" * 1024)
+        await be.set_attr("attr", "user.x", b"y")      # bumps version
+        assert await be.read("attr") == b"\x17" * 1024
+
+    _run(run())
+
+
+# -- mixed resident / non-resident coalesced batches ----------------------
+
+
+def test_coalesced_mixed_device_host_batchmates():
+    """One coalesced launch fed a mix of device-resident and host
+    (numpy) stripe batches returns each submitter bit-identical
+    results in its own flavour (device in, device out; host in, host
+    out)."""
+    import jax.numpy as jnp
+
+    async def run():
+        be = await _backend(resident=True)
+        rng = np.random.default_rng(23)
+        k, chunk = be.k, be.sinfo.chunk_size
+        host_batches = [
+            np.asarray(rng.integers(0, 256, (b, k, chunk)), np.uint8)
+            for b in (2, 1, 4)
+        ]
+        dev_batches = [jnp.asarray(h) for h in host_batches[::-1]]
+        batches = [x for pair in zip(host_batches, dev_batches)
+                   for x in pair]
+        be._inflight_ops = len(batches) + 1
+        try:
+            outs = await asyncio.gather(*(
+                be._coalesced_encode(s) for s in batches
+            ))
+        finally:
+            be._inflight_ops = 0
+        st = be.coalescer.stats()
+        assert st["ops"] == len(batches)
+        assert st["launches"] < len(batches), st
+        for src, got in zip(batches, outs):
+            want = np.asarray(await be._encode_batch(np.asarray(src)))
+            assert np.array_equal(np.asarray(got), want)
+            if not isinstance(src, np.ndarray):
+                assert not isinstance(got, np.ndarray), \
+                    "device submitter must get a device result back"
+
+    _run(run())
+
+
+def test_resident_and_classic_backends_concurrent():
+    """A resident and a non-resident backend interleaving writes over
+    distinct stores stay bit-identical — the residency tier leaks no
+    state across backends."""
+    async def run():
+        res = await _backend(resident=True)
+        cla = await _backend(resident=False)
+        assert cla.resident is None
+        datas = {f"o{i}": bytes([i + 1]) * (512 + 128 * i)
+                 for i in range(8)}
+        await asyncio.gather(*(
+            be.write(o, d)
+            for o, d in datas.items() for be in (res, cla)
+        ))
+        for o, d in datas.items():
+            assert await res.read(o) == d
+            assert await cla.read(o) == d
+
+    _run(run())
+
+
+# -- fused u8 prologue (interpret mode) -----------------------------------
+
+
+def test_apply_bytes_u8_variant_interpret():
+    """The fused int8 lane-pack prologue (apply_bytes with the promoted
+    enc_u8_expand variant) is bit-identical to the word-path oracle in
+    interpret mode, including the quarter-pad tail."""
+    from ceph_tpu.ec import matrix, reference
+    from ceph_tpu.ec.pallas_kernels import (
+        PallasShardApply, bytes_to_words, set_encode_variant,
+        words_to_bytes)
+
+    k, m = 8, 4
+    G = matrix.generator_matrix("cauchy_good", k, m)
+    ap = PallasShardApply(G[k:], interpret=True)
+    rng = np.random.default_rng(41)
+    for n in (4096, 4096 + 512, 1028):     # 1028 % (4*LANE) != 0
+        data = np.asarray(rng.integers(0, 256, (k, n)), np.uint8)
+        base = np.asarray(
+            words_to_bytes(ap.apply_words(bytes_to_words(data))))
+        set_encode_variant("enc_u8_expand")
+        try:
+            got = np.asarray(ap.apply_bytes(data))
+        finally:
+            set_encode_variant("")
+        assert np.array_equal(got, base), f"n={n}"
+        assert np.array_equal(got, reference.encode(G, data)[k:])
+
+
+def test_apply_bytes_rejects_unaligned():
+    from ceph_tpu.ec import matrix
+    from ceph_tpu.ec.pallas_kernels import PallasShardApply
+
+    G = matrix.generator_matrix("reed_sol_van", 4, 2)
+    ap = PallasShardApply(G[4:], interpret=True)
+    with pytest.raises(ValueError, match="multiple of 4"):
+        ap.apply_bytes(np.zeros((4, 1026), np.uint8))
+
+
+def test_auto_variant_resolves_by_backend():
+    """The config default "auto" resolves to the promoted u8 kernel on
+    TPU and the production path elsewhere, at set time."""
+    import jax
+
+    from ceph_tpu.ec.pallas_kernels import (
+        get_encode_variant, set_encode_variant)
+
+    set_encode_variant("auto")
+    try:
+        if jax.default_backend() == "tpu":
+            assert get_encode_variant() == "enc_u8_expand"
+        else:
+            assert get_encode_variant() == ""
+    finally:
+        set_encode_variant("")
