@@ -533,6 +533,246 @@ def _cfg7_main() -> None:
     print(json.dumps(record), flush=True)
 
 
+def _cfg8_mesh_ab(n_writes: int = 32, write_bytes: int = 4096) -> dict:
+    """cfg8: mesh-global EC coalescing A/B — the same concurrent
+    small-write workload driven through TWO co-located ECBackends (two
+    OSDs' worth of EC groups), once with both backends parked on ONE
+    host-level MeshCoalescer (each flush is a single shard_map launch
+    whose batch axis splits over the 8-device 'dp' mesh) and once with
+    the per-backend single-device CoalescedLauncher of cfg6.  The graded
+    signals are exact on any backend, so CPU runs verify the claim
+    without the chip grant:
+
+    - per-device batch counters (real addressable-shard row counts read
+      off each placed launch) prove the batch axis split across ALL
+      mesh devices, and cross_backend_launches proves ops from distinct
+      backends rode one launch;
+    - bit-identity for the corpus payloads: reed_sol_van through the
+      full write/read path, SHEC through the sharded encode plane, and
+      CLAY/LRC through the sharded sub-chunk repair plane;
+    - CLAY/LRC degraded reads move >= 2x fewer inter-device bytes than
+      whole-chunk recovery (ec_mesh_ici_bytes vs
+      ec_mesh_ici_whole_bytes — hard-gated below)."""
+    import asyncio
+
+    import jax
+
+    from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+    from ceph_tpu.osd.ec_backend import ECBackend, LocalShard
+    from ceph_tpu.osd.mesh_coalesce import MeshCoalescer
+    from ceph_tpu.store import CollectionId, MemStore, Transaction
+
+    ndev = len(jax.devices())
+    if ndev < 8:
+        raise AssertionError(
+            f"cfg8 needs an 8-device mesh, backend has {ndev} "
+            "(run via bench.py --cfg8, which bootstraps a virtual mesh)"
+        )
+
+    def make_backend(profile: dict, plugin: str = "jax_rs",
+                     unit: int = 128, **kw) -> ECBackend:
+        codec = ErasureCodePluginRegistry().factory(plugin, profile)
+        align = getattr(codec, "get_alignment", lambda: 1)()
+        unit = -(-unit // align) * align
+        shards = {}
+        for i in range(codec.get_chunk_count()):
+            store = MemStore()
+            cid = CollectionId(1, 0, shard=i)
+            asyncio.run(store.queue_transactions(
+                Transaction().create_collection(cid)))
+            shards[i] = LocalShard(store, cid, pool=1, shard=i)
+        return ECBackend(codec, shards, stripe_unit=unit, **kw)
+
+    RS = {"k": "4", "m": "2", "technique": "reed_sol_van"}
+
+    async def run_pair(b1: ECBackend, b2: ECBackend) -> float:
+        datas = {f"obj-{i}": bytes([i % 255 + 1]) * write_bytes
+                 for i in range(n_writes)}
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *(b1.write(o, d) for o, d in datas.items()),
+            *(b2.write(o, d) for o, d in datas.items()),
+        )
+        dt = time.perf_counter() - t0
+        for be in (b1, b2):
+            for o, d in datas.items():
+                got = await be.read(o)
+                if got != d:
+                    raise AssertionError(f"cfg8 read-back mismatch on {o}")
+        return dt
+
+    out: dict = {"writes_per_backend": n_writes, "backends": 2,
+                 "write_bytes": write_bytes, "devices": ndev}
+
+    # --- arm A: mesh-sharded (one host-level coalescer, two OSDs) ---
+    co = MeshCoalescer()
+    b1 = make_backend(RS, mesh_coalescer=co)
+    b2 = make_backend(RS, mesh_coalescer=co)
+    if b1.mesh_co is not co or b2.mesh_co is not co:
+        raise AssertionError("cfg8: backends did not join the mesh plane")
+    asyncio.run(run_warm(b1))
+    asyncio.run(run_warm(b2))
+    st0 = co.stats()
+    warm_launches, warm_ops = st0["launches"], st0["ops"]
+    out["wall_s_mesh"] = round(asyncio.run(run_pair(b1, b2)), 4)
+    st = co.stats()
+    out["launches_mesh"] = st["launches"] - warm_launches
+    out["ops_mesh"] = st["ops"] - warm_ops
+    out["cross_backend_launches"] = st["cross_backend_launches"]
+    out["max_backends_in_launch"] = st["max_backends_in_launch"]
+    out["occupancy_mesh"] = round(
+        out["ops_mesh"] / max(out["launches_mesh"], 1), 2)
+    # per-device scaling table: lifetime stripe rows per device, read off
+    # the REAL addressable shards of each placed launch
+    per_dev = dict(st["per_device_stripes"])
+    out["per_device_stripes"] = {str(d): int(r)
+                                 for d, r in sorted(per_dev.items())}
+    out["last_per_device"] = {str(d): int(r) for d, r in
+                              sorted(st["last_per_device"].items())}
+    if len(per_dev) != ndev or any(r <= 0 for r in per_dev.values()):
+        raise AssertionError(
+            f"cfg8: batch axis did not split over all {ndev} devices: "
+            f"{per_dev}"
+        )
+    if out["cross_backend_launches"] < 1:
+        raise AssertionError(
+            "cfg8: no launch carried ops from more than one backend"
+        )
+
+    # --- corpus bit-identity on the sharded planes ---
+    import numpy as np
+    rng = np.random.default_rng(8)
+
+    # SHEC joins the mesh encode plane (generator, no decode_selection):
+    # sharded encode must be bit-identical to the single-device launch.
+    bs = make_backend({"k": "4", "m": "3", "c": "2"}, plugin="shec",
+                      unit=1024, mesh_coalescer=co)
+    if bs.mesh_co is not co:
+        raise AssertionError("cfg8: shec backend did not join the mesh")
+    batch = np.asarray(
+        rng.integers(0, 256, (6, bs.k, bs.sinfo.chunk_size)), np.uint8)
+
+    async def shec_check() -> None:
+        mesh_out = np.asarray(await bs._coalesced_encode(batch))
+        ref = np.asarray(await bs._encode_batch(batch))
+        if not np.array_equal(mesh_out, ref):
+            raise AssertionError("cfg8: shec sharded encode not "
+                                 "bit-identical to single-device")
+
+    asyncio.run(shec_check())
+    out["shec_encode_bit_identical"] = True
+
+    # CLAY / LRC ride the sharded sub-chunk repair plane on degraded
+    # reads: bit-identity plus the >=2x ICI-byte gate.
+    async def repair_check(be: ECBackend, lost: int) -> dict:
+        data = np.asarray(
+            rng.integers(0, 256, (4, be.k, be.sinfo.chunk_size)), np.uint8)
+        full = np.asarray(await be._encode_batch(data))
+        avail = {i: full[:, i] for i in range(be.n) if i != lost}
+        got = await be._coalesced_decode(avail, [lost])
+        if not np.array_equal(np.asarray(got[lost]), full[:, lost]):
+            raise AssertionError("cfg8: sharded repair not bit-identical")
+        d = be.perf.dump()
+        moved = float(d.get("ec_mesh_ici_bytes", 0.0))
+        whole = float(d.get("ec_mesh_ici_whole_bytes", 0.0))
+        if be.mesh_stats["repairs"] < 1:
+            raise AssertionError("cfg8: repair did not take the mesh plane")
+        if not (moved > 0 and moved * 2 <= whole):
+            raise AssertionError(
+                f"cfg8: ICI gate failed — moved {moved} vs whole-chunk "
+                f"{whole} (need moved*2 <= whole)"
+            )
+        return {"ici_bytes": moved, "whole_chunk_bytes": whole,
+                "reduction": round(whole / moved, 2)}
+
+    bc = make_backend({"k": "8", "m": "4", "d": "11"}, plugin="clay",
+                      unit=1024, mesh_coalescer=co)
+    out["clay_repair"] = asyncio.run(repair_check(bc, lost=3))
+    bl = make_backend({"k": "12", "m": "4", "l": "4"}, plugin="lrc",
+                      unit=1024, mesh_coalescer=co)
+    out["lrc_repair"] = asyncio.run(repair_check(bl, lost=6))
+
+    # --- arm B: per-backend single-device coalescer (cfg6 launcher) ---
+    c1 = make_backend(RS, coalesce=True)
+    c2 = make_backend(RS, coalesce=True)
+    asyncio.run(run_warm(c1))
+    asyncio.run(run_warm(c2))
+    warm = sum(float(be.perf.dump().get("ec_device_launches", 0.0))
+               for be in (c1, c2))
+    out["wall_s_single"] = round(asyncio.run(run_pair(c1, c2)), 4)
+    out["launches_single"] = sum(
+        float(be.perf.dump().get("ec_device_launches", 0.0))
+        for be in (c1, c2)) - warm
+
+    out["launch_reduction"] = round(
+        out["launches_single"] / max(out["launches_mesh"], 1), 1)
+    out["devices_engaged_mesh"] = len(per_dev)
+    out["devices_engaged_single"] = 1
+    return out
+
+
+def _cfg8_main() -> None:
+    """Standalone cfg8 entry (``python bench.py --cfg8``): CPU-sufficient
+    — launch counts, per-device shard layouts, and ICI byte counters are
+    exact on any backend.  Needs an 8-device mesh; when the current
+    backend exposes fewer (e.g. the single real TPU chip), re-execs in a
+    subprocess with a virtual 8-device CPU mesh, exactly like
+    __graft_entry__.dryrun_multichip."""
+    if "--cfg8-inner" not in sys.argv[1:]:
+        try:  # private API: absent on a future jax -> assume uninitialised
+            from jax._src.xla_bridge import backends_are_initialized
+        except ImportError:
+            def backends_are_initialized() -> bool:
+                return False
+
+        have = 0
+        if backends_are_initialized():
+            import jax
+
+            have = len(jax.devices())
+        if have < 8:
+            import subprocess
+
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            )
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--cfg8", "--cfg8-inner"],
+                env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=900,
+            )
+            if res.stdout:
+                sys.stdout.write(res.stdout)
+                sys.stdout.flush()
+            if res.returncode != 0:
+                raise RuntimeError(
+                    f"cfg8 virtual-mesh subprocess failed "
+                    f"(rc={res.returncode}):\nstderr:\n{res.stderr}"
+                )
+            return
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    cfg8 = _cfg8_mesh_ab()
+    record = {
+        "metric": "ec_mesh_2osd_32w_4KiB_cross_osd_batch_split",
+        "value": cfg8["devices_engaged_mesh"],
+        "unit": "devices sharing each coalesced launch",
+        "vs_baseline": round(
+            cfg8["devices_engaged_mesh"]
+            / cfg8["devices_engaged_single"], 1),
+        "extra": cfg8,
+    }
+    _append_local_record(record)
+    print(json.dumps(record), flush=True)
+
+
 def _append_local_record(record: dict) -> None:
     """Append a successful run to BENCH_LOCAL.jsonl (the auditable local
     trail; PERF.md explains the protocol)."""
@@ -629,6 +869,17 @@ def main() -> None:
     _guard_budget("cfg7")
     extra["cfg7_resident"] = _cfg7_resident_ab()
 
+    # cfg8: mesh-global coalescing A/B needs an 8-device mesh; on the
+    # single real chip it runs standalone via `bench.py --cfg8` (virtual
+    # CPU mesh) instead of inline here.
+    import jax
+
+    if len(jax.devices()) >= 8:
+        _guard_budget("cfg8")
+        extra["cfg8_mesh"] = _cfg8_mesh_ab()
+    else:
+        extra["cfg8_mesh"] = "skipped (<8 devices; run bench.py --cfg8)"
+
     extra["vs_isal_anchor_5gibps"] = round(value / ISA_L_BASELINE_GIBPS, 3)
     record = {
         "metric": "ec_encode_k8_m4_4KiB_stripes",
@@ -648,6 +899,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--cfg7" in sys.argv[1:]:
         _cfg7_main()
+        sys.exit(0)
+    if "--cfg8" in sys.argv[1:]:
+        _cfg8_main()
         sys.exit(0)
     try:
         main()

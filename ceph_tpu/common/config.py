@@ -227,6 +227,14 @@ def global_options() -> list[Option]:
         Option("osd_ec_coalesce_max_stripes", int, 4096,
                "pending stripe count that forces an immediate coalesced "
                "flush regardless of the window", Level.ADVANCED, min=1),
+        Option("osd_ec_mesh_coalesce", bool, False,
+               "promote EC op coalescing to one host-level launcher "
+               "shared by every co-located OSD: each micro-window "
+               "flushes as a single shard_map launch whose stripe "
+               "batch splits across ALL local jax devices (falls back "
+               "to the per-OSD launcher on 1-device hosts and for "
+               "codecs without a generator matrix); also enables "
+               "cross-chip CLAY/LRC sub-chunk degraded reads"),
         Option("ec_pallas_encode_variant", str, "auto",
                "Pallas encode kernel formulation ('' = production "
                "kernel; 'auto' = the perf-lab winner enc_u8_expand on "

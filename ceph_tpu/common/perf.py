@@ -93,6 +93,29 @@ def hist_quantile(h: dict, q: float) -> float:
     return last if not math.isinf(last) else bucket_le(HIST_BUCKETS - 2)
 
 
+def counter_scalar(val) -> float:
+    """Scalar view of ONE dumped counter value, whatever its type.
+
+    perf dumps are not uniformly scalar: LONGRUNAVG dumps
+    ``{"sum", "avgcount"}`` and HISTOGRAM ``{"buckets", "sum",
+    "count"}`` — code that sums ``dump()[subsys][key]`` across daemons
+    breaks the day a key changes type.  This mirrors
+    :meth:`PerfCounters.value`: dict forms collapse to their ``sum``.
+    """
+    if isinstance(val, dict):
+        return float(val.get("sum", 0.0))
+    return float(val)
+
+
+def counter_sum(dumps, subsys: str, key: str) -> float:
+    """Sum one counter across many daemons' ``dump()`` outputs,
+    tolerating daemons without the subsystem or key (mixed-version
+    clusters mid-upgrade)."""
+    return sum(
+        counter_scalar(d.get(subsys, {}).get(key, 0.0)) for d in dumps
+    )
+
+
 @dataclass
 class _Counter:
     type: CounterType

@@ -123,6 +123,35 @@ def pad_batch_pow2_device(arr) -> tuple[jax.Array, int]:
     return jnp.concatenate([arr, pad], axis=0), b
 
 
+def mesh_bucket(n: int, total_devices: int) -> int:
+    """Batch bucket for a mesh-sharded launch: pow2_bucket rounded up to
+    a whole number of device blocks, so the 'dp' split hands every mesh
+    device the same stripe count.  With a power-of-two device count
+    (every real TPU slice) this IS the pow2 bucket once B >= devices, so
+    the compiled-program bound of pow2_bucket carries over unchanged."""
+    bp = pow2_bucket(n)
+    t = max(1, int(total_devices))
+    if bp % t:
+        bp = -(-bp // t) * t
+    return bp
+
+
+def pad_batch_to(arr, target: int):
+    """Zero-pad the leading axis of a host OR device batch up to
+    ``target`` rows (>= current B) without changing representation:
+    numpy stays numpy, device arrays pad with device-allocated zeros
+    (no host round trip).  Rows are independent under GF region ops, so
+    padding preserves bit-identity of the real rows."""
+    b = int(arr.shape[0])
+    if target == b:
+        return arr
+    if isinstance(arr, np.ndarray):
+        pad = np.zeros((target - b,) + arr.shape[1:], np.uint8)
+        return np.concatenate([np.asarray(arr, np.uint8), pad], axis=0)
+    pad = jnp.zeros((target - b,) + tuple(arr.shape[1:]), jnp.uint8)
+    return jnp.concatenate([arr, pad], axis=0)
+
+
 def _default_use_pallas() -> bool:
     """Fused Pallas kernel on real TPU; XLA einsum elsewhere (CPU tests,
     interpret-mode covers the Pallas math there)."""

@@ -55,6 +55,8 @@ class OSDMonitor(PaxosService):
         # Drives the SLOW_OPS health check; re-sent every heartbeat,
         # so stale entries just age out.
         self.slow_op_reports: dict[int, dict] = {}
+        # map-commit waiters (wait_map): woken on every refreshed epoch
+        self._map_waiters: list = []
 
     # -- state ------------------------------------------------------------
     def refresh(self) -> None:
@@ -64,12 +66,37 @@ class OSDMonitor(PaxosService):
         raw = self.store.get(PREFIX, f"full_{last}")
         if raw is not None:
             self.osdmap = OSDMap.from_dict(decode(raw))
+        for ev in self._map_waiters:
+            ev.set()
         for osd, info in self.osdmap.osds.items():
             if info.up:
                 self.failure_reports.pop(osd, None)
                 self.down_pending_out.pop(osd, None)
             elif info.in_cluster and osd not in self.down_pending_out:
                 self.down_pending_out[osd] = time.monotonic()
+
+    async def wait_map(self, pred, timeout: float = 30.0):
+        """Event-wait (no polling) until ``pred(osdmap)`` holds: every
+        committed epoch wakes waiters from refresh(), so the wait ends
+        the moment the map changes — tests and tooling watching for a
+        mark-down/mark-up stop depending on sleep granularity and
+        wall-clock budgets.  ``timeout`` is a safety bound only."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while True:
+            # subscribe BEFORE testing the predicate: a refresh landing
+            # between the test and the wait must not be missed
+            ev = asyncio.Event()
+            self._map_waiters.append(ev)
+            try:
+                if pred(self.osdmap):
+                    return self.osdmap
+                await asyncio.wait_for(
+                    ev.wait(), max(0.0, deadline - loop.time()))
+            finally:
+                self._map_waiters.remove(ev)
 
     def create_initial(self, tx: StoreTransaction) -> None:
         # the genesis incremental carries the crush map so a map history

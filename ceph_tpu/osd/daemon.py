@@ -301,6 +301,13 @@ class OSDDaemon:
             # any PG needs it: first-time jax runtime init blocks for
             # seconds and would stall heartbeats/leases mid-peering
             await asyncio.to_thread(self._ec_mesh)
+        if bool(self.conf["osd_ec_mesh_coalesce"]):
+            # same off-loop warmup for the host mesh coalescer's
+            # device pool (first OSD up pays it; later ones find the
+            # singleton warm)
+            co = self._host_coalescer()
+            if co is not None:
+                await asyncio.to_thread(co.warm)
         if self.cephx:
             # BEFORE the map subscription: a revived OSD's first map
             # triggers peering immediately, and unsigned pg_queries
@@ -375,14 +382,52 @@ class OSDDaemon:
     def _resident_cache(self):
         """The daemon's ONE DeviceShardCache, shared by every primary
         EC backend (namespaced per PG) so the byte budget is a daemon
-        property, not a per-PG one."""
+        property, not a per-PG one.  With the host mesh coalescer on,
+        the cache is sharding-aware: installed streams pre-place with
+        the launch batch sharding so resident reads feed sharded
+        launches without a host round trip or a launch-time gather."""
         if getattr(self, "_resident_cache_obj", None) is None:
             from ceph_tpu.store.device_cache import DeviceShardCache
+            sharding = None
+            co = self._host_coalescer()
+            if co is not None and co.total > 1:
+                from jax.sharding import NamedSharding, PartitionSpec
+                sharding = NamedSharding(
+                    co.mesh(), PartitionSpec(("dp", "cs")))
             self._resident_cache_obj = DeviceShardCache(
                 max_bytes=int(self.conf["osd_ec_resident_max_bytes"]),
                 perf=self.perf,
+                sharding=sharding,
             )
         return self._resident_cache_obj
+
+    def _ec_mesh_stats(self) -> dict:
+        """Admin-socket ``ec mesh stats``: the host-level mesh
+        coalescer (shared across every co-located OSD — the launch,
+        occupancy, and per-device stripe split counters prove the
+        batch axis really fans out) plus each primary EC PG's view of
+        which plane served its batches."""
+        out = {}
+        co = self._host_coalescer()
+        if co is not None:
+            out["host"] = co.stats()
+        for pgid, pg in self.pgs.items():
+            be = getattr(pg, "backend", None)
+            if be is None or not hasattr(be, "mesh_stats"):
+                continue
+            ms = be.mesh_stats
+            out[str(pgid)] = {
+                "plane": ("mesh-coalesced" if be.mesh_co is not None
+                          else "mesh" if be.mesh is not None
+                          else "single-device"),
+                "sharded_decode": bool(be._mesh_dec_ok),
+                "encodes": ms["encodes"],
+                "decodes": ms["decodes"],
+                "repairs": ms["repairs"],
+                "encode_buckets": sorted(ms["encode_buckets"]),
+                "decode_buckets": sorted(ms["decode_buckets"]),
+            }
+        return out
 
     def _ec_resident_stats(self) -> dict:
         """Admin-socket ``ec resident stats``: the shared device-shard
@@ -439,6 +484,9 @@ class OSDDaemon:
                       "per-PG EC cross-op coalescer state")
         sock.register("ec resident stats", self._ec_resident_stats,
                       "device-resident EC shard cache state")
+        sock.register("ec mesh stats", self._ec_mesh_stats,
+                      "host-level mesh coalescer state (cross-OSD "
+                      "sharded EC launches)")
         fp.register_admin_commands(sock)
         await sock.start(run_dir)
         self.admin_socket = sock
@@ -763,6 +811,15 @@ class OSDDaemon:
                 conn.send_message(Message("ec_resident_stats_reply", {
                     "tid": msg.data.get("tid", 0),
                     **self._ec_resident_stats(),
+                }))
+            except ConnectionError:
+                pass
+        elif t == "ec_mesh_stats":
+            # the admin-socket `ec mesh stats` surface over the wire
+            try:
+                conn.send_message(Message("ec_mesh_stats_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    **self._ec_mesh_stats(),
                 }))
             except ConnectionError:
                 pass
@@ -1285,6 +1342,17 @@ class OSDDaemon:
         await self._split_pgs()
         self._resurrect_strays()
         m = self.osdmap
+        me = m.osds.get(self.osd_id) if m is not None else None
+        if me is not None and not me.up:
+            # A map that marks US down predates our own boot (or
+            # wrongly marked us down — _on_map is already re-asserting
+            # with a new boot).  Taking role changes from it would
+            # demote every local PG to stray and announce pg_stray to
+            # the primaries, turning a plain revive into an inventory
+            # reconcile; the reference OSD likewise waits in preboot
+            # until it sees itself up.  The epoch that shows us up
+            # triggers the real scan.
+            return
         for pool in m.pools.values():
             for ps in range(pool.pg_num):
                 up, up_primary, acting, primary = m.pg_to_up_acting(
@@ -1374,6 +1442,23 @@ class OSDDaemon:
             _EC_MESH_CACHE[cs] = mesh
         return mesh
 
+    def _host_coalescer(self):
+        """Host-level mesh coalescer (osd_ec_mesh_coalesce): ONE
+        launcher per process shared by every co-located OSD's EC
+        backends, flushing each micro-window as a single sharded
+        launch over all local jax devices.  Window/stripe caps reuse
+        the per-OSD coalescer options (they are host policy here —
+        first OSD up wins, which is fine for a vstart host with one
+        conf)."""
+        if not bool(self.conf["osd_ec_mesh_coalesce"]):
+            return None
+        from ceph_tpu.osd.mesh_coalesce import host_coalescer
+
+        return host_coalescer(
+            window_us=float(self.conf["osd_ec_coalesce_window_us"]),
+            max_stripes=int(self.conf["osd_ec_coalesce_max_stripes"]),
+        )
+
     def _make_backend(self, pg: PG) -> None:
         if not pg.is_primary:
             pg.backend = None
@@ -1432,6 +1517,7 @@ class OSDDaemon:
                 resident_ns=resident_ns,
                 resident_writeback=bool(
                     self.conf["osd_ec_resident_writeback"]),
+                mesh_coalescer=self._host_coalescer(),
             )
             pg.ec_k = pg.backend.k
         else:
@@ -1715,8 +1801,14 @@ class OSDDaemon:
             return
 
         def infos_in():
-            return all(pg.peer_infos[s].objects is not None
-                       for s in need_inv)
+            # .get: a concurrent re-peer of the same PG resets
+            # peer_infos while this round's gather still polls — a
+            # vanished stray entry means "not answered", not a crash
+            return all(
+                pg.peer_infos.get(s) is not None
+                and pg.peer_infos[s].objects is not None
+                for s in need_inv
+            )
 
         try:
             await asyncio.wait_for(self._gather(

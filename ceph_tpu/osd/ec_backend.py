@@ -489,6 +489,7 @@ class ECBackend:
         coalesce: bool = True,
         coalesce_window_us: float = 200.0,
         coalesce_max_stripes: int = 4096,
+        mesh_coalescer=None,
         resident=None,
         resident_ns: str = "",
         resident_writeback: bool = False,
@@ -552,7 +553,7 @@ class ECBackend:
         # perf counters read these).  *_buckets record the DISTINCT
         # padded batch dims launched — the pow2 shape-bucketing bound on
         # compiled XLA programs is asserted against them.
-        self.mesh_stats = {"encodes": 0, "decodes": 0,
+        self.mesh_stats = {"encodes": 0, "decodes": 0, "repairs": 0,
                            "encode_buckets": set(),
                            "decode_buckets": set()}
         # hedged reads: a data-shard read still pending after
@@ -565,12 +566,15 @@ class ECBackend:
         self.tracer = tracer
         for _k in ("hedge_issued", "hedge_won", "hedge_lost",
                    "ec_coalesce_launches", "ec_coalesce_ops",
-                   "ec_coalesce_pad_waste", "ec_device_launches"):
+                   "ec_coalesce_pad_waste", "ec_device_launches",
+                   "ec_mesh_launches", "ec_mesh_ops",
+                   "ec_mesh_ici_bytes", "ec_mesh_ici_whole_bytes"):
             self.perf.add(_k, CounterType.U64)
-        for _k in ("ec_coalesce_occupancy", "ec_coalesce_wait_us"):
+        for _k in ("ec_coalesce_occupancy", "ec_coalesce_wait_us",
+                   "ec_mesh_occupancy"):
             self.perf.add(_k, CounterType.LONGRUNAVG)
         for _k in ("ec_encode_launch_us", "ec_decode_launch_us",
-                   "ec_coalesce_wait_hist_us"):
+                   "ec_coalesce_wait_hist_us", "ec_mesh_launch_us"):
             self.perf.add(_k, CounterType.HISTOGRAM)
         # device residency (opt-in): keep shard streams on device in a
         # DeviceShardCache so repeated ops feed the kernel without host
@@ -604,6 +608,21 @@ class ECBackend:
             self, window_us=coalesce_window_us,
             max_stripes=coalesce_max_stripes,
         ) if coalesce else None
+        # host-level mesh coalescer (osd/mesh_coalesce.py): parked ops
+        # from EVERY co-located OSD's backend share one shard_map-
+        # sharded launch over the device mesh.  register() refuses
+        # 1-device pools and codecs without a dense generator — those
+        # keep the per-backend launcher above (graceful degradation).
+        # Decode joins only when the codec exposes decode_selection
+        # (shec encodes sharded but decodes per backend).  The host
+        # handle is kept even when sharded launches are refused: the
+        # clay/lrc sub-chunk repair meshes hang off it.
+        self._mesh_host = mesh_coalescer
+        self.mesh_co = None
+        self._mesh_dec_ok = False
+        if mesh_coalescer is not None and mesh_coalescer.register(self):
+            self.mesh_co = mesh_coalescer
+            self._mesh_dec_ok = mesh_coalescer.supports_decode(self)
 
     def _lock(self, oid: str):
         """Per-object write lock, refcounted so the table doesn't grow
@@ -881,7 +900,7 @@ class ECBackend:
         stay on device end to end."""
         if not self._is_device(stripes):
             stripes = np.asarray(stripes, np.uint8)
-        if self.coalescer is None:
+        if self.coalescer is None and self.mesh_co is None:
             return await self._encode_batch(stripes)
         if stripes.ndim != 3 or stripes.shape[1] != self.k \
                 or stripes.shape[2] != self.sinfo.chunk_size:
@@ -889,6 +908,11 @@ class ECBackend:
                 f"encode batch shape {stripes.shape} != "
                 f"(B, {self.k}, {self.sinfo.chunk_size})"
             )
+        if self.mesh_co is not None:
+            # host-wide launcher: batchmates may come from OTHER OSDs'
+            # backends, and the launch shards over the whole mesh
+            return await self.mesh_co.submit(
+                self, ("enc",), stripes, stripes.shape[0])
         return await self.coalescer.submit(
             ("enc",), stripes, stripes.shape[0])
 
@@ -898,7 +922,7 @@ class ECBackend:
         by (available shards, decode targets): only ops with the SAME
         failure pattern share a launch — and hence a decode matrix."""
         missing = [int(w) for w in missing]
-        if self.coalescer is None:
+        if self.coalescer is None and self._mesh_host is None:
             return await self._decode_batch(batched, missing)
         avail = {
             int(s): c if self._is_device(c) else np.asarray(c, np.uint8)
@@ -913,8 +937,20 @@ class ECBackend:
                 f"{ {s: np.shape(c) for s, c in avail.items()} } "
                 f"not uniform (B, {self.sinfo.chunk_size})"
             )
+        b = bs.pop()
+        if self._mesh_host is not None:
+            # cross-chip sub-chunk repair: a single-chunk degraded read
+            # on a clay/lrc codec moves only helper planes / group
+            # chunks over the interconnect, not whole survivor chunks
+            rep = await self._mesh_subchunk_repair(avail, missing)
+            if rep is not None:
+                return rep
         key = ("dec", tuple(sorted(avail)), tuple(missing))
-        return await self.coalescer.submit(key, avail, bs.pop())
+        if self.mesh_co is not None and self._mesh_dec_ok:
+            return await self.mesh_co.submit(self, key, avail, b)
+        if self.coalescer is None:
+            return await self._decode_batch(avail, missing)
+        return await self.coalescer.submit(key, avail, b)
 
     async def _coalesce_launch(self, key: tuple, payloads: list):
         """One device launch for a list of batchmate payloads (called
@@ -973,6 +1009,103 @@ class ECBackend:
             off += sz
         return res
 
+    async def _mesh_subchunk_repair(self, avail: dict,
+                                    missing: list) -> dict | None:
+        """Single-chunk degraded read over the mesh, moving sub-chunks.
+
+        CLAY: the regenerating-code repair reads only 1/q of each of the
+        d helpers' bytes — parallel/clay_sharding extracts the repair
+        planes BEFORE its all_gather, so only those planes ride the
+        interconnect.  LRC: the lost chunk's local group repairs with a
+        group-local all_gather — other groups' chunks never move.  Both
+        operators are bit-identical to the plugin decode (their _check
+        probes gate the corpus), so a degraded read through here returns
+        the same bytes as the classic whole-chunk path.
+
+        Interconnect savings are counter-verified: ec_mesh_ici_bytes
+        accrues the modeled moved bytes, ec_mesh_ici_whole_bytes the
+        whole-chunk counterfactual (k full survivor chunks).
+
+        Returns None whenever the geometry doesn't fit — multi-chunk
+        loss, helpers unavailable, device-resident payloads, or a pool
+        the repair meshes can't tile — and the caller falls back to the
+        classic decode path."""
+        ec = self.ec
+        is_clay = hasattr(ec, "sub_chunk_no") and hasattr(ec, "q")
+        is_lrc = hasattr(ec, "layers")
+        if not (is_clay or is_lrc):
+            return None
+        todo = [w for w in missing if w not in avail]
+        if len(todo) != 1:
+            return None
+        if any(self._is_device(c) for c in avail.values()):
+            return None
+        lost = todo[0]
+        b = next(iter(avail.values())).shape[0]
+        C = self.sinfo.chunk_size
+        try:
+            if is_clay:
+                if C % ec.sub_chunk_no:
+                    return None
+                mesh = self._mesh_host.clay_repair_mesh(self.n)
+                if mesh is None:
+                    return None
+                from ceph_tpu.ec.repair_operator import \
+                    clay_repair_operator
+                from ceph_tpu.parallel.clay_sharding import (
+                    clay_repair_ici_bytes, sharded_clay_repair)
+
+                _, helpers, _ = clay_repair_operator(ec, lost)
+                if any(h not in avail for h in helpers):
+                    return None
+                moved, whole = clay_repair_ici_bytes(
+                    ec, len(helpers), b, C)
+                repair = sharded_clay_repair
+                dp = mesh.shape["dp"]
+            else:
+                groups = len(ec.layers) - 1
+                mesh = self._mesh_host.lrc_repair_mesh(groups)
+                if mesh is None:
+                    return None
+                from ceph_tpu.ec.repair_operator import \
+                    lrc_repair_operator
+                from ceph_tpu.parallel.lrc_sharding import (
+                    lrc_repair_ici_bytes, sharded_lrc_repair)
+
+                _, minimum = lrc_repair_operator(ec, lost)
+                if any(h not in avail for h in minimum):
+                    return None
+                moved, whole = lrc_repair_ici_bytes(
+                    ec, len(minimum), b, C)
+                repair = sharded_lrc_repair
+                dp = mesh.shape["dp"]
+        except Exception:
+            # geometry probe failed (profile the operator can't serve
+            # locally, etc) — the classic decode path handles it
+            return None
+        # dp must divide the launched batch; zero stripes pad (rows are
+        # independent) and the pad slices off below
+        bp = -(-b // dp) * dp
+        chunks = np.zeros((bp, self.n, C), np.uint8)
+        for s, c in avail.items():
+            chunks[:b, int(s)] = np.asarray(c, np.uint8)
+        self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_mesh_launches")
+        self.perf.inc("ec_resident_h2d_bytes", chunks.nbytes)
+        t0 = time.perf_counter()
+        rec = np.asarray(await asyncio.to_thread(
+            repair, mesh, ec, chunks, lost))[:b]
+        launch_us = (time.perf_counter() - t0) * 1e6
+        self.perf.hinc("ec_decode_launch_us", launch_us)
+        self.perf.hinc("ec_mesh_launch_us", launch_us)
+        self.perf.inc("ec_mesh_ici_bytes", moved)
+        self.perf.inc("ec_mesh_ici_whole_bytes", whole)
+        self.perf.inc("ec_resident_d2h_bytes", rec.nbytes)
+        self.mesh_stats["repairs"] += 1
+        out = {w: avail[w] for w in missing if w in avail}
+        out[lost] = rec
+        return out
+
     def _track_op(self):
         """In-flight op accounting for the coalescer's adaptive window:
         when every tracked op is parked in the launcher, nothing else
@@ -989,6 +1122,8 @@ class ECBackend:
                 backend._inflight_ops -= 1
                 if backend.coalescer is not None:
                     backend.coalescer.notify()
+                if backend.mesh_co is not None:
+                    backend.mesh_co.notify()
                 return False
 
         return _Track()

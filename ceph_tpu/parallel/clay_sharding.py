@@ -74,6 +74,23 @@ def sharded_clay_repair(mesh, ec, chunks, lost: int) -> jax.Array:
     return step(dev)
 
 
+def clay_repair_ici_bytes(ec, n_helpers: int, batch: int,
+                          chunk_size: int) -> tuple[int, int]:
+    """(moved, whole) modeled interconnect bytes for one sub-chunk
+    repair launch of ``batch`` stripes.
+
+    moved: what the plane-extracted all_gather above actually ships —
+    each of the d helpers contributes only its repair planes, 1/q of
+    its bytes (the regenerating-code saving).  whole: the counterfactual
+    a classic RS decode moves — k full survivor chunks to the repair
+    site.  Deterministic on CPU, so A/B gates read the counters without
+    a chip; the ratio is q*k/d >= 2 for every supported CLAY profile.
+    """
+    moved = n_helpers * batch * (chunk_size // ec.q)
+    whole = ec.k * batch * chunk_size
+    return moved, whole
+
+
 def sharded_clay_repair_check(mesh) -> None:
     """Dryrun/test probe: encode, repair over the mesh, verify bit-identity
     against the encoded chunk and the single-device plugin repair."""

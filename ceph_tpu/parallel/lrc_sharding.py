@@ -112,6 +112,21 @@ def sharded_lrc_repair(mesh, ec, chunks, lost: int) -> np.ndarray:
     return np.asarray(step(dev)[:, g_lost, 0])
 
 
+def lrc_repair_ici_bytes(ec, n_helpers: int, batch: int,
+                         chunk_size: int) -> tuple[int, int]:
+    """(moved, whole) modeled interconnect bytes for one group-local
+    repair launch of ``batch`` stripes.
+
+    moved: the group-local all_gather ships only the lost chunk's l
+    group members (``n_helpers`` = the minimum_to_decode set).  whole:
+    the counterfactual a non-locality-aware decode moves — k full
+    survivor chunks.  Ratio k/l >= 2 for every kml profile worth
+    deploying (locality below that defeats LRC's point)."""
+    moved = n_helpers * batch * chunk_size
+    whole = ec.get_data_chunk_count() * batch * chunk_size
+    return moved, whole
+
+
 def sharded_lrc_repair_check(mesh_or_devices) -> None:
     """Dryrun/test probe: kml LRC repair over a group-local mesh."""
     from ceph_tpu.ec.registry import ErasureCodePluginRegistry

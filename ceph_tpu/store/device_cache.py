@@ -59,7 +59,8 @@ class DeviceShardCache:
 
     def __init__(self, max_bytes: int = 256 << 20,
                  low_watermark: float = 0.75,
-                 perf: PerfCounters | None = None):
+                 perf: PerfCounters | None = None,
+                 sharding=None):
         if max_bytes <= 0:
             raise ValueError(f"max_bytes must be positive, got {max_bytes}")
         self.max_bytes = int(max_bytes)
@@ -71,6 +72,38 @@ class DeviceShardCache:
         self.evictions = 0
         self.hits = 0
         self.misses = 0
+        # mesh-aware placement (PR 7): when the host runs the mesh-
+        # global EC coalescer, installed streams pre-place with the
+        # launch's batch sharding so a resident read feeds a sharded
+        # launch with neither a host round trip nor a gather-to-one-
+        # device copy at launch time (the reshard happens ONCE, at
+        # install, on device).
+        self.sharding = sharding
+        self.reshards = 0
+
+    def set_sharding(self, sharding) -> None:
+        """Adopt (or drop, with None) the placement applied to
+        subsequently installed device entries.  Existing entries keep
+        their placement — they reshard lazily if a launch needs it."""
+        self.sharding = sharding
+
+    def _place(self, arr):
+        """Re-place a device array with the cache sharding when its
+        leading axis tiles evenly; host arrays and odd shapes install
+        as-is (jax.device_put device->device moves never touch host)."""
+        if self.sharding is None or isinstance(
+                arr, (np.ndarray, bytes, bytearray, memoryview)):
+            return arr
+        try:
+            import jax
+
+            ndev = len(self.sharding.device_set)
+            if arr.ndim >= 1 and arr.shape[0] % max(1, ndev) == 0:
+                arr = jax.device_put(arr, self.sharding)
+                self.reshards += 1
+        except Exception:
+            pass
+        return arr
 
     # -- lookup / install -------------------------------------------------
 
@@ -99,7 +132,7 @@ class DeviceShardCache:
         old = self._entries.pop(key, None)
         if old is not None:
             self.bytes -= old.nbytes
-        ent = _Entry(arr, version, dirty, spill)
+        ent = _Entry(self._place(arr), version, dirty, spill)
         self._entries[key] = ent
         self.bytes += ent.nbytes
 
@@ -208,4 +241,6 @@ class DeviceShardCache:
             "hits": self.hits,
             "misses": self.misses,
             "evictions": self.evictions,
+            "sharded": self.sharding is not None,
+            "reshards": self.reshards,
         }

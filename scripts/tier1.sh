@@ -31,6 +31,14 @@
 #                                   /metrics serving histogram _bucket
 #                                   series, and an injected 2s op
 #                                   raising then clearing SLOW_OPS
+#   scripts/tier1.sh --mesh-smoke   mesh-global EC coalescing end to
+#                                   end: a vstart cluster (3 OSDs, one
+#                                   forced 8-device CPU mesh) with
+#                                   osd_ec_mesh_coalesce on, concurrent
+#                                   writes from PGs on different OSDs
+#                                   sharing sharded launches whose
+#                                   batch axis splits over all devices,
+#                                   and a bit-identical read-back
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -354,6 +362,98 @@ async def main():
 asyncio.run(main())
 EOF
     echo "OBS_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--mesh-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    # force a multi-device mesh on the CPU backend: the launch-count,
+    # cross-backend, and per-device-stripe signals are exact here; only
+    # the wall-clock ratio needs real chips
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+    python - <<'EOF'
+import asyncio
+
+
+async def main():
+    from ceph_tpu.vstart import DevCluster
+
+    cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+        "osd_ec_mesh_coalesce": True,
+    })
+    await cluster.start()
+    try:
+        rados = await cluster.client()
+        r = await rados.mon_command(
+            "osd erasure-code-profile set", name="meshsmoke",
+            profile={"plugin": "jax_rs", "k": "2", "m": "1",
+                     "crush-failure-domain": "osd"})
+        assert r["rc"] in (0, -17), r
+        await rados.pool_create("mesh", pg_num=8, pool_type="erasure",
+                                erasure_code_profile="meshsmoke")
+        io = await rados.open_ioctx("mesh")
+        print("ok: vstart cluster + EC pool "
+              "(jax_rs k=2,m=1, 8 pgs, mesh coalescer on)")
+
+        datas = {f"obj-{i}": bytes([i]) * 4096 for i in range(64)}
+        await asyncio.gather(*(
+            io.write_full(o, d) for o, d in datas.items()
+        ))
+        print("ok: 64 concurrent 4KiB writes acked")
+        for o, d in datas.items():
+            got = await io.read(o)
+            assert got == d, f"read-back mismatch on {o}"
+        print("ok: bit-identical read-back (64/64)")
+
+        # one `ec mesh stats` asok reply carries the HOST coalescer
+        # (shared across every co-located OSD) plus each primary EC
+        # PG's plane; gather all three OSDs' views over the wire
+        osd_planes = {}
+        host = None
+        for osd_id in cluster.osds:
+            reply = await rados.osd_daemon_command(
+                osd_id, "ec_mesh_stats")
+            host = reply.get("host") or host
+            pgs = [v for k, v in reply.items()
+                   if k not in ("tid", "host")]
+            if any(p["plane"] == "mesh-coalesced"
+                   and p["encodes"] > 0 for p in pgs):
+                osd_planes[osd_id] = pgs
+        assert host is not None, "no OSD reported the host coalescer"
+        assert host["devices"] == 8, host
+        assert len(osd_planes) >= 2, (
+            f"mesh-coalesced EC ops seen on only "
+            f"{sorted(osd_planes)} — need >=2 OSDs sharing the host "
+            f"launcher")
+        print(f"ok: OSDs {sorted(osd_planes)} all fed the one host "
+              f"coalescer")
+
+        launches, ops = host["launches"], host["ops"]
+        assert ops >= 64, host
+        assert launches < ops / 2, (
+            f"mesh coalescing too weak: {launches} launches "
+            f"for {ops} ops")
+        assert host["max_backends_in_launch"] >= 2, host
+        assert host["cross_backend_launches"] >= 1, host
+        print(f"ok: {int(ops)} cross-OSD ops rode "
+              f"{int(launches)} sharded launches "
+              f"(max {host['max_backends_in_launch']} backends/launch)")
+
+        per_dev = host["per_device_stripes"]
+        assert len(per_dev) == 8, per_dev
+        assert all(r > 0 for r in per_dev.values()), per_dev
+        print("ok: batch axis split over all 8 devices "
+              + " ".join(f"d{d}:{r}"
+                         for d, r in sorted(per_dev.items(),
+                                            key=lambda kv: int(kv[0]))))
+    finally:
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+    echo "MESH_SMOKE_PASSED"
     exit 0
 fi
 

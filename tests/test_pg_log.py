@@ -150,7 +150,10 @@ def test_gap_below_tail_forces_backfill():
 # integration: O(log) peering, delete propagation, backfill fallback
 
 def _counter(osds, key):
-    return sum(osd.perf.dump().get(key, 0) for osd in osds)
+    from ceph_tpu.common.perf import counter_scalar
+
+    return sum(counter_scalar(osd.perf.dump().get(key, 0))
+               for osd in osds)
 
 
 def test_interval_churn_exchanges_log_not_inventory():
@@ -175,10 +178,10 @@ def test_interval_churn_exchanges_log_not_inventory():
         victim = next(o.osd_id for o in osds
                       if not any(pg.is_primary for pg in o.pgs.values()))
         await osds[victim].shutdown()
-        deadline = asyncio.get_running_loop().time() + 15
-        while mon.osd_monitor.osdmap.is_up(victim):
-            assert asyncio.get_running_loop().time() < deadline
-            await asyncio.sleep(0.05)
+        # event wait, not a sleep-poll: refresh() wakes waiters on
+        # every committed epoch
+        await mon.osd_monitor.wait_map(
+            lambda m: not m.is_up(victim), timeout=15.0)
         r = await client.op("rep", "obj0", [
             {"op": "write", "off": 0, "data": b"v2" * 32},
         ])
@@ -190,22 +193,37 @@ def test_interval_churn_exchanges_log_not_inventory():
                             store=osds[victim].store, host=f"h{victim}")
         await revived.start()
         osds[victim] = revived
-        await wait_active(osds, pool_id)
-        # the revived replica converges via log diff: stale obj0 healed
-        deadline = asyncio.get_running_loop().time() + 15
+        # the revived replica converges via log diff: stale obj0 healed.
+        # Event wait on the replica's own store commits (recovery push
+        # applies through queue_transactions) instead of read-polling.
         from ceph_tpu.store import CollectionId, GHObject
         from ceph_tpu.osd.pg import object_to_ps
         ps = object_to_ps("obj0", 4)
         cid = CollectionId(pool_id, ps)
-        while True:
+
+        def _healed():
             try:
-                if revived.store.read(cid, GHObject(pool_id, "obj0")) \
-                        == b"v2" * 32:
-                    break
+                return revived.store.read(
+                    cid, GHObject(pool_id, "obj0")) == b"v2" * 32
             except KeyError:
-                pass
-            assert asyncio.get_running_loop().time() < deadline
-            await asyncio.sleep(0.05)
+                return False
+
+        healed = asyncio.Event()
+        orig_qt = revived.store.queue_transactions
+
+        async def qt_hook(*a, **kw):
+            res = await orig_qt(*a, **kw)
+            if not healed.is_set() and _healed():
+                healed.set()
+            return res
+
+        revived.store.queue_transactions = qt_hook
+        try:
+            await wait_active(osds, pool_id)
+            if not _healed():        # may have landed before the hook
+                await asyncio.wait_for(healed.wait(), 15.0)
+        finally:
+            revived.store.queue_transactions = orig_qt
         # O(log): churn and recovery used zero inventory scans
         assert _counter(osds, "peer_inventory_scans") == base_scans
         assert _counter(osds, "peer_backfills") == 0
