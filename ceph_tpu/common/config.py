@@ -258,6 +258,17 @@ def global_options() -> list[Option]:
                "(attrs-only store commit per write); honored only in "
                "lenient (unlogged) mode — logged acks require the "
                "store commit", Level.ADVANCED),
+        Option("osd_ec_repair_batch", bool, True,
+               "drain PG missing sets through the batched repair "
+               "engine: degraded objects grouped by lost-shard "
+               "pattern rebuild in shared decode launches with "
+               "locality-aware survivor reads (LRC group-local, CLAY "
+               "helper sub-chunks); objects the engine cannot serve "
+               "fall back to per-object recovery"),
+        Option("osd_ec_repair_batch_objects", int, 64,
+               "max degraded objects per batched repair launch (one "
+               "mClock recovery grant at this cost paces each batch)",
+               Level.ADVANCED, min=1),
         Option("log_to_memory_ring", bool, True, "keep crash ring buffer"),
         Option("debug_default", int, 1, "default subsystem debug level",
                min=0, max=20),

@@ -264,6 +264,17 @@ class OSDDaemon:
         )
         self._use_mclock = (self.conf["osd_op_queue"]
                             == "mclock_scheduler")
+        # batched locality-aware repair engine: drains PG missing sets
+        # through shared decode launches, paced by the mClock recovery
+        # class at batch cost (osd/repair.py)
+        from ceph_tpu.osd.repair import RepairScheduler
+        self.repair = RepairScheduler(
+            self.perf, tracer=self.tracer,
+            op_scheduler=self.op_scheduler,
+            use_mclock=self._use_mclock,
+            max_batch_objects=int(
+                self.conf["osd_ec_repair_batch_objects"]),
+        )
         # completed-op cache keyed by client reqid (the osd_reqid_t dedup
         # the reference keeps in the PG log): a client resend whose first
         # attempt executed but lost the reply gets the cached result
@@ -429,6 +440,24 @@ class OSDDaemon:
             }
         return out
 
+    def _ec_repair_stats(self) -> dict:
+        """Admin-socket ``ec repair stats``: the batched repair
+        engine's lifetime view — batches, objects, per-strategy split,
+        plan-cache hit rate, and the end-to-end byte accounting
+        (survivor bytes read, bytes saved vs the whole-chunk
+        counterfactual, rebuilt bytes written)."""
+        from ceph_tpu.osd.repair import REPAIR_COUNTERS
+        return {
+            "engine": self.repair.stats(),
+            "counters": {k: self.perf.value(k)
+                         for k in REPAIR_COUNTERS},
+            "mclock": {
+                "enabled": self._use_mclock,
+                "recovery_dispatched":
+                    self.op_scheduler.stats().get("recovery", 0),
+            },
+        }
+
     def _ec_resident_stats(self) -> dict:
         """Admin-socket ``ec resident stats``: the shared device-shard
         cache plus each primary EC PG's residency view."""
@@ -487,6 +516,9 @@ class OSDDaemon:
         sock.register("ec mesh stats", self._ec_mesh_stats,
                       "host-level mesh coalescer state (cross-OSD "
                       "sharded EC launches)")
+        sock.register("ec repair stats", self._ec_repair_stats,
+                      "batched repair engine state (strategy split, "
+                      "read-byte savings, mClock pacing)")
         fp.register_admin_commands(sock)
         await sock.start(run_dir)
         self.admin_socket = sock
@@ -820,6 +852,15 @@ class OSDDaemon:
                 conn.send_message(Message("ec_mesh_stats_reply", {
                     "tid": msg.data.get("tid", 0),
                     **self._ec_mesh_stats(),
+                }))
+            except ConnectionError:
+                pass
+        elif t == "ec_repair_stats":
+            # the admin-socket `ec repair stats` surface over the wire
+            try:
+                conn.send_message(Message("ec_repair_stats_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    **self._ec_repair_stats(),
                 }))
             except ConnectionError:
                 pass
@@ -1899,6 +1940,25 @@ class OSDDaemon:
                 if name not in auth_inv:
                     # deleted while this shard was away
                     need[name] = LogEntry(0, 0, name, OP_DELETE, 0)
+        # planning rollup for the batched repair engine: objects that
+        # share a lost-shard pattern will drain through shared decode
+        # launches, so the pattern histogram IS the launch plan
+        if pg.is_ec and missing.backfill:
+            patterns: dict[tuple[int, ...], int] = {}
+            per_obj: dict[str, list[int]] = {}
+            for shard in missing.backfill:
+                for name, entry in missing.by_shard.get(
+                        shard, {}).items():
+                    if entry.op != OP_DELETE:
+                        per_obj.setdefault(name, []).append(shard)
+            for shards in per_obj.values():
+                key = tuple(sorted(shards))
+                patterns[key] = patterns.get(key, 0) + 1
+            if patterns:
+                log.dout(10, "pg %s: backfill plan: %d objects in %d "
+                         "lost-pattern groups (batched launches): %s",
+                         pg.pgid, len(per_obj), len(patterns),
+                         {str(k): v for k, v in patterns.items()})
 
     async def _merge_log(self, pg: PG, d: dict) -> None:
         """Apply an activation merge: adopt authoritative window entries
@@ -3249,8 +3309,32 @@ class OSDDaemon:
                              pg.pgid, name, shard, e)
                     return False
 
+        # batched repair engine first: objects sharing a failure
+        # pattern drain through shared decode launches (grouped by
+        # codec signature + lost-shard set, strategy-planned, paced by
+        # the mClock recovery class at batch cost).  Whatever the
+        # engine cannot serve — stray-only sources, probe failures,
+        # singleton groups — falls through to the classic per-object
+        # path below, which retries and mixes stray reads.
+        engine_done: set[str] = set()
+        if rebuild and self.conf["osd_ec_repair_batch"] \
+                and hasattr(pg.backend, "recover_batch"):
+            try:
+                engine_done = await self.repair.drain(
+                    pg.backend, rebuild, target_version)
+            except Exception as e:       # noqa: BLE001
+                log.derr("pg %s: batched repair drain failed: %r "
+                         "(falling back to per-object recovery)",
+                         pg.pgid, e)
+                engine_done = set()
+            if engine_done:
+                self.perf.inc("recovery_ops", len(engine_done))
+                log.dout(10, "pg %s: repair engine rebuilt %d/%d "
+                         "objects in batches", pg.pgid,
+                         len(engine_done), len(rebuild))
         outcomes = await asyncio.gather(
-            *(recover_one(n, s) for n, s in rebuild.items()),
+            *(recover_one(n, s) for n, s in rebuild.items()
+              if n not in engine_done),
             *(remove_one(s, n) for s, n in removals),
         )
         return sum(1 for ok in outcomes if not ok)

@@ -40,6 +40,8 @@ from ceph_tpu.common.crc32c import crc32c
 from ceph_tpu.common.perf import CounterType, PerfCounters
 from ceph_tpu.common.tracing import current_span
 from ceph_tpu.osd.ec_util import HashInfo, StripeInfo
+from ceph_tpu.osd.repair import (RepairPlan, minimum_to_decode_cached,
+                                 plan_repair, register_repair_counters)
 from ceph_tpu.store import CollectionId, GHObject, ObjectStore, Transaction
 from ceph_tpu.store.device_cache import (DeviceShardCache,
                                          register_resident_counters)
@@ -585,6 +587,9 @@ class ECBackend:
         # their modeled host<->device traffic under the same names, so
         # cfg7's A/B reads one counter pair either way.
         register_resident_counters(self.perf)
+        # batched repair engine counters (accrued by recover_batch;
+        # the per-object paths share the plan hit/miss pair)
+        register_repair_counters(self.perf)
         self.resident: DeviceShardCache | None = None
         self.resident_ns = resident_ns
         self.resident_writeback = False
@@ -1858,7 +1863,8 @@ class ECBackend:
         while True:
             avail = [i for i in range(self.n) if i not in dead]
             try:
-                need = self.ec.minimum_to_decode(list(missing), avail)
+                need = minimum_to_decode_cached(
+                    self.ec, list(missing), avail, perf=self.perf)
             except IOError:
                 raise ShardReadError(
                     f"cannot reconstruct {oid}: "
@@ -2121,7 +2127,11 @@ class ECBackend:
         while True:
             avail = [i for i in range(self.n)
                      if i not in lost or i in stray_avail]
-            need = self.ec.minimum_to_decode(lost, avail)
+            # memoized: a 1000-object drain with one failure pattern
+            # derives the read set once (retry loops shrink avail,
+            # which is a new cache key — the fallback stays intact)
+            need = minimum_to_decode_cached(
+                self.ec, lost, avail, perf=self.perf)
             reads = await asyncio.gather(*(
                 read_source(s) for s in need
             ), return_exceptions=True)
@@ -2176,6 +2186,305 @@ class ECBackend:
             # but dropping is unconditionally safe)
             for s in lost:
                 self.resident.drop(self.resident_ns, oid, s)
+
+    # -- batched recovery (the repair engine's data path) -----------------
+    async def recover_batch(self, names: Sequence[str],
+                            lost: Sequence[int],
+                            versions: Mapping[str, int] | None = None
+                            ) -> dict:
+        """Rebuild ``lost`` shard positions of MANY objects through
+        shared decode launches (the RepairScheduler's entry point).
+
+        All objects must share the failure pattern ``lost``; the repair
+        strategy — plain-RS read set, LRC group-local reads, or CLAY
+        helper sub-chunk plane reads — is planned once per (codec,
+        lost, avail) and applied batch-wide.  Objects the batch cannot
+        serve (metadata/read/write failure, zero length) are simply NOT
+        in the returned ``recovered`` list; the caller demotes them to
+        the per-object ``recover_shard`` path, which retries, shrinks
+        read sets, and pulls stray sources.  Returns::
+
+            {"recovered": [names...], "strategy": "rs|lrc|clay",
+             "batches": <decode launches issued>}
+        """
+        async with self._track_op():
+            return await self._recover_batch_impl(
+                list(names), list(lost), dict(versions or {}))
+
+    async def _recover_batch_impl(self, names: list, lost: list,
+                                  versions: dict) -> dict:
+        lost = sorted({int(s) for s in lost})
+        avail = [i for i in range(self.n) if i not in lost]
+        # strategy selection + memoized plan: IOError (loss beyond
+        # repair) propagates — the whole batch demotes
+        plan = plan_repair(self.ec, lost, avail, perf=self.perf)
+        metas: dict[str, ECObjectMeta] = {}
+        by_len: dict[int, list[str]] = {}
+        for name in names:
+            try:
+                meta = await self._target_meta(
+                    name, versions.get(name) or None)
+            except ShardReadError:
+                meta = None
+            if meta is None or meta.size <= 0:
+                continue          # demote: classic path probes strays
+            metas[name] = meta
+            by_len.setdefault(
+                self.sinfo.logical_to_next_chunk_offset(meta.size), []
+            ).append(name)
+        recovered: list[str] = []
+        batches = 0
+        for shard_len, group in sorted(by_len.items()):
+            done = await self._repair_group(
+                group, lost, plan, shard_len, metas)
+            recovered.extend(done)
+            if done:
+                batches += 1
+        return {"recovered": recovered, "strategy": plan.strategy,
+                "batches": batches}
+
+    async def _repair_group(self, group: list, lost: list,
+                            plan: RepairPlan, shard_len: int,
+                            metas: dict) -> list:
+        """One uniform-shard-length batch: bulk survivor fetch, ONE
+        decode launch, rebuilt-shard fan-out.  Returns the names that
+        completed end to end."""
+        import contextlib
+
+        C = self.sinfo.chunk_size
+        nstripes = shard_len // C
+        read_set = list(plan.read_set)
+        span = (self.tracer.span(
+            "osd:ec:repair_batch", current_span(),
+            objects=len(group), strategy=plan.strategy,
+            lost=",".join(str(s) for s in lost), shard_len=shard_len,
+        ) if self.tracer is not None else contextlib.nullcontext())
+        with span:
+            if plan.strategy == "clay":
+                ok, payload = await self._repair_fetch_clay(
+                    group, plan, shard_len, nstripes, metas)
+            else:
+                ok, payload = await self._repair_fetch_whole(
+                    group, read_set, shard_len, nstripes, metas)
+            if not ok:
+                return []
+            per_obj_read = (
+                len(read_set) * shard_len if plan.strategy != "clay"
+                else len(read_set) * nstripes
+                * len(plan.planes) * (C // plan.sub_chunk_no))
+            whole = self.k * shard_len
+            self.perf.inc("ec_repair_read_bytes",
+                          per_obj_read * len(ok))
+            self.perf.inc("ec_repair_read_bytes_saved",
+                          max(0, whole - per_obj_read) * len(ok))
+            if plan.strategy == "rs":
+                out = ("rs", self._repair_batched_rs(
+                    ok, payload, read_set, nstripes))
+            elif plan.strategy == "lrc":
+                out = await self._repair_decode_lrc(
+                    ok, payload, plan, nstripes)
+            else:
+                out = await self._repair_decode_clay(
+                    ok, payload, plan, nstripes)
+            self.perf.inc("ec_repair_batches")
+            done = await self._repair_writeout(
+                ok, lost, read_set, out, shard_len, nstripes)
+            self.perf.inc("ec_repair_objects", len(done))
+            self.perf.inc("ec_repair_rebuild_bytes",
+                          shard_len * len(lost) * len(done))
+            return done
+
+    async def _repair_fetch_whole(self, group, read_set, shard_len,
+                                  nstripes, metas):
+        """Vectored survivor pull, whole shards (rs/lrc strategies):
+        every (object, survivor) read runs concurrently; an object with
+        any failed read drops out of the batch (demoted).  With the
+        device-resident cache on, fetched streams install in one
+        vectored pass and the decode consumes the SAME device arrays —
+        zero re-upload into the launch."""
+        async def read_obj(oid):
+            reads = await asyncio.gather(*(
+                self._read_shard_range(s, oid, 0, shard_len, shard_len,
+                                       metas[oid].version)
+                for s in read_set
+            ), return_exceptions=True)
+            if any(isinstance(r, BaseException) for r in reads):
+                return None
+            return reads
+
+        per_obj = await asyncio.gather(*(read_obj(o) for o in group))
+        ok = [o for o, r in zip(group, per_obj) if r is not None]
+        payload = {o: r for o, r in zip(group, per_obj)
+                   if r is not None}
+        if payload and self.resident is not None:
+            entries = []
+            for oid, reads in payload.items():
+                devs = [self._to_device(r) for r in reads]
+                payload[oid] = devs
+                entries.extend(
+                    (oid, s, d, metas[oid].version)
+                    for s, d in zip(read_set, devs))
+            self.resident.install_batch(self.resident_ns, entries)
+        return ok, payload
+
+    async def _repair_fetch_clay(self, group, plan, shard_len,
+                                 nstripes, metas):
+        """Vectored helper sub-chunk pull (clay strategy): each helper
+        contributes only its repair planes — 1/q of its bytes — via
+        ranged reads (consecutive planes coalesce into one range)."""
+        from ceph_tpu.parallel.clay_sharding import clay_plane_ranges
+
+        C = self.sinfo.chunk_size
+        sc = C // plan.sub_chunk_no
+        sorted_planes = sorted(plan.planes)
+        ranges = clay_plane_ranges(sorted_planes, sc)
+        # ranged reads arrive in ascending-plane order; reindex into
+        # the operator's plane order (R's input layout)
+        order = [sorted_planes.index(p) for p in plan.planes]
+
+        async def read_helper(oid, h):
+            meta = metas[oid]
+            block = np.empty((nstripes, len(sorted_planes), sc),
+                             np.uint8)
+            version: int | None = meta.version
+            for t in range(nstripes):
+                col = 0
+                for off, ln in ranges:
+                    arr = self._to_host(await self._read_shard_range(
+                        h, oid, t * C + off, ln, shard_len, version))
+                    version = None    # one version check per shard
+                    rows = ln // sc
+                    block[t, col:col + rows] = arr.reshape(rows, sc)
+                    col += rows
+            return block[:, order]
+
+        async def read_obj(oid):
+            blocks = await asyncio.gather(*(
+                read_helper(oid, h) for h in plan.read_set
+            ), return_exceptions=True)
+            if any(isinstance(b, BaseException) for b in blocks):
+                return None
+            # (nstripes, d, P, sc) -> (nstripes, d*P, sc): the helper-
+            # major stacking clay_repair_operator probed R against
+            flat = np.stack(blocks, axis=1)
+            return flat.reshape(nstripes, -1, sc)
+
+        per_obj = await asyncio.gather(*(read_obj(o) for o in group))
+        ok = [o for o, r in zip(group, per_obj) if r is not None]
+        return ok, {o: r for o, r in zip(group, per_obj)
+                    if r is not None}
+
+    def _repair_batched_rs(self, ok, payload, read_set, nstripes):
+        """Assemble the rs strategy's batched decode input: every
+        object's stripes concatenate along the batch axis, keyed by
+        survivor shard id.  The decode itself goes through
+        ``_coalesced_decode`` (in writeout), so the launch may merge
+        with other in-flight groups in the CoalescedLauncher /
+        MeshCoalescer window — the cross-PG coalescing leg."""
+        C = self.sinfo.chunk_size
+        any_dev = any(self._is_device(c)
+                      for oid in ok for c in payload[oid])
+        if any_dev:
+            import jax.numpy as jnp
+            return {s: jnp.concatenate(
+                [self._to_device(payload[oid][j]).reshape(nstripes, C)
+                 for oid in ok], axis=0)
+                for j, s in enumerate(read_set)}
+        return {s: np.concatenate(
+            [payload[oid][j].reshape(nstripes, C) for oid in ok],
+            axis=0)
+            for j, s in enumerate(read_set)}
+
+    async def _repair_decode_lrc(self, ok, payload, plan, nstripes):
+        """LRC group-local decode: one (1, L) GF(2^8) apply recovers
+        every stripe of every object in the batch."""
+        from ceph_tpu.parallel.lrc_sharding import \
+            batched_lrc_group_repair
+
+        C = self.sinfo.chunk_size
+        stacked = np.concatenate([
+            np.stack([self._to_host(a).reshape(nstripes, C)
+                      for a in payload[oid]], axis=1)
+            for oid in ok
+        ], axis=0)                            # (b, L, C)
+        self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_resident_h2d_bytes", stacked.nbytes)
+        t0 = time.perf_counter()
+        rec = await asyncio.to_thread(
+            batched_lrc_group_repair, self.ec, plan.matrix, stacked)
+        self.perf.hinc("ec_decode_launch_us",
+                       (time.perf_counter() - t0) * 1e6)
+        self.perf.inc("ec_resident_d2h_bytes", rec.nbytes)
+        return rec
+
+    async def _repair_decode_clay(self, ok, payload, plan, nstripes):
+        """CLAY plane decode: one (sub, d*P) GF(2^8) apply over the
+        gathered repair planes recovers the whole batch."""
+        from ceph_tpu.parallel.clay_sharding import \
+            batched_clay_plane_repair
+
+        flat = np.concatenate([payload[oid] for oid in ok], axis=0)
+        self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_resident_h2d_bytes", flat.nbytes)
+        t0 = time.perf_counter()
+        rec = await asyncio.to_thread(
+            batched_clay_plane_repair, self.ec, plan.matrix, flat)
+        self.perf.hinc("ec_decode_launch_us",
+                       (time.perf_counter() - t0) * 1e6)
+        self.perf.inc("ec_resident_d2h_bytes", rec.nbytes)
+        return rec
+
+    async def _repair_writeout(self, ok, lost, read_set, out,
+                               shard_len, nstripes):
+        """Fan the rebuilt shards out, per object: full attr set copied
+        from a version-verified survivor (rebuilt shards missing user
+        xattrs would serve stale attr reads), then write_shard to every
+        lost position and drop superseded resident entries."""
+        decoded = out
+        if isinstance(out, tuple):      # rs path: decode HERE so the
+            _, batched = out            # strategy paths share writeout
+            decoded = await self._coalesced_decode(batched, lost)
+        done: list = []
+
+        async def finish(idx, oid):
+            try:
+                good = read_set[0]
+                getattrs = getattr(self.shards[good], "get_attrs",
+                                   None)
+                if getattrs is not None:
+                    attrs = dict(await getattrs(oid))
+                else:
+                    attrs = {
+                        VERSION_ATTR: await self.shards[good].get_attr(
+                            oid, VERSION_ATTR),
+                        HINFO_ATTR: await self.shards[good].get_attr(
+                            oid, HINFO_ATTR),
+                    }
+                lo, hi = idx * nstripes, (idx + 1) * nstripes
+
+                def shard_bytes(w):
+                    if isinstance(decoded, dict):
+                        sl = decoded[w][lo:hi]
+                    else:
+                        sl = decoded[lo:hi]   # single-loss (b, C)
+                    return np.ascontiguousarray(
+                        self._to_host(sl)).tobytes()
+
+                await asyncio.gather(*(
+                    self.shards[s].write_shard(
+                        oid, 0, shard_bytes(s), attrs)
+                    for s in lost
+                ))
+            except (ShardReadError, IOError, KeyError):
+                return
+            if self.resident is not None:
+                for s in lost:
+                    self.resident.drop(self.resident_ns, oid, s)
+            done.append(oid)
+
+        await asyncio.gather(*(
+            finish(i, oid) for i, oid in enumerate(ok)))
+        return done
 
     # -- scrub -----------------------------------------------------------
     async def scrub(self, oid: str) -> dict:

@@ -81,6 +81,12 @@ class MeshCoalescer:
         self._appliers: dict[tuple, object] = {}
         self._enc_appliers: dict[tuple, object] = {}  # pinned per sig
         self._repair_meshes: dict[tuple, object] = {}
+        # sub-chunk repair mesh grants: how often a clay/lrc repair —
+        # degraded read OR the batched rebuild engine — was handed a
+        # mesh (vs None geometry refusals).  The repair engine's
+        # observability rides here so `ec mesh stats` shows whether
+        # rebuild traffic reached the interconnect.
+        self.repair_mesh_grants = 0
         self._items: dict[tuple, list[_MeshItem]] = {}
         self._npending = 0
         self._nstripes = 0
@@ -179,6 +185,8 @@ class MeshCoalescer:
                     break
             self._repair_meshes[key] = (
                 make_ec_mesh(devs, cs=cs) if cs >= 2 else None)
+        if self._repair_meshes[key] is not None:
+            self.repair_mesh_grants += 1
         return self._repair_meshes[key]
 
     def lrc_repair_mesh(self, groups: int):
@@ -194,6 +202,8 @@ class MeshCoalescer:
                     and len(devs) // groups >= 2:
                 mesh = make_group_mesh(devs, groups)
             self._repair_meshes[key] = mesh
+        if self._repair_meshes[key] is not None:
+            self.repair_mesh_grants += 1
         return self._repair_meshes[key]
 
     # -- submit/flush (CoalescedLauncher's adaptive window, host-wide) ----
@@ -550,6 +560,7 @@ class MeshCoalescer:
             "occupancy": (self.ops / self.launches
                           if self.launches else 0.0),
             "cross_backend_launches": self.cross_backend_launches,
+            "repair_mesh_grants": self.repair_mesh_grants,
             "max_backends_in_launch": self.max_backends_in_launch,
             "solo_retries": self.solo_retries,
             "failed_ops": self.failed_ops,

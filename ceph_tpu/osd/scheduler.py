@@ -61,6 +61,7 @@ class _Req:
     l_tag: float
     p_tag: float
     fut: asyncio.Future
+    cost: int = 1
 
 
 class MClockScheduler:
@@ -76,19 +77,26 @@ class MClockScheduler:
         self._stopped = False
 
     # -- submission --------------------------------------------------------
-    async def acquire(self, clazz: str) -> None:
+    async def acquire(self, clazz: str, cost: int = 1) -> None:
         """Wait for this op's dispatch slot. Ops of an unknown class run
-        immediately (fail-open: QoS must never wedge the data path)."""
+        immediately (fail-open: QoS must never wedge the data path).
+
+        ``cost`` charges one submission as that many class-ops against
+        the R/W/L clocks — a batched request (the repair engine drains
+        dozens of objects per launch) advances the tags as if each
+        member had queued individually, so batching cannot be used to
+        sneak recovery work past the class's configured rates."""
         prof = self.profiles.get(clazz)
         if prof is None or self._stopped:
             return
+        cost = max(1, int(cost))
         now = self.clock()
         pr, pl, pp = self._prev.get(clazz, (0.0, 0.0, 0.0))
-        r_tag = (max(now, pr + 1.0 / prof.reservation)
+        r_tag = (max(now, pr + cost / prof.reservation)
                  if prof.reservation > 0 else _INF)
-        l_tag = (max(now, pl + 1.0 / prof.limit)
+        l_tag = (max(now, pl + cost / prof.limit)
                  if prof.limit > 0 else now)
-        p_tag = (max(now, pp + 1.0 / prof.weight)
+        p_tag = (max(now, pp + cost / prof.weight)
                  if prof.weight > 0 else _INF)
         self._prev[clazz] = (
             r_tag if r_tag != _INF else pr,
@@ -97,7 +105,7 @@ class MClockScheduler:
         )
         fut = asyncio.get_running_loop().create_future()
         self._queues.setdefault(clazz, deque()).append(
-            _Req(r_tag, l_tag, p_tag, fut)
+            _Req(r_tag, l_tag, p_tag, fut, cost)
         )
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(
@@ -127,7 +135,9 @@ class MClockScheduler:
         req = self._queues[clazz].popleft()
         if not req.fut.done():
             req.fut.set_result(None)
-            self._dispatched[clazz] = self._dispatched.get(clazz, 0) + 1
+            self._dispatched[clazz] = (
+                self._dispatched.get(clazz, 0) + req.cost
+            )
 
     async def _dispatch_loop(self) -> None:
         while not self._stopped:

@@ -74,6 +74,46 @@ def sharded_clay_repair(mesh, ec, chunks, lost: int) -> jax.Array:
     return step(dev)
 
 
+def clay_plane_ranges(planes, sc: int) -> list[tuple[int, int]]:
+    """Coalesce repair-plane indices into (offset, length) byte ranges
+    inside ONE stripe's chunk bytes (the (sub_chunk_no, sc) layout).
+
+    The repair engine reads survivor shards by these ranges instead of
+    whole chunks — consecutive planes merge into one ranged read, so a
+    q=4 profile issues at most sub_chunk_no/q reads per helper stripe
+    and ships exactly 1/q of the helper's bytes."""
+    runs: list[tuple[int, int]] = []
+    start = prev = None
+    for p in sorted(int(x) for x in planes):
+        if prev is not None and p == prev + 1:
+            prev = p
+            continue
+        if start is not None:
+            runs.append((start * sc, (prev - start + 1) * sc))
+        start = prev = p
+    if start is not None:
+        runs.append((start * sc, (prev - start + 1) * sc))
+    return runs
+
+
+def batched_clay_plane_repair(ec, R, helper_planes) -> np.ndarray:
+    """Recover a batch of lost chunks from pre-extracted helper planes.
+
+    ``helper_planes``: (b, d*P, sc) uint8 — each row stacks the d
+    helpers' P repair planes in helper-ascending order (the layout
+    ``clay_repair_operator`` probed R against).  Returns (b, C)
+    recovered chunks, bit-identical to the plugin repair.  ONE engine
+    apply for the whole batch — the repair engine's CLAY decode."""
+    helper_planes = np.asarray(helper_planes, np.uint8)
+    if helper_planes.ndim != 3:
+        raise ValueError(
+            f"helper_planes shape {helper_planes.shape} != (b, d*P, sc)"
+        )
+    b, _, sc = helper_planes.shape
+    rec = default_engine().apply(np.asarray(R, np.uint8), helper_planes)
+    return np.asarray(rec, np.uint8).reshape(b, ec.sub_chunk_no * sc)
+
+
 def clay_repair_ici_bytes(ec, n_helpers: int, batch: int,
                           chunk_size: int) -> tuple[int, int]:
     """(moved, whole) modeled interconnect bytes for one sub-chunk

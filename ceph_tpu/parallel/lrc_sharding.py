@@ -112,6 +112,25 @@ def sharded_lrc_repair(mesh, ec, chunks, lost: int) -> np.ndarray:
     return np.asarray(step(dev)[:, g_lost, 0])
 
 
+def batched_lrc_group_repair(ec, coeffs, group_chunks) -> np.ndarray:
+    """Recover a batch of lost chunks from their local-group members.
+
+    ``group_chunks``: (b, L, C) uint8 — the ``minimum`` chunks of each
+    stripe in ``lrc_repair_operator`` order.  Returns (b, C), bit-
+    identical to the plugin's cheapest-layer decode.  ONE engine apply
+    for the whole batch — the repair engine's LRC decode (only the
+    local group was ever read; the k-L remote chunks never moved)."""
+    group_chunks = np.asarray(group_chunks, np.uint8)
+    if group_chunks.ndim != 3:
+        raise ValueError(
+            f"group_chunks shape {group_chunks.shape} != (b, L, C)"
+        )
+    rec = default_engine().apply(
+        np.asarray(coeffs, np.uint8), group_chunks)
+    return np.asarray(rec, np.uint8).reshape(
+        group_chunks.shape[0], group_chunks.shape[2])
+
+
 def lrc_repair_ici_bytes(ec, n_helpers: int, batch: int,
                          chunk_size: int) -> tuple[int, int]:
     """(moved, whole) modeled interconnect bytes for one group-local

@@ -136,6 +136,20 @@ class DeviceShardCache:
         self._entries[key] = ent
         self.bytes += ent.nbytes
 
+    def install_batch(self, ns, entries) -> int:
+        """Vectored install: ``entries`` is an iterable of
+        ``(oid, shard, arr, version)`` tuples, installed clean in one
+        call.  The repair engine's bulk survivor pull lands here — the
+        fetched shard streams become resident in the same pass that
+        feeds the batched decode launch, so the decode consumes the
+        already-placed device arrays with zero re-upload.  Returns the
+        number of entries installed."""
+        count = 0
+        for oid, shard, arr, version in entries:
+            self.put(ns, oid, shard, arr, version)
+            count += 1
+        return count
+
     # -- invalidation -----------------------------------------------------
 
     def drop(self, ns, oid, shard) -> None:

@@ -5,8 +5,13 @@ src/test/osd/RadosModel.h model-based op generator) as in-process
 tools driving a DevCluster.
 """
 
-from ceph_tpu.testing.chaos import ChaosHarness, run_chaos
+from ceph_tpu.testing.chaos import (
+    ChaosHarness,
+    run_chaos,
+    run_host_failure_drill,
+)
 from ceph_tpu.testing.rados_model import RadosModel
 from ceph_tpu.testing.thrasher import Thrasher
 
-__all__ = ["ChaosHarness", "RadosModel", "Thrasher", "run_chaos"]
+__all__ = ["ChaosHarness", "RadosModel", "Thrasher", "run_chaos",
+           "run_host_failure_drill"]

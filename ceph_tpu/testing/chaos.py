@@ -144,3 +144,116 @@ class ChaosHarness:
 async def run_chaos(seed: int = 0, **kw) -> dict:
     """One deterministic chaos run; see ChaosHarness."""
     return await ChaosHarness(seed=seed, **kw).run()
+
+
+async def run_host_failure_drill(seed: int = 0, hosts: int = 4,
+                                 osds_per_host: int = 2,
+                                 n_objects: int = 48,
+                                 victim: str = "host1") -> dict:
+    """Full-host-failure drill: every OSD on one CRUSH host dies at
+    once, seeded client load keeps writing through the degraded
+    window, and the revived host's shards converge through the batched
+    repair engine — the rack-power-pull scenario the per-object
+    recovery loop handles one solo launch at a time.
+
+    The EC pool is jax_rs k=2 m=1 over ``crush-failure-domain host``,
+    so losing one host costs each PG at most one shard: client writes
+    continue degraded, and every object written through the window
+    shares the SAME lost-shard pattern per PG — exactly the grouping
+    the engine batches.  Asserts:
+
+    - client ops complete during the degraded window AND during the
+      rebuild (mClock recovery pacing: no starvation);
+    - the repair engine actually drained batches (summed
+      ``ec_repair_batches``/``ec_repair_objects`` deltas > 0);
+    - every object reads back bit-identical after HEALTH_OK.
+    """
+    import asyncio
+
+    import numpy as np
+
+    from ceph_tpu.vstart import DevCluster
+
+    fp.fp_clear()
+    rng = np.random.default_rng(seed)
+    cluster = DevCluster(
+        n_mons=1, n_osds=hosts * osds_per_host,
+        osds_per_host=osds_per_host,
+        overrides={
+            "mon_osd_down_out_interval": 300.0,   # revive, don't remap
+        },
+    )
+    await cluster.start()
+    rados = await cluster.client()
+    out: dict = {"seed": seed, "victim": victim,
+                 "osds": hosts * osds_per_host}
+    try:
+        r = await rados.mon_command(
+            "osd erasure-code-profile set", name="hostdrill",
+            profile={"plugin": "jax_rs", "k": "2", "m": "1",
+                     "crush-failure-domain": "host"})
+        assert r["rc"] in (0, -17), r
+        await rados.pool_create("hostdrill", pg_num=8,
+                                pool_type="erasure",
+                                erasure_code_profile="hostdrill")
+        io = await rados.open_ioctx("hostdrill")
+
+        def payload() -> bytes:
+            return rng.integers(0, 256, 4096, np.uint8).tobytes()
+
+        # steady-state objects, written healthy
+        datas = {f"pre-{i}": payload() for i in range(n_objects // 2)}
+        await asyncio.gather(*(
+            io.write_full(o, d) for o, d in datas.items()))
+
+        killed = await cluster.kill_host(victim)
+        assert killed, f"no OSDs on {victim}"
+        out["killed_osds"] = killed
+
+        # the degraded window: seeded load MUST keep completing while
+        # a whole host is dark (k survivors per stripe exist)
+        degraded = {f"deg-{i}": payload()
+                    for i in range(n_objects // 2)}
+        await asyncio.wait_for(asyncio.gather(*(
+            io.write_full(o, d) for o, d in degraded.items())),
+            timeout=60)
+        datas.update(degraded)
+        out["degraded_writes"] = len(degraded)
+
+        def summed(key: str) -> float:
+            return float(sum(osd.perf.dump().get(key, 0)
+                             for osd in cluster.osds.values()))
+
+        batches0 = summed("ec_repair_batches")
+        objects0 = summed("ec_repair_objects")
+
+        # lights back on: the revived OSDs peer with stale logs and
+        # the primaries drain their missing sets through the engine
+        for osd_id in killed:
+            await cluster.revive_osd(osd_id)
+
+        # client reads DURING the rebuild: mClock's recovery class may
+        # not starve them (a stuck gather here is the starvation bug)
+        probe = list(datas)[: 8]
+        got = await asyncio.wait_for(asyncio.gather(*(
+            io.read(o) for o in probe)), timeout=60)
+        for o, g in zip(probe, got):
+            assert g == datas[o], f"mid-rebuild read mismatch on {o}"
+        out["mid_rebuild_reads"] = len(probe)
+
+        await cluster.wait_health_ok(timeout=60)
+
+        out["repair_batches"] = summed("ec_repair_batches") - batches0
+        out["repair_objects"] = summed("ec_repair_objects") - objects0
+        assert out["repair_batches"] > 0, (
+            "rebuild never used the batched repair engine")
+        assert out["repair_objects"] > 0, out
+
+        for o, d in datas.items():
+            got = await io.read(o)
+            assert got == d, f"post-rebuild read mismatch on {o}"
+        out["verified"] = len(datas)
+        return out
+    finally:
+        await rados.shutdown()
+        await cluster.stop()

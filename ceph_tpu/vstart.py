@@ -34,13 +34,16 @@ class DevCluster:
                  base_port: int = 21000, store_dir: str | None = None,
                  store_kind: str = "wal",
                  cephx: bool = False, ns: str = "",
-                 monmap: dict[str, str] | None = None):
+                 monmap: dict[str, str] | None = None,
+                 osds_per_host: int = 1):
         """``ns``: local:// address namespace prefix so several
         DevClusters (zones) can coexist in one process (the multi-zone
         / geo-replication test topology).  ``monmap``: explicit
         name->addr map overriding the generated one — the DR restart
         path boots a rebuilt cluster against a monmaptool-authored
-        quorum this way."""
+        quorum this way.  ``osds_per_host``: pack that many OSDs onto
+        each CRUSH host (host{id // osds_per_host}) so failure-domain
+        host rules and whole-host failure drills have real topology."""
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.overrides = dict(FAST_TEST_OVERRIDES)
@@ -66,6 +69,7 @@ class DevCluster:
         if monmap is not None:
             self.monmap = dict(monmap)
         self.ns = ns
+        self.osds_per_host = max(1, int(osds_per_host))
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSDDaemon] = {}
         self.mdss: dict[str, "object"] = {}
@@ -148,7 +152,7 @@ class DevCluster:
         osd = OSDDaemon(
             osd_id, self.monmap, self.conf_for(f"osd.{osd_id}"),
             store=store,
-            addr=self._osd_addr(osd_id), host=f"host{osd_id}",
+            addr=self._osd_addr(osd_id), host=self.host_of(osd_id),
         )
         await osd.start()
         self.osds[osd_id] = osd
@@ -186,6 +190,26 @@ class DevCluster:
     async def revive_osd(self, osd_id: int) -> OSDDaemon:
         """Restart with the surviving store (revive_osd :480)."""
         return await self.start_osd(osd_id)
+
+    # -- host topology -----------------------------------------------------
+    def host_of(self, osd_id: int) -> str:
+        """CRUSH host name an OSD registers under."""
+        return f"host{osd_id // self.osds_per_host}"
+
+    def osds_on_host(self, host: str) -> list[int]:
+        """OSD ids placed on ``host`` (running or not)."""
+        return [i for i in range(self.n_osds) if self.host_of(i) == host]
+
+    async def kill_host(self, host: str) -> list[int]:
+        """Hard-stop every OSD on one CRUSH host at once — the full-
+        host-failure drill (rack power pull).  Returns the killed OSD
+        ids so the driver can later revive them individually."""
+        killed = []
+        for osd_id in self.osds_on_host(host):
+            if osd_id in self.osds:
+                await self.kill_osd(osd_id)
+                killed.append(osd_id)
+        return killed
 
     async def start_mds(self, name: str = "a",
                         meta_pool: str = "cephfs_meta",

@@ -1,0 +1,19 @@
+"""Optional-dependency gates for the suite.
+
+The container may lack the optional wheels (zstandard for the zstd
+compressor tier, cryptography for cephx/secure-mode/SSE).  Tests that
+exercise those paths skip — with the reason naming the wheel — instead
+of failing on an import deep inside the stack.
+"""
+
+import importlib.util
+
+import pytest
+
+HAVE_ZSTD = importlib.util.find_spec("zstandard") is not None
+HAVE_CRYPTOGRAPHY = importlib.util.find_spec("cryptography") is not None
+
+requires_zstd = pytest.mark.skipif(
+    not HAVE_ZSTD, reason="zstandard not installed")
+requires_cryptography = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY, reason="cryptography not installed")

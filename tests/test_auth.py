@@ -8,6 +8,8 @@ import time
 
 import pytest
 
+from tests._deps import requires_cryptography
+
 from ceph_tpu.client.rados import RadosError
 from ceph_tpu.mon.auth_monitor import (
     cap_allows,
@@ -126,6 +128,7 @@ def test_cephx_end_to_end():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_cephx_wrong_key_rejected():
     async def run():
         cluster = DevCluster(n_mons=1, n_osds=3, cephx=True)

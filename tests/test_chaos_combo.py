@@ -11,6 +11,8 @@ import asyncio
 
 import pytest
 
+from tests._deps import requires_cryptography
+
 from ceph_tpu.msg import reset_local_namespace
 from ceph_tpu.vstart import DevCluster
 
@@ -22,6 +24,7 @@ def _clean_local():
     reset_local_namespace()
 
 
+@requires_cryptography
 def test_everything_on_under_failures():
     async def run():
         cluster = DevCluster(n_mons=1, n_osds=4, cephx=True, overrides={

@@ -12,6 +12,8 @@ import asyncio
 
 import pytest
 
+from tests._deps import requires_zstd
+
 from ceph_tpu.common.compressor import (envelope_pack, envelope_unpack,
                                         get_compressor,
                                         list_compressors)
@@ -30,6 +32,7 @@ def _clean_local():
     reset_local_namespace()
 
 
+@requires_zstd
 def test_registry_round_trips_every_algorithm():
     body = b"the quick brown fox " * 999
     assert list_compressors() == ["bz2", "lzma", "zlib", "zstd"]
@@ -64,6 +67,7 @@ def _payload(i):
     return (f"object {i} ".encode() * 500)[:4096]
 
 
+@requires_zstd
 def test_walstore_inline_compression_round_trip(tmp_path):
     async def run():
         store = WalStore(str(tmp_path / "s"), compression="zstd")
@@ -150,6 +154,7 @@ def test_walstore_algorithm_migration(tmp_path):
     asyncio.run(run())
 
 
+@requires_zstd
 def test_filestore_wal_compression(tmp_path):
     async def run():
         store = FileStore(str(tmp_path / "f"), compression="zstd")
@@ -167,6 +172,7 @@ def test_filestore_wal_compression(tmp_path):
     asyncio.run(run())
 
 
+@requires_zstd
 def test_rgw_bucket_compression_zstd():
     """RGW rides the shared registry: per-bucket zstd at rest, reads
     inflate per the entry's recorded algorithm."""

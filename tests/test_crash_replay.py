@@ -16,6 +16,8 @@ import struct
 
 import pytest
 
+from tests._deps import requires_zstd
+
 from ceph_tpu.msg import reset_local_namespace
 from ceph_tpu.store import (
     CollectionId,
@@ -201,7 +203,7 @@ def _expected_prefix(frame_ends, prefixes, cut: int) -> dict:
 
 
 @pytest.mark.parametrize("kind", ["python", "native", "file",
-                                  "zstd"])
+                                  pytest.param("zstd", marks=requires_zstd)])
 def test_crash_replay_every_tail_byte(tmp_path, kind):
     """Truncate at EVERY byte boundary of the last two frames plus every
     frame boundary in the log: recovered state must equal the committed
@@ -226,7 +228,8 @@ def test_crash_replay_every_tail_byte(tmp_path, kind):
         assert got == want, f"cut={cut}: state diverged from prefix"
 
 
-@pytest.mark.parametrize("kind", ["python", "native", "zstd"])
+@pytest.mark.parametrize("kind", ["python", "native",
+                                  pytest.param("zstd", marks=requires_zstd)])
 def test_crash_between_append_and_apply(tmp_path, kind):
     """A frame fully appended but the process killed before ack (the
     append-then-apply window): on remount the transaction IS recovered —
@@ -242,7 +245,8 @@ def test_crash_between_append_and_apply(tmp_path, kind):
             f"frame {i}: fully-appended txn not recovered"
 
 
-@pytest.mark.parametrize("kind", ["python", "native", "zstd"])
+@pytest.mark.parametrize("kind", ["python", "native",
+                                  pytest.param("zstd", marks=requires_zstd)])
 def test_crash_replay_corrupt_interior_bit(tmp_path, kind):
     """A flipped bit INSIDE an interior frame ends replay at the longest
     valid prefix before it (crc discipline), never applies garbage."""

@@ -11,6 +11,8 @@ import hashlib
 
 import pytest
 
+from tests._deps import requires_cryptography
+
 from ceph_tpu.msg import reset_local_namespace
 from ceph_tpu.services.rgw import RGWError, RGWLite
 from ceph_tpu.vstart import DevCluster
@@ -229,6 +231,7 @@ def test_upload_part_copy():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_upload_part_copy_sse_and_ranges():
     async def run():
         mon, osds, rados = await start_cluster()

@@ -5,6 +5,8 @@ import asyncio
 
 import pytest
 
+from tests._deps import requires_cryptography
+
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.mon import MonClient, Monitor, MonitorDBStore
 from ceph_tpu.mon.store import StoreTransaction
@@ -285,6 +287,7 @@ def test_client_subscription_and_config_push():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_auth_shared_key():
     async def run():
         key_conf = lambda: fast_conf(auth_shared_key="sekret")  # noqa: E731

@@ -13,6 +13,8 @@ import time
 
 import pytest
 
+from tests._deps import requires_cryptography
+
 from ceph_tpu.msg import reset_local_namespace
 from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers
 from tests.test_services import start_cluster, stop_cluster
@@ -336,6 +338,7 @@ def test_bucket_compression_at_rest():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_streaming_put_compresses_at_rest():
     """Streaming PUTs deflate in flight: large bodies ride the striper
     at compressed offsets, small ones compress at complete() like the
@@ -427,6 +430,7 @@ def test_streaming_put_compresses_at_rest():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_multipart_sse_c():
     """SSE-C across multipart uploads (rgw_crypt.cc multipart rule):
     each part encrypts under its own nonce at part-relative offsets,

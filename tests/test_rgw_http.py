@@ -9,6 +9,8 @@ import xml.etree.ElementTree as ET
 
 import pytest
 
+from tests._deps import requires_cryptography
+
 from ceph_tpu.msg import reset_local_namespace
 from ceph_tpu.services.rgw import RGWLite, RGWUsers
 from ceph_tpu.services.rgw_http import S3Frontend, _Request, sigv4_sign
@@ -384,6 +386,7 @@ def test_streaming_put_and_get():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_sse_c_roundtrip():
     """SSE-C (rgw_crypt.cc role): the stored bytes are ciphertext, GET
     with the right key decrypts (including ranges), wrong/missing keys
@@ -475,6 +478,7 @@ def test_aborted_streaming_put_preserves_old_object():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_sse_c_versioned_get():
     """GET/HEAD ?versionId enforce SSE-C too: no key (or a wrong key)
     must never leak ciphertext with a 200."""
@@ -616,6 +620,7 @@ def test_notification_rest_and_sts_signed_request():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_multipart_sse_c_over_rest():
     """SSE-C headers on UploadPart encrypt each part; the assembled
     object GETs back (full + seam-spanning range) only with the key."""

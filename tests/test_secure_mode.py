@@ -10,6 +10,8 @@ import asyncio
 
 import pytest
 
+from tests._deps import requires_cryptography
+
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.msg import reset_local_namespace
 from ceph_tpu.msg.message import Message
@@ -46,6 +48,7 @@ class Sink:
         pass
 
 
+@requires_cryptography
 def test_secure_roundtrip_and_ciphertext_on_wire():
     async def run():
         sink = Sink()
@@ -85,6 +88,7 @@ def test_mixed_mode_refused():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_wrong_key_cannot_talk():
     async def run():
         sink = Sink()
@@ -104,6 +108,7 @@ def test_wrong_key_cannot_talk():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_reconnect_rekeys_and_replays_losslessly():
     """Every (re)connection derives a FRESH key (per-session salts), so
     seq-based GCM nonces never repeat under one key — and the lossless
@@ -137,6 +142,7 @@ def test_reconnect_rekeys_and_replays_losslessly():
     asyncio.run(run())
 
 
+@requires_cryptography
 def test_secure_cluster_end_to_end():
     async def run():
         cluster = DevCluster(n_mons=1, n_osds=3, tcp=True,

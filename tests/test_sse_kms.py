@@ -13,6 +13,10 @@ import xml.etree.ElementTree as ET
 
 import pytest
 
+from tests._deps import requires_cryptography
+
+pytestmark = requires_cryptography
+
 from ceph_tpu.msg import reset_local_namespace
 from ceph_tpu.services.kms import ConfigKeyKMS, KMSError, LocalKMS
 from ceph_tpu.services.rgw import RGWError, RGWLite, RGWUsers

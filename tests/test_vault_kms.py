@@ -9,6 +9,10 @@ import json
 
 import pytest
 
+from tests._deps import requires_cryptography
+
+pytestmark = requires_cryptography
+
 from ceph_tpu.msg import reset_local_namespace
 from ceph_tpu.services.kms import KMSError, VaultKMS
 
