@@ -65,10 +65,15 @@ class Objecter:
         self._reqid_name = f"{msgr.name}.{msgr.nonce:08x}"
         self._reqid_seq = 0
         self.tracer = Tracer(msgr.name)
-        # resend/timeout observability (l_osdc_* role)
+        # resend/timeout observability (l_osdc_* role), plus the
+        # CLIENT-side latency histogram: end-to-end submit latency as
+        # the application saw it (queueing + resends + map waits
+        # included — the view the OSD-side histograms cannot have)
         self.perf = PerfCounters(f"objecter.{msgr.name}")
-        for _k in ("op_resend", "op_timeout", "map_waits", "op_remap"):
+        for _k in ("op_resend", "op_timeout", "map_waits", "op_remap",
+                   "op_error"):
             self.perf.add(_k, CounterType.U64)
+        self.perf.add("op_latency_us", CounterType.HISTOGRAM)
         # cephx: OSD sessions we have presented our service ticket on
         self._osd_authed: set[int] = set()
         self._osd_auth_futs: dict[int, asyncio.Future] = {}
@@ -173,14 +178,25 @@ class Objecter:
             timeout = float(self.monc.conf["client_op_deadline"])
         parent = current_span()
         prob = float(self.monc.conf["trace_probability"] or 0.0)
-        if parent is not None or (prob and random.random() < prob):
-            with self.tracer.span("objecter:op_submit", parent=parent,
-                                  oid=oid, pool=pool_id) as tctx:
-                return await self._op_submit_impl(
-                    pool_id, oid, ops, timeout, extra, tctx
-                )
-        return await self._op_submit_impl(pool_id, oid, ops, timeout,
-                                          extra, None)
+        t0 = time.monotonic()
+        try:
+            if parent is not None or (prob and random.random() < prob):
+                with self.tracer.span("objecter:op_submit",
+                                      parent=parent, oid=oid,
+                                      pool=pool_id) as tctx:
+                    ret = await self._op_submit_impl(
+                        pool_id, oid, ops, timeout, extra, tctx
+                    )
+            else:
+                ret = await self._op_submit_impl(pool_id, oid, ops,
+                                                 timeout, extra, None)
+        except Exception:
+            # cancellation is the caller's doing, not an op failure
+            self.perf.inc("op_error")
+            raise
+        self.perf.hinc("op_latency_us",
+                       (time.monotonic() - t0) * 1e6)
+        return ret
 
     async def _op_submit_impl(self, pool_id: int, oid: str,
                               ops: list[dict], timeout: float,

@@ -269,6 +269,42 @@ def global_options() -> list[Option]:
                "max degraded objects per batched repair launch (one "
                "mClock recovery grant at this cost paces each batch)",
                Level.ADVANCED, min=1),
+        Option("slo_put_p99_ms", float, 0.0,
+               "SLO: client write p99 latency target in ms, evaluated "
+               "from the windowed op_w_latency_us histograms (0 = "
+               "objective disabled)", min=0.0),
+        Option("slo_get_p999_ms", float, 0.0,
+               "SLO: client read p999 latency target in ms "
+               "(op_r_latency_us; 0 = disabled)", min=0.0),
+        Option("slo_error_rate", float, 0.0,
+               "SLO: max fraction of client ops failing with an IO/"
+               "protocol error over the window (0 = disabled)",
+               min=0.0, max=1.0),
+        Option("slo_rebuild_floor_gibs", float, 0.0,
+               "SLO: minimum sustained rebuild rate in GiB/s while "
+               "recovery is active — a floor, not a ceiling: rebuilding "
+               "slower stretches the degraded window (0 = disabled)",
+               min=0.0),
+        Option("slo_targets", str, "",
+               "extra free-form SLO objectives, comma/space separated "
+               "name=value pairs (e.g. 'op_p50_ms=5 get_p99_ms=20') "
+               "for quantiles outside the typed options"),
+        Option("slo_window", float, 30.0,
+               "SLO evaluation sliding window in seconds (the error "
+               "budget horizon each burn rate is measured over)",
+               min=0.1),
+        Option("slo_raise_evals", int, 2,
+               "consecutive violating evaluations before SLO_VIOLATION "
+               "raises (hysteresis: one noisy window must not flap "
+               "health)", Level.ADVANCED, min=1),
+        Option("slo_clear_evals", int, 2,
+               "consecutive clean evaluations before an active "
+               "SLO_VIOLATION clears", Level.ADVANCED, min=1),
+        Option("ec_hbm_peak_gibps", float, 763.0,
+               "accelerator HBM peak bandwidth in GiB/s (v5e ~819 GB/s "
+               "= 763 GiB/s) — the roofline the utilization telemetry "
+               "reports achieved device GiB/s against", Level.ADVANCED,
+               min=1.0),
         Option("log_to_memory_ring", bool, True, "keep crash ring buffer"),
         Option("debug_default", int, 1, "default subsystem debug level",
                min=0, max=20),

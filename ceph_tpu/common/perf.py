@@ -48,15 +48,31 @@ def bucket_le(i: int) -> float:
     return float(1 << i)
 
 
+def _hist_counts(h: dict | None) -> list[int]:
+    """Bucket list of a dumped histogram, normalized to ints and padded
+    to at least HIST_BUCKETS.  Mixed-version daemons can dump shorter or
+    longer bucket arrays (a histogram layout change mid-upgrade) — the
+    mgr merges whatever arrives, so mismatched counts must pad, never
+    raise or silently drop samples."""
+    if not h:
+        return [0] * HIST_BUCKETS
+    counts = [int(x) for x in h.get("buckets", ())]
+    if len(counts) < HIST_BUCKETS:
+        counts += [0] * (HIST_BUCKETS - len(counts))
+    return counts
+
+
 def hist_merge(a: dict | None, b: dict | None) -> dict:
     """Merge two dumped histograms (elementwise bucket sum) — the mgr
-    aggregates per-daemon dumps into cluster series with this."""
+    aggregates per-daemon dumps into cluster series with this.
+    Mismatched bucket counts merge by padding the shorter side with
+    zeros (no sample is lost, no IndexError)."""
     if not a:
         a = {"buckets": [], "sum": 0.0, "count": 0}
     if not b:
         b = {"buckets": [], "sum": 0.0, "count": 0}
-    ab, bb = list(a.get("buckets", ())), list(b.get("buckets", ()))
-    n = max(len(ab), len(bb), HIST_BUCKETS)
+    ab, bb = _hist_counts(a), _hist_counts(b)
+    n = max(len(ab), len(bb))
     ab += [0] * (n - len(ab))
     bb += [0] * (n - len(bb))
     return {
@@ -66,15 +82,39 @@ def hist_merge(a: dict | None, b: dict | None) -> dict:
     }
 
 
-def hist_quantile(h: dict, q: float) -> float:
+def hist_delta(cur: dict | None, prev: dict | None) -> dict:
+    """``cur - prev`` of two cumulative histogram dumps: the
+    distribution of ONLY the samples recorded between the two
+    snapshots.  This is the sliding-window primitive: counters are
+    monotonic, so the window histogram is the elementwise difference
+    of its edge snapshots.  Buckets clamp at 0 (a daemon restart
+    resets counters; a negative window would corrupt quantiles)."""
+    ca, cb = _hist_counts(cur), _hist_counts(prev)
+    n = max(len(ca), len(cb))
+    ca += [0] * (n - len(ca))
+    cb += [0] * (n - len(cb))
+    buckets = [max(0, x - y) for x, y in zip(ca, cb)]
+    cur = cur or {}
+    prev = prev or {}
+    return {
+        "buckets": buckets,
+        "sum": max(0.0, float(cur.get("sum", 0.0))
+                   - float(prev.get("sum", 0.0))),
+        "count": sum(buckets),
+    }
+
+
+def hist_quantile(h: dict, q: float) -> float | None:
     """Quantile estimate from a dumped histogram: locate the bucket
     holding rank q*count, linearly interpolate inside it (Prometheus
     histogram_quantile semantics).  Overflow bucket returns its lower
-    bound.  Exact and deterministic given the bucket counts."""
+    bound.  Exact and deterministic given the bucket counts.  An EMPTY
+    histogram has no quantiles: returns ``None`` (callers render it as
+    absent/0, but must not mistake it for a measured 0)."""
     counts = list(h.get("buckets", ()))
     total = sum(counts)
     if total <= 0:
-        return 0.0
+        return None
     rank = q * total
     cum = 0.0
     last = 0.0
@@ -91,6 +131,32 @@ def hist_quantile(h: dict, q: float) -> float:
         cum += c
         last = bucket_le(i)
     return last if not math.isinf(last) else bucket_le(HIST_BUCKETS - 2)
+
+
+def hist_frac_above(h: dict, threshold: float) -> float:
+    """Fraction of recorded samples whose value exceeds ``threshold``
+    — the error-budget numerator for a latency SLO (``pXX <= T`` burns
+    budget at ``frac_above(T) / (1 - 0.XX)``).  Exact when the
+    threshold sits on a log2 bucket edge; inside a bucket the count
+    splits by linear interpolation (the same uniform-within-bucket
+    assumption hist_quantile makes).  Empty histograms burn nothing."""
+    counts = list(h.get("buckets", ()))
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    above = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        lo = 0.0 if i == 0 else bucket_le(i - 1)
+        hi = bucket_le(i)
+        if threshold <= lo:
+            above += c
+        elif threshold < hi:                 # inside this bucket
+            if math.isinf(hi):
+                continue   # overflow bucket: value == lower bound
+            above += c * (hi - threshold) / (hi - lo)
+    return above / total
 
 
 def counter_scalar(val) -> float:
@@ -214,11 +280,13 @@ class PerfCounters:
 
     def quantile(self, key: str, q: float) -> float:
         """Quantile of a live HISTOGRAM counter (hist_quantile on a
-        point-in-time dump)."""
+        point-in-time dump); 0.0 when no samples were recorded yet
+        (bench/smoke callers poll before traffic lands)."""
         with self._lock:
             c = self._counters[key]
             h = {"buckets": list(c.buckets), "count": c.count}
-        return hist_quantile(h, q)
+        got = hist_quantile(h, q)
+        return 0.0 if got is None else got
 
     def reset(self) -> None:
         with self._lock:

@@ -241,12 +241,17 @@ class OSDDaemon:
         self.perf = PerfCounters(self.entity)
         for key in ("op", "op_r", "op_w", "op_in_bytes", "op_out_bytes",
                     "subop", "recovery_ops", "peer_inventory_scans",
-                    "peer_backfills", "scrub_errors"):
+                    "peer_backfills", "scrub_errors", "op_error"):
             self.perf.add(key)
         self.perf.add("op_latency", CounterType.TIME)
-        # log2 latency distribution (perf_histogram role): the tail
-        # the averages above cannot show; microseconds
+        # log2 latency distributions (perf_histogram role): the tail
+        # the averages above cannot show; microseconds.  Reads and
+        # writes also record separately — the SLO engine's put_p99 /
+        # get_p999 objectives window each side on its own (a write-amp
+        # tail must not hide inside the read distribution)
         self.perf.add("op_latency_us", CounterType.HISTOGRAM)
+        self.perf.add("op_r_latency_us", CounterType.HISTOGRAM)
+        self.perf.add("op_w_latency_us", CounterType.HISTOGRAM)
         # QoS op scheduler (mClockScheduler role) + op observability
         # (OpRequest/OpTracker role)
         from ceph_tpu.osd.scheduler import ClassProfile
@@ -3704,8 +3709,11 @@ class OSDDaemon:
                 if isinstance(res.get("data"), (bytes, bytearray)):
                     self.perf.inc("op_out_bytes", len(res["data"]))
             self.perf.tinc("op_latency", time.monotonic() - op_start)
-            self.perf.hinc("op_latency_us",
-                           (time.monotonic() - op_start) * 1e6)
+            elapsed_us = (time.monotonic() - op_start) * 1e6
+            self.perf.hinc("op_latency_us", elapsed_us)
+            self.perf.hinc(
+                "op_w_latency_us" if mutating else "op_r_latency_us",
+                elapsed_us)
             if self._perf_queries and rc == OK:
                 self._perf_query_account(
                     pg, conn, str(d.get("oid", "")), ops, results,
@@ -3713,9 +3721,11 @@ class OSDDaemon:
             self._reply(conn, tid, rc, results=results, version=version)
         except ShardReadError as e:
             log.derr("%s: osd_op IO error: %s", self.entity, e)
+            self.perf.inc("op_error")
             self._reply(conn, tid, EIO_RC)
         except (KeyError, ValueError, TypeError) as e:
             log.derr("%s: bad osd_op: %s", self.entity, e)
+            self.perf.inc("op_error")
             self._reply(conn, tid, EINVAL_RC)
         finally:
             # every exit path closes the tracked op (replay answers,

@@ -566,9 +566,14 @@ class ECBackend:
         # shared Tracer (daemon-provided): sampled ops get their
         # coalesced device launch recorded into their trace tree
         self.tracer = tracer
+        # ec_launch_bytes: logical bytes fed into device launches (the
+        # numerator of achieved-GiB/s: ec_launch_bytes delta over
+        # encode+decode launch-us delta — the utilization telemetry's
+        # HBM-roofline-% input)
         for _k in ("hedge_issued", "hedge_won", "hedge_lost",
                    "ec_coalesce_launches", "ec_coalesce_ops",
                    "ec_coalesce_pad_waste", "ec_device_launches",
+                   "ec_launch_bytes",
                    "ec_mesh_launches", "ec_mesh_ops",
                    "ec_mesh_ici_bytes", "ec_mesh_ici_whole_bytes"):
             self.perf.add(_k, CounterType.U64)
@@ -755,6 +760,8 @@ class ECBackend:
         from ceph_tpu.ec.engine import pad_batch_pow2, pad_batch_pow2_device
 
         if self._is_device(stripes):
+            self.perf.inc("ec_launch_bytes",
+                          int(getattr(stripes, "nbytes", 0)))
             stripes, b = pad_batch_pow2_device(stripes)
             if stripes.shape[0] != b:
                 self.perf.inc("ec_coalesce_pad_waste",
@@ -773,6 +780,7 @@ class ECBackend:
             self.perf.inc("ec_coalesce_pad_waste", stripes.shape[0] - b)
         self.mesh_stats["encode_buckets"].add(stripes.shape[0])
         self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_launch_bytes", in_bytes)
         self.perf.inc("ec_resident_h2d_bytes", in_bytes)
         t0 = time.perf_counter()
         if self.mesh is not None:
@@ -821,6 +829,7 @@ class ECBackend:
                 }
             self.mesh_stats["decode_buckets"].add(bp)
         self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_launch_bytes", in_bytes)
         self.perf.inc("ec_resident_h2d_bytes", in_bytes)
         t0 = time.perf_counter()
         if self.mesh is not None:
@@ -880,6 +889,8 @@ class ECBackend:
             self.mesh_stats["decode_buckets"].add(int(bp))
             avail = padded
         self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_launch_bytes", sum(
+            int(getattr(c, "nbytes", 0)) for c in batched.values()))
         t0 = time.perf_counter()
         out = {w: batched[w][:b] for w in missing if w in batched}
         todo = [w for w in missing if w not in batched]
@@ -1096,6 +1107,7 @@ class ECBackend:
             chunks[:b, int(s)] = np.asarray(c, np.uint8)
         self.perf.inc("ec_device_launches")
         self.perf.inc("ec_mesh_launches")
+        self.perf.inc("ec_launch_bytes", chunks.nbytes)
         self.perf.inc("ec_resident_h2d_bytes", chunks.nbytes)
         t0 = time.perf_counter()
         rec = np.asarray(await asyncio.to_thread(
@@ -2408,6 +2420,7 @@ class ECBackend:
             for oid in ok
         ], axis=0)                            # (b, L, C)
         self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_launch_bytes", stacked.nbytes)
         self.perf.inc("ec_resident_h2d_bytes", stacked.nbytes)
         t0 = time.perf_counter()
         rec = await asyncio.to_thread(
@@ -2425,6 +2438,7 @@ class ECBackend:
 
         flat = np.concatenate([payload[oid] for oid in ok], axis=0)
         self.perf.inc("ec_device_launches")
+        self.perf.inc("ec_launch_bytes", flat.nbytes)
         self.perf.inc("ec_resident_h2d_bytes", flat.nbytes)
         t0 = time.perf_counter()
         rec = await asyncio.to_thread(

@@ -9,8 +9,11 @@ exposition endpoint and the restful module's programmatic API
   osd/pg/pool summaries, the OSD tree, MDS ranks, and the recent
   cluster log — assembled from the same mon commands the CLI uses.
 - ``GET /api/osd`` / ``GET /api/pool``  resource listings (restful).
+- ``GET /api/slo``     per-objective SLO verdicts (value / burn rate /
+  worst daemon) + utilization telemetry rates from the slo mgr module.
 - ``GET /metrics``     prometheus text exposition of the mgr's last
-  digest (the pybind/mgr/prometheus serve role).
+  digest (the pybind/mgr/prometheus serve role) plus the SLO burn-rate
+  and utilization gauges.
 - ``GET /``            one self-refreshing HTML page rendering the
   status for a browser, with an operations panel driving the API.
 
@@ -133,6 +136,15 @@ class Dashboard:
             elif path == "/api/trace":
                 status, body = await self._trace_get(headers, query)
                 ctype = "application/json"
+            elif path == "/api/slo":
+                # SLO verdicts + utilization rates straight from the
+                # mgr's last digest (the slo module's contribution)
+                digest = self.mgr.last_digest or {}
+                body = json.dumps({
+                    "slo": digest.get("slo", {}),
+                    "utilization": digest.get("utilization", {}),
+                }).encode()
+                ctype, status = "application/json", 200
             elif path == "/metrics":
                 # collect() messages every OSD; cache briefly so an
                 # aggressive scraper doesn't multiply cluster traffic
@@ -141,7 +153,8 @@ class Dashboard:
                     body = cached
                 else:
                     snap = await self.mgr.collect()
-                    body = self.mgr.prometheus_text(snap).encode()
+                    body = self.mgr.prometheus_text(
+                        snap, self.mgr.prometheus_extra()).encode()
                     self._metrics_cache = (time.monotonic(), body)
                 ctype, status = "text/plain; version=0.0.4", 200
             elif path == "/":
@@ -405,6 +418,54 @@ class Dashboard:
         section("Capacity",
                 f"<p>{pg.get('num_bytes', 0)} bytes stored in "
                 f"{pg.get('num_objects', 0)} objects</p>")
+
+        digest = getattr(self.mgr, "last_digest", None) or {}
+        slo = digest.get("slo") or {}
+        objectives = slo.get("objectives") or []
+        if objectives:
+            def fmt_val(rec):
+                v = rec.get("value")
+                return "n/a" if v is None else \
+                    f"{v:.4g} {rec.get('unit', '')}"
+
+            section("Serving SLO", table(
+                ["objective", "target", "value", "burn rate",
+                 "worst daemon", "status"], [
+                    [esc(r.get("objective", "")),
+                     esc(f"{r.get('target', 0):g} {r.get('unit', '')}"),
+                     esc(fmt_val(r)),
+                     esc(f"{r.get('burn_rate', 0.0):.2f}x"),
+                     esc(str(r.get("worst_daemon") or "-")),
+                     ('<span style="color:#d22">VIOLATING</span>'
+                      if r.get("violating") else
+                      '<span style="color:#2a2">ok</span>')]
+                    for r in objectives
+                ]))
+
+        util = digest.get("utilization") or {}
+        if util:
+            # the rebuild-vs-client-tail pair reads side by side: the
+            # interference arxiv 1906.08602 names as THE tail driver
+            section("Utilization", table(["series", "value"], [
+                ["device GiB/s (EC launches)",
+                 esc(f"{util.get('device_gibps', 0.0):g}")],
+                ["HBM roofline %",
+                 esc(f"{util.get('roofline_pct', 0.0):g}%")],
+                ["coalesce occupancy (ops/launch)",
+                 esc(f"{util.get('coalesce_occupancy', 0.0):g}")],
+                ["coalesce wait p50/p99 µs",
+                 esc(f"{util.get('coalesce_wait_p50_us', 0.0):g} / "
+                     f"{util.get('coalesce_wait_p99_us', 0.0):g}")],
+                ["resident cache hit rate",
+                 esc(f"{util.get('resident_hit_rate', 0.0):g}")],
+                ["rebuild GiB/s ⇄ client p99 ms",
+                 esc(f"{util.get('rebuild_gibps', 0.0):g} ⇄ "
+                     f"{util.get('client_p99_ms', 0.0):g}")],
+                ["client p50/p99/p999 ms",
+                 esc(f"{util.get('client_p50_ms', 0.0):g} / "
+                     f"{util.get('client_p99_ms', 0.0):g} / "
+                     f"{util.get('client_p999_ms', 0.0):g}")],
+            ]))
 
         fsmap = s.get("fs") or {}
         fs_rows = []

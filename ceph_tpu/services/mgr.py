@@ -23,6 +23,25 @@ from ceph_tpu.msg.message import Message
 from ceph_tpu.msg.messenger import Connection, Messenger, Policy
 
 
+def prom_escape(value: str) -> str:
+    """Escape a prometheus label VALUE per the text exposition spec:
+    backslash first (it is the escape char), then double-quote and
+    newline.  Daemon names are tame today, but free-form label values
+    (SLO objective specs, pool names) must not be able to break the
+    scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def prom_label(**labels) -> str:
+    """Render one ``{k="v",...}`` label set with escaped values."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{prom_escape(v)}"' for k, v in labels.items())
+    return "{" + inner + "}"
+
+
 class Mgr:
     def __init__(self, monmap: dict[str, str],
                  conf: ConfigProxy | None = None, name: str = "mgr.x",
@@ -53,6 +72,7 @@ class Mgr:
                 OSDPerfQuery,
                 RBDSupport,
             )
+            from ceph_tpu.services.mgr_slo import SLOMonitor
             from ceph_tpu.services.orchestrator import Orchestrator
 
             pq = OSDPerfQuery(self)
@@ -60,7 +80,8 @@ class Mgr:
                        Progress(self), DeviceHealth(self),
                        Telemetry(self), Insights(self),
                        SnapSchedule(self), Orchestrator(self),
-                       pq, RBDSupport(self, pq), IOStat(self)]
+                       pq, RBDSupport(self, pq), IOStat(self),
+                       SLOMonitor(self)]
         self.modules = {m.name: m for m in modules}
         self.last_digest: dict | None = None
 
@@ -306,16 +327,36 @@ class Mgr:
             await asyncio.sleep(interval)
 
     # -- prometheus exposition ---------------------------------------------
+    def prometheus_extra(self) -> dict[str, dict]:
+        """Gauge families contributed by modules (``prom_metrics``
+        hook): the SLO burn rates + utilization rates ride the same
+        scrape as the daemon counters."""
+        extra: dict[str, dict] = {}
+        for mod in self.modules.values():
+            hook = getattr(mod, "prom_metrics", None)
+            if hook is not None:
+                extra.update(hook())
+        return extra
+
     @staticmethod
-    def prometheus_text(snapshot: dict) -> str:
+    def prometheus_text(snapshot: dict,
+                        extra: dict[str, dict] | None = None) -> str:
         """Render one snapshot in the text exposition format, with the
-        metric names the reference prometheus module exports."""
+        metric names the reference prometheus module exports.
+        ``extra`` appends module gauge families (name -> {"help",
+        "type"?, "samples": [(labels, value)]}).  Label values are
+        escaped per the exposition spec and ``# HELP``/``# TYPE``
+        lines are emitted once per metric name even when several
+        daemons (or an extra family) export the same series."""
         lines: list[str] = []
+        described: set[str] = set()
 
         def metric(name: str, help_: str, samples: list[tuple[str, float]],
                    mtype: str = "gauge") -> None:
-            lines.append(f"# HELP {name} {help_}")
-            lines.append(f"# TYPE {name} {mtype}")
+            if name not in described:
+                described.add(name)
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {mtype}")
             for labels, value in samples:
                 lines.append(f"{name}{labels} {value:g}")
 
@@ -335,7 +376,8 @@ class Mgr:
         metric("ceph_mon_quorum_count", "monitors in quorum",
                [("", len(st["mon"]["quorum"]))])
         up_samples = [
-            (f'{{ceph_daemon="osd.{osd}"}}', 1.0 if info["up"] else 0.0)
+            (prom_label(ceph_daemon=f"osd.{osd}"),
+             1.0 if info["up"] else 0.0)
             for osd, info in sorted(snapshot["osds"].items())
         ]
         if up_samples:
@@ -352,7 +394,7 @@ class Mgr:
         hists: dict[str, list[tuple[str, dict]]] = {}
         merged: dict[str, dict] = {}
         for osd, counters in sorted(snapshot["osd_perf"].items()):
-            lab = f'{{ceph_daemon="osd.{osd}"}}'
+            lab = prom_label(ceph_daemon=f"osd.{osd}")
             for key, value in sorted(counters.items()):
                 if isinstance(value, dict) and "buckets" in value:
                     hists.setdefault(key, []).append(
@@ -375,24 +417,31 @@ class Mgr:
                    [(lab, c) for lab, _, c in entries], mtype="counter")
         for key, entries in sorted(hists.items()):
             base = f"ceph_osd_{key}"
-            lines.append(f"# HELP {base} osd {key} log2 histogram")
-            lines.append(f"# TYPE {base} histogram")
+            if base not in described:
+                described.add(base)
+                lines.append(f"# HELP {base} osd {key} log2 histogram")
+                lines.append(f"# TYPE {base} histogram")
             for daemon, h in entries:
+                dlab = prom_escape(daemon)
                 cum = 0
                 for i, c in enumerate(h.get("buckets", ())):
                     cum += int(c)
                     le = bucket_le(i)
                     le_s = "+Inf" if math.isinf(le) else f"{le:g}"
                     lines.append(
-                        f'{base}_bucket{{ceph_daemon="{daemon}",'
+                        f'{base}_bucket{{ceph_daemon="{dlab}",'
                         f'le="{le_s}"}} {cum:g}')
-                lines.append(f'{base}_sum{{ceph_daemon="{daemon}"}} '
+                lines.append(f'{base}_sum{{ceph_daemon="{dlab}"}} '
                              f'{float(h.get("sum", 0.0)):g}')
-                lines.append(f'{base}_count{{ceph_daemon="{daemon}"}} '
+                lines.append(f'{base}_count{{ceph_daemon="{dlab}"}} '
                              f'{int(h.get("count", 0)):g}')
             m = merged[key]
             metric(f"{base}_quantile",
                    f"cluster-merged {key} quantiles",
-                   [('{q="0.5"}', hist_quantile(m, 0.5)),
-                    ('{q="0.99"}', hist_quantile(m, 0.99))])
+                   [('{q="0.5"}', hist_quantile(m, 0.5) or 0.0),
+                    ('{q="0.99"}', hist_quantile(m, 0.99) or 0.0)])
+        for name, fam in sorted((extra or {}).items()):
+            metric(name, str(fam.get("help", name)),
+                   list(fam.get("samples", ())),
+                   mtype=str(fam.get("type", "gauge")))
         return "\n".join(lines) + "\n"

@@ -1,0 +1,202 @@
+"""SLO + utilization mgr module: the serving-observability brain.
+
+``SLOMonitor`` drives :class:`ceph_tpu.common.slo.SLOEngine` from the
+per-OSD perf dumps the mgr already polls: each report cycle feeds one
+cumulative snapshot into the engine's sliding window, evaluates every
+conf-declared objective, and
+
+- raises ``SLO_VIOLATION`` cluster health (mgr_stat passes the payload
+  straight to the mon's health map) naming the failing objective and
+  the worst daemon,
+- contributes ``slo`` + ``utilization`` digest sections the dashboard
+  panels and ``/api/slo`` serve,
+- exports per-objective error-budget burn-rate gauges plus the
+  utilization rate gauges to the Prometheus scrape (``prom_metrics``
+  hook rendered by ``Mgr.prometheus_text``).
+
+The utilization layer turns the PR 6-8 raw counters into rates over
+the same window: achieved device GiB/s vs the HBM roofline
+(``ec_launch_bytes`` over encode+decode launch-us), coalescer
+occupancy (ops per launch) and window-wait quantiles, resident-cache
+hit rate, and the rebuild-GiB/s vs client-p99 interference pair —
+the panel arxiv 1906.08602 says decides EC tail latency.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ceph_tpu.common.perf import hist_quantile
+from ceph_tpu.common.slo import SLOEngine, targets_from_conf
+from ceph_tpu.services.mgr_modules import MgrModule
+
+
+class SLOMonitor(MgrModule):
+    name = "slo"
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.engine: SLOEngine | None = None
+        self.last_eval: list[dict] = []
+        self.util: dict = {}
+
+    def _ensure_engine(self) -> SLOEngine:
+        # built lazily so conf overrides installed after construction
+        # (vstart passes them per-entity) are honored; an empty target
+        # list still windows the counters for the utilization layer
+        if self.engine is None:
+            conf = self.mgr.conf
+            self.engine = SLOEngine(
+                targets_from_conf(conf),
+                window=float(conf["slo_window"]),
+                raise_evals=int(conf["slo_raise_evals"]),
+                clear_evals=int(conf["slo_clear_evals"]),
+            )
+        return self.engine
+
+    async def serve_once(self) -> None:
+        eng = self._ensure_engine()
+        snap = await self.mgr.collect()
+        per_daemon = {f"osd.{o}": counters
+                      for o, counters in snap["osd_perf"].items()}
+        eng.observe(time.monotonic(), per_daemon)
+        # recovery state from the previous cycle's digest (this cycle's
+        # is being built around us) — one report_interval of lag on the
+        # rebuild-floor objective, never on the latency objectives
+        digest = self.mgr.last_digest or {}
+        recovery = int(digest.get("degraded_objects", 0)) > 0
+        self.last_eval = eng.evaluate(recovery_active=recovery)
+        self.util = self._utilization(eng)
+
+    # -- utilization telemetry (rates from the PR 6-8 counters) -----------
+    def _win_pair(self, eng: SLOEngine, key: str) -> tuple[float, float]:
+        """Window delta of a LONGRUNAVG counter: (sum, count)."""
+        if len(eng._snaps) < 2:
+            return 0.0, 0.0
+        _, old = eng._snaps[0]
+        _, new = eng._snaps[-1]
+        ds = dc = 0.0
+        for daemon, dump in new.items():
+            cur = dump.get(key)
+            if not isinstance(cur, dict):
+                continue
+            prev = old.get(daemon, {}).get(key, {})
+            if not isinstance(prev, dict):
+                prev = {}
+            ds += float(cur.get("sum", 0.0)) - float(prev.get("sum", 0.0))
+            dc += float(cur.get("avgcount", 0)) \
+                - float(prev.get("avgcount", 0))
+        return max(0.0, ds), max(0.0, dc)
+
+    def _utilization(self, eng: SLOEngine) -> dict:
+        gib = float(1 << 30)
+        span = eng.window_span()
+        peak = float(self.mgr.conf["ec_hbm_peak_gibps"] or 1.0)
+
+        launch_bytes, _ = eng._window_scalar("ec_launch_bytes")
+        enc_h, _ = eng._window_hist("ec_encode_launch_us")
+        dec_h, _ = eng._window_hist("ec_decode_launch_us")
+        launch_s = (enc_h.get("sum", 0.0) + dec_h.get("sum", 0.0)) / 1e6
+        device_gibps = (launch_bytes / gib / launch_s) if launch_s > 0 \
+            else 0.0
+
+        occ_sum, occ_n = self._win_pair(eng, "ec_coalesce_occupancy")
+        wait_h, _ = eng._window_hist("ec_coalesce_wait_hist_us")
+        hits, _ = eng._window_scalar("ec_resident_hits")
+        misses, _ = eng._window_scalar("ec_resident_misses")
+        lookups = hits + misses
+        rebuild_bytes, _ = eng._window_scalar("ec_repair_rebuild_bytes")
+        cli_h, _ = eng._window_hist("op_latency_us")
+
+        def q_ms(h, q):
+            v = hist_quantile(h, q)
+            return 0.0 if v is None else round(v / 1000.0, 4)
+
+        return {
+            "window_s": round(span, 3),
+            # device roofline: achieved GiB/s through EC launches vs
+            # the conf'd HBM peak — the % of hardware we actually use
+            "device_gibps": round(device_gibps, 3),
+            "roofline_pct": round(100.0 * device_gibps / peak, 3),
+            "launch_bytes": int(launch_bytes),
+            "launch_seconds": round(launch_s, 6),
+            # coalescer: how full each shared launch ran, and what the
+            # micro-window cost waiters
+            "coalesce_occupancy": round(occ_sum / occ_n, 3)
+            if occ_n > 0 else 0.0,
+            "coalesce_launches": int(occ_n),
+            "coalesce_wait_p50_us": round(hist_quantile(wait_h, 0.5)
+                                          or 0.0, 1),
+            "coalesce_wait_p99_us": round(hist_quantile(wait_h, 0.99)
+                                          or 0.0, 1),
+            # resident cache
+            "resident_hit_rate": round(hits / lookups, 4)
+            if lookups > 0 else 0.0,
+            # interference panel: rebuild throughput against the
+            # client tail it competes with, over the SAME window
+            "rebuild_gibps": round(rebuild_bytes / gib / span, 4)
+            if span > 0 else 0.0,
+            "client_p50_ms": q_ms(cli_h, 0.5),
+            "client_p99_ms": q_ms(cli_h, 0.99),
+            "client_p999_ms": q_ms(cli_h, 0.999),
+        }
+
+    # -- mgr surfaces ------------------------------------------------------
+    def health_checks(self) -> dict[str, dict]:
+        if self.engine is None:
+            return {}
+        return self.engine.health_checks()
+
+    def digest_contrib(self) -> dict:
+        eng = self.engine
+        return {
+            "slo": {
+                "objectives": self.last_eval,
+                "violations": sorted(eng.active) if eng else [],
+                "window_s": eng.window_span() if eng else 0.0,
+            },
+            "utilization": self.util,
+        }
+
+    def prom_metrics(self) -> dict[str, dict]:
+        """Extra gauge families for the Prometheus exposition."""
+        out: dict[str, dict] = {}
+        per_obj: dict[str, list] = {"burn_rate": [], "ok": [],
+                                    "value": []}
+        if self.engine is not None:
+            from ceph_tpu.services.mgr import prom_label
+
+            for obj, vals in sorted(self.engine.gauges().items()):
+                lab = prom_label(objective=obj)
+                for k in per_obj:
+                    per_obj[k].append((lab, float(vals[k])))
+        out["ceph_slo_burn_rate"] = {
+            "help": "error-budget burn rate per SLO objective "
+                    "(1.0 = spending exactly the allowed budget)",
+            "samples": per_obj["burn_rate"]}
+        out["ceph_slo_ok"] = {
+            "help": "1 while the objective meets target "
+                    "(0 = SLO_VIOLATION active)",
+            "samples": per_obj["ok"]}
+        out["ceph_slo_value"] = {
+            "help": "measured value per SLO objective over the window",
+            "samples": per_obj["value"]}
+        u = self.util
+        for key, help_ in (
+                ("device_gibps", "achieved EC device throughput GiB/s"),
+                ("roofline_pct", "achieved device GiB/s as % of the "
+                                 "HBM roofline (ec_hbm_peak_gibps)"),
+                ("coalesce_occupancy", "ops per coalesced launch over "
+                                       "the window"),
+                ("coalesce_wait_p99_us", "coalescer window-wait p99 us"),
+                ("resident_hit_rate", "device-resident shard cache hit "
+                                      "rate"),
+                ("rebuild_gibps", "repair engine rebuild throughput "
+                                  "GiB/s"),
+                ("client_p99_ms", "cluster client op p99 ms over the "
+                                  "window"),
+        ):
+            out[f"ceph_util_{key}"] = {
+                "help": help_,
+                "samples": [("", float(u.get(key, 0.0)))]}
+        return out

@@ -46,6 +46,13 @@
 #                                   through batched launches (asserted
 #                                   over the ec_repair_stats wire
 #                                   command), bit-identical read-back
+#   scripts/tier1.sh --serve-smoke  serving SLO harness end to end: a
+#                                   3-OSD vstart cluster with the mgr
+#                                   SLO module armed, 30s (capped) of
+#                                   seeded closed-loop load, asserting
+#                                   nonzero p50/p99 from the histogram
+#                                   layer, an SLO verdict present in
+#                                   the digest, and zero loadgen errors
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -545,6 +552,77 @@ async def main():
 asyncio.run(main())
 EOF
     echo "REPAIR_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--serve-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    python - <<'EOF'
+import asyncio
+import time
+
+
+async def main():
+    from ceph_tpu.testing.loadgen import LoadGen, RadosBackend
+    from ceph_tpu.vstart import DevCluster
+
+    cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+        "slo_put_p99_ms": 600.0, "slo_get_p999_ms": 600.0,
+        "slo_error_rate": 0.01,
+        "slo_window": 30.0, "slo_raise_evals": 1, "slo_clear_evals": 1,
+    })
+    await cluster.start()
+    try:
+        mgr = await cluster.start_mgr(report_interval=0.2)
+        rados = await cluster.client()
+        await rados.pool_create("serve", pg_num=8, size=3)
+        io = await rados.open_ioctx("serve")
+        print("ok: vstart cluster + mgr SLO module "
+              "(put_p99/get_p999/error_rate armed)")
+
+        gen = LoadGen(RadosBackend(io, prefix="smoke"), seed=1,
+                      mode="closed", clients=4, total_ops=600,
+                      n_keys=32, duration=30.0)
+        await gen.populate()
+        print("ok: seeded keyspace populated (32 keys, zipf mix)")
+        t0 = time.monotonic()
+        res = await gen.run()
+        print(f"ok: closed-loop run finished in {res['wall_s']}s "
+              f"({res['ops']} ops, {res['ops_per_s']} ops/s)")
+
+        assert res["errors"] == 0, f"loadgen errors: {res['errors']}"
+        print("ok: zero loadgen errors")
+        assert res["p50_ms"] > 0.0, res
+        assert res["p99_ms"] >= res["p50_ms"] > 0.0, res
+        print(f"ok: loadgen histogram p50={res['p50_ms']}ms "
+              f"p99={res['p99_ms']}ms")
+
+        # cluster-side histogram layer agrees: nonzero windowed p50/p99
+        await asyncio.sleep(0.5)       # one more report cycle
+        digest = mgr.last_digest or {}
+        objs = digest.get("slo", {}).get("objectives", [])
+        assert objs, "no SLO verdict in the mgr digest"
+        by_name = {o["objective"]: o for o in objs}
+        for needed in ("put_p99_ms", "get_p999_ms", "error_rate"):
+            assert needed in by_name, sorted(by_name)
+        assert by_name["put_p99_ms"]["value"] > 0.0, by_name
+        print("ok: SLO verdict present for every armed objective "
+              + str({o: by_name[o]["ok"] for o in sorted(by_name)}))
+        util = digest.get("utilization", {})
+        assert util.get("client_p50_ms", 0.0) > 0.0, util
+        assert util.get("client_p99_ms", 0.0) >= \
+            util.get("client_p50_ms", 0.0), util
+        print(f"ok: windowed cluster histograms nonzero "
+              f"(client p50={util['client_p50_ms']}ms "
+              f"p99={util['client_p99_ms']}ms)")
+    finally:
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+    echo "SERVE_SMOKE_PASSED"
     exit 0
 fi
 

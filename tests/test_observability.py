@@ -102,7 +102,8 @@ def test_histogram_merge_and_overflow():
     # merging with empty is identity on counts
     m2 = hist_merge(None, a.dump()["h"])
     assert m2["count"] == 2 and m2["sum"] == 103.0
-    assert hist_quantile({"buckets": [], "count": 0}, 0.5) == 0.0
+    # empty histogram has no quantile (None), distinct from "p50==0"
+    assert hist_quantile({"buckets": [], "count": 0}, 0.5) is None
 
 
 def test_histogram_reset():
