@@ -974,6 +974,118 @@ def _cfg9_main() -> None:
     print(json.dumps(record), flush=True)
 
 
+def _cfg11_rescan_ab(n_osds: int = 200, pg_num: int = 8192) -> dict:
+    """cfg11: whole-PG-space rescan A/B at 200 OSDs / 8k PGs — the
+    epoch-cached OSDMapMapping table (one vectorized numpy pass, what
+    every OSD now pays per map epoch) vs the legacy scalar per-PG CRUSH
+    walk, on the same map with live upmap/pg_temp/primary_temp overlays
+    and down OSDs.  Lookups are asserted bit-identical across the full
+    PG space before any timing counts."""
+    import time as _time
+
+    from ceph_tpu.osd.osd_map import Incremental, NO_OSD, OSDMap, PoolInfo
+    from ceph_tpu.placement.crush_map import CrushMap
+
+    osds_per_host = 4
+    crush = CrushMap()
+    root = crush.add_bucket("default", "root")
+    osd = 0
+    for h in range(n_osds // osds_per_host):
+        host = crush.add_bucket(f"host{h}", "host")
+        for _ in range(osds_per_host):
+            crush.add_item(host, osd, 1.0)
+            osd += 1
+        crush.add_item(root, host)
+    crush.create_replicated_rule("replicated_rule", failure_domain="host")
+    m = OSDMap(crush)
+    inc = Incremental(1)
+    for i in range(n_osds):
+        inc.new_up[i] = f"osd.{i}:1{i:04d}"
+    inc.new_pools.append(PoolInfo(
+        1, "scale", "replicated", size=3, pg_num=pg_num))
+    m.apply_incremental(inc)
+    # overlays + failures so the cached path exercises its fixups, not
+    # just the clean bulk pass
+    inc = Incremental(2)
+    inc.new_down = [7, 42, 133]
+    for ps in range(0, pg_num, 257):
+        inc.new_pg_upmap_items[(1, ps)] = [(ps % n_osds,
+                                            (ps * 7 + 11) % n_osds)]
+    for ps in range(1, pg_num, 511):
+        inc.new_pg_temp[(1, ps)] = [(ps + j) % n_osds for j in range(3)]
+    for ps in range(2, pg_num, 1023):
+        inc.new_primary_temp[(1, ps)] = (ps * 13) % n_osds
+    m.apply_incremental(inc)
+
+    def scalar_row(ps):
+        up = m.raw_row_to_up(1, ps, m._pg_to_raw_osds_scalar(1, ps))
+        acting = list(m.pg_temp.get((1, ps), up)) or up
+        primary = m.primary_temp.get((1, ps))
+        up_primary = next((o for o in up if o != NO_OSD), NO_OSD)
+        acting_primary = (
+            primary if primary is not None
+            else next((o for o in acting if o != NO_OSD), NO_OSD)
+        )
+        return up, up_primary, acting, acting_primary
+
+    # A: the legacy rescan — one scalar CRUSH walk per PG
+    t0 = _time.perf_counter()
+    scalar = [scalar_row(ps) for ps in range(pg_num)]
+    t_scalar = _time.perf_counter() - t0
+
+    # cold build: includes the one-off bulk CRUSH pass (paid once per
+    # crush/weight change, then carried across overlay-only epochs)
+    mapping = m.mapping()
+    mapping.invalidate()
+    t0 = _time.perf_counter()
+    tables = mapping.up_acting_tables(1)
+    t_cold = _time.perf_counter() - t0
+
+    for ps in range(pg_num):
+        if tables.lookup(ps) != scalar[ps]:
+            raise AssertionError(
+                f"cfg11 table/scalar drift at pg {ps}: "
+                f"{tables.lookup(ps)} != {scalar[ps]}")
+
+    # B: the steady-state rescan an OSD pays per overlay epoch —
+    # vectorized up/acting rebuild off the epoch-cached raw rows
+    reps = 5
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        tables = mapping.up_acting_tables(1)
+    t_warm = (_time.perf_counter() - t0) / reps
+
+    out = {
+        "n_osds": n_osds, "pg_num": pg_num,
+        "scalar_rescan_s": round(t_scalar, 4),
+        "cached_cold_s": round(t_cold, 4),
+        "cached_warm_s": round(t_warm, 5),
+        "speedup_cold": round(t_scalar / t_cold, 1),
+        "speedup_warm": round(t_scalar / t_warm, 1),
+        "bit_identical_pgs": pg_num,
+    }
+    if out["speedup_warm"] < 20:
+        raise AssertionError(
+            f"cfg11 warm rescan speedup {out['speedup_warm']}x < 20x gate")
+    return out
+
+
+def _cfg11_main() -> None:
+    """Standalone cfg11 entry (``python bench.py --cfg11``): pure
+    control-plane numpy/CPU work, no device needed.  Appends its record
+    to BENCH_LOCAL.jsonl and prints it as the final JSON line."""
+    cfg11 = _cfg11_rescan_ab()
+    record = {
+        "metric": "osdmap_rescan_200osd_8kpg_cached_speedup",
+        "value": cfg11["speedup_warm"],
+        "unit": "x faster full PG-space rescan",
+        "vs_baseline": cfg11["speedup_warm"],
+        "extra": cfg11,
+    }
+    _append_local_record(record)
+    print(json.dumps(record), flush=True)
+
+
 def _cfg10_serve(seed: int = 0, ops_per_phase: int = 240,
                  clients: int = 4) -> dict:
     """cfg10: serving-load SLO scenario (``python bench.py --serve``).
@@ -1312,6 +1424,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--serve" in sys.argv[1:]:
         _serve_main()
+        sys.exit(0)
+    if "--cfg11" in sys.argv[1:]:
+        _cfg11_main()
         sys.exit(0)
     try:
         main()

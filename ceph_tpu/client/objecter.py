@@ -74,6 +74,11 @@ class Objecter:
                    "op_error"):
             self.perf.add(_k, CounterType.U64)
         self.perf.add("op_latency_us", CounterType.HISTOGRAM)
+        # primary-lookup memo off the bulk-mapping table: (map object,
+        # epoch, {(pool, ps) -> acting_primary}).  Keyed by map identity
+        # AND epoch so any new/replayed map drops it wholesale; entries
+        # are filled from pg_to_up_acting (itself a cached-table lookup)
+        self._primary_memo: tuple = (None, -1, {})
         # cephx: OSD sessions we have presented our service ticket on
         self._osd_authed: set[int] = set()
         self._osd_auth_futs: dict[int, asyncio.Future] = {}
@@ -152,6 +157,21 @@ class Objecter:
                 await self._rearm_linger(linger)
 
     # -- targeting --------------------------------------------------------
+    def _pg_primary(self, m, pool_id: int, ps: int) -> int:
+        """Memoized acting-primary for one PG on map ``m`` — hot on
+        every submit retry, so repeated lookups within an epoch are a
+        dict hit instead of even the (cheap) table walk."""
+        memo_map, memo_epoch, memo = self._primary_memo
+        if memo_map is not m or memo_epoch != m.epoch:
+            memo = {}
+            self._primary_memo = (m, m.epoch, memo)
+        key = (pool_id, ps)
+        primary = memo.get(key)
+        if primary is None:
+            _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+            memo[key] = primary
+        return primary
+
     def _target_for(self, pool_id: int, oid: str) -> int | None:
         m = self.monc.osdmap
         if m is None:
@@ -160,7 +180,7 @@ class Objecter:
         if pool is None:
             return None
         ps = object_to_ps(oid, pool.pg_num)
-        _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+        primary = self._pg_primary(m, pool_id, ps)
         return primary if primary >= 0 else None
 
     # -- submission -------------------------------------------------------
@@ -235,7 +255,7 @@ class Objecter:
                 target_pool_id = tier_id
                 pool = m.pools[tier_id]
             ps = object_to_ps(oid, pool.pg_num)
-            _, _, _, primary = m.pg_to_up_acting(target_pool_id, ps)
+            primary = self._pg_primary(m, target_pool_id, ps)
             if primary < 0:
                 await self._await_newer_map(m.epoch, deadline)
                 continue

@@ -229,7 +229,7 @@ class Rados:
         m = self.monc.osdmap
         if m is None or pool_id not in m.pools:
             raise RadosError(-2, f"no pool {pool_id}")
-        _, _, _, primary = m.pg_to_up_acting(pool_id, ps)
+        primary = self.objecter._pg_primary(m, pool_id, ps)
         if primary < 0:
             raise RadosError(-11, f"pg {pool_id}.{ps} has no primary")
         reply = await self.osd_daemon_command(
@@ -452,7 +452,7 @@ class IoCtx:
         deadline = loop.time() + 10.0
         while True:
             m = monc.osdmap
-            _, _, _, primary = m.pg_to_up_acting(self.pool_id, ps)
+            primary = objecter._pg_primary(m, self.pool_id, ps)
             if primary < 0:
                 await asyncio.sleep(0.05)
                 if loop.time() > deadline:

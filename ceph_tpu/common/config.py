@@ -72,6 +72,19 @@ def global_options() -> list[Option]:
                "distinct reporters required to mark an osd down", min=1),
         Option("mon_osd_down_out_interval", float, 30.0,
                "seconds before a down osd is marked out"),
+        Option("mon_osdmap_keep_epochs", int, 200,
+               "OSDMap full+incremental epochs the mon store retains; "
+               "subscribers older than the trim horizon get a full map "
+               "(mon_min_osdmap_epochs trim role)", min=1),
+        Option("osd_heartbeat_peer_limit", int, 0,
+               "max peers each OSD pings (ring successors by id); 0 = "
+               "all up OSDs.  The all-to-all default builds an O(n^2) "
+               "connection mesh that melts one-process clusters past "
+               "~100 OSDs (maybe_update_heartbeat_peers role)", min=0),
+        Option("paxos_propose_interval", float, 0.0,
+               "delay before committing staged boot/failure map changes "
+               "so a burst coalesces into one epoch (0 = immediate)",
+               min=0.0),
         Option("osd_erasure_code_plugins", str, "jax_rs lrc shec clay xor",
                "plugins preloaded at osd start"),
         Option("osd_recovery_max_active", int, 8,

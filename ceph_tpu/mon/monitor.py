@@ -121,6 +121,7 @@ class Monitor:
         self._tasks: list[asyncio.Task] = []
         self._send_tasks: set[asyncio.Task] = set()
         self._genesis_inflight = False
+        self._propose_timer: asyncio.Task | None = None
         self._stopped = False
 
     # -- identity ---------------------------------------------------------
@@ -185,6 +186,8 @@ class Monitor:
         self._stopped = True
         self.elector.stop()
         self.sync.stop()
+        if self._propose_timer is not None:
+            self._propose_timer.cancel()
         for t in self._tasks:
             t.cancel()
         for t in list(self._send_tasks):
@@ -873,16 +876,41 @@ class Monitor:
     # -- osd boot / failure ------------------------------------------------
     async def _prepare_boot(self, data: dict) -> dict:
         osd_id = int(data["id"])
+        interval = float(self.conf["paxos_propose_interval"])
         async with self._mutate_lock:
             changed = self.osd_monitor.prepare_boot(
                 osd_id, str(data["addr"]), str(data.get("host", ""))
             )
-            if changed:
+            if changed and interval <= 0:
                 try:
                     await self.propose_pending()
                 except ConnectionError:
                     return {"epoch": 0}
+        if changed and interval > 0:
+            # paxos_propose_interval: a 200-OSD boot storm staged one
+            # propose per daemon would burn one paxos round + full
+            # subscription fan-out PER OSD; the debounce folds every
+            # boot that lands inside the window into one epoch.  The
+            # ack needs no committed epoch — send_boot polls the map.
+            self._propose_soon(interval)
         return {"epoch": self.osd_monitor.osdmap.epoch}
+
+    def _propose_soon(self, delay: float) -> None:
+        """Debounced propose_pending: one timer, any mutation staged
+        while it runs rides the same commit."""
+        if (self._propose_timer is not None
+                and not self._propose_timer.done()):
+            return
+
+        async def run():
+            await asyncio.sleep(delay)
+            async with self._mutate_lock:
+                try:
+                    await self.propose_pending()
+                except ConnectionError:
+                    pass
+
+        self._propose_timer = asyncio.get_running_loop().create_task(run())
 
     async def _handle_osd_boot(self, conn: Connection, data: dict) -> None:
         if self.is_leader:
@@ -892,16 +920,20 @@ class Monitor:
             self._forward(conn, "osd_boot", data, "osd_boot_ack")
 
     async def _prepare_failure(self, data: dict) -> None:
+        interval = float(self.conf["paxos_propose_interval"])
         async with self._mutate_lock:
             changed = self.osd_monitor.prepare_failure(
                 int(data["target"]), str(data.get("reporter", "")),
                 float(data.get("failed_for", 0.0)),
             )
-            if changed:
+            if changed and interval <= 0:
                 try:
                     await self.propose_pending()
                 except ConnectionError:
                     pass
+        if changed and interval > 0:
+            # failure storms (rack pull) coalesce like boot storms do
+            self._propose_soon(interval)
 
     async def _handle_mds_beacon(self, data: dict) -> None:
         name = str(data.get("name", ""))

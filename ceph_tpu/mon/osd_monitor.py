@@ -57,6 +57,13 @@ class OSDMonitor(PaxosService):
         self.slow_op_reports: dict[int, dict] = {}
         # map-commit waiters (wait_map): woken on every refreshed epoch
         self._map_waiters: list = []
+        # per-epoch decode caches: after a commit, EVERY subscriber
+        # session is answered from incrementals_since/full_map_dict, so
+        # at 200 OSDs one epoch means 200 identical store decodes /
+        # to_dict walks without these.  Committed epochs are immutable
+        # and the wire layer re-encodes per send, so sharing is safe.
+        self._inc_cache: dict[int, dict] = {}
+        self._full_cache: tuple[int, dict | None] = (0, None)
 
     # -- state ------------------------------------------------------------
     def refresh(self) -> None:
@@ -106,17 +113,49 @@ class OSDMonitor(PaxosService):
         m.apply_incremental(inc)
         self._stage(tx, m, inc)
 
-    KEEP_EPOCHS = 200      # map history trim (OSDMonitor epoch pruning)
+    KEEP_EPOCHS = 200      # default map history window (conf-overridable)
+
+    def _keep_epochs(self) -> int:
+        """mon_osdmap_keep_epochs: how many epochs of full/incremental
+        history the store retains (OSDMonitor's mon_min_osdmap_epochs
+        trim role).  A direct KEEP_EPOCHS override on the instance
+        (tests, tools) beats the conf value."""
+        if "KEEP_EPOCHS" in self.__dict__:
+            return max(1, int(self.KEEP_EPOCHS))
+        try:
+            return max(1, int(self.mon.conf["mon_osdmap_keep_epochs"]))
+        except KeyError:
+            return self.KEEP_EPOCHS
+
+    def first_committed(self) -> int:
+        """Oldest epoch whose full map + incremental are still stored
+        (the trim horizon).  0 on legacy stores that predate the key —
+        callers treat that as 'unknown, probe the store'."""
+        return self.store.get_int(PREFIX, "first_committed")
 
     def _stage(self, tx: StoreTransaction, new_map: OSDMap,
                inc: Incremental) -> None:
         tx.put(PREFIX, f"full_{new_map.epoch}", encode(new_map.to_dict()))
         tx.put(PREFIX, f"inc_{inc.epoch}", encode(inc.to_dict()))
         tx.put(PREFIX, "last_committed", new_map.epoch)
-        old = new_map.epoch - self.KEEP_EPOCHS
-        if old > 0:
-            tx.erase(PREFIX, f"full_{old}")
-            tx.erase(PREFIX, f"inc_{old}")
+        keep = self._keep_epochs()
+        horizon = max(1, new_map.epoch - keep + 1)
+        first = self.first_committed()
+        if first <= 0:
+            # legacy store / fresh sync: bound the sweep — anything
+            # below one whole window before the horizon was already
+            # trimmed (or never written) by the previous owner
+            first = max(1, horizon - keep)
+        if horizon > first:
+            # multi-epoch trim: a DR restart or paxos sync can land the
+            # map many epochs ahead of the last trim point, so erase
+            # the WHOLE stale range, not just one epoch per commit
+            for e in range(first, horizon):
+                tx.erase(PREFIX, f"full_{e}")
+                tx.erase(PREFIX, f"inc_{e}")
+            self._inc_cache = {k: v for k, v in self._inc_cache.items()
+                               if k >= horizon}
+        tx.put(PREFIX, "first_committed", max(first, horizon))
 
     def _pending(self) -> Incremental:
         if self.pending is None or self.pending.epoch != self.osdmap.epoch + 1:
@@ -135,22 +174,48 @@ class OSDMonitor(PaxosService):
         return True
 
     def incrementals_since(self, epoch: int) -> list[dict]:
+        """Replayable incrementals (epoch, last]; [] when the gap is not
+        replayable so the caller falls back to a full map.  A subscriber
+        whose epoch predates the trim horizon is answered O(1) off the
+        first_committed key instead of probing the store per epoch."""
+        first = self.first_committed()
+        if first > 0 and epoch + 1 < first:
+            return []              # predates the trimmed horizon
         out = []
         for e in range(epoch + 1, self.osdmap.epoch + 1):
-            raw = self.store.get(PREFIX, f"inc_{e}")
-            if raw is None:
-                return []          # gap (trimmed): caller sends full map
-            out.append(decode(raw))
+            d = self._inc_cache.get(e)
+            if d is None:
+                raw = self.store.get(PREFIX, f"inc_{e}")
+                if raw is None:
+                    return []      # gap (trimmed): caller sends full map
+                d = decode(raw)
+                self._inc_cache[e] = d
+            out.append(d)
+        if len(self._inc_cache) > 2 * self._keep_epochs():
+            # bound on peons too, where _stage's trim never runs
+            horizon = self.osdmap.epoch - self._keep_epochs()
+            self._inc_cache = {k: v for k, v in self._inc_cache.items()
+                               if k > horizon}
         return out
 
     def full_map_dict(self) -> dict:
-        return self.osdmap.to_dict()
+        e = self.osdmap.epoch
+        if self._full_cache[0] != e or self._full_cache[1] is None:
+            self._full_cache = (e, self.osdmap.to_dict())
+        return self._full_cache[1]
 
     # -- boot / failure ---------------------------------------------------
     def prepare_boot(self, osd_id: int, addr: str, host: str) -> bool:
         """MOSDBoot: mark up, ensure crush location (OSDMonitor boot)."""
         if "noup" in self.osdmap.flags:
             log.dout(1, "noup set: ignoring boot from osd.%d", osd_id)
+            return False
+        if self.osdmap.epoch == 0:
+            # genesis race: concurrent boots can reach the leader
+            # before _propose_genesis commits the initial map, and the
+            # empty epoch-0 crush has no "default" root to hang the
+            # host bucket on; the OSD's send_boot loop retries until
+            # the post-genesis map shows it up
             return False
         info = self.osdmap.osds.get(osd_id)
         if info is not None and info.up and info.addr == addr:

@@ -16,6 +16,7 @@ orchestration around it.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import json
 import time
 from collections import deque
@@ -227,6 +228,10 @@ class OSDDaemon:
         # merge deferral retry (one in flight; _scan_pgs serialized)
         self._merge_retry_pending = False
         self._scan_lock = asyncio.Lock()
+        # pool_id -> PoolTables snapshot from the last COMPLETED scan:
+        # the next scan diffs the current tables against these (one
+        # array compare per pool) instead of walking every PG
+        self._scan_tables: dict[int, object] = {}
         self._booted = False
         self._reboot_epoch = 0
         self._map_lock = DLock("osd-map")
@@ -1399,11 +1404,30 @@ class OSDDaemon:
             # until it sees itself up.  The epoch that shows us up
             # triggers the real scan.
             return
+        new_tables: dict[int, object] = {}
         for pool in m.pools.values():
-            for ps in range(pool.pg_num):
-                up, up_primary, acting, primary = m.pg_to_up_acting(
-                    pool.pool_id, ps
-                )
+            # Whole-pool tables from the epoch-cached bulk mapping
+            # (placement/mapping.py), then a vectorized candidate set:
+            # the scalar loop's body is a no-op for any PG that is
+            # neither already held (self.pgs) nor in our up/acting set,
+            # so iterating owned ∪ changed (diff vs the last completed
+            # scan's tables) — or owned ∪ mine when no prior snapshot
+            # exists — visits exactly the PGs the full walk would act
+            # on, without O(pg_num) Python CRUSH walks per map change.
+            tables = m.mapping().up_acting_tables(pool.pool_id)
+            new_tables[pool.pool_id] = tables
+            owned = {pgid.ps for pgid in self.pgs
+                     if pgid.pool == pool.pool_id}
+            prev = self._scan_tables.get(pool.pool_id)
+            if prev is not None:
+                cand = owned | {int(p) for p in tables.diff(prev)}
+            else:
+                cand = owned | {int(p) for p in
+                                tables.pgs_of(self.osd_id)}
+            for ps in sorted(cand):
+                if ps >= pool.pg_num:
+                    continue
+                up, up_primary, acting, primary = tables.lookup(ps)
                 pgid = PGId(pool.pool_id, ps)
                 mine = self.osd_id in acting or self.osd_id in up
                 pg = self.pgs.get(pgid)
@@ -1444,6 +1468,9 @@ class OSDDaemon:
                         pg.peering_task = asyncio.create_task(
                             self._peer(pg)
                         )
+        # snapshot only on completion: a skipped scan (self-down gate)
+        # must keep diffing against the last view we actually acted on
+        self._scan_tables = new_tables
 
     async def _ensure_collections(self, pg: PG, acting: list[int]) -> None:
         tx = StoreTx()
@@ -4631,6 +4658,20 @@ class OSDDaemon:
         asyncio.get_running_loop().create_task(_send())
 
     # -- heartbeats ------------------------------------------------------------
+    def _heartbeat_peers(self) -> set[int]:
+        """Up peers this OSD pings (maybe_update_heartbeat_peers role).
+        With osd_heartbeat_peer_limit set, only the next ``limit`` up
+        OSDs in id order (ring successors) — every OSD is then still
+        watched by ``limit`` predecessors, but a 200-daemon cluster
+        holds O(n·limit) connections instead of an O(n²) full mesh."""
+        up = sorted(o for o, info in self.osdmap.osds.items()
+                    if info.up and o != self.osd_id)
+        limit = int(self.conf["osd_heartbeat_peer_limit"])
+        if limit <= 0 or len(up) <= limit:
+            return set(up)
+        idx = bisect.bisect_left(up, self.osd_id)
+        return {up[(idx + j) % len(up)] for j in range(limit)}
+
     async def _heartbeat_loop(self) -> None:
         """Peer liveness (handle_osd_ping bookkeeping, OSD.cc:5236)."""
         interval = self.conf["osd_heartbeat_interval"]
@@ -4666,11 +4707,13 @@ class OSDDaemon:
                 slow_total=self.op_tracker.slow_ops,
             )
             now = time.monotonic()
-            for osd, info in self.osdmap.osds.items():
-                if osd == self.osd_id or not info.up:
+            peers = self._heartbeat_peers()
+            for osd in list(self._hb_last_rx.keys() |
+                            self._hb_first_tx.keys()):
+                if osd not in peers:
                     self._hb_last_rx.pop(osd, None)
                     self._hb_first_tx.pop(osd, None)
-                    continue
+            for osd in peers:
                 self._send_osd(osd, Message(
                     "osd_ping", {"from": self.osd_id, "ts": now},
                     priority=PRIO_HIGH,

@@ -512,6 +512,14 @@ class ECBackend:
         self.k = codec.get_data_chunk_count()
         self.n = codec.get_chunk_count()
         self.m = self.n - self.k
+        # Physical shard ids holding the LOGICAL data chunks, in logical
+        # order (ECUtil chunk_mapping role).  Mapped layouts (LRC
+        # "DDD__..." interleaves parity between data groups) place data
+        # at chunk_mapping[:k], NOT 0..k-1 — reads must gather from
+        # these shards or they would return parity bytes as data.
+        cm = getattr(codec, "chunk_mapping", None)
+        self.data_shards = ([int(cm[i]) for i in range(self.k)] if cm
+                            else list(range(self.k)))
         unit = stripe_unit or codec.get_chunk_size(0)
         align = getattr(codec, "get_alignment", lambda: 1)()
         if unit % align:
@@ -1411,7 +1419,7 @@ class ECBackend:
         ssize = self.sinfo.logical_to_next_chunk_offset(old_size)
         need = min(coff + clen, ssize)
         segs = []
-        for i in range(self.k):
+        for i in self.data_shards:
             ent = self.resident.get(self.resident_ns, oid, i)
             if ent is None or (version is not None
                                and ent.version != version):
@@ -1748,7 +1756,7 @@ class ECBackend:
         coff = self.sinfo.aligned_logical_offset_to_chunk_offset(offset)
         ssize = self.sinfo.logical_to_next_chunk_offset(obj_size)
 
-        want = list(range(self.k))
+        want = list(self.data_shards)
         if self.hedge_timeout:
             chunks = await self._read_chunks_hedged(
                 oid, coff, clen, ssize, version, want
@@ -1758,20 +1766,20 @@ class ECBackend:
                 self._read_shard_range(i, oid, coff, clen, ssize, version)
                 for i in want
             ), return_exceptions=True)
-            missing = [i for i, r in enumerate(results)
+            missing = [s for s, r in zip(want, results)
                        if isinstance(r, BaseException)]
             if missing:
                 chunks = await self._reconstruct(
                     oid, coff, clen, missing, results, ssize, version
                 )
             else:
-                chunks = {i: results[i] for i in want}
+                chunks = dict(zip(want, results))
         # the Objecter/client boundary: resident chunks materialize to
         # host HERE (one counted copy of the payload), not per-launch
         stripes = np.stack(
             [self._to_host(chunks[i]).reshape(nstripes,
                                               self.sinfo.chunk_size)
-             for i in range(self.k)], axis=1,
+             for i in self.data_shards], axis=1,
         )
         flat = self.sinfo.merge_stripes(stripes)
         return flat[:length].tobytes()
@@ -1862,9 +1870,11 @@ class ECBackend:
         missing: Sequence[int], partial, shard_size: int | None = None,
         version: int | None = None,
     ) -> dict[int, np.ndarray]:
-        """minimum_to_decode-driven repair read + batched decode."""
+        """minimum_to_decode-driven repair read + batched decode.
+        ``partial`` is aligned with the read path's want set (the data
+        shards, in logical order)."""
         have = {
-            i: r for i, r in enumerate(partial)
+            s: r for s, r in zip(self.data_shards, partial)
             if not isinstance(r, BaseException)
         }
         # Availability is discovered, not assumed: shards beyond the initial
@@ -1906,7 +1916,7 @@ class ECBackend:
         }
         out = await self._coalesced_decode(batched, list(missing))
         chunks = {}
-        for i in range(self.k):
+        for i in self.data_shards:
             if i in have:
                 chunks[i] = have[i]
             elif self._is_device(out[i]):
@@ -2523,11 +2533,14 @@ class ECBackend:
         nstripes = shard_len // self.sinfo.chunk_size
         stripes = np.stack(
             [reads[i].reshape(nstripes, self.sinfo.chunk_size)
-             for i in range(self.k)], axis=1,
+             for i in self.data_shards], axis=1,
         )
         recomputed = await self._coalesced_encode(stripes)
         inconsistent = []
-        for i in range(self.k, self.n):
+        for i in range(self.n):
+            if i in self.data_shards:
+                continue        # parity positions only (mapped layouts
+                                # interleave them between data groups)
             stored = reads[i].reshape(nstripes, self.sinfo.chunk_size)
             if not np.array_equal(recomputed[:, i], stored):
                 inconsistent.append(i)

@@ -240,6 +240,8 @@ class OSDMap:
         # never reused, even after pool deletion: a recycled id would
         # alias a dead pool's surviving shard objects into a new pool
         self.max_pool_id = 0
+        # lazily-attached OSDMapMapping (epoch-cached bulk CRUSH rows)
+        self._mapping = None
 
     # -- mutation via incrementals --------------------------------------
     def apply_incremental(self, inc: Incremental) -> None:
@@ -300,6 +302,11 @@ class OSDMap:
         if inc.new_crush is not None:
             self.crush = CrushMap.from_dict(inc.new_crush)
         self.epoch = inc.epoch
+        if self._mapping is not None:
+            # carry the bulk-mapping cache forward: overlay-only epochs
+            # (up/down, temps, upmaps, flags) keep every cached CRUSH
+            # row; crush/weight/pool changes drop only what they touch
+            self._mapping.note_incremental(inc)
 
     # -- queries ---------------------------------------------------------
     def is_up(self, osd: int) -> bool:
@@ -313,8 +320,25 @@ class OSDMap:
         return vec
 
     # -- placement pipeline ---------------------------------------------
+    def mapping(self):
+        """The map's OSDMapMapping (epoch-cached whole-PG-space CRUSH
+        rows + vectorized up/acting table builders); created lazily so
+        plain map construction/decode stays free."""
+        if self._mapping is None:
+            from ceph_tpu.placement.mapping import OSDMapMapping
+
+            self._mapping = OSDMapMapping(self)
+        return self._mapping
+
     def pg_to_raw_osds(self, pool_id: int, ps: int) -> list[int]:
-        """CRUSH evaluation (OSDMap.cc:2395 _pg_to_raw_osds)."""
+        """CRUSH evaluation (OSDMap.cc:2395 _pg_to_raw_osds) — a table
+        lookup into the epoch-cached bulk mapping (bit-identical to the
+        scalar walk, see placement/mapping.py)."""
+        return self.mapping().raw_row(pool_id, ps)
+
+    def _pg_to_raw_osds_scalar(self, pool_id: int, ps: int) -> list[int]:
+        """The per-PG scalar CRUSH walk — the bit-identity oracle for
+        the cached table path (property tests, bench.py --cfg11)."""
         pool = self.pools[pool_id]
         pps = pool.raw_pg_to_pps(ps)
         out = self.crush.do_rule(

@@ -42,14 +42,18 @@ class Balancer(MgrModule):
     """Upmap balancer: even out per-OSD PG counts.
 
     The reference balancer's upmap mode (src/pybind/mgr/balancer/
-    module.py + OSDMap::calc_pg_upmaps): find the most- and least-loaded
-    OSDs by PG count and move one PG between them with a persistent
-    ``osd pg-upmap-items`` remap.  One move per cycle keeps peering
-    churn bounded; convergence comes from repetition.
+    module.py + OSDMap::calc_pg_upmaps): rank OSDs by PG-count
+    deviation off the epoch-cached bulk table and propose a BATCH of
+    ``osd pg-upmap-items`` remaps per cycle (up to ``max_moves``),
+    re-ranking after each proposed move so every move targets the
+    current extremes.  Batching is what converges a 200-OSD cluster in
+    a handful of cycles instead of one-PG-per-cycle trickle; peering
+    churn stays bounded by the batch cap.
     """
 
     name = "balancer"
     max_deviation = 1          # stop when max-min <= this
+    max_moves = 8              # upmap proposals per cycle
 
     def __init__(self, mgr, active: bool = True):
         super().__init__(mgr)
@@ -60,22 +64,18 @@ class Balancer(MgrModule):
     def _pg_distribution(self):
         """(pg counts per up-OSD, pg -> up set) over all pools.
 
-        The full-map scan rides the vectorized bulk mapper (one
-        masked-numpy rule evaluation per pool instead of a per-PG
-        python loop — the OSDMapMapping role); upmap/pg_temp overrides
-        still apply per PG on top of the raw CRUSH rows."""
-        from ceph_tpu.placement.bulk import map_pgs_bulk
-
+        The full-map scan reads the map's OSDMapMapping cache (one
+        vectorized rule evaluation per pool per epoch, shared with the
+        OSDs' peering rescans); upmap/pg_temp overrides still apply per
+        PG on top of the raw CRUSH rows."""
         m = self.mgr.monc.osdmap
         counts = {o: 0 for o, i in m.osds.items()
                   if i.up and i.in_cluster}
         placement = {}
-        rw = m.reweight_vector()
         for pool in m.pools.values():
-            xs = [pool.raw_pg_to_pps(ps) for ps in range(pool.pg_num)]
-            raw_rows = map_pgs_bulk(m.crush, pool.crush_rule, xs,
-                                    pool.size, rw)
-            for ps, raw in enumerate(raw_rows):
+            raw_rows, lens = m.mapping().raw_rows(pool.pool_id)
+            for ps in range(pool.pg_num):
+                raw = raw_rows[ps, :int(lens[ps])]
                 up = m.raw_row_to_up(pool.pool_id, ps,
                                      [int(o) for o in raw])
                 placement[(pool.pool_id, ps)] = up
@@ -90,35 +90,61 @@ class Balancer(MgrModule):
         counts, placement = self._pg_distribution()
         if len(counts) < 2:
             return
-        hot = max(counts, key=lambda o: counts[o])
-        cold = min(counts, key=lambda o: counts[o])
-        if counts[hot] - counts[cold] <= self.max_deviation:
-            self.last_optimize = "balanced"
-            return
         m = self.mgr.monc.osdmap
-        for (pid, ps), up in placement.items():
-            if hot in up and cold not in up:
-                # hot may sit in the up set via an existing (a -> hot)
-                # remap; rewriting that pair to (a -> cold) keeps one
-                # hop per raw slot (appending (hot, cold) would be dead
-                # weight: hot is not in the raw set)
-                pairs = list(m.pg_upmap_items.get((pid, ps), []))
-                for i, (frm, to) in enumerate(pairs):
-                    if to == hot:
-                        pairs[i] = (frm, cold)
-                        break
-                else:
-                    pairs.append((hot, cold))
-                r = await self.mgr.monc.command(
-                    "osd pg-upmap-items", pgid=f"{pid}.{ps}",
-                    mappings=[list(p) for p in pairs],
-                )
-                if r["rc"] == 0:
-                    self.optimizations += 1
-                    self.last_optimize = (
-                        f"moved pg {pid}.{ps} osd.{hot} -> osd.{cold}"
-                    )
-                return
+        moved: set[tuple[int, int]] = set()
+        moves = 0
+        while moves < self.max_moves:
+            hot = max(counts, key=lambda o: counts[o])
+            cold = min(counts, key=lambda o: counts[o])
+            diff = counts[hot] - counts[cold]
+            if diff <= self.max_deviation:
+                if moves == 0:
+                    self.last_optimize = "balanced"
+                break
+            if moves > 0 and diff < 2:
+                # a further move would only swap the extremes, not
+                # shrink the spread — stop the batch here
+                break
+            pgid = next(
+                ((pid, ps) for (pid, ps), up in placement.items()
+                 if hot in up and cold not in up
+                 and (pid, ps) not in moved),
+                None,
+            )
+            if pgid is None:
+                break
+            pid, ps = pgid
+            up = placement[pgid]
+            # hot may sit in the up set via an existing (a -> hot)
+            # remap; rewriting that pair to (a -> cold) keeps one
+            # hop per raw slot (appending (hot, cold) would be dead
+            # weight: hot is not in the raw set)
+            pairs = list(m.pg_upmap_items.get(pgid, []))
+            for i, (frm, to) in enumerate(pairs):
+                if to == hot:
+                    pairs[i] = (frm, cold)
+                    break
+            else:
+                pairs.append((hot, cold))
+            r = await self.mgr.monc.command(
+                "osd pg-upmap-items", pgid=f"{pid}.{ps}",
+                mappings=[list(p) for p in pairs],
+            )
+            if r["rc"] != 0:
+                break
+            moved.add(pgid)
+            moves += 1
+            self.optimizations += 1
+            self.last_optimize = (
+                f"moved pg {pid}.{ps} osd.{hot} -> osd.{cold}"
+                + (f" (+{moves - 1} more this cycle)" if moves > 1
+                   else "")
+            )
+            # re-rank off the proposed state so the next move targets
+            # the NEW extremes without a full re-scan
+            counts[hot] -= 1
+            counts[cold] += 1
+            placement[pgid] = [cold if o == hot else o for o in up]
 
     def digest_contrib(self) -> dict:
         return {"balancer": {

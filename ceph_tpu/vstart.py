@@ -10,6 +10,7 @@ cluster-connection file the CLI reads.
 
 from __future__ import annotations
 
+import asyncio
 import json
 
 from ceph_tpu.client.rados import Rados
@@ -27,6 +28,29 @@ FAST_TEST_OVERRIDES = {
     "osd_heartbeat_interval": 0.2, "osd_heartbeat_grace": 3.0,
 }
 
+# Lightweight-OSD profile for hundreds of daemons in one process.
+# Heartbeats are all-to-all (every OSD pings every up peer each
+# interval, O(n²) messages): at 200 OSDs the fast-test 0.2 s interval
+# would push ~200k pings/s through the shared event loop, so the scale
+# profile stretches liveness timers instead of shrinking them, and
+# turns off per-OSD background loops that add nothing to a control-
+# plane drill (tiering agent; scrub is already opt-in).
+SCALE_TEST_OVERRIDES = {
+    "mon_lease": 2.0, "mon_lease_interval": 0.5,
+    "mon_election_timeout": 1.0, "mon_tick_interval": 0.5,
+    "mon_accept_timeout": 2.0,
+    # fold each boot/failure burst into one map epoch instead of one
+    # paxos round + full subscription fan-out per daemon
+    "paxos_propose_interval": 0.25,
+    "osd_heartbeat_interval": 5.0, "osd_heartbeat_grace": 60.0,
+    # ring-subset heartbeats: the all-to-all mesh at 200 OSDs means
+    # 40k connections (80k reader/writer tasks) in one event loop
+    "osd_heartbeat_peer_limit": 8,
+    "osd_agent_interval": 0.0,
+    "osd_ec_resident": False,
+    "osd_pg_log_max_entries": 32,
+}
+
 
 class DevCluster:
     def __init__(self, n_mons: int = 1, n_osds: int = 3,
@@ -35,7 +59,8 @@ class DevCluster:
                  store_kind: str = "wal",
                  cephx: bool = False, ns: str = "",
                  monmap: dict[str, str] | None = None,
-                 osds_per_host: int = 1):
+                 osds_per_host: int = 1,
+                 scale: bool = False, boot_batch: int | None = None):
         """``ns``: local:// address namespace prefix so several
         DevClusters (zones) can coexist in one process (the multi-zone
         / geo-replication test topology).  ``monmap``: explicit
@@ -43,10 +68,19 @@ class DevCluster:
         path boots a rebuilt cluster against a monmaptool-authored
         quorum this way.  ``osds_per_host``: pack that many OSDs onto
         each CRUSH host (host{id // osds_per_host}) so failure-domain
-        host rules and whole-host failure drills have real topology."""
+        host rules and whole-host failure drills have real topology.
+        ``scale``: apply SCALE_TEST_OVERRIDES (lightweight-OSD profile
+        for 200+ daemons) and boot OSDs in concurrent batches.
+        ``boot_batch``: OSDs booted concurrently per wave in start();
+        defaults to 16 under the scale profile, else 1 (sequential)."""
         self.n_mons = n_mons
         self.n_osds = n_osds
+        self.scale = scale
+        self.boot_batch = (boot_batch if boot_batch is not None
+                           else (32 if scale else 1))
         self.overrides = dict(FAST_TEST_OVERRIDES)
+        if scale:
+            self.overrides.update(SCALE_TEST_OVERRIDES)
         self.overrides.update(overrides or {})
         self.cephx = cephx
         if cephx:
@@ -112,8 +146,16 @@ class DevCluster:
                 assert r["rc"] == 0, r
                 self._entity_keys[f"osd.{i}"] = r["data"]["key"]
             await admin.shutdown()
-        for i in range(self.n_osds):
-            await self.start_osd(i)
+        batch = max(1, self.boot_batch)
+        for lo in range(0, self.n_osds, batch):
+            ids = range(lo, min(lo + batch, self.n_osds))
+            if batch == 1:
+                await self.start_osd(lo)
+            else:
+                # concurrent boots coalesce into few map epochs: the
+                # mon folds every boot that lands in one paxos round
+                # into a single pending incremental
+                await asyncio.gather(*(self.start_osd(i) for i in ids))
 
     def _make_osd_store(self, osd_id: int) -> ObjectStore:
         """With a store_dir, OSD data is durable and a revived OSD

@@ -53,6 +53,15 @@
 #                                   nonzero p50/p99 from the histogram
 #                                   layer, an SLO verdict present in
 #                                   the digest, and zero loadgen errors
+#   scripts/tier1.sh --scale-smoke  O(cluster) control plane at scale:
+#                                   a 200-OSD / 3-mon vstart cluster on
+#                                   the lightweight scale profile —
+#                                   quorum of 3, a 512-PG pool mapped
+#                                   evenly (PG/OSD coefficient of
+#                                   variation < 0.6, no empty OSD
+#                                   bucket), every OSD observing the
+#                                   pool epoch within a 60s deadline,
+#                                   and a bit-identical write/read-back
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -623,6 +632,104 @@ async def main():
 asyncio.run(main())
 EOF
     echo "SERVE_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--scale-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    python - <<'EOF'
+import asyncio
+import time
+
+import numpy as np
+
+N_OSDS = 200
+PG_NUM = 512
+PROP_DEADLINE = 60.0    # s: every OSD must observe the pool epoch
+CV_BOUND = 0.6          # PG/OSD stddev/mean across the cluster
+
+
+async def main():
+    from ceph_tpu.vstart import DevCluster
+
+    t0 = time.monotonic()
+    cluster = DevCluster(n_mons=3, n_osds=N_OSDS, scale=True,
+                         osds_per_host=4)
+    await cluster.start()
+    print(f"booted {N_OSDS} osds in {time.monotonic() - t0:.1f}s")
+    rados = await cluster.client()
+    try:
+        # 1. quorum: all three monitors in
+        q = await rados.mon_command("quorum_status", timeout=30)
+        assert q["rc"] == 0, q
+        quorum = q["data"]["quorum"]
+        assert len(quorum) == 3, f"quorum degraded: {quorum}"
+        print(f"quorum: {quorum}")
+
+        # 2. pool create + map propagation deadline: every OSD must
+        # observe an epoch >= the pool's birth epoch
+        r = await rados.mon_command("osd pool create", pool="scale",
+                                    pg_num=PG_NUM, timeout=60)
+        assert r["rc"] == 0, r
+        mon = next(iter(cluster.mons.values()))
+        target = mon.osd_monitor.osdmap.epoch
+        deadline = time.monotonic() + PROP_DEADLINE
+        while True:
+            lag = sum(1 for o in cluster.osds.values()
+                      if o.osdmap is None or o.osdmap.epoch < target)
+            if lag == 0:
+                break
+            assert time.monotonic() < deadline, \
+                f"{lag} osds still behind epoch {target}"
+            await asyncio.sleep(0.2)
+        print(f"epoch {target} on all {N_OSDS} osds "
+              f"@{time.monotonic() - t0:.1f}s")
+
+        # 3. even PG distribution off the client's cached bulk table
+        while rados.monc.osdmap.epoch < target:
+            await asyncio.sleep(0.1)
+        m = rados.monc.osdmap
+        pool = next(p for p in m.pools.values() if p.name == "scale")
+        tables = m.mapping().up_acting_tables(pool.pool_id)
+        counts = np.zeros(N_OSDS, dtype=int)
+        for ps in range(pool.pg_num):
+            up, _, _, _ = tables.lookup(ps)
+            for o in up:
+                if o >= 0:
+                    counts[o] += 1
+        mean, std = counts.mean(), counts.std()
+        cv = std / mean
+        print(f"pg/osd mean={mean:.2f} std={std:.2f} cv={cv:.2f} "
+              f"min={counts.min()} max={counts.max()}")
+        assert cv < CV_BOUND, f"uneven distribution: cv={cv:.2f}"
+        assert counts.min() >= 1, "an OSD holds zero PGs"
+
+        # 4. e2e I/O once all primaries are active
+        deadline = time.monotonic() + PROP_DEADLINE
+        while True:
+            active = sum(1 for o in cluster.osds.values()
+                         for pg in o.pgs.values()
+                         if pg.is_primary and "active" in str(pg.state))
+            if active >= PG_NUM:
+                break
+            assert time.monotonic() < deadline, \
+                f"only {active}/{PG_NUM} primaries active"
+            await asyncio.sleep(0.5)
+        ioctx = await rados.open_ioctx("scale")
+        payload = bytes(range(256)) * 256     # 64 KiB
+        await ioctx.write_full("scale-smoke-obj", payload)
+        got = await ioctx.read("scale-smoke-obj")
+        assert got == payload, "read-back mismatch"
+        print(f"e2e write/read ok @{time.monotonic() - t0:.1f}s")
+    finally:
+        await rados.shutdown()
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+    echo "SCALE_SMOKE_PASSED"
     exit 0
 fi
 

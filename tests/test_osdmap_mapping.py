@@ -1,0 +1,154 @@
+"""OSDMapMapping property tests: the epoch-cached whole-PG-space table
+must be bit-identical to the scalar per-PG CRUSH walk across randomized
+maps with upmap/pg_temp/primary_temp overlays, down OSDs, and reweights
+(including the raw_row_to_up shared path the DR osdmaptool relies on)."""
+
+import random
+
+import pytest
+
+from ceph_tpu.osd.osd_map import Incremental, NO_OSD, OSDMap, PoolInfo
+from ceph_tpu.placement.crush_map import CrushMap
+
+
+def _scalar_up_acting(m, pool_id, ps):
+    """pg_to_up_acting recomputed from the scalar walk — the oracle the
+    cached table must match bit-for-bit."""
+    up = m.raw_row_to_up(pool_id, ps, m._pg_to_raw_osds_scalar(pool_id, ps))
+    acting = list(m.pg_temp.get((pool_id, ps), up))
+    if not acting:
+        acting = up
+    primary = m.primary_temp.get((pool_id, ps))
+    up_primary = next((o for o in up if o != NO_OSD), NO_OSD)
+    acting_primary = (
+        primary if primary is not None
+        else next((o for o in acting if o != NO_OSD), NO_OSD)
+    )
+    return up, up_primary, acting, acting_primary
+
+
+def _random_map(rng, n_hosts=None, osds_per=None):
+    n_hosts = n_hosts or rng.randint(3, 8)
+    osds_per = osds_per or rng.randint(1, 4)
+    crush = CrushMap()
+    root = crush.add_bucket("default", "root")
+    osd = 0
+    for h in range(n_hosts):
+        host = crush.add_bucket(f"host{h}", "host")
+        for _ in range(osds_per):
+            crush.add_item(host, osd, rng.choice([0.5, 1.0, 1.0, 2.0]))
+            osd += 1
+        crush.add_item(root, host)
+    crush.create_replicated_rule("replicated_rule", failure_domain="host")
+    crush.create_ec_rule("ec_rule", chunk_count=min(6, osd),
+                         failure_domain="osd")
+    m = OSDMap(crush)
+    inc = Incremental(1)
+    for i in range(osd):
+        inc.new_up[i] = f"osd.{i}:1{i:04d}"
+    inc.new_pools.append(PoolInfo(
+        1, "repl", "replicated", size=min(3, n_hosts),
+        pg_num=rng.choice([8, 16, 32]),
+    ))
+    inc.new_pools.append(PoolInfo(
+        2, "ec", "erasure", size=min(6, osd),
+        pg_num=rng.choice([8, 16]), crush_rule="ec_rule",
+    ))
+    m.apply_incremental(inc)
+    return m, osd
+
+
+def _random_overlays(rng, m, n_osds):
+    """Stage a random mutation batch as one incremental: down OSDs,
+    reweights, upmap pairs, pg_temp / primary_temp entries."""
+    inc = Incremental(m.epoch + 1)
+    up_now = [o for o, info in m.osds.items() if info.up]
+    for o in rng.sample(up_now, k=min(len(up_now) - 1, rng.randint(0, 2))):
+        inc.new_down.append(o)
+    for o in rng.sample(range(n_osds), k=rng.randint(0, 2)):
+        inc.new_weights[o] = rng.choice([0, 0x8000, 0x10000])
+    for pool_id, pg_num in ((1, m.pools[1].pg_num), (2, m.pools[2].pg_num)):
+        for _ in range(rng.randint(0, 3)):
+            ps = rng.randrange(pg_num)
+            frm, to = rng.sample(range(n_osds), 2)
+            inc.new_pg_upmap_items[(pool_id, ps)] = [(frm, to)]
+        for _ in range(rng.randint(0, 2)):
+            ps = rng.randrange(pg_num)
+            k = m.pools[pool_id].size
+            inc.new_pg_temp[(pool_id, ps)] = rng.sample(
+                range(n_osds), min(k, n_osds))
+        for _ in range(rng.randint(0, 2)):
+            ps = rng.randrange(pg_num)
+            inc.new_primary_temp[(pool_id, ps)] = rng.randrange(n_osds)
+    return inc
+
+
+def _assert_map_identical(m):
+    mapping = m.mapping()
+    for pool_id, pool in m.pools.items():
+        tables = mapping.up_acting_tables(pool_id)
+        for ps in range(pool.pg_num):
+            assert mapping.raw_row(pool_id, ps) == \
+                m._pg_to_raw_osds_scalar(pool_id, ps), \
+                f"raw row drift pool={pool_id} ps={ps}"
+            want = _scalar_up_acting(m, pool_id, ps)
+            assert m.pg_to_up_acting(pool_id, ps) == want, \
+                f"pg_to_up_acting drift pool={pool_id} ps={ps}"
+            assert tables.lookup(ps) == want, \
+                f"PoolTables.lookup drift pool={pool_id} ps={ps}"
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_table_bit_identical_random_maps(seed):
+    rng = random.Random(seed)
+    m, n_osds = _random_map(rng)
+    _assert_map_identical(m)
+    # mutate through a few epochs of random overlays; the mapping is
+    # carried forward via note_incremental, never rebuilt from scratch
+    for _ in range(4):
+        m.apply_incremental(_random_overlays(rng, m, n_osds))
+        _assert_map_identical(m)
+
+
+def test_overlay_epochs_reuse_raw_rows():
+    """An overlay-only incremental (upmap/temp, no crush or weight
+    change) must NOT rebuild the cached CRUSH rows."""
+    rng = random.Random(99)
+    m, n_osds = _random_map(rng, n_hosts=4, osds_per=2)
+    mapping = m.mapping()
+    _assert_map_identical(m)
+    before = mapping.rebuilds
+    inc = Incremental(m.epoch + 1)
+    inc.new_pg_upmap_items[(1, 0)] = [(0, 5)]
+    inc.new_pg_temp[(1, 1)] = [1, 2, 3]
+    inc.new_primary_temp[(1, 2)] = 4
+    m.apply_incremental(inc)
+    _assert_map_identical(m)
+    assert mapping.rebuilds == before
+
+    # a reweight DOES invalidate (placement genuinely changes)
+    m.apply_incremental(Incremental(m.epoch + 1, new_weights={0: 0x8000}))
+    _assert_map_identical(m)
+    assert mapping.rebuilds > before
+
+
+def test_pgs_of_and_diff_match_lookups():
+    rng = random.Random(7)
+    m, n_osds = _random_map(rng, n_hosts=5, osds_per=2)
+    mapping = m.mapping()
+    tables = mapping.up_acting_tables(1)
+    for osd in range(n_osds):
+        want = {
+            ps for ps in range(m.pools[1].pg_num)
+            if any(osd in s for s in (tables.lookup(ps)[0],
+                                      tables.lookup(ps)[2]))
+        }
+        assert set(int(p) for p in tables.pgs_of(osd)) == want
+    prev = tables
+    victim = next(o for o, info in m.osds.items() if info.up)
+    m.apply_incremental(Incremental(m.epoch + 1, new_down=[victim]))
+    cur = m.mapping().up_acting_tables(1)
+    changed = {int(p) for p in cur.diff(prev)}
+    for ps in range(m.pools[1].pg_num):
+        if cur.lookup(ps) != prev.lookup(ps):
+            assert ps in changed, f"diff missed changed pg {ps}"
