@@ -1195,6 +1195,30 @@ def _cfg10_serve(seed: int = 0, ops_per_phase: int = 240,
                           "worst_daemon", "samples")} for e in evals],
                 "pass": all(e["ok"] for e in evals),
             }
+            # flight-recorder: every phase verdict carries its forensic
+            # bundle (id + on-disk path + worst daemon) into the
+            # BENCH_LOCAL.jsonl record, so a failed phase can be
+            # replayed offline with `ceph-tpu forensics show <id>`.
+            # worst_daemon mirrors the SLO payload's choice: the worst
+            # daemon of the hottest-burning failed objective.
+            worst = ""
+            bad = [e for e in evals if not e["ok"]]
+            if bad:
+                worst = max(bad, key=lambda e: e["burn_rate"]) \
+                    .get("worst_daemon") or ""
+            try:
+                entry = await mgr.forensics_capture(
+                    f"serve:{name}:"
+                    + ("pass" if rec["pass"] else "fail"),
+                    worst_daemon=worst,
+                    detail={"phase": name, "seed": seed,
+                            "pass": rec["pass"]})
+                rec["forensics"] = {"id": entry["id"],
+                                    "bundle": entry["path"],
+                                    "worst_daemon":
+                                        entry["worst_daemon"]}
+            except (ConnectionError, TimeoutError):
+                rec["forensics"] = None
             phases.append(rec)
             return rec
 

@@ -985,6 +985,18 @@ def build_parser() -> argparse.ArgumentParser:
     trace.add_argument("trace_id", help="trace id from a span dump "
                        "or a slow-op record")
 
+    forn = sub.add_parser("forensics")
+    forn_sub = forn.add_subparsers(dest="action", required=True)
+    fls = forn_sub.add_parser("ls")
+    fls.add_argument("--dir", default="",
+                     help="bundle dir (default <tmp>/ceph_tpu_forensics)")
+    fsh = forn_sub.add_parser("show")
+    fsh.add_argument("bundle_id")
+    fsh.add_argument("--dir", default="",
+                     help="bundle dir (default <tmp>/ceph_tpu_forensics)")
+    fsh.add_argument("--limit", type=int, default=None,
+                     help="render only the last N timeline events")
+
     daemon = sub.add_parser("daemon")
     daemon.add_argument("target", help="osd.N, or a path to an .asok")
     daemon.add_argument(
@@ -1100,6 +1112,72 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _run_forensics(args) -> int:
+    """`ceph-tpu forensics ls|show`: offline flight-recorder reader.
+
+    Bundles are plain JSON files the mgr persisted at capture time, so
+    the forensic record stays readable after the cluster (or the whole
+    process) is gone — no rados connection is attempted.
+    """
+    import os
+    import tempfile
+
+    from ceph_tpu.common.events import render_timeline
+
+    j = args.format == "json"
+    d = args.dir or os.path.join(tempfile.gettempdir(),
+                                 "ceph_tpu_forensics")
+    if args.action == "ls":
+        rows = []
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            names = []
+        for fn in names:
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(d, fn)) as f:
+                    b = json.load(f)
+            except (OSError, ValueError):
+                continue
+            rows.append({"id": b.get("id", fn[:-5]),
+                         "reason": b.get("reason", ""),
+                         "captured_at": b.get("captured_at", 0),
+                         "worst_daemon": b.get("worst_daemon", ""),
+                         "events": len(b.get("timeline", [])),
+                         "daemons": sorted(b.get("daemons", {}))})
+        if j:
+            _print({"bundles": rows}, True)
+            return 0
+        if not rows:
+            print(f"(no forensic bundles under {d})")
+            return 0
+        for r in rows:
+            print(f"{r['id']:<30} {r['reason']:<16} "
+                  f"worst={r['worst_daemon'] or '-':<10} "
+                  f"events={r['events']:<5} "
+                  f"daemons={','.join(r['daemons'])}")
+        return 0
+    # show <bundle_id>
+    path = os.path.join(d, f"{args.bundle_id}.json")
+    try:
+        with open(path) as f:
+            b = json.load(f)
+    except (OSError, ValueError):
+        print(f"Error: no bundle {args.bundle_id!r} under {d}",
+              file=sys.stderr)
+        return 1
+    if j:
+        _print(b, True)
+        return 0
+    print(f"bundle {b.get('id')}  reason={b.get('reason')}  "
+          f"worst_daemon={b.get('worst_daemon') or '-'}  "
+          f"daemons={','.join(sorted(b.get('daemons', {})))}")
+    print(render_timeline(b.get("timeline", []), limit=args.limit))
+    return 0
+
+
 # offline tool passthrough: `ceph-tpu tool <name> ...` hands argv to
 # the DR tool suite's own entry points.  These operate on STOPPED
 # daemons' store directories, so no cluster connection is attempted —
@@ -1124,6 +1202,8 @@ def main(argv: list[str] | None = None) -> int:
 
         return importlib.import_module(_TOOLS[argv[1]]).main(argv[2:])
     args = build_parser().parse_args(argv)
+    if args.cmd == "forensics":
+        return _run_forensics(args)
     return asyncio.run(_run(args))
 
 

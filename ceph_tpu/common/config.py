@@ -189,6 +189,21 @@ def global_options() -> list[Option]:
                "how many of the slowest ops keep their full event "
                "timeline + span tree (dump_historic_slow_ops)",
                Level.ADVANCED, min=1),
+        Option("event_journal_size", int, 2048,
+               "bound of each daemon's flight-recorder event ring "
+               "(common/events.py EventJournal)", Level.ADVANCED,
+               min=16),
+        Option("forensics_window_s", float, 60.0,
+               "trailing seconds of each event journal snapshotted "
+               "into a forensic bundle on capture", min=1.0,
+               runtime=True),
+        Option("forensics_dir", str, "",
+               "directory where the mgr persists forensic bundles "
+               "('' = <tempdir>/ceph_tpu_forensics)", runtime=True),
+        Option("forensics_cooldown_s", float, 30.0,
+               "min seconds between automatic forensic captures (a "
+               "flapping health check must not storm bundles)",
+               Level.ADVANCED, min=0.0, runtime=True),
         Option("ms_secure_mode", bool, False,
                "AES-256-GCM on-wire frame encryption (crypto_onwire "
                "analog); needs a configured auth key on every daemon"),

@@ -52,6 +52,7 @@ import errno as _errno
 import random
 from dataclasses import dataclass
 
+from ceph_tpu.common import events
 from ceph_tpu.common.log import Dout
 from ceph_tpu.common.perf import CounterType, PerfCounters
 
@@ -192,6 +193,9 @@ def _eval(name: str) -> FailPoint | None:
             _recompute_active()
     f.fired += 1
     perf.inc("hit")
+    # flight recorder: failpoints are process-global, so firings land
+    # in the shared process journal rather than one daemon's ring
+    events.emit_proc("failpoint.fired", name=f.name, mode=f.mode)
     return f
 
 

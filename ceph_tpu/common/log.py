@@ -97,3 +97,10 @@ def dump_recent(file=None) -> list[str]:
         print(line, file=out)
     print("--- end dump of recent events ---", file=out)
     return lines
+
+
+def recent_lines(count: int = 200) -> list[str]:
+    """Tail of the log ring, bounded and side-effect-free: the asok
+    ``log dump`` handler (the full ring can exceed the line-framed
+    socket protocol's limit in a long-lived process)."""
+    return _ring.dump()[-max(1, int(count)):]

@@ -71,6 +71,16 @@ class Tracer:
     def __init__(self, entity: str):
         self.entity = entity
         self.spans: deque[dict] = deque(maxlen=_RING)
+        #: spans pushed out of the bounded ring before collection —
+        #: each eviction is a potential orphan in a later
+        #: ``assemble_tree``, so span loss must be visible *before*
+        #: a trace is pulled (perf counter / prom gauge)
+        self.ring_evictions = 0
+
+    def _append(self, span: dict) -> None:
+        if len(self.spans) == self.spans.maxlen:
+            self.ring_evictions += 1
+        self.spans.append(span)
 
     @contextmanager
     def span(self, name: str, parent: SpanCtx | None = None, **tags):
@@ -87,7 +97,7 @@ class Tracer:
         try:
             yield ctx
         finally:
-            self.spans.append({
+            self._append({
                 "trace_id": ctx.trace_id,
                 "span_id": ctx.span_id,
                 "parent": parent.span_id if parent else "",
@@ -106,7 +116,7 @@ class Tracer:
         traces at once, so the one measured interval is recorded once
         per interested parent."""
         ctx = SpanCtx(parent.trace_id, secrets.token_hex(4))
-        self.spans.append({
+        self._append({
             "trace_id": ctx.trace_id,
             "span_id": ctx.span_id,
             "parent": parent.span_id,
@@ -121,6 +131,15 @@ class Tracer:
     def dump(self, trace_id: str | None = None) -> list[dict]:
         return [s for s in self.spans
                 if trace_id is None or s["trace_id"] == trace_id]
+
+    def orphan_count(self) -> int:
+        """Spans currently in the ring whose parent has already fallen
+        out of it — what ``assemble_tree`` would tag ``orphan`` if a
+        collection ran now.  O(ring) walk; called at perf-dump time,
+        not on the span hot path."""
+        ids = {s["span_id"] for s in self.spans}
+        return sum(1 for s in self.spans
+                   if s.get("parent") and s["parent"] not in ids)
 
 
 def assemble_tree(spans: list[dict]) -> list[dict]:

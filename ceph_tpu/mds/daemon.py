@@ -328,6 +328,9 @@ class MDSDaemon:
                           "damage table entries")
             sock.register("damage rm", self.damage_rm,
                           "damage rm <id>: ack one entry")
+            from ceph_tpu.common.log import recent_lines
+            sock.register("log dump", recent_lines,
+                          "recent log ring (crash context)")
             fp.register_admin_commands(sock)
             await sock.start(run_dir)
             self.admin_socket = sock

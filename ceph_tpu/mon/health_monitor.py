@@ -76,8 +76,14 @@ class HealthMonitor(PaxosService):
         Returns (cluster-log entries, store mutations for mute expiry)."""
         checks = self.gather()
         logs: list[dict] = []
+        jr = getattr(self.mon, "journal", None)
+        epoch = self.mon.osd_monitor.osdmap.epoch
         for code, v in checks.items():
             if self._prev_codes.get(code) != v["severity"]:
+                if jr is not None:
+                    jr.emit("health.raise", epoch=epoch, code=code,
+                            severity=v["severity"],
+                            message=v["message"])
                 logs.append({
                     "who": f"mon.{self.mon.name}",
                     "level": "warn" if v["severity"] != "HEALTH_ERR"
@@ -88,6 +94,8 @@ class HealthMonitor(PaxosService):
         cleared_mutes = False
         for code in list(self._prev_codes):
             if code not in checks:
+                if jr is not None:
+                    jr.emit("health.clear", epoch=epoch, code=code)
                 logs.append({
                     "who": f"mon.{self.mon.name}",
                     "level": "info",

@@ -33,6 +33,7 @@ from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.service import EPERM_RC, CommandResult, EINVAL_RC
 from ceph_tpu.mon.sync import MonSync
 from ceph_tpu.mon.store import MonitorDBStore, StoreTransaction
+from ceph_tpu.common.events import EventJournal
 from ceph_tpu.common.tracing import Tracer
 from ceph_tpu.msg.codec import encode as codec_encode
 from ceph_tpu.msg.message import Message
@@ -93,6 +94,11 @@ class Monitor:
         # pulls the ring via the "dump_traces" mon command
         self.tracer = Tracer(f"mon.{name}")
         self.paxos.tracer = self.tracer
+        # flight recorder: map commits and health-check transitions
+        # land here; snapshotted into forensic bundles via the
+        # "dump_events" mon command
+        self.journal = EventJournal(
+            f"mon.{name}", size=int(self.conf["event_journal_size"]))
         self.sync = MonSync(self)
         self.osd_monitor = OSDMonitor(self)
         self.config_monitor = ConfigMonitor(self)
@@ -176,6 +182,13 @@ class Monitor:
                           "live configuration")
             sock.register("health", self.health_monitor.summary,
                           "aggregated health")
+            from ceph_tpu.common.log import recent_lines
+            sock.register("log dump", recent_lines,
+                          "recent log ring (crash context)")
+            sock.register("events dump", lambda: {
+                "stats": self.journal.stats(),
+                "events": self.journal.snapshot(),
+            }, "flight-recorder event journal (full ring)")
             fp.register_admin_commands(sock)
             await sock.start(run_dir)
             self.admin_socket = sock
@@ -703,6 +716,18 @@ class Monitor:
             return CommandResult(data={
                 "spans": (self.tracer.dump(tid)
                           + self.msgr.tracer.dump(tid)),
+            })
+        if name == "dump_events":
+            # this mon's flight-recorder ring (plus the process
+            # journal: failpoint/chaos/mesh events shared by every
+            # co-located daemon) — one shard of a forensic bundle
+            from ceph_tpu.common.events import proc_journal
+            w = cmd.get("window_s")
+            w = float(w) if w else None
+            return CommandResult(data={
+                "events": self.journal.snapshot(w),
+                "proc_events": proc_journal().snapshot(w),
+                "stats": self.journal.stats(),
             })
         return None
 

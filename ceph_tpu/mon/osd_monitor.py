@@ -73,6 +73,11 @@ class OSDMonitor(PaxosService):
         raw = self.store.get(PREFIX, f"full_{last}")
         if raw is not None:
             self.osdmap = OSDMap.from_dict(decode(raw))
+            jr = getattr(self.mon, "journal", None)
+            if jr is not None:
+                jr.emit("map.commit", epoch=self.osdmap.epoch,
+                        up=sum(1 for o in self.osdmap.osds.values()
+                               if o.up))
         for ev in self._map_waiters:
             ev.set()
         for osd, info in self.osdmap.osds.items():

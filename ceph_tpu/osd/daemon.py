@@ -27,6 +27,7 @@ import hmac as hmac_mod
 import secrets as secrets_mod
 
 from ceph_tpu.common import failpoint as fp
+from ceph_tpu.common.events import EventJournal
 from ceph_tpu.common.lockdep import DLock
 from ceph_tpu.common.config import ConfigProxy
 from ceph_tpu.common.crc32c import crc32c
@@ -212,6 +213,11 @@ class OSDDaemon:
         self._ungate_tasks: set[asyncio.Task] = set()
         self._tier_auth_state: dict[int, dict] = {}
         self.tracer = Tracer(self.entity)
+        # flight recorder: always-on bounded ring of structured events
+        # (map installs, PG transitions, queue-depth samples, ...) —
+        # the forensic substrate every capture snapshots from
+        self.journal = EventJournal(
+            self.entity, size=int(self.conf["event_journal_size"]))
         # op-LIFETIME memory bound on client payloads (the reference's
         # osd_client_message_size_cap throttle): held from op arrival to
         # completion, so a flood backpressures instead of ballooning RAM
@@ -280,6 +286,7 @@ class OSDDaemon:
         from ceph_tpu.osd.repair import RepairScheduler
         self.repair = RepairScheduler(
             self.perf, tracer=self.tracer,
+            journal=self.journal,
             op_scheduler=self.op_scheduler,
             use_mclock=self._use_mclock,
             max_batch_objects=int(
@@ -380,6 +387,13 @@ class OSDDaemon:
         out = self.perf.dump()
         for k, v in self.msgr.perf.dump().items():
             out[f"msgr_{k}"] = v
+        # tracer span-loss visibility (daemon + messenger rings): how
+        # many spans fell out of each bounded ring before a collection,
+        # and how many surviving spans already lost their parent
+        out["tracer_ring_evictions"] = (
+            self.tracer.ring_evictions + self.msgr.tracer.ring_evictions)
+        out["tracer_orphan_spans"] = (
+            self.tracer.orphan_count() + self.msgr.tracer.orphan_count())
         return out
 
     def _dump_traces_all(self, trace_id=None) -> list[dict]:
@@ -419,6 +433,7 @@ class OSDDaemon:
                 max_bytes=int(self.conf["osd_ec_resident_max_bytes"]),
                 perf=self.perf,
                 sharding=sharding,
+                journal=self.journal,
             )
         return self._resident_cache_obj
 
@@ -482,6 +497,24 @@ class OSDDaemon:
             out[str(pgid)] = be.resident_stats()
         return out
 
+    def _forensics_snapshot(self, window_s=None) -> dict:
+        """One daemon's contribution to a forensic bundle: the trailing
+        window of the event journal plus the slow-op ring and the
+        latency histogram snapshots the SLO engine judges from."""
+        if not window_s:
+            window_s = float(self.conf["forensics_window_s"])
+        dump = self.perf.dump()
+        return {
+            "entity": self.entity,
+            "events": self.journal.snapshot(float(window_s)),
+            "journal": self.journal.stats(),
+            "slow_ops": self.op_tracker.dump_historic_slow_ops(),
+            "hists": {k: dump[k] for k in
+                      ("op_latency_us", "op_r_latency_us",
+                       "op_w_latency_us") if k in dump},
+            "mclock_depths": self.op_scheduler.queue_depths(),
+        }
+
     async def _start_admin_socket(self) -> None:
         """Bind <admin_socket_dir>/<entity>.asok with the reference's
         introspection surface (admin_socket.h:105): perf dump,
@@ -490,7 +523,7 @@ class OSDDaemon:
         if not run_dir:
             return
         from ceph_tpu.common.admin_socket import AdminSocket
-        from ceph_tpu.common.log import dump_recent
+        from ceph_tpu.common.log import recent_lines
 
         sock = AdminSocket(self.entity)
         sock.register("perf dump", self._perf_dump_all,
@@ -510,10 +543,14 @@ class OSDDaemon:
                       "messenger dispatch throttles")
         sock.register("dump_scheduler", self.op_scheduler.stats,
                       "op scheduler queue state")
-        sock.register("log dump", dump_recent,
+        sock.register("log dump", recent_lines,
                       "recent log ring (crash context)")
         sock.register("dump_traces", self._dump_traces_all,
                       "collected trace spans (zipkin-lite)")
+        sock.register("events dump", lambda: {
+            "stats": self.journal.stats(),
+            "events": self.journal.snapshot(),
+        }, "flight-recorder event journal (full ring)")
         sock.register("status", lambda: {
             "entity": self.entity,
             "osdmap_epoch": self.osdmap.epoch if self.osdmap else 0,
@@ -847,6 +884,17 @@ class OSDDaemon:
                 }))
             except ConnectionError:
                 pass
+        elif t == "forensics_capture":
+            # mgr fan-out on SLO_VIOLATION/SLOW_OPS raise: reply with
+            # this daemon's windowed journal + slow-op ring + hists
+            try:
+                conn.send_message(Message("forensics_capture_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    **self._forensics_snapshot(
+                        msg.data.get("window_s")),
+                }))
+            except ConnectionError:
+                pass
         elif t == "ec_resident_stats":
             # the admin-socket `ec resident stats` surface over the wire
             try:
@@ -928,6 +976,9 @@ class OSDDaemon:
     async def _on_map(self, osdmap: OSDMap) -> None:
         async with self._map_lock:
             self.osdmap = osdmap
+            self.journal.emit(
+                "map.install", epoch=osdmap.epoch,
+                up=sum(1 for o in osdmap.osds.values() if o.up))
             # stop reconnect churn toward peers the map marks down
             for osd, info in osdmap.osds.items():
                 if not info.up and info.addr and osd != self.osd_id:
@@ -1404,6 +1455,8 @@ class OSDDaemon:
             # until it sees itself up.  The epoch that shows us up
             # triggers the real scan.
             return
+        self.journal.emit("pg.rescan", epoch=m.epoch if m else 0,
+                          pgs=len(self.pgs))
         new_tables: dict[int, object] = {}
         for pool in m.pools.values():
             # Whole-pool tables from the epoch-cached bulk mapping
@@ -1433,6 +1486,11 @@ class OSDDaemon:
                 pg = self.pgs.get(pgid)
                 if not mine:
                     if pg is not None and self.osd_id not in acting:
+                        if pg.state != "stray":
+                            self.journal.emit(
+                                "pg.state", epoch=m.epoch,
+                                pgid=str(pgid), state="stray",
+                                prev=pg.state)
                         pg.state = "stray"
                         pg.primary = NO_OSD     # drop stale primary role
                         pg.acting = []
@@ -1462,6 +1520,10 @@ class OSDDaemon:
                                 if k[0] == pgid.pool and k[1] == pgid.ps]:
                         del self._watchers[key]
                     pg.start_interval(m.epoch, acting, up, primary)
+                    self.journal.emit(
+                        "pg.interval", epoch=m.epoch, pgid=str(pgid),
+                        primary=bool(pg.is_primary),
+                        acting=list(acting))
                     await self._ensure_collections(pg, acting)
                     self._make_backend(pg)
                     if pg.is_primary:
@@ -1581,6 +1643,7 @@ class OSDDaemon:
                 hedge_timeout=hedge or None,
                 perf=self.perf,
                 tracer=self.tracer,
+                journal=self.journal,
                 coalesce=bool(self.conf["osd_ec_coalesce"]),
                 coalesce_window_us=float(
                     self.conf["osd_ec_coalesce_window_us"]),
@@ -1743,6 +1806,9 @@ class OSDDaemon:
             failures = 0
             if missing.total():
                 pg.state = STATE_RECOVERING
+                self.journal.emit("pg.state", epoch=epoch,
+                                  pgid=str(pg.pgid), state="recovering",
+                                  missing=missing.total())
                 failures = await self._recover(pg, missing)
                 if pg.epoch != epoch:
                     return
@@ -1760,6 +1826,9 @@ class OSDDaemon:
                         "epoch": epoch,
                     }, priority=PRIO_HIGH))
                 pg.state = STATE_ACTIVE
+                self.journal.emit("pg.state", epoch=epoch,
+                                  pgid=str(pg.pgid), state="active",
+                                  degraded=True)
                 self._drain_waiters(pg)
                 self._schedule_repeer(pg, epoch)
                 return
@@ -1787,6 +1856,8 @@ class OSDDaemon:
                 self._send_osd(osd, Message("pg_activate", dict(merge),
                                             priority=PRIO_HIGH))
             pg.state = STATE_ACTIVE
+            self.journal.emit("pg.state", epoch=epoch,
+                              pgid=str(pg.pgid), state="active")
             # a CLEAN activation has nothing missing: keeping the
             # pre-recovery set would report active+degraded (and a
             # degraded PGMap digest) forever after recovery succeeded
@@ -3151,6 +3222,9 @@ class OSDDaemon:
         if (pg is not None and not pg.is_primary
                 and int(d.get("epoch", 0)) == pg.epoch):
             pg.state = STATE_ACTIVE
+            self.journal.emit("pg.state", epoch=pg.epoch,
+                              pgid=str(pgid), state="active",
+                              replica=True)
             if "log" in d:
                 async def merge():
                     try:
@@ -4701,11 +4775,21 @@ class OSDDaemon:
             self.op_tracker.slow_op_seconds = float(
                 self.conf["osd_op_complaint_time"]
             )
+            slow_inflight = self.op_tracker.slow_inflight()
             self.monc.send_osd_beacon(
                 self.osd_id,
-                slow_inflight=self.op_tracker.slow_inflight(),
+                slow_inflight=slow_inflight,
                 slow_total=self.op_tracker.slow_ops,
             )
+            # flight recorder: per-beat mClock backlog sample — a
+            # forensic timeline shows WHICH class's queue grew before
+            # a burn (quiet beats are not recorded)
+            depths = self.op_scheduler.queue_depths()
+            if depths or slow_inflight:
+                self.journal.emit(
+                    "mclock.depth",
+                    epoch=self.osdmap.epoch if self.osdmap else 0,
+                    slow_inflight=slow_inflight, **depths)
             now = time.monotonic()
             peers = self._heartbeat_peers()
             for osd in list(self._hb_last_rx.keys() |
@@ -4725,4 +4809,8 @@ class OSDDaemon:
                 else:
                     silence = now - last
                 if silence > grace:
+                    self.journal.emit(
+                        "hb.miss",
+                        epoch=self.osdmap.epoch if self.osdmap else 0,
+                        peer=osd, silence_s=round(silence, 3))
                     self.monc.report_failure(osd, silence)

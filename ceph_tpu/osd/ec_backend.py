@@ -446,6 +446,11 @@ class CoalescedLauncher:
         be.perf.inc("ec_coalesce_launches")
         be.perf.inc("ec_coalesce_ops", len(live))
         be.perf.tinc("ec_coalesce_occupancy", len(live))
+        if be.journal is not None:
+            be.journal.emit(
+                "coalesce.flush", op=str(key[0]), ops=len(live),
+                stripes=sum(it.nstripes for it in live),
+                launch_ms=round(launch_ms, 3))
         if be.tracer is not None:
             # one measured device launch serves every sampled
             # batchmate: record the same interval once per interested
@@ -488,6 +493,7 @@ class ECBackend:
         hedge_timeout: float | None = None,
         perf: PerfCounters | None = None,
         tracer=None,
+        journal=None,
         coalesce: bool = True,
         coalesce_window_us: float = 200.0,
         coalesce_max_stripes: int = 4096,
@@ -574,6 +580,9 @@ class ECBackend:
         # shared Tracer (daemon-provided): sampled ops get their
         # coalesced device launch recorded into their trace tree
         self.tracer = tracer
+        # flight recorder (daemon-provided EventJournal): coalescer
+        # window flushes land as structured events
+        self.journal = journal
         # ec_launch_bytes: logical bytes fed into device launches (the
         # numerator of achieved-GiB/s: ec_launch_bytes delta over
         # encode+decode launch-us delta — the utilization telemetry's

@@ -36,6 +36,7 @@ import weakref
 
 import numpy as np
 
+from ceph_tpu.common import events
 from ceph_tpu.common.tracing import current_span
 
 
@@ -338,6 +339,12 @@ class MeshCoalescer:
         perf0.inc("ec_device_launches")
         perf0.tinc("ec_mesh_occupancy", len(live))
         perf0.hinc("ec_mesh_launch_us", launch_us)
+        # the launcher is a host singleton shared across OSDs, so mesh
+        # launches land in the process journal (like failpoints), not
+        # an arbitrary member backend's daemon ring
+        events.emit_proc("mesh.launch", op=str(full_key[1][0]),
+                         ops=len(live), backends=n_backends,
+                         launch_us=round(launch_us, 1))
         for it in live:
             it.backend.perf.inc("ec_mesh_ops")
             if it.backend.tracer is not None and it.span is not None:

@@ -213,10 +213,11 @@ class RepairScheduler:
     def __init__(self, perf: PerfCounters, tracer=None,
                  op_scheduler=None, use_mclock: bool = False,
                  max_batch_objects: int = 64,
-                 min_batch_objects: int = 2):
+                 min_batch_objects: int = 2, journal=None):
         register_repair_counters(perf)
         self.perf = perf
         self.tracer = tracer
+        self.journal = journal
         self.op_scheduler = op_scheduler
         self.use_mclock = bool(use_mclock)
         self.max_batch_objects = max(1, int(max_batch_objects))
@@ -289,6 +290,11 @@ class RepairScheduler:
                     self.stats_by_strategy[strat] = (
                         self.stats_by_strategy.get(strat, 0) + len(done)
                     )
+                if self.journal is not None:
+                    self.journal.emit(
+                        "repair.batch_drain", strategy=strat or "?",
+                        objects=len(done), demoted=demoted,
+                        lost=list(lost_t))
                 # let client ops interleave between batches even when
                 # mClock pacing is off
                 await asyncio.sleep(0)

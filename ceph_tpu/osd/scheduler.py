@@ -117,6 +117,12 @@ class MClockScheduler:
     def stats(self) -> dict[str, int]:
         return dict(self._dispatched)
 
+    def queue_depths(self) -> dict[str, int]:
+        """Current per-class backlog (ops waiting in acquire) — the
+        flight recorder samples this each heartbeat so a forensic
+        timeline shows WHICH class's queue grew before an SLO burn."""
+        return {c: len(q) for c, q in self._queues.items() if q}
+
     def shutdown(self) -> None:
         """Cancel everything queued: an op blocked in acquire() at
         daemon teardown must NOT be released to execute against a

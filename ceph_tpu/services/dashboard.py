@@ -136,6 +136,25 @@ class Dashboard:
             elif path == "/api/trace":
                 status, body = await self._trace_get(headers, query)
                 ctype = "application/json"
+            elif path == "/api/forensics":
+                # bundle index from the mgr's flight recorder; ?id=
+                # loads one full bundle (merged timeline + per-daemon
+                # rings) back from disk
+                bid = query.get("id", "")
+                if bid:
+                    bundle = self.mgr.forensics_bundle(bid)
+                    if bundle is None:
+                        body = json.dumps(
+                            {"error": f"no bundle {bid!r}"}).encode()
+                        ctype, status = "application/json", 404
+                    else:
+                        body = json.dumps(bundle).encode()
+                        ctype, status = "application/json", 200
+                else:
+                    body = json.dumps({
+                        "bundles": self.mgr.forensics_index(),
+                    }).encode()
+                    ctype, status = "application/json", 200
             elif path == "/api/slo":
                 # SLO verdicts + utilization rates straight from the
                 # mgr's last digest (the slo module's contribution)

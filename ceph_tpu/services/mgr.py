@@ -13,9 +13,18 @@ exposition format with the metric names the reference's module exports
 from __future__ import annotations
 
 import asyncio
+import json
 import math
+import os
+import tempfile
+import time
 
 from ceph_tpu.common.config import ConfigProxy
+from ceph_tpu.common.events import (
+    EventJournal,
+    merge_timeline,
+    proc_journal,
+)
 from ceph_tpu.common.perf import bucket_le, hist_merge, hist_quantile
 from ceph_tpu.common.tracing import assemble_tree
 from ceph_tpu.mon.client import MonClient
@@ -84,6 +93,14 @@ class Mgr:
                        SLOMonitor(self)]
         self.modules = {m.name: m for m in modules}
         self.last_digest: dict | None = None
+        # flight recorder: the mgr's own ring (SLO eval transitions,
+        # capture bookkeeping) + the bounded in-memory bundle index the
+        # dashboard's /api/forensics serves
+        self.journal = EventJournal(
+            name, size=int(self.conf["event_journal_size"]))
+        self._forensics: list[dict] = []
+        self._forensics_seq = 0
+        self._last_capture_mono = 0.0
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if msg.type == "perf_dump_reply":
@@ -105,6 +122,11 @@ class Mgr:
             fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
             if fut is not None and not fut.done():
                 fut.set_result(msg.data.get("spans", []))
+            return
+        if msg.type == "forensics_capture_reply":
+            fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
+            if fut is not None and not fut.done():
+                fut.set_result(dict(msg.data))
             return
         await self.monc.ms_dispatch(conn, msg)
 
@@ -132,6 +154,15 @@ class Mgr:
             }, "mgr state")
             sock.register("config show", self.conf.show,
                           "live configuration")
+            from ceph_tpu.common.log import recent_lines
+            sock.register("log dump", recent_lines,
+                          "recent log ring (crash context)")
+            sock.register("events dump", lambda: {
+                "stats": self.journal.stats(),
+                "events": self.journal.snapshot(),
+            }, "flight-recorder event journal (full ring)")
+            sock.register("forensics ls", self.forensics_index,
+                          "forensic bundles captured this session")
             await sock.start(run_dir)
             self.admin_socket = sock
 
@@ -234,6 +265,146 @@ class Mgr:
         for s in spans:
             seen.setdefault(str(s.get("span_id")), s)
         return assemble_tree(list(seen.values()))
+
+    # -- forensics (flight-recorder capture) -------------------------------
+    def forensics_dir(self) -> str:
+        d = str(self.conf["forensics_dir"] or "")
+        if not d:
+            d = os.path.join(tempfile.gettempdir(),
+                             "ceph_tpu_forensics")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def forensics_index(self) -> list[dict]:
+        """Bundles captured this mgr session, newest last (the
+        dashboard /api/forensics listing and ``forensics ls`` asok)."""
+        return list(self._forensics)
+
+    def forensics_bundle(self, bundle_id: str) -> dict | None:
+        """Load one bundle back from disk by id (index entries carry
+        the path, so this also works across mgr restarts when the
+        caller knows the directory)."""
+        for entry in self._forensics:
+            if entry["id"] == bundle_id:
+                try:
+                    with open(entry["path"]) as f:
+                        return json.load(f)
+                except (OSError, ValueError):
+                    return None
+        return None
+
+    async def forensics_capture(self, reason: str,
+                                worst_daemon: str = "",
+                                detail: dict | None = None) -> dict:
+        """Fan a ``forensics_capture`` over every up daemon, snapshot
+        the mon + mgr + process journals, merge one epoch-aligned
+        timeline, and persist the JSON bundle.  Returns the index
+        entry (id, path, worst_daemon, ...)."""
+        window = float(self.conf["forensics_window_s"])
+        daemons: dict[str, dict] = {}
+        events: list[dict] = []
+        osdmap = self.monc.osdmap
+        if osdmap is not None:
+            polls = {
+                osd: self.osd_request(osd, info.addr,
+                                      "forensics_capture",
+                                      window_s=window)
+                for osd, info in osdmap.osds.items() if info.up
+            }
+            got_all = await asyncio.gather(*polls.values())
+            for osd, got in zip(polls, got_all):
+                if got:
+                    got.pop("tid", None)
+                    daemons[f"osd.{osd}"] = got
+                    events.extend(got.get("events", ()))
+        try:
+            mon = await self.monc.command("dump_events",
+                                          window_s=window)
+            md = mon.get("data") or {}
+            if md.get("events") or md.get("stats"):
+                daemons["mon"] = {"events": md.get("events", []),
+                                  "journal": md.get("stats", {})}
+                events.extend(md.get("events", ()))
+        except (ConnectionError, asyncio.TimeoutError, KeyError):
+            md = {}
+        # process-global emitters (failpoints, chaos schedule, mesh
+        # launches): prefer the mon's view, fall back to our own —
+        # in this tree both see the same module-level ring
+        proc_events = md.get("proc_events") \
+            or proc_journal().snapshot(window)
+        if proc_events:
+            daemons["proc"] = {"events": proc_events}
+            events.extend(proc_events)
+        own = self.journal.snapshot(window)
+        if own:
+            daemons[self.name] = {"events": own}
+            events.extend(own)
+        timeline = merge_timeline(events)
+        if not worst_daemon:
+            worst_daemon = self._worst_from_bundle(daemons)
+        self._forensics_seq += 1
+        bundle_id = (f"forensics-{int(time.time())}"
+                     f"-{self._forensics_seq:03d}")
+        bundle = {
+            "id": bundle_id,
+            "reason": reason,
+            "captured_at": time.time(),
+            "window_s": window,
+            "worst_daemon": worst_daemon,
+            "detail": detail or {},
+            "daemons": daemons,
+            "timeline": timeline,
+        }
+        path = os.path.join(self.forensics_dir(), f"{bundle_id}.json")
+        try:
+            with open(path, "w") as f:
+                json.dump(bundle, f)
+        except OSError:
+            path = ""
+        entry = {
+            "id": bundle_id, "path": path, "reason": reason,
+            "captured_at": bundle["captured_at"],
+            "worst_daemon": worst_daemon,
+            "events": len(timeline),
+            "daemons": sorted(daemons),
+        }
+        self._forensics.append(entry)
+        del self._forensics[:-64]        # bounded in-memory index
+        self._last_capture_mono = time.monotonic()
+        self.journal.emit("forensics.capture", reason=reason,
+                          bundle=bundle_id,
+                          worst_daemon=worst_daemon,
+                          events=len(timeline))
+        return dict(entry)
+
+    @staticmethod
+    def _worst_from_bundle(daemons: dict[str, dict]) -> str:
+        """Fallback attribution when the trigger carried no payload:
+        the daemon with the most slow ops in its captured ring, else
+        the one with the deepest sampled queue."""
+        worst, score = "", 0
+        for name, d in daemons.items():
+            slow = d.get("slow_ops") or {}
+            n = int(slow.get("num_ops", 0) or 0)
+            if n > score:
+                worst, score = name, n
+        return worst
+
+    async def maybe_auto_capture(self, reason: str,
+                                 worst_daemon: str = "",
+                                 detail: dict | None = None
+                                 ) -> dict | None:
+        """Cooldown-gated capture for automatic triggers: a flapping
+        health check must not storm bundles."""
+        cd = float(self.conf["forensics_cooldown_s"])
+        if (self._last_capture_mono
+                and time.monotonic() - self._last_capture_mono < cd):
+            return None
+        try:
+            return await self.forensics_capture(
+                reason, worst_daemon=worst_daemon, detail=detail)
+        except (ConnectionError, asyncio.TimeoutError):
+            return None
 
     # -- PGMap digest (DaemonServer + PGMap aggregation) -------------------
     async def collect_pg_stats(self) -> dict[int, list[dict]]:

@@ -39,6 +39,11 @@ class SLOMonitor(MgrModule):
         self.engine: SLOEngine | None = None
         self.last_eval: list[dict] = []
         self.util: dict = {}
+        # forensic auto-capture transition tracking: a capture fires
+        # on the RAISE edge of SLO_VIOLATION (engine) and SLOW_OPS
+        # (mon health), never while the condition merely persists
+        self._prev_active: set[str] = set()
+        self._slow_ops_raised = False
 
     def _ensure_engine(self) -> SLOEngine:
         # built lazily so conf overrides installed after construction
@@ -67,6 +72,47 @@ class SLOMonitor(MgrModule):
         recovery = int(digest.get("degraded_objects", 0)) > 0
         self.last_eval = eng.evaluate(recovery_active=recovery)
         self.util = self._utilization(eng)
+        await self._forensic_triggers(eng, snap)
+
+    async def _forensic_triggers(self, eng: SLOEngine,
+                                 snap: dict) -> None:
+        """Flight-recorder integration: journal SLO eval transitions
+        and fan an automatic forensic capture on raise edges."""
+        jr = self.mgr.journal
+        active = set(eng.active)
+        for obj in sorted(active - self._prev_active):
+            rec = eng.active[obj]
+            jr.emit("slo.raise", objective=obj,
+                    burn_rate=round(float(rec.get("burn_rate", 0.0)),
+                                    3),
+                    worst_daemon=rec.get("worst_daemon") or "")
+        for obj in sorted(self._prev_active - active):
+            jr.emit("slo.clear", objective=obj)
+        slo_raised = bool(active - self._prev_active)
+        self._prev_active = active
+        # SLOW_OPS comes from the mon's health map (OSD beacons), so
+        # read it off the status snapshot collect() already fetched
+        checks = ((snap.get("status") or {}).get("health") or {}) \
+            .get("checks", {})
+        slow = checks.get("SLOW_OPS")
+        slow_raised = slow is not None and not self._slow_ops_raised
+        self._slow_ops_raised = slow is not None
+        if not (slo_raised or slow_raised):
+            return
+        if slo_raised:
+            payload = eng.health_checks().get("SLO_VIOLATION", {})
+            worst_obj = max(eng.active,
+                            key=lambda o: eng.active[o]["burn_rate"])
+            worst = eng.active[worst_obj].get("worst_daemon") or ""
+            await self.mgr.maybe_auto_capture(
+                "SLO_VIOLATION", worst_daemon=worst,
+                detail={"message": payload.get("message", ""),
+                        "detail": payload.get("detail", []),
+                        "objective": worst_obj})
+        else:
+            await self.mgr.maybe_auto_capture(
+                "SLOW_OPS",
+                detail={"message": (slow or {}).get("message", "")})
 
     # -- utilization telemetry (rates from the PR 6-8 counters) -----------
     def _win_pair(self, eng: SLOEngine, key: str) -> tuple[float, float]:

@@ -60,7 +60,7 @@ class DeviceShardCache:
     def __init__(self, max_bytes: int = 256 << 20,
                  low_watermark: float = 0.75,
                  perf: PerfCounters | None = None,
-                 sharding=None):
+                 sharding=None, journal=None):
         if max_bytes <= 0:
             raise ValueError(f"max_bytes must be positive, got {max_bytes}")
         self.max_bytes = int(max_bytes)
@@ -80,6 +80,9 @@ class DeviceShardCache:
         # install, on device).
         self.sharding = sharding
         self.reshards = 0
+        # flight recorder: the owning daemon's event journal (None for
+        # standalone caches); evict() emits one watermark event per pass
+        self.journal = journal
 
     def set_sharding(self, sharding) -> None:
         """Adopt (or drop, with None) the placement applied to
@@ -196,6 +199,7 @@ class DeviceShardCache:
         if target is None:
             target = self.low_bytes
         skipped: set[tuple] = set()
+        evicted = freed = 0
         while self.bytes > target:
             key = next((k for k in self._entries if k not in skipped), None)
             if key is None:
@@ -213,7 +217,13 @@ class DeviceShardCache:
             self._entries.pop(key, None)
             self.bytes -= ent.nbytes
             self.evictions += 1
+            evicted += 1
+            freed += ent.nbytes
             self.perf.inc("ec_resident_evictions")
+        if evicted and self.journal is not None:
+            self.journal.emit("cache.evict", evicted=evicted,
+                              freed_bytes=freed, bytes=self.bytes,
+                              target=int(target))
 
     async def flush(self, ns=None) -> None:
         """Spill every dirty entry (optionally one namespace) to the

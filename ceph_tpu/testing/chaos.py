@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import random
 
+from ceph_tpu.common import events
 from ceph_tpu.common import failpoint as fp
 from ceph_tpu.testing.rados_model import RadosModel
 from ceph_tpu.testing.thrasher import Thrasher
@@ -81,6 +82,11 @@ class ChaosHarness:
             "mon_osd_down_out_interval": 300.0,   # no auto-out churn
         })
         await cluster.start()
+        # mgr runs so the drill verdict can attach a forensic bundle;
+        # the balancer stays off — upmap churn mid-thrash would fight
+        # the drill's own kill/revive placement story
+        mgr = await cluster.start_mgr(report_interval=0.5)
+        mgr.modules["balancer"].active = False
         rados = await cluster.client()
         if self.ec:
             r = await rados.mon_command(
@@ -96,6 +102,10 @@ class ChaosHarness:
             await rados.pool_create("chaos", pg_num=8,
                                     size=self.pool_size,
                                     min_size=self.min_size)
+        # the mgr's autoscaler would hold health in WARN over the
+        # deliberately small test pool, wedging wait_health_ok
+        await rados.mon_command("osd pool set", pool="chaos",
+                                var="pg_autoscale_mode", val="off")
         io = await rados.open_ioctx("chaos")
         model = RadosModel(io, seed=self.seed, n_objects=8,
                            max_size=1 << 14, ec=self.ec)
@@ -103,23 +113,38 @@ class ChaosHarness:
                             seed=self.seed)
         try:
             await model.run(self.batch)       # seed some state quietly
+            events.emit_proc("chaos.start", seed=self.seed,
+                             batches=self.n_batches)
             for step, event, arg in self.plan():
+                # flight-recorder: every applied plan event lands in the
+                # process journal, so a forensic bundle captured during
+                # (or after) the storm shows WHAT chaos did and WHEN —
+                # same seed, same chaos.* event sequence
                 if event == "kill":
                     victim = await thrasher.kill_one()
                     self.schedule.append((step, "kill", victim))
+                    events.emit_proc("chaos.kill", step=step,
+                                     victim=-1 if victim is None
+                                     else victim)
                 elif event == "revive":
                     osd = await thrasher.revive_oldest()
                     self.schedule.append((step, "revive", osd))
+                    events.emit_proc("chaos.revive", step=step,
+                                     osd=-1 if osd is None else osd)
                 elif event == "fp_set":
                     name, mode, kw = FAILPOINT_MENU[arg]
                     fp.fp_set(name, mode, **kw)
                     self.schedule.append((step, "fp_set", name))
+                    events.emit_proc("chaos.fp_set", step=step,
+                                     name=name, mode=mode)
                 elif event == "fp_clear":
                     fp.fp_clear()
                     fp.set_seed(self.seed)
                     self.schedule.append((step, "fp_clear", None))
+                    events.emit_proc("chaos.fp_clear", step=step)
                 else:
                     self.schedule.append((step, "calm", None))
+                    events.emit_proc("chaos.calm", step=step)
                 await model.run(self.batch)
         finally:
             fp.fp_clear()
@@ -128,6 +153,22 @@ class ChaosHarness:
                     break
         await cluster.wait_health_ok(timeout=30)
         verified = await model.verify_all()
+        events.emit_proc("chaos.done", seed=self.seed, verified=verified)
+        # attach a forensic bundle to the drill verdict while the
+        # cluster is still up — post-mortems read it via
+        # `ceph-tpu forensics show <id>` long after stop()
+        forensics = None
+        mgr = next(iter(cluster.mgrs.values()), None)
+        if mgr is not None:
+            try:
+                entry = await mgr.forensics_capture(
+                    "chaos:" + ("ok" if verified else "fail"),
+                    detail={"seed": self.seed,
+                            "ops_done": model.ops_done})
+                forensics = {"id": entry["id"], "bundle": entry["path"],
+                             "worst_daemon": entry["worst_daemon"]}
+            except (ConnectionError, TimeoutError):
+                pass
         await rados.shutdown()
         await cluster.stop()
         return {
@@ -138,6 +179,7 @@ class ChaosHarness:
             "ops_done": model.ops_done,
             "kills": thrasher.kills,
             "revives": thrasher.revives,
+            "forensics": forensics,
         }
 
 
@@ -184,6 +226,8 @@ async def run_host_failure_drill(seed: int = 0, hosts: int = 4,
         },
     )
     await cluster.start()
+    mgr = await cluster.start_mgr(report_interval=0.5)
+    mgr.modules["balancer"].active = False   # no upmap churn mid-drill
     rados = await cluster.client()
     out: dict = {"seed": seed, "victim": victim,
                  "osds": hosts * osds_per_host}
@@ -196,6 +240,8 @@ async def run_host_failure_drill(seed: int = 0, hosts: int = 4,
         await rados.pool_create("hostdrill", pg_num=8,
                                 pool_type="erasure",
                                 erasure_code_profile="hostdrill")
+        await rados.mon_command("osd pool set", pool="hostdrill",
+                                var="pg_autoscale_mode", val="off")
         io = await rados.open_ioctx("hostdrill")
 
         def payload() -> bytes:
@@ -209,6 +255,8 @@ async def run_host_failure_drill(seed: int = 0, hosts: int = 4,
         killed = await cluster.kill_host(victim)
         assert killed, f"no OSDs on {victim}"
         out["killed_osds"] = killed
+        events.emit_proc("chaos.host_kill", host=victim,
+                         osds=list(killed))
 
         # the degraded window: seeded load MUST keep completing while
         # a whole host is dark (k survivors per stripe exist)
@@ -231,6 +279,8 @@ async def run_host_failure_drill(seed: int = 0, hosts: int = 4,
         # the primaries drain their missing sets through the engine
         for osd_id in killed:
             await cluster.revive_osd(osd_id)
+        events.emit_proc("chaos.host_revive", host=victim,
+                         osds=list(killed))
 
         # client reads DURING the rebuild: mClock's recovery class may
         # not starve them (a stuck gather here is the starvation bug)
@@ -253,6 +303,18 @@ async def run_host_failure_drill(seed: int = 0, hosts: int = 4,
             got = await io.read(o)
             assert got == d, f"post-rebuild read mismatch on {o}"
         out["verified"] = len(datas)
+        mgr = next(iter(cluster.mgrs.values()), None)
+        if mgr is not None:
+            try:
+                entry = await mgr.forensics_capture(
+                    "drill:host_failure",
+                    detail={"victim": victim, "killed": list(killed)})
+                out["forensics"] = {"id": entry["id"],
+                                    "bundle": entry["path"],
+                                    "worst_daemon":
+                                        entry["worst_daemon"]}
+            except (ConnectionError, TimeoutError):
+                pass
         return out
     finally:
         await rados.shutdown()

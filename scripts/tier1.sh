@@ -31,6 +31,15 @@
 #                                   /metrics serving histogram _bucket
 #                                   series, and an injected 2s op
 #                                   raising then clearing SLOW_OPS
+#   scripts/tier1.sh --forensics-smoke
+#                                   cluster flight recorder end to end:
+#                                   a 3-OSD vstart cluster, a sub-op
+#                                   delay failpoint raising
+#                                   SLO_VIOLATION, the mgr auto-
+#                                   capturing a forensic bundle whose
+#                                   merged timeline spans >=2 daemons,
+#                                   and the offline `forensics ls/show`
+#                                   CLI rendering it after cluster stop
 #   scripts/tier1.sh --mesh-smoke   mesh-global EC coalescing end to
 #                                   end: a vstart cluster (3 OSDs, one
 #                                   forced 8-device CPU mesh) with
@@ -385,6 +394,95 @@ async def main():
 asyncio.run(main())
 EOF
     echo "OBS_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--forensics-smoke" ]; then
+    # flight-recorder gate: 3-OSD vstart, delay failpoint drives an
+    # SLO_VIOLATION, the mgr's auto-capture must persist a bundle, and
+    # the offline `forensics ls/show` CLI must render its merged
+    # timeline AFTER the cluster is stopped.
+    set -e
+    export JAX_PLATFORMS=cpu
+    python - <<'EOF'
+import asyncio
+import io
+import tempfile
+from contextlib import redirect_stdout
+
+BDIR = tempfile.mkdtemp(prefix="ct_forensics_smoke_")
+
+
+async def main() -> str:
+    from ceph_tpu.common import failpoint as fp
+    from ceph_tpu.vstart import DevCluster
+
+    cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+        "slo_put_p99_ms": 50.0,
+        "slo_window": 1.5,
+        "slo_raise_evals": 1,
+        "slo_clear_evals": 1,
+        "osd_heartbeat_interval": 0.1,
+        "forensics_cooldown_s": 0.0,
+        "forensics_dir": BDIR,
+    })
+    await cluster.start()
+    try:
+        mgr = await cluster.start_mgr(report_interval=0.1)
+        rados = await cluster.client()
+        await rados.pool_create("forn", pg_num=4, size=3)
+        ioctx = await rados.open_ioctx("forn")
+        for i in range(10):
+            await ioctx.write_full(f"ok{i}", b"x" * 512)
+        print("ok: vstart cluster + healthy writes")
+
+        fp.fp_set("osd.sub_op", "delay", delay=0.3)
+        deadline = asyncio.get_running_loop().time() + 20.0
+        i = 0
+        while not mgr.forensics_index():
+            await ioctx.write_full(f"slow{i}", b"y" * 512)
+            i += 1
+            assert asyncio.get_running_loop().time() < deadline, \
+                "SLO_VIOLATION never auto-captured a bundle"
+            await asyncio.sleep(0.05)
+        fp.fp_clear("osd.sub_op")
+        entry = mgr.forensics_index()[0]
+        assert entry["reason"] == "SLO_VIOLATION", entry
+        assert entry["path"].startswith(BDIR), entry
+        bundle = mgr.forensics_bundle(entry["id"])
+        assert bundle is not None
+        daemons = {e["entity"] for e in bundle["timeline"]}
+        assert len(daemons) >= 2, daemons
+        walls = [e["wall"] for e in bundle["timeline"]]
+        assert walls == sorted(walls), "timeline not monotonic"
+        print(f"ok: bundle {entry['id']} captured "
+              f"({entry['events']} events from {sorted(daemons)}, "
+              f"worst={entry['worst_daemon']})")
+        return entry["id"]
+    finally:
+        await cluster.stop()
+
+
+bundle_id = asyncio.run(main())
+
+# offline: the bundle must render with the cluster gone
+from ceph_tpu.cli import main as cli_main  # noqa: E402
+
+buf = io.StringIO()
+with redirect_stdout(buf):
+    rc = cli_main(["forensics", "ls", "--dir", BDIR])
+assert rc == 0 and bundle_id in buf.getvalue()
+buf = io.StringIO()
+with redirect_stdout(buf):
+    rc = cli_main(["forensics", "show", bundle_id, "--dir", BDIR])
+assert rc == 0
+shown = buf.getvalue()
+assert "slo.raise" in shown and "failpoint.fired" in shown, shown[:800]
+assert len(shown.splitlines()) > 5, shown
+print(f"ok: offline `forensics show` rendered "
+      f"{len(shown.splitlines()) - 1} timeline lines")
+EOF
+    echo "FORENSICS_SMOKE_PASSED"
     exit 0
 fi
 

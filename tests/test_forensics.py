@@ -1,0 +1,279 @@
+"""Cluster flight recorder: journal rings, timeline merge, forensics.
+
+Unit layer: the bounded ``EventJournal`` ring (eviction accounting,
+monotonic-window snapshots), ``merge_timeline`` ordering (wall primary,
+epoch/entity tiebreaks), ``render_timeline``, the process journal
+reset, and the tracer's ring-eviction / orphan-span counters that ride
+the perf dump (satellite gauges).
+
+Cluster layer: a failpoint-delayed replica sub-op drags real write
+latency over a declared ``put_p99_ms`` target — the mgr must raise
+SLO_VIOLATION *and* automatically capture a forensic bundle whose
+merged timeline spans >= 2 daemons, stays wall-monotonic, and names
+the same worst daemon as the SLO payload; the offline
+``ceph-tpu forensics ls/show`` CLI must render it after the cluster is
+gone.  A seeded chaos pair proves the recorded chaos event-type
+sequence is a pure function of the seed.
+"""
+
+import asyncio
+import io as _io
+import time
+from collections import deque
+from contextlib import redirect_stdout
+
+import pytest
+
+from ceph_tpu.common import events
+from ceph_tpu.common import failpoint as fp
+from ceph_tpu.common.events import (
+    EventJournal,
+    merge_timeline,
+    render_timeline,
+)
+from ceph_tpu.common.tracing import SpanCtx, Tracer
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.vstart import DevCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_local_namespace()
+    fp.fp_clear()
+    fp.set_seed(0)
+    events.reset_proc()
+    yield
+    fp.fp_clear()
+    fp.set_seed(0)
+    events.reset_proc()
+    reset_local_namespace()
+
+
+# -- unit: journal ring ---------------------------------------------------
+def test_journal_ring_bound_and_eviction_accounting():
+    j = EventJournal("osd.9", size=16)
+    for i in range(40):
+        j.emit("tick", epoch=i, n=i)
+    assert len(j) == 16
+    st = j.stats()
+    assert st["entity"] == "osd.9"
+    assert st["size"] == 16 and st["capacity"] == 16
+    assert st["emitted"] == 40 and st["evicted"] == 24
+    snap = j.snapshot()
+    # oldest 24 fell off: the ring holds exactly events 24..39
+    assert [e["fields"]["n"] for e in snap] == list(range(24, 40))
+    assert all(e["entity"] == "osd.9" for e in snap)
+
+
+def test_journal_min_size_floor_and_fieldless_events():
+    j = EventJournal("mon.a", size=1)       # floor clamps to 16
+    j.emit("bare")
+    assert j.stats()["capacity"] == 16
+    (ev,) = j.snapshot()
+    assert ev["type"] == "bare" and "fields" not in ev
+
+
+def test_journal_snapshot_window_uses_monotonic_clock():
+    j = EventJournal("osd.0")
+    j.emit("old")
+    time.sleep(0.05)
+    j.emit("new")
+    assert [e["type"] for e in j.snapshot()] == ["old", "new"]
+    # a 25ms window keeps only the fresh event
+    assert [e["type"] for e in j.snapshot(window_s=0.025)] == ["new"]
+    assert j.snapshot(window_s=0.0) == []
+
+
+# -- unit: timeline merge / render ----------------------------------------
+def test_merge_timeline_wall_primary_epoch_entity_tiebreak():
+    evs = [
+        {"entity": "osd.1", "wall": 3.0, "epoch": 5, "type": "c"},
+        {"entity": "osd.0", "wall": 1.0, "epoch": 9, "type": "a"},
+        {"entity": "osd.2", "wall": 2.0, "epoch": 2, "type": "b"},
+        # same instant: epoch orders first, then entity
+        {"entity": "mon.a", "wall": 2.0, "epoch": 1, "type": "tie-e1"},
+        {"entity": "osd.9", "wall": 2.0, "epoch": 2, "type": "tie-o9"},
+    ]
+    merged = merge_timeline(evs)
+    walls = [e["wall"] for e in merged]
+    assert walls == sorted(walls), "merged timeline must be monotonic"
+    assert [e["type"] for e in merged] == \
+        ["a", "tie-e1", "b", "tie-o9", "c"]
+
+
+def test_render_timeline_lines_and_limit():
+    assert render_timeline([]) == "(empty timeline)"
+    evs = [{"entity": "osd.0", "wall": 100.0 + i, "epoch": i,
+            "type": f"t{i}", "fields": {"k": i}} for i in range(5)]
+    txt = render_timeline(evs)
+    lines = txt.splitlines()
+    assert len(lines) == 5
+    assert "osd.0" in lines[0] and "t0" in lines[0] and "k=0" in lines[0]
+    # limit keeps the TAIL (most recent events)
+    tail = render_timeline(evs, limit=2).splitlines()
+    assert len(tail) == 2 and "t3" in tail[0] and "t4" in tail[1]
+
+
+def test_proc_journal_reset_isolation():
+    events.emit_proc("chaos.kill", step=1)
+    assert len(events.proc_journal()) == 1
+    events.reset_proc()
+    assert len(events.proc_journal()) == 0
+    assert events.proc_journal().entity == "proc"
+
+
+# -- unit: tracer loss counters (satellite gauges) ------------------------
+def test_tracer_ring_evictions_and_orphan_count():
+    tr = Tracer("osd.0")
+    tr.spans = deque(maxlen=4)              # shrink the ring for test
+    root = SpanCtx("t" * 16, "root")
+    for i in range(6):
+        tr.record(f"s{i}", root, start=float(i), duration_ms=1.0)
+    assert tr.ring_evictions == 2
+    # parents of surviving spans are all "root", which never entered
+    # the ring -> every survivor is an orphan
+    assert tr.orphan_count() == 4
+    with tr.span("child", parent=root):
+        pass
+    assert tr.ring_evictions == 3
+
+
+# -- cluster: SLO violation -> automatic forensic bundle ------------------
+FORENSIC_OVERRIDES = {
+    "slo_put_p99_ms": 50.0,
+    "slo_window": 1.5,
+    "slo_raise_evals": 1,
+    "slo_clear_evals": 1,
+    "osd_heartbeat_interval": 0.1,
+    "forensics_cooldown_s": 0.0,
+}
+
+
+def test_slo_violation_auto_captures_bundle(tmp_path):
+    async def run():
+        overrides = dict(FORENSIC_OVERRIDES)
+        overrides["forensics_dir"] = str(tmp_path / "bundles")
+        overrides["admin_socket_dir"] = str(tmp_path)
+        cluster = DevCluster(n_mons=1, n_osds=3, overrides=overrides)
+        await cluster.start()
+        try:
+            mgr = await cluster.start_mgr(report_interval=0.1)
+            rados = await cluster.client()
+            await rados.pool_create("slop", pg_num=4, size=3)
+            ioctx = await rados.open_ioctx("slop")
+
+            for i in range(10):
+                await ioctx.write_full(f"ok{i}", b"x" * 512)
+            await asyncio.sleep(0.3)
+            assert not mgr.forensics_index(), \
+                "no bundle may exist while healthy"
+
+            # stall replica sub-ops until the SLO raises and the mgr's
+            # auto-capture fires
+            fp.fp_set("osd.sub_op", "delay", delay=0.3)
+            deadline = asyncio.get_running_loop().time() + 20.0
+            i = 0
+            while not mgr.forensics_index():
+                await ioctx.write_full(f"slow{i}", b"y" * 512)
+                i += 1
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "SLO_VIOLATION never produced a forensic bundle"
+                await asyncio.sleep(0.05)
+            fp.fp_clear("osd.sub_op")
+
+            entry = mgr.forensics_index()[0]
+            assert entry["reason"] == "SLO_VIOLATION"
+            bundle = mgr.forensics_bundle(entry["id"])
+            assert bundle is not None, "bundle must load back from disk"
+            assert str(tmp_path) in entry["path"]
+
+            # events from >= 2 distinct daemons (e2e requirement)
+            contributors = {e["entity"] for e in bundle["timeline"]}
+            assert len(contributors) >= 2, contributors
+            osd_side = {c for c in contributors if c.startswith("osd.")}
+            assert osd_side, "no OSD journal made it into the bundle"
+
+            # merged timeline is wall-monotonic
+            walls = [e["wall"] for e in bundle["timeline"]]
+            assert walls == sorted(walls)
+            assert walls, "timeline is empty"
+
+            # the bundle names the same worst daemon as the SLO
+            # payload: the slo.raise event on the timeline IS the
+            # raise-time payload, so the two must agree exactly
+            worst = bundle["worst_daemon"]
+            assert worst.startswith("osd."), bundle
+            obj = bundle["detail"]["objective"]
+            raises = [e for e in bundle["timeline"]
+                      if e["type"] == "slo.raise"
+                      and (e.get("fields") or {}).get("objective")
+                      == obj]
+            assert raises, "slo.raise missing from the merged timeline"
+            assert raises[0]["fields"]["worst_daemon"] == worst
+            # and the failpoint that CAUSED the stall is on the
+            # timeline, attributed to the process journal
+            types = {e["type"] for e in bundle["timeline"]}
+            assert "failpoint.fired" in types, sorted(types)
+
+            # admin-socket surfaces: per-daemon ring + mon log dump
+            from ceph_tpu.common.admin_socket import admin_command
+            out = await admin_command(str(tmp_path / "osd.0.asok"),
+                                      "events dump")
+            assert out["stats"]["entity"] == "osd.0"
+            assert any(e["type"] == "pg.interval"
+                       for e in out["events"])
+            logs = await admin_command(str(tmp_path / "mon.a.asok"),
+                                       "log dump")
+            assert isinstance(logs, list)
+
+            return entry["id"], str(tmp_path / "bundles")
+        finally:
+            await cluster.stop()
+
+    bundle_id, bdir = asyncio.run(run())
+
+    # offline reader: works with the cluster fully stopped
+    from ceph_tpu.cli import main as cli_main
+    buf = _io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["forensics", "ls", "--dir", bdir])
+    assert rc == 0 and bundle_id in buf.getvalue()
+    buf = _io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["forensics", "show", bundle_id, "--dir", bdir])
+    assert rc == 0
+    shown = buf.getvalue()
+    assert "slo.raise" in shown and "failpoint.fired" in shown
+
+
+# -- cluster: seeded chaos -> deterministic event sequence ----------------
+def test_chaos_same_seed_same_event_type_sequence():
+    from ceph_tpu.testing import run_chaos
+
+    def chaos_events():
+        # only plan-driven event types: timing-dependent emissions
+        # (mclock.depth, hb.miss) legitimately differ between runs
+        return [e["type"]
+                for e in events.proc_journal().snapshot()
+                if e["type"].startswith("chaos.")]
+
+    async def one(seed):
+        events.reset_proc()
+        r = await run_chaos(seed=seed, n_batches=6)
+        return r, chaos_events()
+
+    async def twice():
+        r1, seq1 = await one(21)
+        reset_local_namespace()
+        r2, seq2 = await one(21)
+        return r1, seq1, r2, seq2
+
+    r1, seq1, r2, seq2 = asyncio.run(twice())
+    assert seq1 == seq2, "same seed must replay the same chaos events"
+    assert any(t != "chaos.start" for t in seq1), seq1
+    assert seq1[0] == "chaos.start" and "chaos.done" in seq1
+    assert r1["schedule"] == r2["schedule"]
+    # the drill verdict carries its forensic bundle (mgr was up)
+    for r in (r1, r2):
+        assert r["forensics"] is not None
+        assert r["forensics"]["bundle"].endswith(".json")
