@@ -1087,7 +1087,7 @@ def _cfg11_main() -> None:
 
 
 def _cfg10_serve(seed: int = 0, ops_per_phase: int = 240,
-                 clients: int = 4) -> dict:
+                 clients: int = 4, defend: bool = False) -> dict:
     """cfg10: serving-load SLO scenario (``python bench.py --serve``).
 
     Three phases over one EC (jax_rs k=2 m=1) DevCluster with the mgr
@@ -1106,7 +1106,13 @@ def _cfg10_serve(seed: int = 0, ops_per_phase: int = 240,
     every objective is judged on exactly that phase's traffic.  Op
     schedules derive from the seed alone (plan_sha256 in each phase
     record proves two runs issued identical streams); wall-clock
-    numbers are the measurement, not the schedule."""
+    numbers are the measurement, not the schedule.
+
+    ``defend=True`` arms the PR-15 QoS defense plane (cfg12: same
+    scenario, ``qos_enable`` on): the mgr QoS module backs the
+    recovery mClock class off while client latency burns and pushes
+    quantile-adaptive EC hedge timeouts — the A/B against defend=False
+    is the storm-flip acceptance measurement."""
     import asyncio
     import hashlib
 
@@ -1115,13 +1121,24 @@ def _cfg10_serve(seed: int = 0, ops_per_phase: int = 240,
         from ceph_tpu.testing.loadgen import LoadGen, RadosBackend
         from ceph_tpu.vstart import DevCluster
 
-        cluster = DevCluster(n_mons=1, n_osds=4, overrides={
+        overrides = {
             "mon_osd_down_out_interval": 300.0,  # we control revive
             "slo_put_p99_ms": 600.0, "slo_get_p999_ms": 400.0,
             "slo_error_rate": 0.01, "slo_rebuild_floor_gibs": 5e-5,
             "slo_window": 30.0,
             "slo_raise_evals": 1, "slo_clear_evals": 1,
-        })
+        }
+        if defend:
+            # the defense plane reacts within one burning eval and
+            # hedges off a short healthy-read quantile: the kill-phase
+            # stragglers (sub-ops parked on the dead OSD) get
+            # reconstructed instead of waited out
+            overrides.update({
+                "qos_enable": True,
+                "qos_hedge_min_samples": 8,
+                "qos_hedge_max_ms": 100.0,
+            })
+        cluster = DevCluster(n_mons=1, n_osds=4, overrides=overrides)
         await cluster.start()
         mgr = await cluster.start_mgr(report_interval=0.2)
         rados = await cluster.client()
@@ -1274,41 +1291,98 @@ def _cfg10_serve(seed: int = 0, ops_per_phase: int = 240,
             digest = mgr.last_digest or {}
             mgr_view = {"slo": digest.get("slo", {}),
                         "utilization": digest.get("utilization", {})}
+            # the defense plane's decision trail: every retune/hedge
+            # push the controller made, in order (same seed => same
+            # sequence — the replayability acceptance check)
+            qos_events = [
+                {"type": e["type"], **(e.get("fields") or {})}
+                for e in mgr.journal.snapshot()
+                if str(e["type"]).startswith("qos.")]
+            qos_view = {"defend": defend,
+                        "state": digest.get("qos", {}),
+                        "events": qos_events}
         finally:
             await rados.shutdown()
             await cluster.stop()
 
-        return {"seed": seed, "phases": phases,
+        return {"seed": seed, "defend": defend, "phases": phases,
                 "verdicts": {p["phase"]: p["pass"] for p in phases},
-                "mgr_view": mgr_view}
+                "qos": qos_view, "mgr_view": mgr_view}
 
     return asyncio.run(run())
 
 
+def _storm_burn(out: dict) -> float:
+    """Worst get_p999 burn rate in the recovery (storm) phase."""
+    for p in out["phases"]:
+        if p["phase"] != "recovery":
+            continue
+        for e in p["slo"]:
+            if e["objective"] == "get_p999_ms":
+                return float(e.get("burn_rate") or 0.0)
+    return 0.0
+
+
 def _serve_main() -> None:
-    """Standalone cfg10 entry (``python bench.py --serve [--seed N]``):
+    """Standalone cfg10/cfg12 entry
+    (``python bench.py --serve [--seed N] [--defend on|off|ab]``):
     CPU-sufficient — the SLO verdict machinery, loadgen determinism,
     and counter plumbing are exact on any backend; on-chip the same
     scenario measures real device rebuild interference.  Appends its
     record (per-phase verdicts in extra.phases) to BENCH_LOCAL.jsonl
-    and prints it as the final JSON line."""
+    and prints it as the final JSON line.
+
+    ``--defend`` selects the cfg12 QoS A/B: ``on``/``off`` run one arm
+    with the defense plane armed/disarmed; ``ab`` runs both arms at
+    the same seed and appends ONE paired record whose value is the
+    storm-phase burn improvement (off/on)."""
     seed = 0
     argv = sys.argv[1:]
     if "--seed" in argv:
         seed = int(argv[argv.index("--seed") + 1])
-    out = _cfg10_serve(seed=seed)
-    passed = sum(1 for p in out["phases"] if p["pass"])
-    v = out["verdicts"]
-    record = {
-        "metric": "serving_slo_three_phase",
-        "value": round(passed / max(len(out["phases"]), 1), 3),
-        "unit": "phase pass fraction",
-        # expectation: healthy phases meet SLO; the storm phase's
-        # verdict is the detection signal, pass or fail
-        "vs_baseline": float(v.get("baseline", False)
-                             and v.get("drain", False)),
-        "extra": out,
-    }
+    defend = ""
+    if "--defend" in argv:
+        defend = argv[argv.index("--defend") + 1]
+        if defend not in ("on", "off", "ab"):
+            raise SystemExit(f"--defend {defend!r}: want on|off|ab")
+
+    if defend == "ab":
+        off = _cfg10_serve(seed=seed, defend=False)
+        on = _cfg10_serve(seed=seed, defend=True)
+        burn_off, burn_on = _storm_burn(off), _storm_burn(on)
+        record = {
+            "metric": "serving_slo_qos_defense_ab",
+            # a fully-defended arm burns 0: floor the denominator so
+            # the ratio reads "at least this much better"
+            "value": round(burn_off / max(burn_on, 0.01), 3),
+            "unit": "x storm get_p999 burn reduction (off/on, >=)",
+            # acceptance: defenses flip the storm verdict outright, or
+            # cut the burn >= 3x while rebuild stays above the floor
+            "vs_baseline": float(
+                on["verdicts"].get("recovery", False)
+                or burn_off >= 3.0 * burn_on),
+            "extra": {"seed": seed,
+                      "storm_burn_off": round(burn_off, 3),
+                      "storm_burn_on": round(burn_on, 3),
+                      "retunes_on": len([e for e in on["qos"]["events"]
+                                         if e["type"] == "qos.retune"]),
+                      "off": off, "on": on},
+        }
+    else:
+        out = _cfg10_serve(seed=seed, defend=(defend == "on"))
+        passed = sum(1 for p in out["phases"] if p["pass"])
+        v = out["verdicts"]
+        record = {
+            "metric": ("serving_slo_three_phase" if not defend
+                       else f"serving_slo_defend_{defend}"),
+            "value": round(passed / max(len(out["phases"]), 1), 3),
+            "unit": "phase pass fraction",
+            # expectation: healthy phases meet SLO; the storm phase's
+            # verdict is the detection signal, pass or fail
+            "vs_baseline": float(v.get("baseline", False)
+                                 and v.get("drain", False)),
+            "extra": out,
+        }
     _append_local_record(record)
     print(json.dumps(record), flush=True)
 

@@ -328,6 +328,77 @@ def global_options() -> list[Option]:
         Option("slo_clear_evals", int, 2,
                "consecutive clean evaluations before an active "
                "SLO_VIOLATION clears", Level.ADVANCED, min=1),
+        # adaptive QoS defense plane (mgr_qos): closes the SLO loop by
+        # actuating mClock recovery shares, hedge timeouts, and RGW
+        # admission from the live burn-rate signal
+        Option("qos_enable", bool, False,
+               "enable the closed-loop QoS controller (mgr_qos): AIMD "
+               "recovery-class mClock retuning + quantile-adaptive EC "
+               "hedge timeouts driven by the SLO burn signal"),
+        Option("qos_backoff", float, 0.5,
+               "multiplicative factor applied to the recovery-class "
+               "mClock limit on each burning evaluation (after the "
+               "raise hysteresis is satisfied)", Level.ADVANCED,
+               min=0.05, max=0.95),
+        Option("qos_ramp_ops", float, 16.0,
+               "additive ops/s restored to the recovery-class limit on "
+               "each clean evaluation (after the clear hysteresis)",
+               Level.ADVANCED, min=0.1),
+        Option("qos_recovery_max_ops", float, 256.0,
+               "recovery-class mClock limit ceiling the controller "
+               "ramps back to when client SLOs are healthy",
+               Level.ADVANCED, min=1.0),
+        Option("qos_recovery_min_ops", float, 4.0,
+               "absolute floor for the recovery-class mClock limit: "
+               "backoff never starves rebuild below this pace",
+               Level.ADVANCED, min=0.1),
+        Option("qos_recovery_min_share", float, 0.05,
+               "recovery pacing floor as a fraction of "
+               "qos_recovery_max_ops (combined with the ops floor and "
+               "the slo_rebuild_floor_gibs-derived floor via max)",
+               Level.ADVANCED, min=0.0, max=1.0),
+        Option("qos_recovery_gib_per_op", float, 1e-3,
+               "assumed GiB rebuilt per recovery-class mClock grant, "
+               "used to translate slo_rebuild_floor_gibs into a "
+               "minimum recovery ops/s", Level.ADVANCED, min=1e-9),
+        Option("qos_hedge_quantile", float, 0.95,
+               "derive each OSD's EC hedge-read timeout from this "
+               "quantile of its windowed shard-read latency histogram "
+               "(0 = adaptive hedging off; the static "
+               "osd_ec_hedge_read_timeout then applies unchanged)",
+               min=0.0, max=0.9999),
+        Option("qos_hedge_min_ms", float, 5.0,
+               "clamp floor for the adaptive hedge timeout in ms "
+               "(hedging below the healthy tail wastes reads)",
+               Level.ADVANCED, min=0.1),
+        Option("qos_hedge_max_ms", float, 250.0,
+               "clamp ceiling for the adaptive hedge timeout in ms",
+               Level.ADVANCED, min=1.0),
+        Option("qos_hedge_min_samples", int, 16,
+               "minimum shard reads in the window before the adaptive "
+               "hedge timeout retunes (thin histograms stay on the "
+               "last pushed value)", Level.ADVANCED, min=1),
+        Option("rgw_max_inflight", int, 0,
+               "RGW admission control: max S3 requests in flight per "
+               "frontend before new ones shed with 503 Slow Down "
+               "(0 = gate disabled)", min=0),
+        Option("rgw_session_ops_per_s", float, 0.0,
+               "RGW admission control: per-session (access key) "
+               "token-bucket refill rate in requests/s (0 = throttle "
+               "disabled)", min=0.0),
+        Option("rgw_session_burst", float, 8.0,
+               "RGW admission control: per-session token-bucket "
+               "capacity (burst size)", Level.ADVANCED, min=1.0),
+        Option("rgw_retry_after_s", float, 1.0,
+               "Retry-After header value (seconds) on 503 Slow Down "
+               "responses", Level.ADVANCED, min=0.0),
+        Option("rgw_gc_obj_min_wait", float, 0.0,
+               "defer RGW data-object deletion this many seconds "
+               "(rgw_gc_obj_min_wait): >0 routes overwrites through "
+               "unique per-write data oids + the GC queue, so a GET "
+               "racing an overwrite of the same key never hits a "
+               "removed-object window (0 = delete inline)",
+               Level.ADVANCED, min=0.0),
         Option("ec_hbm_peak_gibps", float, 763.0,
                "accelerator HBM peak bandwidth in GiB/s (v5e ~819 GB/s "
                "= 763 GiB/s) — the roofline the utilization telemetry "

@@ -111,6 +111,65 @@ def targets_from_conf(conf) -> list[SLOTarget]:
     return out
 
 
+class SnapshotWindow:
+    """Delta view between two cumulative per-daemon snapshots.
+
+    The SLO verdict, the utilization telemetry, and the QoS controller
+    all consume the same sliding window: counters are cumulative, so a
+    window's distribution/total is the elementwise difference of its
+    edge snapshots.  Factoring the delta math here means every consumer
+    reads the identical distributions the verdict was computed from
+    instead of re-deriving them from raw snapshots."""
+
+    def __init__(self, old: dict[str, dict], new: dict[str, dict],
+                 span: float):
+        self.old = old
+        self.new = new
+        self.span = float(span)
+
+    def hist(self, source: str) -> tuple[dict, dict[str, dict]]:
+        """(cluster-merged window histogram, {daemon: window hist})."""
+        per: dict[str, dict] = {}
+        merged: dict = {}
+        for daemon, dump in self.new.items():
+            cur = dump.get(source)
+            if not isinstance(cur, dict) or "buckets" not in cur:
+                continue
+            d = hist_delta(cur, self.old.get(daemon, {}).get(source))
+            per[daemon] = d
+            merged = hist_merge(merged, d)
+        return merged or {"buckets": [], "sum": 0.0, "count": 0}, per
+
+    def scalar(self, key: str) -> tuple[float, dict[str, float]]:
+        """(cluster-total window delta, {daemon: delta}) of a counter."""
+        per: dict[str, float] = {}
+        for daemon, dump in self.new.items():
+            if key not in dump:
+                continue
+            d = counter_scalar(dump.get(key, 0.0)) - counter_scalar(
+                self.old.get(daemon, {}).get(key, 0.0))
+            per[daemon] = max(0.0, d)
+        return sum(per.values()), per
+
+    def pair(self, key: str) -> tuple[float, float]:
+        """Window delta of a LONGRUNAVG counter: (sum, count)."""
+        ds = dc = 0.0
+        for daemon, dump in self.new.items():
+            cur = dump.get(key)
+            if not isinstance(cur, dict):
+                continue
+            prev = self.old.get(daemon, {}).get(key, {})
+            if not isinstance(prev, dict):
+                prev = {}
+            ds += float(cur.get("sum", 0.0)) - float(prev.get("sum", 0.0))
+            dc += float(cur.get("avgcount", 0)) \
+                - float(prev.get("avgcount", 0))
+        return max(0.0, ds), max(0.0, dc)
+
+
+_EMPTY_WINDOW = SnapshotWindow({}, {}, 0.0)
+
+
 class SLOEngine:
     """Sliding-window evaluation of declared targets over per-daemon
     perf dumps, with raise/clear hysteresis and health rendering."""
@@ -141,37 +200,23 @@ class SLOEngine:
             return 0.0
         return self._snaps[-1][0] - self._snaps[0][0]
 
+    def snapshot_window(self) -> SnapshotWindow:
+        """The current sliding window as a :class:`SnapshotWindow` —
+        the one shared delta view the verdict, the utilization layer,
+        and the QoS controller all read.  Empty (zero-span) window
+        until two snapshots have been observed."""
+        if len(self._snaps) < 2:
+            return _EMPTY_WINDOW
+        return SnapshotWindow(self._snaps[0][1], self._snaps[-1][1],
+                              self.window_span())
+
     def _window_hist(self, source: str):
         """(cluster-merged window histogram, {daemon: window histogram})."""
-        if len(self._snaps) < 2:
-            return {"buckets": [], "sum": 0.0, "count": 0}, {}
-        _, old = self._snaps[0]
-        _, new = self._snaps[-1]
-        per: dict[str, dict] = {}
-        merged: dict = {}
-        for daemon, dump in new.items():
-            cur = dump.get(source)
-            if not isinstance(cur, dict) or "buckets" not in cur:
-                continue
-            d = hist_delta(cur, old.get(daemon, {}).get(source))
-            per[daemon] = d
-            merged = hist_merge(merged, d)
-        return merged or {"buckets": [], "sum": 0.0, "count": 0}, per
+        return self.snapshot_window().hist(source)
 
     def _window_scalar(self, key: str):
         """(cluster-total window delta, {daemon: delta}) of a counter."""
-        if len(self._snaps) < 2:
-            return 0.0, {}
-        _, old = self._snaps[0]
-        _, new = self._snaps[-1]
-        per: dict[str, float] = {}
-        for daemon, dump in new.items():
-            if key not in dump:
-                continue
-            d = counter_scalar(dump.get(key, 0.0)) - counter_scalar(
-                old.get(daemon, {}).get(key, 0.0))
-            per[daemon] = max(0.0, d)
-        return sum(per.values()), per
+        return self.snapshot_window().scalar(key)
 
     # -- evaluation --------------------------------------------------------
     def _eval_latency(self, tgt: SLOTarget) -> dict:

@@ -273,7 +273,11 @@ class OSDDaemon:
                 limit=self.conf[f"osd_mclock_{clazz}_lim"],
             )
             for clazz in ("client", "recovery", "scrub")
-        })
+        }, journal=self.journal)
+        # QoS defense plane override: when the mgr controller pushes a
+        # hedge timeout (qos_set), it supersedes the static conf value
+        # for every existing and future EC backend on this daemon
+        self._qos_hedge_override: float | None = None
         self.op_tracker = OpTracker(
             slow_op_seconds=float(self.conf["osd_op_complaint_time"]),
             slow_history_size=int(self.conf["osd_slow_op_history"]),
@@ -483,6 +487,75 @@ class OSDDaemon:
             },
         }
 
+    def _mclock_set(self, clazz: str = "", reservation=None,
+                    weight=None, limit=None) -> dict:
+        """Admin-socket ``mclock set``: runtime retune of one op
+        class's R/W/L (journals ``mclock.retune`` on change)."""
+        if not clazz:
+            return {"error": "clazz required"}
+        change = self.op_scheduler.set_profile(
+            str(clazz),
+            reservation=None if reservation is None
+            else float(reservation),
+            weight=None if weight is None else float(weight),
+            limit=None if limit is None else float(limit))
+        return {"changed": change is not None, "change": change,
+                "profiles": self.op_scheduler.profiles_dump()}
+
+    def _mclock_stats(self) -> dict:
+        """Admin-socket ``mclock stats``: the live QoS picture — class
+        profiles, dispatch counts, backlog, retune count, and the
+        controller-pushed hedge override (None = static conf)."""
+        return {
+            "enabled": self._use_mclock,
+            "profiles": self.op_scheduler.profiles_dump(),
+            "dispatched": self.op_scheduler.stats(),
+            "depths": self.op_scheduler.queue_depths(),
+            "retunes": self.op_scheduler.retunes,
+            "hedge_override_s": self._qos_hedge_override,
+        }
+
+    def _qos_set(self, data: dict) -> dict:
+        """Apply one ``qos_set`` wire cmd from the mgr QoS controller:
+        per-class mClock retunes and/or an adaptive hedge timeout."""
+        out: dict = {}
+        for clazz, prof in (data.get("mclock") or {}).items():
+            change = self.op_scheduler.set_profile(
+                str(clazz),
+                reservation=prof.get("reservation"),
+                weight=prof.get("weight"),
+                limit=prof.get("limit"))
+            if change is not None:
+                out.setdefault("mclock", {})[str(clazz)] = change
+        if "hedge_timeout" in data:
+            ht = data["hedge_timeout"]
+            out["hedge_timeout"] = self._apply_hedge_timeout(
+                float(ht) if ht else None)
+        return out
+
+    def _apply_hedge_timeout(self, timeout: float | None) -> float | None:
+        """Install the controller-derived EC hedge timeout on every
+        existing EC backend and remember it for backends created later
+        (peering re-instantiates them).  None reverts to the static
+        ``osd_ec_hedge_read_timeout`` conf behavior."""
+        prev = self._qos_hedge_override
+        self._qos_hedge_override = timeout
+        applied = timeout
+        if timeout is None:
+            applied = float(
+                self.conf["osd_ec_hedge_read_timeout"]) or None
+        for pg in self.pgs.values():
+            be = getattr(pg, "backend", None)
+            if be is not None and hasattr(be, "hedge_timeout"):
+                be.hedge_timeout = applied
+        if timeout != prev:
+            self.journal.emit(
+                "qos.hedge", epoch=self.osdmap.epoch if self.osdmap
+                else 0,
+                timeout_ms=round(timeout * 1e3, 3)
+                if timeout is not None else 0.0)
+        return timeout
+
     def _ec_resident_stats(self) -> dict:
         """Admin-socket ``ec resident stats``: the shared device-shard
         cache plus each primary EC PG's residency view."""
@@ -566,6 +639,12 @@ class OSDDaemon:
         sock.register("ec repair stats", self._ec_repair_stats,
                       "batched repair engine state (strategy split, "
                       "read-byte savings, mClock pacing)")
+        sock.register("mclock set", self._mclock_set,
+                      "retune one mClock class at runtime: "
+                      "clazz=<name> [reservation=] [weight=] [limit=]")
+        sock.register("mclock stats", self._mclock_stats,
+                      "mClock profiles, dispatch counts, queue depths, "
+                      "retune count, QoS hedge override")
         fp.register_admin_commands(sock)
         await sock.start(run_dir)
         self.admin_socket = sock
@@ -919,6 +998,16 @@ class OSDDaemon:
                 conn.send_message(Message("ec_repair_stats_reply", {
                     "tid": msg.data.get("tid", 0),
                     **self._ec_repair_stats(),
+                }))
+            except ConnectionError:
+                pass
+        elif t == "qos_set":
+            # mgr_qos fan-out: apply mClock retunes and/or the adaptive
+            # hedge timeout pushed by the cluster-wide QoS controller
+            try:
+                conn.send_message(Message("qos_set_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    **self._qos_set(msg.data),
                 }))
             except ConnectionError:
                 pass
@@ -1625,6 +1714,10 @@ class OSDDaemon:
                 return entry
 
             hedge = float(self.conf["osd_ec_hedge_read_timeout"])
+            if self._qos_hedge_override is not None:
+                # the QoS controller's adaptive timeout outlives
+                # backend rebuilds (peering re-instantiates them)
+                hedge = self._qos_hedge_override
             variant = str(self.conf["ec_pallas_encode_variant"])
             if variant:
                 from ceph_tpu.ec import pallas_kernels

@@ -588,6 +588,7 @@ class ECBackend:
         # encode+decode launch-us delta — the utilization telemetry's
         # HBM-roofline-% input)
         for _k in ("hedge_issued", "hedge_won", "hedge_lost",
+                   "hedge_meta",
                    "ec_coalesce_launches", "ec_coalesce_ops",
                    "ec_coalesce_pad_waste", "ec_device_launches",
                    "ec_launch_bytes",
@@ -598,7 +599,11 @@ class ECBackend:
                    "ec_mesh_occupancy"):
             self.perf.add(_k, CounterType.LONGRUNAVG)
         for _k in ("ec_encode_launch_us", "ec_decode_launch_us",
-                   "ec_coalesce_wait_hist_us", "ec_mesh_launch_us"):
+                   "ec_coalesce_wait_hist_us", "ec_mesh_launch_us",
+                   # per-shard-read latency as observed by this primary
+                   # — the distribution the QoS controller derives each
+                   # OSD's adaptive hedge timeout from
+                   "ec_shard_read_us"):
             self.perf.add(_k, CounterType.HISTOGRAM)
         # device residency (opt-in): keep shard streams on device in a
         # DeviceShardCache so repeated ops feed the kernel without host
@@ -1163,14 +1168,39 @@ class ECBackend:
         return _Track()
 
     # -- metadata --------------------------------------------------------
-    async def _attr_all(self, oid: str, name: str) -> list:
+    async def _attr_all(self, oid: str, name: str,
+                        hedged: bool = False) -> list:
         """Fetch one attr from every shard concurrently (metadata is
         replicated per shard; one round-trip worst case instead of k+m
         serial awaits). Each slot is bytes, KeyError (shard affirms the
-        object/attr absent), or another exception (shard unreachable)."""
-        return await asyncio.gather(*(
-            self.shards[i].get_attr(oid, name) for i in range(self.n)
-        ), return_exceptions=True)
+        object/attr absent), or another exception (shard unreachable).
+
+        ``hedged`` (client IO paths only): with a hedge timeout armed,
+        stragglers are cut loose once k shards have answered — a
+        committed write lands on at least n-m = k shards, so any k
+        answers include a fresh copy (the same bound the write path
+        commits with).  Without it, one dead-but-not-yet-marked-down
+        peer stalls every meta read for the whole down-detection
+        window, which IS the degraded-read tail."""
+        tasks = [asyncio.ensure_future(self.shards[i].get_attr(oid,
+                                                               name))
+                 for i in range(self.n)]
+        if hedged and self.hedge_timeout:
+            await asyncio.wait(tasks, timeout=self.hedge_timeout)
+            pending = [t for t in tasks if not t.done()]
+            if pending and len(tasks) - len(pending) >= self.k:
+                self.perf.inc("hedge_meta")
+                for t in pending:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                return [
+                    (ShardReadError(f"shard {i}: hedged (meta)")
+                     if t.cancelled()
+                     else t.exception() if t.exception() is not None
+                     else t.result())
+                    for i, t in enumerate(tasks)
+                ]
+        return await asyncio.gather(*tasks, return_exceptions=True)
 
     async def _get_attr_any(self, oid: str, name: str) -> bytes | None:
         """Read an attr from any shard that still has the object. Returns
@@ -1178,7 +1208,7 @@ class ECBackend:
         if every shard errored transiently, raises — 'unreachable' must
         never be mistaken for 'does not exist' (a write would then reset
         version and skip RMW read-back)."""
-        results = await self._attr_all(oid, name)
+        results = await self._attr_all(oid, name, hedged=True)
         errors = []
         absent = False
         for i, r in enumerate(results):
@@ -1201,7 +1231,7 @@ class ECBackend:
         inverting the stale-shard check (fresh shards would then fail
         version verification). The peering-time authoritative-version
         choice, applied per read."""
-        results = await self._attr_all(oid, VERSION_ATTR)
+        results = await self._attr_all(oid, VERSION_ATTR, hedged=True)
         best: ECObjectMeta | None = None
         errors = []
         absent = False
@@ -1708,6 +1738,31 @@ class ECBackend:
                                 length: int,
                                 shard_size: int | None = None,
                                 version: int | None = None) -> np.ndarray:
+        """Timing shell around :meth:`_read_shard_range_impl`: every
+        completed shard read (success or failure) lands one sample in
+        the ``ec_shard_read_us`` histogram — the distribution the QoS
+        controller derives this OSD's adaptive hedge timeout from.
+        Hedge-cancelled stragglers do NOT record: their observed
+        latency is the timeout itself, and feeding it back would let
+        the controller's own clamp masquerade as a measurement."""
+        t0 = time.monotonic()
+        try:
+            result = await self._read_shard_range_impl(
+                shard, oid, off, length, shard_size, version)
+        except asyncio.CancelledError:
+            raise
+        except BaseException:
+            self.perf.hinc("ec_shard_read_us",
+                           (time.monotonic() - t0) * 1e6)
+            raise
+        self.perf.hinc("ec_shard_read_us",
+                       (time.monotonic() - t0) * 1e6)
+        return result
+
+    async def _read_shard_range_impl(
+            self, shard: int, oid: str, off: int, length: int,
+            shard_size: int | None = None,
+            version: int | None = None) -> np.ndarray:
         """Read [off, off+length) of a shard. A read shorter than the
         region the shard is KNOWN to hold (from object metadata) is a
         shard failure — truncation must trigger reconstruction, not

@@ -66,15 +66,69 @@ class _Req:
 
 class MClockScheduler:
     def __init__(self, profiles: dict[str, ClassProfile] | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, journal=None):
         self.profiles = dict(profiles or DEFAULT_PROFILES)
         self.clock = clock
+        self.journal = journal      # flight recorder; retunes land here
+        self.retunes = 0
         self._prev: dict[str, tuple[float, float, float]] = {}
         self._queues: dict[str, deque[_Req]] = {}
         self._dispatched: dict[str, int] = {}
         self._task: asyncio.Task | None = None
         self._wake = asyncio.Event()
         self._stopped = False
+
+    # -- runtime retuning --------------------------------------------------
+    def set_profile(self, clazz: str, reservation: float | None = None,
+                    weight: float | None = None,
+                    limit: float | None = None) -> dict | None:
+        """Retune one class's R/W/L at runtime (the QoS controller's
+        mClock actuator; also reachable via the ``mclock set`` asok).
+        Omitted fields keep their current value; an unknown class needs
+        all three.  Already-stamped tags keep the rates they were
+        issued under — only ops submitted after the change pace at the
+        new profile.  Returns a change record (journaled as
+        ``mclock.retune``) or None when nothing moved."""
+        prof = self.profiles.get(clazz)
+        if prof is None and None in (reservation, weight, limit):
+            return None
+        new = ClassProfile(
+            reservation=float(prof.reservation if reservation is None
+                              else reservation),
+            weight=float(prof.weight if weight is None else weight),
+            limit=float(prof.limit if limit is None else limit),
+        ) if prof is not None else ClassProfile(
+            float(reservation), float(weight), float(limit))
+        if prof is not None and new == prof:
+            return None
+        self.profiles[clazz] = new
+        self.retunes += 1
+        change = {
+            "clazz": clazz,
+            "reservation": new.reservation,
+            "weight": new.weight,
+            "limit": new.limit,
+            "prev": None if prof is None else {
+                "reservation": prof.reservation,
+                "weight": prof.weight,
+                "limit": prof.limit,
+            },
+        }
+        if self.journal is not None:
+            self.journal.emit(
+                "mclock.retune", clazz=clazz,
+                reservation=round(new.reservation, 3),
+                weight=round(new.weight, 3),
+                limit=round(new.limit, 3),
+                prev_limit=round(prof.limit, 3) if prof else -1.0)
+        # re-evaluate queued heads: a raised limit may make one due now
+        self._wake.set()
+        return change
+
+    def profiles_dump(self) -> dict[str, dict]:
+        return {c: {"reservation": p.reservation, "weight": p.weight,
+                    "limit": p.limit}
+                for c, p in sorted(self.profiles.items())}
 
     # -- submission --------------------------------------------------------
     async def acquire(self, clazz: str, cost: int = 1) -> None:

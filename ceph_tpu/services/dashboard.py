@@ -11,6 +11,8 @@ exposition endpoint and the restful module's programmatic API
 - ``GET /api/osd`` / ``GET /api/pool``  resource listings (restful).
 - ``GET /api/slo``     per-objective SLO verdicts (value / burn rate /
   worst daemon) + utilization telemetry rates from the slo mgr module.
+- ``GET /api/qos``     QoS defense-plane state from the qos mgr module
+  (AIMD recovery limit, pushed hedge timeouts, front-door sheds).
 - ``GET /metrics``     prometheus text exposition of the mgr's last
   digest (the pybind/mgr/prometheus serve role) plus the SLO burn-rate
   and utilization gauges.
@@ -162,6 +164,14 @@ class Dashboard:
                 body = json.dumps({
                     "slo": digest.get("slo", {}),
                     "utilization": digest.get("utilization", {}),
+                }).encode()
+                ctype, status = "application/json", 200
+            elif path == "/api/qos":
+                # defense-plane state: controller AIMD position,
+                # pushed hedge timeouts, front-door shed counts
+                digest = self.mgr.last_digest or {}
+                body = json.dumps({
+                    "qos": digest.get("qos", {}),
                 }).encode()
                 ctype, status = "application/json", 200
             elif path == "/metrics":
@@ -484,6 +494,29 @@ class Dashboard:
                  esc(f"{util.get('client_p50_ms', 0.0):g} / "
                      f"{util.get('client_p99_ms', 0.0):g} / "
                      f"{util.get('client_p999_ms', 0.0):g}")],
+            ]))
+
+        qos = digest.get("qos") or {}
+        if qos.get("enabled"):
+            hedges = qos.get("hedge_timeouts_ms") or {}
+            hedge_s = ", ".join(f"{d}: {t:g}ms"
+                                for d, t in sorted(hedges.items())) \
+                or "none pushed"
+            section("QoS defense plane", table(["series", "value"], [
+                ["controller",
+                 ('<span style="color:#d22">BACKING OFF</span>'
+                  if qos.get("burning") else
+                  '<span style="color:#2a2">steady</span>')],
+                ["client latency burn",
+                 esc(f"{qos.get('burn', 0.0):g}x")],
+                ["recovery limit (ops/s)",
+                 esc(f"{qos.get('recovery_limit', 0.0):g} "
+                     f"(floor {qos.get('recovery_floor', 0.0):g}, "
+                     f"ceiling {qos.get('recovery_ceiling', 0.0):g})")],
+                ["mClock retunes", esc(str(qos.get("retunes", 0)))],
+                ["adaptive hedge timeouts", esc(hedge_s)],
+                ["recent RGW sheds (503)",
+                 esc(str(qos.get("recent_sheds", 0)))],
             ]))
 
         fsmap = s.get("fs") or {}

@@ -81,16 +81,20 @@ class Mgr:
                 OSDPerfQuery,
                 RBDSupport,
             )
+            from ceph_tpu.services.mgr_qos import QoSMonitor
             from ceph_tpu.services.mgr_slo import SLOMonitor
             from ceph_tpu.services.orchestrator import Orchestrator
 
             pq = OSDPerfQuery(self)
+            # QoSMonitor runs directly after SLOMonitor (insertion
+            # order is dispatch order): each report cycle the defense
+            # plane acts on the evaluation the SLO engine just made
             modules = [Balancer(self), PGAutoscaler(self),
                        Progress(self), DeviceHealth(self),
                        Telemetry(self), Insights(self),
                        SnapSchedule(self), Orchestrator(self),
                        pq, RBDSupport(self, pq), IOStat(self),
-                       SLOMonitor(self)]
+                       SLOMonitor(self), QoSMonitor(self)]
         self.modules = {m.name: m for m in modules}
         self.last_digest: dict | None = None
         # flight recorder: the mgr's own ring (SLO eval transitions,
@@ -124,6 +128,11 @@ class Mgr:
                 fut.set_result(msg.data.get("spans", []))
             return
         if msg.type == "forensics_capture_reply":
+            fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
+            if fut is not None and not fut.done():
+                fut.set_result(dict(msg.data))
+            return
+        if msg.type == "qos_set_reply":
             fut = self._futures.pop(int(msg.data.get("tid", 0)), None)
             if fut is not None and not fut.done():
                 fut.set_result(dict(msg.data))
@@ -342,6 +351,21 @@ class Mgr:
         timeline = merge_timeline(events)
         if not worst_daemon:
             worst_daemon = self._worst_from_bundle(daemons)
+        # mgr-module state at capture time (the QoS controller's AIMD
+        # position, pushed hedge timeouts, shed counts): a forensic
+        # bundle must show what the defense plane was DOING when the
+        # violation fired, not just what the daemons saw
+        module_state: dict[str, dict] = {}
+        for mname, mod in self.modules.items():
+            hook = getattr(mod, "forensics_contrib", None)
+            if hook is None:
+                continue
+            try:
+                contrib = hook()
+            except Exception:
+                continue
+            if contrib:
+                module_state[mname] = contrib
         self._forensics_seq += 1
         bundle_id = (f"forensics-{int(time.time())}"
                      f"-{self._forensics_seq:03d}")
@@ -353,6 +377,7 @@ class Mgr:
             "worst_daemon": worst_daemon,
             "detail": detail or {},
             "daemons": daemons,
+            "modules": module_state,
             "timeline": timeline,
         }
         path = os.path.join(self.forensics_dir(), f"{bundle_id}.json")

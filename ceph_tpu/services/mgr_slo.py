@@ -117,42 +117,28 @@ class SLOMonitor(MgrModule):
     # -- utilization telemetry (rates from the PR 6-8 counters) -----------
     def _win_pair(self, eng: SLOEngine, key: str) -> tuple[float, float]:
         """Window delta of a LONGRUNAVG counter: (sum, count)."""
-        if len(eng._snaps) < 2:
-            return 0.0, 0.0
-        _, old = eng._snaps[0]
-        _, new = eng._snaps[-1]
-        ds = dc = 0.0
-        for daemon, dump in new.items():
-            cur = dump.get(key)
-            if not isinstance(cur, dict):
-                continue
-            prev = old.get(daemon, {}).get(key, {})
-            if not isinstance(prev, dict):
-                prev = {}
-            ds += float(cur.get("sum", 0.0)) - float(prev.get("sum", 0.0))
-            dc += float(cur.get("avgcount", 0)) \
-                - float(prev.get("avgcount", 0))
-        return max(0.0, ds), max(0.0, dc)
+        return eng.snapshot_window().pair(key)
 
     def _utilization(self, eng: SLOEngine) -> dict:
         gib = float(1 << 30)
-        span = eng.window_span()
+        win = eng.snapshot_window()
+        span = win.span
         peak = float(self.mgr.conf["ec_hbm_peak_gibps"] or 1.0)
 
-        launch_bytes, _ = eng._window_scalar("ec_launch_bytes")
-        enc_h, _ = eng._window_hist("ec_encode_launch_us")
-        dec_h, _ = eng._window_hist("ec_decode_launch_us")
+        launch_bytes, _ = win.scalar("ec_launch_bytes")
+        enc_h, _ = win.hist("ec_encode_launch_us")
+        dec_h, _ = win.hist("ec_decode_launch_us")
         launch_s = (enc_h.get("sum", 0.0) + dec_h.get("sum", 0.0)) / 1e6
         device_gibps = (launch_bytes / gib / launch_s) if launch_s > 0 \
             else 0.0
 
-        occ_sum, occ_n = self._win_pair(eng, "ec_coalesce_occupancy")
-        wait_h, _ = eng._window_hist("ec_coalesce_wait_hist_us")
-        hits, _ = eng._window_scalar("ec_resident_hits")
-        misses, _ = eng._window_scalar("ec_resident_misses")
+        occ_sum, occ_n = win.pair("ec_coalesce_occupancy")
+        wait_h, _ = win.hist("ec_coalesce_wait_hist_us")
+        hits, _ = win.scalar("ec_resident_hits")
+        misses, _ = win.scalar("ec_resident_misses")
         lookups = hits + misses
-        rebuild_bytes, _ = eng._window_scalar("ec_repair_rebuild_bytes")
-        cli_h, _ = eng._window_hist("op_latency_us")
+        rebuild_bytes, _ = win.scalar("ec_repair_rebuild_bytes")
+        cli_h, _ = win.hist("op_latency_us")
 
         def q_ms(h, q):
             v = hist_quantile(h, q)

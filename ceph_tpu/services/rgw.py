@@ -527,6 +527,11 @@ class RGWLite:
         # across as_user handles like _notif_cache.
         self._pushers: dict[str, tuple] = {}
         self._topics_cache: dict[str, tuple[float, dict | None]] = {}
+        # front-door QoS admission telemetry (rgw_http sheds overload
+        # with 503 Slow Down and counts here; shared across as_user
+        # handles so one gateway keeps one ledger)
+        self.qos_stats: dict[str, int] = {
+            "admitted": 0, "shed_inflight": 0, "shed_session": 0}
         self.striper = RadosStriper(ioctx, StripeLayout(
             stripe_unit=512 * 1024, stripe_count=4,
             object_size=4 * 1024 * 1024,
@@ -551,6 +556,7 @@ class RGWLite:
         child._pushers = self._pushers
         child._topics_cache = self._topics_cache
         child._pool_handles = self._pool_handles
+        child.qos_stats = self.qos_stats
         child.tracer = self.tracer
         return child
 

@@ -33,7 +33,9 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from email.utils import formatdate
 
+from ceph_tpu.common.events import emit_proc
 from ceph_tpu.common.log import Dout
+from ceph_tpu.common.qos import TokenBucket
 from ceph_tpu.services.rgw import (
     ANONYMOUS,
     RGWError,
@@ -255,6 +257,12 @@ class S3Frontend:
         # bucket -> (fetched_at, cors rules): decoration must not
         # double bucket-meta reads on every Origin-bearing request
         self._cors_cache: dict[str, tuple[float, list]] = {}
+        # QoS admission control (the front-door actuator of the
+        # defense plane): requests in flight behind the gate + one
+        # token bucket per session (access key); conf is read live so
+        # the knobs retune without a frontend restart
+        self._inflight = 0
+        self._buckets: dict[str, TokenBucket] = {}
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
@@ -295,6 +303,19 @@ class S3Frontend:
                     # leaves the socket reusable
                     keep_after_stream = keep
                     keep = False
+                shed = self._admission(req)
+                if shed is not None:
+                    # overload sheds at the front door, before any
+                    # RADOS work: 503 Slow Down + Retry-After.  A
+                    # streamed body was never drained, so the socket
+                    # cannot be reused
+                    status, headers, body = shed
+                    await self._respond(writer, req, status, headers,
+                                        body, keep)
+                    if not keep:
+                        break
+                    continue
+                self._inflight += 1
                 try:
                     status, headers, body = await self._route(req)
                 except _HTTPError as e:
@@ -313,6 +334,8 @@ class S3Frontend:
                     log.dout(1, "request failed: %r", e)
                     status, headers, body = self._error(
                         500, "InternalError", type(e).__name__)
+                finally:
+                    self._inflight -= 1
                 if req.stream is not None and \
                         req.stream_consumed >= req.content_length:
                     keep = keep_after_stream
@@ -396,7 +419,8 @@ class S3Frontend:
                        keep: bool) -> None:
         self._reqid += 1
         reason = {200: "OK", 204: "No Content", 206: "Partial Content",
-                  403: "Forbidden", 404: "Not Found"}.get(status, "S3")
+                  403: "Forbidden", 404: "Not Found",
+                  503: "Slow Down"}.get(status, "S3")
         out = [f"HTTP/1.1 {status} {reason}"]
         streaming = not isinstance(body, (bytes, bytearray))
         base = {
@@ -440,6 +464,73 @@ class S3Frontend:
         body = ET.tostring(root, xml_declaration=True,
                            encoding="unicode").encode()
         return status, {"content-type": "application/xml"}, body
+
+    # -- QoS admission control (front-door defense plane) -----------------
+    def _qos_conf(self):
+        """(max_inflight, session_rate, burst, retry_after) read live
+        from conf — 0/0 disables both gates (the default)."""
+        try:
+            conf = self.rgw.ioctx.rados.conf
+            return (int(conf["rgw_max_inflight"]),
+                    float(conf["rgw_session_ops_per_s"]),
+                    float(conf["rgw_session_burst"]),
+                    float(conf["rgw_retry_after_s"]))
+        except (AttributeError, KeyError, TypeError, ValueError):
+            return 0, 0.0, 8.0, 1.0
+
+    @staticmethod
+    def _session_key(req: _Request) -> str:
+        """Throttle identity: the access key from the SigV4 header or
+        presigned query (cheap string parse, no verification — a shed
+        request never reaches auth)."""
+        auth = req.header("authorization")
+        marker = "Credential="
+        i = auth.find(marker)
+        if i >= 0:
+            cred = auth[i + len(marker):]
+            return cred.split("/", 1)[0].split(",", 1)[0]
+        cred = req.query.get("X-Amz-Credential", "")
+        if cred:
+            return cred.split("/", 1)[0]
+        return "anonymous"
+
+    def _admission(self, req: _Request):
+        """Queue-depth gate + per-session token bucket.  Returns a
+        ready 503 Slow Down response tuple when the request sheds,
+        None when admitted."""
+        max_inflight, rate, burst, retry = self._qos_conf()
+        if max_inflight <= 0 and rate <= 0:
+            return None
+        if max_inflight > 0 and self._inflight >= max_inflight:
+            return self._shed(req, "inflight", retry)
+        if rate > 0:
+            now = asyncio.get_running_loop().time()
+            key = self._session_key(req)
+            bucket = self._buckets.get(key)
+            if bucket is None or bucket.rate != rate:
+                bucket = self._buckets[key] = TokenBucket(
+                    rate, burst, now)
+            if not bucket.take(now):
+                return self._shed(req, "session",
+                                  max(retry, bucket.retry_after()),
+                                  session=key)
+        self.rgw.qos_stats["admitted"] += 1
+        return None
+
+    def _shed(self, req: _Request, reason: str, retry_after: float,
+              session: str = ""):
+        self.rgw.qos_stats[f"shed_{reason}"] = \
+            self.rgw.qos_stats.get(f"shed_{reason}", 0) + 1
+        emit_proc("qos.shed", reason=reason, method=req.method,
+                  path=req.path, session=session,
+                  inflight=self._inflight)
+        log.dout(5, "shed %s %s (%s): 503 Slow Down",
+                 req.method, req.path, reason)
+        status, headers, body = self._error(
+            503, "SlowDown", "please reduce your request rate")
+        headers = {**headers,
+                   "retry-after": str(max(1, int(round(retry_after))))}
+        return status, headers, body
 
     # -- auth (rgw_auth_s3.cc) --------------------------------------------
     async def _identify(self, req: _Request) -> str:

@@ -98,16 +98,29 @@ class RadosBackend(Backend):
 class S3Backend(Backend):
     """SigV4-signed S3 traffic against an RGW frontend (stdlib-only
     signing via services.rgw_http; one connection per op, the
-    connection:close discipline the frontend's tests use)."""
+    connection:close discipline the frontend's tests use).
+
+    ``503 Slow Down`` from the frontend's admission control is
+    THROTTLING, not an error: the op retries after the server's
+    Retry-After (capped at ``throttle_backoff_cap``), up to
+    ``max_throttle_retries`` times, and each shed counts into
+    ``self.throttled`` — a well-behaved tenant backing off must not
+    poison the error-rate SLO objective."""
 
     def __init__(self, host: str, port: int, access_key: str,
-                 secret_key: str, bucket: str = "loadgen"):
+                 secret_key: str, bucket: str = "loadgen",
+                 max_throttle_retries: int = 4,
+                 throttle_backoff_cap: float = 2.0):
         self.host, self.port = host, port
         self.ak, self.sk = access_key, secret_key
         self.bucket = bucket
+        self.max_throttle_retries = int(max_throttle_retries)
+        self.throttle_backoff_cap = float(throttle_backoff_cap)
+        self.throttled = 0
 
     async def _request(self, method: str, path: str,
-                       body: bytes = b"") -> tuple[int, bytes]:
+                       body: bytes = b""
+                       ) -> tuple[int, dict[str, str], bytes]:
         import hashlib
 
         from ceph_tpu.services.rgw_http import _Request, sigv4_sign
@@ -132,23 +145,52 @@ class S3Backend(Backend):
         finally:
             writer.close()
         head, _, payload = raw.partition(b"\r\n\r\n")
-        status = int(head.decode().split("\r\n")[0].split(" ")[1])
-        return status, payload
+        head_lines = head.decode().split("\r\n")
+        status = int(head_lines[0].split(" ")[1])
+        resp_hdrs: dict[str, str] = {}
+        for line in head_lines[1:]:
+            k, _, v = line.partition(":")
+            resp_hdrs[k.strip().lower()] = v.strip()
+        return status, resp_hdrs, payload
+
+    async def _request_throttled(self, method: str, path: str,
+                                 body: bytes = b""
+                                 ) -> tuple[int, bytes]:
+        """One op with 503-as-throttling semantics: honor Retry-After
+        with capped backoff; retries exhausted surfaces the 503."""
+        attempt = 0
+        while True:
+            status, hdrs, payload = await self._request(method, path,
+                                                        body)
+            if status != 503:
+                return status, payload
+            self.throttled += 1
+            if attempt >= self.max_throttle_retries:
+                return status, payload
+            try:
+                delay = float(hdrs.get("retry-after", "") or 0.0)
+            except ValueError:
+                delay = 0.0
+            if delay <= 0:
+                delay = 0.05 * (2 ** attempt)      # no header: expo
+            await asyncio.sleep(min(delay, self.throttle_backoff_cap))
+            attempt += 1
 
     async def ensure_bucket(self) -> None:
-        status, _ = await self._request("PUT", f"/{self.bucket}")
+        status, _ = await self._request_throttled("PUT",
+                                                  f"/{self.bucket}")
         if status not in (200, 409):
             raise RuntimeError(f"bucket create HTTP {status}")
 
     async def put(self, key: str, data: bytes) -> None:
-        status, _ = await self._request("PUT",
-                                        f"/{self.bucket}/{key}", data)
+        status, _ = await self._request_throttled(
+            "PUT", f"/{self.bucket}/{key}", data)
         if status >= 300:
             raise RuntimeError(f"PUT {key} HTTP {status}")
 
     async def get(self, key: str) -> bytes:
-        status, body = await self._request("GET",
-                                           f"/{self.bucket}/{key}")
+        status, body = await self._request_throttled(
+            "GET", f"/{self.bucket}/{key}")
         if status >= 300:
             raise RuntimeError(f"GET {key} HTTP {status}")
         return body
@@ -317,6 +359,9 @@ class LoadGen:
             "seed": self.seed, "mode": self.mode,
             "clients": self.clients,
             "ops": ops, "errors": int(dump.get("errors", 0)),
+            # admission-control sheds the backend absorbed via
+            # Retry-After backoff (0 for backends without throttling)
+            "throttled": int(getattr(self.backend, "throttled", 0)),
             "puts": int(dump.get("puts", 0)),
             "gets": int(dump.get("gets", 0)),
             "bytes_put": int(dump.get("bytes_put", 0)),

@@ -365,7 +365,9 @@ class DevCluster:
             assert r["rc"] == 0, r
         ioctx = await rados.open_ioctx(pool)
         users = RGWUsers(ioctx)
-        gw = RGWLite(ioctx, users=users)
+        gw = RGWLite(ioctx, users=users,
+                     gc_min_wait=float(
+                         rados.conf["rgw_gc_obj_min_wait"]))
         if cold_pool:
             zp = ZonePlacement(ioctx)
             await zp.ensure_pool(cold_pool,

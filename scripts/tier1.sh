@@ -62,6 +62,15 @@
 #                                   nonzero p50/p99 from the histogram
 #                                   layer, an SLO verdict present in
 #                                   the digest, and zero loadgen errors
+#   scripts/tier1.sh --qos-smoke    QoS defense plane end to end: a
+#                                   3-OSD vstart cluster with the mgr
+#                                   QoS module armed and a tiny RGW
+#                                   session rate; overload sheds >= 1
+#                                   request with 503 Slow Down, a
+#                                   failpoint-driven latency storm
+#                                   forces >= 1 mClock recovery retune,
+#                                   and after the storm drains every
+#                                   object reads back bit-identical
 #   scripts/tier1.sh --scale-smoke  O(cluster) control plane at scale:
 #                                   a 200-OSD / 3-mon vstart cluster on
 #                                   the lightweight scale profile —
@@ -730,6 +739,115 @@ async def main():
 asyncio.run(main())
 EOF
     echo "SERVE_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--qos-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    python - <<'EOF'
+import asyncio
+
+
+async def main():
+    from ceph_tpu.common import failpoint as fp
+    from ceph_tpu.common.events import proc_journal
+    from ceph_tpu.testing.loadgen import LoadGen, S3Backend
+    from ceph_tpu.vstart import DevCluster
+
+    fp.fp_clear()
+    fp.set_seed(0)
+    cluster = DevCluster(n_mons=1, n_osds=3, overrides={
+        "qos_enable": True,
+        "slo_put_p99_ms": 50.0, "slo_window": 1.5,
+        "slo_raise_evals": 1, "slo_clear_evals": 1,
+        "rgw_session_ops_per_s": 20.0, "rgw_session_burst": 2.0,
+        "rgw_retry_after_s": 0.05,
+        "rgw_gc_obj_min_wait": 300.0,
+    })
+    await cluster.start()
+    try:
+        mgr = await cluster.start_mgr(report_interval=0.1)
+        fe, users = await cluster.start_rgw(pool="rgw")
+        alice = await users.create("alice")
+        be = S3Backend(fe.host, fe.port, alice["access_key"],
+                       alice["secret_key"], bucket="qossmoke",
+                       max_throttle_retries=12)
+        print("ok: vstart cluster + mgr QoS module + RGW admission "
+              "(20 op/s per session)")
+
+        # overload the front door: the per-session bucket sheds and
+        # the client backs off on Retry-After instead of erroring
+        gen = LoadGen(be, seed=11, mode="closed", clients=4,
+                      total_ops=60, n_keys=8,
+                      size_mix=[(512, 1.0)])
+        await gen.populate()
+        res = await gen.run()
+        assert res["errors"] == 0, res
+        assert res["throttled"] > 0, res
+        sheds = [e for e in proc_journal().snapshot()
+                 if e["type"] == "qos.shed"]
+        assert sheds, "no qos.shed event journaled"
+        assert fe.rgw.qos_stats["shed_session"] > 0
+        print(f"ok: {res['throttled']} requests shed with 503 Slow "
+              f"Down and retried clean (0 errors)")
+
+        # latency storm: stalled sub-ops burn put_p99, the controller
+        # backs the recovery mClock class off cluster-wide
+        rados = await cluster.client()
+        await rados.pool_create("qosp", pg_num=4, size=3)
+        io = await rados.open_ioctx("qosp")
+        datas = {}
+        for i in range(8):
+            datas[f"o{i}"] = bytes([i]) * 2048
+            await io.write_full(f"o{i}", datas[f"o{i}"])
+
+        def retunes():
+            return [e["fields"] for e in mgr.journal.snapshot()
+                    if e["type"] == "qos.retune"]
+
+        fp.fp_set("osd.sub_op", "delay", delay=0.3)
+        deadline = asyncio.get_running_loop().time() + 20.0
+        i = 0
+        while not retunes():
+            await io.write_full(f"slow{i}", b"y" * 512)
+            i += 1
+            assert asyncio.get_running_loop().time() < deadline, \
+                "no qos.retune within 20s of storm"
+            await asyncio.sleep(0.05)
+        first = retunes()[0]
+        assert first["limit"] < 256.0, first
+        print(f"ok: recovery mClock class backed off to "
+              f"{first['limit']} ops/s (burn {first['burn']})")
+
+        # drain: the burn clears, the controller ramps back, and every
+        # pre-storm object reads back bit-identical
+        fp.fp_clear("osd.sub_op")
+        floor_lim = min(r["limit"] for r in retunes())
+        deadline = asyncio.get_running_loop().time() + 20.0
+        while retunes()[-1]["limit"] <= floor_lim:
+            await io.write_full("fast", b"z" * 512)
+            assert asyncio.get_running_loop().time() < deadline, \
+                "no ramp-up retune after the storm cleared"
+            await asyncio.sleep(0.1)
+        print(f"ok: storm drained, recovery limit ramping "
+              f"({retunes()[-1]['limit']} ops/s)")
+
+        for o, d in datas.items():
+            got = await io.read(o)
+            assert got == d, f"read-back mismatch on {o}"
+        # the S3 objects survived the shedding too
+        data = await be.get("k00000")
+        assert data.startswith(b"k00000:")
+        print(f"ok: bit-identical read-back ({len(datas)} rados + "
+              f"s3 objects) after drain")
+    finally:
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+    echo "QOS_SMOKE_PASSED"
     exit 0
 fi
 
