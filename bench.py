@@ -1387,6 +1387,82 @@ def _serve_main() -> None:
     print(json.dumps(record), flush=True)
 
 
+def _cfg13_expansion(seed: int = 0, defend: bool = False) -> dict:
+    """cfg13 single arm: the seeded live-expansion drill from
+    testing/chaos.py as a bench scenario.  The drill itself asserts
+    the hard gates (moved objects/bytes EQUAL the PoolTables.diff
+    prediction, batched launches ≪ objects, client p99 and
+    time-to-balanced inside SLO) — a returned dict IS a passed arm.
+
+    ``defend=True`` arms the PR-15 QoS defense plane, which paces the
+    motion as the backfill mClock class (its own AIMD floor/ceiling,
+    distinct from failure recovery)."""
+    import asyncio
+
+    async def run() -> dict:
+        from ceph_tpu.testing.chaos import run_expansion_drill
+
+        overrides = None
+        if defend:
+            overrides = {"qos_enable": True,
+                         "qos_hedge_min_samples": 8,
+                         "qos_hedge_max_ms": 100.0}
+        return await run_expansion_drill(seed=seed, overrides=overrides)
+
+    return asyncio.run(run())
+
+
+def _cfg13_main() -> None:
+    """Standalone cfg13 entry
+    (``python bench.py --cfg13 [--seed N] [--defend on|off|ab]``):
+    CPU-sufficient — placement diff, motion accounting, and SLO
+    verdicts are exact on any backend; on-chip the same drill measures
+    real decode-launch batching.  Default (and ``--defend ab``) runs
+    the QoS off/on pair at one seed and appends ONE paired record:
+    value is the defended arm's time-to-balanced, vs_baseline proves
+    both arms moved exactly what PoolTables.diff predicted while the
+    defended arm held the client p99 SLO with backfill still draining
+    to completion (above its floor, or it would never have balanced)."""
+    seed = 0
+    argv = sys.argv[1:]
+    if "--seed" in argv:
+        seed = int(argv[argv.index("--seed") + 1])
+    defend = "ab"
+    if "--defend" in argv:
+        defend = argv[argv.index("--defend") + 1]
+        if defend not in ("on", "off", "ab"):
+            raise SystemExit(f"--defend {defend!r}: want on|off|ab")
+
+    if defend == "ab":
+        off = _cfg13_expansion(seed=seed, defend=False)
+        on = _cfg13_expansion(seed=seed, defend=True)
+        ok = (off["moved"]["objects"] == off["predicted"]["objects"]
+              and on["moved"]["objects"] == on["predicted"]["objects"]
+              and off["moved"]["bytes"] == off["predicted"]["bytes"]
+              and on["moved"]["bytes"] == on["predicted"]["bytes"]
+              and on["slo"]["pass"])
+        record = {
+            "metric": "expansion_rebalance_slo_ab",
+            "value": on["slo"]["time_to_balanced_s"],
+            "unit": "s time-to-balanced (QoS armed)",
+            "vs_baseline": float(ok),
+            "extra": {"seed": seed, "off": off, "on": on},
+        }
+    else:
+        out = _cfg13_expansion(seed=seed, defend=(defend == "on"))
+        record = {
+            "metric": f"expansion_rebalance_slo_defend_{defend}",
+            "value": out["slo"]["time_to_balanced_s"],
+            "unit": "s time-to-balanced",
+            "vs_baseline": float(
+                out["moved"]["bytes"] == out["predicted"]["bytes"]
+                and out["slo"]["pass"]),
+            "extra": out,
+        }
+    _append_local_record(record)
+    print(json.dumps(record), flush=True)
+
+
 def _append_local_record(record: dict) -> None:
     """Append a successful run to BENCH_LOCAL.jsonl (the auditable local
     trail; PERF.md explains the protocol)."""
@@ -1525,6 +1601,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--cfg11" in sys.argv[1:]:
         _cfg11_main()
+        sys.exit(0)
+    if "--cfg13" in sys.argv[1:]:
+        _cfg13_main()
         sys.exit(0)
     try:
         main()

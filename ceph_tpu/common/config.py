@@ -114,6 +114,19 @@ def global_options() -> list[Option]:
                "scrub reservation (ops/s)"),
         Option("osd_mclock_scrub_wgt", float, 1.0, "scrub weight"),
         Option("osd_mclock_scrub_lim", float, 0.0, "scrub limit"),
+        # backfill = PLANNED data motion (topology change), a distinct
+        # mClock class from recovery (failure repair) so the QoS plane
+        # can pace rebalance and rebuild independently
+        Option("osd_mclock_backfill_res", float, 5.0,
+               "backfill reservation (ops/s)"),
+        Option("osd_mclock_backfill_wgt", float, 1.0,
+               "backfill weight"),
+        Option("osd_mclock_backfill_lim", float, 0.0, "backfill limit"),
+        Option("osd_max_backfills", int, 1,
+               "backfill reservation slots per OSD (local + remote): a "
+               "PG's planned motion starts only once every participant "
+               "granted a slot, so one daemon serves at most this many "
+               "concurrent backfills", min=1),
         Option("osd_client_op_priority", int, 63, "client op priority"),
         Option("mon_lease", float, 2.0,
                "peon lease / liveness window (s)", min=0.1),
@@ -361,6 +374,21 @@ def global_options() -> list[Option]:
                "assumed GiB rebuilt per recovery-class mClock grant, "
                "used to translate slo_rebuild_floor_gibs into a "
                "minimum recovery ops/s", Level.ADVANCED, min=1e-9),
+        Option("qos_backfill_max_ops", float, 128.0,
+               "backfill-class mClock limit ceiling the controller "
+               "ramps back to when client SLOs are healthy (planned "
+               "motion gets its own AIMD position, separate from "
+               "recovery)", Level.ADVANCED, min=1.0),
+        Option("qos_backfill_min_ops", float, 2.0,
+               "absolute floor for the backfill-class mClock limit: "
+               "backoff never parks planned motion below this pace",
+               Level.ADVANCED, min=0.1),
+        Option("qos_backfill_min_share", float, 0.02,
+               "backfill pacing floor as a fraction of "
+               "qos_backfill_max_ops (combined with the ops floor via "
+               "max; no rebuild-GiB term — redundancy is intact during "
+               "planned motion, so backfill may be squeezed harder "
+               "than recovery)", Level.ADVANCED, min=0.0, max=1.0),
         Option("qos_hedge_quantile", float, 0.95,
                "derive each OSD's EC hedge-read timeout from this "
                "quantile of its windowed shard-read latency histogram "

@@ -163,7 +163,11 @@ class QoSController:
                  backoff: float, ramp_ops: float,
                  raise_evals: int, clear_evals: int,
                  hedge_quantile: float, hedge_min_s: float,
-                 hedge_max_s: float, hedge_min_samples: int):
+                 hedge_max_s: float, hedge_min_samples: int,
+                 backfill_res: float = 5.0,
+                 backfill_max_ops: float = 128.0,
+                 backfill_min_ops: float = 2.0,
+                 backfill_min_share: float = 0.02):
         # the pacing floor: never throttle recovery below the largest
         # of (absolute ops floor, share-of-ceiling floor, the ops rate
         # that sustains slo_rebuild_floor_gibs at the assumed GiB/op)
@@ -176,6 +180,18 @@ class QoSController:
             ceiling=recovery_max_ops, backoff=backoff, ramp=ramp_ops,
             raise_evals=raise_evals, clear_evals=clear_evals)
         self.recovery_res = float(recovery_res)
+        # backfill (planned motion) is a SECOND AIMD position driven by
+        # the SAME burn signal but with its own floor/ceiling: during
+        # rebalance every object still has full redundancy, so there is
+        # no rebuild-GiB floor term and backfill may be squeezed much
+        # harder than failure recovery before the controller relents
+        bf_floor = max(backfill_min_ops,
+                       backfill_min_share * backfill_max_ops)
+        self.backfill = AIMDController(
+            initial=backfill_max_ops, floor=bf_floor,
+            ceiling=backfill_max_ops, backoff=backoff, ramp=ramp_ops,
+            raise_evals=raise_evals, clear_evals=clear_evals)
+        self.backfill_res = float(backfill_res)
         self.hedge_quantile = float(hedge_quantile)
         self.hedge_min_s = float(hedge_min_s)
         self.hedge_max_s = float(hedge_max_s)
@@ -201,6 +217,10 @@ class QoSController:
             hedge_min_s=float(conf["qos_hedge_min_ms"]) / 1e3,
             hedge_max_s=float(conf["qos_hedge_max_ms"]) / 1e3,
             hedge_min_samples=int(conf["qos_hedge_min_samples"]),
+            backfill_res=float(conf["osd_mclock_backfill_res"]),
+            backfill_max_ops=float(conf["qos_backfill_max_ops"]),
+            backfill_min_ops=float(conf["qos_backfill_min_ops"]),
+            backfill_min_share=float(conf["qos_backfill_min_share"]),
         )
 
     @staticmethod
@@ -221,6 +241,7 @@ class QoSController:
 
             {"burning": bool, "burn": float,
              "recovery": {"limit", "reservation", "floor", "changed"},
+             "backfill": {"limit", "reservation", "floor", "changed"},
              "hedge": {daemon: timeout_s}}   # only entries that moved
 
         ``hedge`` keys are daemon names (``osd.N``); an entry appears
@@ -240,6 +261,15 @@ class QoSController:
             "changed": new_limit is not None,
         }
         if new_limit is not None:
+            self.retunes += 1
+        new_bf = self.backfill.step(burning)
+        bf = {
+            "limit": self.backfill.value,
+            "reservation": min(self.backfill_res, self.backfill.value),
+            "floor": self.backfill.floor,
+            "changed": new_bf is not None,
+        }
+        if new_bf is not None:
             self.retunes += 1
 
         hedge: dict[str, float] = {}
@@ -264,7 +294,7 @@ class QoSController:
                 hedge[daemon] = t
 
         return {"burning": burning, "burn": burn, "recovery": rec,
-                "hedge": hedge}
+                "backfill": bf, "hedge": hedge}
 
     def state(self) -> dict:
         """Controller state snapshot (digest / forensic bundles)."""
@@ -274,6 +304,9 @@ class QoSController:
             "recovery_limit": round(self.recovery.value, 3),
             "recovery_floor": round(self.recovery.floor, 3),
             "recovery_ceiling": round(self.recovery.ceiling, 3),
+            "backfill_limit": round(self.backfill.value, 3),
+            "backfill_floor": round(self.backfill.floor, 3),
+            "backfill_ceiling": round(self.backfill.ceiling, 3),
             "hedge_timeouts_ms": {
                 d: round(t * 1e3, 3)
                 for d, t in sorted(self._hedge_last.items())},

@@ -68,6 +68,7 @@ class MgrStatMonitor(PaxosService):
             "num_objects": int(d.get("num_objects", 0)),
             "num_bytes": int(d.get("num_bytes", 0)),
             "degraded_objects": int(d.get("degraded_objects", 0)),
+            "misplaced_objects": int(d.get("misplaced_objects", 0)),
         }
 
     def health_checks(self) -> dict[str, dict]:
@@ -92,6 +93,17 @@ class MgrStatMonitor(PaxosService):
                 "message":
                     f"Degraded data redundancy: {degraded} objects "
                     "degraded",
+            }
+        # misplaced is NOT lost redundancy (planned motion: every
+        # object still fully redundant on its old holders), but health
+        # stays WARN until the backfill engine finishes draining so
+        # wait-for-clean callers really wait for motion-complete
+        misplaced = int(d.get("misplaced_objects", 0))
+        if misplaced:
+            checks["OBJECT_MISPLACED"] = {
+                "severity": "HEALTH_WARN",
+                "message": f"{misplaced} objects misplaced "
+                           "(backfill in progress)",
             }
         inactive = {
             s: n for s, n in d.get("pgs_by_state", {}).items()

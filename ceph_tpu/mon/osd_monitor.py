@@ -500,6 +500,8 @@ class OSDMonitor(PaxosService):
                 return self._cmd_tier(name, cmd)
             if name in ("osd set", "osd unset"):
                 return self._cmd_flag(name == "osd set", cmd)
+            if name == "osd purge":
+                return self._cmd_osd_purge(cmd)
             if name == "osd blocklist":
                 return self._cmd_blocklist(cmd)
             if name == "osd pool set-quota":
@@ -904,8 +906,9 @@ class OSDMonitor(PaxosService):
     # every accepted flag is ENFORCED somewhere (noout: tick out-aging;
     # noin: boot weight; noup: boot; nodown: failure reports; pause:
     # OSD op path; norecover/nobackfill: peering recovery gate;
-    # noscrub: scrub loop) — accepting a no-op flag would lie to the
-    # operator
+    # norebalance: peering backfill gate for PGs whose motion is pure
+    # remap — degraded recovery still runs; noscrub: scrub loop) —
+    # accepting a no-op flag would lie to the operator
     def _cmd_setcrushmap(self, cmd: dict) -> CommandResult:
         """``osd setcrushmap`` with the compiler text form (the
         crushtool -c | ceph osd setcrushmap pipeline): the candidate
@@ -938,7 +941,7 @@ class OSDMonitor(PaxosService):
         return CommandResult(outs="set crush map")
 
     FLAGS = ("noout", "noin", "noup", "nodown", "pause", "norecover",
-             "nobackfill", "noscrub")
+             "nobackfill", "norebalance", "noscrub")
 
     def _cmd_pool_quota(self, cmd: dict) -> CommandResult:
         """osd pool set-quota <pool> max_bytes|max_objects <val>
@@ -1075,6 +1078,36 @@ class OSDMonitor(PaxosService):
                 if osd not in pending.new_down:
                     pending.new_down.append(osd)
         return CommandResult(outs=f"{name} {ids}")
+
+    def _cmd_osd_purge(self, cmd: dict) -> CommandResult:
+        """``osd purge <id>``: remove a drained OSD from the map and
+        its CRUSH device item (the drain-then-remove epilogue).  The
+        OSD must already be down AND out — purging live or still-
+        weighted daemons would turn planned motion into failure
+        repair."""
+        osd = int(cmd["id"])
+        info = self.osdmap.osds.get(osd)
+        if info is None:
+            return CommandResult(ENOENT_RC, f"no osd.{osd}")
+        if info.up:
+            return CommandResult(
+                EINVAL_RC, f"osd.{osd} is up; stop it first")
+        pending = self._pending()
+        weight = pending.new_weights.get(osd, info.weight)
+        if weight > 0:
+            return CommandResult(
+                EINVAL_RC,
+                f"osd.{osd} is in; mark it out and wait for motion "
+                "to complete first")
+        if osd not in pending.removed_osds:
+            pending.removed_osds.append(osd)
+        new_crush = (CrushMap.from_dict(pending.new_crush)
+                     if pending.new_crush else
+                     CrushMap.from_dict(self.osdmap.crush.to_dict()))
+        if new_crush.remove_item(osd):
+            pending.new_crush = new_crush.to_dict()
+        self.mon.cluster_log("info", f"osd.{osd} purged")
+        return CommandResult(outs=f"purged osd.{osd}")
 
     def _merge_unsettled(self, pool_id: int) -> str | None:
         """The mon-visible ready-to-merge signals (the reference gates

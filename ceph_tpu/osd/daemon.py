@@ -74,6 +74,7 @@ from ceph_tpu.osd.op_tracker import OpTracker
 from ceph_tpu.osd.scheduler import MClockScheduler
 from ceph_tpu.osd.pg import (
     STATE_ACTIVE,
+    STATE_INCOMPLETE,
     STATE_PEERING,
     STATE_RECOVERING,
     MissingSet,
@@ -272,7 +273,7 @@ class OSDDaemon:
                 weight=self.conf[f"osd_mclock_{clazz}_wgt"],
                 limit=self.conf[f"osd_mclock_{clazz}_lim"],
             )
-            for clazz in ("client", "recovery", "scrub")
+            for clazz in ("client", "recovery", "backfill", "scrub")
         }, journal=self.journal)
         # QoS defense plane override: when the mgr controller pushes a
         # hedge timeout (qos_set), it supersedes the static conf value
@@ -296,6 +297,22 @@ class OSDDaemon:
             max_batch_objects=int(
                 self.conf["osd_ec_repair_batch_objects"]),
         )
+        # planned-motion twin of the repair engine: topology-change
+        # (backfill) drains reuse the same batched machinery but pace
+        # as the mClock "backfill" class, checkpoint a persisted
+        # cursor, and gate on per-OSD reservation slots.  Local slots
+        # cover PGs this daemon primaries, remote slots PGs
+        # backfilling INTO this daemon — separate pools (the
+        # local_reserver/remote_reserver split) so two mutually-
+        # backfilling primaries cannot deadlock.
+        from ceph_tpu.osd.backfill import BackfillEngine, BackfillSlots
+        self.backfill_local = BackfillSlots(
+            int(self.conf["osd_max_backfills"]))
+        self.backfill_remote = BackfillSlots(
+            int(self.conf["osd_max_backfills"]))
+        self.backfill_engine = BackfillEngine(
+            self.repair, self.perf, store=self.store,
+            journal=self.journal)
         # completed-op cache keyed by client reqid (the osd_reqid_t dedup
         # the reference keeps in the PG log): a client resend whose first
         # attempt executed but lost the reply gets the cached result
@@ -487,6 +504,28 @@ class OSDDaemon:
             },
         }
 
+    def _backfill_stats(self) -> dict:
+        """Admin-socket ``backfill stats``: the planned-motion engine's
+        lifetime view — drains, objects, batches, preempts, cursor
+        resumes, moved bytes — plus the live reservation tables and
+        the backfill mClock class's dispatch count.  Motion is complete
+        when both reservation tables are idle and no drain is queued."""
+        from ceph_tpu.osd.backfill import BACKFILL_COUNTERS
+        return {
+            "engine": self.backfill_engine.stats(),
+            "reservations": {
+                "local": self.backfill_local.stats(),
+                "remote": self.backfill_remote.stats(),
+            },
+            "counters": {k: self.perf.value(k)
+                         for k in BACKFILL_COUNTERS},
+            "mclock": {
+                "enabled": self._use_mclock,
+                "backfill_dispatched":
+                    self.op_scheduler.stats().get("backfill", 0),
+            },
+        }
+
     def _mclock_set(self, clazz: str = "", reservation=None,
                     weight=None, limit=None) -> dict:
         """Admin-socket ``mclock set``: runtime retune of one op
@@ -639,6 +678,9 @@ class OSDDaemon:
         sock.register("ec repair stats", self._ec_repair_stats,
                       "batched repair engine state (strategy split, "
                       "read-byte savings, mClock pacing)")
+        sock.register("backfill stats", self._backfill_stats,
+                      "planned-motion engine state (drains, cursor "
+                      "resumes, reservation tables, mClock pacing)")
         sock.register("mclock set", self._mclock_set,
                       "retune one mClock class at runtime: "
                       "clazz=<name> [reservation=] [weight=] [limit=]")
@@ -1001,6 +1043,16 @@ class OSDDaemon:
                 }))
             except ConnectionError:
                 pass
+        elif t == "backfill_stats":
+            # the admin-socket `backfill stats` surface over the wire:
+            # drills and the elastic smoke poll motion-complete here
+            try:
+                conn.send_message(Message("backfill_stats_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    **self._backfill_stats(),
+                }))
+            except ConnectionError:
+                pass
         elif t == "qos_set":
             # mgr_qos fan-out: apply mClock retunes and/or the adaptive
             # hedge timeout pushed by the cluster-wide QoS controller
@@ -1016,7 +1068,7 @@ class OSDDaemon:
                 self._handle_sub_reply(msg.data)
             )
         elif t in ("pg_query", "pg_notify", "pg_activate", "log_trim",
-                   "pg_stray", "pg_purge_stray",
+                   "pg_stray", "pg_purge_stray", "pg_prune_shards",
                    "osd_ping", "osd_ping_reply") and self.cephx \
                 and not await self._sub_op_sig_ok(msg.data):
             log.derr("%s: dropping unsigned/forged %s from %s",
@@ -1032,6 +1084,10 @@ class OSDDaemon:
         elif t == "pg_purge_stray":
             asyncio.get_running_loop().create_task(
                 self._handle_pg_purge_stray(msg.data)
+            )
+        elif t == "pg_prune_shards":
+            asyncio.get_running_loop().create_task(
+                self._handle_pg_prune_shards(msg.data)
             )
         elif t == "log_trim":
             pgid = PGId(int(msg.data["pgid"][0]), int(msg.data["pgid"][1]))
@@ -1800,6 +1856,32 @@ class OSDDaemon:
             # re-peer so recovery can pull from this holder
             self._schedule_repeer(pg, pg.epoch, delay=0.0)
 
+    async def _handle_pg_prune_shards(self, d: dict) -> None:
+        """The primary reached a CLEAN interval: drop shard collections
+        for EC positions we no longer own.  Post-motion hygiene — one
+        log per OSD per PG means a stale old-position collection would
+        later present as held-with-stale-data if the map ever remaps
+        this OSD back to that position."""
+        pgid = PGId(int(d["pgid"][0]), int(d["pgid"][1]))
+        pg = self.pgs.get(pgid)
+        if pg is None or int(d.get("epoch", 0)) != pg.epoch \
+                or self.osd_id not in pg.acting:
+            return
+        owned = {int(x) for x in d.get("owned", ())}
+        tx = StoreTx()
+        for cid in list(self.store.list_collections()):
+            if cid.pool != pgid.pool or cid.pg != pgid.ps:
+                continue
+            if cid.shard < 0 or cid.shard in owned:
+                continue            # meta/replicated cids stay put
+            for oid in list(self.store.list_objects(cid)):
+                tx.remove(cid, oid)
+            tx.remove_collection(cid)
+        if len(tx):
+            await self.store.queue_transactions(tx)
+            log.dout(5, "%s: pg %s: pruned stale shard collections "
+                     "(own %s)", self.entity, pgid, sorted(owned))
+
     async def _handle_pg_purge_stray(self, d: dict) -> None:
         """The primary finished a clean interval with our data merged:
         drop the stray copy (reference PG::purge_strays)."""
@@ -1829,6 +1911,25 @@ class OSDDaemon:
         answers a retry."""
         try:
             epoch = pg.epoch
+            live = sum(1 for o in pg.acting if o != NO_OSD)
+            if pg.ec_k and live < pg.ec_k:
+                # below-k interval: the surviving members cannot decode
+                # a single stripe, and the absent appliers are DOWN,
+                # not divergent — running the log arithmetic here would
+                # count every acked entry as applied-by-fewer-than-k,
+                # rewind it, and DELETE intact shards.  Park as
+                # incomplete; the map change that restores >= k
+                # members opens a new interval and re-peers.
+                if pg.state != STATE_INCOMPLETE:
+                    self.journal.emit("pg.state", epoch=epoch,
+                                      pgid=str(pg.pgid),
+                                      state=STATE_INCOMPLETE,
+                                      prev=pg.state)
+                pg.state = STATE_INCOMPLETE
+                log.dout(1, "pg %s: %d/%d acting members up (< k=%d): "
+                         "incomplete, waiting for a fuller map",
+                         pg.pgid, live, len(pg.acting), pg.ec_k)
+                return
             pg.peer_infos = {}      # re-peer of the same interval: fresh
             if pg.backend is not None \
                     and getattr(pg.backend, "extent_cache", None):
@@ -1852,7 +1953,7 @@ class OSDDaemon:
                 if osd == self.osd_id and shard != local.shard:
                     pg.record_info(PeerInfo(
                         shard, self.osd_id, log=dict(local.log),
-                        tail=local.tail,
+                        tail=local.tail, held=local.held,
                     ))
             await self._gather(pg, epoch, lambda: pg.all_infos_in(),
                                lambda shard: shard not in pg.peer_infos,
@@ -1872,8 +1973,9 @@ class OSDDaemon:
             )
             missing = pg.compute_missing()
             flags = self.osdmap.flags if self.osdmap else set()
-            if missing.total() and ("norecover" in flags
-                                    or "nobackfill" in flags):
+            if (missing.total() or missing.backfill) \
+                    and ("norecover" in flags
+                         or "nobackfill" in flags):
                 # recovery administratively gated: the PG stays PARKED
                 # (ops queue on waiting_for_active) — activating with
                 # holes would serve ENOENT/stale data for durable,
@@ -1881,6 +1983,20 @@ class OSDDaemon:
                 log.dout(1, "pg %s: recovery gated by osdmap flags %s",
                          pg.pgid, sorted(flags))
                 self._schedule_recovery_ungate(pg, epoch)
+                return
+            if missing.backfill and not missing.total() \
+                    and "norebalance" in flags:
+                # pure remap (every object still fully redundant on the
+                # old holders; the only work is planned motion to new
+                # destinations): norebalance pauses exactly this —
+                # degraded PGs above fall through and keep recovering
+                log.dout(1, "pg %s: planned motion gated by "
+                         "norebalance", pg.pgid)
+                self.perf.inc("backfill_gated")
+                self.journal.emit("backfill.gated", epoch=epoch,
+                                  pgid=str(pg.pgid), flag="norebalance")
+                self._schedule_recovery_ungate(
+                    pg, epoch, flags=("norebalance",))
                 return
             if missing.backfill:
                 # log gaps: fall back to inventory comparison for those
@@ -1962,6 +2078,30 @@ class OSDDaemon:
                         "epoch": epoch,
                     }), priority=PRIO_HIGH))
             pg.stray_sources.clear()
+            if pg.is_ec:
+                # post-motion hygiene: members remapped to a new
+                # position still hold the OLD position's collection
+                # (it was the decode source during motion) — now that
+                # the interval is clean those copies are stale the
+                # moment the next write lands, so every acting member
+                # prunes down to the positions it owns
+                owned_by: dict[int, set[int]] = {}
+                for s, osd in enumerate(pg.acting):
+                    if osd != NO_OSD:
+                        owned_by.setdefault(osd, set()).add(s)
+                for osd, owned in owned_by.items():
+                    prune = {
+                        "pgid": [pg.pgid.pool, pg.pgid.ps],
+                        "epoch": epoch, "owned": sorted(owned),
+                    }
+                    if osd == self.osd_id:
+                        asyncio.get_running_loop().create_task(
+                            self._handle_pg_prune_shards(prune))
+                    else:
+                        self._send_osd(osd, Message(
+                            "pg_prune_shards",
+                            self._sign_peer_payload(prune),
+                            priority=PRIO_HIGH))
             self._drain_waiters(pg)
             self._kick_snaptrim(pg)
             log.dout(5, "pg %s: active (recovered %d objects)",
@@ -1969,16 +2109,19 @@ class OSDDaemon:
         except asyncio.CancelledError:
             pass
 
-    def _schedule_recovery_ungate(self, pg: PG, epoch: int) -> None:
-        """Wait out norecover/nobackfill WITHOUT re-running the whole
+    def _schedule_recovery_ungate(
+            self, pg: PG, epoch: int,
+            flags: tuple = ("norecover", "nobackfill")) -> None:
+        """Wait out a gating osdmap flag WITHOUT re-running the whole
         peer log-query exchange every tick: the flag lives in our own
-        osdmap, so poll it locally and only re-peer once it clears."""
+        osdmap, so poll it locally and only re-peer once every flag in
+        ``flags`` cleared (norecover/nobackfill park recovery;
+        norebalance parks pure planned motion)."""
         async def wait_clear():
             try:
                 while not self._stopped and pg.epoch == epoch:
-                    flags = self.osdmap.flags if self.osdmap else set()
-                    if "norecover" not in flags \
-                            and "nobackfill" not in flags:
+                    live = self.osdmap.flags if self.osdmap else set()
+                    if not any(f in live for f in flags):
                         self._schedule_repeer(pg, epoch, delay=0.0)
                         return
                     await asyncio.sleep(0.5)
@@ -2070,15 +2213,25 @@ class OSDDaemon:
         local_inv = self._inventory(pg, my_shard)
         # an object the authoritative history DELETED must not be
         # resurrected from a stale stray's copy
-        deleted = {
-            e.oid for e in latest_per_object(missing.auth_log).values()
-            if e.op == OP_DELETE
-        }
+        latest = latest_per_object(missing.auth_log)
+        deleted = {e.oid for e in latest.values()
+                   if e.op == OP_DELETE}
+        # ... and an object the authoritative history KNOWS is not
+        # stray-ONLY: log recovery / the backfill plan already move it
+        # where it belongs.  Judging membership by the primary's own
+        # collection alone would mark every object missing on EVERY
+        # shard when the primary is itself a fresh backfill
+        # destination (its collection is empty by definition) —
+        # flagging the intact positions as lost leaves decode with no
+        # sources at all.
+        known = {e.oid for e in latest.values()
+                 if e.op != OP_DELETE}
         for osd, sinfo in pg.stray_sources.items():
             sinv = (pg.peer_infos.get(sinfo.shard).objects
                     if pg.peer_infos.get(sinfo.shard) else None) or {}
             for name, ver in sinv.items():
-                if name in local_inv or name in deleted:
+                if name in local_inv or name in known \
+                        or name in deleted:
                     continue          # acting state / history wins
                 for shard, aosd in enumerate(pg.acting):
                     if aosd == NO_OSD:
@@ -2095,6 +2248,17 @@ class OSDDaemon:
         comparison against the authoritative shard (O(objects) — only
         for peers whose log no longer connects)."""
         auth_shard, _, _ = pg.authoritative_log()
+        # the inventory AUTHORITY must be a shard that actually holds
+        # data: under a position permutation the max-head log can
+        # belong to a backfill destination whose collection is empty —
+        # comparing against its (empty) inventory would plan no motion
+        # and silently activate with every object unreadable.  Prefer
+        # any acting position that is NOT itself a destination.
+        if auth_shard in missing.backfill:
+            for s, osd in enumerate(pg.acting):
+                if osd != NO_OSD and s not in missing.backfill:
+                    auth_shard = s
+                    break
         need_inv = set(missing.backfill) | {auth_shard}
         for shard in need_inv:
             # every LOCAL shard position answers synchronously (an OSD
@@ -2122,6 +2286,20 @@ class OSDDaemon:
             return
         self.perf.inc("peer_backfills")
         auth_inv = pg.peer_infos[auth_shard].objects or {}
+        if not auth_inv and auth_shard in missing.backfill:
+            # wholesale permutation: EVERY acting position is a
+            # destination, so no live collection can serve as the
+            # inventory authority.  The authoritative log still names
+            # every surviving object and its version (version attrs
+            # are written from the same entries), so synthesize the
+            # inventory from it; the old-position collections the
+            # acting members still hold are the decode sources.
+            auth_inv = {
+                e.oid: e.obj_version
+                for e in latest_per_object(missing.auth_log).values()
+                if e.op != OP_DELETE
+                and object_to_ps(e.oid, pg.pool.pg_num) == pg.pgid.ps
+            }
         for shard in missing.backfill:
             inv = pg.peer_infos[shard].objects or {}
             need = missing.by_shard.setdefault(shard, {})
@@ -2200,12 +2378,51 @@ class OSDDaemon:
             log.dout(10, "%s: log trim %s failed: %s",
                      self.entity, pgid, e)
 
+    def _held_shards(self, pool: int, ps: int) -> list[int]:
+        """EC shard collections this OSD actually holds DATA in for
+        one PG — the per-POSITION presence signal peering needs on top
+        of the per-OSD log (a member remapped to a new position has a
+        complete log but nothing stored there).  Empty collections do
+        not count: early-epoch intervals create collections before any
+        client write, and an empty position with a non-empty
+        authoritative history is precisely a backfill destination."""
+        held = []
+        for c in self.store.list_collections():
+            if c.pool != pool or c.pg != ps or c.shard < 0:
+                continue
+            try:
+                if self.store.list_objects(c):
+                    held.append(c.shard)
+            except KeyError:
+                continue
+        return sorted(set(held))
+
+    def _read_full_local(self, cid: CollectionId, name: str) -> dict:
+        """The read_full sub-op served against our own store (the
+        messenger only dials peers): decode sources may include OLD
+        shard collections the primary itself still holds."""
+        obj = (GHObject(cid.pool, name, shard=cid.shard)
+               if cid.shard >= 0 else GHObject(cid.pool, name))
+        return {
+            "data": self.store.read(cid, obj),
+            "attrs": dict(self.store.getattrs(cid, obj)),
+            "omap": dict(self.store.omap_get(cid, obj)),
+            "clones": {},
+        }
+
     def _local_info(self, pg: PG) -> PeerInfo:
         shard = (pg.acting.index(self.osd_id)
                  if self.osd_id in pg.acting else NO_OSD)
         entries, tail = pg_log.read_log(self.store, pg.pgid.pool,
                                         pg.pgid.ps)
-        return PeerInfo(shard, self.osd_id, log=entries, tail=tail)
+        # held is an EC-only signal (shard collections do not exist
+        # for replicated PGs) and costs a store collection scan —
+        # computing it for every replicated PG would stall the event
+        # loop during a revive's re-peer storm
+        return PeerInfo(shard, self.osd_id, log=entries, tail=tail,
+                        held=(self._held_shards(pg.pgid.pool,
+                                                pg.pgid.ps)
+                              if pg.is_ec else None))
 
     def _inventory(self, pg: PG, shard: int) -> dict[str, int]:
         """name -> version for our shard of this PG (the MOSDPGNotify
@@ -2709,14 +2926,34 @@ class OSDDaemon:
                     and hit[2] == pg.state:
                 out.append(hit[1])
                 continue
-            missing = pg.missing.total() if pg.missing else 0
+            # degraded vs misplaced (the reference's distinction):
+            # a log-derived hole means redundancy is LOST (degraded);
+            # a backfill-shard hole means every object is still fully
+            # redundant on the old holders and only its planned
+            # destination lacks it (misplaced).  A drain/expansion
+            # storm must show zero degraded throughout.
+            missing = 0
+            misplaced = 0
+            if pg.missing:
+                bf = set(pg.missing.backfill)
+                for shard, need in pg.missing.by_shard.items():
+                    if shard in bf:
+                        misplaced += len(need)
+                    else:
+                        missing += len(need)
+                if not pg.missing.by_shard and pg.missing.backfill:
+                    # pre-plan interval: inventory not compared yet,
+                    # but the remap already promises motion
+                    misplaced = 1
             valid_acting = [o for o in pg.acting if o != NO_OSD]
             state = pg.state
             if state == STATE_ACTIVE:
-                state = "active+clean" if not missing \
-                    else "active+degraded"
+                state = "active+clean" if not (missing or misplaced) \
+                    else ("active+degraded" if missing
+                          else "active+misplaced")
             elif state == STATE_RECOVERING:
-                state = "active+recovering+degraded"
+                state = ("active+recovering+degraded" if missing
+                         else "active+recovering+misplaced")
             if len(valid_acting) < pg.pool.size:
                 state += "+undersized"
             num_objects = 0
@@ -2749,6 +2986,7 @@ class OSDDaemon:
                 "num_objects": num_objects,
                 "num_bytes": num_bytes,
                 "degraded": missing,
+                "misplaced": misplaced,
                 "acting": list(pg.acting),
                 "up": list(pg.up),
             }
@@ -3282,6 +3520,13 @@ class OSDDaemon:
             payload["log"] = {str(s): e.to_wire()
                               for s, e in entries.items()}
             payload["tail"] = tail
+            pool = (self.osdmap.pools.get(pgid.pool)
+                    if self.osdmap else None)
+            if (pg.is_ec if pg is not None
+                    else bool(pool and pool.pool_type == "erasure")):
+                # EC-only signal; the collection scan is wasted work
+                # (and event-loop latency) for replicated PGs
+                payload["held"] = self._held_shards(pgid.pool, pgid.ps)
         conn.send_message(Message("pg_notify",
                                   self._sign_peer_payload(payload),
                                   priority=PRIO_HIGH))
@@ -3304,6 +3549,8 @@ class OSDDaemon:
             log={int(s): LogEntry.from_wire(w)
                  for s, w in d.get("log", {}).items()},
             tail=int(d.get("tail", 0)),
+            held=([int(x) for x in d["held"]]
+                  if "held" in d else None),
         ))
 
     def _handle_pg_activate(self, d: dict) -> None:
@@ -3384,6 +3631,23 @@ class OSDDaemon:
                 srcs = stray_pos.setdefault(int(pos), [])
                 if sosd not in srcs:
                     srcs.append(sosd)
+        # acting members remapped to a NEW position still hold their
+        # old-position collections (one store, many shard cids): they
+        # are first-class decode sources too.  Without them a position
+        # permutation has k intact copies on disk but zero readable
+        # through the acting view — the stray machinery only covers
+        # osds that LEFT the set.
+        for info in pg.peer_infos.values():
+            if info.shard <= PG.STRAY_SHARD_BASE:
+                continue                 # strays announced above
+            for pos in (info.held or ()):
+                pos = int(pos)
+                if not (0 <= pos < len(pg.acting)) \
+                        or pg.acting[pos] == info.osd:
+                    continue             # acting read path serves it
+                srcs = stray_pos.setdefault(pos, [])
+                if info.osd not in srcs:
+                    srcs.append(info.osd)
 
         async def stray_read(pos: int, name: str, version: int,
                              shard_len: int):
@@ -3401,10 +3665,13 @@ class OSDDaemon:
             last = f"shard {pos}: no stray source"
             for sosd in stray_pos.get(int(pos), ()):
                 try:
-                    full = await self.send_sub_op(
-                        sosd, "read_full", cid=_enc_cid(scid),
-                        oid=name,
-                    )
+                    if sosd == self.osd_id:
+                        full = self._read_full_local(scid, name)
+                    else:
+                        full = await self.send_sub_op(
+                            sosd, "read_full", cid=_enc_cid(scid),
+                            oid=name,
+                        )
                 except (KeyError, IOError, ConnectionError) as e:
                     last = f"shard {pos}: stray osd.{sosd}: {e!r}"
                     continue
@@ -3431,30 +3698,37 @@ class OSDDaemon:
             raise ShardReadError(last)
 
         async def stray_shard_copy(name: str,
-                                   shards: list[int]) -> bool:
+                                   shards: list[int]) -> int:
             """Whole-shard copy from former holders (wholesale remap:
-            nothing among the acting set can reconstruct)."""
+            nothing among the acting set can reconstruct).  Returns
+            the bytes copied (0 = failure) so motion accounting can
+            reconcile against placement predictions."""
             if not all(t in stray_pos for t in shards):
                 log.derr("pg %s: stray copy %s: positions %s not "
                          "all announced (%s)", pg.pgid, name, shards,
                          stray_pos)
-                return False
+                return 0
+            copied = 0
             for t in shards:
                 scid = CollectionId(pg.pgid.pool, pg.pgid.ps, t)
                 full = None
                 for sosd in stray_pos[t]:
                     try:
-                        full = await self.send_sub_op(
-                            sosd, "read_full",
-                            cid=_enc_cid(scid), oid=name,
-                        )
+                        if sosd == self.osd_id:
+                            full = self._read_full_local(scid, name)
+                        else:
+                            full = await self.send_sub_op(
+                                sosd, "read_full",
+                                cid=_enc_cid(scid), oid=name,
+                            )
                         break
                     except (KeyError, IOError) as e:
                         log.derr("pg %s: stray copy %s shard %d from "
                                  "osd.%d failed: %r", pg.pgid, name,
                                  t, sosd, e)
                 if full is None:
-                    return False
+                    return 0
+                copied += len(full["data"])
                 obj = GHObject(pg.pgid.pool, name, shard=t)
                 tx = StoreTx()
                 tx.remove(scid, obj).write(scid, obj, 0, full["data"])
@@ -3470,27 +3744,34 @@ class OSDDaemon:
                                            cid=_enc_cid(scid),
                                            ops=encode_tx(tx))
             self.perf.inc("recovery_ops")
-            return True
+            return copied
 
-        async def recover_one(name: str, shards: list[int]) -> bool:
+        async def recover_one(name: str, shards: list[int],
+                              clazz: str = "recovery") -> bool:
             async with sem:
                 if self._use_mclock:
-                    await self.op_scheduler.acquire("recovery")
+                    await self.op_scheduler.acquire(clazz)
                 try:
                     # the log entry names the version to converge to —
                     # a rewound object's stale shards still advertise
                     # the dropped (higher) version in their attrs, so
                     # the internal max-version guess would be wrong
-                    await pg.backend.recover_shard(
+                    nbytes = await pg.backend.recover_shard(
                         name, shards,
                         version=target_version.get(name) or None,
                         stray_read=stray_read if stray_pos else None,
                         stray_positions=sorted(stray_pos),
                     )
                     self.perf.inc("recovery_ops")
+                    if clazz == "backfill" and nbytes:
+                        self.perf.inc("backfill_bytes", int(nbytes))
                     return True
                 except (ShardReadError, IOError, KeyError) as e:
-                    if await stray_shard_copy(name, shards):
+                    copied = await stray_shard_copy(name, shards)
+                    if copied:
+                        if clazz == "backfill":
+                            self.perf.inc("backfill_bytes",
+                                          int(copied))
                         return True
                     log.derr("pg %s: recover %s failed: %s",
                              pg.pgid, name, e)
@@ -3508,6 +3789,24 @@ class OSDDaemon:
                              pg.pgid, name, shard, e)
                     return False
 
+        # planned motion vs failure repair: an object whose needed
+        # shards are ALL backfill destinations (inventory holes on
+        # remapped/new members — the data itself is still fully
+        # redundant on the old holders) moves as the mClock "backfill"
+        # class under a reservation and a resumable cursor.  Anything
+        # touched by a log-derived hole is degraded data and repairs
+        # as "recovery"; a mixed object decodes once on the recovery
+        # side rather than twice.
+        bf_shards = set(missing.backfill)
+        rebuild_bf = {
+            n: shards for n, shards in rebuild.items()
+            if bf_shards and all(s in bf_shards for s in shards)
+        }
+        rebuild_rec = {n: s for n, s in rebuild.items()
+                       if n not in rebuild_bf}
+        use_engine = bool(self.conf["osd_ec_repair_batch"]) \
+            and hasattr(pg.backend, "recover_batch")
+
         # batched repair engine first: objects sharing a failure
         # pattern drain through shared decode launches (grouped by
         # codec signature + lost-shard set, strategy-planned, paced by
@@ -3516,11 +3815,10 @@ class OSDDaemon:
         # singleton groups — falls through to the classic per-object
         # path below, which retries and mixes stray reads.
         engine_done: set[str] = set()
-        if rebuild and self.conf["osd_ec_repair_batch"] \
-                and hasattr(pg.backend, "recover_batch"):
+        if rebuild_rec and use_engine:
             try:
                 engine_done = await self.repair.drain(
-                    pg.backend, rebuild, target_version)
+                    pg.backend, rebuild_rec, target_version)
             except Exception as e:       # noqa: BLE001
                 log.derr("pg %s: batched repair drain failed: %r "
                          "(falling back to per-object recovery)",
@@ -3530,13 +3828,123 @@ class OSDDaemon:
                 self.perf.inc("recovery_ops", len(engine_done))
                 log.dout(10, "pg %s: repair engine rebuilt %d/%d "
                          "objects in batches", pg.pgid,
-                         len(engine_done), len(rebuild))
+                         len(engine_done), len(rebuild_rec))
+        bf_failures = 0
+        if rebuild_bf:
+            bf_failures = await self._backfill_motion(
+                pg, bf_shards, rebuild_bf, target_version,
+                use_engine, recover_one)
         outcomes = await asyncio.gather(
-            *(recover_one(n, s) for n, s in rebuild.items()
+            *(recover_one(n, s) for n, s in rebuild_rec.items()
               if n not in engine_done),
             *(remove_one(s, n) for s, n in removals),
         )
-        return sum(1 for ok in outcomes if not ok)
+        return bf_failures + sum(1 for ok in outcomes if not ok)
+
+    async def _backfill_motion(self, pg: PG, bf_shards: set[int],
+                               rebuild_bf: dict[str, list[int]],
+                               target_version: dict[str, int],
+                               use_engine: bool,
+                               recover_one) -> int:
+        """Reservation-gated planned motion for one PG.
+
+        The primary holds a LOCAL backfill slot plus a REMOTE slot on
+        every backfill-target OSD before any object moves (Ceph's
+        local_reserver/remote_reserver split: the pools are separate so
+        two mutually-backfilling primaries cannot hold-and-wait each
+        other into a deadlock — local slots queue, remote slots are
+        try-and-retry).  Motion then drains through the BackfillEngine:
+        batched coalesced launches, the mClock "backfill" class, and a
+        persisted per-PG cursor so preempted motion resumes without
+        re-moving objects.  Returns the number of objects NOT moved
+        (preemption counts every remaining object as a failure so the
+        caller activates degraded and the next peering round replans
+        against the new map)."""
+        from ceph_tpu.osd.backfill import BackfillPreempted
+
+        epoch = pg.epoch
+        key = str(pg.pgid)
+        targets = sorted({
+            pg.acting[s] for s in bf_shards
+            if 0 <= s < len(pg.acting)
+            and pg.acting[s] not in (NO_OSD, self.osd_id)
+        })
+        waited = await self.backfill_local.reserve(key, epoch)
+        if waited:
+            self.perf.inc("backfill_reserve_waits")
+        granted: list[int] = []
+        try:
+            if pg.epoch != epoch or self._stopped:
+                return len(rebuild_bf)
+            for osd in targets:
+                while True:
+                    if pg.epoch != epoch or self._stopped:
+                        return len(rebuild_bf)
+                    try:
+                        rep = await self.send_sub_op(
+                            osd, "backfill_reserve",
+                            key=key, iepoch=epoch)
+                        if rep and rep.get("granted"):
+                            granted.append(osd)
+                            break
+                    except (ShardReadError, IOError, KeyError,
+                            ConnectionError):
+                        pass
+                    self.perf.inc("backfill_reserve_waits")
+                    await asyncio.sleep(0.2)
+            self.journal.emit("backfill.reserve", epoch=epoch,
+                              pgid=key, targets=targets,
+                              objects=len(rebuild_bf),
+                              queued=bool(waited))
+            done: set[str] = set()
+            if use_engine:
+                try:
+                    done = await self.backfill_engine.drain_pg(
+                        pg.backend, rebuild_bf,
+                        pool=pg.pgid.pool, ps=pg.pgid.ps,
+                        epoch=epoch, versions=target_version,
+                        current_epoch=lambda: pg.epoch,
+                        gate=lambda: self.osdmap is not None
+                        and "norebalance" in self.osdmap.flags,
+                    )
+                except BackfillPreempted:
+                    return len(rebuild_bf)
+                except Exception as e:       # noqa: BLE001
+                    log.derr("pg %s: backfill drain failed: %r "
+                             "(falling back to per-object motion)",
+                             pg.pgid, e)
+            if done:
+                self.perf.inc("recovery_ops", len(done))
+            left = [n for n in rebuild_bf if n not in done]
+            if not left:
+                return 0
+            outcomes = await asyncio.gather(
+                *(recover_one(n, rebuild_bf[n], clazz="backfill")
+                  for n in left))
+            failures = sum(1 for ok in outcomes if not ok)
+            moved = len(left) - failures
+            if moved:
+                # per-object fallback motion still counts as backfill
+                self.perf.inc("backfill_objects", moved)
+            return failures
+        finally:
+            self.backfill_local.release(key)
+            for osd in granted:
+                task = asyncio.get_running_loop().create_task(
+                    self._backfill_release_remote(osd, key))
+                self._ungate_tasks.add(task)
+                task.add_done_callback(self._ungate_tasks.discard)
+
+    async def _backfill_release_remote(self, osd: int,
+                                       key: str) -> None:
+        try:
+            await self.send_sub_op(osd, "backfill_release",
+                                   key=key, iepoch=0)
+        except (ShardReadError, IOError, KeyError, ConnectionError,
+                asyncio.CancelledError):
+            # the holder side also preempts stale reservations on a
+            # newer-epoch reserve, so a lost release self-heals
+            pass
 
     async def _recover_replicated(self, pg: PG, missing: MissingSet,
                                   sem: asyncio.Semaphore) -> int:
@@ -4709,6 +5117,15 @@ class OSDDaemon:
                 await self.store.queue_transactions(
                     decode_tx(list(d["ops"]))
                 )
+            elif kind == "backfill_reserve":
+                # remote backfill reservation: the requesting primary
+                # is about to push shards into this daemon — grant a
+                # remote slot or tell it to wait (it retries; queueing
+                # here would pin a wire round-trip for minutes)
+                value = {"granted": self.backfill_remote.try_reserve(
+                    str(d["key"]), int(d.get("iepoch", 0)))}
+            elif kind == "backfill_release":
+                self.backfill_remote.release(str(d["key"]))
             else:
                 cid = _dec_cid(d["cid"])
                 oid = GHObject(cid.pool, str(d.get("oid", "")),

@@ -2123,7 +2123,7 @@ class ECBackend:
     async def recover_shard(self, oid: str, lost: Sequence[int],
                             version: int | None = None,
                             stray_read=None,
-                            stray_positions: Sequence[int] = ()) -> None:
+                            stray_positions: Sequence[int] = ()) -> int:
         async with self._track_op():
             return await self._recover_shard_impl(
                 oid, lost, version=version, stray_read=stray_read,
@@ -2133,7 +2133,7 @@ class ECBackend:
     async def _recover_shard_impl(
             self, oid: str, lost: Sequence[int],
             version: int | None = None, stray_read=None,
-            stray_positions: Sequence[int] = ()) -> None:
+            stray_positions: Sequence[int] = ()) -> int:
         """Rebuild lost shard objects from survivors (RecoveryOp).
         Source shards are version-verified so a stale survivor (missed
         degraded write) counts as lost, not as a rebuild source.
@@ -2272,6 +2272,11 @@ class ECBackend:
             # but dropping is unconditionally safe)
             for s in lost:
                 self.resident.drop(self.resident_ns, oid, s)
+        # bytes actually written (lost may have GROWN on source-read
+        # failures): the caller's motion accounting must reconcile
+        # against placement predictions, so guessing from the request
+        # is not good enough
+        return shard_len * len(lost)
 
     # -- batched recovery (the repair engine's data path) -----------------
     async def recover_batch(self, names: Sequence[str],
@@ -2320,14 +2325,16 @@ class ECBackend:
             ).append(name)
         recovered: list[str] = []
         batches = 0
+        rebuilt_bytes = 0
         for shard_len, group in sorted(by_len.items()):
             done = await self._repair_group(
                 group, lost, plan, shard_len, metas)
             recovered.extend(done)
             if done:
                 batches += 1
+                rebuilt_bytes += shard_len * len(lost) * len(done)
         return {"recovered": recovered, "strategy": plan.strategy,
-                "batches": batches}
+                "batches": batches, "bytes": rebuilt_bytes}
 
     async def _repair_group(self, group: list, lost: list,
                             plan: RepairPlan, shard_len: int,

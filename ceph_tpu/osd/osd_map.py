@@ -124,6 +124,9 @@ class Incremental:
     new_up: dict[int, str] = field(default_factory=dict)       # osd -> addr
     new_down: list[int] = field(default_factory=list)
     new_weights: dict[int, int] = field(default_factory=dict)  # 16.16
+    # OSDs purged from the map (``osd purge`` after a drain); the
+    # same epoch carries the CRUSH dump without their device items
+    removed_osds: list[int] = field(default_factory=list)
     new_pools: list[PoolInfo] = field(default_factory=list)
     removed_pools: list[int] = field(default_factory=list)
     new_pg_temp: dict[tuple[int, int], list[int]] = field(default_factory=dict)
@@ -150,6 +153,7 @@ class Incremental:
             "new_up": {str(o): a for o, a in self.new_up.items()},
             "new_down": list(self.new_down),
             "new_weights": {str(o): w for o, w in self.new_weights.items()},
+            "removed_osds": list(self.removed_osds),
             "new_pools": [p.to_dict() for p in self.new_pools],
             "removed_pools": list(self.removed_pools),
             "new_pg_temp": {
@@ -194,6 +198,7 @@ class Incremental:
                 PoolInfo.from_dict(p) for p in d.get("new_pools", ())
             ],
             removed_pools=[int(p) for p in d.get("removed_pools", ())],
+            removed_osds=[int(o) for o in d.get("removed_osds", ())],
             new_pg_temp={
                 cls._pgid(s): [int(o) for o in v]
                 for s, v in d.get("new_pg_temp", {}).items()
@@ -259,6 +264,8 @@ class OSDMap:
             info = self.osds.setdefault(osd, OSDInfo())
             info.weight = w
             info.in_cluster = w > 0
+        for osd in inc.removed_osds:
+            self.osds.pop(osd, None)
         for pool in inc.new_pools:
             self.pools[pool.pool_id] = pool
             self.max_pool_id = max(self.max_pool_id, pool.pool_id)

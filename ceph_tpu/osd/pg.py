@@ -83,6 +83,7 @@ STATE_PEERING = "peering"
 STATE_ACTIVE = "active"
 STATE_RECOVERING = "active+recovering"
 STATE_REPLICA = "replica"
+STATE_INCOMPLETE = "incomplete"
 
 
 @dataclass
@@ -95,6 +96,12 @@ class PeerInfo:
     log: dict[int, LogEntry] = field(default_factory=dict)
     tail: int = 0
     objects: dict[str, int] | None = None   # name -> version (backfill)
+    # EC shard collections the OSD actually HOLDS for this PG (None =
+    # pre-upgrade peer that did not report).  One log per OSD per PG
+    # means a member remapped to a different position presents a
+    # complete log for a position it never stored — only collection
+    # presence tells planned motion apart from an applied history.
+    held: list[int] | None = None
 
     @property
     def head(self) -> tuple[int, int]:
@@ -401,6 +408,25 @@ class PG:
             if info.head[1] < auth_tail:
                 # log gap: entries this peer missed were trimmed away —
                 # only a full inventory comparison can find its holes
+                ms.backfill.add(shard)
+                continue
+            if info.head == (0, 0) and not info.log and auth_latest:
+                # brand-new member (remapped in with no history at
+                # all): this is PLANNED MOTION, not failure repair —
+                # inventory comparison (the backfill path) moves the
+                # data, paced and reserved as the backfill class,
+                # instead of replaying the entire authoritative log
+                # entry by entry as if redundancy had been lost
+                ms.backfill.add(shard)
+                continue
+            if self.ec_k and info.held is not None \
+                    and shard not in info.held and auth_latest:
+                # position permutation: the OSD stayed in the acting
+                # set but at a DIFFERENT EC position.  Its (per-OSD)
+                # log claims every entry applied, yet the collection
+                # for the new position was never written — the shard
+                # is a backfill destination, and the data still sits
+                # fully redundant in the old-position collections.
                 ms.backfill.add(shard)
                 continue
             need: dict[str, LogEntry] = {}

@@ -245,10 +245,20 @@ class RepairScheduler:
         }
 
     async def drain(self, backend, rebuild: dict,
-                    versions: dict | None = None) -> set[str]:
+                    versions: dict | None = None, *,
+                    clazz: str = "recovery",
+                    stats: dict | None = None) -> set[str]:
         """Drain ``rebuild`` (oid -> lost shards) through batched
         launches; returns the set of object names rebuilt.  Names not
-        returned were demoted and still need the per-object path."""
+        returned were demoted and still need the per-object path.
+
+        ``clazz`` selects the mClock pacing class — failure repair
+        drains as ``recovery``, the backfill engine reuses this exact
+        machinery as ``backfill`` (planned motion, own AIMD position).
+        When ``stats`` is given, per-call totals accumulate into it
+        ({"batches", "objects", "bytes"}) so the caller can attribute
+        its own share without racing other concurrent drains on the
+        daemon-wide perf counters."""
         versions = versions or {}
         groups: dict[tuple[int, ...], list[str]] = {}
         for name, shards in rebuild.items():
@@ -267,7 +277,7 @@ class RepairScheduler:
                 # per-object loop it replaces
                 if self.use_mclock and self.op_scheduler is not None:
                     await self.op_scheduler.acquire(
-                        "recovery", cost=len(chunk))
+                        clazz, cost=len(chunk))
                 try:
                     res = await backend.recover_batch(
                         chunk, list(lost_t), versions)
@@ -283,6 +293,12 @@ class RepairScheduler:
                 self.batches += int(res.get("batches", 0))
                 self.objects += len(done)
                 self.demoted += demoted
+                if stats is not None:
+                    stats["batches"] = (stats.get("batches", 0)
+                                        + int(res.get("batches", 0)))
+                    stats["objects"] = stats.get("objects", 0) + len(done)
+                    stats["bytes"] = (stats.get("bytes", 0)
+                                      + int(res.get("bytes", 0)))
                 if demoted:
                     self.perf.inc("ec_repair_demoted", demoted)
                 strat = res.get("strategy")
@@ -294,7 +310,7 @@ class RepairScheduler:
                     self.journal.emit(
                         "repair.batch_drain", strategy=strat or "?",
                         objects=len(done), demoted=demoted,
-                        lost=list(lost_t))
+                        lost=list(lost_t), clazz=clazz)
                 # let client ops interleave between batches even when
                 # mClock pacing is off
                 await asyncio.sleep(0)

@@ -3,8 +3,9 @@ classes.
 
 The role of reference src/osd/scheduler/mClockScheduler.{h,cc} (dmClock,
 src/dmclock submodule) in asyncio form: every op class (client,
-recovery, scrub — the reference's client / background_recovery /
-background_best_effort) gets a reservation R (guaranteed ops/s), a
+recovery, backfill, scrub — the reference's client /
+background_recovery / background_best_effort) gets a reservation R
+(guaranteed ops/s), a
 weight W (share of spare capacity), and a limit L (ops/s cap). Each
 submission is stamped with dmClock tags:
 
@@ -49,6 +50,7 @@ DEFAULT_PROFILES = {
     # like tuning osd_mclock_* in the reference.
     "client": ClassProfile(reservation=100.0, weight=10.0, limit=0.0),
     "recovery": ClassProfile(reservation=10.0, weight=1.0, limit=0.0),
+    "backfill": ClassProfile(reservation=5.0, weight=1.0, limit=0.0),
     "scrub": ClassProfile(reservation=5.0, weight=1.0, limit=0.0),
 }
 

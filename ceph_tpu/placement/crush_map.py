@@ -153,6 +153,28 @@ class CrushMap:
             parent.weights[idx] = child.weight
             child = parent
 
+    def remove_item(self, item_id: int) -> bool:
+        """Remove a device from whichever bucket holds it
+        (CrushWrapper::remove_item role, the ``osd purge`` CRUSH half).
+        The emptied host bucket stays — removing a drained OSD must
+        not reshuffle sibling hosts' straw draws.  Returns False when
+        the device is in no bucket."""
+        if item_id < 0:
+            raise ValueError("remove_item removes devices, not buckets")
+        found = False
+        for b in self.buckets.values():
+            if b.id in self._shadow_ids or item_id not in b.items:
+                continue
+            idx = b.items.index(item_id)
+            b.items.pop(idx)
+            b.weights.pop(idx)
+            self._propagate_weight(b)
+            found = True
+        if found:
+            self.class_map.pop(item_id, None)
+            self._topo_gen += 1
+        return found
+
     # -- device classes (CrushWrapper.h:68,458 class-shadow trees) --------
     def set_item_class(self, device_id: int, class_name: str) -> None:
         """Assign a device class (``osd crush set-device-class``,

@@ -451,7 +451,7 @@ class Mgr:
         per_osd = await self.collect_pg_stats()
         pgs_by_state: dict[str, int] = {}
         pools: dict[int, dict] = {}
-        num_objects = num_bytes = degraded = 0
+        num_objects = num_bytes = degraded = misplaced = 0
         pool_names = {}
         osd_df: dict[int, dict] = {}
         osdmap = self.monc.osdmap
@@ -472,16 +472,18 @@ class Mgr:
                 num_objects += int(st.get("num_objects", 0))
                 num_bytes += int(st.get("num_bytes", 0))
                 degraded += int(st.get("degraded", 0))
+                misplaced += int(st.get("misplaced", 0))
                 pid = int(st.get("pool", 0))
                 p = pools.setdefault(pid, {
                     "name": pool_names.get(pid, str(pid)),
                     "num_pgs": 0, "num_objects": 0, "num_bytes": 0,
-                    "degraded": 0,
+                    "degraded": 0, "misplaced": 0,
                 })
                 p["num_pgs"] += 1
                 p["num_objects"] += int(st.get("num_objects", 0))
                 p["num_bytes"] += int(st.get("num_bytes", 0))
                 p["degraded"] += int(st.get("degraded", 0))
+                p["misplaced"] += int(st.get("misplaced", 0))
             osd_df[osd] = {"bytes_used": osd_bytes}
         return {
             "pgs_by_state": pgs_by_state,
@@ -489,6 +491,7 @@ class Mgr:
             "num_objects": num_objects,
             "num_bytes": num_bytes,
             "degraded_objects": degraded,
+            "misplaced_objects": misplaced,
             "pools": pools,
             "osd_df": osd_df,
         }

@@ -67,21 +67,24 @@ class QoSMonitor(MgrModule):
               (osdmap.osds.items() if osdmap else ())
               if info.up}
 
-        rec = out["recovery"]
-        if rec["changed"]:
-            jr.emit("qos.retune", actuator="mclock", clazz="recovery",
-                    limit=round(rec["limit"], 3),
-                    reservation=round(rec["reservation"], 3),
-                    floor=round(rec["floor"], 3),
+        for clazz in ("recovery", "backfill"):
+            dec = out.get(clazz)
+            if not dec or not dec["changed"]:
+                continue
+            jr.emit("qos.retune", actuator="mclock", clazz=clazz,
+                    limit=round(dec["limit"], 3),
+                    reservation=round(dec["reservation"], 3),
+                    floor=round(dec["floor"], 3),
                     burn=round(out["burn"], 3),
                     burning=out["burning"])
             for osd in up:
-                payloads.setdefault(osd, {})["mclock"] = {
-                    "recovery": {
-                        "reservation": rec["reservation"],
-                        "limit": rec["limit"],
-                    }}
-            self._pushed_limit = rec["limit"]
+                payloads.setdefault(
+                    osd, {}).setdefault("mclock", {})[clazz] = {
+                        "reservation": dec["reservation"],
+                        "limit": dec["limit"],
+                    }
+            if clazz == "recovery":
+                self._pushed_limit = dec["limit"]
 
         for daemon, timeout in sorted(out["hedge"].items()):
             # daemons are keyed "osd.N" by SLOMonitor's snapshot feed
@@ -151,6 +154,15 @@ class QoSMonitor(MgrModule):
                         "slo_rebuild_floor_gibs and the share/ops "
                         "floors)",
                 "samples": [("", float(st["recovery_floor"]))]},
+            "ceph_qos_backfill_limit": {
+                "help": "controller-set backfill-class mClock limit "
+                        "ops/s (planned-motion AIMD position)",
+                "samples": [("", float(st["backfill_limit"]))]},
+            "ceph_qos_backfill_floor": {
+                "help": "backfill pacing floor ops/s (share/ops "
+                        "floors; planned motion has no rebuild-GiB "
+                        "term)",
+                "samples": [("", float(st["backfill_floor"]))]},
             "ceph_qos_retunes": {
                 "help": "cumulative mClock retune decisions",
                 "samples": [("", float(st["retunes"]))]},

@@ -8,10 +8,14 @@ tools driving a DevCluster.
 from ceph_tpu.testing.chaos import (
     ChaosHarness,
     run_chaos,
+    run_drain_drill,
+    run_expansion_drill,
     run_host_failure_drill,
+    run_rolling_restart_drill,
 )
 from ceph_tpu.testing.rados_model import RadosModel
 from ceph_tpu.testing.thrasher import Thrasher
 
 __all__ = ["ChaosHarness", "RadosModel", "Thrasher", "run_chaos",
-           "run_host_failure_drill"]
+           "run_drain_drill", "run_expansion_drill",
+           "run_host_failure_drill", "run_rolling_restart_drill"]

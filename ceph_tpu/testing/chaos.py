@@ -19,6 +19,7 @@ tests compare ``result["schedule"]`` across runs.
 
 from __future__ import annotations
 
+import asyncio
 import random
 
 from ceph_tpu.common import events
@@ -39,7 +40,7 @@ FAILPOINT_MENU: list[tuple[str, str, dict]] = [
 class ChaosHarness:
     def __init__(self, seed: int = 0, n_osds: int = 4, n_batches: int = 10,
                  batch: int = 8, pool_size: int = 3, min_size: int = 2,
-                 ec: bool = False):
+                 ec: bool = False, elastic: bool = False):
         self.seed = seed
         self.n_osds = n_osds
         self.n_batches = n_batches
@@ -51,6 +52,12 @@ class ChaosHarness:
         # cross-op coalescing on by default, concurrent model ops share
         # device launches under kill/revive/failpoint churn
         self.ec = ec
+        # elastic=True widens the plan menu with topology events:
+        # add_host boots a brand-new OSD on a brand-new CRUSH host
+        # (planned motion starts mid-op-stream), drain_host marks a
+        # previously-added host's OSDs out again — so the backfill
+        # engine thrashes under the same kill/revive/failpoint churn
+        self.elastic = elastic
         self.schedule: list[tuple] = []       # recorded (step, event, arg)
 
     def plan(self) -> list[tuple]:
@@ -59,6 +66,23 @@ class ChaosHarness:
         plan = []
         for b in range(self.n_batches):
             r = rng.random()
+            if self.elastic:
+                if r < 0.15:
+                    plan.append((b, "kill", None))
+                elif r < 0.30:
+                    plan.append((b, "revive", None))
+                elif r < 0.45:
+                    plan.append((b, "fp_set",
+                                 rng.randrange(len(FAILPOINT_MENU))))
+                elif r < 0.55:
+                    plan.append((b, "fp_clear", None))
+                elif r < 0.70:
+                    plan.append((b, "add_host", None))
+                elif r < 0.85:
+                    plan.append((b, "drain_host", None))
+                else:
+                    plan.append((b, "calm", None))
+                continue
             if r < 0.20:
                 plan.append((b, "kill", None))
             elif r < 0.40:
@@ -111,6 +135,9 @@ class ChaosHarness:
                            max_size=1 << 14, ec=self.ec)
         thrasher = Thrasher(cluster, min_live=self.n_osds - 1,
                             seed=self.seed)
+        added_hosts: list[str] = []        # growable, drainable
+        drained: set[str] = set()
+        elastic_rng = random.Random(f"chaos-elastic:{self.seed}")
         try:
             await model.run(self.batch)       # seed some state quietly
             events.emit_proc("chaos.start", seed=self.seed,
@@ -142,6 +169,33 @@ class ChaosHarness:
                     fp.set_seed(self.seed)
                     self.schedule.append((step, "fp_clear", None))
                     events.emit_proc("chaos.fp_clear", step=step)
+                elif event == "add_host":
+                    host = f"chaos-host{len(added_hosts)}"
+                    osd_id = await cluster.add_osd(host=host)
+                    added_hosts.append(host)
+                    # growth must not widen the kill budget: the model
+                    # stream assumes at most ONE osd dead at a time
+                    # (k=2 m=1 tolerates a single loss), so min_live
+                    # tracks the cluster size
+                    thrasher.min_live += 1
+                    self.schedule.append((step, "add_host", osd_id))
+                    events.emit_proc("chaos.add_host", step=step,
+                                     host=host, osd=osd_id)
+                elif event == "drain_host":
+                    # only added hosts drain: emptying a seed host
+                    # under concurrent kills could drop an EC pool
+                    # below k live members
+                    pool = sorted(set(added_hosts) - drained)
+                    host = (elastic_rng.choice(pool) if pool else None)
+                    if host is not None:
+                        drained.add(host)
+                        ids = cluster.osds_on_host(host)
+                        r = await rados.mon_command("osd out", ids=ids)
+                        if r["rc"] != 0:
+                            raise RuntimeError(f"osd out: {r}")
+                    self.schedule.append((step, "drain_host", host))
+                    events.emit_proc("chaos.drain_host", step=step,
+                                     host=host or "")
                 else:
                     self.schedule.append((step, "calm", None))
                     events.emit_proc("chaos.calm", step=step)
@@ -151,7 +205,9 @@ class ChaosHarness:
             while thrasher.dead:
                 if await thrasher.revive_oldest() is None:
                     break
-        await cluster.wait_health_ok(timeout=30)
+        # elastic runs end with planned motion still draining: give the
+        # engine time to finish before the final verify
+        await cluster.wait_health_ok(timeout=60 if self.elastic else 30)
         verified = await model.verify_all()
         events.emit_proc("chaos.done", seed=self.seed, verified=verified)
         # attach a forensic bundle to the drill verdict while the
@@ -315,6 +371,477 @@ async def run_host_failure_drill(seed: int = 0, hosts: int = 4,
                                         entry["worst_daemon"]}
             except (ConnectionError, TimeoutError):
                 pass
+        return out
+    finally:
+        await rados.shutdown()
+        await cluster.stop()
+
+
+# -- elasticity drills ------------------------------------------------------
+# Seeded storms that grade the backfill engine: expansion, drain-then-
+# remove, and rolling restart.  Each returns an SLO verdict plus a
+# forensics bundle captured while the cluster is still up.
+
+def _summed(cluster, key: str) -> float:
+    return float(sum(osd.perf.dump().get(key, 0)
+                     for osd in cluster.osds.values()))
+
+
+async def _forensic_bundle(cluster, label: str, detail: dict):
+    mgr = next(iter(cluster.mgrs.values()), None)
+    if mgr is None:
+        return None
+    try:
+        entry = await mgr.forensics_capture(label, detail=detail)
+        return {"id": entry["id"], "bundle": entry["path"],
+                "worst_daemon": entry["worst_daemon"]}
+    except (ConnectionError, TimeoutError):
+        return None
+
+
+async def _wait_motion_complete(cluster, timeout: float = 90.0,
+                                on_poll=None) -> None:
+    """Planned motion is DONE when (1) every OSD caught up to the
+    mon's current map (waiting on health alone races: right after a
+    topology change the digest still reflects the PRE-storm interval,
+    so health reads OK before any PG even re-peered), (2) every
+    primary PG is active with nothing missing and no backfill
+    reservation held — debounced, a map can land between polls — and
+    (3) health clears (degraded AND misplaced both zero; the
+    OBJECT_MISPLACED check holds WARN while the engine drains)."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    mon = next(iter(cluster.mons.values()))
+    settled_polls = 0
+    while settled_polls < 3:
+        if on_poll is not None:
+            on_poll()
+        target = mon.osd_monitor.osdmap.epoch
+        settled = all(
+            o.osdmap is not None and o.osdmap.epoch >= target
+            for o in cluster.osds.values())
+        if settled:
+            for o in cluster.osds.values():
+                if o.backfill_local.stats()["active"] \
+                        or o.backfill_remote.stats()["active"]:
+                    settled = False
+                    break
+                for pg in o.pgs.values():
+                    if pg.is_primary and (
+                            pg.state != "active"
+                            or pg.missing.total()
+                            or pg.missing.backfill):
+                        settled = False
+                        break
+                if not settled:
+                    break
+        settled_polls = settled_polls + 1 if settled else 0
+        if loop.time() > deadline:
+            raise TimeoutError("planned motion never completed")
+        await asyncio.sleep(0.25)
+    await cluster.wait_health_ok(timeout=max(
+        5.0, deadline - loop.time()))
+
+
+async def _wait_recovered(rados, timeout: float = 60.0,
+                          ignore: tuple = (
+                              "OSDMAP_FLAGS",
+                              "DEVICE_HEALTH_FLAPPING")) -> None:
+    """Wait until every health check OUTSIDE the expected set clears.
+    A rolling-upgrade window holds noout/norebalance (OSDMAP_FLAGS
+    warns by design) and repeated kill/revive trips the flapping
+    detector — plain HEALTH_OK is unreachable until the drill ends,
+    but PG availability/degradation must still fully settle."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    last = None
+    while True:
+        health = await rados.mon_command("health")
+        if health["rc"] == 0:
+            last = health["data"]
+            checks = dict(last.get("checks", {}))
+            for k in ignore:
+                checks.pop(k, None)
+            if not checks:
+                return
+        assert loop.time() < deadline, \
+            f"recovery never settled: {last}"
+        await asyncio.sleep(0.2)
+
+
+async def _make_ec_cluster(n_osds: int, pool: str, *,
+                           osds_per_host: int = 1,
+                           failure_domain: str = "osd",
+                           pg_num: int = 16,
+                           overrides: dict | None = None):
+    from ceph_tpu.vstart import DevCluster
+
+    fp.fp_clear()
+    cluster = DevCluster(
+        n_mons=1, n_osds=n_osds, osds_per_host=osds_per_host,
+        overrides={"mon_osd_down_out_interval": 300.0,
+                   **(overrides or {})})
+    await cluster.start()
+    mgr = await cluster.start_mgr(report_interval=0.25)
+    mgr.modules["balancer"].active = False   # no upmap churn mid-drill
+    rados = await cluster.client()
+    r = await rados.mon_command(
+        "osd erasure-code-profile set", name=f"{pool}_ec",
+        profile={"plugin": "jax_rs", "k": "2", "m": "1",
+                 "crush-failure-domain": failure_domain})
+    assert r["rc"] in (0, -17), r
+    await rados.pool_create(pool, pg_num=pg_num, pool_type="erasure",
+                            erasure_code_profile=f"{pool}_ec")
+    await rados.mon_command("osd pool set", pool=pool,
+                            var="pg_autoscale_mode", val="off")
+    io = await rados.open_ioctx(pool)
+    return cluster, rados, io
+
+
+async def run_expansion_drill(seed: int = 0, n_osds: int = 4,
+                              add: int = 1, n_objects: int = 64,
+                              obj_size: int = 4096,
+                              p99_slo_ms: float = 2000.0,
+                              balance_slo_s: float = 90.0,
+                              overrides: dict | None = None) -> dict:
+    """Live expansion: +25% OSDs under serving load.
+
+    Grades the backfill engine on the three expansion SLOs:
+
+    - **time-to-balanced** — seconds from the add to motion-complete
+      (health clear + every reservation slot released), bounded by
+      ``balance_slo_s``;
+    - **moved == predicted** — objects and bytes actually drained
+      (``backfill_objects``/``backfill_bytes`` counter deltas) must
+      EQUAL the client-side prediction computed from
+      ``PoolTables.diff`` between the pre- and post-expansion maps
+      (the diff names the moved PGs; changed up-row positions name
+      the moved shards);
+    - **client p99 bounded** — a read loop serves throughout the storm
+      and its p99 must stay under ``p99_slo_ms`` (the backfill mClock
+      class may not starve clients);
+
+    plus the batching guarantee: motion drains through coalesced
+    launches, so ``backfill_batches`` ≪ ``backfill_objects``.
+    """
+    import numpy as np
+
+    from ceph_tpu.osd.backfill import plan_motion
+    from ceph_tpu.osd.osd_map import NO_OSD
+    from ceph_tpu.osd.pg import object_to_ps
+
+    rng = np.random.default_rng(seed)
+    cluster, rados, io = await _make_ec_cluster(n_osds, "expand",
+                                                overrides=overrides)
+    out: dict = {"seed": seed, "osds": n_osds, "added": add}
+    loop = asyncio.get_running_loop()
+    try:
+        datas = {f"obj-{i}": rng.integers(0, 256, obj_size,
+                                          np.uint8).tobytes()
+                 for i in range(n_objects)}
+        await asyncio.gather(*(
+            io.write_full(o, d) for o, d in datas.items()))
+        await cluster.wait_health_ok(timeout=30)
+
+        m = rados.monc.osdmap
+        pid = next(p.pool_id for p in m.pools.values()
+                   if p.name == "expand")
+        pg_num = m.pools[pid].pg_num
+        tables_before = m.mapping().up_acting_tables(pid)
+        objects0 = _summed(cluster, "backfill_objects")
+        batches0 = _summed(cluster, "backfill_batches")
+        bytes0 = _summed(cluster, "backfill_bytes")
+        preempts0 = _summed(cluster, "backfill_preempts")
+
+        # serving load: reads stream through the whole storm and every
+        # latency sample lands in the p99 verdict
+        lat: list[float] = []
+        stop = asyncio.Event()
+        names = list(datas)
+
+        async def serve(worker: int) -> None:
+            i = worker
+            while not stop.is_set():
+                o = names[i % len(names)]
+                i += 3
+                t = loop.time()
+                got = await io.read(o)
+                lat.append(loop.time() - t)
+                assert got == datas[o], f"serving read mismatch on {o}"
+                await asyncio.sleep(0.005)
+
+        servers = [loop.create_task(serve(w)) for w in range(2)]
+        t0 = loop.time()
+        new_ids = []
+        for j in range(add):
+            new_ids.append(await cluster.add_osd(host=f"exp-host{j}"))
+        out["new_osds"] = new_ids
+        events.emit_proc("drill.expansion", seed=seed, added=new_ids)
+
+        # prediction: wait for the client map to carry the new OSDs,
+        # then diff the placement tables — the moved set, exactly
+        deadline = loop.time() + 15
+        while not all(i in m.osds and m.osds[i].up for i in new_ids):
+            assert loop.time() < deadline, "new OSDs never mapped"
+            await asyncio.sleep(0.1)
+        tables_after = m.mapping().up_acting_tables(pid)
+        width = min(tables_before.up.shape[1],
+                    tables_after.up.shape[1])
+        changed_pos: dict[int, list[int]] = {}
+        moved_map: dict[int, dict] = {pid: {}}
+        for ps in (int(x) for x in tables_after.diff(tables_before)):
+            pos = [s for s in range(width)
+                   if int(tables_after.up[ps, s])
+                   != int(tables_before.up[ps, s])
+                   and int(tables_after.up[ps, s]) != NO_OSD]
+            if pos:
+                changed_pos[ps] = pos
+                moved_map[pid][ps] = (
+                    [int(o) for o in tables_before.up[ps, :width]],
+                    [int(o) for o in tables_after.up[ps, :width]])
+        plan = plan_motion(moved_map)
+        events.emit_proc("backfill.plan", pools=1,
+                         moved_pgs=plan["moved_pgs"],
+                         groups=len(plan["groups"]))
+        shard_len = None
+        for osd in cluster.osds.values():
+            for pg in osd.pgs.values():
+                if pg.pgid.pool == pid and pg.backend is not None:
+                    shard_len = (pg.backend.sinfo
+                                 .logical_to_next_chunk_offset(obj_size))
+                    break
+            if shard_len is not None:
+                break
+        predicted_objects = 0
+        predicted_bytes = 0
+        for name in datas:
+            ps = object_to_ps(name, pg_num)
+            if ps in changed_pos:
+                predicted_objects += 1
+                predicted_bytes += shard_len * len(changed_pos[ps])
+        out["predicted"] = {"pgs": len(changed_pos),
+                            "objects": predicted_objects,
+                            "bytes": predicted_bytes}
+        assert predicted_objects > 0, "expansion moved nothing"
+
+        await _wait_motion_complete(cluster, timeout=balance_slo_s)
+        time_to_balanced = loop.time() - t0
+        stop.set()
+        await asyncio.gather(*servers)
+
+        moved_objects = int(_summed(cluster, "backfill_objects")
+                            - objects0)
+        moved_batches = int(_summed(cluster, "backfill_batches")
+                            - batches0)
+        moved_bytes = int(_summed(cluster, "backfill_bytes") - bytes0)
+        out["moved"] = {"objects": moved_objects,
+                        "batches": moved_batches,
+                        "bytes": moved_bytes,
+                        "preempts": int(
+                            _summed(cluster, "backfill_preempts")
+                            - preempts0)}
+        assert moved_objects == predicted_objects, (
+            f"moved {moved_objects} objects, PoolTables.diff "
+            f"predicted {predicted_objects}")
+        assert moved_bytes == predicted_bytes, (
+            f"moved {moved_bytes} bytes, predicted {predicted_bytes}")
+        assert 0 < moved_batches < moved_objects, (
+            f"{moved_batches} launches for {moved_objects} objects: "
+            "motion did not coalesce")
+
+        lat.sort()
+        p99_ms = lat[min(len(lat) - 1,
+                         int(0.99 * (len(lat) - 1)))] * 1000.0
+        out["slo"] = {
+            "time_to_balanced_s": round(time_to_balanced, 3),
+            "client_reads": len(lat),
+            "client_p99_ms": round(p99_ms, 3),
+            "pass": bool(time_to_balanced <= balance_slo_s
+                         and p99_ms <= p99_slo_ms),
+        }
+        assert out["slo"]["pass"], out["slo"]
+
+        for o, d in datas.items():
+            assert await io.read(o) == d, \
+                f"post-expansion read mismatch on {o}"
+        out["verified"] = len(datas)
+        out["forensics"] = await _forensic_bundle(
+            cluster, "drill:expansion",
+            detail={"seed": seed, "slo": out["slo"],
+                    "moved": out["moved"]})
+        return out
+    finally:
+        await rados.shutdown()
+        await cluster.stop()
+
+
+async def run_drain_drill(seed: int = 0, n_osds: int = 5,
+                          n_objects: int = 48,
+                          obj_size: int = 4096,
+                          victim: int | None = None) -> dict:
+    """Drain-then-remove: ``osd out`` → motion-complete → stop →
+    ``osd purge`` — with ZERO degraded objects throughout.
+
+    Planned motion keeps every object fully redundant on its old
+    holders (the drained OSD stays up and serving while the engine
+    copies its shards out), so the degraded counter must never tick;
+    the digest is sampled through the whole drain to prove it.  The
+    purge then removes the OSD from the map and its CRUSH item without
+    triggering a second storm (an emptied device carries no weight)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    cluster, rados, io = await _make_ec_cluster(n_osds, "drain")
+    if victim is None:
+        victim = n_osds - 1
+    out: dict = {"seed": seed, "osds": n_osds, "victim": victim}
+    loop = asyncio.get_running_loop()
+    try:
+        datas = {f"obj-{i}": rng.integers(0, 256, obj_size,
+                                          np.uint8).tobytes()
+                 for i in range(n_objects)}
+        await asyncio.gather(*(
+            io.write_full(o, d) for o, d in datas.items()))
+        await cluster.wait_health_ok(timeout=30)
+
+        mon = next(iter(cluster.mons.values()))
+        objects0 = _summed(cluster, "backfill_objects")
+        r = await rados.mon_command("osd out", ids=[victim])
+        assert r["rc"] == 0, r
+        events.emit_proc("drill.drain", seed=seed, victim=victim)
+
+        # motion drains while we sample the digest: misplaced may
+        # spike, degraded MUST NOT (the victim still serves)
+        peak = {"degraded": 0, "misplaced": 0}
+
+        def sample():
+            digest = mon.mgr_stat.digest or {}
+            peak["degraded"] = max(
+                peak["degraded"],
+                int(digest.get("degraded_objects", 0)))
+            peak["misplaced"] = max(
+                peak["misplaced"],
+                int(digest.get("misplaced_objects", 0)))
+
+        await _wait_motion_complete(cluster, timeout=90,
+                                    on_poll=sample)
+        max_degraded = peak["degraded"]
+        max_misplaced = peak["misplaced"]
+        out["max_degraded"] = max_degraded
+        out["max_misplaced"] = max_misplaced
+        assert max_degraded == 0, (
+            f"drain degraded {max_degraded} objects — planned motion "
+            "must keep full redundancy")
+        moved = int(_summed(cluster, "backfill_objects") - objects0)
+        out["moved_objects"] = moved
+        assert moved > 0, "drain moved nothing"
+
+        # stop the emptied daemon, wait for the mon to see it down,
+        # then purge it out of the map and the CRUSH tree
+        await cluster.kill_osd(victim)
+        m = rados.monc.osdmap
+        deadline = loop.time() + 30
+        while victim in m.osds and m.osds[victim].up:
+            assert loop.time() < deadline, "victim never marked down"
+            await asyncio.sleep(0.2)
+        r = await rados.mon_command("osd purge", id=victim)
+        assert r["rc"] == 0, r
+        deadline = loop.time() + 15
+        while victim in m.osds:
+            assert loop.time() < deadline, "purge never applied"
+            await asyncio.sleep(0.1)
+        out["purged"] = True
+        events.emit_proc("drill.drain.purged", victim=victim)
+        # removal of a zero-weight device must not start a second storm
+        await cluster.wait_health_ok(timeout=30)
+
+        for o, d in datas.items():
+            assert await io.read(o) == d, \
+                f"post-drain read mismatch on {o}"
+        out["verified"] = len(datas)
+        out["forensics"] = await _forensic_bundle(
+            cluster, "drill:drain",
+            detail={"seed": seed, "victim": victim,
+                    "moved_objects": moved,
+                    "max_degraded": max_degraded})
+        return out
+    finally:
+        await rados.shutdown()
+        await cluster.stop()
+
+
+async def run_rolling_restart_drill(seed: int = 0, hosts: int = 3,
+                                    osds_per_host: int = 2,
+                                    n_objects: int = 36,
+                                    obj_size: int = 4096) -> dict:
+    """Rolling restart: wave-by-wave host restarts under ``noout`` +
+    ``norebalance`` — reads stay bit-identical mid-wave, and NO
+    backfill storm follows any wave (the flags pin placement, the
+    revived daemons rejoin log-connected, so the motion engine has
+    nothing to move)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    cluster, rados, io = await _make_ec_cluster(
+        hosts * osds_per_host, "roll", osds_per_host=osds_per_host,
+        failure_domain="host")
+    out: dict = {"seed": seed, "hosts": hosts, "waves": []}
+    loop = asyncio.get_running_loop()
+    try:
+        datas = {f"obj-{i}": rng.integers(0, 256, obj_size,
+                                          np.uint8).tobytes()
+                 for i in range(n_objects)}
+        await asyncio.gather(*(
+            io.write_full(o, d) for o, d in datas.items()))
+        await cluster.wait_health_ok(timeout=30)
+
+        for flag in ("noout", "norebalance"):
+            r = await rados.mon_command("osd set", flag=flag)
+            assert r["rc"] == 0, r
+        m = rados.monc.osdmap
+        probe = list(datas)[:8]
+        objects0 = _summed(cluster, "backfill_objects")
+        for wave in range(hosts):
+            host = f"host{wave}"
+            killed = await cluster.kill_host(host)
+            assert killed, f"no OSDs on {host}"
+            events.emit_proc("drill.rolling.wave", wave=wave,
+                             host=host, osds=list(killed))
+            deadline = loop.time() + 30
+            while any(o in m.osds and m.osds[o].up for o in killed):
+                assert loop.time() < deadline, \
+                    f"wave {wave}: never marked down"
+                await asyncio.sleep(0.2)
+            # mid-wave reads: k shards survive per stripe, decode
+            # must return bit-identical data while the host is dark
+            got = await asyncio.wait_for(asyncio.gather(*(
+                io.read(o) for o in probe)), timeout=60)
+            for o, g in zip(probe, got):
+                assert g == datas[o], \
+                    f"wave {wave}: mid-wave read mismatch on {o}"
+            for osd_id in killed:
+                await cluster.revive_osd(osd_id)
+            await _wait_recovered(rados, timeout=60)
+            moved = int(_summed(cluster, "backfill_objects")
+                        - objects0)
+            out["waves"].append({"host": host, "killed": killed,
+                                 "mid_wave_reads": len(probe),
+                                 "backfill_after_wave": moved})
+            assert moved == 0, (
+                f"wave {wave}: backfill storm moved {moved} objects "
+                "despite noout")
+        for flag in ("noout", "norebalance"):
+            r = await rados.mon_command("osd unset", flag=flag)
+            assert r["rc"] == 0, r
+        await _wait_recovered(rados, timeout=30)
+
+        for o, d in datas.items():
+            assert await io.read(o) == d, \
+                f"post-restart read mismatch on {o}"
+        out["verified"] = len(datas)
+        out["forensics"] = await _forensic_bundle(
+            cluster, "drill:rolling_restart",
+            detail={"seed": seed, "waves": out["waves"]})
         return out
     finally:
         await rados.shutdown()

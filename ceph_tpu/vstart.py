@@ -110,6 +110,7 @@ class DevCluster:
         self.mgrs: dict[str, "object"] = {}
         self.rgws: list["object"] = []
         self._osd_stores: dict[int, ObjectStore] = {}
+        self._host_override: dict[int, str] = {}
 
     def conf(self) -> ConfigProxy:
         return ConfigProxy(overrides=dict(self.overrides))
@@ -233,10 +234,25 @@ class DevCluster:
         """Restart with the surviving store (revive_osd :480)."""
         return await self.start_osd(osd_id)
 
+    async def add_osd(self, host: str | None = None) -> int:
+        """Expansion: provision and boot a brand-new OSD id, optionally
+        on a brand-new CRUSH host (``prepare_boot`` auto-creates the
+        host bucket from the boot host name, so growing the failure
+        domain is just booting with a new host name).  Returns the new
+        OSD id; the resulting map epoch remaps PGs and the backfill
+        engine drains the planned motion."""
+        osd_id = self.n_osds
+        self.n_osds += 1
+        if host is not None:
+            self._host_override[osd_id] = host
+        await self.start_osd(osd_id)
+        return osd_id
+
     # -- host topology -----------------------------------------------------
     def host_of(self, osd_id: int) -> str:
         """CRUSH host name an OSD registers under."""
-        return f"host{osd_id // self.osds_per_host}"
+        return (self._host_override.get(osd_id)
+                or f"host{osd_id // self.osds_per_host}")
 
     def osds_on_host(self, host: str) -> list[int]:
         """OSD ids placed on ``host`` (running or not)."""

@@ -71,6 +71,17 @@
 #                                   forces >= 1 mClock recovery retune,
 #                                   and after the storm drains every
 #                                   object reads back bit-identical
+#   scripts/tier1.sh --elastic-smoke
+#                                   SLO-graded backfill engine end to
+#                                   end: a 4-OSD vstart cluster with an
+#                                   EC pool, one OSD added on a new
+#                                   CRUSH host under light serving
+#                                   load, planned motion polled to
+#                                   completion over the backfill_stats
+#                                   wire command (batched launches,
+#                                   idle reservations, distinct mClock
+#                                   class), bounded time-to-balanced,
+#                                   and a bit-identical read-back
 #   scripts/tier1.sh --scale-smoke  O(cluster) control plane at scale:
 #                                   a 200-OSD / 3-mon vstart cluster on
 #                                   the lightweight scale profile —
@@ -848,6 +859,128 @@ async def main():
 asyncio.run(main())
 EOF
     echo "QOS_SMOKE_PASSED"
+    exit 0
+fi
+
+if [ "${1:-}" = "--elastic-smoke" ]; then
+    set -e
+    export JAX_PLATFORMS=cpu
+    python - <<'EOF'
+import asyncio
+
+
+async def main():
+    from ceph_tpu.vstart import DevCluster
+
+    cluster = DevCluster(n_mons=1, n_osds=4, overrides={
+        "mon_osd_down_out_interval": 300.0,
+    })
+    await cluster.start()
+    try:
+        rados = await cluster.client()
+        r = await rados.mon_command(
+            "osd erasure-code-profile set", name="elsmoke",
+            profile={"plugin": "jax_rs", "k": "2", "m": "1",
+                     "crush-failure-domain": "osd"})
+        assert r["rc"] in (0, -17), r
+        await rados.pool_create("el", pg_num=16, pool_type="erasure",
+                                erasure_code_profile="elsmoke")
+        await rados.mon_command("osd pool set", pool="el",
+                                var="pg_autoscale_mode", val="off")
+        io = await rados.open_ioctx("el")
+        print("ok: vstart cluster + EC pool (jax_rs k=2,m=1, 16 pgs)")
+
+        datas = {f"obj-{i}": bytes([i]) * 4096 for i in range(48)}
+        await asyncio.gather(*(
+            io.write_full(o, d) for o, d in datas.items()))
+        await cluster.wait_health_ok(timeout=30)
+        print("ok: 48 healthy 4KiB writes acked")
+
+        # light serving load streams through the whole expansion
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        reads = [0]
+
+        async def serve():
+            names = list(datas)
+            i = 0
+            while not stop.is_set():
+                o = names[i % len(names)]
+                i += 1
+                got = await io.read(o)
+                assert got == datas[o], f"serving mismatch on {o}"
+                reads[0] += 1
+                await asyncio.sleep(0.01)
+
+        server = loop.create_task(serve())
+        t0 = loop.time()
+        new_id = await cluster.add_osd(host="smoke-host")
+        print(f"ok: osd.{new_id} added on a brand-new CRUSH host "
+              "under load")
+
+        # the client can only address osd.4 once its map carries it
+        m = rados.monc.osdmap
+        deadline = loop.time() + 15.0
+        while new_id not in m.osds or not m.osds[new_id].up:
+            assert loop.time() < deadline, "new OSD never mapped"
+            await asyncio.sleep(0.1)
+            m = rados.monc.osdmap
+
+        # poll the planned motion to completion OVER THE WIRE: the
+        # backfill_stats admin command reports the engine's drains,
+        # batched launches, and the live reservation tables — motion
+        # is complete when objects moved and every slot is idle
+        deadline = loop.time() + 90.0
+        stats = {}
+        while True:
+            objects = batches = dispatched = 0
+            idle = True
+            for osd_id in list(cluster.osds):
+                stats = await rados.osd_daemon_command(
+                    osd_id, "backfill_stats")
+                eng = stats.get("engine", {})
+                objects += eng.get("objects", 0)
+                batches += eng.get("batches", 0)
+                res = stats.get("reservations", {})
+                if res.get("local", {}).get("active") \
+                        or res.get("remote", {}).get("active"):
+                    idle = False
+                assert stats.get("mclock", {}).get("enabled") \
+                    is not None
+                dispatched += stats.get("mclock", {}).get(
+                    "backfill_dispatched", 0)
+            if objects > 0 and idle:
+                break
+            assert loop.time() < deadline, \
+                "planned motion never completed over the wire"
+            await asyncio.sleep(0.25)
+        await cluster.wait_health_ok(timeout=60)
+        t_balanced = loop.time() - t0
+        stop.set()
+        await server
+        assert t_balanced <= 90.0, \
+            f"time-to-balanced {t_balanced:.1f}s blew the bound"
+        assert 0 < batches < objects, (
+            f"{batches} launches for {objects} objects: "
+            "motion did not coalesce")
+        assert dispatched > 0, \
+            "no op dispatched through the backfill mClock class"
+        print(f"ok: motion complete in {t_balanced:.1f}s — "
+              f"{int(objects)} objects in {int(batches)} batched "
+              f"launches, {int(dispatched)} ops through the backfill "
+              f"mClock class, {reads[0]} client reads served")
+
+        for o, d in datas.items():
+            got = await io.read(o)
+            assert got == d, f"read-back mismatch on {o}"
+        print(f"ok: bit-identical read-back ({len(datas)}/{len(datas)})")
+    finally:
+        await cluster.stop()
+
+
+asyncio.run(main())
+EOF
+    echo "ELASTIC_SMOKE_PASSED"
     exit 0
 fi
 

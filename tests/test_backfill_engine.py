@@ -1,0 +1,311 @@
+"""Backfill engine unit tests: the planned-motion plan grouping, the
+local/remote reservation-slot lifecycle (exhaustion queues FIFO,
+preemption cancels cleanly, cancellation gives slots back), and the
+cursor-checkpointed drain — a resumed drain must move no object twice,
+counter-verified, and a newer epoch must preempt between batches."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.common.perf import PerfCounters
+from ceph_tpu.osd import pg_log
+from ceph_tpu.osd.backfill import (
+    BackfillEngine,
+    BackfillPreempted,
+    BackfillSlots,
+    cursor_clear,
+    cursor_load,
+    cursor_save,
+    plan_motion,
+)
+from ceph_tpu.store import MemStore, Transaction
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# -- plan_motion --------------------------------------------------------
+
+
+def test_plan_motion_groups_by_sig_and_dests():
+    moved = {
+        1: {0: ([0, 1, 2], [0, 1, 3]),      # dest {3}
+            4: ([2, 0, 1], [2, 0, 3]),      # dest {3} -> same group
+            7: ([0, 1, 2], [4, 1, 2])},     # dest {4}
+        2: {1: ([0, 1], [3, 1])},           # other pool: other sig
+    }
+    plan = plan_motion(moved)
+    assert plan["moved_pgs"] == 4
+    keyed = {(g["sig"], tuple(g["dests"])): g["pgs"]
+             for g in plan["groups"]}
+    assert keyed[("1", (3,))] == [[1, 0], [1, 4]]
+    assert keyed[("1", (4,))] == [[1, 7]]
+    assert keyed[("2", (3,))] == [[2, 1]]
+    # custom signature merges the pools, custom dests override the
+    # member-set difference
+    plan = plan_motion(moved, sig_of=lambda pool: "ec:k2m1",
+                       dests_of=lambda old, new: [9])
+    assert len(plan["groups"]) == 1
+    assert plan["groups"][0]["dests"] == [9]
+    assert plan["moved_pgs"] == 4
+
+
+def test_plan_motion_ignores_holes_in_up_rows():
+    # NO_OSD padding (-1) never becomes a destination
+    plan = plan_motion({1: {0: ([0, 1, -1], [0, 1, 2])}})
+    assert plan["groups"][0]["dests"] == [2]
+
+
+# -- BackfillSlots ------------------------------------------------------
+
+
+def test_slots_exhaustion_queues_fifo():
+    async def run():
+        slots = BackfillSlots(max_slots=1)
+        assert slots.try_reserve("1.0", epoch=5)
+        assert not slots.try_reserve("1.1", epoch=5)
+        assert slots.stats() == {"max": 1, "active": {"1.0": 5},
+                                 "queued": 0}
+
+        order = []
+
+        async def want(key):
+            waited = await slots.reserve(key, epoch=5)
+            order.append((key, waited))
+
+        t1 = asyncio.ensure_future(want("1.1"))
+        t2 = asyncio.ensure_future(want("1.2"))
+        await asyncio.sleep(0)
+        assert slots.stats()["queued"] == 2
+        slots.release("1.0")
+        await asyncio.gather(t1)
+        # FIFO: 1.1 got the slot first; 1.2 still parked
+        assert order == [("1.1", True)]
+        slots.release("1.1")
+        await asyncio.gather(t2)
+        assert order == [("1.1", True), ("1.2", True)]
+        # an immediate grant reports waited=False
+        slots.release("1.2")
+        assert await slots.reserve("1.3", epoch=6) is False
+
+    _run(run())
+
+
+def test_slots_rereserve_same_key_adopts_epoch():
+    slots = BackfillSlots(max_slots=1)
+    assert slots.try_reserve("1.0", epoch=5)
+    # same key re-reserves without consuming a second slot, and the
+    # newer epoch wins (re-peer of the same interval)
+    assert slots.try_reserve("1.0", epoch=7)
+    assert slots.stats()["active"] == {"1.0": 7}
+    assert not slots.preempt_stale("1.0", newer_epoch=7)   # not stale
+    assert slots.preempt_stale("1.0", newer_epoch=8)
+    assert slots.stats()["active"] == {}
+
+
+def test_slots_waiter_cancel_gives_slot_back():
+    async def run():
+        slots = BackfillSlots(max_slots=1)
+        slots.try_reserve("1.0", epoch=1)
+        t = asyncio.ensure_future(slots.reserve("1.1", epoch=1))
+        await asyncio.sleep(0)
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        assert slots.stats()["queued"] == 0
+        # the cancelled waiter left no ghost: releasing the holder
+        # leaves a free slot for the next PG
+        slots.release("1.0")
+        assert slots.try_reserve("1.2", epoch=1)
+
+    _run(run())
+
+
+def test_slots_preempt_stale_waiter_cancels_cleanly():
+    async def run():
+        slots = BackfillSlots(max_slots=1)
+        slots.try_reserve("1.0", epoch=3)
+        t = asyncio.ensure_future(slots.reserve("1.1", epoch=3))
+        await asyncio.sleep(0)
+        assert slots.preempt_stale("1.1", newer_epoch=4)
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        assert slots.stats()["queued"] == 0
+        # preempting the holder frees the slot too
+        assert slots.preempt_stale("1.0", newer_epoch=4)
+        assert slots.try_reserve("1.2", epoch=4)
+
+    _run(run())
+
+
+def test_slots_resize_pumps_waiters():
+    async def run():
+        slots = BackfillSlots(max_slots=1)
+        slots.try_reserve("1.0", epoch=1)
+        t = asyncio.ensure_future(slots.reserve("1.1", epoch=1))
+        await asyncio.sleep(0)
+        slots.resize(2)                     # osd_max_backfills raised
+        assert await t is True
+        assert set(slots.stats()["active"]) == {"1.0", "1.1"}
+
+    _run(run())
+
+
+# -- cursor persistence -------------------------------------------------
+
+
+def _meta_store():
+    store = MemStore()
+    _run(store.queue_transactions(
+        Transaction().create_collection(pg_log.meta_cid(1, 0))))
+    return store
+
+
+def test_cursor_roundtrip_and_clear():
+    store = _meta_store()
+    assert cursor_load(store, 1, 0) is None
+    _run(cursor_save(store, 1, 0, epoch=9, pos="obj-5", moved=6))
+    assert cursor_load(store, 1, 0) == {"epoch": 9, "pos": "obj-5",
+                                        "moved": 6}
+    _run(cursor_clear(store, 1, 0))
+    assert cursor_load(store, 1, 0) is None
+
+
+# -- BackfillEngine drain -----------------------------------------------
+
+
+class _FakeRepair:
+    """Stands in for the RepairScheduler: records every drain call
+    (names + mClock class) and reports one batch per call."""
+
+    def __init__(self, max_batch_objects=4):
+        self.max_batch_objects = max_batch_objects
+        self.calls = []
+
+    async def drain(self, backend, rebuild, versions=None,
+                    clazz="recovery", stats=None):
+        self.calls.append((tuple(sorted(rebuild)), clazz))
+        if stats is not None:
+            stats["batches"] = 1
+            stats["bytes"] = 100 * len(rebuild)
+        return set(rebuild)
+
+
+def _engine(store=None, max_batch_objects=4):
+    perf = PerfCounters("t")
+    repair = _FakeRepair(max_batch_objects=max_batch_objects)
+    return BackfillEngine(repair, perf, store=store), repair, perf
+
+
+def test_drain_moves_all_in_batches_as_backfill_class():
+    store = _meta_store()
+    eng, repair, perf = _engine(store)
+    rebuild = {f"obj-{i}": [2] for i in range(10)}
+    done = _run(eng.drain_pg(None, rebuild, pool=1, ps=0, epoch=7))
+    assert done == set(rebuild)
+    # 10 objects at max_batch_objects=4: 3 checkpointed batches, every
+    # one dispatched through the backfill mClock class (not recovery)
+    assert [c for _, c in repair.calls] == ["backfill"] * 3
+    assert perf.value("backfill_objects") == 10
+    assert perf.value("backfill_batches") == 3
+    assert perf.value("backfill_bytes") == 1000
+    assert eng.stats()["drains"] == 1
+    # a completed drain clears its cursor
+    assert cursor_load(store, 1, 0) is None
+
+
+def test_preempt_then_resume_moves_no_object_twice():
+    store = _meta_store()
+    eng, repair, perf = _engine(store)
+    rebuild = {f"obj-{i:02d}": [3] for i in range(10)}
+
+    # epoch 7 drain, preempted after the first batch lands
+    epoch_cell = [7]
+
+    def current_epoch():
+        if repair.calls:
+            epoch_cell[0] = 8
+        return epoch_cell[0]
+
+    with pytest.raises(BackfillPreempted):
+        _run(eng.drain_pg(None, rebuild, pool=1, ps=0, epoch=7,
+                          current_epoch=current_epoch))
+    moved_first = {n for names, _ in repair.calls for n in names}
+    assert len(moved_first) == 4             # exactly one batch landed
+    assert perf.value("backfill_preempts") == 1
+    assert eng.stats()["preempts"] == 1
+    cur = cursor_load(store, 1, 0)
+    assert cur == {"epoch": 7, "pos": sorted(moved_first)[-1],
+                   "moved": 4}
+
+    # re-peer lands on the SAME interval epoch: the resumed drain
+    # skips everything the cursor checkpointed
+    repair.calls.clear()
+    done = _run(eng.drain_pg(None, rebuild, pool=1, ps=0, epoch=7))
+    moved_second = {n for names, _ in repair.calls for n in names}
+    assert done == moved_second
+    assert moved_first | moved_second == set(rebuild)
+    assert not (moved_first & moved_second), \
+        "cursor resume re-moved an object"
+    # counter-verified: total objects through the engine == the PG's
+    # population, the skip count == the checkpointed prefix
+    assert perf.value("backfill_objects") == len(rebuild)
+    assert perf.value("backfill_cursor_skipped") == len(moved_first)
+    assert perf.value("backfill_cursor_resumes") == 1
+    assert eng.stats()["resumes"] == 1
+    assert cursor_load(store, 1, 0) is None
+
+
+def test_stale_cursor_from_older_epoch_is_ignored():
+    store = _meta_store()
+    eng, repair, perf = _engine(store)
+    # a cursor checkpointed under epoch 5 describes a DIFFERENT
+    # interval's moved set: a drain at epoch 9 must ignore it and
+    # move everything
+    _run(cursor_save(store, 1, 0, epoch=5, pos="obj-7", moved=8))
+    rebuild = {f"obj-{i}": [2] for i in range(6)}
+    done = _run(eng.drain_pg(None, rebuild, pool=1, ps=0, epoch=9))
+    assert done == set(rebuild)
+    assert perf.value("backfill_cursor_resumes") == 0
+    assert perf.value("backfill_cursor_skipped") == 0
+    assert perf.value("backfill_objects") == 6
+
+
+def test_gate_pauses_drain_until_cleared():
+    store = _meta_store()
+
+    async def run():
+        eng, repair, perf = _engine(store)
+        rebuild = {f"obj-{i}": [2] for i in range(3)}
+        gated = [True]
+        task = asyncio.ensure_future(eng.drain_pg(
+            None, rebuild, pool=1, ps=0, epoch=7,
+            gate=lambda: gated[0]))
+        await asyncio.sleep(0.05)
+        assert not repair.calls, "drain ran through the norebalance gate"
+        assert perf.value("backfill_gated") == 1
+        gated[0] = False                    # operator unsets the flag
+        assert await task == set(rebuild)
+
+    _run(run())
+
+
+def test_gated_drain_still_preempted_by_newer_epoch():
+    store = _meta_store()
+
+    async def run():
+        eng, repair, perf = _engine(store)
+        epoch_cell = [7]
+        task = asyncio.ensure_future(eng.drain_pg(
+            None, {"obj-0": [2]}, pool=1, ps=0, epoch=7,
+            current_epoch=lambda: epoch_cell[0],
+            gate=lambda: True))
+        await asyncio.sleep(0.05)
+        epoch_cell[0] = 8                   # new map while parked
+        with pytest.raises(BackfillPreempted):
+            await task
+        assert not repair.calls
+
+    _run(run())

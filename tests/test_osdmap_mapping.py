@@ -132,6 +132,85 @@ def test_overlay_epochs_reuse_raw_rows():
     assert mapping.rebuilds > before
 
 
+def _scalar_diff_oracle(m, pool_id, cur, prev):
+    """The changed-PG set recomputed the slow way: compare the scalar
+    pg_to_up_acting tuple of every PG across the two snapshots.  PGs
+    beyond the snapshots' common pg_num are new — always changed."""
+    n = min(cur.pg_num, prev.pg_num)
+    changed = {ps for ps in range(n) if cur.lookup(ps) != prev.lookup(ps)}
+    changed.update(range(n, cur.pg_num))
+    return changed
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_diff_exact_vs_scalar_oracle(seed):
+    """PoolTables.diff is the backfill engine's moved-set authority
+    (the expansion drill asserts moved bytes EQUAL its prediction), so
+    it must be exact in both directions: no missed changed PG, no
+    spurious one, across random epochs of down/reweight/upmap/temp."""
+    rng = random.Random(seed)
+    m, n_osds = _random_map(rng)
+    snaps = {pid: m.mapping().up_acting_tables(pid) for pid in m.pools}
+    for _ in range(5):
+        m.apply_incremental(_random_overlays(rng, m, n_osds))
+        for pid in m.pools:
+            cur = m.mapping().up_acting_tables(pid)
+            got = {int(p) for p in cur.diff(snaps[pid])}
+            want = _scalar_diff_oracle(m, pid, cur, snaps[pid])
+            assert got == want, (
+                f"pool {pid}: diff {sorted(got)} != oracle "
+                f"{sorted(want)}")
+            snaps[pid] = cur
+
+
+def test_diff_exact_on_overlay_only_epoch():
+    """An overlay-only incremental rides the fast path (cached CRUSH
+    rows reused, zero rebuilds) — the diff must still be exact there,
+    not just on full rebuilds."""
+    rng = random.Random(3)
+    m, n_osds = _random_map(rng, n_hosts=4, osds_per=2)
+    mapping = m.mapping()
+    prev = mapping.up_acting_tables(1)
+    before = mapping.rebuilds
+    inc = Incremental(m.epoch + 1)
+    inc.new_pg_upmap_items[(1, 2)] = [(int(prev.up[2, 0]), 7)]
+    inc.new_pg_temp[(1, 5)] = [1, 2, 3]
+    inc.new_primary_temp[(1, 6)] = 4
+    m.apply_incremental(inc)
+    cur = m.mapping().up_acting_tables(1)
+    assert mapping.rebuilds == before        # the fast path was taken
+    got = {int(p) for p in cur.diff(prev)}
+    assert got == _scalar_diff_oracle(m, 1, cur, prev)
+    assert got, "three overlay edits produced an empty diff"
+    # clearing the overlays walks back to the original rows: the diff
+    # against the FIRST snapshot must report exactly the same set
+    inc = Incremental(m.epoch + 1)
+    inc.new_pg_upmap_items[(1, 2)] = []
+    inc.new_pg_temp[(1, 5)] = []
+    inc.new_primary_temp[(1, 6)] = NO_OSD
+    m.apply_incremental(inc)
+    back = m.mapping().up_acting_tables(1)
+    assert {int(p) for p in back.diff(prev)} == \
+        _scalar_diff_oracle(m, 1, back, prev)
+
+
+def test_diff_reports_every_pg_past_a_split():
+    """pg_num growth (split): PGs beyond the overlap are new placements
+    — diff must name every one of them plus any resharded survivor."""
+    rng = random.Random(5)
+    m, n_osds = _random_map(rng, n_hosts=4, osds_per=2)
+    prev = m.mapping().up_acting_tables(1)
+    import copy
+    grown = copy.deepcopy(m.pools[1])
+    grown.pg_num = prev.pg_num * 2
+    grown.pgp_num = grown.pg_num
+    m.apply_incremental(Incremental(m.epoch + 1, new_pools=[grown]))
+    cur = m.mapping().up_acting_tables(1)
+    got = {int(p) for p in cur.diff(prev)}
+    assert set(range(prev.pg_num, cur.pg_num)) <= got
+    assert got == _scalar_diff_oracle(m, 1, cur, prev)
+
+
 def test_pgs_of_and_diff_match_lookups():
     rng = random.Random(7)
     m, n_osds = _random_map(rng, n_hosts=5, osds_per=2)
