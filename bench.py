@@ -1463,6 +1463,108 @@ def _cfg13_main() -> None:
     print(json.dumps(record), flush=True)
 
 
+def _cfg14_scrub(seed: int = 0, objects: int = 64,
+                 obj_size: int = 4096) -> dict:
+    """cfg14 single arm: scrub-launch reduction A/B on a standalone
+    EC backend.  ``objects`` uniform ``obj_size`` writes land in ONE
+    shard-length group, so the batched deep scrub is exactly two device
+    launches (one coalesced re-encode + one fused parity/CRC verify)
+    against one-launch-per-object for the sequential oracle.  The
+    launch counter is exact on any backend (CPU included); on-chip the
+    same ratio is what keeps an always-on scrubber off the dispatch
+    path.  Verdict parity between the two arms is asserted object by
+    object — the cheap sweep may not weaken detection."""
+    import asyncio
+
+    import numpy as np
+
+    async def run() -> dict:
+        from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+        from ceph_tpu.osd.ec_backend import ECBackend, LocalShard
+        from ceph_tpu.store import CollectionId, MemStore, Transaction
+
+        codec = ErasureCodePluginRegistry().factory(
+            "jax_rs", {"k": "4", "m": "2", "technique": "reed_sol_van"})
+        store = MemStore()
+        shards = {}
+        for i in range(codec.get_chunk_count()):
+            cid = CollectionId(1, 0, shard=i)
+            await store.queue_transactions(
+                Transaction().create_collection(cid))
+            shards[i] = LocalShard(store, cid, pool=1, shard=i)
+        be = ECBackend(codec, shards, stripe_unit=128)
+
+        rng = np.random.default_rng(seed)
+        names = [f"s{i:03d}" for i in range(objects)]
+        for name in names:
+            await be.write(
+                name, rng.integers(0, 256, obj_size, np.uint8).tobytes())
+
+        t0 = time.perf_counter()
+        before = be.perf.value("ec_scrub_launches")
+        out = await be.scrub_batch(names)
+        batched_launches = be.perf.value("ec_scrub_launches") - before
+        batched_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        before = be.perf.value("ec_scrub_launches")
+        oracle = {name: await be.scrub(name) for name in names}
+        oracle_launches = be.perf.value("ec_scrub_launches") - before
+        oracle_s = time.perf_counter() - t0
+
+        mismatched = [n for n in names if out["reports"][n] != oracle[n]]
+        unclean = [n for n in names if not out["reports"][n]["clean"]]
+        return {
+            "objects": objects,
+            "obj_size": obj_size,
+            "groups": out["groups"],
+            "batched_launches": batched_launches,
+            "oracle_launches": oracle_launches,
+            "reduction_x": oracle_launches / max(batched_launches, 1.0),
+            "batched_s": round(batched_s, 4),
+            "oracle_s": round(oracle_s, 4),
+            "verdicts_match": not mismatched,
+            "mismatched": mismatched,
+            "unclean": unclean,
+        }
+
+    return asyncio.run(run())
+
+
+def _cfg14_main() -> None:
+    """Standalone cfg14 entry
+    (``python bench.py --cfg14 [--seed N] [--objects N]``):
+    CPU-valid — launch accounting and verdict parity are exact on any
+    backend.  Hard gate: the batched sweep must cut scrub launches by
+    at least 16x on a 64-object uniform group (measured 32x: 2 launches
+    vs 64) with per-object verdicts EQUAL to the sequential oracle and
+    a clean corpus staying clean."""
+    seed = 0
+    objects = 64
+    argv = sys.argv[1:]
+    if "--seed" in argv:
+        seed = int(argv[argv.index("--seed") + 1])
+    if "--objects" in argv:
+        objects = int(argv[argv.index("--objects") + 1])
+
+    out = _cfg14_scrub(seed=seed, objects=objects)
+    ok = (out["verdicts_match"]
+          and not out["unclean"]
+          and out["groups"] == 1
+          and out["reduction_x"] >= 16.0)
+    if not ok:
+        raise SystemExit(f"cfg14 gate failed: {json.dumps(out)}")
+    record = {
+        "metric": "scrub_launch_reduction_64obj",
+        "value": round(out["reduction_x"], 2),
+        "unit": "x fewer device launches (batched sweep vs per-object)",
+        "vs_baseline": float(ok),
+        "extra": {"seed": seed, **out},
+    }
+    _append_local_record(record)
+    print(json.dumps(record), flush=True)
+
+
 def _append_local_record(record: dict) -> None:
     """Append a successful run to BENCH_LOCAL.jsonl (the auditable local
     trail; PERF.md explains the protocol)."""
@@ -1604,6 +1706,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--cfg13" in sys.argv[1:]:
         _cfg13_main()
+        sys.exit(0)
+    if "--cfg14" in sys.argv[1:]:
+        _cfg14_main()
         sys.exit(0)
     try:
         main()

@@ -162,7 +162,8 @@ class Rados:
                         "ec_resident_stats_reply",
                         "ec_mesh_stats_reply",
                         "ec_repair_stats_reply",
-                        "backfill_stats_reply"):
+                        "backfill_stats_reply",
+                        "ec_scrub_stats_reply"):
             fut = self._daemon_futs.pop(int(msg.data.get("tid", 0)), None)
             if fut is not None and not fut.done():
                 fut.set_result(msg.data)

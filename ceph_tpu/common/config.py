@@ -110,6 +110,11 @@ def global_options() -> list[Option]:
         Option("osd_mclock_recovery_lim", float, 0.0, "recovery limit"),
         Option("osd_scrub_interval", float, 0.0,
                "seconds between automatic PG scrubs (0 = manual only)"),
+        Option("osd_scrub_jitter", float, 0.5,
+               "randomize each background scrub tick up to this "
+               "fraction beyond osd_scrub_interval (per-OSD seeded "
+               "rng) so a fleet started together does not deep-scrub "
+               "in lockstep"),
         Option("osd_mclock_scrub_res", float, 5.0,
                "scrub reservation (ops/s)"),
         Option("osd_mclock_scrub_wgt", float, 1.0, "scrub weight"),
@@ -389,6 +394,21 @@ def global_options() -> list[Option]:
                "max; no rebuild-GiB term — redundancy is intact during "
                "planned motion, so backfill may be squeezed harder "
                "than recovery)", Level.ADVANCED, min=0.0, max=1.0),
+        Option("qos_scrub_max_ops", float, 64.0,
+               "scrub-class mClock limit ceiling the controller ramps "
+               "back to when client SLOs are healthy (integrity "
+               "verification gets the third AIMD position)",
+               Level.ADVANCED, min=1.0),
+        Option("qos_scrub_min_ops", float, 1.0,
+               "absolute floor for the scrub-class mClock limit: "
+               "backoff never parks verification below this pace",
+               Level.ADVANCED, min=0.1),
+        Option("qos_scrub_min_share", float, 0.01,
+               "scrub pacing floor as a fraction of qos_scrub_max_ops "
+               "(combined with the ops floor via max; scrub verifies "
+               "fully-redundant data, so of the three background "
+               "classes it is squeezed hardest when clients burn)",
+               Level.ADVANCED, min=0.0, max=1.0),
         Option("qos_hedge_quantile", float, 0.95,
                "derive each OSD's EC hedge-read timeout from this "
                "quantile of its windowed shard-read latency histogram "

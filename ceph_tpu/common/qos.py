@@ -167,7 +167,11 @@ class QoSController:
                  backfill_res: float = 5.0,
                  backfill_max_ops: float = 128.0,
                  backfill_min_ops: float = 2.0,
-                 backfill_min_share: float = 0.02):
+                 backfill_min_share: float = 0.02,
+                 scrub_res: float = 1.0,
+                 scrub_max_ops: float = 64.0,
+                 scrub_min_ops: float = 1.0,
+                 scrub_min_share: float = 0.01):
         # the pacing floor: never throttle recovery below the largest
         # of (absolute ops floor, share-of-ceiling floor, the ops rate
         # that sustains slo_rebuild_floor_gibs at the assumed GiB/op)
@@ -192,6 +196,19 @@ class QoSController:
             ceiling=backfill_max_ops, backoff=backoff, ramp=ramp_ops,
             raise_evals=raise_evals, clear_evals=clear_evals)
         self.backfill_res = float(backfill_res)
+        # scrub (verification of data already fully redundant) is the
+        # THIRD AIMD position: like backfill it has no rebuild-GiB
+        # floor, and its share floor sits lower still — of the three
+        # background classes, doubt drains last when clients burn.
+        # The daemon additionally PAUSES in-flight sweeps on the
+        # burning flag; this position governs the dispatch rate of the
+        # sweeps that do run.
+        sc_floor = max(scrub_min_ops, scrub_min_share * scrub_max_ops)
+        self.scrub = AIMDController(
+            initial=scrub_max_ops, floor=sc_floor,
+            ceiling=scrub_max_ops, backoff=backoff, ramp=ramp_ops,
+            raise_evals=raise_evals, clear_evals=clear_evals)
+        self.scrub_res = float(scrub_res)
         self.hedge_quantile = float(hedge_quantile)
         self.hedge_min_s = float(hedge_min_s)
         self.hedge_max_s = float(hedge_max_s)
@@ -221,6 +238,10 @@ class QoSController:
             backfill_max_ops=float(conf["qos_backfill_max_ops"]),
             backfill_min_ops=float(conf["qos_backfill_min_ops"]),
             backfill_min_share=float(conf["qos_backfill_min_share"]),
+            scrub_res=float(conf["osd_mclock_scrub_res"]),
+            scrub_max_ops=float(conf["qos_scrub_max_ops"]),
+            scrub_min_ops=float(conf["qos_scrub_min_ops"]),
+            scrub_min_share=float(conf["qos_scrub_min_share"]),
         )
 
     @staticmethod
@@ -242,6 +263,7 @@ class QoSController:
             {"burning": bool, "burn": float,
              "recovery": {"limit", "reservation", "floor", "changed"},
              "backfill": {"limit", "reservation", "floor", "changed"},
+             "scrub":    {"limit", "reservation", "floor", "changed"},
              "hedge": {daemon: timeout_s}}   # only entries that moved
 
         ``hedge`` keys are daemon names (``osd.N``); an entry appears
@@ -271,6 +293,15 @@ class QoSController:
         }
         if new_bf is not None:
             self.retunes += 1
+        new_sc = self.scrub.step(burning)
+        sc = {
+            "limit": self.scrub.value,
+            "reservation": min(self.scrub_res, self.scrub.value),
+            "floor": self.scrub.floor,
+            "changed": new_sc is not None,
+        }
+        if new_sc is not None:
+            self.retunes += 1
 
         hedge: dict[str, float] = {}
         if self.hedge_quantile > 0.0:
@@ -294,7 +325,7 @@ class QoSController:
                 hedge[daemon] = t
 
         return {"burning": burning, "burn": burn, "recovery": rec,
-                "backfill": bf, "hedge": hedge}
+                "backfill": bf, "scrub": sc, "hedge": hedge}
 
     def state(self) -> dict:
         """Controller state snapshot (digest / forensic bundles)."""
@@ -307,6 +338,9 @@ class QoSController:
             "backfill_limit": round(self.backfill.value, 3),
             "backfill_floor": round(self.backfill.floor, 3),
             "backfill_ceiling": round(self.backfill.ceiling, 3),
+            "scrub_limit": round(self.scrub.value, 3),
+            "scrub_floor": round(self.scrub.floor, 3),
+            "scrub_ceiling": round(self.scrub.ceiling, 3),
             "hedge_timeouts_ms": {
                 d: round(t * 1e3, 3)
                 for d, t in sorted(self._hedge_last.items())},

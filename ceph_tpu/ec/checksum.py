@@ -1,0 +1,196 @@
+r"""Device CRC32C: chunk checksums as a GF(2) bitmatrix contraction.
+
+CRC32C (Castagnoli) is GF(2)-linear in its input once the pre/post
+inversions are factored out.  Writing the table-loop register step
+(common/crc32c.py)::
+
+    r' = T[(r ^ b) & 0xFF] ^ (r >> 8)
+       = A(r) ^ T[b]          with A(r) = (r >> 8) ^ T[r & 0xFF]
+
+(the table is linear: T[i ^ j] = T[i] ^ T[j]) shows that the register
+after L bytes splits into an affine seed part and a data part that is a
+pure GF(2) linear map::
+
+    r_L = A^L(r_0) ^ sum_j A^{L-1-j}(T[b_j])
+              \--- seed ---/   \------ Lmap(data) ------/
+
+``Lmap`` is a (32 x 8L) 0/1 bitmatrix, which means a whole batch of
+shard streams can be checksummed with the SAME MXU kernel that encodes
+them (``ec.engine.bitplane_apply``) — one contraction per pow2 batch
+bucket instead of a host loop per shard.  The seed part never touches
+the device: ``crc32c(seed, zeros(L))`` IS ``~A^L(~seed)``, so the final
+checksum is simply::
+
+    crc32c(seed, data) == Lmap(data) ^ crc32c(seed, b"\\x00" * L)
+
+computed with the (fast, native) host CRC over a cached zero buffer.
+Bit-identity with ``common/crc32c.py`` therefore holds by construction
+— both sides are the same polynomial algebra — and is additionally
+pinned by a corpus test (tests/test_checksum.py).
+
+Exactness bound: bitplane_apply accumulates 0/1 products in f32, exact
+while row population <= 8L < 2^24, i.e. L < 2^21.  ``supported_len``
+gates the device path well below that (the bitmatrix is 32 x 8L bf16 =
+512*L bytes, so the default cap also bounds cache footprint); callers
+fall back to the host CRC beyond the gate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ceph_tpu.common.crc32c import crc32c
+from ceph_tpu.ec import engine
+
+# Device-path length gate.  64 KiB shard streams build a 32 MiB bf16
+# bitmatrix — cached per length, a handful of lengths alive at once.
+CRC_DEVICE_MAX_LEN = 1 << 16
+
+CRC_SEED = 0xFFFFFFFF          # HashInfo's initial per-shard seed
+
+
+def supported_len(length: int, max_len: int | None = None) -> bool:
+    """True when ``length``-byte streams may take the device CRC path."""
+    cap = CRC_DEVICE_MAX_LEN if max_len is None else int(max_len)
+    return 0 < int(length) <= min(cap, (1 << 21) - 1)
+
+
+@functools.lru_cache(maxsize=None)
+def _table_np() -> np.ndarray:
+    tbl = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        tbl.append(c)
+    return np.array(tbl, dtype=np.uint32)
+
+
+@functools.lru_cache(maxsize=8)
+def crc_bitmatrix(length: int) -> np.ndarray:
+    """(32, 8*length) uint8 0/1 matrix M with M @ bits(data) = Lmap(data).
+
+    Column q = 8*j + s holds the 32 register bits contributed by bit s of
+    byte j, i.e. A^{L-1-j}(T[1 << s]); row p is register bit p, matching
+    bitplane_apply's repack (output byte p//8, bit p%8 — little-endian
+    uint32 across the 4 output bytes).
+    """
+    L = int(length)
+    tbl = _table_np()
+    cols = np.empty((L, 8), np.uint32)
+    r = tbl[np.array([1 << s for s in range(8)], np.int64)]
+    cols[L - 1] = r
+    for m in range(1, L):
+        r = (r >> np.uint32(8)) ^ tbl[r & np.uint32(0xFF)]
+        cols[L - 1 - m] = r
+    bits = ((cols[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1)
+    return bits.astype(np.uint8).transpose(2, 0, 1).reshape(32, 8 * L)
+
+
+@functools.lru_cache(maxsize=8)
+def _crc_bitmatrix_bf16(length: int):
+    import jax.numpy as jnp
+    return jnp.asarray(crc_bitmatrix(length), jnp.bfloat16)
+
+
+@functools.lru_cache(maxsize=64)
+def _zeros(length: int) -> bytes:
+    return bytes(length)
+
+
+def zero_crc(seed: int, length: int) -> int:
+    """crc32c(seed, b"\\x00" * length) — the affine seed term."""
+    return crc32c(seed & 0xFFFFFFFF, _zeros(int(length)))
+
+
+def crc_bits_device(streams):
+    """Linear CRC part of a (B, L) uint8 stream batch, on device.
+
+    Returns a (B, 4) uint8 device array: the little-endian register bits
+    of Lmap(stream) per row.  Finalize with :func:`finalize_crcs`.  The
+    input may be a host numpy array or a device array — it is fed to the
+    jitted bitplane kernel either way (this is the launch the scrub /
+    write paths count).
+    """
+    B, L = int(streams.shape[0]), int(streams.shape[1])
+    mat = _crc_bitmatrix_bf16(L)
+    out = engine._apply_bitmatrix(mat, streams.reshape(B, L, 1))
+    return out.reshape(B, 4)
+
+
+def finalize_crcs(bits_host: np.ndarray, seeds, length: int) -> list[int]:
+    """Combine device register bits with per-stream seeds on host.
+
+    ``bits_host``: (B, 4) uint8 (host copy of :func:`crc_bits_device`).
+    ``seeds``: iterable of B seed values (previous cumulative hashes).
+    """
+    b = np.asarray(bits_host, np.uint32)
+    lin = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+    return [int(lin[i]) ^ zero_crc(s, length)
+            for i, s in enumerate(seeds)]
+
+
+def device_crc32c(streams, seeds=None) -> list[int]:
+    """crc32c over each row of a (B, L) uint8 batch, device-computed.
+
+    Bit-identical to ``crc32c(seed, row.tobytes())`` for every row.
+    ``seeds`` defaults to CRC_SEED (0xFFFFFFFF) for all rows.
+    """
+    B, L = int(streams.shape[0]), int(streams.shape[1])
+    if seeds is None:
+        seeds = [CRC_SEED] * B
+    bits = np.asarray(crc_bits_device(streams))
+    return finalize_crcs(bits, seeds, L)
+
+
+@functools.lru_cache(maxsize=1)
+def _verify_jit():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(recomputed, stored, mat):
+        # Parity verdict and CRC register bits in one jitted launch:
+        # the comparison is elementwise over the re-encoded batch, the
+        # checksum is the same bitplane contraction as encode.
+        eq = jnp.all(recomputed == stored, axis=-1)          # (B, n)
+        B, n, L = stored.shape
+        bits = engine.bitplane_apply(mat, stored.reshape(B * n, L, 1))
+        return eq, bits.reshape(B, n, 4)
+
+    return jax.jit(kernel)
+
+
+def verify_batch(recomputed, stored):
+    """Fused scrub verdict: (B, n) shard-equality bools + (B, n) crcs.
+
+    One device launch over a whole scrub group: compares re-encoded
+    shards against stored shards elementwise AND computes each stored
+    stream's CRC register via the same bitplane kernel.  Returns host
+    ``(eq (B, n) bool ndarray, crc_regs (B, n) uint32 ndarray)`` where
+    ``crc_regs`` are finalized with the standard seed (callers compare
+    against HashInfo cumulative hashes, which chain from CRC_SEED).
+    """
+    B, n, L = (int(stored.shape[0]), int(stored.shape[1]),
+               int(stored.shape[2]))
+    mat = _crc_bitmatrix_bf16(L)
+    eq, bits = _verify_jit()(recomputed, stored, mat)
+    eq = np.asarray(eq)
+    b = np.asarray(bits, np.uint32)
+    lin = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+    crcs = lin ^ np.uint32(zero_crc(CRC_SEED, L) & 0xFFFFFFFF)
+    return eq, crcs
+
+
+@functools.lru_cache(maxsize=1)
+def _parity_jit():
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(lambda a, b: jnp.all(a == b, axis=-1))
+
+
+def parity_only_batch(recomputed, stored):
+    """Device parity verdict without the CRC epilogue (stream length
+    beyond the device-CRC gate).  Returns host (B, n) bool ndarray."""
+    return np.asarray(_parity_jit()(recomputed, stored))

@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import bisect
 import json
+import random
 import time
 from collections import deque
 from typing import Mapping
@@ -313,6 +314,15 @@ class OSDDaemon:
         self.backfill_engine = BackfillEngine(
             self.repair, self.perf, store=self.store,
             journal=self.journal)
+        # third sibling: batched device scrub.  Sweeps PG object sets
+        # through ECBackend.scrub_batch in cursor-resumable chunks,
+        # paced as the mClock "scrub" class, pausing while the QoS
+        # plane reports the cluster burning SLO (osd/scrub.py)
+        from ceph_tpu.osd.scrub import ScrubEngine
+        self.scrub_engine = ScrubEngine(
+            self.repair, self.perf, store=self.store,
+            journal=self.journal, op_scheduler=self.op_scheduler,
+            use_mclock=self._use_mclock)
         # completed-op cache keyed by client reqid (the osd_reqid_t dedup
         # the reference keeps in the PG log): a client resend whose first
         # attempt executed but lost the reply gets the cached result
@@ -526,6 +536,23 @@ class OSDDaemon:
             },
         }
 
+    def _ec_scrub_stats(self) -> dict:
+        """Admin-socket ``ec scrub stats``: the batched integrity
+        engine's lifetime view — sweeps, objects verified, convictions,
+        repairs, cursor resumes, SLO preempts — plus the scrub mClock
+        class's dispatch count and the live pause state."""
+        from ceph_tpu.osd.scrub import SCRUB_COUNTERS
+        return {
+            "engine": self.scrub_engine.stats(),
+            "counters": {k: self.perf.value(k)
+                         for k in SCRUB_COUNTERS},
+            "mclock": {
+                "enabled": self._use_mclock,
+                "scrub_dispatched":
+                    self.op_scheduler.stats().get("scrub", 0),
+            },
+        }
+
     def _mclock_set(self, clazz: str = "", reservation=None,
                     weight=None, limit=None) -> dict:
         """Admin-socket ``mclock set``: runtime retune of one op
@@ -570,6 +597,16 @@ class OSDDaemon:
             ht = data["hedge_timeout"]
             out["hedge_timeout"] = self._apply_hedge_timeout(
                 float(ht) if ht else None)
+        if "slo_burning" in data:
+            # the controller's burn verdict doubles as the background-
+            # integrity gate: scrub pauses between batches while the
+            # cluster is burning SLO and resumes (cursor intact) when
+            # the storm passes
+            if bool(data["slo_burning"]):
+                self.scrub_engine.pause("slo")
+            else:
+                self.scrub_engine.resume("slo")
+            out["slo_burning"] = bool(data["slo_burning"])
         return out
 
     def _apply_hedge_timeout(self, timeout: float | None) -> float | None:
@@ -681,6 +718,10 @@ class OSDDaemon:
         sock.register("backfill stats", self._backfill_stats,
                       "planned-motion engine state (drains, cursor "
                       "resumes, reservation tables, mClock pacing)")
+        sock.register("ec scrub stats", self._ec_scrub_stats,
+                      "batched integrity engine state (sweeps, "
+                      "convictions, repairs, SLO preempts, mClock "
+                      "pacing)")
         sock.register("mclock set", self._mclock_set,
                       "retune one mClock class at runtime: "
                       "clazz=<name> [reservation=] [weight=] [limit=]")
@@ -1050,6 +1091,16 @@ class OSDDaemon:
                 conn.send_message(Message("backfill_stats_reply", {
                     "tid": msg.data.get("tid", 0),
                     **self._backfill_stats(),
+                }))
+            except ConnectionError:
+                pass
+        elif t == "ec_scrub_stats":
+            # the admin-socket `ec scrub stats` surface over the wire:
+            # drills and the scrub smoke poll sweep progress here
+            try:
+                conn.send_message(Message("ec_scrub_stats_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    **self._ec_scrub_stats(),
                 }))
             except ConnectionError:
                 pass
@@ -3199,6 +3250,41 @@ class OSDDaemon:
                  pg.pgid, len(details), len(names))
         return report
 
+    async def _scrub_pg_batched(self, pg: PG,
+                                repair: bool = True) -> dict:
+        """Deep-scrub an EC PG through the ScrubEngine's batched sweep:
+        one coalesced re-encode launch per shard-length group with the
+        CRC epilogue fused into the verify launch, convictions drained
+        through the batched repair path as the scrub mClock class.  The
+        background loop uses this; the ``pg_scrub`` wire command keeps
+        the per-object path, whose report carries full per-shard
+        attribution for operators."""
+        names = sorted(await self._scrub_names(pg))
+
+        async def fallback(name: str, shards: list[int]) -> bool:
+            # single-object convictions the batched drain demoted:
+            # per-object rebuild under the object lock, like pg_scrub
+            live = [s for s in shards if pg.acting[s] != NO_OSD]
+            if not live:
+                return False
+            async with pg.backend.object_lock(name):
+                await pg.backend.recover_shard(name, live)
+            return True
+
+        res = await self.scrub_engine.sweep_pg(
+            pg.backend, names,
+            epoch=(self.osdmap.epoch
+                   if self.osdmap is not None else 0),
+            pool=pg.pgid.pool, ps=pg.pgid.ps,
+            repair=repair, repair_fallback=fallback,
+        )
+        self.perf.inc("scrub_errors", res["errors"])
+        report = {"pgid": str(pg.pgid), **res}
+        pg.last_scrub = report
+        log.dout(5, "pg %s: batched scrub done, %d/%d inconsistent",
+                 pg.pgid, res["errors"], res["objects"])
+        return report
+
     async def _scrub_names(self, pg: PG) -> set[str]:
         """Union of object names across every acting member: an object
         missing on the primary must still be scrubbed (the reference
@@ -3229,14 +3315,16 @@ class OSDDaemon:
         except (KeyError, ShardReadError) as e:
             return {"object": name, "clean": False, "error": str(e)}
         if repair and not rep["clean"]:
-            # attribution: per-shard hinfo crcs (and stale versions)
-            # pinpoint the corrupt shard; a parity recompute mismatch
-            # alone cannot say WHICH shard rotted — a corrupt data
-            # shard makes every parity column disagree. With a crc/
-            # stale culprit, rebuild it; otherwise the data shards
-            # verified clean, so rebuild the disagreeing parity.
+            # attribution: per-shard hinfo crcs (and stale or missing
+            # shard copies) pinpoint the corrupt shard; a parity
+            # recompute mismatch alone cannot say WHICH shard rotted —
+            # a corrupt data shard makes every parity column disagree.
+            # With a crc/stale/missing culprit, rebuild it; otherwise
+            # the data shards verified clean, so rebuild the
+            # disagreeing parity.
             culprits = (set(rep.get("crc_mismatch", ()))
-                        | set(rep.get("stale_version", ())))
+                        | set(rep.get("stale_version", ()))
+                        | set(rep.get("missing_shards", ())))
             if culprits:
                 bad = sorted(culprits)
             elif rep.get("hinfo"):
@@ -3382,16 +3470,26 @@ class OSDDaemon:
 
     async def _scrub_loop(self) -> None:
         """Background scrubbing (osd_scrub_interval > 0): round-robin
-        one active primary PG per tick."""
+        one active primary PG per tick.  Ticks are jittered by a
+        per-OSD seeded rng (``osd_scrub_jitter``) so a fleet started
+        together does not deep-scrub in lockstep, and the loop sits
+        out whole ticks while the ScrubEngine is paused (SLO burning
+        per mgr_qos, or admin) — an interrupted sweep's persisted
+        cursor holds its place, so waiting loses nothing."""
         interval = self.conf["osd_scrub_interval"]
+        jitter = float(self.conf["osd_scrub_jitter"])
+        rng = random.Random(f"scrub-jitter:{self.osd_id}")
         cursor = 0
         while not self._stopped:
             try:
-                await asyncio.sleep(interval)
+                await asyncio.sleep(
+                    interval * (1.0 + jitter * rng.random()))
             except asyncio.CancelledError:
                 return
             if self.osdmap is not None \
                     and "noscrub" in self.osdmap.flags:
+                continue
+            if self.scrub_engine.paused:
                 continue
             ready = [pg for pg in self.pgs.values()
                      if pg.is_primary and pg.state == STATE_ACTIVE]
@@ -3400,7 +3498,10 @@ class OSDDaemon:
             pg = ready[cursor % len(ready)]
             cursor += 1
             try:
-                await self._scrub_pg(pg)
+                if pg.is_ec:
+                    await self._scrub_pg_batched(pg)
+                else:
+                    await self._scrub_pg(pg)
             except asyncio.CancelledError:
                 return
             except Exception as e:              # noqa: BLE001

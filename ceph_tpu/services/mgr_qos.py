@@ -7,7 +7,11 @@ computed from (:meth:`SLOEngine.window`), and drives the
 :class:`ceph_tpu.common.qos.QoSController` tick:
 
 - an ``mclock`` retune decision fans a ``qos_set`` wire cmd to every
-  up OSD, shrinking/restoring the recovery class's reservation+limit,
+  up OSD, shrinking/restoring the recovery / backfill / scrub class
+  reservations+limits (three AIMD positions off one burn signal),
+- burning-flag TRANSITIONS fan out as ``slo_burning`` in the same
+  ``qos_set`` payloads: each OSD's ScrubEngine parks its in-flight
+  sweep (cursor held) while the cluster burns and resumes on clear,
 - per-OSD adaptive hedge timeouts push to exactly the OSDs whose
   shard-read tail moved,
 - every decision journals a ``qos.retune`` / ``qos.hedge_push`` event
@@ -39,6 +43,7 @@ class QoSMonitor(MgrModule):
         self.controller: QoSController | None = None
         self.last_tick: dict = {}
         self._pushed_limit: float | None = None
+        self._pushed_burning = False
 
     def _enabled(self) -> bool:
         return bool(self.mgr.conf["qos_enable"])
@@ -67,7 +72,7 @@ class QoSMonitor(MgrModule):
               (osdmap.osds.items() if osdmap else ())
               if info.up}
 
-        for clazz in ("recovery", "backfill"):
+        for clazz in ("recovery", "backfill", "scrub"):
             dec = out.get(clazz)
             if not dec or not dec["changed"]:
                 continue
@@ -97,6 +102,22 @@ class QoSMonitor(MgrModule):
             payloads.setdefault(osd, {})["hedge_timeout"] = timeout
             jr.emit("qos.hedge_push", daemon=str(daemon),
                     timeout_ms=round(timeout * 1e3, 3))
+
+        # the scrub pause gate: the daemons park in-flight sweeps
+        # while the cluster burns SLO, so a burning-flag TRANSITION
+        # must reach every up OSD even when no mClock class retuned
+        # this tick — and any payload already going out carries the
+        # current flag so a restarted OSD re-learns it for free
+        burning = bool(out["burning"])
+        if burning != self._pushed_burning:
+            self._pushed_burning = burning
+            jr.emit("qos.scrub_gate",
+                    action="pause" if burning else "resume",
+                    burn=round(out["burn"], 3))
+            for osd in up:
+                payloads.setdefault(osd, {})
+        for data in payloads.values():
+            data["slo_burning"] = burning
 
         if payloads:
             await asyncio.gather(*(
@@ -163,6 +184,15 @@ class QoSMonitor(MgrModule):
                         "floors; planned motion has no rebuild-GiB "
                         "term)",
                 "samples": [("", float(st["backfill_floor"]))]},
+            "ceph_qos_scrub_limit": {
+                "help": "controller-set scrub-class mClock limit "
+                        "ops/s (integrity-verification AIMD position)",
+                "samples": [("", float(st["scrub_limit"]))]},
+            "ceph_qos_scrub_floor": {
+                "help": "scrub pacing floor ops/s (share/ops floors; "
+                        "verification of fully-redundant data is "
+                        "squeezed hardest under client burn)",
+                "samples": [("", float(st["scrub_floor"]))]},
             "ceph_qos_retunes": {
                 "help": "cumulative mClock retune decisions",
                 "samples": [("", float(st["retunes"]))]},

@@ -11,6 +11,7 @@ import asyncio
 import threading
 from dataclasses import dataclass, field
 
+from ceph_tpu.common import failpoint as fp
 from ceph_tpu.store.object_store import ObjectStore, Transaction
 from ceph_tpu.store.types import CollectionId, GHObject
 
@@ -166,6 +167,39 @@ class MemStore(ObjectStore):
             self._objs[dst.key()] = dst
         else:
             raise ValueError(f"unknown op {name!r}")
+
+    # -- fault injection -------------------------------------------------
+    def corrupt_shard(self, cid: CollectionId, oid: GHObject,
+                      offset: int | None = None,
+                      mask: int | None = None) -> dict | None:
+        """Flip one bit of the stored object bytes AT REST — silent
+        corruption below every checksum and version check, visible only
+        to deep scrub.  Gated on the ``store.corrupt_shard`` failpoint:
+        returns None while the point is not armed, so chaos drills can
+        bound injections with ``count=`` and keep production paths
+        inert.  Offset/mask default to the failpoint's seeded rng
+        (deterministic under failpoint.set_seed), so the same drill
+        seed rots the same bit.  Returns the flip detail for the
+        drill's ledger."""
+        if not fp.ACTIVE:
+            return None
+        try:
+            fp.fire_sync("store.corrupt_shard")
+        except fp.FailPointError:
+            pass          # armed (error/prob mode): this call injects
+        else:
+            return None   # point off / delay-only: leave bytes alone
+        with self._lock:
+            obj = self._get(cid, oid)
+            if not obj.data:
+                return None
+            rng = fp.fp_get("store.corrupt_shard").rng
+            off = rng.randrange(len(obj.data)) if offset is None \
+                else int(offset) % len(obj.data)
+            bit = mask if mask is not None else (1 << rng.randrange(8))
+            obj.data[off] ^= bit
+        return {"oid": oid.name, "cid": str(cid), "offset": off,
+                "mask": int(bit)}
 
     # -- reads -----------------------------------------------------------
     def read(self, cid, oid, offset=0, length=None) -> bytes:

@@ -12,10 +12,12 @@ from ceph_tpu.testing.chaos import (
     run_expansion_drill,
     run_host_failure_drill,
     run_rolling_restart_drill,
+    run_silent_corruption_drill,
 )
 from ceph_tpu.testing.rados_model import RadosModel
 from ceph_tpu.testing.thrasher import Thrasher
 
 __all__ = ["ChaosHarness", "RadosModel", "Thrasher", "run_chaos",
            "run_drain_drill", "run_expansion_drill",
-           "run_host_failure_drill", "run_rolling_restart_drill"]
+           "run_host_failure_drill", "run_rolling_restart_drill",
+           "run_silent_corruption_drill"]

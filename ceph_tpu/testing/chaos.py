@@ -846,3 +846,202 @@ async def run_rolling_restart_drill(seed: int = 0, hosts: int = 3,
     finally:
         await rados.shutdown()
         await cluster.stop()
+
+
+async def run_silent_corruption_drill(seed: int = 0, n_osds: int = 4,
+                                      n_objects: int = 48,
+                                      obj_size: int = 4096,
+                                      n_victims: int = 6,
+                                      p99_slo_ms: float = 2000.0,
+                                      overrides: dict | None = None
+                                      ) -> dict:
+    """Seeded silent-corruption storm graded by the integrity plane.
+
+    Rots ``n_victims`` shard copies AT REST — one bit each, below
+    every version check and replica digest, via the
+    ``store.corrupt_shard`` failpoint (offsets/masks from the seeded
+    failpoint rng, so the same seed rots the same bits) — then runs
+    ONE batched deep-scrub sweep over every primary EC PG and asserts
+    the plane's whole contract at once:
+
+    - **every rot caught in one sweep** — each injected (object,
+      shard) appears convicted in the sweep reports, attributed by
+      the fused CRC epilogue / device parity compare;
+    - **zero false positives** — no clean object is flagged;
+    - **bit-identical repair** — convictions drain through the scrub
+      repair path, every victim reads back byte-identical, and a
+      SECOND sweep reports zero errors;
+    - **client p99 bounded** — a read loop serves through injection,
+      sweep, and repair, and its p99 stays under ``p99_slo_ms``;
+
+    plus determinism: the returned injection ledger and caught set are
+    pure functions of the seed (tests run the drill twice and diff).
+
+    The resident device cache of each victim object is dropped after
+    injection: a warm cache legitimately serves version-matched clean
+    entries to deep scrub (that is the satellite-1 guarantee — the
+    device copy IS verified, h2d-free), so at-rest rot only becomes
+    visible to a sweep after eviction/restart.  The drop models that
+    aging without waiting for it.
+    """
+    import numpy as np
+
+    from ceph_tpu.osd.pg import object_to_ps
+    from ceph_tpu.store.types import CollectionId, GHObject
+
+    rng = np.random.default_rng(seed)
+    cluster, rados, io = await _make_ec_cluster(n_osds, "rot",
+                                                overrides=overrides)
+    out: dict = {"seed": seed, "osds": n_osds, "objects": n_objects}
+    loop = asyncio.get_running_loop()
+    try:
+        datas = {f"obj-{i}": rng.integers(0, 256, obj_size,
+                                          np.uint8).tobytes()
+                 for i in range(n_objects)}
+        await asyncio.gather(*(
+            io.write_full(o, d) for o, d in datas.items()))
+        await cluster.wait_health_ok(timeout=30)
+
+        m = rados.monc.osdmap
+        pid = next(p.pool_id for p in m.pools.values()
+                   if p.name == "rot")
+        pg_num = m.pools[pid].pg_num
+
+        def primary_pg(ps: int):
+            for osd in cluster.osds.values():
+                for pg in osd.pgs.values():
+                    if pg.pgid.pool == pid and pg.pgid.ps == ps \
+                            and pg.is_primary:
+                        return osd, pg
+            raise KeyError(f"no primary for pg {pid}.{ps}")
+
+        # seeded injection: distinct victim objects, one shard each,
+        # bit offset/mask drawn from the failpoint's own seeded rng
+        fp.set_seed(seed)
+        victims = sorted(str(v) for v in rng.choice(
+            sorted(datas), size=n_victims, replace=False))
+        fp.fp_set("store.corrupt_shard", "error", count=n_victims)
+        ledger: list[dict] = []
+        for name in victims:
+            ps = object_to_ps(name, pg_num)
+            osd, pg = primary_pg(ps)
+            shard = int(rng.integers(0, len(pg.acting)))
+            holder = cluster.osds[pg.acting[shard]]
+            flip = holder.store.corrupt_shard(
+                CollectionId(pid, ps, shard),
+                GHObject(pid, name, shard=shard))
+            assert flip is not None, \
+                f"injection refused on {name} shard {shard}"
+            ledger.append({"object": name, "ps": ps, "shard": shard,
+                           "osd": int(pg.acting[shard]), **flip})
+            # model cache aging: a warm resident entry would (by
+            # design) satisfy deep scrub from the verified device
+            # copy — evict so the sweep reads the rotted bytes
+            be = pg.backend
+            if be is not None and be.resident is not None:
+                be.resident.drop_object(be.resident_ns, name)
+        out["injections"] = ledger
+        events.emit_proc("drill.silent_corruption", seed=seed,
+                         victims=victims)
+
+        # serving load: reads stream through the sweep and the repair
+        lat: list[float] = []
+        stop = asyncio.Event()
+        names = sorted(datas)
+
+        async def serve(worker: int) -> None:
+            i = worker
+            while not stop.is_set():
+                o = names[i % len(names)]
+                i += 3
+                t = loop.time()
+                await io.read(o)
+                lat.append(loop.time() - t)
+                await asyncio.sleep(0.005)
+
+        servers = [loop.create_task(serve(w)) for w in range(2)]
+
+        launches0 = _summed(cluster, "ec_scrub_launches")
+        objects0 = _summed(cluster, "ec_scrub_objects")
+
+        async def sweep() -> list[dict]:
+            """One full pass: every primary EC PG of the pool,
+            batched."""
+            details: list[dict] = []
+            for osd in cluster.osds.values():
+                for pg in list(osd.pgs.values()):
+                    if pg.pgid.pool != pid or not pg.is_primary \
+                            or not pg.is_ec:
+                        continue
+                    rep = await osd._scrub_pg_batched(pg)
+                    details.extend(rep.get("inconsistent", ()))
+            return details
+
+        t0 = loop.time()
+        details = await sweep()
+        sweep_s = loop.time() - t0
+        stop.set()
+        await asyncio.gather(*servers)
+
+        flagged = {d["object"] for d in details}
+        false_pos = sorted(flagged - set(victims))
+        missed = sorted(set(victims) - flagged)
+        assert not missed, f"sweep missed injected rot: {missed}"
+        assert not false_pos, f"false positives: {false_pos}"
+        by_obj = {d["object"]: d for d in details}
+        for inj in ledger:
+            d = by_obj[inj["object"]]
+            convicted = (set(d.get("crc_mismatch", ()))
+                         | set(d.get("parity_inconsistent", ()))
+                         | set(d.get("stale_version", ()))
+                         | set(d.get("missing_shards", ())))
+            assert inj["shard"] in convicted, (
+                f"{inj['object']}: rotted shard {inj['shard']} not "
+                f"in convicted set {sorted(convicted)}")
+            assert d.get("repaired"), \
+                f"{inj['object']}: conviction not repaired in-sweep"
+
+        # bit-identical repair: client reads match the originals AND
+        # a second sweep over the same PGs comes back spotless
+        for o, dta in datas.items():
+            assert await io.read(o) == dta, \
+                f"post-repair read mismatch on {o}"
+        recheck = await sweep()
+        assert not recheck, \
+            f"second sweep still inconsistent: {recheck}"
+
+        out["scrub"] = {
+            "caught": len(flagged),
+            "launches": int(_summed(cluster, "ec_scrub_launches")
+                            - launches0),
+            "objects_verified": int(
+                _summed(cluster, "ec_scrub_objects") - objects0),
+            "sweep_s": round(sweep_s, 3),
+        }
+        out["engine"] = {
+            f"osd.{i}": o.scrub_engine.stats()
+            for i, o in sorted(cluster.osds.items())}
+
+        lat.sort()
+        p99_ms = (lat[min(len(lat) - 1, int(0.99 * (len(lat) - 1)))]
+                  * 1000.0) if lat else 0.0
+        out["slo"] = {
+            "injected": n_victims,
+            "caught": len(flagged),
+            "false_positives": len(false_pos),
+            "repaired": len(flagged),
+            "client_reads": len(lat),
+            "client_p99_ms": round(p99_ms, 3),
+            "pass": bool(not missed and not false_pos
+                         and p99_ms <= p99_slo_ms),
+        }
+        assert out["slo"]["pass"], out["slo"]
+        out["forensics"] = await _forensic_bundle(
+            cluster, "drill:silent_corruption",
+            detail={"seed": seed, "slo": out["slo"],
+                    "injections": ledger})
+        return out
+    finally:
+        fp.fp_clear()
+        await rados.shutdown()
+        await cluster.stop()

@@ -1,0 +1,110 @@
+"""Device CRC32C (ec/checksum.py): the GF(2) bitmatrix contraction
+must be BIT-IDENTICAL to the host table loop (common/crc32c.py) —
+including seed chaining, the fused verify launch, and the length gate
+that routes oversized streams back to the host path."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.crc32c import crc32c
+from ceph_tpu.ec import checksum as cs
+from ceph_tpu.osd.ec_util import HashInfo
+
+LENGTHS = [1, 3, 17, 64, 255, 256, 1024, 4096]
+
+
+def _corpus(lengths, rows, seed=0):
+    rng = np.random.default_rng(seed)
+    return {L: rng.integers(0, 256, (rows, L), np.uint8)
+            for L in lengths}
+
+
+# -- corpus identity --------------------------------------------------------
+
+
+@pytest.mark.parametrize("length", LENGTHS)
+def test_device_crc_matches_host_oracle(length):
+    streams = _corpus([length], 5, seed=length)[length]
+    got = cs.device_crc32c(streams)
+    want = [crc32c(cs.CRC_SEED, row.tobytes()) for row in streams]
+    assert got == want
+
+
+def test_device_crc_chained_seeds_match_hashinfo_append():
+    """Seed chaining: the cumulative per-shard hash after an append is
+    crc32c(prev_hash, new_chunk) — the device path must reproduce the
+    exact HashInfo.append sequence, chunk by chunk."""
+    rng = np.random.default_rng(7)
+    n, L = 4, 512
+    chunks = [rng.integers(0, 256, (n, L), np.uint8)
+              for _ in range(3)]
+    hinfo = HashInfo(n)
+    seeds = [cs.CRC_SEED] * n
+    for j, batch in enumerate(chunks):
+        hinfo.append(j * L, [batch[i].tobytes() for i in range(n)])
+        seeds = cs.device_crc32c(batch, seeds=seeds)
+    assert seeds == list(hinfo.cumulative_shard_hashes)
+
+
+def test_zero_crc_is_the_affine_seed_term():
+    for seed in (cs.CRC_SEED, 0, 0xDEADBEEF):
+        for L in (1, 64, 1000):
+            assert cs.zero_crc(seed, L) == crc32c(seed, b"\x00" * L)
+
+
+def test_crc_bitmatrix_is_linear_and_cached():
+    """M(a ^ b) == M(a) ^ M(b): the whole construction stands on GF(2)
+    linearity, so a direct superposition check pins the matrix."""
+    L = 96
+    rng = np.random.default_rng(3)
+    a, b = (rng.integers(0, 256, (1, L), np.uint8) for _ in range(2))
+    lin = {}
+    for key, s in (("a", a), ("b", b), ("ab", a ^ b)):
+        bits = np.asarray(cs.crc_bits_device(s), np.uint32)
+        lin[key] = int(bits[0, 0] | bits[0, 1] << 8
+                       | bits[0, 2] << 16 | bits[0, 3] << 24)
+    assert lin["ab"] == lin["a"] ^ lin["b"]
+    assert cs.crc_bitmatrix(L) is cs.crc_bitmatrix(L)   # lru cached
+
+
+# -- the fused verify launch ------------------------------------------------
+
+
+def test_verify_batch_fused_eq_and_crc():
+    rng = np.random.default_rng(11)
+    B, n, L = 3, 4, 256
+    stored = rng.integers(0, 256, (B, n, L), np.uint8)
+    recomputed = stored.copy()
+    recomputed[1, 2, 17] ^= 0x40         # one shard disagrees
+    eq, crcs = cs.verify_batch(recomputed, stored)
+    want_eq = np.ones((B, n), bool)
+    want_eq[1, 2] = False
+    assert np.array_equal(eq, want_eq)
+    for b in range(B):
+        for i in range(n):
+            assert int(crcs[b, i]) == crc32c(
+                cs.CRC_SEED, stored[b, i].tobytes())
+
+
+def test_parity_only_batch_beyond_gate():
+    rng = np.random.default_rng(13)
+    stored = rng.integers(0, 256, (2, 3, 128), np.uint8)
+    recomputed = stored.copy()
+    recomputed[0, 1, 5] ^= 1
+    eq = cs.parity_only_batch(recomputed, stored)
+    assert not bool(eq[0, 1]) and bool(eq[1, 1]) and bool(eq[0, 0])
+
+
+# -- the length gate --------------------------------------------------------
+
+
+def test_supported_len_gate():
+    assert cs.supported_len(1)
+    assert cs.supported_len(cs.CRC_DEVICE_MAX_LEN)
+    assert not cs.supported_len(0)
+    assert not cs.supported_len(-4)
+    assert not cs.supported_len(cs.CRC_DEVICE_MAX_LEN + 1)
+    # an explicit cap can widen the gate but never past the f32
+    # exactness bound (8L < 2^24)
+    assert cs.supported_len(1 << 20, max_len=1 << 22)
+    assert not cs.supported_len(1 << 21, max_len=1 << 30)

@@ -1,0 +1,59 @@
+"""Seeded silent-corruption drill: the integrity plane graded end to
+end — every injected rot caught in one batched sweep, zero false
+positives, bit-identical repair, bounded client p99, and a seed-
+deterministic injection ledger."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import reset_local_namespace
+from ceph_tpu.testing.chaos import run_silent_corruption_drill
+
+
+@pytest.fixture(autouse=True)
+def _clean_local():
+    reset_local_namespace()
+    yield
+    reset_local_namespace()
+
+
+def test_silent_corruption_drill_catches_and_repairs():
+    out = asyncio.run(run_silent_corruption_drill(
+        seed=7, n_objects=32, n_victims=4))
+    assert out["slo"]["pass"], out["slo"]
+    # caught == injected with zero false positives is asserted inside
+    # the drill; re-pin the shape here so a weakened drill fails loudly
+    assert out["slo"]["caught"] == out["slo"]["injected"] == 4
+    assert out["slo"]["false_positives"] == 0
+    assert out["slo"]["repaired"] == 4
+    assert out["slo"]["client_reads"] > 0
+    assert len(out["injections"]) == 4
+    for inj in out["injections"]:
+        assert {"object", "ps", "shard", "osd", "offset",
+                "mask"} <= set(inj)
+    # the sweep verified every object of the pool, batched
+    assert out["scrub"]["objects_verified"] >= 32
+    assert out["scrub"]["launches"] > 0
+
+
+@pytest.mark.slow
+def test_silent_corruption_drill_same_seed_same_storm():
+    """Same seed => same victims, same bits, same convictions: the
+    drill is a pure function of its seed (failpoint rng + np rng)."""
+
+    def ledger_key(out):
+        return [(i["object"], i["shard"], i["offset"], i["mask"])
+                for i in out["injections"]]
+
+    async def twice():
+        r1 = await run_silent_corruption_drill(
+            seed=3, n_objects=24, n_victims=3)
+        reset_local_namespace()
+        r2 = await run_silent_corruption_drill(
+            seed=3, n_objects=24, n_victims=3)
+        return r1, r2
+
+    r1, r2 = asyncio.run(twice())
+    assert ledger_key(r1) == ledger_key(r2)
+    assert r1["slo"]["caught"] == r2["slo"]["caught"] == 3
