@@ -1565,6 +1565,233 @@ def _cfg14_main() -> None:
     print(json.dumps(record), flush=True)
 
 
+def _cfg15_resync(seed: int = 0, defend: bool = False,
+                  n_objects: int = 240, obj_size: int = 1 << 17,
+                  clients: int = 4, max_window_s: float = 150.0) -> dict:
+    """cfg15 single arm: cold-zone resync as a QoS class (PR-18).
+
+    Two-zone MultisiteRealm; zone B is partitioned while ``n_objects``
+    seeded payloads land on master zone A, then B's gateway handle is
+    re-spliced so a fresh sync agent full-syncs the whole backlog FROM
+    A while a closed-loop client GET stream hits A.  The replication
+    reads and the client reads share A's OSD queues (and the one event
+    loop), so an unpaced resync burns the client get tail.
+
+    ``defend=True`` arms ``qos_enable`` on zone A's mgr — the SOURCE
+    zone owns the replication decision because its clients are the
+    ones burning — and attaches B's orchestrator to A's multisite
+    module, which pushes the controller's replication-class rate to
+    the agent actually doing the pull (``qos.replication_push``
+    journal entries are the actuation proof).  The class is floored,
+    so the arm gate requires CONVERGENCE (lag drained to zero,
+    bit-identical read-back on B), not just a quiet client tail."""
+    import asyncio
+    import random
+
+    async def run() -> dict:
+        from ceph_tpu.msg import reset_local_namespace
+        from ceph_tpu.vstart import MultisiteRealm
+
+        reset_local_namespace()
+        overrides = {
+            "rgw_datalog_shards": 4,
+            "mon_osd_down_out_interval": 300.0,
+            "slo_put_p99_ms": 600.0, "slo_get_p999_ms": 20.0,
+            "slo_error_rate": 0.01, "slo_rebuild_floor_gibs": 5e-5,
+            "slo_window": 30.0,
+            "slo_raise_evals": 1, "slo_clear_evals": 1,
+        }
+        if defend:
+            overrides.update({
+                "qos_enable": True,
+                "qos_replication_max_ops": 12.0,
+                "qos_replication_min_ops": 4.0,
+            })
+        realm = MultisiteRealm(
+            ("a", "b"), n_osds=3, overrides=overrides,
+            agent_kwargs={"poll_interval": 0.05, "seed": seed})
+        await realm.start()
+        loop = asyncio.get_running_loop()
+        try:
+            gw_a = realm.zones["a"]["gw"]
+            gw_b = realm.zones["b"]["gw"]
+            orch_b = realm.zones["b"]["orch"]
+
+            # partition B while the backlog lands on A (the cold-zone
+            # premise: B must later pull EVERYTHING as one full sync).
+            # The orchestrator plans its agent asynchronously — wait
+            # for it, or the "partition" snapshots an empty dict and
+            # the agent spawns live moments later
+            while not orch_b.agents:
+                await asyncio.sleep(0.02)
+            parted = dict(orch_b.agents)
+            orch_b.agents.clear()
+            for agent in parted.values():
+                await agent.stop()
+
+            rng = random.Random(f"cfg15:{seed}")
+            bucket = "bench"
+            await gw_a.create_bucket(bucket)
+            payloads: dict[str, bytes] = {}
+            for i in range(n_objects):
+                key = f"obj-{i:04d}"
+                payloads[key] = rng.randbytes(obj_size)
+                await gw_a.put_object(bucket, key, payloads[key])
+
+            # mgr started AFTER seeding so the SLO window judges the
+            # measurement phase, not the bulk load
+            mgr_a = await realm.zones["a"]["cluster"].start_mgr(
+                report_interval=0.2)
+            mgr_a.modules["multisite"].attach(orch_b)
+
+            keys = sorted(payloads)
+            lats: list[float] = []
+            stop = asyncio.Event()
+
+            async def client(i: int) -> None:
+                crng = random.Random(f"cfg15:{seed}:client:{i}")
+                while not stop.is_set():
+                    key = keys[crng.randrange(len(keys))]
+                    t0 = loop.time()
+                    await gw_a.get_object(bucket, key)
+                    lats.append((loop.time() - t0) * 1e3)
+
+            tasks = [asyncio.ensure_future(client(i))
+                     for i in range(clients)]
+            # rejoin: the handle splice forces a replan, the fresh
+            # agent full-syncs the whole backlog under the client load
+            t0 = loop.time()
+            await orch_b.set_gateway("a", realm.zones["a"]["gw"])
+
+            async def resynced() -> bool:
+                ag = orch_b.agents.get(("a", "b"))
+                if ag is None or ag.perf.value("sync_full_passes") < 1:
+                    return False
+                led = await ag.lag()
+                return led["entries"] == 0 and led["bytes"] == 0
+
+            while not await resynced():
+                assert loop.time() - t0 < max_window_s, "resync stall"
+                await asyncio.sleep(0.1)
+            resync_s = loop.time() - t0
+            stop.set()
+            await asyncio.gather(*tasks)
+
+            # convergence gate: B serves every byte A holds
+            for key, want in payloads.items():
+                got = (await gw_b.get_object(bucket, key))["data"]
+                assert got == want, key
+
+            lats.sort()
+
+            def pct(q: float) -> float:
+                return lats[int(q * (len(lats) - 1))] if lats else 0.0
+
+            ag = orch_b.agents.get(("a", "b"))
+            digest = mgr_a.last_digest or {}
+            get_obj = next(
+                (o for o in digest.get("slo", {}).get("objectives", [])
+                 if o.get("objective") == "get_p999_ms"), {})
+            events = [
+                {"type": e["type"], **(e.get("fields") or {})}
+                for e in mgr_a.journal.snapshot()
+                if str(e["type"]) == "qos.replication_push"
+                or (str(e["type"]) == "qos.retune"
+                    and (e.get("fields") or {}).get("clazz")
+                    == "replication")]
+            return {
+                "seed": seed, "defend": defend,
+                "objects": n_objects, "obj_size": obj_size,
+                "resync_s": round(resync_s, 3),
+                "client_ops": len(lats),
+                "get_p50_ms": round(pct(0.5), 3),
+                "get_p99_ms": round(pct(0.99), 3),
+                "get_p999_ms": round(pct(0.999), 3),
+                # the mgr SLO engine's own windowed view of the same
+                # interference (OSD-side, thousands of samples — the
+                # stable A/B statistic; the client percentiles above
+                # are top-of-tail and noisy run to run)
+                "slo_get_p999": {
+                    "value_ms": round(float(get_obj.get("value", 0.0)),
+                                      3),
+                    "burn": round(float(get_obj.get("burn_rate", 0.0)),
+                                  3),
+                    "ok": bool(get_obj.get("ok", False)),
+                },
+                "sync": {
+                    "bytes": ag.perf.value("sync_bytes"),
+                    "put_ops": ag.perf.value("sync_put_ops"),
+                    "paced_waits": ag.perf.value("sync_paced_waits"),
+                },
+                "mgr": {"slo": digest.get("slo", {}),
+                        "qos": digest.get("qos", {}),
+                        "pushed_rate": digest.get(
+                            "multisite", {}).get("pushed_rate"),
+                        "events": events},
+                "converged": True,
+            }
+        finally:
+            await realm.stop()
+
+    return asyncio.run(run())
+
+
+def _cfg15_main() -> None:
+    """Standalone cfg15 entry
+    (``python bench.py --cfg15 [--seed N] [--defend on|off|ab]``):
+    CPU-sufficient — pacing, lag accounting, and convergence are exact
+    on any backend; on-chip the replicated payloads additionally flow
+    through real device checksum launches.  Default (and ``--defend
+    ab``) runs the QoS off/on pair at one seed and appends ONE paired
+    record: value is the get_p999 SLO burn ratio (unpaced resync over
+    paced resync, from the mgr's own windowed objective — the stable
+    statistic; client-sampled percentiles ride along in extra),
+    vs_baseline proves both arms converged to lag zero with
+    bit-identical read-back while the defended arm actually actuated
+    (at least one ``qos.replication_push``) and held the objective
+    the unpaced arm burns."""
+    seed = 0
+    argv = sys.argv[1:]
+    if "--seed" in argv:
+        seed = int(argv[argv.index("--seed") + 1])
+    defend = "ab"
+    if "--defend" in argv:
+        defend = argv[argv.index("--defend") + 1]
+        if defend not in ("on", "off", "ab"):
+            raise SystemExit(f"--defend {defend!r}: want on|off|ab")
+
+    if defend == "ab":
+        off = _cfg15_resync(seed=seed, defend=False)
+        on = _cfg15_resync(seed=seed, defend=True)
+        pushes = [e for e in on["mgr"]["events"]
+                  if e["type"] == "qos.replication_push"]
+        burn_off = off["slo_get_p999"]["burn"]
+        burn_on = on["slo_get_p999"]["burn"]
+        ok = (off["converged"] and on["converged"]
+              and len(pushes) >= 1
+              and burn_on < 1.0            # defended arm holds the SLO
+              and burn_off > burn_on)      # ...which the unpaced burns
+        record = {
+            "metric": "multisite_resync_qos_ab",
+            "value": round(burn_off / max(burn_on, 0.01), 3),
+            "unit": "x get_p999 burn shed by pacing the resync "
+                    "(defend off/on, both converged to lag 0)",
+            "vs_baseline": float(ok),
+            "extra": {"seed": seed, "off": off, "on": on},
+        }
+    else:
+        out = _cfg15_resync(seed=seed, defend=(defend == "on"))
+        record = {
+            "metric": f"multisite_resync_qos_defend_{defend}",
+            "value": out["get_p999_ms"],
+            "unit": "ms client get p999 during cold-zone resync",
+            "vs_baseline": float(out["converged"]),
+            "extra": out,
+        }
+    _append_local_record(record)
+    print(json.dumps(record), flush=True)
+
+
 def _append_local_record(record: dict) -> None:
     """Append a successful run to BENCH_LOCAL.jsonl (the auditable local
     trail; PERF.md explains the protocol)."""
@@ -1709,6 +1936,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--cfg14" in sys.argv[1:]:
         _cfg14_main()
+        sys.exit(0)
+    if "--cfg15" in sys.argv[1:]:
+        _cfg15_main()
         sys.exit(0)
     try:
         main()

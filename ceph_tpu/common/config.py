@@ -409,6 +409,23 @@ def global_options() -> list[Option]:
                "fully-redundant data, so of the three background "
                "classes it is squeezed hardest when clients burn)",
                Level.ADVANCED, min=0.0, max=1.0),
+        Option("qos_replication_max_ops", float, 64.0,
+               "multisite replication-class pacing ceiling in sync "
+               "ops/s the controller ramps back to when client SLOs "
+               "are healthy (the fourth AIMD position; 0 pushed to an "
+               "agent means unlimited, the controller never pushes 0)",
+               Level.ADVANCED, min=1.0),
+        Option("qos_replication_min_ops", float, 2.0,
+               "absolute floor for the replication-class pacing rate: "
+               "backoff never parks geo-replication below this pace — "
+               "this floor is the knob bounding how fast RPO may grow "
+               "while clients burn", Level.ADVANCED, min=0.1),
+        Option("qos_replication_min_share", float, 0.05,
+               "replication pacing floor as a fraction of "
+               "qos_replication_max_ops (combined with the ops floor "
+               "via max; unlike scrub, replication protects "
+               "not-yet-redundant bytes, so its floor sits above the "
+               "scrub share)", Level.ADVANCED, min=0.0, max=1.0),
         Option("qos_hedge_quantile", float, 0.95,
                "derive each OSD's EC hedge-read timeout from this "
                "quantile of its windowed shard-read latency histogram "
@@ -440,6 +457,12 @@ def global_options() -> list[Option]:
         Option("rgw_retry_after_s", float, 1.0,
                "Retry-After header value (seconds) on 503 Slow Down "
                "responses", Level.ADVANCED, min=0.0),
+        Option("rgw_datalog_shards", int, 1,
+               "number of bucket-datalog shards per bucket: mutations "
+               "hash by object key onto a shard log, multisite sync "
+               "agents keep one replication cursor per shard so replay "
+               "and trim parallelise (1 = single legacy log object)",
+               min=1, max=4096),
         Option("rgw_gc_obj_min_wait", float, 0.0,
                "defer RGW data-object deletion this many seconds "
                "(rgw_gc_obj_min_wait): >0 routes overwrites through "

@@ -171,7 +171,10 @@ class QoSController:
                  scrub_res: float = 1.0,
                  scrub_max_ops: float = 64.0,
                  scrub_min_ops: float = 1.0,
-                 scrub_min_share: float = 0.01):
+                 scrub_min_share: float = 0.01,
+                 replication_max_ops: float = 64.0,
+                 replication_min_ops: float = 2.0,
+                 replication_min_share: float = 0.05):
         # the pacing floor: never throttle recovery below the largest
         # of (absolute ops floor, share-of-ceiling floor, the ops rate
         # that sustains slo_rebuild_floor_gibs at the assumed GiB/op)
@@ -209,6 +212,20 @@ class QoSController:
             ceiling=scrub_max_ops, backoff=backoff, ramp=ramp_ops,
             raise_evals=raise_evals, clear_evals=clear_evals)
         self.scrub_res = float(scrub_res)
+        # geo-replication (multisite sync throughput) is the FOURTH
+        # AIMD position: it is not an mClock class — the decision is
+        # actuated as a token-bucket rate on the secondary's sync
+        # agents — but it rides the same burn signal and hysteresis.
+        # Its floor IS the RPO bound: however hard clients burn, the
+        # replication backlog drains at least this fast, so
+        # unreplicated bytes cannot grow without limit.
+        rp_floor = max(replication_min_ops,
+                       replication_min_share * replication_max_ops)
+        self.replication = AIMDController(
+            initial=replication_max_ops, floor=rp_floor,
+            ceiling=replication_max_ops, backoff=backoff,
+            ramp=ramp_ops, raise_evals=raise_evals,
+            clear_evals=clear_evals)
         self.hedge_quantile = float(hedge_quantile)
         self.hedge_min_s = float(hedge_min_s)
         self.hedge_max_s = float(hedge_max_s)
@@ -242,6 +259,12 @@ class QoSController:
             scrub_max_ops=float(conf["qos_scrub_max_ops"]),
             scrub_min_ops=float(conf["qos_scrub_min_ops"]),
             scrub_min_share=float(conf["qos_scrub_min_share"]),
+            replication_max_ops=float(
+                conf["qos_replication_max_ops"]),
+            replication_min_ops=float(
+                conf["qos_replication_min_ops"]),
+            replication_min_share=float(
+                conf["qos_replication_min_share"]),
         )
 
     @staticmethod
@@ -261,9 +284,10 @@ class QoSController:
         """One controller evaluation.  Returns::
 
             {"burning": bool, "burn": float,
-             "recovery": {"limit", "reservation", "floor", "changed"},
-             "backfill": {"limit", "reservation", "floor", "changed"},
-             "scrub":    {"limit", "reservation", "floor", "changed"},
+             "recovery":    {"limit", "reservation", "floor", "changed"},
+             "backfill":    {"limit", "reservation", "floor", "changed"},
+             "scrub":       {"limit", "reservation", "floor", "changed"},
+             "replication": {"limit", "reservation", "floor", "changed"},
              "hedge": {daemon: timeout_s}}   # only entries that moved
 
         ``hedge`` keys are daemon names (``osd.N``); an entry appears
@@ -302,6 +326,18 @@ class QoSController:
         }
         if new_sc is not None:
             self.retunes += 1
+        new_rp = self.replication.step(burning)
+        rp = {
+            "limit": self.replication.value,
+            # the agents actuate a plain rate limit, not an mClock
+            # (reservation, limit) pair — reservation mirrors the
+            # limit for the journal's uniform retune shape
+            "reservation": self.replication.value,
+            "floor": self.replication.floor,
+            "changed": new_rp is not None,
+        }
+        if new_rp is not None:
+            self.retunes += 1
 
         hedge: dict[str, float] = {}
         if self.hedge_quantile > 0.0:
@@ -325,7 +361,8 @@ class QoSController:
                 hedge[daemon] = t
 
         return {"burning": burning, "burn": burn, "recovery": rec,
-                "backfill": bf, "scrub": sc, "hedge": hedge}
+                "backfill": bf, "scrub": sc, "replication": rp,
+                "hedge": hedge}
 
     def state(self) -> dict:
         """Controller state snapshot (digest / forensic bundles)."""
@@ -341,6 +378,10 @@ class QoSController:
             "scrub_limit": round(self.scrub.value, 3),
             "scrub_floor": round(self.scrub.floor, 3),
             "scrub_ceiling": round(self.scrub.ceiling, 3),
+            "replication_limit": round(self.replication.value, 3),
+            "replication_floor": round(self.replication.floor, 3),
+            "replication_ceiling": round(
+                self.replication.ceiling, 3),
             "hedge_timeouts_ms": {
                 d: round(t * 1e3, 3)
                 for d, t in sorted(self._hedge_last.items())},

@@ -40,7 +40,9 @@ async def _run(args) -> int:
         await rados.connect(timeout=args.timeout)
         ioctx = await rados.open_ioctx(args.pool)
         users = RGWUsers(ioctx)
-        gw = RGWLite(ioctx, users=users)   # admin/system context
+        gw = RGWLite(ioctx, users=users,   # admin/system context
+                     datalog_shards=int(
+                         rados.conf["rgw_datalog_shards"]))
         out = await _dispatch(args, gw, users)
         if out is not None:
             print(json.dumps(out, indent=2, default=str))
@@ -134,6 +136,40 @@ async def _dispatch(args, gw: RGWLite, users: RGWUsers):
             return {"removed": args.placement_id}
         if args.psub == "ls":
             return await zp.ls()
+    if args.cmd == "sync" and args.sub == "status":
+        # this zone's view of replication: per-shard source datalog
+        # positions (what a peer must reach) + the persisted sync
+        # markers of agents pulling INTO this zone (where they are)
+        from ceph_tpu.client.rados import RadosError
+        from ceph_tpu.services.rgw_sync import STATUS_OID
+
+        try:
+            kv = await gw.ioctx.get_omap(STATUS_OID)
+        except RadosError as e:
+            if e.rc != -2:
+                raise
+            kv = {}
+        markers: dict[str, dict[int, int]] = {}
+        for k, v in kv.items():
+            if "\x00" in k:
+                b, _, s = k.rpartition("\x00")
+                markers.setdefault(b, {})[int(s)] = int(v)
+            else:
+                markers.setdefault(k, {}).setdefault(0, int(v))
+        positions: dict[str, dict[str, int]] = {}
+        for b in await gw.list_buckets():
+            positions[b] = {
+                str(s): int((await gw.log_list(
+                    b, after=0, max_entries=1, shard=s))
+                    .get("max_seq", 0))
+                for s in range(gw.datalog_shards)}
+        return {
+            "datalog_shards": gw.datalog_shards,
+            "source_positions": positions,
+            "sync_markers": {
+                b: {str(s): q for s, q in sorted(m.items())}
+                for b, m in sorted(markers.items())},
+        }
     if args.cmd in ("realm", "zonegroup", "zone", "period"):
         from ceph_tpu.services.rgw_zone import RealmStore
 
@@ -281,6 +317,10 @@ def build_parser() -> argparse.ArgumentParser:
     plrm.add_argument("--placement-id", default="default-placement")
     plrm.add_argument("--storage-class", default="")
     pl_sub.add_parser("ls")
+
+    sync = sub.add_parser("sync")
+    sync_sub = sync.add_subparsers(dest="sub", required=True)
+    sync_sub.add_parser("status")
 
     period = sub.add_parser("period")
     period_sub = period.add_subparsers(dest="sub", required=True)

@@ -81,6 +81,9 @@ class Mgr:
                 OSDPerfQuery,
                 RBDSupport,
             )
+            from ceph_tpu.services.mgr_multisite import (
+                MultisiteMonitor,
+            )
             from ceph_tpu.services.mgr_qos import QoSMonitor
             from ceph_tpu.services.mgr_slo import SLOMonitor
             from ceph_tpu.services.orchestrator import Orchestrator
@@ -88,13 +91,16 @@ class Mgr:
             pq = OSDPerfQuery(self)
             # QoSMonitor runs directly after SLOMonitor (insertion
             # order is dispatch order): each report cycle the defense
-            # plane acts on the evaluation the SLO engine just made
+            # plane acts on the evaluation the SLO engine just made,
+            # and MultisiteMonitor follows so the replication-class
+            # decision reaches the sync agents the same cycle
             modules = [Balancer(self), PGAutoscaler(self),
                        Progress(self), DeviceHealth(self),
                        Telemetry(self), Insights(self),
                        SnapSchedule(self), Orchestrator(self),
                        pq, RBDSupport(self, pq), IOStat(self),
-                       SLOMonitor(self), QoSMonitor(self)]
+                       SLOMonitor(self), QoSMonitor(self),
+                       MultisiteMonitor(self)]
         self.modules = {m.name: m for m in modules}
         self.last_digest: dict | None = None
         # flight recorder: the mgr's own ring (SLO eval transitions,

@@ -72,6 +72,20 @@ class QoSMonitor(MgrModule):
               (osdmap.osds.items() if osdmap else ())
               if info.up}
 
+        # the replication class is not an mClock class: its decision is
+        # actuated as a token-bucket rate on the sync agents by the
+        # multisite mgr module (which reads last_tick), so it is
+        # journaled here but never fanned to OSDs
+        rp = out.get("replication")
+        if rp and rp["changed"]:
+            jr.emit("qos.retune", actuator="sync-agent",
+                    clazz="replication",
+                    limit=round(rp["limit"], 3),
+                    reservation=round(rp["reservation"], 3),
+                    floor=round(rp["floor"], 3),
+                    burn=round(out["burn"], 3),
+                    burning=out["burning"])
+
         for clazz in ("recovery", "backfill", "scrub"):
             dec = out.get(clazz)
             if not dec or not dec["changed"]:
@@ -193,6 +207,15 @@ class QoSMonitor(MgrModule):
                         "verification of fully-redundant data is "
                         "squeezed hardest under client burn)",
                 "samples": [("", float(st["scrub_floor"]))]},
+            "ceph_qos_replication_limit": {
+                "help": "controller-set replication-class pacing rate "
+                        "ops/s pushed to multisite sync agents (fourth "
+                        "AIMD position)",
+                "samples": [("", float(st["replication_limit"]))]},
+            "ceph_qos_replication_floor": {
+                "help": "replication pacing floor ops/s — the bound on "
+                        "how fast RPO may grow while clients burn",
+                "samples": [("", float(st["replication_floor"]))]},
             "ceph_qos_retunes": {
                 "help": "cumulative mClock retune decisions",
                 "samples": [("", float(st["retunes"]))]},

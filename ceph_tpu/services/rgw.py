@@ -500,7 +500,7 @@ class RGWLite:
                  users: "RGWUsers | None" = None,
                  gc_min_wait: float = 0.0,
                  auto_reshard_objs: int = 0,
-                 kms=None):
+                 kms=None, datalog_shards: int = 1):
         """``datalog``: append every mutation to the per-bucket data log
         (the cls_rgw bilog) so a multisite sync agent can tail it.
         ``user``: the acting identity for ACL/quota enforcement (None =
@@ -513,6 +513,11 @@ class RGWLite:
         resharding's rgw_max_objs_per_shard; 0 = off)."""
         self.ioctx = ioctx
         self.datalog = datalog
+        # bucket-datalog shard fan-out (rgw_data_log_num_shards role):
+        # mutations hash by object key onto a shard log so multisite
+        # replay and trim parallelise; shard 0 keeps the legacy oid so
+        # a 1-shard config is byte-compatible with pre-shard logs
+        self.datalog_shards = max(1, int(datalog_shards))
         self.user = user
         self.users = users
         self.gc_min_wait = gc_min_wait
@@ -551,7 +556,8 @@ class RGWLite:
         """A handle acting as ``user`` over the same pool."""
         child = RGWLite(self.ioctx, self.datalog, user, self.users,
                         self.gc_min_wait, self.auto_reshard_objs,
-                        kms=self.kms)
+                        kms=self.kms,
+                        datalog_shards=self.datalog_shards)
         child._notif_cache = self._notif_cache
         child._pushers = self._pushers
         child._topics_cache = self._topics_cache
@@ -1723,7 +1729,7 @@ class RGWLite:
         await self.ioctx.remove(
             self._mp_meta_oid(bucket, key, upload_id)
         )
-        await self._log(bucket, "put", key, etag)
+        await self._log(bucket, "put", key, etag, size=total)
         await self._maybe_auto_reshard(bucket, bucket_meta, key)
         out = {"etag": etag, "size": total}
         if entry.get("version_id") and not suspended:
@@ -2803,19 +2809,39 @@ class RGWLite:
         return f"rgw.bucket.index.{bucket}"
 
     @staticmethod
-    def _log_oid(bucket: str) -> str:
-        return f"rgw.bucket.log.{bucket}"
+    def _log_oid(bucket: str, shard: int = 0) -> str:
+        """Datalog shard object.  Shard 0 keeps the legacy unsuffixed
+        name so single-shard deployments (and their persisted sync
+        markers) survive the sharding change unmodified; higher shards
+        use a NUL separator for the same dotted-bucket-name reason as
+        the index shards."""
+        if shard == 0:
+            return f"rgw.bucket.log.{bucket}"
+        return f"rgw.bucket.log\x00{bucket}\x00{shard}"
+
+    def _log_shard_of(self, key: str) -> int:
+        """The datalog shard holding ``key``'s mutations (same
+        crc32 placement as the index shards, so the mapping is a pure
+        function both zones compute identically)."""
+        if self.datalog_shards <= 1:
+            return 0
+        return zlib.crc32(key.encode()) % self.datalog_shards
 
     async def _log(self, bucket: str, op: str, key: str,
-                   etag: str = "", event: str | None = None) -> None:
+                   etag: str = "", event: str | None = None,
+                   size: int = 0) -> None:
         """``event``: explicit S3 event name when the op name alone is
         ambiguous (a versioned DELETE logs 'del' but the S3 event is
-        DeleteMarkerCreated)."""
+        DeleteMarkerCreated).  ``size``: payload bytes for puts, so the
+        sync agent's lag ledger can price unreplicated entries in bytes
+        as well as entries."""
         if self.datalog:
             await self.ioctx.exec(
-                self._log_oid(bucket), "rgw", "log_add",
+                self._log_oid(bucket, self._log_shard_of(key)),
+                "rgw", "log_add",
                 json.dumps({"op": op, "key": key, "etag": etag,
-                            "mtime": time.time()}).encode(),
+                            "mtime": time.time(),
+                            "size": int(size)}).encode(),
             )
         await self._notify(bucket, op, key, etag, event)
 
@@ -3225,16 +3251,18 @@ class RGWLite:
         )
 
     async def log_list(self, bucket: str, after: int = 0,
-                       max_entries: int = 1000) -> dict:
+                       max_entries: int = 1000,
+                       shard: int = 0) -> dict:
         out = await self.ioctx.exec(
-            self._log_oid(bucket), "rgw", "log_list",
+            self._log_oid(bucket, shard), "rgw", "log_list",
             json.dumps({"after": after, "max": max_entries}).encode(),
         )
         return json.loads(out)
 
-    async def log_trim(self, bucket: str, upto: int) -> None:
+    async def log_trim(self, bucket: str, upto: int,
+                       shard: int = 0) -> None:
         await self.ioctx.exec(
-            self._log_oid(bucket), "rgw", "log_trim",
+            self._log_oid(bucket, shard), "rgw", "log_trim",
             json.dumps({"upto": upto}).encode(),
         )
 
@@ -3291,11 +3319,12 @@ class RGWLite:
             except RadosError as e:
                 if e.rc != -2:
                     raise
-        try:
-            await self.ioctx.remove(self._log_oid(bucket))
-        except RadosError as e:
-            if e.rc != -2:
-                raise
+        for shard in range(self.datalog_shards):
+            try:
+                await self.ioctx.remove(self._log_oid(bucket, shard))
+            except RadosError as e:
+                if e.rc != -2:
+                    raise
         await self.ioctx.rm_omap_keys(BUCKETS_OID, [bucket])
 
     async def head_bucket(self, bucket: str) -> dict:
@@ -3614,7 +3643,7 @@ class RGWLite:
         await self.ioctx.set_omap(ctx["index_oid"], {
             key: json.dumps(entry).encode(),
         })
-        await self._log(bucket, "put", key, etag)
+        await self._log(bucket, "put", key, etag, size=size)
         await self._maybe_auto_reshard(bucket, ctx.get("meta", {}),
                                        key)
         out = {"etag": etag, "size": size}

@@ -31,6 +31,7 @@ import secrets
 import time
 
 from ceph_tpu.client.rados import IoCtx, ObjectOperation, RadosError
+from ceph_tpu.common.events import emit_proc
 from ceph_tpu.common.log import Dout
 from ceph_tpu.services.rgw import RGWError
 
@@ -472,10 +473,20 @@ class SyncOrchestrator:
     object, or the poll fallback) atomically re-plans: agents for
     removed zones stop, new zones start, unchanged pairs keep their
     markers (sync positions live on the secondary, so replans lose
-    nothing)."""
+    nothing).
+
+    ``local_zone`` scopes the orchestrator to one zone's point of
+    view: only agents PULLING INTO that zone are run (each zone's own
+    orchestrator replicates into itself, so a two-zone realm runs one
+    agent per side instead of every side running both).  ``None``
+    keeps the omniscient single-process behavior.  ``agent_kwargs``
+    pass through to every spawned RGWSyncAgent (poll_interval, trim,
+    seed)."""
 
     def __init__(self, store: RealmStore, realm: str,
-                 gateways: dict, poll_interval: float = 0.5):
+                 gateways: dict, poll_interval: float = 0.5,
+                 local_zone: str | None = None,
+                 agent_kwargs: dict | None = None):
         from ceph_tpu.services.rgw_sync import RGWSyncAgent
 
         self._agent_cls = RGWSyncAgent
@@ -483,8 +494,11 @@ class SyncOrchestrator:
         self.realm = realm
         self.gateways = dict(gateways)
         self.poll_interval = poll_interval
+        self.local_zone = local_zone
+        self.agent_kwargs = dict(agent_kwargs or {})
         self.period_id: str | None = None
         self.agents: dict[tuple[str, str], object] = {}
+        self._masters: dict[str, str] = {}
         self._task: asyncio.Task | None = None
         self._watch = None
         self._kick = asyncio.Event()
@@ -529,12 +543,24 @@ class SyncOrchestrator:
 
     async def _apply(self, period: dict) -> None:
         want: dict[tuple[str, str], tuple] = {}
-        for zg in period["topology"]["zonegroups"].values():
+        for zgname, zg in period["topology"]["zonegroups"].items():
             master = zg.get("master_zone")
+            old = self._masters.get(zgname)
+            if master:
+                if old and old != master:
+                    # promotion: the period commit just moved the
+                    # write master — the RTO clock's visible edge
+                    emit_proc("sync.failover", realm=self.realm,
+                              zonegroup=zgname, old_master=old,
+                              new_master=master, period=period["id"])
+                self._masters[zgname] = master
             if not master or master not in self.gateways:
                 continue
             for zname in zg["zones"]:
                 if zname == master or zname not in self.gateways:
+                    continue
+                if (self.local_zone is not None
+                        and zname != self.local_zone):
                     continue
                 want[(master, zname)] = (self.gateways[master],
                                         self.gateways[zname])
@@ -544,12 +570,39 @@ class SyncOrchestrator:
         # start the new ones
         for pair, (src, dst) in want.items():
             if pair not in self.agents:
-                agent = self._agent_cls(src, dst)
+                agent = self._agent_cls(src, dst,
+                                        src_zone=pair[0],
+                                        dst_zone=pair[1],
+                                        **self.agent_kwargs)
                 agent.start()
                 self.agents[pair] = agent
         self.period_id = period["id"]
         log.dout(1, "realm %s now at period %s (%d sync agents)",
                  self.realm, period["id"], len(self.agents))
+
+    async def set_gateway(self, zone: str, gw) -> None:
+        """Swap the handle for a (re)started zone: every agent touching
+        the zone stops, the next replan respawns it against the new
+        handle, and the persisted markers resume it where it left off
+        (a revived zone rejoins sync without operator surgery)."""
+        stop = [p for p in self.agents if zone in p]
+        for pair in stop:
+            await self.agents.pop(pair).stop()
+        self.gateways[zone] = gw
+        self.period_id = None          # force re-apply on next cycle
+        self._kick.set()
+
+    def status(self) -> dict:
+        """Per-agent sync status keyed "src->dst" (the mgr multisite
+        module and ``rgw-admin sync status`` both serve this)."""
+        return {
+            "realm": self.realm,
+            "period": self.period_id,
+            "local_zone": self.local_zone,
+            "agents": {f"{s}->{d}": a.status()
+                       for (s, d), a in sorted(self.agents.items())
+                       if hasattr(a, "status")},
+        }
 
     async def stop(self) -> None:
         self._stopped = True

@@ -13,6 +13,8 @@ from ceph_tpu.testing.chaos import (
     run_host_failure_drill,
     run_rolling_restart_drill,
     run_silent_corruption_drill,
+    run_zone_loss_dr_drill,
+    run_zone_loss_drill,
 )
 from ceph_tpu.testing.rados_model import RadosModel
 from ceph_tpu.testing.thrasher import Thrasher
@@ -20,4 +22,5 @@ from ceph_tpu.testing.thrasher import Thrasher
 __all__ = ["ChaosHarness", "RadosModel", "Thrasher", "run_chaos",
            "run_drain_drill", "run_expansion_drill",
            "run_host_failure_drill", "run_rolling_restart_drill",
-           "run_silent_corruption_drill"]
+           "run_silent_corruption_drill", "run_zone_loss_dr_drill",
+           "run_zone_loss_drill"]

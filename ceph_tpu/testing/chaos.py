@@ -1045,3 +1045,262 @@ async def run_silent_corruption_drill(seed: int = 0, n_osds: int = 4,
         fp.fp_clear()
         await rados.shutdown()
         await cluster.stop()
+
+
+# -- geo-replication drills --------------------------------------------------
+# Seeded two-zone storms that grade the multisite plane: measured RPO
+# against the cursor ledger, measured RTO through a period-commit
+# failover, and bit-identical convergence after the lost zone revives.
+
+async def _wait_zone_lag_zero(realm, zone: str,
+                              timeout: float = 60.0) -> None:
+    """Wait until ``zone`` runs at least one pull agent and its
+    replication backlog (entries AND bytes) has drained to zero."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while True:
+        orch = realm.zones[zone]["orch"]
+        if orch is not None and orch.agents:
+            led = await realm.lag()
+            if led[zone]["entries"] == 0 and led[zone]["bytes"] == 0:
+                return
+        if loop.time() > deadline:
+            raise TimeoutError(f"zone {zone} never drained its lag")
+        await asyncio.sleep(0.05)
+
+
+async def run_zone_loss_drill(seed: int = 0, n_objects: int = 12,
+                              n_unreplicated: int = 5,
+                              obj_size: int = 4096,
+                              rto_slo_s: float = 30.0,
+                              datalog_shards: int = 4,
+                              dr_rebuild: bool = False) -> dict:
+    """Whole-zone loss, graded end to end (the geo-replication SLO).
+
+    Boots a two-zone realm — zone ``a`` master over durable stores,
+    zone ``b`` secondary — replicates a seeded write set, then:
+
+    1. **partition** — zone b's pull agents stop (the replication link
+       goes dark) while a keeps acking client writes: fresh keys, an
+       overwrite, a delete, and a conflict key.  The cursor ledger
+       (:meth:`RGWSyncAgent.lag`) prices the unreplicated backlog in
+       entries and bytes — the PREDICTED RPO;
+    2. **zone loss** — zone a dies whole (mons, OSDs, gateway);
+    3. **failover** — a period commit on b's OWN realm store promotes
+       it to master; RTO = seconds from the kill until b acks a write
+       after the commit, bounded by ``rto_slo_s``;
+    4. **measured RPO** — every write a acked that b cannot serve,
+       priced in entries and bytes by inspecting b, asserted EXACTLY
+       EQUAL to the ledger (the ledger is trustworthy: what it says
+       survives is servable, what it says is lost is lost);
+    5. **conflict** — b, now master, writes the conflict key again:
+       both zones wrote the same key across the partition;
+    6. **revive + resync** — a reboots over its surviving stores
+       (``dr_rebuild=True`` first WIPES a's mon store and rebuilds it
+       offline from the OSD stores with monstore_tool + monmaptool —
+       the PR-2 recipe — and restarts against the authored monmap),
+       re-learns the committed topology, full-syncs from b (purging
+       its orphaned unreplicated writes), drains lag to zero, and the
+       drill asserts bit-identical convergence with the conflict key
+       resolved to b's later write on BOTH zones.
+    """
+    import json
+    import shutil
+    import tempfile
+
+    from ceph_tpu.services.rgw import RGWError
+    from ceph_tpu.vstart import MultisiteRealm
+
+    rng = random.Random(f"zone-loss:{seed}")
+    adir = tempfile.mkdtemp(prefix="drill-zone-a-")
+    realm = MultisiteRealm(
+        ("a", "b"), n_osds=3,
+        overrides={"rgw_datalog_shards": datalog_shards},
+        store_dirs={"a": adir}, with_mgr=True,
+        agent_kwargs={"poll_interval": 0.05, "seed": seed})
+    out: dict = {"seed": seed, "dr_rebuild": dr_rebuild,
+                 "shards": datalog_shards}
+    loop = asyncio.get_running_loop()
+    bucket = "geo"
+    try:
+        await realm.start()
+        a_gw = realm.zones["a"]["gw"]
+        b_gw = realm.zones["b"]["gw"]
+
+        # 1a. seeded steady state, fully replicated before the storm
+        datas = {f"obj-{i}": rng.randbytes(obj_size)
+                 for i in range(n_objects)}
+        await a_gw.create_bucket(bucket)
+        for k, d in datas.items():
+            await a_gw.put_object(bucket, k, d)
+        await _wait_zone_lag_zero(realm, "b")
+        assert (await b_gw.get_object(bucket, "obj-0"))["data"] \
+            == datas["obj-0"]
+
+        # 1b. the replication link goes dark: b's agents stop, the
+        # orchestrator holds (the period didn't change), and a keeps
+        # acking writes it can no longer replicate out
+        orch_b = realm.zones["b"]["orch"]
+        parted = dict(orch_b.agents)
+        orch_b.agents.clear()
+        for agent in parted.values():
+            await agent.stop()
+        ledger_agent = parted[("a", "b")]
+
+        # (key, content b must serve for the write NOT to be lost):
+        # None = the write was a delete
+        post_partition: list[tuple[str, bytes | None]] = []
+        predicted_entries = 0
+        predicted_bytes = 0
+        for i in range(n_unreplicated):
+            d = rng.randbytes(obj_size)
+            await a_gw.put_object(bucket, f"lost-{i}", d)
+            post_partition.append((f"lost-{i}", d))
+            predicted_entries += 1
+            predicted_bytes += len(d)
+        over = rng.randbytes(obj_size // 2)
+        await a_gw.put_object(bucket, "obj-0", over)
+        post_partition.append(("obj-0", over))
+        predicted_entries += 1
+        predicted_bytes += len(over)
+        await a_gw.delete_object(bucket, "obj-1")
+        post_partition.append(("obj-1", None))
+        predicted_entries += 1
+        conflict_v1 = rng.randbytes(obj_size)
+        await a_gw.put_object(bucket, "conflict", conflict_v1)
+        post_partition.append(("conflict", conflict_v1))
+        predicted_entries += 1
+        predicted_bytes += len(conflict_v1)
+
+        ledger = await ledger_agent.lag()
+        assert ledger["entries"] == predicted_entries, ledger
+        assert ledger["bytes"] == predicted_bytes, ledger
+        out["ledger"] = {"entries": ledger["entries"],
+                         "bytes": ledger["bytes"]}
+
+        # 2. the zone-loss event: a dies whole, mid-backlog
+        t_kill = loop.time()
+        await realm.stop_zone("a")
+        events.emit_proc("drill.zone_loss", seed=seed, zone="a",
+                         ledger_entries=ledger["entries"],
+                         ledger_bytes=ledger["bytes"])
+
+        # 3. failover: promote b on its own realm copy; RTO is the
+        # whole runbook — kill to first acked write post-commit
+        await realm.failover("b", survivors=["b"])
+        while True:
+            try:
+                await b_gw.put_object(bucket, "rto-probe", b"serving")
+                break
+            except (RGWError, ConnectionError, TimeoutError):
+                assert loop.time() - t_kill < rto_slo_s, \
+                    "zone b never served writes within the RTO SLO"
+                await asyncio.sleep(0.05)
+        rto_s = loop.time() - t_kill
+
+        # 4. measured RPO: what a acked that b cannot serve — must
+        # equal the cursor ledger exactly, entries and bytes
+        measured_entries = 0
+        measured_bytes = 0
+        lost_keys = []
+        for k, want in post_partition:
+            try:
+                served = (await b_gw.get_object(bucket, k))["data"]
+            except RGWError:
+                served = None
+            if served != want:
+                measured_entries += 1
+                measured_bytes += len(want or b"")
+                lost_keys.append(k)
+        out["rpo"] = {"entries": measured_entries,
+                      "bytes": measured_bytes,
+                      "keys": lost_keys}
+
+        # 5. both zones wrote the same key across the partition: the
+        # later write (b's, as the surviving master) must win on BOTH
+        # sides once a returns
+        conflict_v2 = rng.randbytes(obj_size)
+        await b_gw.put_object(bucket, "conflict", conflict_v2)
+
+        # 6. revive a over its surviving stores and resync from b
+        if dr_rebuild:
+            from ceph_tpu.tools import monmaptool, monstore_tool
+
+            shutil.rmtree(f"{adir}/mon.a")
+            argv = ["rebuild", "--store-path", f"{adir}/mon.m",
+                    "--admin-key", "drill-admin"]
+            for i in range(realm.n_osds):
+                argv += ["--osd-store", f"{adir}/osd.{i}"]
+            assert await monstore_tool._run(
+                monstore_tool.build_parser().parse_args(argv)) == 0
+            conf = f"{adir}/cluster.json"
+            assert await monmaptool._run(
+                monmaptool.build_parser().parse_args(
+                    [conf, "--create", "--add", "m",
+                     "local://a-mon.m"])) == 0
+            with open(conf) as f:
+                monmap = json.load(f)["monmap"]
+            await realm.revive_zone("a", monmap=monmap)
+        else:
+            await realm.revive_zone("a")
+        await _wait_zone_lag_zero(realm, "a", timeout=90.0)
+
+        # bit-identical convergence, the orphans purged
+        a_gw = realm.zones["a"]["gw"]
+        keys_a = [e["key"] for e in
+                  (await a_gw.list_objects(bucket))["contents"]]
+        keys_b = [e["key"] for e in
+                  (await b_gw.list_objects(bucket))["contents"]]
+        assert keys_a == keys_b, (keys_a, keys_b)
+        assert not any(k.startswith("lost-") for k in keys_a), keys_a
+        mismatched = []
+        for k in keys_a:
+            da = (await a_gw.get_object(bucket, k))["data"]
+            db = (await b_gw.get_object(bucket, k))["data"]
+            if da != db:
+                mismatched.append(k)
+        assert not mismatched, mismatched
+        conflict_final = (await a_gw.get_object(
+            bucket, "conflict"))["data"]
+        purged = int(next(iter(
+            realm.zones["a"]["orch"].agents.values()))
+            .perf.value("sync_purged"))
+
+        out["slo"] = {
+            "rpo_entries_predicted": predicted_entries,
+            "rpo_entries": measured_entries,
+            "rpo_bytes_predicted": predicted_bytes,
+            "rpo_bytes": measured_bytes,
+            "rto_s": round(rto_s, 3),
+            "rto_slo_s": rto_slo_s,
+            "resync_purged": purged,
+            "converged": not mismatched and keys_a == keys_b,
+            "conflict_winner": "b" if conflict_final == conflict_v2
+            else "a",
+            "pass": bool(
+                measured_entries == predicted_entries
+                == ledger["entries"]
+                and measured_bytes == predicted_bytes
+                == ledger["bytes"]
+                and rto_s <= rto_slo_s
+                and not mismatched and keys_a == keys_b
+                and conflict_final == conflict_v2),
+        }
+        assert out["slo"]["pass"], out["slo"]
+        out["forensics"] = await _forensic_bundle(
+            realm.zones["b"]["cluster"], "drill:zone_loss",
+            detail={"seed": seed, "slo": out["slo"],
+                    "ledger": out["ledger"]})
+        return out
+    finally:
+        await realm.stop()
+        shutil.rmtree(adir, ignore_errors=True)
+
+
+async def run_zone_loss_dr_drill(seed: int = 0, **kw) -> dict:
+    """DR composite: the zone-loss drill with the revived zone's mon
+    store WIPED and rebuilt offline from its surviving OSD stores
+    (monstore_tool + monmaptool) before the restart — chains the PR-2
+    recovery recipe into the geo failover runbook."""
+    kw.setdefault("dr_rebuild", True)
+    return await run_zone_loss_drill(seed=seed, **kw)

@@ -105,36 +105,46 @@ class S3Backend(Backend):
     Retry-After (capped at ``throttle_backoff_cap``), up to
     ``max_throttle_retries`` times, and each shed counts into
     ``self.throttled`` — a well-behaved tenant backing off must not
-    poison the error-rate SLO objective."""
+    poison the error-rate SLO objective.
+
+    ``read_endpoint``: optional ``(host, port)`` every GET is routed
+    to while writes keep hitting ``host:port`` — the multisite
+    read-affinity pattern (write the master zone, read the replicated
+    local zone), selected per request so one generator can grade a
+    geo pair."""
 
     def __init__(self, host: str, port: int, access_key: str,
                  secret_key: str, bucket: str = "loadgen",
                  max_throttle_retries: int = 4,
-                 throttle_backoff_cap: float = 2.0):
+                 throttle_backoff_cap: float = 2.0,
+                 read_endpoint: tuple[str, int] | None = None):
         self.host, self.port = host, port
         self.ak, self.sk = access_key, secret_key
         self.bucket = bucket
         self.max_throttle_retries = int(max_throttle_retries)
         self.throttle_backoff_cap = float(throttle_backoff_cap)
+        self.read_endpoint = (tuple(read_endpoint)
+                              if read_endpoint else None)
         self.throttled = 0
 
     async def _request(self, method: str, path: str,
-                       body: bytes = b""
+                       body: bytes = b"",
+                       endpoint: tuple[str, int] | None = None
                        ) -> tuple[int, dict[str, str], bytes]:
         import hashlib
 
         from ceph_tpu.services.rgw_http import _Request, sigv4_sign
 
+        host, port = endpoint or (self.host, self.port)
         hdrs = {
-            "host": f"{self.host}:{self.port}",
+            "host": f"{host}:{port}",
             "x-amz-date": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
             "x-amz-content-sha256": hashlib.sha256(body).hexdigest(),
         }
         hdrs["authorization"] = sigv4_sign(
             _Request(method, path, hdrs, body), self.ak, self.sk)
         hdrs["content-length"] = str(len(body))
-        reader, writer = await asyncio.open_connection(self.host,
-                                                       self.port)
+        reader, writer = await asyncio.open_connection(host, port)
         try:
             lines = [f"{method} {path} HTTP/1.1"]
             lines += [f"{k}: {v}" for k, v in hdrs.items()]
@@ -154,14 +164,15 @@ class S3Backend(Backend):
         return status, resp_hdrs, payload
 
     async def _request_throttled(self, method: str, path: str,
-                                 body: bytes = b""
+                                 body: bytes = b"",
+                                 endpoint: tuple[str, int] | None = None
                                  ) -> tuple[int, bytes]:
         """One op with 503-as-throttling semantics: honor Retry-After
         with capped backoff; retries exhausted surfaces the 503."""
         attempt = 0
         while True:
-            status, hdrs, payload = await self._request(method, path,
-                                                        body)
+            status, hdrs, payload = await self._request(
+                method, path, body, endpoint=endpoint)
             if status != 503:
                 return status, payload
             self.throttled += 1
@@ -190,7 +201,8 @@ class S3Backend(Backend):
 
     async def get(self, key: str) -> bytes:
         status, body = await self._request_throttled(
-            "GET", f"/{self.bucket}/{key}")
+            "GET", f"/{self.bucket}/{key}",
+            endpoint=self.read_endpoint)
         if status >= 300:
             raise RuntimeError(f"GET {key} HTTP {status}")
         return body

@@ -383,7 +383,9 @@ class DevCluster:
         users = RGWUsers(ioctx)
         gw = RGWLite(ioctx, users=users,
                      gc_min_wait=float(
-                         rados.conf["rgw_gc_obj_min_wait"]))
+                         rados.conf["rgw_gc_obj_min_wait"]),
+                     datalog_shards=int(
+                         rados.conf["rgw_datalog_shards"]))
         if cold_pool:
             zp = ZonePlacement(ioctx)
             await zp.ensure_pool(cold_pool,
@@ -471,3 +473,183 @@ class DevCluster:
                 "monmap": self.monmap,
                 "overrides": self.overrides,
             }, f, indent=2)
+
+
+class MultisiteRealm:
+    """N independent DevClusters as zones of one realm (the two-site
+    production layout: each zone is its own failure domain with its own
+    mons/OSDs/gateway, in one process under distinct ``local://``
+    namespaces).
+
+    Each zone keeps its OWN copy of the realm configuration (committed
+    through its own RealmStore — reference multisite pulls realm config
+    from the master, here the staging verbs run against every zone so a
+    zone loss never loses the topology) and runs its OWN
+    SyncOrchestrator scoped by ``local_zone``: every zone pulls only
+    into itself, so a two-zone realm runs exactly one agent per side
+    and a failover commit on any surviving store re-plans that side
+    alone.  With ``with_mgr`` each zone also gets a mgr whose
+    ``multisite`` module measures (lag ledger, ceph_rgw_sync_* gauges)
+    and paces (replication QoS class) its zone's agents."""
+
+    def __init__(self, zone_names=("a", "b"), realm: str = "earth",
+                 zonegroup: str = "geo", n_mons: int = 1,
+                 n_osds: int = 3, overrides: dict | None = None,
+                 zone_overrides: dict | None = None,
+                 store_dirs: dict | None = None,
+                 with_mgr: bool = False,
+                 mgr_report_interval: float = 0.2,
+                 agent_kwargs: dict | None = None):
+        self.zone_names = list(zone_names)
+        assert self.zone_names, "a realm needs at least one zone"
+        self.realm = realm
+        self.zonegroup = zonegroup
+        self.master = self.zone_names[0]
+        self.n_mons = n_mons
+        self.n_osds = n_osds
+        self.overrides = dict(overrides or {})
+        self.zone_overrides = dict(zone_overrides or {})
+        self.store_dirs = dict(store_dirs or {})
+        self.with_mgr = with_mgr
+        self.mgr_report_interval = mgr_report_interval
+        self.agent_kwargs = dict(agent_kwargs or {})
+        # zone name -> {"cluster", "fe", "users", "gw", "rados",
+        #               "store", "orch", "mgr"}
+        self.zones: dict[str, dict] = {}
+
+    async def start(self) -> "MultisiteRealm":
+        from ceph_tpu.services.rgw_zone import SyncOrchestrator
+
+        for name in self.zone_names:
+            await self._boot_zone(name)
+        # the same staged topology, committed on EVERY zone's store
+        for name in self.zone_names:
+            store = self.zones[name]["store"]
+            await store.realm_create(self.realm)
+            await store.zonegroup_create(self.realm, self.zonegroup,
+                                         master=True)
+            for zname in self.zone_names:
+                await store.zone_create(self.realm, self.zonegroup,
+                                        zname,
+                                        master=zname == self.master)
+            await store.period_update(self.realm, commit=True)
+        gateways = {n: z["gw"] for n, z in self.zones.items()}
+        for name in self.zone_names:
+            z = self.zones[name]
+            orch = SyncOrchestrator(
+                z["store"], self.realm, gateways,
+                poll_interval=0.2, local_zone=name,
+                agent_kwargs=self.agent_kwargs)
+            await orch.start()
+            z["orch"] = orch
+            if z["mgr"] is not None:
+                z["mgr"].modules["multisite"].attach(orch)
+        return self
+
+    async def _boot_zone(self, name: str,
+                         monmap: dict | None = None) -> dict:
+        from ceph_tpu.services.rgw_zone import RealmStore
+
+        cluster = DevCluster(
+            n_mons=self.n_mons, n_osds=self.n_osds,
+            ns=f"{name}-",
+            overrides={**self.overrides,
+                       **self.zone_overrides.get(name, {})},
+            store_dir=self.store_dirs.get(name),
+            monmap=monmap)
+        await cluster.start()
+        mgr = None
+        if self.with_mgr:
+            mgr = await cluster.start_mgr(
+                report_interval=self.mgr_report_interval)
+        fe, users = await cluster.start_rgw()
+        z = {"cluster": cluster, "fe": fe, "users": users,
+             "gw": fe.rgw, "rados": fe._rados,
+             "store": RealmStore(fe.rgw.ioctx), "orch": None,
+             "mgr": mgr}
+        self.zones[name] = z
+        return z
+
+    async def revive_zone(self, name: str,
+                          monmap: dict | None = None) -> dict:
+        """Re-boot a dead zone over its durable store_dir and splice
+        the fresh gateway handle into every survivor's orchestrator —
+        persisted sync markers resume replication where it stopped.
+        ``monmap``: override for DR restarts whose mon stores were
+        rebuilt (monstore_tool + monmaptool recipe)."""
+        from ceph_tpu.services.rgw_zone import SyncOrchestrator
+
+        z = await self._boot_zone(name, monmap=monmap)
+        for other, oz in self.zones.items():
+            if other != name and oz["orch"] is not None:
+                await oz["orch"].set_gateway(name, z["gw"])
+        # the revived zone's own realm copy predates any failover that
+        # happened while it was down: re-commit the CURRENT topology
+        # (a fresh MemStore zone needs the whole realm re-created)
+        store = z["store"]
+        if self.realm not in await store.realm_list():
+            await store.realm_create(self.realm)
+            await store.zonegroup_create(self.realm, self.zonegroup,
+                                        master=True)
+            for zname in self.zone_names:
+                await store.zone_create(self.realm, self.zonegroup,
+                                        zname)
+        await store.zone_modify(self.realm, self.zonegroup,
+                                self.master, master=True)
+        await store.period_update(self.realm, commit=True)
+        gateways = {n: zz["gw"] for n, zz in self.zones.items()}
+        orch = SyncOrchestrator(
+            store, self.realm, gateways, poll_interval=0.2,
+            local_zone=name, agent_kwargs=self.agent_kwargs)
+        await orch.start()
+        z["orch"] = orch
+        if z["mgr"] is not None:
+            z["mgr"].modules["multisite"].attach(orch)
+        # survivors' orchestrators plan pulls FROM the revived zone
+        # against the fresh handle; the revived side pulls the backlog
+        return z
+
+    async def failover(self, to_zone: str,
+                       survivors: list[str] | None = None) -> None:
+        """Promote ``to_zone`` to master by staging + committing a new
+        period on every surviving zone's own store (the dead zone's
+        copy is unreachable and irrelevant — it re-learns on revive)."""
+        names = survivors if survivors is not None else [
+            n for n, z in self.zones.items() if z["orch"] is not None]
+        for name in names:
+            store = self.zones[name]["store"]
+            await store.zone_modify(self.realm, self.zonegroup,
+                                    to_zone, master=True)
+            await store.period_update(self.realm, commit=True)
+        self.master = to_zone
+
+    async def lag(self) -> dict:
+        """Replication backlog per zone: {zone: {"entries", "bytes"}}
+        summed over the agents pulling INTO that zone."""
+        out: dict[str, dict] = {}
+        for name, z in self.zones.items():
+            tot = {"entries": 0, "bytes": 0}
+            orch = z["orch"]
+            for agent in (orch.agents.values() if orch else ()):
+                led = await agent.lag()
+                tot["entries"] += led["entries"]
+                tot["bytes"] += led["bytes"]
+            out[name] = tot
+        return out
+
+    async def stop_zone(self, name: str) -> None:
+        """Hard-stop one zone (the zone-loss event): its orchestrator
+        and cluster die; survivors keep their agents (which now error
+        against the dead source and back off)."""
+        z = self.zones.get(name)
+        if z is None:
+            return
+        if z["orch"] is not None:
+            await z["orch"].stop()
+            z["orch"] = None
+        await z["cluster"].stop()
+
+    async def stop(self) -> None:
+        for name in list(self.zones):
+            await self.stop_zone(name)
+        self.zones.clear()

@@ -62,7 +62,10 @@ def test_multisite_full_and_incremental_sync():
         agent = RGWSyncAgent(primary, secondary)
         await agent.sync_once()
         got = await secondary.get_object("photos", "a.jpg")
-        assert got["data"] == b"A" * 2048 and got["meta"] == {"cam": "x100"}
+        assert got["data"] == b"A" * 2048
+        # user metadata survives; the agent adds LWW provenance keys
+        assert got["meta"]["cam"] == "x100"
+        assert "rgw-source-mtime" in got["meta"]
         assert (await secondary.get_object("photos", "b.jpg"))["data"] \
             == b"B" * 512
 
@@ -125,6 +128,164 @@ def test_multisite_background_agent_converges():
         await c1.stop()
         await c2.stop()
     asyncio.run(run())
+
+def test_full_sync_snapshot_trim_interleave():
+    """A mutation landing BETWEEN the full-sync position snapshot and
+    the copy pass must never be trimmed before incremental replay: the
+    snapshot happens first, so the racing entry sits past the stored
+    marker and full sync itself never trims."""
+    async def run():
+        c1, r1, primary = await _zone("z1-")
+        c2, r2, secondary = await _zone("z2-")
+
+        await primary.create_bucket("b")
+        await primary.put_object("b", "k0", b"v0")
+        agent = RGWSyncAgent(primary, secondary)
+
+        real_list = primary.list_objects
+        fired = False
+
+        async def racy_list(bucket, **kw):
+            # fires after sync_once snapshotted the shard positions,
+            # before the copy pass lists the bucket
+            nonlocal fired
+            if not fired:
+                fired = True
+                await primary.put_object("b", "racer", b"mid-copy")
+            return await real_list(bucket, **kw)
+
+        primary.list_objects = racy_list
+        try:
+            await agent.sync_once()              # full-sync bootstrap
+        finally:
+            primary.list_objects = real_list
+
+        # marker == the pre-race snapshot; the racing entry survives
+        # in the source log, queued for replay — NOT trimmed
+        assert (await agent.markers())["b"][0] == 1
+        log = await primary.log_list("b")
+        assert any(e["key"] == "racer" for e in log["entries"])
+
+        # incremental replays it (idempotent re-put) and only then
+        # trims behind the replay cursor
+        await agent.sync_once()
+        assert (await secondary.get_object("b", "racer"))["data"] \
+            == b"mid-copy"
+        assert (await primary.log_list("b"))["entries"] == []
+
+        await r1.shutdown()
+        await r2.shutdown()
+        await c1.stop()
+        await c2.stop()
+    asyncio.run(run())
+
+
+def test_sharded_datalog_cursors_and_lag():
+    """rgw_datalog_shards > 1: entries spread across shard logs, one
+    persisted cursor per (bucket, shard), the lag ledger prices the
+    backlog in entries AND bytes, and replay + trim are per-shard."""
+    async def run():
+        async def shard_zone(ns):
+            cluster = DevCluster(
+                n_mons=1, n_osds=3, ns=ns,
+                overrides={"rgw_datalog_shards": 4})
+            await cluster.start()
+            rados = await cluster.client(f"client.{ns}admin")
+            await rados.pool_create("rgw", pg_num=4, size=3)
+            io = await rados.open_ioctx("rgw")
+            return cluster, rados, RGWLite(io, datalog_shards=4)
+
+        c1, r1, primary = await shard_zone("z1-")
+        c2, r2, secondary = await shard_zone("z2-")
+
+        await primary.create_bucket("s")
+        datas = {f"k{i}": bytes([i]) * (16 + i) for i in range(12)}
+        for k, d in datas.items():
+            await primary.put_object("s", k, d)
+        used = [s for s in range(4)
+                if (await primary.log_list("s", shard=s))["entries"]]
+        assert len(used) > 1, "keys all hashed to one shard"
+
+        agent = RGWSyncAgent(primary, secondary)
+        assert agent.shards == 4
+        led = await agent.lag()
+        assert led["entries"] == 12
+        assert led["bytes"] == sum(len(d) for d in datas.values())
+        assert set(led["buckets"]["s"]["shards"]) == {0, 1, 2, 3}
+
+        await agent.sync_once()                  # full sync
+        await primary.put_object("s", "k3", b"fresh")
+        await primary.delete_object("s", "k4")
+        await agent.sync_once()                  # per-shard replay+trim
+        assert (await secondary.get_object("s", "k3"))["data"] \
+            == b"fresh"
+        with pytest.raises(RGWError):
+            await secondary.get_object("s", "k4")
+        markers = (await agent.markers())["s"]
+        assert set(markers) == {0, 1, 2, 3}
+        for s in range(4):
+            assert (await primary.log_list("s", shard=s))["entries"] \
+                == []
+        assert (await agent.lag())["entries"] == 0
+
+        await r1.shutdown()
+        await r2.shutdown()
+        await c1.stop()
+        await c2.stop()
+    asyncio.run(run())
+
+
+def test_lww_conflict_resolution_is_convergent():
+    """Both zones wrote the same key: whichever replay order the
+    agents run in, the (mtime, zone) pair picks the SAME winner on
+    both sides — replicated copies carry their provenance, and the
+    zone id breaks exact mtime ties deterministically."""
+    async def run():
+        c1, r1, za = await _zone("z1-")
+        c2, r2, zb = await _zone("z2-")
+        for gw in (za, zb):
+            await gw.create_bucket("c")
+
+        ab = RGWSyncAgent(za, zb, src_zone="a", dst_zone="b")
+        ba = RGWSyncAgent(zb, za, src_zone="b", dst_zone="a")
+        # bootstrap both directions on the EMPTY bucket so the
+        # conflicting writes below replay through the incremental
+        # (LWW) path — full sync mirrors its source authoritatively
+        await ab.sync_once()
+        await ba.sync_once()
+
+        # a partition: each side acks its own write for the same key
+        await za.put_object("c", "k", b"from-a")
+        await asyncio.sleep(0.02)      # strictly later mtime on b
+        await zb.put_object("c", "k", b"from-b")
+
+        # replay in BOTH orders across two rounds: convergent either way
+        await ab.sync_once()
+        await ba.sync_once()
+        await ab.sync_once()
+        assert (await za.get_object("c", "k"))["data"] == b"from-b"
+        assert (await zb.get_object("c", "k"))["data"] == b"from-b"
+        assert ab.perf.value("sync_conflict_skips") >= 1
+
+        # exact-mtime tie: higher zone id wins on both sides
+        mt = "1000000.0"
+        await za.put_object("c", "tie", b"za",
+                            metadata={"rgw-source-mtime": mt,
+                                      "rgw-source-zone": "a"})
+        await zb.put_object("c", "tie", b"zb",
+                            metadata={"rgw-source-mtime": mt,
+                                      "rgw-source-zone": "b"})
+        await ab.sync_once()
+        await ba.sync_once()
+        assert (await za.get_object("c", "tie"))["data"] == b"zb"
+        assert (await zb.get_object("c", "tie"))["data"] == b"zb"
+
+        await r1.shutdown()
+        await r2.shutdown()
+        await c1.stop()
+        await c2.stop()
+    asyncio.run(run())
+
 
 def test_version_level_ops_reconcile():
     """del-version datalog entries change what is CURRENT without

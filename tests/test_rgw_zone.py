@@ -150,3 +150,87 @@ def test_period_commit_reconfigures_sync_without_restarts():
             await c2.stop()
             await c3.stop()
     asyncio.run(run())
+
+
+def test_period_commit_preserves_sync_markers():
+    """A period commit replans the agents (new objects, old ones
+    stopped) but the sync POSITION lives on the secondary, not in the
+    agent: after the reload the fresh agent must resume incrementally
+    from the persisted per-shard markers — no re-full-sync, no replay
+    of already-applied entries, no trimmed-entry gap."""
+    async def run():
+        from ceph_tpu.services.rgw_sync import RGWSyncAgent
+
+        c1, r1, east = await _zone("ze-")
+        c2, r2, west = await _zone("zw-")
+        orch = None
+        try:
+            store = RealmStore(east.ioctx)
+            await store.realm_create("gold")
+            await store.zonegroup_create("gold", "us", master=True)
+            await store.zone_create("gold", "us", "east", master=True)
+            await store.zone_create("gold", "us", "west")
+            await store.period_update("gold", commit=True)
+
+            orch = SyncOrchestrator(
+                store, "gold", {"east": east, "west": west},
+                poll_interval=0.1)
+            await orch.start()
+            await _wait(lambda: asyncio.sleep(0, len(orch.agents) == 1))
+
+            await east.create_bucket("b")
+            await east.put_object("b", "k0", b"v0")
+
+            async def west_has(key, want):
+                try:
+                    return (await west.get_object("b", key))["data"] \
+                        == want
+                except RGWError:
+                    return False
+            await _wait(lambda: west_has("k0", b"v0"))
+
+            agent1 = orch.agents[("east", "west")]
+
+            async def bootstrapped():
+                # the object lands mid-full-sync; wait for the PASS
+                # (markers persisted) before snapshotting the cursor
+                return (agent1.perf.value("sync_full_passes") >= 1
+                        and (await agent1.markers())
+                        .get("b", {}).get(0, 0) >= 1)
+            await _wait(bootstrapped)
+            markers_before = await agent1.markers()
+
+            # RECONFIGURE via periods: west leaves the realm (its
+            # agent stops) ... a write lands while it is out ...
+            await store.zone_rm("gold", "us", "west")
+            await store.period_update("gold", commit=True)
+            await _wait(lambda: asyncio.sleep(0, not orch.agents))
+            await east.put_object("b", "k1", b"v1")
+            # the cursor outlives its agent: still on west's pool
+            assert await agent1.markers() == markers_before
+
+            # ... and west rejoins: the commit spawns a BRAND-NEW
+            # agent object over the SAME persisted cursors
+            await store.zone_create("gold", "us", "west")
+            await store.period_update("gold", commit=True)
+            await _wait(lambda: asyncio.sleep(
+                0, ("east", "west") in orch.agents))
+            agent2 = orch.agents[("east", "west")]
+            assert agent2 is not agent1
+            assert isinstance(agent2, RGWSyncAgent)
+
+            # it resumes incrementally from the persisted cursor: only
+            # the missed write replays — a second full-sync pass would
+            # prove the marker was lost in the reload
+            await _wait(lambda: west_has("k1", b"v1"))
+            assert agent2.perf.value("sync_full_passes") == 0
+            assert (await agent2.markers())["b"][0] \
+                > markers_before["b"][0]
+            await r1.shutdown()
+            await r2.shutdown()
+        finally:
+            if orch is not None:
+                await orch.stop()
+            await c1.stop()
+            await c2.stop()
+    asyncio.run(run())
