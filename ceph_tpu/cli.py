@@ -397,6 +397,8 @@ async def _dispatch(args, rados: Rados) -> int:
             return 1
         _print(report, True)
         return 0 if not report.get("errors") else 1
+    if cmd == "top":
+        return await _run_top(args, rados, j)
     if cmd == "trace":
         # `ceph trace collect <trace_id>`: fan dump_traces across the
         # mon and every up OSD, dedupe by span id, and print ONE
@@ -985,6 +987,16 @@ def build_parser() -> argparse.ArgumentParser:
     trace.add_argument("trace_id", help="trace id from a span dump "
                        "or a slow-op record")
 
+    top = sub.add_parser("top")
+    top.add_argument("--kernels", action="store_true",
+                     help="show the per-signature device kernel table")
+    top.add_argument("--once", action="store_true",
+                     help="render one frame and exit (headless/CI)")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="refresh interval seconds (default 2)")
+    top.add_argument("--iterations", type=int, default=0,
+                     help="stop after N frames (0 = until ^C)")
+
     forn = sub.add_parser("forensics")
     forn_sub = forn.add_subparsers(dest="action", required=True)
     fls = forn_sub.add_parser("ls")
@@ -1112,6 +1124,120 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _render_top(d: dict, kernels: bool) -> str:
+    """One `ceph-tpu top` frame from the ``ts status`` rollup: SLO
+    verdicts, tenant-class burn pairs, utilization rates, defense
+    plane, collect accounting, tracer health, and (``--kernels``) the
+    per-signature device kernel table."""
+    lines: list[str] = []
+    slo = d.get("slo") or {}
+    util = d.get("utilization") or {}
+    qos = d.get("qos") or {}
+    ts = d.get("tsdb") or {}
+    checks = d.get("health_checks") or {}
+    viol = checks.get("SLO_VIOLATION")
+    lines.append("ceph-tpu top — "
+                 + (f"SLO_VIOLATION: {viol.get('message', '')}"
+                    if viol else "cluster within SLO"))
+    objectives = slo.get("objectives") or []
+    if objectives:
+        lines.append("  objectives:")
+        for rec in objectives:
+            val = rec.get("value")
+            val_s = "n/a" if val is None \
+                else f"{val:.4g}{rec.get('unit', '')}"
+            mark = " VIOLATING" if rec.get("violating") else ""
+            lines.append(
+                f"    {rec.get('objective'):<22} {val_s:>12}  "
+                f"target {rec.get('target'):g}{rec.get('unit', '')}  "
+                f"burn {rec.get('burn_rate', 0.0):.2f}x{mark}")
+    classes = slo.get("classes") or {}
+    if classes:
+        lines.append("  tenant classes (5m/1h burn):")
+        for cls, rec in sorted(classes.items()):
+            mark = " VIOLATING" if rec.get("violating") else ""
+            lines.append(
+                f"    {cls:<22} fast {rec.get('fast_burn', 0.0):6.2f}x"
+                f"  slow {rec.get('slow_burn', 0.0):6.2f}x{mark}")
+    if util:
+        lines.append(
+            "  device: "
+            f"{util.get('device_gibps', 0.0):g} GiB/s "
+            f"({util.get('roofline_pct', 0.0):g}% of roofline)  "
+            f"occupancy {util.get('coalesce_occupancy', 0.0):g}  "
+            f"resident hit {util.get('resident_hit_rate', 0.0):g}")
+        lines.append(
+            "  rebuild: "
+            f"{util.get('rebuild_gibps', 0.0):g} GiB/s   client p99 "
+            f"{util.get('client_p99_ms', 0.0):g} ms  p999 "
+            f"{util.get('client_p999_ms', 0.0):g} ms")
+    if qos:
+        lines.append(
+            f"  qos: {'BURNING' if qos.get('burning') else 'idle'} "
+            f"(burn {qos.get('burn', 0.0):g}x)")
+    coll = ts.get("collect") or {}
+    if coll:
+        lines.append(
+            "  collect: "
+            f"{'delta' if coll.get('delta') else 'full'} mode, "
+            f"{coll.get('last_payload_bytes', 0)} B last cycle, "
+            f"{coll.get('resyncs', 0)} resyncs over "
+            f"{coll.get('cycles', 0)} cycles")
+    tracer = ts.get("tracer") or {}
+    if tracer:
+        rate = float(tracer.get("eviction_rate", 0.0))
+        line = (f"  tracer: {tracer.get('ring_evictions', 0)} ring "
+                f"evictions ({rate:g}/s), "
+                f"{tracer.get('orphan_spans', 0)} orphan spans")
+        if rate > 0:
+            line += ("   WARNING: span rings are evicting — traces "
+                     "are being lost; raise tracer_ring_size")
+        lines.append(line)
+    st = ts.get("stats") or {}
+    if st:
+        lines.append(
+            f"  tsdb: {st.get('series', 0)} series, "
+            f"{st.get('points', 0)} points, "
+            f"{st.get('evictions', 0)} evictions")
+    if kernels:
+        ktab = ts.get("kernels") or {}
+        lines.append("  kernels (per codec signature):")
+        if not ktab:
+            lines.append("    (no device launches recorded)")
+        for sig, rec in sorted(ktab.items()):
+            lines.append(
+                f"    {sig:<28} {rec.get('launches', 0):>7} launches  "
+                f"{rec.get('stripes', 0):>8} stripes  "
+                f"{rec.get('wall_us', 0.0) / 1e3:>9.1f} ms  "
+                f"{rec.get('hbm_bytes', 0) / (1 << 20):>9.1f} MiB  "
+                f"{rec.get('gibps', 0.0):>7.2f} GiB/s  "
+                f"{rec.get('roofline_pct', 0.0):>5.1f}%")
+    return "\n".join(lines)
+
+
+async def _run_top(args, rados: Rados, as_json: bool) -> int:
+    """`ceph-tpu top`: the live observability rollup, refreshed from
+    the mon-persisted digest (works headless; --once for CI)."""
+    frames = 0
+    while True:
+        r = await rados.mon_command("ts status")
+        if r["rc"] != 0:
+            print(f"Error: {r['outs']} (rc={r['rc']})",
+                  file=sys.stderr)
+            return 1
+        data = r["data"] or {}
+        if as_json:
+            _print(data, True)
+        else:
+            print(_render_top(data, args.kernels), flush=True)
+        frames += 1
+        if args.once or (args.iterations and frames >= args.iterations):
+            return 0
+        await asyncio.sleep(max(0.1, args.interval))
+        if not as_json:
+            print()
+
+
 def _run_forensics(args) -> int:
     """`ceph-tpu forensics ls|show`: offline flight-recorder reader.
 
@@ -1175,6 +1301,22 @@ def _run_forensics(args) -> int:
           f"worst_daemon={b.get('worst_daemon') or '-'}  "
           f"daemons={','.join(sorted(b.get('daemons', {})))}")
     print(render_timeline(b.get("timeline", []), limit=args.limit))
+    # tsdb lead-up: the retention module attaches the last ten
+    # minutes of burn rates / rebuild GiB/s / class histograms at
+    # capture time — the trajectory INTO the violation
+    tsc = (b.get("modules") or {}).get("ts") or {}
+    series = tsc.get("series") or {}
+    if series:
+        print(f"lead-up ({tsc.get('window_s', 0):g}s of tsdb series "
+              "before capture):")
+        for name in sorted(series):
+            pts = series[name].get("points") or []
+            if not pts:
+                continue
+            vals = [p[1] for p in pts]
+            print(f"  {name:<36} n={len(pts):<4} "
+                  f"last={vals[-1]:<12g} min={min(vals):<12g} "
+                  f"max={max(vals):g}")
     return 0
 
 

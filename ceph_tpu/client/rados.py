@@ -56,6 +56,26 @@ def full_try():
         _FULL_TRY.reset(tok)
 
 
+# tenant/QoS class stamp: ops issued inside `with op_class("gold"):`
+# carry a "qclass" field the OSD routes into per-class latency
+# histograms (op_class_<label>_latency_us) — the attribution the
+# mgr's per-class SLO burn pairs are computed from.  Same contextvar
+# shape as full_try: one `with` covers an entire async flow.
+_OP_CLASS: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "rados_op_class", default=""
+)
+
+
+@contextlib.contextmanager
+def op_class(label: str):
+    """All ops issued inside are stamped with tenant class ``label``."""
+    tok = _OP_CLASS.set(str(label))
+    try:
+        yield
+    finally:
+        _OP_CLASS.reset(tok)
+
+
 class ObjectOperation:
     """Batched multi-op (librados ObjectWriteOperation/ReadOperation)."""
 
@@ -363,6 +383,8 @@ class IoCtx:
             extra["snapid"] = self.read_snap
         if _FULL_TRY.get():
             extra["flags"] = ["full_try"]
+        if _OP_CLASS.get():
+            extra["qclass"] = _OP_CLASS.get()
         reply = await self.rados.objecter.op_submit(
             self.pool_id, self._noid(oid), op.ops, timeout,
             extra=extra or None

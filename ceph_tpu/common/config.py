@@ -346,6 +346,55 @@ def global_options() -> list[Option]:
         Option("slo_clear_evals", int, 2,
                "consecutive clean evaluations before an active "
                "SLO_VIOLATION clears", Level.ADVANCED, min=1),
+        Option("slo_class_labels", str, "gold,bronze",
+               "tenant/QoS class labels ops may be stamped with "
+               "(loadgen --class, RGW access-key mapping); per-class "
+               "op_class_<label>_latency_us histograms and burn pairs "
+               "are evaluated for exactly these"),
+        Option("slo_class_map", str, "",
+               "RGW access-key -> tenant class assignments, comma/"
+               "space separated key=class pairs (e.g. "
+               "'benchkey=gold'); unmapped keys take the LAST label "
+               "of slo_class_labels (bronze)", runtime=True),
+        Option("slo_burn_fast_s", float, 300.0,
+               "fast window of the per-class multiwindow burn pair "
+               "(SRE 5m/1h model); scale down in tests/drills so the "
+               "pair resolves within a run", min=0.1, runtime=True),
+        Option("slo_burn_slow_s", float, 3600.0,
+               "slow window of the per-class multiwindow burn pair; "
+               "a class violates only while BOTH windows burn > 1.0 "
+               "(fast = still happening, slow = material budget "
+               "spend)", min=0.1, runtime=True),
+        # mgr time-series store (common/tsdb.py): bounded per-series
+        # ring buffers fed each digest cycle, three downsample tiers
+        Option("tsdb_raw_points", int, 720,
+               "raw-tier ring capacity per series (one point per "
+               "report cycle; 720 x 5s = 1h)", min=2),
+        Option("tsdb_minute_points", int, 1440,
+               "minute-tier ring capacity per series (sum/count/min/"
+               "max buckets; 1440 x 1m = 24h)", Level.ADVANCED, min=2),
+        Option("tsdb_hour_points", int, 336,
+               "hour-tier ring capacity per series (336 x 1h = 14d)",
+               Level.ADVANCED, min=2),
+        Option("tsdb_tier1_s", float, 60.0,
+               "minute-tier bucket width in seconds", Level.ADVANCED,
+               min=0.1),
+        Option("tsdb_tier2_s", float, 3600.0,
+               "hour-tier bucket width in seconds", Level.ADVANCED,
+               min=0.1),
+        Option("tsdb_max_series", int, 4096,
+               "catalog bound: series beyond this are dropped and "
+               "counted, never grown", Level.ADVANCED, min=1),
+        Option("tsdb_digest_points", int, 60,
+               "raw-tier tail points per series shipped in the 'tsdb' "
+               "digest section (what 'ceph-tpu top' reads through the "
+               "mon; bounds digest growth)", Level.ADVANCED, min=1),
+        Option("mgr_perf_collect_delta", bool, True,
+               "delta-encode mgr perf collection: OSDs ship only "
+               "counters changed since the last acked collect "
+               "(epoch-stamped, full resync on ack mismatch) — makes "
+               "the 1000-OSD collect payload sublinear; digest/tsdb "
+               "contents are bit-identical either way"),
         # adaptive QoS defense plane (mgr_qos): closes the SLO loop by
         # actuating mClock recovery shares, hedge timeouts, and RGW
         # admission from the live burn-rate signal

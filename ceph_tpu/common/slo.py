@@ -343,3 +343,98 @@ class SLOEngine:
                 "value": rec["value"] if rec["value"] is not None else 0.0,
             }
         return out
+
+
+def class_burn(hist: dict, targets: list[SLOTarget]) -> float:
+    """Instantaneous burn of one tenant class: its windowed class
+    histogram judged against every declared LATENCY objective, worst
+    one wins.  Classes share the cluster's latency targets — a class
+    burns when ITS ops miss the same bar everyone is held to."""
+    if not hist or not (hist.get("count") or 0):
+        return 0.0
+    burn = 0.0
+    for tgt in targets:
+        if tgt.kind != "latency":
+            continue
+        allowed = max(1e-9, 1.0 - tgt.quantile)
+        burn = max(burn, hist_frac_above(hist, tgt.threshold * 1000.0)
+                   / allowed)
+    return min(BURN_CAP, burn)
+
+
+class MultiWindowBurn:
+    """Per-class multiwindow burn pairs — the SRE-workbook 5m/1h
+    model PR 15 left open.
+
+    Each report cycle feeds one instantaneous burn sample per class;
+    the pair is the time-average over a FAST window (default 5m:
+    "it's still happening") and a SLOW window (default 1h: "it spent
+    material budget").  A class violates only while BOTH exceed 1.0 —
+    a brief spike can't page (slow window dilutes it) and a long-ago
+    incident can't page (fast window has recovered).  Raise/clear
+    hysteresis on top, same discipline as :class:`SLOEngine`.
+
+    Pure and timer-free: time comes from the caller, so the
+    known-answer hysteresis tests drive synthetic clocks and the
+    seed-7 storm replay gets the same edge sequence every run."""
+
+    def __init__(self, fast_s: float = 300.0, slow_s: float = 3600.0,
+                 raise_evals: int = 2, clear_evals: int = 2):
+        self.fast_s = float(fast_s)
+        self.slow_s = max(float(slow_s), self.fast_s)
+        self.raise_evals = max(1, int(raise_evals))
+        self.clear_evals = max(1, int(clear_evals))
+        self._samples: dict[str, deque[tuple[float, float]]] = {}
+        self._bad: dict[str, int] = {}
+        self._good: dict[str, int] = {}
+        self.active: dict[str, dict] = {}   # class -> last bad record
+        self.last_eval: dict[str, dict] = {}
+
+    def observe(self, t: float, clazz: str, burn: float) -> None:
+        dq = self._samples.setdefault(str(clazz), deque())
+        dq.append((float(t), float(burn)))
+        horizon = float(t) - self.slow_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+
+    @staticmethod
+    def _window_avg(dq: deque, t: float, span: float) -> float:
+        vals = [b for ts, b in dq if ts >= t - span]
+        return sum(vals) / len(vals) if vals else 0.0
+
+    def evaluate(self, t: float) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        for clazz, dq in sorted(self._samples.items()):
+            fast = self._window_avg(dq, t, self.fast_s)
+            slow = self._window_avg(dq, t, self.slow_s)
+            burning = fast > 1.0 and slow > 1.0
+            if burning:
+                self._good[clazz] = 0
+                self._bad[clazz] = self._bad.get(clazz, 0) + 1
+                if self._bad[clazz] >= self.raise_evals:
+                    self.active[clazz] = {
+                        "fast_burn": fast, "slow_burn": slow}
+            else:
+                self._bad[clazz] = 0
+                self._good[clazz] = self._good.get(clazz, 0) + 1
+                if (clazz in self.active
+                        and self._good[clazz] >= self.clear_evals):
+                    del self.active[clazz]
+            out[clazz] = {
+                "class": clazz,
+                "fast_burn": round(fast, 4),
+                "slow_burn": round(slow, 4),
+                "fast_window_s": self.fast_s,
+                "slow_window_s": self.slow_s,
+                "burning": burning,
+                "violating": clazz in self.active,
+            }
+        self.last_eval = out
+        return out
+
+    def worst(self) -> str | None:
+        """The violating class burning fastest (None while clear)."""
+        if not self.active:
+            return None
+        return max(self.active,
+                   key=lambda c: self.active[c]["fast_burn"])

@@ -268,6 +268,17 @@ class MgrStatMonitor(PaxosService):
             })
         if name == "iostat":
             return CommandResult(data=self.digest.get("iostat", {}))
+        if name == "ts status":
+            # the observability rollup `ceph-tpu top` renders: every
+            # section rides the mgr-report digest, so this works from
+            # any client that can reach the mon — no mgr socket needed
+            return CommandResult(data={
+                "tsdb": self.digest.get("tsdb", {}),
+                "slo": self.digest.get("slo", {}),
+                "utilization": self.digest.get("utilization", {}),
+                "qos": self.digest.get("qos", {}),
+                "health_checks": self.digest.get("health_checks", {}),
+            })
         if name == "rbd perf image iostat":
             rs = self.digest.get("rbd_support", {})
             return CommandResult(data=rs.get("image_iostat", {}))

@@ -662,7 +662,7 @@ class Monitor:
         # mgr-stat service (PGMap / balancer / progress / crash)
         if word in ("pg", "df", "balancer", "progress", "crash",
                     "device", "telemetry", "orch", "insights",
-                    "snap-schedule", "rbd", "iostat"):
+                    "snap-schedule", "rbd", "iostat", "ts"):
             return self.mgr_stat
         if prefix.startswith("osd perf "):
             # mgr osd_perf_query module surface, not the OSDMonitor

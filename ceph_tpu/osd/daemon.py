@@ -265,6 +265,24 @@ class OSDDaemon:
         self.perf.add("op_latency_us", CounterType.HISTOGRAM)
         self.perf.add("op_r_latency_us", CounterType.HISTOGRAM)
         self.perf.add("op_w_latency_us", CounterType.HISTOGRAM)
+        # per-tenant-class latency attribution: clients stamp a
+        # "qclass" on each op (loadgen --class / RGW access-key map)
+        # and the op records into op_class_<label>_latency_us too, so
+        # the mgr's per-class multiwindow burn pairs can name the
+        # burning tenant class.  Histograms pre-register for exactly
+        # the conf-declared labels; unknown stamps are ignored (a
+        # misbehaving client must not grow the counter set).
+        self._class_labels = tuple(
+            lbl.strip() for lbl in
+            str(self.conf["slo_class_labels"] or "").split(",")
+            if lbl.strip())
+        for lbl in self._class_labels:
+            self.perf.add(f"op_class_{lbl}_latency_us",
+                          CounterType.HISTOGRAM)
+        # delta-encoded perf collection (perf_dump_delta wire cmd):
+        # baseline + epoch live here, one per collector stream
+        from ceph_tpu.common.perf_collect import DeltaCollectEncoder
+        self._delta_encoder = DeltaCollectEncoder()
         # QoS op scheduler (mClockScheduler role) + op observability
         # (OpRequest/OpTracker role)
         from ceph_tpu.osd.scheduler import ClassProfile
@@ -425,6 +443,15 @@ class OSDDaemon:
             self.tracer.ring_evictions + self.msgr.tracer.ring_evictions)
         out["tracer_orphan_spans"] = (
             self.tracer.orphan_count() + self.msgr.tracer.orphan_count())
+        # kernel profiler table (ec/profiler.py): per-codec-signature
+        # launch attribution with derived roofline % — nested dict, not
+        # a counter; the mgr's tsdb/top surfaces consume it and the
+        # Prometheus renderer skips it
+        from ceph_tpu.ec.profiler import profiler_for
+        kernels = profiler_for(self.perf).dump(
+            peak_gibps=float(self.conf["ec_hbm_peak_gibps"] or 0.0))
+        if kernels:
+            out["ec_kernels"] = kernels
         return out
 
     def _dump_traces_all(self, trace_id=None) -> list[dict]:
@@ -952,6 +979,20 @@ class OSDDaemon:
                 conn.send_message(Message("perf_dump_reply", {
                     "tid": msg.data.get("tid", 0),
                     "counters": self._perf_dump_all(),
+                }))
+            except ConnectionError:
+                pass
+        elif t == "perf_dump_delta":
+            # delta-encoded collect: ship only counters changed since
+            # the collector's acked epoch (full resync on mismatch) —
+            # the sublinear-collect path of common/perf_collect.py
+            payload = self._delta_encoder.encode(
+                self._perf_dump_all(),
+                int(msg.data.get("ack_epoch", 0)))
+            try:
+                conn.send_message(Message("perf_dump_delta_reply", {
+                    "tid": msg.data.get("tid", 0),
+                    **payload,
                 }))
             except ConnectionError:
                 pass
@@ -4417,6 +4458,14 @@ class OSDDaemon:
             self.perf.hinc(
                 "op_w_latency_us" if mutating else "op_r_latency_us",
                 elapsed_us)
+            # tenant-class attribution: the client-stamped qclass
+            # routes the same sample into the class histogram the
+            # per-class burn pairs window (only conf-declared labels
+            # have a registered counter — others drop silently)
+            qclass = d.get("qclass")
+            if qclass in self._class_labels:
+                self.perf.hinc(f"op_class_{qclass}_latency_us",
+                               elapsed_us)
             if self._perf_queries and rc == OK:
                 self._perf_query_account(
                     pg, conn, str(d.get("oid", "")), ops, results,

@@ -579,6 +579,15 @@ class ECBackend:
         # reconstruction from the surviving shards (None/0 = off)
         self.hedge_timeout = hedge_timeout or None
         self.perf = perf if perf is not None else PerfCounters("ec")
+        # kernel profiler (ec/profiler.py): every device launch below
+        # attributes its wall time / stripes / bytes to this backend's
+        # codec signature, recorded at the SAME sites with the SAME
+        # values as the ec_*_launch_us and ec_launch_bytes counters —
+        # attribution of the counters, never a second measurement
+        from ceph_tpu.ec.profiler import profiler_for
+        self.codec_sig = (f"{type(codec).__name__.lower()}"
+                          f"-k{self.k}-m{self.m}")
+        self.profiler = profiler_for(self.perf)
         # shared Tracer (daemon-provided): sampled ops get their
         # coalesced device launch recorded into their trace tree
         self.tracer = tracer
@@ -788,8 +797,8 @@ class ECBackend:
         from ceph_tpu.ec.engine import pad_batch_pow2, pad_batch_pow2_device
 
         if self._is_device(stripes):
-            self.perf.inc("ec_launch_bytes",
-                          int(getattr(stripes, "nbytes", 0)))
+            in_bytes = int(getattr(stripes, "nbytes", 0))
+            self.perf.inc("ec_launch_bytes", in_bytes)
             stripes, b = pad_batch_pow2_device(stripes)
             if stripes.shape[0] != b:
                 self.perf.inc("ec_coalesce_pad_waste",
@@ -799,8 +808,10 @@ class ECBackend:
             t0 = time.perf_counter()
             out = await asyncio.to_thread(
                 self.ec.encode_chunks_device, stripes)
-            self.perf.hinc("ec_encode_launch_us",
-                           (time.perf_counter() - t0) * 1e6)
+            dt_us = (time.perf_counter() - t0) * 1e6
+            self.perf.hinc("ec_encode_launch_us", dt_us)
+            self.profiler.record(f"{self.codec_sig}:enc", dt_us,
+                                 stripes=b, hbm_bytes=in_bytes)
             return out[:b]
         in_bytes = stripes.nbytes if hasattr(stripes, "nbytes") else 0
         stripes, b = pad_batch_pow2(stripes)
@@ -816,8 +827,10 @@ class ECBackend:
                 ("enc",), lambda: self._mesh_gen[self.k:])
             parity = await asyncio.to_thread(ap, stripes)
             self.mesh_stats["encodes"] += 1
-            self.perf.hinc("ec_encode_launch_us",
-                           (time.perf_counter() - t0) * 1e6)
+            dt_us = (time.perf_counter() - t0) * 1e6
+            self.perf.hinc("ec_encode_launch_us", dt_us)
+            self.profiler.record(f"{self.codec_sig}:enc", dt_us,
+                                 stripes=b, hbm_bytes=in_bytes)
             out = np.concatenate(
                 [np.asarray(stripes, np.uint8), parity], axis=1)[:b]
             self.perf.inc("ec_resident_d2h_bytes", out.nbytes)
@@ -825,8 +838,10 @@ class ECBackend:
         out = np.asarray(await asyncio.to_thread(
             self.ec.encode_chunks_batch, stripes
         ))[:b]
-        self.perf.hinc("ec_encode_launch_us",
-                       (time.perf_counter() - t0) * 1e6)
+        dt_us = (time.perf_counter() - t0) * 1e6
+        self.perf.hinc("ec_encode_launch_us", dt_us)
+        self.profiler.record(f"{self.codec_sig}:enc", dt_us,
+                             stripes=b, hbm_bytes=in_bytes)
         self.perf.inc("ec_resident_d2h_bytes", out.nbytes)
         return out
 
@@ -882,14 +897,18 @@ class ECBackend:
                     self.perf.inc("ec_resident_d2h_bytes",
                                   out[w].nbytes)
                 self.mesh_stats["decodes"] += 1
-            self.perf.hinc("ec_decode_launch_us",
-                           (time.perf_counter() - t0) * 1e6)
+            dt_us = (time.perf_counter() - t0) * 1e6
+            self.perf.hinc("ec_decode_launch_us", dt_us)
+            self.profiler.record(f"{self.codec_sig}:dec", dt_us,
+                                 stripes=b, hbm_bytes=in_bytes)
             return out
         out = await asyncio.to_thread(
             self.ec.decode_chunks_batch, batched, missing
         )
-        self.perf.hinc("ec_decode_launch_us",
-                       (time.perf_counter() - t0) * 1e6)
+        dt_us = (time.perf_counter() - t0) * 1e6
+        self.perf.hinc("ec_decode_launch_us", dt_us)
+        self.profiler.record(f"{self.codec_sig}:dec", dt_us,
+                             stripes=b, hbm_bytes=in_bytes)
         res = {w: np.asarray(c)[:b] for w, c in out.items()}
         # only rebuilt chunks cross back down; available targets are
         # passed through as the same host arrays
@@ -917,8 +936,9 @@ class ECBackend:
             self.mesh_stats["decode_buckets"].add(int(bp))
             avail = padded
         self.perf.inc("ec_device_launches")
-        self.perf.inc("ec_launch_bytes", sum(
-            int(getattr(c, "nbytes", 0)) for c in batched.values()))
+        in_bytes = sum(
+            int(getattr(c, "nbytes", 0)) for c in batched.values())
+        self.perf.inc("ec_launch_bytes", in_bytes)
         t0 = time.perf_counter()
         out = {w: batched[w][:b] for w in missing if w in batched}
         todo = [w for w in missing if w not in batched]
@@ -929,8 +949,10 @@ class ECBackend:
                 self.ec.decode_chunks_device, avail, todo)
             for i, w in enumerate(todo):
                 out[w] = rebuilt[:b, i]
-        self.perf.hinc("ec_decode_launch_us",
-                       (time.perf_counter() - t0) * 1e6)
+        dt_us = (time.perf_counter() - t0) * 1e6
+        self.perf.hinc("ec_decode_launch_us", dt_us)
+        self.profiler.record(f"{self.codec_sig}:dec", dt_us,
+                             stripes=b, hbm_bytes=in_bytes)
         return out
 
     # -- cross-op coalescing (CoalescedLauncher front ends) ---------------
@@ -1143,6 +1165,9 @@ class ECBackend:
         launch_us = (time.perf_counter() - t0) * 1e6
         self.perf.hinc("ec_decode_launch_us", launch_us)
         self.perf.hinc("ec_mesh_launch_us", launch_us)
+        self.profiler.record(f"{self.codec_sig}:mesh-repair",
+                             launch_us, stripes=b,
+                             hbm_bytes=chunks.nbytes)
         self.perf.inc("ec_mesh_ici_bytes", moved)
         self.perf.inc("ec_mesh_ici_whole_bytes", whole)
         self.perf.inc("ec_resident_d2h_bytes", rec.nbytes)
@@ -2558,8 +2583,11 @@ class ECBackend:
         t0 = time.perf_counter()
         rec = await asyncio.to_thread(
             batched_lrc_group_repair, self.ec, plan.matrix, stacked)
-        self.perf.hinc("ec_decode_launch_us",
-                       (time.perf_counter() - t0) * 1e6)
+        dt_us = (time.perf_counter() - t0) * 1e6
+        self.perf.hinc("ec_decode_launch_us", dt_us)
+        self.profiler.record(f"{self.codec_sig}:dec", dt_us,
+                             stripes=stacked.shape[0],
+                             hbm_bytes=stacked.nbytes)
         self.perf.inc("ec_resident_d2h_bytes", rec.nbytes)
         return rec
 
@@ -2576,8 +2604,11 @@ class ECBackend:
         t0 = time.perf_counter()
         rec = await asyncio.to_thread(
             batched_clay_plane_repair, self.ec, plan.matrix, flat)
-        self.perf.hinc("ec_decode_launch_us",
-                       (time.perf_counter() - t0) * 1e6)
+        dt_us = (time.perf_counter() - t0) * 1e6
+        self.perf.hinc("ec_decode_launch_us", dt_us)
+        self.profiler.record(f"{self.codec_sig}:dec", dt_us,
+                             stripes=flat.shape[0],
+                             hbm_bytes=flat.nbytes)
         self.perf.inc("ec_resident_d2h_bytes", rec.nbytes)
         return rec
 

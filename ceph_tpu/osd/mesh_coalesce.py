@@ -339,6 +339,21 @@ class MeshCoalescer:
         perf0.inc("ec_device_launches")
         perf0.tinc("ec_mesh_occupancy", len(live))
         perf0.hinc("ec_mesh_launch_us", launch_us)
+        # kernel profiler: the shared sharded launch attributes to the
+        # codec signature (same profile across batchmates by keying);
+        # bytes = each op's payload, the quantity the h2d accounting
+        # below the launch moves
+        be0 = live[0].backend
+        kind = "mesh-enc" if full_key[1][0] == "enc" else "mesh-dec"
+        if full_key[1][0] == "enc":
+            hbm = sum(int(getattr(it.payload, "nbytes", 0))
+                      for it in live)
+        else:
+            hbm = sum(int(getattr(c, "nbytes", 0))
+                      for it in live for c in it.payload.values())
+        be0.profiler.record(f"{be0.codec_sig}:{kind}", launch_us,
+                            stripes=sum(it.nstripes for it in live),
+                            hbm_bytes=hbm)
         # the launcher is a host singleton shared across OSDs, so mesh
         # launches land in the process journal (like failpoints), not
         # an arbitrary member backend's daemon ring

@@ -13,6 +13,10 @@ exposition endpoint and the restful module's programmatic API
   worst daemon) + utilization telemetry rates from the slo mgr module.
 - ``GET /api/qos``     QoS defense-plane state from the qos mgr module
   (AIMD recovery limit, pushed hedge timeouts, front-door sheds).
+- ``GET /api/ts``      time-series query against the mgr's retention
+  store (``?name=`` one series, ``?prefix=`` a namespace, ``start`` /
+  ``end`` / ``tier=raw|1m|1h|auto`` / ``max_points``; no args lists
+  the catalog).
 - ``GET /metrics``     prometheus text exposition of the mgr's last
   digest (the pybind/mgr/prometheus serve role) plus the SLO burn-rate
   and utilization gauges.
@@ -173,6 +177,20 @@ class Dashboard:
                 body = json.dumps({
                     "qos": digest.get("qos", {}),
                 }).encode()
+                ctype, status = "application/json", 200
+            elif path == "/api/ts":
+                # time-series query against the retention module; the
+                # same planner the asok `ts query` command uses
+                def _qf(k):
+                    v = query.get(k, "")
+                    return float(v) if v else None
+                body = json.dumps(self.mgr.ts_query(
+                    name=query.get("name", ""),
+                    prefix=query.get("prefix", ""),
+                    start=_qf("start"), end=_qf("end"),
+                    tier=query.get("tier", "auto"),
+                    max_points=int(query.get("max_points", "0") or 0),
+                )).encode()
                 ctype, status = "application/json", 200
             elif path == "/metrics":
                 # collect() messages every OSD; cache briefly so an

@@ -27,7 +27,12 @@ from __future__ import annotations
 import time
 
 from ceph_tpu.common.perf import hist_quantile
-from ceph_tpu.common.slo import SLOEngine, targets_from_conf
+from ceph_tpu.common.slo import (
+    MultiWindowBurn,
+    SLOEngine,
+    class_burn,
+    targets_from_conf,
+)
 from ceph_tpu.services.mgr_modules import MgrModule
 
 
@@ -39,10 +44,23 @@ class SLOMonitor(MgrModule):
         self.engine: SLOEngine | None = None
         self.last_eval: list[dict] = []
         self.util: dict = {}
+        # per-tenant-class multiwindow burn pairs (5m/1h): built
+        # lazily from conf like the engine; class_eval holds the last
+        # evaluate() output for the digest/tsdb/health surfaces
+        self.class_burns: MultiWindowBurn | None = None
+        self._class_labels: tuple[str, ...] = ()
+        self.class_eval: dict[str, dict] = {}
+        self.class_hists: dict[str, dict] = {}  # cls -> window hist
+        # the last per-daemon snapshot collect() produced — the tsdb
+        # retention module (which runs after us) harvests counters
+        # from it instead of issuing a second collect
+        self.last_snap: dict[str, dict] = {}
         # forensic auto-capture transition tracking: a capture fires
-        # on the RAISE edge of SLO_VIOLATION (engine) and SLOW_OPS
-        # (mon health), never while the condition merely persists
+        # on the RAISE edge of SLO_VIOLATION (engine or tenant class)
+        # and SLOW_OPS (mon health), never while the condition merely
+        # persists
         self._prev_active: set[str] = set()
+        self._prev_class_active: set[str] = set()
         self._slow_ops_raised = False
 
     def _ensure_engine(self) -> SLOEngine:
@@ -59,18 +77,46 @@ class SLOMonitor(MgrModule):
             )
         return self.engine
 
+    def _ensure_classes(self) -> MultiWindowBurn:
+        if self.class_burns is None:
+            conf = self.mgr.conf
+            self._class_labels = tuple(
+                s.strip()
+                for s in str(conf["slo_class_labels"] or "").split(",")
+                if s.strip())
+            self.class_burns = MultiWindowBurn(
+                fast_s=float(conf["slo_burn_fast_s"]),
+                slow_s=float(conf["slo_burn_slow_s"]),
+                raise_evals=int(conf["slo_raise_evals"]),
+                clear_evals=int(conf["slo_clear_evals"]),
+            )
+        return self.class_burns
+
     async def serve_once(self) -> None:
         eng = self._ensure_engine()
         snap = await self.mgr.collect()
         per_daemon = {f"osd.{o}": counters
                       for o, counters in snap["osd_perf"].items()}
-        eng.observe(time.monotonic(), per_daemon)
+        self.last_snap = per_daemon
+        now = time.monotonic()
+        eng.observe(now, per_daemon)
         # recovery state from the previous cycle's digest (this cycle's
         # is being built around us) — one report_interval of lag on the
         # rebuild-floor objective, never on the latency objectives
         digest = self.mgr.last_digest or {}
         recovery = int(digest.get("degraded_objects", 0)) > 0
         self.last_eval = eng.evaluate(recovery_active=recovery)
+        # per-class attribution: each class's windowed histogram judged
+        # against the SAME latency objectives everyone is held to, fed
+        # into the 5m/1h multiwindow pair
+        cb = self._ensure_classes()
+        if self._class_labels:
+            win = eng.snapshot_window()
+            for cls in self._class_labels:
+                merged, _ = win.hist(f"op_class_{cls}_latency_us")
+                self.class_hists[cls] = merged
+                cb.observe(now, cls, class_burn(merged, eng.targets))
+            self.class_eval = cb.evaluate(now)
         self.util = self._utilization(eng)
         await self._forensic_triggers(eng, snap)
 
@@ -90,6 +136,19 @@ class SLOMonitor(MgrModule):
             jr.emit("slo.clear", objective=obj)
         slo_raised = bool(active - self._prev_active)
         self._prev_active = active
+        # tenant-class raise/clear edges mirror the objective edges:
+        # journaled for the flight recorder, capture-triggering below
+        cb = self.class_burns
+        class_active = set(cb.active) if cb is not None else set()
+        for cls in sorted(class_active - self._prev_class_active):
+            rec = cb.active[cls]
+            jr.emit("slo.class_raise", tenant_class=cls,
+                    fast_burn=round(float(rec["fast_burn"]), 3),
+                    slow_burn=round(float(rec["slow_burn"]), 3))
+        for cls in sorted(self._prev_class_active - class_active):
+            jr.emit("slo.class_clear", tenant_class=cls)
+        class_raised = bool(class_active - self._prev_class_active)
+        self._prev_class_active = class_active
         # SLOW_OPS comes from the mon's health map (OSD beacons), so
         # read it off the status snapshot collect() already fetched
         checks = ((snap.get("status") or {}).get("health") or {}) \
@@ -97,18 +156,25 @@ class SLOMonitor(MgrModule):
         slow = checks.get("SLOW_OPS")
         slow_raised = slow is not None and not self._slow_ops_raised
         self._slow_ops_raised = slow is not None
-        if not (slo_raised or slow_raised):
+        if not (slo_raised or slow_raised or class_raised):
             return
-        if slo_raised:
-            payload = eng.health_checks().get("SLO_VIOLATION", {})
-            worst_obj = max(eng.active,
-                            key=lambda o: eng.active[o]["burn_rate"])
-            worst = eng.active[worst_obj].get("worst_daemon") or ""
+        if slo_raised or class_raised:
+            payload = self.health_checks().get("SLO_VIOLATION", {})
+            worst = ""
+            worst_obj = ""
+            if eng.active:
+                worst_obj = max(
+                    eng.active,
+                    key=lambda o: eng.active[o]["burn_rate"])
+                worst = eng.active[worst_obj].get("worst_daemon") or ""
             await self.mgr.maybe_auto_capture(
                 "SLO_VIOLATION", worst_daemon=worst,
                 detail={"message": payload.get("message", ""),
                         "detail": payload.get("detail", []),
-                        "objective": worst_obj})
+                        "objective": worst_obj,
+                        "tenant_class":
+                            (cb.worst() if cb is not None else None)
+                            or ""})
         else:
             await self.mgr.maybe_auto_capture(
                 "SLOW_OPS",
@@ -175,17 +241,52 @@ class SLOMonitor(MgrModule):
 
     # -- mgr surfaces ------------------------------------------------------
     def health_checks(self) -> dict[str, dict]:
-        if self.engine is None:
-            return {}
-        return self.engine.health_checks()
+        """``SLO_VIOLATION`` naming the burning tenant class alongside
+        the worst daemon.  Three shapes: objective-only (engine
+        violations, no class burning), merged (class detail appended to
+        the engine's payload), and class-only (a standalone raise when
+        a class pair violates while every cluster objective is ok —
+        e.g. a small gold tenant drowning inside a healthy average)."""
+        base = self.engine.health_checks() if self.engine else {}
+        cb = self.class_burns
+        if cb is None or not cb.active:
+            return base
+        worst_cls = cb.worst() or ""
+        wrec = cb.active.get(worst_cls, {})
+        cls_msg = (f"tenant class {worst_cls} burning "
+                   f"{float(wrec.get('fast_burn', 0.0)):.2f}x (5m) / "
+                   f"{float(wrec.get('slow_burn', 0.0)):.2f}x (1h)")
+        cls_detail = []
+        for cls, rec in sorted(cb.active.items()):
+            cls_detail.append(
+                f"tenant class {cls}: fast burn "
+                f"{float(rec.get('fast_burn', 0.0)):.2f}x / slow burn "
+                f"{float(rec.get('slow_burn', 0.0)):.2f}x")
+        slo = base.get("SLO_VIOLATION")
+        if slo is None:
+            return {**base, "SLO_VIOLATION": {
+                "severity": "HEALTH_WARN",
+                "message": cls_msg,
+                "detail": cls_detail,
+                "count": len(cb.active),
+                "tenant_class": worst_cls,
+            }}
+        slo = dict(slo)
+        slo["message"] = f"{slo.get('message', '')}; {cls_msg}"
+        slo["detail"] = list(slo.get("detail", ())) + cls_detail
+        slo["tenant_class"] = worst_cls
+        return {**base, "SLO_VIOLATION": slo}
 
     def digest_contrib(self) -> dict:
         eng = self.engine
+        cb = self.class_burns
         return {
             "slo": {
                 "objectives": self.last_eval,
                 "violations": sorted(eng.active) if eng else [],
                 "window_s": eng.window_span() if eng else 0.0,
+                "classes": self.class_eval,
+                "class_violations": sorted(cb.active) if cb else [],
             },
             "utilization": self.util,
         }
@@ -202,6 +303,20 @@ class SLOMonitor(MgrModule):
                 lab = prom_label(objective=obj)
                 for k in per_obj:
                     per_obj[k].append((lab, float(vals[k])))
+        if self.class_eval:
+            from ceph_tpu.services.mgr import prom_label
+
+            fast, slow = [], []
+            for cls, rec in sorted(self.class_eval.items()):
+                lab = prom_label(tenant_class=cls)
+                fast.append((lab, float(rec.get("fast_burn", 0.0))))
+                slow.append((lab, float(rec.get("slow_burn", 0.0))))
+            out["ceph_slo_class_fast_burn"] = {
+                "help": "tenant-class error-budget burn over the fast "
+                        "(5m) window", "samples": fast}
+            out["ceph_slo_class_slow_burn"] = {
+                "help": "tenant-class error-budget burn over the slow "
+                        "(1h) window", "samples": slow}
         out["ceph_slo_burn_rate"] = {
             "help": "error-budget burn rate per SLO objective "
                     "(1.0 = spending exactly the allowed budget)",

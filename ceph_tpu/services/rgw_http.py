@@ -317,7 +317,8 @@ class S3Frontend:
                     continue
                 self._inflight += 1
                 try:
-                    status, headers, body = await self._route(req)
+                    with self._class_ctx(req):
+                        status, headers, body = await self._route(req)
                 except _HTTPError as e:
                     status, headers, body = self._error(e.status, e.code,
                                                         e.msg)
@@ -493,6 +494,40 @@ class S3Frontend:
         if cred:
             return cred.split("/", 1)[0]
         return "anonymous"
+
+    def _tenant_class(self, req: _Request) -> str:
+        """Tenant class for this request: ``slo_class_map`` access-key
+        assignment, defaulting to the LAST ``slo_class_labels`` label
+        (bronze) for unmapped keys.  The class rides the rados qclass
+        contextvar into per-class OSD latency histograms — the S3-side
+        half of the attribution plane (in-process rados clients stamp
+        themselves via loadgen's tenant_class)."""
+        import re as _re
+        try:
+            conf = self.rgw.ioctx.rados.conf
+            labels = [lbl.strip() for lbl in
+                      str(conf["slo_class_labels"] or "").split(",")
+                      if lbl.strip()]
+            spec = str(conf["slo_class_map"] or "")
+        except (AttributeError, KeyError, TypeError):
+            return ""
+        if not labels:
+            return ""
+        mapping = {}
+        for part in _re.split(r"[,\s]+", spec.strip()):
+            if part and "=" in part:
+                k, _, v = part.partition("=")
+                mapping[k.strip()] = v.strip()
+        cls = mapping.get(self._session_key(req), labels[-1])
+        return cls if cls in labels else labels[-1]
+
+    def _class_ctx(self, req: _Request):
+        cls = self._tenant_class(req)
+        if not cls:
+            import contextlib
+            return contextlib.nullcontext()
+        from ceph_tpu.client.rados import op_class
+        return op_class(cls)
 
     def _admission(self, req: _Request):
         """Queue-depth gate + per-session token bucket.  Returns a

@@ -222,11 +222,16 @@ class LoadGen:
                  read_fraction: float = 0.7, n_keys: int = 64,
                  zipf_s: float = 1.1,
                  size_mix: list[tuple[int, float]] | None = None,
-                 duration: float | None = None):
+                 duration: float | None = None,
+                 tenant_class: str = ""):
         if mode not in ("closed", "open"):
             raise ValueError(f"mode {mode!r} not in ('closed', 'open')")
         self.backend = backend
         self.seed = seed
+        # tenant/QoS class every issued op is stamped with (rados
+        # qclass contextvar -> per-class OSD histograms); S3 traffic
+        # is instead classed server-side by the RGW access-key map
+        self.tenant_class = str(tenant_class or "")
         self.mode = mode
         self.clients = max(1, int(clients))
         self.rate = float(rate)
@@ -293,18 +298,28 @@ class LoadGen:
 
         await asyncio.gather(*(one(k, s) for k, s in sizes.items()))
 
+    def _class_ctx(self):
+        """Context stamping ops with the generator's tenant class
+        (no-op when unclassed)."""
+        if not self.tenant_class:
+            import contextlib
+            return contextlib.nullcontext()
+        from ceph_tpu.client.rados import op_class
+        return op_class(self.tenant_class)
+
     async def _issue(self, op: dict) -> None:
         t0 = time.monotonic()
         try:
-            if op["op"] == "put":
-                data = _payload(op["key"], op["size"])
-                await self.backend.put(op["key"], data)
-                self.perf.inc("puts")
-                self.perf.inc("bytes_put", len(data))
-            else:
-                data = await self.backend.get(op["key"])
-                self.perf.inc("gets")
-                self.perf.inc("bytes_get", len(data))
+            with self._class_ctx():
+                if op["op"] == "put":
+                    data = _payload(op["key"], op["size"])
+                    await self.backend.put(op["key"], data)
+                    self.perf.inc("puts")
+                    self.perf.inc("bytes_put", len(data))
+                else:
+                    data = await self.backend.get(op["key"])
+                    self.perf.inc("gets")
+                    self.perf.inc("bytes_get", len(data))
         except Exception:
             self.perf.inc("errors")
         else:
@@ -369,6 +384,7 @@ class LoadGen:
         ops = int(dump.get("ops", 0))
         return {
             "seed": self.seed, "mode": self.mode,
+            "tenant_class": self.tenant_class,
             "clients": self.clients,
             "ops": ops, "errors": int(dump.get("errors", 0)),
             # admission-control sheds the backend absorbed via
